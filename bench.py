@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Benchmark: L7 policy verdicts/sec on TPU.
+
+Primary config (BASELINE.json configs[1]): 1k HTTP path/header regex
+rules × 10k Hubble-replayed HTTP flows; the engine computes the full
+L3/L4 + L7 verdict per flow. Baseline target: 10M verdicts/sec/chip
+(`BASELINE.json ·north_star`); ``vs_baseline`` = value / 10e6.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Usage: python bench.py [--rules 1000] [--flows 10000] [--iters 20]
+       [--batch 16384] [--config http] [--check]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="http",
+                    choices=["http", "fqdn", "kafka"])
+    ap.add_argument("--rules", type=int, default=1000)
+    ap.add_argument("--flows", type=int, default=10000)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--check", action="store_true",
+                    help="verify engine vs oracle on a sample first")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from cilium_tpu.core.config import Config
+    from cilium_tpu.engine.verdict import (
+        encode_flows,
+        flowbatch_to_device,
+        verdict_step,
+    )
+    from cilium_tpu.ingest import synth
+    from cilium_tpu.runtime.loader import Loader
+    from cilium_tpu.runtime.metrics import SpanStat
+
+    def log(msg: str) -> None:
+        if args.verbose:
+            print(msg, file=sys.stderr)
+
+    if args.config == "http":
+        scenario = synth.synth_http_scenario(n_rules=args.rules,
+                                             n_flows=args.flows)
+    elif args.config == "fqdn":
+        scenario = synth.synth_fqdn_scenario(n_names=100, n_rules=args.rules,
+                                             n_flows=args.flows)
+    else:
+        scenario = synth.synth_kafka_scenario(n_rules=args.rules,
+                                              n_records=args.flows)
+    per_identity, scenario = synth.realize_scenario(scenario)
+
+    cfg = Config.from_env()
+    cfg.enable_tpu_offload = True
+    loader = Loader(cfg)
+    with SpanStat("bench_compile") as compile_span:
+        engine = loader.regenerate(per_identity, revision=1)
+    log(f"compile+stage: {compile_span.seconds:.1f}s "
+        f"(cache dir {cfg.loader.cache_dir})")
+
+    if args.check:
+        from cilium_tpu.policy.oracle import OracleVerdictEngine
+
+        sample = scenario.flows[:500]
+        want = OracleVerdictEngine(per_identity).verdict_flows(sample)["verdict"]
+        got = engine.verdict_flows(sample)["verdict"]
+        bad = int((got != want).sum())
+        if bad:
+            print(json.dumps({"metric": "bench_failed_check",
+                              "value": bad, "unit": "mismatches",
+                              "vs_baseline": 0.0}))
+            return 1
+        log("oracle check: OK")
+
+    fb = encode_flows(scenario.flows, engine.policy.kafka_interns, cfg.engine)
+    batch = flowbatch_to_device(fb)
+    step = jax.jit(verdict_step)
+    arrays = engine._arrays
+
+    out = step(arrays, batch)
+    jax.block_until_ready(out)  # compile
+    for _ in range(args.warmup):
+        out = step(arrays, batch)
+    jax.block_until_ready(out)
+
+    times = []
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        out = step(arrays, batch)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    med = times[len(times) // 2]
+    n = len(scenario.flows)
+    vps = n / med
+    log(f"batch={n} median={med*1e3:.2f}ms p99-ish={times[-1]*1e3:.2f}ms "
+        f"verdicts/s={vps:,.0f}")
+    log(f"verdict mix: {np.bincount(np.asarray(out['verdict']), minlength=6).tolist()}")
+
+    print(json.dumps({
+        "metric": f"l7_verdicts_per_sec_{args.config}_{args.rules}rules",
+        "value": round(vps, 1),
+        "unit": "verdicts/s",
+        "vs_baseline": round(vps / 10e6, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

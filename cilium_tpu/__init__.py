@@ -1,0 +1,34 @@
+"""cilium_tpu — a TPU-native policy-verdict framework.
+
+A ground-up re-design of the capabilities of ``uniberg/cilium`` (an
+eBPF-based Kubernetes CNI with L3–L7 network policy) for TPU hardware:
+
+* Cilium-style rule sets (CiliumNetworkPolicy YAML; L7 HTTP/Kafka rules;
+  toFQDNs ``matchPattern`` globs — reference semantics in
+  ``pkg/policy/api`` and ``pkg/fqdn/matchpattern``) are **compiled** on the
+  host into finite automata and exact-match tables packed as JAX arrays.
+* Policy evaluation — the reference's per-packet eBPF policy-map lookup
+  (``bpf/lib/policy.h``) plus the per-request Envoy/proxylib L7 match
+  (``proxylib/``, ``pkg/envoy``) — becomes one batched, vmap'd/sharded
+  state-machine computation over ``(src-identity, dst-identity, L7-field)``
+  tuples streamed from Hubble flow exports.
+* The accelerator path is gated behind a proxylib-style parser plugin
+  interface and a loader (mirroring ``pkg/datapath/loader``), opt-in via
+  the ``enable_tpu_offload`` feature flag; a CPU oracle matcher remains the
+  default, mirroring how the reference keeps eBPF/Envoy as the default.
+
+Package map (≈ reference layer map, see SURVEY.md §1):
+
+====================  =====================================================
+``cilium_tpu.core``    labels, numeric identities, flow model, config
+``cilium_tpu.policy``  rule API + repository + SelectorCache + MapState
+``cilium_tpu.policy.compiler``  rules → NFA/DFA → packed tensors; CPU oracle
+``cilium_tpu.engine``  JAX/Pallas verdict kernels (the "datapath")
+``cilium_tpu.ingest``  Hubble flow JSONL ingest + synthetic generators
+``cilium_tpu.runtime`` loader (tensor staging/revision swap), metrics,
+                       checkpoint cache, verdict service
+``cilium_tpu.parallel`` device meshes, DP/EP/CP shardings, multi-host
+====================  =====================================================
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,46 @@
+"""Core domain model: labels, identities, flows, configuration.
+
+Pure Python (no JAX) — mirrors the reference's ``pkg/labels``,
+``pkg/identity`` and ``api/v1/flow`` at the semantic level.
+"""
+
+from cilium_tpu.core.labels import Label, LabelSet, ParseLabel
+from cilium_tpu.core.identity import (
+    NumericIdentity,
+    ReservedIdentity,
+    IdentityAllocator,
+    IDENTITY_USER_MIN,
+)
+from cilium_tpu.core.flow import (
+    Flow,
+    HTTPInfo,
+    KafkaInfo,
+    DNSInfo,
+    L7Type,
+    TrafficDirection,
+    Verdict,
+    Protocol,
+)
+from cilium_tpu.core.config import Config, EngineConfig, LoaderConfig, ParallelConfig
+
+__all__ = [
+    "Label",
+    "LabelSet",
+    "ParseLabel",
+    "NumericIdentity",
+    "ReservedIdentity",
+    "IdentityAllocator",
+    "IDENTITY_USER_MIN",
+    "Flow",
+    "HTTPInfo",
+    "KafkaInfo",
+    "DNSInfo",
+    "L7Type",
+    "TrafficDirection",
+    "Verdict",
+    "Protocol",
+    "Config",
+    "EngineConfig",
+    "LoaderConfig",
+    "ParallelConfig",
+]

@@ -1,0 +1,102 @@
+"""Configuration tree.
+
+Mirrors the reference's config discipline (``pkg/option/config.go``
+DaemonConfig + per-cell config structs + feature gates — SURVEY.md §2.4,
+§5.6): typed dataclasses, environment/TOML overrides, and one master
+feature gate ``enable_tpu_offload`` (analog of gates like
+``--enable-l7-proxy``). The default path is the CPU oracle matcher; the
+TPU engine is opt-in, mirroring how the reference keeps eBPF/Envoy as the
+default datapath.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Tuple
+
+try:  # tomllib is stdlib on 3.11+
+    import tomllib  # type: ignore
+except Exception:  # pragma: no cover
+    tomllib = None
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Verdict-engine (datapath) knobs."""
+
+    # Automaton packing
+    bank_size: int = 64            # patterns per DFA bank (EP shard unit)
+    max_dfa_states: int = 8192     # per-bank subset-construction cap
+    max_quantifier: int = 64       # {m,n} expansion cap (sanitize rejects above)
+    # Input bucketing (variable-length strings → fixed buckets)
+    dns_name_len: int = 256        # DNS names are ≤255 bytes + NUL
+    http_path_buckets: Tuple[int, ...] = (32, 64, 128, 256)
+    http_host_len: int = 128
+    http_method_len: int = 16
+    kafka_topic_len: int = 256
+    kafka_client_id_len: int = 64
+    # Batching
+    batch_size: int = 8192
+    # dtype for transition tables
+    trans_dtype: str = "int32"
+
+
+@dataclasses.dataclass
+class LoaderConfig:
+    """Tensor staging / artifact cache (analog of pkg/datapath/loader)."""
+
+    cache_dir: str = os.path.expanduser("~/.cache/cilium_tpu")
+    enable_cache: bool = True
+
+
+@dataclasses.dataclass
+class ParallelConfig:
+    """Mesh / sharding layout (SURVEY.md §2.6)."""
+
+    data_axis: str = "data"        # DP over the flow batch
+    expert_axis: str = "expert"    # EP over DFA banks
+    mesh_shape: Optional[Tuple[int, ...]] = None  # None → all devices on data
+    use_expert_axis: bool = False
+
+
+@dataclasses.dataclass
+class Config:
+    """Root config (DaemonConfig analog)."""
+
+    enable_tpu_offload: bool = False   # master feature gate (north star)
+    engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
+    loader: LoaderConfig = dataclasses.field(default_factory=LoaderConfig)
+    parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+    log_level: str = "info"
+    enable_metrics: bool = True
+
+    @classmethod
+    def from_env(cls, env=os.environ) -> "Config":
+        cfg = cls()
+        if env.get("CILIUM_TPU_ENABLE_OFFLOAD", "").lower() in ("1", "true", "yes"):
+            cfg.enable_tpu_offload = True
+        if "CILIUM_TPU_BANK_SIZE" in env:
+            cfg.engine.bank_size = int(env["CILIUM_TPU_BANK_SIZE"])
+        if "CILIUM_TPU_BATCH_SIZE" in env:
+            cfg.engine.batch_size = int(env["CILIUM_TPU_BATCH_SIZE"])
+        if "CILIUM_TPU_CACHE_DIR" in env:
+            cfg.loader.cache_dir = env["CILIUM_TPU_CACHE_DIR"]
+        return cfg
+
+    @classmethod
+    def from_toml(cls, path: str) -> "Config":
+        if tomllib is None:  # pragma: no cover
+            raise RuntimeError("tomllib unavailable")
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+        cfg = cls()
+        cfg.enable_tpu_offload = bool(data.get("enable_tpu_offload",
+                                               cfg.enable_tpu_offload))
+        for section, target in (("engine", cfg.engine),
+                                ("loader", cfg.loader),
+                                ("parallel", cfg.parallel)):
+            for k, v in data.get(section, {}).items():
+                if hasattr(target, k):
+                    setattr(target, k, tuple(v) if isinstance(v, list) else v)
+        return cfg

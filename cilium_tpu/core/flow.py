@@ -1,0 +1,119 @@
+"""Flow model — the engine's unit of work.
+
+Mirrors the Hubble flow proto (reference: ``api/v1/flow/flow.proto``,
+``flowpb.Flow`` — SURVEY.md §2.5) restricted to the fields the verdict
+engine consumes: identities, L4 5-tuple-ish info, traffic direction, and
+the L7 record (HTTP / Kafka / DNS).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Optional, Tuple
+
+
+class Protocol(enum.IntEnum):
+    """IP next-header protocol numbers (subset)."""
+
+    ANY = 0
+    ICMP = 1
+    TCP = 6
+    UDP = 17
+    SCTP = 132
+
+
+class TrafficDirection(enum.IntEnum):
+    # values mirror the policy-map key encoding: 0=egress, 1=ingress
+    EGRESS = 0
+    INGRESS = 1
+
+
+class Verdict(enum.IntEnum):
+    """Flow verdicts (flowpb.Verdict subset)."""
+
+    VERDICT_UNKNOWN = 0
+    FORWARDED = 1
+    DROPPED = 2
+    ERROR = 3
+    AUDIT = 4
+    REDIRECTED = 5
+
+
+class L7Type(enum.IntEnum):
+    NONE = 0
+    HTTP = 1
+    KAFKA = 2
+    DNS = 3
+
+
+class PolicyMatchType(enum.IntEnum):
+    """flowpb policy_match_type values (SURVEY.md §2.5)."""
+
+    NONE = 0
+    L3_L4 = 1
+    L3_ONLY = 2
+    L4_ONLY = 3
+    ALL = 4
+    L7 = 5  # engine extension: matched at L7
+
+
+@dataclasses.dataclass
+class HTTPInfo:
+    method: str = ""
+    path: str = ""
+    host: str = ""
+    headers: Tuple[Tuple[str, str], ...] = ()
+    protocol: str = "HTTP/1.1"
+    code: int = 0
+
+
+@dataclasses.dataclass
+class KafkaInfo:
+    api_key: int = 0
+    api_version: int = 0
+    client_id: str = ""
+    topic: str = ""
+    correlation_id: int = 0
+
+
+@dataclasses.dataclass
+class DNSInfo:
+    query: str = ""
+    qtypes: Tuple[str, ...] = ("A",)
+    rcode: int = 0
+    ips: Tuple[str, ...] = ()
+    ttl: int = 0
+
+
+@dataclasses.dataclass
+class Flow:
+    """One flow/request tuple to be verdicted."""
+
+    src_identity: int = 0
+    dst_identity: int = 0
+    dport: int = 0
+    protocol: Protocol = Protocol.TCP
+    direction: TrafficDirection = TrafficDirection.INGRESS
+    l7: L7Type = L7Type.NONE
+    http: Optional[HTTPInfo] = None
+    kafka: Optional[KafkaInfo] = None
+    dns: Optional[DNSInfo] = None
+    src_ip: str = ""
+    dst_ip: str = ""
+    sport: int = 0
+    time: float = 0.0
+    # endpoint that the policy applies to (for per-endpoint policy): the
+    # local endpoint is dst for ingress, src for egress.
+    verdict: Verdict = Verdict.VERDICT_UNKNOWN
+    policy_match_type: PolicyMatchType = PolicyMatchType.NONE
+    drop_reason: str = ""
+
+    def l7_record(self):
+        if self.l7 == L7Type.HTTP:
+            return self.http
+        if self.l7 == L7Type.KAFKA:
+            return self.kafka
+        if self.l7 == L7Type.DNS:
+            return self.dns
+        return None

@@ -1,0 +1,31 @@
+"""The TPU verdict engine — the "datapath".
+
+JAX kernels replacing the reference's per-packet eBPF policy-map lookup
+(``bpf/bpf_lxc.c`` + ``bpf/lib/policy.h``) and per-request L7 matching
+(Envoy RE2 / proxylib state machines) with batched tensor computations
+(SURVEY.md §2.3 table, §3.3/§3.4 call stacks).
+"""
+
+from cilium_tpu.engine.dfa_kernel import dfa_scan, dfa_scan_banked, match_bits
+from cilium_tpu.engine.mapstate_kernel import (
+    PackedMapState,
+    pack_mapstate,
+    mapstate_lookup,
+)
+from cilium_tpu.engine.verdict import (
+    CompiledPolicy,
+    VerdictEngine,
+    encode_strings,
+)
+
+__all__ = [
+    "dfa_scan",
+    "dfa_scan_banked",
+    "match_bits",
+    "PackedMapState",
+    "pack_mapstate",
+    "mapstate_lookup",
+    "CompiledPolicy",
+    "VerdictEngine",
+    "encode_strings",
+]

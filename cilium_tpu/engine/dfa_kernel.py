@@ -1,0 +1,81 @@
+"""Batched DFA byte-scan — the L7 automaton kernel.
+
+The TPU replacement for the reference's per-request regex scans
+(SURVEY.md §3.4: "per-request × per-rule scan is exactly what the batched
+automaton pass replaces"). Design notes:
+
+* The scan is a ``lax.scan`` over byte positions with a ``[batch]``
+  state carry; each step is one gather from the flattened transition
+  table — sequential in L (string length) but embarrassingly parallel in
+  the batch and bank dimensions, which is where the throughput comes
+  from (flows ≫ bytes).
+* Transition tables are byte-class compressed ``[S, K]`` int32; padding
+  bytes are masked with ``where`` so bucketed/padded strings need no
+  sentinel symbol.
+* Banks are vmapped: ``[n_banks, S, K]`` tables, one shared input batch.
+  Banks are also the EP (expert-parallel) shard unit
+  (``cilium_tpu.parallel``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def dfa_scan(
+    trans: jax.Array,       # [S, K] int32
+    byteclass: jax.Array,   # [256] int32
+    start: jax.Array,       # scalar int32
+    data: jax.Array,        # [B, L] uint8/int32 padded byte strings
+    lengths: jax.Array,     # [B] int32
+) -> jax.Array:
+    """Run the DFA over each row of ``data``; returns final states [B]."""
+    B, L = data.shape
+    K = trans.shape[1]
+    trans_flat = trans.reshape(-1)          # [S*K]
+    cls = byteclass[data.astype(jnp.int32)]  # [B, L]
+
+    def step(states, inputs):
+        c_t, t = inputs
+        nxt = trans_flat[states * K + c_t]
+        states = jnp.where(t < lengths, nxt, states)
+        return states, None
+
+    init = jnp.full((B,), start, dtype=jnp.int32)
+    ts = jnp.arange(L, dtype=jnp.int32)
+    final, _ = lax.scan(step, init, (cls.T, ts))
+    return final
+
+
+def dfa_scan_banked(
+    trans: jax.Array,       # [NB, S, K] int32
+    byteclass: jax.Array,   # [NB, 256] int32
+    start: jax.Array,       # [NB] int32
+    accept: jax.Array,      # [NB, S, W] uint32
+    data: jax.Array,        # [B, L]
+    lengths: jax.Array,     # [B]
+) -> jax.Array:
+    """All banks over one batch → accept words ``[B, NB, W]`` uint32."""
+    finals = jax.vmap(
+        lambda tr, bc, st: dfa_scan(tr, bc, st, data, lengths)
+    )(trans, byteclass, start)              # [NB, B]
+    words = jax.vmap(lambda acc, fs: acc[fs])(accept, finals)  # [NB, B, W]
+    return jnp.transpose(words, (1, 0, 2))  # [B, NB, W]
+
+
+def match_bits(words: jax.Array) -> jax.Array:
+    """Flatten ``[B, NB, W]`` accept words to ``[B, NB*W]`` — the global
+    lane space used by rule bitmap masks (dfa.BankedDFA.stacked lane_of)."""
+    B = words.shape[0]
+    return words.reshape(B, -1)
+
+
+def any_lane_match(words: jax.Array, mask: jax.Array) -> jax.Array:
+    """``words [B, NW]`` uint32 vs ``mask [NW]`` (or broadcastable):
+    True where any masked lane bit is set."""
+    return jnp.any((words & mask) != 0, axis=-1)

@@ -1,0 +1,209 @@
+"""Batched L3/L4 policy-map lookup.
+
+TPU analog of ``bpf/lib/policy.h ·policy_can_access*`` (SURVEY.md §3.3):
+the per-packet hash-map lookups become a batched binary search over a
+sorted key tensor with wildcard probes and priority resolution.
+
+Key layout (3×int32 words, lexicographically sorted):
+
+* ``w0`` — endpoint identity (the identity whose policy applies; the
+  reference's per-endpoint policy maps become one global table keyed by
+  endpoint identity — valid because policy depends only on the identity,
+  the same dedup ``pkg/policy/distillery.go`` exploits)
+* ``w1`` — peer identity (src for ingress, dst for egress); 0 = wildcard
+* ``w2`` — ``(direction << 24) | (proto << 16) | dport``; proto/port 0 =
+  wildcard
+
+Verdict precedence (mapstate.py's golden model, vectorized):
+
+* probe all 8 wildcard combinations of (peer, port, proto);
+* **deny wins** if any covering entry is deny (cilium: deny precedence
+  regardless of breadth);
+* else the most-specific covering allow wins (specificity = peer > port
+  > proto, the datapath's probe order);
+* else default: allow iff the direction is unenforced for this endpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from cilium_tpu.core.flow import TrafficDirection
+from cilium_tpu.policy.mapstate import MapState, MapStateKey, MapStateEntry
+
+
+@dataclasses.dataclass
+class PackedMapState:
+    """Sorted key/entry tensors (host-side numpy; loader stages to device)."""
+
+    key_w0: np.ndarray      # [N] int32 endpoint identity
+    key_w1: np.ndarray      # [N] int32 peer identity
+    key_w2: np.ndarray      # [N] int32 dir|proto|port
+    is_deny: np.ndarray     # [N] bool
+    ruleset_id: np.ndarray  # [N] int32, -1 = no L7 restriction
+    # per-endpoint-identity enforcement: sorted ids + 2-bit flags
+    enf_ids: np.ndarray     # [M] int32 sorted endpoint identities
+    enf_flags: np.ndarray   # [M, 2] bool (ingress, egress)
+
+    @property
+    def n_entries(self) -> int:
+        return len(self.key_w0)
+
+
+def _pack_w2(direction: int, proto: int, dport: int) -> int:
+    return (direction << 24) | (proto << 16) | dport
+
+
+def pack_mapstate(
+    per_identity: Dict[int, MapState],
+    ruleset_of_entry=None,
+) -> PackedMapState:
+    """Pack per-endpoint-identity MapStates into one sorted table.
+
+    ``ruleset_of_entry(ep_id, key, entry) -> int`` maps an entry's L7
+    rule set to a global ruleset id (assigned by the loader); None or a
+    return of -1 means no L7 restriction.
+    """
+    rows: List[Tuple[int, int, int, bool, int]] = []
+    enf: List[Tuple[int, bool, bool]] = []
+    for ep_id, ms in sorted(per_identity.items()):
+        enf.append((ep_id, ms.ingress_enforced, ms.egress_enforced))
+        for key, entry in ms.entries.items():
+            rid = -1
+            if ruleset_of_entry is not None and entry.is_redirect:
+                rid = ruleset_of_entry(ep_id, key, entry)
+            rows.append((
+                ep_id,
+                key.identity,
+                _pack_w2(key.direction, key.proto, key.dport),
+                entry.is_deny,
+                rid,
+            ))
+    if not rows:
+        # sentinel row that can never match (identity -1)
+        rows.append((-1, -1, -1, False, -1))
+    arr = np.array([r[:3] for r in rows], dtype=np.int64)
+    order = np.lexsort((arr[:, 2], arr[:, 1], arr[:, 0]))
+    arr = arr[order]
+    deny = np.array([rows[i][3] for i in order], dtype=bool)
+    rid = np.array([rows[i][4] for i in order], dtype=np.int32)
+    if not enf:
+        enf.append((-1, False, False))
+    enf.sort()
+    return PackedMapState(
+        key_w0=arr[:, 0].astype(np.int32),
+        key_w1=arr[:, 1].astype(np.int32),
+        key_w2=arr[:, 2].astype(np.int32),
+        is_deny=deny,
+        ruleset_id=rid,
+        enf_ids=np.array([e[0] for e in enf], dtype=np.int32),
+        enf_flags=np.array([[e[1], e[2]] for e in enf], dtype=bool),
+    )
+
+
+def _lower_bound3(
+    k0: jax.Array, k1: jax.Array, k2: jax.Array,
+    p0: jax.Array, p1: jax.Array, p2: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Vectorized lower-bound binary search over 3-word sorted keys.
+    Returns (index, found). All probes share the key arrays."""
+    N = k0.shape[0]
+    iters = max(1, int(N).bit_length())
+    lo = jnp.zeros_like(p0)
+    hi = jnp.full_like(p0, N)
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = (lo + hi) >> 1
+        m0, m1, m2 = k0[mid], k1[mid], k2[mid]
+        ge = (
+            (m0 > p0)
+            | ((m0 == p0) & (m1 > p1))
+            | ((m0 == p0) & (m1 == p1) & (m2 >= p2))
+        )
+        return jnp.where(ge, lo, mid + 1), jnp.where(ge, mid, hi)
+
+    lo, hi = lax.fori_loop(0, iters, body, (lo, hi))
+    idx = jnp.clip(lo, 0, N - 1)
+    found = (lo < N) & (k0[idx] == p0) & (k1[idx] == p1) & (k2[idx] == p2)
+    return idx, found
+
+
+# probe order: descending specificity. bit2=peer bit1=port bit0=proto
+_PROBE_SPECS = np.array([7, 6, 5, 4, 3, 2, 1, 0], dtype=np.int32)
+
+
+def mapstate_lookup(
+    key_w0: jax.Array, key_w1: jax.Array, key_w2: jax.Array,
+    is_deny: jax.Array, ruleset_id: jax.Array,
+    enf_ids: jax.Array, enf_flags: jax.Array,
+    ep_ids: jax.Array,      # [B] endpoint identity (policy owner)
+    peer_ids: jax.Array,    # [B]
+    dports: jax.Array,      # [B]
+    protos: jax.Array,      # [B]
+    directions: jax.Array,  # [B]
+) -> Dict[str, jax.Array]:
+    """Batched verdict lookup. Returns dict with:
+    ``allowed`` [B] bool (L3/L4 verdict, pre-L7),
+    ``denied`` [B] bool (explicit deny hit),
+    ``redirect`` [B] bool (L7 evaluation required),
+    ``ruleset`` [B] int32 (winning entry's ruleset id, -1 if none),
+    ``match_spec`` [B] int32 (specificity of winning entry, -1 default).
+    """
+    B = ep_ids.shape[0]
+    specs = jnp.asarray(_PROBE_SPECS)               # [8]
+    peer_sel = (specs >> 2) & 1                      # [8]
+    port_sel = (specs >> 1) & 1
+    proto_sel = specs & 1
+
+    p0 = jnp.broadcast_to(ep_ids[:, None], (B, 8))
+    p1 = peer_ids[:, None] * peer_sel[None, :]
+    w2 = (
+        (directions[:, None] << 24)
+        | ((protos[:, None] * proto_sel[None, :]) << 16)
+        | (dports[:, None] * port_sel[None, :])
+    )
+    idx, found = _lower_bound3(
+        key_w0, key_w1, key_w2,
+        p0.reshape(-1), p1.reshape(-1), w2.reshape(-1),
+    )
+    idx = idx.reshape(B, 8)
+    found = found.reshape(B, 8)
+
+    deny_hit = found & is_deny[idx]
+    denied = jnp.any(deny_hit, axis=1)
+
+    allow_hit = found & ~is_deny[idx]
+    # probes are ordered descending specificity → first allow hit wins
+    any_allow = jnp.any(allow_hit, axis=1)
+    first_allow = jnp.argmax(allow_hit, axis=1)      # [B]
+    win_idx = jnp.take_along_axis(idx, first_allow[:, None], axis=1)[:, 0]
+    ruleset = jnp.where(any_allow, ruleset_id[win_idx], -1)
+    match_spec = jnp.where(
+        denied, 8, jnp.where(any_allow, specs[first_allow], -1)
+    )
+
+    # default enforcement per endpoint identity
+    eidx = jnp.clip(jnp.searchsorted(enf_ids, ep_ids), 0,
+                    enf_ids.shape[0] - 1)
+    eknown = enf_ids[eidx] == ep_ids
+    enforced = jnp.where(
+        directions == int(TrafficDirection.INGRESS),
+        enf_flags[eidx, 0], enf_flags[eidx, 1],
+    ) & eknown
+
+    allowed = ~denied & (any_allow | ~enforced)
+    redirect = allowed & any_allow & (ruleset >= 0)
+    return {
+        "allowed": allowed,
+        "denied": denied,
+        "redirect": redirect,
+        "ruleset": ruleset,
+        "match_spec": match_spec,
+    }

@@ -1,0 +1,622 @@
+"""End-to-end verdict pipeline: compile policy → tensors → jitted step.
+
+This is the compile/execute split of SURVEY.md §7 in one place:
+
+* :class:`CompiledPolicy` (host): per-identity MapStates + the L7 rule
+  universe → packed tensors — the sorted L3/L4 key table, banked DFAs
+  per HTTP field (path/method/host/headers) and for DNS patterns, Kafka
+  ACL columns, and per-ruleset rule bitmaps.
+* :class:`VerdictEngine` (device): one jitted function over those
+  tensors computing, for a flow batch: L3/L4 precedence verdict →
+  L7 automaton matches → per-rule conjunction → ruleset-any → final
+  verdict codes. Mirrors the reference datapath stages ct→policy→L7
+  (SURVEY.md §3.3/§3.4) as one fused batched program.
+
+Verdict codes follow flowpb: FORWARDED=1, DROPPED=2, REDIRECTED=5
+(L7-allowed flows report REDIRECTED — they traversed the proxy path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cilium_tpu.core.config import EngineConfig
+from cilium_tpu.core.flow import (
+    DNSInfo,
+    Flow,
+    HTTPInfo,
+    KafkaInfo,
+    L7Type,
+    TrafficDirection,
+    Verdict,
+)
+from cilium_tpu.policy.api.l7 import L7Rules, PortRuleDNS, PortRuleHTTP, PortRuleKafka
+from cilium_tpu.policy.compiler import matchpattern
+from cilium_tpu.policy.compiler.dfa import BankedDFA, DFABank, compile_patterns
+from cilium_tpu.policy.mapstate import MapState
+from cilium_tpu.engine.dfa_kernel import dfa_scan_banked
+from cilium_tpu.engine.mapstate_kernel import PackedMapState, pack_mapstate, mapstate_lookup
+
+
+# --------------------------------------------------------------- helpers --
+def encode_strings(
+    strings: Sequence[bytes], max_len: int, pad_multiple: int = 32
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Encode byte strings → (data [B, L] uint8, lengths [B] int32,
+    valid [B] bool). Overlong strings are truncated and marked invalid —
+    the engine zeroes their match words (no false accepts)."""
+    B = len(strings)
+    longest = max((len(s) for s in strings), default=1)
+    L = min(max_len, max(pad_multiple, -(-max(longest, 1) // pad_multiple)
+                         * pad_multiple))
+    data = np.zeros((B, L), dtype=np.uint8)
+    lengths = np.zeros((B,), dtype=np.int32)
+    valid = np.ones((B,), dtype=bool)
+    for i, s in enumerate(strings):
+        if len(s) > L:
+            valid[i] = False
+            s = s[:L]
+        data[i, : len(s)] = np.frombuffer(s, dtype=np.uint8)
+        lengths[i] = len(s)
+    return data, lengths, valid
+
+
+def serialize_headers(headers: Sequence[Tuple[str, str]]) -> bytes:
+    """Canonical header block: lowercase names, sorted, ``name:value``
+    lines each newline-terminated. The header automatons match
+    contains-regexes over this form."""
+    lines = sorted(f"{k.strip().lower()}:{v.strip()}" for k, v in headers)
+    return ("".join(line + "\n" for line in lines)).encode("utf-8")
+
+
+def header_requirement_regex(name: str, value: str) -> str:
+    """Regex (over the serialized header block) for one required header.
+    Empty value = presence check."""
+    import re as _re
+
+    n = _re.escape(name.strip().lower())
+    if value:
+        v = _re.escape(value.strip())
+        line = f"{n}:{v}"
+    else:
+        line = f"{n}:[^\\n]*"
+    return f"(?:[^\\n]*\\n)*{line}\\n(?:[^\\n]*\\n)*"
+
+
+def _empty_banked() -> BankedDFA:
+    """A 1-bank, 0-pattern automaton (matches nothing) so tensor shapes
+    stay non-degenerate when a protocol has no rules."""
+    bank = DFABank(
+        trans=np.zeros((2, 1), dtype=np.int32),
+        byteclass=np.zeros(256, dtype=np.int32),
+        accept=np.zeros((2, 1), dtype=np.uint32),
+        start=1,
+        n_patterns=0,
+    )
+    return BankedDFA(
+        banks=[bank],
+        pattern_bank=np.zeros(0, dtype=np.int32),
+        pattern_lane=np.zeros(0, dtype=np.int32),
+        patterns=(),
+    )
+
+
+@dataclasses.dataclass
+class _FieldMatcher:
+    """A deduped pattern universe for one string field + its stacked
+    tensors; rules reference patterns by global lane."""
+
+    banked: BankedDFA
+    arrays: Dict[str, np.ndarray]
+    pattern_index: Dict[str, int]
+
+    @classmethod
+    def build(cls, patterns: List[str], cfg: EngineConfig,
+              case_insensitive: bool = False) -> "_FieldMatcher":
+        uniq: List[str] = []
+        index: Dict[str, int] = {}
+        for p in patterns:
+            if p not in index:
+                index[p] = len(uniq)
+                uniq.append(p)
+        banked = (
+            compile_patterns(
+                uniq,
+                bank_size=cfg.bank_size,
+                max_states=cfg.max_dfa_states,
+                max_quantifier=cfg.max_quantifier,
+                case_insensitive=case_insensitive,
+            )
+            if uniq
+            else _empty_banked()
+        )
+        return cls(banked=banked, arrays=banked.stacked(), pattern_index=index)
+
+    def lane(self, pattern: str) -> int:
+        """Global lane of ``pattern``; -1 for the empty pattern (=no
+        constraint)."""
+        if not pattern:
+            return -1
+        return int(self.arrays["lane_of"][self.pattern_index[pattern]])
+
+
+def _rule_bit(words: jax.Array, lanes: jax.Array) -> jax.Array:
+    """words [B, NW] uint32, lanes [R] int32 (-1 = unconstrained) →
+    bool [B, R]."""
+    word_idx = jnp.clip(lanes >> 5, 0, words.shape[1] - 1)
+    bit_idx = (lanes & 31).astype(jnp.uint32)
+    w = jnp.take(words, word_idx, axis=1)            # [B, R]
+    bits = (w >> bit_idx[None, :]) & jnp.uint32(1)
+    return jnp.where(lanes[None, :] < 0, True, bits.astype(bool))
+
+
+def _masks_to_array(masks: List[List[int]], n_rules: int) -> np.ndarray:
+    W = max(1, (max(n_rules, 1) + 31) // 32)
+    out = np.zeros((max(1, len(masks)), W), dtype=np.uint32)
+    for i, rule_ids in enumerate(masks):
+        for r in rule_ids:
+            out[i, r // 32] |= np.uint32(1 << (r % 32))
+    return out
+
+
+# ---------------------------------------------------------------- policy --
+
+
+@dataclasses.dataclass
+class CompiledPolicy:
+    """Everything the device step needs, as host numpy arrays."""
+
+    mapstate: PackedMapState
+    arrays: Dict[str, np.ndarray]           # flat tensor dict
+    http_rules: List[PortRuleHTTP]
+    kafka_rules: List[PortRuleKafka]
+    dns_rules: List[PortRuleDNS]
+    kafka_interns: Dict[str, Dict[str, int]]  # field → string → id
+    path_matcher: _FieldMatcher
+    method_matcher: _FieldMatcher
+    host_matcher: _FieldMatcher
+    header_matcher: _FieldMatcher
+    dns_matcher: _FieldMatcher
+    revision: int = 0
+
+    @classmethod
+    def build(
+        cls,
+        per_identity: Dict[int, MapState],
+        cfg: Optional[EngineConfig] = None,
+        revision: int = 0,
+    ) -> "CompiledPolicy":
+        cfg = cfg or EngineConfig()
+
+        # -- collect the L7 rule universe (deduped) and rulesets --------
+        http_rules: List[PortRuleHTTP] = []
+        http_index: Dict[PortRuleHTTP, int] = {}
+        kafka_rules: List[PortRuleKafka] = []
+        kafka_index: Dict[PortRuleKafka, int] = {}
+        dns_rules: List[PortRuleDNS] = []
+        dns_index: Dict[PortRuleDNS, int] = {}
+
+        ruleset_key_to_id: Dict[Tuple, int] = {}
+        # per ruleset: member rule ids in each protocol family's space —
+        # a merged entry can carry several families (the oracle checks
+        # all of them), so no single "dominant protocol" is picked
+        ruleset_http: List[List[int]] = []
+        ruleset_kafka: List[List[int]] = []
+        ruleset_dns: List[List[int]] = []
+
+        def intern_rule(table, index, rule):
+            if rule not in index:
+                index[rule] = len(table)
+                table.append(rule)
+            return index[rule]
+
+        def ruleset_of(l7_rules_tuple: Tuple[L7Rules, ...]) -> int:
+            http_ids, kafka_ids, dns_ids = [], [], []
+            for lr in l7_rules_tuple:
+                for h in lr.http:
+                    http_ids.append(intern_rule(http_rules, http_index, h))
+                for k in lr.kafka:
+                    kafka_ids.append(intern_rule(kafka_rules, kafka_index, k))
+                for d in lr.dns:
+                    dns_ids.append(intern_rule(dns_rules, dns_index, d))
+            if not (http_ids or kafka_ids or dns_ids):
+                return -1
+            key = (tuple(sorted(set(http_ids))),
+                   tuple(sorted(set(kafka_ids))),
+                   tuple(sorted(set(dns_ids))))
+            rid = ruleset_key_to_id.get(key)
+            if rid is None:
+                rid = len(ruleset_http)
+                ruleset_key_to_id[key] = rid
+                ruleset_http.append(list(key[0]))
+                ruleset_kafka.append(list(key[1]))
+                ruleset_dns.append(list(key[2]))
+            return rid
+
+        packed = pack_mapstate(
+            per_identity,
+            ruleset_of_entry=lambda ep, key, entry: ruleset_of(entry.l7_rules),
+        )
+
+        # -- compile field matchers -------------------------------------
+        path_matcher = _FieldMatcher.build(
+            [h.path for h in http_rules if h.path], cfg)
+        method_matcher = _FieldMatcher.build(
+            [h.method for h in http_rules if h.method], cfg)
+        host_matcher = _FieldMatcher.build(
+            [h.host for h in http_rules if h.host], cfg,
+            case_insensitive=True)
+        header_pats: List[str] = []
+        rule_header_lanes: List[List[str]] = []
+        for h in http_rules:
+            pats = []
+            for hdr in h.headers:
+                if ":" in hdr:
+                    name, value = hdr.split(":", 1)
+                else:
+                    name, value = hdr, ""
+                pats.append(header_requirement_regex(name, value))
+            for hm in h.header_matches:
+                if hm.mismatch_action.upper() == "LOG":
+                    continue  # LOG mismatches still allow
+                pats.append(header_requirement_regex(hm.name, hm.value))
+            header_pats.extend(pats)
+            rule_header_lanes.append(pats)
+        header_matcher = _FieldMatcher.build(header_pats, cfg)
+
+        dns_pats = []
+        for d in dns_rules:
+            if d.match_name:
+                dns_pats.append(matchpattern.name_to_regex(d.match_name))
+            else:
+                dns_pats.append(matchpattern.to_regex(d.match_pattern))
+        dns_matcher = _FieldMatcher.build(dns_pats, cfg)
+
+        # -- per-rule lane arrays ---------------------------------------
+        Rh = max(1, len(http_rules))
+        max_hdrs = max([len(p) for p in rule_header_lanes] + [1])
+        http_path_lane = np.full(Rh, -1, dtype=np.int32)
+        http_method_lane = np.full(Rh, -1, dtype=np.int32)
+        http_host_lane = np.full(Rh, -1, dtype=np.int32)
+        http_header_lanes = np.full((Rh, max_hdrs), -1, dtype=np.int32)
+        for i, h in enumerate(http_rules):
+            if h.path:
+                http_path_lane[i] = path_matcher.lane(h.path)
+            if h.method:
+                http_method_lane[i] = method_matcher.lane(h.method)
+            if h.host:
+                http_host_lane[i] = host_matcher.lane(h.host)
+            for j, pat in enumerate(rule_header_lanes[i]):
+                http_header_lanes[i, j] = header_matcher.lane(pat)
+
+        Rk = max(1, len(kafka_rules))
+        kafka_apikey_mask = np.zeros(Rk, dtype=np.uint32)   # 0 = any
+        kafka_version = np.full(Rk, -1, dtype=np.int32)
+        kafka_client = np.full(Rk, -1, dtype=np.int32)
+        kafka_topic = np.full(Rk, -1, dtype=np.int32)
+        client_intern: Dict[str, int] = {}
+        topic_intern: Dict[str, int] = {}
+        for i, k in enumerate(kafka_rules):
+            for ak in k.allowed_api_keys():
+                kafka_apikey_mask[i] |= np.uint32(1 << ak)
+            if k.api_version:
+                kafka_version[i] = int(k.api_version)
+            if k.client_id:
+                kafka_client[i] = client_intern.setdefault(
+                    k.client_id, len(client_intern))
+            if k.topic:
+                kafka_topic[i] = topic_intern.setdefault(
+                    k.topic, len(topic_intern))
+
+        Rd = max(1, len(dns_rules))
+        dns_lane = np.full(Rd, -1, dtype=np.int32)
+        for i in range(len(dns_rules)):
+            dns_lane[i] = dns_matcher.lane(dns_pats[i])
+
+        # -- ruleset masks ----------------------------------------------
+        http_members = ruleset_http
+        kafka_members = ruleset_kafka
+        dns_members = ruleset_dns
+
+        arrays: Dict[str, np.ndarray] = {
+            "ms_key_w0": packed.key_w0,
+            "ms_key_w1": packed.key_w1,
+            "ms_key_w2": packed.key_w2,
+            "ms_deny": packed.is_deny,
+            "ms_ruleset": packed.ruleset_id,
+            "ms_enf_ids": packed.enf_ids,
+            "ms_enf_flags": packed.enf_flags,
+            "rs_http_mask": _masks_to_array(http_members or [[]],
+                                            len(http_rules)),
+            "rs_kafka_mask": _masks_to_array(kafka_members or [[]],
+                                             len(kafka_rules)),
+            "rs_dns_mask": _masks_to_array(dns_members or [[]],
+                                           len(dns_rules)),
+            "http_path_lane": http_path_lane,
+            "http_method_lane": http_method_lane,
+            "http_host_lane": http_host_lane,
+            "http_header_lanes": http_header_lanes,
+            "kafka_apikey_mask": kafka_apikey_mask,
+            "kafka_version": kafka_version,
+            "kafka_client": kafka_client,
+            "kafka_topic": kafka_topic,
+            "dns_lane": dns_lane,
+        }
+        for prefix, m in (
+            ("path", path_matcher),
+            ("method", method_matcher),
+            ("host", host_matcher),
+            ("hdr", header_matcher),
+            ("dns", dns_matcher),
+        ):
+            for k, v in m.arrays.items():
+                if k != "lane_of":
+                    arrays[f"{prefix}_{k}"] = v
+
+        return cls(
+            mapstate=packed,
+            arrays=arrays,
+            http_rules=http_rules,
+            kafka_rules=kafka_rules,
+            dns_rules=dns_rules,
+            kafka_interns={"client_id": client_intern, "topic": topic_intern},
+            path_matcher=path_matcher,
+            method_matcher=method_matcher,
+            host_matcher=host_matcher,
+            header_matcher=header_matcher,
+            dns_matcher=dns_matcher,
+            revision=revision,
+        )
+
+
+# ----------------------------------------------------------------- engine --
+@dataclasses.dataclass
+class FlowBatch:
+    """Host-encoded flow tensors (all numpy; shapes static per bucket)."""
+
+    ep_ids: np.ndarray
+    peer_ids: np.ndarray
+    dports: np.ndarray
+    protos: np.ndarray
+    directions: np.ndarray
+    l7_types: np.ndarray
+    path: Tuple[np.ndarray, np.ndarray, np.ndarray]
+    method: Tuple[np.ndarray, np.ndarray, np.ndarray]
+    host: Tuple[np.ndarray, np.ndarray, np.ndarray]
+    headers: Tuple[np.ndarray, np.ndarray, np.ndarray]
+    qname: Tuple[np.ndarray, np.ndarray, np.ndarray]
+    kafka_api_key: np.ndarray
+    kafka_api_version: np.ndarray
+    kafka_client: np.ndarray
+    kafka_topic: np.ndarray
+
+    @property
+    def size(self) -> int:
+        return len(self.ep_ids)
+
+
+def encode_flows(
+    flows: Sequence[Flow],
+    interns: Dict[str, Dict[str, int]],
+    cfg: Optional[EngineConfig] = None,
+) -> FlowBatch:
+    """Featurize flows → FlowBatch (the host half of ingest; mirrors the
+    reference's parse step feeding the verdict lookup)."""
+    cfg = cfg or EngineConfig()
+    B = len(flows)
+    ep = np.zeros(B, dtype=np.int32)
+    peer = np.zeros(B, dtype=np.int32)
+    dport = np.zeros(B, dtype=np.int32)
+    proto = np.zeros(B, dtype=np.int32)
+    dirs = np.zeros(B, dtype=np.int32)
+    l7t = np.zeros(B, dtype=np.int32)
+    paths: List[bytes] = []
+    methods: List[bytes] = []
+    hosts: List[bytes] = []
+    headerblocks: List[bytes] = []
+    qnames: List[bytes] = []
+    k_api = np.zeros(B, dtype=np.int32)
+    k_ver = np.zeros(B, dtype=np.int32)
+    k_cli = np.full(B, -2, dtype=np.int32)
+    k_top = np.full(B, -2, dtype=np.int32)
+    cintern = interns.get("client_id", {})
+    tintern = interns.get("topic", {})
+    for i, f in enumerate(flows):
+        ingress = f.direction == TrafficDirection.INGRESS
+        ep[i] = f.dst_identity if ingress else f.src_identity
+        peer[i] = f.src_identity if ingress else f.dst_identity
+        dport[i] = f.dport
+        proto[i] = int(f.protocol)
+        dirs[i] = int(f.direction)
+        l7t[i] = int(f.l7)
+        h = f.http
+        paths.append((h.path if h else "").encode("utf-8"))
+        methods.append((h.method if h else "").encode("utf-8"))
+        hosts.append((h.host.lower() if h else "").encode("utf-8"))
+        headerblocks.append(serialize_headers(h.headers) if h else b"")
+        d = f.dns
+        qnames.append(
+            matchpattern.sanitize_name(d.query).encode("utf-8")
+            if d and d.query else b"")
+        k = f.kafka
+        if k:
+            k_api[i] = k.api_key
+            k_ver[i] = k.api_version
+            k_cli[i] = cintern.get(k.client_id, -2)
+            k_top[i] = tintern.get(k.topic, -2)
+    bucket = max(cfg.http_path_buckets)
+    return FlowBatch(
+        ep_ids=ep, peer_ids=peer, dports=dport, protos=proto,
+        directions=dirs, l7_types=l7t,
+        path=encode_strings(paths, bucket),
+        method=encode_strings(methods, cfg.http_method_len),
+        host=encode_strings(hosts, cfg.http_host_len),
+        headers=encode_strings(headerblocks, 1024),
+        qname=encode_strings(qnames, cfg.dns_name_len),
+        kafka_api_key=k_api, kafka_api_version=k_ver,
+        kafka_client=k_cli, kafka_topic=k_top,
+    )
+
+
+def verdict_step(arrays: Dict[str, jax.Array], batch: Dict[str, jax.Array]
+                 ) -> Dict[str, jax.Array]:
+    """The pure device function: full verdict for one batch.
+
+    ``arrays`` = CompiledPolicy.arrays staged on device;
+    ``batch`` = FlowBatch fields as device arrays.
+    """
+    ms = mapstate_lookup(
+        arrays["ms_key_w0"], arrays["ms_key_w1"], arrays["ms_key_w2"],
+        arrays["ms_deny"], arrays["ms_ruleset"],
+        arrays["ms_enf_ids"], arrays["ms_enf_flags"],
+        batch["ep_ids"], batch["peer_ids"], batch["dports"],
+        batch["protos"], batch["directions"],
+    )
+    ruleset = jnp.clip(ms["ruleset"], 0, arrays["rs_http_mask"].shape[0] - 1)
+    l7t = batch["l7_types"]
+
+    def scan_field(prefix: str, data, lengths, valid):
+        words = dfa_scan_banked(
+            arrays[f"{prefix}_trans"], arrays[f"{prefix}_byteclass"],
+            arrays[f"{prefix}_start"], arrays[f"{prefix}_accept"],
+            data, lengths,
+        )
+        B = words.shape[0]
+        flat = words.reshape(B, -1)
+        return jnp.where(valid[:, None], flat, 0)
+
+    # HTTP: conjunction of per-field pattern bits per rule
+    path_w = scan_field("path", *batch_field(batch, "path"))
+    method_w = scan_field("method", *batch_field(batch, "method"))
+    host_w = scan_field("host", *batch_field(batch, "host"))
+    hdr_w = scan_field("hdr", *batch_field(batch, "headers"))
+    rule_ok = (
+        _rule_bit(path_w, arrays["http_path_lane"])
+        & _rule_bit(method_w, arrays["http_method_lane"])
+        & _rule_bit(host_w, arrays["http_host_lane"])
+    )
+    hdr_lanes = arrays["http_header_lanes"]          # [R, H]
+    hdr_ok = jax.vmap(lambda lanes: _rule_bit(hdr_w, lanes),
+                      in_axes=1, out_axes=2)(hdr_lanes)  # [B, R, H]
+    rule_ok = rule_ok & jnp.all(hdr_ok, axis=2)
+
+    http_mask = arrays["rs_http_mask"][ruleset]      # [B, Wh]
+    Wh = http_mask.shape[1]
+    rule_words = _bools_to_words(rule_ok, Wh)
+    # a rule family only matches flows carrying that L7 record (oracle:
+    # flow.http is None → no HTTP rule matches)
+    http_ok = (jnp.any((rule_words & http_mask) != 0, axis=1)
+               & (l7t == int(L7Type.HTTP)))
+
+    # Kafka: columnar exact/set matching
+    ak = jnp.clip(batch["kafka_api_key"], 0, 31).astype(jnp.uint32)
+    am = arrays["kafka_apikey_mask"][None, :]        # [1, Rk]
+    k_ok = (
+        ((am == 0) | ((am >> ak[:, None]) & jnp.uint32(1)).astype(bool))
+        & ((arrays["kafka_version"][None, :] < 0)
+           | (arrays["kafka_version"][None, :]
+              == batch["kafka_api_version"][:, None]))
+        & ((arrays["kafka_client"][None, :] < 0)
+           | (arrays["kafka_client"][None, :]
+              == batch["kafka_client"][:, None]))
+        & ((arrays["kafka_topic"][None, :] < 0)
+           | (arrays["kafka_topic"][None, :]
+              == batch["kafka_topic"][:, None]))
+    )
+    kafka_mask = arrays["rs_kafka_mask"][ruleset]
+    k_words = _bools_to_words(k_ok, kafka_mask.shape[1])
+    kafka_ok = (jnp.any((k_words & kafka_mask) != 0, axis=1)
+                & (l7t == int(L7Type.KAFKA)))
+
+    # DNS: qname automaton
+    dns_w = scan_field("dns", *batch_field(batch, "qname"))
+    d_ok = _rule_bit(dns_w, arrays["dns_lane"]) & (arrays["dns_lane"] >= 0)[None, :]
+    dns_mask = arrays["rs_dns_mask"][ruleset]
+    d_words = _bools_to_words(d_ok, dns_mask.shape[1])
+    dns_ok = (jnp.any((d_words & dns_mask) != 0, axis=1)
+              & (l7t == int(L7Type.DNS)))
+
+    # allow-list over the union of the ruleset's families (a merged
+    # entry can carry several protocol families; oracle checks all)
+    l7_ok = http_ok | kafka_ok | dns_ok
+
+    allowed = ms["allowed"] & (l7_ok | ~ms["redirect"])
+    verdict = jnp.where(
+        allowed,
+        jnp.where(ms["redirect"], int(Verdict.REDIRECTED),
+                  int(Verdict.FORWARDED)),
+        int(Verdict.DROPPED),
+    ).astype(jnp.int32)
+    return {
+        "verdict": verdict,
+        "allowed": allowed,
+        "l3l4_allowed": ms["allowed"],
+        "redirect": ms["redirect"],
+        "l7_ok": l7_ok,
+        "match_spec": ms["match_spec"],
+        "ruleset": ms["ruleset"],
+    }
+
+
+def batch_field(batch: Dict[str, jax.Array], name: str):
+    return (batch[f"{name}_data"], batch[f"{name}_len"],
+            batch[f"{name}_valid"])
+
+
+def _bools_to_words(bools: jax.Array, n_words: int) -> jax.Array:
+    """[B, R] bool → [B, n_words] uint32 bitmap (R ≤ 32*n_words)."""
+    B, R = bools.shape
+    pad = n_words * 32 - R
+    if pad:
+        bools = jnp.pad(bools, ((0, 0), (0, pad)))
+    b = bools.reshape(B, n_words, 32).astype(jnp.uint32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    return jnp.sum(b << shifts[None, None, :], axis=2, dtype=jnp.uint32)
+
+
+class VerdictEngine:
+    """Jitted wrapper around :func:`verdict_step` for a CompiledPolicy."""
+
+    def __init__(self, policy: CompiledPolicy, device=None):
+        self.policy = policy
+        self.device = device
+        self._arrays = {
+            k: jax.device_put(v, device) for k, v in policy.arrays.items()
+        }
+        self._step = jax.jit(verdict_step)
+
+    def verdict_batch_arrays(self, batch: Dict[str, jax.Array]):
+        return self._step(self._arrays, batch)
+
+    def verdict_flows(self, flows: Sequence[Flow],
+                      cfg: Optional[EngineConfig] = None):
+        fb = encode_flows(flows, self.policy.kafka_interns, cfg)
+        batch = flowbatch_to_device(fb, self.device)
+        out = self.verdict_batch_arrays(batch)
+        return {k: np.asarray(v) for k, v in out.items()}
+
+
+def flowbatch_to_device(fb: FlowBatch, device=None) -> Dict[str, jax.Array]:
+    def put(x):
+        return jax.device_put(x, device)
+
+    d: Dict[str, jax.Array] = {
+        "ep_ids": put(fb.ep_ids), "peer_ids": put(fb.peer_ids),
+        "dports": put(fb.dports), "protos": put(fb.protos),
+        "directions": put(fb.directions), "l7_types": put(fb.l7_types),
+        "kafka_api_key": put(fb.kafka_api_key),
+        "kafka_api_version": put(fb.kafka_api_version),
+        "kafka_client": put(fb.kafka_client),
+        "kafka_topic": put(fb.kafka_topic),
+    }
+    for name in ("path", "method", "host", "headers", "qname"):
+        data, lengths, valid = getattr(fb, name)
+        d[f"{name}_data"] = put(data)
+        d[f"{name}_len"] = put(lengths)
+        d[f"{name}_valid"] = put(valid)
+    return d

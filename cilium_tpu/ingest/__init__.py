@@ -1,0 +1,29 @@
+"""Flow ingest: Hubble JSONL replay + synthetic benchmark generators.
+
+Reference: Hubble exporter JSONL / ``flowpb.Flow`` (SURVEY.md §2.5) is
+the ingest schema; the five BASELINE.json configs are generated
+synthetically here (§6).
+"""
+
+from cilium_tpu.ingest.hubble import flow_to_dict, flow_from_dict, read_jsonl, write_jsonl
+from cilium_tpu.ingest.synth import (
+    SynthScenario,
+    synth_fqdn_scenario,
+    synth_http_scenario,
+    synth_kafka_scenario,
+    synth_mixed_scenario,
+    synth_clustermesh_scenario,
+)
+
+__all__ = [
+    "flow_to_dict",
+    "flow_from_dict",
+    "read_jsonl",
+    "write_jsonl",
+    "SynthScenario",
+    "synth_fqdn_scenario",
+    "synth_http_scenario",
+    "synth_kafka_scenario",
+    "synth_mixed_scenario",
+    "synth_clustermesh_scenario",
+]

@@ -1,0 +1,152 @@
+"""Hubble flow JSONL reader/writer.
+
+Schema mirrors ``flowpb.Flow`` JSON encoding (reference:
+``api/v1/flow/flow.proto``, SURVEY.md §2.5) for the fields the engine
+consumes. A "Hubble capture replay" (north star) is a stream of these
+JSON objects, one per line — the exporter's on-disk format.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, Iterator, List, Optional
+
+from cilium_tpu.core.flow import (
+    DNSInfo,
+    Flow,
+    HTTPInfo,
+    KafkaInfo,
+    L7Type,
+    Protocol,
+    TrafficDirection,
+    Verdict,
+)
+
+_VERDICT_NAMES = {v.name: v for v in Verdict}
+_DIR_NAMES = {"INGRESS": TrafficDirection.INGRESS,
+              "EGRESS": TrafficDirection.EGRESS}
+
+
+def flow_to_dict(f: Flow) -> Dict:
+    d: Dict = {
+        "verdict": Verdict(f.verdict).name,
+        "traffic_direction": TrafficDirection(f.direction).name,
+        "source": {"identity": f.src_identity},
+        "destination": {"identity": f.dst_identity},
+    }
+    if f.time:
+        d["time"] = f.time
+    if f.src_ip or f.dst_ip:
+        d["IP"] = {"source": f.src_ip, "destination": f.dst_ip}
+    l4_proto = Protocol(f.protocol)
+    port_obj = {"destination_port": f.dport}
+    if f.sport:
+        port_obj["source_port"] = f.sport
+    if l4_proto == Protocol.TCP:
+        d["l4"] = {"TCP": port_obj}
+    elif l4_proto == Protocol.UDP:
+        d["l4"] = {"UDP": port_obj}
+    elif l4_proto == Protocol.SCTP:
+        d["l4"] = {"SCTP": port_obj}
+    if f.l7 == L7Type.HTTP and f.http:
+        d["l7"] = {"type": "REQUEST", "http": {
+            "method": f.http.method,
+            "url": f.http.path,
+            "protocol": f.http.protocol,
+            "headers": [{"key": k, "value": v} for k, v in f.http.headers],
+            **({"host": f.http.host} if f.http.host else {}),
+        }}
+    elif f.l7 == L7Type.KAFKA and f.kafka:
+        d["l7"] = {"type": "REQUEST", "kafka": {
+            "api_key": f.kafka.api_key,
+            "api_version": f.kafka.api_version,
+            "correlation_id": f.kafka.correlation_id,
+            "topic": f.kafka.topic,
+            **({"client_id": f.kafka.client_id} if f.kafka.client_id else {}),
+        }}
+    elif f.l7 == L7Type.DNS and f.dns:
+        d["l7"] = {"type": "REQUEST", "dns": {
+            "query": f.dns.query,
+            "qtypes": list(f.dns.qtypes),
+            "ips": list(f.dns.ips),
+            "ttl": f.dns.ttl,
+        }}
+    return d
+
+
+def flow_from_dict(d: Dict) -> Flow:
+    f = Flow()
+    f.time = d.get("time", 0.0) or 0.0
+    f.verdict = _VERDICT_NAMES.get(d.get("verdict", ""),
+                                   Verdict.VERDICT_UNKNOWN)
+    f.direction = _DIR_NAMES.get(d.get("traffic_direction", ""),
+                                 TrafficDirection.INGRESS)
+    f.src_identity = int((d.get("source") or {}).get("identity", 0))
+    f.dst_identity = int((d.get("destination") or {}).get("identity", 0))
+    ip = d.get("IP") or {}
+    f.src_ip = ip.get("source", "")
+    f.dst_ip = ip.get("destination", "")
+    l4 = d.get("l4") or {}
+    for proto_name, proto in (("TCP", Protocol.TCP), ("UDP", Protocol.UDP),
+                              ("SCTP", Protocol.SCTP)):
+        if proto_name in l4:
+            f.protocol = proto
+            f.dport = int(l4[proto_name].get("destination_port", 0))
+            f.sport = int(l4[proto_name].get("source_port", 0))
+    l7 = d.get("l7") or {}
+    if "http" in l7:
+        h = l7["http"]
+        f.l7 = L7Type.HTTP
+        f.http = HTTPInfo(
+            method=h.get("method", ""),
+            path=h.get("url", ""),
+            host=h.get("host", ""),
+            headers=tuple((x.get("key", ""), x.get("value", ""))
+                          for x in (h.get("headers") or ())),
+            protocol=h.get("protocol", "HTTP/1.1"),
+            code=int(h.get("code", 0)),
+        )
+    elif "kafka" in l7:
+        k = l7["kafka"]
+        f.l7 = L7Type.KAFKA
+        f.kafka = KafkaInfo(
+            api_key=int(k.get("api_key", 0)),
+            api_version=int(k.get("api_version", 0)),
+            client_id=k.get("client_id", ""),
+            topic=k.get("topic", ""),
+            correlation_id=int(k.get("correlation_id", 0)),
+        )
+    elif "dns" in l7:
+        dd = l7["dns"]
+        f.l7 = L7Type.DNS
+        f.dns = DNSInfo(
+            query=dd.get("query", ""),
+            qtypes=tuple(dd.get("qtypes") or ("A",)),
+            ips=tuple(dd.get("ips") or ()),
+            ttl=int(dd.get("ttl", 0)),
+        )
+    return f
+
+
+def write_jsonl(path: str, flows: Iterable[Flow]) -> int:
+    n = 0
+    with open(path, "w") as fp:
+        for f in flows:
+            fp.write(json.dumps(flow_to_dict(f)) + "\n")
+            n += 1
+    return n
+
+
+def read_jsonl(path: str, start: int = 0,
+               limit: Optional[int] = None) -> Iterator[Flow]:
+    """Stream flows from a JSONL capture; ``start`` supports replay-
+    cursor resume (SURVEY.md §5.4)."""
+    with open(path) as fp:
+        for i, line in enumerate(fp):
+            if i < start:
+                continue
+            if limit is not None and i >= start + limit:
+                return
+            line = line.strip()
+            if line:
+                yield flow_from_dict(json.loads(line))

@@ -1,0 +1,392 @@
+"""Synthetic generators for the five BASELINE.json benchmark configs.
+
+Each returns a :class:`SynthScenario`: rules + endpoint label sets +
+flows, ready to resolve and replay. Shapes follow BASELINE.md:
+
+0. toFQDNs matchPattern — 100 DNS names × 10 rules
+1. L7 HTTP — 1k path/header regex rules × 10k flows
+2. Kafka — topic/API-key ACLs × 100k produce/fetch records
+3. Mixed L3–L7 — examples/policies corpus × 1M identity/flow tuples
+4. Cluster mesh — 10k identities × 5k CNPs, streaming
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional, Tuple
+
+from cilium_tpu.core.flow import (
+    DNSInfo,
+    Flow,
+    HTTPInfo,
+    KafkaInfo,
+    L7Type,
+    Protocol,
+    TrafficDirection,
+)
+from cilium_tpu.policy.api import (
+    EndpointSelector,
+    EgressRule,
+    IngressRule,
+    L7Rules,
+    PortProtocol,
+    PortRule,
+    PortRuleDNS,
+    PortRuleHTTP,
+    PortRuleKafka,
+    Rule,
+)
+
+ING = TrafficDirection.INGRESS
+EG = TrafficDirection.EGRESS
+
+
+@dataclasses.dataclass
+class SynthScenario:
+    name: str
+    rules: List[Rule]
+    endpoints: Dict[str, Dict[str, str]]   # name → label dict
+    flows: List[Flow]
+    # filled by the harness after identity allocation:
+    ids: Optional[Dict[str, int]] = None
+
+
+def _sel(**kv) -> EndpointSelector:
+    return EndpointSelector.from_labels(**kv)
+
+
+# ------------------------------------------------------- config 0: FQDN --
+def synth_fqdn_scenario(n_names: int = 100, n_rules: int = 10,
+                        n_flows: Optional[int] = None,
+                        seed: int = 0) -> SynthScenario:
+    rng = random.Random(seed)
+    domains = ["cilium.io", "example.com", "k8s.local", "corp.internal",
+               "cdn.net"]
+    dns_rules = []
+    for i in range(n_rules):
+        base = domains[i % len(domains)]
+        if i % 3 == 0:
+            dns_rules.append(PortRuleDNS(match_name=f"svc{i}.{base}"))
+        elif i % 3 == 1:
+            dns_rules.append(PortRuleDNS(match_pattern=f"*.{base}"))
+        else:
+            dns_rules.append(PortRuleDNS(match_pattern=f"api-*.sub{i}.{base}"))
+    rule = Rule(
+        endpoint_selector=_sel(app="crawler"),
+        egress=(EgressRule(to_ports=(PortRule(
+            ports=(PortProtocol(53, Protocol.UDP),),
+            rules=L7Rules(dns=tuple(dns_rules)),
+        ),),),),
+        labels=("synth=fqdn",),
+    )
+    names = []
+    for i in range(n_names):
+        base = domains[i % len(domains)]
+        kind = rng.random()
+        if kind < 0.3:
+            names.append(f"svc{rng.randrange(n_rules)}.{base}")
+        elif kind < 0.6:
+            names.append(f"host{i}.{base}")
+        elif kind < 0.8:
+            names.append(f"api-{i}.sub{rng.randrange(n_rules)}.{base}")
+        else:
+            names.append(f"deep{i}.x.y.{base}")
+    flows = []
+    for i in range(n_flows or n_names):
+        flows.append(Flow(
+            src_identity=0, dst_identity=0, dport=53, protocol=Protocol.UDP,
+            direction=EG, l7=L7Type.DNS,
+            dns=DNSInfo(query=names[i % len(names)]),
+        ))
+    return SynthScenario(
+        name="fqdn", rules=[rule],
+        endpoints={"crawler": {"app": "crawler"},
+                   "peer": {"app": "peer"}},
+        flows=flows,
+    )
+
+
+# ------------------------------------------------------- config 1: HTTP --
+def synth_http_scenario(n_rules: int = 1000, n_flows: int = 10000,
+                        seed: int = 0) -> SynthScenario:
+    rng = random.Random(seed)
+    http_rules = []
+    for i in range(n_rules):
+        kind = i % 5
+        if kind == 0:
+            http_rules.append(PortRuleHTTP(
+                method="GET", path=f"/api/v{i % 9}/svc{i}/[a-z0-9]+"))
+        elif kind == 1:
+            http_rules.append(PortRuleHTTP(
+                method="POST", path=f"/api/v1/items/{i}(/.*)?"))
+        elif kind == 2:
+            http_rules.append(PortRuleHTTP(
+                path=f"/public/{i}/.*", host=f"svc{i % 50}[.]local"))
+        elif kind == 3:
+            http_rules.append(PortRuleHTTP(
+                method="GET|HEAD", path=f"/static/{i}/[0-9]+/[a-f0-9]+"))
+        else:
+            http_rules.append(PortRuleHTTP(
+                method="PUT", path=f"/admin/{i}/config",
+                headers=(f"X-Role: admin{i % 10}",)))
+    rule = Rule(
+        endpoint_selector=_sel(app="server"),
+        ingress=(IngressRule(
+            from_endpoints=(_sel(app="client"),),
+            to_ports=(PortRule(
+                ports=(PortProtocol(80, Protocol.TCP),),
+                rules=L7Rules(http=tuple(http_rules)),
+            ),),
+        ),),
+        labels=("synth=http",),
+    )
+    flows = []
+    for _ in range(n_flows):
+        i = rng.randrange(n_rules)
+        hit = rng.random() < 0.5
+        kind = i % 5
+        if kind == 0:
+            path = f"/api/v{i % 9}/svc{i}/x9y" if hit else f"/api/v{i % 9}/svc{i}/"
+            method = "GET"
+            headers: Tuple = ()
+        elif kind == 1:
+            path = f"/api/v1/items/{i}/sub" if hit else f"/api/v1/items/{i}x"
+            method = "POST"
+            headers = ()
+        elif kind == 2:
+            path = f"/public/{i}/a/b" if hit else f"/private/{i}/a"
+            method = "GET"
+            headers = ()
+        elif kind == 3:
+            path = (f"/static/{i}/123/abc9" if hit
+                    else f"/static/{i}/123/XYZ")
+            method = "HEAD"
+            headers = ()
+        else:
+            path = f"/admin/{i}/config"
+            method = "PUT"
+            headers = ((("X-Role", f"admin{i % 10}"),) if hit
+                       else (("X-Role", "nobody"),))
+        flows.append(Flow(
+            src_identity=0, dst_identity=0, dport=80, protocol=Protocol.TCP,
+            direction=ING, l7=L7Type.HTTP,
+            http=HTTPInfo(method=method, path=path,
+                          host=f"svc{i % 50}.local", headers=headers),
+        ))
+    return SynthScenario(
+        name="http", rules=[rule],
+        endpoints={"server": {"app": "server"},
+                   "client": {"app": "client"}},
+        flows=flows,
+    )
+
+
+# ------------------------------------------------------ config 2: Kafka --
+def synth_kafka_scenario(n_rules: int = 20, n_records: int = 100000,
+                         seed: int = 0) -> SynthScenario:
+    rng = random.Random(seed)
+    kafka_rules = []
+    for i in range(n_rules):
+        if i % 2 == 0:
+            kafka_rules.append(PortRuleKafka(role="produce",
+                                             topic=f"topic-{i}"))
+        else:
+            kafka_rules.append(PortRuleKafka(role="consume",
+                                             topic=f"topic-{i}",
+                                             client_id=f"client-{i % 5}"))
+    rule = Rule(
+        endpoint_selector=_sel(app="kafka"),
+        ingress=(IngressRule(
+            from_endpoints=(_sel(app="producer"),),
+            to_ports=(PortRule(
+                ports=(PortProtocol(9092, Protocol.TCP),),
+                rules=L7Rules(kafka=tuple(kafka_rules)),
+            ),),
+        ),),
+        labels=("synth=kafka",),
+    )
+    flows = []
+    for _ in range(n_records):
+        i = rng.randrange(n_rules + 5)  # some topics unmatched
+        produce = rng.random() < 0.5
+        flows.append(Flow(
+            src_identity=0, dst_identity=0, dport=9092,
+            protocol=Protocol.TCP, direction=ING, l7=L7Type.KAFKA,
+            kafka=KafkaInfo(
+                api_key=0 if produce else 1,
+                api_version=rng.randint(0, 5),
+                client_id=f"client-{rng.randrange(8)}",
+                topic=f"topic-{i}",
+            ),
+        ))
+    return SynthScenario(
+        name="kafka", rules=[rule],
+        endpoints={"kafka": {"app": "kafka"},
+                   "producer": {"app": "producer"}},
+        flows=flows,
+    )
+
+
+# ------------------------------------------------------ config 3: mixed --
+def synth_mixed_scenario(corpus_dir: str, n_tuples: int = 1_000_000,
+                         seed: int = 0) -> SynthScenario:
+    """examples/policies corpus × synthetic identity/flow tuples."""
+    from cilium_tpu.policy.api import load_cnp_dir
+
+    rng = random.Random(seed)
+    cnps = load_cnp_dir(corpus_dir)
+    rules: List[Rule] = []
+    for c in cnps:
+        rules.extend(c.rules)
+    # endpoints covering the corpus selectors
+    endpoints = {
+        "frontend": {"app": "frontend"},
+        "backend": {"app": "backend"},
+        "db": {"app": "db"},
+        "service": {"app": "service"},
+        "kafka": {"app": "kafka"},
+        "empire-hq": {"app": "empire-hq"},
+        "crawler": {"app": "crawler"},
+        "scraper": {"app": "scraper"},
+        "exporters": {"app": "exporters"},
+        "web": {"tier": "web", "env": "prod"},
+        "cache": {"tier": "cache"},
+        "bystander": {"app": "bystander"},
+    }
+    names = list(endpoints)
+    ports = [80, 443, 5432, 9092, 53, 9100, 9105, 8080]
+    flows = []
+    for _ in range(n_tuples):
+        src, dst = rng.choice(names), rng.choice(names)
+        port = rng.choice(ports)
+        proto = Protocol.UDP if port == 53 else Protocol.TCP
+        f = Flow(src_identity=0, dst_identity=0, dport=port, protocol=proto,
+                 direction=ING)
+        if port == 80 and rng.random() < 0.5:
+            f.l7 = L7Type.HTTP
+            f.http = HTTPInfo(
+                method=rng.choice(["GET", "PUT", "POST"]),
+                path=rng.choice(["/api/v1/x", "/api/v1/config",
+                                 "/other", "/api/v9/y"]),
+                headers=((("X-Admin", "true"),) if rng.random() < 0.5
+                         else ()),
+            )
+        elif port == 9092 and rng.random() < 0.5:
+            f.l7 = L7Type.KAFKA
+            f.kafka = KafkaInfo(
+                api_key=rng.choice([0, 1, 3]),
+                topic=rng.choice(["deathstar-plans", "empire-announce",
+                                  "other"]),
+                client_id="c")
+        elif port == 53 and rng.random() < 0.5:
+            f.l7 = L7Type.DNS
+            f.dns = DNSInfo(query=rng.choice(
+                ["www.cilium.io", "example.com", "evil.io"]))
+        f._src_name = src  # filled to identities by the harness
+        f._dst_name = dst
+        flows.append(f)
+    return SynthScenario(name="mixed", rules=rules, endpoints=endpoints,
+                        flows=flows)
+
+
+# ------------------------------------------------ config 4: clustermesh --
+def synth_clustermesh_scenario(n_identities: int = 10000,
+                               n_policies: int = 5000,
+                               n_flows: int = 100000,
+                               seed: int = 0) -> SynthScenario:
+    """10k identities × 5k CNPs. Policies select label shards; peers
+    select other shards; sprinkled L7."""
+    rng = random.Random(seed)
+    n_apps = 500
+    endpoints = {
+        f"ep{i}": {"app": f"app{i % n_apps}",
+                   "shard": f"s{i % 64}",
+                   "cluster": f"c{i % 4}"}
+        for i in range(n_identities)
+    }
+    rules: List[Rule] = []
+    for i in range(n_policies):
+        app = f"app{i % n_apps}"
+        peer_shard = f"s{(i * 7) % 64}"
+        port = 1000 + (i % 200)
+        l7 = None
+        if i % 10 == 0:
+            l7 = L7Rules(http=(
+                PortRuleHTTP(method="GET", path=f"/p{i}/.*"),))
+        rules.append(Rule(
+            endpoint_selector=_sel(app=app),
+            ingress=(IngressRule(
+                from_endpoints=(_sel(shard=peer_shard),),
+                to_ports=(PortRule(
+                    ports=(PortProtocol(port, Protocol.TCP),),
+                    rules=l7,
+                ),),
+                deny=(i % 17 == 0) and l7 is None,
+            ),),
+            labels=(f"synth=mesh{i}",),
+        ))
+    names = list(endpoints)
+    flows = []
+    for _ in range(n_flows):
+        src, dst = rng.choice(names), rng.choice(names)
+        port = 1000 + rng.randrange(220)
+        f = Flow(src_identity=0, dst_identity=0, dport=port,
+                 protocol=Protocol.TCP, direction=ING)
+        if rng.random() < 0.1:
+            f.l7 = L7Type.HTTP
+            f.http = HTTPInfo(method="GET",
+                              path=f"/p{rng.randrange(n_policies)}/x")
+        f._src_name = src
+        f._dst_name = dst
+        flows.append(f)
+    return SynthScenario(name="clustermesh", rules=rules,
+                        endpoints=endpoints, flows=flows)
+
+
+# ----------------------------------------------------------- harness ----
+def realize_scenario(scenario: SynthScenario):
+    """Allocate identities, resolve policies, fix up flow identities.
+    Returns (per_identity_mapstates, scenario with ids filled)."""
+    from cilium_tpu.core.identity import IdentityAllocator
+    from cilium_tpu.core.labels import LabelSet
+    from cilium_tpu.policy.mapstate import PolicyResolver
+    from cilium_tpu.policy.repository import Repository
+    from cilium_tpu.policy.selectorcache import SelectorCache
+
+    alloc = IdentityAllocator()
+    ids: Dict[str, int] = {}
+    labelsets: Dict[str, "LabelSet"] = {}
+    for name, lbls in scenario.endpoints.items():
+        ls = LabelSet.from_dict(lbls)
+        ids[name] = alloc.allocate(ls)
+        labelsets[name] = ls
+    cache = SelectorCache(alloc)
+    repo = Repository()
+    repo.add(scenario.rules, sanitize=False)  # synth rules are well-formed
+    resolver = PolicyResolver(repo, cache)
+    per_identity = {ids[n]: resolver.resolve(labelsets[n])
+                    for n in scenario.endpoints}
+    scenario.ids = ids
+    # default src/dst for scenarios that use symbolic names
+    for f in scenario.flows:
+        src = getattr(f, "_src_name", None)
+        dst = getattr(f, "_dst_name", None)
+        if src is not None:
+            f.src_identity = ids[src]
+        if dst is not None:
+            f.dst_identity = ids[dst]
+    # single-policy scenarios: default identities
+    if scenario.name == "http":
+        for f in scenario.flows:
+            f.src_identity = ids["client"]
+            f.dst_identity = ids["server"]
+    elif scenario.name == "kafka":
+        for f in scenario.flows:
+            f.src_identity = ids["producer"]
+            f.dst_identity = ids["kafka"]
+    elif scenario.name == "fqdn":
+        for f in scenario.flows:
+            f.src_identity = ids["crawler"]
+            f.dst_identity = ids["peer"]
+    return per_identity, scenario

@@ -1,0 +1,31 @@
+"""Parallelism layer: meshes, shardings, multi-host (SURVEY.md §2.6/§2.7).
+
+The strategy map (reference mechanism → ours):
+
+* **DP** — flows sharded on the batch axis (the reference's
+  shared-nothing per-node agents); rule tensors replicated.
+* **EP** — DFA banks sharded on the ``expert`` axis (the reference's
+  per-namespace/per-parser partitioning); accept words all-gathered.
+* **CP/SP** — long payloads: blockwise transition composition
+  (associative scan / ring exchange) — scaffolding in ``longscan.py``.
+* **Multi-host** — ``jax.distributed`` + global meshes over DCN.
+
+All device-to-device communication is XLA collectives over ICI; there is
+no NCCL/MPI analog to port (the reference has none either — its channels
+are gRPC/etcd/unix sockets, which stay host-side).
+"""
+
+from cilium_tpu.parallel.mesh import make_mesh, data_parallel_mesh
+from cilium_tpu.parallel.sharding import (
+    shard_policy_arrays,
+    shard_flow_batch,
+    make_sharded_step,
+)
+
+__all__ = [
+    "make_mesh",
+    "data_parallel_mesh",
+    "shard_policy_arrays",
+    "shard_flow_batch",
+    "make_sharded_step",
+]

@@ -1,0 +1,38 @@
+"""Device mesh construction."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    shape: Optional[Tuple[int, ...]] = None,
+    axis_names: Sequence[str] = ("data",),
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a mesh over ``devices`` (default: all).
+
+    ``shape=None`` puts every device on the first axis. For a 2-axis
+    layout (DP × EP) pass e.g. ``shape=(4, 2),
+    axis_names=("data", "expert")``.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devs),) + (1,) * (len(axis_names) - 1)
+    n = int(np.prod(shape))
+    if n > len(devs):
+        raise ValueError(f"mesh shape {shape} needs {n} devices, "
+                         f"have {len(devs)}")
+    grid = np.array(devs[:n]).reshape(shape)
+    return Mesh(grid, tuple(axis_names))
+
+
+def data_parallel_mesh(n: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    if n is not None:
+        devs = devs[:n]
+    return make_mesh((len(devs),), ("data",), devs)

@@ -1,0 +1,72 @@
+"""Sharding layouts for the verdict pipeline.
+
+DP: every per-flow tensor is sharded on its leading (batch) axis over
+the ``data`` mesh axis; policy tensors are replicated. EP (optional):
+DFA bank tensors are sharded on their leading (bank) axis over the
+``expert`` axis — each device scans only its rule banks, and XLA
+all-gathers the per-bank accept words where the per-rule conjunction
+needs them.
+
+The jitted step itself is :func:`cilium_tpu.engine.verdict.verdict_step`
+unchanged — shardings are expressed via ``NamedSharding`` on the inputs
+and ``jax.jit`` constraints, letting XLA insert the collectives
+(SURVEY.md §2.7: ICI collectives are the only device-to-device channel).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cilium_tpu.engine.verdict import verdict_step
+
+#: policy tensors sharded on the bank axis under EP
+_EP_BANKED_PREFIXES = ("path_trans", "path_byteclass", "path_accept",
+                       "path_start")
+
+
+def shard_policy_arrays(
+    arrays: Dict[str, np.ndarray],
+    mesh: Mesh,
+    expert_axis: Optional[str] = None,
+) -> Dict[str, jax.Array]:
+    """Stage policy tensors: replicated, except (under EP) the path-DFA
+    bank tensors which shard on the bank axis."""
+    out = {}
+    for k, v in arrays.items():
+        spec = P()
+        if expert_axis is not None and k in _EP_BANKED_PREFIXES:
+            n_banks = v.shape[0]
+            ep_size = mesh.shape[expert_axis]
+            if n_banks % ep_size == 0:
+                spec = P(expert_axis)
+        out[k] = jax.device_put(v, NamedSharding(mesh, spec))
+    return out
+
+
+def shard_flow_batch(
+    batch: Dict[str, np.ndarray], mesh: Mesh, data_axis: str = "data"
+) -> Dict[str, jax.Array]:
+    """DP: shard every per-flow tensor on its leading axis."""
+    out = {}
+    for k, v in batch.items():
+        out[k] = jax.device_put(v, NamedSharding(mesh, P(data_axis)))
+    return out
+
+
+def make_sharded_step(mesh: Mesh, data_axis: str = "data"):
+    """jit verdict_step with batch-sharded outputs pinned to the mesh."""
+    out_sharding = NamedSharding(mesh, P(data_axis))
+
+    @jax.jit
+    def step(arrays, batch):
+        out = verdict_step(arrays, batch)
+        return {
+            k: jax.lax.with_sharding_constraint(v, out_sharding)
+            for k, v in out.items()
+        }
+
+    return step

@@ -1,0 +1,49 @@
+"""Policy engine: rule API, repository, selector cache, MapState.
+
+Mirrors the reference's ``pkg/policy`` (SURVEY.md §2.1) — the heart of the
+system per the north star.
+"""
+
+from cilium_tpu.policy.api import (
+    Rule,
+    IngressRule,
+    EgressRule,
+    PortRule,
+    PortProtocol,
+    L7Rules,
+    PortRuleHTTP,
+    PortRuleKafka,
+    PortRuleDNS,
+    HeaderMatch,
+    EndpointSelector,
+    FQDNSelector,
+)
+from cilium_tpu.policy.repository import Repository
+from cilium_tpu.policy.selectorcache import SelectorCache
+from cilium_tpu.policy.mapstate import (
+    MapState,
+    MapStateKey,
+    MapStateEntry,
+    PolicyResolver,
+)
+
+__all__ = [
+    "Rule",
+    "IngressRule",
+    "EgressRule",
+    "PortRule",
+    "PortProtocol",
+    "L7Rules",
+    "PortRuleHTTP",
+    "PortRuleKafka",
+    "PortRuleDNS",
+    "HeaderMatch",
+    "EndpointSelector",
+    "FQDNSelector",
+    "Repository",
+    "SelectorCache",
+    "MapState",
+    "MapStateKey",
+    "MapStateEntry",
+    "PolicyResolver",
+]

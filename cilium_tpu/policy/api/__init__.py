@@ -1,0 +1,48 @@
+"""The rule language (reference: ``pkg/policy/api`` — SURVEY.md §2.1)."""
+
+from cilium_tpu.policy.api.selector import EndpointSelector, FQDNSelector
+from cilium_tpu.policy.api.l7 import (
+    L7Rules,
+    PortRuleHTTP,
+    PortRuleKafka,
+    PortRuleDNS,
+    HeaderMatch,
+    KAFKA_API_KEYS,
+    KAFKA_ROLE_PRODUCE,
+    KAFKA_ROLE_CONSUME,
+)
+from cilium_tpu.policy.api.rule import (
+    Rule,
+    IngressRule,
+    EgressRule,
+    PortRule,
+    PortProtocol,
+    SanitizeError,
+)
+from cilium_tpu.policy.api.cnp import (
+    CiliumNetworkPolicy,
+    load_cnp_yaml,
+    load_cnp_dir,
+)
+
+__all__ = [
+    "EndpointSelector",
+    "FQDNSelector",
+    "L7Rules",
+    "PortRuleHTTP",
+    "PortRuleKafka",
+    "PortRuleDNS",
+    "HeaderMatch",
+    "KAFKA_API_KEYS",
+    "KAFKA_ROLE_PRODUCE",
+    "KAFKA_ROLE_CONSUME",
+    "Rule",
+    "IngressRule",
+    "EgressRule",
+    "PortRule",
+    "PortProtocol",
+    "SanitizeError",
+    "CiliumNetworkPolicy",
+    "load_cnp_yaml",
+    "load_cnp_dir",
+]

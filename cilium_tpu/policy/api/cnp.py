@@ -1,0 +1,127 @@
+"""CiliumNetworkPolicy YAML ingest.
+
+Reference: ``pkg/k8s/apis/cilium.io/v2`` CRD types + the conversion into
+``api.Rule`` (SURVEY.md §2.1/§2.4). Supports the spec shape used by the
+``examples/policies/`` corpus: ``spec`` or ``specs`` with
+``endpointSelector``, ``ingress[]``, ``egress[]``, ``ingressDeny[]``,
+``egressDeny[]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob as _glob
+import os
+from typing import Dict, List, Optional, Tuple
+
+import yaml
+
+from cilium_tpu.policy.api.rule import (
+    EgressRule,
+    IngressRule,
+    PortRule,
+    Rule,
+)
+from cilium_tpu.policy.api.selector import EndpointSelector, FQDNSelector
+
+
+@dataclasses.dataclass
+class CiliumNetworkPolicy:
+    name: str
+    namespace: str
+    rules: Tuple[Rule, ...]
+
+    @property
+    def labels(self) -> Tuple[str, ...]:
+        return (f"k8s:io.cilium.k8s.policy.name={self.name}",
+                f"k8s:io.cilium.k8s.policy.namespace={self.namespace}")
+
+
+def _parse_ingress(d: Dict, deny: bool) -> IngressRule:
+    return IngressRule(
+        from_endpoints=tuple(
+            EndpointSelector.from_dict(s) for s in (d.get("fromEndpoints") or ())
+        ),
+        from_entities=tuple(d.get("fromEntities") or ()),
+        from_cidrs=tuple(d.get("fromCIDR") or ()) +
+        tuple(c.get("cidr") for c in (d.get("fromCIDRSet") or ())
+              if isinstance(c, dict) and c.get("cidr")),
+        to_ports=tuple(PortRule.from_dict(p) for p in (d.get("toPorts") or ())),
+        deny=deny,
+    )
+
+
+def _parse_egress(d: Dict, deny: bool) -> EgressRule:
+    return EgressRule(
+        to_endpoints=tuple(
+            EndpointSelector.from_dict(s) for s in (d.get("toEndpoints") or ())
+        ),
+        to_entities=tuple(d.get("toEntities") or ()),
+        to_cidrs=tuple(d.get("toCIDR") or ()) +
+        tuple(c.get("cidr") for c in (d.get("toCIDRSet") or ())
+              if isinstance(c, dict) and c.get("cidr")),
+        to_fqdns=tuple(
+            FQDNSelector(
+                match_name=f.get("matchName", "") or "",
+                match_pattern=f.get("matchPattern", "") or "",
+            )
+            for f in (d.get("toFQDNs") or ())
+        ),
+        to_ports=tuple(PortRule.from_dict(p) for p in (d.get("toPorts") or ())),
+        deny=deny,
+    )
+
+
+def _spec_to_rule(spec: Dict, labels: Tuple[str, ...]) -> Rule:
+    return Rule(
+        endpoint_selector=EndpointSelector.from_dict(
+            spec.get("endpointSelector")),
+        ingress=tuple(_parse_ingress(i, False)
+                      for i in (spec.get("ingress") or ())) +
+        tuple(_parse_ingress(i, True)
+              for i in (spec.get("ingressDeny") or ())),
+        egress=tuple(_parse_egress(e, False)
+                     for e in (spec.get("egress") or ())) +
+        tuple(_parse_egress(e, True)
+              for e in (spec.get("egressDeny") or ())),
+        labels=labels,
+        description=spec.get("description", "") or "",
+    )
+
+
+def parse_cnp(doc: Dict) -> CiliumNetworkPolicy:
+    kind = doc.get("kind", "")
+    if kind not in ("CiliumNetworkPolicy", "CiliumClusterwideNetworkPolicy"):
+        raise ValueError(f"not a CNP: kind={kind!r}")
+    meta = doc.get("metadata") or {}
+    name = meta.get("name", "unnamed")
+    namespace = meta.get("namespace", "default")
+    labels = (f"k8s:io.cilium.k8s.policy.name={name}",
+              f"k8s:io.cilium.k8s.policy.namespace={namespace}")
+    specs: List[Dict] = []
+    if doc.get("spec"):
+        specs.append(doc["spec"])
+    specs.extend(doc.get("specs") or ())
+    rules = tuple(_spec_to_rule(s, labels) for s in specs)
+    return CiliumNetworkPolicy(name=name, namespace=namespace, rules=rules)
+
+
+def load_cnp_yaml(path: str) -> List[CiliumNetworkPolicy]:
+    """Load one YAML file (possibly multi-document) of CNPs."""
+    out: List[CiliumNetworkPolicy] = []
+    with open(path) as f:
+        for doc in yaml.safe_load_all(f):
+            if not doc:
+                continue
+            out.append(parse_cnp(doc))
+    return out
+
+
+def load_cnp_dir(path: str) -> List[CiliumNetworkPolicy]:
+    """Load every ``*.yaml`` under ``path`` recursively (the
+    ``examples/policies/`` corpus loader; BASELINE configs[3])."""
+    out: List[CiliumNetworkPolicy] = []
+    for p in sorted(_glob.glob(os.path.join(path, "**", "*.yaml"),
+                               recursive=True)):
+        out.extend(load_cnp_yaml(p))
+    return out

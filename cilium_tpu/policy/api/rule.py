@@ -1,0 +1,203 @@
+"""Rule, IngressRule, EgressRule, PortRule + sanitization.
+
+Reference: ``pkg/policy/api/rule.go``, ``l4.go``, ``rule_validation.go``
+(SURVEY.md §2.1, unverified paths). The shape is::
+
+    Rule{EndpointSelector, Ingress[], Egress[], Labels, Description}
+    IngressRule{FromEndpoints[], FromEntities[], FromCIDR[], ToPorts[],
+                IngressDeny variant via IngressCommonRule}
+    PortRule{Ports []PortProtocol, Rules *L7Rules}
+
+Deny rules (``IngressDeny``/``EgressDeny``) carry no L7 rules — the
+reference forbids L7 on deny (rule_validation.go), and so do we.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, Optional, Tuple
+
+from cilium_tpu.core.flow import Protocol
+from cilium_tpu.core.labels import LabelSet
+from cilium_tpu.policy.api.l7 import L7Rules, KAFKA_API_KEYS
+from cilium_tpu.policy.api.selector import EndpointSelector, FQDNSelector
+
+
+class SanitizeError(ValueError):
+    """Raised by ``Rule.sanitize`` on an invalid rule."""
+
+
+_PROTO_NAMES = {
+    "": Protocol.ANY,
+    "any": Protocol.ANY,
+    "tcp": Protocol.TCP,
+    "udp": Protocol.UDP,
+    "sctp": Protocol.SCTP,
+    "icmp": Protocol.ICMP,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class PortProtocol:
+    port: int = 0            # 0 = all ports
+    protocol: Protocol = Protocol.ANY
+    end_port: int = 0        # inclusive range end; 0 = single port
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "PortProtocol":
+        port_s = str(d.get("port", "0") or "0")
+        if not port_s.isdigit():
+            raise SanitizeError(f"named ports unsupported: {port_s!r}")
+        proto = _PROTO_NAMES.get(str(d.get("protocol", "") or "").lower())
+        if proto is None:
+            raise SanitizeError(f"unknown protocol {d.get('protocol')!r}")
+        return cls(
+            port=int(port_s),
+            protocol=proto,
+            end_port=int(d.get("endPort", 0) or 0),
+        )
+
+    def ports(self) -> Iterable[int]:
+        if self.end_port and self.end_port > self.port:
+            return range(self.port, self.end_port + 1)
+        return (self.port,)
+
+
+@dataclasses.dataclass(frozen=True)
+class PortRule:
+    ports: Tuple[PortProtocol, ...] = ()
+    rules: Optional[L7Rules] = None
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "PortRule":
+        return cls(
+            ports=tuple(PortProtocol.from_dict(p) for p in (d.get("ports") or ())),
+            rules=L7Rules.from_dict(d.get("rules")) if d.get("rules") else None,
+        )
+
+
+# Entities (reference: pkg/policy/api/entity.go) map to reserved-label
+# selectors.
+_ENTITY_SELECTORS: Dict[str, EndpointSelector] = {
+    "all": EndpointSelector(),
+    "world": EndpointSelector(match_labels=(("reserved:world", ""),)),
+    "host": EndpointSelector(match_labels=(("reserved:host", ""),)),
+    "remote-node": EndpointSelector(match_labels=(("reserved:remote-node", ""),)),
+    "health": EndpointSelector(match_labels=(("reserved:health", ""),)),
+    "init": EndpointSelector(match_labels=(("reserved:init", ""),)),
+    "ingress": EndpointSelector(match_labels=(("reserved:ingress", ""),)),
+    "kube-apiserver": EndpointSelector(
+        match_labels=(("reserved:kube-apiserver", ""),)
+    ),
+    "cluster": EndpointSelector(),  # approximation: cluster ≈ all in-cluster
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class IngressRule:
+    from_endpoints: Tuple[EndpointSelector, ...] = ()
+    from_entities: Tuple[str, ...] = ()
+    from_cidrs: Tuple[str, ...] = ()
+    to_ports: Tuple[PortRule, ...] = ()
+    deny: bool = False
+
+    def peer_selectors(self) -> Tuple[EndpointSelector, ...]:
+        sels = list(self.from_endpoints)
+        sels += [_ENTITY_SELECTORS[e] for e in self.from_entities]
+        if not sels:
+            # no peer constraint → wildcard peer
+            sels = [EndpointSelector()]
+        return tuple(sels)
+
+
+@dataclasses.dataclass(frozen=True)
+class EgressRule:
+    to_endpoints: Tuple[EndpointSelector, ...] = ()
+    to_entities: Tuple[str, ...] = ()
+    to_cidrs: Tuple[str, ...] = ()
+    to_fqdns: Tuple[FQDNSelector, ...] = ()
+    to_ports: Tuple[PortRule, ...] = ()
+    deny: bool = False
+
+    def peer_selectors(self) -> Tuple[EndpointSelector, ...]:
+        sels = list(self.to_endpoints)
+        sels += [_ENTITY_SELECTORS[e] for e in self.to_entities]
+        if not sels and not self.to_fqdns:
+            sels = [EndpointSelector()]
+        return tuple(sels)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    endpoint_selector: EndpointSelector = EndpointSelector()
+    ingress: Tuple[IngressRule, ...] = ()
+    egress: Tuple[EgressRule, ...] = ()
+    labels: Tuple[str, ...] = ()          # rule provenance labels
+    description: str = ""
+
+    def sanitize(self, max_quantifier: int = 64) -> "Rule":
+        """Validate the rule; raises SanitizeError.
+
+        Mirrors the reference's ``Rule.Sanitize`` checks that matter for
+        verdict semantics: port range validity, at most one L7 protocol
+        family per PortRule, no L7 on deny rules, valid regex / match
+        patterns, valid Kafka API keys/roles.
+        """
+        from cilium_tpu.policy.compiler import matchpattern, regex_parser
+
+        for direction, rules in (("ingress", self.ingress),
+                                 ("egress", self.egress)):
+            for r in rules:
+                for pr in r.to_ports:
+                    for pp in pr.ports:
+                        if not (0 <= pp.port <= 65535):
+                            raise SanitizeError(f"bad port {pp.port}")
+                        if pp.end_port and pp.end_port < pp.port:
+                            raise SanitizeError(
+                                f"endPort {pp.end_port} < port {pp.port}")
+                    l7 = pr.rules
+                    if l7 is None or l7.is_empty():
+                        continue
+                    if r.deny:
+                        raise SanitizeError("L7 rules not allowed on deny")
+                    if l7.n_protocols() > 1:
+                        raise SanitizeError(
+                            "only one L7 protocol family per PortRule")
+                    for h in l7.http:
+                        for pat in (h.path, h.method, h.host):
+                            if pat:
+                                regex_parser.parse(
+                                    pat, max_quantifier=max_quantifier)
+                        for hdr in h.headers:
+                            if not hdr.strip():
+                                raise SanitizeError("empty header match")
+                    for k in l7.kafka:
+                        if k.role and k.role not in ("produce", "consume"):
+                            raise SanitizeError(f"bad kafka role {k.role!r}")
+                        if k.api_key and k.api_key not in KAFKA_API_KEYS:
+                            raise SanitizeError(
+                                f"unknown kafka apiKey {k.api_key!r}")
+                        if k.api_version:
+                            try:
+                                int(k.api_version)
+                            except ValueError:
+                                raise SanitizeError(
+                                    f"bad kafka apiVersion {k.api_version!r}")
+                    for dr in l7.dns:
+                        if dr.match_name:
+                            matchpattern.validate_name(dr.match_name)
+                        if dr.match_pattern:
+                            matchpattern.validate(dr.match_pattern)
+                        if not (dr.match_name or dr.match_pattern):
+                            raise SanitizeError("empty DNS rule")
+        for er in self.egress:
+            for f in er.to_fqdns:
+                if f.match_name:
+                    matchpattern.validate_name(f.match_name)
+                if f.match_pattern:
+                    matchpattern.validate(f.match_pattern)
+        return self
+
+    @property
+    def key(self) -> str:
+        return "&".join(self.labels) or self.description or str(hash(self))

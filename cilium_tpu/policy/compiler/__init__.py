@@ -1,0 +1,24 @@
+"""Rule compiler: rule sources → finite automata → packed tensors.
+
+This is the host-side half of the compile/execute split (SURVEY.md §7):
+the reference's runtime regex engines (RE2 inside Envoy for HTTP;
+``pkg/fqdn/re``'s LRU of compiled Go regexes for FQDN) become an offline
+compiler producing dense transition tensors the TPU engine gathers
+through.
+"""
+
+from cilium_tpu.policy.compiler import matchpattern
+from cilium_tpu.policy.compiler import regex_parser
+from cilium_tpu.policy.compiler.nfa import NFA, build_nfa
+from cilium_tpu.policy.compiler.dfa import BankedDFA, compile_patterns
+from cilium_tpu.policy.compiler.oracle import OracleMatcher
+
+__all__ = [
+    "matchpattern",
+    "regex_parser",
+    "NFA",
+    "build_nfa",
+    "BankedDFA",
+    "compile_patterns",
+    "OracleMatcher",
+]

@@ -1,0 +1,226 @@
+"""MapState: the realized per-endpoint verdict table.
+
+Reference: ``pkg/policy/mapstate.go`` / ``resolve.go`` (SURVEY.md §2.1) —
+``EndpointPolicy.MapState: Key{Identity, DestPort, Nexthdr,
+TrafficDirection} → Entry{ProxyPort, IsDeny, DerivedFromRules}``.
+
+Precedence semantics reproduced (SURVEY.md §2.1 calls these out as
+"reproduce exactly"; cilium's documented model):
+
+* **deny > allow, at any breadth**: if any entry whose key *covers* the
+  flow (identity/port/proto each equal or wildcard-0) is a deny, the flow
+  is denied — a broad deny beats a narrow allow.
+* among covering allows, the **most specific** wins (this picks the
+  proxy-redirect/L7 behavior), specificity ordered identity > port >
+  proto (matching the datapath's probe order in ``bpf/lib/policy.h``:
+  exact → L4-only → L3-only → all-wildcard).
+* **L7 wildcard-wins**: if any covering allow at the winning (id,port)
+  carries no L7 rules, L7 filtering is bypassed for that flow; otherwise
+  the union of contributed L7 rule sets applies (allow-list: request
+  must match ≥1 rule).
+* **default deny per direction**: enforcement is on for a direction iff
+  ≥1 rule selecting the endpoint has a section for that direction; with
+  enforcement off, no-match ⇒ allow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from cilium_tpu.core.flow import Protocol, TrafficDirection
+from cilium_tpu.core.identity import IDENTITY_WILDCARD
+from cilium_tpu.core.labels import LabelSet
+from cilium_tpu.policy.api.l7 import L7Rules
+from cilium_tpu.policy.api.rule import Rule
+from cilium_tpu.policy.repository import Repository
+from cilium_tpu.policy.selectorcache import SelectorCache
+
+#: Wildcard port in map keys.
+PORT_WILDCARD = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class MapStateKey:
+    identity: int            # peer identity; 0 = wildcard
+    dport: int               # 0 = wildcard
+    proto: int               # Protocol; 0 = wildcard
+    direction: int           # TrafficDirection
+
+    def covers(self, identity: int, dport: int, proto: int,
+               direction: int) -> bool:
+        return (
+            self.direction == direction
+            and self.identity in (IDENTITY_WILDCARD, identity)
+            and self.dport in (PORT_WILDCARD, dport)
+            and self.proto in (0, proto)
+        )
+
+    @property
+    def specificity(self) -> int:
+        return (
+            (4 if self.identity != IDENTITY_WILDCARD else 0)
+            + (2 if self.dport != PORT_WILDCARD else 0)
+            + (1 if self.proto != 0 else 0)
+        )
+
+
+@dataclasses.dataclass
+class MapStateEntry:
+    is_deny: bool = False
+    #: union of L7 rule sets contributed by allows at this key
+    l7_rules: Tuple[L7Rules, ...] = ()
+    #: True if some contributing allow had no L7 restriction
+    l7_wildcard: bool = False
+    derived_from: Tuple[str, ...] = ()
+
+    @property
+    def is_redirect(self) -> bool:
+        return bool(self.l7_rules) and not self.l7_wildcard and not self.is_deny
+
+    def merge(self, other: "MapStateEntry") -> None:
+        self.is_deny = self.is_deny or other.is_deny
+        self.l7_wildcard = self.l7_wildcard or other.l7_wildcard
+        for lr in other.l7_rules:
+            if lr not in self.l7_rules:
+                self.l7_rules = self.l7_rules + (lr,)
+        for d in other.derived_from:
+            if d not in self.derived_from:
+                self.derived_from = self.derived_from + (d,)
+
+
+class MapState:
+    """Key → Entry table + per-direction enforcement flags."""
+
+    def __init__(self) -> None:
+        self.entries: Dict[MapStateKey, MapStateEntry] = {}
+        self.ingress_enforced = False
+        self.egress_enforced = False
+
+    def insert(self, key: MapStateKey, entry: MapStateEntry) -> None:
+        cur = self.entries.get(key)
+        if cur is None:
+            self.entries[key] = entry
+        else:
+            cur.merge(entry)
+
+    def lookup(
+        self, identity: int, dport: int, proto: int, direction: int
+    ) -> Tuple[bool, Optional[MapStateEntry]]:
+        """Pure-Python golden model of the datapath lookup.
+
+        Returns (allowed, winning_entry). ``winning_entry`` is None when
+        the verdict came from default enforcement. L7 is NOT evaluated
+        here — callers check ``entry.is_redirect``.
+        """
+        covering = [
+            (k, e) for k, e in self.entries.items()
+            if k.covers(identity, dport, proto, direction)
+        ]
+        if any(e.is_deny for _, e in covering):
+            denies = [(k, e) for k, e in covering if e.is_deny]
+            k, e = max(denies, key=lambda ke: ke[0].specificity)
+            return False, e
+        allows = [(k, e) for k, e in covering if not e.is_deny]
+        if allows:
+            k, e = max(allows, key=lambda ke: ke[0].specificity)
+            return True, e
+        enforced = (
+            self.ingress_enforced
+            if direction == TrafficDirection.INGRESS
+            else self.egress_enforced
+        )
+        return (not enforced), None
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+
+class PolicyResolver:
+    """Builds MapState per endpoint identity (resolvePolicyLocked +
+    EndpointPolicy analog, SURVEY.md §3.2)."""
+
+    def __init__(self, repo: Repository, selector_cache: SelectorCache):
+        self.repo = repo
+        self.cache = selector_cache
+
+    def resolve(self, endpoint_labels: LabelSet) -> MapState:
+        ms = MapState()
+        for rule in self.repo.matching_rules(endpoint_labels):
+            rule_id = rule.key
+            for ir in rule.ingress:
+                ms.ingress_enforced = True
+                self._apply_direction(
+                    ms, TrafficDirection.INGRESS, ir.peer_selectors(),
+                    ir.to_ports, ir.deny, rule_id, ir.from_cidrs, (),
+                )
+            for er in rule.egress:
+                ms.egress_enforced = True
+                self._apply_direction(
+                    ms, TrafficDirection.EGRESS, er.peer_selectors(),
+                    er.to_ports, er.deny, rule_id, er.to_cidrs, er.to_fqdns,
+                )
+        return ms
+
+    def _apply_direction(
+        self, ms: MapState, direction: int, peer_selectors, to_ports,
+        deny: bool, rule_id: str, cidrs, fqdns,
+    ) -> None:
+        peer_ids: Set[int] = set()
+        wildcard_peer = False
+        for sel in peer_selectors:
+            if sel.is_wildcard():
+                wildcard_peer = True
+            else:
+                peer_ids.update(self.cache.get_selections(sel))
+        for fsel in fqdns:
+            peer_ids.update(self.cache.get_selections(fsel))
+        for cidr in cidrs:
+            peer_ids.update(self._cidr_identities(cidr))
+        if wildcard_peer:
+            ids: Sequence[int] = (IDENTITY_WILDCARD,)
+        else:
+            ids = sorted(peer_ids)
+            if not ids:
+                return  # selector selects nothing (yet)
+
+        # each PortRule contributes its own entries — entries at the same
+        # key merge (union of L7 rule sets; wildcard-wins is preserved
+        # because a no-L7 PortRule contributes l7_wildcard=True)
+        contributions: List[Tuple[int, int, Optional[L7Rules]]] = []
+        if to_ports:
+            for pr in to_ports:
+                l7 = pr.rules if (pr.rules and not pr.rules.is_empty()) else None
+                if not pr.ports:
+                    contributions.append((PORT_WILDCARD, 0, l7))
+                for pp in pr.ports:
+                    for port in pp.ports():
+                        contributions.append((port, int(pp.protocol), l7))
+        else:
+            contributions.append((PORT_WILDCARD, 0, None))
+
+        for identity in ids:
+            for port, proto, l7 in contributions:
+                entry = MapStateEntry(
+                    is_deny=deny,
+                    l7_rules=(l7,) if (l7 and not deny) else (),
+                    l7_wildcard=(l7 is None) and not deny,
+                    derived_from=(rule_id,),
+                )
+                ms.insert(
+                    MapStateKey(identity=identity, dport=port, proto=proto,
+                                direction=direction),
+                    entry,
+                )
+
+    def _cidr_identities(self, cidr: str) -> FrozenSet[int]:
+        """CIDR → local identities. v0: CIDRs are registered with the
+        selector cache as labels ``cidr:<prefix>`` by the ipcache
+        (SURVEY.md §2.1 ipcache); resolve via label match."""
+        from cilium_tpu.core.labels import Label, LabelSet
+
+        out = set()
+        for nid, lbls in self.cache.identities().items():
+            if lbls.has(Label(key=cidr, source="cidr")):
+                out.add(nid)
+        return frozenset(out)

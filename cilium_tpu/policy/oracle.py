@@ -1,0 +1,143 @@
+"""Oracle verdict engine — the default (gate-off) CPU path.
+
+Plays the role the eBPF datapath + Envoy/proxylib play in the reference:
+the always-available, authoritative matcher. The TPU engine
+(``cilium_tpu.engine``) must agree with this bit-for-bit; the feature
+gate ``enable_tpu_offload`` switches between them (SURVEY.md §7 "Gates").
+Pure Python + ``re`` — intentionally simple and readable; correctness
+reference, not a fast path.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional, Sequence, Tuple
+
+from cilium_tpu.core.flow import Flow, L7Type, TrafficDirection, Verdict
+from cilium_tpu.policy.api.l7 import (
+    L7Rules,
+    PortRuleDNS,
+    PortRuleHTTP,
+    PortRuleKafka,
+)
+from cilium_tpu.policy.compiler import matchpattern
+from cilium_tpu.policy.mapstate import MapState
+
+
+def _bytes_fullmatch(pattern: str, s: str, flags: int = 0) -> bool:
+    """Byte-level full match: both sides UTF-8 — the engine's DFA scans
+    UTF-8 bytes, so the oracle must match at the same level ('.' counts
+    bytes, ASCII-only case folding)."""
+    return bool(re.fullmatch(pattern.encode("utf-8"), s.encode("utf-8"),
+                             flags))
+
+
+def _header_present(name: str, value: str, headers) -> bool:
+    """Any-instance semantics: some header instance satisfies the
+    requirement (matches the engine's per-line contains-regex over the
+    serialized header block, where duplicates each keep a line)."""
+    name = name.strip().lower()
+    value = value.strip()
+    for k, v in headers:
+        if k.strip().lower() == name and (not value or v.strip() == value):
+            return True
+    return False
+
+
+def _http_rule_matches(rule: PortRuleHTTP, flow: Flow) -> bool:
+    h = flow.http
+    if h is None:
+        return False
+    if rule.path and not _bytes_fullmatch(rule.path, h.path):
+        return False
+    if rule.method and not _bytes_fullmatch(rule.method, h.method):
+        return False
+    if rule.host and not _bytes_fullmatch(rule.host, h.host, re.IGNORECASE):
+        return False
+    for spec in rule.headers:
+        if ":" in spec:
+            name, value = spec.split(":", 1)
+        else:
+            name, value = spec, ""
+        if not _header_present(name, value, h.headers):
+            return False
+    for hm in rule.header_matches:
+        if hm.mismatch_action.upper() == "LOG":
+            continue
+        if not _header_present(hm.name, hm.value, h.headers):
+            return False
+    return True
+
+
+def _kafka_rule_matches(rule: PortRuleKafka, flow: Flow) -> bool:
+    k = flow.kafka
+    if k is None:
+        return False
+    allowed_keys = rule.allowed_api_keys()
+    if allowed_keys and k.api_key not in allowed_keys:
+        return False
+    if rule.api_version and k.api_version != int(rule.api_version):
+        return False
+    if rule.client_id and k.client_id != rule.client_id:
+        return False
+    if rule.topic and k.topic != rule.topic:
+        return False
+    return True
+
+
+def _dns_rule_matches(rule: PortRuleDNS, flow: Flow) -> bool:
+    d = flow.dns
+    if d is None or not d.query:
+        return False
+    qname = matchpattern.sanitize_name(d.query)
+    if rule.match_name:
+        return bool(re.fullmatch(matchpattern.name_to_regex(rule.match_name),
+                                 qname))
+    return bool(re.fullmatch(matchpattern.to_regex(rule.match_pattern), qname))
+
+
+def l7_allowed(l7_rules: Tuple[L7Rules, ...], flow: Flow) -> bool:
+    """Allow-list semantics: request must match ≥1 rule of the set."""
+    for lr in l7_rules:
+        for r in lr.http:
+            if _http_rule_matches(r, flow):
+                return True
+        for r in lr.kafka:
+            if _kafka_rule_matches(r, flow):
+                return True
+        for r in lr.dns:
+            if _dns_rule_matches(r, flow):
+                return True
+    return False
+
+
+class OracleVerdictEngine:
+    """Same contract as engine.VerdictEngine, pure CPU."""
+
+    def __init__(self, per_identity: Dict[int, MapState]):
+        self.per_identity = per_identity
+
+    def verdict_one(self, flow: Flow) -> Verdict:
+        ingress = flow.direction == TrafficDirection.INGRESS
+        ep_id = flow.dst_identity if ingress else flow.src_identity
+        peer_id = flow.src_identity if ingress else flow.dst_identity
+        ms = self.per_identity.get(ep_id)
+        if ms is None:
+            return Verdict.FORWARDED  # no policy for endpoint → allow
+        allowed, entry = ms.lookup(
+            peer_id, flow.dport, int(flow.protocol), int(flow.direction))
+        if not allowed:
+            return Verdict.DROPPED
+        if entry is not None and entry.is_redirect:
+            if l7_allowed(entry.l7_rules, flow):
+                return Verdict.REDIRECTED
+            return Verdict.DROPPED
+        return Verdict.FORWARDED
+
+    def verdict_flows(self, flows: Sequence[Flow]):
+        import numpy as np
+
+        return {
+            "verdict": np.array([int(self.verdict_one(f)) for f in flows],
+                                dtype=np.int32)
+        }

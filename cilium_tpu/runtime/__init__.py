@@ -1,0 +1,20 @@
+"""Runtime: loader (tensor staging / revision swap / feature gate),
+compiled-artifact checkpoint cache, metrics & spanstat timing.
+
+Mirrors the reference's ``pkg/datapath/loader`` (stage + hot-swap under a
+revision counter, behind the master gate), its metrics registry
+(``pkg/metrics``) and spanstat (``pkg/spanstat``) — SURVEY.md §2.3, §5.
+"""
+
+from cilium_tpu.runtime.loader import Loader
+from cilium_tpu.runtime.checkpoint import ArtifactCache, ruleset_fingerprint
+from cilium_tpu.runtime.metrics import Metrics, SpanStat, METRICS
+
+__all__ = [
+    "Loader",
+    "ArtifactCache",
+    "ruleset_fingerprint",
+    "Metrics",
+    "SpanStat",
+    "METRICS",
+]

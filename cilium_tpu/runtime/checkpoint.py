@@ -1,0 +1,54 @@
+"""Compiled-artifact cache.
+
+The reference survives agent restarts because compiled state outlives
+the process (pinned BPF maps, endpoint state JSON — SURVEY.md §5.3/§5.4).
+Ours: compiled policies are content-addressed by a fingerprint of the
+rule set + engine config; the cache lets a restarted verdict service
+(and bench.py) skip automaton compilation entirely.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from typing import Any, Optional
+
+
+def ruleset_fingerprint(*parts: Any) -> str:
+    """Stable hash over arbitrary picklable rule-set descriptors."""
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(pickle.dumps(p, protocol=4))
+    return h.hexdigest()[:24]
+
+
+class ArtifactCache:
+    def __init__(self, cache_dir: str, enable: bool = True):
+        self.cache_dir = cache_dir
+        self.enable = enable
+        if enable:
+            os.makedirs(cache_dir, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.cache_dir, f"{key}.pkl")
+
+    def get(self, key: str) -> Optional[Any]:
+        if not self.enable:
+            return None
+        path = self._path(key)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path, "rb") as f:
+                return pickle.load(f)
+        except Exception:
+            return None  # corrupt cache entry → recompile
+
+    def put(self, key: str, value: Any) -> None:
+        if not self.enable:
+            return
+        tmp = self._path(key) + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f, protocol=4)
+        os.replace(tmp, self._path(key))

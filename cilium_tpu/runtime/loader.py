@@ -1,0 +1,110 @@
+"""Loader: compile → stage → hot-swap, behind the feature gate.
+
+The analog of ``pkg/datapath/loader`` (SURVEY.md §2.3): where the
+reference compiles/templates BPF ELF per endpoint and attaches it under
+a revision counter, we compile rule sets to tensors, stage them on
+device, and atomically swap the active engine. The
+``enable_tpu_offload`` gate selects TPU engine vs CPU oracle — the
+default stays "reference behavior" (oracle), mirroring how eBPF/Envoy
+remain the reference's default datapath.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.labels import LabelSet
+from cilium_tpu.policy.mapstate import MapState, PolicyResolver
+from cilium_tpu.policy.oracle import OracleVerdictEngine
+from cilium_tpu.policy.repository import Repository
+from cilium_tpu.policy.selectorcache import SelectorCache
+from cilium_tpu.runtime.checkpoint import ArtifactCache, ruleset_fingerprint
+from cilium_tpu.runtime.metrics import METRICS, SpanStat
+
+
+class Loader:
+    """Owns the active engine; single-writer regeneration (the
+    reference's endpoint-regeneration queue is serialized per endpoint;
+    our unit of regeneration is the whole policy snapshot)."""
+
+    def __init__(self, config: Optional[Config] = None, device=None):
+        self.config = config or Config()
+        self.device = device
+        self._lock = threading.Lock()
+        self._engine = None
+        self._revision = 0
+        self._cache = ArtifactCache(self.config.loader.cache_dir,
+                                    self.config.loader.enable_cache)
+
+    @property
+    def revision(self) -> int:
+        return self._revision
+
+    @property
+    def engine(self):
+        with self._lock:
+            return self._engine
+
+    def regenerate(self, per_identity: Dict[int, MapState],
+                   revision: int = 0):
+        """Compile + stage a policy snapshot; atomic swap on success
+        (old engine keeps serving until then — the reference's datapath
+        likewise keeps enforcing during regeneration)."""
+        if not self.config.enable_tpu_offload:
+            engine = OracleVerdictEngine(per_identity)
+            with self._lock:
+                self._engine = engine
+                self._revision = revision
+            METRICS.inc("cilium_tpu_regenerations_total",
+                        labels={"backend": "oracle"})
+            return engine
+
+        from cilium_tpu.engine.verdict import CompiledPolicy, VerdictEngine
+
+        key = ruleset_fingerprint(
+            "policy-v1",
+            sorted(
+                (
+                    ep,
+                    tuple(sorted(
+                        (k.identity, k.dport, k.proto, k.direction,
+                         e.is_deny, e.l7_wildcard,
+                         tuple(sorted(repr(lr) for lr in e.l7_rules)))
+                        for k, e in ms.entries.items()
+                    )),
+                    ms.ingress_enforced,
+                    ms.egress_enforced,
+                )
+                for ep, ms in per_identity.items()
+            ),
+            repr(self.config.engine),
+        )
+        policy = self._cache.get(key)
+        if policy is None:
+            with SpanStat("policy_compile") as span:
+                policy = CompiledPolicy.build(per_identity,
+                                              self.config.engine,
+                                              revision=revision)
+            self._cache.put(key, policy)
+            METRICS.observe("cilium_tpu_compile_seconds", span.seconds)
+        with SpanStat("policy_stage"):
+            engine = VerdictEngine(policy, device=self.device)
+        with self._lock:
+            self._engine = engine
+            self._revision = revision
+        METRICS.inc("cilium_tpu_regenerations_total",
+                    labels={"backend": "tpu"})
+        return engine
+
+    def regenerate_from_repo(self, repo: Repository, cache: SelectorCache,
+                             endpoint_labels: Dict[int, LabelSet]):
+        """Resolve + regenerate for a set of endpoint identities
+        (§3.2's regeneration fan-out, collapsed to one snapshot)."""
+        resolver = PolicyResolver(repo, cache)
+        per_identity = {
+            ep: resolver.resolve(lbls)
+            for ep, lbls in endpoint_labels.items()
+        }
+        return self.regenerate(per_identity, revision=repo.revision)

@@ -1,0 +1,102 @@
+"""Metrics + spanstat.
+
+Counter/gauge/histogram registry with Prometheus text exposition, plus
+``SpanStat`` duration spans (reference: ``pkg/metrics``,
+``pkg/spanstat`` — SURVEY.md §5.5). Key series mirror the reference's:
+``policy_regeneration_time_stats_seconds`` → compile spans;
+``drop_count_total`` / ``policy_l7_total`` → verdict counters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, Tuple], float] = defaultdict(float)
+        self._gauges: Dict[Tuple[str, Tuple], float] = {}
+        self._histos: Dict[Tuple[str, Tuple], List[float]] = defaultdict(list)
+
+    @staticmethod
+    def _key(name: str, labels: Optional[Dict[str, str]]):
+        return (name, tuple(sorted((labels or {}).items())))
+
+    def inc(self, name: str, value: float = 1.0,
+            labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._counters[self._key(name, labels)] += value
+
+    def set_gauge(self, name: str, value: float,
+                  labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._gauges[self._key(name, labels)] = value
+
+    def observe(self, name: str, value: float,
+                labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._histos[self._key(name, labels)].append(value)
+
+    def get(self, name: str, labels: Optional[Dict[str, str]] = None) -> float:
+        with self._lock:
+            k = self._key(name, labels)
+            if k in self._counters:
+                return self._counters[k]
+            return self._gauges.get(k, 0.0)
+
+    def quantile(self, name: str, q: float,
+                 labels: Optional[Dict[str, str]] = None) -> float:
+        with self._lock:
+            vals = sorted(self._histos.get(self._key(name, labels), ()))
+        if not vals:
+            return 0.0
+        idx = min(len(vals) - 1, int(q * len(vals)))
+        return vals[idx]
+
+    def expose(self) -> str:
+        """Prometheus text format."""
+        out = []
+        with self._lock:
+            for (name, labels), v in sorted(self._counters.items()):
+                out.append(f"{_fmt(name, labels)} {v}")
+            for (name, labels), v in sorted(self._gauges.items()):
+                out.append(f"{_fmt(name, labels)} {v}")
+            for (name, labels), vals in sorted(self._histos.items()):
+                if vals:
+                    out.append(f"{_fmt(name + '_count', labels)} {len(vals)}")
+                    out.append(f"{_fmt(name + '_sum', labels)} {sum(vals)}")
+        return "\n".join(out) + "\n"
+
+
+def _fmt(name: str, labels: Tuple) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return f"{name}{{{inner}}}"
+
+
+#: process-global registry (like the reference's default registry)
+METRICS = Metrics()
+
+
+class SpanStat:
+    """Duration span: ``with SpanStat("compile"): ...`` records seconds
+    into the global histogram ``cilium_tpu_span_seconds{span=...}``."""
+
+    def __init__(self, span: str, metrics: Metrics = METRICS):
+        self.span = span
+        self.metrics = metrics
+        self.seconds = 0.0
+
+    def __enter__(self) -> "SpanStat":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.seconds = time.perf_counter() - self._t0
+        self.metrics.observe("cilium_tpu_span_seconds", self.seconds,
+                             {"span": self.span})

@@ -1,0 +1,219 @@
+"""End-to-end differential: TPU engine ≡ oracle verdict path.
+
+Random policies (HTTP/Kafka/DNS L7 + L3/L4 allow/deny) × random flows;
+the jitted engine must agree with the pure-Python oracle on every
+verdict (SURVEY.md §4 control-plane-integration analog).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from cilium_tpu.core.flow import (
+    DNSInfo,
+    Flow,
+    HTTPInfo,
+    KafkaInfo,
+    L7Type,
+    Protocol,
+    TrafficDirection,
+    Verdict,
+)
+from cilium_tpu.core.labels import LabelSet
+from cilium_tpu.core.identity import IdentityAllocator
+from cilium_tpu.policy.api import (
+    EndpointSelector,
+    IngressRule,
+    L7Rules,
+    PortProtocol,
+    PortRule,
+    PortRuleDNS,
+    PortRuleHTTP,
+    PortRuleKafka,
+    Rule,
+)
+from cilium_tpu.policy.mapstate import PolicyResolver
+from cilium_tpu.policy.oracle import OracleVerdictEngine
+from cilium_tpu.policy.repository import Repository
+from cilium_tpu.policy.selectorcache import SelectorCache
+from cilium_tpu.engine.verdict import CompiledPolicy, VerdictEngine
+
+ING = TrafficDirection.INGRESS
+
+
+def _setup(rules, endpoints):
+    """endpoints: dict name → labels dict. Returns (per_identity, ids)."""
+    alloc = IdentityAllocator()
+    ids = {}
+    labelsets = {}
+    for name, lbls in endpoints.items():
+        ls = LabelSet.from_dict(lbls)
+        ids[name] = alloc.allocate(ls)
+        labelsets[name] = ls
+    cache = SelectorCache(alloc)
+    repo = Repository()
+    repo.add(rules)
+    resolver = PolicyResolver(repo, cache)
+    per_identity = {
+        ids[name]: resolver.resolve(labelsets[name]) for name in endpoints
+    }
+    return per_identity, ids
+
+
+ENDPOINTS = {
+    "frontend": {"app": "frontend"},
+    "backend": {"app": "backend"},
+    "db": {"app": "db"},
+    "kafka": {"app": "kafka"},
+    "dnsproxy": {"app": "dnsproxy"},
+}
+
+
+def _http_rules():
+    sel = lambda **kv: EndpointSelector.from_labels(**kv)
+    return [
+        Rule(
+            endpoint_selector=sel(app="backend"),
+            ingress=(
+                IngressRule(
+                    from_endpoints=(sel(app="frontend"),),
+                    to_ports=(PortRule(
+                        ports=(PortProtocol(80, Protocol.TCP),),
+                        rules=L7Rules(http=(
+                            PortRuleHTTP(method="GET",
+                                         path="/api/v[0-9]+/users/.*"),
+                            PortRuleHTTP(method="POST", path="/api/v1/login",
+                                         headers=("X-Auth: token123",)),
+                        )),
+                    ),),
+                ),
+            ),
+            labels=("rule=http-backend",),
+        ),
+        Rule(
+            endpoint_selector=sel(app="db"),
+            ingress=(
+                IngressRule(from_endpoints=(sel(app="backend"),),
+                            to_ports=(PortRule(
+                                ports=(PortProtocol(5432, Protocol.TCP),),),)),
+                IngressRule(from_endpoints=(sel(app="frontend"),), deny=True),
+            ),
+            labels=("rule=db",),
+        ),
+        Rule(
+            endpoint_selector=sel(app="kafka"),
+            ingress=(
+                IngressRule(
+                    from_endpoints=(sel(app="backend"),),
+                    to_ports=(PortRule(
+                        ports=(PortProtocol(9092, Protocol.TCP),),
+                        rules=L7Rules(kafka=(
+                            PortRuleKafka(role="produce", topic="events"),
+                            PortRuleKafka(api_key="fetch", topic="logs"),
+                        )),
+                    ),),
+                ),
+            ),
+            labels=("rule=kafka",),
+        ),
+        Rule(
+            endpoint_selector=sel(app="dnsproxy"),
+            ingress=(
+                IngressRule(
+                    to_ports=(PortRule(
+                        ports=(PortProtocol(53, Protocol.UDP),),
+                        rules=L7Rules(dns=(
+                            PortRuleDNS(match_pattern="*.cilium.io"),
+                            PortRuleDNS(match_name="example.com"),
+                        )),
+                    ),),
+                ),
+            ),
+            labels=("rule=dns",),
+        ),
+    ]
+
+
+def _mk_flows(ids, rng):
+    flows = []
+    apps = list(ids)
+    paths = ["/api/v1/users/7", "/api/v2/users/", "/api/v1/login",
+             "/admin", "/api/vx/users/1", ""]
+    methods = ["GET", "POST", "PUT"]
+    topics = ["events", "logs", "secrets"]
+    qnames = ["www.cilium.io", "a.b.cilium.io", "example.com",
+              "evil.example.com", "EXAMPLE.com."]
+    for _ in range(200):
+        src, dst = rng.choice(apps), rng.choice(apps)
+        port = rng.choice([80, 5432, 9092, 53, 8080])
+        proto = Protocol.UDP if port == 53 else Protocol.TCP
+        f = Flow(src_identity=ids[src], dst_identity=ids[dst], dport=port,
+                 protocol=proto, direction=ING)
+        kind = rng.random()
+        if kind < 0.4:
+            f.l7 = L7Type.HTTP
+            hdrs = (("X-Auth", "token123"),) if rng.random() < 0.5 else ()
+            f.http = HTTPInfo(method=rng.choice(methods),
+                              path=rng.choice(paths),
+                              host="svc.local", headers=hdrs)
+        elif kind < 0.6:
+            f.l7 = L7Type.KAFKA
+            f.kafka = KafkaInfo(
+                api_key=rng.choice([0, 1, 3, 8, 19]),
+                api_version=rng.randint(0, 3),
+                client_id="c1", topic=rng.choice(topics))
+        elif kind < 0.8:
+            f.l7 = L7Type.DNS
+            f.dns = DNSInfo(query=rng.choice(qnames))
+        flows.append(f)
+    return flows
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_engine_matches_oracle(seed):
+    rng = random.Random(seed)
+    per_identity, ids = _setup(_http_rules(), ENDPOINTS)
+    flows = _mk_flows(ids, rng)
+
+    oracle = OracleVerdictEngine(per_identity)
+    want = oracle.verdict_flows(flows)["verdict"]
+
+    policy = CompiledPolicy.build(per_identity)
+    engine = VerdictEngine(policy)
+    got = engine.verdict_flows(flows)["verdict"]
+
+    mism = np.nonzero(got != want)[0]
+    if mism.size:
+        i = int(mism[0])
+        f = flows[i]
+        raise AssertionError(
+            f"{mism.size} mismatches; first: flow {i} "
+            f"src={f.src_identity} dst={f.dst_identity} port={f.dport} "
+            f"l7={f.l7.name} http={f.http} kafka={f.kafka} dns={f.dns} "
+            f"got={Verdict(int(got[i])).name} want={Verdict(int(want[i])).name}"
+        )
+
+
+def test_specific_http_semantics():
+    per_identity, ids = _setup(_http_rules(), ENDPOINTS)
+    policy = CompiledPolicy.build(per_identity)
+    engine = VerdictEngine(policy)
+
+    def flow(path, method="GET", headers=()):
+        return Flow(src_identity=ids["frontend"],
+                    dst_identity=ids["backend"], dport=80,
+                    protocol=Protocol.TCP, direction=ING, l7=L7Type.HTTP,
+                    http=HTTPInfo(method=method, path=path, headers=headers))
+
+    out = engine.verdict_flows([
+        flow("/api/v1/users/42"),                     # allow (rule 1)
+        flow("/api/v1/users/42", method="DELETE"),    # deny: method
+        flow("/api/v1/login", method="POST",
+             headers=(("X-Auth", "token123"),)),      # allow (rule 2)
+        flow("/api/v1/login", method="POST"),         # deny: missing header
+        flow("/admin"),                               # deny: no rule
+    ])
+    v = out["verdict"]
+    R, D = int(Verdict.REDIRECTED), int(Verdict.DROPPED)
+    assert list(v) == [R, D, R, D, D]

@@ -1,0 +1,129 @@
+"""MapState precedence semantics + packed-kernel equivalence.
+
+SURVEY.md §7 hard part #2: deny/wildcard/proxy precedence bit-for-bit.
+The JAX kernel is differentially tested against MapState.lookup (the
+golden model) on randomized tables.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from cilium_tpu.core.flow import Protocol, TrafficDirection
+from cilium_tpu.policy.mapstate import MapState, MapStateEntry, MapStateKey
+from cilium_tpu.engine.mapstate_kernel import mapstate_lookup, pack_mapstate
+
+ING = int(TrafficDirection.INGRESS)
+EG = int(TrafficDirection.EGRESS)
+TCP = int(Protocol.TCP)
+
+
+def _ms(entries, ingress_enforced=True, egress_enforced=False):
+    ms = MapState()
+    ms.ingress_enforced = ingress_enforced
+    ms.egress_enforced = egress_enforced
+    for (ident, port, proto, direction), entry in entries:
+        ms.insert(MapStateKey(ident, port, proto, direction), entry)
+    return ms
+
+
+def test_deny_beats_narrow_allow():
+    # broad deny (any peer) vs specific allow (peer 100, port 80)
+    ms = _ms([
+        ((100, 80, TCP, ING), MapStateEntry()),
+        ((0, 0, 0, ING), MapStateEntry(is_deny=True)),
+    ])
+    allowed, _ = ms.lookup(100, 80, TCP, ING)
+    assert not allowed
+
+
+def test_specific_allow_wins_for_l7():
+    from cilium_tpu.policy.api.l7 import L7Rules, PortRuleHTTP
+
+    l7 = L7Rules(http=(PortRuleHTTP(path="/x"),))
+    ms = _ms([
+        ((0, 0, 0, ING), MapStateEntry(l7_wildcard=True)),
+        ((100, 80, TCP, ING), MapStateEntry(l7_rules=(l7,))),
+    ])
+    allowed, entry = ms.lookup(100, 80, TCP, ING)
+    assert allowed and entry is not None and entry.is_redirect
+    # different peer → falls to the wildcard allow, no redirect
+    allowed, entry = ms.lookup(200, 80, TCP, ING)
+    assert allowed and entry is not None and not entry.is_redirect
+
+
+def test_default_deny_vs_unenforced():
+    ms = _ms([((100, 80, TCP, ING), MapStateEntry())],
+             ingress_enforced=True, egress_enforced=False)
+    assert not ms.lookup(200, 443, TCP, ING)[0]   # enforced, no match
+    assert ms.lookup(200, 443, TCP, EG)[0]        # unenforced direction
+
+
+def test_l7_wildcard_wins_on_merge():
+    from cilium_tpu.policy.api.l7 import L7Rules, PortRuleHTTP
+
+    l7 = L7Rules(http=(PortRuleHTTP(path="/x"),))
+    ms = _ms([
+        ((100, 80, TCP, ING), MapStateEntry(l7_rules=(l7,))),
+        ((100, 80, TCP, ING), MapStateEntry(l7_wildcard=True)),
+    ])
+    _, entry = ms.lookup(100, 80, TCP, ING)
+    assert entry is not None and not entry.is_redirect
+
+
+def _random_mapstate(rng: random.Random) -> MapState:
+    ms = MapState()
+    ms.ingress_enforced = rng.random() < 0.7
+    ms.egress_enforced = rng.random() < 0.5
+    for _ in range(rng.randint(0, 30)):
+        key = MapStateKey(
+            identity=rng.choice([0, 100, 200, 300]),
+            dport=rng.choice([0, 53, 80, 443]),
+            proto=rng.choice([0, TCP, int(Protocol.UDP)]),
+            direction=rng.choice([ING, EG]),
+        )
+        ms.insert(key, MapStateEntry(is_deny=rng.random() < 0.3))
+    return ms
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_kernel_matches_golden_model(seed):
+    rng = random.Random(seed)
+    per_identity = {ep: _random_mapstate(rng) for ep in (1000, 2000, 3000)}
+    packed = pack_mapstate(per_identity)
+
+    eps, peers, ports, protos, dirs, want_allowed = [], [], [], [], [], []
+    for _ in range(300):
+        ep = rng.choice([1000, 2000, 3000, 4000])  # 4000: no policy
+        peer = rng.choice([0, 100, 200, 300, 999])
+        port = rng.choice([0, 53, 80, 443, 8080])
+        proto = rng.choice([TCP, int(Protocol.UDP)])
+        d = rng.choice([ING, EG])
+        ms = per_identity.get(ep)
+        if ms is None:
+            want = True  # no policy → allow
+        else:
+            want = ms.lookup(peer, port, proto, d)[0]
+        eps.append(ep); peers.append(peer); ports.append(port)
+        protos.append(proto); dirs.append(d); want_allowed.append(want)
+
+    import jax.numpy as jnp
+
+    out = mapstate_lookup(
+        jnp.asarray(packed.key_w0), jnp.asarray(packed.key_w1),
+        jnp.asarray(packed.key_w2), jnp.asarray(packed.is_deny),
+        jnp.asarray(packed.ruleset_id), jnp.asarray(packed.enf_ids),
+        jnp.asarray(packed.enf_flags),
+        jnp.asarray(eps, dtype=jnp.int32),
+        jnp.asarray(peers, dtype=jnp.int32),
+        jnp.asarray(ports, dtype=jnp.int32),
+        jnp.asarray(protos, dtype=jnp.int32),
+        jnp.asarray(dirs, dtype=jnp.int32),
+    )
+    got = np.asarray(out["allowed"])
+    mism = np.nonzero(got != np.array(want_allowed))[0]
+    assert mism.size == 0, (
+        f"first mismatch at {mism[:5]}: "
+        f"{[(eps[i], peers[i], ports[i], protos[i], dirs[i]) for i in mism[:5]]}"
+    )

@@ -1,0 +1,56 @@
+"""toFQDNs matchPattern semantics (reference: pkg/fqdn/matchpattern)."""
+
+import re
+
+import pytest
+
+from cilium_tpu.policy.compiler import matchpattern as mp
+
+
+def _matches(pattern: str, name: str) -> bool:
+    rx = re.compile(mp.to_regex(pattern))
+    return bool(rx.match(mp.sanitize_name(name)))
+
+
+def test_exact_name():
+    assert _matches("cilium.io", "cilium.io")
+    assert _matches("cilium.io", "CILIUM.IO")        # case-insensitive
+    assert _matches("cilium.io", "cilium.io.")       # trailing dot normalized
+    assert not _matches("cilium.io", "www.cilium.io")
+    assert not _matches("cilium.io", "ciliumxio")    # '.' is literal
+
+
+def test_star_is_label_local():
+    assert _matches("*.cilium.io", "www.cilium.io")
+    assert _matches("*.cilium.io", "sub-domain_1.cilium.io")
+    # '*' must not cross a label boundary (no dots)
+    assert not _matches("*.cilium.io", "a.b.cilium.io")
+    # zero chars is allowed by '*' but the leading dot remains
+    assert not _matches("*.cilium.io", "cilium.io")
+
+
+def test_star_infix():
+    assert _matches("sub*.cilium.io", "sub.cilium.io")
+    assert _matches("sub*.cilium.io", "sub1.cilium.io")
+    assert not _matches("sub*.cilium.io", "su.cilium.io")
+
+
+def test_match_all():
+    assert _matches("*", "anything.example.com")
+    assert _matches("*", "a")
+    assert _matches("*", ".")
+
+
+def test_validate_rejects():
+    with pytest.raises(mp.InvalidPatternError):
+        mp.validate("")
+    with pytest.raises(mp.InvalidPatternError):
+        mp.validate("exa mple.com")
+    with pytest.raises(mp.InvalidPatternError):
+        mp.validate_name("*.cilium.io")  # '*' not valid in matchName
+
+
+def test_sanitize_idempotent():
+    assert mp.sanitize("Example.COM") == "example.com."
+    assert mp.sanitize("example.com.") == "example.com."
+    assert mp.sanitize("*") == "*"

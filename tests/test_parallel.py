@@ -1,0 +1,52 @@
+"""Multi-device sharding on the 8-device virtual CPU mesh.
+
+Validates: DP-sharded verdict step ≡ single-device results; EP bank
+sharding; the driver's dryrun_multichip contract.
+"""
+
+import sys
+import os
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_dryrun_multichip_8():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)
+
+
+def test_entry_jits():
+    import jax
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    assert "verdict" in out
+
+
+def test_dp_sharded_equals_single_device():
+    import jax
+    from cilium_tpu.parallel.mesh import make_mesh
+    from cilium_tpu.parallel.sharding import (
+        make_sharded_step,
+        shard_flow_batch,
+        shard_policy_arrays,
+    )
+    from cilium_tpu.engine.verdict import verdict_step
+    import __graft_entry__ as ge
+
+    policy, batch = ge._small_policy_and_batch(n_rules=32, n_flows=64)
+    single = jax.jit(verdict_step)(policy.arrays, batch)
+
+    mesh = make_mesh((4, 2), ("data", "expert"))
+    arrays = shard_policy_arrays(policy.arrays, mesh, expert_axis="expert")
+    sbatch = shard_flow_batch(batch, mesh, "data")
+    out = make_sharded_step(mesh, "data")(arrays, sbatch)
+
+    np.testing.assert_array_equal(
+        np.asarray(single["verdict"]), np.asarray(out["verdict"]))

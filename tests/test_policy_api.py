@@ -1,0 +1,100 @@
+"""Rule API: CNP YAML ingest, sanitize, selectors, repository."""
+
+import os
+
+import pytest
+
+from cilium_tpu.core.labels import LabelSet
+from cilium_tpu.policy.api import (
+    EndpointSelector,
+    IngressRule,
+    L7Rules,
+    PortProtocol,
+    PortRule,
+    PortRuleHTTP,
+    PortRuleKafka,
+    Rule,
+    SanitizeError,
+    load_cnp_dir,
+    load_cnp_yaml,
+)
+from cilium_tpu.policy.repository import Repository
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "policies")
+
+
+def test_load_corpus():
+    cnps = load_cnp_dir(FIXTURES)
+    assert len(cnps) >= 8
+    repo = Repository()
+    for cnp in cnps:
+        repo.add(cnp.rules)  # sanitizes
+    assert len(repo) >= 8
+    assert repo.revision == len(cnps)
+
+
+def test_multi_spec_and_clusterwide():
+    cnps = load_cnp_yaml(os.path.join(FIXTURES, "l7", "multi-spec.yaml"))
+    assert [c.name for c in cnps] == ["multi-spec", "cluster-deny-init"]
+    assert len(cnps[0].rules) == 2
+    assert cnps[1].rules[0].ingress[0].deny
+    assert cnps[1].rules[0].endpoint_selector.is_wildcard()
+
+
+def test_selector_sources_and_expressions():
+    cnps = load_cnp_yaml(os.path.join(FIXTURES, "l7", "multi-spec.yaml"))
+    sel = cnps[0].rules[0].ingress[0].from_endpoints[0]
+    assert sel.matches(LabelSet.from_dict({"env": "prod", "x": "y"}))
+    assert not sel.matches(LabelSet.from_dict({"env": "dev"}))
+
+
+def test_entity_selector_matches_reserved():
+    cnps = load_cnp_yaml(os.path.join(FIXTURES, "l3", "deny-world.yaml"))
+    rule = cnps[0].rules[0]
+    sel = rule.ingress[0].peer_selectors()[0]
+    world = LabelSet.parse(["reserved:world"])
+    assert sel.matches(world)
+    assert not sel.matches(LabelSet.from_dict({"app": "x"}))
+
+
+def test_sanitize_rejects_l7_on_deny():
+    r = Rule(
+        endpoint_selector=EndpointSelector(),
+        ingress=(IngressRule(
+            deny=True,
+            to_ports=(PortRule(
+                ports=(PortProtocol(80),),
+                rules=L7Rules(http=(PortRuleHTTP(path="/x"),)),
+            ),),
+        ),),
+    )
+    with pytest.raises(SanitizeError):
+        r.sanitize()
+
+
+def test_sanitize_rejects_bad_regex_and_kafka():
+    r = Rule(ingress=(IngressRule(to_ports=(PortRule(
+        ports=(PortProtocol(80),),
+        rules=L7Rules(http=(PortRuleHTTP(path="/((("),)),
+    ),),),))
+    with pytest.raises(Exception):
+        r.sanitize()
+    r2 = Rule(ingress=(IngressRule(to_ports=(PortRule(
+        ports=(PortProtocol(9092),),
+        rules=L7Rules(kafka=(PortRuleKafka(api_key="notakey"),)),
+    ),),),))
+    with pytest.raises(SanitizeError):
+        r2.sanitize()
+
+
+def test_repository_delete_by_labels():
+    repo = Repository()
+    cnps = load_cnp_dir(FIXTURES)
+    for cnp in cnps:
+        repo.add(cnp.rules)
+    n0 = len(repo)
+    n_del, _ = repo.delete_by_labels(
+        ("k8s:io.cilium.k8s.policy.name=l4-allow-80",))
+    assert n_del == 1
+    assert len(repo) == n0 - 1

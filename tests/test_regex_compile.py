@@ -1,0 +1,161 @@
+"""Differential test: banked DFA ≡ Python `re` oracle.
+
+SURVEY.md §4 calls this "our single most important test": random patterns
+from the supported RE2 subset × random inputs, compiled automata must
+agree with the oracle bit-for-bit.
+"""
+
+import random
+import re
+import string
+
+import numpy as np
+import pytest
+
+from cilium_tpu.policy.compiler import regex_parser as rp
+from cilium_tpu.policy.compiler.dfa import compile_patterns, match_bank_numpy
+from cilium_tpu.policy.compiler.oracle import OracleMatcher
+
+
+def _match_all_numpy(banked, strings):
+    """Match strings against every pattern via the numpy golden scan."""
+    L = max((len(s) for s in strings), default=1) or 1
+    data = np.zeros((len(strings), L), dtype=np.uint8)
+    lengths = np.zeros(len(strings), dtype=np.int32)
+    for i, s in enumerate(strings):
+        bs = s.encode("utf-8")
+        data[i, : len(bs)] = np.frombuffer(bs, dtype=np.uint8)
+        lengths[i] = len(bs)
+    out = np.zeros((len(strings), banked.n_patterns), dtype=bool)
+    for bid, bank in enumerate(banked.banks):
+        words = match_bank_numpy(bank, data, lengths)  # [B, W]
+        sel = banked.pattern_bank == bid
+        for p in np.nonzero(sel)[0]:
+            lane = int(banked.pattern_lane[p])
+            out[:, p] = (words[:, lane // 32] >> (lane % 32) & 1).astype(bool)
+    return out
+
+
+FIXED_PATTERNS = [
+    "/api/v[0-9]+/users/.*",
+    "GET|POST",
+    "foo(bar)?baz",
+    "a{2,4}b",
+    "[a-c]+x",
+    "(ab|cd)*",
+    "x[^0-9]y",
+    "h?ello+",
+    "/public(/.*)?",
+    "\\d{1,3}\\.\\d{1,3}",
+    "",            # empty pattern matches only ""
+    ".*",
+]
+
+FIXED_INPUTS = [
+    "", "/api/v1/users/42", "/api/vx/users/", "GET", "POST", "GETX",
+    "foobaz", "foobarbaz", "foobarbarbaz", "aab", "aaaab", "ab", "b",
+    "abcx", "ax", "ccx", "abab", "abcd", "", "x1y", "xay", "hello",
+    "ellooo", "/public", "/public/x", "/publicx", "12.34", "1234",
+]
+
+
+def test_fixed_corpus_matches_oracle():
+    banked = compile_patterns(FIXED_PATTERNS, bank_size=4)
+    oracle = OracleMatcher(FIXED_PATTERNS)
+    got = _match_all_numpy(banked, FIXED_INPUTS)
+    want = oracle.match_matrix(FIXED_INPUTS)
+    np.testing.assert_array_equal(got, want)
+
+
+def _random_pattern(rng: random.Random, depth: int = 0) -> str:
+    """Generate a random pattern inside the supported subset."""
+    choices = ["lit", "class", "dot"]
+    if depth < 3:
+        choices += ["star", "plus", "opt", "alt", "concat", "group", "rep"]
+    kind = rng.choice(choices)
+    if kind == "lit":
+        return re.escape(rng.choice("abcxyz01/._-"))
+    if kind == "dot":
+        return "."
+    if kind == "class":
+        chars = "".join(rng.sample("abcdef012345", rng.randint(1, 4)))
+        neg = "^" if rng.random() < 0.3 else ""
+        return f"[{neg}{chars}]"
+    if kind == "star":
+        return _random_pattern(rng, depth + 1) + "*"
+    if kind == "plus":
+        return _random_pattern(rng, depth + 1) + "+"
+    if kind == "opt":
+        return _random_pattern(rng, depth + 1) + "?"
+    if kind == "rep":
+        lo = rng.randint(0, 3)
+        hi = lo + rng.randint(0, 3)
+        return f"(?:{_random_pattern(rng, depth + 1)}){{{lo},{hi}}}"
+    if kind == "alt":
+        return (f"(?:{_random_pattern(rng, depth + 1)}"
+                f"|{_random_pattern(rng, depth + 1)})")
+    if kind == "group":
+        return f"({_random_pattern(rng, depth + 1)})"
+    # concat
+    return (_random_pattern(rng, depth + 1)
+            + _random_pattern(rng, depth + 1))
+
+
+def _random_input(rng: random.Random) -> str:
+    n = rng.randint(0, 12)
+    return "".join(rng.choice("abcxyz01/._-ef2345") for _ in range(n))
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_differential(seed):
+    rng = random.Random(seed)
+    patterns = []
+    while len(patterns) < 24:
+        p = _random_pattern(rng)
+        try:
+            rp.parse(p)
+            re.compile(p)
+        except Exception:
+            continue
+        patterns.append(p)
+    inputs = [_random_input(rng) for _ in range(64)] + ["", "a", "/"]
+    banked = compile_patterns(patterns, bank_size=8)
+    oracle = OracleMatcher(patterns)
+    got = _match_all_numpy(banked, inputs)
+    want = oracle.match_matrix(inputs)
+    if not np.array_equal(got, want):
+        bad = np.argwhere(got != want)
+        i, j = bad[0]
+        raise AssertionError(
+            f"mismatch: pattern {patterns[j]!r} input {inputs[i]!r} "
+            f"dfa={got[i, j]} oracle={want[i, j]}"
+        )
+
+
+def test_case_insensitive():
+    pats = ["abc", "x[a-c]z"]
+    banked = compile_patterns(pats, case_insensitive=True)
+    oracle = OracleMatcher(pats, case_insensitive=True)
+    inputs = ["abc", "ABC", "aBc", "xbz", "XBZ", "xDz"]
+    np.testing.assert_array_equal(
+        _match_all_numpy(banked, inputs), oracle.match_matrix(inputs)
+    )
+
+
+def test_bank_overflow_splits():
+    # ".*c.{3}" needs a DFA tracking the last-4 window (≈2^4 states);
+    # the union across distinct letters multiplies — forces splitting
+    pats = [f".*{c}.{{3}}" for c in "abcdefgh"]
+    banked = compile_patterns(pats, bank_size=8, max_states=64)
+    assert banked.n_banks >= 2
+    oracle = OracleMatcher(pats)
+    inputs = ["a123", "xxaxxx", "abcd", "aaaa", "a", "", "hxyz", "zhxyz"]
+    np.testing.assert_array_equal(
+        _match_all_numpy(banked, inputs), oracle.match_matrix(inputs)
+    )
+
+
+def test_unsupported_rejected():
+    for bad in ["a(?=b)", "(a)\\1", "a\\bb"]:
+        with pytest.raises(rp.RegexError):
+            rp.parse(bad)

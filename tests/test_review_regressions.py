@@ -1,0 +1,182 @@
+"""Regression tests for review findings (round-1 code review):
+
+1. L7-wildcard-wins across two PortRules on the same port
+2. flows without an L7 record must not match L7 rules (engine)
+3. non-ASCII strings: UTF-8 byte-level matching, no crash
+4. merged entries with multiple L7 protocol families keep all families
+5. mid-pattern (?i) rejected (Python re would crash at verdict time)
+6. duplicate header instances: any-instance semantics both sides
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.core.flow import (
+    Flow,
+    HTTPInfo,
+    KafkaInfo,
+    L7Type,
+    Protocol,
+    TrafficDirection,
+    Verdict,
+)
+from cilium_tpu.core.identity import IdentityAllocator
+from cilium_tpu.core.labels import LabelSet
+from cilium_tpu.policy.api import (
+    EndpointSelector,
+    IngressRule,
+    L7Rules,
+    PortProtocol,
+    PortRule,
+    PortRuleDNS,
+    PortRuleHTTP,
+    PortRuleKafka,
+    Rule,
+)
+from cilium_tpu.policy.mapstate import PolicyResolver
+from cilium_tpu.policy.oracle import OracleVerdictEngine
+from cilium_tpu.policy.repository import Repository
+from cilium_tpu.policy.selectorcache import SelectorCache
+from cilium_tpu.engine.verdict import CompiledPolicy, VerdictEngine
+
+ING = TrafficDirection.INGRESS
+F, D, R = int(Verdict.FORWARDED), int(Verdict.DROPPED), int(Verdict.REDIRECTED)
+
+
+def _engines(rules, endpoints):
+    alloc = IdentityAllocator()
+    ids = {n: alloc.allocate(LabelSet.from_dict(l))
+           for n, l in endpoints.items()}
+    cache = SelectorCache(alloc)
+    repo = Repository()
+    repo.add(rules, sanitize=False)
+    resolver = PolicyResolver(repo, cache)
+    per_identity = {
+        ids[n]: resolver.resolve(alloc.lookup(ids[n])) for n in endpoints
+    }
+    return (OracleVerdictEngine(per_identity),
+            VerdictEngine(CompiledPolicy.build(per_identity)), ids)
+
+
+def _both(oracle, engine, flows):
+    want = oracle.verdict_flows(flows)["verdict"]
+    got = engine.verdict_flows(flows)["verdict"]
+    np.testing.assert_array_equal(got, want)
+    return list(want)
+
+
+def test_l7_wildcard_wins_across_port_rules():
+    # one IngressRule with two PortRules on port 80: plain allow +
+    # HTTP-restricted — the plain allow's wildcard must survive
+    rules = [Rule(
+        endpoint_selector=EndpointSelector.from_labels(app="srv"),
+        ingress=(IngressRule(to_ports=(
+            PortRule(ports=(PortProtocol(80, Protocol.TCP),)),
+            PortRule(ports=(PortProtocol(80, Protocol.TCP),),
+                     rules=L7Rules(http=(PortRuleHTTP(method="GET"),))),
+        )),),
+    )]
+    oracle, engine, ids = _engines(rules, {"srv": {"app": "srv"},
+                                           "cli": {"app": "cli"}})
+    flows = [Flow(src_identity=ids["cli"], dst_identity=ids["srv"],
+                  dport=80, protocol=Protocol.TCP, direction=ING,
+                  l7=L7Type.HTTP,
+                  http=HTTPInfo(method="POST", path="/x"))]
+    verdicts = _both(oracle, engine, flows)
+    assert verdicts == [F]  # wildcard wins → FORWARDED, not dropped
+
+
+def test_non_l7_flow_does_not_match_l7_rules():
+    rules = [Rule(
+        endpoint_selector=EndpointSelector.from_labels(app="kafka"),
+        ingress=(IngressRule(to_ports=(PortRule(
+            ports=(PortProtocol(9092, Protocol.TCP),),
+            rules=L7Rules(kafka=(PortRuleKafka(role="produce"),)),
+        ),)),),
+    )]
+    oracle, engine, ids = _engines(rules, {"kafka": {"app": "kafka"},
+                                           "cli": {"app": "cli"}})
+    plain_tcp = Flow(src_identity=ids["cli"], dst_identity=ids["kafka"],
+                     dport=9092, protocol=Protocol.TCP, direction=ING)
+    empty_http_rule_target = Flow(
+        src_identity=ids["cli"], dst_identity=ids["kafka"], dport=9092,
+        protocol=Protocol.TCP, direction=ING, l7=L7Type.KAFKA,
+        kafka=KafkaInfo(api_key=0, topic="t"))
+    verdicts = _both(oracle, engine, [plain_tcp, empty_http_rule_target])
+    assert verdicts == [D, R]
+
+
+def test_utf8_strings_no_crash_and_match():
+    rules = [Rule(
+        endpoint_selector=EndpointSelector.from_labels(app="srv"),
+        ingress=(IngressRule(to_ports=(PortRule(
+            ports=(PortProtocol(80, Protocol.TCP),),
+            rules=L7Rules(http=(PortRuleHTTP(path="/café/.*"),)),
+        ),)),),
+    )]
+    oracle, engine, ids = _engines(rules, {"srv": {"app": "srv"},
+                                           "cli": {"app": "cli"}})
+    def flow(path):
+        return Flow(src_identity=ids["cli"], dst_identity=ids["srv"],
+                    dport=80, protocol=Protocol.TCP, direction=ING,
+                    l7=L7Type.HTTP, http=HTTPInfo(method="GET", path=path))
+    verdicts = _both(oracle, engine,
+                     [flow("/café/中文"), flow("/cafe/x"), flow("/café/")])
+    assert verdicts[0] == R
+    assert verdicts[1] == D
+
+
+def test_mixed_protocol_families_merge():
+    # two rules, same port, one HTTP one DNS → merged entry keeps both
+    sel = EndpointSelector.from_labels(app="multi")
+    rules = [
+        Rule(endpoint_selector=sel,
+             ingress=(IngressRule(to_ports=(PortRule(
+                 ports=(PortProtocol(5353, Protocol.UDP),),
+                 rules=L7Rules(http=(PortRuleHTTP(path="/h"),)),
+             ),)),)),
+        Rule(endpoint_selector=sel,
+             ingress=(IngressRule(to_ports=(PortRule(
+                 ports=(PortProtocol(5353, Protocol.UDP),),
+                 rules=L7Rules(dns=(PortRuleDNS(match_name="ok.io"),)),
+             ),)),)),
+    ]
+    oracle, engine, ids = _engines(rules, {"multi": {"app": "multi"},
+                                           "cli": {"app": "cli"}})
+    from cilium_tpu.core.flow import DNSInfo
+
+    dns_flow = Flow(src_identity=ids["cli"], dst_identity=ids["multi"],
+                    dport=5353, protocol=Protocol.UDP, direction=ING,
+                    l7=L7Type.DNS, dns=DNSInfo(query="ok.io"))
+    verdicts = _both(oracle, engine, [dns_flow])
+    assert verdicts == [R]  # dns family must not be dropped from ruleset
+
+
+def test_mid_pattern_inline_flag_rejected():
+    from cilium_tpu.policy.compiler import regex_parser as rp
+
+    with pytest.raises(rp.RegexError):
+        rp.parse("abc(?i)def")
+    assert rp.parse("(?i)abc") is not None
+
+
+def test_duplicate_headers_any_instance():
+    rules = [Rule(
+        endpoint_selector=EndpointSelector.from_labels(app="srv"),
+        ingress=(IngressRule(to_ports=(PortRule(
+            ports=(PortProtocol(80, Protocol.TCP),),
+            rules=L7Rules(http=(PortRuleHTTP(headers=("X-A: 1",)),)),
+        ),)),),
+    )]
+    oracle, engine, ids = _engines(rules, {"srv": {"app": "srv"},
+                                           "cli": {"app": "cli"}})
+    def flow(headers):
+        return Flow(src_identity=ids["cli"], dst_identity=ids["srv"],
+                    dport=80, protocol=Protocol.TCP, direction=ING,
+                    l7=L7Type.HTTP,
+                    http=HTTPInfo(method="GET", path="/", headers=headers))
+    verdicts = _both(oracle, engine, [
+        flow((("X-A", "1"), ("X-A", "2"))),   # any instance matches → allow
+        flow((("X-A", "2"),)),                # no instance matches → drop
+    ])
+    assert verdicts == [R, D]
