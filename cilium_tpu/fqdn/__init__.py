@@ -1,0 +1,13 @@
+"""toFQDNs subsystem: DNS cache, NameManager, DNS proxy verdict path.
+
+Reference: ``pkg/fqdn`` (SURVEY.md §2.1, §3.5) — the glob→regex compile
+lives in ``cilium_tpu.policy.compiler.matchpattern``; this package holds
+the runtime: per-name TTL cache, observed-answer → identity plumbing,
+and the DNS-proxy ``CheckAllowed`` verdict hot path (BASELINE config[0]).
+"""
+
+from cilium_tpu.fqdn.cache import DNSCache
+from cilium_tpu.fqdn.namemanager import NameManager
+from cilium_tpu.fqdn.dnsproxy import DNSProxy
+
+__all__ = ["DNSCache", "NameManager", "DNSProxy"]

@@ -1,0 +1,72 @@
+"""ipcache: IP/CIDR → identity metadata store.
+
+Reference: ``pkg/ipcache`` (SURVEY.md §2.1) — the join point where
+FQDN-resolved IPs become matchable identities: IPs/prefixes map to
+(usually local-scoped CIDR) identities; the BPF-map mirror is replaced
+by notifying the SelectorCache so resolved policy stays incremental.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from cilium_tpu.core.identity import IdentityAllocator, NumericIdentity
+from cilium_tpu.core.labels import Label, LabelSet
+
+
+class IPCache:
+    def __init__(self, allocator: IdentityAllocator,
+                 selector_cache=None) -> None:
+        self._lock = threading.Lock()
+        self._allocator = allocator
+        self._selector_cache = selector_cache
+        # prefix → identity
+        self._by_prefix: Dict[ipaddress._BaseNetwork, NumericIdentity] = {}
+        self._listeners: List[Callable[[str, NumericIdentity, bool], None]] = []
+
+    def upsert(self, prefix: str,
+               identity: Optional[NumericIdentity] = None) -> NumericIdentity:
+        """Insert/refresh a prefix. Without an explicit identity a local
+        CIDR identity is allocated from the ``cidr:<prefix>`` label set
+        (reference: CIDR identities are node-local-scoped)."""
+        net = ipaddress.ip_network(prefix, strict=False)
+        with self._lock:
+            nid = self._by_prefix.get(net)
+            if nid is not None and (identity is None or identity == nid):
+                return nid  # unchanged
+            if identity is None:
+                labels = LabelSet([Label(key=str(net), source="cidr")])
+                identity = self._allocator.allocate(labels)
+                if self._selector_cache is not None:
+                    self._selector_cache.add_identity(identity, labels)
+            self._by_prefix[net] = identity  # insert or remap
+        for fn in self._listeners:
+            fn(str(net), identity, True)
+        return identity
+
+    def delete(self, prefix: str) -> None:
+        net = ipaddress.ip_network(prefix, strict=False)
+        with self._lock:
+            nid = self._by_prefix.pop(net, None)
+        if nid is not None:
+            for fn in self._listeners:
+                fn(str(net), nid, False)
+
+    def lookup(self, ip: str) -> Optional[NumericIdentity]:
+        """Longest-prefix match (the BPF ipcache is an LPM trie)."""
+        addr = ipaddress.ip_address(ip)
+        best: Tuple[int, Optional[NumericIdentity]] = (-1, None)
+        with self._lock:
+            for net, nid in self._by_prefix.items():
+                if addr in net and net.prefixlen > best[0]:
+                    best = (net.prefixlen, nid)
+        return best[1]
+
+    def subscribe(self, fn: Callable[[str, NumericIdentity, bool], None]):
+        self._listeners.append(fn)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._by_prefix)

@@ -1,0 +1,36 @@
+"""proxylib-style L7 parser plugin framework.
+
+Reference: ``proxylib/`` (SURVEY.md §2.2) — the Go shared library Envoy
+loads via a cgo ABI: ``OnNewConnection(proto, connection_id, ingress,
+src_id, dst_id, ...) → Connection`` and ``OnData(reply, end_stream,
+data) → (verdict, bytes)`` with verdicts PASS/DROP/MORE/INJECT/ERROR;
+parsers are registered by name and selected by the policy's ``l7proto``
+field. **This is the plugin interface the north star gates the TPU
+engine behind**: the TPU path registers as a parser backend; the C++
+shim (``shim/``) speaks the same connection/data protocol over a Unix
+socket to the verdict service.
+"""
+
+from cilium_tpu.proxylib.parser import (
+    OpType,
+    Verdict as ParserVerdict,
+    Connection,
+    Parser,
+    register_parser,
+    create_parser,
+    registered_parsers,
+)
+from cilium_tpu.proxylib.kafka import KafkaParser
+from cilium_tpu.proxylib.http import HTTPParser
+
+__all__ = [
+    "OpType",
+    "ParserVerdict",
+    "Connection",
+    "Parser",
+    "register_parser",
+    "create_parser",
+    "registered_parsers",
+    "KafkaParser",
+    "HTTPParser",
+]

@@ -1,0 +1,204 @@
+"""Kafka wire-protocol parser.
+
+Reference: ``proxylib/kafka`` + the Kafka v0-era wire format (public
+protocol spec): a request frame is
+
+    int32 size | int16 api_key | int16 api_version | int32 correlation
+    | string client_id | <api-specific body>
+
+Topic extraction implemented for the record-carrying APIs the rules
+target (BASELINE config[2] "topic/API-key ACL rules × produce/fetch
+records"): produce (acks,timeout then topic array), fetch (replica,
+max_wait,min_bytes then topic array), metadata (topic array). Other
+APIs yield a single record with an empty topic (matched on api_key
+alone). Requests are verdicted per frame: every parsed record must be
+allowed, else the frame is dropped (the reference additionally injects
+a Kafka error response; we drop). Responses pass through.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Tuple
+
+from cilium_tpu.core.flow import KafkaInfo
+from cilium_tpu.proxylib.parser import Connection, Op, OpType, Parser, register_parser
+
+API_PRODUCE = 0
+API_FETCH = 1
+API_METADATA = 3
+
+
+def _read_string(buf: bytes, off: int) -> Tuple[Optional[str], int]:
+    if off + 2 > len(buf):
+        return None, off
+    (n,) = struct.unpack_from(">h", buf, off)
+    off += 2
+    if n < 0:
+        return "", off
+    if off + n > len(buf):
+        return None, off
+    return buf[off:off + n].decode("utf-8", "replace"), off + n
+
+
+def parse_request_records(frame: bytes) -> List[KafkaInfo]:
+    """Parse one complete request frame (without the 4-byte size prefix)
+    into policy-checkable records."""
+    if len(frame) < 8:
+        return []
+    api_key, api_version, correlation = struct.unpack_from(">hhi", frame, 0)
+    client_id, off = _read_string(frame, 8)
+    if client_id is None:
+        client_id, off = "", 8
+    base = dict(api_key=api_key, api_version=api_version,
+                client_id=client_id, correlation_id=correlation)
+
+    topics: Optional[List[str]] = []
+    try:
+        if api_key == API_PRODUCE:
+            off += 6  # acks int16 + timeout int32
+            topics = _read_topic_array(frame, off, _skip_produce_partitions)
+        elif api_key == API_FETCH:
+            off += 12  # replica int32 + max_wait int32 + min_bytes int32
+            topics = _read_topic_array(frame, off, _skip_fetch_partitions)
+        elif api_key == API_METADATA:
+            topics = _read_topic_array(frame, off, None)
+    except Exception:
+        topics = None
+    if topics is None:
+        # unparseable topic data: return an unmatchable record so
+        # topic-constrained rules DENY (conservative; never bypass)
+        return [KafkaInfo(topic="\x00unparseable", **base)]
+    if not topics:
+        return [KafkaInfo(topic="", **base)]
+    return [KafkaInfo(topic=t, **base) for t in topics]
+
+
+def _skip_produce_partitions(frame: bytes, off: int) -> Optional[int]:
+    """produce v0 per-topic payload: array<partition int32,
+    message_set_size int32, bytes[message_set_size]>."""
+    if off + 4 > len(frame):
+        return None
+    (n,) = struct.unpack_from(">i", frame, off)
+    off += 4
+    for _ in range(max(0, min(n, 4096))):
+        if off + 8 > len(frame):
+            return None
+        (_, size) = struct.unpack_from(">ii", frame, off)
+        if size < 0 or off + 8 + size > len(frame):
+            return None
+        off += 8 + size
+    return off
+
+
+def _skip_fetch_partitions(frame: bytes, off: int) -> Optional[int]:
+    """fetch v0 per-topic payload: array<partition int32, offset int64,
+    max_bytes int32> (16 bytes each)."""
+    if off + 4 > len(frame):
+        return None
+    (n,) = struct.unpack_from(">i", frame, off)
+    off += 4
+    need = 16 * max(0, n)
+    if n < 0 or off + need > len(frame):
+        return None
+    return off + need
+
+
+def _read_topic_array(frame: bytes, off: int,
+                      skip_payload) -> Optional[List[str]]:
+    """Parse EVERY topic in the array (each one is policy-checked; a
+    multi-topic frame is only passed if all topics are allowed).
+    Returns None if the layout cannot be fully walked."""
+    if off + 4 > len(frame):
+        return None
+    (n,) = struct.unpack_from(">i", frame, off)
+    off += 4
+    if n < 0 or n > 1024:
+        return None
+    out: List[str] = []
+    for _ in range(n):
+        t, off = _read_string(frame, off)
+        if t is None:
+            return None
+        out.append(t)
+        if skip_payload is not None:
+            nxt = skip_payload(frame, off)
+            if nxt is None:
+                return None
+            off = nxt
+    return out
+
+
+def encode_request(api_key: int, api_version: int, correlation: int,
+                   client_id: str, topic: str = "") -> bytes:
+    """Synthetic encoder (test/replay harness; the reference's unit
+    tests build frames the same way)."""
+    body = struct.pack(">hhi", api_key, api_version, correlation)
+    cid = client_id.encode()
+    body += struct.pack(">h", len(cid)) + cid
+    topics = ([topic] if isinstance(topic, str) and topic
+              else list(topic) if not isinstance(topic, str) else [])
+    if api_key == API_PRODUCE:
+        body += struct.pack(">hi", 1, 1000)
+        body += _topic_array(topics, _produce_payload)
+    elif api_key == API_FETCH:
+        body += struct.pack(">iii", -1, 100, 1)
+        body += _topic_array(topics, _fetch_payload)
+    elif api_key == API_METADATA:
+        body += _topic_array(topics, None)
+    return struct.pack(">i", len(body)) + body
+
+
+def _produce_payload() -> bytes:
+    msgset = b"\x00" * 12
+    return struct.pack(">i", 1) + struct.pack(">ii", 0, len(msgset)) + msgset
+
+
+def _fetch_payload() -> bytes:
+    return struct.pack(">i", 1) + struct.pack(">iqi", 0, 0, 1 << 20)
+
+
+def _topic_array(topics, payload_fn) -> bytes:
+    out = struct.pack(">i", len(topics))
+    for t in topics:
+        tb = t.encode()
+        out += struct.pack(">h", len(tb)) + tb
+        if payload_fn is not None:
+            out += payload_fn()
+    return out
+
+
+class KafkaParser(Parser):
+    def __init__(self, connection: Connection, policy_check):
+        super().__init__(connection, policy_check)
+        self._buf = b""
+
+    def on_data(self, reply: bool, end_stream: bool,
+                data: bytes) -> List[Op]:
+        if reply:
+            return [(OpType.PASS, len(data))] if data else []
+        self._buf += data
+        ops: List[Op] = []
+        while True:
+            if len(self._buf) < 4:
+                ops.append((OpType.MORE, 4 - len(self._buf)))
+                break
+            (size,) = struct.unpack_from(">i", self._buf, 0)
+            if size < 0 or size > 1 << 24:
+                ops.append((OpType.ERROR, 0))
+                break
+            frame_len = 4 + size
+            if len(self._buf) < frame_len:
+                ops.append((OpType.MORE, frame_len - len(self._buf)))
+                break
+            frame = self._buf[4:frame_len]
+            records = parse_request_records(frame)
+            allowed = all(self.policy_check(r) for r in records)
+            ops.append((OpType.PASS if allowed else OpType.DROP, frame_len))
+            self._buf = self._buf[frame_len:]
+            if not self._buf:
+                break
+        return ops
+
+
+register_parser("kafka", KafkaParser)

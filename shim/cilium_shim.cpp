@@ -1,0 +1,200 @@
+// cilium_shim: native proxylib-ABI adapter for the TPU verdict service.
+//
+// Plays the role of the reference's proxylib cgo bridge (SURVEY.md
+// §2.2/§2.3): a C ABI a proxy (Envoy's cilium.network filter, or any
+// host program) can load as a shared library. Connection metadata and
+// payload chunks are forwarded to the verdict service over its Unix
+// socket (4-byte big-endian length + JSON), and the parser ops
+// (MORE/PASS/DROP/INJECT/ERROR, mirroring proxylib verdicts) come back.
+//
+// Build: make -C shim   → libcilium_shim.so
+// The Python test harness drives it via ctypes against a live service.
+
+#include <arpa/inet.h>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+std::mutex g_mu;
+int g_fd = -1;
+
+bool send_all(int fd, const void* buf, size_t len) {
+  const char* p = static_cast<const char*>(buf);
+  while (len > 0) {
+    ssize_t n = ::send(fd, p, len, 0);
+    if (n <= 0) return false;
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t len) {
+  char* p = static_cast<char*>(buf);
+  while (len > 0) {
+    ssize_t n = ::recv(fd, p, len, 0);
+    if (n <= 0) return false;
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+// request/response framing: 4-byte big-endian length + JSON
+bool rpc(const std::string& req, std::string* resp) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (g_fd < 0) return false;
+  uint32_t n = htonl(static_cast<uint32_t>(req.size()));
+  if (!send_all(g_fd, &n, 4) || !send_all(g_fd, req.data(), req.size()))
+    return false;
+  uint32_t rn = 0;
+  if (!recv_all(g_fd, &rn, 4)) return false;
+  rn = ntohl(rn);
+  if (rn > (1u << 26)) return false;
+  resp->resize(rn);
+  return recv_all(g_fd, resp->data(), rn);
+}
+
+std::string b64encode(const uint8_t* data, size_t len) {
+  static const char tbl[] =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  std::string out;
+  out.reserve((len + 2) / 3 * 4);
+  for (size_t i = 0; i < len; i += 3) {
+    uint32_t v = static_cast<uint32_t>(data[i]) << 16;
+    if (i + 1 < len) v |= static_cast<uint32_t>(data[i + 1]) << 8;
+    if (i + 2 < len) v |= data[i + 2];
+    out.push_back(tbl[(v >> 18) & 63]);
+    out.push_back(tbl[(v >> 12) & 63]);
+    out.push_back(i + 1 < len ? tbl[(v >> 6) & 63] : '=');
+    out.push_back(i + 2 < len ? tbl[v & 63] : '=');
+  }
+  return out;
+}
+
+std::string json_escape(const char* s) {
+  std::string out;
+  for (; s && *s; ++s) {
+    if (*s == '"' || *s == '\\') {
+      out.push_back('\\');
+      out.push_back(*s);
+    } else if (static_cast<unsigned char>(*s) >= 0x20) {
+      out.push_back(*s);
+    }
+  }
+  return out;
+}
+
+// Minimal parser for the one response shape we consume:
+//   {"ops": [[op, n], ...]}  /  {"ok": true}  /  {"error": "..."}
+// Returns number of (op,n) pairs written, or -1 on error/absent.
+int parse_ops(const std::string& resp, int32_t* ops_out, int max_pairs) {
+  if (resp.find("\"error\"") != std::string::npos) return -1;
+  size_t p = resp.find("\"ops\"");
+  if (p == std::string::npos) return -1;
+  p = resp.find('[', p);
+  if (p == std::string::npos) return -1;
+  int pairs = 0;
+  ++p;
+  while (pairs < max_pairs) {
+    p = resp.find('[', p);
+    if (p == std::string::npos) break;
+    long op = 0, n = 0;
+    if (sscanf(resp.c_str() + p, "[%ld,%ld]", &op, &n) != 2 &&
+        sscanf(resp.c_str() + p, "[%ld, %ld]", &op, &n) != 2)
+      break;
+    ops_out[2 * pairs] = static_cast<int32_t>(op);
+    ops_out[2 * pairs + 1] = static_cast<int32_t>(n);
+    ++pairs;
+    p = resp.find(']', p);
+    if (p == std::string::npos) break;
+    ++p;
+  }
+  return pairs;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Connect to the verdict service. Returns 0 on success.
+int cshim_connect(const char* socket_path) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (g_fd >= 0) {
+    ::close(g_fd);
+    g_fd = -1;
+  }
+  int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  std::snprintf(addr.sun_path, sizeof(addr.sun_path), "%s", socket_path);
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return -2;
+  }
+  g_fd = fd;
+  return 0;
+}
+
+void cshim_disconnect() {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (g_fd >= 0) ::close(g_fd);
+  g_fd = -1;
+}
+
+// Mirrors proxylib OnNewConnection. Returns 0 on success.
+int cshim_on_new_connection(const char* proto, uint64_t conn_id,
+                            int ingress, uint32_t src_identity,
+                            uint32_t dst_identity, uint32_t dport,
+                            const char* policy_name) {
+  char buf[512];
+  std::snprintf(buf, sizeof(buf),
+                "{\"op\":\"on_new_connection\",\"proto\":\"%s\","
+                "\"conn\":%llu,\"ingress\":%s,\"src\":%u,\"dst\":%u,"
+                "\"dport\":%u,\"policy_name\":\"%s\"}",
+                json_escape(proto).c_str(),
+                static_cast<unsigned long long>(conn_id),
+                ingress ? "true" : "false", src_identity, dst_identity,
+                dport, json_escape(policy_name).c_str());
+  std::string resp;
+  if (!rpc(buf, &resp)) return -1;
+  return resp.find("\"ok\"") != std::string::npos ? 0 : -2;
+}
+
+// Mirrors proxylib OnData: ops_out receives up to max_pairs (op,n)
+// int32 pairs; returns the pair count, or <0 on error.
+int cshim_on_data(uint64_t conn_id, int reply, int end_stream,
+                  const uint8_t* data, size_t len, int32_t* ops_out,
+                  int max_pairs) {
+  std::string req = "{\"op\":\"on_data\",\"conn\":";
+  req += std::to_string(conn_id);
+  req += ",\"reply\":";
+  req += reply ? "true" : "false";
+  req += ",\"end\":";
+  req += end_stream ? "true" : "false";
+  req += ",\"data_b64\":\"";
+  req += b64encode(data, len);
+  req += "\"}";
+  std::string resp;
+  if (!rpc(req, &resp)) return -1;
+  return parse_ops(resp, ops_out, max_pairs);
+}
+
+int cshim_close_connection(uint64_t conn_id) {
+  std::string req = "{\"op\":\"close_connection\",\"conn\":";
+  req += std::to_string(conn_id);
+  req += "}";
+  std::string resp;
+  return rpc(req, &resp) ? 0 : -1;
+}
+
+}  // extern "C"

@@ -1,0 +1,48 @@
+"""Both DFA-step implementations (gather vs one-hot matmul) must agree."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from cilium_tpu.policy.compiler.dfa import compile_patterns
+from cilium_tpu.policy.compiler.oracle import OracleMatcher
+from cilium_tpu.engine.dfa_kernel import dfa_scan_banked
+
+PATTERNS = [
+    "/api/v[0-9]+/users/.*", "GET|POST", "foo(bar)?baz", "a{2,4}b",
+    "[a-c]+x", "(ab|cd)*", "x[^0-9]y", "h?ello+",
+]
+INPUTS = ["", "/api/v1/users/42", "GET", "foobarbaz", "aab", "abab",
+          "xay", "hello", "zzz", "a" * 40]
+
+
+def _encode(strings):
+    L = 64
+    data = np.zeros((len(strings), L), dtype=np.uint8)
+    lengths = np.zeros(len(strings), dtype=np.int32)
+    for i, s in enumerate(strings):
+        bs = s.encode()[:L]
+        data[i, : len(bs)] = np.frombuffer(bs, dtype=np.uint8)
+        lengths[i] = len(bs)
+    return jnp.asarray(data), jnp.asarray(lengths)
+
+
+def test_onehot_equals_gather_and_oracle():
+    banked = compile_patterns(PATTERNS, bank_size=4)
+    st = banked.stacked()
+    data, lengths = _encode(INPUTS)
+    args = (jnp.asarray(st["trans"]), jnp.asarray(st["byteclass"]),
+            jnp.asarray(st["start"]), jnp.asarray(st["accept"]),
+            data, lengths)
+    words_g = np.asarray(dfa_scan_banked(*args, impl="gather"))
+    words_o = np.asarray(dfa_scan_banked(*args, impl="onehot"))
+    np.testing.assert_array_equal(words_g, words_o)
+
+    # and both agree with the oracle through the lane map
+    oracle = OracleMatcher(PATTERNS).match_matrix(INPUTS)
+    flat = words_o.reshape(len(INPUTS), -1)
+    W = st["accept"].shape[2]
+    for p in range(len(PATTERNS)):
+        lane = int(st["lane_of"][p])
+        got = (flat[:, lane // 32] >> (lane % 32)) & 1
+        np.testing.assert_array_equal(got.astype(bool), oracle[:, p],
+                                      err_msg=f"pattern {PATTERNS[p]!r}")

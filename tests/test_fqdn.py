@@ -1,0 +1,89 @@
+"""FQDN subsystem: DNS cache TTL, NameManager plumbing, DNS proxy."""
+
+import numpy as np
+
+from cilium_tpu.core.identity import IdentityAllocator
+from cilium_tpu.fqdn import DNSCache, DNSProxy, NameManager
+from cilium_tpu.ipcache import IPCache
+from cilium_tpu.policy.api.l7 import PortRuleDNS
+from cilium_tpu.policy.api.selector import FQDNSelector
+from cilium_tpu.policy.selectorcache import SelectorCache
+
+
+def test_dns_cache_ttl_and_restore():
+    c = DNSCache(min_ttl=10)
+    c.update(100.0, "www.example.com", ["1.2.3.4"], ttl=30)
+    c.update(100.0, "www.example.com", ["1.2.3.5"], ttl=5)  # clamped to 10
+    assert c.lookup("www.example.com", now=105.0) == ["1.2.3.4", "1.2.3.5"]
+    assert c.lookup("WWW.example.com.", now=115.0) == ["1.2.3.4"]
+    affected = c.expire(now=131.0)
+    assert "www.example.com." in affected
+    assert c.lookup("www.example.com", now=131.0) == []
+    # persist/restore
+    c2 = DNSCache.from_json(c.to_json())
+    assert c2.names() == c.names()
+
+
+def test_name_manager_feeds_selector_cache():
+    alloc = IdentityAllocator()
+    cache = SelectorCache(alloc)
+    ipc = IPCache(alloc, cache)
+    nm = NameManager(cache, ipc)
+    sel = FQDNSelector(match_pattern="*.cilium.io")
+    nm.register_selector(sel)
+
+    updated = []
+    nm.on_update = lambda sels: updated.append(sels)
+
+    assert nm.update_generate_dns(1000.0, "www.cilium.io",
+                                  ["10.0.0.1", "10.0.0.2"], ttl=300)
+    ids = cache.get_selections(sel)
+    assert len(ids) == 2
+    assert all(i >= (1 << 24) for i in ids)  # local CIDR scope
+    assert ipc.lookup("10.0.0.1") in ids
+    assert updated  # regeneration hook fired
+
+    # non-matching name → no change
+    assert not nm.update_generate_dns(1000.0, "evil.com", ["6.6.6.6"],
+                                      ttl=300)
+    # deep subdomain must not match (label-local '*')
+    assert not nm.update_generate_dns(1000.0, "a.b.cilium.io", ["7.7.7.7"],
+                                      ttl=300)
+
+
+def test_name_manager_gc_removes_selections():
+    alloc = IdentityAllocator()
+    cache = SelectorCache(alloc)
+    ipc = IPCache(alloc, cache)
+    nm = NameManager(cache, ipc, DNSCache(min_ttl=1))
+    sel = FQDNSelector(match_name="api.example.com")
+    nm.register_selector(sel)
+    nm.update_generate_dns(100.0, "api.example.com", ["9.9.9.9"], ttl=10)
+    assert len(cache.get_selections(sel)) == 1
+    nm.gc(now=200.0)
+    assert len(cache.get_selections(sel)) == 0
+
+
+def test_dns_proxy_check_allowed_and_batch():
+    proxy = DNSProxy()
+    rules = [PortRuleDNS(match_pattern="*.cilium.io"),
+             PortRuleDNS(match_name="example.com")]
+    proxy.update_allowed(42, 53, rules)
+
+    assert proxy.check_allowed(42, 53, "www.cilium.io")
+    assert proxy.check_allowed(42, 53, "EXAMPLE.COM.")
+    assert not proxy.check_allowed(42, 53, "evil.com")
+    assert not proxy.check_allowed(42, 53, "a.b.cilium.io")
+    assert not proxy.check_allowed(7, 53, "www.cilium.io")  # other endpoint
+
+    qnames = ["www.cilium.io", "evil.com", "example.com", "x.example.com"]
+    want = np.array([True, False, True, False])
+    np.testing.assert_array_equal(proxy.check_batch(42, 53, qnames), want)
+    proxy_tpu = DNSProxy(use_tpu=True)
+    proxy_tpu.update_allowed(42, 53, rules)
+    np.testing.assert_array_equal(proxy_tpu.check_batch(42, 53, qnames),
+                                  want)
+
+    # removing rules → deny
+    proxy.update_allowed(42, 53, [])
+    assert not proxy.check_allowed(42, 53, "www.cilium.io")

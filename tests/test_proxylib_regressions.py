@@ -1,0 +1,145 @@
+"""Regressions for round-1 review of the proxylib/service layer:
+
+1. multi-topic Kafka frames: EVERY topic is policy-checked
+2. negative Content-Length cannot stall the HTTP frame loop
+3. service answers structured errors for well-framed bad requests
+4. ipcache upsert remaps an existing prefix and notifies
+5. unparseable kafka topic data is conservative (deny w/ topic rules)
+"""
+
+import numpy as np
+
+from cilium_tpu.core.flow import Protocol
+from cilium_tpu.core.identity import IdentityAllocator
+from cilium_tpu.core.labels import LabelSet
+from cilium_tpu.ipcache import IPCache
+from cilium_tpu.policy.api import (
+    EndpointSelector,
+    IngressRule,
+    L7Rules,
+    PortProtocol,
+    PortRule,
+    PortRuleKafka,
+    Rule,
+)
+from cilium_tpu.policy.mapstate import PolicyResolver
+from cilium_tpu.policy.repository import Repository
+from cilium_tpu.policy.selectorcache import SelectorCache
+from cilium_tpu.proxylib import Connection, OpType, create_parser
+from cilium_tpu.proxylib.kafka import encode_request, parse_request_records
+from cilium_tpu.runtime.loader import Loader
+from cilium_tpu.core.config import Config
+from cilium_tpu.runtime.service import PolicyBridge, VerdictService
+
+
+def _kafka_setup():
+    rules = [Rule(
+        endpoint_selector=EndpointSelector.from_labels(app="kafka"),
+        ingress=(IngressRule(to_ports=(PortRule(
+            ports=(PortProtocol(9092, Protocol.TCP),),
+            rules=L7Rules(kafka=(
+                PortRuleKafka(role="produce", topic="ok-topic"),)),
+        ),)),),
+    )]
+    alloc = IdentityAllocator()
+    ids = {n: alloc.allocate(LabelSet.from_dict({"app": n}))
+           for n in ("kafka", "cli")}
+    cache = SelectorCache(alloc)
+    repo = Repository()
+    repo.add(rules, sanitize=False)
+    resolver = PolicyResolver(repo, cache)
+    per_identity = {nid: resolver.resolve(alloc.lookup(nid))
+                    for nid in ids.values()}
+    loader = Loader(Config())
+    loader.regenerate(per_identity, revision=1)
+    return loader, ids
+
+
+def test_multi_topic_produce_checks_all_topics():
+    loader, ids = _kafka_setup()
+    bridge = PolicyBridge(loader, deadline_ms=1.0)
+    conn = Connection(proto="kafka", connection_id=1, ingress=True,
+                      src_identity=ids["cli"], dst_identity=ids["kafka"],
+                      dport=9092)
+    parser = create_parser("kafka", conn, bridge.policy_check(conn))
+
+    both_ok = encode_request(0, 1, 1, "c", ["ok-topic", "ok-topic"])
+    mixed = encode_request(0, 1, 2, "c", ["ok-topic", "evil-topic"])
+    recs = parse_request_records(mixed[4:])
+    assert [r.topic for r in recs] == ["ok-topic", "evil-topic"]
+
+    ops = parser.on_data(False, False, both_ok + mixed)
+    assert ops[0] == (OpType.PASS, len(both_ok))
+    assert ops[1] == (OpType.DROP, len(mixed))  # one bad topic → drop
+
+
+def test_multi_topic_fetch_and_metadata():
+    for api_key in (1, 3):
+        frame = encode_request(api_key, 0, 5, "c",
+                               ["t1", "t2", "t3"])
+        recs = parse_request_records(frame[4:])
+        assert [r.topic for r in recs] == ["t1", "t2", "t3"], api_key
+
+
+def test_unparseable_topics_deny_with_topic_rules():
+    loader, ids = _kafka_setup()
+    bridge = PolicyBridge(loader, deadline_ms=1.0)
+    conn = Connection(proto="kafka", connection_id=2, ingress=True,
+                      src_identity=ids["cli"], dst_identity=ids["kafka"],
+                      dport=9092)
+    parser = create_parser("kafka", conn, bridge.policy_check(conn))
+    import struct
+
+    # produce frame with truncated/garbage topic payload
+    body = struct.pack(">hhi", 0, 0, 9) + struct.pack(">h", 1) + b"c"
+    body += struct.pack(">hi", 1, 1000) + b"\xff\xff\xff\xff"
+    frame = struct.pack(">i", len(body)) + body
+    ops = parser.on_data(False, False, frame)
+    assert ops[0] == (OpType.DROP, len(frame))
+
+
+def test_negative_content_length_no_stall():
+    loader, ids = _kafka_setup()
+    bridge = PolicyBridge(loader, deadline_ms=1.0)
+    conn = Connection(proto="http", connection_id=3, ingress=True,
+                      src_identity=ids["cli"], dst_identity=ids["kafka"],
+                      dport=80)
+    parser = create_parser("http", conn, bridge.policy_check(conn))
+    req = b"GET / HTTP/1.1\r\ncontent-length: -9999\r\n\r\n"
+    ops = parser.on_data(False, False, req)
+    # terminates, one verdict op for the whole frame (no body)
+    assert len(ops) <= 2 and ops[0][1] == len(req)
+
+
+def test_service_structured_errors():
+    loader, _ = _kafka_setup()
+    import os
+    import tempfile
+    from cilium_tpu.runtime.service import VerdictClient
+
+    sock = os.path.join(tempfile.mkdtemp(), "s.sock")
+    svc = VerdictService(loader, sock)
+    svc.start()
+    try:
+        c = VerdictClient(sock)
+        assert "error" in c.call({"op": "on_data"})          # missing conn
+        assert "error" in c.call({"op": "on_new_connection"})  # missing proto
+        assert "error" in c.call({"op": "nope"})
+        assert c.call({"op": "ping"})["ok"]                   # still alive
+        c.close()
+    finally:
+        svc.stop()
+
+
+def test_ipcache_upsert_remaps_and_notifies():
+    alloc = IdentityAllocator()
+    ipc = IPCache(alloc)
+    events = []
+    ipc.subscribe(lambda p, nid, up: events.append((p, nid, up)))
+    a = ipc.upsert("10.1.0.0/24", identity=1111)
+    assert a == 1111 and events[-1] == ("10.1.0.0/24", 1111, True)
+    b = ipc.upsert("10.1.0.0/24", identity=2222)  # remap
+    assert b == 2222 and ipc.lookup("10.1.0.5") == 2222
+    assert events[-1] == ("10.1.0.0/24", 2222, True)
+    c = ipc.upsert("10.1.0.0/24")  # refresh keeps current
+    assert c == 2222 and len(events) == 2

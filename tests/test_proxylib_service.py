@@ -1,0 +1,175 @@
+"""proxylib parsers + verdict service + C++ shim end-to-end.
+
+Mirrors the reference's proxylib unit tests: synthetic Kafka/HTTP wire
+frames through the parser ABI, policy enforced by the (oracle) engine
+behind the service; the C++ shim drives the same flow over the Unix
+socket.
+"""
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import pytest
+
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.identity import IdentityAllocator
+from cilium_tpu.core.labels import LabelSet
+from cilium_tpu.core.flow import Protocol, TrafficDirection
+from cilium_tpu.policy.api import (
+    EndpointSelector,
+    IngressRule,
+    L7Rules,
+    PortProtocol,
+    PortRule,
+    PortRuleHTTP,
+    PortRuleKafka,
+    Rule,
+)
+from cilium_tpu.policy.mapstate import PolicyResolver
+from cilium_tpu.policy.repository import Repository
+from cilium_tpu.policy.selectorcache import SelectorCache
+from cilium_tpu.proxylib import Connection, OpType, create_parser
+from cilium_tpu.proxylib.kafka import encode_request
+from cilium_tpu.runtime.loader import Loader
+from cilium_tpu.runtime.service import PolicyBridge, VerdictClient, VerdictService
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _loader():
+    rules = [
+        Rule(
+            endpoint_selector=EndpointSelector.from_labels(app="kafka"),
+            ingress=(IngressRule(to_ports=(PortRule(
+                ports=(PortProtocol(9092, Protocol.TCP),),
+                rules=L7Rules(kafka=(
+                    PortRuleKafka(role="produce", topic="allowed-topic"),)),
+            ),)),),
+        ),
+        Rule(
+            endpoint_selector=EndpointSelector.from_labels(app="web"),
+            ingress=(IngressRule(to_ports=(PortRule(
+                ports=(PortProtocol(80, Protocol.TCP),),
+                rules=L7Rules(http=(
+                    PortRuleHTTP(method="GET", path="/ok/.*"),)),
+            ),)),),
+        ),
+    ]
+    alloc = IdentityAllocator()
+    ids = {
+        "kafka": alloc.allocate(LabelSet.from_dict({"app": "kafka"})),
+        "web": alloc.allocate(LabelSet.from_dict({"app": "web"})),
+        "cli": alloc.allocate(LabelSet.from_dict({"app": "cli"})),
+    }
+    cache = SelectorCache(alloc)
+    repo = Repository()
+    repo.add(rules, sanitize=False)
+    resolver = PolicyResolver(repo, cache)
+    per_identity = {
+        nid: resolver.resolve(alloc.lookup(nid)) for nid in ids.values()
+    }
+    loader = Loader(Config())  # gate off → oracle backend
+    loader.regenerate(per_identity, revision=1)
+    return loader, ids
+
+
+def test_kafka_parser_frames():
+    loader, ids = _loader()
+    bridge = PolicyBridge(loader, deadline_ms=1.0)
+    conn = Connection(proto="kafka", connection_id=1, ingress=True,
+                      src_identity=ids["cli"], dst_identity=ids["kafka"],
+                      dport=9092)
+    parser = create_parser("kafka", conn, bridge.policy_check(conn))
+
+    good = encode_request(0, 1, 7, "cli-1", "allowed-topic")
+    bad = encode_request(0, 1, 8, "cli-1", "secret-topic")
+    fetch = encode_request(1, 2, 9, "cli-1", "allowed-topic")
+
+    ops = parser.on_data(False, False, good + bad)
+    assert ops[0] == (OpType.PASS, len(good))
+    assert ops[1] == (OpType.DROP, len(bad))
+    # consume (role=produce does not allow fetch)
+    ops = parser.on_data(False, False, fetch)
+    assert ops[0] == (OpType.DROP, len(fetch))
+
+    # streaming: partial frame → MORE, then completion
+    ops = parser.on_data(False, False, good[:5])
+    assert ops[0][0] == OpType.MORE
+    ops = parser.on_data(False, False, good[5:])
+    assert ops[0] == (OpType.PASS, len(good))
+
+
+def test_http_parser_frames():
+    loader, ids = _loader()
+    bridge = PolicyBridge(loader, deadline_ms=1.0)
+    conn = Connection(proto="http", connection_id=2, ingress=True,
+                      src_identity=ids["cli"], dst_identity=ids["web"],
+                      dport=80)
+    parser = create_parser("http", conn, bridge.policy_check(conn))
+
+    good = b"GET /ok/x HTTP/1.1\r\nhost: web\r\n\r\n"
+    bad = b"POST /ok/x HTTP/1.1\r\nhost: web\r\ncontent-length: 2\r\n\r\nhi"
+    ops = parser.on_data(False, False, good)
+    assert ops[0] == (OpType.PASS, len(good))
+    ops = parser.on_data(False, False, bad)
+    assert ops[0] == (OpType.DROP, len(bad))
+    assert ops[1][0] == OpType.INJECT
+
+
+@pytest.fixture(scope="module")
+def shim_lib():
+    path = os.path.join(REPO, "shim", "libcilium_shim.so")
+    if not os.path.exists(path):
+        subprocess.run(["make", "-C", os.path.join(REPO, "shim")],
+                       check=True, capture_output=True)
+    lib = ctypes.CDLL(path)
+    lib.cshim_connect.argtypes = [ctypes.c_char_p]
+    lib.cshim_on_new_connection.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_uint32,
+        ctypes.c_uint32, ctypes.c_uint32, ctypes.c_char_p]
+    lib.cshim_on_data.argtypes = [
+        ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+    return lib
+
+
+def test_cpp_shim_end_to_end(shim_lib):
+    loader, ids = _loader()
+    sock = os.path.join(tempfile.mkdtemp(), "verdict.sock")
+    service = VerdictService(loader, sock, deadline_ms=1.0)
+    service.start()
+    try:
+        assert shim_lib.cshim_connect(sock.encode()) == 0
+        assert shim_lib.cshim_on_new_connection(
+            b"kafka", 77, 1, ids["cli"], ids["kafka"], 9092, b"") == 0
+
+        good = encode_request(0, 1, 1, "c", "allowed-topic")
+        bad = encode_request(0, 1, 2, "c", "evil-topic")
+        payload = good + bad
+        buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+        ops = (ctypes.c_int32 * 16)()
+        n = shim_lib.cshim_on_data(77, 0, 0, buf, len(payload), ops, 8)
+        assert n == 2, f"expected 2 ops, got {n}"
+        assert (ops[0], ops[1]) == (int(OpType.PASS), len(good))
+        assert (ops[2], ops[3]) == (int(OpType.DROP), len(bad))
+
+        # service-level batched verdict op via the Python client
+        client = VerdictClient(sock)
+        pong = client.call({"op": "ping"})
+        assert pong["ok"] and pong["revision"] == 1
+        resp = client.call({"op": "verdict", "flows": [{
+            "traffic_direction": "INGRESS",
+            "source": {"identity": ids["cli"]},
+            "destination": {"identity": ids["kafka"]},
+            "l4": {"TCP": {"destination_port": 9092}},
+            "l7": {"kafka": {"api_key": 0, "api_version": 1,
+                              "topic": "allowed-topic"}},
+        }]})
+        assert resp["verdicts"] == [5]  # REDIRECTED (L7 allowed)
+        client.close()
+        shim_lib.cshim_disconnect()
+    finally:
+        service.stop()
