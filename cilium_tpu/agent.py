@@ -1,0 +1,149 @@
+"""The agent: assembly of every subsystem.
+
+Reference: ``daemon/`` + ``pkg/hive`` (SURVEY.md §2.4, §3.1) — the
+agent is a dependency-ordered assembly of cells. Ours wires, in
+dependency order: identity allocator → selector cache → ipcache →
+policy repository → FQDN (cache/NameManager/DNS proxy) → loader
+(feature-gated engine) → endpoint manager → verdict service →
+controllers (DNS GC, checkpoint). One object, explicit start/stop —
+the DI graph is small enough to read.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.identity import IdentityAllocator
+from cilium_tpu.core.labels import LabelSet
+from cilium_tpu.endpoint import EndpointManager
+from cilium_tpu.fqdn import DNSCache, DNSProxy, NameManager
+from cilium_tpu.ipcache import IPCache
+from cilium_tpu.policy.api import CiliumNetworkPolicy, load_cnp_yaml
+from cilium_tpu.policy.repository import Repository
+from cilium_tpu.policy.selectorcache import SelectorCache
+from cilium_tpu.runtime.controller import ControllerManager
+from cilium_tpu.runtime.loader import Loader
+from cilium_tpu.runtime.metrics import METRICS
+from cilium_tpu.runtime.service import VerdictService
+
+
+class Agent:
+    def __init__(self, config: Optional[Config] = None,
+                 state_dir: Optional[str] = None,
+                 socket_path: Optional[str] = None):
+        self.config = config or Config.from_env()
+        self.state_dir = state_dir
+        self.allocator = IdentityAllocator()
+        self.selector_cache = SelectorCache(self.allocator)
+        self.ipcache = IPCache(self.allocator, self.selector_cache)
+        self.repo = Repository()
+        self.dns_cache = DNSCache()
+        self.name_manager = NameManager(self.selector_cache, self.ipcache,
+                                        self.dns_cache)
+        self.dns_proxy = DNSProxy(self.name_manager,
+                                  use_tpu=self.config.enable_tpu_offload)
+        self.loader = Loader(self.config)
+        self.endpoint_manager = EndpointManager(
+            self.repo, self.selector_cache, self.allocator, self.loader,
+            dns_proxy=self.dns_proxy, state_dir=state_dir)
+        self.controllers = ControllerManager()
+        self.service: Optional[VerdictService] = None
+        self.socket_path = socket_path
+        # FQDN updates retrigger regeneration (§3.2 tail)
+        self.name_manager.on_update = (
+            lambda sels: self.endpoint_manager.regenerate_all())
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "Agent":
+        restored = self.endpoint_manager.restore()
+        if restored:
+            METRICS.inc("cilium_tpu_endpoints_restored_total", restored)
+        if self.state_dir:
+            dns_path = os.path.join(self.state_dir, "dnscache.json")
+            if os.path.exists(dns_path):
+                with open(dns_path) as f:
+                    self.dns_cache = DNSCache.from_json(f.read())
+                    self.name_manager.cache = self.dns_cache
+        if self.socket_path:
+            self.service = VerdictService(self.loader, self.socket_path,
+                                          agent=self)
+            self.service.start()
+        self.controllers.update("dns-gc", self._dns_gc, interval=60.0)
+        if self.state_dir:
+            self.controllers.update("checkpoint", self._checkpoint,
+                                    interval=30.0)
+        return self
+
+    def stop(self) -> None:
+        self.controllers.stop_all()
+        if self.service is not None:
+            self.service.stop()
+        if self.state_dir:
+            self._checkpoint()
+        self.endpoint_manager.shutdown()
+
+    def _dns_gc(self) -> None:
+        self.name_manager.gc()
+
+    def _checkpoint(self) -> None:
+        self.endpoint_manager.checkpoint()
+        if self.state_dir:
+            os.makedirs(self.state_dir, exist_ok=True)
+            tmp = os.path.join(self.state_dir, "dnscache.json.tmp")
+            with open(tmp, "w") as f:
+                f.write(self.dns_cache.to_json())
+            os.replace(tmp, os.path.join(self.state_dir, "dnscache.json"))
+
+    # -- policy API (PolicyAdd/PolicyDelete, §3.2) -----------------------
+    def policy_add(self, cnp: CiliumNetworkPolicy, wait: bool = True) -> int:
+        rev = self.repo.add(cnp.rules)
+        self._register_fqdn_selectors(cnp)
+        self.endpoint_manager.regenerate_all(wait=wait)
+        return rev
+
+    def policy_add_file(self, path: str, wait: bool = True) -> int:
+        rev = 0
+        for cnp in load_cnp_yaml(path):
+            rev = self.policy_add(cnp, wait=False)
+        self.endpoint_manager.regenerate_all(wait=wait)
+        return rev
+
+    def policy_delete(self, labels: List[str], wait: bool = True) -> int:
+        n, rev = self.repo.delete_by_labels(labels)
+        if n:
+            self.endpoint_manager.regenerate_all(wait=wait)
+        return n
+
+    def _register_fqdn_selectors(self, cnp: CiliumNetworkPolicy) -> None:
+        for rule in cnp.rules:
+            for er in rule.egress:
+                for fsel in er.to_fqdns:
+                    self.name_manager.register_selector(fsel)
+
+    # -- endpoint API -----------------------------------------------------
+    def endpoint_add(self, endpoint_id: int, labels: Dict[str, str],
+                     ipv4: str = ""):
+        ep = self.endpoint_manager.add_endpoint(
+            endpoint_id, LabelSet.from_dict(labels), ipv4=ipv4)
+        if ipv4:
+            self.ipcache.upsert(f"{ipv4}/32", ep.identity)
+        return ep
+
+    def endpoint_remove(self, endpoint_id: int) -> None:
+        self.endpoint_manager.remove_endpoint(endpoint_id)
+
+    # -- introspection (cilium-dbg surface) ------------------------------
+    def status(self) -> Dict:
+        return {
+            "revision": self.repo.revision,
+            "rules": len(self.repo),
+            "endpoints": len(self.endpoint_manager.endpoints()),
+            "identities": len(self.allocator),
+            "backend": ("tpu" if self.config.enable_tpu_offload
+                        else "oracle"),
+            "engine_revision": self.loader.revision,
+            "controllers": self.controllers.status(),
+        }

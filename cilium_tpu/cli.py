@@ -1,0 +1,178 @@
+"""cilium-tpu debug CLI.
+
+Reference: ``cilium-dbg`` (SURVEY.md §2.4/L7): introspection commands
+over the agent's socket plus offline tooling. Subcommands:
+
+* ``status``      — agent status over the service socket
+* ``policy get``  — installed rules over the socket
+* ``metrics``     — Prometheus text exposition over the socket
+* ``inspect``     — offline dump of a compiled-policy artifact
+  (the ``cilium-dbg bpf policy get`` analog: what the datapath —
+  here, the staged tensors — actually enforces)
+* ``replay``      — run a Hubble JSONL capture through the engine
+  offline and print a verdict summary
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def cmd_status(args) -> int:
+    from cilium_tpu.runtime.service import VerdictClient
+
+    c = VerdictClient(args.socket)
+    print(json.dumps(c.call({"op": "status"}), indent=2, default=str))
+    c.close()
+    return 0
+
+
+def cmd_policy_get(args) -> int:
+    from cilium_tpu.runtime.service import VerdictClient
+
+    c = VerdictClient(args.socket)
+    resp = c.call({"op": "policy_get"})
+    print(json.dumps(resp, indent=2))
+    c.close()
+    return 0 if "error" not in resp else 1
+
+
+def cmd_metrics(args) -> int:
+    from cilium_tpu.runtime.service import VerdictClient
+
+    c = VerdictClient(args.socket)
+    resp = c.call({"op": "metrics"})
+    print(resp.get("text", ""))
+    c.close()
+    return 0
+
+
+def cmd_inspect(args) -> int:
+    """Dump the shape/stats of a compiled policy artifact."""
+    import pickle
+
+    with open(args.artifact, "rb") as f:
+        policy = pickle.load(f)
+    info = {
+        "revision": policy.revision,
+        "mapstate_entries": policy.mapstate.n_entries,
+        "http_rules": len(policy.http_rules),
+        "kafka_rules": len(policy.kafka_rules),
+        "dns_rules": len(policy.dns_rules),
+        "tensors": {
+            k: {"shape": list(v.shape), "dtype": str(v.dtype),
+                "bytes": int(v.nbytes)}
+            for k, v in sorted(policy.arrays.items())
+        },
+        "matchers": {
+            name: {
+                "patterns": len(m.banked.patterns),
+                "banks": m.banked.n_banks,
+                "states": [b.n_states for b in m.banked.banks],
+                "byte_classes": [b.n_classes for b in m.banked.banks],
+            }
+            for name, m in (
+                ("path", policy.path_matcher),
+                ("method", policy.method_matcher),
+                ("host", policy.host_matcher),
+                ("headers", policy.header_matcher),
+                ("dns", policy.dns_matcher),
+            )
+        },
+    }
+    print(json.dumps(info, indent=2))
+    return 0
+
+
+def cmd_replay(args) -> int:
+    """Replay a Hubble JSONL capture against a CNP ruleset."""
+    import numpy as np
+
+    from cilium_tpu.agent import Agent
+    from cilium_tpu.core.config import Config
+    from cilium_tpu.core.flow import Verdict
+    from cilium_tpu.hubble import FlowMetrics, Observer, annotate_flows
+    from cilium_tpu.ingest.hubble import read_jsonl
+    from cilium_tpu.policy.api import load_cnp_yaml
+
+    cfg = Config.from_env()
+    if args.tpu:
+        cfg.enable_tpu_offload = True
+    agent = Agent(cfg)
+    for path in args.policy or ():
+        agent.policy_add_file(path, wait=False)
+    for i, spec in enumerate(args.endpoint or ()):
+        labels = dict(kv.split("=", 1) for kv in spec.split(","))
+        agent.endpoint_add(1000 + i, labels)
+    agent.endpoint_manager.regenerate_all(wait=True)
+
+    engine = agent.loader.engine
+    if engine is None:
+        print("no engine (no endpoints?)", file=sys.stderr)
+        return 1
+    observer = Observer(handlers=[FlowMetrics()])
+    flows = list(read_jsonl(args.capture, start=args.start,
+                            limit=args.limit))
+    out = engine.verdict_flows(flows)
+    if "match_spec" not in out:
+        out = {"verdict": np.asarray(out["verdict"])}
+    annotate_flows(flows, out)
+    observer.observe(flows)
+    counts = {}
+    for f in flows:
+        counts[Verdict(f.verdict).name] = counts.get(
+            Verdict(f.verdict).name, 0) + 1
+    print(json.dumps({"flows": len(flows), "verdicts": counts}))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="cilium-tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("status", help="agent status")
+    p.add_argument("--socket", required=True)
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("policy", help="policy introspection")
+    psub = p.add_subparsers(dest="policy_cmd", required=True)
+    pg = psub.add_parser("get")
+    pg.add_argument("--socket", required=True)
+    pg.set_defaults(fn=cmd_policy_get)
+
+    p = sub.add_parser("metrics", help="Prometheus text metrics")
+    p.add_argument("--socket", required=True)
+    p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("inspect", help="dump a compiled-policy artifact")
+    p.add_argument("artifact")
+    p.set_defaults(fn=cmd_inspect)
+
+    p = sub.add_parser("replay", help="replay a Hubble JSONL capture")
+    p.add_argument("capture")
+    p.add_argument("--policy", action="append",
+                   help="CNP YAML file (repeatable)")
+    p.add_argument("--endpoint", action="append",
+                   help="endpoint labels k=v[,k=v...] (repeatable)")
+    p.add_argument("--start", type=int, default=0)
+    p.add_argument("--limit", type=int, default=None)
+    p.add_argument("--tpu", action="store_true",
+                   help="enable the TPU engine (default: oracle)")
+    p.set_defaults(fn=cmd_replay)
+
+    args = ap.parse_args(argv)
+    try:
+        return args.fn(args)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except ConnectionError as e:
+        print(f"error: cannot reach agent socket: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

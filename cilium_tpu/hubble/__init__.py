@@ -1,0 +1,22 @@
+"""Hubble-style observability: flow ring, observer, metrics, exporter.
+
+Reference: ``pkg/hubble`` (SURVEY.md §2.5) — monitor/accesslog events
+become ``flowpb.Flow``s in a fixed-size ring served over
+``Observer.GetFlows`` (with follow + filters), with flow-metrics
+handlers and a JSONL exporter. Ours ingests verdicted flows straight
+from the engine (the TPU→host outfeed is the verdict array itself).
+"""
+
+from cilium_tpu.hubble.ring import FlowRing
+from cilium_tpu.hubble.observer import Observer, FlowFilter, annotate_flows
+from cilium_tpu.hubble.metrics import FlowMetrics
+from cilium_tpu.hubble.exporter import FlowExporter
+
+__all__ = [
+    "FlowRing",
+    "Observer",
+    "FlowFilter",
+    "annotate_flows",
+    "FlowMetrics",
+    "FlowExporter",
+]

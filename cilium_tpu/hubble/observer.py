@@ -1,0 +1,110 @@
+"""Observer: verdicted flows in, filtered flow streams out.
+
+Reference: ``pkg/hubble/observer`` — ``GetFlows(filter, follow)`` over
+the ring; ``annotate_flows`` plays the parser role
+(``parser/threefour`` + ``parser/seven``): it merges engine verdict
+outputs back onto the Flow objects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from cilium_tpu.core.flow import Flow, L7Type, PolicyMatchType, Verdict
+from cilium_tpu.hubble.ring import FlowRing
+
+
+def annotate_flows(flows: Sequence[Flow], outputs: Dict[str, np.ndarray],
+                   stamp_time: bool = True) -> Sequence[Flow]:
+    """Merge engine outputs (verdict/match_spec arrays) onto flows."""
+    verdicts = np.asarray(outputs["verdict"])
+    specs = np.asarray(outputs.get("match_spec",
+                                   np.full(len(flows), -1)))
+    now = time.time()
+    for i, f in enumerate(flows):
+        f.verdict = Verdict(int(verdicts[i]))
+        if stamp_time and not f.time:
+            f.time = now
+        spec = int(specs[i]) if i < len(specs) else -1
+        if f.verdict == Verdict.REDIRECTED:
+            f.policy_match_type = PolicyMatchType.L7
+        elif spec >= 8:
+            f.policy_match_type = PolicyMatchType.NONE  # denied
+        elif spec == 7:
+            f.policy_match_type = PolicyMatchType.L3_L4
+        elif spec >= 4:
+            f.policy_match_type = PolicyMatchType.L3_ONLY
+        elif spec >= 0:
+            f.policy_match_type = PolicyMatchType.L4_ONLY
+        else:
+            f.policy_match_type = PolicyMatchType.NONE
+    return flows
+
+
+@dataclasses.dataclass
+class FlowFilter:
+    """Subset of flowpb FlowFilter."""
+
+    verdict: Optional[Verdict] = None
+    l7_type: Optional[L7Type] = None
+    src_identity: Optional[int] = None
+    dst_identity: Optional[int] = None
+    dport: Optional[int] = None
+
+    def matches(self, f: Flow) -> bool:
+        if self.verdict is not None and f.verdict != self.verdict:
+            return False
+        if self.l7_type is not None and f.l7 != self.l7_type:
+            return False
+        if self.src_identity is not None and f.src_identity != self.src_identity:
+            return False
+        if self.dst_identity is not None and f.dst_identity != self.dst_identity:
+            return False
+        if self.dport is not None and f.dport != self.dport:
+            return False
+        return True
+
+
+class Observer:
+    def __init__(self, capacity: int = 4096, handlers: Sequence = ()):
+        self.ring = FlowRing(capacity)
+        self.handlers = list(handlers)
+        self.seen = 0
+        self.lost_reported = 0
+
+    def observe(self, flows: Sequence[Flow]) -> None:
+        self.ring.write_many(flows)
+        self.seen += len(flows)
+        for h in self.handlers:
+            h.process(flows)
+
+    def get_flows(self, flt: Optional[FlowFilter] = None,
+                  since_seq: Optional[int] = None,
+                  limit: Optional[int] = None,
+                  follow: bool = False,
+                  timeout: float = 1.0) -> Iterator[Flow]:
+        """Iterate flows from the ring; with ``follow`` blocks for new
+        flows until ``timeout`` passes with none."""
+        seq = self.ring.oldest_seq if since_seq is None else since_seq
+        emitted = 0
+        while True:
+            flow, lost = self.ring.read(seq)
+            if lost:
+                self.lost_reported += lost
+                seq += lost
+            if flow is None:
+                if not follow:
+                    return
+                if not self.ring.wait_for(seq, timeout=timeout):
+                    return
+                continue
+            seq += 1
+            if flt is None or flt.matches(flow):
+                yield flow
+                emitted += 1
+                if limit is not None and emitted >= limit:
+                    return

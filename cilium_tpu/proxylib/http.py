@@ -75,7 +75,8 @@ class HTTPParser(Parser):
                 ops.append((OpType.PASS, frame_len))
             else:
                 ops.append((OpType.DROP, frame_len))
-                ops.append((OpType.INJECT, len(_DENY_RESPONSE)))
+                # queue the 403 body so the proxy/shim can retrieve it
+                ops.append(self.connection.inject(_DENY_RESPONSE))
             self._buf = self._buf[frame_len:]
             if not self._buf:
                 break

@@ -45,7 +45,11 @@ def parse_request_records(frame: bytes) -> List[KafkaInfo]:
     """Parse one complete request frame (without the 4-byte size prefix)
     into policy-checkable records."""
     if len(frame) < 8:
-        return []
+        # too short for a request header — fail closed, like any other
+        # unparseable frame (an empty record list would PASS). api_key
+        # 31 is unassigned, so key- and topic-constrained rules both
+        # refuse it; only an unconstrained allow-all rule admits it.
+        return [KafkaInfo(api_key=31, topic="\x00unparseable")]
     api_key, api_version, correlation = struct.unpack_from(">hhi", frame, 0)
     client_id, off = _read_string(frame, 8)
     if client_id is None:
@@ -81,7 +85,9 @@ def _skip_produce_partitions(frame: bytes, off: int) -> Optional[int]:
         return None
     (n,) = struct.unpack_from(">i", frame, off)
     off += 4
-    for _ in range(max(0, min(n, 4096))):
+    if n < 0 or n > 4096:
+        return None  # refuse rather than desync (fail closed)
+    for _ in range(n):
         if off + 8 > len(frame):
             return None
         (_, size) = struct.unpack_from(">ii", frame, off)

@@ -53,11 +53,23 @@ class Connection:
     policy_name: str = ""     # endpoint/policy scope
     dport: int = 0
     parser: Optional["Parser"] = None
+    #: bytes queued by INJECT ops, drained by the proxy/shim in order
+    pending_inject: List[bytes] = dataclasses.field(default_factory=list)
 
     def on_data(self, reply: bool, end_stream: bool,
                 data: bytes) -> List[Op]:
         assert self.parser is not None
         return self.parser.on_data(reply, end_stream, data)
+
+    def inject(self, payload: bytes) -> Op:
+        """Queue payload for injection; returns the matching INJECT op."""
+        self.pending_inject.append(payload)
+        return (OpType.INJECT, len(payload))
+
+    def take_inject(self) -> bytes:
+        out = b"".join(self.pending_inject)
+        self.pending_inject.clear()
+        return out
 
 
 class Parser:

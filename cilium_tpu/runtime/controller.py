@@ -1,0 +1,111 @@
+"""Controllers: named retry loops with backoff.
+
+Reference: ``pkg/controller`` (SURVEY.md §2.4) — "the agent's universal
+async primitive": a named function re-run on an interval, with
+exponential backoff on failure, individually stoppable, all registered
+in a manager for introspection (``cilium-dbg status --all-controllers``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Callable, Dict, Optional
+
+from cilium_tpu.runtime.metrics import METRICS
+
+
+class Controller:
+    def __init__(self, name: str, fn: Callable[[], None],
+                 interval: float = 10.0, max_backoff: float = 300.0):
+        self.name = name
+        self.fn = fn
+        self.interval = interval
+        self.max_backoff = max_backoff
+        self.failures = 0
+        self.success_count = 0
+        self.last_error: Optional[str] = None
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"ctrl-{name}")
+
+    def start(self) -> "Controller":
+        self._thread.start()
+        return self
+
+    def trigger(self) -> None:
+        """Run now (used instead of waiting out the interval)."""
+        self._wake.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.fn()
+                self.success_count += 1
+                self.failures = 0
+                self.last_error = None
+                METRICS.inc("cilium_tpu_controller_runs_total",
+                            labels={"controller": self.name,
+                                    "status": "success"})
+                delay = self.interval
+            except Exception as e:
+                self.failures += 1
+                self.last_error = f"{type(e).__name__}: {e}"
+                METRICS.inc("cilium_tpu_controller_runs_total",
+                            labels={"controller": self.name,
+                                    "status": "failure"})
+                delay = min(self.max_backoff,
+                            self.interval * (2 ** min(self.failures, 8)))
+            self._wake.wait(timeout=delay)
+            self._wake.clear()
+
+
+class ControllerManager:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._controllers: Dict[str, Controller] = {}
+
+    def update(self, name: str, fn: Callable[[], None],
+               interval: float = 10.0) -> Controller:
+        with self._lock:
+            old = self._controllers.pop(name, None)
+            if old is not None:
+                old.stop()
+            c = Controller(name, fn, interval=interval).start()
+            self._controllers[name] = c
+            return c
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            c = self._controllers.pop(name, None)
+        if c is not None:
+            c.stop()
+
+    def trigger(self, name: str) -> None:
+        with self._lock:
+            c = self._controllers.get(name)
+        if c is not None:
+            c.trigger()
+
+    def status(self) -> Dict[str, Dict]:
+        with self._lock:
+            return {
+                name: {
+                    "success-count": c.success_count,
+                    "failure-count": c.failures,
+                    "last-error": c.last_error,
+                }
+                for name, c in self._controllers.items()
+            }
+
+    def stop_all(self) -> None:
+        with self._lock:
+            for c in self._controllers.values():
+                c.stop()
+            self._controllers.clear()

@@ -83,12 +83,19 @@ class MicroBatcher:
             self._flush_locked()
 
     def _flush_locked(self) -> None:
+        """Swap the pending list out under the lock, then release it
+        before the engine call — new requests must keep enqueuing (and
+        forming the next batch) while this one runs."""
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
         pending, self._pending = self._pending, []
         if not pending:
             return
+        threading.Thread(target=self._run_batch, args=(pending,),
+                         daemon=True).start()
+
+    def _run_batch(self, pending) -> None:
         flows = [p[0] for p in pending]
         t0 = time.perf_counter()
         try:
@@ -174,9 +181,11 @@ class VerdictService:
     per the feature gate) and serves parsers/shims."""
 
     def __init__(self, loader: Loader, socket_path: str,
-                 batch_max: int = 256, deadline_ms: float = 2.0):
+                 batch_max: int = 256, deadline_ms: float = 2.0,
+                 agent=None):
         self.loader = loader
         self.socket_path = socket_path
+        self.agent = agent  # optional backref for introspection ops
         self.bridge = PolicyBridge(loader, batch_max=batch_max,
                                    deadline_ms=deadline_ms)
         self._connections: Dict[int, Connection] = {}
@@ -195,6 +204,19 @@ class VerdictService:
         op = req.get("op")
         if op == "ping":
             return {"ok": True, "revision": self.loader.revision}
+        if op == "status":
+            if self.agent is not None:
+                return self.agent.status()
+            return {"engine_revision": self.loader.revision}
+        if op == "metrics":
+            return {"text": METRICS.expose()}
+        if op == "policy_get":
+            if self.agent is None:
+                return {"error": "no agent attached"}
+            return {"rules": [
+                {"labels": list(r.labels), "description": r.description}
+                for r in self.agent.repo.rules()
+            ], "revision": self.agent.repo.revision}
         if op == "verdict":
             flows = [flow_from_dict(d) for d in req.get("flows", ())]
             engine = self.loader.engine
@@ -229,7 +251,11 @@ class VerdictService:
             data = base64.b64decode(req.get("data_b64", ""))
             ops = conn.on_data(bool(req.get("reply", False)),
                                bool(req.get("end", False)), data)
-            return {"ops": [[int(o), int(n)] for o, n in ops]}
+            resp = {"ops": [[int(o), int(n)] for o, n in ops]}
+            inj = conn.take_inject()
+            if inj:
+                resp["inject_b64"] = base64.b64encode(inj).decode()
+            return resp
         if op == "close_connection":
             with self._conn_lock:
                 self._connections.pop(int(req.get("conn", -1)), None)

@@ -14,6 +14,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <map>
 #include <mutex>
 #include <string>
 #include <sys/socket.h>
@@ -25,6 +26,9 @@ namespace {
 
 std::mutex g_mu;
 int g_fd = -1;
+// per-connection pending INJECT payloads captured from on_data responses
+std::mutex g_inject_mu;
+std::map<uint64_t, std::string> g_inject;
 
 bool send_all(int fd, const void* buf, size_t len) {
   const char* p = static_cast<const char*>(buf);
@@ -78,6 +82,46 @@ std::string b64encode(const uint8_t* data, size_t len) {
     out.push_back(i + 2 < len ? tbl[v & 63] : '=');
   }
   return out;
+}
+
+int b64val(char c) {
+  if (c >= 'A' && c <= 'Z') return c - 'A';
+  if (c >= 'a' && c <= 'z') return c - 'a' + 26;
+  if (c >= '0' && c <= '9') return c - '0' + 52;
+  if (c == '+') return 62;
+  if (c == '/') return 63;
+  return -1;
+}
+
+std::string b64decode(const std::string& in) {
+  std::string out;
+  int buf = 0, bits = 0;
+  for (char c : in) {
+    int v = b64val(c);
+    if (v < 0) continue;  // skip '=' and whitespace
+    buf = (buf << 6) | v;
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out.push_back(static_cast<char>((buf >> bits) & 0xFF));
+    }
+  }
+  return out;
+}
+
+// Extract a JSON string value for `key` from the one response shape we
+// produce (no escaped quotes inside base64).
+bool json_string_field(const std::string& resp, const char* key,
+                       std::string* out) {
+  std::string pat = std::string("\"") + key + "\"";
+  size_t p = resp.find(pat);
+  if (p == std::string::npos) return false;
+  p = resp.find('"', p + pat.size() + 1);
+  if (p == std::string::npos) return false;
+  size_t e = resp.find('"', p + 1);
+  if (e == std::string::npos) return false;
+  *out = resp.substr(p + 1, e - p - 1);
+  return true;
 }
 
 std::string json_escape(const char* s) {
@@ -186,7 +230,27 @@ int cshim_on_data(uint64_t conn_id, int reply, int end_stream,
   req += "\"}";
   std::string resp;
   if (!rpc(req, &resp)) return -1;
+  std::string inj_b64;
+  if (json_string_field(resp, "inject_b64", &inj_b64)) {
+    std::lock_guard<std::mutex> lock(g_inject_mu);
+    g_inject[conn_id] += b64decode(inj_b64);
+  }
   return parse_ops(resp, ops_out, max_pairs);
+}
+
+// Drain pending INJECT bytes for a connection (queued by on_data ops of
+// type INJECT). Returns bytes written, or the required size (negated)
+// if buf is too small; 0 when nothing is pending.
+long cshim_take_inject(uint64_t conn_id, uint8_t* buf, size_t max_len) {
+  std::lock_guard<std::mutex> lock(g_inject_mu);
+  auto it = g_inject.find(conn_id);
+  if (it == g_inject.end() || it->second.empty()) return 0;
+  if (it->second.size() > max_len)
+    return -static_cast<long>(it->second.size());
+  size_t n = it->second.size();
+  std::memcpy(buf, it->second.data(), n);
+  g_inject.erase(it);
+  return static_cast<long>(n);
 }
 
 int cshim_close_connection(uint64_t conn_id) {

@@ -1,0 +1,184 @@
+"""Agent assembly: policy lifecycle, endpoint regeneration, restore,
+controllers, CLI over the service socket."""
+
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Agent
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import (
+    DNSInfo, Flow, HTTPInfo, L7Type, Protocol, TrafficDirection, Verdict,
+)
+from cilium_tpu.endpoint import EndpointState
+from cilium_tpu.policy.api import load_cnp_yaml
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "policies")
+ING = TrafficDirection.INGRESS
+
+
+def _flow(src, dst, port, l7=None, **kw):
+    f = Flow(src_identity=src, dst_identity=dst, dport=port,
+             protocol=Protocol.TCP, direction=ING)
+    if l7 == "http":
+        f.l7 = L7Type.HTTP
+        f.http = HTTPInfo(**kw)
+    return f
+
+
+def test_agent_policy_lifecycle():
+    agent = Agent(Config())
+    agent.endpoint_add(1, {"app": "service"}, ipv4="10.0.0.1")
+    agent.endpoint_add(2, {"app": "frontend"}, ipv4="10.0.0.2")
+    agent.policy_add_file(os.path.join(FIXTURES, "l7", "http-api.yaml"))
+
+    svc = agent.endpoint_manager.get(1)
+    assert svc.state == EndpointState.READY
+    assert svc.policy_revision == agent.repo.revision
+
+    eng = agent.loader.engine
+    sid = agent.endpoint_manager.get(1).identity
+    fid = agent.endpoint_manager.get(2).identity
+    out = eng.verdict_flows([
+        _flow(fid, sid, 80, "http", method="GET", path="/api/v1/x"),
+        _flow(fid, sid, 80, "http", method="DELETE", path="/api/v1/x"),
+    ])["verdict"]
+    assert list(out) == [int(Verdict.REDIRECTED), int(Verdict.DROPPED)]
+
+    # delete policy → default allow (no enforcement)
+    n = agent.policy_delete(
+        ["k8s:io.cilium.k8s.policy.name=l7-http-api"])
+    assert n == 1
+    out = agent.loader.engine.verdict_flows([
+        _flow(fid, sid, 80, "http", method="DELETE", path="/x"),
+    ])["verdict"]
+    assert list(out) == [int(Verdict.FORWARDED)]
+    agent.stop()
+
+
+def test_agent_restore_roundtrip():
+    state = tempfile.mkdtemp()
+    agent = Agent(Config(), state_dir=state)
+    agent.endpoint_add(7, {"app": "web"})
+    agent.endpoint_manager.regenerate_all(wait=True)
+    agent.stop()  # checkpoints
+
+    agent2 = Agent(Config(), state_dir=state).start()
+    agent2.endpoint_manager.regenerate_all(wait=True)
+    ep = agent2.endpoint_manager.get(7)
+    assert ep is not None
+    assert ep.labels.get("app").value == "web"
+    assert len(agent2.allocator) > 0
+    agent2.stop()
+
+
+def test_agent_fqdn_flow_to_regeneration():
+    agent = Agent(Config())
+    agent.endpoint_add(1, {"app": "crawler"}, ipv4="10.0.0.1")
+    agent.policy_add_file(os.path.join(FIXTURES, "dns", "fqdn-egress.yaml"))
+
+    # DNS response for a matching name → CIDR identity → regeneration
+    agent.dns_proxy.observe_response(time.time(), "www.cilium.io",
+                                     ["198.51.100.7"], ttl=600)
+    agent.endpoint_manager.regenerate_all(wait=True)
+    cid = agent.ipcache.lookup("198.51.100.7")
+    assert cid is not None
+    crawler = agent.endpoint_manager.get(1).identity
+    f = Flow(src_identity=crawler, dst_identity=cid, dport=443,
+             protocol=Protocol.TCP,
+             direction=TrafficDirection.EGRESS)
+    out = agent.loader.engine.verdict_flows([f])["verdict"]
+    assert list(out) == [int(Verdict.FORWARDED)]
+    agent.stop()
+
+
+def test_cli_over_socket_and_replay(capsys):
+    from cilium_tpu import cli
+    from cilium_tpu.ingest.hubble import write_jsonl
+
+    sock = os.path.join(tempfile.mkdtemp(), "agent.sock")
+    agent = Agent(Config(), socket_path=sock).start()
+    agent.endpoint_add(1, {"app": "service"})
+    agent.policy_add_file(os.path.join(FIXTURES, "l7", "http-api.yaml"))
+    try:
+        assert cli.main(["status", "--socket", sock]) == 0
+        status = json.loads(capsys.readouterr().out)
+        assert status["rules"] >= 1 and status["backend"] == "oracle"
+
+        assert cli.main(["policy", "get", "--socket", sock]) == 0
+        rules = json.loads(capsys.readouterr().out)
+        assert any("l7-http-api" in ",".join(r["labels"])
+                   for r in rules["rules"])
+
+        assert cli.main(["metrics", "--socket", sock]) == 0
+        assert "cilium_tpu" in capsys.readouterr().out
+    finally:
+        agent.stop()
+
+    # offline replay
+    cap_dir = tempfile.mkdtemp()
+    cap = os.path.join(cap_dir, "flows.jsonl")
+    agent2 = Agent(Config())
+    agent2.endpoint_add(1, {"app": "service"})
+    agent2.endpoint_add(2, {"app": "frontend"})
+    sid = agent2.endpoint_manager.get(1).identity
+    fid = agent2.endpoint_manager.get(2).identity
+    agent2.stop()
+    write_jsonl(cap, [
+        _flow(fid, sid, 80, "http", method="GET", path="/api/v1/ok"),
+        _flow(fid, sid, 80, "http", method="PUT", path="/nope"),
+    ])
+    rc = cli.main([
+        "replay", cap,
+        "--policy", os.path.join(FIXTURES, "l7", "http-api.yaml"),
+        "--endpoint", "app=service", "--endpoint", "app=frontend",
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["flows"] == 2
+
+
+def test_controller_backoff_and_status():
+    from cilium_tpu.runtime.controller import ControllerManager
+
+    mgr = ControllerManager()
+    runs = []
+
+    def flaky():
+        runs.append(1)
+        if len(runs) < 2:
+            raise RuntimeError("boom")
+
+    mgr.update("test-ctrl", flaky, interval=0.05)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        st = mgr.status().get("test-ctrl", {})
+        if st.get("success-count", 0) >= 1:
+            break
+        time.sleep(0.05)
+    st = mgr.status()["test-ctrl"]
+    assert st["success-count"] >= 1
+    mgr.stop_all()
+
+
+def test_hubble_observer_ring_and_metrics():
+    from cilium_tpu.hubble import FlowFilter, FlowMetrics, Observer, annotate_flows
+
+    obs = Observer(capacity=8, handlers=[FlowMetrics()])
+    flows = [_flow(1, 2, 80, "http", method="GET", path="/x")
+             for _ in range(20)]
+    annotate_flows(flows, {"verdict": np.full(20, int(Verdict.DROPPED))})
+    obs.observe(flows)
+    # ring kept only the last 8
+    got = list(obs.get_flows())
+    assert len(got) == 8
+    # filters
+    got = list(obs.get_flows(FlowFilter(verdict=Verdict.FORWARDED)))
+    assert got == []
+    # reader loss detection
+    assert obs.lost_reported == 0  # get_flows starts at oldest

@@ -1,0 +1,154 @@
+"""Regressions for the second review round:
+
+1. produce frames with out-of-range partition counts fail closed
+2. complete-but-short (<8B) kafka frames fail closed
+3. INJECT payload reaches the shim client (take_inject)
+4. revoked DNS rules actively clear from the proxy
+5. endpoint removal clears its DNS allow-sets
+6. regeneration triggers coalesce
+"""
+
+import ctypes
+import os
+import struct
+import tempfile
+import time
+
+import pytest
+
+from cilium_tpu.agent import Agent
+from cilium_tpu.core.config import Config
+from cilium_tpu.proxylib import Connection, OpType, create_parser
+from cilium_tpu.proxylib.kafka import encode_request, parse_request_records
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def test_produce_partition_count_out_of_range_fails_closed():
+    # craft produce frame declaring 5000 partitions for topic[0]
+    body = struct.pack(">hhi", 0, 0, 1) + struct.pack(">h", 1) + b"c"
+    body += struct.pack(">hi", 1, 1000)            # acks, timeout
+    body += struct.pack(">i", 2)                   # 2 topics
+    body += struct.pack(">h", 2) + b"ok"           # topic[0]
+    body += struct.pack(">i", 5000)                # bogus partition count
+    body += b"\x00" * 64
+    recs = parse_request_records(body)
+    assert len(recs) == 1 and recs[0].topic == "\x00unparseable"
+
+
+def test_short_complete_frame_fails_closed():
+    recs = parse_request_records(b"\x00\x00\x00\x00")
+    assert len(recs) == 1
+    assert recs[0].topic == "\x00unparseable" and recs[0].api_key == 31
+
+
+def test_inject_payload_via_service_and_shim():
+    import subprocess
+
+    from cilium_tpu.core.identity import IdentityAllocator
+    from cilium_tpu.core.labels import LabelSet
+    from cilium_tpu.core.flow import Protocol
+    from cilium_tpu.policy.api import (
+        EndpointSelector, IngressRule, L7Rules, PortProtocol, PortRule,
+        PortRuleHTTP, Rule)
+    from cilium_tpu.policy.mapstate import PolicyResolver
+    from cilium_tpu.policy.repository import Repository
+    from cilium_tpu.policy.selectorcache import SelectorCache
+    from cilium_tpu.runtime.loader import Loader
+    from cilium_tpu.runtime.service import VerdictService
+
+    lib_path = os.path.join(REPO, "shim", "libcilium_shim.so")
+    if not os.path.exists(lib_path):
+        subprocess.run(["make", "-C", os.path.join(REPO, "shim")],
+                       check=True, capture_output=True)
+    lib = ctypes.CDLL(lib_path)
+    lib.cshim_connect.argtypes = [ctypes.c_char_p]
+    lib.cshim_on_new_connection.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_uint32,
+        ctypes.c_uint32, ctypes.c_uint32, ctypes.c_char_p]
+    lib.cshim_on_data.argtypes = [
+        ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+    lib.cshim_take_inject.argtypes = [
+        ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t]
+    lib.cshim_take_inject.restype = ctypes.c_long
+
+    alloc = IdentityAllocator()
+    web = alloc.allocate(LabelSet.from_dict({"app": "web"}))
+    cli = alloc.allocate(LabelSet.from_dict({"app": "cli"}))
+    cache = SelectorCache(alloc)
+    repo = Repository()
+    repo.add([Rule(
+        endpoint_selector=EndpointSelector.from_labels(app="web"),
+        ingress=(IngressRule(to_ports=(PortRule(
+            ports=(PortProtocol(80, Protocol.TCP),),
+            rules=L7Rules(http=(PortRuleHTTP(method="GET"),)),
+        ),)),),
+    )], sanitize=False)
+    resolver = PolicyResolver(repo, cache)
+    per_id = {nid: resolver.resolve(alloc.lookup(nid))
+              for nid in (web, cli)}
+    loader = Loader(Config())
+    loader.regenerate(per_id, revision=1)
+    sock = os.path.join(tempfile.mkdtemp(), "v.sock")
+    svc = VerdictService(loader, sock, deadline_ms=1.0)
+    svc.start()
+    try:
+        assert lib.cshim_connect(sock.encode()) == 0
+        assert lib.cshim_on_new_connection(
+            b"http", 5, 1, cli, web, 80, b"") == 0
+        req = b"POST /x HTTP/1.1\r\nhost: w\r\n\r\n"
+        buf = (ctypes.c_uint8 * len(req)).from_buffer_copy(req)
+        ops = (ctypes.c_int32 * 8)()
+        n = lib.cshim_on_data(5, 0, 0, buf, len(req), ops, 4)
+        kinds = [ops[2 * i] for i in range(n)]
+        assert int(OpType.INJECT) in kinds
+        out = (ctypes.c_uint8 * 256)()
+        m = lib.cshim_take_inject(5, out, 256)
+        body = bytes(out[:m])
+        assert m > 0 and b"403 Forbidden" in body
+        # drained: second take returns 0
+        assert lib.cshim_take_inject(5, out, 256) == 0
+    finally:
+        svc.stop()
+
+
+def test_dns_rules_revoked_on_policy_delete_and_endpoint_remove():
+    fixtures = os.path.join(REPO, "examples", "policies")
+    agent = Agent(Config())
+    agent.endpoint_add(1, {"app": "crawler"})
+    # the fixture's port-53 rule peers on kube-dns — it must exist for
+    # the selector to resolve (mirrors the reference: empty selection
+    # installs nothing)
+    agent.endpoint_add(2, {"io.kubernetes.pod.namespace": "kube-system",
+                           "k8s-app": "kube-dns"})
+    agent.policy_add_file(os.path.join(fixtures, "dns", "fqdn-egress.yaml"))
+    assert agent.dns_proxy.check_allowed(1, 53, "www.cilium.io")
+
+    agent.policy_delete(["k8s:io.cilium.k8s.policy.name=fqdn-egress"])
+    agent.endpoint_manager.regenerate_all(wait=True)
+    assert not agent.dns_proxy.check_allowed(1, 53, "www.cilium.io")
+
+    # reinstall, then remove the endpoint: rules must clear
+    agent.policy_add_file(os.path.join(fixtures, "dns", "fqdn-egress.yaml"))
+    assert agent.dns_proxy.check_allowed(1, 53, "www.cilium.io")
+    agent.endpoint_remove(1)
+    assert not agent.dns_proxy.check_allowed(1, 53, "www.cilium.io")
+    agent.stop()
+
+
+def test_regeneration_coalescing():
+    agent = Agent(Config())
+    agent.endpoint_add(1, {"app": "a"})
+    agent.endpoint_manager.regenerate_all(wait=True)
+    em = agent.endpoint_manager
+    done_before = em._gen_done
+    futs = [em.regenerate_all() for _ in range(20)]
+    for f in futs:
+        f.result()
+    # far fewer actual runs than triggers (at least some coalesced)
+    actual_runs = em._gen_done - done_before
+    assert actual_runs >= 1
+    assert em._gen_done == em._gen_target  # everything covered
+    agent.stop()
