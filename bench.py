@@ -85,18 +85,33 @@ def main() -> int:
         log("oracle check: OK")
 
     fb = encode_flows(scenario.flows, engine.policy.kafka_interns, cfg.engine)
-    batch = flowbatch_to_device(fb)
     step = jax.jit(verdict_step)
     arrays = engine._arrays
 
-    out = step(arrays, batch)
+    # The device platform memoizes repeated executions (measured:
+    # impossible >1 PFLOP/s rates when re-submitting one batch). Stage a
+    # distinct, differently-permuted device copy per call — warmup and
+    # timed — so every call is unmemoizable real work. A permutation
+    # keeps the verdict multiset (and the value distribution the gather
+    # path's speed depends on) identical.
+    prng = np.random.default_rng(0)
+    n_copies = args.warmup + args.iters + 1
+    host = {k: np.asarray(v) for k, v in flowbatch_to_device(fb).items()}
+    batches = []
+    for _ in range(n_copies):
+        perm = prng.permutation(fb.size)
+        batches.append({k: jax.device_put(v[perm]) for k, v in host.items()})
+    jax.block_until_ready(batches)
+
+    out = step(arrays, batches[0])
     jax.block_until_ready(out)  # compile
-    for _ in range(args.warmup):
-        out = step(arrays, batch)
+    for i in range(args.warmup):
+        out = step(arrays, batches[1 + i])
     jax.block_until_ready(out)
 
     times = []
-    for _ in range(args.iters):
+    for i in range(args.iters):
+        batch = batches[1 + args.warmup + i]
         t0 = time.perf_counter()
         out = step(arrays, batch)
         jax.block_until_ready(out)

@@ -61,6 +61,11 @@ class Agent:
         restored = self.endpoint_manager.restore()
         if restored:
             METRICS.inc("cilium_tpu_endpoints_restored_total", restored)
+            # re-adopt ip→identity mappings for restored endpoints (the
+            # reference re-adopts the pinned ipcache BPF map on restart)
+            for ep in self.endpoint_manager.endpoints():
+                if ep.ipv4:
+                    self.ipcache.upsert(f"{ep.ipv4}/32", ep.identity)
         if self.state_dir:
             dns_path = os.path.join(self.state_dir, "dnscache.json")
             if os.path.exists(dns_path):
@@ -114,6 +119,7 @@ class Agent:
     def policy_delete(self, labels: List[str], wait: bool = True) -> int:
         n, rev = self.repo.delete_by_labels(labels)
         if n:
+            self._gc_fqdn_selectors()
             self.endpoint_manager.regenerate_all(wait=wait)
         return n
 
@@ -122,6 +128,20 @@ class Agent:
             for er in rule.egress:
                 for fsel in er.to_fqdns:
                     self.name_manager.register_selector(fsel)
+
+    def _gc_fqdn_selectors(self) -> None:
+        """Unregister FQDN selectors no remaining rule references —
+        otherwise deleted toFQDNs policies keep allocating CIDR
+        identities and retriggering regeneration on every DNS answer."""
+        active = {
+            fsel
+            for rule in self.repo.rules()
+            for er in rule.egress
+            for fsel in er.to_fqdns
+        }
+        for sel in self.name_manager.registered_selectors():
+            if sel not in active:
+                self.name_manager.unregister_selector(sel)
 
     # -- endpoint API -----------------------------------------------------
     def endpoint_add(self, endpoint_id: int, labels: Dict[str, str],
@@ -133,6 +153,9 @@ class Agent:
         return ep
 
     def endpoint_remove(self, endpoint_id: int) -> None:
+        for ep in self.endpoint_manager.endpoints():
+            if ep.endpoint_id == endpoint_id and ep.ipv4:
+                self.ipcache.delete(f"{ep.ipv4}/32")
         self.endpoint_manager.remove_endpoint(endpoint_id)
 
     # -- introspection (cilium-dbg surface) ------------------------------

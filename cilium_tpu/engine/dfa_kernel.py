@@ -27,17 +27,31 @@ from jax import lax
 
 
 def _default_impl() -> str:
-    """Step-implementation default. TPU gathers lower to near-scalar
-    loops (~60M/s measured on v5e) while f32 one-hot matmuls ride the
-    MXU; the matmul path is opt-in via CILIUM_TPU_DFA_IMPL=onehot until
-    its TPU compile/runtime behavior is validated on hardware. CPU
-    gathers are fast — gather stays the CPU default."""
+    """Step-implementation default.
+
+    Honest TPU numbers (distinct input buffers per call — the platform
+    memoizes repeated executions, so any same-buffer timing is fake):
+
+    * "gather" — XLA lowers the per-step table gather to a near-scalar
+      loop: ~45M transitions/s regardless of table content. Fast on
+      CPU (the test/oracle path), 100×+ too slow on TPU.
+    * "pallas" — engine/pallas_dfa.py MXU matmul step: ~1G
+      transitions/s, data-oblivious; needs ≤128 states/bank (falls
+      back to gather above that).
+    * "onehot" — same matmul formulation in plain XLA (any state
+      count); slower than pallas (per-step kernel overhead) but a
+      portable reference.
+
+    TPU default is pallas (banks over the state budget still fall
+    back); CPU default is gather."""
     import os
 
     env = os.environ.get("CILIUM_TPU_DFA_IMPL", "")
-    if env in ("gather", "onehot"):
+    if env in ("gather", "onehot", "pallas"):
         return env
-    return "gather"
+    import jax
+
+    return "pallas" if jax.default_backend() == "tpu" else "gather"
 
 
 def dfa_scan(
@@ -54,6 +68,8 @@ def dfa_scan(
     matmuls per step — exact for state ids < 2^24, MXU-friendly).
     """
     impl = impl or _default_impl()
+    if impl == "pallas":
+        impl = "gather"  # single-bank path: pallas handled in banked entry
     if impl not in ("gather", "onehot"):
         raise ValueError(f"unknown dfa impl {impl!r}")
     B, L = data.shape
@@ -120,9 +136,23 @@ def dfa_scan_banked(
 ) -> jax.Array:
     """All banks over one batch → accept words ``[B, NB, W]`` uint32."""
     impl = impl or _default_impl()
-    finals = jax.vmap(
-        lambda tr, bc, st: dfa_scan(tr, bc, st, data, lengths, impl=impl)
-    )(trans, byteclass, start)              # [NB, B]
+    if impl == "pallas":
+        from cilium_tpu.engine import pallas_dfa
+
+        if pallas_dfa.pallas_supported(trans.shape):
+            finals = pallas_dfa.dfa_finals_pallas(
+                trans, byteclass, start, data, lengths,
+                interpret=pallas_dfa.use_interpret())
+            impl = "gather"  # accept-word extraction below
+        else:
+            impl = "gather"  # bank too large for the kernel: fall back
+            finals = None
+    else:
+        finals = None
+    if finals is None:
+        finals = jax.vmap(
+            lambda tr, bc, st: dfa_scan(tr, bc, st, data, lengths, impl=impl)
+        )(trans, byteclass, start)          # [NB, B]
     words = jax.vmap(
         lambda acc, fs: _accept_rows(acc, fs, impl)
     )(accept, finals)                       # [NB, B, W]

@@ -47,6 +47,11 @@ class NameManager:
     def unregister_selector(self, sel: FQDNSelector) -> None:
         with self._lock:
             self._selectors.pop(sel, None)
+        self.selector_cache.remove_selector(sel)
+
+    def registered_selectors(self) -> List[FQDNSelector]:
+        with self._lock:
+            return list(self._selectors)
 
     def update_generate_dns(self, lookup_time: float, name: str,
                             ips: Iterable[str], ttl: int = 0) -> bool:

@@ -75,6 +75,12 @@ class SelectorCache:
                     self._selections[sel] = set()  # FQDN: fed by NameManager
             return frozenset(self._selections[sel])
 
+    def remove_selector(self, sel: Selector) -> None:
+        """Drop a selector no user references anymore (cilium's
+        RemoveSelector): its selections stop receiving churn updates."""
+        with self._lock:
+            self._selections.pop(sel, None)
+
     def get_selections(self, sel: Selector) -> FrozenSet[int]:
         with self._lock:
             got = self._selections.get(sel)

@@ -51,7 +51,13 @@ from cilium_tpu.runtime.metrics import METRICS
 
 class MicroBatcher:
     """Collects single flows; flushes as one engine batch on size or
-    deadline."""
+    deadline.
+
+    One long-lived drain worker runs engine batches serially: while a
+    batch executes, new requests keep enqueuing and form the next batch
+    (natural back-pressure). Spawning a thread per flush instead would
+    pile up unboundedly whenever the engine is slower than the arrival
+    rate."""
 
     def __init__(self, verdict_fn: Callable[[Sequence[Flow]], Sequence[int]],
                  batch_max: int = 256, deadline_ms: float = 2.0):
@@ -59,41 +65,39 @@ class MicroBatcher:
         self.batch_max = batch_max
         self.deadline_s = deadline_ms / 1e3
         self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
         self._pending: List = []          # (flow, event, result_box)
-        self._timer: Optional[threading.Timer] = None
+        self._first_at = 0.0              # enqueue time of oldest pending
+        self._worker: Optional[threading.Thread] = None
 
     def check(self, flow: Flow, timeout: float = 5.0) -> int:
         ev = threading.Event()
         box: List[int] = []
-        with self._lock:
+        with self._cond:
+            if not self._pending:
+                self._first_at = time.monotonic()
             self._pending.append((flow, ev, box))
-            n = len(self._pending)
-            if n >= self.batch_max:
-                self._flush_locked()
-            elif self._timer is None:
-                self._timer = threading.Timer(self.deadline_s, self._on_timer)
-                self._timer.daemon = True
-                self._timer.start()
+            if self._worker is None:
+                self._worker = threading.Thread(target=self._drain,
+                                                daemon=True)
+                self._worker.start()
+            self._cond.notify()
         if not ev.wait(timeout):
             return int(Verdict.ERROR)
         return box[0]
 
-    def _on_timer(self) -> None:
-        with self._lock:
-            self._flush_locked()
-
-    def _flush_locked(self) -> None:
-        """Swap the pending list out under the lock, then release it
-        before the engine call — new requests must keep enqueuing (and
-        forming the next batch) while this one runs."""
-        if self._timer is not None:
-            self._timer.cancel()
-            self._timer = None
-        pending, self._pending = self._pending, []
-        if not pending:
-            return
-        threading.Thread(target=self._run_batch, args=(pending,),
-                         daemon=True).start()
+    def _drain(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending:
+                    self._cond.wait()
+                # wait for a full batch or the oldest entry's deadline
+                while len(self._pending) < self.batch_max:
+                    left = self._first_at + self.deadline_s - time.monotonic()
+                    if left <= 0 or not self._cond.wait(timeout=left):
+                        break
+                pending, self._pending = self._pending, []
+            self._run_batch(pending)
 
     def _run_batch(self, pending) -> None:
         flows = [p[0] for p in pending]

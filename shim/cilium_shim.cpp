@@ -254,6 +254,12 @@ long cshim_take_inject(uint64_t conn_id, uint8_t* buf, size_t max_len) {
 }
 
 int cshim_close_connection(uint64_t conn_id) {
+  {
+    // drop undrained inject bytes: conn ids are reused by the proxy, so
+    // a stale entry would be delivered into the next connection
+    std::lock_guard<std::mutex> lock(g_inject_mu);
+    g_inject.erase(conn_id);
+  }
   std::string req = "{\"op\":\"close_connection\",\"conn\":";
   req += std::to_string(conn_id);
   req += "}";

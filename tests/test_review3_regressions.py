@@ -1,0 +1,88 @@
+"""Regressions for review round 3 (agent lifecycle leaks, batcher)."""
+
+import threading
+import time
+
+import pytest
+
+from cilium_tpu.agent import Agent
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import Flow
+from cilium_tpu.runtime.service import MicroBatcher
+
+
+def _fqdn_policy_yaml(tmp_path):
+    p = tmp_path / "fqdn.yaml"
+    p.write_text(
+        """
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata:
+  name: allow-example
+spec:
+  endpointSelector:
+    matchLabels:
+      app: client
+  egress:
+    - toFQDNs:
+        - matchPattern: "*.example.com"
+""")
+    return str(p)
+
+
+def test_policy_delete_unregisters_fqdn_selectors(tmp_path):
+    agent = Agent(Config())
+    agent.endpoint_add(1, {"app": "client"}, ipv4="10.0.0.1")
+    agent.policy_add_file(_fqdn_policy_yaml(tmp_path))
+    assert len(agent.name_manager.registered_selectors()) == 1
+
+    agent.policy_delete(["k8s:io.cilium.k8s.policy.name=allow-example"])
+    assert agent.name_manager.registered_selectors() == []
+    # stale DNS answers must not churn identities anymore
+    before = len(agent.allocator)
+    agent.name_manager.update_generate_dns(
+        time.time(), "api.example.com", ["1.2.3.4"], ttl=60)
+    assert len(agent.allocator) == before
+
+
+def test_endpoint_remove_cleans_ipcache():
+    agent = Agent(Config())
+    agent.endpoint_add(1, {"app": "x"}, ipv4="10.0.0.9")
+    assert agent.ipcache.lookup("10.0.0.9") is not None
+    agent.endpoint_remove(1)
+    assert agent.ipcache.lookup("10.0.0.9") is None
+
+
+def test_restore_repopulates_ipcache(tmp_path):
+    state = str(tmp_path / "state")
+    a1 = Agent(Config(), state_dir=state).start()
+    a1.endpoint_add(1, {"app": "y"}, ipv4="10.1.0.5")
+    ident = a1.ipcache.lookup("10.1.0.5")
+    a1.stop()
+
+    a2 = Agent(Config(), state_dir=state).start()
+    assert a2.ipcache.lookup("10.1.0.5") == ident
+    a2.stop()
+
+
+def test_microbatcher_single_worker_under_slow_engine():
+    threads_seen = set()
+    calls = []
+
+    def slow_verdicts(flows):
+        threads_seen.add(threading.get_ident())
+        calls.append(len(flows))
+        time.sleep(0.05)
+        return [1] * len(flows)
+
+    mb = MicroBatcher(slow_verdicts, batch_max=4, deadline_ms=1.0)
+    results = []
+    ts = [threading.Thread(target=lambda: results.append(mb.check(Flow())))
+          for _ in range(32)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+    assert len(results) == 32 and all(r == 1 for r in results)
+    assert len(threads_seen) == 1          # one drain worker, not per-flush
+    assert sum(calls) == 32
