@@ -153,9 +153,9 @@ class Agent:
         return ep
 
     def endpoint_remove(self, endpoint_id: int) -> None:
-        for ep in self.endpoint_manager.endpoints():
-            if ep.endpoint_id == endpoint_id and ep.ipv4:
-                self.ipcache.delete(f"{ep.ipv4}/32")
+        ep = self.endpoint_manager.get(endpoint_id)
+        if ep is not None and ep.ipv4:
+            self.ipcache.delete(f"{ep.ipv4}/32")
         self.endpoint_manager.remove_endpoint(endpoint_id)
 
     # -- introspection (cilium-dbg surface) ------------------------------

@@ -213,10 +213,15 @@ class EndpointManager:
         os.makedirs(self.state_dir, exist_ok=True)
         with self._lock:
             eps = [ep.to_json() for ep in self._endpoints.values()]
-        tmp = os.path.join(self.state_dir, "endpoints.json.tmp")
-        with open(tmp, "w") as f:
-            json.dump(eps, f)
-        os.replace(tmp, os.path.join(self.state_dir, "endpoints.json"))
+            # unique tmp per writer + replace under the lock: the
+            # periodic checkpoint controller and an agent stop() may
+            # checkpoint concurrently
+            tmp = os.path.join(
+                self.state_dir,
+                f".endpoints.json.{os.getpid()}.{threading.get_ident()}.tmp")
+            with open(tmp, "w") as f:
+                json.dump(eps, f)
+            os.replace(tmp, os.path.join(self.state_dir, "endpoints.json"))
 
     def restore(self) -> int:
         """Re-adopt persisted endpoints on start; returns count."""

@@ -29,29 +29,27 @@ from jax import lax
 def _default_impl() -> str:
     """Step-implementation default.
 
-    Honest TPU numbers (distinct input buffers per call — the platform
-    memoizes repeated executions, so any same-buffer timing is fake):
+    Honest TPU numbers (measured in a clean process with distinct
+    host-staged input buffers and zero device→host readbacks — earlier
+    "gather is 45M/s" numbers were an artifact of benchmark processes
+    poisoned by readbacks, see docs/PLATFORM.md):
 
-    * "gather" — XLA lowers the per-step table gather to a near-scalar
-      loop: ~45M transitions/s regardless of table content. Fast on
-      CPU (the test/oracle path), 100×+ too slow on TPU.
-    * "pallas" — engine/pallas_dfa.py MXU matmul step: ~1G
-      transitions/s, data-oblivious; needs ≤128 states/bank (falls
-      back to gather above that).
-    * "onehot" — same matmul formulation in plain XLA (any state
-      count); slower than pallas (per-step kernel overhead) but a
-      portable reference.
-
-    TPU default is pallas (banks over the state budget still fall
-    back); CPU default is gather."""
+    * "gather" — one transition-table lookup per (flow, byte, bank);
+      XLA lowers it well on this TPU: ~150G lookups/s at banked-scan
+      shapes. Algorithmically minimal work — the default everywhere.
+    * "pallas" — engine/pallas_dfa.py MXU matmul step: data-oblivious
+      (RE2-style input-independent timing) but pays K×S MACs per
+      lookup; needs ≤128 states/bank. Kept as an option for
+      constant-time-guarantee deployments.
+    * "onehot" — the matmul formulation in plain XLA (any state
+      count); portable reference implementation.
+    """
     import os
 
     env = os.environ.get("CILIUM_TPU_DFA_IMPL", "")
     if env in ("gather", "onehot", "pallas"):
         return env
-    import jax
-
-    return "pallas" if jax.default_backend() == "tpu" else "gather"
+    return "gather"
 
 
 def dfa_scan(
@@ -145,7 +143,20 @@ def dfa_scan_banked(
                 interpret=pallas_dfa.use_interpret())
             impl = "gather"  # accept-word extraction below
         else:
-            impl = "gather"  # bank too large for the kernel: fall back
+            # pallas is an explicit opt-in for its input-independent
+            # timing guarantee; degrading to the data-dependent gather
+            # must be loud, not silent
+            import warnings
+
+            warnings.warn(
+                f"CILIUM_TPU_DFA_IMPL=pallas requested but a bank has "
+                f"{trans.shape[1]} states (limit "
+                f"{pallas_dfa.MAX_STATES}); falling back to the "
+                f"data-dependent 'gather' path — the constant-time "
+                f"guarantee does NOT hold. Compile with a smaller "
+                f"bank_size to keep it.",
+                RuntimeWarning, stacklevel=2)
+            impl = "gather"
             finals = None
     else:
         finals = None

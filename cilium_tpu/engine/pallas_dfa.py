@@ -1,13 +1,13 @@
 """Pallas TPU kernel for the banked DFA byte-scan.
 
-Why a hand-written kernel: XLA lowers the per-step transition-table
-gather to a near-scalar loop on TPU — ~45M transitions/s measured
-(with distinct input buffers per call; the platform memoizes repeated
-executions, so same-buffer timings are fake). That puts the banked scan
-at ~130 ms per 10k-flow batch at 1k rules — 100× off the north-star
-budget. This kernel replaces the state-table gather with MXU matmuls
-whose cost is shape-only (also giving the RE2-style linear-time,
-input-independent guarantee the reference relies on, SURVEY.md §2.2).
+Why a hand-written kernel: the MXU matmul step's cost is shape-only —
+it gives the RE2-style linear-time, *input-independent* timing
+guarantee the reference relies on (SURVEY.md §2.2), which matters for
+deployments where verdict latency must not leak rule or payload
+structure. It is NOT the throughput path: honest clean-process timing
+(docs/PLATFORM.md) shows XLA's native gather sustains ~150G
+transitions/s at banked-scan shapes, so "gather" is the default and
+this kernel is opt-in via CILIUM_TPU_DFA_IMPL=pallas.
 
 Layout: flows ride the lane axis (TILE=1024 lanes), the state axis
 rides sublanes, and each step is

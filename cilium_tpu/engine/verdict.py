@@ -601,22 +601,28 @@ class VerdictEngine:
         return {k: np.asarray(v) for k, v in out.items()}
 
 
-def flowbatch_to_device(fb: FlowBatch, device=None) -> Dict[str, jax.Array]:
-    def put(x):
-        return jax.device_put(x, device)
-
-    d: Dict[str, jax.Array] = {
-        "ep_ids": put(fb.ep_ids), "peer_ids": put(fb.peer_ids),
-        "dports": put(fb.dports), "protos": put(fb.protos),
-        "directions": put(fb.directions), "l7_types": put(fb.l7_types),
-        "kafka_api_key": put(fb.kafka_api_key),
-        "kafka_api_version": put(fb.kafka_api_version),
-        "kafka_client": put(fb.kafka_client),
-        "kafka_topic": put(fb.kafka_topic),
+def flowbatch_to_host_dict(fb: FlowBatch) -> Dict[str, np.ndarray]:
+    """FlowBatch → flat dict of HOST numpy arrays (same keys as
+    :func:`flowbatch_to_device`). Benchmarks build per-iteration device
+    copies from this — staging from host avoids the device→host
+    round-trip that degrades the axon platform (docs/PLATFORM.md)."""
+    d: Dict[str, np.ndarray] = {
+        "ep_ids": fb.ep_ids, "peer_ids": fb.peer_ids,
+        "dports": fb.dports, "protos": fb.protos,
+        "directions": fb.directions, "l7_types": fb.l7_types,
+        "kafka_api_key": fb.kafka_api_key,
+        "kafka_api_version": fb.kafka_api_version,
+        "kafka_client": fb.kafka_client,
+        "kafka_topic": fb.kafka_topic,
     }
     for name in ("path", "method", "host", "headers", "qname"):
         data, lengths, valid = getattr(fb, name)
-        d[f"{name}_data"] = put(data)
-        d[f"{name}_len"] = put(lengths)
-        d[f"{name}_valid"] = put(valid)
+        d[f"{name}_data"] = data
+        d[f"{name}_len"] = lengths
+        d[f"{name}_valid"] = valid
     return d
+
+
+def flowbatch_to_device(fb: FlowBatch, device=None) -> Dict[str, jax.Array]:
+    return {k: jax.device_put(v, device)
+            for k, v in flowbatch_to_host_dict(fb).items()}

@@ -82,9 +82,9 @@ class NameManager:
             ids = {self.ipcache.upsert(f"{ip}/32" if ":" not in ip
                                        else f"{ip}/128")
                    for ip in ips}
-            before = self.selector_cache.get_selections(sel)
-            self.selector_cache.update_fqdn_selections(sel, ids)
-            if self.selector_cache.get_selections(sel) != before:
+            # update_fqdn_selections is a no-op (False) for selectors a
+            # concurrent policy delete already removed — no resurrection
+            if self.selector_cache.update_fqdn_selections(sel, ids):
                 updated.add(sel)
         if updated and self.on_update is not None:
             self.on_update(updated)

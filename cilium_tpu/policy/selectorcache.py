@@ -90,17 +90,26 @@ class SelectorCache:
 
     def update_fqdn_selections(
         self, sel: FQDNSelector, identities: Iterable[int]
-    ) -> None:
+    ) -> bool:
         """NameManager feeds CIDR identities of resolved IPs here
-        (SURVEY.md §3.5 tail)."""
+        (SURVEY.md §3.5 tail). Returns True when the selection changed.
+
+        Deliberately does NOT create the selector: only selectors still
+        registered (added via :meth:`add_selector`, not yet removed) are
+        updated, so a concurrent ``remove_selector`` can never be
+        resurrected by an in-flight NameManager resync."""
         new = set(identities)
         with self._lock:
-            cur = self._selections.setdefault(sel, set())
+            cur = self._selections.get(sel)
+            if cur is None:
+                return False
             added = frozenset(new - cur)
             deleted = frozenset(cur - new)
             if added or deleted:
                 self._selections[sel] = new
                 self._notify(sel, added, deleted)
+                return True
+        return False
 
     # -- notifications ----------------------------------------------------
     def subscribe(self, listener: SelectionListener) -> None:

@@ -66,17 +66,17 @@ class MicroBatcher:
         self.deadline_s = deadline_ms / 1e3
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._pending: List = []          # (flow, event, result_box)
-        self._first_at = 0.0              # enqueue time of oldest pending
+        self._pending: List = []          # (flow, event, result_box, t_enq)
         self._worker: Optional[threading.Thread] = None
+        self._closed = False
 
     def check(self, flow: Flow, timeout: float = 5.0) -> int:
         ev = threading.Event()
         box: List[int] = []
         with self._cond:
-            if not self._pending:
-                self._first_at = time.monotonic()
-            self._pending.append((flow, ev, box))
+            if self._closed:
+                return int(Verdict.ERROR)
+            self._pending.append((flow, ev, box, time.monotonic()))
             if self._worker is None:
                 self._worker = threading.Thread(target=self._drain,
                                                 daemon=True)
@@ -86,17 +86,37 @@ class MicroBatcher:
             return int(Verdict.ERROR)
         return box[0]
 
+    def close(self) -> None:
+        """Stop the drain worker; pending entries get ERROR verdicts."""
+        with self._cond:
+            self._closed = True
+            pending, self._pending = self._pending, []
+            self._cond.notify_all()
+        for _flow, ev, box, _t in pending:
+            box.append(int(Verdict.ERROR))
+            ev.set()
+
     def _drain(self) -> None:
         while True:
             with self._cond:
-                while not self._pending:
+                while not self._pending and not self._closed:
                     self._cond.wait()
+                if self._closed:
+                    return
                 # wait for a full batch or the oldest entry's deadline
-                while len(self._pending) < self.batch_max:
-                    left = self._first_at + self.deadline_s - time.monotonic()
+                while (len(self._pending) < self.batch_max
+                       and not self._closed):
+                    oldest = self._pending[0][3]
+                    left = oldest + self.deadline_s - time.monotonic()
                     if left <= 0 or not self._cond.wait(timeout=left):
                         break
-                pending, self._pending = self._pending, []
+                if self._closed:
+                    return
+                # cap at batch_max: the engine's padding buckets assume
+                # bounded batches, and an unbounded flush under overload
+                # compiles new shapes mid-incident
+                pending = self._pending[:self.batch_max]
+                del self._pending[:self.batch_max]
             self._run_batch(pending)
 
     def _run_batch(self, pending) -> None:
@@ -109,7 +129,7 @@ class MicroBatcher:
         METRICS.observe("cilium_tpu_microbatch_seconds",
                         time.perf_counter() - t0)
         METRICS.observe("cilium_tpu_microbatch_size", len(flows))
-        for (flow, ev, box), v in zip(pending, verdicts):
+        for (flow, ev, box, _t), v in zip(pending, verdicts):
             box.append(int(v))
             ev.set()
 
@@ -301,6 +321,7 @@ class VerdictService:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+        self.bridge.batcher.close()
         if os.path.exists(self.socket_path):
             os.unlink(self.socket_path)
 
