@@ -36,6 +36,10 @@ class EngineConfig:
     http_method_len: int = 16
     kafka_topic_len: int = 256
     kafka_client_id_len: int = 64
+    #: generic (l7proto) records: max fields per record the engine
+    #: encodes pair slots for (our parsers emit ≤4; truncation beyond
+    #: this could only false-DENY, never false-allow)
+    max_generic_fields: int = 16
     # Batching
     batch_size: int = 8192
     # dtype for transition tables

@@ -45,6 +45,7 @@ class L7Type(enum.IntEnum):
     HTTP = 1
     KAFKA = 2
     DNS = 3
+    GENERIC = 4   # proxylib-style l7proto parser records
 
 
 class PolicyMatchType(enum.IntEnum):
@@ -87,6 +88,18 @@ class DNSInfo:
 
 
 @dataclasses.dataclass
+class GenericL7Info:
+    """A record emitted by a generic ``l7proto`` parser (r2d2,
+    memcached, cassandra, …): a flat field map matched against the
+    policy's ``l7`` key/value rules (reference: proxylib parsers +
+    ``PortRuleL7``). Field values are matched exactly; an empty rule
+    value means "field present"."""
+
+    proto: str = ""
+    fields: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
 class Flow:
     """One flow/request tuple to be verdicted."""
 
@@ -99,6 +112,7 @@ class Flow:
     http: Optional[HTTPInfo] = None
     kafka: Optional[KafkaInfo] = None
     dns: Optional[DNSInfo] = None
+    generic: Optional[GenericL7Info] = None
     src_ip: str = ""
     dst_ip: str = ""
     sport: int = 0
@@ -116,4 +130,6 @@ class Flow:
             return self.kafka
         if self.l7 == L7Type.DNS:
             return self.dns
+        if self.l7 == L7Type.GENERIC:
+            return self.generic
         return None

@@ -176,7 +176,8 @@ class CompiledPolicy:
     http_rules: List[PortRuleHTTP]
     kafka_rules: List[PortRuleKafka]
     dns_rules: List[PortRuleDNS]
-    kafka_interns: Dict[str, Dict[str, int]]  # field → string → id
+    gen_rules: List[Tuple[str, Tuple[Tuple[str, str], ...]]]
+    kafka_interns: Dict[str, Dict]          # intern tables (kafka + generic)
     path_matcher: _FieldMatcher
     method_matcher: _FieldMatcher
     host_matcher: _FieldMatcher
@@ -201,6 +202,11 @@ class CompiledPolicy:
         dns_rules: List[PortRuleDNS] = []
         dns_index: Dict[PortRuleDNS, int] = {}
 
+        # generic (l7proto) rules: (proto, sorted (key, value) pairs);
+        # an l7proto with no l7 constraints is the 0-pair allow-all rule
+        gen_rules: List[Tuple[str, Tuple[Tuple[str, str], ...]]] = []
+        gen_index: Dict[Tuple, int] = {}
+
         ruleset_key_to_id: Dict[Tuple, int] = {}
         # per ruleset: member rule ids in each protocol family's space —
         # a merged entry can carry several families (the oracle checks
@@ -208,6 +214,7 @@ class CompiledPolicy:
         ruleset_http: List[List[int]] = []
         ruleset_kafka: List[List[int]] = []
         ruleset_dns: List[List[int]] = []
+        ruleset_gen: List[List[int]] = []
 
         def intern_rule(table, index, rule):
             if rule not in index:
@@ -216,7 +223,7 @@ class CompiledPolicy:
             return index[rule]
 
         def ruleset_of(l7_rules_tuple: Tuple[L7Rules, ...]) -> int:
-            http_ids, kafka_ids, dns_ids = [], [], []
+            http_ids, kafka_ids, dns_ids, gen_ids = [], [], [], []
             for lr in l7_rules_tuple:
                 for h in lr.http:
                     http_ids.append(intern_rule(http_rules, http_index, h))
@@ -224,11 +231,20 @@ class CompiledPolicy:
                     kafka_ids.append(intern_rule(kafka_rules, kafka_index, k))
                 for d in lr.dns:
                     dns_ids.append(intern_rule(dns_rules, dns_index, d))
-            if not (http_ids or kafka_ids or dns_ids):
+                if lr.l7proto:
+                    if not lr.l7:
+                        gen_ids.append(intern_rule(
+                            gen_rules, gen_index, (lr.l7proto, ())))
+                    for g in lr.l7:
+                        gen_ids.append(intern_rule(
+                            gen_rules, gen_index,
+                            (lr.l7proto, tuple(sorted(g.items())))))
+            if not (http_ids or kafka_ids or dns_ids or gen_ids):
                 return -1
             key = (tuple(sorted(set(http_ids))),
                    tuple(sorted(set(kafka_ids))),
-                   tuple(sorted(set(dns_ids))))
+                   tuple(sorted(set(dns_ids))),
+                   tuple(sorted(set(gen_ids))))
             rid = ruleset_key_to_id.get(key)
             if rid is None:
                 rid = len(ruleset_http)
@@ -236,6 +252,7 @@ class CompiledPolicy:
                 ruleset_http.append(list(key[0]))
                 ruleset_kafka.append(list(key[1]))
                 ruleset_dns.append(list(key[2]))
+                ruleset_gen.append(list(key[3]))
             return rid
 
         packed = pack_mapstate(
@@ -318,6 +335,27 @@ class CompiledPolicy:
         for i in range(len(dns_rules)):
             dns_lane[i] = dns_matcher.lane(dns_pats[i])
 
+        # -- generic l7proto rules: proto + (key,value)-pair interning --
+        # A rule matches a record when the record's pair-id set contains
+        # every required pair id. Flows emit (proto,key,value) ids plus
+        # (proto,key,"") presence ids; an empty rule value requires only
+        # presence. Exact-value semantics, matching the oracle.
+        gen_proto_intern: Dict[str, int] = {}
+        gen_pair_intern: Dict[Tuple[str, str, str], int] = {}
+        for proto, pairs in gen_rules:
+            gen_proto_intern.setdefault(proto, len(gen_proto_intern))
+            for k, v in pairs:
+                gen_pair_intern.setdefault((proto, k, v),
+                                           len(gen_pair_intern))
+        Rg = max(1, len(gen_rules))
+        gen_max_pairs = max([len(p) for _, p in gen_rules] + [1])
+        gen_rule_proto = np.full(Rg, -1, dtype=np.int32)
+        gen_rule_pairs = np.full((Rg, gen_max_pairs), -1, dtype=np.int32)
+        for i, (proto, pairs) in enumerate(gen_rules):
+            gen_rule_proto[i] = gen_proto_intern[proto]
+            for j, (k, v) in enumerate(pairs):
+                gen_rule_pairs[i, j] = gen_pair_intern[(proto, k, v)]
+
         # -- ruleset masks ----------------------------------------------
         http_members = ruleset_http
         kafka_members = ruleset_kafka
@@ -337,6 +375,10 @@ class CompiledPolicy:
                                              len(kafka_rules)),
             "rs_dns_mask": _masks_to_array(dns_members or [[]],
                                            len(dns_rules)),
+            "rs_gen_mask": _masks_to_array(ruleset_gen or [[]],
+                                           len(gen_rules)),
+            "gen_rule_proto": gen_rule_proto,
+            "gen_rule_pairs": gen_rule_pairs,
             "http_path_lane": http_path_lane,
             "http_method_lane": http_method_lane,
             "http_host_lane": http_host_lane,
@@ -358,13 +400,25 @@ class CompiledPolicy:
                 if k != "lane_of":
                     arrays[f"{prefix}_{k}"] = v
 
+        # fixed per-flow pair-slot width: a flow can emit at most two ids
+        # per field (value + presence) and never more than the interned
+        # universe; deriving it from the POLICY keeps verdict_step's jit
+        # shape static across batches (no data-driven recompiles)
+        gen_fmax = max(4, min(len(gen_pair_intern),
+                              2 * cfg.max_generic_fields))
+        gen_fmax = -(-gen_fmax // 4) * 4
+
         return cls(
             mapstate=packed,
             arrays=arrays,
             http_rules=http_rules,
             kafka_rules=kafka_rules,
             dns_rules=dns_rules,
-            kafka_interns={"client_id": client_intern, "topic": topic_intern},
+            gen_rules=gen_rules,
+            kafka_interns={"client_id": client_intern, "topic": topic_intern,
+                           "gen_protos": gen_proto_intern,
+                           "gen_pairs": gen_pair_intern,
+                           "gen_fmax": gen_fmax},
             path_matcher=path_matcher,
             method_matcher=method_matcher,
             host_matcher=host_matcher,
@@ -394,6 +448,8 @@ class FlowBatch:
     kafka_api_version: np.ndarray
     kafka_client: np.ndarray
     kafka_topic: np.ndarray
+    gen_proto: np.ndarray     # [B] interned l7proto id, -2 = none/unknown
+    gen_pairs: np.ndarray     # [B, F] interned (proto,key,value) ids, -2 pad
 
     @property
     def size(self) -> int:
@@ -426,6 +482,10 @@ def encode_flows(
     k_top = np.full(B, -2, dtype=np.int32)
     cintern = interns.get("client_id", {})
     tintern = interns.get("topic", {})
+    gproto_intern = interns.get("gen_protos", {})
+    gpair_intern = interns.get("gen_pairs", {})
+    g_proto = np.full(B, -2, dtype=np.int32)
+    g_pair_lists: List[List[int]] = [[] for _ in range(B)]
     for i, f in enumerate(flows):
         ingress = f.direction == TrafficDirection.INGRESS
         ep[i] = f.dst_identity if ingress else f.src_identity
@@ -449,6 +509,23 @@ def encode_flows(
             k_ver[i] = k.api_version
             k_cli[i] = cintern.get(k.client_id, -2)
             k_top[i] = tintern.get(k.topic, -2)
+        g = f.generic
+        if g is not None:
+            g_proto[i] = gproto_intern.get(g.proto, -2)
+            # only interned ids matter — pairs no rule references can
+            # never satisfy a requirement (deduped: a field emits at
+            # most one value id + one presence id)
+            seen: set = set()
+            for key, val in g.fields.items():
+                for probe in ((g.proto, key, val), (g.proto, key, "")):
+                    pid = gpair_intern.get(probe)
+                    if pid is not None and pid not in seen:
+                        seen.add(pid)
+                        g_pair_lists[i].append(pid)
+    Fmax = int(interns.get("gen_fmax", 4))
+    g_pairs = np.full((B, Fmax), -2, dtype=np.int32)
+    for i, pl in enumerate(g_pair_lists):
+        g_pairs[i, :min(len(pl), Fmax)] = pl[:Fmax]
     bucket = max(cfg.http_path_buckets)
     return FlowBatch(
         ep_ids=ep, peer_ids=peer, dports=dport, protos=proto,
@@ -460,6 +537,7 @@ def encode_flows(
         qname=encode_strings(qnames, cfg.dns_name_len),
         kafka_api_key=k_api, kafka_api_version=k_ver,
         kafka_client=k_cli, kafka_topic=k_top,
+        gen_proto=g_proto, gen_pairs=g_pairs,
     )
 
 
@@ -541,9 +619,23 @@ def verdict_step(arrays: Dict[str, jax.Array], batch: Dict[str, jax.Array]
     dns_ok = (jnp.any((d_words & dns_mask) != 0, axis=1)
               & (l7t == int(L7Type.DNS)))
 
+    # generic l7proto records: pair-subset matching
+    grp = arrays["gen_rule_pairs"]                  # [Rg, Km]
+    have = jnp.any(
+        batch["gen_pairs"][:, None, None, :] == grp[None, :, :, None],
+        axis=-1)                                    # [B, Rg, Km]
+    pair_ok = jnp.all(jnp.where(grp[None, :, :] < 0, True, have), axis=-1)
+    proto_ok = (arrays["gen_rule_proto"][None, :]
+                == batch["gen_proto"][:, None])     # [B, Rg]
+    g_ok = pair_ok & proto_ok & (arrays["gen_rule_proto"] >= 0)[None, :]
+    gen_mask = arrays["rs_gen_mask"][ruleset]
+    g_words = _bools_to_words(g_ok, gen_mask.shape[1])
+    gen_ok = (jnp.any((g_words & gen_mask) != 0, axis=1)
+              & (l7t == int(L7Type.GENERIC)))
+
     # allow-list over the union of the ruleset's families (a merged
     # entry can carry several protocol families; oracle checks all)
-    l7_ok = http_ok | kafka_ok | dns_ok
+    l7_ok = http_ok | kafka_ok | dns_ok | gen_ok
 
     allowed = ms["allowed"] & (l7_ok | ~ms["redirect"])
     verdict = jnp.where(
@@ -614,6 +706,8 @@ def flowbatch_to_host_dict(fb: FlowBatch) -> Dict[str, np.ndarray]:
         "kafka_api_version": fb.kafka_api_version,
         "kafka_client": fb.kafka_client,
         "kafka_topic": fb.kafka_topic,
+        "gen_proto": fb.gen_proto,
+        "gen_pairs": fb.gen_pairs,
     }
     for name in ("path", "method", "host", "headers", "qname"):
         data, lengths, valid = getattr(fb, name)

@@ -14,6 +14,7 @@ from typing import Dict, Iterable, Iterator, List, Optional
 from cilium_tpu.core.flow import (
     DNSInfo,
     Flow,
+    GenericL7Info,
     HTTPInfo,
     KafkaInfo,
     L7Type,
@@ -71,6 +72,13 @@ def flow_to_dict(f: Flow) -> Dict:
             "ips": list(f.dns.ips),
             "ttl": f.dns.ttl,
         }}
+    elif f.l7 == L7Type.GENERIC and f.generic:
+        # flowpb models proxylib records as {proto, fields} key/value
+        # pairs (flow.proto L7 "kind: generic")
+        d["l7"] = {"type": "REQUEST", "generic": {
+            "proto": f.generic.proto,
+            "fields": dict(f.generic.fields),
+        }}
     return d
 
 
@@ -124,6 +132,14 @@ def flow_from_dict(d: Dict) -> Flow:
             qtypes=tuple(dd.get("qtypes") or ("A",)),
             ips=tuple(dd.get("ips") or ()),
             ttl=int(dd.get("ttl", 0)),
+        )
+    elif "generic" in l7:
+        g = l7["generic"]
+        f.l7 = L7Type.GENERIC
+        f.generic = GenericL7Info(
+            proto=g.get("proto", ""),
+            fields={str(k): str(v)
+                    for k, v in (g.get("fields") or {}).items()},
         )
     return f
 

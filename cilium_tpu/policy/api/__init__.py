@@ -6,6 +6,7 @@ from cilium_tpu.policy.api.l7 import (
     PortRuleHTTP,
     PortRuleKafka,
     PortRuleDNS,
+    PortRuleL7,
     HeaderMatch,
     KAFKA_API_KEYS,
     KAFKA_ROLE_PRODUCE,
@@ -32,6 +33,7 @@ __all__ = [
     "PortRuleHTTP",
     "PortRuleKafka",
     "PortRuleDNS",
+    "PortRuleL7",
     "HeaderMatch",
     "KAFKA_API_KEYS",
     "KAFKA_ROLE_PRODUCE",

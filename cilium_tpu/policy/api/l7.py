@@ -159,6 +159,23 @@ class PortRuleDNS:
 
 
 @dataclasses.dataclass(frozen=True)
+class PortRuleL7:
+    """One generic key/value rule for an ``l7proto`` parser (reference:
+    ``PortRuleL7 map[string]string``). A record matches when every rule
+    key is present with the exact value; empty value = presence only."""
+
+    fields: Tuple[Tuple[str, str], ...] = ()
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, str]) -> "PortRuleL7":
+        return cls(fields=tuple(sorted((str(k), str(v))
+                                       for k, v in d.items())))
+
+    def items(self) -> Tuple[Tuple[str, str], ...]:
+        return self.fields
+
+
+@dataclasses.dataclass(frozen=True)
 class L7Rules:
     """The per-port L7 rule set (at most one protocol family non-empty)."""
 
@@ -166,7 +183,7 @@ class L7Rules:
     kafka: Tuple[PortRuleKafka, ...] = ()
     dns: Tuple[PortRuleDNS, ...] = ()
     l7proto: str = ""                      # generic proxylib parser name
-    l7: Tuple[Dict[str, str], ...] = ()    # generic key/value rules
+    l7: Tuple[PortRuleL7, ...] = ()        # generic key/value rules
 
     def is_empty(self) -> bool:
         return not (self.http or self.kafka or self.dns or self.l7proto
@@ -187,5 +204,6 @@ class L7Rules:
             kafka=tuple(PortRuleKafka.from_dict(x) for x in (d.get("kafka") or ())),
             dns=tuple(PortRuleDNS.from_dict(x) for x in (d.get("dns") or ())),
             l7proto=d.get("l7proto", "") or "",
-            l7=tuple(dict(x) for x in (d.get("l7") or ())),
+            l7=tuple(PortRuleL7.from_dict(x) if isinstance(x, dict)
+                     else x for x in (d.get("l7") or ())),
         )

@@ -96,6 +96,23 @@ def _dns_rule_matches(rule: PortRuleDNS, flow: Flow) -> bool:
     return bool(re.fullmatch(matchpattern.to_regex(rule.match_pattern), qname))
 
 
+def _generic_rule_matches(rule: Dict[str, str], flow: Flow) -> bool:
+    """One ``l7`` key/value rule vs a generic parser record: every rule
+    key must be present with the exact value; an empty rule value means
+    "field present" (reference: proxylib policy matching of
+    ``PortRuleL7`` maps)."""
+    g = flow.generic
+    if g is None:
+        return False
+    for k, v in rule.items():
+        got = g.fields.get(k)
+        if got is None:
+            return False
+        if v and got != v:
+            return False
+    return True
+
+
 def l7_allowed(l7_rules: Tuple[L7Rules, ...], flow: Flow) -> bool:
     """Allow-list semantics: request must match ≥1 rule of the set."""
     for lr in l7_rules:
@@ -108,6 +125,13 @@ def l7_allowed(l7_rules: Tuple[L7Rules, ...], flow: Flow) -> bool:
         for r in lr.dns:
             if _dns_rule_matches(r, flow):
                 return True
+        if lr.l7proto and flow.generic is not None \
+                and flow.generic.proto == lr.l7proto:
+            if not lr.l7:
+                return True   # parser selected, no record constraints
+            for r in lr.l7:
+                if _generic_rule_matches(r, flow):
+                    return True
     return False
 
 

@@ -22,6 +22,10 @@ from cilium_tpu.proxylib.parser import (
 )
 from cilium_tpu.proxylib.kafka import KafkaParser
 from cilium_tpu.proxylib.http import HTTPParser
+from cilium_tpu.proxylib.r2d2 import R2D2Parser
+from cilium_tpu.proxylib.memcached import MemcachedParser
+from cilium_tpu.proxylib.cassandra import CassandraParser
+from cilium_tpu.proxylib import testparsers  # noqa: F401  (registers)
 
 __all__ = [
     "OpType",
@@ -33,4 +37,7 @@ __all__ = [
     "registered_parsers",
     "KafkaParser",
     "HTTPParser",
+    "R2D2Parser",
+    "MemcachedParser",
+    "CassandraParser",
 ]

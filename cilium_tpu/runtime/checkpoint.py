@@ -12,6 +12,7 @@ from __future__ import annotations
 import hashlib
 import os
 import pickle
+import threading
 from typing import Any, Optional
 
 
@@ -48,7 +49,9 @@ class ArtifactCache:
     def put(self, key: str, value: Any) -> None:
         if not self.enable:
             return
-        tmp = self._path(key) + ".tmp"
+        # unique tmp per writer: concurrent puts of the same key are
+        # benign (content-addressed) but must not race on one tmp file
+        tmp = self._path(key) + f".{os.getpid()}.{threading.get_ident()}.tmp"
         with open(tmp, "wb") as f:
             pickle.dump(value, f, protocol=4)
         os.replace(tmp, self._path(key))

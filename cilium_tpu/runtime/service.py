@@ -36,6 +36,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 from cilium_tpu.core.flow import (
     DNSInfo,
     Flow,
+    GenericL7Info,
     HTTPInfo,
     KafkaInfo,
     L7Type,
@@ -165,6 +166,8 @@ class PolicyBridge:
             f.l7, f.kafka = L7Type.KAFKA, record
         elif isinstance(record, DNSInfo):
             f.l7, f.dns = L7Type.DNS, record
+        elif isinstance(record, GenericL7Info):
+            f.l7, f.generic = L7Type.GENERIC, record
         return f
 
     def policy_check(self, conn: Connection) -> Callable[[object], bool]:

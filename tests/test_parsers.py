@@ -1,0 +1,278 @@
+"""Parser family tests: r2d2, memcached, cassandra, testparsers — and
+generic-L7 (l7proto) verdict parity between the oracle and TPU engine.
+
+Mirrors the reference's proxylib per-parser unit tests (SURVEY.md §2.2:
+per-protocol OnData state machines; §4 unit tier).
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import (
+    Flow,
+    GenericL7Info,
+    L7Type,
+    Protocol,
+    TrafficDirection,
+    Verdict,
+)
+from cilium_tpu.core.identity import IdentityAllocator
+from cilium_tpu.core.labels import LabelSet
+from cilium_tpu.policy.api import (
+    EndpointSelector,
+    IngressRule,
+    L7Rules,
+    PortProtocol,
+    PortRule,
+    PortRuleL7,
+    Rule,
+)
+from cilium_tpu.policy.mapstate import PolicyResolver
+from cilium_tpu.policy.oracle import OracleVerdictEngine
+from cilium_tpu.policy.repository import Repository
+from cilium_tpu.policy.selectorcache import SelectorCache
+from cilium_tpu.proxylib import Connection, OpType, create_parser
+from cilium_tpu.runtime.loader import Loader
+from cilium_tpu.runtime.service import PolicyBridge
+
+
+def _setup(l7proto, l7_rules, app="svc", port=4000):
+    rules = [Rule(
+        endpoint_selector=EndpointSelector.from_labels(app=app),
+        ingress=(IngressRule(to_ports=(PortRule(
+            ports=(PortProtocol(port, Protocol.TCP),),
+            rules=L7Rules(l7proto=l7proto,
+                          l7=tuple(PortRuleL7.from_dict(r)
+                                   for r in l7_rules)),
+        ),)),),
+    )]
+    alloc = IdentityAllocator()
+    ids = {n: alloc.allocate(LabelSet.from_dict({"app": n}))
+           for n in (app, "client")}
+    cache = SelectorCache(alloc)
+    repo = Repository()
+    repo.add(rules, sanitize=False)
+    resolver = PolicyResolver(repo, cache)
+    per_identity = {nid: resolver.resolve(alloc.lookup(nid))
+                    for nid in ids.values()}
+    loader = Loader(Config())
+    loader.regenerate(per_identity, revision=1)
+    return loader, ids, per_identity
+
+
+def _conn(loader, ids, proto, app="svc", port=4000):
+    bridge = PolicyBridge(loader, deadline_ms=1.0)
+    conn = Connection(proto=proto, connection_id=1, ingress=True,
+                      src_identity=ids["client"], dst_identity=ids[app],
+                      dport=port)
+    create_parser(proto, conn, bridge.policy_check(conn))
+    return conn
+
+
+# ----------------------------------------------------------------- r2d2 --
+def test_r2d2_allow_deny_and_inject():
+    loader, ids, _ = _setup("r2d2", [{"cmd": "READ", "file": "public.txt"},
+                                     {"cmd": "HALT"}])
+    conn = _conn(loader, ids, "r2d2")
+    ops = conn.on_data(False, False, b"READ public.txt\r\n")
+    assert ops == [(OpType.PASS, 17)]
+    ops = conn.on_data(False, False, b"READ secret.txt\r\n")
+    assert ops[0] == (OpType.DROP, 17)
+    assert ops[1][0] == OpType.INJECT
+    assert conn.take_inject() == b"ERROR\r\n"
+    assert conn.on_data(False, False, b"HALT\r\n") == [(OpType.PASS, 6)]
+    # WRITE matches no rule
+    ops = conn.on_data(False, False, b"WRITE public.txt\r\n")
+    assert ops[0][0] == OpType.DROP
+
+
+def test_r2d2_chunked_and_garbage():
+    loader, ids, _ = _setup("r2d2", [{"cmd": "RESET"}])
+    conn = _conn(loader, ids, "r2d2")
+    assert conn.on_data(False, False, b"RES")[0][0] == OpType.MORE
+    assert conn.on_data(False, False, b"ET\r\n") == [(OpType.PASS, 7)]
+    conn2 = _conn(loader, ids, "r2d2")
+    assert conn2.on_data(False, False, b"FROB x\r\n")[0][0] == OpType.ERROR
+
+
+# ------------------------------------------------------------ memcached --
+def test_memcached_text_get_set():
+    loader, ids, _ = _setup("memcache", [{"cmd": "get", "key": "a"},
+                                         {"cmd": "set", "key": "a"}])
+    conn = _conn(loader, ids, "memcache")
+    assert conn.on_data(False, False, b"get a\r\n") == [(OpType.PASS, 7)]
+    # multi-key get: every key must be allowed
+    ops = conn.on_data(False, False, b"get a b\r\n")
+    assert ops[0][0] == OpType.DROP
+    # storage command consumes its data block
+    frame = b"set a 0 0 5\r\nhello\r\n"
+    assert conn.on_data(False, False, frame) == [(OpType.PASS, len(frame))]
+    ops = conn.on_data(False, False, b"set b 0 0 5\r\nhello\r\n")
+    assert ops[0][0] == OpType.DROP
+    assert conn.take_inject().startswith(b"SERVER_ERROR")
+
+
+def test_memcached_data_block_split_across_chunks():
+    loader, ids, _ = _setup("memcache", [{"cmd": "set", "key": "k"}])
+    conn = _conn(loader, ids, "memcache")
+    ops = conn.on_data(False, False, b"set k 0 0 10\r\n1234")
+    assert ops == [(OpType.MORE, 8)]
+    ops = conn.on_data(False, False, b"567890\r\n")
+    assert ops == [(OpType.PASS, len(b"set k 0 0 10\r\n1234567890\r\n"))]
+
+
+def test_memcached_binary_frame():
+    loader, ids, _ = _setup("memcache", [{"cmd": "get", "key": "bk"}])
+    conn = _conn(loader, ids, "memcache")
+    key = b"bk"
+    hdr = struct.pack(">BBHBBHIIQ", 0x80, 0x00, len(key), 0, 0, 0,
+                      len(key), 0, 0)
+    frame = hdr + key
+    assert conn.on_data(False, False, frame) == [(OpType.PASS, len(frame))]
+    key2 = b"no"
+    hdr2 = struct.pack(">BBHBBHIIQ", 0x80, 0x00, len(key2), 0, 0, 0,
+                       len(key2), 0, 0)
+    ops = conn.on_data(False, False, hdr2 + key2)
+    assert ops[0][0] == OpType.DROP
+
+
+def test_memcached_keyless_and_unparseable():
+    loader, ids, _ = _setup("memcache", [{"cmd": "version"}])
+    conn = _conn(loader, ids, "memcache")
+    assert conn.on_data(False, False, b"version\r\n") == [(OpType.PASS, 9)]
+    assert conn.on_data(False, False, b"bogus cmd\r\n")[0][0] == OpType.ERROR
+
+
+# ------------------------------------------------------------ cassandra --
+def _cql_query_frame(query: str, opcode=0x07, stream=7) -> bytes:
+    q = query.encode()
+    body = struct.pack(">i", len(q)) + q
+    return struct.pack(">BBhBI", 0x04, 0, stream, opcode, len(body)) + body
+
+
+def test_cassandra_query_allow_deny():
+    loader, ids, _ = _setup("cassandra", [
+        {"query_action": "select", "query_table": "ks.users"}])
+    conn = _conn(loader, ids, "cassandra")
+    frame = _cql_query_frame("SELECT * FROM ks.users WHERE id=1")
+    assert conn.on_data(False, False, frame) == [(OpType.PASS, len(frame))]
+    bad = _cql_query_frame("SELECT * FROM ks.secrets")
+    ops = conn.on_data(False, False, bad)
+    assert ops[0] == (OpType.DROP, len(bad))
+    inj = conn.take_inject()
+    # injected ERROR frame echoes the stream id and carries code 0x2100
+    v, fl, stream, opc, ln = struct.unpack_from(">BBhBI", inj, 0)
+    assert v == 0x84 and opc == 0x00 and stream == 7
+    (code,) = struct.unpack_from(">i", inj, 9)
+    assert code == 0x2100
+
+
+def test_cassandra_handshake_always_passes():
+    loader, ids, _ = _setup("cassandra", [
+        {"query_action": "select", "query_table": "ks.users"}])
+    conn = _conn(loader, ids, "cassandra")
+    startup = struct.pack(">BBhBI", 0x04, 0, 0, 0x01, 0)
+    assert conn.on_data(False, False, startup) == [(OpType.PASS, 9)]
+
+
+def test_cassandra_partial_header_and_insert():
+    loader, ids, _ = _setup("cassandra", [
+        {"query_action": "insert", "query_table": "ks.t"}])
+    conn = _conn(loader, ids, "cassandra")
+    frame = _cql_query_frame("INSERT INTO ks.t (a) VALUES (1)")
+    assert conn.on_data(False, False, frame[:5])[0][0] == OpType.MORE
+    assert conn.on_data(False, False, frame[5:]) == [
+        (OpType.PASS, len(frame))]
+
+
+# ---------------------------------------------------------- testparsers --
+def test_passer_and_lineparser():
+    loader, ids, _ = _setup("test.lineparser", [{"line": "ok"}])
+    conn = _conn(loader, ids, "test.lineparser")
+    ops = conn.on_data(False, False, b"ok\nnope\nok\n")
+    assert ops == [(OpType.PASS, 3), (OpType.DROP, 5), (OpType.PASS, 3)]
+
+    loader2, ids2, _ = _setup("test.passer", [])
+    conn2 = _conn(loader2, ids2, "test.passer")
+    assert conn2.on_data(False, False, b"anything") == [(OpType.PASS, 8)]
+
+
+def test_blockparser_framing():
+    loader, ids, _ = _setup("test.blockparser", [{"prefix": "PASS"}])
+    conn = _conn(loader, ids, "test.blockparser")
+    # block length counts the whole block including the "6:" prefix
+    assert conn.on_data(False, False, b"6:PASS") == [(OpType.PASS, 6)]
+    assert conn.on_data(False, False, b"6:DENY") == [(OpType.DROP, 6)]
+    # split across chunks: MORE with exact remaining byte count
+    ops = conn.on_data(False, False, b"6:PA")
+    assert ops == [(OpType.MORE, 2)]
+    assert conn.on_data(False, False, b"SS") == [(OpType.PASS, 6)]
+    assert conn.on_data(False, False, b"zz:")[0][0] == OpType.ERROR
+
+
+# ------------------------------------------- generic-L7 engine parity ----
+def test_generic_l7_engine_matches_oracle():
+    """TPU engine (CPU backend here) must agree with the oracle on
+    generic l7proto flows — including allow-all (no l7 constraints) and
+    presence-only (empty value) rules."""
+    loader, ids, per_identity = _setup("r2d2", [
+        {"cmd": "READ", "file": "public.txt"},
+        {"cmd": "HALT"},
+        {"cmd": "WRITE", "file": ""},    # presence-only: any file
+    ])
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    engine = Loader(cfg).regenerate(per_identity, revision=1)
+    oracle = OracleVerdictEngine(per_identity)
+
+    def gflow(fields, proto="r2d2"):
+        return Flow(src_identity=ids["client"], dst_identity=ids["svc"],
+                    dport=4000, protocol=Protocol.TCP,
+                    direction=TrafficDirection.INGRESS,
+                    l7=L7Type.GENERIC,
+                    generic=GenericL7Info(proto=proto, fields=dict(fields)))
+
+    flows = [
+        gflow({"cmd": "READ", "file": "public.txt"}),
+        gflow({"cmd": "READ", "file": "secret.txt"}),
+        gflow({"cmd": "HALT"}),
+        gflow({"cmd": "HALT", "file": "x"}),
+        gflow({"cmd": "WRITE", "file": "anything.bin"}),
+        gflow({"cmd": "WRITE"}),                  # no file field: presence fails
+        gflow({"cmd": "RESET"}),
+        gflow({"cmd": "READ", "file": "public.txt"}, proto="memcache"),
+        Flow(src_identity=ids["client"], dst_identity=ids["svc"],
+             dport=4000, protocol=Protocol.TCP,
+             direction=TrafficDirection.INGRESS),   # no L7 record at all
+    ]
+    want = oracle.verdict_flows(flows)["verdict"]
+    got = engine.verdict_flows(flows)["verdict"]
+    assert np.array_equal(np.asarray(got), np.asarray(want)), (
+        list(map(int, got)), list(map(int, want)))
+    # sanity on the expected pattern itself
+    assert int(want[0]) == int(Verdict.REDIRECTED)
+    assert int(want[1]) == int(Verdict.DROPPED)
+    assert int(want[4]) == int(Verdict.REDIRECTED)
+    assert int(want[5]) == int(Verdict.DROPPED)
+
+
+def test_generic_l7_allow_all_parser():
+    """l7proto with no l7 rules: parser selected, all records allowed."""
+    loader, ids, per_identity = _setup("memcache", [])
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    engine = Loader(cfg).regenerate(per_identity, revision=1)
+    oracle = OracleVerdictEngine(per_identity)
+    f = Flow(src_identity=ids["client"], dst_identity=ids["svc"],
+             dport=4000, protocol=Protocol.TCP,
+             direction=TrafficDirection.INGRESS,
+             l7=L7Type.GENERIC,
+             generic=GenericL7Info(proto="memcache",
+                                   fields={"cmd": "get", "key": "zz"}))
+    assert int(oracle.verdict_flows([f])["verdict"][0]) == int(
+        Verdict.REDIRECTED)
+    assert int(engine.verdict_flows([f])["verdict"][0]) == int(
+        Verdict.REDIRECTED)
