@@ -32,9 +32,13 @@ import time
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="http",
-                    choices=["http", "fqdn", "kafka"])
+                    choices=["http", "fqdn", "kafka", "mixed",
+                             "clustermesh"])
     ap.add_argument("--rules", type=int, default=1000)
-    ap.add_argument("--flows", type=int, default=10000)
+    ap.add_argument("--flows", type=int, default=None,
+                    help="flow/tuple count (default: per-config BASELINE "
+                         "shape: http/fqdn 10k, kafka 100k, mixed 1M, "
+                         "clustermesh 100k)")
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--check", action="store_true",
@@ -59,15 +63,30 @@ def main() -> int:
         if args.verbose:
             print(msg, file=sys.stderr)
 
+    if args.flows is None:
+        args.flows = {"http": 10000, "fqdn": 10000, "kafka": 100000,
+                      "mixed": 1000000, "clustermesh": 100000}[args.config]
+
     if args.config == "http":
         scenario = synth.synth_http_scenario(n_rules=args.rules,
                                              n_flows=args.flows)
     elif args.config == "fqdn":
         scenario = synth.synth_fqdn_scenario(n_names=100, n_rules=args.rules,
                                              n_flows=args.flows)
+    elif args.config == "mixed":
+        # BASELINE configs[3]: examples/policies corpus × synthetic tuples
+        import os
+        corpus = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "examples", "policies")
+        scenario = synth.synth_mixed_scenario(corpus, n_tuples=args.flows)
+    elif args.config == "clustermesh":
+        # BASELINE configs[4]: 10k identities × 5k CNP, streaming
+        scenario = synth.synth_clustermesh_scenario(
+            n_identities=10000, n_policies=5000, n_flows=args.flows)
     else:
         scenario = synth.synth_kafka_scenario(n_rules=args.rules,
                                               n_records=args.flows)
+    streaming = args.config in ("mixed", "clustermesh")
     per_identity, scenario = synth.realize_scenario(scenario)
 
     cfg = Config.from_env()
@@ -82,38 +101,80 @@ def main() -> int:
     step = jax.jit(verdict_step)
     arrays = engine._arrays
 
-    # Distinct, differently-permuted device copies per call — warmup and
-    # timed — so no caching layer (compiler CSE, platform replay) can
-    # shortcut repeat executions. Built from HOST numpy: a device round
-    # trip here would poison the process (docs/PLATFORM.md).
-    prng = np.random.default_rng(0)
     host = flowbatch_to_host_dict(fb)
-    n_copies = args.warmup + args.iters + 1
-    batches = []
-    for _ in range(n_copies):
-        perm = prng.permutation(fb.size)
-        batches.append({k: jax.device_put(v[perm]) for k, v in host.items()})
-    jax.block_until_ready(batches)
+    if streaming:
+        # configs[3]/[4] methodology: stream the whole tuple set once,
+        # chunked at the engine batch size. Every timed call sees a
+        # first-use buffer (no repeat → no caching layer can shortcut),
+        # and all chunks are staged to HBM before the timer starts so
+        # the timed region has zero H2D traffic and zero readbacks.
+        bs = cfg.engine.batch_size
+        n_total = fb.size
+        n_chunks = n_total // bs
+        if n_chunks < args.warmup + 2:
+            print(json.dumps({"metric": "bench_failed_setup", "value": 0,
+                              "unit": "too few chunks", "vs_baseline": 0.0}))
+            return 1
+        chunks = []
+        for c in range(n_chunks):
+            sl = slice(c * bs, (c + 1) * bs)
+            chunks.append({k: jax.device_put(v[sl]) for k, v in host.items()})
+        jax.block_until_ready(chunks)
 
-    out = step(arrays, batches[0])
-    jax.block_until_ready(out)  # compile
-    for i in range(args.warmup):
-        out = step(arrays, batches[1 + i])
-    jax.block_until_ready(out)
-
-    times = []
-    for i in range(args.iters):
-        batch = batches[1 + args.warmup + i]
-        t0 = time.perf_counter()
-        out = step(arrays, batch)
+        out = step(arrays, chunks[0])
+        jax.block_until_ready(out)  # compile
+        for i in range(args.warmup):
+            out = step(arrays, chunks[1 + i])
         jax.block_until_ready(out)
-        times.append(time.perf_counter() - t0)
-    times.sort()
-    med = times[len(times) // 2]
-    n = len(scenario.flows)
-    vps = n / med
-    log(f"batch={n} median={med*1e3:.2f}ms p99-ish={times[-1]*1e3:.2f}ms "
-        f"verdicts/s={vps:,.0f}")
+
+        times = []
+        t_stream0 = time.perf_counter()
+        for c in range(1 + args.warmup, n_chunks):
+            t0 = time.perf_counter()
+            out = step(arrays, chunks[c])
+            jax.block_until_ready(out)
+            times.append(time.perf_counter() - t0)
+        t_stream = time.perf_counter() - t_stream0
+        n_timed = (n_chunks - 1 - args.warmup) * bs
+        vps = n_timed / t_stream
+        times.sort()
+        p99 = times[min(len(times) - 1, int(len(times) * 0.99))]
+        log(f"streamed {n_timed} of {n_total} flows in {t_stream:.3f}s "
+            f"(chunk={bs}, p50={times[len(times)//2]*1e3:.2f}ms, "
+            f"p99={p99*1e3:.2f}ms) verdicts/s={vps:,.0f}")
+    else:
+        # Distinct, differently-permuted device copies per call — warmup
+        # and timed — so no caching layer (compiler CSE, platform replay)
+        # can shortcut repeat executions. Built from HOST numpy: a device
+        # round trip here would poison the process (docs/PLATFORM.md).
+        prng = np.random.default_rng(0)
+        n_copies = args.warmup + args.iters + 1
+        batches = []
+        for _ in range(n_copies):
+            perm = prng.permutation(fb.size)
+            batches.append({k: jax.device_put(v[perm])
+                            for k, v in host.items()})
+        jax.block_until_ready(batches)
+
+        out = step(arrays, batches[0])
+        jax.block_until_ready(out)  # compile
+        for i in range(args.warmup):
+            out = step(arrays, batches[1 + i])
+        jax.block_until_ready(out)
+
+        times = []
+        for i in range(args.iters):
+            batch = batches[1 + args.warmup + i]
+            t0 = time.perf_counter()
+            out = step(arrays, batch)
+            jax.block_until_ready(out)
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        med = times[len(times) // 2]
+        n = len(scenario.flows)
+        vps = n / med
+        log(f"batch={n} median={med*1e3:.2f}ms p99-ish={times[-1]*1e3:.2f}ms "
+            f"verdicts/s={vps:,.0f}")
 
     # ---- timing is over; readbacks are safe now -----------------------
     log(f"verdict mix: "
@@ -133,8 +194,11 @@ def main() -> int:
             return 1
         log("oracle check: OK")
 
+    # http/fqdn/kafka wrap their N sub-rules in one Rule — args.rules is
+    # the meaningful count there; mixed/clustermesh have real rule lists
+    n_rules = len(scenario.rules) if streaming else args.rules
     print(json.dumps({
-        "metric": f"l7_verdicts_per_sec_{args.config}_{args.rules}rules",
+        "metric": f"l7_verdicts_per_sec_{args.config}_{n_rules}rules",
         "value": round(vps, 1),
         "unit": "verdicts/s",
         "vs_baseline": round(vps / 10e6, 4),

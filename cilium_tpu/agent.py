@@ -15,12 +15,17 @@ import os
 import threading
 from typing import Dict, List, Optional
 
+from cilium_tpu.clustermesh import ClusterMesh, LocalStatePublisher
 from cilium_tpu.core.config import Config
 from cilium_tpu.core.identity import IdentityAllocator
+from cilium_tpu.kvstore import KVStore
 from cilium_tpu.core.labels import LabelSet
 from cilium_tpu.endpoint import EndpointManager
 from cilium_tpu.fqdn import DNSCache, DNSProxy, NameManager
+from cilium_tpu.health import HealthChecker
+from cilium_tpu.hubble import FlowMetrics, Observer, annotate_flows
 from cilium_tpu.ipcache import IPCache
+from cilium_tpu.monitor import MonitorAgent
 from cilium_tpu.policy.api import CiliumNetworkPolicy, load_cnp_yaml
 from cilium_tpu.policy.repository import Repository
 from cilium_tpu.policy.selectorcache import SelectorCache
@@ -49,6 +54,20 @@ class Agent:
         self.endpoint_manager = EndpointManager(
             self.repo, self.selector_cache, self.allocator, self.loader,
             dns_proxy=self.dns_proxy, state_dir=state_dir)
+        # clustermesh (§2.4): publish local state into our kvstore;
+        # watch remote clusters' stores for their identities/IPs
+        self.kvstore = KVStore()
+        self.publisher = LocalStatePublisher(
+            self.kvstore, self.config.cluster_name, self.allocator,
+            self.ipcache)
+        self.clustermesh = ClusterMesh(
+            self.allocator, self.ipcache, self.selector_cache,
+            on_change=lambda: self.endpoint_manager.regenerate_all())
+        # observability (§2.5): monitor event fan-out + hubble observer
+        self.monitor = MonitorAgent()
+        self.observer = Observer(handlers=[FlowMetrics()])
+        # health probe mesh (§5.3); peers registered via health.add_node
+        self.health = HealthChecker(node_name=self.config.cluster_name)
         self.controllers = ControllerManager()
         self.service: Optional[VerdictService] = None
         self.socket_path = socket_path
@@ -77,12 +96,19 @@ class Agent:
                                           agent=self)
             self.service.start()
         self.controllers.update("dns-gc", self._dns_gc, interval=60.0)
+        self.controllers.update("clustermesh-heartbeat",
+                                self.publisher.heartbeat, interval=15.0)
+        self.controllers.update("health-probe", self.health.probe_all,
+                                interval=60.0)
         if self.state_dir:
             self.controllers.update("checkpoint", self._checkpoint,
                                     interval=30.0)
         return self
 
     def stop(self) -> None:
+        # close() skips the on_change regeneration hook — recompiling
+        # policy for a shutdown teardown would be discarded work
+        self.clustermesh.close()
         self.controllers.stop_all()
         if self.service is not None:
             self.service.stop()
@@ -158,6 +184,26 @@ class Agent:
             self.ipcache.delete(f"{ep.ipv4}/32")
         self.endpoint_manager.remove_endpoint(endpoint_id)
 
+    # -- flow pipeline (engine → monitor → hubble, §3.6) -----------------
+    def process_flows(self, flows: List) -> Dict:
+        """Verdict a batch and fan it out to observability: monitor
+        events (PolicyVerdict/Drop/Trace) and the hubble observer ring.
+        Returns the output arrays as host numpy."""
+        import numpy as np
+
+        engine = self.loader.engine
+        if engine is None:
+            raise RuntimeError(
+                "no policy staged — add an endpoint or policy first")
+        # one device→host readback, shared by monitor + annotate
+        # (readbacks are the expensive sync point, docs/PLATFORM.md)
+        outputs = {k: np.asarray(v)
+                   for k, v in engine.verdict_flows(flows).items()}
+        self.monitor.notify_batch(flows, outputs)
+        annotate_flows(flows, outputs)
+        self.observer.observe(flows)
+        return outputs
+
     # -- introspection (cilium-dbg surface) ------------------------------
     def status(self) -> Dict:
         return {
@@ -169,4 +215,7 @@ class Agent:
                         else "oracle"),
             "engine_revision": self.loader.revision,
             "controllers": self.controllers.status(),
+            "clustermesh": self.clustermesh.status(),
+            "health": {n: s.reachable
+                       for n, s in self.health.status().items()},
         }

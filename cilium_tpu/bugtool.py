@@ -1,0 +1,102 @@
+"""bugtool: one-shot diagnostics bundle.
+
+Reference: ``bugtool/`` (SURVEY.md §2.5, §5.5) — ``cilium-bugtool``
+collects agent status, config, BPF map dumps, metrics, and logs into
+an archive for support. Ours dumps the same strata of our stack:
+agent/status, config, compiled-engine summary (the "BPF map dump"
+analog: staged tensor shapes + revision), metrics exposition, JAX
+device/platform info, and clustermesh/controller state — one JSON
+file per section plus a MANIFEST, optionally tarred.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import platform
+import shutil
+import sys
+import tarfile
+import time
+from typing import Dict, Optional
+
+from cilium_tpu.runtime.metrics import METRICS
+
+
+def _engine_summary(agent) -> Dict:
+    eng = agent.loader.engine
+    if eng is None:
+        return {"staged": False}
+    arrays = getattr(eng, "_arrays", {})
+    return {
+        "staged": True,
+        "revision": agent.loader.revision,
+        "tensors": {
+            k: {"shape": list(getattr(v, "shape", ())),
+                "dtype": str(getattr(v, "dtype", "?"))}
+            for k, v in sorted(arrays.items())
+        },
+        "hbm_bytes": int(sum(
+            getattr(v, "size", 0) * getattr(v, "dtype", None).itemsize
+            for v in arrays.values()
+            if getattr(v, "dtype", None) is not None)),
+    }
+
+
+def _jax_info() -> Dict:
+    try:
+        import jax
+        return {
+            "version": jax.__version__,
+            "backend": jax.default_backend(),
+            "devices": [str(d) for d in jax.devices()],
+        }
+    except Exception as e:  # pragma: no cover - jax import is baked in
+        return {"error": str(e)}
+
+
+def collect(agent, out_dir: str, archive: bool = True) -> str:
+    """Write the bundle; returns the archive (or directory) path."""
+    ts = time.strftime("%Y%m%d-%H%M%S")
+    root = os.path.join(out_dir, f"cilium-tpu-bugtool-{ts}")
+    os.makedirs(root, exist_ok=True)
+    sections = {
+        "status": agent.status(),
+        "config": dataclasses.asdict(agent.config),
+        "engine": _engine_summary(agent),
+        "endpoints": [dict(ep.to_json(), state=str(ep.state))
+                      for ep in agent.endpoint_manager.endpoints()],
+        "identities": {
+            str(nid): list(lbls.format())
+            for nid, lbls in sorted(
+                (n, agent.allocator.lookup(n))
+                for n in agent.allocator.identities())
+            if lbls is not None
+        },
+        "metrics": METRICS.expose(),
+        "environment": {
+            "python": sys.version,
+            "platform": platform.platform(),
+            "argv": sys.argv,
+            "jax": _jax_info(),
+        },
+    }
+    names = []
+    for name, payload in sections.items():
+        fname = f"{name}.json" if not isinstance(payload, str) else f"{name}.txt"
+        with open(os.path.join(root, fname), "w") as f:
+            if isinstance(payload, str):
+                f.write(payload)
+            else:
+                json.dump(payload, f, indent=2, default=str)
+        names.append(fname)
+    with open(os.path.join(root, "MANIFEST.json"), "w") as f:
+        json.dump({"created": ts, "files": sorted(names)}, f, indent=2)
+    if not archive:
+        return root
+    tar_path = root + ".tar.gz"
+    with tarfile.open(tar_path, "w:gz") as tar:
+        tar.add(root, arcname=os.path.basename(root))
+    shutil.rmtree(root)  # only the archive survives
+    return tar_path

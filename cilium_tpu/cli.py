@@ -11,6 +11,8 @@ over the agent's socket plus offline tooling. Subcommands:
   here, the staged tensors — actually enforces)
 * ``replay``      — run a Hubble JSONL capture through the engine
   offline and print a verdict summary
+* ``bugtool``     — collect a diagnostics bundle from the agent
+  (the ``cilium-bugtool`` analog)
 """
 
 from __future__ import annotations
@@ -129,6 +131,19 @@ def cmd_replay(args) -> int:
     return 0
 
 
+def cmd_bugtool(args) -> int:
+    from cilium_tpu.runtime.service import VerdictClient
+
+    c = VerdictClient(args.socket)
+    resp = c.call({"op": "bugtool", "out": args.out})
+    c.close()
+    if "error" in resp:
+        print(f"error: {resp['error']}", file=sys.stderr)
+        return 1
+    print(resp["path"])
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="cilium-tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -150,6 +165,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = sub.add_parser("inspect", help="dump a compiled-policy artifact")
     p.add_argument("artifact")
     p.set_defaults(fn=cmd_inspect)
+
+    p = sub.add_parser("bugtool", help="collect a diagnostics bundle")
+    p.add_argument("--socket", required=True)
+    p.add_argument("--out", default="/tmp")
+    p.set_defaults(fn=cmd_bugtool)
 
     p = sub.add_parser("replay", help="replay a Hubble JSONL capture")
     p.add_argument("capture")

@@ -1,0 +1,258 @@
+"""Clustermesh: multi-cluster identity and ipcache synchronisation.
+
+Reference: ``pkg/clustermesh`` + ``clustermesh-apiserver`` (SURVEY.md
+§2.4) — each agent watches remote clusters' kvstores for identities,
+endpoint IPs, and nodes, making remote workloads matchable by local
+policy. Key layout mirrors the reference's shared-state paths
+(``cilium/state/{identities,ip,nodes}/v1/...``, unverified per the
+SURVEY provenance note).
+
+Design differences from the reference, deliberate:
+
+- Remote label sets are **re-allocated through the local
+  IdentityAllocator** rather than trusting remote numeric IDs — local
+  numeric identities stay dense, which keeps the compiled policy
+  tensors small (remote IDs from k clusters would otherwise fragment
+  the identity axis the TPU engine gathers over).
+- Every remote entry is tagged with a ``cluster=<name>`` label
+  (reference: ``io.cilium.k8s.policy.cluster``) so policies can select
+  by cluster.
+- A `LocalStatePublisher` mirrors the local agent's ipcache into its
+  own kvstore under a TTL lease, so a crashed agent's state ages out
+  of peer clusters (reference: etcd lease GC).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Dict, List, Optional
+
+from cilium_tpu.core.identity import IdentityAllocator, NumericIdentity
+from cilium_tpu.core.labels import Label, LabelSet, SOURCE_K8S
+from cilium_tpu.kvstore import Event, EVENT_DELETE, KVStore, Lease, Watch
+from cilium_tpu.runtime.metrics import METRICS
+
+IP_PREFIX = "cilium/state/ip/v1/default/"
+IDENTITY_PREFIX = "cilium/state/identities/v1/id/"
+NODES_PREFIX = "cilium/state/nodes/v1/"
+
+#: Label key marking which cluster an identity/IP came from
+#: (reference's ``io.cilium.k8s.policy.cluster``; the namespaced key
+#: cannot collide with ordinary workload labels like ``cluster=c0``).
+CLUSTER_LABEL_KEY = "io.cilium.k8s.policy.cluster"
+
+
+def _encode_labels(labels: LabelSet) -> List[str]:
+    return list(labels.format())
+
+
+def _decode_labels(items: List[str]) -> LabelSet:
+    return LabelSet.parse(items)
+
+
+class LocalStatePublisher:
+    """Mirror the local ipcache (IP → identity labels) into a kvstore.
+
+    The reference's agent writes its ipcache/identity state into the
+    shared etcd (or the clustermesh-apiserver proxies it); peers watch
+    it. Keys live under a lease refreshed by `heartbeat()` — wire that
+    to a ControllerManager interval so agent death expires the state.
+    """
+
+    def __init__(self, store: KVStore, cluster_name: str,
+                 allocator: IdentityAllocator, ipcache,
+                 lease_ttl: float = 60.0) -> None:
+        self.store = store
+        self.cluster_name = cluster_name
+        self._allocator = allocator
+        self._lease = store.lease(lease_ttl)
+        self._ipcache = ipcache
+        ipcache.subscribe(self._on_ipcache)
+
+    def _key(self, prefix: str) -> str:
+        return f"{IP_PREFIX}{self.cluster_name}/{prefix}"
+
+    def _on_ipcache(self, prefix: str, nid: NumericIdentity,
+                    upsert: bool) -> None:
+        labels = self._allocator.lookup(nid)
+        # Never re-export state learned FROM another cluster — in a
+        # full mesh (A watches B, B watches A) re-publishing remote
+        # entries under our own prefix would echo them back forever.
+        if labels is not None:
+            tag = labels.get(CLUSTER_LABEL_KEY, SOURCE_K8S)
+            if tag is not None and tag.value != self.cluster_name:
+                return
+        if not upsert:
+            self.store.delete(self._key(prefix))
+            return
+        self.store.set(
+            self._key(prefix),
+            json.dumps({"prefix": prefix, "identity": int(nid),
+                        "labels": _encode_labels(labels) if labels else [],
+                        "cluster": self.cluster_name}),
+            lease=self._lease)
+
+    def heartbeat(self) -> None:
+        self._lease.keepalive()
+        self.store.expire_leases()
+
+
+class RemoteCluster:
+    """Watch one remote cluster's kvstore; feed local ipcache/selectors.
+
+    Mirrors ``pkg/clustermesh ·remoteCluster``: ListAndWatch the remote
+    ip/identity prefixes; each remote IP is upserted into the local
+    ipcache under a locally-allocated identity for its labels (plus the
+    cluster label). Deleting/disconnecting removes everything again.
+    """
+
+    def __init__(self, name: str, store: KVStore,
+                 allocator: IdentityAllocator, ipcache,
+                 selector_cache=None) -> None:
+        self.name = name
+        self.store = store
+        self._allocator = allocator
+        self._ipcache = ipcache
+        self._selector_cache = selector_cache
+        self._lock = threading.Lock()
+        # remote key → (local prefix, local nid); nid refcounted so the
+        # selector cache drops a remote identity when its last IP goes
+        self._prefixes: Dict[str, tuple] = {}
+        self._nid_refs: Dict[NumericIdentity, int] = {}
+        self._watch: Optional[Watch] = None
+        self.ready = False
+
+    def connect(self) -> "RemoteCluster":
+        self._watch = self.store.watch_prefix(IP_PREFIX, self._on_event,
+                                              replay=True)
+        self.ready = True
+        METRICS.set_gauge("cilium_tpu_clustermesh_ready", 1.0,
+                          labels={"cluster": self.name})
+        return self
+
+    def disconnect(self) -> None:
+        if self._watch is not None:
+            self._watch.stop()
+            self._watch = None
+        with self._lock:
+            entries = list(self._prefixes.values())
+            nids = list(self._nid_refs)
+            self._prefixes.clear()
+            self._nid_refs.clear()
+        for prefix, _ in entries:
+            self._ipcache.delete(prefix)
+        for nid in nids:
+            self._release_identity(nid)
+        self.ready = False
+        METRICS.set_gauge("cilium_tpu_clustermesh_ready", 0.0,
+                          labels={"cluster": self.name})
+
+    def _release_identity(self, nid: NumericIdentity) -> None:
+        if self._selector_cache is not None:
+            self._selector_cache.remove_identity(nid)
+        self._allocator.release(nid)
+
+    def _drop_key(self, key: str) -> None:
+        with self._lock:
+            entry = self._prefixes.pop(key, None)
+            last = False
+            if entry is not None:
+                _, nid = entry
+                self._nid_refs[nid] -= 1
+                if self._nid_refs[nid] == 0:
+                    del self._nid_refs[nid]
+                    last = True
+        if entry is not None:
+            self._ipcache.delete(entry[0])
+            if last:
+                self._release_identity(entry[1])
+
+    def _on_event(self, ev: Event) -> None:
+        if ev.typ == EVENT_DELETE:
+            self._drop_key(ev.key)
+            return
+        try:
+            entry = json.loads(ev.value)
+            prefix = entry["prefix"]
+            labels = _decode_labels(entry.get("labels", []))
+        except (ValueError, KeyError):
+            METRICS.inc("cilium_tpu_clustermesh_decode_errors_total",
+                        labels={"cluster": self.name})
+            return
+        tagged = LabelSet(list(labels) + [
+            Label(key=CLUSTER_LABEL_KEY, value=self.name,
+                  source=SOURCE_K8S)])
+        nid = self._allocator.allocate(tagged)
+        with self._lock:
+            prev = self._prefixes.get(ev.key)
+            if prev == (prefix, nid):
+                return  # unchanged re-announce
+            old_last = False
+            if prev is not None:  # remapped prefix or labels
+                _, old_nid = prev
+                self._nid_refs[old_nid] -= 1
+                if self._nid_refs[old_nid] == 0:
+                    del self._nid_refs[old_nid]
+                    old_last = True
+            self._prefixes[ev.key] = (prefix, nid)
+            self._nid_refs[nid] = self._nid_refs.get(nid, 0) + 1
+        if prev is not None and prev[0] != prefix:
+            self._ipcache.delete(prev[0])
+        if self._selector_cache is not None:
+            self._selector_cache.add_identity(nid, tagged)
+        self._ipcache.upsert(prefix, nid)
+        # release AFTER the new mapping is live, and never when the key
+        # kept the same identity (old_nid == nid keeps a refcount)
+        if prev is not None and old_last and prev[1] != nid:
+            self._release_identity(prev[1])
+
+    def num_entries(self) -> int:
+        with self._lock:
+            return len(self._prefixes)
+
+
+class ClusterMesh:
+    """The set of connected remote clusters (``pkg/clustermesh``)."""
+
+    def __init__(self, allocator: IdentityAllocator, ipcache,
+                 selector_cache=None,
+                 on_change: Optional[Callable[[], None]] = None) -> None:
+        self._allocator = allocator
+        self._ipcache = ipcache
+        self._selector_cache = selector_cache
+        self._on_change = on_change
+        self._clusters: Dict[str, RemoteCluster] = {}
+
+    def connect(self, name: str, store: KVStore) -> RemoteCluster:
+        if name in self._clusters:
+            self.disconnect(name)
+        rc = RemoteCluster(name, store, self._allocator, self._ipcache,
+                           self._selector_cache).connect()
+        self._clusters[name] = rc
+        if self._on_change is not None:
+            self._on_change()
+        return rc
+
+    def disconnect(self, name: str) -> None:
+        rc = self._clusters.pop(name, None)
+        if rc is not None:
+            rc.disconnect()
+            if self._on_change is not None:
+                self._on_change()
+
+    def close(self) -> None:
+        """Disconnect everything WITHOUT firing on_change — shutdown
+        teardown must not queue policy recompiles that get discarded."""
+        for name in list(self._clusters):
+            rc = self._clusters.pop(name)
+            rc.disconnect()
+
+    def status(self) -> Dict[str, Dict]:
+        return {
+            name: {"ready": rc.ready, "num-entries": rc.num_entries()}
+            for name, rc in self._clusters.items()
+        }
+
+    def __len__(self) -> int:
+        return len(self._clusters)

@@ -69,6 +69,7 @@ class Config:
     """Root config (DaemonConfig analog)."""
 
     enable_tpu_offload: bool = False   # master feature gate (north star)
+    cluster_name: str = "default"      # clustermesh local cluster name
     engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
     loader: LoaderConfig = dataclasses.field(default_factory=LoaderConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)

@@ -11,6 +11,7 @@ from cilium_tpu.hubble.ring import FlowRing
 from cilium_tpu.hubble.observer import Observer, FlowFilter, annotate_flows
 from cilium_tpu.hubble.metrics import FlowMetrics
 from cilium_tpu.hubble.exporter import FlowExporter
+from cilium_tpu.hubble.relay import Peer, Relay
 
 __all__ = [
     "FlowRing",
@@ -19,4 +20,6 @@ __all__ = [
     "annotate_flows",
     "FlowMetrics",
     "FlowExporter",
+    "Peer",
+    "Relay",
 ]

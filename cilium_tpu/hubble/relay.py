@@ -1,0 +1,85 @@
+"""Hubble Relay: cluster-wide flow queries.
+
+Reference: ``pkg/hubble/relay`` (SURVEY.md §2.5) — Relay keeps a peer
+list (one Hubble observer per node, discovered via the Peer service),
+scatter-gathers ``GetFlows`` across all peers, and merge-sorts the
+per-node streams by timestamp into one cluster-wide stream. Ours
+relays over in-process Observer instances (the node boundary is a
+constructor argument, not a gRPC dial — the scatter/gather and
+merge-sort semantics are the part that carries).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from cilium_tpu.core.flow import Flow
+from cilium_tpu.hubble.observer import FlowFilter, Observer
+
+
+class Peer:
+    """One node's observer endpoint (reference: peer service entry)."""
+
+    def __init__(self, name: str, observer: Observer) -> None:
+        self.name = name
+        self.observer = observer
+        self.available = True
+
+
+class Relay:
+    """Scatter-gather over per-node observers (``hubble-relay``)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._peers: Dict[str, Peer] = {}
+
+    # -- peer management (reference: peer change notifications) ---------
+    def add_peer(self, name: str, observer: Observer) -> Peer:
+        p = Peer(name, observer)
+        with self._lock:
+            self._peers[name] = p
+        return p
+
+    def remove_peer(self, name: str) -> None:
+        with self._lock:
+            self._peers.pop(name, None)
+
+    def peers(self) -> List[str]:
+        with self._lock:
+            return sorted(self._peers)
+
+    # -- queries ---------------------------------------------------------
+    def get_flows(self, flt: Optional[FlowFilter] = None,
+                  limit: Optional[int] = None) -> List[Tuple[str, Flow]]:
+        """Gather matching flows from every available peer, merge-sorted
+        by flow time (the relay contract: one globally time-ordered
+        stream). Returns ``(peer_name, flow)`` pairs; an unreachable
+        peer is skipped and marked unavailable, not fatal (reference
+        degrades the same way)."""
+        with self._lock:
+            peers = list(self._peers.values())
+        streams: List[List[Tuple[float, int, str, Flow]]] = []
+        for idx, p in enumerate(peers):
+            try:
+                # materialize inside the try — get_flows is a generator,
+                # so failures surface during iteration, not at the call
+                stream = [(f.time or 0.0, idx, p.name, f)
+                          for f in p.observer.get_flows(flt)]
+                p.available = True
+            except Exception:
+                p.available = False
+                continue
+            streams.append(stream)
+        merged = list(heapq.merge(*streams))
+        if limit is not None:
+            merged = merged[-limit:]
+        return [(name, f) for _, _, name, f in merged]
+
+    def status(self) -> Dict[str, Dict]:
+        with self._lock:
+            return {
+                name: {"available": p.available}
+                for name, p in self._peers.items()
+            }

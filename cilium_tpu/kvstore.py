@@ -1,0 +1,196 @@
+"""Key-value store: the clustermesh/identity state backbone.
+
+Reference: ``pkg/kvstore`` (SURVEY.md §2.4, §2.7) — an etcd-backed
+store used for identity allocation and clustermesh state, with prefix
+watches (create/modify/delete events) and TTL leases whose expiry
+removes the keys of a crashed agent. Ours is the single-process
+registry the survey prescribes for v0 (§2.7 "single-process registry
+in v0; pluggable later"): same observable contract — linearizable
+set/get/delete, `list_prefix`, replay-then-follow prefix watches,
+leases with keepalive — behind a small interface so an etcd-backed
+implementation can slot in without touching clustermesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+#: Watch event types, mirroring the reference's kvstore EventType.
+EVENT_CREATE = "create"
+EVENT_MODIFY = "modify"
+EVENT_DELETE = "delete"
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    typ: str  # EVENT_CREATE | EVENT_MODIFY | EVENT_DELETE
+    key: str
+    value: str  # previous value for deletes, new value otherwise
+
+
+class Lease:
+    """A TTL lease; keys attached to it vanish when it expires.
+
+    The reference uses etcd leases so a dead agent's identity/ipcache
+    keys are garbage-collected; `keepalive()` is the heartbeat.
+    """
+
+    def __init__(self, ttl: float) -> None:
+        self.ttl = ttl
+        self.deadline = time.monotonic() + ttl
+        self.revoked = False
+
+    def keepalive(self) -> None:
+        self.deadline = time.monotonic() + self.ttl
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.revoked or (now or time.monotonic()) > self.deadline
+
+
+class Watch:
+    """Handle for a prefix watch; `stop()` detaches the callback."""
+
+    def __init__(self, store: "KVStore", prefix: str,
+                 callback: Callable[[Event], None]) -> None:
+        self._store = store
+        self.prefix = prefix
+        self.callback = callback
+        self.stopped = False
+
+    def stop(self) -> None:
+        self.stopped = True
+        self._store._remove_watch(self)
+
+
+class KVStore:
+    """In-memory store with etcd-like semantics.
+
+    Thread-safe. Watch callbacks run synchronously under the caller's
+    thread after the mutation commits (events are ordered per store —
+    the reference serializes events per watcher the same way).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        # Serializes ALL event deliveries (replay and live) so a watch
+        # registered mid-set never sees the live MODIFY before its own
+        # replay CREATE. RLock: a callback may re-enter the store.
+        self._dispatch_lock = threading.RLock()
+        self._data: Dict[str, Tuple[str, Optional[Lease]]] = {}
+        self._watches: List[Watch] = []
+        self._revision = 0
+
+    # -- leases ----------------------------------------------------------
+    def lease(self, ttl: float) -> Lease:
+        return Lease(ttl)
+
+    def revoke(self, lease: Lease) -> None:
+        lease.revoked = True
+        self.expire_leases()
+
+    def expire_leases(self) -> int:
+        """Drop keys whose lease has expired; returns count removed.
+
+        Called opportunistically (and by clustermesh's heartbeat
+        controller) instead of a dedicated expiry thread — keeps the
+        store deterministic under test.
+        """
+        now = time.monotonic()
+        with self._lock:
+            dead = [k for k, (_, l) in self._data.items()
+                    if l is not None and l.expired(now)]
+        for k in dead:
+            self.delete(k)
+        return len(dead)
+
+    # -- kv --------------------------------------------------------------
+    def set(self, key: str, value: str, lease: Optional[Lease] = None) -> None:
+        # dispatch lock is taken BEFORE the commit so watchers observe
+        # mutations in commit order (commit and delivery serialize on
+        # the same lock; releasing _lock first would let a later write
+        # deliver ahead of an earlier one)
+        with self._dispatch_lock:
+            with self._lock:
+                existed = key in self._data
+                self._data[key] = (value, lease)
+                self._revision += 1
+                ev = Event(EVENT_MODIFY if existed else EVENT_CREATE,
+                           key, value)
+                watches = list(self._watches)
+            self._dispatch(watches, ev)
+
+    def get(self, key: str) -> Optional[str]:
+        self.expire_leases()
+        with self._lock:
+            entry = self._data.get(key)
+        return entry[0] if entry is not None else None
+
+    def delete(self, key: str) -> bool:
+        with self._dispatch_lock:
+            with self._lock:
+                entry = self._data.pop(key, None)
+                if entry is None:
+                    return False
+                self._revision += 1
+                ev = Event(EVENT_DELETE, key, entry[0])
+                watches = list(self._watches)
+            self._dispatch(watches, ev)
+        return True
+
+    def delete_prefix(self, prefix: str) -> int:
+        with self._lock:
+            keys = [k for k in self._data if k.startswith(prefix)]
+        return sum(self.delete(k) for k in keys)
+
+    def list_prefix(self, prefix: str) -> Dict[str, str]:
+        self.expire_leases()
+        with self._lock:
+            return {k: v for k, (v, _) in self._data.items()
+                    if k.startswith(prefix)}
+
+    @property
+    def revision(self) -> int:
+        with self._lock:
+            return self._revision
+
+    # -- watches ---------------------------------------------------------
+    def watch_prefix(self, prefix: str,
+                     callback: Callable[[Event], None],
+                     replay: bool = True) -> Watch:
+        """Subscribe to events under `prefix`. With `replay`, current
+        keys are delivered first as CREATE events (the reference's
+        ListAndWatch contract) before any live event."""
+        w = Watch(self, prefix, callback)
+        with self._dispatch_lock:
+            with self._lock:
+                snapshot = [(k, v) for k, (v, _) in self._data.items()
+                            if k.startswith(prefix)] if replay else []
+                self._watches.append(w)
+            # any set() that committed before registration is in the
+            # snapshot; any later one blocks on the dispatch lock until
+            # the replay below has been delivered
+            for k, v in snapshot:
+                callback(Event(EVENT_CREATE, k, v))
+        return w
+
+    def _remove_watch(self, w: Watch) -> None:
+        with self._lock:
+            if w in self._watches:
+                self._watches.remove(w)
+
+    def _dispatch(self, watches: List[Watch], ev: Event) -> None:
+        with self._dispatch_lock:
+            for w in watches:
+                if not w.stopped and ev.key.startswith(w.prefix):
+                    w.callback(ev)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def __iter__(self) -> Iterator[str]:
+        with self._lock:
+            return iter(list(self._data))

@@ -1,0 +1,157 @@
+"""Monitor: datapath event stream with aggregation.
+
+Reference: ``pkg/monitor`` + ``pkg/maps/eventsmap`` (SURVEY.md §2.5) —
+the kernel datapath emits ``TraceNotify`` / ``DropNotify`` /
+``PolicyVerdictNotify`` / debug events over a perf ring buffer; the
+monitor agent decodes them, applies a configurable aggregation level,
+and fans them out to listeners (Hubble's parser is the main consumer).
+
+TPU mapping (§2.7): the "perf buffer" is the verdict/match arrays the
+engine returns per batch — `events_from_outputs` is the decoder that
+turns one batch's arrays into typed notification records. Aggregation
+levels mirror ``monitorAggregation``: ``none`` emits a TraceNotify per
+flow, ``medium``/``maximum`` suppress per-flow traces and keep only
+verdict/drop events (the reference suppresses to connection-level
+trace points).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from cilium_tpu.core.flow import Flow, TrafficDirection, Verdict
+from cilium_tpu.runtime.metrics import METRICS
+
+
+class AggregationLevel(enum.IntEnum):
+    """``--monitor-aggregation`` levels (reference: none/low/medium/max)."""
+
+    NONE = 0
+    LOW = 1
+    MEDIUM = 2
+    MAXIMUM = 3
+
+
+class EventType(enum.IntEnum):
+    """Perf-event message types (reference: ``monitorAPI.MessageType*``)."""
+
+    DROP = 1
+    DEBUG = 2
+    CAPTURE = 3
+    TRACE = 4
+    POLICY_VERDICT = 5
+
+
+@dataclasses.dataclass(frozen=True)
+class MonitorEvent:
+    """One decoded notification (union of the reference notify types)."""
+
+    typ: EventType
+    ts: float
+    src_identity: int
+    dst_identity: int
+    dport: int
+    direction: TrafficDirection
+    verdict: Verdict
+    #: engine match_spec (which precedence slot matched, -1 = none) —
+    #: plays the role of the reference's ``policy_match_type`` +
+    #: ``drop_reason`` fields on PolicyVerdictNotify/DropNotify
+    match_spec: int = -1
+    message: str = ""
+
+
+def events_from_outputs(flows: Sequence[Flow],
+                        outputs: Dict[str, np.ndarray],
+                        level: AggregationLevel = AggregationLevel.MEDIUM,
+                        ) -> List[MonitorEvent]:
+    """Decode one engine batch into monitor events.
+
+    Always emits POLICY_VERDICT per flow (the reference emits
+    PolicyVerdictNotify whenever policy evaluation happened) and DROP
+    for denied flows; TraceNotify per forwarded flow only below
+    MEDIUM aggregation.
+    """
+    verdicts = np.asarray(outputs["verdict"])
+    specs = np.asarray(outputs.get("match_spec",
+                                   np.full(len(flows), -1)))
+    now = time.time()
+    events: List[MonitorEvent] = []
+    for i, f in enumerate(flows):
+        v = Verdict(int(verdicts[i]))
+        spec = int(specs[i]) if i < len(specs) else -1
+        events.append(MonitorEvent(
+            typ=EventType.POLICY_VERDICT, ts=now,
+            src_identity=f.src_identity, dst_identity=f.dst_identity,
+            dport=f.dport, direction=f.direction, verdict=v,
+            match_spec=spec))
+        if v == Verdict.DROPPED:
+            events.append(MonitorEvent(
+                typ=EventType.DROP, ts=now,
+                src_identity=f.src_identity, dst_identity=f.dst_identity,
+                dport=f.dport, direction=f.direction, verdict=v,
+                match_spec=spec, message="Policy denied"))
+        elif level < AggregationLevel.MEDIUM:
+            events.append(MonitorEvent(
+                typ=EventType.TRACE, ts=now,
+                src_identity=f.src_identity, dst_identity=f.dst_identity,
+                dport=f.dport, direction=f.direction, verdict=v,
+                match_spec=spec))
+    return events
+
+
+class MonitorAgent:
+    """Fan-out of monitor events to subscribed listeners.
+
+    Reference: ``pkg/monitor/agent`` — listeners attach over a Unix
+    socket (``cilium-dbg monitor``); ours attach in-process. Listener
+    callbacks run synchronously in notification order; a listener that
+    raises is detached (the reference drops slow/broken consumers
+    rather than stalling the pipeline).
+    """
+
+    def __init__(self,
+                 level: AggregationLevel = AggregationLevel.MEDIUM) -> None:
+        self.level = level
+        self._lock = threading.Lock()
+        self._listeners: List[Callable[[MonitorEvent], None]] = []
+        self.lost = 0
+
+    def subscribe(self, fn: Callable[[MonitorEvent], None]) -> None:
+        with self._lock:
+            self._listeners.append(fn)
+
+    def unsubscribe(self, fn: Callable[[MonitorEvent], None]) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+
+    def notify_batch(self, flows: Sequence[Flow],
+                     outputs: Dict[str, np.ndarray]) -> List[MonitorEvent]:
+        events = events_from_outputs(flows, outputs, self.level)
+        with self._lock:
+            listeners = list(self._listeners)
+        dead = []
+        for ev in events:
+            METRICS.inc("cilium_tpu_monitor_events_total",
+                        labels={"type": ev.typ.name.lower()})
+            for fn in listeners:
+                if fn in dead:
+                    continue
+                try:
+                    fn(ev)
+                except Exception:
+                    dead.append(fn)
+                    self.lost += 1
+        for fn in dead:
+            self.unsubscribe(fn)
+        return events
+
+    def num_listeners(self) -> int:
+        with self._lock:
+            return len(self._listeners)

@@ -49,13 +49,15 @@ class LineParser(Parser):
             nl = self._buf.find(b"\n")
             if nl < 0:
                 if self._buf and end_stream:
-                    # trailing unterminated line at stream end: verdict it
-                    nl = len(self._buf) - 1
+                    # trailing unterminated line at stream end: verdict
+                    # the whole remaining buffer (no newline to strip)
+                    nl = frame_len = len(self._buf)
                 else:
                     if not end_stream:
                         ops.append((OpType.MORE, 1))
                     break
-            frame_len = nl + 1
+            else:
+                frame_len = nl + 1
             text = self._buf[:nl].decode("utf-8", "replace").rstrip("\r")
             record = GenericL7Info(proto="test.lineparser",
                                    fields={"line": text})

@@ -42,6 +42,12 @@ class Controller:
     def stop(self) -> None:
         self._stop.set()
         self._wake.set()
+        # Join so no in-flight fn() run survives stop(): an unjoined
+        # first-run checkpoint writing stale state after the caller's
+        # final synchronous checkpoint corrupts restore.
+        if (self._thread.is_alive()
+                and threading.current_thread() is not self._thread):
+            self._thread.join(timeout=30.0)
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -75,11 +81,12 @@ class ControllerManager:
                interval: float = 10.0) -> Controller:
         with self._lock:
             old = self._controllers.pop(name, None)
-            if old is not None:
-                old.stop()
-            c = Controller(name, fn, interval=interval).start()
+        if old is not None:
+            old.stop()  # outside the lock — stop() joins the thread
+        c = Controller(name, fn, interval=interval).start()
+        with self._lock:
             self._controllers[name] = c
-            return c
+        return c
 
     def remove(self, name: str) -> None:
         with self._lock:
@@ -106,6 +113,8 @@ class ControllerManager:
 
     def stop_all(self) -> None:
         with self._lock:
-            for c in self._controllers.values():
-                c.stop()
+            controllers = list(self._controllers.values())
             self._controllers.clear()
+        for c in controllers:  # join outside the lock: a slow in-flight
+            c.stop()           # fn must not block status()/trigger()
+

@@ -283,6 +283,13 @@ class VerdictService:
             if inj:
                 resp["inject_b64"] = base64.b64encode(inj).decode()
             return resp
+        if op == "bugtool":
+            if self.agent is None:
+                return {"error": "no agent attached"}
+            from cilium_tpu.bugtool import collect
+            path = collect(self.agent, req.get("out", "/tmp"),
+                           archive=bool(req.get("archive", True)))
+            return {"path": path}
         if op == "close_connection":
             with self._conn_lock:
                 self._connections.pop(int(req.get("conn", -1)), None)

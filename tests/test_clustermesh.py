@@ -1,0 +1,212 @@
+"""kvstore (pkg/kvstore analog) and clustermesh (pkg/clustermesh)
+behavior: watches, leases, cross-cluster identity/ipcache sync,
+full-mesh loop prevention, disconnect cleanup."""
+
+import json
+
+import pytest
+
+from cilium_tpu.agent import Agent
+from cilium_tpu.clustermesh import (
+    CLUSTER_LABEL_KEY, IP_PREFIX, ClusterMesh, LocalStatePublisher,
+)
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import (
+    Flow, HTTPInfo, L7Type, Protocol, TrafficDirection, Verdict,
+)
+from cilium_tpu.core.labels import SOURCE_K8S
+from cilium_tpu.kvstore import (
+    EVENT_CREATE, EVENT_DELETE, EVENT_MODIFY, KVStore,
+)
+
+
+# --------------------------------------------------------------- kvstore --
+def test_kvstore_basics():
+    kv = KVStore()
+    kv.set("a/1", "x")
+    kv.set("a/2", "y")
+    kv.set("b/1", "z")
+    assert kv.get("a/1") == "x"
+    assert kv.list_prefix("a/") == {"a/1": "x", "a/2": "y"}
+    assert kv.delete("a/1")
+    assert not kv.delete("a/1")
+    assert kv.get("a/1") is None
+    assert kv.delete_prefix("a/") == 1
+    assert len(kv) == 1
+
+
+def test_kvstore_watch_replay_then_follow():
+    kv = KVStore()
+    kv.set("pfx/old", "1")
+    events = []
+    w = kv.watch_prefix("pfx/", events.append, replay=True)
+    kv.set("pfx/new", "2")
+    kv.set("pfx/new", "3")
+    kv.set("other/x", "ignored")
+    kv.delete("pfx/old")
+    assert [(e.typ, e.key) for e in events] == [
+        (EVENT_CREATE, "pfx/old"),
+        (EVENT_CREATE, "pfx/new"),
+        (EVENT_MODIFY, "pfx/new"),
+        (EVENT_DELETE, "pfx/old"),
+    ]
+    w.stop()
+    kv.set("pfx/after", "4")
+    assert len(events) == 4  # stopped watch sees nothing
+
+
+def test_kvstore_lease_expiry():
+    kv = KVStore()
+    lease = kv.lease(ttl=60.0)
+    kv.set("leased/k", "v", lease=lease)
+    kv.set("plain/k", "v")
+    assert kv.get("leased/k") == "v"
+    lease.deadline = 0.0  # force expiry without sleeping
+    assert kv.get("leased/k") is None
+    assert kv.get("plain/k") == "v"
+    # keepalive resurrects nothing once expired
+    assert kv.list_prefix("leased/") == {}
+
+
+# ----------------------------------------------------------- clustermesh --
+def _two_agents():
+    a = Agent(Config(cluster_name="alpha")).start()
+    b = Agent(Config(cluster_name="beta")).start()
+    return a, b
+
+
+def test_remote_endpoints_become_matchable():
+    a, b = _two_agents()
+    try:
+        a.endpoint_add(1, {"app": "db"}, ipv4="10.1.0.5")
+        b.clustermesh.connect("alpha", a.kvstore)
+
+        nid = b.ipcache.lookup("10.1.0.5")
+        assert nid is not None
+        labels = b.allocator.lookup(nid)
+        assert labels.get("app", SOURCE_K8S).value == "db"
+        assert labels.get(CLUSTER_LABEL_KEY, SOURCE_K8S).value == "alpha"
+
+        # live updates propagate too (watch, not just replay)
+        a.endpoint_add(2, {"app": "cache"}, ipv4="10.1.0.6")
+        assert b.ipcache.lookup("10.1.0.6") is not None
+
+        # remote endpoint removal propagates
+        a.endpoint_remove(1)
+        assert b.ipcache.lookup("10.1.0.5") is None
+        assert b.clustermesh.status()["alpha"]["num-entries"] == 1
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_policy_selects_remote_cluster_identity():
+    """A CNP in cluster beta allows ingress only from alpha's db pods;
+    the remote identity learned via clustermesh satisfies it."""
+    a, b = _two_agents()
+    try:
+        a.endpoint_add(1, {"app": "db"}, ipv4="10.1.0.5")
+        b.endpoint_add(9, {"app": "api"}, ipv4="10.2.0.9")
+        b.clustermesh.connect("alpha", a.kvstore)
+
+        import textwrap
+        import tempfile, os
+        yaml_text = textwrap.dedent("""\
+            apiVersion: cilium.io/v2
+            kind: CiliumNetworkPolicy
+            metadata:
+              name: allow-remote-db
+            spec:
+              endpointSelector:
+                matchLabels:
+                  app: api
+              ingress:
+                - fromEndpoints:
+                    - matchLabels:
+                        app: db
+                  toPorts:
+                    - ports:
+                        - port: "5432"
+                          protocol: TCP
+            """)
+        with tempfile.NamedTemporaryFile("w", suffix=".yaml",
+                                         delete=False) as f:
+            f.write(yaml_text)
+            path = f.name
+        try:
+            b.policy_add_file(path)
+        finally:
+            os.unlink(path)
+
+        remote_id = b.ipcache.lookup("10.1.0.5")
+        local_id = b.endpoint_manager.get(9).identity
+        flows = [
+            Flow(src_identity=remote_id, dst_identity=local_id, dport=5432,
+                 protocol=Protocol.TCP, direction=TrafficDirection.INGRESS),
+            Flow(src_identity=remote_id, dst_identity=local_id, dport=80,
+                 protocol=Protocol.TCP, direction=TrafficDirection.INGRESS),
+        ]
+        out = b.loader.engine.verdict_flows(flows)["verdict"]
+        assert list(out) == [int(Verdict.FORWARDED), int(Verdict.DROPPED)]
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_full_mesh_no_echo():
+    """A↔B full mesh: remote-learned entries must NOT be re-exported
+    into the local store (no amplification loop)."""
+    a, b = _two_agents()
+    try:
+        a.endpoint_add(1, {"app": "db"}, ipv4="10.1.0.5")
+        b.endpoint_add(2, {"app": "api"}, ipv4="10.2.0.9")
+        a.clustermesh.connect("beta", b.kvstore)
+        b.clustermesh.connect("alpha", a.kvstore)
+
+        a_keys = set(a.kvstore.list_prefix(IP_PREFIX))
+        b_keys = set(b.kvstore.list_prefix(IP_PREFIX))
+        assert a_keys == {f"{IP_PREFIX}alpha/10.1.0.5/32"}
+        assert b_keys == {f"{IP_PREFIX}beta/10.2.0.9/32"}
+        # both learned each other's entry exactly once
+        assert a.ipcache.lookup("10.2.0.9") is not None
+        assert b.ipcache.lookup("10.1.0.5") is not None
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_disconnect_removes_remote_state():
+    a, b = _two_agents()
+    try:
+        a.endpoint_add(1, {"app": "db"}, ipv4="10.1.0.5")
+        b.clustermesh.connect("alpha", a.kvstore)
+        nid = b.ipcache.lookup("10.1.0.5")
+        assert nid is not None
+        b.clustermesh.disconnect("alpha")
+        assert b.ipcache.lookup("10.1.0.5") is None
+        assert b.clustermesh.status() == {}
+        # the remote identity is released, not leaked: the selector
+        # cache no longer selects it and the allocator forgot it
+        assert b.allocator.lookup(nid) is None
+        assert all(nid not in b.selector_cache.get_selections(s)
+                   for s in [])  # (no selectors registered — allocator
+        # check above is the load-bearing assertion)
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_publisher_lease_expiry_ages_out_dead_agent():
+    """If an agent stops heartbeating, its published state expires from
+    its store (the etcd-lease GC contract)."""
+    a, b = _two_agents()
+    try:
+        a.endpoint_add(1, {"app": "db"}, ipv4="10.1.0.5")
+        key = f"{IP_PREFIX}alpha/10.1.0.5/32"
+        assert a.kvstore.get(key) is not None
+        a.publisher._lease.deadline = 0.0  # simulate missed heartbeats
+        a.kvstore.expire_leases()
+        assert a.kvstore.get(key) is None
+    finally:
+        a.stop()
+        b.stop()

@@ -1,0 +1,167 @@
+"""Monitor events (pkg/monitor), Hubble Relay scatter-gather, health
+probe mesh (pkg/health), bugtool bundle."""
+
+import json
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Agent
+from cilium_tpu.bugtool import collect
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import (
+    Flow, HTTPInfo, L7Type, Protocol, TrafficDirection, Verdict,
+)
+from cilium_tpu.health import HealthChecker
+from cilium_tpu.hubble import FlowFilter, Observer, Relay
+from cilium_tpu.monitor import (
+    AggregationLevel, EventType, MonitorAgent, events_from_outputs,
+)
+
+ING = TrafficDirection.INGRESS
+
+
+def _flows(n=4):
+    return [Flow(src_identity=100 + i, dst_identity=200, dport=80,
+                 protocol=Protocol.TCP, direction=ING) for i in range(n)]
+
+
+def _outputs(verdicts, specs=None):
+    out = {"verdict": np.array(verdicts)}
+    if specs is not None:
+        out["match_spec"] = np.array(specs)
+    return out
+
+
+# --------------------------------------------------------------- monitor --
+def test_monitor_event_decode_aggregation():
+    flows = _flows(3)
+    out = _outputs([1, 2, 1], specs=[7, 9, 3])
+    # MEDIUM: verdict events always, drop for denied, no traces
+    evs = events_from_outputs(flows, out, AggregationLevel.MEDIUM)
+    assert [e.typ for e in evs] == [
+        EventType.POLICY_VERDICT, EventType.POLICY_VERDICT, EventType.DROP,
+        EventType.POLICY_VERDICT]
+    drop = [e for e in evs if e.typ == EventType.DROP][0]
+    assert drop.src_identity == 101 and drop.match_spec == 9
+    # NONE: forwarded flows additionally produce TraceNotify
+    evs = events_from_outputs(flows, out, AggregationLevel.NONE)
+    assert sum(1 for e in evs if e.typ == EventType.TRACE) == 2
+
+
+def test_monitor_agent_fanout_and_dead_listener():
+    ma = MonitorAgent(level=AggregationLevel.MEDIUM)
+    seen = []
+    ma.subscribe(seen.append)
+
+    def broken(ev):
+        raise RuntimeError("consumer crashed")
+    ma.subscribe(broken)
+
+    ma.notify_batch(_flows(2), _outputs([1, 2]))
+    assert len(seen) == 3  # 2 verdicts + 1 drop
+    assert ma.num_listeners() == 1  # broken listener detached
+    ma.notify_batch(_flows(1), _outputs([1]))
+    assert len(seen) == 4
+
+
+# ----------------------------------------------------------------- relay --
+def test_relay_merge_sorts_across_peers():
+    obs_a, obs_b = Observer(), Observer()
+    fa = _flows(2)
+    fb = _flows(2)
+    for i, f in enumerate(fa):
+        f.time = 10.0 + 2 * i      # t=10, 12
+        f.verdict = Verdict.FORWARDED
+    for i, f in enumerate(fb):
+        f.time = 11.0 + 2 * i      # t=11, 13
+        f.verdict = Verdict.DROPPED
+    obs_a.observe(fa)
+    obs_b.observe(fb)
+
+    relay = Relay()
+    relay.add_peer("node-a", obs_a)
+    relay.add_peer("node-b", obs_b)
+    got = relay.get_flows()
+    assert [name for name, _ in got] == ["node-a", "node-b",
+                                         "node-a", "node-b"]
+    assert [f.time for _, f in got] == [10.0, 11.0, 12.0, 13.0]
+
+    dropped = relay.get_flows(FlowFilter(verdict=Verdict.DROPPED))
+    assert {name for name, _ in dropped} == {"node-b"}
+
+    relay.remove_peer("node-b")
+    assert relay.peers() == ["node-a"]
+    assert len(relay.get_flows()) == 2
+
+
+def test_relay_unreachable_peer_degrades():
+    class Broken:
+        def get_flows(self, flt=None):
+            raise ConnectionError("node down")
+
+    relay = Relay()
+    obs = Observer()
+    f = _flows(1)[0]
+    f.time = 1.0
+    obs.observe([f])
+    relay.add_peer("good", obs)
+    relay.add_peer("bad", Broken())
+    got = relay.get_flows()
+    assert len(got) == 1
+    assert relay.status()["bad"]["available"] is False
+    assert relay.status()["good"]["available"] is True
+
+
+# ---------------------------------------------------------------- health --
+def test_health_failure_detection_and_recovery():
+    hc = HealthChecker(failure_threshold=2)
+    healthy = True
+
+    def probe():
+        if not healthy:
+            raise ConnectionError("unreachable")
+
+    hc.add_node("peer-1", probe)
+    hc.probe_all()
+    assert hc.status()["peer-1"].reachable
+    healthy = False
+    hc.probe_all()
+    assert hc.status()["peer-1"].reachable  # below threshold
+    hc.probe_all()
+    st = hc.status()["peer-1"]
+    assert not st.reachable and st.consecutive_failures == 2
+    assert hc.unreachable() == ["peer-1"]
+    healthy = True
+    hc.probe_all()
+    assert hc.status()["peer-1"].reachable
+    assert hc.unreachable() == []
+
+
+# ------------------------------------------------- agent flow pipeline ---
+def test_agent_process_flows_feeds_monitor_and_hubble(tmp_path):
+    agent = Agent(Config())
+    try:
+        agent.endpoint_add(1, {"app": "web"}, ipv4="10.0.0.1")
+        events = []
+        agent.monitor.subscribe(events.append)
+        flows = [Flow(src_identity=2, dst_identity=agent.endpoint_manager
+                      .get(1).identity, dport=80, protocol=Protocol.TCP,
+                      direction=ING)]
+        out = agent.process_flows(flows)
+        assert "verdict" in out
+        assert any(e.typ == EventType.POLICY_VERDICT for e in events)
+        assert len(list(agent.observer.get_flows())) == 1
+        assert flows[0].verdict in (Verdict.FORWARDED, Verdict.DROPPED)
+
+        # bugtool collects a coherent bundle over this agent
+        path = collect(agent, str(tmp_path))
+        assert path.endswith(".tar.gz")
+        with tarfile.open(path) as tar:
+            names = {os.path.basename(m.name) for m in tar.getmembers()}
+        assert {"MANIFEST.json", "status.json", "engine.json",
+                "metrics.txt", "endpoints.json"} <= names
+    finally:
+        agent.stop()

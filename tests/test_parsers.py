@@ -200,6 +200,17 @@ def test_passer_and_lineparser():
     assert conn2.on_data(False, False, b"anything") == [(OpType.PASS, 8)]
 
 
+def test_lineparser_trailing_unterminated_line():
+    """An unterminated final line at end-of-stream is verdicted on its
+    FULL text (regression: last byte was dropped from the record)."""
+    loader, ids, _ = _setup("test.lineparser", [{"line": "ok"}])
+    conn = _conn(loader, ids, "test.lineparser")
+    assert conn.on_data(False, True, b"ok") == [(OpType.PASS, 2)]
+    conn2 = _conn(loader, ids, "test.lineparser")
+    assert conn2.on_data(False, True, b"ok\nnope") == [
+        (OpType.PASS, 3), (OpType.DROP, 4)]
+
+
 def test_blockparser_framing():
     loader, ids, _ = _setup("test.blockparser", [{"prefix": "PASS"}])
     conn = _conn(loader, ids, "test.blockparser")
