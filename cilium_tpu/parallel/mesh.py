@@ -2,11 +2,39 @@
 
 from __future__ import annotations
 
+import os
+import re
 from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_cpu_host_devices(n: int) -> None:
+    """Force the CPU platform with at least ``n`` virtual devices.
+
+    The axon TPU plugin outranks ``JAX_PLATFORMS=cpu`` during platform
+    selection, and ``XLA_FLAGS`` is only read at backend init — so this
+    must run before any other JAX use in the process. Used by
+    tests/conftest.py and ``__graft_entry__.dryrun_multichip``.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(_COUNT_FLAG + r"=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = f"{flags} {_COUNT_FLAG}={n}".strip()
+    elif int(m.group(1)) < n:
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0), f"{_COUNT_FLAG}={n}")
+    jax.config.update("jax_platforms", "cpu")
+    have = len(jax.devices("cpu"))
+    if have < n:
+        raise RuntimeError(
+            f"need {n} virtual CPU devices but the JAX CPU backend "
+            f"initialized with {have}; force_cpu_host_devices must be "
+            "called before any other JAX use in the process")
 
 
 def make_mesh(

@@ -1,4 +1,4 @@
-"""Test config: force an 8-device virtual CPU mesh before jax imports.
+"""Test config: force an 8-device virtual CPU mesh before jax use.
 
 Mirrors SURVEY.md §4 ("multi-node w/o cluster"): multi-chip logic is
 tested on `--xla_force_host_platform_device_count=8` CPU devices; TPU
@@ -7,16 +7,9 @@ hardware paths are exercised by bench.py / the driver, not unit tests.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # also covers spawned subprocesses
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
-# The environment's TPU platform plugin (axon) wins over the env var, so
-# pin the platform through jax.config as well — before any test imports.
-import jax  # noqa: E402
+from cilium_tpu.parallel.mesh import force_cpu_host_devices  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu_host_devices(8)
