@@ -225,8 +225,12 @@ class ClusterMesh:
         self._clusters: Dict[str, RemoteCluster] = {}
 
     def connect(self, name: str, store: KVStore) -> RemoteCluster:
-        if name in self._clusters:
-            self.disconnect(name)
+        old = self._clusters.pop(name, None)
+        if old is not None:
+            # reconnect: tear down without firing on_change — one
+            # recompile after the new connection is live suffices, and
+            # it never sees the torn-down intermediate state
+            old.disconnect()
         rc = RemoteCluster(name, store, self._allocator, self._ipcache,
                            self._selector_cache).connect()
         self._clusters[name] = rc

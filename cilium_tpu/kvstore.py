@@ -61,8 +61,13 @@ class Watch:
         self.stopped = False
 
     def stop(self) -> None:
-        self.stopped = True
-        self._store._remove_watch(self)
+        # Taking the dispatch lock means stop() returns only after any
+        # in-flight callback delivery has finished — a caller may then
+        # tear down the state the callback feeds (clustermesh
+        # disconnect) without racing a half-delivered event.
+        with self._store._dispatch_lock:
+            self.stopped = True
+            self._store._remove_watch(self)
 
 
 class KVStore:
@@ -102,9 +107,24 @@ class KVStore:
         with self._lock:
             dead = [k for k, (_, l) in self._data.items()
                     if l is not None and l.expired(now)]
+        removed = 0
         for k in dead:
-            self.delete(k)
-        return len(dead)
+            # re-check under the commit lock: the key may have been
+            # re-set with a fresh lease (or no lease) since the scan —
+            # deleting unconditionally would drop a live entry
+            with self._dispatch_lock:
+                with self._lock:
+                    entry = self._data.get(k)
+                    if (entry is None or entry[1] is None
+                            or not entry[1].expired()):
+                        continue
+                    self._data.pop(k)
+                    self._revision += 1
+                    ev = Event(EVENT_DELETE, k, entry[0])
+                    watches = list(self._watches)
+                self._dispatch(watches, ev)
+            removed += 1
+        return removed
 
     # -- kv --------------------------------------------------------------
     def set(self, key: str, value: str, lease: Optional[Lease] = None) -> None:
@@ -163,11 +183,15 @@ class KVStore:
         """Subscribe to events under `prefix`. With `replay`, current
         keys are delivered first as CREATE events (the reference's
         ListAndWatch contract) before any live event."""
-        w = Watch(self, prefix, callback)
+        self.expire_leases()  # dead-agent keys must not replay: no
+        w = Watch(self, prefix, callback)  # DELETE would ever follow
         with self._dispatch_lock:
             with self._lock:
-                snapshot = [(k, v) for k, (v, _) in self._data.items()
-                            if k.startswith(prefix)] if replay else []
+                now = time.monotonic()
+                snapshot = [(k, v) for k, (v, l) in self._data.items()
+                            if k.startswith(prefix)
+                            and (l is None or not l.expired(now))
+                            ] if replay else []
                 self._watches.append(w)
             # any set() that committed before registration is in the
             # snapshot; any later one blocks on the dispatch lock until

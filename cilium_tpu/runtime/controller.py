@@ -76,23 +76,43 @@ class ControllerManager:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._controllers: Dict[str, Controller] = {}
+        # Per-name locks serialize update/remove for one controller
+        # name without making one name's slow stop() (thread join)
+        # block every other name — while stop()/status() stay on the
+        # cheap manager lock.
+        self._name_locks: Dict[str, threading.Lock] = {}
+        self._closed = False
+
+    def _name_lock(self, name: str) -> threading.Lock:
+        with self._lock:
+            lk = self._name_locks.get(name)
+            if lk is None:
+                lk = self._name_locks[name] = threading.Lock()
+            return lk
 
     def update(self, name: str, fn: Callable[[], None],
                interval: float = 10.0) -> Controller:
-        with self._lock:
-            old = self._controllers.pop(name, None)
-        if old is not None:
-            old.stop()  # outside the lock — stop() joins the thread
-        c = Controller(name, fn, interval=interval).start()
-        with self._lock:
-            self._controllers[name] = c
+        with self._name_lock(name):
+            with self._lock:
+                old = self._controllers.pop(name, None)
+            if old is not None:
+                old.stop()  # joins the thread; only this name waits
+            c = Controller(name, fn, interval=interval).start()
+            with self._lock:
+                if not self._closed:
+                    self._controllers[name] = c
+                    return c
+        # stop_all() ran while we were starting: don't leak a running
+        # thread that the (now cleared) manager can never stop again
+        c.stop()
         return c
 
     def remove(self, name: str) -> None:
-        with self._lock:
-            c = self._controllers.pop(name, None)
-        if c is not None:
-            c.stop()
+        with self._name_lock(name):
+            with self._lock:
+                c = self._controllers.pop(name, None)
+            if c is not None:
+                c.stop()
 
     def trigger(self, name: str) -> None:
         with self._lock:
@@ -112,9 +132,18 @@ class ControllerManager:
             }
 
     def stop_all(self) -> None:
+        """Stop every controller. An update() racing this call has its
+        controller stopped instead of leaking an unstoppable thread;
+        updates after stop_all() returns register normally (the agent
+        is restartable)."""
         with self._lock:
+            self._closed = True
             controllers = list(self._controllers.values())
             self._controllers.clear()
-        for c in controllers:  # join outside the lock: a slow in-flight
-            c.stop()           # fn must not block status()/trigger()
+        try:
+            for c in controllers:  # join outside the lock: a slow
+                c.stop()           # in-flight fn must not block status()
+        finally:
+            with self._lock:
+                self._closed = False
 

@@ -210,3 +210,66 @@ def test_publisher_lease_expiry_ages_out_dead_agent():
     finally:
         a.stop()
         b.stop()
+
+
+def test_watch_replay_skips_expired_lease_keys():
+    """Replay must not deliver keys whose lease already expired: the
+    (dead) owner is the only party that would ever delete them, so a
+    late subscriber would import dead-agent state forever."""
+    kv = KVStore()
+    lease = kv.lease(ttl=60.0)
+    kv.set("pfx/dead", "v", lease=lease)
+    kv.set("pfx/live", "v")
+    lease.deadline = 0.0  # owner stopped heartbeating
+    events = []
+    kv.watch_prefix("pfx/", events.append, replay=True)
+    assert [(e.typ, e.key) for e in events] == [(EVENT_CREATE, "pfx/live")]
+    # and the expired key was actually dropped, not just hidden
+    assert "pfx/dead" not in list(kv)
+
+
+def test_expire_leases_respects_reset_key():
+    """A key re-set with a fresh (or no) lease after the expiry scan
+    must survive expire_leases()."""
+    kv = KVStore()
+    lease = kv.lease(ttl=60.0)
+    kv.set("k", "old", lease=lease)
+    lease.deadline = 0.0
+    kv.set("k", "new")  # re-set without a lease before expiry runs
+    assert kv.expire_leases() == 0
+    assert kv.get("k") == "new"
+
+
+def test_reconnect_fires_on_change_once():
+    calls = []
+    kv = KVStore()
+    from cilium_tpu.core.identity import IdentityAllocator
+    from cilium_tpu.ipcache import IPCache
+
+    alloc = IdentityAllocator()
+    mesh = ClusterMesh(alloc, IPCache(alloc),
+                       on_change=lambda: calls.append(1))
+    mesh.connect("alpha", kv)
+    assert len(calls) == 1
+    mesh.connect("alpha", kv)  # reconnect: teardown+connect, ONE event
+    assert len(calls) == 2
+    mesh.disconnect("alpha")
+    assert len(calls) == 3
+
+
+def test_controller_manager_restartable_after_stop_all():
+    from cilium_tpu.runtime.controller import ControllerManager
+
+    mgr = ControllerManager()
+    ran = []
+    mgr.update("t", lambda: ran.append(1), interval=3600.0)
+    mgr.stop_all()
+    assert mgr.status() == {}
+    before = len(ran)
+    mgr.update("t", lambda: ran.append(2), interval=3600.0)
+    import time as _time
+    deadline = _time.time() + 5.0
+    while len(ran) == before and _time.time() < deadline:
+        _time.sleep(0.01)
+    assert len(ran) > before  # re-registered controller actually runs
+    mgr.stop_all()
