@@ -24,7 +24,9 @@ from cilium_tpu.endpoint import EndpointManager
 from cilium_tpu.fqdn import DNSCache, DNSProxy, NameManager
 from cilium_tpu.health import HealthChecker
 from cilium_tpu.hubble import FlowMetrics, Observer, annotate_flows
+from cilium_tpu.ipam import NodeAllocator
 from cilium_tpu.ipcache import IPCache
+from cilium_tpu.loadbalancer import ServiceManager
 from cilium_tpu.monitor import MonitorAgent
 from cilium_tpu.policy.api import CiliumNetworkPolicy, load_cnp_yaml
 from cilium_tpu.policy.repository import Repository
@@ -68,6 +70,11 @@ class Agent:
         self.observer = Observer(handlers=[FlowMetrics()])
         # health probe mesh (§5.3); peers registered via health.add_node
         self.health = HealthChecker(node_name=self.config.cluster_name)
+        # IPAM (§2.4, cluster-pool mode): endpoint IPs come from this
+        # node's podCIDR when the caller doesn't pin one
+        self.ipam = NodeAllocator(self.config.pod_cidr)
+        # services / kube-proxy replacement (§2.4): Maglev selection
+        self.services = ServiceManager()
         self.controllers = ControllerManager()
         self.service: Optional[VerdictService] = None
         self.socket_path = socket_path
@@ -85,6 +92,10 @@ class Agent:
             for ep in self.endpoint_manager.endpoints():
                 if ep.ipv4:
                     self.ipcache.upsert(f"{ep.ipv4}/32", ep.identity)
+                    try:  # IPAM re-adopts restored addresses (§5.4)
+                        self.ipam.allocate_ip(ep.ipv4)
+                    except Exception:
+                        pass
         if self.state_dir:
             dns_path = os.path.join(self.state_dir, "dnscache.json")
             if os.path.exists(dns_path):
@@ -172,16 +183,31 @@ class Agent:
     # -- endpoint API -----------------------------------------------------
     def endpoint_add(self, endpoint_id: int, labels: Dict[str, str],
                      ipv4: str = ""):
+        old = self.endpoint_manager.get(endpoint_id)
+        if old is not None and old.ipv4:
+            if not ipv4:
+                ipv4 = old.ipv4  # re-add (CNI ADD retry) keeps the IP
+            elif old.ipv4 != ipv4:
+                self.ipcache.delete(f"{old.ipv4}/32")
+                self.ipam.release(old.ipv4)
+        if not ipv4:
+            ipv4 = self.ipam.allocate()
+        elif old is None or old.ipv4 != ipv4:
+            try:
+                self.ipam.allocate_ip(ipv4)
+            except ValueError:
+                pass  # out-of-pool pin is fine; an in-pool duplicate
+                      # (PoolExhausted) must raise, not silently share
         ep = self.endpoint_manager.add_endpoint(
             endpoint_id, LabelSet.from_dict(labels), ipv4=ipv4)
-        if ipv4:
-            self.ipcache.upsert(f"{ipv4}/32", ep.identity)
+        self.ipcache.upsert(f"{ipv4}/32", ep.identity)
         return ep
 
     def endpoint_remove(self, endpoint_id: int) -> None:
         ep = self.endpoint_manager.get(endpoint_id)
         if ep is not None and ep.ipv4:
             self.ipcache.delete(f"{ep.ipv4}/32")
+            self.ipam.release(ep.ipv4)
         self.endpoint_manager.remove_endpoint(endpoint_id)
 
     # -- flow pipeline (engine → monitor → hubble, §3.6) -----------------
@@ -218,4 +244,7 @@ class Agent:
             "clustermesh": self.clustermesh.status(),
             "health": {n: s.reachable
                        for n, s in self.health.status().items()},
+            "ipam": {"cidr": str(self.ipam.cidr),
+                     "available": self.ipam.available},
+            "services": len(self.services.list()),
         }

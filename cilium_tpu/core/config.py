@@ -70,6 +70,7 @@ class Config:
 
     enable_tpu_offload: bool = False   # master feature gate (north star)
     cluster_name: str = "default"      # clustermesh local cluster name
+    pod_cidr: str = "10.0.0.0/24"      # this node's IPAM podCIDR
     engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
     loader: LoaderConfig = dataclasses.field(default_factory=LoaderConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)

@@ -1,0 +1,18 @@
+"""Load balancing: services, Maglev consistent hashing, batched
+backend-selection kernel (reference: ``pkg/loadbalancer``,
+``pkg/service``, ``pkg/maglev`` — SURVEY.md §2.4)."""
+
+from cilium_tpu.loadbalancer.kernel import lb_lookup
+from cilium_tpu.loadbalancer.maglev import (
+    DEFAULT_TABLE_SIZE, disruption, fnv1a, fnv1a_words, maglev_table,
+)
+from cilium_tpu.loadbalancer.service import (
+    Backend, BackendState, Frontend, PackedLB, Service, ServiceManager,
+    ServiceType,
+)
+
+__all__ = [
+    "Backend", "BackendState", "DEFAULT_TABLE_SIZE", "Frontend",
+    "PackedLB", "Service", "ServiceManager", "ServiceType",
+    "disruption", "fnv1a", "fnv1a_words", "lb_lookup", "maglev_table",
+]

@@ -1,0 +1,95 @@
+"""Batched backend selection on device.
+
+TPU analog of ``bpf/lib/lb.h ·lb4_lookup_service`` +
+``lb4_select_backend`` (SURVEY.md §2.4): the per-packet lbmap hash
+lookups become one batched binary search over the sorted service keys,
+an FNV-1a 5-tuple hash, and a gather from the stacked Maglev slab —
+all fused by XLA into a few gathers per batch.
+
+The hash recurrence must stay in lockstep with
+``loadbalancer.maglev.fnv1a_words`` (the scalar oracle hashes the same
+uint32 words); the differential test drives both on random flows.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_FNV_PRIME = 0x01000193
+_FNV_BASIS = 0x811C9DC5
+
+
+def _fnv1a_words(words) -> jax.Array:
+    """FNV-1a over a list of [B] uint32 arrays (one symbol per word)."""
+    h = jnp.full_like(words[0], _FNV_BASIS)
+    for w in words:
+        h = (h ^ w) * jnp.uint32(_FNV_PRIME)
+    return h
+
+
+def _lower_bound2(k0: jax.Array, k1: jax.Array,
+                  p0: jax.Array, p1: jax.Array):
+    """Vectorized lower bound over 2-word sorted uint32 keys."""
+    N = k0.shape[0]
+    iters = max(1, int(N).bit_length())
+    lo = jnp.zeros(p0.shape, dtype=jnp.int32)
+    hi = jnp.full(p0.shape, N, dtype=jnp.int32)
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = (lo + hi) >> 1
+        m0, m1 = k0[mid], k1[mid]
+        ge = (m0 > p0) | ((m0 == p0) & (m1 >= p1))
+        return jnp.where(ge, lo, mid + 1), jnp.where(ge, mid, hi)
+
+    lo, hi = lax.fori_loop(0, iters, body, (lo, hi))
+    idx = jnp.clip(lo, 0, N - 1)
+    found = (lo < N) & (k0[idx] == p0) & (k1[idx] == p1)
+    return idx, found
+
+
+def lb_lookup(
+    svc_ip: jax.Array,        # [S] uint32, sorted with svc_l4
+    svc_l4: jax.Array,        # [S] uint32
+    svc_affinity: jax.Array,  # [S] bool
+    tables: jax.Array,        # [S, M] int32
+    backend_ip: jax.Array,    # [G] uint32
+    backend_port: jax.Array,  # [G] int32
+    src_ips: jax.Array,       # [B] uint32
+    src_ports: jax.Array,     # [B] int32
+    dst_ips: jax.Array,       # [B] uint32
+    dst_ports: jax.Array,     # [B] int32
+    protos: jax.Array,        # [B] int32
+) -> Dict[str, jax.Array]:
+    """Returns ``backend`` [B] int32 global backend id (-1 = no service
+    or empty backend set), plus translated ``ip``/``port`` (0 when
+    unmatched) — the DNAT the datapath would apply."""
+    p0 = dst_ips.astype(jnp.uint32)
+    p1 = ((protos.astype(jnp.uint32) << 16)
+          | dst_ports.astype(jnp.uint32))
+    idx, found = _lower_bound2(svc_ip, svc_l4, p0, p1)
+
+    affinity = svc_affinity[idx]
+    src = src_ips.astype(jnp.uint32)
+    zero = jnp.zeros_like(src)
+    h = jnp.where(
+        affinity,  # ClientIP affinity hashes the source address only
+        _fnv1a_words([src, zero, zero, zero, zero]),
+        _fnv1a_words([src, src_ports.astype(jnp.uint32), p0,
+                      dst_ports.astype(jnp.uint32),
+                      protos.astype(jnp.uint32)]),
+    )
+    m = tables.shape[1]
+    slot = (h % jnp.uint32(m)).astype(jnp.int32)
+    backend = jnp.where(found, tables[idx, slot], -1)
+    valid = backend >= 0
+    bidx = jnp.clip(backend, 0, backend_ip.shape[0] - 1)
+    return {
+        "backend": backend,
+        "ip": jnp.where(valid, backend_ip[bidx], 0),
+        "port": jnp.where(valid, backend_port[bidx], 0),
+    }

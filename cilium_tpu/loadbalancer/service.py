@@ -1,0 +1,225 @@
+"""Services and backends: the kube-proxy-replacement model.
+
+Reference: ``pkg/service`` + ``pkg/loadbalancer`` (SURVEY.md §2.4) —
+frontends (VIP:port/proto) map to weighted backend sets with a service
+type (ClusterIP/NodePort/LoadBalancer), optional ClientIP session
+affinity, and consistent (Maglev) backend selection mirrored into the
+BPF lbmap. Ours keeps the same model host-side; the datapath mirror is
+``pack()`` → tensors for the batched JAX kernel
+(``loadbalancer.kernel.lb_lookup``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import ipaddress
+import threading
+from enum import IntEnum
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from cilium_tpu.loadbalancer.maglev import (
+    DEFAULT_TABLE_SIZE, fnv1a_words, maglev_table,
+)
+from cilium_tpu.runtime.metrics import METRICS
+
+
+class ServiceType(IntEnum):
+    CLUSTER_IP = 0
+    NODE_PORT = 1
+    LOAD_BALANCER = 2
+
+
+class BackendState(IntEnum):
+    """Reference: ``lb.BackendState`` — terminating/quarantined backends
+    stay registered but leave the selection table."""
+
+    ACTIVE = 0
+    TERMINATING = 1
+    QUARANTINED = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class Backend:
+    ip: str
+    port: int
+    weight: int = 1
+    state: BackendState = BackendState.ACTIVE
+
+    @property
+    def name(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Frontend:
+    ip: str
+    port: int
+    proto: int = 6  # TCP
+
+    @property
+    def name(self) -> str:
+        return f"{self.ip}:{self.port}/{self.proto}"
+
+
+@dataclasses.dataclass
+class Service:
+    frontend: Frontend
+    backends: List[Backend]
+    svc_type: ServiceType = ServiceType.CLUSTER_IP
+    #: ClientIP session affinity: selection hashes the source IP only,
+    #: so one client sticks to one backend across connections.
+    affinity: bool = False
+
+    def active_backends(self) -> List[Backend]:
+        return [b for b in self.backends if b.state == BackendState.ACTIVE]
+
+
+def _ip_u32(ip: str) -> int:
+    return int(ipaddress.IPv4Address(ip))
+
+
+@dataclasses.dataclass
+class PackedLB:
+    """Host-side tensors for the batched kernel (loader stages them).
+
+    Services sorted by (frontend ip, proto<<16|port) for binary search;
+    ``tables`` stacks every service's Maglev table; ``backend_*`` are
+    indexed by the global backend ids the tables store.
+    """
+
+    svc_ip: np.ndarray        # [S] uint32 frontend IPv4
+    svc_l4: np.ndarray        # [S] uint32 (proto << 16) | port
+    svc_affinity: np.ndarray  # [S] bool
+    tables: np.ndarray        # [S, M] int32 global backend id, -1 empty
+    backend_ip: np.ndarray    # [G] uint32 backend IPv4
+    backend_port: np.ndarray  # [G] int32
+    revision: int = 0
+
+    @property
+    def n_services(self) -> int:
+        return len(self.svc_ip)
+
+
+class ServiceManager:
+    """Service table with Maglev selection (``pkg/service ·Service``).
+
+    Thread-safe. ``pack()`` snapshots the whole table into tensors; the
+    scalar ``select()`` is the oracle the kernel is differentially
+    tested against (same FNV-1a word hash, same tables).
+    """
+
+    def __init__(self, table_size: int = DEFAULT_TABLE_SIZE) -> None:
+        self._lock = threading.Lock()
+        self._services: Dict[Frontend, Service] = {}
+        self._tables: Dict[Frontend, np.ndarray] = {}
+        self._revision = 0
+        self.table_size = table_size
+
+    # -- mutation ---------------------------------------------------------
+    def upsert(self, svc: Service) -> None:
+        active = svc.active_backends()
+        table = maglev_table(
+            list(range(len(active))),
+            [b.name for b in active],
+            m=self.table_size,
+            weights=[b.weight for b in active],
+        )
+        with self._lock:
+            self._services[svc.frontend] = svc
+            self._tables[svc.frontend] = table
+            self._revision += 1
+        METRICS.set_gauge("cilium_tpu_lb_services", float(len(self._services)))
+
+    def delete(self, frontend: Frontend) -> bool:
+        with self._lock:
+            existed = self._services.pop(frontend, None) is not None
+            self._tables.pop(frontend, None)
+            if existed:
+                self._revision += 1
+        METRICS.set_gauge("cilium_tpu_lb_services", float(len(self._services)))
+        return existed
+
+    def get(self, frontend: Frontend) -> Optional[Service]:
+        with self._lock:
+            return self._services.get(frontend)
+
+    def list(self) -> List[Service]:
+        with self._lock:
+            return list(self._services.values())
+
+    @property
+    def revision(self) -> int:
+        with self._lock:
+            return self._revision
+
+    # -- selection (scalar oracle) ----------------------------------------
+    def select(self, src_ip: str, src_port: int, dst_ip: str,
+               dst_port: int, proto: int = 6) -> Optional[Backend]:
+        """Pick the backend for one flow; None if no service matches."""
+        fe = Frontend(dst_ip, dst_port, proto)
+        with self._lock:
+            svc = self._services.get(fe)
+            table = self._tables.get(fe)
+        if svc is None or table is None:
+            return None
+        active = svc.active_backends()
+        if not active:
+            return None
+        words = self._hash_words(
+            _ip_u32(src_ip), src_port, _ip_u32(dst_ip), dst_port, proto,
+            affinity=svc.affinity)
+        h = int(fnv1a_words(np.asarray(words, dtype=np.uint32)))
+        bi = int(table[h % len(table)])
+        if bi < 0:  # empty table (e.g. all backends weight 0)
+            return None
+        return active[bi]
+
+    @staticmethod
+    def _hash_words(src_ip: int, src_port: int, dst_ip: int,
+                    dst_port: int, proto: int,
+                    affinity: bool) -> Tuple[int, ...]:
+        if affinity:  # ClientIP affinity: source address only
+            return (src_ip, 0, 0, 0, 0)
+        return (src_ip, src_port, dst_ip, dst_port, proto)
+
+    # -- datapath mirror ---------------------------------------------------
+    def pack(self) -> PackedLB:
+        with self._lock:
+            items = sorted(
+                self._services.items(),
+                key=lambda kv: (_ip_u32(kv[0].ip),
+                                (kv[0].proto << 16) | kv[0].port))
+            tables = {fe: t for fe, t in self._tables.items()}
+            revision = self._revision
+        backend_ip: List[int] = []
+        backend_port: List[int] = []
+        svc_rows = []
+        slab = []
+        for fe, svc in items:
+            active = svc.active_backends()
+            base = len(backend_ip)
+            backend_ip.extend(_ip_u32(b.ip) for b in active)
+            backend_port.extend(b.port for b in active)
+            t = tables[fe]
+            slab.append(np.where(t >= 0, t + base, -1).astype(np.int32))
+            svc_rows.append((_ip_u32(fe.ip), (fe.proto << 16) | fe.port,
+                             svc.affinity))
+        if not svc_rows:
+            # sentinel that can never match: l4 word 0xFFFFFFFF is
+            # unreachable (real probes have proto<<16|port < 2**24)
+            svc_rows.append((0xFFFFFFFF, 0xFFFFFFFF, False))
+            slab.append(np.full(self.table_size, -1, dtype=np.int32))
+        if not backend_ip:
+            backend_ip.append(0)
+            backend_port.append(0)
+        return PackedLB(
+            svc_ip=np.array([r[0] for r in svc_rows], dtype=np.uint32),
+            svc_l4=np.array([r[1] for r in svc_rows], dtype=np.uint32),
+            svc_affinity=np.array([r[2] for r in svc_rows], dtype=bool),
+            tables=np.stack(slab),
+            backend_ip=np.array(backend_ip, dtype=np.uint32),
+            backend_port=np.array(backend_port, dtype=np.int32),
+            revision=revision,
+        )

@@ -82,6 +82,10 @@ class ControllerManager:
         # cheap manager lock.
         self._name_locks: Dict[str, threading.Lock] = {}
         self._closed = False
+        # bumped at the START of every stop_all: an update() that began
+        # before the bump (and so may have been missed by stop_all's
+        # snapshot) sees the change and stops its own controller
+        self._gen = 0
 
     def _name_lock(self, name: str) -> threading.Lock:
         with self._lock:
@@ -94,16 +98,18 @@ class ControllerManager:
                interval: float = 10.0) -> Controller:
         with self._name_lock(name):
             with self._lock:
+                gen = self._gen
                 old = self._controllers.pop(name, None)
             if old is not None:
                 old.stop()  # joins the thread; only this name waits
             c = Controller(name, fn, interval=interval).start()
             with self._lock:
-                if not self._closed:
+                if not self._closed and self._gen == gen:
                     self._controllers[name] = c
                     return c
-        # stop_all() ran while we were starting: don't leak a running
-        # thread that the (now cleared) manager can never stop again
+        # stop_all() started or ran while we were in flight: our pop
+        # may have hidden the old controller from its snapshot, so
+        # honor the stop ourselves instead of leaking a running thread
         c.stop()
         return c
 
@@ -138,6 +144,7 @@ class ControllerManager:
         is restartable)."""
         with self._lock:
             self._closed = True
+            self._gen += 1
             controllers = list(self._controllers.values())
             self._controllers.clear()
         try:

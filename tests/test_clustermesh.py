@@ -273,3 +273,43 @@ def test_controller_manager_restartable_after_stop_all():
         _time.sleep(0.01)
     assert len(ran) > before  # re-registered controller actually runs
     mgr.stop_all()
+
+
+def test_update_racing_stop_all_does_not_leak_controller():
+    """An update() whose old.stop() join spans an entire stop_all()
+    must not register a surviving controller afterwards."""
+    import threading
+    import time as _time
+
+    from cilium_tpu.runtime.controller import ControllerManager
+
+    mgr = ControllerManager()
+    release_old = threading.Event()
+    old_running = threading.Event()
+
+    def old_fn():
+        old_running.set()
+        release_old.wait(timeout=10.0)
+
+    mgr.update("x", old_fn, interval=3600.0)
+    assert old_running.wait(timeout=5.0)
+
+    new_controller = []
+
+    def do_update():
+        new_controller.append(
+            mgr.update("x", lambda: None, interval=3600.0))
+
+    t = threading.Thread(target=do_update)
+    t.start()
+    # wait until the update thread popped "x" and is joining old_fn
+    deadline = _time.time() + 5.0
+    while "x" in mgr.status() and _time.time() < deadline:
+        _time.sleep(0.005)
+    mgr.stop_all()          # snapshot misses "x" (already popped)
+    release_old.set()       # let the in-flight update finish
+    t.join(timeout=10.0)
+    assert not t.is_alive()
+    assert mgr.status() == {}  # nothing registered after stop_all
+    # and the controller the update created is stopped, not running
+    assert new_controller[0]._stop.is_set()

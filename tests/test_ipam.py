@@ -1,0 +1,99 @@
+"""IPAM (pkg/ipam analog, cluster-pool mode): node CIDR carving,
+per-node allocation, restore re-adoption, agent wiring."""
+
+import pytest
+
+from cilium_tpu.agent import Agent
+from cilium_tpu.core.config import Config
+from cilium_tpu.ipam import ClusterPool, NodeAllocator, PoolExhausted
+
+
+def test_cluster_pool_carves_disjoint_node_cidrs():
+    pool = ClusterPool("10.128.0.0/16", node_mask_size=24)
+    cidrs = {pool.allocate_node_cidr(f"node{i}") for i in range(10)}
+    assert len(cidrs) == 10
+    # idempotent per node
+    assert pool.allocate_node_cidr("node0") in cidrs
+    pool.release_node_cidr("node0")
+    assert pool.allocate_node_cidr("node-new") == "10.128.0.0/24"
+
+
+def test_cluster_pool_exhaustion():
+    pool = ClusterPool("10.0.0.0/30", node_mask_size=31)
+    pool.allocate_node_cidr("a")
+    pool.allocate_node_cidr("b")
+    with pytest.raises(PoolExhausted):
+        pool.allocate_node_cidr("c")
+
+
+def test_node_allocator_skips_network_and_broadcast():
+    alloc = NodeAllocator("10.0.0.0/29")  # 8 addrs, 6 usable
+    got = {alloc.allocate() for _ in range(6)}
+    assert "10.0.0.0" not in got and "10.0.0.7" not in got
+    with pytest.raises(PoolExhausted):
+        alloc.allocate()
+    assert alloc.release("10.0.0.3")
+    assert not alloc.release("10.0.0.3")  # double release
+    assert alloc.allocate() == "10.0.0.3"
+
+
+def test_node_allocator_restore_readopt():
+    alloc = NodeAllocator("10.0.0.0/24")
+    assert alloc.allocate_ip("10.0.0.9") == "10.0.0.9"
+    with pytest.raises(PoolExhausted):
+        alloc.allocate_ip("10.0.0.9")
+    with pytest.raises(ValueError):
+        alloc.allocate_ip("192.168.0.1")
+    # fresh allocations never hand out the re-adopted address
+    for _ in range(100):
+        assert alloc.allocate() != "10.0.0.9"
+
+
+def test_agent_allocates_endpoint_ip_from_pod_cidr():
+    a = Agent(Config(pod_cidr="10.7.0.0/24")).start()
+    try:
+        ep = a.endpoint_add(1, {"app": "web"})  # no IP pinned
+        assert ep.ipv4.startswith("10.7.0.")
+        assert a.ipcache.lookup(ep.ipv4) == ep.identity
+        ep2 = a.endpoint_add(2, {"app": "db"})
+        assert ep2.ipv4 != ep.ipv4
+        a.endpoint_remove(1)
+        assert a.ipcache.lookup(ep.ipv4) is None
+        assert a.status()["ipam"]["available"] == 253
+    finally:
+        a.stop()
+
+
+def test_duplicate_pinned_ip_rejected():
+    a = Agent(Config(pod_cidr="10.7.0.0/24")).start()
+    try:
+        a.endpoint_add(1, {"app": "web"}, ipv4="10.7.0.5")
+        with pytest.raises(PoolExhausted):
+            a.endpoint_add(2, {"app": "db"}, ipv4="10.7.0.5")
+    finally:
+        a.stop()
+
+
+def test_endpoint_readd_reuses_ip_no_leak():
+    a = Agent(Config(pod_cidr="10.7.0.0/24")).start()
+    try:
+        ep1 = a.endpoint_add(1, {"app": "web"})
+        ep2 = a.endpoint_add(1, {"app": "web"})  # CNI ADD retry
+        assert ep2.ipv4 == ep1.ipv4
+        a.endpoint_remove(1)
+        assert a.status()["ipam"]["available"] == 254  # nothing leaked
+        assert a.ipcache.lookup(ep1.ipv4) is None
+    finally:
+        a.stop()
+
+
+def test_endpoint_readd_with_new_pin_releases_old_ip():
+    a = Agent(Config(pod_cidr="10.7.0.0/24")).start()
+    try:
+        a.endpoint_add(1, {"app": "web"}, ipv4="10.7.0.5")
+        ep = a.endpoint_add(1, {"app": "web"}, ipv4="10.7.0.6")
+        assert ep.ipv4 == "10.7.0.6"
+        assert a.ipcache.lookup("10.7.0.5") is None
+        a.endpoint_add(2, {"app": "db"}, ipv4="10.7.0.5")  # freed
+    finally:
+        a.stop()
