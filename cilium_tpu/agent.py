@@ -184,20 +184,24 @@ class Agent:
     def endpoint_add(self, endpoint_id: int, labels: Dict[str, str],
                      ipv4: str = ""):
         old = self.endpoint_manager.get(endpoint_id)
-        if old is not None and old.ipv4:
+        if old is not None and old.ipv4 and not ipv4:
+            ipv4 = old.ipv4  # re-add (CNI ADD retry) keeps the IP
+        if old is not None and old.ipv4 and old.ipv4 == ipv4:
+            pass  # unchanged — nothing to allocate or release
+        else:
+            # acquire the new address FIRST: if it is unavailable the
+            # old pin must stay intact (no torn release-then-fail)
             if not ipv4:
-                ipv4 = old.ipv4  # re-add (CNI ADD retry) keeps the IP
-            elif old.ipv4 != ipv4:
+                ipv4 = self.ipam.allocate()
+            else:
+                try:
+                    self.ipam.allocate_ip(ipv4)
+                except ValueError:
+                    pass  # out-of-pool pin is fine; an in-pool duplicate
+                          # (PoolExhausted) must raise, not silently share
+            if old is not None and old.ipv4:
                 self.ipcache.delete(f"{old.ipv4}/32")
                 self.ipam.release(old.ipv4)
-        if not ipv4:
-            ipv4 = self.ipam.allocate()
-        elif old is None or old.ipv4 != ipv4:
-            try:
-                self.ipam.allocate_ip(ipv4)
-            except ValueError:
-                pass  # out-of-pool pin is fine; an in-pool duplicate
-                      # (PoolExhausted) must raise, not silently share
         ep = self.endpoint_manager.add_endpoint(
             endpoint_id, LabelSet.from_dict(labels), ipv4=ipv4)
         self.ipcache.upsert(f"{ipv4}/32", ep.identity)

@@ -32,9 +32,9 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
 from cilium_tpu.core.flow import TrafficDirection
+from cilium_tpu.engine.search import lower_bound
 from cilium_tpu.policy.mapstate import MapState, MapStateKey, MapStateEntry
 
 
@@ -111,28 +111,8 @@ def _lower_bound3(
     k0: jax.Array, k1: jax.Array, k2: jax.Array,
     p0: jax.Array, p1: jax.Array, p2: jax.Array,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Vectorized lower-bound binary search over 3-word sorted keys.
-    Returns (index, found). All probes share the key arrays."""
-    N = k0.shape[0]
-    iters = max(1, int(N).bit_length())
-    lo = jnp.zeros_like(p0)
-    hi = jnp.full_like(p0, N)
-
-    def body(_, lohi):
-        lo, hi = lohi
-        mid = (lo + hi) >> 1
-        m0, m1, m2 = k0[mid], k1[mid], k2[mid]
-        ge = (
-            (m0 > p0)
-            | ((m0 == p0) & (m1 > p1))
-            | ((m0 == p0) & (m1 == p1) & (m2 >= p2))
-        )
-        return jnp.where(ge, lo, mid + 1), jnp.where(ge, mid, hi)
-
-    lo, hi = lax.fori_loop(0, iters, body, (lo, hi))
-    idx = jnp.clip(lo, 0, N - 1)
-    found = (lo < N) & (k0[idx] == p0) & (k1[idx] == p1) & (k2[idx] == p2)
-    return idx, found
+    """Lower bound over 3-word sorted keys (shared engine/search.py)."""
+    return lower_bound((k0, k1, k2), (p0, p1, p2))
 
 
 # probe order: descending specificity. bit2=peer bit1=port bit0=proto

@@ -1,0 +1,51 @@
+"""Vectorized lower-bound binary search over multi-word sorted keys.
+
+The TPU replacement for the datapath's O(1) hash-map lookups
+(``bpf/lib/policy.h`` / ``lb.h``): hashing is branch-heavy and
+pointer-chasing on a TPU, while a fori_loop binary search over sorted
+key columns is a handful of gathers — shared by the MapState lookup
+(3-word keys) and the load-balancer service lookup (2-word keys).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def lower_bound(
+    keys: Sequence[jax.Array],    # each [N], jointly lexsorted
+    probes: Sequence[jax.Array],  # each [B] (broadcastable shapes)
+) -> Tuple[jax.Array, jax.Array]:
+    """Lexicographic lower bound of each probe tuple in the key table.
+
+    Returns ``(index [B] int32 clipped to [0, N-1], found [B] bool)``
+    where ``found`` marks exact matches.
+    """
+    if len(keys) != len(probes) or not keys:
+        raise ValueError("keys and probes must be equal-length, non-empty")
+    N = keys[0].shape[0]
+    iters = max(1, int(N).bit_length())
+    shape = jnp.broadcast_shapes(*(p.shape for p in probes))
+    lo = jnp.zeros(shape, dtype=jnp.int32)
+    hi = jnp.full(shape, N, dtype=jnp.int32)
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = (lo + hi) >> 1
+        # mid-key >= probe, lexicographically (built innermost-out)
+        ge = keys[-1][mid] >= probes[-1]
+        for k, p in zip(reversed(keys[:-1]), reversed(probes[:-1])):
+            m = k[mid]
+            ge = (m > p) | ((m == p) & ge)
+        return jnp.where(ge, lo, mid + 1), jnp.where(ge, mid, hi)
+
+    lo, hi = lax.fori_loop(0, iters, body, (lo, hi))
+    idx = jnp.clip(lo, 0, N - 1)
+    found = lo < N
+    for k, p in zip(keys, probes):
+        found = found & (k[idx] == p)
+    return idx, found

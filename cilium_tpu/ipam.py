@@ -34,16 +34,27 @@ class ClusterPool:
         self._lock = threading.Lock()
         self._by_node: Dict[str, ipaddress.IPv4Network] = {}
         self._used: Set[ipaddress.IPv4Network] = set()
+        # sequential cursor over subnet indices: avoids rescanning the
+        # whole pool enumeration per allocation (same pattern as
+        # NodeAllocator._cursor); wraps to reclaim released CIDRs
+        self._cursor = 0
+        self._n_subnets = 1 << (node_mask_size - self.pool.prefixlen)
+        self._subnet_span = 1 << (32 - node_mask_size)
 
     def allocate_node_cidr(self, node: str) -> str:
         with self._lock:
             got = self._by_node.get(node)
             if got is not None:  # idempotent re-register
                 return str(got)
-            for net in self.pool.subnets(new_prefix=self.node_mask_size):
+            base = int(self.pool.network_address)
+            for off in range(self._n_subnets):
+                idx = (self._cursor + off) % self._n_subnets
+                net = ipaddress.ip_network(
+                    (base + idx * self._subnet_span, self.node_mask_size))
                 if net not in self._used:
                     self._used.add(net)
                     self._by_node[node] = net
+                    self._cursor = idx + 1
                     METRICS.set_gauge("cilium_tpu_ipam_node_cidrs",
                                       float(len(self._by_node)))
                     return str(net)

@@ -17,7 +17,8 @@ from typing import Dict
 
 import jax
 import jax.numpy as jnp
-from jax import lax
+
+from cilium_tpu.engine.search import lower_bound
 
 _FNV_PRIME = 0x01000193
 _FNV_BASIS = 0x811C9DC5
@@ -33,23 +34,8 @@ def _fnv1a_words(words) -> jax.Array:
 
 def _lower_bound2(k0: jax.Array, k1: jax.Array,
                   p0: jax.Array, p1: jax.Array):
-    """Vectorized lower bound over 2-word sorted uint32 keys."""
-    N = k0.shape[0]
-    iters = max(1, int(N).bit_length())
-    lo = jnp.zeros(p0.shape, dtype=jnp.int32)
-    hi = jnp.full(p0.shape, N, dtype=jnp.int32)
-
-    def body(_, lohi):
-        lo, hi = lohi
-        mid = (lo + hi) >> 1
-        m0, m1 = k0[mid], k1[mid]
-        ge = (m0 > p0) | ((m0 == p0) & (m1 >= p1))
-        return jnp.where(ge, lo, mid + 1), jnp.where(ge, mid, hi)
-
-    lo, hi = lax.fori_loop(0, iters, body, (lo, hi))
-    idx = jnp.clip(lo, 0, N - 1)
-    found = (lo < N) & (k0[idx] == p0) & (k1[idx] == p1)
-    return idx, found
+    """Lower bound over 2-word sorted keys (shared engine/search.py)."""
+    return lower_bound((k0, k1), (p0, p1))
 
 
 def lb_lookup(

@@ -1,14 +1,25 @@
 """Parallelism layer: meshes, shardings, multi-host (SURVEY.md §2.6/§2.7).
 
-The strategy map (reference mechanism → ours):
+The full strategy map (reference mechanism → ours), one module each:
 
-* **DP** — flows sharded on the batch axis (the reference's
-  shared-nothing per-node agents); rule tensors replicated.
-* **EP** — DFA banks sharded on the ``expert`` axis (the reference's
-  per-namespace/per-parser partitioning); accept words all-gathered.
-* **CP/SP** — long payloads: blockwise transition composition
-  (associative scan / ring exchange) — scaffolding in ``longscan.py``.
-* **Multi-host** — ``jax.distributed`` + global meshes over DCN.
+* **DP** (``sharding.py``) — flows sharded on the batch axis (the
+  reference's shared-nothing per-node agents); rule tensors replicated.
+* **TP** (``tp.py``) — the DFA transition table sharded on its *state*
+  axis; one-hot-matmul step with ``psum`` combine (the reference's
+  per-endpoint verdict-table partitioning).
+* **PP** (``pipeline.py``) — host↔device double-buffering across
+  batches; the per-batch stage chain stays XLA-fused (the reference's
+  BPF tail-call chain).
+* **SP/CP** (``engine/longscan.py``) — long payloads: blockwise
+  transition composition via ``associative_scan`` (SP) and the ring
+  ``ppermute`` carry exchange (CP) — the streaming-parse analog.
+* **EP** (``sharding.py``) — DFA banks sharded on the ``expert`` axis
+  (the reference's per-namespace/per-parser partitioning).
+* **Ulysses** (``ulysses.py``) — ``all_to_all`` batch↔bank axis switch
+  between parse and match stages (the Hubble Relay scatter-gather).
+* **Multi-host / elastic** (``multihost.py``) — ``jax.distributed`` +
+  global meshes over DCN; content-hashed rule tensors make every host's
+  staging deterministic, so workers restart without state exchange.
 
 All device-to-device communication is XLA collectives over ICI; there is
 no NCCL/MPI analog to port (the reference has none either — its channels
@@ -16,16 +27,33 @@ are gRPC/etcd/unix sockets, which stay host-side).
 """
 
 from cilium_tpu.parallel.mesh import make_mesh, data_parallel_mesh
+from cilium_tpu.parallel.multihost import (
+    global_mesh,
+    init_multihost,
+    process_span,
+)
+from cilium_tpu.parallel.pipeline import collect, run_pipelined
 from cilium_tpu.parallel.sharding import (
     shard_policy_arrays,
     shard_flow_batch,
     make_sharded_step,
 )
+from cilium_tpu.parallel.tp import dfa_scan_banked_tp, dfa_scan_tp, pad_states
+from cilium_tpu.parallel.ulysses import ulysses_scan_banked
 
 __all__ = [
     "make_mesh",
     "data_parallel_mesh",
+    "global_mesh",
+    "init_multihost",
+    "process_span",
+    "collect",
+    "run_pipelined",
     "shard_policy_arrays",
     "shard_flow_batch",
     "make_sharded_step",
+    "dfa_scan_tp",
+    "dfa_scan_banked_tp",
+    "pad_states",
+    "ulysses_scan_banked",
 ]

@@ -1,0 +1,72 @@
+"""Multi-host (DCN) support — the reference's clustermesh/agent-fleet
+analog (SURVEY.md §2.6 "Elastic/multi-node", §2.7 "DCN via multi-host
+``jax.distributed.initialize`` + pjit global meshes").
+
+One process per host; after :func:`init_multihost` every process sees
+the *global* device set and jitted computations over a
+:func:`global_mesh` are single-program-multiple-data across hosts, with
+XLA routing collectives over ICI within a slice and DCN across slices.
+Rule tensors are deterministic functions of the ruleset (content-hashed
+by the artifact cache), so every host stages identical policy arrays
+without any cross-host state exchange — the same property that lets
+cilium agents run shared-nothing off a common CRD store.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh
+
+from cilium_tpu.parallel.mesh import make_mesh
+
+
+def init_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize ``jax.distributed`` when running multi-process.
+
+    Arguments default from the standard env (``JAX_COORDINATOR_ADDRESS``,
+    ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``; auto-detected on Cloud
+    TPU). Returns True when a multi-process runtime was initialized,
+    False for the single-process (local) case — callers need no branch,
+    the global mesh just spans fewer hosts.
+    """
+    addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nproc = num_processes if num_processes is not None else (
+        int(os.environ["JAX_NUM_PROCESSES"])
+        if "JAX_NUM_PROCESSES" in os.environ else None)
+    pid = process_id if process_id is not None else (
+        int(os.environ["JAX_PROCESS_ID"])
+        if "JAX_PROCESS_ID" in os.environ else None)
+    if addr is None and nproc is None:
+        return False  # single-process
+    jax.distributed.initialize(
+        coordinator_address=addr, num_processes=nproc, process_id=pid)
+    return True
+
+
+def global_mesh(
+    shape: Optional[Tuple[int, ...]] = None,
+    axis_names: Sequence[str] = ("data",),
+) -> Mesh:
+    """Mesh over the GLOBAL device set (all hosts).
+
+    Default: all devices on one ``data`` axis — pure DP scales linearly
+    because policy tensors replicate and flow slices never interact.
+    Pass a 2-D shape (e.g. ``(hosts, devices_per_host)`` as
+    ``("data", "expert")``) to keep EP's all-gathers on ICI while DP
+    spans DCN — the layout rule from the scaling playbook: put the
+    chatty axis on the fast interconnect.
+    """
+    return make_mesh(shape, axis_names, jax.devices())
+
+
+def process_span() -> Tuple[int, int]:
+    """(process_index, process_count) — for sharding host-side work such
+    as flow-capture file assignment across agent processes."""
+    return jax.process_index(), jax.process_count()

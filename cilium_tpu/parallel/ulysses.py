@@ -1,0 +1,65 @@
+"""Ulysses-style ``all_to_all`` re-shard between parse and match stages.
+
+SURVEY.md §2.6: the reference analog is Hubble Relay's scatter-gather
+(flows are node-sharded; a query re-gathers them per request). On a TPU
+mesh the same shape appears when the *rule-bank* set exceeds one chip:
+flows enter **batch-sharded** (DP — each device parsed/encoded its own
+slice), but the DFA banks are **bank-sharded** (EP), so the scan stage
+needs a re-shard:
+
+  parse:  data  [B/n, L]  per device        (batch-sharded)
+  scan:   every device scans ALL flows against ITS banks
+          → ``all_gather`` of the (small) encoded inputs over the axis
+  words:  [B, NB/n, W] per device           (bank-sharded output)
+  match:  the per-rule conjunction needs all banks of each flow
+          → ``lax.all_to_all`` splitting the batch axis and
+            concatenating the bank axis → [B/n, NB, W] (batch-sharded)
+
+This is exactly the Ulysses head/sequence axis switch with banks
+playing the role of heads: two collectives bracket the heavy scan, and
+each device ends holding the full match words for its own flow slice —
+ready for the (cheap, local) conjunction + verdict stage.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from cilium_tpu.engine.dfa_kernel import dfa_scan_banked
+
+
+def ulysses_scan_banked(
+    mesh: Mesh,
+    trans: jax.Array,       # [NB, S, K] int32 — NB divisible by axis size
+    byteclass: jax.Array,   # [NB, 256] int32
+    start: jax.Array,       # [NB] int32
+    accept: jax.Array,      # [NB, S, W] uint32
+    data: jax.Array,        # [B, L] — B divisible by axis size
+    lengths: jax.Array,     # [B]
+    axis: str = "data",
+) -> jax.Array:
+    """Bank-sharded scan of batch-sharded inputs → words ``[B, NB, W]``
+    batch-sharded on ``axis`` (bit-identical to ``dfa_scan_banked``)."""
+
+    def local(trans_l, byteclass_l, start_l, accept_l, data_l, lengths_l):
+        # gather the full (encoded, byte-compressed) flow slice set —
+        # inputs are the *small* tensors; transition tables never move
+        all_data = lax.all_gather(data_l, axis, tiled=True)      # [B, L]
+        all_len = lax.all_gather(lengths_l, axis, tiled=True)    # [B]
+        words = dfa_scan_banked(trans_l, byteclass_l, start_l, accept_l,
+                                all_data, all_len)  # [B, NB/n, W]
+        # Ulysses switch: split batch, concat banks → [B/n, NB, W]
+        return lax.all_to_all(words, axis, split_axis=0, concat_axis=1,
+                              tiled=True)
+
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis, None, None), P(axis, None), P(axis),
+                  P(axis, None, None), P(axis, None), P(axis)),
+        out_specs=P(axis, None, None),
+        check_vma=False,
+    )
+    return fn(trans, byteclass, start, accept, data, lengths)

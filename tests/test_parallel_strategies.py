@@ -1,0 +1,141 @@
+"""TP / Ulysses / PP / multi-host strategy tests (SURVEY.md §2.6).
+
+Each sharded implementation must be bit-identical to the single-device
+kernel it parallelizes — the same discipline as the CP ring tests in
+test_longscan.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cilium_tpu.engine.dfa_kernel import dfa_scan, dfa_scan_banked
+from cilium_tpu.parallel.mesh import make_mesh
+from cilium_tpu.parallel.multihost import (
+    global_mesh,
+    init_multihost,
+    process_span,
+)
+from cilium_tpu.parallel.pipeline import collect, run_pipelined
+from cilium_tpu.parallel.tp import dfa_scan_banked_tp, dfa_scan_tp, pad_states
+from cilium_tpu.parallel.ulysses import ulysses_scan_banked
+from cilium_tpu.policy.compiler.dfa import compile_patterns
+
+
+def _compiled(patterns, bank_size=4):
+    banked = compile_patterns(patterns, bank_size=bank_size)
+    return banked.stacked()
+
+
+def _batch(rng, B=16, L=32):
+    data = rng.integers(0, 256, size=(B, L), dtype=np.uint8)
+    # sprinkle matching strings
+    data[::3, :4] = np.frombuffer(b"/api", dtype=np.uint8)
+    lengths = rng.integers(1, L + 1, size=(B,)).astype(np.int32)
+    return data, lengths
+
+
+PATTERNS = ["/api/v[0-9]+", "/health", "GET", "foo.*bar",
+            "/metrics", "abc", "x+y", "/static/.*[.]js"]
+
+
+@pytest.mark.parametrize("n_shards", [2, 4])
+def test_tp_single_bank_matches_reference(n_shards):
+    rng = np.random.default_rng(0)
+    arrs = _compiled(PATTERNS[:4], bank_size=4)
+    trans, accept = arrs["trans"][0], arrs["accept"][0]
+    byteclass, start = arrs["byteclass"][0], int(arrs["start"][0])
+    data, lengths = _batch(rng)
+
+    ref_finals = dfa_scan(jnp.asarray(trans), jnp.asarray(byteclass),
+                          jnp.int32(start), jnp.asarray(data),
+                          jnp.asarray(lengths))
+    ref_words = np.asarray(accept)[np.asarray(ref_finals)]
+
+    trans_p, accept_p = pad_states(trans, accept, n_shards)
+    mesh = make_mesh((n_shards,), ("state",),
+                     jax.devices("cpu")[:n_shards])
+    finals, words = dfa_scan_tp(
+        mesh, jnp.asarray(trans_p), jnp.asarray(byteclass),
+        start, jnp.asarray(accept_p), jnp.asarray(data),
+        jnp.asarray(lengths))
+    np.testing.assert_array_equal(np.asarray(finals), np.asarray(ref_finals))
+    np.testing.assert_array_equal(np.asarray(words), ref_words)
+
+
+def test_tp_banked_matches_reference():
+    rng = np.random.default_rng(1)
+    arrs = _compiled(PATTERNS, bank_size=3)
+    data, lengths = _batch(rng, B=8, L=24)
+    ref = dfa_scan_banked(
+        jnp.asarray(arrs["trans"]), jnp.asarray(arrs["byteclass"]),
+        jnp.asarray(arrs["start"]), jnp.asarray(arrs["accept"]),
+        jnp.asarray(data), jnp.asarray(lengths))
+
+    trans_p, accept_p = pad_states(arrs["trans"], arrs["accept"], 4)
+    mesh = make_mesh((4,), ("state",), jax.devices("cpu")[:4])
+    words = dfa_scan_banked_tp(
+        mesh, jnp.asarray(trans_p), jnp.asarray(arrs["byteclass"]),
+        jnp.asarray(arrs["start"]), jnp.asarray(accept_p),
+        jnp.asarray(data), jnp.asarray(lengths))
+    np.testing.assert_array_equal(np.asarray(words), np.asarray(ref))
+
+
+@pytest.mark.parametrize("n_dev", [2, 4])
+def test_ulysses_matches_reference(n_dev):
+    rng = np.random.default_rng(2)
+    arrs = _compiled(PATTERNS, bank_size=2)  # 8 patterns → 4 banks
+    nb = arrs["trans"].shape[0]
+    if nb % n_dev:
+        pytest.skip(f"{nb} banks not divisible by {n_dev}")
+    data, lengths = _batch(rng, B=16, L=24)
+    ref = dfa_scan_banked(
+        jnp.asarray(arrs["trans"]), jnp.asarray(arrs["byteclass"]),
+        jnp.asarray(arrs["start"]), jnp.asarray(arrs["accept"]),
+        jnp.asarray(data), jnp.asarray(lengths))
+
+    mesh = make_mesh((n_dev,), ("data",), jax.devices("cpu")[:n_dev])
+    words = ulysses_scan_banked(
+        mesh, jnp.asarray(arrs["trans"]), jnp.asarray(arrs["byteclass"]),
+        jnp.asarray(arrs["start"]), jnp.asarray(arrs["accept"]),
+        jnp.asarray(data), jnp.asarray(lengths))
+    np.testing.assert_array_equal(np.asarray(words), np.asarray(ref))
+
+
+def test_run_pipelined_matches_sequential():
+    from cilium_tpu.core.config import EngineConfig
+    from cilium_tpu.engine.verdict import (
+        CompiledPolicy, encode_flows, flowbatch_to_host_dict, verdict_step)
+    from cilium_tpu.ingest.synth import realize_scenario, synth_http_scenario
+
+    scenario = synth_http_scenario(n_rules=16, n_flows=32)
+    per_identity, scenario = realize_scenario(scenario)
+    cfg = EngineConfig(bank_size=8)
+    policy = CompiledPolicy.build(per_identity, cfg)
+    fb = encode_flows(scenario.flows, policy.kafka_interns, cfg)
+    host = flowbatch_to_host_dict(fb)
+    # three batches: full, permuted, reversed
+    perm = np.random.default_rng(3).permutation(fb.size)
+    batches = [host,
+               {k: v[perm] for k, v in host.items()},
+               {k: v[::-1].copy() for k, v in host.items()}]
+
+    step = jax.jit(verdict_step)
+    arrays = {k: jax.device_put(v) for k, v in policy.arrays.items()}
+    outs = collect(run_pipelined(step, arrays, batches))
+    for b, out in zip(batches, outs):
+        ref = step(arrays, {k: jax.device_put(v) for k, v in b.items()})
+        np.testing.assert_array_equal(out["verdict"], np.asarray(ref["verdict"]))
+
+
+def test_multihost_single_process_fallbacks():
+    assert init_multihost() is False       # no env → local mode, no raise
+    mesh = global_mesh()
+    assert mesh.devices.size == len(jax.devices())
+    idx, count = process_span()
+    assert idx == 0 and count == 1
+    # 2-D layout over the 8 virtual devices
+    mesh2 = global_mesh((4, 2), ("data", "expert"))
+    assert dict(zip(mesh2.axis_names, mesh2.devices.shape)) == {
+        "data": 4, "expert": 2}

@@ -1,0 +1,73 @@
+"""Regression tests for the round-1 review-4 findings."""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.ipam import ClusterPool, NodeAllocator, PoolExhausted
+
+
+def test_endpoint_repin_to_taken_ip_keeps_old_state():
+    """A failed re-pin must not tear down the endpoint's existing IP."""
+    from cilium_tpu.agent import Agent
+    from cilium_tpu.core.config import Config
+
+    cfg = Config()
+    agent = Agent(cfg)
+    try:
+        ep1 = agent.endpoint_add(1, {"app": "a"}, ipv4="10.0.0.5")
+        agent.endpoint_add(2, {"app": "b"}, ipv4="10.0.0.6")
+        with pytest.raises(PoolExhausted):
+            agent.endpoint_add(1, {"app": "a"}, ipv4="10.0.0.6")
+        # old pin fully intact: endpoint, ipcache entry, IPAM ownership
+        assert agent.endpoint_manager.get(1).ipv4 == "10.0.0.5"
+        assert agent.ipcache.lookup("10.0.0.5") == ep1.identity
+        with pytest.raises(PoolExhausted):
+            agent.ipam.allocate_ip("10.0.0.5")
+    finally:
+        agent.stop()
+
+
+def test_cluster_pool_cursor_reclaims_released():
+    pool = ClusterPool("10.128.0.0/20", node_mask_size=24)
+    cidrs = [pool.allocate_node_cidr(f"n{i}") for i in range(16)]
+    assert len(set(cidrs)) == 16
+    with pytest.raises(PoolExhausted):
+        pool.allocate_node_cidr("overflow")
+    pool.release_node_cidr("n3")
+    assert pool.allocate_node_cidr("n3b") == cidrs[3]  # wraps to the hole
+
+
+def test_cluster_pool_allocation_is_fast_for_many_nodes():
+    # /8 pool, /24 nodes: must not rescan 2^16 subnets per allocation
+    import time
+
+    pool = ClusterPool("10.0.0.0/8", node_mask_size=24)
+    t0 = time.monotonic()
+    for i in range(2000):
+        pool.allocate_node_cidr(f"node-{i}")
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_tp_state_count_guard():
+    from cilium_tpu.parallel.tp import MAX_TP_STATES, _check_state_count
+
+    _check_state_count(MAX_TP_STATES - 1)
+    with pytest.raises(ValueError):
+        _check_state_count(MAX_TP_STATES)
+
+
+def test_pipeline_releases_consumed_batches():
+    import jax
+
+    from cilium_tpu.parallel.pipeline import run_pipelined
+
+    seen_staged = []
+
+    def step(arrays, batch):
+        return {"x": batch["x"] + 1}
+
+    batches = [{"x": np.full((4,), i, dtype=np.int32)} for i in range(6)]
+
+    outs = run_pipelined(step, {}, batches, depth=2)
+    vals = [int(np.asarray(o["x"])[0]) for o in outs]
+    assert vals == [1, 2, 3, 4, 5, 6]
