@@ -40,9 +40,14 @@ from cilium_tpu.runtime.service import VerdictService
 class Agent:
     def __init__(self, config: Optional[Config] = None,
                  state_dir: Optional[str] = None,
-                 socket_path: Optional[str] = None):
+                 socket_path: Optional[str] = None,
+                 api_socket_path: Optional[str] = None,
+                 policy_dir: Optional[str] = None):
         self.config = config or Config.from_env()
         self.state_dir = state_dir
+        # serializes compound mutations (endpoint/policy upserts) from
+        # concurrent writers: REST API threads, watcher controller, CLI
+        self.write_lock = threading.RLock()
         self.allocator = IdentityAllocator()
         self.selector_cache = SelectorCache(self.allocator)
         self.ipcache = IPCache(self.allocator, self.selector_cache)
@@ -78,6 +83,12 @@ class Agent:
         self.controllers = ControllerManager()
         self.service: Optional[VerdictService] = None
         self.socket_path = socket_path
+        # REST API (pkg/client-consumable; SURVEY.md §2.4) + the k8s
+        # CNP-watcher analog (a policy directory watcher)
+        self.api_server = None
+        self.api_socket_path = api_socket_path
+        self.policy_watcher = None
+        self.policy_dir = policy_dir
         # FQDN updates retrigger regeneration (§3.2 tail)
         self.name_manager.on_update = (
             lambda sels: self.endpoint_manager.regenerate_all())
@@ -106,6 +117,15 @@ class Agent:
             self.service = VerdictService(self.loader, self.socket_path,
                                           agent=self)
             self.service.start()
+        if self.api_socket_path:
+            from cilium_tpu.runtime.api import APIServer
+
+            self.api_server = APIServer(self, self.api_socket_path).start()
+        if self.policy_dir:
+            from cilium_tpu.runtime.watcher import PolicyDirWatcher
+
+            self.policy_watcher = PolicyDirWatcher(self, self.policy_dir)
+            self.policy_watcher.register(self.controllers)
         self.controllers.update("dns-gc", self._dns_gc, interval=60.0)
         self.controllers.update("clustermesh-heartbeat",
                                 self.publisher.heartbeat, interval=15.0)
@@ -121,6 +141,8 @@ class Agent:
         # policy for a shutdown teardown would be discarded work
         self.clustermesh.close()
         self.controllers.stop_all()
+        if self.api_server is not None:
+            self.api_server.stop()
         if self.service is not None:
             self.service.stop()
         if self.state_dir:

@@ -13,6 +13,11 @@ over the agent's socket plus offline tooling. Subcommands:
   offline and print a verdict summary
 * ``bugtool``     — collect a diagnostics bundle from the agent
   (the ``cilium-bugtool`` analog)
+
+REST-API commands (``--api <socket>``, runtime/api.py — the
+``pkg/client`` consumer role): ``endpoint list|get|add|delete``,
+``identity list``, ``ip list``, ``fqdn cache``, ``service list``,
+``config get|set``, ``policy import|delete``, ``healthz``.
 """
 
 from __future__ import annotations
@@ -144,6 +149,82 @@ def cmd_bugtool(args) -> int:
     return 0
 
 
+def _api(args):
+    from cilium_tpu.runtime.api import APIClient
+
+    return APIClient(args.api)
+
+
+def _print(obj) -> int:
+    print(json.dumps(obj, indent=2, default=str))
+    return 0
+
+
+def cmd_healthz(args) -> int:
+    return _print(_api(args).healthz())
+
+
+def cmd_endpoint(args) -> int:
+    c = _api(args)
+    if args.ep_cmd == "list":
+        return _print(c.endpoints())
+    if args.ep_cmd == "get":
+        code, body = c.request("GET", f"/v1/endpoint/{args.id}")
+        _print(body)
+        return 0 if code == 200 else 1
+    if args.ep_cmd == "add":
+        labels = dict(kv.split("=", 1) for kv in (args.labels or "").split(
+            ",")) if args.labels else {}
+        code, body = c.endpoint_put(args.id, labels, ipv4=args.ipv4)
+        _print(body)
+        return 0 if code in (200, 201) else 1
+    code, body = c.endpoint_delete(args.id)
+    _print(body)
+    return 0 if code == 200 else 1
+
+
+def cmd_identity_list(args) -> int:
+    return _print(_api(args).identities())
+
+
+def cmd_ip_list(args) -> int:
+    return _print(_api(args).ipcache())
+
+
+def cmd_fqdn_cache(args) -> int:
+    return _print(_api(args).fqdn_cache())
+
+
+def cmd_service_list(args) -> int:
+    return _print(_api(args).services())
+
+
+def cmd_config(args) -> int:
+    c = _api(args)
+    if args.cfg_cmd == "get":
+        return _print(c.config())
+    fields = {}
+    for kv in args.set:
+        k, v = kv.split("=", 1)
+        fields[k] = {"true": True, "false": False}.get(v.lower(), v)
+    code, body = c.patch_config(**fields)
+    _print(body)
+    return 0 if code == 200 else 1
+
+
+def cmd_policy_import(args) -> int:
+    with open(args.file) as f:
+        code, body = _api(args).policy_put_yaml(f.read())
+    _print(body)
+    return 0 if code == 200 else 1
+
+
+def cmd_policy_delete(args) -> int:
+    code, body = _api(args).policy_delete(args.labels)
+    _print(body)
+    return 0 if code == 200 else 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="cilium-tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -170,6 +251,70 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--socket", required=True)
     p.add_argument("--out", default="/tmp")
     p.set_defaults(fn=cmd_bugtool)
+
+    p = sub.add_parser("healthz", help="REST healthz")
+    p.add_argument("--api", required=True)
+    p.set_defaults(fn=cmd_healthz)
+
+    p = sub.add_parser("endpoint", help="endpoint CRUD over the REST API")
+    esub = p.add_subparsers(dest="ep_cmd", required=True)
+    e = esub.add_parser("list")
+    e.add_argument("--api", required=True)
+    e.set_defaults(fn=cmd_endpoint)
+    for name in ("get", "delete"):
+        e = esub.add_parser(name)
+        e.add_argument("id", type=int)
+        e.add_argument("--api", required=True)
+        e.set_defaults(fn=cmd_endpoint)
+    e = esub.add_parser("add")
+    e.add_argument("id", type=int)
+    e.add_argument("--labels", help="k=v[,k=v...]")
+    e.add_argument("--ipv4", default="")
+    e.add_argument("--api", required=True)
+    e.set_defaults(fn=cmd_endpoint)
+
+    p = sub.add_parser("identity", help="identity introspection")
+    isub = p.add_subparsers(dest="id_cmd", required=True)
+    i = isub.add_parser("list")
+    i.add_argument("--api", required=True)
+    i.set_defaults(fn=cmd_identity_list)
+
+    p = sub.add_parser("ip", help="ipcache introspection")
+    ipsub = p.add_subparsers(dest="ip_cmd", required=True)
+    i = ipsub.add_parser("list")
+    i.add_argument("--api", required=True)
+    i.set_defaults(fn=cmd_ip_list)
+
+    p = sub.add_parser("fqdn", help="FQDN subsystem introspection")
+    fsub = p.add_subparsers(dest="fqdn_cmd", required=True)
+    i = fsub.add_parser("cache")
+    i.add_argument("--api", required=True)
+    i.set_defaults(fn=cmd_fqdn_cache)
+
+    p = sub.add_parser("service", help="load-balancer services")
+    ssub = p.add_subparsers(dest="svc_cmd", required=True)
+    i = ssub.add_parser("list")
+    i.add_argument("--api", required=True)
+    i.set_defaults(fn=cmd_service_list)
+
+    p = sub.add_parser("config", help="daemon config get/set")
+    csub = p.add_subparsers(dest="cfg_cmd", required=True)
+    i = csub.add_parser("get")
+    i.add_argument("--api", required=True)
+    i.set_defaults(fn=cmd_config)
+    i = csub.add_parser("set")
+    i.add_argument("set", nargs="+", metavar="k=v")
+    i.add_argument("--api", required=True)
+    i.set_defaults(fn=cmd_config)
+
+    pi = psub.add_parser("import", help="PUT a CNP YAML via the REST API")
+    pi.add_argument("file")
+    pi.add_argument("--api", required=True)
+    pi.set_defaults(fn=cmd_policy_import)
+    pd = psub.add_parser("delete", help="delete rules by labels")
+    pd.add_argument("labels", nargs="+")
+    pd.add_argument("--api", required=True)
+    pd.set_defaults(fn=cmd_policy_delete)
 
     p = sub.add_parser("replay", help="replay a Hubble JSONL capture")
     p.add_argument("capture")

@@ -67,6 +67,18 @@ class IPCache:
     def subscribe(self, fn: Callable[[str, NumericIdentity, bool], None]):
         self._listeners.append(fn)
 
+    def dump(self) -> List[Dict]:
+        """All entries, sorted by prefix (``cilium-dbg bpf ipcache
+        list`` / REST ``GET /v1/ip`` analog)."""
+        with self._lock:
+            return [
+                {"cidr": str(net), "identity": int(nid)}
+                for net, nid in sorted(
+                    self._by_prefix.items(),
+                    key=lambda kv: (int(kv[0].network_address),
+                                    kv[0].prefixlen))
+            ]
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._by_prefix)

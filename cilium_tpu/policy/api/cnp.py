@@ -108,12 +108,18 @@ def parse_cnp(doc: Dict) -> CiliumNetworkPolicy:
 
 def load_cnp_yaml(path: str) -> List[CiliumNetworkPolicy]:
     """Load one YAML file (possibly multi-document) of CNPs."""
-    out: List[CiliumNetworkPolicy] = []
     with open(path) as f:
-        for doc in yaml.safe_load_all(f):
-            if not doc:
-                continue
-            out.append(parse_cnp(doc))
+        return load_cnp_yaml_text(f.read())
+
+
+def load_cnp_yaml_text(text: str) -> List[CiliumNetworkPolicy]:
+    """Parse YAML text (possibly multi-document) of CNPs — the REST
+    API's ``PUT /v1/policy`` body format."""
+    out: List[CiliumNetworkPolicy] = []
+    for doc in yaml.safe_load_all(text):
+        if not doc:
+            continue
+        out.append(parse_cnp(doc))
     return out
 
 

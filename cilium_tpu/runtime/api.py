@@ -1,0 +1,387 @@
+"""Agent REST API over a Unix socket + client.
+
+Reference: cilium's go-swagger REST API served on the agent's Unix
+socket (``api/v1/openapi.yaml`` → generated server, ``pkg/client``
+consumer — SURVEY.md §2.4); ``cilium-dbg`` drives it. We serve plain
+HTTP/1.1 + JSON on an ``AF_UNIX`` socket with the same resource
+shapes:
+
+  GET    /v1/healthz        agent liveness + subsystem summary
+  GET    /v1/config         daemon config (read)
+  PATCH  /v1/config         mutate runtime-mutable fields (feature gate)
+  GET    /v1/endpoint       list endpoints
+  GET    /v1/endpoint/{id}  one endpoint
+  PUT    /v1/endpoint/{id}  create/update (CNI ADD analog)
+  DELETE /v1/endpoint/{id}  remove (CNI DEL analog)
+  GET    /v1/policy         rules + revision
+  PUT    /v1/policy         add CNP (YAML text or JSON body)
+  DELETE /v1/policy         delete by labels (JSON body: {"labels": [...]})
+  GET    /v1/identity       allocated identities
+  GET    /v1/ip             ipcache dump
+  GET    /v1/fqdn/cache     DNS cache dump
+  GET    /v1/service        load-balancer services
+  GET    /v1/metrics        Prometheus text exposition
+  GET    /v1/debuginfo      full status dict
+
+The verdict/proxylib data path stays on the binary verdict-service
+socket (runtime/service.py) — control plane and data plane sockets are
+separate, as in the reference (REST vs monitor/accesslog sockets).
+"""
+
+from __future__ import annotations
+
+import http.client
+import http.server
+import json
+import os
+import socket
+import socketserver
+import threading
+import urllib.parse
+from typing import Dict, Optional, Tuple
+
+from cilium_tpu.runtime.metrics import METRICS
+
+#: config fields PATCHable at runtime (the reference's runtime-mutable
+#: DaemonConfig subset; everything else requires an agent restart)
+_MUTABLE_CONFIG = ("enable_tpu_offload",)
+
+
+class _UnixHTTPServer(socketserver.ThreadingUnixStreamServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    # BaseHTTPRequestHandler expects TCP peers; over AF_UNIX the peer
+    # address is a bare string — normalize so logging never crashes
+    def address_string(self) -> str:  # noqa: D102
+        return "unix"
+
+    def log_message(self, fmt, *args):  # quiet; metrics cover access
+        METRICS.inc("cilium_tpu_api_requests_total", 1)
+
+    server_version = "cilium-tpu-api/1.0"
+    agent = None  # set by APIServer
+
+    # -- helpers ----------------------------------------------------------
+    def _send(self, code: int, body, content_type="application/json"):
+        data = (body if isinstance(body, bytes)
+                else json.dumps(body, indent=2, default=str).encode())
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", 0) or 0)
+        return self.rfile.read(n) if n else b""
+
+    def _route(self) -> Tuple[str, Dict[str, str]]:
+        parsed = urllib.parse.urlparse(self.path)
+        query = dict(urllib.parse.parse_qsl(parsed.query))
+        return parsed.path.rstrip("/"), query
+
+    def _ep_id(self, path: str) -> Optional[int]:
+        try:
+            return int(path.rsplit("/", 1)[1])
+        except ValueError:
+            return None
+
+    # -- methods ----------------------------------------------------------
+    def do_GET(self):  # noqa: N802
+        agent = self.agent
+        path, query = self._route()
+        try:
+            if path == "/v1/healthz":
+                return self._send(200, {
+                    "status": "ok",
+                    "endpoints": len(list(agent.endpoint_manager.endpoints())),
+                    "policy_revision": agent.repo.revision,
+                    "engine_revision": agent.loader.revision,
+                    "nodes": agent.health.summary()
+                    if hasattr(agent.health, "summary") else {},
+                })
+            if path == "/v1/config":
+                import dataclasses
+
+                cfg = dataclasses.asdict(agent.config)
+                return self._send(200, {"config": cfg,
+                                        "mutable": list(_MUTABLE_CONFIG)})
+            if path == "/v1/endpoint":
+                return self._send(200, [
+                    ep.to_json() for ep in agent.endpoint_manager.endpoints()
+                ])
+            if path.startswith("/v1/endpoint/"):
+                ep_id = self._ep_id(path)
+                if ep_id is None:
+                    return self._send(400, {"error": "endpoint id must be "
+                                            "an integer"})
+                ep = agent.endpoint_manager.get(ep_id)
+                if ep is None:
+                    return self._send(404, {"error": "endpoint not found"})
+                return self._send(200, ep.to_json())
+            if path == "/v1/policy":
+                return self._send(200, {
+                    "rules": [
+                        {"labels": list(r.labels),
+                         "description": r.description}
+                        for r in agent.repo.rules()
+                    ],
+                    "revision": agent.repo.revision,
+                })
+            if path == "/v1/identity":
+                out = []
+                for nid in agent.allocator.identities():
+                    labels = agent.allocator.lookup(nid)
+                    out.append({"id": int(nid),
+                                "labels": sorted(map(str, labels))
+                                if labels else []})
+                return self._send(200, out)
+            if path == "/v1/ip":
+                return self._send(200, agent.ipcache.dump())
+            if path == "/v1/fqdn/cache":
+                return self._send(200, json.loads(agent.dns_cache.to_json()))
+            if path == "/v1/service":
+                return self._send(200, [
+                    {"frontend": s.frontend.name,
+                     "type": s.svc_type.name,
+                     "backends": [b.name for b in s.backends],
+                     "affinity": s.affinity}
+                    for s in agent.services.list()
+                ])
+            if path == "/v1/metrics":
+                return self._send(200, METRICS.expose().encode(),
+                                  content_type="text/plain; version=0.0.4")
+            if path == "/v1/debuginfo":
+                return self._send(200, agent.status())
+            return self._send(404, {"error": f"no such resource {path}"})
+        except Exception as e:  # surface, never kill the server thread
+            return self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def do_PUT(self):  # noqa: N802
+        agent = self.agent
+        path, _ = self._route()
+        try:
+            if path.startswith("/v1/endpoint/"):
+                ep_id = self._ep_id(path)
+                if ep_id is None:
+                    return self._send(400, {"error": "endpoint id must be "
+                                            "an integer"})
+                body = json.loads(self._body() or b"{}")
+                with agent.write_lock:
+                    ep = agent.endpoint_add(
+                        ep_id,
+                        dict(body.get("labels", {})),
+                        ipv4=body.get("ipv4", ""),
+                    )
+                return self._send(201, ep.to_json())
+            if path == "/v1/policy":
+                ctype = self.headers.get("Content-Type", "")
+                raw = self._body()
+                from cilium_tpu.policy.api.cnp import (
+                    load_cnp_yaml_text,
+                    parse_cnp,
+                )
+
+                if "json" in ctype:
+                    cnps = [parse_cnp(json.loads(raw))]
+                else:
+                    cnps = load_cnp_yaml_text(raw.decode())
+                rev = 0
+                with agent.write_lock:
+                    for cnp in cnps:
+                        # upsert: a CNP update replaces same-name rules
+                        agent.policy_delete(list(cnp.labels), wait=False)
+                        rev = agent.policy_add(cnp)
+                return self._send(200, {"revision": rev,
+                                        "count": len(cnps)})
+            return self._send(404, {"error": f"no such resource {path}"})
+        except Exception as e:
+            return self._send(400, {"error": f"{type(e).__name__}: {e}"})
+
+    def do_PATCH(self):  # noqa: N802
+        agent = self.agent
+        path, _ = self._route()
+        try:
+            if path == "/v1/config":
+                body = json.loads(self._body() or b"{}")
+                # validate ALL keys first: a rejected request must not
+                # leave earlier fields mutated
+                for k in body:
+                    if k not in _MUTABLE_CONFIG:
+                        return self._send(
+                            400, {"error": f"config field {k!r} is not "
+                                  f"runtime-mutable"})
+                with agent.write_lock:
+                    for k, v in body.items():
+                        setattr(agent.config, k, v)
+                    if "enable_tpu_offload" in body:
+                        # the gate flips the loader's engine selection —
+                        # restage, like the reference's datapath reload
+                        agent.endpoint_manager.regenerate_all(wait=True)
+                return self._send(200, {"changed": dict(body)})
+            return self._send(404, {"error": f"no such resource {path}"})
+        except Exception as e:
+            return self._send(400, {"error": f"{type(e).__name__}: {e}"})
+
+    def do_DELETE(self):  # noqa: N802
+        agent = self.agent
+        path, _ = self._route()
+        try:
+            if path.startswith("/v1/endpoint/"):
+                ep_id = self._ep_id(path)
+                if ep_id is None:
+                    return self._send(400, {"error": "endpoint id must be "
+                                            "an integer"})
+                with agent.write_lock:
+                    agent.endpoint_remove(ep_id)
+                return self._send(200, {"deleted": True})
+            if path == "/v1/policy":
+                body = json.loads(self._body() or b"{}")
+                labels = list(body.get("labels", ()))
+                with agent.write_lock:
+                    rev = agent.policy_delete(labels)
+                return self._send(200, {"revision": rev})
+            return self._send(404, {"error": f"no such resource {path}"})
+        except Exception as e:
+            return self._send(400, {"error": f"{type(e).__name__}: {e}"})
+
+
+class APIServer:
+    """Serve the REST API on ``socket_path`` (background thread pool)."""
+
+    def __init__(self, agent, socket_path: str):
+        self.socket_path = socket_path
+        if os.path.exists(socket_path):
+            self._unlink_if_stale(socket_path)
+        handler = type("BoundHandler", (_Handler,), {"agent": agent})
+        self._server = _UnixHTTPServer(socket_path, handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _unlink_if_stale(path: str) -> None:
+        """Remove ``path`` only if it is a dead leftover socket. A live
+        server or a non-socket file raises — never silently hijack."""
+        import stat as stat_mod
+
+        st = os.stat(path)
+        if not stat_mod.S_ISSOCK(st.st_mode):
+            raise FileExistsError(
+                f"{path} exists and is not a socket; refusing to unlink")
+        probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            probe.settimeout(1.0)
+            probe.connect(path)
+        except (ConnectionRefusedError, FileNotFoundError):
+            os.unlink(path)  # stale: nobody listening
+        except OSError:
+            os.unlink(path)  # unreachable/broken socket counts as stale
+        else:
+            raise FileExistsError(
+                f"another server is live on {path}; refusing to replace")
+        finally:
+            probe.close()
+
+    def start(self) -> "APIServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="api-server",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+
+
+class _UnixHTTPConnection(http.client.HTTPConnection):
+    def __init__(self, socket_path: str, timeout: float = 30.0):
+        super().__init__("localhost", timeout=timeout)
+        self._socket_path = socket_path
+
+    def connect(self) -> None:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(self.timeout)
+        sock.connect(self._socket_path)
+        self.sock = sock
+
+
+class APIClient:
+    """``pkg/client`` analog: typed access to the agent REST API."""
+
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+
+    def request(self, method: str, path: str, body=None,
+                content_type: str = "application/json"):
+        conn = _UnixHTTPConnection(self.socket_path)
+        try:
+            data = None
+            if body is not None:
+                data = (body if isinstance(body, (bytes, str))
+                        else json.dumps(body))
+            conn.request(method, path, body=data,
+                         headers={"Content-Type": content_type})
+            resp = conn.getresponse()
+            raw = resp.read()
+            if resp.headers.get_content_type() == "application/json":
+                return resp.status, json.loads(raw or b"null")
+            return resp.status, raw.decode()
+        finally:
+            conn.close()
+
+    # typed helpers
+    def healthz(self):
+        return self.request("GET", "/v1/healthz")[1]
+
+    def config(self):
+        return self.request("GET", "/v1/config")[1]
+
+    def patch_config(self, **fields):
+        return self.request("PATCH", "/v1/config", body=fields)
+
+    def endpoints(self):
+        return self.request("GET", "/v1/endpoint")[1]
+
+    def endpoint_put(self, endpoint_id: int, labels: Dict[str, str],
+                     ipv4: str = ""):
+        return self.request("PUT", f"/v1/endpoint/{endpoint_id}",
+                            body={"labels": labels, "ipv4": ipv4})
+
+    def endpoint_delete(self, endpoint_id: int):
+        return self.request("DELETE", f"/v1/endpoint/{endpoint_id}")
+
+    def policy_get(self):
+        return self.request("GET", "/v1/policy")[1]
+
+    def policy_put_yaml(self, yaml_text: str):
+        return self.request("PUT", "/v1/policy", body=yaml_text,
+                            content_type="application/yaml")
+
+    def policy_delete(self, labels):
+        return self.request("DELETE", "/v1/policy",
+                            body={"labels": list(labels)})
+
+    def identities(self):
+        return self.request("GET", "/v1/identity")[1]
+
+    def ipcache(self):
+        return self.request("GET", "/v1/ip")[1]
+
+    def fqdn_cache(self):
+        return self.request("GET", "/v1/fqdn/cache")[1]
+
+    def services(self):
+        return self.request("GET", "/v1/service")[1]
+
+    def metrics(self) -> str:
+        return self.request("GET", "/v1/metrics")[1]
+
+    def debuginfo(self):
+        return self.request("GET", "/v1/debuginfo")[1]

@@ -1,0 +1,218 @@
+"""REST API (runtime/api.py) + policy directory watcher tests."""
+
+import json
+import os
+import shutil
+import textwrap
+
+import pytest
+
+from cilium_tpu.agent import Agent
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import (
+    Flow,
+    HTTPInfo,
+    L7Type,
+    Protocol,
+    TrafficDirection,
+    Verdict,
+)
+from cilium_tpu.runtime.api import APIClient
+from cilium_tpu.runtime.watcher import PolicyDirWatcher
+
+CNP = textwrap.dedent("""\
+    apiVersion: cilium.io/v2
+    kind: CiliumNetworkPolicy
+    metadata: {name: api-test, namespace: default}
+    spec:
+      endpointSelector: {matchLabels: {app: service}}
+      ingress:
+        - fromEndpoints: [{matchLabels: {app: frontend}}]
+          toPorts:
+            - ports: [{port: "80", protocol: TCP}]
+              rules:
+                http: [{method: GET, path: "/api/.*"}]
+    """)
+
+
+@pytest.fixture
+def api_agent(tmp_path):
+    sock = str(tmp_path / "api.sock")
+    agent = Agent(Config(), api_socket_path=sock).start()
+    yield agent, APIClient(sock)
+    agent.stop()
+
+
+def _flow(src, dst, path="/api/x"):
+    return Flow(src_identity=src, dst_identity=dst, dport=80,
+                protocol=Protocol.TCP, direction=TrafficDirection.INGRESS,
+                l7=L7Type.HTTP,
+                http=HTTPInfo(method="GET", path=path, host="h"))
+
+
+def test_rest_endpoint_policy_flow(api_agent):
+    agent, c = api_agent
+    assert c.healthz()["status"] == "ok"
+
+    code, ep = c.endpoint_put(1, {"app": "service"}, ipv4="10.0.0.3")
+    assert code == 201 and ep["identity"] >= 256
+    code, peer = c.endpoint_put(2, {"app": "frontend"}, ipv4="10.0.0.4")
+    assert code == 201
+
+    code, body = c.policy_put_yaml(CNP)
+    assert code == 200 and body["count"] == 1
+    rules = c.policy_get()
+    assert rules["revision"] >= 1 and len(rules["rules"]) == 1
+
+    # verdicts honor the imported policy
+    out = agent.process_flows([
+        _flow(peer["identity"], ep["identity"]),
+        _flow(peer["identity"], ep["identity"], path="/admin"),
+    ])
+    import numpy as np
+
+    v = list(np.asarray(out["verdict"]))
+    assert v == [int(Verdict.REDIRECTED), int(Verdict.DROPPED)]
+
+    # introspection resources
+    assert {e["id"] for e in c.endpoints()} == {1, 2}
+    assert any(i["cidr"] == "10.0.0.3/32" for i in c.ipcache())
+    ids = c.identities()
+    assert any("k8s:app=service" in str(i["labels"]) for i in ids)
+    assert "cilium_tpu" in c.metrics()
+
+    # PUT same CNP again = upsert, not duplicate
+    code, _ = c.policy_put_yaml(CNP)
+    assert len(c.policy_get()["rules"]) == 1
+
+    # delete via API
+    code, _ = c.policy_delete(["k8s:io.cilium.k8s.policy.name=api-test"])
+    assert code == 200 and c.policy_get()["rules"] == []
+    code, _ = c.endpoint_delete(2)
+    assert code == 200
+    assert {e["id"] for e in c.endpoints()} == {1}
+
+
+def test_rest_config_patch_flips_engine_gate(api_agent):
+    agent, c = api_agent
+    c.endpoint_put(1, {"app": "service"}, ipv4="10.0.0.3")
+    assert c.config()["config"]["enable_tpu_offload"] is False
+    code, body = c.patch_config(enable_tpu_offload=True)
+    assert code == 200 and body["changed"] == {"enable_tpu_offload": True}
+    assert agent.config.enable_tpu_offload is True
+    # non-mutable field rejected
+    code, body = c.patch_config(pod_cidr="10.9.0.0/24")
+    assert code == 400
+
+
+def test_rest_errors(api_agent):
+    _, c = api_agent
+    code, body = c.request("GET", "/v1/endpoint/999")
+    assert code == 404
+    code, body = c.request("GET", "/v1/nope")
+    assert code == 404
+    code, body = c.request("PUT", "/v1/policy", body="kind: Nope",
+                           content_type="application/yaml")
+    assert code == 400
+    # malformed endpoint id is a client error, uniformly across methods
+    for method in ("GET", "PUT", "DELETE"):
+        code, _ = c.request(method, "/v1/endpoint/abc")
+        assert code == 400, method
+
+
+def test_rest_config_patch_is_atomic(api_agent):
+    agent, c = api_agent
+    code, body = c.request(
+        "PATCH", "/v1/config",
+        body={"enable_tpu_offload": True, "bogus": 1})
+    assert code == 400
+    # rejected request must not have mutated anything
+    assert agent.config.enable_tpu_offload is False
+
+
+def test_api_server_refuses_live_socket(api_agent, tmp_path):
+    agent, c = api_agent
+    from cilium_tpu.runtime.api import APIServer
+
+    with pytest.raises(FileExistsError):
+        APIServer(agent, agent.api_socket_path)  # live server present
+    # a plain file is never unlinked
+    f = tmp_path / "notasocket"
+    f.write_text("keep me")
+    with pytest.raises(FileExistsError):
+        APIServer(agent, str(f))
+    assert f.read_text() == "keep me"
+    # a stale socket IS replaced
+    stale = tmp_path / "stale.sock"
+    import socket as socket_mod
+
+    s = socket_mod.socket(socket_mod.AF_UNIX)
+    s.bind(str(stale))
+    s.close()  # bound but never listening → connect refused
+    srv = APIServer(agent, str(stale)).start()
+    assert APIClient(str(stale)).healthz()["status"] == "ok"
+    srv.stop()
+
+
+def test_watcher_bad_file_parsed_once(tmp_path):
+    from cilium_tpu.runtime.metrics import METRICS
+
+    agent = Agent(Config())
+    pdir = tmp_path / "policies"
+    pdir.mkdir()
+    w = PolicyDirWatcher(agent, str(pdir))
+    try:
+        f = pdir / "bad.yaml"
+        f.write_text("metadata: [broken")
+        os.utime(f, (1, 1))
+        before = METRICS.get("cilium_tpu_policy_watch_parse_errors_total")
+        w.scan_once()
+        w.scan_once()
+        w.scan_once()
+        after = METRICS.get("cilium_tpu_policy_watch_parse_errors_total")
+        assert after - before == 1  # unchanged bad file parsed once
+    finally:
+        agent.stop()
+
+
+def test_policy_dir_watcher_add_update_delete(tmp_path):
+    agent = Agent(Config())
+    pdir = tmp_path / "policies"
+    pdir.mkdir()
+    w = PolicyDirWatcher(agent, str(pdir))
+    try:
+        agent.endpoint_add(1, {"app": "service"})
+        agent.endpoint_add(2, {"app": "frontend"})
+
+        f = pdir / "cnp.yaml"
+        f.write_text(CNP)
+        assert w.scan_once() == 1
+        agent.endpoint_manager.regenerate_all(wait=True)
+        assert len(agent.repo.rules()) == 1
+
+        # unchanged mtime → no ops
+        assert w.scan_once() == 0
+
+        # update: different path regex, same name → still one rule set
+        os.utime(f, (1, 1))  # force mtime change
+        f2 = CNP.replace("/api/.*", "/only/.*")
+        f.write_text(f2)
+        os.utime(f, (2, 2))
+        assert w.scan_once() >= 1
+        assert len(agent.repo.rules()) == 1
+        rule = agent.repo.rules()[0]
+        assert any("/only/" in h.path for ing in rule.ingress
+                   for pr in ing.to_ports for h in pr.rules.http)
+
+        # parse error keeps previous state
+        f.write_text("kind: CiliumNetworkPolicy\nmetadata: [broken")
+        os.utime(f, (3, 3))
+        w.scan_once()
+        assert len(agent.repo.rules()) == 1
+
+        # delete file → rules gone
+        f.unlink()
+        assert w.scan_once() == 1
+        assert agent.repo.rules() == ()
+    finally:
+        agent.stop()
