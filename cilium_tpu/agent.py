@@ -42,7 +42,9 @@ class Agent:
                  state_dir: Optional[str] = None,
                  socket_path: Optional[str] = None,
                  api_socket_path: Optional[str] = None,
-                 policy_dir: Optional[str] = None):
+                 policy_dir: Optional[str] = None,
+                 dns_proxy_bind: Optional[tuple] = None,
+                 dns_upstream: tuple = ("127.0.0.53", 53)):
         self.config = config or Config.from_env()
         self.state_dir = state_dir
         # serializes compound mutations (endpoint/policy upserts) from
@@ -89,6 +91,11 @@ class Agent:
         self.api_socket_path = api_socket_path
         self.policy_watcher = None
         self.policy_dir = policy_dir
+        # transparent DNS proxy UDP wire path (§3.5); endpoint resolved
+        # from the client source address, as the reference's TPROXY does
+        self.dns_server = None
+        self.dns_proxy_bind = dns_proxy_bind
+        self.dns_upstream = dns_upstream
         # FQDN updates retrigger regeneration (§3.2 tail)
         self.name_manager.on_update = (
             lambda sels: self.endpoint_manager.regenerate_all())
@@ -126,6 +133,13 @@ class Agent:
 
             self.policy_watcher = PolicyDirWatcher(self, self.policy_dir)
             self.policy_watcher.register(self.controllers)
+        if self.dns_proxy_bind is not None:
+            from cilium_tpu.fqdn.server import DNSProxyServer
+
+            self.dns_server = DNSProxyServer(
+                self.dns_proxy, self._endpoint_of_ip,
+                upstream=self.dns_upstream,
+                bind=self.dns_proxy_bind).start()
         self.controllers.update("dns-gc", self._dns_gc, interval=60.0)
         self.controllers.update("clustermesh-heartbeat",
                                 self.publisher.heartbeat, interval=15.0)
@@ -141,6 +155,8 @@ class Agent:
         # policy for a shutdown teardown would be discarded work
         self.clustermesh.close()
         self.controllers.stop_all()
+        if self.dns_server is not None:
+            self.dns_server.stop()
         if self.api_server is not None:
             self.api_server.stop()
         if self.service is not None:
@@ -201,6 +217,17 @@ class Agent:
         for sel in self.name_manager.registered_selectors():
             if sel not in active:
                 self.name_manager.unregister_selector(sel)
+
+    def _endpoint_of_ip(self, ip: str) -> Optional[int]:
+        """Client source IP → endpoint id (DNS proxy's TPROXY role).
+        Loopback maps to the first endpoint for single-node testing."""
+        for ep in self.endpoint_manager.endpoints():
+            if ep.ipv4 == ip:
+                return ep.endpoint_id
+        if ip.startswith("127."):
+            for ep in self.endpoint_manager.endpoints():
+                return ep.endpoint_id
+        return None
 
     # -- endpoint API -----------------------------------------------------
     def endpoint_add(self, endpoint_id: int, labels: Dict[str, str],

@@ -9,5 +9,6 @@ and the DNS-proxy ``CheckAllowed`` verdict hot path (BASELINE config[0]).
 from cilium_tpu.fqdn.cache import DNSCache
 from cilium_tpu.fqdn.namemanager import NameManager
 from cilium_tpu.fqdn.dnsproxy import DNSProxy
+from cilium_tpu.fqdn.server import DNSProxyServer
 
-__all__ = ["DNSCache", "NameManager", "DNSProxy"]
+__all__ = ["DNSCache", "NameManager", "DNSProxy", "DNSProxyServer"]

@@ -1,0 +1,159 @@
+"""Transparent DNS proxy server (UDP wire path).
+
+Reference: ``pkg/fqdn/dnsproxy/proxy.go`` — the agent TPROXYs pod DNS
+to this proxy; per query it (1) maps the client source address to its
+endpoint, (2) runs ``CheckAllowed``, (3) on deny answers REFUSED
+without touching the network, (4) on allow forwards upstream, relays
+the answer, and feeds the observed IPs to the NameManager so FQDN
+selectors materialize as ipcache identities (SURVEY.md §3.5).
+
+This is the wire half on top of :class:`cilium_tpu.fqdn.dnsproxy
+.DNSProxy` (the verdict half), using the stdlib codec in ``wire.py``.
+Each query is served on a worker thread — upstream RTT never blocks
+the receive loop (the reference serves each request on a goroutine).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+from cilium_tpu.fqdn import wire
+from cilium_tpu.fqdn.dnsproxy import DNSProxy
+from cilium_tpu.runtime.metrics import METRICS
+
+#: verdict callback signature: (qname, endpoint_id, allowed, rcode)
+VerdictHook = Callable[[str, int, bool, int], None]
+
+
+class DNSProxyServer:
+    """Serve DNS on a UDP socket, enforcing the proxy's allow-rules.
+
+    ``endpoint_of``: maps a client source IP to its endpoint id
+    (the reference derives this from the socket's original destination
+    + endpoint lookup); return None for unknown clients → REFUSED.
+    """
+
+    def __init__(
+        self,
+        proxy: DNSProxy,
+        endpoint_of: Callable[[str], Optional[int]],
+        upstream: Tuple[str, int] = ("127.0.0.53", 53),
+        bind: Tuple[str, int] = ("127.0.0.1", 0),
+        dport: int = 53,
+        timeout: float = 2.0,
+        on_verdict: Optional[VerdictHook] = None,
+    ) -> None:
+        self.proxy = proxy
+        self.endpoint_of = endpoint_of
+        self.upstream = upstream
+        self.dport = dport
+        self.timeout = timeout
+        self.on_verdict = on_verdict
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind(bind)
+        self._sock.settimeout(0.5)
+        self.address = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "DNSProxyServer":
+        self._thread = threading.Thread(
+            target=self._serve, name="dns-proxy", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self._sock.close()
+
+    # -- serve loop -------------------------------------------------------
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                data, client = self._sock.recvfrom(4096)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(
+                target=self._handle, args=(data, client), daemon=True
+            ).start()
+
+    def _reply(self, client, query: bytes, rcode: int) -> None:
+        try:
+            self._sock.sendto(wire.encode_response(query, rcode), client)
+        except (OSError, wire.DNSDecodeError):
+            pass
+
+    def _handle(self, data: bytes, client) -> None:
+        try:
+            msg = wire.decode(data)
+        except wire.DNSDecodeError:
+            METRICS.inc("cilium_tpu_fqdn_malformed_queries_total", 1)
+            return  # not even parseable enough to answer
+        if msg.is_response or not msg.questions:
+            return
+        qname = msg.qname
+        ep = self.endpoint_of(client[0])
+        if ep is None:
+            METRICS.inc("cilium_tpu_fqdn_unknown_client_total", 1)
+            self._reply(client, data, wire.RCODE_REFUSED)
+            return
+        allowed = self.proxy.check_allowed(ep, self.dport, qname)
+        METRICS.inc("cilium_tpu_fqdn_queries_total", 1,
+                    labels={"verdict": "allow" if allowed else "deny"})
+        if not allowed:
+            if self.on_verdict:
+                self.on_verdict(qname, ep, False, wire.RCODE_REFUSED)
+            self._reply(client, data, wire.RCODE_REFUSED)
+            return
+
+        # forward upstream on a fresh, CONNECTED socket: connect() makes
+        # the kernel reject datagrams from any other source address, and
+        # the txid + question check below rejects off-path forgeries
+        # racing the resolver — both must pass before the answer is
+        # relayed or observed (cache-poisoning guard)
+        up = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        resp = None
+        try:
+            up.settimeout(self.timeout)
+            up.connect(self.upstream)
+            up.send(data)
+            deadline = time.monotonic() + self.timeout
+            while resp is None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise socket.timeout()
+                up.settimeout(remaining)
+                candidate = up.recv(4096)
+                try:
+                    parsed = wire.decode(candidate)
+                except wire.DNSDecodeError:
+                    continue  # garbage from the right address: keep waiting
+                if (parsed.txid == msg.txid and parsed.is_response
+                        and parsed.qname.lower() == qname.lower()):
+                    resp = candidate
+        except (socket.timeout, OSError):
+            METRICS.inc("cilium_tpu_fqdn_upstream_timeouts_total", 1)
+            self._reply(client, data, 2)  # SERVFAIL
+            return
+        finally:
+            up.close()
+
+        ips = [a.ip for a in parsed.answers if a.ip]
+        if ips and parsed.rcode == wire.RCODE_NOERROR:
+            ttl = min((a.ttl for a in parsed.answers if a.ip), default=0)
+            self.proxy.observe_response(time.time(), qname, ips,
+                                        ttl=int(ttl))
+        if self.on_verdict:
+            self.on_verdict(qname, ep, True, parsed.rcode)
+        try:
+            self._sock.sendto(resp, client)
+        except OSError:
+            pass

@@ -194,7 +194,9 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     for cnp in cnps:
                         # upsert: a CNP update replaces same-name rules
                         agent.policy_delete(list(cnp.labels), wait=False)
-                        rev = agent.policy_add(cnp)
+                        rev = agent.policy_add(cnp, wait=False)
+                    # ONE regeneration for the whole body, not per CNP
+                    agent.endpoint_manager.regenerate_all(wait=True)
                 return self._send(200, {"revision": rev,
                                         "count": len(cnps)})
             return self._send(404, {"error": f"no such resource {path}"})
@@ -207,19 +209,29 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         try:
             if path == "/v1/config":
                 body = json.loads(self._body() or b"{}")
-                # validate ALL keys first: a rejected request must not
-                # leave earlier fields mutated
-                for k in body:
+                # validate ALL keys and value types first: a rejected
+                # request must not leave earlier fields mutated, and a
+                # JSON string "false" must not truthy-enable a bool gate
+                for k, v in body.items():
                     if k not in _MUTABLE_CONFIG:
                         return self._send(
                             400, {"error": f"config field {k!r} is not "
                                   f"runtime-mutable"})
+                    want = type(getattr(agent.config, k))
+                    if not isinstance(v, want):
+                        return self._send(
+                            400, {"error": f"config field {k!r} expects "
+                                  f"{want.__name__}, got "
+                                  f"{type(v).__name__}"})
                 with agent.write_lock:
                     for k, v in body.items():
                         setattr(agent.config, k, v)
                     if "enable_tpu_offload" in body:
-                        # the gate flips the loader's engine selection —
-                        # restage, like the reference's datapath reload
+                        # the gate selects the loader's engine AND the
+                        # DNS proxy's matcher — flip both, then restage
+                        # (the reference's datapath reload)
+                        agent.dns_proxy.use_tpu = bool(
+                            body["enable_tpu_offload"])
                         agent.endpoint_manager.regenerate_all(wait=True)
                 return self._send(200, {"changed": dict(body)})
             return self._send(404, {"error": f"no such resource {path}"})
@@ -242,8 +254,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 body = json.loads(self._body() or b"{}")
                 labels = list(body.get("labels", ()))
                 with agent.write_lock:
-                    rev = agent.policy_delete(labels)
-                return self._send(200, {"revision": rev})
+                    deleted = agent.policy_delete(labels)
+                    rev = agent.repo.revision
+                return self._send(200, {"deleted": deleted,
+                                        "revision": rev})
             return self._send(404, {"error": f"no such resource {path}"})
         except Exception as e:
             return self._send(400, {"error": f"{type(e).__name__}: {e}"})

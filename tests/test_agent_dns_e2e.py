@@ -1,0 +1,82 @@
+"""Agent-integrated DNS proxy e2e: toFQDNs CNP → wire query → ipcache.
+
+The full §3.5 loop on real sockets: a pod (loopback client) resolves a
+name through the agent's transparent DNS proxy; the allowed answer's IP
+becomes a CIDR identity via the NameManager, and a subsequent egress
+flow to that IP is allowed by the toFQDNs-derived policy.
+"""
+
+import socket
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Agent
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import Flow, Protocol, TrafficDirection, Verdict
+from tests.test_dns_wire import FakeUpstream, _client_ask
+
+CNP = textwrap.dedent("""\
+    apiVersion: cilium.io/v2
+    kind: CiliumNetworkPolicy
+    metadata: {name: fqdn-egress, namespace: default}
+    spec:
+      endpointSelector: {matchLabels: {app: client}}
+      egress:
+        - toPorts:
+            - ports: [{port: "53", protocol: UDP}]
+              rules:
+                dns: [{matchPattern: "*.svc.example.com"}]
+        - toFQDNs:
+            - matchPattern: "*.svc.example.com"
+    """)
+
+
+def test_agent_dns_proxy_to_fqdn_identity():
+    upstream = FakeUpstream(ips=("198.51.100.7",), ttl=300)
+    agent = Agent(Config(), dns_proxy_bind=("127.0.0.1", 0),
+                  dns_upstream=upstream.address).start()
+    try:
+        ep = agent.endpoint_add(1, {"app": "client"}, ipv4="10.0.0.2")
+        import yaml
+
+        from cilium_tpu.policy.api.cnp import parse_cnp
+
+        agent.policy_add(parse_cnp(yaml.safe_load(CNP)))
+
+        # denied name: REFUSED, nothing cached
+        msg = _client_ask(agent.dns_server.address, "evil.attacker.io")
+        assert msg.rcode == 5
+        assert upstream.queries == []
+
+        # allowed name: forwarded, answered, identity materialized
+        msg = _client_ask(agent.dns_server.address, "api.svc.example.com")
+        assert msg.rcode == 0
+        assert [a.ip for a in msg.answers] == ["198.51.100.7"]
+
+        deadline = time.time() + 3
+        while time.time() < deadline:
+            if agent.ipcache.lookup("198.51.100.7") is not None:
+                break
+            time.sleep(0.02)
+        nid = agent.ipcache.lookup("198.51.100.7")
+        assert nid is not None
+
+        # egress flow to the resolved IP is allowed by the toFQDNs rule
+        agent.endpoint_manager.regenerate_all(wait=True)
+        out = agent.process_flows([
+            Flow(src_identity=ep.identity, dst_identity=int(nid),
+                 dport=443, protocol=Protocol.TCP,
+                 direction=TrafficDirection.EGRESS),
+            Flow(src_identity=ep.identity, dst_identity=2,  # world
+                 dport=443, protocol=Protocol.TCP,
+                 direction=TrafficDirection.EGRESS),
+        ])
+        v = list(np.asarray(out["verdict"]))
+        assert v[0] == int(Verdict.FORWARDED)
+        assert v[1] == int(Verdict.DROPPED)
+    finally:
+        agent.stop()
+        upstream.close()

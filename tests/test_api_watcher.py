@@ -128,6 +128,20 @@ def test_rest_config_patch_is_atomic(api_agent):
     assert code == 400
     # rejected request must not have mutated anything
     assert agent.config.enable_tpu_offload is False
+    # wrong TYPE is rejected too: the string "false" is truthy and must
+    # not enable a bool gate
+    code, body = c.request("PATCH", "/v1/config",
+                           body={"enable_tpu_offload": "false"})
+    assert code == 400
+    assert agent.config.enable_tpu_offload is False
+
+
+def test_rest_config_patch_flips_dns_proxy_gate(api_agent):
+    agent, c = api_agent
+    assert agent.dns_proxy.use_tpu is False
+    code, _ = c.patch_config(enable_tpu_offload=True)
+    assert code == 200
+    assert agent.dns_proxy.use_tpu is True
 
 
 def test_api_server_refuses_live_socket(api_agent, tmp_path):

@@ -1,0 +1,227 @@
+"""DNS wire codec + transparent proxy server tests (pkg/fqdn/dnsproxy
+wire path analog)."""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from cilium_tpu.fqdn import wire
+from cilium_tpu.fqdn.cache import DNSCache
+from cilium_tpu.fqdn.dnsproxy import DNSProxy
+from cilium_tpu.fqdn.namemanager import NameManager
+from cilium_tpu.fqdn.server import DNSProxyServer
+from cilium_tpu.policy.api.l7 import PortRuleDNS
+
+
+# ------------------------------------------------------------------ codec --
+def test_query_roundtrip():
+    q = wire.encode_query(0x1234, "www.example.com")
+    msg = wire.decode(q)
+    assert msg.txid == 0x1234
+    assert not msg.is_response
+    assert msg.qname == "www.example.com"
+    assert msg.questions[0].qtype == wire.QTYPE_A
+
+
+def test_response_with_answers_roundtrip():
+    q = wire.encode_query(7, "a.io")
+    resp = wire.encode_response(q, wire.RCODE_NOERROR, answers=[
+        ("a.io", wire.QTYPE_A, 300, bytes([10, 1, 2, 3])),
+        ("a.io", wire.QTYPE_A, 60, bytes([10, 1, 2, 4])),
+    ])
+    msg = wire.decode(resp)
+    assert msg.is_response and msg.rcode == wire.RCODE_NOERROR
+    assert msg.txid == 7 and msg.qname == "a.io"
+    assert [a.ip for a in msg.answers] == ["10.1.2.3", "10.1.2.4"]
+    assert [a.ttl for a in msg.answers] == [300, 60]
+
+
+def test_compression_pointer_decode():
+    # hand-built: question www.example.com, answer name = pointer to it
+    hdr = struct.pack("!6H", 1, 0x8180, 1, 1, 0, 0)
+    name = wire.encode_name("www.example.com")
+    question = name + struct.pack("!HH", 1, 1)
+    ptr = bytes([0xC0, 12])  # points at the question name (offset 12)
+    answer = ptr + struct.pack("!HHIH", 1, 1, 60, 4) + bytes([1, 2, 3, 4])
+    msg = wire.decode(hdr + question + answer)
+    assert msg.answers[0].name == "www.example.com"
+    assert msg.answers[0].ip == "1.2.3.4"
+
+
+def test_decode_rejects_malformed():
+    with pytest.raises(wire.DNSDecodeError):
+        wire.decode(b"\x00" * 5)  # short header
+    # compression loop: pointer at offset 12 pointing to itself
+    hdr = struct.pack("!6H", 1, 0, 1, 0, 0, 0)
+    with pytest.raises(wire.DNSDecodeError):
+        wire.decode(hdr + bytes([0xC0, 12]) + b"\x00\x01\x00\x01")
+    with pytest.raises(wire.DNSDecodeError):
+        wire.decode(struct.pack("!6H", 1, 0, 1, 0, 0, 0) + bytes([63]))
+
+
+# ------------------------------------------------------------------ proxy --
+class FakeUpstream:
+    """In-process resolver answering every A query with fixed IPs."""
+
+    def __init__(self, ips=("192.0.2.10",), ttl=120, rcode=0):
+        self.ips, self.ttl, self.rcode = list(ips), ttl, rcode
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.settimeout(0.5)
+        self.address = self.sock.getsockname()
+        self.queries = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                data, client = self.sock.recvfrom(4096)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            msg = wire.decode(data)
+            self.queries.append(msg.qname)
+            answers = [
+                (msg.qname, wire.QTYPE_A, self.ttl,
+                 socket.inet_aton(ip))
+                for ip in self.ips
+            ] if self.rcode == 0 else []
+            self.sock.sendto(
+                wire.encode_response(data, self.rcode, answers), client)
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=2)
+        self.sock.close()
+
+
+def _client_ask(addr, qname, txid=42, timeout=3.0):
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.settimeout(timeout)
+    try:
+        s.sendto(wire.encode_query(txid, qname), addr)
+        data, _ = s.recvfrom(4096)
+    finally:
+        s.close()
+    return wire.decode(data)
+
+
+@pytest.fixture
+def proxy_stack():
+    upstream = FakeUpstream()
+    cache = DNSCache()
+    nm = NameManager(None, None, cache)
+    proxy = DNSProxy(name_manager=nm)
+    proxy.update_allowed(7, 53, [PortRuleDNS(match_pattern="*.allowed.io")])
+    verdicts = []
+    server = DNSProxyServer(
+        proxy,
+        endpoint_of=lambda ip: 7 if ip == "127.0.0.1" else None,
+        upstream=upstream.address,
+        on_verdict=lambda *a: verdicts.append(a),
+    ).start()
+    yield upstream, cache, server, verdicts
+    server.stop()
+    upstream.close()
+
+
+def test_allowed_query_forwarded_and_observed(proxy_stack):
+    upstream, cache, server, verdicts = proxy_stack
+    msg = _client_ask(server.address, "api.allowed.io")
+    assert msg.rcode == wire.RCODE_NOERROR
+    assert [a.ip for a in msg.answers] == ["192.0.2.10"]
+    assert msg.txid == 42                       # txid relayed unchanged
+    assert upstream.queries == ["api.allowed.io"]
+    # observed answer landed in the DNS cache (NameManager path)
+    deadline = time.time() + 2
+    while time.time() < deadline:
+        if cache.lookup("api.allowed.io"):
+            break
+        time.sleep(0.01)
+    assert cache.lookup("api.allowed.io") == ["192.0.2.10"]
+    assert verdicts == [("api.allowed.io", 7, True, 0)]
+
+
+def test_denied_query_refused_without_upstream(proxy_stack):
+    upstream, cache, server, verdicts = proxy_stack
+    msg = _client_ask(server.address, "evil.example.com")
+    assert msg.rcode == wire.RCODE_REFUSED
+    assert msg.answers == []
+    assert upstream.queries == []               # never left the proxy
+    assert cache.lookup("evil.example.com") == []
+    assert verdicts == [("evil.example.com", 7, False, wire.RCODE_REFUSED)]
+
+
+def test_unknown_client_refused():
+    upstream = FakeUpstream()
+    proxy = DNSProxy()
+    server = DNSProxyServer(
+        proxy, endpoint_of=lambda ip: None,
+        upstream=upstream.address).start()
+    try:
+        msg = _client_ask(server.address, "x.io")
+        assert msg.rcode == wire.RCODE_REFUSED
+    finally:
+        server.stop()
+        upstream.close()
+
+
+class ForgingUpstream(FakeUpstream):
+    """Replies with a WRONG txid (an off-path forgery analog)."""
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                data, client = self.sock.recvfrom(4096)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            msg = wire.decode(data)
+            self.queries.append(msg.qname)
+            forged = bytearray(wire.encode_response(data, 0, [
+                (msg.qname, wire.QTYPE_A, 60, socket.inet_aton("6.6.6.6"))
+            ]))
+            struct.pack_into("!H", forged, 0, (msg.txid + 1) & 0xFFFF)
+            self.sock.sendto(bytes(forged), client)
+
+
+def test_forged_txid_never_relayed_or_observed():
+    upstream = ForgingUpstream()
+    cache = DNSCache()
+    nm = NameManager(None, None, cache)
+    proxy = DNSProxy(name_manager=nm)
+    proxy.update_allowed(7, 53, [PortRuleDNS(match_pattern="*")])
+    server = DNSProxyServer(
+        proxy, endpoint_of=lambda ip: 7,
+        upstream=upstream.address, timeout=0.4).start()
+    try:
+        msg = _client_ask(server.address, "www.bank.com", timeout=5.0)
+        assert msg.rcode == 2                   # SERVFAIL, not the forgery
+        assert cache.lookup("www.bank.com") == []  # nothing poisoned
+    finally:
+        server.stop()
+        upstream.close()
+
+
+def test_upstream_timeout_is_servfail():
+    # point at a socket nobody answers on
+    dead = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    dead.bind(("127.0.0.1", 0))
+    proxy = DNSProxy()
+    proxy.update_allowed(7, 53, [PortRuleDNS(match_pattern="*")])
+    server = DNSProxyServer(
+        proxy, endpoint_of=lambda ip: 7,
+        upstream=dead.getsockname(), timeout=0.3).start()
+    try:
+        msg = _client_ask(server.address, "slow.io", timeout=5.0)
+        assert msg.rcode == 2                   # SERVFAIL
+    finally:
+        server.stop()
+        dead.close()
