@@ -111,7 +111,7 @@ def main() -> int:
         bs = cfg.engine.batch_size
         n_total = fb.size
         n_chunks = n_total // bs
-        if n_chunks < args.warmup + 2:
+        if n_chunks < args.warmup + 4:  # compile + >=1 latency + >=2 tput
             print(json.dumps({"metric": "bench_failed_setup", "value": 0,
                               "unit": "too few chunks", "vs_baseline": 0.0}))
             return 1
@@ -127,20 +127,34 @@ def main() -> int:
             out = step(arrays, chunks[1 + i])
         jax.block_until_ready(out)
 
+        # latency pass: block per chunk (p50/p99 are per-batch latency);
+        # uses the first few timed chunks, which the throughput pass then
+        # skips so every throughput-timed buffer is still first-use
+        n_lat = max(1, min(8, n_chunks - 1 - args.warmup - 2))
         times = []
-        t_stream0 = time.perf_counter()
-        for c in range(1 + args.warmup, n_chunks):
+        for c in range(1 + args.warmup, 1 + args.warmup + n_lat):
             t0 = time.perf_counter()
             out = step(arrays, chunks[c])
             jax.block_until_ready(out)
             times.append(time.perf_counter() - t0)
+        # throughput pass: dispatch the whole remaining stream and sync
+        # ONCE — chunks are distinct first-use buffers already resident
+        # in HBM, so this measures pipelined device execution, which is
+        # how a real flow stream runs (compute overlaps dispatch)
+        first = 1 + args.warmup + n_lat
+        t_stream0 = time.perf_counter()
+        outs = []
+        for c in range(first, n_chunks):
+            outs.append(step(arrays, chunks[c]))
+        jax.block_until_ready(outs)
         t_stream = time.perf_counter() - t_stream0
-        n_timed = (n_chunks - 1 - args.warmup) * bs
+        out = outs[-1]
+        n_timed = (n_chunks - first) * bs
         vps = n_timed / t_stream
         times.sort()
         p99 = times[min(len(times) - 1, int(len(times) * 0.99))]
         log(f"streamed {n_timed} of {n_total} flows in {t_stream:.3f}s "
-            f"(chunk={bs}, p50={times[len(times)//2]*1e3:.2f}ms, "
+            f"(chunk={bs}, per-chunk p50={times[len(times)//2]*1e3:.2f}ms, "
             f"p99={p99*1e3:.2f}ms) verdicts/s={vps:,.0f}")
     else:
         # Distinct, differently-permuted device copies per call — warmup
@@ -148,7 +162,9 @@ def main() -> int:
         # can shortcut repeat executions. Built from HOST numpy: a device
         # round trip here would poison the process (docs/PLATFORM.md).
         prng = np.random.default_rng(0)
-        n_copies = args.warmup + args.iters + 1
+        # compile + warmup + latency iters + throughput iters, ALL
+        # distinct permuted copies so every timed call is first-use
+        n_copies = args.warmup + 2 * args.iters + 1
         batches = []
         for _ in range(n_copies):
             perm = prng.permutation(fb.size)
@@ -162,6 +178,7 @@ def main() -> int:
             out = step(arrays, batches[1 + i])
         jax.block_until_ready(out)
 
+        # latency pass: block per call (median/worst per-batch latency)
         times = []
         for i in range(args.iters):
             batch = batches[1 + args.warmup + i]
@@ -172,9 +189,20 @@ def main() -> int:
         times.sort()
         med = times[len(times) // 2]
         n = len(scenario.flows)
-        vps = n / med
-        log(f"batch={n} median={med*1e3:.2f}ms p99-ish={times[-1]*1e3:.2f}ms "
-            f"verdicts/s={vps:,.0f}")
+        # throughput pass: dispatch every timed batch (distinct permuted
+        # first-use buffers, pre-staged in HBM) and sync ONCE — compute
+        # overlaps dispatch, as a real replay pipeline runs
+        base = 1 + args.warmup + args.iters
+        t0 = time.perf_counter()
+        outs = [step(arrays, batches[base + i])
+                for i in range(args.iters)]
+        jax.block_until_ready(outs)
+        t_all = time.perf_counter() - t0
+        out = outs[-1]
+        vps = n * args.iters / t_all
+        log(f"batch={n} latency: median={med*1e3:.2f}ms "
+            f"p99-ish={times[-1]*1e3:.2f}ms ({n/med:,.0f}/s blocking); "
+            f"pipelined verdicts/s={vps:,.0f}")
 
     # ---- timing is over; readbacks are safe now -----------------------
     log(f"verdict mix: "

@@ -44,7 +44,8 @@ class Agent:
                  api_socket_path: Optional[str] = None,
                  policy_dir: Optional[str] = None,
                  dns_proxy_bind: Optional[tuple] = None,
-                 dns_upstream: tuple = ("127.0.0.53", 53)):
+                 dns_upstream: tuple = ("127.0.0.53", 53),
+                 dns_endpoint_of=None):
         self.config = config or Config.from_env()
         self.state_dir = state_dir
         # serializes compound mutations (endpoint/policy upserts) from
@@ -96,6 +97,7 @@ class Agent:
         self.dns_server = None
         self.dns_proxy_bind = dns_proxy_bind
         self.dns_upstream = dns_upstream
+        self.dns_endpoint_of = dns_endpoint_of  # client IP → endpoint id
         # FQDN updates retrigger regeneration (§3.2 tail)
         self.name_manager.on_update = (
             lambda sels: self.endpoint_manager.regenerate_all())
@@ -137,7 +139,8 @@ class Agent:
             from cilium_tpu.fqdn.server import DNSProxyServer
 
             self.dns_server = DNSProxyServer(
-                self.dns_proxy, self._endpoint_of_ip,
+                self.dns_proxy,
+                self.dns_endpoint_of or self._endpoint_of_ip,
                 upstream=self.dns_upstream,
                 bind=self.dns_proxy_bind).start()
         self.controllers.update("dns-gc", self._dns_gc, interval=60.0)
@@ -220,12 +223,10 @@ class Agent:
 
     def _endpoint_of_ip(self, ip: str) -> Optional[int]:
         """Client source IP → endpoint id (DNS proxy's TPROXY role).
-        Loopback maps to the first endpoint for single-node testing."""
+        Unknown sources get None → REFUSED; pass ``dns_endpoint_of`` to
+        override the mapping (e.g. loopback harnesses)."""
         for ep in self.endpoint_manager.endpoints():
             if ep.ipv4 == ip:
-                return ep.endpoint_id
-        if ip.startswith("127."):
-            for ep in self.endpoint_manager.endpoints():
                 return ep.endpoint_id
         return None
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 import socket
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional, Tuple
 
 from cilium_tpu.fqdn import wire
@@ -58,6 +59,10 @@ class DNSProxyServer:
         self.address = self._sock.getsockname()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # bounded worker pool; stop() drains it so no handler outlives
+        # the server (a late upstream answer must not race agent teardown)
+        self._pool = ThreadPoolExecutor(max_workers=16,
+                                        thread_name_prefix="dns-handler")
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "DNSProxyServer":
@@ -70,6 +75,7 @@ class DNSProxyServer:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
+        self._pool.shutdown(wait=True)  # bounded by the upstream timeout
         self._sock.close()
 
     # -- serve loop -------------------------------------------------------
@@ -81,9 +87,10 @@ class DNSProxyServer:
                 continue
             except OSError:
                 break
-            threading.Thread(
-                target=self._handle, args=(data, client), daemon=True
-            ).start()
+            try:
+                self._pool.submit(self._handle, data, client)
+            except RuntimeError:
+                break  # pool shut down mid-stop
 
     def _reply(self, client, query: bytes, rcode: int) -> None:
         try:
@@ -141,7 +148,7 @@ class DNSProxyServer:
                     resp = candidate
         except (socket.timeout, OSError):
             METRICS.inc("cilium_tpu_fqdn_upstream_timeouts_total", 1)
-            self._reply(client, data, 2)  # SERVFAIL
+            self._reply(client, data, wire.RCODE_SERVFAIL)
             return
         finally:
             up.close()

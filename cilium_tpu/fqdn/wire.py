@@ -19,6 +19,7 @@ QTYPE_CNAME = 5
 QTYPE_AAAA = 28
 
 RCODE_NOERROR = 0
+RCODE_SERVFAIL = 2
 RCODE_NXDOMAIN = 3
 RCODE_REFUSED = 5
 
@@ -106,7 +107,13 @@ def encode_name(name: str) -> bytes:
     for label in name.rstrip(".").split("."):
         if not label:
             continue
-        raw = label.encode("ascii")
+        try:
+            raw = label.encode("ascii")
+        except UnicodeEncodeError as e:
+            # names decoded with replacement chars (non-ASCII labels on
+            # the wire) must fail as a DNS error the caller handles, not
+            # as a stray UnicodeEncodeError killing a handler thread
+            raise DNSDecodeError(f"non-ASCII label {label!r}") from e
         if len(raw) > 63:
             raise DNSDecodeError(f"label too long: {label!r}")
         out.append(len(raw))
@@ -149,21 +156,43 @@ def encode_query(txid: int, qname: str, qtype: int = QTYPE_A) -> bytes:
     return header + encode_name(qname) + struct.pack("!HH", qtype, 1)
 
 
+def _question_section_end(data: bytes, qd: int) -> int:
+    """Offset one past the last question (names walked, not decoded)."""
+    off = 12
+    for _ in range(qd):
+        while True:
+            if off >= len(data):
+                raise DNSDecodeError("question runs past message end")
+            length = data[off]
+            if length & 0xC0 == 0xC0:
+                off += 2
+                break
+            off += 1 + length
+            if length == 0:
+                break
+        off += 4  # qtype + qclass
+        if off > len(data):
+            raise DNSDecodeError("truncated question")
+    return off
+
+
 def encode_response(query: bytes, rcode: int,
                     answers: Optional[List[Tuple[str, int, int, bytes]]] =
                     None) -> bytes:
     """Build a response reusing the query's header id + question bytes.
 
-    ``answers``: (name, rtype, ttl, rdata) tuples, names encoded
-    uncompressed.
+    The question section is echoed VERBATIM (non-ASCII labels survive
+    round-trip, as real servers do). ``answers``: (name, rtype, ttl,
+    rdata) tuples, names encoded uncompressed.
     """
-    msg = decode(query)
+    if len(query) < 12:
+        raise DNSDecodeError("query shorter than header")
+    txid, _flags, qd = struct.unpack("!3H", query[:6])[0:3]
+    qend = _question_section_end(query, qd)
     flags = 0x8180 | (rcode & 0xF)  # QR|RD|RA + rcode
     answers = answers or []
-    out = bytearray(struct.pack(
-        "!6H", msg.txid, flags, len(msg.questions), len(answers), 0, 0))
-    for q in msg.questions:
-        out += encode_name(q.qname) + struct.pack("!HH", q.qtype, q.qclass)
+    out = bytearray(struct.pack("!6H", txid, flags, qd, len(answers), 0, 0))
+    out += query[12:qend]
     for name, rtype, ttl, rdata in answers:
         out += encode_name(name) + struct.pack(
             "!HHIH", rtype, 1, ttl, len(rdata)) + rdata

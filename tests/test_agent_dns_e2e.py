@@ -36,8 +36,10 @@ CNP = textwrap.dedent("""\
 
 def test_agent_dns_proxy_to_fqdn_identity():
     upstream = FakeUpstream(ips=("198.51.100.7",), ttl=300)
+    # loopback harness: the test client's 127.0.0.1 maps to endpoint 1
     agent = Agent(Config(), dns_proxy_bind=("127.0.0.1", 0),
-                  dns_upstream=upstream.address).start()
+                  dns_upstream=upstream.address,
+                  dns_endpoint_of=lambda ip: 1).start()
     try:
         ep = agent.endpoint_add(1, {"app": "client"}, ipv4="10.0.0.2")
         import yaml

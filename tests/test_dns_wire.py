@@ -51,6 +51,28 @@ def test_compression_pointer_decode():
     assert msg.answers[0].ip == "1.2.3.4"
 
 
+def test_non_ascii_qname_denied_not_crashed(proxy_stack):
+    """A label byte >= 0x80 decodes with replacement chars; the denial
+    reply must come back as REFUSED, not die in encode_name."""
+    upstream, cache, server, verdicts = proxy_stack
+    hdr = struct.pack("!6H", 9, 0x0100, 1, 0, 0, 0)
+    name = bytes([4, 0xC3, 0xA9, 0x76, 0x6C]) + wire.encode_name("example.com")
+    query = hdr + name + struct.pack("!HH", 1, 1)
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.settimeout(3.0)
+    try:
+        s.sendto(query, server.address)
+        data, _ = s.recvfrom(4096)
+    finally:
+        s.close()
+    # the question bytes are echoed verbatim in a REFUSED reply;
+    # upstream is never consulted
+    msg = wire.decode(data)
+    assert msg.rcode == wire.RCODE_REFUSED
+    assert msg.txid == 9
+    assert upstream.queries == []
+
+
 def test_decode_rejects_malformed():
     with pytest.raises(wire.DNSDecodeError):
         wire.decode(b"\x00" * 5)  # short header
@@ -203,7 +225,7 @@ def test_forged_txid_never_relayed_or_observed():
         upstream=upstream.address, timeout=0.4).start()
     try:
         msg = _client_ask(server.address, "www.bank.com", timeout=5.0)
-        assert msg.rcode == 2                   # SERVFAIL, not the forgery
+        assert msg.rcode == wire.RCODE_SERVFAIL  # not the forgery
         assert cache.lookup("www.bank.com") == []  # nothing poisoned
     finally:
         server.stop()
@@ -221,7 +243,7 @@ def test_upstream_timeout_is_servfail():
         upstream=dead.getsockname(), timeout=0.3).start()
     try:
         msg = _client_ask(server.address, "slow.io", timeout=5.0)
-        assert msg.rcode == 2                   # SERVFAIL
+        assert msg.rcode == wire.RCODE_SERVFAIL
     finally:
         server.stop()
         dead.close()
