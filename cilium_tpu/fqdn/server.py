@@ -87,8 +87,14 @@ class DNSProxyServer:
                 continue
             except OSError:
                 break
+            # decode + endpoint + verdict run INLINE (microseconds, no
+            # network I/O) so denials never convoy behind handlers stuck
+            # on a dead upstream; only allowed queries hit the pool
+            fwd = self._verdict_phase(data, client)
+            if fwd is None:
+                continue
             try:
-                self._pool.submit(self._handle, data, client)
+                self._pool.submit(self._forward, data, client, *fwd)
             except RuntimeError:
                 break  # pool shut down mid-stop
 
@@ -98,20 +104,23 @@ class DNSProxyServer:
         except (OSError, wire.DNSDecodeError):
             pass
 
-    def _handle(self, data: bytes, client) -> None:
+    def _verdict_phase(self, data: bytes, client):
+        """Fast path, runs on the serve loop: decode, map the client to
+        an endpoint, evaluate the verdict, answer denials immediately.
+        Returns (msg, qname, ep) when the query should be forwarded."""
         try:
             msg = wire.decode(data)
         except wire.DNSDecodeError:
             METRICS.inc("cilium_tpu_fqdn_malformed_queries_total", 1)
-            return  # not even parseable enough to answer
+            return None  # not even parseable enough to answer
         if msg.is_response or not msg.questions:
-            return
+            return None
         qname = msg.qname
         ep = self.endpoint_of(client[0])
         if ep is None:
             METRICS.inc("cilium_tpu_fqdn_unknown_client_total", 1)
             self._reply(client, data, wire.RCODE_REFUSED)
-            return
+            return None
         allowed = self.proxy.check_allowed(ep, self.dport, qname)
         METRICS.inc("cilium_tpu_fqdn_queries_total", 1,
                     labels={"verdict": "allow" if allowed else "deny"})
@@ -119,8 +128,11 @@ class DNSProxyServer:
             if self.on_verdict:
                 self.on_verdict(qname, ep, False, wire.RCODE_REFUSED)
             self._reply(client, data, wire.RCODE_REFUSED)
-            return
+            return None
+        return (msg, qname, ep)
 
+    def _forward(self, data: bytes, client, msg, qname: str,
+                 ep: int) -> None:
         # forward upstream on a fresh, CONNECTED socket: connect() makes
         # the kernel reject datagrams from any other source address, and
         # the txid + question check below rejects off-path forgeries

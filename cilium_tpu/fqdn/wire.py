@@ -157,19 +157,10 @@ def encode_query(txid: int, qname: str, qtype: int = QTYPE_A) -> bytes:
 
 
 def _question_section_end(data: bytes, qd: int) -> int:
-    """Offset one past the last question (names walked, not decoded)."""
+    """Offset one past the last question (reuses the decode walker)."""
     off = 12
     for _ in range(qd):
-        while True:
-            if off >= len(data):
-                raise DNSDecodeError("question runs past message end")
-            length = data[off]
-            if length & 0xC0 == 0xC0:
-                off += 2
-                break
-            off += 1 + length
-            if length == 0:
-                break
+        _, off = _decode_name(data, off)
         off += 4  # qtype + qclass
         if off > len(data):
             raise DNSDecodeError("truncated question")

@@ -1,0 +1,160 @@
+"""Property-based differential tests (hypothesis).
+
+SURVEY.md §4: "hypothesis: TPU verdicts ≡ Python `re`/oracle verdicts
+on random rules×inputs — our single most important test." The seeded
+random suites (test_regex_compile, test_mapstate) sweep fixed corpora;
+these add generative coverage WITH shrinking, over the same oracles:
+
+* regex: generated RE2-subset patterns × generated inputs — banked-DFA
+  match matrix ≡ `re` oracle, bit for bit
+* matchpattern: generated FQDN globs × generated names — DFA ≡ glob
+  regex oracle
+* mapstate: generated policy tables × probe keys — vectorized kernel ≡
+  golden precedence model
+"""
+
+import re
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from cilium_tpu.core.flow import TrafficDirection
+from cilium_tpu.engine.mapstate_kernel import mapstate_lookup, pack_mapstate
+from cilium_tpu.policy.compiler import matchpattern
+from cilium_tpu.policy.compiler import regex_parser as rp
+from cilium_tpu.policy.compiler.dfa import compile_patterns
+from cilium_tpu.policy.compiler.oracle import OracleMatcher
+from cilium_tpu.policy.mapstate import MapState, MapStateEntry, MapStateKey
+from tests.test_regex_compile import _match_all_numpy
+
+# a small shared alphabet keeps random patterns and inputs colliding
+# often enough that accept paths are exercised, not just rejects
+ALPHA = "abc01/."
+
+
+# ----------------------------------------------------------------- regex --
+def _pattern_strategy() -> st.SearchStrategy[str]:
+    lit = st.sampled_from(list(ALPHA)).map(re.escape)
+    dot = st.just(".")
+    cls = st.tuples(
+        st.booleans(),
+        st.lists(st.sampled_from(list("abc012")), min_size=1, max_size=4,
+                 unique=True),
+    ).map(lambda t: "[" + ("^" if t[0] else "") + "".join(t[1]) + "]")
+    atom = st.one_of(lit, dot, cls)
+
+    def extend(children):
+        quant = children.flatmap(lambda c: st.sampled_from(
+            [f"(?:{c})?", f"(?:{c})*", f"(?:{c})+", f"(?:{c}){{1,3}}",
+             f"(?:{c}){{0,2}}"]))
+        alt = st.tuples(children, children).map(
+            lambda t: f"(?:{t[0]}|{t[1]})")
+        cat = st.tuples(children, children).map(lambda t: t[0] + t[1])
+        return st.one_of(quant, alt, cat)
+
+    return st.recursive(atom, extend, max_leaves=8)
+
+
+def _parseable(p: str) -> bool:
+    try:
+        rp.parse(p)
+        re.compile(p)
+        return True
+    except Exception:
+        return False
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    patterns=st.lists(_pattern_strategy().filter(_parseable),
+                      min_size=1, max_size=8),
+    inputs=st.lists(st.text(alphabet=ALPHA, max_size=10),
+                    min_size=1, max_size=16),
+)
+def test_regex_dfa_equals_oracle(patterns, inputs):
+    banked = compile_patterns(patterns, bank_size=4)
+    got = _match_all_numpy(banked, inputs)
+    want = OracleMatcher(patterns).match_matrix(inputs)
+    np.testing.assert_array_equal(got, want)
+
+
+# ----------------------------------------------------------- matchpattern --
+_label = st.text(alphabet="abc0-", min_size=1, max_size=6).filter(
+    lambda s: not s.startswith("-") and not s.endswith("-"))
+_glob_part = st.one_of(st.just("*"), _label)
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    globs=st.lists(
+        st.lists(_glob_part, min_size=1, max_size=4).map(".".join),
+        min_size=1, max_size=6),
+    names=st.lists(
+        st.lists(_label, min_size=1, max_size=4).map(".".join),
+        min_size=1, max_size=12),
+)
+def test_matchpattern_dfa_equals_oracle(globs, names):
+    regexes = [matchpattern.to_regex(g) for g in globs]
+    banked = compile_patterns(regexes, bank_size=4)
+    sanitized = [matchpattern.sanitize_name(n) for n in names]
+    got = _match_all_numpy(banked, sanitized)
+    want = OracleMatcher(regexes).match_matrix(sanitized)
+    np.testing.assert_array_equal(got, want)
+
+
+# --------------------------------------------------------------- mapstate --
+_IDS = [0, 100, 200, 300]          # 0 = wildcard peer
+_PORTS = [0, 53, 80]               # 0 = wildcard port
+_PROTOS = [0, 6, 17]               # 0 = wildcard proto
+
+_entry = st.tuples(
+    st.sampled_from(_IDS),
+    st.sampled_from(_PORTS),
+    st.sampled_from(_PROTOS),
+    st.sampled_from([TrafficDirection.INGRESS, TrafficDirection.EGRESS]),
+    st.booleans(),                 # is_deny
+)
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    entries=st.lists(_entry, min_size=0, max_size=12),
+    flags=st.tuples(st.booleans(), st.booleans()),
+    probes=st.lists(
+        st.tuples(st.sampled_from([100, 200, 300, 999]),
+                  st.sampled_from([53, 80, 443]),
+                  st.sampled_from([6, 17]),
+                  st.sampled_from([TrafficDirection.INGRESS,
+                                   TrafficDirection.EGRESS])),
+        min_size=1, max_size=16),
+)
+def test_mapstate_kernel_equals_golden(entries, flags, probes):
+    ms = MapState()
+    ms.ingress_enforced, ms.egress_enforced = flags
+    for peer, port, proto, direction, deny in entries:
+        ms.insert(MapStateKey(peer, port, proto, int(direction)),
+                  MapStateEntry(is_deny=deny))
+    per_identity = {7: ms}
+    packed = pack_mapstate(per_identity)
+
+    import jax.numpy as jnp
+
+    B = len(probes)
+    out = mapstate_lookup(
+        jnp.asarray(packed.key_w0), jnp.asarray(packed.key_w1),
+        jnp.asarray(packed.key_w2), jnp.asarray(packed.is_deny),
+        jnp.asarray(packed.ruleset_id), jnp.asarray(packed.enf_ids),
+        jnp.asarray(packed.enf_flags),
+        jnp.full((B,), 7, dtype=jnp.int32),
+        jnp.asarray([p[0] for p in probes], dtype=jnp.int32),
+        jnp.asarray([p[1] for p in probes], dtype=jnp.int32),
+        jnp.asarray([p[2] for p in probes], dtype=jnp.int32),
+        jnp.asarray([int(p[3]) for p in probes], dtype=jnp.int32))
+    got = np.asarray(out["allowed"])
+
+    for i, (pid, pport, pproto, pdir) in enumerate(probes):
+        want = ms.lookup(pid, pport, pproto, int(pdir))[0]
+        assert bool(got[i]) == bool(want), (
+            f"probe {(pid, pport, pproto, pdir)}: kernel "
+            f"{bool(got[i])} != golden {want} over {entries} "
+            f"flags={flags}")
