@@ -45,7 +45,8 @@ class Agent:
                  policy_dir: Optional[str] = None,
                  dns_proxy_bind: Optional[tuple] = None,
                  dns_upstream: tuple = ("127.0.0.53", 53),
-                 dns_endpoint_of=None):
+                 dns_endpoint_of=None,
+                 hubble_socket_path: Optional[str] = None):
         self.config = config or Config.from_env()
         self.state_dir = state_dir
         # serializes compound mutations (endpoint/policy upserts) from
@@ -98,6 +99,9 @@ class Agent:
         self.dns_proxy_bind = dns_proxy_bind
         self.dns_upstream = dns_upstream
         self.dns_endpoint_of = dns_endpoint_of  # client IP → endpoint id
+        # hubble observer socket (GetFlows/ServerStatus analog)
+        self.hubble_server = None
+        self.hubble_socket_path = hubble_socket_path
         # FQDN updates retrigger regeneration (§3.2 tail)
         self.name_manager.on_update = (
             lambda sels: self.endpoint_manager.regenerate_all())
@@ -135,6 +139,11 @@ class Agent:
 
             self.policy_watcher = PolicyDirWatcher(self, self.policy_dir)
             self.policy_watcher.register(self.controllers)
+        if self.hubble_socket_path:
+            from cilium_tpu.hubble.server import HubbleServer
+
+            self.hubble_server = HubbleServer(
+                self.observer, self.hubble_socket_path).start()
         if self.dns_proxy_bind is not None:
             from cilium_tpu.fqdn.server import DNSProxyServer
 
@@ -158,6 +167,8 @@ class Agent:
         # policy for a shutdown teardown would be discarded work
         self.clustermesh.close()
         self.controllers.stop_all()
+        if self.hubble_server is not None:
+            self.hubble_server.stop()
         if self.dns_server is not None:
             self.dns_server.stop()
         if self.api_server is not None:

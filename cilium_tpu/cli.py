@@ -225,6 +225,35 @@ def cmd_policy_delete(args) -> int:
     return 0 if code == 200 else 1
 
 
+def cmd_observe(args) -> int:
+    """`hubble observe` analog: stream flows from the hubble socket."""
+    from cilium_tpu.hubble.server import HubbleClient
+
+    flt = {}
+    if args.verdict:
+        flt["verdict"] = args.verdict.upper()
+    if args.dport is not None:      # 0 is a valid filter value
+        flt["dport"] = args.dport
+    if args.identity is not None:   # identity 0 = unidentified source
+        flt["src_identity"] = args.identity
+    c = HubbleClient(args.hubble)
+    if args.status:
+        return _print(c.server_status())
+    try:
+        if args.follow:
+            # indefinite live stream (hubble observe -f); --timeout only
+            # bounds each server round-trip, the client auto-resumes
+            for flow in c.follow(flt=flt or None):
+                print(json.dumps(flow), flush=True)
+        else:
+            for flow in c.get_flows(flt=flt or None, limit=args.limit,
+                                    timeout=args.timeout):
+                print(json.dumps(flow))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="cilium-tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -315,6 +344,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     pd.add_argument("labels", nargs="+")
     pd.add_argument("--api", required=True)
     pd.set_defaults(fn=cmd_policy_delete)
+
+    p = sub.add_parser("observe", help="stream flows from the hubble socket")
+    p.add_argument("--hubble", required=True,
+                   help="hubble server unix socket path")
+    p.add_argument("--follow", action="store_true")
+    p.add_argument("--limit", type=int, default=None)
+    p.add_argument("--timeout", type=float, default=1.0)
+    p.add_argument("--verdict", help="FORWARDED/DROPPED/REDIRECTED")
+    p.add_argument("--dport", type=int)
+    p.add_argument("--identity", type=int, help="source identity filter")
+    p.add_argument("--status", action="store_true",
+                   help="print server status instead of flows")
+    p.set_defaults(fn=cmd_observe)
 
     p = sub.add_parser("replay", help="replay a Hubble JSONL capture")
     p.add_argument("capture")

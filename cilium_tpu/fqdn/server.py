@@ -89,8 +89,14 @@ class DNSProxyServer:
                 break
             # decode + endpoint + verdict run INLINE (microseconds, no
             # network I/O) so denials never convoy behind handlers stuck
-            # on a dead upstream; only allowed queries hit the pool
-            fwd = self._verdict_phase(data, client)
+            # on a dead upstream; only allowed queries hit the pool.
+            # User callbacks (endpoint_of / on_verdict) may raise — a
+            # bad query must drop that query, never the serve loop
+            try:
+                fwd = self._verdict_phase(data, client)
+            except Exception:
+                METRICS.inc("cilium_tpu_fqdn_handler_errors_total", 1)
+                continue
             if fwd is None:
                 continue
             try:

@@ -12,6 +12,7 @@ from cilium_tpu.hubble.observer import Observer, FlowFilter, annotate_flows
 from cilium_tpu.hubble.metrics import FlowMetrics
 from cilium_tpu.hubble.exporter import FlowExporter
 from cilium_tpu.hubble.relay import Peer, Relay
+from cilium_tpu.hubble.server import HubbleClient, HubbleServer
 
 __all__ = [
     "FlowRing",
@@ -22,4 +23,6 @@ __all__ = [
     "FlowExporter",
     "Peer",
     "Relay",
+    "HubbleClient",
+    "HubbleServer",
 ]

@@ -86,9 +86,12 @@ class Observer:
                   since_seq: Optional[int] = None,
                   limit: Optional[int] = None,
                   follow: bool = False,
-                  timeout: float = 1.0) -> Iterator[Flow]:
+                  timeout: float = 1.0,
+                  with_seq: bool = False) -> Iterator[Flow]:
         """Iterate flows from the ring; with ``follow`` blocks for new
-        flows until ``timeout`` passes with none."""
+        flows until ``timeout`` passes with none. ``with_seq`` yields
+        ``(seq, flow)`` pairs so consumers can resume via
+        ``since_seq=seq+1``."""
         seq = self.ring.oldest_seq if since_seq is None else since_seq
         emitted = 0
         while True:
@@ -104,7 +107,7 @@ class Observer:
                 continue
             seq += 1
             if flt is None or flt.matches(flow):
-                yield flow
+                yield (seq - 1, flow) if with_seq else flow
                 emitted += 1
                 if limit is not None and emitted >= limit:
                     return

@@ -41,6 +41,7 @@ import urllib.parse
 from typing import Dict, Optional, Tuple
 
 from cilium_tpu.runtime.metrics import METRICS
+from cilium_tpu.runtime.unixsock import unlink_if_stale
 
 #: config fields PATCHable at runtime (the reference's runtime-mutable
 #: DaemonConfig subset; everything else requires an agent restart)
@@ -269,34 +270,10 @@ class APIServer:
     def __init__(self, agent, socket_path: str):
         self.socket_path = socket_path
         if os.path.exists(socket_path):
-            self._unlink_if_stale(socket_path)
+            unlink_if_stale(socket_path)
         handler = type("BoundHandler", (_Handler,), {"agent": agent})
         self._server = _UnixHTTPServer(socket_path, handler)
         self._thread: Optional[threading.Thread] = None
-
-    @staticmethod
-    def _unlink_if_stale(path: str) -> None:
-        """Remove ``path`` only if it is a dead leftover socket. A live
-        server or a non-socket file raises — never silently hijack."""
-        import stat as stat_mod
-
-        st = os.stat(path)
-        if not stat_mod.S_ISSOCK(st.st_mode):
-            raise FileExistsError(
-                f"{path} exists and is not a socket; refusing to unlink")
-        probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        try:
-            probe.settimeout(1.0)
-            probe.connect(path)
-        except (ConnectionRefusedError, FileNotFoundError):
-            os.unlink(path)  # stale: nobody listening
-        except OSError:
-            os.unlink(path)  # unreachable/broken socket counts as stale
-        else:
-            raise FileExistsError(
-                f"another server is live on {path}; refusing to replace")
-        finally:
-            probe.close()
 
     def start(self) -> "APIServer":
         self._thread = threading.Thread(
