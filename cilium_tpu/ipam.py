@@ -55,18 +55,39 @@ class ClusterPool:
                     self._used.add(net)
                     self._by_node[node] = net
                     self._cursor = idx + 1
-                    METRICS.set_gauge("cilium_tpu_ipam_node_cidrs",
-                                      float(len(self._by_node)))
+                    self._gauge()
                     return str(net)
         raise PoolExhausted(f"no /{self.node_mask_size} left in {self.pool}")
+
+    def adopt_node_cidr(self, node: str, cidr: str) -> None:
+        """Re-adopt a persisted assignment on operator restart (§5.4):
+        restored CIDRs must win over fresh allocations, so adopt before
+        the first reconcile pass."""
+        net = ipaddress.ip_network(cidr)
+        if net.prefixlen != self.node_mask_size or not net.subnet_of(
+                self.pool):
+            raise ValueError(f"{cidr} is not a /{self.node_mask_size} "
+                             f"subnet of {self.pool}")
+        with self._lock:
+            held = self._by_node.get(node)
+            if held == net:
+                return
+            if held is not None or net in self._used:
+                raise ValueError(f"conflicting adoption of {cidr} for {node}")
+            self._used.add(net)
+            self._by_node[node] = net
+            self._gauge()
 
     def release_node_cidr(self, node: str) -> None:
         with self._lock:
             net = self._by_node.pop(node, None)
             if net is not None:
                 self._used.discard(net)
-                METRICS.set_gauge("cilium_tpu_ipam_node_cidrs",
-                                  float(len(self._by_node)))
+                self._gauge()
+
+    def _gauge(self) -> None:
+        METRICS.set_gauge("cilium_tpu_ipam_node_cidrs",
+                          float(len(self._by_node)))
 
 
 class NodeAllocator:

@@ -1,0 +1,212 @@
+"""Operator: cluster-wide orchestration (the `operator/` analog).
+
+Reference: ``cilium-operator`` (SURVEY.md §2.4) — one per cluster, it
+owns cluster-scoped work the per-node agents must not race on. The
+north-star-relevant slice is **cluster-pool IPAM**: the operator carves
+a podCIDR per node out of the cluster pool and publishes it; agents
+watch for their assignment and run their :class:`NodeAllocator` inside
+it. State flows through the kvstore (the reference uses CiliumNode CRD
+status; our kvstore plays the CRD-store role, as it does for
+clustermesh), with lease-based liveness: a node whose registration
+lease lapses gets its CIDR reclaimed — the operator's garbage-collection
+duty.
+
+Keys:
+  cilium/nodes/<name>          agent-owned, lease-backed registration
+  cilium/podcidrs/<name>       operator-owned CIDR assignment
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Optional
+
+from cilium_tpu.ipam import ClusterPool, PoolExhausted
+from cilium_tpu.kvstore import EVENT_DELETE, KVStore, Lease
+from cilium_tpu.runtime.controller import Controller
+from cilium_tpu.runtime.metrics import METRICS
+
+NODES_PREFIX = "cilium/nodes/"
+CIDRS_PREFIX = "cilium/podcidrs/"
+
+
+class Operator:
+    """Watches node registrations; assigns/reclaims per-node podCIDRs."""
+
+    def __init__(self, store: KVStore, pool_cidr: str = "10.0.0.0/8",
+                 node_mask_size: int = 24):
+        self.store = store
+        self.pool = ClusterPool(pool_cidr, node_mask_size=node_mask_size)
+        self._lock = threading.Lock()
+        self._watch = None
+        self._controller: Optional[Controller] = None
+
+    def _persisted_assignments(self) -> Dict[str, str]:
+        """node → CIDR from the store, quarantining corrupt entries.
+
+        A single undecodable/unfitting value (mask-size change across
+        restarts, a foreign CIDR, an external writer's partial write —
+        the store is pluggable-etcd by contract) must degrade only that
+        one entry, never crash-loop start() or the reconcile
+        controller: the bad key is deleted so reconcile issues a fresh
+        assignment, and a metric records the quarantine.
+        """
+        out: Dict[str, str] = {}
+        for key, value in self.store.list_prefix(CIDRS_PREFIX).items():
+            try:
+                out[key[len(CIDRS_PREFIX):]] = json.loads(value)["cidr"]
+            except (ValueError, KeyError, TypeError):
+                self.store.delete(key)
+                # no-op unless the pool holds an adoption for this node
+                # (corruption after adopt): without it the subnet leaks
+                self.pool.release_node_cidr(key[len(CIDRS_PREFIX):])
+                METRICS.inc(
+                    "cilium_tpu_operator_cidrs_quarantined_total", 1)
+        return out
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "Operator":
+        # adopt existing assignments first (operator restart must not
+        # re-carve CIDRs out from under live nodes — §5.4 resume)
+        for node, cidr in self._persisted_assignments().items():
+            try:
+                self.pool.adopt_node_cidr(node, cidr)
+            except (ValueError, TypeError):
+                self.store.delete(CIDRS_PREFIX + node)
+                METRICS.inc(
+                    "cilium_tpu_operator_cidrs_quarantined_total", 1)
+        self.reconcile()
+        # Reconcile runs on its own controller thread; the watch
+        # callback only trigger()s it. Reconciling synchronously inside
+        # the callback would deadlock: list_prefix → expire_leases
+        # dispatches a DELETE to our own watch under the store's
+        # dispatch lock, re-entering reconcile on self._lock.
+        self._controller = Controller(
+            "operator-reconcile", lambda: self.reconcile(),
+            interval=30.0).start()
+        self._watch = self.store.watch_prefix(
+            NODES_PREFIX, lambda ev: self._controller.trigger())
+        return self
+
+    def stop(self) -> None:
+        if self._watch is not None:
+            self._watch.stop()
+        if self._controller is not None:
+            self._controller.stop()
+
+    # -- reconciliation ---------------------------------------------------
+    def reconcile(self) -> Dict[str, str]:
+        """One idempotent pass: every registered node has a CIDR; every
+        CIDR belongs to a registered node. Returns the assignment map."""
+        with self._lock:
+            nodes = {
+                key[len(NODES_PREFIX):]
+                for key in self.store.list_prefix(NODES_PREFIX)
+            }
+            assigned = self._persisted_assignments()
+            # reclaim: assignment whose node is gone (lease expired/
+            # deregistered) — the operator's GC duty
+            for node in list(assigned):
+                if node not in nodes:
+                    self.store.delete(CIDRS_PREFIX + node)
+                    self.pool.release_node_cidr(node)
+                    del assigned[node]
+                    METRICS.inc("cilium_tpu_operator_cidrs_reclaimed_total",
+                                1)
+            # assign: registered node without a CIDR
+            for node in sorted(nodes - set(assigned)):
+                try:
+                    cidr = self.pool.allocate_node_cidr(node)
+                except PoolExhausted:
+                    METRICS.inc("cilium_tpu_operator_pool_exhausted_total",
+                                1)
+                    continue
+                self.store.set(CIDRS_PREFIX + node,
+                               json.dumps({"cidr": cidr}))
+                assigned[node] = cidr
+            return assigned
+
+
+class NodeRegistration:
+    """Agent-side: register this node, await its podCIDR assignment.
+
+    ``on_cidr_change(old, new)`` (optional) fires whenever the
+    operator rewrites or deletes this node's assignment — the agent
+    must then rebuild its :class:`NodeAllocator` on the new CIDR
+    instead of allocating pod IPs from a range it no longer owns
+    (e.g. after an operator restart with a changed ``node_mask_size``
+    quarantined and re-carved the old assignment). `new` is ``None``
+    on deletion.
+    """
+
+    def __init__(self, store: KVStore, node_name: str,
+                 lease_ttl: float = 60.0,
+                 on_cidr_change=None):
+        self.store = store
+        self.node_name = node_name
+        self.lease: Lease = store.lease(lease_ttl)
+        self._key = NODES_PREFIX + node_name
+        self._registration = json.dumps({"name": node_name})
+        self._cidr_watch = None
+        if on_cidr_change is not None:
+            self._last_cidr: Optional[str] = None
+
+            def _notify(ev) -> None:
+                new = (None if ev.typ == EVENT_DELETE
+                       else json.loads(ev.value).get("cidr"))
+                old, self._last_cidr = self._last_cidr, new
+                if old != new:
+                    on_cidr_change(old, new)
+
+            self._cidr_watch = store.watch_prefix(
+                CIDRS_PREFIX + node_name, _notify)
+        store.set(self._key, self._registration, lease=self.lease)
+
+    def heartbeat(self) -> None:
+        """Keep the registration lease alive (controller duty).
+
+        A keepalive after the lease already lapsed must NOT silently
+        resurrect it: the store has (or will have) GC'd the node key,
+        the operator may have reclaimed — even reassigned — our CIDR,
+        and extending the dead lease's deadline would leave this agent
+        deregistered forever while believing it is healthy (the
+        reference's etcd keepalive fails with ErrLeaseNotFound and the
+        agent re-registers). Re-register with a fresh lease instead;
+        the caller should then re-read `pod_cidr()` before trusting a
+        previously cached assignment.
+        """
+        if (not self.lease.expired()
+                and self.store.get(self._key) is not None):
+            self.lease.keepalive()
+            # Re-verify AFTER the keepalive: the lease may have lapsed
+            # between the check and the extension (check-then-act
+            # window), in which case GC already deleted the key and a
+            # resurrected deadline would mask the deregistration.
+            if self.store.get(self._key) is not None:
+                return
+        self.lease = self.store.lease(self.lease.ttl)
+        self.store.set(self._key, self._registration, lease=self.lease)
+
+    def pod_cidr(self) -> Optional[str]:
+        raw = self.store.get(CIDRS_PREFIX + self.node_name)
+        return json.loads(raw)["cidr"] if raw else None
+
+    def wait_for_cidr(self, timeout: float = 5.0,
+                      interval: float = 0.05) -> str:
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            cidr = self.pod_cidr()
+            if cidr:
+                return cidr
+            time.sleep(interval)
+        raise TimeoutError(
+            f"no podCIDR assigned to {self.node_name} within {timeout}s")
+
+    def deregister(self) -> None:
+        if self._cidr_watch is not None:
+            self._cidr_watch.stop()
+        self.store.revoke(self.lease)
+        self.store.delete(self._key)

@@ -1,0 +1,253 @@
+"""Operator: cluster-pool CIDR assignment, reclaim, restart adoption.
+
+Reference: ``operator/`` cluster-pool IPAM duties (SURVEY.md §2.4) —
+assignment on node registration, GC of assignments whose node lease
+lapsed, and restart without re-carving live nodes' CIDRs (§5.4).
+"""
+
+import json
+
+import pytest
+
+from cilium_tpu.ipam import ClusterPool, PoolExhausted
+from cilium_tpu.kvstore import KVStore
+from cilium_tpu.operator import (CIDRS_PREFIX, NODES_PREFIX, NodeRegistration,
+                                 Operator)
+
+
+def test_register_assigns_cidr():
+    store = KVStore()
+    op = Operator(store, pool_cidr="10.0.0.0/16", node_mask_size=24).start()
+    try:
+        reg = NodeRegistration(store, "node-a")
+        cidr = reg.wait_for_cidr()
+        assert cidr == "10.0.0.0/24"
+        # idempotent: re-reconcile keeps the assignment stable
+        assert op.reconcile() == {"node-a": "10.0.0.0/24"}
+    finally:
+        op.stop()
+
+
+def test_distinct_nodes_get_distinct_cidrs():
+    store = KVStore()
+    op = Operator(store, pool_cidr="10.0.0.0/16", node_mask_size=24).start()
+    try:
+        cidrs = set()
+        for name in ("a", "b", "c"):
+            cidrs.add(NodeRegistration(store, name).wait_for_cidr())
+        assert len(cidrs) == 3
+    finally:
+        op.stop()
+
+
+def test_deregister_reclaims_cidr():
+    store = KVStore()
+    op = Operator(store, pool_cidr="10.0.0.0/24", node_mask_size=26).start()
+    try:
+        regs = [NodeRegistration(store, f"n{i}") for i in range(4)]
+        for r in regs:
+            r.wait_for_cidr()
+        # pool of four /26s is now exhausted
+        waiter = NodeRegistration(store, "n4")
+        op.reconcile()
+        assert store.get(CIDRS_PREFIX + "n4") is None
+        # freeing one node lets the waiter get the reclaimed CIDR
+        freed = regs[1].pod_cidr()
+        regs[1].deregister()
+        assert waiter.wait_for_cidr() == freed
+        assert store.get(CIDRS_PREFIX + "n1") is None
+    finally:
+        op.stop()
+
+
+def test_reconcile_with_expired_lease_does_not_deadlock():
+    """Regression: list_prefix inside reconcile expires leases, which
+    dispatches DELETE events to the operator's own NODES_PREFIX watch
+    in the same thread. The callback must not re-enter reconcile
+    synchronously (self._lock is not reentrant) — it triggers the
+    reconcile controller instead."""
+    import threading
+    import time
+
+    store = KVStore()
+    op = Operator(store, pool_cidr="10.0.0.0/16", node_mask_size=24).start()
+    try:
+        NodeRegistration(store, "ghost", lease_ttl=0.01)
+        time.sleep(0.05)
+        done = threading.Event()
+        result = {}
+
+        def run():
+            result["assigned"] = op.reconcile()
+            done.set()
+
+        threading.Thread(target=run, daemon=True).start()
+        assert done.wait(timeout=5.0), "reconcile deadlocked"
+        assert result["assigned"] == {}
+    finally:
+        op.stop()
+
+
+def test_heartbeat_after_lapse_reregisters():
+    """Regression: keepalive on an already-expired lease must not
+    silently resurrect it — the node key is gone and the CIDR may have
+    been reclaimed. heartbeat() re-registers with a fresh lease so the
+    operator reassigns."""
+    import time
+
+    store = KVStore()
+    op = Operator(store, pool_cidr="10.0.0.0/16", node_mask_size=24).start()
+    try:
+        reg = NodeRegistration(store, "stall", lease_ttl=0.01)
+        reg.wait_for_cidr()
+        time.sleep(0.05)  # lease lapses; GC reclaims on next touch
+        store.expire_leases()
+        op.reconcile()
+        assert store.get(CIDRS_PREFIX + "stall") is None
+        reg.heartbeat()  # must re-register, not resurrect
+        assert store.get(NODES_PREFIX + "stall") is not None
+        assert not reg.lease.expired()
+        assert reg.wait_for_cidr().endswith("/24")  # fresh assignment
+    finally:
+        op.stop()
+
+
+def test_start_quarantines_corrupt_assignment():
+    """Regression: a persisted assignment that no longer fits the pool
+    config (mask-size change across restarts) must not crash-loop
+    start(); it is deleted so reconcile issues a fresh one."""
+    store = KVStore()
+    store.set(CIDRS_PREFIX + "legacy", json.dumps({"cidr": "10.0.0.0/24"}))
+    store.set(NODES_PREFIX + "legacy", json.dumps({"name": "legacy"}))
+    op = Operator(store, pool_cidr="10.0.0.0/16", node_mask_size=25).start()
+    try:
+        raw = store.get(CIDRS_PREFIX + "legacy")
+        assert raw is not None
+        assert json.loads(raw)["cidr"].endswith("/25")
+    finally:
+        op.stop()
+
+
+def test_lease_expiry_triggers_gc():
+    store = KVStore()
+    op = Operator(store, pool_cidr="10.0.0.0/16", node_mask_size=24)
+    reg = NodeRegistration(store, "ghost", lease_ttl=0.01)
+    op.start()
+    try:
+        import time
+        time.sleep(0.05)
+        store.expire_leases()
+        op.reconcile()
+        assert store.get(CIDRS_PREFIX + "ghost") is None
+        assert store.get(NODES_PREFIX + "ghost") is None
+    finally:
+        op.stop()
+
+
+def test_operator_restart_adopts_existing_assignments():
+    store = KVStore()
+    op1 = Operator(store, pool_cidr="10.0.0.0/16", node_mask_size=24).start()
+    reg = NodeRegistration(store, "survivor")
+    before = reg.wait_for_cidr()
+    op1.stop()
+    # a fresh operator over the same store must keep the assignment and
+    # not hand the same CIDR to a newcomer
+    op2 = Operator(store, pool_cidr="10.0.0.0/16", node_mask_size=24).start()
+    try:
+        assert reg.pod_cidr() == before
+        newcomer = NodeRegistration(store, "newcomer").wait_for_cidr()
+        assert newcomer != before
+    finally:
+        op2.stop()
+
+
+def test_adopt_rejects_foreign_or_conflicting_cidrs():
+    pool = ClusterPool("10.0.0.0/16", node_mask_size=24)
+    with pytest.raises(ValueError):
+        pool.adopt_node_cidr("a", "192.168.0.0/24")  # outside pool
+    with pytest.raises(ValueError):
+        pool.adopt_node_cidr("a", "10.0.0.0/26")  # wrong mask
+    pool.adopt_node_cidr("a", "10.0.5.0/24")
+    pool.adopt_node_cidr("a", "10.0.5.0/24")  # idempotent
+    with pytest.raises(ValueError):
+        pool.adopt_node_cidr("b", "10.0.5.0/24")  # held by a
+    # allocator must skip the adopted subnet
+    assert pool.allocate_node_cidr("c") != "10.0.5.0/24"
+
+
+def test_on_cidr_change_fires_on_recarve():
+    """Regression: an agent must learn when the operator rewrites its
+    assignment (e.g. restart with a changed node_mask_size quarantines
+    the old CIDR and carves a new one) — silently keeping the cached
+    CIDR means allocating pod IPs from a range another node may now
+    own."""
+    import threading
+    import time
+
+    store = KVStore()
+    op1 = Operator(store, pool_cidr="10.0.0.0/16", node_mask_size=24).start()
+    changes = []
+    got_new = threading.Event()
+
+    reg = NodeRegistration(
+        store, "live",
+        on_cidr_change=lambda old, new: (
+            changes.append((old, new)),
+            got_new.set() if new is not None and new.endswith("/25")
+            else None))
+    first = reg.wait_for_cidr()
+    op1.stop()
+    # restart with a different mask: old /24 is quarantined, re-carved
+    # (the agent sees a delete, then the fresh assignment)
+    op2 = Operator(store, pool_cidr="10.0.0.0/16", node_mask_size=25).start()
+    try:
+        assert got_new.wait(timeout=5.0), "agent never notified of re-carve"
+        second = reg.pod_cidr()
+        assert second != first and second.endswith("/25")
+        assert changes[0] == (None, first)
+        assert (first, None) in changes  # the quarantine delete
+        assert changes[-1][1] == second
+    finally:
+        op2.stop()
+
+
+def test_reconcile_quarantines_corrupt_assignment():
+    """Regression: a corrupt CIDRS value appearing AFTER startup (the
+    store is pluggable-etcd; external writers happen) must not
+    crash-loop reconcile — the one entry is quarantined and re-issued,
+    other nodes are unaffected."""
+    store = KVStore()
+    op = Operator(store, pool_cidr="10.0.0.0/16", node_mask_size=24).start()
+    try:
+        reg_a = NodeRegistration(store, "a")
+        reg_b = NodeRegistration(store, "b")
+        cidr_b = reg_b.wait_for_cidr()
+        reg_a.wait_for_cidr()
+        store.set(CIDRS_PREFIX + "a", "{not json")
+        assigned = op.reconcile()  # must not raise
+        assert assigned["b"] == cidr_b
+        assert assigned["a"].endswith("/24")  # re-issued, well-formed
+        assert json.loads(store.get(CIDRS_PREFIX + "a"))["cidr"] == \
+            assigned["a"]
+    finally:
+        op.stop()
+
+
+def test_pool_exhaustion_is_metered_not_fatal():
+    store = KVStore()
+    op = Operator(store, pool_cidr="10.0.0.0/24", node_mask_size=25).start()
+    try:
+        NodeRegistration(store, "a").wait_for_cidr()
+        NodeRegistration(store, "b").wait_for_cidr()
+        NodeRegistration(store, "c")
+        assigned = op.reconcile()
+        assert set(assigned) == {"a", "b"}
+    finally:
+        op.stop()
+
+
+def test_wait_for_cidr_times_out_without_operator():
+    store = KVStore()
+    reg = NodeRegistration(store, "alone")
+    with pytest.raises(TimeoutError):
+        reg.wait_for_cidr(timeout=0.1)
