@@ -46,7 +46,8 @@ class Agent:
                  dns_proxy_bind: Optional[tuple] = None,
                  dns_upstream: tuple = ("127.0.0.53", 53),
                  dns_endpoint_of=None,
-                 hubble_socket_path: Optional[str] = None):
+                 hubble_socket_path: Optional[str] = None,
+                 kvstore: Optional[KVStore] = None):
         self.config = config or Config.from_env()
         self.state_dir = state_dir
         # serializes compound mutations (endpoint/policy upserts) from
@@ -66,8 +67,10 @@ class Agent:
             self.repo, self.selector_cache, self.allocator, self.loader,
             dns_proxy=self.dns_proxy, state_dir=state_dir)
         # clustermesh (§2.4): publish local state into our kvstore;
-        # watch remote clusters' stores for their identities/IPs
-        self.kvstore = KVStore()
+        # watch remote clusters' stores for their identities/IPs. A
+        # caller-supplied store is how this agent shares state with an
+        # Operator (cluster-pool IPAM) and other agents in-process.
+        self.kvstore = kvstore if kvstore is not None else KVStore()
         self.publisher = LocalStatePublisher(
             self.kvstore, self.config.cluster_name, self.allocator,
             self.ipcache)
@@ -79,9 +82,12 @@ class Agent:
         self.observer = Observer(handlers=[FlowMetrics()])
         # health probe mesh (§5.3); peers registered via health.add_node
         self.health = HealthChecker(node_name=self.config.cluster_name)
-        # IPAM (§2.4, cluster-pool mode): endpoint IPs come from this
-        # node's podCIDR when the caller doesn't pin one
+        # IPAM (§2.4): endpoint IPs come from this node's podCIDR when
+        # the caller doesn't pin one. In "cluster-pool" mode the CIDR
+        # arrives from the operator at start(); until then the static
+        # pod_cidr stands in so construction stays non-blocking.
         self.ipam = NodeAllocator(self.config.pod_cidr)
+        self.node_registration = None
         # services / kube-proxy replacement (§2.4): Maglev selection
         self.services = ServiceManager()
         self.controllers = ControllerManager()
@@ -108,6 +114,33 @@ class Agent:
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "Agent":
+        if self.config.ipam_mode == "cluster-pool":
+            # register with the operator and adopt its assignment BEFORE
+            # endpoint restore, so restored IPs re-adopt into the right
+            # allocator (reference: agents block on IPAM readiness)
+            from cilium_tpu.operator import NodeRegistration
+
+            self.node_registration = NodeRegistration(
+                self.kvstore, self.config.node_name,
+                on_cidr_change=self._on_pod_cidr_change)
+            try:
+                self.node_registration.wait_for_cidr(timeout=30.0)
+            except TimeoutError:
+                # don't leave a registered node (holding a reconcile
+                # slot — it would be assigned a CIDR nobody consumes)
+                # or a live watch behind a failed start; a retry builds
+                # a fresh registration instead of stacking watches
+                self.node_registration.deregister()
+                self.node_registration = None
+                raise
+            with self.write_lock:
+                # fresh read, not the wait result: a re-carve landing
+                # between the wait and this swap must not be reverted
+                # (the watch event for it may have already fired)
+                self.ipam = NodeAllocator(self.node_registration.pod_cidr())
+            self.controllers.update(
+                "node-registration", self.node_registration.heartbeat,
+                interval=15.0)
         restored = self.endpoint_manager.restore()
         if restored:
             METRICS.inc("cilium_tpu_endpoints_restored_total", restored)
@@ -167,6 +200,12 @@ class Agent:
         # policy for a shutdown teardown would be discarded work
         self.clustermesh.close()
         self.controllers.stop_all()
+        if self.node_registration is not None:
+            # stop watching, but stay registered: the node keeps its
+            # CIDR across an agent restart (the lease lapses only if we
+            # stay down past the TTL — the reference's pinned-map
+            # discipline, SURVEY.md §5.3/§5.4)
+            self.node_registration.close()
         if self.hubble_server is not None:
             self.hubble_server.stop()
         if self.dns_server is not None:
@@ -181,6 +220,36 @@ class Agent:
 
     def _dns_gc(self) -> None:
         self.name_manager.gc()
+
+    def _on_pod_cidr_change(self, old: Optional[str],
+                            new: Optional[str]) -> None:
+        """The operator rewrote this node's assignment (re-carve after a
+        pool reconfiguration, or reassignment after our lease lapsed).
+        Rebuild the allocator on the new CIDR so fresh endpoint IPs come
+        from a range we actually own; existing endpoints keep their
+        addresses (pods can't be renumbered in place — the reference
+        restarts them), counted so operators can see the skew. A delete
+        (new=None) is left alone: the fresh assignment follows."""
+        # write_lock: endpoint_add may be mid-allocation from the old
+        # allocator on an API thread — swapping under it un-serialized
+        # would hand out an address the new allocator never adopted
+        with self.write_lock:
+            if new is None or new == str(self.ipam.cidr):
+                return
+            alloc = NodeAllocator(new)
+            stale = 0
+            for ep in self.endpoint_manager.endpoints():
+                if not ep.ipv4:
+                    continue
+                try:
+                    alloc.allocate_ip(ep.ipv4)
+                except Exception:
+                    stale += 1
+            self.ipam = alloc
+            # unconditional: the gauge must drop back to 0 once the
+            # skew clears, not report the last nonzero value forever
+            METRICS.set_gauge("cilium_tpu_ipam_endpoints_outside_cidr",
+                              float(stale))
 
     def _checkpoint(self) -> None:
         self.endpoint_manager.checkpoint()
@@ -244,6 +313,15 @@ class Agent:
     # -- endpoint API -----------------------------------------------------
     def endpoint_add(self, endpoint_id: int, labels: Dict[str, str],
                      ipv4: str = ""):
+        # write_lock (reentrant — API handlers already hold it): the
+        # allocate-then-register sequence must not interleave with a
+        # cluster-pool allocator swap (_on_pod_cidr_change), which
+        # adopts only already-registered endpoints' addresses
+        with self.write_lock:
+            return self._endpoint_add_locked(endpoint_id, labels, ipv4)
+
+    def _endpoint_add_locked(self, endpoint_id: int,
+                             labels: Dict[str, str], ipv4: str = ""):
         old = self.endpoint_manager.get(endpoint_id)
         if old is not None and old.ipv4 and not ipv4:
             ipv4 = old.ipv4  # re-add (CNI ADD retry) keeps the IP
@@ -269,11 +347,12 @@ class Agent:
         return ep
 
     def endpoint_remove(self, endpoint_id: int) -> None:
-        ep = self.endpoint_manager.get(endpoint_id)
-        if ep is not None and ep.ipv4:
-            self.ipcache.delete(f"{ep.ipv4}/32")
-            self.ipam.release(ep.ipv4)
-        self.endpoint_manager.remove_endpoint(endpoint_id)
+        with self.write_lock:
+            ep = self.endpoint_manager.get(endpoint_id)
+            if ep is not None and ep.ipv4:
+                self.ipcache.delete(f"{ep.ipv4}/32")
+                self.ipam.release(ep.ipv4)
+            self.endpoint_manager.remove_endpoint(endpoint_id)
 
     # -- flow pipeline (engine → monitor → hubble, §3.6) -----------------
     def process_flows(self, flows: List) -> Dict:
@@ -309,7 +388,9 @@ class Agent:
             "clustermesh": self.clustermesh.status(),
             "health": {n: s.reachable
                        for n, s in self.health.status().items()},
-            "ipam": {"cidr": str(self.ipam.cidr),
+            "ipam": {"mode": self.config.ipam_mode,
+                     "node": self.config.node_name,
+                     "cidr": str(self.ipam.cidr),
                      "available": self.ipam.available},
             "services": len(self.services.list()),
         }

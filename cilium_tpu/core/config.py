@@ -70,7 +70,12 @@ class Config:
 
     enable_tpu_offload: bool = False   # master feature gate (north star)
     cluster_name: str = "default"      # clustermesh local cluster name
-    pod_cidr: str = "10.0.0.0/24"      # this node's IPAM podCIDR
+    node_name: str = "node-0"          # this node's name (operator key)
+    #: "static" uses pod_cidr as-is; "cluster-pool" registers with the
+    #: operator and receives this node's podCIDR from the cluster pool
+    #: (the reference's default IPAM mode, SURVEY.md §2.4)
+    ipam_mode: str = "static"
+    pod_cidr: str = "10.0.0.0/24"      # this node's IPAM podCIDR (static)
     engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
     loader: LoaderConfig = dataclasses.field(default_factory=LoaderConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
@@ -88,6 +93,10 @@ class Config:
             cfg.engine.batch_size = int(env["CILIUM_TPU_BATCH_SIZE"])
         if "CILIUM_TPU_CACHE_DIR" in env:
             cfg.loader.cache_dir = env["CILIUM_TPU_CACHE_DIR"]
+        if "CILIUM_TPU_NODE_NAME" in env:
+            cfg.node_name = env["CILIUM_TPU_NODE_NAME"]
+        if "CILIUM_TPU_IPAM_MODE" in env:
+            cfg.ipam_mode = env["CILIUM_TPU_IPAM_MODE"]
         return cfg
 
     @classmethod
@@ -99,6 +108,10 @@ class Config:
         cfg = cls()
         cfg.enable_tpu_offload = bool(data.get("enable_tpu_offload",
                                                cfg.enable_tpu_offload))
+        for key in ("cluster_name", "node_name", "ipam_mode", "pod_cidr",
+                    "log_level"):
+            if key in data:
+                setattr(cfg, key, data[key])
         for section, target in (("engine", cfg.engine),
                                 ("loader", cfg.loader),
                                 ("parallel", cfg.parallel)):

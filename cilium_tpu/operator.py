@@ -151,16 +151,21 @@ class NodeRegistration:
         self._cidr_watch = None
         if on_cidr_change is not None:
             self._last_cidr: Optional[str] = None
+            cidr_key = CIDRS_PREFIX + node_name
 
             def _notify(ev) -> None:
+                # watch_prefix matches by prefix: without the exact-key
+                # check, node "worker-1" would receive (and act on)
+                # "worker-10"'s assignments
+                if ev.key != cidr_key:
+                    return
                 new = (None if ev.typ == EVENT_DELETE
                        else json.loads(ev.value).get("cidr"))
                 old, self._last_cidr = self._last_cidr, new
                 if old != new:
                     on_cidr_change(old, new)
 
-            self._cidr_watch = store.watch_prefix(
-                CIDRS_PREFIX + node_name, _notify)
+            self._cidr_watch = store.watch_prefix(cidr_key, _notify)
         store.set(self._key, self._registration, lease=self.lease)
 
     def heartbeat(self) -> None:
@@ -205,8 +210,15 @@ class NodeRegistration:
         raise TimeoutError(
             f"no podCIDR assigned to {self.node_name} within {timeout}s")
 
-    def deregister(self) -> None:
+    def close(self) -> None:
+        """Stop watching, but stay registered: used on agent shutdown
+        so the node keeps its CIDR across a restart (the lease lapses
+        only if the agent stays down past the TTL)."""
         if self._cidr_watch is not None:
             self._cidr_watch.stop()
+            self._cidr_watch = None
+
+    def deregister(self) -> None:
+        self.close()
         self.store.revoke(self.lease)
         self.store.delete(self._key)

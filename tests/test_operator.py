@@ -246,6 +246,97 @@ def test_pool_exhaustion_is_metered_not_fatal():
         op.stop()
 
 
+def test_agent_cluster_pool_ipam_end_to_end():
+    """Agent in cluster-pool mode registers with the operator over a
+    shared kvstore, receives its podCIDR, and allocates endpoint IPs
+    from it; restart keeps the same CIDR (restart adoption)."""
+    from cilium_tpu.agent import Agent
+    from cilium_tpu.core.config import Config
+
+    store = KVStore()
+    op = Operator(store, pool_cidr="10.200.0.0/16", node_mask_size=26)
+    op.start()
+    cfg = Config()
+    cfg.ipam_mode = "cluster-pool"
+    cfg.node_name = "worker-1"
+    agent = Agent(config=cfg, kvstore=store).start()
+    try:
+        cidr = str(agent.ipam.cidr)
+        assert cidr.startswith("10.200.") and cidr.endswith("/26")
+        ep = agent.endpoint_add(7, {"app": "web"})
+        assert ep.ipv4.startswith("10.200.")
+        assert agent.status()["ipam"]["mode"] == "cluster-pool"
+    finally:
+        agent.stop()
+    # restart: same node name → same CIDR, still registered
+    agent2 = Agent(config=cfg, kvstore=store).start()
+    try:
+        assert str(agent2.ipam.cidr) == cidr
+    finally:
+        agent2.stop()
+        op.stop()
+
+
+def test_agent_rebuilds_allocator_on_recarve():
+    """When the operator rewrites this node's assignment, the agent
+    rebuilds its allocator on the new CIDR; existing endpoints keep
+    their (now out-of-range) IPs and are counted, new endpoints draw
+    from the new range."""
+    import time
+
+    from cilium_tpu.agent import Agent
+    from cilium_tpu.core.config import Config
+    from cilium_tpu.runtime.metrics import METRICS
+
+    store = KVStore()
+    op = Operator(store, pool_cidr="10.201.0.0/16", node_mask_size=24)
+    op.start()
+    cfg = Config()
+    cfg.ipam_mode = "cluster-pool"
+    cfg.node_name = "worker-r"
+    agent = Agent(config=cfg, kvstore=store).start()
+    try:
+        old_cidr = str(agent.ipam.cidr)
+        agent.endpoint_add(1, {"app": "a"})
+        op.stop()
+        op2 = Operator(store, pool_cidr="10.201.0.0/16",
+                       node_mask_size=25).start()
+        try:
+            deadline = time.monotonic() + 10
+            while (time.monotonic() < deadline
+                   and str(agent.ipam.cidr) == old_cidr):
+                time.sleep(0.05)
+            new_cidr = str(agent.ipam.cidr)
+            assert new_cidr != old_cidr and new_cidr.endswith("/25")
+            ep = agent.endpoint_add(2, {"app": "b"})
+            import ipaddress
+            assert (ipaddress.ip_address(ep.ipv4)
+                    in ipaddress.ip_network(new_cidr))
+        finally:
+            op2.stop()
+    finally:
+        agent.stop()
+
+
+def test_cidr_watch_ignores_other_nodes_with_same_name_prefix():
+    """Regression: the CIDR watch is a prefix watch, so node 'worker-1'
+    would otherwise receive 'worker-10's assignments and rebuild its
+    allocator on a range another node owns."""
+    store = KVStore()
+    op = Operator(store, pool_cidr="10.0.0.0/16", node_mask_size=24).start()
+    seen = []
+    try:
+        reg1 = NodeRegistration(store, "worker-1",
+                                on_cidr_change=lambda o, n: seen.append(n))
+        cidr1 = reg1.wait_for_cidr()
+        reg10 = NodeRegistration(store, "worker-10")
+        cidr10 = reg10.wait_for_cidr()
+        assert cidr10 != cidr1
+        assert seen == [cidr1]  # never worker-10's assignment
+    finally:
+        op.stop()
+
+
 def test_wait_for_cidr_times_out_without_operator():
     store = KVStore()
     reg = NodeRegistration(store, "alone")
