@@ -1,0 +1,158 @@
+"""CNI plugin protocol tests: ADD/DEL/CHECK/VERSION against a live
+agent API socket, spec error codes without one.
+
+Reference: ``plugins/cilium-cni`` — kubelet execs with CNI_* env and
+netconf on stdin; result/error JSON on stdout (SURVEY.md §1/L5).
+"""
+
+import io
+import json
+
+import pytest
+
+from cilium_tpu import cni
+from cilium_tpu.agent import Agent
+from cilium_tpu.core.config import Config
+
+NETCONF = json.dumps({"cniVersion": "1.0.0", "name": "cilium-tpu",
+                      "type": "cilium-tpu-cni"})
+
+
+@pytest.fixture
+def api_sock(tmp_path):
+    sock = str(tmp_path / "api.sock")
+    agent = Agent(Config(), api_socket_path=sock).start()
+    yield agent, sock
+    agent.stop()
+
+
+def run_cni(env, netconf=NETCONF):
+    out = io.StringIO()
+    rc = cni.main(env=env, stdin=io.StringIO(netconf), stdout=out)
+    return rc, json.loads(out.getvalue())
+
+
+def base_env(sock, command, container="cont-abc123"):
+    return {
+        "CNI_COMMAND": command,
+        "CNI_CONTAINERID": container,
+        "CNI_IFNAME": "eth0",
+        "CNI_NETNS": "/var/run/netns/x",
+        "CNI_ARGS": "K8S_POD_NAMESPACE=default;K8S_POD_NAME=web-0",
+        "CILIUM_TPU_API_SOCKET": sock,
+    }
+
+
+def test_add_creates_endpoint_with_ip(api_sock):
+    agent, sock = api_sock
+    rc, result = run_cni(base_env(sock, "ADD"))
+    assert rc == 0, result
+    assert result["cniVersion"] == "1.0.0"
+    ip = result["ips"][0]["address"]
+    assert ip.endswith("/32")
+    eps = list(agent.endpoint_manager.endpoints())
+    assert len(eps) == 1
+    assert eps[0].ipv4 == ip[:-3]
+    labels = {str(lbl) for lbl in agent.allocator.lookup(eps[0].identity)}
+    assert "k8s:io.kubernetes.pod.namespace=default" in labels
+
+
+def test_add_is_idempotent_same_ip(api_sock):
+    agent, sock = api_sock
+    _, first = run_cni(base_env(sock, "ADD"))
+    rc, second = run_cni(base_env(sock, "ADD"))  # kubelet ADD retry
+    assert rc == 0
+    assert second["ips"] == first["ips"]
+    assert len(list(agent.endpoint_manager.endpoints())) == 1
+
+
+def test_del_removes_endpoint_and_is_idempotent(api_sock):
+    agent, sock = api_sock
+    run_cni(base_env(sock, "ADD"))
+    rc, _ = run_cni(base_env(sock, "DEL"))
+    assert rc == 0
+    assert not list(agent.endpoint_manager.endpoints())
+    rc, _ = run_cni(base_env(sock, "DEL"))  # second DEL must succeed
+    assert rc == 0
+
+
+def test_check_reflects_endpoint_lifecycle(api_sock):
+    agent, sock = api_sock
+    env = base_env(sock, "CHECK")
+    rc, err = run_cni(env)
+    assert rc == 1 and err["code"] == cni.ERR_UNKNOWN_CONTAINER
+    run_cni(base_env(sock, "ADD"))
+    rc, _ = run_cni(env)
+    assert rc == 0
+
+
+def test_version_needs_no_agent():
+    rc, result = run_cni({"CNI_COMMAND": "VERSION"})
+    assert rc == 0
+    assert "1.0.0" in result["supportedVersions"]
+
+
+def test_spec_error_codes(tmp_path):
+    # missing CNI_CONTAINERID → invalid env
+    rc, err = run_cni({"CNI_COMMAND": "ADD"})
+    assert rc == 1 and err["code"] == cni.ERR_INVALID_ENV
+    # bad netconf JSON → failed decode
+    env = base_env(str(tmp_path / "missing.sock"), "ADD")
+    out = io.StringIO()
+    rc = cni.main(env=env, stdin=io.StringIO("{nope"), stdout=out)
+    assert rc == 1
+    assert json.loads(out.getvalue())["code"] == cni.ERR_FAILED_DECODE
+    # unsupported version → incompatible
+    rc, err = run_cni(env, netconf=json.dumps({"cniVersion": "9.9.9"}))
+    assert rc == 1 and err["code"] == cni.ERR_INCOMPATIBLE_VERSION
+    # agent socket absent on ADD → try again later
+    rc, err = run_cni(env)
+    assert rc == 1 and err["code"] == cni.ERR_TRY_AGAIN_LATER
+    # but DEL without an agent still succeeds (best-effort cleanup)
+    rc, _ = run_cni(base_env(str(tmp_path / "missing.sock"), "DEL"))
+    assert rc == 0
+
+
+def test_del_ignores_bad_netconf(api_sock):
+    """Regression: DEL is best-effort cleanup — a corrupted or
+    since-unsupported cached netconf must not leave the pod stuck
+    terminating."""
+    agent, sock = api_sock
+    run_cni(base_env(sock, "ADD"))
+    out = io.StringIO()
+    rc = cni.main(env=base_env(sock, "DEL"), stdin=io.StringIO("{nope"),
+                  stdout=out)
+    assert rc == 0
+    assert not list(agent.endpoint_manager.endpoints())
+    rc, _ = run_cni(base_env(sock, "DEL"),
+                    netconf=json.dumps({"cniVersion": "9.9.9"}))
+    assert rc == 0
+
+
+def test_error_json_echoes_requested_version(api_sock):
+    """Regression: CNI error objects must carry the input netconf's
+    cniVersion, not hardcode 1.0.0."""
+    agent, sock = api_sock
+    env = base_env(sock, "CHECK", container="never-added")
+    rc, err = run_cni(env, netconf=json.dumps({"cniVersion": "0.4.0"}))
+    assert rc == 1
+    assert err["code"] == cni.ERR_UNKNOWN_CONTAINER
+    assert err["cniVersion"] == "0.4.0"
+
+
+def test_unexpected_exception_becomes_cni_error(tmp_path, monkeypatch):
+    """Regression: a non-CNIError (e.g. malformed agent response) must
+    surface as a CNI error object on stdout, never a traceback."""
+    monkeypatch.setattr(cni, "_client", lambda env: (_ for _ in ()).throw(
+        RuntimeError("agent sent garbage")))
+    rc, err = run_cni(base_env(str(tmp_path / "x.sock"), "ADD"))
+    assert rc == 1
+    assert err["code"] == cni.ERR_IO_FAILURE
+    assert "agent sent garbage" in err["msg"]
+
+
+def test_endpoint_id_is_stable_and_positive():
+    a = cni.endpoint_id_for("cont-abc123")
+    assert a == cni.endpoint_id_for("cont-abc123")
+    assert a > 0
+    assert a != cni.endpoint_id_for("cont-abc124")
