@@ -43,6 +43,10 @@ def main() -> int:
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--check", action="store_true",
                     help="verify engine vs oracle on a sample (after timing)")
+    ap.add_argument("--profile", metavar="DIR",
+                    help="capture a jax.profiler device trace of the "
+                         "timed passes into DIR (open with Perfetto / "
+                         "tensorboard; SURVEY.md §5.1)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
 
@@ -66,6 +70,24 @@ def main() -> int:
     if args.flows is None:
         args.flows = {"http": 10000, "fqdn": 10000, "kafka": 100000,
                       "mixed": 1000000, "clustermesh": 100000}[args.config]
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def maybe_trace():
+        """jax.profiler trace of the timed passes (--profile). The
+        finally preserves the partial trace when a timed pass raises
+        (the runs one most wants to profile) instead of leaving a
+        dangling profiler session."""
+        if not args.profile:
+            yield
+            return
+        jax.profiler.start_trace(args.profile)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
+            log(f"profiler trace written to {args.profile}")
 
     if args.config == "http":
         scenario = synth.synth_http_scenario(n_rules=args.rules,
@@ -127,27 +149,30 @@ def main() -> int:
             out = step(arrays, chunks[1 + i])
         jax.block_until_ready(out)
 
-        # latency pass: block per chunk (p50/p99 are per-batch latency);
-        # uses the first few timed chunks, which the throughput pass then
-        # skips so every throughput-timed buffer is still first-use
-        n_lat = max(1, min(8, n_chunks - 1 - args.warmup - 2))
-        times = []
-        for c in range(1 + args.warmup, 1 + args.warmup + n_lat):
-            t0 = time.perf_counter()
-            out = step(arrays, chunks[c])
-            jax.block_until_ready(out)
-            times.append(time.perf_counter() - t0)
-        # throughput pass: dispatch the whole remaining stream and sync
-        # ONCE — chunks are distinct first-use buffers already resident
-        # in HBM, so this measures pipelined device execution, which is
-        # how a real flow stream runs (compute overlaps dispatch)
-        first = 1 + args.warmup + n_lat
-        t_stream0 = time.perf_counter()
-        outs = []
-        for c in range(first, n_chunks):
-            outs.append(step(arrays, chunks[c]))
-        jax.block_until_ready(outs)
-        t_stream = time.perf_counter() - t_stream0
+        with maybe_trace():
+            # latency pass: block per chunk (p50/p99 are per-batch
+            # latency); uses the first few timed chunks, which the
+            # throughput pass then skips so every throughput-timed
+            # buffer is still first-use
+            n_lat = max(1, min(8, n_chunks - 1 - args.warmup - 2))
+            times = []
+            for c in range(1 + args.warmup, 1 + args.warmup + n_lat):
+                t0 = time.perf_counter()
+                out = step(arrays, chunks[c])
+                jax.block_until_ready(out)
+                times.append(time.perf_counter() - t0)
+            # throughput pass: dispatch the whole remaining stream and
+            # sync ONCE — chunks are distinct first-use buffers already
+            # resident in HBM, so this measures pipelined device
+            # execution, which is how a real flow stream runs (compute
+            # overlaps dispatch)
+            first = 1 + args.warmup + n_lat
+            t_stream0 = time.perf_counter()
+            outs = []
+            for c in range(first, n_chunks):
+                outs.append(step(arrays, chunks[c]))
+            jax.block_until_ready(outs)
+            t_stream = time.perf_counter() - t_stream0
         out = outs[-1]
         n_timed = (n_chunks - first) * bs
         vps = n_timed / t_stream
@@ -178,26 +203,29 @@ def main() -> int:
             out = step(arrays, batches[1 + i])
         jax.block_until_ready(out)
 
-        # latency pass: block per call (median/worst per-batch latency)
-        times = []
-        for i in range(args.iters):
-            batch = batches[1 + args.warmup + i]
+        with maybe_trace():
+            # latency pass: block per call (median/worst per-batch
+            # latency)
+            times = []
+            for i in range(args.iters):
+                batch = batches[1 + args.warmup + i]
+                t0 = time.perf_counter()
+                out = step(arrays, batch)
+                jax.block_until_ready(out)
+                times.append(time.perf_counter() - t0)
+            times.sort()
+            med = times[len(times) // 2]
+            n = len(scenario.flows)
+            # throughput pass: dispatch every timed batch (distinct
+            # permuted first-use buffers, pre-staged in HBM) and sync
+            # ONCE — compute overlaps dispatch, as a real replay
+            # pipeline runs
+            base = 1 + args.warmup + args.iters
             t0 = time.perf_counter()
-            out = step(arrays, batch)
-            jax.block_until_ready(out)
-            times.append(time.perf_counter() - t0)
-        times.sort()
-        med = times[len(times) // 2]
-        n = len(scenario.flows)
-        # throughput pass: dispatch every timed batch (distinct permuted
-        # first-use buffers, pre-staged in HBM) and sync ONCE — compute
-        # overlaps dispatch, as a real replay pipeline runs
-        base = 1 + args.warmup + args.iters
-        t0 = time.perf_counter()
-        outs = [step(arrays, batches[base + i])
-                for i in range(args.iters)]
-        jax.block_until_ready(outs)
-        t_all = time.perf_counter() - t0
+            outs = [step(arrays, batches[base + i])
+                    for i in range(args.iters)]
+            jax.block_until_ready(outs)
+            t_all = time.perf_counter() - t0
         out = outs[-1]
         vps = n * args.iters / t_all
         log(f"batch={n} latency: median={med*1e3:.2f}ms "

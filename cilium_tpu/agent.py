@@ -33,8 +33,11 @@ from cilium_tpu.policy.repository import Repository
 from cilium_tpu.policy.selectorcache import SelectorCache
 from cilium_tpu.runtime.controller import ControllerManager
 from cilium_tpu.runtime.loader import Loader
+from cilium_tpu.runtime.logging import get_logger, setup as setup_logging
 from cilium_tpu.runtime.metrics import METRICS
 from cilium_tpu.runtime.service import VerdictService
+
+LOG = get_logger("daemon")
 
 
 class Agent:
@@ -114,6 +117,11 @@ class Agent:
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "Agent":
+        # the daemon owns process logging (reference: daemon_main
+        # configures logrus); hosts that embed the agent and own their
+        # process's logging opt out via configure_logging=False
+        if self.config.configure_logging:
+            setup_logging(self.config.log_level)
         if self.config.ipam_mode == "cluster-pool":
             # register with the operator and adopt its assignment BEFORE
             # endpoint restore, so restored IPs re-adopt into the right
@@ -193,6 +201,13 @@ class Agent:
         if self.state_dir:
             self.controllers.update("checkpoint", self._checkpoint,
                                     interval=30.0)
+        LOG.info("agent started", extra={"fields": {
+            "backend": "tpu" if self.config.enable_tpu_offload
+            else "oracle",
+            "ipam_mode": self.config.ipam_mode,
+            "pod_cidr": str(self.ipam.cidr),
+            "endpoints_restored": restored,
+        }})
         return self
 
     def stop(self) -> None:
@@ -217,6 +232,7 @@ class Agent:
         if self.state_dir:
             self._checkpoint()
         self.endpoint_manager.shutdown()
+        LOG.info("agent stopped")
 
     def _dns_gc(self) -> None:
         self.name_manager.gc()

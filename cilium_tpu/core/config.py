@@ -80,6 +80,9 @@ class Config:
     loader: LoaderConfig = dataclasses.field(default_factory=LoaderConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     log_level: str = "info"
+    #: Agent.start() installs the JSONL log handler (daemon behavior).
+    #: Hosts embedding the agent that own process logging set False.
+    configure_logging: bool = True
     enable_metrics: bool = True
 
     @classmethod

@@ -25,7 +25,10 @@ from typing import Dict, Optional
 from cilium_tpu.ipam import ClusterPool, PoolExhausted
 from cilium_tpu.kvstore import EVENT_DELETE, KVStore, Lease
 from cilium_tpu.runtime.controller import Controller
+from cilium_tpu.runtime.logging import get_logger
 from cilium_tpu.runtime.metrics import METRICS
+
+LOG = get_logger("operator")
 
 NODES_PREFIX = "cilium/nodes/"
 CIDRS_PREFIX = "cilium/podcidrs/"
@@ -56,11 +59,15 @@ class Operator:
         for key, value in self.store.list_prefix(CIDRS_PREFIX).items():
             try:
                 out[key[len(CIDRS_PREFIX):]] = json.loads(value)["cidr"]
-            except (ValueError, KeyError, TypeError):
+            except (ValueError, KeyError, TypeError) as e:
                 self.store.delete(key)
                 # no-op unless the pool holds an adoption for this node
                 # (corruption after adopt): without it the subnet leaks
                 self.pool.release_node_cidr(key[len(CIDRS_PREFIX):])
+                LOG.warning("quarantined corrupt podCIDR assignment",
+                            extra={"fields": {
+                                "node": key[len(CIDRS_PREFIX):],
+                                "error": f"{type(e).__name__}: {e}"}})
                 METRICS.inc(
                     "cilium_tpu_operator_cidrs_quarantined_total", 1)
         return out
@@ -111,6 +118,9 @@ class Operator:
                 if node not in nodes:
                     self.store.delete(CIDRS_PREFIX + node)
                     self.pool.release_node_cidr(node)
+                    LOG.info("reclaimed podCIDR from departed node",
+                             extra={"fields": {"node": node,
+                                               "cidr": assigned[node]}})
                     del assigned[node]
                     METRICS.inc("cilium_tpu_operator_cidrs_reclaimed_total",
                                 1)

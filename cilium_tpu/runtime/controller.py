@@ -13,7 +13,10 @@ import time
 import traceback
 from typing import Callable, Dict, Optional
 
+from cilium_tpu.runtime.logging import get_logger
 from cilium_tpu.runtime.metrics import METRICS
+
+LOG = get_logger("controller")
 
 
 class Controller:
@@ -63,6 +66,10 @@ class Controller:
             except Exception as e:
                 self.failures += 1
                 self.last_error = f"{type(e).__name__}: {e}"
+                LOG.error("controller run failed",
+                          extra={"fields": {"controller": self.name,
+                                            "failures": self.failures,
+                                            "error": self.last_error}})
                 METRICS.inc("cilium_tpu_controller_runs_total",
                             labels={"controller": self.name,
                                     "status": "failure"})

@@ -21,7 +21,10 @@ from cilium_tpu.policy.oracle import OracleVerdictEngine
 from cilium_tpu.policy.repository import Repository
 from cilium_tpu.policy.selectorcache import SelectorCache
 from cilium_tpu.runtime.checkpoint import ArtifactCache, ruleset_fingerprint
+from cilium_tpu.runtime.logging import get_logger, span as _log_span
 from cilium_tpu.runtime.metrics import METRICS, SpanStat
+
+LOG = get_logger("loader")
 
 
 class Loader:
@@ -82,6 +85,7 @@ class Loader:
             repr(self.config.engine),
         )
         policy = self._cache.get(key)
+        cached = policy is not None
         if policy is None:
             with SpanStat("policy_compile") as span:
                 policy = CompiledPolicy.build(per_identity,
@@ -89,8 +93,10 @@ class Loader:
                                               revision=revision)
             self._cache.put(key, policy)
             METRICS.observe("cilium_tpu_compile_seconds", span.seconds)
-        with SpanStat("policy_stage"):
-            engine = VerdictEngine(policy, device=self.device)
+        with _log_span(LOG, "policy staged", revision=revision,
+                       identities=len(per_identity), cache_hit=cached):
+            with SpanStat("policy_stage"):
+                engine = VerdictEngine(policy, device=self.device)
         with self._lock:
             self._engine = engine
             self._revision = revision

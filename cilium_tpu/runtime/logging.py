@@ -1,0 +1,123 @@
+"""Structured logging: JSONL records with subsystem fields.
+
+Reference: ``pkg/logging`` (SURVEY.md §5.5) — logrus with a
+``subsys`` field on every logger, level from config, structured
+key/value fields. Ours layers the same shape over stdlib ``logging``:
+``get_logger("loader")`` returns a logger whose records carry
+``subsys``; the JSONL handler emits one JSON object per line
+(`ts`, `level`, `subsys`, `msg`, plus any ``extra`` fields), which is
+what log collectors ingest and what `bugtool` bundles.
+
+Usage::
+
+    log = get_logger("loader")
+    log.info("staged", extra={"fields": {"revision": 3, "banks": 4}})
+
+Call :func:`setup` once (the agent does) to install the JSONL handler;
+until then records propagate to whatever the host process configured —
+library-friendly, like the reference's default logger.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import Optional
+
+ROOT = "cilium_tpu"
+
+_LEVELS = {"debug": logging.DEBUG, "info": logging.INFO,
+           "warning": logging.WARNING, "warn": logging.WARNING,
+           "error": logging.ERROR, "critical": logging.CRITICAL,
+           "fatal": logging.CRITICAL}
+
+
+class JSONLFormatter(logging.Formatter):
+    """One JSON object per record; ``extra={"fields": {...}}`` merges in."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(record.created, 6),
+            "level": record.levelname.lower(),
+            "subsys": getattr(record, "subsys",
+                              record.name.rsplit(".", 1)[-1]),
+            "msg": record.getMessage(),
+        }
+        fields = getattr(record, "fields", None)
+        if fields:
+            for k, v in fields.items():
+                if k not in out:
+                    out[k] = v
+        if record.exc_info and record.exc_info[0] is not None:
+            out["error"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+
+class _SubsysAdapter(logging.LoggerAdapter):
+    """Stamps ``subsys`` on every record and accepts bare keyword
+    fields: ``log.info("msg", extra={"fields": {...}})``."""
+
+    def process(self, msg, kwargs):
+        extra = kwargs.setdefault("extra", {})
+        extra.setdefault("subsys", self.extra["subsys"])
+        return msg, kwargs
+
+
+def get_logger(subsys: str) -> logging.LoggerAdapter:
+    """Per-subsystem structured logger (``subsys`` field on every
+    record), mirroring ``logging.DefaultLogger.WithField(logfields.
+    LogSubsys, ...)`` in the reference."""
+    return _SubsysAdapter(logging.getLogger(f"{ROOT}.{subsys}"),
+                          {"subsys": subsys})
+
+
+def setup(level: str = "info", stream=None,
+          path: Optional[str] = None) -> logging.Logger:
+    """Install the JSONL handler on the package root logger.
+
+    ``path`` appends to a file instead of (not in addition to) the
+    stream — one sink, like the reference's single logrus output.
+    Idempotent: repeated calls reconfigure rather than stack handlers.
+    """
+    root = logging.getLogger(ROOT)
+    resolved = _LEVELS.get(level.lower())
+    root.setLevel(logging.INFO if resolved is None else resolved)
+    for h in list(root.handlers):
+        root.removeHandler(h)
+        h.close()
+    if path is not None:
+        handler: logging.Handler = logging.FileHandler(path)
+    else:
+        handler = logging.StreamHandler(stream or sys.stderr)
+    handler.setFormatter(JSONLFormatter())
+    root.addHandler(handler)
+    root.propagate = False
+    if resolved is None:
+        # a typo'd level must not silently change verbosity unannounced
+        root.warning("unknown log level %r, using info", level,
+                     extra={"subsys": "logging"})
+    return root
+
+
+def span(log: logging.LoggerAdapter, msg: str, **fields):
+    """Context manager logging ``msg`` with a ``duration_s`` field on
+    exit — the logging face of spanstat (metrics keeps the histogram)."""
+
+    class _Span:
+        def __enter__(self):
+            self.t0 = time.monotonic()
+            return self
+
+        def __exit__(self, exc_type, exc, tb):
+            dur = round(time.monotonic() - self.t0, 6)
+            all_fields = dict(fields, duration_s=dur)
+            if exc is not None:
+                all_fields["failed"] = f"{type(exc).__name__}: {exc}"
+                log.error(msg, extra={"fields": all_fields})
+            else:
+                log.info(msg, extra={"fields": all_fields})
+            return False
+
+    return _Span()

@@ -1,0 +1,133 @@
+"""Structured JSONL logging (runtime/logging.py — pkg/logging analog)."""
+
+import io
+import json
+import logging as stdlib_logging
+
+from cilium_tpu.runtime.logging import JSONLFormatter, get_logger, setup, span
+
+
+def capture(level="info"):
+    stream = io.StringIO()
+    setup(level=level, stream=stream)
+    return stream
+
+
+def records(stream):
+    return [json.loads(line) for line in stream.getvalue().splitlines()]
+
+
+def teardown_function(_fn):
+    # restore default propagation so other tests' caplog still works
+    root = stdlib_logging.getLogger("cilium_tpu")
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    root.propagate = True
+    root.setLevel(stdlib_logging.NOTSET)
+
+
+def test_records_are_jsonl_with_subsys_and_fields():
+    stream = capture()
+    log = get_logger("loader")
+    log.info("staged", extra={"fields": {"revision": 3, "banks": 4}})
+    recs = records(stream)
+    assert len(recs) == 1
+    r = recs[0]
+    assert r["msg"] == "staged" and r["subsys"] == "loader"
+    assert r["revision"] == 3 and r["banks"] == 4
+    assert r["level"] == "info" and isinstance(r["ts"], float)
+
+
+def test_level_filtering():
+    stream = capture(level="warning")
+    log = get_logger("daemon")
+    log.info("quiet")
+    log.warning("loud")
+    recs = records(stream)
+    assert [r["msg"] for r in recs] == ["loud"]
+
+
+def test_setup_is_idempotent_no_duplicate_lines():
+    stream = capture()
+    setup(stream=stream)  # reconfigure; must not stack handlers
+    get_logger("x").info("once")
+    assert len(records(stream)) == 1
+
+
+def test_exceptions_are_captured():
+    stream = capture()
+    log = get_logger("svc")
+    try:
+        raise ValueError("boom")
+    except ValueError:
+        log.error("failed", exc_info=True)
+    r = records(stream)[0]
+    assert "boom" in r["error"]
+
+
+def test_span_logs_duration_and_failure():
+    stream = capture()
+    log = get_logger("loader")
+    with span(log, "policy staged", revision=7):
+        pass
+    try:
+        with span(log, "policy staged", revision=8):
+            raise RuntimeError("stage exploded")
+    except RuntimeError:
+        pass
+    ok, fail = records(stream)
+    assert ok["revision"] == 7 and ok["duration_s"] >= 0
+    assert fail["level"] == "error" and "stage exploded" in fail["failed"]
+
+
+def test_fields_cannot_mask_core_keys():
+    stream = capture()
+    get_logger("x").info("msg", extra={"fields": {"msg": "evil",
+                                                  "extra_ok": 1}})
+    r = records(stream)[0]
+    assert r["msg"] == "msg" and r["extra_ok"] == 1
+
+
+def test_unknown_level_warns_and_falls_back():
+    stream = io.StringIO()
+    setup(level="inof", stream=stream)
+    recs = records(stream)
+    assert recs and recs[0]["level"] == "warning"
+    assert "inof" in recs[0]["msg"]
+    # logrus-style aliases resolve
+    stream2 = io.StringIO()
+    setup(level="warn", stream=stream2)
+    log = get_logger("x")
+    log.info("quiet")
+    log.warning("loud")
+    assert [r["msg"] for r in records(stream2)] == ["loud"]
+
+
+def test_embedder_can_opt_out_of_logging_setup():
+    from cilium_tpu.agent import Agent
+    from cilium_tpu.core.config import Config
+
+    root = stdlib_logging.getLogger("cilium_tpu")
+    cfg = Config()
+    cfg.configure_logging = False
+    agent = Agent(cfg).start()
+    try:
+        assert not root.handlers  # host logging config untouched
+        assert root.propagate
+    finally:
+        agent.stop()
+
+
+def test_agent_logs_lifecycle(tmp_path):
+    from cilium_tpu.agent import Agent
+    from cilium_tpu.core.config import Config
+
+    stream = io.StringIO()
+    agent = Agent(Config()).start()
+    # agent.start() installed its own stderr handler; swap the stream
+    # to inspect what the daemon logs
+    setup(stream=stream)
+    agent.endpoint_add(1, {"app": "x"})
+    agent.stop()
+    msgs = [r["msg"] for r in records(stream)]
+    assert "agent stopped" in msgs
