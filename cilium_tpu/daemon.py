@@ -1,0 +1,151 @@
+"""cilium-agent entrypoint: assemble and run the daemon.
+
+Reference: ``daemon/cmd/daemon_main.go`` (SURVEY.md §3.1) — flags over
+config, assemble the hive, run until signalled. Ours:
+``python -m cilium_tpu.daemon`` builds :class:`~cilium_tpu.agent.Agent`
+from a TOML config plus flag overrides, optionally connects to a
+socket-served kvstore (the etcd analog, ``--kvstore``) or embeds the
+cluster operator for single-process deployments (``--run-operator``),
+starts every configured server socket, and blocks until
+SIGINT/SIGTERM.
+
+Examples::
+
+  # single process: agent + operator + cluster-pool IPAM
+  python -m cilium_tpu.daemon --run-operator --ipam-mode cluster-pool \
+      --api-socket /run/ct/api.sock --socket /run/ct/verdict.sock
+
+  # multi-process: kvstore server, operator, agent in separate processes
+  python -m cilium_tpu.kvstore_service /run/ct/kv.sock &
+  python -m cilium_tpu.operator --kvstore /run/ct/kv.sock &
+  python -m cilium_tpu.daemon --kvstore /run/ct/kv.sock \
+      --ipam-mode cluster-pool --node-name node-1
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+from typing import List, Optional
+
+from cilium_tpu.core.config import Config
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="cilium-tpu-agent",
+        description="run the cilium-tpu agent (cilium-agent analog)")
+    ap.add_argument("--config", help="TOML config file")
+    ap.add_argument("--enable-tpu-offload", action="store_true",
+                    help="master feature gate: stage policy on the TPU "
+                         "engine instead of the CPU oracle")
+    ap.add_argument("--node-name")
+    ap.add_argument("--cluster-name")
+    ap.add_argument("--ipam-mode", choices=["static", "cluster-pool"])
+    ap.add_argument("--pod-cidr", help="static-mode podCIDR")
+    ap.add_argument("--log-level")
+    ap.add_argument("--socket", help="verdict service unix socket")
+    ap.add_argument("--api-socket", help="REST API unix socket")
+    ap.add_argument("--hubble-socket", help="hubble observer unix socket")
+    ap.add_argument("--policy-dir",
+                    help="directory of CNP YAML to watch (k8s-watcher "
+                         "analog)")
+    ap.add_argument("--state-dir",
+                    help="checkpoint/restore directory (§5.4)")
+    ap.add_argument("--dns-proxy", metavar="HOST:PORT",
+                    help="bind the transparent DNS proxy")
+    ap.add_argument("--dns-upstream", metavar="HOST:PORT",
+                    default="127.0.0.53:53")
+    ap.add_argument("--kvstore", metavar="SOCKET",
+                    help="connect to a socket-served kvstore "
+                         "(python -m cilium_tpu.kvstore_service)")
+    ap.add_argument("--run-operator", action="store_true",
+                    help="embed the cluster operator (single-process "
+                         "deployments)")
+    ap.add_argument("--operator-pool-cidr", default="10.0.0.0/8")
+    ap.add_argument("--operator-node-mask", type=int, default=24)
+    return ap
+
+
+def config_from_args(args) -> Config:
+    cfg = (Config.from_toml(args.config) if args.config
+           else Config.from_env())
+    if args.enable_tpu_offload:
+        cfg.enable_tpu_offload = True
+    for flag in ("node_name", "cluster_name", "ipam_mode", "pod_cidr",
+                 "log_level"):
+        val = getattr(args, flag)
+        if val is not None:
+            setattr(cfg, flag, val)
+    return cfg
+
+
+def _hostport(spec: str) -> tuple:
+    host, _, port = spec.rpartition(":")
+    return (host, int(port))
+
+
+def build(args):
+    """Assemble (agent, operator, kvstore_client) from parsed flags —
+    separated from main() so tests can drive the exact daemon wiring
+    without processes or signals."""
+    from cilium_tpu.agent import Agent
+
+    cfg = config_from_args(args)
+    kv = None
+    if args.kvstore:
+        from cilium_tpu.kvstore_service import RemoteKVStore
+
+        kv = RemoteKVStore(args.kvstore)
+    operator = None
+    agent = Agent(
+        config=cfg,
+        state_dir=args.state_dir,
+        socket_path=args.socket,
+        api_socket_path=args.api_socket,
+        hubble_socket_path=args.hubble_socket,
+        policy_dir=args.policy_dir,
+        dns_proxy_bind=_hostport(args.dns_proxy) if args.dns_proxy
+        else None,
+        dns_upstream=_hostport(args.dns_upstream),
+        kvstore=kv,
+    )
+    if args.run_operator:
+        from cilium_tpu.operator import Operator
+
+        # the operator must be live before Agent.start() blocks on its
+        # podCIDR assignment (cluster-pool mode)
+        operator = Operator(agent.kvstore,
+                            pool_cidr=args.operator_pool_cidr,
+                            node_mask_size=args.operator_node_mask)
+    return agent, operator, kv
+
+
+def main(argv: Optional[List[str]] = None,
+         ready: Optional[threading.Event] = None) -> int:
+    args = build_parser().parse_args(argv)
+    agent, operator, kv = build(args)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    if operator is not None:
+        operator.start()
+    agent.start()
+    if ready is not None:
+        ready.set()
+    try:
+        stop.wait()
+    finally:
+        agent.stop()
+        if operator is not None:
+            operator.stop()
+        if kv is not None:
+            kv.close()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    sys.exit(main())

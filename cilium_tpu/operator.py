@@ -193,13 +193,22 @@ class NodeRegistration:
         """
         if (not self.lease.expired()
                 and self.store.get(self._key) is not None):
-            self.lease.keepalive()
-            # Re-verify AFTER the keepalive: the lease may have lapsed
-            # between the check and the extension (check-then-act
-            # window), in which case GC already deleted the key and a
-            # resurrected deadline would mask the deregistration.
-            if self.store.get(self._key) is not None:
-                return
+            try:
+                self.lease.keepalive()
+            except KeyError:
+                # remote store: the server is authoritative and answers
+                # a keepalive on an already-expired lease with an error
+                # (etcd's ErrLeaseNotFound) — fall through and
+                # re-register
+                pass
+            else:
+                # Re-verify AFTER the keepalive: the lease may have
+                # lapsed between the check and the extension
+                # (check-then-act window), in which case GC already
+                # deleted the key and a resurrected deadline would mask
+                # the deregistration.
+                if self.store.get(self._key) is not None:
+                    return
         self.lease = self.store.lease(self.lease.ttl)
         self.store.set(self._key, self._registration, lease=self.lease)
 
@@ -232,3 +241,41 @@ class NodeRegistration:
         self.close()
         self.store.revoke(self.lease)
         self.store.delete(self._key)
+
+
+def main(argv=None) -> int:  # pragma: no cover - thin wrapper
+    """``cilium-operator`` entrypoint: run against a socket-served
+    kvstore (``python -m cilium_tpu.kvstore_service``)."""
+    import argparse
+    import signal
+    import threading
+
+    ap = argparse.ArgumentParser(
+        prog="cilium-tpu-operator",
+        description="run the cluster operator (cilium-operator analog)")
+    ap.add_argument("--kvstore", required=True,
+                    help="kvstore server unix socket")
+    ap.add_argument("--pool-cidr", default="10.0.0.0/8")
+    ap.add_argument("--node-mask", type=int, default=24)
+    args = ap.parse_args(argv)
+
+    from cilium_tpu.kvstore_service import RemoteKVStore
+    from cilium_tpu.runtime.logging import setup as setup_logging
+
+    setup_logging()
+    kv = RemoteKVStore(args.kvstore)
+    op = Operator(kv, pool_cidr=args.pool_cidr,
+                  node_mask_size=args.node_mask).start()
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    op.stop()
+    kv.close()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,107 @@
+"""Daemon entrypoint (daemon_main analog): flag→config→assembly wiring,
+plus the real multi-process deployment shape as subprocesses.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from cilium_tpu import daemon
+from cilium_tpu.runtime.api import APIClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def parse(argv):
+    return daemon.build_parser().parse_args(argv)
+
+
+def test_flags_override_config(tmp_path):
+    toml = tmp_path / "agent.toml"
+    toml.write_text('node_name = "from-toml"\nlog_level = "warning"\n')
+    args = parse(["--config", str(toml), "--node-name", "from-flag",
+                  "--enable-tpu-offload"])
+    cfg = daemon.config_from_args(args)
+    assert cfg.node_name == "from-flag"  # flag wins
+    assert cfg.log_level == "warning"    # toml survives
+    assert cfg.enable_tpu_offload
+
+
+def test_build_single_process_with_operator(tmp_path):
+    args = parse(["--run-operator", "--ipam-mode", "cluster-pool",
+                  "--node-name", "solo",
+                  "--operator-pool-cidr", "10.230.0.0/16",
+                  "--api-socket", str(tmp_path / "api.sock")])
+    agent, operator, kv = daemon.build(args)
+    assert operator is not None and kv is None
+    operator.start()
+    agent.start()
+    try:
+        assert str(agent.ipam.cidr).startswith("10.230.")
+        c = APIClient(str(tmp_path / "api.sock"))
+        assert c.healthz()["status"] == "ok"
+    finally:
+        agent.stop()
+        operator.stop()
+
+
+def _wait_for(path, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_three_process_deployment(tmp_path):
+    """kvstore server, operator, and agent as real OS processes — the
+    reference's deployment shape (etcd + cilium-operator +
+    cilium-agent)."""
+    kv_sock = str(tmp_path / "kv.sock")
+    api_sock = str(tmp_path / "api.sock")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    procs = []
+    try:
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "cilium_tpu.kvstore_service", kv_sock],
+            cwd=REPO, env=env))
+        assert _wait_for(kv_sock), "kvstore server never came up"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "cilium_tpu.operator",
+             "--kvstore", kv_sock, "--pool-cidr", "10.240.0.0/16"],
+            cwd=REPO, env=env))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "cilium_tpu.daemon",
+             "--kvstore", kv_sock, "--ipam-mode", "cluster-pool",
+             "--node-name", "proc-node", "--api-socket", api_sock],
+            cwd=REPO, env=env))
+        assert _wait_for(api_sock, timeout=30.0), "agent never came up"
+        client = APIClient(api_sock)
+        deadline = time.monotonic() + 15
+        status = None
+        while time.monotonic() < deadline:
+            try:
+                status = client.request("GET", "/v1/debuginfo")[1]
+                if status["ipam"]["cidr"].startswith("10.240."):
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        assert status is not None
+        assert status["ipam"]["mode"] == "cluster-pool"
+        assert status["ipam"]["cidr"].startswith("10.240."), status["ipam"]
+        # endpoint CRUD across the process boundary
+        code, ep = client.endpoint_put(1, {"app": "proc"})
+        assert code in (200, 201) and ep["ipv4"].startswith("10.240.")
+        # graceful shutdown on SIGTERM
+        for p in reversed(procs):
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            assert p.wait(timeout=20) == 0
+        procs = []
+    finally:
+        for p in procs:
+            p.kill()

@@ -1,0 +1,230 @@
+"""Socket-served kvstore (etcd analog): RemoteKVStore must be a
+drop-in for the in-process KVStore across all consumers.
+
+Reference: ``pkg/kvstore`` etcd backend (SURVEY.md §2.4/§2.7).
+"""
+
+import threading
+import time
+
+import pytest
+
+from cilium_tpu.kvstore import EVENT_CREATE, EVENT_DELETE
+from cilium_tpu.kvstore_service import KVStoreServer, RemoteKVStore
+
+
+@pytest.fixture
+def served(tmp_path):
+    path = str(tmp_path / "kv.sock")
+    server = KVStoreServer(path).start()
+    client = RemoteKVStore(path)
+    yield server, client, path
+    client.close()
+    server.stop()
+
+
+def test_basic_kv_roundtrip(served):
+    _, kv, _ = served
+    kv.set("a/1", "x")
+    kv.set("a/2", "y")
+    kv.set("b/1", "z")
+    assert kv.get("a/1") == "x"
+    assert kv.get("missing") is None
+    assert kv.list_prefix("a/") == {"a/1": "x", "a/2": "y"}
+    assert kv.delete("a/1") is True
+    assert kv.delete("a/1") is False
+    assert kv.delete_prefix("a/") == 1
+    assert kv.revision > 0
+
+
+def test_watch_replay_then_follow(served):
+    _, kv, path = served
+    kv.set("w/1", "old")
+    events = []
+    got_live = threading.Event()
+
+    def cb(ev):
+        events.append(ev)
+        if ev.key == "w/2":
+            got_live.set()
+
+    w = RemoteKVStore(path).watch_prefix("w/", cb)
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not events:
+            time.sleep(0.01)
+        assert events and events[0].typ == EVENT_CREATE
+        assert events[0].key == "w/1"  # replay first
+        kv.set("w/2", "live")
+        assert got_live.wait(5.0)
+    finally:
+        w.stop()
+    # after stop, no further callbacks
+    n = len(events)
+    kv.set("w/3", "ignored")
+    time.sleep(0.1)
+    assert len(events) == n
+
+
+def test_lease_expiry_server_side(served):
+    _, kv, _ = served
+    lease = kv.lease(0.2)
+    kv.set("ephemeral", "v", lease=lease)
+    assert kv.get("ephemeral") == "v"
+    # no client activity at all: the server's sweeper must expire it
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and kv.get("ephemeral") is not None:
+        time.sleep(0.1)
+    assert kv.get("ephemeral") is None
+
+
+def test_keepalive_on_expired_lease_errors(served):
+    _, kv, _ = served
+    lease = kv.lease(0.1)
+    kv.set("gone", "v", lease=lease)
+    time.sleep(0.3)
+    with pytest.raises(KeyError):
+        lease.keepalive()
+
+
+def test_lease_keepalive_keeps_key(served):
+    _, kv, _ = served
+    lease = kv.lease(0.4)
+    kv.set("alive", "v", lease=lease)
+    for _ in range(4):
+        time.sleep(0.2)
+        lease.keepalive()
+    assert kv.get("alive") == "v"
+
+
+def test_expired_lease_delete_fires_watch(served):
+    _, kv, path = served
+    deleted = threading.Event()
+    w = RemoteKVStore(path).watch_prefix(
+        "eph/", lambda ev: deleted.set() if ev.typ == EVENT_DELETE else None)
+    try:
+        kv.set("eph/1", "v", lease=kv.lease(0.2))
+        assert deleted.wait(5.0), "sweeper never fired the DELETE event"
+    finally:
+        w.stop()
+
+
+def test_watch_resubscribes_after_server_restart(tmp_path):
+    """Regression: a watch must survive a kvstore server restart by
+    resubscribing (with replay), not die silently — an agent blind to
+    podCIDR rewrites would allocate from a range it no longer owns."""
+    path = str(tmp_path / "kv.sock")
+    server = KVStoreServer(path).start()
+    kv = RemoteKVStore(path)
+    seen = []
+    got_post_restart = threading.Event()
+
+    def cb(ev):
+        seen.append(ev)
+        if ev.key == "r/after":
+            got_post_restart.set()
+
+    w = RemoteKVStore(path).watch_prefix("r/", cb)
+    try:
+        kv.set("r/before", "1")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not seen:
+            time.sleep(0.01)
+        assert seen
+        server.stop()
+        server = KVStoreServer(path, store=server.store).start()
+        kv.set("r/after", "2")
+        assert got_post_restart.wait(10.0), "watch never resubscribed"
+    finally:
+        w.stop()
+        kv.close()
+        server.stop()
+
+
+def test_revoke_unknown_lease_is_not_an_error(tmp_path):
+    """Regression: after a server restart the lease registry is fresh;
+    deregistration must still reach its key delete."""
+    path = str(tmp_path / "kv.sock")
+    server = KVStoreServer(path).start()
+    kv = RemoteKVStore(path)
+    lease = kv.lease(60.0)
+    kv.set("node/x", "v", lease=lease)
+    server.stop()
+    server = KVStoreServer(path, store=server.store).start()
+    try:
+        kv.revoke(lease)  # unknown to the new server: must not raise
+        assert kv.delete("node/x") in (True, False)
+    finally:
+        kv.close()
+        server.stop()
+
+
+def test_client_reconnects_after_server_restart(tmp_path):
+    path = str(tmp_path / "kv.sock")
+    server = KVStoreServer(path).start()
+    kv = RemoteKVStore(path)
+    kv.set("k", "v")
+    server.stop()
+    server2 = KVStoreServer(path, store=server.store).start()
+    try:
+        assert kv.get("k") == "v"  # transparent reconnect, same data
+    finally:
+        kv.close()
+        server2.stop()
+
+
+def test_operator_and_agent_over_served_store(tmp_path):
+    """The multi-process shape: operator and agent each hold their own
+    RemoteKVStore client to one server — cluster-pool IPAM must work
+    exactly as in-process."""
+    from cilium_tpu.agent import Agent
+    from cilium_tpu.core.config import Config
+    from cilium_tpu.operator import Operator
+
+    path = str(tmp_path / "kv.sock")
+    server = KVStoreServer(path).start()
+    op_kv = RemoteKVStore(path)
+    agent_kv = RemoteKVStore(path)
+    op = Operator(op_kv, pool_cidr="10.220.0.0/16", node_mask_size=24)
+    op.start()
+    cfg = Config()
+    cfg.ipam_mode = "cluster-pool"
+    cfg.node_name = "remote-node"
+    cfg.configure_logging = False
+    agent = Agent(config=cfg, kvstore=agent_kv).start()
+    try:
+        assert str(agent.ipam.cidr).startswith("10.220.")
+        ep = agent.endpoint_add(4, {"app": "x"})
+        assert ep.ipv4.startswith("10.220.")
+    finally:
+        agent.stop()
+        op.stop()
+        op_kv.close()
+        agent_kv.close()
+        server.stop()
+
+
+def test_clustermesh_over_served_store(tmp_path):
+    """Clustermesh publisher + remote watcher across the wire."""
+    from cilium_tpu.clustermesh import LocalStatePublisher
+    from cilium_tpu.core.identity import IdentityAllocator
+    from cilium_tpu.core.labels import LabelSet
+    from cilium_tpu.ipcache import IPCache
+    from cilium_tpu.policy.selectorcache import SelectorCache
+
+    path = str(tmp_path / "kv.sock")
+    server = KVStoreServer(path).start()
+    kv = RemoteKVStore(path)
+    allocator = IdentityAllocator()
+    sc = SelectorCache(allocator)
+    ipcache = IPCache(allocator, sc)
+    pub = LocalStatePublisher(kv, "cluster-a", allocator, ipcache)
+    try:
+        ident = allocator.allocate(LabelSet.from_dict({"app": "remote"}))
+        ipcache.upsert("10.9.9.9/32", ident)
+        pub.heartbeat()
+        keys = kv.list_prefix("cilium/")
+        assert any("10.9.9.9" in k for k in keys), keys
+    finally:
+        kv.close()
+        server.stop()
