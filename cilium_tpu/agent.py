@@ -56,7 +56,15 @@ class Agent:
         # serializes compound mutations (endpoint/policy upserts) from
         # concurrent writers: REST API threads, watcher controller, CLI
         self.write_lock = threading.RLock()
-        self.allocator = IdentityAllocator()
+        # the kvstore comes first: cluster-wide identity allocation and
+        # cluster-pool IPAM both build on it
+        self.kvstore = kvstore if kvstore is not None else KVStore()
+        if self.config.identity_allocation_mode == "kvstore":
+            from cilium_tpu.identity_kvstore import ClusterIdentityAllocator
+
+            self.allocator = ClusterIdentityAllocator(self.kvstore)
+        else:
+            self.allocator = IdentityAllocator()
         self.selector_cache = SelectorCache(self.allocator)
         self.ipcache = IPCache(self.allocator, self.selector_cache)
         self.repo = Repository()
@@ -73,7 +81,6 @@ class Agent:
         # watch remote clusters' stores for their identities/IPs. A
         # caller-supplied store is how this agent shares state with an
         # Operator (cluster-pool IPAM) and other agents in-process.
-        self.kvstore = kvstore if kvstore is not None else KVStore()
         self.publisher = LocalStatePublisher(
             self.kvstore, self.config.cluster_name, self.allocator,
             self.ipcache)
@@ -122,6 +129,13 @@ class Agent:
         # process's logging opt out via configure_logging=False
         if self.config.configure_logging:
             setup_logging(self.config.log_level)
+        if self.config.identity_allocation_mode == "kvstore":
+            # remote allocations reach policy through the selector
+            # cache (the reference's identity-cache events); start()
+            # replays existing cluster identities before anything
+            # resolves policy against them
+            self.allocator.on_change = self._on_cluster_identity
+            self.allocator.start()
         if self.config.ipam_mode == "cluster-pool":
             # register with the operator and adopt its assignment BEFORE
             # endpoint restore, so restored IPs re-adopt into the right
@@ -136,10 +150,12 @@ class Agent:
             except TimeoutError:
                 # don't leave a registered node (holding a reconcile
                 # slot — it would be assigned a CIDR nobody consumes)
-                # or a live watch behind a failed start; a retry builds
-                # a fresh registration instead of stacking watches
+                # or live watches behind a failed start; a retry builds
+                # fresh subscriptions instead of stacking them
                 self.node_registration.deregister()
                 self.node_registration = None
+                if hasattr(self.allocator, "close"):
+                    self.allocator.close()
                 raise
             with self.write_lock:
                 # fresh read, not the wait result: a re-carve landing
@@ -221,6 +237,8 @@ class Agent:
             # stay down past the TTL — the reference's pinned-map
             # discipline, SURVEY.md §5.3/§5.4)
             self.node_registration.close()
+        if hasattr(self.allocator, "close"):
+            self.allocator.close()
         if self.hubble_server is not None:
             self.hubble_server.stop()
         if self.dns_server is not None:
@@ -236,6 +254,17 @@ class Agent:
 
     def _dns_gc(self) -> None:
         self.name_manager.gc()
+
+    def _on_cluster_identity(self, nid: int, labels) -> None:
+        """A (possibly remote) cluster identity appeared or vanished in
+        the kvstore: update selector resolution and regenerate, so
+        policies selecting that identity's labels enforce on this node
+        too (§3.2's incremental path for identity churn)."""
+        if labels is None:
+            self.selector_cache.remove_identity(nid)
+        else:
+            self.selector_cache.add_identity(nid, labels)
+        self.endpoint_manager.regenerate_all()
 
     def _on_pod_cidr_change(self, old: Optional[str],
                             new: Optional[str]) -> None:

@@ -75,6 +75,10 @@ class Config:
     #: operator and receives this node's podCIDR from the cluster pool
     #: (the reference's default IPAM mode, SURVEY.md §2.4)
     ipam_mode: str = "static"
+    #: "local" = single-process allocator; "kvstore" = cluster-wide
+    #: allocation through the shared store, so every node maps the same
+    #: labels to the same identity (`--identity-allocation-mode` analog)
+    identity_allocation_mode: str = "local"
     pod_cidr: str = "10.0.0.0/24"      # this node's IPAM podCIDR (static)
     engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
     loader: LoaderConfig = dataclasses.field(default_factory=LoaderConfig)
@@ -112,7 +116,7 @@ class Config:
         cfg.enable_tpu_offload = bool(data.get("enable_tpu_offload",
                                                cfg.enable_tpu_offload))
         for key in ("cluster_name", "node_name", "ipam_mode", "pod_cidr",
-                    "log_level"):
+                    "identity_allocation_mode", "log_level"):
             if key in data:
                 setattr(cfg, key, data[key])
         for section, target in (("engine", cfg.engine),

@@ -43,6 +43,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--node-name")
     ap.add_argument("--cluster-name")
     ap.add_argument("--ipam-mode", choices=["static", "cluster-pool"])
+    ap.add_argument("--identity-allocation-mode",
+                    choices=["local", "kvstore"],
+                    help="kvstore = cluster-wide label→identity "
+                         "agreement through the shared store")
     ap.add_argument("--pod-cidr", help="static-mode podCIDR")
     ap.add_argument("--log-level")
     ap.add_argument("--socket", help="verdict service unix socket")
@@ -74,7 +78,7 @@ def config_from_args(args) -> Config:
     if args.enable_tpu_offload:
         cfg.enable_tpu_offload = True
     for flag in ("node_name", "cluster_name", "ipam_mode", "pod_cidr",
-                 "log_level"):
+                 "identity_allocation_mode", "log_level"):
         val = getattr(args, flag)
         if val is not None:
             setattr(cfg, flag, val)

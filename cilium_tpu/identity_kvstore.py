@@ -1,0 +1,290 @@
+"""Cluster-wide identity allocation through the shared kvstore.
+
+Reference: ``pkg/allocator`` + ``pkg/identity/cache`` in kvstore mode
+(SURVEY.md §2.1 "label-set → identity allocation via kvstore or
+CiliumIdentity CRD") — every node must map the same label set to the
+same numeric identity, or cross-node policy is meaningless. The etcd
+layout is mirrored:
+
+  cilium/state/identities/v1/id/<id>       → {"labels": [...], "ts": t}
+  cilium/state/identities/v1/value/<enc>   → "<id>"
+
+Allocation claims an id with ``create`` (etcd CreateOnly), then
+publishes the labels→id mapping the same way; losing either race means
+adopting the winner's id. A prefix watch (replay-then-follow) keeps a
+local cache hot and feeds remote allocations to the agent via
+``on_change`` — that's how a selector cache learns about identities
+allocated by *other* nodes. Reserved identities and node-local CIDR
+identities never touch the store (the reference scopes CIDR identities
+node-locally too).
+
+Orphan id keys (a claim whose mapping write lost the race, or a crash
+between the two writes) are garbage-collected by the Operator after a
+grace period — the ``cilium-operator`` identity-GC duty.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Dict, Iterable, Optional
+
+from cilium_tpu.core.identity import (
+    IDENTITY_SCOPE_LOCAL,
+    IDENTITY_USER_MAX,
+    IDENTITY_USER_MIN,
+    RESERVED_LABELS,
+    NumericIdentity,
+)
+from cilium_tpu.core.labels import LabelSet
+from cilium_tpu.kvstore import EVENT_DELETE, Event
+from cilium_tpu.runtime.logging import get_logger
+from cilium_tpu.runtime.metrics import METRICS
+
+LOG = get_logger("identity")
+
+ID_PREFIX = "cilium/state/identities/v1/id/"
+VALUE_PREFIX = "cilium/state/identities/v1/value/"
+
+#: GC grace: an unreferenced id key younger than this may be a claim
+#: whose labels→id mapping write is still in flight — never collect it.
+GC_GRACE_S = 60.0
+
+
+def _encode_labels(labels: LabelSet) -> str:
+    # key-safe, stable: sorted canonical label strings joined by ';'
+    return ";".join(sorted(labels.format()))
+
+
+def _decode_labels(enc: Iterable[str]) -> LabelSet:
+    return LabelSet.parse(enc)
+
+
+def _decode_enc(enc: str) -> LabelSet:
+    return LabelSet() if enc == "" else _decode_labels(enc.split(";"))
+
+
+class ClusterIdentityAllocator:
+    """Duck-type of :class:`~cilium_tpu.core.identity.IdentityAllocator`
+    whose user-scope allocations are cluster-global via the kvstore."""
+
+    def __init__(self, store,
+                 on_change: Optional[Callable[[NumericIdentity,
+                                               Optional[LabelSet]],
+                                              None]] = None):
+        self.store = store
+        #: called as on_change(nid, labels) for identities appearing in
+        #: the store (labels=None on deletion); set before start() or
+        #: via the attribute — the agent points it at its SelectorCache
+        self.on_change = on_change
+        self._lock = threading.Lock()
+        self._by_labels: Dict[LabelSet, NumericIdentity] = {}
+        self._by_id: Dict[NumericIdentity, LabelSet] = {}
+        self._next_local = IDENTITY_SCOPE_LOCAL
+        #: lower bound for the next id claim; bumped past every failed
+        #: create so contended allocation converges without re-listing
+        #: the whole id table from the store each attempt
+        self._candidate_floor = IDENTITY_USER_MIN
+        self._watch = None
+        for rid, lbls in RESERVED_LABELS.items():
+            self._by_labels[lbls] = int(rid)
+            self._by_id[int(rid)] = lbls
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "ClusterIdentityAllocator":
+        """Replay existing identities, then follow the cluster.
+
+        The watch follows the **value** (labels→id) keys — the only
+        authoritative mapping. Following the id claims instead would
+        let a concurrently-losing claim transiently poison every
+        node's label resolution. Idempotent: a retried Agent.start()
+        must not stack a second watch.
+        """
+        if self._watch is None:
+            self._watch = self.store.watch_prefix(VALUE_PREFIX,
+                                                  self._on_event)
+        return self
+
+    def close(self) -> None:
+        if self._watch is not None:
+            self._watch.stop()
+            self._watch = None
+
+    def _gauge_locked(self) -> None:
+        METRICS.set_gauge("cilium_tpu_identities_cluster",
+                          float(len(self._by_id)))
+
+    def _on_event(self, ev: Event) -> None:
+        try:
+            labels = _decode_enc(ev.key[len(VALUE_PREFIX):])
+            nid = int(ev.value)  # previous value on deletes, new else
+        except ValueError:
+            return  # corrupt entry; the operator GC will reap it
+        if ev.typ == EVENT_DELETE:
+            with self._lock:
+                # guard both pops: a stale delete must not evict a
+                # newer winning mapping
+                if self._by_labels.get(labels) == nid:
+                    self._by_labels.pop(labels)
+                dropped = self._by_id.get(nid) == labels
+                if dropped:
+                    self._by_id.pop(nid)
+                self._gauge_locked()
+            if dropped and self.on_change is not None:
+                self.on_change(nid, None)
+            return
+        with self._lock:
+            known = self._by_id.get(nid) == labels
+            self._by_id[nid] = labels
+            self._by_labels[labels] = nid
+            self._gauge_locked()
+        if not known and self.on_change is not None:
+            self.on_change(nid, labels)
+
+    # -- allocation -------------------------------------------------------
+    def allocate(self, labels: LabelSet) -> NumericIdentity:
+        with self._lock:
+            nid = self._by_labels.get(labels)
+            if nid is not None:
+                return nid
+            if any(lbl.source == "cidr" for lbl in labels):
+                # CIDR identities are node-local-scoped (SURVEY §2.1):
+                # they never enter the shared store
+                nid = self._next_local
+                self._next_local += 1
+                self._by_labels[labels] = nid
+                self._by_id[nid] = labels
+                return nid
+        return self._allocate_global(labels)
+
+    def _allocate_global(self, labels: LabelSet) -> NumericIdentity:
+        enc = _encode_labels(labels)
+        value_key = VALUE_PREFIX + enc
+        payload = json.dumps({"labels": sorted(labels.format()),
+                              "ts": time.time()})
+        for _ in range(64):
+            existing = self.store.get(value_key)
+            if existing is not None:
+                nid = int(existing)
+                self._adopt(nid, labels)
+                return nid
+            candidate = self._next_candidate()
+            if candidate >= IDENTITY_USER_MAX:
+                raise RuntimeError("user identity space exhausted")
+            if not self.store.create(ID_PREFIX + str(candidate), payload):
+                with self._lock:  # claimed by a peer we haven't seen
+                    self._candidate_floor = candidate + 1
+                continue  # re-read and retry
+            if self.store.create(value_key, str(candidate)):
+                self._adopt(candidate, labels)
+                return candidate
+            # Lost the mapping race — unless the mapping IS ours (a
+            # retried create whose first attempt landed but whose
+            # response was lost reports False): re-read before
+            # releasing the claim, or we'd delete a live identity.
+            winner = self.store.get(value_key)
+            if winner == str(candidate):
+                self._adopt(candidate, labels)
+                return candidate
+            self.store.delete(ID_PREFIX + str(candidate))
+            if winner is not None:
+                nid = int(winner)
+                self._adopt(nid, labels)
+                return nid
+        raise RuntimeError("identity allocation did not converge")
+
+    def _next_candidate(self) -> int:
+        """Next id to claim, from the watch-mirrored cache — no
+        full-table round trip per attempt. Ids claimed by peers but not
+        yet visible here just fail the create, bumping the floor."""
+        with self._lock:
+            cache_max = max(
+                (int(nid) for nid in self._by_id
+                 if IDENTITY_USER_MIN <= nid < IDENTITY_USER_MAX),
+                default=IDENTITY_USER_MIN - 1)
+            return max(cache_max + 1, self._candidate_floor)
+
+    def _adopt(self, nid: int, labels: LabelSet) -> None:
+        with self._lock:
+            self._by_labels[labels] = nid
+            self._by_id[nid] = labels
+
+    # -- lookups (IdentityAllocator contract) -----------------------------
+    def lookup(self, nid: NumericIdentity) -> Optional[LabelSet]:
+        with self._lock:
+            labels = self._by_id.get(nid)
+        if labels is not None:
+            return labels
+        if nid < IDENTITY_SCOPE_LOCAL:  # cache miss: ask the store
+            raw = self.store.get(ID_PREFIX + str(int(nid)))
+            if raw is not None:
+                try:
+                    labels = _decode_labels(json.loads(raw)["labels"])
+                except (ValueError, KeyError, TypeError):
+                    return None
+                # cache only if the authoritative labels→id mapping
+                # confirms this claim won — a losing claim's labels
+                # must never enter _by_labels
+                winner = self.store.get(
+                    VALUE_PREFIX + _encode_labels(labels))
+                if winner == str(int(nid)):
+                    self._adopt(int(nid), labels)
+                return labels
+        return None
+
+    def lookup_by_labels(self, labels: LabelSet) -> Optional[NumericIdentity]:
+        with self._lock:
+            nid = self._by_labels.get(labels)
+        if nid is not None:
+            return nid
+        raw = self.store.get(VALUE_PREFIX + _encode_labels(labels))
+        if raw is not None:
+            self._adopt(int(raw), labels)
+            return int(raw)
+        return None
+
+    def release(self, nid: NumericIdentity) -> None:
+        """Forget locally. Store entries are shared cluster state; the
+        operator's identity GC — not any one agent — retires ids no
+        endpoint references (the reference's CiliumIdentity GC)."""
+        with self._lock:
+            labels = self._by_id.pop(nid, None)
+            if labels is not None:
+                self._by_labels.pop(labels, None)
+
+    def identities(self) -> Iterable[NumericIdentity]:
+        with self._lock:
+            return list(self._by_id)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._by_id)
+
+
+def gc_orphan_identities(store, grace_s: float = GC_GRACE_S) -> int:
+    """Operator duty: delete id keys no labels→id mapping references —
+    claims whose second write lost the race or crashed — once older
+    than ``grace_s`` (an in-flight claim must never be collected).
+    Returns the number reaped."""
+    referenced = set(store.list_prefix(VALUE_PREFIX).values())
+    now = time.time()
+    reaped = 0
+    for key, raw in store.list_prefix(ID_PREFIX).items():
+        nid = key[len(ID_PREFIX):]
+        if nid in referenced:
+            continue
+        try:
+            ts = float(json.loads(raw).get("ts", 0))
+        except (ValueError, TypeError, AttributeError):
+            # undecodable or non-object payload: treat as ts=0 so the
+            # corrupt entry is reaped once, instead of crash-looping
+            # the operator's reconcile controller forever
+            ts = 0.0
+        if now - ts < grace_s:
+            continue
+        store.delete(key)
+        reaped += 1
+        METRICS.inc("cilium_tpu_operator_identities_gc_total", 1)
+        LOG.info("reaped orphan identity", extra={"fields": {"id": nid}})
+    return reaped

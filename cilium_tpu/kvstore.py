@@ -142,6 +142,23 @@ class KVStore:
                 watches = list(self._watches)
             self._dispatch(watches, ev)
 
+    def create(self, key: str, value: str,
+               lease: Optional[Lease] = None) -> bool:
+        """Set only if absent (the reference's etcd ``CreateOnly``) —
+        the atomic claim primitive distributed identity allocation
+        builds on. Returns False when the key already exists."""
+        self.expire_leases()  # an expired-lease leftover counts as absent
+        with self._dispatch_lock:
+            with self._lock:
+                if key in self._data:
+                    return False
+                self._data[key] = (value, lease)
+                self._revision += 1
+                ev = Event(EVENT_CREATE, key, value)
+                watches = list(self._watches)
+            self._dispatch(watches, ev)
+        return True
+
     def get(self, key: str) -> Optional[str]:
         self.expire_leases()
         with self._lock:

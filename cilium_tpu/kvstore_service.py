@@ -86,6 +86,9 @@ class KVStoreServer:
         if op == "set":
             store.set(req["key"], req["value"], lease=self._lease_of(req))
             return {"ok": True}
+        if op == "create":
+            return {"created": store.create(req["key"], req["value"],
+                                            lease=self._lease_of(req))}
         if op == "get":
             return {"value": store.get(req["key"])}
         if op == "delete":
@@ -343,6 +346,13 @@ class RemoteKVStore:
         if lease is not None:
             req["lease"] = lease.id
         self._call(req)
+
+    def create(self, key: str, value: str,
+               lease: Optional[RemoteLease] = None) -> bool:
+        req = {"op": "create", "key": key, "value": value}
+        if lease is not None:
+            req["lease"] = lease.id
+        return self._call(req)["created"]
 
     def get(self, key: str) -> Optional[str]:
         return self._call({"op": "get", "key": key})["value"]

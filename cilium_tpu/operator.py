@@ -135,6 +135,11 @@ class Operator:
                 self.store.set(CIDRS_PREFIX + node,
                                json.dumps({"cidr": cidr}))
                 assigned[node] = cidr
+            # identity GC (the reference operator's CiliumIdentity GC
+            # duty): reap orphaned allocation claims past their grace
+            from cilium_tpu.identity_kvstore import gc_orphan_identities
+
+            gc_orphan_identities(self.store)
             return assigned
 
 

@@ -1,0 +1,213 @@
+"""Cluster-wide identity allocation (pkg/allocator kvstore-mode analog):
+cross-node label→identity agreement, race convergence, operator GC.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from cilium_tpu.core.labels import LabelSet
+from cilium_tpu.identity_kvstore import (
+    ID_PREFIX,
+    VALUE_PREFIX,
+    ClusterIdentityAllocator,
+    gc_orphan_identities,
+)
+from cilium_tpu.kvstore import KVStore
+
+
+def labels(**kw):
+    return LabelSet.from_dict(kw)
+
+
+def test_two_nodes_agree_on_identity():
+    store = KVStore()
+    a = ClusterIdentityAllocator(store).start()
+    b = ClusterIdentityAllocator(store).start()
+    try:
+        nid_a = a.allocate(labels(app="db"))
+        nid_b = b.allocate(labels(app="db"))
+        assert nid_a == nid_b
+        assert b.allocate(labels(app="web")) != nid_a
+        # either node resolves either identity
+        assert a.lookup(b.allocate(labels(app="web"))) == labels(app="web")
+    finally:
+        a.close()
+        b.close()
+
+
+def test_remote_allocation_triggers_on_change():
+    store = KVStore()
+    seen = []
+    a = ClusterIdentityAllocator(store).start()
+    b = ClusterIdentityAllocator(
+        store, on_change=lambda nid, lbls: seen.append((nid, lbls)))
+    b.start()
+    try:
+        nid = a.allocate(labels(app="remote"))
+        assert (nid, labels(app="remote")) in seen
+        # replay: a fresh allocator learns existing identities at start
+        c = ClusterIdentityAllocator(store).start()
+        try:
+            assert c.lookup_by_labels(labels(app="remote")) == nid
+        finally:
+            c.close()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_concurrent_allocation_converges():
+    store = KVStore()
+    allocators = [ClusterIdentityAllocator(store).start() for _ in range(4)]
+    results = []
+    barrier = threading.Barrier(4)
+
+    def run(alloc):
+        barrier.wait()
+        results.append(alloc.allocate(labels(app="contended")))
+
+    threads = [threading.Thread(target=run, args=(a,)) for a in allocators]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert not any(t.is_alive() for t in threads), "allocator hung"
+        assert len(results) == 4 and len(set(results)) == 1, results
+        # exactly one mapping and at most transiently-orphaned claims
+        assert len(store.list_prefix(VALUE_PREFIX)) == 1
+    finally:
+        for a in allocators:
+            a.close()
+
+
+def test_losing_claim_never_poisons_label_resolution():
+    """Regression: only the labels→id value mapping is authoritative.
+    A bare id claim (the losing side of an allocation race, or a crash
+    between the two writes) must not surface through lookups or the
+    watch — endpoints must never be assigned an identity that is about
+    to be deleted."""
+    store = KVStore()
+    a = ClusterIdentityAllocator(store).start()
+    try:
+        enc_labels = sorted(labels(app="contested").format())
+        store.set(ID_PREFIX + "777", json.dumps(
+            {"labels": enc_labels, "ts": time.time()}))
+        # the claim alone resolves nothing
+        assert a.lookup_by_labels(labels(app="contested")) is None
+        nid = a.allocate(labels(app="contested"))
+        assert nid != 777
+        # lookup of the orphan claim id must not cache into _by_labels
+        a.lookup(777)
+        assert a.lookup_by_labels(labels(app="contested")) == nid
+    finally:
+        a.close()
+
+
+def test_cidr_identities_stay_node_local():
+    store = KVStore()
+    a = ClusterIdentityAllocator(store).start()
+    try:
+        nid = a.allocate(LabelSet.parse(["cidr:10.0.0.0/8"]))
+        assert nid >= 1 << 24  # local scope
+        assert not store.list_prefix(ID_PREFIX)  # never published
+    finally:
+        a.close()
+
+
+def test_reserved_identities_resolve():
+    from cilium_tpu.core.identity import RESERVED_LABELS
+
+    store = KVStore()
+    a = ClusterIdentityAllocator(store).start()
+    try:
+        for rid, lbls in RESERVED_LABELS.items():
+            assert a.allocate(lbls) == int(rid)
+        assert not store.list_prefix(ID_PREFIX)
+    finally:
+        a.close()
+
+
+def test_gc_reaps_orphans_respects_grace_and_references():
+    store = KVStore()
+    a = ClusterIdentityAllocator(store).start()
+    try:
+        live = a.allocate(labels(app="live"))
+        # orphan: claim without a mapping, older than grace
+        store.set(ID_PREFIX + "9999", json.dumps(
+            {"labels": ["k8s:app=orphan"], "ts": time.time() - 3600}))
+        # in-flight: claim without a mapping, fresh
+        store.set(ID_PREFIX + "9998", json.dumps(
+            {"labels": ["k8s:app=inflight"], "ts": time.time()}))
+        assert gc_orphan_identities(store) == 1
+        assert store.get(ID_PREFIX + "9999") is None
+        assert store.get(ID_PREFIX + "9998") is not None
+        assert store.get(ID_PREFIX + str(int(live))) is not None
+    finally:
+        a.close()
+
+
+def test_cross_node_policy_enforcement(tmp_path):
+    """The point of cluster-wide identities: node B's endpoint labels
+    resolve to the same identity node A's policy selectors matched, so
+    A enforces correctly on flows from B's pods."""
+    from cilium_tpu.agent import Agent
+    from cilium_tpu.core.config import Config
+    from cilium_tpu.core.flow import Flow
+    from cilium_tpu.kvstore_service import KVStoreServer, RemoteKVStore
+    from cilium_tpu.operator import Operator
+    from cilium_tpu.policy.api.cnp import load_cnp_yaml_text
+
+    path = str(tmp_path / "kv.sock")
+    server = KVStoreServer(path).start()
+    op = Operator(RemoteKVStore(path), pool_cidr="10.60.0.0/16")
+    op.start()
+
+    def make_agent(name):
+        cfg = Config()
+        cfg.node_name = name
+        cfg.ipam_mode = "cluster-pool"
+        cfg.identity_allocation_mode = "kvstore"
+        cfg.configure_logging = False
+        return Agent(config=cfg, kvstore=RemoteKVStore(path)).start()
+
+    agent_a = make_agent("node-a")
+    agent_b = make_agent("node-b")
+    try:
+        db = agent_a.endpoint_add(1, {"app": "db"})
+        web_remote = agent_b.endpoint_add(2, {"app": "web"})
+        # same labels, either node → same numeric identity
+        assert agent_a.allocator.lookup_by_labels(
+            LabelSet.from_dict({"app": "web"})) == web_remote.identity
+        agent_a.policy_add(load_cnp_yaml_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: allow-web}
+spec:
+  endpointSelector: {matchLabels: {app: db}}
+  ingress:
+  - fromEndpoints: [{matchLabels: {app: web}}]
+    toPorts: [{ports: [{port: "5432", protocol: TCP}]}]
+""")[0])
+        deadline = time.monotonic() + 10
+        verdicts = None
+        while time.monotonic() < deadline:
+            out = agent_a.process_flows([
+                Flow(src_identity=web_remote.identity,
+                     dst_identity=db.identity, dport=5432),
+                Flow(src_identity=db.identity,
+                     dst_identity=db.identity, dport=5432),
+            ])
+            verdicts = [int(v) for v in out["verdict"]]
+            if verdicts == [1, 2]:
+                break
+            time.sleep(0.2)  # remote identity still propagating
+        assert verdicts == [1, 2], verdicts
+    finally:
+        agent_a.stop()
+        agent_b.stop()
+        op.stop()
+        server.stop()
