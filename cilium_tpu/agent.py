@@ -201,6 +201,13 @@ class Agent:
 
             self.hubble_server = HubbleServer(
                 self.observer, self.hubble_socket_path).start()
+            # advertise this node's observer for relay discovery (the
+            # Hubble Peer service analog), lease-backed so a dead
+            # agent's entry ages out of the relay's peer set
+            self._publish_hubble_peer()
+            self.controllers.update("hubble-peer-heartbeat",
+                                    self._hubble_peer_heartbeat,
+                                    interval=15.0)
         if self.dns_proxy_bind is not None:
             from cilium_tpu.fqdn.server import DNSProxyServer
 
@@ -240,6 +247,13 @@ class Agent:
         if hasattr(self.allocator, "close"):
             self.allocator.close()
         if self.hubble_server is not None:
+            from cilium_tpu.hubble.relay import PeerDirectory
+
+            try:  # clean departure: drop out of relays immediately
+                self.kvstore.delete(
+                    PeerDirectory.PREFIX + self.config.node_name)
+            except Exception:
+                pass  # kvstore gone first; the lease ages the entry out
             self.hubble_server.stop()
         if self.dns_server is not None:
             self.dns_server.stop()
@@ -254,6 +268,38 @@ class Agent:
 
     def _dns_gc(self) -> None:
         self.name_manager.gc()
+
+    def _publish_hubble_peer(self) -> None:
+        import json as _json
+
+        from cilium_tpu.hubble.relay import PeerDirectory
+
+        self._hubble_peer_lease = self.kvstore.lease(60.0)
+        self.kvstore.set(
+            PeerDirectory.PREFIX + self.config.node_name,
+            _json.dumps({"socket": self.hubble_socket_path}),
+            lease=self._hubble_peer_lease)
+
+    def _hubble_peer_heartbeat(self) -> None:
+        from cilium_tpu.hubble.relay import PeerDirectory
+
+        key = PeerDirectory.PREFIX + self.config.node_name
+        # key presence is the authoritative liveness check: the local
+        # KVStore's keepalive never raises on a lapsed lease (only the
+        # remote one mirrors etcd's ErrLeaseNotFound), so relying on
+        # the exception alone would lose the advertisement forever
+        # after a >TTL stall
+        if (self._hubble_peer_lease.expired()
+                or self.kvstore.get(key) is None):
+            self._publish_hubble_peer()
+            return
+        try:
+            self._hubble_peer_lease.keepalive()
+        except KeyError:
+            self._publish_hubble_peer()
+            return
+        if self.kvstore.get(key) is None:  # lapsed in the window
+            self._publish_hubble_peer()
 
     def _on_cluster_identity(self, nid: int, labels) -> None:
         """A (possibly remote) cluster identity appeared or vanished in

@@ -122,6 +122,9 @@ class Flow:
     verdict: Verdict = Verdict.VERDICT_UNKNOWN
     policy_match_type: PolicyMatchType = PolicyMatchType.NONE
     drop_reason: str = ""
+    #: emitting node (flowpb.Flow.node_name); stamped by the relay so a
+    #: merged cluster-wide stream stays attributable
+    node_name: str = ""
 
     def l7_record(self):
         if self.l7 == L7Type.HTTP:

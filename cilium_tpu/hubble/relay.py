@@ -66,7 +66,7 @@ class Relay:
                 # materialize inside the try — get_flows is a generator,
                 # so failures surface during iteration, not at the call
                 stream = [(f.time or 0.0, idx, p.name, f)
-                          for f in p.observer.get_flows(flt)]
+                          for f in self._peer_stream(p, flt, limit)]
                 p.available = True
             except Exception:
                 p.available = False
@@ -77,9 +77,185 @@ class Relay:
             merged = merged[-limit:]
         return [(name, f) for _, _, name, f in merged]
 
+    def add_remote_peer(self, name: str, socket_path: str) -> Peer:
+        """Peer on another node, reached over its hubble socket (the
+        reference relay's gRPC dial to each node's observer)."""
+        if not socket_path:
+            raise ValueError(f"peer {name!r}: empty socket path")
+        return self.add_peer(name, RemoteObserver(socket_path))
+
+    @staticmethod
+    def _peer_stream(p: Peer, flt, limit):
+        """Per-peer query with limit push-down. The global newest-N is
+        a subset of the union of per-peer newest-N slices, so an
+        unfiltered limited query only transfers ≤N flows per peer
+        instead of each peer's whole ring. Filtered queries stay
+        unbounded (a newest-N cut below a filter would under-deliver)."""
+        obs = p.observer
+        if limit is None or flt is not None:
+            return obs.get_flows(flt)
+        if hasattr(obs, "ring"):  # in-process Observer: newest-N slice
+            since = max(obs.ring.oldest_seq, obs.ring.next_seq - limit)
+            return obs.get_flows(flt, since_seq=since)
+        return obs.get_flows(flt, limit=limit)  # RemoteObserver
+
     def status(self) -> Dict[str, Dict]:
         with self._lock:
             return {
                 name: {"available": p.available}
                 for name, p in self._peers.items()
             }
+
+
+class RemoteObserver:
+    """Observer-shaped adapter over a node's hubble socket."""
+
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+
+    def get_flows(self, flt: Optional[FlowFilter] = None,
+                  limit: Optional[int] = None):
+        from cilium_tpu.hubble.server import HubbleClient, filter_to_dict
+        from cilium_tpu.ingest.hubble import flow_from_dict
+
+        client = HubbleClient(self.socket_path)
+        since = None
+        if limit is not None and flt is None:
+            # newest-N, not first-N: resume from next_seq - N so a
+            # limited relay query transfers N flows, not the whole ring
+            st = client.server_status()
+            since = max(st["oldest_seq"], st["next_seq"] - limit)
+        for d in client.get_flows(flt=filter_to_dict(flt),
+                                  since_seq=since):
+            yield flow_from_dict(d)
+
+
+class RelayObserver:
+    """Adapter presenting a Relay as the Observer a
+    :class:`~cilium_tpu.hubble.server.HubbleServer` serves — one relay
+    socket, cluster-wide merged ``GetFlows``, same wire protocol (the
+    existing CLI works against it unchanged).
+
+    Snapshot queries only: per-request merge seqs are not stable across
+    requests, so honoring ``follow``/``since_seq`` would replay the
+    whole cluster snapshot as duplicates in a hot loop. Such requests
+    are rejected with an error line instead (the CLI surfaces it);
+    follow a node's own hubble socket for live streams.
+    ``server_status`` on a relay reports the last snapshot's size.
+    """
+
+    def __init__(self, relay: Relay):
+        self.relay = relay
+        self.seen = 0  # size of the last snapshot served
+        self.lost_reported = 0
+
+    class _Ring:
+        # a relay has no ring; zeros distinguish it from a node status
+        capacity = 0
+        oldest_seq = 0
+        next_seq = 0
+
+    ring = _Ring()
+
+    def get_flows(self, flt=None, since_seq=None, limit=None,
+                  follow=False, timeout=None, with_seq=False):
+        if follow or since_seq is not None:
+            raise ValueError(
+                "the relay serves snapshot queries only; follow/resume "
+                "against a node's own hubble socket")
+        merged = self.relay.get_flows(flt, limit=limit)
+        self.seen = len(merged)
+        for seq, (peer, flow) in enumerate(merged):
+            flow.node_name = flow.node_name or peer
+            yield (seq, flow) if with_seq else flow
+
+
+class PeerDirectory:
+    """kvstore-backed peer discovery (the Hubble Peer service analog):
+    agents publish ``cilium/hubble/peers/<node> → {"socket": path}``
+    under their registration lease; the relay watches the prefix and
+    keeps the peer set current as nodes come and go."""
+
+    PREFIX = "cilium/hubble/peers/"
+
+    def __init__(self, store, relay: Relay):
+        self.store = store
+        self.relay = relay
+        self._watch = None
+
+    def start(self) -> "PeerDirectory":
+        import json as _json
+
+        from cilium_tpu.kvstore import EVENT_DELETE
+
+        def on_event(ev):
+            name = ev.key[len(self.PREFIX):]
+            if ev.typ == EVENT_DELETE:
+                self.relay.remove_peer(name)
+                return
+            try:
+                sock = _json.loads(ev.value)["socket"]
+            except (ValueError, KeyError, TypeError):
+                return
+            self.relay.add_remote_peer(name, sock)
+
+        self._watch = self.store.watch_prefix(self.PREFIX, on_event)
+        return self
+
+    def stop(self) -> None:
+        if self._watch is not None:
+            self._watch.stop()
+            self._watch = None
+
+
+def main(argv=None) -> int:  # pragma: no cover - thin wrapper
+    """``hubble-relay`` entrypoint: discover peers via the kvstore (or
+    take static ``--peer name=socket`` pairs) and serve the merged
+    stream on ``--socket``."""
+    import argparse
+    import signal
+    import threading
+
+    from cilium_tpu.hubble.server import HubbleServer
+    from cilium_tpu.runtime.logging import setup as setup_logging
+
+    ap = argparse.ArgumentParser(prog="cilium-tpu-hubble-relay")
+    ap.add_argument("--socket", required=True,
+                    help="unix socket to serve the merged stream on")
+    ap.add_argument("--kvstore", help="kvstore socket for peer discovery")
+    ap.add_argument("--peer", action="append", default=[],
+                    metavar="NAME=SOCKET", help="static peer (repeatable)")
+    args = ap.parse_args(argv)
+
+    setup_logging()
+    relay = Relay()
+    for spec in args.peer:
+        name, sep, sock = spec.partition("=")
+        if not sep or not name or not sock:
+            ap.error(f"--peer {spec!r}: expected NAME=SOCKET")
+        relay.add_remote_peer(name, sock)
+    directory = None
+    kv = None
+    if args.kvstore:
+        from cilium_tpu.kvstore_service import RemoteKVStore
+
+        kv = RemoteKVStore(args.kvstore)
+        directory = PeerDirectory(kv, relay).start()
+    server = HubbleServer(RelayObserver(relay), args.socket,
+                          relay=relay).start()
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    server.stop()
+    if directory is not None:
+        directory.stop()
+    if kv is not None:
+        kv.close()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    sys.exit(main())

@@ -34,6 +34,20 @@ from cilium_tpu.ingest.hubble import flow_to_dict
 _MAX_FOLLOW_TIMEOUT = 300.0
 
 
+def filter_to_dict(flt: Optional[FlowFilter]) -> Optional[Dict]:
+    """Inverse of :func:`filter_from_dict` (for relaying a filter on to
+    a peer's hubble socket)."""
+    if flt is None:
+        return None
+    return {
+        "verdict": flt.verdict.name if flt.verdict is not None else None,
+        "l7_type": flt.l7_type.name if flt.l7_type is not None else None,
+        "src_identity": flt.src_identity,
+        "dst_identity": flt.dst_identity,
+        "dport": flt.dport,
+    }
+
+
 def filter_from_dict(d: Optional[Dict]) -> Optional[FlowFilter]:
     if not d:
         return None

@@ -37,6 +37,8 @@ def flow_to_dict(f: Flow) -> Dict:
     }
     if f.time:
         d["time"] = f.time
+    if f.node_name:
+        d["node_name"] = f.node_name
     if f.src_ip or f.dst_ip:
         d["IP"] = {"source": f.src_ip, "destination": f.dst_ip}
     l4_proto = Protocol(f.protocol)
@@ -91,6 +93,7 @@ def flow_from_dict(d: Dict) -> Flow:
                                  TrafficDirection.INGRESS)
     f.src_identity = int((d.get("source") or {}).get("identity", 0))
     f.dst_identity = int((d.get("destination") or {}).get("identity", 0))
+    f.node_name = d.get("node_name", "")
     ip = d.get("IP") or {}
     f.src_ip = ip.get("source", "")
     f.dst_ip = ip.get("destination", "")

@@ -1,0 +1,182 @@
+"""Cluster-wide Hubble relay over sockets: remote peers, kvstore peer
+discovery, merged time-ordered stream served on one relay socket.
+
+Reference: ``pkg/hubble/relay`` + the Peer service (SURVEY.md §2.5).
+"""
+
+import json
+import time
+
+import pytest
+
+from cilium_tpu.core.flow import Flow, Verdict
+from cilium_tpu.hubble import Observer
+from cilium_tpu.hubble.observer import FlowFilter
+from cilium_tpu.hubble.relay import (
+    PeerDirectory,
+    Relay,
+    RelayObserver,
+    RemoteObserver,
+)
+from cilium_tpu.hubble.server import HubbleClient, HubbleServer
+from cilium_tpu.kvstore import KVStore
+
+
+def _flow(t, src=1, dst=2, verdict=Verdict.FORWARDED):
+    return Flow(time=t, src_identity=src, dst_identity=dst, dport=80,
+                verdict=verdict)
+
+
+@pytest.fixture
+def two_nodes(tmp_path):
+    obs_a, obs_b = Observer(), Observer()
+    obs_a.observe([_flow(1.0, src=10), _flow(3.0, src=10)])
+    obs_b.observe([_flow(2.0, src=20), _flow(4.0, src=20)])
+    srv_a = HubbleServer(obs_a, str(tmp_path / "a.sock")).start()
+    srv_b = HubbleServer(obs_b, str(tmp_path / "b.sock")).start()
+    yield tmp_path
+    srv_a.stop()
+    srv_b.stop()
+
+
+def test_remote_peers_merge_time_ordered(two_nodes):
+    relay = Relay()
+    relay.add_remote_peer("node-a", str(two_nodes / "a.sock"))
+    relay.add_remote_peer("node-b", str(two_nodes / "b.sock"))
+    merged = relay.get_flows()
+    assert [(n, f.time) for n, f in merged] == [
+        ("node-a", 1.0), ("node-b", 2.0), ("node-a", 3.0), ("node-b", 4.0)]
+    # filters push down to the peers
+    only_b = relay.get_flows(FlowFilter(src_identity=20))
+    assert {n for n, _ in only_b} == {"node-b"}
+
+
+def test_relay_socket_serves_merged_stream(two_nodes):
+    relay = Relay()
+    relay.add_remote_peer("node-a", str(two_nodes / "a.sock"))
+    relay.add_remote_peer("node-b", str(two_nodes / "b.sock"))
+    server = HubbleServer(RelayObserver(relay),
+                          str(two_nodes / "relay.sock"), relay=relay).start()
+    try:
+        client = HubbleClient(str(two_nodes / "relay.sock"))
+        flows = list(client.get_flows())
+        assert [f["node_name"] for f in flows] == [
+            "node-a", "node-b", "node-a", "node-b"]
+        assert client.peers()["peers"] == ["node-a", "node-b"]
+    finally:
+        server.stop()
+
+
+def test_unreachable_peer_degrades_not_fatal(two_nodes):
+    relay = Relay()
+    relay.add_remote_peer("node-a", str(two_nodes / "a.sock"))
+    relay.add_remote_peer("ghost", str(two_nodes / "nope.sock"))
+    merged = relay.get_flows()
+    assert {n for n, _ in merged} == {"node-a"}
+    assert relay.status()["ghost"]["available"] is False
+
+
+def test_peer_directory_tracks_membership(two_nodes):
+    store = KVStore()
+    relay = Relay()
+    directory = PeerDirectory(store, relay).start()
+    try:
+        store.set(PeerDirectory.PREFIX + "node-a",
+                  json.dumps({"socket": str(two_nodes / "a.sock")}))
+        assert relay.peers() == ["node-a"]
+        assert len(relay.get_flows()) == 2
+        store.set(PeerDirectory.PREFIX + "node-b",
+                  json.dumps({"socket": str(two_nodes / "b.sock")}))
+        assert len(relay.get_flows()) == 4
+        store.delete(PeerDirectory.PREFIX + "node-b")
+        assert relay.peers() == ["node-a"]
+    finally:
+        directory.stop()
+
+
+def test_relay_rejects_follow_and_resume(two_nodes):
+    """Regression: per-request merge seqs are unstable, so follow or
+    since_seq against the relay would busy-loop duplicates; the server
+    must answer with an error line instead."""
+    relay = Relay()
+    relay.add_remote_peer("node-a", str(two_nodes / "a.sock"))
+    server = HubbleServer(RelayObserver(relay),
+                          str(two_nodes / "relay.sock"), relay=relay).start()
+    try:
+        client = HubbleClient(str(two_nodes / "relay.sock"))
+        with pytest.raises(RuntimeError):
+            list(client.get_flows(follow=True, timeout=0.2))
+        with pytest.raises(RuntimeError):
+            list(client.get_flows(since_seq=3))
+    finally:
+        server.stop()
+
+
+def test_limit_pushes_down_to_peers(two_nodes):
+    """Regression: limit=N must not transfer each peer's whole ring."""
+    relay = Relay()
+    relay.add_remote_peer("node-a", str(two_nodes / "a.sock"))
+    relay.add_remote_peer("node-b", str(two_nodes / "b.sock"))
+    merged = relay.get_flows(limit=2)
+    assert [(n, f.time) for n, f in merged] == [
+        ("node-a", 3.0), ("node-b", 4.0)]  # global newest-2
+
+
+def test_hubble_peer_readvertises_after_lapse(tmp_path):
+    """Regression: with the in-process store, keepalive never raises —
+    the heartbeat must detect the vanished key and re-advertise."""
+    from cilium_tpu.agent import Agent
+    from cilium_tpu.core.config import Config
+
+    store = KVStore()
+    cfg = Config()
+    cfg.node_name = "lapse"
+    cfg.configure_logging = False
+    agent = Agent(cfg, kvstore=store,
+                  hubble_socket_path=str(tmp_path / "h.sock")).start()
+    try:
+        key = PeerDirectory.PREFIX + "lapse"
+        assert store.get(key) is not None
+        # simulate a >TTL stall: force-expire the advertisement lease
+        agent._hubble_peer_lease.deadline = 0.0
+        store.expire_leases()
+        assert store.get(key) is None
+        agent._hubble_peer_heartbeat()
+        assert store.get(key) is not None  # re-advertised
+    finally:
+        agent.stop()
+
+
+def test_agents_publish_peers_and_relay_sees_their_flows(tmp_path):
+    """End to end: two agents advertise their observers through the
+    kvstore; a relay discovers both and serves one merged stream."""
+    from cilium_tpu.agent import Agent
+    from cilium_tpu.core.config import Config
+
+    store = KVStore()
+
+    def make_agent(name):
+        cfg = Config()
+        cfg.node_name = name
+        cfg.configure_logging = False
+        return Agent(cfg, kvstore=store,
+                     hubble_socket_path=str(tmp_path / f"{name}.sock")
+                     ).start()
+
+    agent_a = make_agent("na")
+    agent_b = make_agent("nb")
+    relay = Relay()
+    directory = PeerDirectory(store, relay).start()
+    try:
+        assert sorted(relay.peers()) == ["na", "nb"]
+        for agent, ident in ((agent_a, 100), (agent_b, 200)):
+            agent.endpoint_add(1, {"app": f"x{ident}"})
+            agent.observer.observe([_flow(float(ident), src=ident)])
+        merged = relay.get_flows()
+        assert {f.src_identity for _, f in merged} >= {100, 200}
+        # clean departure drops the peer
+        agent_b.stop()
+        assert relay.peers() == ["na"]
+    finally:
+        directory.stop()
+        agent_a.stop()
