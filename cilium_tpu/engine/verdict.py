@@ -541,13 +541,57 @@ def encode_flows(
     )
 
 
+#: Column order of the packed int32 "scalars" array. Packing the 21
+#: per-flow scalar/flag columns into ONE device argument (plus the five
+#: byte buckets and gen_pairs: 7 arrays total instead of 27) cuts
+#: per-dispatch overhead measurably on tunneled TPU transports, where
+#: argument count — not bytes — dominates small-batch dispatch latency.
+_SCALAR_COLS = (
+    "ep_ids", "peer_ids", "dports", "protos", "directions", "l7_types",
+    "kafka_api_key", "kafka_api_version", "kafka_client", "kafka_topic",
+    "gen_proto",
+    "path_len", "path_valid", "method_len", "method_valid",
+    "host_len", "host_valid", "headers_len", "headers_valid",
+    "qname_len", "qname_valid",
+)
+
+
+def pack_batch(d: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """27-key flat layout → 7-array packed layout (host side)."""
+    scalars = np.stack(
+        [d[c].astype(np.int32) for c in _SCALAR_COLS], axis=1)
+    out = {"scalars": np.ascontiguousarray(scalars)}
+    for name in ("path", "method", "host", "headers", "qname"):
+        out[f"{name}_data"] = d[f"{name}_data"]
+    out["gen_pairs"] = d["gen_pairs"]
+    return out
+
+
+def unpack_batch(packed: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    """Packed layout → flat names (inside jit: slices fuse for free).
+    ``*_valid`` columns come back as bool."""
+    scalars = packed["scalars"]
+    out = {}
+    for i, col in enumerate(_SCALAR_COLS):
+        v = scalars[:, i]
+        out[col] = (v != 0) if col.endswith("_valid") else v
+    for name in ("path", "method", "host", "headers", "qname"):
+        out[f"{name}_data"] = packed[f"{name}_data"]
+    out["gen_pairs"] = packed["gen_pairs"]
+    return out
+
+
 def verdict_step(arrays: Dict[str, jax.Array], batch: Dict[str, jax.Array]
                  ) -> Dict[str, jax.Array]:
     """The pure device function: full verdict for one batch.
 
     ``arrays`` = CompiledPolicy.arrays staged on device;
-    ``batch`` = FlowBatch fields as device arrays.
+    ``batch`` = FlowBatch fields as device arrays, either packed
+    (:func:`pack_batch`) or flat — the dict-key check is static under
+    jit, so both layouts trace cleanly.
     """
+    if "scalars" in batch:
+        batch = unpack_batch(batch)
     ms = mapstate_lookup(
         arrays["ms_key_w0"], arrays["ms_key_w1"], arrays["ms_key_w2"],
         arrays["ms_deny"], arrays["ms_ruleset"],
@@ -694,10 +738,12 @@ class VerdictEngine:
 
 
 def flowbatch_to_host_dict(fb: FlowBatch) -> Dict[str, np.ndarray]:
-    """FlowBatch → flat dict of HOST numpy arrays (same keys as
-    :func:`flowbatch_to_device`). Benchmarks build per-iteration device
-    copies from this — staging from host avoids the device→host
-    round-trip that degrades the axon platform (docs/PLATFORM.md)."""
+    """FlowBatch → packed dict of HOST numpy arrays (same keys as
+    :func:`flowbatch_to_device`): one int32 "scalars" block plus the
+    five byte buckets and gen_pairs (see :func:`pack_batch` for why).
+    Benchmarks build per-iteration device copies from this — staging
+    from host avoids the device→host round-trip that degrades the axon
+    platform (docs/PLATFORM.md)."""
     d: Dict[str, np.ndarray] = {
         "ep_ids": fb.ep_ids, "peer_ids": fb.peer_ids,
         "dports": fb.dports, "protos": fb.protos,
@@ -714,7 +760,7 @@ def flowbatch_to_host_dict(fb: FlowBatch) -> Dict[str, np.ndarray]:
         d[f"{name}_data"] = data
         d[f"{name}_len"] = lengths
         d[f"{name}_valid"] = valid
-    return d
+    return pack_batch(d)
 
 
 def flowbatch_to_device(fb: FlowBatch, device=None) -> Dict[str, jax.Array]:

@@ -174,8 +174,15 @@ class NodeRegistration:
                 # "worker-10"'s assignments
                 if ev.key != cidr_key:
                     return
-                new = (None if ev.typ == EVENT_DELETE
-                       else json.loads(ev.value).get("cidr"))
+                if ev.typ == EVENT_DELETE:
+                    new = None
+                else:
+                    try:
+                        new = json.loads(ev.value).get("cidr")
+                    except (ValueError, AttributeError):
+                        return  # corrupt write: the operator will
+                        # quarantine it; crashing the store's dispatch
+                        # here would starve every later watcher
                 old, self._last_cidr = self._last_cidr, new
                 if old != new:
                     on_cidr_change(old, new)
@@ -219,7 +226,15 @@ class NodeRegistration:
 
     def pod_cidr(self) -> Optional[str]:
         raw = self.store.get(CIDRS_PREFIX + self.node_name)
-        return json.loads(raw)["cidr"] if raw else None
+        if not raw:
+            return None
+        try:
+            return json.loads(raw)["cidr"]
+        except (ValueError, KeyError, TypeError):
+            # transiently corrupt assignment (operator quarantines it on
+            # its next reconcile): report "not assigned yet" so
+            # wait_for_cidr keeps polling instead of aborting start()
+            return None
 
     def wait_for_cidr(self, timeout: float = 5.0,
                       interval: float = 0.05) -> str:

@@ -43,6 +43,18 @@ def shard_policy_arrays(
             ep_size = mesh.shape[expert_axis]
             if n_banks % ep_size == 0:
                 spec = P(expert_axis)
+            else:
+                # replication fallback must be VISIBLE: every device
+                # scanning every bank is a silent perf cliff otherwise.
+                # Shrink engine.bank_size so the bank count divides the
+                # expert axis.
+                import warnings
+
+                warnings.warn(
+                    f"EP: {k} has {n_banks} bank(s), not divisible by "
+                    f"expert axis size {ep_size}; replicating instead "
+                    "of sharding (reduce engine.bank_size to restore "
+                    "EP)", RuntimeWarning, stacklevel=2)
         out[k] = jax.device_put(v, NamedSharding(mesh, spec))
     return out
 
