@@ -102,7 +102,6 @@ def cmd_replay(args) -> int:
     from cilium_tpu.core.config import Config
     from cilium_tpu.core.flow import Verdict
     from cilium_tpu.hubble import FlowMetrics, Observer, annotate_flows
-    from cilium_tpu.ingest.hubble import read_jsonl
     from cilium_tpu.policy.api import load_cnp_yaml
 
     cfg = Config.from_env()
@@ -121,18 +120,31 @@ def cmd_replay(args) -> int:
         print("no engine (no endpoints?)", file=sys.stderr)
         return 1
     observer = Observer(handlers=[FlowMetrics()])
-    flows = list(read_jsonl(args.capture, start=args.start,
-                            limit=args.limit))
-    out = engine.verdict_flows(flows)
-    if "match_spec" not in out:
-        out = {"verdict": np.asarray(out["verdict"])}
-    annotate_flows(flows, out)
-    observer.observe(flows)
-    counts = {}
-    for f in flows:
-        counts[Verdict(f.verdict).name] = counts.get(
-            Verdict(f.verdict).name, 0) + 1
-    print(json.dumps({"flows": len(flows), "verdicts": counts}))
+    from cilium_tpu.ingest.cursor import ReplayCursor, replay_chunks
+
+    cursor = (ReplayCursor(args.cursor, args.capture)
+              if args.cursor else None)
+    counts: dict = {}
+    total = 0
+    for commit_index, flows in replay_chunks(
+            args.capture, cursor=cursor, start=args.start,
+            limit=args.limit):
+        out = engine.verdict_flows(flows)
+        if "match_spec" not in out:
+            out = {"verdict": np.asarray(out["verdict"])}
+        annotate_flows(flows, out)
+        observer.observe(flows)
+        for f in flows:
+            counts[Verdict(f.verdict).name] = counts.get(
+                Verdict(f.verdict).name, 0) + 1
+        total += len(flows)
+        if cursor is not None:  # commit AFTER processing (§5.4): a
+            cursor.commit(commit_index)  # kill re-runs ≤1 chunk
+    if cursor is not None and (args.limit is None or total < args.limit):
+        # ran to EOF: a finished replay must not pin the cursor there —
+        # re-running the same command should replay, not print 0 flows
+        cursor.clear()
+    print(json.dumps({"flows": total, "verdicts": counts}))
     return 0
 
 
@@ -366,6 +378,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="endpoint labels k=v[,k=v...] (repeatable)")
     p.add_argument("--start", type=int, default=0)
     p.add_argument("--limit", type=int, default=None)
+    p.add_argument("--cursor",
+                   help="cursor file: resume a killed replay from the "
+                        "last committed chunk (kill/resume, §5.4)")
     p.add_argument("--tpu", action="store_true",
                    help="enable the TPU engine (default: oracle)")
     p.set_defaults(fn=cmd_replay)

@@ -1,0 +1,130 @@
+"""Replay cursor checkpointing: kill/resume mid-stream (SURVEY §5.4)."""
+
+import json
+
+from cilium_tpu import cli
+from cilium_tpu.core.flow import Flow
+from cilium_tpu.ingest.cursor import ReplayCursor, replay_chunks
+from cilium_tpu.ingest.hubble import flow_to_dict
+
+
+def write_capture(path, n):
+    with open(path, "w") as f:
+        for i in range(n):
+            f.write(json.dumps(flow_to_dict(
+                Flow(time=float(i), src_identity=100 + i,
+                     dst_identity=2, dport=80))) + "\n")
+
+
+def test_chunks_resume_from_cursor(tmp_path):
+    cap = str(tmp_path / "cap.jsonl")
+    write_capture(cap, 10)
+    cursor = ReplayCursor(str(tmp_path / "cursor.json"), cap)
+
+    seen = []
+    for commit_index, flows in replay_chunks(cap, chunk_size=3,
+                                             cursor=cursor):
+        seen.extend(f.src_identity for f in flows)
+        cursor.commit(commit_index)
+        if len(seen) >= 6:
+            break  # "kill" mid-stream after two committed chunks
+
+    assert seen == [100 + i for i in range(6)]
+    # resume: continues at flow 6, no replays, no skips
+    resumed = []
+    for commit_index, flows in replay_chunks(cap, chunk_size=3,
+                                             cursor=cursor):
+        resumed.extend(f.src_identity for f in flows)
+        cursor.commit(commit_index)
+    assert resumed == [100 + i for i in range(6, 10)]
+
+
+def test_kill_before_commit_replays_one_chunk(tmp_path):
+    """commit-after-process: a kill between processing and commit
+    re-runs that chunk — flows are never skipped."""
+    cap = str(tmp_path / "cap.jsonl")
+    write_capture(cap, 6)
+    cursor = ReplayCursor(str(tmp_path / "cursor.json"), cap)
+    gen = replay_chunks(cap, chunk_size=3, cursor=cursor)
+    next(gen)
+    # killed HERE: processed but not committed
+    del gen
+    replayed = []
+    for commit_index, flows in replay_chunks(cap, chunk_size=3,
+                                             cursor=cursor):
+        replayed.extend(f.src_identity for f in flows)
+        cursor.commit(commit_index)
+    assert replayed == [100 + i for i in range(6)]  # chunk 0 re-run
+
+
+def test_blank_lines_neither_duplicate_nor_truncate(tmp_path):
+    """Regression: the cursor is line-indexed — a capture with blank
+    lines (concatenated/hand-edited JSONL) must deliver every flow
+    exactly once across chunk boundaries and resumes."""
+    cap = str(tmp_path / "gaps.jsonl")
+    lines = []
+    for i in range(8):
+        lines.append(json.dumps(flow_to_dict(
+            Flow(time=float(i), src_identity=100 + i, dst_identity=2,
+                 dport=80))))
+        if i in (1, 2, 5):
+            lines.append("")  # blank line after flows 1, 2, 5
+    with open(cap, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+    cursor = ReplayCursor(str(tmp_path / "cursor.json"), cap)
+    seen = []
+    for commit_index, flows in replay_chunks(cap, chunk_size=3,
+                                             cursor=cursor):
+        seen.extend(f.src_identity for f in flows)
+        cursor.commit(commit_index)
+        if len(seen) >= 3:
+            break  # kill after the first committed chunk
+    for commit_index, flows in replay_chunks(cap, chunk_size=3,
+                                             cursor=cursor):
+        seen.extend(f.src_identity for f in flows)
+        cursor.commit(commit_index)
+    assert seen == [100 + i for i in range(8)]  # exactly once, in order
+
+
+def test_cursor_ignores_other_captures_and_corruption(tmp_path):
+    cap_a = str(tmp_path / "a.jsonl")
+    cap_b = str(tmp_path / "b.jsonl")
+    write_capture(cap_a, 4)
+    write_capture(cap_b, 4)
+    cursor_path = str(tmp_path / "cursor.json")
+    ReplayCursor(cursor_path, cap_a).commit(3)
+    # same file, different capture → start over, don't skip b's flows
+    assert ReplayCursor(cursor_path, cap_b).load() == 0
+    assert ReplayCursor(cursor_path, cap_a).load() == 3
+    with open(cursor_path, "w") as f:
+        f.write("{torn write")
+    assert ReplayCursor(cursor_path, cap_a).load() == 0
+
+
+def test_cli_replay_with_cursor_resumes(tmp_path, capsys):
+    cap = str(tmp_path / "cap.jsonl")
+    write_capture(cap, 8)
+    cursor = str(tmp_path / "cursor.json")
+    cnp = tmp_path / "p.yaml"
+    cnp.write_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: t}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - toPorts: [{ports: [{port: "80", protocol: TCP}]}]
+""")
+    argv = ["replay", cap, "--policy", str(cnp), "--endpoint", "app=svc",
+            "--cursor", cursor]
+    assert cli.main(argv + ["--limit", "5"]) == 0
+    first = json.loads(capsys.readouterr().out)
+    assert first["flows"] == 5
+    assert cli.main(argv) == 0  # resumes at 5, runs to EOF
+    second = json.loads(capsys.readouterr().out)
+    assert second["flows"] == 3
+    # completed replay clears the cursor: a re-run replays from 0
+    assert cli.main(argv) == 0
+    third = json.loads(capsys.readouterr().out)
+    assert third["flows"] == 8
