@@ -187,9 +187,11 @@ def main() -> int:
         # can shortcut repeat executions. Built from HOST numpy: a device
         # round trip here would poison the process (docs/PLATFORM.md).
         prng = np.random.default_rng(0)
-        # compile + warmup + latency iters + throughput iters, ALL
-        # distinct permuted copies so every timed call is first-use
-        n_copies = args.warmup + 2 * args.iters + 1
+        # compile + warmup + latency iters; throughput windows stage
+        # their own copies one window at a time (below) so HBM holds at
+        # most iters extra copies, not 3*iters. ALL copies are distinct
+        # permutations so every timed call is first-use.
+        n_copies = args.warmup + args.iters + 1
         batches = []
         for _ in range(n_copies):
             perm = prng.permutation(fb.size)
@@ -217,15 +219,27 @@ def main() -> int:
             med = times[len(times) // 2]
             n = len(scenario.flows)
             # throughput pass: dispatch every timed batch (distinct
-            # permuted first-use buffers, pre-staged in HBM) and sync
-            # ONCE — compute overlaps dispatch, as a real replay
-            # pipeline runs
-            base = 1 + args.warmup + args.iters
-            t0 = time.perf_counter()
-            outs = [step(arrays, batches[base + i])
-                    for i in range(args.iters)]
-            jax.block_until_ready(outs)
-            t_all = time.perf_counter() - t0
+            # permuted first-use buffers, staged per window, untimed)
+            # and sync ONCE per window — compute overlaps dispatch, as
+            # a real replay pipeline runs. Median of 5 windows: the
+            # tunneled transport's run-to-run jitter is ±30% on
+            # identical binaries, so a single window reports tunnel
+            # luck; the median is the defensible sustained figure (the
+            # streaming configs are single-window by construction —
+            # one first-use pass over the whole tuple set).
+            window_times = []
+            for _ in range(5):
+                wb = []
+                for _ in range(args.iters):
+                    perm = prng.permutation(fb.size)
+                    wb.append({k: jax.device_put(v[perm])
+                               for k, v in host.items()})
+                jax.block_until_ready(wb)
+                t0 = time.perf_counter()
+                outs = [step(arrays, b) for b in wb]
+                jax.block_until_ready(outs)
+                window_times.append(time.perf_counter() - t0)
+            t_all = sorted(window_times)[len(window_times) // 2]
         out = outs[-1]
         vps = n * args.iters / t_all
         log(f"batch={n} latency: median={med*1e3:.2f}ms "

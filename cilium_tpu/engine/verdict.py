@@ -557,7 +557,11 @@ _SCALAR_COLS = (
 
 
 def pack_batch(d: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-    """27-key flat layout → 7-array packed layout (host side)."""
+    """27-key flat layout → 7-array packed layout (host side). The five
+    byte buckets stay separate: concatenating them into one blob was
+    tried and benched SLOWER (the in-kernel slices deny the DFA scans a
+    clean [B, L] layout and the host-side concat taxes every batch
+    copy) — argument-count savings beyond the scalar block don't pay."""
     scalars = np.stack(
         [d[c].astype(np.int32) for c in _SCALAR_COLS], axis=1)
     out = {"scalars": np.ascontiguousarray(scalars)}
