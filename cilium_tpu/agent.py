@@ -90,8 +90,12 @@ class Agent:
         # observability (§2.5): monitor event fan-out + hubble observer
         self.monitor = MonitorAgent()
         self.observer = Observer(handlers=[FlowMetrics()])
-        # health probe mesh (§5.3); peers registered via health.add_node
-        self.health = HealthChecker(node_name=self.config.cluster_name)
+        # health probe mesh (§5.3); peers register via health.add_node
+        # or kvstore discovery (HealthPeerWatcher at start())
+        self.health = HealthChecker(node_name=self.config.node_name)
+        self._hubble_ad = None
+        self._health_ad = None
+        self._health_watcher = None
         # IPAM (§2.4): endpoint IPs come from this node's podCIDR when
         # the caller doesn't pin one. In "cluster-pool" mode the CIDR
         # arrives from the operator at start(); until then the static
@@ -188,9 +192,23 @@ class Agent:
                                           agent=self)
             self.service.start()
         if self.api_socket_path:
+            import json as _json
+
+            from cilium_tpu.health import PEERS_PREFIX, HealthPeerWatcher
+            from cilium_tpu.runtime.advertise import Advertisement
             from cilium_tpu.runtime.api import APIServer
 
             self.api_server = APIServer(self, self.api_socket_path).start()
+            # advertise the health endpoint and probe every other
+            # advertised node (pkg/health's full probe mesh, §5.3)
+            self._health_ad = Advertisement(
+                self.kvstore, PEERS_PREFIX + self.config.node_name,
+                _json.dumps({"socket": self.api_socket_path}))
+            self.controllers.update("health-peer-heartbeat",
+                                    self._health_ad.heartbeat,
+                                    interval=15.0)
+            self._health_watcher = HealthPeerWatcher(
+                self.kvstore, self.health).start()
         if self.policy_dir:
             from cilium_tpu.runtime.watcher import PolicyDirWatcher
 
@@ -204,9 +222,17 @@ class Agent:
             # advertise this node's observer for relay discovery (the
             # Hubble Peer service analog), lease-backed so a dead
             # agent's entry ages out of the relay's peer set
-            self._publish_hubble_peer()
+            import json as _json
+
+            from cilium_tpu.hubble.relay import PeerDirectory
+            from cilium_tpu.runtime.advertise import Advertisement
+
+            self._hubble_ad = Advertisement(
+                self.kvstore,
+                PeerDirectory.PREFIX + self.config.node_name,
+                _json.dumps({"socket": self.hubble_socket_path}))
             self.controllers.update("hubble-peer-heartbeat",
-                                    self._hubble_peer_heartbeat,
+                                    self._hubble_ad.heartbeat,
                                     interval=15.0)
         if self.dns_proxy_bind is not None:
             from cilium_tpu.fqdn.server import DNSProxyServer
@@ -246,14 +272,12 @@ class Agent:
             self.node_registration.close()
         if hasattr(self.allocator, "close"):
             self.allocator.close()
+        if self._health_watcher is not None:
+            self._health_watcher.stop()
+        for ad in (self._hubble_ad, self._health_ad):
+            if ad is not None:  # clean departure: peers drop us now
+                ad.withdraw()  # instead of waiting out the lease
         if self.hubble_server is not None:
-            from cilium_tpu.hubble.relay import PeerDirectory
-
-            try:  # clean departure: drop out of relays immediately
-                self.kvstore.delete(
-                    PeerDirectory.PREFIX + self.config.node_name)
-            except Exception:
-                pass  # kvstore gone first; the lease ages the entry out
             self.hubble_server.stop()
         if self.dns_server is not None:
             self.dns_server.stop()
@@ -268,38 +292,6 @@ class Agent:
 
     def _dns_gc(self) -> None:
         self.name_manager.gc()
-
-    def _publish_hubble_peer(self) -> None:
-        import json as _json
-
-        from cilium_tpu.hubble.relay import PeerDirectory
-
-        self._hubble_peer_lease = self.kvstore.lease(60.0)
-        self.kvstore.set(
-            PeerDirectory.PREFIX + self.config.node_name,
-            _json.dumps({"socket": self.hubble_socket_path}),
-            lease=self._hubble_peer_lease)
-
-    def _hubble_peer_heartbeat(self) -> None:
-        from cilium_tpu.hubble.relay import PeerDirectory
-
-        key = PeerDirectory.PREFIX + self.config.node_name
-        # key presence is the authoritative liveness check: the local
-        # KVStore's keepalive never raises on a lapsed lease (only the
-        # remote one mirrors etcd's ErrLeaseNotFound), so relying on
-        # the exception alone would lose the advertisement forever
-        # after a >TTL stall
-        if (self._hubble_peer_lease.expired()
-                or self.kvstore.get(key) is None):
-            self._publish_hubble_peer()
-            return
-        try:
-            self._hubble_peer_lease.keepalive()
-        except KeyError:
-            self._publish_hubble_peer()
-            return
-        if self.kvstore.get(key) is None:  # lapsed in the window
-            self._publish_hubble_peer()
 
     def _on_cluster_identity(self, nid: int, labels) -> None:
         """A (possibly remote) cluster identity appeared or vanished in

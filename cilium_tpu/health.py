@@ -14,11 +14,16 @@ reported unreachable until a probe succeeds again.
 from __future__ import annotations
 
 import dataclasses
+import json
 import threading
 import time
 from typing import Callable, Dict, List, Optional
 
 from cilium_tpu.runtime.metrics import METRICS
+
+#: kvstore prefix where agents advertise their health endpoint (the
+#: per-node ``cilium-health`` listener analog): value = {"socket": api}
+PEERS_PREFIX = "cilium/health/peers/"
 
 
 @dataclasses.dataclass
@@ -103,3 +108,57 @@ class HealthChecker:
         with self._lock:
             return sorted(n for n, s in self._status.items()
                           if not s.reachable)
+
+
+def socket_probe(api_socket_path: str,
+                 timeout: float = 3.0) -> Callable[[], None]:
+    """TCP-probe analog: GET the peer agent's ``/v1/healthz`` over its
+    API socket; any connect/HTTP/decode failure raises = probe failed.
+    Short timeout: probe_all is sequential, so one wedged peer must not
+    stall the whole round (the reference probe is similarly bounded)."""
+
+    def probe() -> None:
+        from cilium_tpu.runtime.api import APIClient
+
+        resp = APIClient(api_socket_path, timeout=timeout).healthz()
+        if not isinstance(resp, dict) or resp.get("status") != "ok":
+            raise RuntimeError(f"unhealthy response: {resp!r}")
+
+    return probe
+
+
+class HealthPeerWatcher:
+    """Discover the probe mesh from kvstore advertisements: every node
+    publishing under ``cilium/health/peers/`` becomes a probed peer
+    (except ourselves), and departures — clean or lease-expired —
+    remove the peer. This is how each agent ends up probing every
+    other node, the reference's full-mesh discipline."""
+
+    def __init__(self, store, checker: HealthChecker):
+        self.store = store
+        self.checker = checker
+        self._watch = None
+
+    def start(self) -> "HealthPeerWatcher":
+        from cilium_tpu.kvstore import EVENT_DELETE
+
+        def on_event(ev) -> None:
+            name = ev.key[len(PEERS_PREFIX):]
+            if name == self.checker.node_name:
+                return  # don't probe ourselves
+            if ev.typ == EVENT_DELETE:
+                self.checker.remove_node(name)
+                return
+            try:
+                sock = json.loads(ev.value)["socket"]
+            except (ValueError, KeyError, TypeError):
+                return
+            self.checker.add_node(name, socket_probe(sock))
+
+        self._watch = self.store.watch_prefix(PEERS_PREFIX, on_event)
+        return self
+
+    def stop(self) -> None:
+        if self._watch is not None:
+            self._watch.stop()
+            self._watch = None

@@ -306,12 +306,13 @@ class _UnixHTTPConnection(http.client.HTTPConnection):
 class APIClient:
     """``pkg/client`` analog: typed access to the agent REST API."""
 
-    def __init__(self, socket_path: str):
+    def __init__(self, socket_path: str, timeout: float = 30.0):
         self.socket_path = socket_path
+        self.timeout = timeout
 
     def request(self, method: str, path: str, body=None,
                 content_type: str = "application/json"):
-        conn = _UnixHTTPConnection(self.socket_path)
+        conn = _UnixHTTPConnection(self.socket_path, timeout=self.timeout)
         try:
             data = None
             if body is not None:

@@ -1,0 +1,91 @@
+"""Cross-process health probe mesh: kvstore discovery + socket probes.
+
+Reference: ``pkg/health`` full mesh (SURVEY.md §2.5/§5.3) — every node
+probes every other node's health endpoint and reports reachability.
+"""
+
+import time
+
+from cilium_tpu.agent import Agent
+from cilium_tpu.core.config import Config
+from cilium_tpu.health import (
+    PEERS_PREFIX,
+    HealthChecker,
+    HealthPeerWatcher,
+    socket_probe,
+)
+from cilium_tpu.kvstore import KVStore
+from cilium_tpu.runtime.advertise import Advertisement
+
+
+def make_agent(store, name, tmp_path):
+    cfg = Config()
+    cfg.node_name = name
+    cfg.configure_logging = False
+    return Agent(cfg, kvstore=store,
+                 api_socket_path=str(tmp_path / f"{name}-api.sock")).start()
+
+
+def test_agents_probe_each_other(tmp_path):
+    store = KVStore()
+    a = make_agent(store, "na", tmp_path)
+    b = make_agent(store, "nb", tmp_path)
+    try:
+        # discovery: each sees exactly the other (never itself)
+        assert set(a.health.status()) == {"nb"}
+        assert set(b.health.status()) == {"na"}
+        a.health.probe_all()
+        st = a.health.status()["nb"]
+        assert st.reachable and st.last_latency_s > 0
+    finally:
+        b.stop()
+        # clean departure: nb withdrew its advertisement
+        assert set(a.health.status()) == set()
+        a.stop()
+
+
+def test_dead_peer_becomes_unreachable(tmp_path):
+    store = KVStore()
+    a = make_agent(store, "na", tmp_path)
+    checker = HealthChecker(node_name="observer", failure_threshold=2)
+    watcher = HealthPeerWatcher(store, checker).start()
+    try:
+        assert set(checker.status()) == {"na"}
+        checker.probe_all()
+        assert checker.status()["na"].reachable
+        # kill the agent's API server without a clean withdraw: the
+        # probe must start failing and cross the threshold
+        a.api_server.stop()
+        checker.probe_all()
+        checker.probe_all()
+        assert checker.status()["na"].reachable is False
+        assert checker.unreachable() == ["na"]
+    finally:
+        watcher.stop()
+        a.stop()
+
+
+def test_lease_lapse_ages_peer_out(tmp_path):
+    store = KVStore()
+    checker = HealthChecker(node_name="observer")
+    watcher = HealthPeerWatcher(store, checker).start()
+    try:
+        ad = Advertisement(store, PEERS_PREFIX + "ghost",
+                           '{"socket": "/nonexistent"}', ttl=0.05)
+        assert set(checker.status()) == {"ghost"}
+        time.sleep(0.1)
+        store.expire_leases()
+        assert set(checker.status()) == set()
+        # heartbeat after the lapse re-publishes (Advertisement is
+        # authoritative on key presence, not the dead lease)
+        ad.heartbeat()
+        assert set(checker.status()) == {"ghost"}
+    finally:
+        watcher.stop()
+
+
+def test_socket_probe_raises_on_dead_socket(tmp_path):
+    import pytest
+
+    with pytest.raises(Exception):
+        socket_probe(str(tmp_path / "nope.sock"))()

@@ -138,10 +138,10 @@ def test_hubble_peer_readvertises_after_lapse(tmp_path):
         key = PeerDirectory.PREFIX + "lapse"
         assert store.get(key) is not None
         # simulate a >TTL stall: force-expire the advertisement lease
-        agent._hubble_peer_lease.deadline = 0.0
+        agent._hubble_ad._lease.deadline = 0.0
         store.expire_leases()
         assert store.get(key) is None
-        agent._hubble_peer_heartbeat()
+        agent._hubble_ad.heartbeat()
         assert store.get(key) is not None  # re-advertised
     finally:
         agent.stop()
