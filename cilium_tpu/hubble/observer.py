@@ -9,7 +9,9 @@ outputs back onto the Flow objects.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
+import uuid
 from typing import Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
@@ -75,12 +77,20 @@ class Observer:
         self.handlers = list(handlers)
         self.seen = 0
         self.lost_reported = 0
+        #: per-construction token: a consumer resuming by seq can tell
+        #: "same observer, later" from "restarted observer, seqs reset"
+        self.instance = uuid.uuid4().hex
+        # observe() used to be single-writer (the agent pipeline); relay
+        # followers made it multi-writer, so the counter += and handler
+        # fan-out serialize here (the ring has its own lock)
+        self._observe_lock = threading.Lock()
 
     def observe(self, flows: Sequence[Flow]) -> None:
-        self.ring.write_many(flows)
-        self.seen += len(flows)
-        for h in self.handlers:
-            h.process(flows)
+        with self._observe_lock:
+            self.ring.write_many(flows)
+            self.seen += len(flows)
+            for h in self.handlers:
+                h.process(flows)
 
     def get_flows(self, flt: Optional[FlowFilter] = None,
                   since_seq: Optional[int] = None,

@@ -170,6 +170,141 @@ class RelayObserver:
             yield (seq, flow) if with_seq else flow
 
 
+class FollowingRelay:
+    """Live relay: follow every peer's stream into a local ring and
+    serve THAT — the reference relay's actual shape (it holds open
+    GetFlows(follow) streams to each node and re-serves the merged
+    stream), so follow/resume work natively on the relay socket,
+    unlike the snapshot-only :class:`RelayObserver`.
+
+    Each peer gets a follower thread running the hubble client's
+    resumable follow loop; flows land in ``self.observer`` (a normal
+    ring Observer) stamped with the peer's node name. Interleaving
+    across peers is arrival-order (the reference relay's follow mode
+    is likewise best-effort ordered)."""
+
+    def __init__(self, ring_capacity: int = 8192):
+        self.observer = Observer(capacity=ring_capacity)
+        self._lock = threading.Lock()
+        self._followers: Dict[str, "_PeerFollower"] = {}
+
+    def add_remote_peer(self, name: str, socket_path: str) -> None:
+        if not socket_path:
+            raise ValueError(f"peer {name!r}: empty socket path")
+        with self._lock:
+            old = self._followers.get(name)
+            # idempotent: a kvstore re-advertisement (lease-lapse
+            # republish) for a live follower must NOT replace it — a
+            # fresh client restarts at since_seq=None and would replay
+            # the peer's whole ring into ours as duplicates
+            if (old is not None and old.socket_path == socket_path
+                    and old.alive()):
+                return
+            f = _PeerFollower(name, socket_path, self.observer)
+            f.start()  # started before it becomes visible: a racing
+            self._followers[name] = f  # remove/stop never joins an
+        if old is not None:            # unstarted thread
+            old.stop()
+
+    def remove_peer(self, name: str) -> None:
+        with self._lock:
+            f = self._followers.pop(name, None)
+        if f is not None:
+            f.stop()
+
+    def peers(self) -> List[str]:
+        with self._lock:
+            return sorted(self._followers)
+
+    def status(self) -> Dict[str, Dict]:
+        with self._lock:
+            return {name: {"available": f.connected,
+                           "flows": f.delivered}
+                    for name, f in self._followers.items()}
+
+    def stop(self) -> None:
+        with self._lock:
+            followers = list(self._followers.values())
+            self._followers.clear()
+        for f in followers:
+            f.stop()
+
+
+class _PeerFollower:
+    """One peer's follow stream → the relay's local ring."""
+
+    def __init__(self, name: str, socket_path: str, observer: Observer):
+        self.name = name
+        self.socket_path = socket_path
+        self.observer = observer
+        self.connected = False
+        self.delivered = 0
+        self._stop = threading.Event()
+        from cilium_tpu.hubble.server import HubbleClient
+
+        self._client = HubbleClient(socket_path)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"relay-follow-{name}")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._client.close()  # cancel the in-flight follow window
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        from cilium_tpu.ingest.hubble import flow_from_dict
+
+        client = self._client
+        backoff = 0.1
+        instance = None
+        while not self._stop.is_set():
+            try:
+                # Each window opens with a status probe: it flips
+                # `connected` as soon as the peer answers (a quiet node
+                # is not an unavailable node), and its observer
+                # instance token detects restarts — a restarted node's
+                # ring seqs start over, so resuming at our stale cursor
+                # would silently skip (or wait out) its new flows
+                # regardless of how the seq numbers happen to compare.
+                st = client.server_status()
+                self.connected = True
+                if st.get("instance") != instance:
+                    if instance is not None:
+                        client.last_seq = None  # peer restarted
+                    instance = st.get("instance")
+                # long window (idle peers don't get redialed twice a
+                # second); stop() cancels it via client.close()
+                for d in client.get_flows(
+                        follow=True, timeout=60.0,
+                        since_seq=(client.last_seq + 1
+                                   if client.last_seq is not None
+                                   else None)):
+                    backoff = 0.1
+                    flow = flow_from_dict(d)
+                    flow.node_name = flow.node_name or self.name
+                    self.observer.observe([flow])
+                    self.delivered += 1
+                    if self._stop.is_set():
+                        return
+                backoff = 0.1
+            except Exception:
+                # ANY failure (connect, torn frame, malformed flow
+                # dict) must degrade to reconnect-with-backoff — a
+                # dead follower that still reports available would be
+                # a silent hole in the merged stream
+                self.connected = False
+                if self._stop.wait(backoff):
+                    return
+                backoff = min(5.0, backoff * 2)
+
+
 class PeerDirectory:
     """kvstore-backed peer discovery (the Hubble Peer service analog):
     agents publish ``cilium/hubble/peers/<node> → {"socket": path}``
@@ -225,10 +360,16 @@ def main(argv=None) -> int:  # pragma: no cover - thin wrapper
     ap.add_argument("--kvstore", help="kvstore socket for peer discovery")
     ap.add_argument("--peer", action="append", default=[],
                     metavar="NAME=SOCKET", help="static peer (repeatable)")
+    ap.add_argument("--mode", choices=["live", "snapshot"],
+                    default="live",
+                    help="live (default): follow every peer into a "
+                         "local ring — follow/resume work on the relay "
+                         "socket; snapshot: scatter-gather per query "
+                         "(full peer history, no follow)")
     args = ap.parse_args(argv)
 
     setup_logging()
-    relay = Relay()
+    relay = FollowingRelay() if args.mode == "live" else Relay()
     for spec in args.peer:
         name, sep, sock = spec.partition("=")
         if not sep or not name or not sock:
@@ -241,8 +382,9 @@ def main(argv=None) -> int:  # pragma: no cover - thin wrapper
 
         kv = RemoteKVStore(args.kvstore)
         directory = PeerDirectory(kv, relay).start()
-    server = HubbleServer(RelayObserver(relay), args.socket,
-                          relay=relay).start()
+    observer = (relay.observer if args.mode == "live"
+                else RelayObserver(relay))
+    server = HubbleServer(observer, args.socket, relay=relay).start()
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
@@ -250,6 +392,8 @@ def main(argv=None) -> int:  # pragma: no cover - thin wrapper
     server.stop()
     if directory is not None:
         directory.stop()
+    if args.mode == "live":
+        relay.stop()
     if kv is not None:
         kv.close()
     return 0

@@ -73,26 +73,35 @@ class HubbleServer:
 
             unlink_if_stale(socket_path)
         outer = self
+        self._active_requests: set = set()
+        self._active_lock = threading.Lock()
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):  # noqa: A003
-                line = self.rfile.readline(1 << 20)
-                if not line:
-                    return
+                with outer._active_lock:
+                    outer._active_requests.add(self.request)
                 try:
-                    req = json.loads(line)
-                except json.JSONDecodeError:
-                    self._send({"error": "bad request json"})
-                    return
-                try:
-                    outer._dispatch(req, self._send)
-                except BrokenPipeError:
-                    pass  # client went away mid-stream
-                except Exception as e:
+                    line = self.rfile.readline(1 << 20)
+                    if not line:
+                        return
                     try:
-                        self._send({"error": f"{type(e).__name__}: {e}"})
-                    except OSError:
-                        pass
+                        req = json.loads(line)
+                    except json.JSONDecodeError:
+                        self._send({"error": "bad request json"})
+                        return
+                    try:
+                        outer._dispatch(req, self._send)
+                    except BrokenPipeError:
+                        pass  # client went away mid-stream
+                    except Exception as e:
+                        try:
+                            self._send(
+                                {"error": f"{type(e).__name__}: {e}"})
+                        except OSError:
+                            pass
+                finally:
+                    with outer._active_lock:
+                        outer._active_requests.discard(self.request)
 
             def _send(self, obj: Dict) -> None:
                 self.wfile.write((json.dumps(obj) + "\n").encode())
@@ -128,7 +137,8 @@ class HubbleServer:
                   "lost": self.observer.lost_reported,
                   "ring_capacity": self.observer.ring.capacity,
                   "oldest_seq": self.observer.ring.oldest_seq,
-                  "next_seq": self.observer.ring.next_seq})
+                  "next_seq": self.observer.ring.next_seq,
+                  "instance": getattr(self.observer, "instance", "")})
         elif op == "peers":
             if self.relay is None:
                 send({"error": "not a relay"})
@@ -148,6 +158,17 @@ class HubbleServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        # terminate in-flight streams too: a long follow window must
+        # not outlive the server (clients would block on a dead server
+        # for the rest of the window — e.g. a relay follower missing a
+        # node restart behind the same socket path)
+        with self._active_lock:
+            active = list(self._active_requests)
+        for sock in active:
+            try:
+                sock.shutdown(2)
+            except OSError:
+                pass
         if self._thread:
             self._thread.join(timeout=5)
         if os.path.exists(self.socket_path):
@@ -160,9 +181,29 @@ class HubbleClient:
     def __init__(self, socket_path: str):
         self.socket_path = socket_path
         self.last_seq: Optional[int] = None
+        self._active_sock: Optional[socket.socket] = None
+        self._closed = False
+
+    def close(self) -> None:
+        """Cancel an in-flight request/stream from another thread AND
+        refuse new ones (sticky): without the flag, close() landing
+        between two requests cancels nothing and the owner blocks in a
+        fresh follow window. shutdown only — the owning thread's
+        ``finally`` is the single close, avoiding the cross-thread
+        fd-reuse hazard."""
+        self._closed = True
+        sock = self._active_sock
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
     def _request(self, req: Dict) -> Iterator[Dict]:
+        if self._closed:
+            raise ConnectionError("client closed")
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._active_sock = sock
         try:
             sock.connect(self.socket_path)
             sock.sendall((json.dumps(req) + "\n").encode())
@@ -176,6 +217,7 @@ class HubbleClient:
                     line, buf = buf.split(b"\n", 1)
                     yield json.loads(line)
         finally:
+            self._active_sock = None
             sock.close()
 
     def get_flows(self, flt: Optional[Dict] = None,
@@ -198,6 +240,10 @@ class HubbleClient:
                 return
             elif "error" in obj:
                 raise RuntimeError(obj["error"])
+        # the stream closed WITHOUT the end marker (server stopped and
+        # severed it): a silently truncated list would be
+        # indistinguishable from a complete one
+        raise ConnectionError("flow stream truncated before end marker")
 
     def follow(self, flt: Optional[Dict] = None,
                timeout: float = _MAX_FOLLOW_TIMEOUT) -> Iterator[Dict]:

@@ -27,6 +27,18 @@ def _flow(t, src=1, dst=2, verdict=Verdict.FORWARDED):
                 verdict=verdict)
 
 
+class _Nodes:
+    """tmp_path stand-in that also exposes the node servers/observers."""
+
+    def __init__(self, base, observers, servers):
+        self.base = base
+        self.observers = observers
+        self.servers = servers
+
+    def __truediv__(self, other):
+        return self.base / other
+
+
 @pytest.fixture
 def two_nodes(tmp_path):
     obs_a, obs_b = Observer(), Observer()
@@ -34,9 +46,10 @@ def two_nodes(tmp_path):
     obs_b.observe([_flow(2.0, src=20), _flow(4.0, src=20)])
     srv_a = HubbleServer(obs_a, str(tmp_path / "a.sock")).start()
     srv_b = HubbleServer(obs_b, str(tmp_path / "b.sock")).start()
-    yield tmp_path
-    srv_a.stop()
-    srv_b.stop()
+    nodes = _Nodes(tmp_path, [obs_a, obs_b], [srv_a, srv_b])
+    yield nodes
+    for srv in nodes.servers:
+        srv.stop()
 
 
 def test_remote_peers_merge_time_ordered(two_nodes):
@@ -145,6 +158,116 @@ def test_hubble_peer_readvertises_after_lapse(tmp_path):
         assert store.get(key) is not None  # re-advertised
     finally:
         agent.stop()
+
+
+def test_following_relay_streams_live(two_nodes):
+    """Live relay: peers' flows arrive in the relay ring as they
+    happen, and follow works natively on the relay socket."""
+    import threading
+    import time as _time
+
+    from cilium_tpu.hubble.relay import FollowingRelay
+
+    relay = FollowingRelay()
+    relay.add_remote_peer("node-a", str(two_nodes / "a.sock"))
+    relay.add_remote_peer("node-b", str(two_nodes / "b.sock"))
+    server = HubbleServer(relay.observer, str(two_nodes / "relay.sock"),
+                          relay=relay).start()
+    try:
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline and relay.observer.seen < 4:
+            _time.sleep(0.05)
+        assert relay.observer.seen == 4  # both peers' histories followed
+        client = HubbleClient(str(two_nodes / "relay.sock"))
+        got = list(client.get_flows())
+        assert {f["node_name"] for f in got} == {"node-a", "node-b"}
+
+        # a NEW flow lands on node-a while a follow stream is open
+        collected = []
+        done = threading.Event()
+
+        def follow():
+            fc = HubbleClient(str(two_nodes / "relay.sock"))
+            for f in fc.get_flows(follow=True, timeout=5.0):
+                collected.append(f)
+                if f.get("source", {}).get("identity") == 999:
+                    done.set()
+                    return
+
+        t = threading.Thread(target=follow, daemon=True)
+        t.start()
+        _time.sleep(0.3)
+        # a new flow lands on node-a's observer mid-follow
+        two_nodes.observers[0].observe([_flow(9.0, src=999)])
+        assert done.wait(10.0), "live flow never reached the follower"
+        assert relay.status()["node-a"]["available"]
+    finally:
+        server.stop()
+        relay.stop()
+
+
+def test_following_relay_readd_is_duplicate_free(two_nodes):
+    """Regression: a kvstore re-advertisement (lease-lapse republish)
+    for a live follower must not replace it — a fresh client would
+    replay the peer's whole ring into the relay as duplicates."""
+    import time as _time
+
+    from cilium_tpu.hubble.relay import FollowingRelay
+
+    relay = FollowingRelay()
+    relay.add_remote_peer("node-a", str(two_nodes / "a.sock"))
+    deadline = _time.monotonic() + 10
+    while _time.monotonic() < deadline and relay.observer.seen < 2:
+        _time.sleep(0.05)
+    assert relay.observer.seen == 2
+    relay.add_remote_peer("node-a", str(two_nodes / "a.sock"))  # re-ad
+    _time.sleep(0.5)
+    assert relay.observer.seen == 2  # no replayed duplicates
+    relay.stop()
+
+
+def test_following_relay_survives_peer_restart(two_nodes):
+    """Regression: a restarted peer's ring seqs start over at 0; the
+    follower must detect this and reset its resume cursor instead of
+    waiting forever at a stale high since_seq."""
+    import time as _time
+
+    from cilium_tpu.hubble.relay import FollowingRelay
+
+    relay = FollowingRelay()
+    relay.add_remote_peer("node-a", str(two_nodes / "a.sock"))
+    deadline = _time.monotonic() + 10
+    while _time.monotonic() < deadline and relay.observer.seen < 2:
+        _time.sleep(0.05)
+    # restart the node: NEW observer (seqs from 0), same socket path
+    two_nodes.servers[0].stop()
+    fresh = Observer()
+    two_nodes.servers[0] = HubbleServer(fresh,
+                                        str(two_nodes / "a.sock")).start()
+    fresh.observe([_flow(9.0, src=999)])
+    deadline = _time.monotonic() + 30
+    while _time.monotonic() < deadline and relay.observer.seen < 3:
+        _time.sleep(0.2)
+    assert relay.observer.seen >= 3, "post-restart flow never arrived"
+    relay.stop()
+
+
+def test_following_relay_peer_removal_stops_stream(two_nodes):
+    from cilium_tpu.hubble.relay import FollowingRelay
+    import time as _time
+
+    relay = FollowingRelay()
+    relay.add_remote_peer("node-a", str(two_nodes / "a.sock"))
+    deadline = _time.monotonic() + 10
+    while _time.monotonic() < deadline and relay.observer.seen < 2:
+        _time.sleep(0.05)
+    relay.remove_peer("node-a")
+    seen = relay.observer.seen
+    two_nodes.observers[0].observe([_flow(9.0, src=999)])
+    _time.sleep(0.5)
+    assert relay.observer.seen == seen  # follower stopped
+    assert relay.peers() == []
+    relay.stop()
 
 
 def test_agents_publish_peers_and_relay_sees_their_flows(tmp_path):
