@@ -1,0 +1,193 @@
+"""Control-plane golden replay (SURVEY §4 "test/controlplane" row):
+the full examples/policies corpus is loaded into a faked agent, a
+fixed synthetic flow set replays through BOTH engines, and the
+verdicts must match the checked-in golden file bit-for-bit.
+
+Any semantic drift — rule parsing, selector resolution, MapState
+precedence, L7 matching, on either the oracle or the TPU-gated engine
+— breaks this test loudly. Regenerate the goldens ONLY after manually
+confirming the new verdicts are correct:
+
+    python tests/test_controlplane_golden.py regen
+"""
+
+import glob
+import json
+import os
+
+import pytest
+
+from cilium_tpu.agent import Agent
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import (
+    DNSInfo,
+    Flow,
+    HTTPInfo,
+    KafkaInfo,
+    L7Type,
+    Protocol,
+    TrafficDirection,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS = os.path.join(REPO, "examples", "policies")
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "golden", "corpus_verdicts.json")
+
+#: (endpoint id, flow-key, labels); ids and insertion order are FIXED
+#: so identity allocation is deterministic across runs. One endpoint
+#: per corpus selector, plus bystanders.
+ENDPOINTS = [
+    (1, "frontend", {"app": "frontend"}),
+    (2, "backend", {"app": "backend"}),
+    (3, "service", {"app": "service"}),
+    (4, "db", {"app": "db"}),
+    (5, "empire-hq", {"app": "empire-hq"}),
+    (6, "kafka", {"app": "kafka"}),
+    (7, "crawler", {"app": "crawler"}),
+    (8, "kube-dns", {"io.kubernetes.pod.namespace": "kube-system",
+                     "k8s-app": "kube-dns"}),
+    (9, "web", {"tier": "web", "env": "prod"}),
+    (10, "cache", {"tier": "cache"}),
+    (11, "staging", {"env": "staging", "app": "canary"}),
+    (12, "unrelated", {"app": "unrelated"}),
+]
+
+
+def build_agent(agent=None):
+    if agent is None:
+        cfg = Config()
+        cfg.configure_logging = False
+        agent = Agent(cfg)
+    ids = {}
+    for ep_id, key, labels in ENDPOINTS:
+        ids[key] = agent.endpoint_add(
+            ep_id, labels, ipv4=f"10.50.0.{ep_id}").identity
+    for path in sorted(glob.glob(os.path.join(CORPUS, "*", "*.yaml"))):
+        agent.policy_add_file(path, wait=False)
+    agent.endpoint_manager.regenerate_all(wait=True)
+    return agent, ids
+
+
+def build_flows(ids):
+    WORLD = 2  # reserved world identity
+
+    def f(src, dst, dport, proto=Protocol.TCP, l7=L7Type.NONE,
+          direction=TrafficDirection.INGRESS, **kw):
+        src_id = ids[src] if isinstance(src, str) else src
+        return Flow(src_identity=src_id, dst_identity=ids[dst],
+                    dport=dport, protocol=proto, direction=direction,
+                    l7=l7, **kw)
+
+    def http(m, p, headers=()):
+        return HTTPInfo(method=m, path=p, host="svc.local",
+                        headers=tuple(headers))
+
+    def kafka(api_key, topic):
+        return KafkaInfo(api_key=api_key, api_version=3, topic=topic,
+                         client_id="c1")
+
+    def dns(src, qname):
+        return Flow(src_identity=ids[src], dst_identity=ids["kube-dns"],
+                    dport=53, protocol=Protocol.UDP,
+                    direction=TrafficDirection.EGRESS, l7=L7Type.DNS,
+                    dns=DNSInfo(query=qname))
+
+    return [
+        # l3-allow-frontend: backend accepts frontend on ANY port
+        f("frontend", "backend", 8080),
+        # l4-allow-80: backend accepts anyone on TCP/80
+        f("unrelated", "backend", 80),
+        f("unrelated", "backend", 8080),          # neither rule: drop
+        # l3-deny-world + default-deny on db
+        f(WORLD, "db", 5432),                     # explicit deny
+        f("frontend", "db", 5432),                # no allow: drop
+        # multi-spec doc 1: web accepts env In (prod, staging) on 443
+        f("staging", "web", 443),
+        f("unrelated", "web", 443),               # env absent: drop
+        # multi-spec doc 2: cache accepts tier=web (any port)
+        f("web", "cache", 6379),
+        f("unrelated", "cache", 6379),
+        # l7-http-api on service
+        f("frontend", "service", 80, l7=L7Type.HTTP,
+          http=http("GET", "/api/v2/items")),
+        f("frontend", "service", 80, l7=L7Type.HTTP,
+          http=http("PUT", "/api/v1/config",
+                    [("X-Admin", "true")])),
+        f("frontend", "service", 80, l7=L7Type.HTTP,
+          http=http("PUT", "/api/v1/config")),    # header missing
+        f("frontend", "service", 80, l7=L7Type.HTTP,
+          http=http("DELETE", "/api/v1/items")),  # method not allowed
+        f("unrelated", "service", 80, l7=L7Type.HTTP,
+          http=http("GET", "/api/v1/items")),     # wrong peer
+        # kafka-topic-acl: produce deathstar-plans / consume
+        # empire-announce, from empire-hq only
+        f("empire-hq", "kafka", 9092, l7=L7Type.KAFKA,
+          kafka=kafka(0, "deathstar-plans")),     # produce: allowed
+        f("empire-hq", "kafka", 9092, l7=L7Type.KAFKA,
+          kafka=kafka(0, "empire-announce")),     # produce: wrong role
+        f("empire-hq", "kafka", 9092, l7=L7Type.KAFKA,
+          kafka=kafka(1, "empire-announce")),     # fetch: allowed
+        f("unrelated", "kafka", 9092, l7=L7Type.KAFKA,
+          kafka=kafka(0, "deathstar-plans")),     # wrong peer
+        # fqdn-egress: crawler may query *.cilium.io / example.com at
+        # kube-dns
+        dns("crawler", "docs.cilium.io"),
+        dns("crawler", "example.com"),
+        dns("crawler", "evil.attacker.net"),
+    ]
+
+
+def compute_verdicts():
+    agent, ids = build_agent()
+    try:
+        flows = build_flows(ids)
+        out = agent.loader.engine.verdict_flows(flows)
+        return [int(v) for v in out["verdict"]], ids
+    finally:
+        agent.stop()
+
+
+def test_corpus_replay_matches_goldens():
+    with open(GOLDEN) as fp:
+        golden = json.load(fp)
+    verdicts, ids = compute_verdicts()
+    assert verdicts == golden["verdicts"], (
+        "verdict drift vs goldens — if intentional, regenerate via "
+        "`python tests/test_controlplane_golden.py regen` after "
+        "manually validating every changed verdict")
+    # identity allocation determinism is part of the contract
+    assert {k: int(v) for k, v in ids.items()} == golden["identities"]
+
+
+@pytest.mark.parametrize("offload", [False, True])
+def test_both_engines_agree_on_corpus(offload):
+    # set the gate directly, not via the environment: ambient
+    # CILIUM_TPU_* vars must not turn the "oracle" case into a second
+    # offload run (or change bank/batch shapes under the goldens)
+    cfg = Config()
+    cfg.enable_tpu_offload = offload
+    cfg.configure_logging = False
+    agent, ids = build_agent(Agent(cfg))
+    try:
+        out = agent.loader.engine.verdict_flows(build_flows(ids))
+        with open(GOLDEN) as fp:
+            golden = json.load(fp)
+        assert [int(v) for v in out["verdict"]] == golden["verdicts"]
+    finally:
+        agent.stop()
+
+
+if __name__ == "__main__":
+    import sys
+
+    if len(sys.argv) > 1 and sys.argv[1] == "regen":
+        verdicts, ids = compute_verdicts()
+        os.makedirs(os.path.dirname(GOLDEN), exist_ok=True)
+        with open(GOLDEN, "w") as fp:
+            json.dump({"verdicts": verdicts,
+                       "identities": {k: int(v) for k, v in ids.items()}},
+                      fp, indent=1)
+        print(f"wrote {GOLDEN}: {verdicts}")
+    else:
+        print(__doc__)
