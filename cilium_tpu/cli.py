@@ -148,6 +148,29 @@ def cmd_replay(args) -> int:
     return 0
 
 
+def cmd_capture(args) -> int:
+    """Binary capture tooling (perf-ring-analog format)."""
+    import os
+
+    from cilium_tpu.core.flow import L7Type
+    from cilium_tpu.ingest import binary
+    from cilium_tpu.ingest.hubble import read_jsonl
+
+    if args.capture_cmd == "info":
+        n = binary.capture_count(args.file)
+        print(json.dumps({"records": n,
+                          "bytes": os.path.getsize(args.file)}))
+        return 0
+    # convert JSONL → binary tuples; L7 payloads are not carried by the
+    # fixed-size record (as in the reference's ring events), so count
+    # what was flattened to its tuple form
+    flows = list(read_jsonl(args.input))
+    l7_flattened = sum(1 for f in flows if f.l7 != L7Type.NONE)
+    n = binary.write_capture(args.output, flows)
+    print(json.dumps({"records": n, "l7_payloads_dropped": l7_flattened}))
+    return 0
+
+
 def cmd_bugtool(args) -> int:
     from cilium_tpu.runtime.service import VerdictClient
 
@@ -370,7 +393,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="print server status instead of flows")
     p.set_defaults(fn=cmd_observe)
 
-    p = sub.add_parser("replay", help="replay a Hubble JSONL capture")
+    p = sub.add_parser("capture", help="binary capture tooling")
+    capsub = p.add_subparsers(dest="capture_cmd", required=True)
+    ci = capsub.add_parser("info", help="validate + describe a capture")
+    ci.add_argument("file")
+    ci.set_defaults(fn=cmd_capture)
+    cc = capsub.add_parser("convert",
+                           help="JSONL → binary tuple capture")
+    cc.add_argument("input")
+    cc.add_argument("output")
+    cc.set_defaults(fn=cmd_capture)
+
+    p = sub.add_parser("replay",
+                       help="replay a Hubble capture (JSONL or binary)")
     p.add_argument("capture")
     p.add_argument("--policy", action="append",
                    help="CNP YAML file (repeatable)")
@@ -394,6 +429,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     except ConnectionError as e:
         print(f"error: cannot reach agent socket: {e}", file=sys.stderr)
         return 1
+    except Exception as e:
+        from cilium_tpu.ingest.binary import CaptureError
+
+        if isinstance(e, CaptureError):
+            print(f"error: invalid capture: {e}", file=sys.stderr)
+            return 1
+        raise
 
 
 if __name__ == "__main__":

@@ -64,6 +64,27 @@ def replay_chunks(capture: str, chunk_size: int = 8192,
 
     index = max(start, cursor.load() if cursor is not None else 0)
     emitted = 0
+    from cilium_tpu.ingest.binary import MAGIC
+
+    with open(capture, "rb") as probe:
+        is_binary = probe.read(len(MAGIC)) == MAGIC
+    if is_binary:
+        # binary captures (ingest/binary.py): the cursor indexes
+        # records — fixed-size, so no blank-line concerns; validated
+        # once and memmapped, so chunking costs one open total
+        from cilium_tpu.ingest.binary import map_capture, records_to_flows
+
+        records = map_capture(capture)
+        while index < len(records):
+            take = chunk_size if limit is None else min(
+                chunk_size, limit - emitted)
+            if take <= 0:
+                return
+            chunk = records_to_flows(records[index:index + take])
+            yield index + len(chunk), chunk
+            index += len(chunk)
+            emitted += len(chunk)
+        return
     with open(capture) as fp:
         for _ in range(index):
             if not fp.readline():

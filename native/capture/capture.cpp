@@ -1,0 +1,139 @@
+// Binary flow-capture codec: the perf-ring-buffer / PolicyVerdictNotify
+// analog (reference: bpf/lib/events.h defines fixed-size C event
+// records; pkg/monitor consumes them). Flow tuples are fixed 32-byte
+// little-endian records so the Python side ingests them zero-copy as a
+// numpy structured array — no per-record parsing on the hot path.
+//
+// File layout:
+//   header (16B): magic "CTCAP1\0\0" | u32 version | u32 record_count
+//   records (32B each, packed):
+//     u32 src_identity | u32 dst_identity | u16 dport | u16 sport |
+//     u8 proto | u8 direction | u8 l7_type | u8 verdict | f64 time |
+//     u32 reserved0 | u32 reserved1
+//
+// L7 payloads (paths/qnames/topics) are not carried here — neither are
+// they in the reference's ring events (L7 arrives via the accesslog
+// path); JSONL remains the capture format for L7 flows.
+//
+// C ABI so ctypes loads it without pybind11. All functions return
+// >=0 on success, negative error codes otherwise.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+namespace {
+
+constexpr char MAGIC[8] = {'C', 'T', 'C', 'A', 'P', '1', '\0', '\0'};
+constexpr uint32_t VERSION = 1;
+
+#pragma pack(push, 1)
+struct Header {
+  char magic[8];
+  uint32_t version;
+  uint32_t record_count;
+};
+
+struct Record {
+  uint32_t src_identity;
+  uint32_t dst_identity;
+  uint16_t dport;
+  uint16_t sport;
+  uint8_t proto;
+  uint8_t direction;
+  uint8_t l7_type;
+  uint8_t verdict;
+  double time;
+  uint32_t reserved0;
+  uint32_t reserved1;
+};
+#pragma pack(pop)
+
+static_assert(sizeof(Header) == 16, "header must be 16 bytes");
+static_assert(sizeof(Record) == 32, "record must be 32 bytes");
+
+}  // namespace
+
+extern "C" {
+
+// error codes
+enum {
+  CT_OK = 0,
+  CT_ERR_IO = -1,
+  CT_ERR_MAGIC = -2,
+  CT_ERR_VERSION = -3,
+  CT_ERR_TRUNCATED = -4,
+};
+
+int ct_capture_record_size() { return (int)sizeof(Record); }
+
+// Write `n` records to `path` (whole-file write; the writer owns the
+// file). Returns CT_OK or a negative error.
+int ct_capture_write(const char* path, const void* records, uint32_t n) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return CT_ERR_IO;
+  Header h;
+  std::memcpy(h.magic, MAGIC, sizeof(MAGIC));
+  h.version = VERSION;
+  h.record_count = n;
+  int rc = CT_OK;
+  if (std::fwrite(&h, sizeof(h), 1, f) != 1) rc = CT_ERR_IO;
+  if (rc == CT_OK && n > 0 &&
+      std::fwrite(records, sizeof(Record), n, f) != n)
+    rc = CT_ERR_IO;
+  if (std::fclose(f) != 0 && rc == CT_OK) rc = CT_ERR_IO;
+  return rc;
+}
+
+// Validate the header; returns the record count (>=0) or an error.
+int ct_capture_count(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return CT_ERR_IO;
+  Header h;
+  int rc;
+  if (std::fread(&h, sizeof(h), 1, f) != 1) {
+    rc = CT_ERR_TRUNCATED;
+  } else if (std::memcmp(h.magic, MAGIC, sizeof(MAGIC)) != 0) {
+    rc = CT_ERR_MAGIC;
+  } else if (h.version != VERSION) {
+    rc = CT_ERR_VERSION;
+  } else {
+    // the byte length must back the declared count: a torn write must
+    // not read as a shorter-but-valid capture
+    if (std::fseek(f, 0, SEEK_END) != 0) {
+      rc = CT_ERR_IO;
+    } else {
+      long size = std::ftell(f);
+      long want = (long)sizeof(Header) + (long)h.record_count * 32;
+      rc = (size == want) ? (int)h.record_count : CT_ERR_TRUNCATED;
+    }
+  }
+  std::fclose(f);
+  return rc;
+}
+
+// Read up to `max` records starting at record `offset` into `out`.
+// Returns the number read (>=0) or a negative error.
+int ct_capture_read(const char* path, void* out, uint32_t max,
+                    uint32_t offset) {
+  int total = ct_capture_count(path);
+  if (total < 0) return total;
+  if (offset >= (uint32_t)total) return 0;
+  uint32_t n = (uint32_t)total - offset;
+  if (n > max) n = max;
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return CT_ERR_IO;
+  int rc;
+  if (std::fseek(f, (long)sizeof(Header) + (long)offset * 32,
+                 SEEK_SET) != 0) {
+    rc = CT_ERR_IO;
+  } else if (std::fread(out, sizeof(Record), n, f) != n) {
+    rc = CT_ERR_TRUNCATED;
+  } else {
+    rc = (int)n;
+  }
+  std::fclose(f);
+  return rc;
+}
+
+}  // extern "C"

@@ -1,0 +1,164 @@
+"""Binary flow captures (native codec + numpy fallback): roundtrip,
+validation, replay integration.
+
+Reference: fixed-size perf-ring event records (bpf/lib/events.h) read
+by pkg/monitor — SURVEY.md §2.5/§2.7.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from cilium_tpu import cli
+from cilium_tpu.core.flow import (
+    Flow,
+    L7Type,
+    Protocol,
+    TrafficDirection,
+    Verdict,
+)
+from cilium_tpu.ingest import binary
+
+
+def flows(n=10):
+    return [
+        Flow(src_identity=100 + i, dst_identity=200 + i, dport=80 + i,
+             sport=4000 + i, protocol=Protocol.UDP if i % 2 else
+             Protocol.TCP,
+             direction=TrafficDirection.EGRESS if i % 3 == 0 else
+             TrafficDirection.INGRESS,
+             l7=L7Type.NONE, verdict=Verdict.FORWARDED,
+             time=float(i) / 8)
+        for i in range(n)
+    ]
+
+
+def test_roundtrip_preserves_tuples(tmp_path):
+    path = str(tmp_path / "cap.bin")
+    orig = flows(10)
+    assert binary.write_capture(path, orig) == 10
+    assert binary.capture_count(path) == 10
+    back = binary.read_capture(path)
+    for a, b in zip(orig, back):
+        assert (a.src_identity, a.dst_identity, a.dport, a.sport,
+                a.protocol, a.direction, a.l7, a.verdict, a.time) == \
+               (b.src_identity, b.dst_identity, b.dport, b.sport,
+                b.protocol, b.direction, b.l7, b.verdict, b.time)
+
+
+def test_native_lib_is_used_and_matches_layout():
+    lib = binary._native()
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    assert lib.ct_capture_record_size() == binary.RECORD.itemsize
+
+
+def test_native_and_numpy_paths_interoperate(tmp_path, monkeypatch):
+    """A capture written by the native codec reads identically through
+    the pure-numpy fallback, and vice versa — same wire format."""
+    if binary._native() is None:
+        pytest.skip("native toolchain unavailable")
+    orig = flows(7)
+    native_path = str(tmp_path / "native.bin")
+    binary.write_capture(native_path, orig)  # native write
+
+    monkeypatch.setattr(binary, "_native", lambda: None)  # force numpy
+    assert binary.capture_count(native_path) == 7
+    back = binary.read_capture(native_path)
+    assert [f.src_identity for f in back] == [
+        f.src_identity for f in orig]
+    numpy_path = str(tmp_path / "numpy.bin")
+    binary.write_capture(numpy_path, orig)  # numpy write
+    monkeypatch.undo()
+    back2 = binary.read_capture(numpy_path)  # native read
+    assert [f.dport for f in back2] == [f.dport for f in orig]
+
+
+def test_partial_reads(tmp_path):
+    path = str(tmp_path / "cap.bin")
+    binary.write_capture(path, flows(10))
+    rec = binary.read_records(path, start=3, limit=4)
+    assert list(rec["src_identity"]) == [103, 104, 105, 106]
+    assert len(binary.read_records(path, start=9, limit=100)) == 1
+    assert len(binary.read_records(path, start=50)) == 0
+
+
+def test_validation_rejects_garbage(tmp_path):
+    bad = tmp_path / "bad.bin"
+    bad.write_bytes(b"NOTACAP\x00" + b"\x00" * 24)
+    with pytest.raises(binary.CaptureError):
+        binary.capture_count(str(bad))
+    # torn write: declared count not backed by bytes
+    path = str(tmp_path / "torn.bin")
+    binary.write_capture(path, flows(5))
+    with open(path, "r+b") as fp:
+        fp.truncate(16 + 3 * 32 + 7)
+    with pytest.raises(binary.CaptureError):
+        binary.capture_count(str(path))
+
+
+def test_cli_convert_info_replay(tmp_path, capsys):
+    from cilium_tpu.ingest.hubble import flow_to_dict
+
+    jsonl = tmp_path / "cap.jsonl"
+    jsonl.write_text("\n".join(
+        json.dumps(flow_to_dict(f)) for f in flows(8)) + "\n")
+    bin_path = tmp_path / "cap.bin"
+    assert cli.main(["capture", "convert", str(jsonl),
+                     str(bin_path)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out == {"records": 8, "l7_payloads_dropped": 0}
+    assert cli.main(["capture", "info", str(bin_path)]) == 0
+    assert json.loads(capsys.readouterr().out)["records"] == 8
+
+    cnp = tmp_path / "p.yaml"
+    cnp.write_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: t}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - toPorts: [{ports: [{port: "80", protocol: TCP}]}]
+""")
+    rc = cli.main(["replay", str(bin_path), "--policy", str(cnp),
+                   "--endpoint", "app=svc",
+                   "--cursor", str(tmp_path / "cur.json")])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["flows"] == 8
+
+
+def test_l7_flows_flatten_to_l4_tuples(tmp_path):
+    """Regression: a record has no L7 payload, so keeping the L7 type
+    would re-verdict an HTTP flow against EMPTY fields — converted
+    flows must come back as the L3/L4 tuples they are."""
+    from cilium_tpu.core.flow import HTTPInfo
+
+    path = str(tmp_path / "l7.bin")
+    binary.write_capture(path, [
+        Flow(src_identity=1, dst_identity=2, dport=80,
+             l7=L7Type.HTTP,
+             http=HTTPInfo(method="GET", path="/api", host="h"))])
+    (back,) = binary.read_capture(path)
+    assert back.l7 == L7Type.NONE and back.http is None
+
+
+def test_cli_reports_invalid_captures_cleanly(tmp_path, capsys):
+    rc = cli.main(["capture", "info", str(tmp_path / "missing.bin")])
+    assert rc == 1
+    assert "error" in capsys.readouterr().err
+    bad = tmp_path / "bad.bin"
+    bad.write_bytes(b"garbage")
+    rc = cli.main(["capture", "info", str(bad)])
+    assert rc == 1
+    assert "invalid capture" in capsys.readouterr().err
+
+
+def test_zero_copy_ingest_shape():
+    """read_records hands the engine a structured array whose columns
+    are directly usable — the zero-parse contract."""
+    rec = binary.flows_to_records(flows(4))
+    assert rec.dtype == binary.RECORD
+    np.testing.assert_array_equal(rec["dport"], [80, 81, 82, 83])
