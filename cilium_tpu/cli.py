@@ -119,27 +119,46 @@ def cmd_replay(args) -> int:
     if engine is None:
         print("no engine (no endpoints?)", file=sys.stderr)
         return 1
-    observer = Observer(handlers=[FlowMetrics()])
+    from cilium_tpu.ingest.binary import CaptureError
     from cilium_tpu.ingest.cursor import ReplayCursor, replay_chunks
 
+    # the fast path skips per-flow observability by design, so its
+    # Observer is never built
+    observer = None if args.fast else Observer(handlers=[FlowMetrics()])
     cursor = (ReplayCursor(args.cursor, args.capture)
               if args.cursor else None)
     counts: dict = {}
     total = 0
-    for commit_index, flows in replay_chunks(
-            args.capture, cursor=cursor, start=args.start,
-            limit=args.limit):
-        out = engine.verdict_flows(flows)
-        if "match_spec" not in out:
-            out = {"verdict": np.asarray(out["verdict"])}
-        annotate_flows(flows, out)
-        observer.observe(flows)
-        for f in flows:
-            counts[Verdict(f.verdict).name] = counts.get(
-                Verdict(f.verdict).name, 0) + 1
-        total += len(flows)
-        if cursor is not None:  # commit AFTER processing (§5.4): a
-            cursor.commit(commit_index)  # kill re-runs ≤1 chunk
+    try:
+        chunks = replay_chunks(args.capture, cursor=cursor,
+                               start=args.start, limit=args.limit,
+                               decode=not args.fast)
+        for commit_index, chunk in chunks:
+            if args.fast:
+                # columnar: records → verdicts, no Flow objects
+                out = engine.verdict_records(chunk)
+                for v, c in zip(*np.unique(out["verdict"],
+                                           return_counts=True)):
+                    name = Verdict(int(v)).name
+                    counts[name] = counts.get(name, 0) + int(c)
+            else:
+                out = engine.verdict_flows(chunk)
+                if "match_spec" not in out:
+                    out = {"verdict": np.asarray(out["verdict"])}
+                annotate_flows(chunk, out)
+                observer.observe(chunk)
+                for f in chunk:
+                    counts[Verdict(f.verdict).name] = counts.get(
+                        Verdict(f.verdict).name, 0) + 1
+            total += len(chunk)
+            if cursor is not None:  # commit AFTER processing (§5.4):
+                cursor.commit(commit_index)  # a kill re-runs ≤1 chunk
+    except CaptureError as e:
+        if args.fast and "bad magic" in str(e):
+            print("error: --fast needs a binary capture "
+                  "(cilium-tpu capture convert)", file=sys.stderr)
+            return 1
+        raise  # missing/truncated: main()'s handler reports precisely
     if cursor is not None and (args.limit is None or total < args.limit):
         # ran to EOF: a finished replay must not pin the cursor there —
         # re-running the same command should replay, not print 0 flows
@@ -416,6 +435,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--cursor",
                    help="cursor file: resume a killed replay from the "
                         "last committed chunk (kill/resume, §5.4)")
+    p.add_argument("--fast", action="store_true",
+                   help="columnar fast path for binary captures: no "
+                        "per-flow Python objects, skips per-flow "
+                        "observability (hubble/monitor fan-out)")
     p.add_argument("--tpu", action="store_true",
                    help="enable the TPU engine (default: oracle)")
     p.set_defaults(fn=cmd_replay)

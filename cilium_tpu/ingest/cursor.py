@@ -51,7 +51,8 @@ class ReplayCursor:
 
 def replay_chunks(capture: str, chunk_size: int = 8192,
                   cursor: Optional[ReplayCursor] = None,
-                  start: int = 0, limit: Optional[int] = None):
+                  start: int = 0, limit: Optional[int] = None,
+                  decode: bool = True):
     """Yield ``(commit_index, flows)`` chunks, resuming from the cursor
     when one is given. ``commit_index`` is the LINE index just past the
     chunk — commit it verbatim after fully processing the chunk
@@ -59,7 +60,10 @@ def replay_chunks(capture: str, chunk_size: int = 8192,
     skips one). Line-indexed, not flow-indexed, so blank lines can
     neither double-deliver nor silently truncate a resume. One open
     file handle for the whole pass (a per-chunk reopen-and-skip would
-    be quadratic in capture size). ``limit`` counts flows."""
+    be quadratic in capture size). ``limit`` counts flows.
+    ``decode=False`` (binary captures only) yields raw record arrays
+    instead of Flow lists — the columnar fast path — under the SAME
+    cursor protocol, so kill/resume semantics live in one place."""
     from cilium_tpu.ingest.hubble import flow_from_dict
 
     index = max(start, cursor.load() if cursor is not None else 0)
@@ -80,11 +84,16 @@ def replay_chunks(capture: str, chunk_size: int = 8192,
                 chunk_size, limit - emitted)
             if take <= 0:
                 return
-            chunk = records_to_flows(records[index:index + take])
-            yield index + len(chunk), chunk
-            index += len(chunk)
-            emitted += len(chunk)
+            raw = records[index:index + take]
+            chunk = records_to_flows(raw) if decode else raw
+            yield index + len(raw), chunk
+            index += len(raw)
+            emitted += len(raw)
         return
+    if not decode:
+        from cilium_tpu.ingest.binary import CaptureError
+
+        raise CaptureError("bad magic")  # raw mode is binary-only
     with open(capture) as fp:
         for _ in range(index):
             if not fp.readline():

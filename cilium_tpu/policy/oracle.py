@@ -165,3 +165,10 @@ class OracleVerdictEngine:
             "verdict": np.array([int(self.verdict_one(f)) for f in flows],
                                 dtype=np.int32)
         }
+
+    def verdict_records(self, rec):
+        """Interface parity with VerdictEngine.verdict_records (the
+        oracle has no columnar path; records round-trip through Flow)."""
+        from cilium_tpu.ingest.binary import records_to_flows
+
+        return self.verdict_flows(records_to_flows(rec))
