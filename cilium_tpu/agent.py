@@ -74,9 +74,20 @@ class Agent:
         self.dns_proxy = DNSProxy(self.name_manager,
                                   use_tpu=self.config.enable_tpu_offload)
         self.loader = Loader(self.config)
+        # services / kube-proxy replacement (§2.4): Maglev selection;
+        # built before the endpoint manager so toServices policy rules
+        # resolve against it (backend IPs → identities via the ipcache)
+        self.services = ServiceManager()
         self.endpoint_manager = EndpointManager(
             self.repo, self.selector_cache, self.allocator, self.loader,
-            dns_proxy=self.dns_proxy, state_dir=state_dir)
+            dns_proxy=self.dns_proxy, state_dir=state_dir,
+            services=self.services,
+            backend_identity=lambda ip: self.ipcache.lookup(ip))
+        # backend-set changes alter toServices resolution → regenerate,
+        # but only when some rule actually uses toServices: routine
+        # backend churn must not trigger full-policy recomputation in
+        # clusters with no such rules
+        self.services.on_change = self._on_service_change
         # clustermesh (§2.4): publish local state into our kvstore;
         # watch remote clusters' stores for their identities/IPs. A
         # caller-supplied store is how this agent shares state with an
@@ -102,8 +113,6 @@ class Agent:
         # pod_cidr stands in so construction stays non-blocking.
         self.ipam = NodeAllocator(self.config.pod_cidr)
         self.node_registration = None
-        # services / kube-proxy replacement (§2.4): Maglev selection
-        self.services = ServiceManager()
         self.controllers = ControllerManager()
         self.service: Optional[VerdictService] = None
         self.socket_path = socket_path
@@ -292,6 +301,11 @@ class Agent:
 
     def _dns_gc(self) -> None:
         self.name_manager.gc()
+
+    def _on_service_change(self) -> None:
+        if any(er.to_services for rule in self.repo.rules()
+               for er in rule.egress):
+            self.endpoint_manager.regenerate_all()
 
     def _on_cluster_identity(self, nid: int, labels) -> None:
         """A (possibly remote) cluster identity appeared or vanished in

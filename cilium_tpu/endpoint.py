@@ -77,13 +77,18 @@ class EndpointManager:
     def __init__(self, repo: Repository, selector_cache: SelectorCache,
                  allocator: IdentityAllocator, loader: Loader,
                  dns_proxy=None, state_dir: Optional[str] = None,
-                 regen_workers: int = 4):
+                 regen_workers: int = 4,
+                 services=None, backend_identity=None):
         self.repo = repo
         self.cache = selector_cache
         self.allocator = allocator
         self.loader = loader
         self.dns_proxy = dns_proxy
         self.state_dir = state_dir
+        # `toServices` resolution context (ServiceManager + ip→identity
+        # hook), threaded into every PolicyResolver this manager builds
+        self.services = services
+        self.backend_identity = backend_identity
         self._lock = threading.RLock()
         self._endpoints: Dict[int, Endpoint] = {}
         self._pool = ThreadPoolExecutor(max_workers=regen_workers,
@@ -167,7 +172,9 @@ class EndpointManager:
                 for ep in eps:
                     ep.state = EndpointState.REGENERATING
             with SpanStat("endpoint_regeneration"):
-                resolver = PolicyResolver(self.repo, self.cache)
+                resolver = PolicyResolver(
+                    self.repo, self.cache, services=self.services,
+                    backend_identity=self.backend_identity)
                 per_identity = {}
                 resolved = {}
                 for ep in eps:

@@ -71,6 +71,10 @@ class Service:
     #: ClientIP session affinity: selection hashes the source IP only,
     #: so one client sticks to one backend across connections.
     affinity: bool = False
+    #: k8s object metadata — what policy `toServices` selects on
+    name: str = ""
+    namespace: str = "default"
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     def active_backends(self) -> List[Backend]:
         return [b for b in self.backends if b.state == BackendState.ACTIVE]
@@ -116,6 +120,15 @@ class ServiceManager:
         self._tables: Dict[Frontend, np.ndarray] = {}
         self._revision = 0
         self.table_size = table_size
+        #: fired after every mutation commit — policy `toServices`
+        #: resolution depends on the backend set, so the agent points
+        #: this at endpoint regeneration (the reference's k8s service
+        #: watcher likewise retriggers policy recomputation)
+        self.on_change = None
+
+    def _changed(self) -> None:
+        if self.on_change is not None:
+            self.on_change()
 
     # -- mutation ---------------------------------------------------------
     def upsert(self, svc: Service) -> None:
@@ -131,6 +144,7 @@ class ServiceManager:
             self._tables[svc.frontend] = table
             self._revision += 1
         METRICS.set_gauge("cilium_tpu_lb_services", float(len(self._services)))
+        self._changed()
 
     def delete(self, frontend: Frontend) -> bool:
         with self._lock:
@@ -139,6 +153,8 @@ class ServiceManager:
             if existed:
                 self._revision += 1
         METRICS.set_gauge("cilium_tpu_lb_services", float(len(self._services)))
+        if existed:
+            self._changed()
         return existed
 
     def get(self, frontend: Frontend) -> Optional[Service]:

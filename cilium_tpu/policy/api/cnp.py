@@ -67,8 +67,27 @@ def _parse_egress(d: Dict, deny: bool) -> EgressRule:
             )
             for f in (d.get("toFQDNs") or ())
         ),
+        to_services=tuple(_parse_service_selector(s)
+                          for s in (d.get("toServices") or ())),
         to_ports=tuple(PortRule.from_dict(p) for p in (d.get("toPorts") or ())),
         deny=deny,
+    )
+
+
+def _parse_service_selector(d: Dict):
+    from cilium_tpu.policy.api.rule import EndpointSelector, ServiceSelector
+
+    ks = d.get("k8sService") or {}
+    kss = d.get("k8sServiceSelector") or {}
+    sel = kss.get("selector")
+    return ServiceSelector(
+        name=ks.get("serviceName", "") or "",
+        namespace=ks.get("namespace", "default") or "default",
+        # full matchLabels + matchExpressions via the shared selector
+        # machinery; None when the label form isn't used
+        label_selector=(EndpointSelector.from_dict(sel)
+                        if sel is not None else None),
+        selector_namespace=kss.get("namespace", "") or "",
     )
 
 

@@ -111,18 +111,50 @@ class IngressRule:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServiceSelector:
+    """``toServices`` member (reference: api.Service) — pick k8s
+    services by name+namespace or by a label selector over service
+    labels (full matchLabels + matchExpressions semantics via
+    :class:`EndpointSelector`); the rule then allows egress to the
+    service's backends."""
+
+    name: str = ""
+    namespace: str = "default"
+    label_selector: Optional[EndpointSelector] = None
+    #: namespace scope for the label-selector form; empty = every
+    #: namespace (reference k8sServiceSelector semantics) — a NAMED
+    #: namespace must constrain the match, or a label an attacker can
+    #: apply in their own namespace would open the allow
+    selector_namespace: str = ""
+
+    def matches(self, svc_name: str, svc_namespace: str,
+                svc_labels) -> bool:
+        if self.name:
+            return (svc_name == self.name
+                    and svc_namespace == self.namespace)
+        if self.label_selector is None:
+            return False  # neither form given: selects nothing
+        if (self.selector_namespace
+                and svc_namespace != self.selector_namespace):
+            return False
+        return self.label_selector.matches(
+            LabelSet.from_dict(dict(svc_labels)))
+
+
+@dataclasses.dataclass(frozen=True)
 class EgressRule:
     to_endpoints: Tuple[EndpointSelector, ...] = ()
     to_entities: Tuple[str, ...] = ()
     to_cidrs: Tuple[str, ...] = ()
     to_fqdns: Tuple[FQDNSelector, ...] = ()
+    to_services: Tuple[ServiceSelector, ...] = ()
     to_ports: Tuple[PortRule, ...] = ()
     deny: bool = False
 
     def peer_selectors(self) -> Tuple[EndpointSelector, ...]:
         sels = list(self.to_endpoints)
         sels += [_ENTITY_SELECTORS[e] for e in self.to_entities]
-        if not sels and not self.to_fqdns:
+        if not sels and not self.to_fqdns and not self.to_services:
             sels = [EndpointSelector()]
         return tuple(sels)
 

@@ -140,9 +140,17 @@ class PolicyResolver:
     """Builds MapState per endpoint identity (resolvePolicyLocked +
     EndpointPolicy analog, SURVEY.md §3.2)."""
 
-    def __init__(self, repo: Repository, selector_cache: SelectorCache):
+    def __init__(self, repo: Repository, selector_cache: SelectorCache,
+                 services=None, backend_identity=None):
         self.repo = repo
         self.cache = selector_cache
+        #: optional ServiceManager: `toServices` resolves against its
+        #: k8s metadata (reference: pkg/k8s service cache feeding
+        #: resolveEgressPolicy); None → toServices selects nothing
+        self.services = services
+        #: optional ip → NumericIdentity hook (the agent passes
+        #: ipcache.lookup): how backend IPs become matchable identities
+        self.backend_identity = backend_identity
 
     def resolve(self, endpoint_labels: LabelSet) -> MapState:
         ms = MapState()
@@ -159,12 +167,13 @@ class PolicyResolver:
                 self._apply_direction(
                     ms, TrafficDirection.EGRESS, er.peer_selectors(),
                     er.to_ports, er.deny, rule_id, er.to_cidrs, er.to_fqdns,
+                    services=er.to_services,
                 )
         return ms
 
     def _apply_direction(
         self, ms: MapState, direction: int, peer_selectors, to_ports,
-        deny: bool, rule_id: str, cidrs, fqdns,
+        deny: bool, rule_id: str, cidrs, fqdns, services=(),
     ) -> None:
         peer_ids: Set[int] = set()
         wildcard_peer = False
@@ -177,6 +186,8 @@ class PolicyResolver:
             peer_ids.update(self.cache.get_selections(fsel))
         for cidr in cidrs:
             peer_ids.update(self._cidr_identities(cidr))
+        for svc_sel in services:
+            peer_ids.update(self._service_identities(svc_sel))
         if wildcard_peer:
             ids: Sequence[int] = (IDENTITY_WILDCARD,)
         else:
@@ -212,6 +223,24 @@ class PolicyResolver:
                                 direction=direction),
                     entry,
                 )
+
+    def _service_identities(self, svc_sel) -> Set[int]:
+        """``toServices`` → backend identities: match services by k8s
+        name/namespace or label selector, then map each ACTIVE
+        backend's IP to its identity (the reference resolves k8s
+        Endpoints the same way — via the ipcache join point, §2.1)."""
+        ids: Set[int] = set()
+        if self.services is None or self.backend_identity is None:
+            return ids
+        for svc in self.services.list():
+            if not svc_sel.matches(svc.name, svc.namespace,
+                                   svc.labels or {}):
+                continue
+            for backend in svc.active_backends():
+                nid = self.backend_identity(backend.ip)
+                if nid is not None:
+                    ids.add(int(nid))
+        return ids
 
     def _cidr_identities(self, cidr: str) -> FrozenSet[int]:
         """CIDR → local identities. v0: CIDRs are registered with the

@@ -1,0 +1,204 @@
+"""toServices egress rules (reference: api.Service in pkg/policy/api):
+k8s-service-by-name and by-label-selector resolution to backend
+identities, with regeneration on backend churn.
+"""
+
+import pytest
+
+from cilium_tpu.agent import Agent
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import Flow, TrafficDirection
+from cilium_tpu.loadbalancer import Backend, Frontend, Service
+from cilium_tpu.policy.api.cnp import load_cnp_yaml_text
+
+CNP = """
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: to-svc}
+spec:
+  endpointSelector: {matchLabels: {app: client}}
+  egress:
+  - toServices:
+    - k8sService: {serviceName: orders, namespace: default}
+    toPorts: [{ports: [{port: "8080", protocol: TCP}]}]
+"""
+
+CNP_BY_LABELS = """
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: to-svc-labels}
+spec:
+  endpointSelector: {matchLabels: {app: client}}
+  egress:
+  - toServices:
+    - k8sServiceSelector:
+        selector: {matchLabels: {team: payments}}
+"""
+
+
+@pytest.fixture
+def agent():
+    cfg = Config()
+    cfg.configure_logging = False
+    a = Agent(cfg).start()
+    yield a
+    a.stop()
+
+
+def order_service(backend_ips, name="orders", labels=None,
+                  namespace="default"):
+    import zlib
+
+    # frontend VIP derived deterministically from the name (hash() is
+    # PYTHONHASHSEED-randomized): distinct services must not collide on
+    # the ServiceManager's frontend key
+    vip = f"10.96.0.{(zlib.crc32(name.encode()) % 200) + 10}"
+    return Service(
+        frontend=Frontend(ip=vip, port=8080),
+        backends=[Backend(ip=ip, port=8080) for ip in backend_ips],
+        name=name, namespace=namespace, labels=labels or {})
+
+
+def egress_flow(src, dst, dport=8080):
+    return Flow(src_identity=src, dst_identity=dst, dport=dport,
+                direction=TrafficDirection.EGRESS)
+
+
+def test_to_services_by_name_allows_backends_only(agent):
+    client = agent.endpoint_add(1, {"app": "client"})
+    backend = agent.endpoint_add(2, {"app": "orders-pod"},
+                                 ipv4="10.0.0.7")
+    other = agent.endpoint_add(3, {"app": "other"}, ipv4="10.0.0.8")
+    agent.services.upsert(order_service(["10.0.0.7"]))
+    agent.policy_add(load_cnp_yaml_text(CNP)[0])
+    out = agent.process_flows([
+        egress_flow(client.identity, backend.identity),
+        egress_flow(client.identity, other.identity),
+        egress_flow(client.identity, backend.identity, dport=9999),
+    ])
+    assert [int(v) for v in out["verdict"]] == [1, 2, 2]
+
+
+def test_to_services_by_label_selector(agent):
+    client = agent.endpoint_add(1, {"app": "client"})
+    backend = agent.endpoint_add(2, {"app": "pay"}, ipv4="10.0.0.9")
+    agent.services.upsert(order_service(
+        ["10.0.0.9"], name="payments", labels={"team": "payments"}))
+    agent.services.upsert(order_service(["10.0.0.8"], name="ads",
+                                        labels={"team": "ads"}))
+    agent.policy_add(load_cnp_yaml_text(CNP_BY_LABELS)[0])
+    out = agent.process_flows([
+        egress_flow(client.identity, backend.identity, dport=1234),
+    ])
+    assert int(out["verdict"][0]) == 1  # no toPorts → any port
+
+
+def test_backend_churn_regenerates(agent):
+    client = agent.endpoint_add(1, {"app": "client"})
+    b1 = agent.endpoint_add(2, {"app": "pod-a"}, ipv4="10.0.0.7")
+    b2 = agent.endpoint_add(3, {"app": "pod-b"}, ipv4="10.0.0.8")
+    agent.services.upsert(order_service(["10.0.0.7"]))
+    agent.policy_add(load_cnp_yaml_text(CNP)[0])
+    out = agent.process_flows([
+        egress_flow(client.identity, b1.identity),
+        egress_flow(client.identity, b2.identity),
+    ])
+    assert [int(v) for v in out["verdict"]] == [1, 2]
+    # the service moves to pod-b: resolution must follow
+    agent.services.upsert(order_service(["10.0.0.8"]))
+    agent.endpoint_manager.regenerate_all(wait=True)
+    out = agent.process_flows([
+        egress_flow(client.identity, b1.identity),
+        egress_flow(client.identity, b2.identity),
+    ])
+    assert [int(v) for v in out["verdict"]] == [2, 1]
+
+
+def test_unmatched_service_selects_nothing_not_wildcard(agent):
+    """A toServices rule naming an absent service must NOT collapse to
+    a wildcard peer (the peer_selectors default)."""
+    client = agent.endpoint_add(1, {"app": "client"})
+    other = agent.endpoint_add(2, {"app": "other"}, ipv4="10.0.0.8")
+    agent.policy_add(load_cnp_yaml_text(CNP)[0])
+    out = agent.process_flows([
+        egress_flow(client.identity, other.identity),
+    ])
+    assert int(out["verdict"][0]) == 2
+
+
+def test_label_selector_respects_namespace_scope(agent):
+    """Regression: a namespaced k8sServiceSelector must not match a
+    same-labeled service in another namespace — an attacker-controlled
+    namespace could otherwise open the allow."""
+    cnp = load_cnp_yaml_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: scoped}
+spec:
+  endpointSelector: {matchLabels: {app: client}}
+  egress:
+  - toServices:
+    - k8sServiceSelector:
+        selector: {matchLabels: {team: payments}}
+        namespace: prod
+""")[0]
+    client = agent.endpoint_add(1, {"app": "client"})
+    prod_pod = agent.endpoint_add(2, {"app": "p"}, ipv4="10.0.0.7")
+    evil_pod = agent.endpoint_add(3, {"app": "e"}, ipv4="10.0.0.8")
+    agent.services.upsert(order_service(
+        ["10.0.0.7"], name="pay-prod", labels={"team": "payments"},
+        namespace="prod"))
+    agent.services.upsert(order_service(
+        ["10.0.0.8"], name="pay-evil", labels={"team": "payments"},
+        namespace="attacker"))
+    agent.policy_add(cnp)
+    out = agent.process_flows([
+        egress_flow(client.identity, prod_pod.identity, dport=1),
+        egress_flow(client.identity, evil_pod.identity, dport=1),
+    ])
+    assert [int(v) for v in out["verdict"]] == [1, 2]
+
+
+def test_match_expressions_select_services(agent):
+    cnp = load_cnp_yaml_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: exprs}
+spec:
+  endpointSelector: {matchLabels: {app: client}}
+  egress:
+  - toServices:
+    - k8sServiceSelector:
+        selector:
+          matchExpressions:
+          - {key: team, operator: In, values: [payments, billing]}
+""")[0]
+    client = agent.endpoint_add(1, {"app": "client"})
+    pod = agent.endpoint_add(2, {"app": "p"}, ipv4="10.0.0.7")
+    agent.services.upsert(order_service(
+        ["10.0.0.7"], name="billing", labels={"team": "billing"}))
+    agent.policy_add(cnp)
+    out = agent.process_flows([
+        egress_flow(client.identity, pod.identity, dport=1)])
+    assert int(out["verdict"][0]) == 1
+
+
+def test_oracle_and_tpu_agree_on_to_services():
+    for offload in (False, True):
+        cfg = Config()
+        cfg.enable_tpu_offload = offload
+        cfg.configure_logging = False
+        a = Agent(cfg).start()
+        try:
+            client = a.endpoint_add(1, {"app": "client"})
+            backend = a.endpoint_add(2, {"app": "orders-pod"},
+                                     ipv4="10.0.0.7")
+            a.services.upsert(order_service(["10.0.0.7"]))
+            a.policy_add(load_cnp_yaml_text(CNP)[0])
+            out = a.process_flows([
+                egress_flow(client.identity, backend.identity),
+                egress_flow(client.identity, backend.identity, 9999),
+            ])
+            assert [int(v) for v in out["verdict"]] == [1, 2], offload
+        finally:
+            a.stop()
