@@ -20,6 +20,7 @@ class Protocol(enum.IntEnum):
     ICMP = 1
     TCP = 6
     UDP = 17
+    ICMPV6 = 58
     SCTP = 132
 
 

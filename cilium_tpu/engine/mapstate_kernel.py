@@ -136,11 +136,21 @@ def mapstate_lookup(
     ``ruleset`` [B] int32 (winning entry's ruleset id, -1 if none),
     ``match_spec`` [B] int32 (specificity of winning entry, -1 default).
     """
+    from cilium_tpu.policy.mapstate import ICMP_TYPE_BIT
+
     B = ep_ids.shape[0]
     specs = jnp.asarray(_PROBE_SPECS)               # [8]
     peer_sel = (specs >> 2) & 1                      # [8]
     port_sel = (specs >> 1) & 1
     proto_sel = specs & 1
+
+    # ICMP key encoding lives HERE, beside the probes, so every caller
+    # (and the hypothesis differential suite, which calls this
+    # directly) matches the golden MapState.lookup: the type gets the
+    # marker bit in the port slot (type 0 must never read as the port
+    # wildcard — policy/mapstate.py effective_dport)
+    is_icmp = (protos == 1) | (protos == 58)
+    dports = jnp.where(is_icmp, dports | ICMP_TYPE_BIT, dports)
 
     p0 = jnp.broadcast_to(ep_ids[:, None], (B, 8))
     p1 = peer_ids[:, None] * peer_sel[None, :]
@@ -155,6 +165,12 @@ def mapstate_lookup(
     )
     idx = idx.reshape(B, 8)
     found = found.reshape(B, 8)
+    # proto-ANY port entries are an L4 construct: an ICMP flow whose
+    # marked type collides with the port value must not match them
+    # (mirrors MapStateKey.covers); the (port, proto-wildcard) probes
+    # are masked for ICMP flows
+    l4_only_probe = (port_sel == 1) & (proto_sel == 0)
+    found = found & ~(is_icmp[:, None] & l4_only_probe[None, :])
 
     deny_hit = found & is_deny[idx]
     denied = jnp.any(deny_hit, axis=1)

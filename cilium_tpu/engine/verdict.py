@@ -644,6 +644,9 @@ def verdict_step(arrays: Dict[str, jax.Array], batch: Dict[str, jax.Array]
     """
     if "scalars" in batch:
         batch = unpack_batch(batch)
+    # ICMP key encoding (marker bit in the port slot) happens inside
+    # mapstate_lookup so the kernel matches its golden model for every
+    # caller, not just this one
     ms = mapstate_lookup(
         arrays["ms_key_w0"], arrays["ms_key_w1"], arrays["ms_key_w2"],
         arrays["ms_deny"], arrays["ms_ruleset"],

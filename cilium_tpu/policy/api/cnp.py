@@ -18,9 +18,11 @@ import yaml
 
 from cilium_tpu.policy.api.rule import (
     EgressRule,
+    ICMPField,
     IngressRule,
     PortRule,
     Rule,
+    SanitizeError,
 )
 from cilium_tpu.policy.api.selector import EndpointSelector, FQDNSelector
 
@@ -37,6 +39,40 @@ class CiliumNetworkPolicy:
                 f"k8s:io.cilium.k8s.policy.namespace={self.namespace}")
 
 
+#: named ICMP types (upstream api.ICMPField.Type is an int-or-string),
+#: per family — the common probe/diagnostic set
+_ICMP_TYPE_NAMES = {
+    "IPv4": {"EchoReply": 0, "DestinationUnreachable": 3, "Redirect": 5,
+             "EchoRequest": 8, "TimeExceeded": 11, "ParameterProblem": 12,
+             "Timestamp": 13, "TimestampReply": 14},
+    "IPv6": {"DestinationUnreachable": 1, "PacketTooBig": 2,
+             "TimeExceeded": 3, "ParameterProblem": 4,
+             "EchoRequest": 128, "EchoReply": 129},
+}
+
+
+def _parse_icmp_type(family: str, raw) -> int:
+    if isinstance(raw, str) and not raw.lstrip("-").isdigit():
+        named = _ICMP_TYPE_NAMES.get(family, {}).get(raw)
+        if named is None:
+            raise SanitizeError(f"unknown ICMP type name {raw!r}")
+        return named
+    try:
+        return int(raw if raw is not None else 0)
+    except (ValueError, TypeError):
+        raise SanitizeError(f"bad ICMP type {raw!r}")
+
+
+def _parse_icmps(d: Dict):
+    return tuple(
+        ICMPField(family=f.get("family", "IPv4") or "IPv4",
+                  icmp_type=_parse_icmp_type(
+                      f.get("family", "IPv4") or "IPv4", f.get("type")))
+        for ic in (d.get("icmps") or ())
+        for f in (ic.get("fields") or ())
+    )
+
+
 def _parse_ingress(d: Dict, deny: bool) -> IngressRule:
     return IngressRule(
         from_endpoints=tuple(
@@ -46,6 +82,7 @@ def _parse_ingress(d: Dict, deny: bool) -> IngressRule:
         from_cidrs=tuple(d.get("fromCIDR") or ()) +
         tuple(c.get("cidr") for c in (d.get("fromCIDRSet") or ())
               if isinstance(c, dict) and c.get("cidr")),
+        icmps=_parse_icmps(d),
         to_ports=tuple(PortRule.from_dict(p) for p in (d.get("toPorts") or ())),
         deny=deny,
     )
@@ -69,6 +106,7 @@ def _parse_egress(d: Dict, deny: bool) -> EgressRule:
         ),
         to_services=tuple(_parse_service_selector(s)
                           for s in (d.get("toServices") or ())),
+        icmps=_parse_icmps(d),
         to_ports=tuple(PortRule.from_dict(p) for p in (d.get("toPorts") or ())),
         deny=deny,
     )

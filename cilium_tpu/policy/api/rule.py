@@ -94,18 +94,38 @@ _ENTITY_SELECTORS: Dict[str, EndpointSelector] = {
 
 
 @dataclasses.dataclass(frozen=True)
+class ICMPField:
+    """One ``icmps.fields`` member (reference: api.ICMPField) — an ICMP
+    type for a family. The datapath keys ICMP exactly like L4: the type
+    rides the key's port slot with the ICMP(v6) protocol number, so the
+    engines need no new machinery; flows carry the type in ``dport``."""
+
+    family: str = "IPv4"  # "IPv4" | "IPv6"
+    icmp_type: int = 0
+
+    @property
+    def protocol(self) -> Protocol:
+        return (Protocol.ICMPV6 if self.family == "IPv6"
+                else Protocol.ICMP)
+
+
+@dataclasses.dataclass(frozen=True)
 class IngressRule:
     from_endpoints: Tuple[EndpointSelector, ...] = ()
     from_entities: Tuple[str, ...] = ()
     from_cidrs: Tuple[str, ...] = ()
     to_ports: Tuple[PortRule, ...] = ()
+    icmps: Tuple[ICMPField, ...] = ()
     deny: bool = False
 
     def peer_selectors(self) -> Tuple[EndpointSelector, ...]:
         sels = list(self.from_endpoints)
         sels += [_ENTITY_SELECTORS[e] for e in self.from_entities]
-        if not sels:
-            # no peer constraint → wildcard peer
+        if not sels and not self.from_cidrs:
+            # no peer constraint AT ALL → wildcard peer. A CIDR-only
+            # rule must NOT wildcard: its peers are exactly the
+            # CIDR-derived identities (resolved in PolicyResolver) —
+            # wildcarding would silently drop the CIDR constraint.
             sels = [EndpointSelector()]
         return tuple(sels)
 
@@ -149,13 +169,15 @@ class EgressRule:
     to_fqdns: Tuple[FQDNSelector, ...] = ()
     to_services: Tuple[ServiceSelector, ...] = ()
     to_ports: Tuple[PortRule, ...] = ()
+    icmps: Tuple[ICMPField, ...] = ()
     deny: bool = False
 
     def peer_selectors(self) -> Tuple[EndpointSelector, ...]:
         sels = list(self.to_endpoints)
         sels += [_ENTITY_SELECTORS[e] for e in self.to_entities]
-        if not sels and not self.to_fqdns and not self.to_services:
-            sels = [EndpointSelector()]
+        if (not sels and not self.to_fqdns and not self.to_services
+                and not self.to_cidrs):  # see IngressRule: CIDR-only
+            sels = [EndpointSelector()]  # rules must not wildcard
         return tuple(sels)
 
 
@@ -180,6 +202,18 @@ class Rule:
         for direction, rules in (("ingress", self.ingress),
                                  ("egress", self.egress)):
             for r in rules:
+                if r.icmps and r.to_ports:
+                    # reference Rule.Sanitize: ICMPs cannot coexist
+                    # with ToPorts in the same rule
+                    raise SanitizeError(
+                        "icmps and toPorts are mutually exclusive")
+                for ic in r.icmps:
+                    if ic.family not in ("IPv4", "IPv6"):
+                        raise SanitizeError(
+                            f"bad ICMP family {ic.family!r}")
+                    if not (0 <= ic.icmp_type <= 255):
+                        raise SanitizeError(
+                            f"bad ICMP type {ic.icmp_type}")
                 for pr in r.to_ports:
                     for pp in pr.ports:
                         if not (0 <= pp.port <= 65535):

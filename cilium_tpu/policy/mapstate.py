@@ -49,6 +49,12 @@ class MapStateKey:
 
     def covers(self, identity: int, dport: int, proto: int,
                direction: int) -> bool:
+        if (self.proto == 0 and self.dport != PORT_WILDCARD
+                and proto in _ICMP_PROTOS):
+            # a proto-ANY port rule is an L4 (TCP/UDP/SCTP) construct
+            # (reference toPorts semantics); it must not match ICMP
+            # flows whose marked type happens to equal the port
+            return False
         return (
             self.direction == direction
             and self.identity in (IDENTITY_WILDCARD, identity)
@@ -113,6 +119,7 @@ class MapState:
         the verdict came from default enforcement. L7 is NOT evaluated
         here — callers check ``entry.is_redirect``.
         """
+        dport = effective_dport(dport, proto)
         covering = [
             (k, e) for k, e in self.entries.items()
             if k.covers(identity, dport, proto, direction)
@@ -134,6 +141,24 @@ class MapState:
 
     def __len__(self) -> int:
         return len(self.entries)
+
+
+#: ICMP type values live in the key's port slot OR'd with this bit:
+#: without it, ICMP type 0 (EchoReply) would key as dport 0 ==
+#: PORT_WILDCARD and an EchoReply-only allow would match ALL ICMP.
+#: Flow-side lookups apply the same bit for ICMP protocols (see
+#: :func:`effective_dport`). Proto-specific entries can't collide
+#: cross-protocol (keys include the protocol); proto-WILDCARD port
+#: entries could — `covers()` and the kernel therefore exclude ICMP
+#: flows from proto-ANY port matches (L4 semantics, as the reference).
+ICMP_TYPE_BIT = 1 << 15
+_ICMP_PROTOS = (int(Protocol.ICMP), int(Protocol.ICMPV6))
+
+
+def effective_dport(dport: int, proto: int) -> int:
+    """Flow-side key port: ICMP types get the marker bit (always, so
+    type 0 matches a type-0 rule entry and never the port wildcard)."""
+    return dport | ICMP_TYPE_BIT if proto in _ICMP_PROTOS else dport
 
 
 class PolicyResolver:
@@ -161,19 +186,20 @@ class PolicyResolver:
                 self._apply_direction(
                     ms, TrafficDirection.INGRESS, ir.peer_selectors(),
                     ir.to_ports, ir.deny, rule_id, ir.from_cidrs, (),
+                    icmps=ir.icmps,
                 )
             for er in rule.egress:
                 ms.egress_enforced = True
                 self._apply_direction(
                     ms, TrafficDirection.EGRESS, er.peer_selectors(),
                     er.to_ports, er.deny, rule_id, er.to_cidrs, er.to_fqdns,
-                    services=er.to_services,
+                    services=er.to_services, icmps=er.icmps,
                 )
         return ms
 
     def _apply_direction(
         self, ms: MapState, direction: int, peer_selectors, to_ports,
-        deny: bool, rule_id: str, cidrs, fqdns, services=(),
+        deny: bool, rule_id: str, cidrs, fqdns, services=(), icmps=(),
     ) -> None:
         peer_ids: Set[int] = set()
         wildcard_peer = False
@@ -206,7 +232,22 @@ class PolicyResolver:
                     contributions.append((PORT_WILDCARD, 0, l7))
                 for pp in pr.ports:
                     for port in pp.ports():
+                        # a toPorts entry under the ICMP protocol keys
+                        # like the icmps form (port slot carries the
+                        # marked type); PORT_WILDCARD stays a wildcard
+                        if port != PORT_WILDCARD:
+                            port = effective_dport(port,
+                                                   int(pp.protocol))
                         contributions.append((port, int(pp.protocol), l7))
+        elif icmps:
+            # ICMP keys as the datapath encodes them: the marked type
+            # in the port slot (one encoding, shared with the flow
+            # side) under the ICMP(v6) protocol
+            for ic in icmps:
+                contributions.append(
+                    (effective_dport(int(ic.icmp_type),
+                                     int(ic.protocol)),
+                     int(ic.protocol), None))
         else:
             contributions.append((PORT_WILDCARD, 0, None))
 

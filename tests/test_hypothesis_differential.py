@@ -103,9 +103,13 @@ def test_matchpattern_dfa_equals_oracle(globs, names):
 
 
 # --------------------------------------------------------------- mapstate --
+# entry ports include marker-bit ICMP keys (type|0x8000, as the
+# resolver writes for icmps rules) and the collision-prone raw 32768;
+# protos include ICMP(1)/ICMPv6(58) so the encoding semantics are
+# property-checked, not just unit-tested
 _IDS = [0, 100, 200, 300]          # 0 = wildcard peer
-_PORTS = [0, 53, 80]               # 0 = wildcard port
-_PROTOS = [0, 6, 17]               # 0 = wildcard proto
+_PORTS = [0, 53, 80, 32768, 0x8000 | 8]   # 0 = wildcard port
+_PROTOS = [0, 6, 17, 1, 58]        # 0 = wildcard proto
 
 _entry = st.tuples(
     st.sampled_from(_IDS),
@@ -122,8 +126,8 @@ _entry = st.tuples(
     flags=st.tuples(st.booleans(), st.booleans()),
     probes=st.lists(
         st.tuples(st.sampled_from([100, 200, 300, 999]),
-                  st.sampled_from([53, 80, 443]),
-                  st.sampled_from([6, 17]),
+                  st.sampled_from([0, 8, 53, 80, 443, 32768]),
+                  st.sampled_from([6, 17, 1, 58]),
                   st.sampled_from([TrafficDirection.INGRESS,
                                    TrafficDirection.EGRESS])),
         min_size=1, max_size=16),

@@ -1,0 +1,267 @@
+"""ICMP rules (reference: api.Rule.ICMPs / ICMPField): the type rides
+the key's port slot under the ICMP(v6) protocol, like the datapath."""
+
+import pytest
+
+from cilium_tpu.agent import Agent
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import Flow, Protocol, TrafficDirection
+from cilium_tpu.policy.api import SanitizeError
+from cilium_tpu.policy.api.cnp import load_cnp_yaml_text
+
+CNP = """
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: ping}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - fromEndpoints: [{matchLabels: {app: probe}}]
+    icmps:
+    - fields:
+      - {family: IPv4, type: 8}
+      - {family: IPv6, type: 128}
+"""
+
+
+def icmp_flow(src, dst, icmp_type, proto=Protocol.ICMP):
+    return Flow(src_identity=src, dst_identity=dst, dport=icmp_type,
+                protocol=proto, direction=TrafficDirection.INGRESS)
+
+
+@pytest.mark.parametrize("offload", [False, True])
+def test_icmp_type_matching(offload):
+    cfg = Config()
+    cfg.enable_tpu_offload = offload
+    cfg.configure_logging = False
+    agent = Agent(cfg).start()
+    try:
+        svc = agent.endpoint_add(1, {"app": "svc"})
+        probe = agent.endpoint_add(2, {"app": "probe"})
+        other = agent.endpoint_add(3, {"app": "other"})
+        agent.policy_add(load_cnp_yaml_text(CNP)[0])
+        out = agent.process_flows([
+            icmp_flow(probe.identity, svc.identity, 8),    # echo req
+            icmp_flow(probe.identity, svc.identity, 0),    # echo reply
+            icmp_flow(other.identity, svc.identity, 8),    # wrong peer
+            icmp_flow(probe.identity, svc.identity, 128,
+                      proto=Protocol.ICMPV6),              # v6 echo
+            # type 8 as a TCP port must NOT be confused with ICMP 8
+            Flow(src_identity=probe.identity, dst_identity=svc.identity,
+                 dport=8, protocol=Protocol.TCP,
+                 direction=TrafficDirection.INGRESS),
+        ])
+        assert [int(v) for v in out["verdict"]] == [1, 2, 2, 1, 2], \
+            offload
+    finally:
+        agent.stop()
+
+
+def _sanitize(yaml_text):
+    # sanitization runs at Repository.add (the reference sanitizes on
+    # PolicyAdd); exercise the same entry point
+    for cnp in load_cnp_yaml_text(yaml_text):
+        for rule in cnp.rules:
+            rule.sanitize()
+
+
+def test_icmps_and_toports_are_mutually_exclusive():
+    with pytest.raises(SanitizeError):
+        _sanitize("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: bad}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - icmps: [{fields: [{type: 8}]}]
+    toPorts: [{ports: [{port: "80", protocol: TCP}]}]
+""")
+
+
+def test_bad_icmp_fields_rejected():
+    with pytest.raises(SanitizeError):
+        _sanitize("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: bad2}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - icmps: [{fields: [{family: IPv9, type: 8}]}]
+""")
+    with pytest.raises(SanitizeError):
+        _sanitize("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: bad3}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - icmps: [{fields: [{type: 300}]}]
+""")
+
+
+@pytest.mark.parametrize("offload", [False, True])
+def test_icmp_type_zero_is_not_a_wildcard(offload):
+    """Regression: EchoReply (type 0) rides the port slot — without
+    the marker bit it would key as PORT_WILDCARD and an EchoReply-only
+    allow would match every ICMP type."""
+    cfg = Config()
+    cfg.enable_tpu_offload = offload
+    cfg.configure_logging = False
+    agent = Agent(cfg).start()
+    try:
+        svc = agent.endpoint_add(1, {"app": "svc"})
+        probe = agent.endpoint_add(2, {"app": "probe"})
+        agent.policy_add(load_cnp_yaml_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: reply-only}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - fromEndpoints: [{matchLabels: {app: probe}}]
+    icmps: [{fields: [{type: 0}]}]
+""")[0])
+        out = agent.process_flows([
+            icmp_flow(probe.identity, svc.identity, 0),   # EchoReply
+            icmp_flow(probe.identity, svc.identity, 8),   # EchoRequest
+            icmp_flow(probe.identity, svc.identity, 3),
+        ])
+        assert [int(v) for v in out["verdict"]] == [1, 2, 2], offload
+    finally:
+        agent.stop()
+
+
+def test_named_icmp_types_parse():
+    cnp = load_cnp_yaml_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: named}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - icmps:
+    - fields:
+      - {family: IPv4, type: EchoRequest}
+      - {family: IPv6, type: EchoReply}
+""")[0]
+    fields = cnp.rules[0].ingress[0].icmps
+    assert [(f.family, f.icmp_type) for f in fields] == [
+        ("IPv4", 8), ("IPv6", 129)]
+    with pytest.raises(SanitizeError):
+        load_cnp_yaml_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: badname}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - icmps: [{fields: [{type: NoSuchType}]}]
+""")
+
+
+def test_cidr_only_rule_does_not_wildcard_peer():
+    """Regression: a fromCIDR-only rule's peers are exactly the
+    CIDR-derived identities — peer_selectors() wildcarding would
+    silently drop the CIDR constraint (allow from ANY identity)."""
+    cfg = Config()
+    cfg.configure_logging = False
+    agent = Agent(cfg).start()
+    try:
+        svc = agent.endpoint_add(1, {"app": "svc"})
+        other = agent.endpoint_add(2, {"app": "other"})
+        # register a CIDR identity the way the ipcache does
+        cidr_id = agent.ipcache.upsert("192.0.2.0/24", None)
+        agent.policy_add(load_cnp_yaml_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: cidr-only}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - fromCIDR: ["192.0.2.0/24"]
+""")[0])
+        flows = [
+            Flow(src_identity=other.identity, dst_identity=svc.identity,
+                 dport=80, direction=TrafficDirection.INGRESS),
+        ]
+        if cidr_id is not None:
+            flows.append(Flow(src_identity=int(cidr_id),
+                              dst_identity=svc.identity, dport=80,
+                              direction=TrafficDirection.INGRESS))
+        out = agent.process_flows(flows)
+        verdicts = [int(v) for v in out["verdict"]]
+        assert verdicts[0] == 2, "in-cluster peer must NOT be allowed"
+        if cidr_id is not None:
+            assert verdicts[1] == 1, "CIDR identity must be allowed"
+    finally:
+        agent.stop()
+
+
+@pytest.mark.parametrize("offload", [False, True])
+def test_proto_any_port_rule_does_not_match_icmp(offload):
+    """Regression: a proto-ANY toPorts rule at port 32768 is an L4
+    construct; an ICMP EchoReply (marked type 0 == 32768 in the key's
+    port slot) must not match it."""
+    cfg = Config()
+    cfg.enable_tpu_offload = offload
+    cfg.configure_logging = False
+    agent = Agent(cfg).start()
+    try:
+        svc = agent.endpoint_add(1, {"app": "svc"})
+        probe = agent.endpoint_add(2, {"app": "probe"})
+        agent.policy_add(load_cnp_yaml_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: l4-any}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - fromEndpoints: [{matchLabels: {app: probe}}]
+    toPorts: [{ports: [{port: "32768", protocol: ANY}]}]
+""")[0])
+        out = agent.process_flows([
+            Flow(src_identity=probe.identity, dst_identity=svc.identity,
+                 dport=32768, protocol=Protocol.TCP,
+                 direction=TrafficDirection.INGRESS),
+            Flow(src_identity=probe.identity, dst_identity=svc.identity,
+                 dport=32768, protocol=Protocol.UDP,
+                 direction=TrafficDirection.INGRESS),
+            icmp_flow(probe.identity, svc.identity, 0),  # EchoReply
+        ])
+        assert [int(v) for v in out["verdict"]] == [1, 1, 2], offload
+    finally:
+        agent.stop()
+
+
+def test_egress_icmp_deny():
+    cfg = Config()
+    cfg.configure_logging = False
+    agent = Agent(cfg).start()
+    try:
+        svc = agent.endpoint_add(1, {"app": "svc"})
+        peer = agent.endpoint_add(2, {"app": "peer"})
+        agent.policy_add(load_cnp_yaml_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: no-ping-out}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  egress:
+  - toEndpoints: [{matchLabels: {}}]
+  egressDeny:
+  - icmps: [{fields: [{type: 8}]}]
+""")[0])
+        out = agent.process_flows([
+            Flow(src_identity=svc.identity, dst_identity=peer.identity,
+                 dport=8, protocol=Protocol.ICMP,
+                 direction=TrafficDirection.EGRESS),
+            Flow(src_identity=svc.identity, dst_identity=peer.identity,
+                 dport=80, protocol=Protocol.TCP,
+                 direction=TrafficDirection.EGRESS),
+        ])
+        assert [int(v) for v in out["verdict"]] == [2, 1]
+    finally:
+        agent.stop()
