@@ -47,6 +47,7 @@ class PackedMapState:
     key_w2: np.ndarray      # [N] int32 dir|proto|port
     is_deny: np.ndarray     # [N] bool
     ruleset_id: np.ndarray  # [N] int32, -1 = no L7 restriction
+    auth: np.ndarray        # [N] bool — entry demands mutual auth
     # per-endpoint-identity enforcement: sorted ids + 2-bit flags
     enf_ids: np.ndarray     # [M] int32 sorted endpoint identities
     enf_flags: np.ndarray   # [M, 2] bool (ingress, egress)
@@ -70,7 +71,7 @@ def pack_mapstate(
     rule set to a global ruleset id (assigned by the loader); None or a
     return of -1 means no L7 restriction.
     """
-    rows: List[Tuple[int, int, int, bool, int]] = []
+    rows: List[Tuple[int, int, int, bool, int, bool]] = []
     enf: List[Tuple[int, bool, bool]] = []
     for ep_id, ms in sorted(per_identity.items()):
         enf.append((ep_id, ms.ingress_enforced, ms.egress_enforced))
@@ -84,15 +85,17 @@ def pack_mapstate(
                 _pack_w2(key.direction, key.proto, key.dport),
                 entry.is_deny,
                 rid,
+                getattr(entry, "auth_required", False),
             ))
     if not rows:
         # sentinel row that can never match (identity -1)
-        rows.append((-1, -1, -1, False, -1))
+        rows.append((-1, -1, -1, False, -1, False))
     arr = np.array([r[:3] for r in rows], dtype=np.int64)
     order = np.lexsort((arr[:, 2], arr[:, 1], arr[:, 0]))
     arr = arr[order]
     deny = np.array([rows[i][3] for i in order], dtype=bool)
     rid = np.array([rows[i][4] for i in order], dtype=np.int32)
+    auth = np.array([rows[i][5] for i in order], dtype=bool)
     if not enf:
         enf.append((-1, False, False))
     enf.sort()
@@ -102,6 +105,7 @@ def pack_mapstate(
         key_w2=arr[:, 2].astype(np.int32),
         is_deny=deny,
         ruleset_id=rid,
+        auth=auth,
         enf_ids=np.array([e[0] for e in enf], dtype=np.int32),
         enf_flags=np.array([[e[1], e[2]] for e in enf], dtype=bool),
     )
@@ -128,13 +132,15 @@ def mapstate_lookup(
     dports: jax.Array,      # [B]
     protos: jax.Array,      # [B]
     directions: jax.Array,  # [B]
+    auth: jax.Array = None,  # [N] bool entry auth flags (optional)
 ) -> Dict[str, jax.Array]:
     """Batched verdict lookup. Returns dict with:
     ``allowed`` [B] bool (L3/L4 verdict, pre-L7),
     ``denied`` [B] bool (explicit deny hit),
     ``redirect`` [B] bool (L7 evaluation required),
     ``ruleset`` [B] int32 (winning entry's ruleset id, -1 if none),
-    ``match_spec`` [B] int32 (specificity of winning entry, -1 default).
+    ``match_spec`` [B] int32 (specificity of winning entry, -1 default),
+    ``auth_required`` [B] bool (winning allow demands mutual auth).
     """
     from cilium_tpu.policy.mapstate import ICMP_TYPE_BIT
 
@@ -196,10 +202,15 @@ def mapstate_lookup(
 
     allowed = ~denied & (any_allow | ~enforced)
     redirect = allowed & any_allow & (ruleset >= 0)
+    if auth is None:
+        auth_required = jnp.zeros_like(allowed)
+    else:
+        auth_required = allowed & any_allow & auth[win_idx]
     return {
         "allowed": allowed,
         "denied": denied,
         "redirect": redirect,
         "ruleset": ruleset,
         "match_spec": match_spec,
+        "auth_required": auth_required,
     }

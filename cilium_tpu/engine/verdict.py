@@ -367,6 +367,7 @@ class CompiledPolicy:
             "ms_key_w2": packed.key_w2,
             "ms_deny": packed.is_deny,
             "ms_ruleset": packed.ruleset_id,
+            "ms_auth": packed.auth,
             "ms_enf_ids": packed.enf_ids,
             "ms_enf_flags": packed.enf_flags,
             "rs_http_mask": _masks_to_array(http_members or [[]],
@@ -653,6 +654,7 @@ def verdict_step(arrays: Dict[str, jax.Array], batch: Dict[str, jax.Array]
         arrays["ms_enf_ids"], arrays["ms_enf_flags"],
         batch["ep_ids"], batch["peer_ids"], batch["dports"],
         batch["protos"], batch["directions"],
+        auth=arrays.get("ms_auth"),
     )
     ruleset = jnp.clip(ms["ruleset"], 0, arrays["rs_http_mask"].shape[0] - 1)
     l7t = batch["l7_types"]
@@ -751,6 +753,7 @@ def verdict_step(arrays: Dict[str, jax.Array], batch: Dict[str, jax.Array]
         "l7_ok": l7_ok,
         "match_spec": ms["match_spec"],
         "ruleset": ms["ruleset"],
+        "auth_required": ms["auth_required"],
     }
 
 

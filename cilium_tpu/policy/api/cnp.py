@@ -83,6 +83,7 @@ def _parse_ingress(d: Dict, deny: bool) -> IngressRule:
         tuple(c.get("cidr") for c in (d.get("fromCIDRSet") or ())
               if isinstance(c, dict) and c.get("cidr")),
         icmps=_parse_icmps(d),
+        auth_mode=(d.get("authentication") or {}).get("mode", "") or "",
         to_ports=tuple(PortRule.from_dict(p) for p in (d.get("toPorts") or ())),
         deny=deny,
     )
@@ -107,6 +108,7 @@ def _parse_egress(d: Dict, deny: bool) -> EgressRule:
         to_services=tuple(_parse_service_selector(s)
                           for s in (d.get("toServices") or ())),
         icmps=_parse_icmps(d),
+        auth_mode=(d.get("authentication") or {}).get("mode", "") or "",
         to_ports=tuple(PortRule.from_dict(p) for p in (d.get("toPorts") or ())),
         deny=deny,
     )

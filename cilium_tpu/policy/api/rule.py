@@ -116,6 +116,10 @@ class IngressRule:
     from_cidrs: Tuple[str, ...] = ()
     to_ports: Tuple[PortRule, ...] = ()
     icmps: Tuple[ICMPField, ...] = ()
+    #: api.Rule Authentication.Mode: "" (unset) | "required" |
+    #: "disabled"; "required" marks matching entries auth_required —
+    #: the datapath lane the mutual-auth subsystem keys on
+    auth_mode: str = ""
     deny: bool = False
 
     def peer_selectors(self) -> Tuple[EndpointSelector, ...]:
@@ -170,6 +174,7 @@ class EgressRule:
     to_services: Tuple[ServiceSelector, ...] = ()
     to_ports: Tuple[PortRule, ...] = ()
     icmps: Tuple[ICMPField, ...] = ()
+    auth_mode: str = ""  # see IngressRule.auth_mode
     deny: bool = False
 
     def peer_selectors(self) -> Tuple[EndpointSelector, ...]:
@@ -207,6 +212,12 @@ class Rule:
                     # with ToPorts in the same rule
                     raise SanitizeError(
                         "icmps and toPorts are mutually exclusive")
+                if r.auth_mode not in ("", "required", "disabled"):
+                    raise SanitizeError(
+                        f"bad authentication mode {r.auth_mode!r}")
+                if r.auth_mode and r.deny:
+                    raise SanitizeError(
+                        "authentication not allowed on deny rules")
                 for ic in r.icmps:
                     if ic.family not in ("IPv4", "IPv6"):
                         raise SanitizeError(

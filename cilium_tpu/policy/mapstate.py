@@ -78,6 +78,16 @@ class MapStateEntry:
     l7_rules: Tuple[L7Rules, ...] = ()
     #: True if some contributing allow had no L7 restriction
     l7_wildcard: bool = False
+    #: the entry's AuthType slot (SURVEY §2.1): a contributing rule
+    #: with authentication mode "required" marks matching traffic for
+    #: the mutual-auth subsystem (surfaced as the engine's
+    #: ``auth_required`` output lane)
+    auth_required: bool = False
+    #: True when a contributing rule set an explicit mode (required OR
+    #: disabled) — explicit beats derived-from-covering-entries, which
+    #: is how mode "disabled" overrides a broader required (the
+    #: reference's authPreferredInsert precedence)
+    auth_explicit: bool = False
     derived_from: Tuple[str, ...] = ()
 
     @property
@@ -87,6 +97,14 @@ class MapStateEntry:
     def merge(self, other: "MapStateEntry") -> None:
         self.is_deny = self.is_deny or other.is_deny
         self.l7_wildcard = self.l7_wildcard or other.l7_wildcard
+        # auth precedence on one key: explicit beats implicit; between
+        # explicit contributors, required beats disabled (never
+        # silently waive a handshake)
+        if other.auth_explicit and not self.auth_explicit:
+            self.auth_required = other.auth_required
+        elif other.auth_explicit and self.auth_explicit:
+            self.auth_required = self.auth_required or other.auth_required
+        self.auth_explicit = self.auth_explicit or other.auth_explicit
         for lr in other.l7_rules:
             if lr not in self.l7_rules:
                 self.l7_rules = self.l7_rules + (lr,)
@@ -186,7 +204,7 @@ class PolicyResolver:
                 self._apply_direction(
                     ms, TrafficDirection.INGRESS, ir.peer_selectors(),
                     ir.to_ports, ir.deny, rule_id, ir.from_cidrs, (),
-                    icmps=ir.icmps,
+                    icmps=ir.icmps, auth=ir.auth_mode,
                 )
             for er in rule.egress:
                 ms.egress_enforced = True
@@ -194,12 +212,37 @@ class PolicyResolver:
                     ms, TrafficDirection.EGRESS, er.peer_selectors(),
                     er.to_ports, er.deny, rule_id, er.to_cidrs, er.to_fqdns,
                     services=er.to_services, icmps=er.icmps,
+                    auth=er.auth_mode,
                 )
+        self._propagate_auth(ms)
         return ms
+
+    @staticmethod
+    def _propagate_auth(ms: MapState) -> None:
+        """authPreferredInsert (reference mapstate): a more-specific
+        allow entry inherits auth_required from any covering allow
+        entry that demands it, UNLESS an explicit mode was set on the
+        narrow entry (that's how ``disabled`` carves an exception out
+        of a broad ``required``). Without this, adding a narrower allow
+        would silently waive the handshake for exactly the traffic the
+        broad auth rule covers."""
+        demanding = [(k, e) for k, e in ms.entries.items()
+                     if e.auth_required and not e.is_deny]
+        if not demanding:
+            return
+        for key, entry in ms.entries.items():
+            if entry.is_deny or entry.auth_explicit or entry.auth_required:
+                continue
+            for ck, _ in demanding:
+                if ck != key and ck.covers(key.identity, key.dport,
+                                           key.proto, key.direction):
+                    entry.auth_required = True
+                    break
 
     def _apply_direction(
         self, ms: MapState, direction: int, peer_selectors, to_ports,
         deny: bool, rule_id: str, cidrs, fqdns, services=(), icmps=(),
+        auth: str = "",
     ) -> None:
         peer_ids: Set[int] = set()
         wildcard_peer = False
@@ -257,6 +300,8 @@ class PolicyResolver:
                     is_deny=deny,
                     l7_rules=(l7,) if (l7 and not deny) else (),
                     l7_wildcard=(l7 is None) and not deny,
+                    auth_required=(auth == "required") and not deny,
+                    auth_explicit=bool(auth) and not deny,
                     derived_from=(rule_id,),
                 )
                 ms.insert(

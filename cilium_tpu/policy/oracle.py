@@ -141,29 +141,40 @@ class OracleVerdictEngine:
     def __init__(self, per_identity: Dict[int, MapState]):
         self.per_identity = per_identity
 
-    def verdict_one(self, flow: Flow) -> Verdict:
+    def _decide(self, flow: Flow):
+        """One lookup → (verdict, winning_entry, allowed)."""
         ingress = flow.direction == TrafficDirection.INGRESS
         ep_id = flow.dst_identity if ingress else flow.src_identity
         peer_id = flow.src_identity if ingress else flow.dst_identity
         ms = self.per_identity.get(ep_id)
         if ms is None:
-            return Verdict.FORWARDED  # no policy for endpoint → allow
+            return Verdict.FORWARDED, None, True  # no policy → allow
         allowed, entry = ms.lookup(
             peer_id, flow.dport, int(flow.protocol), int(flow.direction))
         if not allowed:
-            return Verdict.DROPPED
+            return Verdict.DROPPED, entry, False
         if entry is not None and entry.is_redirect:
             if l7_allowed(entry.l7_rules, flow):
-                return Verdict.REDIRECTED
-            return Verdict.DROPPED
-        return Verdict.FORWARDED
+                return Verdict.REDIRECTED, entry, True
+            return Verdict.DROPPED, entry, True
+        return Verdict.FORWARDED, entry, True
+
+    def verdict_one(self, flow: Flow) -> Verdict:
+        return self._decide(flow)[0]
 
     def verdict_flows(self, flows: Sequence[Flow]):
         import numpy as np
 
+        verdicts = []
+        auth = []
+        for f in flows:
+            verdict, entry, allowed = self._decide(f)
+            verdicts.append(int(verdict))
+            auth.append(bool(allowed and entry is not None
+                             and entry.auth_required))
         return {
-            "verdict": np.array([int(self.verdict_one(f)) for f in flows],
-                                dtype=np.int32)
+            "verdict": np.array(verdicts, dtype=np.int32),
+            "auth_required": np.array(auth, dtype=bool),
         }
 
     def verdict_records(self, rec):

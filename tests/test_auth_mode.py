@@ -1,0 +1,158 @@
+"""Authentication mode (api.Rule Authentication → MapStateEntry
+AuthType slot, SURVEY §2.1): rules with mode "required" surface the
+auth_required output lane — the mutual-auth subsystem's datapath hook.
+"""
+
+import pytest
+
+from cilium_tpu.agent import Agent
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import Flow, TrafficDirection
+from cilium_tpu.policy.api import SanitizeError
+from cilium_tpu.policy.api.cnp import load_cnp_yaml_text
+
+CNP = """
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: mtls}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - fromEndpoints: [{matchLabels: {app: peer}}]
+    authentication: {mode: required}
+    toPorts: [{ports: [{port: "443", protocol: TCP}]}]
+  - fromEndpoints: [{matchLabels: {app: open}}]
+    toPorts: [{ports: [{port: "80", protocol: TCP}]}]
+"""
+
+
+@pytest.mark.parametrize("offload", [False, True])
+def test_auth_required_lane(offload):
+    cfg = Config()
+    cfg.enable_tpu_offload = offload
+    cfg.configure_logging = False
+    agent = Agent(cfg).start()
+    try:
+        svc = agent.endpoint_add(1, {"app": "svc"})
+        peer = agent.endpoint_add(2, {"app": "peer"})
+        open_ep = agent.endpoint_add(3, {"app": "open"})
+        agent.policy_add(load_cnp_yaml_text(CNP)[0])
+
+        def f(src, dport):
+            return Flow(src_identity=src, dst_identity=svc.identity,
+                        dport=dport,
+                        direction=TrafficDirection.INGRESS)
+
+        out = agent.loader.engine.verdict_flows([
+            f(peer.identity, 443),      # allowed, auth demanded
+            f(open_ep.identity, 80),    # allowed, no auth
+            f(peer.identity, 80),       # dropped (no rule)
+        ])
+        assert [int(v) for v in out["verdict"]] == [1, 1, 2], offload
+        assert [bool(a) for a in out["auth_required"]] == \
+            [True, False, False], offload
+    finally:
+        agent.stop()
+
+
+def test_auth_sanitize():
+    def _sanitize(text):
+        for cnp in load_cnp_yaml_text(text):
+            for rule in cnp.rules:
+                rule.sanitize()
+
+    with pytest.raises(SanitizeError):
+        _sanitize("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: badmode}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - authentication: {mode: sometimes}
+""")
+    with pytest.raises(SanitizeError):
+        _sanitize("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: authdeny}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingressDeny:
+  - authentication: {mode: required}
+    fromEndpoints: [{matchLabels: {app: x}}]
+""")
+
+
+@pytest.mark.parametrize("offload", [False, True])
+def test_auth_propagates_to_more_specific_entries(offload):
+    """authPreferredInsert: a narrower allow within a broad
+    required-auth rule's coverage inherits the auth demand — unless it
+    explicitly disables it."""
+    cfg = Config()
+    cfg.enable_tpu_offload = offload
+    cfg.configure_logging = False
+    agent = Agent(cfg).start()
+    try:
+        svc = agent.endpoint_add(1, {"app": "svc"})
+        peer = agent.endpoint_add(2, {"app": "peer"})
+        agent.policy_add(load_cnp_yaml_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: broad-auth}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - fromEndpoints: [{matchLabels: {app: peer}}]
+    authentication: {mode: required}
+  - fromEndpoints: [{matchLabels: {app: peer}}]
+    toPorts: [{ports: [{port: "443", protocol: TCP}]}]
+  - fromEndpoints: [{matchLabels: {app: peer}}]
+    authentication: {mode: disabled}
+    toPorts: [{ports: [{port: "8080", protocol: TCP}]}]
+""")[0])
+
+        def f(dport):
+            return Flow(src_identity=peer.identity,
+                        dst_identity=svc.identity, dport=dport,
+                        direction=TrafficDirection.INGRESS)
+
+        out = agent.loader.engine.verdict_flows([f(443), f(8080), f(22)])
+        assert [int(v) for v in out["verdict"]] == [1, 1, 1], offload
+        # 443: narrower allow inherits the broad required-auth;
+        # 8080: explicit disabled carves the exception;
+        # 22: the broad (required) entry itself wins
+        assert [bool(a) for a in out["auth_required"]] == \
+            [True, False, True], offload
+    finally:
+        agent.stop()
+
+
+def test_auth_survives_entry_merge():
+    """Two rules landing on the same key: if either demands auth, the
+    merged entry demands it (never silently waive a handshake)."""
+    cfg = Config()
+    cfg.configure_logging = False
+    agent = Agent(cfg).start()
+    try:
+        svc = agent.endpoint_add(1, {"app": "svc"})
+        peer = agent.endpoint_add(2, {"app": "peer"})
+        agent.policy_add(load_cnp_yaml_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: merged}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - fromEndpoints: [{matchLabels: {app: peer}}]
+    toPorts: [{ports: [{port: "443", protocol: TCP}]}]
+  - fromEndpoints: [{matchLabels: {app: peer}}]
+    authentication: {mode: required}
+    toPorts: [{ports: [{port: "443", protocol: TCP}]}]
+""")[0])
+        out = agent.loader.engine.verdict_flows([
+            Flow(src_identity=peer.identity, dst_identity=svc.identity,
+                 dport=443, direction=TrafficDirection.INGRESS)])
+        assert bool(out["auth_required"][0]) is True
+    finally:
+        agent.stop()

@@ -117,6 +117,7 @@ _entry = st.tuples(
     st.sampled_from(_PROTOS),
     st.sampled_from([TrafficDirection.INGRESS, TrafficDirection.EGRESS]),
     st.booleans(),                 # is_deny
+    st.booleans(),                 # auth_required
 )
 
 
@@ -135,9 +136,10 @@ _entry = st.tuples(
 def test_mapstate_kernel_equals_golden(entries, flags, probes):
     ms = MapState()
     ms.ingress_enforced, ms.egress_enforced = flags
-    for peer, port, proto, direction, deny in entries:
+    for peer, port, proto, direction, deny, auth in entries:
         ms.insert(MapStateKey(peer, port, proto, int(direction)),
-                  MapStateEntry(is_deny=deny))
+                  MapStateEntry(is_deny=deny,
+                                auth_required=auth and not deny))
     per_identity = {7: ms}
     packed = pack_mapstate(per_identity)
 
@@ -153,12 +155,20 @@ def test_mapstate_kernel_equals_golden(entries, flags, probes):
         jnp.asarray([p[0] for p in probes], dtype=jnp.int32),
         jnp.asarray([p[1] for p in probes], dtype=jnp.int32),
         jnp.asarray([p[2] for p in probes], dtype=jnp.int32),
-        jnp.asarray([int(p[3]) for p in probes], dtype=jnp.int32))
+        jnp.asarray([int(p[3]) for p in probes], dtype=jnp.int32),
+        auth=jnp.asarray(packed.auth))
     got = np.asarray(out["allowed"])
+    got_auth = np.asarray(out["auth_required"])
 
     for i, (pid, pport, pproto, pdir) in enumerate(probes):
-        want = ms.lookup(pid, pport, pproto, int(pdir))[0]
+        want, entry = ms.lookup(pid, pport, pproto, int(pdir))
         assert bool(got[i]) == bool(want), (
             f"probe {(pid, pport, pproto, pdir)}: kernel "
             f"{bool(got[i])} != golden {want} over {entries} "
             f"flags={flags}")
+        want_auth = bool(want and entry is not None
+                         and entry.auth_required)
+        assert bool(got_auth[i]) == want_auth, (
+            f"auth lane probe {(pid, pport, pproto, pdir)}: kernel "
+            f"{bool(got_auth[i])} != golden {want_auth} over "
+            f"{entries} flags={flags}")
