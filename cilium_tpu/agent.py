@@ -15,6 +15,7 @@ import os
 import threading
 from typing import Dict, List, Optional
 
+from cilium_tpu.auth import AuthManager
 from cilium_tpu.clustermesh import ClusterMesh, LocalStatePublisher
 from cilium_tpu.core.config import Config
 from cilium_tpu.core.identity import IdentityAllocator
@@ -113,6 +114,9 @@ class Agent:
         # pod_cidr stands in so construction stays non-blocking.
         self.ipam = NodeAllocator(self.config.pod_cidr)
         self.node_registration = None
+        # mutual-auth state: pairs that completed a handshake; entries
+        # demanding auth DROP until their pair lands here (§2.1 AuthType)
+        self.auth = AuthManager()
         self.controllers = ControllerManager()
         self.service: Optional[VerdictService] = None
         self.socket_path = socket_path
@@ -252,6 +256,7 @@ class Agent:
                 upstream=self.dns_upstream,
                 bind=self.dns_proxy_bind).start()
         self.controllers.update("dns-gc", self._dns_gc, interval=60.0)
+        self.controllers.update("auth-gc", self.auth.expire, interval=60.0)
         self.controllers.update("clustermesh-heartbeat",
                                 self.publisher.heartbeat, interval=15.0)
         self.controllers.update("health-probe", self.health.probe_all,
@@ -464,8 +469,11 @@ class Agent:
                 "no policy staged — add an endpoint or policy first")
         # one device→host readback, shared by monitor + annotate
         # (readbacks are the expensive sync point, docs/PLATFORM.md)
-        outputs = {k: np.asarray(v)
-                   for k, v in engine.verdict_flows(flows).items()}
+        outputs = {
+            k: np.asarray(v)
+            for k, v in engine.verdict_flows(
+                flows, authed_pairs=self.auth.pairs_array()).items()
+        }
         self.monitor.notify_batch(flows, outputs)
         annotate_flows(flows, outputs)
         self.observer.observe(flows)

@@ -1,0 +1,104 @@
+"""Mutual-auth state: which identity pairs have completed a handshake.
+
+Reference: the auth map + ``pkg/auth`` (SURVEY §2.1's AuthType slot is
+the demand side; this is the supply side) — traffic whose winning
+policy entry demands authentication DROPS until the pair
+(src identity, dst identity) appears here, with expiration like the
+datapath's auth map entries. The agent stages the pair set as a sorted
+tensor next to the policy (same discipline as rule tensors: host
+mutates, device consumes a snapshot).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from cilium_tpu.runtime.metrics import METRICS
+
+#: padding sentinel GREATER than any real identity (identities are
+#: non-negative int32): sentinel rows at the tail keep the padded
+#: table lexicographically sorted. Two int32 words, not one packed
+#: int64 — jax runs with x64 disabled, where an int64 shift would
+#: silently truncate.
+PAIR_SENTINEL = np.iinfo(np.int32).max
+
+
+class AuthManager:
+    """Authed (src, dst) identity pairs with expiry."""
+
+    def __init__(self, default_ttl: float = 3600.0):
+        self.default_ttl = default_ttl
+        self._lock = threading.Lock()
+        self._pairs: Dict[Tuple[int, int], float] = {}  # pair → expiry
+        self._version = 0
+        self._cached: Optional[Tuple[int, np.ndarray]] = None
+
+    def authenticate(self, src_identity: int, dst_identity: int,
+                     ttl: Optional[float] = None) -> None:
+        """Record a completed handshake (the reference's auth map
+        upsert after the auth service signs off)."""
+        expiry = time.time() + (self.default_ttl if ttl is None else ttl)
+        with self._lock:
+            self._pairs[(int(src_identity), int(dst_identity))] = expiry
+            self._version += 1
+            METRICS.set_gauge("cilium_tpu_auth_pairs",
+                              float(len(self._pairs)))
+
+    def revoke(self, src_identity: int, dst_identity: int) -> bool:
+        with self._lock:
+            hit = self._pairs.pop((int(src_identity),
+                                   int(dst_identity)), None)
+            if hit is not None:
+                self._version += 1
+            METRICS.set_gauge("cilium_tpu_auth_pairs",
+                              float(len(self._pairs)))
+        return hit is not None
+
+    def expire(self) -> int:
+        """GC lapsed entries (controller duty). Returns count removed."""
+        now = time.time()
+        with self._lock:
+            dead = [p for p, exp in self._pairs.items() if exp <= now]
+            for p in dead:
+                del self._pairs[p]
+            if dead:
+                self._version += 1
+            METRICS.set_gauge("cilium_tpu_auth_pairs",
+                              float(len(self._pairs)))
+        return len(dead)
+
+    def is_authed(self, src_identity: int, dst_identity: int) -> bool:
+        with self._lock:
+            exp = self._pairs.get((int(src_identity), int(dst_identity)))
+        return exp is not None and exp > time.time()
+
+    def pairs(self) -> Dict[Tuple[int, int], float]:
+        with self._lock:
+            return dict(self._pairs)
+
+    def pairs_array(self) -> np.ndarray:
+        """Live pairs as a lexicographically sorted [P, 2] int32 table
+        (src, dst columns), padded to the next power of two with
+        sentinel rows so jit sees few distinct shapes. Cached behind a
+        version counter: the hot path pays a dict lookup, not a
+        rebuild, when auth state hasn't changed. Lapsed-but-not-GC'd
+        entries may appear until ``expire()`` runs; callers needing
+        exact TTL edges (tests) call expire() first."""
+        with self._lock:
+            if self._cached is not None and self._cached[0] == self._version:
+                return self._cached[1]
+            now = time.time()
+            live = sorted((s, d) for (s, d), exp in self._pairs.items()
+                          if exp > now)
+            size = 8
+            while size < len(live):
+                size *= 2
+            out = np.full((size, 2), PAIR_SENTINEL, dtype=np.int32)
+            for i, (s, d) in enumerate(live):
+                out[i] = (s, d)
+            self._cached = (self._version, out)
+            return out

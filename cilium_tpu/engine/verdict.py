@@ -40,6 +40,7 @@ from cilium_tpu.policy.compiler import matchpattern
 from cilium_tpu.policy.compiler.dfa import BankedDFA, DFABank, compile_patterns
 from cilium_tpu.policy.mapstate import MapState
 from cilium_tpu.engine.dfa_kernel import dfa_scan_banked
+from cilium_tpu.engine.search import lower_bound
 from cilium_tpu.engine.mapstate_kernel import PackedMapState, pack_mapstate, mapstate_lookup
 
 
@@ -631,6 +632,8 @@ def unpack_batch(packed: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
     for name in ("path", "method", "host", "headers", "qname"):
         out[f"{name}_data"] = packed[f"{name}_data"]
     out["gen_pairs"] = packed["gen_pairs"]
+    if "auth_pairs" in packed:  # staged auth table rides alongside
+        out["auth_pairs"] = packed["auth_pairs"]
     return out
 
 
@@ -739,6 +742,19 @@ def verdict_step(arrays: Dict[str, jax.Array], batch: Dict[str, jax.Array]
     l7_ok = http_ok | kafka_ok | dns_ok | gen_ok
 
     allowed = ms["allowed"] & (l7_ok | ~ms["redirect"])
+    auth_required = ms["auth_required"]
+    if "auth_pairs" in batch:  # static key check: enforcement staged
+        # drop-until-authed (the reference's auth map): a winning allow
+        # that demands auth forwards only if (src, dst) completed the
+        # handshake. Pairs ride a lex-sorted [P, 2] int32 table
+        # (two words, not a packed int64 — x64 is disabled under jax);
+        # flows rebuild (src, dst) from (ep, peer) by direction.
+        ingress = batch["directions"] == int(TrafficDirection.INGRESS)
+        src = jnp.where(ingress, batch["peer_ids"], batch["ep_ids"])
+        dst = jnp.where(ingress, batch["ep_ids"], batch["peer_ids"])
+        pairs = batch["auth_pairs"]
+        _, authed = lower_bound((pairs[:, 0], pairs[:, 1]), (src, dst))
+        allowed = allowed & (~auth_required | authed)
     verdict = jnp.where(
         allowed,
         jnp.where(ms["redirect"], int(Verdict.REDIRECTED),
@@ -787,10 +803,24 @@ class VerdictEngine:
     def verdict_batch_arrays(self, batch: Dict[str, jax.Array]):
         return self._step(self._arrays, batch)
 
+    @property
+    def needs_auth(self) -> bool:
+        """True when some staged entry demands authentication — when
+        False, callers can skip staging the authed-pairs table."""
+        return bool(np.any(self.policy.arrays["ms_auth"]))
+
     def verdict_flows(self, flows: Sequence[Flow],
-                      cfg: Optional[EngineConfig] = None):
+                      cfg: Optional[EngineConfig] = None,
+                      authed_pairs: Optional[np.ndarray] = None):
+        """``authed_pairs`` (lex-sorted [P, 2] int32 (src, dst) table,
+        AuthManager.pairs_array): enables drop-until-authed enforcement
+        for entries demanding authentication; None leaves the demand as
+        an output lane only."""
         fb = encode_flows(flows, self.policy.kafka_interns, cfg)
         batch = flowbatch_to_device(fb, self.device)
+        if authed_pairs is not None and self.needs_auth:
+            batch["auth_pairs"] = jax.device_put(authed_pairs,
+                                                 self.device)
         out = self.verdict_batch_arrays(batch)
         return {k: np.asarray(v) for k, v in out.items()}
 

@@ -162,16 +162,28 @@ class OracleVerdictEngine:
     def verdict_one(self, flow: Flow) -> Verdict:
         return self._decide(flow)[0]
 
-    def verdict_flows(self, flows: Sequence[Flow]):
+    def verdict_flows(self, flows: Sequence[Flow], authed_pairs=None):
+        """``authed_pairs``: lex-sorted [P, 2] int32 (src, dst) table
+        (AuthManager.pairs_array; sentinel rows ignored) — same
+        contract as VerdictEngine.verdict_flows."""
         import numpy as np
 
+        if authed_pairs is None:
+            pairs = None
+        else:
+            table = np.asarray(authed_pairs).reshape(-1, 2)
+            pairs = {(int(s), int(d)) for s, d in table}
         verdicts = []
         auth = []
         for f in flows:
             verdict, entry, allowed = self._decide(f)
+            demand = bool(allowed and entry is not None
+                          and entry.auth_required)
+            if (demand and pairs is not None
+                    and (f.src_identity, f.dst_identity) not in pairs):
+                verdict = Verdict.DROPPED  # drop until handshake
             verdicts.append(int(verdict))
-            auth.append(bool(allowed and entry is not None
-                             and entry.auth_required))
+            auth.append(demand)
         return {
             "verdict": np.array(verdicts, dtype=np.int32),
             "auth_required": np.array(auth, dtype=bool),

@@ -66,14 +66,18 @@ class Loader:
 
         from cilium_tpu.engine.verdict import CompiledPolicy, VerdictEngine
 
+        # "policy-v2": the packed format gained the ms_auth array — a
+        # version bump invalidates pre-auth cached artifacts, and the
+        # entry tuple must include auth_required or two policies
+        # differing only in authentication would share one artifact
         key = ruleset_fingerprint(
-            "policy-v1",
+            "policy-v2",
             sorted(
                 (
                     ep,
                     tuple(sorted(
                         (k.identity, k.dport, k.proto, k.direction,
-                         e.is_deny, e.l7_wildcard,
+                         e.is_deny, e.l7_wildcard, e.auth_required,
                          tuple(sorted(repr(lr) for lr in e.l7_rules)))
                         for k, e in ms.entries.items()
                     )),

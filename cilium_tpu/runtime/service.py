@@ -140,8 +140,12 @@ class PolicyBridge:
     verdicts — the role of proxylib's ``policymap.go``."""
 
     def __init__(self, loader: Loader, batch_max: int = 256,
-                 deadline_ms: float = 2.0):
+                 deadline_ms: float = 2.0, authed_pairs_fn=None):
         self.loader = loader
+        #: supplies AuthManager.pairs_array() — the L7 proxy path must
+        #: enforce drop-until-authed exactly like Agent.process_flows,
+        #: or auth-demanding traffic would slip through the proxy
+        self.authed_pairs_fn = authed_pairs_fn
         self.batcher = MicroBatcher(self._verdicts, batch_max=batch_max,
                                     deadline_ms=deadline_ms)
 
@@ -149,7 +153,10 @@ class PolicyBridge:
         engine = self.loader.engine
         if engine is None:
             return [int(Verdict.DROPPED)] * len(flows)
-        return [int(v) for v in engine.verdict_flows(flows)["verdict"]]
+        pairs = (self.authed_pairs_fn()
+                 if self.authed_pairs_fn is not None else None)
+        return [int(v) for v in engine.verdict_flows(
+            flows, authed_pairs=pairs)["verdict"]]
 
     def record_to_flow(self, conn: Connection, record) -> Flow:
         f = Flow(
@@ -213,8 +220,10 @@ class VerdictService:
         self.loader = loader
         self.socket_path = socket_path
         self.agent = agent  # optional backref for introspection ops
-        self.bridge = PolicyBridge(loader, batch_max=batch_max,
-                                   deadline_ms=deadline_ms)
+        self.bridge = PolicyBridge(
+            loader, batch_max=batch_max, deadline_ms=deadline_ms,
+            authed_pairs_fn=(agent.auth.pairs_array
+                             if agent is not None else None))
         self._connections: Dict[int, Connection] = {}
         self._conn_lock = threading.Lock()
         self._server: Optional[socketserver.ThreadingUnixStreamServer] = None
@@ -249,7 +258,9 @@ class VerdictService:
             engine = self.loader.engine
             if engine is None:
                 return {"error": "no policy loaded"}
-            out = engine.verdict_flows(flows)
+            out = engine.verdict_flows(
+                flows, authed_pairs=self.bridge.authed_pairs_fn()
+                if self.bridge.authed_pairs_fn is not None else None)
             METRICS.inc("cilium_tpu_service_verdicts_total", len(flows))
             return {"verdicts": [int(v) for v in out["verdict"]]}
         if op == "on_new_connection":

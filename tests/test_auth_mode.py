@@ -128,6 +128,123 @@ spec:
         agent.stop()
 
 
+@pytest.mark.parametrize("offload", [False, True])
+def test_drop_until_authed_enforcement(offload):
+    """The supply side: traffic demanding auth DROPS until the
+    identity pair completes a handshake (AuthManager), forwards after,
+    and drops again on revocation — through the full agent path."""
+    cfg = Config()
+    cfg.enable_tpu_offload = offload
+    cfg.configure_logging = False
+    agent = Agent(cfg).start()
+    try:
+        svc = agent.endpoint_add(1, {"app": "svc"})
+        peer = agent.endpoint_add(2, {"app": "peer"})
+        agent.policy_add(load_cnp_yaml_text(CNP)[0])
+        flow = Flow(src_identity=peer.identity, dst_identity=svc.identity,
+                    dport=443, direction=TrafficDirection.INGRESS)
+
+        out = agent.process_flows([flow])
+        assert int(out["verdict"][0]) == 2, "must drop pre-handshake"
+        assert bool(out["auth_required"][0])
+
+        agent.auth.authenticate(peer.identity, svc.identity)
+        out = agent.process_flows([flow])
+        assert int(out["verdict"][0]) == 1, "authed pair must forward"
+
+        agent.auth.revoke(peer.identity, svc.identity)
+        out = agent.process_flows([flow])
+        assert int(out["verdict"][0]) == 2, "revocation must re-drop"
+    finally:
+        agent.stop()
+
+
+def test_auth_ttl_expiry_drops_again():
+    import time
+
+    cfg = Config()
+    cfg.configure_logging = False
+    agent = Agent(cfg).start()
+    try:
+        svc = agent.endpoint_add(1, {"app": "svc"})
+        peer = agent.endpoint_add(2, {"app": "peer"})
+        agent.policy_add(load_cnp_yaml_text(CNP)[0])
+        flow = Flow(src_identity=peer.identity, dst_identity=svc.identity,
+                    dport=443, direction=TrafficDirection.INGRESS)
+        agent.auth.authenticate(peer.identity, svc.identity, ttl=0.05)
+        assert int(agent.process_flows([flow])["verdict"][0]) == 1
+        time.sleep(0.1)
+        assert agent.auth.expire() == 1
+        assert int(agent.process_flows([flow])["verdict"][0]) == 2
+    finally:
+        agent.stop()
+
+
+def test_engines_agree_under_enforcement():
+    from cilium_tpu.auth import AuthManager
+
+    for offload in (False, True):
+        cfg = Config()
+        cfg.enable_tpu_offload = offload
+        cfg.configure_logging = False
+        agent = Agent(cfg).start()
+        try:
+            svc = agent.endpoint_add(1, {"app": "svc"})
+            peer = agent.endpoint_add(2, {"app": "peer"})
+            open_ep = agent.endpoint_add(3, {"app": "open"})
+            agent.policy_add(load_cnp_yaml_text(CNP)[0])
+            mgr = AuthManager()
+            mgr.authenticate(peer.identity, svc.identity)
+            out = agent.loader.engine.verdict_flows([
+                Flow(src_identity=peer.identity,
+                     dst_identity=svc.identity, dport=443,
+                     direction=TrafficDirection.INGRESS),
+                Flow(src_identity=open_ep.identity,
+                     dst_identity=svc.identity, dport=443,
+                     direction=TrafficDirection.INGRESS),
+                Flow(src_identity=open_ep.identity,
+                     dst_identity=svc.identity, dport=80,
+                     direction=TrafficDirection.INGRESS),
+            ], authed_pairs=mgr.pairs_array())
+            # authed pair forwards; unauthed pair on 443 has no rule
+            # (only peer does) → plain drop; open on 80 forwards
+            assert [int(v) for v in out["verdict"]] == [1, 2, 1], offload
+        finally:
+            agent.stop()
+
+
+def test_verdict_service_enforces_auth(tmp_path):
+    """Regression: the L7 proxy / verdict-service path must enforce
+    drop-until-authed exactly like Agent.process_flows — a handshake
+    requirement that only binds one ingress path is a bypass."""
+    from cilium_tpu.ingest.hubble import flow_to_dict
+    from cilium_tpu.runtime.service import VerdictClient
+
+    sock = str(tmp_path / "svc.sock")
+    cfg = Config()
+    cfg.configure_logging = False
+    agent = Agent(cfg, socket_path=sock).start()
+    try:
+        svc = agent.endpoint_add(1, {"app": "svc"})
+        peer = agent.endpoint_add(2, {"app": "peer"})
+        agent.policy_add(load_cnp_yaml_text(CNP)[0])
+        flow = Flow(src_identity=peer.identity, dst_identity=svc.identity,
+                    dport=443, direction=TrafficDirection.INGRESS)
+        client = VerdictClient(sock)
+        try:
+            resp = client.call({"op": "verdict",
+                                "flows": [flow_to_dict(flow)]})
+            assert resp["verdicts"] == [2], resp  # pre-handshake: drop
+            agent.auth.authenticate(peer.identity, svc.identity)
+            resp = client.call({"op": "verdict",
+                                "flows": [flow_to_dict(flow)]})
+            assert resp["verdicts"] == [1], resp
+        finally:
+            client.close()
+    finally:
+        agent.stop()
+
+
 def test_auth_survives_entry_merge():
     """Two rules landing on the same key: if either demands auth, the
     merged entry demands it (never silently waive a handshake)."""
