@@ -35,15 +35,23 @@ class AuthManager:
         self._lock = threading.Lock()
         self._pairs: Dict[Tuple[int, int], float] = {}  # pair → expiry
         self._version = 0
-        self._cached: Optional[Tuple[int, np.ndarray]] = None
+        #: (version, earliest_expiry_among_cached, array)
+        self._cached: Optional[Tuple[int, float, np.ndarray]] = None
 
     def authenticate(self, src_identity: int, dst_identity: int,
                      ttl: Optional[float] = None) -> None:
         """Record a completed handshake (the reference's auth map
         upsert after the auth service signs off)."""
+        src, dst = int(src_identity), int(dst_identity)
+        for nid in (src, dst):
+            # one out-of-range pair would make every later
+            # pairs_array() build raise (int32 overflow) and poison the
+            # whole verdict path; == PAIR_SENTINEL would match padding
+            if not (0 <= nid < PAIR_SENTINEL):
+                raise ValueError(f"identity {nid} outside int32 range")
         expiry = time.time() + (self.default_ttl if ttl is None else ttl)
         with self._lock:
-            self._pairs[(int(src_identity), int(dst_identity))] = expiry
+            self._pairs[(src, dst)] = expiry
             self._version += 1
             METRICS.set_gauge("cilium_tpu_auth_pairs",
                               float(len(self._pairs)))
@@ -84,21 +92,26 @@ class AuthManager:
         """Live pairs as a lexicographically sorted [P, 2] int32 table
         (src, dst columns), padded to the next power of two with
         sentinel rows so jit sees few distinct shapes. Cached behind a
-        version counter: the hot path pays a dict lookup, not a
-        rebuild, when auth state hasn't changed. Lapsed-but-not-GC'd
-        entries may appear until ``expire()`` runs; callers needing
-        exact TTL edges (tests) call expire() first."""
+        version counter AND the earliest expiry of the cached set: the
+        hot path pays a dict lookup when nothing changed, yet a lapsed
+        TTL invalidates at the next call — expiry binds at lookup time
+        (as the reference datapath checks auth-map expiration inline),
+        not at the next GC sweep."""
+        now = time.time()
         with self._lock:
-            if self._cached is not None and self._cached[0] == self._version:
-                return self._cached[1]
-            now = time.time()
+            if (self._cached is not None
+                    and self._cached[0] == self._version
+                    and now < self._cached[1]):
+                return self._cached[2]
             live = sorted((s, d) for (s, d), exp in self._pairs.items()
                           if exp > now)
+            earliest = min((exp for exp in self._pairs.values()
+                            if exp > now), default=float("inf"))
             size = 8
             while size < len(live):
                 size *= 2
             out = np.full((size, 2), PAIR_SENTINEL, dtype=np.int32)
             for i, (s, d) in enumerate(live):
                 out[i] = (s, d)
-            self._cached = (self._version, out)
+            self._cached = (self._version, earliest, out)
             return out

@@ -167,6 +167,21 @@ def cmd_replay(args) -> int:
     return 0
 
 
+def cmd_auth(args) -> int:
+    """Mutual-auth pair management over the REST API."""
+    c = _api(args)
+    if args.auth_cmd == "list":
+        return _print(c.auth_list())
+    if args.auth_cmd == "add":
+        code, body = c.auth_put(args.src, args.dst, ttl=args.ttl)
+        ok = code == 201
+    else:
+        code, body = c.auth_delete(args.src, args.dst)
+        ok = code == 200
+    _print(body)  # error bodies included — a silent rc 1 helps nobody
+    return 0 if ok else 1
+
+
 def cmd_capture(args) -> int:
     """Binary capture tooling (perf-ring-analog format)."""
     import os
@@ -411,6 +426,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--status", action="store_true",
                    help="print server status instead of flows")
     p.set_defaults(fn=cmd_observe)
+
+    p = sub.add_parser("auth", help="mutual-auth pair management")
+    asub = p.add_subparsers(dest="auth_cmd", required=True)
+    a = asub.add_parser("list")
+    a.add_argument("--api", required=True)
+    a.set_defaults(fn=cmd_auth)
+    for name in ("add", "delete"):
+        a = asub.add_parser(name)
+        a.add_argument("src", type=int, help="source identity")
+        a.add_argument("dst", type=int, help="destination identity")
+        if name == "add":
+            a.add_argument("--ttl", type=float, default=None)
+        a.add_argument("--api", required=True)
+        a.set_defaults(fn=cmd_auth)
 
     p = sub.add_parser("capture", help="binary capture tooling")
     capsub = p.add_subparsers(dest="capture_cmd", required=True)

@@ -798,16 +798,14 @@ class VerdictEngine:
         self._arrays = {
             k: jax.device_put(v, device) for k, v in policy.arrays.items()
         }
+        #: True when some staged entry demands authentication — when
+        #: False, callers skip staging the authed-pairs table
+        self.needs_auth = bool(np.any(policy.arrays["ms_auth"]))
         self._step = jax.jit(verdict_step)
 
     def verdict_batch_arrays(self, batch: Dict[str, jax.Array]):
         return self._step(self._arrays, batch)
 
-    @property
-    def needs_auth(self) -> bool:
-        """True when some staged entry demands authentication — when
-        False, callers can skip staging the authed-pairs table."""
-        return bool(np.any(self.policy.arrays["ms_auth"]))
 
     def verdict_flows(self, flows: Sequence[Flow],
                       cfg: Optional[EngineConfig] = None,

@@ -140,6 +140,12 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                                 "labels": sorted(map(str, labels))
                                 if labels else []})
                 return self._send(200, out)
+            if path == "/v1/auth":
+                return self._send(200, [
+                    {"src_identity": s, "dst_identity": d,
+                     "expires": exp}
+                    for (s, d), exp in sorted(agent.auth.pairs().items())
+                ])
             if path == "/v1/ip":
                 return self._send(200, agent.ipcache.dump())
             if path == "/v1/fqdn/cache":
@@ -200,6 +206,14 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     agent.endpoint_manager.regenerate_all(wait=True)
                 return self._send(200, {"revision": rev,
                                         "count": len(cnps)})
+            if path == "/v1/auth":
+                # mutual-auth handshake completion (the auth service's
+                # upsert into the auth map)
+                body = json.loads(self._body() or b"{}")
+                agent.auth.authenticate(
+                    int(body["src_identity"]), int(body["dst_identity"]),
+                    ttl=body.get("ttl"))
+                return self._send(201, {"ok": True})
             return self._send(404, {"error": f"no such resource {path}"})
         except Exception as e:
             return self._send(400, {"error": f"{type(e).__name__}: {e}"})
@@ -259,6 +273,11 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     rev = agent.repo.revision
                 return self._send(200, {"deleted": deleted,
                                         "revision": rev})
+            if path == "/v1/auth":
+                body = json.loads(self._body() or b"{}")
+                deleted = agent.auth.revoke(int(body["src_identity"]),
+                                            int(body["dst_identity"]))
+                return self._send(200, {"deleted": deleted})
             return self._send(404, {"error": f"no such resource {path}"})
         except Exception as e:
             return self._send(400, {"error": f"{type(e).__name__}: {e}"})
@@ -348,6 +367,21 @@ class APIClient:
 
     def endpoint_delete(self, endpoint_id: int):
         return self.request("DELETE", f"/v1/endpoint/{endpoint_id}")
+
+    def auth_list(self):
+        return self.request("GET", "/v1/auth")[1]
+
+    def auth_put(self, src_identity: int, dst_identity: int, ttl=None):
+        body = {"src_identity": src_identity,
+                "dst_identity": dst_identity}
+        if ttl is not None:
+            body["ttl"] = ttl
+        return self.request("PUT", "/v1/auth", body=body)
+
+    def auth_delete(self, src_identity: int, dst_identity: int):
+        return self.request("DELETE", "/v1/auth",
+                            body={"src_identity": src_identity,
+                                  "dst_identity": dst_identity})
 
     def policy_get(self):
         return self.request("GET", "/v1/policy")[1]

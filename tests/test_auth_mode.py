@@ -245,6 +245,74 @@ def test_verdict_service_enforces_auth(tmp_path):
         agent.stop()
 
 
+def test_auth_rest_and_cli(tmp_path, capsys):
+    """The handshake-completion surface: REST PUT/GET/DELETE /v1/auth
+    and the CLI auth subcommands drive enforcement end to end."""
+    import json as _json
+
+    from cilium_tpu import cli
+
+    api = str(tmp_path / "api.sock")
+    cfg = Config()
+    cfg.configure_logging = False
+    agent = Agent(cfg, api_socket_path=api).start()
+    try:
+        svc = agent.endpoint_add(1, {"app": "svc"})
+        peer = agent.endpoint_add(2, {"app": "peer"})
+        agent.policy_add(load_cnp_yaml_text(CNP)[0])
+        flow = Flow(src_identity=peer.identity, dst_identity=svc.identity,
+                    dport=443, direction=TrafficDirection.INGRESS)
+        assert int(agent.process_flows([flow])["verdict"][0]) == 2
+
+        assert cli.main(["auth", "add", str(peer.identity),
+                         str(svc.identity), "--api", api]) == 0
+        capsys.readouterr()
+        assert int(agent.process_flows([flow])["verdict"][0]) == 1
+
+        assert cli.main(["auth", "list", "--api", api]) == 0
+        listed = _json.loads(capsys.readouterr().out)
+        assert listed[0]["src_identity"] == peer.identity
+
+        assert cli.main(["auth", "delete", str(peer.identity),
+                         str(svc.identity), "--api", api]) == 0
+        capsys.readouterr()
+        assert int(agent.process_flows([flow])["verdict"][0]) == 2
+    finally:
+        agent.stop()
+
+
+def test_out_of_range_identity_rejected_not_poisoning():
+    """Regression: one out-of-int32-range pair must be rejected at
+    authenticate() — accepted, it would make every later pairs_array()
+    raise and poison the whole verdict path."""
+    from cilium_tpu.auth import PAIR_SENTINEL, AuthManager
+
+    mgr = AuthManager()
+    for bad in (2**31, -1, PAIR_SENTINEL):
+        with pytest.raises(ValueError):
+            mgr.authenticate(bad, 5)
+        with pytest.raises(ValueError):
+            mgr.authenticate(5, bad)
+    mgr.authenticate(5, 6)
+    assert mgr.pairs_array().shape == (8, 2)  # still healthy
+
+
+def test_ttl_binds_at_lookup_not_gc():
+    """Regression: a lapsed TTL must stop forwarding at the NEXT
+    lookup, not at the next 60s GC sweep — the cache invalidates on
+    the earliest expiry of the cached set."""
+    import time
+
+    from cilium_tpu.auth import AuthManager
+
+    mgr = AuthManager()
+    mgr.authenticate(1, 2, ttl=0.05)
+    assert mgr.pairs_array()[0, 0] == 1  # cached with the pair
+    time.sleep(0.1)
+    arr = mgr.pairs_array()  # NO expire() call — must still drop it
+    assert (arr[:, 0] == 1).sum() == 0
+
+
 def test_auth_survives_entry_merge():
     """Two rules landing on the same key: if either demands auth, the
     merged entry demands it (never silently waive a handshake)."""
