@@ -18,7 +18,7 @@ from typing import Dict, List, Optional
 from cilium_tpu.auth import AuthManager
 from cilium_tpu.clustermesh import ClusterMesh, LocalStatePublisher
 from cilium_tpu.core.config import Config
-from cilium_tpu.core.identity import IdentityAllocator
+from cilium_tpu.core.identity import IdentityAllocator, ReservedIdentity
 from cilium_tpu.kvstore import KVStore
 from cilium_tpu.core.labels import LabelSet
 from cilium_tpu.endpoint import EndpointManager
@@ -83,7 +83,8 @@ class Agent:
             self.repo, self.selector_cache, self.allocator, self.loader,
             dns_proxy=self.dns_proxy, state_dir=state_dir,
             services=self.services,
-            backend_identity=lambda ip: self.ipcache.lookup(ip))
+            backend_identity=lambda ip: self.ipcache.lookup(ip),
+            cluster_name=self.config.cluster_name)
         # backend-set changes alter toServices resolution → regenerate,
         # but only when some rule actually uses toServices: routine
         # backend churn must not trigger full-policy recomputation in
@@ -182,6 +183,17 @@ class Agent:
             self.controllers.update(
                 "node-registration", self.node_registration.heartbeat,
                 interval=15.0)
+        # tag kube-apiserver IPs with the reserved identity so the
+        # `kube-apiserver` entity selects real traffic (flows from
+        # these IPs resolve to ReservedIdentity.KUBE_APISERVER)
+        import ipaddress as _ipaddress
+
+        for ip in self.config.kube_apiserver_ips:
+            if "/" not in ip:
+                # family-aware host prefix: a bare IPv6 address must
+                # become /128, not /32 (which would tag a 2^96 block)
+                ip = f"{ip}/{_ipaddress.ip_address(ip).max_prefixlen}"
+            self.ipcache.upsert(ip, int(ReservedIdentity.KUBE_APISERVER))
         restored = self.endpoint_manager.restore()
         if restored:
             METRICS.inc("cilium_tpu_endpoints_restored_total", restored)

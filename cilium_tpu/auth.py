@@ -26,6 +26,14 @@ from cilium_tpu.runtime.metrics import METRICS
 #: silently truncate.
 PAIR_SENTINEL = np.iinfo(np.int32).max
 
+#: Explicit opt-out for ``authed_pairs`` on VerdictEngine/Oracle
+#: verdict calls: auth demand surfaces as an output lane only and
+#: auth-requiring traffic still forwards. Passing ``None`` instead is
+#: fail-closed — auth-demanding flows drop until a pairs table is
+#: supplied (a verdict path wired up without an AuthManager must not
+#: silently waive handshakes the policy requires).
+AUTH_UNENFORCED = object()
+
 
 class AuthManager:
     """Authed (src, dst) identity pairs with expiry."""

@@ -133,16 +133,24 @@ def cmd_replay(args) -> int:
         chunks = replay_chunks(args.capture, cursor=cursor,
                                start=args.start, limit=args.limit,
                                decode=not args.fast)
+        # offline replay has no live handshake state: drop-until-authed
+        # enforcement is explicitly waived (the fail-closed None default
+        # would report every auth-gated flow DROPPED, misstating what
+        # the datapath did); the auth demand still surfaces per flow
+        from cilium_tpu.auth import AUTH_UNENFORCED
+
         for commit_index, chunk in chunks:
             if args.fast:
                 # columnar: records → verdicts, no Flow objects
-                out = engine.verdict_records(chunk)
+                out = engine.verdict_records(
+                    chunk, authed_pairs=AUTH_UNENFORCED)
                 for v, c in zip(*np.unique(out["verdict"],
                                            return_counts=True)):
                     name = Verdict(int(v)).name
                     counts[name] = counts.get(name, 0) + int(c)
             else:
-                out = engine.verdict_flows(chunk)
+                out = engine.verdict_flows(
+                    chunk, authed_pairs=AUTH_UNENFORCED)
                 if "match_spec" not in out:
                     out = {"verdict": np.asarray(out["verdict"])}
                 annotate_flows(chunk, out)

@@ -40,7 +40,8 @@ NODES_PREFIX = "cilium/state/nodes/v1/"
 #: Label key marking which cluster an identity/IP came from
 #: (reference's ``io.cilium.k8s.policy.cluster``; the namespaced key
 #: cannot collide with ordinary workload labels like ``cluster=c0``).
-CLUSTER_LABEL_KEY = "io.cilium.k8s.policy.cluster"
+#: Shared with the policy layer: the `cluster` entity selects on it.
+from cilium_tpu.policy.api.rule import CLUSTER_LABEL_KEY  # noqa: E402
 
 
 def _encode_labels(labels: LabelSet) -> List[str]:

@@ -84,6 +84,12 @@ class Config:
     #: labels to the same identity (`--identity-allocation-mode` analog)
     identity_allocation_mode: str = "local"
     pod_cidr: str = "10.0.0.0/24"      # this node's IPAM podCIDR (static)
+    #: IPs of the kube-apiserver (``--k8s-api-server`` analog): the
+    #: agent upserts each into the ipcache under the reserved
+    #: kube-apiserver identity, which is what the `kube-apiserver`
+    #: entity selects (reference: apiserver IPs are tagged with the
+    #: reserved identity by the k8s watcher)
+    kube_apiserver_ips: tuple = ()
     engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
     loader: LoaderConfig = dataclasses.field(default_factory=LoaderConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
@@ -123,6 +129,8 @@ class Config:
                     "identity_allocation_mode", "log_level"):
             if key in data:
                 setattr(cfg, key, data[key])
+        if "kube_apiserver_ips" in data:
+            cfg.kube_apiserver_ips = tuple(data["kube_apiserver_ips"])
         for section, target in (("engine", cfg.engine),
                                 ("loader", cfg.loader),
                                 ("parallel", cfg.parallel)):

@@ -24,7 +24,23 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Set
 
 from cilium_tpu.core.identity import IdentityAllocator, NumericIdentity
-from cilium_tpu.core.labels import LabelSet
+from cilium_tpu.core.labels import Label, LabelSet, SOURCE_K8S, SOURCE_RESERVED
+from cilium_tpu.policy.api.rule import CLUSTER_LABEL_KEY
+
+
+def with_cluster_label(labels: LabelSet, cluster_name: str) -> LabelSet:
+    """Inject ``k8s:io.cilium.k8s.policy.cluster=<name>`` into a
+    workload endpoint's labels (reference: every k8s endpoint identity
+    carries it) — this is what the ``cluster`` entity selects, so it
+    matches in-cluster workloads WITHOUT matching ``reserved:world``.
+    Reserved-identity label sets (host/health/…) are left untouched:
+    adding a k8s label would re-allocate them as user identities."""
+    if labels.get(CLUSTER_LABEL_KEY) is not None or any(
+            l.source == SOURCE_RESERVED for l in labels):
+        return labels
+    return LabelSet(list(labels) + [
+        Label(key=CLUSTER_LABEL_KEY, value=cluster_name,
+              source=SOURCE_K8S)])
 from cilium_tpu.core.flow import TrafficDirection
 from cilium_tpu.policy.mapstate import PolicyResolver
 from cilium_tpu.policy.repository import Repository
@@ -78,7 +94,8 @@ class EndpointManager:
                  allocator: IdentityAllocator, loader: Loader,
                  dns_proxy=None, state_dir: Optional[str] = None,
                  regen_workers: int = 4,
-                 services=None, backend_identity=None):
+                 services=None, backend_identity=None,
+                 cluster_name: str = "default"):
         self.repo = repo
         self.cache = selector_cache
         self.allocator = allocator
@@ -89,6 +106,7 @@ class EndpointManager:
         # hook), threaded into every PolicyResolver this manager builds
         self.services = services
         self.backend_identity = backend_identity
+        self.cluster_name = cluster_name
         self._lock = threading.RLock()
         self._endpoints: Dict[int, Endpoint] = {}
         self._pool = ThreadPoolExecutor(max_workers=regen_workers,
@@ -108,6 +126,7 @@ class EndpointManager:
     # -- lifecycle --------------------------------------------------------
     def add_endpoint(self, endpoint_id: int, labels: LabelSet,
                      ipv4: str = "") -> Endpoint:
+        labels = with_cluster_label(labels, self.cluster_name)
         ep = Endpoint(endpoint_id=endpoint_id, labels=labels, ipv4=ipv4)
         ep.identity = self.allocator.allocate(labels)
         self.cache.add_identity(ep.identity, labels)
@@ -174,7 +193,8 @@ class EndpointManager:
             with SpanStat("endpoint_regeneration"):
                 resolver = PolicyResolver(
                     self.repo, self.cache, services=self.services,
-                    backend_identity=self.backend_identity)
+                    backend_identity=self.backend_identity,
+                    cluster_name=self.cluster_name)
                 per_identity = {}
                 resolved = {}
                 for ep in eps:
@@ -242,6 +262,10 @@ class EndpointManager:
         n = 0
         for d in eps:
             ep = Endpoint.from_json(d)
+            # older checkpoints predate the cluster label — normalize so
+            # restored endpoints land on the same identity a fresh add
+            # would get
+            ep.labels = with_cluster_label(ep.labels, self.cluster_name)
             ep.identity = self.allocator.allocate(ep.labels)
             self.cache.add_identity(ep.identity, ep.labels)
             with self._lock:

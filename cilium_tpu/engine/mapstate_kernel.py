@@ -11,16 +11,22 @@ Key layout (3×int32 words, lexicographically sorted):
   endpoint identity — valid because policy depends only on the identity,
   the same dedup ``pkg/policy/distillery.go`` exploits)
 * ``w1`` — peer identity (src for ingress, dst for egress); 0 = wildcard
-* ``w2`` — ``(direction << 24) | (proto << 16) | dport``; proto/port 0 =
-  wildcard
+* ``w2`` — ``(direction << 29) | (proto << 21) | (port_plen << 16) |
+  dport``; proto 0 = wildcard. ``port_plen`` keys port RANGES as
+  aligned prefix blocks (reference ``mapstate.go`` port-range mask
+  entries): plen 16 = exact port, 0 = all ports, 1..15 = a
+  ``2^(16-plen)``-wide block based at ``dport``.
 
 Verdict precedence (mapstate.py's golden model, vectorized):
 
-* probe all 8 wildcard combinations of (peer, port, proto);
+* probe every wildcard combination of (peer, port-prefix, proto) —
+  the port dimension probes each DISTINCT prefix length present in
+  the packed table (``port_plens``, sorted descending; {16, 0} when
+  no ranges exist → the classic 8 probes);
 * **deny wins** if any covering entry is deny (cilium: deny precedence
   regardless of breadth);
 * else the most-specific covering allow wins (specificity = peer > port
-  > proto, the datapath's probe order);
+  prefix-length > proto, the datapath's probe order);
 * else default: allow iff the direction is unenforced for this endpoint.
 """
 
@@ -44,21 +50,31 @@ class PackedMapState:
 
     key_w0: np.ndarray      # [N] int32 endpoint identity
     key_w1: np.ndarray      # [N] int32 peer identity
-    key_w2: np.ndarray      # [N] int32 dir|proto|port
+    key_w2: np.ndarray      # [N] int32 dir|proto|plen|port
     is_deny: np.ndarray     # [N] bool
     ruleset_id: np.ndarray  # [N] int32, -1 = no L7 restriction
     auth: np.ndarray        # [N] bool — entry demands mutual auth
     # per-endpoint-identity enforcement: sorted ids + 2-bit flags
     enf_ids: np.ndarray     # [M] int32 sorted endpoint identities
     enf_flags: np.ndarray   # [M, 2] bool (ingress, egress)
+    #: [P] int32 DISTINCT port prefix lengths present, sorted
+    #: descending (always contains 16 and 0) — the lookup's port
+    #: probe set; its SHAPE is static per compile, so a ruleset that
+    #: introduces a new prefix length recompiles once
+    port_plens: np.ndarray = None
+
+    def __post_init__(self):
+        if self.port_plens is None:
+            self.port_plens = np.array([16, 0], dtype=np.int32)
 
     @property
     def n_entries(self) -> int:
         return len(self.key_w0)
 
 
-def _pack_w2(direction: int, proto: int, dport: int) -> int:
-    return (direction << 24) | (proto << 16) | dport
+def _pack_w2(direction: int, proto: int, dport: int,
+             plen: int = 16) -> int:
+    return (direction << 29) | (proto << 21) | (plen << 16) | dport
 
 
 def pack_mapstate(
@@ -73,16 +89,21 @@ def pack_mapstate(
     """
     rows: List[Tuple[int, int, int, bool, int, bool]] = []
     enf: List[Tuple[int, bool, bool]] = []
+    plens = {16, 0}
     for ep_id, ms in sorted(per_identity.items()):
         enf.append((ep_id, ms.ingress_enforced, ms.egress_enforced))
         for key, entry in ms.entries.items():
             rid = -1
             if ruleset_of_entry is not None and entry.is_redirect:
                 rid = ruleset_of_entry(ep_id, key, entry)
+            plen = getattr(key, "port_plen", None)
+            if plen is None:
+                plen = 0 if key.dport == 0 else 16
+            plens.add(plen)
             rows.append((
                 ep_id,
                 key.identity,
-                _pack_w2(key.direction, key.proto, key.dport),
+                _pack_w2(key.direction, key.proto, key.dport, plen),
                 entry.is_deny,
                 rid,
                 getattr(entry, "auth_required", False),
@@ -108,6 +129,8 @@ def pack_mapstate(
         auth=auth,
         enf_ids=np.array([e[0] for e in enf], dtype=np.int32),
         enf_flags=np.array([[e[1], e[2]] for e in enf], dtype=bool),
+        port_plens=np.array(sorted(plens, reverse=True),
+                            dtype=np.int32),
     )
 
 
@@ -119,8 +142,9 @@ def _lower_bound3(
     return lower_bound((k0, k1, k2), (p0, p1, p2))
 
 
-# probe order: descending specificity. bit2=peer bit1=port bit0=proto
-_PROBE_SPECS = np.array([7, 6, 5, 4, 3, 2, 1, 0], dtype=np.int32)
+#: match_spec value reported for an explicit deny verdict (above the
+#: maximum allow specificity 34+32+1=67)
+DENY_SPEC = 68
 
 
 def mapstate_lookup(
@@ -133,22 +157,33 @@ def mapstate_lookup(
     protos: jax.Array,      # [B]
     directions: jax.Array,  # [B]
     auth: jax.Array = None,  # [N] bool entry auth flags (optional)
+    port_plens: jax.Array = None,  # [P] int32 desc (default [16, 0])
 ) -> Dict[str, jax.Array]:
     """Batched verdict lookup. Returns dict with:
     ``allowed`` [B] bool (L3/L4 verdict, pre-L7),
     ``denied`` [B] bool (explicit deny hit),
     ``redirect`` [B] bool (L7 evaluation required),
     ``ruleset`` [B] int32 (winning entry's ruleset id, -1 if none),
-    ``match_spec`` [B] int32 (specificity of winning entry, -1 default),
+    ``match_spec`` [B] int32 (specificity of winning entry per
+    MapStateKey.specificity, -1 default, DENY_SPEC on deny),
     ``auth_required`` [B] bool (winning allow demands mutual auth).
     """
     from cilium_tpu.policy.mapstate import ICMP_TYPE_BIT
 
+    if port_plens is None:
+        port_plens = jnp.array([16, 0], dtype=jnp.int32)
     B = ep_ids.shape[0]
-    specs = jnp.asarray(_PROBE_SPECS)               # [8]
-    peer_sel = (specs >> 2) & 1                      # [8]
-    port_sel = (specs >> 1) & 1
-    proto_sel = specs & 1
+    P = port_plens.shape[0]
+    n_probes = 2 * P * 2
+    # probe grid, descending specificity: peer (desc) → port prefix
+    # length (desc; port_plens is sorted desc at pack time) → proto
+    # (desc). Probe COUNT is static (shape of port_plens).
+    peer_sel = jnp.repeat(jnp.array([1, 0], dtype=jnp.int32), P * 2)
+    plen = jnp.tile(jnp.repeat(port_plens, 2), 2)       # [n_probes]
+    proto_sel = jnp.tile(jnp.array([1, 0], dtype=jnp.int32), 2 * P)
+    pmask = jnp.where(plen == 0, 0,
+                      (0xFFFF << (16 - plen)) & 0xFFFF)  # [n_probes]
+    specs = peer_sel * 34 + plen * 2 + proto_sel         # [n_probes]
 
     # ICMP key encoding lives HERE, beside the probes, so every caller
     # (and the hypothesis differential suite, which calls this
@@ -158,24 +193,25 @@ def mapstate_lookup(
     is_icmp = (protos == 1) | (protos == 58)
     dports = jnp.where(is_icmp, dports | ICMP_TYPE_BIT, dports)
 
-    p0 = jnp.broadcast_to(ep_ids[:, None], (B, 8))
+    p0 = jnp.broadcast_to(ep_ids[:, None], (B, n_probes))
     p1 = peer_ids[:, None] * peer_sel[None, :]
     w2 = (
-        (directions[:, None] << 24)
-        | ((protos[:, None] * proto_sel[None, :]) << 16)
-        | (dports[:, None] * port_sel[None, :])
+        (directions[:, None] << 29)
+        | ((protos[:, None] * proto_sel[None, :]) << 21)
+        | (plen[None, :] << 16)
+        | (dports[:, None] & pmask[None, :])
     )
     idx, found = _lower_bound3(
         key_w0, key_w1, key_w2,
         p0.reshape(-1), p1.reshape(-1), w2.reshape(-1),
     )
-    idx = idx.reshape(B, 8)
-    found = found.reshape(B, 8)
+    idx = idx.reshape(B, n_probes)
+    found = found.reshape(B, n_probes)
     # proto-ANY port entries are an L4 construct: an ICMP flow whose
     # marked type collides with the port value must not match them
-    # (mirrors MapStateKey.covers); the (port, proto-wildcard) probes
-    # are masked for ICMP flows
-    l4_only_probe = (port_sel == 1) & (proto_sel == 0)
+    # (mirrors MapStateKey.covers); the (port-specific, proto-wildcard)
+    # probes are masked for ICMP flows
+    l4_only_probe = (plen > 0) & (proto_sel == 0)
     found = found & ~(is_icmp[:, None] & l4_only_probe[None, :])
 
     deny_hit = found & is_deny[idx]
@@ -188,7 +224,7 @@ def mapstate_lookup(
     win_idx = jnp.take_along_axis(idx, first_allow[:, None], axis=1)[:, 0]
     ruleset = jnp.where(any_allow, ruleset_id[win_idx], -1)
     match_spec = jnp.where(
-        denied, 8, jnp.where(any_allow, specs[first_allow], -1)
+        denied, DENY_SPEC, jnp.where(any_allow, specs[first_allow], -1)
     )
 
     # default enforcement per endpoint identity

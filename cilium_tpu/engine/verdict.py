@@ -371,6 +371,7 @@ class CompiledPolicy:
             "ms_auth": packed.auth,
             "ms_enf_ids": packed.enf_ids,
             "ms_enf_flags": packed.enf_flags,
+            "ms_plens": packed.port_plens,
             "rs_http_mask": _masks_to_array(http_members or [[]],
                                             len(http_rules)),
             "rs_kafka_mask": _masks_to_array(kafka_members or [[]],
@@ -658,6 +659,7 @@ def verdict_step(arrays: Dict[str, jax.Array], batch: Dict[str, jax.Array]
         batch["ep_ids"], batch["peer_ids"], batch["dports"],
         batch["protos"], batch["directions"],
         auth=arrays.get("ms_auth"),
+        port_plens=arrays.get("ms_plens"),
     )
     ruleset = jnp.clip(ms["ruleset"], 0, arrays["rs_http_mask"].shape[0] - 1)
     l7t = batch["l7_types"]
@@ -807,28 +809,47 @@ class VerdictEngine:
         return self._step(self._arrays, batch)
 
 
+    def _stage_auth(self, batch: Dict[str, jax.Array],
+                    authed_pairs) -> None:
+        """Stage the authed-pairs table for drop-until-authed.
+
+        Fail-closed default: when the staged policy demands auth and no
+        table was supplied (``None``), an EMPTY sentinel table is
+        staged so auth-demanding flows DROP — a verdict path built
+        without an AuthManager backref must not forward traffic that
+        policy says waits on a handshake. ``AUTH_UNENFORCED`` opts into
+        demand-lane-only behavior explicitly."""
+        from cilium_tpu.auth import AUTH_UNENFORCED
+
+        if not self.needs_auth or authed_pairs is AUTH_UNENFORCED:
+            return
+        if authed_pairs is None:
+            # sentinel row that never matches (identities are >= 0)
+            authed_pairs = np.full((1, 2), -1, dtype=np.int32)
+        batch["auth_pairs"] = jax.device_put(authed_pairs, self.device)
+
     def verdict_flows(self, flows: Sequence[Flow],
                       cfg: Optional[EngineConfig] = None,
                       authed_pairs: Optional[np.ndarray] = None):
         """``authed_pairs`` (lex-sorted [P, 2] int32 (src, dst) table,
-        AuthManager.pairs_array): enables drop-until-authed enforcement
-        for entries demanding authentication; None leaves the demand as
-        an output lane only."""
+        AuthManager.pairs_array): drop-until-authed enforcement for
+        entries demanding authentication. See :meth:`_stage_auth` for
+        the None / AUTH_UNENFORCED contract."""
         fb = encode_flows(flows, self.policy.kafka_interns, cfg)
         batch = flowbatch_to_device(fb, self.device)
-        if authed_pairs is not None and self.needs_auth:
-            batch["auth_pairs"] = jax.device_put(authed_pairs,
-                                                 self.device)
+        self._stage_auth(batch, authed_pairs)
         out = self.verdict_batch_arrays(batch)
         return {k: np.asarray(v) for k, v in out.items()}
 
-    def verdict_records(self, rec, cfg: Optional[EngineConfig] = None):
+    def verdict_records(self, rec, cfg: Optional[EngineConfig] = None,
+                        authed_pairs: Optional[np.ndarray] = None):
         """Columnar fast path: binary capture records → verdicts with
         no per-flow Python objects (ingest/binary.py → encode_records
         → device)."""
         fmax = int(self.policy.kafka_interns.get("gen_fmax", 4))
         fb = encode_records(rec, cfg, fmax=fmax)
         batch = flowbatch_to_device(fb, self.device)
+        self._stage_auth(batch, authed_pairs)
         out = self.verdict_batch_arrays(batch)
         return {k: np.asarray(v) for k, v in out.items()}
 

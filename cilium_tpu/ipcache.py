@@ -13,7 +13,23 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 from cilium_tpu.core.identity import IdentityAllocator, NumericIdentity
-from cilium_tpu.core.labels import Label, LabelSet
+from cilium_tpu.core.labels import Label, LabelSet, SOURCE_RESERVED
+
+
+def cidr_labels(prefix: str) -> LabelSet:
+    """Label set for a CIDR identity: one ``cidr:`` label per COVERING
+    prefix (/0 up to the prefix itself) plus ``reserved:world``
+    (reference: ``pkg/labels/cidr ·GetCIDRLabels``). The ancestor chain
+    is what makes containment matching work — a rule for 10.0.0.0/8
+    selects the /32 identity of an IP inside it because that identity
+    carries the 10.0.0.0/8 label; toCIDRSet ``except`` subtraction and
+    the world entity (CIDR identities ARE world) both ride on this."""
+    net = ipaddress.ip_network(prefix, strict=False)
+    labels = [Label(key="world", source=SOURCE_RESERVED)]
+    for plen in range(0, net.prefixlen + 1):
+        labels.append(Label(key=str(net.supernet(new_prefix=plen)),
+                            source="cidr"))
+    return LabelSet(labels)
 
 
 class IPCache:
@@ -37,7 +53,7 @@ class IPCache:
             if nid is not None and (identity is None or identity == nid):
                 return nid  # unchanged
             if identity is None:
-                labels = LabelSet([Label(key=str(net), source="cidr")])
+                labels = cidr_labels(str(net))
                 identity = self._allocator.allocate(labels)
                 if self._selector_cache is not None:
                     self._selector_cache.add_identity(identity, labels)

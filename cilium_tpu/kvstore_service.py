@@ -298,8 +298,12 @@ class RemoteKVStore:
     #: ops NOT retried once the request may have reached the server:
     #: a replayed "lease" creates (and leaks) a second server-side
     #: lease; a replayed "delete" reports deleted=False for a delete
-    #: that happened. Everything else is idempotent.
-    _NO_RESEND = frozenset({"lease", "delete"})
+    #: that happened; a replayed "create" that applied the first time
+    #: reports created=False, which callers would misread as a peer
+    #: winning the claim (the identity allocator's id-claim key would
+    #: leak as an orphan until operator GC). Everything else is
+    #: idempotent.
+    _NO_RESEND = frozenset({"lease", "delete", "create"})
 
     def _call(self, req: Dict) -> Dict:
         with self._lock:

@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Tuple
 import yaml
 
 from cilium_tpu.policy.api.rule import (
+    CIDRRule,
     EgressRule,
     ICMPField,
     IngressRule,
@@ -52,13 +53,17 @@ _ICMP_TYPE_NAMES = {
 
 
 def _parse_icmp_type(family: str, raw) -> int:
+    if raw is None:
+        # upstream api.ICMPField requires Type; silently defaulting to
+        # 0 would turn the entry into an EchoReply-only rule
+        raise SanitizeError("icmps fields member missing 'type'")
     if isinstance(raw, str) and not raw.lstrip("-").isdigit():
         named = _ICMP_TYPE_NAMES.get(family, {}).get(raw)
         if named is None:
             raise SanitizeError(f"unknown ICMP type name {raw!r}")
         return named
     try:
-        return int(raw if raw is not None else 0)
+        return int(raw)
     except (ValueError, TypeError):
         raise SanitizeError(f"bad ICMP type {raw!r}")
 
@@ -73,15 +78,37 @@ def _parse_icmps(d: Dict):
     )
 
 
+def _parse_cidr_set(raw) -> Tuple[CIDRRule, ...]:
+    """``fromCIDRSet``/``toCIDRSet`` members. A plain string member is
+    the degenerate no-except form; ``except`` clauses are CARRIED (they
+    subtract from the peer set at resolve time — dropping them would
+    silently allow the carved-out sub-CIDRs)."""
+    out = []
+    for c in (raw or ()):
+        if isinstance(c, str):
+            out.append(CIDRRule(cidr=c))
+        elif isinstance(c, dict) and c.get("cidr"):
+            out.append(CIDRRule(
+                cidr=c["cidr"],
+                except_cidrs=tuple(c.get("except") or ()),
+            ))
+        else:
+            raise SanitizeError(f"bad CIDRSet member {c!r}")
+    return tuple(out)
+
+
 def _parse_ingress(d: Dict, deny: bool) -> IngressRule:
     return IngressRule(
         from_endpoints=tuple(
             EndpointSelector.from_dict(s) for s in (d.get("fromEndpoints") or ())
         ),
         from_entities=tuple(d.get("fromEntities") or ()),
-        from_cidrs=tuple(d.get("fromCIDR") or ()) +
-        tuple(c.get("cidr") for c in (d.get("fromCIDRSet") or ())
-              if isinstance(c, dict) and c.get("cidr")),
+        from_cidrs=tuple(d.get("fromCIDR") or ()),
+        from_cidr_set=_parse_cidr_set(d.get("fromCIDRSet")),
+        from_requires=tuple(
+            EndpointSelector.from_dict(s)
+            for s in (d.get("fromRequires") or ())
+        ),
         icmps=_parse_icmps(d),
         auth_mode=(d.get("authentication") or {}).get("mode", "") or "",
         to_ports=tuple(PortRule.from_dict(p) for p in (d.get("toPorts") or ())),
@@ -95,9 +122,12 @@ def _parse_egress(d: Dict, deny: bool) -> EgressRule:
             EndpointSelector.from_dict(s) for s in (d.get("toEndpoints") or ())
         ),
         to_entities=tuple(d.get("toEntities") or ()),
-        to_cidrs=tuple(d.get("toCIDR") or ()) +
-        tuple(c.get("cidr") for c in (d.get("toCIDRSet") or ())
-              if isinstance(c, dict) and c.get("cidr")),
+        to_cidrs=tuple(d.get("toCIDR") or ()),
+        to_cidr_set=_parse_cidr_set(d.get("toCIDRSet")),
+        to_requires=tuple(
+            EndpointSelector.from_dict(s)
+            for s in (d.get("toRequires") or ())
+        ),
         to_fqdns=tuple(
             FQDNSelector(
                 match_name=f.get("matchName", "") or "",

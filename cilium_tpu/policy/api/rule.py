@@ -76,21 +76,72 @@ class PortRule:
         )
 
 
-# Entities (reference: pkg/policy/api/entity.go) map to reserved-label
-# selectors.
-_ENTITY_SELECTORS: Dict[str, EndpointSelector] = {
-    "all": EndpointSelector(),
-    "world": EndpointSelector(match_labels=(("reserved:world", ""),)),
-    "host": EndpointSelector(match_labels=(("reserved:host", ""),)),
-    "remote-node": EndpointSelector(match_labels=(("reserved:remote-node", ""),)),
-    "health": EndpointSelector(match_labels=(("reserved:health", ""),)),
-    "init": EndpointSelector(match_labels=(("reserved:init", ""),)),
-    "ingress": EndpointSelector(match_labels=(("reserved:ingress", ""),)),
-    "kube-apiserver": EndpointSelector(
-        match_labels=(("reserved:kube-apiserver", ""),)
-    ),
-    "cluster": EndpointSelector(),  # approximation: cluster ≈ all in-cluster
+# Entities (reference: pkg/policy/api/entity.go) map to TUPLES of
+# selectors (an entity may cover several reserved classes).
+#: label every workload endpoint identity carries (value = local
+#: cluster name) — how the ``cluster`` entity selects in-cluster
+#: endpoints WITHOUT matching ``reserved:world`` or CIDR identities
+#: (reference: EntitySelectorMapping + InitEntities(clusterName))
+CLUSTER_LABEL_KEY = "io.cilium.k8s.policy.cluster"
+
+
+def _reserved(name: str) -> EndpointSelector:
+    return EndpointSelector(match_labels=((f"reserved:{name}", ""),))
+
+
+def _cluster_entity(cluster_name: str) -> Tuple[EndpointSelector, ...]:
+    # reference entity.go: cluster = host + remote-node + init + health
+    # + ingress + unmanaged + every endpoint carrying the local
+    # cluster label. Notably NOT world / kube-apiserver: a rule
+    # `fromEntities: [cluster]` must not admit world traffic.
+    return (
+        _reserved("host"), _reserved("remote-node"), _reserved("init"),
+        _reserved("health"), _reserved("ingress"), _reserved("unmanaged"),
+        EndpointSelector(
+            match_labels=((f"k8s:{CLUSTER_LABEL_KEY}", cluster_name),)),
+    )
+
+
+_ENTITY_SELECTORS: Dict[str, Tuple[EndpointSelector, ...]] = {
+    "all": (EndpointSelector(),),
+    "world": (_reserved("world"),),
+    "host": (_reserved("host"),),
+    "remote-node": (_reserved("remote-node"),),
+    "health": (_reserved("health"),),
+    "init": (_reserved("init"),),
+    "unmanaged": (_reserved("unmanaged"),),
+    "ingress": (_reserved("ingress"),),
+    "kube-apiserver": (_reserved("kube-apiserver"),),
 }
+
+
+def entity_selectors(entity: str,
+                     cluster_name: str = "default",
+                     ) -> Tuple[EndpointSelector, ...]:
+    """Selectors for an entity. ``cluster`` binds to the CALLER's
+    cluster name (reference api.InitEntities binds it once per agent;
+    here it's an argument so two agents with different cluster names
+    in one process — clustermesh tests do this — don't fight over a
+    process-global)."""
+    if entity == "cluster":
+        return _cluster_entity(cluster_name)
+    sels = _ENTITY_SELECTORS.get(entity)
+    if sels is None:
+        raise SanitizeError(f"unknown entity {entity!r}")
+    return sels
+
+
+@dataclasses.dataclass(frozen=True)
+class CIDRRule:
+    """``fromCIDRSet``/``toCIDRSet`` member (reference:
+    ``pkg/policy/api/cidr.go ·CIDRRule``): a prefix with carve-outs.
+    Excepted sub-CIDRs are SUBTRACTED from the rule's peer set at
+    resolve time — they produce no allow entries, so excepted traffic
+    falls through to default-deny (matching the reference, where
+    excepts become requirements excluding the sub-CIDR identities)."""
+
+    cidr: str
+    except_cidrs: Tuple[str, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -114,6 +165,8 @@ class IngressRule:
     from_endpoints: Tuple[EndpointSelector, ...] = ()
     from_entities: Tuple[str, ...] = ()
     from_cidrs: Tuple[str, ...] = ()
+    from_cidr_set: Tuple[CIDRRule, ...] = ()
+    from_requires: Tuple[EndpointSelector, ...] = ()
     to_ports: Tuple[PortRule, ...] = ()
     icmps: Tuple[ICMPField, ...] = ()
     #: api.Rule Authentication.Mode: "" (unset) | "required" |
@@ -122,10 +175,12 @@ class IngressRule:
     auth_mode: str = ""
     deny: bool = False
 
-    def peer_selectors(self) -> Tuple[EndpointSelector, ...]:
+    def peer_selectors(self, cluster_name: str = "default",
+                       ) -> Tuple[EndpointSelector, ...]:
         sels = list(self.from_endpoints)
-        sels += [_ENTITY_SELECTORS[e] for e in self.from_entities]
-        if not sels and not self.from_cidrs:
+        for e in self.from_entities:
+            sels += entity_selectors(e, cluster_name)
+        if not sels and not self.from_cidrs and not self.from_cidr_set:
             # no peer constraint AT ALL → wildcard peer. A CIDR-only
             # rule must NOT wildcard: its peers are exactly the
             # CIDR-derived identities (resolved in PolicyResolver) —
@@ -170,6 +225,8 @@ class EgressRule:
     to_endpoints: Tuple[EndpointSelector, ...] = ()
     to_entities: Tuple[str, ...] = ()
     to_cidrs: Tuple[str, ...] = ()
+    to_cidr_set: Tuple[CIDRRule, ...] = ()
+    to_requires: Tuple[EndpointSelector, ...] = ()
     to_fqdns: Tuple[FQDNSelector, ...] = ()
     to_services: Tuple[ServiceSelector, ...] = ()
     to_ports: Tuple[PortRule, ...] = ()
@@ -177,11 +234,14 @@ class EgressRule:
     auth_mode: str = ""  # see IngressRule.auth_mode
     deny: bool = False
 
-    def peer_selectors(self) -> Tuple[EndpointSelector, ...]:
+    def peer_selectors(self, cluster_name: str = "default",
+                       ) -> Tuple[EndpointSelector, ...]:
         sels = list(self.to_endpoints)
-        sels += [_ENTITY_SELECTORS[e] for e in self.to_entities]
+        for e in self.to_entities:
+            sels += entity_selectors(e, cluster_name)
         if (not sels and not self.to_fqdns and not self.to_services
-                and not self.to_cidrs):  # see IngressRule: CIDR-only
+                and not self.to_cidrs
+                and not self.to_cidr_set):  # see IngressRule: CIDR-only
             sels = [EndpointSelector()]  # rules must not wildcard
         return tuple(sels)
 
@@ -204,9 +264,39 @@ class Rule:
         """
         from cilium_tpu.policy.compiler import matchpattern, regex_parser
 
+        import ipaddress
+
         for direction, rules in (("ingress", self.ingress),
                                  ("egress", self.egress)):
             for r in rules:
+                for ent in (getattr(r, "from_entities", ())
+                            or getattr(r, "to_entities", ())):
+                    entity_selectors(ent)  # raises on unknown entity
+                plain_cidrs = (getattr(r, "from_cidrs", ())
+                               or getattr(r, "to_cidrs", ()))
+                cidr_set = (getattr(r, "from_cidr_set", ())
+                            or getattr(r, "to_cidr_set", ()))
+                for c in plain_cidrs:
+                    try:
+                        ipaddress.ip_network(c, strict=False)
+                    except ValueError:
+                        raise SanitizeError(f"bad CIDR {c!r}")
+                for cr in cidr_set:
+                    try:
+                        net = ipaddress.ip_network(cr.cidr, strict=False)
+                    except ValueError:
+                        raise SanitizeError(f"bad CIDR {cr.cidr!r}")
+                    for ex in cr.except_cidrs:
+                        try:
+                            exn = ipaddress.ip_network(ex, strict=False)
+                            contained = exn.subnet_of(net)
+                        except (ValueError, TypeError):
+                            raise SanitizeError(f"bad except CIDR {ex!r}")
+                        if not contained:
+                            # reference rule_validation: excepts must be
+                            # inside the rule's CIDR
+                            raise SanitizeError(
+                                f"except {ex} not within {cr.cidr}")
                 if r.icmps and r.to_ports:
                     # reference Rule.Sanitize: ICMPs cannot coexist
                     # with ToPorts in the same rule
@@ -227,6 +317,14 @@ class Rule:
                             f"bad ICMP type {ic.icmp_type}")
                 for pr in r.to_ports:
                     for pp in pr.ports:
+                        if pp.protocol in (Protocol.ICMP, Protocol.ICMPV6):
+                            # upstream rule_validation only allows
+                            # TCP/UDP/SCTP/ANY in toPorts; an ICMP
+                            # toPorts entry would alias a port to an
+                            # ICMP type (use the icmps field instead)
+                            raise SanitizeError(
+                                "ICMP protocols not allowed in toPorts; "
+                                "use the icmps field")
                         if not (0 <= pp.port <= 65535):
                             raise SanitizeError(f"bad port {pp.port}")
                         if pp.end_port and pp.end_port < pp.port:

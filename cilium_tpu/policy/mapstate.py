@@ -43,13 +43,31 @@ PORT_WILDCARD = 0
 @dataclasses.dataclass(frozen=True)
 class MapStateKey:
     identity: int            # peer identity; 0 = wildcard
-    dport: int               # 0 = wildcard
+    dport: int               # masked port prefix base; 0+plen 0 = wildcard
     proto: int               # Protocol; 0 = wildcard
     direction: int           # TrafficDirection
+    #: port prefix length (reference: pkg/policy/mapstate.go keys port
+    #: RANGES via prefix/mask entries, not per-port enumeration):
+    #: 16 = exact port, 0 = wildcard, 1..15 = an aligned 2^(16-plen)
+    #: block starting at ``dport``. None = infer from dport (0 →
+    #: wildcard, else exact) so legacy 4-arg constructions keep their
+    #: meaning.
+    port_plen: Optional[int] = None
+
+    def __post_init__(self):
+        if self.port_plen is None:
+            object.__setattr__(
+                self, "port_plen",
+                0 if self.dport == PORT_WILDCARD else 16)
+
+    @property
+    def port_mask(self) -> int:
+        return 0 if self.port_plen == 0 else (
+            (0xFFFF << (16 - self.port_plen)) & 0xFFFF)
 
     def covers(self, identity: int, dport: int, proto: int,
                direction: int) -> bool:
-        if (self.proto == 0 and self.dport != PORT_WILDCARD
+        if (self.proto == 0 and self.port_plen != 0
                 and proto in _ICMP_PROTOS):
             # a proto-ANY port rule is an L4 (TCP/UDP/SCTP) construct
             # (reference toPorts semantics); it must not match ICMP
@@ -58,15 +76,18 @@ class MapStateKey:
         return (
             self.direction == direction
             and self.identity in (IDENTITY_WILDCARD, identity)
-            and self.dport in (PORT_WILDCARD, dport)
+            and (dport & self.port_mask) == self.dport
             and self.proto in (0, proto)
         )
 
     @property
     def specificity(self) -> int:
+        # peer > port (longer prefix > shorter) > proto; the peer
+        # component (34) exceeds the max port+proto component (33) so
+        # an L3-specific entry still beats any L4-only entry
         return (
-            (4 if self.identity != IDENTITY_WILDCARD else 0)
-            + (2 if self.dport != PORT_WILDCARD else 0)
+            (34 if self.identity != IDENTITY_WILDCARD else 0)
+            + 2 * self.port_plen
             + (1 if self.proto != 0 else 0)
         )
 
@@ -173,10 +194,62 @@ ICMP_TYPE_BIT = 1 << 15
 _ICMP_PROTOS = (int(Protocol.ICMP), int(Protocol.ICMPV6))
 
 
+def port_range_blocks(lo: int, hi: int) -> List[Tuple[int, int]]:
+    """Decompose an inclusive port range into maximal aligned
+    power-of-two blocks ``(base, prefix_len)`` — CIDR-style over the
+    16-bit port space (reference: ``pkg/policy/mapstate.go`` keys port
+    ranges via mask entries). ``1024-65535`` → 6 blocks."""
+    out: List[Tuple[int, int]] = []
+    while lo <= hi:
+        size = (lo & -lo) or (1 << 16)
+        while size > hi - lo + 1:
+            size >>= 1
+        out.append((lo, 16 - (size.bit_length() - 1)))
+        lo += size
+    return out
+
+
 def effective_dport(dport: int, proto: int) -> int:
     """Flow-side key port: ICMP types get the marker bit (always, so
     type 0 matches a type-0 rule entry and never the port wildcard)."""
     return dport | ICMP_TYPE_BIT if proto in _ICMP_PROTOS else dport
+
+
+def _collect_requirements(selectors) -> Tuple:
+    """fromRequires/toRequires selectors → conjunctive MatchExpressions
+    (reference converts each required matchLabel into an ``In``
+    requirement merged into the direction's peer selectors)."""
+    from cilium_tpu.policy.api.selector import MatchExpression
+
+    reqs = []
+    for sel in selectors:
+        for k, v in sel.match_labels:
+            if v:
+                reqs.append(MatchExpression(key=k, operator="In",
+                                            values=(v,)))
+            else:
+                reqs.append(MatchExpression(key=k, operator="Exists"))
+        reqs.extend(sel.match_expressions)
+    return tuple(reqs)
+
+
+def _require(peer_selectors, reqs):
+    """AND the requirements into every label-based peer selector. A
+    wildcard peer stops being the map-key wildcard: it becomes a real
+    selector over the requirements (requirements constrain even
+    all-peer rules; CIDR/FQDN/service-derived peers are unaffected,
+    matching the reference where requires merge into fromEndpoints)."""
+    from cilium_tpu.policy.api.selector import EndpointSelector
+
+    if not reqs:
+        return peer_selectors
+    return tuple(
+        EndpointSelector(
+            match_labels=sel.match_labels,
+            match_expressions=tuple(sel.match_expressions) + reqs,
+        )
+        for sel in peer_selectors
+    )
 
 
 class PolicyResolver:
@@ -184,9 +257,14 @@ class PolicyResolver:
     EndpointPolicy analog, SURVEY.md §3.2)."""
 
     def __init__(self, repo: Repository, selector_cache: SelectorCache,
-                 services=None, backend_identity=None):
+                 services=None, backend_identity=None,
+                 cluster_name: str = "default"):
         self.repo = repo
         self.cache = selector_cache
+        #: local cluster name: the `cluster` entity's selectors bind to
+        #: it (reference api.InitEntities — per-resolver here, not a
+        #: process-global, so co-resident agents don't fight)
+        self.cluster_name = cluster_name
         #: optional ServiceManager: `toServices` resolves against its
         #: k8s metadata (reference: pkg/k8s service cache feeding
         #: resolveEgressPolicy); None → toServices selects nothing
@@ -197,22 +275,39 @@ class PolicyResolver:
 
     def resolve(self, endpoint_labels: LabelSet) -> MapState:
         ms = MapState()
-        for rule in self.repo.matching_rules(endpoint_labels):
+        matching = list(self.repo.matching_rules(endpoint_labels))
+        # fromRequires/toRequires (reference: api.IngressRule.FromRequires,
+        # aggregated in rule.go ·GetSourceEndpointSelectorsWithRequirements):
+        # requirements from ANY rule selecting this endpoint are ANDed
+        # into EVERY label-based peer selector for the direction — they
+        # grant nothing themselves, they only constrain.
+        ingress_reqs = _collect_requirements(
+            sel for rule in matching for ir in rule.ingress
+            for sel in ir.from_requires)
+        egress_reqs = _collect_requirements(
+            sel for rule in matching for er in rule.egress
+            for sel in er.to_requires)
+        for rule in matching:
             rule_id = rule.key
             for ir in rule.ingress:
                 ms.ingress_enforced = True
                 self._apply_direction(
-                    ms, TrafficDirection.INGRESS, ir.peer_selectors(),
+                    ms, TrafficDirection.INGRESS,
+                    _require(ir.peer_selectors(self.cluster_name),
+                             ingress_reqs),
                     ir.to_ports, ir.deny, rule_id, ir.from_cidrs, (),
                     icmps=ir.icmps, auth=ir.auth_mode,
+                    cidr_set=ir.from_cidr_set,
                 )
             for er in rule.egress:
                 ms.egress_enforced = True
                 self._apply_direction(
-                    ms, TrafficDirection.EGRESS, er.peer_selectors(),
+                    ms, TrafficDirection.EGRESS,
+                    _require(er.peer_selectors(self.cluster_name),
+                             egress_reqs),
                     er.to_ports, er.deny, rule_id, er.to_cidrs, er.to_fqdns,
                     services=er.to_services, icmps=er.icmps,
-                    auth=er.auth_mode,
+                    auth=er.auth_mode, cidr_set=er.to_cidr_set,
                 )
         self._propagate_auth(ms)
         return ms
@@ -242,7 +337,7 @@ class PolicyResolver:
     def _apply_direction(
         self, ms: MapState, direction: int, peer_selectors, to_ports,
         deny: bool, rule_id: str, cidrs, fqdns, services=(), icmps=(),
-        auth: str = "",
+        auth: str = "", cidr_set=(),
     ) -> None:
         peer_ids: Set[int] = set()
         wildcard_peer = False
@@ -255,6 +350,15 @@ class PolicyResolver:
             peer_ids.update(self.cache.get_selections(fsel))
         for cidr in cidrs:
             peer_ids.update(self._cidr_identities(cidr))
+        for cr in cidr_set:
+            # CIDRRule.except: carve-outs SUBTRACT — an identity inside
+            # an excepted sub-CIDR (it carries the except prefix among
+            # its ancestor cidr: labels) gets no allow entry from this
+            # rule and falls through to default-deny
+            ids = set(self._cidr_identities(cr.cidr))
+            for ex in cr.except_cidrs:
+                ids -= self._cidr_identities(ex)
+            peer_ids.update(ids)
         for svc_sel in services:
             peer_ids.update(self._service_identities(svc_sel))
         if wildcard_peer:
@@ -267,21 +371,27 @@ class PolicyResolver:
         # each PortRule contributes its own entries — entries at the same
         # key merge (union of L7 rule sets; wildcard-wins is preserved
         # because a no-L7 PortRule contributes l7_wildcard=True)
-        contributions: List[Tuple[int, int, Optional[L7Rules]]] = []
+        # contribution = (port-base, port-plen, proto, l7)
+        contributions: List[Tuple[int, int, int, Optional[L7Rules]]] = []
         if to_ports:
             for pr in to_ports:
                 l7 = pr.rules if (pr.rules and not pr.rules.is_empty()) else None
                 if not pr.ports:
-                    contributions.append((PORT_WILDCARD, 0, l7))
+                    contributions.append((PORT_WILDCARD, 0, 0, l7))
                 for pp in pr.ports:
-                    for port in pp.ports():
-                        # a toPorts entry under the ICMP protocol keys
-                        # like the icmps form (port slot carries the
-                        # marked type); PORT_WILDCARD stays a wildcard
-                        if port != PORT_WILDCARD:
-                            port = effective_dport(port,
-                                                   int(pp.protocol))
-                        contributions.append((port, int(pp.protocol), l7))
+                    proto = int(pp.protocol)
+                    if pp.end_port and pp.end_port > pp.port:
+                        # a port RANGE becomes O(log) aligned prefix
+                        # blocks, not per-port keys (reference:
+                        # mapstate.go port-range entries) — 1024-65535
+                        # is 6 rows, not 64512
+                        for base, plen in port_range_blocks(
+                                pp.port, pp.end_port):
+                            contributions.append((base, plen, proto, l7))
+                    elif pp.port == PORT_WILDCARD:
+                        contributions.append((PORT_WILDCARD, 0, proto, l7))
+                    else:
+                        contributions.append((pp.port, 16, proto, l7))
         elif icmps:
             # ICMP keys as the datapath encodes them: the marked type
             # in the port slot (one encoding, shared with the flow
@@ -290,12 +400,12 @@ class PolicyResolver:
                 contributions.append(
                     (effective_dport(int(ic.icmp_type),
                                      int(ic.protocol)),
-                     int(ic.protocol), None))
+                     16, int(ic.protocol), None))
         else:
-            contributions.append((PORT_WILDCARD, 0, None))
+            contributions.append((PORT_WILDCARD, 0, 0, None))
 
         for identity in ids:
-            for port, proto, l7 in contributions:
+            for port, plen, proto, l7 in contributions:
                 entry = MapStateEntry(
                     is_deny=deny,
                     l7_rules=(l7,) if (l7 and not deny) else (),
@@ -306,7 +416,7 @@ class PolicyResolver:
                 )
                 ms.insert(
                     MapStateKey(identity=identity, dport=port, proto=proto,
-                                direction=direction),
+                                direction=direction, port_plen=plen),
                     entry,
                 )
 
@@ -331,11 +441,20 @@ class PolicyResolver:
     def _cidr_identities(self, cidr: str) -> FrozenSet[int]:
         """CIDR → local identities. v0: CIDRs are registered with the
         selector cache as labels ``cidr:<prefix>`` by the ipcache
-        (SURVEY.md §2.1 ipcache); resolve via label match."""
-        from cilium_tpu.core.labels import Label, LabelSet
+        (SURVEY.md §2.1 ipcache); resolve via label match. The rule's
+        CIDR string is NORMALIZED (host bits masked) before matching —
+        ipcache labels are normalized, and a verbatim mismatch on an
+        ``except`` clause would silently fail open."""
+        import ipaddress
 
+        from cilium_tpu.core.labels import Label
+
+        try:
+            key = str(ipaddress.ip_network(cidr, strict=False))
+        except ValueError:
+            return frozenset()  # unsanitized garbage selects nothing
         out = set()
         for nid, lbls in self.cache.identities().items():
-            if lbls.has(Label(key=cidr, source="cidr")):
+            if lbls.has(Label(key=key, source="cidr")):
                 out.add(nid)
         return frozenset(out)

@@ -165,11 +165,17 @@ class OracleVerdictEngine:
     def verdict_flows(self, flows: Sequence[Flow], authed_pairs=None):
         """``authed_pairs``: lex-sorted [P, 2] int32 (src, dst) table
         (AuthManager.pairs_array; sentinel rows ignored) — same
-        contract as VerdictEngine.verdict_flows."""
+        contract as VerdictEngine.verdict_flows: ``None`` is
+        fail-closed (auth-demanding flows drop), ``AUTH_UNENFORCED``
+        leaves the demand as an output lane only."""
         import numpy as np
 
-        if authed_pairs is None:
+        from cilium_tpu.auth import AUTH_UNENFORCED
+
+        if authed_pairs is AUTH_UNENFORCED:
             pairs = None
+        elif authed_pairs is None:
+            pairs = set()  # fail closed: no handshake recorded yet
         else:
             table = np.asarray(authed_pairs).reshape(-1, 2)
             pairs = {(int(s), int(d)) for s, d in table}
@@ -189,9 +195,10 @@ class OracleVerdictEngine:
             "auth_required": np.array(auth, dtype=bool),
         }
 
-    def verdict_records(self, rec):
+    def verdict_records(self, rec, authed_pairs=None):
         """Interface parity with VerdictEngine.verdict_records (the
         oracle has no columnar path; records round-trip through Flow)."""
         from cilium_tpu.ingest.binary import records_to_flows
 
-        return self.verdict_flows(records_to_flows(rec))
+        return self.verdict_flows(records_to_flows(rec),
+                                  authed_pairs=authed_pairs)

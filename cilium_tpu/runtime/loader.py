@@ -66,17 +66,19 @@ class Loader:
 
         from cilium_tpu.engine.verdict import CompiledPolicy, VerdictEngine
 
-        # "policy-v2": the packed format gained the ms_auth array — a
-        # version bump invalidates pre-auth cached artifacts, and the
-        # entry tuple must include auth_required or two policies
-        # differing only in authentication would share one artifact
+        # "policy-v3": v2 gained the ms_auth array; v3 gained port-range
+        # prefix keys (ms_plens + the w2 repack) — each bump invalidates
+        # older cached artifacts, and the entry tuple must include every
+        # verdict-relevant key/entry field or two policies differing
+        # only in that field would share one artifact
         key = ruleset_fingerprint(
-            "policy-v2",
+            "policy-v3",
             sorted(
                 (
                     ep,
                     tuple(sorted(
                         (k.identity, k.dport, k.proto, k.direction,
+                         k.port_plen,
                          e.is_deny, e.l7_wildcard, e.auth_required,
                          tuple(sorted(repr(lr) for lr in e.l7_rules)))
                         for k, e in ms.entries.items()

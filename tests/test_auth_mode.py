@@ -6,6 +6,7 @@ auth_required output lane — the mutual-auth subsystem's datapath hook.
 import pytest
 
 from cilium_tpu.agent import Agent
+from cilium_tpu.auth import AUTH_UNENFORCED
 from cilium_tpu.core.config import Config
 from cilium_tpu.core.flow import Flow, TrafficDirection
 from cilium_tpu.policy.api import SanitizeError
@@ -43,14 +44,45 @@ def test_auth_required_lane(offload):
                         dport=dport,
                         direction=TrafficDirection.INGRESS)
 
+        # lane-only check: AUTH_UNENFORCED waives drop-until-authed
+        # (passing nothing is fail-closed and would drop flow 0)
         out = agent.loader.engine.verdict_flows([
             f(peer.identity, 443),      # allowed, auth demanded
             f(open_ep.identity, 80),    # allowed, no auth
             f(peer.identity, 80),       # dropped (no rule)
-        ])
+        ], authed_pairs=AUTH_UNENFORCED)
         assert [int(v) for v in out["verdict"]] == [1, 1, 2], offload
         assert [bool(a) for a in out["auth_required"]] == \
             [True, False, False], offload
+    finally:
+        agent.stop()
+
+
+@pytest.mark.parametrize("offload", [False, True])
+def test_auth_fails_closed_without_pairs_table(offload):
+    """ADVICE r1: a verdict path with no authed-pairs table must DROP
+    auth-demanding traffic (None = fail-closed), not forward it."""
+    cfg = Config()
+    cfg.enable_tpu_offload = offload
+    cfg.configure_logging = False
+    agent = Agent(cfg).start()
+    try:
+        svc = agent.endpoint_add(1, {"app": "svc"})
+        peer = agent.endpoint_add(2, {"app": "peer"})
+        open_ep = agent.endpoint_add(3, {"app": "open"})
+        agent.policy_add(load_cnp_yaml_text(CNP)[0])
+
+        def f(src, dport):
+            return Flow(src_identity=src, dst_identity=svc.identity,
+                        dport=dport,
+                        direction=TrafficDirection.INGRESS)
+
+        out = agent.loader.engine.verdict_flows([
+            f(peer.identity, 443),    # auth demanded, no table → DROP
+            f(open_ep.identity, 80),  # no auth → forward
+        ])
+        assert [int(v) for v in out["verdict"]] == [2, 1], offload
+        assert bool(out["auth_required"][0])
     finally:
         agent.stop()
 
@@ -117,7 +149,8 @@ spec:
                         dst_identity=svc.identity, dport=dport,
                         direction=TrafficDirection.INGRESS)
 
-        out = agent.loader.engine.verdict_flows([f(443), f(8080), f(22)])
+        out = agent.loader.engine.verdict_flows(
+            [f(443), f(8080), f(22)], authed_pairs=AUTH_UNENFORCED)
         assert [int(v) for v in out["verdict"]] == [1, 1, 1], offload
         # 443: narrower allow inherits the broad required-auth;
         # 8080: explicit disabled carves the exception;

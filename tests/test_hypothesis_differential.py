@@ -108,7 +108,14 @@ def test_matchpattern_dfa_equals_oracle(globs, names):
 # protos include ICMP(1)/ICMPv6(58) so the encoding semantics are
 # property-checked, not just unit-tested
 _IDS = [0, 100, 200, 300]          # 0 = wildcard peer
-_PORTS = [0, 53, 80, 32768, 0x8000 | 8]   # 0 = wildcard port
+#: (port-base, plen): exact ports and range prefix blocks (plen<16)
+#: — port RANGES are first-class keys since policy-v3; None plen =
+#: legacy inference (0 → wildcard, else exact)
+_PORTS = [(0, None), (53, None), (80, None), (32768, None),
+          (0x8000 | 8, None),
+          (1024, 6),     # 1024-2047 block (from a 1024-65535 range)
+          (80, 14),      # 80-83 block
+          (0, 1)]        # 0-32767 block (base 0 but NOT a wildcard)
 _PROTOS = [0, 6, 17, 1, 58]        # 0 = wildcard proto
 
 _entry = st.tuples(
@@ -127,7 +134,8 @@ _entry = st.tuples(
     flags=st.tuples(st.booleans(), st.booleans()),
     probes=st.lists(
         st.tuples(st.sampled_from([100, 200, 300, 999]),
-                  st.sampled_from([0, 8, 53, 80, 443, 32768]),
+                  st.sampled_from([0, 8, 53, 80, 82, 443, 1500, 32768,
+                                   40000]),
                   st.sampled_from([6, 17, 1, 58]),
                   st.sampled_from([TrafficDirection.INGRESS,
                                    TrafficDirection.EGRESS])),
@@ -136,8 +144,9 @@ _entry = st.tuples(
 def test_mapstate_kernel_equals_golden(entries, flags, probes):
     ms = MapState()
     ms.ingress_enforced, ms.egress_enforced = flags
-    for peer, port, proto, direction, deny, auth in entries:
-        ms.insert(MapStateKey(peer, port, proto, int(direction)),
+    for peer, (port, plen), proto, direction, deny, auth in entries:
+        ms.insert(MapStateKey(peer, port, proto, int(direction),
+                              port_plen=plen),
                   MapStateEntry(is_deny=deny,
                                 auth_required=auth and not deny))
     per_identity = {7: ms}
@@ -156,7 +165,8 @@ def test_mapstate_kernel_equals_golden(entries, flags, probes):
         jnp.asarray([p[1] for p in probes], dtype=jnp.int32),
         jnp.asarray([p[2] for p in probes], dtype=jnp.int32),
         jnp.asarray([int(p[3]) for p in probes], dtype=jnp.int32),
-        auth=jnp.asarray(packed.auth))
+        auth=jnp.asarray(packed.auth),
+        port_plens=jnp.asarray(packed.port_plens))
     got = np.asarray(out["allowed"])
     got_auth = np.asarray(out["auth_required"])
 

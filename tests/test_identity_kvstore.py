@@ -179,9 +179,13 @@ def test_cross_node_policy_enforcement(tmp_path):
     try:
         db = agent_a.endpoint_add(1, {"app": "db"})
         web_remote = agent_b.endpoint_add(2, {"app": "web"})
-        # same labels, either node → same numeric identity
+        # same labels, either node → same numeric identity (endpoint
+        # labels are normalized with the cluster label on add)
+        from cilium_tpu.endpoint import with_cluster_label
+
         assert agent_a.allocator.lookup_by_labels(
-            LabelSet.from_dict({"app": "web"})) == web_remote.identity
+            with_cluster_label(LabelSet.from_dict({"app": "web"}),
+                               "default")) == web_remote.identity
         agent_a.policy_add(load_cnp_yaml_text("""
 apiVersion: cilium.io/v2
 kind: CiliumNetworkPolicy
