@@ -14,11 +14,16 @@ ZERO readbacks. Distinct permuted batches are staged from host numpy
 buffers, and verdict values are only read back after the last timer
 stops. Oracle checking (--check) also runs after timing.
 
-Prints exactly ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Prints exactly ONE JSON line per config (the BASELINE metric is
+throughput AND latency, so the line carries both):
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+   "p50_ms": N, "p99_ms": N}
+
+``--config all`` runs every BASELINE config and prints one line each
+(the default single-config invocation still prints exactly one line).
 
 Usage: python bench.py [--rules 1000] [--flows 10000] [--iters 20]
-       [--config http] [--check]
+       [--config http|fqdn|kafka|mixed|clustermesh|all] [--check]
 """
 
 from __future__ import annotations
@@ -28,28 +33,12 @@ import json
 import sys
 import time
 
+#: per-config BASELINE flow/tuple shapes
+_DEFAULT_FLOWS = {"http": 10000, "fqdn": 10000, "kafka": 100000,
+                  "mixed": 1000000, "clustermesh": 100000}
 
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--config", default="http",
-                    choices=["http", "fqdn", "kafka", "mixed",
-                             "clustermesh"])
-    ap.add_argument("--rules", type=int, default=1000)
-    ap.add_argument("--flows", type=int, default=None,
-                    help="flow/tuple count (default: per-config BASELINE "
-                         "shape: http/fqdn 10k, kafka 100k, mixed 1M, "
-                         "clustermesh 100k)")
-    ap.add_argument("--iters", type=int, default=20)
-    ap.add_argument("--warmup", type=int, default=3)
-    ap.add_argument("--check", action="store_true",
-                    help="verify engine vs oracle on a sample (after timing)")
-    ap.add_argument("--profile", metavar="DIR",
-                    help="capture a jax.profiler device trace of the "
-                         "timed passes into DIR (open with Perfetto / "
-                         "tensorboard; SURVEY.md §5.1)")
-    ap.add_argument("--verbose", action="store_true")
-    args = ap.parse_args()
 
+def run_config(config: str, args) -> dict:
     import jax
     import numpy as np
 
@@ -67,9 +56,7 @@ def main() -> int:
         if args.verbose:
             print(msg, file=sys.stderr)
 
-    if args.flows is None:
-        args.flows = {"http": 10000, "fqdn": 10000, "kafka": 100000,
-                      "mixed": 1000000, "clustermesh": 100000}[args.config]
+    n_flows = args.flows if args.flows is not None else _DEFAULT_FLOWS[config]
 
     import contextlib
 
@@ -89,26 +76,26 @@ def main() -> int:
             jax.profiler.stop_trace()
             log(f"profiler trace written to {args.profile}")
 
-    if args.config == "http":
+    if config == "http":
         scenario = synth.synth_http_scenario(n_rules=args.rules,
-                                             n_flows=args.flows)
-    elif args.config == "fqdn":
+                                             n_flows=n_flows)
+    elif config == "fqdn":
         scenario = synth.synth_fqdn_scenario(n_names=100, n_rules=args.rules,
-                                             n_flows=args.flows)
-    elif args.config == "mixed":
+                                             n_flows=n_flows)
+    elif config == "mixed":
         # BASELINE configs[3]: examples/policies corpus × synthetic tuples
         import os
         corpus = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "examples", "policies")
-        scenario = synth.synth_mixed_scenario(corpus, n_tuples=args.flows)
-    elif args.config == "clustermesh":
+        scenario = synth.synth_mixed_scenario(corpus, n_tuples=n_flows)
+    elif config == "clustermesh":
         # BASELINE configs[4]: 10k identities × 5k CNP, streaming
         scenario = synth.synth_clustermesh_scenario(
-            n_identities=10000, n_policies=5000, n_flows=args.flows)
+            n_identities=10000, n_policies=5000, n_flows=n_flows)
     else:
         scenario = synth.synth_kafka_scenario(n_rules=args.rules,
-                                              n_records=args.flows)
-    streaming = args.config in ("mixed", "clustermesh")
+                                              n_records=n_flows)
+    streaming = config in ("mixed", "clustermesh")
     per_identity, scenario = synth.realize_scenario(scenario)
 
     cfg = Config.from_env()
@@ -134,9 +121,8 @@ def main() -> int:
         n_total = fb.size
         n_chunks = n_total // bs
         if n_chunks < args.warmup + 4:  # compile + >=1 latency + >=2 tput
-            print(json.dumps({"metric": "bench_failed_setup", "value": 0,
-                              "unit": "too few chunks", "vs_baseline": 0.0}))
-            return 1
+            return {"metric": "bench_failed_setup", "value": 0,
+                    "unit": "too few chunks", "vs_baseline": 0.0}
         chunks = []
         for c in range(n_chunks):
             sl = slice(c * bs, (c + 1) * bs)
@@ -177,10 +163,11 @@ def main() -> int:
         n_timed = (n_chunks - first) * bs
         vps = n_timed / t_stream
         times.sort()
-        p99 = times[min(len(times) - 1, int(len(times) * 0.99))]
+        p50_ms = times[len(times) // 2] * 1e3
+        p99_ms = times[min(len(times) - 1, int(len(times) * 0.99))] * 1e3
         log(f"streamed {n_timed} of {n_total} flows in {t_stream:.3f}s "
-            f"(chunk={bs}, per-chunk p50={times[len(times)//2]*1e3:.2f}ms, "
-            f"p99={p99*1e3:.2f}ms) verdicts/s={vps:,.0f}")
+            f"(chunk={bs}, per-chunk p50={p50_ms:.2f}ms, "
+            f"p99={p99_ms:.2f}ms) verdicts/s={vps:,.0f}")
     else:
         # Distinct, differently-permuted device copies per call — warmup
         # and timed — so no caching layer (compiler CSE, platform replay)
@@ -242,8 +229,10 @@ def main() -> int:
             t_all = sorted(window_times)[len(window_times) // 2]
         out = outs[-1]
         vps = n * args.iters / t_all
-        log(f"batch={n} latency: median={med*1e3:.2f}ms "
-            f"p99-ish={times[-1]*1e3:.2f}ms ({n/med:,.0f}/s blocking); "
+        p50_ms = med * 1e3
+        p99_ms = times[min(len(times) - 1, int(len(times) * 0.99))] * 1e3
+        log(f"batch={n} latency: median={p50_ms:.2f}ms "
+            f"p99={p99_ms:.2f}ms ({n/med:,.0f}/s blocking); "
             f"pipelined verdicts/s={vps:,.0f}")
 
     # ---- timing is over; readbacks are safe now -----------------------
@@ -258,22 +247,55 @@ def main() -> int:
         got = engine.verdict_flows(sample)["verdict"]
         bad = int((got != want).sum())
         if bad:
-            print(json.dumps({"metric": "bench_failed_check",
-                              "value": bad, "unit": "mismatches",
-                              "vs_baseline": 0.0}))
-            return 1
+            return {"metric": "bench_failed_check",
+                    "value": bad, "unit": "mismatches",
+                    "vs_baseline": 0.0}
         log("oracle check: OK")
 
     # http/fqdn/kafka wrap their N sub-rules in one Rule — args.rules is
     # the meaningful count there; mixed/clustermesh have real rule lists
     n_rules = len(scenario.rules) if streaming else args.rules
-    print(json.dumps({
-        "metric": f"l7_verdicts_per_sec_{args.config}_{n_rules}rules",
+    return {
+        "metric": f"l7_verdicts_per_sec_{config}_{n_rules}rules",
         "value": round(vps, 1),
         "unit": "verdicts/s",
         "vs_baseline": round(vps / 10e6, 4),
-    }))
-    return 0
+        # the BASELINE metric's second half: per-batch verdict latency
+        "p50_ms": round(p50_ms, 3),
+        "p99_ms": round(p99_ms, 3),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="http",
+                    choices=["http", "fqdn", "kafka", "mixed",
+                             "clustermesh", "all"])
+    ap.add_argument("--rules", type=int, default=1000)
+    ap.add_argument("--flows", type=int, default=None,
+                    help="flow/tuple count (default: per-config BASELINE "
+                         "shape: http/fqdn 10k, kafka 100k, mixed 1M, "
+                         "clustermesh 100k)")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--check", action="store_true",
+                    help="verify engine vs oracle on a sample (after timing)")
+    ap.add_argument("--profile", metavar="DIR",
+                    help="capture a jax.profiler device trace of the "
+                         "timed passes into DIR (open with Perfetto / "
+                         "tensorboard; SURVEY.md §5.1)")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args()
+
+    configs = (["http", "fqdn", "kafka", "mixed", "clustermesh"]
+               if args.config == "all" else [args.config])
+    rc = 0
+    for config in configs:
+        result = run_config(config, args)
+        print(json.dumps(result), flush=True)
+        if result["metric"].startswith("bench_failed"):
+            rc = 1
+    return rc
 
 
 if __name__ == "__main__":
