@@ -139,3 +139,71 @@ def test_multihost_single_process_fallbacks():
     mesh2 = global_mesh((4, 2), ("data", "expert"))
     assert dict(zip(mesh2.axis_names, mesh2.devices.shape)) == {
         "data": 4, "expert": 2}
+
+
+def test_ep_all_families_shard_and_agree():
+    """DP×EP over the virtual mesh: every DFA family's bank tensors
+    shard on the expert axis (none silently replicate), and verdicts
+    match the single-device engine bit-for-bit on a scenario that
+    exercises path/method/host/header/dns matchers."""
+    from jax.sharding import PartitionSpec
+
+    from cilium_tpu.core.config import EngineConfig
+    from cilium_tpu.engine.verdict import (
+        CompiledPolicy,
+        encode_flows,
+        flowbatch_to_host_dict,
+        verdict_step,
+    )
+    from cilium_tpu.ingest import synth
+    from cilium_tpu.parallel.sharding import (
+        EP_BANKED_FAMILIES,
+        make_sharded_step,
+        shard_flow_batch,
+        shard_policy_arrays,
+    )
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the 8-device virtual mesh")
+
+    # http (path/method/host/header) + fqdn (dns) in one policy set
+    http = synth.synth_http_scenario(n_rules=40, n_flows=48)
+    fqdn = synth.synth_fqdn_scenario(n_names=20, n_rules=10, n_flows=16)
+    per_http, http = synth.realize_scenario(http)
+    per_fqdn, fqdn = synth.realize_scenario(fqdn)
+    # merge: identities don't collide (same deterministic allocator
+    # seeds would — offset the fqdn side)
+    off = 1 << 12
+    per_identity = dict(per_http)
+    for ep, ms in per_fqdn.items():
+        per_identity[ep + off] = ms
+    flows = list(http.flows)
+    for f in fqdn.flows:
+        import dataclasses as _dc
+
+        flows.append(_dc.replace(f, src_identity=f.src_identity + off,
+                                 dst_identity=f.dst_identity + off))
+
+    cfg = EngineConfig(bank_size=4)
+    policy = CompiledPolicy.build(per_identity, cfg)
+    fb = encode_flows(flows, policy.kafka_interns, cfg)
+    host = flowbatch_to_host_dict(fb)
+
+    ref = jax.jit(verdict_step)(
+        {k: jnp.asarray(v) for k, v in policy.arrays.items()},
+        {k: jnp.asarray(v) for k, v in host.items()})
+    ref_v = np.asarray(ref["verdict"])
+
+    mesh = make_mesh((2, 2), ("data", "expert"), jax.devices()[:4])
+    arrays = shard_policy_arrays(policy.arrays, mesh,
+                                 expert_axis="expert")
+    for fam in EP_BANKED_FAMILIES:
+        assert arrays[f"{fam}_trans"].sharding.spec == \
+            PartitionSpec("expert"), fam
+    pad = (-len(flows)) % 2
+    if pad:  # batch axis must divide dp
+        host = {k: np.concatenate([v, v[:pad]]) for k, v in host.items()}
+    sbatch = shard_flow_batch(host, mesh, "data")
+    out = make_sharded_step(mesh, "data")(arrays, sbatch)
+    got_v = np.asarray(out["verdict"])[:len(flows)]
+    np.testing.assert_array_equal(got_v, ref_v)
