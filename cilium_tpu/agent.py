@@ -74,17 +74,28 @@ class Agent:
                                         self.dns_cache)
         self.dns_proxy = DNSProxy(self.name_manager,
                                   use_tpu=self.config.enable_tpu_offload)
-        self.loader = Loader(self.config)
+        # k8s-Secret analog: secret-backed header-match values resolve
+        # against this at compile (SecretStore docstring)
+        from cilium_tpu.secrets import SecretStore
+
+        self.secrets = SecretStore()
+        self.loader = Loader(self.config, secrets=self.secrets)
         # services / kube-proxy replacement (§2.4): Maglev selection;
         # built before the endpoint manager so toServices policy rules
         # resolve against it (backend IPs → identities via the ipcache)
         self.services = ServiceManager()
+        #: toGroups provider registry (reference pkg/policy/api/groups
+        #: callbacks): name → fn(GroupsSpec) -> [cidr]; resolution
+        #: happens at every regeneration so provider refreshes land via
+        #: regenerate_all()
+        self.group_providers = {}
         self.endpoint_manager = EndpointManager(
             self.repo, self.selector_cache, self.allocator, self.loader,
             dns_proxy=self.dns_proxy, state_dir=state_dir,
             services=self.services,
             backend_identity=lambda ip: self.ipcache.lookup(ip),
-            cluster_name=self.config.cluster_name)
+            cluster_name=self.config.cluster_name,
+            group_cidrs=self._resolve_group)
         # backend-set changes alter toServices resolution → regenerate,
         # but only when some rule actually uses toServices: routine
         # backend churn must not trigger full-policy recomputation in
@@ -426,19 +437,26 @@ class Agent:
 
     # -- endpoint API -----------------------------------------------------
     def endpoint_add(self, endpoint_id: int, labels: Dict[str, str],
-                     ipv4: str = ""):
+                     ipv4: str = "", named_ports=None):
         # write_lock (reentrant — API handlers already hold it): the
         # allocate-then-register sequence must not interleave with a
         # cluster-pool allocator swap (_on_pod_cidr_change), which
         # adopts only already-registered endpoints' addresses
         with self.write_lock:
-            return self._endpoint_add_locked(endpoint_id, labels, ipv4)
+            return self._endpoint_add_locked(endpoint_id, labels, ipv4,
+                                             named_ports=named_ports)
 
     def _endpoint_add_locked(self, endpoint_id: int,
-                             labels: Dict[str, str], ipv4: str = ""):
+                             labels: Dict[str, str], ipv4: str = "",
+                             named_ports=None):
         old = self.endpoint_manager.get(endpoint_id)
         if old is not None and old.ipv4 and not ipv4:
             ipv4 = old.ipv4  # re-add (CNI ADD retry) keeps the IP
+        if old is not None and named_ports is None:
+            # same asymmetry guard as the IP: a re-add without
+            # named_ports must not wipe the table (named toPorts rules
+            # would silently resolve to nothing)
+            named_ports = old.named_ports
         if old is not None and old.ipv4 and old.ipv4 == ipv4:
             pass  # unchanged — nothing to allocate or release
         else:
@@ -456,9 +474,37 @@ class Agent:
                 self.ipcache.delete(f"{old.ipv4}/32")
                 self.ipam.release(old.ipv4)
         ep = self.endpoint_manager.add_endpoint(
-            endpoint_id, LabelSet.from_dict(labels), ipv4=ipv4)
+            endpoint_id, LabelSet.from_dict(labels), ipv4=ipv4,
+            named_ports=named_ports)
         self.ipcache.upsert(f"{ipv4}/32", ep.identity)
         return ep
+
+    def register_group_provider(self, name: str, fn) -> None:
+        """``fn(GroupsSpec) -> Iterable[str]`` (CIDRs). Registering
+        re-resolves policies so existing toGroups rules pick it up."""
+        self.group_providers[name] = fn
+        self.endpoint_manager.regenerate_all(wait=True)
+
+    def _resolve_group(self, spec):
+        fn = self.group_providers.get(spec.provider)
+        if fn is None:
+            return ()
+        try:
+            return tuple(fn(spec))
+        except Exception:
+            LOG.warning("group provider %s failed; rule selects nothing",
+                        spec.provider)
+            return ()
+
+    def secret_set(self, namespace: str, name: str, value: str) -> None:
+        """Upsert a secret and re-resolve policies referencing it (the
+        reference's secret-sync watcher triggers regeneration too)."""
+        self.secrets.set(namespace, name, value)
+        self.endpoint_manager.regenerate_all(wait=True)
+
+    def secret_delete(self, namespace: str, name: str) -> None:
+        self.secrets.delete(namespace, name)
+        self.endpoint_manager.regenerate_all(wait=True)
 
     def endpoint_remove(self, endpoint_id: int) -> None:
         with self.write_lock:

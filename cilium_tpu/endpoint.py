@@ -65,6 +65,10 @@ class Endpoint:
     state: EndpointState = EndpointState.WAITING_TO_REGENERATE
     policy_revision: int = 0
     ipv4: str = ""
+    #: container port names (k8s pod spec ports[].name analog): what
+    #: NAMED toPorts entries resolve against at regeneration
+    #: (reference: pkg/policy/l4.go named-port resolution)
+    named_ports: Dict[str, int] = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> Dict:
         return {
@@ -73,6 +77,7 @@ class Endpoint:
             "identity": self.identity,
             "policy_revision": self.policy_revision,
             "ipv4": self.ipv4,
+            "named_ports": dict(self.named_ports),
         }
 
     @classmethod
@@ -83,6 +88,8 @@ class Endpoint:
             identity=int(d.get("identity", 0)),
             policy_revision=int(d.get("policy_revision", 0)),
             ipv4=d.get("ipv4", ""),
+            named_ports={str(k): int(v) for k, v in
+                         (d.get("named_ports") or {}).items()},
             state=EndpointState.RESTORING,
         )
 
@@ -95,7 +102,7 @@ class EndpointManager:
                  dns_proxy=None, state_dir: Optional[str] = None,
                  regen_workers: int = 4,
                  services=None, backend_identity=None,
-                 cluster_name: str = "default"):
+                 cluster_name: str = "default", group_cidrs=None):
         self.repo = repo
         self.cache = selector_cache
         self.allocator = allocator
@@ -107,6 +114,7 @@ class EndpointManager:
         self.services = services
         self.backend_identity = backend_identity
         self.cluster_name = cluster_name
+        self.group_cidrs = group_cidrs
         self._lock = threading.RLock()
         self._endpoints: Dict[int, Endpoint] = {}
         self._pool = ThreadPoolExecutor(max_workers=regen_workers,
@@ -125,9 +133,10 @@ class EndpointManager:
 
     # -- lifecycle --------------------------------------------------------
     def add_endpoint(self, endpoint_id: int, labels: LabelSet,
-                     ipv4: str = "") -> Endpoint:
+                     ipv4: str = "", named_ports=None) -> Endpoint:
         labels = with_cluster_label(labels, self.cluster_name)
-        ep = Endpoint(endpoint_id=endpoint_id, labels=labels, ipv4=ipv4)
+        ep = Endpoint(endpoint_id=endpoint_id, labels=labels, ipv4=ipv4,
+                      named_ports=dict(named_ports or {}))
         ep.identity = self.allocator.allocate(labels)
         self.cache.add_identity(ep.identity, labels)
         with self._lock:
@@ -153,6 +162,19 @@ class EndpointManager:
             self.cache.remove_identity(ep.identity)
         METRICS.set_gauge("cilium_tpu_endpoints", len(self._endpoints))
         self.regenerate_all()
+
+    def update_named_ports(self, endpoint_id: int,
+                           named_ports: Dict[str, int]) -> None:
+        """Rename/remap an endpoint's container ports (k8s pod update):
+        policies with named toPorts re-resolve on the next
+        regeneration, which this triggers."""
+        with self._lock:
+            ep = self._endpoints.get(endpoint_id)
+            if ep is None:
+                return
+            ep.named_ports = {str(k): int(v)
+                              for k, v in named_ports.items()}
+        self.regenerate_all(wait=True)
 
     def get(self, endpoint_id: int) -> Optional[Endpoint]:
         with self._lock:
@@ -191,15 +213,27 @@ class EndpointManager:
                 for ep in eps:
                     ep.state = EndpointState.REGENERATING
             with SpanStat("endpoint_regeneration"):
+                # identity → merged named-port table (endpoints sharing
+                # an identity share a pod template upstream; first
+                # writer wins on a name conflict)
+                np_of: Dict[int, Dict[str, int]] = {}
+                for ep in eps:
+                    table = np_of.setdefault(ep.identity, {})
+                    for k, v in ep.named_ports.items():
+                        table.setdefault(k, v)
                 resolver = PolicyResolver(
                     self.repo, self.cache, services=self.services,
                     backend_identity=self.backend_identity,
-                    cluster_name=self.cluster_name)
+                    cluster_name=self.cluster_name,
+                    named_ports_of=lambda nid: np_of.get(nid, {}))
+                resolver.group_cidrs = self.group_cidrs
                 per_identity = {}
                 resolved = {}
                 for ep in eps:
                     if ep.identity not in resolved:
-                        resolved[ep.identity] = resolver.resolve(ep.labels)
+                        resolved[ep.identity] = resolver.resolve(
+                            ep.labels,
+                            named_ports=np_of.get(ep.identity, {}))
                     per_identity[ep.identity] = resolved[ep.identity]
                 self.loader.regenerate(per_identity, revision=revision)
                 self._update_dns_proxy(eps, resolved)

@@ -185,6 +185,12 @@ class CompiledPolicy:
     header_matcher: _FieldMatcher
     dns_matcher: _FieldMatcher
     revision: int = 0
+    #: per-HTTP-rule proxy-side header rewrites from ADD/DELETE/REPLACE
+    #: mismatch actions: [(action, header-name, value), ...] — the
+    #: shim/Envoy layer owns applying them; the verdict engine only
+    #: carries them (reference: cilium.l7policy filter does the bytes)
+    header_rewrites: List[List[Tuple[str, str, str]]] = \
+        dataclasses.field(default_factory=list)
 
     @classmethod
     def build(
@@ -192,6 +198,7 @@ class CompiledPolicy:
         per_identity: Dict[int, MapState],
         cfg: Optional[EngineConfig] = None,
         revision: int = 0,
+        secret_lookup=None,
     ) -> "CompiledPolicy":
         cfg = cfg or EngineConfig()
 
@@ -269,10 +276,18 @@ class CompiledPolicy:
         host_matcher = _FieldMatcher.build(
             [h.host for h in http_rules if h.host], cfg,
             case_insensitive=True)
+        from cilium_tpu.secrets import resolve_header_value
+
         header_pats: List[str] = []
-        rule_header_lanes: List[List[str]] = []
+        rule_header_lanes: List[List[str]] = []   # FAIL: gate the rule
+        rule_log_lanes: List[List[str]] = []      # LOG: raise l7_log
+        rule_dead: List[bool] = []   # FAIL w/ unresolvable secret
+        header_rewrites: List[List[Tuple[str, str, str]]] = []
         for h in http_rules:
             pats = []
+            log_pats = []
+            rewrites: List[Tuple[str, str, str]] = []
+            dead = False
             for hdr in h.headers:
                 if ":" in hdr:
                     name, value = hdr.split(":", 1)
@@ -280,11 +295,30 @@ class CompiledPolicy:
                     name, value = hdr, ""
                 pats.append(header_requirement_regex(name, value))
             for hm in h.header_matches:
-                if hm.mismatch_action.upper() == "LOG":
-                    continue  # LOG mismatches still allow
-                pats.append(header_requirement_regex(hm.name, hm.value))
+                action = hm.mismatch_action
+                value = resolve_header_value(hm, secret_lookup)
+                if action == "":
+                    # FAIL: mismatch denies; an unresolvable secret
+                    # kills the rule outright (fail closed)
+                    if value is None:
+                        dead = True
+                    else:
+                        pats.append(header_requirement_regex(
+                            hm.name, value))
+                elif action == "LOG":
+                    if value is not None:
+                        log_pats.append(header_requirement_regex(
+                            hm.name, value))
+                else:
+                    # ADD/DELETE/REPLACE: never gate; the rewrite is
+                    # proxy-side (exposed for the shim/Envoy layer)
+                    rewrites.append((action, hm.name, value or ""))
             header_pats.extend(pats)
+            header_pats.extend(log_pats)
             rule_header_lanes.append(pats)
+            rule_log_lanes.append(log_pats)
+            rule_dead.append(dead)
+            header_rewrites.append(rewrites)
         header_matcher = _FieldMatcher.build(header_pats, cfg)
 
         dns_pats = []
@@ -298,10 +332,13 @@ class CompiledPolicy:
         # -- per-rule lane arrays ---------------------------------------
         Rh = max(1, len(http_rules))
         max_hdrs = max([len(p) for p in rule_header_lanes] + [1])
+        max_logs = max([len(p) for p in rule_log_lanes] + [1])
         http_path_lane = np.full(Rh, -1, dtype=np.int32)
         http_method_lane = np.full(Rh, -1, dtype=np.int32)
         http_host_lane = np.full(Rh, -1, dtype=np.int32)
         http_header_lanes = np.full((Rh, max_hdrs), -1, dtype=np.int32)
+        http_log_lanes = np.full((Rh, max_logs), -1, dtype=np.int32)
+        http_rule_dead = np.zeros(Rh, dtype=bool)
         for i, h in enumerate(http_rules):
             if h.path:
                 http_path_lane[i] = path_matcher.lane(h.path)
@@ -311,6 +348,9 @@ class CompiledPolicy:
                 http_host_lane[i] = host_matcher.lane(h.host)
             for j, pat in enumerate(rule_header_lanes[i]):
                 http_header_lanes[i, j] = header_matcher.lane(pat)
+            for j, pat in enumerate(rule_log_lanes[i]):
+                http_log_lanes[i, j] = header_matcher.lane(pat)
+            http_rule_dead[i] = rule_dead[i]
 
         Rk = max(1, len(kafka_rules))
         kafka_apikey_mask = np.zeros(Rk, dtype=np.uint32)   # 0 = any
@@ -386,6 +426,8 @@ class CompiledPolicy:
             "http_method_lane": http_method_lane,
             "http_host_lane": http_host_lane,
             "http_header_lanes": http_header_lanes,
+            "http_log_lanes": http_log_lanes,
+            "http_rule_dead": http_rule_dead,
             "kafka_apikey_mask": kafka_apikey_mask,
             "kafka_version": kafka_version,
             "kafka_client": kafka_client,
@@ -428,6 +470,7 @@ class CompiledPolicy:
             header_matcher=header_matcher,
             dns_matcher=dns_matcher,
             revision=revision,
+            header_rewrites=header_rewrites,
         )
 
 
@@ -688,6 +731,10 @@ def verdict_step(arrays: Dict[str, jax.Array], batch: Dict[str, jax.Array]
     hdr_ok = jax.vmap(lambda lanes: _rule_bit(hdr_w, lanes),
                       in_axes=1, out_axes=2)(hdr_lanes)  # [B, R, H]
     rule_ok = rule_ok & jnp.all(hdr_ok, axis=2)
+    # a FAIL header match whose secret is unresolvable kills the rule
+    # (fail closed — compiler marks it dead)
+    if "http_rule_dead" in arrays:
+        rule_ok = rule_ok & ~arrays["http_rule_dead"][None, :]
 
     http_mask = arrays["rs_http_mask"][ruleset]      # [B, Wh]
     Wh = http_mask.shape[1]
@@ -696,6 +743,23 @@ def verdict_step(arrays: Dict[str, jax.Array], batch: Dict[str, jax.Array]
     # flow.http is None → no HTTP rule matches)
     http_ok = (jnp.any((rule_words & http_mask) != 0, axis=1)
                & (l7t == int(L7Type.HTTP)))
+
+    # LOG-action header matches: a matching rule whose LOG lane
+    # mismatched raises the flow's l7_log lane (allow + log, the
+    # reference's access-log annotation)
+    if "http_log_lanes" in arrays:
+        log_lanes = arrays["http_log_lanes"]         # [R, G]
+        log_bits = jax.vmap(lambda lanes: _rule_bit(hdr_w, lanes),
+                            in_axes=1, out_axes=2)(log_lanes)
+        # padding lanes (-1) read True via _rule_bit → ~bits masks them
+        log_fail = jnp.any(~log_bits, axis=2)        # [B, R]
+        r_idx = jnp.arange(rule_ok.shape[1])
+        in_set = ((http_mask[:, r_idx >> 5]
+                   >> (r_idx & 31).astype(jnp.uint32)) & 1).astype(bool)
+        l7_log_http = jnp.any(rule_ok & in_set & log_fail, axis=1) \
+            & http_ok
+    else:
+        l7_log_http = jnp.zeros_like(http_ok)
 
     # Kafka: columnar exact/set matching
     ak = jnp.clip(batch["kafka_api_key"], 0, 31).astype(jnp.uint32)
@@ -769,6 +833,7 @@ def verdict_step(arrays: Dict[str, jax.Array], batch: Dict[str, jax.Array]
         "l3l4_allowed": ms["allowed"],
         "redirect": ms["redirect"],
         "l7_ok": l7_ok,
+        "l7_log": l7_log_http & allowed & ms["redirect"],
         "match_spec": ms["match_spec"],
         "ruleset": ms["ruleset"],
         "auth_required": ms["auth_required"],

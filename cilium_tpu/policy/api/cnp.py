@@ -19,6 +19,7 @@ import yaml
 from cilium_tpu.policy.api.rule import (
     CIDRRule,
     EgressRule,
+    GroupsSpec,
     ICMPField,
     IngressRule,
     PortRule,
@@ -137,6 +138,8 @@ def _parse_egress(d: Dict, deny: bool) -> EgressRule:
         ),
         to_services=tuple(_parse_service_selector(s)
                           for s in (d.get("toServices") or ())),
+        to_groups=tuple(GroupsSpec.from_dict(g)
+                        for g in (d.get("toGroups") or ())),
         icmps=_parse_icmps(d),
         auth_mode=(d.get("authentication") or {}).get("mode", "") or "",
         to_ports=tuple(PortRule.from_dict(p) for p in (d.get("toPorts") or ())),

@@ -24,15 +24,48 @@ import dataclasses
 from typing import Dict, Optional, Tuple
 
 
+class SanitizeError(ValueError):
+    """Raised on an invalid rule (parse or ``Rule.sanitize``).
+
+    Lives here (the bottom of the policy/api import chain) so both the
+    L7 types and rule.py can raise it; rule.py re-exports it as the
+    public name."""
+
+
+#: valid HeaderMatch mismatch actions (reference api.MismatchAction).
+#: Verdict semantics: "" (FAIL) denies on mismatch; LOG allows and
+#: raises the flow's ``l7_log`` lane; ADD/DELETE/REPLACE allow — the
+#: rewrite is applied proxy-side (exposed as CompiledPolicy
+#: header_rewrites for the shim/Envoy layer, which owns the bytes).
+MISMATCH_ACTIONS = ("", "LOG", "ADD", "DELETE", "REPLACE")
+
+
+def _header_value_str(value) -> str:
+    """Header values are strings by contract. YAML 1.1 silently turns
+    unquoted ``yes``/``on``/``true`` into bools — str() would compile a
+    requirement for the literal 'True', denying exactly what the
+    author wrote, so reject loudly instead."""
+    if value is None:
+        return ""
+    if isinstance(value, bool):
+        raise SanitizeError(
+            "headerMatches value parsed as a YAML boolean — quote it "
+            '(e.g. value: "yes")')
+    return str(value)
+
+
 @dataclasses.dataclass(frozen=True)
 class HeaderMatch:
-    """Secret-less subset of the reference's HeaderMatch (mismatch
-    actions LOG/ADD/DELETE/REPLACE are accepted but only LOG affects the
-    verdict model: mismatch with action LOG still allows)."""
+    """Reference HeaderMatch: name + expected value (inline or
+    secret-backed) + mismatch action. ``secret`` is a (namespace, name)
+    reference resolved against the agent's secret store at compile; an
+    unresolvable secret on a FAIL match fails CLOSED (never matches),
+    mirroring the reference's inaccessible-secret behavior."""
 
     name: str
     value: str = ""
     mismatch_action: str = ""  # "" = deny on mismatch (default)
+    secret: Optional[Tuple[str, str]] = None  # (namespace, name)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,9 +85,12 @@ class PortRuleHTTP:
             headers=tuple(d.get("headers") or ()),
             header_matches=tuple(
                 HeaderMatch(
-                    name=h["name"],
-                    value=h.get("value", "") or "",
-                    mismatch_action=h.get("mismatch", "") or "",
+                    name=str(h["name"]),
+                    value=_header_value_str(h.get("value")),
+                    mismatch_action=(h.get("mismatch", "") or "").upper(),
+                    secret=((h["secret"].get("namespace", "default"),
+                             h["secret"]["name"])
+                            if h.get("secret") else None),
                 )
                 for h in (d.get("headerMatches") or ())
             ),

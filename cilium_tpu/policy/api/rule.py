@@ -19,12 +19,17 @@ from typing import Dict, Iterable, Optional, Tuple
 
 from cilium_tpu.core.flow import Protocol
 from cilium_tpu.core.labels import LabelSet
-from cilium_tpu.policy.api.l7 import L7Rules, KAFKA_API_KEYS
+from cilium_tpu.policy.api.l7 import (
+    L7Rules,
+    KAFKA_API_KEYS,
+    MISMATCH_ACTIONS,
+    SanitizeError,
+)
 from cilium_tpu.policy.api.selector import EndpointSelector, FQDNSelector
 
 
-class SanitizeError(ValueError):
-    """Raised by ``Rule.sanitize`` on an invalid rule."""
+# SanitizeError is defined in l7.py (the bottom of the api import
+# chain) and re-exported here as the long-standing public name.
 
 
 _PROTO_NAMES = {
@@ -37,20 +42,41 @@ _PROTO_NAMES = {
 }
 
 
+#: IANA service-name shape (k8s container port names): 1-15 chars of
+#: [a-z0-9-], at least one letter, no leading/trailing/double dash
+def _valid_port_name(name: str) -> bool:
+    if not (1 <= len(name) <= 15) or name != name.lower():
+        return False
+    if name.startswith("-") or name.endswith("-") or "--" in name:
+        return False
+    if not all(c.isalnum() or c == "-" for c in name):
+        return False
+    return any(c.isalpha() for c in name)
+
+
 @dataclasses.dataclass(frozen=True)
 class PortProtocol:
     port: int = 0            # 0 = all ports
     protocol: Protocol = Protocol.ANY
     end_port: int = 0        # inclusive range end; 0 = single port
+    #: NAMED port (reference pkg/policy/api/l4.go: Port may be an IANA
+    #: service name): resolved against endpoint named-port tables at
+    #: regeneration (pkg/policy/l4.go named-port resolution); when set,
+    #: ``port`` is 0 until resolution
+    name: str = ""
 
     @classmethod
     def from_dict(cls, d: Dict) -> "PortProtocol":
         port_s = str(d.get("port", "0") or "0")
-        if not port_s.isdigit():
-            raise SanitizeError(f"named ports unsupported: {port_s!r}")
         proto = _PROTO_NAMES.get(str(d.get("protocol", "") or "").lower())
         if proto is None:
             raise SanitizeError(f"unknown protocol {d.get('protocol')!r}")
+        if not port_s.isdigit():
+            if not _valid_port_name(port_s):
+                raise SanitizeError(f"bad port name {port_s!r}")
+            if d.get("endPort"):
+                raise SanitizeError("endPort not allowed with a named port")
+            return cls(port=0, protocol=proto, name=port_s)
         return cls(
             port=int(port_s),
             protocol=proto,
@@ -129,6 +155,34 @@ def entity_selectors(entity: str,
     if sels is None:
         raise SanitizeError(f"unknown entity {entity!r}")
     return sels
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupsSpec:
+    """``toGroups`` member (reference: ``pkg/policy/api/groups.go`` —
+    cloud-provider group references, e.g. AWS security groups, that an
+    operator resolves to CIDR sets). ``provider`` names a registered
+    resolver (agent.register_group_provider); ``fields`` carries the
+    provider-specific spec verbatim. Resolution happens at every
+    regeneration, so refreshed provider data takes effect without
+    policy rewrites (the reference re-derives on a timer)."""
+
+    provider: str
+    fields: Tuple[Tuple[str, str], ...] = ()
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "GroupsSpec":
+        if not isinstance(d, dict) or len(d) != 1:
+            raise SanitizeError(f"bad toGroups member {d!r}")
+        provider, spec = next(iter(d.items()))
+        if not isinstance(spec, dict) or not spec:
+            raise SanitizeError(
+                f"toGroups {provider!r} spec must be a non-empty object")
+        return cls(provider=str(provider),
+                   fields=tuple(sorted((str(k), str(v) if not
+                                        isinstance(v, (list, tuple))
+                                        else ",".join(map(str, v)))
+                                       for k, v in spec.items())))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -229,6 +283,7 @@ class EgressRule:
     to_requires: Tuple[EndpointSelector, ...] = ()
     to_fqdns: Tuple[FQDNSelector, ...] = ()
     to_services: Tuple[ServiceSelector, ...] = ()
+    to_groups: Tuple[GroupsSpec, ...] = ()
     to_ports: Tuple[PortRule, ...] = ()
     icmps: Tuple[ICMPField, ...] = ()
     auth_mode: str = ""  # see IngressRule.auth_mode
@@ -240,8 +295,8 @@ class EgressRule:
         for e in self.to_entities:
             sels += entity_selectors(e, cluster_name)
         if (not sels and not self.to_fqdns and not self.to_services
-                and not self.to_cidrs
-                and not self.to_cidr_set):  # see IngressRule: CIDR-only
+                and not self.to_cidrs and not self.to_cidr_set
+                and not self.to_groups):  # see IngressRule: CIDR-only
             sels = [EndpointSelector()]  # rules must not wildcard
         return tuple(sels)
 
@@ -346,6 +401,17 @@ class Rule:
                         for hdr in h.headers:
                             if not hdr.strip():
                                 raise SanitizeError("empty header match")
+                        for hm in h.header_matches:
+                            if hm.mismatch_action not in MISMATCH_ACTIONS:
+                                raise SanitizeError(
+                                    f"bad mismatch action "
+                                    f"{hm.mismatch_action!r}")
+                            if not hm.name.strip():
+                                raise SanitizeError(
+                                    "headerMatches member missing name")
+                            if hm.secret is not None and not hm.secret[1]:
+                                raise SanitizeError(
+                                    "secret reference missing name")
                     for k in l7.kafka:
                         if k.role and k.role not in ("produce", "consume"):
                             raise SanitizeError(f"bad kafka role {k.role!r}")

@@ -258,13 +258,26 @@ class PolicyResolver:
 
     def __init__(self, repo: Repository, selector_cache: SelectorCache,
                  services=None, backend_identity=None,
-                 cluster_name: str = "default"):
+                 cluster_name: str = "default",
+                 named_ports_of=None):
         self.repo = repo
         self.cache = selector_cache
         #: local cluster name: the `cluster` entity's selectors bind to
         #: it (reference api.InitEntities — per-resolver here, not a
         #: process-global, so co-resident agents don't fight)
         self.cluster_name = cluster_name
+        #: ``named_ports_of(identity) -> Mapping[str, int]`` — how a
+        #: named toPorts entry resolves against PEER endpoints (egress:
+        #: the remote endpoint owns the name, reference pkg/policy/l4.go
+        #: named-port resolution over selected endpoints); None → named
+        #: egress ports resolve to nothing
+        self.named_ports_of = named_ports_of
+        self._subject_named_ports: Dict[str, int] = {}
+        #: ``group_cidrs(GroupsSpec) -> Iterable[str]`` — resolves a
+        #: toGroups reference to CIDRs (agent provider registry); None
+        #: → groups resolve to nothing. Queried at every resolve, so
+        #: refreshed provider data lands on the next regeneration.
+        self.group_cidrs = None
         #: optional ServiceManager: `toServices` resolves against its
         #: k8s metadata (reference: pkg/k8s service cache feeding
         #: resolveEgressPolicy); None → toServices selects nothing
@@ -273,8 +286,14 @@ class PolicyResolver:
         #: ipcache.lookup): how backend IPs become matchable identities
         self.backend_identity = backend_identity
 
-    def resolve(self, endpoint_labels: LabelSet) -> MapState:
+    def resolve(self, endpoint_labels: LabelSet,
+                named_ports=None) -> MapState:
+        """``named_ports``: the SUBJECT endpoint's name→port table —
+        ingress named toPorts resolve against it (the destination of
+        ingress traffic is the endpoint itself); egress named ports
+        resolve against peers via ``named_ports_of``."""
         ms = MapState()
+        self._subject_named_ports = dict(named_ports or {})
         matching = list(self.repo.matching_rules(endpoint_labels))
         # fromRequires/toRequires (reference: api.IngressRule.FromRequires,
         # aggregated in rule.go ·GetSourceEndpointSelectorsWithRequirements):
@@ -308,6 +327,7 @@ class PolicyResolver:
                     er.to_ports, er.deny, rule_id, er.to_cidrs, er.to_fqdns,
                     services=er.to_services, icmps=er.icmps,
                     auth=er.auth_mode, cidr_set=er.to_cidr_set,
+                    groups=er.to_groups,
                 )
         self._propagate_auth(ms)
         return ms
@@ -337,7 +357,7 @@ class PolicyResolver:
     def _apply_direction(
         self, ms: MapState, direction: int, peer_selectors, to_ports,
         deny: bool, rule_id: str, cidrs, fqdns, services=(), icmps=(),
-        auth: str = "", cidr_set=(),
+        auth: str = "", cidr_set=(), groups=(),
     ) -> None:
         peer_ids: Set[int] = set()
         wildcard_peer = False
@@ -361,6 +381,14 @@ class PolicyResolver:
             peer_ids.update(ids)
         for svc_sel in services:
             peer_ids.update(self._service_identities(svc_sel))
+        for g in groups:
+            # toGroups → provider-resolved CIDRs → identities; an
+            # unknown provider or empty result selects NOTHING (the
+            # rule must not silently widen)
+            if self.group_cidrs is None:
+                continue
+            for cidr in (self.group_cidrs(g) or ()):
+                peer_ids.update(self._cidr_identities(cidr))
         if wildcard_peer:
             ids: Sequence[int] = (IDENTITY_WILDCARD,)
         else:
@@ -380,7 +408,16 @@ class PolicyResolver:
                     contributions.append((PORT_WILDCARD, 0, 0, l7))
                 for pp in pr.ports:
                     proto = int(pp.protocol)
-                    if pp.end_port and pp.end_port > pp.port:
+                    if pp.name:
+                        # NAMED port: resolve against endpoint
+                        # named-port tables; unresolvable names
+                        # contribute NOTHING (they must not widen to a
+                        # port wildcard — reference drops them too)
+                        for port in self._resolve_named_port(
+                                pp.name, direction,
+                                None if wildcard_peer else ids):
+                            contributions.append((port, 16, proto, l7))
+                    elif pp.end_port and pp.end_port > pp.port:
                         # a port RANGE becomes O(log) aligned prefix
                         # blocks, not per-port keys (reference:
                         # mapstate.go port-range entries) — 1024-65535
@@ -419,6 +456,27 @@ class PolicyResolver:
                                 direction=direction, port_plen=plen),
                     entry,
                 )
+
+    def _resolve_named_port(self, name: str, direction: int,
+                            peer_ids) -> List[int]:
+        """Named port → numeric port(s). Ingress: the subject endpoint
+        owns the name. Egress: the selected PEER endpoints own it —
+        union over their tables (wildcard peer: every known identity),
+        mirroring pkg/policy/l4.go resolution over selected endpoints."""
+        if direction == TrafficDirection.INGRESS:
+            p = self._subject_named_ports.get(name)
+            return [int(p)] if p else []
+        if self.named_ports_of is None:
+            return []
+        idents = (peer_ids if peer_ids is not None
+                  else list(self.cache.identities()))
+        out: Set[int] = set()
+        for i in idents:
+            table = self.named_ports_of(i) or {}
+            p = table.get(name)
+            if p:
+                out.add(int(p))
+        return sorted(out)
 
     def _service_identities(self, svc_sel) -> Set[int]:
         """``toServices`` → backend identities: match services by k8s

@@ -22,6 +22,7 @@ from cilium_tpu.policy.api.l7 import (
 )
 from cilium_tpu.policy.compiler import matchpattern
 from cilium_tpu.policy.mapstate import MapState
+from cilium_tpu.secrets import resolve_header_value
 
 
 def _bytes_fullmatch(pattern: str, s: str, flags: int = 0) -> bool:
@@ -44,7 +45,8 @@ def _header_present(name: str, value: str, headers) -> bool:
     return False
 
 
-def _http_rule_matches(rule: PortRuleHTTP, flow: Flow) -> bool:
+def _http_rule_matches(rule: PortRuleHTTP, flow: Flow,
+                       secret_lookup=None) -> bool:
     h = flow.http
     if h is None:
         return False
@@ -62,11 +64,35 @@ def _http_rule_matches(rule: PortRuleHTTP, flow: Flow) -> bool:
         if not _header_present(name, value, h.headers):
             return False
     for hm in rule.header_matches:
-        if hm.mismatch_action.upper() == "LOG":
+        if hm.mismatch_action != "":
+            # LOG/ADD/DELETE/REPLACE never gate the verdict — the
+            # mismatch consequence is a log lane or a proxy-side
+            # header rewrite (api.MismatchAction semantics)
             continue
-        if not _header_present(hm.name, hm.value, h.headers):
+        value = resolve_header_value(hm, secret_lookup)
+        if value is None:
+            return False  # unresolvable secret on FAIL → fail closed
+        if not _header_present(hm.name, value, h.headers):
             return False
     return True
+
+
+def _http_log_mismatch(rule: PortRuleHTTP, flow: Flow,
+                       secret_lookup=None) -> bool:
+    """True when a LOG-action header match of ``rule`` mismatched (the
+    rule still allows; the flow's l7_log lane raises)."""
+    h = flow.http
+    if h is None:
+        return False
+    for hm in rule.header_matches:
+        if hm.mismatch_action != "LOG":
+            continue
+        value = resolve_header_value(hm, secret_lookup)
+        if value is None:
+            continue  # unresolvable secret: nothing to compare
+        if not _header_present(hm.name, value, h.headers):
+            return True
+    return False
 
 
 def _kafka_rule_matches(rule: PortRuleKafka, flow: Flow) -> bool:
@@ -113,51 +139,63 @@ def _generic_rule_matches(rule: Dict[str, str], flow: Flow) -> bool:
     return True
 
 
-def l7_allowed(l7_rules: Tuple[L7Rules, ...], flow: Flow) -> bool:
-    """Allow-list semantics: request must match ≥1 rule of the set."""
+def l7_allowed(l7_rules: Tuple[L7Rules, ...], flow: Flow,
+               secret_lookup=None) -> Tuple[bool, bool]:
+    """Allow-list semantics: request must match ≥1 rule of the set.
+    Returns ``(allowed, log)`` — ``log`` raises when a matching HTTP
+    rule carried a LOG-action header match that mismatched."""
+    allowed = False
+    log = False
     for lr in l7_rules:
         for r in lr.http:
-            if _http_rule_matches(r, flow):
-                return True
+            if _http_rule_matches(r, flow, secret_lookup):
+                allowed = True
+                log = log or _http_log_mismatch(r, flow, secret_lookup)
         for r in lr.kafka:
             if _kafka_rule_matches(r, flow):
-                return True
+                return True, log
         for r in lr.dns:
             if _dns_rule_matches(r, flow):
-                return True
+                return True, log
         if lr.l7proto and flow.generic is not None \
                 and flow.generic.proto == lr.l7proto:
             if not lr.l7:
-                return True   # parser selected, no record constraints
+                return True, log  # parser selected, no constraints
             for r in lr.l7:
                 if _generic_rule_matches(r, flow):
-                    return True
-    return False
+                    return True, log
+    return allowed, log
 
 
 class OracleVerdictEngine:
-    """Same contract as engine.VerdictEngine, pure CPU."""
+    """Same contract as engine.VerdictEngine, pure CPU.
 
-    def __init__(self, per_identity: Dict[int, MapState]):
+    ``secret_lookup(namespace, name) -> Optional[str]`` resolves
+    secret-backed header-match values (SecretStore.lookup)."""
+
+    def __init__(self, per_identity: Dict[int, MapState],
+                 secret_lookup=None):
         self.per_identity = per_identity
+        self.secret_lookup = secret_lookup
 
     def _decide(self, flow: Flow):
-        """One lookup → (verdict, winning_entry, allowed)."""
+        """One lookup → (verdict, winning_entry, allowed, l7_log)."""
         ingress = flow.direction == TrafficDirection.INGRESS
         ep_id = flow.dst_identity if ingress else flow.src_identity
         peer_id = flow.src_identity if ingress else flow.dst_identity
         ms = self.per_identity.get(ep_id)
         if ms is None:
-            return Verdict.FORWARDED, None, True  # no policy → allow
+            return Verdict.FORWARDED, None, True, False  # no policy
         allowed, entry = ms.lookup(
             peer_id, flow.dport, int(flow.protocol), int(flow.direction))
         if not allowed:
-            return Verdict.DROPPED, entry, False
+            return Verdict.DROPPED, entry, False, False
         if entry is not None and entry.is_redirect:
-            if l7_allowed(entry.l7_rules, flow):
-                return Verdict.REDIRECTED, entry, True
-            return Verdict.DROPPED, entry, True
-        return Verdict.FORWARDED, entry, True
+            ok, log = l7_allowed(entry.l7_rules, flow, self.secret_lookup)
+            if ok:
+                return Verdict.REDIRECTED, entry, True, log
+            return Verdict.DROPPED, entry, True, False
+        return Verdict.FORWARDED, entry, True, False
 
     def verdict_one(self, flow: Flow) -> Verdict:
         return self._decide(flow)[0]
@@ -181,8 +219,9 @@ class OracleVerdictEngine:
             pairs = {(int(s), int(d)) for s, d in table}
         verdicts = []
         auth = []
+        logs = []
         for f in flows:
-            verdict, entry, allowed = self._decide(f)
+            verdict, entry, allowed, log = self._decide(f)
             demand = bool(allowed and entry is not None
                           and entry.auth_required)
             if (demand and pairs is not None
@@ -190,9 +229,11 @@ class OracleVerdictEngine:
                 verdict = Verdict.DROPPED  # drop until handshake
             verdicts.append(int(verdict))
             auth.append(demand)
+            logs.append(log and verdict == Verdict.REDIRECTED)
         return {
             "verdict": np.array(verdicts, dtype=np.int32),
             "auth_required": np.array(auth, dtype=bool),
+            "l7_log": np.array(logs, dtype=bool),
         }
 
     def verdict_records(self, rec, authed_pairs=None):

@@ -177,11 +177,18 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     return self._send(400, {"error": "endpoint id must be "
                                             "an integer"})
                 body = json.loads(self._body() or b"{}")
+                named_ports = body.get("named_ports")
                 with agent.write_lock:
                     ep = agent.endpoint_add(
                         ep_id,
                         dict(body.get("labels", {})),
                         ipv4=body.get("ipv4", ""),
+                        # None (field absent) preserves an existing
+                        # endpoint's table on re-PUT
+                        named_ports=(
+                            {str(k): int(v)
+                             for k, v in named_ports.items()}
+                            if named_ports is not None else None),
                     )
                 return self._send(201, ep.to_json())
             if path == "/v1/policy":

@@ -27,14 +27,38 @@ from cilium_tpu.runtime.metrics import METRICS, SpanStat
 LOG = get_logger("loader")
 
 
+def _referenced_secret_values(per_identity, secrets) -> tuple:
+    """(namespace, name, value) for every secret referenced by a
+    header match in the snapshot — the slice of the secret store that
+    affects compiled requirements."""
+    refs = set()
+    for ms in per_identity.values():
+        for entry in ms.entries.values():
+            for lr in entry.l7_rules:
+                for h in lr.http:
+                    for hm in h.header_matches:
+                        if hm.secret is not None:
+                            refs.add(hm.secret)
+    if not refs or secrets is None:
+        return ()
+    return tuple(sorted(
+        (ns, name, secrets.lookup(ns, name) or "") for ns, name in refs))
+
+
 class Loader:
     """Owns the active engine; single-writer regeneration (the
     reference's endpoint-regeneration queue is serialized per endpoint;
     our unit of regeneration is the whole policy snapshot)."""
 
-    def __init__(self, config: Optional[Config] = None, device=None):
+    def __init__(self, config: Optional[Config] = None, device=None,
+                 secrets=None):
         self.config = config or Config()
         self.device = device
+        #: optional SecretStore: secret-backed header-match values
+        #: resolve against it at compile (both engines see the same
+        #: snapshot; its fingerprint enters the artifact key so secret
+        #: rotation recompiles)
+        self.secrets = secrets
         self._lock = threading.Lock()
         self._engine = None
         self._revision = 0
@@ -55,8 +79,11 @@ class Loader:
         """Compile + stage a policy snapshot; atomic swap on success
         (old engine keeps serving until then — the reference's datapath
         likewise keeps enforcing during regeneration)."""
+        secret_lookup = (self.secrets.lookup
+                         if self.secrets is not None else None)
         if not self.config.enable_tpu_offload:
-            engine = OracleVerdictEngine(per_identity)
+            engine = OracleVerdictEngine(per_identity,
+                                         secret_lookup=secret_lookup)
             with self._lock:
                 self._engine = engine
                 self._revision = revision
@@ -89,6 +116,10 @@ class Loader:
                 for ep, ms in per_identity.items()
             ),
             repr(self.config.engine),
+            # only secrets actually REFERENCED by this snapshot's
+            # header matches enter the key: rotating an unrelated
+            # secret must not invalidate every cached artifact
+            _referenced_secret_values(per_identity, self.secrets),
         )
         policy = self._cache.get(key)
         cached = policy is not None
@@ -96,7 +127,8 @@ class Loader:
             with SpanStat("policy_compile") as span:
                 policy = CompiledPolicy.build(per_identity,
                                               self.config.engine,
-                                              revision=revision)
+                                              revision=revision,
+                                              secret_lookup=secret_lookup)
             self._cache.put(key, policy)
             METRICS.observe("cilium_tpu_compile_seconds", span.seconds)
         with _log_span(LOG, "policy staged", revision=revision,

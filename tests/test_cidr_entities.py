@@ -302,3 +302,44 @@ spec:
   ingress:
   - fromCIDR: ["10.0.0.0/99"]
 """)
+
+
+@pytest.mark.parametrize("offload", [False, True])
+def test_to_groups_resolves_via_provider(offload):
+    """toGroups (reference pkg/policy/api/groups.go): a registered
+    provider resolves the group to CIDRs; egress is allowed only to
+    identities inside them, and re-resolution at regeneration picks up
+    provider refreshes."""
+    agent = _agent(offload)
+    try:
+        client = agent.endpoint_add(1, {"app": "client"})
+        in_grp = agent.ipcache.upsert("198.18.0.5/32", None)
+        out_grp = agent.ipcache.upsert("198.19.0.5/32", None)
+        group_cidrs = ["198.18.0.0/16"]
+        agent.register_group_provider("aws", lambda spec: group_cidrs)
+        agent.policy_add(load_cnp_yaml_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: to-groups}
+spec:
+  endpointSelector: {matchLabels: {app: client}}
+  egress:
+  - toGroups:
+    - aws: {securityGroupsIds: [sg-1234]}
+""")[0])
+
+        def f(dst):
+            return Flow(src_identity=client.identity,
+                        dst_identity=int(dst), dport=443,
+                        direction=TrafficDirection.EGRESS)
+
+        out = agent.process_flows([f(in_grp), f(out_grp)])
+        assert [int(v) for v in out["verdict"]] == [1, 2]
+
+        # provider refresh: the group now covers the other range
+        group_cidrs[:] = ["198.19.0.0/16"]
+        agent.endpoint_manager.regenerate_all(wait=True)
+        out = agent.process_flows([f(in_grp), f(out_grp)])
+        assert [int(v) for v in out["verdict"]] == [2, 1]
+    finally:
+        agent.stop()

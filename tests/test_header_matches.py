@@ -1,0 +1,161 @@
+"""HeaderMatches mismatch actions + secret-backed values (VERDICT r1
+missing #10).
+
+Reference ``pkg/policy/api/http.go ·HeaderMatch``: "" (FAIL) denies on
+mismatch, LOG allows and annotates the access log (our l7_log lane),
+ADD/DELETE/REPLACE allow with a proxy-side rewrite; values may come
+from k8s Secrets (our SecretStore) — an unresolvable secret on a FAIL
+match fails closed.
+"""
+
+import pytest
+
+from cilium_tpu.agent import Agent
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import Flow, HTTPInfo, L7Type, TrafficDirection
+from cilium_tpu.policy.api import SanitizeError
+from cilium_tpu.policy.api.cnp import load_cnp_yaml_text
+
+CNP = """
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: hm}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - fromEndpoints: [{matchLabels: {app: peer}}]
+    toPorts:
+    - ports: [{port: "80", protocol: TCP}]
+      rules:
+        http:
+        - path: "/fail/.*"
+          headerMatches:
+          - {name: X-Req, value: "yes"}
+        - path: "/log/.*"
+          headerMatches:
+          - {name: X-Trace, value: "on", mismatch: LOG}
+        - path: "/rewrite/.*"
+          headerMatches:
+          - {name: X-Inject, value: v1, mismatch: REPLACE}
+        - path: "/secret/.*"
+          headerMatches:
+          - {name: X-Token, mismatch: "", secret: {namespace: ns, name: tok}}
+"""
+
+
+def _agent(offload: bool) -> Agent:
+    cfg = Config()
+    cfg.enable_tpu_offload = offload
+    cfg.configure_logging = False
+    return Agent(cfg).start()
+
+
+def _http(agent, svc, peer, path, headers=()):
+    return Flow(src_identity=peer.identity, dst_identity=svc.identity,
+                dport=80, direction=TrafficDirection.INGRESS,
+                l7=L7Type.HTTP,
+                http=HTTPInfo(method="GET", path=path, host="svc.local",
+                              headers=tuple(headers)))
+
+
+@pytest.mark.parametrize("offload", [False, True])
+def test_mismatch_actions(offload):
+    agent = _agent(offload)
+    try:
+        svc = agent.endpoint_add(1, {"app": "svc"})
+        peer = agent.endpoint_add(2, {"app": "peer"})
+        agent.policy_add(load_cnp_yaml_text(CNP)[0])
+
+        flows = [
+            # FAIL: header present → allow; missing → deny
+            _http(agent, svc, peer, "/fail/x", [("X-Req", "yes")]),
+            _http(agent, svc, peer, "/fail/x"),
+            # LOG: mismatch still allows, but raises l7_log
+            _http(agent, svc, peer, "/log/x", [("X-Trace", "on")]),
+            _http(agent, svc, peer, "/log/x"),
+            # REPLACE: never gates
+            _http(agent, svc, peer, "/rewrite/x"),
+        ]
+        out = agent.process_flows(flows)
+        assert [int(v) for v in out["verdict"]] == [5, 2, 5, 5, 5]
+        assert [bool(x) for x in out["l7_log"]] == \
+            [False, False, False, True, False]
+
+        # the REPLACE rewrite is carried for the proxy layer
+        if offload:
+            rewrites = [r for rule in
+                        agent.loader.engine.policy.header_rewrites
+                        for r in rule]
+            assert ("REPLACE", "X-Inject", "v1") in rewrites
+    finally:
+        agent.stop()
+
+
+@pytest.mark.parametrize("offload", [False, True])
+def test_secret_backed_value(offload):
+    agent = _agent(offload)
+    try:
+        svc = agent.endpoint_add(1, {"app": "svc"})
+        peer = agent.endpoint_add(2, {"app": "peer"})
+        agent.policy_add(load_cnp_yaml_text(CNP)[0])
+
+        f_good = _http(agent, svc, peer, "/secret/x",
+                       [("X-Token", "s3cr3t")])
+
+        # secret missing → FAIL match fails CLOSED (rule dead)
+        out = agent.process_flows([f_good])
+        assert int(out["verdict"][0]) == 2
+
+        # secret lands → matching value allows, wrong value denies
+        agent.secret_set("ns", "tok", "s3cr3t")
+        out = agent.process_flows([
+            f_good,
+            _http(agent, svc, peer, "/secret/x", [("X-Token", "nope")]),
+        ])
+        assert [int(v) for v in out["verdict"]] == [5, 2]
+
+        # rotation re-resolves
+        agent.secret_set("ns", "tok", "other")
+        out = agent.process_flows([f_good])
+        assert int(out["verdict"][0]) == 2
+    finally:
+        agent.stop()
+
+
+def test_sanitize_rejects_bad_actions():
+    with pytest.raises(SanitizeError):
+        for cnp in load_cnp_yaml_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: bad}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - toPorts:
+    - ports: [{port: "80", protocol: TCP}]
+      rules:
+        http:
+        - headerMatches: [{name: X, mismatch: EXPLODE}]
+"""):
+            for rule in cnp.rules:
+                rule.sanitize()
+
+
+def test_yaml_bool_header_value_rejected():
+    """`value: yes` (unquoted) parses as a YAML bool — compiling it to
+    the literal 'True' would deny what the author wrote; reject at
+    parse instead."""
+    with pytest.raises(SanitizeError):
+        load_cnp_yaml_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: bool-val}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - toPorts:
+    - ports: [{port: "80", protocol: TCP}]
+      rules:
+        http:
+        - headerMatches: [{name: X-Req, value: yes}]
+""")

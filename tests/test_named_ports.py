@@ -1,0 +1,130 @@
+"""Named ports (VERDICT r1 item 3).
+
+Reference: ``pkg/policy/api/l4.go`` (Port may be an IANA service
+name), ``pkg/policy/l4.go`` (resolution against endpoint named-port
+tables at regeneration). Ingress names resolve against the subject
+endpoint; egress names against the selected peer endpoints; renaming
+an endpoint port re-resolves and flips verdicts.
+"""
+
+import pytest
+
+from cilium_tpu.agent import Agent
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import Flow, TrafficDirection
+from cilium_tpu.policy.api import SanitizeError
+from cilium_tpu.policy.api.cnp import load_cnp_yaml_text
+
+NAMED_CNP = """
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: named-http}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - fromEndpoints: [{matchLabels: {app: peer}}]
+    toPorts: [{ports: [{port: "web", protocol: TCP}]}]
+"""
+
+
+def test_sanitize_accepts_named_ports():
+    for cnp in load_cnp_yaml_text(NAMED_CNP):
+        for rule in cnp.rules:
+            rule.sanitize()
+    pp = load_cnp_yaml_text(NAMED_CNP)[0].rules[0] \
+        .ingress[0].to_ports[0].ports[0]
+    assert pp.name == "web" and pp.port == 0
+
+    for bad in ("Web", "-web", "web-", "a--b", "1234567890123456", "80x!"):
+        with pytest.raises(SanitizeError):
+            for cnp in load_cnp_yaml_text(
+                    NAMED_CNP.replace('"web"', f'"{bad}"')):
+                for rule in cnp.rules:  # all-digit overlong ports are
+                    rule.sanitize()     # caught at sanitize, not parse
+
+
+@pytest.mark.parametrize("offload", [False, True])
+def test_named_port_resolution_and_rename_flip(offload):
+    cfg = Config()
+    cfg.enable_tpu_offload = offload
+    cfg.configure_logging = False
+    agent = Agent(cfg).start()
+    try:
+        svc = agent.endpoint_add(1, {"app": "svc"},
+                                 named_ports={"web": 8080})
+        peer = agent.endpoint_add(2, {"app": "peer"})
+        agent.policy_add(load_cnp_yaml_text(NAMED_CNP)[0])
+
+        def f(dport):
+            return Flow(src_identity=peer.identity,
+                        dst_identity=svc.identity, dport=dport,
+                        direction=TrafficDirection.INGRESS)
+
+        out = agent.process_flows([f(8080), f(80)])
+        assert [int(v) for v in out["verdict"]] == [1, 2]
+
+        # rename: web now maps to 9090 → the old port must DROP and
+        # the new one forward (re-resolution at regeneration)
+        agent.endpoint_manager.update_named_ports(1, {"web": 9090})
+        out = agent.process_flows([f(8080), f(9090)])
+        assert [int(v) for v in out["verdict"]] == [2, 1]
+
+        # removing the name entirely: nothing resolves → default deny
+        # (an unresolvable named port must NOT widen to a wildcard)
+        agent.endpoint_manager.update_named_ports(1, {})
+        out = agent.process_flows([f(8080), f(9090), f(0)])
+        assert [int(v) for v in out["verdict"]] == [2, 2, 2]
+    finally:
+        agent.stop()
+
+
+@pytest.mark.parametrize("offload", [False, True])
+def test_named_port_egress_resolves_against_peers(offload):
+    cfg = Config()
+    cfg.enable_tpu_offload = offload
+    cfg.configure_logging = False
+    agent = Agent(cfg).start()
+    try:
+        client = agent.endpoint_add(1, {"app": "client"})
+        db = agent.endpoint_add(2, {"app": "db"},
+                                named_ports={"pg": 5432})
+        agent.policy_add(load_cnp_yaml_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: named-egress}
+spec:
+  endpointSelector: {matchLabels: {app: client}}
+  egress:
+  - toEndpoints: [{matchLabels: {app: db}}]
+    toPorts: [{ports: [{port: "pg", protocol: TCP}]}]
+""")[0])
+
+        def f(dport, dst):
+            return Flow(src_identity=client.identity, dst_identity=dst,
+                        dport=dport, direction=TrafficDirection.EGRESS)
+
+        out = agent.process_flows([
+            f(5432, db.identity),   # peer's named port → forward
+            f(5433, db.identity),   # wrong port → drop
+        ])
+        assert [int(v) for v in out["verdict"]] == [1, 2]
+    finally:
+        agent.stop()
+
+
+def test_re_add_preserves_named_ports():
+    """A CNI ADD retry (re-add without named_ports) must not wipe the
+    table — same asymmetry guard as the kept IP."""
+    cfg = Config()
+    cfg.configure_logging = False
+    agent = Agent(cfg).start()
+    try:
+        agent.endpoint_add(1, {"app": "svc"}, named_ports={"web": 8080})
+        ep = agent.endpoint_add(1, {"app": "svc"})
+        assert ep.named_ports == {"web": 8080}
+        # explicit table still replaces
+        ep = agent.endpoint_add(1, {"app": "svc"},
+                                named_ports={"web": 9090})
+        assert ep.named_ports == {"web": 9090}
+    finally:
+        agent.stop()
