@@ -139,6 +139,47 @@ def cmd_replay(args) -> int:
         # the datapath did); the auth demand still surfaces per flow
         from cilium_tpu.auth import AUTH_UNENFORCED
 
+        # captures from another cluster carry foreign NUMERIC ids but
+        # flowpb labels; re-map by EXACT label set against local
+        # identities (subset matching would let {app=x} remap onto a
+        # narrower {app=x, env=prod} identity and satisfy requirements
+        # the flow never carried). The cluster-name label is excluded
+        # from the comparison on both sides — it differs by definition
+        # between the capturing and replaying clusters.
+        from cilium_tpu.core.labels import ParseLabel
+        from cilium_tpu.policy.api.rule import CLUSTER_LABEL_KEY
+
+        def _norm(label_strs) -> frozenset:
+            out = set()
+            for s in label_strs:
+                lbl = ParseLabel(s)
+                if lbl.key != CLUSTER_LABEL_KEY:
+                    out.add((lbl.source, lbl.key, lbl.value))
+            return frozenset(out)
+
+        by_labels = {}
+        for cand, lbls in sorted(
+                agent.selector_cache.identities().items()):
+            by_labels.setdefault(_norm(l.format() for l in lbls), cand)
+        remap_cache: dict = {}
+
+        def _identity_for(labels) -> int:
+            nid = remap_cache.get(labels)
+            if nid is None:
+                nid = by_labels.get(_norm(labels), -1)
+                remap_cache[labels] = nid
+            return nid
+
+        def _remap(flow) -> None:
+            if flow.src_labels:
+                nid = _identity_for(flow.src_labels)
+                if nid >= 0:
+                    flow.src_identity = nid
+            if flow.dst_labels:
+                nid = _identity_for(flow.dst_labels)
+                if nid >= 0:
+                    flow.dst_identity = nid
+
         for commit_index, chunk in chunks:
             if args.fast:
                 # columnar: records → verdicts, no Flow objects
@@ -149,6 +190,8 @@ def cmd_replay(args) -> int:
                     name = Verdict(int(v)).name
                     counts[name] = counts.get(name, 0) + int(c)
             else:
+                for f in chunk:
+                    _remap(f)
                 out = engine.verdict_flows(
                     chunk, authed_pairs=AUTH_UNENFORCED)
                 if "match_spec" not in out:

@@ -126,6 +126,11 @@ class Flow:
     #: emitting node (flowpb.Flow.node_name); stamped by the relay so a
     #: merged cluster-wide stream stays attributable
     node_name: str = ""
+    #: flowpb Endpoint.labels of each side — carried so captures from
+    #: ANOTHER cluster (whose numeric identities mean nothing here) can
+    #: be re-mapped to local identities by label at replay
+    src_labels: Tuple[str, ...] = ()
+    dst_labels: Tuple[str, ...] = ()
 
     def l7_record(self):
         if self.l7 == L7Type.HTTP:

@@ -64,7 +64,7 @@ def replay_chunks(capture: str, chunk_size: int = 8192,
     ``decode=False`` (binary captures only) yields raw record arrays
     instead of Flow lists — the columnar fast path — under the SAME
     cursor protocol, so kill/resume semantics live in one place."""
-    from cilium_tpu.ingest.hubble import flow_from_dict
+    from cilium_tpu.ingest.accesslog import parse_capture_line
 
     index = max(start, cursor.load() if cursor is not None else 0)
     emitted = 0
@@ -104,7 +104,7 @@ def replay_chunks(capture: str, chunk_size: int = 8192,
             line_no += 1
             s = line.strip()
             if s:
-                flows.append(flow_from_dict(json.loads(s)))
+                flows.append(parse_capture_line(json.loads(s)))
                 emitted += 1
             done = limit is not None and emitted >= limit
             if len(flows) >= chunk_size or done:

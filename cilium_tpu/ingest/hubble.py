@@ -28,12 +28,36 @@ _DIR_NAMES = {"INGRESS": TrafficDirection.INGRESS,
               "EGRESS": TrafficDirection.EGRESS}
 
 
+def _to_time(v) -> float:
+    """flowpb encodes time as an RFC3339 string; our writer uses epoch
+    floats. Accept both. Protobuf Timestamps carry NANOSECOND fractions
+    (9 digits) which fromisoformat rejects — truncate to microseconds
+    first."""
+    if not v:
+        return 0.0
+    if isinstance(v, (int, float)):
+        return float(v)
+    import datetime
+    import re as _re
+
+    s = str(v).replace("Z", "+00:00")
+    s = _re.sub(r"(\.\d{6})\d+", r"\1", s)  # ns → µs precision
+    try:
+        return datetime.datetime.fromisoformat(s).timestamp()
+    except ValueError:
+        return 0.0
+
+
 def flow_to_dict(f: Flow) -> Dict:
     d: Dict = {
         "verdict": Verdict(f.verdict).name,
         "traffic_direction": TrafficDirection(f.direction).name,
-        "source": {"identity": f.src_identity},
-        "destination": {"identity": f.dst_identity},
+        "source": {"identity": f.src_identity,
+                   **({"labels": list(f.src_labels)}
+                      if f.src_labels else {})},
+        "destination": {"identity": f.dst_identity,
+                        **({"labels": list(f.dst_labels)}
+                           if f.dst_labels else {})},
     }
     if f.time:
         d["time"] = f.time
@@ -85,15 +109,26 @@ def flow_to_dict(f: Flow) -> Dict:
 
 
 def flow_from_dict(d: Dict) -> Flow:
+    if isinstance(d.get("flow"), dict):
+        # the reference hubble exporter / `hubble observe -o jsonl`
+        # envelope: {"flow": {...}, "node_name": ..., "time": ...}
+        inner = dict(d["flow"])
+        for k in ("node_name", "time"):
+            inner.setdefault(k, d.get(k))
+        d = inner
     f = Flow()
-    f.time = d.get("time", 0.0) or 0.0
+    f.time = _to_time(d.get("time"))
     f.verdict = _VERDICT_NAMES.get(d.get("verdict", ""),
                                    Verdict.VERDICT_UNKNOWN)
     f.direction = _DIR_NAMES.get(d.get("traffic_direction", ""),
                                  TrafficDirection.INGRESS)
-    f.src_identity = int((d.get("source") or {}).get("identity", 0))
-    f.dst_identity = int((d.get("destination") or {}).get("identity", 0))
-    f.node_name = d.get("node_name", "")
+    src = d.get("source") or {}
+    dst = d.get("destination") or {}
+    f.src_identity = int(src.get("identity", 0) or 0)
+    f.dst_identity = int(dst.get("identity", 0) or 0)
+    f.src_labels = tuple(src.get("labels") or ())
+    f.dst_labels = tuple(dst.get("labels") or ())
+    f.node_name = d.get("node_name", "") or ""
     ip = d.get("IP") or {}
     f.src_ip = ip.get("source", "")
     f.dst_ip = ip.get("destination", "")
@@ -159,7 +194,11 @@ def write_jsonl(path: str, flows: Iterable[Flow]) -> int:
 def read_jsonl(path: str, start: int = 0,
                limit: Optional[int] = None) -> Iterator[Flow]:
     """Stream flows from a JSONL capture; ``start`` supports replay-
-    cursor resume (SURVEY.md §5.4)."""
+    cursor resume (SURVEY.md §5.4). Lines may be flowpb JSON (bare or
+    exporter-enveloped) or Envoy accesslog entries — see
+    ingest/accesslog.py."""
+    from cilium_tpu.ingest.accesslog import parse_capture_line
+
     with open(path) as fp:
         for i, line in enumerate(fp):
             if i < start:
@@ -168,4 +207,4 @@ def read_jsonl(path: str, start: int = 0,
                 return
             line = line.strip()
             if line:
-                yield flow_from_dict(json.loads(line))
+                yield parse_capture_line(json.loads(line))

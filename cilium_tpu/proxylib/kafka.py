@@ -12,8 +12,11 @@ records"): produce (acks,timeout then topic array), fetch (replica,
 max_wait,min_bytes then topic array), metadata (topic array). Other
 APIs yield a single record with an empty topic (matched on api_key
 alone). Requests are verdicted per frame: every parsed record must be
-allowed, else the frame is dropped (the reference additionally injects
-a Kafka error response; we drop). Responses pass through.
+allowed, else the frame is DROPPED and a Kafka error response
+(TOPIC_AUTHORIZATION_FAILED, v0 response shape per API) is INJECTed
+back to the client — matching the reference, where a denied produce
+still gets a well-formed broker error instead of a hung request.
+Responses pass through.
 """
 
 from __future__ import annotations
@@ -174,6 +177,68 @@ def _topic_array(topics, payload_fn) -> bytes:
     return out
 
 
+#: Kafka error code injected for policy denials (reference
+#: proxylib/kafka: the broker-side authorization failure).
+ERR_TOPIC_AUTHORIZATION_FAILED = 29
+
+
+def produce_acks(frame: bytes) -> int:
+    """The acks field of a produce request (first int16 after the
+    client id); -1 when unreadable. acks=0 produces expect NO response
+    — injecting one would be consumed as the reply to the client's
+    NEXT request and desync the connection."""
+    if len(frame) < 8:
+        return -1
+    _, off = _read_string(frame, 8)
+    if off + 2 > len(frame):
+        return -1
+    (acks,) = struct.unpack_from(">h", frame, off)
+    return acks
+
+
+def _string(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">h", len(b)) + b
+
+
+def encode_error_response(records: List[KafkaInfo]) -> bytes:
+    """A well-formed v0 error response frame for a denied request:
+    correlation id echoed, every topic/partition carrying
+    TOPIC_AUTHORIZATION_FAILED. Unknown/unparseable APIs return b"" —
+    the caller falls back to a bare DROP."""
+    if not records:
+        return b""
+    r0 = records[0]
+    topics = [r.topic for r in records if r.topic
+              and not r.topic.startswith("\x00")]
+    err = ERR_TOPIC_AUTHORIZATION_FAILED
+    if r0.api_key == API_PRODUCE:
+        # v0: array<topic, array<partition i32, error i16, offset i64>>
+        body = struct.pack(">i", len(topics))
+        for t in topics:
+            body += _string(t) + struct.pack(">i", 1)
+            body += struct.pack(">ihq", 0, err, -1)
+    elif r0.api_key == API_FETCH:
+        # v0: array<topic, array<partition i32, error i16,
+        #      high_watermark i64, message_set_size i32 (empty)>>
+        body = struct.pack(">i", len(topics))
+        for t in topics:
+            body += _string(t) + struct.pack(">i", 1)
+            body += struct.pack(">ihqi", 0, err, -1, 0)
+    elif r0.api_key == API_METADATA:
+        # v0: brokers array (empty) + array<topic_metadata:
+        #      error i16, topic, partitions array (empty)>
+        body = struct.pack(">i", 0)
+        body += struct.pack(">i", len(topics))
+        for t in topics:
+            body += struct.pack(">h", err) + _string(t)
+            body += struct.pack(">i", 0)
+    else:
+        return b""
+    payload = struct.pack(">i", r0.correlation_id) + body
+    return struct.pack(">i", len(payload)) + payload
+
+
 class KafkaParser(Parser):
     def __init__(self, connection: Connection, policy_check):
         super().__init__(connection, policy_check)
@@ -200,7 +265,19 @@ class KafkaParser(Parser):
             frame = self._buf[4:frame_len]
             records = parse_request_records(frame)
             allowed = all(self.policy_check(r) for r in records)
-            ops.append((OpType.PASS if allowed else OpType.DROP, frame_len))
+            if allowed:
+                ops.append((OpType.PASS, frame_len))
+            else:
+                # deny: drop the request AND answer the client with a
+                # broker-shaped authorization error (reference
+                # proxylib/kafka behavior); unparseable frames have no
+                # valid correlation id to echo, and acks=0 produces
+                # expect no response at all → bare drop for those
+                err = encode_error_response(records)
+                if err and not (records[0].api_key == API_PRODUCE
+                                and produce_acks(frame) == 0):
+                    ops.append(self.connection.inject(err))
+                ops.append((OpType.DROP, frame_len))
             self._buf = self._buf[frame_len:]
             if not self._buf:
                 break

@@ -1,0 +1,167 @@
+"""Reference-shaped capture ingest (VERDICT r1 missing #7).
+
+Three line schemas must replay: bare flowpb JSON (our writer), the
+hubble exporter envelope ``{"flow": {...}}``, and Envoy accesslog
+entries (``pkg/envoy`` accesslog → ``pkg/hubble/parser/seven``).
+Foreign captures carry cluster-local identity NUMBERS; flows with
+labels re-map to local identities at replay.
+"""
+
+import json
+import os
+
+import pytest
+
+from cilium_tpu import cli
+from cilium_tpu.core.flow import Flow, L7Type, TrafficDirection, Verdict
+from cilium_tpu.ingest.accesslog import (
+    accesslog_to_flow,
+    is_accesslog_entry,
+    parse_capture_line,
+)
+from cilium_tpu.ingest.hubble import flow_from_dict
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "golden", "reference_capture.jsonl")
+
+CNP = """
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: api}
+spec:
+  endpointSelector: {matchLabels: {app: service}}
+  ingress:
+  - fromEndpoints: [{matchLabels: {app: frontend}}]
+    toPorts:
+    - ports: [{port: "80", protocol: TCP}]
+      rules:
+        http:
+        - {method: GET, path: "/api/.*"}
+"""
+
+
+def test_envelope_and_labels_parse():
+    f = flow_from_dict({
+        "flow": {
+            "verdict": "FORWARDED",
+            "traffic_direction": "INGRESS",
+            "source": {"identity": 9999, "labels": ["k8s:app=frontend"]},
+            "destination": {"identity": 8888,
+                            "labels": ["k8s:app=service"]},
+            "l4": {"TCP": {"destination_port": 80}},
+            "l7": {"type": "REQUEST",
+                   "http": {"method": "GET", "url": "/api/x"}},
+            "time": "2026-07-30T10:00:00Z",
+        },
+        "node_name": "ref-node-1",
+    })
+    assert f.src_identity == 9999 and f.src_labels == ("k8s:app=frontend",)
+    assert f.dst_labels == ("k8s:app=service",)
+    assert f.node_name == "ref-node-1" and f.time > 0
+    assert f.l7 == L7Type.HTTP and f.http.path == "/api/x"
+
+
+def test_accesslog_entry_parse():
+    d = {
+        "entry_type": "Request",
+        "timestamp": "2026-07-30T10:00:01Z",
+        "is_ingress": True,
+        "source_security_id": 1234,
+        "destination_security_id": 5678,
+        "source_address": "10.0.0.9:51334",
+        "destination_address": "10.0.0.2:80",
+        "http": {"http_protocol": "HTTP/1.1", "host": "svc.local",
+                 "path": "/api/v1/items", "method": "GET",
+                 "headers": [{"key": "X-A", "value": "b"}]},
+    }
+    assert is_accesslog_entry(d)
+    f = accesslog_to_flow(d)
+    assert f.direction == TrafficDirection.INGRESS
+    assert (f.src_identity, f.dst_identity) == (1234, 5678)
+    assert f.dport == 80 and f.sport == 51334
+    assert f.http.method == "GET" and f.http.headers == (("X-A", "b"),)
+    # dispatcher picks the right schema per line
+    assert parse_capture_line(d).l7 == L7Type.HTTP
+    assert parse_capture_line({"source": {"identity": 1}}).src_identity == 1
+
+
+def test_golden_reference_capture_replays(tmp_path, capsys):
+    """`cli replay` verdicts the checked-in reference-format capture:
+    identity remap by label makes the foreign ids irrelevant."""
+    cnp_path = tmp_path / "cnp.yaml"
+    cnp_path.write_text(CNP)
+    rc = cli.main(["replay", GOLDEN, "--policy", str(cnp_path),
+                   "--endpoint", "app=service",
+                   "--endpoint", "app=frontend",
+                   "--endpoint", "app=other"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    summary = json.loads(out)
+    assert summary["flows"] == 4
+    # line 1: enveloped flowpb GET /api/x from frontend → REDIRECTED
+    # line 2: bare flowpb DELETE /api/x → L7 deny
+    # line 3: enveloped from app=other (remapped) → no rule → drop
+    # line 4: accesslog GET /api/items with LOCAL numeric ids (no
+    #         labels): ids 0/0 hit no policy → forwarded
+    assert summary["verdicts"] == {"REDIRECTED": 1, "DROPPED": 2,
+                                   "FORWARDED": 1}
+
+
+def _write_golden():
+    lines = [
+        {"flow": {
+            "traffic_direction": "INGRESS", "verdict": "FORWARDED",
+            "source": {"identity": 90001,
+                       "labels": ["k8s:app=frontend"]},
+            "destination": {"identity": 90002,
+                            "labels": ["k8s:app=service"]},
+            "l4": {"TCP": {"destination_port": 80}},
+            "l7": {"type": "REQUEST",
+                   "http": {"method": "GET", "url": "/api/x"}},
+        }, "node_name": "ref-node-1",
+            "time": "2026-07-30T09:00:00Z"},
+        {"traffic_direction": "INGRESS", "verdict": "FORWARDED",
+         "source": {"identity": 90001, "labels": ["k8s:app=frontend"]},
+         "destination": {"identity": 90002,
+                         "labels": ["k8s:app=service"]},
+         "l4": {"TCP": {"destination_port": 80}},
+         "l7": {"type": "REQUEST",
+                "http": {"method": "DELETE", "url": "/api/x"}}},
+        {"flow": {
+            "traffic_direction": "INGRESS", "verdict": "FORWARDED",
+            "source": {"identity": 90003, "labels": ["k8s:app=other"]},
+            "destination": {"identity": 90002,
+                            "labels": ["k8s:app=service"]},
+            "l4": {"TCP": {"destination_port": 80}},
+            "l7": {"type": "REQUEST",
+                   "http": {"method": "GET", "url": "/api/x"}},
+        }},
+        {"entry_type": "Request", "is_ingress": True,
+         "timestamp": "2026-07-30T09:00:02Z",
+         "source_security_id": 0, "destination_security_id": 0,
+         "source_address": "10.0.0.9:51334",
+         "destination_address": "10.0.0.2:80",
+         "http": {"http_protocol": "HTTP/1.1", "host": "svc.local",
+                  "path": "/api/items", "method": "GET"}},
+    ]
+    with open(GOLDEN, "w") as fp:
+        for line in lines:
+            fp.write(json.dumps(line) + "\n")
+
+
+if __name__ == "__main__":
+    _write_golden()
+    print(f"wrote {GOLDEN}")
+
+
+def test_ipv6_addresses_and_ns_timestamps():
+    from cilium_tpu.ingest.accesslog import _split_addr
+    from cilium_tpu.ingest.hubble import _to_time
+
+    assert _split_addr("[2001:db8::1]:8080") == ("2001:db8::1", 8080)
+    assert _split_addr("2001:db8::1") == ("2001:db8::1", 0)
+    assert _split_addr("10.0.0.1:443") == ("10.0.0.1", 443)
+    assert _split_addr("[::1]") == ("::1", 0)
+    # protobuf Timestamps carry 9 fractional digits
+    t = _to_time("2026-07-30T10:00:00.123456789Z")
+    assert t > 0 and abs(t % 1 - 0.123456) < 1e-5

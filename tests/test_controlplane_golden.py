@@ -51,6 +51,44 @@ ENDPOINTS = [
     (10, "cache", {"tier": "cache"}),
     (11, "staging", {"env": "staging", "app": "canary"}),
     (12, "unrelated", {"app": "unrelated"}),
+    # round-2 corpus growth (new policies use fresh labels so the
+    # round-1 verdict prefix is unchanged)
+    (13, "vault", {"app": "vault"}),
+    (14, "registry", {"app": "registry"}),
+    (15, "audit", {"app": "audit"}),
+    (16, "reporter", {"app": "reporter"}),
+    (17, "reporter-prod", {"app": "reporter", "env": "prod"}),
+    (18, "metricsd", {"app": "metricsd"}),
+    (19, "exporter", {"app": "exporter"}),
+    (20, "webapp", {"app": "webapp"}),
+    (21, "gateway", {"app": "gateway"}),
+    (22, "nodeport-svc", {"app": "nodeport-svc"}),
+    (23, "lb", {"app": "lb"}),
+    (24, "probe-target", {"app": "probe-target"}),
+    (25, "legacy", {"app": "legacy"}),
+    (26, "admin", {"app": "admin"}),
+    (27, "api-gw", {"app": "api-gw"}),
+    (28, "partner", {"app": "partner"}),
+    (29, "payments", {"app": "payments"}),
+    (30, "checkout", {"app": "checkout"}),
+    (31, "vhost", {"app": "vhost"}),
+    (32, "edge", {"app": "edge"}),
+    (33, "kafka-metrics", {"app": "kafka-metrics"}),
+    (34, "analytics", {"app": "analytics"}),
+    (35, "pinned-client", {"app": "pinned-client"}),
+]
+
+#: container port names (named-port corpus policies resolve against
+#: these at regeneration)
+NAMED_PORTS = {"webapp": {"http": 8080}}
+
+#: CIDR identities the corpus CIDR(-except) policies match; fixed
+#: upsert order keeps local-scope id allocation deterministic
+CIDRS = [
+    ("estate", "172.18.0.9/32"),       # in 172.16/12, outside except
+    ("quarantine", "172.20.1.9/32"),   # inside the 172.20/16 except
+    ("collector", "192.0.2.10/32"),    # in 192.0.2.0/24
+    ("honeypot", "192.0.2.250/32"),    # inside the 192.0.2.240/28 except
 ]
 
 
@@ -62,7 +100,10 @@ def build_agent(agent=None):
     ids = {}
     for ep_id, key, labels in ENDPOINTS:
         ids[key] = agent.endpoint_add(
-            ep_id, labels, ipv4=f"10.50.0.{ep_id}").identity
+            ep_id, labels, ipv4=f"10.50.0.{ep_id}",
+            named_ports=NAMED_PORTS.get(key)).identity
+    for key, prefix in CIDRS:
+        ids[key] = int(agent.ipcache.upsert(prefix, None))
     for path in sorted(glob.glob(os.path.join(CORPUS, "*", "*.yaml"))):
         agent.policy_add_file(path, wait=False)
     agent.endpoint_manager.regenerate_all(wait=True)
@@ -135,6 +176,74 @@ def build_flows(ids):
         dns("crawler", "docs.cilium.io"),
         dns("crawler", "example.com"),
         dns("crawler", "evil.attacker.net"),
+        # ---- round-2 corpus (appended; prefix above is frozen) ----
+        # l3-cidr-except: estate in, quarantine carved out
+        f("estate", "vault", 443),
+        f("quarantine", "vault", 443),
+        # l3-entities-cluster: in-cluster yes; world and CIDR ids no
+        f("frontend", "registry", 5000),
+        f(WORLD, "registry", 5000),
+        f("estate", "registry", 5000),
+        # l3-from-requires: env=prod required on top of app=reporter
+        f("reporter-prod", "audit", 4000),
+        f("reporter", "audit", 4000),
+        # l3-nodes-only: host/remote-node entities; pods excluded
+        f(1, "metricsd", 9100),                   # reserved host
+        f(6, "metricsd", 9100),                   # reserved remote-node
+        f("frontend", "metricsd", 9100),
+        # l3-egress-cidrset (egress: the SOURCE endpoint is the policy
+        # subject; destinations are the CIDR identities)
+        f("exporter", "collector", 443, direction=TrafficDirection.EGRESS),
+        f("exporter", "honeypot", 443, direction=TrafficDirection.EGRESS),
+        f("exporter", "collector", 80, direction=TrafficDirection.EGRESS),
+        # l4-named-port: "http" resolves to webapp's 8080
+        f("gateway", "webapp", 8080),
+        f("gateway", "webapp", 80),
+        # l4-port-range-high: 30000-32767
+        f("lb", "nodeport-svc", 30000),
+        f("lb", "nodeport-svc", 32767),
+        f("lb", "nodeport-svc", 29999),
+        # l4-icmp-probe: EchoRequest (8) only, in-cluster only
+        f("frontend", "probe-target", 8, proto=Protocol.ICMP),
+        f("frontend", "probe-target", 0, proto=Protocol.ICMP),
+        f(WORLD, "probe-target", 8, proto=Protocol.ICMP),
+        # l4-deny-telnet: broad allow, narrow deny wins on 23
+        f("admin", "legacy", 22),
+        f("admin", "legacy", 23),
+        # l7-header-matches: FAIL key gates; LOG mismatch still allows
+        f("partner", "api-gw", 8080, l7=L7Type.HTTP,
+          http=http("GET", "/v2/report",
+                    [("X-Api-Key", "k-123"), ("X-Trace-Id", "t-9")])),
+        f("partner", "api-gw", 8080, l7=L7Type.HTTP,
+          http=http("GET", "/v2/report", [("X-Api-Key", "k-123")])),
+        f("partner", "api-gw", 8080, l7=L7Type.HTTP,
+          http=http("GET", "/v2/report")),
+        # l7-auth-required: no handshake table in this replay →
+        # drop-until-authed fails closed
+        f("checkout", "payments", 8443),
+        # l7-http-host: only the api vhost
+        f("edge", "vhost", 80, l7=L7Type.HTTP,
+          http=HTTPInfo(method="GET", path="/x",
+                        host="api.corp.internal")),
+        f("edge", "vhost", 80, l7=L7Type.HTTP,
+          http=HTTPInfo(method="GET", path="/x",
+                        host="web.corp.internal")),
+        # kafka-consume-acl: fetch yes, produce no
+        f("analytics", "kafka-metrics", 9092, l7=L7Type.KAFKA,
+          kafka=kafka(1, "metrics-events")),
+        f("analytics", "kafka-metrics", 9092, l7=L7Type.KAFKA,
+          kafka=kafka(0, "metrics-events")),
+        # dns-names: exact names only
+        Flow(src_identity=ids["pinned-client"],
+             dst_identity=ids["kube-dns"], dport=53,
+             protocol=Protocol.UDP,
+             direction=TrafficDirection.EGRESS, l7=L7Type.DNS,
+             dns=DNSInfo(query="registry.corp.internal")),
+        Flow(src_identity=ids["pinned-client"],
+             dst_identity=ids["kube-dns"], dport=53,
+             protocol=Protocol.UDP,
+             direction=TrafficDirection.EGRESS, l7=L7Type.DNS,
+             dns=DNSInfo(query="other.corp.internal")),
     ]
 
 

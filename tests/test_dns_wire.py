@@ -247,3 +247,127 @@ def test_upstream_timeout_is_servfail():
     finally:
         server.stop()
         dead.close()
+
+
+# ------------------------------------------------------------- TCP path --
+class FakeTCPUpstream:
+    """In-process TCP resolver (RFC 7766 framing), fixed A answers."""
+
+    def __init__(self, ips=("192.0.2.10",), ttl=120, rcode=0):
+        self.ips, self.ttl, self.rcode = list(ips), ttl, rcode
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.sock.settimeout(0.5)
+        self.address = self.sock.getsockname()
+        self.queries = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    @staticmethod
+    def _recvn(conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with conn:
+                conn.settimeout(2.0)
+                while True:
+                    try:
+                        hdr = self._recvn(conn, 2)
+                        if hdr is None:
+                            break
+                        data = self._recvn(
+                            conn, int.from_bytes(hdr, "big"))
+                    except (socket.timeout, OSError):
+                        break
+                    msg = wire.decode(data)
+                    self.queries.append(msg.qname)
+                    answers = [
+                        (msg.qname, wire.QTYPE_A, self.ttl,
+                         socket.inet_aton(ip))
+                        for ip in self.ips
+                    ] if self.rcode == 0 else []
+                    resp = wire.encode_response(data, self.rcode, answers)
+                    conn.sendall(len(resp).to_bytes(2, "big") + resp)
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=2)
+        self.sock.close()
+
+
+def _client_ask_tcp(addr, qnames, txid=42, timeout=3.0):
+    """Ask one or more queries over ONE TCP connection (pipelining)."""
+    if isinstance(qnames, str):
+        qnames = [qnames]
+    out = []
+    with socket.create_connection(addr, timeout=timeout) as s:
+        for i, qname in enumerate(qnames):
+            q = wire.encode_query(txid + i, qname)
+            s.sendall(len(q).to_bytes(2, "big") + q)
+            hdr = FakeTCPUpstream._recvn(s, 2)
+            assert hdr is not None, "proxy closed mid-exchange"
+            resp = FakeTCPUpstream._recvn(s, int.from_bytes(hdr, "big"))
+            out.append(wire.decode(resp))
+    return out if len(out) > 1 else out[0]
+
+
+def test_tcp_allowed_query_forwards_and_caches():
+    """The TCP listener shares CheckAllowed and the observe path
+    (reference: dnsproxy serves UDP and TCP; TCP is the truncation
+    fallback)."""
+    upstream = FakeTCPUpstream(ips=("192.0.2.55",), ttl=90)
+    cache = DNSCache()
+    nm = NameManager(None, None, cache)
+    proxy = DNSProxy(name_manager=nm)
+    proxy.update_allowed(7, 53, [PortRuleDNS(match_pattern="*.allowed.io")])
+    server = DNSProxyServer(
+        proxy, endpoint_of=lambda ip: 7,
+        upstream=upstream.address).start()
+    try:
+        msg = _client_ask_tcp(server.address, "api.allowed.io")
+        assert msg.rcode == wire.RCODE_NOERROR
+        assert [a.ip for a in msg.answers] == ["192.0.2.55"]
+        assert upstream.queries == ["api.allowed.io"]
+        assert cache.lookup("api.allowed.io") == ["192.0.2.55"]
+
+        # denied name over the SAME wire path: REFUSED, upstream
+        # never contacted
+        msg = _client_ask_tcp(server.address, "evil.other.io")
+        assert msg.rcode == wire.RCODE_REFUSED
+        assert upstream.queries == ["api.allowed.io"]
+    finally:
+        server.stop()
+        upstream.close()
+
+
+def test_tcp_pipelined_queries_one_connection():
+    upstream = FakeTCPUpstream()
+    proxy = DNSProxy()
+    proxy.update_allowed(7, 53, [PortRuleDNS(match_pattern="*")])
+    server = DNSProxyServer(
+        proxy, endpoint_of=lambda ip: 7,
+        upstream=upstream.address).start()
+    try:
+        msgs = _client_ask_tcp(server.address,
+                               ["a.x.io", "b.x.io", "c.x.io"])
+        assert [m.rcode for m in msgs] == [0, 0, 0]
+        assert upstream.queries == ["a.x.io", "b.x.io", "c.x.io"]
+    finally:
+        server.stop()
+        upstream.close()

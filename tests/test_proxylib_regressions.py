@@ -70,7 +70,9 @@ def test_multi_topic_produce_checks_all_topics():
 
     ops = parser.on_data(False, False, both_ok + mixed)
     assert ops[0] == (OpType.PASS, len(both_ok))
-    assert ops[1] == (OpType.DROP, len(mixed))  # one bad topic → drop
+    # one bad topic → error injected + frame dropped
+    assert ops[1][0] == OpType.INJECT
+    assert ops[2] == (OpType.DROP, len(mixed))
 
 
 def test_multi_topic_fetch_and_metadata():
@@ -95,7 +97,16 @@ def test_unparseable_topics_deny_with_topic_rules():
     body += struct.pack(">hi", 1, 1000) + b"\xff\xff\xff\xff"
     frame = struct.pack(">i", len(body)) + body
     ops = parser.on_data(False, False, frame)
-    assert ops[0] == (OpType.DROP, len(frame))
+    # unparseable topic data (acks=1): a produce-shaped error response
+    # with ZERO topics is still injected — correlation id echoed — and
+    # the frame drops
+    assert ops[0][0] == OpType.INJECT
+    assert ops[1] == (OpType.DROP, len(frame))
+    err = conn.take_inject()
+    size, correlation = struct.unpack_from(">ii", err, 0)
+    assert size == len(err) - 4 and correlation == 9
+    (ntopics,) = struct.unpack_from(">i", err, 8)
+    assert ntopics == 0
 
 
 def test_negative_content_length_no_stall():
@@ -143,3 +154,28 @@ def test_ipcache_upsert_remaps_and_notifies():
     assert events[-1] == ("10.1.0.0/24", 2222, True)
     c = ipc.upsert("10.1.0.0/24")  # refresh keeps current
     assert c == 2222 and len(events) == 2
+
+
+def test_acks0_produce_denial_has_no_inject():
+    """acks=0 produces expect NO response; injecting one would be read
+    as the reply to the client's NEXT request and desync the
+    connection — denial is a bare DROP."""
+    import struct
+
+    from cilium_tpu.proxylib.kafka import encode_request, produce_acks
+
+    loader, ids = _kafka_setup()
+    bridge = PolicyBridge(loader, deadline_ms=1.0)
+    conn = Connection(proto="kafka", connection_id=9, ingress=True,
+                      src_identity=ids["cli"], dst_identity=ids["kafka"],
+                      dport=9092)
+    parser = create_parser("kafka", conn, bridge.policy_check(conn))
+
+    denied = bytearray(encode_request(0, 0, 11, "c", "evil-topic"))
+    # flip the acks field (first int16 after the 1-byte client id) to 0
+    acks_off = 4 + 8 + 2 + 1
+    struct.pack_into(">h", denied, acks_off, 0)
+    assert produce_acks(bytes(denied[4:])) == 0
+    ops = parser.on_data(False, False, bytes(denied))
+    assert ops == [(OpType.DROP, len(denied))]
+    assert conn.take_inject() == b""

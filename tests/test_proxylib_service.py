@@ -89,10 +89,31 @@ def test_kafka_parser_frames():
 
     ops = parser.on_data(False, False, good + bad)
     assert ops[0] == (OpType.PASS, len(good))
-    assert ops[1] == (OpType.DROP, len(bad))
+    # denial = broker-shaped error INJECTed back + request DROPPED
+    assert ops[1][0] == OpType.INJECT
+    assert ops[2] == (OpType.DROP, len(bad))
+    err = conn.take_inject()
+    import struct as _struct
+
+    size, correlation = _struct.unpack_from(">ii", err, 0)
+    assert size == len(err) - 4
+    assert correlation == 8  # echoes the denied request's id
+    from cilium_tpu.proxylib.kafka import ERR_TOPIC_AUTHORIZATION_FAILED
+
+    # produce v0 body: array<topic, array<partition, err i16, off i64>>
+    (ntop,) = _struct.unpack_from(">i", err, 8)
+    assert ntop == 1
+    (tlen,) = _struct.unpack_from(">h", err, 12)
+    topic = err[14:14 + tlen].decode()
+    assert topic == "secret-topic"
+    off = 14 + tlen
+    (nparts, _part, code) = _struct.unpack_from(">iih", err, off)
+    assert nparts == 1 and code == ERR_TOPIC_AUTHORIZATION_FAILED
     # consume (role=produce does not allow fetch)
     ops = parser.on_data(False, False, fetch)
-    assert ops[0] == (OpType.DROP, len(fetch))
+    assert ops[0][0] == OpType.INJECT
+    assert ops[1] == (OpType.DROP, len(fetch))
+    conn.take_inject()
 
     # streaming: partial frame → MORE, then completion
     ops = parser.on_data(False, False, good[:5])
@@ -152,9 +173,31 @@ def test_cpp_shim_end_to_end(shim_lib):
         buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
         ops = (ctypes.c_int32 * 16)()
         n = shim_lib.cshim_on_data(77, 0, 0, buf, len(payload), ops, 8)
-        assert n == 2, f"expected 2 ops, got {n}"
+        assert n == 3, f"expected 3 ops, got {n}"
         assert (ops[0], ops[1]) == (int(OpType.PASS), len(good))
-        assert (ops[2], ops[3]) == (int(OpType.DROP), len(bad))
+        assert ops[2] == int(OpType.INJECT)
+        assert (ops[4], ops[5]) == (int(OpType.DROP), len(bad))
+
+        # the denied produce's error response rides the shim's INJECT
+        # channel: a well-formed broker frame, correlation id echoed
+        shim_lib.cshim_take_inject.restype = ctypes.c_long
+        ibuf = (ctypes.c_uint8 * 512)()
+        ilen = shim_lib.cshim_take_inject(77, ibuf, 512)
+        assert ilen > 0, "expected injected Kafka error response"
+        err = bytes(ibuf[:ilen])
+        import struct as _struct
+
+        size, correlation = _struct.unpack_from(">ii", err, 0)
+        assert size == len(err) - 4 and correlation == 2
+        from cilium_tpu.proxylib.kafka import (
+            ERR_TOPIC_AUTHORIZATION_FAILED,
+        )
+
+        (tlen,) = _struct.unpack_from(">h", err, 12)
+        assert err[14:14 + tlen].decode() == "evil-topic"
+        (_nparts, _part, code) = _struct.unpack_from(
+            ">iih", err, 14 + tlen)
+        assert code == ERR_TOPIC_AUTHORIZATION_FAILED
 
         # service-level batched verdict op via the Python client
         client = VerdictClient(sock)
