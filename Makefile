@@ -1,0 +1,30 @@
+# CI lanes (SURVEY §4/§5.2). No pip/apt — everything runs from the
+# baked environment at the repo root.
+
+PY ?= python
+
+.PHONY: test shim determinism dryrun bench bench-all check
+
+test:            ## full suite (CPU, virtual 8-device mesh via conftest)
+	$(PY) -m pytest tests/ -q
+
+shim:            ## build the C++ proxylib-ABI shim
+	$(MAKE) -C shim
+
+determinism:     ## deterministic-compile + debug_nans sanitizer lane
+	$(PY) -m pytest tests/test_determinism.py -q
+
+dryrun:          ## driver multi-chip contract on a virtual CPU mesh
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PY) -c "import jax; jax.config.update('jax_platforms','cpu'); \
+	import __graft_entry__ as ge; ge.dryrun_multichip(8); \
+	fn, a = ge.entry(); jax.block_until_ready(jax.jit(fn)(*a)); \
+	print('entry OK')"
+
+bench:           ## headline config on the attached accelerator
+	$(PY) bench.py --config http --check
+
+bench-all:       ## every BASELINE config, one JSON line each
+	$(PY) bench.py --config all
+
+check: shim test determinism dryrun   ## the full CI gate

@@ -36,6 +36,10 @@ import time
 #: per-config BASELINE flow/tuple shapes
 _DEFAULT_FLOWS = {"http": 10000, "fqdn": 10000, "kafka": 100000,
                   "mixed": 1000000, "clustermesh": 100000}
+#: per-config BASELINE rule counts (configs[0] is "100 DNS names x 10
+#: regex rules"; http is the 1k-rule north-star shape)
+_DEFAULT_RULES = {"http": 1000, "fqdn": 10, "kafka": 1000,
+                  "mixed": 0, "clustermesh": 0}
 
 
 def run_config(config: str, args) -> dict:
@@ -57,6 +61,8 @@ def run_config(config: str, args) -> dict:
             print(msg, file=sys.stderr)
 
     n_flows = args.flows if args.flows is not None else _DEFAULT_FLOWS[config]
+    n_rules = (args.rules if args.rules is not None
+               else _DEFAULT_RULES[config])
 
     import contextlib
 
@@ -77,10 +83,10 @@ def run_config(config: str, args) -> dict:
             log(f"profiler trace written to {args.profile}")
 
     if config == "http":
-        scenario = synth.synth_http_scenario(n_rules=args.rules,
+        scenario = synth.synth_http_scenario(n_rules=n_rules,
                                              n_flows=n_flows)
     elif config == "fqdn":
-        scenario = synth.synth_fqdn_scenario(n_names=100, n_rules=args.rules,
+        scenario = synth.synth_fqdn_scenario(n_names=100, n_rules=n_rules,
                                              n_flows=n_flows)
     elif config == "mixed":
         # BASELINE configs[3]: examples/policies corpus × synthetic tuples
@@ -93,7 +99,7 @@ def run_config(config: str, args) -> dict:
         scenario = synth.synth_clustermesh_scenario(
             n_identities=10000, n_policies=5000, n_flows=n_flows)
     else:
-        scenario = synth.synth_kafka_scenario(n_rules=args.rules,
+        scenario = synth.synth_kafka_scenario(n_rules=n_rules,
                                               n_records=n_flows)
     streaming = config in ("mixed", "clustermesh")
     per_identity, scenario = synth.realize_scenario(scenario)
@@ -252,9 +258,10 @@ def run_config(config: str, args) -> dict:
                     "vs_baseline": 0.0}
         log("oracle check: OK")
 
-    # http/fqdn/kafka wrap their N sub-rules in one Rule — args.rules is
+    # http/fqdn/kafka wrap their N sub-rules in one Rule — n_rules is
     # the meaningful count there; mixed/clustermesh have real rule lists
-    n_rules = len(scenario.rules) if streaming else args.rules
+    if streaming:
+        n_rules = len(scenario.rules)
     return {
         "metric": f"l7_verdicts_per_sec_{config}_{n_rules}rules",
         "value": round(vps, 1),
@@ -271,7 +278,8 @@ def main() -> int:
     ap.add_argument("--config", default="http",
                     choices=["http", "fqdn", "kafka", "mixed",
                              "clustermesh", "all"])
-    ap.add_argument("--rules", type=int, default=1000)
+    ap.add_argument("--rules", type=int, default=None,
+                    help="rule count (default: per-config BASELINE shape)")
     ap.add_argument("--flows", type=int, default=None,
                     help="flow/tuple count (default: per-config BASELINE "
                          "shape: http/fqdn 10k, kafka 100k, mixed 1M, "
@@ -287,15 +295,40 @@ def main() -> int:
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
 
-    configs = (["http", "fqdn", "kafka", "mixed", "clustermesh"]
-               if args.config == "all" else [args.config])
-    rc = 0
-    for config in configs:
-        result = run_config(config, args)
-        print(json.dumps(result), flush=True)
-        if result["metric"].startswith("bench_failed"):
-            rc = 1
-    return rc
+    if args.config == "all":
+        # one SUBPROCESS per config: after a config's post-timing
+        # readbacks the process is permanently in the tunnel's ~64ms
+        # sync mode (docs/PLATFORM.md), which would poison every
+        # subsequent config's numbers by ~100x
+        import os
+        import subprocess
+
+        rc = 0
+        for config in ("http", "fqdn", "kafka", "mixed", "clustermesh"):
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--config", config,
+                   "--iters", str(args.iters),
+                   "--warmup", str(args.warmup)]
+            if args.rules is not None:
+                cmd += ["--rules", str(args.rules)]
+            if args.flows is not None:
+                cmd += ["--flows", str(args.flows)]
+            if args.check:
+                cmd.append("--check")
+            if args.verbose:
+                cmd.append("--verbose")
+            if args.profile:
+                cmd += ["--profile",
+                        os.path.join(args.profile, config)]
+            r = subprocess.run(cmd, stdout=subprocess.PIPE)
+            sys.stdout.buffer.write(r.stdout)
+            sys.stdout.flush()
+            rc = rc or r.returncode
+        return rc
+
+    result = run_config(args.config, args)
+    print(json.dumps(result), flush=True)
+    return 1 if result["metric"].startswith("bench_failed") else 0
 
 
 if __name__ == "__main__":

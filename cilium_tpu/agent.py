@@ -51,6 +51,7 @@ class Agent:
                  dns_upstream: tuple = ("127.0.0.53", 53),
                  dns_endpoint_of=None,
                  hubble_socket_path: Optional[str] = None,
+                 accesslog_socket_path: Optional[str] = None,
                  kvstore: Optional[KVStore] = None):
         self.config = config or Config.from_env()
         self.state_dir = state_dir
@@ -89,13 +90,19 @@ class Agent:
         #: happens at every regeneration so provider refreshes land via
         #: regenerate_all()
         self.group_providers = {}
+        # proxy-port allocation + redirect lifecycle (pkg/proxy role):
+        # reconciled against every resolved snapshot at regeneration
+        from cilium_tpu.proxy_manager import ProxyManager
+
+        self.proxy_manager = ProxyManager()
         self.endpoint_manager = EndpointManager(
             self.repo, self.selector_cache, self.allocator, self.loader,
             dns_proxy=self.dns_proxy, state_dir=state_dir,
             services=self.services,
             backend_identity=lambda ip: self.ipcache.lookup(ip),
             cluster_name=self.config.cluster_name,
-            group_cidrs=self._resolve_group)
+            group_cidrs=self._resolve_group,
+            proxy_manager=self.proxy_manager)
         # backend-set changes alter toServices resolution → regenerate,
         # but only when some rule actually uses toServices: routine
         # backend churn must not trigger full-policy recomputation in
@@ -147,6 +154,10 @@ class Agent:
         # hubble observer socket (GetFlows/ServerStatus analog)
         self.hubble_server = None
         self.hubble_socket_path = hubble_socket_path
+        # proxy→agent L7 record channel (pkg/envoy accesslog server):
+        # proxies write JSON records; parsed flows land in the observer
+        self.accesslog_server = None
+        self.accesslog_socket_path = accesslog_socket_path
         # FQDN updates retrigger regeneration (§3.2 tail)
         self.name_manager.on_update = (
             lambda sels: self.endpoint_manager.regenerate_all())
@@ -270,6 +281,11 @@ class Agent:
             self.controllers.update("hubble-peer-heartbeat",
                                     self._hubble_ad.heartbeat,
                                     interval=15.0)
+        if self.accesslog_socket_path:
+            from cilium_tpu.hubble.accesslog_server import AccessLogServer
+
+            self.accesslog_server = AccessLogServer(
+                self.observer, self.accesslog_socket_path).start()
         if self.dns_proxy_bind is not None:
             from cilium_tpu.fqdn.server import DNSProxyServer
 
@@ -316,6 +332,8 @@ class Agent:
                 ad.withdraw()  # instead of waiting out the lease
         if self.hubble_server is not None:
             self.hubble_server.stop()
+        if self.accesslog_server is not None:
+            self.accesslog_server.stop()
         if self.dns_server is not None:
             self.dns_server.stop()
         if self.api_server is not None:

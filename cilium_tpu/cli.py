@@ -162,6 +162,7 @@ def cmd_replay(args) -> int:
                 agent.selector_cache.identities().items()):
             by_labels.setdefault(_norm(l.format() for l in lbls), cand)
         remap_cache: dict = {}
+        unmapped = [0]
 
         def _identity_for(labels) -> int:
             nid = remap_cache.get(labels)
@@ -171,14 +172,20 @@ def cmd_replay(args) -> int:
             return nid
 
         def _remap(flow) -> None:
+            # labels with NO local match map to identity 0 (unknown),
+            # never the foreign NUMBER — sequential id spaces collide
+            # across clusters, so keeping it would silently evaluate
+            # the flow against an unrelated local workload's policy
             if flow.src_labels:
                 nid = _identity_for(flow.src_labels)
-                if nid >= 0:
-                    flow.src_identity = nid
+                flow.src_identity = nid if nid >= 0 else 0
+                if nid < 0:
+                    unmapped[0] += 1
             if flow.dst_labels:
                 nid = _identity_for(flow.dst_labels)
-                if nid >= 0:
-                    flow.dst_identity = nid
+                flow.dst_identity = nid if nid >= 0 else 0
+                if nid < 0:
+                    unmapped[0] += 1
 
         for commit_index, chunk in chunks:
             if args.fast:
@@ -214,7 +221,12 @@ def cmd_replay(args) -> int:
         # ran to EOF: a finished replay must not pin the cursor there —
         # re-running the same command should replay, not print 0 flows
         cursor.clear()
-    print(json.dumps({"flows": total, "verdicts": counts}))
+    summary = {"flows": total, "verdicts": counts}
+    if not args.fast and unmapped[0]:
+        # flows whose capture labels matched no local identity were
+        # evaluated as identity 0 — surface it, don't hide it
+        summary["unmapped_labels"] = unmapped[0]
+    print(json.dumps(summary))
     return 0
 
 
@@ -309,6 +321,10 @@ def cmd_identity_list(args) -> int:
 
 def cmd_ip_list(args) -> int:
     return _print(_api(args).ipcache())
+
+
+def cmd_proxy_list(args) -> int:
+    return _print(_api(args).proxy_redirects())
 
 
 def cmd_fqdn_cache(args) -> int:
@@ -433,6 +449,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     i = ipsub.add_parser("list")
     i.add_argument("--api", required=True)
     i.set_defaults(fn=cmd_ip_list)
+
+    p = sub.add_parser("proxy", help="proxy redirect table")
+    prsub = p.add_subparsers(dest="proxy_cmd", required=True)
+    i = prsub.add_parser("list")
+    i.add_argument("--api", required=True)
+    i.set_defaults(fn=cmd_proxy_list)
 
     p = sub.add_parser("fqdn", help="FQDN subsystem introspection")
     fsub = p.add_subparsers(dest="fqdn_cmd", required=True)

@@ -52,6 +52,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--socket", help="verdict service unix socket")
     ap.add_argument("--api-socket", help="REST API unix socket")
     ap.add_argument("--hubble-socket", help="hubble observer unix socket")
+    ap.add_argument("--accesslog-socket",
+                    help="proxy accesslog ingest unix socket "
+                         "(pkg/envoy accesslog server analog)")
     ap.add_argument("--policy-dir",
                     help="directory of CNP YAML to watch (k8s-watcher "
                          "analog)")
@@ -109,6 +112,7 @@ def build(args):
         socket_path=args.socket,
         api_socket_path=args.api_socket,
         hubble_socket_path=args.hubble_socket,
+        accesslog_socket_path=args.accesslog_socket,
         policy_dir=args.policy_dir,
         dns_proxy_bind=_hostport(args.dns_proxy) if args.dns_proxy
         else None,

@@ -102,7 +102,8 @@ class EndpointManager:
                  dns_proxy=None, state_dir: Optional[str] = None,
                  regen_workers: int = 4,
                  services=None, backend_identity=None,
-                 cluster_name: str = "default", group_cidrs=None):
+                 cluster_name: str = "default", group_cidrs=None,
+                 proxy_manager=None):
         self.repo = repo
         self.cache = selector_cache
         self.allocator = allocator
@@ -115,6 +116,9 @@ class EndpointManager:
         self.backend_identity = backend_identity
         self.cluster_name = cluster_name
         self.group_cidrs = group_cidrs
+        #: optional ProxyManager: redirect lifecycle reconciles against
+        #: every resolved snapshot (pkg/proxy during regeneration)
+        self.proxy_manager = proxy_manager
         self._lock = threading.RLock()
         self._endpoints: Dict[int, Endpoint] = {}
         self._pool = ThreadPoolExecutor(max_workers=regen_workers,
@@ -236,6 +240,8 @@ class EndpointManager:
                             named_ports=np_of.get(ep.identity, {}))
                     per_identity[ep.identity] = resolved[ep.identity]
                 self.loader.regenerate(per_identity, revision=revision)
+                if self.proxy_manager is not None:
+                    self.proxy_manager.reconcile(per_identity)
                 self._update_dns_proxy(eps, resolved)
             with self._lock:
                 for ep in eps:

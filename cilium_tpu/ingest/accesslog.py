@@ -72,8 +72,14 @@ def _split_addr(addr: str) -> tuple:
 
 
 def accesslog_to_flow(d: Dict) -> Flow:
+    from cilium_tpu.core.flow import Verdict
+
     f = Flow()
     f.time = _to_time(d.get("timestamp"))
+    if str(d.get("entry_type", "")).lower() == "denied":
+        # a Denied entry IS the proxy's verdict — hubble metrics and
+        # GetFlows must see DROPPED, not VERDICT_UNKNOWN
+        f.verdict = Verdict.DROPPED
     ingress = bool(d.get("is_ingress", True))
     f.direction = (TrafficDirection.INGRESS if ingress
                    else TrafficDirection.EGRESS)

@@ -202,30 +202,43 @@ def _string(s: str) -> bytes:
 
 
 def encode_error_response(records: List[KafkaInfo]) -> bytes:
-    """A well-formed v0 error response frame for a denied request:
+    """A well-formed error response frame for a denied request:
     correlation id echoed, every topic/partition carrying
-    TOPIC_AUTHORIZATION_FAILED. Unknown/unparseable APIs return b"" —
-    the caller falls back to a bare DROP."""
+    TOPIC_AUTHORIZATION_FAILED. Version-aware within the layouts that
+    are stable (produce/fetch v0-v2 request layout; v1+ responses gain
+    a throttle_time_ms field — appended for produce, leading for
+    fetch). Anything newer/unknown returns b"" — the caller falls back
+    to a bare DROP (a guessed-wrong frame would desync the client
+    worse than silence)."""
     if not records:
         return b""
     r0 = records[0]
+    v = r0.api_version
     topics = [r.topic for r in records if r.topic
               and not r.topic.startswith("\x00")]
     err = ERR_TOPIC_AUTHORIZATION_FAILED
-    if r0.api_key == API_PRODUCE:
-        # v0: array<topic, array<partition i32, error i16, offset i64>>
+    if r0.api_key == API_PRODUCE and 0 <= v <= 2:
+        # array<topic, array<partition i32, error i16, offset i64>>
+        # (+ v2: per-partition log_append_time i64; v1+: trailing
+        # throttle_time_ms)
         body = struct.pack(">i", len(topics))
         for t in topics:
             body += _string(t) + struct.pack(">i", 1)
             body += struct.pack(">ihq", 0, err, -1)
-    elif r0.api_key == API_FETCH:
-        # v0: array<topic, array<partition i32, error i16,
-        #      high_watermark i64, message_set_size i32 (empty)>>
-        body = struct.pack(">i", len(topics))
+            if v >= 2:
+                body += struct.pack(">q", -1)  # log_append_time
+        if v >= 1:
+            body += struct.pack(">i", 0)       # throttle_time_ms
+    elif r0.api_key == API_FETCH and 0 <= v <= 2:
+        # (v1+: leading throttle_time_ms) array<topic,
+        #  array<partition i32, error i16, high_watermark i64,
+        #        message_set_size i32 (empty)>>
+        body = b"" if v == 0 else struct.pack(">i", 0)
+        body += struct.pack(">i", len(topics))
         for t in topics:
             body += _string(t) + struct.pack(">i", 1)
             body += struct.pack(">ihqi", 0, err, -1, 0)
-    elif r0.api_key == API_METADATA:
+    elif r0.api_key == API_METADATA and v == 0:
         # v0: brokers array (empty) + array<topic_metadata:
         #      error i16, topic, partitions array (empty)>
         body = struct.pack(">i", 0)
@@ -273,6 +286,11 @@ class KafkaParser(Parser):
                 # proxylib/kafka behavior); unparseable frames have no
                 # valid correlation id to echo, and acks=0 produces
                 # expect no response at all → bare drop for those
+                # encode_error_response is version-gated (returns b""
+                # outside the layouts it can encode correctly); the
+                # acks guard is valid for the same produce versions
+                # (acks position is stable v0-v2, shifted by
+                # transactional_id in v3+)
                 err = encode_error_response(records)
                 if err and not (records[0].api_key == API_PRODUCE
                                 and produce_acks(frame) == 0):

@@ -158,6 +158,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                      "affinity": s.affinity}
                     for s in agent.services.list()
                 ])
+            if path == "/v1/proxy":
+                # redirect table (`cilium-dbg status --all-redirects`
+                # analog): live (l7proto, direction) → proxy port
+                return self._send(200, agent.proxy_manager.dump())
             if path == "/v1/metrics":
                 return self._send(200, METRICS.expose().encode(),
                                   content_type="text/plain; version=0.0.4")
@@ -403,6 +407,9 @@ class APIClient:
 
     def identities(self):
         return self.request("GET", "/v1/identity")[1]
+
+    def proxy_redirects(self):
+        return self.request("GET", "/v1/proxy")[1]
 
     def ipcache(self):
         return self.request("GET", "/v1/ip")[1]

@@ -165,3 +165,13 @@ def test_ipv6_addresses_and_ns_timestamps():
     # protobuf Timestamps carry 9 fractional digits
     t = _to_time("2026-07-30T10:00:00.123456789Z")
     assert t > 0 and abs(t % 1 - 0.123456) < 1e-5
+
+
+def test_denied_accesslog_entry_carries_dropped_verdict():
+    f = accesslog_to_flow({
+        "entry_type": "Denied", "is_ingress": True,
+        "source_security_id": 1, "destination_security_id": 2,
+        "destination_address": "10.0.0.2:80",
+        "http": {"method": "GET", "path": "/x"},
+    })
+    assert f.verdict == Verdict.DROPPED

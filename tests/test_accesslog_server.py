@@ -1,0 +1,65 @@
+"""Accesslog server socket: the proxy→agent L7 record channel
+(reference pkg/envoy accesslog server → hubble parser/seven).
+
+Proxies write newline-delimited JSON records (accesslog OR flowpb
+schema) over a unix socket; parsed flows land in the agent's Observer
+ring and are visible over the hubble GetFlows surface. Malformed
+lines are counted, never fatal.
+"""
+
+import json
+import os
+import socket
+import tempfile
+import time
+
+from cilium_tpu.agent import Agent
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import L7Type
+
+
+def test_accesslog_records_reach_the_observer():
+    path = os.path.join(tempfile.mkdtemp(), "accesslog.sock")
+    cfg = Config()
+    cfg.configure_logging = False
+    agent = Agent(cfg, accesslog_socket_path=path).start()
+    try:
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+            s.connect(path)
+            lines = [
+                # Envoy accesslog entry
+                json.dumps({
+                    "entry_type": "Request", "is_ingress": True,
+                    "source_security_id": 101,
+                    "destination_security_id": 202,
+                    "destination_address": "10.0.0.2:80",
+                    "http": {"method": "GET", "path": "/a",
+                             "host": "svc.local"},
+                }),
+                "{not json",  # must be skipped, not fatal
+                # flowpb-shaped line
+                json.dumps({
+                    "traffic_direction": "INGRESS",
+                    "verdict": "FORWARDED",
+                    "source": {"identity": 101},
+                    "destination": {"identity": 202},
+                    "l4": {"TCP": {"destination_port": 9092}},
+                    "l7": {"kafka": {"api_key": 1, "api_version": 2,
+                                     "topic": "t"}},
+                }),
+            ]
+            s.sendall(("\n".join(lines) + "\n").encode())
+
+        deadline = time.time() + 5
+        while time.time() < deadline and agent.observer.seen < 2:
+            time.sleep(0.02)
+        assert agent.observer.seen == 2
+
+        flows = list(agent.observer.get_flows())
+        kinds = sorted(f.l7 for f in flows)
+        assert kinds == sorted([L7Type.HTTP, L7Type.KAFKA])
+        http = next(f for f in flows if f.l7 == L7Type.HTTP)
+        assert (http.src_identity, http.dst_identity) == (101, 202)
+        assert http.dport == 80 and http.http.path == "/a"
+    finally:
+        agent.stop()

@@ -1,0 +1,92 @@
+"""Proxy manager: port allocation + redirect lifecycle (SURVEY §2.2
+"proxy manager" row; reference pkg/proxy).
+
+Redirects are keyed (l7proto, direction), hold a STABLE proxy port
+while any resolved policy references them, are released when nothing
+does, and released ports are reused.
+"""
+
+from cilium_tpu.agent import Agent
+from cilium_tpu.core.config import Config
+from cilium_tpu.proxy_manager import ProxyManager, ProxyPortExhausted
+from cilium_tpu.policy.api.cnp import load_cnp_yaml_text
+
+HTTP_CNP = """
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: http-api}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - fromEndpoints: [{matchLabels: {app: peer}}]
+    toPorts:
+    - ports: [{port: "80", protocol: TCP}]
+      rules:
+        http: [{method: GET, path: "/.*"}]
+"""
+
+KAFKA_CNP = """
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: kafka-acl}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - fromEndpoints: [{matchLabels: {app: peer}}]
+    toPorts:
+    - ports: [{port: "9092", protocol: TCP}]
+      rules:
+        kafka: [{role: produce, topic: t}]
+"""
+
+
+def test_acquire_release_reuse():
+    pm = ProxyManager(port_min=100, port_max=101)
+    r1 = pm.acquire("http", True, (1, 80))
+    r2 = pm.acquire("http", True, (2, 80))     # same redirect, 2 users
+    assert r1.proxy_port == r2.proxy_port == 100
+    r3 = pm.acquire("kafka", True, (1, 9092))
+    assert r3.proxy_port == 101
+    try:
+        pm.acquire("dns", False, (1, 53))
+        raise AssertionError("range must exhaust")
+    except ProxyPortExhausted:
+        pass
+    pm.release("http", True, (1, 80))
+    assert pm.lookup("http", True) == 100      # still held by user 2
+    pm.release("http", True, (2, 80))
+    assert pm.lookup("http", True) is None
+    # released port is reused
+    assert pm.acquire("dns", False, (1, 53)).proxy_port == 100
+
+
+def test_agent_reconciles_redirect_lifecycle():
+    cfg = Config()
+    cfg.configure_logging = False
+    agent = Agent(cfg).start()
+    try:
+        agent.endpoint_add(1, {"app": "svc"})
+        agent.endpoint_add(2, {"app": "peer"})
+        assert agent.proxy_manager.dump() == []
+
+        agent.policy_add(load_cnp_yaml_text(HTTP_CNP)[0])
+        dump = agent.proxy_manager.dump()
+        assert len(dump) == 1
+        assert dump[0]["l7proto"] == "http" and dump[0]["ingress"]
+        http_port = dump[0]["proxy_port"]
+
+        # a second L7 family adds a second redirect; http's port is
+        # STABLE across the reconcile
+        agent.policy_add(load_cnp_yaml_text(KAFKA_CNP)[0])
+        dump = {d["l7proto"]: d for d in agent.proxy_manager.dump()}
+        assert set(dump) == {"http", "kafka"}
+        assert dump["http"]["proxy_port"] == http_port
+
+        # removing the http policy releases only the http redirect
+        agent.policy_delete(
+            ["k8s:io.cilium.k8s.policy.name=http-api",
+             "k8s:io.cilium.k8s.policy.namespace=default"])
+        dump = {d["l7proto"]: d for d in agent.proxy_manager.dump()}
+        assert set(dump) == {"kafka"}
+    finally:
+        agent.stop()

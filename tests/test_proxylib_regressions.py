@@ -179,3 +179,20 @@ def test_acks0_produce_denial_has_no_inject():
     ops = parser.on_data(False, False, bytes(denied))
     assert ops == [(OpType.DROP, len(denied))]
     assert conn.take_inject() == b""
+
+
+def test_unknown_kafka_version_denial_is_bare_drop():
+    """Versions outside the layouts we can encode (e.g. produce v3+,
+    whose request gains transactional_id and shifts acks) get NO
+    injected response — a guessed-wrong frame would desync worse than
+    silence."""
+    loader, ids = _kafka_setup()
+    bridge = PolicyBridge(loader, deadline_ms=1.0)
+    conn = Connection(proto="kafka", connection_id=10, ingress=True,
+                      src_identity=ids["cli"], dst_identity=ids["kafka"],
+                      dport=9092)
+    parser = create_parser("kafka", conn, bridge.policy_check(conn))
+    denied = encode_request(0, 3, 12, "c", "evil-topic")
+    ops = parser.on_data(False, False, denied)
+    assert ops == [(OpType.DROP, len(denied))]
+    assert conn.take_inject() == b""
