@@ -118,7 +118,8 @@ class _FieldMatcher:
 
     @classmethod
     def build(cls, patterns: List[str], cfg: EngineConfig,
-              case_insensitive: bool = False) -> "_FieldMatcher":
+              case_insensitive: bool = False,
+              bank_cache=None) -> "_FieldMatcher":
         uniq: List[str] = []
         index: Dict[str, int] = {}
         for p in patterns:
@@ -132,6 +133,7 @@ class _FieldMatcher:
                 max_states=cfg.max_dfa_states,
                 max_quantifier=cfg.max_quantifier,
                 case_insensitive=case_insensitive,
+                bank_cache=bank_cache,
             )
             if uniq
             else _empty_banked()
@@ -199,7 +201,11 @@ class CompiledPolicy:
         cfg: Optional[EngineConfig] = None,
         revision: int = 0,
         secret_lookup=None,
+        bank_cache=None,
     ) -> "CompiledPolicy":
+        """``bank_cache`` (compiler.dfa.BankCache): reuse compiled DFA
+        banks across builds — incremental rule updates recompile only
+        banks whose pattern membership changed."""
         cfg = cfg or EngineConfig()
 
         # -- collect the L7 rule universe (deduped) and rulesets --------
@@ -270,12 +276,14 @@ class CompiledPolicy:
 
         # -- compile field matchers -------------------------------------
         path_matcher = _FieldMatcher.build(
-            [h.path for h in http_rules if h.path], cfg)
+            [h.path for h in http_rules if h.path], cfg,
+            bank_cache=bank_cache)
         method_matcher = _FieldMatcher.build(
-            [h.method for h in http_rules if h.method], cfg)
+            [h.method for h in http_rules if h.method], cfg,
+            bank_cache=bank_cache)
         host_matcher = _FieldMatcher.build(
             [h.host for h in http_rules if h.host], cfg,
-            case_insensitive=True)
+            case_insensitive=True, bank_cache=bank_cache)
         from cilium_tpu.secrets import resolve_header_value
 
         header_pats: List[str] = []
@@ -319,7 +327,8 @@ class CompiledPolicy:
             rule_log_lanes.append(log_pats)
             rule_dead.append(dead)
             header_rewrites.append(rewrites)
-        header_matcher = _FieldMatcher.build(header_pats, cfg)
+        header_matcher = _FieldMatcher.build(header_pats, cfg,
+                                             bank_cache=bank_cache)
 
         dns_pats = []
         for d in dns_rules:
@@ -327,7 +336,8 @@ class CompiledPolicy:
                 dns_pats.append(matchpattern.name_to_regex(d.match_name))
             else:
                 dns_pats.append(matchpattern.to_regex(d.match_pattern))
-        dns_matcher = _FieldMatcher.build(dns_pats, cfg)
+        dns_matcher = _FieldMatcher.build(dns_pats, cfg,
+                                          bank_cache=bank_cache)
 
         # -- per-rule lane arrays ---------------------------------------
         Rh = max(1, len(http_rules))

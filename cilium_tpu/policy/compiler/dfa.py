@@ -195,31 +195,86 @@ class BankedDFA:
         }
 
 
+class BankCache:
+    """Content-addressed cache of compiled :class:`DFABank` objects —
+    the incremental-compile mechanism (SURVEY §7 hard part #4): a rule
+    update recompiles only the banks whose pattern membership changed;
+    unchanged banks (the common case: patterns append at the end of a
+    family's universe) are reused across regenerations. A cached
+    ``None`` records "this pattern group overflows the state cap", so
+    the split decision is also remembered. Bounded LRU."""
+
+    _MISS = object()
+
+    def __init__(self, max_banks: int = 4096):
+        import collections
+
+        self._od = collections.OrderedDict()
+        self.max_banks = max_banks
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key):
+        v = self._od.get(key, self._MISS)
+        if v is self._MISS:
+            self.misses += 1
+            return self._MISS
+        self._od.move_to_end(key)
+        self.hits += 1
+        return v
+
+    def put(self, key, bank) -> None:
+        self._od[key] = bank
+        self._od.move_to_end(key)
+        while len(self._od) > self.max_banks:
+            self._od.popitem(last=False)
+
+
 def compile_patterns(
     patterns: Sequence[str],
     bank_size: int = 64,
     max_states: int = 8192,
     max_quantifier: int = 64,
     case_insensitive: bool = False,
+    bank_cache: Optional[BankCache] = None,
 ) -> BankedDFA:
     """Compile ``patterns`` (regex sources) into a :class:`BankedDFA`.
 
     Patterns are greedily grouped into banks of ``bank_size``; a bank
     whose subset construction exceeds ``max_states`` is split in half
     recursively (single patterns that alone exceed the cap are rejected).
+    With a ``bank_cache``, banks whose pattern group compiled before
+    are reused (incremental rule updates).
     """
-    asts = [rp.parse(p, max_quantifier=max_quantifier,
-                     case_insensitive=case_insensitive) for p in patterns]
+    # ASTs parse LAZILY: a fully-cached rebuild must not pay O(N)
+    # regex parsing — the cache key is built from pattern strings alone
+    asts: List = [None] * len(patterns)
+
+    def _ast(i: int):
+        if asts[i] is None:
+            asts[i] = rp.parse(patterns[i],
+                               max_quantifier=max_quantifier,
+                               case_insensitive=case_insensitive)
+        return asts[i]
 
     banks: List[DFABank] = []
     pattern_bank = np.zeros(len(patterns), dtype=np.int32)
     pattern_lane = np.zeros(len(patterns), dtype=np.int32)
 
     def compile_range(indices: List[int]) -> None:
-        try:
-            bank = compile_bank([asts[i] for i in indices],
-                                max_states=max_states)
-        except BankOverflow:
+        key = (tuple(patterns[i] for i in indices),
+               max_states, max_quantifier, case_insensitive)
+        bank = (bank_cache.get(key) if bank_cache is not None
+                else BankCache._MISS)
+        if bank is BankCache._MISS:
+            try:
+                bank = compile_bank([_ast(i) for i in indices],
+                                    max_states=max_states)
+            except BankOverflow:
+                bank = None
+            if bank_cache is not None:
+                bank_cache.put(key, bank)
+        if bank is None:  # overflows the state cap → split
             if len(indices) == 1:
                 raise rp.RegexError(
                     f"pattern too large for state cap: {patterns[indices[0]]!r}")

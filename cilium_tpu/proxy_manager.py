@@ -153,27 +153,28 @@ class ProxyManager:
         reference keeps a redirect's port for its lifetime)."""
         want = self._snapshot_users(per_identity)
         with self._lock:
-            # drop stale redirects / stale users
+            # a redirect still demanded by the snapshot keeps its PORT
+            # even when its user set is fully replaced (e.g. an
+            # endpoint re-identified): release only keys nothing wants
+            # — a delete-then-recreate could swap ports between live
+            # redirects and misroute an externally-bound proxy
             for key in list(self._redirects):
-                r = self._redirects[key]
-                keep = want.get(key, set())
-                r.users &= keep
-                if not r.users:
-                    del self._redirects[key]
-                    self._free.append(r.proxy_port)
-                    METRICS.inc(
-                        "cilium_tpu_proxy_redirects_released_total",
-                        labels={"l7proto": r.l7proto})
-            # add wanted
+                if key in want:
+                    self._redirects[key].users = set(want[key])
+                    continue
+                r = self._redirects.pop(key)
+                self._free.append(r.proxy_port)
+                METRICS.inc(
+                    "cilium_tpu_proxy_redirects_released_total",
+                    labels={"l7proto": r.l7proto})
             for key, users in want.items():
-                r = self._redirects.get(key)
-                if r is None:
+                if key not in self._redirects:
                     r = Redirect(key[0], key[1], self._alloc_port())
+                    r.users = set(users)
                     self._redirects[key] = r
                     METRICS.inc(
                         "cilium_tpu_proxy_redirects_created_total",
                         labels={"l7proto": key[0]})
-                r.users |= users
             self._set_gauge()
             return {k: r.proxy_port
                     for k, r in self._redirects.items()}

@@ -64,6 +64,13 @@ class Loader:
         self._revision = 0
         self._cache = ArtifactCache(self.config.loader.cache_dir,
                                     self.config.loader.enable_cache)
+        # per-loader DFA bank cache: incremental rule updates recompile
+        # only the banks whose pattern group changed (SURVEY §7 hard
+        # part #4 — the reference stays O(Δ) via SelectorCache; our
+        # compile stays O(Δ banks) via this)
+        from cilium_tpu.policy.compiler.dfa import BankCache
+
+        self.bank_cache = BankCache()
 
     @property
     def revision(self) -> int:
@@ -128,7 +135,8 @@ class Loader:
                 policy = CompiledPolicy.build(per_identity,
                                               self.config.engine,
                                               revision=revision,
-                                              secret_lookup=secret_lookup)
+                                              secret_lookup=secret_lookup,
+                                              bank_cache=self.bank_cache)
             self._cache.put(key, policy)
             METRICS.observe("cilium_tpu_compile_seconds", span.seconds)
         with _log_span(LOG, "policy staged", revision=revision,

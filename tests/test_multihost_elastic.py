@@ -53,27 +53,37 @@ def _launch_once(tmp_path, tag: str, crash_pid, timeout):
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
-            return None, f"worker {i} hung in round {tag}"
+            return None, f"worker {i} hung in round {tag}", True
         want_rc = 1 if i == crash_pid else 0
         if p.returncode != want_rc:
+            text = stderr.decode()[-2000:]
+            # ONLY the coordination-service startup/exit-polling
+            # misfires seen under host load are retryable; any other
+            # wrong exit code is a real failure and must fail fast
+            retryable = ("coordination" in text.lower()
+                         or "UNAVAILABLE" in text
+                         or "DEADLINE" in text)
             return None, (f"worker {i} rc={p.returncode} (want "
-                          f"{want_rc})\n{stderr.decode()[-2000:]}")
+                          f"{want_rc})\n{text}"), retryable
         with open(outs[i]) as fp:
             results.append(json.load(fp))
-    return results, ""
+    return results, "", False
 
 
 def _launch_round(tmp_path, tag: str, crash_pid=None, timeout=180):
     # under a fully loaded host the coordination service's startup
-    # barrier / exit polling can misfire spuriously; retry a couple of
-    # times — the ASSERTIONS on the results stay strict
+    # barrier / exit polling can misfire spuriously; retry THOSE only
+    # — real worker failures fail fast, and the result assertions
+    # stay strict
     err = ""
     for attempt in range(3):
-        results, err = _launch_once(tmp_path, f"{tag}-a{attempt}",
-                                    crash_pid, timeout)
+        results, err, retryable = _launch_once(
+            tmp_path, f"{tag}-a{attempt}", crash_pid, timeout)
         if results is not None:
             return results
-    pytest.fail(f"round {tag} failed 3 attempts: {err}")
+        if not retryable:
+            break
+    pytest.fail(f"round {tag} failed: {err}")
 
 
 def test_two_process_cluster_kill_and_rejoin(tmp_path):
