@@ -327,6 +327,27 @@ def cmd_proxy_list(args) -> int:
     return _print(_api(args).proxy_redirects())
 
 
+def cmd_policy_trace(args) -> int:
+    """`cilium policy trace` analog over the REST API."""
+    def _labels(specs):
+        # pass label STRINGS through verbatim so source prefixes
+        # ("cidr:10.0.0.0/8", "reserved:world") survive the transport
+        out = []
+        for spec in specs or ():
+            out.extend(s for s in spec.split(",") if s)
+        return out
+
+    named_ports = {}
+    for spec in args.named_port or ():
+        name, _, port = spec.partition("=")
+        named_ports[name] = int(port)
+    return _print(_api(args).policy_trace(
+        _labels(args.src), _labels(args.dst),
+        dport=args.dport, protocol=args.protocol,
+        direction="egress" if args.egress else "ingress",
+        named_ports=named_ports or None))
+
+
 def cmd_fqdn_cache(args) -> int:
     return _print(_api(args).fqdn_cache())
 
@@ -372,6 +393,11 @@ def cmd_observe(args) -> int:
         flt["dport"] = args.dport
     if args.identity is not None:   # identity 0 = unidentified source
         flt["src_identity"] = args.identity
+    for name in ("http_method", "http_path", "dns_query", "node_name",
+                 "source_label", "destination_label"):
+        v = getattr(args, name, None)
+        if v:
+            flt[name] = v
     c = HubbleClient(args.hubble)
     if args.status:
         return _print(c.server_status())
@@ -403,6 +429,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     pg = psub.add_parser("get")
     pg.add_argument("--socket", required=True)
     pg.set_defaults(fn=cmd_policy_get)
+    pt = psub.add_parser("trace",
+                         help="explain the verdict for hypothetical "
+                              "src/dst label sets")
+    pt.add_argument("--api", required=True)
+    pt.add_argument("--src", action="append",
+                    help="source labels k=v[,k=v]")
+    pt.add_argument("--dst", action="append",
+                    help="destination labels k=v[,k=v]")
+    pt.add_argument("--dport", type=int, default=0)
+    pt.add_argument("--protocol", type=int, default=6)
+    pt.add_argument("--egress", action="store_true",
+                    help="trace egress (default ingress)")
+    pt.add_argument("--named-port", dest="named_port", action="append",
+                    help="endpoint named-port table entry name=port "
+                         "(resolves named toPorts in traced rules)")
+    pt.set_defaults(fn=cmd_policy_trace)
 
     p = sub.add_parser("metrics", help="Prometheus text metrics")
     p.add_argument("--socket", required=True)
@@ -496,6 +538,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--verdict", help="FORWARDED/DROPPED/REDIRECTED")
     p.add_argument("--dport", type=int)
     p.add_argument("--identity", type=int, help="source identity filter")
+    p.add_argument("--http-method", dest="http_method",
+                   help="HTTP method regex")
+    p.add_argument("--http-path", dest="http_path",
+                   help="HTTP path regex")
+    p.add_argument("--dns-query", dest="dns_query",
+                   help="DNS query regex")
+    p.add_argument("--node-name", dest="node_name",
+                   help="emitting node regex")
+    p.add_argument("--source-label", dest="source_label",
+                   help="source endpoint label substring")
+    p.add_argument("--destination-label", dest="destination_label",
+                   help="destination endpoint label substring")
     p.add_argument("--status", action="store_true",
                    help="print server status instead of flows")
     p.set_defaults(fn=cmd_observe)

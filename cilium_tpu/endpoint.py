@@ -36,7 +36,10 @@ def with_cluster_label(labels: LabelSet, cluster_name: str) -> LabelSet:
     Reserved-identity label sets (host/health/…) are left untouched:
     adding a k8s label would re-allocate them as user identities."""
     if labels.get(CLUSTER_LABEL_KEY) is not None or any(
-            l.source == SOURCE_RESERVED for l in labels):
+            l.source in (SOURCE_RESERVED, "cidr") for l in labels):
+        # reserved AND cidr label sets stay untouched: stamping a CIDR
+        # peer as an in-cluster workload would make it match `cluster`
+        # entity rules (policy trace passes such sets through here)
         return labels
     return LabelSet(list(labels) + [
         Label(key=CLUSTER_LABEL_KEY, value=cluster_name,

@@ -49,13 +49,32 @@ def annotate_flows(flows: Sequence[Flow], outputs: Dict[str, np.ndarray],
 
 @dataclasses.dataclass
 class FlowFilter:
-    """Subset of flowpb FlowFilter."""
+    """flowpb FlowFilter for the fields our flows carry (reference
+    ``hubble observe`` filter surface): identity/port/verdict/L7 type
+    plus regex matches on HTTP method/path, DNS query, node name, and
+    label substrings on either endpoint. Regex fields use un-anchored
+    search semantics, matching the reference's filter behavior."""
 
     verdict: Optional[Verdict] = None
     l7_type: Optional[L7Type] = None
     src_identity: Optional[int] = None
     dst_identity: Optional[int] = None
     dport: Optional[int] = None
+    protocol: Optional[int] = None
+    http_method: Optional[str] = None   # regex
+    http_path: Optional[str] = None     # regex
+    dns_query: Optional[str] = None     # regex
+    node_name: Optional[str] = None     # regex
+    source_label: Optional[str] = None       # label string substring
+    destination_label: Optional[str] = None  # label string substring
+
+    def _re(self, pattern: str, value: str) -> bool:
+        import re
+
+        try:
+            return re.search(pattern, value or "") is not None
+        except re.error:
+            return False  # bad client pattern matches nothing
 
     def matches(self, f: Flow) -> bool:
         if self.verdict is not None and f.verdict != self.verdict:
@@ -67,6 +86,26 @@ class FlowFilter:
         if self.dst_identity is not None and f.dst_identity != self.dst_identity:
             return False
         if self.dport is not None and f.dport != self.dport:
+            return False
+        if self.protocol is not None and int(f.protocol) != self.protocol:
+            return False
+        if self.http_method is not None and not (
+                f.http and self._re(self.http_method, f.http.method)):
+            return False
+        if self.http_path is not None and not (
+                f.http and self._re(self.http_path, f.http.path)):
+            return False
+        if self.dns_query is not None and not (
+                f.dns and self._re(self.dns_query, f.dns.query)):
+            return False
+        if self.node_name is not None and not self._re(
+                self.node_name, f.node_name):
+            return False
+        if self.source_label is not None and not any(
+                self.source_label in s for s in f.src_labels):
+            return False
+        if self.destination_label is not None and not any(
+                self.destination_label in s for s in f.dst_labels):
             return False
         return True
 

@@ -45,6 +45,13 @@ def filter_to_dict(flt: Optional[FlowFilter]) -> Optional[Dict]:
         "src_identity": flt.src_identity,
         "dst_identity": flt.dst_identity,
         "dport": flt.dport,
+        "protocol": flt.protocol,
+        "http_method": flt.http_method,
+        "http_path": flt.http_path,
+        "dns_query": flt.dns_query,
+        "node_name": flt.node_name,
+        "source_label": flt.source_label,
+        "destination_label": flt.destination_label,
     }
 
 
@@ -57,6 +64,13 @@ def filter_from_dict(d: Optional[Dict]) -> Optional[FlowFilter]:
         src_identity=d.get("src_identity"),
         dst_identity=d.get("dst_identity"),
         dport=d.get("dport"),
+        protocol=d.get("protocol"),
+        http_method=d.get("http_method"),
+        http_path=d.get("http_path"),
+        dns_query=d.get("dns_query"),
+        node_name=d.get("node_name"),
+        source_label=d.get("source_label"),
+        destination_label=d.get("destination_label"),
     )
 
 

@@ -206,13 +206,26 @@ class BankCache:
 
     _MISS = object()
 
-    def __init__(self, max_banks: int = 4096):
+    def __init__(self, max_banks: int = 4096,
+                 max_bytes: int = 256 << 20):
         import collections
 
         self._od = collections.OrderedDict()
         self.max_banks = max_banks
+        #: cumulative tensor-byte bound — a bank can be up to ~8MB
+        #: (8192 states x 256 classes x int32), so a count bound alone
+        #: could retain gigabytes
+        self.max_bytes = max_bytes
+        self.bytes = 0
         self.hits = 0
         self.misses = 0
+
+    @staticmethod
+    def _bank_bytes(bank) -> int:
+        if bank is None:
+            return 0
+        return int(bank.trans.nbytes + bank.accept.nbytes
+                   + bank.byteclass.nbytes)
 
     def get(self, key):
         v = self._od.get(key, self._MISS)
@@ -224,10 +237,16 @@ class BankCache:
         return v
 
     def put(self, key, bank) -> None:
+        old = self._od.get(key)
+        if old is not None:
+            self.bytes -= self._bank_bytes(old)
         self._od[key] = bank
         self._od.move_to_end(key)
-        while len(self._od) > self.max_banks:
-            self._od.popitem(last=False)
+        self.bytes += self._bank_bytes(bank)
+        while self._od and (len(self._od) > self.max_banks
+                            or self.bytes > self.max_bytes):
+            _, evicted = self._od.popitem(last=False)
+            self.bytes -= self._bank_bytes(evicted)
 
 
 def compile_patterns(

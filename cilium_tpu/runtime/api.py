@@ -225,6 +225,40 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     int(body["src_identity"]), int(body["dst_identity"]),
                     ttl=body.get("ttl"))
                 return self._send(201, {"ok": True})
+            if path == "/v1/policy/trace":
+                # `cilium policy trace` analog: explain the verdict
+                # for HYPOTHETICAL src/dst label sets
+                from cilium_tpu.core.labels import LabelSet, ParseLabel
+                from cilium_tpu.endpoint import with_cluster_label
+                from cilium_tpu.policy.trace import trace
+
+                body = json.loads(self._body() or b"{}")
+                cluster = agent.config.cluster_name
+
+                def _ls(v):
+                    # list form preserves sources ("cidr:10.0.0.0/8",
+                    # "reserved:world"); dict form parses each k=v via
+                    # ParseLabel so source-prefixed keys survive too
+                    if isinstance(v, dict):
+                        items = [f"{k}={val}" if val else str(k)
+                                 for k, val in v.items()]
+                    else:
+                        items = [str(s) for s in (v or ())]
+                    return with_cluster_label(
+                        LabelSet(ParseLabel(s) for s in items), cluster)
+
+                result = trace(
+                    agent.repo,
+                    src_labels=_ls(body.get("src_labels")),
+                    dst_labels=_ls(body.get("dst_labels")),
+                    dport=int(body.get("dport", 0) or 0),
+                    proto=int(body.get("protocol", 6) or 6),
+                    ingress=(str(body.get("direction", "ingress"))
+                             .lower() != "egress"),
+                    cluster_name=cluster,
+                    named_ports=body.get("named_ports"),
+                )
+                return self._send(200, result)
             return self._send(404, {"error": f"no such resource {path}"})
         except Exception as e:
             return self._send(400, {"error": f"{type(e).__name__}: {e}"})
@@ -410,6 +444,13 @@ class APIClient:
 
     def proxy_redirects(self):
         return self.request("GET", "/v1/proxy")[1]
+
+    def policy_trace(self, src_labels, dst_labels, dport=0,
+                     protocol=6, direction="ingress", named_ports=None):
+        return self.request("PUT", "/v1/policy/trace", {
+            "src_labels": src_labels, "dst_labels": dst_labels,
+            "dport": dport, "protocol": protocol,
+            "direction": direction, "named_ports": named_ports})[1]
 
     def ipcache(self):
         return self.request("GET", "/v1/ip")[1]

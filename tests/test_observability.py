@@ -165,3 +165,49 @@ def test_agent_process_flows_feeds_monitor_and_hubble(tmp_path):
                 "metrics.txt", "endpoints.json"} <= names
     finally:
         agent.stop()
+
+
+def test_flow_filter_l7_and_label_fields():
+    """Round-2 FlowFilter parity: regex filters on HTTP method/path,
+    DNS query, node name; label substring filters on either side."""
+    from cilium_tpu.core.flow import (
+        DNSInfo,
+        Flow,
+        HTTPInfo,
+        L7Type,
+    )
+    from cilium_tpu.hubble.observer import FlowFilter
+
+    http = Flow(src_identity=1, dst_identity=2, dport=80,
+                l7=L7Type.HTTP, node_name="node-a",
+                src_labels=("k8s:app=frontend",),
+                http=HTTPInfo(method="GET", path="/api/v1/items"))
+    dns = Flow(src_identity=3, dst_identity=4, dport=53,
+               l7=L7Type.DNS, node_name="node-b",
+               dst_labels=("reserved:world",),
+               dns=DNSInfo(query="www.example.com"))
+
+    assert FlowFilter(http_method="GET|HEAD").matches(http)
+    assert not FlowFilter(http_method="^POST$").matches(http)
+    assert FlowFilter(http_path="/api/v[0-9]+/").matches(http)
+    assert not FlowFilter(http_path="/admin").matches(http)
+    # an HTTP filter never matches a non-HTTP flow
+    assert not FlowFilter(http_path="/").matches(dns)
+    assert FlowFilter(dns_query=r"example\.com$").matches(dns)
+    assert not FlowFilter(dns_query="^evil").matches(dns)
+    assert FlowFilter(node_name="node-[ab]").matches(http)
+    assert FlowFilter(source_label="app=frontend").matches(http)
+    assert not FlowFilter(source_label="app=backend").matches(http)
+    assert FlowFilter(destination_label="reserved:world").matches(dns)
+    # malformed client regex matches nothing rather than raising
+    assert not FlowFilter(http_path="[").matches(http)
+
+
+def test_hubble_filter_roundtrip_serde():
+    from cilium_tpu.hubble.observer import FlowFilter
+    from cilium_tpu.hubble.server import filter_from_dict, filter_to_dict
+
+    flt = FlowFilter(http_path="/x", dns_query="a", node_name="n",
+                     source_label="s", destination_label="d",
+                     protocol=6, http_method="GET")
+    assert filter_from_dict(filter_to_dict(flt)) == flt
