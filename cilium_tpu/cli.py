@@ -340,6 +340,10 @@ def cmd_policy_trace(args) -> int:
     named_ports = {}
     for spec in args.named_port or ():
         name, _, port = spec.partition("=")
+        if not name or not port.isdigit():
+            print(f"error: --named-port wants name=port, got {spec!r}",
+                  file=sys.stderr)
+            return 2
         named_ports[name] = int(port)
     return _print(_api(args).policy_trace(
         _labels(args.src), _labels(args.dst),

@@ -9,6 +9,7 @@ outputs back onto the Flow objects.
 from __future__ import annotations
 
 import dataclasses
+import re as _re_mod
 import threading
 import time
 import uuid
@@ -69,11 +70,9 @@ class FlowFilter:
     destination_label: Optional[str] = None  # label string substring
 
     def _re(self, pattern: str, value: str) -> bool:
-        import re
-
         try:
-            return re.search(pattern, value or "") is not None
-        except re.error:
+            return _re_mod.search(pattern, value or "") is not None
+        except _re_mod.error:
             return False  # bad client pattern matches nothing
 
     def matches(self, f: Flow) -> bool:

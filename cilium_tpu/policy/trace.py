@@ -125,6 +125,22 @@ def trace(repo, src_labels: LabelSet, dst_labels: LabelSet,
         for dr in (rule.ingress if ingress else rule.egress):
             enforced = True
             if not _peer_matches(dr, peer, requires, cluster_name):
+                # FQDN/service/group peers resolve against RUNTIME
+                # state (DNS answers, service backends, providers) the
+                # rule-level trace doesn't have — say so instead of
+                # silently reporting a bare default-deny
+                runtime_peers = [name for name, field in (
+                    ("toFQDNs", "to_fqdns"),
+                    ("toServices", "to_services"),
+                    ("toGroups", "to_groups"),
+                ) if getattr(dr, field, ())]
+                if runtime_peers:
+                    notes.append(
+                        f"rule {list(rule.labels)}: "
+                        f"{'/'.join(runtime_peers)} peers resolve "
+                        "against runtime state (DNS answers, service "
+                        "backends, group providers) — not evaluated "
+                        "by trace; the datapath may allow this flow")
                 continue
             if dr.icmps:
                 from cilium_tpu.policy.mapstate import _ICMP_PROTOS

@@ -228,7 +228,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             if path == "/v1/policy/trace":
                 # `cilium policy trace` analog: explain the verdict
                 # for HYPOTHETICAL src/dst label sets
-                from cilium_tpu.core.labels import LabelSet, ParseLabel
+                from cilium_tpu.core.labels import LabelSet
                 from cilium_tpu.endpoint import with_cluster_label
                 from cilium_tpu.policy.trace import trace
 
@@ -238,14 +238,15 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 def _ls(v):
                     # list form preserves sources ("cidr:10.0.0.0/8",
                     # "reserved:world"); dict form parses each k=v via
-                    # ParseLabel so source-prefixed keys survive too
+                    # the shared label parser so source-prefixed keys
+                    # survive too
                     if isinstance(v, dict):
                         items = [f"{k}={val}" if val else str(k)
                                  for k, val in v.items()]
                     else:
                         items = [str(s) for s in (v or ())]
-                    return with_cluster_label(
-                        LabelSet(ParseLabel(s) for s in items), cluster)
+                    return with_cluster_label(LabelSet.parse(items),
+                                              cluster)
 
                 result = trace(
                     agent.repo,

@@ -165,3 +165,25 @@ spec:
         assert rc == 0 and '"ALLOWED"' in out
     finally:
         agent.stop()
+
+
+def test_trace_notes_runtime_resolved_peers():
+    """toFQDNs/toServices/toGroups peers resolve against runtime state
+    the trace doesn't have — the trace must SAY so, not report a bare
+    default-deny."""
+    repo = Repository()
+    for cnp in load_cnp_yaml_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: fqdn-out}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  egress:
+  - toFQDNs: [{matchName: example.com}]
+    toPorts: [{ports: [{port: "443", protocol: TCP}]}]
+"""):
+        repo.add(list(cnp.rules))
+    r = trace(repo, src_labels=_ls(app="svc"), dst_labels=_ls(app="x"),
+              dport=443, ingress=False)
+    assert r["verdict"] == "DENIED"
+    assert any("toFQDNs" in n and "runtime" in n for n in r["notes"])
