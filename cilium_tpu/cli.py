@@ -340,7 +340,7 @@ def cmd_policy_trace(args) -> int:
     named_ports = {}
     for spec in args.named_port or ():
         name, _, port = spec.partition("=")
-        if not name or not port.isdigit():
+        if not name or not port.isdecimal():
             print(f"error: --named-port wants name=port, got {spec!r}",
                   file=sys.stderr)
             return 2
@@ -449,6 +449,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="endpoint named-port table entry name=port "
                          "(resolves named toPorts in traced rules)")
     pt.set_defaults(fn=cmd_policy_trace)
+    ps_ = psub.add_parser("selectors",
+                          help="live selector -> identity resolution")
+    ps_.add_argument("--api", required=True)
+    ps_.set_defaults(fn=lambda args: _print(_api(args).selectors()))
 
     p = sub.add_parser("metrics", help="Prometheus text metrics")
     p.add_argument("--socket", required=True)

@@ -81,6 +81,19 @@ class SelectorCache:
         with self._lock:
             self._selections.pop(sel, None)
 
+    def dump(self):
+        """Registered selectors → selected identities (the
+        ``cilium-dbg policy selectors`` surface)."""
+        with self._lock:
+            return [
+                {"selector": sel.cache_key(),
+                 "kind": type(sel).__name__,
+                 "identities": sorted(int(i) for i in ids)}
+                for sel, ids in sorted(
+                    self._selections.items(),
+                    key=lambda kv: kv[0].cache_key())
+            ]
+
     def get_selections(self, sel: Selector) -> FrozenSet[int]:
         with self._lock:
             got = self._selections.get(sel)

@@ -128,19 +128,28 @@ def trace(repo, src_labels: LabelSet, dst_labels: LabelSet,
                 # FQDN/service/group peers resolve against RUNTIME
                 # state (DNS answers, service backends, providers) the
                 # rule-level trace doesn't have — say so instead of
-                # silently reporting a bare default-deny
+                # silently reporting a bare default-deny. Only when
+                # the rest of the rule COULD cover this flow: if its
+                # ports don't match or requires reject the peer, no
+                # runtime resolution could make the rule apply
                 runtime_peers = [name for name, field in (
                     ("toFQDNs", "to_fqdns"),
                     ("toServices", "to_services"),
                     ("toGroups", "to_groups"),
                 ) if getattr(dr, field, ())]
                 if runtime_peers:
-                    notes.append(
-                        f"rule {list(rule.labels)}: "
-                        f"{'/'.join(runtime_peers)} peers resolve "
-                        "against runtime state (DNS answers, service "
-                        "backends, group providers) — not evaluated "
-                        "by trace; the datapath may allow this flow")
+                    ports_ok, _, _ = _ports_match(
+                        dr.to_ports, dport, proto, named_ports)
+                    reqs_ok = all(sel.matches(peer)
+                                  for sel in requires)
+                    if ports_ok and reqs_ok:
+                        notes.append(
+                            f"rule {list(rule.labels)}: "
+                            f"{'/'.join(runtime_peers)} peers resolve "
+                            "against runtime state (DNS answers, "
+                            "service backends, group providers) — not "
+                            "evaluated by trace; the datapath may "
+                            "allow this flow")
                 continue
             if dr.icmps:
                 from cilium_tpu.policy.mapstate import _ICMP_PROTOS

@@ -158,6 +158,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                      "affinity": s.affinity}
                     for s in agent.services.list()
                 ])
+            if path == "/v1/selectors":
+                # `cilium-dbg policy selectors` analog: live selector →
+                # identity resolution state
+                return self._send(200, agent.selector_cache.dump())
             if path == "/v1/proxy":
                 # redirect table (`cilium-dbg status --all-redirects`
                 # analog): live (l7proto, direction) → proxy port
@@ -445,6 +449,9 @@ class APIClient:
 
     def proxy_redirects(self):
         return self.request("GET", "/v1/proxy")[1]
+
+    def selectors(self):
+        return self.request("GET", "/v1/selectors")[1]
 
     def policy_trace(self, src_labels, dst_labels, dport=0,
                      protocol=6, direction="ingress", named_ports=None):

@@ -58,11 +58,12 @@ _peer = st.one_of(
     st.just(CIDRRule(cidr=CIDR)),
 )
 
-_ingress = st.tuples(_peer, _ports, st.booleans(), st.booleans()).map(
+_ingress = st.tuples(_peer, _ports, st.booleans(), st.booleans(),
+                     st.sampled_from(["", "required", "disabled"])).map(
     lambda t: _mk_ingress(*t))
 
 
-def _mk_ingress(peer, ports, deny, icmp):
+def _mk_ingress(peer, ports, deny, icmp, auth):
     kw = dict(deny=deny)
     if isinstance(peer, EndpointSelector):
         kw["from_endpoints"] = (peer,)
@@ -74,6 +75,8 @@ def _mk_ingress(peer, ports, deny, icmp):
         kw["icmps"] = (ICMPField(family="IPv4", icmp_type=8),)
     elif ports:
         kw["to_ports"] = (PortRule(ports=ports),)
+    if not deny:
+        kw["auth_mode"] = auth
     return IngressRule(**kw)
 
 
@@ -131,11 +134,17 @@ def test_engine_equals_oracle_on_random_policies(rules, flows):
         for s, dst, dport, proto in flows
     ]
 
+    # no authed_pairs on either side: both must FAIL CLOSED the same
+    # way on auth-demanding entries (incl. authPreferredInsert
+    # propagation to narrower allows), and agree on the demand lane
     oracle = OracleVerdictEngine(per_identity)
-    want = oracle.verdict_flows(flow_objs)["verdict"]
+    want = oracle.verdict_flows(flow_objs)
     engine = VerdictEngine(
         CompiledPolicy.build(per_identity, EngineConfig(bank_size=8)))
-    got = engine.verdict_flows(flow_objs)["verdict"]
+    got = engine.verdict_flows(flow_objs)
     np.testing.assert_array_equal(
-        got, want,
+        got["verdict"], want["verdict"],
         err_msg=f"rules={rules!r} flows={flow_objs!r}")
+    np.testing.assert_array_equal(
+        got["auth_required"], want["auth_required"],
+        err_msg=f"auth lane: rules={rules!r} flows={flow_objs!r}")

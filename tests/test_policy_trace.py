@@ -187,3 +187,51 @@ spec:
               dport=443, ingress=False)
     assert r["verdict"] == "DENIED"
     assert any("toFQDNs" in n and "runtime" in n for n in r["notes"])
+
+
+def test_policy_selectors_over_rest(capsys):
+    d = tempfile.mkdtemp()
+    api = os.path.join(d, "api.sock")
+    cfg = Config()
+    cfg.configure_logging = False
+    agent = Agent(cfg, api_socket_path=api).start()
+    try:
+        ep = agent.endpoint_add(1, {"app": "peer"})
+        agent.endpoint_add(2, {"app": "svc"})
+        agent.policy_add(load_cnp_yaml_text(CNP)[0])
+        rc = cli.main(["policy", "selectors", "--api", api])
+        out = capsys.readouterr().out
+        assert rc == 0
+        import json as _json
+
+        entries = _json.loads(out)
+        by_sel = {e["selector"]: e for e in entries}
+        assert any("app=peer" in k for k in by_sel)
+        peer_sel = next(e for k, e in by_sel.items() if "app=peer" in k)
+        assert ep.identity in peer_sel["identities"]
+    finally:
+        agent.stop()
+
+
+def test_runtime_peer_note_only_when_rule_could_cover():
+    """The runtime-resolution note must not over-fire: if the rule's
+    ports can't cover the traced flow, no DNS/service resolution could
+    make it apply."""
+    repo = Repository()
+    for cnp in load_cnp_yaml_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: fqdn-443}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  egress:
+  - toFQDNs: [{matchName: example.com}]
+    toPorts: [{ports: [{port: "443", protocol: TCP}]}]
+"""):
+        repo.add(list(cnp.rules))
+    r80 = trace(repo, src_labels=_ls(app="svc"), dst_labels=_ls(app="x"),
+                dport=80, ingress=False)
+    assert r80["verdict"] == "DENIED" and r80["notes"] == []
+    r443 = trace(repo, src_labels=_ls(app="svc"),
+                 dst_labels=_ls(app="x"), dport=443, ingress=False)
+    assert any("toFQDNs" in n for n in r443["notes"])
