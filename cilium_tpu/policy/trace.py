@@ -138,11 +138,26 @@ def trace(repo, src_labels: LabelSet, dst_labels: LabelSet,
                     ("toGroups", "to_groups"),
                 ) if getattr(dr, field, ())]
                 if runtime_peers:
-                    ports_ok, _, _ = _ports_match(
-                        dr.to_ports, dport, proto, named_ports)
+                    # the same L4 coverage check the matched path
+                    # applies — with an UNRESOLVED named port counting
+                    # as could-cover (silently suppressing the note
+                    # there would hide both ambiguities at once)
+                    if dr.icmps:
+                        from cilium_tpu.policy.mapstate import (
+                            _ICMP_PROTOS,
+                        )
+
+                        could = proto in _ICMP_PROTOS and any(
+                            int(ic.protocol) == proto
+                            and ic.icmp_type == dport
+                            for ic in dr.icmps)
+                        unresolved = False
+                    else:
+                        could, _, unresolved = _ports_match(
+                            dr.to_ports, dport, proto, named_ports)
                     reqs_ok = all(sel.matches(peer)
                                   for sel in requires)
-                    if ports_ok and reqs_ok:
+                    if (could or unresolved) and reqs_ok:
                         notes.append(
                             f"rule {list(rule.labels)}: "
                             f"{'/'.join(runtime_peers)} peers resolve "

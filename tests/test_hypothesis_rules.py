@@ -72,7 +72,8 @@ def _mk_ingress(peer, ports, deny, icmp, auth):
     elif peer != "wildcard":
         kw["from_entities"] = (peer,)
     if icmp and not deny:
-        kw["icmps"] = (ICMPField(family="IPv4", icmp_type=8),)
+        kw["icmps"] = (ICMPField(family="IPv4", icmp_type=8),
+                       ICMPField(family="IPv6", icmp_type=128))
     elif ports:
         kw["to_ports"] = (PortRule(ports=ports),)
     if not deny:
@@ -92,8 +93,8 @@ _rule = st.tuples(_selector, st.lists(_ingress, min_size=1, max_size=3)).map(
         st.tuples(
             st.integers(0, 5),                     # src slot (see below)
             st.sampled_from(APPS),                 # dst app
-            st.sampled_from([0, 8, 80, 443, 1500, 8080, 30000]),
-            st.sampled_from([6, 17, 1]),           # tcp/udp/icmp
+            st.sampled_from([0, 8, 80, 128, 443, 1500, 8080, 30000]),
+            st.sampled_from([6, 17, 1, 58]),   # tcp/udp/icmp/icmpv6
         ),
         min_size=1, max_size=24),
 )

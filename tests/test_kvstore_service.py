@@ -228,3 +228,74 @@ def test_clustermesh_over_served_store(tmp_path):
     finally:
         kv.close()
         server.stop()
+
+
+def test_create_not_resent_after_ambiguous_connection_loss(tmp_path):
+    """ADVICE r1: a 'create' whose connection dies after the request
+    may have been APPLIED; blindly resending would report
+    created=False and make the caller believe a peer won the claim.
+    The client must surface the ambiguity (raise), not resend."""
+    import socket as _socket
+
+    import pytest
+
+    from cilium_tpu.kvstore_service import (
+        KVStoreServer,
+        RemoteKVStore,
+        send_msg,
+    )
+
+    path = str(tmp_path / "kv.sock")
+    server = KVStoreServer(socket_path=path).start()
+    try:
+        client = RemoteKVStore(path)
+        assert client.create("claim/1", "a") is True
+
+        # route the NEXT create through a DECOY endpoint that swallows
+        # the request and closes without replying — deterministic
+        # "connection died after send, application state unknown". A
+        # client that (wrongly) resends would reconnect to the REAL
+        # server and the create would succeed instead of raising.
+        import threading as _threading
+
+        decoy_path = str(tmp_path / "decoy.sock")
+        decoy = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+        decoy.bind(decoy_path)
+        decoy.listen(1)
+
+        def _swallow():
+            conn, _ = decoy.accept()
+            conn.recv(1 << 16)
+            conn.close()
+
+        t = _threading.Thread(target=_swallow, daemon=True)
+        t.start()
+        sabotage = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+        sabotage.connect(decoy_path)
+        real = client._sock
+        client._sock = sabotage
+        try:
+            with pytest.raises((OSError, ConnectionError)):
+                client.create("claim/2", "b")
+        finally:
+            if real is not None:
+                real.close()
+            t.join(timeout=2)
+            decoy.close()
+        # PROOF of no-resend: a resend would have landed claim/2 on
+        # the real server
+        fresh_check = RemoteKVStore(path)
+        try:
+            got = fresh_check.get("claim/2")
+        except KeyError:
+            got = None
+        assert got is None, "create was resent after ambiguous loss"
+        fresh_check.close()
+        # the ambiguity is the caller's to resolve (re-read, adopt);
+        # a FRESH client still works and sees consistent state
+        fresh = RemoteKVStore(path)
+        assert fresh.get("claim/1") == "a"
+        fresh.close()
+        client.close()
+    finally:
+        server.stop()

@@ -235,3 +235,36 @@ spec:
     r443 = trace(repo, src_labels=_ls(app="svc"),
                  dst_labels=_ls(app="x"), dport=443, ingress=False)
     assert any("toFQDNs" in n for n in r443["notes"])
+
+
+def test_runtime_peer_note_respects_named_ports_and_icmps():
+    repo = Repository()
+    for cnp in load_cnp_yaml_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: fqdn-named}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  egress:
+  - toFQDNs: [{matchName: example.com}]
+    toPorts: [{ports: [{port: "https", protocol: TCP}]}]
+---
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: fqdn-icmp}
+spec:
+  endpointSelector: {matchLabels: {app: pinger}}
+  egress:
+  - toFQDNs: [{matchName: example.com}]
+    icmps: [{fields: [{family: IPv4, type: 8}]}]
+"""):
+        repo.add(list(cnp.rules))
+    # unresolved named port: BOTH ambiguities noted, not silently
+    # dropped
+    r = trace(repo, src_labels=_ls(app="svc"), dst_labels=_ls(app="x"),
+              dport=443, ingress=False)
+    assert any("toFQDNs" in n for n in r["notes"])
+    # icmps-restricted rule can never cover a TCP flow → NO note
+    r = trace(repo, src_labels=_ls(app="pinger"),
+              dst_labels=_ls(app="x"), dport=80, ingress=False)
+    assert r["notes"] == []
