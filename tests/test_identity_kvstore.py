@@ -196,7 +196,8 @@ spec:
   - fromEndpoints: [{matchLabels: {app: web}}]
     toPorts: [{ports: [{port: "5432", protocol: TCP}]}]
 """)[0])
-        deadline = time.monotonic() + 10
+        deadline = time.monotonic() + 30  # generous: cross-process
+        # watch propagation can lag badly on a loaded host
         verdicts = None
         while time.monotonic() < deadline:
             out = agent_a.process_flows([
