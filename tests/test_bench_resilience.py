@@ -1,0 +1,58 @@
+"""bench.py survives transient backend failure (VERDICT r2 item 1).
+
+Round 2's official BENCH capture was lost to one transient axon
+``UNAVAILABLE`` during backend init. These tests inject that failure
+via CILIUM_TPU_BENCH_FAIL_FILE and assert the outer re-exec loop
+(probe → fresh inner process → bounded retry) both recovers from a
+transient failure and, on total failure, still emits ONE parseable
+JSON line instead of a traceback.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+BENCH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench.py")
+
+
+def _run(tmp_path, fail_count, retries):
+    fail_file = tmp_path / "failures"
+    fail_file.write_text(str(fail_count))
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "CILIUM_TPU_BENCH_FAIL_FILE": str(fail_file),
+        "CILIUM_TPU_BENCH_BACKOFF": "0",
+        "CILIUM_TPU_BENCH_RETRIES": str(retries),
+        "CILIUM_TPU_BENCH_PROBE_TIMEOUT": "120",
+    })
+    return subprocess.run(
+        [sys.executable, BENCH, "--config", "fqdn", "--rules", "4",
+         "--flows", "256", "--iters", "2", "--warmup", "1"],
+        capture_output=True, text=True, env=env, timeout=300)
+
+
+def test_recovers_from_transient_backend_failure(tmp_path):
+    r = _run(tmp_path, fail_count=1, retries=3)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["metric"].startswith("l7_verdicts_per_sec_fqdn")
+    assert rec["value"] > 0
+    # the injected failure actually happened (probe attempt #1 died,
+    # the outer announced a retry)
+    assert "backend attempt 2/" in r.stderr
+
+
+def test_total_backend_failure_emits_parseable_line(tmp_path):
+    r = _run(tmp_path, fail_count=99, retries=2)
+    assert r.returncode == 1
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1
+    rec = json.loads(lines[0])  # the driver's `parsed` must be non-null
+    assert rec["metric"] == "bench_failed_backend_fqdn"
+    assert rec["vs_baseline"] == 0.0
+    assert "unit" in rec and "value" in rec
