@@ -118,6 +118,80 @@ _DEFAULT_RULES = {"http": 1000, "fqdn": 10, "kafka": 1000,
                   "mixed": 0, "clustermesh": 0}
 
 
+def _bench_from_capture(args, cfg, engine, scenario, arrays, log):
+    """The north-star lane: file→verdict END-TO-END over a stored v2
+    Hubble capture (binary base records + L7 sidecar). Every timed
+    sample covers mapped-file read → vectorized featurize
+    (encode_l7_records: pure numpy gathers against the capture string
+    table) → device_put → verdict step; throughput windows dispatch
+    the whole file sequentially (host encode of chunk i+1 overlaps
+    device compute of chunk i) and sync once. Zero readbacks inside
+    timing (docs/PLATFORM.md)."""
+    import jax
+
+    from cilium_tpu.engine.verdict import CaptureReplay
+    from cilium_tpu.ingest import binary
+
+    cap = args.from_capture
+    if not os.path.exists(cap):
+        flows = scenario.flows
+        reps = -(-args.capture_flows // len(flows))
+        n = binary.write_capture_l7(cap, (flows * reps)[:args.capture_flows])
+        log(f"wrote v2 capture {cap}: {n} records")
+    rec_all = binary.map_capture(cap)
+    l7_all, offsets, blob = binary.read_l7_sidecar(cap)
+    # replay session: per-field string tables DFA-scanned ONCE on
+    # device (the pkg/fqdn/re regex-LRU analog, batch-computed); each
+    # chunk then costs one [B, 15] int32 row block host-side
+    replay = CaptureReplay(engine, l7_all, offsets, blob, cfg.engine)
+    bs = min(len(rec_all), args.flows if args.flows is not None
+             else _DEFAULT_FLOWS["http"])
+    nch = len(rec_all) // bs
+
+    def encode_chunk(c):
+        sl = slice(c * bs, (c + 1) * bs)
+        return {"rows": jax.device_put(
+            replay.feat.encode_rows(rec_all[sl], l7_all[sl]))}
+
+    def step(arrays_, batch):  # the capture-specialized step
+        return replay._step(arrays_, replay.table_words, batch)
+
+    jax.block_until_ready(step(arrays, encode_chunk(0)))  # compile/warm
+
+    # e2e latency: blocking file→verdict per chunk, enough samples
+    # that p99 is a real quantile (not a max-of-few)
+    n_lat = 200
+    lat = []
+    for i in range(n_lat):
+        t0 = time.perf_counter()
+        out = step(arrays, encode_chunk(i % nch))
+        jax.block_until_ready(out)
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+
+    # e2e throughput: sequential replay of the whole file per window,
+    # one sync per window; median of 5 (tunnel jitter, PLATFORM.md)
+    window_times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        outs = [step(arrays, encode_chunk(c)) for c in range(nch)]
+        jax.block_until_ready(outs)
+        window_times.append(time.perf_counter() - t0)
+    t = sorted(window_times)[len(window_times) // 2]
+    e2e_vps = nch * bs / t
+    log(f"e2e capture replay: {len(rec_all)} records (chunk={bs}), "
+        f"{e2e_vps:,.0f} verdicts/s file→device, "
+        f"p50={lat[len(lat) // 2] * 1e3:.2f}ms "
+        f"p99={lat[int(len(lat) * 0.99)] * 1e3:.2f}ms per chunk")
+    return {
+        "e2e_verdicts_per_sec": round(e2e_vps, 1),
+        "e2e_p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+        "e2e_p99_ms": round(lat[min(len(lat) - 1,
+                                    int(len(lat) * 0.99))] * 1e3, 3),
+        "capture_records": int(len(rec_all)),
+    }
+
+
 def run_config(config: str, args) -> dict:
     import jax
     import numpy as np
@@ -317,6 +391,17 @@ def run_config(config: str, args) -> dict:
             f"p99={p99_ms:.2f}ms ({n/med:,.0f}/s blocking); "
             f"pipelined verdicts/s={vps:,.0f}")
 
+    # e2e capture-replay lane (still zero readbacks: runs before the
+    # post-timing readback below, in the same clean process)
+    e2e = None
+    if getattr(args, "from_capture", None):
+        if config != "http":
+            return {"metric": "bench_failed_setup", "value": 0,
+                    "unit": "--from-capture is the http lane",
+                    "vs_baseline": 0.0}
+        e2e = _bench_from_capture(args, cfg, engine, scenario, arrays,
+                                  log)
+
     # ---- timing is over; readbacks are safe now -----------------------
     log(f"verdict mix: "
         f"{np.bincount(np.asarray(out['verdict']), minlength=6).tolist()}")
@@ -338,6 +423,21 @@ def run_config(config: str, args) -> dict:
     # the meaningful count there; mixed/clustermesh have real rule lists
     if streaming:
         n_rules = len(scenario.rules)
+    if e2e is not None:
+        # the north-star line: value = file→verdict e2e rate; the
+        # device-only rate rides alongside for comparison
+        return {
+            "metric": f"e2e_capture_replay_{config}_{n_rules}rules",
+            "value": e2e["e2e_verdicts_per_sec"],
+            "unit": "verdicts/s",
+            "vs_baseline": round(e2e["e2e_verdicts_per_sec"] / 10e6, 4),
+            "p50_ms": e2e["e2e_p50_ms"],
+            "p99_ms": e2e["e2e_p99_ms"],
+            "device_verdicts_per_sec": round(vps, 1),
+            "device_p50_ms": round(p50_ms, 3),
+            "device_p99_ms": round(p99_ms, 3),
+            "capture_records": e2e["capture_records"],
+        }
     return {
         "metric": f"l7_verdicts_per_sec_{config}_{n_rules}rules",
         "value": round(vps, 1),
@@ -360,6 +460,9 @@ def _inner_cmd(config: str, args) -> list:
         cmd += ["--flows", str(args.flows)]
     if args.check:
         cmd.append("--check")
+    if getattr(args, "from_capture", None) and config == "http":
+        cmd += ["--from-capture", args.from_capture,
+                "--capture-flows", str(args.capture_flows)]
     if args.verbose:
         cmd.append("--verbose")
     if args.profile:
@@ -453,6 +556,14 @@ def main() -> int:
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--check", action="store_true",
                     help="verify engine vs oracle on a sample (after timing)")
+    ap.add_argument("--from-capture", metavar="FILE", dest="from_capture",
+                    help="http config: ALSO time end-to-end file→verdict "
+                         "replay of a stored v2 binary capture (written "
+                         "from the synth scenario if FILE is absent) — "
+                         "the north star's 'replaying a Hubble capture'")
+    ap.add_argument("--capture-flows", type=int, default=200000,
+                    help="records to write when --from-capture creates "
+                         "the file (default 200000)")
     ap.add_argument("--profile", metavar="DIR",
                     help="capture a jax.profiler device trace of the "
                          "timed passes into DIR (open with Perfetto / "

@@ -189,9 +189,17 @@ def cmd_replay(args) -> int:
 
         for commit_index, chunk in chunks:
             if args.fast:
-                # columnar: records → verdicts, no Flow objects
-                out = engine.verdict_records(
-                    chunk, authed_pairs=AUTH_UNENFORCED)
+                # columnar: records → verdicts, no Flow objects; v2
+                # captures carry their L7 sidecar (gathered against
+                # the shared string table), v1 records are L3/L4-only
+                chunk, l7raw, offsets, blob = chunk
+                if l7raw is not None:
+                    out = engine.verdict_l7_records(
+                        chunk, l7raw, offsets, blob,
+                        authed_pairs=AUTH_UNENFORCED)
+                else:
+                    out = engine.verdict_records(
+                        chunk, authed_pairs=AUTH_UNENFORCED)
                 for v, c in zip(*np.unique(out["verdict"],
                                            return_counts=True)):
                     name = Verdict(int(v)).name
@@ -255,16 +263,34 @@ def cmd_capture(args) -> int:
 
     if args.capture_cmd == "info":
         n = binary.capture_count(args.file)
-        print(json.dumps({"records": n,
-                          "bytes": os.path.getsize(args.file)}))
+        info = {"records": n, "bytes": os.path.getsize(args.file),
+                "version": binary.capture_version(args.file)}
+        if info["version"] == binary.VERSION_L7:
+            n_strings, blob_bytes = binary.l7_info(args.file)  # O(1)
+            info["strings"] = n_strings
+            info["blob_bytes"] = blob_bytes
+        print(json.dumps(info))
         return 0
-    # convert JSONL → binary tuples; L7 payloads are not carried by the
-    # fixed-size record (as in the reference's ring events), so count
-    # what was flattened to its tuple form
+    # convert JSONL → binary. L7 payloads ride the v2 sidecar (string
+    # table + fixed L7 records) unless --l4-only asks for the compact
+    # v1 tuple form (the reference's ring-event shape), in which case
+    # count what was flattened
     flows = list(read_jsonl(args.input))
-    l7_flattened = sum(1 for f in flows if f.l7 != L7Type.NONE)
-    n = binary.write_capture(args.output, flows)
-    print(json.dumps({"records": n, "l7_payloads_dropped": l7_flattened}))
+    # generic l7proto payloads never fit the fixed L7 record — both
+    # versions flatten them to their L4 tuple (counted as dropped)
+    n_gen = sum(1 for f in flows if f.l7 == L7Type.GENERIC)
+    n_l7 = sum(1 for f in flows if f.l7 != L7Type.NONE) - n_gen
+    if n_l7 and not args.l4_only:
+        n = binary.write_capture_l7(args.output, flows)
+        out = {"records": n, "version": binary.VERSION_L7,
+               "l7_payloads": n_l7}
+        if n_gen:
+            out["l7_payloads_dropped"] = n_gen
+        print(json.dumps(out))
+    else:
+        n = binary.write_capture(args.output, flows)
+        print(json.dumps({"records": n, "version": binary.VERSION,
+                          "l7_payloads_dropped": n_l7 + n_gen}))
     return 0
 
 
@@ -582,9 +608,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     ci.add_argument("file")
     ci.set_defaults(fn=cmd_capture)
     cc = capsub.add_parser("convert",
-                           help="JSONL → binary tuple capture")
+                           help="JSONL → binary capture (v2 with L7 "
+                                "sidecar when payloads are present)")
     cc.add_argument("input")
     cc.add_argument("output")
+    cc.add_argument("--l4-only", action="store_true",
+                    help="write compact v1 tuple records, flattening "
+                         "L7 payloads (the ring-event shape)")
     cc.set_defaults(fn=cmd_capture)
 
     p = sub.add_parser("replay",

@@ -645,6 +645,426 @@ def encode_records(rec, cfg: Optional[EngineConfig] = None,
     )
 
 
+def _gather_table_field(blob: np.ndarray, offsets: np.ndarray,
+                        idx: np.ndarray, max_len: int,
+                        pad_multiple: int = 32,
+                        fixed_len: Optional[int] = None):
+    """Vectorized :func:`encode_strings` over a capture string table:
+    ``idx`` [B] references strings in (offsets, blob); returns the same
+    (data [B, L] u8, lengths, valid) triple — built entirely from numpy
+    gathers (unique → fill → scatter back), no per-flow Python.
+    ``fixed_len`` pins the padded width (chunked replay: every chunk
+    must produce identical shapes so the jitted step compiles once)."""
+    uniq, inv = np.unique(idx, return_inverse=True)
+    starts = offsets[uniq].astype(np.int64)
+    lens = offsets[uniq + 1].astype(np.int64) - starts
+    if fixed_len is not None:
+        L = fixed_len
+    else:
+        longest = int(lens.max()) if len(lens) else 1
+        L = min(max_len,
+                max(pad_multiple, -(-max(longest, 1) // pad_multiple)
+                    * pad_multiple))
+    valid_u = lens <= L
+    lens_u = np.minimum(lens, L)
+    pos = np.arange(L, dtype=np.int64)
+    gidx = starts[:, None] + pos[None, :]
+    mask = pos[None, :] < lens_u[:, None]
+    if blob.size:
+        data_u = np.where(mask, blob[np.minimum(gidx, blob.size - 1)], 0)
+    else:
+        data_u = np.zeros((len(uniq), L), dtype=np.uint8)
+    return (data_u.astype(np.uint8, copy=False)[inv],
+            lens_u.astype(np.int32)[inv], valid_u[inv])
+
+
+def _intern_lut(offsets: np.ndarray, blob: np.ndarray, idx: np.ndarray,
+                intern: Dict[str, int]) -> np.ndarray:
+    """Map string-table indices → engine intern ids (-2 = unknown),
+    resolving each UNIQUE string once."""
+    uniq, inv = np.unique(idx, return_inverse=True)
+    lut = np.full(len(uniq), -2, dtype=np.int32)
+    for j, u in enumerate(uniq):
+        s = blob[int(offsets[u]):int(offsets[u + 1])].tobytes()
+        lut[j] = intern.get(s.decode("utf-8", "replace"), -2)
+    return lut[inv]
+
+
+class CaptureFeaturizer:
+    """Chunked-replay featurizer over one v2 capture: pays the string
+    work ONCE per file, then each chunk is pure row gathers.
+
+    At construction, every string each field references is encoded
+    into a padded per-field table ([S_used, L] u8 + lengths + valid),
+    kafka strings resolve to engine intern ids, and a string-table →
+    row LUT is built per field. ``encode(rec, l7)`` then featurizes a
+    chunk with ~8 numpy row-gathers — this is what lets file→verdict
+    replay keep pace with the device (north star "replaying a Hubble
+    capture"; the reference's per-request parse has no analog of this
+    because its datapath consumes one packet at a time)."""
+
+    _FIELD_CAPS = (("path", "http_path_buckets"),
+                   ("method", "http_method_len"),
+                   ("host", "http_host_len"),
+                   ("headers", None),      # fixed 1024 cap
+                   ("qname", "dns_name_len"))
+
+    def __init__(self, l7, offsets, blob, interns: Dict[str, Dict],
+                 cfg: Optional[EngineConfig] = None):
+        cfg = cfg or EngineConfig()
+        self.cfg = cfg
+        self.interns = interns
+        self.fmax = int(interns.get("gen_fmax", 4))
+        self.widths = capture_field_widths(l7, offsets, cfg)
+        n_strings = len(offsets) - 1
+        self.tables: Dict[str, tuple] = {}
+        self.luts: Dict[str, np.ndarray] = {}
+        for field, _ in self._FIELD_CAPS:
+            used = np.unique(l7[field])
+            data, lens, valid = _gather_table_field(
+                blob, offsets, used, self.widths[field],
+                fixed_len=self.widths[field])
+            lut = np.zeros(n_strings, dtype=np.int32)
+            lut[used] = np.arange(len(used), dtype=np.int32)
+            self.tables[field] = (data, lens, valid)
+            self.luts[field] = lut
+        for col, key in (("kafka_client", "client_id"),
+                         ("kafka_topic", "topic")):
+            used = np.unique(l7[col])
+            ids = _intern_lut(offsets, blob, used, interns.get(key, {}))
+            lut = np.full(n_strings, -2, dtype=np.int32)
+            lut[used] = ids
+            self.luts[col] = lut
+
+    def _field(self, name: str, idx: np.ndarray):
+        data, lens, valid = self.tables[name]
+        rows = self.luts[name][idx]
+        return data[rows], lens[rows], valid[rows]
+
+    def encode_packed(self, rec, l7) -> Dict[str, np.ndarray]:
+        """Chunk → the packed device-batch dict DIRECTLY (what
+        :func:`flowbatch_to_host_dict` produces), skipping the
+        FlowBatch + stack round-trip: the int32 scalars block is
+        filled column-by-column in one preallocated array and the
+        constant gen_pairs block is cached — this is the replay hot
+        path."""
+        rec = np.asarray(rec)  # one contiguous copy off the memmap
+        B = len(rec)
+        col = {c: i for i, c in enumerate(_SCALAR_COLS)}
+        scal = np.empty((B, len(_SCALAR_COLS)), dtype=np.int32)
+        ingress = rec["direction"] == int(TrafficDirection.INGRESS)
+        scal[:, col["ep_ids"]] = np.where(
+            ingress, rec["dst_identity"], rec["src_identity"])
+        scal[:, col["peer_ids"]] = np.where(
+            ingress, rec["src_identity"], rec["dst_identity"])
+        scal[:, col["dports"]] = rec["dport"]
+        scal[:, col["protos"]] = rec["proto"]
+        scal[:, col["directions"]] = rec["direction"]
+        scal[:, col["l7_types"]] = rec["l7_type"]
+        scal[:, col["kafka_api_key"]] = l7["kafka_api_key"]
+        scal[:, col["kafka_api_version"]] = l7["kafka_api_version"]
+        scal[:, col["kafka_client"]] = \
+            self.luts["kafka_client"][l7["kafka_client"]]
+        scal[:, col["kafka_topic"]] = \
+            self.luts["kafka_topic"][l7["kafka_topic"]]
+        scal[:, col["gen_proto"]] = -2
+        out: Dict[str, np.ndarray] = {"scalars": scal}
+        for name, _ in self._FIELD_CAPS:
+            data, lens, valid = self.tables[name]
+            rows = self.luts[name][l7[name]]
+            out[f"{name}_data"] = data[rows]
+            scal[:, col[f"{name}_len"]] = lens[rows]
+            scal[:, col[f"{name}_valid"]] = valid[rows]
+        cached = getattr(self, "_gen_pairs_cache", None)
+        if cached is None or len(cached) < B:
+            cached = np.full((B, self.fmax), -2, dtype=np.int32)
+            self._gen_pairs_cache = cached
+        out["gen_pairs"] = cached[:B]
+        return out
+
+    def encode_rows(self, rec, l7) -> np.ndarray:
+        """Chunk → ONE [B, 15] int32 block for
+        :func:`verdict_step_capture`: per-flow scalars plus per-field
+        ROW indices into the staged table match-words — the string
+        bytes themselves never leave the string table (scanned once
+        per file on device). ~0.3ms per 10k flows."""
+        rec = np.asarray(rec)
+        B = len(rec)
+        out = np.empty((B, len(_ROW_COLS)), dtype=np.int32)
+        col = {c: i for i, c in enumerate(_ROW_COLS)}
+        ingress = rec["direction"] == int(TrafficDirection.INGRESS)
+        out[:, col["ep_ids"]] = np.where(
+            ingress, rec["dst_identity"], rec["src_identity"])
+        out[:, col["peer_ids"]] = np.where(
+            ingress, rec["src_identity"], rec["dst_identity"])
+        out[:, col["dports"]] = rec["dport"]
+        out[:, col["protos"]] = rec["proto"]
+        out[:, col["directions"]] = rec["direction"]
+        out[:, col["l7_types"]] = rec["l7_type"]
+        out[:, col["kafka_api_key"]] = l7["kafka_api_key"]
+        out[:, col["kafka_api_version"]] = l7["kafka_api_version"]
+        out[:, col["kafka_client"]] = \
+            self.luts["kafka_client"][l7["kafka_client"]]
+        out[:, col["kafka_topic"]] = \
+            self.luts["kafka_topic"][l7["kafka_topic"]]
+        for name, _ in self._FIELD_CAPS:
+            out[:, col[f"{name}_row"]] = self.luts[name][l7[name]]
+        return out
+
+    def encode(self, rec, l7) -> FlowBatch:
+        ingress = rec["direction"] == int(TrafficDirection.INGRESS)
+        ep = np.where(ingress, rec["dst_identity"],
+                      rec["src_identity"]).astype(np.int32)
+        peer = np.where(ingress, rec["src_identity"],
+                        rec["dst_identity"]).astype(np.int32)
+        B = len(rec)
+        return FlowBatch(
+            ep_ids=ep, peer_ids=peer,
+            dports=rec["dport"].astype(np.int32),
+            protos=rec["proto"].astype(np.int32),
+            directions=rec["direction"].astype(np.int32),
+            l7_types=rec["l7_type"].astype(np.int32),
+            path=self._field("path", l7["path"]),
+            method=self._field("method", l7["method"]),
+            host=self._field("host", l7["host"]),
+            headers=self._field("headers", l7["headers"]),
+            qname=self._field("qname", l7["qname"]),
+            kafka_api_key=l7["kafka_api_key"].astype(np.int32),
+            kafka_api_version=l7["kafka_api_version"].astype(np.int32),
+            kafka_client=self.luts["kafka_client"][l7["kafka_client"]],
+            kafka_topic=self.luts["kafka_topic"][l7["kafka_topic"]],
+            gen_proto=np.full(B, -2, dtype=np.int32),
+            gen_pairs=np.full((B, self.fmax), -2, dtype=np.int32),
+        )
+
+
+#: Column order of the [B, 15] "rows" block verdict_step_capture
+#: consumes (see CaptureFeaturizer.encode_rows).
+_ROW_COLS = (
+    "ep_ids", "peer_ids", "dports", "protos", "directions", "l7_types",
+    "kafka_api_key", "kafka_api_version", "kafka_client", "kafka_topic",
+    "path_row", "method_row", "host_row", "headers_row", "qname_row",
+)
+
+
+def stage_capture_tables(engine: "VerdictEngine",
+                         feat: CaptureFeaturizer) -> Dict[str, jax.Array]:
+    """Scan each per-field string table through its banked DFA ONCE and
+    keep the match words on device ([S_used, NW] per field, invalid
+    rows zeroed). The reference memoizes per-string regex results in an
+    LRU (``pkg/fqdn/re``); here the whole capture string table is the
+    cache, computed in one batched scan — per-chunk replay then only
+    GATHERS match words by row index (:func:`verdict_step_capture`),
+    so the DFA cost scales with UNIQUE strings, not flows."""
+    tw: Dict[str, jax.Array] = {}
+    for field, prefix in (("path", "path"), ("method", "method"),
+                          ("host", "host"), ("headers", "hdr"),
+                          ("qname", "dns")):
+        data, lens, valid = feat.tables[field]
+        a = engine._arrays
+        words = dfa_scan_banked(
+            a[f"{prefix}_trans"], a[f"{prefix}_byteclass"],
+            a[f"{prefix}_start"], a[f"{prefix}_accept"],
+            jax.device_put(data, engine.device),
+            jax.device_put(lens, engine.device))
+        flat = words.reshape(len(data), -1)
+        flat = jnp.where(jax.device_put(valid, engine.device)[:, None],
+                         flat, 0)
+        tw[field] = flat
+    return tw
+
+
+def verdict_step_capture(arrays: Dict[str, jax.Array],
+                         table_words: Dict[str, jax.Array],
+                         batch: Dict[str, jax.Array]
+                         ) -> Dict[str, jax.Array]:
+    """:func:`verdict_step` specialized for v2-capture replay: string
+    match words come from the staged per-file tables (gathered by row
+    index) instead of per-flow DFA scans. Generic ``l7proto`` records
+    don't ride v2 captures (their gen_proto is -2 by format), so the
+    generic-family term — which -2 can never satisfy — is dropped.
+    Everything else (precedence, families, auth, log lanes) matches
+    verdict_step exactly; tests pin bit-parity."""
+    rows = batch["rows"]
+    col = {c: i for i, c in enumerate(_ROW_COLS)}
+
+    def c(name):
+        return rows[:, col[name]]
+
+    ms = mapstate_lookup(
+        arrays["ms_key_w0"], arrays["ms_key_w1"], arrays["ms_key_w2"],
+        arrays["ms_deny"], arrays["ms_ruleset"],
+        arrays["ms_enf_ids"], arrays["ms_enf_flags"],
+        c("ep_ids"), c("peer_ids"), c("dports"),
+        c("protos"), c("directions"),
+        auth=arrays.get("ms_auth"),
+        port_plens=arrays.get("ms_plens"),
+    )
+    ruleset = jnp.clip(ms["ruleset"], 0,
+                       arrays["rs_http_mask"].shape[0] - 1)
+    l7t = c("l7_types")
+
+    path_w = table_words["path"][c("path_row")]
+    method_w = table_words["method"][c("method_row")]
+    host_w = table_words["host"][c("host_row")]
+    hdr_w = table_words["headers"][c("headers_row")]
+    rule_ok = (
+        _rule_bit(path_w, arrays["http_path_lane"])
+        & _rule_bit(method_w, arrays["http_method_lane"])
+        & _rule_bit(host_w, arrays["http_host_lane"])
+    )
+    hdr_lanes = arrays["http_header_lanes"]
+    hdr_ok = jax.vmap(lambda lanes: _rule_bit(hdr_w, lanes),
+                      in_axes=1, out_axes=2)(hdr_lanes)
+    rule_ok = rule_ok & jnp.all(hdr_ok, axis=2)
+    if "http_rule_dead" in arrays:
+        rule_ok = rule_ok & ~arrays["http_rule_dead"][None, :]
+
+    http_mask = arrays["rs_http_mask"][ruleset]
+    rule_words = _bools_to_words(rule_ok, http_mask.shape[1])
+    http_ok = (jnp.any((rule_words & http_mask) != 0, axis=1)
+               & (l7t == int(L7Type.HTTP)))
+
+    if "http_log_lanes" in arrays:
+        log_lanes = arrays["http_log_lanes"]
+        log_bits = jax.vmap(lambda lanes: _rule_bit(hdr_w, lanes),
+                            in_axes=1, out_axes=2)(log_lanes)
+        log_fail = jnp.any(~log_bits, axis=2)
+        r_idx = jnp.arange(rule_ok.shape[1])
+        in_set = ((http_mask[:, r_idx >> 5]
+                   >> (r_idx & 31).astype(jnp.uint32)) & 1).astype(bool)
+        l7_log_http = jnp.any(rule_ok & in_set & log_fail, axis=1) \
+            & http_ok
+    else:
+        l7_log_http = jnp.zeros_like(http_ok)
+
+    ak = jnp.clip(c("kafka_api_key"), 0, 31).astype(jnp.uint32)
+    am = arrays["kafka_apikey_mask"][None, :]
+    k_ok = (
+        ((am == 0) | ((am >> ak[:, None]) & jnp.uint32(1)).astype(bool))
+        & ((arrays["kafka_version"][None, :] < 0)
+           | (arrays["kafka_version"][None, :]
+              == c("kafka_api_version")[:, None]))
+        & ((arrays["kafka_client"][None, :] < 0)
+           | (arrays["kafka_client"][None, :]
+              == c("kafka_client")[:, None]))
+        & ((arrays["kafka_topic"][None, :] < 0)
+           | (arrays["kafka_topic"][None, :]
+              == c("kafka_topic")[:, None]))
+    )
+    kafka_mask = arrays["rs_kafka_mask"][ruleset]
+    k_words = _bools_to_words(k_ok, kafka_mask.shape[1])
+    kafka_ok = (jnp.any((k_words & kafka_mask) != 0, axis=1)
+                & (l7t == int(L7Type.KAFKA)))
+
+    dns_w = table_words["qname"][c("qname_row")]
+    d_ok = (_rule_bit(dns_w, arrays["dns_lane"])
+            & (arrays["dns_lane"] >= 0)[None, :])
+    dns_mask = arrays["rs_dns_mask"][ruleset]
+    d_words = _bools_to_words(d_ok, dns_mask.shape[1])
+    dns_ok = (jnp.any((d_words & dns_mask) != 0, axis=1)
+              & (l7t == int(L7Type.DNS)))
+
+    l7_ok = http_ok | kafka_ok | dns_ok
+
+    allowed = ms["allowed"] & (l7_ok | ~ms["redirect"])
+    auth_required = ms["auth_required"]
+    if "auth_pairs" in batch:
+        ingress = c("directions") == int(TrafficDirection.INGRESS)
+        src = jnp.where(ingress, c("peer_ids"), c("ep_ids"))
+        dst = jnp.where(ingress, c("ep_ids"), c("peer_ids"))
+        pairs = batch["auth_pairs"]
+        _, authed = lower_bound((pairs[:, 0], pairs[:, 1]), (src, dst))
+        allowed = allowed & (~auth_required | authed)
+    verdict = jnp.where(
+        allowed,
+        jnp.where(ms["redirect"], int(Verdict.REDIRECTED),
+                  int(Verdict.FORWARDED)),
+        int(Verdict.DROPPED),
+    ).astype(jnp.int32)
+    return {
+        "verdict": verdict,
+        "allowed": allowed,
+        "l3l4_allowed": ms["allowed"],
+        "redirect": ms["redirect"],
+        "l7_ok": l7_ok,
+        "l7_log": l7_log_http & allowed & ms["redirect"],
+        "match_spec": ms["match_spec"],
+        "ruleset": ms["ruleset"],
+        "auth_required": ms["auth_required"],
+    }
+
+
+def capture_field_widths(l7, offsets,
+                         cfg: Optional[EngineConfig] = None,
+                         pad_multiple: int = 32) -> Dict[str, int]:
+    """Per-field padded widths over a WHOLE capture — pass to
+    :func:`encode_l7_records` so every chunk of a chunked replay
+    encodes to identical shapes (one jit compile for the stream)."""
+    cfg = cfg or EngineConfig()
+    caps = {"path": max(cfg.http_path_buckets),
+            "method": cfg.http_method_len, "host": cfg.http_host_len,
+            "headers": 1024, "qname": cfg.dns_name_len}
+    widths = {}
+    for field, cap in caps.items():
+        idx = l7[field]
+        lens = (offsets[idx + 1].astype(np.int64)
+                - offsets[idx].astype(np.int64))
+        longest = int(lens.max()) if len(lens) else 1
+        widths[field] = min(
+            cap, max(pad_multiple,
+                     -(-max(longest, 1) // pad_multiple) * pad_multiple))
+    return widths
+
+
+def encode_l7_records(rec, l7, offsets, blob,
+                      interns: Dict[str, Dict],
+                      cfg: Optional[EngineConfig] = None,
+                      widths: Optional[Dict[str, int]] = None
+                      ) -> FlowBatch:
+    """Vectorized FlowBatch straight from a v2 binary capture
+    (``ingest/binary.py`` base records + L7 sidecar): string fields
+    gather from the capture's string table, kafka strings resolve to
+    engine intern ids via a unique-string LUT — no per-flow Python
+    objects between disk and device (VERDICT r2 item 2; north star
+    "replaying a Hubble capture"). Strings were normalized at capture
+    write time (see ``ingest.binary.flows_to_capture_l7``)."""
+    cfg = cfg or EngineConfig()
+    B = len(rec)
+    ingress = rec["direction"] == int(TrafficDirection.INGRESS)
+    ep = np.where(ingress, rec["dst_identity"],
+                  rec["src_identity"]).astype(np.int32)
+    peer = np.where(ingress, rec["src_identity"],
+                    rec["dst_identity"]).astype(np.int32)
+    fmax = int(interns.get("gen_fmax", 4))
+    w = widths or {}
+
+    def field(name: str, cap: int):
+        return _gather_table_field(blob, offsets, l7[name], cap,
+                                   fixed_len=w.get(name))
+
+    return FlowBatch(
+        ep_ids=ep, peer_ids=peer,
+        dports=rec["dport"].astype(np.int32),
+        protos=rec["proto"].astype(np.int32),
+        directions=rec["direction"].astype(np.int32),
+        l7_types=rec["l7_type"].astype(np.int32),
+        path=field("path", max(cfg.http_path_buckets)),
+        method=field("method", cfg.http_method_len),
+        host=field("host", cfg.http_host_len),
+        headers=field("headers", 1024),
+        qname=field("qname", cfg.dns_name_len),
+        kafka_api_key=l7["kafka_api_key"].astype(np.int32),
+        kafka_api_version=l7["kafka_api_version"].astype(np.int32),
+        kafka_client=_intern_lut(offsets, blob, l7["kafka_client"],
+                                 interns.get("client_id", {})),
+        kafka_topic=_intern_lut(offsets, blob, l7["kafka_topic"],
+                                interns.get("topic", {})),
+        gen_proto=np.full(B, -2, dtype=np.int32),
+        gen_pairs=np.full((B, fmax), -2, dtype=np.int32),
+    )
+
+
 #: Column order of the packed int32 "scalars" array. Packing the 21
 #: per-flow scalar/flag columns into ONE device argument (plus the five
 #: byte buckets and gen_pairs: 7 arrays total instead of 27) cuts
@@ -926,6 +1346,46 @@ class VerdictEngine:
         batch = flowbatch_to_device(fb, self.device)
         self._stage_auth(batch, authed_pairs)
         out = self.verdict_batch_arrays(batch)
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    def verdict_l7_records(self, rec, l7, offsets, blob,
+                           cfg: Optional[EngineConfig] = None,
+                           authed_pairs: Optional[np.ndarray] = None):
+        """Columnar fast path over a v2 capture (base records + L7
+        sidecar): full HTTP/Kafka/DNS verdicts, zero per-flow Python
+        (ingest/binary.py → encode_l7_records → device)."""
+        fb = encode_l7_records(rec, l7, offsets, blob,
+                               self.policy.kafka_interns, cfg)
+        batch = flowbatch_to_device(fb, self.device)
+        self._stage_auth(batch, authed_pairs)
+        out = self.verdict_batch_arrays(batch)
+        return {k: np.asarray(v) for k, v in out.items()}
+
+
+class CaptureReplay:
+    """Replay session over one v2 capture: string tables scanned once
+    on device (:func:`stage_capture_tables`), chunks verdicted via
+    :func:`verdict_step_capture` from [B, 15] row blocks. The
+    file→verdict hot path for the north star's capture replay."""
+
+    def __init__(self, engine: "VerdictEngine", l7, offsets, blob,
+                 cfg: Optional[EngineConfig] = None):
+        self.engine = engine
+        self.feat = CaptureFeaturizer(l7, offsets, blob,
+                                      engine.policy.kafka_interns, cfg)
+        self.table_words = stage_capture_tables(engine, self.feat)
+        self._step = jax.jit(verdict_step_capture)
+
+    def verdict_rows(self, rows: np.ndarray, authed_pairs=None
+                     ) -> Dict[str, jax.Array]:
+        batch = {"rows": jax.device_put(rows, self.engine.device)}
+        self.engine._stage_auth(batch, authed_pairs)
+        return self._step(self.engine._arrays, self.table_words, batch)
+
+    def verdict_chunk(self, rec, l7, authed_pairs=None
+                      ) -> Dict[str, np.ndarray]:
+        out = self.verdict_rows(self.feat.encode_rows(rec, l7),
+                                authed_pairs)
         return {k: np.asarray(v) for k, v in out.items()}
 
 

@@ -7,10 +7,17 @@ that split: L3/L4 flow tuples ride a packed 32-byte little-endian
 record (written/validated by the native codec,
 ``native/capture/capture.cpp`` → ``libcilium_capture.so``), and the
 Python side maps them STRAIGHT into a numpy structured array — no
-per-record parsing between disk and the engine's ``encode_flows``. L7
-payloads (paths/qnames/topics) are not carried — they aren't in the
-reference's ring events either (L7 arrives via the accesslog path);
-JSONL remains the capture format for L7 flows.
+per-record parsing between disk and the engine's ``encode_flows``.
+
+Version 2 adds an L7 SIDECAR (the accesslog-path analog, columnar):
+a shared string table (u32 offsets + one blob, string 0 = "") plus a
+fixed 32-byte L7 record per flow referencing it, carrying
+path/method/host/headers/qname/kafka fields. Strings are normalized at
+WRITE time (host lowercased, qname sanitized, headers canonically
+serialized) so replay featurizes with pure numpy gathers — zero
+per-flow Python (``engine.verdict.encode_l7_records``). Generic
+``l7proto`` records still ride JSONL (their open-ended field maps
+don't fit a fixed record).
 
 The native library is built on demand (``make -C native/capture``,
 same discipline as the proxylib shim); if the toolchain is missing, a
@@ -43,8 +50,11 @@ LIB_PATH = os.path.join(NATIVE_DIR, "libcilium_capture.so")
 
 MAGIC = b"CTCAP1\x00\x00"
 VERSION = 1
+VERSION_L7 = 2
 HEADER = np.dtype([("magic", "S8"), ("version", "<u4"),
                    ("count", "<u4")])
+L7HEADER = np.dtype([("n_strings", "<u4"), ("reserved", "<u4"),
+                     ("blob_bytes", "<u8")])
 
 #: numpy view of the C Record struct (keep in lockstep with
 #: native/capture/capture.cpp)
@@ -57,6 +67,17 @@ RECORD = np.dtype([
     ("reserved0", "<u4"), ("reserved1", "<u4"),
 ])
 assert RECORD.itemsize == 32
+
+#: numpy view of the C L7Record struct (v2 sidecar; keep in lockstep
+#: with native/capture/capture.cpp). Fields are indices into the
+#: capture's shared string table; index 0 is always the empty string.
+L7REC = np.dtype([
+    ("path", "<u4"), ("method", "<u4"), ("host", "<u4"),
+    ("headers", "<u4"), ("qname", "<u4"),
+    ("kafka_client", "<u4"), ("kafka_topic", "<u4"),
+    ("kafka_api_key", "<i2"), ("kafka_api_version", "<i2"),
+])
+assert L7REC.itemsize == 32
 
 _lib_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -94,6 +115,19 @@ def _native() -> Optional[ctypes.CDLL]:
                                         ctypes.c_void_p,
                                         ctypes.c_uint32,
                                         ctypes.c_uint32]
+        lib.ct_capture_write_l7.restype = ctypes.c_int
+        lib.ct_capture_write_l7.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_uint32, ctypes.c_void_p, ctypes.c_uint64]
+        lib.ct_capture_l7_info.restype = ctypes.c_int
+        lib.ct_capture_l7_info.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.ct_capture_read_l7.restype = ctypes.c_int
+        lib.ct_capture_read_l7.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -172,13 +206,24 @@ def capture_count(path: str) -> int:
         h = np.frombuffer(raw, dtype=HEADER)[0]
         if bytes(h["magic"]).ljust(8, b"\x00") != MAGIC:
             raise CaptureError("bad magic")
-        if int(h["version"]) != VERSION:
+        version, count = int(h["version"]), int(h["count"])
+        if version not in (VERSION, VERSION_L7):
             raise CaptureError("unsupported version")
+        want = HEADER.itemsize + count * RECORD.itemsize
+        if version == VERSION_L7:
+            fp.seek(want)
+            lraw = fp.read(L7HEADER.itemsize)
+            if len(lraw) < L7HEADER.itemsize:
+                raise CaptureError("truncated capture")
+            lh = np.frombuffer(lraw, dtype=L7HEADER)[0]
+            want += (L7HEADER.itemsize
+                     + (int(lh["n_strings"]) + 1) * 4
+                     + int(lh["blob_bytes"])
+                     + count * L7REC.itemsize)
         fp.seek(0, os.SEEK_END)
-        want = HEADER.itemsize + int(h["count"]) * RECORD.itemsize
         if fp.tell() != want:
             raise CaptureError("truncated capture")
-        return int(h["count"])
+        return count
 
 
 def read_records(path: str, start: int = 0,
@@ -209,9 +254,195 @@ def read_capture(path: str, start: int = 0,
 
 def map_capture(path: str):
     """Validate once, then expose the records as a read-only memmap —
-    the chunked-replay path: one open, no per-chunk revalidation."""
+    the chunked-replay path: one open, no per-chunk revalidation.
+    Works for both versions: base records immediately follow the
+    header either way."""
     total = capture_count(path)
     if total == 0:
         return np.zeros(0, dtype=RECORD)
     return np.memmap(path, dtype=RECORD, mode="r",
                      offset=HEADER.itemsize, shape=(total,))
+
+
+# -- v2: L7 sidecar --------------------------------------------------------
+
+def capture_version(path: str) -> int:
+    with open(path, "rb") as fp:
+        raw = fp.read(HEADER.itemsize)
+    if len(raw) < HEADER.itemsize:
+        raise CaptureError("truncated capture")
+    return int(np.frombuffer(raw, dtype=HEADER)[0]["version"])
+
+
+def flows_to_capture_l7(flows: Iterable[Flow]):
+    """Flows → (records, l7_records, offsets, blob): the v2 capture
+    sections. String normalization happens HERE, at write time (host
+    lowercased, qname sanitized, headers serialized canonically), so
+    the replay hot path does zero per-string transformation — the same
+    split the reference uses (accesslog entries arrive normalized from
+    Envoy; the ring consumer never re-parses)."""
+    from cilium_tpu.engine.verdict import serialize_headers
+    from cilium_tpu.policy.compiler import matchpattern
+
+    flows = list(flows)
+    strings: List[bytes] = [b""]
+    index: dict = {b"": 0}
+
+    def intern(b: bytes) -> int:
+        i = index.get(b)
+        if i is None:
+            i = index[b] = len(strings)
+            strings.append(b)
+        return i
+
+    rec = np.zeros(len(flows), dtype=RECORD)
+    l7 = np.zeros(len(flows), dtype=L7REC)
+    for i, f in enumerate(flows):
+        # generic l7proto payloads (open-ended field maps) don't fit
+        # the fixed L7 record — flatten to the L4 tuple (same invariant
+        # as v1's flows_to_records: an uncarriable payload must not
+        # re-verdict against EMPTY fields on replay)
+        l7t = L7Type.NONE if f.l7 == L7Type.GENERIC else f.l7
+        rec[i] = (f.src_identity, f.dst_identity, f.dport, f.sport,
+                  int(f.protocol), int(f.direction), int(l7t),
+                  int(f.verdict), f.time, 0, 0)
+        h = f.http
+        if h is not None:
+            l7[i]["path"] = intern(h.path.encode("utf-8"))
+            l7[i]["method"] = intern(h.method.encode("utf-8"))
+            l7[i]["host"] = intern(h.host.lower().encode("utf-8"))
+            l7[i]["headers"] = intern(serialize_headers(h.headers))
+        d = f.dns
+        if d is not None and d.query:
+            l7[i]["qname"] = intern(
+                matchpattern.sanitize_name(d.query).encode("utf-8"))
+        k = f.kafka
+        if k is not None:
+            l7[i]["kafka_client"] = intern(k.client_id.encode("utf-8"))
+            l7[i]["kafka_topic"] = intern(k.topic.encode("utf-8"))
+            l7[i]["kafka_api_key"] = k.api_key
+            l7[i]["kafka_api_version"] = k.api_version
+    lens = np.array([len(s) for s in strings], dtype=np.uint64)
+    total = int(lens.sum())
+    if total > 0xFFFFFFFF:
+        # u32 offsets cap the string table at 4 GiB; wrapping silently
+        # would gather garbage slices on replay
+        raise CaptureError(f"string table too large ({total} bytes)")
+    offsets = np.zeros(len(strings) + 1, dtype=np.uint32)
+    offsets[1:] = np.cumsum(lens)
+    blob = np.frombuffer(b"".join(strings), dtype=np.uint8)
+    return rec, l7, offsets, blob
+
+
+def write_capture_l7(path: str, flows: Iterable[Flow]) -> int:
+    """Write a version-2 capture (base records + L7 sidecar)."""
+    rec, l7, offsets, blob = flows_to_capture_l7(flows)
+    lib = _native()
+    if lib is not None:
+        _check(lib.ct_capture_write_l7(
+            path.encode(),
+            np.ascontiguousarray(rec).ctypes.data_as(ctypes.c_void_p),
+            len(rec),
+            np.ascontiguousarray(l7).ctypes.data_as(ctypes.c_void_p),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            len(offsets) - 1,
+            blob.ctypes.data_as(ctypes.c_void_p),
+            int(blob.size)))
+        return len(rec)
+    header = np.zeros(1, dtype=HEADER)
+    header[0] = (MAGIC, VERSION_L7, len(rec))
+    l7h = np.zeros(1, dtype=L7HEADER)
+    l7h[0] = (len(offsets) - 1, 0, int(blob.size))
+    with open(path, "wb") as fp:
+        fp.write(header.tobytes())
+        fp.write(rec.tobytes())
+        fp.write(l7h.tobytes())
+        fp.write(offsets.tobytes())
+        fp.write(blob.tobytes())
+        fp.write(l7.tobytes())
+    return len(rec)
+
+
+def l7_info(path: str):
+    """O(1) sidecar geometry: (n_strings, blob_bytes) from the 16-byte
+    L7Header ((0, 0) for a v1 capture) — the ct_capture_l7_info analog."""
+    total = capture_count(path)  # full-layout validation
+    if capture_version(path) != VERSION_L7:
+        return 0, 0
+    with open(path, "rb") as fp:
+        fp.seek(HEADER.itemsize + total * RECORD.itemsize)
+        lh = np.frombuffer(fp.read(L7HEADER.itemsize), dtype=L7HEADER)[0]
+    return int(lh["n_strings"]), int(lh["blob_bytes"])
+
+
+def read_l7_sidecar(path: str):
+    """(l7_records, offsets, blob) of a v2 capture — one sequential
+    read per section, no per-record parsing."""
+    total = capture_count(path)  # full-layout validation
+    if capture_version(path) != VERSION_L7:
+        raise CaptureError("capture has no L7 sidecar (v1)")
+    with open(path, "rb") as fp:
+        fp.seek(HEADER.itemsize + total * RECORD.itemsize)
+        lh = np.frombuffer(fp.read(L7HEADER.itemsize), dtype=L7HEADER)[0]
+        n_strings = int(lh["n_strings"])
+        blob_bytes = int(lh["blob_bytes"])
+        offsets = np.fromfile(fp, dtype="<u4", count=n_strings + 1)
+        blob = np.fromfile(fp, dtype=np.uint8, count=blob_bytes)
+        l7 = np.fromfile(fp, dtype=L7REC, count=total)
+    return l7, offsets, blob
+
+
+def _table_get(offsets: np.ndarray, blob: np.ndarray, idx: int) -> bytes:
+    return blob[int(offsets[idx]):int(offsets[idx + 1])].tobytes()
+
+
+def read_capture_flows_l7(path: str) -> List[Flow]:
+    """Object-path reconstruction of a v2 capture (tooling/tests; the
+    hot path is engine.verdict.encode_l7_records over the raw
+    sections)."""
+    rec = read_records(path)
+    l7, offsets, blob = read_l7_sidecar(path)
+    return records_to_flows_l7(rec, l7, offsets, blob)
+
+
+def records_to_flows_l7(rec: np.ndarray, l7: np.ndarray,
+                        offsets: np.ndarray, blob: np.ndarray
+                        ) -> List[Flow]:
+    from cilium_tpu.core.flow import DNSInfo, HTTPInfo, KafkaInfo
+
+    flows = []
+    for r, s in zip(rec, l7):
+        f = Flow(src_identity=int(r["src_identity"]),
+                 dst_identity=int(r["dst_identity"]),
+                 dport=int(r["dport"]), sport=int(r["sport"]),
+                 protocol=Protocol(int(r["proto"])),
+                 direction=TrafficDirection(int(r["direction"])),
+                 l7=L7Type(int(r["l7_type"])),
+                 verdict=Verdict(int(r["verdict"])),
+                 time=float(r["time"]))
+        if f.l7 == L7Type.HTTP:
+            hdr_block = _table_get(offsets, blob, int(s["headers"]))
+            headers = tuple(
+                tuple(line.split(":", 1))
+                for line in hdr_block.decode("utf-8").splitlines() if line)
+            f.http = HTTPInfo(
+                method=_table_get(offsets, blob,
+                                  int(s["method"])).decode("utf-8"),
+                path=_table_get(offsets, blob,
+                                int(s["path"])).decode("utf-8"),
+                host=_table_get(offsets, blob,
+                                int(s["host"])).decode("utf-8"),
+                headers=headers)
+        elif f.l7 == L7Type.DNS:
+            f.dns = DNSInfo(query=_table_get(
+                offsets, blob, int(s["qname"])).decode("utf-8"))
+        elif f.l7 == L7Type.KAFKA:
+            f.kafka = KafkaInfo(
+                api_key=int(s["kafka_api_key"]),
+                api_version=int(s["kafka_api_version"]),
+                client_id=_table_get(offsets, blob,
+                                     int(s["kafka_client"])).decode("utf-8"),
+                topic=_table_get(offsets, blob,
+                                 int(s["kafka_topic"])).decode("utf-8"))
+        flows.append(f)
+    return flows

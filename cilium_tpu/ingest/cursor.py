@@ -75,17 +75,37 @@ def replay_chunks(capture: str, chunk_size: int = 8192,
     if is_binary:
         # binary captures (ingest/binary.py): the cursor indexes
         # records — fixed-size, so no blank-line concerns; validated
-        # once and memmapped, so chunking costs one open total
-        from cilium_tpu.ingest.binary import map_capture, records_to_flows
+        # once and memmapped, so chunking costs one open total. A v2
+        # capture's L7 sidecar is loaded once; decode=True rebuilds
+        # Flow objects WITH payloads, decode=False yields
+        # (records, l7_records) so the columnar path can gather
+        # against the (whole-capture) string table.
+        from cilium_tpu.ingest.binary import (
+            VERSION_L7,
+            capture_version,
+            map_capture,
+            read_l7_sidecar,
+            records_to_flows,
+            records_to_flows_l7,
+        )
 
         records = map_capture(capture)
+        side = (read_l7_sidecar(capture)
+                if capture_version(capture) == VERSION_L7 else None)
         while index < len(records):
             take = chunk_size if limit is None else min(
                 chunk_size, limit - emitted)
             if take <= 0:
                 return
             raw = records[index:index + take]
-            chunk = records_to_flows(raw) if decode else raw
+            if side is not None:
+                l7, offsets, blob = side
+                l7raw = l7[index:index + len(raw)]
+                chunk = (records_to_flows_l7(raw, l7raw, offsets, blob)
+                         if decode else (raw, l7raw, offsets, blob))
+            else:
+                chunk = (records_to_flows(raw) if decode
+                         else (raw, None, None, None))
             yield index + len(raw), chunk
             index += len(raw)
             emitted += len(raw)

@@ -243,3 +243,13 @@ class OracleVerdictEngine:
 
         return self.verdict_flows(records_to_flows(rec),
                                   authed_pairs=authed_pairs)
+
+    def verdict_l7_records(self, rec, l7, offsets, blob,
+                           authed_pairs=None):
+        """Interface parity with VerdictEngine.verdict_l7_records (v2
+        captures; the oracle reconstructs Flow objects with payloads)."""
+        from cilium_tpu.ingest.binary import records_to_flows_l7
+
+        return self.verdict_flows(
+            records_to_flows_l7(rec, l7, offsets, blob),
+            authed_pairs=authed_pairs)

@@ -108,7 +108,7 @@ def test_cli_convert_info_replay(tmp_path, capsys):
     assert cli.main(["capture", "convert", str(jsonl),
                      str(bin_path)]) == 0
     out = json.loads(capsys.readouterr().out)
-    assert out == {"records": 8, "l7_payloads_dropped": 0}
+    assert out == {"records": 8, "version": 1, "l7_payloads_dropped": 0}
     assert cli.main(["capture", "info", str(bin_path)]) == 0
     assert json.loads(capsys.readouterr().out)["records"] == 8
 

@@ -1,0 +1,247 @@
+"""v2 binary captures (L7 sidecar): roundtrip, vectorized-encode
+parity, verdict parity vs the object path, validation.
+
+VERDICT r2 item 2 / north star "replaying a Hubble capture": the
+binary format now carries HTTP/Kafka/DNS payloads via a string table +
+fixed 32B L7 records, and featurization is pure numpy gathers
+(``engine.verdict.encode_l7_records``) — these tests pin that the
+zero-Python path verdicts bit-identically to the per-flow object path.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import (
+    DNSInfo,
+    Flow,
+    HTTPInfo,
+    KafkaInfo,
+    L7Type,
+    Protocol,
+    TrafficDirection,
+)
+from cilium_tpu.engine.verdict import (
+    encode_flows,
+    encode_l7_records,
+    flowbatch_to_host_dict,
+)
+from cilium_tpu.ingest import binary, synth
+from cilium_tpu.runtime.loader import Loader
+
+
+def l7_flows():
+    return [
+        Flow(src_identity=1001, dst_identity=2002, dport=80,
+             l7=L7Type.HTTP,
+             http=HTTPInfo(method="GET", path="/api/v1/items/7",
+                           host="SVC.Local",
+                           headers=(("X-Role", "admin"),
+                                    ("Accept", "json")))),
+        Flow(src_identity=1001, dst_identity=2002, dport=9092,
+             l7=L7Type.KAFKA,
+             kafka=KafkaInfo(api_key=0, api_version=3,
+                             client_id="producer-1", topic="orders")),
+        Flow(src_identity=1001, dst_identity=2002, dport=53,
+             protocol=Protocol.UDP, direction=TrafficDirection.EGRESS,
+             l7=L7Type.DNS, dns=DNSInfo(query="API.Example.COM.")),
+        Flow(src_identity=1001, dst_identity=2002, dport=443),
+    ]
+
+
+def test_v2_roundtrip_object_path(tmp_path):
+    path = str(tmp_path / "cap2.bin")
+    assert binary.write_capture_l7(path, l7_flows()) == 4
+    assert binary.capture_count(path) == 4
+    assert binary.capture_version(path) == binary.VERSION_L7
+    back = binary.read_capture_flows_l7(path)
+    assert back[0].http.path == "/api/v1/items/7"
+    assert back[0].http.host == "svc.local"          # write-time lowercase
+    assert dict(back[0].http.headers) == {"x-role": "admin",
+                                          "accept": "json"}
+    assert back[1].kafka.topic == "orders"
+    assert back[1].kafka.api_version == 3
+    # write-time sanitize (matchpattern.sanitize_name: lowercased,
+    # FQDN trailing dot preserved — same form encode_flows feeds the
+    # DNS automaton)
+    assert back[2].dns.query == "api.example.com."
+    assert back[3].l7 == L7Type.NONE
+
+
+def test_v2_generic_flows_flatten_to_l4(tmp_path):
+    """Generic l7proto payloads don't fit the fixed L7 record — a v2
+    capture must record them as their L4 tuple (same invariant as v1),
+    never as a GENERIC flow with empty fields that would re-verdict
+    differently."""
+    from cilium_tpu.core.flow import GenericL7Info
+
+    path = str(tmp_path / "gen.bin")
+    binary.write_capture_l7(path, [
+        Flow(src_identity=1, dst_identity=2, dport=6379,
+             l7=L7Type.GENERIC,
+             generic=GenericL7Info(proto="r2d2",
+                                   fields={"cmd": "GET"}))])
+    (back,) = binary.read_capture_flows_l7(path)
+    assert back.l7 == L7Type.NONE
+    assert back.generic is None
+
+
+def test_v2_native_and_numpy_writers_agree(tmp_path, monkeypatch):
+    if binary._native() is None:
+        pytest.skip("native toolchain unavailable")
+    native_path = tmp_path / "native.bin"
+    numpy_path = tmp_path / "numpy.bin"
+    binary.write_capture_l7(str(native_path), l7_flows())
+    monkeypatch.setattr(binary, "_lib", None)
+    monkeypatch.setattr(binary, "_lib_tried", True)
+    binary.write_capture_l7(str(numpy_path), l7_flows())
+    assert native_path.read_bytes() == numpy_path.read_bytes()
+    # and the fallback validates/reads the native-written file
+    assert binary.capture_count(str(native_path)) == 4
+    l7, offsets, blob = binary.read_l7_sidecar(str(native_path))
+    assert len(l7) == 4 and offsets[0] == 0
+    assert int(offsets[-1]) == blob.size
+
+
+def test_v2_validation(tmp_path):
+    path = tmp_path / "cap2.bin"
+    binary.write_capture_l7(str(path), l7_flows())
+    raw = path.read_bytes()
+    truncated = tmp_path / "trunc.bin"
+    truncated.write_bytes(raw[:-7])
+    with pytest.raises(binary.CaptureError):
+        binary.capture_count(str(truncated))
+    # a v1 capture has no sidecar to read
+    v1 = tmp_path / "v1.bin"
+    binary.write_capture(str(v1), l7_flows())
+    with pytest.raises(binary.CaptureError):
+        binary.read_l7_sidecar(str(v1))
+
+
+@pytest.mark.parametrize("which", ["http", "fqdn", "kafka"])
+def test_v2_verdict_parity_with_flows_path(tmp_path, which):
+    """The whole point: capture→gather→device verdicts == per-flow
+    object-path verdicts, for every L7 family the sidecar carries."""
+    if which == "http":
+        scenario = synth.synth_http_scenario(n_rules=25, n_flows=300)
+    elif which == "fqdn":
+        scenario = synth.synth_fqdn_scenario(n_names=20, n_rules=8,
+                                             n_flows=300)
+    else:
+        scenario = synth.synth_kafka_scenario(n_rules=15, n_records=300)
+    per_identity, scenario = synth.realize_scenario(scenario)
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    engine = Loader(cfg).regenerate(per_identity, revision=1)
+
+    path = str(tmp_path / "cap2.bin")
+    binary.write_capture_l7(path, scenario.flows)
+    rec = binary.map_capture(path)
+    l7, offsets, blob = binary.read_l7_sidecar(path)
+
+    via_capture = engine.verdict_l7_records(rec, l7, offsets, blob)
+    via_flows = engine.verdict_flows(scenario.flows)
+    np.testing.assert_array_equal(via_capture["verdict"],
+                                  via_flows["verdict"])
+    # flows must actually exercise both outcomes
+    assert len(set(via_flows["verdict"].tolist())) > 1
+
+
+def test_cli_v2_convert_info_fast_replay(tmp_path, capsys):
+    """CLI plumbing: JSONL with L7 payloads converts to a v2 capture,
+    `capture info` reports the sidecar, and --fast replay (columnar,
+    sidecar-gathering) agrees with the object path on the same file."""
+    import json
+
+    from cilium_tpu import cli
+    from cilium_tpu.ingest.hubble import flow_to_dict
+
+    jsonl = tmp_path / "cap.jsonl"
+    jsonl.write_text("\n".join(
+        json.dumps(flow_to_dict(f)) for f in l7_flows()) + "\n")
+    bin_path = tmp_path / "cap2.bin"
+    assert cli.main(["capture", "convert", str(jsonl),
+                     str(bin_path)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out == {"records": 4, "version": 2, "l7_payloads": 3}
+    assert cli.main(["capture", "info", str(bin_path)]) == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["version"] == 2 and info["strings"] > 1
+
+    cnp = tmp_path / "p.yaml"
+    cnp.write_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: t}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - toPorts: [{ports: [{port: "80", protocol: TCP}],
+               rules: {http: [{method: GET, path: "/api/.*"}]}}]
+""")
+    base = ["--policy", str(cnp), "--endpoint", "app=svc"]
+    assert cli.main(["replay", str(bin_path)] + base) == 0
+    slow = json.loads(capsys.readouterr().out)
+    assert cli.main(["replay", str(bin_path), "--fast"] + base) == 0
+    fast = json.loads(capsys.readouterr().out)
+    assert fast == slow
+    assert slow["flows"] == 4
+
+
+@pytest.mark.parametrize("which", ["http", "fqdn", "kafka"])
+def test_capture_replay_staged_tables_parity(tmp_path, which):
+    """The staged-table replay path (string tables DFA-scanned once on
+    device, chunks verdicted from row indices — verdict_step_capture)
+    must agree bit-for-bit with verdict_flows, including across chunk
+    boundaries."""
+    from cilium_tpu.engine.verdict import CaptureReplay
+
+    if which == "http":
+        scenario = synth.synth_http_scenario(n_rules=25, n_flows=300)
+    elif which == "fqdn":
+        scenario = synth.synth_fqdn_scenario(n_names=20, n_rules=8,
+                                             n_flows=300)
+    else:
+        scenario = synth.synth_kafka_scenario(n_rules=15, n_records=300)
+    per_identity, scenario = synth.realize_scenario(scenario)
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    engine = Loader(cfg).regenerate(per_identity, revision=1)
+
+    path = str(tmp_path / "cap2.bin")
+    binary.write_capture_l7(path, scenario.flows)
+    rec = binary.map_capture(path)
+    l7, offsets, blob = binary.read_l7_sidecar(path)
+
+    replay = CaptureReplay(engine, l7, offsets, blob, cfg.engine)
+    got = []
+    for s in range(0, len(rec), 100):  # three chunks
+        out = replay.verdict_chunk(rec[s:s + 100], l7[s:s + 100])
+        got.extend(out["verdict"].tolist())
+    want = engine.verdict_flows(scenario.flows)["verdict"]
+    np.testing.assert_array_equal(got, want)
+    assert len(set(want.tolist())) > 1
+
+
+def test_encode_l7_matches_encode_flows(tmp_path):
+    """Array-level parity: the vectorized gather featurizer produces
+    the SAME FlowBatch tensors as the per-flow encoder."""
+    scenario = synth.synth_http_scenario(n_rules=10, n_flows=120)
+    per_identity, scenario = synth.realize_scenario(scenario)
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    engine = Loader(cfg).regenerate(per_identity, revision=1)
+    interns = engine.policy.kafka_interns
+
+    path = str(tmp_path / "cap2.bin")
+    binary.write_capture_l7(path, scenario.flows)
+    rec = binary.map_capture(path)
+    l7, offsets, blob = binary.read_l7_sidecar(path)
+
+    a = flowbatch_to_host_dict(encode_flows(scenario.flows, interns,
+                                            cfg.engine))
+    b = flowbatch_to_host_dict(encode_l7_records(rec, l7, offsets, blob,
+                                                 interns, cfg.engine))
+    assert a.keys() == b.keys()
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
