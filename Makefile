@@ -3,7 +3,8 @@
 
 PY ?= python
 
-.PHONY: test shim determinism dryrun bench bench-all check
+.PHONY: test shim determinism dryrun bench bench-all bench-e2e \
+        bench-service check
 
 test:            ## full suite (CPU, virtual 8-device mesh via conftest)
 	$(PY) -m pytest tests/ -q
@@ -26,5 +27,11 @@ bench:           ## headline config on the attached accelerator
 
 bench-all:       ## every BASELINE config, one JSON line each
 	$(PY) bench.py --config all
+
+bench-e2e:       ## file→verdict replay of a stored v2 Hubble capture
+	$(PY) bench.py --config http --from-capture /tmp/ct_bench_capture.bin
+
+bench-service:   ## socket→MicroBatcher→engine tail latency sweep
+	$(PY) bench_service.py --shim --out SERVICE_LATENCY.json
 
 check: shim test determinism dryrun   ## the full CI gate

@@ -192,6 +192,82 @@ def _bench_from_capture(args, cfg, engine, scenario, arrays, log):
     }
 
 
+def _bench_regen(args, log) -> dict:
+    """Regeneration latency (VERDICT r2 item 5; reference:
+    ``cilium_policy_regeneration_time_stats_seconds`` + the distillery
+    benches): time-to-staged-revision for (a) a COLD 1k-rule compile,
+    (b) INCREMENTAL regenerations after ±1 rule (warm BankCache:
+    only banks whose pattern membership changed recompile), and (c) a
+    warm-restart restage from the on-disk artifact cache. The disk
+    cache is disabled for (a)/(b) so compiles are timed, not disk
+    hits."""
+    import tempfile
+
+    from cilium_tpu.core.config import Config
+    from cilium_tpu.ingest import synth
+    from cilium_tpu.runtime.loader import Loader
+
+    n_rules = args.rules if args.rules is not None else 1000
+
+    def build(n):
+        per_identity, _ = synth.realize_scenario(
+            synth.synth_http_scenario(n_rules=n, n_flows=8))
+        return per_identity
+
+    base = build(n_rules)
+    plus = build(n_rules + 1)   # one rule appended at the end
+
+    cfg = Config.from_env()
+    cfg.enable_tpu_offload = True
+    cfg.loader.enable_cache = False
+    loader = Loader(cfg)
+    t0 = time.perf_counter()
+    loader.regenerate(base, revision=1)
+    cold_s = time.perf_counter() - t0
+    log(f"cold compile+stage: {cold_s:.2f}s ({n_rules} rules)")
+
+    iters = max(6, args.iters)
+    h0, m0 = loader.bank_cache.hits, loader.bank_cache.misses
+    times = []
+    for i in range(iters):
+        per = plus if i % 2 == 0 else base
+        t0 = time.perf_counter()
+        loader.regenerate(per, revision=2 + i)
+        times.append(time.perf_counter() - t0)
+    hits = loader.bank_cache.hits - h0
+    misses = loader.bank_cache.misses - m0
+    times.sort()
+    p50 = times[len(times) // 2]
+    p99 = times[min(len(times) - 1, int(len(times) * 0.99))]
+    log(f"incremental regen: p50={p50 * 1e3:.1f}ms p99={p99 * 1e3:.1f}ms "
+        f"bank cache {hits}/{hits + misses} hits")
+
+    # warm-restart lane: a NEW loader (fresh process analog) restages
+    # the identical ruleset from the content-addressed artifact cache
+    cfg2 = Config.from_env()
+    cfg2.enable_tpu_offload = True
+    cfg2.loader.cache_dir = tempfile.mkdtemp(prefix="ct_regen_")
+    l2 = Loader(cfg2)
+    l2.regenerate(base, revision=1)          # populates the cache
+    l3 = Loader(cfg2)
+    t0 = time.perf_counter()
+    l3.regenerate(base, revision=1)          # artifact hit + restage
+    restage_s = time.perf_counter() - t0
+    log(f"artifact-cache restage: {restage_s * 1e3:.1f}ms")
+
+    return {
+        "metric": f"policy_regen_latency_{n_rules}rules",
+        "value": round(p50 * 1e3, 1),
+        "unit": "ms to staged revision (incremental, warm bank cache)",
+        "vs_baseline": 0.0,
+        "incr_p50_ms": round(p50 * 1e3, 1),
+        "incr_p99_ms": round(p99 * 1e3, 1),
+        "cold_ms": round(cold_s * 1e3, 1),
+        "bank_cache_hit_rate": round(hits / max(1, hits + misses), 4),
+        "artifact_restage_ms": round(restage_s * 1e3, 1),
+    }
+
+
 def run_config(config: str, args) -> dict:
     import jax
     import numpy as np
@@ -209,6 +285,9 @@ def run_config(config: str, args) -> dict:
     def log(msg: str) -> None:
         if args.verbose:
             print(msg, file=sys.stderr)
+
+    if config == "regen":
+        return _bench_regen(args, log)
 
     n_flows = args.flows if args.flows is not None else _DEFAULT_FLOWS[config]
     n_rules = (args.rules if args.rules is not None
@@ -330,13 +409,14 @@ def run_config(config: str, args) -> dict:
         # can shortcut repeat executions. Built from HOST numpy: a device
         # round trip here would poison the process (docs/PLATFORM.md).
         prng = np.random.default_rng(0)
-        # compile + warmup + latency iters; throughput windows stage
-        # their own copies one window at a time (below) so HBM holds at
-        # most iters extra copies, not 3*iters. ALL copies are distinct
-        # permutations so every timed call is first-use.
-        n_copies = args.warmup + args.iters + 1
+        # compile + warmup copies; latency and throughput passes stage
+        # their own copies one WINDOW at a time (≤ iters extra copies
+        # resident) so raising the sample count cannot balloon HBM.
+        # ALL copies are distinct permutations so every timed call is
+        # first-use.
+        n_lat = max(args.lat_iters, args.iters)
         batches = []
-        for _ in range(n_copies):
+        for _ in range(args.warmup + 1):
             perm = prng.permutation(fb.size)
             batches.append({k: jax.device_put(v[perm])
                             for k, v in host.items()})
@@ -347,17 +427,25 @@ def run_config(config: str, args) -> dict:
         for i in range(args.warmup):
             out = step(arrays, batches[1 + i])
         jax.block_until_ready(out)
+        del batches
 
         with maybe_trace():
-            # latency pass: block per call (median/worst per-batch
-            # latency)
+            # latency pass: block per call (per-batch latency; enough
+            # samples that p99 is a quantile, not the sample max),
+            # staged in windows of `iters` distinct copies
             times = []
-            for i in range(args.iters):
-                batch = batches[1 + args.warmup + i]
-                t0 = time.perf_counter()
-                out = step(arrays, batch)
-                jax.block_until_ready(out)
-                times.append(time.perf_counter() - t0)
+            while len(times) < n_lat:
+                wb = []
+                for _ in range(min(args.iters, n_lat - len(times))):
+                    perm = prng.permutation(fb.size)
+                    wb.append({k: jax.device_put(v[perm])
+                               for k, v in host.items()})
+                jax.block_until_ready(wb)
+                for batch in wb:
+                    t0 = time.perf_counter()
+                    out = step(arrays, batch)
+                    jax.block_until_ready(out)
+                    times.append(time.perf_counter() - t0)
             times.sort()
             med = times[len(times) // 2]
             n = len(scenario.flows)
@@ -453,6 +541,7 @@ def _inner_cmd(config: str, args) -> list:
     cmd = [sys.executable, os.path.abspath(__file__), "--inner",
            "--config", config,
            "--iters", str(args.iters),
+           "--lat-iters", str(args.lat_iters),
            "--warmup", str(args.warmup)]
     if args.rules is not None:
         cmd += ["--rules", str(args.rules)]
@@ -545,7 +634,7 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="http",
                     choices=["http", "fqdn", "kafka", "mixed",
-                             "clustermesh", "all"])
+                             "clustermesh", "regen", "all"])
     ap.add_argument("--rules", type=int, default=None,
                     help="rule count (default: per-config BASELINE shape)")
     ap.add_argument("--flows", type=int, default=None,
@@ -553,6 +642,9 @@ def main() -> int:
                          "shape: http/fqdn 10k, kafka 100k, mixed 1M, "
                          "clustermesh 100k)")
     ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--lat-iters", type=int, default=100, dest="lat_iters",
+                    help="blocking latency samples for the p50/p99 pass "
+                         "(non-streaming configs)")
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--check", action="store_true",
                     help="verify engine vs oracle on a sample (after timing)")
@@ -597,7 +689,7 @@ def main() -> int:
     # process that has done post-timing readbacks is permanently in
     # the tunnel's ~64ms sync mode — docs/PLATFORM.md), with probe +
     # bounded retry around every attempt
-    configs = (("http", "fqdn", "kafka", "mixed", "clustermesh")
+    configs = (("http", "fqdn", "kafka", "mixed", "clustermesh", "regen")
                if args.config == "all" else (args.config,))
     rc = 0
     backend_dead = False

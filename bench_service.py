@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""Service-level tail-latency benchmark: Unix socket → MicroBatcher →
+engine, under concurrent closed-loop load.
+
+VERDICT r2 item 3 / SURVEY.md §7 hard part #5: the micro-batcher
+trades p99 latency for MXU utilization — this measures that trade
+honestly. Per deadline setting (default 0.5/2/8 ms), N client threads
+each run a closed loop of single-record ``check`` requests over the
+verdict service's Unix socket (4B-length-prefixed JSON — the same
+protocol the C++ shim speaks); every sample is CLIENT-OBSERVED wall
+time (socket + JSON + queueing + batcher deadline + engine). ≥200
+samples per point so p99 is a real quantile, not a max.
+
+``--shim`` adds a lane driving the C++ shim
+(shim/libcilium_shim.so → cshim_on_data with Kafka produce records)
+so the native client path is on record too.
+
+Prints one JSON line per sweep point and writes the full sweep to
+``--out`` (SERVICE_LATENCY artifact).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+
+def build_engine(n_rules: int):
+    from cilium_tpu.core.config import Config
+    from cilium_tpu.ingest import synth
+    from cilium_tpu.runtime.loader import Loader
+
+    scenario = synth.synth_http_scenario(n_rules=n_rules, n_flows=2000)
+    per_identity, scenario = synth.realize_scenario(scenario)
+    cfg = Config.from_env()
+    cfg.enable_tpu_offload = True
+    loader = Loader(cfg)
+    loader.regenerate(per_identity, revision=1)
+    return loader, scenario
+
+
+def run_point(loader, scenario, deadline_ms: float, batch_max: int,
+              threads: int, per_thread: int, warmup: int,
+              sock_dir: str) -> dict:
+    from cilium_tpu.ingest.hubble import flow_to_dict
+    from cilium_tpu.runtime.metrics import METRICS
+    from cilium_tpu.runtime.service import VerdictClient, VerdictService
+
+    sock = os.path.join(sock_dir, f"svc_{deadline_ms}.sock")
+    service = VerdictService(loader, sock, batch_max=batch_max,
+                             deadline_ms=deadline_ms)
+    service.start()
+    # pre-warm every pow2 batch shape the padded flush can produce —
+    # an XLA compile inside the timed window would report compiler
+    # latency, not service latency
+    size = 1
+    while size <= batch_max:
+        service.bridge._verdicts(scenario.flows[:size])
+        size *= 2
+    # distinct request templates per thread, pre-serialized
+    reqs = [{"op": "check", "flow": flow_to_dict(f)}
+            for f in scenario.flows[:threads * 64]]
+    hist_key = ("cilium_tpu_microbatch_size", ())
+    n_batches_before = len(METRICS._histos.get(hist_key, ()))
+
+    lat_lock = threading.Lock()
+    latencies: list = []
+    errors = [0]
+    start_barrier = threading.Barrier(threads + 1)
+    done_barrier = threading.Barrier(threads + 1)
+
+    def worker(tid: int):
+        # EVERY exit path must pass both barriers or main blocks
+        # forever waiting for threads+1 parties
+        client = None
+        mine = reqs[tid::threads] or reqs
+        try:
+            client = VerdictClient(sock)
+            for i in range(warmup):
+                client.call(mine[i % len(mine)])
+        except Exception:
+            with lat_lock:
+                errors[0] += 1
+            client = None
+        start_barrier.wait()
+        out = []
+        try:
+            if client is not None:
+                for i in range(per_thread):
+                    t0 = time.perf_counter()
+                    resp = client.call(mine[i % len(mine)])
+                    dt = time.perf_counter() - t0
+                    if "verdict" not in resp:
+                        with lat_lock:
+                            errors[0] += 1
+                    out.append(dt)
+        except Exception:
+            with lat_lock:
+                errors[0] += 1
+        with lat_lock:
+            latencies.extend(out)
+        done_barrier.wait()
+        if client is not None:
+            client.close()
+
+    workers = [threading.Thread(target=worker, args=(t,), daemon=True)
+               for t in range(threads)]
+    for w in workers:
+        w.start()
+    start_barrier.wait()
+    t_wall0 = time.perf_counter()
+    done_barrier.wait()
+    t_wall = time.perf_counter() - t_wall0
+    for w in workers:
+        w.join(timeout=30)
+    service.stop()
+
+    sizes = METRICS._histos.get(hist_key, ())[n_batches_before:]
+    latencies.sort()
+    n = len(latencies)
+    if n == 0:  # every worker failed before timing anything
+        return {"deadline_ms": deadline_ms, "batch_max": batch_max,
+                "threads": threads, "samples": 0, "errors": errors[0],
+                "throughput_rps": 0.0, "p50_ms": 0.0, "p95_ms": 0.0,
+                "p99_ms": 0.0, "max_ms": 0.0, "mean_batch_size": 0}
+
+    def q(p: float) -> float:
+        return latencies[min(n - 1, int(n * p))] * 1e3
+
+    return {
+        "deadline_ms": deadline_ms,
+        "batch_max": batch_max,
+        "threads": threads,
+        "samples": n,
+        "errors": errors[0],
+        "throughput_rps": round(n / t_wall, 1),
+        "p50_ms": round(q(0.50), 3),
+        "p95_ms": round(q(0.95), 3),
+        "p99_ms": round(q(0.99), 3),
+        "max_ms": round(latencies[-1] * 1e3, 3),
+        "mean_batch_size": round(sum(sizes) / len(sizes), 1) if sizes
+        else 0,
+    }
+
+
+def run_shim_point(loader, deadline_ms: float, batch_max: int,
+                   per_thread: int, threads: int, sock_dir: str):
+    """Kafka produce records through the C++ shim (native client path):
+    cshim_on_data → socket → parser → MicroBatcher → engine."""
+    import ctypes
+    import subprocess
+
+    from cilium_tpu.runtime.service import VerdictService
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    lib_path = os.path.join(repo, "shim", "libcilium_shim.so")
+    if not os.path.exists(lib_path):
+        try:
+            subprocess.run(["make", "-C", os.path.join(repo, "shim")],
+                           check=True, capture_output=True)
+        except (OSError, subprocess.CalledProcessError):
+            return None
+    lib = ctypes.CDLL(lib_path)
+    lib.cshim_connect.argtypes = [ctypes.c_char_p]
+    lib.cshim_on_new_connection.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_uint32,
+        ctypes.c_uint32, ctypes.c_uint32, ctypes.c_char_p]
+    lib.cshim_on_data.argtypes = [
+        ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+
+    from cilium_tpu.proxylib.kafka import encode_request
+
+    sock = os.path.join(sock_dir, "svc_shim.sock")
+    service = VerdictService(loader, sock, batch_max=batch_max,
+                             deadline_ms=deadline_ms)
+    service.start()
+    try:
+        if lib.cshim_connect(sock.encode()) != 0:
+            return None
+        # latency is what this lane measures — the record parses and
+        # verdicts regardless of whether the synth policy allows it
+        payload = encode_request(0, 1, 7, "bench", "synth-topic")
+        buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+        ops = (ctypes.c_int32 * 16)()
+        lib.cshim_on_new_connection(b"kafka", 1, 1, 1001, 1002, 9092,
+                                    b"")
+        lat = []
+        for i in range(per_thread):
+            t0 = time.perf_counter()
+            lib.cshim_on_data(1, 0, 0, buf, len(payload), ops, 8)
+            lat.append(time.perf_counter() - t0)
+        lat.sort()
+        n = len(lat)
+        return {
+            "lane": "cpp_shim_kafka", "deadline_ms": deadline_ms,
+            "samples": n,
+            "p50_ms": round(lat[n // 2] * 1e3, 3),
+            "p99_ms": round(lat[min(n - 1, int(n * 0.99))] * 1e3, 3),
+        }
+    finally:
+        try:
+            lib.cshim_disconnect()
+        except Exception:
+            pass
+        service.stop()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rules", type=int, default=1000)
+    ap.add_argument("--deadlines", default="0.5,2,8",
+                    help="comma-separated MicroBatcher deadlines (ms)")
+    ap.add_argument("--threads", type=int, default=16)
+    ap.add_argument("--per-thread", type=int, default=50,
+                    help="timed requests per thread (total = threads x "
+                         "this; keep >= 200 total for a real p99)")
+    ap.add_argument("--warmup", type=int, default=10)
+    ap.add_argument("--batch-max", type=int, default=256)
+    ap.add_argument("--shim", action="store_true",
+                    help="add the C++-shim kafka lane")
+    ap.add_argument("--out", default=None,
+                    help="write the full sweep JSON here")
+    args = ap.parse_args()
+
+    # honor JAX_PLATFORMS even with a PJRT plugin site on the path
+    # (env alone does not always win — same guard as bench.py)
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    import tempfile
+
+    loader, scenario = build_engine(args.rules)
+    sock_dir = tempfile.mkdtemp(prefix="ct_svcbench_")
+    points = []
+    for d in (float(x) for x in args.deadlines.split(",")):
+        pt = run_point(loader, scenario, d, args.batch_max,
+                       args.threads, args.per_thread, args.warmup,
+                       sock_dir)
+        points.append(pt)
+        print(json.dumps({
+            "metric": f"service_check_latency_d{d}ms_{args.rules}rules",
+            "value": pt["p99_ms"], "unit": "ms p99 (client-observed)",
+            "vs_baseline": 0.0, **pt}), flush=True)
+    if args.shim:
+        pt = run_shim_point(loader, 2.0, args.batch_max,
+                            max(200, args.per_thread), 1, sock_dir)
+        if pt is not None:
+            points.append(pt)
+            print(json.dumps({
+                "metric": "service_shim_kafka_latency_d2.0ms",
+                "value": pt["p99_ms"], "unit": "ms p99",
+                "vs_baseline": 0.0, **pt}), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"rules": args.rules, "points": points}, f,
+                      indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

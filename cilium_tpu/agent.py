@@ -114,10 +114,11 @@ class Agent:
         # Operator (cluster-pool IPAM) and other agents in-process.
         self.publisher = LocalStatePublisher(
             self.kvstore, self.config.cluster_name, self.allocator,
-            self.ipcache)
+            self.ipcache, services=self.services)
         self.clustermesh = ClusterMesh(
             self.allocator, self.ipcache, self.selector_cache,
-            on_change=lambda: self.endpoint_manager.regenerate_all())
+            on_change=lambda: self.endpoint_manager.regenerate_all(),
+            services=self.services)
         # observability (§2.5): monitor event fan-out + hubble observer
         self.monitor = MonitorAgent()
         self.observer = Observer(handlers=[FlowMetrics()])
@@ -455,18 +456,29 @@ class Agent:
 
     # -- endpoint API -----------------------------------------------------
     def endpoint_add(self, endpoint_id: int, labels: Dict[str, str],
-                     ipv4: str = "", named_ports=None):
+                     ipv4: str = "", named_ports=None,
+                     host: bool = False):
         # write_lock (reentrant — API handlers already hold it): the
         # allocate-then-register sequence must not interleave with a
         # cluster-pool allocator swap (_on_pod_cidr_change), which
         # adopts only already-registered endpoints' addresses
         with self.write_lock:
             return self._endpoint_add_locked(endpoint_id, labels, ipv4,
-                                             named_ports=named_ports)
+                                             named_ports=named_ports,
+                                             host=host)
+
+    def host_endpoint_add(self, labels: Dict[str, str],
+                          ipv4: str = "", endpoint_id: int = 0):
+        """Register THIS node's host endpoint: node labels +
+        ``reserved:host`` → fixed identity 1, subject to CCNP
+        nodeSelector policies only (reference: the host endpoint +
+        host firewall)."""
+        return self.endpoint_add(endpoint_id, labels, ipv4=ipv4,
+                                 host=True)
 
     def _endpoint_add_locked(self, endpoint_id: int,
                              labels: Dict[str, str], ipv4: str = "",
-                             named_ports=None):
+                             named_ports=None, host: bool = False):
         old = self.endpoint_manager.get(endpoint_id)
         if old is not None and old.ipv4 and not ipv4:
             ipv4 = old.ipv4  # re-add (CNI ADD retry) keeps the IP
@@ -491,8 +503,15 @@ class Agent:
             if old is not None and old.ipv4:
                 self.ipcache.delete(f"{old.ipv4}/32")
                 self.ipam.release(old.ipv4)
+        label_set = LabelSet.from_dict(labels)
+        if host:
+            from cilium_tpu.core.labels import SOURCE_RESERVED, Label
+
+            label_set = LabelSet(
+                list(label_set) + [Label(key="host", value="",
+                                         source=SOURCE_RESERVED)])
         ep = self.endpoint_manager.add_endpoint(
-            endpoint_id, LabelSet.from_dict(labels), ipv4=ipv4,
+            endpoint_id, label_set, ipv4=ipv4,
             named_ports=named_ports)
         self.ipcache.upsert(f"{ipv4}/32", ep.identity)
         return ep

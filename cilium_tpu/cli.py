@@ -191,12 +191,14 @@ def cmd_replay(args) -> int:
             if args.fast:
                 # columnar: records → verdicts, no Flow objects; v2
                 # captures carry their L7 sidecar (gathered against
-                # the shared string table), v1 records are L3/L4-only
-                chunk, l7raw, offsets, blob = chunk
+                # the shared string table) + whole-capture widths so
+                # the jitted step compiles once; v1 records are
+                # L3/L4-only
+                chunk, l7raw, offsets, blob, widths = chunk
                 if l7raw is not None:
                     out = engine.verdict_l7_records(
                         chunk, l7raw, offsets, blob,
-                        authed_pairs=AUTH_UNENFORCED)
+                        authed_pairs=AUTH_UNENFORCED, widths=widths)
                 else:
                     out = engine.verdict_records(
                         chunk, authed_pairs=AUTH_UNENFORCED)

@@ -36,6 +36,7 @@ from cilium_tpu.runtime.metrics import METRICS
 IP_PREFIX = "cilium/state/ip/v1/default/"
 IDENTITY_PREFIX = "cilium/state/identities/v1/id/"
 NODES_PREFIX = "cilium/state/nodes/v1/"
+SERVICES_PREFIX = "cilium/state/services/v1/"
 
 #: Label key marking which cluster an identity/IP came from
 #: (reference's ``io.cilium.k8s.policy.cluster``; the namespaced key
@@ -63,12 +64,17 @@ class LocalStatePublisher:
 
     def __init__(self, store: KVStore, cluster_name: str,
                  allocator: IdentityAllocator, ipcache,
-                 lease_ttl: float = 60.0) -> None:
+                 lease_ttl: float = 60.0, services=None) -> None:
         self.store = store
         self.cluster_name = cluster_name
         self._allocator = allocator
         self._lease = store.lease(lease_ttl)
         self._ipcache = ipcache
+        #: optional ServiceManager — SHARED services are exported for
+        #: peers' global-service merge (reference: the clustermesh
+        #: apiserver exports services annotated service.cilium.io/global)
+        self._services = services
+        self._published_services: Dict[str, str] = {}  # key → value
         ipcache.subscribe(self._on_ipcache)
 
     def _key(self, prefix: str) -> str:
@@ -94,8 +100,41 @@ class LocalStatePublisher:
                         "cluster": self.cluster_name}),
             lease=self._lease)
 
+    def publish_services(self) -> None:
+        """Export SHARED services (+ their active local backends) under
+        the services prefix; un-shared/deleted ones are withdrawn.
+        Reconcile-style (called from heartbeat): eventual consistency
+        under a lease, like the rest of the published state."""
+        if self._services is None:
+            return
+        current: Dict[str, str] = {}
+        for svc in self._services.list():
+            if not svc.shared:
+                continue
+            key = (f"{SERVICES_PREFIX}{self.cluster_name}/"
+                   f"{svc.namespace}/{svc.name}")
+            current[key] = json.dumps({
+                "cluster": self.cluster_name,
+                "namespace": svc.namespace,
+                "name": svc.name,
+                "shared": True,
+                "backends": [{"ip": b.ip, "port": b.port,
+                              "weight": b.weight}
+                             for b in svc.active_backends()],
+            }, sort_keys=True)
+            # re-setting an unchanged value every heartbeat would emit
+            # MODIFY to every watching peer → full policy regeneration
+            # mesh-wide every 15s; only publish real changes (the
+            # lease keepalive keeps unchanged keys alive)
+            if self._published_services.get(key) != current[key]:
+                self.store.set(key, current[key], lease=self._lease)
+        for key in self._published_services.keys() - current.keys():
+            self.store.delete(key)
+        self._published_services = current
+
     def heartbeat(self) -> None:
         self._lease.keepalive()
+        self.publish_services()
         self.store.expire_leases()
 
 
@@ -110,23 +149,32 @@ class RemoteCluster:
 
     def __init__(self, name: str, store: KVStore,
                  allocator: IdentityAllocator, ipcache,
-                 selector_cache=None) -> None:
+                 selector_cache=None, services=None) -> None:
         self.name = name
         self.store = store
         self._allocator = allocator
         self._ipcache = ipcache
         self._selector_cache = selector_cache
+        #: optional ServiceManager: remote GLOBAL services feed its
+        #: clustermesh overlay (pkg/clustermesh services sync)
+        self._services = services
         self._lock = threading.Lock()
         # remote key → (local prefix, local nid); nid refcounted so the
         # selector cache drops a remote identity when its last IP goes
         self._prefixes: Dict[str, tuple] = {}
         self._nid_refs: Dict[NumericIdentity, int] = {}
+        #: remote service key → (namespace, name) for delete events
+        self._service_keys: Dict[str, tuple] = {}
         self._watch: Optional[Watch] = None
+        self._svc_watch: Optional[Watch] = None
         self.ready = False
 
     def connect(self) -> "RemoteCluster":
         self._watch = self.store.watch_prefix(IP_PREFIX, self._on_event,
                                               replay=True)
+        if self._services is not None:
+            self._svc_watch = self.store.watch_prefix(
+                SERVICES_PREFIX, self._on_service_event, replay=True)
         self.ready = True
         METRICS.set_gauge("cilium_tpu_clustermesh_ready", 1.0,
                           labels={"cluster": self.name})
@@ -136,20 +184,64 @@ class RemoteCluster:
         if self._watch is not None:
             self._watch.stop()
             self._watch = None
+        if self._svc_watch is not None:
+            self._svc_watch.stop()
+            self._svc_watch = None
         with self._lock:
             entries = list(self._prefixes.values())
             nids = list(self._nid_refs)
             self._prefixes.clear()
             self._nid_refs.clear()
+            self._service_keys.clear()
         for prefix, _ in entries:
             self._ipcache.delete(prefix)
         for nid in nids:
             self._release_identity(nid)
+        if self._services is not None:
+            self._services.remove_remote_cluster(self.name)
         self.ready = False
         METRICS.set_gauge("cilium_tpu_clustermesh_ready", 0.0,
                           labels={"cluster": self.name})
 
+    def _on_service_event(self, ev: Event) -> None:
+        from cilium_tpu.loadbalancer.service import Backend
+
+        if ev.typ == EVENT_DELETE:
+            with self._lock:
+                ns_name = self._service_keys.pop(ev.key, None)
+            if ns_name is not None:
+                self._services.set_remote_backends(
+                    self.name, ns_name[0], ns_name[1], [])
+            return
+        try:
+            entry = json.loads(ev.value)
+            namespace = entry["namespace"]
+            name = entry["name"]
+            backends = [Backend(ip=b["ip"], port=int(b["port"]),
+                                weight=int(b.get("weight", 1)))
+                        for b in entry.get("backends", ())]
+        except (ValueError, KeyError, TypeError):
+            METRICS.inc("cilium_tpu_clustermesh_decode_errors_total",
+                        labels={"cluster": self.name})
+            return
+        # accept only the watched cluster's own announcements: in a
+        # shared-store topology another cluster's keys would otherwise
+        # be double-ingested under the wrong cluster tag
+        if entry.get("cluster") not in (None, self.name):
+            return
+        with self._lock:
+            self._service_keys[ev.key] = (namespace, name)
+        self._services.set_remote_backends(self.name, namespace, name,
+                                           backends)
+
     def _release_identity(self, nid: NumericIdentity) -> None:
+        from cilium_tpu.core.identity import IDENTITY_USER_MIN
+
+        # a remote cluster's host maps to the reserved REMOTE_NODE
+        # identity (core.identity allocate) — reserved registrations
+        # are process invariants this refcount must never tear down
+        if nid < IDENTITY_USER_MIN:
+            return
         if self._selector_cache is not None:
             self._selector_cache.remove_identity(nid)
         self._allocator.release(nid)
@@ -218,11 +310,13 @@ class ClusterMesh:
 
     def __init__(self, allocator: IdentityAllocator, ipcache,
                  selector_cache=None,
-                 on_change: Optional[Callable[[], None]] = None) -> None:
+                 on_change: Optional[Callable[[], None]] = None,
+                 services=None) -> None:
         self._allocator = allocator
         self._ipcache = ipcache
         self._selector_cache = selector_cache
         self._on_change = on_change
+        self._services = services
         self._clusters: Dict[str, RemoteCluster] = {}
 
     def connect(self, name: str, store: KVStore) -> RemoteCluster:
@@ -233,7 +327,8 @@ class ClusterMesh:
             # it never sees the torn-down intermediate state
             old.disconnect()
         rc = RemoteCluster(name, store, self._allocator, self._ipcache,
-                           self._selector_cache).connect()
+                           self._selector_cache,
+                           services=self._services).connect()
         self._clusters[name] = rc
         if self._on_change is not None:
             self._on_change()

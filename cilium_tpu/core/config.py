@@ -73,6 +73,11 @@ class Config:
     """Root config (DaemonConfig analog)."""
 
     enable_tpu_offload: bool = False   # master feature gate (north star)
+    #: ``--policy-audit-mode`` analog (reference pkg/option): policy is
+    #: evaluated and reported but NOT enforced — flows that would be
+    #: denied forward with verdict AUDIT (4) instead of DROPPED, so a
+    #: ruleset can be rolled out observe-only before enforcement
+    policy_audit_mode: bool = False
     cluster_name: str = "default"      # clustermesh local cluster name
     node_name: str = "node-0"          # this node's name (operator key)
     #: "static" uses pod_cidr as-is; "cluster-pool" registers with the
@@ -104,6 +109,9 @@ class Config:
         cfg = cls()
         if env.get("CILIUM_TPU_ENABLE_OFFLOAD", "").lower() in ("1", "true", "yes"):
             cfg.enable_tpu_offload = True
+        if env.get("CILIUM_TPU_POLICY_AUDIT_MODE", "").lower() in (
+                "1", "true", "yes"):
+            cfg.policy_audit_mode = True
         if "CILIUM_TPU_BANK_SIZE" in env:
             cfg.engine.bank_size = int(env["CILIUM_TPU_BANK_SIZE"])
         if "CILIUM_TPU_BATCH_SIZE" in env:

@@ -14,7 +14,12 @@ import enum
 import threading
 from typing import Dict, Iterable, Optional
 
-from cilium_tpu.core.labels import Label, LabelSet, SOURCE_RESERVED
+from cilium_tpu.core.labels import (
+    CLUSTER_LABEL_KEY,
+    Label,
+    LabelSet,
+    SOURCE_RESERVED,
+)
 
 NumericIdentity = int  # u32
 
@@ -76,6 +81,34 @@ class IdentityAllocator:
             nid = self._by_labels.get(labels)
             if nid is not None:
                 return nid
+            # the host/remote-node endpoints keep their FIXED reserved
+            # identity regardless of accompanying node labels
+            # (reference: the host endpoint is always identity 1; node
+            # labels vary per node but the datapath identity does not).
+            # A clustermesh-synced set (cluster label present) is NEVER
+            # the local host: another cluster's host maps to
+            # REMOTE_NODE here, exactly as the reference treats peer
+            # nodes — granting it HOST would extend host-entity trust
+            # across the mesh.
+            from_remote = any(l.key == CLUSTER_LABEL_KEY
+                              for l in labels)
+            for l in labels:
+                if l.source != SOURCE_RESERVED:
+                    continue
+                if l.key == "host":
+                    nid = int(ReservedIdentity.REMOTE_NODE if from_remote
+                              else ReservedIdentity.HOST)
+                    break
+                if l.key == "remote-node":
+                    nid = int(ReservedIdentity.REMOTE_NODE)
+                    break
+            if nid is not None:
+                self._by_labels[labels] = nid
+                if not from_remote:
+                    # remote-tagged sets must not overwrite the
+                    # canonical reserved label set in _by_id
+                    self._by_id[nid] = labels
+                return nid
             if any(l.source == "cidr" for l in labels):
                 nid = self._next_local
                 self._next_local += 1
@@ -95,6 +128,11 @@ class IdentityAllocator:
         return self._by_labels.get(labels)
 
     def release(self, nid: NumericIdentity) -> None:
+        # reserved identities are process invariants — a refcounting
+        # consumer (clustermesh) dropping its last reference to e.g.
+        # REMOTE_NODE must not destroy the reserved registration
+        if nid < IDENTITY_USER_MIN:
+            return
         with self._lock:
             lbls = self._by_id.pop(nid, None)
             if lbls is not None:

@@ -45,6 +45,13 @@ class Label:
         return self.format()
 
 
+#: Label key tagging which cluster an identity came from (reference:
+#: ``io.cilium.k8s.policy.cluster``). Canonical home here so both the
+#: policy layer and the identity allocator read one definition without
+#: an import cycle; ``policy.api.rule`` re-exports it.
+CLUSTER_LABEL_KEY = "io.cilium.k8s.policy.cluster"
+
+
 def ParseLabel(s: str) -> Label:
     """Parse ``[source:]key[=value]`` into a Label.
 

@@ -37,6 +37,10 @@ def build_parser() -> argparse.ArgumentParser:
         prog="cilium-tpu-agent",
         description="run the cilium-tpu agent (cilium-agent analog)")
     ap.add_argument("--config", help="TOML config file")
+    ap.add_argument("--policy-audit-mode", action="store_true",
+                    help="evaluate policy but do not enforce it: "
+                         "would-be denials forward with verdict AUDIT "
+                         "(--policy-audit-mode analog)")
     ap.add_argument("--enable-tpu-offload", action="store_true",
                     help="master feature gate: stage policy on the TPU "
                          "engine instead of the CPU oracle")
@@ -80,6 +84,8 @@ def config_from_args(args) -> Config:
            else Config.from_env())
     if args.enable_tpu_offload:
         cfg.enable_tpu_offload = True
+    if args.policy_audit_mode:
+        cfg.policy_audit_mode = True
     for flag in ("node_name", "cluster_name", "ipam_mode", "pod_cidr",
                  "identity_allocation_mode", "log_level"):
         val = getattr(args, flag)

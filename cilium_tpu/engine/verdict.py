@@ -202,10 +202,14 @@ class CompiledPolicy:
         revision: int = 0,
         secret_lookup=None,
         bank_cache=None,
+        audit: bool = False,
     ) -> "CompiledPolicy":
         """``bank_cache`` (compiler.dfa.BankCache): reuse compiled DFA
         banks across builds — incremental rule updates recompile only
-        banks whose pattern membership changed."""
+        banks whose pattern membership changed. ``audit`` =
+        policy_audit_mode: would-be denials verdict AUDIT, not DROPPED
+        (staged as a device scalar so the jitted step needs no
+        recompile-per-mode)."""
         cfg = cfg or EngineConfig()
 
         # -- collect the L7 rule universe (deduped) and rulesets --------
@@ -413,6 +417,7 @@ class CompiledPolicy:
         dns_members = ruleset_dns
 
         arrays: Dict[str, np.ndarray] = {
+            "audit_mode": np.array(audit, dtype=bool),
             "ms_key_w0": packed.key_w0,
             "ms_key_w1": packed.key_w1,
             "ms_key_w2": packed.key_w2,
@@ -741,47 +746,6 @@ class CaptureFeaturizer:
         rows = self.luts[name][idx]
         return data[rows], lens[rows], valid[rows]
 
-    def encode_packed(self, rec, l7) -> Dict[str, np.ndarray]:
-        """Chunk → the packed device-batch dict DIRECTLY (what
-        :func:`flowbatch_to_host_dict` produces), skipping the
-        FlowBatch + stack round-trip: the int32 scalars block is
-        filled column-by-column in one preallocated array and the
-        constant gen_pairs block is cached — this is the replay hot
-        path."""
-        rec = np.asarray(rec)  # one contiguous copy off the memmap
-        B = len(rec)
-        col = {c: i for i, c in enumerate(_SCALAR_COLS)}
-        scal = np.empty((B, len(_SCALAR_COLS)), dtype=np.int32)
-        ingress = rec["direction"] == int(TrafficDirection.INGRESS)
-        scal[:, col["ep_ids"]] = np.where(
-            ingress, rec["dst_identity"], rec["src_identity"])
-        scal[:, col["peer_ids"]] = np.where(
-            ingress, rec["src_identity"], rec["dst_identity"])
-        scal[:, col["dports"]] = rec["dport"]
-        scal[:, col["protos"]] = rec["proto"]
-        scal[:, col["directions"]] = rec["direction"]
-        scal[:, col["l7_types"]] = rec["l7_type"]
-        scal[:, col["kafka_api_key"]] = l7["kafka_api_key"]
-        scal[:, col["kafka_api_version"]] = l7["kafka_api_version"]
-        scal[:, col["kafka_client"]] = \
-            self.luts["kafka_client"][l7["kafka_client"]]
-        scal[:, col["kafka_topic"]] = \
-            self.luts["kafka_topic"][l7["kafka_topic"]]
-        scal[:, col["gen_proto"]] = -2
-        out: Dict[str, np.ndarray] = {"scalars": scal}
-        for name, _ in self._FIELD_CAPS:
-            data, lens, valid = self.tables[name]
-            rows = self.luts[name][l7[name]]
-            out[f"{name}_data"] = data[rows]
-            scal[:, col[f"{name}_len"]] = lens[rows]
-            scal[:, col[f"{name}_valid"]] = valid[rows]
-        cached = getattr(self, "_gen_pairs_cache", None)
-        if cached is None or len(cached) < B:
-            cached = np.full((B, self.fmax), -2, dtype=np.int32)
-            self._gen_pairs_cache = cached
-        out["gen_pairs"] = cached[:B]
-        return out
-
     def encode_rows(self, rec, l7) -> np.ndarray:
         """Chunk → ONE [B, 15] int32 block for
         :func:`verdict_step_capture`: per-flow scalars plus per-field
@@ -880,11 +844,11 @@ def verdict_step_capture(arrays: Dict[str, jax.Array],
                          ) -> Dict[str, jax.Array]:
     """:func:`verdict_step` specialized for v2-capture replay: string
     match words come from the staged per-file tables (gathered by row
-    index) instead of per-flow DFA scans. Generic ``l7proto`` records
-    don't ride v2 captures (their gen_proto is -2 by format), so the
-    generic-family term — which -2 can never satisfy — is dropped.
-    Everything else (precedence, families, auth, log lanes) matches
-    verdict_step exactly; tests pin bit-parity."""
+    index) instead of per-flow DFA scans, then the shared
+    :func:`_verdict_core` assembles the verdict — capture replay and
+    live verdicts share one implementation of the semantics. Generic
+    ``l7proto`` records don't ride v2 captures (their gen_proto is -2
+    by format), so the generic family is skipped."""
     rows = batch["rows"]
     col = {c: i for i, c in enumerate(_ROW_COLS)}
 
@@ -900,121 +864,24 @@ def verdict_step_capture(arrays: Dict[str, jax.Array],
         auth=arrays.get("ms_auth"),
         port_plens=arrays.get("ms_plens"),
     )
-    ruleset = jnp.clip(ms["ruleset"], 0,
-                       arrays["rs_http_mask"].shape[0] - 1)
-    l7t = c("l7_types")
-
-    path_w = table_words["path"][c("path_row")]
-    method_w = table_words["method"][c("method_row")]
-    host_w = table_words["host"][c("host_row")]
-    hdr_w = table_words["headers"][c("headers_row")]
-    rule_ok = (
-        _rule_bit(path_w, arrays["http_path_lane"])
-        & _rule_bit(method_w, arrays["http_method_lane"])
-        & _rule_bit(host_w, arrays["http_host_lane"])
-    )
-    hdr_lanes = arrays["http_header_lanes"]
-    hdr_ok = jax.vmap(lambda lanes: _rule_bit(hdr_w, lanes),
-                      in_axes=1, out_axes=2)(hdr_lanes)
-    rule_ok = rule_ok & jnp.all(hdr_ok, axis=2)
-    if "http_rule_dead" in arrays:
-        rule_ok = rule_ok & ~arrays["http_rule_dead"][None, :]
-
-    http_mask = arrays["rs_http_mask"][ruleset]
-    rule_words = _bools_to_words(rule_ok, http_mask.shape[1])
-    http_ok = (jnp.any((rule_words & http_mask) != 0, axis=1)
-               & (l7t == int(L7Type.HTTP)))
-
-    if "http_log_lanes" in arrays:
-        log_lanes = arrays["http_log_lanes"]
-        log_bits = jax.vmap(lambda lanes: _rule_bit(hdr_w, lanes),
-                            in_axes=1, out_axes=2)(log_lanes)
-        log_fail = jnp.any(~log_bits, axis=2)
-        r_idx = jnp.arange(rule_ok.shape[1])
-        in_set = ((http_mask[:, r_idx >> 5]
-                   >> (r_idx & 31).astype(jnp.uint32)) & 1).astype(bool)
-        l7_log_http = jnp.any(rule_ok & in_set & log_fail, axis=1) \
-            & http_ok
-    else:
-        l7_log_http = jnp.zeros_like(http_ok)
-
-    ak = jnp.clip(c("kafka_api_key"), 0, 31).astype(jnp.uint32)
-    am = arrays["kafka_apikey_mask"][None, :]
-    k_ok = (
-        ((am == 0) | ((am >> ak[:, None]) & jnp.uint32(1)).astype(bool))
-        & ((arrays["kafka_version"][None, :] < 0)
-           | (arrays["kafka_version"][None, :]
-              == c("kafka_api_version")[:, None]))
-        & ((arrays["kafka_client"][None, :] < 0)
-           | (arrays["kafka_client"][None, :]
-              == c("kafka_client")[:, None]))
-        & ((arrays["kafka_topic"][None, :] < 0)
-           | (arrays["kafka_topic"][None, :]
-              == c("kafka_topic")[:, None]))
-    )
-    kafka_mask = arrays["rs_kafka_mask"][ruleset]
-    k_words = _bools_to_words(k_ok, kafka_mask.shape[1])
-    kafka_ok = (jnp.any((k_words & kafka_mask) != 0, axis=1)
-                & (l7t == int(L7Type.KAFKA)))
-
-    dns_w = table_words["qname"][c("qname_row")]
-    d_ok = (_rule_bit(dns_w, arrays["dns_lane"])
-            & (arrays["dns_lane"] >= 0)[None, :])
-    dns_mask = arrays["rs_dns_mask"][ruleset]
-    d_words = _bools_to_words(d_ok, dns_mask.shape[1])
-    dns_ok = (jnp.any((d_words & dns_mask) != 0, axis=1)
-              & (l7t == int(L7Type.DNS)))
-
-    l7_ok = http_ok | kafka_ok | dns_ok
-
-    allowed = ms["allowed"] & (l7_ok | ~ms["redirect"])
-    auth_required = ms["auth_required"]
-    if "auth_pairs" in batch:
-        ingress = c("directions") == int(TrafficDirection.INGRESS)
-        src = jnp.where(ingress, c("peer_ids"), c("ep_ids"))
-        dst = jnp.where(ingress, c("ep_ids"), c("peer_ids"))
-        pairs = batch["auth_pairs"]
-        _, authed = lower_bound((pairs[:, 0], pairs[:, 1]), (src, dst))
-        allowed = allowed & (~auth_required | authed)
-    verdict = jnp.where(
-        allowed,
-        jnp.where(ms["redirect"], int(Verdict.REDIRECTED),
-                  int(Verdict.FORWARDED)),
-        int(Verdict.DROPPED),
-    ).astype(jnp.int32)
-    return {
-        "verdict": verdict,
-        "allowed": allowed,
-        "l3l4_allowed": ms["allowed"],
-        "redirect": ms["redirect"],
-        "l7_ok": l7_ok,
-        "l7_log": l7_log_http & allowed & ms["redirect"],
-        "match_spec": ms["match_spec"],
-        "ruleset": ms["ruleset"],
-        "auth_required": ms["auth_required"],
-    }
+    words = (table_words["path"][c("path_row")],
+             table_words["method"][c("method_row")],
+             table_words["host"][c("host_row")],
+             table_words["headers"][c("headers_row")],
+             table_words["qname"][c("qname_row")])
+    ingress = c("directions") == int(TrafficDirection.INGRESS)
+    src = jnp.where(ingress, c("peer_ids"), c("ep_ids"))
+    dst = jnp.where(ingress, c("ep_ids"), c("peer_ids"))
+    return _verdict_core(
+        arrays, ms, c("l7_types"), words,
+        (c("kafka_api_key"), c("kafka_api_version"),
+         c("kafka_client"), c("kafka_topic")),
+        (src, dst), batch, gen_cols=None)
 
 
-def capture_field_widths(l7, offsets,
-                         cfg: Optional[EngineConfig] = None,
-                         pad_multiple: int = 32) -> Dict[str, int]:
-    """Per-field padded widths over a WHOLE capture — pass to
-    :func:`encode_l7_records` so every chunk of a chunked replay
-    encodes to identical shapes (one jit compile for the stream)."""
-    cfg = cfg or EngineConfig()
-    caps = {"path": max(cfg.http_path_buckets),
-            "method": cfg.http_method_len, "host": cfg.http_host_len,
-            "headers": 1024, "qname": cfg.dns_name_len}
-    widths = {}
-    for field, cap in caps.items():
-        idx = l7[field]
-        lens = (offsets[idx + 1].astype(np.int64)
-                - offsets[idx].astype(np.int64))
-        longest = int(lens.max()) if len(lens) else 1
-        widths[field] = min(
-            cap, max(pad_multiple,
-                     -(-max(longest, 1) // pad_multiple) * pad_multiple))
-    return widths
+# canonical implementation lives in ingest.binary (pure numpy, usable
+# by the replay cursor without jax); re-exported here for engine users
+from cilium_tpu.ingest.binary import capture_field_widths  # noqa: E402
 
 
 def encode_l7_records(rec, l7, offsets, blob,
@@ -1111,47 +978,24 @@ def unpack_batch(packed: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
     return out
 
 
-def verdict_step(arrays: Dict[str, jax.Array], batch: Dict[str, jax.Array]
-                 ) -> Dict[str, jax.Array]:
-    """The pure device function: full verdict for one batch.
+def _verdict_core(arrays, ms, l7t, words, kafka_cols, auth_src_dst,
+                  batch, gen_cols=None):
+    """Shared back half of :func:`verdict_step` and
+    :func:`verdict_step_capture`: per-family rule conjunctions →
+    ruleset-any → precedence + auth + audit assembly. Keeping it in
+    ONE place is what guarantees capture replay and live verdicts
+    cannot drift.
 
-    ``arrays`` = CompiledPolicy.arrays staged on device;
-    ``batch`` = FlowBatch fields as device arrays, either packed
-    (:func:`pack_batch`) or flat — the dict-key check is static under
-    jit, so both layouts trace cleanly.
-    """
-    if "scalars" in batch:
-        batch = unpack_batch(batch)
-    # ICMP key encoding (marker bit in the port slot) happens inside
-    # mapstate_lookup so the kernel matches its golden model for every
-    # caller, not just this one
-    ms = mapstate_lookup(
-        arrays["ms_key_w0"], arrays["ms_key_w1"], arrays["ms_key_w2"],
-        arrays["ms_deny"], arrays["ms_ruleset"],
-        arrays["ms_enf_ids"], arrays["ms_enf_flags"],
-        batch["ep_ids"], batch["peer_ids"], batch["dports"],
-        batch["protos"], batch["directions"],
-        auth=arrays.get("ms_auth"),
-        port_plens=arrays.get("ms_plens"),
-    )
+    ``words`` = (path_w, method_w, host_w, hdr_w, dns_w) match-word
+    tensors; ``kafka_cols`` = (api_key, api_version, client, topic)
+    int32 columns; ``auth_src_dst`` = (src, dst) identity columns for
+    the authed-pairs check; ``gen_cols`` = (gen_proto, gen_pairs) or
+    None when the caller's format cannot carry generic records (v2
+    captures — a -2 proto could never match anyway)."""
     ruleset = jnp.clip(ms["ruleset"], 0, arrays["rs_http_mask"].shape[0] - 1)
-    l7t = batch["l7_types"]
-
-    def scan_field(prefix: str, data, lengths, valid):
-        words = dfa_scan_banked(
-            arrays[f"{prefix}_trans"], arrays[f"{prefix}_byteclass"],
-            arrays[f"{prefix}_start"], arrays[f"{prefix}_accept"],
-            data, lengths,
-        )
-        B = words.shape[0]
-        flat = words.reshape(B, -1)
-        return jnp.where(valid[:, None], flat, 0)
+    path_w, method_w, host_w, hdr_w, dns_w = words
 
     # HTTP: conjunction of per-field pattern bits per rule
-    path_w = scan_field("path", *batch_field(batch, "path"))
-    method_w = scan_field("method", *batch_field(batch, "method"))
-    host_w = scan_field("host", *batch_field(batch, "host"))
-    hdr_w = scan_field("hdr", *batch_field(batch, "headers"))
     rule_ok = (
         _rule_bit(path_w, arrays["http_path_lane"])
         & _rule_bit(method_w, arrays["http_method_lane"])
@@ -1167,8 +1011,7 @@ def verdict_step(arrays: Dict[str, jax.Array], batch: Dict[str, jax.Array]
         rule_ok = rule_ok & ~arrays["http_rule_dead"][None, :]
 
     http_mask = arrays["rs_http_mask"][ruleset]      # [B, Wh]
-    Wh = http_mask.shape[1]
-    rule_words = _bools_to_words(rule_ok, Wh)
+    rule_words = _bools_to_words(rule_ok, http_mask.shape[1])
     # a rule family only matches flows carrying that L7 record (oracle:
     # flow.http is None → no HTTP rule matches)
     http_ok = (jnp.any((rule_words & http_mask) != 0, axis=1)
@@ -1192,19 +1035,17 @@ def verdict_step(arrays: Dict[str, jax.Array], batch: Dict[str, jax.Array]
         l7_log_http = jnp.zeros_like(http_ok)
 
     # Kafka: columnar exact/set matching
-    ak = jnp.clip(batch["kafka_api_key"], 0, 31).astype(jnp.uint32)
+    k_api, k_ver, k_cli, k_top = kafka_cols
+    ak = jnp.clip(k_api, 0, 31).astype(jnp.uint32)
     am = arrays["kafka_apikey_mask"][None, :]        # [1, Rk]
     k_ok = (
         ((am == 0) | ((am >> ak[:, None]) & jnp.uint32(1)).astype(bool))
         & ((arrays["kafka_version"][None, :] < 0)
-           | (arrays["kafka_version"][None, :]
-              == batch["kafka_api_version"][:, None]))
+           | (arrays["kafka_version"][None, :] == k_ver[:, None]))
         & ((arrays["kafka_client"][None, :] < 0)
-           | (arrays["kafka_client"][None, :]
-              == batch["kafka_client"][:, None]))
+           | (arrays["kafka_client"][None, :] == k_cli[:, None]))
         & ((arrays["kafka_topic"][None, :] < 0)
-           | (arrays["kafka_topic"][None, :]
-              == batch["kafka_topic"][:, None]))
+           | (arrays["kafka_topic"][None, :] == k_top[:, None]))
     )
     kafka_mask = arrays["rs_kafka_mask"][ruleset]
     k_words = _bools_to_words(k_ok, kafka_mask.shape[1])
@@ -1212,30 +1053,33 @@ def verdict_step(arrays: Dict[str, jax.Array], batch: Dict[str, jax.Array]
                 & (l7t == int(L7Type.KAFKA)))
 
     # DNS: qname automaton
-    dns_w = scan_field("dns", *batch_field(batch, "qname"))
-    d_ok = _rule_bit(dns_w, arrays["dns_lane"]) & (arrays["dns_lane"] >= 0)[None, :]
+    d_ok = (_rule_bit(dns_w, arrays["dns_lane"])
+            & (arrays["dns_lane"] >= 0)[None, :])
     dns_mask = arrays["rs_dns_mask"][ruleset]
     d_words = _bools_to_words(d_ok, dns_mask.shape[1])
     dns_ok = (jnp.any((d_words & dns_mask) != 0, axis=1)
               & (l7t == int(L7Type.DNS)))
 
-    # generic l7proto records: pair-subset matching
-    grp = arrays["gen_rule_pairs"]                  # [Rg, Km]
-    have = jnp.any(
-        batch["gen_pairs"][:, None, None, :] == grp[None, :, :, None],
-        axis=-1)                                    # [B, Rg, Km]
-    pair_ok = jnp.all(jnp.where(grp[None, :, :] < 0, True, have), axis=-1)
-    proto_ok = (arrays["gen_rule_proto"][None, :]
-                == batch["gen_proto"][:, None])     # [B, Rg]
-    g_ok = pair_ok & proto_ok & (arrays["gen_rule_proto"] >= 0)[None, :]
-    gen_mask = arrays["rs_gen_mask"][ruleset]
-    g_words = _bools_to_words(g_ok, gen_mask.shape[1])
-    gen_ok = (jnp.any((g_words & gen_mask) != 0, axis=1)
-              & (l7t == int(L7Type.GENERIC)))
-
     # allow-list over the union of the ruleset's families (a merged
     # entry can carry several protocol families; oracle checks all)
-    l7_ok = http_ok | kafka_ok | dns_ok | gen_ok
+    l7_ok = http_ok | kafka_ok | dns_ok
+
+    if gen_cols is not None:
+        # generic l7proto records: pair-subset matching
+        gen_proto, gen_pairs = gen_cols
+        grp = arrays["gen_rule_pairs"]              # [Rg, Km]
+        have = jnp.any(
+            gen_pairs[:, None, None, :] == grp[None, :, :, None],
+            axis=-1)                                # [B, Rg, Km]
+        pair_ok = jnp.all(jnp.where(grp[None, :, :] < 0, True, have),
+                          axis=-1)
+        proto_ok = (arrays["gen_rule_proto"][None, :]
+                    == gen_proto[:, None])          # [B, Rg]
+        g_ok = pair_ok & proto_ok & (arrays["gen_rule_proto"] >= 0)[None, :]
+        gen_mask = arrays["rs_gen_mask"][ruleset]
+        g_words = _bools_to_words(g_ok, gen_mask.shape[1])
+        l7_ok = l7_ok | (jnp.any((g_words & gen_mask) != 0, axis=1)
+                         & (l7t == int(L7Type.GENERIC)))
 
     allowed = ms["allowed"] & (l7_ok | ~ms["redirect"])
     auth_required = ms["auth_required"]
@@ -1243,19 +1087,21 @@ def verdict_step(arrays: Dict[str, jax.Array], batch: Dict[str, jax.Array]
         # drop-until-authed (the reference's auth map): a winning allow
         # that demands auth forwards only if (src, dst) completed the
         # handshake. Pairs ride a lex-sorted [P, 2] int32 table
-        # (two words, not a packed int64 — x64 is disabled under jax);
-        # flows rebuild (src, dst) from (ep, peer) by direction.
-        ingress = batch["directions"] == int(TrafficDirection.INGRESS)
-        src = jnp.where(ingress, batch["peer_ids"], batch["ep_ids"])
-        dst = jnp.where(ingress, batch["ep_ids"], batch["peer_ids"])
+        # (two words, not a packed int64 — x64 is disabled under jax).
+        src, dst = auth_src_dst
         pairs = batch["auth_pairs"]
         _, authed = lower_bound((pairs[:, 0], pairs[:, 1]), (src, dst))
         allowed = allowed & (~auth_required | authed)
+    # policy_audit_mode: a would-be denial forwards with verdict AUDIT
+    # (device scalar — no recompile when the mode flips)
+    deny_code = jnp.where(arrays["audit_mode"], int(Verdict.AUDIT),
+                          int(Verdict.DROPPED)) \
+        if "audit_mode" in arrays else jnp.int32(int(Verdict.DROPPED))
     verdict = jnp.where(
         allowed,
         jnp.where(ms["redirect"], int(Verdict.REDIRECTED),
                   int(Verdict.FORWARDED)),
-        int(Verdict.DROPPED),
+        deny_code,
     ).astype(jnp.int32)
     return {
         "verdict": verdict,
@@ -1268,6 +1114,57 @@ def verdict_step(arrays: Dict[str, jax.Array], batch: Dict[str, jax.Array]
         "ruleset": ms["ruleset"],
         "auth_required": ms["auth_required"],
     }
+
+
+def verdict_step(arrays: Dict[str, jax.Array], batch: Dict[str, jax.Array]
+                 ) -> Dict[str, jax.Array]:
+    """The pure device function: full verdict for one batch.
+
+    ``arrays`` = CompiledPolicy.arrays staged on device;
+    ``batch`` = FlowBatch fields as device arrays, either packed
+    (:func:`pack_batch`) or flat — the dict-key check is static under
+    jit, so both layouts trace cleanly.
+    """
+    if "scalars" in batch:
+        batch = unpack_batch(batch)
+    # ICMP key encoding (marker bit in the port slot) happens inside
+    # mapstate_lookup so the kernel matches its golden model for every
+    # caller, not just this one
+    ms = mapstate_lookup(
+        arrays["ms_key_w0"], arrays["ms_key_w1"], arrays["ms_key_w2"],
+        arrays["ms_deny"], arrays["ms_ruleset"],
+        arrays["ms_enf_ids"], arrays["ms_enf_flags"],
+        batch["ep_ids"], batch["peer_ids"], batch["dports"],
+        batch["protos"], batch["directions"],
+        auth=arrays.get("ms_auth"),
+        port_plens=arrays.get("ms_plens"),
+    )
+
+    def scan_field(prefix: str, data, lengths, valid):
+        words = dfa_scan_banked(
+            arrays[f"{prefix}_trans"], arrays[f"{prefix}_byteclass"],
+            arrays[f"{prefix}_start"], arrays[f"{prefix}_accept"],
+            data, lengths,
+        )
+        B = words.shape[0]
+        flat = words.reshape(B, -1)
+        return jnp.where(valid[:, None], flat, 0)
+
+    words = (scan_field("path", *batch_field(batch, "path")),
+             scan_field("method", *batch_field(batch, "method")),
+             scan_field("host", *batch_field(batch, "host")),
+             scan_field("hdr", *batch_field(batch, "headers")),
+             scan_field("dns", *batch_field(batch, "qname")))
+    # flows rebuild (src, dst) from (ep, peer) by direction
+    ingress = batch["directions"] == int(TrafficDirection.INGRESS)
+    src = jnp.where(ingress, batch["peer_ids"], batch["ep_ids"])
+    dst = jnp.where(ingress, batch["ep_ids"], batch["peer_ids"])
+    return _verdict_core(
+        arrays, ms, batch["l7_types"], words,
+        (batch["kafka_api_key"], batch["kafka_api_version"],
+         batch["kafka_client"], batch["kafka_topic"]),
+        (src, dst), batch,
+        gen_cols=(batch["gen_proto"], batch["gen_pairs"]))
 
 
 def batch_field(batch: Dict[str, jax.Array], name: str):
@@ -1350,12 +1247,17 @@ class VerdictEngine:
 
     def verdict_l7_records(self, rec, l7, offsets, blob,
                            cfg: Optional[EngineConfig] = None,
-                           authed_pairs: Optional[np.ndarray] = None):
+                           authed_pairs: Optional[np.ndarray] = None,
+                           widths: Optional[Dict[str, int]] = None):
         """Columnar fast path over a v2 capture (base records + L7
         sidecar): full HTTP/Kafka/DNS verdicts, zero per-flow Python
-        (ingest/binary.py → encode_l7_records → device)."""
+        (ingest/binary.py → encode_l7_records → device). Chunked
+        callers MUST pass whole-capture ``widths``
+        (:func:`capture_field_widths`) or every chunk whose longest
+        string rounds differently re-jits the step."""
         fb = encode_l7_records(rec, l7, offsets, blob,
-                               self.policy.kafka_interns, cfg)
+                               self.policy.kafka_interns, cfg,
+                               widths=widths)
         batch = flowbatch_to_device(fb, self.device)
         self._stage_auth(batch, authed_pairs)
         out = self.verdict_batch_arrays(batch)

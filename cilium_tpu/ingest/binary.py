@@ -31,7 +31,7 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Iterable, List, Optional
+from typing import Dict, Iterable, List, Optional
 
 import numpy as np
 
@@ -361,6 +361,31 @@ def write_capture_l7(path: str, flows: Iterable[Flow]) -> int:
         fp.write(blob.tobytes())
         fp.write(l7.tobytes())
     return len(rec)
+
+
+def capture_field_widths(l7, offsets, cfg=None,
+                         pad_multiple: int = 32) -> Dict[str, int]:
+    """Per-field padded widths over a WHOLE capture — pass to the
+    engine's ``encode_l7_records`` so every chunk of a chunked replay
+    encodes to identical shapes (one jit compile for the stream).
+    Lives here (pure numpy) so the replay cursor can compute it
+    without touching jax."""
+    from cilium_tpu.core.config import EngineConfig
+
+    cfg = cfg or EngineConfig()
+    caps = {"path": max(cfg.http_path_buckets),
+            "method": cfg.http_method_len, "host": cfg.http_host_len,
+            "headers": 1024, "qname": cfg.dns_name_len}
+    widths = {}
+    for field, cap in caps.items():
+        idx = l7[field]
+        lens = (offsets[idx + 1].astype(np.int64)
+                - offsets[idx].astype(np.int64))
+        longest = int(lens.max()) if len(lens) else 1
+        widths[field] = min(
+            cap, max(pad_multiple,
+                     -(-max(longest, 1) // pad_multiple) * pad_multiple))
+    return widths
 
 
 def l7_info(path: str):

@@ -82,6 +82,7 @@ def replay_chunks(capture: str, chunk_size: int = 8192,
         # against the (whole-capture) string table.
         from cilium_tpu.ingest.binary import (
             VERSION_L7,
+            capture_field_widths,
             capture_version,
             map_capture,
             read_l7_sidecar,
@@ -92,6 +93,11 @@ def replay_chunks(capture: str, chunk_size: int = 8192,
         records = map_capture(capture)
         side = (read_l7_sidecar(capture)
                 if capture_version(capture) == VERSION_L7 else None)
+        # whole-capture field widths ride along so the columnar
+        # consumer encodes every chunk to identical shapes (one jit
+        # compile for the stream) without re-reading the sidecar
+        widths = (capture_field_widths(side[0], side[1])
+                  if side is not None and not decode else None)
         while index < len(records):
             take = chunk_size if limit is None else min(
                 chunk_size, limit - emitted)
@@ -102,10 +108,11 @@ def replay_chunks(capture: str, chunk_size: int = 8192,
                 l7, offsets, blob = side
                 l7raw = l7[index:index + len(raw)]
                 chunk = (records_to_flows_l7(raw, l7raw, offsets, blob)
-                         if decode else (raw, l7raw, offsets, blob))
+                         if decode else (raw, l7raw, offsets, blob,
+                                         widths))
             else:
                 chunk = (records_to_flows(raw) if decode
-                         else (raw, None, None, None))
+                         else (raw, None, None, None, None))
             yield index + len(raw), chunk
             index += len(raw)
             emitted += len(raw)

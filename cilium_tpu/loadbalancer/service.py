@@ -75,8 +75,15 @@ class Service:
     name: str = ""
     namespace: str = "default"
     labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: global service (reference: ``service.cilium.io/global`` — the
+    #: clustermesh-shared annotation): backends announced by remote
+    #: clusters for the same (namespace, name) merge into this
+    #: service's selection table, and the local publisher exports it
+    shared: bool = False
 
     def active_backends(self) -> List[Backend]:
+        """LOCAL active backends only — the merged (clustermesh) view
+        lives on :meth:`ServiceManager.active_backends`."""
         return [b for b in self.backends if b.state == BackendState.ACTIVE]
 
 
@@ -118,6 +125,10 @@ class ServiceManager:
         self._lock = threading.Lock()
         self._services: Dict[Frontend, Service] = {}
         self._tables: Dict[Frontend, np.ndarray] = {}
+        #: clustermesh global-service overlay: (namespace, name) →
+        #: cluster → remote backends (reference: pkg/clustermesh
+        #: services sync feeding pkg/service)
+        self._remote: Dict[Tuple[str, str], Dict[str, List[Backend]]] = {}
         self._revision = 0
         self.table_size = table_size
         #: fired after every mutation commit — policy `toServices`
@@ -130,18 +141,88 @@ class ServiceManager:
         if self.on_change is not None:
             self.on_change()
 
-    # -- mutation ---------------------------------------------------------
-    def upsert(self, svc: Service) -> None:
-        active = svc.active_backends()
-        table = maglev_table(
+    # -- clustermesh merge ------------------------------------------------
+    def _merged_active_locked(self, svc: Service) -> List[Backend]:
+        """Active backends incl. the remote overlay for shared
+        services; deterministic order (local, then clusters sorted)."""
+        out = svc.active_backends()
+        if svc.shared:
+            per_cluster = self._remote.get((svc.namespace, svc.name), {})
+            for cluster in sorted(per_cluster):
+                out.extend(b for b in per_cluster[cluster]
+                           if b.state == BackendState.ACTIVE)
+        return out
+
+    def active_backends(self, svc: Service) -> List[Backend]:
+        """The selection view of a service's backends (merged across
+        clusters for shared services) — what ``toServices`` resolution
+        and the LB tables see."""
+        with self._lock:
+            return self._merged_active_locked(svc)
+
+    def _rebuild_table_locked(self, svc: Service) -> None:
+        active = self._merged_active_locked(svc)
+        self._tables[svc.frontend] = maglev_table(
             list(range(len(active))),
             [b.name for b in active],
             m=self.table_size,
             weights=[b.weight for b in active],
         )
+
+    def set_remote_backends(self, cluster: str, namespace: str,
+                            name: str, backends: List[Backend]) -> None:
+        """Clustermesh ingest: replace ``cluster``'s announced backends
+        for global service (namespace, name); selection tables of a
+        matching local SHARED service rebuild immediately."""
+        with self._lock:
+            per = self._remote.setdefault((namespace, name), {})
+            if per.get(cluster, []) == list(backends):
+                if not per:
+                    del self._remote[(namespace, name)]
+                return  # unchanged re-announce: no rebuild, no regen
+            if backends:
+                per[cluster] = list(backends)
+            else:
+                per.pop(cluster, None)
+                if not per:
+                    del self._remote[(namespace, name)]
+            changed = False
+            for svc in self._services.values():
+                if (svc.shared and svc.namespace == namespace
+                        and svc.name == name):
+                    self._rebuild_table_locked(svc)
+                    changed = True
+            if changed:
+                self._revision += 1
+        if changed:
+            self._changed()
+
+    def remove_remote_cluster(self, cluster: str) -> None:
+        """Drop every backend ``cluster`` announced (disconnect)."""
+        with self._lock:
+            changed = False
+            for (namespace, name) in list(self._remote):
+                per = self._remote[(namespace, name)]
+                if cluster not in per:
+                    continue
+                del per[cluster]
+                if not per:
+                    del self._remote[(namespace, name)]
+                for svc in self._services.values():
+                    if (svc.shared and svc.namespace == namespace
+                            and svc.name == name):
+                        self._rebuild_table_locked(svc)
+                        changed = True
+            if changed:
+                self._revision += 1
+        if changed:
+            self._changed()
+
+    # -- mutation ---------------------------------------------------------
+    def upsert(self, svc: Service) -> None:
         with self._lock:
             self._services[svc.frontend] = svc
-            self._tables[svc.frontend] = table
+            self._rebuild_table_locked(svc)
             self._revision += 1
         METRICS.set_gauge("cilium_tpu_lb_services", float(len(self._services)))
         self._changed()
@@ -178,9 +259,10 @@ class ServiceManager:
         with self._lock:
             svc = self._services.get(fe)
             table = self._tables.get(fe)
+            active = (self._merged_active_locked(svc)
+                      if svc is not None else [])
         if svc is None or table is None:
             return None
-        active = svc.active_backends()
         if not active:
             return None
         words = self._hash_words(
@@ -208,13 +290,15 @@ class ServiceManager:
                 key=lambda kv: (_ip_u32(kv[0].ip),
                                 (kv[0].proto << 16) | kv[0].port))
             tables = {fe: t for fe, t in self._tables.items()}
+            merged = {fe: self._merged_active_locked(svc)
+                      for fe, svc in items}
             revision = self._revision
         backend_ip: List[int] = []
         backend_port: List[int] = []
         svc_rows = []
         slab = []
         for fe, svc in items:
-            active = svc.active_backends()
+            active = merged[fe]
             base = len(backend_ip)
             backend_ip.extend(_ip_u32(b.ip) for b in active)
             backend_port.extend(b.port for b in active)

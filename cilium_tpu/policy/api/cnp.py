@@ -164,10 +164,23 @@ def _parse_service_selector(d: Dict):
     )
 
 
-def _spec_to_rule(spec: Dict, labels: Tuple[str, ...]) -> Rule:
+def _spec_to_rule(spec: Dict, labels: Tuple[str, ...],
+                  clusterwide: bool = False) -> Rule:
+    node_sel = spec.get("nodeSelector")
+    if node_sel is not None:
+        # host policy (reference: CCNP.Spec.NodeSelector → host
+        # firewall): nodes only, CCNP only, and never both selectors
+        if not clusterwide:
+            raise SanitizeError(
+                "nodeSelector requires CiliumClusterwideNetworkPolicy")
+        if spec.get("endpointSelector") is not None:
+            raise SanitizeError(
+                "spec cannot have both endpointSelector and nodeSelector")
+        subject = EndpointSelector.from_dict(node_sel)
+    else:
+        subject = EndpointSelector.from_dict(spec.get("endpointSelector"))
     return Rule(
-        endpoint_selector=EndpointSelector.from_dict(
-            spec.get("endpointSelector")),
+        endpoint_selector=subject,
         ingress=tuple(_parse_ingress(i, False)
                       for i in (spec.get("ingress") or ())) +
         tuple(_parse_ingress(i, True)
@@ -178,6 +191,7 @@ def _spec_to_rule(spec: Dict, labels: Tuple[str, ...]) -> Rule:
               for e in (spec.get("egressDeny") or ())),
         labels=labels,
         description=spec.get("description", "") or "",
+        node_selector=node_sel is not None,
     )
 
 
@@ -194,7 +208,9 @@ def parse_cnp(doc: Dict) -> CiliumNetworkPolicy:
     if doc.get("spec"):
         specs.append(doc["spec"])
     specs.extend(doc.get("specs") or ())
-    rules = tuple(_spec_to_rule(s, labels) for s in specs)
+    clusterwide = kind == "CiliumClusterwideNetworkPolicy"
+    rules = tuple(_spec_to_rule(s, labels, clusterwide=clusterwide)
+                  for s in specs)
     return CiliumNetworkPolicy(name=name, namespace=namespace, rules=rules)
 
 

@@ -108,7 +108,9 @@ class PortRule:
 #: cluster name) — how the ``cluster`` entity selects in-cluster
 #: endpoints WITHOUT matching ``reserved:world`` or CIDR identities
 #: (reference: EntitySelectorMapping + InitEntities(clusterName))
-CLUSTER_LABEL_KEY = "io.cilium.k8s.policy.cluster"
+from cilium_tpu.core.labels import CLUSTER_LABEL_KEY  # noqa: E402,F401
+# (canonical definition lives in core.labels; re-exported here for the
+# policy-layer consumers that historically imported it from this module)
 
 
 def _reserved(name: str) -> EndpointSelector:
@@ -308,6 +310,25 @@ class Rule:
     egress: Tuple[EgressRule, ...] = ()
     labels: Tuple[str, ...] = ()          # rule provenance labels
     description: str = ""
+    #: True when the rule came from a CCNP ``nodeSelector`` spec: the
+    #: endpoint_selector then selects NODES (host endpoints carrying
+    #: ``reserved:host``/``reserved:remote-node`` + node labels) and
+    #: never pods — and pod rules never select host endpoints
+    #: (reference: CiliumClusterwideNetworkPolicy.Spec.NodeSelector +
+    #: host-firewall enforcement on the host endpoint)
+    node_selector: bool = False
+
+    def selects(self, endpoint_labels) -> bool:
+        """Subject match with the pod/node scope split applied."""
+        from cilium_tpu.core.labels import SOURCE_RESERVED
+
+        is_node = any(
+            l.source == SOURCE_RESERVED and l.key in ("host",
+                                                      "remote-node")
+            for l in endpoint_labels)
+        if is_node != self.node_selector:
+            return False
+        return self.endpoint_selector.matches(endpoint_labels)
 
     def sanitize(self, max_quantifier: int = 64) -> "Rule":
         """Validate the rule; raises SanitizeError.

@@ -490,7 +490,11 @@ class PolicyResolver:
             if not svc_sel.matches(svc.name, svc.namespace,
                                    svc.labels or {}):
                 continue
-            for backend in svc.active_backends():
+            # merged view: shared (global) services include backends
+            # announced by remote clusters (pkg/clustermesh services
+            # sync); their IPs resolve through the ipcache entries the
+            # IP sync created
+            for backend in self.services.active_backends(svc):
                 nid = self.backend_identity(backend.ip)
                 if nid is not None:
                     ids.add(int(nid))

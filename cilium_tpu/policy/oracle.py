@@ -174,9 +174,13 @@ class OracleVerdictEngine:
     secret-backed header-match values (SecretStore.lookup)."""
 
     def __init__(self, per_identity: Dict[int, MapState],
-                 secret_lookup=None):
+                 secret_lookup=None, audit: bool = False):
         self.per_identity = per_identity
         self.secret_lookup = secret_lookup
+        #: policy_audit_mode (reference pkg/option): would-be denials
+        #: forward with verdict AUDIT instead of DROPPED; nothing else
+        #: about evaluation changes
+        self.audit = audit
 
     def _decide(self, flow: Flow):
         """One lookup → (verdict, winning_entry, allowed, l7_log)."""
@@ -198,7 +202,10 @@ class OracleVerdictEngine:
         return Verdict.FORWARDED, entry, True, False
 
     def verdict_one(self, flow: Flow) -> Verdict:
-        return self._decide(flow)[0]
+        v = self._decide(flow)[0]
+        if self.audit and v == Verdict.DROPPED:
+            return Verdict.AUDIT
+        return v
 
     def verdict_flows(self, flows: Sequence[Flow], authed_pairs=None):
         """``authed_pairs``: lex-sorted [P, 2] int32 (src, dst) table
@@ -227,6 +234,10 @@ class OracleVerdictEngine:
             if (demand and pairs is not None
                     and (f.src_identity, f.dst_identity) not in pairs):
                 verdict = Verdict.DROPPED  # drop until handshake
+            if self.audit and verdict == Verdict.DROPPED:
+                # audit mode disables enforcement wholesale — auth
+                # drops included — but the would-be denial is reported
+                verdict = Verdict.AUDIT
             verdicts.append(int(verdict))
             auth.append(demand)
             logs.append(log and verdict == Verdict.REDIRECTED)
@@ -245,9 +256,10 @@ class OracleVerdictEngine:
                                   authed_pairs=authed_pairs)
 
     def verdict_l7_records(self, rec, l7, offsets, blob,
-                           authed_pairs=None):
+                           authed_pairs=None, widths=None):
         """Interface parity with VerdictEngine.verdict_l7_records (v2
-        captures; the oracle reconstructs Flow objects with payloads)."""
+        captures; the oracle reconstructs Flow objects with payloads —
+        ``widths`` is a device-side shape hint with no oracle role)."""
         from cilium_tpu.ingest.binary import records_to_flows_l7
 
         return self.verdict_flows(

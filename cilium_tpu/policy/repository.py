@@ -67,9 +67,12 @@ class Repository:
         """Rules whose endpointSelector matches (resolvePolicyLocked's
         outer loop)."""
         with self._lock:
+            # Rule.selects applies the pod/node scope split: CCNP
+            # nodeSelector rules only select host endpoints and pod
+            # rules never do (reference: host-firewall policies are
+            # sourced exclusively from nodeSelector CCNPs)
             return tuple(
-                r for r in self._rules
-                if r.endpoint_selector.matches(endpoint_labels)
+                r for r in self._rules if r.selects(endpoint_labels)
             )
 
     def __len__(self) -> int:

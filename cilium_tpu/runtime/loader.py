@@ -89,8 +89,9 @@ class Loader:
         secret_lookup = (self.secrets.lookup
                          if self.secrets is not None else None)
         if not self.config.enable_tpu_offload:
-            engine = OracleVerdictEngine(per_identity,
-                                         secret_lookup=secret_lookup)
+            engine = OracleVerdictEngine(
+                per_identity, secret_lookup=secret_lookup,
+                audit=self.config.policy_audit_mode)
             with self._lock:
                 self._engine = engine
                 self._revision = revision
@@ -100,13 +101,15 @@ class Loader:
 
         from cilium_tpu.engine.verdict import CompiledPolicy, VerdictEngine
 
-        # "policy-v3": v2 gained the ms_auth array; v3 gained port-range
-        # prefix keys (ms_plens + the w2 repack) — each bump invalidates
-        # older cached artifacts, and the entry tuple must include every
-        # verdict-relevant key/entry field or two policies differing
-        # only in that field would share one artifact
+        # "policy-v4": v2 gained the ms_auth array; v3 port-range prefix
+        # keys (ms_plens + the w2 repack); v4 the audit_mode scalar —
+        # each bump invalidates older cached artifacts, and the entry
+        # tuple must include every verdict-relevant key/entry field or
+        # two policies differing only in that field would share one
+        # artifact
         key = ruleset_fingerprint(
-            "policy-v3",
+            "policy-v4",
+            self.config.policy_audit_mode,
             sorted(
                 (
                     ep,
@@ -132,11 +135,11 @@ class Loader:
         cached = policy is not None
         if policy is None:
             with SpanStat("policy_compile") as span:
-                policy = CompiledPolicy.build(per_identity,
-                                              self.config.engine,
-                                              revision=revision,
-                                              secret_lookup=secret_lookup,
-                                              bank_cache=self.bank_cache)
+                policy = CompiledPolicy.build(
+                    per_identity, self.config.engine, revision=revision,
+                    secret_lookup=secret_lookup,
+                    bank_cache=self.bank_cache,
+                    audit=self.config.policy_audit_mode)
             self._cache.put(key, policy)
             METRICS.observe("cilium_tpu_compile_seconds", span.seconds)
         with _log_span(LOG, "policy staged", revision=revision,

@@ -104,6 +104,10 @@ def build_agent(agent=None):
             named_ports=NAMED_PORTS.get(key)).identity
     for key, prefix in CIDRS:
         ids[key] = int(agent.ipcache.upsert(prefix, None))
+    # the node's host endpoint (reserved:host + node labels → fixed
+    # identity 1): subject of the host/ corpus CCNPs
+    ids["host"] = agent.host_endpoint_add(
+        {"node-role": "worker"}, ipv4="10.50.0.100").identity
     for path in sorted(glob.glob(os.path.join(CORPUS, "*", "*.yaml"))):
         agent.policy_add_file(path, wait=False)
     agent.endpoint_manager.regenerate_all(wait=True)
@@ -244,6 +248,16 @@ def build_flows(ids):
              protocol=Protocol.UDP,
              direction=TrafficDirection.EGRESS, l7=L7Type.DNS,
              dns=DNSInfo(query="other.corp.internal")),
+        # ---- round-3 corpus (appended; prefix above is frozen) ----
+        # host/host-firewall.yaml: CCNP nodeSelector on the host ep
+        f("frontend", "host", 22),                # cluster → ssh: allow
+        f(6, "host", 9100),                       # remote-node scrape
+        f("frontend", "host", 9100),              # pods can't scrape
+        f(WORLD, "host", 22),                     # world outside cluster
+        f("frontend", "host", 80),                # default-deny on host
+        # the wildcard pod policies must NOT have attached to the host
+        # endpoint, nor the host CCNP to any pod
+        f("frontend", "metricsd", 22),
     ]
 
 
@@ -283,6 +297,26 @@ def test_both_engines_agree_on_corpus(offload):
         with open(GOLDEN) as fp:
             golden = json.load(fp)
         assert [int(v) for v in out["verdict"]] == golden["verdicts"]
+    finally:
+        agent.stop()
+
+
+@pytest.mark.parametrize("offload", [False, True])
+def test_audit_mode_corpus_golden(offload):
+    """policy_audit_mode over the FULL corpus: exactly the golden
+    verdicts with every DROPPED (2) replaced by AUDIT (4) — audit must
+    change nothing else, on either backend."""
+    cfg = Config()
+    cfg.enable_tpu_offload = offload
+    cfg.policy_audit_mode = True
+    cfg.configure_logging = False
+    agent, ids = build_agent(Agent(cfg))
+    try:
+        out = agent.loader.engine.verdict_flows(build_flows(ids))
+        with open(GOLDEN) as fp:
+            golden = json.load(fp)
+        want = [4 if v == 2 else v for v in golden["verdicts"]]
+        assert [int(v) for v in out["verdict"]] == want
     finally:
         agent.stop()
 
