@@ -375,7 +375,9 @@ def run_config(config: str, args) -> dict:
             # latency); uses the first few timed chunks, which the
             # throughput pass then skips so every throughput-timed
             # buffer is still first-use
-            n_lat = max(1, min(8, n_chunks - 1 - args.warmup - 2))
+            # enough samples that the streaming p99 is a quantile too
+            # (at the 1M-tuple BASELINE shape there are ~120 chunks)
+            n_lat = max(1, min(32, n_chunks - 1 - args.warmup - 2))
             times = []
             for c in range(1 + args.warmup, 1 + args.warmup + n_lat):
                 t0 = time.perf_counter()

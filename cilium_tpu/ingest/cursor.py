@@ -117,6 +117,27 @@ def replay_chunks(capture: str, chunk_size: int = 8192,
             index += len(raw)
             emitted += len(raw)
         return
+    from cilium_tpu.ingest.flowpb import looks_like_pb_capture
+
+    if looks_like_pb_capture(capture):
+        # protobuf flow stream (api/v1/flow framing): cursor indexes
+        # MESSAGES; decode is per-flow by nature (object path only)
+        if not decode:
+            from cilium_tpu.ingest.binary import CaptureError
+
+            raise CaptureError("bad magic")  # columnar needs CTCAP
+        from cilium_tpu.ingest.flowpb import iter_pb_capture
+
+        flows = []
+        for f in iter_pb_capture(capture, start=index, limit=limit):
+            flows.append(f)
+            index += 1
+            if len(flows) >= chunk_size:
+                yield index, flows
+                flows = []
+        if flows:
+            yield index, flows
+        return
     if not decode:
         from cilium_tpu.ingest.binary import CaptureError
 

@@ -75,6 +75,10 @@ def flow_to_dict(f: Flow) -> Dict:
         d["l4"] = {"UDP": port_obj}
     elif l4_proto == Protocol.SCTP:
         d["l4"] = {"SCTP": port_obj}
+    elif l4_proto == Protocol.ICMP:
+        d["l4"] = {"ICMPv4": {"type": f.dport}}
+    elif l4_proto == Protocol.ICMPV6:
+        d["l4"] = {"ICMPv6": {"type": f.dport}}
     if f.l7 == L7Type.HTTP and f.http:
         d["l7"] = {"type": "REQUEST", "http": {
             "method": f.http.method,
@@ -108,6 +112,23 @@ def flow_to_dict(f: Flow) -> Dict:
     return d
 
 
+def split_http_url(url: str) -> tuple:
+    """flowpb's ``http.url`` is ABSOLUTE (pkg/hubble/parser/seven
+    builds scheme://host/path); policy regexes match the PATH. Returns
+    ``(path_with_query, host)`` — host empty for bare paths. Shared by
+    the JSONL and protobuf ingest paths so they can never disagree on
+    what a policy regex sees."""
+    if "://" not in url:
+        return url, ""
+    from urllib.parse import urlsplit
+
+    parts = urlsplit(url)
+    path = parts.path or "/"
+    if parts.query:
+        path = f"{path}?{parts.query}"
+    return path, parts.hostname or ""
+
+
 def flow_from_dict(d: Dict) -> Flow:
     if isinstance(d.get("flow"), dict):
         # the reference hubble exporter / `hubble observe -o jsonl`
@@ -139,14 +160,22 @@ def flow_from_dict(d: Dict) -> Flow:
             f.protocol = proto
             f.dport = int(l4[proto_name].get("destination_port", 0))
             f.sport = int(l4[proto_name].get("source_port", 0))
+    for proto_name, proto in (("ICMPv4", Protocol.ICMP),
+                              ("ICMPv6", Protocol.ICMPV6)):
+        if proto_name in l4:
+            # flowpb carries {type, code}; the engine keys ICMP rules
+            # by type in the port slot (bpf encodes it the same way)
+            f.protocol = proto
+            f.dport = int(l4[proto_name].get("type", 0))
     l7 = d.get("l7") or {}
     if "http" in l7:
         h = l7["http"]
         f.l7 = L7Type.HTTP
+        url, url_host = split_http_url(h.get("url", ""))
         f.http = HTTPInfo(
             method=h.get("method", ""),
-            path=h.get("url", ""),
-            host=h.get("host", ""),
+            path=url,
+            host=h.get("host", "") or url_host,
             headers=tuple((x.get("key", ""), x.get("value", ""))
                           for x in (h.get("headers") or ())),
             protocol=h.get("protocol", "HTTP/1.1"),

@@ -253,9 +253,35 @@ def synth_mixed_scenario(corpus_dir: str, n_tuples: int = 1_000_000,
         "web": {"tier": "web", "env": "prod"},
         "cache": {"tier": "cache"},
         "bystander": {"app": "bystander"},
+        # realistic/ corpus coverage (round 3): representative
+        # endpoints per namespace so the 1M-tuple stream exercises the
+        # production-shaped rules too
+        "storefront": {"app": "storefront", "tier": "web",
+                       "env": "prod"},
+        "catalog": {"app": "catalog", "tier": "backend", "env": "prod"},
+        "payments": {"app": "payments", "tier": "backend",
+                     "env": "prod"},
+        "orders-db": {"app": "orders-db"},
+        "broker": {"app": "broker"},
+        "orders-svc": {"app": "orders-svc"},
+        "analytics": {"app": "analytics"},
+        "apigw": {"app": "apigw"},
+        "internal": {"zone": "internal"},
+        "team-a": {"team": "a"},
+        "team-b": {"team": "b"},
+        "prom": {"app": "prom"},
+        "ledger": {"app": "ledger", "ns": "fintech"},
+        "transfer-svc": {"app": "transfer-svc", "ns": "fintech"},
+        "registry": {"app": "registry"},
+        "ci-runner": {"app": "ci-runner"},
+        "webapp": {"app": "webapp", "ns": "saas"},
+        "api-paid": {"app": "api", "plan": "paid"},
+        "worker": {"role": "worker"},
+        "tenant-db": {"app": "tenant-db"},
     }
     names = list(endpoints)
-    ports = [80, 443, 5432, 9092, 53, 9100, 9105, 8080]
+    ports = [80, 443, 5432, 9092, 53, 9100, 9105, 8080,
+             8443, 7443, 5000, 6379, 9080, 5672, 50051]
     flows = []
     for _ in range(n_tuples):
         src, dst = rng.choice(names), rng.choice(names)

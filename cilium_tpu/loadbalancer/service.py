@@ -129,6 +129,9 @@ class ServiceManager:
         #: cluster → remote backends (reference: pkg/clustermesh
         #: services sync feeding pkg/service)
         self._remote: Dict[Tuple[str, str], Dict[str, List[Backend]]] = {}
+        #: per-frontend backend-state generation: lets table builds run
+        #: OUTSIDE the lock and detect a concurrent change before swap
+        self._gen: Dict[Frontend, int] = {}
         self._revision = 0
         self.table_size = table_size
         #: fired after every mutation commit — policy `toServices`
@@ -160,14 +163,36 @@ class ServiceManager:
         with self._lock:
             return self._merged_active_locked(svc)
 
-    def _rebuild_table_locked(self, svc: Service) -> None:
-        active = self._merged_active_locked(svc)
-        self._tables[svc.frontend] = maglev_table(
+    def _build_table(self, active: List[Backend]) -> np.ndarray:
+        """Pure maglev permutation — call OUTSIDE the lock (the
+        table-size loop is the expensive part; holding the lock
+        through it would stall concurrent select() datapath calls)."""
+        return maglev_table(
             list(range(len(active))),
             [b.name for b in active],
             m=self.table_size,
             weights=[b.weight for b in active],
         )
+
+    def _rebuild(self, fe: Frontend) -> None:
+        """Build + swap one frontend's table with the maglev loop
+        OUTSIDE the lock; retries if backend state moved underneath."""
+        while True:
+            with self._lock:
+                svc = self._services.get(fe)
+                if svc is None:
+                    return
+                gen = self._gen.get(fe, 0)
+                active = self._merged_active_locked(svc)
+            table = self._build_table(active)
+            with self._lock:
+                if fe not in self._services:
+                    return
+                if self._gen.get(fe, 0) == gen:
+                    self._tables[fe] = table
+                    return
+            # a concurrent mutation bumped the generation: loop with a
+            # fresh snapshot so the stale table never lands
 
     def set_remote_backends(self, cluster: str, namespace: str,
                             name: str, backends: List[Backend]) -> None:
@@ -186,21 +211,24 @@ class ServiceManager:
                 per.pop(cluster, None)
                 if not per:
                     del self._remote[(namespace, name)]
-            changed = False
+            stale = []
             for svc in self._services.values():
                 if (svc.shared and svc.namespace == namespace
                         and svc.name == name):
-                    self._rebuild_table_locked(svc)
-                    changed = True
-            if changed:
+                    self._gen[svc.frontend] = \
+                        self._gen.get(svc.frontend, 0) + 1
+                    stale.append(svc.frontend)
+            if stale:
                 self._revision += 1
-        if changed:
+        for fe in stale:
+            self._rebuild(fe)
+        if stale:
             self._changed()
 
     def remove_remote_cluster(self, cluster: str) -> None:
         """Drop every backend ``cluster`` announced (disconnect)."""
         with self._lock:
-            changed = False
+            stale = []
             for (namespace, name) in list(self._remote):
                 per = self._remote[(namespace, name)]
                 if cluster not in per:
@@ -211,19 +239,23 @@ class ServiceManager:
                 for svc in self._services.values():
                     if (svc.shared and svc.namespace == namespace
                             and svc.name == name):
-                        self._rebuild_table_locked(svc)
-                        changed = True
-            if changed:
+                        self._gen[svc.frontend] = \
+                            self._gen.get(svc.frontend, 0) + 1
+                        stale.append(svc.frontend)
+            if stale:
                 self._revision += 1
-        if changed:
+        for fe in stale:
+            self._rebuild(fe)
+        if stale:
             self._changed()
 
     # -- mutation ---------------------------------------------------------
     def upsert(self, svc: Service) -> None:
         with self._lock:
             self._services[svc.frontend] = svc
-            self._rebuild_table_locked(svc)
+            self._gen[svc.frontend] = self._gen.get(svc.frontend, 0) + 1
             self._revision += 1
+        self._rebuild(svc.frontend)
         METRICS.set_gauge("cilium_tpu_lb_services", float(len(self._services)))
         self._changed()
 
@@ -231,6 +263,7 @@ class ServiceManager:
         with self._lock:
             existed = self._services.pop(frontend, None) is not None
             self._tables.pop(frontend, None)
+            self._gen.pop(frontend, None)
             if existed:
                 self._revision += 1
         METRICS.set_gauge("cilium_tpu_lb_services", float(len(self._services)))
@@ -270,7 +303,10 @@ class ServiceManager:
             affinity=svc.affinity)
         h = int(fnv1a_words(np.asarray(words, dtype=np.uint32)))
         bi = int(table[h % len(table)])
-        if bi < 0:  # empty table (e.g. all backends weight 0)
+        # bi < 0: empty table (all backends weight 0). bi >= len: the
+        # table is being rebuilt outside the lock and this select won
+        # the race against the swap — treat as a miss, never index OOB
+        if bi < 0 or bi >= len(active):
             return None
         return active[bi]
 

@@ -37,6 +37,43 @@ spec:
       rules:
         http:
         - {method: GET, path: "/api/.*"}
+        - method: POST
+          path: "/api/.*"
+          headerMatches:
+          - {name: X-Token, value: secret}
+---
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: broker}
+spec:
+  endpointSelector: {matchLabels: {app: broker}}
+  ingress:
+  - fromEndpoints: [{matchLabels: {app: producer}}]
+    toPorts:
+    - ports: [{port: "9092", protocol: TCP}]
+      rules:
+        kafka:
+        - {role: produce, topic: orders}
+  - fromEndpoints: [{matchLabels: {app: consumer}}]
+    toPorts:
+    - ports: [{port: "9092", protocol: TCP}]
+      rules:
+        kafka:
+        - {role: consume, topic: orders}
+---
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: resolver}
+spec:
+  endpointSelector: {matchLabels: {app: client}}
+  egress:
+  - toEndpoints: [{matchLabels: {k8s-app: kube-dns}}]
+    toPorts:
+    - ports: [{port: "53", protocol: UDP}]
+      rules:
+        dns:
+        - {matchPattern: "*.corp.io"}
+        - {matchName: api.example.com}
 """
 
 
@@ -85,57 +122,149 @@ def test_accesslog_entry_parse():
     assert parse_capture_line({"source": {"identity": 1}}).src_identity == 1
 
 
-def test_golden_reference_capture_replays(tmp_path, capsys):
-    """`cli replay` verdicts the checked-in reference-format capture:
-    identity remap by label makes the foreign ids irrelevant."""
-    cnp_path = tmp_path / "cnp.yaml"
-    cnp_path.write_text(CNP)
-    rc = cli.main(["replay", GOLDEN, "--policy", str(cnp_path),
-                   "--endpoint", "app=service",
-                   "--endpoint", "app=frontend",
-                   "--endpoint", "app=other"])
-    out = capsys.readouterr().out
-    assert rc == 0
-    summary = json.loads(out)
-    assert summary["flows"] == 4
-    # line 1: enveloped flowpb GET /api/x from frontend → REDIRECTED
-    # line 2: bare flowpb DELETE /api/x → L7 deny
-    # line 3: enveloped from app=other (remapped) → no rule → drop
-    # line 4: accesslog GET /api/items with LOCAL numeric ids (no
-    #         labels): ids 0/0 hit no policy → forwarded
-    assert summary["verdicts"] == {"REDIRECTED": 1, "DROPPED": 2,
-                                   "FORWARDED": 1}
+def _fixture_lines():
+    """~54 reference-shaped lines: flowpb bare + hubble-exporter
+    envelope + Envoy accesslog; HTTP (headers, hosts, query strings),
+    Kafka produce/fetch ACL hits and misses, DNS allow/deny, L4-only
+    and drop variants — wide enough to catch schema drift per family
+    (VERDICT r2 item 8)."""
 
+    def fp(src_app, dst_app, dport, envelope, l7=None, proto="TCP",
+           direction="INGRESS", verdict="FORWARDED", src_labels=None):
+        import zlib
 
-def _write_golden():
-    lines = [
-        {"flow": {
-            "traffic_direction": "INGRESS", "verdict": "FORWARDED",
-            "source": {"identity": 90001,
-                       "labels": ["k8s:app=frontend"]},
-            "destination": {"identity": 90002,
-                            "labels": ["k8s:app=service"]},
-            "l4": {"TCP": {"destination_port": 80}},
-            "l7": {"type": "REQUEST",
-                   "http": {"method": "GET", "url": "/api/x"}},
-        }, "node_name": "ref-node-1",
-            "time": "2026-07-30T09:00:00Z"},
-        {"traffic_direction": "INGRESS", "verdict": "FORWARDED",
-         "source": {"identity": 90001, "labels": ["k8s:app=frontend"]},
-         "destination": {"identity": 90002,
-                         "labels": ["k8s:app=service"]},
-         "l4": {"TCP": {"destination_port": 80}},
-         "l7": {"type": "REQUEST",
-                "http": {"method": "DELETE", "url": "/api/x"}}},
-        {"flow": {
-            "traffic_direction": "INGRESS", "verdict": "FORWARDED",
-            "source": {"identity": 90003, "labels": ["k8s:app=other"]},
-            "destination": {"identity": 90002,
-                            "labels": ["k8s:app=service"]},
-            "l4": {"TCP": {"destination_port": 80}},
-            "l7": {"type": "REQUEST",
-                   "http": {"method": "GET", "url": "/api/x"}},
-        }},
+        d = {"traffic_direction": direction, "verdict": verdict,
+             "source": {"identity": 90000 + zlib.crc32(src_app.encode()) % 1000,
+                        "labels": src_labels
+                        or [f"k8s:app={src_app}"]},
+             "destination": {"identity": 91000 + zlib.crc32(dst_app.encode()) % 1000,
+                             "labels": [f"k8s:app={dst_app}"]
+                             if dst_app != "kube-dns" else
+                             ["k8s:k8s-app=kube-dns"]},
+             "l4": ({proto: {"type": dport}}
+                    if proto.startswith("ICMP") else
+                    {proto: {"destination_port": dport}})}
+        if l7 is not None:
+            d["l7"] = l7
+        if envelope:
+            return {"flow": d, "node_name": "ref-node-1",
+                    "time": "2026-07-30T09:00:00Z"}
+        return d
+
+    def http(method, path, headers=None, host=""):
+        h = {"method": method, "url": path}
+        if headers:
+            h["headers"] = [{"key": k, "value": v} for k, v in headers]
+        if host:
+            h["host"] = host
+        return {"type": "REQUEST", "http": h}
+
+    def kafka(api_key, topic, version=3, client="c1"):
+        return {"type": "REQUEST",
+                "kafka": {"api_key": api_key, "api_version": version,
+                          "topic": topic, "client_id": client}}
+
+    def dns(q):
+        return {"type": "REQUEST", "dns": {"query": q}}
+
+    lines = []
+    # ---- HTTP family (alternating envelope/bare) ----
+    lines += [
+        fp("frontend", "service", 80, True,
+           http("GET", "/api/x")),                      # REDIRECTED
+        fp("frontend", "service", 80, False,
+           http("GET", "/api/items?page=2")),           # REDIRECTED
+        fp("frontend", "service", 80, True,
+           http("GET", "/admin")),                      # path: DROP
+        fp("frontend", "service", 80, False,
+           http("POST", "/api/y",
+                headers=[("X-Token", "secret")])),      # REDIRECTED
+        fp("frontend", "service", 80, True,
+           http("POST", "/api/y")),                     # no hdr: DROP
+        fp("frontend", "service", 80, False,
+           http("POST", "/api/y",
+                headers=[("X-Token", "wrong")])),       # hdr: DROP
+        fp("frontend", "service", 80, True,
+           http("POST", "/api/y",
+                headers=[("Accept", "json"),
+                         ("X-Token", "secret")])),      # extra hdrs ok
+        fp("frontend", "service", 80, False,
+           http("DELETE", "/api/x")),                   # method: DROP
+        fp("other", "service", 80, True,
+           http("GET", "/api/x")),                      # peer: DROP
+        fp("frontend", "service", 8080, False,
+           http("GET", "/api/x")),                      # port: DROP
+        fp("frontend", "service", 80, True,
+           http("GET", "/api/x", host="svc.local")),    # host free
+        fp("world-src", "service", 80, False,
+           http("GET", "/api/x"),
+           src_labels=["reserved:world"]),              # world: DROP
+        # real Hubble exporters write ABSOLUTE urls
+        # (pkg/hubble/parser/seven: scheme://host/path) — the path
+        # must still match
+        fp("frontend", "service", 80, True,
+           http("GET", "http://svc.local/api/abs")),    # REDIRECTED
+        fp("frontend", "service", 80, False,
+           http("GET", "https://svc.local/nope")),      # path: DROP
+        fp("frontend", "service", 80, True,
+           http("GET", "http://svc.local/api/q?x=1")),  # query kept
+        # multi-label identity (namespace + app): no local endpoint
+        # carries the EXACT set, so remap falls to identity 0 → DROP
+        # (the conservative foreign-identity rule; cli.py `_remap`)
+        fp("frontend", "service", 80, False,
+           http("GET", "/api/multi"),
+           src_labels=["k8s:io.kubernetes.pod.namespace=default",
+                       "k8s:app=frontend"]),
+    ]
+    # ---- Kafka family ----
+    lines += [
+        fp("producer", "broker", 9092, True, kafka(0, "orders")),
+        fp("producer", "broker", 9092, False, kafka(0, "orders", 5)),
+        fp("producer", "broker", 9092, True, kafka(0, "audit-log")),
+        fp("producer", "broker", 9092, False, kafka(1, "orders")),
+        fp("consumer", "broker", 9092, True, kafka(1, "orders")),
+        fp("consumer", "broker", 9092, False,
+           kafka(1, "orders", client="c9")),
+        fp("consumer", "broker", 9092, True, kafka(0, "orders")),
+        fp("other", "broker", 9092, False, kafka(0, "orders")),
+        fp("producer", "broker", 9093, True, kafka(0, "orders")),
+        fp("producer", "broker", 9092, False, kafka(3, "whatever")),
+    ]
+    # ---- DNS family (egress to the resolver) ----
+    lines += [
+        fp("client", "kube-dns", 53, True, dns("docs.corp.io"),
+           proto="UDP", direction="EGRESS"),
+        fp("client", "kube-dns", 53, False, dns("wiki.corp.io."),
+           proto="UDP", direction="EGRESS"),
+        fp("client", "kube-dns", 53, True, dns("api.example.com"),
+           proto="UDP", direction="EGRESS"),
+        fp("client", "kube-dns", 53, False, dns("deep.sub.corp.io"),
+           proto="UDP", direction="EGRESS"),
+        fp("client", "kube-dns", 53, True, dns("evil.attacker.net"),
+           proto="UDP", direction="EGRESS"),
+        fp("client", "kube-dns", 53, False, dns("corp.io"),
+           proto="UDP", direction="EGRESS"),
+        fp("other", "kube-dns", 53, True, dns("docs.corp.io"),
+           proto="UDP", direction="EGRESS"),
+        fp("client", "kube-dns", 5353, False, dns("docs.corp.io"),
+           proto="UDP", direction="EGRESS"),
+    ]
+    # ---- L4-only + drop-verdict variants ----
+    lines += [
+        fp("frontend", "service", 80, True),       # L7 port, no L7 rec
+        fp("frontend", "service", 81, False),      # port: DROP
+        fp("other", "producer", 12345, True),      # no policy: FWD
+        fp("frontend", "service", 80, False, verdict="DROPPED"),
+        fp("frontend", "service", 80, True, proto="UDP"),
+        fp("producer", "broker", 9092, False),     # kafka port, no rec
+        fp("frontend", "broker", 22, True),        # default-deny
+        fp("client", "kube-dns", 53, False, proto="UDP",
+           direction="EGRESS"),                    # dns port, no rec
+        fp("frontend", "service", 8, True, proto="ICMPv4"),
+        fp("frontend", "service", 443, False, proto="SCTP"),
+    ]
+    # ---- Envoy accesslog entries (local numeric ids) ----
+    lines += [
         {"entry_type": "Request", "is_ingress": True,
          "timestamp": "2026-07-30T09:00:02Z",
          "source_security_id": 0, "destination_security_id": 0,
@@ -143,10 +272,132 @@ def _write_golden():
          "destination_address": "10.0.0.2:80",
          "http": {"http_protocol": "HTTP/1.1", "host": "svc.local",
                   "path": "/api/items", "method": "GET"}},
+        {"entry_type": "Request", "is_ingress": True,
+         "timestamp": "2026-07-30T09:00:03Z",
+         "source_security_id": 0, "destination_security_id": 0,
+         "source_address": "10.0.0.9:51335",
+         "destination_address": "10.0.0.2:80",
+         "http": {"method": "POST", "path": "/api/y",
+                  "headers": [{"key": "X-Token",
+                               "value": "secret"}]}},
+        {"entry_type": "Denied", "is_ingress": True,
+         "timestamp": "2026-07-30T09:00:04Z",
+         "source_security_id": 0, "destination_security_id": 0,
+         "destination_address": "10.0.0.2:80",
+         "http": {"method": "GET", "path": "/blocked"}},
+        {"entry_type": "Request", "is_ingress": True,
+         "timestamp": "2026-07-30T09:00:05Z",
+         "source_security_id": 0, "destination_security_id": 0,
+         "destination_address": "10.0.0.5:9092",
+         "kafka": {"api_key": 0, "api_version": 3, "topic": "orders",
+                   "client_id": "al-1"}},
+        {"entry_type": "Request", "is_ingress": False,
+         "timestamp": "2026-07-30T09:00:06Z",
+         "source_security_id": 0, "destination_security_id": 0,
+         "destination_address": "10.0.0.53:53",
+         "dns": {"query": "docs.corp.io"}},
+        {"entry_type": "Request", "is_ingress": True,
+         "timestamp": "2026-07-30T09:00:07Z",
+         "source_security_id": 0, "destination_security_id": 0,
+         "source_address": "[2001:db8::9]:4242",
+         "destination_address": "[2001:db8::2]:80",
+         "http": {"method": "GET", "path": "/api/v6"}},
     ]
+    return lines
+
+
+GOLDEN_VERDICTS = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "golden",
+    "reference_capture_verdicts.json")
+
+#: the endpoints the replay agent registers; capture labels remap onto
+#: these (foreign numeric ids are irrelevant by design)
+_ENDPOINTS = ("service", "frontend", "other", "broker", "producer",
+              "consumer", "client")
+
+
+def _replay_args(cnp_path):
+    args = ["--policy", str(cnp_path)]
+    for app in _ENDPOINTS:
+        args += ["--endpoint", f"app={app}"]
+    args += ["--endpoint", "k8s-app=kube-dns"]
+    return args
+
+
+def test_golden_reference_capture_per_line_verdicts(tmp_path, capsys):
+    """Every fixture line's verdict is pinned individually: schema
+    drift in ANY family (http/kafka/dns/accesslog, either envelope)
+    breaks exactly the affected lines."""
+    import numpy as np
+
+    from cilium_tpu.agent import Agent
+    from cilium_tpu.auth import AUTH_UNENFORCED
+    from cilium_tpu.core.config import Config
+    from cilium_tpu.policy.api.cnp import load_cnp_yaml_text
+
+    with open(GOLDEN) as fp:
+        raw = [json.loads(s) for s in fp if s.strip()]
+    assert len(raw) >= 50
+    with open(GOLDEN_VERDICTS) as fp:
+        want = json.load(fp)
+    assert len(want) == len(raw)
+
+    cfg = Config()
+    cfg.configure_logging = False
+    agent = Agent(cfg)
+    try:
+        for i, app in enumerate(_ENDPOINTS):
+            agent.endpoint_add(100 + i, {"app": app})
+        agent.endpoint_add(200, {"k8s-app": "kube-dns"})
+        for cnp in load_cnp_yaml_text(CNP):
+            agent.policy_add(cnp)
+        flows = [parse_capture_line(d) for d in raw]
+        # label remap, as cli replay does
+        by_label = {}
+        for nid, lbls in agent.selector_cache.identities().items():
+            for lbl in lbls:
+                by_label[lbl.format()] = nid
+        for f in flows:
+            if f.src_labels:
+                f.src_identity = by_label.get(f.src_labels[0], 0)
+            if f.dst_labels:
+                f.dst_identity = by_label.get(f.dst_labels[0], 0)
+        out = agent.loader.engine.verdict_flows(
+            flows, authed_pairs=AUTH_UNENFORCED)
+        got = [Verdict(int(v)).name for v in out["verdict"]]
+        assert got == want, [
+            (i, raw[i], got[i], want[i])
+            for i in range(len(got)) if got[i] != want[i]][:5]
+    finally:
+        agent.stop()
+
+
+def test_golden_reference_capture_replays(tmp_path, capsys):
+    """`cli replay` aggregate over the same fixture (the CLI path:
+    parse, remap, verdict, summarize)."""
+    cnp_path = tmp_path / "cnp.yaml"
+    cnp_path.write_text(CNP)
+    rc = cli.main(["replay", GOLDEN] + _replay_args(cnp_path))
+    out = capsys.readouterr().out
+    assert rc == 0
+    summary = json.loads(out)
+    with open(GOLDEN_VERDICTS) as fp:
+        want = json.load(fp)
+    assert summary["flows"] == len(want)
+    from collections import Counter
+    assert summary["verdicts"] == dict(Counter(want))
+
+
+def _write_golden():
+    lines = _fixture_lines()
     with open(GOLDEN, "w") as fp:
         for line in lines:
             fp.write(json.dumps(line) + "\n")
+    # compute + pin per-line verdicts via the same path the test uses
+    import subprocess
+    import sys as _sys
+    print(f"wrote {GOLDEN}: {len(lines)} lines; now run the per-line "
+          f"test once to fill {GOLDEN_VERDICTS}")
 
 
 if __name__ == "__main__":

@@ -76,11 +76,100 @@ ENDPOINTS = [
     (33, "kafka-metrics", {"app": "kafka-metrics"}),
     (34, "analytics", {"app": "analytics"}),
     (35, "pinned-client", {"app": "pinned-client"}),
+    # round-3 realistic corpus (examples/policies/realistic/): ~55
+    # endpoints across 7 production-shaped namespaces; appended so the
+    # earlier identity allocation is unchanged
+    # -- ecommerce --
+    (36, "gateway-ec", {"app": "gateway", "env": "prod"}),
+    (37, "storefront", {"app": "storefront", "tier": "web",
+                        "env": "prod"}),
+    (38, "catalog", {"app": "catalog", "tier": "backend",
+                     "env": "prod"}),
+    (39, "cart", {"app": "cart", "tier": "backend", "env": "prod"}),
+    (40, "payments-ec", {"app": "payments", "tier": "backend",
+                         "env": "prod"}),
+    (41, "orders-db", {"app": "orders-db"}),
+    (42, "cache-redis", {"app": "cache-redis"}),
+    (43, "search-ec", {"app": "search", "env": "prod"}),
+    (44, "reindexer", {"app": "reindexer", "env": "prod"}),
+    (45, "fraud-ec", {"app": "fraud"}),
+    (46, "email", {"app": "email"}),
+    (47, "metrics-pusher", {"app": "metrics-pusher"}),
+    (48, "legacy-crawler", {"app": "legacy-crawler", "env": "prod"}),
+    (49, "payments-staging", {"app": "payments", "env": "staging"}),
+    # -- streaming --
+    (50, "broker", {"app": "broker"}),
+    (51, "orders-svc", {"app": "orders-svc"}),
+    (52, "web-tracker", {"app": "web-tracker"}),
+    (53, "warehouse", {"app": "warehouse"}),
+    (54, "analytics2", {"app": "analytics"}),
+    (55, "zookeeper", {"app": "zookeeper"}),
+    (56, "schema-registry", {"app": "schema-registry"}),
+    (57, "streaming-client", {"ns": "streaming"}),
+    (58, "ci-deployer", {"app": "ci-deployer"}),
+    (59, "kafka-exporter", {"app": "kafka-exporter"}),
+    (60, "prom", {"app": "prom"}),
+    # -- edge / apigw --
+    (61, "apigw2", {"app": "apigw"}),
+    (62, "partner-proxy", {"app": "partner-proxy"}),
+    (63, "internal-client", {"zone": "internal"}),
+    (64, "ops-console", {"app": "ops-console"}),
+    # -- tenants --
+    (65, "tenant-ingress", {"app": "tenant-ingress", "env": "prod"}),
+    (66, "tenant-ingress-stg", {"app": "tenant-ingress",
+                                "env": "staging"}),
+    (67, "web-tenant", {"tier": "web", "ns": "tenants"}),
+    (68, "team-a-1", {"team": "a"}),
+    (69, "team-a-batch", {"team": "a", "role": "batch"}),
+    (70, "team-b-1", {"team": "b"}),
+    (71, "team-b-api", {"team": "b", "role": "api"}),
+    (72, "staging-pod", {"env": "staging"}),
+    (73, "shared-proxy", {"app": "shared-proxy"}),
+    (74, "tenant-dns", {"app": "tenant-dns"}),
+    # -- monitoring --
+    (75, "node-agent", {"app": "node-agent"}),
+    (76, "pushgw", {"app": "pushgw"}),
+    (77, "grafana", {"app": "grafana"}),
+    (78, "alertmanager", {"app": "alertmanager"}),
+    (79, "loki", {"app": "loki"}),
+    (80, "promtail", {"app": "promtail"}),
+    (81, "job-runner", {"kind": "job"}),
+    # -- fintech --
+    (82, "ledger", {"app": "ledger", "ns": "fintech"}),
+    (83, "ledger-replica", {"app": "ledger", "role": "replica",
+                            "ns": "fintech"}),
+    (84, "transfer-svc", {"app": "transfer-svc", "ns": "fintech"}),
+    (85, "payment-api", {"app": "payment-api", "ns": "fintech"}),
+    (86, "reporting", {"app": "reporting", "ns": "fintech"}),
+    (87, "compliance-tap", {"app": "compliance-tap"}),
+    (88, "vault-sidecar", {"app": "vault-sidecar", "ns": "fintech"}),
+    (89, "feature-store", {"app": "feature-store", "ns": "fintech"}),
+    (90, "fraud-model", {"app": "fraud-model"}),
+    (91, "edge-pod", {"zone": "edge"}),
+    # -- platform --
+    (92, "registry2", {"app": "registry"}),
+    (93, "ci-runner", {"app": "ci-runner"}),
+    (94, "ci-controller", {"app": "ci-controller"}),
+    (95, "kubelet-puller", {"kind": "kubelet-puller"}),
+    (96, "artifact-cache", {"app": "artifact-cache"}),
+    (97, "webhook-rx", {"app": "webhook-rx"}),
+    # -- saas --
+    (98, "webapp2", {"app": "webapp", "ns": "saas"}),
+    (99, "ingress-lb", {"app": "ingress-lb"}),
+    (100, "api-free", {"app": "api", "plan": "free"}),
+    (101, "api-paid", {"app": "api", "plan": "paid"}),
+    (102, "ws-hub", {"app": "ws-hub"}),
+    (103, "jobqueue", {"app": "jobqueue"}),
+    (104, "worker", {"role": "worker"}),
+    (105, "billing-bridge", {"app": "billing-bridge"}),
+    (106, "tenant-db", {"app": "tenant-db"}),
+    (107, "asset-origin", {"app": "asset-origin"}),
+    (108, "search-idx", {"app": "search-idx"}),
 ]
 
 #: container port names (named-port corpus policies resolve against
 #: these at regeneration)
-NAMED_PORTS = {"webapp": {"http": 8080}}
+NAMED_PORTS = {"webapp": {"http": 8080}, "apigw2": {"metrics": 15020}}
 
 #: CIDR identities the corpus CIDR(-except) policies match; fixed
 #: upsert order keeps local-scope id allocation deterministic
@@ -89,6 +178,10 @@ CIDRS = [
     ("quarantine", "172.20.1.9/32"),   # inside the 172.20/16 except
     ("collector", "192.0.2.10/32"),    # in 192.0.2.0/24
     ("honeypot", "192.0.2.250/32"),    # inside the 192.0.2.240/28 except
+    # round-3 realistic corpus destinations (appended; order frozen)
+    ("mp-collector", "198.51.100.10/32"),   # metrics VPC, allowed
+    ("mp-honeypot", "198.51.100.130/32"),   # inside the /28 except
+    ("partner-api", "203.0.113.5/32"),      # payments partner range
 ]
 
 
@@ -258,6 +351,252 @@ def build_flows(ids):
         # the wildcard pod policies must NOT have attached to the host
         # endpoint, nor the host CCNP to any pod
         f("frontend", "metricsd", 22),
+        # ---- round-3 realistic corpus (appended; prefix frozen) ----
+        # ecommerce: storefront L7 via gateway
+        f("gateway-ec", "storefront", 8080, l7=L7Type.HTTP,
+          http=http("GET", "/products/42")),
+        f("gateway-ec", "storefront", 8080, l7=L7Type.HTTP,
+          http=http("POST", "/checkout/cart-9")),
+        f("gateway-ec", "storefront", 8080, l7=L7Type.HTTP,
+          http=http("POST", "/account/delete")),   # POST not /checkout
+        f("legacy-crawler", "storefront", 8080),   # explicit deny
+        f("catalog", "storefront", 8080),          # not a listed peer
+        # catalog paths
+        f("storefront", "catalog", 8080, l7=L7Type.HTTP,
+          http=http("GET", "/api/products?page=2")),
+        f("storefront", "catalog", 8080, l7=L7Type.HTTP,
+          http=http("GET", "/api/categories/7")),
+        f("storefront", "catalog", 8080, l7=L7Type.HTTP,
+          http=http("DELETE", "/api/products/1")),  # method
+        f("search-ec", "catalog", 8080),            # plain L4 allow
+        f("cart", "catalog", 8080),                 # cart not allowed
+        # cart CRUD
+        f("storefront", "cart", 8080, l7=L7Type.HTTP,
+          http=http("DELETE", "/cart/7/items/2")),
+        f("storefront", "cart", 8080, l7=L7Type.HTTP,
+          http=http("PUT", "/cart/7")),             # PUT not in verbs
+        # payments: cart + auth required (no handshake → fail closed),
+        # storefront and world explicitly denied
+        f("cart", "payments-ec", 8443),
+        f("storefront", "payments-ec", 8443),
+        f(WORLD, "payments-ec", 8443),
+        f("fraud-ec", "payments-ec", 8443),         # not a peer
+        # orders-db tier access
+        f("catalog", "orders-db", 5432),
+        f("cart", "orders-db", 5432),
+        f("payments-ec", "orders-db", 5432),
+        f("storefront", "orders-db", 5432),         # web tier: no
+        f("catalog", "orders-db", 5433),            # wrong port
+        # cache: backend tier allowed on 6379, admin port denied to all
+        f("catalog", "cache-redis", 6379),
+        f("payments-ec", "cache-redis", 6379),
+        f("storefront", "cache-redis", 6379),       # tier=web: no
+        f("catalog", "cache-redis", 16379),         # admin port deny
+        # search range 9200-9299
+        f("catalog", "search-ec", 9200),
+        f("reindexer", "search-ec", 9250),
+        f("catalog", "search-ec", 9300),            # past endPort
+        f("storefront", "search-ec", 9200),         # wrong peer
+        # fraud requires env=prod on the payments peer
+        f("payments-ec", "fraud-ec", 9000),
+        f("payments-staging", "fraud-ec", 9000),
+        # gateway ← world + cluster on 443
+        f(WORLD, "gateway-ec", 443),
+        f("storefront", "gateway-ec", 443),
+        f(WORLD, "gateway-ec", 8443),
+        # email DNS allowlist
+        Flow(src_identity=ids["email"], dst_identity=ids["kube-dns"],
+             dport=53, protocol=Protocol.UDP,
+             direction=TrafficDirection.EGRESS, l7=L7Type.DNS,
+             dns=DNSInfo(query="smtp.mailgun.org")),
+        Flow(src_identity=ids["email"], dst_identity=ids["kube-dns"],
+             dport=53, protocol=Protocol.UDP,
+             direction=TrafficDirection.EGRESS, l7=L7Type.DNS,
+             dns=DNSInfo(query="api.sendgrid.net")),
+        Flow(src_identity=ids["email"], dst_identity=ids["kube-dns"],
+             dport=53, protocol=Protocol.UDP,
+             direction=TrafficDirection.EGRESS, l7=L7Type.DNS,
+             dns=DNSInfo(query="exfil.attacker.io")),
+        # metrics-pusher CIDR-except egress
+        f("metrics-pusher", "mp-collector", 4317,
+          direction=TrafficDirection.EGRESS),
+        f("metrics-pusher", "mp-honeypot", 4317,
+          direction=TrafficDirection.EGRESS),
+        # prod backend tier → partner CIDR
+        f("payments-ec", "partner-api", 443,
+          direction=TrafficDirection.EGRESS),
+        f("storefront", "partner-api", 443,
+          direction=TrafficDirection.EGRESS),  # web tier: not granted
+        # streaming: per-topic ACLs
+        f("orders-svc", "broker", 9092, l7=L7Type.KAFKA,
+          kafka=kafka(0, "order-events")),
+        f("orders-svc", "broker", 9092, l7=L7Type.KAFKA,
+          kafka=kafka(0, "click-events")),          # wrong topic
+        f("web-tracker", "broker", 9092, l7=L7Type.KAFKA,
+          kafka=KafkaInfo(api_key=0, api_version=3,
+                          topic="click-events", client_id="tracker")),
+        f("web-tracker", "broker", 9092, l7=L7Type.KAFKA,
+          kafka=KafkaInfo(api_key=0, api_version=3,
+                          topic="click-events", client_id="rogue")),
+        f("warehouse", "broker", 9092, l7=L7Type.KAFKA,
+          kafka=kafka(1, "order-events")),
+        f("analytics2", "broker", 9092, l7=L7Type.KAFKA,
+          kafka=kafka(1, "click-events")),
+        f("warehouse", "broker", 9092, l7=L7Type.KAFKA,
+          kafka=kafka(1, "click-events")),          # warehouse: no
+        f("analytics2", "broker", 9092, l7=L7Type.KAFKA,
+          kafka=kafka(0, "order-events")),          # consumer producing
+        f("broker", "broker", 9093),                # replication port
+        f(WORLD, "broker", 9092),                   # world denied
+        f("broker", "zookeeper", 2181),
+        f("analytics2", "zookeeper", 2181),         # broker-only
+        # schema registry: ns-wide reads, CI-only writes
+        f("streaming-client", "schema-registry", 8081, l7=L7Type.HTTP,
+          http=http("GET", "/subjects")),
+        f("streaming-client", "schema-registry", 8081, l7=L7Type.HTTP,
+          http=http("POST", "/subjects/orders-value/versions")),
+        f("ci-deployer", "schema-registry", 8081, l7=L7Type.HTTP,
+          http=http("POST", "/subjects/orders-value/versions")),
+        f("prom", "kafka-exporter", 9308),
+        f("grafana", "kafka-exporter", 9308),       # prom only
+        # apigw: FAIL-gated partner key, LOG-only trace header
+        f("partner-proxy", "apigw2", 8080, l7=L7Type.HTTP,
+          http=http("GET", "/v2/report",
+                    [("X-Api-Key", "partner-k1"),
+                     ("X-Trace-Id", "t-1")])),
+        f("partner-proxy", "apigw2", 8080, l7=L7Type.HTTP,
+          http=http("GET", "/v2/report",
+                    [("X-Api-Key", "partner-k1")])),  # LOG missing: ok
+        f("partner-proxy", "apigw2", 8080, l7=L7Type.HTTP,
+          http=http("GET", "/v2/report",
+                    [("X-Api-Key", "wrong")])),       # FAIL gate
+        f("internal-client", "apigw2", 8080, l7=L7Type.HTTP,
+          http=http("PUT", "/v1/things/3")),
+        f("internal-client", "apigw2", 8080, l7=L7Type.HTTP,
+          http=http("GET", "/v3/things")),            # no v3
+        f("ops-console", "apigw2", 8080, l7=L7Type.HTTP,
+          http=HTTPInfo(method="DELETE", path="/admin/keys/1",
+                        host="admin.edge.internal")),
+        f("internal-client", "apigw2", 8080, l7=L7Type.HTTP,
+          http=HTTPInfo(method="GET", path="/admin/keys",
+                        host="admin.edge.internal")),  # ops only
+        f("frontend", "apigw2", 8080, l7=L7Type.HTTP,
+          http=http("GET", "/healthz")),               # cluster probe
+        f("prom", "apigw2", 15020),                    # named port
+        f("prom", "apigw2", 15021),
+        # tenants: overlapping selectors + requires
+        f("tenant-ingress", "web-tenant", 8500),
+        f("tenant-ingress-stg", "web-tenant", 8500),   # requires prod
+        f("tenant-ingress", "web-tenant", 9500),       # past range
+        f("team-a-1", "team-a-batch", 7777),           # team-a any port
+        f("team-b-1", "team-a-1", 7777),               # cross-team: no
+        f("team-b-1", "team-b-api", 50051),
+        f("team-b-1", "team-b-api", 50052),            # only gRPC port
+        f("team-a-1", "team-b-api", 8088, l7=L7Type.HTTP,
+          http=http("GET", "/shared/reports")),
+        f("team-a-1", "team-b-api", 8088, l7=L7Type.HTTP,
+          http=http("POST", "/shared/reports")),       # read-only
+        f("staging-pod", "team-b-1", 50051),           # staging denied
+        f("team-a-1", "shared-proxy", 3128),
+        f("team-b-1", "shared-proxy", 3128),
+        f("team-a-1", "shared-proxy", 8, proto=Protocol.ICMP),
+        f("team-b-1", "shared-proxy", 8, proto=Protocol.ICMP),  # a only
+        Flow(src_identity=ids["team-a-1"],
+             dst_identity=ids["tenant-dns"], dport=53,
+             protocol=Protocol.UDP, direction=TrafficDirection.EGRESS,
+             l7=L7Type.DNS,
+             dns=DNSInfo(query="db.tenants.svc.cluster.local")),
+        Flow(src_identity=ids["team-b-1"],
+             dst_identity=ids["tenant-dns"], dport=53,
+             protocol=Protocol.UDP, direction=TrafficDirection.EGRESS,
+             l7=L7Type.DNS,
+             dns=DNSInfo(query="evil.example.com")),
+        # monitoring
+        f("prom", "node-agent", 9100),
+        f("prom", "node-agent", 9104),
+        f("grafana", "node-agent", 9100),              # prom only
+        f("job-runner", "pushgw", 9091, l7=L7Type.HTTP,
+          http=http("POST", "/metrics/job/nightly-etl")),
+        f("job-runner", "pushgw", 9091, l7=L7Type.HTTP,
+          http=http("DELETE", "/metrics/job/nightly-etl")),
+        f("grafana", "prom", 9090, l7=L7Type.HTTP,
+          http=http("GET", "/api/v1/query?q=up")),
+        f("prom", "grafana", 9090),                    # not reversed
+        f("ops-console", "grafana", 3000),             # auth: no table
+        f("promtail", "loki", 3100),
+        f(WORLD, "loki", 3100),
+        f("job-runner", "loki", 3100),
+        # fintech
+        f("transfer-svc", "ledger", 7443, l7=L7Type.HTTP,
+          http=http("POST", "/ledger/entries")),       # auth fail-closed
+        f("reporting", "ledger-replica", 7443, l7=L7Type.HTTP,
+          http=http("GET", "/ledger/entries/abc-123")),
+        f("reporting", "ledger", 7443, l7=L7Type.HTTP,
+          http=http("GET", "/ledger/entries/abc-123")),  # not replica
+        f("edge-pod", "payment-api", 8443, l7=L7Type.HTTP,
+          http=http("POST", "/v1/payments",
+                    [("X-Idempotency-Key", "k-7")])),
+        f("edge-pod", "payment-api", 8443, l7=L7Type.HTTP,
+          http=http("POST", "/v1/payments")),          # header required
+        f("compliance-tap", "ledger", 7443),
+        f("compliance-tap", "transfer-svc", 7000),
+        f("staging-pod", "ledger", 7443),              # staging denied
+        f("transfer-svc", "vault-sidecar", 8200),
+        f("edge-pod", "vault-sidecar", 8200),          # edge denied
+        f("fraud-model", "feature-store", 6565),
+        f("transfer-svc", "feature-store", 6565),
+        f("reporting", "feature-store", 6565),         # not a peer
+        # platform: registry pull/push split
+        f("ci-runner", "registry2", 5000, l7=L7Type.HTTP,
+          http=http("GET", "/v2/app/manifests/latest")),
+        f("kubelet-puller", "registry2", 5000, l7=L7Type.HTTP,
+          http=http("HEAD", "/v2/app/blobs/sha256:aa")),
+        f("ci-runner", "registry2", 5000, l7=L7Type.HTTP,
+          http=http("PUT", "/v2/app/manifests/latest")),  # push: no
+        f("ci-controller", "registry2", 5000, l7=L7Type.HTTP,
+          http=http("PUT", "/v2/app/manifests/latest")),
+        f("ci-controller", "registry2", 5001),
+        f("ci-runner", "registry2", 5001),             # GC port deny
+        f("ci-runner", "artifact-cache", 31500),
+        f("ci-runner", "artifact-cache", 32500),       # past range
+        f("ci-controller", "ci-runner", 8079),
+        f("ci-controller", "ci-runner", 22),           # SSH denied all
+        f(WORLD, "webhook-rx", 443),
+        f("ci-runner", "webhook-rx", 443),
+        # saas: vhosts, plans, queue, db rails
+        f("ingress-lb", "webapp2", 8080, l7=L7Type.HTTP,
+          http=HTTPInfo(method="POST", path="/login",
+                        host="app.saas.io")),
+        f("ingress-lb", "webapp2", 8080, l7=L7Type.HTTP,
+          http=HTTPInfo(method="POST", path="/login",
+                        host="docs.saas.io")),         # docs is GET-only
+        f("webapp2", "api-free", 9080, l7=L7Type.HTTP,
+          http=http("GET", "/api/items")),
+        f("webapp2", "api-free", 9080, l7=L7Type.HTTP,
+          http=http("POST", "/api/items")),            # free plan: RO
+        f("webapp2", "api-paid", 9080, l7=L7Type.HTTP,
+          http=http("PATCH", "/api/items/9")),
+        f("staging-pod", "api-paid", 9080),            # staging denied
+        f("webapp2", "ws-hub", 9090),
+        f("api-paid", "ws-hub", 9090),
+        f("worker", "jobqueue", 5672),
+        f("worker", "jobqueue", 15672),                # admin denied
+        f("api-paid", "billing-bridge", 4000),         # auth fail-closed
+        f("api-paid", "tenant-db", 5432),
+        f("worker", "tenant-db", 5432),
+        f("webapp2", "tenant-db", 5432),               # web deny rail
+        f(WORLD, "asset-origin", 443, l7=L7Type.HTTP,
+          http=http("GET", "/assets/0a1b2c/logo.png")),
+        f(WORLD, "asset-origin", 443, l7=L7Type.HTTP,
+          http=http("POST", "/assets/0a1b2c/logo.png")),
+        f("worker", "search-idx", 9201, l7=L7Type.HTTP,
+          http=http("POST", "/_bulk")),
+        f("api-paid", "search-idx", 9201, l7=L7Type.HTTP,
+          http=http("GET", "/products/_search")),
+        f("api-paid", "search-idx", 9201, l7=L7Type.HTTP,
+          http=http("POST", "/_bulk")),                # writer role only
+        f("prom", "webapp2", 15090),                   # sidecar scrape
+        f("grafana", "webapp2", 15090),
     ]
 
 

@@ -1,0 +1,166 @@
+"""Protobuf flow decode (VERDICT r2 item 10): minimal wire reader so
+real Hubble pb captures replay — no protoc. The acceptance bar: a pb
+fixture replays to the SAME verdicts as its JSONL twin.
+"""
+
+import json
+
+from cilium_tpu import cli
+from cilium_tpu.core.flow import (
+    DNSInfo,
+    Flow,
+    HTTPInfo,
+    KafkaInfo,
+    L7Type,
+    Protocol,
+    TrafficDirection,
+    Verdict,
+)
+from cilium_tpu.ingest import flowpb
+from cilium_tpu.ingest.hubble import flow_to_dict
+
+
+def sample_flows():
+    return [
+        Flow(src_identity=101, dst_identity=202, dport=80, sport=4444,
+             l7=L7Type.HTTP, verdict=Verdict.FORWARDED, time=1234.5,
+             http=HTTPInfo(method="POST", path="/api/y?q=1",
+                           host="svc.local",
+                           headers=(("X-Token", "secret"),
+                                    ("Accept", "json")))),
+        Flow(src_identity=103, dst_identity=204, dport=9092,
+             l7=L7Type.KAFKA,
+             kafka=KafkaInfo(api_key=1, api_version=7, topic="orders",
+                             correlation_id=42)),
+        Flow(src_identity=105, dst_identity=206, dport=53,
+             protocol=Protocol.UDP, direction=TrafficDirection.EGRESS,
+             l7=L7Type.DNS, dns=DNSInfo(query="docs.corp.io")),
+        Flow(src_identity=107, dst_identity=208, dport=8,
+             protocol=Protocol.ICMP),
+        Flow(src_identity=109, dst_identity=210, dport=443,
+             direction=TrafficDirection.EGRESS,
+             verdict=Verdict.DROPPED),
+    ]
+
+
+def test_roundtrip_preserves_engine_fields():
+    for orig in sample_flows():
+        back = flowpb.decode_flow(flowpb.encode_flow(orig))
+        assert back.src_identity == orig.src_identity
+        assert back.dst_identity == orig.dst_identity
+        assert back.dport == orig.dport
+        assert back.protocol == orig.protocol
+        assert back.direction == orig.direction
+        assert back.l7 == orig.l7
+        if orig.http:
+            assert back.http.method == orig.http.method
+            assert back.http.path == orig.http.path
+            assert back.http.headers == orig.http.headers
+        if orig.kafka:
+            assert back.kafka.api_key == orig.kafka.api_key
+            assert back.kafka.api_version == orig.kafka.api_version
+            assert back.kafka.topic == orig.kafka.topic
+        if orig.dns:
+            assert back.dns.query == orig.dns.query
+    # time survives via the Timestamp submessage
+    f = sample_flows()[0]
+    assert abs(flowpb.decode_flow(flowpb.encode_flow(f)).time
+               - 1234.5) < 1e-6
+
+
+def test_absolute_url_splits_like_jsonl_path():
+    f = Flow(dport=80, l7=L7Type.HTTP,
+             http=HTTPInfo(method="GET",
+                           path="http://svc.local/api/x?p=2"))
+    back = flowpb.decode_flow(flowpb.encode_flow(f))
+    assert back.http.path == "/api/x?p=2"
+    assert back.http.host == "svc.local"
+
+
+def test_unknown_fields_skip_cleanly():
+    """A capture from a NEWER schema (extra fields of every wire type)
+    must still decode the subset we consume."""
+    msg = bytearray(flowpb.encode_flow(sample_flows()[0]))
+    flowpb._tag(msg, 99, flowpb._VARINT)
+    flowpb._write_varint(msg, 12345)
+    flowpb._tag(msg, 100, flowpb._I64)
+    msg += b"\x01\x02\x03\x04\x05\x06\x07\x08"
+    flowpb._put_len(msg, 101, b"opaque-submessage")
+    flowpb._tag(msg, 102, flowpb._I32)
+    msg += b"\xaa\xbb\xcc\xdd"
+    back = flowpb.decode_flow(bytes(msg))
+    assert back.http.method == "POST"
+    assert back.dst_identity == 202
+
+
+def test_pb_capture_replays_like_jsonl_twin(tmp_path, capsys):
+    """The acceptance differential: identical flows through the pb
+    stream and the JSONL exporter format produce identical replay
+    summaries (same policy, same endpoints)."""
+    flows = []
+    for i in range(30):
+        kind = i % 3
+        labels = ["k8s:app=frontend"] if i % 2 == 0 \
+            else ["k8s:app=other"]
+        if kind == 0:
+            f = Flow(dport=80, l7=L7Type.HTTP,
+                     http=HTTPInfo(method="GET",
+                                   path=f"/api/item{i}"))
+        elif kind == 1:
+            f = Flow(dport=80, l7=L7Type.HTTP,
+                     http=HTTPInfo(method="DELETE", path="/api/x"))
+        else:
+            f = Flow(dport=81)
+        f.src_labels = tuple(labels)
+        f.dst_labels = ("k8s:app=service",)
+        f.src_identity = 90000 + i
+        f.dst_identity = 91000
+        flows.append(f)
+
+    pb_path = str(tmp_path / "cap.pb")
+    assert flowpb.write_pb_capture(pb_path, flows) == 30
+    jsonl_path = tmp_path / "cap.jsonl"
+    jsonl_path.write_text("\n".join(
+        json.dumps(flow_to_dict(f)) for f in flows) + "\n")
+
+    cnp = tmp_path / "p.yaml"
+    cnp.write_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: t}
+spec:
+  endpointSelector: {matchLabels: {app: service}}
+  ingress:
+  - fromEndpoints: [{matchLabels: {app: frontend}}]
+    toPorts: [{ports: [{port: "80", protocol: TCP}],
+               rules: {http: [{method: GET, path: "/api/.*"}]}}]
+""")
+    base = ["--policy", str(cnp), "--endpoint", "app=service",
+            "--endpoint", "app=frontend", "--endpoint", "app=other"]
+    assert cli.main(["replay", pb_path] + base) == 0
+    pb_summary = json.loads(capsys.readouterr().out)
+    assert cli.main(["replay", str(jsonl_path)] + base) == 0
+    jsonl_summary = json.loads(capsys.readouterr().out)
+    assert pb_summary == jsonl_summary
+    assert pb_summary["flows"] == 30
+    assert len(pb_summary["verdicts"]) > 1  # a real mix
+
+    # cursor/limit protocol works over pb streams too
+    assert cli.main(["replay", pb_path, "--limit", "10"] + base) == 0
+    assert json.loads(capsys.readouterr().out)["flows"] == 10
+
+
+def test_sniffer_rejects_other_formats(tmp_path):
+    from cilium_tpu.ingest import binary
+
+    pb_path = str(tmp_path / "c.pb")
+    flowpb.write_pb_capture(pb_path, sample_flows())
+    assert flowpb.looks_like_pb_capture(pb_path)
+
+    jsonl = tmp_path / "c.jsonl"
+    jsonl.write_text('{"flow": {}}\n')
+    assert not flowpb.looks_like_pb_capture(str(jsonl))
+
+    ct = str(tmp_path / "c.bin")
+    binary.write_capture(ct, sample_flows()[:1])
+    assert not flowpb.looks_like_pb_capture(ct)
