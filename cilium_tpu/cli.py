@@ -296,6 +296,21 @@ def cmd_capture(args) -> int:
     return 0
 
 
+def cmd_profile(args) -> int:
+    """Profile a LIVE serving process on demand (pkg/pprof analog)."""
+    from cilium_tpu.runtime.service import VerdictClient
+
+    c = VerdictClient(args.socket)
+    resp = c.call({"op": "profile", "mode": args.mode,
+                   "seconds": args.seconds, "out": args.out})
+    c.close()
+    if "error" in resp:
+        print(f"error: {resp['error']}", file=sys.stderr)
+        return 1
+    print(json.dumps(resp))
+    return 0
+
+
 def cmd_bugtool(args) -> int:
     from cilium_tpu.runtime.service import VerdictClient
 
@@ -489,6 +504,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = sub.add_parser("inspect", help="dump a compiled-policy artifact")
     p.add_argument("artifact")
     p.set_defaults(fn=cmd_inspect)
+
+    p = sub.add_parser("profile",
+                       help="profile a live service on demand "
+                            "(host stacks or jax device trace)")
+    p.add_argument("--socket", required=True,
+                   help="verdict service unix socket")
+    p.add_argument("--mode", choices=["host", "device"], default="host")
+    p.add_argument("--seconds", type=float, default=2.0)
+    p.add_argument("--out", default="/tmp/cilium_tpu_profile")
+    p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("bugtool", help="collect a diagnostics bundle")
     p.add_argument("--socket", required=True)

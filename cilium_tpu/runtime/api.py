@@ -229,6 +229,27 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     int(body["src_identity"]), int(body["dst_identity"]),
                     ttl=body.get("ttl"))
                 return self._send(201, {"ok": True})
+            if path == "/v1/profile":
+                # pkg/pprof analog: profile the LIVE agent on demand
+                # (SURVEY §5.1); blocks for `seconds`, returns the
+                # artifact path
+                from cilium_tpu.runtime.profiling import (
+                    PROFILER,
+                    ProfileBusy,
+                )
+
+                body = json.loads(self._body() or b"{}")
+                try:
+                    result = PROFILER.capture(
+                        body.get("out", "/tmp/cilium_tpu_profile"),
+                        seconds=float(body.get("seconds", 2.0)),
+                        mode=body.get("mode", "host"),
+                    )
+                except ProfileBusy as e:
+                    return self._send(409, {"error": str(e)})
+                except ValueError as e:
+                    return self._send(400, {"error": str(e)})
+                return self._send(200, result)
             if path == "/v1/policy/trace":
                 # `cilium policy trace` analog: explain the verdict
                 # for HYPOTHETICAL src/dst label sets

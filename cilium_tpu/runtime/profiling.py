@@ -1,0 +1,109 @@
+"""On-demand profiling for long-running processes — the ``pkg/pprof``
+analog (SURVEY.md §5.1): the reference serves CPU/heap profiles from a
+flag-gated HTTP endpoint on a LIVE agent; ours captures either a
+jax.profiler device trace or a sampled host-stack profile from the
+running process, behind the REST API (``PUT /v1/profile``) and the
+verdict service (``{"op": "profile"}``).
+
+Host mode is a dependency-free sampling profiler: ``sys._current_frames``
+polled at ``hz`` for ``seconds``, aggregated into collapsed-stack lines
+(``frame;frame;frame count``) — the flamegraph input format, readable
+with any pprof/speedscope tooling. Device mode wraps
+``jax.profiler.start_trace``/``stop_trace`` (Perfetto/XPlane output),
+the same trace ``bench.py --profile`` captures, but attachable to a
+serving process on demand.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from collections import Counter
+from typing import Dict, Optional
+
+
+class ProfileBusy(RuntimeError):
+    pass
+
+
+class Profiler:
+    """One capture at a time per process (both backends are global)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._active: Optional[str] = None
+
+    def capture(self, out_dir: str, seconds: float = 2.0,
+                mode: str = "host", hz: int = 97) -> Dict[str, object]:
+        # bounded: this BLOCKS the calling handler. The cap stays
+        # under common client socket timeouts (APIClient defaults to
+        # 30s) — a capture the client can't wait out would leave it
+        # with neither the path nor a retry (ProfileBusy until done)
+        seconds = min(max(seconds, 0.1), 20.0)
+        with self._lock:
+            if self._active is not None:
+                raise ProfileBusy(f"{self._active} capture in progress")
+            self._active = mode
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            if mode == "device":
+                return self._capture_device(out_dir, seconds)
+            if mode == "host":
+                return self._capture_host(out_dir, seconds, hz)
+            raise ValueError(f"unknown profile mode {mode!r}")
+        finally:
+            with self._lock:
+                self._active = None
+
+    def _capture_device(self, out_dir: str, seconds: float) -> Dict:
+        import jax
+
+        jax.profiler.start_trace(out_dir)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+        return {"mode": "device", "path": out_dir,
+                "seconds": seconds,
+                "hint": "open with Perfetto / tensorboard profile"}
+
+    def _capture_host(self, out_dir: str, seconds: float,
+                      hz: int) -> Dict:
+        me = threading.get_ident()
+        stacks: Counter = Counter()
+        samples = 0
+        interval = 1.0 / hz
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline:
+            for tid, frame in sys._current_frames().items():
+                if tid == me:
+                    continue  # don't profile the profiler
+                parts = []
+                while frame is not None:
+                    code = frame.f_code
+                    parts.append(
+                        f"{code.co_name} "
+                        f"({os.path.basename(code.co_filename)}:"
+                        f"{frame.f_lineno})")
+                    frame = frame.f_back
+                stacks[";".join(reversed(parts))] += 1
+            samples += 1
+            time.sleep(interval)
+        # ns resolution: two quick captures in one wall-clock second
+        # must not overwrite each other
+        path = os.path.join(
+            out_dir, f"host_profile_{time.time_ns()}.collapsed")
+        with open(path, "w") as fp:
+            for stack, count in stacks.most_common():
+                fp.write(f"{stack} {count}\n")
+        return {"mode": "host", "path": path, "seconds": seconds,
+                "samples": samples, "distinct_stacks": len(stacks),
+                "hint": "collapsed-stack format (flamegraph.pl / "
+                        "speedscope)"}
+
+
+#: process-wide instance (both the REST API and the verdict service
+#: route here; the reference's pprof server is process-global too)
+PROFILER = Profiler()

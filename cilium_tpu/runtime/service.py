@@ -320,6 +320,22 @@ class VerdictService:
             if inj:
                 resp["inject_b64"] = base64.b64encode(inj).decode()
             return resp
+        if op == "profile":
+            # on-demand profiling of the serving process (pkg/pprof
+            # analog; SURVEY §5.1) — blocks for `seconds`
+            from cilium_tpu.runtime.profiling import (
+                PROFILER,
+                ProfileBusy,
+            )
+
+            try:
+                return PROFILER.capture(
+                    req.get("out", "/tmp/cilium_tpu_profile"),
+                    seconds=float(req.get("seconds", 2.0)),
+                    mode=req.get("mode", "host"),
+                )
+            except (ProfileBusy, ValueError) as e:
+                return {"error": str(e)}
         if op == "bugtool":
             if self.agent is None:
                 return {"error": "no agent attached"}

@@ -183,9 +183,18 @@ def test_cross_node_policy_enforcement(tmp_path):
         # labels are normalized with the cluster label on add)
         from cilium_tpu.endpoint import with_cluster_label
 
+        # cross-process watch propagation is eventually consistent —
+        # poll with a deadline (the bare assert flaked under full-suite
+        # load when node B's allocation hadn't reached A's watch yet)
+        want_labels = with_cluster_label(LabelSet.from_dict(
+            {"app": "web"}), "default")
+        deadline0 = time.monotonic() + 30
+        while (agent_a.allocator.lookup_by_labels(want_labels)
+                != web_remote.identity
+                and time.monotonic() < deadline0):
+            time.sleep(0.2)
         assert agent_a.allocator.lookup_by_labels(
-            with_cluster_label(LabelSet.from_dict({"app": "web"}),
-                               "default")) == web_remote.identity
+            want_labels) == web_remote.identity
         agent_a.policy_add(load_cnp_yaml_text("""
 apiVersion: cilium.io/v2
 kind: CiliumNetworkPolicy

@@ -1,0 +1,123 @@
+"""On-demand profiling of live processes (pkg/pprof analog —
+VERDICT r2 missing #5 / SURVEY §5.1)."""
+
+import json
+import os
+import threading
+import time
+
+from cilium_tpu.runtime.profiling import Profiler
+
+
+def test_host_profile_samples_running_threads(tmp_path):
+    stop = threading.Event()
+
+    def busy_loop_marker_fn():
+        while not stop.is_set():
+            sum(range(200))
+            time.sleep(0.001)
+
+    t = threading.Thread(target=busy_loop_marker_fn, daemon=True)
+    t.start()
+    try:
+        result = Profiler().capture(str(tmp_path), seconds=0.4,
+                                    mode="host", hz=200)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert result["mode"] == "host"
+    assert result["samples"] > 10
+    content = open(result["path"]).read()
+    assert "busy_loop_marker_fn" in content  # the live thread shows up
+    # collapsed-stack lines: "frame;frame count"
+    first = content.splitlines()[0]
+    assert first.rsplit(" ", 1)[1].isdigit()
+
+
+def test_device_profile_writes_trace(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    p = Profiler()
+    out = str(tmp_path / "trace")
+
+    def work():
+        for _ in range(5):
+            jax.block_until_ready(jnp.arange(512) * 2)
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    result = p.capture(out, seconds=0.3, mode="device")
+    t.join(timeout=10)
+    assert result["mode"] == "device"
+    # jax writes plugins/profile/... under the trace dir
+    found = [os.path.join(dp, f) for dp, _, fs in os.walk(out)
+             for f in fs]
+    assert found, "no trace artifacts written"
+
+
+def test_busy_and_bad_mode_surface_cleanly(tmp_path):
+    import pytest
+
+    from cilium_tpu.runtime.profiling import ProfileBusy
+
+    p = Profiler()
+    done = threading.Event()
+
+    def long_capture():
+        p.capture(str(tmp_path), seconds=0.5, mode="host")
+        done.set()
+
+    t = threading.Thread(target=long_capture, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5  # poll, don't race the start
+    while p._active is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert p._active == "host"
+    with pytest.raises(ProfileBusy):
+        p.capture(str(tmp_path), seconds=0.1, mode="host")
+    done.wait(timeout=5)
+    with pytest.raises(ValueError):
+        p.capture(str(tmp_path), seconds=0.1, mode="heap")
+
+
+def test_profile_over_service_socket_and_rest(tmp_path):
+    """The live-process surfaces: verdict-service op + REST endpoint
+    + CLI (a serving daemon is traceable on demand)."""
+    from cilium_tpu import cli
+    from cilium_tpu.agent import Agent
+    from cilium_tpu.core.config import Config
+    from cilium_tpu.runtime.api import APIClient, APIServer
+    from cilium_tpu.runtime.loader import Loader
+    from cilium_tpu.runtime.service import VerdictService
+
+    loader = Loader(Config())
+    svc_sock = str(tmp_path / "svc.sock")
+    service = VerdictService(loader, svc_sock)
+    service.start()
+    cfg = Config()
+    cfg.configure_logging = False
+    agent = Agent(cfg)
+    api_sock = str(tmp_path / "api.sock")
+    api = APIServer(agent, api_sock)
+    api.start()
+    try:
+        # CLI → service socket
+        rc = cli.main(["profile", "--socket", svc_sock,
+                       "--seconds", "0.2",
+                       "--out", str(tmp_path / "p1")])
+        assert rc == 0
+        # REST endpoint
+        client = APIClient(api_sock)
+        code, resp = client.request("PUT", "/v1/profile", {
+            "seconds": 0.2, "mode": "host",
+            "out": str(tmp_path / "p2")})
+        assert code == 200, resp
+        assert os.path.exists(resp["path"])
+        code, resp = client.request("PUT", "/v1/profile",
+                                    {"mode": "heap"})
+        assert code == 400
+    finally:
+        api.stop()
+        service.stop()
+        agent.stop()
