@@ -187,6 +187,7 @@ def cmd_replay(args) -> int:
                 if nid < 0:
                     unmapped[0] += 1
 
+        replay_session = None
         for commit_index, chunk in chunks:
             if args.fast:
                 # columnar: records → verdicts, no Flow objects; v2
@@ -195,7 +196,23 @@ def cmd_replay(args) -> int:
                 # the jitted step compiles once; v1 records are
                 # L3/L4-only
                 chunk, l7raw, offsets, blob, widths = chunk
-                if l7raw is not None:
+                if l7raw is not None and replay_session is None \
+                        and hasattr(engine, "_arrays"):
+                    # TPU engine (the oracle has no staged arrays):
+                    # one CaptureReplay session for the stream —
+                    # string tables DFA-scanned ONCE on device,
+                    # chunks verdict from [B,15] row blocks
+                    from cilium_tpu.engine.verdict import CaptureReplay
+                    from cilium_tpu.ingest.binary import read_l7_sidecar
+
+                    full_l7, off_all, blob_all = read_l7_sidecar(
+                        args.capture)
+                    replay_session = CaptureReplay(
+                        engine, full_l7, off_all, blob_all, cfg.engine)
+                if l7raw is not None and replay_session is not None:
+                    out = replay_session.verdict_chunk(
+                        chunk, l7raw, authed_pairs=AUTH_UNENFORCED)
+                elif l7raw is not None:
                     out = engine.verdict_l7_records(
                         chunk, l7raw, offsets, blob,
                         authed_pairs=AUTH_UNENFORCED, widths=widths)

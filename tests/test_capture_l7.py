@@ -223,6 +223,47 @@ def test_capture_replay_staged_tables_parity(tmp_path, which):
     assert len(set(want.tolist())) > 1
 
 
+def test_cli_fast_tpu_uses_staged_replay_and_agrees(tmp_path, capsys):
+    """--fast --tpu routes v2 captures through the CaptureReplay
+    session (staged string tables); the summary must equal the object
+    path's, chunked across the stream."""
+    import json
+
+    from cilium_tpu import cli
+    from cilium_tpu.ingest.hubble import flow_to_dict
+
+    scenario = synth.synth_http_scenario(n_rules=12, n_flows=120)
+    _, scenario = synth.realize_scenario(scenario)
+    for f in scenario.flows:
+        f.src_labels = ()
+        f.dst_labels = ()
+    jsonl = tmp_path / "cap.jsonl"
+    jsonl.write_text("\n".join(
+        json.dumps(flow_to_dict(f)) for f in scenario.flows) + "\n")
+    bin_path = tmp_path / "cap2.bin"
+    assert cli.main(["capture", "convert", str(jsonl),
+                     str(bin_path)]) == 0
+    capsys.readouterr()
+    cnp = tmp_path / "p.yaml"
+    cnp.write_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: t}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - toPorts: [{ports: [{port: "80", protocol: TCP}],
+               rules: {http: [{method: GET, path: "/api/.*"}]}}]
+""")
+    base = ["--policy", str(cnp), "--endpoint", "app=svc", "--tpu"]
+    assert cli.main(["replay", str(bin_path)] + base) == 0
+    slow = json.loads(capsys.readouterr().out)
+    assert cli.main(["replay", str(bin_path), "--fast"] + base) == 0
+    fast = json.loads(capsys.readouterr().out)
+    assert fast == slow
+    assert slow["flows"] == 120
+
+
 def test_encode_l7_matches_encode_flows(tmp_path):
     """Array-level parity: the vectorized gather featurizer produces
     the SAME FlowBatch tensors as the per-flow encoder."""
