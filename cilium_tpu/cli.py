@@ -190,35 +190,38 @@ def cmd_replay(args) -> int:
         replay_session = None
         for commit_index, chunk in chunks:
             if args.fast:
-                # columnar: records → verdicts, no Flow objects; v2
-                # captures carry their L7 sidecar (gathered against
-                # the shared string table) + whole-capture widths so
-                # the jitted step compiles once; v1 records are
-                # L3/L4-only
-                chunk, l7raw, offsets, blob, widths = chunk
-                if l7raw is not None and replay_session is None \
-                        and hasattr(engine, "_arrays"):
-                    # TPU engine (the oracle has no staged arrays):
-                    # one CaptureReplay session for the stream —
-                    # string tables DFA-scanned ONCE on device,
-                    # chunks verdict from [B,15] row blocks
-                    from cilium_tpu.engine.verdict import CaptureReplay
-                    from cilium_tpu.ingest.binary import read_l7_sidecar
+                # columnar: records → verdicts, no Flow objects. v2
+                # chunks (RawChunk.l7 set) carry the whole-capture
+                # sidecar + widths, so nothing re-reads the file; v1
+                # records are L3/L4-only.
+                if chunk.l7 is not None and replay_session is None:
+                    from cilium_tpu.engine.verdict import (
+                        CaptureReplay,
+                        VerdictEngine,
+                    )
 
-                    full_l7, off_all, blob_all = read_l7_sidecar(
-                        args.capture)
-                    replay_session = CaptureReplay(
-                        engine, full_l7, off_all, blob_all, cfg.engine)
-                if l7raw is not None and replay_session is not None:
+                    if isinstance(engine, VerdictEngine):
+                        # one CaptureReplay session for the stream —
+                        # string tables DFA-scanned ONCE on device,
+                        # chunks verdict from [B,15] row blocks (the
+                        # oracle keeps the per-chunk object path)
+                        replay_session = CaptureReplay(
+                            engine, chunk.l7_all, chunk.offsets,
+                            chunk.blob, cfg.engine)
+                    else:
+                        replay_session = False
+                if chunk.l7 is not None and replay_session:
                     out = replay_session.verdict_chunk(
-                        chunk, l7raw, authed_pairs=AUTH_UNENFORCED)
-                elif l7raw is not None:
+                        chunk.records, chunk.l7,
+                        authed_pairs=AUTH_UNENFORCED)
+                elif chunk.l7 is not None:
                     out = engine.verdict_l7_records(
-                        chunk, l7raw, offsets, blob,
-                        authed_pairs=AUTH_UNENFORCED, widths=widths)
+                        chunk.records, chunk.l7, chunk.offsets,
+                        chunk.blob, authed_pairs=AUTH_UNENFORCED,
+                        widths=chunk.widths)
                 else:
                     out = engine.verdict_records(
-                        chunk, authed_pairs=AUTH_UNENFORCED)
+                        chunk.records, authed_pairs=AUTH_UNENFORCED)
                 for v, c in zip(*np.unique(out["verdict"],
                                            return_counts=True)):
                     name = Verdict(int(v)).name

@@ -11,9 +11,29 @@ write-then-rename pattern the agent's checkpoint files use.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 from typing import Optional
+
+
+@dataclasses.dataclass
+class RawChunk:
+    """One ``decode=False`` chunk of a binary capture: the record
+    slice plus whole-capture context (sidecar sections, widths, the
+    full L7 array) so columnar consumers never re-read the file.
+    ``l7``/``offsets``/``blob``/``widths``/``l7_all`` are None for v1
+    (L3/L4-only) captures."""
+
+    records: object
+    l7: object = None
+    offsets: object = None
+    blob: object = None
+    widths: object = None
+    l7_all: object = None
+
+    def __len__(self) -> int:  # noqa: D105 — chunk length = records
+        return len(self.records)
 
 
 class ReplayCursor:
@@ -108,11 +128,11 @@ def replay_chunks(capture: str, chunk_size: int = 8192,
                 l7, offsets, blob = side
                 l7raw = l7[index:index + len(raw)]
                 chunk = (records_to_flows_l7(raw, l7raw, offsets, blob)
-                         if decode else (raw, l7raw, offsets, blob,
-                                         widths))
+                         if decode else RawChunk(
+                             raw, l7raw, offsets, blob, widths, l7))
             else:
                 chunk = (records_to_flows(raw) if decode
-                         else (raw, None, None, None, None))
+                         else RawChunk(raw))
             yield index + len(raw), chunk
             index += len(raw)
             emitted += len(raw)

@@ -86,6 +86,37 @@ _rule = st.tuples(_selector, st.lists(_ingress, min_size=1, max_size=3)).map(
                    labels=(f"gen={hash((t[0], tuple(t[1]))) & 0xffff}",)))
 
 
+def _build_per_identity(rules, with_cidrs=True):
+    """Shared world-building for the generative differentials: app +
+    (optionally) CIDR identities registered the way the agent does,
+    rules loaded unsanitized, resolved per identity."""
+    from cilium_tpu.endpoint import with_cluster_label
+
+    alloc = IdentityAllocator()
+    cache = SelectorCache(alloc)
+    ids = {}
+    for app in APPS:
+        lbls = with_cluster_label(LabelSet.from_dict({"app": app}),
+                                  "default")
+        ids[app] = alloc.allocate(lbls)
+        cache.add_identity(ids[app], lbls)
+    cidr_ids = []
+    if with_cidrs:
+        for leaf in LEAVES:
+            lbls = cidr_labels(leaf)
+            nid = alloc.allocate(lbls)
+            cache.add_identity(nid, lbls)
+            cidr_ids.append(nid)
+    repo = Repository()
+    repo.add(list(rules), sanitize=False)
+    resolver = PolicyResolver(repo, cache)
+    per_identity = {
+        nid: resolver.resolve(alloc.lookup(nid))
+        for nid in ids.values()
+    }
+    return per_identity, ids, cidr_ids
+
+
 @settings(max_examples=25, deadline=None)
 @given(
     rules=st.lists(_rule, min_size=1, max_size=4),
@@ -99,31 +130,7 @@ _rule = st.tuples(_selector, st.lists(_ingress, min_size=1, max_size=3)).map(
         min_size=1, max_size=24),
 )
 def test_engine_equals_oracle_on_random_policies(rules, flows):
-    alloc = IdentityAllocator()
-    cache = SelectorCache(alloc)
-    ids = {}
-    for app in APPS:
-        # same normalization the agent applies (cluster label)
-        from cilium_tpu.endpoint import with_cluster_label
-
-        lbls = with_cluster_label(LabelSet.from_dict({"app": app}),
-                                  "default")
-        ids[app] = alloc.allocate(lbls)
-        cache.add_identity(ids[app], lbls)
-    cidr_ids = []
-    for leaf in LEAVES:
-        lbls = cidr_labels(leaf)
-        nid = alloc.allocate(lbls)
-        cache.add_identity(nid, lbls)
-        cidr_ids.append(nid)
-
-    repo = Repository()
-    repo.add(list(rules), sanitize=False)
-    resolver = PolicyResolver(repo, cache)
-    per_identity = {
-        nid: resolver.resolve(alloc.lookup(nid))
-        for nid in ids.values()
-    }
+    per_identity, ids, cidr_ids = _build_per_identity(rules)
 
     # src slots: 3 apps, then the 3 CIDR leaves, world(2)
     src_pool = [ids["web"], ids["db"], ids["cache"], *cidr_ids, 2]
@@ -171,23 +178,7 @@ def test_audit_mode_transform_on_random_policies(rules, flows):
     else moves."""
     from cilium_tpu.core.flow import Verdict
 
-    alloc = IdentityAllocator()
-    cache = SelectorCache(alloc)
-    ids = {}
-    for app in APPS:
-        from cilium_tpu.endpoint import with_cluster_label
-
-        lbls = with_cluster_label(LabelSet.from_dict({"app": app}),
-                                  "default")
-        ids[app] = alloc.allocate(lbls)
-        cache.add_identity(ids[app], lbls)
-    repo = Repository()
-    repo.add(list(rules), sanitize=False)
-    resolver = PolicyResolver(repo, cache)
-    per_identity = {
-        nid: resolver.resolve(alloc.lookup(nid))
-        for nid in ids.values()
-    }
+    per_identity, ids, _ = _build_per_identity(rules, with_cidrs=False)
     src_pool = [ids["web"], ids["db"], ids["cache"], 2]
     flow_objs = [
         Flow(src_identity=src_pool[s % len(src_pool)],
