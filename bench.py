@@ -47,6 +47,7 @@ import argparse
 import json
 import os
 import sys
+import tempfile
 import time
 
 #: exit code an --inner / --probe subprocess uses to report "the
@@ -319,7 +320,6 @@ def run_config(config: str, args) -> dict:
                                              n_flows=n_flows)
     elif config == "mixed":
         # BASELINE configs[3]: examples/policies corpus × synthetic tuples
-        import os
         corpus = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "examples", "policies")
         scenario = synth.synth_mixed_scenario(corpus, n_tuples=n_flows)
@@ -482,15 +482,46 @@ def run_config(config: str, args) -> dict:
             f"pipelined verdicts/s={vps:,.0f}")
 
     # e2e capture-replay lane (still zero readbacks: runs before the
-    # post-timing readback below, in the same clean process)
+    # post-timing readback below, in the same clean process). Default
+    # ON for the http config — the north star is "replaying a Hubble
+    # capture", so the official line must carry the e2e rate.
     e2e = None
-    if getattr(args, "from_capture", None):
+    cap = getattr(args, "from_capture", None)
+    cap_is_auto = cap == "auto"
+    if cap_is_auto:
+        if config == "http":
+            # per-user dir (no cross-user /tmp collisions or symlink
+            # planting); key carries every shape knob so a stale file
+            # from a different scenario can't be silently reused
+            d = os.path.join(tempfile.gettempdir(),
+                             f"ct_bench_{os.getuid()}")
+            os.makedirs(d, exist_ok=True)
+            cap = os.path.join(
+                d, f"cap_{n_rules}r_{n_flows}b_"
+                   f"{args.capture_flows}f_v2.bin")
+        else:
+            cap = None
+    elif cap in (None, "", "none"):
+        cap = None
+    if cap is not None:
         if config != "http":
             return {"metric": "bench_failed_setup", "value": 0,
                     "unit": "--from-capture is the http lane",
                     "vs_baseline": 0.0}
-        e2e = _bench_from_capture(args, cfg, engine, scenario, arrays,
-                                  log)
+        args.from_capture = cap
+        try:
+            e2e = _bench_from_capture(args, cfg, engine, scenario,
+                                      arrays, log)
+        except Exception:
+            # ONLY an auto-managed cache file may be rewritten — a
+            # user-supplied capture is their data, and the error is
+            # theirs to see
+            if cap_is_auto and os.path.exists(cap):
+                os.unlink(cap)
+                e2e = _bench_from_capture(args, cfg, engine, scenario,
+                                          arrays, log)
+            else:
+                raise
 
     # ---- timing is over; readbacks are safe now -----------------------
     log(f"verdict mix: "
@@ -651,10 +682,13 @@ def main() -> int:
     ap.add_argument("--check", action="store_true",
                     help="verify engine vs oracle on a sample (after timing)")
     ap.add_argument("--from-capture", metavar="FILE", dest="from_capture",
+                    default="auto",
                     help="http config: ALSO time end-to-end file→verdict "
                          "replay of a stored v2 binary capture (written "
                          "from the synth scenario if FILE is absent) — "
-                         "the north star's 'replaying a Hubble capture'")
+                         "the north star's 'replaying a Hubble capture'. "
+                         "Default 'auto' uses a shape-keyed temp file; "
+                         "'none' disables the lane")
     ap.add_argument("--capture-flows", type=int, default=200000,
                     help="records to write when --from-capture creates "
                          "the file (default 200000)")
