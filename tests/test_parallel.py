@@ -40,7 +40,8 @@ def test_dp_sharded_equals_single_device():
     from cilium_tpu.engine.verdict import verdict_step
     import __graft_entry__ as ge
 
-    policy, batch = ge._small_policy_and_batch(n_rules=32, n_flows=64)
+    policy, batch, _, _ = ge._small_policy_and_batch(n_rules=32,
+                                                     n_flows=64)
     single = jax.jit(verdict_step)(policy.arrays, batch)
 
     mesh = make_mesh((4, 2), ("data", "expert"))
