@@ -441,3 +441,59 @@ def test_to_services_sees_remote_backends(offload):
     finally:
         a.stop()
         b.stop()
+
+
+def test_global_services_across_processes(tmp_path):
+    """The multi-process shape of the services sync: each cluster's
+    state rides its own SOCKET-SERVED kvstore (separate server
+    threads + socket protocol, the etcd-per-cluster topology); beta
+    watches alpha's server remotely and merges the shared service's
+    backends."""
+    import time as _time
+
+    from cilium_tpu.kvstore_service import KVStoreServer, RemoteKVStore
+    from cilium_tpu.loadbalancer import Backend, Frontend, Service
+
+    srv_a = KVStoreServer(str(tmp_path / "a.sock")).start()
+    srv_b = KVStoreServer(str(tmp_path / "b.sock")).start()
+    try:
+        a = Agent(Config(cluster_name="alpha"),
+                  kvstore=RemoteKVStore(str(tmp_path / "a.sock"))).start()
+        b = Agent(Config(cluster_name="beta"),
+                  kvstore=RemoteKVStore(str(tmp_path / "b.sock"))).start()
+        try:
+            a.endpoint_add(1, {"app": "orders"}, ipv4="10.1.0.7")
+            a.services.upsert(Service(
+                frontend=Frontend("10.96.1.1", 8080),
+                backends=[Backend(ip="10.1.0.7", port=8080)],
+                name="orders", namespace="default", shared=True))
+            a.publisher.publish_services()
+            b.endpoint_add(10, {"app": "orders"}, ipv4="10.2.0.7")
+            b.services.upsert(Service(
+                frontend=Frontend("10.97.1.1", 8080),
+                backends=[Backend(ip="10.2.0.7", port=8080)],
+                name="orders", namespace="default", shared=True))
+            # beta connects to ALPHA'S socket server (cross-store watch)
+            b.clustermesh.connect(
+                "alpha", RemoteKVStore(str(tmp_path / "a.sock")))
+            svc = b.services.get(Frontend("10.97.1.1", 8080))
+            deadline = _time.monotonic() + 30
+            merged = []
+            while _time.monotonic() < deadline:
+                merged = [bk.ip for bk in b.services.active_backends(svc)]
+                if merged == ["10.2.0.7", "10.1.0.7"]:
+                    break
+                _time.sleep(0.2)  # socket watch propagation
+            assert merged == ["10.2.0.7", "10.1.0.7"]
+            # the synced remote POD ip resolves to a remote identity
+            deadline = _time.monotonic() + 30
+            while (b.ipcache.lookup("10.1.0.7") is None
+                    and _time.monotonic() < deadline):
+                _time.sleep(0.2)
+            assert b.ipcache.lookup("10.1.0.7") is not None
+        finally:
+            a.stop()
+            b.stop()
+    finally:
+        srv_a.stop()
+        srv_b.stop()
