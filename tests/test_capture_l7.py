@@ -264,6 +264,67 @@ spec:
     assert slow["flows"] == 120
 
 
+def test_capture_replay_enforces_auth_pairs(tmp_path):
+    """Drop-until-authed rides the capture path too: the same
+    authed-pairs table drives verdict_step_capture and verdict_flows
+    to identical verdicts (fail-closed without the handshake, forward
+    with it)."""
+    import numpy as np
+
+    from cilium_tpu.core.flow import Flow
+    from cilium_tpu.core.identity import IdentityAllocator
+    from cilium_tpu.core.labels import LabelSet
+    from cilium_tpu.engine.verdict import CaptureReplay
+    from cilium_tpu.policy.api import (
+        EndpointSelector,
+        IngressRule,
+        PortProtocol,
+        PortRule,
+        Rule,
+    )
+    from cilium_tpu.core.flow import Protocol
+    from cilium_tpu.policy.mapstate import PolicyResolver
+    from cilium_tpu.policy.repository import Repository
+    from cilium_tpu.policy.selectorcache import SelectorCache
+
+    rules = [Rule(
+        endpoint_selector=EndpointSelector.from_labels(app="pay"),
+        ingress=(IngressRule(
+            from_endpoints=(EndpointSelector.from_labels(app="cart"),),
+            auth_mode="required",
+            to_ports=(PortRule(
+                ports=(PortProtocol(8443, Protocol.TCP),)),)),),
+    )]
+    alloc = IdentityAllocator()
+    pay = alloc.allocate(LabelSet.from_dict({"app": "pay"}))
+    cart = alloc.allocate(LabelSet.from_dict({"app": "cart"}))
+    cache = SelectorCache(alloc)
+    repo = Repository()
+    repo.add(rules, sanitize=False)
+    resolver = PolicyResolver(repo, cache)
+    per_identity = {pay: resolver.resolve(alloc.lookup(pay))}
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    engine = Loader(cfg).regenerate(per_identity, revision=1)
+
+    flows = [Flow(src_identity=cart, dst_identity=pay, dport=8443)]
+    path = str(tmp_path / "auth.bin")
+    binary.write_capture_l7(path, flows)
+    rec = binary.map_capture(path)
+    l7, offsets, blob = binary.read_l7_sidecar(path)
+    replay = CaptureReplay(engine, l7, offsets, blob, cfg.engine)
+
+    for pairs, want in (
+            (None, 2),                                    # fail closed
+            (np.array([[cart, pay]], dtype=np.int32), 1),  # authed
+    ):
+        via_cap = replay.verdict_chunk(rec, l7, authed_pairs=pairs)
+        via_flows = engine.verdict_flows(flows, authed_pairs=pairs)
+        assert int(via_cap["verdict"][0]) == want
+        assert int(via_flows["verdict"][0]) == want
+        assert bool(via_cap["auth_required"][0])
+
+
 def test_encode_l7_matches_encode_flows(tmp_path):
     """Array-level parity: the vectorized gather featurizer produces
     the SAME FlowBatch tensors as the per-flow encoder."""
