@@ -269,9 +269,6 @@ def test_capture_replay_enforces_auth_pairs(tmp_path):
     authed-pairs table drives verdict_step_capture and verdict_flows
     to identical verdicts (fail-closed without the handshake, forward
     with it)."""
-    import numpy as np
-
-    from cilium_tpu.core.flow import Flow
     from cilium_tpu.core.identity import IdentityAllocator
     from cilium_tpu.core.labels import LabelSet
     from cilium_tpu.engine.verdict import CaptureReplay
@@ -282,7 +279,6 @@ def test_capture_replay_enforces_auth_pairs(tmp_path):
         PortRule,
         Rule,
     )
-    from cilium_tpu.core.flow import Protocol
     from cilium_tpu.policy.mapstate import PolicyResolver
     from cilium_tpu.policy.repository import Repository
     from cilium_tpu.policy.selectorcache import SelectorCache
