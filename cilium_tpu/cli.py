@@ -297,7 +297,16 @@ def cmd_capture(args) -> int:
     # table + fixed L7 records) unless --l4-only asks for the compact
     # v1 tuple form (the reference's ring-event shape), in which case
     # count what was flattened
-    flows = list(read_jsonl(args.input))
+    from cilium_tpu.ingest.flowpb import (
+        looks_like_pb_capture,
+        read_pb_capture,
+    )
+
+    # protobuf flow streams convert too (the full format matrix:
+    # JSONL | pb → CTCAP v1/v2)
+    flows = (read_pb_capture(args.input)
+             if looks_like_pb_capture(args.input)
+             else list(read_jsonl(args.input)))
     # generic l7proto payloads never fit the fixed L7 record — both
     # versions flatten them to their L4 tuple (counted as dropped)
     n_gen = sum(1 for f in flows if f.l7 == L7Type.GENERIC)

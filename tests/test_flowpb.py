@@ -150,6 +150,23 @@ spec:
     assert json.loads(capsys.readouterr().out)["flows"] == 10
 
 
+def test_pb_converts_to_v2_binary(tmp_path, capsys):
+    """capture convert accepts pb streams: pb → CTCAP v2 with the L7
+    payloads carried."""
+    pb_path = str(tmp_path / "c.pb")
+    flowpb.write_pb_capture(pb_path, sample_flows())
+    out_path = str(tmp_path / "c.bin")
+    assert cli.main(["capture", "convert", pb_path, out_path]) == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["version"] == 2
+    assert info["records"] == len(sample_flows())
+    from cilium_tpu.ingest.binary import read_capture_flows_l7
+
+    back = read_capture_flows_l7(out_path)
+    assert back[0].http.path == "/api/y?q=1"
+    assert back[1].kafka.topic == "orders"
+
+
 def test_sniffer_rejects_other_formats(tmp_path):
     from cilium_tpu.ingest import binary
 
