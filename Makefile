@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: test shim determinism dryrun bench bench-all bench-e2e \
-        bench-service check
+        bench-service bench-regen bench-sp check
 
 test:            ## full suite (CPU, virtual 8-device mesh via conftest)
 	$(PY) -m pytest tests/ -q
@@ -33,5 +33,11 @@ bench-e2e:       ## file→verdict replay of a stored v2 Hubble capture
 
 bench-service:   ## socket→MicroBatcher→engine tail latency sweep
 	$(PY) bench_service.py --shim --out SERVICE_LATENCY.json
+
+bench-regen:     ## cold vs incremental vs restage regeneration latency
+	$(PY) bench.py --config regen
+
+bench-sp:        ## SP (associative-scan) vs sequential payload scan
+	$(PY) bench_sp.py
 
 check: shim test determinism dryrun   ## the full CI gate
