@@ -283,6 +283,27 @@ def cmd_capture(args) -> int:
     from cilium_tpu.ingest import binary
     from cilium_tpu.ingest.hubble import read_jsonl
 
+    if args.capture_cmd == "synth":
+        # reproducible BASELINE-shaped captures for demos/benches
+        from cilium_tpu.ingest import synth as synthmod
+
+        if args.scenario == "http":
+            scenario = synthmod.synth_http_scenario(
+                n_rules=args.rules, n_flows=args.flows, seed=args.seed)
+        elif args.scenario == "fqdn":
+            scenario = synthmod.synth_fqdn_scenario(
+                n_names=100, n_rules=args.rules, n_flows=args.flows,
+                seed=args.seed)
+        else:
+            scenario = synthmod.synth_kafka_scenario(
+                n_rules=args.rules, n_records=args.flows,
+                seed=args.seed)
+        _, scenario = synthmod.realize_scenario(scenario)
+        n = binary.write_capture_l7(args.output, scenario.flows)
+        print(json.dumps({"records": n, "version": binary.VERSION_L7,
+                          "scenario": args.scenario,
+                          "rules": args.rules, "seed": args.seed}))
+        return 0
     if args.capture_cmd == "info":
         n = binary.capture_count(args.file)
         info = {"records": n, "bytes": os.path.getsize(args.file),
@@ -672,6 +693,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="write compact v1 tuple records, flattening "
                          "L7 payloads (the ring-event shape)")
     cc.set_defaults(fn=cmd_capture)
+    cs = capsub.add_parser("synth",
+                           help="write a reproducible synthetic v2 "
+                                "capture (BASELINE scenario shapes)")
+    cs.add_argument("output")
+    cs.add_argument("--scenario", choices=["http", "fqdn", "kafka"],
+                    default="http")
+    cs.add_argument("--rules", type=int, default=100)
+    cs.add_argument("--flows", type=int, default=10000)
+    cs.add_argument("--seed", type=int, default=0)
+    cs.set_defaults(fn=cmd_capture)
 
     p = sub.add_parser("replay",
                        help="replay a Hubble capture (JSONL or binary)")

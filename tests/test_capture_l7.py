@@ -343,3 +343,18 @@ def test_encode_l7_matches_encode_flows(tmp_path):
     assert a.keys() == b.keys()
     for k in a:
         np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+def test_cli_capture_synth_is_reproducible(tmp_path, capsys):
+    import json
+
+    from cilium_tpu import cli
+
+    a, b = str(tmp_path / "a.bin"), str(tmp_path / "b.bin")
+    for out in (a, b):
+        assert cli.main(["capture", "synth", out, "--scenario", "http",
+                         "--rules", "10", "--flows", "200",
+                         "--seed", "7"]) == 0
+        rec = json.loads(capsys.readouterr().out)
+        assert rec["records"] == 200 and rec["version"] == 2
+    assert open(a, "rb").read() == open(b, "rb").read()  # same seed
