@@ -312,12 +312,10 @@ def run_config(config: str, args) -> dict:
             jax.profiler.stop_trace()
             log(f"profiler trace written to {args.profile}")
 
-    if config == "http":
-        scenario = synth.synth_http_scenario(n_rules=n_rules,
-                                             n_flows=n_flows)
-    elif config == "fqdn":
-        scenario = synth.synth_fqdn_scenario(n_names=100, n_rules=n_rules,
-                                             n_flows=n_flows)
+    if config in ("http", "fqdn", "kafka"):
+        # shared dispatch with `cilium-tpu capture synth` — one place
+        # owns the BASELINE scenario shapes
+        scenario = synth.scenario_by_name(config, n_rules, n_flows)
     elif config == "mixed":
         # BASELINE configs[3]: examples/policies corpus × synthetic tuples
         corpus = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -327,9 +325,6 @@ def run_config(config: str, args) -> dict:
         # BASELINE configs[4]: 10k identities × 5k CNP, streaming
         scenario = synth.synth_clustermesh_scenario(
             n_identities=10000, n_policies=5000, n_flows=n_flows)
-    else:
-        scenario = synth.synth_kafka_scenario(n_rules=n_rules,
-                                              n_records=n_flows)
     streaming = config in ("mixed", "clustermesh")
     per_identity, scenario = synth.realize_scenario(scenario)
 

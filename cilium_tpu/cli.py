@@ -285,20 +285,18 @@ def cmd_capture(args) -> int:
 
     if args.capture_cmd == "synth":
         # reproducible BASELINE-shaped captures for demos/benches
+        # (shared dispatch with bench.py; identity fixup only — a
+        # capture writer doesn't need policy resolution)
         from cilium_tpu.ingest import synth as synthmod
 
-        if args.scenario == "http":
-            scenario = synthmod.synth_http_scenario(
-                n_rules=args.rules, n_flows=args.flows, seed=args.seed)
-        elif args.scenario == "fqdn":
-            scenario = synthmod.synth_fqdn_scenario(
-                n_names=100, n_rules=args.rules, n_flows=args.flows,
-                seed=args.seed)
-        else:
-            scenario = synthmod.synth_kafka_scenario(
-                n_rules=args.rules, n_records=args.flows,
-                seed=args.seed)
-        _, scenario = synthmod.realize_scenario(scenario)
+        try:
+            scenario = synthmod.scenario_by_name(
+                args.scenario, args.rules, args.flows, seed=args.seed)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        _, scenario = synthmod.realize_scenario(scenario,
+                                                resolve=False)
         n = binary.write_capture_l7(args.output, scenario.flows)
         print(json.dumps({"records": n, "version": binary.VERSION_L7,
                           "scenario": args.scenario,

@@ -371,9 +371,30 @@ def synth_clustermesh_scenario(n_identities: int = 10000,
 
 
 # ----------------------------------------------------------- harness ----
-def realize_scenario(scenario: SynthScenario):
+def scenario_by_name(name: str, n_rules: int, n_flows: int,
+                     seed: int = 0) -> "SynthScenario":
+    """One dispatch for the BASELINE scenario shapes — shared by
+    bench.py and `cilium-tpu capture synth` so both generate
+    identically shaped inputs (incl. fqdn's 100-name universe)."""
+    if n_rules < 1:
+        raise ValueError("n_rules must be >= 1")
+    if name == "http":
+        return synth_http_scenario(n_rules=n_rules, n_flows=n_flows,
+                                   seed=seed)
+    if name == "fqdn":
+        return synth_fqdn_scenario(n_names=100, n_rules=n_rules,
+                                   n_flows=n_flows, seed=seed)
+    if name == "kafka":
+        return synth_kafka_scenario(n_rules=n_rules, n_records=n_flows,
+                                    seed=seed)
+    raise ValueError(f"unknown scenario {name!r}")
+
+
+def realize_scenario(scenario: SynthScenario, resolve: bool = True):
     """Allocate identities, resolve policies, fix up flow identities.
-    Returns (per_identity_mapstates, scenario with ids filled)."""
+    Returns (per_identity_mapstates, scenario with ids filled);
+    ``resolve=False`` skips policy resolution (capture writers only
+    need the identity fixup) and returns ``None`` for the mapstates."""
     from cilium_tpu.core.identity import IdentityAllocator
     from cilium_tpu.core.labels import LabelSet
     from cilium_tpu.policy.mapstate import PolicyResolver
@@ -387,12 +408,14 @@ def realize_scenario(scenario: SynthScenario):
         ls = LabelSet.from_dict(lbls)
         ids[name] = alloc.allocate(ls)
         labelsets[name] = ls
-    cache = SelectorCache(alloc)
-    repo = Repository()
-    repo.add(scenario.rules, sanitize=False)  # synth rules are well-formed
-    resolver = PolicyResolver(repo, cache)
-    per_identity = {ids[n]: resolver.resolve(labelsets[n])
-                    for n in scenario.endpoints}
+    per_identity = None
+    if resolve:
+        cache = SelectorCache(alloc)
+        repo = Repository()
+        repo.add(scenario.rules, sanitize=False)  # well-formed by synth
+        resolver = PolicyResolver(repo, cache)
+        per_identity = {ids[n]: resolver.resolve(labelsets[n])
+                        for n in scenario.endpoints}
     scenario.ids = ids
     # default src/dst for scenarios that use symbolic names
     for f in scenario.flows:
