@@ -303,6 +303,16 @@ def cmd_capture(args) -> int:
                           "rules": args.rules, "seed": args.seed}))
         return 0
     if args.capture_cmd == "info":
+        from cilium_tpu.ingest.flowpb import (
+            iter_pb_capture,
+            looks_like_pb_capture,
+        )
+
+        if looks_like_pb_capture(args.file):
+            n = sum(1 for _ in iter_pb_capture(args.file))
+            print(json.dumps({"records": n, "format": "flowpb-stream",
+                              "bytes": os.path.getsize(args.file)}))
+            return 0
         n = binary.capture_count(args.file)
         info = {"records": n, "bytes": os.path.getsize(args.file),
                 "version": binary.capture_version(args.file)}

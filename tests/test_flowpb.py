@@ -167,6 +167,17 @@ def test_pb_converts_to_v2_binary(tmp_path, capsys):
     assert back[1].kafka.topic == "orders"
 
 
+def test_capture_info_reports_pb_streams(tmp_path, capsys):
+    pb_path = str(tmp_path / "c.pb")
+    flowpb.write_pb_capture(pb_path, sample_flows())
+    assert cli.main(["capture", "info", pb_path]) == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info == {"records": len(sample_flows()),
+                    "format": "flowpb-stream",
+                    "bytes": info["bytes"]}
+    assert info["bytes"] > 0
+
+
 def test_sniffer_rejects_other_formats(tmp_path):
     from cilium_tpu.ingest import binary
 
