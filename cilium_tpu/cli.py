@@ -309,7 +309,15 @@ def cmd_capture(args) -> int:
         )
 
         if looks_like_pb_capture(args.file):
-            n = sum(1 for _ in iter_pb_capture(args.file))
+            from cilium_tpu.ingest.flowpb import PBError
+
+            try:
+                n = sum(1 for _ in iter_pb_capture(args.file))
+            except PBError as e:
+                # arbitrary bytes can sniff as a varint prefix — a
+                # torn/garbage file must report cleanly, not traceback
+                print(f"error: invalid capture: {e}", file=sys.stderr)
+                return 1
             print(json.dumps({"records": n, "format": "flowpb-stream",
                               "bytes": os.path.getsize(args.file)}))
             return 0
