@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: test shim determinism dryrun bench bench-all bench-e2e \
-        bench-service bench-regen bench-sp check
+        bench-service bench-regen bench-sp bench-watch check
 
 test:            ## full suite (CPU, virtual 8-device mesh via conftest)
 	$(PY) -m pytest tests/ -q
@@ -39,5 +39,8 @@ bench-regen:     ## cold vs incremental vs restage regeneration latency
 
 bench-sp:        ## SP (associative-scan) vs sequential payload scan
 	$(PY) bench_sp.py
+
+bench-watch:     ## probe until the tunnel answers, then capture the sweep
+	$(PY) bench.py --watch r04
 
 check: shim test determinism dryrun   ## the full CI gate

@@ -47,6 +47,55 @@ def test_recovers_from_transient_backend_failure(tmp_path):
     assert "backend attempt 2/" in r.stderr
 
 
+def _run_watch(tmp_path, fail_count, max_hours="0.0002"):
+    """Drive bench.py --watch (dry mode) with injected probe failures.
+    The watcher writes its log/artifacts next to bench.py, so tests
+    use a throwaway tag and clean up after themselves."""
+    fail_file = tmp_path / "failures"
+    fail_file.write_text(str(fail_count))
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "CILIUM_TPU_BENCH_FAIL_FILE": str(fail_file),
+        "CILIUM_TPU_WATCH_INTERVAL": "0",
+        "CILIUM_TPU_WATCH_MAX_HOURS": max_hours,  # ~0.7s deadline
+        "CILIUM_TPU_WATCH_DRY": "1",
+        "CILIUM_TPU_BENCH_PROBE_TIMEOUT": "120",
+    })
+    tag = f"testwatch{os.getpid()}"
+    try:
+        r = subprocess.run(
+            [sys.executable, BENCH, "--watch", tag],
+            capture_output=True, text=True, env=env, timeout=300)
+        log_path = os.path.join(os.path.dirname(BENCH),
+                                f"WATCH_{tag}.log")
+        log = open(log_path).read() if os.path.exists(log_path) else ""
+        return r, log
+    finally:
+        for name in (f"WATCH_{tag}.log", f"BENCH_ALL_{tag}.json",
+                     f"SERVICE_LATENCY_{tag}.json"):
+            p = os.path.join(os.path.dirname(BENCH), name)
+            if os.path.exists(p):
+                os.unlink(p)
+
+
+def test_watch_arms_when_tunnel_returns(tmp_path):
+    # one injected probe failure, then the tunnel "returns" (CPU
+    # backend answers) — the watcher must log the down probe, detect
+    # recovery, and arm the sweep
+    r, log = _run_watch(tmp_path, fail_count=1, max_hours="1")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "probe #1: down" in log
+    assert "tunnel is UP" in log
+    assert "sweep armed" in log
+
+
+def test_watch_deadline_expires_while_down(tmp_path):
+    r, log = _run_watch(tmp_path, fail_count=99)
+    assert r.returncode == 3, r.stderr[-2000:]
+    assert "deadline expired" in log
+
+
 def test_total_backend_failure_emits_parseable_line(tmp_path):
     r = _run(tmp_path, fail_count=99, retries=2)
     assert r.returncode == 1
