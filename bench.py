@@ -729,18 +729,29 @@ def _watch(args) -> int:
           os.path.join(here, f"SERVICE_LATENCY_{tag}.json")],
          None),
     ]
+    # per-step hard timeout: bench.py steps carry their own probe+retry
+    # but bench_service.py does not, and a mid-sweep re-wedge must cost
+    # one killed step, not a hung watcher
+    step_timeout = float(
+        os.environ.get("CILIUM_TPU_WATCH_STEP_TIMEOUT", "14400"))
     rc = 0
     for cmd, out_path in sweep:
         log(f"run: {' '.join(os.path.basename(c) for c in cmd[1:])}")
-        r = subprocess.run(cmd, stdout=subprocess.PIPE)
-        if out_path is not None and r.stdout:
+        try:
+            r = subprocess.run(cmd, stdout=subprocess.PIPE,
+                               timeout=step_timeout)
+            out, step_rc = r.stdout, r.returncode
+        except subprocess.TimeoutExpired as e:
+            out, step_rc = e.stdout or b"", 1
+            log(f"step timed out after {step_timeout:.0f}s (killed)")
+        if out_path is not None and out:
             with open(out_path, "wb") as fp:
-                fp.write(r.stdout)
-        sys.stdout.buffer.write(r.stdout or b"")
+                fp.write(out)
+        sys.stdout.buffer.write(out or b"")
         sys.stdout.flush()
-        log(f"done rc={r.returncode}"
+        log(f"done rc={step_rc}"
             + (f" → {os.path.basename(out_path)}" if out_path else ""))
-        rc = rc or r.returncode
+        rc = rc or step_rc
     log(f"sweep complete rc={rc}")
     return rc
 

@@ -10,6 +10,7 @@ reference, not a fast path.
 
 from __future__ import annotations
 
+import functools
 import re
 from typing import Dict, Optional, Sequence, Tuple
 
@@ -95,6 +96,60 @@ def _http_log_mismatch(rule: PortRuleHTTP, flow: Flow,
     return False
 
 
+@functools.lru_cache(maxsize=4096)
+def has_proxy_actions(l7_rules: Tuple[L7Rules, ...]) -> bool:
+    """True when any HTTP rule of the set carries a non-FAIL mismatch
+    action — the cheap gate that lets the proxy bridge skip the
+    per-request rule walk for the (common) policies with none."""
+    return any(hm.mismatch_action
+               for lr in l7_rules for r in lr.http
+               for hm in r.header_matches)
+
+
+def http_proxy_actions(l7_rules: Tuple[L7Rules, ...], flow: Flow,
+                       secret_lookup=None):
+    """``(rewrites, log)`` for an allowed HTTP flow, in ONE walk of the
+    rule set: ``rewrites`` are the ADD/DELETE/REPLACE HeaderMatch ops
+    of matching rules whose mismatch fires, ``log`` raises when a
+    LOG-action match mismatched — the reference's ``cilium.l7policy``
+    filter does both on the request path (``pkg/policy/api
+    ·HeaderMatch MismatchAction``, SURVEY.md §2.2). Mismatch = no
+    header instance satisfies (name, value); DELETE additionally
+    requires SOME instance of the name to exist (deleting an absent
+    header is a no-op not worth re-framing the request for). The
+    verdict itself is unaffected: these actions never gate."""
+    ops: list = []
+    seen = set()
+    log = False
+    h = flow.http
+    headers = h.headers if h is not None else ()
+    present_names = {k.strip().lower() for k, _ in headers}
+    for lr in l7_rules:
+        for r in lr.http:
+            if not _http_rule_matches(r, flow, secret_lookup):
+                continue
+            for hm in r.header_matches:
+                action = hm.mismatch_action
+                if action == "":
+                    continue
+                value = resolve_header_value(hm, secret_lookup)
+                if value is None:
+                    continue  # unresolvable secret: nothing to compare
+                if _header_present(hm.name, value, headers):
+                    continue  # no mismatch → no consequence
+                if action == "LOG":
+                    log = True
+                    continue
+                if action == "DELETE" \
+                        and hm.name.strip().lower() not in present_names:
+                    continue
+                op = (action, hm.name, value)
+                if op not in seen:
+                    seen.add(op)
+                    ops.append(op)
+    return ops, log
+
+
 def _kafka_rule_matches(rule: PortRuleKafka, flow: Flow) -> bool:
     k = flow.kafka
     if k is None:
@@ -167,6 +222,22 @@ def l7_allowed(l7_rules: Tuple[L7Rules, ...], flow: Flow,
     return allowed, log
 
 
+def lookup_entry(per_identity: Dict[int, MapState], flow: Flow):
+    """The flow's winning MapState entry: ``(allowed, entry)``;
+    ``(True, None)`` when the endpoint has no policy. The ONE place
+    the ingress/egress endpoint-vs-peer identity selection lives —
+    the oracle's decide path and the proxy bridge's rewrite walk must
+    agree on it bit-for-bit."""
+    ingress = flow.direction == TrafficDirection.INGRESS
+    ep_id = flow.dst_identity if ingress else flow.src_identity
+    peer_id = flow.src_identity if ingress else flow.dst_identity
+    ms = per_identity.get(ep_id)
+    if ms is None:
+        return True, None
+    return ms.lookup(peer_id, flow.dport, int(flow.protocol),
+                     int(flow.direction))
+
+
 class OracleVerdictEngine:
     """Same contract as engine.VerdictEngine, pure CPU.
 
@@ -184,14 +255,9 @@ class OracleVerdictEngine:
 
     def _decide(self, flow: Flow):
         """One lookup → (verdict, winning_entry, allowed, l7_log)."""
-        ingress = flow.direction == TrafficDirection.INGRESS
-        ep_id = flow.dst_identity if ingress else flow.src_identity
-        peer_id = flow.src_identity if ingress else flow.dst_identity
-        ms = self.per_identity.get(ep_id)
-        if ms is None:
+        allowed, entry = lookup_entry(self.per_identity, flow)
+        if allowed and entry is None:
             return Verdict.FORWARDED, None, True, False  # no policy
-        allowed, entry = ms.lookup(
-            peer_id, flow.dport, int(flow.protocol), int(flow.direction))
         if not allowed:
             return Verdict.DROPPED, entry, False, False
         if entry is not None and entry.is_redirect:

@@ -19,6 +19,29 @@ _DENY_RESPONSE = (b"HTTP/1.1 403 Forbidden\r\n"
                   b"content-length: 15\r\n\r\nAccess denied\r\n")
 
 
+def apply_header_rewrites(head: bytes, rewrites) -> bytes:
+    """Apply ``(action, name, value)`` HeaderMatch mismatch ops to a
+    request head (request line + header lines, no trailing CRLFCRLF) —
+    the byte-mutation half of the reference's ``cilium.l7policy``
+    filter. ADD appends another instance; REPLACE drops every instance
+    and writes one; DELETE drops every instance."""
+    lines = head.split(b"\r\n")
+    request_line, header_lines = lines[0], lines[1:]
+    for action, name, value in rewrites:
+        lname = name.strip().lower().encode("utf-8")
+
+        def keeps(line: bytes) -> bool:
+            k = line.split(b":", 1)[0].strip().lower()
+            return k != lname
+
+        if action in ("REPLACE", "DELETE"):
+            header_lines = [ln for ln in header_lines if keeps(ln)]
+        if action in ("ADD", "REPLACE"):
+            header_lines.append(name.encode("utf-8") + b": "
+                                + value.encode("utf-8"))
+    return b"\r\n".join([request_line] + header_lines)
+
+
 def parse_request_head(head: bytes) -> Optional[HTTPInfo]:
     try:
         text = head.decode("utf-8", "replace")
@@ -72,7 +95,23 @@ class HTTPParser(Parser):
                 ops.append((OpType.MORE, frame_len - len(self._buf)))
                 break
             if self.policy_check(info):
-                ops.append((OpType.PASS, frame_len))
+                rewrites = self.connection.pending_rewrites
+                self.connection.pending_rewrites = []
+                if rewrites:
+                    # the rewrite rides the op stream: DROP the original
+                    # frame, INJECT the mutated one (same machinery any
+                    # proxylib frame rewrite uses — the shim/proxy owns
+                    # splicing the bytes)
+                    body = self._buf[sep + 4:frame_len]
+                    mutated = (apply_header_rewrites(head, rewrites)
+                               + b"\r\n\r\n" + body)
+                    ops.append((OpType.DROP, frame_len))
+                    # upstream-bound: the mutated frame replaces the
+                    # request, so it rides the request direction
+                    ops.append(self.connection.inject(mutated,
+                                                      reply=False))
+                else:
+                    ops.append((OpType.PASS, frame_len))
             else:
                 ops.append((OpType.DROP, frame_len))
                 # queue the 403 body so the proxy/shim can retrieve it

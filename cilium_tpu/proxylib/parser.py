@@ -53,22 +53,37 @@ class Connection:
     policy_name: str = ""     # endpoint/policy scope
     dport: int = 0
     parser: Optional["Parser"] = None
-    #: bytes queued by INJECT ops, drained by the proxy/shim in order
-    pending_inject: List[bytes] = dataclasses.field(default_factory=list)
+    #: (reply, bytes) queued by INJECT ops, drained per DIRECTION by
+    #: the proxy/shim in order — reply=True is client-bound (error
+    #: responses), reply=False is upstream-bound (rewritten request
+    #: frames). Mirrors proxylib's ``Inject(reply, data)``: one queue
+    #: per stream direction, never mixed.
+    pending_inject: List[Tuple[bool, bytes]] = \
+        dataclasses.field(default_factory=list)
+    #: header-rewrite ops ``(action, name, value)`` the policy layer
+    #: attached to the LAST allowed record (HeaderMatch ADD/DELETE/
+    #: REPLACE mismatch actions) — the HTTP parser consumes them to
+    #: rewrite the frame before passing it (cilium.l7policy analog)
+    pending_rewrites: List[Tuple[str, str, str]] = \
+        dataclasses.field(default_factory=list)
 
     def on_data(self, reply: bool, end_stream: bool,
                 data: bytes) -> List[Op]:
         assert self.parser is not None
         return self.parser.on_data(reply, end_stream, data)
 
-    def inject(self, payload: bytes) -> Op:
-        """Queue payload for injection; returns the matching INJECT op."""
-        self.pending_inject.append(payload)
+    def inject(self, payload: bytes, reply: bool = True) -> Op:
+        """Queue payload for injection into the ``reply`` direction's
+        stream; returns the matching INJECT op."""
+        self.pending_inject.append((reply, payload))
         return (OpType.INJECT, len(payload))
 
-    def take_inject(self) -> bytes:
-        out = b"".join(self.pending_inject)
-        self.pending_inject.clear()
+    def take_inject(self, reply: bool = True) -> bytes:
+        """Drain queued inject bytes for ONE direction (client-bound
+        by default — the deny-response path)."""
+        out = b"".join(p for r, p in self.pending_inject if r == reply)
+        self.pending_inject = [
+            (r, p) for r, p in self.pending_inject if r != reply]
         return out
 
 

@@ -62,6 +62,10 @@ class Loader:
         self._lock = threading.Lock()
         self._engine = None
         self._revision = 0
+        #: the staged snapshot (identity → MapState); the proxy bridge
+        #: walks it host-side for per-request header-rewrite ops (the
+        #: winning entry's HTTP rules carry the mismatch actions)
+        self.per_identity: Dict[int, MapState] = {}
         self._cache = ArtifactCache(self.config.loader.cache_dir,
                                     self.config.loader.enable_cache)
         # per-loader DFA bank cache: incremental rule updates recompile
@@ -95,6 +99,7 @@ class Loader:
             with self._lock:
                 self._engine = engine
                 self._revision = revision
+                self.per_identity = per_identity
             METRICS.inc("cilium_tpu_regenerations_total",
                         labels={"backend": "oracle"})
             return engine
@@ -149,6 +154,7 @@ class Loader:
         with self._lock:
             self._engine = engine
             self._revision = revision
+            self.per_identity = per_identity
         METRICS.inc("cilium_tpu_regenerations_total",
                     labels={"backend": "tpu"})
         return engine

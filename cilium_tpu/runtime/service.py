@@ -157,12 +157,18 @@ class PolicyBridge:
     verdicts — the role of proxylib's ``policymap.go``."""
 
     def __init__(self, loader: Loader, batch_max: int = 256,
-                 deadline_ms: float = 2.0, authed_pairs_fn=None):
+                 deadline_ms: float = 2.0, authed_pairs_fn=None,
+                 accesslog_fn=None):
         self.loader = loader
         #: supplies AuthManager.pairs_array() — the L7 proxy path must
         #: enforce drop-until-authed exactly like Agent.process_flows,
         #: or auth-demanding traffic would slip through the proxy
         self.authed_pairs_fn = authed_pairs_fn
+        #: ``accesslog_fn(flow)``: sink for LOG-action accesslog records
+        #: (the reference annotates the Envoy access log on a LOG
+        #: header-match mismatch; ours emits the L7 flow to the hubble
+        #: observer via this callback)
+        self.accesslog_fn = accesslog_fn
         self.batcher = MicroBatcher(self._verdicts, batch_max=batch_max,
                                     deadline_ms=deadline_ms)
 
@@ -193,6 +199,28 @@ class PolicyBridge:
             f.l7, f.generic = L7Type.GENERIC, record
         return f
 
+    def http_proxy_actions(self, flow: Flow):
+        """(rewrites, log) for an ALLOWED HTTP flow: the firing
+        ADD/DELETE/REPLACE header-rewrite ops plus whether a LOG-action
+        mismatch should annotate the access log (oracle and TPU engine
+        share this host-side walk — it reads rule objects, which never
+        leave the host). Gated on ``has_proxy_actions`` so policies
+        with no mismatch actions (the common case) pay one cached set
+        lookup, not a rule walk, per request."""
+        from cilium_tpu.policy.oracle import (
+            has_proxy_actions,
+            http_proxy_actions,
+            lookup_entry,
+        )
+
+        allowed, entry = lookup_entry(self.loader.per_identity, flow)
+        if (not allowed or entry is None or not entry.is_redirect
+                or not has_proxy_actions(entry.l7_rules)):
+            return [], False
+        secret_lookup = (self.loader.secrets.lookup
+                         if self.loader.secrets is not None else None)
+        return http_proxy_actions(entry.l7_rules, flow, secret_lookup)
+
     def policy_check(self, conn: Connection) -> Callable[[object], bool]:
         def check(record) -> bool:
             flow = self.record_to_flow(conn, record)
@@ -201,6 +229,13 @@ class PolicyBridge:
             # but does not enforce it
             allowed = v in (int(Verdict.FORWARDED),
                             int(Verdict.REDIRECTED), int(Verdict.AUDIT))
+            conn.pending_rewrites = []
+            if allowed and flow.http is not None:
+                rewrites, log = self.http_proxy_actions(flow)
+                conn.pending_rewrites = rewrites
+                if log and self.accesslog_fn is not None:
+                    flow.verdict = Verdict(v)
+                    self.accesslog_fn(flow)
             METRICS.inc("cilium_tpu_policy_l7_total",
                         labels={"proto": conn.proto,
                                 "verdict": "allow" if allowed else "deny"})
@@ -242,11 +277,26 @@ class VerdictService:
         self.bridge = PolicyBridge(
             loader, batch_max=batch_max, deadline_ms=deadline_ms,
             authed_pairs_fn=(agent.auth.pairs_array
-                             if agent is not None else None))
+                             if agent is not None else None),
+            accesslog_fn=(self._accesslog
+                          if agent is not None else None))
         self._connections: Dict[int, Connection] = {}
         self._conn_lock = threading.Lock()
         self._server: Optional[socketserver.ThreadingUnixStreamServer] = None
         self._thread: Optional[threading.Thread] = None
+
+    def _accesslog(self, flow: Flow) -> None:
+        """LOG-action sink: the annotated L7 flow lands in the agent's
+        hubble observer ring (the reference's access-log path: Envoy →
+        accesslog socket → pkg/hubble parser/seven)."""
+        import time as _time
+
+        if not flow.time:
+            flow.time = _time.time()
+        from cilium_tpu.core.flow import PolicyMatchType
+
+        flow.policy_match_type = PolicyMatchType.L7
+        self.agent.observer.observe([flow])
 
     # -- request handling -------------------------------------------------
     def handle(self, req: Dict) -> Dict:
@@ -316,9 +366,16 @@ class VerdictService:
             ops = conn.on_data(bool(req.get("reply", False)),
                                bool(req.get("end", False)), data)
             resp = {"ops": [[int(o), int(n)] for o, n in ops]}
-            inj = conn.take_inject()
+            inj = conn.take_inject(reply=True)
             if inj:
                 resp["inject_b64"] = base64.b64encode(inj).decode()
+            inj_req = conn.take_inject(reply=False)
+            if inj_req:
+                # upstream-bound bytes (rewritten request frames) ride
+                # their own field so the shim never splices them into
+                # the client-bound stream
+                resp["inject_req_b64"] = \
+                    base64.b64encode(inj_req).decode()
             return resp
         if op == "profile":
             # on-demand profiling of the serving process (pkg/pprof

@@ -26,9 +26,13 @@ namespace {
 
 std::mutex g_mu;
 int g_fd = -1;
-// per-connection pending INJECT payloads captured from on_data responses
+// per-connection pending INJECT payloads captured from on_data
+// responses, split by stream direction: reply (client-bound error
+// responses) vs request (upstream-bound rewritten frames) — mixing
+// them would splice response bytes into the upstream stream
 std::mutex g_inject_mu;
-std::map<uint64_t, std::string> g_inject;
+std::map<uint64_t, std::string> g_inject;      // reply direction
+std::map<uint64_t, std::string> g_inject_req;  // request direction
 
 bool send_all(int fd, const void* buf, size_t len) {
   const char* p = static_cast<const char*>(buf);
@@ -235,22 +239,40 @@ int cshim_on_data(uint64_t conn_id, int reply, int end_stream,
     std::lock_guard<std::mutex> lock(g_inject_mu);
     g_inject[conn_id] += b64decode(inj_b64);
   }
+  if (json_string_field(resp, "inject_req_b64", &inj_b64)) {
+    std::lock_guard<std::mutex> lock(g_inject_mu);
+    g_inject_req[conn_id] += b64decode(inj_b64);
+  }
   return parse_ops(resp, ops_out, max_pairs);
 }
 
-// Drain pending INJECT bytes for a connection (queued by on_data ops of
-// type INJECT). Returns bytes written, or the required size (negated)
-// if buf is too small; 0 when nothing is pending.
-long cshim_take_inject(uint64_t conn_id, uint8_t* buf, size_t max_len) {
+namespace {
+long take_from(std::map<uint64_t, std::string>& q, uint64_t conn_id,
+               uint8_t* buf, size_t max_len) {
   std::lock_guard<std::mutex> lock(g_inject_mu);
-  auto it = g_inject.find(conn_id);
-  if (it == g_inject.end() || it->second.empty()) return 0;
+  auto it = q.find(conn_id);
+  if (it == q.end() || it->second.empty()) return 0;
   if (it->second.size() > max_len)
     return -static_cast<long>(it->second.size());
   size_t n = it->second.size();
   std::memcpy(buf, it->second.data(), n);
-  g_inject.erase(it);
+  q.erase(it);
   return static_cast<long>(n);
+}
+}  // namespace
+
+// Drain pending client-bound INJECT bytes (error responses) for a
+// connection. Returns bytes written, or the required size (negated)
+// if buf is too small; 0 when nothing is pending.
+long cshim_take_inject(uint64_t conn_id, uint8_t* buf, size_t max_len) {
+  return take_from(g_inject, conn_id, buf, max_len);
+}
+
+// Same, for the UPSTREAM-bound direction (rewritten request frames
+// that replace DROPped originals).
+long cshim_take_inject_req(uint64_t conn_id, uint8_t* buf,
+                           size_t max_len) {
+  return take_from(g_inject_req, conn_id, buf, max_len);
 }
 
 int cshim_close_connection(uint64_t conn_id) {
@@ -259,6 +281,7 @@ int cshim_close_connection(uint64_t conn_id) {
     // a stale entry would be delivered into the next connection
     std::lock_guard<std::mutex> lock(g_inject_mu);
     g_inject.erase(conn_id);
+    g_inject_req.erase(conn_id);
   }
   std::string req = "{\"op\":\"close_connection\",\"conn\":";
   req += std::to_string(conn_id);

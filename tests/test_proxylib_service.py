@@ -157,6 +157,165 @@ def shim_lib():
     return lib
 
 
+def _rewrite_loader():
+    """Loader whose HTTP rule carries every rewrite mismatch action
+    (pkg/policy/api ·HeaderMatch ADD/DELETE/REPLACE, SURVEY.md §2.2)."""
+    from cilium_tpu.policy.api import HeaderMatch
+
+    rules = [Rule(
+        endpoint_selector=EndpointSelector.from_labels(app="web"),
+        ingress=(IngressRule(to_ports=(PortRule(
+            ports=(PortProtocol(80, Protocol.TCP),),
+            rules=L7Rules(http=(PortRuleHTTP(
+                method="GET", path="/ok/.*",
+                header_matches=(
+                    HeaderMatch(name="X-Add", value="v1",
+                                mismatch_action="ADD"),
+                    HeaderMatch(name="X-Rep", value="v2",
+                                mismatch_action="REPLACE"),
+                    HeaderMatch(name="X-Del", value="good",
+                                mismatch_action="DELETE"),
+                )),)),
+        ),)),),
+    )]
+    alloc = IdentityAllocator()
+    ids = {
+        "web": alloc.allocate(LabelSet.from_dict({"app": "web"})),
+        "cli": alloc.allocate(LabelSet.from_dict({"app": "cli"})),
+    }
+    cache = SelectorCache(alloc)
+    repo = Repository()
+    repo.add(rules, sanitize=False)
+    resolver = PolicyResolver(repo, cache)
+    per_identity = {
+        nid: resolver.resolve(alloc.lookup(nid)) for nid in ids.values()
+    }
+    loader = Loader(Config())
+    loader.regenerate(per_identity, revision=1)
+    return loader, ids
+
+
+def test_cpp_shim_header_rewrites(shim_lib):
+    """VERDICT r3 item 2: a request traverses the C++ shim and comes
+    out with headers added/replaced/deleted — the rewrite rides the
+    op stream as DROP(original) + INJECT(mutated), the same machinery
+    the Kafka error response uses."""
+    loader, ids = _rewrite_loader()
+    sock = os.path.join(tempfile.mkdtemp(), "verdict.sock")
+    service = VerdictService(loader, sock, deadline_ms=1.0)
+    service.start()
+    try:
+        assert shim_lib.cshim_connect(sock.encode()) == 0
+        assert shim_lib.cshim_on_new_connection(
+            b"http", 91, 1, ids["cli"], ids["web"], 80, b"") == 0
+
+        req = (b"GET /ok/x HTTP/1.1\r\n"
+               b"host: web\r\n"
+               b"X-Rep: old\r\n"
+               b"X-Del: bad\r\n"
+               b"content-length: 2\r\n\r\nhi")
+        buf = (ctypes.c_uint8 * len(req)).from_buffer_copy(req)
+        ops = (ctypes.c_int32 * 16)()
+        n = shim_lib.cshim_on_data(91, 0, 0, buf, len(req), ops, 8)
+        assert n == 2, f"expected DROP+INJECT, got {n} ops"
+        assert (ops[0], ops[1]) == (int(OpType.DROP), len(req))
+        assert ops[2] == int(OpType.INJECT)
+
+        # the mutated frame is UPSTREAM-bound: it rides the request-
+        # direction inject queue, never the client-bound one
+        shim_lib.cshim_take_inject.restype = ctypes.c_long
+        shim_lib.cshim_take_inject_req.restype = ctypes.c_long
+        ibuf = (ctypes.c_uint8 * 1024)()
+        assert shim_lib.cshim_take_inject(91, ibuf, 1024) == 0
+        ilen = shim_lib.cshim_take_inject_req(91, ibuf, 1024)
+        assert ilen == ops[3]
+        out = bytes(ibuf[:ilen])
+        head, body = out.split(b"\r\n\r\n", 1)
+        assert body == b"hi"
+        lines = head.split(b"\r\n")
+        assert lines[0] == b"GET /ok/x HTTP/1.1"
+        names = [ln.split(b":", 1)[0].lower() for ln in lines[1:]]
+        assert b"x-add: v1" in {ln.lower() for ln in lines[1:]}
+        assert b"x-rep: v2" in {ln.lower() for ln in lines[1:]}
+        assert names.count(b"x-rep") == 1  # REPLACE: old instance gone
+        assert b"x-del" not in names       # DELETE fired (value was bad)
+        assert b"host: web" in lines[1:]   # untouched headers survive
+
+        # a request already satisfying every match passes UNMODIFIED
+        ok = (b"GET /ok/y HTTP/1.1\r\nhost: web\r\n"
+              b"X-Add: v1\r\nX-Rep: v2\r\nX-Del: good\r\n\r\n")
+        buf = (ctypes.c_uint8 * len(ok)).from_buffer_copy(ok)
+        n = shim_lib.cshim_on_data(91, 0, 0, buf, len(ok), ops, 8)
+        assert n == 1
+        assert (ops[0], ops[1]) == (int(OpType.PASS), len(ok))
+        shim_lib.cshim_disconnect()
+    finally:
+        service.stop()
+
+
+def test_log_action_emits_accesslog():
+    """A LOG-action mismatch on an allowed request emits an access-log
+    record: the annotated L7 flow lands in the agent's hubble observer
+    (reference: Envoy accesslog annotation on HeaderMatch LOG)."""
+    from cilium_tpu.agent import Agent
+    from cilium_tpu.core.flow import L7Type, PolicyMatchType
+    from cilium_tpu.policy.api.cnp import load_cnp_yaml_text
+
+    cnp = """
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: log}
+spec:
+  endpointSelector: {matchLabels: {app: web}}
+  ingress:
+  - fromEndpoints: [{matchLabels: {app: cli}}]
+    toPorts:
+    - ports: [{port: "80", protocol: TCP}]
+      rules:
+        http:
+        - path: "/ok/.*"
+          headerMatches:
+          - {name: X-Trace, value: "on", mismatch: LOG}
+"""
+    cfg = Config()
+    cfg.configure_logging = False
+    agent = Agent(cfg).start()
+    sock = os.path.join(tempfile.mkdtemp(), "verdict.sock")
+    try:
+        web = agent.endpoint_add(1, {"app": "web"})
+        cli = agent.endpoint_add(2, {"app": "cli"})
+        agent.policy_add(load_cnp_yaml_text(cnp)[0])
+        service = VerdictService(agent.loader, sock, deadline_ms=1.0,
+                                 agent=agent)
+        service.start()
+        try:
+            conn = Connection(proto="http", connection_id=5, ingress=True,
+                              src_identity=cli.identity,
+                              dst_identity=web.identity, dport=80)
+            parser = create_parser("http", conn,
+                                   service.bridge.policy_check(conn))
+            # mismatch (no X-Trace): allowed AND logged
+            ops = parser.on_data(False, False,
+                                 b"GET /ok/x HTTP/1.1\r\nhost: w\r\n\r\n")
+            assert ops[0][0] == OpType.PASS
+            logged = [f for f in agent.observer.get_flows()
+                      if f.l7 == L7Type.HTTP]
+            assert len(logged) == 1
+            assert logged[0].policy_match_type == PolicyMatchType.L7
+            assert logged[0].http.path == "/ok/x"
+            # satisfied match: allowed, NOT logged
+            ops = parser.on_data(
+                False, False,
+                b"GET /ok/y HTTP/1.1\r\nX-Trace: on\r\n\r\n")
+            assert ops[0][0] == OpType.PASS
+            assert len([f for f in agent.observer.get_flows()
+                        if f.l7 == L7Type.HTTP]) == 1
+        finally:
+            service.stop()
+    finally:
+        agent.stop()
+
+
 def test_cpp_shim_end_to_end(shim_lib):
     loader, ids = _loader()
     sock = os.path.join(tempfile.mkdtemp(), "verdict.sock")
