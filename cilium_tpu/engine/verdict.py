@@ -1038,8 +1038,12 @@ def _verdict_core(arrays, ms, l7t, words, kafka_cols, auth_src_dst,
     k_api, k_ver, k_cli, k_top = kafka_cols
     ak = jnp.clip(k_api, 0, 31).astype(jnp.uint32)
     am = arrays["kafka_apikey_mask"][None, :]        # [1, Rk]
+    # api_key < 0 is the unknown-role sentinel (flowpb decode): it
+    # matches only api-key-unconstrained rules — the clip alone would
+    # collapse it onto 0/produce and falsely match produce ACLs
     k_ok = (
-        ((am == 0) | ((am >> ak[:, None]) & jnp.uint32(1)).astype(bool))
+        ((am == 0) | (((am >> ak[:, None]) & jnp.uint32(1)).astype(bool)
+                      & (k_api >= 0)[:, None]))
         & ((arrays["kafka_version"][None, :] < 0)
            | (arrays["kafka_version"][None, :] == k_ver[:, None]))
         & ((arrays["kafka_client"][None, :] < 0)

@@ -30,14 +30,17 @@ from cilium_tpu.core.flow import (
     TrafficDirection,
     Verdict,
 )
+from cilium_tpu.ingest.binary import CaptureError
 
 # -- wire primitives -------------------------------------------------------
 
 _VARINT, _I64, _LEN, _I32 = 0, 1, 2, 5
 
 
-class PBError(ValueError):
-    pass
+class PBError(CaptureError):
+    """Wire-grammar failure. Subclasses CaptureError so the cursor /
+    CLI paths that degrade cleanly on a corrupt CTCAP degrade the same
+    way on a corrupt pb stream (ADVICE r3 #4)."""
 
 
 def _read_varint(buf: memoryview, pos: int) -> Tuple[int, int]:
@@ -57,6 +60,12 @@ def _read_varint(buf: memoryview, pos: int) -> Tuple[int, int]:
 
 
 def _write_varint(out: bytearray, v: int) -> None:
+    if v < 0:
+        # a negative Python int never reaches 0 under >>= 7; protobuf
+        # negative ints are a 10-byte two's-complement encoding we
+        # deliberately don't emit (no field needs it) — error loudly
+        # instead of hanging the encoder (ADVICE r3 #3)
+        raise PBError(f"negative varint {v}")
     while True:
         b = v & 0x7F
         v >>= 7
@@ -138,8 +147,10 @@ _L7_TYPE, _L7_DNS, _L7_HTTP, _L7_KAFKA = 1, 100, 101, 102
 #: HTTP message
 _H_CODE, _H_METHOD, _H_URL, _H_PROTOCOL, _H_HEADERS = 1, 2, 3, 4, 5
 _HDR_KEY, _HDR_VALUE = 1, 2
-#: DNS message
-_D_QUERY, _D_RCODE = 1, 5
+#: DNS message (query=1 … observation_source=5, rcode=6 per the
+#: upstream flow.proto ordering — rcode at 5 was knowably off,
+#: ADVICE r3 #2)
+_D_QUERY, _D_RCODE = 1, 6
 #: Kafka message
 _K_ERROR, _K_VERSION, _K_APIKEY, _K_CORRELATION, _K_TOPIC = 1, 2, 3, 4, 5
 
@@ -147,9 +158,19 @@ _K_ERROR, _K_VERSION, _K_APIKEY, _K_CORRELATION, _K_TOPIC = 1, 2, 3, 4, 5
 _L7_REQUEST = 1
 
 #: Kafka.api_key rides the wire as the ROLE STRING upstream
-#: ("produce"/"fetch"/...); numeric api keys map both ways
-_KAFKA_APIKEY_NAMES = {0: "produce", 1: "fetch", 3: "metadata"}
-_KAFKA_APIKEY_NUMS = {v: k for k, v in _KAFKA_APIKEY_NAMES.items()}
+#: ("produce"/"fetch"/...); numeric api keys map both ways. DERIVED
+#: from the repo's one canonical table (``policy/api/l7.py
+#: ·KAFKA_API_KEYS``, mirroring upstream ``pkg/policy/api/kafka.go``)
+#: so the wire codec and the ACL matcher cannot diverge (ADVICE r3
+#: #1: an unknown name must NOT collapse to 0/produce, which would
+#: falsely match produce-scoped ACLs).
+from cilium_tpu.policy.api.l7 import (  # noqa: E402
+    KAFKA_API_KEYS as _KAFKA_APIKEY_NUMS,
+)
+
+_KAFKA_APIKEY_NAMES = {v: k for k, v in _KAFKA_APIKEY_NUMS.items()}
+#: unknown-role sentinel: matches only api-key-unconstrained rules
+KAFKA_APIKEY_UNKNOWN = -1
 
 
 # -- decode ----------------------------------------------------------------
@@ -229,6 +250,9 @@ def _dec_kafka(data: memoryview) -> KafkaInfo:
                 # raw api key for roles without a name — mapping those
                 # to 0/produce would rewrite the ACL being checked
                 k.api_key = int(name)
+            else:
+                # unknown role string: sentinel, never 0/produce
+                k.api_key = KAFKA_APIKEY_UNKNOWN
         elif field == _K_CORRELATION and wt == _VARINT:
             k.correlation_id = int(v)
         elif field == _K_TOPIC and wt == _LEN:
@@ -428,18 +452,29 @@ def iter_pb_capture(path: str, start: int = 0,
 
 
 def looks_like_pb_capture(path: str) -> bool:
-    """Sniff: not our CTCAP binary, not JSONL — try one pb message."""
+    """Sniff: not our CTCAP binary, not JSONL — and the FIRST full
+    message must actually decode as a Flow (a leading varint alone
+    accepts ~any binary garbage and would route corrupt files into the
+    pb replay path — ADVICE r3 #4)."""
     with open(path, "rb") as fp:
-        head = fp.read(64)
-    if not head or head[:1] in (b"{", b"[", b" ", b"\n"):
-        return False
-    from cilium_tpu.ingest.binary import MAGIC
+        head = fp.read(16)
+        if not head or head[:1] in (b"{", b"[", b" ", b"\n"):
+            return False
+        from cilium_tpu.ingest.binary import MAGIC
 
-    if head.startswith(MAGIC):
-        return False
-    try:
-        buf = memoryview(head)
-        n, pos = _read_varint(buf, 0)
-        return 0 < n < 1 << 24
-    except PBError:
-        return False
+        if head.startswith(MAGIC):
+            return False
+        try:
+            n, pos = _read_varint(memoryview(head), 0)
+            if not 0 < n < 1 << 24:
+                return False
+            fp.seek(pos)
+            msg = fp.read(n)
+            if len(msg) < n:
+                return False
+            decode_flow(msg)
+            return True
+        except ValueError:
+            # PBError, but also e.g. urlsplit errors from a bogus URL
+            # field — any first-message decode failure means "not ours"
+            return False

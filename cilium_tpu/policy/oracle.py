@@ -10,7 +10,6 @@ reference, not a fast path.
 
 from __future__ import annotations
 
-import functools
 import re
 from typing import Dict, Optional, Sequence, Tuple
 
@@ -96,11 +95,13 @@ def _http_log_mismatch(rule: PortRuleHTTP, flow: Flow,
     return False
 
 
-@functools.lru_cache(maxsize=4096)
 def has_proxy_actions(l7_rules: Tuple[L7Rules, ...]) -> bool:
     """True when any HTTP rule of the set carries a non-FAIL mismatch
     action — the cheap gate that lets the proxy bridge skip the
-    per-request rule walk for the (common) policies with none."""
+    per-request rule walk for the (common) policies with none. Callers
+    on a hot path memoize per policy revision (PolicyBridge) — a
+    module-level cache here would pin dead policy snapshots alive
+    across regenerations."""
     return any(hm.mismatch_action
                for lr in l7_rules for r in lr.http
                for hm in r.header_matches)

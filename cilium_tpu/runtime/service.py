@@ -171,6 +171,10 @@ class PolicyBridge:
         self.accesslog_fn = accesslog_fn
         self.batcher = MicroBatcher(self._verdicts, batch_max=batch_max,
                                     deadline_ms=deadline_ms)
+        # has_proxy_actions memo, valid for ONE policy revision (reset
+        # on revision change so dead snapshots aren't pinned alive)
+        self._pa_cache: Dict = {}
+        self._pa_revision = -1
 
     def _verdicts(self, flows: Sequence[Flow]) -> Sequence[int]:
         engine = self.loader.engine
@@ -214,8 +218,16 @@ class PolicyBridge:
         )
 
         allowed, entry = lookup_entry(self.loader.per_identity, flow)
-        if (not allowed or entry is None or not entry.is_redirect
-                or not has_proxy_actions(entry.l7_rules)):
+        if not allowed or entry is None or not entry.is_redirect:
+            return [], False
+        if self._pa_revision != self.loader.revision:
+            self._pa_cache = {}
+            self._pa_revision = self.loader.revision
+        gate = self._pa_cache.get(entry.l7_rules)
+        if gate is None:
+            gate = self._pa_cache[entry.l7_rules] = \
+                has_proxy_actions(entry.l7_rules)
+        if not gate:
             return [], False
         secret_lookup = (self.loader.secrets.lookup
                          if self.loader.secrets is not None else None)

@@ -192,3 +192,113 @@ def test_sniffer_rejects_other_formats(tmp_path):
     ct = str(tmp_path / "c.bin")
     binary.write_capture(ct, sample_flows()[:1])
     assert not flowpb.looks_like_pb_capture(ct)
+
+    # binary garbage whose head parses as a plausible varint must NOT
+    # sniff as pb (the first full message has to decode — ADVICE r3 #4)
+    junk = tmp_path / "junk.bin"
+    junk.write_bytes(bytes([0x40]) + b"\xff" * 0x40)
+    assert not flowpb.looks_like_pb_capture(str(junk))
+
+
+def test_pb_errors_are_capture_errors(tmp_path):
+    """A corrupt pb stream surfaces as CaptureError (the cursor/CLI
+    degradation path), not a raw codec exception (ADVICE r3 #4)."""
+    from cilium_tpu.ingest.binary import CaptureError
+
+    assert issubclass(flowpb.PBError, CaptureError)
+
+
+def test_negative_varint_raises(tmp_path):
+    """Encoding a hand-built flow with a negative numeric field errors
+    loudly instead of hanging the encoder (ADVICE r3 #3)."""
+    import pytest
+
+    f = sample_flows()[1]
+    f.kafka.api_version = -1
+    with pytest.raises(flowpb.PBError):
+        flowpb.encode_flow(f)
+
+
+def test_unknown_kafka_role_is_sentinel_not_produce():
+    """An api-key role string outside the table decodes to the -1
+    sentinel; a real upstream name (e.g. offsetcommit) decodes to its
+    number — neither may collapse onto 0/produce (ADVICE r3 #1)."""
+    out = bytearray()
+    flowpb._put_varint(out, flowpb._K_VERSION, 3)
+    flowpb._put_str(out, flowpb._K_APIKEY, "somefutureapi")
+    k = flowpb._dec_kafka(memoryview(bytes(out)))
+    assert k.api_key == flowpb.KAFKA_APIKEY_UNKNOWN
+
+    out = bytearray()
+    flowpb._put_str(out, flowpb._K_APIKEY, "offsetcommit")
+    assert flowpb._dec_kafka(memoryview(bytes(out))).api_key == 8
+
+
+def test_unknown_role_matches_only_unconstrained_rules():
+    """Engine + oracle: an unknown-role (-1) Kafka record must not
+    match a produce-scoped ACL, but still matches a rule with no
+    api-key constraint."""
+    from cilium_tpu.core.flow import (
+        Flow,
+        KafkaInfo,
+        L7Type,
+        TrafficDirection,
+    )
+    from cilium_tpu.core.flow import Protocol as P
+    from cilium_tpu.policy.api import (
+        EndpointSelector,
+        IngressRule,
+        L7Rules,
+        PortProtocol,
+        PortRule,
+        PortRuleKafka,
+        Rule,
+    )
+    from cilium_tpu.core.identity import IdentityAllocator
+    from cilium_tpu.core.labels import LabelSet
+    from cilium_tpu.policy.mapstate import PolicyResolver
+    from cilium_tpu.policy.repository import Repository
+    from cilium_tpu.policy.selectorcache import SelectorCache
+    from cilium_tpu.core.config import Config
+    from cilium_tpu.runtime.loader import Loader
+
+    def build(kafka_rule):
+        rules = [Rule(
+            endpoint_selector=EndpointSelector.from_labels(app="k"),
+            ingress=(IngressRule(to_ports=(PortRule(
+                ports=(PortProtocol(9092, P.TCP),),
+                rules=L7Rules(kafka=(kafka_rule,)),
+            ),)),),
+        )]
+        alloc = IdentityAllocator()
+        ids = {n: alloc.allocate(LabelSet.from_dict({"app": n}))
+               for n in ("k", "c")}
+        cache = SelectorCache(alloc)
+        repo = Repository()
+        repo.add(rules, sanitize=False)
+        resolver = PolicyResolver(repo, cache)
+        per_identity = {i: resolver.resolve(alloc.lookup(i))
+                        for i in ids.values()}
+        return per_identity, ids
+
+    flow = lambda ids: Flow(  # noqa: E731
+        src_identity=ids["c"], dst_identity=ids["k"], dport=9092,
+        protocol=P.TCP, direction=TrafficDirection.INGRESS,
+        l7=L7Type.KAFKA,
+        kafka=KafkaInfo(api_key=-1, api_version=0, topic="t"))
+
+    for offload in (False, True):
+        cfg = Config()
+        cfg.enable_tpu_offload = offload
+        # produce-scoped: unknown role must NOT match → DROPPED
+        per_identity, ids = build(PortRuleKafka(role="produce", topic="t"))
+        ld = Loader(cfg)
+        ld.regenerate(per_identity, revision=1)
+        v = ld.engine.verdict_flows([flow(ids)])["verdict"]
+        assert int(v[0]) == 2, f"offload={offload}"
+        # unconstrained rule: unknown role still allowed → REDIRECTED
+        per_identity, ids = build(PortRuleKafka(topic="t"))
+        ld = Loader(cfg)
+        ld.regenerate(per_identity, revision=1)
+        v = ld.engine.verdict_flows([flow(ids)])["verdict"]
+        assert int(v[0]) == 5, f"offload={offload}"
