@@ -110,13 +110,15 @@ def _probe() -> int:
     print("probe-ok", flush=True)
     return 0
 
-#: per-config BASELINE flow/tuple shapes
+#: per-config BASELINE flow/tuple shapes (generic is the proxylib
+#: l7proto lane — not a BASELINE config, shaped like kafka)
 _DEFAULT_FLOWS = {"http": 10000, "fqdn": 10000, "kafka": 100000,
-                  "mixed": 1000000, "clustermesh": 100000}
+                  "mixed": 1000000, "clustermesh": 100000,
+                  "generic": 100000}
 #: per-config BASELINE rule counts (configs[0] is "100 DNS names x 10
 #: regex rules"; http is the 1k-rule north-star shape)
 _DEFAULT_RULES = {"http": 1000, "fqdn": 10, "kafka": 1000,
-                  "mixed": 0, "clustermesh": 0}
+                  "mixed": 0, "clustermesh": 0, "generic": 200}
 
 
 def _bench_from_capture(args, cfg, engine, scenario, arrays, log):
@@ -138,21 +140,27 @@ def _bench_from_capture(args, cfg, engine, scenario, arrays, log):
         flows = scenario.flows
         reps = -(-args.capture_flows // len(flows))
         n = binary.write_capture_l7(cap, (flows * reps)[:args.capture_flows])
-        log(f"wrote v2 capture {cap}: {n} records")
+        log(f"wrote v{binary.capture_version(cap)} capture {cap}: "
+            f"{n} records")
     rec_all = binary.map_capture(cap)
     l7_all, offsets, blob = binary.read_l7_sidecar(cap)
+    gen_all = binary.read_gen_sidecar(cap)  # None below v3
     # replay session: per-field string tables DFA-scanned ONCE on
     # device (the pkg/fqdn/re regex-LRU analog, batch-computed); each
-    # chunk then costs one [B, 15] int32 row block host-side
-    replay = CaptureReplay(engine, l7_all, offsets, blob, cfg.engine)
+    # chunk then costs one [B, 15(+gen)] int32 row block host-side
+    replay = CaptureReplay(engine, l7_all, offsets, blob, cfg.engine,
+                           gen=gen_all)
     bs = min(len(rec_all), args.flows if args.flows is not None
-             else _DEFAULT_FLOWS["http"])
+             else _DEFAULT_FLOWS[args.config])
     nch = len(rec_all) // bs
 
     def encode_chunk(c):
         sl = slice(c * bs, (c + 1) * bs)
+        gr = (replay.feat.gen_rows[sl]
+              if replay.feat.gen_rows is not None else None)
         return {"rows": jax.device_put(
-            replay.feat.encode_rows(rec_all[sl], l7_all[sl]))}
+            replay.feat.encode_rows(rec_all[sl], l7_all[sl],
+                                    gen_rows=gr))}
 
     def step(arrays_, batch):  # the capture-specialized step
         return replay._step(arrays_, replay.table_words, batch)
@@ -312,7 +320,7 @@ def run_config(config: str, args) -> dict:
             jax.profiler.stop_trace()
             log(f"profiler trace written to {args.profile}")
 
-    if config in ("http", "fqdn", "kafka"):
+    if config in ("http", "fqdn", "kafka", "generic"):
         # shared dispatch with `cilium-tpu capture synth` — one place
         # owns the BASELINE scenario shapes
         scenario = synth.scenario_by_name(config, n_rules, n_flows)
@@ -484,7 +492,7 @@ def run_config(config: str, args) -> dict:
     cap = getattr(args, "from_capture", None)
     cap_is_auto = cap == "auto"
     if cap_is_auto:
-        if config == "http":
+        if config in ("http", "generic"):
             # per-user dir (no cross-user /tmp collisions or symlink
             # planting); key carries every shape knob so a stale file
             # from a different scenario can't be silently reused
@@ -492,16 +500,16 @@ def run_config(config: str, args) -> dict:
                              f"ct_bench_{os.getuid()}")
             os.makedirs(d, exist_ok=True)
             cap = os.path.join(
-                d, f"cap_{n_rules}r_{n_flows}b_"
+                d, f"cap_{config}_{n_rules}r_{n_flows}b_"
                    f"{args.capture_flows}f_v2.bin")
         else:
             cap = None
     elif cap in (None, "", "none"):
         cap = None
     if cap is not None:
-        if config != "http":
+        if config not in ("http", "generic"):
             return {"metric": "bench_failed_setup", "value": 0,
-                    "unit": "--from-capture is the http lane",
+                    "unit": "--from-capture is an http/generic lane",
                     "vs_baseline": 0.0}
         args.from_capture = cap
         try:
@@ -577,7 +585,8 @@ def _inner_cmd(config: str, args) -> list:
         cmd += ["--flows", str(args.flows)]
     if args.check:
         cmd.append("--check")
-    if getattr(args, "from_capture", None) and config == "http":
+    if getattr(args, "from_capture", None) \
+            and config in ("http", "generic"):
         cmd += ["--from-capture", args.from_capture,
                 "--capture-flows", str(args.capture_flows)]
     if args.verbose:
@@ -759,8 +768,8 @@ def _watch(args) -> int:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="http",
-                    choices=["http", "fqdn", "kafka", "mixed",
-                             "clustermesh", "regen", "all"])
+                    choices=["http", "fqdn", "kafka", "generic",
+                             "mixed", "clustermesh", "regen", "all"])
     ap.add_argument("--rules", type=int, default=None,
                     help="rule count (default: per-config BASELINE shape)")
     ap.add_argument("--flows", type=int, default=None,
@@ -828,7 +837,8 @@ def main() -> int:
     # process that has done post-timing readbacks is permanently in
     # the tunnel's ~64ms sync mode — docs/PLATFORM.md), with probe +
     # bounded retry around every attempt
-    configs = (("http", "fqdn", "kafka", "mixed", "clustermesh", "regen")
+    configs = (("http", "fqdn", "kafka", "generic", "mixed",
+                "clustermesh", "regen")
                if args.config == "all" else (args.config,))
     rc = 0
     backend_dead = False

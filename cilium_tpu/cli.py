@@ -207,18 +207,19 @@ def cmd_replay(args) -> int:
                         # oracle keeps the per-chunk object path)
                         replay_session = CaptureReplay(
                             engine, chunk.l7_all, chunk.offsets,
-                            chunk.blob, cfg.engine)
+                            chunk.blob, cfg.engine, gen=chunk.gen_all)
                     else:
                         replay_session = False
                 if chunk.l7 is not None and replay_session:
                     out = replay_session.verdict_chunk(
                         chunk.records, chunk.l7,
-                        authed_pairs=AUTH_UNENFORCED)
+                        authed_pairs=AUTH_UNENFORCED,
+                        start=chunk.start)
                 elif chunk.l7 is not None:
                     out = engine.verdict_l7_records(
                         chunk.records, chunk.l7, chunk.offsets,
                         chunk.blob, authed_pairs=AUTH_UNENFORCED,
-                        widths=chunk.widths)
+                        widths=chunk.widths, gen=chunk.gen)
                 else:
                     out = engine.verdict_records(
                         chunk.records, authed_pairs=AUTH_UNENFORCED)
@@ -298,7 +299,8 @@ def cmd_capture(args) -> int:
         _, scenario = synthmod.realize_scenario(scenario,
                                                 resolve=False)
         n = binary.write_capture_l7(args.output, scenario.flows)
-        print(json.dumps({"records": n, "version": binary.VERSION_L7,
+        print(json.dumps({"records": n,
+                          "version": binary.capture_version(args.output),
                           "scenario": args.scenario,
                           "rules": args.rules, "seed": args.seed}))
         return 0
@@ -324,10 +326,14 @@ def cmd_capture(args) -> int:
         n = binary.capture_count(args.file)
         info = {"records": n, "bytes": os.path.getsize(args.file),
                 "version": binary.capture_version(args.file)}
-        if info["version"] == binary.VERSION_L7:
+        if info["version"] in (binary.VERSION_L7, binary.VERSION_L7G):
             n_strings, blob_bytes = binary.l7_info(args.file)  # O(1)
             info["strings"] = n_strings
             info["blob_bytes"] = blob_bytes
+        if info["version"] == binary.VERSION_L7G:
+            gen = binary.read_gen_sidecar(args.file)
+            info["gen_fmax"] = int(gen.dtype["pairs"].shape[0])
+            info["gen_records"] = int((gen["proto"] != 0).sum())
         print(json.dumps(info))
         return 0
     # convert JSONL → binary. L7 payloads ride the v2 sidecar (string
@@ -344,21 +350,25 @@ def cmd_capture(args) -> int:
     flows = (read_pb_capture(args.input)
              if looks_like_pb_capture(args.input)
              else list(read_jsonl(args.input)))
-    # generic l7proto payloads never fit the fixed L7 record — both
-    # versions flatten them to their L4 tuple (counted as dropped)
-    n_gen = sum(1 for f in flows if f.l7 == L7Type.GENERIC)
-    n_l7 = sum(1 for f in flows if f.l7 != L7Type.NONE) - n_gen
+    # generic l7proto payloads ride the v3 GENERIC section (a capture
+    # with none stays v2); --l4-only still flattens everything. A
+    # GENERIC flow with no payload/proto is uncarriable (and
+    # unmatchable) either way — counted as dropped, not hidden.
+    n_gen_drop = sum(1 for f in flows if f.l7 == L7Type.GENERIC
+                     and (f.generic is None or not f.generic.proto))
+    n_l7 = sum(1 for f in flows if f.l7 != L7Type.NONE) - n_gen_drop
     if n_l7 and not args.l4_only:
         n = binary.write_capture_l7(args.output, flows)
-        out = {"records": n, "version": binary.VERSION_L7,
+        out = {"records": n,
+               "version": binary.capture_version(args.output),
                "l7_payloads": n_l7}
-        if n_gen:
-            out["l7_payloads_dropped"] = n_gen
+        if n_gen_drop:
+            out["l7_payloads_dropped"] = n_gen_drop
         print(json.dumps(out))
     else:
         n = binary.write_capture(args.output, flows)
         print(json.dumps({"records": n, "version": binary.VERSION,
-                          "l7_payloads_dropped": n_l7 + n_gen}))
+                          "l7_payloads_dropped": n_l7 + n_gen_drop}))
     return 0
 
 
@@ -713,7 +723,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                            help="write a reproducible synthetic v2 "
                                 "capture (BASELINE scenario shapes)")
     cs.add_argument("output")
-    cs.add_argument("--scenario", choices=["http", "fqdn", "kafka"],
+    cs.add_argument("--scenario",
+                    choices=["http", "fqdn", "kafka", "generic"],
                     default="http")
     cs.add_argument("--rules", type=int, default=100)
     cs.add_argument("--flows", type=int, default=10000)

@@ -575,9 +575,12 @@ def encode_flows(
             g_proto[i] = gproto_intern.get(g.proto, -2)
             # only interned ids matter — pairs no rule references can
             # never satisfy a requirement (deduped: a field emits at
-            # most one value id + one presence id)
+            # most one value id + one presence id). Sorted key order:
+            # the capture path (_gen_intern_rows) reproduces this
+            # exact id sequence, so Fmax truncation selects the SAME
+            # subset live and on replay.
             seen: set = set()
-            for key, val in g.fields.items():
+            for key, val in sorted(g.fields.items()):
                 for probe in ((g.proto, key, val), (g.proto, key, "")):
                     pid = gpair_intern.get(probe)
                     if pid is not None and pid not in seen:
@@ -695,6 +698,65 @@ def _intern_lut(offsets: np.ndarray, blob: np.ndarray, idx: np.ndarray,
     return lut[inv]
 
 
+def _gen_intern_rows(gen, offsets: np.ndarray, blob: np.ndarray,
+                     interns: Dict[str, Dict]) -> np.ndarray:
+    """v3 GENERIC section → row-aligned engine columns: one
+    ``[N, 1 + gen_fmax]`` int32 block (col 0 = interned l7proto id,
+    rest = interned pair ids, -2 pad). The (proto, key, value) triple
+    resolution runs once per UNIQUE triple; per-row assembly is
+    vectorized (dedup + left-pack), mirroring ``encode_flows``'s
+    value-id + presence-id probing — set semantics, so slot order
+    doesn't matter to the engine's membership check."""
+    N = len(gen)
+    Fe = int(interns.get("gen_fmax", 4))
+    out = np.full((N, 1 + Fe), -2, dtype=np.int32)
+    if N == 0:
+        return out
+    gproto = interns.get("gen_protos", {})
+    gpair = interns.get("gen_pairs", {})
+    proto_idx = np.asarray(gen["proto"], dtype=np.int64)
+    out[:, 0] = _intern_lut(offsets, blob, proto_idx, gproto)
+    pairs = np.asarray(gen["pairs"], dtype=np.int64)     # [N, F, 2]
+    F = pairs.shape[1]
+    triples = np.concatenate(
+        [np.repeat(proto_idx, F)[:, None], pairs.reshape(-1, 2)],
+        axis=1)                                          # [N*F, 3]
+    uniq, inv = np.unique(triples, axis=0, return_inverse=True)
+
+    def s(i: int) -> str:
+        return blob[int(offsets[i]):int(offsets[i + 1])] \
+            .tobytes().decode("utf-8", "replace")
+
+    vid = np.full(len(uniq), -2, dtype=np.int32)
+    pid = np.full(len(uniq), -2, dtype=np.int32)
+    for j, (p, k, v) in enumerate(uniq):
+        if k == 0:
+            continue  # string 0 = "" = unused pair slot
+        ps, ks, vs = s(int(p)), s(int(k)), s(int(v))
+        vid[j] = gpair.get((ps, ks, vs), -2)
+        pid[j] = gpair.get((ps, ks, ""), -2)
+    # interleave value-id then presence-id per pair slot — the capture
+    # writes pairs in sorted-key order and encode_flows probes
+    # (value, presence) per sorted key, so this candidate sequence is
+    # the SAME id sequence the live path builds; first-occurrence
+    # dedup + left-pack + Fe cap therefore select an identical subset
+    # (live/replay verdict parity even under Fmax truncation)
+    cand = np.empty((N, 2 * F), dtype=np.int32)
+    cand[:, 0::2] = vid[inv].reshape(N, F)
+    cand[:, 1::2] = pid[inv].reshape(N, F)
+    dup = np.zeros_like(cand, dtype=bool)
+    for j in range(1, 2 * F):  # F is small (pair slots per flow)
+        dup[:, j] = (cand[:, :j] == cand[:, j:j + 1]).any(axis=1)
+    c = np.where(dup, -2, cand)
+    order = np.argsort(c == -2, axis=1, kind="stable")
+    packed = np.take_along_axis(c, order, axis=1)
+    if packed.shape[1] < Fe:
+        packed = np.pad(packed, ((0, 0), (0, Fe - packed.shape[1])),
+                        constant_values=-2)
+    out[:, 1:] = packed[:, :Fe]
+    return out
+
+
 class CaptureFeaturizer:
     """Chunked-replay featurizer over one v2 capture: pays the string
     work ONCE per file, then each chunk is pure row gathers.
@@ -715,12 +777,17 @@ class CaptureFeaturizer:
                    ("qname", "dns_name_len"))
 
     def __init__(self, l7, offsets, blob, interns: Dict[str, Dict],
-                 cfg: Optional[EngineConfig] = None):
+                 cfg: Optional[EngineConfig] = None, gen=None):
         cfg = cfg or EngineConfig()
         self.cfg = cfg
         self.interns = interns
         self.fmax = int(interns.get("gen_fmax", 4))
         self.widths = capture_field_widths(l7, offsets, cfg)
+        #: v3 captures: whole-capture generic columns, row-aligned
+        #: ([N, 1+fmax] int32); chunk callers pass the slice matching
+        #: their record slice to :meth:`encode_rows`
+        self.gen_rows = (_gen_intern_rows(gen, offsets, blob, interns)
+                         if gen is not None else None)
         n_strings = len(offsets) - 1
         self.tables: Dict[str, tuple] = {}
         self.luts: Dict[str, np.ndarray] = {}
@@ -746,12 +813,14 @@ class CaptureFeaturizer:
         rows = self.luts[name][idx]
         return data[rows], lens[rows], valid[rows]
 
-    def encode_rows(self, rec, l7) -> np.ndarray:
+    def encode_rows(self, rec, l7, gen_rows=None) -> np.ndarray:
         """Chunk → ONE [B, 15] int32 block for
         :func:`verdict_step_capture`: per-flow scalars plus per-field
         ROW indices into the staged table match-words — the string
         bytes themselves never leave the string table (scanned once
-        per file on device). ~0.3ms per 10k flows."""
+        per file on device). ~0.3ms per 10k flows. ``gen_rows`` (the
+        chunk's slice of :attr:`gen_rows`, v3 captures) appends the
+        generic proto/pair columns → [B, 16 + gen_fmax]."""
         rec = np.asarray(rec)
         B = len(rec)
         out = np.empty((B, len(_ROW_COLS)), dtype=np.int32)
@@ -773,6 +842,9 @@ class CaptureFeaturizer:
             self.luts["kafka_topic"][l7["kafka_topic"]]
         for name, _ in self._FIELD_CAPS:
             out[:, col[f"{name}_row"]] = self.luts[name][l7[name]]
+        if gen_rows is not None:
+            out = np.concatenate(
+                [out, np.asarray(gen_rows, dtype=np.int32)], axis=1)
         return out
 
     def encode(self, rec, l7) -> FlowBatch:
@@ -842,13 +914,14 @@ def verdict_step_capture(arrays: Dict[str, jax.Array],
                          table_words: Dict[str, jax.Array],
                          batch: Dict[str, jax.Array]
                          ) -> Dict[str, jax.Array]:
-    """:func:`verdict_step` specialized for v2-capture replay: string
-    match words come from the staged per-file tables (gathered by row
-    index) instead of per-flow DFA scans, then the shared
+    """:func:`verdict_step` specialized for v2/v3-capture replay:
+    string match words come from the staged per-file tables (gathered
+    by row index) instead of per-flow DFA scans, then the shared
     :func:`_verdict_core` assembles the verdict — capture replay and
-    live verdicts share one implementation of the semantics. Generic
-    ``l7proto`` records don't ride v2 captures (their gen_proto is -2
-    by format), so the generic family is skipped."""
+    live verdicts share one implementation of the semantics. A v3
+    capture's generic columns ride the SAME row block (cols 15+:
+    interned proto id + pair ids), so generic traffic costs no extra
+    device argument; v2 row blocks are [B, 15] and skip the family."""
     rows = batch["rows"]
     col = {c: i for i, c in enumerate(_ROW_COLS)}
 
@@ -872,11 +945,14 @@ def verdict_step_capture(arrays: Dict[str, jax.Array],
     ingress = c("directions") == int(TrafficDirection.INGRESS)
     src = jnp.where(ingress, c("peer_ids"), c("ep_ids"))
     dst = jnp.where(ingress, c("ep_ids"), c("peer_ids"))
+    n = len(_ROW_COLS)
+    gen_cols = ((rows[:, n], rows[:, n + 1:])
+                if rows.shape[1] > n else None)
     return _verdict_core(
         arrays, ms, c("l7_types"), words,
         (c("kafka_api_key"), c("kafka_api_version"),
          c("kafka_client"), c("kafka_topic")),
-        (src, dst), batch, gen_cols=None)
+        (src, dst), batch, gen_cols=gen_cols)
 
 
 # canonical implementation lives in ingest.binary (pure numpy, usable
@@ -887,8 +963,8 @@ from cilium_tpu.ingest.binary import capture_field_widths  # noqa: E402
 def encode_l7_records(rec, l7, offsets, blob,
                       interns: Dict[str, Dict],
                       cfg: Optional[EngineConfig] = None,
-                      widths: Optional[Dict[str, int]] = None
-                      ) -> FlowBatch:
+                      widths: Optional[Dict[str, int]] = None,
+                      gen=None) -> FlowBatch:
     """Vectorized FlowBatch straight from a v2 binary capture
     (``ingest/binary.py`` base records + L7 sidecar): string fields
     gather from the capture's string table, kafka strings resolve to
@@ -905,6 +981,8 @@ def encode_l7_records(rec, l7, offsets, blob,
                     rec["dst_identity"]).astype(np.int32)
     fmax = int(interns.get("gen_fmax", 4))
     w = widths or {}
+    gen_rows = (_gen_intern_rows(gen, offsets, blob, interns)
+                if gen is not None else None)
 
     def field(name: str, cap: int):
         return _gather_table_field(blob, offsets, l7[name], cap,
@@ -927,8 +1005,10 @@ def encode_l7_records(rec, l7, offsets, blob,
                                  interns.get("client_id", {})),
         kafka_topic=_intern_lut(offsets, blob, l7["kafka_topic"],
                                 interns.get("topic", {})),
-        gen_proto=np.full(B, -2, dtype=np.int32),
-        gen_pairs=np.full((B, fmax), -2, dtype=np.int32),
+        gen_proto=(gen_rows[:, 0] if gen_rows is not None
+                   else np.full(B, -2, dtype=np.int32)),
+        gen_pairs=(gen_rows[:, 1:] if gen_rows is not None
+                   else np.full((B, fmax), -2, dtype=np.int32)),
     )
 
 
@@ -1252,16 +1332,18 @@ class VerdictEngine:
     def verdict_l7_records(self, rec, l7, offsets, blob,
                            cfg: Optional[EngineConfig] = None,
                            authed_pairs: Optional[np.ndarray] = None,
-                           widths: Optional[Dict[str, int]] = None):
-        """Columnar fast path over a v2 capture (base records + L7
-        sidecar): full HTTP/Kafka/DNS verdicts, zero per-flow Python
+                           widths: Optional[Dict[str, int]] = None,
+                           gen=None):
+        """Columnar fast path over a v2/v3 capture (base records + L7
+        sidecar, ``gen`` = v3 GENERIC section slice): full
+        HTTP/Kafka/DNS/generic verdicts, zero per-flow Python
         (ingest/binary.py → encode_l7_records → device). Chunked
         callers MUST pass whole-capture ``widths``
         (:func:`capture_field_widths`) or every chunk whose longest
         string rounds differently re-jits the step."""
         fb = encode_l7_records(rec, l7, offsets, blob,
                                self.policy.kafka_interns, cfg,
-                               widths=widths)
+                               widths=widths, gen=gen)
         batch = flowbatch_to_device(fb, self.device)
         self._stage_auth(batch, authed_pairs)
         out = self.verdict_batch_arrays(batch)
@@ -1269,16 +1351,20 @@ class VerdictEngine:
 
 
 class CaptureReplay:
-    """Replay session over one v2 capture: string tables scanned once
-    on device (:func:`stage_capture_tables`), chunks verdicted via
-    :func:`verdict_step_capture` from [B, 15] row blocks. The
-    file→verdict hot path for the north star's capture replay."""
+    """Replay session over one v2/v3 capture: string tables scanned
+    once on device (:func:`stage_capture_tables`), chunks verdicted
+    via :func:`verdict_step_capture` from [B, 15(+gen)] row blocks.
+    The file→verdict hot path for the north star's capture replay.
+    ``gen`` (v3 GENERIC section, whole capture) converts to interned
+    columns once; per-chunk callers pass their record range via
+    ``start``."""
 
     def __init__(self, engine: "VerdictEngine", l7, offsets, blob,
-                 cfg: Optional[EngineConfig] = None):
+                 cfg: Optional[EngineConfig] = None, gen=None):
         self.engine = engine
         self.feat = CaptureFeaturizer(l7, offsets, blob,
-                                      engine.policy.kafka_interns, cfg)
+                                      engine.policy.kafka_interns, cfg,
+                                      gen=gen)
         self.table_words = stage_capture_tables(engine, self.feat)
         self._step = jax.jit(verdict_step_capture)
 
@@ -1288,10 +1374,13 @@ class CaptureReplay:
         self.engine._stage_auth(batch, authed_pairs)
         return self._step(self.engine._arrays, self.table_words, batch)
 
-    def verdict_chunk(self, rec, l7, authed_pairs=None
+    def verdict_chunk(self, rec, l7, authed_pairs=None, start: int = 0
                       ) -> Dict[str, np.ndarray]:
-        out = self.verdict_rows(self.feat.encode_rows(rec, l7),
-                                authed_pairs)
+        gen_rows = (self.feat.gen_rows[start:start + len(rec)]
+                    if self.feat.gen_rows is not None else None)
+        out = self.verdict_rows(
+            self.feat.encode_rows(rec, l7, gen_rows=gen_rows),
+            authed_pairs)
         return {k: np.asarray(v) for k, v in out.items()}
 
 

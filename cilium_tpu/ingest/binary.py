@@ -15,9 +15,12 @@ fixed 32-byte L7 record per flow referencing it, carrying
 path/method/host/headers/qname/kafka fields. Strings are normalized at
 WRITE time (host lowercased, qname sanitized, headers canonically
 serialized) so replay featurizes with pure numpy gathers — zero
-per-flow Python (``engine.verdict.encode_l7_records``). Generic
-``l7proto`` records still ride JSONL (their open-ended field maps
-don't fit a fixed record).
+per-flow Python (``engine.verdict.encode_l7_records``). Version 3
+adds a GENERIC section so ``l7proto`` records ride the binary
+file→verdict path too (VERDICT r3 item 3): per flow, the proto name
+and up to fmax (key, value) field pairs as indices into the SAME
+string table; a capture with no generic flows stays byte-identical
+v2.
 
 The native library is built on demand (``make -C native/capture``,
 same discipline as the proxylib shim); if the toolchain is missing, a
@@ -51,10 +54,23 @@ LIB_PATH = os.path.join(NATIVE_DIR, "libcilium_capture.so")
 MAGIC = b"CTCAP1\x00\x00"
 VERSION = 1
 VERSION_L7 = 2
+#: version 3 = v2 + a GENERIC section after the L7 records: one fixed
+#: record per flow carrying the ``l7proto`` name and up to fmax
+#: (key, value) field pairs as string-table indices (VERDICT r3 item
+#: 3 — generic traffic rides the binary file→verdict path too). fmax
+#: lives in the L7Header's reserved word; a capture with no generic
+#: flows still writes byte-identical v2.
+VERSION_L7G = 3
 HEADER = np.dtype([("magic", "S8"), ("version", "<u4"),
                    ("count", "<u4")])
 L7HEADER = np.dtype([("n_strings", "<u4"), ("reserved", "<u4"),
                      ("blob_bytes", "<u8")])
+
+
+def gen_dtype(fmax: int) -> np.dtype:
+    """Per-flow generic record: l7proto string index + fmax (key,
+    value) string-index pairs (index 0 = "" = unused slot)."""
+    return np.dtype([("proto", "<u4"), ("pairs", "<u4", (fmax, 2))])
 
 #: numpy view of the C Record struct (keep in lockstep with
 #: native/capture/capture.cpp)
@@ -91,11 +107,14 @@ def _native() -> Optional[ctypes.CDLL]:
         if _lib is not None or _lib_tried:
             return _lib
         _lib_tried = True
-        if not os.path.exists(LIB_PATH):
-            try:
-                subprocess.run(["make", "-C", NATIVE_DIR],
-                               check=True, capture_output=True)
-            except (OSError, subprocess.CalledProcessError):
+        # ALWAYS run make (a no-op when the .so is newer than
+        # capture.cpp): a stale pre-v3 library would reject version-3
+        # files the Python writer just produced
+        try:
+            subprocess.run(["make", "-C", NATIVE_DIR],
+                           check=True, capture_output=True)
+        except (OSError, subprocess.CalledProcessError):
+            if not os.path.exists(LIB_PATH):
                 return None
         try:
             lib = ctypes.CDLL(LIB_PATH)
@@ -104,6 +123,8 @@ def _native() -> Optional[ctypes.CDLL]:
         lib.ct_capture_record_size.restype = ctypes.c_int
         if lib.ct_capture_record_size() != RECORD.itemsize:
             return None  # layout drift: refuse rather than corrupt
+        if not hasattr(lib, "ct_capture_write_l7g"):
+            return None  # pre-v3 ABI: fall back to the numpy codec
         lib.ct_capture_write.restype = ctypes.c_int
         lib.ct_capture_write.argtypes = [ctypes.c_char_p,
                                          ctypes.c_void_p,
@@ -207,10 +228,10 @@ def capture_count(path: str) -> int:
         if bytes(h["magic"]).ljust(8, b"\x00") != MAGIC:
             raise CaptureError("bad magic")
         version, count = int(h["version"]), int(h["count"])
-        if version not in (VERSION, VERSION_L7):
+        if version not in (VERSION, VERSION_L7, VERSION_L7G):
             raise CaptureError("unsupported version")
         want = HEADER.itemsize + count * RECORD.itemsize
-        if version == VERSION_L7:
+        if version in (VERSION_L7, VERSION_L7G):
             fp.seek(want)
             lraw = fp.read(L7HEADER.itemsize)
             if len(lraw) < L7HEADER.itemsize:
@@ -220,6 +241,11 @@ def capture_count(path: str) -> int:
                      + (int(lh["n_strings"]) + 1) * 4
                      + int(lh["blob_bytes"])
                      + count * L7REC.itemsize)
+            if version == VERSION_L7G:
+                fmax = int(lh["reserved"])
+                if fmax <= 0:
+                    raise CaptureError("truncated capture")
+                want += count * gen_dtype(fmax).itemsize
         fp.seek(0, os.SEEK_END)
         if fp.tell() != want:
             raise CaptureError("truncated capture")
@@ -275,12 +301,14 @@ def capture_version(path: str) -> int:
 
 
 def flows_to_capture_l7(flows: Iterable[Flow]):
-    """Flows → (records, l7_records, offsets, blob): the v2 capture
-    sections. String normalization happens HERE, at write time (host
-    lowercased, qname sanitized, headers serialized canonically), so
-    the replay hot path does zero per-string transformation — the same
-    split the reference uses (accesslog entries arrive normalized from
-    Envoy; the ring consumer never re-parses)."""
+    """Flows → (records, l7_records, offsets, blob, gen, fmax): the
+    v2/v3 capture sections (``gen`` is None and fmax 0 when no flow
+    carries a generic payload — the file stays v2). String
+    normalization happens HERE, at write time (host lowercased, qname
+    sanitized, headers serialized canonically), so the replay hot path
+    does zero per-string transformation — the same split the reference
+    uses (accesslog entries arrive normalized from Envoy; the ring
+    consumer never re-parses)."""
     from cilium_tpu.engine.verdict import serialize_headers
     from cilium_tpu.policy.compiler import matchpattern
 
@@ -297,15 +325,31 @@ def flows_to_capture_l7(flows: Iterable[Flow]):
 
     rec = np.zeros(len(flows), dtype=RECORD)
     l7 = np.zeros(len(flows), dtype=L7REC)
+    gen_rows: List[Tuple[int, List[Tuple[int, int]]]] = []
+    fmax = 0
     for i, f in enumerate(flows):
-        # generic l7proto payloads (open-ended field maps) don't fit
-        # the fixed L7 record — flatten to the L4 tuple (same invariant
-        # as v1's flows_to_records: an uncarriable payload must not
-        # re-verdict against EMPTY fields on replay)
-        l7t = L7Type.NONE if f.l7 == L7Type.GENERIC else f.l7
+        g = f.generic
+        carriable = (f.l7 == L7Type.GENERIC and g is not None
+                     and g.proto)
+        # a GENERIC flow with no payload/proto can never match a rule;
+        # flatten it to the L4 tuple (same invariant as v1: an
+        # uncarriable payload must not re-verdict against EMPTY fields)
+        l7t = (L7Type.NONE
+               if f.l7 == L7Type.GENERIC and not carriable else f.l7)
         rec[i] = (f.src_identity, f.dst_identity, f.dport, f.sport,
                   int(f.protocol), int(f.direction), int(l7t),
                   int(f.verdict), f.time, 0, 0)
+        if carriable:
+            pairs = [(intern(k.encode("utf-8")),
+                      intern(v.encode("utf-8")))
+                     for k, v in sorted(g.fields.items()) if k]
+            gen_rows.append((intern(g.proto.encode("utf-8")), pairs))
+            # a carriable flow forces the GENERIC section even with
+            # zero field pairs — a proto-only flow written as v2 would
+            # re-verdict against an ABSENT payload on replay
+            fmax = max(fmax, len(pairs), 1)
+        else:
+            gen_rows.append((0, []))
         h = f.http
         if h is not None:
             l7[i]["path"] = intern(h.path.encode("utf-8"))
@@ -331,14 +375,23 @@ def flows_to_capture_l7(flows: Iterable[Flow]):
     offsets = np.zeros(len(strings) + 1, dtype=np.uint32)
     offsets[1:] = np.cumsum(lens)
     blob = np.frombuffer(b"".join(strings), dtype=np.uint8)
-    return rec, l7, offsets, blob
+    gen = None
+    if fmax > 0:
+        gen = np.zeros(len(flows), dtype=gen_dtype(fmax))
+        for i, (proto, pairs) in enumerate(gen_rows):
+            gen[i]["proto"] = proto
+            for j, (k, v) in enumerate(pairs):
+                gen[i]["pairs"][j] = (k, v)
+    return rec, l7, offsets, blob, gen, fmax
 
 
 def write_capture_l7(path: str, flows: Iterable[Flow]) -> int:
-    """Write a version-2 capture (base records + L7 sidecar)."""
-    rec, l7, offsets, blob = flows_to_capture_l7(flows)
+    """Write a version-2 capture (base records + L7 sidecar); version
+    3 when any flow carries a generic ``l7proto`` payload (the extra
+    GENERIC section, see ``VERSION_L7G``)."""
+    rec, l7, offsets, blob, gen, fmax = flows_to_capture_l7(flows)
     lib = _native()
-    if lib is not None:
+    if lib is not None and gen is None:
         _check(lib.ct_capture_write_l7(
             path.encode(),
             np.ascontiguousarray(rec).ctypes.data_as(ctypes.c_void_p),
@@ -349,10 +402,27 @@ def write_capture_l7(path: str, flows: Iterable[Flow]) -> int:
             blob.ctypes.data_as(ctypes.c_void_p),
             int(blob.size)))
         return len(rec)
+    if lib is not None and gen is not None \
+            and hasattr(lib, "ct_capture_write_l7g"):
+        lib.ct_capture_write_l7g.restype = ctypes.c_int
+        _check(lib.ct_capture_write_l7g(
+            path.encode(),
+            np.ascontiguousarray(rec).ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_uint32(len(rec)),
+            np.ascontiguousarray(l7).ctypes.data_as(ctypes.c_void_p),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            ctypes.c_uint32(len(offsets) - 1),
+            blob.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_uint64(int(blob.size)),
+            np.ascontiguousarray(gen).ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_uint32(fmax)))
+        return len(rec)
     header = np.zeros(1, dtype=HEADER)
-    header[0] = (MAGIC, VERSION_L7, len(rec))
+    version = VERSION_L7 if gen is None else VERSION_L7G
+    header[0] = (MAGIC, version, len(rec))
     l7h = np.zeros(1, dtype=L7HEADER)
-    l7h[0] = (len(offsets) - 1, 0, int(blob.size))
+    # the reserved word carries gen fmax in v3 (0 in v2)
+    l7h[0] = (len(offsets) - 1, fmax, int(blob.size))
     with open(path, "wb") as fp:
         fp.write(header.tobytes())
         fp.write(rec.tobytes())
@@ -360,6 +430,8 @@ def write_capture_l7(path: str, flows: Iterable[Flow]) -> int:
         fp.write(offsets.tobytes())
         fp.write(blob.tobytes())
         fp.write(l7.tobytes())
+        if gen is not None:
+            fp.write(gen.tobytes())
     return len(rec)
 
 
@@ -392,7 +464,7 @@ def l7_info(path: str):
     """O(1) sidecar geometry: (n_strings, blob_bytes) from the 16-byte
     L7Header ((0, 0) for a v1 capture) — the ct_capture_l7_info analog."""
     total = capture_count(path)  # full-layout validation
-    if capture_version(path) != VERSION_L7:
+    if capture_version(path) not in (VERSION_L7, VERSION_L7G):
         return 0, 0
     with open(path, "rb") as fp:
         fp.seek(HEADER.itemsize + total * RECORD.itemsize)
@@ -401,10 +473,10 @@ def l7_info(path: str):
 
 
 def read_l7_sidecar(path: str):
-    """(l7_records, offsets, blob) of a v2 capture — one sequential
+    """(l7_records, offsets, blob) of a v2/v3 capture — one sequential
     read per section, no per-record parsing."""
     total = capture_count(path)  # full-layout validation
-    if capture_version(path) != VERSION_L7:
+    if capture_version(path) not in (VERSION_L7, VERSION_L7G):
         raise CaptureError("capture has no L7 sidecar (v1)")
     with open(path, "rb") as fp:
         fp.seek(HEADER.itemsize + total * RECORD.itemsize)
@@ -417,6 +489,21 @@ def read_l7_sidecar(path: str):
     return l7, offsets, blob
 
 
+def read_gen_sidecar(path: str):
+    """The v3 GENERIC section as a ``gen_dtype(fmax)`` array, or None
+    for v1/v2 captures (one sequential read, like the L7 sidecar)."""
+    total = capture_count(path)  # full-layout validation
+    if capture_version(path) != VERSION_L7G:
+        return None
+    with open(path, "rb") as fp:
+        fp.seek(HEADER.itemsize + total * RECORD.itemsize)
+        lh = np.frombuffer(fp.read(L7HEADER.itemsize), dtype=L7HEADER)[0]
+        fmax = int(lh["reserved"])
+        fp.seek((int(lh["n_strings"]) + 1) * 4 + int(lh["blob_bytes"])
+                + total * L7REC.itemsize, os.SEEK_CUR)
+        return np.fromfile(fp, dtype=gen_dtype(fmax), count=total)
+
+
 def _table_get(offsets: np.ndarray, blob: np.ndarray, idx: int) -> bytes:
     return blob[int(offsets[idx]):int(offsets[idx + 1])].tobytes()
 
@@ -427,16 +514,23 @@ def read_capture_flows_l7(path: str) -> List[Flow]:
     sections)."""
     rec = read_records(path)
     l7, offsets, blob = read_l7_sidecar(path)
-    return records_to_flows_l7(rec, l7, offsets, blob)
+    return records_to_flows_l7(rec, l7, offsets, blob,
+                               gen=read_gen_sidecar(path))
 
 
 def records_to_flows_l7(rec: np.ndarray, l7: np.ndarray,
-                        offsets: np.ndarray, blob: np.ndarray
+                        offsets: np.ndarray, blob: np.ndarray,
+                        gen: Optional[np.ndarray] = None
                         ) -> List[Flow]:
-    from cilium_tpu.core.flow import DNSInfo, HTTPInfo, KafkaInfo
+    from cilium_tpu.core.flow import (
+        DNSInfo,
+        GenericL7Info,
+        HTTPInfo,
+        KafkaInfo,
+    )
 
     flows = []
-    for r, s in zip(rec, l7):
+    for i, (r, s) in enumerate(zip(rec, l7)):
         f = Flow(src_identity=int(r["src_identity"]),
                  dst_identity=int(r["dst_identity"]),
                  dport=int(r["dport"]), sport=int(r["sport"]),
@@ -469,5 +563,18 @@ def records_to_flows_l7(rec: np.ndarray, l7: np.ndarray,
                                      int(s["kafka_client"])).decode("utf-8"),
                 topic=_table_get(offsets, blob,
                                  int(s["kafka_topic"])).decode("utf-8"))
+        elif f.l7 == L7Type.GENERIC and gen is not None:
+            g = gen[i]
+            fields = {}
+            for k_idx, v_idx in g["pairs"]:
+                if k_idx:  # index 0 = "" = unused slot
+                    fields[_table_get(offsets, blob,
+                                      int(k_idx)).decode("utf-8")] = \
+                        _table_get(offsets, blob,
+                                   int(v_idx)).decode("utf-8")
+            f.generic = GenericL7Info(
+                proto=_table_get(offsets, blob,
+                                 int(g["proto"])).decode("utf-8"),
+                fields=fields)
         flows.append(f)
     return flows

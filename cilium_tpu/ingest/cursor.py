@@ -23,7 +23,10 @@ class RawChunk:
     slice plus whole-capture context (sidecar sections, widths, the
     full L7 array) so columnar consumers never re-read the file.
     ``l7``/``offsets``/``blob``/``widths``/``l7_all`` are None for v1
-    (L3/L4-only) captures."""
+    (L3/L4-only) captures; ``gen``/``gen_all`` (the v3 GENERIC
+    section slice / whole array) are None below v3. ``start`` is the
+    chunk's global record index — CaptureReplay uses it to slice its
+    row-aligned generic columns."""
 
     records: object
     l7: object = None
@@ -31,6 +34,9 @@ class RawChunk:
     blob: object = None
     widths: object = None
     l7_all: object = None
+    gen: object = None
+    gen_all: object = None
+    start: int = 0
 
     def __len__(self) -> int:  # noqa: D105 — chunk length = records
         return len(self.records)
@@ -102,17 +108,21 @@ def replay_chunks(capture: str, chunk_size: int = 8192,
         # against the (whole-capture) string table.
         from cilium_tpu.ingest.binary import (
             VERSION_L7,
+            VERSION_L7G,
             capture_field_widths,
             capture_version,
             map_capture,
+            read_gen_sidecar,
             read_l7_sidecar,
             records_to_flows,
             records_to_flows_l7,
         )
 
         records = map_capture(capture)
+        version = capture_version(capture)
         side = (read_l7_sidecar(capture)
-                if capture_version(capture) == VERSION_L7 else None)
+                if version in (VERSION_L7, VERSION_L7G) else None)
+        gen_all = read_gen_sidecar(capture)  # None below v3
         # whole-capture field widths ride along so the columnar
         # consumer encodes every chunk to identical shapes (one jit
         # compile for the stream) without re-reading the sidecar
@@ -127,9 +137,13 @@ def replay_chunks(capture: str, chunk_size: int = 8192,
             if side is not None:
                 l7, offsets, blob = side
                 l7raw = l7[index:index + len(raw)]
-                chunk = (records_to_flows_l7(raw, l7raw, offsets, blob)
+                genraw = (gen_all[index:index + len(raw)]
+                          if gen_all is not None else None)
+                chunk = (records_to_flows_l7(raw, l7raw, offsets, blob,
+                                             gen=genraw)
                          if decode else RawChunk(
-                             raw, l7raw, offsets, blob, widths, l7))
+                             raw, l7raw, offsets, blob, widths, l7,
+                             genraw, gen_all, index))
             else:
                 chunk = (records_to_flows(raw) if decode
                          else RawChunk(raw))

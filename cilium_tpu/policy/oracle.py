@@ -323,12 +323,13 @@ class OracleVerdictEngine:
                                   authed_pairs=authed_pairs)
 
     def verdict_l7_records(self, rec, l7, offsets, blob,
-                           authed_pairs=None, widths=None):
-        """Interface parity with VerdictEngine.verdict_l7_records (v2
-        captures; the oracle reconstructs Flow objects with payloads —
-        ``widths`` is a device-side shape hint with no oracle role)."""
+                           authed_pairs=None, widths=None, gen=None):
+        """Interface parity with VerdictEngine.verdict_l7_records
+        (v2/v3 captures; the oracle reconstructs Flow objects with
+        payloads — ``widths`` is a device-side shape hint with no
+        oracle role)."""
         from cilium_tpu.ingest.binary import records_to_flows_l7
 
         return self.verdict_flows(
-            records_to_flows_l7(rec, l7, offsets, blob),
+            records_to_flows_l7(rec, l7, offsets, blob, gen=gen),
             authed_pairs=authed_pairs)

@@ -35,6 +35,10 @@ namespace {
 constexpr char MAGIC[8] = {'C', 'T', 'C', 'A', 'P', '1', '\0', '\0'};
 constexpr uint32_t VERSION = 1;
 constexpr uint32_t VERSION_L7 = 2;
+// v3 = v2 + a GENERIC section after the L7 records: per flow a u32
+// l7proto string index plus fmax (key, value) u32 string-index pairs
+// (record size 4 + 8*fmax). fmax rides the L7Header's reserved word.
+constexpr uint32_t VERSION_L7G = 3;
 
 #pragma pack(push, 1)
 struct Header {
@@ -86,7 +90,9 @@ static_assert(sizeof(L7Record) == 32, "l7 record must be 32 bytes");
 int read_header(FILE* f, Header* h) {
   if (std::fread(h, sizeof(*h), 1, f) != 1) return -4;
   if (std::memcmp(h->magic, MAGIC, sizeof(MAGIC)) != 0) return -2;
-  if (h->version != VERSION && h->version != VERSION_L7) return -3;
+  if (h->version != VERSION && h->version != VERSION_L7 &&
+      h->version != VERSION_L7G)
+    return -3;
   return 0;
 }
 
@@ -164,6 +170,51 @@ int ct_capture_write_l7(const char* path, const void* records, uint32_t n,
   return rc;
 }
 
+// Write a version-3 capture: v2 sections plus the GENERIC section
+// (`gen` = n records of 4 + 8*gen_fmax bytes each; gen_fmax > 0).
+int ct_capture_write_l7g(const char* path, const void* records,
+                         uint32_t n, const void* l7_records,
+                         const uint32_t* offsets, uint32_t n_strings,
+                         const void* blob, uint64_t blob_bytes,
+                         const void* gen, uint32_t gen_fmax) {
+  if (gen_fmax == 0 || n_strings == 0 || offsets[0] != 0 ||
+      offsets[n_strings] != blob_bytes)
+    return CT_ERR_TRUNCATED;
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return CT_ERR_IO;
+  Header h;
+  std::memcpy(h.magic, MAGIC, sizeof(MAGIC));
+  h.version = VERSION_L7G;
+  h.record_count = n;
+  L7Header lh;
+  lh.n_strings = n_strings;
+  lh.reserved = gen_fmax;
+  lh.blob_bytes = blob_bytes;
+  size_t gen_bytes = (size_t)n * (4 + 8 * (size_t)gen_fmax);
+  int rc = CT_OK;
+  if (std::fwrite(&h, sizeof(h), 1, f) != 1) rc = CT_ERR_IO;
+  if (rc == CT_OK && n > 0 &&
+      std::fwrite(records, sizeof(Record), n, f) != n)
+    rc = CT_ERR_IO;
+  if (rc == CT_OK && std::fwrite(&lh, sizeof(lh), 1, f) != 1)
+    rc = CT_ERR_IO;
+  if (rc == CT_OK &&
+      std::fwrite(offsets, sizeof(uint32_t), n_strings + 1, f) !=
+          n_strings + 1)
+    rc = CT_ERR_IO;
+  if (rc == CT_OK && blob_bytes > 0 &&
+      std::fwrite(blob, 1, blob_bytes, f) != blob_bytes)
+    rc = CT_ERR_IO;
+  if (rc == CT_OK && n > 0 &&
+      std::fwrite(l7_records, sizeof(L7Record), n, f) != n)
+    rc = CT_ERR_IO;
+  if (rc == CT_OK && n > 0 &&
+      std::fwrite(gen, 1, gen_bytes, f) != gen_bytes)
+    rc = CT_ERR_IO;
+  if (std::fclose(f) != 0 && rc == CT_OK) rc = CT_ERR_IO;
+  return rc;
+}
+
 // Validate the header; returns the record count (>=0) or an error.
 int ct_capture_count(const char* path) {
   FILE* f = std::fopen(path, "rb");
@@ -186,6 +237,14 @@ int ct_capture_count(const char* path) {
                (long)sizeof(L7Header) +
                (long)(lh.n_strings + 1) * 4 + (long)lh.blob_bytes +
                (long)h.record_count * 32;
+        if (h.version == VERSION_L7G) {
+          // reserved carries gen fmax; record = 4 + 8*fmax bytes
+          if (lh.reserved == 0) {
+            rc = CT_ERR_TRUNCATED;
+          } else {
+            want += (long)h.record_count * (4 + 8 * (long)lh.reserved);
+          }
+        }
       }
     }
     if (rc == 0) {
@@ -215,7 +274,7 @@ int ct_capture_l7_info(const char* path, uint32_t* n_strings,
   if (!f) return CT_ERR_IO;
   Header h;
   int rc = read_header(f, &h);
-  if (rc == 0 && h.version == VERSION_L7) {
+  if (rc == 0 && (h.version == VERSION_L7 || h.version == VERSION_L7G)) {
     L7Header lh;
     if (std::fseek(f, (long)h.record_count * 32, SEEK_CUR) != 0 ||
         std::fread(&lh, sizeof(lh), 1, f) != 1) {
