@@ -543,6 +543,26 @@ class Agent:
         self.secrets.delete(namespace, name)
         self.endpoint_manager.regenerate_all(wait=True)
 
+    def endpoint_config(self, endpoint_id: int,
+                        policy_audit_mode: Optional[bool] = None,
+                        wait: bool = True):
+        """Per-endpoint option surface (reference: ``cilium-dbg
+        endpoint config <id> PolicyAuditMode=...``). Changing an
+        option regenerates so the staged tables pick up the bit."""
+        with self.write_lock:  # like every mutating entry point:
+            # must not interleave with endpoint_remove / allocator swap
+            ep = self.endpoint_manager.get(endpoint_id)
+            if ep is None:
+                raise KeyError(f"no endpoint {endpoint_id}")
+            changed = False
+            if policy_audit_mode is not None \
+                    and ep.policy_audit_mode != policy_audit_mode:
+                ep.policy_audit_mode = bool(policy_audit_mode)
+                changed = True
+        if changed:
+            self.endpoint_manager.regenerate_all(wait=wait)
+        return ep
+
     def endpoint_remove(self, endpoint_id: int) -> None:
         with self.write_lock:
             ep = self.endpoint_manager.get(endpoint_id)

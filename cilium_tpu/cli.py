@@ -429,6 +429,29 @@ def cmd_endpoint(args) -> int:
         code, body = c.endpoint_put(args.id, labels, ipv4=args.ipv4)
         _print(body)
         return 0 if code in (200, 201) else 1
+    if args.ep_cmd == "config":
+        # `cilium-dbg endpoint config <id> PolicyAuditMode=...` analog
+        opts = {}
+        for kv in args.options:
+            k, _, v = kv.partition("=")
+            if k.lower() not in ("policyauditmode", "policy_audit_mode"):
+                print(f"error: unknown option {k!r}", file=sys.stderr)
+                return 1
+            vl = v.strip().lower()
+            if vl in ("true", "enabled", "1", "yes"):
+                opts["policy_audit_mode"] = True
+            elif vl in ("false", "disabled", "0", "no"):
+                opts["policy_audit_mode"] = False
+            else:
+                # a typo'd value must error, never silently disable
+                print(f"error: bad value {v!r} for {k} "
+                      f"(Enabled|Disabled)", file=sys.stderr)
+                return 1
+        code, body = c.request("PATCH",
+                               f"/v1/endpoint/{args.id}/config",
+                               body=opts)
+        _print(body)
+        return 0 if code == 200 else 1
     code, body = c.endpoint_delete(args.id)
     _print(body)
     return 0 if code == 200 else 1
@@ -614,6 +637,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     e.add_argument("id", type=int)
     e.add_argument("--labels", help="k=v[,k=v...]")
     e.add_argument("--ipv4", default="")
+    e.add_argument("--api", required=True)
+    e.set_defaults(fn=cmd_endpoint)
+    e = esub.add_parser("config",
+                        help="per-endpoint options "
+                             "(PolicyAuditMode=Enabled|Disabled)")
+    e.add_argument("id", type=int)
+    e.add_argument("options", nargs="+", metavar="K=V")
     e.add_argument("--api", required=True)
     e.set_defaults(fn=cmd_endpoint)
 

@@ -72,6 +72,10 @@ class Endpoint:
     #: NAMED toPorts entries resolve against at regeneration
     #: (reference: pkg/policy/l4.go named-port resolution)
     named_ports: Dict[str, int] = dataclasses.field(default_factory=dict)
+    #: per-endpoint PolicyAuditMode (reference endpoint option): this
+    #: endpoint's would-be denials verdict AUDIT while the rest of the
+    #: fleet enforces — the policy-rollout use-case
+    policy_audit_mode: bool = False
 
     def to_json(self) -> Dict:
         return {
@@ -81,6 +85,7 @@ class Endpoint:
             "policy_revision": self.policy_revision,
             "ipv4": self.ipv4,
             "named_ports": dict(self.named_ports),
+            "policy_audit_mode": self.policy_audit_mode,
         }
 
     @classmethod
@@ -93,6 +98,7 @@ class Endpoint:
             ipv4=d.get("ipv4", ""),
             named_ports={str(k): int(v) for k, v in
                          (d.get("named_ports") or {}).items()},
+            policy_audit_mode=bool(d.get("policy_audit_mode", False)),
             state=EndpointState.RESTORING,
         )
 
@@ -242,6 +248,12 @@ class EndpointManager:
                             ep.labels,
                             named_ports=np_of.get(ep.identity, {}))
                     per_identity[ep.identity] = resolved[ep.identity]
+                    # per-endpoint PolicyAuditMode: our policy unit is
+                    # the identity (endpoints sharing one share a
+                    # MapState, like the reference's distillery), so
+                    # any audit-mode endpoint audits its identity
+                    if ep.policy_audit_mode:
+                        per_identity[ep.identity].audit = True
                 self.loader.regenerate(per_identity, revision=revision)
                 if self.proxy_manager is not None:
                     self.proxy_manager.reconcile(per_identity)

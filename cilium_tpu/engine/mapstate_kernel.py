@@ -54,9 +54,9 @@ class PackedMapState:
     is_deny: np.ndarray     # [N] bool
     ruleset_id: np.ndarray  # [N] int32, -1 = no L7 restriction
     auth: np.ndarray        # [N] bool — entry demands mutual auth
-    # per-endpoint-identity enforcement: sorted ids + 2-bit flags
+    # per-endpoint-identity enforcement: sorted ids + 3-bit flags
     enf_ids: np.ndarray     # [M] int32 sorted endpoint identities
-    enf_flags: np.ndarray   # [M, 2] bool (ingress, egress)
+    enf_flags: np.ndarray   # [M, 3] bool (ingress, egress, audit)
     #: [P] int32 DISTINCT port prefix lengths present, sorted
     #: descending (always contains 16 and 0) — the lookup's port
     #: probe set; its SHAPE is static per compile, so a ruleset that
@@ -88,10 +88,11 @@ def pack_mapstate(
     return of -1 means no L7 restriction.
     """
     rows: List[Tuple[int, int, int, bool, int, bool]] = []
-    enf: List[Tuple[int, bool, bool]] = []
+    enf: List[Tuple[int, bool, bool, bool]] = []
     plens = {16, 0}
     for ep_id, ms in sorted(per_identity.items()):
-        enf.append((ep_id, ms.ingress_enforced, ms.egress_enforced))
+        enf.append((ep_id, ms.ingress_enforced, ms.egress_enforced,
+                    getattr(ms, "audit", False)))
         for key, entry in ms.entries.items():
             rid = -1
             if ruleset_of_entry is not None and entry.is_redirect:
@@ -118,7 +119,7 @@ def pack_mapstate(
     rid = np.array([rows[i][4] for i in order], dtype=np.int32)
     auth = np.array([rows[i][5] for i in order], dtype=bool)
     if not enf:
-        enf.append((-1, False, False))
+        enf.append((-1, False, False, False))
     enf.sort()
     return PackedMapState(
         key_w0=arr[:, 0].astype(np.int32),
@@ -128,7 +129,8 @@ def pack_mapstate(
         ruleset_id=rid,
         auth=auth,
         enf_ids=np.array([e[0] for e in enf], dtype=np.int32),
-        enf_flags=np.array([[e[1], e[2]] for e in enf], dtype=bool),
+        enf_flags=np.array([[e[1], e[2], e[3]] for e in enf],
+                           dtype=bool),
         port_plens=np.array(sorted(plens, reverse=True),
                             dtype=np.int32),
     )
@@ -166,7 +168,9 @@ def mapstate_lookup(
     ``ruleset`` [B] int32 (winning entry's ruleset id, -1 if none),
     ``match_spec`` [B] int32 (specificity of winning entry per
     MapStateKey.specificity, -1 default, DENY_SPEC on deny),
-    ``auth_required`` [B] bool (winning allow demands mutual auth).
+    ``auth_required`` [B] bool (winning allow demands mutual auth),
+    ``audit`` [B] bool (the owning endpoint is in per-endpoint
+    policy-audit mode — enf_flags column 2).
     """
     from cilium_tpu.policy.mapstate import ICMP_TYPE_BIT
 
@@ -249,4 +253,5 @@ def mapstate_lookup(
         "ruleset": ruleset,
         "match_spec": match_spec,
         "auth_required": auth_required,
+        "audit": enf_flags[eidx, 2] & eknown,
     }

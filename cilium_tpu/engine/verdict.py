@@ -1176,11 +1176,16 @@ def _verdict_core(arrays, ms, l7t, words, kafka_cols, auth_src_dst,
         pairs = batch["auth_pairs"]
         _, authed = lower_bound((pairs[:, 0], pairs[:, 1]), (src, dst))
         allowed = allowed & (~auth_required | authed)
-    # policy_audit_mode: a would-be denial forwards with verdict AUDIT
-    # (device scalar — no recompile when the mode flips)
-    deny_code = jnp.where(arrays["audit_mode"], int(Verdict.AUDIT),
-                          int(Verdict.DROPPED)) \
-        if "audit_mode" in arrays else jnp.int32(int(Verdict.DROPPED))
+    # policy_audit_mode: a would-be denial forwards with verdict AUDIT.
+    # Per FLOW: the global scalar (device-staged — no recompile when
+    # the mode flips) ORs with the owning endpoint's audit bit from
+    # the enforcement table (reference: per-endpoint PolicyAuditMode —
+    # one namespace can audit a new policy while the fleet enforces)
+    audit = ms.get("audit", jnp.zeros_like(ms["allowed"]))
+    if "audit_mode" in arrays:
+        audit = audit | arrays["audit_mode"]
+    deny_code = jnp.where(audit, int(Verdict.AUDIT),
+                          int(Verdict.DROPPED)).astype(jnp.int32)
     verdict = jnp.where(
         allowed,
         jnp.where(ms["redirect"], int(Verdict.REDIRECTED),

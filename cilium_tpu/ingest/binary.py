@@ -107,15 +107,25 @@ def _native() -> Optional[ctypes.CDLL]:
         if _lib is not None or _lib_tried:
             return _lib
         _lib_tried = True
-        # ALWAYS run make (a no-op when the .so is newer than
-        # capture.cpp): a stale pre-v3 library would reject version-3
-        # files the Python writer just produced
+        # rebuild when missing OR older than its sources (a stale
+        # pre-v3 library would reject version-3 files the Python
+        # writer just produced); a current .so costs two stat()s, not
+        # a make fork, per process
+        srcs = [os.path.join(NATIVE_DIR, n)
+                for n in ("capture.cpp", "Makefile")]
         try:
-            subprocess.run(["make", "-C", NATIVE_DIR],
-                           check=True, capture_output=True)
-        except (OSError, subprocess.CalledProcessError):
-            if not os.path.exists(LIB_PATH):
-                return None
+            stale = (not os.path.exists(LIB_PATH)
+                     or os.path.getmtime(LIB_PATH)
+                     < max(os.path.getmtime(s) for s in srcs))
+        except OSError:
+            stale = True
+        if stale:
+            try:
+                subprocess.run(["make", "-C", NATIVE_DIR],
+                               check=True, capture_output=True)
+            except (OSError, subprocess.CalledProcessError):
+                if not os.path.exists(LIB_PATH):
+                    return None
         try:
             lib = ctypes.CDLL(LIB_PATH)
         except OSError:
@@ -402,8 +412,8 @@ def write_capture_l7(path: str, flows: Iterable[Flow]) -> int:
             blob.ctypes.data_as(ctypes.c_void_p),
             int(blob.size)))
         return len(rec)
-    if lib is not None and gen is not None \
-            and hasattr(lib, "ct_capture_write_l7g"):
+    if lib is not None and gen is not None:
+        # _native() guarantees the v3 symbol (pre-v3 ABIs load as None)
         lib.ct_capture_write_l7g.restype = ctypes.c_int
         _check(lib.ct_capture_write_l7g(
             path.encode(),

@@ -141,6 +141,12 @@ class MapState:
         self.entries: Dict[MapStateKey, MapStateEntry] = {}
         self.ingress_enforced = False
         self.egress_enforced = False
+        #: per-endpoint policy-audit mode (reference: the endpoint
+        #: option PolicyAuditMode, settable per endpoint while the
+        #: fleet enforces): would-be denials for THIS endpoint's
+        #: policy verdict AUDIT instead of DROPPED. The global
+        #: ``Config.policy_audit_mode`` flag is the default-all.
+        self.audit = False
 
     def insert(self, key: MapStateKey, entry: MapStateEntry) -> None:
         cur = self.entries.get(key)

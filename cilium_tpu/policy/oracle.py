@@ -223,16 +223,21 @@ def l7_allowed(l7_rules: Tuple[L7Rules, ...], flow: Flow,
     return allowed, log
 
 
-def lookup_entry(per_identity: Dict[int, MapState], flow: Flow):
-    """The flow's winning MapState entry: ``(allowed, entry)``;
-    ``(True, None)`` when the endpoint has no policy. The ONE place
-    the ingress/egress endpoint-vs-peer identity selection lives —
-    the oracle's decide path and the proxy bridge's rewrite walk must
-    agree on it bit-for-bit."""
+def owner_mapstate(per_identity: Dict[int, MapState], flow: Flow):
+    """(owning endpoint's MapState or None, peer identity). The ONE
+    place the ingress/egress endpoint-vs-peer identity selection
+    lives — the oracle's decide path and the proxy bridge's rewrite
+    walk must agree on it bit-for-bit."""
     ingress = flow.direction == TrafficDirection.INGRESS
     ep_id = flow.dst_identity if ingress else flow.src_identity
     peer_id = flow.src_identity if ingress else flow.dst_identity
-    ms = per_identity.get(ep_id)
+    return per_identity.get(ep_id), peer_id
+
+
+def lookup_entry(per_identity: Dict[int, MapState], flow: Flow):
+    """The flow's winning MapState entry: ``(allowed, entry)``;
+    ``(True, None)`` when the endpoint has no policy."""
+    ms, peer_id = owner_mapstate(per_identity, flow)
     if ms is None:
         return True, None
     return ms.lookup(peer_id, flow.dport, int(flow.protocol),
@@ -254,6 +259,15 @@ class OracleVerdictEngine:
         #: about evaluation changes
         self.audit = audit
 
+    def _audit_for(self, flow: Flow) -> bool:
+        """Global audit flag OR the owning endpoint's per-endpoint
+        audit bit (MapState.audit — reference PolicyAuditMode per
+        endpoint)."""
+        if self.audit:
+            return True
+        ms, _ = owner_mapstate(self.per_identity, flow)
+        return ms is not None and getattr(ms, "audit", False)
+
     def _decide(self, flow: Flow):
         """One lookup → (verdict, winning_entry, allowed, l7_log)."""
         allowed, entry = lookup_entry(self.per_identity, flow)
@@ -270,7 +284,7 @@ class OracleVerdictEngine:
 
     def verdict_one(self, flow: Flow) -> Verdict:
         v = self._decide(flow)[0]
-        if self.audit and v == Verdict.DROPPED:
+        if v == Verdict.DROPPED and self._audit_for(flow):
             return Verdict.AUDIT
         return v
 
@@ -301,7 +315,7 @@ class OracleVerdictEngine:
             if (demand and pairs is not None
                     and (f.src_identity, f.dst_identity) not in pairs):
                 verdict = Verdict.DROPPED  # drop until handshake
-            if self.audit and verdict == Verdict.DROPPED:
+            if verdict == Verdict.DROPPED and self._audit_for(f):
                 # audit mode disables enforcement wholesale — auth
                 # drops included — but the would-be denial is reported
                 verdict = Verdict.AUDIT

@@ -320,6 +320,31 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                             body["enable_tpu_offload"])
                         agent.endpoint_manager.regenerate_all(wait=True)
                 return self._send(200, {"changed": dict(body)})
+            if path.startswith("/v1/endpoint/") \
+                    and path.endswith("/config"):
+                # per-endpoint options (`cilium-dbg endpoint config`):
+                # currently PolicyAuditMode
+                try:
+                    ep_id = int(path.split("/")[3])
+                except (ValueError, IndexError):
+                    return self._send(400, {"error": "endpoint id must "
+                                            "be an integer"})
+                body = json.loads(self._body() or b"{}")
+                unknown = set(body) - {"policy_audit_mode"}
+                if unknown:
+                    return self._send(
+                        400, {"error": f"unknown endpoint option(s) "
+                              f"{sorted(unknown)}"})
+                pam = body.get("policy_audit_mode")
+                if pam is not None and not isinstance(pam, bool):
+                    return self._send(
+                        400, {"error": "policy_audit_mode expects bool"})
+                try:
+                    ep = agent.endpoint_config(
+                        ep_id, policy_audit_mode=pam)
+                except KeyError:
+                    return self._send(404, {"error": "endpoint not found"})
+                return self._send(200, ep.to_json())
             return self._send(404, {"error": f"no such resource {path}"})
         except Exception as e:
             return self._send(400, {"error": f"{type(e).__name__}: {e}"})

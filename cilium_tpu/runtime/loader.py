@@ -106,14 +106,15 @@ class Loader:
 
         from cilium_tpu.engine.verdict import CompiledPolicy, VerdictEngine
 
-        # "policy-v4": v2 gained the ms_auth array; v3 port-range prefix
-        # keys (ms_plens + the w2 repack); v4 the audit_mode scalar —
-        # each bump invalidates older cached artifacts, and the entry
-        # tuple must include every verdict-relevant key/entry field or
-        # two policies differing only in that field would share one
+        # "policy-v5": v2 gained the ms_auth array; v3 port-range prefix
+        # keys (ms_plens + the w2 repack); v4 the audit_mode scalar; v5
+        # the per-endpoint audit bit (enf_flags grew a column) — each
+        # bump invalidates older cached artifacts, and the entry tuple
+        # must include every verdict-relevant key/entry field or two
+        # policies differing only in that field would share one
         # artifact
         key = ruleset_fingerprint(
-            "policy-v4",
+            "policy-v5",
             self.config.policy_audit_mode,
             sorted(
                 (
@@ -127,6 +128,7 @@ class Loader:
                     )),
                     ms.ingress_enforced,
                     ms.egress_enforced,
+                    getattr(ms, "audit", False),
                 )
                 for ep, ms in per_identity.items()
             ),

@@ -106,6 +106,20 @@ def test_rest_commands(live_agent, capsys):
                           "--api", api])
     assert rc == 0
 
+    # per-endpoint PolicyAuditMode over REST (`cilium-dbg endpoint
+    # config` analog): set, visible in the endpoint json, unset
+    rc, out = _run(capsys, ["endpoint", "config", "1",
+                            "PolicyAuditMode=Enabled", "--api", api])
+    assert rc == 0 and json.loads(out)["policy_audit_mode"] is True
+    rc, out = _run(capsys, ["endpoint", "get", "1", "--api", api])
+    assert rc == 0 and json.loads(out)["policy_audit_mode"] is True
+    rc, out = _run(capsys, ["endpoint", "config", "1",
+                            "PolicyAuditMode=Disabled", "--api", api])
+    assert rc == 0 and json.loads(out)["policy_audit_mode"] is False
+    rc, _ = _run(capsys, ["endpoint", "config", "1", "Bogus=1",
+                          "--api", api])
+    assert rc == 1
+
 
 def test_observe_streams_flows(live_agent, capsys):
     agent, svc, api, hubble, tmp = live_agent

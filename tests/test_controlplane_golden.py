@@ -660,6 +660,36 @@ def test_audit_mode_corpus_golden(offload):
         agent.stop()
 
 
+@pytest.mark.parametrize("offload", [False, True])
+def test_per_endpoint_audit_corpus_golden(offload):
+    """Per-endpoint PolicyAuditMode over the FULL corpus (VERDICT r3
+    item 5): with ONLY the db endpoint in audit mode, exactly the
+    denials whose owning endpoint is db flip DROPPED→AUDIT; every
+    other endpoint's identical denials keep enforcing — on either
+    backend."""
+    cfg = Config()
+    cfg.enable_tpu_offload = offload
+    cfg.configure_logging = False
+    agent, ids = build_agent(Agent(cfg))
+    try:
+        agent.endpoint_config(4, policy_audit_mode=True)  # "db"
+        flows = build_flows(ids)
+        out = agent.loader.engine.verdict_flows(flows)
+        with open(GOLDEN) as fp:
+            golden = json.load(fp)
+        want = []
+        for fl, v in zip(flows, golden["verdicts"]):
+            ingress = fl.direction == TrafficDirection.INGRESS
+            owner = fl.dst_identity if ingress else fl.src_identity
+            want.append(4 if v == 2 and owner == ids["db"] else v)
+        assert [int(v) for v in out["verdict"]] == want
+        # the corpus must actually exercise both regimes
+        assert 4 in want, "no db denial in the corpus flows"
+        assert 2 in want, "no still-enforced denial elsewhere"
+    finally:
+        agent.stop()
+
+
 if __name__ == "__main__":
     import sys
 

@@ -137,6 +137,67 @@ spec:
                           int(Verdict.AUDIT)]
 
 
+@pytest.mark.parametrize("offload", [False, True])
+def test_per_endpoint_audit_mode(offload):
+    """VERDICT r3 item 5: endpoint A in PolicyAuditMode AUDITs its
+    would-be denial while endpoint B's IDENTICAL flow DROPs — the
+    audit bit is per-endpoint in the staged tables, not a fleet-wide
+    scalar — on both backends, and flipping the option back restores
+    enforcement."""
+    agent = _agent(offload, audit=False)
+    try:
+        a = agent.endpoint_add(1, {"app": "a"})
+        b = agent.endpoint_add(2, {"app": "b"})
+        cli = agent.endpoint_add(3, {"app": "cli"})
+        for cnp in load_cnp_yaml_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: a}
+spec:
+  endpointSelector: {matchLabels: {app: a}}
+  ingress:
+  - fromEndpoints: [{matchLabels: {app: cli}}]
+    toPorts: [{ports: [{port: "80", protocol: TCP}]}]
+---
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: b}
+spec:
+  endpointSelector: {matchLabels: {app: b}}
+  ingress:
+  - fromEndpoints: [{matchLabels: {app: cli}}]
+    toPorts: [{ports: [{port: "80", protocol: TCP}]}]
+"""):
+            agent.policy_add(cnp)
+        agent.endpoint_config(1, policy_audit_mode=True)
+
+        flows = [
+            # identical denied flows (port 81 not allowed): A audits,
+            # B drops
+            Flow(src_identity=cli.identity, dst_identity=a.identity,
+                 dport=81),
+            Flow(src_identity=cli.identity, dst_identity=b.identity,
+                 dport=81),
+            # allowed traffic unaffected on both
+            Flow(src_identity=cli.identity, dst_identity=a.identity,
+                 dport=80),
+            Flow(src_identity=cli.identity, dst_identity=b.identity,
+                 dport=80),
+        ]
+        got = [int(v) for v in
+               agent.loader.engine.verdict_flows(flows)["verdict"]]
+        assert got == [int(Verdict.AUDIT), int(Verdict.DROPPED),
+                       int(Verdict.FORWARDED), int(Verdict.FORWARDED)]
+
+        # the bit round-trips off: enforcement restores
+        agent.endpoint_config(1, policy_audit_mode=False)
+        got = [int(v) for v in
+               agent.loader.engine.verdict_flows(flows[:2])["verdict"]]
+        assert got == [int(Verdict.DROPPED), int(Verdict.DROPPED)]
+    finally:
+        agent.stop()
+
+
 def test_audit_mode_engine_oracle_parity():
     """Hypothesis-lite sweep: audit engine == audit oracle across the
     synth http scenario, and equals the non-audit verdicts with

@@ -131,7 +131,8 @@ _entry = st.tuples(
 @settings(max_examples=60, deadline=None)
 @given(
     entries=st.lists(_entry, min_size=0, max_size=12),
-    flags=st.tuples(st.booleans(), st.booleans()),
+    flags=st.tuples(st.booleans(), st.booleans(),
+                    st.booleans()),        # (ing, eg, per-ep AUDIT)
     probes=st.lists(
         st.tuples(st.sampled_from([100, 200, 300, 999]),
                   st.sampled_from([0, 8, 53, 80, 82, 443, 1500, 32768,
@@ -143,7 +144,7 @@ _entry = st.tuples(
 )
 def test_mapstate_kernel_equals_golden(entries, flags, probes):
     ms = MapState()
-    ms.ingress_enforced, ms.egress_enforced = flags
+    ms.ingress_enforced, ms.egress_enforced, ms.audit = flags
     for peer, (port, plen), proto, direction, deny, auth in entries:
         ms.insert(MapStateKey(peer, port, proto, int(direction),
                               port_plen=plen),
@@ -169,6 +170,10 @@ def test_mapstate_kernel_equals_golden(entries, flags, probes):
         port_plens=jnp.asarray(packed.port_plens))
     got = np.asarray(out["allowed"])
     got_auth = np.asarray(out["auth_required"])
+    # the per-endpoint audit bit rides the enforcement table: the
+    # kernel must report exactly the owning MapState's flag
+    np.testing.assert_array_equal(np.asarray(out["audit"]),
+                                  np.full(B, ms.audit, dtype=bool))
 
     for i, (pid, pport, pproto, pdir) in enumerate(probes):
         want, entry = ms.lookup(pid, pport, pproto, int(pdir))
