@@ -52,6 +52,7 @@ class Agent:
                  dns_endpoint_of=None,
                  hubble_socket_path: Optional[str] = None,
                  accesslog_socket_path: Optional[str] = None,
+                 monitor_socket_path: Optional[str] = None,
                  kvstore: Optional[KVStore] = None):
         self.config = config or Config.from_env()
         self.state_dir = state_dir
@@ -159,6 +160,11 @@ class Agent:
         # proxies write JSON records; parsed flows land in the observer
         self.accesslog_server = None
         self.accesslog_socket_path = accesslog_socket_path
+        # monitor Unix socket (`cilium-dbg monitor` contract): second
+        # processes stream PolicyVerdict/Drop/Trace events with
+        # per-subscriber aggregation
+        self.monitor_server = None
+        self.monitor_socket_path = monitor_socket_path
         # FQDN updates retrigger regeneration (§3.2 tail)
         self.name_manager.on_update = (
             lambda sels: self.endpoint_manager.regenerate_all())
@@ -287,6 +293,11 @@ class Agent:
 
             self.accesslog_server = AccessLogServer(
                 self.observer, self.accesslog_socket_path).start()
+        if self.monitor_socket_path:
+            from cilium_tpu.monitor import MonitorServer
+
+            self.monitor_server = MonitorServer(
+                self.monitor, self.monitor_socket_path).start()
         if self.dns_proxy_bind is not None:
             from cilium_tpu.fqdn.server import DNSProxyServer
 
@@ -335,6 +346,8 @@ class Agent:
             self.hubble_server.stop()
         if self.accesslog_server is not None:
             self.accesslog_server.stop()
+        if self.monitor_server is not None:
+            self.monitor_server.stop()
         if self.dns_server is not None:
             self.dns_server.stop()
         if self.api_server is not None:
@@ -589,10 +602,17 @@ class Agent:
             for k, v in engine.verdict_flows(
                 flows, authed_pairs=self.auth.pairs_array()).items()
         }
+        self.fan_out(flows, outputs)
+        return outputs
+
+    def fan_out(self, flows: List, outputs: Dict) -> None:
+        """Observability fan-out for one verdicted batch: monitor
+        events (→ the monitor socket), verdict/match annotation, and
+        the hubble observer ring. The ONE place the sequence lives —
+        the replay pipeline and the verdict service both call it."""
         self.monitor.notify_batch(flows, outputs)
         annotate_flows(flows, outputs)
         self.observer.observe(flows)
-        return outputs
 
     # -- introspection (cilium-dbg surface) ------------------------------
     def status(self) -> Dict:

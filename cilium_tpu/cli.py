@@ -528,6 +528,33 @@ def cmd_policy_delete(args) -> int:
     return 0 if code == 200 else 1
 
 
+def cmd_monitor(args) -> int:
+    """`cilium-dbg monitor` analog: attach to the agent's monitor
+    socket and stream PolicyVerdict/Drop/Trace events as JSON lines,
+    with a per-subscription aggregation level."""
+    from cilium_tpu.monitor import monitor_follow
+
+    n = 0
+    try:
+        for ev in monitor_follow(args.socket, level=args.level,
+                                 types=args.type):
+            print(json.dumps(ev), flush=True)
+            n += 1
+            if args.count is not None and n >= args.count:
+                return 0
+    except KeyboardInterrupt:
+        return 0
+    except ConnectionError:
+        # the agent shut down: the stream ENDING is not an error
+        # (cilium-dbg monitor reports the end, not a failure)
+        print("monitor stream closed by agent", file=sys.stderr)
+        return 0
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_observe(args) -> int:
     """`hubble observe` analog: stream flows from the hubble socket."""
     from cilium_tpu.hubble.server import HubbleClient
@@ -695,6 +722,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     pd.add_argument("labels", nargs="+")
     pd.add_argument("--api", required=True)
     pd.set_defaults(fn=cmd_policy_delete)
+
+    p = sub.add_parser("monitor",
+                       help="stream datapath events from the monitor "
+                            "socket (cilium-dbg monitor analog)")
+    p.add_argument("--socket", required=True,
+                   help="agent monitor unix socket path")
+    p.add_argument("--level",
+                   choices=["none", "low", "medium", "maximum"],
+                   help="aggregation level for THIS subscription "
+                        "(default: the agent's level)")
+    p.add_argument("--type", action="append",
+                   choices=["drop", "debug", "capture", "trace",
+                            "policy_verdict"],
+                   help="event type filter (repeatable; default all)")
+    p.add_argument("--count", type=int, default=None,
+                   help="exit after N events")
+    p.set_defaults(fn=cmd_monitor)
 
     p = sub.add_parser("observe", help="stream flows from the hubble socket")
     p.add_argument("--hubble", required=True,

@@ -59,6 +59,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--accesslog-socket",
                     help="proxy accesslog ingest unix socket "
                          "(pkg/envoy accesslog server analog)")
+    ap.add_argument("--monitor-socket",
+                    help="monitor event stream unix socket "
+                         "(`cilium-dbg monitor` analog; per-subscriber "
+                         "aggregation levels)")
     ap.add_argument("--policy-dir",
                     help="directory of CNP YAML to watch (k8s-watcher "
                          "analog)")
@@ -119,6 +123,7 @@ def build(args):
         api_socket_path=args.api_socket,
         hubble_socket_path=args.hubble_socket,
         accesslog_socket_path=args.accesslog_socket,
+        monitor_socket_path=args.monitor_socket,
         policy_dir=args.policy_dir,
         dns_proxy_bind=_hostport(args.dns_proxy) if args.dns_proxy
         else None,

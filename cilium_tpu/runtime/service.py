@@ -59,12 +59,24 @@ def verdict_flows_padded(engine, flows: Sequence[Flow],
     the shape space to ~log2(batch_max) sizes so p99 under live load
     isn't a compile storm (SURVEY.md §7 hard part #5). Pad flows are
     identity-0 tuples; their verdicts are sliced off."""
+    return [int(v) for v in
+            verdict_outputs_padded(engine, flows,
+                                   authed_pairs=authed_pairs)["verdict"]]
+
+
+def verdict_outputs_padded(engine, flows: Sequence[Flow],
+                           authed_pairs=None):
+    """Full output lanes under the same pow2 padding (every lane
+    sliced back to the real batch) — for callers that fan the batch
+    out to observability and need match_spec/l7_log too."""
+    import numpy as np
+
     n = len(flows)
     target = 1 << max(0, n - 1).bit_length()
     if target > n:
         flows = list(flows) + [Flow()] * (target - n)
-    return [int(v) for v in engine.verdict_flows(
-        flows, authed_pairs=authed_pairs)["verdict"][:n]]
+    out = engine.verdict_flows(flows, authed_pairs=authed_pairs)
+    return {k: np.asarray(v)[:n] for k, v in out.items()}
 
 
 class MicroBatcher:
@@ -345,10 +357,17 @@ class VerdictService:
             engine = self.loader.engine
             if engine is None:
                 return {"error": "no policy loaded"}
-            verdicts = verdict_flows_padded(
+            out = verdict_outputs_padded(
                 engine, flows,
                 authed_pairs=self.bridge.authed_pairs_fn()
                 if self.bridge.authed_pairs_fn is not None else None)
+            verdicts = [int(v) for v in out["verdict"]]
+            if self.agent is not None and flows:
+                # the reference's datapath emits PolicyVerdictNotify
+                # whenever policy evaluation happened, so
+                # service-driven verdicts reach the monitor socket +
+                # hubble ring like replayed ones
+                self.agent.fan_out(flows, out)
             METRICS.inc("cilium_tpu_service_verdicts_total", len(flows))
             return {"verdicts": verdicts}
         if op == "on_new_connection":

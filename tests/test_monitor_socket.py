@@ -1,0 +1,154 @@
+"""Monitor Unix socket (VERDICT r3 item 6): the ``cilium-dbg
+monitor`` contract — a SECOND PROCESS attaches to a live agent's
+monitor socket and streams PolicyVerdictNotify events, with
+per-subscriber aggregation levels and type filters.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from cilium_tpu.agent import Agent
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import Flow
+from cilium_tpu.policy.api.cnp import load_cnp_yaml_text
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CNP = """
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: mon}
+spec:
+  endpointSelector: {matchLabels: {app: svc}}
+  ingress:
+  - fromEndpoints: [{matchLabels: {app: cli}}]
+    toPorts: [{ports: [{port: "80", protocol: TCP}]}]
+"""
+
+
+@pytest.fixture
+def live_agent(tmp_path):
+    sock = str(tmp_path / "monitor.sock")
+    cfg = Config()
+    cfg.configure_logging = False
+    agent = Agent(cfg, monitor_socket_path=sock).start()
+    svc = agent.endpoint_add(1, {"app": "svc"})
+    cli = agent.endpoint_add(2, {"app": "cli"})
+    agent.policy_add(load_cnp_yaml_text(CNP)[0])
+    yield agent, sock, svc, cli
+    agent.stop()
+
+
+def _wait_clients(agent, n, deadline=10.0):
+    t0 = time.monotonic()
+    while agent.monitor_server.num_clients() < n:
+        if time.monotonic() - t0 > deadline:
+            raise AssertionError(
+                f"monitor clients never reached {n} "
+                f"(at {agent.monitor_server.num_clients()})")
+        time.sleep(0.05)
+
+
+def _flows(svc, cli):
+    return [
+        Flow(src_identity=cli.identity, dst_identity=svc.identity,
+             dport=80),   # allowed
+        Flow(src_identity=cli.identity, dst_identity=svc.identity,
+             dport=81),   # denied
+    ]
+
+
+def test_second_process_streams_policy_verdicts(live_agent):
+    """The done criterion: `cilium-tpu monitor` in ANOTHER PROCESS
+    receives PolicyVerdictNotify (and Drop) events from a live
+    agent."""
+    agent, sock, svc, cli = live_agent
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cilium_tpu.cli", "monitor",
+         "--socket", sock, "--count", "3"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=REPO)
+    try:
+        _wait_clients(agent, 1)
+        agent.process_flows(_flows(svc, cli))
+        out, err = proc.communicate(timeout=30)
+    finally:
+        proc.kill()
+    assert proc.returncode == 0, err[-2000:]
+    events = [json.loads(ln) for ln in out.splitlines() if ln.strip()]
+    assert len(events) == 3
+    types = [e["type"] for e in events]
+    # MEDIUM (default) aggregation over (allow, deny):
+    # POLICY_VERDICT, POLICY_VERDICT + DROP — no TRACE
+    assert types.count("POLICY_VERDICT") == 2
+    assert types.count("DROP") == 1
+    pv = [e for e in events if e["type"] == "POLICY_VERDICT"]
+    assert {e["verdict"] for e in pv} == {"FORWARDED", "DROPPED"}
+    assert pv[0]["src_identity"] == cli.identity
+    assert pv[0]["dst_identity"] == svc.identity
+
+
+def test_per_subscriber_aggregation_and_type_filter(live_agent):
+    """Two concurrent subscribers: level=none sees per-flow TRACE
+    events the MEDIUM default suppresses; a types=["drop"] subscriber
+    sees only DROP — each connection gets ITS OWN level, the agent's
+    global level untouched."""
+    from cilium_tpu.monitor import monitor_follow
+
+    agent, sock, svc, cli = live_agent
+    verbose = monitor_follow(sock, level="none")
+    drops = monitor_follow(sock, types=["drop"])
+    _wait_clients(agent, 2)
+    agent.process_flows(_flows(svc, cli))
+
+    # verbose (none): PV+TRACE for the allow, PV+DROP for the deny
+    got = [next(verbose) for _ in range(4)]
+    assert [e["type"] for e in got] == [
+        "POLICY_VERDICT", "TRACE", "POLICY_VERDICT", "DROP"]
+    # drop-only subscriber: exactly the one DROP
+    d = next(drops)
+    assert d["type"] == "DROP" and d["dport"] == 81
+    assert d["message"] == "Policy denied"
+    verbose.close()
+    drops.close()
+
+
+def test_agent_shutdown_ends_stream_cleanly(tmp_path):
+    """A follower without --count exits 0 when the agent stops — the
+    stream ending is not an error (cilium-dbg monitor contract)."""
+    sock = str(tmp_path / "monitor.sock")
+    cfg = Config()
+    cfg.configure_logging = False
+    agent = Agent(cfg, monitor_socket_path=sock).start()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cilium_tpu.cli", "monitor",
+         "--socket", sock],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=REPO)
+    try:
+        _wait_clients(agent, 1)
+        agent.stop()
+        out, err = proc.communicate(timeout=30)
+    finally:
+        proc.kill()
+    assert proc.returncode == 0, err[-2000:]
+    assert "closed by agent" in err
+
+
+def test_bad_subscription_errors(live_agent):
+    from cilium_tpu.monitor import monitor_follow
+
+    agent, sock, svc, cli = live_agent
+    with pytest.raises(ValueError):
+        next(monitor_follow(sock, level="bogus"))
+    with pytest.raises(ValueError):
+        next(monitor_follow(sock, types=["nope"]))
