@@ -1,15 +1,27 @@
 #!/usr/bin/env python
 """Service-level tail-latency benchmark: Unix socket → MicroBatcher →
-engine, under concurrent closed-loop load.
+engine, under concurrent load.
 
 VERDICT r2 item 3 / SURVEY.md §7 hard part #5: the micro-batcher
 trades p99 latency for MXU utilization — this measures that trade
-honestly. Per deadline setting (default 0.5/2/8 ms), N client threads
-each run a closed loop of single-record ``check`` requests over the
-verdict service's Unix socket (4B-length-prefixed JSON — the same
-protocol the C++ shim speaks); every sample is CLIENT-OBSERVED wall
-time (socket + JSON + queueing + batcher deadline + engine). ≥200
-samples per point so p99 is a real quantile, not a max.
+honestly, in two regimes:
+
+* **Closed loop** (the original sweep): N client threads each run a
+  think-time-free request loop. Throughput is COUPLED to latency
+  (each thread has one request in flight), so this regime can never
+  fill large batches — it measures the lightly-loaded latency floor.
+* **Open loop** (VERDICT r3 item 4): requests arrive on a Poisson
+  schedule at a FIXED offered rate, independent of responses — the
+  regime micro-batching exists for. Latency is measured from the
+  SCHEDULED arrival time (wrk2-style), so a backed-up service shows
+  honest queueing delay instead of coordinated omission. The sweep
+  raises offered load until saturation (achieved < 90% of offered)
+  and reports the throughput-vs-p99 curve plus the achieved
+  batch-size distribution.
+
+Every sample is CLIENT-OBSERVED wall time over the verdict service's
+Unix socket (4B-length-prefixed JSON — the same protocol the C++ shim
+speaks); ≥200 samples per point so p99 is a real quantile, not a max.
 
 ``--shim`` adds a lane driving the C++ shim
 (shim/libcilium_shim.so → cshim_on_data with Kafka produce records)
@@ -24,6 +36,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
 import sys
 import threading
 import time
@@ -43,29 +56,64 @@ def build_engine(n_rules: int):
     return loader, scenario
 
 
+#: MicroBatcher flush-size histogram key (METRICS internal layout)
+_HIST_KEY = ("cilium_tpu_microbatch_size", ())
+
+
+def _prewarm(service, scenario, batch_max: int) -> None:
+    """Compile every pow2 batch shape the padded flush can produce —
+    an XLA compile inside a timed window would report compiler
+    latency, not service latency."""
+    size = 1
+    while size <= batch_max:
+        service.bridge._verdicts(scenario.flows[:size])
+        size *= 2
+
+
+def _hist_mark() -> int:
+    from cilium_tpu.runtime.metrics import METRICS
+
+    return len(METRICS._histos.get(_HIST_KEY, ()))
+
+
+def _batches_since(mark: int):
+    from cilium_tpu.runtime.metrics import METRICS
+
+    return METRICS._histos.get(_HIST_KEY, ())[mark:]
+
+
+def _quantiles(latencies: list) -> dict:
+    """samples/p50/p95/p99/max in ms (sorts in place); zeros when no
+    samples landed so every point carries the same schema."""
+    latencies.sort()
+    n = len(latencies)
+    if n == 0:
+        return {"samples": 0, "p50_ms": 0.0, "p95_ms": 0.0,
+                "p99_ms": 0.0, "max_ms": 0.0}
+
+    def q(p: float) -> float:
+        return round(latencies[min(n - 1, int(n * p))] * 1e3, 3)
+
+    return {"samples": n, "p50_ms": q(0.50), "p95_ms": q(0.95),
+            "p99_ms": q(0.99),
+            "max_ms": round(latencies[-1] * 1e3, 3)}
+
+
 def run_point(loader, scenario, deadline_ms: float, batch_max: int,
               threads: int, per_thread: int, warmup: int,
               sock_dir: str) -> dict:
     from cilium_tpu.ingest.hubble import flow_to_dict
-    from cilium_tpu.runtime.metrics import METRICS
     from cilium_tpu.runtime.service import VerdictClient, VerdictService
 
     sock = os.path.join(sock_dir, f"svc_{deadline_ms}.sock")
     service = VerdictService(loader, sock, batch_max=batch_max,
                              deadline_ms=deadline_ms)
     service.start()
-    # pre-warm every pow2 batch shape the padded flush can produce —
-    # an XLA compile inside the timed window would report compiler
-    # latency, not service latency
-    size = 1
-    while size <= batch_max:
-        service.bridge._verdicts(scenario.flows[:size])
-        size *= 2
+    _prewarm(service, scenario, batch_max)
     # distinct request templates per thread, pre-serialized
     reqs = [{"op": "check", "flow": flow_to_dict(f)}
             for f in scenario.flows[:threads * 64]]
-    hist_key = ("cilium_tpu_microbatch_size", ())
-    n_batches_before = len(METRICS._histos.get(hist_key, ()))
+    n_batches_before = _hist_mark()
 
     lat_lock = threading.Lock()
     latencies: list = []
@@ -119,31 +167,130 @@ def run_point(loader, scenario, deadline_ms: float, batch_max: int,
         w.join(timeout=30)
     service.stop()
 
-    sizes = METRICS._histos.get(hist_key, ())[n_batches_before:]
-    latencies.sort()
-    n = len(latencies)
-    if n == 0:  # every worker failed before timing anything
-        return {"deadline_ms": deadline_ms, "batch_max": batch_max,
-                "threads": threads, "samples": 0, "errors": errors[0],
-                "throughput_rps": 0.0, "p50_ms": 0.0, "p95_ms": 0.0,
-                "p99_ms": 0.0, "max_ms": 0.0, "mean_batch_size": 0}
-
-    def q(p: float) -> float:
-        return latencies[min(n - 1, int(n * p))] * 1e3
-
+    sizes = _batches_since(n_batches_before)
+    qs = _quantiles(latencies)
     return {
         "deadline_ms": deadline_ms,
         "batch_max": batch_max,
         "threads": threads,
-        "samples": n,
         "errors": errors[0],
-        "throughput_rps": round(n / t_wall, 1),
-        "p50_ms": round(q(0.50), 3),
-        "p95_ms": round(q(0.95), 3),
-        "p99_ms": round(q(0.99), 3),
-        "max_ms": round(latencies[-1] * 1e3, 3),
+        "throughput_rps": round(qs["samples"] / t_wall, 1)
+        if qs["samples"] else 0.0,
+        **qs,
         "mean_batch_size": round(sum(sizes) / len(sizes), 1) if sizes
         else 0,
+    }
+
+
+def run_open_point(loader, scenario, deadline_ms: float, batch_max: int,
+                   rate_rps: float, duration_s: float, conns: int,
+                   warmup: int, sock_dir: str) -> dict:
+    """One open-loop point: a Poisson arrival schedule at
+    ``rate_rps`` drives ``conns`` connections; workers pull the next
+    scheduled arrival from a shared cursor, sleep until it, send, and
+    record latency FROM THE SCHEDULED TIME — a worker that falls
+    behind charges the backlog to the measurement instead of silently
+    thinning the offered load (coordinated omission)."""
+    from cilium_tpu.ingest.hubble import flow_to_dict
+    from cilium_tpu.runtime.service import VerdictClient, VerdictService
+
+    sock = os.path.join(sock_dir, f"svc_open_{deadline_ms}.sock")
+    service = VerdictService(loader, sock, batch_max=batch_max,
+                             deadline_ms=deadline_ms)
+    service.start()
+    try:
+        _prewarm(service, scenario, batch_max)
+        reqs = [{"op": "check", "flow": flow_to_dict(f)}
+                for f in scenario.flows[:512]]
+        # fixed-seed Poisson schedule (reproducible offered load)
+        rng = random.Random(1234)
+        arrivals = []
+        t = 0.0
+        while t < duration_s:
+            t += rng.expovariate(rate_rps)
+            arrivals.append(t)
+        n_before = _hist_mark()
+
+        cursor = [0]
+        lock = threading.Lock()
+        latencies: list = []
+        errors = [0]
+        base_time = [0.0]
+        ready = threading.Barrier(conns + 1)
+        done = threading.Barrier(conns + 1)
+
+        def worker(tid: int):
+            # EVERY exit path passes BOTH barriers: main sorts the
+            # latency list after `done`, so a straggler extending it
+            # later would corrupt the sort
+            client = None
+            try:
+                client = VerdictClient(sock)
+                for i in range(warmup):
+                    client.call(reqs[(tid + i) % len(reqs)])
+            except Exception:
+                with lock:
+                    errors[0] += 1
+                client = None
+            ready.wait()
+            out = []
+            try:
+                if client is not None:
+                    base = base_time[0]
+                    while True:
+                        with lock:
+                            i = cursor[0]
+                            cursor[0] += 1
+                        if i >= len(arrivals):
+                            break
+                        sched = base + arrivals[i]
+                        now = time.perf_counter()
+                        if sched > now:
+                            time.sleep(sched - now)
+                        resp = client.call(reqs[i % len(reqs)])
+                        dt = time.perf_counter() - sched
+                        if "verdict" not in resp:
+                            with lock:
+                                errors[0] += 1
+                        out.append(dt)
+            except Exception:
+                with lock:
+                    errors[0] += 1
+            with lock:
+                latencies.extend(out)
+            done.wait()
+            if client is not None:
+                client.close()
+
+        workers = [threading.Thread(target=worker, args=(c,),
+                                    daemon=True) for c in range(conns)]
+        for w in workers:
+            w.start()
+        # workers block on the barrier until base_time is set
+        base_time[0] = time.perf_counter() + 0.05
+        ready.wait()
+        t0 = time.perf_counter()
+        done.wait()
+        wall = time.perf_counter() - t0
+        for w in workers:
+            w.join(timeout=30)
+    finally:
+        service.stop()
+
+    sizes = _batches_since(n_before)
+    qs = _quantiles(latencies)
+    return {
+        "deadline_ms": deadline_ms,
+        "offered_rps": rate_rps,
+        "achieved_rps": round(qs["samples"] / max(wall, 1e-9), 1)
+        if qs["samples"] else 0.0,
+        "errors": errors[0],
+        **qs,
+        "mean_batch_size": round(sum(sizes) / len(sizes), 1)
+        if sizes else 0,
+        "max_batch_size": int(max(sizes)) if sizes else 0,
+        "batch_max": batch_max,
+        "conns": conns,
     }
 
 
@@ -224,6 +371,24 @@ def main() -> int:
     ap.add_argument("--batch-max", type=int, default=256)
     ap.add_argument("--shim", action="store_true",
                     help="add the C++-shim kafka lane")
+    ap.add_argument("--no-open", action="store_true",
+                    help="skip the open-loop (Poisson fixed-rate) sweep")
+    ap.add_argument("--open-rates", default=None,
+                    help="comma-separated offered rates (rps); default "
+                         "doubles from 500 until saturation")
+    ap.add_argument("--open-deadline", type=float, default=8.0,
+                    help="MicroBatcher deadline (ms) for the open-loop "
+                         "sweep (the batching-regime deadline)")
+    ap.add_argument("--open-duration", type=float, default=3.0,
+                    help="seconds of offered load per open-loop point")
+    ap.add_argument("--open-conns", type=int, default=256,
+                    help="client connections serving the arrival "
+                         "schedule. The protocol is request-response "
+                         "per connection, so in-flight requests — "
+                         "and therefore the max achievable batch — "
+                         "are capped at this count (a proxy opens "
+                         "many connections in production for the "
+                         "same reason)")
     ap.add_argument("--out", default=None,
                     help="write the full sweep JSON here")
     args = ap.parse_args()
@@ -258,6 +423,37 @@ def main() -> int:
                 "metric": "service_shim_kafka_latency_d2.0ms",
                 "value": pt["p99_ms"], "unit": "ms p99",
                 "vs_baseline": 0.0, **pt}), flush=True)
+
+    open_points = []
+    if not args.no_open:
+        # open-loop throughput-vs-p99 curve (VERDICT r3 item 4): fixed
+        # offered rates until saturation — the regime where the
+        # batcher actually fills batches
+        d = args.open_deadline
+        if args.open_rates:
+            rates = [float(x) for x in args.open_rates.split(",")]
+            adaptive = False
+        else:
+            rates, adaptive = [500.0], True
+        i = 0
+        while i < len(rates):
+            rate = rates[i]
+            pt = run_open_point(loader, scenario, d, args.batch_max,
+                                rate, args.open_duration,
+                                args.open_conns, args.warmup, sock_dir)
+            pt["lane"] = "open_loop"
+            open_points.append(pt)
+            print(json.dumps({
+                "metric": f"service_open_loop_d{d}ms_"
+                          f"{int(rate)}rps_{args.rules}rules",
+                "value": pt["p99_ms"], "unit": "ms p99 (from scheduled "
+                "arrival)", "vs_baseline": 0.0, **pt}), flush=True)
+            saturated = (pt["achieved_rps"] < 0.9 * rate
+                         or pt["samples"] == 0)
+            if adaptive and not saturated and rate < 65536:
+                rates.append(rate * 2)
+            i += 1
+        points.extend(open_points)
     if args.out:
         with open(args.out, "w") as f:
             json.dump({"rules": args.rules, "points": points}, f,
