@@ -209,7 +209,6 @@ def run_open_point(loader, scenario, deadline_ms: float, batch_max: int,
         while t < duration_s:
             t += rng.expovariate(rate_rps)
             arrivals.append(t)
-        n_before = _hist_mark()
 
         cursor = [0]
         lock = threading.Lock()
@@ -231,6 +230,8 @@ def run_open_point(loader, scenario, deadline_ms: float, batch_max: int,
             except Exception:
                 with lock:
                     errors[0] += 1
+                if client is not None:
+                    client.close()  # don't leak the connected fd
                 client = None
             ready.wait()
             out = []
@@ -266,12 +267,18 @@ def run_open_point(loader, scenario, deadline_ms: float, batch_max: int,
                                     daemon=True) for c in range(conns)]
         for w in workers:
             w.start()
-        # workers block on the barrier until base_time is set
+        # workers block on the barrier until base_time is set; warmup
+        # has fully finished once every worker reaches the barrier, so
+        # the histogram mark taken HERE excludes warmup batches from
+        # the reported batch-size distribution
         base_time[0] = time.perf_counter() + 0.05
         ready.wait()
-        t0 = time.perf_counter()
+        n_before = _hist_mark()
         done.wait()
-        wall = time.perf_counter() - t0
+        # wall from the SCHEDULE ORIGIN, not barrier release: the
+        # 50ms lead-in must not dilute achieved_rps into a false
+        # saturation verdict at short durations
+        wall = time.perf_counter() - base_time[0]
         for w in workers:
             w.join(timeout=30)
     finally:

@@ -1,0 +1,210 @@
+"""Modern (flexible-version, KIP-482) Kafka frames fail CLOSED.
+
+The parser implements the v0-era classic wire format (see
+``proxylib/kafka.py`` module docstring and the PARITY Kafka row).
+Flexible versions (produce v9+, fetch v12+) switch the body to
+compact strings/arrays and tagged fields — these fixtures are
+byte-exact flexible frames proving what happens when one arrives:
+
+* the version-independent request-header prefix (api_key,
+  api_version, correlation, classic client_id) still parses;
+* the body does NOT (compact/tagged layout), so the record carries
+  the unmatchable ``\\x00unparseable`` topic → every topic-constrained
+  rule DENIES (fail closed, never a false allow);
+* an api-key-scoped rule with no topic constraint still matches on
+  the (stable) api_key — "allow all produce" means all produce;
+* the denial is a bare DROP (no injected error response: the v0-era
+  encoder refuses to guess a flexible response layout) and the
+  connection does NOT desync (framing is the stable size prefix).
+"""
+
+import struct
+
+import pytest
+
+from cilium_tpu.core.flow import Protocol
+from cilium_tpu.core.identity import IdentityAllocator
+from cilium_tpu.core.labels import LabelSet
+from cilium_tpu.policy.api import (
+    EndpointSelector,
+    IngressRule,
+    L7Rules,
+    PortProtocol,
+    PortRule,
+    PortRuleKafka,
+    Rule,
+)
+from cilium_tpu.policy.mapstate import PolicyResolver
+from cilium_tpu.policy.repository import Repository
+from cilium_tpu.policy.selectorcache import SelectorCache
+from cilium_tpu.proxylib import Connection, OpType, create_parser
+from cilium_tpu.proxylib.kafka import encode_request, parse_request_records
+from cilium_tpu.core.config import Config
+from cilium_tpu.runtime.loader import Loader
+from cilium_tpu.runtime.service import PolicyBridge
+
+
+# -- flexible wire primitives (KIP-482) ------------------------------------
+
+def _uvarint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _compact_str(s: str) -> bytes:
+    b = s.encode()
+    return _uvarint(len(b) + 1) + b
+
+
+def _classic_str(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">h", len(b)) + b
+
+
+def produce_v9(topic: str, correlation: int = 7,
+               client_id: str = "modern-client") -> bytes:
+    """A byte-exact flexible produce (api_key 0, version 9) request:
+    header v2 (client_id stays a CLASSIC string per KIP-482; tagged
+    fields follow) + compact body."""
+    head = struct.pack(">hhi", 0, 9, correlation)
+    head += _classic_str(client_id)
+    head += _uvarint(0)                      # header tagged fields
+    body = _uvarint(0)                       # transactional_id = null
+    body += struct.pack(">hi", 1, 30000)     # acks, timeout_ms
+    body += _uvarint(1 + 1)                  # topics: compact array, 1
+    body += _compact_str(topic)
+    body += _uvarint(1 + 1)                  # partitions: 1
+    body += struct.pack(">i", 0)             # partition index
+    body += _uvarint(0)                      # records = null
+    body += _uvarint(0)                      # partition tagged fields
+    body += _uvarint(0)                      # topic tagged fields
+    body += _uvarint(0)                      # request tagged fields
+    frame = head + body
+    return struct.pack(">i", len(frame)) + frame
+
+
+def fetch_v12(topic: str, correlation: int = 9) -> bytes:
+    """A byte-exact flexible fetch (api_key 1, version 12) request."""
+    head = struct.pack(">hhi", 1, 12, correlation)
+    head += _classic_str("modern-consumer")
+    head += _uvarint(0)
+    body = struct.pack(">iii", -1, 500, 1)   # replica,max_wait,min_bytes
+    body += struct.pack(">i", 1 << 20)       # max_bytes (v3+)
+    body += struct.pack(">b", 0)             # isolation_level (v4+)
+    body += struct.pack(">ii", 0, -1)        # session id/epoch (v7+)
+    body += _uvarint(1 + 1)                  # topics: 1
+    body += _compact_str(topic)
+    body += _uvarint(1 + 1)                  # partitions: 1
+    # partition i32, current_leader_epoch i32, fetch_offset i64,
+    # last_fetched_epoch i32 (v12+), log_start_offset i64,
+    # partition_max_bytes i32
+    body += struct.pack(">iiqiqi", 0, -1, 0, -1, -1, 1 << 20)
+    body += _uvarint(0)                      # partition tagged
+    body += _uvarint(0)                      # topic tagged
+    body += _uvarint(1 + 0)                  # forgotten_topics: 0
+    body += _compact_str("")                 # rack_id (compact)
+    body += _uvarint(0)                      # request tagged
+    frame = head + body
+    return struct.pack(">i", len(frame)) + frame
+
+
+def _loader(kafka_rules):
+    rules = [Rule(
+        endpoint_selector=EndpointSelector.from_labels(app="kafka"),
+        ingress=(IngressRule(to_ports=(PortRule(
+            ports=(PortProtocol(9092, Protocol.TCP),),
+            rules=L7Rules(kafka=tuple(kafka_rules)),
+        ),)),),
+    )]
+    alloc = IdentityAllocator()
+    ids = {n: alloc.allocate(LabelSet.from_dict({"app": n}))
+           for n in ("kafka", "cli")}
+    cache = SelectorCache(alloc)
+    repo = Repository()
+    repo.add(rules, sanitize=False)
+    resolver = PolicyResolver(repo, cache)
+    per_identity = {i: resolver.resolve(alloc.lookup(i))
+                    for i in ids.values()}
+    loader = Loader(Config())
+    loader.regenerate(per_identity, revision=1)
+    return loader, ids
+
+
+def _parser(loader, ids):
+    bridge = PolicyBridge(loader, deadline_ms=1.0)
+    conn = Connection(proto="kafka", connection_id=1, ingress=True,
+                      src_identity=ids["cli"], dst_identity=ids["kafka"],
+                      dport=9092)
+    return create_parser("kafka", conn, bridge.policy_check(conn)), conn
+
+
+def test_flexible_header_prefix_parses_body_fails_closed():
+    """The stable header fields come through; the compact body yields
+    the unmatchable topic sentinel, never a real-looking topic."""
+    for frame, key, ver in ((produce_v9("allowed-topic"), 0, 9),
+                            (fetch_v12("allowed-topic"), 1, 12)):
+        (rec,) = parse_request_records(frame[4:])
+        assert rec.api_key == key
+        assert rec.api_version == ver
+        assert rec.topic.startswith("\x00"), (
+            f"flexible v{ver} body must not parse as a real topic "
+            f"(got {rec.topic!r})")
+
+
+@pytest.mark.parametrize("make_frame", [produce_v9, fetch_v12])
+def test_topic_scoped_rule_denies_flexible_frame(make_frame):
+    """A topic ACL that ALLOWS this very topic on classic frames still
+    DENIES the flexible encoding of it — unparseable topic data must
+    never satisfy a topic constraint."""
+    loader, ids = _loader([
+        PortRuleKafka(role="produce", topic="allowed-topic"),
+        PortRuleKafka(role="consume", topic="allowed-topic"),
+    ])
+    parser, conn = _parser(loader, ids)
+    frame = make_frame("allowed-topic")
+    ops = parser.on_data(False, False, frame)
+    # bare DROP: the v0-era error encoder refuses to guess a flexible
+    # response layout (a wrong guess would desync the client)
+    assert ops == [(OpType.DROP, len(frame))]
+    assert conn.take_inject() == b""
+
+    # classic v0 framing of the SAME topic is allowed — the deny above
+    # is the version, not the ACL
+    classic = encode_request(0, 1, 2, "c", "allowed-topic")
+    ops = parser.on_data(False, False, classic)
+    assert ops == [(OpType.PASS, len(classic))]
+
+
+def test_unconstrained_api_key_rule_still_matches():
+    """An api-key-scoped rule with no topic/client constraint admits a
+    flexible produce: api_key parses from the version-independent
+    header, and 'allow all produce' means all produce."""
+    loader, ids = _loader([PortRuleKafka(role="produce")])
+    parser, _ = _parser(loader, ids)
+    frame = produce_v9("whatever")
+    ops = parser.on_data(False, False, frame)
+    assert ops == [(OpType.PASS, len(frame))]
+    # ...but a fetch (not in the produce role's api keys) is denied
+    f = fetch_v12("whatever")
+    ops = parser.on_data(False, False, f)
+    assert ops[-1] == (OpType.DROP, len(f))
+
+
+def test_no_desync_after_flexible_frame():
+    """Framing is the stable size prefix: a classic frame following a
+    denied flexible one parses normally (no stream desync)."""
+    loader, ids = _loader([PortRuleKafka(role="produce",
+                                         topic="allowed-topic")])
+    parser, conn = _parser(loader, ids)
+    modern = produce_v9("allowed-topic")
+    classic = encode_request(0, 1, 3, "c", "allowed-topic")
+    ops = parser.on_data(False, False, modern + classic)
+    assert ops[0] == (OpType.DROP, len(modern))
+    assert ops[-1] == (OpType.PASS, len(classic))
