@@ -208,6 +208,16 @@ def cmd_replay(args) -> int:
                         replay_session = CaptureReplay(
                             engine, chunk.l7_all, chunk.offsets,
                             chunk.blob, cfg.engine, gen=chunk.gen_all)
+                        # featurize the whole file once — chunks then
+                        # slice (the staged-table discipline applied
+                        # to the row block too). Only when the run
+                        # actually covers the file: a --limit/--start/
+                        # cursor-bounded replay must not pay (or
+                        # allocate) whole-capture featurization for a
+                        # few chunks
+                        if args.limit is None and chunk.start == 0:
+                            replay_session.stage_rows(
+                                chunk.records_all, chunk.l7_all)
                     else:
                         replay_session = False
                 if chunk.l7 is not None and replay_session:

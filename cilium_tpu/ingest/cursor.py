@@ -37,6 +37,9 @@ class RawChunk:
     gen: object = None
     gen_all: object = None
     start: int = 0
+    #: the whole capture's record array (memmap) — lets a replay
+    #: session featurize the file ONCE (CaptureReplay.stage_rows)
+    records_all: object = None
 
     def __len__(self) -> int:  # noqa: D105 — chunk length = records
         return len(self.records)
@@ -143,7 +146,7 @@ def replay_chunks(capture: str, chunk_size: int = 8192,
                                              gen=genraw)
                          if decode else RawChunk(
                              raw, l7raw, offsets, blob, widths, l7,
-                             genraw, gen_all, index))
+                             genraw, gen_all, index, records))
             else:
                 chunk = (records_to_flows(raw) if decode
                          else RawChunk(raw))
