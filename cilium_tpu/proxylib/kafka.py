@@ -1,19 +1,37 @@
 """Kafka wire-protocol parser.
 
-Reference: ``proxylib/kafka`` + the Kafka v0-era wire format (public
-protocol spec): a request frame is
+Reference: ``proxylib/kafka`` + the public Kafka protocol spec: a
+request frame is
 
     int32 size | int16 api_key | int16 api_version | int32 correlation
-    | string client_id | <api-specific body>
+    | string client_id | [flexible: tagged fields] | <api body>
 
 Topic extraction implemented for the record-carrying APIs the rules
 target (BASELINE config[2] "topic/API-key ACL rules × produce/fetch
-records"): produce (acks,timeout then topic array), fetch (replica,
-max_wait,min_bytes then topic array), metadata (topic array). Other
-APIs yield a single record with an empty topic (matched on api_key
-alone). Requests are verdicted per frame: every parsed record must be
-allowed, else the frame is DROPPED and a Kafka error response
-(TOPIC_AUTHORIZATION_FAILED, v0 response shape per API) is INJECTed
+records"), across the format's generations:
+
+* **produce** v0–v2 (acks,timeout then classic topic array), v3–v8
+  (leading transactional_id), v9–v11 FLEXIBLE (KIP-482: header
+  tagged fields, compact strings/arrays, per-partition compact
+  record batches + tagged fields);
+* **fetch** v0–v2 (replica,max_wait,min_bytes), v3–v6 (+max_bytes,
+  isolation), v7–v11 (+session id/epoch, per-partition
+  log_start_offset, v9+ current_leader_epoch), v12 FLEXIBLE
+  (+last_fetched_epoch, compact layout). v13+ replaced topic NAMES
+  with topic-id uuids (KIP-516) — decoding them as names would let a
+  crafted frame present a fake allowed name, so they fail CLOSED by
+  version gate;
+* **metadata** v0–v8 classic topic array (v9+ is flexible with
+  topic-id structs — not decoded; fails CLOSED below).
+
+Other APIs yield a single record with an empty topic (matched on
+api_key alone). ANY walk failure — truncated data, a version newer
+than the layouts above, compact/tagged garbage — produces the
+unmatchable ``\\x00unparseable`` topic sentinel, so topic-constrained
+rules fail CLOSED rather than ever matching a guessed topic. Requests
+are verdicted per frame: every parsed record must be allowed, else
+the frame is DROPPED and a Kafka error response
+(TOPIC_AUTHORIZATION_FAILED, v0-era response shapes only) is INJECTed
 back to the client — matching the reference, where a denied produce
 still gets a well-formed broker error instead of a hung request.
 Responses pass through.
@@ -44,6 +62,63 @@ def _read_string(buf: bytes, off: int) -> Tuple[Optional[str], int]:
     return buf[off:off + n].decode("utf-8", "replace"), off + n
 
 
+class _WalkError(Exception):
+    """Body-walk failure → the unparseable (fail-closed) record."""
+
+
+# -- flexible-version (KIP-482) primitives ---------------------------------
+
+def _read_uvarint(buf: bytes, off: int) -> Tuple[int, int]:
+    out = 0
+    shift = 0
+    while True:
+        if off >= len(buf) or shift > 28:
+            raise _WalkError("truncated/oversized uvarint")
+        b = buf[off]
+        off += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, off
+        shift += 7
+
+
+def _skip_tagged(buf: bytes, off: int) -> int:
+    """Skip a tagged-fields block (uvarint count, then per field a
+    uvarint tag + uvarint size + size bytes)."""
+    n, off = _read_uvarint(buf, off)
+    if n > 64:
+        raise _WalkError("implausible tagged-field count")
+    for _ in range(n):
+        _, off = _read_uvarint(buf, off)       # tag
+        size, off = _read_uvarint(buf, off)    # value size
+        off += size
+        if off > len(buf):
+            raise _WalkError("truncated tagged field")
+    return off
+
+
+def _read_compact_str(buf: bytes, off: int) -> Tuple[Optional[str], int]:
+    """Compact (nullable) string: uvarint length+1; 0 = null."""
+    n1, off = _read_uvarint(buf, off)
+    if n1 == 0:
+        return None, off
+    n = n1 - 1
+    if off + n > len(buf):
+        raise _WalkError("truncated compact string")
+    return buf[off:off + n].decode("utf-8", "replace"), off + n
+
+
+def _skip_compact_bytes(buf: bytes, off: int) -> int:
+    """Compact nullable bytes: uvarint length+1; 0 = null."""
+    n1, off = _read_uvarint(buf, off)
+    if n1 == 0:
+        return off
+    off += n1 - 1
+    if off > len(buf):
+        raise _WalkError("truncated compact bytes")
+    return off
+
+
 def parse_request_records(frame: bytes) -> List[KafkaInfo]:
     """Parse one complete request frame (without the 4-byte size prefix)
     into policy-checkable records."""
@@ -61,17 +136,59 @@ def parse_request_records(frame: bytes) -> List[KafkaInfo]:
                 client_id=client_id, correlation_id=correlation)
 
     topics: Optional[List[str]] = []
+    v = api_version
     try:
         if api_key == API_PRODUCE:
-            off += 6  # acks int16 + timeout int32
-            topics = _read_topic_array(frame, off, _skip_produce_partitions)
+            if v > 11:
+                # beyond the layouts verified byte-exactly: fail
+                # closed, never walk with a guessed layout (a wrong
+                # walk can extract an attacker-chosen fake topic)
+                raise _WalkError(f"produce v{v} not decoded")
+            if v >= 9:  # flexible (v9-v11 share the topic layout)
+                off = _skip_tagged(frame, off)  # header tagged fields
+                _, off = _read_compact_str(frame, off)  # transactional_id
+                off += 6  # acks int16 + timeout int32
+                topics = _read_compact_topic_array(
+                    frame, off, _skip_produce_partitions_flex)
+            else:
+                if v >= 3:  # transactional_id (nullable classic string)
+                    tx, off = _read_string(frame, off)
+                    if tx is None:
+                        raise _WalkError("truncated transactional_id")
+                off += 6  # acks int16 + timeout int32
+                topics = _read_topic_array(frame, off,
+                                           _skip_produce_partitions)
         elif api_key == API_FETCH:
-            off += 12  # replica int32 + max_wait int32 + min_bytes int32
-            topics = _read_topic_array(frame, off, _skip_fetch_partitions)
+            if v > 12:
+                # v13+ replaced topic names with topic-id uuids
+                # (KIP-516): walking them as names would let a crafted
+                # frame present a fake allowed name for a forbidden
+                # topic — fail closed
+                raise _WalkError(f"fetch v{v} not decoded")
+            if v == 12:  # flexible, name-based
+                off = _skip_tagged(frame, off)
+                off += 25  # replica,max_wait,min_bytes,max_bytes i32s
+                #          + isolation i8 + session id/epoch i32s
+                topics = _read_compact_topic_array(
+                    frame, off, _skip_fetch_partitions_flex)
+            else:
+                # classic header grows with the version:
+                # v0-2: replica+max_wait+min_bytes; v3: +max_bytes;
+                # v4-6: +isolation; v7-11: +session id/epoch
+                off += (12 if v <= 2 else 16 if v == 3
+                        else 17 if v <= 6 else 25)
+                per_part = 16 if v <= 4 else 24 if v <= 8 else 28
+                topics = _read_topic_array(
+                    frame, off,
+                    lambda f, o: _skip_fetch_partitions(f, o, per_part))
         elif api_key == API_METADATA:
+            if v >= 9:
+                # flexible metadata carries topic-id structs we don't
+                # decode — fail CLOSED, never guess
+                raise _WalkError("flexible metadata not decoded")
             topics = _read_topic_array(frame, off, None)
-    except Exception:
-        topics = None
+    except Exception:  # incl. _WalkError: any walk failure is the
+        topics = None  # fail-closed sentinel below
     if topics is None:
         # unparseable topic data: return an unmatchable record so
         # topic-constrained rules DENY (conservative; never bypass)
@@ -100,17 +217,70 @@ def _skip_produce_partitions(frame: bytes, off: int) -> Optional[int]:
     return off
 
 
-def _skip_fetch_partitions(frame: bytes, off: int) -> Optional[int]:
-    """fetch v0 per-topic payload: array<partition int32, offset int64,
-    max_bytes int32> (16 bytes each)."""
+def _skip_fetch_partitions(frame: bytes, off: int,
+                           per_part: int = 16) -> Optional[int]:
+    """fetch classic per-topic payload: array of fixed-size partition
+    entries (16B v0-4: partition i32 + offset i64 + max_bytes i32;
+    24B v5-8: + log_start_offset i64; 28B v9-11: + leader_epoch)."""
     if off + 4 > len(frame):
         return None
     (n,) = struct.unpack_from(">i", frame, off)
     off += 4
-    need = 16 * max(0, n)
+    need = per_part * max(0, n)
     if n < 0 or off + need > len(frame):
         return None
     return off + need
+
+
+def _skip_produce_partitions_flex(frame: bytes, off: int) -> int:
+    """flexible produce per-topic payload: compact array of
+    {index i32, records compact-bytes, tagged}, then topic tagged."""
+    n1, off = _read_uvarint(frame, off)
+    n = max(0, n1 - 1)
+    if n > 4096:
+        raise _WalkError("implausible partition count")
+    for _ in range(n):
+        off += 4  # partition index
+        if off > len(frame):
+            raise _WalkError("truncated partition")
+        off = _skip_compact_bytes(frame, off)   # record batch
+        off = _skip_tagged(frame, off)          # partition tagged
+    return _skip_tagged(frame, off)             # topic tagged
+
+
+def _skip_fetch_partitions_flex(frame: bytes, off: int) -> int:
+    """flexible fetch per-topic payload: compact array of
+    {partition i32, current_leader_epoch i32, fetch_offset i64,
+    last_fetched_epoch i32, log_start_offset i64, max_bytes i32,
+    tagged} (32B fixed + tagged each), then topic tagged."""
+    n1, off = _read_uvarint(frame, off)
+    n = max(0, n1 - 1)
+    if n > 4096:
+        raise _WalkError("implausible partition count")
+    for _ in range(n):
+        off += 32
+        if off > len(frame):
+            raise _WalkError("truncated partition")
+        off = _skip_tagged(frame, off)
+    return _skip_tagged(frame, off)
+
+
+def _read_compact_topic_array(frame: bytes, off: int,
+                              skip_payload) -> List[str]:
+    """Flexible (compact) topic array: every topic name is extracted
+    and policy-checked, like the classic walk."""
+    n1, off = _read_uvarint(frame, off)
+    n = max(0, n1 - 1)
+    if n > 1024:
+        raise _WalkError("implausible topic count")
+    out: List[str] = []
+    for _ in range(n):
+        t, off = _read_compact_str(frame, off)
+        if t is None:
+            raise _WalkError("null topic name")
+        out.append(t)
+        off = skip_payload(frame, off)
+    return out
 
 
 def _read_topic_array(frame: bytes, off: int,

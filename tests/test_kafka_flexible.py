@@ -1,21 +1,21 @@
-"""Modern (flexible-version, KIP-482) Kafka frames fail CLOSED.
+"""Modern (flexible-version, KIP-482) Kafka frames: DECODED, and
+fail-closed everywhere decoding ends.
 
-The parser implements the v0-era classic wire format (see
-``proxylib/kafka.py`` module docstring and the PARITY Kafka row).
-Flexible versions (produce v9+, fetch v12+) switch the body to
-compact strings/arrays and tagged fields — these fixtures are
-byte-exact flexible frames proving what happens when one arrives:
+Round 4 first proved flexible frames fail closed; the walk now
+understands them (``proxylib/kafka.py``): produce v3–v8 (leading
+transactional_id) and v9+ flexible (header tagged fields, compact
+strings/arrays, compact record batches), fetch v3–v11 classic
+evolution and v12+ flexible. These fixtures are byte-exact flexible
+frames asserting both halves of the contract:
 
-* the version-independent request-header prefix (api_key,
-  api_version, correlation, classic client_id) still parses;
-* the body does NOT (compact/tagged layout), so the record carries
-  the unmatchable ``\\x00unparseable`` topic → every topic-constrained
-  rule DENIES (fail closed, never a false allow);
-* an api-key-scoped rule with no topic constraint still matches on
-  the (stable) api_key — "allow all produce" means all produce;
-* the denial is a bare DROP (no injected error response: the v0-era
-  encoder refuses to guess a flexible response layout) and the
-  connection does NOT desync (framing is the stable size prefix).
+* topic ACLs enforce on flexible frames exactly as on classic ones
+  (allowed topic passes, wrong topic drops);
+* anything beyond the decoded layouts — flexible metadata, corrupt
+  compact lengths — still yields the unmatchable ``\\x00unparseable``
+  topic, so topic-constrained rules fail CLOSED, never a guess;
+* a denied flexible frame is a bare DROP (the error-response encoder
+  stays v0-era: a guessed flexible response would desync the client)
+  and the size-prefix framing never desyncs.
 """
 
 import struct
@@ -68,7 +68,7 @@ def _classic_str(s: str) -> bytes:
     return struct.pack(">h", len(b)) + b
 
 
-def produce_v9(topic: str, correlation: int = 7,
+def produce_v9(*topics: str, correlation: int = 7,
                client_id: str = "modern-client") -> bytes:
     """A byte-exact flexible produce (api_key 0, version 9) request:
     header v2 (client_id stays a CLASSIC string per KIP-482; tagged
@@ -78,14 +78,32 @@ def produce_v9(topic: str, correlation: int = 7,
     head += _uvarint(0)                      # header tagged fields
     body = _uvarint(0)                       # transactional_id = null
     body += struct.pack(">hi", 1, 30000)     # acks, timeout_ms
-    body += _uvarint(1 + 1)                  # topics: compact array, 1
-    body += _compact_str(topic)
-    body += _uvarint(1 + 1)                  # partitions: 1
-    body += struct.pack(">i", 0)             # partition index
-    body += _uvarint(0)                      # records = null
-    body += _uvarint(0)                      # partition tagged fields
-    body += _uvarint(0)                      # topic tagged fields
+    body += _uvarint(len(topics) + 1)        # topics: compact array
+    for t in topics:
+        body += _compact_str(t)
+        body += _uvarint(1 + 1)              # partitions: 1
+        body += struct.pack(">i", 0)         # partition index
+        body += _uvarint(0)                  # records = null
+        body += _uvarint(0)                  # partition tagged fields
+        body += _uvarint(0)                  # topic tagged fields
     body += _uvarint(0)                      # request tagged fields
+    frame = head + body
+    return struct.pack(">i", len(frame)) + frame
+
+
+def produce_v3(topic: str, correlation: int = 5) -> bytes:
+    """Classic produce v3: the transactional_id era (nullable classic
+    string BEFORE acks) — misparsed as v0 it would read garbage."""
+    head = struct.pack(">hhi", 0, 3, correlation)
+    head += _classic_str("txn-client")
+    body = struct.pack(">h", -1)             # transactional_id = null
+    body += struct.pack(">hi", 1, 30000)     # acks, timeout_ms
+    tb = topic.encode()
+    body += struct.pack(">i", 1)             # topics: 1
+    body += struct.pack(">h", len(tb)) + tb
+    msgset = b"\x00" * 12
+    body += struct.pack(">i", 1)             # partitions: 1
+    body += struct.pack(">ii", 0, len(msgset)) + msgset
     frame = head + body
     return struct.pack(">i", len(frame)) + frame
 
@@ -111,6 +129,20 @@ def fetch_v12(topic: str, correlation: int = 9) -> bytes:
     body += _uvarint(1 + 0)                  # forgotten_topics: 0
     body += _compact_str("")                 # rack_id (compact)
     body += _uvarint(0)                      # request tagged
+    frame = head + body
+    return struct.pack(">i", len(frame)) + frame
+
+
+def metadata_v9(correlation: int = 4) -> bytes:
+    """Flexible metadata (topic-id structs) — NOT decoded; the walk
+    must fail closed rather than guess."""
+    head = struct.pack(">hhi", 3, 9, correlation)
+    head += _classic_str("admin")
+    head += _uvarint(0)
+    body = _uvarint(1 + 1)                   # topics: 1 (struct form)
+    body += b"\x00" * 16                     # topic_id uuid (v10 form)
+    body += _compact_str("secret-topic")
+    body += _uvarint(0)
     frame = head + body
     return struct.pack(">i", len(frame)) + frame
 
@@ -145,66 +177,93 @@ def _parser(loader, ids):
     return create_parser("kafka", conn, bridge.policy_check(conn)), conn
 
 
-def test_flexible_header_prefix_parses_body_fails_closed():
-    """The stable header fields come through; the compact body yields
-    the unmatchable topic sentinel, never a real-looking topic."""
-    for frame, key, ver in ((produce_v9("allowed-topic"), 0, 9),
-                            (fetch_v12("allowed-topic"), 1, 12)):
-        (rec,) = parse_request_records(frame[4:])
-        assert rec.api_key == key
-        assert rec.api_version == ver
-        assert rec.topic.startswith("\x00"), (
-            f"flexible v{ver} body must not parse as a real topic "
-            f"(got {rec.topic!r})")
+def test_flexible_frames_decode():
+    """Header AND body parse: real topics come out of flexible
+    produce/fetch and the transactional produce generation."""
+    (rec,) = parse_request_records(produce_v9("orders")[4:])
+    assert (rec.api_key, rec.api_version, rec.topic) == (0, 9, "orders")
+    assert rec.client_id == "modern-client"
+    (rec,) = parse_request_records(fetch_v12("orders")[4:])
+    assert (rec.api_key, rec.api_version, rec.topic) == (1, 12, "orders")
+    (rec,) = parse_request_records(produce_v3("orders")[4:])
+    assert (rec.api_key, rec.api_version, rec.topic) == (0, 3, "orders")
+    # multi-topic flexible produce: EVERY topic policy-checked
+    recs = parse_request_records(produce_v9("a", "b", "c")[4:])
+    assert [r.topic for r in recs] == ["a", "b", "c"]
 
 
-@pytest.mark.parametrize("make_frame", [produce_v9, fetch_v12])
-def test_topic_scoped_rule_denies_flexible_frame(make_frame):
-    """A topic ACL that ALLOWS this very topic on classic frames still
-    DENIES the flexible encoding of it — unparseable topic data must
-    never satisfy a topic constraint."""
+@pytest.mark.parametrize("make_frame", [produce_v9, fetch_v12,
+                                        produce_v3])
+def test_topic_acl_enforces_on_modern_frames(make_frame):
+    """The SAME topic ACL governs classic and modern encodings: the
+    allowed topic passes, a different topic drops."""
     loader, ids = _loader([
         PortRuleKafka(role="produce", topic="allowed-topic"),
         PortRuleKafka(role="consume", topic="allowed-topic"),
     ])
     parser, conn = _parser(loader, ids)
-    frame = make_frame("allowed-topic")
-    ops = parser.on_data(False, False, frame)
-    # bare DROP: the v0-era error encoder refuses to guess a flexible
-    # response layout (a wrong guess would desync the client)
-    assert ops == [(OpType.DROP, len(frame))]
+    ok = make_frame("allowed-topic")
+    ops = parser.on_data(False, False, ok)
+    assert ops == [(OpType.PASS, len(ok))], make_frame.__name__
+
+    bad = make_frame("secret-topic")
+    ops = parser.on_data(False, False, bad)
+    # flexible/newer-than-v2 denials are a bare DROP (no guessed
+    # error response); classic v3 produce likewise (encoder is v0-2)
+    assert ops[-1] == (OpType.DROP, len(bad))
     assert conn.take_inject() == b""
 
-    # classic v0 framing of the SAME topic is allowed — the deny above
-    # is the version, not the ACL
-    classic = encode_request(0, 1, 2, "c", "allowed-topic")
-    ops = parser.on_data(False, False, classic)
-    assert ops == [(OpType.PASS, len(classic))]
+
+def test_undecoded_layouts_fail_closed():
+    """Beyond the decoded generations the sentinel comes back: a rule
+    allowing this very topic must still DENY (never match a guess)."""
+    loader, ids = _loader([PortRuleKafka(topic="secret-topic")])
+    parser, conn = _parser(loader, ids)
+    good = produce_v9("secret-topic")
+    # same length (size prefix stays truthful), body bytes garbled
+    # from inside client_id onward → tagged/compact walk fails
+    corrupt = good[:20] + b"\xff" * (len(good) - 20)
+    # versions beyond the verified layouts fail closed BY VERSION
+    # GATE: fetch v13+ replaced topic names with uuids (KIP-516) — a
+    # name-layout walk could extract an attacker-chosen fake topic
+    fetch_v13 = bytearray(fetch_v12("secret-topic"))
+    struct.pack_into(">h", fetch_v13, 6, 13)  # bump version in place
+    produce_v12 = bytearray(good)
+    struct.pack_into(">h", produce_v12, 6, 12)
+    for frame in (metadata_v9(), corrupt, bytes(fetch_v13),
+                  bytes(produce_v12)):
+        ops = parser.on_data(False, False, frame)
+        assert ops[-1] == (OpType.DROP, len(frame))
+        (rec, *_) = parse_request_records(frame[4:])
+        assert rec.topic.startswith("\x00"), rec.topic
+    # sanity: the uncorrupted twin IS allowed by this rule
+    ops = parser.on_data(False, False, good)
+    assert ops == [(OpType.PASS, len(good))]
 
 
 def test_unconstrained_api_key_rule_still_matches():
-    """An api-key-scoped rule with no topic/client constraint admits a
-    flexible produce: api_key parses from the version-independent
-    header, and 'allow all produce' means all produce."""
+    """An api-key-scoped rule with no topic/client constraint admits
+    flexible produce; fetch (not in the produce role) is denied."""
     loader, ids = _loader([PortRuleKafka(role="produce")])
     parser, _ = _parser(loader, ids)
     frame = produce_v9("whatever")
     ops = parser.on_data(False, False, frame)
     assert ops == [(OpType.PASS, len(frame))]
-    # ...but a fetch (not in the produce role's api keys) is denied
     f = fetch_v12("whatever")
     ops = parser.on_data(False, False, f)
     assert ops[-1] == (OpType.DROP, len(f))
 
 
-def test_no_desync_after_flexible_frame():
-    """Framing is the stable size prefix: a classic frame following a
-    denied flexible one parses normally (no stream desync)."""
+def test_no_desync_across_generations():
+    """Framing is the stable size prefix: flexible, transactional and
+    classic frames interleave on one connection without desync."""
     loader, ids = _loader([PortRuleKafka(role="produce",
                                          topic="allowed-topic")])
     parser, conn = _parser(loader, ids)
-    modern = produce_v9("allowed-topic")
-    classic = encode_request(0, 1, 3, "c", "allowed-topic")
-    ops = parser.on_data(False, False, modern + classic)
+    modern = produce_v9("secret-topic")          # denied
+    txn = produce_v3("allowed-topic")            # allowed
+    classic = encode_request(0, 1, 3, "c", "allowed-topic")  # allowed
+    ops = parser.on_data(False, False, modern + txn + classic)
     assert ops[0] == (OpType.DROP, len(modern))
+    assert (OpType.PASS, len(txn)) in ops
     assert ops[-1] == (OpType.PASS, len(classic))
