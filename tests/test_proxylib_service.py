@@ -253,6 +253,34 @@ def test_cpp_shim_header_rewrites(shim_lib):
         service.stop()
 
 
+def test_pipelined_rewrite_and_deny_keep_directions_apart():
+    """The scenario that motivated direction-aware injects: ONE chunk
+    carrying an allowed request (rewrite fires → upstream-bound
+    mutated frame) AND a denied request (client-bound 403). The two
+    inject payloads must come out of their own direction queues,
+    never concatenated."""
+    loader, ids = _rewrite_loader()
+    bridge = PolicyBridge(loader, deadline_ms=1.0)
+    conn = Connection(proto="http", connection_id=3, ingress=True,
+                      src_identity=ids["cli"], dst_identity=ids["web"],
+                      dport=80)
+    parser = create_parser("http", conn, bridge.policy_check(conn))
+
+    ok = b"GET /ok/x HTTP/1.1\r\nhost: web\r\nX-Rep: old\r\n\r\n"
+    denied = b"POST /nope HTTP/1.1\r\nhost: web\r\n\r\n"
+    ops = parser.on_data(False, False, ok + denied)
+    assert [o for o, _ in ops] == [OpType.DROP, OpType.INJECT,
+                                   OpType.DROP, OpType.INJECT]
+    assert ops[0][1] == len(ok) and ops[2][1] == len(denied)
+
+    upstream = conn.take_inject(reply=False)
+    client = conn.take_inject(reply=True)
+    assert upstream.startswith(b"GET /ok/x")      # the rewritten frame
+    assert b"X-Rep: v2" in upstream and b"403" not in upstream
+    assert client.startswith(b"HTTP/1.1 403")     # the deny response
+    assert b"X-Rep" not in client
+
+
 def test_log_action_emits_accesslog():
     """A LOG-action mismatch on an allowed request emits an access-log
     record: the annotated L7 flow lands in the agent's hubble observer
