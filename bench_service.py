@@ -184,7 +184,8 @@ def run_point(loader, scenario, deadline_ms: float, batch_max: int,
 
 def run_open_point(loader, scenario, deadline_ms: float, batch_max: int,
                    rate_rps: float, duration_s: float, conns: int,
-                   warmup: int, sock_dir: str) -> dict:
+                   warmup: int, sock_dir: str,
+                   drain_workers: int = 1) -> dict:
     """One open-loop point: a Poisson arrival schedule at
     ``rate_rps`` drives ``conns`` connections; workers pull the next
     scheduled arrival from a shared cursor, sleep until it, send, and
@@ -196,7 +197,8 @@ def run_open_point(loader, scenario, deadline_ms: float, batch_max: int,
 
     sock = os.path.join(sock_dir, f"svc_open_{deadline_ms}.sock")
     service = VerdictService(loader, sock, batch_max=batch_max,
-                             deadline_ms=deadline_ms)
+                             deadline_ms=deadline_ms,
+                             drain_workers=drain_workers)
     service.start()
     try:
         _prewarm(service, scenario, batch_max)
@@ -298,6 +300,7 @@ def run_open_point(loader, scenario, deadline_ms: float, batch_max: int,
         "max_batch_size": int(max(sizes)) if sizes else 0,
         "batch_max": batch_max,
         "conns": conns,
+        "drain_workers": drain_workers,
     }
 
 
@@ -388,6 +391,10 @@ def main() -> int:
                          "sweep (the batching-regime deadline)")
     ap.add_argument("--open-duration", type=float, default=3.0,
                     help="seconds of offered load per open-loop point")
+    ap.add_argument("--drain-workers", type=int, default=1,
+                    help="MicroBatcher drain workers for the open-loop "
+                         "sweep (2 pipelines batch k+1 against batch "
+                         "k's device round-trip)")
     ap.add_argument("--open-conns", type=int, default=256,
                     help="client connections serving the arrival "
                          "schedule. The protocol is request-response "
@@ -447,7 +454,8 @@ def main() -> int:
             rate = rates[i]
             pt = run_open_point(loader, scenario, d, args.batch_max,
                                 rate, args.open_duration,
-                                args.open_conns, args.warmup, sock_dir)
+                                args.open_conns, args.warmup, sock_dir,
+                                drain_workers=args.drain_workers)
             pt["lane"] = "open_loop"
             open_points.append(pt)
             print(json.dumps({

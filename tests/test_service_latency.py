@@ -71,6 +71,49 @@ def test_open_loop_point_runs(tmp_path):
     assert pt["max_batch_size"] <= 8
 
 
+def test_pipelined_drain_workers_verdict_correctly(tmp_path):
+    """drain_workers=2 (batch k+1 overlapping batch k's device
+    round-trip): every request still gets exactly ITS verdict —
+    interleaved allow/deny traffic from many threads comes back
+    per-flow correct, and nothing is dropped or double-answered."""
+    loader, svc = _loader()
+    service = VerdictService(loader, str(tmp_path / "p.sock"),
+                             deadline_ms=1.0, batch_max=8,
+                             drain_workers=2)
+    service.start()
+    results = {}
+    lock = threading.Lock()
+    try:
+        def worker(tid):
+            client = VerdictClient(str(tmp_path / "p.sock"))
+            out = []
+            for i in range(30):
+                dport = 80 if (tid + i) % 2 == 0 else 81
+                r = client.call({"op": "check", "flow": flow_to_dict(
+                    Flow(src_identity=9, dst_identity=svc,
+                         dport=dport))})
+                out.append((dport, r["verdict"]))
+            with lock:
+                results[tid] = out
+            client.close()
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert len(results) == 8
+        for tid, out in results.items():
+            assert len(out) == 30
+            for dport, v in out:
+                want = (int(Verdict.FORWARDED) if dport == 80
+                        else int(Verdict.DROPPED))
+                assert v == want, (tid, dport, v)
+    finally:
+        service.stop()
+
+
 def test_check_op_over_socket(tmp_path):
     loader, svc = _loader()
     service = VerdictService(loader, str(tmp_path / "s.sock"),
