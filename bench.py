@@ -745,6 +745,12 @@ def _watch(args) -> int:
           "--shim", "--out",
           os.path.join(here, f"SERVICE_LATENCY_{tag}.json")],
          None),
+        # the pipelined-drain lever, measured on TPU (PLATFORM.md):
+        # open-loop only, one closed-loop deadline for reference
+        ([sys.executable, os.path.join(here, "bench_service.py"),
+          "--deadlines", "2", "--drain-workers", "2", "--out",
+          os.path.join(here, f"SERVICE_LATENCY_{tag}_pipelined.json")],
+         None),
     ]
     # per-step hard timeout: bench.py steps carry their own probe+retry
     # but bench_service.py does not, and a mid-sweep re-wedge must cost
