@@ -122,12 +122,13 @@ _DEFAULT_RULES = {"http": 1000, "fqdn": 10, "kafka": 1000,
 
 
 def _bench_from_capture(args, cfg, engine, scenario, arrays, log):
-    """The north-star lane: file→verdict END-TO-END over a stored v2
-    Hubble capture (binary base records + L7 sidecar). Every timed
-    sample covers mapped-file read → vectorized featurize
-    (encode_l7_records: pure numpy gathers against the capture string
-    table) → device_put → verdict step; throughput windows dispatch
-    the whole file sequentially (host encode of chunk i+1 overlaps
+    """The north-star lane: file→verdict END-TO-END over a stored
+    v2/v3 Hubble capture (binary base records + L7 sidecar + generic
+    section). Session STAGING — string tables DFA-scanned on device,
+    the whole file featurized into one row block — is paid once per
+    file and reported as stage_ms; every timed sample then covers
+    row-slice → device_put → verdict step, and throughput windows
+    dispatch the whole file sequentially (H2D of chunk i+1 overlaps
     device compute of chunk i) and sync once. Zero readbacks inside
     timing (docs/PLATFORM.md)."""
     import jax
