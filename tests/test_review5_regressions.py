@@ -1,0 +1,159 @@
+"""Round-4 review findings, pinned as regressions.
+
+Each test is a specific bug the round-4 code reviews caught before
+commit; these keep them fixed.
+"""
+
+import struct
+
+import pytest
+
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import Flow, HTTPInfo, L7Type, TrafficDirection
+from cilium_tpu.core.flow import Protocol
+
+
+def test_delete_on_absent_header_is_a_noop_pass(tmp_path):
+    """A DELETE HeaderMatch whose header is entirely ABSENT must not
+    fire: deleting nothing is not worth re-framing the request, so
+    the frame PASSes untouched instead of DROP+INJECTing a
+    byte-identical copy."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_proxylib_service import _rewrite_loader
+
+    from cilium_tpu.proxylib import Connection, OpType, create_parser
+    from cilium_tpu.runtime.service import PolicyBridge
+
+    loader, ids = _rewrite_loader()
+    bridge = PolicyBridge(loader, deadline_ms=1.0)
+    conn = Connection(proto="http", connection_id=9, ingress=True,
+                      src_identity=ids["cli"], dst_identity=ids["web"],
+                      dport=80)
+    parser = create_parser("http", conn, bridge.policy_check(conn))
+    # X-Add and X-Rep satisfied; X-Del absent → only DELETE could
+    # fire, and it must not
+    req = (b"GET /ok/x HTTP/1.1\r\nhost: web\r\n"
+           b"X-Add: v1\r\nX-Rep: v2\r\n\r\n")
+    ops = parser.on_data(False, False, req)
+    assert ops == [(OpType.PASS, len(req))]
+    assert conn.take_inject(reply=False) == b""
+
+
+def test_sniffer_survives_urlsplit_valueerror(tmp_path):
+    """A pb message whose HTTP url field explodes urlsplit (e.g. a
+    malformed IPv6 literal) must make the sniffer return False, not
+    raise through capture-format dispatch."""
+    from cilium_tpu.ingest import flowpb
+
+    out = bytearray()
+    h = bytearray()
+    flowpb._put_str(h, flowpb._H_URL, "http://[bad")
+    l7 = bytearray()
+    flowpb._put_len(l7, flowpb._L7_HTTP, bytes(h))
+    flowpb._put_len(out, flowpb._F_L7, bytes(l7))
+    msg = bytes(out)
+    path = tmp_path / "weird.pb"
+    pre = bytearray()
+    flowpb._write_varint(pre, len(msg))
+    path.write_bytes(bytes(pre) + msg)
+    assert flowpb.looks_like_pb_capture(str(path)) is False
+
+
+def test_stage_rows_wrong_start_raises(tmp_path):
+    """After stage_rows, a chunk slice outside the staged capture
+    fails loudly instead of silently verdicting a short batch."""
+    from cilium_tpu.engine.verdict import CaptureReplay
+    from cilium_tpu.ingest import binary, synth
+    from cilium_tpu.runtime.loader import Loader
+
+    per_identity, scenario = synth.realize_scenario(
+        synth.synth_http_scenario(n_rules=4, n_flows=32))
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    engine = Loader(cfg).regenerate(per_identity, revision=1)
+    path = str(tmp_path / "c.bin")
+    binary.write_capture_l7(path, scenario.flows)
+    rec = binary.map_capture(path)
+    l7, offsets, blob = binary.read_l7_sidecar(path)
+    replay = CaptureReplay(engine, l7, offsets, blob, cfg.engine)
+    replay.stage_rows(rec, l7)
+    with pytest.raises(ValueError, match="outside the staged"):
+        replay.verdict_chunk(rec[:16], l7[:16], start=len(rec) - 4)
+
+
+def test_monitor_null_level_means_agent_default():
+    """A subscription frame with ``"level": null`` uses the agent's
+    level — NOT AggregationLevel[str(None)] == NONE, which would
+    flood the subscriber with per-flow traces."""
+    import numpy as np
+
+    from cilium_tpu.monitor import (
+        AggregationLevel,
+        MonitorAgent,
+        MonitorServer,
+        monitor_follow,
+    )
+    import tempfile, os, time  # noqa: E401
+
+    agent = MonitorAgent(level=AggregationLevel.MEDIUM)
+    sock = os.path.join(tempfile.mkdtemp(), "m.sock")
+    server = MonitorServer(agent, sock).start()
+    try:
+        # level=None in the frame: send a literal null via the raw
+        # protocol (monitor_follow omits the key when falsy, so drive
+        # the socket directly)
+        import socket as _socket
+
+        from cilium_tpu.runtime.service import recv_msg, send_msg
+
+        s = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+        s.connect(sock)
+        send_msg(s, {"level": None})
+        ack = recv_msg(s)
+        assert ack.get("ok") and ack["level"] == "MEDIUM"
+        s.close()
+        # and the helper path still errors on a bogus level
+        with pytest.raises(ValueError):
+            monitor_follow(sock, level="bogus")
+    finally:
+        server.stop()
+
+
+def test_monitor_survives_malformed_batch():
+    """One malformed outputs dict must not detach the socket feed for
+    every subscriber: the batch tap swallows decode failures and the
+    NEXT good batch still streams."""
+    import os
+    import tempfile
+    import numpy as np
+
+    from cilium_tpu.monitor import MonitorAgent, MonitorServer, monitor_follow
+
+    agent = MonitorAgent()
+    sock = os.path.join(tempfile.mkdtemp(), "m.sock")
+    server = MonitorServer(agent, sock).start()
+    try:
+        stream = monitor_follow(sock)
+        import time
+
+        t0 = time.monotonic()
+        while server.num_clients() < 1:
+            assert time.monotonic() - t0 < 10
+            time.sleep(0.02)
+        flow = Flow(src_identity=1, dst_identity=2, dport=80)
+        # malformed: verdict value outside the enum, straight into the
+        # server's batch tap (the engine never produces this; the tap
+        # must still never detach itself over it)
+        server._on_batch([flow], {"verdict": np.array([99])})
+        with agent._lock:
+            taps = list(agent._batch_listeners)
+        assert server._on_batch in taps  # tap NOT detached
+        agent.notify_batch([flow], {"verdict": np.array([2])})
+        ev = next(stream)
+        assert ev["type"] == "POLICY_VERDICT"
+        assert ev["verdict"] == "DROPPED"
+        stream.close()
+    finally:
+        server.stop()
