@@ -28,7 +28,7 @@ from cilium_tpu.hubble import FlowMetrics, Observer, annotate_flows
 from cilium_tpu.ipam import NodeAllocator
 from cilium_tpu.ipcache import IPCache
 from cilium_tpu.loadbalancer import ServiceManager
-from cilium_tpu.monitor import MonitorAgent
+from cilium_tpu.monitor import AggregationLevel, MonitorAgent
 from cilium_tpu.policy.api import CiliumNetworkPolicy, load_cnp_yaml
 from cilium_tpu.policy.repository import Repository
 from cilium_tpu.policy.selectorcache import SelectorCache
@@ -121,7 +121,16 @@ class Agent:
             on_change=lambda: self.endpoint_manager.regenerate_all(),
             services=self.services)
         # observability (§2.5): monitor event fan-out + hubble observer
-        self.monitor = MonitorAgent()
+        try:
+            level = AggregationLevel[
+                self.config.monitor_aggregation.upper()]
+        except KeyError:
+            raise ValueError(
+                f"monitor_aggregation "
+                f"{self.config.monitor_aggregation!r} — expected one "
+                f"of {[m.name.lower() for m in AggregationLevel]}"
+            ) from None
+        self.monitor = MonitorAgent(level=level)
         self.observer = Observer(handlers=[FlowMetrics()])
         # health probe mesh (§5.3); peers register via health.add_node
         # or kvstore discovery (HealthPeerWatcher at start())

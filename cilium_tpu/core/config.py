@@ -99,6 +99,11 @@ class Config:
     loader: LoaderConfig = dataclasses.field(default_factory=LoaderConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     log_level: str = "info"
+    #: ``--monitor-aggregation`` analog (reference pkg/monitor):
+    #: none/low emit per-flow TraceNotify events; medium/maximum
+    #: suppress them to verdict/drop events. The agent's default;
+    #: monitor-socket subscribers pick their own level per connection.
+    monitor_aggregation: str = "medium"
     #: Agent.start() installs the JSONL log handler (daemon behavior).
     #: Hosts embedding the agent that own process logging set False.
     configure_logging: bool = True
@@ -134,7 +139,8 @@ class Config:
         cfg.enable_tpu_offload = bool(data.get("enable_tpu_offload",
                                                cfg.enable_tpu_offload))
         for key in ("cluster_name", "node_name", "ipam_mode", "pod_cidr",
-                    "identity_allocation_mode", "log_level"):
+                    "identity_allocation_mode", "log_level",
+                    "monitor_aggregation"):
             if key in data:
                 setattr(cfg, key, data[key])
         if "kube_apiserver_ips" in data:

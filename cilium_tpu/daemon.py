@@ -63,6 +63,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="monitor event stream unix socket "
                          "(`cilium-dbg monitor` analog; per-subscriber "
                          "aggregation levels)")
+    ap.add_argument("--monitor-aggregation",
+                    choices=["none", "low", "medium", "maximum"],
+                    help="default monitor aggregation level "
+                         "(reference `--monitor-aggregation`)")
     ap.add_argument("--policy-dir",
                     help="directory of CNP YAML to watch (k8s-watcher "
                          "analog)")
@@ -91,7 +95,8 @@ def config_from_args(args) -> Config:
     if args.policy_audit_mode:
         cfg.policy_audit_mode = True
     for flag in ("node_name", "cluster_name", "ipam_mode", "pod_cidr",
-                 "identity_allocation_mode", "log_level"):
+                 "identity_allocation_mode", "log_level",
+                 "monitor_aggregation"):
         val = getattr(args, flag)
         if val is not None:
             setattr(cfg, flag, val)

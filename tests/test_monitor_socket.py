@@ -144,6 +144,31 @@ def test_agent_shutdown_ends_stream_cleanly(tmp_path):
     assert "closed by agent" in err
 
 
+def test_monitor_aggregation_config(tmp_path):
+    """`--monitor-aggregation none` (Config.monitor_aggregation) sets
+    the agent default: a subscriber with NO explicit level gets
+    per-flow TRACE events MEDIUM would suppress."""
+    from cilium_tpu.monitor import monitor_follow
+
+    sock = str(tmp_path / "monitor.sock")
+    cfg = Config()
+    cfg.configure_logging = False
+    cfg.monitor_aggregation = "none"
+    agent = Agent(cfg, monitor_socket_path=sock).start()
+    try:
+        svc = agent.endpoint_add(1, {"app": "svc"})
+        cli = agent.endpoint_add(2, {"app": "cli"})
+        agent.policy_add(load_cnp_yaml_text(CNP)[0])
+        stream = monitor_follow(sock)  # no level: agent default
+        _wait_clients(agent, 1)
+        agent.process_flows(_flows(svc, cli)[:1])  # one allowed flow
+        got = [next(stream), next(stream)]
+        assert [e["type"] for e in got] == ["POLICY_VERDICT", "TRACE"]
+        stream.close()
+    finally:
+        agent.stop()
+
+
 def test_bad_subscription_errors(live_agent):
     from cilium_tpu.monitor import monitor_follow
 
