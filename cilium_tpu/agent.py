@@ -122,8 +122,11 @@ class Agent:
             services=self.services)
         # observability (§2.5): monitor event fan-out + hubble observer
         try:
+            # `or`: a YAML null/"" means "use the dataclass default",
+            # not AggregationLevel[str(None)] == NONE
             level = AggregationLevel[
-                self.config.monitor_aggregation.upper()]
+                str(self.config.monitor_aggregation
+                    or Config.monitor_aggregation).upper()]
         except KeyError:
             raise ValueError(
                 f"monitor_aggregation "

@@ -30,6 +30,7 @@ import threading
 from typing import List, Optional
 
 from cilium_tpu.core.config import Config
+from cilium_tpu.monitor import AggregationLevel
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -64,7 +65,7 @@ def build_parser() -> argparse.ArgumentParser:
                          "(`cilium-dbg monitor` analog; per-subscriber "
                          "aggregation levels)")
     ap.add_argument("--monitor-aggregation",
-                    choices=["none", "low", "medium", "maximum"],
+                    choices=[m.name.lower() for m in AggregationLevel],
                     help="default monitor aggregation level "
                          "(reference `--monitor-aggregation`)")
     ap.add_argument("--policy-dir",

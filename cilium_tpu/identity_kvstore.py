@@ -86,6 +86,26 @@ class ClusterIdentityAllocator:
         #: create so contended allocation converges without re-listing
         #: the whole id table from the store each attempt
         self._candidate_floor = IDENTITY_USER_MIN
+        #: per-labels (generation, monotonic-ts) deletion tombstones:
+        #: read-through adoptions use the generation to detect a DELETE
+        #: racing their on_change announcement; the timestamp lets old
+        #: tombstones be pruned (a racing adoption resolves in
+        #: milliseconds, so entries are only load-bearing briefly)
+        self._del_gen: Dict[LabelSet, tuple] = {}
+        self._del_gen_pruned = 0.0  # monotonic ts of last prune pass
+        #: global sequence feeding every tombstone's generation: values
+        #: are never reused, even after a tombstone is pruned — a
+        #: per-labels counter restarting at 1 post-prune could collide
+        #: with a generation a stalled adoption snapshotted (ABA)
+        self._gen_seq = 0
+        #: serializes EVERY on_change delivery (watch events and
+        #: read-through adoptions alike), so consumers observe
+        #: adds/removes for an identity in a coherent order — without
+        #: it, an adoption's add racing a watch DELETE's remove could
+        #: land last and resurrect a retired identity in e.g. the
+        #: selector cache forever. RLock: a consumer callback may
+        #: itself allocate/look up identities on the same thread.
+        self._notify_lock = threading.RLock()
         self._watch = None
         for rid, lbls in RESERVED_LABELS.items():
             self._by_labels[lbls] = int(rid)
@@ -122,25 +142,37 @@ class ClusterIdentityAllocator:
         except ValueError:
             return  # corrupt entry; the operator GC will reap it
         if ev.typ == EVENT_DELETE:
-            with self._lock:
-                # guard both pops: a stale delete must not evict a
-                # newer winning mapping
-                if self._by_labels.get(labels) == nid:
-                    self._by_labels.pop(labels)
-                dropped = self._by_id.get(nid) == labels
-                if dropped:
-                    self._by_id.pop(nid)
-                self._gauge_locked()
-            if dropped and self.on_change is not None:
-                self.on_change(nid, None)
+            with self._notify_lock:
+                with self._lock:
+                    now = time.monotonic()
+                    self._gen_seq += 1
+                    self._del_gen[labels] = (self._gen_seq, now)
+                    if (len(self._del_gen) > 1024
+                            and now - self._del_gen_pruned > 5.0):
+                        # bound churn growth: tombstones older than a
+                        # minute can no longer be raced by any adoption.
+                        # Rate-limited: during a churn storm where all
+                        # entries are young, the rebuild frees nothing,
+                        # so don't pay the O(n) scan on every DELETE.
+                        self._del_gen_pruned = now
+                        self._del_gen = {
+                            k: v for k, v in self._del_gen.items()
+                            if now - v[1] < 60.0}
+                    # guard both pops: a stale delete must not evict a
+                    # newer winning mapping
+                    if self._by_labels.get(labels) == nid:
+                        self._by_labels.pop(labels)
+                    dropped = self._by_id.get(nid) == labels
+                    if dropped:
+                        self._by_id.pop(nid)
+                    self._gauge_locked()
+                if dropped and self.on_change is not None:
+                    self.on_change(nid, None)
             return
-        with self._lock:
-            known = self._by_id.get(nid) == labels
-            self._by_id[nid] = labels
-            self._by_labels[labels] = nid
-            self._gauge_locked()
-        if not known and self.on_change is not None:
-            self.on_change(nid, labels)
+        with self._notify_lock:
+            known = self._insert(nid, labels)
+            if not known and self.on_change is not None:
+                self.on_change(nid, labels)
 
     # -- allocation -------------------------------------------------------
     def allocate(self, labels: LabelSet) -> NumericIdentity:
@@ -164,10 +196,11 @@ class ClusterIdentityAllocator:
         payload = json.dumps({"labels": sorted(labels.format()),
                               "ts": time.time()})
         for _ in range(64):
+            gen = self._gen_of(labels)  # before ANY store read/write
             existing = self.store.get(value_key)
             if existing is not None:
                 nid = int(existing)
-                self._adopt(nid, labels)
+                self._adopt(nid, labels, gen)
                 return nid
             candidate = self._next_candidate()
             if candidate >= IDENTITY_USER_MAX:
@@ -177,7 +210,7 @@ class ClusterIdentityAllocator:
                     self._candidate_floor = candidate + 1
                 continue  # re-read and retry
             if self.store.create(value_key, str(candidate)):
-                self._adopt(candidate, labels)
+                self._adopt(candidate, labels, gen)
                 return candidate
             # Lost the mapping race — unless the mapping IS ours (a
             # retried create whose first attempt landed but whose
@@ -185,12 +218,12 @@ class ClusterIdentityAllocator:
             # releasing the claim, or we'd delete a live identity.
             winner = self.store.get(value_key)
             if winner == str(candidate):
-                self._adopt(candidate, labels)
+                self._adopt(candidate, labels, gen)
                 return candidate
             self.store.delete(ID_PREFIX + str(candidate))
             if winner is not None:
                 nid = int(winner)
-                self._adopt(nid, labels)
+                self._adopt(nid, labels, gen)
                 return nid
         raise RuntimeError("identity allocation did not converge")
 
@@ -205,10 +238,72 @@ class ClusterIdentityAllocator:
                 default=IDENTITY_USER_MIN - 1)
             return max(cache_max + 1, self._candidate_floor)
 
-    def _adopt(self, nid: int, labels: LabelSet) -> None:
+    def _gen_of(self, labels: LabelSet) -> int:
+        """Deletion generation for `labels`; read-through callers MUST
+        snapshot this BEFORE their store read — a DELETE whose watch
+        event lands entirely between the read and the adoption is only
+        visible as a generation bump."""
         with self._lock:
+            return self._del_gen.get(labels, (0,))[0]
+
+    def _insert(self, nid: int, labels: LabelSet,
+                clobber: bool = True) -> bool:
+        """Cache a labels↔id mapping; returns whether consumers already
+        know it (both directions present — a one-sided residue means
+        some transition was never announced, so it must NOT suppress
+        the announcement; duplicate adds are idempotent downstream).
+
+        ``clobber=False`` (read-through adoptions) refuses — atomically
+        — to overwrite a live mapping for the same labels with a
+        DIFFERENT id: the cached one came from the serialized watch
+        stream and is newer than the caller's point-in-time store read
+        (delete + re-create while the reader stalled). Reported as
+        known so the caller neither announces nor undoes anything."""
+        with self._lock:
+            cur = self._by_labels.get(labels)
+            if not clobber and cur is not None and cur != nid:
+                return True
+            known = (self._by_id.get(nid) == labels and cur == nid)
             self._by_labels[labels] = nid
             self._by_id[nid] = labels
+            self._gauge_locked()
+        return known
+
+    def _adopt(self, nid: int, labels: LabelSet, gen: int) -> None:
+        """Adopt a mapping read through from the store (`gen` = the
+        deletion generation snapshotted before that read).
+
+        Read-through adoptions must notify like watch events do: the
+        watch CREATE that later arrives for this mapping sees it as
+        `known` and stays silent, so skipping on_change here would
+        leave e.g. a selector cache permanently blind to an identity
+        whenever a store lookup races ahead of the watch stream."""
+        known = self._insert(nid, labels, clobber=False)
+        if known:
+            return
+        # Announce under the notify lock, but only if the mapping is
+        # still current (no watch DELETE bumped the generation since
+        # before our store read, and the cache entry is still ours).
+        # If a delete committed but its watch event hasn't arrived yet,
+        # the announce is transiently stale — and the DELETE's remove,
+        # serialized behind us on the notify lock, retires it. If the
+        # generation HAS moved, the watch already owns this label set:
+        # retract our residue (guarded per entry) so a dead adoption
+        # can't linger in the cache — no future watch event would ever
+        # retire it — and can't make the next genuine CREATE look
+        # already-known. Every interleaving converges on watch truth.
+        with self._notify_lock:
+            with self._lock:
+                current = (self._del_gen.get(labels, (0,))[0] == gen
+                           and self._by_labels.get(labels) == nid)
+                if not current:
+                    if self._by_labels.get(labels) == nid:
+                        self._by_labels.pop(labels)
+                    if self._by_id.get(nid) == labels:
+                        self._by_id.pop(nid)
+                    self._gauge_locked()
+            if current and self.on_change is not None:
+                self.on_change(nid, labels)
 
     # -- lookups (IdentityAllocator contract) -----------------------------
     def lookup(self, nid: NumericIdentity) -> Optional[LabelSet]:
@@ -226,10 +321,11 @@ class ClusterIdentityAllocator:
                 # cache only if the authoritative labels→id mapping
                 # confirms this claim won — a losing claim's labels
                 # must never enter _by_labels
+                gen = self._gen_of(labels)
                 winner = self.store.get(
                     VALUE_PREFIX + _encode_labels(labels))
                 if winner == str(int(nid)):
-                    self._adopt(int(nid), labels)
+                    self._adopt(int(nid), labels, gen)
                 return labels
         return None
 
@@ -238,9 +334,10 @@ class ClusterIdentityAllocator:
             nid = self._by_labels.get(labels)
         if nid is not None:
             return nid
+        gen = self._gen_of(labels)
         raw = self.store.get(VALUE_PREFIX + _encode_labels(labels))
         if raw is not None:
-            self._adopt(int(raw), labels)
+            self._adopt(int(raw), labels, gen)
             return int(raw)
         return None
 

@@ -13,9 +13,10 @@ from cilium_tpu.identity_kvstore import (
     ID_PREFIX,
     VALUE_PREFIX,
     ClusterIdentityAllocator,
+    _encode_labels,
     gc_orphan_identities,
 )
-from cilium_tpu.kvstore import KVStore
+from cilium_tpu.kvstore import EVENT_CREATE, EVENT_DELETE, Event, KVStore
 
 
 def labels(**kw):
@@ -56,6 +57,183 @@ def test_remote_allocation_triggers_on_change():
             c.close()
     finally:
         a.close()
+        b.close()
+
+
+def test_readthrough_lookup_fires_on_change():
+    """Regression (round-4 full-suite flake): when a store read-through
+    in lookup_by_labels/lookup wins the race against the watch stream,
+    the adoption must fire on_change — the watch CREATE that arrives
+    later sees the mapping as known and stays silent, so a silent
+    adoption leaves the agent's selector cache permanently blind to the
+    identity (cross-node flows then never match fromEndpoints
+    selectors, no matter how long the caller polls)."""
+    store = KVStore()
+    a = ClusterIdentityAllocator(store).start()
+    seen = []
+    # b: watch never started — every event must come from read-through
+    b = ClusterIdentityAllocator(
+        store, on_change=lambda nid, lbls: seen.append((nid, lbls)))
+    try:
+        nid = a.allocate(labels(app="raced"))
+        assert b.lookup_by_labels(labels(app="raced")) == nid
+        assert (nid, labels(app="raced")) in seen
+        # idempotent: the (simulated) late watch CREATE stays silent
+        before = len(seen)
+        b._on_event(Event(EVENT_CREATE,
+                          VALUE_PREFIX + _encode_labels(
+                              labels(app="raced")), str(int(nid))))
+        assert len(seen) == before
+        # lookup() by id read-through notifies too
+        nid2 = a.allocate(labels(app="raced2"))
+        assert b.lookup(nid2) == labels(app="raced2")
+        assert (nid2, labels(app="raced2")) in seen
+    finally:
+        a.close()
+        b.close()
+
+
+def test_readthrough_adoption_racing_delete_ends_removed():
+    """A DELETE landing while a read-through adoption announces itself
+    must not leave the identity resurrected in consumers: on_change
+    deliveries are serialized (notify lock) and the adoption re-checks
+    the deletion generation before announcing, so the last notification
+    consumers see is the removal."""
+    store = KVStore()
+    a = ClusterIdentityAllocator(store).start()
+    b = ClusterIdentityAllocator(store)  # watch never started
+    events = []
+
+    def on_change(nid, lbls):
+        events.append((nid, lbls))
+        if lbls is not None and len(events) == 1:
+            # the identity is retired exactly while b announces it
+            key = VALUE_PREFIX + _encode_labels(lbls)
+            store.delete(key)
+            b._on_event(Event(EVENT_DELETE, key, str(int(nid))))
+
+    b.on_change = on_change
+    try:
+        nid = a.allocate(labels(app="ghost"))
+        assert b.lookup_by_labels(labels(app="ghost")) == nid
+        assert events[0] == (nid, labels(app="ghost"))
+        # whatever the interleaving, the stream must END with a remove
+        assert events[-1] == (nid, None), events
+        assert b.lookup_by_labels(labels(app="ghost")) is None
+    finally:
+        a.close()
+        b.close()
+
+
+def test_stale_readthrough_never_clobbers_newer_mapping():
+    """A read-through adoption carrying a stale id (its store read
+    predates a delete + re-create) must not overwrite the newer
+    watch-delivered mapping, announce the dead id, or evict the live
+    entry on its undo path."""
+    store = KVStore()
+    a = ClusterIdentityAllocator(store).start()
+    events = []
+    b = ClusterIdentityAllocator(
+        store, on_change=lambda nid, lbls: events.append((nid, lbls)))
+    b.start()
+    try:
+        old = a.allocate(labels(app="churny"))
+        # retire and re-create under a DIFFERENT id (written straight
+        # to the store: a fresh allocate may legitimately reuse the
+        # retired number): b's watch (synchronous in-process) tracks
+        # both transitions
+        key = VALUE_PREFIX + _encode_labels(labels(app="churny"))
+        store.delete(key)
+        store.delete(ID_PREFIX + str(int(old)))
+        new = int(old) + 100
+        store.set(ID_PREFIX + str(new), json.dumps(
+            {"labels": sorted(labels(app="churny").format()),
+             "ts": time.time()}))
+        store.set(key, str(new))
+        assert b.lookup_by_labels(labels(app="churny")) == new
+        events.clear()
+        # the stalled reader finally adopts its stale point-in-time id
+        # (gen 0: its snapshot predates the delete)
+        b._adopt(int(old), labels(app="churny"), 0)
+        assert b.lookup_by_labels(labels(app="churny")) == new
+        assert events == []  # neither announced nor compensated
+    finally:
+        a.close()
+        b.close()
+
+
+def test_delete_fully_processed_mid_readthrough_stays_silent():
+    """A DELETE whose watch event lands ENTIRELY between a read-through
+    caller's store read and its adoption is only visible as a deletion
+    generation bump: the adoption must detect it, announce nothing, and
+    retract its insert (no future watch event would ever retire it)."""
+    store = KVStore()
+    events = []
+    b = ClusterIdentityAllocator(
+        store, on_change=lambda nid, lbls: events.append((nid, lbls)))
+    key = VALUE_PREFIX + _encode_labels(labels(app="gone"))
+    try:
+        store.set(key, "5000")
+        # reader: snapshots gen, reads the store...
+        gen = b._gen_of(labels(app="gone"))
+        raw = store.get(key)
+        # ...the identity is retired and the watch event is FULLY
+        # processed before the reader resumes
+        store.delete(key)
+        b._on_event(Event(EVENT_DELETE, key, "5000"))
+        b._adopt(int(raw), labels(app="gone"), gen)
+        assert events == []
+        assert b.lookup_by_labels(labels(app="gone")) is None
+        assert b.lookup(5000) is None  # no cache residue either
+    finally:
+        b.close()
+
+
+def test_stale_adoption_retracts_even_without_on_change():
+    """The retraction of a dead adoption must not depend on having an
+    on_change consumer: an allocator built with on_change=None (the
+    constructor's default) would otherwise cache the retired mapping
+    forever — no future watch event targets it."""
+    store = KVStore()
+    b = ClusterIdentityAllocator(store)  # on_change=None
+    key = VALUE_PREFIX + _encode_labels(labels(app="gone"))
+    try:
+        store.set(key, "5000")
+        gen = b._gen_of(labels(app="gone"))
+        raw = store.get(key)
+        store.delete(key)
+        b._on_event(Event(EVENT_DELETE, key, "5000"))
+        b._adopt(int(raw), labels(app="gone"), gen)
+        assert b.lookup_by_labels(labels(app="gone")) is None
+        assert b.lookup(5000) is None
+    finally:
+        b.close()
+
+
+def test_create_after_adoption_residue_still_announces():
+    """A watch CREATE arriving when the cache holds a one-sided residue
+    of an earlier read-through insert (same id, labels side since
+    retired) must still announce: `known` requires BOTH directions, so
+    an unannounced transition can't be masked by stale residue."""
+    store = KVStore()
+    events = []
+    b = ClusterIdentityAllocator(
+        store, on_change=lambda nid, lbls: events.append((nid, lbls)))
+    L = labels(app="lag")
+    key = VALUE_PREFIX + _encode_labels(L)
+    try:
+        # lagging node: store already holds the re-created mapping
+        # L→1001 (history: create 1000, delete, create 1001), and a
+        # read-through inserts it before the watch replays the history
+        assert b._insert(1001, L, clobber=False) is False
+        b._on_event(Event(EVENT_CREATE, key, "1000"))
+        b._on_event(Event(EVENT_DELETE, key, "1000"))
+        b._on_event(Event(EVENT_CREATE, key, "1001"))
+        assert (1000, None) in events
+        # the live identity IS announced despite the _by_id residue
+        assert events[-1] == (1001, L), events
+        assert b.lookup_by_labels(L) == 1001
+    finally:
         b.close()
 
 
