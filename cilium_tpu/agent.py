@@ -159,6 +159,9 @@ class Agent:
         self.api_socket_path = api_socket_path
         self.policy_watcher = None
         self.policy_dir = policy_dir
+        # pkg/k8s watcher-layer analog: CNP/CCNP informers feeding the
+        # repo + CEP/CiliumNode status publication (config.k8s_api_socket)
+        self.k8s_bridge = None
         # transparent DNS proxy UDP wire path (§3.5); endpoint resolved
         # from the client source address, as the reference's TPROXY does
         self.dns_server = None
@@ -280,6 +283,14 @@ class Agent:
 
             self.policy_watcher = PolicyDirWatcher(self, self.policy_dir)
             self.policy_watcher.register(self.controllers)
+        if self.config.k8s_api_socket and self.k8s_bridge is None:
+            # None-guard: a retried Agent.start() must not stack a
+            # second set of informer threads (same rule as the
+            # allocator watch above)
+            from cilium_tpu.k8s.agent_bridge import K8sWatcherBridge
+
+            self.k8s_bridge = K8sWatcherBridge(
+                self, self.config.k8s_api_socket).start()
         if self.hubble_socket_path:
             from cilium_tpu.hubble.server import HubbleServer
 
@@ -341,6 +352,8 @@ class Agent:
         # policy for a shutdown teardown would be discarded work
         self.clustermesh.close()
         self.controllers.stop_all()
+        if self.k8s_bridge is not None:
+            self.k8s_bridge.stop()
         if self.node_registration is not None:
             # stop watching, but stay registered: the node keeps its
             # CIDR across an agent restart (the lease lapses only if we
@@ -488,9 +501,12 @@ class Agent:
         # cluster-pool allocator swap (_on_pod_cidr_change), which
         # adopts only already-registered endpoints' addresses
         with self.write_lock:
-            return self._endpoint_add_locked(endpoint_id, labels, ipv4,
-                                             named_ports=named_ports,
-                                             host=host)
+            ep = self._endpoint_add_locked(endpoint_id, labels, ipv4,
+                                           named_ports=named_ports,
+                                           host=host)
+        if self.k8s_bridge is not None:  # outside the lock: socket IO
+            self.k8s_bridge.publish_endpoint(ep)
+        return ep
 
     def host_endpoint_add(self, labels: Dict[str, str],
                           ipv4: str = "", endpoint_id: int = 0):
@@ -595,6 +611,8 @@ class Agent:
                 self.ipcache.delete(f"{ep.ipv4}/32")
                 self.ipam.release(ep.ipv4)
             self.endpoint_manager.remove_endpoint(endpoint_id)
+        if self.k8s_bridge is not None:  # outside the lock: socket IO
+            self.k8s_bridge.withdraw_endpoint(endpoint_id)
 
     # -- flow pipeline (engine → monitor → hubble, §3.6) -----------------
     def process_flows(self, flows: List) -> Dict:

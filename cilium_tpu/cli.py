@@ -538,6 +538,53 @@ def cmd_policy_delete(args) -> int:
     return 0 if code == 200 else 1
 
 
+def cmd_k8s(args) -> int:
+    """kubectl-shaped access to the fake-apiserver (SURVEY §2.4 K8s
+    layer): apply/get/delete/list Cilium CRDs over its socket."""
+    import yaml as _yaml
+
+    from cilium_tpu.k8s.apiserver import K8sClient, NotFound
+
+    c = K8sClient(args.socket)
+    if args.k8s_cmd == "apply":
+        applied = []
+        with open(args.file) as f:
+            for doc in _yaml.safe_load_all(f.read()):
+                if not doc:
+                    continue
+                plural = _k8s_plural_of(doc)
+                applied.append(c.apply(plural, doc)["metadata"])
+        return _print(applied)
+    if args.k8s_cmd == "get":
+        try:
+            if args.name:
+                return _print(c.get(args.plural, args.name,
+                                    args.namespace))
+            return _print(c.list(args.plural, args.namespace)["items"])
+        except NotFound as e:
+            print(str(e), file=sys.stderr)
+            return 1
+    if args.k8s_cmd == "delete":
+        try:
+            gone = c.delete(args.plural, args.name, args.namespace)
+        except NotFound as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        return _print({"deleted": gone["metadata"]})
+    raise AssertionError(args.k8s_cmd)
+
+
+def _k8s_plural_of(doc) -> str:
+    from cilium_tpu.k8s.apiserver import RESOURCES
+
+    kind = doc.get("kind", "")
+    for plural, (k, _) in RESOURCES.items():
+        if k == kind:
+            return plural
+    raise SystemExit(f"unsupported kind {kind!r} "
+                     f"(known: {[k for k, _ in RESOURCES.values()]})")
+
+
 def cmd_monitor(args) -> int:
     """`cilium-dbg monitor` analog: attach to the agent's monitor
     socket and stream PolicyVerdict/Drop/Trace events as JSON lines,
@@ -713,6 +760,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     i = ssub.add_parser("list")
     i.add_argument("--api", required=True)
     i.set_defaults(fn=cmd_service_list)
+
+    p = sub.add_parser("k8s", help="kubectl-shaped fake-apiserver "
+                                   "access (apply/get/delete CRDs)")
+    ksub = p.add_subparsers(dest="k8s_cmd", required=True)
+    k = ksub.add_parser("apply")
+    k.add_argument("--socket", required=True)
+    k.add_argument("-f", "--file", required=True)
+    k.set_defaults(fn=cmd_k8s)
+    k = ksub.add_parser("get")
+    k.add_argument("--socket", required=True)
+    k.add_argument("plural")
+    k.add_argument("name", nargs="?")
+    k.add_argument("-n", "--namespace", default=None)
+    k.set_defaults(fn=cmd_k8s)
+    k = ksub.add_parser("delete")
+    k.add_argument("--socket", required=True)
+    k.add_argument("plural")
+    k.add_argument("name")
+    k.add_argument("-n", "--namespace", default=None)
+    k.set_defaults(fn=cmd_k8s)
 
     p = sub.add_parser("config", help="daemon config get/set")
     csub = p.add_subparsers(dest="cfg_cmd", required=True)

@@ -99,6 +99,11 @@ class Config:
     loader: LoaderConfig = dataclasses.field(default_factory=LoaderConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     log_level: str = "info"
+    #: ``--k8s-api-socket``: when set, the agent consumes CNP/CCNP
+    #: from the fake-apiserver (cilium_tpu.k8s) through list+watch
+    #: informers and publishes CiliumEndpoint/CiliumNode status back —
+    #: the reference's pkg/k8s watcher layer (SURVEY §2.4)
+    k8s_api_socket: str = ""
     #: ``--monitor-aggregation`` analog (reference pkg/monitor):
     #: none/low emit per-flow TraceNotify events; medium/maximum
     #: suppress them to verdict/drop events. The agent's default;

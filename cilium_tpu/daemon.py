@@ -68,6 +68,11 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=[m.name.lower() for m in AggregationLevel],
                     help="default monitor aggregation level "
                          "(reference `--monitor-aggregation`)")
+    ap.add_argument("--k8s-api-socket",
+                    help="fake-apiserver unix socket: consume CNP/CCNP "
+                         "via list+watch informers and publish "
+                         "CiliumEndpoint/CiliumNode status "
+                         "(pkg/k8s watcher-layer analog)")
     ap.add_argument("--policy-dir",
                     help="directory of CNP YAML to watch (k8s-watcher "
                          "analog)")
@@ -97,7 +102,7 @@ def config_from_args(args) -> Config:
         cfg.policy_audit_mode = True
     for flag in ("node_name", "cluster_name", "ipam_mode", "pod_cidr",
                  "identity_allocation_mode", "log_level",
-                 "monitor_aggregation"):
+                 "monitor_aggregation", "k8s_api_socket"):
         val = getattr(args, flag)
         if val is not None:
             setattr(cfg, flag, val)

@@ -1,0 +1,222 @@
+"""Agent ↔ fake-apiserver integration (SURVEY §2.4 "resource watchers
+feed policy repo" + CEP/CiliumNode status publication, §3.2 CNP path).
+"""
+
+import time
+
+from cilium_tpu.agent import Agent
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import Flow
+from cilium_tpu.k8s.apiserver import APIServer, K8sClient, NotFound
+from cilium_tpu.kvstore import KVStore
+
+
+def cnp_obj(name, port="5432", ns="default", app="web"):
+    return {
+        "apiVersion": "cilium.io/v2",
+        "kind": "CiliumNetworkPolicy",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "endpointSelector": {"matchLabels": {"app": "db"}},
+            "ingress": [{
+                "fromEndpoints": [{"matchLabels": {"app": app}}],
+                "toPorts": [{"ports": [
+                    {"port": port, "protocol": "TCP"}]}],
+            }],
+        },
+    }
+
+
+def make_agent(socket_path, tmp_path=None):
+    cfg = Config()
+    cfg.k8s_api_socket = socket_path
+    cfg.configure_logging = False
+    return Agent(config=cfg, kvstore=KVStore()).start()
+
+
+def verdicts(agent, db, web, dport=5432):
+    out = agent.process_flows([
+        Flow(src_identity=web.identity, dst_identity=db.identity,
+             dport=dport),
+        Flow(src_identity=db.identity, dst_identity=db.identity,
+             dport=dport),
+    ])
+    return [int(v) for v in out["verdict"]]
+
+
+def wait_until(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_cnp_lifecycle_drives_enforcement(tmp_path):
+    server = APIServer(str(tmp_path / "k8s.sock")).start()
+    c = K8sClient(server.socket_path)
+    # a CNP applied BEFORE the agent starts must be enforced at start
+    # (initial informer list is synchronous — WaitForCacheSync)
+    c.create("ciliumnetworkpolicies", cnp_obj("allow-web"))
+    agent = make_agent(server.socket_path)
+    try:
+        db = agent.endpoint_add(1, {"app": "db"})
+        web = agent.endpoint_add(2, {"app": "web"})
+        agent.endpoint_manager.regenerate_all(wait=True)
+        assert verdicts(agent, db, web) == [1, 2]  # FORWARDED, DROPPED
+
+        # live update: rule now selects a different peer → web drops
+        c.apply("ciliumnetworkpolicies", cnp_obj("allow-web", app="api"))
+        assert wait_until(
+            lambda: verdicts(agent, db, web) == [2, 2]), \
+            verdicts(agent, db, web)
+
+        # back to allowing web on another port
+        c.apply("ciliumnetworkpolicies", cnp_obj("allow-web",
+                                                 port="6000"))
+        assert wait_until(
+            lambda: verdicts(agent, db, web, dport=6000) == [1, 2])
+        # the old port is gone (upsert replaced, not accumulated)
+        assert verdicts(agent, db, web, dport=5432) == [2, 2]
+
+        # delete: no rule selects db → default-allow (no policy)
+        c.delete("ciliumnetworkpolicies", "allow-web")
+        assert wait_until(
+            lambda: verdicts(agent, db, web) == [1, 1])
+    finally:
+        agent.stop()
+        server.stop()
+
+
+def test_unparseable_cnp_keeps_previous_state(tmp_path):
+    server = APIServer(str(tmp_path / "k8s.sock")).start()
+    c = K8sClient(server.socket_path)
+    agent = make_agent(server.socket_path)
+    try:
+        db = agent.endpoint_add(1, {"app": "db"})
+        web = agent.endpoint_add(2, {"app": "web"})
+        c.create("ciliumnetworkpolicies", cnp_obj("allow-web"))
+        assert wait_until(lambda: verdicts(agent, db, web) == [1, 2])
+        # a bad update (invalid protocol → SanitizeError) must not
+        # wipe enforcement
+        bad = cnp_obj("allow-web")
+        bad["spec"]["ingress"][0]["toPorts"][0]["ports"][0][
+            "protocol"] = "BOGUS"
+        c.apply("ciliumnetworkpolicies", bad)
+        time.sleep(0.5)
+        assert verdicts(agent, db, web) == [1, 2]
+    finally:
+        agent.stop()
+        server.stop()
+
+
+def test_ccnp_ingest(tmp_path):
+    server = APIServer(str(tmp_path / "k8s.sock")).start()
+    c = K8sClient(server.socket_path)
+    agent = make_agent(server.socket_path)
+    try:
+        db = agent.endpoint_add(1, {"app": "db"})
+        web = agent.endpoint_add(2, {"app": "web"})
+        ccnp = cnp_obj("cluster-allow")
+        ccnp["kind"] = "CiliumClusterwideNetworkPolicy"
+        del ccnp["metadata"]["namespace"]
+        c.create("ciliumclusterwidenetworkpolicies", ccnp)
+        assert wait_until(lambda: verdicts(agent, db, web) == [1, 2])
+        c.delete("ciliumclusterwidenetworkpolicies", "cluster-allow")
+        assert wait_until(lambda: verdicts(agent, db, web) == [1, 1])
+    finally:
+        agent.stop()
+        server.stop()
+
+
+def test_cep_and_node_status_published(tmp_path):
+    server = APIServer(str(tmp_path / "k8s.sock")).start()
+    c = K8sClient(server.socket_path)
+    agent = make_agent(server.socket_path)
+    try:
+        ep = agent.endpoint_add(7, {"app": "db"},
+                                named_ports={"pg": 5432})
+        cep = c.get("ciliumendpoints", "node-0-ep-7")
+        st = cep["status"]
+        assert st["id"] == 7
+        assert st["identity"]["id"] == int(ep.identity)
+        assert "k8s:app=db" in st["identity"]["labels"]
+        assert st["networking"]["addressing"][0]["ipv4"] == ep.ipv4
+        assert st["named-ports"] == [{"name": "pg", "port": 5432}]
+        # the periodic sync converges status drift (policy revision)
+        agent.endpoint_manager.regenerate_all(wait=True)
+        agent.k8s_bridge.sync_endpoint_status()
+        cep = c.get("ciliumendpoints", "node-0-ep-7")
+        assert cep["status"]["policy"]["revision"] == ep.policy_revision
+        # node object exists
+        node = c.get("ciliumnodes", agent.config.node_name)
+        assert node["kind"] == "CiliumNode"
+        # removal withdraws the CEP
+        agent.endpoint_remove(7)
+        try:
+            c.get("ciliumendpoints", "node-0-ep-7")
+            assert False, "CEP not withdrawn"
+        except NotFound:
+            pass
+    finally:
+        agent.stop()
+        server.stop()
+
+
+def test_k8s_cli_apply_get_delete(tmp_path, capsys):
+    """`cilium-tpu k8s apply/get/delete` drives the apiserver like
+    kubectl, straight from a corpus YAML file."""
+    import yaml
+
+    from cilium_tpu.cli import main as cli_main
+
+    server = APIServer(str(tmp_path / "k8s.sock")).start()
+    sock = server.socket_path
+    f = tmp_path / "cnp.yaml"
+    f.write_text(yaml.safe_dump(cnp_obj("from-cli")))
+    try:
+        assert cli_main(["k8s", "apply", "--socket", sock,
+                         "-f", str(f)]) == 0
+        capsys.readouterr()
+        assert cli_main(["k8s", "get", "--socket", sock,
+                         "ciliumnetworkpolicies", "from-cli"]) == 0
+        got = __import__("json").loads(capsys.readouterr().out)
+        assert got["spec"]["endpointSelector"][
+            "matchLabels"]["app"] == "db"
+        # apply again = update (no conflict), then delete
+        assert cli_main(["k8s", "apply", "--socket", sock,
+                         "-f", str(f)]) == 0
+        assert cli_main(["k8s", "delete", "--socket", sock,
+                         "ciliumnetworkpolicies", "from-cli"]) == 0
+        assert cli_main(["k8s", "get", "--socket", sock,
+                         "ciliumnetworkpolicies", "from-cli"]) == 1
+    finally:
+        server.stop()
+
+
+def test_cep_sync_prunes_orphans(tmp_path):
+    """A CEP this node owns but whose endpoint no longer exists is
+    pruned by the periodic sync (stale status must not outlive the
+    endpoint — the reference's CEP GC)."""
+    server = APIServer(str(tmp_path / "k8s.sock")).start()
+    c = K8sClient(server.socket_path)
+    agent = make_agent(server.socket_path)
+    try:
+        agent.endpoint_add(9, {"app": "db"})
+        # simulate a stale CEP left by a crashed prior incarnation
+        c.apply("ciliumendpoints", {
+            "metadata": {"name": "node-0-ep-99", "namespace": "default"},
+            "status": {"id": 99, "networking":
+                       {"node": agent.config.node_name}}})
+        # another node's CEP must NOT be pruned
+        c.apply("ciliumendpoints", {
+            "metadata": {"name": "other-ep-50", "namespace": "default"},
+            "status": {"id": 50, "networking": {"node": "other-node"}}})
+        agent.k8s_bridge.sync_endpoint_status()
+        names = {o["metadata"]["name"]
+                 for o in c.list("ciliumendpoints")["items"]}
+        assert names == {"node-0-ep-9", "other-ep-50"}
+    finally:
+        agent.stop()
+        server.stop()

@@ -1,0 +1,255 @@
+"""K8s layer (SURVEY §2.4): fake-apiserver list/watch semantics and the
+Reflector/Informer contract the reference's pkg/k8s watchers rely on.
+"""
+
+import collections
+import threading
+import time
+
+import pytest
+
+from cilium_tpu.k8s.apiserver import (
+    APIServer,
+    Conflict,
+    K8sClient,
+    NotFound,
+    ResourceStore,
+    WatchGone,
+)
+from cilium_tpu.k8s.informer import Informer
+
+
+def cnp(name, ns="default", port="80"):
+    return {
+        "apiVersion": "cilium.io/v2",
+        "kind": "CiliumNetworkPolicy",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "endpointSelector": {"matchLabels": {"app": "db"}},
+            "ingress": [{
+                "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+                "toPorts": [{"ports": [
+                    {"port": port, "protocol": "TCP"}]}],
+            }],
+        },
+    }
+
+
+# -- store semantics ------------------------------------------------------
+
+def test_crud_and_resource_versions():
+    s = ResourceStore()
+    a = s.create("ciliumnetworkpolicies", cnp("a"))
+    b = s.create("ciliumnetworkpolicies", cnp("b"))
+    assert int(b["metadata"]["resourceVersion"]) > \
+        int(a["metadata"]["resourceVersion"])
+    assert a["metadata"]["uid"] != b["metadata"]["uid"]
+    got = s.get("ciliumnetworkpolicies", "default", "a")
+    assert got["spec"] == cnp("a")["spec"]
+    listing = s.list("ciliumnetworkpolicies")
+    assert {o["metadata"]["name"] for o in listing["items"]} == {"a", "b"}
+    assert listing["resource_version"] == b["metadata"]["resourceVersion"]
+    gone = s.delete("ciliumnetworkpolicies", "default", "a")
+    assert gone["metadata"]["name"] == "a"
+    with pytest.raises(NotFound):
+        s.get("ciliumnetworkpolicies", "default", "a")
+
+
+def test_create_conflict_and_unknown_resource():
+    s = ResourceStore()
+    s.create("ciliumnetworkpolicies", cnp("a"))
+    with pytest.raises(Conflict):
+        s.create("ciliumnetworkpolicies", cnp("a"))
+    with pytest.raises(NotFound):
+        s.list("widgets")
+
+
+def test_update_optimistic_concurrency_and_generation():
+    s = ResourceStore()
+    a = s.create("ciliumnetworkpolicies", cnp("a"))
+    fresh = cnp("a", port="443")
+    fresh["metadata"]["resourceVersion"] = a["metadata"]["resourceVersion"]
+    a2 = s.update("ciliumnetworkpolicies", fresh)
+    assert a2["metadata"]["generation"] == 2  # spec changed
+    assert a2["metadata"]["uid"] == a["metadata"]["uid"]
+    # stale rv conflicts (optimistic concurrency)
+    stale = cnp("a", port="8080")
+    stale["metadata"]["resourceVersion"] = a["metadata"]["resourceVersion"]
+    with pytest.raises(Conflict):
+        s.update("ciliumnetworkpolicies", stale)
+    # rv-less update is a forced write (kubectl replace --force analog)
+    forced = cnp("a", port="9090")
+    a3 = s.update("ciliumnetworkpolicies", forced)
+    assert a3["metadata"]["generation"] == 3
+
+
+def test_cluster_scoped_resources_drop_namespace():
+    s = ResourceStore()
+    node = s.create("ciliumnodes", {
+        "metadata": {"name": "n1", "namespace": "ignored"},
+        "spec": {"podCIDR": "10.0.0.0/24"}})
+    assert "namespace" not in node["metadata"]
+    assert s.get("ciliumnodes", "", "n1")["spec"]["podCIDR"] \
+        == "10.0.0.0/24"
+
+
+def test_watch_replays_strictly_after_rv_and_follows():
+    s = ResourceStore()
+    a = s.create("ciliumnetworkpolicies", cnp("a"))
+    b = s.create("ciliumnetworkpolicies", cnp("b"))
+    seen = []
+    w = s.watch("ciliumnetworkpolicies",
+                a["metadata"]["resourceVersion"], seen.append)
+    try:
+        # replay: only b (strictly after a's rv)
+        assert [e["object"]["metadata"]["name"] for e in seen] == ["b"]
+        assert seen[0]["type"] == "ADDED"
+        s.delete("ciliumnetworkpolicies", "default", "b")
+        assert seen[-1]["type"] == "DELETED"
+        # other resources don't leak into this watch
+        s.create("ciliumnodes", {"metadata": {"name": "n1"}})
+        assert all(e["object"]["kind"] == "CiliumNetworkPolicy"
+                   for e in seen)
+    finally:
+        w.stop()
+
+
+def test_watch_gone_on_instance_change_or_future_rv():
+    """A reflector resuming against a RESTARTED apiserver (fresh store,
+    rv counter reset) must get 410 immediately — a coincidentally-valid
+    rv from the old history silently resumes into the wrong history
+    otherwise. Both guards: instance token mismatch, and future rv."""
+    s = ResourceStore()
+    s.create("ciliumnetworkpolicies", cnp("a"))
+    rv = s.list("ciliumnetworkpolicies")["resource_version"]
+    # same instance + current rv: fine
+    s.watch("ciliumnetworkpolicies", rv, lambda e: None,
+            instance=s.instance).stop()
+    with pytest.raises(WatchGone):
+        s.watch("ciliumnetworkpolicies", rv, lambda e: None,
+                instance="someone-elses-history")
+    with pytest.raises(WatchGone):
+        s.watch("ciliumnetworkpolicies", str(int(rv) + 50),
+                lambda e: None, instance=s.instance)
+
+
+def test_watch_gone_when_history_compacted():
+    s = ResourceStore()
+    s._events = collections.deque(maxlen=4)  # tiny retention
+    first = s.create("ciliumnetworkpolicies", cnp("a"))
+    for i in range(6):
+        s.create("ciliumnetworkpolicies", cnp(f"x{i}"))
+    with pytest.raises(WatchGone):
+        s.watch("ciliumnetworkpolicies",
+                first["metadata"]["resourceVersion"], lambda e: None)
+    # watching from the current list rv is always fine
+    rv = s.list("ciliumnetworkpolicies")["resource_version"]
+    s.watch("ciliumnetworkpolicies", rv, lambda e: None).stop()
+
+
+# -- socket server + client -----------------------------------------------
+
+def test_client_crud_apply_and_errors(tmp_path):
+    server = APIServer(str(tmp_path / "k8s.sock")).start()
+    try:
+        c = K8sClient(server.socket_path)
+        made = c.create("ciliumnetworkpolicies", cnp("a"))
+        assert made["metadata"]["uid"]
+        with pytest.raises(Conflict):
+            c.create("ciliumnetworkpolicies", cnp("a"))
+        with pytest.raises(NotFound):
+            c.get("ciliumnetworkpolicies", "nope")
+        # apply: update existing without handing in an rv
+        applied = c.apply("ciliumnetworkpolicies", cnp("a", port="443"))
+        assert applied["metadata"]["generation"] == 2
+        # apply: creates missing
+        c.apply("ciliumnetworkpolicies", cnp("b"))
+        names = {o["metadata"]["name"]
+                 for o in c.list("ciliumnetworkpolicies")["items"]}
+        assert names == {"a", "b"}
+        c.delete("ciliumnetworkpolicies", "b")
+        assert len(c.list("ciliumnetworkpolicies")["items"]) == 1
+    finally:
+        server.stop()
+
+
+# -- informer -------------------------------------------------------------
+
+def wait_until(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_informer_sync_follow_update_delete(tmp_path):
+    server = APIServer(str(tmp_path / "k8s.sock")).start()
+    events = []
+    lock = threading.Lock()
+
+    def rec(kind):
+        def h(*objs):
+            with lock:
+                events.append((kind, objs[-1]["metadata"]["name"]))
+        return h
+
+    try:
+        c = K8sClient(server.socket_path)
+        c.create("ciliumnetworkpolicies", cnp("pre"))
+        inf = Informer(c, "ciliumnetworkpolicies",
+                       on_add=rec("add"), on_update=rec("update"),
+                       on_delete=rec("del")).start()
+        try:
+            # initial list is synchronous
+            assert ("add", "pre") in events
+            c.create("ciliumnetworkpolicies", cnp("live"))
+            assert wait_until(lambda: ("add", "live") in events)
+            c.apply("ciliumnetworkpolicies", cnp("live", port="443"))
+            assert wait_until(lambda: ("update", "live") in events)
+            c.delete("ciliumnetworkpolicies", "live")
+            assert wait_until(lambda: ("del", "live") in events)
+            assert ("live", ) not in inf.store
+        finally:
+            inf.stop()
+    finally:
+        server.stop()
+
+
+def test_informer_relists_across_server_restart(tmp_path):
+    """The Reflector contract: a dead apiserver (or compacted watch)
+    means relist — changes made while the watcher was blind surface as
+    deltas, including deletes."""
+    path = str(tmp_path / "k8s.sock")
+    server = APIServer(path).start()
+    events = []
+
+    def rec(kind):
+        return lambda *objs: events.append(
+            (kind, objs[-1]["metadata"]["name"]))
+
+    c = K8sClient(path)
+    c.create("ciliumnetworkpolicies", cnp("keep"))
+    c.create("ciliumnetworkpolicies", cnp("drop"))
+    inf = Informer(c, "ciliumnetworkpolicies",
+                   on_add=rec("add"), on_update=rec("update"),
+                   on_delete=rec("del")).start()
+    try:
+        assert {("add", "keep"), ("add", "drop")} <= set(events)
+        lists_before = inf.list_count
+        server.stop()
+        # a NEW apiserver (fresh store: rv restarts) — while the
+        # informer was blind, 'drop' vanished and 'new' appeared
+        server = APIServer(path).start()
+        c2 = K8sClient(path)
+        c2.create("ciliumnetworkpolicies", cnp("keep"))
+        c2.create("ciliumnetworkpolicies", cnp("new"))
+        assert wait_until(lambda: inf.list_count > lists_before
+                          and ("add", "new") in events
+                          and ("del", "drop") in events, timeout=30)
+        assert ("default", "drop") not in inf.store
+        assert ("default", "new") in inf.store
+    finally:
+        inf.stop()
+        server.stop()
