@@ -66,6 +66,16 @@ class Agent:
             from cilium_tpu.identity_kvstore import ClusterIdentityAllocator
 
             self.allocator = ClusterIdentityAllocator(self.kvstore)
+        elif self.config.identity_allocation_mode == "crd":
+            if not self.config.k8s_api_socket:
+                raise ValueError(
+                    "identity_allocation_mode=crd requires "
+                    "k8s_api_socket (the CiliumIdentity store)")
+            from cilium_tpu.k8s.apiserver import K8sClient
+            from cilium_tpu.k8s.identity_crd import CRDIdentityAllocator
+
+            self.allocator = CRDIdentityAllocator(
+                K8sClient(self.config.k8s_api_socket))
         else:
             self.allocator = IdentityAllocator()
         self.selector_cache = SelectorCache(self.allocator)
@@ -191,7 +201,7 @@ class Agent:
         # process's logging opt out via configure_logging=False
         if self.config.configure_logging:
             setup_logging(self.config.log_level)
-        if self.config.identity_allocation_mode == "kvstore":
+        if self.config.identity_allocation_mode in ("kvstore", "crd"):
             # remote allocations reach policy through the selector
             # cache (the reference's identity-cache events); start()
             # replays existing cluster identities before anything

@@ -49,9 +49,11 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--cluster-name")
     ap.add_argument("--ipam-mode", choices=["static", "cluster-pool"])
     ap.add_argument("--identity-allocation-mode",
-                    choices=["local", "kvstore"],
+                    choices=["local", "kvstore", "crd"],
                     help="kvstore = cluster-wide label→identity "
-                         "agreement through the shared store")
+                         "agreement through the shared store; crd = "
+                         "through CiliumIdentity objects on the "
+                         "--k8s-api-socket apiserver")
     ap.add_argument("--pod-cidr", help="static-mode podCIDR")
     ap.add_argument("--log-level")
     ap.add_argument("--socket", help="verdict service unix socket")

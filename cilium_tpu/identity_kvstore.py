@@ -26,17 +26,16 @@ grace period — the ``cilium-operator`` identity-GC duty.
 from __future__ import annotations
 
 import json
-import threading
+
 import time
-from typing import Callable, Dict, Iterable, Optional
+from typing import Callable, Iterable, Optional
 
 from cilium_tpu.core.identity import (
     IDENTITY_SCOPE_LOCAL,
     IDENTITY_USER_MAX,
-    IDENTITY_USER_MIN,
-    RESERVED_LABELS,
     NumericIdentity,
 )
+from cilium_tpu.core.identity_cache import IdentityCacheBase
 from cilium_tpu.core.labels import LabelSet
 from cilium_tpu.kvstore import EVENT_DELETE, Event
 from cilium_tpu.runtime.logging import get_logger
@@ -65,51 +64,20 @@ def _decode_enc(enc: str) -> LabelSet:
     return LabelSet() if enc == "" else _decode_labels(enc.split(";"))
 
 
-class ClusterIdentityAllocator:
+class ClusterIdentityAllocator(IdentityCacheBase):
     """Duck-type of :class:`~cilium_tpu.core.identity.IdentityAllocator`
-    whose user-scope allocations are cluster-global via the kvstore."""
+    whose user-scope allocations are cluster-global via the kvstore.
+    Cache + ordered on_change delivery live in
+    :class:`~cilium_tpu.core.identity_cache.IdentityCacheBase`; this
+    class owns the etcd-layout claim protocol and the prefix watch."""
 
     def __init__(self, store,
                  on_change: Optional[Callable[[NumericIdentity,
                                                Optional[LabelSet]],
                                               None]] = None):
+        super().__init__(on_change=on_change)
         self.store = store
-        #: called as on_change(nid, labels) for identities appearing in
-        #: the store (labels=None on deletion); set before start() or
-        #: via the attribute — the agent points it at its SelectorCache
-        self.on_change = on_change
-        self._lock = threading.Lock()
-        self._by_labels: Dict[LabelSet, NumericIdentity] = {}
-        self._by_id: Dict[NumericIdentity, LabelSet] = {}
-        self._next_local = IDENTITY_SCOPE_LOCAL
-        #: lower bound for the next id claim; bumped past every failed
-        #: create so contended allocation converges without re-listing
-        #: the whole id table from the store each attempt
-        self._candidate_floor = IDENTITY_USER_MIN
-        #: per-labels (generation, monotonic-ts) deletion tombstones:
-        #: read-through adoptions use the generation to detect a DELETE
-        #: racing their on_change announcement; the timestamp lets old
-        #: tombstones be pruned (a racing adoption resolves in
-        #: milliseconds, so entries are only load-bearing briefly)
-        self._del_gen: Dict[LabelSet, tuple] = {}
-        self._del_gen_pruned = 0.0  # monotonic ts of last prune pass
-        #: global sequence feeding every tombstone's generation: values
-        #: are never reused, even after a tombstone is pruned — a
-        #: per-labels counter restarting at 1 post-prune could collide
-        #: with a generation a stalled adoption snapshotted (ABA)
-        self._gen_seq = 0
-        #: serializes EVERY on_change delivery (watch events and
-        #: read-through adoptions alike), so consumers observe
-        #: adds/removes for an identity in a coherent order — without
-        #: it, an adoption's add racing a watch DELETE's remove could
-        #: land last and resurrect a retired identity in e.g. the
-        #: selector cache forever. RLock: a consumer callback may
-        #: itself allocate/look up identities on the same thread.
-        self._notify_lock = threading.RLock()
         self._watch = None
-        for rid, lbls in RESERVED_LABELS.items():
-            self._by_labels[lbls] = int(rid)
-            self._by_id[int(rid)] = lbls
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "ClusterIdentityAllocator":
@@ -131,10 +99,6 @@ class ClusterIdentityAllocator:
             self._watch.stop()
             self._watch = None
 
-    def _gauge_locked(self) -> None:
-        METRICS.set_gauge("cilium_tpu_identities_cluster",
-                          float(len(self._by_id)))
-
     def _on_event(self, ev: Event) -> None:
         try:
             labels = _decode_enc(ev.key[len(VALUE_PREFIX):])
@@ -142,54 +106,11 @@ class ClusterIdentityAllocator:
         except ValueError:
             return  # corrupt entry; the operator GC will reap it
         if ev.typ == EVENT_DELETE:
-            with self._notify_lock:
-                with self._lock:
-                    now = time.monotonic()
-                    self._gen_seq += 1
-                    self._del_gen[labels] = (self._gen_seq, now)
-                    if (len(self._del_gen) > 1024
-                            and now - self._del_gen_pruned > 5.0):
-                        # bound churn growth: tombstones older than a
-                        # minute can no longer be raced by any adoption.
-                        # Rate-limited: during a churn storm where all
-                        # entries are young, the rebuild frees nothing,
-                        # so don't pay the O(n) scan on every DELETE.
-                        self._del_gen_pruned = now
-                        self._del_gen = {
-                            k: v for k, v in self._del_gen.items()
-                            if now - v[1] < 60.0}
-                    # guard both pops: a stale delete must not evict a
-                    # newer winning mapping
-                    if self._by_labels.get(labels) == nid:
-                        self._by_labels.pop(labels)
-                    dropped = self._by_id.get(nid) == labels
-                    if dropped:
-                        self._by_id.pop(nid)
-                    self._gauge_locked()
-                if dropped and self.on_change is not None:
-                    self.on_change(nid, None)
-            return
-        with self._notify_lock:
-            known = self._insert(nid, labels)
-            if not known and self.on_change is not None:
-                self.on_change(nid, labels)
+            self._remote_delete(nid, labels)
+        else:
+            self._remote_upsert(nid, labels)
 
-    # -- allocation -------------------------------------------------------
-    def allocate(self, labels: LabelSet) -> NumericIdentity:
-        with self._lock:
-            nid = self._by_labels.get(labels)
-            if nid is not None:
-                return nid
-            if any(lbl.source == "cidr" for lbl in labels):
-                # CIDR identities are node-local-scoped (SURVEY §2.1):
-                # they never enter the shared store
-                nid = self._next_local
-                self._next_local += 1
-                self._by_labels[labels] = nid
-                self._by_id[nid] = labels
-                return nid
-        return self._allocate_global(labels)
-
+    # -- allocation (etcd CreateOnly claim protocol) ----------------------
     def _allocate_global(self, labels: LabelSet) -> NumericIdentity:
         enc = _encode_labels(labels)
         value_key = VALUE_PREFIX + enc
@@ -227,84 +148,6 @@ class ClusterIdentityAllocator:
                 return nid
         raise RuntimeError("identity allocation did not converge")
 
-    def _next_candidate(self) -> int:
-        """Next id to claim, from the watch-mirrored cache — no
-        full-table round trip per attempt. Ids claimed by peers but not
-        yet visible here just fail the create, bumping the floor."""
-        with self._lock:
-            cache_max = max(
-                (int(nid) for nid in self._by_id
-                 if IDENTITY_USER_MIN <= nid < IDENTITY_USER_MAX),
-                default=IDENTITY_USER_MIN - 1)
-            return max(cache_max + 1, self._candidate_floor)
-
-    def _gen_of(self, labels: LabelSet) -> int:
-        """Deletion generation for `labels`; read-through callers MUST
-        snapshot this BEFORE their store read — a DELETE whose watch
-        event lands entirely between the read and the adoption is only
-        visible as a generation bump."""
-        with self._lock:
-            return self._del_gen.get(labels, (0,))[0]
-
-    def _insert(self, nid: int, labels: LabelSet,
-                clobber: bool = True) -> bool:
-        """Cache a labels↔id mapping; returns whether consumers already
-        know it (both directions present — a one-sided residue means
-        some transition was never announced, so it must NOT suppress
-        the announcement; duplicate adds are idempotent downstream).
-
-        ``clobber=False`` (read-through adoptions) refuses — atomically
-        — to overwrite a live mapping for the same labels with a
-        DIFFERENT id: the cached one came from the serialized watch
-        stream and is newer than the caller's point-in-time store read
-        (delete + re-create while the reader stalled). Reported as
-        known so the caller neither announces nor undoes anything."""
-        with self._lock:
-            cur = self._by_labels.get(labels)
-            if not clobber and cur is not None and cur != nid:
-                return True
-            known = (self._by_id.get(nid) == labels and cur == nid)
-            self._by_labels[labels] = nid
-            self._by_id[nid] = labels
-            self._gauge_locked()
-        return known
-
-    def _adopt(self, nid: int, labels: LabelSet, gen: int) -> None:
-        """Adopt a mapping read through from the store (`gen` = the
-        deletion generation snapshotted before that read).
-
-        Read-through adoptions must notify like watch events do: the
-        watch CREATE that later arrives for this mapping sees it as
-        `known` and stays silent, so skipping on_change here would
-        leave e.g. a selector cache permanently blind to an identity
-        whenever a store lookup races ahead of the watch stream."""
-        known = self._insert(nid, labels, clobber=False)
-        if known:
-            return
-        # Announce under the notify lock, but only if the mapping is
-        # still current (no watch DELETE bumped the generation since
-        # before our store read, and the cache entry is still ours).
-        # If a delete committed but its watch event hasn't arrived yet,
-        # the announce is transiently stale — and the DELETE's remove,
-        # serialized behind us on the notify lock, retires it. If the
-        # generation HAS moved, the watch already owns this label set:
-        # retract our residue (guarded per entry) so a dead adoption
-        # can't linger in the cache — no future watch event would ever
-        # retire it — and can't make the next genuine CREATE look
-        # already-known. Every interleaving converges on watch truth.
-        with self._notify_lock:
-            with self._lock:
-                current = (self._del_gen.get(labels, (0,))[0] == gen
-                           and self._by_labels.get(labels) == nid)
-                if not current:
-                    if self._by_labels.get(labels) == nid:
-                        self._by_labels.pop(labels)
-                    if self._by_id.get(nid) == labels:
-                        self._by_id.pop(nid)
-                    self._gauge_locked()
-            if current and self.on_change is not None:
-                self.on_change(nid, labels)
-
     # -- lookups (IdentityAllocator contract) -----------------------------
     def lookup(self, nid: NumericIdentity) -> Optional[LabelSet]:
         with self._lock:
@@ -340,23 +183,6 @@ class ClusterIdentityAllocator:
             self._adopt(int(raw), labels, gen)
             return int(raw)
         return None
-
-    def release(self, nid: NumericIdentity) -> None:
-        """Forget locally. Store entries are shared cluster state; the
-        operator's identity GC — not any one agent — retires ids no
-        endpoint references (the reference's CiliumIdentity GC)."""
-        with self._lock:
-            labels = self._by_id.pop(nid, None)
-            if labels is not None:
-                self._by_labels.pop(labels, None)
-
-    def identities(self) -> Iterable[NumericIdentity]:
-        with self._lock:
-            return list(self._by_id)
-
-    def __len__(self) -> int:
-        with self._lock:
-            return len(self._by_id)
 
 
 def gc_orphan_identities(store, grace_s: float = GC_GRACE_S) -> int:

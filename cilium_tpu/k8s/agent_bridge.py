@@ -146,7 +146,10 @@ class K8sWatcherBridge:
 
     def sync_endpoint_status(self) -> None:
         """Periodic controller body: converge every local endpoint's
-        CEP (and prune CEPs of endpoints that no longer exist here)."""
+        CEP (and prune CEPs of endpoints that no longer exist here),
+        plus the CiliumNode object — a podCIDR re-carve after start
+        must not leave stale node state published forever."""
+        self.publish_node()
         eps = self.agent.endpoint_manager.endpoints()
         mine = set()
         for ep in eps:

@@ -138,13 +138,20 @@ class ResourceStore:
             self._compacted_rv = self._events[0][0]
         self._events.append((self._rv, typ, plural, snap))
 
-    def _deliver(self, typ: str, plural: str, obj: Dict) -> None:
-        with self._dispatch:
-            with self._lock:
-                watches = [w for w in self._watches if w.plural == plural]
-            ev = {"type": typ, "object": obj}
-            for w in watches:
-                w.push(ev)
+    def _deliver_locked(self, typ: str, plural: str, obj: Dict) -> None:
+        """Caller holds self._dispatch (NOT self._lock): push to the
+        watches registered for `plural`. Emission (rv stamping) and
+        delivery happen inside ONE dispatch critical section per write
+        — two concurrent writes delivering in separate sections could
+        reach watchers out of rv order, making informers cache the
+        stale object (its event arrives last) until a relist, and a
+        registering watch could see a just-emitted event twice
+        (backlog + live)."""
+        with self._lock:
+            watches = [w for w in self._watches if w.plural == plural]
+        ev = {"type": typ, "object": obj}
+        for w in watches:
+            w.push(ev)
 
     # -- verbs ------------------------------------------------------------
     def list(self, plural: str, namespace: Optional[str] = None) -> Dict:
@@ -168,13 +175,14 @@ class ResourceStore:
         obj = self._stamp_new(plural, obj)
         meta = obj["metadata"]
         k = _key(meta.get("namespace", ""), meta["name"])
-        with self._lock:
-            if k in self._objs[plural]:
-                raise Conflict(f"{plural} {k[0]}/{k[1]} exists")
-            self._emit_locked("ADDED", plural, obj)
-            self._objs[plural][k] = obj
-            snap = json.loads(json.dumps(obj))
-        self._deliver("ADDED", plural, snap)
+        with self._dispatch:
+            with self._lock:
+                if k in self._objs[plural]:
+                    raise Conflict(f"{plural} {k[0]}/{k[1]} exists")
+                self._emit_locked("ADDED", plural, obj)
+                self._objs[plural][k] = obj
+                snap = json.loads(json.dumps(obj))
+            self._deliver_locked("ADDED", plural, snap)
         return snap
 
     def update(self, plural: str, obj: Dict) -> Dict:
@@ -185,43 +193,46 @@ class ResourceStore:
             raise ValueError("metadata.name required")
         k = _key(meta.get("namespace", "") if namespaced else "",
                  meta["name"])
-        with self._lock:
-            cur = self._objs[plural].get(k)
-            if cur is None:
-                raise NotFound(f"{plural} {k[0]}/{k[1]}")
-            want_rv = meta.get("resourceVersion")
-            if want_rv is not None and \
-                    want_rv != cur["metadata"]["resourceVersion"]:
-                raise Conflict(
-                    f"{plural} {k[1]}: stale resourceVersion "
-                    f"{want_rv} (current "
-                    f"{cur['metadata']['resourceVersion']})")
-            # carry immutable metadata; bump generation on spec change
-            for field in ("uid", "generation"):
-                meta[field] = cur["metadata"][field]
-            if namespaced:
-                meta["namespace"] = k[0]
-            obj.setdefault("apiVersion", "cilium.io/v2")
-            obj.setdefault("kind", kind)
-            if any(obj.get(f) != cur.get(f)
-                   for f in ("spec", "specs")):
-                meta["generation"] = cur["metadata"]["generation"] + 1
-            self._emit_locked("MODIFIED", plural, obj)
-            self._objs[plural][k] = obj
-            snap = json.loads(json.dumps(obj))
-        self._deliver("MODIFIED", plural, snap)
+        with self._dispatch:
+            with self._lock:
+                cur = self._objs[plural].get(k)
+                if cur is None:
+                    raise NotFound(f"{plural} {k[0]}/{k[1]}")
+                want_rv = meta.get("resourceVersion")
+                if want_rv is not None and \
+                        want_rv != cur["metadata"]["resourceVersion"]:
+                    raise Conflict(
+                        f"{plural} {k[1]}: stale resourceVersion "
+                        f"{want_rv} (current "
+                        f"{cur['metadata']['resourceVersion']})")
+                # carry immutable metadata; bump generation on change
+                for field in ("uid", "generation"):
+                    meta[field] = cur["metadata"][field]
+                if namespaced:
+                    meta["namespace"] = k[0]
+                obj.setdefault("apiVersion", "cilium.io/v2")
+                obj.setdefault("kind", kind)
+                if any(obj.get(f) != cur.get(f)
+                       for f in ("spec", "specs")):
+                    meta["generation"] = \
+                        cur["metadata"]["generation"] + 1
+                self._emit_locked("MODIFIED", plural, obj)
+                self._objs[plural][k] = obj
+                snap = json.loads(json.dumps(obj))
+            self._deliver_locked("MODIFIED", plural, snap)
         return snap
 
     def delete(self, plural: str, namespace: str, name: str) -> Dict:
         self._check(plural)
         k = _key(namespace, name)
-        with self._lock:
-            obj = self._objs[plural].pop(k, None)
-            if obj is None:
-                raise NotFound(f"{plural} {k[0]}/{k[1]}")
-            self._emit_locked("DELETED", plural, obj)
-            snap = json.loads(json.dumps(obj))
-        self._deliver("DELETED", plural, snap)
+        with self._dispatch:
+            with self._lock:
+                obj = self._objs[plural].pop(k, None)
+                if obj is None:
+                    raise NotFound(f"{plural} {k[0]}/{k[1]}")
+                self._emit_locked("DELETED", plural, obj)
+                snap = json.loads(json.dumps(obj))
+            self._deliver_locked("DELETED", plural, snap)
         return snap
 
     # -- watch ------------------------------------------------------------

@@ -38,12 +38,19 @@ class Operator:
     """Watches node registrations; assigns/reclaims per-node podCIDRs."""
 
     def __init__(self, store: KVStore, pool_cidr: str = "10.0.0.0/8",
-                 node_mask_size: int = 24):
+                 node_mask_size: int = 24, k8s_api_socket: str = ""):
         self.store = store
         self.pool = ClusterPool(pool_cidr, node_mask_size=node_mask_size)
         self._lock = threading.Lock()
         self._watch = None
         self._controller: Optional[Controller] = None
+        #: when set, reconcile also runs the CiliumIdentity CRD GC
+        #: (identity-allocation-mode=crd deployments)
+        self._k8s_client = None
+        if k8s_api_socket:
+            from cilium_tpu.k8s.apiserver import K8sClient
+
+            self._k8s_client = K8sClient(k8s_api_socket)
 
     def _persisted_assignments(self) -> Dict[str, str]:
         """node → CIDR from the store, quarantining corrupt entries.
@@ -140,6 +147,10 @@ class Operator:
             from cilium_tpu.identity_kvstore import gc_orphan_identities
 
             gc_orphan_identities(self.store)
+            if self._k8s_client is not None:
+                from cilium_tpu.k8s.identity_crd import gc_crd_identities
+
+                gc_crd_identities(self._k8s_client)
             return assigned
 
 
@@ -277,6 +288,9 @@ def main(argv=None) -> int:  # pragma: no cover - thin wrapper
                     help="kvstore server unix socket")
     ap.add_argument("--pool-cidr", default="10.0.0.0/8")
     ap.add_argument("--node-mask", type=int, default=24)
+    ap.add_argument("--k8s-api-socket", default="",
+                    help="fake-apiserver socket: also run the "
+                         "CiliumIdentity CRD GC (crd identity mode)")
     args = ap.parse_args(argv)
 
     from cilium_tpu.kvstore_service import RemoteKVStore
@@ -285,7 +299,8 @@ def main(argv=None) -> int:  # pragma: no cover - thin wrapper
     setup_logging()
     kv = RemoteKVStore(args.kvstore)
     op = Operator(kv, pool_cidr=args.pool_cidr,
-                  node_mask_size=args.node_mask).start()
+                  node_mask_size=args.node_mask,
+                  k8s_api_socket=args.k8s_api_socket).start()
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())

@@ -1,0 +1,219 @@
+"""CRD-mode identity allocation (SURVEY §2.1 "via kvstore or
+CiliumIdentity CRD"): CiliumIdentity objects as the cluster store,
+informer-mirrored caches, duplicate tolerance, operator GC.
+"""
+
+import threading
+import time
+
+import pytest
+
+from cilium_tpu.core.labels import LabelSet
+from cilium_tpu.k8s.apiserver import APIServer, K8sClient
+from cilium_tpu.k8s.identity_crd import (
+    PLURAL,
+    CRDIdentityAllocator,
+    gc_crd_identities,
+    identity_object,
+)
+
+
+def labels(**kw):
+    return LabelSet.from_dict(kw)
+
+
+def wait_until(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.fixture()
+def server(tmp_path):
+    s = APIServer(str(tmp_path / "k8s.sock")).start()
+    yield s
+    s.stop()
+
+
+def test_two_nodes_agree_and_remote_announces(server):
+    c = K8sClient(server.socket_path)
+    seen = []
+    a = CRDIdentityAllocator(K8sClient(server.socket_path)).start()
+    b = CRDIdentityAllocator(
+        c, on_change=lambda nid, lbls: seen.append((nid, lbls))).start()
+    try:
+        nid = a.allocate(labels(app="db"))
+        # b's informer hears the create and can resolve both ways
+        assert wait_until(
+            lambda: b.lookup_by_labels(labels(app="db")) == nid)
+        assert b.lookup(nid) == labels(app="db")
+        assert (nid, labels(app="db")) in seen
+        # same labels on b → same id, no duplicate created
+        assert b.allocate(labels(app="db")) == nid
+        assert len(c.list(PLURAL)["items"]) == 1
+        # fresh allocator replays the table at start (synchronous)
+        d = CRDIdentityAllocator(K8sClient(server.socket_path)).start()
+        try:
+            assert d.lookup_by_labels(labels(app="db")) == nid
+        finally:
+            d.close()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_cidr_identities_stay_node_local(server):
+    a = CRDIdentityAllocator(K8sClient(server.socket_path)).start()
+    try:
+        nid = a.allocate(LabelSet.parse(["cidr:10.0.0.0/8"]))
+        assert nid >= 1 << 24
+        assert not K8sClient(server.socket_path).list(PLURAL)["items"]
+    finally:
+        a.close()
+
+
+def test_duplicate_identities_tolerated_lowest_wins(server):
+    """The CRD store has no labels→id uniqueness: a cross-node race
+    can create two CiliumIdentities for one label set. Lookups resolve
+    to the lowest id; both ids stay resolvable (endpoints may carry
+    either); deleting the winner falls back to the survivor."""
+    c = K8sClient(server.socket_path)
+    seen = []
+    a = CRDIdentityAllocator(
+        c, on_change=lambda nid, lbls: seen.append((nid, lbls))).start()
+    try:
+        # simulate the race loser's object arriving from another node
+        c.create(PLURAL, identity_object(300, labels(app="dup")))
+        assert wait_until(
+            lambda: a.lookup_by_labels(labels(app="dup")) == 300)
+        c.create(PLURAL, identity_object(290, labels(app="dup")))
+        assert wait_until(
+            lambda: a.lookup_by_labels(labels(app="dup")) == 290)
+        # both ids resolve labels (selector parity for either)
+        assert a.lookup(300) == labels(app="dup")
+        assert a.lookup(290) == labels(app="dup")
+        assert (300, labels(app="dup")) in seen
+        assert (290, labels(app="dup")) in seen
+        # GC the winner (e.g. operator reaped it): survivor takes over
+        c.delete(PLURAL, "290")
+        assert wait_until(
+            lambda: a.lookup_by_labels(labels(app="dup")) == 300)
+        assert (290, None) in seen
+    finally:
+        a.close()
+
+
+def test_concurrent_allocation_converges_or_duplicates_safely(server):
+    allocators = [
+        CRDIdentityAllocator(K8sClient(server.socket_path)).start()
+        for _ in range(4)]
+    results = []
+    barrier = threading.Barrier(4)
+
+    def run(alloc):
+        barrier.wait()
+        results.append(alloc.allocate(labels(app="contended")))
+
+    threads = [threading.Thread(target=run, args=(a,))
+               for a in allocators]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(15)
+        assert not any(t.is_alive() for t in threads), "allocator hung"
+        assert len(results) == 4
+        # duplicates are legal; convergence means every allocator
+        # eventually resolves the labels to ONE deterministic id
+        want = min(results)
+        for a in allocators:
+            assert wait_until(
+                lambda: a.lookup_by_labels(
+                    labels(app="contended")) == want)
+    finally:
+        for a in allocators:
+            a.close()
+
+
+def test_gc_reaps_unreferenced_after_grace(server):
+    c = K8sClient(server.socket_path)
+    # referenced identity: a CEP points at it
+    c.create(PLURAL, dict(identity_object(256, labels(app="live")),
+                          **{"created-at": time.time() - 3600}))
+    c.create("ciliumendpoints", {
+        "metadata": {"name": "n1-ep-1", "namespace": "default"},
+        "status": {"id": 1, "identity": {"id": 256},
+                   "networking": {"node": "n1"}}})
+    # unreferenced + old → reap; unreferenced + fresh → keep
+    c.create(PLURAL, dict(identity_object(300, labels(app="old")),
+                          **{"created-at": time.time() - 3600}))
+    c.create(PLURAL, identity_object(301, labels(app="fresh")))
+    assert gc_crd_identities(c) == 1
+    names = {o["metadata"]["name"] for o in c.list(PLURAL)["items"]}
+    assert names == {"256", "301"}
+
+
+def test_agent_crd_mode_cross_node_enforcement(server):
+    """The reference's CRD deployment shape: two agents, no kvstore
+    identity mode — identities agree cluster-wide through CiliumIdentity
+    objects, so node A enforces on flows from node B's endpoints."""
+    from cilium_tpu.agent import Agent
+    from cilium_tpu.core.config import Config
+    from cilium_tpu.core.flow import Flow
+    from cilium_tpu.policy.api.cnp import load_cnp_yaml_text
+
+    def make_agent(name):
+        cfg = Config()
+        cfg.node_name = name
+        cfg.identity_allocation_mode = "crd"
+        cfg.k8s_api_socket = server.socket_path
+        cfg.configure_logging = False
+        return Agent(config=cfg).start()
+
+    agent_a = make_agent("node-a")
+    agent_b = make_agent("node-b")
+    try:
+        db = agent_a.endpoint_add(1, {"app": "db"})
+        web_remote = agent_b.endpoint_add(2, {"app": "web"})
+        agent_a.policy_add(load_cnp_yaml_text("""
+apiVersion: cilium.io/v2
+kind: CiliumNetworkPolicy
+metadata: {name: allow-web}
+spec:
+  endpointSelector: {matchLabels: {app: db}}
+  ingress:
+  - fromEndpoints: [{matchLabels: {app: web}}]
+    toPorts: [{ports: [{port: "5432", protocol: TCP}]}]
+""")[0])
+
+        def verdicts():
+            out = agent_a.process_flows([
+                Flow(src_identity=web_remote.identity,
+                     dst_identity=db.identity, dport=5432),
+                Flow(src_identity=db.identity,
+                     dst_identity=db.identity, dport=5432),
+            ])
+            return [int(v) for v in out["verdict"]]
+
+        assert wait_until(lambda: verdicts() == [1, 2], timeout=30), \
+            verdicts()
+        # same labels, either node → same numeric identity
+        assert agent_a.endpoint_add(3, {"app": "web"}).identity \
+            == web_remote.identity
+    finally:
+        agent_a.stop()
+        agent_b.stop()
+
+
+def test_agent_crd_mode_requires_socket():
+    from cilium_tpu.agent import Agent
+    from cilium_tpu.core.config import Config
+
+    cfg = Config()
+    cfg.identity_allocation_mode = "crd"
+    cfg.configure_logging = False
+    with pytest.raises(ValueError):
+        Agent(config=cfg)
