@@ -424,6 +424,16 @@ def test_generic_capture_hypothesis_differential(tmp_path):
             for s in range(0, len(rec), 16)))
         np.testing.assert_array_equal(
             got_staged, want, err_msg=f"staged path trial {trial}")
+        # dedup replay (unique-row table + id stream) is lossless:
+        # chunked verdicts through verdict_idx equal every other path
+        ratio = replay.stage_unique()
+        assert 0 < ratio <= 1.0
+        got_dedup = list(itertools.chain.from_iterable(
+            np.asarray(replay.verdict_idx(
+                replay.row_idx[s:s + 16])["verdict"]).tolist()
+            for s in range(0, len(rec), 16)))
+        np.testing.assert_array_equal(
+            got_dedup, want, err_msg=f"dedup path trial {trial}")
         seen_verdicts |= set(int(v) for v in want)
 
     # the sweep exercised allow AND deny, not one degenerate outcome
