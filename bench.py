@@ -94,20 +94,9 @@ def _init_backend() -> None:
         # 10-20s — five table-scan shapes alone put ~80s into
         # stage_ms before this (BENCH_ALL_r04 first run). With the
         # cache, repeat shapes load in milliseconds across processes.
-        # Setup failure (read-only HOME etc.) must degrade to no-cache,
-        # NOT masquerade as backend-unavailable rc=42.
-        try:
-            cache_dir = os.environ.get(
-                "CILIUM_TPU_XLA_CACHE",
-                os.path.expanduser("~/.cache/cilium_tpu/xla"))
-            if cache_dir:
-                os.makedirs(cache_dir, exist_ok=True)
-                jax.config.update("jax_compilation_cache_dir",
-                                  cache_dir)
-                jax.config.update(
-                    "jax_persistent_cache_min_compile_time_secs", 0.5)
-        except OSError as e:
-            print(f"xla cache disabled: {e}", file=sys.stderr)
+        from cilium_tpu.runtime.xla_cache import enable_persistent_cache
+
+        enable_persistent_cache()
         jax.devices()
     except Exception as e:  # noqa: BLE001 — any init error means retry
         print(f"backend init failed: {e}", file=sys.stderr)
@@ -182,8 +171,8 @@ def _bench_from_capture(args, cfg, engine, scenario, arrays, log):
     # unique-row table cut that to 2-4B/row. Fall back to plain row
     # streaming when the capture doesn't repeat enough to pay for
     # the gather indirection.
-    dedup_ratio = replay.stage_unique()
-    use_dedup = dedup_ratio < 0.5
+    dedup_ratio = replay.stage_unique(drop_if_ratio_at_least=0.5)
+    use_dedup = replay.row_idx is not None
     if use_dedup:
         replay.stage_unique_device()  # inside stage timing, honestly
     stage_s = time.perf_counter() - t_stage0

@@ -413,6 +413,12 @@ def main() -> int:
         import jax
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # without the persistent cache, every sweep process recompiled all
+    # ~9 pow2 batch buckets at 10-20s each through the tunnel — the
+    # round-4 first TPU sweep's windows were mostly compile time
+    from cilium_tpu.runtime.xla_cache import enable_persistent_cache
+
+    enable_persistent_cache()
 
     import tempfile
 

@@ -757,6 +757,24 @@ def _gen_intern_rows(gen, offsets: np.ndarray, blob: np.ndarray,
     return out
 
 
+
+def _pad_rows_pow2(*arrays):
+    """Pad each array's FIRST axis (same length across arrays) with
+    zeros up to the next power of two — shape buckets so the jitted
+    scans/gathers hit the persistent XLA cache across captures instead
+    of compiling per-file exact sizes. Padded rows must never be
+    referenced (valid-masked or absent from every id stream)."""
+    n = len(arrays[0])
+    S_pad = 1 << max(0, (max(1, n) - 1)).bit_length()
+    if S_pad == n:
+        return arrays if len(arrays) > 1 else arrays[0]
+    out = tuple(
+        np.concatenate(
+            [a, np.zeros((S_pad - n,) + a.shape[1:], dtype=a.dtype)])
+        for a in arrays)
+    return out if len(out) > 1 else out[0]
+
+
 class CaptureFeaturizer:
     """Chunked-replay featurizer over one v2 capture: pays the string
     work ONCE per file, then each chunk is pure row gathers.
@@ -796,22 +814,11 @@ class CaptureFeaturizer:
             data, lens, valid = _gather_table_field(
                 blob, offsets, used, self.widths[field],
                 fixed_len=self.widths[field])
-            # pad the string count to the next power of two: the
-            # staged table scan (stage_capture_tables) then compiles
-            # for shape buckets instead of per-file exact sizes, so
-            # the persistent XLA cache hits across captures — a fresh
-            # TPU compile through the tunnel is 10-20s per shape
-            S = max(1, len(data))
-            S_pad = 1 << (S - 1).bit_length()
-            if S_pad != S:
-                pad = S_pad - S
-                data = np.concatenate(
-                    [data, np.zeros((pad,) + data.shape[1:],
-                                    dtype=data.dtype)])
-                lens = np.concatenate(
-                    [lens, np.zeros(pad, dtype=lens.dtype)])
-                valid = np.concatenate(
-                    [valid, np.zeros(pad, dtype=valid.dtype)])
+            # shape-bucket the string count (_pad_rows_pow2): the
+            # staged table scan (stage_capture_tables) then hits the
+            # persistent XLA cache across captures — a fresh TPU
+            # compile through the tunnel is 10-20s per shape
+            data, lens, valid = _pad_rows_pow2(data, lens, valid)
             lut = np.zeros(n_strings, dtype=np.int32)
             lut[used] = np.arange(len(used), dtype=np.int32)
             self.tables[field] = (data, lens, valid)
@@ -1338,15 +1345,24 @@ class VerdictEngine:
 
     def verdict_flows(self, flows: Sequence[Flow],
                       cfg: Optional[EngineConfig] = None,
-                      authed_pairs: Optional[np.ndarray] = None):
+                      authed_pairs: Optional[np.ndarray] = None,
+                      outputs: Optional[Sequence[str]] = None):
         """``authed_pairs`` (lex-sorted [P, 2] int32 (src, dst) table,
         AuthManager.pairs_array): drop-until-authed enforcement for
         entries demanding authentication. See :meth:`_stage_auth` for
-        the None / AUTH_UNENFORCED contract."""
+        the None / AUTH_UNENFORCED contract.
+
+        ``outputs``: materialize only these lanes. Each np.asarray is
+        its own device→host transfer — on the tunneled TPU that is a
+        full RTT per lane (docs/PLATFORM.md), so a caller that only
+        consumes verdicts (the MicroBatcher service path) pays 1 RTT
+        instead of one per output key."""
         fb = encode_flows(flows, self.policy.kafka_interns, cfg)
         batch = flowbatch_to_device(fb, self.device)
         self._stage_auth(batch, authed_pairs)
         out = self.verdict_batch_arrays(batch)
+        if outputs is not None:
+            out = {k: out[k] for k in outputs}
         return {k: np.asarray(v) for k, v in out.items()}
 
     def verdict_records(self, rec, cfg: Optional[EngineConfig] = None,
@@ -1418,7 +1434,8 @@ class CaptureReplay:
             np.asarray(rec), l7, gen_rows=self.feat.gen_rows)
         return self.rows_all
 
-    def stage_unique(self) -> float:
+    def stage_unique(self, drop_if_ratio_at_least: Optional[float]
+                     = None) -> float:
         """Deduplicate the staged row block (capture traffic repeats
         its 15-tuples heavily — identities × ports × L7 fields draw
         from small sets): the unique-row table goes to the device once,
@@ -1438,22 +1455,32 @@ class CaptureReplay:
         table it won't use. The table is padded to a power-of-two row
         count (padded ids are never emitted in ``row_idx``), keeping
         the jitted step's shapes in buckets the persistent XLA cache
-        can hit across captures."""
+        can hit across captures.
+
+        ``drop_if_ratio_at_least``: a capture that barely repeats makes
+        the id stream a net loss AND the unique table ≈ a full copy of
+        ``rows_all`` — past this ratio the table/ids are discarded
+        immediately (``row_idx`` stays None) instead of pinning ~2× the
+        capture in host memory for a session that will stream rows."""
         assert self.rows_all is not None, "stage_rows first"
         uniq, inverse = np.unique(self.rows_all, axis=0,
                                   return_inverse=True)
         n_true = len(uniq)
-        S_pad = 1 << max(0, (n_true - 1)).bit_length()
-        if S_pad != n_true:
-            uniq = np.concatenate(
-                [uniq, np.zeros((S_pad - n_true,) + uniq.shape[1:],
-                                dtype=uniq.dtype)])
+        ratio = n_true / max(1, len(self.rows_all))
+        if drop_if_ratio_at_least is not None \
+                and ratio >= drop_if_ratio_at_least:
+            self._uniq_host = None
+            self.unique_rows = None
+            self.row_idx = None
+            self.n_unique = n_true
+            return ratio
+        uniq = _pad_rows_pow2(uniq)
         self._uniq_host = uniq
         self.unique_rows = None
         self.n_unique = n_true
-        idx_dtype = np.uint16 if S_pad <= (1 << 16) else np.int32
+        idx_dtype = np.uint16 if len(uniq) <= (1 << 16) else np.int32
         self.row_idx = inverse.astype(idx_dtype)
-        return n_true / max(1, len(self.rows_all))
+        return ratio
 
     def stage_unique_device(self) -> jax.Array:
         """Push the (padded) unique-row table to the device, once."""
@@ -1462,12 +1489,17 @@ class CaptureReplay:
                                               self.engine.device)
         return self.unique_rows
 
-    def verdict_idx(self, idx: np.ndarray) -> Dict[str, jax.Array]:
+    def verdict_idx(self, idx: np.ndarray, authed_pairs=None
+                    ) -> Dict[str, jax.Array]:
         """Verdict a chunk given per-flow unique-row ids (the
         :meth:`stage_unique` stream): one tiny H2D + on-device gather
-        + the shared capture step."""
+        + the shared capture step. Auth staging matches
+        :meth:`verdict_rows` — the id stream must enforce
+        drop-until-authed exactly like every other replay path (None
+        is fail-closed when the policy demands auth)."""
         batch = {"rows": self.stage_unique_device(),
                  "idx": jax.device_put(idx, self.engine.device)}
+        self.engine._stage_auth(batch, authed_pairs)
         return self._step(self.engine._arrays, self.table_words, batch)
 
     def verdict_rows(self, rows: np.ndarray, authed_pairs=None

@@ -288,12 +288,15 @@ class OracleVerdictEngine:
             return Verdict.AUDIT
         return v
 
-    def verdict_flows(self, flows: Sequence[Flow], authed_pairs=None):
+    def verdict_flows(self, flows: Sequence[Flow], authed_pairs=None,
+                      outputs=None):
         """``authed_pairs``: lex-sorted [P, 2] int32 (src, dst) table
         (AuthManager.pairs_array; sentinel rows ignored) — same
         contract as VerdictEngine.verdict_flows: ``None`` is
         fail-closed (auth-demanding flows drop), ``AUTH_UNENFORCED``
-        leaves the demand as an output lane only."""
+        leaves the demand as an output lane only. ``outputs`` subsets
+        the returned lanes (interface parity with the device engine,
+        where each lane is a device→host transfer)."""
         import numpy as np
 
         from cilium_tpu.auth import AUTH_UNENFORCED
@@ -322,11 +325,14 @@ class OracleVerdictEngine:
             verdicts.append(int(verdict))
             auth.append(demand)
             logs.append(log and verdict == Verdict.REDIRECTED)
-        return {
+        out = {
             "verdict": np.array(verdicts, dtype=np.int32),
             "auth_required": np.array(auth, dtype=bool),
             "l7_log": np.array(logs, dtype=bool),
         }
+        if outputs is not None:
+            out = {k: out[k] for k in outputs}
+        return out
 
     def verdict_records(self, rec, authed_pairs=None):
         """Interface parity with VerdictEngine.verdict_records (the

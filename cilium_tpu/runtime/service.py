@@ -58,24 +58,29 @@ def verdict_flows_padded(engine, flows: Sequence[Flow],
     each distinct size is a fresh XLA compile — pow2 bucketing bounds
     the shape space to ~log2(batch_max) sizes so p99 under live load
     isn't a compile storm (SURVEY.md §7 hard part #5). Pad flows are
-    identity-0 tuples; their verdicts are sliced off."""
+    identity-0 tuples; their verdicts are sliced off. Only the verdict
+    lane is read back: each output lane is a device→host RTT on the
+    tunneled TPU, and this path's callers consume nothing else."""
     return [int(v) for v in
             verdict_outputs_padded(engine, flows,
-                                   authed_pairs=authed_pairs)["verdict"]]
+                                   authed_pairs=authed_pairs,
+                                   outputs=("verdict",))["verdict"]]
 
 
 def verdict_outputs_padded(engine, flows: Sequence[Flow],
-                           authed_pairs=None):
+                           authed_pairs=None, outputs=None):
     """Full output lanes under the same pow2 padding (every lane
     sliced back to the real batch) — for callers that fan the batch
-    out to observability and need match_spec/l7_log too."""
+    out to observability and need match_spec/l7_log too. ``outputs``
+    limits which lanes are read back (one transfer per lane)."""
     import numpy as np
 
     n = len(flows)
     target = 1 << max(0, n - 1).bit_length()
     if target > n:
         flows = list(flows) + [Flow()] * (target - n)
-    out = engine.verdict_flows(flows, authed_pairs=authed_pairs)
+    out = engine.verdict_flows(flows, authed_pairs=authed_pairs,
+                               outputs=outputs)
     return {k: np.asarray(v)[:n] for k, v in out.items()}
 
 

@@ -1,0 +1,48 @@
+"""Persistent XLA compilation cache setup (shared, idempotent).
+
+A TPU compile through the tunneled transport costs 10-20s per shape
+(docs/PLATFORM.md); the engine's shapes are deliberately bucketed
+(pow2 batch buckets in the service path, pow2 string/unique-row tables
+in capture replay) precisely so they repeat — but without a persistent
+cache every fresh PROCESS recompiles all of them, which turned whole
+bench_service measurement windows into compile storms (round-4 first
+TPU sweep) and costs every daemon restart the same. One call, before
+or after jax import, points every process at one on-disk cache.
+
+Reference analog: compiled-datapath reuse across agent restarts
+(``pkg/datapath/loader``'s object cache keyed by template hash); the
+artifact cache in ``runtime/loader.py`` plays that role for staged
+POLICY tensors, this one for XLA executables.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_done = False
+
+
+def enable_persistent_cache() -> None:
+    """Point jax at the shared on-disk compilation cache; failure to
+    set up (read-only HOME, exotic jax build) degrades to no-cache.
+    Override the location with ``CILIUM_TPU_XLA_CACHE``; set it empty
+    to disable."""
+    global _done
+    if _done:
+        return
+    _done = True
+    try:
+        import jax
+
+        cache_dir = os.environ.get(
+            "CILIUM_TPU_XLA_CACHE",
+            os.path.expanduser("~/.cache/cilium_tpu/xla"))
+        if not cache_dir:
+            return
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as e:  # noqa: BLE001 — cache is an optimization
+        print(f"xla persistent cache disabled: {e}", file=sys.stderr)

@@ -535,14 +535,22 @@ def test_capture_replay_enforces_auth_pairs(tmp_path):
     l7, offsets, blob = binary.read_l7_sidecar(path)
     replay = CaptureReplay(engine, l7, offsets, blob, cfg.engine)
 
+    replay.stage_rows(rec, l7)
+    replay.stage_unique()
     for pairs, want in (
             (None, 2),                                    # fail closed
             (np.array([[cart, pay]], dtype=np.int32), 1),  # authed
     ):
         via_cap = replay.verdict_chunk(rec, l7, authed_pairs=pairs)
         via_flows = engine.verdict_flows(flows, authed_pairs=pairs)
+        # the dedup id stream enforces identically (regression: its
+        # first cut skipped _stage_auth, silently forwarding unauthed
+        # auth-demanding flows on this path only)
+        via_idx = replay.verdict_idx(replay.row_idx,
+                                     authed_pairs=pairs)
         assert int(via_cap["verdict"][0]) == want
         assert int(via_flows["verdict"][0]) == want
+        assert int(np.asarray(via_idx["verdict"])[0]) == want
         assert bool(via_cap["auth_required"][0])
 
 
