@@ -19,6 +19,7 @@ Keys:
 from __future__ import annotations
 
 import json
+import os
 import threading
 from typing import Dict, Optional
 
@@ -38,7 +39,9 @@ class Operator:
     """Watches node registrations; assigns/reclaims per-node podCIDRs."""
 
     def __init__(self, store: KVStore, pool_cidr: str = "10.0.0.0/8",
-                 node_mask_size: int = 24, k8s_api_socket: str = ""):
+                 node_mask_size: int = 24, k8s_api_socket: str = "",
+                 leader_election: bool = False,
+                 instance: str = "", election_ttl: float = 15.0):
         self.store = store
         self.pool = ClusterPool(pool_cidr, node_mask_size=node_mask_size)
         self._lock = threading.Lock()
@@ -51,6 +54,13 @@ class Operator:
             from cilium_tpu.k8s.apiserver import K8sClient
 
             self._k8s_client = K8sClient(k8s_api_socket)
+        #: HA mode (reference: cilium-operator replicas behind leader
+        #: election): only the elected instance reconciles; standbys
+        #: campaign and take over within the election TTL
+        self._leader_election = leader_election
+        self._instance = instance or f"operator-{os.getpid()}"
+        self._election_ttl = election_ttl
+        self._elector = None
 
     def _persisted_assignments(self) -> Dict[str, str]:
         """node → CIDR from the store, quarantining corrupt entries.
@@ -81,8 +91,30 @@ class Operator:
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "Operator":
-        # adopt existing assignments first (operator restart must not
-        # re-carve CIDRs out from under live nodes — §5.4 resume)
+        """Without leader election: lead immediately (the single-
+        replica deployment). With it: campaign, and reconcile only
+        while elected — a standby replica parks here until the
+        leader's lock lapses or is released."""
+        if not self._leader_election:
+            self._start_leading()
+            return self
+        from cilium_tpu.runtime.leader import LeaderElector
+
+        self._elector = LeaderElector(
+            self.store, "cilium-operator", self._instance,
+            on_started_leading=self._start_leading,
+            on_stopped_leading=self._stop_leading,
+            ttl=self._election_ttl).start()
+        return self
+
+    def _start_leading(self) -> None:
+        # adopt existing assignments first (operator restart/failover
+        # must not re-carve CIDRs out from under live nodes — §5.4
+        # resume; the pool is rebuilt fresh from the persisted store
+        # state, which also discards any stale carvings a previous
+        # leadership stint of THIS instance left in memory)
+        self.pool = ClusterPool(str(self.pool.pool),
+                                node_mask_size=self.pool.node_mask_size)
         for node, cidr in self._persisted_assignments().items():
             try:
                 self.pool.adopt_node_cidr(node, cidr)
@@ -101,13 +133,27 @@ class Operator:
             interval=30.0).start()
         self._watch = self.store.watch_prefix(
             NODES_PREFIX, lambda ev: self._controller.trigger())
-        return self
 
-    def stop(self) -> None:
+    def _stop_leading(self) -> None:
         if self._watch is not None:
             self._watch.stop()
+            self._watch = None
         if self._controller is not None:
             self._controller.stop()
+            self._controller = None
+
+    @property
+    def is_leader(self) -> bool:
+        if not self._leader_election:
+            return True
+        return self._elector is not None and self._elector.is_leader
+
+    def stop(self) -> None:
+        if self._elector is not None:
+            self._elector.stop()  # resigns; drove _stop_leading
+            self._elector = None
+            return
+        self._stop_leading()
 
     # -- reconciliation ---------------------------------------------------
     def reconcile(self) -> Dict[str, str]:
@@ -291,6 +337,11 @@ def main(argv=None) -> int:  # pragma: no cover - thin wrapper
     ap.add_argument("--k8s-api-socket", default="",
                     help="fake-apiserver socket: also run the "
                          "CiliumIdentity CRD GC (crd identity mode)")
+    ap.add_argument("--leader-election", action="store_true",
+                    help="HA mode: campaign for the operator lock; "
+                         "reconcile only while elected (run several "
+                         "replicas, reference leader election)")
+    ap.add_argument("--election-ttl", type=float, default=15.0)
     args = ap.parse_args(argv)
 
     from cilium_tpu.kvstore_service import RemoteKVStore
@@ -300,7 +351,9 @@ def main(argv=None) -> int:  # pragma: no cover - thin wrapper
     kv = RemoteKVStore(args.kvstore)
     op = Operator(kv, pool_cidr=args.pool_cidr,
                   node_mask_size=args.node_mask,
-                  k8s_api_socket=args.k8s_api_socket).start()
+                  k8s_api_socket=args.k8s_api_socket,
+                  leader_election=args.leader_election,
+                  election_ttl=args.election_ttl).start()
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())

@@ -1,0 +1,96 @@
+"""Operator HA: leader election over the kvstore (reference:
+cilium-operator replicas behind a k8s Lease — exactly one reconciles).
+"""
+
+import time
+
+from cilium_tpu.kvstore import KVStore
+from cilium_tpu.operator import NodeRegistration, Operator
+from cilium_tpu.runtime.leader import LEADER_PREFIX, LeaderElector
+
+
+def wait_until(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_single_winner_and_clean_handover():
+    store = KVStore()
+    events = []
+
+    def mk(name):
+        return LeaderElector(
+            store, "op", name,
+            on_started_leading=lambda: events.append(("lead", name)),
+            on_stopped_leading=lambda: events.append(("stop", name)),
+            ttl=0.5).start()
+
+    a = mk("a")
+    assert wait_until(lambda: a.is_leader)
+    b = mk("b")
+    time.sleep(0.4)
+    assert not b.is_leader  # exactly one leader
+    assert store.get(LEADER_PREFIX + "op") == "a"
+    # clean resign hands over without waiting out the TTL window
+    a.stop()
+    assert ("stop", "a") in events
+    assert wait_until(lambda: b.is_leader, timeout=5)
+    assert store.get(LEADER_PREFIX + "op") == "b"
+    b.stop()
+    assert events[-1] == ("stop", "b")
+
+
+def test_crash_failover_after_ttl():
+    """A leader that vanishes without resigning (crash) loses the lock
+    when its lease lapses; the standby takes over."""
+    store = KVStore()
+    a = LeaderElector(store, "op", "a", lambda: None, lambda: None,
+                      ttl=0.4).start()
+    assert wait_until(lambda: a.is_leader)
+    b = LeaderElector(store, "op", "b", lambda: None, lambda: None,
+                      ttl=0.4).start()
+    # simulate crash: kill a's campaign thread without resigning
+    a._stop.set()
+    a._thread.join(timeout=5)
+    assert wait_until(lambda: b.is_leader, timeout=10)
+    b.stop()
+
+
+def test_operator_ha_failover_reassigns_nodes():
+    """Two HA operators: only the leader assigns podCIDRs; when it
+    resigns, the standby takes over, adopts persisted assignments
+    (no re-carve under live nodes), and serves new registrations."""
+    store = KVStore()
+    op1 = Operator(store, pool_cidr="10.77.0.0/16",
+                   leader_election=True, instance="op1",
+                   election_ttl=0.5).start()
+    op2 = Operator(store, pool_cidr="10.77.0.0/16",
+                   leader_election=True, instance="op2",
+                   election_ttl=0.5).start()
+    try:
+        assert wait_until(lambda: op1.is_leader or op2.is_leader)
+        leader, standby = (op1, op2) if op1.is_leader else (op2, op1)
+        assert not standby.is_leader
+
+        reg1 = NodeRegistration(store, "node-1")
+        assert reg1.wait_for_cidr(timeout=10)
+        cidr1 = reg1.pod_cidr()
+
+        leader.stop()
+        assert wait_until(lambda: standby.is_leader, timeout=10)
+        # existing assignment survives the failover
+        assert reg1.pod_cidr() == cidr1
+        # and the new leader serves fresh registrations, from the
+        # same pool with no overlap
+        reg2 = NodeRegistration(store, "node-2")
+        assert reg2.wait_for_cidr(timeout=10)
+        assert reg2.pod_cidr() != cidr1
+        reg1.close()
+        reg2.close()
+    finally:
+        op1.stop()
+        op2.stop()
