@@ -79,8 +79,10 @@ def verdict_outputs_padded(engine, flows: Sequence[Flow],
     target = 1 << max(0, n - 1).bit_length()
     if target > n:
         flows = list(flows) + [Flow()] * (target - n)
-    out = engine.verdict_flows(flows, authed_pairs=authed_pairs,
-                               outputs=outputs)
+    # the blob transport (one H2D per batch instead of seven) exists
+    # on the device engine only; the oracle has no transfers to save
+    fn = getattr(engine, "verdict_flows_blob", engine.verdict_flows)
+    out = fn(flows, authed_pairs=authed_pairs, outputs=outputs)
     return {k: np.asarray(v)[:n] for k, v in out.items()}
 
 

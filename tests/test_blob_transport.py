@@ -1,0 +1,75 @@
+"""Single-blob service transport (one H2D per batch): bit-identical
+verdicts to the multi-array path across every scenario family and the
+auth table, through the padded service entry too.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.core.config import Config
+from cilium_tpu.ingest import synth
+from cilium_tpu.runtime.loader import Loader
+
+
+@pytest.mark.parametrize("name", ["http", "fqdn", "kafka", "generic"])
+def test_blob_equals_multiarray(name):
+    scenario = synth.scenario_by_name(name, 40, 256)
+    per_identity, scenario = synth.realize_scenario(scenario)
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    engine = Loader(cfg).regenerate(per_identity, revision=1)
+    flows = scenario.flows[:256]
+    want = engine.verdict_flows(flows)
+    got = engine.verdict_flows_blob(flows)
+    assert set(want) == set(got)
+    for k in want:
+        np.testing.assert_array_equal(
+            np.asarray(got[k]), np.asarray(want[k]), err_msg=k)
+
+
+def test_blob_enforces_auth_and_padded_path():
+    from cilium_tpu.core.flow import Flow, Protocol
+    from cilium_tpu.core.identity import IdentityAllocator
+    from cilium_tpu.core.labels import LabelSet
+    from cilium_tpu.policy.api import (
+        EndpointSelector,
+        IngressRule,
+        PortProtocol,
+        PortRule,
+        Rule,
+    )
+    from cilium_tpu.policy.mapstate import PolicyResolver
+    from cilium_tpu.policy.repository import Repository
+    from cilium_tpu.policy.selectorcache import SelectorCache
+    from cilium_tpu.runtime.service import verdict_flows_padded
+
+    rules = [Rule(
+        endpoint_selector=EndpointSelector.from_labels(app="pay"),
+        ingress=(IngressRule(
+            from_endpoints=(EndpointSelector.from_labels(app="cart"),),
+            auth_mode="required",
+            to_ports=(PortRule(
+                ports=(PortProtocol(8443, Protocol.TCP),)),)),),
+    )]
+    alloc = IdentityAllocator()
+    pay = alloc.allocate(LabelSet.from_dict({"app": "pay"}))
+    cart = alloc.allocate(LabelSet.from_dict({"app": "cart"}))
+    cache = SelectorCache(alloc)
+    repo = Repository()
+    repo.add(rules, sanitize=False)
+    per_identity = {pay: PolicyResolver(repo, cache).resolve(
+        alloc.lookup(pay))}
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    engine = Loader(cfg).regenerate(per_identity, revision=1)
+    flows = [Flow(src_identity=cart, dst_identity=pay, dport=8443)] * 3
+
+    for pairs, want in (
+            (None, 2),                                     # fail closed
+            (np.array([[cart, pay]], dtype=np.int32), 1)):  # authed
+        got = engine.verdict_flows_blob(flows, authed_pairs=pairs)
+        assert [int(v) for v in got["verdict"]] == [want] * 3
+        # padded service entry (non-pow2 batch) rides the blob path
+        got_padded = verdict_flows_padded(engine, flows,
+                                          authed_pairs=pairs)
+        assert got_padded == [want] * 3
