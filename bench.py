@@ -180,8 +180,10 @@ def _bench_from_capture(args, cfg, engine, scenario, arrays, log):
         f"{stage_s * 1e3:.1f}ms; unique rows "
         f"{replay.n_unique}/{len(rows_all)} "
         f"({dedup_ratio:.3f}) → {'id' if use_dedup else 'row'} stream")
-    bs = min(len(rec_all), args.flows if args.flows is not None
-             else _DEFAULT_FLOWS[args.config])
+    bs = min(len(rec_all),
+             getattr(args, "replay_chunk", None)
+             or (args.flows if args.flows is not None
+                 else _DEFAULT_FLOWS[args.config]))
     nch = len(rec_all) // bs
 
     if use_dedup:
@@ -240,6 +242,7 @@ def _bench_from_capture(args, cfg, engine, scenario, arrays, log):
         # device-resident unique table; "row" = full 60B/flow rows)
         "unique_rows": int(replay.n_unique),
         "stream": "id" if use_dedup else "row",
+        "chunk": int(bs),
     }
 
 
@@ -606,6 +609,7 @@ def run_config(config: str, args) -> dict:
             "stage_ms": e2e["stage_ms"],
             "unique_rows": e2e["unique_rows"],
             "stream": e2e["stream"],
+            "chunk": e2e["chunk"],
         }
     return {
         "metric": f"l7_verdicts_per_sec_{config}_{n_rules}rules",
@@ -633,7 +637,8 @@ def _inner_cmd(config: str, args) -> list:
     if getattr(args, "from_capture", None) \
             and config in ("http", "generic"):
         cmd += ["--from-capture", args.from_capture,
-                "--capture-flows", str(args.capture_flows)]
+                "--capture-flows", str(args.capture_flows),
+                "--replay-chunk", str(args.replay_chunk)]
     if args.verbose:
         cmd.append("--verbose")
     if args.profile:
@@ -845,6 +850,12 @@ def main() -> int:
     ap.add_argument("--capture-flows", type=int, default=200000,
                     help="records to write when --from-capture creates "
                          "the file (default 200000)")
+    ap.add_argument("--replay-chunk", type=int, default=65536,
+                    help="e2e capture-replay chunk size (the replay "
+                         "pipeline's own batching — independent of the "
+                         "BASELINE --flows batch shape the device "
+                         "latency lane measures; small chunks pay "
+                         "per-dispatch overhead ~20x at 10k vs 64k)")
     ap.add_argument("--profile", metavar="DIR",
                     help="capture a jax.profiler device trace of the "
                          "timed passes into DIR (open with Perfetto / "
