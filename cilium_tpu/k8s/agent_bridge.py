@@ -44,7 +44,12 @@ def _provenance(obj: Dict) -> Tuple[str, ...]:
     meta = obj.get("metadata", {})
     name = meta.get("name", "unnamed")
     namespace = meta.get("namespace", "default")
-    return (f"k8s:io.cilium.k8s.policy.name={name}",
+    kind = obj.get("kind", "CiliumNetworkPolicy")
+    # kind-discriminating label: without it a CNP default/X and a CCNP
+    # named X share provenance, so deleting one wipes the other's rules
+    # (upstream: io.cilium.k8s.policy.derived-from)
+    return (f"k8s:io.cilium.k8s.policy.derived-from={kind}",
+            f"k8s:io.cilium.k8s.policy.name={name}",
             f"k8s:io.cilium.k8s.policy.namespace={namespace}")
 
 

@@ -191,8 +191,15 @@ class ResourceStore:
         meta = obj.setdefault("metadata", {})
         if not meta.get("name"):
             raise ValueError("metadata.name required")
-        k = _key(meta.get("namespace", "") if namespaced else "",
-                 meta["name"])
+        # same namespace handling as _stamp_new: a namespace-less
+        # object of a namespaced kind lives in "default" (so apply =
+        # create → update resolves to the SAME key on both verbs), and
+        # a cluster-scoped object can never pick up a namespace
+        if namespaced:
+            meta.setdefault("namespace", "default")
+        else:
+            meta.pop("namespace", None)
+        k = _key(meta["namespace"] if namespaced else "", meta["name"])
         with self._dispatch:
             with self._lock:
                 cur = self._objs[plural].get(k)
@@ -208,8 +215,6 @@ class ResourceStore:
                 # carry immutable metadata; bump generation on change
                 for field in ("uid", "generation"):
                     meta[field] = cur["metadata"][field]
-                if namespaced:
-                    meta["namespace"] = k[0]
                 obj.setdefault("apiVersion", "cilium.io/v2")
                 obj.setdefault("kind", kind)
                 if any(obj.get(f) != cur.get(f)

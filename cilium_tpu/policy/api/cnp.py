@@ -34,10 +34,16 @@ class CiliumNetworkPolicy:
     name: str
     namespace: str
     rules: Tuple[Rule, ...]
+    #: source CRD kind — CNP vs CCNP must not share provenance labels,
+    #: or an upsert of ``default/X`` (CNP) silently deletes clusterwide
+    #: policy ``X`` (reference disambiguates with
+    #: ``io.cilium.k8s.policy.derived-from``)
+    kind: str = "CiliumNetworkPolicy"
 
     @property
     def labels(self) -> Tuple[str, ...]:
-        return (f"k8s:io.cilium.k8s.policy.name={self.name}",
+        return (f"k8s:io.cilium.k8s.policy.derived-from={self.kind}",
+                f"k8s:io.cilium.k8s.policy.name={self.name}",
                 f"k8s:io.cilium.k8s.policy.namespace={self.namespace}")
 
 
@@ -202,7 +208,8 @@ def parse_cnp(doc: Dict) -> CiliumNetworkPolicy:
     meta = doc.get("metadata") or {}
     name = meta.get("name", "unnamed")
     namespace = meta.get("namespace", "default")
-    labels = (f"k8s:io.cilium.k8s.policy.name={name}",
+    labels = (f"k8s:io.cilium.k8s.policy.derived-from={kind}",
+              f"k8s:io.cilium.k8s.policy.name={name}",
               f"k8s:io.cilium.k8s.policy.namespace={namespace}")
     specs: List[Dict] = []
     if doc.get("spec"):
@@ -211,7 +218,8 @@ def parse_cnp(doc: Dict) -> CiliumNetworkPolicy:
     clusterwide = kind == "CiliumClusterwideNetworkPolicy"
     rules = tuple(_spec_to_rule(s, labels, clusterwide=clusterwide)
                   for s in specs)
-    return CiliumNetworkPolicy(name=name, namespace=namespace, rules=rules)
+    return CiliumNetworkPolicy(name=name, namespace=namespace, rules=rules,
+                               kind=kind)
 
 
 def load_cnp_yaml(path: str) -> List[CiliumNetworkPolicy]:

@@ -44,6 +44,8 @@ class LeaderElector:
         self.is_leader = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        #: the lease backing the currently-held lock; stop() revokes it
+        self._lease = None
 
     # -- campaign loop ----------------------------------------------------
     def start(self) -> "LeaderElector":
@@ -79,9 +81,25 @@ class LeaderElector:
                 if self._stop.wait(interval):
                     return
                 continue
-            self._lead(lease, interval)
+            self._lease = lease
+            try:
+                self._lead(lease, interval)
+            finally:
+                self._lease = None
             if self._stop.is_set():
+                # resign path: drop our key via lease revocation — the
+                # key is attached to OUR lease, so this can never
+                # delete a lock a standby re-acquired in the meantime
+                # (the unconditional get-then-delete could)
+                try:
+                    self.store.revoke(lease)
+                except Exception:  # noqa: BLE001 — lease ages out
+                    pass
                 return
+            try:  # leadership lost mid-stint: release our leftovers
+                self.store.revoke(lease)
+            except Exception:  # noqa: BLE001
+                pass
 
     def _lead(self, lease, interval: float) -> None:
         """One leadership stint: callbacks, keepalive, demotion."""
@@ -154,8 +172,3 @@ class LeaderElector:
                             extra={"fields": {"key": self.key}})
                 return
             self._thread = None
-        try:
-            if self.store.get(self.key) == self.identity:
-                self.store.delete(self.key)
-        except Exception:  # noqa: BLE001 — store gone: lease ages out
-            pass

@@ -1,0 +1,167 @@
+"""Round-4 advisor findings, pinned as regressions (ADVICE.md r4).
+
+1. apiserver: update() must default the namespace for namespaced kinds
+   the same way create() does, or `apply` of a namespace-less CNP
+   succeeds once and 404s on every re-apply.
+2. CNP vs CCNP provenance must be disjoint (derived-from label), or a
+   clusterwide policy named X and a namespaced default/X delete each
+   other's rules on upsert (fail-open for deny rules).
+3. LeaderElector.stop() must release via lease revocation, never an
+   unconditional key delete that can remove a standby's fresh lock.
+"""
+
+import time
+
+from cilium_tpu.agent import Agent
+from cilium_tpu.core.config import Config
+from cilium_tpu.k8s.agent_bridge import _provenance
+from cilium_tpu.k8s.apiserver import APIServer, K8sClient, ResourceStore
+from cilium_tpu.kvstore import KVStore
+from cilium_tpu.policy.api.cnp import parse_cnp
+from cilium_tpu.runtime.leader import LEADER_PREFIX, LeaderElector
+
+
+def _cnp_doc(name, kind="CiliumNetworkPolicy", namespace=None, app="web"):
+    doc = {
+        "apiVersion": "cilium.io/v2",
+        "kind": kind,
+        "metadata": {"name": name},
+        "spec": {
+            "endpointSelector": {"matchLabels": {"app": "db"}},
+            "ingress": [{
+                "fromEndpoints": [{"matchLabels": {"app": app}}],
+                "toPorts": [{"ports": [
+                    {"port": "80", "protocol": "TCP"}]}],
+            }],
+        },
+    }
+    if namespace is not None:
+        doc["metadata"]["namespace"] = namespace
+    return doc
+
+
+# -- 1: re-apply without metadata.namespace --------------------------------
+
+def test_store_update_defaults_namespace():
+    s = ResourceStore()
+    s.create("ciliumnetworkpolicies", _cnp_doc("a"))
+    # update with the same namespace-less shape must hit default/a,
+    # not ""/a (which raised NotFound before the fix)
+    doc = _cnp_doc("a", app="api")
+    out = s.update("ciliumnetworkpolicies", doc)
+    assert out["metadata"]["namespace"] == "default"
+    got = s.get("ciliumnetworkpolicies", "default", "a")
+    assert got["spec"]["ingress"][0]["fromEndpoints"][0][
+        "matchLabels"]["app"] == "api"
+
+
+def test_update_strips_namespace_from_clusterwide_kinds():
+    # the mirror case: update() of a cluster-scoped object carrying a
+    # bogus metadata.namespace must strip it (as create does), or the
+    # stored CCNP's provenance labels shift under the agent bridge
+    s = ResourceStore()
+    s.create("ciliumclusterwidenetworkpolicies",
+             _cnp_doc("cw", kind="CiliumClusterwideNetworkPolicy"))
+    doc = _cnp_doc("cw", kind="CiliumClusterwideNetworkPolicy",
+                   namespace="kube-system", app="api")
+    out = s.update("ciliumclusterwidenetworkpolicies", doc)
+    assert "namespace" not in out["metadata"]
+    got = s.get("ciliumclusterwidenetworkpolicies", "", "cw")
+    assert got["spec"]["ingress"][0]["fromEndpoints"][0][
+        "matchLabels"]["app"] == "api"
+
+
+def test_client_reapply_namespaceless_cnp(tmp_path):
+    server = APIServer(str(tmp_path / "k8s.sock")).start()
+    try:
+        c = K8sClient(server.socket_path)
+        first = c.apply("ciliumnetworkpolicies", _cnp_doc("np"))
+        second = c.apply("ciliumnetworkpolicies",
+                         _cnp_doc("np", app="api"))
+        assert first["metadata"]["namespace"] == "default"
+        assert second["metadata"]["namespace"] == "default"
+        assert int(second["metadata"]["generation"]) == 2
+    finally:
+        server.stop()
+
+
+# -- 2: CNP/CCNP provenance disambiguation ---------------------------------
+
+def test_cnp_ccnp_labels_disjoint():
+    cnp = parse_cnp(_cnp_doc("x"))
+    ccnp = parse_cnp(_cnp_doc(
+        "x", kind="CiliumClusterwideNetworkPolicy"))
+    assert set(cnp.labels) != set(ccnp.labels)
+    assert any("derived-from=CiliumNetworkPolicy" in l
+               for l in cnp.labels)
+    assert any("derived-from=CiliumClusterwideNetworkPolicy" in l
+               for l in ccnp.labels)
+    # _provenance (the delete path) must match parse_cnp (the add path)
+    assert set(_provenance(_cnp_doc("x"))) == set(cnp.labels)
+    assert set(_provenance(_cnp_doc(
+        "x", kind="CiliumClusterwideNetworkPolicy"))) == set(ccnp.labels)
+
+
+def test_deleting_ccnp_keeps_same_named_cnp_rules():
+    cfg = Config()
+    cfg.configure_logging = False
+    agent = Agent(config=cfg, kvstore=KVStore()).start()
+    try:
+        agent.policy_add(parse_cnp(_cnp_doc("x")), wait=False)
+        agent.policy_add(parse_cnp(_cnp_doc(
+            "x", kind="CiliumClusterwideNetworkPolicy", app="api")),
+            wait=False)
+        assert len(agent.repo.rules()) == 2
+        n = agent.policy_delete(list(_provenance(_cnp_doc(
+            "x", kind="CiliumClusterwideNetworkPolicy"))), wait=False)
+        assert n == 1  # ONLY the clusterwide policy's rule
+        remaining = agent.repo.rules()
+        assert len(remaining) == 1
+        assert any("derived-from=CiliumNetworkPolicy" in l
+                   for l in remaining[0].labels)
+    finally:
+        agent.stop()
+
+
+# -- 3: leader resign must not delete a standby's lock ---------------------
+
+class _NoDeleteStore(KVStore):
+    """KVStore that records delete() calls on the leader key — the old
+    stop() path used get-then-delete, which can race a standby's
+    acquisition; the fixed path revokes our own lease instead."""
+
+    def __init__(self):
+        super().__init__()
+        self.leader_key_deletes = 0
+
+    def delete(self, key):
+        if key.startswith(LEADER_PREFIX):
+            self.leader_key_deletes += 1
+        return super().delete(key)
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_leader_stop_releases_via_lease_revocation():
+    store = _NoDeleteStore()
+    a = LeaderElector(store, "op", "a", lambda: None, lambda: None,
+                      ttl=0.5).start()
+    assert _wait(lambda: a.is_leader)
+    b = LeaderElector(store, "op", "b", lambda: None, lambda: None,
+                      ttl=0.5).start()
+    # clean resign: b takes over promptly (revocation freed the key)
+    a.stop()
+    assert _wait(lambda: store.get(LEADER_PREFIX + "op") == "b")
+    # the standby's fresh lock survives a's teardown, and a never
+    # issued a raw delete on the leader key (the racy primitive)
+    time.sleep(0.2)
+    assert store.get(LEADER_PREFIX + "op") == "b"
+    assert store.leader_key_deletes == 0
+    b.stop()
