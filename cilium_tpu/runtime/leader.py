@@ -44,8 +44,6 @@ class LeaderElector:
         self.is_leader = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        #: the lease backing the currently-held lock; stop() revokes it
-        self._lease = None
 
     # -- campaign loop ----------------------------------------------------
     def start(self) -> "LeaderElector":
@@ -81,11 +79,7 @@ class LeaderElector:
                 if self._stop.wait(interval):
                     return
                 continue
-            self._lease = lease
-            try:
-                self._lead(lease, interval)
-            finally:
-                self._lease = None
+            self._lead(lease, interval)
             if self._stop.is_set():
                 # resign path: drop our key via lease revocation — the
                 # key is attached to OUR lease, so this can never

@@ -353,6 +353,26 @@ class VerdictService:
         flow.policy_match_type = PolicyMatchType.L7
         self.agent.observer.observe([flow])
 
+    # -- stream mode ------------------------------------------------------
+    def handle_stream(self, sock: socket.socket, req: Dict) -> None:
+        """``stream_start``: ack, then hand the connection to a
+        :class:`cilium_tpu.runtime.stream.StreamSession` until
+        end-of-stream. The chunked binary path shares the engine (and
+        its auth staging) with every other verdict path — only the
+        transport differs."""
+        from cilium_tpu.runtime.stream import StreamSession
+
+        if self.loader.engine is None:
+            send_msg(sock, {"error": "no policy loaded"})
+            return
+        send_msg(sock, {"ok": True, "revision": self.loader.revision})
+        StreamSession(
+            self.loader, sock,
+            widths=req.get("widths") or None,
+            authed_pairs_fn=self.bridge.authed_pairs_fn,
+            pipeline_depth=int(req.get("pipeline_depth") or 8),
+        ).run()
+
     # -- request handling -------------------------------------------------
     def handle(self, req: Dict) -> Dict:
         try:
@@ -370,6 +390,19 @@ class VerdictService:
             return {"engine_revision": self.loader.revision}
         if op == "metrics":
             return {"text": METRICS.expose()}
+        if op == "mapstate_pull":
+            # NPDS role (reference pkg/envoy xDS): the compiled L3/L4
+            # MapState serialized for the shim's LOCAL fast path —
+            # L4-only flows then verdict in-proxy with zero service
+            # round-trips (runtime/npds.py documents blob + semantics)
+            from cilium_tpu.runtime.npds import serialize_mapstates
+
+            blob = serialize_mapstates(
+                self.loader.per_identity, self.loader.revision,
+                audit_global=self.loader.config.policy_audit_mode)
+            METRICS.inc("cilium_tpu_npds_pulls_total")
+            return {"revision": self.loader.revision,
+                    "npds_b64": base64.b64encode(blob).decode()}
         if op == "policy_get":
             if self.agent is None:
                 return {"error": "no agent attached"}
@@ -418,7 +451,9 @@ class VerdictService:
                 return {"error": str(e)}
             with self._conn_lock:
                 self._connections[conn.connection_id] = conn
-            return {"ok": True}
+            # the revision stamp is the shim's NPDS invalidation
+            # signal (shim/cilium_shim.cpp re-pulls on mismatch)
+            return {"ok": True, "revision": self.loader.revision}
         if op == "on_data":
             with self._conn_lock:
                 conn = self._connections.get(int(req["conn"]))
@@ -486,6 +521,13 @@ class VerdictService:
                             # unreliable), but never traceback
                             send_msg(self.request,
                                      {"error": "malformed request"})
+                            return
+                        if req.get("op") == "stream_start":
+                            # switch this connection to the chunked
+                            # binary verdict stream (runtime/stream.py)
+                            # until end-of-stream; the connection is
+                            # single-use in stream mode
+                            service.handle_stream(self.request, req)
                             return
                         send_msg(self.request, service.handle(req))
                 except (ConnectionError, struct.error, OSError):

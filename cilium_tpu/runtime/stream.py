@@ -1,0 +1,384 @@
+"""Streaming binary verdict transport: the serving-path counterpart of
+the offline capture replay.
+
+Reference role: the per-request JSON protocol in ``runtime/service.py``
+models the agent↔proxy control channel, but the reference's DATA paths
+all stream — Envoy verdicts in-filter with no agent round-trip, access
+logs ride a one-way socket (SURVEY §2.2, §2.7). On a tunneled TPU the
+request/response shape is fatal for throughput: every verdict batch
+pays a full H2D+readback RTT (~120 ms observed, docs/PLATFORM.md), so
+the in-flight window equals the connection count and the online path
+saturated at ~438 rps in round 4 while the offline path did 207M/s.
+
+This module closes that gap with a CHUNKED BINARY STREAM on the same
+Unix socket:
+
+* the client sends length-prefixed frames whose payload is a
+  self-contained v2/v3 capture image (``ingest.binary
+  .sections_to_bytes``) — no JSON, no base64, no per-record parsing;
+* the server runs a decoupled three-stage pipeline: a reader thread
+  (socket → frame queue), a worker thread (parse → featurize →
+  single-blob H2D dispatch), and a writer thread (device readback →
+  verdict frame). JAX dispatch is asynchronous, so while chunk k's
+  readback is in flight over the tunnel, chunks k+1..k+D are already
+  staged/executing on device — the RTT is amortized over the pipeline
+  depth instead of paid per chunk;
+* verdicts return as raw u8 arrays keyed by the client's sequence
+  number, on the same socket, decoupled from sends (the client can
+  have many chunks outstanding).
+
+Chunk shapes are padded to power-of-two record counts and the string
+widths are fixed for the whole session (handshake), so the engine sees
+a handful of compiled shapes no matter what traffic streams.
+
+Protocol (after a ``{"op": "stream_start", ...}`` JSON handshake on
+the verdict socket; see ``VerdictService``):
+
+  frame   := <u32 payload_len> <u32 seq> <u8 kind> payload
+  c→s     := kind 0: capture image | kind 1: end-of-stream (empty)
+  s→c     := kind 0: u8 verdict array (one byte per record, in the
+             chunk's record order)
+           | kind 1: end-ack (all pending verdicts flushed)
+           | kind 2: per-chunk error (utf-8 message; stream continues)
+
+A poisoned frame (bad magic, truncated image) fails ONLY its sequence
+number — the serving path must degrade per-chunk, not per-connection.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cilium_tpu.ingest.binary import (
+    CaptureError,
+    capture_from_bytes,
+    capture_to_bytes,
+)
+from cilium_tpu.runtime.metrics import METRICS
+
+FRAME_HEADER = struct.Struct("<IIB")
+
+KIND_CHUNK = 0
+KIND_END = 1
+KIND_ERROR = 2
+
+#: hard cap on one frame's payload — a corrupt length prefix must not
+#: make the server try to buffer gigabytes
+MAX_FRAME = 256 << 20
+
+#: default bound on dispatched-but-unread device computations: deep
+#: enough to hide several tunnel RTTs, shallow enough that per-chunk
+#: latency stays ~(depth/throughput) under saturation
+PIPELINE_DEPTH = 16
+
+#: the largest record count one chunk may carry (pow2-padded shapes
+#: above this would blow compile-shape variety and device memory)
+CHUNK_MAX = 1 << 17
+
+
+def send_frame(sock: socket.socket, seq: int, kind: int,
+               payload: bytes = b"") -> None:
+    sock.sendall(FRAME_HEADER.pack(len(payload), seq, kind) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    parts: List[bytes] = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(n - got)
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        parts.append(chunk)
+        got += len(chunk)
+    return b"".join(parts)
+
+
+def recv_frame(sock: socket.socket) -> Tuple[int, int, bytes]:
+    n, seq, kind = FRAME_HEADER.unpack(
+        _recv_exact(sock, FRAME_HEADER.size))
+    if n > MAX_FRAME:
+        raise ConnectionError(f"frame too large ({n} bytes)")
+    return seq, kind, _recv_exact(sock, n) if n else b""
+
+
+class StreamSession:
+    """Server side of one stream connection (runs on the service's
+    handler thread until end-of-stream or disconnect)."""
+
+    def __init__(self, loader, sock: socket.socket,
+                 widths: Optional[Dict[str, int]] = None,
+                 authed_pairs_fn=None,
+                 pipeline_depth: int = PIPELINE_DEPTH):
+        from cilium_tpu.core.config import EngineConfig
+
+        self.loader = loader
+        self.sock = sock
+        self.authed_pairs_fn = authed_pairs_fn
+        cfg = EngineConfig()
+        # session-fixed string widths: the client promises its strings
+        # fit (longer ones clip exactly like the engine's config caps);
+        # fixed widths mean one compiled step per pow2 record bucket
+        caps = {"path": max(cfg.http_path_buckets),
+                "method": cfg.http_method_len,
+                "host": cfg.http_host_len,
+                "headers": 1024, "qname": cfg.dns_name_len}
+        self.widths = dict(caps)
+        for k, v in (widths or {}).items():
+            if k in caps:
+                self.widths[k] = max(1, min(int(v), caps[k]))
+        self._in: "queue.Queue" = queue.Queue(maxsize=32)
+        self._out: "queue.Queue" = queue.Queue(
+            maxsize=max(1, int(pipeline_depth)))
+        self._send_lock = threading.Lock()
+        #: incremental dedup session, rebuilt on engine swap (policy
+        #: revision bump) — see engine/session.py
+        self._inc = None
+        self._inc_engine = None
+
+    # -- pipeline stages ---------------------------------------------------
+    def run(self) -> None:
+        worker = threading.Thread(target=self._work, daemon=True,
+                                  name="stream-worker")
+        writer = threading.Thread(target=self._write, daemon=True,
+                                  name="stream-writer")
+        worker.start()
+        writer.start()
+        try:
+            while True:
+                try:
+                    seq, kind, payload = recv_frame(self.sock)
+                except (ConnectionError, OSError):
+                    break
+                self._in.put((seq, kind, payload))
+                if kind == KIND_END:
+                    break
+        finally:
+            self._in.put(None)
+            worker.join()
+            writer.join()
+
+    def _dispatch_chunk(self, payload: bytes):
+        """Parse + incremental-dedup featurize + async device dispatch.
+        Returns (n_records, device verdict array) — readback happens on
+        the writer thread so the tunnel RTT overlaps the next chunks'
+        host work and device execution.
+
+        The transport math that dictates the design (measured,
+        docs/PLATFORM.md round 5): the tunneled TPU moves ~10–30 MB/s
+        H2D and a synchronous readback is a ~120 ms RTT. Streaming the
+        raw featurized blob (244 B/flow) capped the stream at ~60k
+        verdicts/s; the incremental dedup session
+        (engine/session.py) ships 4 B/flow steady-state, and the
+        ``copy_to_host_async`` below keeps several readbacks in
+        flight (130 ms/chunk serialized → ~25 ms/chunk measured with
+        5 in flight)."""
+        rec, l7, offsets, blob, gen = capture_from_bytes(payload)
+        n = len(rec)
+        if n == 0:
+            return 0, None
+        if n > CHUNK_MAX:
+            raise CaptureError(
+                f"chunk of {n} records exceeds max {CHUNK_MAX}")
+        engine = self.loader.engine
+        if engine is None:
+            raise RuntimeError("no policy loaded")
+        pairs = (self.authed_pairs_fn()
+                 if self.authed_pairs_fn is not None else None)
+        if not hasattr(engine, "_blob_step"):
+            # oracle backend (enable_tpu_offload off): no device, no
+            # pipelining to win — reconstruct and verdict host-side so
+            # stream clients work identically under either gate
+            from cilium_tpu.ingest.binary import records_to_flows_l7
+
+            flows = records_to_flows_l7(rec, l7, offsets, blob, gen=gen)
+            out = engine.verdict_flows(flows, authed_pairs=pairs)
+            return n, np.asarray(out["verdict"])
+        if self._inc is None or self._inc_engine is not engine:
+            # first chunk, or the loader hot-swapped a new revision:
+            # session tables were scanned against the OLD engine's
+            # DFA banks — rebuild (the NPDS-invalidation analog)
+            from cilium_tpu.engine.session import IncrementalSession
+
+            self._inc = IncrementalSession(engine, widths=self.widths)
+            self._inc_engine = engine
+        n, verdict = self._inc.verdict_chunk(
+            rec, l7, offsets, blob, gen=gen, authed_pairs=pairs)
+        # issue the D2H NOW, not at the writer's np.asarray: readbacks
+        # only overlap if ISSUED while earlier ones are in flight
+        if hasattr(verdict, "copy_to_host_async"):
+            verdict.copy_to_host_async()
+        return n, verdict
+
+    def _work(self) -> None:
+        while True:
+            item = self._in.get()
+            if item is None:
+                self._out.put(None)
+                return
+            seq, kind, payload = item
+            if kind == KIND_END:
+                self._out.put((seq, KIND_END, 0, None))
+                self._out.put(None)
+                return
+            if kind != KIND_CHUNK:
+                self._out.put((seq, KIND_ERROR, 0,
+                               f"unknown frame kind {kind}"))
+                continue
+            try:
+                n, dev = self._dispatch_chunk(payload)
+            except Exception as e:  # noqa: BLE001 — fail the SEQ only
+                self._out.put((seq, KIND_ERROR, 0,
+                               f"{type(e).__name__}: {e}"))
+                continue
+            self._out.put((seq, KIND_CHUNK, n, dev))
+
+    def _write(self) -> None:
+        while True:
+            item = self._out.get()
+            if item is None:
+                return
+            seq, kind, n, dev = item
+            try:
+                if kind == KIND_END:
+                    send_frame(self.sock, seq, KIND_END)
+                    continue
+                if kind == KIND_ERROR:
+                    send_frame(self.sock, seq, KIND_ERROR,
+                               str(dev).encode())
+                    continue
+                if n == 0:
+                    send_frame(self.sock, seq, KIND_CHUNK)
+                    continue
+                verdicts = np.asarray(dev)[:n].astype(np.uint8)
+                METRICS.inc("cilium_tpu_stream_verdicts_total", n)
+                send_frame(self.sock, seq, KIND_CHUNK,
+                           verdicts.tobytes())
+            except (OSError, BrokenPipeError):
+                # client went away: drain silently so the worker can
+                # finish and the session unwinds
+                continue
+
+
+class StreamClient:
+    """Client for the stream protocol (what a proxy data plane would
+    speak in C; Python here for tests/bench).
+
+    ``send_flows``/``send_image`` are non-blocking up to the socket
+    buffer; verdicts arrive on a background thread and are retrieved
+    with ``result(seq)`` (blocking) or ``results()`` (drain in
+    completion order). ``finish()`` sends end-of-stream and blocks for
+    the end-ack, guaranteeing every outstanding verdict has landed."""
+
+    def __init__(self, socket_path: str, widths: Optional[Dict] = None,
+                 timeout: float = 120.0,
+                 pipeline_depth: Optional[int] = None):
+        from cilium_tpu.runtime.service import recv_msg, send_msg
+
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.connect(socket_path)
+        self.timeout = timeout
+        hello = {"op": "stream_start", "widths": widths or {}}
+        if pipeline_depth:
+            hello["pipeline_depth"] = int(pipeline_depth)
+        send_msg(self.sock, hello)
+        ack = recv_msg(self.sock)
+        if not ack.get("ok"):
+            raise RuntimeError(f"stream_start refused: {ack}")
+        self.revision = ack.get("revision")
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._results: Dict[int, object] = {}
+        self._done = False
+        self._recv_thread = threading.Thread(target=self._recv_loop,
+                                             daemon=True)
+        self._recv_thread.start()
+
+    def _recv_loop(self) -> None:
+        try:
+            while True:
+                seq, kind, payload = recv_frame(self.sock)
+                with self._cond:
+                    if kind == KIND_END:
+                        self._done = True
+                    elif kind == KIND_ERROR:
+                        self._results[seq] = RuntimeError(
+                            payload.decode("utf-8", "replace"))
+                    else:
+                        self._results[seq] = np.frombuffer(
+                            payload, dtype=np.uint8)
+                    self._cond.notify_all()
+                    if kind == KIND_END:
+                        return
+        except (ConnectionError, OSError):
+            with self._cond:
+                self._done = True
+                self._cond.notify_all()
+
+    def send_image(self, image: bytes) -> int:
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+        send_frame(self.sock, seq, KIND_CHUNK, image)
+        return seq
+
+    def send_flows(self, flows: Sequence) -> int:
+        return self.send_image(capture_to_bytes(flows))
+
+    def result(self, seq: int) -> np.ndarray:
+        """Block for one chunk's verdicts (raises if the server failed
+        that chunk)."""
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: seq in self._results or self._done,
+                timeout=self.timeout)
+            if seq not in self._results:
+                raise TimeoutError(
+                    f"no verdict for seq {seq}"
+                    + (" (stream closed)" if self._done else ""))
+            assert ok
+            r = self._results.pop(seq)
+        if isinstance(r, Exception):
+            raise r
+        return r
+
+    def results(self) -> Iterator[Tuple[int, object]]:
+        """Drain results as they land, until the stream ends and all
+        are consumed. Yields ``(seq, ndarray)`` for verdicts and
+        ``(seq, Exception)`` for per-chunk failures — the protocol
+        degrades per CHUNK, so a failed seq must not terminate the
+        drain (raising from a generator closes it for good)."""
+        while True:
+            with self._cond:
+                self._cond.wait_for(
+                    lambda: self._results or self._done,
+                    timeout=self.timeout)
+                if not self._results:
+                    if self._done:
+                        return
+                    raise TimeoutError("stream stalled")
+                seq = next(iter(self._results))
+                r = self._results.pop(seq)
+            yield seq, r
+
+    def finish(self) -> None:
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+        send_frame(self.sock, seq, KIND_END)
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._done,
+                                       timeout=self.timeout):
+                raise TimeoutError("no end-ack")
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
