@@ -101,6 +101,10 @@ class Agent:
         #: happens at every regeneration so provider refreshes land via
         #: regenerate_all()
         self.group_providers = {}
+        #: CiliumCIDRGroup registry (v2alpha1): name → member CIDRs;
+        #: fed by the k8s bridge's ciliumcidrgroups informer (or
+        #: set_cidr_group directly); resolved at every regeneration
+        self.cidr_groups: Dict[str, Tuple[str, ...]] = {}
         # proxy-port allocation + redirect lifecycle (pkg/proxy role):
         # reconciled against every resolved snapshot at regeneration
         from cilium_tpu.proxy_manager import ProxyManager
@@ -113,6 +117,7 @@ class Agent:
             backend_identity=lambda ip: self.ipcache.lookup(ip),
             cluster_name=self.config.cluster_name,
             group_cidrs=self._resolve_group,
+            cidr_group_cidrs=lambda name: self.cidr_groups.get(name, ()),
             proxy_manager=self.proxy_manager)
         # backend-set changes alter toServices resolution → regenerate,
         # but only when some rule actually uses toServices: routine

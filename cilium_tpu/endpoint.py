@@ -112,7 +112,7 @@ class EndpointManager:
                  regen_workers: int = 4,
                  services=None, backend_identity=None,
                  cluster_name: str = "default", group_cidrs=None,
-                 proxy_manager=None):
+                 cidr_group_cidrs=None, proxy_manager=None):
         self.repo = repo
         self.cache = selector_cache
         self.allocator = allocator
@@ -125,6 +125,7 @@ class EndpointManager:
         self.backend_identity = backend_identity
         self.cluster_name = cluster_name
         self.group_cidrs = group_cidrs
+        self.cidr_group_cidrs = cidr_group_cidrs
         #: optional ProxyManager: redirect lifecycle reconciles against
         #: every resolved snapshot (pkg/proxy during regeneration)
         self.proxy_manager = proxy_manager
@@ -240,6 +241,7 @@ class EndpointManager:
                     cluster_name=self.cluster_name,
                     named_ports_of=lambda nid: np_of.get(nid, {}))
                 resolver.group_cidrs = self.group_cidrs
+                resolver.cidr_group_cidrs = self.cidr_group_cidrs
                 per_identity = {}
                 resolved = {}
                 for ep in eps:

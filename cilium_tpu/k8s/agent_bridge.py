@@ -35,6 +35,7 @@ CNP_PLURAL = "ciliumnetworkpolicies"
 CCNP_PLURAL = "ciliumclusterwidenetworkpolicies"
 CEP_PLURAL = "ciliumendpoints"
 NODE_PLURAL = "ciliumnodes"
+CIDRGROUP_PLURAL = "ciliumcidrgroups"
 
 
 def _provenance(obj: Dict) -> Tuple[str, ...]:
@@ -85,6 +86,28 @@ class K8sWatcherBridge:
         self.agent.policy_delete(list(_provenance(obj)), wait=False)
         LOG.info("deleted CNP", extra={"fields": {
             "name": obj.get("metadata", {}).get("name")}})
+
+    # -- CIDR groups -------------------------------------------------------
+    def _cidr_group_upsert(self, obj: Dict) -> None:
+        """CiliumCIDRGroup (v2alpha1): update the agent's group
+        registry and regenerate — referencing policies re-expand the
+        group on the next resolve (the reference re-translates
+        referencing CNPs on group events; our resolve-time expansion
+        needs only the regeneration)."""
+        name = obj.get("metadata", {}).get("name", "")
+        cidrs = tuple(str(c) for c in
+                      (obj.get("spec", {}).get("externalCIDRs") or ()))
+        with self.agent.write_lock:
+            self.agent.cidr_groups[name] = cidrs
+        self.agent.endpoint_manager.regenerate_all(wait=False)
+        LOG.info("applied CiliumCIDRGroup", extra={"fields": {
+            "name": name, "cidrs": len(cidrs)}})
+
+    def _cidr_group_remove(self, obj: Dict) -> None:
+        name = obj.get("metadata", {}).get("name", "")
+        with self.agent.write_lock:
+            self.agent.cidr_groups.pop(name, None)
+        self.agent.endpoint_manager.regenerate_all(wait=False)
 
     # -- status publication ----------------------------------------------
     def _cep_name(self, endpoint_id: int) -> str:
@@ -179,6 +202,13 @@ class K8sWatcherBridge:
         # policy informers: the initial list applies synchronously, so
         # an agent is enforcing its CNPs before start() returns (the
         # reference blocks on WaitForCacheSync before going Ready)
+        # CIDR groups FIRST: a CNP referencing a group must find it
+        # registered when the policy informer's initial list applies
+        self._informers.append(Informer(
+            self.client, CIDRGROUP_PLURAL,
+            on_add=self._cidr_group_upsert,
+            on_update=lambda old, new: self._cidr_group_upsert(new),
+            on_delete=self._cidr_group_remove).start())
         for plural in (CNP_PLURAL, CCNP_PLURAL):
             self._informers.append(Informer(
                 self.client, plural,

@@ -50,6 +50,12 @@ RESOURCES: Dict[str, Tuple[str, bool]] = {
     "ciliumendpoints": ("CiliumEndpoint", True),
     "ciliumidentities": ("CiliumIdentity", False),
     "ciliumnodes": ("CiliumNode", False),
+    # v2alpha1 additions (newer reference trees):
+    # CiliumCIDRGroup — named CIDR sets policies reference via
+    # cidrGroupRef; CiliumEndpointSlice — operator-batched CEPs so
+    # watchers scale with slices, not endpoints
+    "ciliumcidrgroups": ("CiliumCIDRGroup", False),
+    "ciliumendpointslices": ("CiliumEndpointSlice", False),
 }
 
 #: watch-history ring size: how many events back a lagging watcher can

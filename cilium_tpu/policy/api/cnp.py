@@ -94,6 +94,20 @@ def _parse_cidr_set(raw) -> Tuple[CIDRRule, ...]:
     for c in (raw or ()):
         if isinstance(c, str):
             out.append(CIDRRule(cidr=c))
+        elif isinstance(c, dict) and c.get("cidrGroupRef"):
+            # v2alpha1 CiliumCIDRGroup reference: expanded to the
+            # group's CIDRs at resolve time (group edits re-target the
+            # policy on the next regeneration)
+            if c.get("cidr"):
+                # reference rule_validation: the members are mutually
+                # exclusive — dropping one silently would leave a rule
+                # meaning something its manifest doesn't say
+                raise SanitizeError(
+                    "cidrGroupRef and cidr are mutually exclusive")
+            out.append(CIDRRule(
+                group_ref=str(c["cidrGroupRef"]),
+                except_cidrs=tuple(c.get("except") or ()),
+            ))
         elif isinstance(c, dict) and c.get("cidr"):
             out.append(CIDRRule(
                 cidr=c["cidr"],

@@ -194,10 +194,18 @@ class CIDRRule:
     Excepted sub-CIDRs are SUBTRACTED from the rule's peer set at
     resolve time — they produce no allow entries, so excepted traffic
     falls through to default-deny (matching the reference, where
-    excepts become requirements excluding the sub-CIDR identities)."""
+    excepts become requirements excluding the sub-CIDR identities).
 
-    cidr: str
+    ``group_ref`` (reference: ``cidrGroupRef``, v2alpha1
+    CiliumCIDRGroup): instead of a literal prefix, name a cluster
+    CIDR-group object; the resolver expands it to the group's CIDRs at
+    resolve time (each inheriting this rule's excepts), so group edits
+    re-target referencing policies on the next regeneration without
+    touching the policies themselves."""
+
+    cidr: str = ""
     except_cidrs: Tuple[str, ...] = ()
+    group_ref: str = ""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -358,6 +366,20 @@ class Rule:
                     except ValueError:
                         raise SanitizeError(f"bad CIDR {c!r}")
                 for cr in cidr_set:
+                    if cr.group_ref:
+                        if cr.cidr:
+                            # reference rule_validation: cidrGroupRef
+                            # and cidr are mutually exclusive members
+                            raise SanitizeError(
+                                "cidrGroupRef and cidr are exclusive")
+                        net = None
+                        for ex in cr.except_cidrs:
+                            try:
+                                ipaddress.ip_network(ex, strict=False)
+                            except ValueError:
+                                raise SanitizeError(
+                                    f"bad except CIDR {ex!r}")
+                        continue
                     try:
                         net = ipaddress.ip_network(cr.cidr, strict=False)
                     except ValueError:

@@ -284,6 +284,12 @@ class PolicyResolver:
         #: → groups resolve to nothing. Queried at every resolve, so
         #: refreshed provider data lands on the next regeneration.
         self.group_cidrs = None
+        #: ``cidr_group_cidrs(name) -> Iterable[str]`` — resolves a
+        #: CIDRRule.group_ref (CiliumCIDRGroup, v2alpha1) to its
+        #: member CIDRs; None / unknown name → the ref selects NOTHING
+        #: (a dangling group must not widen the rule). Queried at
+        #: every resolve, like group_cidrs.
+        self.cidr_group_cidrs = None
         #: optional ServiceManager: `toServices` resolves against its
         #: k8s metadata (reference: pkg/k8s service cache feeding
         #: resolveEgressPolicy); None → toServices selects nothing
@@ -381,7 +387,17 @@ class PolicyResolver:
             # an excepted sub-CIDR (it carries the except prefix among
             # its ancestor cidr: labels) gets no allow entry from this
             # rule and falls through to default-deny
-            ids = set(self._cidr_identities(cr.cidr))
+            if cr.group_ref:
+                # cidrGroupRef: each member CIDR inherits the rule's
+                # excepts; unknown group/provider → selects nothing
+                members = (tuple(self.cidr_group_cidrs(cr.group_ref)
+                                 or ())
+                           if self.cidr_group_cidrs is not None else ())
+            else:
+                members = (cr.cidr,)
+            ids = set()
+            for member in members:
+                ids |= set(self._cidr_identities(member))
             for ex in cr.except_cidrs:
                 ids -= self._cidr_identities(ex)
             peer_ids.update(ids)
