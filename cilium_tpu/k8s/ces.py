@@ -33,16 +33,24 @@ CES_PLURAL = "ciliumendpointslices"
 
 def _slim(cep: Dict) -> Dict:
     """CEP → CoreCiliumEndpoint (the slice member shape): the slim
-    subset agents need — name, numeric id, identity, networking,
-    named ports."""
+    subset agents need — name + namespace (CEPs are namespaced; a
+    slice mixes namespaces, so members must disambiguate), numeric
+    id, identity, networking, named ports."""
     status = cep.get("status", {})
+    meta = cep.get("metadata", {})
     return {
-        "name": cep.get("metadata", {}).get("name", ""),
+        "name": meta.get("name", ""),
+        "namespace": meta.get("namespace", "default"),
         "id": status.get("id", 0),
         "identity": status.get("identity", {}),
         "networking": status.get("networking", {}),
         "named-ports": status.get("named-ports", []),
     }
+
+
+def _cep_key(cep: Dict):
+    meta = cep.get("metadata", {})
+    return (meta.get("namespace", "default"), meta.get("name", ""))
 
 
 class CESBatcher:
@@ -54,10 +62,12 @@ class CESBatcher:
         self.max_per_slice = max_per_slice
         self.prefix = prefix
         self._lock = threading.Lock()
-        #: cep name → slice name
-        self._placement: Dict[str, str] = {}
-        #: slice name → {cep name → slim endpoint}
-        self._slices: Dict[str, Dict[str, Dict]] = {}
+        #: (namespace, name) → slice name — CEPs are NAMESPACED;
+        #: keying by bare name would collide same-named pods across
+        #: namespaces (second one silently evicts the first)
+        self._placement: Dict[tuple, str] = {}
+        #: slice name → {(namespace, name) → slim endpoint}
+        self._slices: Dict[str, Dict[tuple, Dict]] = {}
         self._counter = 0
         self._informer: Optional[Informer] = None
 
@@ -93,24 +103,23 @@ class CESBatcher:
         return name
 
     def _on_cep(self, cep: Dict) -> None:
-        name = cep.get("metadata", {}).get("name", "")
-        if not name:
+        key = _cep_key(cep)
+        if not key[1]:
             return
         with self._lock:
-            slice_name = self._placement.get(name)
+            slice_name = self._placement.get(key)
             if slice_name is None:
                 slice_name = self._pick_slice()
-                self._placement[name] = slice_name
-            self._slices[slice_name][name] = _slim(cep)
+                self._placement[key] = slice_name
+            self._slices[slice_name][key] = _slim(cep)
             self._apply_slice(slice_name)
 
     def _on_cep_delete(self, cep: Dict) -> None:
-        name = cep.get("metadata", {}).get("name", "")
         with self._lock:
-            slice_name = self._placement.pop(name, None)
+            slice_name = self._placement.pop(_cep_key(cep), None)
             if slice_name is None:
                 return
-            self._slices.get(slice_name, {}).pop(name, None)
+            self._slices.get(slice_name, {}).pop(_cep_key(cep), None)
             self._apply_slice(slice_name)
 
     # -- lifecycle ---------------------------------------------------------

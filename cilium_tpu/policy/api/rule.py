@@ -372,7 +372,6 @@ class Rule:
                             # and cidr are mutually exclusive members
                             raise SanitizeError(
                                 "cidrGroupRef and cidr are exclusive")
-                        net = None
                         for ex in cr.except_cidrs:
                             try:
                                 ipaddress.ip_network(ex, strict=False)

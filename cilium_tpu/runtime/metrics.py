@@ -41,6 +41,14 @@ class Metrics:
         with self._lock:
             self._histos[self._key(name, labels)].append(value)
 
+    def histo_sum(self, name: str,
+                  labels: Optional[Dict[str, str]] = None) -> float:
+        """Locked sum of a histogram's samples (phase-attribution
+        deltas and similar read-side consumers)."""
+        with self._lock:
+            return float(sum(self._histos.get(
+                self._key(name, labels), ())))
+
     def get(self, name: str, labels: Optional[Dict[str, str]] = None) -> float:
         with self._lock:
             k = self._key(name, labels)

@@ -104,11 +104,11 @@ def test_cidr_group_drives_enforcement(tmp_path):
         server.stop()
 
 
-def _cep(name, ep_id, identity=1000):
+def _cep(name, ep_id, identity=1000, namespace="default"):
     return {
         "apiVersion": "cilium.io/v2",
         "kind": "CiliumEndpoint",
-        "metadata": {"name": name, "namespace": "default"},
+        "metadata": {"name": name, "namespace": namespace},
         "status": {"id": ep_id, "identity": {"id": identity},
                    "networking": {"node": "n1"}},
     }
@@ -168,6 +168,39 @@ def test_ces_batching_churn(tmp_path):
             return not members and not slices
 
         assert wait_until(all_gone)
+    finally:
+        batcher.stop()
+        server.stop()
+
+
+def test_ces_same_name_across_namespaces(tmp_path):
+    """web-0 in two namespaces are two slice members, and deleting
+    one leaves the other's placement intact (CEPs are namespaced)."""
+    server = APIServer(str(tmp_path / "k8s.sock")).start()
+    c = K8sClient(server.socket_path)
+    batcher = CESBatcher(K8sClient(server.socket_path),
+                         max_per_slice=10).start()
+    try:
+        c.apply("ciliumendpoints", _cep("web-0", 1, namespace="a"))
+        c.apply("ciliumendpoints", _cep("web-0", 2, namespace="b"))
+
+        def two_members():
+            slices, _ = _slice_members(c)
+            members = [(e["namespace"], e["name"], e["id"])
+                       for s in slices for e in s["endpoints"]]
+            return sorted(members) == [("a", "web-0", 1),
+                                       ("b", "web-0", 2)]
+
+        assert wait_until(two_members)
+        c.delete("ciliumendpoints", "web-0", "a")
+
+        def one_left():
+            slices, _ = _slice_members(c)
+            members = [(e["namespace"], e["id"])
+                       for s in slices for e in s["endpoints"]]
+            return members == [("b", 2)]
+
+        assert wait_until(one_left)
     finally:
         batcher.stop()
         server.stop()
