@@ -4,7 +4,8 @@
 PY ?= python
 
 .PHONY: test shim determinism dryrun bench bench-all bench-e2e \
-        bench-service bench-regen bench-sp bench-watch check
+        bench-service bench-regen bench-sp bench-stream \
+        bench-multichip bench-watch check
 
 test:            ## full suite (CPU, virtual 8-device mesh via conftest)
 	$(PY) -m pytest tests/ -q
@@ -39,6 +40,15 @@ bench-regen:     ## cold vs incremental vs restage regeneration latency
 
 bench-sp:        ## SP (associative-scan) vs sequential payload scan
 	$(PY) bench_sp.py
+
+bench-stream:    ## online serving path: chunked binary stream transport
+	$(PY) bench_service.py --stream --stream-only --rules 1000 \
+	    --stream-chunk 16384 --stream-depth 16 \
+	    --out SERVICE_LATENCY_stream.json
+
+bench-multichip: ## DP/DPxEP/TP scaling on the virtual 8-device mesh
+	JAX_PLATFORMS=cpu $(PY) bench_multichip.py --devices 8 \
+	    --out MULTICHIP_PERF.json
 
 bench-watch:     ## probe until the tunnel answers, then capture the sweep
 	$(PY) bench.py --watch r04

@@ -167,6 +167,32 @@ def test_bugtool_and_offline_replay(live_agent, capsys):
     assert summary["flows"] == 1
 
 
+def test_capture_stream_against_live_agent(live_agent, capsys,
+                                           tmp_path):
+    """`capture stream`: synth a binary capture, replay it through the
+    live agent's verdict socket over the chunked binary transport."""
+    agent, svc, api, hubble, tmp = live_agent
+    policy = tmp / "cnp.yaml"
+    policy.write_text(CNP)
+    agent.policy_add_file(str(policy), wait=False)
+    agent.endpoint_add(1, {"app": "service"})
+    agent.endpoint_manager.regenerate_all(wait=True)
+
+    cap = str(tmp / "cap.bin")
+    rc, out = _run(capsys, ["capture", "synth", cap,
+                            "--scenario", "http", "--rules", "20",
+                            "--flows", "500"])
+    assert rc == 0
+    rc, out = _run(capsys, ["capture", "stream", cap,
+                            "--socket", svc, "--chunk", "128"])
+    assert rc == 0, out
+    info = json.loads(out)
+    assert info["records"] == 500
+    assert info["errors"] == 0
+    assert sum(info["verdicts"]) == 500
+    assert info["records_per_sec"] > 0
+
+
 def test_unreachable_socket_is_an_error_not_a_traceback(tmp_path, capsys):
     rc = cli.main(["status", "--socket", str(tmp_path / "nope.sock")])
     err = capsys.readouterr().err
