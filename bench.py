@@ -789,27 +789,36 @@ def run_config(config: str, args) -> dict:
     cap = getattr(args, "from_capture", None)
     cap_is_auto = cap == "auto"
     if cap_is_auto:
-        if config in ("http", "generic"):
-            # per-user dir (no cross-user /tmp collisions or symlink
-            # planting); key carries every shape knob so a stale file
-            # from a different scenario can't be silently reused
-            d = os.path.join(tempfile.gettempdir(),
-                             f"ct_bench_{os.getuid()}")
-            os.makedirs(d, exist_ok=True)
-            card = getattr(args, "capture_cardinality", "low")
-            cap = os.path.join(
-                d, f"cap_{config}_{n_rules}r_{n_flows}b_"
-                   f"{args.capture_flows}f"
-                   f"{'_hicard' if card == 'high' else ''}_v2.bin")
-        else:
-            cap = None
+        # every config except regen is capture-capable as of round 5
+        # per-user dir (no cross-user /tmp collisions or symlink
+        # planting); key carries every shape knob so a stale file
+        # from a different scenario can't be silently reused
+        d = os.path.join(tempfile.gettempdir(),
+                         f"ct_bench_{os.getuid()}")
+        os.makedirs(d, exist_ok=True)
+        card = getattr(args, "capture_cardinality", "low")
+        # mixed's flows derive from the examples/policies corpus, not
+        # (n_rules, n_flows) alone — fingerprint the corpus contents
+        # into the key or a corpus edit silently reuses stale traffic
+        corpus_tag = ""
+        if config == "mixed":
+            import hashlib
+
+            h = hashlib.sha256()
+            for root, _, files in sorted(os.walk(corpus)):
+                for name in sorted(files):
+                    p = os.path.join(root, name)
+                    h.update(name.encode())
+                    with open(p, "rb") as fh:
+                        h.update(fh.read())
+            corpus_tag = f"_c{h.hexdigest()[:8]}"
+        cap = os.path.join(
+            d, f"cap_{config}_{n_rules}r_{n_flows}b_"
+               f"{args.capture_flows}f{corpus_tag}"
+               f"{'_hicard' if card == 'high' else ''}_v2.bin")
     elif cap in (None, "", "none"):
         cap = None
     if cap is not None:
-        if config not in ("http", "generic"):
-            return {"metric": "bench_failed_setup", "value": 0,
-                    "unit": "--from-capture is an http/generic lane",
-                    "vs_baseline": 0.0}
         args.from_capture = cap
         try:
             e2e = _bench_from_capture(args, cfg, engine, scenario,
@@ -903,8 +912,7 @@ def _inner_cmd(config: str, args) -> list:
         cmd += ["--flows", str(args.flows)]
     if args.check:
         cmd.append("--check")
-    if getattr(args, "from_capture", None) \
-            and config in ("http", "generic"):
+    if getattr(args, "from_capture", None) and config != "regen":
         cmd += ["--from-capture", args.from_capture,
                 "--capture-flows", str(args.capture_flows),
                 "--replay-chunk", str(args.replay_chunk),
@@ -1112,12 +1120,13 @@ def main() -> int:
                     help="verify engine vs oracle on a sample (after timing)")
     ap.add_argument("--from-capture", metavar="FILE", dest="from_capture",
                     default="auto",
-                    help="http config: ALSO time end-to-end file→verdict "
-                         "replay of a stored v2 binary capture (written "
-                         "from the synth scenario if FILE is absent) — "
-                         "the north star's 'replaying a Hubble capture'. "
-                         "Default 'auto' uses a shape-keyed temp file; "
-                         "'none' disables the lane")
+                    help="time end-to-end file→verdict replay of a "
+                         "stored v2/v3 binary capture (written from the "
+                         "synth scenario if FILE is absent) — the north "
+                         "star's 'replaying a Hubble capture'. Default "
+                         "'auto' (every config except regen, round 5) "
+                         "uses a shape-keyed temp file; 'none' disables "
+                         "the lane (the full-batch lane then reports)")
     ap.add_argument("--capture-flows", type=int, default=200000,
                     help="records to write when --from-capture creates "
                          "the file (default 200000)")
