@@ -6,10 +6,14 @@ sorted key tensor with wildcard probes and priority resolution.
 
 Key layout (3×int32 words, lexicographically sorted):
 
-* ``w0`` — endpoint identity (the identity whose policy applies; the
-  reference's per-endpoint policy maps become one global table keyed by
-  endpoint identity — valid because policy depends only on the identity,
-  the same dedup ``pkg/policy/distillery.go`` exploits)
+* ``w0`` — policy TEMPLATE id (round 5): identities whose resolved
+  entry sets are identical share one template's rows, and the lookup
+  indirects identity → template through ``enf_ids``/``tmpl_ids``
+  (``subject`` in :func:`mapstate_lookup`) before probing. This is
+  ``pkg/policy/distillery.go``'s dedup applied to the packed tensor —
+  at clustermesh scale it shrinks the table ~16× (10M → 625k rows).
+  Hand-built tables (tests) may still key w0 by raw endpoint identity
+  and pass ``tmpl_ids=None``.
 * ``w1`` — peer identity (src for ingress, dst for egress); 0 = wildcard
 * ``w2`` — ``(direction << 29) | (proto << 21) | (port_plen << 16) |
   dport``; proto 0 = wildcard. ``port_plen`` keys port RANGES as
@@ -48,7 +52,7 @@ from cilium_tpu.policy.mapstate import MapState, MapStateKey, MapStateEntry
 class PackedMapState:
     """Sorted key/entry tensors (host-side numpy; loader stages to device)."""
 
-    key_w0: np.ndarray      # [N] int32 endpoint identity
+    key_w0: np.ndarray      # [N] int32 policy TEMPLATE id (see tmpl_ids)
     key_w1: np.ndarray      # [N] int32 peer identity
     key_w2: np.ndarray      # [N] int32 dir|proto|plen|port
     is_deny: np.ndarray     # [N] bool
@@ -57,6 +61,16 @@ class PackedMapState:
     # per-endpoint-identity enforcement: sorted ids + 3-bit flags
     enf_ids: np.ndarray     # [M] int32 sorted endpoint identities
     enf_flags: np.ndarray   # [M, 3] bool (ingress, egress, audit)
+    #: [M] int32 policy-template id per enf_ids row: identities whose
+    #: resolved entry sets are IDENTICAL share one template's table
+    #: rows — the distillery dedup (pkg/policy/distillery.go) applied
+    #: to the packed tensor. At clustermesh scale (10k identities ×
+    #: ~1k entries) this shrinks the key table ~100× (10M → distinct
+    #: templates), which is the difference between the probe's binary
+    #: search walking a 40 MB random-access table and a cache-resident
+    #: one. None = w0 holds raw endpoint identities (legacy direct
+    #: construction in tests).
+    tmpl_ids: np.ndarray = None
     #: [P] int32 DISTINCT port prefix lengths present, sorted
     #: descending (always contains 16 and 0) — the lookup's port
     #: probe set; its SHAPE is static per compile, so a ruleset that
@@ -89,10 +103,13 @@ def pack_mapstate(
     """
     rows: List[Tuple[int, int, int, bool, int, bool]] = []
     enf: List[Tuple[int, bool, bool, bool]] = []
+    tmpl_of_identity: List[int] = []
+    tmpl_index: Dict[tuple, int] = {}
     plens = {16, 0}
     for ep_id, ms in sorted(per_identity.items()):
         enf.append((ep_id, ms.ingress_enforced, ms.egress_enforced,
                     getattr(ms, "audit", False)))
+        ep_rows = []
         for key, entry in ms.entries.items():
             rid = -1
             if ruleset_of_entry is not None and entry.is_redirect:
@@ -101,16 +118,27 @@ def pack_mapstate(
             if plen is None:
                 plen = 0 if key.dport == 0 else 16
             plens.add(plen)
-            rows.append((
-                ep_id,
+            ep_rows.append((
                 key.identity,
                 _pack_w2(key.direction, key.proto, key.dport, plen),
                 entry.is_deny,
                 rid,
                 getattr(entry, "auth_required", False),
             ))
+        # distillery dedup: identities with identical verdict-relevant
+        # entry sets share one TEMPLATE; the table stores each template
+        # once and the lookup indirects identity → template. rid is
+        # content-keyed by the caller (ruleset_of dedups rule-id
+        # sets), so shared entries share rulesets too.
+        fp = tuple(sorted(ep_rows))
+        tmpl = tmpl_index.get(fp)
+        if tmpl is None:
+            tmpl = tmpl_index[fp] = len(tmpl_index)
+            for r in ep_rows:
+                rows.append((tmpl,) + r)
+        tmpl_of_identity.append(tmpl)
     if not rows:
-        # sentinel row that can never match (identity -1)
+        # sentinel row that can never match (template ids are >= 0)
         rows.append((-1, -1, -1, False, -1, False))
     arr = np.array([r[:3] for r in rows], dtype=np.int64)
     order = np.lexsort((arr[:, 2], arr[:, 1], arr[:, 0]))
@@ -120,7 +148,11 @@ def pack_mapstate(
     auth = np.array([rows[i][5] for i in order], dtype=bool)
     if not enf:
         enf.append((-1, False, False, False))
-    enf.sort()
+        tmpl_of_identity.append(-1)
+    # tmpl_ids must stay aligned with the SORTED enf table
+    enf_order = sorted(range(len(enf)), key=lambda i: enf[i])
+    enf = [enf[i] for i in enf_order]
+    tmpl_of_identity = [tmpl_of_identity[i] for i in enf_order]
     return PackedMapState(
         key_w0=arr[:, 0].astype(np.int32),
         key_w1=arr[:, 1].astype(np.int32),
@@ -133,6 +165,7 @@ def pack_mapstate(
                            dtype=bool),
         port_plens=np.array(sorted(plens, reverse=True),
                             dtype=np.int32),
+        tmpl_ids=np.array(tmpl_of_identity, dtype=np.int32),
     )
 
 
@@ -160,6 +193,9 @@ def mapstate_lookup(
     directions: jax.Array,  # [B]
     auth: jax.Array = None,  # [N] bool entry auth flags (optional)
     port_plens: jax.Array = None,  # [P] int32 desc (default [16, 0])
+    tmpl_ids: jax.Array = None,  # [M] int32 identity→template (see
+    #                              PackedMapState.tmpl_ids); None = w0
+    #                              holds raw endpoint identities
 ) -> Dict[str, jax.Array]:
     """Batched verdict lookup. Returns dict with:
     ``allowed`` [B] bool (L3/L4 verdict, pre-L7),
@@ -197,7 +233,21 @@ def mapstate_lookup(
     is_icmp = (protos == 1) | (protos == 58)
     dports = jnp.where(is_icmp, dports | ICMP_TYPE_BIT, dports)
 
-    p0 = jnp.broadcast_to(ep_ids[:, None], (B, n_probes))
+    # identity → enforcement row (reused below) and, with the
+    # distillery dedup, identity → policy TEMPLATE: probes search the
+    # deduped table by template id. An unknown identity maps to -1,
+    # which matches no table row (template ids are >= 0) — identical
+    # to the pre-dedup behavior where an absent identity's w0 found
+    # nothing.
+    eidx = jnp.clip(jnp.searchsorted(enf_ids, ep_ids), 0,
+                    enf_ids.shape[0] - 1)
+    eknown = enf_ids[eidx] == ep_ids
+    if tmpl_ids is None:
+        subject = ep_ids
+    else:
+        subject = jnp.where(eknown, tmpl_ids[eidx], -1)
+
+    p0 = jnp.broadcast_to(subject[:, None], (B, n_probes))
     p1 = peer_ids[:, None] * peer_sel[None, :]
     w2 = (
         (directions[:, None] << 29)
@@ -231,10 +281,7 @@ def mapstate_lookup(
         denied, DENY_SPEC, jnp.where(any_allow, specs[first_allow], -1)
     )
 
-    # default enforcement per endpoint identity
-    eidx = jnp.clip(jnp.searchsorted(enf_ids, ep_ids), 0,
-                    enf_ids.shape[0] - 1)
-    eknown = enf_ids[eidx] == ep_ids
+    # default enforcement per endpoint identity (eidx/eknown above)
     enforced = jnp.where(
         directions == int(TrafficDirection.INGRESS),
         enf_flags[eidx, 0], enf_flags[eidx, 1],

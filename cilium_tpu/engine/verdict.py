@@ -427,6 +427,7 @@ class CompiledPolicy:
             "ms_enf_ids": packed.enf_ids,
             "ms_enf_flags": packed.enf_flags,
             "ms_plens": packed.port_plens,
+            "ms_tmpl_ids": packed.tmpl_ids,
             "rs_http_mask": _masks_to_array(http_members or [[]],
                                             len(http_rules)),
             "rs_kafka_mask": _masks_to_array(kafka_members or [[]],
@@ -970,6 +971,7 @@ def verdict_step_capture(arrays: Dict[str, jax.Array],
         c("protos"), c("directions"),
         auth=arrays.get("ms_auth"),
         port_plens=arrays.get("ms_plens"),
+        tmpl_ids=arrays.get("ms_tmpl_ids"),
     )
     words = (table_words["path"][c("path_row")],
              table_words["method"][c("method_row")],
@@ -1314,6 +1316,7 @@ def verdict_step(arrays: Dict[str, jax.Array], batch: Dict[str, jax.Array]
         batch["protos"], batch["directions"],
         auth=arrays.get("ms_auth"),
         port_plens=arrays.get("ms_plens"),
+        tmpl_ids=arrays.get("ms_tmpl_ids"),
     )
 
     def scan_field(prefix: str, data, lengths, valid):

@@ -134,15 +134,16 @@ class Loader:
 
         from cilium_tpu.engine.verdict import CompiledPolicy, VerdictEngine
 
-        # "policy-v5": v2 gained the ms_auth array; v3 port-range prefix
+        # "policy-v6": v2 gained the ms_auth array; v3 port-range prefix
         # keys (ms_plens + the w2 repack); v4 the audit_mode scalar; v5
-        # the per-endpoint audit bit (enf_flags grew a column) — each
-        # bump invalidates older cached artifacts, and the entry tuple
-        # must include every verdict-relevant key/entry field or two
-        # policies differing only in that field would share one
-        # artifact
+        # the per-endpoint audit bit (enf_flags grew a column); v6 the
+        # distillery template dedup (ms_tmpl_ids; key_w0 holds template
+        # ids) — each bump invalidates older cached artifacts, and the
+        # entry tuple must include every verdict-relevant key/entry
+        # field or two policies differing only in that field would
+        # share one artifact
         key = ruleset_fingerprint(
-            "policy-v5",
+            "policy-v6",
             self.config.policy_audit_mode,
             sorted(
                 (

@@ -167,7 +167,8 @@ def test_mapstate_kernel_equals_golden(entries, flags, probes):
         jnp.asarray([p[2] for p in probes], dtype=jnp.int32),
         jnp.asarray([int(p[3]) for p in probes], dtype=jnp.int32),
         auth=jnp.asarray(packed.auth),
-        port_plens=jnp.asarray(packed.port_plens))
+        port_plens=jnp.asarray(packed.port_plens),
+        tmpl_ids=jnp.asarray(packed.tmpl_ids))
     got = np.asarray(out["allowed"])
     got_auth = np.asarray(out["auth_required"])
     # the per-endpoint audit bit rides the enforcement table: the

@@ -120,6 +120,7 @@ def test_kernel_matches_golden_model(seed):
         jnp.asarray(ports, dtype=jnp.int32),
         jnp.asarray(protos, dtype=jnp.int32),
         jnp.asarray(dirs, dtype=jnp.int32),
+        tmpl_ids=jnp.asarray(packed.tmpl_ids),
     )
     got = np.asarray(out["allowed"])
     mism = np.nonzero(got != np.array(want_allowed))[0]
