@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test shim determinism dryrun bench bench-all bench-e2e \
+.PHONY: test shim determinism dryrun chaos bench bench-all bench-e2e \
         bench-service bench-regen bench-sp bench-stream \
         bench-multichip bench-watch check
 
@@ -15,6 +15,12 @@ shim:            ## build the C++ proxylib-ABI shim
 
 determinism:     ## deterministic-compile + debug_nans sanitizer lane
 	$(PY) -m pytest tests/test_determinism.py -q
+
+# chaos: golden corpus replayed under injected device failures /
+# stream drops / mid-swap crashes (runtime/faults.py) — seeded and
+# deterministic; marked slow so tier-1 timing never pays for it
+chaos:           ## seeded fault-injection replay lane
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py -q -m chaos
 
 dryrun:          ## driver multi-chip contract on a virtual CPU mesh
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

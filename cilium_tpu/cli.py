@@ -351,28 +351,40 @@ def cmd_capture(args) -> int:
             # each frame is self-contained (carries the file's string
             # table) — simple and correct; the bench path amortizes
             # tables via the server's incremental session anyway
-            for i in range(0, len(rec), bs):
-                g = gen[i:i + bs] if gen is not None else None
-                client.send_image(sections_to_bytes(
-                    np.asarray(rec[i:i + bs]), l7[i:i + bs],
-                    offsets, blob, g, fmax))
-            client.finish()
+            try:
+                for i in range(0, len(rec), bs):
+                    g = gen[i:i + bs] if gen is not None else None
+                    client.send_image(sections_to_bytes(
+                        np.asarray(rec[i:i + bs]), l7[i:i + bs],
+                        offsets, blob, g, fmax))
+                client.finish()
+            except (OSError, ConnectionError, TimeoutError):
+                # a dead/hung service: the drain below reports the
+                # truncation; a thread traceback helps nobody
+                pass
 
         th = threading.Thread(target=sender, daemon=True)
         th.start()
-        for _seq, v in client.results():
-            if isinstance(v, Exception):
-                state["errors"] += 1
-                continue
-            counts += np.bincount(v, minlength=6)[:6]
-            state["n"] += len(v)
+        stalled = False
+        try:
+            for _seq, v in client.results():
+                if isinstance(v, Exception):
+                    state["errors"] += 1
+                    continue
+                counts += np.bincount(v, minlength=6)[:6]
+                state["n"] += len(v)
+        except TimeoutError:
+            # a hung service stalls results() (no frame within the
+            # client timeout): the replay is truncated — report it in
+            # the summary JSON with exit 1, never as a traceback
+            stalled = True
         th.join(timeout=30)
         client.close()
         dt = max(_time.monotonic() - t0, 1e-9)
         # a dead service mid-stream drains results() cleanly with the
         # sender's BrokenPipeError swallowed — a truncated replay must
         # exit nonzero, never report partial success
-        truncated = state["n"] != len(rec) or th.is_alive()
+        truncated = stalled or state["n"] != len(rec) or th.is_alive()
         print(json.dumps({
             "records": state["n"],
             "expected": int(len(rec)),
@@ -381,6 +393,7 @@ def cmd_capture(args) -> int:
             "records_per_sec": round(state["n"] / dt, 1),
             "errors": state["errors"],
             "truncated": truncated,
+            "stalled": stalled,
             "revision": client.revision,
         }))
         return 1 if (state["errors"] or truncated) else 0

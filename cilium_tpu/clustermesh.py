@@ -31,7 +31,19 @@ from typing import Callable, Dict, List, Optional
 from cilium_tpu.core.identity import IdentityAllocator, NumericIdentity
 from cilium_tpu.core.labels import Label, LabelSet, SOURCE_K8S
 from cilium_tpu.kvstore import Event, EVENT_DELETE, KVStore, Lease, Watch
+from cilium_tpu.runtime import faults
 from cilium_tpu.runtime.metrics import METRICS
+
+#: fires per remote-cluster event ingest: a session fault costs one
+#: event (isolated by the kvstore's per-watcher delivery), and the
+#: next announcement of the key repairs the entry
+SESSION_POINT = faults.register_point(
+    "clustermesh.session", "remote-cluster event ingest")
+#: fires in the publisher heartbeat: the owning Controller's
+#: exponential backoff (runtime/controller.py) absorbs it and the
+#: lease keeps published state alive until the next beat lands
+HEARTBEAT_POINT = faults.register_point(
+    "clustermesh.heartbeat", "local-state publisher heartbeat")
 
 IP_PREFIX = "cilium/state/ip/v1/default/"
 IDENTITY_PREFIX = "cilium/state/identities/v1/id/"
@@ -133,6 +145,7 @@ class LocalStatePublisher:
         self._published_services = current
 
     def heartbeat(self) -> None:
+        faults.maybe_fail(HEARTBEAT_POINT)
         self._lease.keepalive()
         self.publish_services()
         self.store.expire_leases()
@@ -262,6 +275,7 @@ class RemoteCluster:
                 self._release_identity(entry[1])
 
     def _on_event(self, ev: Event) -> None:
+        faults.maybe_fail(SESSION_POINT)
         if ev.typ == EVENT_DELETE:
             self._drop_key(ev.key)
             return

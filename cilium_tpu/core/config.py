@@ -18,7 +18,10 @@ from typing import Optional, Tuple
 try:  # tomllib is stdlib on 3.11+
     import tomllib  # type: ignore
 except Exception:  # pragma: no cover
-    tomllib = None
+    try:  # 3.10: the identical-API backport, if present
+        import tomli as tomllib  # type: ignore
+    except Exception:
+        tomllib = None
 
 
 @dataclasses.dataclass
@@ -56,6 +59,21 @@ class LoaderConfig:
 
     cache_dir: str = os.path.expanduser("~/.cache/cilium_tpu")
     enable_cache: bool = True
+
+
+@dataclasses.dataclass
+class BreakerConfig:
+    """TPU-lane circuit breaker (runtime/service.py): after
+    ``failure_threshold`` consecutive device-dispatch failures the
+    verdict path trips to the CPU oracle (correct but slower) and
+    half-open probes the device lane every ``probe_interval`` seconds
+    until a probe succeeds. Mirrors pkg/controller's backoff
+    discipline applied to the datapath itself: degrade gracefully,
+    never wrongly."""
+
+    enabled: bool = True
+    failure_threshold: int = 3
+    probe_interval: float = 5.0
 
 
 @dataclasses.dataclass
@@ -98,6 +116,7 @@ class Config:
     engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
     loader: LoaderConfig = dataclasses.field(default_factory=LoaderConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+    breaker: BreakerConfig = dataclasses.field(default_factory=BreakerConfig)
     log_level: str = "info"
     #: ``--k8s-api-socket``: when set, the agent consumes CNP/CCNP
     #: from the fake-apiserver (cilium_tpu.k8s) through list+watch
@@ -152,7 +171,8 @@ class Config:
             cfg.kube_apiserver_ips = tuple(data["kube_apiserver_ips"])
         for section, target in (("engine", cfg.engine),
                                 ("loader", cfg.loader),
-                                ("parallel", cfg.parallel)):
+                                ("parallel", cfg.parallel),
+                                ("breaker", cfg.breaker)):
             for k, v in data.get(section, {}).items():
                 if hasattr(target, k):
                     setattr(target, k, tuple(v) if isinstance(v, list) else v)

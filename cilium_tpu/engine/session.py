@@ -376,6 +376,9 @@ class IncrementalSession:
             # verdicts are sliced off before anything reads them
             idx = np.concatenate(
                 [idx, np.zeros(B_pad - n, dtype=np.int32)])
+        from cilium_tpu.engine.verdict import DISPATCH_POINT, _faults
+
+        _faults.maybe_fail(DISPATCH_POINT)
         table_words = {f: self.tables[f].words for f in _FIELDS}
         batch = {"rows": self.rows_dev,
                  "idx": jax.device_put(idx, self.engine.device)}

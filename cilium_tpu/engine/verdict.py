@@ -1362,6 +1362,14 @@ def _bools_to_words(bools: jax.Array, n_words: int) -> jax.Array:
     return jnp.sum(b << shifts[None, None, :], axis=2, dtype=jnp.uint32)
 
 
+from cilium_tpu.runtime import faults as _faults
+
+#: fires at every device dispatch of the jitted engine (the oracle is
+#: never injected — it is the fallback the breaker trips TO)
+DISPATCH_POINT = _faults.register_point(
+    "engine.dispatch", "device dispatch in VerdictEngine")
+
+
 class VerdictEngine:
     """Jitted wrapper around :func:`verdict_step` for a CompiledPolicy."""
 
@@ -1380,6 +1388,7 @@ class VerdictEngine:
         self._blob_steps: Dict[tuple, object] = {}
 
     def verdict_batch_arrays(self, batch: Dict[str, jax.Array]):
+        _faults.maybe_fail(DISPATCH_POINT)
         return self._step(self._arrays, batch)
 
     def _blob_step(self, layout):
@@ -1401,6 +1410,7 @@ class VerdictEngine:
         :func:`pack_blob_host`) — the service path's per-batch wall is
         transport RTTs, not device work. Bit-identical verdicts to
         :meth:`verdict_flows` (pinned by differential test)."""
+        _faults.maybe_fail(DISPATCH_POINT)
         fb = encode_flows(flows, self.policy.kafka_interns, cfg)
         blob, layout = pack_blob_host(flowbatch_to_host_dict(fb))
         batch = {"blob": jax.device_put(blob, self.device)}

@@ -23,6 +23,13 @@ from cilium_tpu.fqdn.namemanager import NameManager
 from cilium_tpu.policy.compiler import matchpattern
 from cilium_tpu.policy.compiler.dfa import compile_patterns
 from cilium_tpu.policy.api.l7 import PortRuleDNS
+from cilium_tpu.runtime import faults
+from cilium_tpu.runtime.metrics import DNSPROXY_FALLBACKS, METRICS
+
+#: fires in the banked-DFA batch path; a device fault degrades the
+#: batch to the CPU regex matcher (same verdicts, slower)
+QUERY_POINT = faults.register_point(
+    "dnsproxy.query", "banked-DFA DNS batch verdict")
 
 
 class DNSProxy:
@@ -82,19 +89,29 @@ class DNSProxy:
             return np.array(
                 [any(p.match(q) for p in pats) for q in sanitized],
                 dtype=bool)
-        st = self._get_banked(key, srcs)
-        from cilium_tpu.engine.dfa_kernel import dfa_scan_banked
+        try:
+            faults.maybe_fail(QUERY_POINT)
+            st = self._get_banked(key, srcs)
+            from cilium_tpu.engine.dfa_kernel import dfa_scan_banked
 
-        data = np.zeros((len(sanitized), 256), dtype=np.uint8)
-        lengths = np.zeros(len(sanitized), dtype=np.int32)
-        for i, q in enumerate(sanitized):
-            bs = q.encode("utf-8")[:256]
-            data[i, : len(bs)] = np.frombuffer(bs, dtype=np.uint8)
-            lengths[i] = len(bs)
-        words = np.asarray(dfa_scan_banked(
-            st["trans"], st["byteclass"], st["start"], st["accept"],
-            data, lengths))
-        return words.reshape(len(sanitized), -1).any(axis=1) != 0
+            data = np.zeros((len(sanitized), 256), dtype=np.uint8)
+            lengths = np.zeros(len(sanitized), dtype=np.int32)
+            for i, q in enumerate(sanitized):
+                bs = q.encode("utf-8")[:256]
+                data[i, : len(bs)] = np.frombuffer(bs, dtype=np.uint8)
+                lengths[i] = len(bs)
+            words = np.asarray(dfa_scan_banked(
+                st["trans"], st["byteclass"], st["start"], st["accept"],
+                data, lengths))
+            return words.reshape(len(sanitized), -1).any(axis=1) != 0
+        except Exception:  # noqa: BLE001 — device sick: degrade to CPU
+            # the regex set and the banked DFA are compiled from the
+            # SAME sources, so the fallback answers identically —
+            # correct but per-query (the reference's pkg/fqdn/re path)
+            METRICS.inc(DNSPROXY_FALLBACKS)
+            return np.array(
+                [any(p.match(q) for p in pats) for q in sanitized],
+                dtype=bool)
 
     def _get_banked(self, key, srcs):
         """Staged device tensors for the key's automaton, cached keyed

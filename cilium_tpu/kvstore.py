@@ -18,6 +18,17 @@ import threading
 import time
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
+from cilium_tpu.runtime import faults
+from cilium_tpu.runtime.logging import get_logger
+from cilium_tpu.runtime.metrics import KVSTORE_WATCH_ERRORS, METRICS
+
+LOG = get_logger("kvstore")
+
+#: fires per watch-event delivery — a session fault must cost ONE
+#: watcher ONE event, never the committing writer or its siblings
+WATCH_POINT = faults.register_point(
+    "kvstore.watch", "per-watch event delivery in KVStore")
+
 #: Watch event types, mirroring the reference's kvstore EventType.
 EVENT_CREATE = "create"
 EVENT_MODIFY = "modify"
@@ -214,7 +225,7 @@ class KVStore:
             # snapshot; any later one blocks on the dispatch lock until
             # the replay below has been delivered
             for k, v in snapshot:
-                callback(Event(EVENT_CREATE, k, v))
+                self._deliver(w, Event(EVENT_CREATE, k, v))
         return w
 
     def _remove_watch(self, w: Watch) -> None:
@@ -222,11 +233,27 @@ class KVStore:
             if w in self._watches:
                 self._watches.remove(w)
 
+    def _deliver(self, w: Watch, ev: Event) -> None:
+        """One watcher, one event — isolated. A raising callback (or
+        an injected session fault) must cost that watcher that event,
+        never propagate into the committing writer: the reference
+        serializes and logs per-watcher errors the same way."""
+        try:
+            faults.maybe_fail(WATCH_POINT)
+            w.callback(ev)
+        except Exception as e:  # noqa: BLE001 — isolate the watcher
+            METRICS.inc(KVSTORE_WATCH_ERRORS)
+            LOG.error("watch callback failed",
+                      extra={"fields": {
+                          "prefix": w.prefix, "key": ev.key,
+                          "event": ev.typ,
+                          "error": f"{type(e).__name__}: {e}"}})
+
     def _dispatch(self, watches: List[Watch], ev: Event) -> None:
         with self._dispatch_lock:
             for w in watches:
                 if not w.stopped and ev.key.startswith(w.prefix):
-                    w.callback(ev)
+                    self._deliver(w, ev)
 
     def __len__(self) -> int:
         with self._lock:

@@ -21,10 +21,16 @@ from cilium_tpu.policy.oracle import OracleVerdictEngine
 from cilium_tpu.policy.repository import Repository
 from cilium_tpu.policy.selectorcache import SelectorCache
 from cilium_tpu.runtime.checkpoint import ArtifactCache, ruleset_fingerprint
+from cilium_tpu.runtime import faults
 from cilium_tpu.runtime.logging import get_logger, span as _log_span
-from cilium_tpu.runtime.metrics import METRICS, SpanStat
+from cilium_tpu.runtime.metrics import LOADER_ROLLBACKS, METRICS, SpanStat
 
 LOG = get_logger("loader")
+
+#: fires between stage and commit: a crash here must leave the
+#: PREVIOUS revision serving (tests/test_faults.py pins it)
+SWAP_POINT = faults.register_point(
+    "loader.swap", "revision swap in Loader.regenerate")
 
 
 def _referenced_secret_values(per_identity, secrets) -> tuple:
@@ -86,6 +92,11 @@ class Loader:
 
         self.bank_cache = BankCache()
         self._warned_oracle_scale = False
+        # lazily-built CPU oracle over the ACTIVE snapshot: the circuit
+        # breaker's fallback lane (runtime/service.py). Cached per
+        # revision; invalidated by _commit.
+        self._fallback = None
+        self._fallback_revision = -1
 
     @property
     def revision(self) -> int:
@@ -96,11 +107,80 @@ class Loader:
         with self._lock:
             return self._engine
 
+    @property
+    def fallback_engine(self):
+        """CPU oracle over the currently-serving snapshot — the
+        circuit breaker's degraded lane. When the active engine IS the
+        oracle (gate off) it is returned directly; otherwise an
+        OracleVerdictEngine is built lazily and cached until the next
+        revision commit. Always correct, never fast."""
+        with self._lock:
+            engine = self._engine
+            revision = self._revision
+            per_identity = self.per_identity
+            if engine is None or isinstance(engine, OracleVerdictEngine):
+                return engine
+            if self._fallback is not None \
+                    and self._fallback_revision == revision:
+                return self._fallback
+        secret_lookup = (self.secrets.lookup
+                         if self.secrets is not None else None)
+        fallback = OracleVerdictEngine(
+            per_identity, secret_lookup=secret_lookup,
+            audit=self.config.policy_audit_mode)
+        with self._lock:
+            # only install if no newer revision committed meanwhile
+            if self._revision == revision:
+                self._fallback = fallback
+                self._fallback_revision = revision
+        return fallback
+
+    def _commit(self, engine, revision: int,
+                per_identity: Dict[int, MapState], backend: str):
+        """The revision swap — ONE critical section, so a reader sees
+        either the old (engine, revision, snapshot) triple or the new
+        one, never a mix. The loader.swap injection point fires just
+        before: a fault here models a crash mid-swap, and regenerate's
+        rollback guarantees the previous table keeps serving."""
+        faults.maybe_fail(SWAP_POINT)
+        with self._lock:
+            self._engine = engine
+            self._revision = revision
+            self.per_identity = per_identity
+            self._fallback = None
+            self._fallback_revision = -1
+        METRICS.inc("cilium_tpu_regenerations_total",
+                    labels={"backend": backend})
+        return engine
+
     def regenerate(self, per_identity: Dict[int, MapState],
                    revision: int = 0):
         """Compile + stage a policy snapshot; atomic swap on success
         (old engine keeps serving until then — the reference's datapath
-        likewise keeps enforcing during regeneration)."""
+        likewise keeps enforcing during regeneration). Any failure
+        before or during the swap ROLLS BACK: the previous
+        (engine, revision, snapshot) triple is restored verbatim and
+        keeps serving, the rollback is counted, and the error
+        propagates to the caller."""
+        with self._lock:
+            prev = (self._engine, self._revision, self.per_identity)
+        try:
+            return self._regenerate(per_identity, revision)
+        except Exception as e:
+            with self._lock:
+                self._engine, self._revision, self.per_identity = prev
+                self._fallback = None
+                self._fallback_revision = -1
+            METRICS.inc(LOADER_ROLLBACKS)
+            LOG.error("regeneration rolled back",
+                      extra={"fields": {
+                          "revision": revision,
+                          "serving_revision": prev[1],
+                          "error": f"{type(e).__name__}: {e}"}})
+            raise
+
+    def _regenerate(self, per_identity: Dict[int, MapState],
+                    revision: int = 0):
         secret_lookup = (self.secrets.lookup
                          if self.secrets is not None else None)
         if not self.config.enable_tpu_offload:
@@ -124,13 +204,7 @@ class Loader:
             engine = OracleVerdictEngine(
                 per_identity, secret_lookup=secret_lookup,
                 audit=self.config.policy_audit_mode)
-            with self._lock:
-                self._engine = engine
-                self._revision = revision
-                self.per_identity = per_identity
-            METRICS.inc("cilium_tpu_regenerations_total",
-                        labels={"backend": "oracle"})
-            return engine
+            return self._commit(engine, revision, per_identity, "oracle")
 
         from cilium_tpu.engine.verdict import CompiledPolicy, VerdictEngine
 
@@ -182,13 +256,7 @@ class Loader:
                        identities=len(per_identity), cache_hit=cached):
             with SpanStat("policy_stage"):
                 engine = VerdictEngine(policy, device=self.device)
-        with self._lock:
-            self._engine = engine
-            self._revision = revision
-            self.per_identity = per_identity
-        METRICS.inc("cilium_tpu_regenerations_total",
-                    labels={"backend": "tpu"})
-        return engine
+        return self._commit(engine, revision, per_identity, "tpu")
 
     def regenerate_from_repo(self, repo: Repository, cache: SelectorCache,
                              endpoint_labels: Dict[int, LabelSet]):

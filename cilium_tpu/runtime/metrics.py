@@ -14,6 +14,30 @@ import time
 from collections import defaultdict
 from typing import Dict, List, Optional, Tuple
 
+# -- degraded-operation series (runtime/faults.py + the TPU→oracle
+# circuit breaker in runtime/service.py). Named here so the emitting
+# seams, the chaos suite, and dashboards agree on one spelling.
+#: breaker CLOSED→OPEN transitions (N consecutive device failures)
+BREAKER_TRIPS = "cilium_tpu_breaker_trips_total"
+#: breaker HALF_OPEN→CLOSED transitions (a probe succeeded)
+BREAKER_RECOVERIES = "cilium_tpu_breaker_recoveries_total"
+#: verdicts served by the CPU oracle because the device lane was
+#: tripped or the dispatch failed (correct-but-slower path)
+BREAKER_FALLBACK_VERDICTS = "cilium_tpu_breaker_fallback_verdicts_total"
+#: gauge: 0 = CLOSED (device serving), 1 = OPEN (oracle serving),
+#: 2 = HALF_OPEN (probe in flight)
+BREAKER_STATE = "cilium_tpu_breaker_state"
+#: faults fired by an armed FaultPlan, labelled by injection point
+FAULTS_INJECTED = "cilium_tpu_faults_injected_total"
+#: regenerations rolled back mid-swap (previous table kept serving)
+LOADER_ROLLBACKS = "cilium_tpu_loader_swap_rollbacks_total"
+#: stream-client reconnect attempts that re-established the session
+STREAM_RECONNECTS = "cilium_tpu_stream_reconnects_total"
+#: watch callbacks that raised and were isolated (kvstore.py)
+KVSTORE_WATCH_ERRORS = "cilium_tpu_kvstore_watch_errors_total"
+#: banked-DFA DNS batch failures degraded to the CPU regex path
+DNSPROXY_FALLBACKS = "cilium_tpu_dnsproxy_fallback_total"
+
 
 class Metrics:
     def __init__(self) -> None:

@@ -48,7 +48,16 @@ from cilium_tpu.core.flow import (
 from cilium_tpu.ingest.hubble import flow_from_dict
 from cilium_tpu.proxylib.parser import Connection, OpType, create_parser
 from cilium_tpu.runtime.loader import Loader
-from cilium_tpu.runtime.metrics import METRICS
+from cilium_tpu.runtime.logging import get_logger
+from cilium_tpu.runtime.metrics import (
+    BREAKER_FALLBACK_VERDICTS,
+    BREAKER_RECOVERIES,
+    BREAKER_STATE,
+    BREAKER_TRIPS,
+    METRICS,
+)
+
+LOG = get_logger("service")
 
 
 def verdict_flows_padded(engine, flows: Sequence[Flow],
@@ -84,6 +93,178 @@ def verdict_outputs_padded(engine, flows: Sequence[Flow],
     fn = getattr(engine, "verdict_flows_blob", engine.verdict_flows)
     out = fn(flows, authed_pairs=authed_pairs, outputs=outputs)
     return {k: np.asarray(v)[:n] for k, v in out.items()}
+
+
+class CircuitBreaker:
+    """TPU-lane circuit breaker (pkg/controller's backoff discipline
+    applied to the datapath): CLOSED routes verdicts to the device
+    engine; ``failure_threshold`` CONSECUTIVE dispatch failures trip
+    it OPEN (every verdict then rides the CPU oracle — correct but
+    slower); after ``probe_interval`` seconds one request is let
+    through HALF_OPEN as a probe — success recovers to CLOSED, failure
+    re-opens and re-arms the probe timer.
+
+    Thread-safe; the MicroBatcher drain workers, the per-request
+    "verdict" op and the stream sessions all share one instance, so
+    "N consecutive failures" means N across the whole service, exactly
+    like an operator would count them. ``clock`` is injectable so the
+    chaos suite drives the probe timer deterministically."""
+
+    CLOSED, OPEN, HALF_OPEN = 0, 1, 2
+    _NAMES = {0: "closed", 1: "open", 2: "half-open"}
+
+    def __init__(self, failure_threshold: int = 3,
+                 probe_interval: float = 5.0, clock=time.monotonic):
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.probe_interval = float(probe_interval)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        #: (event, state-name) transition log — the replayable trace
+        #: the chaos suite compares across seeded runs
+        self.events: List = []
+        METRICS.set_gauge(BREAKER_STATE, float(self.CLOSED))
+
+    @property
+    def state(self) -> int:
+        with self._lock:
+            return self._state
+
+    def _transition(self, state: int, event: str) -> None:
+        self._state = state
+        self.events.append((event, self._NAMES[state]))
+        METRICS.set_gauge(BREAKER_STATE, float(state))
+
+    def allow_primary(self) -> bool:
+        """May this request try the device lane? OPEN returns False
+        until the probe timer expires, then exactly one caller gets
+        True as the HALF_OPEN probe (concurrent callers keep falling
+        back — a thundering herd onto a possibly-sick device would
+        defeat the probe's purpose)."""
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN and \
+                    self.clock() - self._opened_at >= self.probe_interval:
+                self._transition(self.HALF_OPEN, "probe")
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._state == self.HALF_OPEN:
+                self._transition(self.CLOSED, "recover")
+                METRICS.inc(BREAKER_RECOVERIES)
+            self._consecutive_failures = 0
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive_failures += 1
+            if self._state == self.HALF_OPEN:
+                # failed probe: back to OPEN, re-arm the timer
+                self._opened_at = self.clock()
+                self._transition(self.OPEN, "probe-failed")
+            elif (self._state == self.CLOSED
+                  and self._consecutive_failures
+                  >= self.failure_threshold):
+                self._opened_at = self.clock()
+                self._transition(self.OPEN, "trip")
+                METRICS.inc(BREAKER_TRIPS)
+
+
+class ResilientVerdictor:
+    """The degraded-mode verdict pipeline: device engine behind a
+    :class:`CircuitBreaker`, CPU oracle (``Loader.fallback_engine``)
+    as the always-correct fallback. Every verdict path in the service
+    (MicroBatcher, the bulk "verdict" op, stream sessions) routes
+    through one instance, so a sick device degrades the WHOLE service
+    to correct-but-slower instead of erroring any single path.
+
+    When the active engine already is the oracle (gate off) the
+    breaker never engages — there is no faster lane to trip from."""
+
+    def __init__(self, loader: Loader, breaker: Optional[CircuitBreaker]
+                 = None, authed_pairs_fn=None):
+        self.loader = loader
+        cfg = getattr(loader.config, "breaker", None)
+        if breaker is None:
+            breaker = CircuitBreaker(
+                failure_threshold=getattr(cfg, "failure_threshold", 3),
+                probe_interval=getattr(cfg, "probe_interval", 5.0))
+        self.breaker = breaker
+        self.enabled = getattr(cfg, "enabled", True)
+        self.authed_pairs_fn = authed_pairs_fn
+
+    @staticmethod
+    def _device_backed(engine) -> bool:
+        # the jitted engine exposes the blob step; the oracle doesn't
+        return hasattr(engine, "_blob_step")
+
+    def _pairs(self, authed_pairs):
+        if authed_pairs is not None:
+            return authed_pairs
+        return (self.authed_pairs_fn()
+                if self.authed_pairs_fn is not None else None)
+
+    # -- breaker bookkeeping shared with StreamSession ------------------
+    def allow_device(self, engine) -> bool:
+        if not self.enabled or not self._device_backed(engine):
+            return True
+        return self.breaker.allow_primary()
+
+    def on_device_success(self) -> None:
+        if self.enabled:
+            self.breaker.record_success()
+
+    def on_device_failure(self, exc: BaseException) -> None:
+        if self.enabled:
+            self.breaker.record_failure()
+        LOG.warning("device verdict lane failed; serving via oracle",
+                    extra={"fields": {
+                        "error": f"{type(exc).__name__}: {exc}"}})
+
+    def fallback_outputs(self, flows: Sequence[Flow], authed_pairs=None,
+                         outputs=None):
+        """Oracle lane, with the fallback counter."""
+        METRICS.inc(BREAKER_FALLBACK_VERDICTS, len(flows))
+        return verdict_outputs_padded(
+            self.loader.fallback_engine, flows,
+            authed_pairs=self._pairs(authed_pairs), outputs=outputs)
+
+    # -- the verdict entry points ---------------------------------------
+    def outputs(self, flows: Sequence[Flow], authed_pairs=None,
+                outputs=None):
+        """Full output lanes under pow2 padding, surviving device
+        failure: device lane when the breaker allows, oracle
+        otherwise or on dispatch failure — the request is answered
+        either way, and always correctly."""
+        engine = self.loader.engine
+        if engine is None:
+            raise RuntimeError("no policy loaded")
+        pairs = self._pairs(authed_pairs)
+        if not self.enabled or not self._device_backed(engine):
+            return verdict_outputs_padded(engine, flows,
+                                          authed_pairs=pairs,
+                                          outputs=outputs)
+        if self.breaker.allow_primary():
+            try:
+                out = verdict_outputs_padded(engine, flows,
+                                             authed_pairs=pairs,
+                                             outputs=outputs)
+                self.breaker.record_success()
+                return out
+            except Exception as e:  # noqa: BLE001 — degrade, don't die
+                self.on_device_failure(e)
+        return self.fallback_outputs(flows, authed_pairs=pairs,
+                                     outputs=outputs)
+
+    def verdicts(self, flows: Sequence[Flow],
+                 authed_pairs=None) -> List[int]:
+        return [int(v) for v in
+                self.outputs(flows, authed_pairs=authed_pairs,
+                             outputs=("verdict",))["verdict"]]
 
 
 class MicroBatcher:
@@ -199,12 +380,17 @@ class PolicyBridge:
 
     def __init__(self, loader: Loader, batch_max: int = 256,
                  deadline_ms: float = 2.0, authed_pairs_fn=None,
-                 accesslog_fn=None, drain_workers: int = 1):
+                 accesslog_fn=None, drain_workers: int = 1,
+                 verdictor: Optional[ResilientVerdictor] = None):
         self.loader = loader
         #: supplies AuthManager.pairs_array() — the L7 proxy path must
         #: enforce drop-until-authed exactly like Agent.process_flows,
         #: or auth-demanding traffic would slip through the proxy
         self.authed_pairs_fn = authed_pairs_fn
+        #: shared degraded-mode pipeline (standalone bridges build
+        #: their own so the breaker protects them too)
+        self.verdictor = verdictor or ResilientVerdictor(
+            loader, authed_pairs_fn=authed_pairs_fn)
         #: ``accesslog_fn(flow)``: sink for LOG-action accesslog records
         #: (the reference annotates the Envoy access log on a LOG
         #: header-match mismatch; ours emits the L7 flow to the hubble
@@ -219,12 +405,11 @@ class PolicyBridge:
         self._pa_revision = -1
 
     def _verdicts(self, flows: Sequence[Flow]) -> Sequence[int]:
-        engine = self.loader.engine
-        if engine is None:
+        if self.loader.engine is None:
             return [int(Verdict.DROPPED)] * len(flows)
-        pairs = (self.authed_pairs_fn()
-                 if self.authed_pairs_fn is not None else None)
-        return verdict_flows_padded(engine, flows, authed_pairs=pairs)
+        # breaker-guarded: a device failure serves this batch from the
+        # oracle instead of erroring every queued request
+        return self.verdictor.verdicts(flows)
 
     def record_to_flow(self, conn: Connection, record) -> Flow:
         f = Flow(
@@ -328,13 +513,18 @@ class VerdictService:
         self.loader = loader
         self.socket_path = socket_path
         self.agent = agent  # optional backref for introspection ops
+        #: ONE breaker-guarded pipeline for every verdict path this
+        #: service serves (batcher, bulk op, streams)
+        self.verdictor = ResilientVerdictor(
+            loader, authed_pairs_fn=(agent.auth.pairs_array
+                                     if agent is not None else None))
         self.bridge = PolicyBridge(
             loader, batch_max=batch_max, deadline_ms=deadline_ms,
             authed_pairs_fn=(agent.auth.pairs_array
                              if agent is not None else None),
             accesslog_fn=(self._accesslog
                           if agent is not None else None),
-            drain_workers=drain_workers)
+            drain_workers=drain_workers, verdictor=self.verdictor)
         self._connections: Dict[int, Connection] = {}
         self._conn_lock = threading.Lock()
         self._server: Optional[socketserver.ThreadingUnixStreamServer] = None
@@ -371,6 +561,7 @@ class VerdictService:
             widths=req.get("widths") or None,
             authed_pairs_fn=self.bridge.authed_pairs_fn,
             pipeline_depth=int(req.get("pipeline_depth") or 8),
+            verdictor=self.verdictor,
         ).run()
 
     # -- request handling -------------------------------------------------
@@ -418,13 +609,11 @@ class VerdictService:
             return {"verdict": self.bridge.batcher.check(flow)}
         if op == "verdict":
             flows = [flow_from_dict(d) for d in req.get("flows", ())]
-            engine = self.loader.engine
-            if engine is None:
+            if self.loader.engine is None:
                 return {"error": "no policy loaded"}
-            out = verdict_outputs_padded(
-                engine, flows,
-                authed_pairs=self.bridge.authed_pairs_fn()
-                if self.bridge.authed_pairs_fn is not None else None)
+            # breaker-guarded: device dispatch failures degrade this
+            # request to the oracle lane instead of an error response
+            out = self.verdictor.outputs(flows)
             verdicts = [int(v) for v in out["verdict"]]
             if self.agent is not None and flows:
                 # the reference's datapath emits PolicyVerdictNotify

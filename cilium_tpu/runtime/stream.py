@@ -48,9 +48,11 @@ number — the serving path must degrade per-chunk, not per-connection.
 from __future__ import annotations
 
 import queue
+import random
 import socket
 import struct
 import threading
+import time
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -60,7 +62,17 @@ from cilium_tpu.ingest.binary import (
     capture_from_bytes,
     capture_to_bytes,
 )
-from cilium_tpu.runtime.metrics import METRICS
+from cilium_tpu.runtime import faults
+from cilium_tpu.runtime.metrics import METRICS, STREAM_RECONNECTS
+
+#: fires at the server's per-chunk dispatch (a fault fails ONE seq —
+#: the per-chunk degradation contract)
+FRAME_SERVER_POINT = faults.register_point(
+    "stream.frame.server", "per-chunk dispatch in StreamSession")
+#: fires at the client's per-frame receive; plans typically raise
+#: ConnectionError here to exercise reconnect-with-resume
+FRAME_CLIENT_POINT = faults.register_point(
+    "stream.frame.client", "per-frame receive in StreamClient")
 
 FRAME_HEADER = struct.Struct("<IIB")
 
@@ -114,12 +126,17 @@ class StreamSession:
     def __init__(self, loader, sock: socket.socket,
                  widths: Optional[Dict[str, int]] = None,
                  authed_pairs_fn=None,
-                 pipeline_depth: int = PIPELINE_DEPTH):
+                 pipeline_depth: int = PIPELINE_DEPTH,
+                 verdictor=None):
         from cilium_tpu.core.config import EngineConfig
 
         self.loader = loader
         self.sock = sock
         self.authed_pairs_fn = authed_pairs_fn
+        #: optional ResilientVerdictor (runtime/service.py): shares the
+        #: service-wide circuit breaker so a sick device degrades
+        #: stream chunks to the oracle instead of erroring every seq
+        self.verdictor = verdictor
         cfg = EngineConfig()
         # session-fixed string widths: the client promises its strings
         # fit (longer ones clip exactly like the engine's config caps);
@@ -178,6 +195,7 @@ class StreamSession:
         ``copy_to_host_async`` below keeps several readbacks in
         flight (130 ms/chunk serialized → ~25 ms/chunk measured with
         5 in flight)."""
+        faults.maybe_fail(FRAME_SERVER_POINT)
         rec, l7, offsets, blob, gen = capture_from_bytes(payload)
         n = len(rec)
         if n == 0:
@@ -199,21 +217,49 @@ class StreamSession:
             flows = records_to_flows_l7(rec, l7, offsets, blob, gen=gen)
             out = engine.verdict_flows(flows, authed_pairs=pairs)
             return n, np.asarray(out["verdict"])
-        if self._inc is None or self._inc_engine is not engine:
-            # first chunk, or the loader hot-swapped a new revision:
-            # session tables were scanned against the OLD engine's
-            # DFA banks — rebuild (the NPDS-invalidation analog)
-            from cilium_tpu.engine.session import IncrementalSession
+        vd = self.verdictor
+        if vd is not None and not vd.allow_device(engine):
+            # breaker open: the whole service is in degraded mode —
+            # this chunk rides the oracle like every other path
+            return n, self._oracle_chunk(rec, l7, offsets, blob, gen,
+                                         pairs)
+        try:
+            if self._inc is None or self._inc_engine is not engine:
+                # first chunk, or the loader hot-swapped a new revision:
+                # session tables were scanned against the OLD engine's
+                # DFA banks — rebuild (the NPDS-invalidation analog)
+                from cilium_tpu.engine.session import IncrementalSession
 
-            self._inc = IncrementalSession(engine, widths=self.widths)
-            self._inc_engine = engine
-        n, verdict = self._inc.verdict_chunk(
-            rec, l7, offsets, blob, gen=gen, authed_pairs=pairs)
+                self._inc = IncrementalSession(engine, widths=self.widths)
+                self._inc_engine = engine
+            n, verdict = self._inc.verdict_chunk(
+                rec, l7, offsets, blob, gen=gen, authed_pairs=pairs)
+        except Exception as e:  # noqa: BLE001 — degrade, don't error
+            if vd is None:
+                raise
+            vd.on_device_failure(e)
+            # the session may hold state staged against the failed
+            # dispatch — rebuild it on the next device chunk
+            self._inc = None
+            return n, self._oracle_chunk(rec, l7, offsets, blob, gen,
+                                         pairs)
+        if vd is not None:
+            vd.on_device_success()
         # issue the D2H NOW, not at the writer's np.asarray: readbacks
         # only overlap if ISSUED while earlier ones are in flight
         if hasattr(verdict, "copy_to_host_async"):
             verdict.copy_to_host_async()
         return n, verdict
+
+    def _oracle_chunk(self, rec, l7, offsets, blob, gen, pairs):
+        """One chunk through the CPU oracle (the breaker's degraded
+        lane) — correct verdicts, no device involved."""
+        from cilium_tpu.ingest.binary import records_to_flows_l7
+
+        flows = records_to_flows_l7(rec, l7, offsets, blob, gen=gen)
+        out = self.verdictor.fallback_outputs(flows, authed_pairs=pairs,
+                                              outputs=("verdict",))
+        return np.asarray(out["verdict"])
 
     def _work(self) -> None:
         while True:
@@ -273,59 +319,147 @@ class StreamClient:
     buffer; verdicts arrive on a background thread and are retrieved
     with ``result(seq)`` (blocking) or ``results()`` (drain in
     completion order). ``finish()`` sends end-of-stream and blocks for
-    the end-ack, guaranteeing every outstanding verdict has landed."""
+    the end-ack, guaranteeing every outstanding verdict has landed.
+
+    ``reconnect=True`` adds RECONNECT-WITH-RESUME: every sent chunk is
+    retained until its verdict (or per-chunk error) lands; on a
+    connection drop the client re-dials with exponential backoff +
+    jitter (the ``controller.py`` retry discipline), re-handshakes,
+    and re-sends every unacked chunk in sequence order — resuming from
+    the last acked cursor. Server verdicts are deterministic, so the
+    at-least-once replay of an in-flight chunk is idempotent."""
 
     def __init__(self, socket_path: str, widths: Optional[Dict] = None,
                  timeout: float = 120.0,
-                 pipeline_depth: Optional[int] = None):
-        from cilium_tpu.runtime.service import recv_msg, send_msg
-
-        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self.sock.connect(socket_path)
+                 pipeline_depth: Optional[int] = None,
+                 reconnect: bool = False, max_reconnects: int = 5,
+                 backoff_base: float = 0.05, backoff_max: float = 2.0,
+                 reconnect_seed: int = 0):
+        self.socket_path = socket_path
         self.timeout = timeout
-        hello = {"op": "stream_start", "widths": widths or {}}
-        if pipeline_depth:
-            hello["pipeline_depth"] = int(pipeline_depth)
-        send_msg(self.sock, hello)
-        ack = recv_msg(self.sock)
-        if not ack.get("ok"):
-            raise RuntimeError(f"stream_start refused: {ack}")
-        self.revision = ack.get("revision")
+        self._widths = widths or {}
+        self._pipeline_depth = pipeline_depth
+        self.reconnect = reconnect
+        self.max_reconnects = max_reconnects
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        #: seeded jitter so chaos runs with one plan replay identically
+        self._jitter = random.Random(reconnect_seed)
         self._seq = 0
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
+        self._send_lock = threading.Lock()
         self._results: Dict[int, object] = {}
+        #: seq → chunk image, retained until acked (reconnect mode)
+        self._unacked: Dict[int, bytes] = {}
+        self._finish_seq: Optional[int] = None
         self._done = False
+        self._connect()
         self._recv_thread = threading.Thread(target=self._recv_loop,
                                              daemon=True)
         self._recv_thread.start()
 
-    def _recv_loop(self) -> None:
+    def _connect(self) -> None:
+        from cilium_tpu.runtime.service import recv_msg, send_msg
+
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.connect(self.socket_path)
+        hello = {"op": "stream_start", "widths": self._widths}
+        if self._pipeline_depth:
+            hello["pipeline_depth"] = int(self._pipeline_depth)
+        send_msg(sock, hello)
+        ack = recv_msg(sock)
+        if not ack.get("ok"):
+            sock.close()
+            raise RuntimeError(f"stream_start refused: {ack}")
+        self.revision = ack.get("revision")
+        self.sock = sock
+
+    def _try_reconnect(self) -> bool:
+        """Re-dial + re-handshake + re-send unacked chunks. Backoff is
+        the controller.py discipline: base * 2^attempt capped, plus
+        seeded jitter so simultaneous clients don't re-dial in sync."""
         try:
-            while True:
+            self.sock.close()
+        except OSError:
+            pass
+        for attempt in range(self.max_reconnects):
+            delay = min(self.backoff_base * (2 ** attempt),
+                        self.backoff_max)
+            time.sleep(delay * (1.0 + 0.25 * self._jitter.random()))
+            try:
+                self._connect()
+            except (OSError, RuntimeError):
+                continue
+            with self._lock:
+                pending = sorted(self._unacked.items())
+                finish_seq = self._finish_seq
+            try:
+                with self._send_lock:
+                    for seq, image in pending:
+                        send_frame(self.sock, seq, KIND_CHUNK, image)
+                    if finish_seq is not None:
+                        # finish() already ran: re-send end-of-stream
+                        # so the resumed session still end-acks
+                        send_frame(self.sock, finish_seq, KIND_END)
+            except (OSError, ConnectionError):
+                continue
+            METRICS.inc(STREAM_RECONNECTS)
+            return True
+        return False
+
+    def _recv_loop(self) -> None:
+        while True:
+            try:
                 seq, kind, payload = recv_frame(self.sock)
+                # injected drops model the tunnel dying mid-frame: the
+                # received frame is DISCARDED (its seq stays unacked
+                # and is re-sent after resume)
+                faults.maybe_fail(FRAME_CLIENT_POINT)
+            except (ConnectionError, OSError):
+                if self.reconnect and not self._done \
+                        and self._try_reconnect():
+                    continue
                 with self._cond:
-                    if kind == KIND_END:
-                        self._done = True
-                    elif kind == KIND_ERROR:
-                        self._results[seq] = RuntimeError(
-                            payload.decode("utf-8", "replace"))
-                    else:
-                        self._results[seq] = np.frombuffer(
-                            payload, dtype=np.uint8)
+                    self._done = True
                     self._cond.notify_all()
-                    if kind == KIND_END:
-                        return
-        except (ConnectionError, OSError):
+                return
             with self._cond:
-                self._done = True
+                if kind == KIND_END:
+                    self._done = True
+                elif (self.reconnect and seq not in self._unacked
+                      and seq not in self._results):
+                    # at-least-once resume: a chunk double-sent across
+                    # the drop can answer twice — the second delivery
+                    # of an already-consumed seq is dropped, or the
+                    # count-consuming drain would overcount
+                    pass
+                elif kind == KIND_ERROR:
+                    self._unacked.pop(seq, None)
+                    self._results[seq] = RuntimeError(
+                        payload.decode("utf-8", "replace"))
+                else:
+                    self._unacked.pop(seq, None)
+                    self._results[seq] = np.frombuffer(
+                        payload, dtype=np.uint8)
                 self._cond.notify_all()
+                if kind == KIND_END:
+                    return
 
     def send_image(self, image: bytes) -> int:
         with self._lock:
             seq = self._seq
             self._seq += 1
-        send_frame(self.sock, seq, KIND_CHUNK, image)
+            if self.reconnect:
+                self._unacked[seq] = image
+        try:
+            with self._send_lock:
+                send_frame(self.sock, seq, KIND_CHUNK, image)
+        except (OSError, ConnectionError):
+            if not self.reconnect:
+                raise
+            # the chunk stays in _unacked; the recv thread's reconnect
+            # re-sends it once the session is back
         return seq
 
     def send_flows(self, flows: Sequence) -> int:
@@ -371,7 +505,14 @@ class StreamClient:
         with self._lock:
             seq = self._seq
             self._seq += 1
-        send_frame(self.sock, seq, KIND_END)
+            if self.reconnect:
+                self._finish_seq = seq
+        try:
+            with self._send_lock:
+                send_frame(self.sock, seq, KIND_END)
+        except (OSError, ConnectionError):
+            if not self.reconnect:
+                raise  # the recv thread's resume re-sends END
         with self._cond:
             if not self._cond.wait_for(lambda: self._done,
                                        timeout=self.timeout):

@@ -11,6 +11,7 @@
 // The Python test harness drives it via ctypes against a live service.
 
 #include <arpa/inet.h>
+#include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -203,6 +204,14 @@ std::mutex g_policy_mu;
 std::map<uint32_t, EpPolicy> g_policy;
 uint32_t g_policy_revision = 0;
 bool g_policy_loaded = false;
+// TTL on the cached table (seconds; 0 = disabled): connection-driven
+// invalidation alone lets a deny sit unenforced indefinitely when no
+// new connections arrive — the TTL bounds staleness in TIME, like the
+// reference's server-push xDS bounds propagation. g_policy_stamp is
+// the last successful load OR pull attempt, so a dead service is
+// re-tried at TTL cadence instead of on every check.
+double g_policy_ttl = 0.0;
+std::chrono::steady_clock::time_point g_policy_stamp;
 
 uint32_t rd_u32(const uint8_t* p) {
   return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
@@ -251,7 +260,22 @@ int policy_load_blob(const uint8_t* blob, size_t len) {
   g_policy = std::move(table);
   g_policy_revision = revision;
   g_policy_loaded = true;
+  g_policy_stamp = std::chrono::steady_clock::now();
   return static_cast<int>(revision);
+}
+
+// Returns true when the cached table is past its TTL and this caller
+// claimed the refresh slot (the stamp is advanced so concurrent
+// checks — and every check while the service stays down — don't all
+// pull).
+bool policy_ttl_due() {
+  std::lock_guard<std::mutex> lock(g_policy_mu);
+  if (g_policy_ttl <= 0.0 || !g_policy_loaded) return false;
+  auto now = std::chrono::steady_clock::now();
+  double age = std::chrono::duration<double>(now - g_policy_stamp).count();
+  if (age <= g_policy_ttl) return false;
+  g_policy_stamp = now;
+  return true;
 }
 
 }  // namespace
@@ -283,6 +307,19 @@ uint32_t cshim_policy_revision() {
   return g_policy_loaded ? g_policy_revision : 0;
 }
 
+// Time-bound the cached table: with ttl > 0, a policy_check whose
+// table is older than ttl seconds re-pulls from the connected service
+// FIRST — so a policy change (e.g. a new deny) is enforced within the
+// TTL even when no new connections arrive to carry the revision
+// stamp. 0 (the default) restores pure connection-driven
+// invalidation. On a failed pull the stale table keeps serving
+// ("enforce what we have") and the next attempt waits a full TTL.
+void cshim_policy_set_ttl(double seconds) {
+  std::lock_guard<std::mutex> lock(g_policy_mu);
+  g_policy_ttl = seconds;
+  g_policy_stamp = std::chrono::steady_clock::now();
+}
+
 // Local L3/L4 verdict — the in-proxy fast path. Returns:
 //   1 FORWARDED, 2 DROPPED, 4 AUDIT (would-deny, forward + log)
 //  -1 no local policy for this endpoint (fall back to the service)
@@ -295,6 +332,7 @@ uint32_t cshim_policy_revision() {
 // tests/test_npds_shim.py.
 int cshim_policy_check(uint32_t src_identity, uint32_t dst_identity,
                        uint16_t dport, uint8_t proto, int ingress) {
+  if (policy_ttl_due()) cshim_policy_pull();
   std::lock_guard<std::mutex> lock(g_policy_mu);
   if (!g_policy_loaded) return -1;
   uint32_t ep = ingress ? dst_identity : src_identity;

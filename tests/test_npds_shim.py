@@ -26,7 +26,11 @@ LIB = os.path.join(REPO, "shim", "libcilium_shim.so")
 
 @pytest.fixture(scope="module")
 def shim():
-    if not os.path.exists(LIB):
+    src = os.path.join(REPO, "shim", "cilium_shim.cpp")
+    # rebuild on a missing OR stale .so — a source edit must not test
+    # the previous binary
+    if (not os.path.exists(LIB)
+            or os.path.getmtime(LIB) < os.path.getmtime(src)):
         subprocess.run(["make", "-C", os.path.join(REPO, "shim")],
                        check=True, capture_output=True)
     lib = ctypes.CDLL(LIB)
@@ -38,6 +42,8 @@ def shim():
     lib.cshim_policy_check.restype = ctypes.c_int
     lib.cshim_policy_pull.restype = ctypes.c_int
     lib.cshim_policy_revision.restype = ctypes.c_uint32
+    lib.cshim_policy_set_ttl.argtypes = [ctypes.c_double]
+    lib.cshim_policy_set_ttl.restype = None
     lib.cshim_connect.argtypes = [ctypes.c_char_p]
     lib.cshim_on_new_connection.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_uint32,
@@ -212,6 +218,52 @@ def test_shim_local_fast_path_e2e(tmp_path, shim):
         assert shim.cshim_policy_check(web, db, 5432, 6, 1) == 2
         assert shim.cshim_policy_check(web, db, 6000, 6, 1) == 1
     finally:
+        shim.cshim_disconnect()
+        service.stop()
+
+
+def test_shim_ttl_bounds_stale_policy(tmp_path, shim):
+    """ADVICE r5 (medium): with ZERO new connections, a policy change
+    must still be enforced within the TTL — cshim_policy_check re-pulls
+    once the cached table ages out, so a new deny propagates in time,
+    not on the next connection that may never come."""
+    import time
+
+    from cilium_tpu.core.config import Config
+    from cilium_tpu.runtime.loader import Loader
+    from cilium_tpu.runtime.service import VerdictService
+
+    per_identity, db, web = _l4_policy(5432)
+    loader = Loader(Config())
+    loader.regenerate(per_identity, revision=1)
+    sock = str(tmp_path / "svc.sock")
+    service = VerdictService(loader, sock)
+    service.start()
+    try:
+        assert shim.cshim_connect(sock.encode()) == 0
+        assert shim.cshim_policy_pull() == 1
+        shim.cshim_policy_set_ttl(0.05)
+        assert shim.cshim_policy_check(web, db, 5432, 6, 1) == 1
+
+        # the allow moves 5432 → 6000 (i.e. 5432 becomes a deny); no
+        # connection ever arrives to carry the revision stamp
+        per_identity2, _, _ = _l4_policy(6000)
+        loader.regenerate(per_identity2, revision=2)
+        assert shim.cshim_policy_revision() == 1  # still cached
+        time.sleep(0.06)  # age the table past the TTL
+        # the next check itself re-pulls, then probes the NEW table
+        assert shim.cshim_policy_check(web, db, 5432, 6, 1) == 2
+        assert shim.cshim_policy_revision() == 2
+        assert shim.cshim_policy_check(web, db, 6000, 6, 1) == 1
+
+        # service down + expired TTL: the cached table keeps serving
+        # ("enforce what we have"), no error, no blank table
+        service.stop()
+        time.sleep(0.06)
+        assert shim.cshim_policy_check(web, db, 6000, 6, 1) == 1
+        assert shim.cshim_policy_revision() == 2
+    finally:
+        shim.cshim_policy_set_ttl(0.0)  # module-scoped lib: reset
         shim.cshim_disconnect()
         service.stop()
 
