@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test shim determinism dryrun chaos bench bench-all bench-e2e \
+.PHONY: test shim determinism dryrun chaos obs bench bench-all bench-e2e \
         bench-service bench-regen bench-sp bench-stream \
         bench-multichip bench-watch check
 
@@ -21,6 +21,20 @@ determinism:     ## deterministic-compile + debug_nans sanitizer lane
 # deterministic; marked slow so tier-1 timing never pays for it
 chaos:           ## seeded fault-injection replay lane
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py -q -m chaos
+
+# obs: flight-recorder tracing + metrics exposition tests, then a
+# scrape-lint — expose the LIVE registry (after the tests populated
+# it) and assert the Prometheus text parses with zero malformed lines
+obs:             ## observability lane: tracing tests + scrape lint
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_tracing.py \
+	    tests/test_observability.py -q
+	JAX_PLATFORMS=cpu $(PY) -c "\
+	from cilium_tpu.runtime.metrics import METRICS, lint_exposition; \
+	METRICS.inc('cilium_tpu_scrape_lint_total'); \
+	METRICS.observe('cilium_tpu_scrape_lint_seconds', 0.01); \
+	text = METRICS.expose(); errs = lint_exposition(text); \
+	assert not errs, errs; \
+	print('scrape-lint OK:', len(text.splitlines()), 'lines')"
 
 dryrun:          ## driver multi-chip contract on a virtual CPU mesh
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -59,4 +73,4 @@ bench-multichip: ## DP/DPxEP/TP scaling on the virtual 8-device mesh
 bench-watch:     ## probe until the tunnel answers, then capture the sweep
 	$(PY) bench.py --watch r04
 
-check: shim test determinism dryrun   ## the full CI gate
+check: shim test determinism dryrun obs   ## the full CI gate

@@ -73,13 +73,13 @@ def _prewarm(service, scenario, batch_max: int) -> None:
 def _hist_mark() -> int:
     from cilium_tpu.runtime.metrics import METRICS
 
-    return len(METRICS._histos.get(_HIST_KEY, ()))
+    return METRICS.histo_count(_HIST_KEY[0])
 
 
 def _batches_since(mark: int):
     from cilium_tpu.runtime.metrics import METRICS
 
-    return METRICS._histos.get(_HIST_KEY, ())[mark:]
+    return METRICS.samples_since(_HIST_KEY[0], mark)
 
 
 def _quantiles(latencies: list) -> dict:
@@ -558,7 +558,19 @@ def main() -> int:
                     help="skip the closed/open JSON-protocol sweeps")
     ap.add_argument("--out", default=None,
                     help="write the full sweep JSON here")
+    ap.add_argument("--trace", action="store_true",
+                    help="leave the flight recorder on during the "
+                         "sweep (default: disabled, so the bench "
+                         "measures the un-instrumented hot path; the "
+                         "tracing-overhead A/B runs once with and "
+                         "once without this flag)")
     args = ap.parse_args()
+
+    # the flight recorder defaults ON for serving processes; a bench
+    # must measure the disarmed path unless tracing is the experiment
+    from cilium_tpu.runtime.tracing import TRACER
+
+    TRACER.configure(enabled=bool(args.trace))
 
     # honor JAX_PLATFORMS even with a PJRT plugin site on the path
     # (env alone does not always win — same guard as bench.py)

@@ -56,6 +56,14 @@ class Agent:
                  kvstore: Optional[KVStore] = None):
         self.config = config or Config.from_env()
         self.state_dir = state_dir
+        # the flight recorder follows daemon config (the one knob set
+        # per process, like the metrics registry): sampling/capacity
+        # apply to every ingress this agent serves
+        from cilium_tpu.runtime.tracing import TRACER
+
+        TRACER.configure(enabled=self.config.tracing.enabled,
+                         sample_rate=self.config.tracing.sample_rate,
+                         capacity=self.config.tracing.ring_capacity)
         # serializes compound mutations (endpoint/policy upserts) from
         # concurrent writers: REST API threads, watcher controller, CLI
         self.write_lock = threading.RLock()
@@ -637,6 +645,13 @@ class Agent:
         import numpy as np
 
         engine = self.loader.engine
+        if engine is None and self.endpoint_manager.endpoints():
+            # endpoint_add queues its regeneration asynchronously; a
+            # caller that verdicts immediately after adding endpoints
+            # used to win that race only by scheduler luck — block on
+            # the queued regeneration instead of failing on timing
+            self.endpoint_manager.regenerate_all(wait=True)
+            engine = self.loader.engine
         if engine is None:
             raise RuntimeError(
                 "no policy staged — add an endpoint or policy first")

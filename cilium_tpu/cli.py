@@ -10,7 +10,10 @@ over the agent's socket plus offline tooling. Subcommands:
   (the ``cilium-dbg bpf policy get`` analog: what the datapath —
   here, the staged tensors — actually enforces)
 * ``replay``      — run a Hubble JSONL capture through the engine
-  offline and print a verdict summary
+  offline and print a verdict summary (``--trace-out`` dumps the
+  flight-recorder Chrome trace-event JSON for the run)
+* ``trace dump``  — fetch the live agent's flight recorder
+  (runtime/tracing.py) as Perfetto-loadable Chrome trace-event JSON
 * ``bugtool``     — collect a diagnostics bundle from the agent
   (the ``cilium-bugtool`` analog)
 
@@ -103,8 +106,18 @@ def cmd_replay(args) -> int:
     from cilium_tpu.core.flow import Verdict
     from cilium_tpu.hubble import FlowMetrics, Observer, annotate_flows
     from cilium_tpu.policy.api import load_cnp_yaml
+    from cilium_tpu.runtime.logging import get_logger, setup as log_setup
+    from cilium_tpu.runtime.tracing import (
+        PHASE_FALLBACK,
+        PHASE_HOST,
+        TRACER,
+    )
 
     cfg = Config.from_env()
+    # install the JSONL handler (stderr): replay is a one-shot daemon
+    # run, and its chunk log lines carry the flight-recorder trace_id
+    log_setup(cfg.log_level)
+    replay_log = get_logger("replay")
     if args.tpu:
         cfg.enable_tpu_offload = True
     agent = Agent(cfg)
@@ -188,8 +201,27 @@ def cmd_replay(args) -> int:
                     unmapped[0] += 1
 
         replay_session = None
+        # the jitted engine records its own host-prep/device-dispatch
+        # spans; the oracle records none — attribute its whole
+        # evaluation to the fallback phase so every replay trace shows
+        # phases regardless of the gate
+        engine_is_device = hasattr(engine, "_blob_step")
+
+        def _verdict_span():
+            import contextlib
+
+            if engine_is_device:
+                return contextlib.nullcontext()
+            return TRACER.span("oracle.verdict", phase=PHASE_FALLBACK)
+
         for commit_index, chunk in chunks:
-            if args.fast:
+            # one flight-recorder trace per replayed chunk: the engine
+            # spans (host-prep/device-dispatch or fallback) land under
+            # it, flows are stamped at annotate, and the chunk log
+            # line below carries the same id
+            with TRACER.trace("replay.chunk",
+                              chunk=int(commit_index)) as tctx:
+              if args.fast:
                 # columnar: records → verdicts, no Flow objects. v2
                 # chunks (RawChunk.l7 set) carry the whole-capture
                 # sidecar + widths, so nothing re-reads the file; v1
@@ -221,34 +253,56 @@ def cmd_replay(args) -> int:
                     else:
                         replay_session = False
                 if chunk.l7 is not None and replay_session:
-                    out = replay_session.verdict_chunk(
-                        chunk.records, chunk.l7,
-                        authed_pairs=AUTH_UNENFORCED,
-                        start=chunk.start)
+                    from cilium_tpu.runtime.tracing import (
+                        PHASE_DEVICE as _PHD,
+                    )
+
+                    # CaptureReplay is device-engine-only; its chunk
+                    # step is dominated by the staged-table gather +
+                    # readback — one device span at the call site
+                    with TRACER.span("replay.dispatch", phase=_PHD,
+                                     records=len(chunk)):
+                        out = replay_session.verdict_chunk(
+                            chunk.records, chunk.l7,
+                            authed_pairs=AUTH_UNENFORCED,
+                            start=chunk.start)
                 elif chunk.l7 is not None:
-                    out = engine.verdict_l7_records(
-                        chunk.records, chunk.l7, chunk.offsets,
-                        chunk.blob, authed_pairs=AUTH_UNENFORCED,
-                        widths=chunk.widths, gen=chunk.gen)
+                    with _verdict_span():
+                        out = engine.verdict_l7_records(
+                            chunk.records, chunk.l7, chunk.offsets,
+                            chunk.blob, authed_pairs=AUTH_UNENFORCED,
+                            widths=chunk.widths, gen=chunk.gen)
                 else:
-                    out = engine.verdict_records(
-                        chunk.records, authed_pairs=AUTH_UNENFORCED)
-                for v, c in zip(*np.unique(out["verdict"],
-                                           return_counts=True)):
-                    name = Verdict(int(v)).name
-                    counts[name] = counts.get(name, 0) + int(c)
-            else:
-                for f in chunk:
-                    _remap(f)
-                out = engine.verdict_flows(
-                    chunk, authed_pairs=AUTH_UNENFORCED)
-                if "match_spec" not in out:
-                    out = {"verdict": np.asarray(out["verdict"])}
-                annotate_flows(chunk, out)
-                observer.observe(chunk)
-                for f in chunk:
-                    counts[Verdict(f.verdict).name] = counts.get(
-                        Verdict(f.verdict).name, 0) + 1
+                    with _verdict_span():
+                        out = engine.verdict_records(
+                            chunk.records, authed_pairs=AUTH_UNENFORCED)
+                with TRACER.span("replay.account", phase=PHASE_HOST):
+                    for v, c in zip(*np.unique(out["verdict"],
+                                               return_counts=True)):
+                        name = Verdict(int(v)).name
+                        counts[name] = counts.get(name, 0) + int(c)
+              else:
+                with TRACER.span("replay.remap", phase=PHASE_HOST,
+                                 records=len(chunk)):
+                    for f in chunk:
+                        _remap(f)
+                with _verdict_span():
+                    out = engine.verdict_flows(
+                        chunk, authed_pairs=AUTH_UNENFORCED)
+                with TRACER.span("replay.account", phase=PHASE_HOST):
+                    if "match_spec" not in out:
+                        out = {"verdict": np.asarray(out["verdict"])}
+                    annotate_flows(chunk, out)
+                    observer.observe(chunk)
+                    for f in chunk:
+                        counts[Verdict(f.verdict).name] = counts.get(
+                            Verdict(f.verdict).name, 0) + 1
+              if tctx is not None:
+                  # the JSONL correlate: this record's trace_id equals
+                  # the chunk's span trace id and the flow stamps
+                  replay_log.info("chunk replayed", extra={"fields": {
+                      "chunk": int(commit_index),
+                      "records": len(chunk)}})
             total += len(chunk)
             if cursor is not None:  # commit AFTER processing (§5.4):
                 cursor.commit(commit_index)  # a kill re-runs ≤1 chunk
@@ -267,7 +321,36 @@ def cmd_replay(args) -> int:
         # flows whose capture labels matched no local identity were
         # evaluated as identity 0 — surface it, don't hide it
         summary["unmapped_labels"] = unmapped[0]
+    if args.trace_out:
+        # the whole run's flight-recorder ring as Chrome trace-event
+        # JSON (load at ui.perfetto.dev): per-chunk traces with
+        # queue/host/device (or fallback) phase spans
+        with open(args.trace_out, "w") as fp:
+            json.dump(TRACER.chrome_trace(), fp)
+        summary["trace_out"] = args.trace_out
+        summary["trace_ids"] = len(TRACER.trace_ids())
     print(json.dumps(summary))
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Dump the live agent's flight recorder (`/v1/trace`).
+
+    Default output is Chrome trace-event JSON — load it at
+    https://ui.perfetto.dev (same family as the jax.profiler dumps).
+    ``--spans`` prints the raw span records instead."""
+    c = _api(args)
+    body = c.traces(trace_id=args.trace_id, limit=args.limit,
+                    chrome=not args.spans)
+    text = json.dumps(body, indent=2, default=str)
+    if args.out:
+        with open(args.out, "w") as fp:
+            fp.write(text)
+        n = (len(body.get("traceEvents", ()))
+             if not args.spans else len(body.get("spans", ())))
+        print(json.dumps({"out": args.out, "events": n}))
+    else:
+        print(text)
     return 0
 
 
@@ -347,6 +430,8 @@ def cmd_capture(args) -> int:
         state = {"n": 0, "errors": 0}
         t0 = _time.monotonic()
 
+        from cilium_tpu.runtime.tracing import TRACER
+
         def sender():
             # each frame is self-contained (carries the file's string
             # table) — simple and correct; the bench path amortizes
@@ -354,9 +439,13 @@ def cmd_capture(args) -> int:
             try:
                 for i in range(0, len(rec), bs):
                     g = gen[i:i + bs] if gen is not None else None
-                    client.send_image(sections_to_bytes(
-                        np.asarray(rec[i:i + bs]), l7[i:i + bs],
-                        offsets, blob, g, fmax))
+                    # one trace per chunk: the id rides the traced
+                    # frame, so the SERVER's flight recorder shows
+                    # this chunk's queue/host/device phases
+                    with TRACER.trace("capture.stream", chunk=i // bs):
+                        client.send_image(sections_to_bytes(
+                            np.asarray(rec[i:i + bs]), l7[i:i + bs],
+                            offsets, blob, g, fmax))
                 client.finish()
             except (OSError, ConnectionError, TimeoutError):
                 # a dead/hung service: the drain below reports the
@@ -767,6 +856,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--socket", required=True)
     p.set_defaults(fn=cmd_metrics)
 
+    p = sub.add_parser("trace",
+                       help="flight-recorder traces (runtime/tracing.py)")
+    trsub = p.add_subparsers(dest="trace_cmd", required=True)
+    td = trsub.add_parser(
+        "dump",
+        help="dump recorded traces as Chrome trace-event JSON "
+             "(Perfetto-loadable; --spans for raw span records)")
+    td.add_argument("--api", required=True)
+    td.add_argument("--out", default=None,
+                    help="write to a file instead of stdout")
+    td.add_argument("--trace-id", dest="trace_id", default=None,
+                    help="only this trace id")
+    td.add_argument("--limit", type=int, default=None,
+                    help="newest N span records (raw mode)")
+    td.add_argument("--spans", action="store_true",
+                    help="raw span records instead of Chrome JSON")
+    td.set_defaults(fn=cmd_trace)
+
     p = sub.add_parser("inspect", help="dump a compiled-policy artifact")
     p.add_argument("artifact")
     p.set_defaults(fn=cmd_inspect)
@@ -994,6 +1101,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "observability (hubble/monitor fan-out)")
     p.add_argument("--tpu", action="store_true",
                    help="enable the TPU engine (default: oracle)")
+    p.add_argument("--trace-out", dest="trace_out", default=None,
+                   help="write the run's flight-recorder Chrome "
+                        "trace-event JSON here (ui.perfetto.dev)")
     p.set_defaults(fn=cmd_replay)
 
     args = ap.parse_args(argv)

@@ -77,6 +77,21 @@ class BreakerConfig:
 
 
 @dataclasses.dataclass
+class TracingConfig:
+    """Flight-recorder knobs (runtime/tracing.py): per-request phase
+    attribution (queue-wait / host-prep / device-dispatch /
+    oracle-fallback) into a bounded ring, exported via ``GET
+    /v1/trace`` and ``cilium-tpu trace dump``. ``sample_rate`` admits
+    every ceil(1/rate)-th ingress deterministically; ``enabled=False``
+    reduces every probe to one attribute read (the <2% overhead
+    contract on the service bench)."""
+
+    enabled: bool = True
+    sample_rate: float = 1.0
+    ring_capacity: int = 4096
+
+
+@dataclasses.dataclass
 class ParallelConfig:
     """Mesh / sharding layout (SURVEY.md §2.6)."""
 
@@ -117,6 +132,7 @@ class Config:
     loader: LoaderConfig = dataclasses.field(default_factory=LoaderConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     breaker: BreakerConfig = dataclasses.field(default_factory=BreakerConfig)
+    tracing: TracingConfig = dataclasses.field(default_factory=TracingConfig)
     log_level: str = "info"
     #: ``--k8s-api-socket``: when set, the agent consumes CNP/CCNP
     #: from the fake-apiserver (cilium_tpu.k8s) through list+watch
@@ -151,6 +167,12 @@ class Config:
             cfg.node_name = env["CILIUM_TPU_NODE_NAME"]
         if "CILIUM_TPU_IPAM_MODE" in env:
             cfg.ipam_mode = env["CILIUM_TPU_IPAM_MODE"]
+        if env.get("CILIUM_TPU_TRACING", "").lower() in ("0", "false",
+                                                         "no", "off"):
+            cfg.tracing.enabled = False
+        if "CILIUM_TPU_TRACE_SAMPLE_RATE" in env:
+            cfg.tracing.sample_rate = float(
+                env["CILIUM_TPU_TRACE_SAMPLE_RATE"])
         return cfg
 
     @classmethod
@@ -172,7 +194,8 @@ class Config:
         for section, target in (("engine", cfg.engine),
                                 ("loader", cfg.loader),
                                 ("parallel", cfg.parallel),
-                                ("breaker", cfg.breaker)):
+                                ("breaker", cfg.breaker),
+                                ("tracing", cfg.tracing)):
             for k, v in data.get(section, {}).items():
                 if hasattr(target, k):
                     setattr(target, k, tuple(v) if isinstance(v, list) else v)

@@ -126,6 +126,10 @@ class Flow:
     #: emitting node (flowpb.Flow.node_name); stamped by the relay so a
     #: merged cluster-wide stream stays attributable
     node_name: str = ""
+    #: flight-recorder trace id (runtime/tracing.py), stamped at
+    #: verdict annotation when a trace context is active — flows, JSONL
+    #: logs, and /v1/trace spans join on this one id
+    trace_id: str = ""
     #: flowpb Endpoint.labels of each side — carried so captures from
     #: ANOTHER cluster (whose numeric identities mean nothing here) can
     #: be re-mapped to local identities by label at replay

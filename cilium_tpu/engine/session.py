@@ -358,6 +358,12 @@ class IncrementalSession:
                       authed_pairs=None):
         """Featurize + intern one chunk, push deltas, dispatch the
         gather+verdict step. Returns (n, device verdict array)."""
+        from cilium_tpu.runtime.tracing import (
+            PHASE_DEVICE,
+            PHASE_HOST,
+            TRACER,
+        )
+
         n = len(rec)
         if n == 0:
             return 0, None
@@ -365,23 +371,30 @@ class IncrementalSession:
                 or any(t.n >= self.max_strings
                        for t in self.tables.values())):
             self.reset()
-        rows = self._encode_rows(rec, l7, offsets, blob, gen)
-        idx = self._row_idx(rows)
-        for t in self.tables.values():
-            t.flush()
-        self._flush_rows()
-        B_pad = _pow2(n, floor=32)
-        if B_pad > n:
-            # pad ids point at row 0 — a REAL session row, but padded
-            # verdicts are sliced off before anything reads them
-            idx = np.concatenate(
-                [idx, np.zeros(B_pad - n, dtype=np.int32)])
-        from cilium_tpu.engine.verdict import DISPATCH_POINT, _faults
+        with TRACER.span("session.featurize", phase=PHASE_HOST,
+                         records=n):
+            rows = self._encode_rows(rec, l7, offsets, blob, gen)
+            idx = self._row_idx(rows)
+        with TRACER.span("session.dispatch", phase=PHASE_DEVICE,
+                         records=n):
+            # delta flushes are device transfers — device-dispatch,
+            # like the step they feed
+            for t in self.tables.values():
+                t.flush()
+            self._flush_rows()
+            B_pad = _pow2(n, floor=32)
+            if B_pad > n:
+                # pad ids point at row 0 — a REAL session row, but
+                # padded verdicts are sliced off before anything
+                # reads them
+                idx = np.concatenate(
+                    [idx, np.zeros(B_pad - n, dtype=np.int32)])
+            from cilium_tpu.engine.verdict import DISPATCH_POINT, _faults
 
-        _faults.maybe_fail(DISPATCH_POINT)
-        table_words = {f: self.tables[f].words for f in _FIELDS}
-        batch = {"rows": self.rows_dev,
-                 "idx": jax.device_put(idx, self.engine.device)}
-        self.engine._stage_auth(batch, authed_pairs)
-        out = self._step(self.engine._arrays, table_words, batch)
-        return n, out["verdict"]
+            _faults.maybe_fail(DISPATCH_POINT)
+            table_words = {f: self.tables[f].words for f in _FIELDS}
+            batch = {"rows": self.rows_dev,
+                     "idx": jax.device_put(idx, self.engine.device)}
+            self.engine._stage_auth(batch, authed_pairs)
+            out = self._step(self.engine._arrays, table_words, batch)
+            return n, out["verdict"]

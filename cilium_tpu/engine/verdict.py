@@ -1363,6 +1363,11 @@ def _bools_to_words(bools: jax.Array, n_words: int) -> jax.Array:
 
 
 from cilium_tpu.runtime import faults as _faults
+from cilium_tpu.runtime.tracing import (
+    PHASE_DEVICE as _PH_DEVICE,
+    PHASE_HOST as _PH_HOST,
+    TRACER as _TRACER,
+)
 
 #: fires at every device dispatch of the jitted engine (the oracle is
 #: never injected — it is the fallback the breaker trips TO)
@@ -1411,14 +1416,22 @@ class VerdictEngine:
         transport RTTs, not device work. Bit-identical verdicts to
         :meth:`verdict_flows` (pinned by differential test)."""
         _faults.maybe_fail(DISPATCH_POINT)
-        fb = encode_flows(flows, self.policy.kafka_interns, cfg)
-        blob, layout = pack_blob_host(flowbatch_to_host_dict(fb))
-        batch = {"blob": jax.device_put(blob, self.device)}
-        self._stage_auth(batch, authed_pairs)
-        out = self._blob_step(layout)(self._arrays, batch)
-        if outputs is not None:
-            out = {k: out[k] for k in outputs}
-        return {k: np.asarray(v) for k, v in out.items()}
+        # phase attribution (runtime/tracing.py): featurize/pack is
+        # host-prep; transfer + jitted step + readback is
+        # device-dispatch. Leaf spans — nothing else on this path
+        # records a phase, so a request's phases sum to its latency.
+        with _TRACER.span("engine.featurize", phase=_PH_HOST,
+                          records=len(flows)):
+            fb = encode_flows(flows, self.policy.kafka_interns, cfg)
+            blob, layout = pack_blob_host(flowbatch_to_host_dict(fb))
+        with _TRACER.span("engine.dispatch", phase=_PH_DEVICE,
+                          records=len(flows)):
+            batch = {"blob": jax.device_put(blob, self.device)}
+            self._stage_auth(batch, authed_pairs)
+            out = self._blob_step(layout)(self._arrays, batch)
+            if outputs is not None:
+                out = {k: out[k] for k in outputs}
+            return {k: np.asarray(v) for k, v in out.items()}
 
 
     def _stage_auth(self, batch: Dict[str, jax.Array],
@@ -1454,13 +1467,17 @@ class VerdictEngine:
         full RTT per lane (docs/PLATFORM.md), so a caller that only
         consumes verdicts (the MicroBatcher service path) pays 1 RTT
         instead of one per output key."""
-        fb = encode_flows(flows, self.policy.kafka_interns, cfg)
-        batch = flowbatch_to_device(fb, self.device)
-        self._stage_auth(batch, authed_pairs)
-        out = self.verdict_batch_arrays(batch)
-        if outputs is not None:
-            out = {k: out[k] for k in outputs}
-        return {k: np.asarray(v) for k, v in out.items()}
+        with _TRACER.span("engine.featurize", phase=_PH_HOST,
+                          records=len(flows)):
+            fb = encode_flows(flows, self.policy.kafka_interns, cfg)
+        with _TRACER.span("engine.dispatch", phase=_PH_DEVICE,
+                          records=len(flows)):
+            batch = flowbatch_to_device(fb, self.device)
+            self._stage_auth(batch, authed_pairs)
+            out = self.verdict_batch_arrays(batch)
+            if outputs is not None:
+                out = {k: out[k] for k in outputs}
+            return {k: np.asarray(v) for k, v in out.items()}
 
     def verdict_records(self, rec, cfg: Optional[EngineConfig] = None,
                         authed_pairs: Optional[np.ndarray] = None):
@@ -1468,11 +1485,15 @@ class VerdictEngine:
         no per-flow Python objects (ingest/binary.py → encode_records
         → device)."""
         fmax = int(self.policy.kafka_interns.get("gen_fmax", 4))
-        fb = encode_records(rec, cfg, fmax=fmax)
-        batch = flowbatch_to_device(fb, self.device)
-        self._stage_auth(batch, authed_pairs)
-        out = self.verdict_batch_arrays(batch)
-        return {k: np.asarray(v) for k, v in out.items()}
+        with _TRACER.span("engine.featurize", phase=_PH_HOST,
+                          records=len(rec)):
+            fb = encode_records(rec, cfg, fmax=fmax)
+        with _TRACER.span("engine.dispatch", phase=_PH_DEVICE,
+                          records=len(rec)):
+            batch = flowbatch_to_device(fb, self.device)
+            self._stage_auth(batch, authed_pairs)
+            out = self.verdict_batch_arrays(batch)
+            return {k: np.asarray(v) for k, v in out.items()}
 
     def verdict_l7_records(self, rec, l7, offsets, blob,
                            cfg: Optional[EngineConfig] = None,
@@ -1486,13 +1507,17 @@ class VerdictEngine:
         callers MUST pass whole-capture ``widths``
         (:func:`capture_field_widths`) or every chunk whose longest
         string rounds differently re-jits the step."""
-        fb = encode_l7_records(rec, l7, offsets, blob,
-                               self.policy.kafka_interns, cfg,
-                               widths=widths, gen=gen)
-        batch = flowbatch_to_device(fb, self.device)
-        self._stage_auth(batch, authed_pairs)
-        out = self.verdict_batch_arrays(batch)
-        return {k: np.asarray(v) for k, v in out.items()}
+        with _TRACER.span("engine.featurize", phase=_PH_HOST,
+                          records=len(rec)):
+            fb = encode_l7_records(rec, l7, offsets, blob,
+                                   self.policy.kafka_interns, cfg,
+                                   widths=widths, gen=gen)
+        with _TRACER.span("engine.dispatch", phase=_PH_DEVICE,
+                          records=len(rec)):
+            batch = flowbatch_to_device(fb, self.device)
+            self._stage_auth(batch, authed_pairs)
+            out = self.verdict_batch_arrays(batch)
+            return {k: np.asarray(v) for k, v in out.items()}
 
 
 class CaptureReplay:

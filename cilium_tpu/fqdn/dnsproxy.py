@@ -25,6 +25,12 @@ from cilium_tpu.policy.compiler.dfa import compile_patterns
 from cilium_tpu.policy.api.l7 import PortRuleDNS
 from cilium_tpu.runtime import faults
 from cilium_tpu.runtime.metrics import DNSPROXY_FALLBACKS, METRICS
+from cilium_tpu.runtime.tracing import (
+    PHASE_DEVICE,
+    PHASE_FALLBACK,
+    PHASE_HOST,
+    TRACER,
+)
 
 #: fires in the banked-DFA batch path; a device fault degrades the
 #: batch to the CPU regex matcher (same verdicts, slower)
@@ -84,34 +90,53 @@ class DNSProxy:
             pats = self._compiled.get(key)
         if srcs is None or pats is None:
             return np.zeros(len(qnames), dtype=bool)
-        sanitized = [matchpattern.sanitize_name(q) for q in qnames]
-        if not self.use_tpu:
-            return np.array(
-                [any(p.match(q) for p in pats) for q in sanitized],
-                dtype=bool)
-        try:
-            faults.maybe_fail(QUERY_POINT)
-            st = self._get_banked(key, srcs)
-            from cilium_tpu.engine.dfa_kernel import dfa_scan_banked
+        # DNS batch = its own trace ingress (ISSUE 2): phase spans show
+        # whether the batch rode the banked DFA or degraded to regex
+        with TRACER.trace("dnsproxy.batch", endpoint=endpoint_id,
+                          queries=len(qnames)):
+            with TRACER.span("dns.sanitize", phase=PHASE_HOST,
+                             records=len(qnames)):
+                sanitized = [matchpattern.sanitize_name(q)
+                             for q in qnames]
+            if not self.use_tpu:
+                with TRACER.span("dns.regex", phase=PHASE_FALLBACK,
+                                 records=len(sanitized)):
+                    return np.array(
+                        [any(p.match(q) for p in pats)
+                         for q in sanitized], dtype=bool)
+            try:
+                faults.maybe_fail(QUERY_POINT)
+                with TRACER.span("dns.dfa", phase=PHASE_DEVICE,
+                                 records=len(sanitized)):
+                    st = self._get_banked(key, srcs)
+                    from cilium_tpu.engine.dfa_kernel import (
+                        dfa_scan_banked,
+                    )
 
-            data = np.zeros((len(sanitized), 256), dtype=np.uint8)
-            lengths = np.zeros(len(sanitized), dtype=np.int32)
-            for i, q in enumerate(sanitized):
-                bs = q.encode("utf-8")[:256]
-                data[i, : len(bs)] = np.frombuffer(bs, dtype=np.uint8)
-                lengths[i] = len(bs)
-            words = np.asarray(dfa_scan_banked(
-                st["trans"], st["byteclass"], st["start"], st["accept"],
-                data, lengths))
-            return words.reshape(len(sanitized), -1).any(axis=1) != 0
-        except Exception:  # noqa: BLE001 — device sick: degrade to CPU
-            # the regex set and the banked DFA are compiled from the
-            # SAME sources, so the fallback answers identically —
-            # correct but per-query (the reference's pkg/fqdn/re path)
-            METRICS.inc(DNSPROXY_FALLBACKS)
-            return np.array(
-                [any(p.match(q) for p in pats) for q in sanitized],
-                dtype=bool)
+                    data = np.zeros((len(sanitized), 256),
+                                    dtype=np.uint8)
+                    lengths = np.zeros(len(sanitized), dtype=np.int32)
+                    for i, q in enumerate(sanitized):
+                        bs = q.encode("utf-8")[:256]
+                        data[i, : len(bs)] = np.frombuffer(
+                            bs, dtype=np.uint8)
+                        lengths[i] = len(bs)
+                    words = np.asarray(dfa_scan_banked(
+                        st["trans"], st["byteclass"], st["start"],
+                        st["accept"], data, lengths))
+                    return (words.reshape(len(sanitized), -1)
+                            .any(axis=1) != 0)
+            except Exception:  # noqa: BLE001 — device sick: degrade
+                # the regex set and the banked DFA are compiled from
+                # the SAME sources, so the fallback answers identically
+                # — correct but per-query (the reference's pkg/fqdn/re
+                # path)
+                METRICS.inc(DNSPROXY_FALLBACKS)
+                with TRACER.span("dns.regex", phase=PHASE_FALLBACK,
+                                 records=len(sanitized)):
+                    return np.array(
+                        [any(p.match(q) for p in pats)
+                         for q in sanitized], dtype=bool)
 
     def _get_banked(self, key, srcs):
         """Staged device tensors for the key's automaton, cached keyed

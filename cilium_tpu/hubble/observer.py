@@ -19,19 +19,27 @@ import numpy as np
 
 from cilium_tpu.core.flow import Flow, L7Type, PolicyMatchType, Verdict
 from cilium_tpu.hubble.ring import FlowRing
+from cilium_tpu.runtime.tracing import TRACER
 
 
 def annotate_flows(flows: Sequence[Flow], outputs: Dict[str, np.ndarray],
                    stamp_time: bool = True) -> Sequence[Flow]:
-    """Merge engine outputs (verdict/match_spec arrays) onto flows."""
+    """Merge engine outputs (verdict/match_spec arrays) onto flows.
+
+    When a flight-recorder trace is active (service verdict op, CLI
+    replay chunk), its id is stamped on each flow — the Hubble record
+    then joins the trace spans and the JSONL log lines on one id."""
     verdicts = np.asarray(outputs["verdict"])
     specs = np.asarray(outputs.get("match_spec",
                                    np.full(len(flows), -1)))
     now = time.time()
+    trace_id = TRACER.current_trace_id()
     for i, f in enumerate(flows):
         f.verdict = Verdict(int(verdicts[i]))
         if stamp_time and not f.time:
             f.time = now
+        if trace_id and not f.trace_id:
+            f.trace_id = trace_id
         spec = int(specs[i]) if i < len(specs) else -1
         if f.verdict == Verdict.REDIRECTED:
             f.policy_match_type = PolicyMatchType.L7

@@ -63,6 +63,8 @@ def flow_to_dict(f: Flow) -> Dict:
         d["time"] = f.time
     if f.node_name:
         d["node_name"] = f.node_name
+    if f.trace_id:
+        d["trace_id"] = f.trace_id
     if f.src_ip or f.dst_ip:
         d["IP"] = {"source": f.src_ip, "destination": f.dst_ip}
     l4_proto = Protocol(f.protocol)
@@ -150,6 +152,7 @@ def flow_from_dict(d: Dict) -> Flow:
     f.src_labels = tuple(src.get("labels") or ())
     f.dst_labels = tuple(dst.get("labels") or ())
     f.node_name = d.get("node_name", "") or ""
+    f.trace_id = d.get("trace_id", "") or ""
     ip = d.get("IP") or {}
     f.src_ip = ip.get("source", "")
     f.dst_ip = ip.get("destination", "")

@@ -9,6 +9,7 @@ revision counter, behind the master gate), its metrics registry
 from cilium_tpu.runtime.loader import Loader
 from cilium_tpu.runtime.checkpoint import ArtifactCache, ruleset_fingerprint
 from cilium_tpu.runtime.metrics import Metrics, SpanStat, METRICS
+from cilium_tpu.runtime.tracing import TRACER, Tracer
 
 __all__ = [
     "Loader",
@@ -17,4 +18,6 @@ __all__ = [
     "Metrics",
     "SpanStat",
     "METRICS",
+    "TRACER",
+    "Tracer",
 ]

@@ -21,6 +21,9 @@ shapes:
   GET    /v1/fqdn/cache     DNS cache dump
   GET    /v1/service        load-balancer services
   GET    /v1/metrics        Prometheus text exposition
+  GET    /v1/trace          flight-recorder spans (runtime/tracing.py);
+                            ?trace_id= filters, ?limit= bounds,
+                            ?format=chrome → Chrome trace-event JSON
   GET    /v1/debuginfo      full status dict
 
 The verdict/proxylib data path stays on the binary verdict-service
@@ -169,6 +172,25 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             if path == "/v1/metrics":
                 return self._send(200, METRICS.expose().encode(),
                                   content_type="text/plain; version=0.0.4")
+            if path == "/v1/trace":
+                from cilium_tpu.runtime.tracing import TRACER
+
+                tid = query.get("trace_id") or None
+                if query.get("format") == "chrome":
+                    return self._send(200,
+                                      TRACER.chrome_trace(trace_id=tid))
+                try:
+                    limit = int(query.get("limit", 0)) or None
+                except ValueError:
+                    return self._send(400, {"error": "limit must be "
+                                            "an integer"})
+                return self._send(200, {
+                    "enabled": TRACER.enabled,
+                    "sample_rate": TRACER.sample_rate,
+                    "dropped": TRACER.dropped,
+                    "trace_ids": TRACER.trace_ids(),
+                    "spans": TRACER.dump(trace_id=tid, limit=limit),
+                })
             if path == "/v1/debuginfo":
                 return self._send(200, agent.status())
             return self._send(404, {"error": f"no such resource {path}"})
@@ -517,6 +539,18 @@ class APIClient:
 
     def metrics(self) -> str:
         return self.request("GET", "/v1/metrics")[1]
+
+    def traces(self, trace_id: Optional[str] = None,
+               limit: Optional[int] = None, chrome: bool = False):
+        q = []
+        if trace_id:
+            q.append(f"trace_id={trace_id}")
+        if limit:
+            q.append(f"limit={int(limit)}")
+        if chrome:
+            q.append("format=chrome")
+        path = "/v1/trace" + ("?" + "&".join(q) if q else "")
+        return self.request("GET", path)[1]
 
     def debuginfo(self):
         return self.request("GET", "/v1/debuginfo")[1]

@@ -213,4 +213,11 @@ def maybe_fail(point: str) -> None:
     exc = plan.check(point)
     if exc is not None:
         METRICS.inc(FAULTS_INJECTED, labels={"point": point})
+        # a fired fault under an active flight-recorder trace becomes
+        # a span event — the trace shows WHICH request the fault hit
+        # (import here: the disarmed path must stay one global read)
+        from cilium_tpu.runtime.tracing import TRACER
+
+        TRACER.event("fault.injected", point=point,
+                     exc=type(exc).__name__)
         raise exc

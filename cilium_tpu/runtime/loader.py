@@ -24,6 +24,7 @@ from cilium_tpu.runtime.checkpoint import ArtifactCache, ruleset_fingerprint
 from cilium_tpu.runtime import faults
 from cilium_tpu.runtime.logging import get_logger, span as _log_span
 from cilium_tpu.runtime.metrics import LOADER_ROLLBACKS, METRICS, SpanStat
+from cilium_tpu.runtime.tracing import PHASE_HOST, TRACER
 
 LOG = get_logger("loader")
 
@@ -164,20 +165,28 @@ class Loader:
         propagates to the caller."""
         with self._lock:
             prev = (self._engine, self._revision, self.per_identity)
-        try:
-            return self._regenerate(per_identity, revision)
-        except Exception as e:
-            with self._lock:
-                self._engine, self._revision, self.per_identity = prev
-                self._fallback = None
-                self._fallback_revision = -1
-            METRICS.inc(LOADER_ROLLBACKS)
-            LOG.error("regeneration rolled back",
-                      extra={"fields": {
-                          "revision": revision,
-                          "serving_revision": prev[1],
-                          "error": f"{type(e).__name__}: {e}"}})
-            raise
+        # regeneration is its own ingress: a root trace per attempt, so
+        # compile/stage cost and rollbacks are attributable like any
+        # request (and the staged-revision log line carries the id)
+        with TRACER.trace("loader.regenerate", revision=revision):
+            try:
+                return self._regenerate(per_identity, revision)
+            except Exception as e:
+                with self._lock:
+                    self._engine, self._revision, self.per_identity = \
+                        prev
+                    self._fallback = None
+                    self._fallback_revision = -1
+                METRICS.inc(LOADER_ROLLBACKS)
+                TRACER.event("loader.rollback", revision=revision,
+                             serving_revision=prev[1],
+                             error=f"{type(e).__name__}: {e}")
+                LOG.error("regeneration rolled back",
+                          extra={"fields": {
+                              "revision": revision,
+                              "serving_revision": prev[1],
+                              "error": f"{type(e).__name__}: {e}"}})
+                raise
 
     def _regenerate(self, per_identity: Dict[int, MapState],
                     revision: int = 0):
@@ -244,7 +253,9 @@ class Loader:
         policy = self._cache.get(key)
         cached = policy is not None
         if policy is None:
-            with SpanStat("policy_compile") as span:
+            with SpanStat("policy_compile") as span, \
+                    TRACER.span("policy.compile", phase=PHASE_HOST,
+                                identities=len(per_identity)):
                 policy = CompiledPolicy.build(
                     per_identity, self.config.engine, revision=revision,
                     secret_lookup=secret_lookup,
@@ -254,7 +265,8 @@ class Loader:
             METRICS.observe("cilium_tpu_compile_seconds", span.seconds)
         with _log_span(LOG, "policy staged", revision=revision,
                        identities=len(per_identity), cache_hit=cached):
-            with SpanStat("policy_stage"):
+            with SpanStat("policy_stage"), \
+                    TRACER.span("policy.stage", cache_hit=cached):
                 engine = VerdictEngine(policy, device=self.device)
         return self._commit(engine, revision, per_identity, "tpu")
 

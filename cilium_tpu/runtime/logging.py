@@ -8,6 +8,11 @@ key/value fields. Ours layers the same shape over stdlib ``logging``:
 (`ts`, `level`, `subsys`, `msg`, plus any ``extra`` fields), which is
 what log collectors ingest and what `bugtool` bundles.
 
+When a flight-recorder trace is active (``runtime/tracing.py``
+contextvar), every record emitted under it carries ``trace_id`` — logs
+join traces and Hubble flow records on one id with zero per-call-site
+changes.
+
 Usage::
 
     log = get_logger("loader")
@@ -28,6 +33,14 @@ from typing import Optional
 
 ROOT = "cilium_tpu"
 
+
+def _current_trace_id() -> str:
+    # lazy import: logging is the package's lowest layer; pulling the
+    # tracer in at call time keeps import order unconstrained
+    from cilium_tpu.runtime.tracing import TRACER
+
+    return TRACER.current_trace_id()
+
 _LEVELS = {"debug": logging.DEBUG, "info": logging.INFO,
            "warning": logging.WARNING, "warn": logging.WARNING,
            "error": logging.ERROR, "critical": logging.CRITICAL,
@@ -45,6 +58,12 @@ class JSONLFormatter(logging.Formatter):
                               record.name.rsplit(".", 1)[-1]),
             "msg": record.getMessage(),
         }
+        # correlate with the flight recorder: a record emitted under an
+        # active trace context carries the trace id (contextvar read —
+        # formatters run synchronously on the emitting thread)
+        tid = _current_trace_id()
+        if tid:
+            out["trace_id"] = tid
         fields = getattr(record, "fields", None)
         if fields:
             for k, v in fields.items():

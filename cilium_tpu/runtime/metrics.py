@@ -5,13 +5,24 @@ Counter/gauge/histogram registry with Prometheus text exposition, plus
 ``pkg/spanstat`` — SURVEY.md §5.5). Key series mirror the reference's:
 ``policy_regeneration_time_stats_seconds`` → compile spans;
 ``drop_count_total`` / ``policy_l7_total`` → verdict counters.
+
+Histograms are FIXED-BUCKET (cumulative ``_bucket{le=...}`` series +
+``_count``/``_sum``), not sample lists: a long-running agent must hold
+constant memory per series. A small bounded reservoir of the most
+recent observations is retained per series so :meth:`Metrics.quantile`
+(benches, tests) still answers over the recent window. Exposition is
+valid Prometheus text format (``# HELP``/``# TYPE`` per family, label
+values escaped) — :func:`lint_exposition` is the scrape-lint the
+``make obs`` lane runs against the live registry.
 """
 
 from __future__ import annotations
 
+import bisect
+import re
 import threading
 import time
-from collections import defaultdict
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 # -- degraded-operation series (runtime/faults.py + the TPU→oracle
@@ -37,23 +48,82 @@ STREAM_RECONNECTS = "cilium_tpu_stream_reconnects_total"
 KVSTORE_WATCH_ERRORS = "cilium_tpu_kvstore_watch_errors_total"
 #: banked-DFA DNS batch failures degraded to the CPU regex path
 DNSPROXY_FALLBACKS = "cilium_tpu_dnsproxy_fallback_total"
+#: spans recorded by the flight recorder (runtime/tracing.py),
+#: labelled by phase — the aggregate face of per-request attribution
+TRACE_SPANS = "cilium_tpu_trace_spans_total"
+
+#: latency-shaped default boundaries (seconds; the Prometheus client
+#: defaults) — covers every ``*_seconds`` series we emit
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+#: count-shaped boundaries (batch sizes, record counts): pow2, matching
+#: the engine's pow2 padding buckets
+SIZE_BUCKETS: Tuple[float, ...] = tuple(
+    float(1 << i) for i in range(15))  # 1 .. 16384
+#: most recent observations retained per series for quantile()
+RESERVOIR = 1024
+
+
+class _Histogram:
+    """One series: cumulative fixed buckets + count/sum + a bounded
+    reservoir of recent samples (quantile's window)."""
+
+    __slots__ = ("buckets", "counts", "count", "sum", "reservoir")
+
+    def __init__(self, buckets: Tuple[float, ...]):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)  # +1: the +Inf bucket
+        self.count = 0
+        self.sum = 0.0
+        self.reservoir: deque = deque(maxlen=RESERVOIR)
+
+    def observe(self, value: float) -> None:
+        self.counts[bisect.bisect_left(self.buckets, value)] += 1
+        self.count += 1
+        self.sum += value
+        self.reservoir.append(value)
 
 
 class Metrics:
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._counters: Dict[Tuple[str, Tuple], float] = defaultdict(float)
+        self._counters: Dict[Tuple[str, Tuple], float] = {}
         self._gauges: Dict[Tuple[str, Tuple], float] = {}
-        self._histos: Dict[Tuple[str, Tuple], List[float]] = defaultdict(list)
+        self._histos: Dict[Tuple[str, Tuple], _Histogram] = {}
+        #: name → explicit bucket boundaries (else shape heuristic)
+        self._buckets: Dict[str, Tuple[float, ...]] = {}
+        self._help: Dict[str, str] = {}
 
     @staticmethod
     def _key(name: str, labels: Optional[Dict[str, str]]):
         return (name, tuple(sorted((labels or {}).items())))
 
+    def describe(self, name: str, help_text: str,
+                 buckets: Optional[Tuple[float, ...]] = None) -> None:
+        """Register HELP text (and, for histograms, explicit bucket
+        boundaries) for a metric family."""
+        with self._lock:
+            self._help[name] = help_text
+            if buckets is not None:
+                self._buckets[name] = tuple(sorted(float(b)
+                                                   for b in buckets))
+
+    def _buckets_for(self, name: str) -> Tuple[float, ...]:
+        explicit = self._buckets.get(name)
+        if explicit is not None:
+            return explicit
+        # shape heuristic: count-valued series get pow2 boundaries,
+        # everything else is latency-shaped seconds
+        if name.endswith(("_size", "_records", "_bytes")):
+            return SIZE_BUCKETS
+        return DEFAULT_BUCKETS
+
     def inc(self, name: str, value: float = 1.0,
             labels: Optional[Dict[str, str]] = None) -> None:
+        k = self._key(name, labels)
         with self._lock:
-            self._counters[self._key(name, labels)] += value
+            self._counters[k] = self._counters.get(k, 0.0) + value
 
     def set_gauge(self, name: str, value: float,
                   labels: Optional[Dict[str, str]] = None) -> None:
@@ -62,16 +132,43 @@ class Metrics:
 
     def observe(self, name: str, value: float,
                 labels: Optional[Dict[str, str]] = None) -> None:
+        k = self._key(name, labels)
         with self._lock:
-            self._histos[self._key(name, labels)].append(value)
+            h = self._histos.get(k)
+            if h is None:
+                h = self._histos[k] = _Histogram(self._buckets_for(name))
+            h.observe(value)
 
     def histo_sum(self, name: str,
                   labels: Optional[Dict[str, str]] = None) -> float:
-        """Locked sum of a histogram's samples (phase-attribution
+        """Locked cumulative sum of a histogram series (phase-attribution
         deltas and similar read-side consumers)."""
         with self._lock:
-            return float(sum(self._histos.get(
-                self._key(name, labels), ())))
+            h = self._histos.get(self._key(name, labels))
+            return float(h.sum) if h is not None else 0.0
+
+    def histo_count(self, name: str,
+                    labels: Optional[Dict[str, str]] = None) -> int:
+        """Cumulative observation count — monotone, so callers can use
+        it as a mark for :meth:`samples_since`."""
+        with self._lock:
+            h = self._histos.get(self._key(name, labels))
+            return int(h.count) if h is not None else 0
+
+    def samples_since(self, name: str, mark: int,
+                      labels: Optional[Dict[str, str]] = None
+                      ) -> List[float]:
+        """Observations recorded after ``mark`` (a prior
+        :meth:`histo_count`), served from the bounded reservoir —
+        truncated to the newest :data:`RESERVOIR` if more arrived."""
+        with self._lock:
+            h = self._histos.get(self._key(name, labels))
+            if h is None:
+                return []
+            newer = h.count - mark
+            if newer <= 0:
+                return []
+            return list(h.reservoir)[-min(newer, len(h.reservoir)):]
 
     def get(self, name: str, labels: Optional[Dict[str, str]] = None) -> float:
         with self._lock:
@@ -82,37 +179,192 @@ class Metrics:
 
     def quantile(self, name: str, q: float,
                  labels: Optional[Dict[str, str]] = None) -> float:
+        """Quantile over the series' recent-sample reservoir (the
+        bench/test face; dashboards use the bucket series)."""
         with self._lock:
-            vals = sorted(self._histos.get(self._key(name, labels), ()))
+            h = self._histos.get(self._key(name, labels))
+            vals = sorted(h.reservoir) if h is not None else []
         if not vals:
             return 0.0
         idx = min(len(vals) - 1, int(q * len(vals)))
         return vals[idx]
 
     def expose(self) -> str:
-        """Prometheus text format."""
-        out = []
+        """Valid Prometheus text format: one ``# HELP``/``# TYPE`` pair
+        per family, escaped label values, cumulative ``_bucket{le=...}``
+        series (ending ``+Inf``) plus ``_count``/``_sum`` per
+        histogram series."""
+        out: List[str] = []
         with self._lock:
-            for (name, labels), v in sorted(self._counters.items()):
-                out.append(f"{_fmt(name, labels)} {v}")
-            for (name, labels), v in sorted(self._gauges.items()):
-                out.append(f"{_fmt(name, labels)} {v}")
-            for (name, labels), vals in sorted(self._histos.items()):
-                if vals:
-                    out.append(f"{_fmt(name + '_count', labels)} {len(vals)}")
-                    out.append(f"{_fmt(name + '_sum', labels)} {sum(vals)}")
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            histos = sorted(self._histos.items(),
+                            key=lambda kv: kv[0])
+            help_texts = dict(self._help)
+
+        def _family(name: str, typ: str) -> None:
+            help_text = help_texts.get(
+                name, f"cilium_tpu {typ} {name}")
+            out.append(f"# HELP {name} {_escape_help(help_text)}")
+            out.append(f"# TYPE {name} {typ}")
+
+        last = None
+        for (name, labels), v in counters:
+            if name != last:
+                _family(name, "counter")
+                last = name
+            out.append(f"{_fmt(name, labels)} {_num(v)}")
+        last = None
+        for (name, labels), v in gauges:
+            if name != last:
+                _family(name, "gauge")
+                last = name
+            out.append(f"{_fmt(name, labels)} {_num(v)}")
+        last = None
+        for (name, labels), h in histos:
+            if name != last:
+                _family(name, "histogram")
+                last = name
+            cum = 0
+            for bound, n in zip(h.buckets, h.counts):
+                cum += n
+                out.append(_fmt(name + "_bucket",
+                                labels + (("le", _num(bound)),))
+                           + f" {cum}")
+            out.append(_fmt(name + "_bucket", labels + (("le", "+Inf"),))
+                       + f" {h.count}")
+            out.append(f"{_fmt(name + '_count', labels)} {h.count}")
+            out.append(f"{_fmt(name + '_sum', labels)} {_num(h.sum)}")
         return "\n".join(out) + "\n"
+
+
+def _num(v: float) -> str:
+    """Canonical number rendering: integers without a trailing .0 (the
+    Prometheus text convention for counts/bounds)."""
+    f = float(v)
+    return str(int(f)) if f.is_integer() and abs(f) < 1e15 else repr(f)
+
+
+def _escape_label(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _fmt(name: str, labels: Tuple) -> str:
     if not labels:
         return name
-    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in labels)
     return f"{name}{{{inner}}}"
+
+
+# -- scrape lint ------------------------------------------------------------
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{[^{}]*\})?"
+    r" (?P<value>[+-]?(?:\d+\.?\d*(?:[eE][+-]?\d+)?|Inf|NaN))"
+    r"(?: (?P<ts>[+-]?\d+))?$")
+_LABEL_RE = re.compile(
+    r'(?P<key>[a-zA-Z_][a-zA-Z0-9_]*)='
+    r'"(?P<val>(?:[^"\\\n]|\\["\\n])*)"')
+
+
+def lint_exposition(text: str) -> List[str]:
+    """Parse Prometheus text exposition line-by-line; return a list of
+    error strings (empty = clean). Checks: comment shape, sample-line
+    grammar, label quoting/escaping, TYPE declared before a family's
+    samples, histogram buckets cumulative and +Inf-terminated with
+    ``_count`` equal to the +Inf bucket."""
+    errors: List[str] = []
+    typed: Dict[str, str] = {}
+    buckets_seen: Dict[Tuple[str, Tuple], List[Tuple[str, int]]] = {}
+    counts_seen: Dict[Tuple[str, Tuple], int] = {}
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(" ", 3)
+            if len(parts) < 4 or parts[1] not in ("HELP", "TYPE") \
+                    or not _NAME_RE.fullmatch(parts[2]):
+                errors.append(f"line {i}: malformed comment: {line!r}")
+            elif parts[1] == "TYPE":
+                if parts[3] not in ("counter", "gauge", "histogram",
+                                    "summary", "untyped"):
+                    errors.append(f"line {i}: unknown type {parts[3]!r}")
+                typed[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            errors.append(f"line {i}: malformed sample: {line!r}")
+            continue
+        name = m.group("name")
+        raw_labels = m.group("labels")
+        labels: List[Tuple[str, str]] = []
+        if raw_labels:
+            body = raw_labels[1:-1]
+            consumed = 0
+            for lm in _LABEL_RE.finditer(body):
+                labels.append((lm.group("key"), lm.group("val")))
+                consumed = lm.end()
+                if consumed < len(body) and body[consumed] == ",":
+                    consumed += 1
+            if consumed != len(body):
+                errors.append(
+                    f"line {i}: malformed labels: {raw_labels!r}")
+        family = name
+        for suffix in ("_bucket", "_count", "_sum"):
+            base = name[:-len(suffix)] if name.endswith(suffix) else None
+            if base and typed.get(base) == "histogram":
+                family = base
+                break
+        if family not in typed:
+            errors.append(f"line {i}: sample {name!r} has no # TYPE")
+            continue
+        if typed[family] == "histogram" and name == family + "_bucket":
+            le = dict(labels).get("le")
+            if le is None:
+                errors.append(f"line {i}: bucket without le label")
+                continue
+            key = (family, tuple(sorted(
+                (k, v) for k, v in labels if k != "le")))
+            buckets_seen.setdefault(key, []).append(
+                (le, int(float(m.group("value")))))
+        if typed.get(family) == "histogram" and name == family + "_count":
+            counts_seen[(family, tuple(sorted(labels)))] = \
+                int(float(m.group("value")))
+    for (family, labels), series in buckets_seen.items():
+        values = [v for _, v in series]
+        if values != sorted(values):
+            errors.append(
+                f"{family}{dict(labels)}: buckets not cumulative")
+        if series[-1][0] != "+Inf":
+            errors.append(f"{family}{dict(labels)}: missing +Inf bucket")
+        else:
+            total = counts_seen.get((family, labels))
+            if total is not None and total != series[-1][1]:
+                errors.append(
+                    f"{family}{dict(labels)}: _count {total} != "
+                    f"+Inf bucket {series[-1][1]}")
+    return errors
 
 
 #: process-global registry (like the reference's default registry)
 METRICS = Metrics()
+METRICS.describe("cilium_tpu_microbatch_size",
+                 "records per MicroBatcher flush", buckets=SIZE_BUCKETS)
+METRICS.describe("cilium_tpu_microbatch_seconds",
+                 "MicroBatcher flush wall seconds")
+METRICS.describe("cilium_tpu_span_seconds",
+                 "SpanStat duration spans, labelled by span")
+METRICS.describe(BREAKER_STATE,
+                 "0=closed (device), 1=open (oracle), 2=half-open")
+METRICS.describe(TRACE_SPANS,
+                 "flight-recorder spans recorded, by phase")
 
 
 class SpanStat:

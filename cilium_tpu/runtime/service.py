@@ -56,6 +56,11 @@ from cilium_tpu.runtime.metrics import (
     BREAKER_TRIPS,
     METRICS,
 )
+from cilium_tpu.runtime.tracing import (
+    PHASE_FALLBACK,
+    PHASE_QUEUE,
+    TRACER,
+)
 
 LOG = get_logger("service")
 
@@ -221,6 +226,8 @@ class ResilientVerdictor:
     def on_device_failure(self, exc: BaseException) -> None:
         if self.enabled:
             self.breaker.record_failure()
+        TRACER.event("device.failure",
+                     error=f"{type(exc).__name__}: {exc}")
         LOG.warning("device verdict lane failed; serving via oracle",
                     extra={"fields": {
                         "error": f"{type(exc).__name__}: {exc}"}})
@@ -229,9 +236,11 @@ class ResilientVerdictor:
                          outputs=None):
         """Oracle lane, with the fallback counter."""
         METRICS.inc(BREAKER_FALLBACK_VERDICTS, len(flows))
-        return verdict_outputs_padded(
-            self.loader.fallback_engine, flows,
-            authed_pairs=self._pairs(authed_pairs), outputs=outputs)
+        with TRACER.span("oracle.verdict", phase=PHASE_FALLBACK,
+                         records=len(flows)):
+            return verdict_outputs_padded(
+                self.loader.fallback_engine, flows,
+                authed_pairs=self._pairs(authed_pairs), outputs=outputs)
 
     # -- the verdict entry points ---------------------------------------
     def outputs(self, flows: Sequence[Flow], authed_pairs=None,
@@ -245,9 +254,18 @@ class ResilientVerdictor:
             raise RuntimeError("no policy loaded")
         pairs = self._pairs(authed_pairs)
         if not self.enabled or not self._device_backed(engine):
-            return verdict_outputs_padded(engine, flows,
-                                          authed_pairs=pairs,
-                                          outputs=outputs)
+            if self._device_backed(engine):
+                return verdict_outputs_padded(engine, flows,
+                                              authed_pairs=pairs,
+                                              outputs=outputs)
+            # active engine IS the oracle (gate off): attribute the
+            # whole evaluation to the fallback phase — there is no
+            # host/device split to show
+            with TRACER.span("oracle.verdict", phase=PHASE_FALLBACK,
+                             records=len(flows)):
+                return verdict_outputs_padded(engine, flows,
+                                              authed_pairs=pairs,
+                                              outputs=outputs)
         if self.breaker.allow_primary():
             try:
                 out = verdict_outputs_padded(engine, flows,
@@ -257,6 +275,9 @@ class ResilientVerdictor:
                 return out
             except Exception as e:  # noqa: BLE001 — degrade, don't die
                 self.on_device_failure(e)
+        else:
+            TRACER.event("breaker.rerouted",
+                         state=self.breaker.state)
         return self.fallback_outputs(flows, authed_pairs=pairs,
                                      outputs=outputs)
 
@@ -300,10 +321,14 @@ class MicroBatcher:
     def check(self, flow: Flow, timeout: float = 5.0) -> int:
         ev = threading.Event()
         box: List[int] = []
+        # the caller's trace context crosses the thread handoff WITH
+        # the entry — the drain worker attributes this request's
+        # queue-wait and fans the batch's phase spans back to it
+        ctx = TRACER.current()
         with self._cond:
             if self._closed:
                 return int(Verdict.ERROR)
-            self._pending.append((flow, ev, box, time.monotonic()))
+            self._pending.append((flow, ev, box, time.monotonic(), ctx))
             if not self._workers:
                 self._workers = [
                     threading.Thread(target=self._drain, daemon=True)
@@ -321,7 +346,7 @@ class MicroBatcher:
             self._closed = True
             pending, self._pending = self._pending, []
             self._cond.notify_all()
-        for _flow, ev, box, _t in pending:
+        for _flow, ev, box, _t, _ctx in pending:
             box.append(int(Verdict.ERROR))
             ev.set()
 
@@ -361,15 +386,29 @@ class MicroBatcher:
 
     def _run_batch(self, pending) -> None:
         flows = [p[0] for p in pending]
+        # per-request queue-wait attribution: monotonic deltas anchored
+        # to wall time (one wall read per batch, not per request)
+        t_drain = time.monotonic()
+        wall = time.time()
+        for _flow, _ev, _box, t_enq, ctx in pending:
+            if ctx is not None:
+                waited = t_drain - t_enq
+                TRACER.add_span(ctx, "batch.queue", PHASE_QUEUE,
+                                wall - waited, waited)
+        # the batch dispatch runs under the GROUP of sampled member
+        # contexts: each request's trace shows the batch's host/device
+        # (or fallback) spans — its honest share of where time went
+        group = TRACER.group([p[4] for p in pending])
         t0 = time.perf_counter()
         try:
-            verdicts = self.verdict_fn(flows)
+            with TRACER.activate(group):
+                verdicts = self.verdict_fn(flows)
         except Exception:
             verdicts = [int(Verdict.ERROR)] * len(flows)
         METRICS.observe("cilium_tpu_microbatch_seconds",
                         time.perf_counter() - t0)
         METRICS.observe("cilium_tpu_microbatch_size", len(flows))
-        for (flow, ev, box, _t), v in zip(pending, verdicts):
+        for (flow, ev, box, _t, _ctx), v in zip(pending, verdicts):
             box.append(int(v))
             ev.set()
 
@@ -555,7 +594,11 @@ class VerdictService:
         if self.loader.engine is None:
             send_msg(sock, {"error": "no policy loaded"})
             return
-        send_msg(sock, {"ok": True, "revision": self.loader.revision})
+        # "trace": this server accepts KIND_CHUNK_TRACED frames (the
+        # flight-recorder id prefix) — clients only send them when
+        # they see this, so old peers interoperate unchanged
+        send_msg(sock, {"ok": True, "revision": self.loader.revision,
+                        "trace": True})
         StreamSession(
             self.loader, sock,
             widths=req.get("widths") or None,
@@ -566,7 +609,17 @@ class VerdictService:
 
     # -- request handling -------------------------------------------------
     def handle(self, req: Dict) -> Dict:
+        op = req.get("op")
         try:
+            if op in ("check", "verdict"):
+                # verdict-path ingress: one trace per request, id
+                # returned to the caller so client-side latency joins
+                # the server-side phase spans
+                with TRACER.trace(f"service.{op}") as ctx:
+                    resp = self._handle(req)
+                    if ctx is not None and "error" not in resp:
+                        resp.setdefault("trace_id", ctx.trace_id)
+                    return resp
             return self._handle(req)
         except Exception as e:  # malformed fields must not kill the conn
             return {"error": f"{type(e).__name__}: {e}"}

@@ -64,6 +64,14 @@ from cilium_tpu.ingest.binary import (
 )
 from cilium_tpu.runtime import faults
 from cilium_tpu.runtime.metrics import METRICS, STREAM_RECONNECTS
+from cilium_tpu.runtime.tracing import (
+    PHASE_DEVICE,
+    PHASE_FALLBACK,
+    PHASE_HOST,
+    PHASE_QUEUE,
+    TRACE_ID_CHARS,
+    TRACER,
+)
 
 #: fires at the server's per-chunk dispatch (a fault fails ONE seq —
 #: the per-chunk degradation contract)
@@ -79,6 +87,12 @@ FRAME_HEADER = struct.Struct("<IIB")
 KIND_CHUNK = 0
 KIND_END = 1
 KIND_ERROR = 2
+#: a capture chunk whose payload is prefixed by a 16-hex-char trace id
+#: (runtime/tracing.py): the flight-recorder context crossing the wire.
+#: OPTIONAL both ways — servers advertise ``"trace": true`` in the
+#: stream_start ack and clients only send this kind to peers that do,
+#: so old clients and old servers interoperate unchanged.
+KIND_CHUNK_TRACED = 3
 
 #: hard cap on one frame's payload — a corrupt length prefix must not
 #: make the server try to buffer gigabytes
@@ -172,7 +186,9 @@ class StreamSession:
                     seq, kind, payload = recv_frame(self.sock)
                 except (ConnectionError, OSError):
                     break
-                self._in.put((seq, kind, payload))
+                # receive stamp: the worker attributes reader-queue
+                # dwell as the chunk's queue-wait phase
+                self._in.put((seq, kind, payload, time.monotonic()))
                 if kind == KIND_END:
                     break
         finally:
@@ -196,7 +212,9 @@ class StreamSession:
         flight (130 ms/chunk serialized → ~25 ms/chunk measured with
         5 in flight)."""
         faults.maybe_fail(FRAME_SERVER_POINT)
-        rec, l7, offsets, blob, gen = capture_from_bytes(payload)
+        with TRACER.span("stream.parse", phase=PHASE_HOST,
+                         bytes=len(payload)):
+            rec, l7, offsets, blob, gen = capture_from_bytes(payload)
         n = len(rec)
         if n == 0:
             return 0, None
@@ -214,9 +232,12 @@ class StreamSession:
             # stream clients work identically under either gate
             from cilium_tpu.ingest.binary import records_to_flows_l7
 
-            flows = records_to_flows_l7(rec, l7, offsets, blob, gen=gen)
-            out = engine.verdict_flows(flows, authed_pairs=pairs)
-            return n, np.asarray(out["verdict"])
+            with TRACER.span("oracle.verdict", phase=PHASE_FALLBACK,
+                             records=n):
+                flows = records_to_flows_l7(rec, l7, offsets, blob,
+                                            gen=gen)
+                out = engine.verdict_flows(flows, authed_pairs=pairs)
+                return n, np.asarray(out["verdict"])
         vd = self.verdictor
         if vd is not None and not vd.allow_device(engine):
             # breaker open: the whole service is in degraded mode —
@@ -267,29 +288,47 @@ class StreamSession:
             if item is None:
                 self._out.put(None)
                 return
-            seq, kind, payload = item
+            seq, kind, payload, t_recv = item
             if kind == KIND_END:
-                self._out.put((seq, KIND_END, 0, None))
+                self._out.put((seq, KIND_END, 0, None, None))
                 self._out.put(None)
                 return
+            ctx = None
+            if kind == KIND_CHUNK_TRACED:
+                # adopt the client's trace id (the CLIENT sampled;
+                # adoption bypasses the local sampler) and split the
+                # id prefix off the capture image
+                tid = payload[:TRACE_ID_CHARS].decode("ascii", "replace")
+                payload = payload[TRACE_ID_CHARS:]
+                ctx = TRACER.start("stream.chunk", trace_id=tid,
+                                   seq=seq)
+                kind = KIND_CHUNK
             if kind != KIND_CHUNK:
                 self._out.put((seq, KIND_ERROR, 0,
-                               f"unknown frame kind {kind}"))
+                               f"unknown frame kind {kind}", None))
                 continue
+            if ctx is not None:
+                waited = time.monotonic() - t_recv
+                TRACER.add_span(ctx, "stream.queue", PHASE_QUEUE,
+                                time.time() - waited, waited)
             try:
-                n, dev = self._dispatch_chunk(payload)
+                with TRACER.activate(ctx):
+                    n, dev = self._dispatch_chunk(payload)
             except Exception as e:  # noqa: BLE001 — fail the SEQ only
+                TRACER.event("stream.chunk_error", ctx=ctx,
+                             error=f"{type(e).__name__}: {e}")
+                TRACER.finish(ctx)
                 self._out.put((seq, KIND_ERROR, 0,
-                               f"{type(e).__name__}: {e}"))
+                               f"{type(e).__name__}: {e}", None))
                 continue
-            self._out.put((seq, KIND_CHUNK, n, dev))
+            self._out.put((seq, KIND_CHUNK, n, dev, ctx))
 
     def _write(self) -> None:
         while True:
             item = self._out.get()
             if item is None:
                 return
-            seq, kind, n, dev = item
+            seq, kind, n, dev, ctx = item
             try:
                 if kind == KIND_END:
                     send_frame(self.sock, seq, KIND_END)
@@ -301,7 +340,12 @@ class StreamSession:
                 if n == 0:
                     send_frame(self.sock, seq, KIND_CHUNK)
                     continue
-                verdicts = np.asarray(dev)[:n].astype(np.uint8)
+                # the blocking wait for an async dispatch is genuine
+                # device time — attributed where it is PAID (here),
+                # not where the dispatch was issued
+                with TRACER.span("stream.readback", phase=PHASE_DEVICE,
+                                 ctx=ctx, records=n):
+                    verdicts = np.asarray(dev)[:n].astype(np.uint8)
                 METRICS.inc("cilium_tpu_stream_verdicts_total", n)
                 send_frame(self.sock, seq, KIND_CHUNK,
                            verdicts.tobytes())
@@ -309,6 +353,8 @@ class StreamSession:
                 # client went away: drain silently so the worker can
                 # finish and the session unwinds
                 continue
+            finally:
+                TRACER.finish(ctx)
 
 
 class StreamClient:
@@ -350,8 +396,12 @@ class StreamClient:
         self._cond = threading.Condition(self._lock)
         self._send_lock = threading.Lock()
         self._results: Dict[int, object] = {}
-        #: seq → chunk image, retained until acked (reconnect mode)
-        self._unacked: Dict[int, bytes] = {}
+        #: seq → (trace_id, chunk image), retained until acked
+        #: (reconnect mode) — the trace id rides the resume so a chunk
+        #: re-sent across a drop keeps its identity end to end
+        self._unacked: Dict[int, Tuple[str, bytes]] = {}
+        #: did the server's stream_start ack advertise trace support?
+        self._trace_peer = False
         self._finish_seq: Optional[int] = None
         self._done = False
         self._connect()
@@ -373,6 +423,9 @@ class StreamClient:
             sock.close()
             raise RuntimeError(f"stream_start refused: {ack}")
         self.revision = ack.get("revision")
+        # only send traced frames to servers that understand them —
+        # absent on old peers, so the field degrades to plain chunks
+        self._trace_peer = bool(ack.get("trace"))
         self.sock = sock
 
     def _try_reconnect(self) -> bool:
@@ -396,8 +449,9 @@ class StreamClient:
                 finish_seq = self._finish_seq
             try:
                 with self._send_lock:
-                    for seq, image in pending:
-                        send_frame(self.sock, seq, KIND_CHUNK, image)
+                    for seq, (tid, image) in pending:
+                        send_frame(self.sock, seq, *self._chunk_frame(
+                            tid, image))
                     if finish_seq is not None:
                         # finish() already ran: re-send end-of-stream
                         # so the resumed session still end-acks
@@ -446,15 +500,30 @@ class StreamClient:
                 if kind == KIND_END:
                     return
 
-    def send_image(self, image: bytes) -> int:
+    def _chunk_frame(self, trace_id: str,
+                     image: bytes) -> Tuple[int, bytes]:
+        """(kind, payload) for one chunk: traced when the peer
+        advertised support and a well-formed id is present."""
+        if self._trace_peer and trace_id \
+                and len(trace_id) == TRACE_ID_CHARS:
+            return KIND_CHUNK_TRACED, trace_id.encode("ascii") + image
+        return KIND_CHUNK, image
+
+    def send_image(self, image: bytes,
+                   trace_id: Optional[str] = None) -> int:
+        """``trace_id=None`` picks up the ambient flight-recorder
+        context (if any); pass ``""`` to force an untraced frame."""
+        if trace_id is None:
+            trace_id = TRACER.current_trace_id()
         with self._lock:
             seq = self._seq
             self._seq += 1
             if self.reconnect:
-                self._unacked[seq] = image
+                self._unacked[seq] = (trace_id, image)
         try:
+            kind, payload = self._chunk_frame(trace_id, image)
             with self._send_lock:
-                send_frame(self.sock, seq, KIND_CHUNK, image)
+                send_frame(self.sock, seq, kind, payload)
         except (OSError, ConnectionError):
             if not self.reconnect:
                 raise
@@ -462,8 +531,10 @@ class StreamClient:
             # re-sends it once the session is back
         return seq
 
-    def send_flows(self, flows: Sequence) -> int:
-        return self.send_image(capture_to_bytes(flows))
+    def send_flows(self, flows: Sequence,
+                   trace_id: Optional[str] = None) -> int:
+        return self.send_image(capture_to_bytes(flows),
+                               trace_id=trace_id)
 
     def result(self, seq: int) -> np.ndarray:
         """Block for one chunk's verdicts (raises if the server failed

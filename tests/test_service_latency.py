@@ -142,8 +142,8 @@ def test_concurrent_checks_coalesce_and_verdict_correctly(tmp_path):
     service = VerdictService(loader, str(tmp_path / "s.sock"),
                              deadline_ms=20.0, batch_max=64)
     service.start()
-    key = ("cilium_tpu_microbatch_size", ())
-    before = len(METRICS._histos.get(key, ()))
+    key = "cilium_tpu_microbatch_size"
+    before = METRICS.histo_count(key)
     try:
         results = {}
 
@@ -163,7 +163,7 @@ def test_concurrent_checks_coalesce_and_verdict_correctly(tmp_path):
         for i in range(16):
             want = Verdict.FORWARDED if i % 2 == 0 else Verdict.DROPPED
             assert results[i] == int(want), i
-        sizes = METRICS._histos.get(key, ())[before:]
+        sizes = METRICS.samples_since(key, before)
         assert sum(sizes) == 16
         assert len(sizes) < 16  # coalescing actually happened
     finally:
