@@ -3,8 +3,8 @@
 
 PY ?= python
 
-.PHONY: test shim determinism dryrun chaos obs bench bench-all bench-e2e \
-        bench-service bench-regen bench-sp bench-stream \
+.PHONY: test shim lint determinism dryrun chaos obs bench bench-all \
+        bench-e2e bench-service bench-regen bench-sp bench-stream \
         bench-multichip bench-watch check
 
 test:            ## full suite (CPU, virtual 8-device mesh via conftest)
@@ -12,6 +12,13 @@ test:            ## full suite (CPU, virtual 8-device mesh via conftest)
 
 shim:            ## build the C++ proxylib-ABI shim
 	$(MAKE) -C shim
+
+# lint: ctlint codebase-aware static analysis (cilium_tpu/analysis —
+# jit-purity, lock-order, registry consistency, swallowed exceptions,
+# unused imports). Fails on any non-allowlisted finding; CTLINT.json
+# is the CI report artifact. Rule catalog: docs/ANALYSIS.md
+lint:            ## ctlint static-analysis gate
+	$(PY) -m cilium_tpu.analysis --format text --out CTLINT.json
 
 determinism:     ## deterministic-compile + debug_nans sanitizer lane
 	$(PY) -m pytest tests/test_determinism.py -q
@@ -73,4 +80,4 @@ bench-multichip: ## DP/DPxEP/TP scaling on the virtual 8-device mesh
 bench-watch:     ## probe until the tunnel answers, then capture the sweep
 	$(PY) bench.py --watch r04
 
-check: shim test determinism dryrun obs   ## the full CI gate
+check: shim lint test determinism dryrun obs   ## the full CI gate

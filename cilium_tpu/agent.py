@@ -25,7 +25,7 @@ from cilium_tpu.endpoint import EndpointManager
 from cilium_tpu.fqdn import DNSCache, DNSProxy, NameManager
 from cilium_tpu.health import HealthChecker
 from cilium_tpu.hubble import FlowMetrics, Observer, annotate_flows
-from cilium_tpu.ipam import NodeAllocator
+from cilium_tpu.ipam import NodeAllocator, PoolExhausted
 from cilium_tpu.ipcache import IPCache
 from cilium_tpu.loadbalancer import ServiceManager
 from cilium_tpu.monitor import AggregationLevel, MonitorAgent
@@ -271,7 +271,10 @@ class Agent:
                     self.ipcache.upsert(f"{ep.ipv4}/32", ep.identity)
                     try:  # IPAM re-adopts restored addresses (§5.4)
                         self.ipam.allocate_ip(ep.ipv4)
-                    except Exception:
+                    except (ValueError, PoolExhausted):
+                        # outside the re-carved node CIDR, or already
+                        # taken: the ipam audit gauge surfaces it —
+                        # restore must not abort over one address
                         pass
         if self.state_dir:
             dns_path = os.path.join(self.state_dir, "dnscache.json")

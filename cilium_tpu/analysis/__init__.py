@@ -1,0 +1,76 @@
+"""ctlint — codebase-aware static analysis for cilium-tpu.
+
+Zero-dependency (stdlib ``ast``) rule framework plus a rule set
+tailored to this codebase's unwritten contracts: jit purity, lock
+order, and the string registries (metric families, fault points,
+stream frame kinds). ``make lint`` runs it as part of ``make check``;
+``cilium-tpu lint`` and ``python -m cilium_tpu.analysis`` are the CLI
+faces. Rule catalog and allowlisting: docs/ANALYSIS.md.
+"""
+
+from cilium_tpu.analysis.core import (
+    Finding,
+    ProjectIndex,
+    RULES,
+    render_json,
+    render_text,
+    run,
+)
+
+__all__ = ["Finding", "ProjectIndex", "RULES", "render_json",
+           "render_text", "run", "run_cli"]
+
+
+def run_cli(argv=None) -> int:
+    """The `cilium-tpu lint` / `python -m cilium_tpu.analysis` driver.
+    Exit 1 on any non-allowlisted finding."""
+    import argparse
+    import os
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="cilium-tpu lint",
+        description="codebase-aware static analysis "
+                    "(docs/ANALYSIS.md)")
+    ap.add_argument("targets", nargs="*", default=(),
+                    help="repo-relative files/dirs "
+                         "(default: cilium_tpu)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: the package's parent)")
+    ap.add_argument("--format", choices=["text", "json"],
+                    default="text")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run "
+                         "(default: all)")
+    ap.add_argument("--out", default=None,
+                    help="also write a JSON report here (the CI "
+                         "artifact)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, doc in sorted(RULES.items()):
+            print(f"{rule}: {doc}")
+        return 0
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    if rules:
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            print(f"error: unknown rule(s) {unknown} "
+                  f"(--list-rules)", file=sys.stderr)
+            return 2
+    findings, suppressed = run(
+        root, targets=tuple(args.targets) or ("cilium_tpu",),
+        rules=rules)
+    if args.out:
+        with open(args.out, "w") as fp:
+            fp.write(render_json(findings, suppressed))
+    if args.format == "json":
+        print(render_json(findings, suppressed))
+    else:
+        print(render_text(findings, suppressed))
+    return 1 if findings else 0

@@ -1,0 +1,8 @@
+"""``python -m cilium_tpu.analysis`` — the make-lint entry point."""
+
+import sys
+
+from cilium_tpu.analysis import run_cli
+
+if __name__ == "__main__":
+    sys.exit(run_cli())

@@ -1,0 +1,245 @@
+"""ctlint core: findings, the disable-comment allowlist, and the
+project index every rule runs against.
+
+The framework's correctness rests on invariants no unit test
+enumerates — jitted kernels must stay pure, the threaded runtime must
+acquire locks in one order, string registries (metric families, fault
+points, stream frame kinds) must agree across producer and consumer
+sites. ctlint machine-checks those contracts from the stdlib ``ast``
+alone (zero dependencies — the lane must run in any environment that
+can import the package), the same way Hyperflex's compiler enforces
+the pattern↔kernel contract rather than trusting it (PAPERS.md).
+
+Allowlisting: an INTENTIONAL violation carries an inline
+
+    # ctlint: disable=rule-id[,rule-id]  # why it is safe
+
+on the finding's line, or on a comment-only line directly above it.
+A disable with no justification text after the rule list is itself a
+finding (``bare-disable``) — the allowlist is an audit trail, not an
+off switch.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+#: repo-relative package directory ctlint analyzes by default
+DEFAULT_TARGET = "cilium_tpu"
+
+_DISABLE_RE = re.compile(
+    r"#\s*ctlint:\s*disable=(?P<rules>[A-Za-z0-9_,\- ]+?)"
+    r"(?:\s*#\s*(?P<why>.*))?$")
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation, anchored to a file:line."""
+
+    path: str      # repo-relative
+    line: int      # 1-based
+    rule: str      # stable rule id (docs/ANALYSIS.md catalog)
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> Dict:
+        return {"path": self.path, "line": self.line,
+                "rule": self.rule, "message": self.message}
+
+
+class SourceFile:
+    """One parsed module: source, AST, and its disable allowlist."""
+
+    def __init__(self, path: str, module: str, source: str):
+        self.path = path          # repo-relative
+        self.module = module      # dotted module name
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+        #: line (1-based) → set of disabled rule ids on that line
+        self.disables: Dict[int, set] = {}
+        #: disable comments with no justification → bare-disable finding
+        self.bare_disables: List[int] = []
+        self._scan_disables()
+
+    def _scan_disables(self) -> None:
+        for i, text in enumerate(self.lines, 1):
+            m = _DISABLE_RE.search(text)
+            if m is None:
+                continue
+            rules = {r.strip() for r in m.group("rules").split(",")
+                     if r.strip()}
+            if not (m.group("why") or "").strip():
+                self.bare_disables.append(i)
+            self.disables.setdefault(i, set()).update(rules)
+            # a comment-only line covers the next line of code, so a
+            # long statement can carry its allowlist above itself
+            if text[:m.start()].strip() == "":
+                self.disables.setdefault(i + 1, set()).update(rules)
+
+    def disabled(self, line: int, rule: str) -> bool:
+        return rule in self.disables.get(line, ())
+
+
+class ProjectIndex:
+    """Every analyzed module, parsed once and shared by all rules."""
+
+    def __init__(self, files: Dict[str, SourceFile]):
+        #: dotted module name → SourceFile
+        self.files = files
+        self.by_path = {f.path: f for f in files.values()}
+
+    @classmethod
+    def from_tree(cls, root: str,
+                  targets: Sequence[str] = (DEFAULT_TARGET,)
+                  ) -> Tuple["ProjectIndex", List[Finding]]:
+        """Index ``targets`` (repo-relative dirs/files) under ``root``.
+        Unparseable files become findings, not crashes — a linter that
+        dies on a syntax error hides every other finding."""
+        sources: Dict[str, str] = {}
+        for target in targets:
+            full = os.path.join(root, target)
+            if os.path.isfile(full):
+                sources[target] = _read(full)
+                continue
+            for dirpath, _dirnames, filenames in sorted(os.walk(full)):
+                for name in sorted(filenames):
+                    if not name.endswith(".py"):
+                        continue
+                    path = os.path.join(dirpath, name)
+                    rel = os.path.relpath(path, root)
+                    sources[rel] = _read(path)
+        return cls.from_sources(sources)
+
+    @classmethod
+    def from_sources(cls, sources: Dict[str, str]
+                     ) -> Tuple["ProjectIndex", List[Finding]]:
+        """Build from ``{repo-relative path: source}`` — the test
+        corpus face: rules run against in-memory snippets exactly as
+        they run against the tree."""
+        files: Dict[str, SourceFile] = {}
+        errors: List[Finding] = []
+        for rel, source in sorted(sources.items()):
+            module = _module_name(rel)
+            try:
+                files[module] = SourceFile(rel, module, source)
+            except SyntaxError as e:
+                errors.append(Finding(rel, e.lineno or 1, "parse-error",
+                                      f"cannot parse: {e.msg}"))
+        return cls(files), errors
+
+    def get(self, module: str) -> Optional[SourceFile]:
+        return self.files.get(module)
+
+
+def _read(path: str) -> str:
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def _module_name(rel_path: str) -> str:
+    mod = rel_path[:-3] if rel_path.endswith(".py") else rel_path
+    mod = mod.replace(os.sep, ".").replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+# -- rule registry ----------------------------------------------------------
+
+#: rule id → one-line description (the docs/ANALYSIS.md catalog source)
+RULES: Dict[str, str] = {
+    "jit-purity": "no host effects (clock, RNG, I/O, locks, host "
+                  "syncs, traced-value branching) reachable from a "
+                  "jitted/pallas entry point",
+    "lock-order": "the static lock-acquisition graph of the threaded "
+                  "runtime has no cycles and no nested re-acquire of "
+                  "a non-reentrant lock",
+    "metric-registry": "every metric name is declared once in "
+                       "runtime/metrics.py, Prometheus-legal, and "
+                       "used with exactly one instrument kind",
+    "fault-registry": "every faults.maybe_fail seam names a "
+                      "register_point'd point; every point has a seam",
+    "frame-kind": "every KIND_* stream frame constant is handled in "
+                  "both server and client dispatch",
+    "swallowed-exception": "no bare except, and no except "
+                           "Exception whose body only passes",
+    "unused-import": "no unused module-level imports (outside "
+                     "__init__ re-export surfaces)",
+    "bare-disable": "every ctlint disable comment carries a "
+                    "justification",
+    "parse-error": "every analyzed file parses",
+}
+
+#: checker callables; each may emit findings for several rule ids
+CHECKERS: List[Callable[[ProjectIndex], List[Finding]]] = []
+
+
+def checker(fn: Callable[[ProjectIndex], List[Finding]]):
+    CHECKERS.append(fn)
+    return fn
+
+
+def _bare_disable_findings(index: ProjectIndex) -> List[Finding]:
+    out = []
+    for f in index.files.values():
+        for line in f.bare_disables:
+            out.append(Finding(
+                f.path, line, "bare-disable",
+                "ctlint disable without a justification comment "
+                "(write `# ctlint: disable=RULE  # why`)"))
+    return out
+
+
+def run(root: str, targets: Sequence[str] = (DEFAULT_TARGET,),
+        rules: Optional[Sequence[str]] = None
+        ) -> Tuple[List[Finding], int]:
+    """Run all checkers; returns (active findings, suppressed count).
+    ``rules`` filters to a subset of rule ids."""
+    # rule modules register their checkers on import
+    from cilium_tpu.analysis import (  # noqa: F401
+        exceptions,
+        imports,
+        locks,
+        purity,
+        registry,
+    )
+
+    index, findings = ProjectIndex.from_tree(root, targets)
+    for check in CHECKERS:
+        findings.extend(check(index))
+    findings.extend(_bare_disable_findings(index))
+    if rules:
+        wanted = set(rules)
+        findings = [f for f in findings if f.rule in wanted]
+    active: List[Finding] = []
+    suppressed = 0
+    for f in sorted(set(findings)):
+        sf = index.by_path.get(f.path)
+        if sf is not None and sf.disabled(f.line, f.rule):
+            suppressed += 1
+            continue
+        active.append(f)
+    return active, suppressed
+
+
+def render_text(findings: Sequence[Finding], suppressed: int) -> str:
+    lines = [f.format() for f in findings]
+    lines.append(f"ctlint: {len(findings)} finding(s), "
+                 f"{suppressed} allowlisted")
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding], suppressed: int) -> str:
+    return json.dumps({
+        "findings": [f.as_dict() for f in findings],
+        "count": len(findings),
+        "suppressed": suppressed,
+    }, indent=2)

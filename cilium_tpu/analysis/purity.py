@@ -1,0 +1,194 @@
+"""jit-purity: nothing host-effectful reachable from a jitted kernel.
+
+A jitted function runs at TRACE time: a ``time.time()`` inside it
+stamps the compile, not the request; a ``random.random()`` bakes one
+draw into the compiled artifact; a lock acquisition can deadlock the
+trace under the loader's swap lock; ``np.asarray``/``.item()`` force a
+blocking device sync in the middle of what must stay an async
+dispatch; a Python ``if`` over a traced value either fails to trace or
+silently specializes. None of these fail a unit test on CPU — the
+verdicts stay right — so the contract is machine-checked here instead.
+
+Entry points (detected, not listed): ``@jax.jit`` /
+``functools.partial(jax.jit, ...)`` decorators, ``jax.jit(fn)`` /
+``pl.pallas_call(kernel, ...)`` / ``shard_map(fn, ...)`` call forms.
+Reachability follows plain calls through the indexed project; an
+unresolvable callee is skipped (miss, don't invent).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from cilium_tpu.analysis.callgraph import ModuleInfo, Project, dotted
+from cilium_tpu.analysis.core import Finding, ProjectIndex, checker
+
+RULE = "jit-purity"
+
+#: qualified-name prefixes whose call is a host effect under trace
+_IMPURE_PREFIXES: Tuple[Tuple[str, str], ...] = (
+    ("time.", "wall-clock/sleep"),
+    ("random.", "host RNG"),
+    ("numpy.random", "host RNG"),
+    ("socket.", "I/O"),
+    ("os.", "I/O"),
+    ("threading.", "thread/lock construction"),
+    ("cilium_tpu.runtime.metrics.", "metrics lock"),
+    ("cilium_tpu.runtime.tracing.", "tracer lock"),
+    ("cilium_tpu.runtime.faults.", "fault-point lock+RNG"),
+    ("cilium_tpu.runtime.logging.", "log I/O"),
+)
+
+#: exact qualified names that force a host sync / materialization
+_HOST_SYNC = {
+    "numpy.asarray": "host materialization of a traced value",
+    "numpy.array": "host materialization of a traced value",
+    "numpy.frombuffer": "host materialization",
+    "jax.device_get": "blocking device→host sync",
+}
+
+#: builtins that are host I/O
+_IO_BUILTINS = {"open", "print", "input"}
+
+#: attribute calls that block on the device
+_SYNC_ATTRS = {"item": "blocking .item() host sync",
+               "tolist": "blocking .tolist() host sync",
+               "block_until_ready": "blocking device sync"}
+
+#: jit-wrapping call forms whose first Name argument is an entry point
+_WRAPPERS = ("jax.jit", "jit", "pl.pallas_call", "pallas_call",
+             "jax.pmap", "shard_map", "jax.experimental.shard_map"
+             ".shard_map")
+
+
+def _is_jit_decorator(mi: ModuleInfo, dec: ast.expr) -> bool:
+    q = mi.qualify(dec if not isinstance(dec, ast.Call) else dec.func)
+    if q in ("jax.jit", "jit", "jax.pmap"):
+        return True
+    if isinstance(dec, ast.Call) and q in ("functools.partial",
+                                           "partial") and dec.args:
+        inner = mi.qualify(dec.args[0])
+        return inner in ("jax.jit", "jit", "jax.pmap")
+    return False
+
+
+def find_entries(project: Project) -> List[Tuple[ModuleInfo, ast.AST]]:
+    entries: List[Tuple[ModuleInfo, ast.AST]] = []
+    seen: Set[int] = set()
+
+    def add(mi: ModuleInfo, fn: Optional[ast.AST]) -> None:
+        if fn is not None and id(fn) not in seen:
+            seen.add(id(fn))
+            entries.append((mi, fn))
+
+    for mi in project.modules.values():
+        for fns in mi.all_functions.values():
+            for fn in fns:
+                if any(_is_jit_decorator(mi, d)
+                       for d in fn.decorator_list):
+                    add(mi, fn)
+        for node in ast.walk(mi.sf.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            q = mi.qualify(node.func)
+            if q is None or (q not in _WRAPPERS
+                             and not q.endswith(".shard_map")):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Name):
+                resolved = project.resolve_function(mi, arg.id)
+                if resolved is not None:
+                    add(*resolved)
+            elif isinstance(arg, (ast.FunctionDef, ast.Lambda)):
+                add(mi, arg)
+    return entries
+
+
+def _callees(project: Project, mi: ModuleInfo, fn: ast.AST
+             ) -> List[Tuple[ModuleInfo, ast.AST]]:
+    out = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d is None:
+            continue
+        if "." not in d:
+            resolved = project.resolve_function(mi, d)
+            if resolved is not None:
+                out.append(resolved)
+            continue
+        # mod.fn where mod is an imported project module
+        root, _, attr = d.rpartition(".")
+        target = project.modules.get(mi.imports.get(root, ""))
+        if target is not None and "." not in attr \
+                and attr in target.functions:
+            out.append((target, target.functions[attr]))
+    return out
+
+
+def _scan_impure(mi: ModuleInfo, fn: ast.AST, entry_name: str,
+                 findings: List[Finding]) -> None:
+    path = mi.sf.path
+
+    def report(line: int, what: str) -> None:
+        findings.append(Finding(
+            path, line, RULE,
+            f"{what} inside `{getattr(fn, 'name', '<lambda>')}`, "
+            f"reachable from jitted entry `{entry_name}`"))
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            q = mi.qualify(node.func)
+            if q is not None:
+                if q in _HOST_SYNC:
+                    report(node.lineno, f"{_HOST_SYNC[q]} (`{q}`)")
+                    continue
+                if q in _IO_BUILTINS:
+                    report(node.lineno, f"host I/O call `{q}`")
+                    continue
+                hit = next((why for p, why in _IMPURE_PREFIXES
+                            if q.startswith(p)), None)
+                if hit is not None:
+                    report(node.lineno, f"{hit} call `{q}`")
+                    continue
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _SYNC_ATTRS:
+                report(node.lineno, _SYNC_ATTRS[node.func.attr])
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                d = dotted(item.context_expr) or ""
+                leaf = d.rsplit(".", 1)[-1].lower()
+                if "lock" in leaf or "cond" in leaf:
+                    report(node.lineno,
+                           f"lock acquisition `with {d}`")
+        elif isinstance(node, (ast.If, ast.While)):
+            for sub in ast.walk(node.test):
+                if isinstance(sub, ast.Call):
+                    q = mi.qualify(sub.func) or ""
+                    if q.startswith(("jnp.", "jax.numpy", "jax.lax",
+                                     "lax.")):
+                        report(node.lineno,
+                               "Python branch on a traced value "
+                               f"(`{dotted(sub.func)}` in the test)")
+                        break
+
+
+@checker
+def check(index: ProjectIndex) -> List[Finding]:
+    project = Project(index)
+    findings: List[Finding] = []
+    visited: Dict[int, str] = {}
+    stack = [(mi, fn, getattr(fn, "name", "<lambda>"))
+             for mi, fn in find_entries(project)]
+    while stack:
+        mi, fn, entry = stack.pop()
+        if id(fn) in visited:
+            continue
+        visited[id(fn)] = entry
+        _scan_impure(mi, fn, entry, findings)
+        for cmi, cfn in _callees(project, mi, fn):
+            if id(cfn) not in visited:
+                stack.append((cmi, cfn, entry))
+    return findings

@@ -19,7 +19,7 @@ import shutil
 import sys
 import tarfile
 import time
-from typing import Dict, Optional
+from typing import Dict
 
 from cilium_tpu.runtime.metrics import METRICS
 

@@ -16,6 +16,8 @@ over the agent's socket plus offline tooling. Subcommands:
   (runtime/tracing.py) as Perfetto-loadable Chrome trace-event JSON
 * ``bugtool``     — collect a diagnostics bundle from the agent
   (the ``cilium-bugtool`` analog)
+* ``lint``        — ctlint codebase-aware static analysis
+  (cilium_tpu/analysis; rule catalog in docs/ANALYSIS.md)
 
 REST-API commands (``--api <socket>``, runtime/api.py — the
 ``pkg/client`` consumer role): ``endpoint list|get|add|delete``,
@@ -582,6 +584,24 @@ def cmd_bugtool(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """ctlint: the `make lint` gate as a subcommand (exit 1 on any
+    non-allowlisted finding)."""
+    from cilium_tpu.analysis import run_cli
+
+    argv: List[str] = list(args.targets or [])
+    argv += ["--format", args.format]
+    if args.root:
+        argv += ["--root", args.root]
+    if args.rules:
+        argv += ["--rules", args.rules]
+    if args.out:
+        argv += ["--out", args.out]
+    if args.list_rules:
+        argv += ["--list-rules"]
+    return run_cli(argv)
+
+
 def _api(args):
     from cilium_tpu.runtime.api import APIClient
 
@@ -892,6 +912,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--socket", required=True)
     p.add_argument("--out", default="/tmp")
     p.set_defaults(fn=cmd_bugtool)
+
+    p = sub.add_parser("lint",
+                       help="ctlint codebase-aware static analysis "
+                            "(docs/ANALYSIS.md)")
+    p.add_argument("targets", nargs="*",
+                   help="repo-relative files/dirs (default: cilium_tpu)")
+    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: the installed package's "
+                        "parent)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids (default: all)")
+    p.add_argument("--out", default=None,
+                   help="also write a JSON report here")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser("healthz", help="REST healthz")
     p.add_argument("--api", required=True)

@@ -30,7 +30,7 @@ from typing import Callable, Dict, List, Optional
 
 from cilium_tpu.core.identity import IdentityAllocator, NumericIdentity
 from cilium_tpu.core.labels import Label, LabelSet, SOURCE_K8S
-from cilium_tpu.kvstore import Event, EVENT_DELETE, KVStore, Lease, Watch
+from cilium_tpu.kvstore import Event, EVENT_DELETE, KVStore, Watch
 from cilium_tpu.runtime import faults
 from cilium_tpu.runtime.metrics import METRICS
 

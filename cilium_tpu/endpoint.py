@@ -21,7 +21,7 @@ import json
 import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, Dict, List, Optional, Set
+from typing import Dict, List, Optional, Set
 
 from cilium_tpu.core.identity import IdentityAllocator, NumericIdentity
 from cilium_tpu.core.labels import Label, LabelSet, SOURCE_K8S, SOURCE_RESERVED

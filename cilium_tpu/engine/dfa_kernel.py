@@ -46,6 +46,10 @@ def _default_impl() -> str:
     """
     import os
 
+    # trace-time STATIC config: the env pick selects which step gets
+    # compiled (same role as a static_argname), it never runs per
+    # batch — flipping the env between traces recompiles, by design
+    # ctlint: disable=jit-purity  # static impl selection at trace time
     env = os.environ.get("CILIUM_TPU_DFA_IMPL", "")
     if env in ("gather", "onehot", "pallas"):
         return env

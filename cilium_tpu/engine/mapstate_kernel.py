@@ -37,7 +37,7 @@ Verdict precedence (mapstate.py's golden model, vectorized):
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -45,7 +45,7 @@ import numpy as np
 
 from cilium_tpu.core.flow import TrafficDirection
 from cilium_tpu.engine.search import lower_bound
-from cilium_tpu.policy.mapstate import MapState, MapStateKey, MapStateEntry
+from cilium_tpu.policy.mapstate import MapState
 
 
 @dataclasses.dataclass

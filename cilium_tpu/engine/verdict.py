@@ -27,10 +27,7 @@ import numpy as np
 
 from cilium_tpu.core.config import EngineConfig
 from cilium_tpu.core.flow import (
-    DNSInfo,
     Flow,
-    HTTPInfo,
-    KafkaInfo,
     L7Type,
     TrafficDirection,
     Verdict,

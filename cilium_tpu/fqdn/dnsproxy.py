@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import re
 import threading
-import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import re
 import threading
-import time
 from typing import Callable, Dict, Iterable, List, Optional, Set
 
 from cilium_tpu.fqdn.cache import DNSCache

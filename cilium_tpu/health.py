@@ -17,7 +17,7 @@ import dataclasses
 import json
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List
 
 from cilium_tpu.runtime.metrics import METRICS
 

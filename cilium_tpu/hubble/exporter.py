@@ -6,7 +6,7 @@ from __future__ import annotations
 import json
 import os
 import threading
-from typing import Optional, Sequence
+from typing import Sequence
 
 from cilium_tpu.core.flow import Flow
 from cilium_tpu.ingest.hubble import flow_to_dict

@@ -13,7 +13,7 @@ import re as _re_mod
 import threading
 import time
 import uuid
-from typing import Dict, Iterator, List, Optional, Sequence
+from typing import Dict, Iterator, Optional, Sequence
 
 import numpy as np
 

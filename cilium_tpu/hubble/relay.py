@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import heapq
 import threading
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from cilium_tpu.core.flow import Flow
 from cilium_tpu.hubble.observer import FlowFilter, Observer

@@ -9,7 +9,7 @@ JSON objects, one per line — the exporter's on-disk format.
 from __future__ import annotations
 
 import json
-from typing import Dict, Iterable, Iterator, List, Optional
+from typing import Dict, Iterable, Iterator, Optional
 
 from cilium_tpu.core.flow import (
     DNSInfo,

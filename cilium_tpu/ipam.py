@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import ipaddress
 import threading
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Set
 
 from cilium_tpu.runtime.metrics import METRICS
 

@@ -21,7 +21,7 @@ Semantics carried over:
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 from cilium_tpu.k8s.apiserver import Conflict, K8sClient, NotFound
 from cilium_tpu.k8s.informer import Informer

@@ -16,7 +16,7 @@ kernel gathers from (``loadbalancer.kernel``).
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 

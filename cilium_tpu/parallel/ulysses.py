@@ -24,7 +24,6 @@ ready for the (cheap, local) conjunction + verdict stage.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 import dataclasses
 import glob as _glob
 import os
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 import yaml
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, Optional, Tuple
 
-from cilium_tpu.core.labels import Label, LabelSet, ParseLabel, SOURCE_ANY
+from cilium_tpu.core.labels import Label, LabelSet, ParseLabel
 
 
 @dataclasses.dataclass(frozen=True)

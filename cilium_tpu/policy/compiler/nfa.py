@@ -10,7 +10,7 @@ the TPU replacement for the reference's per-rule RE2 / Go-regex scans
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Sequence, Tuple
 
 from cilium_tpu.policy.compiler import regex_parser as rp
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import functools
 import re
-from typing import List, Optional, Sequence
+from typing import Sequence
 
 import numpy as np
 

@@ -32,7 +32,6 @@ from cilium_tpu.core.flow import Protocol, TrafficDirection
 from cilium_tpu.core.identity import IDENTITY_WILDCARD
 from cilium_tpu.core.labels import LabelSet
 from cilium_tpu.policy.api.l7 import L7Rules
-from cilium_tpu.policy.api.rule import Rule
 from cilium_tpu.policy.repository import Repository
 from cilium_tpu.policy.selectorcache import SelectorCache
 
@@ -186,7 +185,6 @@ class MapState:
 
     def __len__(self) -> int:
         return len(self.entries)
-
 
 #: ICMP type values live in the key's port slot OR'd with this bit:
 #: without it, ICMP type 0 (EchoReply) would key as dport 0 ==

@@ -11,9 +11,9 @@ reference, not a fast path.
 from __future__ import annotations
 
 import re
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, Sequence, Tuple
 
-from cilium_tpu.core.flow import Flow, L7Type, TrafficDirection, Verdict
+from cilium_tpu.core.flow import Flow, TrafficDirection, Verdict
 from cilium_tpu.policy.api.l7 import (
     L7Rules,
     PortRuleDNS,

@@ -9,7 +9,7 @@ whose ``endpointSelector`` matches the identity's labels.
 from __future__ import annotations
 
 import threading
-from typing import Iterable, List, Optional, Sequence, Tuple
+from typing import Iterable, List, Sequence, Tuple
 
 from cilium_tpu.core.labels import LabelSet
 from cilium_tpu.policy.api.rule import Rule

@@ -10,7 +10,7 @@ world.
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, FrozenSet, Iterable, Optional, Set, Tuple, Union
+from typing import Callable, Dict, FrozenSet, Iterable, Optional, Set, Union
 
 from cilium_tpu.core.identity import IdentityAllocator, NumericIdentity
 from cilium_tpu.core.labels import LabelSet

@@ -10,7 +10,7 @@ frame (headers + Content-Length body) is passed or dropped whole.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 from cilium_tpu.core.flow import HTTPInfo
 from cilium_tpu.proxylib.parser import Connection, Op, OpType, Parser, register_parser

@@ -11,8 +11,6 @@ re-publish rather than silently extend a lease whose key was GC'd.
 
 from __future__ import annotations
 
-from typing import Optional
-
 
 class Advertisement:
     """Publish ``key = value`` under a TTL lease; heartbeat keeps it
@@ -48,5 +46,6 @@ class Advertisement:
             self.store.delete(self.key)
             if self._lease is not None:
                 self.store.revoke(self._lease)
+        # ctlint: disable=swallowed-exception  # withdraw is best-effort
         except Exception:
             pass  # store gone first: the lease ages the entry out

@@ -9,8 +9,6 @@ in a manager for introspection (``cilium-dbg status --all-controllers``).
 from __future__ import annotations
 
 import threading
-import time
-import traceback
 from typing import Callable, Dict, Optional
 
 from cilium_tpu.runtime.logging import get_logger

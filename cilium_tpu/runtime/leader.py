@@ -74,6 +74,7 @@ class LeaderElector:
             if not won:
                 try:
                     self.store.revoke(lease)
+                # ctlint: disable=swallowed-exception  # best-effort revoke of a lost campaign; the lease ages out
                 except Exception:  # noqa: BLE001
                     pass
                 if self._stop.wait(interval):
@@ -87,11 +88,13 @@ class LeaderElector:
                 # (the unconditional get-then-delete could)
                 try:
                     self.store.revoke(lease)
+                # ctlint: disable=swallowed-exception  # resign is best-effort; the lease ages the key out
                 except Exception:  # noqa: BLE001 — lease ages out
                     pass
                 return
             try:  # leadership lost mid-stint: release our leftovers
                 self.store.revoke(lease)
+            # ctlint: disable=swallowed-exception  # best-effort cleanup; the lease ages the key out
             except Exception:  # noqa: BLE001
                 pass
 

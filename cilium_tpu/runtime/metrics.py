@@ -366,6 +366,113 @@ METRICS.describe(BREAKER_STATE,
 METRICS.describe(TRACE_SPANS,
                  "flight-recorder spans recorded, by phase")
 
+# -- family catalog ---------------------------------------------------------
+# ctlint (analysis/registry.py, rule metric-registry) requires every
+# family written anywhere in the package to be declared here exactly
+# once: the declaration is what turns a typo'd producer name into a
+# lint error instead of a silently-dead series, and it gives every
+# exposed family real # HELP text.
+METRICS.describe(BREAKER_TRIPS,
+                 "breaker CLOSED->OPEN transitions")
+METRICS.describe(BREAKER_RECOVERIES,
+                 "breaker HALF_OPEN->CLOSED transitions")
+METRICS.describe(BREAKER_FALLBACK_VERDICTS,
+                 "verdicts served by the CPU oracle while degraded")
+METRICS.describe(FAULTS_INJECTED,
+                 "faults fired by an armed FaultPlan, by point")
+METRICS.describe(LOADER_ROLLBACKS,
+                 "regenerations rolled back mid-swap")
+METRICS.describe(STREAM_RECONNECTS,
+                 "stream-client reconnects that resumed the session")
+METRICS.describe(KVSTORE_WATCH_ERRORS,
+                 "kvstore watch callbacks that raised and were isolated")
+METRICS.describe(DNSPROXY_FALLBACKS,
+                 "banked-DFA DNS batches degraded to the regex path")
+METRICS.describe("cilium_tpu_accesslog_decode_errors_total",
+                 "undecodable access-log records")
+METRICS.describe("cilium_tpu_accesslog_records_total",
+                 "access-log records ingested, by proto")
+METRICS.describe("cilium_tpu_api_requests_total",
+                 "REST API requests served")
+METRICS.describe("cilium_tpu_auth_pairs",
+                 "mutual-auth pairs currently authenticated")
+METRICS.describe("cilium_tpu_clustermesh_decode_errors_total",
+                 "undecodable remote-cluster kvstore events")
+METRICS.describe("cilium_tpu_clustermesh_ready",
+                 "1 when the remote cluster's session is live")
+METRICS.describe("cilium_tpu_compile_seconds",
+                 "policy snapshot compile wall seconds",
+                 buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                          30.0, 60.0, 120.0))
+METRICS.describe("cilium_tpu_controller_runs_total",
+                 "controller loop runs, by name and status")
+METRICS.describe("cilium_tpu_endpoint_regenerations_total",
+                 "per-endpoint regeneration completions, by status")
+METRICS.describe("cilium_tpu_endpoints",
+                 "endpoints currently managed")
+METRICS.describe("cilium_tpu_endpoints_restored_total",
+                 "endpoints restored from the state dir at startup")
+METRICS.describe("cilium_tpu_fqdn_handler_errors_total",
+                 "DNS proxy handler threads that raised")
+METRICS.describe("cilium_tpu_fqdn_malformed_queries_total",
+                 "DNS queries that failed wire parsing")
+METRICS.describe("cilium_tpu_fqdn_queries_total",
+                 "DNS proxy queries, by verdict")
+METRICS.describe("cilium_tpu_fqdn_unknown_client_total",
+                 "DNS queries from unmapped client addresses")
+METRICS.describe("cilium_tpu_fqdn_upstream_timeouts_total",
+                 "upstream DNS resolutions that timed out")
+METRICS.describe("cilium_tpu_health_probe_seconds",
+                 "node-to-node health probe latency, by peer")
+METRICS.describe("cilium_tpu_health_reachable",
+                 "1 when the peer's last health probe succeeded")
+METRICS.describe("cilium_tpu_identities_cluster",
+                 "identities known to the cluster-scope cache")
+METRICS.describe("cilium_tpu_ipam_endpoints_outside_cidr",
+                 "restored endpoints whose IP is outside the node CIDR")
+METRICS.describe("cilium_tpu_ipam_ips_allocated",
+                 "IPs currently allocated from the node CIDR")
+METRICS.describe("cilium_tpu_ipam_node_cidrs",
+                 "node CIDRs carved from the cluster pool")
+METRICS.describe("cilium_tpu_k8s_cnp_parse_errors_total",
+                 "CNP/CCNP objects that failed rule parsing")
+METRICS.describe("cilium_tpu_lb_services",
+                 "load-balancer services installed")
+METRICS.describe("cilium_tpu_leader",
+                 "1 while this process holds the named leader lock")
+METRICS.describe("cilium_tpu_monitor_events_total",
+                 "monitor socket events fanned out, by type")
+METRICS.describe("cilium_tpu_npds_pulls_total",
+                 "NPDS mapstate pulls served to shims")
+METRICS.describe("cilium_tpu_operator_cidrs_quarantined_total",
+                 "pod CIDRs quarantined pending release confirmation")
+METRICS.describe("cilium_tpu_operator_cidrs_reclaimed_total",
+                 "pod CIDRs reclaimed from departed nodes")
+METRICS.describe("cilium_tpu_operator_identities_gc_total",
+                 "kvstore identities garbage-collected")
+METRICS.describe("cilium_tpu_operator_pool_exhausted_total",
+                 "node CIDR requests refused: cluster pool exhausted")
+METRICS.describe("cilium_tpu_policy_l7_total",
+                 "L7 proxy policy checks, by proto and verdict")
+METRICS.describe("cilium_tpu_policy_watch_ops_total",
+                 "policy-directory watch operations applied")
+METRICS.describe("cilium_tpu_policy_watch_parse_errors_total",
+                 "policy-directory files that failed YAML parsing")
+METRICS.describe("cilium_tpu_proxy_redirects",
+                 "proxy redirects currently installed")
+METRICS.describe("cilium_tpu_proxy_redirects_created_total",
+                 "proxy redirects created")
+METRICS.describe("cilium_tpu_proxy_redirects_released_total",
+                 "proxy redirects released")
+METRICS.describe("cilium_tpu_regenerations_total",
+                 "policy snapshot regenerations committed, by backend")
+METRICS.describe("cilium_tpu_service_verdicts_total",
+                 "flows verdicted via the bulk service op")
+METRICS.describe("cilium_tpu_stream_unknown_frames_total",
+                 "stream frames dropped for an unknown kind")
+METRICS.describe("cilium_tpu_stream_verdicts_total",
+                 "verdicts returned over the chunked binary stream")
+
 
 class SpanStat:
     """Duration span: ``with SpanStat("compile"): ...`` records seconds

@@ -46,7 +46,7 @@ from cilium_tpu.core.flow import (
     Verdict,
 )
 from cilium_tpu.ingest.hubble import flow_from_dict
-from cilium_tpu.proxylib.parser import Connection, OpType, create_parser
+from cilium_tpu.proxylib.parser import Connection, create_parser
 from cilium_tpu.runtime.loader import Loader
 from cilium_tpu.runtime.logging import get_logger
 from cilium_tpu.runtime.metrics import (

@@ -92,6 +92,10 @@ KIND_ERROR = 2
 #: OPTIONAL both ways — servers advertise ``"trace": true`` in the
 #: stream_start ack and clients only send this kind to peers that do,
 #: so old clients and old servers interoperate unchanged.
+# client-to-server only: the server adopts the id and always replies
+# with plain KIND_CHUNK frames, so the client dispatch never sees this
+# kind (unknown kinds there are dropped and counted, not misparsed).
+# ctlint: disable=frame-kind  # one-directional kind, see above
 KIND_CHUNK_TRACED = 3
 
 #: hard cap on one frame's payload — a corrupt length prefix must not
@@ -492,10 +496,19 @@ class StreamClient:
                     self._unacked.pop(seq, None)
                     self._results[seq] = RuntimeError(
                         payload.decode("utf-8", "replace"))
-                else:
+                elif kind == KIND_CHUNK:
                     self._unacked.pop(seq, None)
                     self._results[seq] = np.frombuffer(
                         payload, dtype=np.uint8)
+                else:
+                    # a kind this client does not speak (ctlint
+                    # frame-kind found the old catch-all here):
+                    # dropping and counting the frame beats misparsing
+                    # its payload as a verdict array — the seq stays
+                    # pending and surfaces as a timeout or a resume
+                    # re-send, never as wrong verdicts
+                    METRICS.inc(
+                        "cilium_tpu_stream_unknown_frames_total")
                 self._cond.notify_all()
                 if kind == KIND_END:
                     return

@@ -1,0 +1,461 @@
+"""ctlint (cilium_tpu/analysis): each rule catches its bad corpus,
+passes its good corpus, honors the disable allowlist — and the shipped
+tree is clean (the `make lint` gate, asserted from the suite too so a
+finding fails CI even if the lint lane is skipped)."""
+
+import os
+import socket
+import threading
+
+from cilium_tpu.analysis import run
+from cilium_tpu.analysis.core import ProjectIndex
+from cilium_tpu.analysis import exceptions as exc_rule
+from cilium_tpu.analysis import imports as imp_rule
+from cilium_tpu.analysis import locks as lock_rule
+from cilium_tpu.analysis import purity as purity_rule
+from cilium_tpu.analysis import registry as reg_rule
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _check(sources, checker, **kw):
+    """Run one rule over an in-memory corpus, applying the same
+    disable filtering core.run does."""
+    index, errors = ProjectIndex.from_sources(sources)
+    assert not errors, errors
+    out = []
+    for f in checker(index, **kw):
+        sf = index.by_path.get(f.path)
+        if sf is not None and sf.disabled(f.line, f.rule):
+            continue
+        out.append(f)
+    return out
+
+
+# -- jit-purity -------------------------------------------------------------
+
+PURITY_BAD = """\
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def helper(x):
+    return x + time.time()
+
+
+@jax.jit
+def kernel(x):
+    if jnp.any(x > 0):
+        return helper(x)
+    return x
+"""
+
+PURITY_GOOD = """\
+import jax
+import jax.numpy as jnp
+
+
+def helper(x):
+    return jnp.sum(x)
+
+
+@jax.jit
+def kernel(x):
+    return jnp.where(x > 0, helper(x), x)
+"""
+
+
+def test_purity_bad_corpus():
+    findings = _check({"pkg/kern.py": PURITY_BAD}, purity_rule.check)
+    msgs = "\n".join(f.message for f in findings)
+    assert any(f.rule == "jit-purity" for f in findings)
+    assert "time.time" in msgs           # impure call via helper
+    assert "traced value" in msgs        # if jnp.any(...) branch
+
+
+def test_purity_good_corpus():
+    assert _check({"pkg/kern.py": PURITY_GOOD}, purity_rule.check) == []
+
+
+def test_purity_jit_call_form_and_lock():
+    src = (
+        "import threading\n"
+        "import jax\n"
+        "LOCK = threading.Lock()\n"
+        "def step(x):\n"
+        "    with LOCK:\n"
+        "        return x\n"
+        "fn = jax.jit(step)\n"
+    )
+    findings = _check({"pkg/m.py": src}, purity_rule.check)
+    assert any("lock acquisition" in f.message for f in findings)
+
+
+# -- lock-order -------------------------------------------------------------
+
+LOCKS_CYCLE = """\
+import threading
+
+
+class A:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def do(self):
+        with self._lock:
+            B_SINGLETON.poke()
+
+
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poke(self):
+        with self._lock:
+            pass
+
+    def back(self):
+        with self._lock:
+            A_SINGLETON.do()
+
+
+A_SINGLETON = A()
+B_SINGLETON = B()
+"""
+
+LOCKS_SELF_DEADLOCK = """\
+import threading
+
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    def outer(self):
+        with self._cond:
+            self.inner()
+
+    def inner(self):
+        with self._lock:
+            pass
+"""
+
+LOCKS_GOOD = """\
+import threading
+
+
+class C:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def outer(self):
+        with self._lock:
+            self.inner()
+
+    def inner(self):
+        with self._lock:
+            pass
+"""
+
+
+def test_lock_cycle_detected():
+    findings = _check({"pkg/m.py": LOCKS_CYCLE}, lock_rule.check)
+    assert any("lock-order cycle" in f.message for f in findings)
+
+
+def test_lock_condition_alias_self_deadlock():
+    # with self._cond holds the WRAPPED self._lock: calling a method
+    # that re-takes self._lock is a one-thread deadlock
+    findings = _check({"pkg/m.py": LOCKS_SELF_DEADLOCK},
+                      lock_rule.check)
+    assert any("self-deadlock" in f.message for f in findings)
+
+
+def test_lock_rlock_reentry_allowed():
+    assert _check({"pkg/m.py": LOCKS_GOOD}, lock_rule.check) == []
+
+
+# -- metric-registry --------------------------------------------------------
+
+METRICS_DECL = """\
+METRICS.describe("cilium_tpu_good_total", "declared counter")
+METRICS.describe("cilium_tpu_depth", "declared gauge")
+"""
+
+METRICS_BAD = """\
+METRICS.inc("cilium_tpu_good_total")
+METRICS.inc("cilium_tpu_typo_total")            # undeclared
+METRICS.inc("cilium_tpu_requests")              # counter w/o _total
+METRICS.set_gauge("cilium_tpu_good_total", 1)   # kind conflict
+METRICS.observe("cilium tpu bad name", 1.0)     # illegal name
+v = METRICS.get("cilium_tpu_never_written_total")
+"""
+
+METRICS_GOOD = """\
+METRICS.inc("cilium_tpu_good_total")
+METRICS.set_gauge("cilium_tpu_depth", 3)
+v = METRICS.get("cilium_tpu_good_total")
+"""
+
+
+def test_metric_registry_bad_corpus():
+    findings = _check(
+        {"pkg/decl.py": METRICS_DECL, "pkg/use.py": METRICS_BAD},
+        reg_rule.check_metrics, decl_module="pkg.decl")
+    msgs = "\n".join(f.message for f in findings)
+    assert "cilium_tpu_typo_total` written here but never declared" \
+        in msgs
+    assert "must end in `_total`" in msgs
+    assert "conflicting instrument kinds" in msgs
+    assert "not a legal Prometheus metric name" in msgs
+    assert "nothing in the package writes it" in msgs
+
+
+def test_metric_registry_good_corpus():
+    assert _check(
+        {"pkg/decl.py": METRICS_DECL, "pkg/use.py": METRICS_GOOD},
+        reg_rule.check_metrics, decl_module="pkg.decl") == []
+
+
+# -- fault-registry ---------------------------------------------------------
+
+FAULTS_BAD = """\
+from pkg import faults
+
+GOOD_POINT = faults.register_point("seam.good", "covered")
+DEAD_POINT = faults.register_point("seam.dead", "no seam")
+
+
+def covered():
+    faults.maybe_fail(GOOD_POINT)
+
+
+def drifted():
+    faults.maybe_fail("seam.ghost")
+"""
+
+
+def test_fault_registry_drift():
+    findings = _check(
+        {"pkg/faults.py": "def register_point(n, d=''):\n    return n\n"
+                          "def maybe_fail(p):\n    pass\n",
+         "pkg/seams.py": FAULTS_BAD},
+        reg_rule.check_faults, faults_module="pkg.faults")
+    msgs = "\n".join(f.message for f in findings)
+    assert "seam.ghost" in msgs and "unregistered" in msgs
+    assert "seam.dead" in msgs and "dead injection point" in msgs
+    assert "seam.good" not in msgs
+
+
+# -- frame-kind -------------------------------------------------------------
+
+FRAMES_BAD = """\
+KIND_A = 0
+KIND_B = 1
+
+
+class Server:
+    def _work(self, kind):
+        if kind == KIND_A:
+            return "a"
+        if kind == KIND_B:
+            return "b"
+
+
+class Client:
+    def _recv(self, kind):
+        if kind == KIND_A:
+            return "a"
+        return "??"  # KIND_B falls through — the gap
+"""
+
+
+def test_frame_kind_gap():
+    findings = _check(
+        {"pkg/proto.py": FRAMES_BAD}, reg_rule.check_frames,
+        defs_module="pkg.proto",
+        sites=(("pkg.proto", "Server", ("_work",)),
+               ("pkg.proto", "Client", ("_recv",))))
+    assert len(findings) == 1
+    assert "KIND_B" in findings[0].message
+    assert "Client" in findings[0].message
+
+
+def test_frame_kind_duplicate_value():
+    src = "KIND_A = 0\nKIND_B = 0\n"
+    findings = _check({"pkg/proto.py": src}, reg_rule.check_frames,
+                      defs_module="pkg.proto", sites=())
+    assert any("reuses wire value" in f.message for f in findings)
+
+
+# -- swallowed-exception / unused-import ------------------------------------
+
+def test_swallowed_exception():
+    src = (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "def h():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except:\n"
+        "        return 1\n"
+        "def ok():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except ValueError:\n"
+        "        pass\n"
+    )
+    findings = _check({"pkg/m.py": src}, exc_rule.check)
+    assert len(findings) == 2
+    assert {f.line for f in findings} == {4, 9}
+
+
+def test_unused_import():
+    src = "import os\nimport sys\n\nprint(sys.argv)\n"
+    findings = _check({"pkg/m.py": src}, imp_rule.check)
+    assert [f.line for f in findings] == [1]
+    # __init__ re-export surfaces are exempt
+    assert _check({"pkg/__init__.py": "import os\n"},
+                  imp_rule.check) == []
+
+
+# -- disable allowlist ------------------------------------------------------
+
+def test_disable_comment_honored():
+    src = (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    # ctlint: disable=swallowed-exception  # test fixture\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    assert _check({"pkg/m.py": src}, exc_rule.check) == []
+
+
+def test_disable_without_justification_is_a_finding():
+    src = "import os  # ctlint: disable=unused-import\n"
+    index, _ = ProjectIndex.from_sources({"pkg/m.py": src})
+    from cilium_tpu.analysis.core import _bare_disable_findings
+
+    findings = _bare_disable_findings(index)
+    assert len(findings) == 1
+    assert findings[0].rule == "bare-disable"
+
+
+# -- the shipped tree -------------------------------------------------------
+
+def test_shipped_tree_is_clean():
+    """The `make lint` gate, from inside the suite: zero
+    non-allowlisted findings across cilium_tpu/."""
+    findings, _suppressed = run(REPO_ROOT)
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_lock_graph_is_nontrivial():
+    """Guard against the lock analysis going vacuously quiet: the real
+    tree must yield a meaningful lock set and acquisition edges."""
+    from cilium_tpu.analysis.callgraph import Project
+
+    index, errors = ProjectIndex.from_tree(REPO_ROOT, ("cilium_tpu",))
+    assert not errors
+    a = lock_rule._Analyzer(Project(index))
+    assert len(a.kinds) >= 30
+    edges = 0
+    for _key, s in a.summaries.items():
+        edges += sum(1 for held, _l, _k, _ln in s.acquires if held)
+        edges += sum(1 for held, _c, _ln in s.calls if held)
+    assert edges >= 10
+
+
+def test_purity_entries_found_in_tree():
+    """Same guard for the purity walk: the engine's jitted entry
+    points must be discovered."""
+    from cilium_tpu.analysis.callgraph import Project
+
+    index, _ = ProjectIndex.from_tree(REPO_ROOT, ("cilium_tpu",))
+    names = {getattr(fn, "name", "<lambda>")
+             for _mi, fn in purity_rule.find_entries(Project(index))}
+    assert "verdict_step" in names
+    assert "verdict_step_capture" in names
+
+
+# -- regression: the frame-kind fix in StreamClient -------------------------
+
+def test_stream_client_drops_unknown_frame_kind(tmp_path):
+    """ctlint frame-kind found StreamClient._recv_loop treating ANY
+    non-END/ERROR kind as a verdict array. Pin the fix: an unknown
+    kind is dropped and counted, and the following valid chunk still
+    lands for the same seq."""
+    from cilium_tpu.runtime.metrics import METRICS
+    from cilium_tpu.runtime.service import recv_msg, send_msg
+    from cilium_tpu.runtime.stream import (
+        KIND_CHUNK,
+        KIND_END,
+        StreamClient,
+        send_frame,
+    )
+
+    path = str(tmp_path / "s.sock")
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv.bind(path)
+    srv.listen(1)
+
+    def server():
+        conn, _ = srv.accept()
+        recv_msg(conn)  # stream_start handshake
+        send_msg(conn, {"ok": True, "revision": 1})
+        # unknown kind 9 first: must be dropped, not parsed as the
+        # verdicts for seq 0
+        send_frame(conn, 0, 9, b"\x07\x07\x07\x07")
+        send_frame(conn, 0, KIND_CHUNK, bytes([1, 2, 5]))
+        send_frame(conn, 1, KIND_END)
+        conn.close()
+
+    th = threading.Thread(target=server, daemon=True)
+    th.start()
+    before = METRICS.get("cilium_tpu_stream_unknown_frames_total")
+    client = StreamClient(path, timeout=10.0)
+    try:
+        verdicts = client.result(0)
+        assert list(verdicts) == [1, 2, 5]
+        assert METRICS.get("cilium_tpu_stream_unknown_frames_total") \
+            == before + 1
+    finally:
+        client.close()
+        srv.close()
+    th.join(timeout=10)
+
+
+def test_cli_lint_subcommand_json(capsys):
+    """`cilium-tpu lint --format json` exits 0 on the shipped tree and
+    prints a well-formed report."""
+    import json
+
+    from cilium_tpu.cli import main
+
+    rc = main(["lint", "--format", "json"])
+    out = capsys.readouterr().out
+    report = json.loads(out)
+    assert rc == 0
+    assert report["count"] == 0
+    assert report["findings"] == []
+    assert report["suppressed"] >= 1
+
+
+def test_cli_lint_exits_nonzero_on_findings(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f():\n    try:\n        g()\n"
+                   "    except:\n        pass\n")
+    from cilium_tpu.cli import main
+
+    rc = main(["lint", "--root", str(tmp_path), "bad.py"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "swallowed-exception" in out
