@@ -15,8 +15,11 @@ shim:            ## build the C++ proxylib-ABI shim
 
 # lint: ctlint codebase-aware static analysis (cilium_tpu/analysis —
 # jit-purity, lock-order, registry consistency, swallowed exceptions,
-# unused imports). Fails on any non-allowlisted finding; CTLINT.json
-# is the CI report artifact. Rule catalog: docs/ANALYSIS.md
+# unused imports, plus the v2 dataflow families: shape-dtype,
+# recompile-hazard, abi-surface, config-surface). Fails on any
+# non-allowlisted finding; CTLINT.json is the CI report artifact
+# (schema 2: findings byte-stable for a clean tree + timings_ms).
+# Rule catalog and dataflow-core internals: docs/ANALYSIS.md
 lint:            ## ctlint static-analysis gate
 	$(PY) -m cilium_tpu.analysis --format text --out CTLINT.json
 

@@ -330,6 +330,8 @@ def run_shim_point(loader, deadline_ms: float, batch_max: int,
         ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+    # disconnect returns void — the c_int default would read garbage
+    lib.cshim_disconnect.restype = None
 
     from cilium_tpu.proxylib.kafka import encode_request
 

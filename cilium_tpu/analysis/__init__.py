@@ -42,6 +42,15 @@ def run_cli(argv=None) -> int:
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule ids to run "
                          "(default: all)")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="ID",
+                    help="run one rule id (repeatable; combines "
+                         "with --rules)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report findings only for files git sees "
+                         "as changed/untracked (the whole tree is "
+                         "still indexed — rules are cross-file); "
+                         "the pre-commit face")
     ap.add_argument("--out", default=None,
                     help="also write a JSON report here (the CI "
                          "artifact)")
@@ -55,17 +64,28 @@ def run_cli(argv=None) -> int:
         return 0
     root = args.root or os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
-    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
-             if args.rules else None)
+    rules = [r.strip() for r in (args.rules or "").split(",")
+             if r.strip()]
+    rules.extend(args.rule or ())
     if rules:
         unknown = [r for r in rules if r not in RULES]
         if unknown:
             print(f"error: unknown rule(s) {unknown} "
                   f"(--list-rules)", file=sys.stderr)
             return 2
+    only_paths = None
+    if args.changed_only:
+        only_paths = _git_changed_paths(root)
+        if only_paths is None:
+            print("error: --changed-only needs a git checkout",
+                  file=sys.stderr)
+            return 2
+        if not only_paths:
+            print("ctlint: no changed files")
+            return 0
     findings, suppressed = run(
         root, targets=tuple(args.targets) or ("cilium_tpu",),
-        rules=rules)
+        rules=rules or None, only_paths=only_paths)
     if args.out:
         with open(args.out, "w") as fp:
             fp.write(render_json(findings, suppressed))
@@ -74,3 +94,30 @@ def run_cli(argv=None) -> int:
     else:
         print(render_text(findings, suppressed))
     return 1 if findings else 0
+
+
+def _git_changed_paths(root):
+    """Repo-relative .py paths git reports as modified/added/
+    untracked (the ``--changed-only`` filter); None when git is
+    unavailable."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "-C", root, "status", "--porcelain"],
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    paths = []
+    for line in out.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:].strip()
+        if " -> " in path:  # rename: take the new side
+            path = path.split(" -> ", 1)[1]
+        path = path.strip('"')
+        if path.endswith(".py"):
+            paths.append(path)
+    return paths

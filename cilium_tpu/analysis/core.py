@@ -23,14 +23,23 @@ off switch.
 from __future__ import annotations
 
 import ast
+import hashlib
 import json
 import os
+import pickle
 import re
+import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 #: repo-relative package directory ctlint analyzes by default
 DEFAULT_TARGET = "cilium_tpu"
+
+#: CTLINT.json schema. 2 = adds schema_version + timings_ms (v2
+#: dataflow families). Findings/count/suppressed are byte-stable for a
+#: clean tree; timings_ms is measured and varies run to run.
+SCHEMA_VERSION = 2
 
 _DISABLE_RE = re.compile(
     r"#\s*ctlint:\s*disable=(?P<rules>[A-Za-z0-9_,\- ]+?)"
@@ -57,11 +66,13 @@ class Finding:
 class SourceFile:
     """One parsed module: source, AST, and its disable allowlist."""
 
-    def __init__(self, path: str, module: str, source: str):
+    def __init__(self, path: str, module: str, source: str,
+                 tree: Optional[ast.AST] = None):
         self.path = path          # repo-relative
         self.module = module      # dotted module name
         self.source = source
-        self.tree = ast.parse(source, filename=path)
+        self.tree = tree if tree is not None \
+            else ast.parse(source, filename=path)
         self.lines = source.splitlines()
         #: line (1-based) → set of disabled rule ids on that line
         self.disables: Dict[int, set] = {}
@@ -91,35 +102,53 @@ class SourceFile:
 class ProjectIndex:
     """Every analyzed module, parsed once and shared by all rules."""
 
-    def __init__(self, files: Dict[str, SourceFile]):
+    def __init__(self, files: Dict[str, SourceFile],
+                 root: Optional[str] = None):
         #: dotted module name → SourceFile
         self.files = files
         self.by_path = {f.path: f for f in files.values()}
+        #: repo root when indexed from a tree (None for in-memory
+        #: corpora) — rules that read non-Python surfaces (C++ ABI,
+        #: docs) anchor here
+        self.root = root
 
     @classmethod
     def from_tree(cls, root: str,
-                  targets: Sequence[str] = (DEFAULT_TARGET,)
+                  targets: Sequence[str] = (DEFAULT_TARGET,),
+                  jobs: Optional[int] = None
                   ) -> Tuple["ProjectIndex", List[Finding]]:
         """Index ``targets`` (repo-relative dirs/files) under ``root``.
         Unparseable files become findings, not crashes — a linter that
-        dies on a syntax error hides every other finding."""
-        sources: Dict[str, str] = {}
+        dies on a syntax error hides every other finding. Files are
+        read and hashed on a thread pool; a per-content-hash AST cache
+        under ``<root>/.ctlint_cache/`` skips re-parsing unchanged
+        files across runs (ast.parse dominates a warm lint run)."""
+        paths: List[Tuple[str, str]] = []   # (rel, full)
         for target in targets:
             full = os.path.join(root, target)
             if os.path.isfile(full):
-                sources[target] = _read(full)
+                paths.append((target, full))
                 continue
             for dirpath, _dirnames, filenames in sorted(os.walk(full)):
                 for name in sorted(filenames):
                     if not name.endswith(".py"):
                         continue
                     path = os.path.join(dirpath, name)
-                    rel = os.path.relpath(path, root)
-                    sources[rel] = _read(path)
-        return cls.from_sources(sources)
+                    paths.append((os.path.relpath(path, root), path))
+        cache = _AstCache(root)
+        with ThreadPoolExecutor(max_workers=min(8, max(1, len(paths)))
+                                ) as pool:
+            sources = dict(pool.map(
+                lambda rf: (rf[0], _read(rf[1])), paths))
+        index, errors = cls.from_sources(sources, root=root,
+                                         cache=cache)
+        cache.flush()
+        return index, errors
 
     @classmethod
-    def from_sources(cls, sources: Dict[str, str]
+    def from_sources(cls, sources: Dict[str, str],
+                     root: Optional[str] = None,
+                     cache: Optional["_AstCache"] = None
                      ) -> Tuple["ProjectIndex", List[Finding]]:
         """Build from ``{repo-relative path: source}`` — the test
         corpus face: rules run against in-memory snippets exactly as
@@ -129,14 +158,77 @@ class ProjectIndex:
         for rel, source in sorted(sources.items()):
             module = _module_name(rel)
             try:
-                files[module] = SourceFile(rel, module, source)
+                tree = cache.tree_for(rel, source) if cache else None
+                files[module] = SourceFile(rel, module, source,
+                                           tree=tree)
+                if cache is not None:
+                    cache.store(rel, source, files[module].tree)
             except SyntaxError as e:
                 errors.append(Finding(rel, e.lineno or 1, "parse-error",
                                       f"cannot parse: {e.msg}"))
-        return cls(files), errors
+        return cls(files, root=root), errors
 
     def get(self, module: str) -> Optional[SourceFile]:
         return self.files.get(module)
+
+
+class _AstCache:
+    """Content-hash → pickled-AST cache (one file per lint run, not
+    per module — a single read/write beats 250 tiny files). A stale
+    or unreadable cache is ignored wholesale; the format is an
+    implementation detail keyed on the pickle protocol."""
+
+    NAME = ".ctlint_cache/ast.pkl"
+
+    def __init__(self, root: Optional[str]):
+        self.path = os.path.join(root, self.NAME) if root else None
+        self._old: Dict[str, bytes] = {}
+        self._new: Dict[str, bytes] = {}
+        self._dirty = False
+        if self.path and os.path.exists(self.path):
+            try:
+                with open(self.path, "rb") as f:
+                    self._old = pickle.load(f)
+            except Exception:
+                self._old = {}
+
+    @staticmethod
+    def _key(source: str) -> str:
+        return hashlib.sha256(source.encode("utf-8")).hexdigest()
+
+    def tree_for(self, rel: str, source: str) -> Optional[ast.AST]:
+        blob = self._old.get(self._key(source))
+        if blob is None:
+            return None
+        try:
+            return pickle.loads(blob)
+        except Exception:
+            return None
+
+    def store(self, rel: str, source: str, tree: ast.AST) -> None:
+        key = self._key(source)
+        blob = self._old.get(key)
+        if blob is None:
+            try:
+                blob = pickle.dumps(tree, protocol=4)
+            except Exception:
+                return
+            self._dirty = True
+        self._new[key] = blob
+
+    def flush(self) -> None:
+        if self.path is None:
+            return
+        if not self._dirty and set(self._new) == set(self._old):
+            return
+        try:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(self._new, f, protocol=4)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # cache is best-effort; the lint result is identical
 
 
 def _read(path: str) -> str:
@@ -173,6 +265,19 @@ RULES: Dict[str, str] = {
                            "Exception whose body only passes",
     "unused-import": "no unused module-level imports (outside "
                      "__init__ re-export surfaces)",
+    "shape-dtype": "abstract shape/dtype interpretation of every "
+                   "jitted entry: provable broadcast/matmul/reshape "
+                   "mismatches, overflow-prone narrow-int "
+                   "accumulations, weak-type wraps",
+    "recompile-hazard": "jit cache-key churn: per-call wrapper "
+                        "construction, shape-dependent Python "
+                        "branching, config scalars fixing shapes "
+                        "under trace",
+    "abi-surface": "extern \"C\" signatures diffed bidirectionally "
+                   "against every ctypes argtypes/restype/call in "
+                   "the package and test/bench surfaces",
+    "config-surface": "Config field ⇄ TOML key ⇄ CILIUM_TPU_* env "
+                      "var ⇄ docs mention, four-way parity",
     "bare-disable": "every ctlint disable comment carries a "
                     "justification",
     "parse-error": "every analyzed file parses",
@@ -198,27 +303,51 @@ def _bare_disable_findings(index: ProjectIndex) -> List[Finding]:
     return out
 
 
+#: per-rule wall time of the last run() (milliseconds) — rendered
+#: into CTLINT.json as ``timings_ms``; measured, so NOT byte-stable
+LAST_TIMINGS: Dict[str, float] = {}
+
+
 def run(root: str, targets: Sequence[str] = (DEFAULT_TARGET,),
-        rules: Optional[Sequence[str]] = None
+        rules: Optional[Sequence[str]] = None,
+        only_paths: Optional[Sequence[str]] = None
         ) -> Tuple[List[Finding], int]:
     """Run all checkers; returns (active findings, suppressed count).
-    ``rules`` filters to a subset of rule ids."""
+    ``rules`` filters to a subset of rule ids. ``only_paths`` (the
+    ``--changed-only`` face) restricts the REPORTED findings to those
+    repo-relative paths — the whole tree is still indexed, because
+    every interesting rule here is cross-file."""
     # rule modules register their checkers on import
     from cilium_tpu.analysis import (  # noqa: F401
+        abi,
+        configsurface,
         exceptions,
         imports,
         locks,
         purity,
+        recompile,
         registry,
+        shapes,
     )
 
+    LAST_TIMINGS.clear()
+    t0 = time.monotonic()
     index, findings = ProjectIndex.from_tree(root, targets)
+    LAST_TIMINGS["parse"] = (time.monotonic() - t0) * 1000.0
     for check in CHECKERS:
-        findings.extend(check(index))
+        t0 = time.monotonic()
+        found = check(index)
+        label = check.__module__.rsplit(".", 1)[-1]
+        LAST_TIMINGS[label] = LAST_TIMINGS.get(label, 0.0) \
+            + (time.monotonic() - t0) * 1000.0
+        findings.extend(found)
     findings.extend(_bare_disable_findings(index))
     if rules:
         wanted = set(rules)
         findings = [f for f in findings if f.rule in wanted]
+    if only_paths is not None:
+        wanted_paths = set(only_paths)
+        findings = [f for f in findings if f.path in wanted_paths]
     active: List[Finding] = []
     suppressed = 0
     for f in sorted(set(findings)):
@@ -237,9 +366,21 @@ def render_text(findings: Sequence[Finding], suppressed: int) -> str:
     return "\n".join(lines)
 
 
-def render_json(findings: Sequence[Finding], suppressed: int) -> str:
-    return json.dumps({
+def render_json(findings: Sequence[Finding], suppressed: int,
+                timings: Optional[Dict[str, float]] = None) -> str:
+    """The CTLINT.json report. Everything except ``timings_ms`` is
+    deterministic for a given tree (sorted findings, fixed key
+    order); ``timings_ms`` is measured wall time per rule module and
+    varies run to run — stability tests must compare around it."""
+    report = {
+        "schema_version": SCHEMA_VERSION,
         "findings": [f.as_dict() for f in findings],
         "count": len(findings),
         "suppressed": suppressed,
-    }, indent=2)
+    }
+    if timings is None:
+        timings = LAST_TIMINGS
+    if timings:
+        report["timings_ms"] = {k: round(v, 3)
+                                for k, v in sorted(timings.items())}
+    return json.dumps(report, indent=2)

@@ -145,7 +145,11 @@ def cmd_replay(args) -> int:
     counts: dict = {}
     total = 0
     try:
+        # engine.batch_size (CILIUM_TPU_BATCH_SIZE / [engine] TOML)
+        # is the replay chunk unit — the batch shape the jitted step
+        # compiles for
         chunks = replay_chunks(args.capture, cursor=cursor,
+                               chunk_size=cfg.engine.batch_size,
                                start=args.start, limit=args.limit,
                                decode=not args.fast)
         # offline replay has no live handshake state: drop-until-authed
@@ -595,6 +599,10 @@ def cmd_lint(args) -> int:
         argv += ["--root", args.root]
     if args.rules:
         argv += ["--rules", args.rules]
+    for rule in args.rule or ():
+        argv += ["--rule", rule]
+    if args.changed_only:
+        argv += ["--changed-only"]
     if args.out:
         argv += ["--out", args.out]
     if args.list_rules:
@@ -924,6 +932,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "parent)")
     p.add_argument("--rules", default=None,
                    help="comma-separated rule ids (default: all)")
+    p.add_argument("--rule", action="append", default=None,
+                   metavar="ID",
+                   help="run one rule id (repeatable)")
+    p.add_argument("--changed-only", action="store_true",
+                   help="report findings only for git-changed files "
+                        "(pre-commit face; the tree is still indexed)")
     p.add_argument("--out", default=None,
                    help="also write a JSON report here")
     p.add_argument("--list-rules", action="store_true",

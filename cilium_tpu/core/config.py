@@ -41,16 +41,17 @@ class EngineConfig:
     http_path_buckets: Tuple[int, ...] = (32, 64, 128, 256)
     http_host_len: int = 128
     http_method_len: int = 16
-    kafka_topic_len: int = 256
-    kafka_client_id_len: int = 64
+    # (kafka topic/client-id length caps were removed by the ctlint
+    # config-surface sweep: Kafka fields match by exact interned id,
+    # never through a length-bucketed automaton, so the knobs were
+    # dead the day they landed)
     #: generic (l7proto) records: max fields per record the engine
     #: encodes pair slots for (our parsers emit ≤4; truncation beyond
     #: this could only false-DENY, never false-allow)
     max_generic_fields: int = 16
-    # Batching
+    #: replay/featurize chunk unit — the batch shape the jitted step
+    #: compiles for (``cilium-tpu replay`` and the bench sweeps)
     batch_size: int = 8192
-    # dtype for transition tables
-    trans_dtype: str = "int32"
 
 
 @dataclasses.dataclass

@@ -141,6 +141,7 @@ def dfa_scan_banked(
     if impl == "pallas":
         from cilium_tpu.engine import pallas_dfa
 
+        # ctlint: disable=recompile-hazard  # impl pick per bank shape is a trace-time static choice, by design
         if pallas_dfa.pallas_supported(trans.shape):
             finals = pallas_dfa.dfa_finals_pallas(
                 trans, byteclass, start, data, lengths,

@@ -26,6 +26,7 @@ regime the reference's streaming parsers target).
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -102,24 +103,18 @@ def payload_scan_sp(
         axis=1)[:, 0]
 
 
-def payload_scan_cp(
-    mesh: Mesh,
-    trans,                  # [S, K]
-    byteclass,              # [256]
-    start,                  # scalar int32
-    data,                   # [B, L] — L sharded over seq_axis
-    lengths,                # [B]
-    seq_axis: str = "seq",
-    block: int = 256,
-):
-    """Context-parallel payload scan: L sharded across ``seq_axis``;
-    per-device blockwise composition + ring ppermute of the carry."""
+@functools.lru_cache(maxsize=None)
+def _cp_step(mesh: Mesh, seq_axis: str, block: int):
+    """Cached shard_map wrapper per (mesh, axis, block): the wrapper
+    used to be rebuilt inside :func:`payload_scan_cp`, so every call
+    was a fresh closure — a jit-cache miss and a full re-trace per
+    payload batch (ctlint recompile-hazard). Batch size and shard
+    length are read off the shard inside, so the same compiled step
+    serves every payload shape that hits it."""
     n_dev = mesh.shape[seq_axis]
-    B, L = data.shape
-    assert L % n_dev == 0, "payload length must divide the seq axis"
-    shard_len = L // n_dev
 
     def local(trans, byteclass, start, data_shard, lengths):
+        B, shard_len = data_shard.shape
         # my position on the ring
         idx = lax.axis_index(seq_axis)
         offset = idx * shard_len
@@ -168,13 +163,31 @@ def payload_scan_cp(
         all_states = lax.all_gather(states, seq_axis)   # [n_dev, B]
         return all_states[n_dev - 1]
 
-    from jax.experimental.shard_map import shard_map
+    from cilium_tpu.parallel.compat import shard_map
 
-    fn = shard_map(
+    return shard_map(
         local, mesh=mesh,
         in_specs=(P(), P(), P(), P(None, seq_axis), P()),
         out_specs=P(),
-        check_rep=False,
+        check_vma=False,
     )
+
+
+def payload_scan_cp(
+    mesh: Mesh,
+    trans,                  # [S, K]
+    byteclass,              # [256]
+    start,                  # scalar int32
+    data,                   # [B, L] — L sharded over seq_axis
+    lengths,                # [B]
+    seq_axis: str = "seq",
+    block: int = 256,
+):
+    """Context-parallel payload scan: L sharded across ``seq_axis``;
+    per-device blockwise composition + ring ppermute of the carry."""
+    n_dev = mesh.shape[seq_axis]
+    _B, L = data.shape
+    assert L % n_dev == 0, "payload length must divide the seq axis"
+    fn = _cp_step(mesh, seq_axis, block)
     return fn(trans, byteclass, jnp.asarray(start, jnp.int32), data,
               lengths)

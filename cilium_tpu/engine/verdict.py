@@ -979,6 +979,7 @@ def verdict_step_capture(arrays: Dict[str, jax.Array],
     src = jnp.where(ingress, c("peer_ids"), c("ep_ids"))
     dst = jnp.where(ingress, c("ep_ids"), c("peer_ids"))
     n = len(_ROW_COLS)
+    # ctlint: disable=recompile-hazard  # row width is static per capture layout: one compile per layout, by design
     gen_cols = ((rows[:, n], rows[:, n + 1:])
                 if rows.shape[1] > n else None)
     return _verdict_core(

@@ -34,7 +34,7 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Dict, Iterable, List, Optional
+from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
@@ -151,6 +151,16 @@ def _native() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint32,
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint32),
             ctypes.c_uint32, ctypes.c_void_p, ctypes.c_uint64]
+        # the v3 writer was the ONE symbol bound without argtypes —
+        # its calls hand-wrapped every scalar and nothing checked the
+        # pointer marshaling (ctlint abi-surface); declared here with
+        # the rest of the surface
+        lib.ct_capture_write_l7g.restype = ctypes.c_int
+        lib.ct_capture_write_l7g.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_uint32, ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.c_void_p, ctypes.c_uint32]
         lib.ct_capture_l7_info.restype = ctypes.c_int
         lib.ct_capture_l7_info.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint32),
@@ -413,8 +423,8 @@ def write_capture_l7(path: str, flows: Iterable[Flow]) -> int:
             int(blob.size)))
         return len(rec)
     if lib is not None and gen is not None:
-        # _native() guarantees the v3 symbol (pre-v3 ABIs load as None)
-        lib.ct_capture_write_l7g.restype = ctypes.c_int
+        # _native() guarantees the v3 symbol (pre-v3 ABIs load as
+        # None) and declared its argtypes/restype with the rest
         _check(lib.ct_capture_write_l7g(
             path.encode(),
             np.ascontiguousarray(rec).ctypes.data_as(ctypes.c_void_p),

@@ -168,7 +168,11 @@ class K8sWatcherBridge:
                 "spec": {"ipam": {"podCIDRs":
                                   [pod_cidr] if pod_cidr else []}},
             })
-        except (OSError, RuntimeError) as e:
+        except (OSError, RuntimeError, Conflict) as e:
+            # best-effort like publish_endpoint: two publishers (the
+            # periodic sync controller vs an explicit sync) can race
+            # apply's get→update and the loser gets a Conflict the
+            # next tick converges — it must not escape the caller
             LOG.warning("CiliumNode publish failed",
                         extra={"fields": {"error": str(e)}})
 

@@ -59,6 +59,30 @@ def make_mesh(
     return Mesh(grid, tuple(axis_names))
 
 
+def mesh_from_config(pcfg, devices: Optional[Sequence] = None) -> Mesh:
+    """Build the mesh a :class:`~cilium_tpu.core.config.ParallelConfig`
+    describes — the TOML/env-driven face of :func:`make_mesh`:
+    ``data_axis`` (DP over the flow batch), plus ``expert_axis`` (EP
+    over DFA banks) when ``use_expert_axis`` is set; ``mesh_shape``
+    pins the layout (None → every device on the data axis)."""
+    axes = ((pcfg.data_axis, pcfg.expert_axis)
+            if pcfg.use_expert_axis else (pcfg.data_axis,))
+    shape = pcfg.mesh_shape
+    if shape is not None:
+        shape = tuple(shape)
+        if len(shape) != len(axes):
+            raise ValueError(
+                f"mesh_shape {shape} has {len(shape)} axes but the "
+                f"config names {len(axes)} ({axes})")
+    return make_mesh(shape, axes, devices)
+
+
+def mesh_from_root_config(cfg, devices: Optional[Sequence] = None) -> Mesh:
+    """:func:`mesh_from_config` off a root ``Config`` (its
+    ``parallel`` section)."""
+    return mesh_from_config(cfg.parallel, devices)
+
+
 def data_parallel_mesh(n: Optional[int] = None) -> Mesh:
     devs = jax.devices()
     if n is not None:

@@ -27,6 +27,7 @@ than pattern count, so it is the axis TP must cut.
 
 from __future__ import annotations
 
+import functools
 from typing import Tuple
 
 import jax
@@ -34,6 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+
+from cilium_tpu.parallel.compat import shard_map
 
 #: one-hot matmul carries state ids in f32 — exact only below 2^24
 MAX_TP_STATES = 1 << 24
@@ -106,6 +109,27 @@ def _local_scan(trans_l, byteclass, start, accept_l, data, lengths,
     return finals, out
 
 
+@functools.lru_cache(maxsize=None)
+def _tp_step(mesh: Mesh, state_axis: str):
+    """Cached shard_map wrapper per (mesh, axis). Building the wrapper
+    inside :func:`dfa_scan_tp` made every call a fresh closure — a
+    jit-cache miss and a full re-trace per batch (found by ctlint
+    recompile-hazard); byteclass/start ride as replicated args so the
+    wrapped callable itself is invariant."""
+
+    def wrapped(trans, byteclass, start, accept, data, lengths):
+        return _local_scan(trans, byteclass, start, accept, data,
+                           lengths, state_axis)
+
+    return shard_map(
+        wrapped, mesh=mesh,
+        in_specs=(P(state_axis, None), P(), P(),
+                  P(state_axis, None), P(None, None), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+
+
 def dfa_scan_tp(
     mesh: Mesh,
     trans: jax.Array,       # [S, K] int32 — S divisible by mesh[state_axis]
@@ -118,17 +142,33 @@ def dfa_scan_tp(
 ) -> Tuple[jax.Array, jax.Array]:
     """State-axis-sharded DFA scan → (finals [B], accept words [B, W])."""
     _check_state_count(trans.shape[0])
-    fn = jax.shard_map(
-        lambda t, a, d, ln: _local_scan(
-            t, byteclass, jnp.asarray(start, jnp.int32), a, d, ln,
-            state_axis),
-        mesh=mesh,
-        in_specs=(P(state_axis, None), P(state_axis, None), P(None, None),
-                  P()),
-        out_specs=(P(), P()),
+    fn = _tp_step(mesh, state_axis)
+    return fn(trans, byteclass, jnp.asarray(start, jnp.int32), accept,
+              data, lengths)
+
+
+@functools.lru_cache(maxsize=None)
+def _tp_banked_step(mesh: Mesh, state_axis: str):
+    """Cached banked-TP wrapper per (mesh, axis) — same per-call
+    re-trace fix as :func:`_tp_step`, with byteclass as a replicated
+    arg instead of a closure."""
+
+    def local(trans_l, byteclass, starts, accept_l, data, lengths):
+        def one_bank(t, a, s, bc):
+            _, words = _local_scan(t, bc, s, a, data, lengths,
+                                   state_axis)
+            return words
+        words = jax.vmap(one_bank)(trans_l, accept_l, starts,
+                                   byteclass)        # [NB, B, W]
+        return jnp.transpose(words, (1, 0, 2))       # [B, NB, W]
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P(None, state_axis, None), P(),
+                  P(), P(None, state_axis, None), P(None, None), P()),
+        out_specs=P(),
         check_vma=False,
     )
-    return fn(trans, accept, data, lengths)
 
 
 def dfa_scan_banked_tp(
@@ -144,20 +184,5 @@ def dfa_scan_banked_tp(
     """All banks, state-axis TP → accept words ``[B, NB, W]`` uint32
     (same contract as ``dfa_kernel.dfa_scan_banked``)."""
     _check_state_count(trans.shape[1])
-
-    def local(trans_l, accept_l, starts, data, lengths):
-        def one_bank(t, a, s, bc):
-            _, words = _local_scan(t, bc, s, a, data, lengths, state_axis)
-            return words
-        words = jax.vmap(one_bank)(trans_l, accept_l, starts,
-                                   byteclass)        # [NB, B, W]
-        return jnp.transpose(words, (1, 0, 2))       # [B, NB, W]
-
-    fn = jax.shard_map(
-        local, mesh=mesh,
-        in_specs=(P(None, state_axis, None), P(None, state_axis, None),
-                  P(), P(None, None), P()),
-        out_specs=P(),
-        check_vma=False,
-    )
-    return fn(trans, accept, start, data, lengths)
+    fn = _tp_banked_step(mesh, state_axis)
+    return fn(trans, byteclass, start, accept, data, lengths)

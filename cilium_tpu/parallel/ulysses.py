@@ -23,11 +23,41 @@ ready for the (cheap, local) conjunction + verdict stage.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from cilium_tpu.engine.dfa_kernel import dfa_scan_banked
+from cilium_tpu.parallel.compat import shard_map
+
+
+@functools.lru_cache(maxsize=None)
+def _ulysses_step(mesh: Mesh, axis: str):
+    """Cached shard_map wrapper per (mesh, axis): building it inside
+    :func:`ulysses_scan_banked` made every call a fresh closure — a
+    jit-cache miss and full re-trace per chunk (ctlint
+    recompile-hazard)."""
+
+    def local(trans_l, byteclass_l, start_l, accept_l, data_l, lengths_l):
+        # gather the full (encoded, byte-compressed) flow slice set —
+        # inputs are the *small* tensors; transition tables never move
+        all_data = lax.all_gather(data_l, axis, tiled=True)      # [B, L]
+        all_len = lax.all_gather(lengths_l, axis, tiled=True)    # [B]
+        words = dfa_scan_banked(trans_l, byteclass_l, start_l, accept_l,
+                                all_data, all_len)  # [B, NB/n, W]
+        # Ulysses switch: split batch, concat banks → [B/n, NB, W]
+        return lax.all_to_all(words, axis, split_axis=0, concat_axis=1,
+                              tiled=True)
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis, None, None), P(axis, None), P(axis),
+                  P(axis, None, None), P(axis, None), P(axis)),
+        out_specs=P(axis, None, None),
+        check_vma=False,
+    )
 
 
 def ulysses_scan_banked(
@@ -42,23 +72,5 @@ def ulysses_scan_banked(
 ) -> jax.Array:
     """Bank-sharded scan of batch-sharded inputs → words ``[B, NB, W]``
     batch-sharded on ``axis`` (bit-identical to ``dfa_scan_banked``)."""
-
-    def local(trans_l, byteclass_l, start_l, accept_l, data_l, lengths_l):
-        # gather the full (encoded, byte-compressed) flow slice set —
-        # inputs are the *small* tensors; transition tables never move
-        all_data = lax.all_gather(data_l, axis, tiled=True)      # [B, L]
-        all_len = lax.all_gather(lengths_l, axis, tiled=True)    # [B]
-        words = dfa_scan_banked(trans_l, byteclass_l, start_l, accept_l,
-                                all_data, all_len)  # [B, NB/n, W]
-        # Ulysses switch: split batch, concat banks → [B/n, NB, W]
-        return lax.all_to_all(words, axis, split_axis=0, concat_axis=1,
-                              tiled=True)
-
-    fn = jax.shard_map(
-        local, mesh=mesh,
-        in_specs=(P(axis, None, None), P(axis, None), P(axis),
-                  P(axis, None, None), P(axis, None), P(axis)),
-        out_specs=P(axis, None, None),
-        check_vma=False,
-    )
+    fn = _ulysses_step(mesh, axis)
     return fn(trans, byteclass, start, accept, data, lengths)

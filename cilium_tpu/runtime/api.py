@@ -170,6 +170,12 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 # analog): live (l7proto, direction) → proxy port
                 return self._send(200, agent.proxy_manager.dump())
             if path == "/v1/metrics":
+                # Config.enable_metrics gates the scrape surface (the
+                # reference's --enable-metrics): counters still count
+                # internally, the exposition endpoint just declines
+                if not getattr(agent.config, "enable_metrics", True):
+                    return self._send(
+                        404, b'{"error": "metrics disabled"}')
                 return self._send(200, METRICS.expose().encode(),
                                   content_type="text/plain; version=0.0.4")
             if path == "/v1/trace":

@@ -32,6 +32,7 @@ def main() -> int:
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from cilium_tpu.parallel.compat import shard_map
     from cilium_tpu.parallel.multihost import (
         global_mesh,
         init_multihost,
@@ -43,7 +44,7 @@ def main() -> int:
 
     # 1. DCN proof: psum across processes (1 CPU device per process)
     mesh = global_mesh()
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         lambda x: jax.lax.psum(x, "data"), mesh=mesh,
         in_specs=P("data"), out_specs=P()))
     ga = jax.make_array_from_process_local_data(

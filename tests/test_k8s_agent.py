@@ -130,6 +130,34 @@ def test_ccnp_ingest(tmp_path):
         server.stop()
 
 
+def test_publish_node_conflict_is_best_effort(tmp_path):
+    """Two publishers (periodic sync controller vs explicit sync) can
+    race apply's get→update on the CiliumNode object; the loser's
+    Conflict must stay inside publish_node (it converges next tick),
+    exactly like publish_endpoint — a full-suite-load flake before
+    the fix."""
+    from cilium_tpu.k8s.apiserver import Conflict
+
+    server = APIServer(str(tmp_path / "k8s.sock")).start()
+    agent = make_agent(server.socket_path)
+    try:
+        bridge = agent.k8s_bridge
+
+        def conflicting_apply(plural, obj):
+            raise Conflict("stale resourceVersion 1 (current 3)")
+
+        original = bridge.client.apply
+        bridge.client.apply = conflicting_apply
+        try:
+            bridge.publish_node()  # must not raise
+        finally:
+            bridge.client.apply = original
+        bridge.publish_node()      # and the real path still works
+    finally:
+        agent.stop()
+        server.stop()
+
+
 def test_cep_and_node_status_published(tmp_path):
     server = APIServer(str(tmp_path / "k8s.sock")).start()
     c = K8sClient(server.socket_path)

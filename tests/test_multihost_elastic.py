@@ -16,7 +16,17 @@ import socket
 import subprocess
 import sys
 
+import jax
 import pytest
+
+# pre-jax.shard_map generations (the baked image's jax) cannot run
+# multiprocess collectives on the CPU backend at all
+# ("Multiprocess computations aren't implemented on the CPU
+# backend.") — skip rather than fail so tier-1 stays signal-clean
+pytestmark = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="this jax generation lacks CPU multiprocess collectives "
+           "(and jax.shard_map)")
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "multihost_worker.py")

@@ -44,6 +44,9 @@ def shim():
     lib.cshim_policy_revision.restype = ctypes.c_uint32
     lib.cshim_policy_set_ttl.argtypes = [ctypes.c_double]
     lib.cshim_policy_set_ttl.restype = None
+    # disconnect returns void — without this the ctypes default
+    # (c_int) reads garbage (ctlint abi-surface)
+    lib.cshim_disconnect.restype = None
     lib.cshim_connect.argtypes = [ctypes.c_char_p]
     lib.cshim_on_new_connection.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_uint32,

@@ -154,6 +154,18 @@ def shim_lib():
         ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+    # ctlint abi-surface: the inject drains return C `long` (the
+    # c_int default truncates on LP64) and take pointer buffers, and
+    # disconnect returns void — declare the full contract here so no
+    # call relies on ctypes defaults
+    lib.cshim_take_inject.argtypes = [
+        ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t]
+    lib.cshim_take_inject.restype = ctypes.c_long
+    lib.cshim_take_inject_req.argtypes = [
+        ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t]
+    lib.cshim_take_inject_req.restype = ctypes.c_long
+    lib.cshim_close_connection.argtypes = [ctypes.c_uint64]
+    lib.cshim_disconnect.restype = None
     return lib
 
 
@@ -223,8 +235,7 @@ def test_cpp_shim_header_rewrites(shim_lib):
 
         # the mutated frame is UPSTREAM-bound: it rides the request-
         # direction inject queue, never the client-bound one
-        shim_lib.cshim_take_inject.restype = ctypes.c_long
-        shim_lib.cshim_take_inject_req.restype = ctypes.c_long
+        # (restype/argtypes declared once in the shim_lib fixture)
         ibuf = (ctypes.c_uint8 * 1024)()
         assert shim_lib.cshim_take_inject(91, ibuf, 1024) == 0
         ilen = shim_lib.cshim_take_inject_req(91, ibuf, 1024)
@@ -248,6 +259,11 @@ def test_cpp_shim_header_rewrites(shim_lib):
         n = shim_lib.cshim_on_data(91, 0, 0, buf, len(ok), ops, 8)
         assert n == 1
         assert (ops[0], ops[1]) == (int(OpType.PASS), len(ok))
+        # connection teardown crosses the ABI too (drops any
+        # undrained inject bytes server- and shim-side) — the one
+        # cshim_* symbol nothing exercised before ctlint abi-surface
+        # flagged it as unbound
+        assert shim_lib.cshim_close_connection(91) == 0
         shim_lib.cshim_disconnect()
     finally:
         service.stop()
@@ -367,7 +383,6 @@ def test_cpp_shim_end_to_end(shim_lib):
 
         # the denied produce's error response rides the shim's INJECT
         # channel: a well-formed broker frame, correlation id echoed
-        shim_lib.cshim_take_inject.restype = ctypes.c_long
         ibuf = (ctypes.c_uint8 * 512)()
         ilen = shim_lib.cshim_take_inject(77, ibuf, 512)
         assert ilen > 0, "expected injected Kafka error response"
