@@ -3,9 +3,9 @@
 
 PY ?= python
 
-.PHONY: test shim lint determinism dryrun chaos obs bench bench-all \
-        bench-e2e bench-service bench-regen bench-sp bench-stream \
-        bench-multichip bench-watch check
+.PHONY: test shim lint determinism dryrun chaos obs soak bench \
+        bench-all bench-e2e bench-service bench-regen bench-sp \
+        bench-stream bench-multichip bench-watch check
 
 test:            ## full suite (CPU, virtual 8-device mesh via conftest)
 	$(PY) -m pytest tests/ -q
@@ -45,6 +45,14 @@ obs:             ## observability lane: tracing tests + scrape lint
 	text = METRICS.expose(); errs = lint_exposition(text); \
 	assert not errs, errs; \
 	print('scrape-lint OK:', len(text.splitlines()), 'lines')"
+
+# soak: short synthetic overload (4× saturation) against the
+# admission-controlled batcher path — asserts shed > 0 with the queue
+# depth bounded at max_pending and admitted-request p99 within 2× the
+# unloaded p99 (ISSUE 5 acceptance). Marked slow+soak so tier-1
+# timing never pays for it.
+soak:            ## synthetic-overload admission/shed lane
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_soak.py -q -m soak
 
 dryrun:          ## driver multi-chip contract on a virtual CPU mesh
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

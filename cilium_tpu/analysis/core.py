@@ -278,6 +278,9 @@ RULES: Dict[str, str] = {
                    "the package and test/bench surfaces",
     "config-surface": "Config field ⇄ TOML key ⇄ CILIUM_TPU_* env "
                       "var ⇄ docs mention, four-way parity",
+    "unbounded-queue": "no queue.Queue() without maxsize and no "
+                       "list-as-queue append without a bound/shed "
+                       "path in threaded runtime modules",
     "bare-disable": "every ctlint disable comment carries a "
                     "justification",
     "parse-error": "every analyzed file parses",
@@ -325,6 +328,7 @@ def run(root: str, targets: Sequence[str] = (DEFAULT_TARGET,),
         imports,
         locks,
         purity,
+        queues,
         recompile,
         registry,
         shapes,

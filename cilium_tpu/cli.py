@@ -42,6 +42,20 @@ def cmd_status(args) -> int:
     return 0
 
 
+def cmd_drain(args) -> int:
+    """Graceful drain: stop admitting data-path verdicts, flush — not
+    error — pending batches, snapshot warm-restart state. The service
+    keeps answering control ops; restart with loader.warm_restore to
+    complete the warm cycle."""
+    from cilium_tpu.runtime.service import VerdictClient
+
+    c = VerdictClient(args.socket)
+    resp = c.call({"op": "drain"})
+    print(json.dumps(resp, indent=2, default=str))
+    c.close()
+    return 0 if resp.get("ok") else 1
+
+
 def cmd_policy_get(args) -> int:
     from cilium_tpu.runtime.service import VerdictClient
 
@@ -853,6 +867,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = sub.add_parser("status", help="agent status")
     p.add_argument("--socket", required=True)
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("drain", help="gracefully drain the verdict "
+                       "service (flush pending, snapshot warm state)")
+    p.add_argument("--socket", required=True)
+    p.set_defaults(fn=cmd_drain)
 
     p = sub.add_parser("policy", help="policy introspection")
     psub = p.add_subparsers(dest="policy_cmd", required=True)

@@ -60,6 +60,37 @@ class LoaderConfig:
 
     cache_dir: str = os.path.expanduser("~/.cache/cilium_tpu")
     enable_cache: bool = True
+    #: restore the last drain's warm snapshot (revision + compiled
+    #: policy + oracle snapshot) at Agent.start when no policy has
+    #: been loaded yet — the restarted service answers its first
+    #: request verdict-identically without recompilation
+    warm_restore: bool = False
+
+
+@dataclasses.dataclass
+class AdmissionConfig:
+    """Overload admission control (runtime/admission.py): bounded
+    verdict-queue occupancy with explicit sheds, two priority classes
+    (control traffic never sheds behind data-path verdicts), deadline
+    feasibility, and the drain/warm-restart sequence's knobs."""
+
+    enabled: bool = True
+    #: verdict-queue occupancy bound: data-path requests shed here
+    max_pending: int = 1024
+    #: control-class headroom above max_pending (policy/config/drain/
+    #: health ops admitted while data traffic sheds)
+    control_reserve: int = 64
+    #: deadline assigned to requests that carry none (deadline_ms on
+    #: the wire overrides per request)
+    default_deadline_ms: float = 5000.0
+    #: REST API bound: concurrent in-flight handlers before 503 sheds
+    api_max_inflight: int = 64
+    #: per-session chunk credits a stream server advertises (0
+    #: disables credit flow control)
+    stream_credit_window: int = 32
+    #: drain flush budget: pending verdicts still unflushed after this
+    #: resolve as ERROR (the abort tail of a stuck drain)
+    drain_timeout_s: float = 30.0
 
 
 @dataclasses.dataclass
@@ -134,6 +165,8 @@ class Config:
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     breaker: BreakerConfig = dataclasses.field(default_factory=BreakerConfig)
     tracing: TracingConfig = dataclasses.field(default_factory=TracingConfig)
+    admission: AdmissionConfig = dataclasses.field(
+        default_factory=AdmissionConfig)
     log_level: str = "info"
     #: ``--k8s-api-socket``: when set, the agent consumes CNP/CCNP
     #: from the fake-apiserver (cilium_tpu.k8s) through list+watch
@@ -174,6 +207,12 @@ class Config:
         if "CILIUM_TPU_TRACE_SAMPLE_RATE" in env:
             cfg.tracing.sample_rate = float(
                 env["CILIUM_TPU_TRACE_SAMPLE_RATE"])
+        if "CILIUM_TPU_ADMISSION_MAX_PENDING" in env:
+            cfg.admission.max_pending = int(
+                env["CILIUM_TPU_ADMISSION_MAX_PENDING"])
+        if "CILIUM_TPU_STREAM_CREDIT_WINDOW" in env:
+            cfg.admission.stream_credit_window = int(
+                env["CILIUM_TPU_STREAM_CREDIT_WINDOW"])
         return cfg
 
     @classmethod
@@ -196,7 +235,8 @@ class Config:
                                 ("loader", cfg.loader),
                                 ("parallel", cfg.parallel),
                                 ("breaker", cfg.breaker),
-                                ("tracing", cfg.tracing)):
+                                ("tracing", cfg.tracing),
+                                ("admission", cfg.admission)):
             for k, v in data.get(section, {}).items():
                 if hasattr(target, k):
                     setattr(target, k, tuple(v) if isinstance(v, list) else v)

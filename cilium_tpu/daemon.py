@@ -25,6 +25,7 @@ Examples::
 from __future__ import annotations
 
 import argparse
+import logging
 import signal
 import threading
 from typing import List, Optional
@@ -159,7 +160,18 @@ def main(argv: Optional[List[str]] = None,
     args = build_parser().parse_args(argv)
     agent, operator, kv = build(args)
     stop = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    # SIGTERM is the orchestrated shutdown (kubelet's grace period):
+    # drain first — stop admitting, flush pending verdicts, snapshot
+    # warm-restart state — so in-flight requests finish with real
+    # verdicts and the next process restores without recompiling.
+    # SIGINT (^C) stays the fast path: stop without the drain flush.
+    drain_first = threading.Event()
+
+    def _sigterm(*_):
+        drain_first.set()
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sigterm)
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     if operator is not None:
         operator.start()
@@ -169,6 +181,15 @@ def main(argv: Optional[List[str]] = None,
     try:
         stop.wait()
     finally:
+        if drain_first.is_set():
+            try:
+                agent.drain()
+            except Exception as e:  # noqa: BLE001 — still stop cleanly
+                # a failed drain (e.g. an injected service.drain
+                # fault) must not block shutdown; pending entries
+                # resolve via the stop path instead
+                logging.getLogger("cilium_tpu.daemon").warning(
+                    "drain before stop failed: %s", e)
         agent.stop()
         if operator is not None:
             operator.stop()

@@ -1,0 +1,261 @@
+"""Bounded admission control for the serving path.
+
+The reference agent survives traffic spikes because the datapath
+bounds work per admitted packet and pushes back on producers instead
+of buffering arbitrarily (the kernel-offload and selective-copy
+arguments in PAPERS.md make the same point from the socket side).
+Before this module our service plane had the opposite shape:
+``MicroBatcher._pending`` grew without bound under overload, callers
+that hit their timeout still consumed device batch slots, and p99
+diverged instead of shedding.
+
+This module is the front door every serving ingress now passes:
+
+* **Bounded queue occupancy.** ``AdmissionGate.admit`` sheds when the
+  verdict queue is at its configured bound
+  (``Config.admission.max_pending``) — an explicit, counted shed
+  response beats an unbounded queue and a timeout.
+* **Two priority classes.** ``CLASS_CONTROL`` (policy updates, drain,
+  health — the ops an operator needs DURING an overload) gets
+  ``control_reserve`` headroom above the data-path bound, so control
+  traffic never sheds behind data-path verdicts.
+* **Deadline feasibility.** Requests carry deadlines (absolute
+  monotonic seconds; ``deadline_from_ms`` builds them from the wire's
+  ``deadline_ms``). A request whose deadline cannot be met given the
+  current queue depth and the recent batch service rate is shed AT
+  ADMISSION — serving it would waste a device batch slot on an answer
+  nobody is waiting for.
+* **Abandoned-request reaping.** The MicroBatcher carries each
+  entry's deadline; entries whose caller timed out (abandoned) or
+  whose deadline passed while queued are dropped before
+  featurize/dispatch and counted (``cilium_tpu_admission_reaped_total``).
+* **Drain mode.** ``begin_drain`` stops admitting data-path work
+  (control still admitted — a draining service must answer status and
+  the drain op itself) ahead of the flush + warm-snapshot sequence in
+  ``VerdictService.drain``.
+
+``RequestSlots`` is the same discipline for the REST API
+(``runtime/api.py``): a bounded in-flight count with control-class
+headroom, returning explicit 503 sheds instead of piling threads.
+
+Shed decisions are visible three ways: counters
+(``cilium_tpu_admission_{admitted,shed}_total``), the queue-depth
+gauge, and a ``shed``-phase span on sampled traces
+(``runtime/tracing.py``) so a shed request's trace says WHY it never
+reached the engine.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+from cilium_tpu.runtime import faults
+from cilium_tpu.runtime.metrics import (
+    ADMISSION_ADMITTED,
+    ADMISSION_QUEUE_DEPTH,
+    ADMISSION_REAPED,
+    ADMISSION_SHED,
+    METRICS,
+)
+
+#: priority classes: data-path verdict traffic sheds first; control
+#: traffic (policy/config/drain/health) gets reserved headroom
+CLASS_DATA = "data"
+CLASS_CONTROL = "control"
+
+#: shed reasons (the ``reason`` label on the shed counter)
+SHED_QUEUE_FULL = "queue-full"
+SHED_DEADLINE = "deadline"
+SHED_DRAINING = "draining"
+SHED_FAULT = "fault"
+
+#: fires at every admission decision; an injected fault forces a shed
+#: (reason "fault") — the chaos suite's handle on the gate
+ADMIT_POINT = faults.register_point(
+    "service.admit", "admission decision in AdmissionGate.admit")
+
+
+def deadline_from_ms(deadline_ms, default_ms: float,
+                     clock=time.monotonic) -> float:
+    """Absolute monotonic deadline from a wire-carried ``deadline_ms``.
+    None/0/unparsable → the configured default; NEGATIVE passes
+    through as already-expired (the caller declared it gave up — the
+    gate sheds it with reason "deadline")."""
+    try:
+        ms = float(deadline_ms) if deadline_ms is not None else 0.0
+    except (TypeError, ValueError):
+        ms = 0.0
+    if ms == 0.0:
+        ms = float(default_ms)
+    return clock() + ms / 1e3
+
+
+def count_shed(surface: str, klass: str, reason: str) -> None:
+    """One shed, on the shared counter — callers that shed outside the
+    gate (the MicroBatcher's hard bound) stay on the same series."""
+    METRICS.inc(ADMISSION_SHED,
+                labels={"surface": surface, "class": klass,
+                        "reason": reason})
+
+
+class AdmissionGate:
+    """The verdict-path admission decision. One instance per
+    :class:`~cilium_tpu.runtime.service.VerdictService`; ``depth_fn``
+    reads the MicroBatcher's queue occupancy so the bound tracks the
+    real backlog, not a shadow counter."""
+
+    def __init__(self, max_pending: int = 1024,
+                 control_reserve: int = 64, enabled: bool = True,
+                 depth_fn: Optional[Callable[[], int]] = None,
+                 clock=time.monotonic, surface: str = "service"):
+        self.max_pending = max(1, int(max_pending))
+        self.control_reserve = max(0, int(control_reserve))
+        self.enabled = bool(enabled)
+        self.depth_fn = depth_fn
+        self.clock = clock
+        self.surface = surface
+        self._lock = threading.Lock()
+        self._draining = False
+        #: EWMA of the batcher's service rate (records/second) — the
+        #: denominator of the deadline-feasibility estimate
+        self._rate = 0.0
+
+    @classmethod
+    def from_config(cls, cfg, depth_fn=None,
+                    surface: str = "service") -> "AdmissionGate":
+        """Build from ``Config.admission`` (tolerates absence so
+        standalone loaders/old configs keep working)."""
+        return cls(
+            max_pending=getattr(cfg, "max_pending", 1024),
+            control_reserve=getattr(cfg, "control_reserve", 64),
+            enabled=getattr(cfg, "enabled", True),
+            depth_fn=depth_fn, surface=surface)
+
+    # -- drain ------------------------------------------------------------
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def begin_drain(self) -> None:
+        """Stop admitting data-path work (idempotent). Control traffic
+        stays admitted — a draining service must still answer status,
+        metrics, and the drain op itself."""
+        with self._lock:
+            self._draining = True
+
+    # -- feasibility estimate ---------------------------------------------
+    def note_batch(self, records: int, seconds: float) -> None:
+        """Fold one completed batch into the service-rate EWMA (the
+        MicroBatcher calls this per flush)."""
+        if records <= 0 or seconds <= 0.0:
+            return
+        rate = records / seconds
+        with self._lock:
+            self._rate = rate if self._rate <= 0.0 \
+                else 0.8 * self._rate + 0.2 * rate
+
+    def estimated_wait(self, depth: int) -> float:
+        """Seconds a request arriving now waits behind ``depth``
+        queued records (0 until a rate estimate exists)."""
+        with self._lock:
+            rate = self._rate
+        return depth / rate if rate > 0.0 else 0.0
+
+    # -- the decision -----------------------------------------------------
+    def admit(self, klass: str = CLASS_DATA,
+              deadline: Optional[float] = None) -> Tuple[bool, str]:
+        """(admitted, shed_reason). Sheds are counted; admitted
+        requests are counted per class. Disabled gates only enforce
+        drain mode — drain correctness trumps the knob."""
+        try:
+            faults.maybe_fail(ADMIT_POINT)
+        except Exception:  # noqa: BLE001 — plan-chosen exception
+            # an injected admission fault IS a shed: the request is
+            # refused explicitly, never half-admitted
+            count_shed(self.surface, klass, SHED_FAULT)
+            return False, SHED_FAULT
+        with self._lock:
+            draining = self._draining
+        if draining and klass != CLASS_CONTROL:
+            count_shed(self.surface, klass, SHED_DRAINING)
+            return False, SHED_DRAINING
+        if not self.enabled:
+            return True, ""
+        depth = self.depth_fn() if self.depth_fn is not None else 0
+        METRICS.set_gauge(ADMISSION_QUEUE_DEPTH, float(depth),
+                          labels={"surface": self.surface})
+        bound = self.max_pending + (self.control_reserve
+                                    if klass == CLASS_CONTROL else 0)
+        if depth >= bound:
+            count_shed(self.surface, klass, SHED_QUEUE_FULL)
+            return False, SHED_QUEUE_FULL
+        if deadline is not None:
+            remaining = deadline - self.clock()
+            if remaining <= 0.0 or remaining < self.estimated_wait(depth):
+                # infeasible: the caller will have given up before we
+                # could answer — admitting it only wastes a batch slot
+                count_shed(self.surface, klass, SHED_DEADLINE)
+                return False, SHED_DEADLINE
+        METRICS.inc(ADMISSION_ADMITTED,
+                    labels={"surface": self.surface, "class": klass})
+        return True, ""
+
+    def reap(self, count: int = 1) -> None:
+        """Count entries dropped before dispatch (abandoned callers /
+        expired deadlines) — the MicroBatcher's reaping face."""
+        if count > 0:
+            METRICS.inc(ADMISSION_REAPED, count)
+
+
+class RequestSlots:
+    """Bounded in-flight admission for the REST API: each request
+    holds a slot for its handler's duration; data-class requests shed
+    at ``max_inflight``, control-class requests get ``control_reserve``
+    headroom so policy/config/drain ops land during overload."""
+
+    def __init__(self, max_inflight: int = 64,
+                 control_reserve: int = 8, enabled: bool = True):
+        self.max_inflight = max(0, int(max_inflight))
+        self.control_reserve = max(0, int(control_reserve))
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._inflight = 0
+
+    @classmethod
+    def from_config(cls, cfg) -> "RequestSlots":
+        return cls(max_inflight=getattr(cfg, "api_max_inflight", 64),
+                   control_reserve=getattr(cfg, "control_reserve", 64),
+                   enabled=getattr(cfg, "enabled", True))
+
+    def acquire(self, klass: str = CLASS_DATA) -> Tuple[bool, str]:
+        if not self.enabled:
+            with self._lock:
+                self._inflight += 1
+            return True, ""
+        bound = self.max_inflight + (self.control_reserve
+                                     if klass == CLASS_CONTROL else 0)
+        with self._lock:
+            if self._inflight >= bound:
+                shed = True
+            else:
+                shed = False
+                self._inflight += 1
+        if shed:
+            count_shed("api", klass, SHED_QUEUE_FULL)
+            return False, SHED_QUEUE_FULL
+        METRICS.inc(ADMISSION_ADMITTED,
+                    labels={"surface": "api", "class": klass})
+        return True, ""
+
+    def release(self) -> None:
+        with self._lock:
+            if self._inflight > 0:
+                self._inflight -= 1
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight

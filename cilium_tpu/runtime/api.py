@@ -43,12 +43,20 @@ import threading
 import urllib.parse
 from typing import Dict, Optional, Tuple
 
+from cilium_tpu.runtime import admission
 from cilium_tpu.runtime.metrics import METRICS
 from cilium_tpu.runtime.unixsock import unlink_if_stale
 
 #: config fields PATCHable at runtime (the reference's runtime-mutable
 #: DaemonConfig subset; everything else requires an agent restart)
 _MUTABLE_CONFIG = ("enable_tpu_offload",)
+
+#: control-class resources: the ops an operator needs DURING an
+#: overload (health, config, policy mutation, drain, auth, the scrape
+#: surface) — admitted with reserved headroom above the data-class
+#: in-flight bound, so they never shed behind bulk reads
+_CONTROL_PATHS = ("/v1/healthz", "/v1/config", "/v1/policy",
+                  "/v1/drain", "/v1/auth", "/v1/metrics")
 
 
 class _UnixHTTPServer(socketserver.ThreadingUnixStreamServer):
@@ -93,8 +101,63 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         except ValueError:
             return None
 
+    # -- admission --------------------------------------------------------
+    @staticmethod
+    def _klass(path: str) -> str:
+        for prefix in _CONTROL_PATHS:
+            if path == prefix or path.startswith(prefix + "/"):
+                return admission.CLASS_CONTROL
+        return admission.CLASS_DATA
+
+    def _admit(self) -> bool:
+        """Bounded in-flight admission for REST ops: sheds with an
+        explicit 503 (``shed: true``) instead of piling handler
+        threads. Control paths get reserved headroom. A client-carried
+        ``X-Cilium-Deadline-Ms`` that is already non-positive sheds
+        immediately — the caller has given up."""
+        slots = getattr(self.server, "slots", None)
+        if slots is None:
+            self._held_slot = False
+            return True
+        path, _ = self._route()
+        klass = self._klass(path)
+        deadline_ms = self.headers.get("X-Cilium-Deadline-Ms")
+        if deadline_ms is not None:
+            try:
+                if float(deadline_ms) <= 0.0:
+                    admission.count_shed("api", klass,
+                                         admission.SHED_DEADLINE)
+                    self._held_slot = False
+                    self._send(503, {"error": "shed: deadline",
+                                     "shed": True,
+                                     "reason": admission.SHED_DEADLINE})
+                    return False
+            except ValueError:
+                pass  # unparsable header: ignore, admit normally
+        ok, reason = slots.acquire(klass)
+        if not ok:
+            self._held_slot = False
+            self._send(503, {"error": f"shed: {reason}", "shed": True,
+                             "reason": reason})
+            return False
+        self._held_slot = True
+        return True
+
+    def _release(self) -> None:
+        if getattr(self, "_held_slot", False):
+            self.server.slots.release()
+            self._held_slot = False
+
     # -- methods ----------------------------------------------------------
     def do_GET(self):  # noqa: N802
+        if not self._admit():
+            return
+        try:
+            self._do_GET()
+        finally:
+            self._release()
+
+    def _do_GET(self):
         agent = self.agent
         path, query = self._route()
         try:
@@ -204,6 +267,14 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             return self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
     def do_PUT(self):  # noqa: N802
+        if not self._admit():
+            return
+        try:
+            self._do_PUT()
+        finally:
+            self._release()
+
+    def _do_PUT(self):
         agent = self.agent
         path, _ = self._route()
         try:
@@ -317,7 +388,38 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         except Exception as e:
             return self._send(400, {"error": f"{type(e).__name__}: {e}"})
 
+    def do_POST(self):  # noqa: N802
+        if not self._admit():
+            return
+        try:
+            self._do_POST()
+        finally:
+            self._release()
+
+    def _do_POST(self):
+        agent = self.agent
+        path, _ = self._route()
+        try:
+            if path == "/v1/drain":
+                # graceful drain (SIGTERM's REST face): stop admitting
+                # data-path verdicts, flush pending batches through the
+                # engine, snapshot warm-restart state. The service
+                # keeps answering control ops afterwards; restart +
+                # Loader.restore_warm completes the warm cycle.
+                return self._send(200, agent.drain())
+            return self._send(404, {"error": f"no such resource {path}"})
+        except Exception as e:
+            return self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
     def do_PATCH(self):  # noqa: N802
+        if not self._admit():
+            return
+        try:
+            self._do_PATCH()
+        finally:
+            self._release()
+
+    def _do_PATCH(self):
         agent = self.agent
         path, _ = self._route()
         try:
@@ -378,6 +480,14 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             return self._send(400, {"error": f"{type(e).__name__}: {e}"})
 
     def do_DELETE(self):  # noqa: N802
+        if not self._admit():
+            return
+        try:
+            self._do_DELETE()
+        finally:
+            self._release()
+
+    def _do_DELETE(self):
         agent = self.agent
         path, _ = self._route()
         try:
@@ -416,6 +526,11 @@ class APIServer:
             unlink_if_stale(socket_path)
         handler = type("BoundHandler", (_Handler,), {"agent": agent})
         self._server = _UnixHTTPServer(socket_path, handler)
+        # bounded in-flight admission (runtime/admission.py): data-
+        # class requests shed at api_max_inflight; control paths get
+        # control_reserve headroom
+        self._server.slots = admission.RequestSlots.from_config(
+            getattr(agent.config, "admission", None))
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "APIServer":
@@ -506,6 +621,10 @@ class APIClient:
         return self.request("DELETE", "/v1/auth",
                             body={"src_identity": src_identity,
                                   "dst_identity": dst_identity})
+
+    def drain(self):
+        """Graceful drain: stop admitting, flush, warm-snapshot."""
+        return self.request("POST", "/v1/drain")
 
     def policy_get(self):
         return self.request("GET", "/v1/policy")[1]

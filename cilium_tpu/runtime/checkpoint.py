@@ -15,6 +15,16 @@ import pickle
 import threading
 from typing import Any, Optional
 
+from cilium_tpu.runtime.metrics import ARTIFACT_CACHE_CORRUPT, METRICS
+
+#: everything a poisoned/stale pickle can legitimately raise: I/O
+#: failures, truncation, garbage bytes, and artifacts referencing
+#: classes that moved or vanished across versions. Deliberately NOT
+#: ``Exception`` — a MemoryError or KeyboardInterrupt mid-load must
+#: propagate, not silently turn into "cache miss, recompile"
+_CORRUPT_ERRORS = (OSError, EOFError, pickle.UnpicklingError,
+                   AttributeError, ImportError)
+
 
 def ruleset_fingerprint(*parts: Any) -> str:
     """Stable hash over arbitrary picklable rule-set descriptors."""
@@ -43,8 +53,17 @@ class ArtifactCache:
         try:
             with open(path, "rb") as f:
                 return pickle.load(f)
-        except Exception:
-            return None  # corrupt cache entry → recompile
+        except _CORRUPT_ERRORS:
+            # corrupt entry → recompile; DELETE it so every later get
+            # of this key is a clean miss instead of a re-parse of the
+            # same poison, and count it so a recurring corruption
+            # (bad disk, version skew) is visible to operators
+            METRICS.inc(ARTIFACT_CACHE_CORRUPT)
+            try:
+                os.remove(path)
+            except OSError:
+                pass  # already gone, or unremovable — miss either way
+            return None
 
     def put(self, key: str, value: Any) -> None:
         if not self.enable:

@@ -43,6 +43,12 @@ Known injection points (registered by the modules owning the seam):
 ``loader.swap``            between stage and commit in ``runtime/loader.py``
 ``stream.frame.server``    per-chunk dispatch in ``StreamSession``
 ``stream.frame.client``    per-frame receive in ``StreamClient``
+``stream.credit``          credit-grant send in ``StreamSession`` (a
+                           fired fault LOSES the grant)
+``service.admit``          admission decision in ``runtime/admission.py``
+                           (a fired fault forces an explicit shed)
+``service.drain``          between stop-admitting and the pending
+                           flush in ``VerdictService.drain``
 ``kvstore.watch``          per-watch event delivery in ``kvstore.py``
 ``clustermesh.session``    remote-cluster event ingest in ``clustermesh.py``
 ``clustermesh.heartbeat``  local-state publisher heartbeat

@@ -23,7 +23,12 @@ from cilium_tpu.policy.selectorcache import SelectorCache
 from cilium_tpu.runtime.checkpoint import ArtifactCache, ruleset_fingerprint
 from cilium_tpu.runtime import faults
 from cilium_tpu.runtime.logging import get_logger, span as _log_span
-from cilium_tpu.runtime.metrics import LOADER_ROLLBACKS, METRICS, SpanStat
+from cilium_tpu.runtime.metrics import (
+    LOADER_ROLLBACKS,
+    METRICS,
+    SpanStat,
+    WARM_RESTORES,
+)
 from cilium_tpu.runtime.tracing import PHASE_HOST, TRACER
 
 LOG = get_logger("loader")
@@ -32,6 +37,12 @@ LOG = get_logger("loader")
 #: PREVIOUS revision serving (tests/test_faults.py pins it)
 SWAP_POINT = faults.register_point(
     "loader.swap", "revision swap in Loader.regenerate")
+
+#: artifact-cache key of the warm-restart snapshot (graceful drain
+#: writes it; a restarted loader restores from it). Versioned like
+#: the policy fingerprint epochs — bump on layout change so stale
+#: snapshots read as a clean miss, never as a misparse.
+WARM_STATE_KEY = "warm-state-v1"
 
 
 def _referenced_secret_values(per_identity, secrets) -> tuple:
@@ -98,6 +109,10 @@ class Loader:
         # revision; invalidated by _commit.
         self._fallback = None
         self._fallback_revision = -1
+        #: artifact-cache key of the ACTIVE compiled policy (None on
+        #: the oracle backend) — what the warm-restart snapshot points
+        #: at so a restarted loader skips fingerprint + compile
+        self._last_artifact_key: Optional[str] = None
 
     @property
     def revision(self) -> int:
@@ -213,6 +228,7 @@ class Loader:
             engine = OracleVerdictEngine(
                 per_identity, secret_lookup=secret_lookup,
                 audit=self.config.policy_audit_mode)
+            self._last_artifact_key = None
             return self._commit(engine, revision, per_identity, "oracle")
 
         from cilium_tpu.engine.verdict import CompiledPolicy, VerdictEngine
@@ -268,7 +284,87 @@ class Loader:
             with SpanStat("policy_stage"), \
                     TRACER.span("policy.stage", cache_hit=cached):
                 engine = VerdictEngine(policy, device=self.device)
+        self._last_artifact_key = key
         return self._commit(engine, revision, per_identity, "tpu")
+
+    # -- warm restart -----------------------------------------------------
+    def snapshot_warm(self) -> bool:
+        """Persist the serving state — revision, the compiled policy's
+        artifact key, and the resolved snapshot (from which the oracle
+        fallback rebuilds) — through the artifact cache. The graceful
+        drain calls this last, so a restarted service can
+        :meth:`restore_warm` and answer its first request
+        verdict-identically without recompilation (the reference's
+        pinned-map restart discipline, SURVEY §5.3/§5.4, applied to
+        compiled tensors instead of BPF maps)."""
+        with self._lock:
+            engine = self._engine
+            revision = self._revision
+            per_identity = self.per_identity
+            key = self._last_artifact_key
+        if engine is None or not self._cache.enable:
+            return False
+        self._cache.put(WARM_STATE_KEY, {
+            "format": 1,
+            "revision": revision,
+            "artifact_key": key,
+            "per_identity": per_identity,
+            "offload": bool(self.config.enable_tpu_offload),
+            "audit": bool(self.config.policy_audit_mode),
+        })
+        return True
+
+    def restore_warm(self) -> bool:
+        """Rebuild the serving state from the last drain's snapshot.
+        Fast path (gate unchanged, compiled artifact still cached):
+        stage the cached policy directly — no fingerprint walk, no
+        compile. Degraded path (artifact evicted/corrupt, or the
+        feature gate flipped since the snapshot): full
+        :meth:`regenerate` from the snapshot's resolved policy — still
+        no caller-side policy replay needed. Returns False on a clean
+        miss (no/stale snapshot); the caller then boots cold."""
+        state = self._cache.get(WARM_STATE_KEY)
+        if not isinstance(state, dict) or state.get("format") != 1:
+            return False
+        try:
+            revision = int(state["revision"])
+            per_identity = state["per_identity"]
+            key = state.get("artifact_key")
+            offload = bool(state.get("offload"))
+        except (KeyError, TypeError, ValueError):
+            return False
+        if self.config.enable_tpu_offload and offload and key:
+            policy = self._cache.get(key)
+            if policy is not None:
+                from cilium_tpu.engine.verdict import VerdictEngine
+
+                with _log_span(LOG, "warm restore", revision=revision,
+                               identities=len(per_identity)):
+                    with SpanStat("policy_stage"), \
+                            TRACER.span("policy.stage",
+                                        cache_hit=True, warm=True):
+                        engine = VerdictEngine(policy,
+                                               device=self.device)
+                self._last_artifact_key = key
+                self._commit(engine, revision, per_identity, "warm")
+                METRICS.inc(WARM_RESTORES)
+                return True
+        if not self.config.enable_tpu_offload and not offload:
+            secret_lookup = (self.secrets.lookup
+                             if self.secrets is not None else None)
+            engine = OracleVerdictEngine(
+                per_identity, secret_lookup=secret_lookup,
+                audit=self.config.policy_audit_mode)
+            self._last_artifact_key = None
+            self._commit(engine, revision, per_identity, "warm")
+            METRICS.inc(WARM_RESTORES)
+            return True
+        # artifact evicted or the gate flipped since the snapshot:
+        # regenerate from the snapshot's resolved policy (may compile,
+        # but the caller still needn't replay policy sources)
+        self.regenerate(per_identity, revision=revision)
+        METRICS.inc(WARM_RESTORES)
+        return True
 
     def regenerate_from_repo(self, repo: Repository, cache: SelectorCache,
                              endpoint_labels: Dict[int, LabelSet]):

@@ -52,6 +52,28 @@ DNSPROXY_FALLBACKS = "cilium_tpu_dnsproxy_fallback_total"
 #: labelled by phase — the aggregate face of per-request attribution
 TRACE_SPANS = "cilium_tpu_trace_spans_total"
 
+# -- overload-resilience series (runtime/admission.py + the drain /
+# warm-restart sequence in runtime/service.py + runtime/loader.py).
+#: requests admitted past the gate, by surface (service/api) + class
+ADMISSION_ADMITTED = "cilium_tpu_admission_admitted_total"
+#: requests shed at (or behind) the gate, by surface/class/reason
+ADMISSION_SHED = "cilium_tpu_admission_shed_total"
+#: queued entries dropped before dispatch: caller abandoned (timed
+#: out) or deadline expired while queued
+ADMISSION_REAPED = "cilium_tpu_admission_reaped_total"
+#: gauge: verdict-queue occupancy sampled at each admission decision
+ADMISSION_QUEUE_DEPTH = "cilium_tpu_admission_queue_depth"
+#: graceful drains completed (admission stopped, pending flushed)
+DRAINS = "cilium_tpu_drains_total"
+#: loader restorations from a warm-restart snapshot (no recompile)
+WARM_RESTORES = "cilium_tpu_warm_restores_total"
+#: corrupt artifact-cache entries deleted on read (recompile follows)
+ARTIFACT_CACHE_CORRUPT = "cilium_tpu_artifact_cache_corrupt_total"
+#: stream-client sends that blocked at zero credit (backpressure)
+STREAM_CREDIT_WAITS = "cilium_tpu_stream_credit_waits_total"
+#: credit grants sent by stream servers (one per answered chunk)
+STREAM_CREDITS_GRANTED = "cilium_tpu_stream_credits_granted_total"
+
 #: latency-shaped default boundaries (seconds; the Prometheus client
 #: defaults) — covers every ``*_seconds`` series we emit
 DEFAULT_BUCKETS: Tuple[float, ...] = (
@@ -472,6 +494,25 @@ METRICS.describe("cilium_tpu_stream_unknown_frames_total",
                  "stream frames dropped for an unknown kind")
 METRICS.describe("cilium_tpu_stream_verdicts_total",
                  "verdicts returned over the chunked binary stream")
+METRICS.describe(ADMISSION_ADMITTED,
+                 "requests admitted past the gate, by surface/class")
+METRICS.describe(ADMISSION_SHED,
+                 "requests shed, by surface/class/reason")
+METRICS.describe(ADMISSION_REAPED,
+                 "queued entries dropped before dispatch (abandoned "
+                 "caller or expired deadline)")
+METRICS.describe(ADMISSION_QUEUE_DEPTH,
+                 "verdict-queue occupancy at the admission decision")
+METRICS.describe(DRAINS,
+                 "graceful drains completed")
+METRICS.describe(WARM_RESTORES,
+                 "loader restorations from a warm-restart snapshot")
+METRICS.describe(ARTIFACT_CACHE_CORRUPT,
+                 "corrupt artifact-cache entries deleted on read")
+METRICS.describe(STREAM_CREDIT_WAITS,
+                 "stream-client sends that blocked at zero credit")
+METRICS.describe(STREAM_CREDITS_GRANTED,
+                 "credit grants sent by stream servers")
 
 
 class SpanStat:

@@ -47,22 +47,32 @@ from cilium_tpu.core.flow import (
 )
 from cilium_tpu.ingest.hubble import flow_from_dict
 from cilium_tpu.proxylib.parser import Connection, create_parser
+from cilium_tpu.runtime import admission, faults
 from cilium_tpu.runtime.loader import Loader
 from cilium_tpu.runtime.logging import get_logger
 from cilium_tpu.runtime.metrics import (
+    ADMISSION_REAPED,
     BREAKER_FALLBACK_VERDICTS,
     BREAKER_RECOVERIES,
     BREAKER_STATE,
     BREAKER_TRIPS,
+    DRAINS,
     METRICS,
 )
 from cilium_tpu.runtime.tracing import (
     PHASE_FALLBACK,
     PHASE_QUEUE,
+    PHASE_SHED,
     TRACER,
 )
 
 LOG = get_logger("service")
+
+#: fires between stop-admitting and the pending flush in
+#: VerdictService.drain — a crash mid-drain leaves the gate draining
+#: (not half-open); the operator retries the drain
+DRAIN_POINT = faults.register_point(
+    "service.drain", "drain sequence in VerdictService.drain")
 
 
 def verdict_flows_padded(engine, flows: Sequence[Flow],
@@ -244,11 +254,18 @@ class ResilientVerdictor:
 
     # -- the verdict entry points ---------------------------------------
     def outputs(self, flows: Sequence[Flow], authed_pairs=None,
-                outputs=None):
+                outputs=None, deadline: Optional[float] = None):
         """Full output lanes under pow2 padding, surviving device
         failure: device lane when the breaker allows, oracle
         otherwise or on dispatch failure — the request is answered
-        either way, and always correctly."""
+        either way, and always correctly. ``deadline`` (absolute
+        monotonic) is the batch's propagated budget: recorded on the
+        dispatch trace so a blown deadline is attributable to the
+        phase that ate it."""
+        if deadline is not None:
+            TRACER.event("dispatch.deadline",
+                         remaining_ms=round(
+                             (deadline - time.monotonic()) * 1e3, 3))
         engine = self.loader.engine
         if engine is None:
             raise RuntimeError("no policy loaded")
@@ -281,11 +298,31 @@ class ResilientVerdictor:
         return self.fallback_outputs(flows, authed_pairs=pairs,
                                      outputs=outputs)
 
-    def verdicts(self, flows: Sequence[Flow],
-                 authed_pairs=None) -> List[int]:
+    def verdicts(self, flows: Sequence[Flow], authed_pairs=None,
+                 deadline: Optional[float] = None) -> List[int]:
         return [int(v) for v in
                 self.outputs(flows, authed_pairs=authed_pairs,
-                             outputs=("verdict",))["verdict"]]
+                             outputs=("verdict",),
+                             deadline=deadline)["verdict"]]
+
+
+class _Pending:
+    """One queued check: the flow plus its rendezvous and deadline
+    bookkeeping. ``abandoned`` flips when the caller gives up waiting
+    — the drain worker reaps the entry before dispatch instead of
+    spending a device batch slot on an answer nobody reads."""
+
+    __slots__ = ("flow", "ev", "box", "t_enq", "ctx", "deadline",
+                 "abandoned")
+
+    def __init__(self, flow: Flow, deadline: Optional[float], ctx):
+        self.flow = flow
+        self.ev = threading.Event()
+        self.box: List[int] = []
+        self.t_enq = time.monotonic()
+        self.ctx = ctx
+        self.deadline = deadline
+        self.abandoned = False
 
 
 class MicroBatcher:
@@ -303,53 +340,144 @@ class MicroBatcher:
     the saturation throughput without touching the deadline
     semantics. Each request still gets exactly one verdict; ordering
     across batches is not part of the contract (never was — callers
-    block per request)."""
+    block per request).
+
+    Overload discipline (runtime/admission.py): ``max_pending`` is the
+    HARD queue bound, enforced under the lock — enqueues past it shed
+    explicitly instead of growing the list; per-entry deadlines are
+    carried to dispatch, and entries whose caller abandoned them or
+    whose deadline lapsed in the queue are reaped before featurize."""
 
     def __init__(self, verdict_fn: Callable[[Sequence[Flow]], Sequence[int]],
                  batch_max: int = 256, deadline_ms: float = 2.0,
-                 drain_workers: int = 1):
+                 drain_workers: int = 1, max_pending: int = 0,
+                 gate=None):
         self.verdict_fn = verdict_fn
         self.batch_max = batch_max
         self.deadline_s = deadline_ms / 1e3
         self.drain_workers = max(1, int(drain_workers))
+        #: hard occupancy bound (0 = unbounded, standalone/test use;
+        #: the service always passes its configured bound)
+        self.max_pending = max(0, int(max_pending))
+        #: optional AdmissionGate: fed the per-batch service rate for
+        #: its deadline-feasibility estimate
+        self.gate = gate
+        # does the verdict_fn accept the batch deadline? (propagated
+        # to engine dispatch when it does; plain fns stay plain)
+        import inspect
+
+        try:
+            self._fn_takes_deadline = "deadline" in \
+                inspect.signature(verdict_fn).parameters
+        except (TypeError, ValueError):
+            self._fn_takes_deadline = False
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._pending: List = []          # (flow, event, result_box, t_enq)
+        self._pending: List[_Pending] = []
+        self._inflight = 0               # entries popped, batch running
+        self.peak_pending = 0            # high-water mark (soak lane)
         self._workers: List[threading.Thread] = []
         self._closed = False
+        self._draining = False
 
-    def check(self, flow: Flow, timeout: float = 5.0) -> int:
-        ev = threading.Event()
-        box: List[int] = []
+    # -- enqueue ----------------------------------------------------------
+    def check(self, flow: Flow, timeout: float = 5.0,
+              deadline: Optional[float] = None) -> int:
+        return self.check_ex(flow, timeout=timeout, deadline=deadline)[0]
+
+    def check_ex(self, flow: Flow, timeout: float = 5.0,
+                 deadline: Optional[float] = None):
+        """(verdict, status): status is "ok", "shed" (queue at bound),
+        "closed" (drained/stopped), or "timeout" (caller gave up; the
+        entry is marked abandoned and reaped before dispatch).
+        ``deadline`` is absolute monotonic seconds; None derives one
+        from ``timeout`` so every entry is reapable."""
+        if deadline is None:
+            deadline = time.monotonic() + timeout
         # the caller's trace context crosses the thread handoff WITH
         # the entry — the drain worker attributes this request's
         # queue-wait and fans the batch's phase spans back to it
-        ctx = TRACER.current()
+        entry = _Pending(flow, deadline, TRACER.current())
+        shed = False
         with self._cond:
-            if self._closed:
-                return int(Verdict.ERROR)
-            self._pending.append((flow, ev, box, time.monotonic(), ctx))
-            if not self._workers:
-                self._workers = [
-                    threading.Thread(target=self._drain, daemon=True)
-                    for _ in range(self.drain_workers)]
-                for w in self._workers:
-                    w.start()
-            self._cond.notify()
-        if not ev.wait(timeout):
-            return int(Verdict.ERROR)
-        return box[0]
+            if self._closed or self._draining:
+                return int(Verdict.ERROR), "closed"
+            if self.max_pending and \
+                    len(self._pending) >= self.max_pending:
+                shed = True
+            else:
+                self._pending.append(entry)
+                if len(self._pending) > self.peak_pending:
+                    self.peak_pending = len(self._pending)
+                if not self._workers:
+                    self._workers = [
+                        threading.Thread(target=self._drain, daemon=True)
+                        for _ in range(self.drain_workers)]
+                    for w in self._workers:
+                        w.start()
+                self._cond.notify()
+        if shed:
+            admission.count_shed("batcher", admission.CLASS_DATA,
+                                 admission.SHED_QUEUE_FULL)
+            if entry.ctx is not None:
+                TRACER.add_span(entry.ctx, "admission.shed",
+                                PHASE_SHED, time.time(), 0.0,
+                                reason=admission.SHED_QUEUE_FULL)
+            return int(Verdict.ERROR), "shed"
+        wait = min(timeout, max(0.0, deadline - time.monotonic()))
+        if not entry.ev.wait(wait):
+            # caller is leaving: flag the entry so the drain worker
+            # drops it before featurize/dispatch instead of wasting a
+            # batch slot on it
+            entry.abandoned = True
+            return int(Verdict.ERROR), "timeout"
+        return entry.box[0], "ok"
 
-    def close(self) -> None:
-        """Stop the drain worker; pending entries get ERROR verdicts."""
+    # -- lifecycle --------------------------------------------------------
+    def close(self, abort: bool = True) -> None:
+        """``abort=True`` (default): stop now, pending entries get
+        ERROR verdicts — the crash-stop path. ``abort=False`` delegates
+        to :meth:`drain`: flush pending through the engine first."""
+        if not abort:
+            self.drain()
+            return
         with self._cond:
             self._closed = True
             pending, self._pending = self._pending, []
             self._cond.notify_all()
-        for _flow, ev, box, _t, _ctx in pending:
-            box.append(int(Verdict.ERROR))
-            ev.set()
+        for entry in pending:
+            entry.box.append(int(Verdict.ERROR))
+            entry.ev.set()
 
+    def drain(self, timeout: float = 30.0) -> int:
+        """Flush pending entries THROUGH the engine, then stop: the
+        graceful half of shutdown — in-flight requests get real
+        verdicts, not ERRORs. Entries still unflushed when ``timeout``
+        lapses (wedged engine) resolve as ERROR. Returns the number of
+        entries flushed with real verdicts. Idempotent."""
+        t_deadline = time.monotonic() + max(0.0, timeout)
+        with self._cond:
+            if self._closed:
+                return 0
+            self._draining = True
+            backlog = len(self._pending) + self._inflight
+            self._cond.notify_all()
+            while self._pending or self._inflight:
+                left = t_deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cond.wait(timeout=min(left, 0.05))
+            self._closed = True
+            leftovers, self._pending = self._pending, []
+            self._cond.notify_all()
+        for entry in leftovers:
+            entry.box.append(int(Verdict.ERROR))
+            entry.ev.set()
+        for w in self._workers:
+            w.join(timeout=1.0)
+        return max(0, backlog - len(leftovers))
+
+    # -- drain workers ----------------------------------------------------
     def _drain(self) -> None:
         while True:
             with self._cond:
@@ -361,11 +489,12 @@ class MicroBatcher:
                 # Non-emptiness re-checked after EVERY wake: a sibling
                 # pipelined worker may have drained the queue while we
                 # waited (indexing [0] blind would kill this thread,
-                # and workers are never respawned)
+                # and workers are never respawned). Drain mode flushes
+                # immediately — coalescing gains nothing on the way out
                 while (self._pending
                        and len(self._pending) < self.batch_max
-                       and not self._closed):
-                    oldest = self._pending[0][3]
+                       and not self._closed and not self._draining):
+                    oldest = self._pending[0].t_enq
                     left = oldest + self.deadline_s - time.monotonic()
                     if left <= 0 or not self._cond.wait(timeout=left):
                         break
@@ -378,39 +507,88 @@ class MicroBatcher:
                 # compiles new shapes mid-incident
                 pending = self._pending[:self.batch_max]
                 del self._pending[:self.batch_max]
+                self._inflight += len(pending)
                 if self._pending:
                     # a sibling drain worker (pipelined mode) can start
                     # on the remainder immediately
                     self._cond.notify()
-            self._run_batch(pending)
+            try:
+                self._run_batch(pending)
+            finally:
+                with self._cond:
+                    self._inflight -= len(pending)
+                    self._cond.notify_all()
 
-    def _run_batch(self, pending) -> None:
-        flows = [p[0] for p in pending]
+    def _reap(self, pending: List[_Pending]) -> List[_Pending]:
+        """Drop abandoned/expired entries before dispatch. Reaped
+        entries resolve ERROR (their caller is gone or about to be);
+        the drop is counted and, for sampled traces, attributed to the
+        shed phase — the trace says the request died in the queue."""
+        now = time.monotonic()
+        live: List[_Pending] = []
+        reaped: List[_Pending] = []
+        for entry in pending:
+            if entry.abandoned or (entry.deadline is not None
+                                   and entry.deadline <= now):
+                reaped.append(entry)
+            else:
+                live.append(entry)
+        if reaped:
+            if self.gate is not None:
+                self.gate.reap(len(reaped))
+            else:
+                METRICS.inc(ADMISSION_REAPED, len(reaped))
+            wall = time.time()
+            for entry in reaped:
+                if entry.ctx is not None:
+                    waited = now - entry.t_enq
+                    TRACER.add_span(entry.ctx, "admission.reap",
+                                    PHASE_SHED, wall - waited, waited)
+                entry.box.append(int(Verdict.ERROR))
+                entry.ev.set()
+        return live
+
+    def _run_batch(self, pending: List[_Pending]) -> None:
+        pending = self._reap(pending)
+        if not pending:
+            return
+        flows = [p.flow for p in pending]
         # per-request queue-wait attribution: monotonic deltas anchored
         # to wall time (one wall read per batch, not per request)
         t_drain = time.monotonic()
         wall = time.time()
-        for _flow, _ev, _box, t_enq, ctx in pending:
-            if ctx is not None:
-                waited = t_drain - t_enq
-                TRACER.add_span(ctx, "batch.queue", PHASE_QUEUE,
+        for entry in pending:
+            if entry.ctx is not None:
+                waited = t_drain - entry.t_enq
+                TRACER.add_span(entry.ctx, "batch.queue", PHASE_QUEUE,
                                 wall - waited, waited)
         # the batch dispatch runs under the GROUP of sampled member
         # contexts: each request's trace shows the batch's host/device
         # (or fallback) spans — its honest share of where time went
-        group = TRACER.group([p[4] for p in pending])
+        group = TRACER.group([p.ctx for p in pending])
+        # the batch deadline — the tightest member's — rides to the
+        # engine dispatch when the verdict_fn can carry it
+        deadlines = [p.deadline for p in pending
+                     if p.deadline is not None]
+        batch_deadline = min(deadlines) if deadlines else None
         t0 = time.perf_counter()
         try:
             with TRACER.activate(group):
-                verdicts = self.verdict_fn(flows)
+                if self._fn_takes_deadline:
+                    verdicts = self.verdict_fn(flows,
+                                               deadline=batch_deadline)
+                else:
+                    verdicts = self.verdict_fn(flows)
         except Exception:
             verdicts = [int(Verdict.ERROR)] * len(flows)
-        METRICS.observe("cilium_tpu_microbatch_seconds",
-                        time.perf_counter() - t0)
+        seconds = time.perf_counter() - t0
+        METRICS.observe("cilium_tpu_microbatch_seconds", seconds)
         METRICS.observe("cilium_tpu_microbatch_size", len(flows))
-        for (flow, ev, box, _t, _ctx), v in zip(pending, verdicts):
-            box.append(int(v))
-            ev.set()
+        if self.gate is not None:
+            self.gate.note_batch(len(flows), seconds)
+        for entry, v in zip(pending, verdicts):
+            entry.box.append(int(v))
+            entry.ev.set()
 
 
 class PolicyBridge:
@@ -420,7 +598,8 @@ class PolicyBridge:
     def __init__(self, loader: Loader, batch_max: int = 256,
                  deadline_ms: float = 2.0, authed_pairs_fn=None,
                  accesslog_fn=None, drain_workers: int = 1,
-                 verdictor: Optional[ResilientVerdictor] = None):
+                 verdictor: Optional[ResilientVerdictor] = None,
+                 gate=None):
         self.loader = loader
         #: supplies AuthManager.pairs_array() — the L7 proxy path must
         #: enforce drop-until-authed exactly like Agent.process_flows,
@@ -435,20 +614,24 @@ class PolicyBridge:
         #: header-match mismatch; ours emits the L7 flow to the hubble
         #: observer via this callback)
         self.accesslog_fn = accesslog_fn
-        self.batcher = MicroBatcher(self._verdicts, batch_max=batch_max,
-                                    deadline_ms=deadline_ms,
-                                    drain_workers=drain_workers)
+        adm = getattr(loader.config, "admission", None)
+        self.batcher = MicroBatcher(
+            self._verdicts, batch_max=batch_max,
+            deadline_ms=deadline_ms, drain_workers=drain_workers,
+            max_pending=getattr(adm, "max_pending", 0), gate=gate)
         # has_proxy_actions memo, valid for ONE policy revision (reset
         # on revision change so dead snapshots aren't pinned alive)
         self._pa_cache: Dict = {}
         self._pa_revision = -1
 
-    def _verdicts(self, flows: Sequence[Flow]) -> Sequence[int]:
+    def _verdicts(self, flows: Sequence[Flow],
+                  deadline: Optional[float] = None) -> Sequence[int]:
         if self.loader.engine is None:
             return [int(Verdict.DROPPED)] * len(flows)
         # breaker-guarded: a device failure serves this batch from the
-        # oracle instead of erroring every queued request
-        return self.verdictor.verdicts(flows)
+        # oracle instead of erroring every queued request; the batch
+        # deadline rides along for dispatch-side attribution
+        return self.verdictor.verdicts(flows, deadline=deadline)
 
     def record_to_flow(self, conn: Connection, record) -> Flow:
         f = Flow(
@@ -552,18 +735,27 @@ class VerdictService:
         self.loader = loader
         self.socket_path = socket_path
         self.agent = agent  # optional backref for introspection ops
+        self.admission_config = getattr(loader.config, "admission",
+                                        None)
         #: ONE breaker-guarded pipeline for every verdict path this
         #: service serves (batcher, bulk op, streams)
         self.verdictor = ResilientVerdictor(
             loader, authed_pairs_fn=(agent.auth.pairs_array
                                      if agent is not None else None))
+        #: bounded admission in front of every verdict ingress; its
+        #: depth_fn reads the real batcher backlog (len() is atomic —
+        #: an instantaneous read is all the bound check needs)
+        self.gate = admission.AdmissionGate.from_config(
+            self.admission_config,
+            depth_fn=lambda: len(self.bridge.batcher._pending))
         self.bridge = PolicyBridge(
             loader, batch_max=batch_max, deadline_ms=deadline_ms,
             authed_pairs_fn=(agent.auth.pairs_array
                              if agent is not None else None),
             accesslog_fn=(self._accesslog
                           if agent is not None else None),
-            drain_workers=drain_workers, verdictor=self.verdictor)
+            drain_workers=drain_workers, verdictor=self.verdictor,
+            gate=self.gate)
         self._connections: Dict[int, Connection] = {}
         self._conn_lock = threading.Lock()
         self._server: Optional[socketserver.ThreadingUnixStreamServer] = None
@@ -594,17 +786,38 @@ class VerdictService:
         if self.loader.engine is None:
             send_msg(sock, {"error": "no policy loaded"})
             return
+        ok, reason = self.gate.admit(admission.CLASS_DATA)
+        if not ok:
+            # a draining/overloaded service refuses NEW streams at the
+            # handshake — existing sessions run to end-of-stream
+            send_msg(sock, {"error": f"shed: {reason}", "shed": True,
+                            "reason": reason})
+            return
+        # credit flow control: clients that opt in (``"credit": true``
+        # in the hello) get a server-advertised chunk window; the
+        # session grants a credit back per answered chunk, so a slow
+        # consumer backpressures the producer instead of ballooning
+        # server queues. Peers that don't opt in see neither the ack
+        # field nor credit frames — unchanged interop.
+        credit_window = 0
+        if req.get("credit"):
+            credit_window = int(getattr(
+                self.admission_config, "stream_credit_window", 32))
         # "trace": this server accepts KIND_CHUNK_TRACED frames (the
         # flight-recorder id prefix) — clients only send them when
         # they see this, so old peers interoperate unchanged
-        send_msg(sock, {"ok": True, "revision": self.loader.revision,
-                        "trace": True})
+        ack = {"ok": True, "revision": self.loader.revision,
+               "trace": True}
+        if credit_window > 0:
+            ack["credit"] = credit_window
+        send_msg(sock, ack)
         StreamSession(
             self.loader, sock,
             widths=req.get("widths") or None,
             authed_pairs_fn=self.bridge.authed_pairs_fn,
             pipeline_depth=int(req.get("pipeline_depth") or 8),
             verdictor=self.verdictor,
+            credit_window=credit_window,
         ).run()
 
     # -- request handling -------------------------------------------------
@@ -626,8 +839,36 @@ class VerdictService:
 
     def _handle(self, req: Dict) -> Dict:
         op = req.get("op")
+        deadline = None
+        if op in ("check", "verdict", "on_new_connection"):
+            # data-path ingress: bounded admission + deadline
+            # feasibility BEFORE any work. Control ops (ping, status,
+            # policy, drain itself) never queue behind verdicts and
+            # stay admitted during overload and drain.
+            if op != "on_new_connection":
+                deadline = admission.deadline_from_ms(
+                    req.get("deadline_ms"),
+                    getattr(self.admission_config,
+                            "default_deadline_ms", 5000.0))
+            ok, reason = self.gate.admit(admission.CLASS_DATA,
+                                         deadline=deadline)
+            if not ok:
+                TRACER.add_span(TRACER.current(), "admission.shed",
+                                PHASE_SHED, time.time(), 0.0,
+                                reason=reason)
+                resp = {"shed": True, "reason": reason}
+                if op == "check":
+                    # explicit shed verdict: fail-closed for the
+                    # caller, distinguishable from a policy DROP or a
+                    # timeout ERROR by the shed flag
+                    resp["verdict"] = int(Verdict.ERROR)
+                else:
+                    resp["error"] = f"shed: {reason}"
+                return resp
         if op == "ping":
             return {"ok": True, "revision": self.loader.revision}
+        if op == "drain":
+            return self.drain()
         if op == "status":
             if self.agent is not None:
                 return self.agent.status()
@@ -657,16 +898,26 @@ class VerdictService:
         if op == "check":
             # single-record policy check through the MicroBatcher — the
             # per-request path a proxylib parser/shim sees (requests
-            # coalesce across connections into one engine batch)
+            # coalesce across connections into one engine batch). The
+            # wire deadline rides the queue entry: expire in the queue
+            # and the entry is reaped before dispatch.
             flow = flow_from_dict(req.get("flow", {}))
-            return {"verdict": self.bridge.batcher.check(flow)}
+            v, status = self.bridge.batcher.check_ex(
+                flow, deadline=deadline)
+            resp = {"verdict": v}
+            if status in ("shed", "closed"):
+                resp["shed"] = True
+                resp["reason"] = (admission.SHED_QUEUE_FULL
+                                  if status == "shed"
+                                  else admission.SHED_DRAINING)
+            return resp
         if op == "verdict":
             flows = [flow_from_dict(d) for d in req.get("flows", ())]
             if self.loader.engine is None:
                 return {"error": "no policy loaded"}
             # breaker-guarded: device dispatch failures degrade this
             # request to the oracle lane instead of an error response
-            out = self.verdictor.outputs(flows)
+            out = self.verdictor.outputs(flows, deadline=deadline)
             verdicts = [int(v) for v in out["verdict"]]
             if self.agent is not None and flows:
                 # the reference's datapath emits PolicyVerdictNotify
@@ -782,11 +1033,51 @@ class VerdictService:
                                         daemon=True)
         self._thread.start()
 
-    def stop(self) -> None:
+    def drain(self) -> Dict:
+        """Graceful drain: stop admitting data-path work, flush — not
+        error — pending batches through the engine, then snapshot the
+        loader's warm state (revision + compiled policy + oracle
+        snapshot) so a restarted service answers its first request
+        verdict-identically without recompilation. Idempotent; the
+        service keeps answering control ops (status, metrics, drain)
+        afterwards. A fault injected at ``service.drain`` aborts
+        between stop-admitting and the flush — the gate stays
+        draining and the operator retries."""
+        self.gate.begin_drain()
+        faults.maybe_fail(DRAIN_POINT)
+        timeout = getattr(self.admission_config, "drain_timeout_s",
+                          30.0)
+        flushed = self.bridge.batcher.drain(timeout=timeout)
+        warm = False
+        if self.loader.revision > 0:
+            warm = self.loader.snapshot_warm()
+        METRICS.inc(DRAINS)
+        TRACER.event("service.drained", flushed=flushed,
+                     warm_snapshot=warm)
+        LOG.info("service drained", extra={"fields": {
+            "flushed": flushed, "warm_snapshot": warm,
+            "revision": self.loader.revision}})
+        return {"ok": True, "flushed": flushed,
+                "warm_snapshot": warm,
+                "revision": self.loader.revision}
+
+    def stop(self, drain: bool = True) -> None:
+        """Shutdown. ``drain=True`` (the default — Agent.stop and the
+        daemon use it) flushes pending verdicts through the engine
+        before stopping; ``drain=False`` is the crash-stop path
+        (pending entries resolve ERROR)."""
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+        if drain:
+            # flush quietly WITHOUT latching the gate into drain mode:
+            # the socket server is already down, so nothing new is
+            # admitted, and a later start() of this instance (tests do
+            # this) must not find a permanently-draining gate — the
+            # latched drain belongs to the explicit drain() op
+            self.bridge.batcher.drain(timeout=getattr(
+                self.admission_config, "drain_timeout_s", 30.0))
         self.bridge.batcher.close()
         if os.path.exists(self.socket_path):
             os.unlink(self.socket_path)

@@ -36,10 +36,23 @@ the verdict socket; see ``VerdictService``):
 
   frame   := <u32 payload_len> <u32 seq> <u8 kind> payload
   c→s     := kind 0: capture image | kind 1: end-of-stream (empty)
+           | kind 3: capture image prefixed by a 16-hex trace id
+             (only to servers that advertised ``"trace": true``)
   s→c     := kind 0: u8 verdict array (one byte per record, in the
              chunk's record order)
            | kind 1: end-ack (all pending verdicts flushed)
            | kind 2: per-chunk error (utf-8 message; stream continues)
+           | kind 4: credit grant (u32 additional chunk credits; only
+             to clients that sent ``"credit": true`` in the hello)
+
+Credit flow control: clients that opt in receive a window in the
+stream_start ack (``"credit": N`` — ``Config.admission
+.stream_credit_window``); each chunk send consumes a credit, each
+answered chunk grants one back, and the client HALTS sends at zero —
+a slow consumer backpressures the producer instead of ballooning the
+server's queues. Credits survive reconnect-with-resume (fresh window
+minus the re-sent unacked chunks). Peers that don't opt in see
+neither the field nor the frames.
 
 A poisoned frame (bad magic, truncated image) fails ONLY its sequence
 number — the serving path must degrade per-chunk, not per-connection.
@@ -63,7 +76,12 @@ from cilium_tpu.ingest.binary import (
     capture_to_bytes,
 )
 from cilium_tpu.runtime import faults
-from cilium_tpu.runtime.metrics import METRICS, STREAM_RECONNECTS
+from cilium_tpu.runtime.metrics import (
+    METRICS,
+    STREAM_CREDIT_WAITS,
+    STREAM_CREDITS_GRANTED,
+    STREAM_RECONNECTS,
+)
 from cilium_tpu.runtime.tracing import (
     PHASE_DEVICE,
     PHASE_FALLBACK,
@@ -81,6 +99,11 @@ FRAME_SERVER_POINT = faults.register_point(
 #: ConnectionError here to exercise reconnect-with-resume
 FRAME_CLIENT_POINT = faults.register_point(
     "stream.frame.client", "per-frame receive in StreamClient")
+#: fires at the server's credit-grant send: an injected fault LOSES
+#: the grant (the client's window shrinks by one) — the chaos suite
+#: proves a lost credit degrades throughput, never correctness
+CREDIT_POINT = faults.register_point(
+    "stream.credit", "credit grant send in StreamSession")
 
 FRAME_HEADER = struct.Struct("<IIB")
 
@@ -97,6 +120,15 @@ KIND_ERROR = 2
 # kind (unknown kinds there are dropped and counted, not misparsed).
 # ctlint: disable=frame-kind  # one-directional kind, see above
 KIND_CHUNK_TRACED = 3
+#: credit grant: payload is a little-endian u32 of additional chunk
+#: credits. Server-to-client only — the writer grants one per
+#: answered chunk; clients that opted in (``"credit": true`` in the
+#: stream_start hello) halt sends at zero credit, so a slow consumer
+#: backpressures the producer instead of ballooning server queues.
+#: Old clients never opt in and old servers never grant — unchanged
+#: interop both ways.
+# ctlint: disable=frame-kind  # server-to-client only, see above
+KIND_CREDIT = 4
 
 #: hard cap on one frame's payload — a corrupt length prefix must not
 #: make the server try to buffer gigabytes
@@ -145,12 +177,16 @@ class StreamSession:
                  widths: Optional[Dict[str, int]] = None,
                  authed_pairs_fn=None,
                  pipeline_depth: int = PIPELINE_DEPTH,
-                 verdictor=None):
+                 verdictor=None, credit_window: int = 0):
         from cilium_tpu.core.config import EngineConfig
 
         self.loader = loader
         self.sock = sock
         self.authed_pairs_fn = authed_pairs_fn
+        #: chunk credits advertised to this session's client in the
+        #: stream_start ack; 0 = the client didn't opt in, grant
+        #: nothing (old-peer interop)
+        self.credit_window = max(0, int(credit_window))
         #: optional ResilientVerdictor (runtime/service.py): shares the
         #: service-wide circuit breaker so a sick device degrades
         #: stream chunks to the oracle instead of erroring every seq
@@ -327,6 +363,23 @@ class StreamSession:
                 continue
             self._out.put((seq, KIND_CHUNK, n, dev, ctx))
 
+    def _grant_credit(self, seq: int) -> None:
+        """One credit back to the producer for one answered chunk. An
+        injected ``stream.credit`` fault LOSES the grant — the client
+        window shrinks; reconnect-with-resume restores it — so the
+        chaos suite can prove credit loss degrades pacing, never
+        verdicts."""
+        if not self.credit_window:
+            return
+        try:
+            faults.maybe_fail(CREDIT_POINT)
+        except Exception:  # noqa: BLE001 — plan-chosen exception
+            return  # the grant is lost; FAULTS_INJECTED counted it
+        with self._send_lock:
+            send_frame(self.sock, seq, KIND_CREDIT,
+                       struct.pack("<I", 1))
+        METRICS.inc(STREAM_CREDITS_GRANTED)
+
     def _write(self) -> None:
         while True:
             item = self._out.get()
@@ -335,14 +388,19 @@ class StreamSession:
             seq, kind, n, dev, ctx = item
             try:
                 if kind == KIND_END:
-                    send_frame(self.sock, seq, KIND_END)
+                    with self._send_lock:
+                        send_frame(self.sock, seq, KIND_END)
                     continue
                 if kind == KIND_ERROR:
-                    send_frame(self.sock, seq, KIND_ERROR,
-                               str(dev).encode())
+                    with self._send_lock:
+                        send_frame(self.sock, seq, KIND_ERROR,
+                                   str(dev).encode())
+                    self._grant_credit(seq)
                     continue
                 if n == 0:
-                    send_frame(self.sock, seq, KIND_CHUNK)
+                    with self._send_lock:
+                        send_frame(self.sock, seq, KIND_CHUNK)
+                    self._grant_credit(seq)
                     continue
                 # the blocking wait for an async dispatch is genuine
                 # device time — attributed where it is PAID (here),
@@ -351,8 +409,13 @@ class StreamSession:
                                  ctx=ctx, records=n):
                     verdicts = np.asarray(dev)[:n].astype(np.uint8)
                 METRICS.inc("cilium_tpu_stream_verdicts_total", n)
-                send_frame(self.sock, seq, KIND_CHUNK,
-                           verdicts.tobytes())
+                with self._send_lock:
+                    send_frame(self.sock, seq, KIND_CHUNK,
+                               verdicts.tobytes())
+                # grant AFTER the verdict frame: the window counts
+                # unanswered chunks, so the producer's next send is
+                # paced by consumption, not by raw socket capacity
+                self._grant_credit(seq)
             except (OSError, BrokenPipeError):
                 # client went away: drain silently so the worker can
                 # finish and the session unwinds
@@ -406,6 +469,11 @@ class StreamClient:
         self._unacked: Dict[int, Tuple[str, bytes]] = {}
         #: did the server's stream_start ack advertise trace support?
         self._trace_peer = False
+        #: credit flow control: None = peer didn't advertise a window
+        #: (old server) → unenforced; else the remaining chunk credits
+        #: — sends halt at zero until the server grants more
+        self._credits: Optional[int] = None
+        self._credit_window = 0
         self._finish_seq: Optional[int] = None
         self._done = False
         self._connect()
@@ -418,7 +486,8 @@ class StreamClient:
 
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         sock.connect(self.socket_path)
-        hello = {"op": "stream_start", "widths": self._widths}
+        hello = {"op": "stream_start", "widths": self._widths,
+                 "credit": True}
         if self._pipeline_depth:
             hello["pipeline_depth"] = int(self._pipeline_depth)
         send_msg(sock, hello)
@@ -430,6 +499,13 @@ class StreamClient:
         # only send traced frames to servers that understand them —
         # absent on old peers, so the field degrades to plain chunks
         self._trace_peer = bool(ack.get("trace"))
+        # a fresh window per (re)connect: old servers advertise none →
+        # credits stay unenforced
+        window = int(ack.get("credit") or 0)
+        with self._cond:
+            self._credit_window = window
+            self._credits = window if window > 0 else None
+            self._cond.notify_all()
         self.sock = sock
 
     def _try_reconnect(self) -> bool:
@@ -462,6 +538,15 @@ class StreamClient:
                         send_frame(self.sock, finish_seq, KIND_END)
             except (OSError, ConnectionError):
                 continue
+            with self._cond:
+                # credits survive the reconnect: the fresh window from
+                # the re-handshake, minus the unacked chunks just
+                # re-sent (each consumes a credit; their grants come
+                # back as the resumed session answers them)
+                if self._credits is not None:
+                    self._credits = max(
+                        0, self._credit_window - len(pending))
+                self._cond.notify_all()
             METRICS.inc(STREAM_RECONNECTS)
             return True
         return False
@@ -483,6 +568,17 @@ class StreamClient:
                     self._cond.notify_all()
                 return
             with self._cond:
+                if kind == KIND_CREDIT:
+                    # replenished window: wake any send blocked at
+                    # zero. MUST precede the resume-dedup branch — a
+                    # grant's seq echoes an already-acked chunk and
+                    # would be swallowed as a duplicate there.
+                    grant = (struct.unpack("<I", payload[:4])[0]
+                             if len(payload) >= 4 else 1)
+                    if self._credits is not None:
+                        self._credits += grant
+                    self._cond.notify_all()
+                    continue
                 if kind == KIND_END:
                     self._done = True
                 elif (self.reconnect and seq not in self._unacked
@@ -522,12 +618,36 @@ class StreamClient:
             return KIND_CHUNK_TRACED, trace_id.encode("ascii") + image
         return KIND_CHUNK, image
 
+    def _acquire_credit(self) -> None:
+        """Halt at zero credit until the server grants (backpressure:
+        the producer paces to the consumer). No-op when the peer
+        advertised no window. Raises TimeoutError if no grant lands
+        within ``timeout`` — a wedged consumer must surface, not
+        buffer."""
+        with self._cond:
+            if self._credits is None:
+                return
+            if self._credits <= 0:
+                METRICS.inc(STREAM_CREDIT_WAITS)
+                ok = self._cond.wait_for(
+                    lambda: (self._credits is None
+                             or self._credits > 0 or self._done),
+                    timeout=self.timeout)
+                if self._credits is None or self._done:
+                    return  # window gone / stream over: let send fail
+                if not ok:
+                    raise TimeoutError(
+                        "no stream credit: server window exhausted "
+                        "and no grant arrived")
+            self._credits -= 1
+
     def send_image(self, image: bytes,
                    trace_id: Optional[str] = None) -> int:
         """``trace_id=None`` picks up the ambient flight-recorder
         context (if any); pass ``""`` to force an untraced frame."""
         if trace_id is None:
             trace_id = TRACER.current_trace_id()
+        self._acquire_credit()
         with self._lock:
             seq = self._seq
             self._seq += 1

@@ -67,7 +67,11 @@ PHASE_QUEUE = "queue-wait"
 PHASE_HOST = "host-prep"
 PHASE_DEVICE = "device-dispatch"
 PHASE_FALLBACK = "oracle-fallback"
-PHASES = (PHASE_QUEUE, PHASE_HOST, PHASE_DEVICE, PHASE_FALLBACK)
+#: the request never reached the engine: shed at admission, or reaped
+#: from the queue after its caller abandoned / its deadline expired
+PHASE_SHED = "shed"
+PHASES = (PHASE_QUEUE, PHASE_HOST, PHASE_DEVICE, PHASE_FALLBACK,
+          PHASE_SHED)
 
 #: trace ids on the wire are exactly this many ascii hex chars
 TRACE_ID_CHARS = 16

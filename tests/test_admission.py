@@ -1,0 +1,502 @@
+"""Overload-resilient service plane (ISSUE 5): bounded admission with
+explicit sheds, two priority classes, deadline propagation +
+abandoned-request reaping, MicroBatcher drain vs abort semantics, the
+service drain op, and the REST in-flight gate + /v1/drain."""
+
+import threading
+import time
+
+import pytest
+
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import Flow, Protocol, TrafficDirection, Verdict
+from cilium_tpu.runtime import admission
+from cilium_tpu.runtime.admission import (
+    CLASS_CONTROL,
+    CLASS_DATA,
+    SHED_DEADLINE,
+    SHED_DRAINING,
+    SHED_QUEUE_FULL,
+    AdmissionGate,
+    RequestSlots,
+    deadline_from_ms,
+)
+from cilium_tpu.runtime.loader import Loader
+from cilium_tpu.runtime.metrics import (
+    ADMISSION_ADMITTED,
+    ADMISSION_REAPED,
+    ADMISSION_SHED,
+    METRICS,
+)
+from cilium_tpu.runtime.service import MicroBatcher, VerdictService
+
+
+def _metric(name, labels=None):
+    return METRICS.get(name, labels)
+
+
+# ---------------------------------------------------------------------------
+# AdmissionGate
+
+
+def test_gate_bounds_data_and_reserves_control():
+    depth = [0]
+    gate = AdmissionGate(max_pending=4, control_reserve=2,
+                         depth_fn=lambda: depth[0])
+    adm0 = _metric(ADMISSION_ADMITTED,
+                   {"surface": "service", "class": CLASS_DATA})
+    assert gate.admit(CLASS_DATA) == (True, "")
+    depth[0] = 4
+    # at the bound: data sheds, control rides the reserve
+    assert gate.admit(CLASS_DATA) == (False, SHED_QUEUE_FULL)
+    assert gate.admit(CLASS_CONTROL) == (True, "")
+    depth[0] = 6
+    assert gate.admit(CLASS_CONTROL) == (False, SHED_QUEUE_FULL)
+    assert _metric(ADMISSION_ADMITTED,
+                   {"surface": "service",
+                    "class": CLASS_DATA}) == adm0 + 1
+    assert _metric(ADMISSION_SHED,
+                   {"surface": "service", "class": CLASS_DATA,
+                    "reason": SHED_QUEUE_FULL}) >= 1
+
+
+def test_gate_deadline_feasibility():
+    clock = [100.0]
+    depth = [0]
+    gate = AdmissionGate(max_pending=100, depth_fn=lambda: depth[0],
+                         clock=lambda: clock[0])
+    # already-expired deadline: shed on arrival
+    assert gate.admit(CLASS_DATA, deadline=99.0) == \
+        (False, SHED_DEADLINE)
+    # feasible until the rate estimate says the queue is too deep:
+    # 100 records/s service rate, 50 queued → ~0.5 s wait
+    gate.note_batch(100, 1.0)
+    depth[0] = 50
+    assert gate.admit(CLASS_DATA, deadline=clock[0] + 1.0)[0] is True
+    assert gate.admit(CLASS_DATA, deadline=clock[0] + 0.2) == \
+        (False, SHED_DEADLINE)
+    # control class obeys the same physics (a deadline is a deadline)
+    assert gate.admit(CLASS_CONTROL, deadline=clock[0] + 0.2) == \
+        (False, SHED_DEADLINE)
+
+
+def test_gate_drain_mode_sheds_data_admits_control():
+    gate = AdmissionGate(max_pending=10, depth_fn=lambda: 0)
+    assert not gate.draining
+    gate.begin_drain()
+    gate.begin_drain()  # idempotent
+    assert gate.draining
+    assert gate.admit(CLASS_DATA) == (False, SHED_DRAINING)
+    assert gate.admit(CLASS_CONTROL) == (True, "")
+    # drain is honored even with the gate knob off
+    off = AdmissionGate(max_pending=10, enabled=False)
+    off.begin_drain()
+    assert off.admit(CLASS_DATA) == (False, SHED_DRAINING)
+
+
+def test_deadline_from_ms():
+    now = 50.0
+    assert deadline_from_ms(2000, 5000.0, clock=lambda: now) == 52.0
+    assert deadline_from_ms(None, 5000.0, clock=lambda: now) == 55.0
+    assert deadline_from_ms(0, 5000.0, clock=lambda: now) == 55.0
+    assert deadline_from_ms("junk", 1000.0, clock=lambda: now) == 51.0
+    # negative = the caller already gave up: expires in the past
+    assert deadline_from_ms(-1000, 5000.0, clock=lambda: now) == 49.0
+
+
+# ---------------------------------------------------------------------------
+# MicroBatcher: hard bound, reaping, drain vs abort
+
+
+def test_batcher_hard_bound_sheds_explicitly():
+    release = threading.Event()
+
+    def slow_verdicts(flows):
+        release.wait(5.0)
+        return [int(Verdict.FORWARDED)] * len(flows)
+
+    mb = MicroBatcher(slow_verdicts, batch_max=1, deadline_ms=0.0,
+                      max_pending=2)
+    shed0 = _metric(ADMISSION_SHED,
+                    {"surface": "batcher", "class": CLASS_DATA,
+                     "reason": SHED_QUEUE_FULL})
+    results = []
+    threads = [threading.Thread(
+        target=lambda: results.append(mb.check_ex(Flow(), timeout=5.0)))
+        for _ in range(6)]
+    for t in threads:
+        t.start()
+    # wait until the queue is saturated: 1 in flight, 2 queued, rest
+    # must shed at the bound
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline and len(results) < 3:
+        time.sleep(0.005)
+    release.set()
+    for t in threads:
+        t.join(timeout=5.0)
+    statuses = [s for _, s in results]
+    assert statuses.count("shed") >= 1
+    assert mb.peak_pending <= 2
+    for v, s in results:
+        if s == "shed":
+            assert v == int(Verdict.ERROR)
+        else:
+            assert (v, s) == (int(Verdict.FORWARDED), "ok")
+    assert _metric(ADMISSION_SHED,
+                   {"surface": "batcher", "class": CLASS_DATA,
+                    "reason": SHED_QUEUE_FULL}) > shed0
+    mb.close()
+
+
+def test_batcher_reaps_abandoned_entries_before_dispatch():
+    """A caller that times out marks its entry abandoned; the drain
+    worker drops it before featurize/dispatch — the engine never sees
+    the flow."""
+    gate_open = threading.Event()
+    seen = []
+
+    def verdicts(flows):
+        seen.append([f.dport for f in flows])
+        gate_open.wait(5.0)
+        return [int(Verdict.FORWARDED)] * len(flows)
+
+    mb = MicroBatcher(verdicts, batch_max=1, deadline_ms=0.0)
+    reaped0 = _metric(ADMISSION_REAPED)
+    # first request occupies the single drain worker
+    t1 = threading.Thread(
+        target=lambda: mb.check(Flow(dport=1), timeout=5.0))
+    t1.start()
+    while not seen:
+        time.sleep(0.005)
+    # second request queues behind it and gives up immediately
+    v, status = mb.check_ex(Flow(dport=2), timeout=0.01)
+    assert (v, status) == (int(Verdict.ERROR), "timeout")
+    gate_open.set()
+    t1.join(timeout=5.0)
+    # let the worker pick up (and reap) the abandoned entry
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline and \
+            _metric(ADMISSION_REAPED) <= reaped0:
+        time.sleep(0.005)
+    assert _metric(ADMISSION_REAPED) > reaped0
+    assert all(2 not in batch for batch in seen), seen
+    mb.close()
+
+
+def test_batcher_reaps_expired_deadlines():
+    gate_open = threading.Event()
+    seen = []
+
+    def verdicts(flows):
+        seen.append([f.dport for f in flows])
+        gate_open.wait(5.0)
+        return [int(Verdict.FORWARDED)] * len(flows)
+
+    mb = MicroBatcher(verdicts, batch_max=1, deadline_ms=0.0)
+    reaped0 = _metric(ADMISSION_REAPED)
+    t1 = threading.Thread(
+        target=lambda: mb.check(Flow(dport=1), timeout=5.0))
+    t1.start()
+    while not seen:
+        time.sleep(0.005)
+    # queued with a deadline that lapses while the worker is busy: the
+    # caller's wait is CAPPED at the deadline (not the 5 s timeout) and
+    # the lapsed entry is reaped before dispatch
+    box = []
+    t0 = time.monotonic()
+    t2 = threading.Thread(target=lambda: box.append(mb.check_ex(
+        Flow(dport=2), timeout=5.0,
+        deadline=time.monotonic() + 0.02)))
+    t2.start()
+    t2.join(timeout=5.0)
+    waited = time.monotonic() - t0
+    gate_open.set()
+    t1.join(timeout=5.0)
+    assert box and box[0] == (int(Verdict.ERROR), "timeout")
+    assert waited < 2.0  # returned at the deadline, not the timeout
+    # the worker reaps the lapsed entry instead of dispatching it
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline and \
+            _metric(ADMISSION_REAPED) <= reaped0:
+        time.sleep(0.005)
+    assert _metric(ADMISSION_REAPED) > reaped0
+    assert all(2 not in batch for batch in seen), seen
+    mb.close()
+
+
+def test_batcher_drain_flushes_pending_close_aborts():
+    """drain(): queued entries get REAL verdicts; close(abort=True):
+    queued entries get ERROR — the two halves of the old close()."""
+    stall = threading.Event()
+
+    def verdicts(flows):
+        stall.wait(0.05)
+        return [int(Verdict.FORWARDED)] * len(flows)
+
+    # drain path
+    mb = MicroBatcher(verdicts, batch_max=64, deadline_ms=50.0)
+    results = []
+    threads = [threading.Thread(
+        target=lambda: results.append(mb.check(Flow(), timeout=5.0)))
+        for _ in range(8)]
+    for t in threads:
+        t.start()
+    time.sleep(0.02)  # let them enqueue (deadline_ms holds the batch)
+    stall.set()
+    flushed = mb.drain(timeout=5.0)
+    for t in threads:
+        t.join(timeout=5.0)
+    assert results and all(v == int(Verdict.FORWARDED)
+                           for v in results), results
+    assert flushed >= 1
+    assert mb.drain() == 0  # idempotent
+    # post-drain checks are refused, not queued
+    assert mb.check_ex(Flow())[1] == "closed"
+
+    # abort path
+    stall2 = threading.Event()
+    mb2 = MicroBatcher(
+        lambda flows: (stall2.wait(5.0),
+                       [int(Verdict.FORWARDED)] * len(flows))[1],
+        batch_max=1, deadline_ms=0.0)
+    r2 = []
+    t1 = threading.Thread(target=lambda: r2.append(mb2.check(
+        Flow(dport=1), timeout=5.0)))
+    t1.start()
+    time.sleep(0.02)
+    t2 = threading.Thread(target=lambda: r2.append(mb2.check(
+        Flow(dport=2), timeout=5.0)))
+    t2.start()
+    time.sleep(0.02)
+    mb2.close(abort=True)  # queued entry (dport=2) errors NOW
+    stall2.set()
+    t1.join(timeout=5.0)
+    t2.join(timeout=5.0)
+    assert int(Verdict.ERROR) in r2
+
+
+# ---------------------------------------------------------------------------
+# Service-level: shed responses, deadline on the wire, the drain op
+
+
+def _tiny_service(tmp_path, **admission_kw):
+    from tests.test_faults import _tiny_policy
+
+    cfg = Config()
+    cfg.loader.enable_cache = False
+    for k, v in admission_kw.items():
+        setattr(cfg.admission, k, v)
+    loader = Loader(cfg)
+    per, db, web = _tiny_policy(5432)
+    loader.regenerate(per, revision=1)
+    svc = VerdictService(loader, str(tmp_path / "adm.sock"))
+    svc.start()
+    return svc, int(db), int(web)
+
+
+def _flow_dict(web, db, port):
+    return {"source": {"identity": web},
+            "destination": {"identity": db},
+            "l4": {"TCP": {"destination_port": port}},
+            "traffic_direction": "INGRESS"}
+
+
+def test_service_check_carries_deadline_and_sheds_expired(tmp_path):
+    from cilium_tpu.runtime.service import VerdictClient
+
+    svc, db, web = _tiny_service(tmp_path)
+    try:
+        client = VerdictClient(svc.socket_path)
+        ok = client.call({"op": "check",
+                          "flow": _flow_dict(web, db, 5432),
+                          "deadline_ms": 4000})
+        assert ok["verdict"] == 1 and "shed" not in ok
+        # a negative deadline is infeasible on arrival → explicit shed
+        shed = client.call({"op": "check",
+                            "flow": _flow_dict(web, db, 5432),
+                            "deadline_ms": -1})
+        assert shed["shed"] is True
+        assert shed["reason"] == SHED_DEADLINE
+        assert shed["verdict"] == int(Verdict.ERROR)
+        # same on the bulk op
+        bulk = client.call({"op": "verdict",
+                            "flows": [_flow_dict(web, db, 5432)],
+                            "deadline_ms": -1})
+        assert bulk["shed"] is True and "verdicts" not in bulk
+        client.close()
+    finally:
+        svc.stop()
+
+
+def test_service_drain_op_flushes_and_keeps_control_plane(tmp_path):
+    from cilium_tpu.runtime.service import VerdictClient
+
+    svc, db, web = _tiny_service(tmp_path)
+    try:
+        client = VerdictClient(svc.socket_path)
+        assert client.call({"op": "check",
+                            "flow": _flow_dict(web, db, 5432)}
+                           )["verdict"] == 1
+        resp = client.call({"op": "drain"})
+        assert resp["ok"] is True
+        assert resp["warm_snapshot"] is False  # cache disabled
+        # drained: data path sheds with reason=draining…
+        shed = client.call({"op": "check",
+                            "flow": _flow_dict(web, db, 5432)})
+        assert shed["shed"] is True
+        assert shed["reason"] == SHED_DRAINING
+        # …while control ops keep answering
+        assert client.call({"op": "ping"})["ok"] is True
+        assert client.call({"op": "status"})["engine_revision"] == 1
+        # new stream sessions are refused at the handshake
+        import socket as socket_mod
+
+        from cilium_tpu.runtime.service import recv_msg, send_msg
+
+        s = socket_mod.socket(socket_mod.AF_UNIX,
+                              socket_mod.SOCK_STREAM)
+        s.connect(svc.socket_path)
+        send_msg(s, {"op": "stream_start"})
+        ack = recv_msg(s)
+        assert ack.get("shed") is True
+        s.close()
+        client.close()
+    finally:
+        svc.stop()
+
+
+def test_stream_ack_advertises_credit_window(tmp_path):
+    import socket as socket_mod
+
+    from cilium_tpu.runtime.service import recv_msg, send_msg
+
+    svc, _, _ = _tiny_service(tmp_path, stream_credit_window=7)
+    try:
+        s = socket_mod.socket(socket_mod.AF_UNIX,
+                              socket_mod.SOCK_STREAM)
+        s.connect(svc.socket_path)
+        send_msg(s, {"op": "stream_start", "credit": True})
+        ack = recv_msg(s)
+        assert ack["ok"] and ack["credit"] == 7
+        s.close()
+        # a hello WITHOUT the opt-in gets no window (old-peer interop)
+        s = socket_mod.socket(socket_mod.AF_UNIX,
+                              socket_mod.SOCK_STREAM)
+        s.connect(svc.socket_path)
+        send_msg(s, {"op": "stream_start"})
+        assert "credit" not in recv_msg(s)
+        s.close()
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# REST: in-flight slots + POST /v1/drain
+
+
+def test_request_slots_control_reserve():
+    slots = RequestSlots(max_inflight=1, control_reserve=1)
+    assert slots.acquire(CLASS_DATA) == (True, "")
+    assert slots.acquire(CLASS_DATA) == (False, SHED_QUEUE_FULL)
+    assert slots.acquire(CLASS_CONTROL) == (True, "")
+    assert slots.acquire(CLASS_CONTROL) == (False, SHED_QUEUE_FULL)
+    slots.release()
+    slots.release()
+    assert slots.inflight == 0
+    assert slots.acquire(CLASS_DATA) == (True, "")
+
+
+@pytest.fixture()
+def rest_agent(tmp_path):
+    from cilium_tpu.agent import Agent
+
+    cfg = Config()
+    cfg.loader.enable_cache = False
+    agent = Agent(config=cfg,
+                  socket_path=str(tmp_path / "svc.sock"),
+                  api_socket_path=str(tmp_path / "api.sock"))
+    agent.start()
+    yield agent
+    agent.stop()
+
+
+def test_rest_sheds_data_class_but_not_control(rest_agent):
+    from cilium_tpu.runtime.api import APIClient
+
+    client = APIClient(rest_agent.api_socket_path)
+    # artificially exhaust the data-class slots
+    slots = rest_agent.api_server._server.slots
+    slots.max_inflight = 0
+    try:
+        status, body = client.request("GET", "/v1/endpoint")
+        assert status == 503 and body["shed"] is True
+        # control path rides the reserve
+        assert client.healthz()["status"] == "ok"
+        # an already-expired client deadline sheds without a slot
+        status, body = client.request(
+            "GET", "/v1/healthz")
+        assert status == 200
+    finally:
+        slots.max_inflight = 64
+
+
+def test_rest_drain_endpoint_and_deadline_header(rest_agent):
+    from cilium_tpu.runtime.api import APIClient, _UnixHTTPConnection
+
+    client = APIClient(rest_agent.api_socket_path)
+    status, body = client.drain()
+    assert status == 200 and body["ok"] is True
+    # verdict service now sheds data; REST control plane still up
+    assert client.healthz()["status"] == "ok"
+    assert rest_agent.service.gate.draining
+    # an expired deadline header sheds explicitly
+    conn = _UnixHTTPConnection(rest_agent.api_socket_path)
+    try:
+        conn.request("GET", "/v1/endpoint",
+                     headers={"X-Cilium-Deadline-Ms": "0"})
+        resp = conn.getresponse()
+        assert resp.status == 503
+        import json
+
+        assert json.loads(resp.read())["reason"] == SHED_DEADLINE
+    finally:
+        conn.close()
+
+
+def test_agent_stop_drains_in_flight_requests(tmp_path):
+    """Agent.stop() uses the drain path: a request in flight when stop
+    begins resolves with a real verdict, not ERROR."""
+    from tests.test_faults import _tiny_policy
+
+    from cilium_tpu.agent import Agent
+
+    cfg = Config()
+    cfg.loader.enable_cache = False
+    agent = Agent(config=cfg, socket_path=str(tmp_path / "svc.sock"))
+    agent.start()
+    per, db, web = _tiny_policy(5432)
+    agent.loader.regenerate(per, revision=1)
+    batcher = agent.service.bridge.batcher
+    # hold the drain worker so an entry is mid-queue during stop
+    stall = threading.Event()
+    orig = batcher.verdict_fn
+
+    def gated(flows, deadline=None):
+        stall.wait(2.0)
+        return orig(flows, deadline=deadline)
+
+    batcher.verdict_fn = gated
+    got = []
+    t = threading.Thread(target=lambda: got.append(batcher.check(
+        Flow(src_identity=web, dst_identity=db, dport=5432,
+             protocol=Protocol.TCP,
+             direction=TrafficDirection.INGRESS), timeout=10.0)))
+    t.start()
+    time.sleep(0.05)
+    stopper = threading.Thread(target=agent.stop)
+    stopper.start()
+    time.sleep(0.05)
+    stall.set()
+    t.join(timeout=10.0)
+    stopper.join(timeout=10.0)
+    assert got == [1], got

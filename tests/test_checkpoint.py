@@ -1,0 +1,152 @@
+"""runtime/checkpoint.py: fingerprint stability ACROSS processes (the
+property the warm-restart cycle rests on), corrupt-entry → counted
+delete → recompile path, narrowed exception handling (MemoryError and
+KeyboardInterrupt must escape), and concurrent same-key puts."""
+
+import os
+import pickle
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from cilium_tpu.runtime.checkpoint import ArtifactCache, ruleset_fingerprint
+from cilium_tpu.runtime.metrics import ARTIFACT_CACHE_CORRUPT, METRICS
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Fingerprints
+
+
+def test_fingerprint_stable_across_processes():
+    """The artifact key must be a pure function of the descriptors —
+    NOT of PYTHONHASHSEED or process identity — or a restarted service
+    could never find its own warm artifacts."""
+    parts = ("policy-v6", True,
+             [(1, ("a", "b"), 3.5), (2, ("c",), 0.25)],
+             {"nested": ("tuple", 7)})
+    local = ruleset_fingerprint(*parts)
+    code = (
+        "from cilium_tpu.runtime.checkpoint import ruleset_fingerprint\n"
+        "print(ruleset_fingerprint('policy-v6', True,"
+        " [(1, ('a', 'b'), 3.5), (2, ('c',), 0.25)],"
+        " {'nested': ('tuple', 7)}))")
+    for seed in ("0", "1", "random"):
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=120,
+            cwd=REPO_ROOT,
+            env=dict(os.environ, PYTHONHASHSEED=seed,
+                     JAX_PLATFORMS="cpu"))
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip() == local, (seed, out.stdout)
+
+
+def test_fingerprint_distinguishes_inputs():
+    assert ruleset_fingerprint("a") != ruleset_fingerprint("b")
+    assert ruleset_fingerprint("a", 1) != ruleset_fingerprint("a", 2)
+    assert len(ruleset_fingerprint("a")) == 24
+
+
+# ---------------------------------------------------------------------------
+# Corrupt entries
+
+
+def test_corrupt_entry_is_deleted_and_counted(tmp_path):
+    cache = ArtifactCache(str(tmp_path))
+    cache.put("k", {"compiled": [1, 2, 3]})
+    assert cache.get("k") == {"compiled": [1, 2, 3]}
+    path = cache._path("k")
+    with open(path, "wb") as f:
+        f.write(b"\x80\x05garbage not a pickle")
+    before = METRICS.get(ARTIFACT_CACHE_CORRUPT)
+    assert cache.get("k") is None        # corrupt → miss (recompile)
+    assert not os.path.exists(path)      # poison deleted…
+    assert METRICS.get(ARTIFACT_CACHE_CORRUPT) == before + 1
+    assert cache.get("k") is None        # …so the re-read is a CLEAN
+    assert METRICS.get(ARTIFACT_CACHE_CORRUPT) == before + 1  # miss
+
+
+def test_truncated_and_unimportable_entries_recompile(tmp_path):
+    cache = ArtifactCache(str(tmp_path))
+    cache.put("t", list(range(1000)))
+    path = cache._path("t")
+    with open(path, "rb") as f:
+        blob = f.read()
+    with open(path, "wb") as f:
+        f.write(blob[: len(blob) // 2])   # truncation → EOF/Unpickling
+    assert cache.get("t") is None
+    assert not os.path.exists(path)
+    # a pickle referencing a class that no longer exists (version
+    # skew) → AttributeError path, same recompile outcome
+    with open(cache._path("skew"), "wb") as f:
+        f.write(pickle.dumps(("cilium_tpu.no_such_module", 1))
+                .replace(b"cilium_tpu.no_such_module",
+                         b"cilium_tpu.no_such_module"))
+        # hand-craft a STACK_GLOBAL pickle for a missing attribute
+    with open(cache._path("skew"), "wb") as f:
+        f.write(b"\x80\x04\x95\x2e\x00\x00\x00\x00\x00\x00\x00\x8c"
+                b"\x14cilium_tpu.runtime\x8c\x0eNoSuchArtifact\x93.")
+    assert cache.get("skew") is None
+    assert not os.path.exists(cache._path("skew"))
+
+
+def test_fatal_exceptions_are_not_swallowed(tmp_path, monkeypatch):
+    """The old `except Exception` turned a MemoryError mid-load into a
+    silent recompile; the narrowed handler must let fatal/interrupt
+    exceptions escape."""
+    cache = ArtifactCache(str(tmp_path))
+    cache.put("k", "v")
+
+    for exc in (MemoryError, KeyboardInterrupt):
+        def boom(*a, **kw):
+            raise exc()
+
+        monkeypatch.setattr(pickle, "load", boom)
+        with pytest.raises(exc):
+            cache.get("k")
+        monkeypatch.undo()
+    assert cache.get("k") == "v"  # entry untouched by the failures
+
+
+def test_disabled_cache_is_inert(tmp_path):
+    cache = ArtifactCache(str(tmp_path / "off"), enable=False)
+    cache.put("k", "v")
+    assert cache.get("k") is None
+    assert not os.path.exists(str(tmp_path / "off"))
+
+
+# ---------------------------------------------------------------------------
+# Concurrency
+
+
+def test_concurrent_put_same_key_no_torn_reads(tmp_path):
+    """Concurrent puts of the same (content-addressed) key must never
+    leave a torn file or a stray tmp: every get during and after the
+    race returns a complete value."""
+    cache = ArtifactCache(str(tmp_path))
+    payload = {"blob": list(range(5000))}
+    start = threading.Barrier(8)
+    errors = []
+
+    def writer():
+        start.wait()
+        for _ in range(20):
+            cache.put("hot", payload)
+            got = cache.get("hot")
+            if got is not None and got != payload:
+                errors.append(got)
+
+    threads = [threading.Thread(target=writer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert not errors
+    assert cache.get("hot") == payload
+    leftovers = [p for p in os.listdir(str(tmp_path))
+                 if p.endswith(".tmp")]
+    assert leftovers == []

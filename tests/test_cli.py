@@ -197,3 +197,19 @@ def test_unreachable_socket_is_an_error_not_a_traceback(tmp_path, capsys):
     rc = cli.main(["status", "--socket", str(tmp_path / "nope.sock")])
     err = capsys.readouterr().err
     assert rc == 1 and "error" in err
+
+
+def test_drain_command(live_agent, capsys):
+    """`cilium-tpu drain`: orders the graceful drain over the verdict
+    socket; the service then sheds data-path work with an explicit
+    reason while control ops keep answering."""
+    agent, svc, api, hubble, tmp = live_agent
+
+    rc, out = _run(capsys, ["drain", "--socket", svc])
+    assert rc == 0
+    resp = json.loads(out)
+    assert resp["ok"] is True and "flushed" in resp
+    assert agent.service.gate.draining
+    # control plane still answers post-drain
+    rc, out = _run(capsys, ["status", "--socket", svc])
+    assert rc == 0

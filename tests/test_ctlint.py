@@ -1008,3 +1008,101 @@ def test_metrics_endpoint_honors_enable_metrics():
             assert status == 404
         finally:
             server.stop()
+
+
+# -- unbounded-queue --------------------------------------------------------
+
+from cilium_tpu.analysis import queues as queue_rule  # noqa: E402
+
+QUEUE_BAD = """\
+import queue
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self.q = queue.Queue()
+        self._pending = []
+
+    def start(self):
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def submit(self, item):
+        self._pending.append(item)
+
+    def _run(self):
+        pass
+"""
+
+QUEUE_GOOD = """\
+import queue
+import threading
+
+
+class Pipeline:
+    def __init__(self, bound):
+        self.q = queue.Queue(maxsize=bound)
+        self.q2 = queue.Queue(8)
+        self._pending = []
+        self.max_pending = bound
+
+    def start(self):
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def submit(self, item):
+        if len(self._pending) >= self.max_pending:
+            return False
+        self._pending.append(item)
+        return True
+
+    def _run(self):
+        pass
+"""
+
+
+def test_unbounded_queue_bad_corpus():
+    findings = _check({"pkg/pipe.py": QUEUE_BAD}, queue_rule.check)
+    msgs = "\n".join(f.message for f in findings)
+    assert all(f.rule == "unbounded-queue" for f in findings)
+    assert "`Queue()` without `maxsize`" in msgs
+    assert "_pending" in msgs and "list used as a queue" in msgs
+    assert len(findings) == 2
+
+
+def test_unbounded_queue_good_corpus():
+    assert _check({"pkg/pipe.py": QUEUE_GOOD},
+                  queue_rule.check) == []
+
+
+def test_unbounded_queue_scoping_and_forms():
+    # no threading import → out of scope (single-threaded scripts may
+    # use lists freely)
+    single = QUEUE_BAD.replace("import threading\n", "") \
+        .replace("self._t = threading.Thread(target=self._run)\n"
+                 "        self._t.start()", "pass")
+    assert _check({"pkg/single.py": single}, queue_rule.check) == []
+    # `from queue import Queue` resolves through module imports
+    src = ("from queue import Queue\n"
+           "import threading\n\n\n"
+           "def build():\n"
+           "    return Queue()\n")
+    findings = _check({"pkg/q.py": src}, queue_rule.check)
+    assert len(findings) == 1 and findings[0].rule == "unbounded-queue"
+    # LifoQueue/PriorityQueue count too
+    src2 = ("import queue\nimport threading\n\n\n"
+            "def build():\n"
+            "    return queue.PriorityQueue()\n")
+    assert len(_check({"pkg/q2.py": src2}, queue_rule.check)) == 1
+
+
+def test_unbounded_queue_disable_pragma_honored():
+    src = QUEUE_BAD.replace(
+        "        self._pending.append(item)",
+        "        # ctlint: disable=unbounded-queue  # test-only log\n"
+        "        self._pending.append(item)").replace(
+        "        self.q = queue.Queue()",
+        "        # ctlint: disable=unbounded-queue  # drained inline\n"
+        "        self.q = queue.Queue()")
+    assert _check({"pkg/pipe.py": src}, queue_rule.check) == []

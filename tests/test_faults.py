@@ -127,7 +127,8 @@ def test_registered_points_cover_the_documented_seams():
 
     pts = faults.registered_points()
     for p in ("engine.dispatch", "loader.swap", "stream.frame.server",
-              "stream.frame.client", "kvstore.watch",
+              "stream.frame.client", "stream.credit", "service.admit",
+              "service.drain", "kvstore.watch",
               "clustermesh.session", "dnsproxy.query"):
         assert p in pts, p
 
@@ -614,3 +615,166 @@ def test_chaos_stream_replay_with_drops_and_device_faults(tmp_path):
         client.close()
     finally:
         svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 5: overload/drain fault points + the drain→restart warm cycle
+
+
+def test_admission_fault_forces_an_explicit_shed(tmp_path):
+    """An injected service.admit fault is a SHED — the request is
+    refused explicitly (counted, flagged), never half-admitted."""
+    from cilium_tpu.runtime.service import VerdictClient
+
+    per, db, web = _tiny_policy(5432)
+    svc = _service(tmp_path, per, offload=False)
+    try:
+        client = VerdictClient(svc.socket_path)
+        flow = {"source": {"identity": int(web)},
+                "destination": {"identity": int(db)},
+                "l4": {"TCP": {"destination_port": 5432}},
+                "traffic_direction": "INGRESS"}
+        plan = FaultPlan([FaultRule("service.admit", times=1)], seed=3)
+        with faults.inject(plan):
+            shed = client.call({"op": "check", "flow": flow})
+            assert shed["shed"] is True and shed["reason"] == "fault"
+            # the fault budget is spent: the next request serves
+            ok = client.call({"op": "check", "flow": flow})
+            assert ok["verdict"] == 1 and "shed" not in ok
+        assert plan.counts("service.admit") == (2, 1)
+        client.close()
+    finally:
+        svc.stop()
+
+
+def test_drain_fault_leaves_gate_draining_and_retry_succeeds(tmp_path):
+    """A crash between stop-admitting and the flush (service.drain
+    point): the drain op errors, the gate STAYS draining (fail-safe:
+    no half-open re-admission), and a retried drain completes."""
+    from cilium_tpu.runtime.service import VerdictClient
+
+    per, _db, _web = _tiny_policy(5432)
+    svc = _service(tmp_path, per, offload=False)
+    try:
+        client = VerdictClient(svc.socket_path)
+        with faults.inject(FaultPlan(
+                [FaultRule("service.drain", times=1)], seed=9)):
+            resp = client.call({"op": "drain"})
+            assert "error" in resp
+            assert svc.gate.draining  # fail-safe: still draining
+            retry = client.call({"op": "drain"})
+            assert retry["ok"] is True
+        client.close()
+    finally:
+        svc.stop()
+
+
+def test_stream_credit_grant_loss_degrades_not_corrupts(tmp_path):
+    """An injected stream.credit fault LOSES one grant: the client's
+    window shrinks by one but every verdict still lands and matches —
+    credit loss costs pacing, never correctness."""
+    from cilium_tpu.runtime.stream import StreamClient
+
+    per, db, web = _tiny_policy(5432)
+    svc = _service(tmp_path, per, offload=False)
+    try:
+        flows = _stream_flows(web, db, 32)
+        want = [int(v) for v in
+                svc.loader.engine.verdict_flows(flows)["verdict"]]
+        client = StreamClient(svc.socket_path, timeout=30.0)
+        window = client._credits
+        assert window and window > 1
+        plan = FaultPlan([FaultRule("stream.credit", times=1)], seed=4)
+        with faults.inject(plan):
+            seqs = [client.send_flows(flows) for _ in range(6)]
+            client.finish()
+            for seq in seqs:
+                assert list(client.result(seq)) == want
+        assert plan.counts("stream.credit")[1] == 1
+        # exactly one grant was lost → steady-state window is one low
+        with client._cond:
+            assert client._credits == window - 1
+        client.close()
+    finally:
+        svc.stop()
+
+
+def test_drain_restart_cycle_is_verdict_clean_and_warm(tmp_path):
+    """THE ISSUE 5 acceptance cycle: requests in flight when the drain
+    begins finish with REAL verdicts (zero ERRORs); the warm snapshot
+    lands; a fresh loader (new process stand-in, same cache dir)
+    restores it with ZERO recompilation and reproduces the golden
+    corpus verdict-identically."""
+    from cilium_tpu.runtime.metrics import WARM_RESTORES
+    from cilium_tpu.runtime.service import VerdictClient
+
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.loader.cache_dir = str(tmp_path / "cache")
+    per, db, web = _tiny_policy(5432)
+    loader = Loader(cfg)
+    loader.regenerate(per, revision=7)
+    svc = VerdictService(loader, str(tmp_path / "svc.sock"))
+    svc.start()
+
+    corpus = [{"source": {"identity": int(web)},
+               "destination": {"identity": int(db)},
+               "l4": {"TCP": {"destination_port": p}},
+               "traffic_direction": "INGRESS"}
+              for p in (5432, 5433, 80, 5432, 9999)]
+    try:
+        client = VerdictClient(svc.socket_path)
+        golden = client.call({"op": "verdict", "flows": corpus})
+        assert "verdicts" in golden
+
+        # in-flight requests racing the drain: every ADMITTED check
+        # resolves with a real verdict, sheds are explicit
+        results = []
+        lock = threading.Lock()
+
+        def caller():
+            c = VerdictClient(svc.socket_path)
+            for _ in range(12):
+                r = c.call({"op": "check", "flow": corpus[0]})
+                with lock:
+                    results.append(r)
+            c.close()
+
+        threads = [threading.Thread(target=caller) for _ in range(4)]
+        for t in threads:
+            t.start()
+        drained = svc.drain()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert drained["ok"] and drained["warm_snapshot"] is True
+        admitted = [r for r in results if not r.get("shed")]
+        shed = [r for r in results if r.get("shed")]
+        assert all(r["verdict"] == 1 for r in admitted), admitted[:5]
+        assert all(r["reason"] for r in shed)
+        client.close()
+    finally:
+        svc.stop()
+
+    # "restart": a fresh loader over the same artifact cache — no
+    # policy replay, no fingerprint walk, no compile
+    compiles0 = METRICS.histo_count("cilium_tpu_compile_seconds")
+    warm0 = _metric(WARM_RESTORES)
+    cfg2 = Config()
+    cfg2.enable_tpu_offload = True
+    cfg2.loader.cache_dir = str(tmp_path / "cache")
+    loader2 = Loader(cfg2)
+    assert loader2.restore_warm() is True
+    assert loader2.revision == 7
+    assert _metric(WARM_RESTORES) == warm0 + 1
+    assert METRICS.histo_count("cilium_tpu_compile_seconds") \
+        == compiles0, "warm restore recompiled"
+
+    svc2 = VerdictService(loader2, str(tmp_path / "svc2.sock"))
+    svc2.start()
+    try:
+        client2 = VerdictClient(svc2.socket_path)
+        replay = client2.call({"op": "verdict", "flows": corpus})
+        assert replay["verdicts"] == golden["verdicts"]
+        client2.close()
+    finally:
+        svc2.stop()

@@ -244,3 +244,135 @@ def test_stream_raw_frame_protocol(tmp_path):
         sock.close()
     finally:
         service.stop()
+
+
+# -- credit-based flow control ----------------------------------------------
+
+
+def test_stream_credits_roundtrip_and_accounting(tmp_path):
+    """Every chunk consumes a credit, every answered chunk grants one
+    back: after a full send/finish cycle the window is restored and
+    the grant counter moved by exactly the chunk count."""
+    from cilium_tpu.runtime.metrics import (
+        METRICS,
+        STREAM_CREDITS_GRANTED,
+    )
+
+    service, loader, scenario = _service(tmp_path, "http", tpu=False)
+    try:
+        granted0 = METRICS.get(STREAM_CREDITS_GRANTED)
+        client = StreamClient(service.socket_path)
+        window = client._credits
+        assert window == 32  # the configured default window
+        seqs = [client.send_flows(scenario.flows[:64])
+                for _ in range(5)]
+        client.finish()
+        for seq in seqs:
+            assert len(client.result(seq)) == 64
+        with client._cond:
+            assert client._credits == window  # all granted back
+        assert METRICS.get(STREAM_CREDITS_GRANTED) == granted0 + 5
+        client.close()
+    finally:
+        service.stop()
+
+
+def test_stream_client_halts_at_zero_credit(tmp_path):
+    """Deterministic backpressure: a window-1 server that withholds
+    its answer leaves the client's second send BLOCKED; the answer
+    (and its grant) releases it."""
+    import os
+    import socket
+    import struct
+    import threading
+
+    from cilium_tpu.runtime.metrics import (
+        METRICS,
+        STREAM_CREDIT_WAITS,
+    )
+    from cilium_tpu.runtime.service import recv_msg, send_msg
+    from cilium_tpu.runtime.stream import KIND_CREDIT
+
+    path = str(tmp_path / "fake.sock")
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv.bind(path)
+    srv.listen(1)
+    release = threading.Event()
+    server_err = []
+
+    def fake_server():
+        try:
+            conn, _ = srv.accept()
+            assert recv_msg(conn)["op"] == "stream_start"
+            send_msg(conn, {"ok": True, "revision": 1, "credit": 1})
+            seq, kind, payload = recv_frame(conn)   # chunk 0 arrives
+            release.wait(10.0)
+            # answer chunk 0 (empty verdict array) + grant its credit
+            send_frame(conn, seq, KIND_CHUNK, b"\x01")
+            send_frame(conn, seq, KIND_CREDIT, struct.pack("<I", 1))
+            seq2, _, _ = recv_frame(conn)           # the unblocked send
+            send_frame(conn, seq2, KIND_CHUNK, b"\x01")
+            send_frame(conn, seq2, KIND_CREDIT, struct.pack("<I", 1))
+            recv_frame(conn)                        # KIND_END
+            send_frame(conn, 99, KIND_END)
+            conn.close()
+        except Exception as e:  # surfaces in the main thread's assert
+            server_err.append(e)
+
+    t = threading.Thread(target=fake_server, daemon=True)
+    t.start()
+    client = StreamClient(path, timeout=10.0)
+    assert client._credits == 1
+    waits0 = METRICS.get(STREAM_CREDIT_WAITS)
+    client.send_image(b"chunk-zero")      # consumes the only credit
+    sent2 = []
+    t2 = threading.Thread(
+        target=lambda: sent2.append(client.send_image(b"chunk-one")))
+    t2.start()
+    t2.join(timeout=0.3)
+    assert t2.is_alive(), "send at zero credit did not block"
+    release.set()                          # server answers + grants
+    t2.join(timeout=10.0)
+    assert not t2.is_alive() and sent2 == [1]
+    assert METRICS.get(STREAM_CREDIT_WAITS) > waits0
+    client.finish()
+    assert len(client.result(0)) == 1
+    assert len(client.result(1)) == 1
+    client.close()
+    t.join(timeout=10.0)
+    assert not server_err, server_err
+    srv.close()
+    os.unlink(path)
+
+
+def test_stream_credits_survive_reconnect_with_resume(tmp_path):
+    """A mid-stream connection drop (injected at the client's frame
+    receive): the client re-handshakes, re-sends unacked chunks, and
+    the credit window resumes — all verdicts land and the steady-state
+    window is restored."""
+    from cilium_tpu.runtime import faults as faults_mod
+    from cilium_tpu.runtime.faults import FaultPlan, FaultRule
+
+    service, loader, scenario = _service(tmp_path, "http", tpu=False)
+    try:
+        client = StreamClient(service.socket_path, timeout=60.0,
+                              reconnect=True, backoff_base=0.01,
+                              reconnect_seed=3)
+        window = client._credits
+        assert window and window > 0
+        plan = FaultPlan([FaultRule("stream.frame.client", after=1,
+                                    times=1, exc=ConnectionError)],
+                         seed=17)
+        with faults_mod.inject(plan):
+            seqs = [client.send_flows(scenario.flows[:32])
+                    for _ in range(6)]
+            client.finish()
+            for seq in seqs:
+                assert len(client.result(seq)) == 32
+        assert plan.counts("stream.frame.client")[1] == 1
+        with client._cond:
+            assert client._credits is not None
+            assert 0 < client._credits <= window
+        client.close()
+    finally:
+        service.stop()
