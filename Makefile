@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test shim lint determinism dryrun chaos obs soak bench \
         bench-all bench-e2e bench-service bench-regen bench-sp \
-        bench-stream bench-multichip bench-watch check
+        bench-stream bench-multichip bench-watch perf-report check
 
 test:            ## full suite (CPU, virtual 8-device mesh via conftest)
 	$(PY) -m pytest tests/ -q
@@ -91,4 +91,12 @@ bench-multichip: ## DP/DPxEP/TP scaling on the virtual 8-device mesh
 bench-watch:     ## probe until the tunnel answers, then capture the sweep
 	$(PY) bench.py --watch r04
 
-check: shim lint test determinism dryrun obs   ## the full CI gate
+# perf-report: schema-validate every BENCH_*/MULTICHIP_*/SERVICE_*
+# artifact, normalize them into the round trajectory
+# (PERF_TRAJECTORY.json — the CI artifact), classify round-over-round
+# deltas as code regression vs environment change (provenance/RTT
+# evidence), and fail on an unexplained regression in the newest round
+perf-report:     ## bench trajectory + regression gate
+	$(PY) -m cilium_tpu.perf_report --root . --out PERF_TRAJECTORY.json
+
+check: shim lint test determinism dryrun obs perf-report   ## the full CI gate

@@ -212,11 +212,19 @@ def main() -> int:
         scan_1(j["trans"], j["byteclass"], j["start"], j["accept"],
                dj, lj)), args.windows)
 
+    from cilium_tpu.parallel.collectives import LEDGER
+
     tp_mesh = make_mesh((n,), ("state",), devices)
     trans_p, accept_p = pad_states(arrs["trans"], arrs["accept"], n)
     tpj, apj = jnp.asarray(trans_p), jnp.asarray(accept_p)
+    # per-collective breakdown (perf ledger): reset → one traced call
+    # → snapshot gives op kind / count per block / bytes — the
+    # "99.99% collective overhead" number, decomposed
+    LEDGER.reset()
     jax.block_until_ready(dfa_scan_banked_tp(
         tp_mesh, tpj, j["byteclass"], j["start"], apj, dj, lj))
+    tp_collectives = LEDGER.snapshot()
+    LEDGER.publish_metrics()
     t_tp = _time_windows(lambda: jax.block_until_ready(
         dfa_scan_banked_tp(tp_mesh, tpj, j["byteclass"], j["start"],
                            apj, dj, lj)), args.windows)
@@ -229,6 +237,10 @@ def main() -> int:
         "strong_scaling_speedup": round(speedup, 3),
         "strong_scaling_efficiency": round(speedup / n, 4),
         "overhead_fraction": round(max(0.0, 1 - speedup / n), 4),
+        # the ledger's per-collective account: op kind, count per
+        # block (the scan body's psum executes once per scanned
+        # byte), bytes per call — evidence, not vibes
+        "collectives": tp_collectives,
         # TP shards the DFA state axis, which costs a collective per
         # scanned byte — it exists as the states-don't-fit fallback
         # (parallel/tp.py MAX_TP_STATES), not a throughput play; the
@@ -255,6 +267,11 @@ def main() -> int:
         "rules": args.rules,
         "points": points,
     }
+    # provenance fingerprint (perf ledger): perf-report classifies
+    # cross-round deltas off this
+    from cilium_tpu.runtime.provenance import stamp
+
+    stamp(line)
     print(json.dumps(line), flush=True)
     if args.out:
         with open(args.out, "w") as f:

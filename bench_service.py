@@ -507,6 +507,35 @@ def run_stream_point(loader, scenario, chunk_records: int,
     }
 
 
+import re as _re
+
+_TRANSIENT_RE = _re.compile(
+    r"connection|reset|refused|broken ?pipe|timed out|unavailable|"
+    r"read body|EOF", _re.I)
+
+
+def _safe_point(lane: str, fn, *a, **kw):
+    """Lane isolation (perf ledger): a sweep point that dies on a
+    transient connection error gets exactly ONE retry; a second (or
+    non-transient) failure records a structured failure point —
+    ``{lane, failed, error, attempts}`` — and the sweep continues
+    instead of losing the whole artifact."""
+    for attempt in (1, 2):
+        try:
+            return fn(*a, **kw)
+        except Exception as e:  # noqa: BLE001 — any point death must
+            # degrade to a structured record, not kill the sweep
+            err = f"{type(e).__name__}: {e}"
+            if attempt == 1 and _TRANSIENT_RE.search(err):
+                print(f"[{lane}] transient point failure, one retry: "
+                      f"{err[:200]}", file=sys.stderr)
+                continue
+            print(f"[{lane}] point failed ({attempt} attempt(s)): "
+                  f"{err[:200]}", file=sys.stderr)
+            return {"lane": lane, "failed": True, "error": err[:500],
+                    "attempts": attempt}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rules", type=int, default=1000)
@@ -605,9 +634,14 @@ def main() -> int:
         i = 0
         while i < len(rates):
             rate = rates[i]
-            pt = run_stream_point(loader, scenario, args.stream_chunk,
-                                  rate, args.stream_duration, sock_dir,
-                                  pipeline_depth=args.stream_depth)
+            pt = _safe_point(
+                "stream", run_stream_point, loader, scenario,
+                args.stream_chunk, rate, args.stream_duration,
+                sock_dir, pipeline_depth=args.stream_depth)
+            if pt.get("failed"):
+                points.append(pt)
+                i += 1
+                continue
             pt["device_rtt_ms"] = rtt
             points.append(pt)
             print(json.dumps({
@@ -625,15 +659,19 @@ def main() -> int:
             i += 1
     if args.stream_only:
         if args.out:
+            from cilium_tpu.runtime.provenance import stamp
+
             with open(args.out, "w") as f:
-                json.dump({"rules": args.rules, "points": points}, f,
-                          indent=1)
+                json.dump(stamp({"rules": args.rules,
+                                 "points": points}), f, indent=1)
         return 0
     for d in (float(x) for x in args.deadlines.split(",")):
-        pt = run_point(loader, scenario, d, args.batch_max,
-                       args.threads, args.per_thread, args.warmup,
-                       sock_dir)
+        pt = _safe_point("closed", run_point, loader, scenario, d,
+                         args.batch_max, args.threads, args.per_thread,
+                         args.warmup, sock_dir)
         points.append(pt)
+        if pt.get("failed"):
+            continue
         print(json.dumps({
             "metric": f"service_check_latency_d{d}ms_{args.rules}rules",
             "value": pt["p99_ms"], "unit": "ms p99 (client-observed)",
@@ -662,10 +700,15 @@ def main() -> int:
         i = 0
         while i < len(rates):
             rate = rates[i]
-            pt = run_open_point(loader, scenario, d, args.batch_max,
-                                rate, args.open_duration,
-                                args.open_conns, args.warmup, sock_dir,
-                                drain_workers=args.drain_workers)
+            pt = _safe_point(
+                "open_loop", run_open_point, loader, scenario, d,
+                args.batch_max, rate, args.open_duration,
+                args.open_conns, args.warmup, sock_dir,
+                drain_workers=args.drain_workers)
+            if pt.get("failed"):
+                open_points.append(pt)
+                i += 1
+                continue
             pt["lane"] = "open_loop"
             open_points.append(pt)
             print(json.dumps({
@@ -680,9 +723,12 @@ def main() -> int:
             i += 1
         points.extend(open_points)
     if args.out:
+        # provenance fingerprint + versioned schema (perf ledger)
+        from cilium_tpu.runtime.provenance import stamp
+
         with open(args.out, "w") as f:
-            json.dump({"rules": args.rules, "points": points}, f,
-                      indent=1)
+            json.dump(stamp({"rules": args.rules, "points": points}),
+                      f, indent=1)
     return 0
 
 

@@ -281,6 +281,12 @@ RULES: Dict[str, str] = {
     "unbounded-queue": "no queue.Queue() without maxsize and no "
                        "list-as-queue append without a bound/shed "
                        "path in threaded runtime modules",
+    "obs-doc-parity": "every metric family declared in "
+                      "runtime/metrics.py and every phase label "
+                      "(tracing PHASE_*, engine-probe phases, capture "
+                      "staging phases) is documented in "
+                      "docs/OBSERVABILITY.md, and the doc names no "
+                      "family that no longer exists",
     "bare-disable": "every ctlint disable comment carries a "
                     "justification",
     "parse-error": "every analyzed file parses",
@@ -327,6 +333,7 @@ def run(root: str, targets: Sequence[str] = (DEFAULT_TARGET,),
         exceptions,
         imports,
         locks,
+        obsdocs,
         purity,
         queues,
         recompile,

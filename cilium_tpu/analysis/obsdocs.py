@@ -1,0 +1,152 @@
+"""obs-doc-parity: the observability surface ⇄ docs/OBSERVABILITY.md.
+
+The perf ledger (ISSUE 6) made docs/OBSERVABILITY.md the operator's
+catalog of every metric family and every phase label the system can
+emit — and a catalog that drifts is worse than none: a dashboard built
+from stale docs reads dead series, and an undocumented phase label is
+attribution output nobody can interpret. This rule closes the drift
+both ways:
+
+* every metric family declared in ``runtime/metrics.py``
+  (``METRICS.describe``) must be mentioned in the doc;
+* every phase label value — the tracing ``PHASE_*`` constants, the
+  engine-probe ``ENGINE_PHASES`` / ``CAPTURE_PHASES`` tuples
+  (``engine/phases.py``), and every ``_StagePhase("...")`` staging
+  phase used anywhere — must be mentioned in the doc;
+* every ``cilium_tpu_*``-shaped token the doc mentions must still be a
+  declared family (stale docs teach dead series); derived histogram
+  suffixes (``_bucket``/``_count``/``_sum``) of declared families are
+  fine.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from cilium_tpu.analysis.callgraph import Project
+from cilium_tpu.analysis.core import Finding, ProjectIndex, checker
+
+RULE = "obs-doc-parity"
+
+METRICS_MODULE = "cilium_tpu.runtime.metrics"
+TRACING_MODULE = "cilium_tpu.runtime.tracing"
+PHASES_MODULE = "cilium_tpu.engine.phases"
+DOC_PATH = os.path.join("docs", "OBSERVABILITY.md")
+
+#: phase-label tuple constants whose VALUES the doc must cover
+_PHASE_TUPLES = ("ENGINE_PHASES", "CAPTURE_PHASES")
+
+_DOC_FAMILY_RE = re.compile(r"\bcilium_tpu_[a-z0-9_]*[a-z0-9]\b")
+
+
+def _declared_families(project: Project) -> Dict[str, Tuple[str, int]]:
+    mi = project.modules.get(METRICS_MODULE)
+    if mi is None:
+        return {}
+    out: Dict[str, Tuple[str, int]] = {}
+    for node in ast.walk(mi.sf.tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "describe" and node.args:
+            name = project.resolve_string(mi, node.args[0])
+            if name is not None:
+                out.setdefault(name, (mi.sf.path, node.lineno))
+    return out
+
+
+def _phase_values(project: Project) -> Dict[str, Tuple[str, int]]:
+    """Phase label value → declaring (path, line)."""
+    out: Dict[str, Tuple[str, int]] = {}
+    mi = project.modules.get(TRACING_MODULE)
+    if mi is not None:
+        for node in mi.sf.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id.startswith("PHASE_") \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                out.setdefault(node.value.value,
+                               (mi.sf.path, node.lineno))
+    pm = project.modules.get(PHASES_MODULE)
+    if pm is not None:
+        for node in pm.sf.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id in _PHASE_TUPLES \
+                    and isinstance(node.value, ast.Tuple):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) \
+                            and isinstance(elt.value, str):
+                        out.setdefault(elt.value,
+                                       (pm.sf.path, node.lineno))
+    # _StagePhase("...") call sites anywhere in the package (the
+    # capture-staging phase labels are literals at their seams)
+    for mod in project.modules.values():
+        for node in ast.walk(mod.sf.tree):
+            if isinstance(node, ast.Call) and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                fn = node.func
+                name = fn.attr if isinstance(fn, ast.Attribute) \
+                    else fn.id if isinstance(fn, ast.Name) else ""
+                if name == "_StagePhase":
+                    out.setdefault(node.args[0].value,
+                                   (mod.sf.path, node.lineno))
+    return out
+
+
+def check_obs_docs(index: ProjectIndex,
+                   doc_text: Optional[str] = None) -> List[Finding]:
+    if doc_text is None:
+        if index.root is None:
+            return []  # in-memory corpus without a doc: nothing to diff
+        path = os.path.join(index.root, DOC_PATH)
+        try:
+            with open(path, encoding="utf-8") as fp:
+                doc_text = fp.read()
+        except OSError:
+            mi = index.get(METRICS_MODULE)
+            if mi is None:
+                return []
+            return [Finding(mi.path, 1, RULE,
+                            f"{DOC_PATH} is missing — the metric/phase "
+                            f"catalog has no doc to agree with")]
+
+    project = Project(index)
+    findings: List[Finding] = []
+    families = _declared_families(project)
+    for name, (path, line) in sorted(families.items()):
+        if name not in doc_text:
+            findings.append(Finding(
+                path, line, RULE,
+                f"metric family `{name}` is not documented in "
+                f"{DOC_PATH} (add it to the family catalog)"))
+    for value, (path, line) in sorted(_phase_values(project).items()):
+        if value not in doc_text:
+            findings.append(Finding(
+                path, line, RULE,
+                f"phase label `{value}` is not documented in "
+                f"{DOC_PATH}"))
+    # stale direction: doc tokens that are no longer declared families
+    if families:
+        derived = set()
+        for name in families:
+            derived.update((name + "_bucket", name + "_count",
+                            name + "_sum"))
+        for i, line_text in enumerate(doc_text.splitlines(), 1):
+            for tok in _DOC_FAMILY_RE.findall(line_text):
+                if tok not in families and tok not in derived:
+                    findings.append(Finding(
+                        DOC_PATH, i, RULE,
+                        f"{DOC_PATH} mentions `{tok}` but "
+                        f"runtime/metrics.py declares no such family "
+                        f"— stale doc or typo"))
+    return findings
+
+
+@checker
+def check(index: ProjectIndex) -> List[Finding]:
+    return check_obs_docs(index)

@@ -624,6 +624,28 @@ def cmd_lint(args) -> int:
     return run_cli(argv)
 
 
+def cmd_perf_report(args) -> int:
+    """perf-report: normalize bench artifacts into the trajectory and
+    gate on unexplained regressions (docs/OBSERVABILITY.md)."""
+    from cilium_tpu.perf_report import run_cli
+
+    argv: List[str] = []
+    if args.root:
+        argv += ["--root", args.root]
+    if args.out:
+        argv += ["--out", args.out]
+    if args.threshold is not None:
+        argv += ["--threshold", str(args.threshold)]
+    if args.strict:
+        argv += ["--strict"]
+    if args.no_fail:
+        argv += ["--no-fail"]
+    if args.verbose:
+        argv += ["--verbose"]
+    argv += ["--format", args.format]
+    return run_cli(argv)
+
+
 def _api(args):
     from cilium_tpu.runtime.api import APIClient
 
@@ -962,6 +984,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
     p.set_defaults(fn=cmd_lint)
+
+    p = sub.add_parser("perf-report",
+                       help="bench-artifact trajectory + regression "
+                            "gate (docs/OBSERVABILITY.md)")
+    p.add_argument("--root", default=None,
+                   help="artifact directory (default: repo root)")
+    p.add_argument("--out", default=None,
+                   help="write PERF_TRAJECTORY.json here")
+    p.add_argument("--threshold", type=float, default=None,
+                   help="worse-factor-over-1 needing explanation")
+    p.add_argument("--strict", action="store_true",
+                   help="gate every round transition, not just the "
+                        "newest")
+    p.add_argument("--no-fail", action="store_true",
+                   help="report-only: always exit 0")
+    p.add_argument("--format", choices=["text", "json"],
+                   default="text")
+    p.add_argument("--verbose", action="store_true")
+    p.set_defaults(fn=cmd_perf_report)
 
     p = sub.add_parser("healthz", help="REST healthz")
     p.add_argument("--api", required=True)

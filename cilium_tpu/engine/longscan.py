@@ -135,9 +135,12 @@ def _cp_step(mesh: Mesh, seq_axis: str, block: int):
         identity = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
         perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
 
+        from cilium_tpu.parallel import collectives
+
         def ring_step(i, state):
             carry, send = state
-            recv = lax.ppermute(send, seq_axis, perm)
+            recv = collectives.ppermute(send, seq_axis, perm,
+                                        site="cp.ring_carry")
             # recv = cumulative of the sender (my left neighbor, covering
             # shards [sender-k .. sender]); fold into my carry only while
             # it still describes shards left of me: step i delivers the
@@ -148,8 +151,12 @@ def _cp_step(mesh: Mesh, seq_axis: str, block: int):
 
         carry = identity
         send = mine
-        carry, _ = lax.fori_loop(
-            0, n_dev - 1, lambda i, st: ring_step(i, st), (carry, send))
+        # the ring body traces once, executes n_dev-1 times per block
+        # (a 1-device mesh runs it zero times — factor 0 records 0)
+        with collectives.LEDGER.scaled(n_dev - 1):
+            carry, _ = lax.fori_loop(
+                0, n_dev - 1, lambda i, st: ring_step(i, st),
+                (carry, send))
         # NOTE: this fori ring passes each device's LOCAL function one
         # hop per step, so after k steps I have received the local
         # function of the device k hops left and composed it in order.
@@ -160,7 +167,8 @@ def _cp_step(mesh: Mesh, seq_axis: str, block: int):
             axis=1)[:, 0]
         # device idx holds the composition of shards [0..idx]; only the
         # last device has the whole payload — gather and keep its answer
-        all_states = lax.all_gather(states, seq_axis)   # [n_dev, B]
+        all_states = collectives.all_gather(
+            states, seq_axis, site="cp.final_gather")   # [n_dev, B]
         return all_states[n_dev - 1]
 
     from cilium_tpu.parallel.compat import shard_map

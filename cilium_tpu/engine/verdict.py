@@ -1360,7 +1360,13 @@ def _bools_to_words(bools: jax.Array, n_words: int) -> jax.Array:
     return jnp.sum(b << shifts[None, None, :], axis=2, dtype=jnp.uint32)
 
 
+import time as _time
+
 from cilium_tpu.runtime import faults as _faults
+from cilium_tpu.runtime.metrics import (
+    CAPTURE_STAGE_SECONDS as _CAPTURE_STAGE_SECONDS,
+    METRICS as _METRICS,
+)
 from cilium_tpu.runtime.tracing import (
     PHASE_DEVICE as _PH_DEVICE,
     PHASE_HOST as _PH_HOST,
@@ -1371,6 +1377,31 @@ from cilium_tpu.runtime.tracing import (
 #: never injected — it is the fallback the breaker trips TO)
 DISPATCH_POINT = _faults.register_point(
     "engine.dispatch", "device dispatch in VerdictEngine")
+
+
+class _StagePhase:
+    """Capture-staging phase timer (perf ledger): seconds into
+    ``cilium_tpu_capture_stage_seconds{phase=...}`` plus a tracer span
+    when a trace is active — benches read ``histo_sum`` deltas to put
+    a machine-readable split next to ``stage_ms``."""
+
+    __slots__ = ("phase", "_t0")
+
+    def __init__(self, phase: str):
+        self.phase = phase
+
+    def __enter__(self) -> "_StagePhase":
+        self._t0 = _time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        dur = _time.perf_counter() - self._t0
+        _METRICS.observe(_CAPTURE_STAGE_SECONDS, dur,
+                         labels={"phase": self.phase})
+        ctx = _TRACER.current()
+        if ctx is not None:
+            _TRACER.add_span(ctx, f"capture.stage.{self.phase}",
+                             _PH_HOST, _time.time() - dur, dur)
 
 
 class VerdictEngine:
@@ -1530,10 +1561,14 @@ class CaptureReplay:
     def __init__(self, engine: "VerdictEngine", l7, offsets, blob,
                  cfg: Optional[EngineConfig] = None, gen=None):
         self.engine = engine
-        self.feat = CaptureFeaturizer(l7, offsets, blob,
-                                      engine.policy.kafka_interns, cfg,
-                                      gen=gen)
-        self.table_words = stage_capture_tables(engine, self.feat)
+        # stage-phase attribution (perf ledger): each once-per-file
+        # staging step lands in cilium_tpu_capture_stage_seconds{phase}
+        # so the 12.5s stage_ms has a machine-readable split
+        with _StagePhase("tables"):
+            self.feat = CaptureFeaturizer(l7, offsets, blob,
+                                          engine.policy.kafka_interns,
+                                          cfg, gen=gen)
+            self.table_words = stage_capture_tables(engine, self.feat)
         self._step = jax.jit(verdict_step_capture)
         #: whole-capture row block ([N, 15(+gen)] int32) once
         #: :meth:`stage_rows` has run — per-chunk featurize then
@@ -1550,8 +1585,9 @@ class CaptureReplay:
         scan: per-file work paid at open, not per chunk). At TPU
         device rates the per-chunk featurize (~19M rows/s host-side)
         is otherwise the e2e ceiling."""
-        self.rows_all = self.feat.encode_rows(
-            np.asarray(rec), l7, gen_rows=self.feat.gen_rows)
+        with _StagePhase("featurize"):
+            self.rows_all = self.feat.encode_rows(
+                np.asarray(rec), l7, gen_rows=self.feat.gen_rows)
         return self.rows_all
 
     def stage_unique(self, drop_if_ratio_at_least: Optional[float]
@@ -1583,6 +1619,11 @@ class CaptureReplay:
         immediately (``row_idx`` stays None) instead of pinning ~2× the
         capture in host memory for a session that will stream rows."""
         assert self.rows_all is not None, "stage_rows first"
+        with _StagePhase("dedup"):
+            return self._stage_unique(drop_if_ratio_at_least)
+
+    def _stage_unique(self, drop_if_ratio_at_least: Optional[float]
+                      = None) -> float:
         uniq, inverse = np.unique(self.rows_all, axis=0,
                                   return_inverse=True)
         n_true = len(uniq)
@@ -1605,8 +1646,10 @@ class CaptureReplay:
     def stage_unique_device(self) -> jax.Array:
         """Push the (padded) unique-row table to the device, once."""
         if self.unique_rows is None:
-            self.unique_rows = jax.device_put(self._uniq_host,
-                                              self.engine.device)
+            with _StagePhase("table-h2d"):
+                self.unique_rows = jax.device_put(self._uniq_host,
+                                                  self.engine.device)
+                np.asarray(self.unique_rows[:2])  # completion-forced
         return self.unique_rows
 
     def verdict_idx(self, idx: np.ndarray, authed_pairs=None

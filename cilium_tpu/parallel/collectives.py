@@ -1,0 +1,166 @@
+"""Trace-time collective accounting — the multichip half of the perf
+ledger.
+
+MULTICHIP_PERF_r05's TP lane reads "99.99% collective overhead" with
+no per-collective breakdown: nothing said WHICH collective, how many
+per block, or how many bytes each moves. Timing individual collectives
+at runtime would need one dispatch per op (destroying the fused
+program being measured), so this ledger accounts at **trace time**
+instead: every collective in ``parallel/`` + ``engine/longscan.py``
+routes through a thin wrapper that records ``(site, op kind, axis,
+payload bytes)`` while jax traces the block, then emits the unchanged
+``lax`` op. A loop whose body traces once but executes N times (the
+per-byte ``lax.scan`` in tp.py, the ring ``fori_loop`` in longscan.py)
+wraps its trace in :meth:`CollectiveLedger.scaled` so recorded counts
+are **per compiled block execution**, not per trace.
+
+Semantics and caveats, explicit because this is an accounting
+instrument:
+
+* Counts are per execution of one compiled block (one shard_map call),
+  per device. They do not multiply by runtime call count — a bench
+  resets the ledger, triggers one fresh trace per lane, and snapshots.
+* Bytes come from as-traced shapes. Under ``vmap`` the traced shape
+  excludes the mapped axis, so a vmapped collective records once with
+  per-lane bytes.
+* :meth:`CollectiveLedger.record` runs under jax tracing (from
+  shard_map bodies), where the jit-purity contract forbids locks and
+  I/O — it is therefore lock-free dict arithmetic; a rare concurrent
+  trace may lose an update, which an accounting ledger tolerates.
+  :meth:`publish_metrics` (host-side only, never under trace) copies
+  deltas into the Prometheus families
+  ``cilium_tpu_collective_ops_total`` /
+  ``cilium_tpu_collective_bytes_total``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+import numpy as np
+from jax import lax
+
+from cilium_tpu.runtime.metrics import (
+    COLLECTIVE_BYTES,
+    COLLECTIVE_OPS,
+    METRICS,
+)
+
+
+class _Scaled:
+    __slots__ = ("ledger", "n")
+
+    def __init__(self, ledger: "CollectiveLedger", n: int):
+        self.ledger = ledger
+        self.n = n
+
+    def __enter__(self):
+        stack = getattr(self.ledger._scale, "stack", None)
+        if stack is None:
+            stack = self.ledger._scale.stack = []
+        stack.append(self.n)
+        return self
+
+    def __exit__(self, *exc):
+        self.ledger._scale.stack.pop()
+        return False
+
+
+class CollectiveLedger:
+    """Per-process collective account book (one instance:
+    :data:`LEDGER`, mirroring the METRICS registry discipline)."""
+
+    def __init__(self) -> None:
+        #: (site, op, axis) → [count_per_block, bytes_per_block,
+        #:                     bytes_per_call]
+        self._ops: Dict[tuple, List[float]] = {}
+        self._scale = threading.local()
+        #: what publish_metrics already pushed, per key
+        self._published: Dict[tuple, List[float]] = {}
+
+    def scaled(self, n: int) -> _Scaled:
+        """``with LEDGER.scaled(L): lax.scan(...)`` — multiply every
+        record inside by ``L`` (the loop body traces once, executes
+        ``L`` times per block)."""
+        return _Scaled(self, int(n))
+
+    def _factor(self) -> int:
+        f = 1
+        for s in getattr(self._scale, "stack", None) or ():
+            f *= s
+        return f
+
+    def record(self, site: str, op: str, axis, shape, dtype) -> None:
+        nbytes = int(np.prod(shape)) * int(np.dtype(dtype).itemsize) \
+            if shape else int(np.dtype(dtype).itemsize)
+        f = self._factor()
+        key = (site, op, str(axis))
+        cur = self._ops.get(key)
+        if cur is None:
+            self._ops[key] = [f, nbytes * f, nbytes]
+        else:
+            cur[0] += f
+            cur[1] += nbytes * f
+            cur[2] = nbytes
+
+    def snapshot(self) -> List[Dict]:
+        """Sorted per-site rows: op kind, count per block, bytes per
+        block, bytes per single op — the multichip bench's
+        per-collective breakdown."""
+        return [{"site": site, "op": op, "axis": axis,
+                 "count_per_block": int(c),
+                 "bytes_per_block": int(b),
+                 "bytes_per_call": int(per)}
+                for (site, op, axis), (c, b, per)
+                in sorted(self._ops.items())]
+
+    def reset(self) -> None:
+        self._ops = {}
+        self._published = {}
+
+    def publish_metrics(self) -> None:
+        """Push accumulated counts into the Prometheus families —
+        call from host code only (never under trace: METRICS locks).
+        Idempotent across calls: only deltas since the last publish
+        are added."""
+        for key, (c, b, _per) in list(self._ops.items()):
+            pub = self._published.setdefault(key, [0.0, 0.0])
+            dc, db = c - pub[0], b - pub[1]
+            if dc <= 0 and db <= 0:
+                continue
+            site, op, axis = key
+            labels = {"site": site, "op": op, "axis": axis}
+            if dc > 0:
+                METRICS.inc(COLLECTIVE_OPS, dc, labels=labels)
+            if db > 0:
+                METRICS.inc(COLLECTIVE_BYTES, db, labels=labels)
+            pub[0], pub[1] = c, b
+
+
+#: process-global ledger (like METRICS / TRACER)
+LEDGER = CollectiveLedger()
+
+
+# -- the wrappers: record, then emit the unchanged lax op -------------------
+
+def psum(x, axis, *, site: str):
+    LEDGER.record(site, "psum", axis, x.shape, x.dtype)
+    return lax.psum(x, axis)
+
+
+def all_gather(x, axis, *, site: str, tiled: bool = False):
+    LEDGER.record(site, "all_gather", axis, x.shape, x.dtype)
+    return lax.all_gather(x, axis, tiled=tiled)
+
+
+def all_to_all(x, axis, split_axis: int, concat_axis: int, *,
+               site: str, tiled: bool = False):
+    LEDGER.record(site, "all_to_all", axis, x.shape, x.dtype)
+    return lax.all_to_all(x, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=tiled)
+
+
+def ppermute(x, axis, perm, *, site: str):
+    LEDGER.record(site, "ppermute", axis, x.shape, x.dtype)
+    return lax.ppermute(x, axis, perm)

@@ -36,6 +36,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from cilium_tpu.parallel import collectives
 from cilium_tpu.parallel.compat import shard_map
 
 #: one-hot matmul carries state ids in f32 — exact only below 2^24
@@ -87,7 +88,9 @@ def _local_scan(trans_l, byteclass, start, accept_l, data, lengths,
                             dtype=jnp.float32)       # [B, S_loc]
         part = jnp.matmul(oh, trans_f,
                           precision=lax.Precision.HIGHEST)  # [B, K]
-        rows = lax.psum(part, state_axis)            # exact: 1 nonzero term
+        # exact: 1 nonzero term. Ledger-routed: THE collective-per-
+        # scanned-byte that makes TP a fallback lane, now on record
+        rows = collectives.psum(part, state_axis, site="tp.scan_step")
         nxt = jnp.take_along_axis(
             rows, c_t[:, None].astype(jnp.int32), axis=1
         )[:, 0].astype(jnp.int32)
@@ -95,7 +98,10 @@ def _local_scan(trans_l, byteclass, start, accept_l, data, lengths,
 
     init = jnp.full((B,), start, dtype=jnp.int32)
     ts = jnp.arange(L, dtype=jnp.int32)
-    finals, _ = lax.scan(step, init, (cls.T, ts))    # [B]
+    # the scan body traces ONCE but executes L times per block — the
+    # scaled() context makes the ledger's count per block honest
+    with collectives.LEDGER.scaled(int(L)):
+        finals, _ = lax.scan(step, init, (cls.T, ts))    # [B]
 
     # accept words, state-sharded: psum of byte-plane matmuls
     oh_f = jax.nn.one_hot(finals - offset, S_loc, dtype=jnp.float32)
@@ -104,7 +110,8 @@ def _local_scan(trans_l, byteclass, start, accept_l, data, lengths,
     for shift in (0, 8, 16, 24):
         plane = ((accept_l >> shift) & jnp.uint32(0xFF)).astype(jnp.float32)
         part = jnp.matmul(oh_f, plane, precision=lax.Precision.HIGHEST)
-        vals = lax.psum(part, state_axis).astype(jnp.uint32)
+        vals = collectives.psum(part, state_axis,
+                                site="tp.accept_plane").astype(jnp.uint32)
         out = out | (vals << shift)
     return finals, out
 

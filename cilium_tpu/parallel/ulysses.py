@@ -26,10 +26,10 @@ from __future__ import annotations
 import functools
 
 import jax
-from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from cilium_tpu.engine.dfa_kernel import dfa_scan_banked
+from cilium_tpu.parallel import collectives
 from cilium_tpu.parallel.compat import shard_map
 
 
@@ -43,13 +43,16 @@ def _ulysses_step(mesh: Mesh, axis: str):
     def local(trans_l, byteclass_l, start_l, accept_l, data_l, lengths_l):
         # gather the full (encoded, byte-compressed) flow slice set —
         # inputs are the *small* tensors; transition tables never move
-        all_data = lax.all_gather(data_l, axis, tiled=True)      # [B, L]
-        all_len = lax.all_gather(lengths_l, axis, tiled=True)    # [B]
+        all_data = collectives.all_gather(
+            data_l, axis, tiled=True, site="ulysses.gather")     # [B, L]
+        all_len = collectives.all_gather(
+            lengths_l, axis, tiled=True, site="ulysses.gather")  # [B]
         words = dfa_scan_banked(trans_l, byteclass_l, start_l, accept_l,
                                 all_data, all_len)  # [B, NB/n, W]
         # Ulysses switch: split batch, concat banks → [B/n, NB, W]
-        return lax.all_to_all(words, axis, split_axis=0, concat_axis=1,
-                              tiled=True)
+        return collectives.all_to_all(
+            words, axis, split_axis=0, concat_axis=1, tiled=True,
+            site="ulysses.switch")
 
     return shard_map(
         local, mesh=mesh,

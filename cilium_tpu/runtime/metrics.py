@@ -74,6 +74,22 @@ STREAM_CREDIT_WAITS = "cilium_tpu_stream_credit_waits_total"
 #: credit grants sent by stream servers (one per answered chunk)
 STREAM_CREDITS_GRANTED = "cilium_tpu_stream_credits_granted_total"
 
+# -- perf-ledger series (device-time attribution + collective
+# accounting: engine/phases.py, engine/verdict.py capture staging,
+# parallel/collectives.py). Named here so the probes, the benches,
+# and the obs-doc-parity lint agree on one spelling.
+#: per-phase seconds from the engine phase probe (mapstate / dfa-scan
+#: / resolve / gather / h2d / featurize / compile / execute)
+ENGINE_PHASE_SECONDS = "cilium_tpu_engine_phase_seconds"
+#: capture-replay session staging, split by phase (tables / featurize
+#: / dedup / table-h2d) — the 12.5s ``stage_ms`` decomposed
+CAPTURE_STAGE_SECONDS = "cilium_tpu_capture_stage_seconds"
+#: collective ops recorded by the trace-time ledger, by site/op/axis
+#: (counts are per compiled block execution — see parallel/collectives)
+COLLECTIVE_OPS = "cilium_tpu_collective_ops_total"
+#: bytes moved by those collectives (as-traced payload shapes)
+COLLECTIVE_BYTES = "cilium_tpu_collective_bytes_total"
+
 #: latency-shaped default boundaries (seconds; the Prometheus client
 #: defaults) — covers every ``*_seconds`` series we emit
 DEFAULT_BUCKETS: Tuple[float, ...] = (
@@ -513,6 +529,21 @@ METRICS.describe(STREAM_CREDIT_WAITS,
                  "stream-client sends that blocked at zero credit")
 METRICS.describe(STREAM_CREDITS_GRANTED,
                  "credit grants sent by stream servers")
+METRICS.describe(ENGINE_PHASE_SECONDS,
+                 "engine phase probe seconds, by phase",
+                 buckets=(1e-5, 5e-5, 1e-4, 5e-4, 0.001, 0.0025,
+                          0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                          1.0, 2.5))
+METRICS.describe(CAPTURE_STAGE_SECONDS,
+                 "capture-replay session staging seconds, by phase",
+                 buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                          2.5, 5.0, 10.0, 30.0, 60.0, 120.0))
+METRICS.describe(COLLECTIVE_OPS,
+                 "collective ops recorded at trace time, by "
+                 "site/op/axis (count per compiled block)")
+METRICS.describe(COLLECTIVE_BYTES,
+                 "collective payload bytes (as-traced shapes), by "
+                 "site/op/axis")
 
 
 class SpanStat:

@@ -1106,3 +1106,105 @@ def test_unbounded_queue_disable_pragma_honored():
         "        # ctlint: disable=unbounded-queue  # drained inline\n"
         "        self.q = queue.Queue()")
     assert _check({"pkg/pipe.py": src}, queue_rule.check) == []
+
+
+# -- obs-doc-parity ---------------------------------------------------------
+
+from cilium_tpu.analysis import obsdocs as obs_rule  # noqa: E402
+
+OBS_METRICS = '''\
+FOO = "cilium_tpu_foo_total"
+
+METRICS.describe(FOO, "foo events")
+METRICS.describe("cilium_tpu_bar_seconds", "bar latency")
+'''
+
+OBS_TRACING = '''\
+PHASE_QUEUE = "queue-wait"
+PHASE_DEVICE = "device-dispatch"
+'''
+
+OBS_PHASES = '''\
+ENGINE_PHASES = ("mapstate", "dfa-scan")
+CAPTURE_PHASES = ("gather",)
+'''
+
+OBS_SOURCES = {
+    "cilium_tpu/runtime/metrics.py": OBS_METRICS,
+    "cilium_tpu/runtime/tracing.py": OBS_TRACING,
+    "cilium_tpu/engine/phases.py": OBS_PHASES,
+}
+
+OBS_DOC_COMPLETE = (
+    "catalog: `cilium_tpu_foo_total` and `cilium_tpu_bar_seconds`.\n"
+    "phases: queue-wait, device-dispatch, mapstate, dfa-scan, "
+    "gather, tables\n")
+
+
+def test_obs_doc_parity_complete_doc_is_clean():
+    assert _check(OBS_SOURCES, obs_rule.check_obs_docs,
+                  doc_text=OBS_DOC_COMPLETE) == []
+
+
+def test_obs_doc_parity_flags_undocumented_family_and_phase():
+    doc = "only `cilium_tpu_foo_total` and queue-wait, mapstate, " \
+          "dfa-scan, gather documented"
+    findings = _check(OBS_SOURCES, obs_rule.check_obs_docs,
+                      doc_text=doc)
+    msgs = [f.message for f in findings]
+    assert any("cilium_tpu_bar_seconds" in m for m in msgs)
+    assert any("device-dispatch" in m for m in msgs)
+    # undocumented-family findings anchor at the declaration
+    fam = [f for f in findings if "bar_seconds" in f.message]
+    assert fam[0].path == "cilium_tpu/runtime/metrics.py"
+
+
+def test_obs_doc_parity_flags_stale_doc_name():
+    doc = OBS_DOC_COMPLETE + \
+        "\nand the long-gone `cilium_tpu_ghost_total` series\n"
+    findings = _check(OBS_SOURCES, obs_rule.check_obs_docs,
+                      doc_text=doc)
+    assert len(findings) == 1
+    assert "ghost" in findings[0].message
+    assert findings[0].path.endswith("OBSERVABILITY.md")
+
+
+def test_obs_doc_parity_derived_suffixes_are_fine():
+    doc = OBS_DOC_COMPLETE + \
+        "\nhistogram faces: cilium_tpu_bar_seconds_bucket and " \
+        "cilium_tpu_bar_seconds_count\n"
+    assert _check(OBS_SOURCES, obs_rule.check_obs_docs,
+                  doc_text=doc) == []
+
+
+def test_obs_doc_parity_stage_phase_literals_are_collected():
+    sources = dict(OBS_SOURCES)
+    sources["cilium_tpu/engine/verdict.py"] = (
+        "class _StagePhase:\n"
+        "    def __init__(self, phase):\n"
+        "        self.phase = phase\n\n\n"
+        "def stage():\n"
+        "    with _StagePhase(\"tables\"):\n"
+        "        pass\n")
+    doc_missing = OBS_DOC_COMPLETE.replace(", tables", "")
+    findings = _check(sources, obs_rule.check_obs_docs,
+                      doc_text=doc_missing)
+    assert any("`tables`" in f.message for f in findings)
+    assert _check(sources, obs_rule.check_obs_docs,
+                  doc_text=OBS_DOC_COMPLETE) == []
+
+
+def test_obs_doc_parity_real_tree_nonvacuous():
+    """The shipped tree: ≥60 declared families, ≥10 phase labels, and
+    the shipped doc covers them all (the rule would bite on drift)."""
+    from cilium_tpu.analysis.callgraph import Project
+
+    index, errors = ProjectIndex.from_tree(REPO_ROOT)
+    assert not errors
+    project = Project(index)
+    families = obs_rule._declared_families(project)
+    phases = obs_rule._phase_values(project)
+    assert len(families) >= 60, len(families)
+    assert len(phases) >= 10, sorted(phases)
+    assert "tables" in phases and "dfa-scan" in phases
+    assert obs_rule.check_obs_docs(index) == []

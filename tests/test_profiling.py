@@ -81,6 +81,105 @@ def test_busy_and_bad_mode_surface_cleanly(tmp_path):
         p.capture(str(tmp_path), seconds=0.1, mode="heap")
 
 
+def test_host_profile_output_format_is_collapsed_stacks(tmp_path):
+    """Every line of the artifact is `frame;frame;... count`, counts
+    sum to (samples × live threads)-ish, and two captures in the same
+    wall-clock second get distinct artifact paths."""
+    stop = threading.Event()
+
+    def fmt_marker_fn():
+        while not stop.is_set():
+            sum(range(100))
+
+    t = threading.Thread(target=fmt_marker_fn, daemon=True)
+    t.start()
+    try:
+        p = Profiler()
+        r1 = p.capture(str(tmp_path), seconds=0.3, mode="host", hz=200)
+        r2 = p.capture(str(tmp_path), seconds=0.2, mode="host", hz=200)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert r1["path"] != r2["path"]  # ns-resolution filenames
+    total = 0
+    for line in open(r1["path"]):
+        stack, count = line.rstrip("\n").rsplit(" ", 1)
+        assert stack and count.isdigit()
+        assert ";" not in count
+        total += int(count)
+    assert total >= r1["samples"]  # >= 1 thread sampled per tick
+    assert r1["distinct_stacks"] >= 1
+    assert r1["seconds"] == 0.3
+
+
+def test_service_op_profile_direct(tmp_path):
+    """The `{"op": "profile"}` service path proper (not via CLI):
+    host capture returns the artifact, busy and bad-mode degrade to
+    `{"error": ...}` responses instead of killing the session."""
+    from cilium_tpu.core.config import Config
+    from cilium_tpu.runtime.loader import Loader
+    from cilium_tpu.runtime.service import VerdictClient, VerdictService
+
+    loader = Loader(Config())
+    sock = str(tmp_path / "svc.sock")
+    service = VerdictService(loader, sock)
+    service.start()
+    try:
+        client = VerdictClient(sock)
+        resp = client.call({"op": "profile", "seconds": 0.2,
+                            "mode": "host",
+                            "out": str(tmp_path / "prof")})
+        assert resp["mode"] == "host"
+        assert os.path.exists(resp["path"])
+        assert resp["samples"] > 0
+        resp = client.call({"op": "profile", "mode": "heap"})
+        assert "error" in resp and "heap" in resp["error"]
+        client.close()
+    finally:
+        service.stop()
+
+
+def test_service_op_profile_busy_is_an_error_response(tmp_path):
+    """One capture at a time across surfaces: a second concurrent
+    `{"op": "profile"}` answers ProfileBusy as an error payload."""
+    from cilium_tpu.core.config import Config
+    from cilium_tpu.runtime.loader import Loader
+    from cilium_tpu.runtime.profiling import PROFILER
+    from cilium_tpu.runtime.service import VerdictClient, VerdictService
+
+    loader = Loader(Config())
+    sock = str(tmp_path / "svc.sock")
+    service = VerdictService(loader, sock)
+    service.start()
+    try:
+        first_resp = {}
+
+        def long_capture():
+            c = VerdictClient(sock)
+            first_resp.update(c.call(
+                {"op": "profile", "seconds": 0.8, "mode": "host",
+                 "out": str(tmp_path / "p_long")}))
+            c.close()
+
+        t = threading.Thread(target=long_capture, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5
+        while PROFILER._active is None \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert PROFILER._active == "host"
+        c2 = VerdictClient(sock)
+        resp = c2.call({"op": "profile", "seconds": 0.1,
+                        "mode": "host",
+                        "out": str(tmp_path / "p_short")})
+        c2.close()
+        assert "error" in resp and "in progress" in resp["error"]
+        t.join(timeout=10)
+        assert os.path.exists(first_resp["path"])  # winner unharmed
+    finally:
+        service.stop()
+
+
 def test_profile_over_service_socket_and_rest(tmp_path):
     """The live-process surfaces: verdict-service op + REST endpoint
     + CLI (a serving daemon is traceable on demand)."""
