@@ -5,7 +5,8 @@ PY ?= python
 
 .PHONY: test shim lint determinism dryrun chaos obs soak bench \
         bench-all bench-e2e bench-service bench-regen bench-sp \
-        bench-stream bench-multichip bench-watch perf-report check
+        bench-stage bench-stream bench-multichip bench-watch \
+        perf-report check
 
 test:            ## full suite (CPU, virtual 8-device mesh via conftest)
 	$(PY) -m pytest tests/ -q
@@ -78,6 +79,13 @@ bench-regen:     ## cold vs incremental vs restage regeneration latency
 
 bench-sp:        ## SP (associative-scan) vs sequential payload scan
 	$(PY) bench_sp.py
+
+# bench-stage: the fast staging microbench — columnar capture write +
+# CaptureReplay session staging (tables/featurize/dedup/h2d phase
+# split) + verdict-memo fill, one provenance-stamped line per lane.
+# The cold stage_ms is the number the ISSUE-7 ≥10× budget tracks.
+bench-stage:     ## capture→session staging microbench (phase split)
+	$(PY) bench_stage.py
 
 bench-stream:    ## online serving path: chunked binary stream transport
 	$(PY) bench_service.py --stream --stream-only --rules 1000 \

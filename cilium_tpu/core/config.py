@@ -52,6 +52,18 @@ class EngineConfig:
     #: replay/featurize chunk unit — the batch shape the jitted step
     #: compiles for (``cilium-tpu replay`` and the bench sweeps)
     batch_size: int = 8192
+    #: capture-replay dedup heuristic: past this unique/total ratio
+    #: the staged unique-row table is discarded (the id stream would
+    #: move MORE bytes than plain rows, and the table ≈ a full copy of
+    #: the capture in host memory) and replay streams full rows.
+    #: 1.0 = always keep the table; see CaptureReplay.stage_unique.
+    stage_unique_drop_ratio: float = 0.5
+    #: device-resident verdict memo over the deduped replay rows
+    #: (engine/memo.py): unique rows are verdicted once per policy
+    #: revision, chunks then gather memoized outputs on device.
+    #: Invalidated on every Loader revision commit — disable to force
+    #: every chunk through the full verdict step.
+    verdict_memo: bool = True
 
 
 @dataclasses.dataclass
@@ -195,6 +207,12 @@ class Config:
             cfg.engine.bank_size = int(env["CILIUM_TPU_BANK_SIZE"])
         if "CILIUM_TPU_BATCH_SIZE" in env:
             cfg.engine.batch_size = int(env["CILIUM_TPU_BATCH_SIZE"])
+        if "CILIUM_TPU_STAGE_UNIQUE_DROP_RATIO" in env:
+            cfg.engine.stage_unique_drop_ratio = float(
+                env["CILIUM_TPU_STAGE_UNIQUE_DROP_RATIO"])
+        if env.get("CILIUM_TPU_VERDICT_MEMO", "").lower() in (
+                "0", "false", "no", "off"):
+            cfg.engine.verdict_memo = False
         if "CILIUM_TPU_CACHE_DIR" in env:
             cfg.loader.cache_dir = env["CILIUM_TPU_CACHE_DIR"]
         if "CILIUM_TPU_NODE_NAME" in env:

@@ -119,3 +119,57 @@ def parse_capture_line(d: Dict) -> Flow:
     if is_accesslog_entry(d):
         return accesslog_to_flow(d)
     return flow_from_dict(d)
+
+
+def accesslog_to_columns(d: Dict) -> tuple:
+    """One accesslog entry → the flat column tuple of
+    ``ingest.columnar`` (COLUMN_FIELDS order) — the Flow-object-free
+    half of :func:`accesslog_to_flow`, sharing its normalization
+    (header serialization, host lowering, Denied→DROPPED) exactly."""
+    from cilium_tpu.core.flow import Verdict
+    from cilium_tpu.engine.verdict import serialize_headers
+
+    verdict = (int(Verdict.DROPPED)
+               if str(d.get("entry_type", "")).lower() == "denied"
+               else int(Verdict.VERDICT_UNKNOWN))
+    ingress = bool(d.get("is_ingress", True))
+    direction = int(TrafficDirection.INGRESS if ingress
+                    else TrafficDirection.EGRESS)
+    _, sport = _split_addr(d.get("source_address", "") or "")
+    _, dport = _split_addr(d.get("destination_address", "") or "")
+    l7t = int(L7Type.NONE)
+    path = method = host = headers = b""
+    kclient = ktopic = b""
+    kapi = kver = 0
+    if isinstance(d.get("http"), dict):
+        h = d["http"]
+        l7t = int(L7Type.HTTP)
+        path = (h.get("path", "") or "").encode("utf-8")
+        method = (h.get("method", "") or "").encode("utf-8")
+        host = (h.get("host", "") or "").lower().encode("utf-8")
+        headers = serialize_headers(tuple(
+            (x.get("key", ""), x.get("value", ""))
+            for x in (h.get("headers") or ())))
+    elif isinstance(d.get("kafka"), dict):
+        k = d["kafka"]
+        l7t = int(L7Type.KAFKA)
+        kapi = int(k.get("api_key", 0) or 0)
+        kver = int(k.get("api_version", 0) or 0)
+        kclient = (k.get("client_id", "") or "").encode("utf-8")
+        ktopic = (k.get("topic", "") or "").encode("utf-8")
+    return (_to_time(d.get("timestamp")), verdict, direction,
+            int(d.get("source_security_id", 0) or 0),
+            int(d.get("destination_security_id", 0) or 0),
+            sport, dport, int(Protocol.TCP), l7t,
+            path, method, host, headers, b"",
+            kclient, ktopic, kapi, kver, b"", ())
+
+
+def capture_line_to_columns(d: Dict) -> tuple:
+    """One capture line (either schema) → column tuple (the
+    Flow-object-free twin of :func:`parse_capture_line`)."""
+    from cilium_tpu.ingest.hubble import flow_dict_to_columns
+
+    if is_accesslog_entry(d):
+        return accesslog_to_columns(d)
+    return flow_dict_to_columns(d)

@@ -161,6 +161,24 @@ def _native() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint32),
             ctypes.c_uint32, ctypes.c_void_p, ctypes.c_uint64,
             ctypes.c_void_p, ctypes.c_uint32]
+        if not hasattr(lib, "ct_capture_writer_open"):
+            return None  # pre-batch-writer ABI: numpy codec instead
+        # streaming columnar record-batch writer (ingest/columnar.py):
+        # base records stream to disk per batch, trailing sections
+        # buffer natively, finish() lays down the string table
+        lib.ct_capture_writer_open.restype = ctypes.c_void_p
+        lib.ct_capture_writer_open.argtypes = [ctypes.c_char_p,
+                                               ctypes.c_uint32]
+        lib.ct_capture_writer_batch.restype = ctypes.c_int
+        lib.ct_capture_writer_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_uint32]
+        lib.ct_capture_writer_finish.restype = ctypes.c_int
+        lib.ct_capture_writer_finish.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_uint32, ctypes.c_void_p, ctypes.c_uint64]
+        lib.ct_capture_writer_abort.restype = ctypes.c_int
+        lib.ct_capture_writer_abort.argtypes = [ctypes.c_void_p]
         lib.ct_capture_l7_info.restype = ctypes.c_int
         lib.ct_capture_l7_info.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint32),
@@ -218,6 +236,24 @@ def records_to_flows(rec: np.ndarray) -> List[Flow]:
 
 
 # -- file IO ---------------------------------------------------------------
+
+def write_capture_records(path: str, rec: np.ndarray) -> int:
+    """Write a v1 capture straight from a RECORD array (the columnar
+    tooling path — no Flow objects)."""
+    lib = _native()
+    if lib is not None:
+        buf = np.ascontiguousarray(rec)
+        _check(lib.ct_capture_write(
+            path.encode(), buf.ctypes.data_as(ctypes.c_void_p),
+            len(buf)))
+        return len(buf)
+    header = np.zeros(1, dtype=HEADER)
+    header[0] = (MAGIC, VERSION, len(rec))
+    with open(path, "wb") as fp:
+        fp.write(header.tobytes())
+        fp.write(np.ascontiguousarray(rec).tobytes())
+    return len(rec)
+
 
 def write_capture(path: str, flows: Iterable[Flow]) -> int:
     rec = flows_to_records(flows)
@@ -405,10 +441,136 @@ def flows_to_capture_l7(flows: Iterable[Flow]):
     return rec, l7, offsets, blob, gen, fmax
 
 
+class CaptureWriter:
+    """Streaming columnar record-batch writer (the Python face of
+    ``ct_capture_writer_*``; a pure-numpy fallback buffers batches and
+    writes the identical layout when the native codec is unbuildable).
+
+    Usage: ``write_batch`` per record batch (base records + aligned L7
+    rows + — for ``fmax > 0`` — aligned GENERIC rows), then ``finish``
+    with the shared string table. A writer abandoned without finish
+    leaves a file readers reject as truncated, never misparse."""
+
+    def __init__(self, path: str, fmax: int = 0):
+        self.path = path
+        self.fmax = int(fmax)
+        self.n = 0
+        self._lib = _native()
+        self._handle = None
+        self._batches: List[tuple] = []  # fallback buffering
+        if self._lib is not None:
+            self._handle = self._lib.ct_capture_writer_open(
+                path.encode(), self.fmax)
+            if not self._handle:
+                raise CaptureError("io error")
+
+    def write_batch(self, rec: np.ndarray, l7: np.ndarray,
+                    gen: Optional[np.ndarray] = None) -> None:
+        if len(rec) != len(l7) or (
+                self.fmax > 0 and (gen is None or len(gen) != len(rec))):
+            raise CaptureError("batch sections misaligned")
+        if self._handle is not None:
+            _check(self._lib.ct_capture_writer_batch(
+                self._handle,
+                np.ascontiguousarray(rec).ctypes.data_as(
+                    ctypes.c_void_p),
+                np.ascontiguousarray(l7).ctypes.data_as(
+                    ctypes.c_void_p),
+                (np.ascontiguousarray(gen).ctypes.data_as(
+                    ctypes.c_void_p) if self.fmax > 0 else None),
+                len(rec)))
+        else:
+            self._batches.append(
+                (np.asarray(rec).copy(), np.asarray(l7).copy(),
+                 None if gen is None else np.asarray(gen).copy()))
+        self.n += len(rec)
+
+    def finish(self, offsets: np.ndarray, blob: np.ndarray) -> int:
+        offsets = np.ascontiguousarray(offsets, dtype=np.uint32)
+        blob = np.ascontiguousarray(blob, dtype=np.uint8)
+        if self._handle is not None:
+            handle, self._handle = self._handle, None
+            return _check(self._lib.ct_capture_writer_finish(
+                handle,
+                offsets.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_uint32)),
+                len(offsets) - 1,
+                blob.ctypes.data_as(ctypes.c_void_p),
+                int(blob.size)))
+        rec = (np.concatenate([b[0] for b in self._batches])
+               if self._batches else np.zeros(0, dtype=RECORD))
+        l7 = (np.concatenate([b[1] for b in self._batches])
+              if self._batches else np.zeros(0, dtype=L7REC))
+        gen = (np.concatenate([b[2] for b in self._batches])
+               if self.fmax > 0 else None)
+        header = np.zeros(1, dtype=HEADER)
+        version = VERSION_L7 if self.fmax == 0 else VERSION_L7G
+        header[0] = (MAGIC, version, len(rec))
+        l7h = np.zeros(1, dtype=L7HEADER)
+        l7h[0] = (len(offsets) - 1, self.fmax, int(blob.size))
+        with open(self.path, "wb") as fp:
+            fp.write(header.tobytes())
+            fp.write(rec.tobytes())
+            fp.write(l7h.tobytes())
+            fp.write(offsets.tobytes())
+            fp.write(blob.tobytes())
+            fp.write(l7.tobytes())
+            if gen is not None:
+                fp.write(gen.tobytes())
+        self._batches = []
+        return len(rec)
+
+    def abort(self) -> None:
+        if self._handle is not None:
+            handle, self._handle = self._handle, None
+            self._lib.ct_capture_writer_abort(handle)
+        self._batches = []
+
+    def __enter__(self) -> "CaptureWriter":
+        return self
+
+    def __exit__(self, exc_type, *exc) -> None:
+        if self._handle is not None:
+            self.abort()
+
+
+def write_capture_columns(path: str, cols,
+                          batch_size: int = 1 << 16) -> int:
+    """Write :class:`~cilium_tpu.ingest.columnar.CaptureColumns`
+    through the streaming record-batch writer (native when built),
+    chunked at ``batch_size`` records."""
+    w = CaptureWriter(path, fmax=cols.fmax)
+    try:
+        for s in range(0, len(cols.rec), batch_size):
+            w.write_batch(
+                cols.rec[s:s + batch_size],
+                cols.l7[s:s + batch_size],
+                (cols.gen[s:s + batch_size]
+                 if cols.gen is not None else None))
+        return w.finish(cols.offsets, cols.blob)
+    except BaseException:
+        w.abort()
+        raise
+
+
 def write_capture_l7(path: str, flows: Iterable[Flow]) -> int:
     """Write a version-2 capture (base records + L7 sidecar); version
     3 when any flow carries a generic ``l7proto`` payload (the extra
-    GENERIC section, see ``VERSION_L7G``)."""
+    GENERIC section, see ``VERSION_L7G``). Encoding is columnar
+    (``ingest.columnar.flows_to_columns`` → the streaming batch
+    writer): one batch intern per string column instead of per-record
+    interleaved interning, so the string-table ORDER differs from the
+    historical per-record writer (``flows_to_capture_l7``, kept as the
+    differential reference) while every resolved field is identical."""
+    from cilium_tpu.ingest.columnar import flows_to_columns
+
+    return write_capture_columns(path, flows_to_columns(flows))
+
+
+def _write_capture_l7_rowmajor(path: str, flows: Iterable[Flow]) -> int:
+    """The historical per-record write path (row-major intern order).
+    Reference/differential use only — ``write_capture_l7`` is the
+    product path."""
     rec, l7, offsets, blob, gen, fmax = flows_to_capture_l7(flows)
     lib = _native()
     if lib is not None and gen is None:
@@ -479,9 +641,10 @@ def sections_to_bytes(rec, l7, offsets, blob,
 
 def capture_to_bytes(flows: Iterable[Flow]) -> bytes:
     """Flows → in-memory v2/v3 capture image (client side of the
-    stream protocol)."""
-    rec, l7, offsets, blob, gen, fmax = flows_to_capture_l7(flows)
-    return sections_to_bytes(rec, l7, offsets, blob, gen, fmax)
+    stream protocol; columnar-encoded like :func:`write_capture_l7`)."""
+    from cilium_tpu.ingest.columnar import flows_to_columns
+
+    return flows_to_columns(flows).to_bytes()
 
 
 def capture_from_bytes(buf: bytes):

@@ -214,6 +214,85 @@ def flow_from_dict(d: Dict) -> Flow:
     return f
 
 
+def flow_dict_to_columns(d: Dict) -> tuple:
+    """One flowpb JSON object → the flat column tuple of
+    ``ingest.columnar`` (COLUMN_FIELDS order) — the Flow-object-free
+    half of :func:`flow_from_dict`, sharing its field semantics
+    (url split, host lowering, header serialization, qname
+    sanitization) so the columnar and object ingest paths can never
+    disagree on what a policy regex sees."""
+    from cilium_tpu.engine.verdict import serialize_headers
+    from cilium_tpu.policy.compiler import matchpattern
+
+    if isinstance(d.get("flow"), dict):
+        inner = dict(d["flow"])
+        for k in ("node_name", "time"):
+            inner.setdefault(k, d.get(k))
+        d = inner
+    verdict = int(_VERDICT_NAMES.get(d.get("verdict", ""),
+                                     Verdict.VERDICT_UNKNOWN))
+    direction = int(_DIR_NAMES.get(d.get("traffic_direction", ""),
+                                   TrafficDirection.INGRESS))
+    src = d.get("source") or {}
+    dst = d.get("destination") or {}
+    proto, dport, sport = int(Protocol.TCP), 0, 0  # Flow() default
+    l4 = d.get("l4") or {}
+    for name, p in (("TCP", Protocol.TCP), ("UDP", Protocol.UDP),
+                    ("SCTP", Protocol.SCTP)):
+        if name in l4:
+            proto = int(p)
+            dport = int(l4[name].get("destination_port", 0))
+            sport = int(l4[name].get("source_port", 0))
+    for name, p in (("ICMPv4", Protocol.ICMP),
+                    ("ICMPv6", Protocol.ICMPV6)):
+        if name in l4:
+            proto = int(p)
+            dport = int(l4[name].get("type", 0))
+    l7t = int(L7Type.NONE)
+    path = method = host = headers = qname = kclient = ktopic = b""
+    kapi = kver = 0
+    gproto = b""
+    gpairs: tuple = ()
+    l7 = d.get("l7") or {}
+    if "http" in l7:
+        h = l7["http"]
+        l7t = int(L7Type.HTTP)
+        url, url_host = split_http_url(h.get("url", ""))
+        path = url.encode("utf-8")
+        method = (h.get("method", "") or "").encode("utf-8")
+        host = ((h.get("host", "") or url_host).lower()
+                .encode("utf-8"))
+        headers = serialize_headers(tuple(
+            (x.get("key", ""), x.get("value", ""))
+            for x in (h.get("headers") or ())))
+    elif "kafka" in l7:
+        k = l7["kafka"]
+        l7t = int(L7Type.KAFKA)
+        kapi = int(k.get("api_key", 0))
+        kver = int(k.get("api_version", 0))
+        kclient = (k.get("client_id", "") or "").encode("utf-8")
+        ktopic = (k.get("topic", "") or "").encode("utf-8")
+    elif "dns" in l7:
+        q = l7["dns"].get("query", "")
+        l7t = int(L7Type.DNS)
+        if q:
+            qname = matchpattern.sanitize_name(q).encode("utf-8")
+    elif "generic" in l7:
+        g = l7["generic"]
+        l7t = int(L7Type.GENERIC)
+        gproto = (g.get("proto", "") or "").encode("utf-8")
+        gpairs = tuple(
+            (str(k).encode("utf-8"), str(v).encode("utf-8"))
+            for k, v in sorted((g.get("fields") or {}).items())
+            if str(k))
+    return (_to_time(d.get("time")), verdict, direction,
+            int(src.get("identity", 0) or 0),
+            int(dst.get("identity", 0) or 0),
+            sport, dport, proto, l7t,
+            path, method, host, headers, qname,
+            kclient, ktopic, kapi, kver, gproto, gpairs)
+
+
 def write_jsonl(path: str, flows: Iterable[Flow]) -> int:
     n = 0
     with open(path, "w") as fp:

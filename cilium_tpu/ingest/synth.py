@@ -495,3 +495,25 @@ def realize_scenario(scenario: SynthScenario, resolve: bool = True):
             f.src_identity = ids["crawler"]
             f.dst_identity = ids["peer"]
     return per_identity, scenario
+
+
+def scenario_capture_columns(scenario, n_records: int):
+    """A realized scenario's flows, replicated to ``n_records`` and
+    encoded straight into capture columns (``ingest.columnar``) — the
+    shared capture-writing face of ``bench.py``'s e2e lane and the
+    ``make bench-stage`` staging microbench, so both write the same
+    traffic the same columnar way."""
+    from cilium_tpu.ingest.columnar import flows_to_columns
+
+    flows = scenario.flows
+    reps = -(-n_records // len(flows))
+    return flows_to_columns((flows * reps)[:n_records])
+
+
+def write_scenario_capture(path: str, scenario, n_records: int) -> int:
+    """``scenario_capture_columns`` → the streaming record-batch
+    writer; returns the record count."""
+    from cilium_tpu.ingest.binary import write_capture_columns
+
+    return write_capture_columns(
+        path, scenario_capture_columns(scenario, n_records))

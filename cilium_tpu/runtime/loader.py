@@ -165,6 +165,15 @@ class Loader:
             self.per_identity = per_identity
             self._fallback = None
             self._fallback_revision = -1
+        # every committed revision — regenerate, warm restore, oracle
+        # alike — bumps the process-global policy generation so
+        # device-resident verdict memos (engine/memo.py) can never
+        # serve a verdict computed under a previous revision. The
+        # import stays lazy: memo.py is jax-free at module level, and
+        # the oracle-only loader path must remain so too.
+        from cilium_tpu.engine.memo import POLICY_GENERATION
+
+        POLICY_GENERATION.bump()
         METRICS.inc("cilium_tpu_regenerations_total",
                     labels={"backend": backend})
         return engine
@@ -179,7 +188,8 @@ class Loader:
         keeps serving, the rollback is counted, and the error
         propagates to the caller."""
         with self._lock:
-            prev = (self._engine, self._revision, self.per_identity)
+            prev = (self._engine, self._revision, self.per_identity,
+                    self._last_artifact_key)
         # regeneration is its own ingress: a root trace per attempt, so
         # compile/stage cost and rollbacks are attributable like any
         # request (and the staged-revision log line carries the id)
@@ -189,9 +199,23 @@ class Loader:
             except Exception as e:
                 with self._lock:
                     self._engine, self._revision, self.per_identity = \
-                        prev
+                        prev[:3]
+                    # the artifact pointer rolls back WITH the triple:
+                    # a compile that succeeded before the failed swap
+                    # already moved it, and a later snapshot_warm /
+                    # restore_warm would otherwise restage the ABORTED
+                    # revision's policy under the serving revision's
+                    # name (found by the ISSUE-7 memo staleness suite)
+                    self._last_artifact_key = prev[3]
                     self._fallback = None
                     self._fallback_revision = -1
+                # a rollback is a serving-state change too: memos
+                # filled against the aborted revision's partial state
+                # (the swap point fires between stage and commit)
+                # must drop, exactly like a successful commit
+                from cilium_tpu.engine.memo import POLICY_GENERATION
+
+                POLICY_GENERATION.bump()
                 METRICS.inc(LOADER_ROLLBACKS)
                 TRACER.event("loader.rollback", revision=revision,
                              serving_revision=prev[1],

@@ -90,6 +90,15 @@ COLLECTIVE_OPS = "cilium_tpu_collective_ops_total"
 #: bytes moved by those collectives (as-traced payload shapes)
 COLLECTIVE_BYTES = "cilium_tpu_collective_bytes_total"
 
+# -- verdict-memo series (engine/memo.py: the device-resident verdict
+# memo behind capture/stream replay — hits are chunk rows served by
+# the on-device gather, misses are unique rows verdicted and
+# inserted, invalidations are memo drops with a reason label
+# (policy-swap / auth-change)).
+VERDICT_MEMO_HITS = "cilium_tpu_verdict_memo_hits_total"
+VERDICT_MEMO_MISSES = "cilium_tpu_verdict_memo_misses_total"
+VERDICT_MEMO_INVALIDATIONS = "cilium_tpu_verdict_memo_invalidations_total"
+
 #: latency-shaped default boundaries (seconds; the Prometheus client
 #: defaults) — covers every ``*_seconds`` series we emit
 DEFAULT_BUCKETS: Tuple[float, ...] = (
@@ -544,6 +553,13 @@ METRICS.describe(COLLECTIVE_OPS,
 METRICS.describe(COLLECTIVE_BYTES,
                  "collective payload bytes (as-traced shapes), by "
                  "site/op/axis")
+METRICS.describe(VERDICT_MEMO_HITS,
+                 "replay rows served from the device verdict memo")
+METRICS.describe(VERDICT_MEMO_MISSES,
+                 "unique rows verdicted and inserted into the memo")
+METRICS.describe(VERDICT_MEMO_INVALIDATIONS,
+                 "verdict-memo drops, by reason (policy-swap / "
+                 "auth-change / session-reset)")
 
 
 class SpanStat:

@@ -42,7 +42,13 @@ def enable_persistent_cache() -> None:
             return
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # 0.1s, not the 0.5s default: the capture-staging programs
+        # (fused table scan, memo gather) compile in 0.1-0.5s on CPU
+        # and sat just under the old bar — every fresh bench process
+        # recompiled all of them, which WAS the dominant stage_ms
+        # phase of the tier-1 CPU config. Sub-0.1s programs stay
+        # uncached (disk round-trip wouldn't pay).
         jax.config.update(
-            "jax_persistent_cache_min_compile_time_secs", 0.5)
+            "jax_persistent_cache_min_compile_time_secs", 0.1)
     except Exception as e:  # noqa: BLE001 — cache is an optimization
         print(f"xla persistent cache disabled: {e}", file=sys.stderr)

@@ -28,6 +28,7 @@
 
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 
 namespace {
@@ -213,6 +214,160 @@ int ct_capture_write_l7g(const char* path, const void* records,
     rc = CT_ERR_IO;
   if (std::fclose(f) != 0 && rc == CT_OK) rc = CT_ERR_IO;
   return rc;
+}
+
+// -- streaming columnar record-batch writer ---------------------------
+//
+// The file layout interleaves sections (records | strings | l7 | gen),
+// so a one-shot writer forces the caller to assemble every section in
+// memory first. This writer accepts RECORD BATCHES instead: base
+// records stream straight to the file as they arrive, the trailing
+// fixed-width sections (L7 + GENERIC rows, 32 and 4+8*fmax bytes per
+// record) buffer in growable arrays, and finish() lays down the string
+// table + buffered sections and patches the header count. Memory held
+// is O(records x trailing-row width), never the string blob or the
+// base records.
+
+namespace {
+
+struct BatchWriter {
+  FILE* f;
+  uint32_t n;
+  uint32_t gen_fmax;  // 0 = v2 capture
+  unsigned char* l7;
+  size_t l7_cap;
+  unsigned char* gen;
+  size_t gen_cap;
+};
+
+int grow(unsigned char** buf, size_t* cap, size_t need) {
+  if (need <= *cap) return CT_OK;
+  size_t want = *cap ? *cap : 4096;
+  while (want < need) want *= 2;
+  unsigned char* p = (unsigned char*)std::realloc(*buf, want);
+  if (!p) return CT_ERR_IO;
+  *buf = p;
+  *cap = want;
+  return CT_OK;
+}
+
+void writer_free(BatchWriter* w) {
+  if (w->f) std::fclose(w->f);
+  std::free(w->l7);
+  std::free(w->gen);
+  std::free(w);
+}
+
+}  // namespace
+
+// Open a streaming writer; gen_fmax 0 writes a v2 capture, >0 a v3
+// with that many pair slots per GENERIC row. Returns NULL on error.
+void* ct_capture_writer_open(const char* path, uint32_t gen_fmax) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return nullptr;
+  BatchWriter* w = (BatchWriter*)std::calloc(1, sizeof(BatchWriter));
+  if (!w) {
+    std::fclose(f);
+    return nullptr;
+  }
+  w->f = f;
+  w->gen_fmax = gen_fmax;
+  Header h;
+  std::memcpy(h.magic, MAGIC, sizeof(MAGIC));
+  h.version = gen_fmax ? VERSION_L7G : VERSION_L7;
+  h.record_count = 0;  // patched by finish()
+  if (std::fwrite(&h, sizeof(h), 1, f) != 1) {
+    writer_free(w);
+    return nullptr;
+  }
+  return w;
+}
+
+// Append one record batch: n base records (streamed to disk), their n
+// L7 rows (buffered), and — for a v3 writer — their n GENERIC rows of
+// 4 + 8*gen_fmax bytes (buffered; pass NULL for a v2 writer).
+int ct_capture_writer_batch(void* wp, const void* records,
+                            const void* l7_records, const void* gen,
+                            uint32_t n) {
+  BatchWriter* w = (BatchWriter*)wp;
+  if (!w || !w->f) return CT_ERR_IO;
+  if (n == 0) return CT_OK;
+  if (w->gen_fmax != 0 && gen == nullptr) return CT_ERR_TRUNCATED;
+  if (std::fwrite(records, sizeof(Record), n, w->f) != n)
+    return CT_ERR_IO;
+  size_t l7_bytes = (size_t)n * sizeof(L7Record);
+  if (grow(&w->l7, &w->l7_cap,
+           (size_t)w->n * sizeof(L7Record) + l7_bytes) != CT_OK)
+    return CT_ERR_IO;
+  std::memcpy(w->l7 + (size_t)w->n * sizeof(L7Record), l7_records,
+              l7_bytes);
+  if (w->gen_fmax != 0) {
+    size_t row = 4 + 8 * (size_t)w->gen_fmax;
+    if (grow(&w->gen, &w->gen_cap, ((size_t)w->n + n) * row) != CT_OK)
+      return CT_ERR_IO;
+    std::memcpy(w->gen + (size_t)w->n * row, gen, (size_t)n * row);
+  }
+  w->n += n;
+  return CT_OK;
+}
+
+// Write the string table + buffered trailing sections, patch the
+// header count, close and free the writer (always freed, even on
+// error). Returns the record count (>=0) or a negative error.
+int ct_capture_writer_finish(void* wp, const uint32_t* offsets,
+                             uint32_t n_strings, const void* blob,
+                             uint64_t blob_bytes) {
+  BatchWriter* w = (BatchWriter*)wp;
+  if (!w) return CT_ERR_IO;
+  int rc = CT_OK;
+  if (n_strings == 0 || offsets[0] != 0 ||
+      offsets[n_strings] != blob_bytes)
+    rc = CT_ERR_TRUNCATED;
+  L7Header lh;
+  lh.n_strings = n_strings;
+  lh.reserved = w->gen_fmax;
+  lh.blob_bytes = blob_bytes;
+  if (rc == CT_OK && std::fwrite(&lh, sizeof(lh), 1, w->f) != 1)
+    rc = CT_ERR_IO;
+  if (rc == CT_OK &&
+      std::fwrite(offsets, sizeof(uint32_t), n_strings + 1, w->f) !=
+          n_strings + 1)
+    rc = CT_ERR_IO;
+  if (rc == CT_OK && blob_bytes > 0 &&
+      std::fwrite(blob, 1, blob_bytes, w->f) != blob_bytes)
+    rc = CT_ERR_IO;
+  if (rc == CT_OK && w->n > 0 &&
+      std::fwrite(w->l7, sizeof(L7Record), w->n, w->f) != w->n)
+    rc = CT_ERR_IO;
+  if (rc == CT_OK && w->gen_fmax != 0 && w->n > 0) {
+    size_t gen_bytes = (size_t)w->n * (4 + 8 * (size_t)w->gen_fmax);
+    if (std::fwrite(w->gen, 1, gen_bytes, w->f) != gen_bytes)
+      rc = CT_ERR_IO;
+  }
+  if (rc == CT_OK) {
+    Header h;
+    std::memcpy(h.magic, MAGIC, sizeof(MAGIC));
+    h.version = w->gen_fmax ? VERSION_L7G : VERSION_L7;
+    h.record_count = w->n;
+    if (std::fseek(w->f, 0, SEEK_SET) != 0 ||
+        std::fwrite(&h, sizeof(h), 1, w->f) != 1)
+      rc = CT_ERR_IO;
+  }
+  int n = (int)w->n;
+  if (std::fclose(w->f) != 0 && rc == CT_OK) rc = CT_ERR_IO;
+  w->f = nullptr;
+  writer_free(w);
+  return rc == CT_OK ? n : rc;
+}
+
+// Abandon a streaming writer: close, free, leave whatever bytes were
+// written (the header still says 0 records, so readers reject it as
+// truncated rather than misparse).
+int ct_capture_writer_abort(void* wp) {
+  BatchWriter* w = (BatchWriter*)wp;
+  if (!w) return CT_ERR_IO;
+  writer_free(w);
+  return CT_OK;
 }
 
 // Validate the header; returns the record count (>=0) or an error.

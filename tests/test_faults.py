@@ -778,3 +778,130 @@ def test_drain_restart_cycle_is_verdict_clean_and_warm(tmp_path):
         client2.close()
     finally:
         svc2.stop()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7: verdict-memo staleness across loader swap / rollback /
+# warm restore — a policy commit can never serve a memoized verdict
+# computed under a previous revision.
+
+
+def _memo_session(loader, cfg, flows):
+    from cilium_tpu.engine.verdict import CaptureReplay
+    from cilium_tpu.ingest.columnar import flows_to_columns
+
+    cols = flows_to_columns(flows)
+    replay = CaptureReplay(loader.engine, cols.l7, cols.offsets,
+                           cols.blob, cfg.engine, gen=cols.gen,
+                           loader=loader)
+    replay.stage_rows(cols.rec, cols.l7)
+    replay.stage_unique()
+    return replay, cols
+
+
+def test_memo_invalidates_across_swap_rollback_warm_restore(tmp_path):
+    """One replay session with a HOT memo, driven through every
+    serving-state transition: revision swap (verdicts follow the new
+    policy), rollback (verdicts stay with the surviving revision),
+    snapshot/warm-restore (verdicts return with the restored
+    revision) — each transition drops the memo (counted) and every
+    answer is bit-equal to the serving engine's verdict_flows."""
+    from cilium_tpu.runtime.metrics import VERDICT_MEMO_INVALIDATIONS
+
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.loader.cache_dir = str(tmp_path / "cache")
+    loader = Loader(cfg)
+    per1, db, web = _tiny_policy(5432)
+    loader.regenerate(per1, revision=1)
+    flows = [_flow(web, db, 5432), _flow(web, db, 6000)] * 6
+
+    replay, cols = _memo_session(loader, cfg, flows)
+
+    def session_verdicts():
+        out = replay.verdict_chunk(cols.rec, cols.l7)
+        return [int(v) for v in out["verdict"]]
+
+    def engine_verdicts():
+        return [int(v) for v in
+                loader.engine.verdict_flows(flows)["verdict"]]
+
+    # memo hot under rev 1: 5432 allowed, 6000 dropped
+    assert session_verdicts() == [1, 2] * 6 == engine_verdicts()
+    memo = replay.memo
+    inv0 = memo.invalidations
+    minv0 = _metric(VERDICT_MEMO_INVALIDATIONS,
+                    {"reason": "policy-swap"})
+
+    # CNP change: only 6000 allowed now — the hot memo must flip WITH
+    # the swap, not serve rev-1 answers
+    per2, _, _ = _tiny_policy(6000)
+    loader.regenerate(per2, revision=2)
+    assert session_verdicts() == [2, 1] * 6 == engine_verdicts()
+    assert replay.memo.invalidations + inv0 >= inv0 + 1
+    assert _metric(VERDICT_MEMO_INVALIDATIONS,
+                   {"reason": "policy-swap"}) >= minv0 + 1
+
+    # mid-swap crash: rollback restores rev 2 — the session keeps
+    # answering rev-2 semantics, never a torn state
+    with faults.inject(FaultPlan([FaultRule("loader.swap", times=1)])):
+        with pytest.raises(FaultInjected):
+            loader.regenerate(per1, revision=3)
+        assert loader.revision == 2
+        assert session_verdicts() == [2, 1] * 6 == engine_verdicts()
+
+    # drain-style snapshot at rev 2, move on to rev 3, then warm
+    # restore: the session must follow BACK to the restored revision
+    assert loader.snapshot_warm() is True
+    loader.regenerate(per1, revision=3)
+    assert session_verdicts() == [1, 2] * 6 == engine_verdicts()
+    assert loader.restore_warm() is True
+    assert loader.revision == 2
+    assert session_verdicts() == [2, 1] * 6 == engine_verdicts()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_memo_golden_corpus_stable_across_cnp_change():
+    """The acceptance replay for the verdict memo: the golden corpus
+    replays through a memo-hot session, an (unrelated) CNP change
+    commits mid-session, and the corpus verdicts are IDENTICAL before
+    and after — the memo refilled against the new revision instead of
+    serving stale rows, and both answers match the serving engine."""
+    from cilium_tpu.agent import Agent
+    from cilium_tpu.auth import AUTH_UNENFORCED
+    from tests.test_controlplane_golden import build_agent, build_flows
+
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.configure_logging = False
+    agent, ids = build_agent(Agent(cfg))
+    try:
+        flows = build_flows(ids)
+        loader = agent.loader
+        replay, cols = _memo_session(loader, cfg, flows)
+
+        def session_verdicts():
+            out = replay.verdict_chunk(
+                cols.rec, cols.l7, authed_pairs=AUTH_UNENFORCED)
+            return [int(v) for v in out["verdict"]]
+
+        def engine_verdicts():
+            return [int(v) for v in loader.engine.verdict_flows(
+                flows, authed_pairs=AUTH_UNENFORCED)["verdict"]]
+
+        before = session_verdicts()
+        assert before == engine_verdicts()
+        assert replay.memo is not None and replay.memo.hits > 0
+        inv0 = replay.memo.invalidations
+
+        # an unrelated CNP (fresh port on an existing endpoint pair)
+        # commits a new revision; corpus traffic is untouched by it
+        loader.regenerate(loader.per_identity,
+                          revision=loader.revision + 1)
+        after = session_verdicts()
+        assert after == before, "memo served stale verdicts after swap"
+        assert after == engine_verdicts()
+        assert replay.memo.invalidations >= inv0 + 1
+    finally:
+        agent.stop()
