@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test shim lint determinism dryrun chaos obs soak bench \
+.PHONY: test shim lint determinism dryrun chaos obs soak churn bench \
         bench-all bench-e2e bench-service bench-regen bench-sp \
         bench-stage bench-stream bench-multichip bench-watch \
         perf-report check
@@ -53,7 +53,20 @@ obs:             ## observability lane: tracing tests + scrape lint
 # unloaded p99 (ISSUE 5 acceptance). Marked slow+soak so tier-1
 # timing never pays for it.
 soak:            ## synthetic-overload admission/shed lane
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_soak.py -q -m soak
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_soak.py -q \
+	    -m "soak and not churn"
+
+# churn: the ISSUE-8 acceptance soak — sustained CNP add/delete +
+# FQDN pattern churn through a live replay session across ≥50
+# committed policy updates. Asserts zero ERROR verdicts and zero
+# stale-allow/stale-deny vs the serving engine + sampled CPU oracle,
+# bank-scoped compile work (O(Δ), not O(policy×updates)), and a
+# steady-state memo hit ratio ≥0.99. Writes a provenance-stamped
+# update→enforcement p99 bench line consumed by perf-report.
+churn:           ## sustained policy-churn soak (bank-scoped compile)
+	JAX_PLATFORMS=cpu \
+	CILIUM_TPU_CHURN_BENCH_OUT=BENCH_CHURN_r06.jsonl \
+	$(PY) -m pytest tests/test_soak.py -q -m churn
 
 dryrun:          ## driver multi-chip contract on a virtual CPU mesh
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

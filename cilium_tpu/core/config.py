@@ -77,6 +77,16 @@ class LoaderConfig:
     #: been loaded yet — the restarted service answers its first
     #: request verdict-identically without recompilation
     warm_restore: bool = False
+    #: content-addressed automaton banks (policy/compiler/bankplan.py):
+    #: CNP/FQDN churn recompiles only the banks whose pattern
+    #: membership changed, a per-bank compile failure quarantines only
+    #: that bank (old cover keeps serving), and committed revisions
+    #: carry bank-scoped memo invalidation instead of a global drop.
+    #: Off = the pre-bank positional grouping + full-drop epochs.
+    bank_isolation: bool = True
+    #: how long a quarantined bank serves its stale cover before the
+    #: next regeneration retries its compile
+    bank_quarantine_ttl_s: float = 30.0
 
 
 @dataclasses.dataclass
@@ -215,6 +225,12 @@ class Config:
             cfg.engine.verdict_memo = False
         if "CILIUM_TPU_CACHE_DIR" in env:
             cfg.loader.cache_dir = env["CILIUM_TPU_CACHE_DIR"]
+        if env.get("CILIUM_TPU_BANK_ISOLATION", "").lower() in (
+                "0", "false", "no", "off"):
+            cfg.loader.bank_isolation = False
+        if "CILIUM_TPU_BANK_QUARANTINE_TTL_S" in env:
+            cfg.loader.bank_quarantine_ttl_s = float(
+                env["CILIUM_TPU_BANK_QUARANTINE_TTL_S"])
         if "CILIUM_TPU_NODE_NAME" in env:
             cfg.node_name = env["CILIUM_TPU_NODE_NAME"]
         if "CILIUM_TPU_IPAM_MODE" in env:

@@ -27,6 +27,8 @@ jax-free.
 
 from __future__ import annotations
 
+import collections
+import dataclasses
 import functools
 import hashlib
 import threading
@@ -42,26 +44,127 @@ from cilium_tpu.runtime.metrics import (
 )
 
 
+@dataclasses.dataclass(frozen=True)
+class PolicyDelta:
+    """What one committed revision actually changed — the bank-scoped
+    half of the staleness contract. ``full=True`` (the conservative
+    default: rollbacks, gate flips, audit/secret/engine-config
+    changes, quarantined builds) means "assume everything moved";
+    otherwise only rows whose enforcement identity is in
+    ``changed_identities`` can verdict differently (every rule change
+    alters its identities' MapState fingerprints, so identity
+    granularity subsumes rule/bank granularity for memo OUTPUTS), and
+    ``changed_banks`` names the hot-swapped content-addressed bank
+    keys for observability and the per-bank epoch map."""
+
+    full: bool = True
+    reason: str = "policy-swap"
+    changed_identities: frozenset = frozenset()
+    changed_banks: frozenset = frozenset()
+
+    @classmethod
+    def none(cls) -> "PolicyDelta":
+        """A commit that changed nothing semantic (same artifact key:
+        a no-op regenerate, a warm restore of the serving policy) —
+        consumers keep memos, buffers, and staged tables."""
+        return cls(full=False, reason="no-change")
+
+    @classmethod
+    def banks(cls, identities, banks,
+              reason: str = "bank-swap") -> "PolicyDelta":
+        return cls(full=False, reason=reason,
+                   changed_identities=frozenset(identities),
+                   changed_banks=frozenset(banks))
+
+    @property
+    def is_noop(self) -> bool:
+        return (not self.full and not self.changed_identities
+                and not self.changed_banks)
+
+    def merge(self, other: "PolicyDelta") -> "PolicyDelta":
+        if self.full or other.full:
+            return PolicyDelta(full=True)
+        if other.is_noop:
+            return self
+        if self.is_noop:
+            return other
+        return PolicyDelta(
+            full=False, reason=other.reason,
+            changed_identities=(self.changed_identities
+                                | other.changed_identities),
+            changed_banks=self.changed_banks | other.changed_banks)
+
+
+#: committed-revision deltas retained for lagging consumers; a session
+#: further behind than this reads a conservative FULL delta
+_DELTA_RING = 64
+
+
 class _PolicyGeneration:
     """Process-global epoch of committed policy revisions. Monotone;
     bumped by ``Loader._commit`` (every backend: tpu / oracle / warm)
     AND by a rollback's restore — a reverted swap is still a serving-
-    state change a memo must not read through."""
+    state change a memo must not read through.
 
-    __slots__ = ("_lock", "_value")
+    Each bump carries a :class:`PolicyDelta` (default: full). A
+    bounded ring of recent deltas lets a consumer at epoch g ask
+    "what changed since g?" and invalidate only the rows a bank-scoped
+    commit touched; per-bank epochs record the generation at which a
+    content-addressed bank key last entered/left the serving plan."""
+
+    __slots__ = ("_lock", "_value", "_ring", "_bank_epochs",
+                 "_last_full")
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._value = 0
+        self._ring: collections.deque = collections.deque(
+            maxlen=_DELTA_RING)
+        self._bank_epochs: Dict[str, int] = {}
+        self._last_full = 0
 
-    def bump(self) -> int:
+    def bump(self, delta: Optional[PolicyDelta] = None) -> int:
         with self._lock:
             self._value += 1
+            d = delta if delta is not None else PolicyDelta(full=True)
+            self._ring.append((self._value, d))
+            if d.full:
+                self._last_full = self._value
+            for k in d.changed_banks:
+                self._bank_epochs[k] = self._value
+            # the epoch map tracks retired keys too; keep it bounded
+            if len(self._bank_epochs) > 65536:
+                cut = sorted(self._bank_epochs.values())[
+                    len(self._bank_epochs) // 2]
+                self._bank_epochs = {
+                    k: v for k, v in self._bank_epochs.items()
+                    if v >= cut}
             return self._value
 
     @property
     def value(self) -> int:
         return self._value
+
+    def bank_epoch(self, key: str) -> int:
+        """Generation at which bank ``key`` last changed (0 = never
+        seen). A full commit moves EVERY bank's effective epoch."""
+        with self._lock:
+            return max(self._bank_epochs.get(key, 0), self._last_full)
+
+    def deltas_since(self, gen: int) -> PolicyDelta:
+        """Merged delta of every commit after epoch ``gen``. Returns
+        a no-op delta when ``gen`` is current, and a conservative FULL
+        delta when the ring no longer covers the gap."""
+        with self._lock:
+            if gen >= self._value:
+                return PolicyDelta.none()
+            if not self._ring or self._ring[0][0] > gen + 1:
+                return PolicyDelta(full=True)
+            merged = PolicyDelta.none()
+            for v, d in self._ring:
+                if v > gen:
+                    merged = merged.merge(d)
+            return merged
 
 
 POLICY_GENERATION = _PolicyGeneration()
@@ -153,6 +256,22 @@ def _update_step():
     return update
 
 
+@functools.lru_cache(maxsize=1)
+def _scatter_step():
+    """Jitted scattered refill: rewrite the memo rows a bank-scoped
+    policy commit touched, in place (duplicate indices write identical
+    rows — padding by repetition is safe)."""
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def scatter(table, idx, block):
+        return table.at[idx.astype(jnp.int32)].set(
+            block.astype(jnp.int32))
+
+    return scatter
+
+
 def _pow2(n: int, floor: int = 1) -> int:
     return max(floor, 1 << max(0, max(1, n) - 1).bit_length())
 
@@ -207,6 +326,42 @@ class VerdictMemo:
         self.invalidations += 1
         METRICS.inc(VERDICT_MEMO_INVALIDATIONS,
                     labels={"reason": reason})
+
+    def adopt(self) -> None:
+        """Re-adopt the current policy generation WITHOUT dropping the
+        table — the owner reconciled a bank-scoped :class:`PolicyDelta`
+        itself (kept unaffected rows, queued affected ones for a
+        scatter refill). Only owners that consumed
+        ``POLICY_GENERATION.deltas_since`` may call this; anything
+        else must go through :meth:`valid_for`'s full drop."""
+        self._gen = policy_generation()
+
+    def partial_invalidate(self, n_rows: int, reason: str) -> None:
+        """Count a bank-scoped partial drop (``n_rows`` slots will be
+        rewritten by :meth:`refill_scatter`). The table stays — that
+        is the point."""
+        if n_rows <= 0:
+            return
+        self.invalidations += 1
+        METRICS.inc(VERDICT_MEMO_INVALIDATIONS,
+                    labels={"reason": reason})
+
+    def refill_scatter(self, idx, packed_block, n_real: int) -> None:
+        """Rewrite the memo rows at ``idx`` with freshly-computed
+        packed outputs (``idx``/``packed_block`` may be padded by
+        repeating real ids — duplicates write identical rows). Counts
+        ``n_real`` recomputed rows as misses, so the hit ratio stays
+        honest under churn."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.table is None or n_real <= 0:
+            return
+        self.table = _scatter_step()(
+            self.table, jax.device_put(idx, self.device),
+            jnp.asarray(packed_block))
+        self.misses += n_real
+        METRICS.inc(VERDICT_MEMO_MISSES, n_real)
 
     # -- write ------------------------------------------------------------
     def fill(self, packed_block, base: int, n_new: int,

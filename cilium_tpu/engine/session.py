@@ -170,10 +170,17 @@ class IncrementalSession:
     def __init__(self, engine, widths: Optional[Dict[str, int]] = None,
                  max_rows: int = MAX_ROWS,
                  max_strings: int = MAX_STRINGS,
-                 memo: bool = True):
+                 memo: bool = True, loader=None):
         from cilium_tpu.core.config import EngineConfig
+        from cilium_tpu.engine.memo import policy_generation
 
         self.engine = engine
+        #: optional Loader backref: makes the session swap-safe under
+        #: churn — committed revisions are consumed as PolicyDeltas
+        #: (bank-scoped: only rows touching a changed identity/bank
+        #: recompute; a no-change commit drops nothing)
+        self.loader = loader
+        self._gen_epoch = policy_generation()
         #: device-resident verdict memo over the session row table
         #: (engine/memo.py): steady state, a chunk whose rows are all
         #: known costs one id H2D + one gather — the verdict step runs
@@ -207,14 +214,94 @@ class IncrementalSession:
         self.row_capacity = 0
         self.rows_dev: Optional[jax.Array] = None
         self._pending_rows: list = []
+        #: host mirror of each session row's enforcement identity
+        #: (bounded by max_rows like the row table itself): the
+        #: bank-scoped invalidation mask is computed from it without
+        #: a device readback
+        self._row_eps: list = []
+        #: session row ids a bank-scoped commit touched, awaiting a
+        #: scatter refill in _memo_serve
+        self._memo_dirty: Optional[np.ndarray] = None
 
-    def reset(self) -> None:
+    def reset(self, reason: str = "session-reset") -> None:
         self.resets += 1
         if self.memo is not None:
             # session row ids restart from 0 — memoized outputs keyed
             # by the old id space must go with them
-            self.memo.invalidate("session-reset")
+            self.memo.invalidate(reason)
         self._init_state()
+
+    # -- swap safety ------------------------------------------------------
+    def _ensure_current(self) -> None:
+        """Consume committed revisions' PolicyDeltas (mirrors
+        ``CaptureReplay._ensure_current``): a no-change commit keeps
+        every table and the memo; a bank-scoped commit rescans the
+        session string tables through the new arrays (session strings
+        are raw bytes — policy-independent) and queues only rows whose
+        enforcement identity changed for a memo refill; anything else
+        resets the session."""
+        from cilium_tpu.engine.memo import (
+            POLICY_GENERATION,
+            policy_generation,
+        )
+
+        gen_now = policy_generation()
+        if gen_now == self._gen_epoch:
+            return
+        delta = POLICY_GENERATION.deltas_since(self._gen_epoch)
+        self._gen_epoch = gen_now
+        new_engine = self.engine
+        if self.loader is not None:
+            cand = self.loader.engine
+            if type(cand).__name__ == "VerdictEngine":
+                new_engine = cand
+        if delta.is_noop:
+            self._rebind(new_engine)
+            if self.memo is not None:
+                self.memo.adopt()
+            return
+        partial = (not delta.full
+                   and new_engine is not self.engine
+                   and (new_engine.policy.kafka_interns
+                        == self.engine.policy.kafka_interns))
+        if not partial:
+            self._rebind(new_engine)
+            self.reset(reason="policy-swap")
+            return
+        self._rebind(new_engine)
+        # rescan EVERY session string through the new policy's DFAs:
+        # the match-word tables are policy-scoped even though the
+        # strings themselves are not. O(session strings), bounded.
+        for t in self.tables.values():
+            t._pending = sorted(
+                ((i, s) for s, i in t.ids.items()), key=lambda p: p[0])
+            t.words = None
+            t.capacity = 0
+            t._nw = None
+        if self.memo is not None and self.memo.filled:
+            if delta.changed_identities:
+                eps = np.asarray(self._row_eps[:self.memo.filled],
+                                 dtype=np.int64)
+                affected = np.nonzero(np.isin(
+                    eps, np.fromiter(delta.changed_identities,
+                                     dtype=np.int64)))[0].astype(
+                                         np.int32)
+                if len(affected):
+                    self.memo.partial_invalidate(len(affected),
+                                                 delta.reason)
+                    prev = self._memo_dirty
+                    self._memo_dirty = (affected if prev is None
+                                        else np.union1d(prev, affected))
+            self.memo.adopt()
+        elif self.memo is not None:
+            self.memo.adopt()
+
+    def _rebind(self, engine) -> None:
+        if engine is self.engine:
+            return
+        self.engine = engine
+        for t in self.tables.values():
+            t.engine = engine
 
     # -- per-chunk host featurize -----------------------------------------
     def _string_lut(self, field: str, idx: np.ndarray, offsets,
@@ -318,6 +405,7 @@ class IncrementalSession:
                 rid = self.n_rows
                 self.n_rows += 1
                 self._pending_rows.append(row.copy())
+                self._row_eps.append(int(row[0]))
                 if chain is None:
                     self.row_ids[key] = [(row.tobytes(), rid)]
                 else:
@@ -342,6 +430,7 @@ class IncrementalSession:
                 rid = self.n_rows
                 self.n_rows += 1
                 self._pending_rows.append(row.copy())
+                self._row_eps.append(int(row[0]))
                 chain.append((row.tobytes(), rid))
             lut[j] = rid
         return lut[inv].astype(np.int32)
@@ -382,6 +471,7 @@ class IncrementalSession:
         n = len(rec)
         if n == 0:
             return 0, None
+        self._ensure_current()
         if (self.n_rows >= self.max_rows
                 or any(t.n >= self.max_strings
                        for t in self.tables.values())):
@@ -446,5 +536,20 @@ class IncrementalSession:
             self.engine._stage_auth(batch, authed_pairs)
             out = self._step(self.engine._arrays, table_words, batch)
             m.fill(memo_pack(out), base, n_new, sig)
+        dirty = self._memo_dirty
+        if dirty is not None and len(dirty) and m.table is not None:
+            # bank-scoped refill: rewrite ONLY the rows a committed
+            # revision touched; everything else keeps serving
+            D = _pow2(len(dirty), floor=32)
+            ridx = (np.concatenate(
+                [dirty, np.full(D - len(dirty), dirty[0],
+                                dtype=dirty.dtype)])
+                if D > len(dirty) else dirty)
+            batch = {"rows": self.rows_dev,
+                     "idx": jax.device_put(ridx, self.engine.device)}
+            self.engine._stage_auth(batch, authed_pairs)
+            out = self._step(self.engine._arrays, table_words, batch)
+            m.refill_scatter(ridx, memo_pack(out), len(dirty))
+        self._memo_dirty = None
         return m.gather(
             jax.device_put(idx, self.engine.device))["verdict"]

@@ -38,10 +38,20 @@ from cilium_tpu.core.identity import (
 from cilium_tpu.core.identity_cache import IdentityCacheBase
 from cilium_tpu.core.labels import LabelSet
 from cilium_tpu.kvstore import EVENT_DELETE, Event
+from cilium_tpu.runtime import faults
 from cilium_tpu.runtime.logging import get_logger
 from cilium_tpu.runtime.metrics import METRICS
 
 LOG = get_logger("identity")
+
+#: fires per identity-churn event delivery (the add/delete stream a
+#: churn storm floods): a fired fault LOSES that delivery — the
+#: kvstore watch isolates it — modelling burst churn overwhelming a
+#: watcher. The chaos suite pins that local allocations (and their
+#: verdicts) survive, and that a fresh replay-then-follow converges.
+CHURN_POINT = faults.register_point(
+    "kvstore.churn_storm",
+    "burst identity add/delete delivery in ClusterIdentityAllocator")
 
 ID_PREFIX = "cilium/state/identities/v1/id/"
 VALUE_PREFIX = "cilium/state/identities/v1/value/"
@@ -100,6 +110,7 @@ class ClusterIdentityAllocator(IdentityCacheBase):
             self._watch = None
 
     def _on_event(self, ev: Event) -> None:
+        faults.maybe_fail(CHURN_POINT)
         try:
             labels = _decode_enc(ev.key[len(VALUE_PREFIX):])
             nid = int(ev.value)  # previous value on deletes, new else

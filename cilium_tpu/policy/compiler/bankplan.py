@@ -1,0 +1,385 @@
+"""Content-addressed automaton banks: stable partition + failure-
+isolated compile (SURVEY §7 hard part #4, the churn half).
+
+:class:`~cilium_tpu.policy.compiler.dfa.BankCache` made repeated
+compiles of an UNCHANGED pattern group cheap, but the group boundaries
+themselves were positional (``patterns[i : i + bank_size]``): deleting
+one CNP shifts every later group's membership, so a single rule delete
+recompiled O(policy) banks and the cache bought nothing exactly when
+churn hit. This module replaces the positional grouping with a
+**content-defined partition** (the rsync/LBFS chunking trick applied
+to the sorted pattern universe): a pattern is a bank boundary iff a
+pure hash of the pattern says so, which makes bank membership a pure
+function of the pattern SET — an add/delete perturbs only the bank(s)
+around the touched patterns and every other bank's membership (and
+therefore its content-addressed key) is byte-identical. Compile work
+under churn is O(Δ banks), not O(policy).
+
+Bank keys are :func:`ruleset_fingerprint` hashes of the bank's pattern
+tuple + compile options — cross-process-stable like the checkpoint
+fingerprints (pinned under three ``PYTHONHASHSEED``\\ s by
+tests/test_checkpoint.py), so a restarted daemon, a bench process and
+the serving agent agree on which banks changed.
+
+:class:`BankRegistry` adds **per-bank failure isolation**: a bank
+whose compile fails (the ``loader.bank_compile`` injection point, a
+pathological pattern, a transient toolchain error) is *quarantined* —
+counted, TTL-stamped, and retried by a later regeneration — instead
+of aborting the whole policy swap. While quarantined, the bank's
+patterns are served from the last-good compiled bank that covered
+them (bit-identical for every other bank; stale-but-bounded for the
+quarantined one), and patterns with no prior compiled cover fail
+CLOSED through a dead bank (L7 rules are allow-lists — a lane that
+never matches can only deny more, never less).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cilium_tpu.policy.compiler import regex_parser as rp
+from cilium_tpu.policy.compiler.dfa import (
+    BankOverflow,
+    BankedDFA,
+    DFABank,
+    compile_bank,
+)
+from cilium_tpu.runtime import faults
+from cilium_tpu.runtime.checkpoint import ruleset_fingerprint
+from cilium_tpu.runtime.logging import get_logger
+from cilium_tpu.runtime.metrics import (
+    BANK_QUARANTINED,
+    BANK_REBUILDS,
+    METRICS,
+)
+
+LOG = get_logger("bankplan")
+
+#: fires once per bank-group compile attempt: a fired fault models a
+#: per-bank compile failure and must quarantine ONLY that bank — the
+#: rest of the regeneration proceeds (tests/test_faults.py pins it)
+BANK_COMPILE_POINT = faults.register_point(
+    "loader.bank_compile", "per-bank DFA compile in BankRegistry")
+
+#: bank-key format epoch — bump on any change to partitioning, key
+#: derivation, or DFABank layout so stale registries/artifacts read as
+#: clean misses, never as a misparse
+BANK_FORMAT = "bank-v1"
+
+#: a run of non-boundary patterns longer than this is force-split —
+#: bounds the membership ripple of a pathological hash run to the run
+#: itself (the partition stays a pure function of the pattern set)
+_HARD_CAP_FACTOR = 4
+
+
+def bank_boundary(pattern: str, target: int) -> bool:
+    """Pure per-pattern boundary predicate of the content-defined
+    partition: True ≈ 1/target of the time, independent of every other
+    pattern."""
+    return zlib.crc32(pattern.encode("utf-8")) % max(1, target) == 0
+
+
+def partition_patterns(patterns: Sequence[str],
+                       target: int) -> List[Tuple[str, ...]]:
+    """Content-defined partition of a pattern set into bank groups.
+
+    A pure function of ``set(patterns)`` and ``target`` (sorted walk +
+    per-pattern hash boundaries): add-then-delete of any subset returns
+    the exact original groups, and an add/delete perturbs only the
+    group(s) adjacent to the touched patterns."""
+    uniq = sorted(set(patterns))
+    hard_cap = max(1, target) * _HARD_CAP_FACTOR
+    groups: List[Tuple[str, ...]] = []
+    cur: List[str] = []
+    for p in uniq:
+        cur.append(p)
+        if bank_boundary(p, target) or len(cur) >= hard_cap:
+            groups.append(tuple(cur))
+            cur = []
+    if cur:
+        groups.append(tuple(cur))
+    return groups
+
+
+def bank_key(patterns: Tuple[str, ...], opts: Tuple) -> str:
+    """Cross-process-stable content address of one bank group (pattern
+    tuple + compile options), like the checkpoint fingerprints."""
+    return ruleset_fingerprint(BANK_FORMAT, patterns, opts)
+
+
+def _dead_bank(n_patterns: int) -> DFABank:
+    """A bank whose every lane never accepts — the fail-CLOSED home of
+    patterns whose compile is quarantined with no prior cover. Safe by
+    the allow-list property: an L7 lane that never matches can only
+    deny more."""
+    n_words = max(1, (max(1, n_patterns) + 31) // 32)
+    return DFABank(
+        trans=np.zeros((2, 1), dtype=np.int32),
+        byteclass=np.zeros(256, dtype=np.int32),
+        accept=np.zeros((2, n_words), dtype=np.uint32),
+        start=1,
+        n_patterns=n_patterns,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class FieldBankStats:
+    """One field's build outcome, for the loader's plan diff and the
+    churn soak's O(Δ) assertions."""
+
+    field: str
+    #: content-addressed keys of the groups serving their CURRENT
+    #: membership, in partition order (quarantined groups excluded —
+    #: they serve stale covers, and the loader treats any quarantine
+    #: as a full-invalidation commit)
+    bank_keys: Tuple[str, ...]
+    rebuilt: Tuple[str, ...]       # keys compiled by THIS build
+    reused: int                    # groups served from the registry
+    quarantined: Tuple[str, ...]   # keys serving a stale cover
+
+
+class _Quarantine:
+    __slots__ = ("until", "failures", "error")
+
+    def __init__(self, until: float, failures: int, error: str):
+        self.until = until
+        self.failures = failures
+        self.error = error
+
+
+class BankRegistry:
+    """Per-loader store of compiled bank groups, content-addressed,
+    with quarantine. Single-writer by construction (the loader's
+    regeneration path is serialized), so no locking here."""
+
+    def __init__(self, quarantine_ttl_s: float = 30.0,
+                 max_groups: int = 4096, max_bytes: int = 256 << 20,
+                 clock=time.monotonic):
+        #: key → [(DFABank, pattern tuple), ...] (a group splits into
+        #: several banks when subset construction overflows)
+        self._groups: "collections.OrderedDict[str, List[Tuple[DFABank, Tuple[str, ...]]]]" = \
+            collections.OrderedDict()
+        self._group_bytes: Dict[str, int] = {}
+        #: (opts, pattern) → key of the last-GOOD group containing it
+        #: (the quarantine fallback's cover index)
+        self._cover: Dict[Tuple, str] = {}
+        self._quarantine: Dict[str, _Quarantine] = {}
+        self.quarantine_ttl_s = quarantine_ttl_s
+        self.max_groups = max_groups
+        self.max_bytes = max_bytes
+        self.bytes = 0
+        self.clock = clock
+        #: lifetime counters (the churn soak's O(Δ) ledger)
+        self.compiles = 0          # group compiles that succeeded
+        self.bank_compiles = 0     # individual DFA banks built
+        self.reuses = 0
+        self.quarantine_events = 0
+        self.quarantined_serves = 0
+
+    # -- bookkeeping ------------------------------------------------------
+    @staticmethod
+    def _bytes_of(group: List[Tuple[DFABank, Tuple[str, ...]]]) -> int:
+        return sum(int(b.trans.nbytes + b.accept.nbytes
+                       + b.byteclass.nbytes) for b, _ in group)
+
+    def _store(self, key: str, group, opts: Tuple) -> None:
+        nbytes = self._bytes_of(group)
+        old = self._groups.pop(key, None)
+        if old is not None:
+            self.bytes -= self._group_bytes.pop(key, 0)
+        self._groups[key] = group
+        self._group_bytes[key] = nbytes
+        self.bytes += nbytes
+        for _, pats in group:
+            for p in pats:
+                self._cover[(opts, p)] = key
+        while self._groups and (len(self._groups) > self.max_groups
+                                or self.bytes > self.max_bytes):
+            k, _ = self._groups.popitem(last=False)
+            self.bytes -= self._group_bytes.pop(k, 0)
+        # the cover index tracks deleted patterns too — prune entries
+        # whose group was evicted once it outgrows the group store
+        if len(self._cover) > 16 * max(1024, self.max_groups):
+            self._cover = {ck: k for ck, k in self._cover.items()
+                           if k in self._groups}
+
+    def _get(self, key: str):
+        g = self._groups.get(key)
+        if g is not None:
+            self._groups.move_to_end(key)
+        return g
+
+    # -- compile ----------------------------------------------------------
+    def _compile_group(self, group: Tuple[str, ...], opts: Tuple):
+        """Compile one group (deterministic halving on state-cap
+        overflow). The injection point fires once per group, so a
+        forced failure quarantines the group as a unit."""
+        max_states, max_quantifier, case_insensitive = opts
+        faults.maybe_fail(BANK_COMPILE_POINT)
+        out: List[Tuple[DFABank, Tuple[str, ...]]] = []
+
+        def rec(pats: Tuple[str, ...]) -> None:
+            asts = [rp.parse(p, max_quantifier=max_quantifier,
+                             case_insensitive=case_insensitive)
+                    for p in pats]
+            try:
+                bank = compile_bank(asts, max_states=max_states)
+            except BankOverflow:
+                if len(pats) == 1:
+                    raise rp.RegexError(
+                        f"pattern too large for state cap: {pats[0]!r}")
+                mid = len(pats) // 2
+                rec(pats[:mid])
+                rec(pats[mid:])
+                return
+            out.append((bank, pats))
+
+        rec(tuple(group))
+        self.bank_compiles += len(out)
+        return out
+
+    def compile_field(self, field: str, patterns: Sequence[str],
+                      cfg, case_insensitive: bool = False
+                      ) -> Tuple[BankedDFA, FieldBankStats]:
+        """Compile one field's pattern universe through the
+        content-addressed partition. Reuses unchanged groups, compiles
+        changed ones, quarantines (never raises past) per-group
+        failures."""
+        opts = (cfg.max_dfa_states, cfg.max_quantifier,
+                bool(case_insensitive))
+        now = self.clock()
+        groups = partition_patterns(patterns, cfg.bank_size)
+
+        live_keys: List[str] = []
+        rebuilt: List[str] = []
+        quarantined: List[str] = []
+        reused = 0
+        #: ordered (DFABank, pattern tuple) list feeding the stack
+        banks: List[Tuple[DFABank, Tuple[str, ...]]] = []
+        #: patterns served by a stale cover (quarantined groups)
+        fallback_pats: List[str] = []
+
+        for group in groups:
+            key = bank_key(group, opts)
+            cached = self._get(key)
+            if cached is not None:
+                banks.extend(cached)
+                live_keys.append(key)
+                reused += 1
+                self.reuses += 1
+                continue
+            q = self._quarantine.get(key)
+            if q is not None and now < q.until:
+                # still serving the outage: don't re-attempt yet
+                quarantined.append(key)
+                fallback_pats.extend(group)
+                self.quarantined_serves += 1
+                continue
+            try:
+                compiled = self._compile_group(group, opts)
+            except Exception as e:  # per-bank isolation: quarantine,
+                # keep regenerating — the old cover serves this group
+                failures = (q.failures + 1) if q is not None else 1
+                self._quarantine[key] = _Quarantine(
+                    now + self.quarantine_ttl_s, failures,
+                    f"{type(e).__name__}: {e}")
+                self.quarantine_events += 1
+                METRICS.inc(BANK_QUARANTINED, labels={"field": field})
+                LOG.error("bank compile quarantined",
+                          extra={"fields": {
+                              "field": field, "bank": key,
+                              "patterns": len(group),
+                              "failures": failures,
+                              "ttl_s": self.quarantine_ttl_s,
+                              "error": f"{type(e).__name__}: {e}"}})
+                quarantined.append(key)
+                fallback_pats.extend(group)
+                continue
+            self._quarantine.pop(key, None)
+            self._store(key, compiled, opts)
+            banks.extend(compiled)
+            live_keys.append(key)
+            rebuilt.append(key)
+            self.compiles += 1
+            METRICS.inc(BANK_REBUILDS, labels={"field": field})
+
+        # -- quarantine fallback: last-good covers, then fail closed --
+        if fallback_pats:
+            cover_keys: List[str] = []
+            seen = set()
+            uncovered: List[str] = []
+            for p in fallback_pats:
+                ck = self._cover.get((opts, p))
+                if ck is not None and ck in self._groups:
+                    if ck not in seen:
+                        seen.add(ck)
+                        cover_keys.append(ck)
+                else:
+                    uncovered.append(p)
+            for ck in cover_keys:
+                banks.extend(self._get(ck))
+            if uncovered:
+                banks.append((_dead_bank(len(uncovered)),
+                              tuple(uncovered)))
+
+        banked = self._assemble(patterns, banks)
+        stats = FieldBankStats(
+            field=field, bank_keys=tuple(live_keys),
+            rebuilt=tuple(rebuilt), reused=reused,
+            quarantined=tuple(quarantined))
+        return banked, stats
+
+    @staticmethod
+    def _assemble(patterns: Sequence[str],
+                  banks: List[Tuple[DFABank, Tuple[str, ...]]]
+                  ) -> BankedDFA:
+        """(bank, member patterns) list → BankedDFA over the INPUT
+        pattern order. A pattern present in several banks (its current
+        bank plus a stale cover carrying it for a different
+        quarantined group) binds to its FIRST bank in order — current
+        banks are appended before covers, so live compiles win."""
+        if not banks:
+            banks = [(_dead_bank(1), ("",))]
+        assign: Dict[str, Tuple[int, int]] = {}
+        for bid, (_, pats) in enumerate(banks):
+            for lane, p in enumerate(pats):
+                assign.setdefault(p, (bid, lane))
+        pattern_bank = np.zeros(len(patterns), dtype=np.int32)
+        pattern_lane = np.zeros(len(patterns), dtype=np.int32)
+        for i, p in enumerate(patterns):
+            bid, lane = assign[p]
+            pattern_bank[i] = bid
+            pattern_lane[i] = lane
+        return BankedDFA(
+            banks=[b for b, _ in banks],
+            pattern_bank=pattern_bank,
+            pattern_lane=pattern_lane,
+            patterns=tuple(patterns),
+        )
+
+    # -- introspection ----------------------------------------------------
+    def expired_quarantines(self, now: Optional[float] = None
+                            ) -> Tuple[str, ...]:
+        """Keys whose quarantine TTL has lapsed — the next regenerate
+        retries their compile."""
+        now = self.clock() if now is None else now
+        return tuple(k for k, q in self._quarantine.items()
+                     if now >= q.until)
+
+    def status(self) -> Dict:
+        return {
+            "groups": len(self._groups),
+            "bytes": self.bytes,
+            "compiles": self.compiles,
+            "bank_compiles": self.bank_compiles,
+            "reuses": self.reuses,
+            "quarantined": len(self._quarantine),
+            "quarantine_events": self.quarantine_events,
+            "quarantined_serves": self.quarantined_serves,
+        }

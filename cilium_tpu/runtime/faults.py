@@ -41,6 +41,14 @@ Known injection points (registered by the modules owning the seam):
 ``engine.dispatch``        device dispatch in ``engine/verdict.py``
                            (``verdict_batch_arrays`` / blob step)
 ``loader.swap``            between stage and commit in ``runtime/loader.py``
+``loader.bank_compile``    per-bank DFA compile in
+                           ``policy/compiler/bankplan.BankRegistry`` (a
+                           fired fault quarantines ONLY that bank; the
+                           regeneration proceeds on the old cover)
+``kvstore.churn_storm``    per identity-churn event delivery in
+                           ``identity_kvstore.ClusterIdentityAllocator``
+                           (a fired fault loses that delivery —
+                           modelling burst add/delete churn)
 ``stream.frame.server``    per-chunk dispatch in ``StreamSession``
 ``stream.frame.client``    per-frame receive in ``StreamClient``
 ``stream.credit``          credit-grant send in ``StreamSession`` (a

@@ -24,6 +24,7 @@ from cilium_tpu.runtime.checkpoint import ArtifactCache, ruleset_fingerprint
 from cilium_tpu.runtime import faults
 from cilium_tpu.runtime.logging import get_logger, span as _log_span
 from cilium_tpu.runtime.metrics import (
+    BANK_HOTSWAPS,
     LOADER_ROLLBACKS,
     METRICS,
     SpanStat,
@@ -43,6 +44,34 @@ SWAP_POINT = faults.register_point(
 #: the policy fingerprint epochs — bump on layout change so stale
 #: snapshots read as a clean miss, never as a misparse.
 WARM_STATE_KEY = "warm-state-v1"
+
+
+def _identity_entry_tuple(ms) -> tuple:
+    """The verdict-relevant content of one identity's MapState — every
+    key/entry field that can change a verdict must appear here, or two
+    policies differing only in that field would share a fingerprint."""
+    return (
+        tuple(sorted(
+            (k.identity, k.dport, k.proto, k.direction, k.port_plen,
+             e.is_deny, e.l7_wildcard, e.auth_required,
+             tuple(sorted(repr(lr) for lr in e.l7_rules)))
+            for k, e in ms.entries.items()
+        )),
+        ms.ingress_enforced,
+        ms.egress_enforced,
+        getattr(ms, "audit", False),
+    )
+
+
+def identity_fingerprints(per_identity: Dict[int, "MapState"]
+                          ) -> Dict[int, str]:
+    """Per-identity content fingerprints — the unit of the bank-scoped
+    invalidation delta. Cross-process-stable (pickle+sha, like every
+    checkpoint fingerprint): a CNP add/delete changes exactly the
+    fingerprints of the identities it selects, so a committed revision
+    can tell memo owners WHICH rows may have moved."""
+    return {ep: ruleset_fingerprint(_identity_entry_tuple(ms))
+            for ep, ms in per_identity.items()}
 
 
 def _referenced_secret_values(per_identity, secrets) -> tuple:
@@ -103,6 +132,26 @@ class Loader:
         from cilium_tpu.policy.compiler.dfa import BankCache
 
         self.bank_cache = BankCache()
+        # content-addressed bank registry (policy/compiler/bankplan):
+        # the churn-proof compile path — content-defined partition, per-
+        # bank quarantine, O(Δ) rebuilds. Supersedes bank_cache when on.
+        if self.config.loader.bank_isolation:
+            from cilium_tpu.policy.compiler.bankplan import BankRegistry
+
+            self.bank_registry = BankRegistry(
+                quarantine_ttl_s=self.config.loader.bank_quarantine_ttl_s)
+        else:
+            self.bank_registry = None
+        #: per-identity fingerprints + bank plan of the SERVING policy
+        #: (None/empty until the first TPU commit): the inputs of the
+        #: bank-scoped PolicyDelta a commit hands to memo owners
+        self._identity_fps: Optional[Dict[int, str]] = None
+        self._globals_fp: Optional[str] = None
+        self._bank_plan: Dict[str, tuple] = {}
+        #: True while the serving policy contains quarantined banks —
+        #: degraded builds are never cached, never warm-snapshotted,
+        #: and always commit a FULL delta
+        self._degraded = False
         self._warned_oracle_scale = False
         # lazily-built CPU oracle over the ACTIVE snapshot: the circuit
         # breaker's fallback lane (runtime/service.py). Cached per
@@ -152,12 +201,18 @@ class Loader:
         return fallback
 
     def _commit(self, engine, revision: int,
-                per_identity: Dict[int, MapState], backend: str):
+                per_identity: Dict[int, MapState], backend: str,
+                delta=None):
         """The revision swap — ONE critical section, so a reader sees
         either the old (engine, revision, snapshot) triple or the new
         one, never a mix. The loader.swap injection point fires just
         before: a fault here models a crash mid-swap, and regenerate's
-        rollback guarantees the previous table keeps serving."""
+        rollback guarantees the previous table keeps serving.
+
+        ``delta`` (engine.memo.PolicyDelta, default FULL) tells memo
+        owners what this commit actually changed: a bank-scoped delta
+        lets sessions drop only the rows touching a changed bank, and
+        a no-change delta (same artifact key) drops nothing."""
         faults.maybe_fail(SWAP_POINT)
         with self._lock:
             self._engine = engine
@@ -173,7 +228,7 @@ class Loader:
         # the oracle-only loader path must remain so too.
         from cilium_tpu.engine.memo import POLICY_GENERATION
 
-        POLICY_GENERATION.bump()
+        POLICY_GENERATION.bump(delta)
         METRICS.inc("cilium_tpu_regenerations_total",
                     labels={"backend": backend})
         return engine
@@ -189,7 +244,8 @@ class Loader:
         propagates to the caller."""
         with self._lock:
             prev = (self._engine, self._revision, self.per_identity,
-                    self._last_artifact_key)
+                    self._last_artifact_key, self._identity_fps,
+                    self._globals_fp, self._bank_plan, self._degraded)
         # regeneration is its own ingress: a root trace per attempt, so
         # compile/stage cost and rollbacks are attributable like any
         # request (and the staged-revision log line carries the id)
@@ -207,6 +263,13 @@ class Loader:
                     # revision's policy under the serving revision's
                     # name (found by the ISSUE-7 memo staleness suite)
                     self._last_artifact_key = prev[3]
+                    # ...and so do the delta inputs: fingerprints/plan
+                    # of the ABORTED build must not seed the next
+                    # commit's bank-scoped invalidation
+                    self._identity_fps = prev[4]
+                    self._globals_fp = prev[5]
+                    self._bank_plan = prev[6]
+                    self._degraded = prev[7]
                     self._fallback = None
                     self._fallback_revision = -1
                 # a rollback is a serving-state change too: memos
@@ -253,43 +316,47 @@ class Loader:
                 per_identity, secret_lookup=secret_lookup,
                 audit=self.config.policy_audit_mode)
             self._last_artifact_key = None
+            self._identity_fps = None
+            self._globals_fp = None
+            self._bank_plan = {}
+            self._degraded = False
             return self._commit(engine, revision, per_identity, "oracle")
 
+        from cilium_tpu.engine.memo import PolicyDelta
         from cilium_tpu.engine.verdict import CompiledPolicy, VerdictEngine
 
-        # "policy-v6": v2 gained the ms_auth array; v3 port-range prefix
+        # "policy-v7": v2 gained the ms_auth array; v3 port-range prefix
         # keys (ms_plens + the w2 repack); v4 the audit_mode scalar; v5
         # the per-endpoint audit bit (enf_flags grew a column); v6 the
         # distillery template dedup (ms_tmpl_ids; key_w0 holds template
-        # ids) — each bump invalidates older cached artifacts, and the
-        # entry tuple must include every verdict-relevant key/entry
-        # field or two policies differing only in that field would
-        # share one artifact
-        key = ruleset_fingerprint(
-            "policy-v6",
+        # ids); v7 the content-addressed bank partition (lane layout
+        # differs from the positional grouping) — each bump invalidates
+        # older cached artifacts. The key is now derived from the
+        # per-identity fingerprints + a globals fingerprint, so the
+        # SAME inputs also seed the bank-scoped invalidation delta.
+        fps = identity_fingerprints(per_identity)
+        globals_fp = ruleset_fingerprint(
             self.config.policy_audit_mode,
-            sorted(
-                (
-                    ep,
-                    tuple(sorted(
-                        (k.identity, k.dport, k.proto, k.direction,
-                         k.port_plen,
-                         e.is_deny, e.l7_wildcard, e.auth_required,
-                         tuple(sorted(repr(lr) for lr in e.l7_rules)))
-                        for k, e in ms.entries.items()
-                    )),
-                    ms.ingress_enforced,
-                    ms.egress_enforced,
-                    getattr(ms, "audit", False),
-                )
-                for ep, ms in per_identity.items()
-            ),
             repr(self.config.engine),
+            bool(self.config.loader.bank_isolation),
             # only secrets actually REFERENCED by this snapshot's
             # header matches enter the key: rotating an unrelated
             # secret must not invalidate every cached artifact
             _referenced_secret_values(per_identity, self.secrets),
         )
+        key = ruleset_fingerprint(
+            "policy-v7", globals_fp, tuple(sorted(fps.items())))
+        with self._lock:
+            serving_engine = self._engine
+        if (key == self._last_artifact_key and not self._degraded
+                and isinstance(serving_engine, VerdictEngine)):
+            # byte-identical policy re-committed (identity churn that
+            # netted out, a redundant update): keep the serving engine,
+            # advance the revision, and tell memo owners NOTHING
+            # changed — the add-then-delete case of the churn plane
+            self._identity_fps = fps
+            return self._commit(serving_engine, revision, per_identity,
+                                "tpu", delta=PolicyDelta.none())
         policy = self._cache.get(key)
         cached = policy is not None
         if policy is None:
@@ -300,16 +367,70 @@ class Loader:
                     per_identity, self.config.engine, revision=revision,
                     secret_lookup=secret_lookup,
                     bank_cache=self.bank_cache,
+                    bank_registry=self.bank_registry,
                     audit=self.config.policy_audit_mode)
-            self._cache.put(key, policy)
+            quarantined = tuple(getattr(policy, "bank_quarantined",
+                                        ()) or ())
+            if not quarantined:
+                # degraded builds (quarantined banks serving stale
+                # covers) are never cached: the clean key must keep
+                # reading as a miss so the TTL retry recompiles
+                self._cache.put(key, policy)
             METRICS.observe("cilium_tpu_compile_seconds", span.seconds)
+        else:
+            quarantined = tuple(getattr(policy, "bank_quarantined",
+                                        ()) or ())
         with _log_span(LOG, "policy staged", revision=revision,
                        identities=len(per_identity), cache_hit=cached):
             with SpanStat("policy_stage"), \
                     TRACER.span("policy.stage", cache_hit=cached):
                 engine = VerdictEngine(policy, device=self.device)
-        self._last_artifact_key = key
-        return self._commit(engine, revision, per_identity, "tpu")
+        new_plan = dict(getattr(policy, "bank_plan", {}) or {})
+        delta = self._delta_for(fps, globals_fp, new_plan,
+                                bool(quarantined))
+        self._last_artifact_key = key if not quarantined else None
+        self._identity_fps = fps
+        self._globals_fp = globals_fp
+        self._bank_plan = new_plan
+        self._degraded = bool(quarantined)
+        return self._commit(engine, revision, per_identity, "tpu",
+                            delta=delta)
+
+    def _delta_for(self, fps: Dict[int, str], globals_fp: str,
+                   new_plan: Dict[str, tuple], degraded: bool):
+        """Bank-scoped PolicyDelta of this commit vs the serving
+        state; conservative FULL whenever the serving state can't
+        vouch for unchanged rows (first commit, globals change,
+        quarantine involved on either side)."""
+        from cilium_tpu.engine.memo import PolicyDelta
+
+        changed_banks = set()
+        for field in set(self._bank_plan) | set(new_plan):
+            old_keys = set(self._bank_plan.get(field, ()))
+            new_keys = set(new_plan.get(field, ()))
+            changed_banks |= old_keys ^ new_keys
+            swapped_in = len(new_keys - old_keys)
+            if swapped_in:
+                METRICS.inc(BANK_HOTSWAPS, swapped_in,
+                            labels={"field": field})
+        prev_fps = self._identity_fps
+        if (prev_fps is None or self._globals_fp != globals_fp
+                or degraded or self._degraded):
+            return PolicyDelta(full=True)
+        changed_ids = {ep for ep in set(prev_fps) | set(fps)
+                       if prev_fps.get(ep) != fps.get(ep)}
+        return PolicyDelta.banks(changed_ids, changed_banks)
+
+    def bank_status(self) -> Dict[str, object]:
+        """Bank registry + serving-plan snapshot (the service `status`
+        op's churn-plane face)."""
+        if self.bank_registry is None:
+            return {"enabled": False}
+        out: Dict[str, object] = {"enabled": True,
+                                  "degraded": self._degraded}
+        out.update(self.bank_registry.status())
+        out["plan"] = {f: len(k) for f, k in self._bank_plan.items()}
+        return out
 
     # -- warm restart -----------------------------------------------------
     def snapshot_warm(self) -> bool:
@@ -358,10 +479,27 @@ class Loader:
         except (KeyError, TypeError, ValueError):
             return False
         if self.config.enable_tpu_offload and offload and key:
+            from cilium_tpu.engine.memo import PolicyDelta
+            from cilium_tpu.engine.verdict import VerdictEngine
+
+            with self._lock:
+                serving_engine = self._engine
+            if (key == self._last_artifact_key and not self._degraded
+                    and isinstance(serving_engine, VerdictEngine)):
+                # the snapshot IS the serving policy (drain → restore
+                # without an intervening change): keep the staged
+                # engine, commit the snapshot's revision, and drop
+                # NOTHING — replay memos and unique-row buffers stay
+                # hot across the warm restart (ISSUE-8 satellite; the
+                # old unconditional drop cost the whole memo hit
+                # ratio on every restart)
+                self._identity_fps = identity_fingerprints(per_identity)
+                self._commit(serving_engine, revision, per_identity,
+                             "warm", delta=PolicyDelta.none())
+                METRICS.inc(WARM_RESTORES)
+                return True
             policy = self._cache.get(key)
             if policy is not None:
-                from cilium_tpu.engine.verdict import VerdictEngine
-
                 with _log_span(LOG, "warm restore", revision=revision,
                                identities=len(per_identity)):
                     with SpanStat("policy_stage"), \
@@ -369,8 +507,21 @@ class Loader:
                                         cache_hit=True, warm=True):
                         engine = VerdictEngine(policy,
                                                device=self.device)
+                # a real fingerprint change (or an unknown serving
+                # state): hand memo owners the identity-scoped delta
+                # when the serving fingerprints can vouch for it
+                fps = identity_fingerprints(per_identity)
+                new_plan = dict(getattr(policy, "bank_plan", {}) or {})
+                delta = self._delta_for(fps, self._globals_fp or "",
+                                        new_plan, False) \
+                    if self._globals_fp is not None \
+                    else PolicyDelta(full=True)
                 self._last_artifact_key = key
-                self._commit(engine, revision, per_identity, "warm")
+                self._identity_fps = fps
+                self._bank_plan = new_plan
+                self._degraded = False
+                self._commit(engine, revision, per_identity, "warm",
+                             delta=delta)
                 METRICS.inc(WARM_RESTORES)
                 return True
         if not self.config.enable_tpu_offload and not offload:

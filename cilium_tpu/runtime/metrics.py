@@ -94,10 +94,24 @@ COLLECTIVE_BYTES = "cilium_tpu_collective_bytes_total"
 # memo behind capture/stream replay — hits are chunk rows served by
 # the on-device gather, misses are unique rows verdicted and
 # inserted, invalidations are memo drops with a reason label
-# (policy-swap / auth-change)).
+# (policy-swap / auth-change / session-reset, plus the bank-scoped
+# partial drops: bank-swap)).
 VERDICT_MEMO_HITS = "cilium_tpu_verdict_memo_hits_total"
 VERDICT_MEMO_MISSES = "cilium_tpu_verdict_memo_misses_total"
 VERDICT_MEMO_INVALIDATIONS = "cilium_tpu_verdict_memo_invalidations_total"
+
+# -- churn-proof policy plane (policy/compiler/bankplan.py +
+# runtime/loader.py): content-addressed automaton banks, per-bank
+# quarantine, and the O(Δ) incremental-compile ledger.
+#: bank groups compiled (a cache miss in the content-addressed
+#: registry), by field — O(Δ) under churn is THE property
+BANK_REBUILDS = "cilium_tpu_bank_rebuilds_total"
+#: bank groups quarantined after a compile failure (old cover keeps
+#: serving; TTL-retried), by field
+BANK_QUARANTINED = "cilium_tpu_bank_quarantined_total"
+#: bank groups hot-swapped into the serving plan by a committed
+#: revision (new content-addressed key), by field
+BANK_HOTSWAPS = "cilium_tpu_bank_hotswaps_total"
 
 #: latency-shaped default boundaries (seconds; the Prometheus client
 #: defaults) — covers every ``*_seconds`` series we emit
@@ -559,7 +573,15 @@ METRICS.describe(VERDICT_MEMO_MISSES,
                  "unique rows verdicted and inserted into the memo")
 METRICS.describe(VERDICT_MEMO_INVALIDATIONS,
                  "verdict-memo drops, by reason (policy-swap / "
-                 "auth-change / session-reset)")
+                 "auth-change / session-reset / bank-swap)")
+METRICS.describe(BANK_REBUILDS,
+                 "automaton bank groups compiled, by field")
+METRICS.describe(BANK_QUARANTINED,
+                 "bank groups quarantined after compile failure, "
+                 "by field")
+METRICS.describe(BANK_HOTSWAPS,
+                 "bank groups hot-swapped by a committed revision, "
+                 "by field")
 
 
 class SpanStat:

@@ -871,8 +871,13 @@ class VerdictService:
             return self.drain()
         if op == "status":
             if self.agent is not None:
-                return self.agent.status()
-            return {"engine_revision": self.loader.revision}
+                status = self.agent.status()
+                if isinstance(status, dict):
+                    status.setdefault("banks",
+                                      self.loader.bank_status())
+                return status
+            return {"engine_revision": self.loader.revision,
+                    "banks": self.loader.bank_status()}
         if op == "metrics":
             return {"text": METRICS.expose()}
         if op == "mapstate_pull":

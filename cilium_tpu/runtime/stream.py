@@ -285,16 +285,22 @@ class StreamSession:
             return n, self._oracle_chunk(rec, l7, offsets, blob, gen,
                                          pairs)
         try:
-            if self._inc is None or self._inc_engine is not engine:
-                # first chunk, or the loader hot-swapped a new revision:
-                # session tables were scanned against the OLD engine's
-                # DFA banks — rebuild (the NPDS-invalidation analog)
+            if self._inc is None:
+                # loader-wired session (ISSUE 8): a policy committed
+                # mid-stream is consumed as a bank-scoped delta — the
+                # session rescans only what changed and keeps its
+                # interned rows + memo instead of rebuilding from
+                # scratch on every hot-swap (the old behavior, which
+                # cost the whole dedup state per CNP update)
                 from cilium_tpu.engine.session import IncrementalSession
 
-                self._inc = IncrementalSession(engine, widths=self.widths)
+                self._inc = IncrementalSession(engine,
+                                               widths=self.widths,
+                                               loader=self.loader)
                 self._inc_engine = engine
             n, verdict = self._inc.verdict_chunk(
                 rec, l7, offsets, blob, gen=gen, authed_pairs=pairs)
+            self._inc_engine = self._inc.engine
         except Exception as e:  # noqa: BLE001 — degrade, don't error
             if vd is None:
                 raise

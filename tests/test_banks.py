@@ -1,0 +1,356 @@
+"""Content-addressed automaton banks (policy/compiler/bankplan.py) +
+the loader's churn-proof policy plane (ISSUE 8): the partition is a
+pure function of the pattern set, a CNP add/delete recompiles O(Δ)
+banks, a per-bank compile failure quarantines only its bank, and
+commits carry bank-scoped invalidation deltas instead of a global
+memo drop."""
+
+import tempfile
+
+import numpy as np
+import pytest
+
+from cilium_tpu.core.config import Config, EngineConfig
+from cilium_tpu.core.flow import (
+    Flow,
+    HTTPInfo,
+    L7Type,
+    Protocol,
+    TrafficDirection,
+)
+from cilium_tpu.policy.compiler.bankplan import (
+    BankRegistry,
+    bank_key,
+    partition_patterns,
+)
+from cilium_tpu.policy.compiler.dfa import compile_patterns, match_bank_numpy
+from cilium_tpu.runtime import faults
+from cilium_tpu.runtime.faults import FaultPlan, FaultRule
+from cilium_tpu.runtime.loader import Loader, identity_fingerprints
+
+
+# ---------------------------------------------------------------------------
+# Partition: pure function of the set, O(Δ) locality
+
+
+def test_partition_is_a_pure_function_of_the_set():
+    pats = [f"/svc{i}/.*" for i in range(50)]
+    a = partition_patterns(pats, 8)
+    b = partition_patterns(list(reversed(pats)), 8)       # order-free
+    c = partition_patterns(pats + pats[:10], 8)           # dup-free
+    assert a == b == c
+    assert sorted(p for g in a for p in g) == sorted(set(pats))
+
+
+def test_partition_add_then_delete_returns_original_banks():
+    """The property the churn plane rests on: any add/delete sequence
+    that nets out returns the EXACT original bank set (same groups,
+    same content-addressed keys)."""
+    base = [f"/svc{i}/.*" for i in range(60)]
+    opts = (8192, 64, False)
+    orig = partition_patterns(base, 8)
+    orig_keys = [bank_key(g, opts) for g in orig]
+    for extra in (["/zzz/.*"], ["/aaa/.*", "/mmm/.*"],
+                  [f"/churn{i}/x" for i in range(9)]):
+        grown = partition_patterns(base + extra, 8)
+        shrunk = partition_patterns(
+            [p for p in base + extra if p not in set(extra)], 8)
+        assert shrunk == orig
+        assert [bank_key(g, opts) for g in shrunk] == orig_keys
+        assert grown != orig  # the add really moved SOME bank
+
+
+def test_partition_perturbation_is_local():
+    """One added pattern changes O(1) groups, not O(total) — the
+    content-defined boundary property (positional grouping failed
+    exactly this: one mid-list delete shifted every later bank)."""
+    base = [f"/svc{i}/.*" for i in range(120)]
+    before = set(partition_patterns(base, 8))
+    for extra in ("/added/a.*", "/added/b.*", "/zz/tail.*"):
+        after = set(partition_patterns(base + [extra], 8))
+        changed = after ^ before
+        # an add splits/extends at most the group it lands in (plus
+        # its neighbour when the new pattern is itself a boundary)
+        assert len(changed) <= 4, (extra, len(changed))
+
+
+def test_bank_key_distinguishes_patterns_and_opts():
+    g = ("/a/.*", "/b/.*")
+    assert bank_key(g, (8192, 64, False)) != \
+        bank_key(g, (8192, 64, True))
+    assert bank_key(g, (8192, 64, False)) != \
+        bank_key(("/a/.*",), (8192, 64, False))
+    assert len(bank_key(g, (8192, 64, False))) == 24
+
+
+# ---------------------------------------------------------------------------
+# Registry: parity, reuse, quarantine
+
+
+def _matches(banked, strings):
+    """(row, pattern) accept set via the CPU reference scan."""
+    L = max(32, max(len(s) for s in strings))
+    data = np.zeros((len(strings), L), dtype=np.uint8)
+    lens = np.zeros(len(strings), dtype=np.int32)
+    for i, s in enumerate(strings):
+        data[i, : len(s)] = np.frombuffer(s, dtype=np.uint8)
+        lens[i] = len(s)
+    out = set()
+    for bi, bank in enumerate(banked.banks):
+        w = match_bank_numpy(bank, data, lens)
+        for p_i in range(banked.n_patterns):
+            if int(banked.pattern_bank[p_i]) != bi:
+                continue
+            lane = int(banked.pattern_lane[p_i])
+            for row in range(len(strings)):
+                if w[row, lane // 32] >> (lane % 32) & 1:
+                    out.add((row, banked.patterns[p_i]))
+    return out
+
+
+def test_registry_matches_greedy_compiler_bit_for_bit():
+    cfg = EngineConfig(bank_size=4)
+    pats = [f"/api/v{i}/.*" for i in range(20)] + ["GET", "PUT"]
+    banked, stats = BankRegistry().compile_field("path", pats, cfg)
+    greedy = compile_patterns(pats, bank_size=4)
+    probes = [b"/api/v3/x", b"/api/v15/yy", b"GET", b"/nope"]
+    assert _matches(banked, probes) == _matches(greedy, probes)
+    assert len(stats.rebuilt) == len(stats.bank_keys) >= 3
+
+
+def test_registry_reuses_unchanged_groups():
+    cfg = EngineConfig(bank_size=4)
+    pats = [f"/api/v{i}/.*" for i in range(24)]
+    reg = BankRegistry()
+    _, s1 = reg.compile_field("path", pats, cfg)
+    c0 = reg.compiles
+    # unchanged set → zero compiles; one add → O(1) compiles
+    _, s2 = reg.compile_field("path", pats, cfg)
+    assert reg.compiles == c0 and s2.reused == len(s2.bank_keys)
+    _, s3 = reg.compile_field("path", pats + ["/new/.*"], cfg)
+    assert 1 <= reg.compiles - c0 <= 2
+    assert set(s3.bank_keys) & set(s1.bank_keys), \
+        "an add rebuilt every bank"
+
+
+def test_quarantined_bank_serves_cover_then_fails_closed():
+    """A forced compile failure on a CHANGED bank: unchanged banks are
+    byte-identically reused, the failed bank's pre-existing patterns
+    serve from the last-good cover, and its genuinely-new patterns
+    fail CLOSED (never match → allow-list denies)."""
+    cfg = EngineConfig(bank_size=4)
+    base = [f"/api/v{i}/.*" for i in range(16)]
+    reg = BankRegistry(quarantine_ttl_s=30.0)
+    banked0, s0 = reg.compile_field("path", base, cfg)
+    with faults.inject(FaultPlan(
+            [FaultRule("loader.bank_compile", times=1)])):
+        banked1, s1 = reg.compile_field("path", base + ["/new/.*"],
+                                        cfg)
+    assert len(s1.quarantined) == 1
+    assert reg.quarantine_events == 1
+    probes = [b"/api/v3/x", b"/api/v12/y", b"/new/x"]
+    before = _matches(banked0, probes)
+    after = _matches(banked1, probes)
+    # every pre-existing pattern matches exactly as before...
+    assert {(r, p) for r, p in after if p != "/new/.*"} == before
+    # ...and the uncompiled new pattern NEVER matches (fail closed)
+    assert not any(p == "/new/.*" for _, p in after)
+
+
+def test_quarantine_ttl_retry_recovers():
+    clock = [0.0]
+    cfg = EngineConfig(bank_size=4)
+    reg = BankRegistry(quarantine_ttl_s=10.0, clock=lambda: clock[0])
+    base = [f"/api/v{i}/.*" for i in range(8)]
+    reg.compile_field("path", base, cfg)
+    with faults.inject(FaultPlan(
+            [FaultRule("loader.bank_compile", times=1)])):
+        _, s1 = reg.compile_field("path", base + ["/new/.*"], cfg)
+    assert s1.quarantined
+    # inside the TTL: no re-attempt (still quarantined), no compile
+    c0 = reg.compiles
+    _, s2 = reg.compile_field("path", base + ["/new/.*"], cfg)
+    assert s2.quarantined and reg.compiles == c0
+    assert reg.quarantined_serves >= 1
+    # past the TTL: the retry compiles and clears the quarantine
+    clock[0] = 11.0
+    assert reg.expired_quarantines() != ()
+    banked3, s3 = reg.compile_field("path", base + ["/new/.*"], cfg)
+    assert not s3.quarantined and reg.compiles == c0 + 1
+    assert any(p == "/new/.*" for _, p in
+               _matches(banked3, [b"/new/x"]))
+
+
+# ---------------------------------------------------------------------------
+# Loader integration: O(Δ) compile, no-op commits, degraded handling
+
+
+def _policy(paths, port=80):
+    from cilium_tpu.core.identity import IdentityAllocator
+    from cilium_tpu.core.labels import LabelSet
+    from cilium_tpu.policy.api import (
+        EndpointSelector,
+        IngressRule,
+        PortProtocol,
+        PortRule,
+        Rule,
+    )
+    from cilium_tpu.policy.api.l7 import L7Rules, PortRuleHTTP
+    from cilium_tpu.policy.mapstate import PolicyResolver
+    from cilium_tpu.policy.repository import Repository
+    from cilium_tpu.policy.selectorcache import SelectorCache
+
+    rules = [Rule(
+        endpoint_selector=EndpointSelector.from_labels(app="db"),
+        ingress=(IngressRule(
+            from_endpoints=(EndpointSelector.from_labels(app="web"),),
+            to_ports=(PortRule(
+                ports=(PortProtocol(port, Protocol.TCP),),
+                rules=L7Rules(http=tuple(
+                    PortRuleHTTP(path=p, method="GET")
+                    for p in paths))),)),),
+    )]
+    alloc = IdentityAllocator()
+    db = alloc.allocate(LabelSet.from_dict({"app": "db"}))
+    web = alloc.allocate(LabelSet.from_dict({"app": "web"}))
+    cache = SelectorCache(alloc)
+    repo = Repository()
+    repo.add(rules, sanitize=False)
+    return ({db: PolicyResolver(repo, cache).resolve(alloc.lookup(db))},
+            db, web)
+
+
+def _http_flow(web, db, path, port=80):
+    return Flow(src_identity=web, dst_identity=db, dport=port,
+                protocol=Protocol.TCP,
+                direction=TrafficDirection.INGRESS, l7=L7Type.HTTP,
+                http=HTTPInfo(method="GET", path=path))
+
+
+@pytest.fixture()
+def tpu_loader(tmp_path):
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.engine.bank_size = 4
+    cfg.loader.cache_dir = str(tmp_path / "cache")
+    return Loader(cfg)
+
+
+def test_loader_cnp_add_recompiles_o_delta_banks(tpu_loader):
+    loader = tpu_loader
+    paths = [f"/p{i}/.*" for i in range(24)]
+    per1, db, web = _policy(paths)
+    loader.regenerate(per1, revision=1)
+    banks_total = len(loader._bank_plan.get("path", ()))
+    assert banks_total >= 4, "scale the policy up: too few banks"
+    c0 = loader.bank_registry.compiles
+    per2, db, web = _policy(paths + ["/new/.*"])
+    loader.regenerate(per2, revision=2)
+    delta_compiles = loader.bank_registry.compiles - c0
+    assert 1 <= delta_compiles <= 3, \
+        f"1-path add recompiled {delta_compiles} groups " \
+        f"(of {banks_total}) — not O(Δ)"
+    out = loader.engine.verdict_flows(
+        [_http_flow(web, db, "/new/x"), _http_flow(web, db, "/p3/x"),
+         _http_flow(web, db, "/zz")])
+    assert [int(v) for v in out["verdict"]] == [5, 5, 2]
+
+
+def test_loader_noop_regenerate_keeps_engine_and_emits_noop_delta(
+        tpu_loader):
+    from cilium_tpu.engine import memo
+
+    loader = tpu_loader
+    per1, db, web = _policy([f"/p{i}/.*" for i in range(8)])
+    loader.regenerate(per1, revision=1)
+    engine1 = loader.engine
+    per_same, _, _ = _policy([f"/p{i}/.*" for i in range(8)])
+    loader.regenerate(per_same, revision=2)
+    assert loader.engine is engine1
+    assert loader.revision == 2
+    d = memo.POLICY_GENERATION.deltas_since(memo.policy_generation() - 1)
+    assert d.is_noop
+
+
+def test_loader_bank_compile_failure_quarantines_not_aborts(
+        tpu_loader):
+    loader = tpu_loader
+    paths = [f"/p{i}/.*" for i in range(12)]
+    per1, db, web = _policy(paths)
+    loader.regenerate(per1, revision=1)
+    per2, db, web = _policy(paths + ["/fail/.*"])
+    golden = [_http_flow(web, db, "/p3/x"), _http_flow(web, db, "/zz")]
+    before = [int(v) for v in
+              loader.engine.verdict_flows(golden)["verdict"]]
+    with faults.inject(FaultPlan(
+            [FaultRule("loader.bank_compile", times=1)])):
+        loader.regenerate(per2, revision=2)   # must NOT raise
+    assert loader.revision == 2
+    assert loader._degraded
+    st = loader.bank_status()
+    assert st["degraded"] and st["quarantine_events"] >= 1
+    # every other bank serves bit-identical verdicts; the failed
+    # bank's new pattern fails closed
+    out = loader.engine.verdict_flows(
+        golden + [_http_flow(web, db, "/fail/x")])
+    assert [int(v) for v in out["verdict"]][:2] == before
+    assert int(out["verdict"][2]) == 2
+    # degraded builds are never cached under the clean key: the TTL
+    # retry recompiles and recovers
+    for q in loader.bank_registry._quarantine.values():
+        q.until = 0.0
+    loader.regenerate(per2, revision=3)
+    assert not loader._degraded
+    out = loader.engine.verdict_flows([_http_flow(web, db, "/fail/x")])
+    assert int(out["verdict"][0]) == 5
+
+
+def test_identity_fingerprints_change_only_for_touched_identities():
+    per1, db, web = _policy([f"/p{i}/.*" for i in range(4)])
+    per2, db2, web2 = _policy([f"/p{i}/.*" for i in range(4)] +
+                              ["/new/.*"])
+    fp1 = identity_fingerprints(per1)
+    fp2 = identity_fingerprints(per2)
+    assert fp1.keys() == fp2.keys()
+    assert fp1 != fp2                 # the selected identity moved
+    # and a byte-identical snapshot fingerprints identically
+    per3, _, _ = _policy([f"/p{i}/.*" for i in range(4)])
+    assert identity_fingerprints(per3) == fp1
+
+
+def test_bank_isolation_off_falls_back_to_positional_path(tmp_path):
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.loader.bank_isolation = False
+    cfg.loader.cache_dir = str(tmp_path / "cache")
+    loader = Loader(cfg)
+    assert loader.bank_registry is None
+    per1, db, web = _policy(["/a/.*", "/b/.*"])
+    loader.regenerate(per1, revision=1)
+    out = loader.engine.verdict_flows(
+        [_http_flow(web, db, "/a/x"), _http_flow(web, db, "/c/x")])
+    assert [int(v) for v in out["verdict"]] == [5, 2]
+    assert loader.bank_status() == {"enabled": False}
+
+
+def test_hypothesis_add_delete_property():
+    hypothesis = pytest.importorskip("hypothesis")
+    from hypothesis import given, settings, strategies as st
+
+    pat = st.text(alphabet="abcxyz/.*", min_size=1, max_size=12)
+
+    @settings(max_examples=50, deadline=None)
+    @given(base=st.lists(pat, max_size=40, unique=True),
+           extra=st.lists(pat, max_size=6, unique=True))
+    def prop(base, extra):
+        before = partition_patterns(base, 4)
+        withx = partition_patterns(base + extra, 4)
+        after = partition_patterns(
+            [p for p in base + extra if p not in set(extra)
+             or p in set(base)], 4)
+        assert after == before
+        # every pattern appears in exactly one group
+        flat = [p for g in withx for p in g]
+        assert sorted(flat) == sorted(set(base) | set(extra))
+
+    prop()

@@ -51,6 +51,36 @@ def test_fingerprint_distinguishes_inputs():
     assert len(ruleset_fingerprint("a")) == 24
 
 
+def test_bank_keys_stable_across_processes():
+    """Content-addressed bank keys (policy/compiler/bankplan) must be
+    a pure function of the CNP/FQDN pattern inputs — cross-process-
+    stable like the artifact fingerprints — or a restarted daemon
+    would see every bank as changed and recompile O(policy) under the
+    first churn event (ISSUE 8)."""
+    code = (
+        "from cilium_tpu.policy.compiler.bankplan import ("
+        "bank_key, partition_patterns)\n"
+        "pats = [f'/api/v{i}/.*' for i in range(40)]"
+        " + ['(?:[^\\\\n]*\\\\n)*x-token:abc']\n"
+        "opts = (8192, 64, False)\n"
+        "print(';'.join(bank_key(g, opts)"
+        " for g in partition_patterns(pats, 8)))")
+    outs = []
+    for seed in ("0", "1", "random"):
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=120,
+            cwd=REPO_ROOT,
+            env=dict(os.environ, PYTHONHASHSEED=seed,
+                     JAX_PLATFORMS="cpu"))
+        assert out.returncode == 0, out.stderr
+        outs.append(out.stdout.strip())
+    assert outs[0] and outs[0] == outs[1] == outs[2]
+    # several groups, each with a distinct key
+    keys = outs[0].split(";")
+    assert len(keys) >= 3 and len(set(keys)) == len(keys)
+
+
 # ---------------------------------------------------------------------------
 # Corrupt entries
 

@@ -122,13 +122,16 @@ def test_registered_points_cover_the_documented_seams():
     import cilium_tpu.clustermesh  # noqa: F401
     import cilium_tpu.engine.verdict  # noqa: F401
     import cilium_tpu.fqdn.dnsproxy  # noqa: F401
+    import cilium_tpu.identity_kvstore  # noqa: F401
     import cilium_tpu.kvstore  # noqa: F401
+    import cilium_tpu.policy.compiler.bankplan  # noqa: F401
     import cilium_tpu.runtime.stream  # noqa: F401
 
     pts = faults.registered_points()
-    for p in ("engine.dispatch", "loader.swap", "stream.frame.server",
+    for p in ("engine.dispatch", "loader.swap", "loader.bank_compile",
+              "stream.frame.server",
               "stream.frame.client", "stream.credit", "service.admit",
-              "service.drain", "kvstore.watch",
+              "service.drain", "kvstore.watch", "kvstore.churn_storm",
               "clustermesh.session", "dnsproxy.query"):
         assert p in pts, p
 
@@ -804,8 +807,11 @@ def test_memo_invalidates_across_swap_rollback_warm_restore(tmp_path):
     serving-state transition: revision swap (verdicts follow the new
     policy), rollback (verdicts stay with the surviving revision),
     snapshot/warm-restore (verdicts return with the restored
-    revision) — each transition drops the memo (counted) and every
-    answer is bit-equal to the serving engine's verdict_flows."""
+    revision) — each CONTENT-changing transition invalidates the
+    touched memo rows (bank-scoped since ISSUE 8: a CNP change drops
+    only rows of the identities it selects, counted under
+    reason=bank-swap; a rollback stays a full policy-swap drop) and
+    every answer is bit-equal to the serving engine's verdict_flows."""
     from cilium_tpu.runtime.metrics import VERDICT_MEMO_INVALIDATIONS
 
     cfg = Config()
@@ -830,25 +836,31 @@ def test_memo_invalidates_across_swap_rollback_warm_restore(tmp_path):
     assert session_verdicts() == [1, 2] * 6 == engine_verdicts()
     memo = replay.memo
     inv0 = memo.invalidations
-    minv0 = _metric(VERDICT_MEMO_INVALIDATIONS,
-                    {"reason": "policy-swap"})
+    bsw0 = _metric(VERDICT_MEMO_INVALIDATIONS,
+                   {"reason": "bank-swap"})
 
     # CNP change: only 6000 allowed now — the hot memo must flip WITH
-    # the swap, not serve rev-1 answers
+    # the swap, not serve rev-1 answers. The db identity's fingerprint
+    # changed, so the invalidation is bank-scoped, not a full drop.
     per2, _, _ = _tiny_policy(6000)
     loader.regenerate(per2, revision=2)
     assert session_verdicts() == [2, 1] * 6 == engine_verdicts()
-    assert replay.memo.invalidations + inv0 >= inv0 + 1
+    assert replay.memo.invalidations >= inv0 + 1
     assert _metric(VERDICT_MEMO_INVALIDATIONS,
-                   {"reason": "policy-swap"}) >= minv0 + 1
+                   {"reason": "bank-swap"}) >= bsw0 + 1
 
     # mid-swap crash: rollback restores rev 2 — the session keeps
-    # answering rev-2 semantics, never a torn state
+    # answering rev-2 semantics, never a torn state (a rollback is a
+    # conservative FULL drop: reason=policy-swap)
+    psw0 = _metric(VERDICT_MEMO_INVALIDATIONS,
+                   {"reason": "policy-swap"})
     with faults.inject(FaultPlan([FaultRule("loader.swap", times=1)])):
         with pytest.raises(FaultInjected):
             loader.regenerate(per1, revision=3)
         assert loader.revision == 2
         assert session_verdicts() == [2, 1] * 6 == engine_verdicts()
+    assert _metric(VERDICT_MEMO_INVALIDATIONS,
+                   {"reason": "policy-swap"}) >= psw0 + 1
 
     # drain-style snapshot at rev 2, move on to rev 3, then warm
     # restore: the session must follow BACK to the restored revision
@@ -864,10 +876,12 @@ def test_memo_invalidates_across_swap_rollback_warm_restore(tmp_path):
 @pytest.mark.slow
 def test_chaos_memo_golden_corpus_stable_across_cnp_change():
     """The acceptance replay for the verdict memo: the golden corpus
-    replays through a memo-hot session, an (unrelated) CNP change
-    commits mid-session, and the corpus verdicts are IDENTICAL before
-    and after — the memo refilled against the new revision instead of
-    serving stale rows, and both answers match the serving engine."""
+    replays through a memo-hot session, a policy re-commit lands
+    mid-session, and the corpus verdicts are IDENTICAL before and
+    after, matching the serving engine both times. Since ISSUE 8 the
+    re-commit of a BYTE-IDENTICAL snapshot is a no-change delta: the
+    memo must survive it UNTOUCHED (zero invalidations, hits keep
+    accruing) — the churn-proof half of the staleness contract."""
     from cilium_tpu.agent import Agent
     from cilium_tpu.auth import AUTH_UNENFORCED
     from tests.test_controlplane_golden import build_agent, build_flows
@@ -894,14 +908,199 @@ def test_chaos_memo_golden_corpus_stable_across_cnp_change():
         assert before == engine_verdicts()
         assert replay.memo is not None and replay.memo.hits > 0
         inv0 = replay.memo.invalidations
+        hits0 = replay.memo.hits
 
-        # an unrelated CNP (fresh port on an existing endpoint pair)
-        # commits a new revision; corpus traffic is untouched by it
+        # the SAME snapshot re-commits under a new revision (identity
+        # churn that netted out): a no-change delta — the memo keeps
+        # serving, bit-identically, without a drop or a refill
         loader.regenerate(loader.per_identity,
                           revision=loader.revision + 1)
         after = session_verdicts()
         assert after == before, "memo served stale verdicts after swap"
         assert after == engine_verdicts()
-        assert replay.memo.invalidations >= inv0 + 1
+        assert replay.memo.invalidations == inv0, \
+            "no-change commit dropped the memo"
+        assert replay.memo.hits > hits0
     finally:
         agent.stop()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8: churn-proof policy plane — per-bank compile failure
+# isolation, identity churn-storm delivery loss, and the warm-restart
+# memo-retention regression.
+
+
+def _paths_policy(paths):
+    """_tiny_policy with an HTTP path allow-list (drives DFA banks)."""
+    from cilium_tpu.core.identity import IdentityAllocator
+    from cilium_tpu.core.labels import LabelSet
+    from cilium_tpu.policy.api import (
+        EndpointSelector,
+        IngressRule,
+        PortProtocol,
+        PortRule,
+        Rule,
+    )
+    from cilium_tpu.policy.api.l7 import L7Rules, PortRuleHTTP
+    from cilium_tpu.policy.mapstate import PolicyResolver
+    from cilium_tpu.policy.repository import Repository
+    from cilium_tpu.policy.selectorcache import SelectorCache
+
+    rules = [Rule(
+        endpoint_selector=EndpointSelector.from_labels(app="db"),
+        ingress=(IngressRule(
+            from_endpoints=(EndpointSelector.from_labels(app="web"),),
+            to_ports=(PortRule(
+                ports=(PortProtocol(80, Protocol.TCP),),
+                rules=L7Rules(http=tuple(
+                    PortRuleHTTP(path=p, method="GET")
+                    for p in paths))),)),),
+    )]
+    alloc = IdentityAllocator()
+    db = alloc.allocate(LabelSet.from_dict({"app": "db"}))
+    web = alloc.allocate(LabelSet.from_dict({"app": "web"}))
+    cache = SelectorCache(alloc)
+    repo = Repository()
+    repo.add(rules, sanitize=False)
+    return ({db: PolicyResolver(repo, cache).resolve(
+        alloc.lookup(db))}, db, web)
+
+
+def _http_flow(web, db, path):
+    from cilium_tpu.core.flow import HTTPInfo, L7Type
+
+    return Flow(src_identity=web, dst_identity=db, dport=80,
+                protocol=Protocol.TCP,
+                direction=TrafficDirection.INGRESS, l7=L7Type.HTTP,
+                http=HTTPInfo(method="GET", path=path))
+
+
+def test_bank_compile_fault_quarantines_only_its_bank(tmp_path):
+    """loader.bank_compile fires on the one changed bank of a CNP
+    add: the regeneration COMMITS (no abort, no rollback), every
+    unchanged bank serves golden verdicts bit-identically, the
+    quarantine is counted, and the TTL retry recovers the bank."""
+    from cilium_tpu.runtime.metrics import BANK_QUARANTINED
+
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.engine.bank_size = 4
+    cfg.loader.cache_dir = str(tmp_path / "cache")
+    loader = Loader(cfg)
+    paths = [f"/p{i}/.*" for i in range(16)]
+    per1, db, web = _paths_policy(paths)
+    loader.regenerate(per1, revision=1)
+    golden_flows = [_flow(web, db, 5432)] + \
+        [_http_flow(web, db, f"/p{i}/x") for i in range(16)] + \
+        [_http_flow(web, db, "/nope")]
+    golden = [int(v) for v in
+              loader.engine.verdict_flows(golden_flows)["verdict"]]
+    rollbacks0 = _metric(LOADER_ROLLBACKS)
+    q0 = _metric(BANK_QUARANTINED, {"field": "path"})
+
+    per2, db, web = _paths_policy(paths + ["/fresh/.*"])
+    with faults.inject(FaultPlan(
+            [FaultRule("loader.bank_compile", times=1)])):
+        loader.regenerate(per2, revision=2)  # commits despite the fault
+    assert loader.revision == 2
+    assert _metric(LOADER_ROLLBACKS) == rollbacks0, \
+        "bank failure escalated to a full rollback"
+    assert _metric(BANK_QUARANTINED, {"field": "path"}) == q0 + 1
+    # unchanged banks: bit-identical golden verdicts
+    after = [int(v) for v in
+             loader.engine.verdict_flows(golden_flows)["verdict"]]
+    assert after == golden
+    # the failed bank's new pattern fails CLOSED while quarantined
+    out = loader.engine.verdict_flows([_http_flow(web, db, "/fresh/x")])
+    assert int(out["verdict"][0]) == 2
+    # TTL retry: recompile succeeds, the new pattern enforces
+    for q in loader.bank_registry._quarantine.values():
+        q.until = 0.0
+    loader.regenerate(per2, revision=3)
+    out = loader.engine.verdict_flows([_http_flow(web, db, "/fresh/x")])
+    assert int(out["verdict"][0]) == 5
+    assert not loader._degraded
+
+
+def test_kvstore_churn_storm_loses_deliveries_not_correctness():
+    """kvstore.churn_storm drops identity add/delete deliveries on a
+    watching allocator mid-burst: the dropped events are isolated and
+    counted, the WRITER's own allocations (and the verdicts they
+    drive) are untouched, and a fresh replay-then-follow converges to
+    the store's true mapping."""
+    from cilium_tpu.core.labels import LabelSet
+    from cilium_tpu.identity_kvstore import ClusterIdentityAllocator
+    from cilium_tpu.kvstore import KVStore
+
+    store = KVStore()
+    writer = ClusterIdentityAllocator(store).start()
+    watcher_events = []
+    watcher = ClusterIdentityAllocator(
+        store, on_change=lambda nid, lbl: watcher_events.append(
+            (int(nid), lbl))).start()
+
+    fired0 = _metric(FAULTS_INJECTED, {"point": "kvstore.churn_storm"})
+    errs0 = _metric(KVSTORE_WATCH_ERRORS)
+    with faults.inject(FaultPlan(
+            [FaultRule("kvstore.churn_storm", prob=0.4)], seed=11)):
+        ids = [writer.allocate(LabelSet.from_dict({"app": f"a{i}"}))
+               for i in range(24)]
+    assert _metric(FAULTS_INJECTED,
+                   {"point": "kvstore.churn_storm"}) > fired0
+    assert _metric(KVSTORE_WATCH_ERRORS) > errs0
+    # the writer itself is authoritative: every id resolves locally
+    for i, nid in enumerate(ids):
+        assert writer.lookup_by_labels(
+            LabelSet.from_dict({"app": f"a{i}"})) == nid
+    # the storm-hit watcher lost SOME deliveries but never corrupted:
+    # everything it did see matches the writer's mapping
+    for nid, lbl in watcher_events:
+        if lbl is not None:
+            assert writer.lookup_by_labels(lbl) == nid
+    # a fresh replay-then-follow (restart after the storm) converges
+    fresh = ClusterIdentityAllocator(store).start()
+    for i, nid in enumerate(ids):
+        assert fresh.lookup_by_labels(
+            LabelSet.from_dict({"app": f"a{i}"})) == nid
+    writer.close()
+    watcher.close()
+    fresh.close()
+
+
+def test_warm_restore_same_artifact_keeps_memo(tmp_path):
+    """ISSUE-8 satellite regression: a drain → warm-restore cycle
+    whose artifact key is UNCHANGED must not drop the device memo or
+    the unique-row buffer — the restarted service keeps its memo hit
+    ratio instead of re-verdicting the whole row universe."""
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.loader.cache_dir = str(tmp_path / "cache")
+    loader = Loader(cfg)
+    per1, db, web = _tiny_policy(5432)
+    loader.regenerate(per1, revision=1)
+    flows = [_flow(web, db, 5432), _flow(web, db, 6000)] * 8
+
+    replay, cols = _memo_session(loader, cfg, flows)
+    out = replay.verdict_chunk(cols.rec, cols.l7)
+    golden = [int(v) for v in out["verdict"]]
+    memo = replay.memo
+    assert memo is not None and memo.hits > 0
+    inv0 = memo.invalidations
+    misses0 = memo.misses
+    hits0 = memo.hits
+    uniq_buf = replay.unique_rows
+    assert uniq_buf is not None
+
+    # drain-style snapshot, then an immediate warm restore (process
+    # kept, artifact unchanged — the warm-restart fast path)
+    assert loader.snapshot_warm() is True
+    assert loader.restore_warm() is True
+    after = replay.verdict_chunk(cols.rec, cols.l7)
+    assert [int(v) for v in after["verdict"]] == golden
+    assert memo.invalidations == inv0, \
+        "same-key warm restore dropped the memo"
+    assert memo.misses == misses0, "memo refilled after warm restore"
+    assert memo.hits > hits0
+    assert replay.unique_rows is uniq_buf, \
+        "unique-row device buffer was re-staged"

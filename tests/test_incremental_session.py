@@ -137,3 +137,109 @@ def test_session_enforces_auth():
     n, dev = sess.verdict_chunk(rec, l7, offsets, blob, gen=gen,
                                 authed_pairs=pairs)
     assert [int(v) for v in np.asarray(dev)[:n]] == [1] * 5
+
+
+def test_session_follows_bank_scoped_policy_churn(tmp_path):
+    """ISSUE 8: a loader-wired session rides committed policy updates
+    WITHOUT resetting — a CNP add/delete rescans its string tables and
+    refills only the memo rows whose identity changed; a no-op commit
+    (add-then-delete netting out) touches nothing; and every answer is
+    bit-equal to the serving engine."""
+    from cilium_tpu.core.flow import (
+        Flow,
+        HTTPInfo,
+        L7Type,
+        Protocol,
+        TrafficDirection,
+    )
+    from cilium_tpu.core.identity import IdentityAllocator
+    from cilium_tpu.core.labels import LabelSet
+    from cilium_tpu.policy.api import (
+        EndpointSelector,
+        IngressRule,
+        PortProtocol,
+        PortRule,
+        Rule,
+    )
+    from cilium_tpu.policy.api.l7 import L7Rules, PortRuleHTTP
+    from cilium_tpu.policy.mapstate import PolicyResolver
+    from cilium_tpu.policy.repository import Repository
+    from cilium_tpu.policy.selectorcache import SelectorCache
+
+    alloc = IdentityAllocator()
+    db = alloc.allocate(LabelSet.from_dict({"app": "db"}))
+    web = alloc.allocate(LabelSet.from_dict({"app": "web"}))
+
+    def resolve(paths):
+        rules = [Rule(
+            endpoint_selector=EndpointSelector.from_labels(app="db"),
+            ingress=(IngressRule(
+                from_endpoints=(
+                    EndpointSelector.from_labels(app="web"),),
+                to_ports=(PortRule(
+                    ports=(PortProtocol(80, Protocol.TCP),),
+                    rules=L7Rules(http=tuple(
+                        PortRuleHTTP(path=p, method="GET")
+                        for p in paths))),)),),
+        )]
+        repo = Repository()
+        repo.add(rules, sanitize=False)
+        return {db: PolicyResolver(repo, SelectorCache(alloc)).resolve(
+            alloc.lookup(db))}
+
+    def flow(path):
+        return Flow(src_identity=web, dst_identity=db, dport=80,
+                    protocol=Protocol.TCP,
+                    direction=TrafficDirection.INGRESS,
+                    l7=L7Type.HTTP,
+                    http=HTTPInfo(method="GET", path=path))
+
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.engine.bank_size = 4
+    cfg.loader.cache_dir = str(tmp_path / "cache")
+    loader = Loader(cfg)
+    base = [f"/p{i}/.*" for i in range(10)]
+    loader.regenerate(resolve(base), revision=1)
+
+    flows = [flow(f"/p{i}/x") for i in range(10)] + [flow("/no")]
+    flows = flows * 20
+    rec, l7, offsets, blob, gen = capture_from_bytes(
+        capture_to_bytes(flows))
+
+    sess = IncrementalSession(loader.engine, loader=loader)
+
+    def session_verdicts():
+        n, dev = sess.verdict_chunk(rec, l7, offsets, blob, gen=gen)
+        return [int(v) for v in np.asarray(dev)[:n]]
+
+    def engine_verdicts():
+        return [int(v) for v in
+                loader.engine.verdict_flows(flows)["verdict"]]
+
+    assert session_verdicts() == engine_verdicts()
+    assert sess.memo is not None and sess.memo.hits > 0
+    rows0, resets0 = sess.n_rows, sess.resets
+    inv0 = sess.memo.invalidations
+
+    # CNP add: the session follows the commit without a reset — the
+    # memo partially refills (bank-scoped) and stays id-compatible
+    loader.regenerate(resolve(base + ["/new/.*"]), revision=2)
+    assert session_verdicts() == engine_verdicts()
+    assert sess.resets == resets0, "bank-scoped commit reset the session"
+    assert sess.n_rows == rows0
+    assert sess.memo.invalidations >= inv0 + 1  # partial, counted
+
+    # CNP delete back to base: verdicts revert with the policy
+    loader.regenerate(resolve(base), revision=3)
+    assert session_verdicts() == engine_verdicts()
+    assert sess.resets == resets0
+
+    # add-then-delete netted out → revision 3 == revision 1 content;
+    # re-committing it is a NO-OP delta: nothing drops, hits accrue
+    hits0 = sess.memo.hits
+    inv1 = sess.memo.invalidations
+    loader.regenerate(resolve(base), revision=4)
+    assert session_verdicts() == engine_verdicts()
+    assert sess.memo.invalidations == inv1
+    assert sess.memo.hits > hits0

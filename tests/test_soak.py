@@ -136,3 +136,234 @@ def test_overload_with_deadlines_reaps_instead_of_wasting_slots():
     mb.close()
     assert METRICS.get(ADMISSION_REAPED) > reaped0
     assert err > 0  # abandoned callers saw explicit timeouts
+
+
+# ---------------------------------------------------------------------------
+# `make churn`: the ISSUE-8 acceptance soak — sustained CNP add/delete
+# + FQDN pattern churn (a CPU-sized slice of the BASELINE configs[4]
+# "millions of users" shape: many identities x many rules, updates
+# streaming while verdicts serve). Asserts, across >= 50 committed
+# policy updates driven through one live replay session:
+#   * zero ERROR verdicts, and session verdicts match the serving
+#     engine every update (and the CPU oracle on sampled updates) —
+#     no stale-allow/stale-deny ever;
+#   * compile work is bank-scoped: total bank compiles grow with the
+#     CHANGE count, not with policy size x updates;
+#   * steady-state memo hit ratio >= 0.99 — the churn-proof memo;
+#   * update->enforcement p99 recorded (and emitted as a provenance-
+#     stamped bench line when CILIUM_TPU_CHURN_BENCH_OUT is set).
+
+
+@pytest.mark.churn
+def test_churn_soak_bank_scoped_compile_and_hot_memo(tmp_path):
+    import json
+    import os
+
+    import numpy as np
+
+    from cilium_tpu.core.config import Config
+    from cilium_tpu.core.flow import (
+        DNSInfo,
+        HTTPInfo,
+        L7Type,
+        Protocol,
+        TrafficDirection,
+    )
+    from cilium_tpu.core.identity import IdentityAllocator
+    from cilium_tpu.core.labels import LabelSet
+    from cilium_tpu.engine.verdict import CaptureReplay
+    from cilium_tpu.ingest.columnar import flows_to_columns
+    from cilium_tpu.policy.api import (
+        EndpointSelector,
+        IngressRule,
+        PortProtocol,
+        PortRule,
+        Rule,
+    )
+    from cilium_tpu.policy.api.l7 import (
+        L7Rules,
+        PortRuleDNS,
+        PortRuleHTTP,
+    )
+    from cilium_tpu.policy.mapstate import PolicyResolver
+    from cilium_tpu.policy.repository import Repository
+    from cilium_tpu.policy.selectorcache import SelectorCache
+    from cilium_tpu.runtime.loader import Loader
+
+    rng = np.random.default_rng(8)
+    N_IDS = 12          # db identities under independent churn
+    BASE_PATHS = 8      # HTTP paths per identity at t0
+    UPDATES = 56        # committed policy updates (>= 50 acceptance)
+
+    alloc = IdentityAllocator()
+    web = alloc.allocate(LabelSet.from_dict({"app": "web"}))
+    dbs = [alloc.allocate(LabelSet.from_dict({"app": f"db{i}"}))
+           for i in range(N_IDS)]
+    #: live rule state: identity index -> list of (kind, pattern)
+    rules_of = {i: [("http", f"/svc{i}/p{j}/.*")
+                    for j in range(BASE_PATHS)]
+                + [("dns", f"api{i}.corp.io")]
+                for i in range(N_IDS)}
+
+    def resolve():
+        repo = Repository()
+        rules = []
+        for i in range(N_IDS):
+            http = tuple(PortRuleHTTP(path=p, method="GET")
+                         for k, p in rules_of[i] if k == "http")
+            dns = tuple(PortRuleDNS(match_name=p)
+                        for k, p in rules_of[i] if k == "dns")
+            rules.append(Rule(
+                endpoint_selector=EndpointSelector.from_labels(
+                    app=f"db{i}"),
+                ingress=(IngressRule(
+                    from_endpoints=(
+                        EndpointSelector.from_labels(app="web"),),
+                    to_ports=(
+                        PortRule(ports=(PortProtocol(80, Protocol.TCP),),
+                                 rules=L7Rules(http=http)),
+                        PortRule(ports=(PortProtocol(53, Protocol.UDP),),
+                                 rules=L7Rules(dns=dns)),)),),
+            ))
+        repo.add(rules, sanitize=False)
+        resolver = PolicyResolver(repo, SelectorCache(alloc))
+        return {db: resolver.resolve(alloc.lookup(db)) for db in dbs}
+
+    def http_flow(i, path):
+        return Flow(src_identity=web, dst_identity=dbs[i], dport=80,
+                    protocol=Protocol.TCP,
+                    direction=TrafficDirection.INGRESS,
+                    l7=L7Type.HTTP,
+                    http=HTTPInfo(method="GET", path=path))
+
+    def dns_flow(i, qname):
+        return Flow(src_identity=web, dst_identity=dbs[i], dport=53,
+                    protocol=Protocol.UDP,
+                    direction=TrafficDirection.INGRESS,
+                    l7=L7Type.DNS, dns=DNSInfo(query=qname))
+
+    # the serving corpus: a FIXED flow universe (the capture whose
+    # rows the memo dedups) replayed after every committed update —
+    # base-rule traffic plus never-allowed probes, HTTP and DNS
+    corpus = []
+    for i in range(N_IDS):
+        for j in range(BASE_PATHS):
+            corpus.append(http_flow(i, f"/svc{i}/p{j}/x"))
+        corpus.append(http_flow(i, "/svc-other/forbidden"))
+        corpus.append(dns_flow(i, f"api{i}.corp.io"))
+        corpus.append(dns_flow(i, "evil.net"))
+    # repeat to capture-replay scale: high dedup like real traffic
+    corpus = corpus * 30   # ~4k flows, ~132 unique rows
+
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.engine.bank_size = 4       # many small banks: O(Δ) visible
+    cfg.loader.cache_dir = str(tmp_path / "cache")
+    loader = Loader(cfg)
+    loader.regenerate(resolve(), revision=1)
+    banks_t0 = sum(len(k) for k in loader._bank_plan.values())
+    compiles_t0 = loader.bank_registry.compiles
+    assert banks_t0 >= 8, "scale the policy up: too few banks"
+
+    cols = flows_to_columns(corpus)
+    replay = CaptureReplay(loader.engine, cols.l7, cols.offsets,
+                           cols.blob, cfg.engine, gen=cols.gen,
+                           loader=loader)
+    replay.stage_rows(cols.rec, cols.l7)
+    replay.stage_unique()
+
+    def session_verdicts():
+        out = replay.verdict_chunk(cols.rec, cols.l7)
+        return [int(v) for v in out["verdict"]]
+
+    def engine_verdicts(flows):
+        return [int(v) for v in
+                loader.engine.verdict_flows(flows)["verdict"]]
+
+    # warm the memo under rev 1 and pin the t0 goldens
+    base = session_verdicts()
+    assert int(Verdict.ERROR) not in base
+    assert base == engine_verdicts(corpus)
+
+    added = []          # (identity, kind, pattern) added by churn
+    update_ms = []
+    changes = 0
+    for step in range(UPDATES):
+        i = int(rng.integers(N_IDS))
+        if added and (step % 3 == 2):      # delete a churned rule
+            j = int(rng.integers(len(added)))
+            di, kind, pat = added.pop(j)
+            rules_of[di].remove((kind, pat))
+            probe = None
+        elif step % 4 == 3:                # FQDN churn
+            kind, pat = "dns", f"churn{step}.corp.io"
+            rules_of[i].append((kind, pat))
+            added.append((i, kind, pat))
+            probe = dns_flow(i, pat)
+        else:                              # CNP add (new HTTP path)
+            kind, pat = "http", f"/churn{step}/.*"
+            rules_of[i].append((kind, pat))
+            added.append((i, kind, pat))
+            probe = http_flow(i, f"/churn{step}/x")
+        changes += 1
+        t0 = time.perf_counter()
+        loader.regenerate(resolve(), revision=2 + step)
+        if probe is not None:
+            # update->enforcement: the NEW rule answers on the
+            # serving engine (readback completion-forced)
+            assert engine_verdicts([probe]) == [5]
+        update_ms.append((time.perf_counter() - t0) * 1e3)
+        # the live session follows every commit: zero ERRORs, zero
+        # stale verdicts (bit-equal to the serving engine)
+        got = session_verdicts()
+        assert int(Verdict.ERROR) not in got
+        assert got == engine_verdicts(corpus), f"stale at step {step}"
+        if step % 10 == 0 or step == UPDATES - 1:
+            # sampled ground truth: the CPU oracle agrees (one copy
+            # of the distinct flow set — the oracle is slow)
+            distinct = corpus[: len(corpus) // 30]
+            oracle = loader.fallback_engine
+            want = [int(v) for v in
+                    oracle.verdict_flows(distinct)["verdict"]]
+            assert got[: len(distinct)] == want, \
+                f"oracle mismatch at step {step}"
+
+    # -- acceptance: compile work is O(Δ), not O(policy x updates) ----
+    churn_compiles = loader.bank_registry.compiles - compiles_t0
+    assert churn_compiles >= UPDATES // 4, "churn never recompiled"
+    per_update = churn_compiles / changes
+    assert per_update <= 4.0, (
+        f"{per_update:.1f} bank compiles/update — wholesale recompile "
+        f"({banks_t0} banks at t0)")
+
+    # -- acceptance: steady-state memo hit ratio >= 0.99 --------------
+    m = replay.memo
+    assert m is not None
+    ratio = m.hits / max(1, m.hits + m.misses)
+    assert ratio >= 0.99, (
+        f"memo hit ratio {ratio:.4f} under churn "
+        f"(hits={m.hits} misses={m.misses} inval={m.invalidations})")
+
+    # -- update->enforcement latency, on a bench line ------------------
+    p99 = sorted(update_ms)[min(len(update_ms) - 1,
+                                int(0.99 * len(update_ms)))]
+    out_path = os.environ.get("CILIUM_TPU_CHURN_BENCH_OUT")
+    if out_path:
+        from cilium_tpu.runtime.provenance import stamp
+
+        line = stamp({
+            "metric": "churn_update_p99_ms",
+            "value": round(p99, 3),
+            "unit": "ms update->enforcement p99",
+            "lane": "churn",
+            "updates": UPDATES,
+            "identities": N_IDS,
+            "banks_t0": banks_t0,
+            "bank_compiles": churn_compiles,
+            "compiles_per_update": round(per_update, 3),
+            "memo_hit_ratio": round(ratio, 6),
+            "memo_invalidations": m.invalidations,
+            "p50_ms": round(sorted(update_ms)[len(update_ms) // 2], 3),
+        })
+        with open(out_path, "a") as fp:
+            fp.write(json.dumps(line) + "\n")
