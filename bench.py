@@ -636,7 +636,6 @@ def run_config(config: str, args) -> dict:
     from cilium_tpu.engine.verdict import (
         encode_flows,
         flowbatch_to_host_dict,
-        verdict_step,
     )
     from cilium_tpu.ingest import synth
     from cilium_tpu.runtime.loader import Loader
@@ -698,7 +697,10 @@ def run_config(config: str, args) -> dict:
         f"(cache dir {cfg.loader.cache_dir})")
 
     fb = encode_flows(scenario.flows, engine.policy.kafka_interns, cfg.engine)
-    step = jax.jit(verdict_step)
+    # the engine's STAGED step — the fused megakernel unless
+    # CILIUM_TPU_KERNEL_IMPL=legacy, in which case jax.jit(verdict_step)
+    # (engine/verdict.py): the device lane measures what serves
+    step = engine._step
     arrays = engine._arrays
 
     host = flowbatch_to_host_dict(fb)

@@ -281,6 +281,10 @@ RULES: Dict[str, str] = {
     "unbounded-queue": "no queue.Queue() without maxsize and no "
                        "list-as-queue append without a bound/shed "
                        "path in threaded runtime modules",
+    "pallas-block-shape": "pallas_call block shapes align to the "
+                          "(8, 128) TPU tile where literally provable, "
+                          "and every matmul inside a pallas kernel "
+                          "pins preferred_element_type",
     "obs-doc-parity": "every metric family declared in "
                       "runtime/metrics.py and every phase label "
                       "(tracing PHASE_*, engine-probe phases, capture "
@@ -334,6 +338,7 @@ def run(root: str, targets: Sequence[str] = (DEFAULT_TARGET,),
         imports,
         locks,
         obsdocs,
+        pallas_shapes,
         purity,
         queues,
         recompile,

@@ -1,0 +1,149 @@
+"""pallas-block-shape: TPU tiling hygiene for Pallas kernels.
+
+Two classes of silent Pallas performance/correctness hazards this
+codebase has now hit enough times to machine-check (the megakernel
+review found both in draft kernels):
+
+* **Misaligned block shapes** — a ``pl.BlockSpec`` whose trailing
+  block dims don't land on the (8, 128) TPU tile forces Mosaic into
+  padded/strided layouts (or compile failure on real hardware that
+  interpret-mode tests never see). Flagged when the LITERAL dims are
+  provable: the last block dim must be a multiple of 128 and the
+  second-to-last a multiple of 8 (leading size-1 dims — the "one
+  bank/tile per grid cell" idiom — are exempt, and dims written as
+  variables are not guessed at). Module-level integer constants
+  (``TILE = 1024``) resolve like literals.
+* **Unpinned accumulators** — a matmul inside a kernel body without
+  an explicit ``preferred_element_type``: TPU matmuls default to
+  bf16 accumulation, which silently rounds integer-valued lattices
+  (state ids, position counts) above 256 — the exactness bugs the
+  one-hot automaton kernels depend on avoiding. Every
+  ``jnp.dot`` / ``jnp.matmul`` / ``lax.dot_general`` / ``pl.dot``
+  reachable inside a function passed to ``pallas_call`` must pin it
+  (``precision=HIGHEST`` is NOT the same contract: it constrains the
+  multiply, not the accumulator dtype).
+
+Kernel bodies are found structurally: any function passed as the
+first argument to a ``pallas_call`` in the same module, including
+nested helper defs inside it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from cilium_tpu.analysis.callgraph import dotted
+from cilium_tpu.analysis.core import Finding, ProjectIndex, checker
+
+RULE = "pallas-block-shape"
+
+_DOT_CALLS = {"jnp.dot", "jnp.matmul", "jax.numpy.dot",
+              "jax.numpy.matmul", "lax.dot_general",
+              "jax.lax.dot_general", "pl.dot"}
+
+
+def _module_int_consts(tree: ast.AST) -> Dict[str, int]:
+    """Module-level ``NAME = <int literal>`` bindings (TILE = 1024)."""
+    out: Dict[str, int] = {}
+    for node in getattr(tree, "body", ()):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, int):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _dim_value(node: ast.AST, consts: Dict[str, int]) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+def _is_blockspec(call: ast.Call) -> bool:
+    d = dotted(call.func) or ""
+    return d.split(".")[-1] == "BlockSpec"
+
+
+def _is_pallas_call(call: ast.Call) -> bool:
+    d = dotted(call.func) or ""
+    return d.split(".")[-1] == "pallas_call"
+
+
+def _check_block_shape(call: ast.Call, consts: Dict[str, int],
+                       path: str) -> List[Finding]:
+    if not call.args or not isinstance(call.args[0], ast.Tuple):
+        return []
+    dims = [_dim_value(e, consts) for e in call.args[0].elts]
+    if len(dims) < 1:
+        return []
+    findings = []
+    last = dims[-1]
+    if last is not None and last > 1 and last % 128 != 0:
+        findings.append(Finding(
+            path, call.lineno, RULE,
+            f"BlockSpec last block dim {last} is not a multiple of "
+            f"128 — TPU lanes tile at 128; Mosaic pads or rejects "
+            f"this layout"))
+    if len(dims) >= 2:
+        second = dims[-2]
+        if second is not None and second > 1 and second % 8 != 0:
+            findings.append(Finding(
+                path, call.lineno, RULE,
+                f"BlockSpec second-to-last block dim {second} is not "
+                f"a multiple of 8 — TPU sublanes tile at 8 "
+                f"(f32); use an (8, 128)-aligned block"))
+    return findings
+
+
+def _kernel_names(tree: ast.AST) -> Dict[str, int]:
+    """Function names passed as the first arg to a pallas_call (the
+    kernel bodies), with the call line for context."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_pallas_call(node) \
+                and node.args and isinstance(node.args[0], ast.Name):
+            out.setdefault(node.args[0].id, node.lineno)
+    return out
+
+
+def _check_kernel_dots(fn: ast.FunctionDef, path: str) -> List[Finding]:
+    findings = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func) or ""
+        if d not in _DOT_CALLS and d.split(".")[-1] != "dot_general":
+            continue
+        if any(kw.arg == "preferred_element_type"
+               for kw in node.keywords):
+            continue
+        findings.append(Finding(
+            path, node.lineno, RULE,
+            f"`{d}` inside pallas kernel `{fn.name}` without "
+            f"`preferred_element_type` — TPU matmuls default to bf16 "
+            f"accumulation, silently rounding values above 256; pin "
+            f"the accumulator dtype explicitly"))
+    return findings
+
+
+@checker
+def check(index: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in index.files.values():
+        src = sf.source
+        if "pallas" not in src:
+            continue
+        consts = _module_int_consts(sf.tree)
+        kernels = _kernel_names(sf.tree)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) and _is_blockspec(node):
+                findings.extend(_check_block_shape(node, consts,
+                                                   sf.path))
+            elif isinstance(node, ast.FunctionDef) \
+                    and node.name in kernels:
+                findings.extend(_check_kernel_dots(node, sf.path))
+    return findings

@@ -96,10 +96,24 @@ def find_entries(project: Project) -> List[Tuple[ModuleInfo, ast.AST]]:
                              and not q.endswith(".shard_map")):
                 continue
             arg = node.args[0]
+            # jit(partial(fn, ...)) binds statics around a real entry —
+            # unwrap to the inner function (the megakernel's staged
+            # step and the impl-threaded staging scans jit this way)
+            if isinstance(arg, ast.Call) and arg.args \
+                    and mi.qualify(arg.func) in ("functools.partial",
+                                                 "partial"):
+                arg = arg.args[0]
             if isinstance(arg, ast.Name):
                 resolved = project.resolve_function(mi, arg.id)
                 if resolved is not None:
                     add(*resolved)
+            elif isinstance(arg, ast.Attribute):
+                # module-qualified entry (`_mk.fused_verdict_step`)
+                q2 = mi.qualify(arg) or dotted(arg) or ""
+                owner, _, attr = q2.rpartition(".")
+                target = project.modules.get(owner)
+                if target is not None and attr in target.functions:
+                    add(target, target.functions[attr])
             elif isinstance(arg, (ast.FunctionDef, ast.Lambda)):
                 add(mi, arg)
     return entries

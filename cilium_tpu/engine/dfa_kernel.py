@@ -1,4 +1,4 @@
-"""Batched DFA byte-scan — the L7 automaton kernel.
+"""Batched DFA byte-scan — the dense-gather L7 automaton kernel.
 
 The TPU replacement for the reference's per-request regex scans
 (SURVEY.md §3.4: "per-request × per-rule scan is exactly what the batched
@@ -15,6 +15,14 @@ automaton pass replaces"). Design notes:
 * Banks are vmapped: ``[n_banks, S, K]`` tables, one shared input batch.
   Banks are also the EP (expert-parallel) shard unit
   (``cilium_tpu.parallel``).
+* This is the ``dfa-dense`` arm of the megakernel's per-bank-shape
+  autotuner (``engine/megakernel.py``); the ``nfa-bitset``
+  rules-as-lanes arm lives in ``engine/nfa_kernel.py``.
+
+Implementation choice is a TRACE-STATIC argument: callers resolve it
+once on the host (``resolve_impl()`` reads the env; the engine does it
+at staging) and thread it through — nothing here reads the
+environment or probes the backend under trace.
 """
 
 from __future__ import annotations
@@ -26,8 +34,11 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _default_impl() -> str:
-    """Step-implementation default.
+def resolve_impl(env=None) -> str:
+    """HOST-side step-implementation resolution — call once at
+    engine/bank staging and thread the result as a static argument
+    (never under trace: flipping the env between traces would
+    otherwise be an invisible recompile lever).
 
     Honest TPU numbers (measured in a clean process with distinct
     host-staged input buffers and zero device→host readbacks — earlier
@@ -46,13 +57,10 @@ def _default_impl() -> str:
     """
     import os
 
-    # trace-time STATIC config: the env pick selects which step gets
-    # compiled (same role as a static_argname), it never runs per
-    # batch — flipping the env between traces recompiles, by design
-    # ctlint: disable=jit-purity  # static impl selection at trace time
-    env = os.environ.get("CILIUM_TPU_DFA_IMPL", "")
-    if env in ("gather", "onehot", "pallas"):
-        return env
+    env = os.environ if env is None else env
+    pick = env.get("CILIUM_TPU_DFA_IMPL", "")
+    if pick in ("gather", "onehot", "pallas"):
+        return pick
     return "gather"
 
 
@@ -66,10 +74,11 @@ def dfa_scan(
 ) -> jax.Array:
     """Run the DFA over each row of ``data``; returns final states [B].
 
-    ``impl``: "gather" (one gather per step) or "onehot" (two f32
-    matmuls per step — exact for state ids < 2^24, MXU-friendly).
+    ``impl``: "gather" (one gather per step; the default) or "onehot"
+    (two f32 matmuls per step — exact for state ids < 2^24,
+    MXU-friendly). A trace-static choice; None means "gather".
     """
-    impl = impl or _default_impl()
+    impl = impl or "gather"
     if impl == "pallas":
         impl = "gather"  # single-bank path: pallas handled in banked entry
     if impl not in ("gather", "onehot"):
@@ -127,6 +136,47 @@ def _accept_rows(accept: jax.Array, finals: jax.Array,
     return out
 
 
+def dfa_finals_banked(
+    trans: jax.Array,       # [NB, S, K] int32
+    byteclass: jax.Array,   # [NB, 256] int32
+    start: jax.Array,       # [NB] int32
+    data: jax.Array,        # [B, L]
+    lengths: jax.Array,     # [B]
+    impl: Optional[str] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Final DFA states for every (bank, flow) → [NB, B] int32; the
+    accept-table reads layer on top (``dfa_scan_banked``)."""
+    impl = impl or "gather"
+    if impl == "pallas":
+        from cilium_tpu.engine import pallas_dfa
+
+        # ctlint: disable=recompile-hazard  # impl pick per bank shape is a trace-time static choice, by design
+        if pallas_dfa.pallas_supported(trans.shape):
+            if interpret is None:
+                interpret = pallas_dfa.use_interpret()
+            return pallas_dfa.dfa_finals_pallas(
+                trans, byteclass, start, data, lengths,
+                interpret=interpret)
+        # pallas is an explicit opt-in for its input-independent
+        # timing guarantee; degrading to the data-dependent gather
+        # must be loud, not silent
+        import warnings
+
+        warnings.warn(
+            f"CILIUM_TPU_DFA_IMPL=pallas requested but a bank has "
+            f"{trans.shape[1]} states (limit "
+            f"{pallas_dfa.MAX_STATES}); falling back to the "
+            f"data-dependent 'gather' path — the constant-time "
+            f"guarantee does NOT hold. Compile with a smaller "
+            f"bank_size to keep it.",
+            RuntimeWarning, stacklevel=2)
+        impl = "gather"
+    return jax.vmap(
+        lambda tr, bc, st: dfa_scan(tr, bc, st, data, lengths, impl=impl)
+    )(trans, byteclass, start)              # [NB, B]
+
+
 def dfa_scan_banked(
     trans: jax.Array,       # [NB, S, K] int32
     byteclass: jax.Array,   # [NB, 256] int32
@@ -135,44 +185,32 @@ def dfa_scan_banked(
     data: jax.Array,        # [B, L]
     lengths: jax.Array,     # [B]
     impl: Optional[str] = None,
-) -> jax.Array:
-    """All banks over one batch → accept words ``[B, NB, W]`` uint32."""
-    impl = impl or _default_impl()
-    if impl == "pallas":
-        from cilium_tpu.engine import pallas_dfa
+    interpret: Optional[bool] = None,
+    extra_accept: Optional[jax.Array] = None,
+):
+    """All banks over one batch → accept words ``[B, NB, W]`` uint32.
 
-        # ctlint: disable=recompile-hazard  # impl pick per bank shape is a trace-time static choice, by design
-        if pallas_dfa.pallas_supported(trans.shape):
-            finals = pallas_dfa.dfa_finals_pallas(
-                trans, byteclass, start, data, lengths,
-                interpret=pallas_dfa.use_interpret())
-            impl = "gather"  # accept-word extraction below
-        else:
-            # pallas is an explicit opt-in for its input-independent
-            # timing guarantee; degrading to the data-dependent gather
-            # must be loud, not silent
-            import warnings
+    ``impl``/``interpret`` are trace-static (resolve on the host via
+    :func:`resolve_impl`; None = "gather" / backend-probe fallback for
+    direct callers). ``extra_accept`` ([NB, S, Wg]) reads a second
+    accept plane off the same final states — the megakernel's
+    group-accept tables (one extra gather, no second scan) — and makes
+    the return a ``(words, extra_words)`` tuple."""
+    impl = impl or "gather"
+    finals = dfa_finals_banked(trans, byteclass, start, data, lengths,
+                               impl=impl, interpret=interpret)
+    word_impl = "gather" if impl == "pallas" else impl
 
-            warnings.warn(
-                f"CILIUM_TPU_DFA_IMPL=pallas requested but a bank has "
-                f"{trans.shape[1]} states (limit "
-                f"{pallas_dfa.MAX_STATES}); falling back to the "
-                f"data-dependent 'gather' path — the constant-time "
-                f"guarantee does NOT hold. Compile with a smaller "
-                f"bank_size to keep it.",
-                RuntimeWarning, stacklevel=2)
-            impl = "gather"
-            finals = None
-    else:
-        finals = None
-    if finals is None:
-        finals = jax.vmap(
-            lambda tr, bc, st: dfa_scan(tr, bc, st, data, lengths, impl=impl)
-        )(trans, byteclass, start)          # [NB, B]
-    words = jax.vmap(
-        lambda acc, fs: _accept_rows(acc, fs, impl)
-    )(accept, finals)                       # [NB, B, W]
-    return jnp.transpose(words, (1, 0, 2))  # [B, NB, W]
+    def extract(acc):
+        words = jax.vmap(
+            lambda a, fs: _accept_rows(a, fs, word_impl)
+        )(acc, finals)                      # [NB, B, W]
+        return jnp.transpose(words, (1, 0, 2))  # [B, NB, W]
+
+    words = extract(accept)
+    if extra_accept is None:
+        return words
+    return words, extract(extra_accept)
 
 
 def match_bits(words: jax.Array) -> jax.Array:

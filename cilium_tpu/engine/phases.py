@@ -8,27 +8,44 @@ batch through separately-jitted sub-steps, each ending in a forced
 ``block_until_ready`` is not a reliable completion barrier on the
 tunneled platform):
 
-=============  ==========================================================
-``featurize``  host encode: flows → packed numpy batch
-``h2d``        host→device transfer of the packed batch, completion-forced
-``mapstate``   the L3/L4 mapstate gather (``mapstate_kernel``)
-``dfa-scan``   the five per-field banked DFA scans (live path), or
-``gather``     the staged-table match-word gathers (capture path)
-``resolve``    per-rule conjunction → ruleset-any → priority/auth/audit
-``compile``    first-call cost minus steady-state (the compile half of
-               the compile-vs-execute split)
-``execute``    steady-state fused-step wall (the execute half)
-=============  ==========================================================
+=================  ======================================================
+``featurize``      host encode: flows → packed numpy batch
+``h2d``            host→device transfer of the packed batch, forced
+``mapstate``       the L3/L4 mapstate gather (``mapstate_kernel``)
+``dfa-scan``       the five per-field banked DFA scans (live path), or
+``gather``         the staged-table match-word gathers (capture path)
+``resolve``        per-rule conjunction → ruleset-any → priority/auth/
+                   audit (the LEGACY formulation — the three-op
+                   baseline the megakernel is judged against)
+``fused-verdict``  the engine's staged megakernel step
+                   (``engine/megakernel.py``): mapstate + scans +
+                   factored resolve in ONE device dispatch, where the
+                   three rows above are three
+``dfa-dense``      the planned fields' scans through the dense-gather
+                   DFA arm, per the engine's kernel plan
+``nfa-bitset``     the planned fields' scans through the bitset-NFA
+                   rules-as-lanes arm (only reported when the plan
+                   uses it)
+``compile``        first-call cost minus steady-state (the compile
+                   half of the compile-vs-execute split)
+``execute``        steady-state fused-step wall (the execute half)
+=================  ======================================================
 
 Coverage contract: ``attributed / wall``. Sub-steps jitted separately
 forgo cross-phase fusion, so the device-side decomposition sums to
 ≥ the fused step on every platform measured — a coverage below ~0.9
 means a phase is MISSING from the decomposition, which is exactly what
-the number exists to catch. Results feed the flight recorder
-(``runtime/tracing.py`` spans under an ``engine.phase_probe`` root)
-and the ``cilium_tpu_engine_phase_seconds{phase=...}`` family — and the
-bench artifacts, where ROADMAP's open perf items (zero-copy ingest,
-megakernel, multichip) will be judged against them.
+the number exists to catch. (With the megakernel staged, wall is the
+ONE-dispatch fused step, so coverage well above 1 is the speedup
+showing.) ``three_op_ms``/``fused_ms``/``fused_speedup`` on the
+report carry the dispatch-count story explicitly:
+``three_op_dispatches`` is 3 (mapstate, scan, resolve, each
+completion-forced), ``fused_dispatches`` is 1. Results feed the
+flight recorder (``runtime/tracing.py`` spans under an
+``engine.phase_probe`` root) and the
+``cilium_tpu_engine_phase_seconds{phase=...}`` family — and the bench
+artifacts, where ROADMAP's open perf items (megakernel, multichip)
+are judged against them.
 
 This is an inspection instrument, not a hot-path layer: nothing here
 runs per request.
@@ -53,8 +70,6 @@ from cilium_tpu.engine.verdict import (
     encode_flows,
     flowbatch_to_host_dict,
     unpack_batch,
-    verdict_step,
-    verdict_step_capture,
 )
 from cilium_tpu.runtime.metrics import ENGINE_PHASE_SECONDS, METRICS
 from cilium_tpu.runtime.tracing import PHASE_DEVICE, PHASE_HOST, TRACER
@@ -62,6 +77,7 @@ from cilium_tpu.runtime.tracing import PHASE_DEVICE, PHASE_HOST, TRACER
 #: phase label values the probes emit (obs-doc-parity: each must be
 #: documented in docs/OBSERVABILITY.md)
 ENGINE_PHASES = ("featurize", "h2d", "mapstate", "dfa-scan", "resolve",
+                 "fused-verdict", "dfa-dense", "nfa-bitset",
                  "compile", "execute")
 CAPTURE_PHASES = ("gather", "mapstate", "resolve")
 
@@ -213,16 +229,46 @@ def _record(report: Dict, reps: int) -> None:
                                 labels={"phase": phase})
 
 
+def _impl_scan(arrays, batch, impl_plan, wanted: str,
+               dfa_impl: str, interpret: bool):
+    """Scan only the fields the engine's kernel plan runs through
+    ``wanted`` — the per-impl attribution lanes (dfa-dense /
+    nfa-bitset phase labels)."""
+    from cilium_tpu.engine.megakernel import SCAN_FIELDS, fused_scan_field
+
+    b = _unpacked(batch)
+    impls = dict(impl_plan)
+    out = []
+    for prefix, field in SCAN_FIELDS:
+        if impls.get(prefix, "dfa-dense") != wanted:
+            continue
+        w, _ = fused_scan_field(
+            arrays, prefix, *batch_field(b, field), impl=wanted,
+            dfa_impl=dfa_impl, interpret=interpret)
+        out.append(w)
+    return tuple(out)
+
+
+#: jitted once at module scope — per-call wrapping would churn the jit
+#: cache (the recompile-hazard rule's own lesson)
+_IMPL_SCAN = jax.jit(_impl_scan, static_argnums=(2, 3, 4, 5))
+
+
 class EnginePhaseProbe:
     """Per-phase attribution of the LIVE verdict path (featurize →
-    h2d → mapstate → dfa-scan → resolve) for one engine."""
+    h2d → mapstate → dfa-scan → resolve, plus the fused megakernel
+    step and its per-impl scan lanes) for one engine."""
 
     def __init__(self, engine):
         self.engine = engine
         self._ms = jax.jit(_live_mapstate)
         self._scan = jax.jit(_live_scan)
         self._resolve = jax.jit(_live_resolve)
-        self._full = jax.jit(verdict_step)
+        # the engine's STAGED step (the fused megakernel unless the
+        # engine was built legacy) — the wall the decomposition covers
+        self._full = engine._step
+        self._impl_plan = tuple(sorted(
+            getattr(engine, "impl_plan", {}).items()))
 
     def measure_flows(self, flows: Sequence, cfg=None, reps: int = 5
                       ) -> Dict:
@@ -261,10 +307,33 @@ class EnginePhaseProbe:
         full_s, full_first, _ = _timed(
             lambda: self._full(arrays, batch), reps)
 
+        # the three-op baseline the megakernel replaces: mapstate →
+        # scan → resolve as three completion-forced device dispatches
+        # (the pre-fused execution shape, HBM round-trips included)
+        def three_op():
+            m = self._ms(arrays, batch)
+            _force(m)
+            w = self._scan(arrays, batch)
+            _force(w)
+            return self._resolve(arrays, m, w, batch)
+
+        three_s, _, _ = _timed(three_op, reps)
+
         phases_ms = {"h2d": round(h2d_s * 1e3, 3),
                      "mapstate": round(ms_s * 1e3, 3),
                      "dfa-scan": round(scan_s * 1e3, 3),
-                     "resolve": round(res_s * 1e3, 3)}
+                     "resolve": round(res_s * 1e3, 3),
+                     "fused-verdict": round(full_s * 1e3, 3)}
+        # per-impl scan lanes, per the engine's kernel plan
+        for impl in sorted({v for _, v in self._impl_plan} or
+                           {"dfa-dense"}):
+            impl_s, _, _ = _timed(
+                lambda: _IMPL_SCAN(
+                    arrays, batch, self._impl_plan, impl,
+                    getattr(self.engine, "_dfa_impl", "gather"),
+                    getattr(self.engine, "_interpret", True)),
+                reps)
+            phases_ms[impl] = round(impl_s * 1e3, 3)
         attributed = (ms_s + scan_s + res_s) * 1e3
         report = {
             "batch": int(len(host_batch["scalars"])),
@@ -274,6 +343,14 @@ class EnginePhaseProbe:
             "coverage": round(attributed / max(full_s * 1e3, 1e-9), 4),
             "compile_ms": round(max(0.0, full_first - full_s) * 1e3, 3),
             "execute_ms": round(full_s * 1e3, 3),
+            # the dispatch-count story the megakernel exists for: ONE
+            # device dispatch where the baseline pays three
+            "fused_ms": round(full_s * 1e3, 3),
+            "fused_dispatches": 1,
+            "three_op_ms": round(three_s * 1e3, 3),
+            "three_op_dispatches": 3,
+            "fused_speedup": round(three_s / max(full_s, 1e-9), 3),
+            "impl_plan": dict(self._impl_plan),
         }
         if not _defer_record:
             _record(report, reps)
@@ -290,7 +367,9 @@ class CapturePhaseProbe:
         self._gather = jax.jit(_cap_gather)
         self._ms = jax.jit(_cap_mapstate)
         self._resolve = jax.jit(_cap_resolve)
-        self._full = jax.jit(verdict_step_capture)
+        # the session's staged step (fused when the policy carries a
+        # resolve plan) — the wall the decomposition covers
+        self._full = replay._step
 
     def measure(self, start: int = 0, n: Optional[int] = None,
                 reps: int = 5, authed_pairs=None) -> Dict:

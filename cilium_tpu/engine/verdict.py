@@ -19,6 +19,7 @@ Verdict codes follow flowpb: FORWARDED=1, DROPPED=2, REDIRECTED=5
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -212,6 +213,15 @@ class CompiledPolicy:
     #: non-empty marks the policy DEGRADED: never cached, never warm-
     #: snapshotted, commits a full invalidation delta
     bank_quarantined: Tuple[str, ...] = ()
+    #: host-side metadata of the factored resolve plan
+    #: (engine/megakernel.py): group count + the path-lane → group
+    #: mapping the NFA arm's group plane derives from. None when the
+    #: grouping degenerated (fused step falls back to legacy resolve).
+    resolve_meta: Optional[Dict] = None
+    #: field → scan-impl pick ("dfa-dense" / "nfa-bitset"), written at
+    #: engine staging by the per-bank-shape autotuner; rides the
+    #: policy object into bank_status and the bench lines
+    kernel_plan: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     @classmethod
     def build(
@@ -507,6 +517,19 @@ class CompiledPolicy:
                 bank_plan[st.field] = st.bank_keys
                 bank_quarantined.extend(st.quarantined)
 
+        # factored resolve plan (engine/megakernel.py): rule-signature
+        # groups + group-accept planes over the path automaton — the
+        # rp_* arrays stage to device with everything else; the fused
+        # step falls back to the legacy per-rule resolve when absent
+        from cilium_tpu.engine import megakernel as _mk
+
+        resolve_meta = None
+        plan = _mk.build_resolve_plan(arrays, len(http_rules),
+                                      len(dns_rules))
+        if plan is not None:
+            rp_arrays, resolve_meta = plan
+            arrays.update(rp_arrays)
+
         return cls(
             mapstate=packed,
             arrays=arrays,
@@ -527,6 +550,7 @@ class CompiledPolicy:
             header_rewrites=header_rewrites,
             bank_plan=bank_plan,
             bank_quarantined=tuple(bank_quarantined),
+            resolve_meta=resolve_meta,
         )
 
 
@@ -954,7 +978,9 @@ _TABLE_FIELDS = (("path", "path"), ("method", "method"),
 
 
 def _stage_tables_step(arrays: Dict[str, jax.Array],
-                       tables: Dict[str, tuple]
+                       tables: Dict[str, tuple],
+                       impl: str = "gather",
+                       interpret: Optional[bool] = None
                        ) -> Dict[str, jax.Array]:
     """All five per-field table scans as ONE traced program. Fusing
     them matters twice over: one dispatch instead of ~40 eager ops per
@@ -963,20 +989,42 @@ def _stage_tables_step(arrays: Dict[str, jax.Array],
     compilation cache's min-compile-time bar — a fresh process restages
     a repeat capture shape from disk in milliseconds instead of
     recompiling five sub-threshold programs (~2s, the dominant
-    stage_ms phase of the tier-1 CPU config)."""
+    stage_ms phase of the tier-1 CPU config).
+
+    With a factored resolve plan staged (``rp_path_gaccept``,
+    engine/megakernel.py) the path table also emits per-row GROUP
+    words — a second accept read off the same final states, bank-ORed
+    into the ``"path_groups"`` table the fused capture resolve
+    gathers. ``impl``/``interpret`` are trace-static (the engine
+    resolves them at staging; see dfa_kernel.resolve_impl)."""
     tw: Dict[str, jax.Array] = {}
     for field, prefix in _TABLE_FIELDS:
         data, lens, valid = tables[field]
-        words = dfa_scan_banked(
+        want_groups = field == "path" and "rp_path_gaccept" in arrays
+        out = dfa_scan_banked(
             arrays[f"{prefix}_trans"], arrays[f"{prefix}_byteclass"],
             arrays[f"{prefix}_start"], arrays[f"{prefix}_accept"],
-            data, lens)
+            data, lens, impl=impl, interpret=interpret,
+            extra_accept=(arrays["rp_path_gaccept"] if want_groups
+                          else None))
+        if want_groups:
+            words, gw3 = out
+            gwords = jax.lax.reduce(gw3, jnp.uint32(0),
+                                    jax.lax.bitwise_or, (1,))
+            tw["path_groups"] = jnp.where(valid[:, None], gwords, 0)
+        else:
+            words = out
         flat = words.reshape(data.shape[0], -1)
         tw[field] = jnp.where(valid[:, None], flat, 0)
     return tw
 
 
-_STAGE_TABLES = jax.jit(_stage_tables_step)
+@functools.lru_cache(maxsize=8)
+def _stage_tables_jit(impl: str, interpret: Optional[bool]):
+    """One jitted staging program per (impl, interpret) static pair —
+    the env/backend picks resolve on the host, never under trace."""
+    return jax.jit(functools.partial(_stage_tables_step, impl=impl,
+                                     interpret=interpret))
 
 from cilium_tpu.engine.memo import memo_pack as _memo_pack  # noqa: E402
 
@@ -998,7 +1046,9 @@ def stage_capture_tables(engine: "VerdictEngine",
     compile."""
     tables = {field: jax.device_put(feat.tables[field], engine.device)
               for field, _ in _TABLE_FIELDS}
-    return _STAGE_TABLES(engine._arrays, tables)
+    step = _stage_tables_jit(getattr(engine, "_dfa_impl", "gather"),
+                             getattr(engine, "_interpret", None))
+    return step(engine._arrays, tables)
 
 
 def verdict_step_capture(arrays: Dict[str, jax.Array],
@@ -1052,10 +1102,19 @@ def verdict_step_capture(arrays: Dict[str, jax.Array],
     # ctlint: disable=recompile-hazard  # row width is static per capture layout: one compile per layout, by design
     gen_cols = ((rows[:, n], rows[:, n + 1:])
                 if rows.shape[1] > n else None)
+    kafka_cols = (c("kafka_api_key"), c("kafka_api_version"),
+                  c("kafka_client"), c("kafka_topic"))
+    if "rp_g_method" in arrays and "path_groups" in table_words:
+        # factored resolve (megakernel): the staged path table carries
+        # per-row GROUP words; replay gathers them like any match word
+        from cilium_tpu.engine import megakernel as _mk
+
+        gwords = table_words["path_groups"][c("path_row")]
+        return _mk.fused_verdict_core(
+            arrays, ms, c("l7_types"), words, gwords, kafka_cols,
+            (src, dst), batch, gen_cols=gen_cols)
     return _verdict_core(
-        arrays, ms, c("l7_types"), words,
-        (c("kafka_api_key"), c("kafka_api_version"),
-         c("kafka_client"), c("kafka_topic")),
+        arrays, ms, c("l7_types"), words, kafka_cols,
         (src, dst), batch, gen_cols=gen_cols)
 
 
@@ -1162,13 +1221,104 @@ def unpack_batch(packed: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
     return out
 
 
+def _l7_kafka(arrays, ruleset, kafka_cols, l7t):
+    """Kafka columnar exact/set matching → ruleset-any [B] bool.
+    Shared verbatim by the legacy and fused (megakernel) resolves."""
+    k_api, k_ver, k_cli, k_top = kafka_cols
+    ak = jnp.clip(k_api, 0, 31).astype(jnp.uint32)
+    am = arrays["kafka_apikey_mask"][None, :]        # [1, Rk]
+    # api_key < 0 is the unknown-role sentinel (flowpb decode): it
+    # matches only api-key-unconstrained rules — the clip alone would
+    # collapse it onto 0/produce and falsely match produce ACLs
+    k_ok = (
+        ((am == 0) | (((am >> ak[:, None]) & jnp.uint32(1)).astype(bool)
+                      & (k_api >= 0)[:, None]))
+        & ((arrays["kafka_version"][None, :] < 0)
+           | (arrays["kafka_version"][None, :] == k_ver[:, None]))
+        & ((arrays["kafka_client"][None, :] < 0)
+           | (arrays["kafka_client"][None, :] == k_cli[:, None]))
+        & ((arrays["kafka_topic"][None, :] < 0)
+           | (arrays["kafka_topic"][None, :] == k_top[:, None]))
+    )
+    kafka_mask = arrays["rs_kafka_mask"][ruleset]
+    k_words = _bools_to_words(k_ok, kafka_mask.shape[1])
+    return (jnp.any((k_words & kafka_mask) != 0, axis=1)
+            & (l7t == int(L7Type.KAFKA)))
+
+
+def _l7_generic(arrays, ruleset, gen_cols, l7t):
+    """Generic l7proto pair-subset matching → ruleset-any [B] bool.
+    Shared verbatim by the legacy and fused resolves."""
+    gen_proto, gen_pairs = gen_cols
+    grp = arrays["gen_rule_pairs"]              # [Rg, Km]
+    have = jnp.any(
+        gen_pairs[:, None, None, :] == grp[None, :, :, None],
+        axis=-1)                                # [B, Rg, Km]
+    pair_ok = jnp.all(jnp.where(grp[None, :, :] < 0, True, have),
+                      axis=-1)
+    proto_ok = (arrays["gen_rule_proto"][None, :]
+                == gen_proto[:, None])          # [B, Rg]
+    g_ok = pair_ok & proto_ok & (arrays["gen_rule_proto"] >= 0)[None, :]
+    gen_mask = arrays["rs_gen_mask"][ruleset]
+    g_words = _bools_to_words(g_ok, gen_mask.shape[1])
+    return (jnp.any((g_words & gen_mask) != 0, axis=1)
+            & (l7t == int(L7Type.GENERIC)))
+
+
+def _assemble_verdict(arrays, ms, l7_ok, l7_log_http, auth_src_dst,
+                      batch):
+    """Precedence + auth + audit assembly → the output dict. ONE
+    implementation for every resolve path (legacy, fused, capture) so
+    none can drift on the verdict-code semantics."""
+    allowed = ms["allowed"] & (l7_ok | ~ms["redirect"])
+    auth_required = ms["auth_required"]
+    if "auth_pairs" in batch:  # static key check: enforcement staged
+        # drop-until-authed (the reference's auth map): a winning allow
+        # that demands auth forwards only if (src, dst) completed the
+        # handshake. Pairs ride a lex-sorted [P, 2] int32 table
+        # (two words, not a packed int64 — x64 is disabled under jax).
+        src, dst = auth_src_dst
+        pairs = batch["auth_pairs"]
+        _, authed = lower_bound((pairs[:, 0], pairs[:, 1]), (src, dst))
+        allowed = allowed & (~auth_required | authed)
+    # policy_audit_mode: a would-be denial forwards with verdict AUDIT.
+    # Per FLOW: the global scalar (device-staged — no recompile when
+    # the mode flips) ORs with the owning endpoint's audit bit from
+    # the enforcement table (reference: per-endpoint PolicyAuditMode —
+    # one namespace can audit a new policy while the fleet enforces)
+    audit = ms.get("audit", jnp.zeros_like(ms["allowed"]))
+    if "audit_mode" in arrays:
+        audit = audit | arrays["audit_mode"]
+    deny_code = jnp.where(audit, int(Verdict.AUDIT),
+                          int(Verdict.DROPPED)).astype(jnp.int32)
+    verdict = jnp.where(
+        allowed,
+        jnp.where(ms["redirect"], int(Verdict.REDIRECTED),
+                  int(Verdict.FORWARDED)),
+        deny_code,
+    ).astype(jnp.int32)
+    return {
+        "verdict": verdict,
+        "allowed": allowed,
+        "l3l4_allowed": ms["allowed"],
+        "redirect": ms["redirect"],
+        "l7_ok": l7_ok,
+        "l7_log": l7_log_http & allowed & ms["redirect"],
+        "match_spec": ms["match_spec"],
+        "ruleset": ms["ruleset"],
+        "auth_required": ms["auth_required"],
+    }
+
+
 def _verdict_core(arrays, ms, l7t, words, kafka_cols, auth_src_dst,
                   batch, gen_cols=None):
     """Shared back half of :func:`verdict_step` and
     :func:`verdict_step_capture`: per-family rule conjunctions →
     ruleset-any → precedence + auth + audit assembly. Keeping it in
     ONE place is what guarantees capture replay and live verdicts
-    cannot drift.
+    cannot drift. (The megakernel's factored resolve
+    (``engine/megakernel.py``) replaces only the HTTP/DNS conjunction
+    halves; kafka/generic and the assembly are these same helpers.)
 
     ``words`` = (path_w, method_w, host_w, hdr_w, dns_w) match-word
     tensors; ``kafka_cols`` = (api_key, api_version, client, topic)
@@ -1218,27 +1368,7 @@ def _verdict_core(arrays, ms, l7t, words, kafka_cols, auth_src_dst,
     else:
         l7_log_http = jnp.zeros_like(http_ok)
 
-    # Kafka: columnar exact/set matching
-    k_api, k_ver, k_cli, k_top = kafka_cols
-    ak = jnp.clip(k_api, 0, 31).astype(jnp.uint32)
-    am = arrays["kafka_apikey_mask"][None, :]        # [1, Rk]
-    # api_key < 0 is the unknown-role sentinel (flowpb decode): it
-    # matches only api-key-unconstrained rules — the clip alone would
-    # collapse it onto 0/produce and falsely match produce ACLs
-    k_ok = (
-        ((am == 0) | (((am >> ak[:, None]) & jnp.uint32(1)).astype(bool)
-                      & (k_api >= 0)[:, None]))
-        & ((arrays["kafka_version"][None, :] < 0)
-           | (arrays["kafka_version"][None, :] == k_ver[:, None]))
-        & ((arrays["kafka_client"][None, :] < 0)
-           | (arrays["kafka_client"][None, :] == k_cli[:, None]))
-        & ((arrays["kafka_topic"][None, :] < 0)
-           | (arrays["kafka_topic"][None, :] == k_top[:, None]))
-    )
-    kafka_mask = arrays["rs_kafka_mask"][ruleset]
-    k_words = _bools_to_words(k_ok, kafka_mask.shape[1])
-    kafka_ok = (jnp.any((k_words & kafka_mask) != 0, axis=1)
-                & (l7t == int(L7Type.KAFKA)))
+    kafka_ok = _l7_kafka(arrays, ruleset, kafka_cols, l7t)
 
     # DNS: qname automaton
     d_ok = (_rule_bit(dns_w, arrays["dns_lane"])
@@ -1254,59 +1384,10 @@ def _verdict_core(arrays, ms, l7t, words, kafka_cols, auth_src_dst,
 
     if gen_cols is not None:
         # generic l7proto records: pair-subset matching
-        gen_proto, gen_pairs = gen_cols
-        grp = arrays["gen_rule_pairs"]              # [Rg, Km]
-        have = jnp.any(
-            gen_pairs[:, None, None, :] == grp[None, :, :, None],
-            axis=-1)                                # [B, Rg, Km]
-        pair_ok = jnp.all(jnp.where(grp[None, :, :] < 0, True, have),
-                          axis=-1)
-        proto_ok = (arrays["gen_rule_proto"][None, :]
-                    == gen_proto[:, None])          # [B, Rg]
-        g_ok = pair_ok & proto_ok & (arrays["gen_rule_proto"] >= 0)[None, :]
-        gen_mask = arrays["rs_gen_mask"][ruleset]
-        g_words = _bools_to_words(g_ok, gen_mask.shape[1])
-        l7_ok = l7_ok | (jnp.any((g_words & gen_mask) != 0, axis=1)
-                         & (l7t == int(L7Type.GENERIC)))
+        l7_ok = l7_ok | _l7_generic(arrays, ruleset, gen_cols, l7t)
 
-    allowed = ms["allowed"] & (l7_ok | ~ms["redirect"])
-    auth_required = ms["auth_required"]
-    if "auth_pairs" in batch:  # static key check: enforcement staged
-        # drop-until-authed (the reference's auth map): a winning allow
-        # that demands auth forwards only if (src, dst) completed the
-        # handshake. Pairs ride a lex-sorted [P, 2] int32 table
-        # (two words, not a packed int64 — x64 is disabled under jax).
-        src, dst = auth_src_dst
-        pairs = batch["auth_pairs"]
-        _, authed = lower_bound((pairs[:, 0], pairs[:, 1]), (src, dst))
-        allowed = allowed & (~auth_required | authed)
-    # policy_audit_mode: a would-be denial forwards with verdict AUDIT.
-    # Per FLOW: the global scalar (device-staged — no recompile when
-    # the mode flips) ORs with the owning endpoint's audit bit from
-    # the enforcement table (reference: per-endpoint PolicyAuditMode —
-    # one namespace can audit a new policy while the fleet enforces)
-    audit = ms.get("audit", jnp.zeros_like(ms["allowed"]))
-    if "audit_mode" in arrays:
-        audit = audit | arrays["audit_mode"]
-    deny_code = jnp.where(audit, int(Verdict.AUDIT),
-                          int(Verdict.DROPPED)).astype(jnp.int32)
-    verdict = jnp.where(
-        allowed,
-        jnp.where(ms["redirect"], int(Verdict.REDIRECTED),
-                  int(Verdict.FORWARDED)),
-        deny_code,
-    ).astype(jnp.int32)
-    return {
-        "verdict": verdict,
-        "allowed": allowed,
-        "l3l4_allowed": ms["allowed"],
-        "redirect": ms["redirect"],
-        "l7_ok": l7_ok,
-        "l7_log": l7_log_http & allowed & ms["redirect"],
-        "match_spec": ms["match_spec"],
-        "ruleset": ms["ruleset"],
-        "auth_required": ms["auth_required"],
-    }
+    return _assemble_verdict(arrays, ms, l7_ok, l7_log_http,
+                             auth_src_dst, batch)
 
 
 #: transfer order of the single-blob service transport (pack_blob_host
@@ -1475,18 +1556,55 @@ class _StagePhase:
 
 
 class VerdictEngine:
-    """Jitted wrapper around :func:`verdict_step` for a CompiledPolicy."""
+    """Jitted wrapper around the verdict step for a CompiledPolicy.
 
-    def __init__(self, policy: CompiledPolicy, device=None):
+    By default the step is the fused megakernel
+    (``engine/megakernel.fused_verdict_step``): one device dispatch
+    for mapstate gather + byte-scans + factored priority resolve,
+    with the scan impl picked per bank shape at staging and recorded
+    on ``policy.kernel_plan``. ``cfg.kernel_impl="legacy"`` (or a
+    policy whose resolve plan degenerated) reverts to the unfused
+    :func:`verdict_step` — bit-equal either way."""
+
+    def __init__(self, policy: CompiledPolicy, device=None,
+                 cfg: Optional[EngineConfig] = None):
+        from cilium_tpu.engine import megakernel as _mk
+        from cilium_tpu.engine.dfa_kernel import resolve_impl
+
         self.policy = policy
         self.device = device
+        self.cfg = cfg or EngineConfig()
+        #: trace-static scan choices, resolved ONCE here on the host
+        #: (never under trace — the ctlint jit-purity contract)
+        self._dfa_impl = resolve_impl()
+        self._interpret = jax.default_backend() != "tpu"
         self._arrays = {
             k: jax.device_put(v, device) for k, v in policy.arrays.items()
         }
         #: True when some staged entry demands authentication — when
         #: False, callers skip staging the authed-pairs table
         self.needs_auth = bool(np.any(policy.arrays["ms_auth"]))
-        self._step = jax.jit(verdict_step)
+        #: field → scan impl of the staged step ({} on the legacy path)
+        self.impl_plan: Dict[str, str] = {}
+        #: per-field autotune report (impl, timings, shapes)
+        self.kernel_report: Dict[str, Dict] = {}
+        mode = getattr(self.cfg, "kernel_impl", "auto")
+        if mode != "legacy":
+            impl_plan, extra, report = _mk.plan_for_engine(
+                policy, self.cfg, self._interpret)
+            for k, v in extra.items():
+                self._arrays[k] = jax.device_put(v, device)
+            self.impl_plan = impl_plan
+            self.kernel_report = report
+            policy.kernel_plan = dict(impl_plan)
+            self._step = jax.jit(functools.partial(
+                _mk.fused_verdict_step,
+                impl_plan=tuple(sorted(impl_plan.items())),
+                dfa_impl=self._dfa_impl,
+                interpret=self._interpret,
+                use_pallas_nfa=not self._interpret))
+        else:
+            self._step = jax.jit(verdict_step)
         #: layout-tuple → jitted blob step (the layout is static per
         #: config; distinct layouts are distinct compiles)
         self._blob_steps: Dict[tuple, object] = {}
@@ -1498,8 +1616,10 @@ class VerdictEngine:
     def _blob_step(self, layout):
         fn = self._blob_steps.get(layout)
         if fn is None:
+            inner = self._step  # jitted-in-jitted inlines under trace
+
             def step(arrays, batch):
-                return verdict_step(arrays, unpack_blob(batch, layout))
+                return inner(arrays, unpack_blob(batch, layout))
 
             fn = jax.jit(step)
             self._blob_steps[layout] = fn

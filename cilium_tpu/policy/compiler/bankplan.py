@@ -181,6 +181,11 @@ class BankRegistry:
         self.reuses = 0
         self.quarantine_events = 0
         self.quarantined_serves = 0
+        #: bank key → scan-impl pick ("dfa-dense" / "nfa-bitset") the
+        #: megakernel autotuner recorded at staging — content-addressed
+        #: banks carry their kernel choice across regenerations (the
+        #: loader writes it after every successful stage)
+        self.kernel_picks: Dict[str, str] = {}
 
     # -- bookkeeping ------------------------------------------------------
     @staticmethod
@@ -382,4 +387,5 @@ class BankRegistry:
             "quarantined": len(self._quarantine),
             "quarantine_events": self.quarantine_events,
             "quarantined_serves": self.quarantined_serves,
+            "kernel_picks": dict(self.kernel_picks),
         }

@@ -325,15 +325,17 @@ class Loader:
         from cilium_tpu.engine.memo import PolicyDelta
         from cilium_tpu.engine.verdict import CompiledPolicy, VerdictEngine
 
-        # "policy-v7": v2 gained the ms_auth array; v3 port-range prefix
+        # "policy-v8": v2 gained the ms_auth array; v3 port-range prefix
         # keys (ms_plens + the w2 repack); v4 the audit_mode scalar; v5
         # the per-endpoint audit bit (enf_flags grew a column); v6 the
         # distillery template dedup (ms_tmpl_ids; key_w0 holds template
         # ids); v7 the content-addressed bank partition (lane layout
-        # differs from the positional grouping) — each bump invalidates
-        # older cached artifacts. The key is now derived from the
-        # per-identity fingerprints + a globals fingerprint, so the
-        # SAME inputs also seed the bank-scoped invalidation delta.
+        # differs from the positional grouping); v8 the megakernel
+        # resolve plan (rp_* group arrays + resolve_meta on the
+        # artifact) — each bump invalidates older cached artifacts.
+        # The key is now derived from the per-identity fingerprints +
+        # a globals fingerprint, so the SAME inputs also seed the
+        # bank-scoped invalidation delta.
         fps = identity_fingerprints(per_identity)
         globals_fp = ruleset_fingerprint(
             self.config.policy_audit_mode,
@@ -345,7 +347,7 @@ class Loader:
             _referenced_secret_values(per_identity, self.secrets),
         )
         key = ruleset_fingerprint(
-            "policy-v7", globals_fp, tuple(sorted(fps.items())))
+            "policy-v8", globals_fp, tuple(sorted(fps.items())))
         with self._lock:
             serving_engine = self._engine
         if (key == self._last_artifact_key and not self._degraded
@@ -384,7 +386,9 @@ class Loader:
                        identities=len(per_identity), cache_hit=cached):
             with SpanStat("policy_stage"), \
                     TRACER.span("policy.stage", cache_hit=cached):
-                engine = VerdictEngine(policy, device=self.device)
+                engine = VerdictEngine(policy, device=self.device,
+                                       cfg=self.config.engine)
+        self._record_kernel_plan(policy, engine)
         new_plan = dict(getattr(policy, "bank_plan", {}) or {})
         delta = self._delta_for(fps, globals_fp, new_plan,
                                 bool(quarantined))
@@ -421,6 +425,22 @@ class Loader:
                        if prev_fps.get(ep) != fps.get(ep)}
         return PolicyDelta.banks(changed_ids, changed_banks)
 
+    def _record_kernel_plan(self, policy, engine) -> None:
+        """Push the staged engine's per-bank kernel picks into the
+        bank registry (content-addressed banks carry their kernel
+        choice across regenerations) and onto the serving plan the
+        `status` op exposes."""
+        picks = dict(getattr(engine, "impl_plan", {}) or {})
+        self._kernel_plan = picks
+        if self.bank_registry is None or not picks:
+            return
+        field_of_prefix = {"path": "path", "method": "method",
+                           "host": "host", "hdr": "hdr", "dns": "dns"}
+        for prefix, impl in picks.items():
+            field = field_of_prefix.get(prefix, prefix)
+            for key in getattr(policy, "bank_plan", {}).get(field, ()):
+                self.bank_registry.kernel_picks[key] = impl
+
     def bank_status(self) -> Dict[str, object]:
         """Bank registry + serving-plan snapshot (the service `status`
         op's churn-plane face)."""
@@ -430,6 +450,7 @@ class Loader:
                                   "degraded": self._degraded}
         out.update(self.bank_registry.status())
         out["plan"] = {f: len(k) for f, k in self._bank_plan.items()}
+        out["kernel_plan"] = dict(getattr(self, "_kernel_plan", {}))
         return out
 
     # -- warm restart -----------------------------------------------------
@@ -449,6 +470,10 @@ class Loader:
             key = self._last_artifact_key
         if engine is None or not self._cache.enable:
             return False
+        from cilium_tpu.engine.megakernel import (
+            autotune_cache_snapshot,
+        )
+
         self._cache.put(WARM_STATE_KEY, {
             "format": 1,
             "revision": revision,
@@ -456,6 +481,10 @@ class Loader:
             "per_identity": per_identity,
             "offload": bool(self.config.enable_tpu_offload),
             "audit": bool(self.config.policy_audit_mode),
+            # per-bank-shape kernel picks survive the restart: the
+            # restaged engine re-plans against a warm autotune cache
+            # instead of re-benching every shape
+            "kernel_autotune": autotune_cache_snapshot(),
         })
         return True
 
@@ -471,6 +500,9 @@ class Loader:
         state = self._cache.get(WARM_STATE_KEY)
         if not isinstance(state, dict) or state.get("format") != 1:
             return False
+        from cilium_tpu.engine.megakernel import autotune_cache_adopt
+
+        autotune_cache_adopt(state.get("kernel_autotune"))
         try:
             revision = int(state["revision"])
             per_identity = state["per_identity"]
@@ -505,8 +537,10 @@ class Loader:
                     with SpanStat("policy_stage"), \
                             TRACER.span("policy.stage",
                                         cache_hit=True, warm=True):
-                        engine = VerdictEngine(policy,
-                                               device=self.device)
+                        engine = VerdictEngine(
+                            policy, device=self.device,
+                            cfg=self.config.engine)
+                self._record_kernel_plan(policy, engine)
                 # a real fingerprint change (or an unknown serving
                 # state): hand memo owners the identity-scoped delta
                 # when the serving fingerprints can vouch for it

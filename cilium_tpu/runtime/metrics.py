@@ -113,6 +113,14 @@ BANK_QUARANTINED = "cilium_tpu_bank_quarantined_total"
 #: revision (new content-addressed key), by field
 BANK_HOTSWAPS = "cilium_tpu_bank_hotswaps_total"
 
+# -- megakernel scan autotuner (engine/megakernel.py): dense-DFA vs
+# bitset-NFA measured per bank shape at engine staging
+#: autotuner decisions, by winning impl and field (cache misses only —
+#: a shape-key hit re-serves the recorded pick without re-benching)
+KERNEL_AUTOTUNE_PICKS = "cilium_tpu_kernel_autotune_picks_total"
+#: wall seconds spent measuring one bank shape (both arms)
+KERNEL_AUTOTUNE_SECONDS = "cilium_tpu_kernel_autotune_seconds"
+
 #: latency-shaped default boundaries (seconds; the Prometheus client
 #: defaults) — covers every ``*_seconds`` series we emit
 DEFAULT_BUCKETS: Tuple[float, ...] = (
@@ -582,6 +590,12 @@ METRICS.describe(BANK_QUARANTINED,
 METRICS.describe(BANK_HOTSWAPS,
                  "bank groups hot-swapped by a committed revision, "
                  "by field")
+METRICS.describe(KERNEL_AUTOTUNE_PICKS,
+                 "megakernel scan-impl autotune decisions, by impl "
+                 "and field")
+METRICS.describe(KERNEL_AUTOTUNE_SECONDS,
+                 "seconds measuring dense vs bitset-NFA for one bank "
+                 "shape")
 
 
 class SpanStat:

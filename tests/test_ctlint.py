@@ -1208,3 +1208,93 @@ def test_obs_doc_parity_real_tree_nonvacuous():
     assert len(phases) >= 10, sorted(phases)
     assert "tables" in phases and "dfa-scan" in phases
     assert obs_rule.check_obs_docs(index) == []
+
+
+# -- pallas-block-shape ------------------------------------------------------
+
+from cilium_tpu.analysis import pallas_shapes as pallas_rule  # noqa: E402
+
+PALLAS_BAD = """\
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+TILE = 100
+
+
+def _kern(x_ref, o_ref):
+    o_ref[:] = jnp.dot(x_ref[:], x_ref[:])
+
+
+def run(x):
+    return pl.pallas_call(
+        _kern,
+        grid=(1,),
+        in_specs=[pl.BlockSpec((64, 100), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((12, TILE), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((12, 100), jnp.float32),
+    )(x)
+"""
+
+PALLAS_GOOD = """\
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+TILE = 1024
+
+
+def _kern(x_ref, o_ref):
+    o_ref[:] = jnp.dot(x_ref[:], x_ref[:],
+                       preferred_element_type=jnp.float32)
+
+
+def run(x, L):
+    return pl.pallas_call(
+        _kern,
+        grid=(1,),
+        in_specs=[pl.BlockSpec((1, L, TILE), lambda i: (0, 0, 0)),
+                  pl.BlockSpec((8, 128), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((1, 1, 8, 128), lambda i: (0, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+    )(x)
+"""
+
+
+def test_pallas_block_shape_bad_corpus():
+    findings = _check({"pkg/k.py": PALLAS_BAD}, pallas_rule.check)
+    assert all(f.rule == "pallas-block-shape" for f in findings)
+    msgs = "\n".join(f.message for f in findings)
+    # 100 violates the 128-lane tile twice (literal + via TILE const),
+    # 12 violates the 8-sublane tile, and the kernel dot is unpinned
+    assert msgs.count("not a multiple of 128") == 2
+    assert "not a multiple of 8" in msgs
+    assert "preferred_element_type" in msgs
+    assert len(findings) == 4
+
+
+def test_pallas_block_shape_good_corpus():
+    # aligned literals, module constants, variable dims (not guessed),
+    # leading size-1 dims, and a pinned dot: all clean
+    assert _check({"pkg/k.py": PALLAS_GOOD}, pallas_rule.check) == []
+
+
+def test_pallas_block_shape_dot_outside_kernel_not_flagged():
+    src = PALLAS_GOOD.replace(
+        "def run(x, L):",
+        "def helper(a, b):\n"
+        "    return jnp.dot(a, b)\n\n\n"
+        "def run(x, L):")
+    # an unpinned dot in a NON-kernel function is host/XLA code where
+    # the default precision rules apply — out of this rule's scope
+    assert _check({"pkg/k.py": src}, pallas_rule.check) == []
+
+
+def test_pallas_block_shape_shipped_kernels_clean():
+    src_dfa = open(os.path.join(
+        REPO_ROOT, "cilium_tpu/engine/pallas_dfa.py")).read()
+    src_nfa = open(os.path.join(
+        REPO_ROOT, "cilium_tpu/engine/pallas_nfa.py")).read()
+    assert _check({"cilium_tpu/engine/pallas_dfa.py": src_dfa,
+                   "cilium_tpu/engine/pallas_nfa.py": src_nfa},
+                  pallas_rule.check) == []

@@ -1,0 +1,445 @@
+"""Megakernel differential suite (ISSUE 9 acceptance).
+
+The fused verdict step (`engine/megakernel.py`) must be BIT-EQUAL to
+the legacy three-op path on every output lane, for every scan arm the
+autotuner can pick — over the golden 5000-flow corpus, curated edge
+policies (LOG header matches, dead secret-backed rules, multi-ruleset
+membership), and hypothesis-random rule banks/payloads. Plus the
+bitset-NFA arm's word-level equality with the dense DFA, the Pallas
+kernel in interpret mode, and the autotuner's cache/record mechanics.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import (
+    Flow,
+    HTTPInfo,
+    L7Type,
+    Protocol,
+    TrafficDirection,
+)
+from cilium_tpu.ingest import synth
+from cilium_tpu.policy.api import (
+    EndpointSelector,
+    IngressRule,
+    L7Rules,
+    PortProtocol,
+    PortRule,
+    PortRuleHTTP,
+    Rule,
+)
+from cilium_tpu.policy.api.l7 import HeaderMatch
+from cilium_tpu.runtime.loader import Loader
+
+OUTPUT_LANES = ("verdict", "allowed", "l3l4_allowed", "redirect",
+                "l7_ok", "l7_log", "match_spec", "ruleset",
+                "auth_required")
+
+
+def _cfg(**engine_kw):
+    cfg = Config.from_env()
+    cfg.enable_tpu_offload = True
+    for k, v in engine_kw.items():
+        setattr(cfg.engine, k, v)
+    return cfg
+
+
+def _engine(per_identity, cfg):
+    return Loader(cfg).regenerate(per_identity, revision=1), cfg
+
+
+def _assert_fused_equals_legacy(engine, flows, cfg):
+    """Engine's staged (fused) step vs the legacy verdict_step, all
+    output lanes."""
+    import jax
+
+    from cilium_tpu.engine.verdict import (
+        encode_flows,
+        flowbatch_to_host_dict,
+        verdict_step,
+    )
+
+    host = flowbatch_to_host_dict(encode_flows(
+        flows, engine.policy.kafka_interns, cfg.engine))
+    batch = {k: jax.device_put(v) for k, v in host.items()}
+    want = jax.jit(verdict_step)(engine._arrays, batch)
+    got = engine.verdict_batch_arrays(batch)
+    assert set(want) == set(got)
+    for k in OUTPUT_LANES:
+        np.testing.assert_array_equal(np.asarray(want[k]),
+                                      np.asarray(got[k]), err_msg=k)
+
+
+@pytest.mark.parametrize("config,n_rules", [
+    ("http", 300), ("fqdn", 200), ("kafka", 100), ("generic", 50)])
+def test_fused_bit_equal_per_config(config, n_rules):
+    per_identity, scenario = synth.realize_scenario(
+        synth.scenario_by_name(config, n_rules, 512))
+    engine, cfg = _engine(per_identity, _cfg())
+    assert engine.impl_plan, "fused step should be staged by default"
+    _assert_fused_equals_legacy(engine, scenario.flows, cfg)
+
+
+def test_fused_legacy_knob_reverts_wholesale():
+    per_identity, scenario = synth.realize_scenario(
+        synth.synth_http_scenario(n_rules=40, n_flows=64))
+    engine, cfg = _engine(per_identity, _cfg(kernel_impl="legacy"))
+    assert engine.impl_plan == {}
+    _assert_fused_equals_legacy(engine, scenario.flows, cfg)
+
+
+# ---------------------------------------------------------- edge policies
+def _http_policy(http_rules, secrets=None, n_selectors=1):
+    """Realize a policy whose http rules split across ``n_selectors``
+    endpoint selectors — multi-ruleset membership for the group
+    factoring to chew on."""
+    sel = EndpointSelector.from_labels
+    rules = []
+    chunk = max(1, len(http_rules) // n_selectors)
+    for i in range(n_selectors):
+        sub = http_rules[i * chunk:(i + 1) * chunk] or http_rules[:1]
+        rules.append(Rule(
+            endpoint_selector=sel(app=f"server{i}"),
+            ingress=(IngressRule(
+                from_endpoints=(sel(app="client"),),
+                to_ports=(PortRule(
+                    ports=(PortProtocol(80, Protocol.TCP),),
+                    rules=L7Rules(http=tuple(sub))),)),),
+            labels=(f"mk={i}",)))
+    endpoints = {f"server{i}": {"app": f"server{i}"}
+                 for i in range(n_selectors)}
+    endpoints["client"] = {"app": "client"}
+    scenario = synth.SynthScenario(name="mk", rules=rules,
+                                   endpoints=endpoints, flows=[])
+    return synth.realize_scenario(scenario)
+
+
+def _flows(ids, paths, headers=(), n_servers=1):
+    out = []
+    for i, p in enumerate(paths):
+        for s in range(n_servers):
+            out.append(Flow(
+                src_identity=ids["client"],
+                dst_identity=ids[f"server{s}"],
+                dport=80, direction=TrafficDirection.INGRESS,
+                l7=L7Type.HTTP,
+                http=HTTPInfo(method=("GET", "POST")[i % 2], path=p,
+                              host="svc.local",
+                              headers=tuple(headers))))
+    return out
+
+
+def test_fused_log_lanes_and_dead_rules():
+    """LOG-action header matches (the l7_log lane) and a dead rule
+    (unresolvable FAIL secret) ride the group signature exactly."""
+    http = [
+        PortRuleHTTP(path="/log/.*", header_matches=(
+            HeaderMatch(name="X-Trace", value="on",
+                        mismatch_action="LOG"),)),
+        PortRuleHTTP(path="/fail/.*", header_matches=(
+            HeaderMatch(name="X-Tok", mismatch_action="",
+                        secret=("ns", "missing")),)),
+        PortRuleHTTP(path="/open/.*"),
+        PortRuleHTTP(method="GET"),  # path-unconstrained group
+    ]
+    per_identity, scenario = _http_policy(http)
+    engine, cfg = _engine(per_identity, _cfg())
+    ids = scenario.ids
+    flows = _flows(ids, ["/log/a", "/log/b", "/fail/x", "/open/y",
+                         "/none", "/log/c"],
+                   headers=(("X-Trace", "off"),))
+    flows += _flows(ids, ["/log/a"], headers=(("X-Trace", "on"),))
+    _assert_fused_equals_legacy(engine, flows, cfg)
+    # and the semantics are live: some l7_log set, dead rule denies
+    out = engine.verdict_flows(flows)
+    assert out["l7_log"].any()
+
+
+def test_fused_multi_ruleset_membership():
+    """The same rule signature under different ruleset memberships
+    must stay in separate groups — a flow's ruleset must only see its
+    own members' path lanes."""
+    http = [PortRuleHTTP(method="GET", path=f"/svc{i}/[a-z]+")
+            for i in range(12)]
+    per_identity, scenario = _http_policy(http, n_selectors=3)
+    engine, cfg = _engine(per_identity, _cfg())
+    ids = scenario.ids
+    flows = _flows(ids, [f"/svc{i}/abc" for i in range(12)],
+                   n_servers=3)
+    _assert_fused_equals_legacy(engine, flows, cfg)
+    out = engine.verdict_flows(flows)
+    # server0 serves rules 0-3 only: its flows for /svc8 must drop
+    assert len(set(np.asarray(out["verdict"]).tolist())) > 1
+
+
+def test_plan_degenerate_falls_back_to_legacy_resolve(monkeypatch):
+    from cilium_tpu.engine import megakernel
+
+    monkeypatch.setattr(megakernel, "GROUP_CAP", 1)
+    per_identity, scenario = synth.realize_scenario(
+        synth.synth_http_scenario(n_rules=60, n_flows=128))
+    cfg = _cfg()
+    # a cached artifact compiled under the real GROUP_CAP would carry
+    # its plan regardless of the monkeypatch — force a fresh compile
+    cfg.loader.enable_cache = False
+    engine, cfg = _engine(per_identity, cfg)
+    assert engine.policy.resolve_meta is None
+    assert "rp_g_method" not in engine.policy.arrays
+    _assert_fused_equals_legacy(engine, scenario.flows, cfg)
+
+
+def test_no_http_rules_policy():
+    per_identity, scenario = synth.realize_scenario(
+        synth.scenario_by_name("fqdn", 20, 64))
+    engine, cfg = _engine(per_identity, _cfg())
+    _assert_fused_equals_legacy(engine, scenario.flows, cfg)
+
+
+# ------------------------------------------------- bitset-NFA arm equality
+PATTERNS = [
+    "/api/v[0-9]+/users/.*", "GET|POST", "foo(bar)?baz", "a{2,4}b",
+    "[a-c]+x", "(ab|cd)*", "x[^0-9]y", "h?ello+", "", ".*",
+]
+
+
+def _rand_payloads(n=300, L=32, seed=0):
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 256, size=(n, L)).astype(np.uint8)
+    for i, s in enumerate(["/api/v1/users/42", "GET", "foobarbaz",
+                           "aab", "abab", "xay", "hello", "", "cd",
+                           "aaab"]):
+        b = s.encode()
+        data[i, :len(b)] = np.frombuffer(b, np.uint8)
+        data[i, len(b):] = 0
+    lens = rng.integers(0, L + 1, size=(n,)).astype(np.int32)
+    lens[:10] = [16, 3, 9, 3, 4, 3, 5, 0, 2, 4]
+    return data, lens
+
+
+def test_nfa_scan_words_equal_dense():
+    import jax
+    import jax.numpy as jnp
+
+    from cilium_tpu.core.config import EngineConfig
+    from cilium_tpu.engine import nfa_kernel
+    from cilium_tpu.engine.dfa_kernel import dfa_scan_banked
+    from cilium_tpu.policy.compiler.dfa import compile_patterns
+
+    banked = compile_patterns(PATTERNS, bank_size=4)
+    st = banked.stacked()
+    data, lens = _rand_payloads()
+    want = np.asarray(dfa_scan_banked(
+        jnp.asarray(st["trans"]), jnp.asarray(st["byteclass"]),
+        jnp.asarray(st["start"]), jnp.asarray(st["accept"]),
+        jnp.asarray(data), jnp.asarray(lens)))
+    banks = nfa_kernel.banks_from_dfa(banked, EngineConfig())
+    assert banks is not None
+    stacked = {k: jnp.asarray(v)
+               for k, v in nfa_kernel.stack_nfa_banks(banks).items()}
+    got = np.asarray(jax.jit(
+        lambda s, d, l: nfa_kernel.nfa_scan_banked(s, d, l))(
+        stacked, jnp.asarray(data), jnp.asarray(lens)))
+    np.testing.assert_array_equal(want, got)
+
+
+def test_pallas_nfa_interpret_equals_dense():
+    import jax
+    import jax.numpy as jnp
+
+    from cilium_tpu.core.config import EngineConfig
+    from cilium_tpu.engine import nfa_kernel
+    from cilium_tpu.engine.dfa_kernel import dfa_scan_banked
+    from cilium_tpu.policy.compiler.dfa import compile_patterns
+
+    banked = compile_patterns(PATTERNS, bank_size=4)
+    st = banked.stacked()
+    data, lens = _rand_payloads(n=48, L=16, seed=3)
+    want = np.asarray(dfa_scan_banked(
+        jnp.asarray(st["trans"]), jnp.asarray(st["byteclass"]),
+        jnp.asarray(st["start"]), jnp.asarray(st["accept"]),
+        jnp.asarray(data), jnp.asarray(lens)))
+    banks = nfa_kernel.banks_from_dfa(banked, EngineConfig())
+    stacked = {k: jnp.asarray(v)
+               for k, v in nfa_kernel.stack_nfa_banks(banks).items()}
+    got = np.asarray(jax.jit(
+        lambda s, d, l: nfa_kernel.nfa_scan_banked(
+            s, d, l, use_pallas=True, interpret=True))(
+        stacked, jnp.asarray(data), jnp.asarray(lens)))
+    np.testing.assert_array_equal(want, got)
+
+
+def test_forced_nfa_arm_full_engine_bit_equal():
+    """kernel_impl=nfa-bitset forces the arm engine-wide (bank_size
+    small enough that every bank fits the position budget) — the full
+    verdict must still be bit-equal."""
+    per_identity, scenario = synth.realize_scenario(
+        synth.synth_http_scenario(n_rules=24, n_flows=256))
+    engine, cfg = _engine(per_identity,
+                          _cfg(kernel_impl="nfa-bitset", bank_size=4))
+    assert "nfa-bitset" in engine.impl_plan.values(), engine.kernel_report
+    _assert_fused_equals_legacy(engine, scenario.flows, cfg)
+
+
+def test_forced_nfa_ineligible_falls_back_dense():
+    """A bank over the position budget degrades the forced arm to
+    dense for that field — recorded on the plan, verdicts unchanged."""
+    per_identity, scenario = synth.realize_scenario(
+        synth.synth_http_scenario(n_rules=200, n_flows=64))
+    engine, cfg = _engine(per_identity, _cfg(kernel_impl="nfa-bitset"))
+    assert engine.impl_plan["path"] == "dfa-dense"
+    _assert_fused_equals_legacy(engine, scenario.flows, cfg)
+
+
+# --------------------------------------------------------------- autotune
+def test_autotune_mechanics_and_recording():
+    import jax
+
+    from cilium_tpu.core.config import EngineConfig
+    from cilium_tpu.engine import megakernel, nfa_kernel
+    from cilium_tpu.policy.compiler.dfa import compile_patterns
+    from cilium_tpu.runtime.metrics import (
+        KERNEL_AUTOTUNE_PICKS,
+        METRICS,
+    )
+
+    pats = [f"/t{i}/x" for i in range(6)]
+    banked = compile_patterns(pats, bank_size=3)
+    st = banked.stacked()
+    arrays = {f"at_{k}": jax.device_put(v) for k, v in st.items()}
+    banks = nfa_kernel.banks_from_dfa(banked, EngineConfig())
+    stacked = nfa_kernel.stack_nfa_banks(banks)
+    megakernel._AUTOTUNE_CACHE.clear()
+    r1 = megakernel.autotune_field("at-test", arrays, "at", stacked,
+                                   width=16, interpret=True,
+                                   probe_batch=64)
+    assert r1["impl"] in ("dfa-dense", "nfa-bitset")
+    assert r1["dense_ms"] is not None and r1["nfa_ms"] is not None
+    picks = METRICS.get(KERNEL_AUTOTUNE_PICKS,
+                        {"impl": r1["impl"], "field": "at-test"})
+    assert picks >= 1
+    # shape-key cache: second call re-serves without re-benching
+    r2 = megakernel.autotune_field("at-test", arrays, "at", stacked,
+                                   width=16, interpret=True,
+                                   probe_batch=64)
+    assert r2 is r1
+    # snapshot → adopt round-trips into a cold cache
+    snap = megakernel.autotune_cache_snapshot()
+    megakernel._AUTOTUNE_CACHE.clear()
+    megakernel.autotune_cache_adopt(snap)
+    r3 = megakernel.autotune_field("at-test", arrays, "at", stacked,
+                                   width=16, interpret=True,
+                                   probe_batch=64)
+    assert r3["impl"] == r1["impl"]
+
+
+def test_autotune_mode_stages_and_records_plan():
+    per_identity, scenario = synth.realize_scenario(
+        synth.synth_http_scenario(n_rules=16, n_flows=64))
+    engine, cfg = _engine(per_identity,
+                          _cfg(kernel_impl="autotune", bank_size=4))
+    # every field carries a measured or eligible-arm report
+    assert set(engine.kernel_report) == {"path", "method", "host",
+                                         "hdr", "dns"}
+    for rep in engine.kernel_report.values():
+        assert rep["impl"] in ("dfa-dense", "nfa-bitset")
+        assert rep["dense_ms"] is not None
+    # picks ride the policy and the loader's registry/status
+    assert engine.policy.kernel_plan == engine.impl_plan
+    loader = Loader(_cfg())
+    loader.regenerate(per_identity, revision=2)
+    status = loader.bank_status()
+    assert status["enabled"]
+    assert "kernel_plan" in status
+    _assert_fused_equals_legacy(engine, scenario.flows, cfg)
+
+
+# ------------------------------------------------ golden corpus (at size)
+@pytest.mark.slow
+def test_golden_5000_flow_fused_bit_equal_both_arms():
+    """The acceptance differential at size: 5000 flows over a policy
+    whose banks fit both arms; the fused step must be bit-equal to
+    the legacy path with the scan forced through EACH autotuner arm,
+    and through capture replay (the staged-table + group-word path)."""
+    import itertools
+
+    from cilium_tpu.engine.verdict import CaptureReplay
+    from cilium_tpu.ingest import binary
+
+    scen = synth.synth_http_scenario(n_rules=48, n_flows=5000)
+    for impl in ("dfa-dense", "nfa-bitset"):
+        per_identity, scenario = synth.realize_scenario(scen)
+        engine, cfg = _engine(per_identity,
+                              _cfg(kernel_impl=impl, bank_size=4))
+        if impl == "nfa-bitset":
+            assert "nfa-bitset" in engine.impl_plan.values()
+        _assert_fused_equals_legacy(engine, scenario.flows, cfg)
+
+    # capture replay over the same corpus (dense arm), chunked
+    import tempfile, os
+
+    per_identity, scenario = synth.realize_scenario(scen)
+    engine, cfg = _engine(per_identity, _cfg())
+    cap = os.path.join(tempfile.mkdtemp(), "mk_golden.bin")
+    binary.write_capture_l7(cap, scenario.flows)
+    rec = binary.map_capture(cap)
+    l7, offsets, blob = binary.read_l7_sidecar(cap)
+    replay = CaptureReplay(engine, l7, offsets, blob, cfg.engine,
+                           gen=binary.read_gen_sidecar(cap))
+    assert "path_groups" in replay.table_words
+    replay.stage_rows(rec, l7)
+    replay.stage_unique(drop_if_ratio_at_least=0.9)
+    got = list(itertools.chain.from_iterable(
+        replay.verdict_chunk(rec[s:s + 512], l7[s:s + 512],
+                             start=s)["verdict"].tolist()
+        for s in range(0, len(rec), 512)))
+    want = engine.verdict_flows(scenario.flows)["verdict"]
+    np.testing.assert_array_equal(got, want)
+    assert len(set(got)) > 1
+
+
+# ------------------------------------------------------ hypothesis fuzzing
+try:
+    from hypothesis import given, settings, strategies as st
+except ImportError:  # pragma: no cover - depends on the image
+    given = None
+
+if given is not None:
+    _short = st.text(alphabet="abcx/", min_size=0, max_size=6)
+    _pattern = st.one_of(
+        _short.map(lambda s: s.replace("/", "") or "a"),
+        st.sampled_from(["/a/[a-c]+", "x(y|z)*", "ab{1,3}c", ".*b",
+                         "a?b+c", "[^x]y"]),
+    )
+    _method = st.sampled_from(["", "GET", "PUT|POST"])
+    _hdr = st.sampled_from([(), ("X-A: 1",), ("X-A: 1", "X-B: 2")])
+
+    @st.composite
+    def _policies(draw):
+        rules = []
+        for _ in range(draw(st.integers(1, 8))):
+            rules.append(PortRuleHTTP(
+                path=draw(_pattern), method=draw(_method),
+                headers=draw(_hdr)))
+        return rules
+
+    @given(rules=_policies(),
+           payloads=st.lists(_short, min_size=1, max_size=16),
+           n_sel=st.integers(1, 2),
+           data=st.data())
+    @settings(max_examples=25, deadline=None)
+    def test_hypothesis_fused_equals_legacy(rules, payloads, n_sel,
+                                            data):
+        """Random rule banks + random payloads: the fused step (both
+        arms where eligible) is bit-equal to the legacy step."""
+        per_identity, scenario = _http_policy(rules,
+                                              n_selectors=n_sel)
+        impl = data.draw(st.sampled_from(["auto", "nfa-bitset"]))
+        engine, cfg = _engine(per_identity,
+                              _cfg(kernel_impl=impl, bank_size=4))
+        flows = _flows(scenario.ids,
+                       ["/" + p if not p.startswith("/") else p
+                        for p in payloads],
+                       headers=(("X-A", "1"),), n_servers=n_sel)
+        _assert_fused_equals_legacy(engine, flows, cfg)

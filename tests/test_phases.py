@@ -270,3 +270,53 @@ def test_ledger_cp_ring_scales_by_hops():
         == n - 1
     assert snap[("cp.final_gather", "all_gather")]["count_per_block"] \
         == 1
+
+
+# -- megakernel attribution (ISSUE 9) ---------------------------------------
+
+def test_engine_probe_attributes_fused_step_as_one_dispatch(
+        engine_and_scenario):
+    """The acceptance check: the probe attributes the fused megakernel
+    step as ONE device dispatch where the three-op decomposition pays
+    three — and the fused step is faster than the three-op chain."""
+    from cilium_tpu.engine.phases import ENGINE_PHASES, EnginePhaseProbe
+
+    engine, scenario, cfg = engine_and_scenario
+    assert engine.impl_plan, "default engines stage the megakernel"
+    probe = EnginePhaseProbe(engine)
+    report = probe.measure_flows(scenario.flows[:512], cfg.engine,
+                                 reps=3)
+    assert report["fused_dispatches"] == 1
+    assert report["three_op_dispatches"] == 3
+    assert report["fused_ms"] > 0
+    assert report["three_op_ms"] >= report["fused_ms"] * 0.5
+    assert report["fused_speedup"] > 0
+    # fused-verdict + the plan's impls are first-class phase labels
+    assert "fused-verdict" in ENGINE_PHASES
+    assert report["phases_ms"]["fused-verdict"] > 0
+    for impl in set(engine.impl_plan.values()):
+        assert impl in ENGINE_PHASES
+        assert report["phases_ms"][impl] > 0
+    assert report["impl_plan"] == engine.impl_plan
+    # the coverage contract still holds: the decomposition covers (or,
+    # fused, exceeds) the staged step's wall
+    assert report["coverage"] >= 0.9, report
+
+
+def test_engine_probe_nfa_impl_phase_label():
+    """A plan that uses the bitset-NFA arm reports its phase lane."""
+    from cilium_tpu.core.config import Config
+    from cilium_tpu.engine.phases import EnginePhaseProbe
+    from cilium_tpu.runtime.loader import Loader
+
+    per_identity, scenario = synth.realize_scenario(
+        synth.synth_http_scenario(n_rules=12, n_flows=64))
+    cfg = Config.from_env()
+    cfg.enable_tpu_offload = True
+    cfg.engine.kernel_impl = "nfa-bitset"
+    cfg.engine.bank_size = 4
+    engine = Loader(cfg).regenerate(per_identity, revision=1)
+    assert "nfa-bitset" in engine.impl_plan.values()
+    report = EnginePhaseProbe(engine).measure_flows(
+        scenario.flows[:64], cfg.engine, reps=2)
+    assert report["phases_ms"]["nfa-bitset"] > 0
