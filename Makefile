@@ -3,10 +3,10 @@
 
 PY ?= python
 
-.PHONY: test shim lint determinism dryrun chaos obs soak churn bench \
-        bench-all bench-e2e bench-service bench-regen bench-sp \
-        bench-stage bench-stream bench-kernel bench-multichip \
-        bench-watch perf-report check
+.PHONY: test shim lint determinism dryrun chaos obs soak churn dst \
+        dst-validate bench bench-all bench-e2e bench-service \
+        bench-regen bench-sp bench-stage bench-stream bench-kernel \
+        bench-multichip bench-watch perf-report check
 
 test:            ## full suite (CPU, virtual 8-device mesh via conftest)
 	$(PY) -m pytest tests/ -q
@@ -52,8 +52,11 @@ obs:             ## observability lane: tracing tests + scrape lint
 # depth bounded at max_pending and admitted-request p99 within 2× the
 # unloaded p99 (ISSUE 5 acceptance). Marked slow+soak so tier-1
 # timing never pays for it.
+# -s: the virtual-time fixture prints the simulated-vs-wall speedup
+# on the lane output (ISSUE 10 — the lane now simulates its service
+# times on an autojumping VirtualClock; one real-clock smoke stays)
 soak:            ## synthetic-overload admission/shed lane
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_soak.py -q \
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_soak.py -q -s \
 	    -m "soak and not churn"
 
 # churn: the ISSUE-8 acceptance soak — sustained CNP add/delete +
@@ -63,10 +66,33 @@ soak:            ## synthetic-overload admission/shed lane
 # bank-scoped compile work (O(Δ), not O(policy×updates)), and a
 # steady-state memo hit ratio ≥0.99. Writes a provenance-stamped
 # update→enforcement p99 bench line consumed by perf-report.
+# CILIUM_TPU_DST_SEED: the lane's driving seed rides the bench line's
+# provenance stamp (runtime/provenance.dst_stamp) so perf-report can
+# tie an update-latency regression to the schedule that exposed it
 churn:           ## sustained policy-churn soak (bank-scoped compile)
 	JAX_PLATFORMS=cpu \
 	CILIUM_TPU_CHURN_BENCH_OUT=BENCH_CHURN_r06.jsonl \
+	CILIUM_TPU_DST_SEED=8 \
 	$(PY) -m pytest tests/test_soak.py -q -m churn
+
+# dst: deterministic simulation testing (runtime/dst.py) — seeded
+# fault-SCHEDULE search under virtual time (runtime/simclock.py):
+# each seed is a schedule of fault arms / policy churn / identity
+# storms / drain-restore cycles / time advances against a real
+# Loader+engine+breaker+session world, with standing invariants
+# (oracle agreement, fail-closed, session/memo honesty, O(Δ) compile,
+# breaker+quarantine liveness) checked after every event. The same
+# CILIUM_TPU_DST_SEED replays byte-identically; a violation is
+# delta-debugged to a minimal schedule under tests/dst/regressions/.
+dst:             ## seeded fault-schedule search (DST) lane
+	JAX_PLATFORMS=cpu $(PY) -m cilium_tpu.runtime.dst \
+	    --schedules 200 --shrink --out BENCH_DST_r06.jsonl
+
+# dst-validate: planted-bug proof — re-introduce a known FIXED bug
+# behind the mutation flag and show the schedule search catches and
+# shrinks it within a bounded seed budget (both known mutations).
+dst-validate:    ## planted-bug validation of the DST searcher
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/dst/test_planted.py -q
 
 dryrun:          ## driver multi-chip contract on a virtual CPU mesh
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -127,6 +127,14 @@ class Agent:
             group_cidrs=self._resolve_group,
             cidr_group_cidrs=lambda name: self.cidr_groups.get(name, ()),
             proxy_manager=self.proxy_manager)
+        # identity-churn regeneration debounce (ISSUE-10 satellite):
+        # burst add/delete events from the cluster watch coalesce into
+        # one regeneration per quiet window instead of one per event
+        from cilium_tpu.identity_kvstore import RegenDebouncer
+
+        self._identity_debounce = RegenDebouncer(
+            lambda: self.endpoint_manager.regenerate_all(),
+            window_s=self.config.loader.identity_regen_debounce_s)
         # backend-set changes alter toServices resolution → regenerate,
         # but only when some rule actually uses toServices: routine
         # backend churn must not trigger full-policy recomputation in
@@ -406,6 +414,9 @@ class Agent:
             self.node_registration.close()
         if hasattr(self.allocator, "close"):
             self.allocator.close()
+        # after the watch is closed no new churn events arrive; a
+        # pending debounced regeneration is discarded work on shutdown
+        self._identity_debounce.close()
         if self._health_watcher is not None:
             self._health_watcher.stop()
         for ad in (self._hubble_ad, self._health_ad):
@@ -440,12 +451,15 @@ class Agent:
         """A (possibly remote) cluster identity appeared or vanished in
         the kvstore: update selector resolution and regenerate, so
         policies selecting that identity's labels enforce on this node
-        too (§3.2's incremental path for identity churn)."""
+        too (§3.2's incremental path for identity churn). The selector
+        cache updates synchronously; the regeneration is DEBOUNCED —
+        a churn storm of N events costs one selector pass per event
+        but O(1) regenerations (identity_kvstore.RegenDebouncer)."""
         if labels is None:
             self.selector_cache.remove_identity(nid)
         else:
             self.selector_cache.add_identity(nid, labels)
-        self.endpoint_manager.regenerate_all()
+        self._identity_debounce.note()
 
     def _on_pod_cidr_change(self, old: Optional[str],
                             new: Optional[str]) -> None:

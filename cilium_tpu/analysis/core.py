@@ -291,6 +291,10 @@ RULES: Dict[str, str] = {
                       "staging phases) is documented in "
                       "docs/OBSERVABILITY.md, and the doc names no "
                       "family that no longer exists",
+    "wall-clock": "behavioral time (time.time/monotonic/sleep) in "
+                  "serving-plane modules routes through the injected "
+                  "Clock (runtime/simclock.py); real-world reads "
+                  "carry a justified disable",
     "bare-disable": "every ctlint disable comment carries a "
                     "justification",
     "parse-error": "every analyzed file parses",
@@ -344,6 +348,7 @@ def run(root: str, targets: Sequence[str] = (DEFAULT_TARGET,),
         recompile,
         registry,
         shapes,
+        wallclock,
     )
 
     LAST_TIMINGS.clear()

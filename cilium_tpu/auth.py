@@ -12,11 +12,11 @@ mutates, device consumes a snapshot).
 from __future__ import annotations
 
 import threading
-import time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from cilium_tpu.runtime import simclock
 from cilium_tpu.runtime.metrics import METRICS
 
 #: padding sentinel GREATER than any real identity (identities are
@@ -57,7 +57,7 @@ class AuthManager:
             # whole verdict path; == PAIR_SENTINEL would match padding
             if not (0 <= nid < PAIR_SENTINEL):
                 raise ValueError(f"identity {nid} outside int32 range")
-        expiry = time.time() + (self.default_ttl if ttl is None else ttl)
+        expiry = simclock.wall() + (self.default_ttl if ttl is None else ttl)
         with self._lock:
             self._pairs[(src, dst)] = expiry
             self._version += 1
@@ -76,7 +76,7 @@ class AuthManager:
 
     def expire(self) -> int:
         """GC lapsed entries (controller duty). Returns count removed."""
-        now = time.time()
+        now = simclock.wall()
         with self._lock:
             dead = [p for p, exp in self._pairs.items() if exp <= now]
             for p in dead:
@@ -90,7 +90,7 @@ class AuthManager:
     def is_authed(self, src_identity: int, dst_identity: int) -> bool:
         with self._lock:
             exp = self._pairs.get((int(src_identity), int(dst_identity)))
-        return exp is not None and exp > time.time()
+        return exp is not None and exp > simclock.wall()
 
     def pairs(self) -> Dict[Tuple[int, int], float]:
         with self._lock:
@@ -105,7 +105,7 @@ class AuthManager:
         TTL invalidates at the next call — expiry binds at lookup time
         (as the reference datapath checks auth-map expiration inline),
         not at the next GC sweep."""
-        now = time.time()
+        now = simclock.wall()
         with self._lock:
             if (self._cached is not None
                     and self._cached[0] == self._version

@@ -94,6 +94,12 @@ class LoaderConfig:
     #: how long a quarantined bank serves its stale cover before the
     #: next regeneration retries its compile
     bank_quarantine_ttl_s: float = 30.0
+    #: identity-churn regeneration debounce (identity_kvstore
+    #: .RegenDebouncer): remote identity add/delete events re-arm a
+    #: quiet window this long before ONE regeneration covers the
+    #: burst, so a 100-event churn storm costs O(1) regenerations.
+    #: 0 = regenerate per event (the pre-debounce behavior).
+    identity_regen_debounce_s: float = 0.05
 
 
 @dataclasses.dataclass
@@ -153,6 +159,23 @@ class TracingConfig:
 
 
 @dataclasses.dataclass
+class DSTConfig:
+    """Deterministic simulation testing (runtime/dst.py): seeded
+    fault-schedule search over the serving plane under virtual time
+    (runtime/simclock.py). ``seed`` pins one schedule for replay —
+    the same seed reproduces a byte-identical event trace; ``make
+    dst`` sweeps ``schedules`` seeds of up to ``max_events`` events
+    each and fails on any invariant violation. ``mutation`` arms a
+    known-fixed planted bug (faults.MUTATIONS) so the lane can prove
+    the search catches it."""
+
+    seed: int = 0
+    schedules: int = 200
+    max_events: int = 12
+    mutation: str = ""
+
+
+@dataclasses.dataclass
 class ParallelConfig:
     """Mesh / sharding layout (SURVEY.md §2.6)."""
 
@@ -196,6 +219,7 @@ class Config:
     tracing: TracingConfig = dataclasses.field(default_factory=TracingConfig)
     admission: AdmissionConfig = dataclasses.field(
         default_factory=AdmissionConfig)
+    dst: DSTConfig = dataclasses.field(default_factory=DSTConfig)
     log_level: str = "info"
     #: ``--k8s-api-socket``: when set, the agent consumes CNP/CCNP
     #: from the fake-apiserver (cilium_tpu.k8s) through list+watch
@@ -241,6 +265,9 @@ class Config:
         if "CILIUM_TPU_BANK_QUARANTINE_TTL_S" in env:
             cfg.loader.bank_quarantine_ttl_s = float(
                 env["CILIUM_TPU_BANK_QUARANTINE_TTL_S"])
+        if "CILIUM_TPU_IDENTITY_REGEN_DEBOUNCE_S" in env:
+            cfg.loader.identity_regen_debounce_s = float(
+                env["CILIUM_TPU_IDENTITY_REGEN_DEBOUNCE_S"])
         if "CILIUM_TPU_NODE_NAME" in env:
             cfg.node_name = env["CILIUM_TPU_NODE_NAME"]
         if "CILIUM_TPU_IPAM_MODE" in env:
@@ -257,6 +284,10 @@ class Config:
         if "CILIUM_TPU_STREAM_CREDIT_WINDOW" in env:
             cfg.admission.stream_credit_window = int(
                 env["CILIUM_TPU_STREAM_CREDIT_WINDOW"])
+        if "CILIUM_TPU_DST_SEED" in env:
+            cfg.dst.seed = int(env["CILIUM_TPU_DST_SEED"])
+        if "CILIUM_TPU_DST_MUTATION" in env:
+            cfg.dst.mutation = env["CILIUM_TPU_DST_MUTATION"]
         return cfg
 
     @classmethod
@@ -280,7 +311,8 @@ class Config:
                                 ("parallel", cfg.parallel),
                                 ("breaker", cfg.breaker),
                                 ("tracing", cfg.tracing),
-                                ("admission", cfg.admission)):
+                                ("admission", cfg.admission),
+                                ("dst", cfg.dst)):
             for k, v in data.get(section, {}).items():
                 if hasattr(target, k):
                     setattr(target, k, tuple(v) if isinstance(v, list) else v)

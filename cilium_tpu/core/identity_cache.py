@@ -30,9 +30,9 @@ id in the backing store) and the remote-event wiring, which feeds
 from __future__ import annotations
 
 import threading
-import time
 from typing import Callable, Dict, Iterable, Optional
 
+from cilium_tpu.runtime import simclock
 from cilium_tpu.core.identity import (
     IDENTITY_SCOPE_LOCAL,
     IDENTITY_USER_MIN,
@@ -162,7 +162,7 @@ class IdentityCacheBase:
         """A remote deletion of (nid, labels)."""
         with self._notify_lock:
             with self._lock:
-                now = time.monotonic()
+                now = simclock.now()
                 self._gen_seq += 1
                 self._del_gen[labels] = (self._gen_seq, now)
                 if (len(self._del_gen) > 1024

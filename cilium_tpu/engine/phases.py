@@ -71,6 +71,7 @@ from cilium_tpu.engine.verdict import (
     flowbatch_to_host_dict,
     unpack_batch,
 )
+from cilium_tpu.runtime import simclock
 from cilium_tpu.runtime.metrics import ENGINE_PHASE_SECONDS, METRICS
 from cilium_tpu.runtime.tracing import PHASE_DEVICE, PHASE_HOST, TRACER
 
@@ -211,7 +212,7 @@ def _cap_resolve(arrays, ms, rows, words, batch):
 
 def _record(report: Dict, reps: int) -> None:
     """Publish a probe report into METRICS + the flight recorder."""
-    now = time.time()
+    now = simclock.wall()
     with TRACER.trace("engine.phase_probe", batch=report.get("batch"),
                       reps=reps) as ctx:
         for phase, ms in report["phases_ms"].items():

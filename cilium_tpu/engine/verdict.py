@@ -1513,6 +1513,8 @@ def _bools_to_words(bools: jax.Array, n_words: int) -> jax.Array:
 
 import time as _time
 
+from cilium_tpu.runtime import simclock as _simclock
+
 from cilium_tpu.runtime import faults as _faults
 from cilium_tpu.runtime.metrics import (
     CAPTURE_STAGE_SECONDS as _CAPTURE_STAGE_SECONDS,
@@ -1552,7 +1554,7 @@ class _StagePhase:
         ctx = _TRACER.current()
         if ctx is not None:
             _TRACER.add_span(ctx, f"capture.stage.{self.phase}",
-                             _PH_HOST, _time.time() - dur, dur)
+                             _PH_HOST, _simclock.wall() - dur, dur)
 
 
 class VerdictEngine:

@@ -9,9 +9,9 @@ from __future__ import annotations
 
 import json
 import threading
-import time
 from typing import Dict, Iterable, List, Optional, Set
 
+from cilium_tpu.runtime import simclock
 from cilium_tpu.policy.compiler import matchpattern
 
 
@@ -40,14 +40,14 @@ class DNSCache:
 
     def lookup(self, name: str, now: Optional[float] = None) -> List[str]:
         name = matchpattern.sanitize_name(name)
-        now = time.time() if now is None else now
+        now = simclock.wall() if now is None else now
         with self._lock:
             entry = self._names.get(name, {})
             return sorted(ip for ip, exp in entry.items() if exp > now)
 
     def lookup_by_regex(self, regex, now: Optional[float] = None
                         ) -> Dict[str, List[str]]:
-        now = time.time() if now is None else now
+        now = simclock.wall() if now is None else now
         out: Dict[str, List[str]] = {}
         with self._lock:
             for name, entry in self._names.items():
@@ -60,7 +60,7 @@ class DNSCache:
     def expire(self, now: Optional[float] = None) -> Set[str]:
         """Drop expired IPs; returns names that lost IPs (the reference's
         GC feeds these into policy updates)."""
-        now = time.time() if now is None else now
+        now = simclock.wall() if now is None else now
         affected: Set[str] = set()
         with self._lock:
             for name, entry in list(self._names.items()):

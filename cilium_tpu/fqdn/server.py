@@ -19,12 +19,12 @@ from __future__ import annotations
 
 import socket
 import threading
-import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional, Tuple
 
 from cilium_tpu.fqdn import wire
 from cilium_tpu.fqdn.dnsproxy import DNSProxy
+from cilium_tpu.runtime import simclock
 from cilium_tpu.runtime.metrics import METRICS
 
 #: verdict callback signature: (qname, endpoint_id, allowed, rcode)
@@ -269,7 +269,7 @@ class DNSProxyServer:
         ips = [a.ip for a in parsed.answers if a.ip]
         if ips and parsed.rcode == wire.RCODE_NOERROR:
             ttl = min((a.ttl for a in parsed.answers if a.ip), default=0)
-            self.proxy.observe_response(time.time(), qname, ips,
+            self.proxy.observe_response(simclock.wall(), qname, ips,
                                         ttl=int(ttl))
         if self.on_verdict:
             self.on_verdict(qname, ep, True, parsed.rcode)
@@ -288,9 +288,9 @@ class DNSProxyServer:
             up.settimeout(self.timeout)
             up.connect(self.upstream)
             up.send(data)
-            deadline = time.monotonic() + self.timeout
+            deadline = simclock.now() + self.timeout
             while resp is None:
-                remaining = deadline - time.monotonic()
+                remaining = deadline - simclock.now()
                 if remaining <= 0:
                     raise socket.timeout()
                 up.settimeout(remaining)
@@ -312,7 +312,7 @@ class DNSProxyServer:
         ips = [a.ip for a in parsed.answers if a.ip]
         if ips and parsed.rcode == wire.RCODE_NOERROR:
             ttl = min((a.ttl for a in parsed.answers if a.ip), default=0)
-            self.proxy.observe_response(time.time(), qname, ips,
+            self.proxy.observe_response(simclock.wall(), qname, ips,
                                         ttl=int(ttl))
         if self.on_verdict:
             self.on_verdict(qname, ep, True, parsed.rcode)

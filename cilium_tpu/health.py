@@ -19,6 +19,7 @@ import threading
 import time
 from typing import Callable, Dict, List
 
+from cilium_tpu.runtime import simclock
 from cilium_tpu.runtime.metrics import METRICS
 
 #: kvstore prefix where agents advertise their health endpoint (the
@@ -79,7 +80,7 @@ class HealthChecker:
                 st = self._status.get(name)
                 if st is None:  # removed concurrently
                     continue
-                st.last_probe_ts = time.time()
+                st.last_probe_ts = simclock.wall()
                 st.last_latency_s = latency
                 st.last_error = err
                 if ok:

@@ -11,12 +11,12 @@ from __future__ import annotations
 import dataclasses
 import re as _re_mod
 import threading
-import time
 import uuid
 from typing import Dict, Iterator, Optional, Sequence
 
 import numpy as np
 
+from cilium_tpu.runtime import simclock
 from cilium_tpu.core.flow import Flow, L7Type, PolicyMatchType, Verdict
 from cilium_tpu.hubble.ring import FlowRing
 from cilium_tpu.runtime.tracing import TRACER
@@ -32,7 +32,7 @@ def annotate_flows(flows: Sequence[Flow], outputs: Dict[str, np.ndarray],
     verdicts = np.asarray(outputs["verdict"])
     specs = np.asarray(outputs.get("match_spec",
                                    np.full(len(flows), -1)))
-    now = time.time()
+    now = simclock.wall()
     trace_id = TRACER.current_trace_id()
     for i, f in enumerate(flows):
         f.verdict = Verdict(int(verdicts[i]))

@@ -16,6 +16,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from cilium_tpu.core.flow import Flow
+from cilium_tpu.runtime import simclock
 from cilium_tpu.hubble.observer import FlowFilter, Observer
 
 
@@ -300,7 +301,7 @@ class _PeerFollower:
                 # dead follower that still reports available would be
                 # a silent hole in the merged stream
                 self.connected = False
-                if self._stop.wait(backoff):
+                if simclock.wait_on(self._stop, backoff):
                     return
                 backoff = min(5.0, backoff * 2)
 

@@ -26,8 +26,7 @@ grace period — the ``cilium-operator`` identity-GC duty.
 from __future__ import annotations
 
 import json
-
-import time
+import threading
 from typing import Callable, Iterable, Optional
 
 from cilium_tpu.core.identity import (
@@ -38,7 +37,7 @@ from cilium_tpu.core.identity import (
 from cilium_tpu.core.identity_cache import IdentityCacheBase
 from cilium_tpu.core.labels import LabelSet
 from cilium_tpu.kvstore import EVENT_DELETE, Event
-from cilium_tpu.runtime import faults
+from cilium_tpu.runtime import faults, simclock
 from cilium_tpu.runtime.logging import get_logger
 from cilium_tpu.runtime.metrics import METRICS
 
@@ -126,7 +125,7 @@ class ClusterIdentityAllocator(IdentityCacheBase):
         enc = _encode_labels(labels)
         value_key = VALUE_PREFIX + enc
         payload = json.dumps({"labels": sorted(labels.format()),
-                              "ts": time.time()})
+                              "ts": simclock.wall()})
         for _ in range(64):
             gen = self._gen_of(labels)  # before ANY store read/write
             existing = self.store.get(value_key)
@@ -202,7 +201,7 @@ def gc_orphan_identities(store, grace_s: float = GC_GRACE_S) -> int:
     than ``grace_s`` (an in-flight claim must never be collected).
     Returns the number reaped."""
     referenced = set(store.list_prefix(VALUE_PREFIX).values())
-    now = time.time()
+    now = simclock.wall()
     reaped = 0
     for key, raw in store.list_prefix(ID_PREFIX).items():
         nid = key[len(ID_PREFIX):]
@@ -222,3 +221,128 @@ def gc_orphan_identities(store, grace_s: float = GC_GRACE_S) -> int:
         METRICS.inc("cilium_tpu_operator_identities_gc_total", 1)
         LOG.info("reaped orphan identity", extra={"fields": {"id": nid}})
     return reaped
+
+
+class RegenDebouncer:
+    """Coalesce a burst of identity-churn events into O(1)
+    regenerations.
+
+    The PR-8 churn-storm postmortem: every remote identity add/delete
+    reaching ``Agent._on_cluster_identity`` queued a full-policy
+    regeneration, so a 100-event storm (a node rebooting, a namespace
+    rollout) cost O(events) regenerations even though the *last* one
+    covers them all. This debouncer sits between the watch callback
+    and ``regenerate_all``: selector-cache updates stay synchronous
+    (policy correctness never waits), but the regeneration fires once
+    per quiet ``window_s`` — re-armed by each event, bounded by
+    ``max_delay_s`` so a sustained storm still regenerates at a
+    bounded staleness, never at event rate.
+
+    Clock-driven (runtime/simclock.py): under a VirtualClock the
+    window is an ``advance()`` away, so the churn soak proves the
+    O(1) property without sleeping through it. ``window_s<=0``
+    degrades to the old synchronous behavior (the knob's off switch).
+    """
+
+    def __init__(self, fire: Callable[[], None],
+                 window_s: float = 0.05, max_delay_s: float = 0.5):
+        self.fire = fire
+        self.window_s = float(window_s)
+        self.max_delay_s = max(float(max_delay_s), self.window_s)
+        self._lock = threading.Lock()
+        self._kick = simclock.event()
+        self._pending = 0
+        self._first: Optional[float] = None
+        self._deadline = 0.0
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        #: lifetime fires — the churn-soak O(1) assertion reads this
+        self.fires = 0
+
+    def note(self) -> None:
+        """One churn event. Coalesces with neighbors inside the
+        window; the (count-1) events a fire absorbs are counted on
+        ``cilium_tpu_identity_regen_coalesced_total``."""
+        if self.window_s <= 0.0:
+            self.fires += 1
+            self.fire()
+            return
+        with self._lock:
+            if self._closed:
+                return
+            now = simclock.now()
+            self._pending += 1
+            self._deadline = now + self.window_s
+            if self._first is None:
+                self._first = now
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True,
+                    name="identity-regen-debounce")
+                self._thread.start()
+        self._kick.set()
+
+    def _take_due(self) -> int:
+        """(pending count if the window closed, else 0); resets state
+        on a take."""
+        with self._lock:
+            if self._pending == 0:
+                return 0
+            now = simclock.now()
+            target = min(self._deadline,
+                         (self._first or now) + self.max_delay_s)
+            if now < target and not self._closed:
+                return 0
+            n, self._pending, self._first = self._pending, 0, None
+            self._kick.clear()
+            return n
+
+    def _wait_s(self) -> Optional[float]:
+        with self._lock:
+            if self._pending == 0:
+                return None  # idle: park on the kick event
+            target = min(self._deadline,
+                         (self._first or 0.0) + self.max_delay_s)
+            return max(0.0, target - simclock.now())
+
+    def _run(self) -> None:
+        while True:
+            if self._closed and self._pending == 0:
+                return
+            n = self._take_due()
+            if n:
+                METRICS.inc(
+                    "cilium_tpu_identity_regen_coalesced_total", n - 1)
+                self.fires += 1
+                try:
+                    self.fire()
+                except Exception:  # noqa: BLE001 — a failed regen is
+                    # logged by the regeneration path itself; the
+                    # debouncer must keep serving later windows
+                    LOG.error("debounced regeneration failed",
+                              exc_info=True)
+                continue
+            wait = self._wait_s()
+            simclock.wait_on(self._kick, wait)
+            if wait is None:
+                self._kick.clear()
+
+    def flush(self) -> None:
+        """Synchronously fire any pending coalesced regeneration (the
+        deterministic face for tests and shutdown)."""
+        with self._lock:
+            n, self._pending, self._first = self._pending, 0, None
+        if n:
+            METRICS.inc(
+                "cilium_tpu_identity_regen_coalesced_total", n - 1)
+            self.fires += 1
+            self.fire()
+
+    def close(self, flush: bool = False) -> None:
+        with self._lock:
+            self._closed = True
+        self._kick.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        if flush:
+            self.flush()

@@ -22,9 +22,9 @@ from the reference:
 
 from __future__ import annotations
 
-import time
 from typing import Callable, Dict, Optional
 
+from cilium_tpu.runtime import simclock
 from cilium_tpu.core.identity import (
     IDENTITY_SCOPE_LOCAL,
     IDENTITY_USER_MAX,
@@ -53,7 +53,7 @@ def identity_object(nid: int, labels: LabelSet) -> Dict:
         # upstream stores map[label]→value; a sorted canonical list is
         # the same information in this codebase's label format
         "security-labels": sorted(labels.format()),
-        "created-at": time.time(),
+        "created-at": simclock.wall(),
     }
 
 
@@ -210,7 +210,7 @@ def gc_crd_identities(client: K8sClient,
             referenced.add(str(int(ident["id"])))
         except (KeyError, TypeError, ValueError):
             pass  # corrupt/foreign CEP must not kill the GC pass
-    now = time.time()
+    now = simclock.wall()
     reaped = 0
     for obj in identities:
         name = obj["metadata"]["name"]

@@ -16,9 +16,9 @@ import json
 import socket
 import struct
 import threading
-import time
 from typing import Callable, Dict, Optional, Tuple
 
+from cilium_tpu.runtime import simclock
 from cilium_tpu.k8s.apiserver import K8sClient
 from cilium_tpu.runtime.logging import get_logger
 from cilium_tpu.runtime.service import recv_msg
@@ -128,16 +128,16 @@ class Informer:
         # starting alongside (or slightly before) the apiserver is a
         # normal boot-order race, not a fatal error — the reference
         # blocks in WaitForCacheSync the same way
-        deadline = time.monotonic() + self.sync_timeout
+        deadline = simclock.now() + self.sync_timeout
         backoff = 0.1
         while True:
             try:
                 rv = self._sync_list()
                 break
             except (OSError, ConnectionError, RuntimeError):
-                if time.monotonic() >= deadline:
+                if simclock.now() >= deadline:
                     raise
-                time.sleep(backoff)
+                simclock.sleep(backoff)
                 backoff = min(2.0, backoff * 2)
         self._thread = threading.Thread(
             target=self._run, args=(rv,), daemon=True,
@@ -152,7 +152,7 @@ class Informer:
                 sock = self.client.watch_socket(self.plural, rv,
                                                 self._instance)
             except OSError:
-                if self._stop.wait(backoff):
+                if simclock.wait_on(self._stop, backoff):
                     return
                 backoff = min(5.0, backoff * 2)
                 continue
@@ -179,7 +179,7 @@ class Informer:
                     sock.close()
                 except OSError:
                     pass
-            if self._stop.wait(backoff):
+            if simclock.wait_on(self._stop, backoff):
                 return
             backoff = min(5.0, backoff * 2)
             # stream broke or history compacted: ListAndWatch again
@@ -188,7 +188,7 @@ class Informer:
                     rv = self._sync_list()
                     break
                 except (OSError, ConnectionError, RuntimeError):
-                    if self._stop.wait(backoff):
+                    if simclock.wait_on(self._stop, backoff):
                         return
                     backoff = min(5.0, backoff * 2)
 

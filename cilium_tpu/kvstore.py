@@ -15,10 +15,9 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-import time
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
-from cilium_tpu.runtime import faults
+from cilium_tpu.runtime import faults, simclock
 from cilium_tpu.runtime.logging import get_logger
 from cilium_tpu.runtime.metrics import KVSTORE_WATCH_ERRORS, METRICS
 
@@ -51,14 +50,14 @@ class Lease:
 
     def __init__(self, ttl: float) -> None:
         self.ttl = ttl
-        self.deadline = time.monotonic() + ttl
+        self.deadline = simclock.now() + ttl
         self.revoked = False
 
     def keepalive(self) -> None:
-        self.deadline = time.monotonic() + self.ttl
+        self.deadline = simclock.now() + self.ttl
 
     def expired(self, now: Optional[float] = None) -> bool:
-        return self.revoked or (now or time.monotonic()) > self.deadline
+        return self.revoked or (now or simclock.now()) > self.deadline
 
 
 class Watch:
@@ -114,7 +113,7 @@ class KVStore:
         controller) instead of a dedicated expiry thread — keeps the
         store deterministic under test.
         """
-        now = time.monotonic()
+        now = simclock.now()
         with self._lock:
             dead = [k for k, (_, l) in self._data.items()
                     if l is not None and l.expired(now)]
@@ -215,7 +214,7 @@ class KVStore:
         w = Watch(self, prefix, callback)  # DELETE would ever follow
         with self._dispatch_lock:
             with self._lock:
-                now = time.monotonic()
+                now = simclock.now()
                 snapshot = [(k, v) for k, (v, l) in self._data.items()
                             if k.startswith(prefix)
                             and (l is None or not l.expired(now))

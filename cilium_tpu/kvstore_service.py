@@ -38,9 +38,9 @@ import socket
 import socketserver
 import struct
 import threading
-import time
 from typing import Callable, Dict, Optional
 
+from cilium_tpu.runtime import simclock
 from cilium_tpu.kvstore import Event, KVStore, Lease
 from cilium_tpu.runtime.logging import get_logger
 from cilium_tpu.runtime.service import recv_msg, send_msg
@@ -214,7 +214,7 @@ class KVStoreServer:
         return self
 
     def _sweep(self) -> None:
-        while not self._stop.wait(EXPIRY_SWEEP_S):
+        while not simclock.wait_on(self._stop, EXPIRY_SWEEP_S):
             self.store.expire_leases()
             # prune the id registry too, or every expiry/re-register
             # cycle leaks one entry for the life of the server
@@ -245,15 +245,15 @@ class RemoteLease:
         self._store = store
         self.id = lease_id
         self.ttl = ttl
-        self.deadline = time.monotonic() + ttl
+        self.deadline = simclock.now() + ttl
         self.revoked = False
 
     def keepalive(self) -> None:
         self._store._call({"op": "keepalive", "lease": self.id})
-        self.deadline = time.monotonic() + self.ttl
+        self.deadline = simclock.now() + self.ttl
 
     def expired(self, now: Optional[float] = None) -> bool:
-        return self.revoked or (now or time.monotonic()) > self.deadline
+        return self.revoked or (now or simclock.now()) > self.deadline
 
 
 class RemoteWatch:
@@ -423,7 +423,7 @@ class RemoteKVStore:
                 w = watch_box.get("w")
                 if w is None or w.stopped:
                     return
-                time.sleep(backoff)
+                simclock.sleep(backoff)
                 backoff = min(5.0, backoff * 2)
                 try:
                     newsock = self._connect()

@@ -20,11 +20,11 @@ from __future__ import annotations
 import dataclasses
 import enum
 import threading
-import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from cilium_tpu.runtime import simclock
 from cilium_tpu.core.flow import Flow, TrafficDirection, Verdict
 from cilium_tpu.runtime.metrics import METRICS
 
@@ -80,7 +80,7 @@ def events_from_outputs(flows: Sequence[Flow],
     verdicts = np.asarray(outputs["verdict"])
     specs = np.asarray(outputs.get("match_spec",
                                    np.full(len(flows), -1)))
-    now = time.time()
+    now = simclock.wall()
     events: List[MonitorEvent] = []
     for i, f in enumerate(flows):
         v = Verdict(int(verdicts[i]))

@@ -25,6 +25,7 @@ from typing import Dict, Optional
 
 from cilium_tpu.ipam import ClusterPool, PoolExhausted
 from cilium_tpu.kvstore import EVENT_DELETE, KVStore, Lease
+from cilium_tpu.runtime import simclock
 from cilium_tpu.runtime.controller import Controller
 from cilium_tpu.runtime.logging import get_logger
 from cilium_tpu.runtime.metrics import METRICS
@@ -295,14 +296,13 @@ class NodeRegistration:
 
     def wait_for_cidr(self, timeout: float = 5.0,
                       interval: float = 0.05) -> str:
-        import time
-
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        
+        deadline = simclock.now() + timeout
+        while simclock.now() < deadline:
             cidr = self.pod_cidr()
             if cidr:
                 return cidr
-            time.sleep(interval)
+            simclock.sleep(interval)
         raise TimeoutError(
             f"no podCIDR assigned to {self.node_name} within {timeout}s")
 

@@ -37,7 +37,6 @@ from __future__ import annotations
 
 import collections
 import dataclasses
-import time
 import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -50,7 +49,7 @@ from cilium_tpu.policy.compiler.dfa import (
     DFABank,
     compile_bank,
 )
-from cilium_tpu.runtime import faults
+from cilium_tpu.runtime import faults, simclock
 from cilium_tpu.runtime.checkpoint import ruleset_fingerprint
 from cilium_tpu.runtime.logging import get_logger
 from cilium_tpu.runtime.metrics import (
@@ -93,6 +92,15 @@ def partition_patterns(patterns: Sequence[str],
     per-pattern hash boundaries): add-then-delete of any subset returns
     the exact original groups, and an add/delete perturbs only the
     group(s) adjacent to the touched patterns."""
+    if faults.mutation_active("positional-banks"):
+        # DST planted bug (the pre-ISSUE-8 positional grouping): one
+        # delete shifts every later bank → O(policy) recompiles per
+        # update; the schedule search must catch the compile-bound
+        # invariant violating (tests/dst/test_planted.py)
+        uniq = sorted(set(patterns))
+        step = max(1, target)
+        return [tuple(uniq[i:i + step])
+                for i in range(0, len(uniq), step)]
     uniq = sorted(set(patterns))
     hard_cap = max(1, target) * _HARD_CAP_FACTOR
     groups: List[Tuple[str, ...]] = []
@@ -160,7 +168,7 @@ class BankRegistry:
 
     def __init__(self, quarantine_ttl_s: float = 30.0,
                  max_groups: int = 4096, max_bytes: int = 256 << 20,
-                 clock=time.monotonic):
+                 clock=None):
         #: key → [(DFABank, pattern tuple), ...] (a group splits into
         #: several banks when subset construction overflows)
         self._groups: "collections.OrderedDict[str, List[Tuple[DFABank, Tuple[str, ...]]]]" = \
@@ -174,7 +182,9 @@ class BankRegistry:
         self.max_groups = max_groups
         self.max_bytes = max_bytes
         self.bytes = 0
-        self.clock = clock
+        # quarantine TTLs ride the process clock (simclock) unless a
+        # test injects its own — virtual time expires them instantly
+        self.clock = clock if clock is not None else simclock.now
         #: lifetime counters (the churn soak's O(Δ) ledger)
         self.compiles = 0          # group compiles that succeeded
         self.bank_compiles = 0     # individual DFA banks built

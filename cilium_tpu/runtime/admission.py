@@ -48,10 +48,9 @@ reached the engine.
 from __future__ import annotations
 
 import threading
-import time
 from typing import Callable, Optional, Tuple
 
-from cilium_tpu.runtime import faults
+from cilium_tpu.runtime import faults, simclock
 from cilium_tpu.runtime.metrics import (
     ADMISSION_ADMITTED,
     ADMISSION_QUEUE_DEPTH,
@@ -78,7 +77,7 @@ ADMIT_POINT = faults.register_point(
 
 
 def deadline_from_ms(deadline_ms, default_ms: float,
-                     clock=time.monotonic) -> float:
+                     clock=None) -> float:
     """Absolute monotonic deadline from a wire-carried ``deadline_ms``.
     None/0/unparsable → the configured default; NEGATIVE passes
     through as already-expired (the caller declared it gave up — the
@@ -89,7 +88,8 @@ def deadline_from_ms(deadline_ms, default_ms: float,
         ms = 0.0
     if ms == 0.0:
         ms = float(default_ms)
-    return clock() + ms / 1e3
+    now = clock() if clock is not None else simclock.now()
+    return now + ms / 1e3
 
 
 def count_shed(surface: str, klass: str, reason: str) -> None:
@@ -109,12 +109,12 @@ class AdmissionGate:
     def __init__(self, max_pending: int = 1024,
                  control_reserve: int = 64, enabled: bool = True,
                  depth_fn: Optional[Callable[[], int]] = None,
-                 clock=time.monotonic, surface: str = "service"):
+                 clock=None, surface: str = "service"):
         self.max_pending = max(1, int(max_pending))
         self.control_reserve = max(0, int(control_reserve))
         self.enabled = bool(enabled)
         self.depth_fn = depth_fn
-        self.clock = clock
+        self.clock = clock if clock is not None else simclock.now
         self.surface = surface
         self._lock = threading.Lock()
         self._draining = False

@@ -11,6 +11,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, Optional
 
+from cilium_tpu.runtime import simclock
 from cilium_tpu.runtime.logging import get_logger
 from cilium_tpu.runtime.metrics import METRICS
 
@@ -73,7 +74,10 @@ class Controller:
                                     "status": "failure"})
                 delay = min(self.max_backoff,
                             self.interval * (2 ** min(self.failures, 8)))
-            self._wake.wait(timeout=delay)
+            # the interval/backoff wait rides the process clock: under
+            # a VirtualClock the next run is one advance() away, so
+            # heartbeat/reconcile controllers simulate hours in ms
+            simclock.wait_on(self._wake, delay)
             self._wake.clear()
 
 

@@ -1,0 +1,860 @@
+"""Deterministic simulation testing: seeded fault-schedule search over
+the serving plane.
+
+``make chaos``/``make churn`` replay the handful of fault schedules a
+human had patience to write; this module SEARCHES the schedule space.
+A schedule is a seeded list of events — fault arms at named injection
+points (runtime/faults.py), policy churn, identity churn storms,
+traffic rounds, drain→warm-restore cycles, virtual-time advances —
+executed against a small but real serving world (Loader + compiled
+engine + circuit breaker + capture-replay session + kvstore) under a
+driven :class:`~cilium_tpu.runtime.simclock.VirtualClock`, with
+standing invariants checked after every event:
+
+* **Oracle agreement** — served verdicts match a freshly-resolved CPU
+  oracle of the COMMITTED rule set whenever the loader is not
+  degraded (no stale reads, whatever faults fired), and are never
+  ERROR.
+* **Fail closed** — under bank quarantine the plane may deny more,
+  never serve ERROR; probes for never-allowed traffic always deny.
+* **Session honesty** — the live replay session's verdicts are
+  bit-equal to the serving engine's, and its memo accounting
+  (hits+misses == lookups) never lies.
+* **O(Δ) compile** — bank compiles grow with the CHANGE count, never
+  with policy size × updates.
+* **Liveness** — with faults exhausted, bounded virtual time recovers
+  everything: the breaker re-closes past its probe interval and
+  quarantined banks clear past their TTL.
+
+Determinism: the same ``CILIUM_TPU_DST_SEED`` replays a byte-identical
+event trace (pinned across runs AND ``PYTHONHASHSEED``\\ s by
+tests/dst/). A violating schedule is shrunk by delta debugging
+(:func:`shrink`) to a minimal event list and emitted as a committable
+JSON regression case. Planted-bug validation
+(``faults.mutation_active``) re-introduces a known fixed bug behind
+``CILIUM_TPU_DST_MUTATION`` and proves the search catches it within a
+bounded seed budget.
+
+``make dst`` sweeps ``Config.dst.schedules`` seeds and writes one
+provenance-stamped summary line (the perf ledger ties any later
+regression back to the schedule that exposed it via the
+``dst_seed``/``schedule_digest`` stamp — runtime/provenance.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import random
+import sys
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from cilium_tpu.runtime import faults, simclock
+
+#: schedule format epoch, stamped on every trace + shrunken case
+SCHEDULE_FORMAT = 1
+
+#: injection points the generator arms (all pre-registered by their
+#: owning modules)
+FAULT_POINTS = (
+    "engine.dispatch",
+    "loader.swap",
+    "loader.bank_compile",
+    "kvstore.churn_storm",
+)
+
+#: breaker/quarantine timings the schedules steer around; small so
+#: liveness checks cross them with single advances
+PROBE_INTERVAL_S = 5.0
+QUARANTINE_TTL_S = 30.0
+
+#: virtual advances the generator picks from — chosen to straddle the
+#: probe interval and quarantine TTL boundaries
+ADVANCES = (0.5, 2.0, 6.0, 31.0)
+
+#: bank compiles per committed change the O(Δ) invariant tolerates
+#: (matches the `make churn` acceptance bound)
+COMPILES_PER_CHANGE_BOUND = 4.0
+
+
+class InvariantViolation(AssertionError):
+    """One failed standing invariant, anchored to the event index."""
+
+    def __init__(self, index: int, name: str, detail: str):
+        super().__init__(f"event {index}: [{name}] {detail}")
+        self.index = index
+        self.invariant = name
+        self.detail = detail
+
+
+class SchedulePlan(faults.FaultPlan):
+    """A FaultPlan armed incrementally by schedule events: each
+    ``arm`` grants a point N one-shot fires consumed by its next
+    hits. Decisions are a pure function of the arm/hit sequence, so
+    the recorded trace replays byte-identically."""
+
+    def __init__(self):
+        super().__init__(rules=(), seed=0)
+        self._budget: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        #: (point, hit-ordinal-at-fire) — the replayable fire log
+        self.fires: List[Tuple[str, int]] = []
+        self._hits: Dict[str, int] = {}
+
+    def arm(self, point: str, times: int = 1) -> None:
+        with self._lock:
+            self._budget[point] = self._budget.get(point, 0) + times
+
+    def disarm_all(self) -> None:
+        with self._lock:
+            self._budget.clear()
+
+    def check(self, point: str) -> Optional[Exception]:
+        with self._lock:
+            idx = self._hits.get(point, 0)
+            self._hits[point] = idx + 1
+            left = self._budget.get(point, 0)
+            if left <= 0:
+                return None
+            self._budget[point] = left - 1
+            self.fires.append((point, idx))
+        return faults.FaultInjected(
+            f"dst scheduled fault at {point} (hit {idx})")
+
+
+# -- the world ---------------------------------------------------------------
+
+
+class DSTWorld:
+    """A small, real slice of the serving plane: resolved policy →
+    Loader → compiled engine + CPU oracle, breaker-guarded verdictor,
+    a live capture-replay session with the device-resident memo, and
+    a kvstore-backed identity allocator. Everything time-driven reads
+    the installed (virtual) clock."""
+
+    N_IDS = 3
+    BASE_PATHS = 4
+
+    def __init__(self, cache_dir: str):
+        from cilium_tpu.core.config import Config
+        from cilium_tpu.core.identity import IdentityAllocator
+        from cilium_tpu.core.labels import LabelSet
+        from cilium_tpu.runtime.loader import Loader
+        from cilium_tpu.runtime.service import (
+            CircuitBreaker,
+            ResilientVerdictor,
+        )
+
+        cfg = Config()
+        cfg.enable_tpu_offload = True
+        cfg.engine.bank_size = 2       # many small banks: O(Δ) visible
+        cfg.loader.cache_dir = cache_dir
+        cfg.loader.bank_quarantine_ttl_s = QUARANTINE_TTL_S
+        cfg.breaker.failure_threshold = 2
+        cfg.breaker.probe_interval = PROBE_INTERVAL_S
+        self.cfg = cfg
+        self.alloc = IdentityAllocator()
+        self.web = self.alloc.allocate(LabelSet.from_dict({"app": "web"}))
+        self.dbs = [self.alloc.allocate(
+            LabelSet.from_dict({"app": f"db{i}"}))
+            for i in range(self.N_IDS)]
+        #: identity index → list of (kind, pattern); the DESIRED state
+        self.rules_of = {
+            i: [("http", f"/svc{i}/p{j}/.*")
+                for j in range(self.BASE_PATHS)]
+            + [("dns", f"api{i}.corp.io")]
+            for i in range(self.N_IDS)}
+        #: the last state a successful commit (or warm restore) staged
+        #: — the oracle the serving plane is held to
+        self.committed = {i: list(v) for i, v in self.rules_of.items()}
+        self.loader = Loader(cfg)
+        self.loader.regenerate(self._resolve(), revision=1)
+        self.revision = 1
+        self.breaker = CircuitBreaker(
+            failure_threshold=cfg.breaker.failure_threshold,
+            probe_interval=cfg.breaker.probe_interval)
+        self.verdictor = ResilientVerdictor(self.loader,
+                                            breaker=self.breaker)
+        self._session = None
+        self._session_cols = None
+        #: bank compiles carried across warm-restart loader swaps so
+        #: the O(Δ) bound sees the whole schedule's work
+        self._compiles_carry = 0
+        self.compiles0 = self.bank_compiles()
+        self.changes = 0
+        #: regenerate ATTEMPTS (committed, rolled back, and liveness
+        #: retries alike) — the denominator of the O(Δ) bound: every
+        #: attempt may compile its delta, rollbacks included
+        self.attempts = 0
+        #: kvstore identity plane for churn storms
+        from cilium_tpu.identity_kvstore import ClusterIdentityAllocator
+        from cilium_tpu.kvstore import KVStore
+
+        self.store = KVStore()
+        self.cluster_alloc = ClusterIdentityAllocator(self.store).start()
+        self.storm_pool = [LabelSet.from_dict({"storm": f"s{i}"})
+                           for i in range(8)]
+
+    def bank_compiles(self) -> int:
+        reg = self.loader.bank_registry
+        return self._compiles_carry + (reg.compiles if reg else 0)
+
+    # -- policy ----------------------------------------------------------
+    def _resolve(self):
+        from cilium_tpu.core.flow import Protocol
+        from cilium_tpu.policy.api import (
+            EndpointSelector,
+            IngressRule,
+            PortProtocol,
+            PortRule,
+            Rule,
+        )
+        from cilium_tpu.policy.api.l7 import (
+            L7Rules,
+            PortRuleDNS,
+            PortRuleHTTP,
+        )
+        from cilium_tpu.policy.mapstate import PolicyResolver
+        from cilium_tpu.policy.repository import Repository
+        from cilium_tpu.policy.selectorcache import SelectorCache
+
+        repo = Repository()
+        rules = []
+        for i in range(self.N_IDS):
+            http = tuple(PortRuleHTTP(path=p, method="GET")
+                         for k, p in self.rules_of[i] if k == "http")
+            dns = tuple(PortRuleDNS(match_name=p)
+                        for k, p in self.rules_of[i] if k == "dns")
+            rules.append(Rule(
+                endpoint_selector=EndpointSelector.from_labels(
+                    app=f"db{i}"),
+                ingress=(IngressRule(
+                    from_endpoints=(
+                        EndpointSelector.from_labels(app="web"),),
+                    to_ports=(
+                        PortRule(ports=(PortProtocol(80, Protocol.TCP),),
+                                 rules=L7Rules(http=http)),
+                        PortRule(ports=(PortProtocol(53, Protocol.UDP),),
+                                 rules=L7Rules(dns=dns)),)),),
+            ))
+        repo.add(rules, sanitize=False)
+        resolver = PolicyResolver(repo, SelectorCache(self.alloc))
+        return {db: resolver.resolve(self.alloc.lookup(db))
+                for db in self.dbs}
+
+    def _http(self, i: int, path: str):
+        from cilium_tpu.core.flow import (
+            Flow,
+            HTTPInfo,
+            L7Type,
+            Protocol,
+            TrafficDirection,
+        )
+
+        return Flow(src_identity=self.web, dst_identity=self.dbs[i],
+                    dport=80, protocol=Protocol.TCP,
+                    direction=TrafficDirection.INGRESS, l7=L7Type.HTTP,
+                    http=HTTPInfo(method="GET", path=path))
+
+    def _dns(self, i: int, qname: str):
+        from cilium_tpu.core.flow import (
+            DNSInfo,
+            Flow,
+            L7Type,
+            Protocol,
+            TrafficDirection,
+        )
+
+        return Flow(src_identity=self.web, dst_identity=self.dbs[i],
+                    dport=53, protocol=Protocol.UDP,
+                    direction=TrafficDirection.INGRESS, l7=L7Type.DNS,
+                    dns=DNSInfo(query=qname))
+
+    def corpus(self):
+        """The probe corpus: every pattern in the UNION of committed
+        and desired states, plus never-allowed probes. Probing
+        desired-but-rolled-back patterns is what catches a plane
+        serving an aborted revision (it allows what the committed
+        oracle denies); the fixed probes are the fail-closed
+        canaries. Deterministic order."""
+        flows = []
+        for i in range(self.N_IDS):
+            pats = list(self.committed[i])
+            pats += [kp for kp in self.rules_of[i] if kp not in pats]
+            for kind, pat in pats:
+                if kind == "http":
+                    flows.append(self._http(
+                        i, pat.replace("/.*", "/x")))
+                else:
+                    flows.append(self._dns(i, pat))
+            flows.append(self._http(i, "/never/allowed"))
+            flows.append(self._dns(i, "evil.example"))
+        return flows
+
+    def oracle_verdicts(self, flows) -> List[int]:
+        """Ground truth: an OracleVerdictEngine over a FRESH resolve
+        of the committed rule set — independent of every staged/cached
+        structure the faults may have corrupted."""
+        from cilium_tpu.policy.oracle import OracleVerdictEngine
+
+        saved = {i: list(v) for i, v in self.rules_of.items()}
+        self.rules_of = {i: list(v) for i, v in self.committed.items()}
+        try:
+            per_identity = self._resolve()
+        finally:
+            self.rules_of = saved
+        oracle = OracleVerdictEngine(per_identity)
+        return [int(v) for v in
+                oracle.verdict_flows(flows)["verdict"]]
+
+    # -- event executors --------------------------------------------------
+    def churn(self, op: str, i: int, step: int) -> Dict:
+        """One policy update (add/delete a pattern) committed through
+        the loader; a swap/bank fault may make it roll back or commit
+        degraded — both recorded."""
+        if op == "delete":
+            extras = [(k, p) for k, p in self.rules_of[i]
+                      if "/churn" in p or p.startswith("churn")]
+            if not extras:
+                op = "add"  # nothing churned-in yet: degrade to add
+            else:
+                self.rules_of[i].remove(extras[0])
+        if op == "add":
+            self.rules_of[i].append(("http", f"/churn{step}/.*"))
+        self.revision += 1
+        rolled_back = False
+        reg = self.loader.bank_registry
+        quarantined_before = reg.status()["quarantined"] if reg else 0
+        # a registry with no cached groups (fresh process after a warm
+        # restore) legitimately compiles the whole plan on its first
+        # build — the adjacency bound only holds for a warm registry
+        warm_registry = bool(reg and reg.status()["groups"])
+        compiles_before = self.bank_compiles()
+        self.attempts += 1
+        try:
+            self.loader.regenerate(self._resolve(),
+                                   revision=self.revision)
+        except Exception:
+            # rollback path: the previous revision keeps serving and
+            # the DESIRED state stays un-committed
+            rolled_back = True
+        else:
+            self.committed = {j: list(v)
+                              for j, v in self.rules_of.items()}
+            self.changes += 1
+        compiles = self.bank_compiles() - compiles_before
+        if not warm_registry:
+            # cold-start rebuild: baseline it out of the O(Δ) window
+            self.compiles0 += compiles
+            self.attempts -= 1
+        quarantined_after = reg.status()["quarantined"] if reg else 0
+        if (op == "delete" and not rolled_back and warm_registry
+                and quarantined_before == 0 and quarantined_after == 0
+                and compiles > COMPILES_PER_CHANGE_BOUND):
+            # the content-defined partition's core property: a delete
+            # perturbs only the adjacent bank(s). The positional-banks
+            # planted bug shifts every later bank and trips this.
+            raise InvariantViolation(
+                step, "o-delta-compile",
+                f"one clean delete compiled {compiles} banks "
+                f"(> {COMPILES_PER_CHANGE_BOUND}: membership shifted "
+                f"wholesale)")
+        return {"op": op, "identity": i, "rolled_back": rolled_back,
+                "compiles": compiles,
+                "degraded": bool(self.loader.bank_status().get(
+                    "degraded"))}
+
+    def traffic(self, index: int) -> Dict:
+        """One verdict round through the breaker-guarded verdictor +
+        the live session, with the oracle/fail-closed/session
+        invariants."""
+        from cilium_tpu.core.flow import Verdict
+
+        flows = self.corpus()
+        want = self.oracle_verdicts(flows)
+        got = self.verdictor.verdicts(flows)
+        if int(Verdict.ERROR) in got:
+            raise InvariantViolation(index, "no-error-verdicts",
+                                     f"served ERROR: {got}")
+        degraded = bool(self.loader.bank_status().get("degraded"))
+        if not degraded and got != want:
+            raise InvariantViolation(
+                index, "oracle-agreement",
+                f"served {got} != oracle {want} (not degraded)")
+        if degraded:
+            # fail-closed: a quarantined plane may deny more than the
+            # oracle, never allow what the oracle denies
+            for k, (g, w) in enumerate(zip(got, want)):
+                if w == int(Verdict.DROPPED) and g != w:
+                    raise InvariantViolation(
+                        index, "fail-closed",
+                        f"flow {k}: oracle denies, degraded plane "
+                        f"served {g}")
+        sess = self.session_verdicts(index)
+        return {"verdicts": _digest(got), "degraded": degraded,
+                "breaker": self.breaker.state, "session": sess}
+
+    def session_verdicts(self, index: int) -> Dict:
+        """The live capture-replay session must follow every commit
+        (bit-equal to the serving engine) with honest memo accounting."""
+        from cilium_tpu.core.flow import Verdict
+
+        try:
+            if self._session is None:
+                from cilium_tpu.engine.verdict import CaptureReplay
+                from cilium_tpu.ingest.columnar import flows_to_columns
+
+                # the staged capture is pinned at session birth: later
+                # churn invalidates memo rows bank-scoped, it does not
+                # change which rows the session replays
+                self._session_flows = self.corpus() * 4
+                cols = flows_to_columns(self._session_flows)
+                self._session_cols = cols
+                replay = CaptureReplay(self.loader.engine, cols.l7,
+                                       cols.offsets, cols.blob,
+                                       self.cfg.engine, gen=cols.gen,
+                                       loader=self.loader)
+                replay.stage_rows(cols.rec, cols.l7)
+                replay.stage_unique()
+                self._session = replay
+            cols = self._session_cols
+            out = self._session.verdict_chunk(cols.rec, cols.l7)
+        except InvariantViolation:
+            raise
+        except Exception as e:  # noqa: BLE001 — an injected dispatch
+            # fault failing the session chunk is a legitimate outcome
+            # (the stream path rebuilds its session the same way);
+            # the NEXT round must stage fresh and agree again
+            self._session = None
+            return {"faulted": type(e).__name__}
+        got = [int(v) for v in out["verdict"]]
+        if int(Verdict.ERROR) in got:
+            raise InvariantViolation(index, "session-no-error",
+                                     "session served ERROR")
+        engine = self.loader.engine
+        try:
+            want = [int(v) for v in engine.verdict_flows(
+                self._session_flows)["verdict"]]
+        except Exception as e:  # noqa: BLE001 — injected dispatch fault
+            # on the comparison round: skip the bit-equality check,
+            # keep the session; its verdicts were already checked
+            # ERROR-free above
+            return {"verdicts": _digest(got),
+                    "compare_faulted": type(e).__name__}
+        if got != want:
+            raise InvariantViolation(
+                index, "session-stale",
+                "session verdicts diverged from the serving engine")
+        m = self._session.memo
+        memo = {}
+        if m is not None:
+            if m.hits + m.misses < m.hits or m.hits < 0 or m.misses < 0:
+                raise InvariantViolation(index, "memo-accounting",
+                                         f"hits={m.hits} "
+                                         f"misses={m.misses}")
+            memo = {"hits": m.hits, "misses": m.misses,
+                    "invalidations": m.invalidations}
+        return {"verdicts": _digest(got), "memo": memo}
+
+    def storm(self, n: int, index: int) -> Dict:
+        """A burst of identity add/delete through the kvstore watch
+        (the churn_storm point may lose deliveries); local allocation
+        and a fresh replay-then-follow must converge regardless."""
+        from cilium_tpu.identity_kvstore import (
+            ClusterIdentityAllocator,
+            VALUE_PREFIX,
+        )
+
+        for k in range(n):
+            labels = self.storm_pool[k % len(self.storm_pool)]
+            if k % 3 == 2:
+                nid = self.cluster_alloc.lookup_by_labels(labels)
+                if nid is not None:
+                    enc = ";".join(sorted(labels.format()))
+                    self.store.delete(VALUE_PREFIX + enc)
+            else:
+                self.cluster_alloc.allocate(labels)
+        # convergence: a fresh allocator replaying the store agrees
+        # with the store's authoritative mappings
+        fresh = ClusterIdentityAllocator(self.store).start()
+        try:
+            for key, raw in self.store.list_prefix(
+                    VALUE_PREFIX).items():
+                enc = key[len(VALUE_PREFIX):]
+                from cilium_tpu.identity_kvstore import _decode_enc
+
+                nid = fresh.lookup_by_labels(_decode_enc(enc))
+                if nid != int(raw):
+                    raise InvariantViolation(
+                        index, "identity-convergence",
+                        f"fresh replay maps {enc!r} to {nid}, "
+                        f"store says {raw}")
+        finally:
+            fresh.close()
+        return {"events": n, "store_keys": len(self.store)}
+
+    def drain_restore(self, index: int) -> Dict:
+        """Warm-restart cycle: snapshot the serving state, restore it
+        into a FRESH loader (the restarted process), and re-point the
+        verdictor/session at it — first answers must be verdict-
+        identical (the traffic invariant right after proves it)."""
+        from cilium_tpu.runtime.loader import Loader
+        from cilium_tpu.runtime.service import ResilientVerdictor
+
+        warm = self.loader.snapshot_warm()
+        restored = False
+        crashed = ""
+        if warm:
+            fresh = Loader(self.cfg)
+            try:
+                restored = fresh.restore_warm()
+            except Exception as e:  # noqa: BLE001 — an injected swap
+                # fault mid-restore models a crash during warm boot;
+                # the OLD process keeps serving (restored stays False)
+                crashed = type(e).__name__
+            if restored:
+                self._compiles_carry = self.bank_compiles()
+                self.loader = fresh
+                self.verdictor = ResilientVerdictor(
+                    self.loader, breaker=self.breaker)
+                # the restarted process stages a fresh session, and
+                # its empty bank registry re-compiles the plan once —
+                # cold-start cost, not churn cost: reset the O(Δ)
+                # accounting window to this incarnation
+                self._session = None
+                self.compiles0 = self.bank_compiles()
+                self.attempts = 0
+        return {"warm_snapshot": warm, "restored": restored,
+                "crashed": crashed, "revision": self.loader.revision}
+
+    # -- end-of-schedule liveness -----------------------------------------
+    def check_liveness(self, plan: SchedulePlan, clock, index: int,
+                      ) -> Dict:
+        """With faults exhausted, bounded virtual time recovers the
+        plane: breaker re-closes, quarantines clear, verdicts match."""
+        from cilium_tpu.runtime.service import CircuitBreaker
+
+        plan.disarm_all()
+        clock.advance(PROBE_INTERVAL_S + 0.1)
+        out = self.traffic(index)
+        if self.breaker.state != CircuitBreaker.CLOSED:
+            raise InvariantViolation(
+                index, "breaker-liveness",
+                f"breaker state {self.breaker.state} after a healthy "
+                f"round past the probe interval")
+        if out["degraded"]:
+            clock.advance(QUARANTINE_TTL_S + 0.1)
+            self.revision += 1
+            self.attempts += 1
+            self.loader.regenerate(self._resolve(),
+                                   revision=self.revision)
+            self.committed = {j: list(v)
+                              for j, v in self.rules_of.items()}
+            if self.loader.bank_status().get("degraded"):
+                raise InvariantViolation(
+                    index, "quarantine-liveness",
+                    "bank quarantine survived TTL + regeneration "
+                    "with faults exhausted")
+            out = self.traffic(index)
+        compiles = self.bank_compiles() - self.compiles0
+        if self.attempts and compiles / self.attempts > \
+                COMPILES_PER_CHANGE_BOUND:
+            raise InvariantViolation(
+                index, "o-delta-compile",
+                f"{compiles} bank compiles over {self.attempts} "
+                f"regenerate attempts "
+                f"(> {COMPILES_PER_CHANGE_BOUND}/attempt: "
+                f"wholesale recompiles)")
+        return {"final": out, "bank_compiles": compiles,
+                "changes": self.changes, "attempts": self.attempts}
+
+    def close(self) -> None:
+        self.cluster_alloc.close()
+
+
+def _digest(verdicts: Sequence[int]) -> str:
+    return hashlib.sha256(bytes(int(v) & 0xFF
+                                for v in verdicts)).hexdigest()[:16]
+
+
+# -- schedules ---------------------------------------------------------------
+
+
+def generate(seed: int, max_events: int = 12) -> List[List]:
+    """The seeded schedule: a concrete event list (JSON-serializable,
+    self-contained) so a shrunken subset re-runs without the RNG."""
+    rng = random.Random(seed)
+    n = rng.randint(max(3, max_events // 2), max_events)
+    events: List[List] = []
+    for k in range(n):
+        roll = rng.random()
+        if roll < 0.22:
+            point = rng.choice(FAULT_POINTS)
+            events.append(["fault", point, rng.randint(1, 3)])
+        elif roll < 0.40:
+            events.append(["churn",
+                           rng.choice(["add", "add", "delete"]),
+                           rng.randrange(DSTWorld.N_IDS)])
+        elif roll < 0.62:
+            events.append(["traffic"])
+        elif roll < 0.74:
+            events.append(["advance", rng.choice(ADVANCES)])
+        elif roll < 0.86:
+            events.append(["storm", rng.randint(4, 24)])
+        else:
+            events.append(["drain-restore"])
+    # every schedule ends with the liveness epilogue (implicit)
+    return events
+
+
+def schedule_digest(events: Sequence[Sequence]) -> str:
+    return hashlib.sha256(json.dumps(
+        list(events), sort_keys=True).encode()).hexdigest()[:16]
+
+
+def run_schedule(seed: int, events: Optional[List[List]] = None,
+                 cache_dir: Optional[str] = None,
+                 max_events: int = 12) -> Dict:
+    """Execute one schedule under a fresh world + driven VirtualClock.
+    Returns ``{"seed", "events", "trace", "digest", "violation"}``;
+    the trace is byte-identical for identical (seed, events)."""
+    if events is None:
+        events = generate(seed, max_events=max_events)
+    # a FRESH artifact-cache dir per schedule: a pre-warmed cache
+    # would skip bank compiles and change the trace's compile counts —
+    # byte-identical replay requires a byte-identical starting state
+    import shutil
+    import tempfile
+
+    own_cache = cache_dir is None
+    if own_cache:
+        cache_dir = tempfile.mkdtemp(prefix="ct_dst_")
+    trace: List[Dict] = []
+    violation: Optional[Dict] = None
+    plan = SchedulePlan()
+    clock = simclock.VirtualClock()
+    with simclock.use(clock):
+        world = DSTWorld(cache_dir)
+        try:
+            with faults.inject(plan):
+                for i, ev in enumerate(events):
+                    kind = ev[0]
+                    try:
+                        if kind == "fault":
+                            plan.arm(ev[1], int(ev[2]))
+                            out = {"armed": ev[1], "times": int(ev[2])}
+                        elif kind == "churn":
+                            out = world.churn(ev[1], int(ev[2]) %
+                                              DSTWorld.N_IDS, step=i)
+                        elif kind == "traffic":
+                            out = world.traffic(i)
+                        elif kind == "advance":
+                            clock.advance(float(ev[1]))
+                            out = {"now": round(clock.now(), 6)}
+                        elif kind == "storm":
+                            out = world.storm(int(ev[1]), i)
+                        elif kind == "drain-restore":
+                            out = world.drain_restore(i)
+                        else:
+                            raise ValueError(f"unknown event {ev!r}")
+                    except InvariantViolation as v:
+                        violation = {"index": v.index,
+                                     "invariant": v.invariant,
+                                     "detail": v.detail}
+                        trace.append({"i": i, "t": round(clock.now(), 6),
+                                      "event": list(ev),
+                                      "violation": violation})
+                        break
+                    trace.append({"i": i, "t": round(clock.now(), 6),
+                                  "event": list(ev), "out": out})
+                if violation is None:
+                    try:
+                        out = world.check_liveness(plan, clock,
+                                                   len(events))
+                        trace.append({"i": len(events),
+                                      "t": round(clock.now(), 6),
+                                      "event": ["liveness"],
+                                      "out": out})
+                    except InvariantViolation as v:
+                        violation = {"index": v.index,
+                                     "invariant": v.invariant,
+                                     "detail": v.detail}
+                        trace.append({"i": len(events),
+                                      "t": round(clock.now(), 6),
+                                      "event": ["liveness"],
+                                      "violation": violation})
+        finally:
+            world.close()
+            if own_cache:
+                shutil.rmtree(cache_dir, ignore_errors=True)
+    blob = json.dumps({"format": SCHEDULE_FORMAT, "seed": seed,
+                       "events": events, "trace": trace},
+                      sort_keys=True)
+    return {"seed": seed, "events": events, "trace": trace,
+            "digest": hashlib.sha256(blob.encode()).hexdigest(),
+            "schedule_digest": schedule_digest(events),
+            "violation": violation}
+
+
+# -- search + shrink ---------------------------------------------------------
+
+
+def search(schedules: int, seed0: int = 0, max_events: int = 12,
+           cache_dir: Optional[str] = None,
+           progress=None) -> Tuple[int, Optional[Dict]]:
+    """Run ``schedules`` seeded schedules; returns (count_run, first
+    violating result or None)."""
+    for k in range(schedules):
+        res = run_schedule(seed0 + k, cache_dir=cache_dir,
+                           max_events=max_events)
+        if progress is not None:
+            progress(k, res)
+        if res["violation"] is not None:
+            return k + 1, res
+    return schedules, None
+
+
+def shrink(seed: int, events: List[List],
+           cache_dir: Optional[str] = None) -> Dict:
+    """Delta-debug a violating schedule to a (1-)minimal event list:
+    repeatedly drop chunks, keeping any subset that still violates.
+    Returns the final violating result (its ``events`` are minimal —
+    removing any single event no longer violates)."""
+    def violates(evs: List[List]) -> Optional[Dict]:
+        res = run_schedule(seed, events=evs, cache_dir=cache_dir)
+        return res if res["violation"] is not None else None
+
+    best = violates(events)
+    assert best is not None, "shrink() needs a violating schedule"
+    n = 2
+    evs = list(events)
+    while len(evs) >= 2:
+        chunk = max(1, len(evs) // n)
+        shrunk = False
+        for start in range(0, len(evs), chunk):
+            cand = evs[:start] + evs[start + chunk:]
+            if not cand:
+                continue
+            res = violates(cand)
+            if res is not None:
+                evs, best = cand, res
+                n = max(n - 1, 2)
+                shrunk = True
+                break
+        if not shrunk:
+            if n >= len(evs):
+                break
+            n = min(len(evs), n * 2)
+    return best
+
+
+def emit_regression(result: Dict, out_dir: str) -> str:
+    """Write a violating (ideally shrunken) schedule as a committable
+    regression case; tests/dst/ replays every file in its corpus
+    directory."""
+    os.makedirs(out_dir, exist_ok=True)
+    name = (f"dst_seed{result['seed']}_"
+            f"{result['schedule_digest']}.json")
+    path = os.path.join(out_dir, name)
+    with open(path, "w") as fp:
+        json.dump({"format": SCHEDULE_FORMAT,
+                   "seed": result["seed"],
+                   "events": result["events"],
+                   "violation": result["violation"],
+                   "mutation": os.environ.get(faults.MUTATION_ENV, "")},
+                  fp, indent=2, sort_keys=True)
+        fp.write("\n")
+    return path
+
+
+# -- the `make dst` lane -----------------------------------------------------
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    from cilium_tpu.core.config import Config
+
+    cfg = Config.from_env()
+    ap = argparse.ArgumentParser(
+        description="seeded fault-schedule search (DST)")
+    ap.add_argument("--schedules", type=int, default=cfg.dst.schedules)
+    ap.add_argument("--seed", type=int, default=cfg.dst.seed,
+                    help="first seed (CILIUM_TPU_DST_SEED)")
+    ap.add_argument("--max-events", type=int, default=cfg.dst.max_events)
+    ap.add_argument("--replay", action="store_true",
+                    help="run ONLY --seed and print its trace")
+    ap.add_argument("--shrink", action="store_true",
+                    help="delta-debug the first violation to a "
+                         "minimal schedule")
+    ap.add_argument("--out", default="BENCH_DST_r06.jsonl")
+    ap.add_argument("--regressions", default="tests/dst/regressions")
+    args = ap.parse_args(argv)
+
+    t0 = simclock.perf()
+    if args.replay:
+        res = run_schedule(args.seed, max_events=args.max_events)
+        print(json.dumps(res, indent=2, sort_keys=True))
+        return 1 if res["violation"] else 0
+
+    distinct = set()
+    sim_s = [0.0]
+
+    def progress(k, res):
+        distinct.add(res["schedule_digest"])
+        sim_s[0] += res["trace"][-1]["t"] if res["trace"] else 0.0
+        if (k + 1) % 25 == 0:
+            print(f"[dst] {k + 1}/{args.schedules} schedules, "
+                  f"{len(distinct)} distinct, "
+                  f"{sim_s[0]:.0f}s simulated", flush=True)
+
+    ran, failing = search(args.schedules, seed0=args.seed,
+                          max_events=args.max_events,
+                          progress=progress)
+    wall_s = simclock.perf() - t0
+    line = {
+        "metric": "dst_schedules_explored",
+        "value": ran,
+        "unit": "schedules",
+        "lane": "dst",
+        "distinct_schedules": len(distinct),
+        "violations": 0 if failing is None else 1,
+        "simulated_s": round(sim_s[0], 3),
+        "wall_s": round(wall_s, 3),
+        "speedup_vs_real_time": round(sim_s[0] / max(wall_s, 1e-9), 1),
+        "seed0": args.seed,
+        "max_events": args.max_events,
+        "mutation": os.environ.get(faults.MUTATION_ENV, ""),
+    }
+    if failing is not None:
+        line["failing_seed"] = failing["seed"]
+        line["failing_invariant"] = failing["violation"]["invariant"]
+        print(f"[dst] VIOLATION at seed {failing['seed']}: "
+              f"{failing['violation']}", flush=True)
+        if args.shrink:
+            small = shrink(failing["seed"], failing["events"])
+            path = emit_regression(small, args.regressions)
+            line["shrunk_events"] = len(small["events"])
+            line["regression_case"] = path
+            print(f"[dst] shrunk to {len(small['events'])} events "
+                  f"-> {path}", flush=True)
+    from cilium_tpu.runtime.provenance import stamp
+
+    # the lane's own bench line rides the dst provenance stamp: seed0
+    # + a digest over the distinct schedules explored
+    os.environ["CILIUM_TPU_DST_SEED"] = str(args.seed)
+    os.environ["CILIUM_TPU_DST_DIGEST"] = hashlib.sha256(
+        ",".join(sorted(distinct)).encode()).hexdigest()[:16]
+    stamp(line)
+    with open(args.out, "a") as fp:
+        fp.write(json.dumps(line) + "\n")
+    print(f"[dst] {ran} schedules ({len(distinct)} distinct), "
+          f"{line['violations']} violation(s); simulated "
+          f"{sim_s[0]:.0f}s of virtual time in {wall_s:.1f}s wall "
+          f"({line['speedup_vs_real_time']}x)", flush=True)
+    return 1 if failing is not None else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

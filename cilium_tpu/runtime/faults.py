@@ -67,6 +67,7 @@ Known injection points (registered by the modules owning the seam):
 from __future__ import annotations
 
 import contextlib
+import os
 import random
 import threading
 import zlib
@@ -214,6 +215,30 @@ def inject(plan: FaultPlan):
         yield plan
     finally:
         clear()
+
+
+#: DST mutation testing (runtime/dst.py): ``CILIUM_TPU_DST_MUTATION``
+#: names a known FIXED bug to re-introduce, so the schedule search can
+#: prove it would have caught the bug. Off (empty) in production; the
+#: env var is read per call so tests toggle it with monkeypatch.
+MUTATION_ENV = "CILIUM_TPU_DST_MUTATION"
+
+#: mutation name → where the planted bug lives (introspection/docs)
+MUTATIONS: Dict[str, str] = {
+    "rollback-artifact-key":
+        "Loader.regenerate rollback keeps _last_artifact_key at the "
+        "aborted revision (the PR-7 warm-snapshot staleness bug)",
+    "positional-banks":
+        "bankplan.partition_patterns groups positionally — one delete "
+        "shifts every later bank (the pre-PR-8 O(policy) compile bug)",
+}
+
+
+def mutation_active(name: str) -> bool:
+    """True when the named planted bug is armed. The seams guard their
+    buggy variant with this, so shipped behavior is untouched unless
+    the DST validation lane arms the mutation explicitly."""
+    return os.environ.get(MUTATION_ENV, "") == name
 
 
 def maybe_fail(point: str) -> None:

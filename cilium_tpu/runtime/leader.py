@@ -20,6 +20,7 @@ import threading
 from typing import Callable, Optional
 
 from cilium_tpu.runtime.logging import get_logger
+from cilium_tpu.runtime import simclock
 from cilium_tpu.runtime.metrics import METRICS
 
 LOG = get_logger("leader")
@@ -63,7 +64,7 @@ class LeaderElector:
             try:
                 lease = self.store.lease(self.ttl)
             except Exception:  # store briefly unreachable: retry
-                if self._stop.wait(interval):
+                if simclock.wait_on(self._stop, interval):
                     return
                 continue
             try:
@@ -77,7 +78,7 @@ class LeaderElector:
                 # ctlint: disable=swallowed-exception  # best-effort revoke of a lost campaign; the lease ages out
                 except Exception:  # noqa: BLE001
                     pass
-                if self._stop.wait(interval):
+                if simclock.wait_on(self._stop, interval):
                     return
                 continue
             self._lead(lease, interval)
@@ -113,7 +114,7 @@ class LeaderElector:
         ka_stop = threading.Event()
 
         def ticker() -> None:
-            while not ka_stop.wait(interval):
+            while not simclock.wait_on(ka_stop, interval):
                 try:
                     lease.keepalive()
                 except Exception:  # lost anyway; main loop detects
@@ -128,7 +129,7 @@ class LeaderElector:
             finally:
                 ka_stop.set()
                 t.join(timeout=5.0)
-            while not self._stop.wait(interval):
+            while not simclock.wait_on(self._stop, interval):
                 try:
                     lease.keepalive()
                     if self.store.get(self.key) != self.identity:

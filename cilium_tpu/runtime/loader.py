@@ -261,8 +261,11 @@ class Loader:
                     # already moved it, and a later snapshot_warm /
                     # restore_warm would otherwise restage the ABORTED
                     # revision's policy under the serving revision's
-                    # name (found by the ISSUE-7 memo staleness suite)
-                    self._last_artifact_key = prev[3]
+                    # name (found by the ISSUE-7 memo staleness suite).
+                    # The DST mutation re-plants exactly that bug so
+                    # the schedule search can prove it catches it.
+                    if not faults.mutation_active("rollback-artifact-key"):
+                        self._last_artifact_key = prev[3]
                     # ...and so do the delta inputs: fingerprints/plan
                     # of the ABORTED build must not seed the next
                     # commit's bank-scoped invalidation

@@ -28,8 +28,9 @@ from __future__ import annotations
 import json
 import logging
 import sys
-import time
 from typing import Optional
+
+from cilium_tpu.runtime import simclock
 
 ROOT = "cilium_tpu"
 
@@ -126,11 +127,11 @@ def span(log: logging.LoggerAdapter, msg: str, **fields):
 
     class _Span:
         def __enter__(self):
-            self.t0 = time.monotonic()
+            self.t0 = simclock.now()
             return self
 
         def __exit__(self, exc_type, exc, tb):
-            dur = round(time.monotonic() - self.t0, 6)
+            dur = round(simclock.now() - self.t0, 6)
             all_fields = dict(fields, duration_s=dur)
             if exc is not None:
                 all_fields["failed"] = f"{type(exc).__name__}: {exc}"

@@ -477,6 +477,10 @@ METRICS.describe("cilium_tpu_controller_runs_total",
                  "controller loop runs, by name and status")
 METRICS.describe("cilium_tpu_endpoint_regenerations_total",
                  "per-endpoint regeneration completions, by status")
+METRICS.describe("cilium_tpu_identity_regen_coalesced_total",
+                 "identity-churn events absorbed by an already-armed "
+                 "regeneration debounce window (storm size minus the "
+                 "one regeneration that covered it)")
 METRICS.describe("cilium_tpu_endpoints",
                  "endpoints currently managed")
 METRICS.describe("cilium_tpu_endpoints_restored_total",

@@ -62,6 +62,7 @@ class Profiler:
 
         jax.profiler.start_trace(out_dir)
         try:
+            # ctlint: disable=wall-clock  # the device trace window is real seconds of real execution by definition
             time.sleep(seconds)
         finally:
             jax.profiler.stop_trace()
@@ -75,7 +76,9 @@ class Profiler:
         stacks: Counter = Counter()
         samples = 0
         interval = 1.0 / hz
+        # ctlint: disable=wall-clock  # sampling profiler: the capture window measures real execution, never simulated time
         deadline = time.monotonic() + seconds
+        # ctlint: disable=wall-clock  # see above — real capture window
         while time.monotonic() < deadline:
             for tid, frame in sys._current_frames().items():
                 if tid == me:
@@ -90,11 +93,14 @@ class Profiler:
                     frame = frame.f_back
                 stacks[";".join(reversed(parts))] += 1
             samples += 1
+            # ctlint: disable=wall-clock  # real sampling cadence (hz is a real-time rate)
             time.sleep(interval)
         # ns resolution: two quick captures in one wall-clock second
         # must not overwrite each other
         path = os.path.join(
-            out_dir, f"host_profile_{time.time_ns()}.collapsed")
+            out_dir,
+            # ctlint: disable=wall-clock  # filename uniqueness stamp
+            f"host_profile_{time.time_ns()}.collapsed")
         with open(path, "w") as fp:
             for stack, count in stacks.most_common():
                 fp.write(f"{stack} {count}\n")

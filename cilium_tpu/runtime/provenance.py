@@ -83,6 +83,7 @@ def fingerprint(rtt: bool = True,
     process — still get host/git identity)."""
     fp: Dict[str, object] = {
         "schema": BENCH_SCHEMA,
+        # ctlint: disable=wall-clock  # provenance stamps record when the REAL world produced this artifact
         "captured_unix": int(time.time()),
         "host_platform": platform.platform(),
         "python": platform.python_version(),
@@ -112,12 +113,39 @@ def fingerprint(rtt: bool = True,
     return fp
 
 
+def dst_stamp() -> Optional[Dict[str, object]]:
+    """The deterministic-simulation provenance rider: when a lane runs
+    under the DST harness (``CILIUM_TPU_DST_SEED`` set by `make dst` /
+    the converted chaos/churn lanes), its bench lines carry the seed
+    and schedule digest, so perf-report can tie a regression to the
+    exact fault schedule that exposed it (replay:
+    ``python -m cilium_tpu.runtime.dst --replay --seed N``)."""
+    seed = os.environ.get("CILIUM_TPU_DST_SEED")
+    if seed is None:
+        return None
+    out: Dict[str, object] = {}
+    try:
+        out["dst_seed"] = int(seed)
+    except ValueError:
+        out["dst_seed"] = seed
+    digest = os.environ.get("CILIUM_TPU_DST_DIGEST")
+    if digest:
+        out["schedule_digest"] = digest
+    mutation = os.environ.get("CILIUM_TPU_DST_MUTATION")
+    if mutation:
+        out["mutation"] = mutation
+    return out
+
+
 def stamp(obj: Dict, rtt: bool = True) -> Dict:
     """Stamp ``obj`` (a bench line or artifact dict) in place with the
     versioned schema tag + fingerprint; returns ``obj``. Never raises."""
     try:
         obj["bench_schema"] = BENCH_SCHEMA
         obj["provenance"] = fingerprint(rtt=rtt)
+        dst = dst_stamp()
+        if dst is not None:
+            obj["dst"] = dst
     except Exception as e:  # noqa: BLE001 — the bench line must still
         # print; the stamp records its own failure instead of raising
         obj.setdefault("provenance", None)

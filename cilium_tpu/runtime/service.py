@@ -31,7 +31,6 @@ import socket
 import socketserver
 import struct
 import threading
-import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 from cilium_tpu.core.flow import (
@@ -47,7 +46,7 @@ from cilium_tpu.core.flow import (
 )
 from cilium_tpu.ingest.hubble import flow_from_dict
 from cilium_tpu.proxylib.parser import Connection, create_parser
-from cilium_tpu.runtime import admission, faults
+from cilium_tpu.runtime import admission, faults, simclock
 from cilium_tpu.runtime.loader import Loader
 from cilium_tpu.runtime.logging import get_logger
 from cilium_tpu.runtime.metrics import (
@@ -123,16 +122,18 @@ class CircuitBreaker:
     "verdict" op and the stream sessions all share one instance, so
     "N consecutive failures" means N across the whole service, exactly
     like an operator would count them. ``clock`` is injectable so the
-    chaos suite drives the probe timer deterministically."""
+    chaos suite drives the probe timer deterministically; the default
+    follows the process clock (runtime/simclock.py), so a DST run's
+    virtual clock drives every breaker built after install."""
 
     CLOSED, OPEN, HALF_OPEN = 0, 1, 2
     _NAMES = {0: "closed", 1: "open", 2: "half-open"}
 
     def __init__(self, failure_threshold: int = 3,
-                 probe_interval: float = 5.0, clock=time.monotonic):
+                 probe_interval: float = 5.0, clock=None):
         self.failure_threshold = max(1, int(failure_threshold))
         self.probe_interval = float(probe_interval)
-        self.clock = clock
+        self.clock = clock if clock is not None else simclock.now
         self._lock = threading.Lock()
         self._state = self.CLOSED
         self._consecutive_failures = 0
@@ -265,7 +266,7 @@ class ResilientVerdictor:
         if deadline is not None:
             TRACER.event("dispatch.deadline",
                          remaining_ms=round(
-                             (deadline - time.monotonic()) * 1e3, 3))
+                             (deadline - simclock.now()) * 1e3, 3))
         engine = self.loader.engine
         if engine is None:
             raise RuntimeError("no policy loaded")
@@ -317,9 +318,11 @@ class _Pending:
 
     def __init__(self, flow: Flow, deadline: Optional[float], ctx):
         self.flow = flow
-        self.ev = threading.Event()
+        # clock-integrated event: a VirtualClock wakes the waiting
+        # caller promptly when the drain worker answers in virtual time
+        self.ev = simclock.event()
         self.box: List[int] = []
-        self.t_enq = time.monotonic()
+        self.t_enq = simclock.now()
         self.ctx = ctx
         self.deadline = deadline
         self.abandoned = False
@@ -393,7 +396,7 @@ class MicroBatcher:
         ``deadline`` is absolute monotonic seconds; None derives one
         from ``timeout`` so every entry is reapable."""
         if deadline is None:
-            deadline = time.monotonic() + timeout
+            deadline = simclock.now() + timeout
         # the caller's trace context crosses the thread handoff WITH
         # the entry — the drain worker attributes this request's
         # queue-wait and fans the batch's phase spans back to it
@@ -421,11 +424,11 @@ class MicroBatcher:
                                  admission.SHED_QUEUE_FULL)
             if entry.ctx is not None:
                 TRACER.add_span(entry.ctx, "admission.shed",
-                                PHASE_SHED, time.time(), 0.0,
+                                PHASE_SHED, simclock.wall(), 0.0,
                                 reason=admission.SHED_QUEUE_FULL)
             return int(Verdict.ERROR), "shed"
-        wait = min(timeout, max(0.0, deadline - time.monotonic()))
-        if not entry.ev.wait(wait):
+        wait = min(timeout, max(0.0, deadline - simclock.now()))
+        if not simclock.wait_on(entry.ev, wait):
             # caller is leaving: flag the entry so the drain worker
             # drops it before featurize/dispatch instead of wasting a
             # batch slot on it
@@ -455,7 +458,7 @@ class MicroBatcher:
         verdicts, not ERRORs. Entries still unflushed when ``timeout``
         lapses (wedged engine) resolve as ERROR. Returns the number of
         entries flushed with real verdicts. Idempotent."""
-        t_deadline = time.monotonic() + max(0.0, timeout)
+        t_deadline = simclock.now() + max(0.0, timeout)
         with self._cond:
             if self._closed:
                 return 0
@@ -463,10 +466,10 @@ class MicroBatcher:
             backlog = len(self._pending) + self._inflight
             self._cond.notify_all()
             while self._pending or self._inflight:
-                left = t_deadline - time.monotonic()
+                left = t_deadline - simclock.now()
                 if left <= 0:
                     break
-                self._cond.wait(timeout=min(left, 0.05))
+                simclock.wait_cond(self._cond, min(left, 0.05))
             self._closed = True
             leftovers, self._pending = self._pending, []
             self._cond.notify_all()
@@ -495,8 +498,9 @@ class MicroBatcher:
                        and len(self._pending) < self.batch_max
                        and not self._closed and not self._draining):
                     oldest = self._pending[0].t_enq
-                    left = oldest + self.deadline_s - time.monotonic()
-                    if left <= 0 or not self._cond.wait(timeout=left):
+                    left = oldest + self.deadline_s - simclock.now()
+                    if left <= 0 or not simclock.wait_cond(self._cond,
+                                                           left):
                         break
                 if self._closed:
                     return
@@ -524,7 +528,7 @@ class MicroBatcher:
         entries resolve ERROR (their caller is gone or about to be);
         the drop is counted and, for sampled traces, attributed to the
         shed phase — the trace says the request died in the queue."""
-        now = time.monotonic()
+        now = simclock.now()
         live: List[_Pending] = []
         reaped: List[_Pending] = []
         for entry in pending:
@@ -538,7 +542,7 @@ class MicroBatcher:
                 self.gate.reap(len(reaped))
             else:
                 METRICS.inc(ADMISSION_REAPED, len(reaped))
-            wall = time.time()
+            wall = simclock.wall()
             for entry in reaped:
                 if entry.ctx is not None:
                     waited = now - entry.t_enq
@@ -555,8 +559,8 @@ class MicroBatcher:
         flows = [p.flow for p in pending]
         # per-request queue-wait attribution: monotonic deltas anchored
         # to wall time (one wall read per batch, not per request)
-        t_drain = time.monotonic()
-        wall = time.time()
+        t_drain = simclock.now()
+        wall = simclock.wall()
         for entry in pending:
             if entry.ctx is not None:
                 waited = t_drain - entry.t_enq
@@ -571,7 +575,10 @@ class MicroBatcher:
         deadlines = [p.deadline for p in pending
                      if p.deadline is not None]
         batch_deadline = min(deadlines) if deadlines else None
-        t0 = time.perf_counter()
+        # perf() so the EWMA service rate is measured in the currency
+        # the batch was served in (virtual under a VirtualClock, where
+        # synthetic service time is a virtual sleep)
+        t0 = simclock.perf()
         try:
             with TRACER.activate(group):
                 if self._fn_takes_deadline:
@@ -581,7 +588,7 @@ class MicroBatcher:
                     verdicts = self.verdict_fn(flows)
         except Exception:
             verdicts = [int(Verdict.ERROR)] * len(flows)
-        seconds = time.perf_counter() - t0
+        seconds = simclock.perf() - t0
         METRICS.observe("cilium_tpu_microbatch_seconds", seconds)
         METRICS.observe("cilium_tpu_microbatch_size", len(flows))
         if self.gate is not None:
@@ -765,10 +772,8 @@ class VerdictService:
         """LOG-action sink: the annotated L7 flow lands in the agent's
         hubble observer ring (the reference's access-log path: Envoy →
         accesslog socket → pkg/hubble parser/seven)."""
-        import time as _time
-
         if not flow.time:
-            flow.time = _time.time()
+            flow.time = simclock.wall()
         from cilium_tpu.core.flow import PolicyMatchType
 
         flow.policy_match_type = PolicyMatchType.L7
@@ -854,7 +859,7 @@ class VerdictService:
                                          deadline=deadline)
             if not ok:
                 TRACER.add_span(TRACER.current(), "admission.shed",
-                                PHASE_SHED, time.time(), 0.0,
+                                PHASE_SHED, simclock.wall(), 0.0,
                                 reason=reason)
                 resp = {"shed": True, "reason": reason}
                 if op == "check":

@@ -1,0 +1,508 @@
+"""Injectable time: the clock seam under the whole serving plane.
+
+Every time-driven state machine in this tree — circuit-breaker
+recovery, bank-quarantine TTLs, admission deadlines, reconnect
+backoff, credit waits, kvstore leases, clustermesh heartbeats, DNS
+cache expiry — used to read the wall clock directly, so `make chaos`
+and `make soak` could only exercise the handful of schedules they had
+the patience to *sleep* through. This module makes time a test input,
+the FoundationDB deterministic-simulation discipline: production code
+routes every behavioral clock read and every timed wait through the
+installed :class:`Clock`; tests install a :class:`VirtualClock` and
+drive (or auto-advance) virtual time, so hours of TTL/backoff/deadline
+behavior run in milliseconds and a seeded fault schedule replays
+byte-identically (``runtime/dst.py`` builds the schedule search on
+top).
+
+Contract:
+
+* **Behavioral time** (``now``/``wall``/``sleep`` and the timed
+  waits) is virtualizable. ``now()`` is monotonic seconds (the
+  ``time.monotonic`` role: deadlines, TTLs, backoff); ``wall()`` is
+  epoch seconds (the ``time.time`` role: stamps on flows, traces,
+  cache entries).
+* **Measurement time** (``perf()``) is real by default even under the
+  virtual clock's driven mode — an engine batch still takes real CPU
+  seconds and benchmarks must say so. ``VirtualClock`` flips it to
+  virtual so simulated service times (a ``sleep`` inside a synthetic
+  engine) are measured in the same currency they were spent in.
+* The module-level functions (:func:`now`, :func:`sleep`, ...) read
+  the installed clock at **call time**, so objects constructed before
+  a test installs its virtual clock still follow it; constructors may
+  also take an explicit ``clock`` for per-instance injection (the
+  chaos suite's manually-advanced breaker clock predates this module
+  and keeps working).
+
+The ctlint ``wall-clock`` rule (analysis/wallclock.py) enforces the
+seam: direct ``time.time/monotonic/sleep`` in runtime/engine/policy
+modules is a finding unless justified (provenance/bench stamping and
+profiler sampling measure the real world by definition).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import heapq
+import threading
+import time
+from typing import Callable, List, Optional
+
+__all__ = [
+    "Clock", "RealClock", "VirtualClock", "ClockEvent",
+    "get", "install", "reset", "use",
+    "now", "wall", "perf", "sleep", "event",
+    "wait_on", "wait_for", "wait_cond",
+]
+
+#: fixed virtual epoch (2020-09-13T12:26:40Z): wall stamps under a
+#: VirtualClock must be a pure function of virtual time, never of the
+#: host's clock, or DST traces would differ byte-wise across runs
+VIRTUAL_EPOCH = 1_600_000_000.0
+
+
+class Clock:
+    """The protocol. ``RealClock`` is the production implementation;
+    ``VirtualClock`` the simulation one. Methods mirror the stdlib
+    call sites they replace so the refactor stays mechanical."""
+
+    def now(self) -> float:            # pragma: no cover - interface
+        raise NotImplementedError
+
+    def wall(self) -> float:           # pragma: no cover - interface
+        raise NotImplementedError
+
+    def perf(self) -> float:           # pragma: no cover - interface
+        raise NotImplementedError
+
+    def sleep(self, seconds: float) -> float:  # pragma: no cover
+        """Block for ``seconds`` on this clock; returns the wake
+        instant in this clock's ``now()`` timeline."""
+        raise NotImplementedError
+
+    def event(self) -> threading.Event:
+        """An Event whose timed wait integrates with this clock (pair
+        with :meth:`wait_on`)."""
+        return threading.Event()
+
+    def wait_on(self, ev, timeout: Optional[float] = None) -> bool:
+        """``ev.wait(timeout)`` with the timeout measured on THIS
+        clock. Returns True when the event fired."""
+        raise NotImplementedError      # pragma: no cover - interface
+
+    def wait_for(self, cond: threading.Condition,
+                 predicate: Callable[[], bool],
+                 timeout: Optional[float] = None) -> bool:
+        """``cond.wait_for(predicate, timeout)`` with the timeout on
+        THIS clock. Caller holds ``cond``."""
+        raise NotImplementedError      # pragma: no cover - interface
+
+    def wait_cond(self, cond: threading.Condition,
+                  timeout: Optional[float] = None) -> bool:
+        """``cond.wait(timeout)`` with the timeout on THIS clock.
+        Returns False once the (virtual) deadline has passed; True on
+        any earlier wake-up. Like the stdlib primitive it may wake
+        spuriously — call sites re-check their predicate in a loop."""
+        raise NotImplementedError      # pragma: no cover - interface
+
+
+class RealClock(Clock):
+    """Production time: thin delegation to the stdlib."""
+
+    # the one module allowed to touch time.* directly is this one —
+    # it IS the seam the wall-clock rule points everyone else at
+
+    def now(self) -> float:
+        return time.monotonic()
+
+    def wall(self) -> float:
+        return time.time()
+
+    def perf(self) -> float:
+        return time.perf_counter()
+
+    def sleep(self, seconds: float) -> float:
+        time.sleep(seconds)
+        return self.now()
+
+    def wait_on(self, ev, timeout: Optional[float] = None) -> bool:
+        return ev.wait(timeout)
+
+    def wait_for(self, cond, predicate, timeout=None) -> bool:
+        return cond.wait_for(predicate, timeout)
+
+    def wait_cond(self, cond, timeout=None) -> bool:
+        woke = cond.wait(timeout)
+        return True if timeout is None else woke
+
+
+class ClockEvent:
+    """A ``threading.Event`` that notifies its VirtualClock on
+    ``set()``, so a virtual ``wait_on`` wakes promptly instead of on
+    its safety poll. Transparent on the real clock (never built)."""
+
+    __slots__ = ("_ev", "_clock")
+
+    def __init__(self, clock: "VirtualClock"):
+        self._ev = threading.Event()
+        self._clock = clock
+
+    def set(self) -> None:
+        self._ev.set()
+        self._clock.kick()
+
+    def clear(self) -> None:
+        self._ev.clear()
+
+    def is_set(self) -> bool:
+        return self._ev.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        # a bare .wait on a ClockEvent measures on the virtual clock
+        # too — callers that hold one got it from VirtualClock.event()
+        return self._clock.wait_on(self, timeout)
+
+
+class _Waiter:
+    """One parked virtual wait: its deadline, the condition to notify
+    at expiry (None = parked on the clock's own condvar), and the
+    fired flag advance() flips."""
+
+    __slots__ = ("deadline", "seq", "cond", "fired")
+
+    def __init__(self, deadline: float, seq: int,
+                 cond: Optional[threading.Condition]):
+        self.deadline = deadline
+        self.seq = seq
+        self.cond = cond
+        self.fired = False
+
+
+class VirtualClock(Clock):
+    """Deterministic simulated time.
+
+    Two driving modes:
+
+    * **Driven** (default): time moves only when the test/DST runner
+      calls :meth:`advance` / :meth:`advance_to` — sleepers park on an
+      event heap and wake exactly at their deadline. This is the mode
+      the schedule-search runner uses: the whole event sequence is a
+      pure function of the schedule.
+    * **Autojump** (``autojump=seconds``): when the clock has parked
+      waiters and sees no clock activity for that many REAL seconds
+      (every thread that participates in time is blocked), it jumps to
+      the earliest deadline — trio's MockClock discipline adapted to
+      OS threads. This converts sleep-bound multi-threaded lanes
+      (`make soak`'s synthetic service times) to virtual time without
+      restructuring them.
+
+    ``perf()`` is virtual here: simulated work (a virtual sleep inside
+    a synthetic engine) must be measured in the currency it was spent
+    in, or EWMA service-rate estimates would divide real microseconds
+    into virtual records.
+    """
+
+    def __init__(self, start: float = 0.0, wall0: float = VIRTUAL_EPOCH,
+                 autojump: Optional[float] = None, poll: float = 0.002,
+                 max_real_block: float = 120.0):
+        self._cv = threading.Condition()
+        self._now = float(start)
+        self._wall0 = float(wall0)
+        self._heap: List[tuple] = []   # (deadline, seq) → waiter
+        self._by_seq = {}
+        self._seq = 0
+        self._activity = 0
+        self._poll = float(poll)
+        self._autojump = autojump
+        self._max_real_block = float(max_real_block)
+        self._jumper: Optional[threading.Thread] = None
+        self._closed = False
+        #: total virtual seconds advanced — the lane-output speedup
+        #: report divides this by real elapsed seconds
+        self.simulated = 0.0
+
+    # -- reads ------------------------------------------------------------
+    def now(self) -> float:
+        return self._now          # float read is atomic under the GIL
+
+    def wall(self) -> float:
+        return self._wall0 + self._now
+
+    def perf(self) -> float:
+        return self._now
+
+    # -- waiter bookkeeping ----------------------------------------------
+    def _register(self, deadline: float,
+                  cond: Optional[threading.Condition]) -> _Waiter:
+        # registering (= a thread going to sleep) is deliberately NOT
+        # activity: a waiter re-arming a short poll must not hold the
+        # autojump off forever. Activity is the real wake signals —
+        # events firing, kicks, advances.
+        with self._cv:
+            self._seq += 1
+            w = _Waiter(deadline, self._seq, cond)
+            heapq.heappush(self._heap, (deadline, w.seq))
+            self._by_seq[w.seq] = w
+            self._ensure_jumper()
+            return w
+
+    def _unregister(self, w: _Waiter) -> None:
+        with self._cv:
+            self._by_seq.pop(w.seq, None)   # heap entry lazily dropped
+            self._cv.notify_all()
+
+    def kick(self) -> None:
+        """External wake signal (a ClockEvent fired, work arrived):
+        bump activity so autojump holds off, and wake parked
+        waiters so they re-check their events."""
+        with self._cv:
+            self._activity += 1
+            self._cv.notify_all()
+
+    # -- advancing --------------------------------------------------------
+    def advance(self, dt: float) -> float:
+        """Move virtual time forward by ``dt``; fires every waiter
+        whose deadline falls inside, in deadline order, waking each at
+        exactly its own instant. Returns the new now()."""
+        return self.advance_to(self._now + max(0.0, float(dt)))
+
+    def advance_to(self, target: float) -> float:
+        while True:
+            notify_conds = []
+            with self._cv:
+                target = max(target, self._now)
+                due = None
+                while self._heap:
+                    deadline, seq = self._heap[0]
+                    w = self._by_seq.get(seq)
+                    if w is None:            # stale heap entry
+                        heapq.heappop(self._heap)
+                        continue
+                    if deadline > target:
+                        break
+                    heapq.heappop(self._heap)
+                    due = w
+                    break
+                if due is None:
+                    self.simulated += target - self._now
+                    self._now = target
+                    self._activity += 1
+                    self._cv.notify_all()
+                    return self._now
+                # step to THIS deadline only: a woken sleeper may
+                # register new, earlier work before later waiters fire
+                self.simulated += max(0.0, due.deadline - self._now)
+                self._now = max(self._now, due.deadline)
+                due.fired = True
+                self._by_seq.pop(due.seq, None)
+                self._activity += 1
+                self._cv.notify_all()
+                if due.cond is not None:
+                    notify_conds.append(due.cond)
+            # notify foreign condvars OUTSIDE self._cv: a waiter holds
+            # its cond then takes _cv to register — acquiring in the
+            # opposite order here would deadlock the pair
+            for cond in notify_conds:
+                with cond:
+                    cond.notify_all()
+
+    def advance_to_next(self) -> Optional[float]:
+        """Jump to the earliest parked deadline (None when idle)."""
+        with self._cv:
+            while self._heap and self._heap[0][1] not in self._by_seq:
+                heapq.heappop(self._heap)
+            if not self._heap:
+                return None
+            target = self._heap[0][0]
+        return self.advance_to(target)
+
+    # -- autojump ---------------------------------------------------------
+    def _ensure_jumper(self) -> None:
+        # caller holds _cv
+        if self._autojump is None or self._jumper is not None:
+            return
+        t = threading.Thread(target=self._jump_loop, daemon=True,
+                             name="simclock-autojump")
+        self._jumper = t
+        t.start()
+
+    def _jump_loop(self) -> None:
+        last = -1
+        while not self._closed:
+            time.sleep(self._autojump)
+            with self._cv:
+                if self._closed:
+                    return
+                live = [s for _, s in self._heap if s in self._by_seq]
+                if not live or self._activity != last:
+                    last = self._activity
+                    continue
+                target = min(self._by_seq[s].deadline for s in live)
+                if target <= self._now:
+                    continue
+            self.advance_to(target)
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    # -- waits ------------------------------------------------------------
+    def sleep(self, seconds: float) -> float:
+        """Park until virtual time reaches now+seconds. Returns the
+        virtual WAKE instant (the waiter's own deadline) — the only
+        race-free way for a woken thread to know when it ran: by the
+        time it reads ``now()`` a driver may have advanced further."""
+        w = self._register(self._now + max(0.0, float(seconds)), None)
+        deadline_real = time.monotonic() + self._max_real_block
+        try:
+            with self._cv:
+                while not w.fired:
+                    if time.monotonic() >= deadline_real:
+                        raise RuntimeError(
+                            "virtual sleep blocked for "
+                            f"{self._max_real_block}s real time — "
+                            "nothing is advancing the VirtualClock")
+                    self._cv.wait(self._poll if self._autojump is None
+                                  else 1.0)
+            return w.deadline
+        finally:
+            self._unregister(w)
+
+    def event(self):
+        return ClockEvent(self)
+
+    def wait_on(self, ev, timeout: Optional[float] = None) -> bool:
+        real = getattr(ev, "_ev", ev)   # unwrap ClockEvent
+        if timeout is None:
+            return real.wait()
+        # ClockEvent.set() kicks our condvar, so the poll slice is a
+        # safety net only; a plain threading.Event set by a thread
+        # that doesn't know the clock is caught by the poll
+        slice_s = 0.25 if isinstance(ev, ClockEvent) else self._poll
+        w = self._register(self._now + max(0.0, float(timeout)), None)
+        deadline_real = time.monotonic() + self._max_real_block
+        try:
+            with self._cv:
+                while True:
+                    if real.is_set():
+                        return True
+                    if w.fired or self._now >= w.deadline:
+                        return real.is_set()
+                    if time.monotonic() >= deadline_real:
+                        raise RuntimeError(
+                            "virtual wait_on blocked for "
+                            f"{self._max_real_block}s real time — "
+                            "nothing is advancing the VirtualClock")
+                    self._cv.wait(slice_s)
+        finally:
+            self._unregister(w)
+
+    def wait_for(self, cond, predicate, timeout=None) -> bool:
+        if timeout is None:
+            # timeless wait: plain condition semantics, no heap entry
+            while not predicate():
+                cond.wait(self._poll)
+            return True
+        w = self._register(self._now + max(0.0, float(timeout)), cond)
+        deadline_real = time.monotonic() + self._max_real_block
+        try:
+            while True:
+                if predicate():
+                    return True
+                if w.fired or self._now >= w.deadline:
+                    return predicate()
+                if time.monotonic() >= deadline_real:
+                    raise RuntimeError(
+                        "virtual wait_for blocked for "
+                        f"{self._max_real_block}s real time — "
+                        "nothing is advancing the VirtualClock")
+                cond.wait(self._poll)
+        finally:
+            self._unregister(w)
+
+    def wait_cond(self, cond, timeout=None) -> bool:
+        if timeout is None:
+            cond.wait()
+            return True
+        w = self._register(self._now + max(0.0, float(timeout)), cond)
+        try:
+            cond.wait(self._poll)
+            return not (w.fired or self._now >= w.deadline)
+        finally:
+            self._unregister(w)
+
+
+# -- the installed clock ----------------------------------------------------
+
+_REAL = RealClock()
+_CLOCK: Clock = _REAL
+_INSTALL_LOCK = threading.Lock()
+
+
+def get() -> Clock:
+    return _CLOCK
+
+
+def install(clock: Clock) -> None:
+    """Install ``clock`` process-wide. Tests prefer :func:`use`."""
+    global _CLOCK
+    with _INSTALL_LOCK:
+        _CLOCK = clock
+
+
+def reset() -> None:
+    global _CLOCK
+    with _INSTALL_LOCK:
+        _CLOCK = _REAL
+
+
+@contextlib.contextmanager
+def use(clock: Clock):
+    """``with use(VirtualClock()) as clk: ...`` — install for the
+    block, always restored (a leaked virtual clock would wedge every
+    later test's timeouts)."""
+    prev = _CLOCK
+    install(clock)
+    try:
+        yield clock
+    finally:
+        install(prev)
+        if isinstance(clock, VirtualClock):
+            clock.close()
+
+
+# -- call-time delegation: late-bound so objects built before a test
+#    installs its clock still follow it ------------------------------------
+
+def now() -> float:
+    return _CLOCK.now()
+
+
+def wall() -> float:
+    return _CLOCK.wall()
+
+
+def perf() -> float:
+    return _CLOCK.perf()
+
+
+def sleep(seconds: float) -> float:
+    return _CLOCK.sleep(seconds)
+
+
+def event() -> threading.Event:
+    return _CLOCK.event()
+
+
+def wait_on(ev, timeout: Optional[float] = None) -> bool:
+    return _CLOCK.wait_on(ev, timeout)
+
+
+def wait_for(cond: threading.Condition, predicate,
+             timeout: Optional[float] = None) -> bool:
+    return _CLOCK.wait_for(cond, predicate, timeout)
+
+
+def wait_cond(cond: threading.Condition,
+              timeout: Optional[float] = None) -> bool:
+    return _CLOCK.wait_cond(cond, timeout)

@@ -65,7 +65,6 @@ import random
 import socket
 import struct
 import threading
-import time
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -75,7 +74,7 @@ from cilium_tpu.ingest.binary import (
     capture_from_bytes,
     capture_to_bytes,
 )
-from cilium_tpu.runtime import faults
+from cilium_tpu.runtime import faults, simclock
 from cilium_tpu.runtime.metrics import (
     METRICS,
     STREAM_CREDIT_WAITS,
@@ -228,7 +227,7 @@ class StreamSession:
                     break
                 # receive stamp: the worker attributes reader-queue
                 # dwell as the chunk's queue-wait phase
-                self._in.put((seq, kind, payload, time.monotonic()))
+                self._in.put((seq, kind, payload, simclock.now()))
                 if kind == KIND_END:
                     break
         finally:
@@ -354,9 +353,9 @@ class StreamSession:
                                f"unknown frame kind {kind}", None))
                 continue
             if ctx is not None:
-                waited = time.monotonic() - t_recv
+                waited = simclock.now() - t_recv
                 TRACER.add_span(ctx, "stream.queue", PHASE_QUEUE,
-                                time.time() - waited, waited)
+                                simclock.wall() - waited, waited)
             try:
                 with TRACER.activate(ctx):
                     n, dev = self._dispatch_chunk(payload)
@@ -525,7 +524,7 @@ class StreamClient:
         for attempt in range(self.max_reconnects):
             delay = min(self.backoff_base * (2 ** attempt),
                         self.backoff_max)
-            time.sleep(delay * (1.0 + 0.25 * self._jitter.random()))
+            simclock.sleep(delay * (1.0 + 0.25 * self._jitter.random()))
             try:
                 self._connect()
             except (OSError, RuntimeError):
@@ -635,7 +634,8 @@ class StreamClient:
                 return
             if self._credits <= 0:
                 METRICS.inc(STREAM_CREDIT_WAITS)
-                ok = self._cond.wait_for(
+                ok = simclock.wait_for(
+                    self._cond,
                     lambda: (self._credits is None
                              or self._credits > 0 or self._done),
                     timeout=self.timeout)
@@ -679,7 +679,8 @@ class StreamClient:
         """Block for one chunk's verdicts (raises if the server failed
         that chunk)."""
         with self._cond:
-            ok = self._cond.wait_for(
+            ok = simclock.wait_for(
+                self._cond,
                 lambda: seq in self._results or self._done,
                 timeout=self.timeout)
             if seq not in self._results:
@@ -700,7 +701,8 @@ class StreamClient:
         drain (raising from a generator closes it for good)."""
         while True:
             with self._cond:
-                self._cond.wait_for(
+                simclock.wait_for(
+                    self._cond,
                     lambda: self._results or self._done,
                     timeout=self.timeout)
                 if not self._results:
@@ -724,8 +726,8 @@ class StreamClient:
             if not self.reconnect:
                 raise  # the recv thread's resume re-sends END
         with self._cond:
-            if not self._cond.wait_for(lambda: self._done,
-                                       timeout=self.timeout):
+            if not simclock.wait_for(self._cond, lambda: self._done,
+                                     timeout=self.timeout):
                 raise TimeoutError("no end-ack")
 
     def close(self) -> None:

@@ -54,11 +54,11 @@ from __future__ import annotations
 
 import contextvars
 import threading
-import time
 import uuid
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from cilium_tpu.runtime import simclock
 from cilium_tpu.runtime.metrics import METRICS, TRACE_SPANS
 
 #: canonical phase names (ISSUE 2); free-form phases are allowed but
@@ -96,7 +96,7 @@ class TraceContext:
                  attrs: Optional[Dict] = None):
         self.trace_id = trace_id
         self.name = name
-        self.t0 = time.time()
+        self.t0 = simclock.wall()
         self.attrs = attrs or {}
         self._next_span = [0]  # list: shared mutable counter, no lock
         # (span ids only need uniqueness per trace; a rare duplicate
@@ -160,11 +160,11 @@ class _SpanCM:
         self.attrs = attrs
 
     def __enter__(self):
-        self.t0 = time.time()
+        self.t0 = simclock.wall()
         return self
 
     def __exit__(self, exc_type, exc, tb):
-        dur = time.time() - self.t0
+        dur = simclock.wall() - self.t0
         if exc is not None:
             self.attrs = dict(self.attrs,
                               error=f"{exc_type.__name__}: {exc}")
@@ -241,7 +241,7 @@ class Tracer:
         if ctx is None:
             return
         for m in ctx.members():
-            self._record(m, m.name, "", m.t0, time.time() - m.t0,
+            self._record(m, m.name, "", m.t0, simclock.wall() - m.t0,
                          dict(m.attrs, root=True))
 
     @staticmethod
@@ -287,7 +287,7 @@ class Tracer:
         ctx = ctx if ctx is not None else _CURRENT.get()
         if ctx is None or not self.enabled:
             return
-        now = time.time()
+        now = simclock.wall()
         recs = [{"trace_id": m.trace_id, "span_id": m.next_span_id(),
                  "name": name, "event": True, "ts": round(now, 6),
                  "attrs": attrs} for m in ctx.members()]

@@ -1,0 +1,217 @@
+"""Timing boundary cases the schedule searcher is blind to without
+explicit pins (ISSUE 10 satellite): deadline lapse at the exact tick,
+quarantine TTL expiry racing a regeneration, breaker half-open under
+concurrent probes, and a credit grant landing during reconnect. All
+under virtual time — the boundaries are EXACT, not sleep-approximate.
+"""
+
+import threading
+
+import pytest
+
+from cilium_tpu.runtime import simclock
+from cilium_tpu.runtime.simclock import VirtualClock
+
+
+# ---------------------------------------------------------------------------
+# 1) deadline lapse at the exact tick
+
+
+def test_admission_deadline_at_the_exact_tick_sheds():
+    """A request whose deadline equals now() EXACTLY has zero budget:
+    the gate sheds it (reason deadline) — `remaining <= 0` — and one
+    virtual tick earlier it admits. The boundary is pinned closed."""
+    from cilium_tpu.runtime.admission import (
+        AdmissionGate,
+        SHED_DEADLINE,
+    )
+
+    clk = VirtualClock(start=50.0)
+    with simclock.use(clk):
+        gate = AdmissionGate(max_pending=8, depth_fn=lambda: 0)
+        ok, reason = gate.admit(deadline=clk.now())       # exact tick
+        assert (ok, reason) == (False, SHED_DEADLINE)
+        ok, _ = gate.admit(deadline=clk.now() + 1e-6)     # one tick in
+        assert ok
+
+
+def test_microbatcher_reaps_an_entry_expiring_at_the_exact_tick():
+    """An entry whose deadline == now at dispatch is reaped (deadline
+    <= now), never spent a batch slot on; one whose deadline is one
+    tick later dispatches."""
+    from cilium_tpu.core.flow import Flow, Verdict
+    from cilium_tpu.runtime.service import MicroBatcher, _Pending
+
+    clk = VirtualClock(start=10.0)
+    with simclock.use(clk):
+        served = []
+        mb = MicroBatcher(lambda flows: served.append(len(flows))
+                          or [int(Verdict.FORWARDED)] * len(flows),
+                          batch_max=4, deadline_ms=1.0)
+        exact = _Pending(Flow(), clk.now(), None)          # lapses NOW
+        live = _Pending(Flow(), clk.now() + 1e-6, None)
+        out = mb._reap([exact, live])
+        assert out == [live]
+        assert exact.box == [int(Verdict.ERROR)]
+        assert exact.ev.is_set()
+        mb.close()
+
+
+# ---------------------------------------------------------------------------
+# 2) quarantine TTL expiry racing a regeneration
+
+
+def test_quarantine_ttl_expiry_races_regeneration():
+    """A regeneration that starts at EXACTLY the quarantine TTL tick
+    retries the bank (now >= until); one tick earlier it must keep
+    serving the stale cover without a retry compile. Either way the
+    pattern set served is consistent — the boundary changes WHEN the
+    retry happens, never correctness."""
+    from cilium_tpu.core.config import EngineConfig
+    from cilium_tpu.policy.compiler.bankplan import BankRegistry
+    from cilium_tpu.runtime import faults
+    from cilium_tpu.runtime.faults import FaultPlan, FaultRule
+
+    clk = VirtualClock()
+    with simclock.use(clk):
+        reg = BankRegistry(quarantine_ttl_s=30.0)
+        cfg = EngineConfig(bank_size=2)
+        pats = ["/a/.*", "/b/.*", "/c/.*", "/d/.*"]
+        reg.compile_field("path", pats, cfg)        # healthy baseline
+        with faults.inject(FaultPlan(
+                [FaultRule("loader.bank_compile", times=1)])):
+            _, stats = reg.compile_field("path", pats + ["/e/.*"],
+                                         cfg)
+        assert stats.quarantined, "fault must quarantine a group"
+        quarantined = set(stats.quarantined)
+        compiles_q = reg.compiles
+
+        # one tick BEFORE expiry: stale cover keeps serving, no retry
+        clk.advance(30.0 - 1e-3)
+        assert reg.expired_quarantines() == ()
+        _, stats2 = reg.compile_field("path", pats + ["/e/.*"], cfg)
+        assert set(stats2.quarantined) == quarantined
+        assert reg.compiles == compiles_q   # no retry compile yet
+
+        # AT the expiry tick: the next regeneration retries + recovers
+        clk.advance(1e-3)
+        assert set(reg.expired_quarantines()) == quarantined
+        _, stats3 = reg.compile_field("path", pats + ["/e/.*"], cfg)
+        assert not stats3.quarantined
+        assert reg.compiles > compiles_q    # the retry compiled
+
+
+# ---------------------------------------------------------------------------
+# 3) breaker half-open with concurrent probes
+
+
+def test_breaker_half_open_admits_exactly_one_concurrent_probe():
+    """N threads hit allow_primary at the exact probe-interval tick:
+    EXACTLY one becomes the half-open probe; the rest keep falling
+    back (a thundering herd onto a sick device would defeat the
+    probe). A failed probe re-arms the timer at the failure instant."""
+    from cilium_tpu.runtime.service import CircuitBreaker
+
+    clk = VirtualClock()
+    with simclock.use(clk):
+        br = CircuitBreaker(failure_threshold=1, probe_interval=5.0)
+        br.record_failure()
+        assert br.state == CircuitBreaker.OPEN
+        clk.advance(5.0)                     # exactly the interval
+        results = []
+        lock = threading.Lock()
+        start = threading.Barrier(8)
+
+        def prober():
+            start.wait()
+            got = br.allow_primary()
+            with lock:
+                results.append(got)
+
+        ts = [threading.Thread(target=prober) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=5.0)
+        assert results.count(True) == 1, results
+        assert br.state == CircuitBreaker.HALF_OPEN
+        # failed probe: OPEN again, timer re-armed from NOW — one tick
+        # shy of the new interval stays closed to probes
+        br.record_failure()                  # re-armed at now=5.0
+        clk.advance_to(10.0 - 1e-6)
+        assert not br.allow_primary()
+        clk.advance_to(10.0)                 # exactly interval later
+        assert br.allow_primary()
+
+
+# ---------------------------------------------------------------------------
+# 4) credit grant arriving during reconnect
+
+
+def test_credit_grant_arriving_during_reconnect_is_not_lost():
+    """The client's credit window is rebuilt from the re-handshake
+    minus re-sent unacked chunks; a grant that lands immediately after
+    (the server answering a resumed chunk) must ADD to that window —
+    the reconnect must never double-count or drop it. Pure client-side
+    state-machine check, driven through the same lock/condition the
+    recv loop uses."""
+    from cilium_tpu.runtime.stream import StreamClient
+
+    clk = VirtualClock()
+    with simclock.use(clk):
+        client = StreamClient.__new__(StreamClient)   # no socket I/O
+        client._lock = threading.Lock()
+        client._cond = threading.Condition(client._lock)
+        client.timeout = 5.0
+        client._done = False
+        client._credit_window = 4
+        client._credits = 0                 # exhausted pre-drop
+        client._unacked = {7: ("", b"img7"), 8: ("", b"img8")}
+        # reconnect path: fresh window minus the 2 re-sent chunks
+        with client._cond:
+            client._credits = max(
+                0, client._credit_window - len(client._unacked))
+        assert client._credits == 2
+        # the resumed session answers seq 7 AND grants a credit — the
+        # recv-loop bookkeeping for a grant frame during resume:
+        with client._cond:
+            client._credits += 1
+            client._cond.notify_all()
+        with client._cond:
+            client._unacked.pop(7)
+        assert client._credits == 3
+        # a sender blocked at zero credit wakes on the grant: window
+        # accounting and the wait predicate agree
+        client._acquire_credit()
+        assert client._credits == 2
+
+
+def test_acquire_credit_times_out_on_virtual_clock_without_grant():
+    """A wedged consumer surfaces as TimeoutError after the VIRTUAL
+    timeout — no real seconds slept."""
+    from cilium_tpu.runtime.stream import StreamClient
+
+    clk = VirtualClock()
+    with simclock.use(clk):
+        client = StreamClient.__new__(StreamClient)
+        client._lock = threading.Lock()
+        client._cond = threading.Condition(client._lock)
+        client.timeout = 30.0               # 30 VIRTUAL seconds
+        client._done = False
+        client._credits = 0
+        client._credit_window = 4
+        boom = []
+
+        def sender():
+            try:
+                client._acquire_credit()
+            except TimeoutError:
+                boom.append(True)
+
+        t = threading.Thread(target=sender)
+        t.start()
+        while not clk._by_seq:
+            threading.Event().wait(0.002)
+        clk.advance(30.1)
+        t.join(timeout=5.0)
+        assert boom == [True]

@@ -1,0 +1,153 @@
+"""Identity churn-storm regeneration batching (ISSUE 10 satellite):
+a burst of identity add/delete events coalesces behind a debounce
+window into O(1) regenerations, counted, under virtual time."""
+
+import threading
+
+import pytest
+
+from cilium_tpu.identity_kvstore import RegenDebouncer
+from cilium_tpu.runtime import simclock
+from cilium_tpu.runtime.metrics import METRICS
+from cilium_tpu.runtime.simclock import VirtualClock
+
+COALESCED = "cilium_tpu_identity_regen_coalesced_total"
+
+
+def test_storm_of_100_events_fires_once():
+    """100 events inside the window → exactly ONE regeneration; the
+    99 absorbed events land on the coalesced counter."""
+    clk = VirtualClock(autojump=0.003)
+    with simclock.use(clk):
+        fires = []
+        deb = RegenDebouncer(lambda: fires.append(simclock.now()),
+                             window_s=0.05)
+        before = METRICS.get(COALESCED)
+        for _ in range(100):
+            deb.note()
+        # quiet: the window closes one virtual tick after the last
+        # event — autojump crosses it without sleeping
+        deadline = threading.Event()
+        for _ in range(2000):
+            if deb.fires:
+                break
+            deadline.wait(0.005)
+        deb.close()
+        assert deb.fires == 1
+        assert len(fires) == 1
+        assert METRICS.get(COALESCED) - before == 99
+
+
+def test_spaced_events_each_rearm_the_window_until_max_delay():
+    """Events spaced inside the window keep re-arming it, but
+    max_delay bounds the staleness: a sustained storm still
+    regenerates, at the bounded cadence — never at event rate."""
+    clk = VirtualClock(autojump=0.003)
+    with simclock.use(clk):
+        fires = []
+        deb = RegenDebouncer(lambda: fires.append(round(
+            simclock.now(), 3)), window_s=0.05, max_delay_s=0.2)
+        stop = threading.Event()
+
+        def stormer():
+            # an event every 0.03 virtual s for 0.6 virtual s: the
+            # window (0.05) never goes quiet, so only max_delay fires
+            for _ in range(20):
+                deb.note()
+                simclock.sleep(0.03)
+            stop.set()
+
+        t = threading.Thread(target=stormer)
+        t.start()
+        t.join(timeout=30.0)
+        assert stop.is_set()
+        deb.close(flush=True)
+        # 0.6s of sustained storm / 0.2s max delay ≈ 3 fires (+ the
+        # final flush) — O(duration/max_delay), never O(20 events)
+        assert 1 <= deb.fires <= 6, (deb.fires, fires)
+
+
+def test_window_zero_degrades_to_synchronous_per_event():
+    fires = []
+    deb = RegenDebouncer(lambda: fires.append(1), window_s=0.0)
+    for _ in range(5):
+        deb.note()
+    assert len(fires) == 5
+    deb.close()
+
+
+def test_flush_fires_pending_synchronously_and_close_is_idempotent():
+    clk = VirtualClock()
+    with simclock.use(clk):
+        fires = []
+        deb = RegenDebouncer(lambda: fires.append(1), window_s=10.0)
+        deb.note()
+        deb.note()
+        assert not fires            # window still open (virtual)
+        deb.flush()
+        assert len(fires) == 1
+        deb.close()
+        deb.close()
+        deb.note()                  # after close: dropped, no crash
+        assert len(fires) == 1
+
+
+def test_fire_exception_does_not_kill_the_debouncer():
+    clk = VirtualClock(autojump=0.003)
+    with simclock.use(clk):
+        calls = []
+
+        def boom():
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError("regen failed")
+
+        deb = RegenDebouncer(boom, window_s=0.02)
+        deb.note()
+        ev = threading.Event()
+        for _ in range(1000):
+            if calls:
+                break
+            ev.wait(0.005)
+        assert calls, "first window never fired"
+        deb.note()                  # the NEXT window must still fire
+        for _ in range(1000):
+            if len(calls) >= 2:
+                break
+            ev.wait(0.005)
+        deb.close()
+        assert len(calls) >= 2
+
+
+def test_agent_identity_hook_is_debounced():
+    """The agent wiring: _on_cluster_identity updates the selector
+    cache synchronously but routes regeneration through the
+    debouncer (the storm assertion at the integration seam)."""
+    from cilium_tpu.agent import Agent
+    from cilium_tpu.core.config import Config
+    from cilium_tpu.core.labels import LabelSet
+
+    cfg = Config()
+    cfg.configure_logging = False
+    agent = Agent(cfg)
+    try:
+        regen_calls = []
+        agent._identity_debounce.fire = \
+            lambda: regen_calls.append(simclock.now())
+        clk = VirtualClock(autojump=0.003)
+        with simclock.use(clk):
+            for k in range(100):
+                agent._on_cluster_identity(
+                    10_000 + k,
+                    LabelSet.from_dict({"storm": f"s{k}"}))
+            ev = threading.Event()
+            for _ in range(2000):
+                if agent._identity_debounce.fires:
+                    break
+                ev.wait(0.005)
+            assert agent._identity_debounce.fires == 1
+            assert len(regen_calls) == 1
+            # the selector cache saw every event synchronously
+            assert agent.selector_cache is not None
+    finally:
+        agent.stop()

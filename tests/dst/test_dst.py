@@ -1,0 +1,135 @@
+"""The DST runner (runtime/dst.py): schedule generation, byte-exact
+replay (in-process and across PYTHONHASHSEEDs), a clean-tree search
+slice, the ddmin shrinker, and replay of the committed regression
+corpus. The full 200-schedule sweep is `make dst`; the planted-bug
+proofs are tests/dst/test_planted.py (`make dst-validate`)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from cilium_tpu.runtime import dst
+
+REGRESSION_DIR = os.path.join(os.path.dirname(__file__), "regressions")
+
+
+def test_generate_is_seeded_and_self_contained():
+    a = dst.generate(11)
+    b = dst.generate(11)
+    c = dst.generate(12)
+    assert a == b
+    assert a != c
+    assert all(isinstance(ev, list) and isinstance(ev[0], str)
+               for ev in a)
+    # self-contained: a schedule round-trips through JSON verbatim
+    assert json.loads(json.dumps(a)) == a
+
+
+def test_schedule_digest_stable():
+    evs = dst.generate(5)
+    assert dst.schedule_digest(evs) == dst.schedule_digest(list(evs))
+    assert dst.schedule_digest(evs) != dst.schedule_digest(evs[:-1])
+
+
+@pytest.mark.slow
+@pytest.mark.dst
+def test_same_seed_replays_byte_identical_in_process():
+    r1 = dst.run_schedule(3)
+    r2 = dst.run_schedule(3)
+    assert r1["digest"] == r2["digest"]
+    assert r1["trace"] == r2["trace"]
+    assert r1["violation"] is None
+
+
+@pytest.mark.slow
+@pytest.mark.dst
+def test_trace_byte_identical_across_three_hashseeds():
+    """The acceptance pin: the same DST_SEED produces a byte-identical
+    event trace across 3 runs AND 3 PYTHONHASHSEEDs."""
+    digests = set()
+    for hashseed in ("0", "1", "42"):
+        env = dict(os.environ, PYTHONHASHSEED=hashseed,
+                   JAX_PLATFORMS="cpu")
+        env.pop("CILIUM_TPU_DST_MUTATION", None)
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "from cilium_tpu.runtime import dst; "
+             "print(dst.run_schedule(7)['digest'])"],
+            capture_output=True, text=True, timeout=480, env=env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        digests.add(out.stdout.strip().splitlines()[-1])
+    assert len(digests) == 1, digests
+
+
+@pytest.mark.slow
+@pytest.mark.dst
+def test_clean_tree_slice_has_zero_violations():
+    """A tier-friendly slice of `make dst`: the shipped tree violates
+    no invariant over a handful of seeded schedules."""
+    ran, failing = dst.search(6, seed0=100)
+    assert ran == 6
+    assert failing is None, failing and failing["violation"]
+
+
+def test_shrink_is_ddmin_minimal_on_a_synthetic_predicate(monkeypatch):
+    """The shrinker contract, isolated from the (slow) world: ddmin
+    over run_schedule keeps any subset that still violates and stops
+    at 1-minimality."""
+    def fake_run(seed, events=None, cache_dir=None, max_events=12):
+        events = events if events is not None else dst.generate(seed)
+        # "violates" iff the schedule still contains BOTH markers
+        bad = (["fault", "loader.swap", 1] in events
+               and ["drain-restore"] in events)
+        return {"seed": seed, "events": events, "trace": [],
+                "digest": "x", "schedule_digest": "y",
+                "violation": ({"index": 0, "invariant": "synthetic",
+                               "detail": ""} if bad else None)}
+
+    monkeypatch.setattr(dst, "run_schedule", fake_run)
+    events = [["traffic"], ["fault", "loader.swap", 1], ["advance", 2.0],
+              ["storm", 8], ["drain-restore"], ["traffic"], ["churn",
+              "add", 0]]
+    best = dst.shrink(0, events)
+    assert best["violation"] is not None
+    assert sorted(map(str, best["events"])) == sorted(map(str, [
+        ["fault", "loader.swap", 1], ["drain-restore"]]))
+
+
+@pytest.mark.slow
+@pytest.mark.dst
+@pytest.mark.parametrize("case", sorted(
+    os.listdir(REGRESSION_DIR)) if os.path.isdir(REGRESSION_DIR)
+    else [])
+def test_regression_corpus_replays(case, monkeypatch):
+    """Every shrunken schedule committed under regressions/ must keep
+    reproducing its violation (with its recorded mutation armed) —
+    the committable-regression half of the shrink contract."""
+    with open(os.path.join(REGRESSION_DIR, case)) as fp:
+        data = json.load(fp)
+    assert data["format"] == dst.SCHEDULE_FORMAT
+    if data.get("mutation"):
+        monkeypatch.setenv("CILIUM_TPU_DST_MUTATION", data["mutation"])
+    else:
+        monkeypatch.delenv("CILIUM_TPU_DST_MUTATION", raising=False)
+    res = dst.run_schedule(data["seed"], events=data["events"])
+    assert res["violation"] is not None, \
+        f"{case} no longer reproduces its violation"
+    assert res["violation"]["invariant"] == \
+        data["violation"]["invariant"]
+
+
+def test_dst_stamp_rides_bench_lines(monkeypatch):
+    """Provenance satellite: CILIUM_TPU_DST_SEED/_DIGEST on the
+    environment land as the `dst` rider on every stamped bench line."""
+    from cilium_tpu.runtime.provenance import stamp
+
+    monkeypatch.setenv("CILIUM_TPU_DST_SEED", "41")
+    monkeypatch.setenv("CILIUM_TPU_DST_DIGEST", "abc123")
+    line = stamp({"metric": "x", "value": 1}, rtt=False)
+    assert line["dst"] == {"dst_seed": 41, "schedule_digest": "abc123"}
+    monkeypatch.delenv("CILIUM_TPU_DST_SEED")
+    line2 = stamp({"metric": "x", "value": 1}, rtt=False)
+    assert "dst" not in line2

@@ -1,0 +1,46 @@
+"""Planted-bug validation (`make dst-validate`, ISSUE 10 acceptance):
+re-introduce a known FIXED bug behind ``CILIUM_TPU_DST_MUTATION`` and
+prove the schedule search catches it within a bounded seed budget and
+shrinks the failing schedule to a ≤5-event regression case."""
+
+import pytest
+
+from cilium_tpu.runtime import dst, faults
+
+pytestmark = [pytest.mark.slow, pytest.mark.dst]
+
+#: seeds the searcher may burn before we call the mutation missed —
+#: both known mutations are caught well inside this budget
+SEED_BUDGET = 25
+
+
+def test_mutations_are_documented():
+    assert set(faults.MUTATIONS) >= {"rollback-artifact-key",
+                                     "positional-banks"}
+    assert not faults.mutation_active("rollback-artifact-key")
+
+
+@pytest.mark.parametrize("mutation,invariants", [
+    # PR-7's real bug: rollback left _last_artifact_key at the aborted
+    # revision → a later warm snapshot/restore stages the WRONG policy
+    ("rollback-artifact-key", {"oracle-agreement", "session-stale"}),
+    # pre-PR-8 positional bank grouping: one delete shifts every later
+    # bank → O(policy) compiles per update
+    ("positional-banks", {"o-delta-compile"}),
+])
+def test_planted_bug_is_caught_and_shrunk(mutation, invariants,
+                                          monkeypatch):
+    monkeypatch.setenv(faults.MUTATION_ENV, mutation)
+    ran, failing = dst.search(SEED_BUDGET)
+    assert failing is not None, \
+        f"{mutation} not caught within {SEED_BUDGET} seeds"
+    assert failing["violation"]["invariant"] in invariants, \
+        failing["violation"]
+    small = dst.shrink(failing["seed"], failing["events"])
+    assert small["violation"] is not None
+    assert len(small["events"]) <= 5, small["events"]
+    # the UNMUTATED tree does not violate on the shrunken schedule —
+    # the case isolates the planted bug, not a harness artifact
+    monkeypatch.delenv(faults.MUTATION_ENV)
+    clean = dst.run_schedule(small["seed"], events=small["events"])
+    assert clean["violation"] is None, clean["violation"]

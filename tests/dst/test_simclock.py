@@ -1,0 +1,181 @@
+"""Virtual-clock unit tests: the DST layer's foundation
+(runtime/simclock.py). Driven mode must be exact (waiters wake at
+their deadline, in deadline order); autojump must advance only at
+quiescence; the module-level seam must late-bind so objects built
+before a test installs its clock still follow it."""
+
+import threading
+
+import pytest
+
+from cilium_tpu.runtime import simclock
+from cilium_tpu.runtime.simclock import RealClock, VirtualClock
+
+
+def test_real_clock_is_the_default_and_delegates():
+    assert isinstance(simclock.get(), RealClock)
+    ev = simclock.event()
+    assert isinstance(ev, threading.Event)
+    ev.set()
+    assert simclock.wait_on(ev, 0.01)
+    assert simclock.now() > 0
+    assert simclock.wall() > 1_000_000_000
+
+
+def test_virtual_now_wall_perf_advance():
+    clk = VirtualClock(start=100.0)
+    with simclock.use(clk):
+        assert simclock.now() == 100.0
+        assert simclock.wall() == simclock.VIRTUAL_EPOCH + 100.0
+        clk.advance(2.5)
+        assert simclock.now() == 102.5
+        assert simclock.perf() == 102.5      # virtual measurement
+        assert clk.simulated == pytest.approx(2.5)
+
+
+def test_use_restores_previous_clock_on_exit():
+    before = simclock.get()
+    with simclock.use(VirtualClock()):
+        assert simclock.get() is not before
+    assert simclock.get() is before
+
+
+def test_sleep_parks_until_advance_and_wakes_at_its_deadline():
+    clk = VirtualClock()
+    order = []
+    lock = threading.Lock()
+    with simclock.use(clk):
+        def sleeper(name, dt):
+            woke = simclock.sleep(dt)   # the exact virtual wake instant
+            with lock:
+                order.append((name, round(woke, 6)))
+
+        ts = [threading.Thread(target=sleeper, args=("b", 2.0)),
+              threading.Thread(target=sleeper, args=("a", 1.0))]
+        for t in ts:
+            t.start()
+        # wait until both are parked
+        deadline = 200
+        while len(clk._by_seq) < 2 and deadline:
+            threading.Event().wait(0.005)
+            deadline -= 1
+        assert len(clk._by_seq) == 2
+        clk.advance(3.0)
+        for t in ts:
+            t.join(timeout=5.0)
+    assert sorted(order, key=lambda x: x[1]) == [("a", 1.0),
+                                                 ("b", 2.0)]
+
+
+def test_wait_on_clock_event_fires_and_times_out():
+    clk = VirtualClock()
+    with simclock.use(clk):
+        ev = simclock.event()
+        got = []
+
+        def waiter():
+            got.append(simclock.wait_on(ev, timeout=5.0))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        while not clk._by_seq:
+            threading.Event().wait(0.002)
+        ev.set()                      # fires BEFORE the deadline
+        t.join(timeout=5.0)
+        assert got == [True]
+
+        ev2 = simclock.event()
+        got2 = []
+        t2 = threading.Thread(
+            target=lambda: got2.append(simclock.wait_on(ev2, 5.0)))
+        t2.start()
+        while not clk._by_seq:
+            threading.Event().wait(0.002)
+        clk.advance(5.0)              # deadline passes: timeout
+        t2.join(timeout=5.0)
+        assert got2 == [False]
+
+
+def test_wait_for_predicate_and_virtual_timeout():
+    clk = VirtualClock()
+    with simclock.use(clk):
+        cond = threading.Condition()
+        state = {"ready": False}
+        results = []
+
+        def waiter(timeout):
+            with cond:
+                results.append(simclock.wait_for(
+                    cond, lambda: state["ready"], timeout))
+
+        t = threading.Thread(target=waiter, args=(10.0,))
+        t.start()
+        while not clk._by_seq:
+            threading.Event().wait(0.002)
+        with cond:
+            state["ready"] = True
+            cond.notify_all()
+        t.join(timeout=5.0)
+        assert results == [True]
+
+        state["ready"] = False
+        t2 = threading.Thread(target=waiter, args=(1.0,))
+        t2.start()
+        while not clk._by_seq:
+            threading.Event().wait(0.002)
+        clk.advance(1.5)              # virtual deadline lapses
+        t2.join(timeout=5.0)
+        assert results == [True, False]
+
+
+def test_autojump_advances_only_at_quiescence():
+    clk = VirtualClock(autojump=0.005)
+    with simclock.use(clk):
+        done = []
+
+        def sleeper():
+            simclock.sleep(30.0)      # would be 30 real seconds
+            done.append(simclock.now())
+
+        t = threading.Thread(target=sleeper)
+        t.start()
+        t.join(timeout=10.0)          # autojump must release it fast
+        assert done and done[0] == pytest.approx(30.0)
+        assert clk.simulated == pytest.approx(30.0)
+
+
+def test_advance_steps_through_intermediate_deadlines():
+    """A sleeper woken mid-advance may schedule NEW earlier work; the
+    clock must step deadline-by-deadline, never overshoot."""
+    clk = VirtualClock()
+    seen = []
+    with simclock.use(clk):
+        def chain():
+            seen.append(round(simclock.sleep(1.0), 6))
+
+        t = threading.Thread(target=chain)
+        t.start()
+        while not clk._by_seq:
+            threading.Event().wait(0.002)
+        clk.advance(10.0)
+        t.join(timeout=5.0)
+    # the sleeper woke at ITS deadline, not the advance target
+    assert seen == [1.0]
+    assert clk.now() == 10.0
+
+
+def test_late_binding_objects_follow_an_installed_clock():
+    """A breaker built under the real clock follows a virtual clock
+    installed afterwards — the module functions late-bind."""
+    from cilium_tpu.runtime.service import CircuitBreaker
+
+    br = CircuitBreaker(failure_threshold=1, probe_interval=5.0)
+    clk = VirtualClock()
+    with simclock.use(clk):
+        br.record_failure()
+        assert br.state == CircuitBreaker.OPEN
+        assert not br.allow_primary()     # probe timer not expired
+        clk.advance(5.1)
+        assert br.allow_primary()         # virtual expiry → probe
+        br.record_success()
+        assert br.state == CircuitBreaker.CLOSED

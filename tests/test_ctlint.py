@@ -1298,3 +1298,102 @@ def test_pallas_block_shape_shipped_kernels_clean():
     assert _check({"cilium_tpu/engine/pallas_dfa.py": src_dfa,
                    "cilium_tpu/engine/pallas_nfa.py": src_nfa},
                   pallas_rule.check) == []
+
+
+# ---------------------------------------------------------------------------
+# wall-clock (behavioral time routes through the injected Clock)
+
+from cilium_tpu.analysis import wallclock as wc_rule  # noqa: E402
+
+WALLCLOCK_BAD = """
+import time
+
+
+class Breaker:
+    def __init__(self):
+        self.opened_at = time.monotonic()
+
+    def expired(self):
+        return time.time() > self.opened_at + 5.0
+
+    def backoff(self):
+        time.sleep(0.5)
+"""
+
+WALLCLOCK_GOOD = """
+import time
+
+from cilium_tpu.runtime import simclock
+
+
+class Breaker:
+    def __init__(self):
+        self.opened_at = simclock.now()
+
+    def expired(self):
+        return simclock.wall() > self.opened_at + 5.0
+
+    def backoff(self):
+        simclock.sleep(0.5)
+
+    def measure(self):
+        # perf_counter is measurement, exempt by design
+        t0 = time.perf_counter()
+        return time.perf_counter() - t0
+"""
+
+
+def test_wall_clock_bad_corpus_flags_all_three_surfaces():
+    findings = _check({"cilium_tpu/runtime/breaker.py": WALLCLOCK_BAD},
+                      wc_rule.check)
+    assert all(f.rule == "wall-clock" for f in findings)
+    msgs = "\n".join(f.message for f in findings)
+    assert "time.monotonic" in msgs
+    assert "time.time" in msgs
+    assert "time.sleep" in msgs
+    assert len(findings) == 3
+
+
+def test_wall_clock_good_corpus_clean_and_perf_counter_exempt():
+    assert _check({"cilium_tpu/runtime/breaker.py": WALLCLOCK_GOOD},
+                  wc_rule.check) == []
+
+
+def test_wall_clock_out_of_scope_modules_untouched():
+    # analysis/bench/cli modules are NOT serving-plane scope; the
+    # clock seam itself is explicitly exempt
+    for path in ("cilium_tpu/analysis/timing.py",
+                 "cilium_tpu/cli.py",
+                 "cilium_tpu/runtime/simclock.py"):
+        assert _check({path: WALLCLOCK_BAD}, wc_rule.check) == [], path
+
+
+def test_wall_clock_justified_disable_honored():
+    src = WALLCLOCK_BAD.replace(
+        "        self.opened_at = time.monotonic()",
+        "        # ctlint: disable=wall-clock  # capture stamp of the real world\n"
+        "        self.opened_at = time.monotonic()")
+    findings = _check({"cilium_tpu/runtime/breaker.py": src},
+                      wc_rule.check)
+    assert len(findings) == 2  # the allowlisted monotonic is gone
+
+
+def test_wall_clock_from_import_alias_flagged():
+    src = """
+from time import sleep
+
+
+def retry():
+    sleep(1.0)
+"""
+    findings = _check({"cilium_tpu/runtime/retry.py": src},
+                      wc_rule.check)
+    assert len(findings) == 1
+    assert "time.sleep" in findings[0].message
+
+
+def test_wall_clock_tree_is_clean():
+    """The refactor is COMPLETE: the shipped serving plane has no
+    unjustified direct clock reads (the tree-wide acceptance)."""
+    findings, _sup = run(REPO_ROOT, rules=["wall-clock"])
+    assert findings == [], "\n".join(f.format() for f in findings)

@@ -18,7 +18,7 @@ import pytest
 
 from cilium_tpu.core.config import Config
 from cilium_tpu.core.flow import Flow, Protocol, TrafficDirection
-from cilium_tpu.runtime import faults
+from cilium_tpu.runtime import faults, simclock
 from cilium_tpu.runtime.faults import FaultInjected, FaultPlan, FaultRule
 from cilium_tpu.runtime.loader import Loader
 from cilium_tpu.runtime.metrics import (
@@ -299,6 +299,7 @@ def test_service_device_failure_degrades_to_oracle(tmp_path):
 
     per, db, web = _tiny_policy(5432)
     svc = _service(tmp_path, per, threshold=2, probe_interval=0.05)
+    probe_advance = 1.0   # > probe_interval: the timer reads expired
     want = {5432: 1, 5433: 2}
     trips0 = _metric(BREAKER_TRIPS)
     recov0 = _metric(BREAKER_RECOVERIES)
@@ -317,9 +318,11 @@ def test_service_device_failure_degrades_to_oracle(tmp_path):
             assert plan.counts("engine.dispatch")[1] == 2
         assert _metric(BREAKER_TRIPS) == trips0 + 1
         assert _metric(BREAKER_FALLBACK_VERDICTS) > fallb0
-        # injection over: wait out the probe interval; the next
-        # request half-open probes the device lane and recovers
-        time.sleep(0.06)
+        # injection over: advance the breaker's clock past the probe
+        # interval (no wall-clock sleep — ISSUE-10 virtual time); the
+        # next request half-open probes the device lane and recovers
+        svc.verdictor.breaker.clock = \
+            lambda: simclock.now() + probe_advance
         resp = client.call({"op": "verdict", "flows": [
             {"source": {"identity": web},
              "destination": {"identity": db},

@@ -3,7 +3,15 @@ admission-controlled batcher path (ISSUE 5 acceptance). Under a ~4×
 saturation offered load the service must SHED — explicitly and
 counted — while the admission queue depth stays at or under its
 configured bound and the p99 of ADMITTED requests stays within 2× the
-unloaded p99. Marked slow+soak so tier-1 timing never pays for it."""
+unloaded p99. Marked slow+soak so tier-1 timing never pays for it.
+
+Converted to VIRTUAL time (ISSUE 10): the synthetic engine's service
+time is a virtual sleep under an autojumping
+:class:`~cilium_tpu.runtime.simclock.VirtualClock`, so the lane
+simulates the same seconds of saturation in a fraction of the wall
+clock (the speedup is printed on the lane output) with the
+assertions UNCHANGED. One reduced-scale real-clock smoke variant
+keeps the wall-clock path honest."""
 
 import threading
 import time
@@ -11,6 +19,7 @@ import time
 import pytest
 
 from cilium_tpu.core.flow import Flow, Verdict
+from cilium_tpu.runtime import simclock
 from cilium_tpu.runtime.admission import AdmissionGate, CLASS_DATA
 from cilium_tpu.runtime.metrics import ADMISSION_SHED, METRICS
 from cilium_tpu.runtime.service import MicroBatcher
@@ -24,9 +33,23 @@ BATCH_MAX = 32
 MAX_PENDING = 32
 
 
+@pytest.fixture()
+def virtual_time():
+    """Autojumping virtual clock for the converted soak lanes; prints
+    the simulated-vs-wall speedup on the lane output."""
+    clock = simclock.VirtualClock(autojump=0.0015, poll=0.0015)
+    t0 = time.monotonic()
+    with simclock.use(clock):
+        yield clock
+    wall = max(time.monotonic() - t0, 1e-9)
+    print(f"\n[dst] soak lane under virtual time: simulated "
+          f"{clock.simulated:.2f}s in {wall:.2f}s wall "
+          f"({clock.simulated / wall:.1f}x)")
+
+
 def _build(gate=None):
     def verdict_fn(flows, deadline=None):
-        time.sleep(SERVICE_S)
+        simclock.sleep(SERVICE_S)
         return [int(Verdict.FORWARDED)] * len(flows)
 
     return MicroBatcher(verdict_fn, batch_max=BATCH_MAX,
@@ -36,15 +59,17 @@ def _build(gate=None):
 
 def _drive(mb, n_threads, per_thread, timeout=2.0):
     """Closed-loop load: n_threads callers issuing back-to-back
-    checks. Returns (admitted latencies, shed count, error count)."""
+    checks. Returns (admitted latencies, shed count, error count).
+    Latencies are measured on the installed clock — virtual seconds
+    under the converted lane, real seconds in the smoke variant."""
     lat, shed, err = [], [0], [0]
     lock = threading.Lock()
 
     def worker():
         for _ in range(per_thread):
-            t0 = time.monotonic()
+            t0 = simclock.now()
             v, status = mb.check_ex(Flow(), timeout=timeout)
-            dt = time.monotonic() - t0
+            dt = simclock.now() - t0
             with lock:
                 if status == "ok" and v == int(Verdict.FORWARDED):
                     lat.append(dt)
@@ -67,7 +92,7 @@ def _p99(samples):
     return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
 
 
-def test_overload_sheds_bounds_depth_and_protects_p99():
+def test_overload_sheds_bounds_depth_and_protects_p99(virtual_time):
     # -- unloaded baseline: a single closed-loop caller ------------------
     mb0 = _build()
     base_lat, base_shed, base_err = _drive(mb0, n_threads=1,
@@ -88,7 +113,9 @@ def test_overload_sheds_bounds_depth_and_protects_p99():
     shed_before = sum(
         v for (name, labels), v in METRICS._counters.items()
         if name == ADMISSION_SHED)
-    lat, shed, err = _drive(mb, n_threads=128, per_thread=12)
+    # virtual time makes saturation cheap: simulate ~4x the load the
+    # real-clock lane could afford at the same wall cost
+    lat, shed, err = _drive(mb, n_threads=128, per_thread=30)
     mb.close()
 
     # 1) sheds happened, explicitly and counted
@@ -114,10 +141,11 @@ def test_overload_sheds_bounds_depth_and_protects_p99():
         f"{p99_unloaded * 1e3:.1f} ms)")
 
     # 4) nothing vanished: every request either answered or shed
-    assert len(lat) + shed + err == 128 * 12
+    assert len(lat) + shed + err == 128 * 30
 
 
-def test_overload_with_deadlines_reaps_instead_of_wasting_slots():
+def test_overload_with_deadlines_reaps_instead_of_wasting_slots(
+        virtual_time):
     """Callers with tight deadlines under overload: lapsed entries are
     reaped (counted), and the engine only ever dispatched flows whose
     callers could still be waiting."""
@@ -136,6 +164,22 @@ def test_overload_with_deadlines_reaps_instead_of_wasting_slots():
     mb.close()
     assert METRICS.get(ADMISSION_REAPED) > reaped0
     assert err > 0  # abandoned callers saw explicit timeouts
+
+
+def test_overload_realclock_smoke():
+    """The real-clock smoke variant of the converted lane: reduced
+    scale, same assertion structure — keeps the wall-clock code path
+    (RealClock waits, real sleeps) exercised now that the full lane
+    runs virtual."""
+    gate = AdmissionGate(max_pending=MAX_PENDING, control_reserve=8)
+    mb = _build(gate=gate)
+    gate.depth_fn = lambda: len(mb._pending)
+    lat, shed, err = _drive(mb, n_threads=96, per_thread=3)
+    mb.close()
+    assert shed > 0, "4x overload produced zero sheds"
+    assert mb.peak_pending <= MAX_PENDING
+    assert lat, "no requests were admitted under overload"
+    assert len(lat) + shed + err == 96 * 3
 
 
 # ---------------------------------------------------------------------------
@@ -288,6 +332,9 @@ def test_churn_soak_bank_scoped_compile_and_hot_memo(tmp_path):
     added = []          # (identity, kind, pattern) added by churn
     update_ms = []
     changes = 0
+    schedule = []       # (step, op, identity, pattern): the lane's
+    #                     replayable update schedule, digested onto
+    #                     the bench line's dst provenance stamp
     for step in range(UPDATES):
         i = int(rng.integers(N_IDS))
         if added and (step % 3 == 2):      # delete a churned rule
@@ -306,6 +353,7 @@ def test_churn_soak_bank_scoped_compile_and_hot_memo(tmp_path):
             added.append((i, kind, pat))
             probe = http_flow(i, f"/churn{step}/x")
         changes += 1
+        schedule.append((step, kind, pat))
         t0 = time.perf_counter()
         loader.regenerate(resolve(), revision=2 + step)
         if probe is not None:
@@ -349,7 +397,15 @@ def test_churn_soak_bank_scoped_compile_and_hot_memo(tmp_path):
                                 int(0.99 * len(update_ms)))]
     out_path = os.environ.get("CILIUM_TPU_CHURN_BENCH_OUT")
     if out_path:
+        import hashlib
+
         from cilium_tpu.runtime.provenance import stamp
+
+        # the lane's update schedule rides the dst provenance stamp:
+        # a regression on this line names the exact churn sequence
+        os.environ["CILIUM_TPU_DST_DIGEST"] = hashlib.sha256(
+            json.dumps(schedule, sort_keys=True).encode()
+        ).hexdigest()[:16]
 
         line = stamp({
             "metric": "churn_update_p99_ms",
