@@ -4,9 +4,9 @@
 PY ?= python
 
 .PHONY: test shim lint determinism dryrun chaos obs soak churn dst \
-        dst-validate bench bench-all bench-e2e bench-service \
-        bench-regen bench-sp bench-stage bench-stream bench-kernel \
-        bench-multichip bench-watch perf-report check
+        dst-validate serve-soak bench bench-all bench-e2e \
+        bench-service bench-regen bench-sp bench-stage bench-stream \
+        bench-kernel bench-multichip bench-watch perf-report check
 
 test:            ## full suite (CPU, virtual 8-device mesh via conftest)
 	$(PY) -m pytest tests/ -q
@@ -57,7 +57,21 @@ obs:             ## observability lane: tracing tests + scrape lint
 # times on an autojumping VirtualClock; one real-clock smoke stays)
 soak:            ## synthetic-overload admission/shed lane
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_soak.py -q -s \
-	    -m "soak and not churn"
+	    -m "soak and not churn and not serve"
+
+# serve-soak: the ISSUE-11 acceptance lane — the DST load model
+# (runtime/loadmodel.py) drives >=100k CONCURRENT virtual streams
+# (heavy-tailed arrivals, diurnal swing, reconnect storms, seeded
+# serve.lease/serve.ring_slot faults) through the continuously-
+# batched serving loop (runtime/serveloop.py + engine/ring.py) under
+# the autojumping VirtualClock, with lease-accounting / sampled-
+# correctness / memo-honesty invariants checked after every event.
+# Gates: 0 violations, concurrency peak >= 95k, p99 <= 2x unloaded,
+# shed rate bounded, memo-bypass bytes > 0. One provenance-stamped
+# line lands in BENCH_SERVE_r07.jsonl (consumed by perf-report).
+serve-soak:      ## 100k-virtual-stream continuous-batching soak
+	JAX_PLATFORMS=cpu $(PY) -m cilium_tpu.runtime.loadmodel \
+	    --streams 100000 --out BENCH_SERVE_r07.jsonl
 
 # churn: the ISSUE-8 acceptance soak — sustained CNP add/delete +
 # FQDN pattern churn through a live replay session across ≥50

@@ -176,6 +176,30 @@ class DSTConfig:
 
 
 @dataclasses.dataclass
+class ServeConfig:
+    """Continuously-batched serving loop (runtime/serveloop.py +
+    engine/ring.py): streams are admitted into verdict-ring slot
+    leases and whatever slots have pending chunks are packed into one
+    fused dispatch per ``pack_interval_ms``. Off by default — the
+    stream path then uses its per-session dispatch (the pre-ring
+    behavior); both are verdict-bit-equal."""
+
+    enabled: bool = False
+    #: verdict-ring slots (= concurrently admitted streams); a new
+    #: stream past this sheds with reason ``ring-full``
+    slot_capacity: int = 1024
+    #: idle lease lifetime: a stream silent this long loses its slot
+    #: (reconnect-with-resume re-grants)
+    lease_ttl_s: float = 30.0
+    #: continuous-batching cadence: the pack thread drains pending
+    #: slots into one fused dispatch this often
+    pack_interval_ms: float = 2.0
+    #: per-slot pending-chunk bound: a producer outrunning the pack
+    #: cycle sheds (``queue-full``) instead of buffering forever
+    max_slot_pending: int = 64
+
+
+@dataclasses.dataclass
 class ParallelConfig:
     """Mesh / sharding layout (SURVEY.md §2.6)."""
 
@@ -219,6 +243,7 @@ class Config:
     tracing: TracingConfig = dataclasses.field(default_factory=TracingConfig)
     admission: AdmissionConfig = dataclasses.field(
         default_factory=AdmissionConfig)
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     dst: DSTConfig = dataclasses.field(default_factory=DSTConfig)
     log_level: str = "info"
     #: ``--k8s-api-socket``: when set, the agent consumes CNP/CCNP
@@ -284,6 +309,18 @@ class Config:
         if "CILIUM_TPU_STREAM_CREDIT_WINDOW" in env:
             cfg.admission.stream_credit_window = int(
                 env["CILIUM_TPU_STREAM_CREDIT_WINDOW"])
+        if env.get("CILIUM_TPU_SERVE_LOOP", "").lower() in (
+                "1", "true", "yes"):
+            cfg.serve.enabled = True
+        if "CILIUM_TPU_SERVE_SLOT_CAPACITY" in env:
+            cfg.serve.slot_capacity = int(
+                env["CILIUM_TPU_SERVE_SLOT_CAPACITY"])
+        if "CILIUM_TPU_SERVE_LEASE_TTL_S" in env:
+            cfg.serve.lease_ttl_s = float(
+                env["CILIUM_TPU_SERVE_LEASE_TTL_S"])
+        if "CILIUM_TPU_SERVE_PACK_INTERVAL_MS" in env:
+            cfg.serve.pack_interval_ms = float(
+                env["CILIUM_TPU_SERVE_PACK_INTERVAL_MS"])
         if "CILIUM_TPU_DST_SEED" in env:
             cfg.dst.seed = int(env["CILIUM_TPU_DST_SEED"])
         if "CILIUM_TPU_DST_MUTATION" in env:
@@ -312,6 +349,7 @@ class Config:
                                 ("breaker", cfg.breaker),
                                 ("tracing", cfg.tracing),
                                 ("admission", cfg.admission),
+                                ("serve", cfg.serve),
                                 ("dst", cfg.dst)):
             for k, v in data.get(section, {}).items():
                 if hasattr(target, k):

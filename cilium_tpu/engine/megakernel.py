@@ -20,9 +20,13 @@ in group space (``G ≪ R``: the 1k-rule http policy has 15 groups) and
 collapses to ruleset-any over a ``[RS, G]`` bitmap. Bit-equal to the
 legacy path by construction (the factoring is exact boolean algebra);
 pinned over the golden corpus and hypothesis-random policies by
-tests/test_megakernel.py. Kafka and generic-l7 rule families keep the
-legacy columnar formulas (their rules carry no automaton lanes to
-factor through — and they are not the hot families).
+tests/test_megakernel.py. Kafka and generic-l7 rule families ride the
+same factored path as distinct-PREDICATE groups (no automaton lanes
+to factor through, but identical predicates across rules collapse to
+one group with OR'd ruleset membership), so every protocol family —
+http, dns, kafka, generic — resolves in group space inside the one
+fused launch; the precedence/auth/audit assembly stays the shared
+``_assemble_verdict``.
 
 **Per-bank-shape scan autotuning.** The byte-scan has two
 implementations — the dense-gather DFA (``engine/dfa_kernel.py``) and
@@ -84,11 +88,90 @@ def _mask_bits(mask: np.ndarray, n: int) -> np.ndarray:
     return bits.reshape(RS, W * 32)[:, :n]
 
 
+def _dedup_kafka_groups(arrays: Dict[str, np.ndarray],
+                        n_kafka: int) -> Tuple[Dict, int]:
+    """Kafka rules deduped to distinct-predicate groups: a kafka rule
+    is a pure conjunction of exact matches (apikey mask / version /
+    client / topic), so identical predicates across rules — the common
+    case when many rulesets reference the same ACL — collapse to one
+    group whose ruleset membership is the OR of its members'. Exact by
+    boolean algebra: ruleset-any over rules == ruleset-any over
+    distinct predicates with OR'd membership."""
+    RS = arrays["rs_kafka_mask"].shape[0]
+    member = _mask_bits(arrays["rs_kafka_mask"], max(1, n_kafka))
+    groups: Dict[tuple, set] = {}
+    for r in range(n_kafka):
+        rss = np.nonzero(member[:, r])[0]
+        if not len(rss):
+            continue  # unreferenced rule can never fire
+        key = (int(arrays["kafka_apikey_mask"][r]),
+               int(arrays["kafka_version"][r]),
+               int(arrays["kafka_client"][r]),
+               int(arrays["kafka_topic"][r]))
+        groups.setdefault(key, set()).update(int(x) for x in rss)
+    G = max(1, len(groups))
+    Gw = (G + 31) // 32
+    # the empty/dummy slot carries an impossible predicate spelled as
+    # "never a member": zero membership words keep it inert
+    k_mask = np.zeros(G, np.uint32)
+    k_ver = np.full(G, -1, np.int32)
+    k_cli = np.full(G, -1, np.int32)
+    k_top = np.full(G, -1, np.int32)
+    rs_kmask = np.zeros((RS, Gw), np.uint32)
+    for g, (key, rss) in enumerate(groups.items()):
+        k_mask[g], k_ver[g], k_cli[g], k_top[g] = key
+        gbit = np.uint32(1 << (g % 32))
+        for rs in rss:
+            rs_kmask[rs, g // 32] |= gbit
+    return {"rp_k_apikey_mask": k_mask, "rp_k_version": k_ver,
+            "rp_k_client": k_cli, "rp_k_topic": k_top,
+            "rp_rs_kmask": rs_kmask}, len(groups)
+
+
+def _dedup_gen_groups(arrays: Dict[str, np.ndarray],
+                      n_gen: int) -> Tuple[Dict, int]:
+    """Generic (l7proto) rules deduped to distinct (proto, pair-id
+    SET) groups — pair matching is subset semantics, so order and
+    duplicates inside a rule's pair row are irrelevant to the
+    predicate identity."""
+    RS = arrays["rs_gen_mask"].shape[0]
+    member = _mask_bits(arrays["rs_gen_mask"], max(1, n_gen))
+    groups: Dict[tuple, set] = {}
+    for r in range(n_gen):
+        if int(arrays["gen_rule_proto"][r]) < 0:
+            continue  # proto-less rule is dead by construction
+        rss = np.nonzero(member[:, r])[0]
+        if not len(rss):
+            continue
+        pairs = tuple(sorted({int(p)
+                              for p in arrays["gen_rule_pairs"][r]
+                              if p >= 0}))
+        key = (int(arrays["gen_rule_proto"][r]), pairs)
+        groups.setdefault(key, set()).update(int(x) for x in rss)
+    G = max(1, len(groups))
+    Gw = (G + 31) // 32
+    Km = max([len(k[1]) for k in groups] + [1])
+    g_proto = np.full(G, -1, np.int32)
+    g_pairs = np.full((G, Km), -1, np.int32)
+    rs_gmask = np.zeros((RS, Gw), np.uint32)
+    for g, (key, rss) in enumerate(groups.items()):
+        proto, pairs = key
+        g_proto[g] = proto
+        g_pairs[g, :len(pairs)] = pairs
+        gbit = np.uint32(1 << (g % 32))
+        for rs in rss:
+            rs_gmask[rs, g // 32] |= gbit
+    return {"rp_gen_proto": g_proto, "rp_gen_pairs": g_pairs,
+            "rp_rs_genmask": rs_gmask}, len(groups)
+
+
 def build_resolve_plan(arrays: Dict[str, np.ndarray], n_http: int,
-                       n_dns: int) -> Optional[Tuple[Dict, Dict]]:
-    """Factor the per-rule HTTP conjunction and the DNS lane checks
-    into group space. Returns ``(rp_arrays, meta)`` — ``rp_arrays``
-    joins ``CompiledPolicy.arrays`` (staged to device), ``meta`` stays
+                       n_dns: int, n_kafka: int = 0,
+                       n_gen: int = 0) -> Optional[Tuple[Dict, Dict]]:
+    """Factor the per-rule HTTP conjunction, the DNS lane checks, and
+    the kafka/generic predicate tables into group space. Returns
+    ``(rp_arrays, meta)`` — ``rp_arrays`` joins
+    ``CompiledPolicy.arrays`` (staged to device), ``meta`` stays
     host-side (NFA group-plane construction, observability) — or None
     when the grouping degenerates past :data:`GROUP_CAP`."""
     RS = arrays["rs_http_mask"].shape[0]
@@ -176,6 +259,15 @@ def build_resolve_plan(arrays: Dict[str, np.ndarray], n_http: int,
         for rs in np.nonzero(dmem[:, r])[0]:
             dns_rsmask[rs, lane // 32] |= np.uint32(1 << (lane % 32))
 
+    # kafka/generic ride the same factored path (distinct-predicate
+    # groups, no accept planes needed — their predicates are columnar
+    # exact matches): one fused launch resolves EVERY protocol family
+    # in group space
+    k_arrays, k_groups = _dedup_kafka_groups(arrays, n_kafka)
+    gen_arrays, gen_groups = _dedup_gen_groups(arrays, n_gen)
+    if len(groups) + k_groups + gen_groups > GROUP_CAP:
+        return None
+
     rp = {
         "rp_g_method": g_method, "rp_g_host": g_host,
         "rp_g_hdr": g_hdr, "rp_g_log": g_log,
@@ -183,7 +275,10 @@ def build_resolve_plan(arrays: Dict[str, np.ndarray], n_http: int,
         "rp_rs_gmask": rs_gmask, "rp_path_gaccept": gacc,
         "rp_dns_rsmask": dns_rsmask,
     }
-    meta = {"groups": len(groups), "lane_groups": lane_groups}
+    rp.update(k_arrays)
+    rp.update(gen_arrays)
+    meta = {"groups": len(groups), "lane_groups": lane_groups,
+            "kafka_groups": k_groups, "gen_groups": gen_groups}
     return rp, meta
 
 
@@ -224,11 +319,64 @@ def _fused_l7_dns(arrays, ruleset, dns_w, l7t):
             & (l7t == int(L7Type.DNS)))
 
 
+def _fused_l7_kafka(arrays, ruleset, kafka_cols, l7t):
+    """Group-space kafka conjunction over the DEDUPED predicate table
+    (``rp_k_*``) — same formula as the legacy ``_l7_kafka``, evaluated
+    once per distinct predicate instead of once per rule."""
+    from cilium_tpu.engine.verdict import _bools_to_words
+
+    k_api, k_ver, k_cli, k_top = kafka_cols
+    ak = jnp.clip(k_api, 0, 31).astype(jnp.uint32)
+    am = arrays["rp_k_apikey_mask"][None, :]        # [1, Gk]
+    # api_key < 0 is the unknown-role sentinel — it matches only
+    # api-key-unconstrained predicates (see _l7_kafka)
+    g_ok = (
+        ((am == 0) | (((am >> ak[:, None]) & jnp.uint32(1)).astype(bool)
+                      & (k_api >= 0)[:, None]))
+        & ((arrays["rp_k_version"][None, :] < 0)
+           | (arrays["rp_k_version"][None, :] == k_ver[:, None]))
+        & ((arrays["rp_k_client"][None, :] < 0)
+           | (arrays["rp_k_client"][None, :] == k_cli[:, None]))
+        & ((arrays["rp_k_topic"][None, :] < 0)
+           | (arrays["rp_k_topic"][None, :] == k_top[:, None]))
+    )
+    gmask = arrays["rp_rs_kmask"][ruleset]
+    g_words = _bools_to_words(g_ok, gmask.shape[1])
+    return (jnp.any((g_words & gmask) != 0, axis=1)
+            & (l7t == int(L7Type.KAFKA)))
+
+
+def _fused_l7_generic(arrays, ruleset, gen_cols, l7t):
+    """Group-space generic pair-subset matching over the deduped
+    (proto, pair-set) predicate table (``rp_gen_*``)."""
+    from cilium_tpu.engine.verdict import _bools_to_words
+
+    gen_proto, gen_pairs = gen_cols
+    grp = arrays["rp_gen_pairs"]                # [Gg, Km]
+    have = jnp.any(
+        gen_pairs[:, None, None, :] == grp[None, :, :, None],
+        axis=-1)                                # [B, Gg, Km]
+    pair_ok = jnp.all(jnp.where(grp[None, :, :] < 0, True, have),
+                      axis=-1)
+    proto_ok = (arrays["rp_gen_proto"][None, :]
+                == gen_proto[:, None])          # [B, Gg]
+    g_ok = pair_ok & proto_ok \
+        & (arrays["rp_gen_proto"] >= 0)[None, :]
+    gmask = arrays["rp_rs_genmask"][ruleset]
+    g_words = _bools_to_words(g_ok, gmask.shape[1])
+    return (jnp.any((g_words & gmask) != 0, axis=1)
+            & (l7t == int(L7Type.GENERIC)))
+
+
 def fused_verdict_core(arrays, ms, l7t, words, gwords, kafka_cols,
                        auth_src_dst, batch, gen_cols=None):
-    """The factored-resolve back half; shares the kafka/generic/
-    precedence assembly with the legacy ``_verdict_core`` so the two
-    paths cannot drift on the families the plan doesn't touch."""
+    """The factored-resolve back half; shares the precedence/auth/
+    audit assembly with the legacy ``_verdict_core`` so the two paths
+    cannot drift on the verdict-code semantics. Kafka/generic use
+    their deduped predicate groups when the plan staged them
+    (``rp_k_*``/``rp_gen_*`` — every protocol family resolves in one
+    fused launch); plans from older artifacts fall back to the
+    per-rule helpers, still bit-equal."""
     from cilium_tpu.engine.verdict import (
         _assemble_verdict,
         _l7_generic,
@@ -239,11 +387,18 @@ def fused_verdict_core(arrays, ms, l7t, words, gwords, kafka_cols,
                        arrays["rs_http_mask"].shape[0] - 1)
     http_ok, l7_log_http = _fused_l7_http(arrays, ruleset, words,
                                           gwords, l7t)
-    kafka_ok = _l7_kafka(arrays, ruleset, kafka_cols, l7t)
+    if "rp_rs_kmask" in arrays:      # static under jit
+        kafka_ok = _fused_l7_kafka(arrays, ruleset, kafka_cols, l7t)
+    else:
+        kafka_ok = _l7_kafka(arrays, ruleset, kafka_cols, l7t)
     dns_ok = _fused_l7_dns(arrays, ruleset, words[4], l7t)
     l7_ok = http_ok | kafka_ok | dns_ok
     if gen_cols is not None:
-        l7_ok = l7_ok | _l7_generic(arrays, ruleset, gen_cols, l7t)
+        if "rp_rs_genmask" in arrays:
+            l7_ok = l7_ok | _fused_l7_generic(arrays, ruleset,
+                                              gen_cols, l7t)
+        else:
+            l7_ok = l7_ok | _l7_generic(arrays, ruleset, gen_cols, l7t)
     return _assemble_verdict(arrays, ms, l7_ok, l7_log_http,
                              auth_src_dst, batch)
 

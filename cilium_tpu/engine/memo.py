@@ -44,6 +44,19 @@ from cilium_tpu.runtime.metrics import (
 )
 
 
+#: L7 family names of the bank-reference granularity: which rule
+#: family a memoized row's verdict actually READ. Rows carry their
+#: family in the l7_types column; "l4" rows read no L7 banks at all
+#: and move only on a structural (MapState) change.
+FAMILY_OF_L7TYPE = {0: "l4", 1: "http", 2: "kafka", 3: "dns",
+                    4: "generic"}
+
+#: wildcard family: the identity's STRUCTURAL state (MapState keys,
+#: deny/auth/wildcard bits, enforcement flags) changed — every row of
+#: the identity may verdict differently regardless of family
+FAMILY_ALL = "*"
+
+
 @dataclasses.dataclass(frozen=True)
 class PolicyDelta:
     """What one committed revision actually changed — the bank-scoped
@@ -55,12 +68,29 @@ class PolicyDelta:
     alters its identities' MapState fingerprints, so identity
     granularity subsumes rule/bank granularity for memo OUTPUTS), and
     ``changed_banks`` names the hot-swapped content-addressed bank
-    keys for observability and the per-bank epoch map."""
+    keys for observability and the per-bank epoch map.
+
+    ``changed_identity_families`` narrows further, to TRUE
+    bank-reference granularity (the PR-8 "remaining headroom"): each
+    ``(identity, family)`` pair says which rule family of that
+    identity actually changed, where family is one of
+    :data:`FAMILY_OF_L7TYPE`'s values or :data:`FAMILY_ALL` (the
+    identity's structural MapState moved — all rows affected). A row
+    only re-verdicts when its identity changed AND its own L7 family
+    read a swapped bank: an HTTP-path bank swap no longer refills the
+    identity's DNS/kafka memo rows, because their verdicts never read
+    the path automaton (every ``l7_ok`` contribution is gated on
+    ``l7t == family``). Empty = unknown (producer predates family
+    fingerprints) — consumers fall back to identity granularity."""
 
     full: bool = True
     reason: str = "policy-swap"
     changed_identities: frozenset = frozenset()
     changed_banks: frozenset = frozenset()
+    #: frozenset of (identity, family) pairs; family FAMILY_ALL marks
+    #: a structural change. Covers exactly ``changed_identities`` when
+    #: non-empty (the loader produces both from the same fingerprints)
+    changed_identity_families: frozenset = frozenset()
 
     @classmethod
     def none(cls) -> "PolicyDelta":
@@ -70,16 +100,34 @@ class PolicyDelta:
         return cls(full=False, reason="no-change")
 
     @classmethod
-    def banks(cls, identities, banks,
-              reason: str = "bank-swap") -> "PolicyDelta":
+    def banks(cls, identities, banks, reason: str = "bank-swap",
+              identity_families=()) -> "PolicyDelta":
         return cls(full=False, reason=reason,
                    changed_identities=frozenset(identities),
-                   changed_banks=frozenset(banks))
+                   changed_banks=frozenset(banks),
+                   changed_identity_families=frozenset(
+                       identity_families))
 
     @property
     def is_noop(self) -> bool:
         return (not self.full and not self.changed_identities
                 and not self.changed_banks)
+
+    def affects(self, identity: int, l7_type: int) -> bool:
+        """May a memoized row with this (enforcement identity, L7
+        type) verdict differently under this delta? The consumer-side
+        face of the granularity ladder: full → identity → family."""
+        if self.full:
+            return True
+        if identity not in self.changed_identities:
+            return False
+        fams = self.changed_identity_families
+        if not fams:
+            return True          # identity-granular producer
+        if (identity, FAMILY_ALL) in fams:
+            return True
+        family = FAMILY_OF_L7TYPE.get(int(l7_type))
+        return family is not None and (identity, family) in fams
 
     def merge(self, other: "PolicyDelta") -> "PolicyDelta":
         if self.full or other.full:
@@ -88,11 +136,52 @@ class PolicyDelta:
             return self
         if self.is_noop:
             return other
+        # family narrowing only survives a merge when BOTH sides carry
+        # it: a families-blind delta means "all families" for its
+        # identities, and widening per-identity would lose the
+        # invariant that the family set covers changed_identities
+        if (self.changed_identity_families
+                and other.changed_identity_families):
+            fams = (self.changed_identity_families
+                    | other.changed_identity_families)
+        else:
+            fams = frozenset()
         return PolicyDelta(
             full=False, reason=other.reason,
             changed_identities=(self.changed_identities
                                 | other.changed_identities),
-            changed_banks=self.changed_banks | other.changed_banks)
+            changed_banks=self.changed_banks | other.changed_banks,
+            changed_identity_families=fams)
+
+
+def affected_row_ids(delta: "PolicyDelta", eps, l7_types
+                     ) -> "np.ndarray":
+    """Vectorized :meth:`PolicyDelta.affects` over aligned
+    ``(enforcement identity, l7 type)`` columns → the affected row
+    ids, int32. The shared consumer-side half of the family-granular
+    invalidation (CaptureReplay offline, IncrementalSession online,
+    the verdict ring's shared session) — one implementation so the
+    layers can't drift on what "row read the swapped bank" means."""
+    eps = np.asarray(eps, dtype=np.int64)
+    l7s = np.asarray(l7_types, dtype=np.int64)
+    if delta.full:
+        return np.arange(len(eps), dtype=np.int32)
+    if not delta.changed_identities:
+        return np.zeros(0, dtype=np.int32)
+    fams = delta.changed_identity_families
+    mask = np.zeros(len(eps), dtype=bool)
+    for ep in delta.changed_identities:
+        sel = eps == ep
+        if not sel.any():
+            continue
+        if not fams or (ep, FAMILY_ALL) in fams:
+            mask |= sel        # identity-granular (or structural)
+            continue
+        codes = [code for code, name in FAMILY_OF_L7TYPE.items()
+                 if (ep, name) in fams]
+        if codes:
+            mask |= sel & np.isin(l7s, codes)
+    return np.nonzero(mask)[0].astype(np.int32)
 
 
 #: committed-revision deltas retained for lagging consumers; a session

@@ -1,0 +1,273 @@
+"""Persistent device-resident verdict ring: the continuous-batching
+engine face of the serving loop.
+
+The pre-ring serving plane was request/response-shaped: MicroBatcher
+formed batches host-side per request wave, every stream carried a
+PRIVATE IncrementalSession, and every stream's bytes crossed the
+socket/PCIe boundary even when the verdict memo already knew the
+answer. The ring inverts all three:
+
+* **One row universe for every admitted stream.** The ring owns one
+  shared :class:`~cilium_tpu.engine.session.IncrementalSession` —
+  string tables, unique-row table, and the device-resident verdict
+  memo are RING-resident, not per-stream. Live traffic repeats its
+  15-tuples across streams at least as hard as within one (identities
+  × ports × L7 fields draw from small sets), so cross-stream dedup is
+  strictly more memo-hits than per-stream dedup ever saw.
+* **Continuous batching, one fused dispatch per pack.** Streams
+  submit chunks into their leased slots; the pack cycle drains
+  whatever slots have pending work and serves the CONCATENATED id
+  vector through one ``serve_ids`` call — one fused megakernel
+  dispatch for the delta rows plus one on-device memo gather for
+  everything known, however many streams contributed. No per-wave
+  host barrier: a slot that missed this pack rides the next.
+* **Memo hits never cross the boundary.** ``encode_ids`` interns
+  host-side; a row the ring has seen before ships 4 bytes of id
+  instead of its featurized row block — the Libra selective-copy
+  argument (PAPERS.md) applied at the H2D seam, with the saved bytes
+  counted on ``cilium_tpu_serve_memo_bypass_bytes_total`` so the
+  claim is a number, not an adjective.
+
+Slot-resident session state survives policy hot-swaps through the
+shared session's PR-8 delta path (``loader=``): a bank-scoped commit
+refills only the memo rows whose identity+family read the swapped
+bank; slots notice nothing.
+
+Slot lifecycle (grant/TTL/expiry/admission) lives one layer up in
+``runtime/serveloop.py`` — this module is the engine-side mechanism:
+slots, packing, the fused dispatch, and the byte accounting.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from cilium_tpu.engine.session import IncrementalSession
+from cilium_tpu.runtime.metrics import (
+    METRICS,
+    SERVE_MEMO_BYPASS_BYTES,
+    SERVE_PACK_RECORDS,
+    SERVE_PACK_STREAMS,
+)
+
+#: hard bound on records one pack cycle may carry to the device —
+#: chunks past it wait for the next cycle (pow2-padded shapes above
+#: this would blow compile-shape variety and device memory, the same
+#: bound the stream transport enforces per chunk)
+PACK_MAX = 1 << 17
+
+
+class RingSlot:
+    """One leased stream's ring residency: pending (not yet packed)
+    encoded chunks plus lifetime accounting. The slot holds ENCODED
+    ids, never raw payloads — encoding happens at submit so the pack
+    cycle is a concatenate, not a featurize loop."""
+
+    __slots__ = ("slot_id", "stream_id", "pending", "records_in",
+                 "records_out", "epoch")
+
+    def __init__(self, slot_id: int):
+        self.slot_id = slot_id
+        self.stream_id: Optional[str] = None
+        #: [(idx int32 array, completion callback or None), ...] —
+        #: bounded by the serve loop's per-slot pending bound; the
+        #: ring itself bounds the PACK, not the slot
+        self.pending: List[Tuple[np.ndarray, object]] = []
+        self.records_in = 0
+        self.records_out = 0
+        #: session reset epoch the pending ids were encoded under —
+        #: a session reset orphans encoded ids, so stale pending work
+        #: is re-encoded (see VerdictRing.submit/pack)
+        self.epoch = 0
+
+
+class RingFull(RuntimeError):
+    """No free slot: the caller sheds the stream with an explicit
+    reason instead of queueing it invisibly."""
+
+
+class VerdictRing:
+    """Fixed-capacity ring of stream slots over one shared
+    incremental session. Thread-safe: the serve loop's pack thread
+    and the per-connection submit paths interleave under one lock;
+    the device dispatch itself runs outside it (jax dispatch is
+    async, and two packs never run concurrently by construction —
+    only the pack loop calls :meth:`pack`)."""
+
+    def __init__(self, engine, capacity: int, loader=None,
+                 widths: Optional[Dict[str, int]] = None,
+                 memo: bool = True):
+        self.capacity = max(1, int(capacity))
+        self.session = IncrementalSession(engine, widths=widths,
+                                          memo=memo, loader=loader)
+        self._lock = threading.Lock()
+        self._slots: Dict[int, RingSlot] = {}
+        self._free: List[int] = list(range(self.capacity - 1, -1, -1))
+        #: slot ids with pending work, in submit order (bounded by
+        #: capacity: a slot appears at most once)
+        self._dirty: List[int] = []
+        self._dirty_set: set = set()
+        #: lifetime counters (the serve loop's bench/invariant face)
+        self.packs = 0
+        self.records_packed = 0
+        self.bytes_saved = 0
+        self.bytes_shipped = 0
+
+    # -- slot lifecycle ---------------------------------------------------
+    @property
+    def occupancy(self) -> int:
+        with self._lock:
+            return len(self._slots)
+
+    def acquire(self, stream_id: str) -> RingSlot:
+        """Claim a free slot for ``stream_id``; raises
+        :class:`RingFull` when the ring is at capacity — the caller
+        sheds with reason ``ring-full``, never queues."""
+        with self._lock:
+            if not self._free:
+                raise RingFull(
+                    f"ring at capacity ({self.capacity} slots)")
+            sid = self._free.pop()
+            slot = self._slots.get(sid)
+            if slot is None:
+                slot = RingSlot(sid)
+            slot.stream_id = stream_id
+            slot.pending = []
+            self._slots[sid] = slot
+            return slot
+
+    def release(self, slot: RingSlot) -> List[Tuple[np.ndarray, object]]:
+        """Return a slot to the free list (lease expiry, stream end,
+        drain). Pending unpacked chunks are DROPPED and returned —
+        popped under the ring lock, so a chunk is resolved by EITHER
+        the pack cycle (verdicts) or the releaser (error), never
+        both."""
+        with self._lock:
+            dropped = slot.pending
+            slot.pending = []
+            slot.stream_id = None
+            if slot.slot_id in self._slots:
+                del self._slots[slot.slot_id]
+                self._free.append(slot.slot_id)
+            if slot.slot_id in self._dirty_set:
+                self._dirty_set.discard(slot.slot_id)
+                self._dirty = [s for s in self._dirty
+                               if s != slot.slot_id]
+            return dropped
+
+    # -- submit -----------------------------------------------------------
+    def submit(self, slot: RingSlot, rec, l7, offsets, blob, gen=None,
+               done=None) -> int:
+        """Encode one chunk into the slot's pending queue (host work
+        only). ``done`` is an opaque completion token the pack cycle
+        hands back with the chunk's verdicts. Returns the chunk's
+        record count. Raises if the slot is not resident."""
+        n = len(rec)
+        with self._lock:
+            if self._slots.get(slot.slot_id) is not slot:
+                raise RuntimeError("slot is not ring-resident")
+            # encode under the lock: the session's intern tables are
+            # shared mutable state, and encode is the only writer
+            # besides pack's dispatch (which never interns)
+            idx, novel = self.session.encode_ids(rec, l7, offsets,
+                                                 blob, gen)
+            known = n - novel
+            row_bytes = self.session.row_width * 4
+            # selective-copy accounting: known rows ship a 4-byte id
+            # instead of their featurized row block
+            self.bytes_saved += known * max(0, row_bytes - 4)
+            self.bytes_shipped += novel * row_bytes + n * 4
+            if known:
+                METRICS.inc(SERVE_MEMO_BYPASS_BYTES,
+                            known * max(0, row_bytes - 4))
+            slot.pending.append((idx, done))
+            slot.records_in += n
+            slot.epoch = self.session.resets
+            if slot.slot_id not in self._dirty_set:
+                self._dirty_set.add(slot.slot_id)
+                self._dirty.append(slot.slot_id)
+        return n
+
+    # -- the pack cycle ---------------------------------------------------
+    def pack(self, authed_pairs=None, max_records: int = PACK_MAX
+             ) -> List[Tuple[RingSlot, int, object, object]]:
+        """Drain pending chunks (submit order, up to ``max_records``)
+        into ONE fused dispatch; returns ``[(slot, n, done, device
+        verdict slice), ...]`` per packed chunk. Chunks whose ids
+        predate a session reset are dropped with ``verdicts=None`` —
+        the serve loop resubmits them (their payload is gone; the
+        LOAD MODEL treats it as a retryable shed). Empty list when
+        nothing was pending."""
+        with self._lock:
+            batch: List[Tuple[RingSlot, np.ndarray, object]] = []
+            stale: List[Tuple[RingSlot, int, object]] = []
+            total = 0
+            epoch = self.session.resets
+            taken_slots = 0
+            while self._dirty and total < max_records:
+                sid = self._dirty[0]
+                slot = self._slots.get(sid)
+                if slot is None or not slot.pending:
+                    self._dirty.pop(0)
+                    self._dirty_set.discard(sid)
+                    continue
+                idx, done = slot.pending[0]
+                if slot.epoch != epoch:
+                    # encoded before a session reset: the ids name
+                    # rows that no longer exist
+                    slot.pending.pop(0)
+                    stale.append((slot, len(idx), done))
+                    continue
+                if total + len(idx) > max_records and batch:
+                    break  # next cycle picks it up — no host barrier
+                slot.pending.pop(0)
+                batch.append((slot, idx, done))
+                total += len(idx)
+                if not slot.pending:
+                    self._dirty.pop(0)
+                    self._dirty_set.discard(sid)
+                taken_slots += 1
+            if not batch:
+                return [(s, n, d, None) for s, n, d in stale]
+            packed = np.concatenate([idx for _, idx, _ in batch])
+        # dispatch OUTSIDE the lock: submits keep landing while the
+        # fused step runs; only the pack loop calls pack(), so two
+        # dispatches never race on the session's device tables
+        try:
+            verdicts = self.session.serve_ids(packed,
+                                              authed_pairs=authed_pairs)
+        except Exception:
+            # dispatch failed (injected fault, sick device): put the
+            # batch BACK at the slots' heads — the next cycle retries
+            # it (transient faults recover), and no ticket is lost
+            with self._lock:
+                for slot, idx, done in reversed(batch):
+                    slot.pending.insert(0, (idx, done))
+                    if slot.slot_id not in self._dirty_set:
+                        self._dirty_set.add(slot.slot_id)
+                        self._dirty.insert(0, slot.slot_id)
+            raise
+        self.packs += 1
+        self.records_packed += int(total)
+        METRICS.observe(SERVE_PACK_RECORDS, float(total))
+        METRICS.observe(SERVE_PACK_STREAMS,
+                        float(len({s.slot_id for s, _, _ in batch})))
+        out: List[Tuple[RingSlot, int, object, object]] = []
+        base = 0
+        for slot, idx, done in batch:
+            n = len(idx)
+            out.append((slot, n, done, verdicts[base:base + n]))
+            slot.records_out += n
+            base += n
+        out.extend((s, n, d, None) for s, n, d in stale)
+        return out
+
+    def memo_stats(self) -> Dict[str, int]:
+        m = self.session.memo
+        if m is None:
+            return {}
+        return {"hits": m.hits, "misses": m.misses,
+                "invalidations": m.invalidations}

@@ -59,6 +59,9 @@ MAX_ROWS = 1 << 18
 MAX_STRINGS = 1 << 16
 
 _FIELDS = ("path", "method", "host", "headers", "qname")
+#: row-column index of the L7 type (the family key of the
+#: bank-reference invalidation narrowing)
+_L7_COL = _ROW_COLS.index("l7_types")
 _PREFIX = {"path": "path", "method": "method", "host": "host",
            "headers": "hdr", "qname": "dns"}
 
@@ -214,10 +217,10 @@ class IncrementalSession:
         self.row_capacity = 0
         self.rows_dev: Optional[jax.Array] = None
         self._pending_rows: list = []
-        #: host mirror of each session row's enforcement identity
-        #: (bounded by max_rows like the row table itself): the
-        #: bank-scoped invalidation mask is computed from it without
-        #: a device readback
+        #: host mirror of each session row's (enforcement identity,
+        #: l7 type) — bounded by max_rows like the row table itself:
+        #: the family-granular (bank-reference) invalidation mask is
+        #: computed from it without a device readback
         self._row_eps: list = []
         #: session row ids a bank-scoped commit touched, awaiting a
         #: scatter refill in _memo_serve
@@ -280,12 +283,19 @@ class IncrementalSession:
             t._nw = None
         if self.memo is not None and self.memo.filled:
             if delta.changed_identities:
-                eps = np.asarray(self._row_eps[:self.memo.filled],
-                                 dtype=np.int64)
-                affected = np.nonzero(np.isin(
-                    eps, np.fromiter(delta.changed_identities,
-                                     dtype=np.int64)))[0].astype(
-                                         np.int32)
+                from cilium_tpu.engine.memo import affected_row_ids
+
+                # family-granular (bank-reference) narrowing: only
+                # rows whose own L7 family read a swapped bank refill
+                # — an HTTP-path bank swap keeps the same identity's
+                # DNS/kafka rows serving (PolicyDelta.affects)
+                pairs = self._row_eps[:self.memo.filled]
+                affected = affected_row_ids(
+                    delta,
+                    np.fromiter((p[0] for p in pairs),
+                                dtype=np.int64, count=len(pairs)),
+                    np.fromiter((p[1] for p in pairs),
+                                dtype=np.int64, count=len(pairs)))
                 if len(affected):
                     self.memo.partial_invalidate(len(affected),
                                                  delta.reason)
@@ -405,7 +415,8 @@ class IncrementalSession:
                 rid = self.n_rows
                 self.n_rows += 1
                 self._pending_rows.append(row.copy())
-                self._row_eps.append(int(row[0]))
+                self._row_eps.append((int(row[0]),
+                                      int(row[_L7_COL])))
                 if chain is None:
                     self.row_ids[key] = [(row.tobytes(), rid)]
                 else:
@@ -430,7 +441,8 @@ class IncrementalSession:
                 rid = self.n_rows
                 self.n_rows += 1
                 self._pending_rows.append(row.copy())
-                self._row_eps.append(int(row[0]))
+                self._row_eps.append((int(row[0]),
+                                      int(row[_L7_COL])))
                 chain.append((row.tobytes(), rid))
             lut[j] = rid
         return lut[inv].astype(np.int32)
@@ -458,10 +470,64 @@ class IncrementalSession:
         self._pending_rows = []
 
     # -- the chunk entry point --------------------------------------------
+    def encode_ids(self, rec, l7, offsets, blob, gen=None):
+        """HOST half of a chunk: swap-safety check, capacity guard,
+        featurize + intern → ``(idx, novel)`` where ``idx`` is the
+        chunk's session row ids (int32, unpadded) and ``novel`` the
+        number of rows this chunk interned for the first time. No
+        device work happens here — the verdict ring packs many
+        streams' encoded ids into ONE :meth:`serve_ids` dispatch.
+        Rows already interned (``n - novel``) never ship their
+        featurized bytes again: only the 4-byte id crosses, the
+        memo-bypass selective-copy property the ring counts."""
+        n = len(rec)
+        if n == 0:
+            return np.zeros(0, dtype=np.int32), 0
+        self._ensure_current()
+        if (self.n_rows >= self.max_rows
+                or any(t.n >= self.max_strings
+                       for t in self.tables.values())):
+            self.reset()
+        rows = self._encode_rows(rec, l7, offsets, blob, gen)
+        before = self.n_rows
+        idx = self._row_idx(rows)
+        return idx, self.n_rows - before
+
+    def serve_ids(self, idx: np.ndarray, authed_pairs=None):
+        """DEVICE half: flush pending string/row deltas and serve one
+        id vector — ONE fused dispatch (delta verdict step + memo
+        fill) plus one on-device gather, however many streams'
+        chunks were packed into ``idx``. Returns the device verdict
+        array aligned to ``idx`` (padding sliced by the caller)."""
+        for t in self.tables.values():
+            t.flush()
+        self._flush_rows()
+        n = len(idx)
+        B_pad = _pow2(n, floor=32)
+        if B_pad > n:
+            # pad ids point at row 0 — a REAL session row, but padded
+            # verdicts are sliced off before anything reads them
+            idx = np.concatenate(
+                [idx, np.zeros(B_pad - n, dtype=np.int32)])
+        from cilium_tpu.engine.verdict import DISPATCH_POINT, _faults
+
+        _faults.maybe_fail(DISPATCH_POINT)
+        table_words = {f: self.tables[f].words for f in _FIELDS}
+        if self.memo is not None:
+            return self._memo_serve(idx, table_words, authed_pairs)
+        batch = {"rows": self.rows_dev,
+                 "idx": jax.device_put(idx, self.engine.device)}
+        self.engine._stage_auth(batch, authed_pairs)
+        out = self._step(self.engine._arrays, table_words, batch)
+        return out["verdict"]
+
     def verdict_chunk(self, rec, l7, offsets, blob, gen=None,
                       authed_pairs=None):
         """Featurize + intern one chunk, push deltas, dispatch the
-        gather+verdict step. Returns (n, device verdict array)."""
+        gather+verdict step. Returns (n, device verdict array).
+        Composition of :meth:`encode_ids` + :meth:`serve_ids` — the
+        single-stream shape of what the verdict ring does for many
+        streams per dispatch."""
         from cilium_tpu.runtime.tracing import (
             PHASE_DEVICE,
             PHASE_HOST,
@@ -471,41 +537,14 @@ class IncrementalSession:
         n = len(rec)
         if n == 0:
             return 0, None
-        self._ensure_current()
-        if (self.n_rows >= self.max_rows
-                or any(t.n >= self.max_strings
-                       for t in self.tables.values())):
-            self.reset()
         with TRACER.span("session.featurize", phase=PHASE_HOST,
                          records=n):
-            rows = self._encode_rows(rec, l7, offsets, blob, gen)
-            idx = self._row_idx(rows)
+            idx, _ = self.encode_ids(rec, l7, offsets, blob, gen)
         with TRACER.span("session.dispatch", phase=PHASE_DEVICE,
                          records=n):
             # delta flushes are device transfers — device-dispatch,
             # like the step they feed
-            for t in self.tables.values():
-                t.flush()
-            self._flush_rows()
-            B_pad = _pow2(n, floor=32)
-            if B_pad > n:
-                # pad ids point at row 0 — a REAL session row, but
-                # padded verdicts are sliced off before anything
-                # reads them
-                idx = np.concatenate(
-                    [idx, np.zeros(B_pad - n, dtype=np.int32)])
-            from cilium_tpu.engine.verdict import DISPATCH_POINT, _faults
-
-            _faults.maybe_fail(DISPATCH_POINT)
-            table_words = {f: self.tables[f].words for f in _FIELDS}
-            if self.memo is not None:
-                return n, self._memo_serve(idx, table_words,
-                                           authed_pairs)
-            batch = {"rows": self.rows_dev,
-                     "idx": jax.device_put(idx, self.engine.device)}
-            self.engine._stage_auth(batch, authed_pairs)
-            out = self._step(self.engine._arrays, table_words, batch)
-            return n, out["verdict"]
+            return n, self.serve_ids(idx, authed_pairs=authed_pairs)
 
     def _memo_serve(self, idx: np.ndarray, table_words,
                     authed_pairs) -> jax.Array:

@@ -525,7 +525,9 @@ class CompiledPolicy:
 
         resolve_meta = None
         plan = _mk.build_resolve_plan(arrays, len(http_rules),
-                                      len(dns_rules))
+                                      len(dns_rules),
+                                      n_kafka=len(kafka_rules),
+                                      n_gen=len(gen_rules))
         if plan is not None:
             rp_arrays, resolve_meta = plan
             arrays.update(rp_arrays)
@@ -1900,19 +1902,26 @@ class CaptureReplay:
 
     def _affected_unique_ids(self, delta) -> Optional[np.ndarray]:
         """Unique-row ids whose verdict may have moved under a
-        bank-scoped delta: rows whose enforcement identity's MapState
-        fingerprint changed (identity granularity subsumes rule/bank
-        granularity for memo outputs — every rule change alters its
-        identities' fingerprints). None = can't tell (no staged host
-        rows) → caller must drop."""
+        bank-scoped delta. Identity granularity subsumes rule/bank
+        granularity for memo outputs (every rule change alters its
+        identities' fingerprints); with family fingerprints on the
+        delta it narrows further to bank-REFERENCE granularity — a row
+        re-verdicts only when its own L7 family read a swapped bank
+        (``PolicyDelta.affects``), so an HTTP-path bank swap keeps the
+        identity's DNS/kafka rows serving. None = can't tell (no
+        staged host rows) → caller must drop."""
+        from cilium_tpu.engine.memo import affected_row_ids
+
         if self._uniq_host is None or self.rows_all is None:
             return None
         if not delta.changed_identities:
             return np.zeros(0, dtype=np.int32)
-        eps = self._uniq_host[:self.n_unique, _ROW_COLS.index("ep_ids")]
-        mask = np.isin(eps, np.fromiter(delta.changed_identities,
-                                        dtype=np.int64))
-        return np.nonzero(mask)[0].astype(np.int32)
+        return affected_row_ids(
+            delta,
+            self._uniq_host[:self.n_unique,
+                            _ROW_COLS.index("ep_ids")],
+            self._uniq_host[:self.n_unique,
+                            _ROW_COLS.index("l7_types")])
 
     def stage_rows(self, rec, l7) -> np.ndarray:
         """Featurize the WHOLE capture once, as part of session

@@ -69,6 +69,9 @@ SHED_QUEUE_FULL = "queue-full"
 SHED_DEADLINE = "deadline"
 SHED_DRAINING = "draining"
 SHED_FAULT = "fault"
+#: the verdict ring has no free slot for a new stream lease
+#: (runtime/serveloop.py) — explicit, counted, retryable
+SHED_RING_FULL = "ring-full"
 
 #: fires at every admission decision; an injected fault forces a shed
 #: (reason "fault") — the chaos suite's handle on the gate

@@ -64,6 +64,8 @@ FAULT_POINTS = (
     "loader.swap",
     "loader.bank_compile",
     "kvstore.churn_storm",
+    "serve.lease",
+    "serve.ring_slot",
 )
 
 #: breaker/quarantine timings the schedules steer around; small so
@@ -197,6 +199,13 @@ class DSTWorld:
         self.cluster_alloc = ClusterIdentityAllocator(self.store).start()
         self.storm_pool = [LabelSet.from_dict({"storm": f"s{i}"})
                            for i in range(8)]
+        #: lazily-built continuously-batched serving loop
+        #: (runtime/serveloop.py) — a SMALL ring (capacity 4, short
+        #: lease TTL) so ring-full sheds and TTL expiries are
+        #: reachable inside a 12-event schedule; dropped on
+        #: drain-restore (a restarted process builds a fresh one)
+        self._serve = None
+        self._serve_streams = 0
 
     def bank_compiles(self) -> int:
         reg = self.loader.bank_registry
@@ -459,6 +468,96 @@ class DSTWorld:
                     "invalidations": m.invalidations}
         return {"verdicts": _digest(got), "memo": memo}
 
+    def serve(self, n_streams: int, index: int) -> Dict:
+        """One round through the continuously-batched serving loop:
+        ``n_streams`` virtual streams connect (reconnect-with-resume
+        — a live lease renews, never re-grants), each submits the
+        probe corpus as a chunk, ONE inline pack cycle serves them.
+        Invariants: every chunk resolves or sheds explicitly (nothing
+        vanishes), ring verdicts are bit-equal to the serving engine
+        when not degraded and never ERROR, and lease accounting is
+        exact. Armed ``serve.lease``/``serve.ring_slot`` faults are
+        explicit sheds, recorded in the trace."""
+        from cilium_tpu.core.flow import Verdict
+        from cilium_tpu.ingest.columnar import flows_to_columns
+        from cilium_tpu.runtime.serveloop import (
+            LeaseExpired,
+            ServeLoop,
+            ShedError,
+        )
+
+        if self._serve is None:
+            self._serve = ServeLoop(self.loader, capacity=4,
+                                    lease_ttl_s=10.0,
+                                    pack_interval_s=0.01)
+        loop = self._serve
+        flows = self.corpus()
+        cols = flows_to_columns(flows)
+        sections = (cols.rec, cols.l7, cols.offsets, cols.blob,
+                    cols.gen)
+        tickets = []
+        sheds = 0
+        grants_before = loop.grants
+        for k in range(n_streams):
+            sid = f"dst-s{k}"
+            try:
+                lease = loop.connect(sid, resume=True)
+            except ShedError:
+                sheds += 1
+                continue
+            try:
+                tickets.append(loop.submit(lease, *sections))
+            except (ShedError, LeaseExpired):
+                sheds += 1
+        try:
+            loop.step()
+        except Exception as e:  # noqa: BLE001 — an injected dispatch
+            # fault failing the pack is a legitimate outcome; the
+            # restarted loop must converge next round
+            self._serve = None
+            return {"faulted": type(e).__name__, "sheds": sheds}
+        degraded = bool(self.loader.bank_status().get("degraded"))
+        want = None
+        got_digest = ""
+        for t in tickets:
+            if not t.done:
+                raise InvariantViolation(
+                    index, "serve-liveness",
+                    "a submitted chunk neither resolved nor shed "
+                    "after the pack cycle")
+            if t.error is not None:
+                sheds += 1  # session-reset/lease loss: explicit
+                continue
+            got = [int(v) for v in t.verdicts]
+            if int(Verdict.ERROR) in got:
+                raise InvariantViolation(index, "serve-no-error",
+                                         "ring served ERROR")
+            if want is None:
+                try:
+                    want = [int(v) for v in
+                            self.loader.engine.verdict_flows(
+                                flows)["verdict"]]
+                except Exception:  # noqa: BLE001 — injected dispatch
+                    want = got  # comparison round faulted: skip
+            if not degraded and got != want:
+                raise InvariantViolation(
+                    index, "serve-stale",
+                    "ring verdicts diverged from the serving engine")
+            got_digest = _digest(got)
+        st = loop.status()
+        if st["grants"] - st["expiries"] - st["releases"] \
+                != st["occupancy"]:
+            raise InvariantViolation(
+                index, "serve-lease-accounting",
+                f"grants {st['grants']} - expiries {st['expiries']} "
+                f"- releases {st['releases']} != occupancy "
+                f"{st['occupancy']}")
+        return {"streams": n_streams, "sheds": sheds,
+                "grants_new": loop.grants - grants_before,
+                "occupancy": st["occupancy"],
+                "bytes_saved": st["bytes_saved"],
+                "verdicts": got_digest}
+
     def storm(self, n: int, index: int) -> Dict:
         """A burst of identity add/delete through the kvstore watch
         (the churn_storm point may lose deliveries); local allocation
@@ -525,6 +624,9 @@ class DSTWorld:
                 # cold-start cost, not churn cost: reset the O(Δ)
                 # accounting window to this incarnation
                 self._session = None
+                # ...and a fresh serving loop: ring/lease state is
+                # process-resident, not snapshot state
+                self._serve = None
                 self.compiles0 = self.bank_compiles()
                 self.attempts = 0
         return {"warm_snapshot": warm, "restored": restored,
@@ -547,10 +649,19 @@ class DSTWorld:
                 f"round past the probe interval")
         if out["degraded"]:
             clock.advance(QUARANTINE_TTL_S + 0.1)
+            reg = self.loader.bank_registry
+            quarantined = reg.status()["quarantined"] if reg else 0
             self.revision += 1
             self.attempts += 1
             self.loader.regenerate(self._resolve(),
                                    revision=self.revision)
+            # the recovery regenerate recompiles each previously-
+            # quarantined bank once — O(injected faults), the cost of
+            # RECOVERY, not wholesale churn work: baseline it out of
+            # the O(Δ) window like cold-start rebuilds (a schedule
+            # arming 5 bank-compile faults must not read as 5
+            # compiles/attempt)
+            self.compiles0 += quarantined
             self.committed = {j: list(v)
                               for j, v in self.rules_of.items()}
             if self.loader.bank_status().get("degraded"):
@@ -568,8 +679,19 @@ class DSTWorld:
                 f"regenerate attempts "
                 f"(> {COMPILES_PER_CHANGE_BOUND}/attempt: "
                 f"wholesale recompiles)")
+        # restart survivability: with faults exhausted, a clean
+        # drain → warm-restore cycle must stage the SERVING policy —
+        # a poisoned artifact pointer left behind by an earlier
+        # faulted sequence (the PR-7 rollback-artifact-key shape)
+        # surfaces HERE as oracle disagreement on the restarted
+        # process's first round, however the faults masked it while
+        # they were armed (a crashed restore hides the bad pointer;
+        # the exhausted retry does not)
+        restart = self.drain_restore(index)
+        out = self.traffic(index)
         return {"final": out, "bank_compiles": compiles,
-                "changes": self.changes, "attempts": self.attempts}
+                "changes": self.changes, "attempts": self.attempts,
+                "restart": restart}
 
     def close(self) -> None:
         self.cluster_alloc.close()
@@ -598,11 +720,13 @@ def generate(seed: int, max_events: int = 12) -> List[List]:
             events.append(["churn",
                            rng.choice(["add", "add", "delete"]),
                            rng.randrange(DSTWorld.N_IDS)])
-        elif roll < 0.62:
+        elif roll < 0.56:
             events.append(["traffic"])
-        elif roll < 0.74:
+        elif roll < 0.68:
+            events.append(["serve", rng.randint(2, 6)])
+        elif roll < 0.78:
             events.append(["advance", rng.choice(ADVANCES)])
-        elif roll < 0.86:
+        elif roll < 0.88:
             events.append(["storm", rng.randint(4, 24)])
         else:
             events.append(["drain-restore"])
@@ -651,6 +775,8 @@ def run_schedule(seed: int, events: Optional[List[List]] = None,
                                               DSTWorld.N_IDS, step=i)
                         elif kind == "traffic":
                             out = world.traffic(i)
+                        elif kind == "serve":
+                            out = world.serve(int(ev[1]), i)
                         elif kind == "advance":
                             clock.advance(float(ev[1]))
                             out = {"now": round(clock.now(), 6)}

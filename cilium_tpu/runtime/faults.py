@@ -55,6 +55,12 @@ Known injection points (registered by the modules owning the seam):
                            fired fault LOSES the grant)
 ``service.admit``          admission decision in ``runtime/admission.py``
                            (a fired fault forces an explicit shed)
+``serve.lease``            slot-lease decision in
+                           ``runtime/serveloop.ServeLoop.connect`` (a
+                           fired fault is an explicit shed)
+``serve.ring_slot``        chunk submit into a ring slot in
+                           ``ServeLoop.submit`` (a fired fault fails
+                           only that chunk)
 ``service.drain``          between stop-admitting and the pending
                            flush in ``VerdictService.drain``
 ``kvstore.watch``          per-watch event delivery in ``kvstore.py``

@@ -74,6 +74,53 @@ def identity_fingerprints(per_identity: Dict[int, "MapState"]
             for ep, ms in per_identity.items()}
 
 
+#: rule-family accessors of one L7Rules object — the split behind the
+#: family-granular (bank-reference) invalidation delta
+_L7_FAMILIES = (("http", "http"), ("kafka", "kafka"), ("dns", "dns"),
+                ("generic", "l7"))
+
+
+def _identity_family_tuples(ms) -> Dict[str, tuple]:
+    """One identity's MapState, split into the independently-
+    fingerprintable pieces a verdict reads: ``struct`` (keys, deny/
+    auth/wildcard bits, enforcement flags, which entries carry L7
+    rules at all — what EVERY row of the identity reads through the
+    mapstate gather) plus one tuple per rule family (what only rows of
+    that L7 type read, since every ``l7_ok`` contribution is gated on
+    ``l7t == family``). A path-bank swap moves only the ``http``
+    tuple, so DNS/kafka memo rows of the same identity keep serving."""
+    struct = []
+    fam: Dict[str, list] = {name: [] for name, _ in _L7_FAMILIES}
+    for k, e in sorted(ms.entries.items(),
+                       key=lambda kv: repr(kv[0])):
+        key = (k.identity, k.dport, k.proto, k.direction, k.port_plen)
+        struct.append((key, e.is_deny, e.l7_wildcard, e.auth_required,
+                       bool(e.l7_rules)))
+        for name, attr in _L7_FAMILIES:
+            rules = tuple(sorted(
+                repr(r) for lr in e.l7_rules
+                for r in getattr(lr, attr)))
+            if rules:
+                fam[name].append((key, rules))
+    out = {"struct": (tuple(struct), ms.ingress_enforced,
+                      ms.egress_enforced, getattr(ms, "audit", False))}
+    out.update({name: tuple(v) for name, v in fam.items()})
+    return out
+
+
+def identity_family_fingerprints(per_identity: Dict[int, "MapState"]
+                                 ) -> Dict[int, Dict[str, str]]:
+    """Per-identity per-family fingerprints: ``{identity: {"struct":
+    fp, "http": fp, "kafka": fp, "dns": fp, "generic": fp}}`` — the
+    inputs of the family-granular :class:`PolicyDelta` narrowing
+    (engine/memo.py). A commit whose only difference is one family's
+    rules produces a delta that refills ONLY that family's memo rows,
+    counted honestly as misses."""
+    return {ep: {name: ruleset_fingerprint(t)
+                 for name, t in _identity_family_tuples(ms).items()}
+            for ep, ms in per_identity.items()}
+
+
 def _referenced_secret_values(per_identity, secrets) -> tuple:
     """(namespace, name, value) for every secret referenced by a
     header match in the snapshot — the slice of the secret store that
@@ -146,6 +193,11 @@ class Loader:
         #: (None/empty until the first TPU commit): the inputs of the
         #: bank-scoped PolicyDelta a commit hands to memo owners
         self._identity_fps: Optional[Dict[int, str]] = None
+        #: per-identity per-family fingerprints of the serving policy
+        #: (identity_family_fingerprints) — the family-granular half
+        #: of the delta; None whenever _identity_fps is
+        self._identity_family_fps: Optional[
+            Dict[int, Dict[str, str]]] = None
         self._globals_fp: Optional[str] = None
         self._bank_plan: Dict[str, tuple] = {}
         #: True while the serving policy contains quarantined banks —
@@ -245,7 +297,8 @@ class Loader:
         with self._lock:
             prev = (self._engine, self._revision, self.per_identity,
                     self._last_artifact_key, self._identity_fps,
-                    self._globals_fp, self._bank_plan, self._degraded)
+                    self._globals_fp, self._bank_plan, self._degraded,
+                    self._identity_family_fps)
         # regeneration is its own ingress: a root trace per attempt, so
         # compile/stage cost and rollbacks are attributable like any
         # request (and the staged-revision log line carries the id)
@@ -273,6 +326,7 @@ class Loader:
                     self._globals_fp = prev[5]
                     self._bank_plan = prev[6]
                     self._degraded = prev[7]
+                    self._identity_family_fps = prev[8]
                     self._fallback = None
                     self._fallback_revision = -1
                 # a rollback is a serving-state change too: memos
@@ -320,6 +374,7 @@ class Loader:
                 audit=self.config.policy_audit_mode)
             self._last_artifact_key = None
             self._identity_fps = None
+            self._identity_family_fps = None
             self._globals_fp = None
             self._bank_plan = {}
             self._degraded = False
@@ -328,14 +383,16 @@ class Loader:
         from cilium_tpu.engine.memo import PolicyDelta
         from cilium_tpu.engine.verdict import CompiledPolicy, VerdictEngine
 
-        # "policy-v8": v2 gained the ms_auth array; v3 port-range prefix
+        # "policy-v9": v2 gained the ms_auth array; v3 port-range prefix
         # keys (ms_plens + the w2 repack); v4 the audit_mode scalar; v5
         # the per-endpoint audit bit (enf_flags grew a column); v6 the
         # distillery template dedup (ms_tmpl_ids; key_w0 holds template
         # ids); v7 the content-addressed bank partition (lane layout
         # differs from the positional grouping); v8 the megakernel
         # resolve plan (rp_* group arrays + resolve_meta on the
-        # artifact) — each bump invalidates older cached artifacts.
+        # artifact); v9 kafka/generic predicate groups joined the plan
+        # (rp_k_*/rp_gen_*) — each bump invalidates older cached
+        # artifacts.
         # The key is now derived from the per-identity fingerprints +
         # a globals fingerprint, so the SAME inputs also seed the
         # bank-scoped invalidation delta.
@@ -350,7 +407,7 @@ class Loader:
             _referenced_secret_values(per_identity, self.secrets),
         )
         key = ruleset_fingerprint(
-            "policy-v8", globals_fp, tuple(sorted(fps.items())))
+            "policy-v9", globals_fp, tuple(sorted(fps.items())))
         with self._lock:
             serving_engine = self._engine
         if (key == self._last_artifact_key and not self._degraded
@@ -360,6 +417,8 @@ class Loader:
             # advance the revision, and tell memo owners NOTHING
             # changed — the add-then-delete case of the churn plane
             self._identity_fps = fps
+            self._identity_family_fps = \
+                identity_family_fingerprints(per_identity)
             return self._commit(serving_engine, revision, per_identity,
                                 "tpu", delta=PolicyDelta.none())
         policy = self._cache.get(key)
@@ -393,10 +452,12 @@ class Loader:
                                        cfg=self.config.engine)
         self._record_kernel_plan(policy, engine)
         new_plan = dict(getattr(policy, "bank_plan", {}) or {})
+        fam_fps = identity_family_fingerprints(per_identity)
         delta = self._delta_for(fps, globals_fp, new_plan,
-                                bool(quarantined))
+                                bool(quarantined), fam_fps)
         self._last_artifact_key = key if not quarantined else None
         self._identity_fps = fps
+        self._identity_family_fps = fam_fps
         self._globals_fp = globals_fp
         self._bank_plan = new_plan
         self._degraded = bool(quarantined)
@@ -404,12 +465,17 @@ class Loader:
                             delta=delta)
 
     def _delta_for(self, fps: Dict[int, str], globals_fp: str,
-                   new_plan: Dict[str, tuple], degraded: bool):
+                   new_plan: Dict[str, tuple], degraded: bool,
+                   fam_fps: Optional[Dict[int, Dict[str, str]]] = None):
         """Bank-scoped PolicyDelta of this commit vs the serving
         state; conservative FULL whenever the serving state can't
         vouch for unchanged rows (first commit, globals change,
-        quarantine involved on either side)."""
-        from cilium_tpu.engine.memo import PolicyDelta
+        quarantine involved on either side). With family fingerprints
+        on both sides the delta narrows to bank-REFERENCE granularity:
+        per changed identity, the (identity, family) pairs whose rule
+        family actually moved — FAMILY_ALL when the structural
+        MapState did."""
+        from cilium_tpu.engine.memo import FAMILY_ALL, PolicyDelta
 
         changed_banks = set()
         for field in set(self._bank_plan) | set(new_plan):
@@ -426,7 +492,29 @@ class Loader:
             return PolicyDelta(full=True)
         changed_ids = {ep for ep in set(prev_fps) | set(fps)
                        if prev_fps.get(ep) != fps.get(ep)}
-        return PolicyDelta.banks(changed_ids, changed_banks)
+        families: set = set()
+        prev_fams = self._identity_family_fps
+        if prev_fams is not None and fam_fps is not None:
+            for ep in changed_ids:
+                old_f = prev_fams.get(ep)
+                new_f = fam_fps.get(ep)
+                if old_f is None or new_f is None or \
+                        old_f.get("struct") != new_f.get("struct"):
+                    # appeared/vanished/structural: everything moved
+                    families.add((ep, FAMILY_ALL))
+                    continue
+                moved = [name for name in new_f
+                         if name != "struct"
+                         and old_f.get(name) != new_f.get(name)]
+                if moved:
+                    families.update((ep, name) for name in moved)
+                else:
+                    # whole-identity fp moved but neither struct nor
+                    # any family tuple did (fingerprint formulation
+                    # drift): never narrow past what we can prove
+                    families.add((ep, FAMILY_ALL))
+        return PolicyDelta.banks(changed_ids, changed_banks,
+                                 identity_families=families)
 
     def _record_kernel_plan(self, policy, engine) -> None:
         """Push the staged engine's per-bank kernel picks into the
@@ -529,6 +617,8 @@ class Loader:
                 # old unconditional drop cost the whole memo hit
                 # ratio on every restart)
                 self._identity_fps = identity_fingerprints(per_identity)
+                self._identity_family_fps = \
+                    identity_family_fingerprints(per_identity)
                 self._commit(serving_engine, revision, per_identity,
                              "warm", delta=PolicyDelta.none())
                 METRICS.inc(WARM_RESTORES)
@@ -548,13 +638,15 @@ class Loader:
                 # state): hand memo owners the identity-scoped delta
                 # when the serving fingerprints can vouch for it
                 fps = identity_fingerprints(per_identity)
+                fam_fps = identity_family_fingerprints(per_identity)
                 new_plan = dict(getattr(policy, "bank_plan", {}) or {})
                 delta = self._delta_for(fps, self._globals_fp or "",
-                                        new_plan, False) \
+                                        new_plan, False, fam_fps) \
                     if self._globals_fp is not None \
                     else PolicyDelta(full=True)
                 self._last_artifact_key = key
                 self._identity_fps = fps
+                self._identity_family_fps = fam_fps
                 self._bank_plan = new_plan
                 self._degraded = False
                 self._commit(engine, revision, per_identity, "warm",

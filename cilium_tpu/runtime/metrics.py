@@ -113,6 +113,31 @@ BANK_QUARANTINED = "cilium_tpu_bank_quarantined_total"
 #: revision (new content-addressed key), by field
 BANK_HOTSWAPS = "cilium_tpu_bank_hotswaps_total"
 
+# -- continuously-batched serving loop (runtime/serveloop.py +
+# engine/ring.py): persistent verdict ring, stream slot leases, and
+# the memo-bypass selective-copy accounting.
+#: gauge: stream slots currently leased in the verdict ring
+SERVE_RING_OCCUPANCY = "cilium_tpu_serve_ring_occupancy"
+#: slot leases granted (one per admitted stream; a reconnect-with-
+#: resume that finds its lease alive does NOT grant again)
+SERVE_LEASE_GRANTS = "cilium_tpu_serve_lease_grants_total"
+#: leases expired by TTL (no activity renewed them in time)
+SERVE_LEASE_EXPIRIES = "cilium_tpu_serve_lease_expiries_total"
+#: leases released cleanly (stream end / drain)
+SERVE_LEASE_RELEASES = "cilium_tpu_serve_lease_releases_total"
+#: H2D bytes that never crossed because the row was already ring-
+#: resident (memo/dedup hit): featurized row bytes avoided minus the
+#: 4-byte id actually shipped — the Libra selective-copy claim, as a
+#: counter
+SERVE_MEMO_BYPASS_BYTES = "cilium_tpu_serve_memo_bypass_bytes_total"
+#: records per pack-cycle fused dispatch
+SERVE_PACK_RECORDS = "cilium_tpu_serve_pack_records"
+#: distinct streams contributing to one pack-cycle dispatch
+SERVE_PACK_STREAMS = "cilium_tpu_serve_pack_streams"
+#: submit→verdict latency through the serving loop (seconds, on the
+#: installed clock — virtual under the DST load model)
+SERVE_LATENCY = "cilium_tpu_serve_latency_seconds"
+
 # -- megakernel scan autotuner (engine/megakernel.py): dense-DFA vs
 # bitset-NFA measured per bank shape at engine staging
 #: autotuner decisions, by winning impl and field (cache misses only —
@@ -600,6 +625,26 @@ METRICS.describe(KERNEL_AUTOTUNE_PICKS,
 METRICS.describe(KERNEL_AUTOTUNE_SECONDS,
                  "seconds measuring dense vs bitset-NFA for one bank "
                  "shape")
+METRICS.describe(SERVE_RING_OCCUPANCY,
+                 "stream slots currently leased in the verdict ring")
+METRICS.describe(SERVE_LEASE_GRANTS,
+                 "verdict-ring slot leases granted")
+METRICS.describe(SERVE_LEASE_EXPIRIES,
+                 "slot leases expired by TTL without renewal")
+METRICS.describe(SERVE_LEASE_RELEASES,
+                 "slot leases released cleanly (stream end / drain)")
+METRICS.describe(SERVE_MEMO_BYPASS_BYTES,
+                 "H2D bytes saved by ring-resident rows (memo/dedup "
+                 "hits ship a 4-byte id, not the featurized row)")
+METRICS.describe(SERVE_PACK_RECORDS,
+                 "records per pack-cycle fused dispatch",
+                 buckets=SIZE_BUCKETS)
+METRICS.describe(SERVE_PACK_STREAMS,
+                 "distinct streams contributing to one pack-cycle "
+                 "dispatch", buckets=SIZE_BUCKETS)
+METRICS.describe(SERVE_LATENCY,
+                 "submit-to-verdict latency through the serving loop "
+                 "(installed-clock seconds)")
 
 
 class SpanStat:

@@ -1,0 +1,491 @@
+"""Continuously-batched device-resident serving loop: stream slot
+leases over the persistent verdict ring.
+
+The serving-plane shape this module replaces is request/response: a
+host-side MicroBatcher forms a batch per request wave, answers it,
+and forgets everything. The serve loop is the opposite — a PERSISTENT
+loop over device-resident state (engine/ring.py):
+
+* **Slot leases.** A stream is admitted ONCE, through the same
+  AdmissionGate/credit discipline as every other ingress (PRs 5/10),
+  into a ring slot lease with a TTL. Chunks then ride the lease —
+  no per-chunk admission, no per-wave barrier. A lease renews on
+  activity and EXPIRES when idle past its TTL, returning the slot; a
+  reconnect-with-resume that finds its lease alive reuses it without
+  a second grant (``cilium_tpu_serve_lease_grants_total`` counts
+  streams, not dial attempts).
+* **Continuous batching.** The pack cycle (``pack_interval``) drains
+  whatever slots have pending encoded chunks into ONE fused
+  megakernel dispatch + one on-device memo gather. Latency under
+  light load ≈ pack interval + dispatch; under heavy load the pack
+  amortizes one dispatch over hundreds of streams.
+* **Explicit shed, never queue-forever.** Ring at capacity →
+  ``ring-full``; per-slot pending at bound → ``queue-full``;
+  draining → ``draining``; armed ``serve.lease`` fault → ``fault``.
+  All counted on the shared admission series, surface ``serve``.
+* **Hot-swap safe.** The ring's shared session consumes committed
+  PolicyDeltas (PR 8): a bank-scoped commit refills only the memo
+  rows whose identity+family read the swapped bank; slots and leases
+  notice nothing.
+
+Two driving modes, mirroring the simulation clock's: ``start()``
+spawns the production pack thread (``simclock.sleep`` paced, so an
+autojumping VirtualClock converts the loop to virtual time
+unrestructured); ``step()`` is the inline pack cycle the DST runner
+and the 100k-stream load model (runtime/loadmodel.py) drive
+deterministically.
+
+Fault points: ``serve.lease`` fires at every lease decision (a fired
+fault is an explicit shed); ``serve.ring_slot`` fires at every chunk
+submit (a fired fault fails THAT chunk — per-chunk degradation, the
+stream transport's contract).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from cilium_tpu.engine.ring import RingFull, RingSlot, VerdictRing
+from cilium_tpu.runtime import admission, faults, simclock
+from cilium_tpu.runtime.logging import get_logger
+from cilium_tpu.runtime.metrics import (
+    METRICS,
+    SERVE_LATENCY,
+    SERVE_LEASE_EXPIRIES,
+    SERVE_LEASE_GRANTS,
+    SERVE_LEASE_RELEASES,
+    SERVE_RING_OCCUPANCY,
+)
+
+LOG = get_logger("serveloop")
+
+#: fires at every lease decision in ServeLoop.connect — an injected
+#: fault forces an explicit shed (reason "fault"), never a half-grant
+LEASE_POINT = faults.register_point(
+    "serve.lease", "slot-lease decision in ServeLoop.connect")
+#: fires at every chunk submit into a ring slot — an injected fault
+#: fails ONLY that chunk (the per-chunk degradation contract)
+RING_SLOT_POINT = faults.register_point(
+    "serve.ring_slot", "chunk submit into a ring slot in "
+                       "ServeLoop.submit")
+
+
+class ShedError(RuntimeError):
+    """An explicit, counted shed: the stream/chunk was refused with a
+    reason, never silently queued."""
+
+    def __init__(self, reason: str):
+        super().__init__(f"shed: {reason}")
+        self.reason = reason
+
+
+class LeaseExpired(RuntimeError):
+    """The stream's slot lease lapsed (idle past TTL): the caller
+    re-connects (reconnect-with-resume grants a fresh slot)."""
+
+
+class SlotLease:
+    """One stream's ring residency grant. Renewed by activity;
+    expired by the pack cycle when idle past ``ttl_s``."""
+
+    __slots__ = ("stream_id", "slot", "ttl_s", "granted_at",
+                 "expires_at", "active")
+
+    def __init__(self, stream_id: str, slot: RingSlot, ttl_s: float,
+                 now: float):
+        self.stream_id = stream_id
+        self.slot = slot
+        self.ttl_s = float(ttl_s)
+        self.granted_at = now
+        self.expires_at = now + self.ttl_s
+        self.active = True
+
+    def renew(self, now: float) -> None:
+        self.expires_at = now + self.ttl_s
+
+    def expired(self, now: float) -> bool:
+        # the exact tick expires: expires_at <= now, the same closed
+        # boundary as admission deadlines (zero budget = lapsed)
+        return self.expires_at <= now
+
+
+class ChunkTicket:
+    """Completion token for one submitted chunk: the submitter parks
+    on a clock-integrated event; the pack cycle resolves it with host
+    verdicts or an error string."""
+
+    __slots__ = ("ev", "n", "t_submit", "t_done", "verdicts", "error")
+
+    def __init__(self, n: int):
+        self.ev = simclock.event()
+        self.n = n
+        self.t_submit = simclock.now()
+        self.t_done: Optional[float] = None
+        self.verdicts: Optional[np.ndarray] = None
+        self.error: Optional[str] = None
+
+    def resolve(self, verdicts: Optional[np.ndarray],
+                error: Optional[str] = None) -> None:
+        self.verdicts = verdicts
+        self.error = error
+        self.t_done = simclock.now()
+        self.ev.set()
+
+    @property
+    def latency(self) -> Optional[float]:
+        return (None if self.t_done is None
+                else max(0.0, self.t_done - self.t_submit))
+
+    @property
+    def done(self) -> bool:
+        return self.ev.is_set()
+
+    def wait(self, timeout: float = 30.0) -> np.ndarray:
+        if not simclock.wait_on(self.ev, timeout):
+            raise TimeoutError("no verdict from the serve loop")
+        if self.error is not None:
+            raise ShedError(self.error)
+        return self.verdicts
+
+
+class ServeLoop:
+    """The serving loop. One instance per service; owns the ring and
+    every lease. Thread-safe: connects/submits land from connection
+    threads while the single pack thread (or the DST runner's inline
+    ``step()``) cycles."""
+
+    def __init__(self, loader, capacity: int = 1024,
+                 lease_ttl_s: float = 30.0,
+                 pack_interval_s: float = 0.002,
+                 max_slot_pending: int = 64,
+                 gate: Optional[admission.AdmissionGate] = None,
+                 authed_pairs_fn=None,
+                 widths: Optional[Dict[str, int]] = None,
+                 memo: bool = True):
+        engine = loader.engine
+        if engine is None or not hasattr(engine, "_blob_step"):
+            raise RuntimeError(
+                "the serve loop needs the device engine "
+                "(enable_tpu_offload) — the oracle has no ring to "
+                "be resident in")
+        self.loader = loader
+        self.ring = VerdictRing(engine, capacity, loader=loader,
+                                widths=widths, memo=memo)
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.pack_interval_s = float(pack_interval_s)
+        #: per-slot pending-chunk bound: a producer outrunning the
+        #: pack cycle sheds (queue-full) instead of buffering forever
+        self.max_slot_pending = max(1, int(max_slot_pending))
+        self.gate = gate
+        self.authed_pairs_fn = authed_pairs_fn
+        self._lock = threading.Lock()
+        #: serializes pack cycles: step() may be driven inline (DST)
+        #: AND by the production thread, and drain() packs too — the
+        #: shared session's device tables are single-writer
+        self._pack_lock = threading.Lock()
+        self._leases: Dict[str, SlotLease] = {}
+        #: lazy expiry heap of (expires_at-at-push, stream_id): a
+        #: renewed lease's stale entries re-push at pop time, so
+        #: expiry sweeps are O(lapsed log n), never O(all leases) —
+        #: the difference between 100k idle streams costing nothing
+        #: and costing every pack cycle
+        self._expiry_heap: list = []
+        self._draining = False
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        #: lifetime counters (the load model's invariant face)
+        self.grants = 0
+        self.expiries = 0
+        self.releases = 0
+        self.sheds = 0
+        self.served_records = 0
+        self.chunk_errors = 0
+        self.pack_failures = 0
+
+    @classmethod
+    def from_config(cls, loader, cfg, gate=None,
+                    authed_pairs_fn=None) -> "ServeLoop":
+        """Build from ``Config.serve`` (tolerates absence so embedders
+        with older configs keep working)."""
+        return cls(
+            loader,
+            capacity=getattr(cfg, "slot_capacity", 1024),
+            lease_ttl_s=getattr(cfg, "lease_ttl_s", 30.0),
+            pack_interval_s=getattr(cfg, "pack_interval_ms", 2.0) / 1e3,
+            max_slot_pending=getattr(cfg, "max_slot_pending", 64),
+            gate=gate, authed_pairs_fn=authed_pairs_fn)
+
+    # -- leases -----------------------------------------------------------
+    def _shed(self, reason: str) -> None:
+        self.sheds += 1
+        admission.count_shed("serve", admission.CLASS_DATA, reason)
+
+    def connect(self, stream_id: str,
+                resume: bool = False) -> SlotLease:
+        """Admit one stream into a slot lease. ``resume=True`` is
+        reconnect-with-resume: a still-live lease for the stream is
+        RENEWED and returned — never granted (counted) twice; an
+        expired/absent one falls through to a fresh grant. Raises
+        :class:`ShedError` (reason ``fault`` / ``draining`` /
+        ``ring-full`` / gate reason) instead of queueing."""
+        try:
+            faults.maybe_fail(LEASE_POINT)
+        except Exception:  # noqa: BLE001 — plan-chosen exception
+            self._shed(admission.SHED_FAULT)
+            raise ShedError(admission.SHED_FAULT)
+        now = simclock.now()
+        with self._lock:
+            if self._draining:
+                self._shed(admission.SHED_DRAINING)
+                raise ShedError(admission.SHED_DRAINING)
+            if resume:
+                lease = self._leases.get(stream_id)
+                if lease is not None and lease.active:
+                    if not lease.expired(now):
+                        lease.renew(now)
+                        return lease
+                    # expired but not yet swept: release the slot NOW
+                    # (counted as an expiry) before re-granting — or
+                    # the overwrite below would leak the old slot
+                    # until the ring filled up
+                    self._release_locked(lease, "expired")
+            elif stream_id in self._leases:
+                # duplicate connect without resume: one stream, one
+                # lease — the old one is released first (its pending
+                # work resolves as error)
+                self._release_locked(self._leases[stream_id],
+                                     "superseded")
+        if self.gate is not None:
+            ok, reason = self.gate.admit(admission.CLASS_DATA)
+            if not ok:
+                self.sheds += 1  # counted by the gate already
+                raise ShedError(reason)
+        with self._lock:
+            if self._draining:
+                self._shed(admission.SHED_DRAINING)
+                raise ShedError(admission.SHED_DRAINING)
+            try:
+                slot = self.ring.acquire(stream_id)
+            except RingFull:
+                self._shed(admission.SHED_RING_FULL)
+                raise ShedError(admission.SHED_RING_FULL)
+            lease = SlotLease(stream_id, slot, self.lease_ttl_s, now)
+            self._leases[stream_id] = lease
+            heapq.heappush(self._expiry_heap,
+                           (lease.expires_at, stream_id))
+            self.grants += 1
+            METRICS.inc(SERVE_LEASE_GRANTS)
+            METRICS.set_gauge(SERVE_RING_OCCUPANCY,
+                              float(len(self._leases)))
+            return lease
+
+    def _release_locked(self, lease: SlotLease, how: str) -> None:
+        """Caller holds self._lock. Resolves the slot's pending
+        chunks as errors, returns the slot, counts by ``how``."""
+        if not lease.active:
+            return
+        lease.active = False
+        # release pops the slot's pending under the RING lock, so a
+        # chunk resolves through exactly one of (pack → verdicts,
+        # release → error) — never both
+        dropped = self.ring.release(lease.slot)
+        self._leases.pop(lease.stream_id, None)
+        for _idx, done in dropped:
+            if done is not None:
+                done.resolve(None, error=f"lease-{how}")
+        if how == "expired":
+            self.expiries += 1
+            METRICS.inc(SERVE_LEASE_EXPIRIES)
+        else:
+            self.releases += 1
+            METRICS.inc(SERVE_LEASE_RELEASES)
+        METRICS.set_gauge(SERVE_RING_OCCUPANCY,
+                          float(len(self._leases)))
+
+    def disconnect(self, lease: SlotLease) -> None:
+        """Clean stream end: release the slot (pending unpacked
+        chunks resolve as ``lease-closed`` errors — callers flush
+        with a final ``step()``/pack before disconnecting)."""
+        with self._lock:
+            self._release_locked(lease, "closed")
+
+    # -- data path --------------------------------------------------------
+    def submit(self, lease: SlotLease, rec, l7, offsets, blob,
+               gen=None) -> ChunkTicket:
+        """Encode one chunk into the stream's slot (host work only)
+        and return its completion ticket; the next pack cycle serves
+        it. Raises :class:`LeaseExpired` when the lease lapsed
+        (reconnect first) and :class:`ShedError` on backpressure
+        (``queue-full``) or an armed ``serve.ring_slot`` fault."""
+        try:
+            faults.maybe_fail(RING_SLOT_POINT)
+        except Exception:  # noqa: BLE001 — plan-chosen exception
+            self.chunk_errors += 1
+            self._shed(admission.SHED_FAULT)
+            raise ShedError(admission.SHED_FAULT)
+        now = simclock.now()
+        with self._lock:
+            if not lease.active or lease.expired(now):
+                if lease.active:
+                    self._release_locked(lease, "expired")
+                raise LeaseExpired(
+                    f"lease for {lease.stream_id} lapsed")
+            if len(lease.slot.pending) >= self.max_slot_pending:
+                self._shed(admission.SHED_QUEUE_FULL)
+                raise ShedError(admission.SHED_QUEUE_FULL)
+            lease.renew(now)
+        ticket = ChunkTicket(len(rec))
+        # ring.submit takes its own lock; encoding outside ours keeps
+        # lease ops responsive while a big chunk featurizes
+        self.ring.submit(lease.slot, rec, l7, offsets, blob, gen=gen,
+                         done=ticket)
+        return ticket
+
+    # -- the pack cycle ---------------------------------------------------
+    def _expire_leases(self, now: float) -> int:
+        lapsed = 0
+        with self._lock:
+            heap = self._expiry_heap
+            while heap and heap[0][0] <= now:
+                _, stream_id = heapq.heappop(heap)
+                lease = self._leases.get(stream_id)
+                if lease is None or not lease.active:
+                    continue          # released/superseded: stale entry
+                if lease.expired(now):
+                    self._release_locked(lease, "expired")
+                    lapsed += 1
+                else:
+                    # renewed since this entry was pushed: re-arm at
+                    # the lease's REAL deadline
+                    heapq.heappush(heap, (lease.expires_at, stream_id))
+        return lapsed
+
+    def step(self) -> int:
+        """One pack cycle: expire idle leases, pack + dispatch
+        pending chunks, resolve tickets. Returns records served.
+        The inline face the DST runner / load model drives; the
+        production thread calls it on the pack interval."""
+        now = simclock.now()
+        self._expire_leases(now)
+        pairs = (self.authed_pairs_fn()
+                 if self.authed_pairs_fn is not None else None)
+        served = 0
+        with self._pack_lock:
+            results = self.ring.pack(authed_pairs=pairs)
+        for _slot, n, ticket, dev in results:
+            if ticket is None:
+                continue
+            if dev is None:
+                # encoded ids predate a session reset — the payload
+                # is gone; the stream retries the chunk
+                self.chunk_errors += 1
+                ticket.resolve(None, error="session-reset")
+                continue
+            ticket.resolve(np.asarray(dev)[:n].astype(np.int32))
+            METRICS.observe(SERVE_LATENCY,
+                            max(0.0, simclock.now() - ticket.t_submit))
+            served += n
+        self.served_records += served
+        return served
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                if self._stop:
+                    return
+            # hold the (possibly autojumping) virtual clock while the
+            # pack's REAL compute runs: a dispatch/compile must not
+            # read as idle time, or simulated latencies would inflate
+            # by wall compute (see simclock.hold)
+            with simclock.hold():
+                try:
+                    self.step()
+                except Exception as e:  # noqa: BLE001 — degrade,
+                    # never die: the ring put the batch back, the
+                    # next cycle retries (transient faults recover)
+                    self.pack_failures += 1
+                    LOG.warning("pack cycle failed; retrying next "
+                                "interval", extra={"fields": {
+                                    "error": f"{type(e).__name__}: "
+                                             f"{e}"}})
+            simclock.sleep(self.pack_interval_s)
+
+    def start(self) -> "ServeLoop":
+        """Spawn the production pack thread (virtual-time ready: the
+        interval is a ``simclock.sleep``)."""
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run,
+                                            daemon=True,
+                                            name="serve-pack-loop")
+            self._thread.start()
+        return self
+
+    # -- drain ------------------------------------------------------------
+    def drain(self, max_cycles: int = 64) -> int:
+        """Stop admitting new leases, pack out every pending chunk
+        (bounded cycles — a wedged engine must not wedge the drain),
+        then release every lease. Returns records flushed. A lease
+        that expires at exactly the drain tick still gets its pending
+        chunks FLUSHED — drain packs before releasing, so expiry vs
+        drain is a who-counts race, never a lost verdict."""
+        with self._lock:
+            self._draining = True
+        flushed = 0
+        for _ in range(max_cycles):
+            # NOTE: no lease expiry here — pending work of an
+            # already-expired lease was resolved at expiry; work
+            # still pending on live leases flushes even if their TTL
+            # lapses mid-drain
+            pairs = (self.authed_pairs_fn()
+                     if self.authed_pairs_fn is not None else None)
+            with self._pack_lock:
+                results = self.ring.pack(authed_pairs=pairs)
+            if not results:
+                break
+            for _slot, n, ticket, dev in results:
+                if ticket is None:
+                    continue
+                if dev is None:
+                    self.chunk_errors += 1
+                    ticket.resolve(None, error="session-reset")
+                    continue
+                ticket.resolve(np.asarray(dev)[:n].astype(np.int32))
+                flushed += n
+        self.served_records += flushed
+        with self._lock:
+            for lease in list(self._leases.values()):
+                self._release_locked(lease, "drained")
+        return flushed
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stop = True
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- introspection ----------------------------------------------------
+    def status(self) -> Dict[str, object]:
+        with self._lock:
+            occupancy = len(self._leases)
+        return {
+            "occupancy": occupancy,
+            "capacity": self.ring.capacity,
+            "grants": self.grants,
+            "expiries": self.expiries,
+            "releases": self.releases,
+            "sheds": self.sheds,
+            "packs": self.ring.packs,
+            "records_packed": self.ring.records_packed,
+            "served_records": self.served_records,
+            "chunk_errors": self.chunk_errors,
+            "pack_failures": self.pack_failures,
+            "bytes_saved": self.ring.bytes_saved,
+            "bytes_shipped": self.ring.bytes_shipped,
+            "memo": self.ring.memo_stats(),
+            "draining": self._draining,
+        }

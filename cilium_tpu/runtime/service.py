@@ -767,6 +767,29 @@ class VerdictService:
         self._conn_lock = threading.Lock()
         self._server: Optional[socketserver.ThreadingUnixStreamServer] = None
         self._thread: Optional[threading.Thread] = None
+        #: continuously-batched serving loop (runtime/serveloop.py),
+        #: built lazily on the first stream once a device engine is
+        #: serving — gated by Config.serve.enabled; stream sessions
+        #: then dispatch through ring slot leases instead of private
+        #: per-session state (verdict-bit-equal either way)
+        self.serveloop = None
+        self._serve_config = getattr(loader.config, "serve", None)
+
+    def _ensure_serveloop(self):
+        """The serve loop, when enabled and a device engine serves
+        (None otherwise — sessions use their private dispatch)."""
+        if not getattr(self._serve_config, "enabled", False):
+            return None
+        with self._conn_lock:
+            if self.serveloop is None \
+                    and hasattr(self.loader.engine, "_blob_step"):
+                from cilium_tpu.runtime.serveloop import ServeLoop
+
+                self.serveloop = ServeLoop.from_config(
+                    self.loader, self._serve_config,
+                    authed_pairs_fn=self.bridge.authed_pairs_fn,
+                ).start()
+            return self.serveloop
 
     def _accesslog(self, flow: Flow) -> None:
         """LOG-action sink: the annotated L7 flow lands in the agent's
@@ -823,6 +846,7 @@ class VerdictService:
             pipeline_depth=int(req.get("pipeline_depth") or 8),
             verdictor=self.verdictor,
             credit_window=credit_window,
+            serveloop=self._ensure_serveloop(),
         ).run()
 
     # -- request handling -------------------------------------------------
@@ -1058,6 +1082,10 @@ class VerdictService:
         timeout = getattr(self.admission_config, "drain_timeout_s",
                           30.0)
         flushed = self.bridge.batcher.drain(timeout=timeout)
+        if self.serveloop is not None:
+            # the ring drains too: pending packed chunks flush
+            # through the engine, leases release
+            flushed += self.serveloop.drain()
         warm = False
         if self.loader.revision > 0:
             warm = self.loader.snapshot_warm()
@@ -1089,6 +1117,8 @@ class VerdictService:
             self.bridge.batcher.drain(timeout=getattr(
                 self.admission_config, "drain_timeout_s", 30.0))
         self.bridge.batcher.close()
+        if self.serveloop is not None:
+            self.serveloop.stop()
         if os.path.exists(self.socket_path):
             os.unlink(self.socket_path)
 

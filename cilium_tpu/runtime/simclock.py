@@ -50,7 +50,7 @@ from typing import Callable, List, Optional
 __all__ = [
     "Clock", "RealClock", "VirtualClock", "ClockEvent",
     "get", "install", "reset", "use",
-    "now", "wall", "perf", "sleep", "event",
+    "now", "wall", "perf", "sleep", "event", "hold",
     "wait_on", "wait_for", "wait_cond",
 ]
 
@@ -211,6 +211,7 @@ class VirtualClock(Clock):
         self._by_seq = {}
         self._seq = 0
         self._activity = 0
+        self._busy = 0
         self._poll = float(poll)
         self._autojump = autojump
         self._max_real_block = float(max_real_block)
@@ -257,6 +258,27 @@ class VirtualClock(Clock):
         with self._cv:
             self._activity += 1
             self._cv.notify_all()
+
+    @contextlib.contextmanager
+    def hold(self):
+        """Mark the calling thread BUSY for the block: autojump will
+        not advance virtual time while any thread holds. An unparked
+        thread doing real compute (an engine dispatch, a compile) is
+        invisible to the parked-waiter heuristic — without a hold the
+        jumper reads its silence as quiet and races virtual time past
+        work that is still happening, which inflates every simulated
+        latency by REAL compute time. Driven mode and RealClock are
+        unaffected (the jumper is the only reader)."""
+        with self._cv:
+            self._busy += 1
+            self._activity += 1
+        try:
+            yield
+        finally:
+            with self._cv:
+                self._busy -= 1
+                self._activity += 1
+                self._cv.notify_all()
 
     # -- advancing --------------------------------------------------------
     def advance(self, dt: float) -> float:
@@ -333,7 +355,8 @@ class VirtualClock(Clock):
                 if self._closed:
                     return
                 live = [s for _, s in self._heap if s in self._by_seq]
-                if not live or self._activity != last:
+                if not live or self._busy > 0 \
+                        or self._activity != last:
                     last = self._activity
                     continue
                 target = min(self._by_seq[s].deadline for s in live)
@@ -492,6 +515,18 @@ def sleep(seconds: float) -> float:
 
 def event() -> threading.Event:
     return _CLOCK.event()
+
+
+def hold():
+    """``with simclock.hold(): <real compute>`` — marks the calling
+    thread busy so an autojumping VirtualClock will not advance
+    virtual time past work that is still physically happening. A
+    no-op context under RealClock (and harmless under driven virtual
+    clocks — only the autojump loop reads the flag)."""
+    clock = _CLOCK
+    if isinstance(clock, VirtualClock):
+        return clock.hold()
+    return contextlib.nullcontext()
 
 
 def wait_on(ev, timeout: Optional[float] = None) -> bool:

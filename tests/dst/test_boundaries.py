@@ -3,6 +3,11 @@ explicit pins (ISSUE 10 satellite): deadline lapse at the exact tick,
 quarantine TTL expiry racing a regeneration, breaker half-open under
 concurrent probes, and a credit grant landing during reconnect. All
 under virtual time — the boundaries are EXACT, not sleep-approximate.
+
+ISSUE 11 adds the slot-lease boundaries of the continuously-batched
+serving loop (runtime/serveloop.py): lease expiry racing a drain,
+lease grant during reconnect-with-resume never double-counted, and
+ring-full admission shedding with an explicit reason.
 """
 
 import threading
@@ -11,6 +16,32 @@ import pytest
 
 from cilium_tpu.runtime import simclock
 from cilium_tpu.runtime.simclock import VirtualClock
+
+
+def _serve_world(tmp_path, capacity=2, ttl=10.0):
+    """A tiny real serving slice: compiled policy → ServeLoop, driven
+    inline (no thread) so every boundary is an exact virtual tick."""
+    from cilium_tpu.core.config import Config
+    from cilium_tpu.ingest import synth
+    from cilium_tpu.ingest.binary import (
+        capture_from_bytes,
+        capture_to_bytes,
+    )
+    from cilium_tpu.runtime.loader import Loader
+    from cilium_tpu.runtime.serveloop import ServeLoop
+
+    scenario = synth.scenario_by_name("http", 12, 64)
+    per_identity, scenario = synth.realize_scenario(scenario)
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.loader.cache_dir = str(tmp_path / "cache")
+    loader = Loader(cfg)
+    loader.regenerate(per_identity, revision=1)
+    sections = capture_from_bytes(
+        capture_to_bytes(scenario.flows[:16]))
+    loop = ServeLoop(loader, capacity=capacity, lease_ttl_s=ttl,
+                     pack_interval_s=0.01)
+    return loop, sections
 
 
 # ---------------------------------------------------------------------------
@@ -184,6 +215,119 @@ def test_credit_grant_arriving_during_reconnect_is_not_lost():
         # accounting and the wait predicate agree
         client._acquire_credit()
         assert client._credits == 2
+
+
+def test_lease_expiry_racing_a_drain_loses_no_verdict(tmp_path):
+    """A lease that expires at EXACTLY the drain tick: drain packs
+    pending chunks BEFORE releasing leases, so the chunk still gets a
+    real verdict; the slot is released exactly once (as drained, not
+    double-counted as expired), and the books stay exact."""
+    clk = VirtualClock()
+    with simclock.use(clk):
+        loop, sections = _serve_world(tmp_path, ttl=10.0)
+        lease = loop.connect("s0")
+        ticket = loop.submit(lease, *sections)
+        # advance to EXACTLY the lease expiry tick, then drain
+        # without an intervening pack cycle — the race, pinned
+        clk.advance_to(lease.expires_at)
+        flushed = loop.drain()
+        assert flushed == ticket.n
+        assert ticket.done and ticket.error is None
+        assert len(ticket.verdicts) == ticket.n
+        st = loop.status()
+        # released once, as a drain release — never ALSO expired
+        assert (st["grants"], st["expiries"], st["releases"]) \
+            == (1, 0, 1)
+        assert st["occupancy"] == 0
+
+
+def test_lease_expires_at_the_exact_tick_between_packs(tmp_path):
+    """One tick short of the TTL the lease survives a pack cycle; AT
+    the tick it expires: the slot returns, pending work resolves as
+    an explicit lease-expired error (never silently lost), and a
+    submit on the dead lease raises LeaseExpired."""
+    from cilium_tpu.runtime.serveloop import LeaseExpired
+
+    clk = VirtualClock()
+    with simclock.use(clk):
+        loop, sections = _serve_world(tmp_path, ttl=10.0)
+        lease = loop.connect("s0")
+        clk.advance_to(lease.expires_at - 1e-6)
+        loop.step()
+        assert lease.active and loop.status()["occupancy"] == 1
+        ticket = loop.submit(lease, *sections)   # renews the lease
+        assert lease.expires_at == clk.now() + 10.0
+        clk.advance_to(lease.expires_at)         # idle to the tick
+        # enqueue pending work JUST as the TTL lapses: the expiry
+        # sweep must resolve it explicitly
+        loop.step()
+        assert not lease.active
+        assert loop.status()["expiries"] == 1
+        with pytest.raises(LeaseExpired):
+            loop.submit(lease, *sections)
+        # the renewed-then-packed first chunk was served normally
+        assert ticket.done
+
+
+def test_reconnect_with_resume_never_double_counts_a_grant(tmp_path):
+    """Reconnect-with-resume against a LIVE lease renews and returns
+    the SAME lease with no second grant; against a lease expired at
+    exactly the reconnect tick it re-grants — once. The grant counter
+    counts streams, not dial attempts."""
+    clk = VirtualClock()
+    with simclock.use(clk):
+        loop, sections = _serve_world(tmp_path, ttl=10.0)
+        lease = loop.connect("s0")
+        assert loop.grants == 1
+        # storm of re-dials against the live lease: same object, no
+        # new grants, expiry deadline renewed each time
+        clk.advance(5.0)
+        for _ in range(4):
+            again = loop.connect("s0", resume=True)
+            assert again is lease
+        assert loop.grants == 1
+        assert lease.expires_at == clk.now() + 10.0
+        # ONE tick before expiry: still a resume, still no grant
+        clk.advance_to(lease.expires_at - 1e-6)
+        assert loop.connect("s0", resume=True) is lease
+        assert loop.grants == 1
+        # AT the expiry tick: the lease is dead — resume re-grants a
+        # fresh lease (counted once); books stay exact
+        clk.advance_to(lease.expires_at)
+        fresh = loop.connect("s0", resume=True)
+        assert fresh is not lease
+        assert loop.grants == 2
+        st = loop.status()
+        assert st["grants"] - st["expiries"] - st["releases"] \
+            == st["occupancy"] == 1
+
+
+def test_ring_full_sheds_with_explicit_reason(tmp_path):
+    """A stream past the ring's slot capacity sheds with reason
+    ``ring-full`` — explicit, counted on the admission series, and
+    retryable: a released slot admits the next connect."""
+    from cilium_tpu.runtime.admission import SHED_RING_FULL
+    from cilium_tpu.runtime.metrics import ADMISSION_SHED, METRICS
+    from cilium_tpu.runtime.serveloop import ShedError
+
+    clk = VirtualClock()
+    with simclock.use(clk):
+        loop, sections = _serve_world(tmp_path, capacity=2)
+        a = loop.connect("s0")
+        loop.connect("s1")
+        shed_before = METRICS.get(ADMISSION_SHED, labels={
+            "surface": "serve", "class": "data",
+            "reason": SHED_RING_FULL})
+        with pytest.raises(ShedError) as exc:
+            loop.connect("s2")
+        assert exc.value.reason == SHED_RING_FULL
+        assert METRICS.get(ADMISSION_SHED, labels={
+            "surface": "serve", "class": "data",
+            "reason": SHED_RING_FULL}) == shed_before + 1
+        # retryable: a freed slot admits the shed stream
+        loop.disconnect(a)
+        lease = loop.connect("s2")
+        assert lease.active
 
 
 def test_acquire_credit_times_out_on_virtual_clock_without_grant():

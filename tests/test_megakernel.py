@@ -443,3 +443,114 @@ if given is not None:
                         for p in payloads],
                        headers=(("X-A", "1"),), n_servers=n_sel)
         _assert_fused_equals_legacy(engine, flows, cfg)
+
+
+# --------------------------------------- kafka/generic factored groups
+def test_kafka_rides_the_factored_plan_with_predicate_dedup():
+    """ISSUE 11 satellite: kafka resolves on the factored path —
+    identical predicates across rulesets collapse to ONE group whose
+    ruleset membership is the OR of its members', the rp_k_* tables
+    stage to device, and the fused resolve stays bit-equal to the
+    legacy per-rule formula."""
+    from cilium_tpu.core.flow import KafkaInfo
+    from cilium_tpu.policy.api.l7 import PortRuleKafka
+
+    sel = EndpointSelector.from_labels
+    shared = [PortRuleKafka(role="produce", topic="orders"),
+              PortRuleKafka(role="consume", topic="orders",
+                            client_id="etl"),
+              PortRuleKafka(role="produce", topic="audit")]
+    rules = []
+    for i in range(4):   # 4 rulesets x 3 identical predicates = 12 rules
+        rules.append(Rule(
+            endpoint_selector=sel(app=f"broker{i}"),
+            ingress=(IngressRule(
+                from_endpoints=(sel(app="producer"),),
+                to_ports=(PortRule(
+                    ports=(PortProtocol(9092, Protocol.TCP),),
+                    rules=L7Rules(kafka=tuple(shared))),)),),
+            labels=(f"kf={i}",)))
+    endpoints = {f"broker{i}": {"app": f"broker{i}"} for i in range(4)}
+    endpoints["producer"] = {"app": "producer"}
+    per_identity, scenario = synth.realize_scenario(
+        synth.SynthScenario(name="kfgroups", rules=rules,
+                            endpoints=endpoints, flows=[]))
+    engine, cfg = _engine(per_identity, _cfg())
+    meta = engine.policy.resolve_meta
+    assert meta is not None
+    # 12 rules but only 3 distinct predicates -> 3 groups
+    assert meta["kafka_groups"] == 3
+    assert "rp_rs_kmask" in engine.policy.arrays
+    ids = scenario.ids
+    flows = []
+    for b in range(4):
+        for api_key, topic, client in [
+                (0, "orders", "x"), (1, "orders", "etl"),
+                (0, "audit", "x"), (1, "audit", "etl"),
+                (0, "other", "x"), (-1, "orders", "x")]:
+            flows.append(Flow(
+                src_identity=ids["producer"],
+                dst_identity=ids[f"broker{b}"], dport=9092,
+                protocol=Protocol.TCP,
+                direction=TrafficDirection.INGRESS,
+                l7=L7Type.KAFKA,
+                kafka=KafkaInfo(api_key=api_key, api_version=1,
+                                client_id=client, topic=topic)))
+    _assert_fused_equals_legacy(engine, flows, cfg)
+    out = engine.verdict_flows(flows)
+    verdicts = set(np.asarray(out["verdict"]).tolist())
+    assert len(verdicts) > 1   # allows and denies both exercised
+
+
+def test_generic_rides_the_factored_plan_with_predicate_dedup():
+    """Generic (l7proto) rules dedup to (proto, pair-set) groups —
+    pair ORDER inside a rule is predicate-irrelevant, so permuted
+    copies collapse; resolve stays bit-equal."""
+    from cilium_tpu.core.flow import GenericL7Info
+    from cilium_tpu.policy.api.l7 import PortRuleL7
+
+    sel = EndpointSelector.from_labels
+    rules = []
+    for i in range(3):
+        gen = (PortRuleL7(fields=(("cmd", "get"), ("table", "t1"))),
+               # permuted duplicate of the first predicate
+               PortRuleL7(fields=(("table", "t1"), ("cmd", "get"))),
+               PortRuleL7(fields=(("cmd", "put"),)))
+        rules.append(Rule(
+            endpoint_selector=sel(app=f"db{i}"),
+            ingress=(IngressRule(
+                from_endpoints=(sel(app="client"),),
+                to_ports=(PortRule(
+                    ports=(PortProtocol(6379, Protocol.TCP),),
+                    rules=L7Rules(l7proto="r2d2", l7=gen)),)),),
+            labels=(f"gen={i}",)))
+    endpoints = {f"db{i}": {"app": f"db{i}"} for i in range(3)}
+    endpoints["client"] = {"app": "client"}
+    per_identity, scenario = synth.realize_scenario(
+        synth.SynthScenario(name="gengroups", rules=rules,
+                            endpoints=endpoints, flows=[]))
+    engine, cfg = _engine(per_identity, _cfg())
+    meta = engine.policy.resolve_meta
+    assert meta is not None
+    # 9 rules, permuted duplicates collapse -> 2 distinct predicates
+    assert meta["gen_groups"] == 2
+    assert "rp_rs_genmask" in engine.policy.arrays
+    ids = scenario.ids
+    flows = []
+    for d in range(3):
+        for fields in ([("cmd", "get"), ("table", "t1")],
+                       [("cmd", "put")],
+                       [("cmd", "del")],
+                       [("cmd", "get")]):
+            flows.append(Flow(
+                src_identity=ids["client"],
+                dst_identity=ids[f"db{d}"], dport=6379,
+                protocol=Protocol.TCP,
+                direction=TrafficDirection.INGRESS,
+                l7=L7Type.GENERIC,
+                generic=GenericL7Info(proto="r2d2",
+                                      fields=dict(fields))))
+    _assert_fused_equals_legacy(engine, flows, cfg)
+    out = engine.verdict_flows(flows)
+    verdicts = set(np.asarray(out["verdict"]).tolist())
+    assert len(verdicts) > 1
