@@ -67,21 +67,21 @@ class RingSlot:
     cycle is a concatenate, not a featurize loop."""
 
     __slots__ = ("slot_id", "stream_id", "pending", "records_in",
-                 "records_out", "epoch")
+                 "records_out")
 
     def __init__(self, slot_id: int):
         self.slot_id = slot_id
         self.stream_id: Optional[str] = None
-        #: [(idx int32 array, completion callback or None), ...] —
-        #: bounded by the serve loop's per-slot pending bound; the
-        #: ring itself bounds the PACK, not the slot
-        self.pending: List[Tuple[np.ndarray, object]] = []
+        #: [(idx int32 array, completion token or None, session reset
+        #: epoch the ids were encoded under), ...] — bounded by the
+        #: serve loop's per-slot pending bound; the ring itself bounds
+        #: the PACK, not the slot. The epoch rides EACH chunk: a
+        #: session reset orphans the ids encoded before it, and a
+        #: later submit into the same slot must not launder the stale
+        #: chunk past pack()'s staleness check (see pack)
+        self.pending: List[Tuple[np.ndarray, object, int]] = []
         self.records_in = 0
         self.records_out = 0
-        #: session reset epoch the pending ids were encoded under —
-        #: a session reset orphans encoded ids, so stale pending work
-        #: is re-encoded (see VerdictRing.submit/pack)
-        self.epoch = 0
 
 
 class RingFull(RuntimeError):
@@ -89,13 +89,23 @@ class RingFull(RuntimeError):
     reason instead of queueing it invisibly."""
 
 
+class SlotNotResident(RuntimeError):
+    """The slot was released (lease expiry/disconnect) between the
+    caller's lease check and the ring operation — the serve loop
+    translates this to its lease-lapsed contract."""
+
+
 class VerdictRing:
     """Fixed-capacity ring of stream slots over one shared
     incremental session. Thread-safe: the serve loop's pack thread
-    and the per-connection submit paths interleave under one lock;
-    the device dispatch itself runs outside it (jax dispatch is
-    async, and two packs never run concurrently by construction —
-    only the pack loop calls :meth:`pack`)."""
+    and the per-connection submit paths interleave under the ring
+    lock; the shared session has its OWN lock (``_session_lock``)
+    held by both the submit-side encode (which may reset the session
+    or consume a policy delta) and the pack-side serve — the dispatch
+    runs outside the RING lock so slot/lease operations stay
+    responsive, but never concurrently with an encode that could
+    mutate the tables it reads. Two packs never run concurrently by
+    construction — only the pack loop calls :meth:`pack`."""
 
     def __init__(self, engine, capacity: int, loader=None,
                  widths: Optional[Dict[str, int]] = None,
@@ -104,6 +114,13 @@ class VerdictRing:
         self.session = IncrementalSession(engine, widths=widths,
                                           memo=memo, loader=loader)
         self._lock = threading.Lock()
+        #: serializes EVERY session touch: submit-side encode (which
+        #: may reset the session or consume a policy delta, mutating
+        #: tables/rows_dev/memo) against pack-side serve (which
+        #: flushes and reads the same state outside the ring lock).
+        #: Ordering: _lock may be held when taking _session_lock,
+        #: never the reverse
+        self._session_lock = threading.Lock()
         self._slots: Dict[int, RingSlot] = {}
         self._free: List[int] = list(range(self.capacity - 1, -1, -1))
         #: slot ids with pending work, in submit order (bounded by
@@ -139,41 +156,49 @@ class VerdictRing:
             self._slots[sid] = slot
             return slot
 
-    def release(self, slot: RingSlot) -> List[Tuple[np.ndarray, object]]:
+    def release(self, slot: RingSlot
+                ) -> List[Tuple[np.ndarray, object, int]]:
         """Return a slot to the free list (lease expiry, stream end,
         drain). Pending unpacked chunks are DROPPED and returned —
         popped under the ring lock, so a chunk is resolved by EITHER
         the pack cycle (verdicts) or the releaser (error), never
-        both."""
+        both. Identity-checked: releasing a slot OBJECT whose id was
+        already re-acquired by another stream must not evict the new
+        resident."""
         with self._lock:
             dropped = slot.pending
             slot.pending = []
             slot.stream_id = None
-            if slot.slot_id in self._slots:
+            if self._slots.get(slot.slot_id) is slot:
                 del self._slots[slot.slot_id]
                 self._free.append(slot.slot_id)
-            if slot.slot_id in self._dirty_set:
-                self._dirty_set.discard(slot.slot_id)
-                self._dirty = [s for s in self._dirty
-                               if s != slot.slot_id]
+                if slot.slot_id in self._dirty_set:
+                    self._dirty_set.discard(slot.slot_id)
+                    self._dirty = [s for s in self._dirty
+                                   if s != slot.slot_id]
             return dropped
 
     # -- submit -----------------------------------------------------------
     def submit(self, slot: RingSlot, rec, l7, offsets, blob, gen=None,
                done=None) -> int:
         """Encode one chunk into the slot's pending queue (host work
-        only). ``done`` is an opaque completion token the pack cycle
-        hands back with the chunk's verdicts. Returns the chunk's
-        record count. Raises if the slot is not resident."""
+        only). ``done`` is a completion token the pack cycle hands
+        back with the chunk's verdicts; if non-None it must expose
+        ``resolve(verdicts, error=...)`` so the ring can fail it
+        directly when its slot vanishes mid-dispatch (see pack's
+        failure handler). Returns the chunk's record count. Raises
+        :class:`SlotNotResident` if the slot was released."""
         n = len(rec)
         with self._lock:
             if self._slots.get(slot.slot_id) is not slot:
-                raise RuntimeError("slot is not ring-resident")
-            # encode under the lock: the session's intern tables are
-            # shared mutable state, and encode is the only writer
-            # besides pack's dispatch (which never interns)
-            idx, novel = self.session.encode_ids(rec, l7, offsets,
-                                                 blob, gen)
+                raise SlotNotResident("slot is not ring-resident")
+            # encode under the session lock: encode may reset the
+            # session or consume a policy delta, and pack's dispatch
+            # reads the same tables outside the ring lock
+            with self._session_lock:
+                idx, novel = self.session.encode_ids(rec, l7, offsets,
+                                                     blob, gen)
+                epoch = self.session.resets
             known = n - novel
             row_bytes = self.session.row_width * 4
             # selective-copy accounting: known rows ship a 4-byte id
@@ -183,9 +208,10 @@ class VerdictRing:
             if known:
                 METRICS.inc(SERVE_MEMO_BYPASS_BYTES,
                             known * max(0, row_bytes - 4))
-            slot.pending.append((idx, done))
+            # the epoch rides the chunk, not the slot: a later submit
+            # after a reset must not launder THIS chunk's stale ids
+            slot.pending.append((idx, done, epoch))
             slot.records_in += n
-            slot.epoch = self.session.resets
             if slot.slot_id not in self._dirty_set:
                 self._dirty_set.add(slot.slot_id)
                 self._dirty.append(slot.slot_id)
@@ -202,7 +228,7 @@ class VerdictRing:
         LOAD MODEL treats it as a retryable shed). Empty list when
         nothing was pending."""
         with self._lock:
-            batch: List[Tuple[RingSlot, np.ndarray, object]] = []
+            batch: List[Tuple[RingSlot, np.ndarray, object, int]] = []
             stale: List[Tuple[RingSlot, int, object]] = []
             total = 0
             epoch = self.session.resets
@@ -214,17 +240,19 @@ class VerdictRing:
                     self._dirty.pop(0)
                     self._dirty_set.discard(sid)
                     continue
-                idx, done = slot.pending[0]
-                if slot.epoch != epoch:
+                idx, done, chunk_epoch = slot.pending[0]
+                if chunk_epoch != epoch:
                     # encoded before a session reset: the ids name
-                    # rows that no longer exist
+                    # rows that no longer exist (the CHUNK's epoch —
+                    # a post-reset submit into the same slot must not
+                    # launder this one through)
                     slot.pending.pop(0)
                     stale.append((slot, len(idx), done))
                     continue
                 if total + len(idx) > max_records and batch:
                     break  # next cycle picks it up — no host barrier
                 slot.pending.pop(0)
-                batch.append((slot, idx, done))
+                batch.append((slot, idx, done, chunk_epoch))
                 total += len(idx)
                 if not slot.pending:
                     self._dirty.pop(0)
@@ -232,32 +260,53 @@ class VerdictRing:
                 taken_slots += 1
             if not batch:
                 return [(s, n, d, None) for s, n, d in stale]
-            packed = np.concatenate([idx for _, idx, _ in batch])
-        # dispatch OUTSIDE the lock: submits keep landing while the
-        # fused step runs; only the pack loop calls pack(), so two
-        # dispatches never race on the session's device tables
+            packed = np.concatenate([idx for _, idx, _, _ in batch])
+        # dispatch OUTSIDE the ring lock (slot/lease ops stay
+        # responsive) but UNDER the session lock: a submit-side
+        # encode may reset the session or consume a policy delta,
+        # and must not mutate the tables a dispatch is reading
+        orphans: List[Tuple[int, object]] = []
         try:
-            verdicts = self.session.serve_ids(packed,
-                                              authed_pairs=authed_pairs)
+            with self._session_lock:
+                if self.session.resets != epoch:
+                    # a submit-triggered reset landed between the
+                    # drain and the dispatch: the whole batch's ids
+                    # are orphaned — same staleness as the per-chunk
+                    # check, caught one window later
+                    stale.extend((slot, len(idx), done)
+                                 for slot, idx, done, _ in batch)
+                    return [(s, n, d, None) for s, n, d in stale]
+                verdicts = self.session.serve_ids(
+                    packed, authed_pairs=authed_pairs)
         except Exception:
             # dispatch failed (injected fault, sick device): put the
             # batch BACK at the slots' heads — the next cycle retries
-            # it (transient faults recover), and no ticket is lost
+            # it (transient faults recover), and no ticket is lost.
+            # A slot released while the dispatch was in flight is no
+            # longer ring-resident (acquire() builds a fresh RingSlot
+            # for its id): its chunks cannot ride a retry, so their
+            # tickets fail NOW instead of stranding the submitters
             with self._lock:
-                for slot, idx, done in reversed(batch):
-                    slot.pending.insert(0, (idx, done))
+                for slot, idx, done, ce in reversed(batch):
+                    if self._slots.get(slot.slot_id) is not slot:
+                        orphans.append((len(idx), done))
+                        continue
+                    slot.pending.insert(0, (idx, done, ce))
                     if slot.slot_id not in self._dirty_set:
                         self._dirty_set.add(slot.slot_id)
                         self._dirty.insert(0, slot.slot_id)
+            for _n, done in orphans:
+                if done is not None:
+                    done.resolve(None, error="slot-released")
             raise
         self.packs += 1
         self.records_packed += int(total)
         METRICS.observe(SERVE_PACK_RECORDS, float(total))
         METRICS.observe(SERVE_PACK_STREAMS,
-                        float(len({s.slot_id for s, _, _ in batch})))
+                        float(len({s.slot_id for s, _, _, _ in batch})))
         out: List[Tuple[RingSlot, int, object, object]] = []
         base = 0
-        for slot, idx, done in batch:
+        for slot, idx, done, _ in batch:
             n = len(idx)
             out.append((slot, n, done, verdicts[base:base + n]))
             slot.records_out += n

@@ -49,7 +49,12 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from cilium_tpu.engine.ring import RingFull, RingSlot, VerdictRing
+from cilium_tpu.engine.ring import (
+    RingFull,
+    RingSlot,
+    SlotNotResident,
+    VerdictRing,
+)
 from cilium_tpu.runtime import admission, faults, simclock
 from cilium_tpu.runtime.logging import get_logger
 from cilium_tpu.runtime.metrics import (
@@ -264,10 +269,25 @@ class ServeLoop:
             if not ok:
                 self.sheds += 1  # counted by the gate already
                 raise ShedError(reason)
+        now = simclock.now()
         with self._lock:
             if self._draining:
                 self._shed(admission.SHED_DRAINING)
                 raise ShedError(admission.SHED_DRAINING)
+            # the lock was dropped around gate.admit: a concurrent
+            # connect for the SAME stream may have granted meanwhile.
+            # Overwriting its lease would orphan the old slot (the
+            # expiry heap resolves stream_id to the NEW lease) and
+            # leak it until the ring filled — so reuse or release
+            # the racer's lease first, one stream = one live slot
+            racer = self._leases.get(stream_id)
+            if racer is not None and racer.active:
+                if resume and not racer.expired(now):
+                    racer.renew(now)
+                    return racer
+                self._release_locked(
+                    racer, "expired" if racer.expired(now)
+                    else "superseded")
             try:
                 slot = self.ring.acquire(stream_id)
             except RingFull:
@@ -293,8 +313,9 @@ class ServeLoop:
         # chunk resolves through exactly one of (pack → verdicts,
         # release → error) — never both
         dropped = self.ring.release(lease.slot)
-        self._leases.pop(lease.stream_id, None)
-        for _idx, done in dropped:
+        if self._leases.get(lease.stream_id) is lease:
+            self._leases.pop(lease.stream_id, None)
+        for _idx, done, _epoch in dropped:
             if done is not None:
                 done.resolve(None, error=f"lease-{how}")
         if how == "expired":
@@ -341,8 +362,20 @@ class ServeLoop:
         ticket = ChunkTicket(len(rec))
         # ring.submit takes its own lock; encoding outside ours keeps
         # lease ops responsive while a big chunk featurizes
-        self.ring.submit(lease.slot, rec, l7, offsets, blob, gen=gen,
-                         done=ticket)
+        try:
+            self.ring.submit(lease.slot, rec, l7, offsets, blob,
+                             gen=gen, done=ticket)
+        except SlotNotResident:
+            # the pack thread expired the lease (or a concurrent
+            # disconnect released it) between our lease check and the
+            # ring call: surface it as the lease-lapsed contract so
+            # callers hit the reconnect-with-resume path, not a
+            # connection-fatal error
+            with self._lock:
+                if lease.active:
+                    self._release_locked(lease, "closed")
+            raise LeaseExpired(
+                f"lease for {lease.stream_id} lost its ring slot")
         return ticket
 
     # -- the pack cycle ---------------------------------------------------

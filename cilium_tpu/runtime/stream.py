@@ -391,6 +391,14 @@ class StreamSession:
                 return
             seq, kind, payload, t_recv = item
             if kind == KIND_END:
+                if self._lease is not None and self.serveloop is not None:
+                    # release BEFORE queueing the END ack: every prior
+                    # chunk already resolved (ring waits are
+                    # synchronous on this thread), and a client whose
+                    # finish() saw the ack must observe the slot
+                    # returned — not race the handler's cleanup
+                    self.serveloop.disconnect(self._lease)
+                    self._lease = None
                 self._out.put((seq, KIND_END, 0, None, None))
                 self._out.put(None)
                 return

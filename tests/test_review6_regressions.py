@@ -1,0 +1,174 @@
+"""Round-6 review findings (serving-loop PR), pinned as regressions.
+
+Each test is a specific bug the round-6 review caught in the verdict
+ring / serve loop: session-reset staleness laundered past pack()'s
+check by a later submit, slot-loss races surfacing as
+connection-fatal errors instead of the lease-lapsed contract,
+duplicate connects leaking ring slots around the admission gate, and
+the dispatch-failure retry stranding tickets of released slots.
+"""
+
+import sys
+
+import pytest
+
+from cilium_tpu.runtime import simclock
+from cilium_tpu.runtime.serveloop import LeaseExpired
+from cilium_tpu.runtime.simclock import VirtualClock
+
+sys.path.insert(0, "tests")
+
+
+def test_session_reset_fails_stale_chunk_not_later_ones(tmp_path):
+    """The reset epoch rides EACH pending chunk, not the slot: a
+    chunk encoded before a session reset must fail with
+    ``session-reset`` even when its slot submits again afterwards.
+    Per-slot tracking let the later submit launder the stale ids
+    through — they then gathered clamped rows from the
+    re-initialized table: silently wrong verdicts."""
+    from test_serveloop import _direct, _sections, _world
+
+    from cilium_tpu.engine.session import MAX_ROWS
+
+    clk = VirtualClock()
+    with simclock.use(clk):
+        loop, loader, scenario = _world(tmp_path)
+        flows = scenario.flows[:64]
+        want = _direct(loader, flows)
+        a = loop.connect("a")
+        b = loop.connect("b")
+        t_stale = loop.submit(a, *_sections(flows))
+        # arm a capacity reset on the NEXT encode — stream b's, the
+        # cross-stream trigger the review exercised
+        sess = loop.ring.session
+        sess.max_rows = sess.n_rows
+        t_b = loop.submit(b, *_sections(flows))
+        assert sess.resets == 1
+        sess.max_rows = MAX_ROWS          # disarm
+        # a post-reset submit into the SAME slot as the stale chunk
+        t_fresh = loop.submit(a, *_sections(flows))
+        loop.step()
+        # the pre-reset chunk fails explicitly — never wrong verdicts
+        assert t_stale.done and t_stale.error == "session-reset"
+        # post-reset chunks (either slot) serve bit-equal
+        assert t_b.error is None
+        assert [int(v) for v in t_b.verdicts] == want
+        assert t_fresh.error is None
+        assert [int(v) for v in t_fresh.verdicts] == want
+
+
+def test_submit_after_slot_loss_raises_lease_expired(tmp_path):
+    """ring.submit finding its slot released (the pack thread expired
+    the lease between ServeLoop.submit's lease check and the ring
+    call) must surface as LeaseExpired — the reconnect-with-resume
+    path — not a bare RuntimeError that fails the whole stream
+    connection."""
+    from test_serveloop import _sections, _world
+
+    clk = VirtualClock()
+    with simclock.use(clk):
+        loop, loader, scenario = _world(tmp_path)
+        lease = loop.connect("s0")
+        # the pack thread won the race: the slot is gone while the
+        # lease object is still in the submitter's hand
+        loop.ring.release(lease.slot)
+        with pytest.raises(LeaseExpired):
+            loop.submit(lease, *_sections(scenario.flows[:8]))
+        assert loop.status()["occupancy"] == 0
+        # the documented recovery path works end to end
+        lease = loop.connect("s0", resume=True)
+        t = loop.submit(lease, *_sections(scenario.flows[:8]))
+        loop.step()
+        assert t.done and t.error is None
+
+
+def test_duplicate_connect_race_around_gate_leaks_no_slot(tmp_path):
+    """connect() drops the loop lock around gate.admit: a concurrent
+    connect for the same stream that grants in that window must not
+    be overwritten blindly — the loser's slot would become
+    unreachable (the expiry heap resolves stream_id to the NEW lease)
+    and leak until the ring filled toward spurious ring-full sheds."""
+    from test_serveloop import _world
+
+    clk = VirtualClock()
+    with simclock.use(clk):
+        loop, loader, scenario = _world(tmp_path, capacity=4)
+
+        class RacingGate:
+            """Admits everything, but the first admit fires a
+            competing connect — a deterministic stand-in for the
+            two-thread interleaving in the gate window."""
+
+            def __init__(self, stream_id, resume):
+                self.stream_id = stream_id
+                self.resume = resume
+                self.racer = None
+                self._fired = False
+
+            def admit(self, cls):
+                if not self._fired:
+                    self._fired = True
+                    self.racer = loop.connect(self.stream_id,
+                                              resume=self.resume)
+                return True, None
+
+        gate = loop.gate = RacingGate("s0", resume=False)
+        lease = loop.connect("s0")
+        # one stream, one live lease, one ring slot — the racer's
+        # grant was released (superseded), not leaked
+        assert loop.status()["occupancy"] == 1
+        assert loop.ring.occupancy == 1
+        assert not gate.racer.active
+        assert lease.active
+        loop.disconnect(lease)
+
+        # resume flavor: both dials race; the loser REUSES the
+        # winner's lease instead of granting a second slot
+        gate = loop.gate = RacingGate("s1", resume=True)
+        grants0 = loop.grants
+        l1 = loop.connect("s1", resume=True)
+        assert l1 is gate.racer            # same lease, renewed
+        assert loop.grants == grants0 + 1  # granted exactly once
+        assert loop.status()["occupancy"] == 1
+        assert loop.ring.occupancy == 1
+
+
+def test_dispatch_failure_resolves_tickets_of_released_slots(tmp_path):
+    """The pack retry path re-queues a failed batch at the slots'
+    heads — but a slot released while the dispatch was in flight is
+    no longer ring-resident (acquire() builds a fresh RingSlot for
+    its id), so re-queuing onto the orphaned object would strand its
+    submitter until the wait timeout. Those tickets fail NOW
+    (``slot-released``); resident slots still retry losslessly."""
+    from test_serveloop import _direct, _sections, _world
+
+    clk = VirtualClock()
+    with simclock.use(clk):
+        loop, loader, scenario = _world(tmp_path)
+        flows = scenario.flows[:32]
+        want = _direct(loader, flows)
+        a = loop.connect("a")
+        b = loop.connect("b")
+        ta = loop.submit(a, *_sections(flows))
+        tb = loop.submit(b, *_sections(flows))
+        sess = loop.ring.session
+        real = sess.serve_ids
+
+        def sick_device(idx, authed_pairs=None):
+            # stream a hangs up while the dispatch is in flight...
+            loop.disconnect(a)
+            # ...and the device fails the launch
+            raise RuntimeError("sick device")
+
+        sess.serve_ids = sick_device
+        with pytest.raises(RuntimeError):
+            loop.step()
+        sess.serve_ids = real
+        # a's chunk cannot ride the retry (its slot is gone): the
+        # ticket fails immediately instead of timing out
+        assert ta.done and ta.error == "slot-released"
+        # b's chunk was restored and the next cycle serves it
+        assert not tb.done
+        loop.step()
+        assert tb.done and tb.error is None
+        assert [int(v) for v in tb.verdicts] == want
