@@ -154,9 +154,19 @@ bench-stream:    ## online serving path: chunked binary stream transport
 	    --stream-chunk 16384 --stream-depth 16 \
 	    --out SERVICE_LATENCY_stream.json
 
-bench-multichip: ## DP/DPxEP/TP scaling on the virtual 8-device mesh
+# bench-multichip: every §2.6 lane on the virtual 8-device mesh —
+# DP (batch-sharded), DPxEP (auto-partitioned comparison), EP
+# (one-shot all_to_all re-shard), CP (payload-sharded blockwise scan,
+# one carry exchange per block), TP (state-axis fallback). STRICT
+# gate (ISSUE 12): fails if DP constant-silicon efficiency < 0.8, CP
+# or EP overhead_fraction > 0.1, or any lane records more ledger
+# collectives per compiled block than the budget it declares on the
+# line. The provenance-stamped artifact feeds perf-report, whose
+# collective-budget gate holds the declared budgets across rounds.
+bench-multichip: ## DP/EP/CP/TP scaling + collective-budget gate
 	JAX_PLATFORMS=cpu $(PY) bench_multichip.py --devices 8 \
-	    --out MULTICHIP_PERF.json
+	    --flows-per-device 1024 --strict-gate \
+	    --out MULTICHIP_PERF_r06.json
 
 bench-watch:     ## probe until the tunnel answers, then capture the sweep
 	$(PY) bench.py --watch r04
@@ -169,4 +179,4 @@ bench-watch:     ## probe until the tunnel answers, then capture the sweep
 perf-report:     ## bench trajectory + regression gate
 	$(PY) -m cilium_tpu.perf_report --root . --out PERF_TRAJECTORY.json
 
-check: shim lint test determinism dryrun obs perf-report   ## the full CI gate
+check: shim lint test determinism dryrun obs bench-multichip perf-report   ## the full CI gate

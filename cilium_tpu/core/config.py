@@ -207,6 +207,16 @@ class ParallelConfig:
     expert_axis: str = "expert"    # EP over DFA banks
     mesh_shape: Optional[Tuple[int, ...]] = None  # None → all devices on data
     use_expert_axis: bool = False
+    #: sharded verdict lane ``parallel.sharding.stage_for_lane``
+    #: builds: "auto" (DP today — zero collectives at verdict batch
+    #: shapes), "dp", "ep" (bank-sharded one-shot all_to_all
+    #: re-shard), "cp" (payload-sharded blockwise scan, one carry
+    #: exchange per block). Every lane is verdict-bit-equal — the
+    #: knob only moves time and memory.
+    lane: str = "auto"
+    #: CP inner composition block (bytes per blockwise-SP block inside
+    #: each device's payload shard — parallel/cp.py)
+    cp_block: int = 256
 
 
 @dataclasses.dataclass
@@ -321,6 +331,11 @@ class Config:
         if "CILIUM_TPU_SERVE_PACK_INTERVAL_MS" in env:
             cfg.serve.pack_interval_ms = float(
                 env["CILIUM_TPU_SERVE_PACK_INTERVAL_MS"])
+        if env.get("CILIUM_TPU_PARALLEL_LANE", "") in (
+                "auto", "dp", "ep", "cp"):
+            cfg.parallel.lane = env["CILIUM_TPU_PARALLEL_LANE"]
+        if "CILIUM_TPU_CP_BLOCK" in env:
+            cfg.parallel.cp_block = int(env["CILIUM_TPU_CP_BLOCK"])
         if "CILIUM_TPU_DST_SEED" in env:
             cfg.dst.seed = int(env["CILIUM_TPU_DST_SEED"])
         if "CILIUM_TPU_DST_MUTATION" in env:

@@ -38,7 +38,9 @@ def pad_banks_for_ep(arrays: Dict[str, np.ndarray],
     axis so the bank axis shards evenly. Padded banks are all-zero:
     transition table pins the dead state, accept words are empty —
     scanning one yields nothing, and lane indices (bank*(32*W)+lane)
-    only ever point at real banks."""
+    only ever point at real banks. The megakernel's path group-accept
+    plane (``rp_path_gaccept``) shares the path family's bank axis
+    and pads identically (zero group bits are inert)."""
     out = dict(arrays)
     for fam in EP_BANKED_FAMILIES:
         key = f"{fam}_trans"
@@ -48,9 +50,12 @@ def pad_banks_for_ep(arrays: Dict[str, np.ndarray],
         pad = (-n_banks) % ep_size
         if pad == 0:
             continue
-        for suf in _EP_BANKED_SUFFIXES:
-            v = out[f"{fam}_{suf}"]
-            out[f"{fam}_{suf}"] = np.concatenate(
+        keys = [f"{fam}_{suf}" for suf in _EP_BANKED_SUFFIXES]
+        if fam == "path" and "rp_path_gaccept" in out:
+            keys.append("rp_path_gaccept")
+        for k in keys:
+            v = out[k]
+            out[k] = np.concatenate(
                 [v, np.zeros((pad,) + v.shape[1:], dtype=v.dtype)])
     return out
 
@@ -68,7 +73,8 @@ def shard_policy_arrays(
     out = {}
     for k, v in arrays.items():
         spec = P()
-        if expert_axis is not None and k in _EP_BANKED_KEYS:
+        if expert_axis is not None and (
+                k in _EP_BANKED_KEYS or k == "rp_path_gaccept"):
             spec = P(expert_axis)
         out[k] = jax.device_put(v, NamedSharding(mesh, spec))
     return out
@@ -97,3 +103,70 @@ def make_sharded_step(mesh: Mesh, data_axis: str = "data"):
         }
 
     return step
+
+
+#: values of ``[parallel] lane``: which sharded verdict lane
+#: :func:`stage_for_lane` builds (docs/PLATFORM.md "Multichip
+#: layouts" says which wins when)
+LANES = ("auto", "dp", "ep", "cp")
+
+
+def stage_for_lane(cfg, policy_arrays: Dict[str, np.ndarray],
+                   batch: Dict[str, np.ndarray], devices=None):
+    """The config-driven face of lane selection: stage ``(step,
+    arrays, batch)`` for the ``[parallel] lane`` the root ``Config``
+    names, on a single-axis mesh over ``devices``.
+
+    * ``dp`` (and ``auto`` today): batch-sharded verdict step —
+      wins at verdict batch shapes (everything local, 0 collectives);
+    * ``ep``: bank-sharded one-shot re-shard
+      (:mod:`cilium_tpu.parallel.ulysses`) — when the bank set
+      outgrows one chip's HBM;
+    * ``cp``: payload-sharded blockwise scan
+      (:mod:`cilium_tpu.parallel.cp`, ``cp_block`` sets the inner
+      composition block) — long payloads, small per-bank automata.
+
+    Every lane is verdict-bit-equal; the knob only moves time and
+    memory (pinned by tests/test_multichip.py)."""
+    from cilium_tpu.parallel.mesh import make_mesh
+
+    pcfg = cfg.parallel
+    lane = pcfg.lane
+    if lane not in LANES:
+        raise ValueError(f"[parallel] lane must be one of {LANES}, "
+                         f"got {lane!r}")
+    if lane == "auto":
+        # DP wins at verdict batch shapes: flows >> banks >> payload
+        # length, and DP is the only lane with zero collectives
+        lane = "dp"
+    if lane == "dp":
+        mesh = make_mesh(None, (pcfg.data_axis,), devices)
+        arrays = shard_policy_arrays(policy_arrays, mesh)
+        sbatch = shard_flow_batch(batch, mesh, pcfg.data_axis)
+        return make_sharded_step(mesh, pcfg.data_axis), arrays, sbatch
+    if lane == "ep":
+        from cilium_tpu.parallel.ulysses import (
+            make_ep_verdict_step,
+            stage_ep_arrays,
+            stage_replicated,
+        )
+
+        mesh = make_mesh(None, (pcfg.expert_axis,), devices)
+        arrays = stage_ep_arrays(policy_arrays, mesh, pcfg.expert_axis)
+        sbatch = stage_replicated(batch, mesh)
+        return (make_ep_verdict_step(mesh, arrays, sbatch,
+                                     pcfg.expert_axis),
+                arrays, sbatch)
+    # cp: payload byte columns sharded over the "seq" axis
+    from cilium_tpu.parallel.cp import (
+        cp_shard_batch,
+        make_cp_verdict_step,
+    )
+
+    mesh = make_mesh(None, ("seq",), devices)
+    arrays = {k: jax.device_put(v, NamedSharding(mesh, P()))
+              for k, v in policy_arrays.items()}
+    sbatch = cp_shard_batch(batch, mesh, "seq")
+    return (make_cp_verdict_step(mesh, batch, "seq",
+                                 block=pcfg.cp_block),
+            arrays, sbatch)

@@ -23,6 +23,14 @@ When this pays: rule banks whose subset-construction DFA is too big for
 one chip's HBM (``S × K`` transition + ``S × W`` accept tensors) — the
 state axis is the only axis that grows with pattern complexity rather
 than pattern count, so it is the axis TP must cut.
+
+This is the **fallback** lane, never a throughput play: the scan-step
+``psum`` executes once per scanned byte (on record in the PR-6
+collective ledger; MULTICHIP_PERF_r05 measured the lane 99.99%
+collective-bound). The throughput lane for scan sharding is the
+payload-sharded blockwise CP scan (``parallel/cp.py`` — ONE carry
+exchange per compiled block); reach for TP only when a single bank's
+states genuinely exceed one chip.
 """
 
 from __future__ import annotations

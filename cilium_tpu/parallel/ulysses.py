@@ -1,52 +1,77 @@
-"""Ulysses-style ``all_to_all`` re-shard between parse and match stages.
+"""Ulysses-style EP: rule banks sharded, ONE ``all_to_all`` re-shard
+between parse and match.
 
 SURVEY.md §2.6: the reference analog is Hubble Relay's scatter-gather
-(flows are node-sharded; a query re-gathers them per request). On a TPU
-mesh the same shape appears when the *rule-bank* set exceeds one chip:
-flows enter **batch-sharded** (DP — each device parsed/encoded its own
-slice), but the DFA banks are **bank-sharded** (EP), so the scan stage
-needs a re-shard:
+(flows are node-sharded; a query re-gathers them per request). On a
+TPU mesh the same shape appears when the *rule-bank* set exceeds one
+chip: the DFA banks are **bank-sharded** (EP), so every device scans
+the batch against ITS banks, but the per-rule conjunction needs all
+banks of each flow — a re-shard between the scan ("parse") and the
+resolve ("match").
 
-  parse:  data  [B/n, L]  per device        (batch-sharded)
-  scan:   every device scans ALL flows against ITS banks
-          → ``all_gather`` of the (small) encoded inputs over the axis
-  words:  [B, NB/n, W] per device           (bank-sharded output)
-  match:  the per-rule conjunction needs all banks of each flow
-          → ``lax.all_to_all`` splitting the batch axis and
-            concatenating the bank axis → [B/n, NB, W] (batch-sharded)
+MULTICHIP_PERF_r05 recorded the auto-partitioned DP×EP lane losing
+34% to that re-shard. Two structural fixes land here:
 
-This is exactly the Ulysses head/sequence axis switch with banks
-playing the role of heads: two collectives bracket the heavy scan, and
-each device ends holding the full match words for its own flow slice —
-ready for the (cheap, local) conjunction + verdict stage.
+* **The verdict-step face** (:func:`make_ep_verdict_step` /
+  :func:`stage_ep_arrays`) is a shard_map program with *declarative*
+  PartitionSpecs (SNIPPETS.md [1]/[2] pattern): bank tensors staged
+  ``P(axis)`` ONCE via explicit NamedSharding ``device_put``, encoded
+  inputs staged replicated ONCE — so the compiled program contains
+  exactly **one collective**: the ``all_to_all`` that splits the
+  batch axis and concatenates the bank axis (every family's accept
+  words plus the megakernel's group planes ride ONE packed uint32
+  payload). Scan work shards over banks, resolve work shards over the
+  batch, and the fused factored resolve still runs inside the same
+  single dispatch.
+* **The raw scan** (:func:`ulysses_scan_banked`, batch-sharded
+  inputs) packs payload bytes and lengths into ONE gathered buffer —
+  one ``all_gather`` + one ``all_to_all`` per block where it used to
+  pay three collectives.
+
+Factories are ``lru_cache``d per (mesh, axis[, layout]) like PR 4's —
+rebuilding a shard_map wrapper per call is a jit-cache miss and a
+full re-trace (ctlint recompile-hazard).
 """
 
 from __future__ import annotations
 
 import functools
+from typing import Dict, Tuple
 
 import jax
-from jax.sharding import Mesh, PartitionSpec as P
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cilium_tpu.engine.dfa_kernel import dfa_scan_banked
 from cilium_tpu.parallel import collectives
 from cilium_tpu.parallel.compat import shard_map
 
+#: the five scanned string fields: (bank-tensor prefix, batch field)
+_SCAN_FIELDS = (("path", "path"), ("method", "method"),
+                ("host", "host"), ("hdr", "headers"), ("dns", "qname"))
+
 
 @functools.lru_cache(maxsize=None)
 def _ulysses_step(mesh: Mesh, axis: str):
-    """Cached shard_map wrapper per (mesh, axis): building it inside
-    :func:`ulysses_scan_banked` made every call a fresh closure — a
-    jit-cache miss and full re-trace per chunk (ctlint
-    recompile-hazard)."""
+    """Cached shard_map wrapper per (mesh, axis) for the raw
+    batch-sharded scan: ONE packed input gather + ONE batch↔bank
+    switch per compiled block."""
 
     def local(trans_l, byteclass_l, start_l, accept_l, data_l, lengths_l):
-        # gather the full (encoded, byte-compressed) flow slice set —
-        # inputs are the *small* tensors; transition tables never move
-        all_data = collectives.all_gather(
-            data_l, axis, tiled=True, site="ulysses.gather")     # [B, L]
-        all_len = collectives.all_gather(
-            lengths_l, axis, tiled=True, site="ulysses.gather")  # [B]
+        # ONE packed gather: the (small, byte-compressed) payloads and
+        # their lengths ride a single collective — transition tables
+        # never move
+        lb = lax.bitcast_convert_type(
+            lengths_l.astype(jnp.int32)[:, None], jnp.uint8)
+        packed = jnp.concatenate(
+            [data_l.astype(jnp.uint8), lb.reshape(lb.shape[0], 4)],
+            axis=1)
+        allp = collectives.all_gather(
+            packed, axis, tiled=True, site="ulysses.gather")  # [B, L+4]
+        all_data = allp[:, :-4]
+        all_len = lax.bitcast_convert_type(
+            allp[:, -4:].reshape(-1, 1, 4), jnp.int32)[:, 0]
         words = dfa_scan_banked(trans_l, byteclass_l, start_l, accept_l,
                                 all_data, all_len)  # [B, NB/n, W]
         # Ulysses switch: split batch, concat banks → [B/n, NB, W]
@@ -77,3 +102,187 @@ def ulysses_scan_banked(
     batch-sharded on ``axis`` (bit-identical to ``dfa_scan_banked``)."""
     fn = _ulysses_step(mesh, axis)
     return fn(trans, byteclass, start, accept, data, lengths)
+
+
+# ----------------------------------------------------- verdict-step face --
+
+def stage_ep_arrays(arrays: Dict, mesh: Mesh, axis: str = "expert",
+                    ) -> Dict[str, jax.Array]:
+    """Stage policy tensors for the one-shot EP step ONCE: every DFA
+    family's bank tensors (and the megakernel's path group-accept
+    plane, which shares the path bank axis) shard ``P(axis)`` on the
+    bank dimension via explicit NamedSharding; everything else
+    replicates. Bank counts pad up to the axis size
+    (:func:`cilium_tpu.parallel.sharding.pad_banks_for_ep` — padded
+    banks are inert)."""
+    from cilium_tpu.parallel.sharding import (
+        _EP_BANKED_KEYS,
+        pad_banks_for_ep,
+    )
+
+    arrays = pad_banks_for_ep(arrays, mesh.shape[axis])
+    out = {}
+    for k, v in arrays.items():
+        banked = k in _EP_BANKED_KEYS or k == "rp_path_gaccept"
+        spec = P(axis) if banked else P()
+        out[k] = jax.device_put(v, NamedSharding(mesh, spec))
+    return out
+
+
+def stage_replicated(batch: Dict, mesh: Mesh) -> Dict[str, jax.Array]:
+    """Stage a host batch replicated on the mesh ONCE (explicit
+    NamedSharding ``device_put``) — the EP step's inputs enter
+    replicated so the compiled program needs no input gather."""
+    return {k: jax.device_put(v, NamedSharding(mesh, P()))
+            for k, v in batch.items()}
+
+
+@functools.lru_cache(maxsize=None)
+def _ep_verdict_factory(mesh: Mesh, axis: str,
+                        array_keys: Tuple[str, ...],
+                        batch_keys: Tuple[str, ...]):
+    """One compiled program per (mesh, axis, layout): local-bank scans
+    over the full batch → ONE packed all_to_all (batch-axis split →
+    bank-axis gather) → local-batch factored resolve. One dispatch,
+    one collective."""
+    from cilium_tpu.core.flow import TrafficDirection
+    from cilium_tpu.engine.mapstate_kernel import mapstate_lookup
+    from cilium_tpu.engine.megakernel import fused_verdict_core
+    from cilium_tpu.engine.verdict import _verdict_core, unpack_batch
+
+    n = mesh.shape[axis]
+    banked = frozenset(k for k in array_keys
+                       if k == "rp_path_gaccept"
+                       or _is_banked_key(k))
+
+    def body(arrays, batch):
+        b = unpack_batch(batch) if "scalars" in batch else dict(batch)
+        B = b["ep_ids"].shape[0]
+        Bl = B // n
+        plan_on = "rp_g_method" in arrays  # static under jit
+
+        # scan: full batch × LOCAL banks, every family
+        segs = []            # (prefix, NBl, W, Gw) for reassembly
+        parts = []
+        for prefix, field in _SCAN_FIELDS:
+            data = b[f"{field}_data"]
+            lengths = b[f"{field}_len"]
+            want_groups = plan_on and prefix == "path"
+            out = dfa_scan_banked(
+                arrays[f"{prefix}_trans"],
+                arrays[f"{prefix}_byteclass"],
+                arrays[f"{prefix}_start"], arrays[f"{prefix}_accept"],
+                data, lengths,
+                extra_accept=(arrays["rp_path_gaccept"]
+                              if want_groups else None))
+            w3, g3 = out if want_groups else (out, None)
+            NBl, W = w3.shape[1], w3.shape[2]
+            Gw = g3.shape[2] if g3 is not None else 0
+            segs.append((prefix, NBl, W, Gw))
+            parts.append(w3.reshape(B, NBl * W))
+            if g3 is not None:
+                parts.append(g3.reshape(B, NBl * Gw))
+
+        # THE re-shard: one all_to_all carries every family's words
+        # (and the group planes) — batch split, banks gathered
+        payload = jnp.concatenate(parts, axis=1)        # [B, C]
+        C = payload.shape[1]
+        switched = collectives.all_to_all(
+            payload, axis, split_axis=0, concat_axis=1, tiled=True,
+            site="ulysses.switch")                      # [Bl, n*C]
+        blocks = switched.reshape(Bl, n, C)
+
+        def loc(v):
+            r0 = lax.axis_index(axis) * Bl
+            return lax.dynamic_slice_in_dim(v, r0, Bl, axis=0)
+
+        # reassemble full-bank words per family (leading-axis bank
+        # sharding is contiguous, so concat over source devices
+        # restores global bank order), mask by the LOCAL valid column
+        words = []
+        gwords = None
+        off = 0
+        for prefix, NBl, W, Gw in segs:
+            field = dict(_SCAN_FIELDS)[prefix]
+            valid_l = loc(b[f"{field}_valid"])
+            w = blocks[:, :, off:off + NBl * W].reshape(
+                Bl, n, NBl, W).reshape(Bl, n * NBl, W)
+            off += NBl * W
+            flat = w.reshape(Bl, -1)
+            if prefix == "dns" and plan_on:
+                # padded dns banks append zero lanes past the
+                # rs-mask's width — trim to the plan's lane space
+                flat = flat[:, :arrays["rp_dns_rsmask"].shape[1]]
+            words.append(jnp.where(valid_l[:, None], flat, 0))
+            if Gw:
+                g = blocks[:, :, off:off + NBl * Gw].reshape(
+                    Bl, n, NBl, Gw).reshape(Bl, n * NBl, Gw)
+                off += NBl * Gw
+                gw = jax.lax.reduce(g, jnp.uint32(0),
+                                    jax.lax.bitwise_or, (1,))
+                gwords = jnp.where(valid_l[:, None], gw, 0)
+        words = tuple(words)
+
+        # match: LOCAL batch slice only — mapstate + resolve shard
+        # over the batch like DP, scan work sharded over banks
+        ms = mapstate_lookup(
+            arrays["ms_key_w0"], arrays["ms_key_w1"],
+            arrays["ms_key_w2"], arrays["ms_deny"],
+            arrays["ms_ruleset"], arrays["ms_enf_ids"],
+            arrays["ms_enf_flags"],
+            loc(b["ep_ids"]), loc(b["peer_ids"]), loc(b["dports"]),
+            loc(b["protos"]), loc(b["directions"]),
+            auth=arrays.get("ms_auth"),
+            port_plens=arrays.get("ms_plens"),
+            tmpl_ids=arrays.get("ms_tmpl_ids"))
+        directions = loc(b["directions"])
+        ep_ids, peer_ids = loc(b["ep_ids"]), loc(b["peer_ids"])
+        ingress = directions == int(TrafficDirection.INGRESS)
+        src = jnp.where(ingress, peer_ids, ep_ids)
+        dst = jnp.where(ingress, ep_ids, peer_ids)
+        kafka_cols = (loc(b["kafka_api_key"]),
+                      loc(b["kafka_api_version"]),
+                      loc(b["kafka_client"]), loc(b["kafka_topic"]))
+        gen_cols = (loc(b["gen_proto"]), loc(b["gen_pairs"]))
+        l7t = loc(b["l7_types"])
+        ab = ({"auth_pairs": b["auth_pairs"]}
+              if "auth_pairs" in b else {})
+        if not plan_on:
+            return _verdict_core(arrays, ms, l7t, words, kafka_cols,
+                                 (src, dst), ab, gen_cols=gen_cols)
+        return fused_verdict_core(arrays, ms, l7t, words, gwords,
+                                  kafka_cols, (src, dst), ab,
+                                  gen_cols=gen_cols)
+
+    a_specs = {k: (P(axis) if k in banked else P())
+               for k in array_keys}
+    b_specs = {k: P() for k in batch_keys}
+    return jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(a_specs, b_specs),
+        out_specs=P(axis), check_vma=False))
+
+
+def _is_banked_key(k: str) -> bool:
+    from cilium_tpu.parallel.sharding import _EP_BANKED_KEYS
+
+    return k in _EP_BANKED_KEYS
+
+
+def make_ep_verdict_step(mesh: Mesh, arrays: Dict, batch: Dict,
+                         axis: str = "expert"):
+    """The one-shot EP verdict step for these layouts: full nine-lane
+    output batch-sharded on ``axis``, bit-equal to the single-device
+    fused step. ``arrays`` from :func:`stage_ep_arrays`, ``batch``
+    from :func:`stage_replicated`; the batch size must divide the
+    axis (checked loudly — a silent floor-divide would truncate
+    verdicts)."""
+    n = mesh.shape[axis]
+    B = (batch["scalars"].shape[0] if "scalars" in batch
+         else batch["ep_ids"].shape[0])
+    if B % n:
+        raise ValueError(
+            f"EP one-shot step needs the batch ({B}) divisible by "
+            f"the {axis!r} axis ({n}); pad the batch first")
+    return _ep_verdict_factory(mesh, axis,
+                               tuple(sorted(arrays.keys())),
+                               tuple(sorted(batch.keys())))

@@ -23,7 +23,12 @@ This module is the reader:
   signal explains it);
 * **gate CI**: exit non-zero when the NEWEST round transition contains
   an unexplained code regression (historic transitions are reported
-  but do not fail — they are already shipped history).
+  but do not fail — they are already shipped history), when a staging
+  metric busts the absolute ``--stage-budget-ms`` budget, or when a
+  sharded multichip lane records more ledger collectives per compiled
+  block than the budget it declared on the bench line
+  (:func:`collective_budget_violations` — the structural guard
+  against a per-byte-collective regression).
 
 Faces: ``cilium-tpu perf-report``, ``python -m cilium_tpu.perf_report``,
 ``make perf-report`` (writes ``PERF_TRAJECTORY.json``, part of
@@ -242,7 +247,10 @@ def normalize_artifact(path: str) -> List[Dict]:
                                        "constant_silicon_efficiency",
                                        "strong_scaling_efficiency",
                                        "overhead_fraction",
-                                       "collectives")
+                                       "collectives",
+                                       "collective_count_per_block",
+                                       "collective_budget_per_block",
+                                       "xla_collectives")
                  if k in p}
                 for p in obj["points"] if isinstance(p, dict)]
         return [entry]
@@ -331,6 +339,52 @@ def normalize_all(root: str) -> Tuple[List[Dict], List[str]]:
             entries.extend(found)
     entries.extend(derive_stage_entries(entries))
     return entries, errors
+
+
+def collective_budget_violations(entries: List[Dict],
+                                 newest: Optional[int]) -> List[Dict]:
+    """The collective-budget gate (ISSUE 12): every sharded bench lane
+    that DECLARES a per-block collective budget on its point
+    (``collective_budget_per_block``) is held to it against the
+    ledger's recorded rows (``collectives``: count_per_block per
+    site). A lane regressing back to per-byte collectives — the
+    MULTICHIP_PERF_r05 TP shape — is caught structurally here, not by
+    wall-clock noise. Only the NEWEST round gates (history is already
+    shipped); lanes without a declared budget (tp, the documented
+    per-byte fallback) are not judged."""
+    out = []
+    for e in entries:
+        if e["status"] != "ok" or e["round"] != newest:
+            continue
+        for p in e["extras"].get("points") or []:
+            budget = p.get("collective_budget_per_block")
+            rows = p.get("collectives")
+            if budget is None or rows is None:
+                continue
+            total = sum(int(r.get("count_per_block", 0))
+                        for r in rows if isinstance(r, dict))
+            if total <= budget:
+                continue
+            sites = ", ".join(
+                f"{r.get('site')}:{r.get('count_per_block')}"
+                for r in rows if isinstance(r, dict))
+            out.append({
+                "metric": f"{e['metric']}[{p.get('lane')}]",
+                "kind": e["kind"],
+                "from": e["round_label"],
+                "to": e["round_label"],
+                "from_value": float(budget),
+                "to_value": float(total),
+                "direction": "lower",
+                "worse_factor": round(total / max(budget, 1), 4),
+                "classification": "code_regression",
+                "reason": (f"lane {p.get('lane')!r} records {total} "
+                           f"ledger collective(s) per compiled block "
+                           f"({sites}) over its declared budget "
+                           f"{budget} — per-block collective "
+                           f"structure regressed"),
+            })
+    return out
 
 
 # -- trajectory + classification --------------------------------------------
@@ -538,6 +592,8 @@ def build_trajectory(entries: List[Dict],
                     "reason": (f"stage_ms {last['value']:g} exceeds "
                                f"the budget {stage_budget_ms:g}ms"),
                 })
+    collective_violations = collective_budget_violations(entries,
+                                                         newest)
     return {
         "schema": TRAJECTORY_SCHEMA,
         "threshold": threshold,
@@ -547,7 +603,8 @@ def build_trajectory(entries: List[Dict],
         "trajectory": trajectory,
         "deltas": deltas,
         "failures": failures,
-        "gate_regressions": gate + budget_violations,
+        "gate_regressions": (gate + budget_violations
+                             + collective_violations),
     }
 
 

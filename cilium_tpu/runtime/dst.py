@@ -44,6 +44,7 @@ regression back to the schedule that exposed it via the
 from __future__ import annotations
 
 import argparse
+import functools
 import hashlib
 import json
 import os
@@ -53,6 +54,17 @@ import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from cilium_tpu.runtime import faults, simclock
+
+
+@functools.lru_cache(maxsize=1)
+def _ref_step():
+    """Memoized single-device reference step for the multichip arm —
+    one jit wrapper for the process (ctlint recompile-hazard)."""
+    import jax
+
+    from cilium_tpu.engine.verdict import verdict_step
+
+    return jax.jit(verdict_step)
 
 #: schedule format epoch, stamped on every trace + shrunken case
 SCHEDULE_FORMAT = 1
@@ -558,6 +570,94 @@ class DSTWorld:
                 "bytes_saved": st["bytes_saved"],
                 "verdicts": got_digest}
 
+    def multichip(self, index: int) -> Dict:
+        """Sampled invariant checks through the SHARDED verdict lanes
+        on a small virtual mesh (ISSUE 12): the DP-sharded step and
+        the payload-sharded CP step must match the single-device step
+        bit-for-bit on EVERY output lane, serve no ERROR, and hold
+        oracle agreement / fail-closed exactly like the single-device
+        plane — so mesh configs enter the searched fault space
+        instead of living only in the bench."""
+        import jax
+
+        devs = jax.devices()
+        if len(devs) < 2:
+            return {"skipped": "single-device backend"}
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from cilium_tpu.core.flow import Verdict
+        from cilium_tpu.engine.verdict import (
+            encode_flows,
+            flowbatch_to_host_dict,
+        )
+        from cilium_tpu.parallel.cp import (
+            cp_shard_batch,
+            make_cp_verdict_step,
+        )
+        from cilium_tpu.parallel.mesh import make_mesh
+        from cilium_tpu.parallel.sharding import (
+            make_sharded_step,
+            shard_flow_batch,
+            shard_policy_arrays,
+        )
+
+        n = 2
+        flows = self.corpus()
+        pad = (-len(flows)) % n
+        padded = flows + flows[:pad]
+        policy = self.loader.engine.policy
+        try:
+            host = flowbatch_to_host_dict(encode_flows(
+                padded, policy.kafka_interns, self.cfg.engine))
+            ref = _ref_step()(
+                {k: jnp.asarray(v) for k, v in policy.arrays.items()},
+                {k: jnp.asarray(v) for k, v in host.items()})
+            mesh = make_mesh((n,), ("data",), devs[:n])
+            arrays = shard_policy_arrays(policy.arrays, mesh)
+            out = make_sharded_step(mesh, "data")(
+                arrays, shard_flow_batch(host, mesh))
+            cmesh = make_mesh((n,), ("seq",), devs[:n])
+            cout = make_cp_verdict_step(cmesh, host)(
+                {k: jax.device_put(v, NamedSharding(cmesh, P()))
+                 for k, v in policy.arrays.items()},
+                cp_shard_batch(host, cmesh))
+        except InvariantViolation:
+            raise
+        except Exception as e:  # noqa: BLE001 — an injected fault
+            # failing the staging/dispatch is a legitimate outcome;
+            # the next round must stage fresh and agree again
+            return {"faulted": type(e).__name__}
+        for lane, sharded in (("dp", out), ("cp", cout)):
+            for key in ref:
+                if not np.array_equal(np.asarray(sharded[key]),
+                                      np.asarray(ref[key])):
+                    raise InvariantViolation(
+                        index, f"multichip-{lane}-parity",
+                        f"sharded output lane {key!r} diverged from "
+                        f"the single-device step")
+        got = [int(v) for v in np.asarray(out["verdict"])[:len(flows)]]
+        if int(Verdict.ERROR) in got:
+            raise InvariantViolation(index, "multichip-no-error",
+                                     "sharded step served ERROR")
+        want = self.oracle_verdicts(flows)
+        degraded = bool(self.loader.bank_status().get("degraded"))
+        if not degraded and got != want:
+            raise InvariantViolation(
+                index, "multichip-oracle-agreement",
+                f"sharded step served {got} != oracle {want} "
+                f"(not degraded)")
+        if degraded:
+            for k, (g, w) in enumerate(zip(got, want)):
+                if w == int(Verdict.DROPPED) and g != w:
+                    raise InvariantViolation(
+                        index, "multichip-fail-closed",
+                        f"flow {k}: oracle denies, degraded sharded "
+                        f"plane served {g}")
+        return {"devices": n, "flows": len(flows),
+                "verdicts": _digest(got), "degraded": degraded}
+
     def storm(self, n: int, index: int) -> Dict:
         """A burst of identity add/delete through the kvstore watch
         (the churn_storm point may lose deliveries); local allocation
@@ -722,11 +822,15 @@ def generate(seed: int, max_events: int = 12) -> List[List]:
                            rng.randrange(DSTWorld.N_IDS)])
         elif roll < 0.56:
             events.append(["traffic"])
-        elif roll < 0.68:
+        elif roll < 0.66:
             events.append(["serve", rng.randint(2, 6)])
-        elif roll < 0.78:
+        elif roll < 0.72:
+            # ISSUE 12: sharded-lane checks ride the schedule space —
+            # a fault armed two events earlier now also hits the mesh
+            events.append(["multichip"])
+        elif roll < 0.80:
             events.append(["advance", rng.choice(ADVANCES)])
-        elif roll < 0.88:
+        elif roll < 0.89:
             events.append(["storm", rng.randint(4, 24)])
         else:
             events.append(["drain-restore"])
@@ -777,6 +881,8 @@ def run_schedule(seed: int, events: Optional[List[List]] = None,
                             out = world.traffic(i)
                         elif kind == "serve":
                             out = world.serve(int(ev[1]), i)
+                        elif kind == "multichip":
+                            out = world.multichip(i)
                         elif kind == "advance":
                             clock.advance(float(ev[1]))
                             out = {"now": round(clock.now(), 6)}
@@ -901,6 +1007,16 @@ def emit_regression(result: Dict, out_dir: str) -> str:
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     from cilium_tpu.core.config import Config
+
+    # the multichip arm needs >=2 virtual devices; force them before
+    # any jax use (a backend already initialized narrower just makes
+    # the arm record "skipped" — never fails the lane)
+    try:
+        from cilium_tpu.parallel.mesh import force_cpu_host_devices
+
+        force_cpu_host_devices(2)
+    except RuntimeError:
+        pass
 
     cfg = Config.from_env()
     ap = argparse.ArgumentParser(

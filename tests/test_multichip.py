@@ -1,0 +1,307 @@
+"""ISSUE 12 differential suite: sharded verdicts ≡ single-device on
+ALL NINE output lanes, for DP / EP / CP meshes on the 8-device virtual
+mesh, plus the collective-structure pins (CP: one carry exchange per
+compiled block; EP: one all_to_all per batch) and a carry-boundary
+case where a match straddles two devices' payload blocks."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+#: every key the verdict step emits — the nine output lanes
+NINE_LANES = ("verdict", "allowed", "l3l4_allowed", "redirect",
+              "l7_ok", "l7_log", "match_spec", "ruleset",
+              "auth_required")
+
+
+def _policy_and_batch(widen: bool = False):
+    import __graft_entry__ as ge
+
+    # 56 http + 8 generic = 64 flows: divisible by every mesh split
+    policy, batch, flows, cfg = ge._small_policy_and_batch(
+        n_rules=64, n_flows=56, bank_size=8, n_generic=8)
+    if widen:
+        # bucket widening is semantics-preserving (padded bytes sit
+        # past every length; the scans mask them) — it makes the
+        # byte columns wide enough to actually CP-shard on 8 devices
+        batch = dict(batch)
+        for key in ("path_data", "headers_data"):
+            cur = batch[key]
+            if cur.shape[1] < 256:
+                batch[key] = np.pad(
+                    cur, ((0, 0), (0, 256 - cur.shape[1])))
+    return policy, batch
+
+
+def _reference(policy, batch):
+    from cilium_tpu.engine.verdict import verdict_step
+
+    out = jax.jit(verdict_step)(
+        {k: jnp.asarray(v) for k, v in policy.arrays.items()},
+        {k: jnp.asarray(v) for k, v in batch.items()})
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+def _assert_all_lanes(got, ref, lane):
+    assert set(ref) == set(NINE_LANES)
+    for key in NINE_LANES:
+        np.testing.assert_array_equal(
+            np.asarray(got[key]), ref[key],
+            err_msg=f"{lane}: output lane {key!r} diverged")
+
+
+def test_dp_sharded_all_nine_lanes():
+    from cilium_tpu.parallel.mesh import make_mesh
+    from cilium_tpu.parallel.sharding import (
+        make_sharded_step,
+        shard_flow_batch,
+        shard_policy_arrays,
+    )
+
+    policy, batch = _policy_and_batch()
+    ref = _reference(policy, batch)
+    mesh = make_mesh((8,), ("data",), jax.devices()[:8])
+    arrays = shard_policy_arrays(policy.arrays, mesh)
+    out = make_sharded_step(mesh, "data")(
+        arrays, shard_flow_batch(batch, mesh, "data"))
+    _assert_all_lanes(out, ref, "dp")
+
+
+def test_ep_oneshot_all_nine_lanes_and_single_all_to_all():
+    from cilium_tpu.parallel.collectives import LEDGER
+    from cilium_tpu.parallel.mesh import make_mesh
+    from cilium_tpu.parallel.ulysses import (
+        make_ep_verdict_step,
+        stage_ep_arrays,
+        stage_replicated,
+    )
+
+    policy, batch = _policy_and_batch()
+    ref = _reference(policy, batch)
+    mesh = make_mesh((8,), ("expert",), jax.devices()[:8])
+    arrays = stage_ep_arrays(policy.arrays, mesh, "expert")
+    sbatch = stage_replicated(batch, mesh)
+    LEDGER.reset()
+    step = make_ep_verdict_step(mesh, arrays, sbatch, "expert")
+    out = step(arrays, sbatch)
+    jax.block_until_ready(out)
+    _assert_all_lanes(out, ref, "ep")
+    # the one-shot contract: the compiled block's ONLY ledger-routed
+    # collective is the batch-split/bank-gather switch
+    rows = LEDGER.snapshot()
+    assert sum(r["count_per_block"] for r in rows) == 1, rows
+    assert rows[0]["site"] == "ulysses.switch"
+    assert rows[0]["op"] == "all_to_all"
+
+
+def test_cp_verdict_all_nine_lanes_and_budget():
+    from cilium_tpu.parallel.collectives import LEDGER
+    from cilium_tpu.parallel.cp import (
+        cp_shard_batch,
+        cp_sharded_keys,
+        make_cp_verdict_step,
+    )
+    from cilium_tpu.parallel.mesh import make_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    policy, batch = _policy_and_batch(widen=True)
+    ref = _reference(policy, batch)
+    mesh = make_mesh((8,), ("seq",), jax.devices()[:8])
+    skeys = cp_sharded_keys(batch, mesh)
+    assert "path_data" in skeys and "headers_data" in skeys
+    arrays = {k: jax.device_put(v, NamedSharding(mesh, P()))
+              for k, v in policy.arrays.items()}
+    LEDGER.reset()
+    out = make_cp_verdict_step(mesh, batch)(
+        arrays, cp_shard_batch(batch, mesh))
+    jax.block_until_ready(out)
+    _assert_all_lanes(out, ref, "cp")
+    # ≤1 collective per compiled block PER SHARDED FIELD, none else
+    rows = LEDGER.snapshot()
+    assert rows, "CP verdict recorded no collectives"
+    for r in rows:
+        assert r["site"].startswith("cp.carry."), r
+        assert r["op"] == "all_gather"
+        assert r["count_per_block"] == 1, r
+    assert len(rows) == len(skeys)
+
+
+def test_cp_scan_match_straddles_device_boundary():
+    """A signature split across two devices' payload blocks only
+    matches if the carry exchange threads the state correctly — the
+    case a block-local scan gets wrong."""
+    from cilium_tpu.engine.dfa_kernel import dfa_scan_banked
+    from cilium_tpu.parallel.cp import dfa_scan_banked_cp
+    from cilium_tpu.parallel.mesh import make_mesh
+    from cilium_tpu.policy.compiler.dfa import compile_patterns
+
+    n = 8
+    arrs = compile_patterns([".*attack-signature.*"],
+                            bank_size=1).stacked()
+    L = 1024           # 128 columns per device
+    shard = L // n
+    rng = np.random.default_rng(0)
+    data = rng.integers(97, 123, size=(4, L), dtype=np.uint8)
+    sig = b"attack-signature"
+    # row 0: signature centered ON the device-3/4 cut; row 1: fully
+    # inside one shard; row 2: at the very end; row 3: no signature
+    cut = 4 * shard
+    data[0, cut - 8:cut + 8] = np.frombuffer(sig, dtype=np.uint8)
+    data[1, 10:26] = np.frombuffer(sig, dtype=np.uint8)
+    data[2, L - 16:] = np.frombuffer(sig, dtype=np.uint8)
+    lengths = np.full((4,), L, dtype=np.int32)
+
+    ref = dfa_scan_banked(
+        jnp.asarray(arrs["trans"]), jnp.asarray(arrs["byteclass"]),
+        jnp.asarray(arrs["start"]), jnp.asarray(arrs["accept"]),
+        jnp.asarray(data), jnp.asarray(lengths))
+    mesh = make_mesh((n,), ("seq",), jax.devices()[:n])
+    cp = dfa_scan_banked_cp(
+        mesh, jnp.asarray(arrs["trans"]), jnp.asarray(arrs["byteclass"]),
+        jnp.asarray(arrs["start"]), jnp.asarray(arrs["accept"]),
+        jnp.asarray(data), jnp.asarray(lengths), block=64)
+    np.testing.assert_array_equal(np.asarray(cp), np.asarray(ref))
+    got = np.asarray(cp)
+    assert got[0].any(), "straddling match lost at the carry boundary"
+    assert got[1].any() and got[2].any() and not got[3].any()
+
+
+def test_cp_scan_one_collective_per_block():
+    from cilium_tpu.engine.dfa_kernel import dfa_scan_banked
+    from cilium_tpu.parallel.collectives import LEDGER
+    from cilium_tpu.parallel.cp import dfa_scan_banked_cp
+    from cilium_tpu.parallel.mesh import make_mesh
+    from cilium_tpu.policy.compiler.dfa import compile_patterns
+
+    n = 8
+    arrs = compile_patterns(["/cp/v[0-9]+", "cp-x+y"],
+                            bank_size=1).stacked()
+    L = 168  # distinctive length → fresh trace for this test
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 128, size=(8, L), dtype=np.uint8)
+    lengths = rng.integers(1, L + 1, size=(8,)).astype(np.int32)
+    mesh = make_mesh((n,), ("seq",), jax.devices()[:n])
+    LEDGER.reset()
+    out = dfa_scan_banked_cp(
+        mesh, jnp.asarray(arrs["trans"]), jnp.asarray(arrs["byteclass"]),
+        jnp.asarray(arrs["start"]), jnp.asarray(arrs["accept"]),
+        jnp.asarray(data), jnp.asarray(lengths), block=32)
+    jax.block_until_ready(out)
+    rows = LEDGER.snapshot()
+    # THE acceptance pin: ≤1 collective per compiled block (TP's
+    # state-axis lane records one psum per scanned byte here)
+    assert sum(r["count_per_block"] for r in rows) == 1, rows
+    assert rows[0]["site"] == "cp.carry_exchange"
+    ref = dfa_scan_banked(
+        jnp.asarray(arrs["trans"]), jnp.asarray(arrs["byteclass"]),
+        jnp.asarray(arrs["start"]), jnp.asarray(arrs["accept"]),
+        jnp.asarray(data), jnp.asarray(lengths))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_stage_for_lane_selects_and_agrees():
+    """The [parallel] lane/cp_block knobs drive a real consumer:
+    every lane the config can name produces bit-identical verdicts."""
+    from cilium_tpu.core.config import Config
+    from cilium_tpu.parallel.sharding import stage_for_lane
+
+    policy, batch = _policy_and_batch()
+    ref = _reference(policy, batch)
+    for lane in ("auto", "dp", "ep", "cp"):
+        cfg = Config()
+        cfg.parallel.lane = lane
+        cfg.parallel.cp_block = 64
+        step, arrays, sbatch = stage_for_lane(cfg, policy.arrays,
+                                              batch)
+        out = step(arrays, sbatch)
+        np.testing.assert_array_equal(
+            np.asarray(out["verdict"]), ref["verdict"],
+            err_msg=f"lane {lane}")
+    cfg = Config()
+    cfg.parallel.lane = "warp"
+    with pytest.raises(ValueError, match="lane"):
+        stage_for_lane(cfg, policy.arrays, batch)
+
+
+def test_parallel_lane_env_knobs():
+    from cilium_tpu.core.config import Config
+
+    cfg = Config.from_env({"CILIUM_TPU_PARALLEL_LANE": "cp",
+                           "CILIUM_TPU_CP_BLOCK": "128"})
+    assert cfg.parallel.lane == "cp"
+    assert cfg.parallel.cp_block == 128
+    # unknown lane values are ignored, not crashed on
+    cfg = Config.from_env({"CILIUM_TPU_PARALLEL_LANE": "warp"})
+    assert cfg.parallel.lane == "auto"
+
+
+def test_hypothesis_cp_random_banks_payloads_meshes():
+    """Property: for random bank shapes × payload lengths × mesh
+    splits, the payload-sharded CP scan is bit-equal to the banked
+    reference — including lengths that land inside any shard."""
+    hypothesis = pytest.importorskip("hypothesis")
+    from hypothesis import given, settings, strategies as st
+
+    from cilium_tpu.engine.dfa_kernel import dfa_scan_banked
+    from cilium_tpu.parallel.cp import dfa_scan_banked_cp
+    from cilium_tpu.parallel.mesh import make_mesh
+    from cilium_tpu.policy.compiler.dfa import compile_patterns
+
+    POOL = ["/api/v[0-9]+", "/health", "GET", "foo.*bar", "abc",
+            "x+y", ".*sig.*", "[a-d]{2}z"]
+
+    @settings(max_examples=15, deadline=None)
+    @given(st.tuples(
+        st.integers(1, 255),              # payload length
+        st.sampled_from((2, 4, 8)),       # mesh split
+        st.integers(1, 3),                # bank size
+        st.lists(st.sampled_from(POOL), min_size=1, max_size=6,
+                 unique=True),
+        st.integers(0, 2 ** 31 - 1),      # data seed
+        st.integers(8, 64)))              # inner block
+    def prop(args):
+        L, n_dev, bank_size, pats, seed, block = args
+        arrs = compile_patterns(pats, bank_size=bank_size).stacked()
+        rng = np.random.default_rng(seed)
+        B = 4
+        data = rng.integers(0, 256, size=(B, L), dtype=np.uint8)
+        lengths = rng.integers(0, L + 1, size=(B,)).astype(np.int32)
+        ref = dfa_scan_banked(
+            jnp.asarray(arrs["trans"]), jnp.asarray(arrs["byteclass"]),
+            jnp.asarray(arrs["start"]), jnp.asarray(arrs["accept"]),
+            jnp.asarray(data), jnp.asarray(lengths))
+        mesh = make_mesh((n_dev,), ("seq",), jax.devices()[:n_dev])
+        cp = dfa_scan_banked_cp(
+            mesh, jnp.asarray(arrs["trans"]),
+            jnp.asarray(arrs["byteclass"]), jnp.asarray(arrs["start"]),
+            jnp.asarray(arrs["accept"]), jnp.asarray(data),
+            jnp.asarray(lengths), block=block)
+        np.testing.assert_array_equal(np.asarray(cp), np.asarray(ref))
+
+    prop()
+
+
+def test_ep_batch_must_divide_axis():
+    """B not divisible by the expert axis is a loud staging error,
+    not silent wrong verdicts."""
+    from cilium_tpu.parallel.mesh import make_mesh
+    from cilium_tpu.parallel.ulysses import (
+        make_ep_verdict_step,
+        stage_ep_arrays,
+        stage_replicated,
+    )
+
+    policy, batch = _policy_and_batch()
+    odd = {k: v[:61] for k, v in batch.items()}
+    mesh = make_mesh((8,), ("expert",), jax.devices()[:8])
+    arrays = stage_ep_arrays(policy.arrays, mesh, "expert")
+    sbatch = stage_replicated(odd, mesh)
+    with pytest.raises(ValueError, match="divisible"):
+        make_ep_verdict_step(mesh, arrays, sbatch, "expert")

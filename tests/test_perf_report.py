@@ -332,3 +332,94 @@ def test_golden_replay_artifact_attribution_and_provenance(tmp_path):
     art.write_text(json.dumps(rec) + "\n")
     entries = normalize_artifact(str(art))
     assert entries and not validate_entry(entries[0])
+
+
+# -- collective-budget gate (ISSUE 12) ---------------------------------------
+
+def _multichip_line(lane_points):
+    return {"metric": "multichip_weak_scaling_8dev", "value": 1.0,
+            "unit": "DP constant-silicon efficiency", "platform": "cpu",
+            "points": lane_points}
+
+
+def test_collective_budget_within_budget_is_clean(tmp_path):
+    _write(tmp_path, "MULTICHIP_PERF_r07.json", _multichip_line([
+        {"lane": "cp", "collective_budget_per_block": 1,
+         "collectives": [{"site": "cp.carry_exchange",
+                          "op": "all_gather", "axis": "seq",
+                          "count_per_block": 1}]},
+        {"lane": "dp", "collective_budget_per_block": 0,
+         "collectives": []},
+        # no declared budget → not judged, however many it records
+        {"lane": "tp", "collectives": [
+            {"site": "tp.scan_step", "op": "psum",
+             "count_per_block": 64}]},
+    ]))
+    entries, errs = normalize_all(str(tmp_path))
+    report = build_trajectory(entries)
+    assert report["gate_regressions"] == []
+
+
+def test_collective_budget_violation_gates_newest_round(tmp_path):
+    # the regression shape this gate exists for: the CP lane slid
+    # back to a collective per scanned byte
+    _write(tmp_path, "MULTICHIP_PERF_r07.json", _multichip_line([
+        {"lane": "cp", "collective_budget_per_block": 1,
+         "collectives": [{"site": "cp.carry_exchange",
+                          "op": "ppermute", "axis": "seq",
+                          "count_per_block": 64}]},
+    ]))
+    entries, _ = normalize_all(str(tmp_path))
+    report = build_trajectory(entries)
+    gate = report["gate_regressions"]
+    assert len(gate) == 1, gate
+    assert gate[0]["classification"] == "code_regression"
+    assert "cp" in gate[0]["metric"]
+    assert "declared budget 1" in gate[0]["reason"]
+    assert "64" in gate[0]["reason"]
+
+
+def test_collective_budget_old_rounds_do_not_gate(tmp_path):
+    # an over-budget lane in a SHIPPED round reports nothing: only
+    # the newest round gates (consistent with the delta classifier)
+    _write(tmp_path, "MULTICHIP_PERF_r05.json", _multichip_line([
+        {"lane": "tp", "collective_budget_per_block": 1,
+         "collectives": [{"site": "tp.scan_step", "op": "psum",
+                          "count_per_block": 64}]},
+    ]))
+    _write(tmp_path, "MULTICHIP_PERF_r07.json", _multichip_line([
+        {"lane": "cp", "collective_budget_per_block": 1,
+         "collectives": [{"site": "cp.carry_exchange",
+                          "op": "all_gather",
+                          "count_per_block": 1}]},
+    ]))
+    entries, _ = normalize_all(str(tmp_path))
+    report = build_trajectory(entries)
+    assert report["newest_round"] == 7
+    assert report["gate_regressions"] == []
+
+
+def test_real_multichip_artifact_budgets_hold():
+    """The committed r06 artifact's declared budgets hold through the
+    same reader CI runs — the acceptance pin, not a fixture."""
+    path = os.path.join(REPO_ROOT, "MULTICHIP_PERF_r06.json")
+    if not os.path.exists(path):
+        import pytest
+
+        pytest.skip("MULTICHIP_PERF_r06.json not captured yet")
+    entries = normalize_artifact(path)
+    assert entries
+    pts = entries[0]["extras"]["points"]
+    lanes = {p.get("lane"): p for p in pts}
+    for lane in ("dp", "ep", "cp"):
+        assert lane in lanes, lanes.keys()
+        assert "collective_budget_per_block" in lanes[lane]
+    report = build_trajectory(entries)
+    assert report["gate_regressions"] == []
+    # the r05 indictment numbers, reversed: the cp lane records <=1
+    # collective per compiled block and stays within overhead budget
+    cp = lanes["cp"]
+    assert sum(r["count_per_block"]
+               for r in cp["collectives"]) <= 1
+    assert cp["overhead_fraction"] <= 0.1
+    assert lanes["ep"]["overhead_fraction"] <= 0.1

@@ -236,8 +236,13 @@ def test_ledger_ulysses_records_gather_and_switch():
         jnp.asarray(data), jnp.asarray(lengths))
     jax.block_until_ready(out)
     snap = {(r["site"], r["op"]): r for r in LEDGER.snapshot()}
-    # two gathers (data + lengths) bracket one bank↔batch switch
-    assert snap[("ulysses.gather", "all_gather")]["count_per_block"] == 2
+    # ONE packed gather (payload bytes + lengths ride one collective —
+    # the round-7 rework fused the former two) brackets one
+    # bank↔batch switch
+    gather = snap[("ulysses.gather", "all_gather")]
+    assert gather["count_per_block"] == 1
+    # the packed buffer carries the payload plus 4 length bytes/row
+    assert gather["bytes_per_call"] == (n * 4 // n) * (L + 4)
     assert snap[("ulysses.switch", "all_to_all")]["count_per_block"] == 1
 
 
