@@ -3,10 +3,11 @@
 
 PY ?= python
 
-.PHONY: test shim lint determinism dryrun chaos obs soak churn dst \
-        dst-validate serve-soak bench bench-all bench-e2e \
-        bench-service bench-regen bench-sp bench-stage bench-stream \
-        bench-kernel bench-multichip bench-watch perf-report check
+.PHONY: test shim lint determinism dryrun chaos obs soak churn \
+        churn-fleet churn-fleet-smoke dst dst-validate serve-soak \
+        bench bench-all bench-e2e bench-service bench-regen bench-sp \
+        bench-stage bench-stream bench-kernel bench-multichip \
+        bench-watch perf-report check
 
 test:            ## full suite (CPU, virtual 8-device mesh via conftest)
 	$(PY) -m pytest tests/ -q
@@ -88,6 +89,28 @@ churn:           ## sustained policy-churn soak (bank-scoped compile)
 	CILIUM_TPU_CHURN_BENCH_OUT=BENCH_CHURN_r06.jsonl \
 	CILIUM_TPU_DST_SEED=8 \
 	$(PY) -m pytest tests/test_soak.py -q -m churn
+
+# churn-fleet: the ISSUE-13 acceptance lane — BASELINE configs[4]
+# scale (10k identities x 5k CNP over ~200 service classes) driven as
+# a churn storm through one live Loader + replay session by
+# runtime/fleet.py. Gates: zero stale/ERROR verdicts vs the serving
+# engine + sampled oracle, bank compiles/update <= 1.1x the 27-bank
+# churn ratio (O(Δ) survives two orders of magnitude more policy),
+# update->enforcement p99 <= 2x the committed BENCH_CHURN_r06 number,
+# and peak RSS under the declared bound (sharded registry +
+# fingerprint store + artifact-cache LRU). One provenance-stamped
+# line lands in BENCH_CHURN_FLEET_r07.jsonl (consumed by perf-report).
+churn-fleet:     ## fleet-scale churn storm (10k ids x 5k CNP)
+	JAX_PLATFORMS=cpu $(PY) -m cilium_tpu.runtime.fleet \
+	    --identities 10000 --cnps 5000 --updates 56 \
+	    --out BENCH_CHURN_FLEET_r07.jsonl
+
+# the smoke face of the same driver — small enough for `make check`;
+# the p99 gate stays off (the 27-bank baseline is not comparable at
+# smoke scale) but every correctness gate is armed
+churn-fleet-smoke: ## fleet churn driver at check-sized smoke scale
+	JAX_PLATFORMS=cpu $(PY) -m cilium_tpu.runtime.fleet \
+	    --identities 1000 --cnps 500 --updates 10 --no-p99-gate
 
 # dst: deterministic simulation testing (runtime/dst.py) — seeded
 # fault-SCHEDULE search under virtual time (runtime/simclock.py):
@@ -179,4 +202,4 @@ bench-watch:     ## probe until the tunnel answers, then capture the sweep
 perf-report:     ## bench trajectory + regression gate
 	$(PY) -m cilium_tpu.perf_report --root . --out PERF_TRAJECTORY.json
 
-check: shim lint test determinism dryrun obs bench-multichip perf-report   ## the full CI gate
+check: shim lint test determinism dryrun obs churn-fleet-smoke bench-multichip perf-report   ## the full CI gate

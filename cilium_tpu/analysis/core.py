@@ -281,6 +281,10 @@ RULES: Dict[str, str] = {
     "unbounded-queue": "no queue.Queue() without maxsize and no "
                        "list-as-queue append without a bound/shed "
                        "path in threaded runtime modules",
+    "unbounded-registry": "no dict/set registry in long-lived "
+                          "runtime/engine/policy modules inserted "
+                          "into on an event path without an "
+                          "eviction, bound, or TTL",
     "pallas-block-shape": "pallas_call block shapes align to the "
                           "(8, 128) TPU tile where literally provable, "
                           "and every matmul inside a pallas kernel "
@@ -348,6 +352,7 @@ def run(root: str, targets: Sequence[str] = (DEFAULT_TARGET,),
         recompile,
         registry,
         shapes,
+        unboundedreg,
         wallclock,
     )
 

@@ -100,6 +100,64 @@ class LoaderConfig:
     #: burst, so a 100-event churn storm costs O(1) regenerations.
     #: 0 = regenerate per event (the pre-debounce behavior).
     identity_regen_debounce_s: float = 0.05
+    #: on-disk artifact-cache byte bound: past it, least-recently-used
+    #: entries are evicted (counted on
+    #: ``cilium_tpu_artifact_cache_evictions_total``). The currently-
+    #: serving policy's artifact and the warm-restart snapshot are
+    #: protected — never evicted. 0 = unbounded (the pre-bound
+    #: behavior: the dir grows without limit under churn).
+    artifact_cache_max_bytes: int = 2 << 30
+
+
+@dataclasses.dataclass
+class CompileConfig:
+    """Fleet-scale bank-compile plane
+    (policy/compiler/compilequeue.py): the parallel work queue behind
+    ``BankRegistry.compile_field``, the sharded registry bounds, and
+    the compiled-bank artifact distribution. Every knob only moves
+    time/memory — failure semantics stay the PR-8 contract (pending or
+    failed banks serve the last-good cover, uncovered patterns fail
+    CLOSED)."""
+
+    #: bank-compile worker threads. 0 = inline serial compiles (the
+    #: pre-queue loop); 1 = queued but strictly ordered (what the
+    #: seeded DST schedules run, so per-bank fault attribution is
+    #: deterministic); >1 = parallel compiles (the fleet lanes)
+    workers: int = 2
+    #: per-bank compile deadline: a serving-blocking compile still
+    #: running this long after submit stops blocking the regeneration —
+    #: the bank serves its last-good cover (uncovered patterns fail
+    #: closed) and the compile finishes in the background
+    deadline_s: float = 30.0
+    #: in-queue retry budget for WORKER DEATH (the ``compile.worker``
+    #: fault point): a task whose worker dies re-queues with backoff
+    #: up to this many times, then fails into quarantine. Compile
+    #: exceptions (bad pattern, ``loader.bank_compile`` faults) are
+    #: deterministic and quarantine immediately — retrying them is
+    #: wasted work; the quarantine TTL is their retry schedule.
+    max_retries: int = 3
+    #: exponential-backoff base for in-queue retries (doubles per
+    #: attempt, deterministic ±10% jitter from the work key)
+    backoff_base_s: float = 0.25
+    #: backoff ceiling
+    backoff_max_s: float = 8.0
+    #: bounded in-flight memory: pending + running compile tasks the
+    #: queue holds before ``submit`` blocks the producer
+    max_pending: int = 256
+    #: byte-bounded LRU shards of the bank registry (the 5k-CNP
+    #: pattern universe serves in bounded memory; eviction recompiles
+    #: or re-fetches on next use)
+    registry_shards: int = 8
+    #: total byte bound across registry shards
+    registry_max_bytes: int = 256 << 20
+    #: per-identity fingerprint store byte bound (sharded LRU;
+    #: eviction recomputes — never changes a delta, only its cost)
+    fp_cache_max_bytes: int = 64 << 20
+    #: publish compiled bank groups into the loader's ArtifactCache
+    #: (sha256-checksummed) and fetch them on registry miss — compiled
+    #: banks become location-transparent artifacts (compile anywhere,
+    #: distribute; a corrupt/lost artifact degrades to recompile)
+    bank_artifacts: bool = True
 
 
 @dataclasses.dataclass
@@ -253,6 +311,8 @@ class Config:
     tracing: TracingConfig = dataclasses.field(default_factory=TracingConfig)
     admission: AdmissionConfig = dataclasses.field(
         default_factory=AdmissionConfig)
+    compile: CompileConfig = dataclasses.field(
+        default_factory=CompileConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     dst: DSTConfig = dataclasses.field(default_factory=DSTConfig)
     log_level: str = "info"
@@ -303,6 +363,23 @@ class Config:
         if "CILIUM_TPU_IDENTITY_REGEN_DEBOUNCE_S" in env:
             cfg.loader.identity_regen_debounce_s = float(
                 env["CILIUM_TPU_IDENTITY_REGEN_DEBOUNCE_S"])
+        if "CILIUM_TPU_ARTIFACT_CACHE_MAX_BYTES" in env:
+            cfg.loader.artifact_cache_max_bytes = int(
+                env["CILIUM_TPU_ARTIFACT_CACHE_MAX_BYTES"])
+        if "CILIUM_TPU_COMPILE_WORKERS" in env:
+            cfg.compile.workers = int(env["CILIUM_TPU_COMPILE_WORKERS"])
+        if "CILIUM_TPU_COMPILE_DEADLINE_S" in env:
+            cfg.compile.deadline_s = float(
+                env["CILIUM_TPU_COMPILE_DEADLINE_S"])
+        if "CILIUM_TPU_COMPILE_MAX_RETRIES" in env:
+            cfg.compile.max_retries = int(
+                env["CILIUM_TPU_COMPILE_MAX_RETRIES"])
+        if "CILIUM_TPU_COMPILE_REGISTRY_MAX_BYTES" in env:
+            cfg.compile.registry_max_bytes = int(
+                env["CILIUM_TPU_COMPILE_REGISTRY_MAX_BYTES"])
+        if env.get("CILIUM_TPU_COMPILE_BANK_ARTIFACTS", "").lower() in (
+                "0", "false", "no", "off"):
+            cfg.compile.bank_artifacts = False
         if "CILIUM_TPU_NODE_NAME" in env:
             cfg.node_name = env["CILIUM_TPU_NODE_NAME"]
         if "CILIUM_TPU_IPAM_MODE" in env:
@@ -364,6 +441,7 @@ class Config:
                                 ("breaker", cfg.breaker),
                                 ("tracing", cfg.tracing),
                                 ("admission", cfg.admission),
+                                ("compile", cfg.compile),
                                 ("serve", cfg.serve),
                                 ("dst", cfg.dst)):
             for k, v in data.get(section, {}).items():

@@ -106,35 +106,47 @@ def pack_mapstate(
     tmpl_of_identity: List[int] = []
     tmpl_index: Dict[tuple, int] = {}
     plens = {16, 0}
+    #: per-call memo keyed by the MapState's OBJECT identity: at fleet
+    #: scale many identities share one resolved state object, and
+    #: rebuilding its row tuple per identity is the packing hot spot.
+    #: The per_identity dict keeps every ms alive for the call, so
+    #: id() keys cannot be recycled mid-pack.
+    ms_memo: Dict[int, tuple] = {}
     for ep_id, ms in sorted(per_identity.items()):
         enf.append((ep_id, ms.ingress_enforced, ms.egress_enforced,
                     getattr(ms, "audit", False)))
-        ep_rows = []
-        for key, entry in ms.entries.items():
-            rid = -1
-            if ruleset_of_entry is not None and entry.is_redirect:
-                rid = ruleset_of_entry(ep_id, key, entry)
-            plen = getattr(key, "port_plen", None)
-            if plen is None:
-                plen = 0 if key.dport == 0 else 16
-            plens.add(plen)
-            ep_rows.append((
-                key.identity,
-                _pack_w2(key.direction, key.proto, key.dport, plen),
-                entry.is_deny,
-                rid,
-                getattr(entry, "auth_required", False),
-            ))
+        cached = ms_memo.get(id(ms))
+        if cached is None:
+            ep_rows = []
+            ep_plens = set()
+            for key, entry in ms.entries.items():
+                rid = -1
+                if ruleset_of_entry is not None and entry.is_redirect:
+                    rid = ruleset_of_entry(ep_id, key, entry)
+                plen = getattr(key, "port_plen", None)
+                if plen is None:
+                    plen = 0 if key.dport == 0 else 16
+                ep_plens.add(plen)
+                ep_rows.append((
+                    key.identity,
+                    _pack_w2(key.direction, key.proto, key.dport, plen),
+                    entry.is_deny,
+                    rid,
+                    getattr(entry, "auth_required", False),
+                ))
+            cached = ms_memo[id(ms)] = (tuple(sorted(ep_rows)),
+                                        frozenset(ep_plens))
+        fp, ep_plens = cached
+        plens |= ep_plens
         # distillery dedup: identities with identical verdict-relevant
         # entry sets share one TEMPLATE; the table stores each template
         # once and the lookup indirects identity → template. rid is
         # content-keyed by the caller (ruleset_of dedups rule-id
         # sets), so shared entries share rulesets too.
-        fp = tuple(sorted(ep_rows))
         tmpl = tmpl_index.get(fp)
         if tmpl is None:
             tmpl = tmpl_index[fp] = len(tmpl_index)
-            for r in ep_rows:
+            for r in fp:
                 rows.append((tmpl,) + r)
         tmpl_of_identity.append(tmpl)
     if not rows:

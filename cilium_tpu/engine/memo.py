@@ -56,6 +56,12 @@ FAMILY_OF_L7TYPE = {0: "l4", 1: "http", 2: "kafka", 3: "dns",
 #: the identity may verdict differently regardless of family
 FAMILY_ALL = "*"
 
+#: wildcard port of the bank-reference granularity: the family's
+#: rules changed on a port-range/wildcard entry (or the producer
+#: couldn't split by port) — every port's rows of that (identity,
+#: family) may verdict differently
+PORT_ALL = -1
+
 
 @dataclasses.dataclass(frozen=True)
 class PolicyDelta:
@@ -70,8 +76,7 @@ class PolicyDelta:
     ``changed_banks`` names the hot-swapped content-addressed bank
     keys for observability and the per-bank epoch map.
 
-    ``changed_identity_families`` narrows further, to TRUE
-    bank-reference granularity (the PR-8 "remaining headroom"): each
+    ``changed_identity_families`` narrows to family granularity: each
     ``(identity, family)`` pair says which rule family of that
     identity actually changed, where family is one of
     :data:`FAMILY_OF_L7TYPE`'s values or :data:`FAMILY_ALL` (the
@@ -81,7 +86,20 @@ class PolicyDelta:
     identity's DNS/kafka memo rows, because their verdicts never read
     the path automaton (every ``l7_ok`` contribution is gated on
     ``l7t == family``). Empty = unknown (producer predates family
-    fingerprints) — consumers fall back to identity granularity."""
+    fingerprints) — consumers fall back to identity granularity.
+
+    ``changed_identity_family_ports`` is the final step to TRUE
+    bank-reference granularity (the PR-8 "remaining headroom",
+    finished by ISSUE 13): ``(identity, family, dport)`` triples name
+    the exact MapState ENTRY whose rule set moved — and a memo row
+    reads a bank only through its entry's ruleset, so a 5k-CNP delta
+    touching one port's rules refills exactly the rows whose
+    ``(identity, l7-family, dport)`` routes through the changed
+    banks. ``dport`` :data:`PORT_ALL` marks a port-range/wildcard
+    entry (every port of the family affected). The triple set covers
+    exactly the ``changed_identity_families`` pairs when non-empty;
+    empty = no port information — consumers fall back to family
+    granularity."""
 
     full: bool = True
     reason: str = "policy-swap"
@@ -91,6 +109,10 @@ class PolicyDelta:
     #: a structural change. Covers exactly ``changed_identities`` when
     #: non-empty (the loader produces both from the same fingerprints)
     changed_identity_families: frozenset = frozenset()
+    #: frozenset of (identity, family, dport) triples — the
+    #: bank-reference granularity; dport PORT_ALL marks a range/
+    #: wildcard entry. Covers exactly the family pairs when non-empty.
+    changed_identity_family_ports: frozenset = frozenset()
 
     @classmethod
     def none(cls) -> "PolicyDelta":
@@ -101,22 +123,28 @@ class PolicyDelta:
 
     @classmethod
     def banks(cls, identities, banks, reason: str = "bank-swap",
-              identity_families=()) -> "PolicyDelta":
+              identity_families=(), identity_family_ports=()
+              ) -> "PolicyDelta":
         return cls(full=False, reason=reason,
                    changed_identities=frozenset(identities),
                    changed_banks=frozenset(banks),
                    changed_identity_families=frozenset(
-                       identity_families))
+                       identity_families),
+                   changed_identity_family_ports=frozenset(
+                       identity_family_ports))
 
     @property
     def is_noop(self) -> bool:
         return (not self.full and not self.changed_identities
                 and not self.changed_banks)
 
-    def affects(self, identity: int, l7_type: int) -> bool:
+    def affects(self, identity: int, l7_type: int,
+                dport: Optional[int] = None) -> bool:
         """May a memoized row with this (enforcement identity, L7
-        type) verdict differently under this delta? The consumer-side
-        face of the granularity ladder: full → identity → family."""
+        type, destination port) verdict differently under this delta?
+        The consumer-side face of the granularity ladder: full →
+        identity → family → bank reference (port). ``dport=None`` =
+        the caller has no port column — family granularity."""
         if self.full:
             return True
         if identity not in self.changed_identities:
@@ -127,7 +155,13 @@ class PolicyDelta:
         if (identity, FAMILY_ALL) in fams:
             return True
         family = FAMILY_OF_L7TYPE.get(int(l7_type))
-        return family is not None and (identity, family) in fams
+        if family is None or (identity, family) not in fams:
+            return False
+        ports = self.changed_identity_family_ports
+        if not ports or dport is None:
+            return True          # family-granular producer/consumer
+        return ((identity, family, PORT_ALL) in ports
+                or (identity, family, int(dport)) in ports)
 
     def merge(self, other: "PolicyDelta") -> "PolicyDelta":
         if self.full or other.full:
@@ -146,22 +180,33 @@ class PolicyDelta:
                     | other.changed_identity_families)
         else:
             fams = frozenset()
+        # ...and port narrowing likewise: both sides or neither (a
+        # ports-blind delta means "all ports" for its family pairs)
+        if fams and self.changed_identity_family_ports \
+                and other.changed_identity_family_ports:
+            ports = (self.changed_identity_family_ports
+                     | other.changed_identity_family_ports)
+        else:
+            ports = frozenset()
         return PolicyDelta(
             full=False, reason=other.reason,
             changed_identities=(self.changed_identities
                                 | other.changed_identities),
             changed_banks=self.changed_banks | other.changed_banks,
-            changed_identity_families=fams)
+            changed_identity_families=fams,
+            changed_identity_family_ports=ports)
 
 
-def affected_row_ids(delta: "PolicyDelta", eps, l7_types
-                     ) -> "np.ndarray":
+def affected_row_ids(delta: "PolicyDelta", eps, l7_types,
+                     dports=None) -> "np.ndarray":
     """Vectorized :meth:`PolicyDelta.affects` over aligned
-    ``(enforcement identity, l7 type)`` columns → the affected row
-    ids, int32. The shared consumer-side half of the family-granular
-    invalidation (CaptureReplay offline, IncrementalSession online,
-    the verdict ring's shared session) — one implementation so the
-    layers can't drift on what "row read the swapped bank" means."""
+    ``(enforcement identity, l7 type[, dport])`` columns → the
+    affected row ids, int32. The shared consumer-side half of the
+    bank-reference invalidation (CaptureReplay offline,
+    IncrementalSession online, the verdict ring's shared session) —
+    one implementation so the layers can't drift on what "row read
+    the swapped bank" means. ``dports=None`` keeps family
+    granularity (the pre-ISSUE-13 consumers)."""
     eps = np.asarray(eps, dtype=np.int64)
     l7s = np.asarray(l7_types, dtype=np.int64)
     if delta.full:
@@ -169,6 +214,11 @@ def affected_row_ids(delta: "PolicyDelta", eps, l7_types
     if not delta.changed_identities:
         return np.zeros(0, dtype=np.int32)
     fams = delta.changed_identity_families
+    ports = delta.changed_identity_family_ports
+    if dports is not None:
+        dps = np.asarray(dports, dtype=np.int64)
+    else:
+        dps = None
     mask = np.zeros(len(eps), dtype=bool)
     for ep in delta.changed_identities:
         sel = eps == ep
@@ -177,10 +227,20 @@ def affected_row_ids(delta: "PolicyDelta", eps, l7_types
         if not fams or (ep, FAMILY_ALL) in fams:
             mask |= sel        # identity-granular (or structural)
             continue
-        codes = [code for code, name in FAMILY_OF_L7TYPE.items()
-                 if (ep, name) in fams]
-        if codes:
-            mask |= sel & np.isin(l7s, codes)
+        for code, name in FAMILY_OF_L7TYPE.items():
+            if (ep, name) not in fams:
+                continue
+            fam_sel = sel & (l7s == code)
+            if not fam_sel.any():
+                continue
+            if ports and dps is not None \
+                    and (ep, name, PORT_ALL) not in ports:
+                # bank-reference narrowing: only rows whose entry
+                # (port) routes through the changed rule set refill
+                fam_ports = [p for (e, n, p) in ports
+                             if e == ep and n == name]
+                fam_sel = fam_sel & np.isin(dps, fam_ports)
+            mask |= fam_sel
     return np.nonzero(mask)[0].astype(np.int32)
 
 

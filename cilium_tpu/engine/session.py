@@ -62,6 +62,7 @@ _FIELDS = ("path", "method", "host", "headers", "qname")
 #: row-column index of the L7 type (the family key of the
 #: bank-reference invalidation narrowing)
 _L7_COL = _ROW_COLS.index("l7_types")
+_DPORT_COL = _ROW_COLS.index("dports")
 _PREFIX = {"path": "path", "method": "method", "host": "host",
            "headers": "hdr", "qname": "dns"}
 
@@ -218,9 +219,9 @@ class IncrementalSession:
         self.rows_dev: Optional[jax.Array] = None
         self._pending_rows: list = []
         #: host mirror of each session row's (enforcement identity,
-        #: l7 type) — bounded by max_rows like the row table itself:
-        #: the family-granular (bank-reference) invalidation mask is
-        #: computed from it without a device readback
+        #: l7 type, dport) — bounded by max_rows like the row table
+        #: itself: the bank-reference invalidation mask is computed
+        #: from it without a device readback
         self._row_eps: list = []
         #: session row ids a bank-scoped commit touched, awaiting a
         #: scatter refill in _memo_serve
@@ -285,17 +286,21 @@ class IncrementalSession:
             if delta.changed_identities:
                 from cilium_tpu.engine.memo import affected_row_ids
 
-                # family-granular (bank-reference) narrowing: only
-                # rows whose own L7 family read a swapped bank refill
-                # — an HTTP-path bank swap keeps the same identity's
-                # DNS/kafka rows serving (PolicyDelta.affects)
+                # bank-reference narrowing: only rows whose own L7
+                # family AND entry port read a swapped bank refill —
+                # an HTTP-path bank swap on one port keeps the same
+                # identity's DNS/kafka rows AND its other ports'
+                # HTTP rows serving (PolicyDelta.affects)
                 pairs = self._row_eps[:self.memo.filled]
                 affected = affected_row_ids(
                     delta,
                     np.fromiter((p[0] for p in pairs),
                                 dtype=np.int64, count=len(pairs)),
                     np.fromiter((p[1] for p in pairs),
-                                dtype=np.int64, count=len(pairs)))
+                                dtype=np.int64, count=len(pairs)),
+                    dports=np.fromiter((p[2] for p in pairs),
+                                       dtype=np.int64,
+                                       count=len(pairs)))
                 if len(affected):
                     self.memo.partial_invalidate(len(affected),
                                                  delta.reason)
@@ -416,7 +421,8 @@ class IncrementalSession:
                 self.n_rows += 1
                 self._pending_rows.append(row.copy())
                 self._row_eps.append((int(row[0]),
-                                      int(row[_L7_COL])))
+                                      int(row[_L7_COL]),
+                                      int(row[_DPORT_COL])))
                 if chain is None:
                     self.row_ids[key] = [(row.tobytes(), rid)]
                 else:
@@ -442,7 +448,8 @@ class IncrementalSession:
                 self.n_rows += 1
                 self._pending_rows.append(row.copy())
                 self._row_eps.append((int(row[0]),
-                                      int(row[_L7_COL])))
+                                      int(row[_L7_COL]),
+                                      int(row[_DPORT_COL])))
                 chain.append((row.tobytes(), rid))
             lut[j] = rid
         return lut[inv].astype(np.int32)

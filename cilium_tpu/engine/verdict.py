@@ -305,9 +305,24 @@ class CompiledPolicy:
                 ruleset_gen.append(list(key[3]))
             return rid
 
+        # per-build memo keyed by the l7-rules tuple's OBJECT identity:
+        # at fleet scale (10k identities over ~hundreds of shared
+        # resolved MapStates) the same tuple reaches ruleset_of once
+        # per identity — walking its rules every time is the dominant
+        # per-update cost. The tuples stay alive for the whole build
+        # (their entries hold them), so id() keys cannot be recycled.
+        _ruleset_memo: Dict[int, int] = {}
+
+        def ruleset_of_entry(ep, key, entry):
+            rid = _ruleset_memo.get(id(entry.l7_rules))
+            if rid is None:
+                rid = ruleset_of(entry.l7_rules)
+                _ruleset_memo[id(entry.l7_rules)] = rid
+            return rid
+
         packed = pack_mapstate(
             per_identity,
-            ruleset_of_entry=lambda ep, key, entry: ruleset_of(entry.l7_rules),
+            ruleset_of_entry=ruleset_of_entry,
         )
 
         # -- compile field matchers -------------------------------------
@@ -383,7 +398,18 @@ class CompiledPolicy:
                                           field="dns")
 
         # -- per-rule lane arrays ---------------------------------------
-        Rh = max(1, len(http_rules))
+        # Rule-table row counts BUCKET past 64 (next multiple of 64):
+        # every staged array sized by a rule count keeps its shape
+        # across ±63 net rule adds, so incremental policy updates at
+        # fleet scale reuse the jitted step's compiled executable
+        # instead of paying an XLA recompile per update. Padded rows
+        # are inert three ways over: lanes are -1, membership masks
+        # never select them, and (for HTTP) the dead flag is set.
+        # Small policies (≤64 rules) keep exact shapes.
+        def _rbucket(n: int) -> int:
+            return max(1, n) if n <= 64 else -(-n // 64) * 64
+
+        Rh = _rbucket(len(http_rules))
         max_hdrs = max([len(p) for p in rule_header_lanes] + [1])
         max_logs = max([len(p) for p in rule_log_lanes] + [1])
         http_path_lane = np.full(Rh, -1, dtype=np.int32)
@@ -404,8 +430,9 @@ class CompiledPolicy:
             for j, pat in enumerate(rule_log_lanes[i]):
                 http_log_lanes[i, j] = header_matcher.lane(pat)
             http_rule_dead[i] = rule_dead[i]
+        http_rule_dead[len(http_rules):] = True   # padding is inert
 
-        Rk = max(1, len(kafka_rules))
+        Rk = _rbucket(len(kafka_rules))
         kafka_apikey_mask = np.zeros(Rk, dtype=np.uint32)   # 0 = any
         kafka_version = np.full(Rk, -1, dtype=np.int32)
         kafka_client = np.full(Rk, -1, dtype=np.int32)
@@ -424,7 +451,7 @@ class CompiledPolicy:
                 kafka_topic[i] = topic_intern.setdefault(
                     k.topic, len(topic_intern))
 
-        Rd = max(1, len(dns_rules))
+        Rd = _rbucket(len(dns_rules))
         dns_lane = np.full(Rd, -1, dtype=np.int32)
         for i in range(len(dns_rules)):
             dns_lane[i] = dns_matcher.lane(dns_pats[i])
@@ -441,7 +468,7 @@ class CompiledPolicy:
             for k, v in pairs:
                 gen_pair_intern.setdefault((proto, k, v),
                                            len(gen_pair_intern))
-        Rg = max(1, len(gen_rules))
+        Rg = _rbucket(len(gen_rules))
         gen_max_pairs = max([len(p) for _, p in gen_rules] + [1])
         gen_rule_proto = np.full(Rg, -1, dtype=np.int32)
         gen_rule_pairs = np.full((Rg, gen_max_pairs), -1, dtype=np.int32)
@@ -467,14 +494,13 @@ class CompiledPolicy:
             "ms_enf_flags": packed.enf_flags,
             "ms_plens": packed.port_plens,
             "ms_tmpl_ids": packed.tmpl_ids,
-            "rs_http_mask": _masks_to_array(http_members or [[]],
-                                            len(http_rules)),
+            # mask widths follow the BUCKETED rule counts so they
+            # shape-stabilize with the lane arrays (padded bits stay 0)
+            "rs_http_mask": _masks_to_array(http_members or [[]], Rh),
             "rs_kafka_mask": _masks_to_array(kafka_members or [[]],
-                                             len(kafka_rules)),
-            "rs_dns_mask": _masks_to_array(dns_members or [[]],
-                                           len(dns_rules)),
-            "rs_gen_mask": _masks_to_array(ruleset_gen or [[]],
-                                           len(gen_rules)),
+                                             Rk),
+            "rs_dns_mask": _masks_to_array(dns_members or [[]], Rd),
+            "rs_gen_mask": _masks_to_array(ruleset_gen or [[]], Rg),
             "gen_rule_proto": gen_rule_proto,
             "gen_rule_pairs": gen_rule_pairs,
             "http_path_lane": http_path_lane,
@@ -1626,6 +1652,7 @@ class VerdictEngine:
                 return inner(arrays, unpack_blob(batch, layout))
 
             fn = jax.jit(step)
+            # ctlint: disable=unbounded-registry  # keyed by bucketed blob layout (finite shape universe)
             self._blob_steps[layout] = fn
         return fn
 
@@ -1921,7 +1948,9 @@ class CaptureReplay:
             self._uniq_host[:self.n_unique,
                             _ROW_COLS.index("ep_ids")],
             self._uniq_host[:self.n_unique,
-                            _ROW_COLS.index("l7_types")])
+                            _ROW_COLS.index("l7_types")],
+            dports=self._uniq_host[:self.n_unique,
+                                   _ROW_COLS.index("dports")])
 
     def stage_rows(self, rec, l7) -> np.ndarray:
         """Featurize the WHOLE capture once, as part of session

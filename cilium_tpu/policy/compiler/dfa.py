@@ -171,6 +171,17 @@ class BankedDFA:
         S = max(b.n_states for b in self.banks)
         K = max(b.n_classes for b in self.banks)
         W = max(b.n_words for b in self.banks)
+        # state/class dims BUCKET past their floor (next multiple):
+        # one pattern added to the largest bank no longer changes the
+        # stacked shape, so incremental fleet updates reuse the jitted
+        # step's executable. Padded states self-loop to dead and
+        # padded classes are never emitted by byteclass — the same
+        # inertness argument as the per-bank padding below. Small
+        # policies keep exact shapes.
+        if S > 256:
+            S = -(-S // 256) * 256
+        if K > 64:
+            K = -(-K // 16) * 16
         trans = np.zeros((B, S, K), dtype=np.int32)
         byteclass = np.zeros((B, 256), dtype=np.int32)
         accept = np.zeros((B, S, W), dtype=np.uint32)

@@ -150,6 +150,7 @@ class MapState:
     def insert(self, key: MapStateKey, entry: MapStateEntry) -> None:
         cur = self.entries.get(key)
         if cur is None:
+            # ctlint: disable=unbounded-registry  # value object: lifetime is one resolved snapshot, size = its rule set
             self.entries[key] = entry
         else:
             cur.merge(entry)

@@ -78,6 +78,12 @@ FAULT_POINTS = (
     "kvstore.churn_storm",
     "serve.lease",
     "serve.ring_slot",
+    # ISSUE 13 — the fleet compile plane's fault surface: a worker
+    # dying mid-compile (retried with backoff; exhaustion quarantines
+    # with cover) and a lost/corrupt distributed bank artifact
+    # (degrades to a counted recompile)
+    "compile.worker",
+    "artifact.fetch",
 )
 
 #: breaker/quarantine timings the schedules steer around; small so
@@ -129,6 +135,7 @@ class SchedulePlan(faults.FaultPlan):
     def check(self, point: str) -> Optional[Exception]:
         with self._lock:
             idx = self._hits.get(point, 0)
+            # ctlint: disable=unbounded-registry  # keyed by registered fault points (finite)
             self._hits[point] = idx + 1
             left = self._budget.get(point, 0)
             if left <= 0:
@@ -169,6 +176,13 @@ class DSTWorld:
         cfg.loader.bank_quarantine_ttl_s = QUARANTINE_TTL_S
         cfg.breaker.failure_threshold = 2
         cfg.breaker.probe_interval = PROBE_INTERVAL_S
+        # ONE compile worker: the queue machinery (deadlines, backoff,
+        # priority pops, worker-death respawn) is fully armed, but
+        # per-bank fault ATTRIBUTION stays a pure function of the
+        # schedule — with N workers racing, WHICH bank an armed
+        # loader.bank_compile/compile.worker fault hits would depend
+        # on thread scheduling and byte-identical replay would break
+        cfg.compile.workers = 1
         self.cfg = cfg
         self.alloc = IdentityAllocator()
         self.web = self.alloc.allocate(LabelSet.from_dict({"app": "web"}))
@@ -220,8 +234,15 @@ class DSTWorld:
         self._serve_streams = 0
 
     def bank_compiles(self) -> int:
+        """Compile-or-fetch WORK units: with bank artifacts on, a
+        wholesale membership shift can serve from artifacts compiled
+        earlier in the same schedule — cheaper than recompiling, but
+        still O(policy) plan churn. The O(Δ) invariant bounds work
+        per change, so fetches count (a fetch-masked positional-banks
+        regression must still trip it — tests/dst/test_planted.py)."""
         reg = self.loader.bank_registry
-        return self._compiles_carry + (reg.compiles if reg else 0)
+        return self._compiles_carry + (
+            (reg.compiles + reg.artifact_hits) if reg else 0)
 
     # -- policy ----------------------------------------------------------
     def _resolve(self):
@@ -384,6 +405,50 @@ class DSTWorld:
                 f"(> {COMPILES_PER_CHANGE_BOUND}: membership shifted "
                 f"wholesale)")
         return {"op": op, "identity": i, "rolled_back": rolled_back,
+                "compiles": compiles,
+                "degraded": bool(self.loader.bank_status().get(
+                    "degraded"))}
+
+    def churn_burst(self, n: int, step: int) -> Dict:
+        """A churn STORM (ISSUE 13): ``n`` CNP pattern mutations land
+        between regenerations (the debounced-identity-storm shape),
+        then ONE regenerate drives the whole multi-bank delta through
+        the parallel compile queue. The O(Δ) accounting charges the
+        attempt ``n`` change-units, so the per-change compile bound
+        still holds — a storm may compile many banks, but only O(its
+        own size)."""
+        applied = 0
+        for k in range(n):
+            i = (step + k) % self.N_IDS
+            if k % 3 == 2:
+                extras = [(kk, p) for kk, p in self.rules_of[i]
+                          if "/storm" in p or "/churn" in p]
+                if extras:
+                    self.rules_of[i].remove(extras[0])
+                    applied += 1
+                    continue
+            self.rules_of[i].append(("http", f"/storm{step}k{k}/.*"))
+            applied += 1
+        self.revision += 1
+        rolled_back = False
+        reg = self.loader.bank_registry
+        warm_registry = bool(reg and reg.status()["groups"])
+        compiles_before = self.bank_compiles()
+        self.attempts += max(1, applied)
+        try:
+            self.loader.regenerate(self._resolve(),
+                                   revision=self.revision)
+        except Exception:
+            rolled_back = True
+        else:
+            self.committed = {j: list(v)
+                              for j, v in self.rules_of.items()}
+            self.changes += applied
+        compiles = self.bank_compiles() - compiles_before
+        if not warm_registry:
+            self.compiles0 += compiles
+            self.attempts -= max(1, applied)
+        return {"mutations": applied, "rolled_back": rolled_back,
                 "compiles": compiles,
                 "degraded": bool(self.loader.bank_status().get(
                     "degraded"))}
@@ -716,6 +781,7 @@ class DSTWorld:
                 crashed = type(e).__name__
             if restored:
                 self._compiles_carry = self.bank_compiles()
+                self.loader.close()   # old incarnation's workers die
                 self.loader = fresh
                 self.verdictor = ResilientVerdictor(
                     self.loader, breaker=self.breaker)
@@ -795,6 +861,7 @@ class DSTWorld:
 
     def close(self) -> None:
         self.cluster_alloc.close()
+        self.loader.close()
 
 
 def _digest(verdicts: Sequence[int]) -> str:
@@ -816,10 +883,18 @@ def generate(seed: int, max_events: int = 12) -> List[List]:
         if roll < 0.22:
             point = rng.choice(FAULT_POINTS)
             events.append(["fault", point, rng.randint(1, 3)])
-        elif roll < 0.40:
+        elif roll < 0.36:
             events.append(["churn",
                            rng.choice(["add", "add", "delete"]),
                            rng.randrange(DSTWorld.N_IDS)])
+        elif roll < 0.44:
+            # ISSUE 13: a churn STORM through the parallel compile
+            # queue — n mutations, one regenerate, O(Δ) still bounded.
+            # Sizes stay small: every net-new pattern grows the probe
+            # corpus, and each distinct corpus size re-traces the
+            # jitted step — a size-9 burst tripled the sweep's wall
+            # time for no extra invariant coverage.
+            events.append(["churn-burst", rng.randint(2, 5)])
         elif roll < 0.56:
             events.append(["traffic"])
         elif roll < 0.66:
@@ -877,6 +952,8 @@ def run_schedule(seed: int, events: Optional[List[List]] = None,
                         elif kind == "churn":
                             out = world.churn(ev[1], int(ev[2]) %
                                               DSTWorld.N_IDS, step=i)
+                        elif kind == "churn-burst":
+                            out = world.churn_burst(int(ev[1]), step=i)
                         elif kind == "traffic":
                             out = world.traffic(i)
                         elif kind == "serve":

@@ -80,45 +80,87 @@ _L7_FAMILIES = (("http", "http"), ("kafka", "kafka"), ("dns", "dns"),
                 ("generic", "l7"))
 
 
-def _identity_family_tuples(ms) -> Dict[str, tuple]:
+def _family_port_of(key) -> int:
+    """The bank-reference port bucket of one MapState entry key:
+    its exact dport for an exact-port entry, PORT_ALL for wildcard/
+    range entries (a row on ANY port may route through them)."""
+    from cilium_tpu.engine.memo import PORT_ALL
+
+    plen = getattr(key, "port_plen", None)
+    if plen is None:
+        plen = 0 if key.dport == 0 else 16
+    if key.dport == 0 or plen != 16:
+        return PORT_ALL
+    return int(key.dport)
+
+
+def _identity_family_tuples(ms) -> Dict[str, object]:
     """One identity's MapState, split into the independently-
     fingerprintable pieces a verdict reads: ``struct`` (keys, deny/
     auth/wildcard bits, enforcement flags, which entries carry L7
     rules at all — what EVERY row of the identity reads through the
-    mapstate gather) plus one tuple per rule family (what only rows of
-    that L7 type read, since every ``l7_ok`` contribution is gated on
-    ``l7t == family``). A path-bank swap moves only the ``http``
-    tuple, so DNS/kafka memo rows of the same identity keep serving."""
+    mapstate gather) plus, per rule family, a PER-PORT split of the
+    entries carrying that family's rules (what only rows of that L7
+    type AND that destination port read — a row reads a bank only
+    through its own entry's ruleset). A path-bank swap on port 8080
+    moves only the ``http``/8080 tuple, so the identity's DNS/kafka
+    rows — and its port-80 HTTP rows — keep serving."""
     struct = []
-    fam: Dict[str, list] = {name: [] for name, _ in _L7_FAMILIES}
+    fam: Dict[str, Dict[int, list]] = {name: {}
+                                       for name, _ in _L7_FAMILIES}
     for k, e in sorted(ms.entries.items(),
                        key=lambda kv: repr(kv[0])):
         key = (k.identity, k.dport, k.proto, k.direction, k.port_plen)
         struct.append((key, e.is_deny, e.l7_wildcard, e.auth_required,
                        bool(e.l7_rules)))
+        port = _family_port_of(k)
         for name, attr in _L7_FAMILIES:
             rules = tuple(sorted(
                 repr(r) for lr in e.l7_rules
                 for r in getattr(lr, attr)))
             if rules:
-                fam[name].append((key, rules))
-    out = {"struct": (tuple(struct), ms.ingress_enforced,
-                      ms.egress_enforced, getattr(ms, "audit", False))}
-    out.update({name: tuple(v) for name, v in fam.items()})
+                fam[name].setdefault(port, []).append((key, rules))
+    out: Dict[str, object] = {
+        "struct": (tuple(struct), ms.ingress_enforced,
+                   ms.egress_enforced, getattr(ms, "audit", False))}
+    out.update({name: {port: tuple(v) for port, v in ports.items()}
+                for name, ports in fam.items()})
     return out
 
 
 def identity_family_fingerprints(per_identity: Dict[int, "MapState"]
-                                 ) -> Dict[int, Dict[str, str]]:
-    """Per-identity per-family fingerprints: ``{identity: {"struct":
-    fp, "http": fp, "kafka": fp, "dns": fp, "generic": fp}}`` — the
-    inputs of the family-granular :class:`PolicyDelta` narrowing
-    (engine/memo.py). A commit whose only difference is one family's
-    rules produces a delta that refills ONLY that family's memo rows,
-    counted honestly as misses."""
-    return {ep: {name: ruleset_fingerprint(t)
-                 for name, t in _identity_family_tuples(ms).items()}
+                                 ) -> Dict[int, Dict[str, object]]:
+    """Per-identity per-family-per-port fingerprints: ``{identity:
+    {"struct": fp, "http": {port: fp, ...}, "kafka": {...}, "dns":
+    {...}, "generic": {...}}}`` — the inputs of the bank-reference
+    :class:`PolicyDelta` narrowing (engine/memo.py). A commit whose
+    only difference is one family's rules on one port produces a
+    delta that refills ONLY that family's rows on that port, counted
+    honestly as misses. Port :data:`~cilium_tpu.engine.memo.PORT_ALL`
+    buckets wildcard/range entries."""
+    return {ep: _family_fps_of_tuples(_identity_family_tuples(ms))
             for ep, ms in per_identity.items()}
+
+
+def _family_fps_of_tuples(tuples: Dict[str, object]
+                          ) -> Dict[str, object]:
+    out: Dict[str, object] = {}
+    for name, t in tuples.items():
+        if name == "struct":
+            out[name] = ruleset_fingerprint(t)
+        else:
+            out[name] = {port: ruleset_fingerprint(v)
+                         for port, v in t.items()}
+    return out
+
+
+def _identity_bundle(ms) -> tuple:
+    """(whole-identity fp, family/port fps) of one MapState in ONE
+    entry walk — the unit the sharded FingerprintStore caches so a
+    10k-identity regeneration fingerprints only the identities whose
+    resolved state actually changed."""
+    return (ruleset_fingerprint(_identity_entry_tuple(ms)),
+            _family_fps_of_tuples(_identity_family_tuples(ms)))
 
 
 def _referenced_secret_values(per_identity, secrets) -> tuple:
@@ -170,8 +212,11 @@ class Loader:
         #: walks it host-side for per-request header-rewrite ops (the
         #: winning entry's HTTP rules carry the mismatch actions)
         self.per_identity: Dict[int, MapState] = {}
-        self._cache = ArtifactCache(self.config.loader.cache_dir,
-                                    self.config.loader.enable_cache)
+        self._cache = ArtifactCache(
+            self.config.loader.cache_dir,
+            self.config.loader.enable_cache,
+            max_bytes=self.config.loader.artifact_cache_max_bytes)
+        self._cache.set_protected({WARM_STATE_KEY})
         # per-loader DFA bank cache: incremental rule updates recompile
         # only the banks whose pattern group changed (SURVEY §7 hard
         # part #4 — the reference stays O(Δ) via SelectorCache; our
@@ -179,14 +224,44 @@ class Loader:
         from cilium_tpu.policy.compiler.dfa import BankCache
 
         self.bank_cache = BankCache()
+        #: sharded per-identity fingerprint store: the 10k-identity
+        #: fingerprint walk is O(Δ) when the caller reuses unchanged
+        #: MapState objects (runtime/fingerprints.py)
+        from cilium_tpu.runtime.fingerprints import FingerprintStore
+
+        self._fp_store = FingerprintStore(
+            max_bytes=self.config.compile.fp_cache_max_bytes)
         # content-addressed bank registry (policy/compiler/bankplan):
         # the churn-proof compile path — content-defined partition, per-
         # bank quarantine, O(Δ) rebuilds. Supersedes bank_cache when on.
+        # The fleet-scale plane rides it: a parallel compile queue
+        # ([compile] workers > 0), byte-bounded registry shards, and
+        # distributable checksum-verified bank artifacts.
         if self.config.loader.bank_isolation:
             from cilium_tpu.policy.compiler.bankplan import BankRegistry
+            from cilium_tpu.policy.compiler.compilequeue import (
+                CompileQueue,
+            )
+            from cilium_tpu.runtime.checkpoint import BankArtifactStore
 
+            ccfg = self.config.compile
+            queue = None
+            if ccfg.workers > 0:
+                queue = CompileQueue(
+                    workers=ccfg.workers,
+                    deadline_s=ccfg.deadline_s,
+                    max_retries=ccfg.max_retries,
+                    backoff_base_s=ccfg.backoff_base_s,
+                    backoff_max_s=ccfg.backoff_max_s,
+                    max_pending=ccfg.max_pending)
+            artifacts = None
+            if ccfg.bank_artifacts and self.config.loader.enable_cache:
+                artifacts = BankArtifactStore(self._cache)
             self.bank_registry = BankRegistry(
-                quarantine_ttl_s=self.config.loader.bank_quarantine_ttl_s)
+                quarantine_ttl_s=self.config.loader.bank_quarantine_ttl_s,
+                max_bytes=ccfg.registry_max_bytes,
+                shards=ccfg.registry_shards,
+                queue=queue, artifacts=artifacts)
         else:
             self.bank_registry = None
         #: per-identity fingerprints + bank plan of the SERVING policy
@@ -319,6 +394,7 @@ class Loader:
                     # the schedule search can prove it catches it.
                     if not faults.mutation_active("rollback-artifact-key"):
                         self._last_artifact_key = prev[3]
+                        self._update_protected()
                     # ...and so do the delta inputs: fingerprints/plan
                     # of the ABORTED build must not seed the next
                     # commit's bank-scoped invalidation
@@ -395,8 +471,13 @@ class Loader:
         # artifacts.
         # The key is now derived from the per-identity fingerprints +
         # a globals fingerprint, so the SAME inputs also seed the
-        # bank-scoped invalidation delta.
-        fps = identity_fingerprints(per_identity)
+        # bank-scoped invalidation delta. Both fingerprint views come
+        # from ONE walk through the sharded store: identities whose
+        # resolved MapState object is unchanged since the last
+        # regeneration don't re-fingerprint (O(Δ) at 10k identities).
+        bundles = self._fp_store.bundle(per_identity, _identity_bundle)
+        fps = {ep: b[0] for ep, b in bundles.items()}
+        fam_fps_all = {ep: b[1] for ep, b in bundles.items()}
         globals_fp = ruleset_fingerprint(
             self.config.policy_audit_mode,
             repr(self.config.engine),
@@ -417,8 +498,7 @@ class Loader:
             # advance the revision, and tell memo owners NOTHING
             # changed — the add-then-delete case of the churn plane
             self._identity_fps = fps
-            self._identity_family_fps = \
-                identity_family_fingerprints(per_identity)
+            self._identity_family_fps = fam_fps_all
             return self._commit(serving_engine, revision, per_identity,
                                 "tpu", delta=PolicyDelta.none())
         policy = self._cache.get(key)
@@ -452,10 +532,11 @@ class Loader:
                                        cfg=self.config.engine)
         self._record_kernel_plan(policy, engine)
         new_plan = dict(getattr(policy, "bank_plan", {}) or {})
-        fam_fps = identity_family_fingerprints(per_identity)
+        fam_fps = fam_fps_all
         delta = self._delta_for(fps, globals_fp, new_plan,
                                 bool(quarantined), fam_fps)
         self._last_artifact_key = key if not quarantined else None
+        self._update_protected()
         self._identity_fps = fps
         self._identity_family_fps = fam_fps
         self._globals_fp = globals_fp
@@ -471,10 +552,12 @@ class Loader:
         state; conservative FULL whenever the serving state can't
         vouch for unchanged rows (first commit, globals change,
         quarantine involved on either side). With family fingerprints
-        on both sides the delta narrows to bank-REFERENCE granularity:
-        per changed identity, the (identity, family) pairs whose rule
-        family actually moved — FAMILY_ALL when the structural
-        MapState did."""
+        on both sides the delta narrows to true bank-REFERENCE
+        granularity: per changed identity, the (identity, family)
+        pairs whose rule family actually moved — FAMILY_ALL when the
+        structural MapState did — and, per moved family, the exact
+        ports whose entry rule sets changed (PORT_ALL for wildcard/
+        range entries)."""
         from cilium_tpu.engine.memo import FAMILY_ALL, PolicyDelta
 
         changed_banks = set()
@@ -493,6 +576,7 @@ class Loader:
         changed_ids = {ep for ep in set(prev_fps) | set(fps)
                        if prev_fps.get(ep) != fps.get(ep)}
         families: set = set()
+        family_ports: set = set()
         prev_fams = self._identity_family_fps
         if prev_fams is not None and fam_fps is not None:
             for ep in changed_ids:
@@ -507,14 +591,25 @@ class Loader:
                          if name != "struct"
                          and old_f.get(name) != new_f.get(name)]
                 if moved:
-                    families.update((ep, name) for name in moved)
+                    for name in moved:
+                        families.add((ep, name))
+                        # bank-reference narrowing: the exact entry
+                        # ports whose rule sets moved (symmetric diff
+                        # of the per-port fingerprints — non-empty by
+                        # construction when the family dict differs)
+                        oldp = old_f.get(name) or {}
+                        newp = new_f.get(name) or {}
+                        for port in set(oldp) | set(newp):
+                            if oldp.get(port) != newp.get(port):
+                                family_ports.add((ep, name, port))
                 else:
                     # whole-identity fp moved but neither struct nor
                     # any family tuple did (fingerprint formulation
                     # drift): never narrow past what we can prove
                     families.add((ep, FAMILY_ALL))
         return PolicyDelta.banks(changed_ids, changed_banks,
-                                 identity_families=families)
+                                 identity_families=families,
+                                 identity_family_ports=family_ports)
 
     def _record_kernel_plan(self, policy, engine) -> None:
         """Push the staged engine's per-bank kernel picks into the
@@ -532,6 +627,31 @@ class Loader:
             for key in getattr(policy, "bank_plan", {}).get(field, ()):
                 self.bank_registry.kernel_picks[key] = impl
 
+    def _update_protected(self) -> None:
+        """Keep the byte-bounded artifact cache's eviction-exempt set
+        pointing at what we actually serve: the active compiled
+        policy's artifact + the warm-restart snapshot."""
+        self._cache.set_protected(
+            {self._last_artifact_key, WARM_STATE_KEY})
+
+    def kick_expired_bank_rebuilds(self) -> int:
+        """Proactively re-submit expired-quarantine banks at
+        BACKGROUND priority through the compile queue (the repair
+        compiles between regenerations, off the serving critical
+        path). Returns the number submitted; 0 when the fleet compile
+        plane is off."""
+        if self.bank_registry is None:
+            return 0
+        return self.bank_registry.kick_expired_rebuilds()
+
+    def close(self) -> None:
+        """Tear down the owned compile plane (worker threads). The
+        loader stays queryable — only background compiles stop; tests
+        and the DST harness call this when replacing a loader so
+        abandoned workers never outlive their world."""
+        if self.bank_registry is not None:
+            self.bank_registry.close()
+
     def bank_status(self) -> Dict[str, object]:
         """Bank registry + serving-plan snapshot (the service `status`
         op's churn-plane face)."""
@@ -542,6 +662,7 @@ class Loader:
         out.update(self.bank_registry.status())
         out["plan"] = {f: len(k) for f, k in self._bank_plan.items()}
         out["kernel_plan"] = dict(getattr(self, "_kernel_plan", {}))
+        out["fp_store"] = self._fp_store.status()
         return out
 
     # -- warm restart -----------------------------------------------------
@@ -645,6 +766,7 @@ class Loader:
                     if self._globals_fp is not None \
                     else PolicyDelta(full=True)
                 self._last_artifact_key = key
+                self._update_protected()
                 self._identity_fps = fps
                 self._identity_family_fps = fam_fps
                 self._bank_plan = new_plan

@@ -113,6 +113,49 @@ BANK_QUARANTINED = "cilium_tpu_bank_quarantined_total"
 #: revision (new content-addressed key), by field
 BANK_HOTSWAPS = "cilium_tpu_bank_hotswaps_total"
 
+# -- fleet-scale compile plane (policy/compiler/compilequeue.py +
+# runtime/checkpoint.py bank artifacts + the sharded registry/
+# fingerprint stores): the parallel bank-compile work queue's
+# lifecycle ledger, the artifact-distribution fetch results, and the
+# byte-bound eviction counters.
+#: compile tasks submitted to the work queue, by priority class
+#: (serving = delta compiles blocking a regeneration; background =
+#: proactive quarantine-TTL rebuilds)
+COMPILE_QUEUE_SUBMITTED = "cilium_tpu_compile_queue_submitted_total"
+#: submits coalesced onto an in-flight task with the same work key
+#: (content-addressed dedup: N racing compilers, one compile)
+COMPILE_QUEUE_DEDUP = "cilium_tpu_compile_queue_dedup_total"
+#: tasks completed (success or permanent failure)
+COMPILE_QUEUE_COMPLETED = "cilium_tpu_compile_queue_completed_total"
+#: in-queue retries (worker death re-queues with backoff)
+COMPILE_QUEUE_RETRIES = "cilium_tpu_compile_queue_retries_total"
+#: serving-blocking waits that hit the per-bank compile deadline
+#: (the bank serves its cover; the compile finishes in background)
+COMPILE_DEADLINE_LAPSES = "cilium_tpu_compile_deadline_lapses_total"
+#: worker threads killed by the ``compile.worker`` fault point (or a
+#: crash in the pool machinery); the pool respawns
+COMPILE_WORKER_DEATHS = "cilium_tpu_compile_worker_deaths_total"
+#: gauge: pending + running compile tasks (bounded by
+#: ``[compile] max_pending``)
+COMPILE_QUEUE_DEPTH = "cilium_tpu_compile_queue_depth"
+#: compile results that landed AFTER their serving-blocking waiter
+#: lapsed (stored for the next regeneration — work never wasted)
+COMPILE_LATE_RESULTS = "cilium_tpu_compile_late_results_total"
+#: banks served from their last-good cover while their compile was
+#: still PENDING in the queue (deadline lapse, not quarantine)
+BANK_PENDING_SERVES = "cilium_tpu_bank_pending_serves_total"
+#: compiled-bank artifact fetches, by result (hit / miss / corrupt —
+#: a corrupt or faulted fetch degrades to recompile, never a crash)
+BANK_ARTIFACT_FETCHES = "cilium_tpu_bank_artifact_fetches_total"
+#: bank groups evicted from the byte-bounded registry shards
+REGISTRY_SHARD_EVICTIONS = "cilium_tpu_registry_shard_evictions_total"
+#: identity-fingerprint bundles evicted from the sharded store
+#: (recomputed on next regeneration — cost, never correctness)
+FP_CACHE_EVICTIONS = "cilium_tpu_fp_cache_evictions_total"
+#: on-disk artifact-cache entries evicted by the byte-bound LRU
+#: (the serving artifact and warm snapshot are protected)
+ARTIFACT_CACHE_EVICTIONS = "cilium_tpu_artifact_cache_evictions_total"
+
 # -- continuously-batched serving loop (runtime/serveloop.py +
 # engine/ring.py): persistent verdict ring, stream slot leases, and
 # the memo-bypass selective-copy accounting.
@@ -198,8 +241,10 @@ class Metrics:
         """Register HELP text (and, for histograms, explicit bucket
         boundaries) for a metric family."""
         with self._lock:
+            # ctlint: disable=unbounded-registry  # bounded by declared metric families (metric-registry enforces the catalog)
             self._help[name] = help_text
             if buckets is not None:
+                # ctlint: disable=unbounded-registry  # one entry per declared histogram family
                 self._buckets[name] = tuple(sorted(float(b)
                                                    for b in buckets))
 
@@ -217,11 +262,13 @@ class Metrics:
             labels: Optional[Dict[str, str]] = None) -> None:
         k = self._key(name, labels)
         with self._lock:
+            # ctlint: disable=unbounded-registry  # keyed by declared family x finite label enums
             self._counters[k] = self._counters.get(k, 0.0) + value
 
     def set_gauge(self, name: str, value: float,
                   labels: Optional[Dict[str, str]] = None) -> None:
         with self._lock:
+            # ctlint: disable=unbounded-registry  # keyed by declared family x finite label enums
             self._gauges[self._key(name, labels)] = value
 
     def observe(self, name: str, value: float,
@@ -619,6 +666,44 @@ METRICS.describe(BANK_QUARANTINED,
 METRICS.describe(BANK_HOTSWAPS,
                  "bank groups hot-swapped by a committed revision, "
                  "by field")
+METRICS.describe(COMPILE_QUEUE_SUBMITTED,
+                 "bank-compile tasks submitted, by priority class "
+                 "(serving / background)")
+METRICS.describe(COMPILE_QUEUE_DEDUP,
+                 "compile submits coalesced onto an in-flight task "
+                 "with the same work key")
+METRICS.describe(COMPILE_QUEUE_COMPLETED,
+                 "compile tasks completed (success or permanent "
+                 "failure)")
+METRICS.describe(COMPILE_QUEUE_RETRIES,
+                 "in-queue compile retries (worker-death backoff "
+                 "re-queues)")
+METRICS.describe(COMPILE_DEADLINE_LAPSES,
+                 "serving-blocking compile waits that hit the "
+                 "per-bank deadline (bank rides its cover)")
+METRICS.describe(COMPILE_WORKER_DEATHS,
+                 "compile worker threads that died mid-task (pool "
+                 "respawns)")
+METRICS.describe(COMPILE_QUEUE_DEPTH,
+                 "pending + running compile tasks in the work queue")
+METRICS.describe(COMPILE_LATE_RESULTS,
+                 "compile results stored after their waiter's "
+                 "deadline lapsed")
+METRICS.describe(BANK_PENDING_SERVES,
+                 "banks served from their last-good cover while "
+                 "their compile was still pending")
+METRICS.describe(BANK_ARTIFACT_FETCHES,
+                 "compiled-bank artifact fetches, by result "
+                 "(hit / miss / corrupt)")
+METRICS.describe(REGISTRY_SHARD_EVICTIONS,
+                 "bank groups evicted from the byte-bounded registry "
+                 "shards")
+METRICS.describe(FP_CACHE_EVICTIONS,
+                 "identity-fingerprint bundles evicted from the "
+                 "sharded store")
+METRICS.describe(ARTIFACT_CACHE_EVICTIONS,
+                 "artifact-cache entries evicted by the byte-bound "
+                 "LRU (serving + warm keys protected)")
 METRICS.describe(KERNEL_AUTOTUNE_PICKS,
                  "megakernel scan-impl autotune decisions, by impl "
                  "and field")

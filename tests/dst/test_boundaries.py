@@ -359,3 +359,221 @@ def test_acquire_credit_times_out_on_virtual_clock_without_grant():
         clk.advance(30.1)
         t.join(timeout=5.0)
         assert boom == [True]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 13: fleet compile-plane boundaries — per-bank deadline lapse
+# at the exact virtual tick, worker-death retry exhaustion reaching
+# quarantine-with-cover, and drain-while-compiling.
+
+
+def _queued_registry(workers=1, deadline_s=10.0, max_retries=1,
+                     backoff_base_s=0.5):
+    from cilium_tpu.core.config import EngineConfig
+    from cilium_tpu.policy.compiler.bankplan import BankRegistry
+    from cilium_tpu.policy.compiler.compilequeue import CompileQueue
+
+    cfg = EngineConfig()
+    cfg.bank_size = 4
+    q = CompileQueue(workers=workers, deadline_s=deadline_s,
+                     max_retries=max_retries,
+                     backoff_base_s=backoff_base_s)
+    return BankRegistry(queue=q), cfg
+
+
+def test_compile_deadline_lapses_at_the_exact_tick_serves_cover(
+        tmp_path):
+    """A bank compile still in flight at EXACTLY its deadline tick
+    stops blocking the build: the bank is PENDING (cover for covered
+    patterns, fail-closed dead bank for the rest — never an abort),
+    and the late completion lands in the registry so the NEXT build
+    reuses it with zero compiles."""
+    import time as _time
+
+    clk = VirtualClock(start=100.0)
+    with simclock.use(clk):
+        reg, cfg = _queued_registry(deadline_s=10.0)
+        try:
+            gate = threading.Event()
+            orig = reg._compile_group
+
+            def slow(group, opts):
+                gate.wait(5.0)       # REAL stall: worker busy
+                return orig(group, opts)
+
+            reg._compile_group = slow
+            pats = ["/d1/.*", "/d2/.*"]
+            out = {}
+
+            def build():
+                out["res"] = reg.compile_field("path", pats, cfg)
+
+            th = threading.Thread(target=build)
+            th.start()
+            # the waiter must park on the virtual heap first
+            for _ in range(400):
+                if clk._heap:
+                    break
+                _time.sleep(0.005)
+            clk.advance_to(110.0)            # the EXACT deadline tick
+            th.join(timeout=10.0)
+            banked, stats = out["res"]
+            assert stats.pending, "exact-tick lapse must mark pending"
+            assert stats.quarantined == stats.pending
+            assert reg.pending_serves == 1
+            # no prior cover: patterns fail CLOSED via a dead bank
+            assert len(banked.patterns) == len(pats)
+            gate.set()
+            for _ in range(400):
+                if not reg._pending_keys:
+                    break
+                _time.sleep(0.005)
+            assert not reg._pending_keys, "late result did not land"
+            _, s2 = reg.compile_field("path", pats, cfg)
+            assert not s2.quarantined and s2.rebuilt == ()
+            assert s2.reused == len(s2.bank_keys)
+        finally:
+            reg.close()
+
+
+def test_worker_death_backoff_gates_on_the_exact_virtual_tick():
+    """The in-queue retry's backoff gate is virtual: one tick before
+    ``not_before`` the retry does not run; AT the tick it does. (The
+    gate also carries a REAL-time release valve so a blocked DST
+    driver can't deadlock on it — the base here is large enough that
+    only the virtual release is in play within this test's window.)"""
+    import time as _time
+
+    from cilium_tpu.runtime import faults
+
+    clk = VirtualClock(start=0.0)
+    with simclock.use(clk):
+        from cilium_tpu.policy.compiler.compilequeue import CompileQueue
+
+        q = CompileQueue(workers=1, backoff_base_s=5.0, max_retries=3)
+        try:
+            with faults.inject(faults.FaultPlan(
+                    [faults.FaultRule("compile.worker", times=1)])):
+                t = q.submit("k", lambda: "ok")
+                # death happens promptly (real time); the retry then
+                # parks until now + backoff on the VIRTUAL clock
+                for _ in range(400):
+                    if q.worker_deaths == 1:
+                        break
+                    _time.sleep(0.005)
+                assert q.worker_deaths == 1
+                nb = t.not_before
+                assert nb > clk.now()
+                clk.advance_to(nb - 0.001)
+                _time.sleep(0.1)
+                assert not t.done, "retry ran BEFORE its backoff gate"
+                clk.advance_to(nb)           # the exact tick
+                assert q.wait(t, timeout=30.0)
+                assert t.result == "ok"
+        finally:
+            q.close()
+
+
+def test_worker_death_exhaustion_reaches_quarantine_with_cover():
+    """Retry exhaustion under virtual time: every retry consumed by a
+    death leaves the bank quarantined; previously-compiled patterns
+    ride their cover, new ones fail closed — the fail-closed pin of
+    the ISSUE-13 acceptance."""
+    import time as _time
+
+    from cilium_tpu.core.flow import Verdict  # noqa: F401 — doc anchor
+    from cilium_tpu.runtime import faults
+
+    clk = VirtualClock(start=0.0)
+    with simclock.use(clk):
+        # a LONG deadline so the pending-lapse path cannot preempt the
+        # exhaustion path; the retries release through the gate's
+        # real-time valve (exactly how a blocked DST driver survives)
+        reg, cfg = _queued_registry(max_retries=1, backoff_base_s=0.1,
+                                    deadline_s=1000.0)
+        try:
+            pats = [f"/w{i}/.*" for i in range(4)]
+            _, s0 = reg.compile_field("path", pats, cfg)
+            assert not s0.quarantined
+            grown = pats + ["/w-new/.*"]
+            with faults.inject(faults.FaultPlan(
+                    [faults.FaultRule("compile.worker", times=10)])):
+                out = {}
+
+                def build():
+                    out["res"] = reg.compile_field("path", grown, cfg)
+
+                th = threading.Thread(target=build)
+                th.start()
+                th.join(timeout=30.0)
+            assert "res" in out, "build wedged on the backoff gate"
+            banked, s1 = out["res"]
+            assert s1.quarantined, "exhaustion must quarantine"
+            assert not s1.pending, "exhaustion, not a deadline lapse"
+            assert reg._quarantine
+            # the changed bank's patterns: covered ones ride the old
+            # cover, the new one binds to a lane (dead bank or cover)
+            assert len(banked.patterns) == len(grown)
+        finally:
+            reg.close()
+
+
+def test_drain_while_compiling_completes_inflight_and_stores(
+        tmp_path):
+    """Drain racing an in-flight bank compile: the compile finishes,
+    its result lands in the registry (and the artifact store), and
+    the drained queue refuses new work instead of buffering it."""
+    import time as _time
+
+    import pytest as _pytest
+
+    from cilium_tpu.policy.compiler.compilequeue import QueueDraining
+    from cilium_tpu.runtime.checkpoint import (
+        ArtifactCache,
+        BankArtifactStore,
+    )
+
+    clk = VirtualClock(start=0.0)
+    with simclock.use(clk):
+        from cilium_tpu.core.config import EngineConfig
+        from cilium_tpu.policy.compiler.bankplan import BankRegistry
+        from cilium_tpu.policy.compiler.compilequeue import CompileQueue
+
+        cfg = EngineConfig()
+        cfg.bank_size = 4
+        q = CompileQueue(workers=1, deadline_s=30.0)
+        store = BankArtifactStore(ArtifactCache(str(tmp_path)))
+        reg = BankRegistry(queue=q, artifacts=store)
+        gate = threading.Event()
+        orig = reg._compile_group
+
+        def slow(group, opts):
+            gate.wait(5.0)
+            return orig(group, opts)
+
+        reg._compile_group = slow
+        pats = ["/dr1/.*"]
+        out = {}
+        th = threading.Thread(
+            target=lambda: out.update(
+                res=reg.compile_field("path", pats, cfg)))
+        th.start()
+        _time.sleep(0.05)                    # compile is in flight
+        drained = {}
+        dth = threading.Thread(
+            target=lambda: drained.update(ok=q.drain(timeout=60.0)))
+        dth.start()
+        _time.sleep(0.05)
+        gate.set()                           # the compile completes
+        dth.join(timeout=10.0)
+        th.join(timeout=10.0)
+        assert drained["ok"] is True
+        _, s = out["res"]
+        assert s.rebuilt and not s.quarantined, \
+            "drain abandoned an in-flight compile"
+        assert reg._group_count() == len(s.bank_keys)
+        with _pytest.raises(QueueDraining):
+            q.submit("post-drain", lambda: None)
+        # ...and the artifact was published before the drain finished
+        assert store.fetch(s.rebuilt[0]) is not None
+        reg.close()

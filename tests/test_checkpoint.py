@@ -81,6 +81,148 @@ def test_bank_keys_stable_across_processes():
     assert len(keys) >= 3 and len(set(keys)) == len(keys)
 
 
+def test_compile_work_keys_and_shard_placement_stable_across_seeds():
+    """Fleet-scale addressing (ISSUE 13): compile-queue work keys AND
+    registry shard placement must be pure functions of the bank key —
+    cross-process-stable under any PYTHONHASHSEED — or two hosts of a
+    fleet would disagree on which compile dedups with which and where
+    a bank lives."""
+    code = (
+        "from cilium_tpu.policy.compiler.bankplan import ("
+        "bank_key, partition_patterns, registry_shard_of)\n"
+        "from cilium_tpu.policy.compiler.compilequeue import work_key\n"
+        "pats = [f'/fleet/{i}/.*' for i in range(40)]\n"
+        "opts = (8192, 64, False)\n"
+        "keys = [bank_key(g, opts)"
+        " for g in partition_patterns(pats, 8)]\n"
+        "print(';'.join(f'{work_key(k)}:{registry_shard_of(k, 8)}'"
+        " for k in keys))")
+    outs = []
+    for seed in ("0", "1", "random"):
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=120,
+            cwd=REPO_ROOT,
+            env=dict(os.environ, PYTHONHASHSEED=seed,
+                     JAX_PLATFORMS="cpu"))
+        assert out.returncode == 0, out.stderr
+        outs.append(out.stdout.strip())
+    assert outs[0] and outs[0] == outs[1] == outs[2]
+    pairs = outs[0].split(";")
+    assert len(pairs) >= 3
+    wkeys = [p.split(":")[0] for p in pairs]
+    assert len(set(wkeys)) == len(wkeys)
+    shards = {int(p.split(":")[1]) for p in pairs}
+    assert all(0 <= s < 8 for s in shards)
+
+
+def test_eight_worker_same_bank_key_race_single_registry_insert():
+    """Eight threads compiling the SAME content-addressed bank set
+    through one queue-backed registry: the work-key dedup must
+    produce exactly one registry insert (and one compile) per key."""
+    from cilium_tpu.core.config import EngineConfig
+    from cilium_tpu.policy.compiler.bankplan import BankRegistry
+    from cilium_tpu.policy.compiler.compilequeue import CompileQueue
+
+    cfg = EngineConfig()
+    cfg.bank_size = 4
+    pats = [f"/race/{i}/.*" for i in range(12)]
+    queue = CompileQueue(workers=8, deadline_s=30.0)
+    reg = BankRegistry(queue=queue)
+    start = threading.Barrier(8)
+    stats, errors = [], []
+
+    def racer():
+        try:
+            start.wait()
+            _, s = reg.compile_field("path", pats, cfg)
+            stats.append(s)
+        except Exception as e:  # noqa: BLE001 — fail the test loudly
+            errors.append(e)
+
+    threads = [threading.Thread(target=racer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    try:
+        assert not errors, errors
+        assert len(stats) == 8
+        keys = stats[0].bank_keys
+        assert all(s.bank_keys == keys for s in stats)
+        # exactly one insert (and one compile) per content key
+        assert reg.compiles == len(keys), (reg.compiles, len(keys))
+        assert reg._group_count() == len(keys)
+        assert queue.dedup_hits >= 0   # racers that lost the submit
+        assert not stats[0].quarantined
+    finally:
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
+# Byte-bound LRU (ISSUE 13)
+
+
+def test_artifact_cache_byte_bound_evicts_lru_and_counts(tmp_path):
+    from cilium_tpu.runtime.metrics import (
+        ARTIFACT_CACHE_EVICTIONS,
+        METRICS,
+    )
+
+    payload = {"blob": list(range(4000))}   # ~20KB pickled
+    cache = ArtifactCache(str(tmp_path), max_bytes=70 << 10)
+    before = METRICS.get(ARTIFACT_CACHE_EVICTIONS)
+    for i in range(8):
+        cache.put(f"k{i}", payload)
+    assert cache.total_bytes() <= 70 << 10
+    assert cache.evictions > 0
+    assert METRICS.get(ARTIFACT_CACHE_EVICTIONS) - before \
+        == cache.evictions
+    # oldest evicted first, newest retained
+    assert cache.get("k7") is not None
+    assert cache.get("k0") is None
+
+
+def test_artifact_cache_protected_keys_never_evicted(tmp_path):
+    payload = {"blob": list(range(4000))}
+    cache = ArtifactCache(str(tmp_path), max_bytes=70 << 10)
+    cache.put("serving", payload)
+    cache.set_protected({"serving"})
+    for i in range(12):
+        cache.put(f"churn{i}", payload)
+    assert cache.get("serving") == payload, \
+        "evicting the currently-serving key is forbidden"
+    assert cache.evictions > 0
+
+
+def test_artifact_cache_lru_order_survives_restart(tmp_path):
+    """A fresh process seeds its LRU from file mtimes: the PREVIOUS
+    incarnation's least-recently-written entries evict first."""
+    import time as _time
+
+    payload = {"blob": list(range(4000))}
+    warm = ArtifactCache(str(tmp_path), max_bytes=1 << 30)
+    warm.put("old", payload)
+    one = warm.total_bytes()
+    _time.sleep(0.02)
+    warm.put("new", payload)
+    # room for two entries + slack, not three: the restart's put must
+    # evict exactly the oldest-mtime survivor
+    fresh = ArtifactCache(str(tmp_path), max_bytes=int(2.5 * one))
+    fresh.put("extra", payload)             # forces a scan + evict
+    assert fresh.get("extra") is not None
+    assert fresh.get("new") is not None
+    assert fresh.get("old") is None, "mtime-LRU should evict oldest"
+
+
+def test_artifact_cache_unbounded_when_zero(tmp_path):
+    cache = ArtifactCache(str(tmp_path), max_bytes=0)
+    for i in range(6):
+        cache.put(f"k{i}", {"blob": list(range(4000))})
+    assert cache.evictions == 0
+    assert all(cache.get(f"k{i}") is not None for i in range(6))
+
+
 # ---------------------------------------------------------------------------
 # Corrupt entries
 

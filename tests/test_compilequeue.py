@@ -1,0 +1,463 @@
+"""policy/compiler/compilequeue.py: the fleet-scale bank-compile work
+queue — priority classes, work-key dedup, worker-death retry with
+backoff, deadline lapse, bounded in-flight, drain — plus its
+integration with the sharded BankRegistry (pending→cover, late
+results, artifact fetch, TTL escalation)."""
+
+import threading
+import time
+
+import pytest
+
+from cilium_tpu.core.config import EngineConfig
+from cilium_tpu.policy.compiler.bankplan import (
+    BankRegistry,
+    bank_key,
+    partition_patterns,
+    registry_shard_of,
+)
+from cilium_tpu.policy.compiler.compilequeue import (
+    PRIO_BACKGROUND,
+    PRIO_SERVING,
+    CompileQueue,
+    QueueDraining,
+    WorkerDied,
+    work_key,
+)
+from cilium_tpu.runtime import faults, simclock
+from cilium_tpu.runtime.checkpoint import (
+    ArtifactCache,
+    BankArtifactStore,
+)
+
+
+def _cfg(bank_size=4):
+    cfg = EngineConfig()
+    cfg.bank_size = bank_size
+    return cfg
+
+
+def _queue(**kw):
+    kw.setdefault("workers", 2)
+    kw.setdefault("deadline_s", 5.0)
+    return CompileQueue(**kw)
+
+
+# ---------------------------------------------------------------------------
+# queue mechanics
+
+
+def test_submit_wait_roundtrip():
+    q = _queue()
+    try:
+        t = q.submit("k1", lambda: 41 + 1)
+        assert q.wait(t, timeout=10.0)
+        assert t.error is None and t.result == 42
+    finally:
+        q.close()
+
+
+def test_work_key_dedup_single_execution():
+    """N racing submitters of one content key → ONE execution; every
+    waiter observes the one result."""
+    q = _queue(workers=4)
+    runs = []
+    done = threading.Barrier(9)
+    tasks = []
+    lock = threading.Lock()
+
+    def fn():
+        runs.append(1)
+        time.sleep(0.05)          # hold the task in flight
+        return "compiled"
+
+    def submitter():
+        done.wait()
+        t = q.submit("hot", fn)
+        with lock:
+            tasks.append(t)
+
+    threads = [threading.Thread(target=submitter) for _ in range(8)]
+    for t in threads:
+        t.start()
+    done.wait()
+    for t in threads:
+        t.join(timeout=10)
+    try:
+        assert len(tasks) == 8
+        for t in tasks:
+            assert q.wait(t, timeout=10.0) and t.result == "compiled"
+        assert len(runs) == 1, "dedup failed: same key ran twice"
+        assert q.dedup_hits == 7
+    finally:
+        q.close()
+
+
+def test_priority_serving_pops_before_background():
+    """With one worker held busy, a serving task submitted AFTER a
+    pile of background tasks still runs before them."""
+    order = []
+    gate = threading.Event()
+    q = _queue(workers=1)
+    try:
+        q.submit("hold", lambda: (gate.wait(5), order.append("hold")))
+        for i in range(3):
+            q.submit(f"bg{i}", (lambda i=i: order.append(f"bg{i}")),
+                     prio=PRIO_BACKGROUND)
+        ts = q.submit("urgent", lambda: order.append("serving"),
+                      prio=PRIO_SERVING)
+        gate.set()
+        assert q.wait(ts, timeout=10.0)
+        assert order.index("serving") == 1, order   # right after hold
+    finally:
+        q.close()
+
+
+def test_worker_death_retries_then_succeeds_and_respawns():
+    """An armed compile.worker fault kills the worker mid-task: the
+    task re-queues with backoff and succeeds on retry; the pool
+    respawned (the next task still runs)."""
+    q = _queue(workers=1, backoff_base_s=0.01)
+    try:
+        with faults.inject(faults.FaultPlan(
+                [faults.FaultRule("compile.worker", times=1)])):
+            t = q.submit("k", lambda: "ok")
+            assert q.wait(t, timeout=10.0)
+            assert t.error is None and t.result == "ok"
+            assert q.worker_deaths == 1 and q.retries == 1
+            t2 = q.submit("k2", lambda: "still alive")
+            assert q.wait(t2, timeout=10.0) and t2.result == "still alive"
+    finally:
+        q.close()
+
+
+def test_worker_death_exhaustion_fails_task():
+    q = _queue(workers=1, max_retries=2, backoff_base_s=0.01)
+    try:
+        with faults.inject(faults.FaultPlan(
+                [faults.FaultRule("compile.worker", times=10)])):
+            t = q.submit("doomed", lambda: "never")
+            assert q.wait(t, timeout=10.0)
+            assert isinstance(t.error, WorkerDied)
+    finally:
+        q.close()
+
+
+def test_compile_exception_fails_immediately_no_retry():
+    q = _queue(workers=1)
+    try:
+        calls = []
+
+        def bad():
+            calls.append(1)
+            raise ValueError("bad pattern")
+
+        t = q.submit("bad", bad)
+        assert q.wait(t, timeout=10.0)
+        assert isinstance(t.error, ValueError)
+        assert len(calls) == 1, "deterministic failure was retried"
+        assert q.retries == 0
+    finally:
+        q.close()
+
+
+def test_deadline_lapse_under_virtual_time_exact_tick():
+    """A compile still in flight at EXACTLY the deadline tick lapses
+    the waiter (cover serves); the late completion is stored and
+    counted."""
+    clock = simclock.VirtualClock()
+    with simclock.use(clock):
+        q = CompileQueue(workers=1, deadline_s=10.0)
+        release = threading.Event()
+
+        def slow():
+            release.wait(5.0)     # real wait: worker busy, no virtual
+            return "late"
+
+        t = q.submit("slow", slow)
+        waiter_done = []
+
+        def waiter():
+            waiter_done.append(q.wait(t))
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        deadline = t.deadline
+        for _ in range(200):      # the waiter must park first
+            if clock._heap:
+                break
+            time.sleep(0.005)
+        clock.advance_to(deadline)           # the EXACT tick
+        th.join(timeout=5.0)
+        assert waiter_done == [False], "exact-tick deadline must lapse"
+        assert q.deadline_lapses == 1
+        release.set()
+        for _ in range(400):
+            if t.done:
+                break
+            time.sleep(0.005)
+        assert t.done and t.result == "late"
+        assert q.late_results == 1
+        q.close()
+
+
+def test_bounded_pending_blocks_producer():
+    q = CompileQueue(workers=1, max_pending=2, deadline_s=5.0)
+    gate = threading.Event()
+    try:
+        q.submit("a", lambda: gate.wait(5))
+        q.submit("b", lambda: None)
+        state = {"submitted": False}
+
+        def third():
+            q.submit("c", lambda: None)
+            state["submitted"] = True
+
+        th = threading.Thread(target=third)
+        th.start()
+        time.sleep(0.1)
+        assert not state["submitted"], \
+            "submit did not block at max_pending"
+        gate.set()
+        th.join(timeout=5.0)
+        assert state["submitted"]
+    finally:
+        q.close()
+
+
+def test_drain_while_compiling_finishes_inflight_then_refuses():
+    """The drain-while-compiling boundary: a task running at drain
+    time completes and its result lands; new submits refuse."""
+    q = _queue(workers=1)
+    gate = threading.Event()
+    t = q.submit("inflight", lambda: (gate.wait(5), "done")[1])
+    th = threading.Thread(target=lambda: q.drain(timeout=30.0))
+    th.start()
+    time.sleep(0.05)
+    gate.set()
+    th.join(timeout=10.0)
+    assert t.done and t.result == "done"
+    with pytest.raises(QueueDraining):
+        q.submit("new", lambda: None)
+    q.close()
+
+
+def test_close_fails_pending_tasks_loudly():
+    q = _queue(workers=1)
+    gate = threading.Event()
+    q.submit("hold", lambda: gate.wait(5))
+    t = q.submit("queued", lambda: "never ran")
+    q.close()
+    gate.set()
+    assert q.wait(t, timeout=5.0)
+    assert isinstance(t.error, QueueDraining)
+
+
+# ---------------------------------------------------------------------------
+# registry integration
+
+
+def test_registry_queue_path_matches_serial_path():
+    """The queued compile_field output is bit-identical to the serial
+    registry's (same banks, same stats shape)."""
+    import numpy as np
+
+    pats = [f"/api/v{i}/.*" for i in range(24)]
+    cfg = _cfg()
+    serial = BankRegistry()
+    q = CompileQueue(workers=3, deadline_s=30.0)
+    queued = BankRegistry(queue=q)
+    try:
+        b1, s1 = serial.compile_field("path", pats, cfg)
+        b2, s2 = queued.compile_field("path", pats, cfg)
+        assert s1.bank_keys == s2.bank_keys
+        assert set(s1.rebuilt) == set(s2.rebuilt)
+        assert np.array_equal(b1.pattern_bank, b2.pattern_bank)
+        assert np.array_equal(b1.pattern_lane, b2.pattern_lane)
+        for x, y in zip(b1.banks, b2.banks):
+            assert np.array_equal(x.trans, y.trans)
+            assert np.array_equal(x.accept, y.accept)
+        # reuse on the second build
+        _, s3 = queued.compile_field("path", pats, cfg)
+        assert s3.rebuilt == () and s3.reused == len(s3.bank_keys)
+    finally:
+        queued.close()
+
+
+def test_registry_worker_death_exhaustion_quarantines_with_cover():
+    """compile.worker deaths past the retry budget fail the bank into
+    quarantine: the PREVIOUS cover serves its patterns, new patterns
+    fail closed, and the registry is not degraded after recovery."""
+    pats = [f"/svc/p{i}/.*" for i in range(8)]
+    cfg = _cfg()
+    q = CompileQueue(workers=1, max_retries=1, backoff_base_s=0.01)
+    reg = BankRegistry(queue=q)
+    try:
+        _, s0 = reg.compile_field("path", pats, cfg)
+        assert not s0.quarantined
+        grown = pats + ["/svc/new/.*"]
+        with faults.inject(faults.FaultPlan(
+                [faults.FaultRule("compile.worker", times=10)])):
+            banked, s1 = reg.compile_field("path", grown, cfg)
+        assert s1.quarantined, "exhausted retries must quarantine"
+        assert reg._quarantine, "TTL stamp missing"
+        # every pattern still has a lane (cover or dead bank)
+        assert len(banked.patterns) == len(grown)
+        # recovery: expire the TTL, recompile cleanly
+        for qq in reg._quarantine.values():
+            qq.until = 0.0
+        _, s2 = reg.compile_field("path", grown, cfg)
+        assert not s2.quarantined and not reg._quarantine
+    finally:
+        reg.close()
+
+
+def test_registry_ttl_escalates_on_repeated_failures():
+    clock = [1000.0]
+    reg = BankRegistry(quarantine_ttl_s=10.0, clock=lambda: clock[0])
+    cfg = _cfg()
+    pats = ["/a/.*", "/b/.*"]
+    with faults.inject(faults.FaultPlan(
+            [faults.FaultRule("loader.bank_compile", times=99)])):
+        reg.compile_field("path", pats, cfg)
+        (key, q1), = [(k, q.until - clock[0])
+                      for k, q in reg._quarantine.items()]
+        assert q1 == pytest.approx(10.0)       # first failure: exact
+        clock[0] += 11.0
+        reg.compile_field("path", pats, cfg)
+        q2 = reg._quarantine[key].until - clock[0]
+        assert q2 > 15.0, "repeated failure did not escalate the TTL"
+        clock[0] += q2 + 1.0
+        reg.compile_field("path", pats, cfg)
+        q3 = reg._quarantine[key].until - clock[0]
+        assert q3 > q2 * 1.5, "TTL did not keep escalating"
+
+
+def test_background_kick_rebuilds_expired_quarantine():
+    clock = [0.0]
+    q = CompileQueue(workers=1, backoff_base_s=0.01)
+    reg = BankRegistry(quarantine_ttl_s=5.0, clock=lambda: clock[0],
+                       queue=q)
+    cfg = _cfg()
+    pats = [f"/k{i}/.*" for i in range(4)]
+    try:
+        with faults.inject(faults.FaultPlan(
+                [faults.FaultRule("loader.bank_compile", times=1)])):
+            _, s = reg.compile_field("path", pats, cfg)
+        assert s.quarantined
+        assert reg.kick_expired_rebuilds() == 0     # TTL not lapsed
+        clock[0] += 6.0
+        n = reg.kick_expired_rebuilds()
+        assert n == 1
+        for _ in range(400):
+            if not reg._quarantine:
+                break
+            time.sleep(0.005)
+        assert not reg._quarantine, \
+            "background rebuild did not clear the quarantine"
+        _, s2 = reg.compile_field("path", pats, cfg)
+        assert not s2.quarantined and s2.rebuilt == ()
+    finally:
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
+# artifact distribution
+
+
+def test_artifact_fetch_skips_compile_and_verifies_checksum(tmp_path):
+    cfg = _cfg()
+    pats = [f"/art/{i}/.*" for i in range(6)]
+    cache = ArtifactCache(str(tmp_path))
+    store = BankArtifactStore(cache)
+    producer = BankRegistry(artifacts=store)
+    producer.compile_field("path", pats, cfg)
+    assert producer.compiles > 0
+
+    consumer = BankRegistry(artifacts=store)
+    _, s = consumer.compile_field("path", pats, cfg)
+    assert consumer.compiles == 0, "artifact fetch should skip compile"
+    assert consumer.artifact_hits == len(s.bank_keys)
+    assert set(s.fetched) == set(s.bank_keys)
+
+
+def test_corrupt_artifact_degrades_to_recompile_counted(tmp_path):
+    import os
+
+    from cilium_tpu.runtime.metrics import BANK_ARTIFACT_FETCHES, METRICS
+
+    cfg = _cfg()
+    pats = ["/c1/.*", "/c2/.*"]
+    cache = ArtifactCache(str(tmp_path))
+    store = BankArtifactStore(cache)
+    producer = BankRegistry(artifacts=store)
+    producer.compile_field("path", pats, cfg)
+    # flip payload bytes INSIDE every bank artifact (outer pickle
+    # stays valid — only the checksum can catch this): never a crash,
+    # never a silently wrong bank
+    import pickle
+
+    for name in os.listdir(str(tmp_path)):
+        if name.startswith("bankart-"):
+            p = str(tmp_path / name)
+            entry = pickle.load(open(p, "rb"))
+            payload = bytearray(entry["payload"])
+            payload[len(payload) // 2] ^= 0xFF
+            entry["payload"] = bytes(payload)
+            pickle.dump(entry, open(p, "wb"))
+    corrupt0 = METRICS._counters.get(
+        (BANK_ARTIFACT_FETCHES, (("result", "corrupt"),)), 0)
+    consumer = BankRegistry(artifacts=store)
+    _, s = consumer.compile_field("path", pats, cfg)
+    assert not s.fetched and consumer.compiles > 0
+    assert not s.quarantined
+    corrupt1 = METRICS._counters.get(
+        (BANK_ARTIFACT_FETCHES, (("result", "corrupt"),)), 0)
+    assert corrupt1 > corrupt0
+
+
+def test_artifact_fetch_fault_point_degrades_to_recompile(tmp_path):
+    cfg = _cfg()
+    pats = ["/f1/.*"]
+    cache = ArtifactCache(str(tmp_path))
+    store = BankArtifactStore(cache)
+    producer = BankRegistry(artifacts=store)
+    producer.compile_field("path", pats, cfg)
+    consumer = BankRegistry(artifacts=store)
+    with faults.inject(faults.FaultPlan(
+            [faults.FaultRule("artifact.fetch", times=1)])):
+        _, s = consumer.compile_field("path", pats, cfg)
+    assert not s.fetched and consumer.compiles > 0
+    assert not s.quarantined
+
+
+# ---------------------------------------------------------------------------
+# sharding
+
+
+def test_registry_shards_bound_bytes_and_evict():
+    cfg = _cfg(bank_size=2)
+    reg = BankRegistry(shards=4, max_bytes=64 << 10, max_groups=64)
+    pats = [f"/evict/{i}/seg{i % 7}/.*" for i in range(48)]
+    reg.compile_field("path", pats, cfg)
+    assert reg.bytes <= 64 << 10 + 4096
+    # shard placement is a pure function of the key
+    for key in list(reg._quarantine) or []:
+        assert 0 <= registry_shard_of(key, 4) < 4
+
+
+def test_shard_of_is_stable_and_spread():
+    cfg = _cfg()
+    opts = (cfg.max_dfa_states, cfg.max_quantifier, False)
+    keys = [bank_key(g, opts)
+            for g in partition_patterns(
+                [f"/spread/{i}/.*" for i in range(64)], 4)]
+    shards = {registry_shard_of(k, 8) for k in keys}
+    assert len(shards) > 1, "shard function collapsed"
+    assert all(registry_shard_of(k, 8) == registry_shard_of(k, 8)
+               for k in keys)
+
+
+def test_work_key_is_pure_function_of_bank_key():
+    assert work_key("abc") == work_key("abc")
+    assert work_key("abc") != work_key("abd")

@@ -1397,3 +1397,125 @@ def test_wall_clock_tree_is_clean():
     unjustified direct clock reads (the tree-wide acceptance)."""
     findings, _sup = run(REPO_ROOT, rules=["wall-clock"])
     assert findings == [], "\n".join(f.format() for f in findings)
+
+
+# -- unbounded-registry -----------------------------------------------------
+
+from cilium_tpu.analysis import unboundedreg as ureg_rule  # noqa: E402
+
+UREG_BAD = """\
+from typing import Dict
+
+SEEN: Dict[str, int] = {}
+
+
+def on_request(key, value):
+    SEEN[key] = value
+
+
+class Registry:
+    def __init__(self):
+        self._by_key = {}
+        self._members = set()
+
+    def on_event(self, key, value):
+        self._by_key[key] = value
+        self._members.add(key)
+"""
+
+UREG_GOOD = """\
+from typing import Dict
+
+TABLE: Dict[str, int] = {}
+
+
+def on_request(key, value):
+    TABLE[key] = value
+    if len(TABLE) > 1024:
+        TABLE.clear()
+
+
+class Registry:
+    def __init__(self):
+        self._by_key = {}
+        self._lru = {}
+        self._rebuilt = {}
+        self.max_entries = 64
+
+    def on_event(self, key, value):
+        if len(self._by_key) >= self.max_entries:
+            self._by_key.pop(next(iter(self._by_key)))
+        self._by_key[key] = value
+        self._lru[key] = value
+
+    def evict(self, key):
+        del self._lru[key]
+
+    def prune(self, live):
+        self._rebuilt = {k: v for k, v in self._rebuilt.items()
+                         if k in live}
+
+    def insert(self, k, v):
+        self._rebuilt[k] = v
+"""
+
+
+def test_unbounded_registry_bad_corpus():
+    findings = _check({"cilium_tpu/runtime/reg.py": UREG_BAD},
+                      ureg_rule.check)
+    assert all(f.rule == "unbounded-registry" for f in findings)
+    msgs = "\n".join(f.message for f in findings)
+    assert "SEEN" in msgs and "_by_key" in msgs and "_members" in msgs
+    assert len(findings) == 3
+
+
+def test_unbounded_registry_good_corpus():
+    assert _check({"cilium_tpu/runtime/reg.py": UREG_GOOD},
+                  ureg_rule.check) == []
+
+
+def test_unbounded_registry_scoped_to_longlived_modules():
+    # the same bad source OUTSIDE runtime/engine/policy is out of
+    # scope (CLI helpers, tests, benches may hold short-lived maps)
+    assert _check({"cilium_tpu/ingest/reg.py": UREG_BAD},
+                  ureg_rule.check) == []
+    assert _check({"cilium_tpu/engine/reg.py": UREG_BAD},
+                  ureg_rule.check) != []
+    assert _check({"cilium_tpu/policy/compiler/reg.py": UREG_BAD},
+                  ureg_rule.check) != []
+
+
+def test_unbounded_registry_init_time_insertion_not_flagged():
+    src = (
+        "class Warm:\n"
+        "    def __init__(self, pairs):\n"
+        "        self._by_key = {}\n"
+        "        for k, v in pairs:\n"
+        "            self._by_key[k] = v\n")
+    assert _check({"cilium_tpu/runtime/w.py": src},
+                  ureg_rule.check) == []
+
+
+def test_unbounded_registry_disable_pragma_honored():
+    src = UREG_BAD.replace(
+        "    SEEN[key] = value",
+        "    # ctlint: disable=unbounded-registry  # bounded upstream\n"
+        "    SEEN[key] = value").replace(
+        "        self._by_key[key] = value",
+        "        # ctlint: disable=unbounded-registry  # test corpus\n"
+        "        self._by_key[key] = value").replace(
+        "        self._members.add(key)",
+        "        # ctlint: disable=unbounded-registry  # test corpus\n"
+        "        self._members.add(key)")
+    assert _check({"cilium_tpu/runtime/reg.py": src},
+                  ureg_rule.check) == []
+
+
+def test_unbounded_registry_tree_clean():
+    """The shipped tree passes with justified allowlists only — the
+    fleet-scale stores (sharded registry, fingerprint store, artifact
+    LRU) all carry real bounds."""
+    from cilium_tpu.analysis.core import run as _ctrun
+
+    findings, _ = _ctrun(REPO_ROOT, rules=["unbounded-registry"])
+    assert findings == [], [str(f) for f in findings]

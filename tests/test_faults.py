@@ -1026,6 +1026,190 @@ def test_bank_compile_fault_quarantines_only_its_bank(tmp_path):
     assert not loader._degraded
 
 
+def test_compile_worker_death_retries_then_serves_correctly(tmp_path):
+    """ISSUE 13: a compile.worker death mid-regeneration is absorbed
+    by the queue's retry — the CNP add COMMITS, the new rule enforces,
+    nothing quarantines, and the respawn counter moved."""
+    from cilium_tpu.runtime.metrics import COMPILE_WORKER_DEATHS
+
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.engine.bank_size = 4
+    cfg.compile.workers = 1
+    cfg.compile.backoff_base_s = 0.01
+    cfg.loader.cache_dir = str(tmp_path / "cache")
+    loader = Loader(cfg)
+    paths = [f"/p{i}/.*" for i in range(8)]
+    per1, db, web = _paths_policy(paths)
+    loader.regenerate(per1, revision=1)
+    deaths0 = _metric(COMPILE_WORKER_DEATHS)
+
+    per2, db, web = _paths_policy(paths + ["/fresh/.*"])
+    with faults.inject(FaultPlan(
+            [FaultRule("compile.worker", times=1)])):
+        loader.regenerate(per2, revision=2)
+    assert loader.revision == 2
+    assert _metric(COMPILE_WORKER_DEATHS) == deaths0 + 1
+    assert not loader._degraded, \
+        "a single worker death must be retried, not quarantined"
+    out = loader.engine.verdict_flows([_http_flow(web, db, "/fresh/x")])
+    assert int(out["verdict"][0]) == 5
+    loader.close()
+
+
+def test_compile_worker_death_exhaustion_quarantines_with_cover(
+        tmp_path):
+    """Retry budget exhausted by repeated worker deaths: the bank
+    quarantines — its NEW pattern fails CLOSED, unchanged banks serve
+    bit-identically — and the exhausted-fault recovery recompiles."""
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.engine.bank_size = 4
+    cfg.compile.workers = 1
+    cfg.compile.max_retries = 1
+    cfg.compile.backoff_base_s = 0.01
+    cfg.loader.cache_dir = str(tmp_path / "cache")
+    loader = Loader(cfg)
+    paths = [f"/p{i}/.*" for i in range(8)]
+    per1, db, web = _paths_policy(paths)
+    loader.regenerate(per1, revision=1)
+    golden_flows = [_http_flow(web, db, f"/p{i}/x") for i in range(8)]
+    golden = [int(v) for v in
+              loader.engine.verdict_flows(golden_flows)["verdict"]]
+
+    per2, db, web = _paths_policy(paths + ["/fresh/.*"])
+    with faults.inject(FaultPlan(
+            [FaultRule("compile.worker", times=10)])):
+        loader.regenerate(per2, revision=2)
+    assert loader.revision == 2
+    assert loader._degraded, "exhausted retries must quarantine"
+    after = [int(v) for v in
+             loader.engine.verdict_flows(golden_flows)["verdict"]]
+    assert after == golden, "unchanged banks must serve bit-identically"
+    out = loader.engine.verdict_flows([_http_flow(web, db, "/fresh/x")])
+    assert int(out["verdict"][0]) == 2, "uncovered pattern fails CLOSED"
+    # recovery: TTL lapse + regenerate with the fault exhausted
+    for q in loader.bank_registry._quarantine.values():
+        q.until = 0.0
+    loader.regenerate(per2, revision=3)
+    out = loader.engine.verdict_flows([_http_flow(web, db, "/fresh/x")])
+    assert int(out["verdict"][0]) == 5
+    assert not loader._degraded
+    loader.close()
+
+
+def test_artifact_fetch_fault_degrades_to_recompile_not_crash(
+        tmp_path):
+    """ISSUE 13: a lost/corrupt distributed bank artifact
+    (artifact.fetch fires on a fresh loader sharing the cache dir)
+    recompiles — verdicts identical, nothing quarantined, fetch
+    corruption counted."""
+    from cilium_tpu.runtime.metrics import BANK_ARTIFACT_FETCHES
+
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.engine.bank_size = 4
+    cfg.loader.cache_dir = str(tmp_path / "cache")
+    paths = [f"/p{i}/.*" for i in range(8)]
+    per1, db, web = _paths_policy(paths)
+    producer = Loader(cfg)
+    producer.regenerate(per1, revision=1)
+    golden_flows = [_http_flow(web, db, f"/p{i}/x") for i in range(8)]
+    golden = [int(v) for v in
+              producer.engine.verdict_flows(golden_flows)["verdict"]]
+    producer.close()
+
+    # a fresh "host" fetches bank artifacts instead of compiling —
+    # and every fetch faults: the plane recompiles, never crashes.
+    # (The whole-policy artifact is blinded so the per-bank path runs;
+    # bank-artifact reads still reach the real cache.)
+    consumer = Loader(cfg)
+    consumer._cache.get = lambda key, _real=consumer._cache.get: (
+        None if not key.startswith("bankart-") else _real(key))
+    corrupt0 = _metric(BANK_ARTIFACT_FETCHES, {"result": "corrupt"})
+    with faults.inject(FaultPlan(
+            [FaultRule("artifact.fetch", prob=1.0, times=None)])):
+        consumer.regenerate(per1, revision=1)
+    assert _metric(BANK_ARTIFACT_FETCHES,
+                   {"result": "corrupt"}) > corrupt0
+    assert not consumer._degraded
+    got = [int(v) for v in
+           consumer.engine.verdict_flows(golden_flows)["verdict"]]
+    assert got == golden
+    consumer.close()
+
+
+def test_corrupt_artifact_plus_compile_failure_quarantines_with_cover(
+        tmp_path):
+    """The combined ISSUE-13 outage: the distributed artifact is lost
+    (artifact.fetch fires) AND the recompile fails (loader.bank_compile
+    fires) — the bank must reach QUARANTINE-WITH-COVER: unchanged
+    banks bit-identical, the uncovered pattern fails CLOSED, and the
+    plane recovers once the faults exhaust and the TTL lapses."""
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.engine.bank_size = 4
+    cfg.loader.cache_dir = str(tmp_path / "cache")
+    loader = Loader(cfg)
+    paths = [f"/p{i}/.*" for i in range(8)]
+    per1, db, web = _paths_policy(paths)
+    loader.regenerate(per1, revision=1)
+    golden_flows = [_http_flow(web, db, f"/p{i}/x") for i in range(8)]
+    golden = [int(v) for v in
+              loader.engine.verdict_flows(golden_flows)["verdict"]]
+
+    per2, db, web = _paths_policy(paths + ["/fresh/.*"])
+    with faults.inject(FaultPlan([
+            FaultRule("artifact.fetch", times=8),
+            FaultRule("loader.bank_compile", times=1)])):
+        loader.regenerate(per2, revision=2)
+    assert loader.revision == 2
+    assert loader._degraded, "lost artifact + failed compile must " \
+        "quarantine"
+    after = [int(v) for v in
+             loader.engine.verdict_flows(golden_flows)["verdict"]]
+    assert after == golden
+    out = loader.engine.verdict_flows([_http_flow(web, db, "/fresh/x")])
+    assert int(out["verdict"][0]) == 2, "uncovered pattern fails CLOSED"
+    for q in loader.bank_registry._quarantine.values():
+        q.until = 0.0
+    loader.regenerate(per2, revision=3)
+    assert not loader._degraded
+    out = loader.engine.verdict_flows([_http_flow(web, db, "/fresh/x")])
+    assert int(out["verdict"][0]) == 5
+    loader.close()
+
+
+def test_fresh_loader_fetches_bank_artifacts_instead_of_compiling(
+        tmp_path):
+    """The distribution path itself: with a shared artifact cache, a
+    restarted/remote loader serves the same policy with ZERO bank
+    compiles (all groups fetched, checksum-verified)."""
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.engine.bank_size = 4
+    cfg.loader.cache_dir = str(tmp_path / "cache")
+    paths = [f"/p{i}/.*" for i in range(8)]
+    per1, db, web = _paths_policy(paths)
+    producer = Loader(cfg)
+    producer.regenerate(per1, revision=1)
+    assert producer.bank_registry.compiles > 0
+    producer.close()
+
+    consumer = Loader(cfg)
+    # defeat the whole-policy artifact hit so the per-bank path runs
+    consumer._cache.get = lambda key, _real=consumer._cache.get: (
+        None if not key.startswith("bankart-") else _real(key))
+    consumer.regenerate(per1, revision=1)
+    assert consumer.bank_registry.compiles == 0, \
+        "every bank should have been fetched, not compiled"
+    assert consumer.bank_registry.artifact_hits > 0
+    got = [int(v) for v in consumer.engine.verdict_flows(
+        [_http_flow(web, db, "/p3/x")])["verdict"]]
+    assert got == [5]
+    consumer.close()
+
+
 def test_kvstore_churn_storm_loses_deliveries_not_correctness():
     """kvstore.churn_storm drops identity add/delete deliveries on a
     watching allocator mid-burst: the dropped events are isolated and

@@ -1,0 +1,49 @@
+"""runtime/fleet.py: the fleet-scale policy-plane churn driver.
+
+The smoke test runs the REAL driver — same gates minus the p99 bound
+(meaningless at smoke scale) — inside tier-1, so `make check` always
+exercises the storm path. The full BASELINE configs[4] scale
+(10k identities × 5k CNP) runs behind the ``slow`` marker AND an
+explicit env opt-in (``CILIUM_TPU_FLEET_FULL=1``, what
+``make churn-fleet`` effectively is): a multi-minute lane must never
+ride an unfiltered ``pytest tests/`` by accident."""
+
+import os
+
+import pytest
+
+from cilium_tpu.runtime import fleet
+
+
+def test_baseline_numbers_parse():
+    ratio, p99 = fleet._baseline_churn(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert 0.5 <= ratio <= 2.0
+    assert 100.0 <= p99 <= 10000.0
+
+
+def test_fleet_smoke_storm_all_gates(tmp_path):
+    """Small-scale storm through the full driver: zero stale/ERROR,
+    O(Δ) compile bound, RSS bound — the p99 gate stays off."""
+    result = fleet.run(identities=400, cnps=200, updates=8,
+                       cache_dir=str(tmp_path / "cache"),
+                       workers=2, gate_p99=False,
+                       progress=lambda *_: None)
+    assert result["compiles_per_update"] <= result["odelta_bound"]
+    assert result["memo_hit_ratio"] >= 0.98
+    assert result["rss_peak_mb"] <= result["rss_bound_mb"]
+    assert result["classes"] == 8
+    assert result["compile_queue"]["completed"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.churn
+@pytest.mark.skipif(os.environ.get("CILIUM_TPU_FLEET_FULL") != "1",
+                    reason="full 10k x 5k scale runs via "
+                           "`make churn-fleet` (CILIUM_TPU_FLEET_FULL=1)")
+def test_fleet_full_scale(tmp_path):
+    result = fleet.run(identities=10000, cnps=5000, updates=56,
+                       cache_dir=str(tmp_path / "cache"),
+                       workers=4, gate_p99=True,
+                       progress=lambda *_: None)
+    assert result["value"] <= result["p99_bound_ms"]

@@ -243,3 +243,131 @@ def test_session_follows_bank_scoped_policy_churn(tmp_path):
     assert session_verdicts() == engine_verdicts()
     assert sess.memo.invalidations == inv1
     assert sess.memo.hits > hits0
+
+
+def test_session_refill_is_port_granular_bank_reference(tmp_path):
+    """ISSUE 13: the final invalidation narrowing — a commit changing
+    only identity db's HTTP rules ON PORT 8080 refills EXACTLY the
+    session's http rows to 8080. Its port-80 HTTP rows (same identity,
+    same family!) and its DNS rows keep serving from the memo — a row
+    reads a bank only through its own MapState entry's ruleset."""
+    from cilium_tpu.core.flow import (
+        DNSInfo,
+        Flow,
+        HTTPInfo,
+        L7Type,
+        Protocol,
+        TrafficDirection,
+    )
+    from cilium_tpu.core.identity import IdentityAllocator
+    from cilium_tpu.core.labels import LabelSet
+    from cilium_tpu.policy.api import (
+        EndpointSelector,
+        IngressRule,
+        PortProtocol,
+        PortRule,
+        Rule,
+    )
+    from cilium_tpu.policy.api.l7 import (
+        L7Rules,
+        PortRuleDNS,
+        PortRuleHTTP,
+    )
+    from cilium_tpu.policy.mapstate import PolicyResolver
+    from cilium_tpu.policy.repository import Repository
+    from cilium_tpu.policy.selectorcache import SelectorCache
+
+    alloc = IdentityAllocator()
+    db = alloc.allocate(LabelSet.from_dict({"app": "db"}))
+    web = alloc.allocate(LabelSet.from_dict({"app": "web"}))
+
+    def resolve(paths_8080):
+        rules = [Rule(
+            endpoint_selector=EndpointSelector.from_labels(app="db"),
+            ingress=(IngressRule(
+                from_endpoints=(
+                    EndpointSelector.from_labels(app="web"),),
+                to_ports=(
+                    PortRule(ports=(PortProtocol(80, Protocol.TCP),),
+                             rules=L7Rules(http=tuple(
+                                 PortRuleHTTP(path=f"/stable{i}/.*",
+                                              method="GET")
+                                 for i in range(4)))),
+                    PortRule(ports=(PortProtocol(8080, Protocol.TCP),),
+                             rules=L7Rules(http=tuple(
+                                 PortRuleHTTP(path=p, method="GET")
+                                 for p in paths_8080))),
+                    PortRule(ports=(PortProtocol(53, Protocol.UDP),),
+                             rules=L7Rules(dns=(
+                                 PortRuleDNS(match_name="api.corp.io"),
+                             ))),)),),
+        )]
+        repo = Repository()
+        repo.add(rules, sanitize=False)
+        return {db: PolicyResolver(repo, SelectorCache(alloc)).resolve(
+            alloc.lookup(db))}
+
+    def http(port, path):
+        return Flow(src_identity=web, dst_identity=db, dport=port,
+                    protocol=Protocol.TCP,
+                    direction=TrafficDirection.INGRESS,
+                    l7=L7Type.HTTP,
+                    http=HTTPInfo(method="GET", path=path))
+
+    def dns(q):
+        return Flow(src_identity=web, dst_identity=db, dport=53,
+                    protocol=Protocol.UDP,
+                    direction=TrafficDirection.INGRESS,
+                    l7=L7Type.DNS, dns=DNSInfo(query=q))
+
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.engine.bank_size = 4
+    cfg.loader.cache_dir = str(tmp_path / "cache")
+    loader = Loader(cfg)
+    base_8080 = [f"/alt{i}/.*" for i in range(4)]
+    loader.regenerate(resolve(base_8080), revision=1)
+
+    flows = ([http(80, f"/stable{i}/x") for i in range(4)]
+             + [http(8080, f"/alt{i}/x") for i in range(4)]
+             + [http(8080, "/nope"), dns("api.corp.io"),
+                dns("evil.net")])
+    flows = flows * 16
+    rec, l7, offsets, blob, gen = capture_from_bytes(
+        capture_to_bytes(flows))
+    sess = IncrementalSession(loader.engine, loader=loader)
+
+    def session_verdicts():
+        n, dev = sess.verdict_chunk(rec, l7, offsets, blob, gen=gen)
+        return [int(v) for v in np.asarray(dev)[:n]]
+
+    def engine_verdicts():
+        return [int(v) for v in
+                loader.engine.verdict_flows(flows)["verdict"]]
+
+    assert session_verdicts() == engine_verdicts()
+    n8080 = sum(1 for ep, l7t, dport in sess._row_eps
+                if l7t == 1 and dport == 8080)
+    nhttp = sum(1 for ep, l7t, _ in sess._row_eps if l7t == 1)
+    assert 0 < n8080 < nhttp, "need rows on BOTH http ports"
+
+    misses0 = sess.memo.misses
+    inval0 = sess.memo.invalidations
+    resets0 = sess.resets
+    # churn ONLY the 8080 rule set
+    loader.regenerate(resolve(base_8080 + ["/alt-new/.*"]),
+                      revision=2)
+    assert session_verdicts() == engine_verdicts()
+    assert sess.resets == resets0
+    refilled = sess.memo.misses - misses0
+    assert refilled == n8080, (
+        f"port-granular refill broke: {refilled} rows re-missed, "
+        f"expected exactly the {n8080} http@8080 rows "
+        f"(identity has {nhttp} http rows total)")
+    assert sess.memo.invalidations == inval0 + 1
+    # the new 8080 rule enforces on a fresh probe
+    probe = [http(8080, "/alt-new/x")] * 4
+    got = [int(v) for v in
+           loader.engine.verdict_flows(probe)["verdict"]]
+    assert got == [5] * 4
+    loader.close()

@@ -287,12 +287,13 @@ def test_ring_survives_policy_hot_swap_with_family_granular_refill(
         misses0, inval0 = memo.misses, memo.invalidations
         n_unique = loop.ring.session.n_rows
         # the identity whose rules churn, and its per-family unique
-        # row counts, straight from the session's (ep, l7t) mirror
+        # row counts, straight from the session's (ep, l7t, dport)
+        # mirror
         pairs = loop.ring.session._row_eps[:n_unique]
-        id0 = min(ep for ep, _ in pairs)   # dbs[0]: lowest identity
-        id0_http = sum(1 for ep, l7t in pairs
+        id0 = min(ep for ep, _, _ in pairs)  # dbs[0]: lowest identity
+        id0_http = sum(1 for ep, l7t, _ in pairs
                        if ep == id0 and l7t == 1)
-        id0_all = sum(1 for ep, _ in pairs if ep == id0)
+        id0_all = sum(1 for ep, _, _ in pairs if ep == id0)
         assert 0 < id0_http < id0_all      # both families present
         # churn ONLY identity 0's HTTP family
         rules_of[0].append(("http", "/churn/added/.*"))
@@ -348,6 +349,45 @@ def test_family_delta_affects_matrix():
     merged2 = fam.merge(PolicyDelta.banks(
         {5}, set(), identity_families={(5, "dns")}))
     assert not merged2.affects(7, 3) and merged2.affects(5, 3)
+
+
+def test_port_delta_affects_matrix():
+    """ISSUE 13: the bank-reference (port) rung of the granularity
+    ladder — exact ports narrow, PORT_ALL widens, port info only
+    survives a merge when both sides carry it."""
+    from cilium_tpu.engine.memo import (
+        PORT_ALL,
+        PolicyDelta,
+        affected_row_ids,
+    )
+
+    d = PolicyDelta.banks(
+        {7}, set(), identity_families={(7, "http")},
+        identity_family_ports={(7, "http", 8080)})
+    assert d.affects(7, 1, 8080)
+    assert not d.affects(7, 1, 80)     # same identity+family, other port
+    assert d.affects(7, 1)             # port-blind consumer: family level
+    assert not d.affects(7, 3, 8080)   # dns row untouched
+    wide = PolicyDelta.banks(
+        {7}, set(), identity_families={(7, "http")},
+        identity_family_ports={(7, "http", PORT_ALL)})
+    assert wide.affects(7, 1, 80) and wide.affects(7, 1, 8080)
+    eps = np.array([7, 7, 7, 8])
+    l7s = np.array([1, 1, 3, 1])
+    dps = np.array([8080, 80, 53, 8080])
+    assert affected_row_ids(d, eps, l7s, dports=dps).tolist() == [0]
+    assert affected_row_ids(d, eps, l7s).tolist() == [0, 1]
+    # merge: ports survive only when both sides carry them
+    d2 = PolicyDelta.banks(
+        {9}, set(), identity_families={(9, "dns")},
+        identity_family_ports={(9, "dns", 53)})
+    m = d.merge(d2)
+    assert not m.affects(7, 1, 80) and m.affects(9, 3, 53)
+    blind = PolicyDelta.banks({5}, set(),
+                              identity_families={(5, "http")})
+    m2 = d.merge(blind)
+    assert m2.affects(7, 1, 80), \
+        "merging a ports-blind delta must widen to all ports"
 
 
 # ---------------------------------------------------------------------------
