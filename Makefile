@@ -39,7 +39,8 @@ chaos:           ## seeded fault-injection replay lane
 # it) and assert the Prometheus text parses with zero malformed lines
 obs:             ## observability lane: tracing tests + scrape lint
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_tracing.py \
-	    tests/test_observability.py -q
+	    tests/test_observability.py tests/test_provenance.py \
+	    tests/test_explain.py -q -m "not slow"
 	JAX_PLATFORMS=cpu $(PY) -c "\
 	from cilium_tpu.runtime.metrics import METRICS, lint_exposition; \
 	METRICS.inc('cilium_tpu_scrape_lint_total'); \
@@ -66,9 +67,12 @@ soak:            ## synthetic-overload admission/shed lane
 # serve.lease/serve.ring_slot faults) through the continuously-
 # batched serving loop (runtime/serveloop.py + engine/ring.py) under
 # the autojumping VirtualClock, with lease-accounting / sampled-
-# correctness / memo-honesty invariants checked after every event.
+# correctness / memo-honesty / explanation-decode invariants checked
+# after every event.
 # Gates: 0 violations, concurrency peak >= 95k, p99 <= 2x unloaded,
-# shed rate bounded, memo-bypass bytes > 0. One provenance-stamped
+# shed rate bounded, memo-bypass bytes > 0, explanation coverage
+# >= 0.999 of served verdicts, and declared-SLO burn rates <= 1.0
+# over the whole-run window (ISSUE 14). One provenance-stamped
 # line lands in BENCH_SERVE_r07.jsonl (consumed by perf-report).
 serve-soak:      ## 100k-virtual-stream continuous-batching soak
 	JAX_PLATFORMS=cpu $(PY) -m cilium_tpu.runtime.loadmodel \

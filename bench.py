@@ -392,6 +392,56 @@ def _bench_from_capture(args, cfg, engine, scenario, arrays, log):
         window_times.append(time.perf_counter() - t0)
     t = sorted(window_times)[len(window_times) // 2]
     e2e_vps = reps * nch * bs / t
+
+    # provenance-lane overhead (ISSUE 14): identical windows, but the
+    # window-end consumption also materializes the provenance
+    # surfaces — the attribution lane readback, cited generations off
+    # the memo's host bookkeeping, and a sample of packed provenance
+    # words. The attribution lane itself is computed by the fused
+    # step EITHER WAY (it is an output lane, not a second dispatch),
+    # so this measures exactly the marginal consumption cost the
+    # perf-report gate holds ≤2%. Windows run as INTERLEAVED A/B
+    # pairs with the arm ORDER alternating per pair — a fixed
+    # base-then-prov order reads ~2% of pure cache/frequency drift
+    # as "overhead" on the CI host (measured); alternation cancels
+    # it, leaving the real marginal cost.
+    from cilium_tpu.engine.attribution import pack_word
+
+    def _consume_provenance(out_, c):
+        l7m = np.asarray(out_["l7_match"])
+        if memo is not None:
+            gens = memo.cited_gens(
+                row_idx[c * bs:(c + 1) * bs][:len(l7m)])
+        else:
+            gens = np.zeros(min(8, len(l7m)), dtype=np.int64)
+        for k in range(min(8, len(l7m))):
+            pack_word(int(l7m[k]), 1, memo is not None,
+                      int(gens[k]) if k < len(gens) else 0)
+
+    def _window(consume: bool) -> float:
+        t0 = time.perf_counter()
+        last_c = 0
+        w_out = None
+        for _ in range(reps):
+            for c in range(nch):
+                w_out = step(arrays, encode_chunk(c))
+                last_c = c
+        _force(w_out)
+        if consume:
+            _consume_provenance(w_out, last_c)
+        return time.perf_counter() - t0
+
+    base_times, prov_times = [], []
+    for pair in range(6):
+        first_prov = bool(pair % 2)
+        a = _window(consume=first_prov)
+        b = _window(consume=not first_prov)
+        (prov_times if first_prov else base_times).append(a)
+        (base_times if first_prov else prov_times).append(b)
+    t_base = sorted(base_times)[len(base_times) // 2]
+    t_prov = sorted(prov_times)[len(prov_times) // 2]
+    provenance_overhead_pct = round(
+        max(0.0, (t_prov - t_base) / t_base) * 100, 3)
     rtt_p50, rtt_max = _tunnel_rtt_probe()
     # per-chunk device-time attribution (perf ledger): h2d / gather /
     # mapstate / resolve decomposition of one replay chunk, with the
@@ -427,6 +477,11 @@ def _bench_from_capture(args, cfg, engine, scenario, arrays, log):
         "stage_phases_ms": stage_phases_ms,
         # per-chunk phase attribution + compile/execute split
         "attribution": attribution,
+        # marginal cost of consuming the provenance surfaces (lane
+        # readback + cited gens + packed words) vs verdict-only
+        # windows; perf-report gates it against the declared budget
+        "provenance_overhead_pct": provenance_overhead_pct,
+        "provenance_budget_pct": 2.0,
         # dedup stream accounting, so the ratio behind the e2e rate
         # is visible: unique 15-tuples / total records, and which
         # stream the windows used ("id+memo" = row ids gathering
@@ -990,6 +1045,8 @@ def run_config(config: str, args) -> dict:
             "memo": e2e["memo"],
             **({k: e2e[k] for k in ("memo_fill_ms", "memo_hits",
                                     "memo_misses") if k in e2e}),
+            "provenance_overhead_pct": e2e["provenance_overhead_pct"],
+            "provenance_budget_pct": e2e["provenance_budget_pct"],
             "e2e_vps_min": e2e["e2e_vps_min"],
             "e2e_vps_max": e2e["e2e_vps_max"],
             "e2e_windows": e2e["e2e_windows"],

@@ -702,11 +702,15 @@ class Agent:
 
     def fan_out(self, flows: List, outputs: Dict) -> None:
         """Observability fan-out for one verdicted batch: monitor
-        events (→ the monitor socket), verdict/match annotation, and
-        the hubble observer ring. The ONE place the sequence lives —
-        the replay pipeline and the verdict service both call it."""
+        events (→ the monitor socket), verdict/match annotation
+        (honest ``policy_match_type`` + provenance stamps when the
+        engine outputs carry the attribution lane), and the hubble
+        observer ring. The ONE place the sequence lives — the replay
+        pipeline and the verdict service both call it."""
         self.monitor.notify_batch(flows, outputs)
-        annotate_flows(flows, outputs)
+        annotate_flows(flows, outputs,
+                       amap=getattr(self.loader.engine, "attribution",
+                                    None))
         self.observer.observe(flows)
 
     # -- introspection (cilium-dbg surface) ------------------------------

@@ -16,7 +16,16 @@ both ways:
 * every ``cilium_tpu_*``-shaped token the doc mentions must still be a
   declared family (stale docs teach dead series); derived histogram
   suffixes (``_bucket``/``_count``/``_sum``) of declared families are
-  fine.
+  fine;
+* every **reason-label VALUE** the system can emit — shed reasons
+  (the ``SHED_*`` constants in ``runtime/admission.py``), memo
+  invalidation reasons (``engine/memo.INVALIDATION_REASONS`` plus any
+  literal ``reason=`` at engine/runtime call sites), and every
+  literal ``{"reason"/"result": ...}`` metric label value anywhere in
+  the package — must appear in the doc's **Reason-label catalog**
+  section, and a catalog row whose value is no longer emitted
+  anywhere is a stale-doc finding (a dashboard filtering on a dead
+  label value silently matches nothing).
 """
 
 from __future__ import annotations
@@ -40,6 +49,19 @@ DOC_PATH = os.path.join("docs", "OBSERVABILITY.md")
 _PHASE_TUPLES = ("ENGINE_PHASES", "CAPTURE_PHASES")
 
 _DOC_FAMILY_RE = re.compile(r"\bcilium_tpu_[a-z0-9_]*[a-z0-9]\b")
+
+ADMISSION_MODULE = "cilium_tpu.runtime.admission"
+MEMO_MODULE = "cilium_tpu.engine.memo"
+#: module prefixes whose literal ``reason=`` call kwargs / bare
+#: ``invalidate("...")`` args are reason-label values
+_REASON_CALL_PREFIXES = ("cilium_tpu.engine", "cilium_tpu.runtime",
+                        "cilium_tpu.policy")
+#: label keys whose literal values are reason-label values
+_LABEL_KEYS = ("reason", "result")
+#: the doc section holding the reason-label catalog; rows are
+#: ``| `value` | ... |`` table lines
+REASON_SECTION = "## Reason-label catalog"
+_REASON_ROW_RE = re.compile(r"^\|\s*`([a-z0-9*_-]+)`")
 
 
 def _declared_families(project: Project) -> Dict[str, Tuple[str, int]]:
@@ -98,6 +120,102 @@ def _phase_values(project: Project) -> Dict[str, Tuple[str, int]]:
     return out
 
 
+def _const_strs(node: ast.AST) -> List[str]:
+    """String constants of a value expression: a bare constant, or
+    both branches of a conditional (``"a" if x else "b"`` — the
+    explained/unexplained shape)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, ast.IfExp):
+        return _const_strs(node.body) + _const_strs(node.orelse)
+    if isinstance(node, ast.BoolOp):
+        # `dynamic or "fallback"` — the fallback is emittable
+        out: List[str] = []
+        for v in node.values:
+            out.extend(_const_strs(v))
+        return out
+    return []
+
+
+def _reason_values(project: Project) -> Dict[str, Tuple[str, int]]:
+    """Every reason-label VALUE the tree can emit → declaring
+    (path, line): shed reasons (``SHED_*``), the memo invalidation
+    registry (``INVALIDATION_REASONS``), literal ``reason=`` call
+    kwargs / ``invalidate("...")`` args in the serving modules, and
+    literal ``{"reason"/"result": ...}`` metric label values
+    anywhere."""
+    out: Dict[str, Tuple[str, int]] = {}
+
+    def note(value: str, path: str, line: int) -> None:
+        if value:
+            out.setdefault(value, (path, line))
+
+    mi = project.modules.get(ADMISSION_MODULE)
+    if mi is not None:
+        for node in mi.sf.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id.startswith("SHED_") \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                note(node.value.value, mi.sf.path, node.lineno)
+    mm = project.modules.get(MEMO_MODULE)
+    if mm is not None:
+        for node in mm.sf.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == "INVALIDATION_REASONS" \
+                    and isinstance(node.value, ast.Tuple):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) \
+                            and isinstance(elt.value, str):
+                        note(elt.value, mm.sf.path, node.lineno)
+    for name, mod in project.modules.items():
+        reason_module = name.startswith(_REASON_CALL_PREFIXES)
+        for node in ast.walk(mod.sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            fn_name = fn.attr if isinstance(fn, ast.Attribute) \
+                else fn.id if isinstance(fn, ast.Name) else ""
+            if reason_module:
+                if fn_name == "invalidate" and node.args:
+                    for v in _const_strs(node.args[0]):
+                        note(v, mod.sf.path, node.lineno)
+                for kw in node.keywords:
+                    if kw.arg == "reason":
+                        for v in _const_strs(kw.value):
+                            note(v, mod.sf.path, node.lineno)
+            # literal {"reason"/"result": ...} metric label values,
+            # tree-wide (the artifact-fetch result shape)
+            for kw in node.keywords:
+                if kw.arg != "labels" \
+                        or not isinstance(kw.value, ast.Dict):
+                    continue
+                for k, v in zip(kw.value.keys, kw.value.values):
+                    if isinstance(k, ast.Constant) \
+                            and k.value in _LABEL_KEYS:
+                        for s in _const_strs(v):
+                            note(s, mod.sf.path, node.lineno)
+    return out
+
+
+def _documented_reasons(doc_text: str) -> Dict[str, int]:
+    """Value → doc line of every Reason-label catalog row."""
+    out: Dict[str, int] = {}
+    in_section = False
+    for i, line in enumerate(doc_text.splitlines(), 1):
+        if line.strip().startswith("## "):
+            in_section = line.strip() == REASON_SECTION.strip()
+            continue
+        if not in_section:
+            continue
+        m = _REASON_ROW_RE.match(line.strip())
+        if m:
+            out.setdefault(m.group(1), i)
+    return out
+
+
 def check_obs_docs(index: ProjectIndex,
                    doc_text: Optional[str] = None) -> List[Finding]:
     if doc_text is None:
@@ -130,6 +248,26 @@ def check_obs_docs(index: ProjectIndex,
                 path, line, RULE,
                 f"phase label `{value}` is not documented in "
                 f"{DOC_PATH}"))
+    # reason-label parity, both directions (only when the tree has a
+    # reason surface at all — in-memory rule corpora without the
+    # admission module are not judged)
+    reasons = _reason_values(project)
+    documented = _documented_reasons(doc_text)
+    if reasons:
+        for value, (path, line) in sorted(reasons.items()):
+            if value not in documented:
+                findings.append(Finding(
+                    path, line, RULE,
+                    f"reason-label value `{value}` is not in "
+                    f"{DOC_PATH}'s Reason-label catalog (an operator "
+                    f"cannot interpret an undocumented reason)"))
+        for value, line in sorted(documented.items()):
+            if value not in reasons:
+                findings.append(Finding(
+                    DOC_PATH, line, RULE,
+                    f"{DOC_PATH} catalogs reason-label value "
+                    f"`{value}` but nothing in the tree emits it — "
+                    f"stale doc or typo"))
     # stale direction: doc tokens that are no longer declared families
     if families:
         derived = set()

@@ -14,6 +14,9 @@ over the agent's socket plus offline tooling. Subcommands:
   flight-recorder Chrome trace-event JSON for the run)
 * ``trace dump``  — fetch the live agent's flight recorder
   (runtime/tracing.py) as Perfetto-loadable Chrome trace-event JSON
+* ``explain``     — verdict provenance for one trace id
+  (runtime/explain.py): which rule/bank/generation produced the
+  served verdicts, re-resolved on the CPU oracle (served vs fresh)
 * ``bugtool``     — collect a diagnostics bundle from the agent
   (the ``cilium-bugtool`` analog)
 * ``lint``        — ctlint codebase-aware static analysis
@@ -322,7 +325,9 @@ def cmd_replay(args) -> int:
                 with TRACER.span("replay.account", phase=PHASE_HOST):
                     if "match_spec" not in out:
                         out = {"verdict": np.asarray(out["verdict"])}
-                    annotate_flows(chunk, out)
+                    annotate_flows(chunk, out,
+                                   amap=getattr(engine, "attribution",
+                                                None))
                     observer.observe(chunk)
                     for f in chunk:
                         counts[Verdict(f.verdict).name] = counts.get(
@@ -361,6 +366,49 @@ def cmd_replay(args) -> int:
         summary["trace_ids"] = len(TRACER.trace_ids())
     print(json.dumps(summary))
     return 0
+
+
+def cmd_explain(args) -> int:
+    """Explain one served verdict chain: recorded provenance for a
+    trace id — (rule id, bank key, policy generation, memo-hit,
+    kernel impl) per sampled record — re-resolved through the CPU
+    oracle at the current revision so the output shows SERVED vs
+    FRESH agreement per record."""
+    from cilium_tpu.runtime.service import VerdictClient
+
+    c = VerdictClient(args.socket)
+    resp = c.call({"op": "explain", "trace_id": args.trace_id})
+    c.close()
+    if "error" in resp:
+        print(json.dumps(resp))
+        return 1
+    if args.json:
+        print(json.dumps(resp, indent=2, default=str))
+        return 0 if resp.get("found") else 1
+    if not resp.get("found"):
+        print(f"trace {args.trace_id}: no recorded provenance "
+              f"(expired from the explain store, or the chunk was "
+              f"not traced)")
+        return 1
+    print(f"trace {args.trace_id} — revision "
+          f"{resp.get('revision')}, generation "
+          f"{resp.get('generation_now')}"
+          + (" [DEGRADED]" if resp.get("degraded") else ""))
+    for r in resp.get("records", ()):
+        p = r.get("provenance", {})
+        agree = r.get("agreement")
+        mark = ("==" if agree else
+                "!=" if agree is not None else "??")
+        fresh = r.get("fresh_verdict_name", "?")
+        print(f"  [{r.get('index')}] served={r.get('verdict_name')} "
+              f"{mark} fresh={fresh}  rule={p.get('rule', '-')}  "
+              f"bank={p.get('bank_key', '') or '-'}  "
+              f"gen={p.get('generation')}"
+              f"{' memo' if p.get('memo_hit') else ''}"
+              f"  kernel={p.get('kernel') or '-'}")
+    ok = resp.get("served_equals_fresh", True) \
+        or resp.get("degraded", False)
+    return 0 if ok else 1
 
 
 def cmd_trace(args) -> int:
@@ -978,6 +1026,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     td.add_argument("--spans", action="store_true",
                     help="raw span records instead of Chrome JSON")
     td.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser(
+        "explain",
+        help="verdict provenance for one trace id: cited rule/bank/"
+             "generation, re-resolved on the CPU oracle "
+             "(served vs fresh)")
+    p.add_argument("trace_id")
+    p.add_argument("--socket", required=True)
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON instead of the summary lines")
+    p.set_defaults(fn=cmd_explain)
 
     p = sub.add_parser("inspect", help="dump a compiled-policy artifact")
     p.add_argument("artifact")

@@ -258,6 +258,44 @@ class ServeConfig:
 
 
 @dataclasses.dataclass
+class SLOConfig:
+    """Declared service-level objectives (runtime/slo.py): the serve
+    loop tracks multi-window error-budget burn rates against these
+    targets and publishes them as
+    ``cilium_tpu_slo_burn_rate{slo,window}`` gauges + the `status`
+    op. Targets declare intent — changing them never changes serving
+    behavior, only what counts as budget spend."""
+
+    enabled: bool = True
+    #: latency SLO: 99% of served chunks complete under this
+    #: submit→verdict latency (the p99 target `make serve-soak` holds)
+    serve_p99_ms: float = 200.0
+    #: availability SLO: the explicit-shed fraction stays under this
+    shed_rate: float = 1e-3
+    #: trailing burn-rate windows, seconds (multi-window alerting:
+    #: a fast page window and a slow ticket window)
+    windows_s: Tuple[float, ...] = (300.0, 3600.0)
+
+
+@dataclasses.dataclass
+class ProvenanceConfig:
+    """Verdict provenance & the explain plane (engine/attribution.py,
+    runtime/explain.py): the attribution output lane rides the fused
+    dispatch, memo rows remember the generation they were computed
+    under, and sampled (traced) verdicts record bounded explain
+    entries queryable via ``GET /v1/explain`` / ``cilium-tpu
+    explain``. Disabling drops the ServedPack bundling on the serve
+    path (the attribution LANE itself is part of the verdict step and
+    costs the same either way)."""
+
+    enabled: bool = True
+    #: bounded explain store: trace ids retained (LRU)
+    explain_capacity: int = 1024
+    #: flows per traced chunk reconstructed for the explain store
+    sample_per_chunk: int = 8
+
+
+@dataclasses.dataclass
 class ParallelConfig:
     """Mesh / sharding layout (SURVEY.md §2.6)."""
 
@@ -314,6 +352,9 @@ class Config:
     compile: CompileConfig = dataclasses.field(
         default_factory=CompileConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    slo: SLOConfig = dataclasses.field(default_factory=SLOConfig)
+    provenance: ProvenanceConfig = dataclasses.field(
+        default_factory=ProvenanceConfig)
     dst: DSTConfig = dataclasses.field(default_factory=DSTConfig)
     log_level: str = "info"
     #: ``--k8s-api-socket``: when set, the agent consumes CNP/CCNP
@@ -408,6 +449,17 @@ class Config:
         if "CILIUM_TPU_SERVE_PACK_INTERVAL_MS" in env:
             cfg.serve.pack_interval_ms = float(
                 env["CILIUM_TPU_SERVE_PACK_INTERVAL_MS"])
+        if "CILIUM_TPU_SLO_SERVE_P99_MS" in env:
+            cfg.slo.serve_p99_ms = float(
+                env["CILIUM_TPU_SLO_SERVE_P99_MS"])
+        if "CILIUM_TPU_SLO_SHED_RATE" in env:
+            cfg.slo.shed_rate = float(env["CILIUM_TPU_SLO_SHED_RATE"])
+        if env.get("CILIUM_TPU_PROVENANCE", "").lower() in (
+                "0", "false", "no", "off"):
+            cfg.provenance.enabled = False
+        if "CILIUM_TPU_EXPLAIN_CAPACITY" in env:
+            cfg.provenance.explain_capacity = int(
+                env["CILIUM_TPU_EXPLAIN_CAPACITY"])
         if env.get("CILIUM_TPU_PARALLEL_LANE", "") in (
                 "auto", "dp", "ep", "cp"):
             cfg.parallel.lane = env["CILIUM_TPU_PARALLEL_LANE"]
@@ -443,6 +495,8 @@ class Config:
                                 ("admission", cfg.admission),
                                 ("compile", cfg.compile),
                                 ("serve", cfg.serve),
+                                ("slo", cfg.slo),
+                                ("provenance", cfg.provenance),
                                 ("dst", cfg.dst)):
             for k, v in data.get(section, {}).items():
                 if hasattr(target, k):

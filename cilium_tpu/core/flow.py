@@ -135,6 +135,19 @@ class Flow:
     #: be re-mapped to local identities by label at replay
     src_labels: Tuple[str, ...] = ()
     dst_labels: Tuple[str, ...] = ()
+    #: verdict provenance (engine/attribution.py), stamped at
+    #: annotation when the engine outputs carried the attribution
+    #: lane: the packed provenance word (0 = no provenance recorded —
+    #: old captures and oracle-served flows decode to nothing), the
+    #: compact rule label (e.g. ``http:g3/r17``), the content-
+    #: addressed bank key the match was read from, the
+    #: POLICY_GENERATION the verdict was computed under (-1 =
+    #: unknown), and whether it was served from the device memo
+    prov_word: int = 0
+    prov_rule: str = ""
+    prov_bank: str = ""
+    prov_generation: int = -1
+    prov_memo: bool = False
 
     def l7_record(self):
         if self.l7 == L7Type.HTTP:

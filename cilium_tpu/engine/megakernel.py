@@ -100,6 +100,7 @@ def _dedup_kafka_groups(arrays: Dict[str, np.ndarray],
     RS = arrays["rs_kafka_mask"].shape[0]
     member = _mask_bits(arrays["rs_kafka_mask"], max(1, n_kafka))
     groups: Dict[tuple, set] = {}
+    rule_keys: Dict[int, tuple] = {}
     for r in range(n_kafka):
         rss = np.nonzero(member[:, r])[0]
         if not len(rss):
@@ -108,6 +109,7 @@ def _dedup_kafka_groups(arrays: Dict[str, np.ndarray],
                int(arrays["kafka_version"][r]),
                int(arrays["kafka_client"][r]),
                int(arrays["kafka_topic"][r]))
+        rule_keys[r] = key
         groups.setdefault(key, set()).update(int(x) for x in rss)
     G = max(1, len(groups))
     Gw = (G + 31) // 32
@@ -118,14 +120,27 @@ def _dedup_kafka_groups(arrays: Dict[str, np.ndarray],
     k_cli = np.full(G, -1, np.int32)
     k_top = np.full(G, -1, np.int32)
     rs_kmask = np.zeros((RS, Gw), np.uint32)
+    group_of_key: Dict[tuple, int] = {}
     for g, (key, rss) in enumerate(groups.items()):
+        group_of_key[key] = g
         k_mask[g], k_ver[g], k_cli[g], k_top[g] = key
         gbit = np.uint32(1 << (g % 32))
         for rs in rss:
             rs_kmask[rs, g // 32] |= gbit
+    # rule → group map: the attribution lane's bridge between the
+    # legacy per-rule resolve and the fused group space (a matched
+    # rule's group is matched and vice versa — exact, so the lane is
+    # bit-equal across arms). Sized to the BUCKETED rule table (the
+    # legacy conjunction runs over padded rule lanes); padding = -1.
+    k_rule_group = np.full(
+        max(1, int(arrays["kafka_apikey_mask"].shape[0])), -1,
+        np.int32)
+    for r, key in rule_keys.items():
+        k_rule_group[r] = group_of_key[key]
     return {"rp_k_apikey_mask": k_mask, "rp_k_version": k_ver,
             "rp_k_client": k_cli, "rp_k_topic": k_top,
-            "rp_rs_kmask": rs_kmask}, len(groups)
+            "rp_rs_kmask": rs_kmask,
+            "rp_k_rule_group": k_rule_group}, len(groups)
 
 
 def _dedup_gen_groups(arrays: Dict[str, np.ndarray],
@@ -137,6 +152,7 @@ def _dedup_gen_groups(arrays: Dict[str, np.ndarray],
     RS = arrays["rs_gen_mask"].shape[0]
     member = _mask_bits(arrays["rs_gen_mask"], max(1, n_gen))
     groups: Dict[tuple, set] = {}
+    rule_keys: Dict[int, tuple] = {}
     for r in range(n_gen):
         if int(arrays["gen_rule_proto"][r]) < 0:
             continue  # proto-less rule is dead by construction
@@ -147,6 +163,7 @@ def _dedup_gen_groups(arrays: Dict[str, np.ndarray],
                               for p in arrays["gen_rule_pairs"][r]
                               if p >= 0}))
         key = (int(arrays["gen_rule_proto"][r]), pairs)
+        rule_keys[r] = key
         groups.setdefault(key, set()).update(int(x) for x in rss)
     G = max(1, len(groups))
     Gw = (G + 31) // 32
@@ -154,15 +171,22 @@ def _dedup_gen_groups(arrays: Dict[str, np.ndarray],
     g_proto = np.full(G, -1, np.int32)
     g_pairs = np.full((G, Km), -1, np.int32)
     rs_gmask = np.zeros((RS, Gw), np.uint32)
+    group_of_key: Dict[tuple, int] = {}
     for g, (key, rss) in enumerate(groups.items()):
+        group_of_key[key] = g
         proto, pairs = key
         g_proto[g] = proto
         g_pairs[g, :len(pairs)] = pairs
         gbit = np.uint32(1 << (g % 32))
         for rs in rss:
             rs_gmask[rs, g // 32] |= gbit
+    gen_rule_group = np.full(
+        max(1, int(arrays["gen_rule_proto"].shape[0])), -1, np.int32)
+    for r, key in rule_keys.items():
+        gen_rule_group[r] = group_of_key[key]
     return {"rp_gen_proto": g_proto, "rp_gen_pairs": g_pairs,
-            "rp_rs_genmask": rs_gmask}, len(groups)
+            "rp_rs_genmask": rs_gmask,
+            "rp_gen_rule_group": gen_rule_group}, len(groups)
 
 
 def build_resolve_plan(arrays: Dict[str, np.ndarray], n_http: int,
@@ -212,6 +236,11 @@ def build_resolve_plan(arrays: Dict[str, np.ndarray], n_http: int,
     NB, S, W = acc.shape
     NL = NB * 32 * W
     lane_groups = np.zeros((NL, Gw), np.uint32)
+    # rule → group map (attribution lane): every live referenced rule
+    # belongs to exactly one signature group. Sized to the BUCKETED
+    # rule table (the legacy conjunction runs over padded lanes).
+    rule_group = np.full(
+        max(1, int(arrays["http_path_lane"].shape[0])), -1, np.int32)
     for g, (key, rules) in enumerate(groups.items()):
         meth, host, hdr, log, rss, anypath = key
         g_method[g] = meth
@@ -223,6 +252,8 @@ def build_resolve_plan(arrays: Dict[str, np.ndarray], n_http: int,
         gbit = np.uint32(1 << (g % 32))
         for rs in rss:
             rs_gmask[rs, g // 32] |= gbit
+        for r in rules:
+            rule_group[r] = g
         if not anypath:
             for r in rules:
                 lane_groups[int(arrays["http_path_lane"][r]),
@@ -287,19 +318,39 @@ def build_resolve_plan(arrays: Dict[str, np.ndarray], n_http: int,
         "rp_g_anypath": g_anypath, "rp_g_haslog": g_haslog,
         "rp_rs_gmask": rs_gmask, "rp_path_gaccept": gacc,
         "rp_dns_rsmask": dns_rsmask,
+        "rp_rule_group": rule_group,
     }
     rp.update(k_arrays)
     rp.update(gen_arrays)
     meta = {"groups": len(groups), "lane_groups": lane_groups,
-            "kafka_groups": k_groups, "gen_groups": gen_groups}
+            "kafka_groups": k_groups, "gen_groups": gen_groups,
+            # attribution: group → ordered member rule ids per family
+            # (host-side; the explain plane maps a winning group back
+            # to concrete rules through these)
+            "group_rules": tuple(tuple(int(r) for r in rules)
+                                 for rules in groups.values()),
+            "kafka_group_rules": tuple(
+                tuple(int(r) for r in range(n_kafka)
+                      if int(k_arrays["rp_k_rule_group"][r]) == g)
+                for g in range(k_groups)),
+            "gen_group_rules": tuple(
+                tuple(int(r) for r in range(n_gen)
+                      if int(gen_arrays["rp_gen_rule_group"][r]) == g)
+                for g in range(gen_groups))}
     return rp, meta
 
 
 # --------------------------------------------------------- fused resolve --
 def _fused_l7_http(arrays, ruleset, words, gwords, l7t):
-    """Group-space HTTP conjunction: (http_ok, l7_log_http) bit-equal
-    to the legacy per-rule path."""
-    from cilium_tpu.engine.verdict import _bools_to_words, _rule_bit
+    """Group-space HTTP conjunction: (http_ok, l7_log_http, win)
+    bit-equal to the legacy per-rule path — ``win`` is the lowest
+    matched-and-in-ruleset group index (the attribution lane's value;
+    -1 when nothing matched)."""
+    from cilium_tpu.engine.verdict import (
+        _bools_to_words,
+        _first_lane,
+        _rule_bit,
+    )
 
     _path_w, method_w, host_w, hdr_w, _dns_w = words
     sig_ok = (_rule_bit(method_w, arrays["rp_g_method"])
@@ -315,6 +366,7 @@ def _fused_l7_http(arrays, ruleset, words, gwords, l7t):
     gmask = arrays["rp_rs_gmask"][ruleset]
     http_ok = (jnp.any((ok_words & gmask) != 0, axis=1)
                & (l7t == int(L7Type.HTTP)))
+    win = _first_lane(ok_words & gmask)
     # LOG-action lanes ride the group signature: a matching group
     # whose LOG lane mismatched raises l7_log (allow + log)
     log_bits = jax.vmap(lambda lanes: _rule_bit(hdr_w, lanes),
@@ -323,20 +375,24 @@ def _fused_l7_http(arrays, ruleset, words, gwords, l7t):
                 & arrays["rp_g_haslog"][None, :])
     logw = _bools_to_words(ok_g & log_fail, Gw)
     l7_log_http = jnp.any((logw & gmask) != 0, axis=1) & http_ok
-    return http_ok, l7_log_http
+    return http_ok, l7_log_http, win
 
 
 def _fused_l7_dns(arrays, ruleset, dns_w, l7t):
+    from cilium_tpu.engine.verdict import _first_lane
+
     dmask = arrays["rp_dns_rsmask"][ruleset]
-    return (jnp.any((dns_w & dmask) != 0, axis=1)
-            & (l7t == int(L7Type.DNS)))
+    ok = (jnp.any((dns_w & dmask) != 0, axis=1)
+          & (l7t == int(L7Type.DNS)))
+    return ok, _first_lane(dns_w & dmask)
 
 
 def _fused_l7_kafka(arrays, ruleset, kafka_cols, l7t):
     """Group-space kafka conjunction over the DEDUPED predicate table
     (``rp_k_*``) — same formula as the legacy ``_l7_kafka``, evaluated
-    once per distinct predicate instead of once per rule."""
-    from cilium_tpu.engine.verdict import _bools_to_words
+    once per distinct predicate instead of once per rule. Returns
+    ``(ok, win)`` with ``win`` the lowest matched group index."""
+    from cilium_tpu.engine.verdict import _bools_to_words, _first_lane
 
     k_api, k_ver, k_cli, k_top = kafka_cols
     ak = jnp.clip(k_api, 0, 31).astype(jnp.uint32)
@@ -355,14 +411,15 @@ def _fused_l7_kafka(arrays, ruleset, kafka_cols, l7t):
     )
     gmask = arrays["rp_rs_kmask"][ruleset]
     g_words = _bools_to_words(g_ok, gmask.shape[1])
-    return (jnp.any((g_words & gmask) != 0, axis=1)
-            & (l7t == int(L7Type.KAFKA)))
+    ok = (jnp.any((g_words & gmask) != 0, axis=1)
+          & (l7t == int(L7Type.KAFKA)))
+    return ok, _first_lane(g_words & gmask)
 
 
 def _fused_l7_generic(arrays, ruleset, gen_cols, l7t):
     """Group-space generic pair-subset matching over the deduped
     (proto, pair-set) predicate table (``rp_gen_*``)."""
-    from cilium_tpu.engine.verdict import _bools_to_words
+    from cilium_tpu.engine.verdict import _bools_to_words, _first_lane
 
     gen_proto, gen_pairs = gen_cols
     grp = arrays["rp_gen_pairs"]                # [Gg, Km]
@@ -377,8 +434,9 @@ def _fused_l7_generic(arrays, ruleset, gen_cols, l7t):
         & (arrays["rp_gen_proto"] >= 0)[None, :]
     gmask = arrays["rp_rs_genmask"][ruleset]
     g_words = _bools_to_words(g_ok, gmask.shape[1])
-    return (jnp.any((g_words & gmask) != 0, axis=1)
-            & (l7t == int(L7Type.GENERIC)))
+    ok = (jnp.any((g_words & gmask) != 0, axis=1)
+          & (l7t == int(L7Type.GENERIC)))
+    return ok, _first_lane(g_words & gmask)
 
 
 def fused_verdict_core(arrays, ms, l7t, words, gwords, kafka_cols,
@@ -392,28 +450,38 @@ def fused_verdict_core(arrays, ms, l7t, words, gwords, kafka_cols,
     per-rule helpers, still bit-equal."""
     from cilium_tpu.engine.verdict import (
         _assemble_verdict,
+        _combine_l7_match,
         _l7_generic,
         _l7_kafka,
     )
 
     ruleset = jnp.clip(ms["ruleset"], 0,
                        arrays["rs_http_mask"].shape[0] - 1)
-    http_ok, l7_log_http = _fused_l7_http(arrays, ruleset, words,
-                                          gwords, l7t)
+    http_ok, l7_log_http, http_win = _fused_l7_http(
+        arrays, ruleset, words, gwords, l7t)
     if "rp_rs_kmask" in arrays:      # static under jit
-        kafka_ok = _fused_l7_kafka(arrays, ruleset, kafka_cols, l7t)
+        kafka_ok, kafka_win = _fused_l7_kafka(arrays, ruleset,
+                                              kafka_cols, l7t)
     else:
-        kafka_ok = _l7_kafka(arrays, ruleset, kafka_cols, l7t)
-    dns_ok = _fused_l7_dns(arrays, ruleset, words[4], l7t)
+        kafka_ok, kafka_win = _l7_kafka(arrays, ruleset, kafka_cols,
+                                        l7t)
+    dns_ok, dns_win = _fused_l7_dns(arrays, ruleset, words[4], l7t)
     l7_ok = http_ok | kafka_ok | dns_ok
+    gen_ok = gen_win = None
     if gen_cols is not None:
         if "rp_rs_genmask" in arrays:
-            l7_ok = l7_ok | _fused_l7_generic(arrays, ruleset,
-                                              gen_cols, l7t)
+            gen_ok, gen_win = _fused_l7_generic(arrays, ruleset,
+                                                gen_cols, l7t)
         else:
-            l7_ok = l7_ok | _l7_generic(arrays, ruleset, gen_cols, l7t)
+            gen_ok, gen_win = _l7_generic(arrays, ruleset, gen_cols,
+                                          l7t)
+        l7_ok = l7_ok | gen_ok
+    l7_match = _combine_l7_match(
+        (http_ok, http_win), (kafka_ok, kafka_win),
+        (dns_ok, dns_win),
+        (gen_ok, gen_win) if gen_ok is not None else None)
     return _assemble_verdict(arrays, ms, l7_ok, l7_log_http,
-                             auth_src_dst, batch)
+                             auth_src_dst, batch, l7_match=l7_match)
 
 
 # ------------------------------------------------------------ fused step --

@@ -357,21 +357,37 @@ def auth_signature(authed_pairs) -> Optional[str]:
     return hashlib.sha1(a.tobytes()).hexdigest()
 
 
-#: column order of the packed [N, 9] int32 memo table — every output
-#: lane of ``_verdict_core`` (bool lanes stored as 0/1)
+#: the reason-label values a memo drop can be counted under
+#: (``cilium_tpu_verdict_memo_invalidations_total{reason=...}``) —
+#: the canonical registry ctlint's ``obs-doc-parity`` reason-label
+#: extension holds docs/OBSERVABILITY.md to
+INVALIDATION_REASONS = ("policy-swap", "auth-change", "session-reset",
+                        "bank-swap", "no-change")
+
+#: column order of the packed [N, 10] int32 memo table — every output
+#: lane of ``_verdict_core`` (bool lanes stored as 0/1). ``l7_match``
+#: is the attribution lane: memoized verdicts keep their provenance,
+#: so a memo-served row can still name the rule that produced it.
 MEMO_COLS = ("verdict", "match_spec", "ruleset", "allowed",
              "l3l4_allowed", "redirect", "l7_ok", "l7_log",
-             "auth_required")
-_MEMO_INT = frozenset(("verdict", "match_spec", "ruleset"))
+             "auth_required", "l7_match")
+_MEMO_INT = frozenset(("verdict", "match_spec", "ruleset", "l7_match"))
 
 
 def memo_pack(out: Dict) -> "object":
-    """Verdict-step output dict → one [N, 9] int32 block (traceable;
-    fused into the fill step's jit)."""
+    """Verdict-step output dict → one [N, 10] int32 block (traceable;
+    fused into the fill step's jit). Outputs from a pre-attribution
+    producer (no ``l7_match`` lane) pack -1 — "unattributed", the
+    honest value."""
     import jax.numpy as jnp
 
-    return jnp.stack([out[c].astype(jnp.int32) for c in MEMO_COLS],
-                     axis=1)
+    cols = []
+    for c in MEMO_COLS:
+        if c in out:
+            cols.append(out[c].astype(jnp.int32))
+        else:
+            cols.append(jnp.full(out["verdict"].shape, -1, jnp.int32))
+    return jnp.stack(cols, axis=1)
 
 
 @functools.lru_cache(maxsize=1)
@@ -442,9 +458,16 @@ class VerdictMemo:
         self.device = device
         self._gen = policy_generation()
         self._auth_sig: Optional[str] = None
-        self.table = None          # [cap, 9] int32 on device
+        self.table = None          # [cap, 10] int32 on device
         self.capacity = 0
         self.filled = 0            # row ids [0, filled) are memoized
+        #: host-side per-slot CITED generation: the policy epoch each
+        #: slot's outputs were computed under. A memo-served verdict
+        #: cites its fill-time generation (the explanation-honesty
+        #: contract: what you cite is what you computed under), which
+        #: under bank-scoped deltas is legitimately older than the
+        #: current epoch for untouched rows.
+        self.gens: Optional[np.ndarray] = None
         #: lifetime counters (mirrors of the METRICS families)
         self.hits = 0
         self.misses = 0
@@ -470,6 +493,7 @@ class VerdictMemo:
         self.table = None
         self.capacity = 0
         self.filled = 0
+        self.gens = None
         self._auth_sig = None
         self._gen = policy_generation()
         self.invalidations += 1
@@ -509,6 +533,13 @@ class VerdictMemo:
         self.table = _scatter_step()(
             self.table, jax.device_put(idx, self.device),
             jnp.asarray(packed_block))
+        if self.gens is not None:
+            # refilled rows were COMPUTED now: they cite the current
+            # generation; untouched rows keep citing theirs (the
+            # hot-swap half of the explanation-honesty contract)
+            real = np.asarray(idx[:n_real]).astype(np.int64)
+            self.gens[real[real < len(self.gens)]] = \
+                policy_generation()
         self.misses += n_real
         METRICS.inc(VERDICT_MEMO_MISSES, n_real)
 
@@ -534,11 +565,30 @@ class VerdictMemo:
             if old is not None:
                 grown = _update_step()(grown, old, 0)
             self.table = grown
+        if self.gens is None or cap_needed > len(self.gens):
+            grown_g = np.zeros(cap_needed, dtype=np.int64)
+            if self.gens is not None:
+                grown_g[:len(self.gens)] = self.gens
+            self.gens = grown_g
         self.table = _update_step()(self.table,
                                     jnp.asarray(packed_block), base)
+        self.gens[base:base + n_new] = policy_generation()
         self.filled = max(self.filled, base + n_new)
         self.misses += n_new
         METRICS.inc(VERDICT_MEMO_MISSES, n_new)
+
+    def cited_gens(self, idx) -> "np.ndarray":
+        """Host-side cited generation per served row id — the
+        generation each slot's outputs were computed under (see
+        :attr:`gens`). Unknown slots (pre-attribution memo, padding)
+        cite -1."""
+        ids = np.asarray(idx).astype(np.int64)
+        if self.gens is None:
+            return np.full(len(ids), -1, dtype=np.int64)
+        out = np.full(len(ids), -1, dtype=np.int64)
+        ok = (ids >= 0) & (ids < len(self.gens))
+        out[ok] = self.gens[ids[ok]]
+        return out
 
     # -- read -------------------------------------------------------------
     def gather(self, idx) -> Dict:

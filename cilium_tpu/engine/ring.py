@@ -109,8 +109,11 @@ class VerdictRing:
 
     def __init__(self, engine, capacity: int, loader=None,
                  widths: Optional[Dict[str, int]] = None,
-                 memo: bool = True):
+                 memo: bool = True, provenance: bool = False):
         self.capacity = max(1, int(capacity))
+        #: serve with the attribution/provenance lanes riding the
+        #: dispatch (engine/attribution.ServedPack per chunk)
+        self.provenance = bool(provenance)
         self.session = IncrementalSession(engine, widths=widths,
                                           memo=memo, loader=loader)
         self._lock = threading.Lock()
@@ -277,7 +280,8 @@ class VerdictRing:
                                  for slot, idx, done, _ in batch)
                     return [(s, n, d, None) for s, n, d in stale]
                 verdicts = self.session.serve_ids(
-                    packed, authed_pairs=authed_pairs)
+                    packed, authed_pairs=authed_pairs,
+                    provenance=self.provenance)
         except Exception:
             # dispatch failed (injected fault, sick device): put the
             # batch BACK at the slots' heads — the next cycle retries
@@ -304,11 +308,18 @@ class VerdictRing:
         METRICS.observe(SERVE_PACK_RECORDS, float(total))
         METRICS.observe(SERVE_PACK_STREAMS,
                         float(len({s.slot_id for s, _, _, _ in batch})))
+        if self.provenance and hasattr(verdicts, "slice"):
+            # stamp the pack-cycle id on the bundle before slicing —
+            # every chunk of this dispatch shares it
+            verdicts.pack_cycle = self.packs
         out: List[Tuple[RingSlot, int, object, object]] = []
         base = 0
         for slot, idx, done, _ in batch:
             n = len(idx)
-            out.append((slot, n, done, verdicts[base:base + n]))
+            piece = (verdicts.slice(base, n)
+                     if hasattr(verdicts, "slice")
+                     else verdicts[base:base + n])
+            out.append((slot, n, done, piece))
             slot.records_out += n
             base += n
         out.extend((s, n, d, None) for s, n, d in stale)

@@ -500,12 +500,19 @@ class IncrementalSession:
         idx = self._row_idx(rows)
         return idx, self.n_rows - before
 
-    def serve_ids(self, idx: np.ndarray, authed_pairs=None):
+    def serve_ids(self, idx: np.ndarray, authed_pairs=None,
+                  provenance: bool = False):
         """DEVICE half: flush pending string/row deltas and serve one
         id vector — ONE fused dispatch (delta verdict step + memo
         fill) plus one on-device gather, however many streams'
         chunks were packed into ``idx``. Returns the device verdict
-        array aligned to ``idx`` (padding sliced by the caller)."""
+        array aligned to ``idx`` (padding sliced by the caller); with
+        ``provenance=True`` returns a
+        :class:`~cilium_tpu.engine.attribution.ServedPack` carrying
+        the attribution lane, per-row cited generations, and the
+        memo-hit/computed split alongside the verdicts (same
+        dispatch — the extra lanes ride the gather the memo already
+        does)."""
         for t in self.tables.values():
             t.flush()
         self._flush_rows()
@@ -521,12 +528,40 @@ class IncrementalSession:
         _faults.maybe_fail(DISPATCH_POINT)
         table_words = {f: self.tables[f].words for f in _FIELDS}
         if self.memo is not None:
-            return self._memo_serve(idx, table_words, authed_pairs)
+            return self._memo_serve(idx, table_words, authed_pairs,
+                                    provenance=provenance)
         batch = {"rows": self.rows_dev,
                  "idx": jax.device_put(idx, self.engine.device)}
         self.engine._stage_auth(batch, authed_pairs)
         out = self._step(self.engine._arrays, table_words, batch)
-        return out["verdict"]
+        if not provenance:
+            return out["verdict"]
+        return self._pack_provenance(out, idx, memo_hit=None)
+
+    def _pack_provenance(self, out, idx, memo_hit=None):
+        """Build the ServedPack for one served id vector. ``out`` is
+        the step/gather output dict; ``memo_hit`` the per-row
+        hit mask (None = everything computed this dispatch)."""
+        from cilium_tpu.engine.attribution import (
+            ServedPack,
+            kernel_label,
+        )
+        from cilium_tpu.engine.memo import policy_generation
+
+        gen_now = policy_generation()
+        n = len(idx)
+        if memo_hit is None:
+            memo_hit = np.zeros(n, dtype=bool)
+        if self.memo is not None and self.memo.gens is not None:
+            gens = self.memo.cited_gens(idx)
+        else:
+            gens = np.full(n, gen_now, dtype=np.int64)
+        return ServedPack(
+            verdict=out["verdict"],
+            l7_match=out.get("l7_match"),
+            match_spec=out["match_spec"],
+            gens=gens, memo_hit=memo_hit, generation=gen_now,
+            kernel=kernel_label(self.engine))
 
     def verdict_chunk(self, rec, l7, offsets, blob, gen=None,
                       authed_pairs=None):
@@ -554,7 +589,7 @@ class IncrementalSession:
             return n, self.serve_ids(idx, authed_pairs=authed_pairs)
 
     def _memo_serve(self, idx: np.ndarray, table_words,
-                    authed_pairs) -> jax.Array:
+                    authed_pairs, provenance: bool = False):
         """Serve one (padded) id chunk from the verdict memo. Outputs
         for DELTA rows — session rows newer than the memo's fill mark
         — are computed first through the shared capture step (so
@@ -566,6 +601,7 @@ class IncrementalSession:
         sig = auth_signature(authed_pairs)
         m = self.memo
         m.valid_for(sig)  # drops the memo on generation/auth change
+        base0 = m.filled  # rows below this mark are memo HITS
         if m.filled < self.n_rows:
             base = m.filled
             n_new = self.n_rows - base
@@ -596,6 +632,15 @@ class IncrementalSession:
             self.engine._stage_auth(batch, authed_pairs)
             out = self._step(self.engine._arrays, table_words, batch)
             m.refill_scatter(ridx, memo_pack(out), len(dirty))
+        refilled = dirty if dirty is not None else None
         self._memo_dirty = None
-        return m.gather(
-            jax.device_put(idx, self.engine.device))["verdict"]
+        gathered = m.gather(jax.device_put(idx, self.engine.device))
+        if not provenance:
+            return gathered["verdict"]
+        # memo-hit = the row was resident BEFORE this dispatch and was
+        # not rewritten by the bank-scoped refill above — everything
+        # else was computed under the current generation
+        hit = idx < base0
+        if refilled is not None and len(refilled):
+            hit &= ~np.isin(idx, refilled)
+        return self._pack_provenance(gathered, idx, memo_hit=hit)

@@ -1249,9 +1249,64 @@ def unpack_batch(packed: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
     return out
 
 
+#: masked-min sentinel for the attribution winners (any value past
+#: every legal lane/group/rule index). A plain int, NOT a jnp
+#: constant: a module-level jax array would initialize the backend at
+#: import time, before tests/conftest.py can force the virtual mesh.
+_ATTR_NONE = 0x7FFFFFFF
+
+
+def _first_lane(words: "jax.Array") -> "jax.Array":
+    """[B, W] uint32 masked match words → the lowest set LANE index
+    per row (int32; -1 when no bit is set). The device half of the
+    attribution lane: a lane here is a group index (group-accept
+    words), a DNS pattern lane, or a kafka/generic predicate-group
+    bit, depending on which words the caller masked."""
+    nz = words != 0
+    any_ = jnp.any(nz, axis=1)
+    i0 = jnp.argmax(nz, axis=1).astype(jnp.int32)   # first nonzero word
+    w = jnp.take_along_axis(words, i0[:, None], axis=1)[:, 0]
+    lsb = w & (~w + jnp.uint32(1))
+    bit = jax.lax.population_count(lsb - jnp.uint32(1)).astype(jnp.int32)
+    return jnp.where(any_, i0 * 32 + bit, -1)
+
+
+def _masked_min(matched: "jax.Array", values: "jax.Array"
+                ) -> "jax.Array":
+    """min over ``values[r]`` where ``matched[b, r]`` (and the value
+    is non-negative) → [B] int32, -1 when nothing matched. The legacy
+    per-rule face of the attribution winner — with ``values`` a
+    rule→group map it equals the fused path's lowest matched group
+    (a group matches iff one of its member rules does)."""
+    v = values[None, :].astype(jnp.int32)
+    big = jnp.where(matched & (v >= 0), v, _ATTR_NONE)
+    m = jnp.min(big, axis=1)
+    return jnp.where(m == _ATTR_NONE, -1, m)
+
+
+def _combine_l7_match(http, kafka, dns, gen=None) -> "jax.Array":
+    """Per-family (ok, win) pairs → ONE [B] int32 attribution lane.
+    Families are mutually exclusive per flow (every family's ``ok``
+    is gated on its own ``l7t``), so the combine is a select, not a
+    priority."""
+    http_ok, http_win = http
+    kafka_ok, kafka_win = kafka
+    dns_ok, dns_win = dns
+    out = jnp.where(http_ok, http_win,
+                    jnp.where(kafka_ok, kafka_win,
+                              jnp.where(dns_ok, dns_win, -1)))
+    if gen is not None:
+        gen_ok, gen_win = gen
+        out = jnp.where((out < 0) & gen_ok, gen_win, out)
+    return out.astype(jnp.int32)
+
+
 def _l7_kafka(arrays, ruleset, kafka_cols, l7t):
-    """Kafka columnar exact/set matching → ruleset-any [B] bool.
-    Shared verbatim by the legacy and fused (megakernel) resolves."""
+    """Kafka columnar exact/set matching → ``(ruleset-any [B] bool,
+    attribution winner [B] int32)``. Shared verbatim by the legacy
+    and fused (megakernel) resolves; the winner is reported in GROUP
+    space when the resolve plan staged ``rp_k_rule_group`` (bit-equal
+    to the fused arm's lowest matched group), else in rule space."""
     k_api, k_ver, k_cli, k_top = kafka_cols
     ak = jnp.clip(k_api, 0, 31).astype(jnp.uint32)
     am = arrays["kafka_apikey_mask"][None, :]        # [1, Rk]
@@ -1270,13 +1325,22 @@ def _l7_kafka(arrays, ruleset, kafka_cols, l7t):
     )
     kafka_mask = arrays["rs_kafka_mask"][ruleset]
     k_words = _bools_to_words(k_ok, kafka_mask.shape[1])
-    return (jnp.any((k_words & kafka_mask) != 0, axis=1)
-            & (l7t == int(L7Type.KAFKA)))
+    ok = (jnp.any((k_words & kafka_mask) != 0, axis=1)
+          & (l7t == int(L7Type.KAFKA)))
+    Rk = k_ok.shape[1]
+    r_idx = jnp.arange(Rk)
+    in_set = ((kafka_mask[:, r_idx >> 5]
+               >> (r_idx & 31).astype(jnp.uint32)) & 1).astype(bool)
+    values = (arrays["rp_k_rule_group"]
+              if "rp_k_rule_group" in arrays
+              else jnp.arange(Rk, dtype=jnp.int32))
+    return ok, _masked_min(k_ok & in_set, values)
 
 
 def _l7_generic(arrays, ruleset, gen_cols, l7t):
-    """Generic l7proto pair-subset matching → ruleset-any [B] bool.
-    Shared verbatim by the legacy and fused resolves."""
+    """Generic l7proto pair-subset matching → ``(ruleset-any [B]
+    bool, attribution winner [B] int32)``. Shared verbatim by the
+    legacy and fused resolves (winner space: see ``_l7_kafka``)."""
     gen_proto, gen_pairs = gen_cols
     grp = arrays["gen_rule_pairs"]              # [Rg, Km]
     have = jnp.any(
@@ -1289,15 +1353,29 @@ def _l7_generic(arrays, ruleset, gen_cols, l7t):
     g_ok = pair_ok & proto_ok & (arrays["gen_rule_proto"] >= 0)[None, :]
     gen_mask = arrays["rs_gen_mask"][ruleset]
     g_words = _bools_to_words(g_ok, gen_mask.shape[1])
-    return (jnp.any((g_words & gen_mask) != 0, axis=1)
-            & (l7t == int(L7Type.GENERIC)))
+    ok = (jnp.any((g_words & gen_mask) != 0, axis=1)
+          & (l7t == int(L7Type.GENERIC)))
+    Rg = g_ok.shape[1]
+    r_idx = jnp.arange(Rg)
+    in_set = ((gen_mask[:, r_idx >> 5]
+               >> (r_idx & 31).astype(jnp.uint32)) & 1).astype(bool)
+    values = (arrays["rp_gen_rule_group"]
+              if "rp_gen_rule_group" in arrays
+              else jnp.arange(Rg, dtype=jnp.int32))
+    return ok, _masked_min(g_ok & in_set, values)
 
 
 def _assemble_verdict(arrays, ms, l7_ok, l7_log_http, auth_src_dst,
-                      batch):
+                      batch, l7_match=None):
     """Precedence + auth + audit assembly → the output dict. ONE
     implementation for every resolve path (legacy, fused, capture) so
-    none can drift on the verdict-code semantics."""
+    none can drift on the verdict-code semantics.
+
+    ``l7_match`` is the attribution lane ([B] int32): the winning
+    L7 rule-signature group (group space, the fused plan) or rule
+    index (rule space, plan-less policies) of the family that
+    matched; -1 = no L7 winner. The host side maps it to rule id +
+    bank key through ``engine/attribution.AttributionMap``."""
     allowed = ms["allowed"] & (l7_ok | ~ms["redirect"])
     auth_required = ms["auth_required"]
     if "auth_pairs" in batch:  # static key check: enforcement staged
@@ -1325,6 +1403,8 @@ def _assemble_verdict(arrays, ms, l7_ok, l7_log_http, auth_src_dst,
                   int(Verdict.FORWARDED)),
         deny_code,
     ).astype(jnp.int32)
+    if l7_match is None:
+        l7_match = jnp.full(l7_ok.shape, -1, jnp.int32)
     return {
         "verdict": verdict,
         "allowed": allowed,
@@ -1335,6 +1415,7 @@ def _assemble_verdict(arrays, ms, l7_ok, l7_log_http, auth_src_dst,
         "match_spec": ms["match_spec"],
         "ruleset": ms["ruleset"],
         "auth_required": ms["auth_required"],
+        "l7_match": l7_match.astype(jnp.int32),
     }
 
 
@@ -1378,6 +1459,17 @@ def _verdict_core(arrays, ms, l7t, words, kafka_cols, auth_src_dst,
     # flow.http is None → no HTTP rule matches)
     http_ok = (jnp.any((rule_words & http_mask) != 0, axis=1)
                & (l7t == int(L7Type.HTTP)))
+    r_idx = jnp.arange(rule_ok.shape[1])
+    in_set = ((http_mask[:, r_idx >> 5]
+               >> (r_idx & 31).astype(jnp.uint32)) & 1).astype(bool)
+    # attribution winner: in GROUP space when the plan staged the
+    # rule→group map (equals the fused arm's lowest matched group —
+    # a group matches iff one of its member rules does), else the
+    # lowest matched rule index
+    http_win = _masked_min(
+        rule_ok & in_set,
+        (arrays["rp_rule_group"] if "rp_rule_group" in arrays
+         else jnp.arange(rule_ok.shape[1], dtype=jnp.int32)))
 
     # LOG-action header matches: a matching rule whose LOG lane
     # mismatched raises the flow's l7_log lane (allow + log, the
@@ -1388,15 +1480,12 @@ def _verdict_core(arrays, ms, l7t, words, kafka_cols, auth_src_dst,
                             in_axes=1, out_axes=2)(log_lanes)
         # padding lanes (-1) read True via _rule_bit → ~bits masks them
         log_fail = jnp.any(~log_bits, axis=2)        # [B, R]
-        r_idx = jnp.arange(rule_ok.shape[1])
-        in_set = ((http_mask[:, r_idx >> 5]
-                   >> (r_idx & 31).astype(jnp.uint32)) & 1).astype(bool)
         l7_log_http = jnp.any(rule_ok & in_set & log_fail, axis=1) \
             & http_ok
     else:
         l7_log_http = jnp.zeros_like(http_ok)
 
-    kafka_ok = _l7_kafka(arrays, ruleset, kafka_cols, l7t)
+    kafka_ok, kafka_win = _l7_kafka(arrays, ruleset, kafka_cols, l7t)
 
     # DNS: qname automaton
     d_ok = (_rule_bit(dns_w, arrays["dns_lane"])
@@ -1405,17 +1494,30 @@ def _verdict_core(arrays, ms, l7t, words, kafka_cols, auth_src_dst,
     d_words = _bools_to_words(d_ok, dns_mask.shape[1])
     dns_ok = (jnp.any((d_words & dns_mask) != 0, axis=1)
               & (l7t == int(L7Type.DNS)))
+    # DNS attribution is always LANE space (the fused arm reads the
+    # same lanes off its ruleset lane-mask)
+    dr_idx = jnp.arange(d_ok.shape[1])
+    dns_in_set = ((dns_mask[:, dr_idx >> 5]
+                   >> (dr_idx & 31).astype(jnp.uint32)) & 1
+                  ).astype(bool)
+    dns_win = _masked_min(d_ok & dns_in_set, arrays["dns_lane"])
 
     # allow-list over the union of the ruleset's families (a merged
     # entry can carry several protocol families; oracle checks all)
     l7_ok = http_ok | kafka_ok | dns_ok
 
+    gen_pair = None
     if gen_cols is not None:
         # generic l7proto records: pair-subset matching
-        l7_ok = l7_ok | _l7_generic(arrays, ruleset, gen_cols, l7t)
+        gen_ok, gen_win = _l7_generic(arrays, ruleset, gen_cols, l7t)
+        l7_ok = l7_ok | gen_ok
+        gen_pair = (gen_ok, gen_win)
 
+    l7_match = _combine_l7_match((http_ok, http_win),
+                                 (kafka_ok, kafka_win),
+                                 (dns_ok, dns_win), gen_pair)
     return _assemble_verdict(arrays, ms, l7_ok, l7_log_http,
-                             auth_src_dst, batch)
+                             auth_src_dst, batch, l7_match=l7_match)
 
 
 #: transfer order of the single-blob service transport (pack_blob_host
@@ -1638,6 +1740,20 @@ class VerdictEngine:
         #: layout-tuple → jitted blob step (the layout is static per
         #: config; distinct layouts are distinct compiles)
         self._blob_steps: Dict[tuple, object] = {}
+        #: lazily-built host-side attribution decoder (provenance)
+        self._attribution = None
+
+    @property
+    def attribution(self):
+        """Host-side :class:`~cilium_tpu.engine.attribution.
+        AttributionMap` over this engine's policy — decodes the
+        ``l7_match`` output lane to rule ids + bank keys. Built once
+        per engine (the policy is immutable per revision)."""
+        if self._attribution is None:
+            from cilium_tpu.engine.attribution import AttributionMap
+
+            self._attribution = AttributionMap.from_policy(self.policy)
+        return self._attribution
 
     def verdict_batch_arrays(self, batch: Dict[str, jax.Array]):
         _faults.maybe_fail(DISPATCH_POINT)

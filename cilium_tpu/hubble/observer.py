@@ -23,17 +23,36 @@ from cilium_tpu.runtime.tracing import TRACER
 
 
 def annotate_flows(flows: Sequence[Flow], outputs: Dict[str, np.ndarray],
-                   stamp_time: bool = True) -> Sequence[Flow]:
-    """Merge engine outputs (verdict/match_spec arrays) onto flows.
+                   stamp_time: bool = True, amap=None,
+                   prov=None) -> Sequence[Flow]:
+    """Merge engine outputs (verdict/match_spec/attribution arrays)
+    onto flows.
 
     When a flight-recorder trace is active (service verdict op, CLI
     replay chunk), its id is stamped on each flow — the Hubble record
-    then joins the trace spans and the JSONL log lines on one id."""
+    then joins the trace spans and the JSONL log lines on one id.
+
+    ``policy_match_type`` is filled HONESTLY from the attribution
+    lane when the outputs carry it (``l7_match`` ≥ 0 ⇒ an L7 rule
+    actually matched ⇒ ``L7``); pre-attribution outputs keep the old
+    spec-derived mapping. ``amap`` (an
+    ``engine/attribution.AttributionMap``) additionally stamps the
+    provenance fields (packed word, rule label, bank key); ``prov``
+    (a ``ServedPack``) refines the cited generation and memo-hit per
+    row — without it, attributed flows cite the current policy
+    generation as computed-now."""
     verdicts = np.asarray(outputs["verdict"])
     specs = np.asarray(outputs.get("match_spec",
                                    np.full(len(flows), -1)))
+    l7m = (np.asarray(outputs["l7_match"])
+           if "l7_match" in outputs else None)
     now = simclock.wall()
     trace_id = TRACER.current_trace_id()
+    gen_now = -1
+    if amap is not None:
+        from cilium_tpu.engine.memo import policy_generation
+
+        gen_now = policy_generation()
     for i, f in enumerate(flows):
         f.verdict = Verdict(int(verdicts[i]))
         if stamp_time and not f.time:
@@ -41,7 +60,10 @@ def annotate_flows(flows: Sequence[Flow], outputs: Dict[str, np.ndarray],
         if trace_id and not f.trace_id:
             f.trace_id = trace_id
         spec = int(specs[i]) if i < len(specs) else -1
-        if f.verdict == Verdict.REDIRECTED:
+        code = int(l7m[i]) if l7m is not None and i < len(l7m) else -1
+        if code >= 0 or f.verdict == Verdict.REDIRECTED:
+            # an L7 rule demonstrably matched (attribution lane), or
+            # the legacy REDIRECTED signal on pre-attribution outputs
             f.policy_match_type = PolicyMatchType.L7
         elif spec >= 8:
             f.policy_match_type = PolicyMatchType.NONE  # denied
@@ -53,6 +75,23 @@ def annotate_flows(flows: Sequence[Flow], outputs: Dict[str, np.ndarray],
             f.policy_match_type = PolicyMatchType.L4_ONLY
         else:
             f.policy_match_type = PolicyMatchType.NONE
+        if amap is not None and l7m is not None:
+            from cilium_tpu.engine.attribution import pack_word
+
+            gen = (int(prov.gens[i]) if prov is not None
+                   and i < len(prov.gens) else gen_now)
+            hit = (bool(prov.memo_hit[i]) if prov is not None
+                   and i < len(prov.memo_hit) else False)
+            kernel = prov.kernel if prov is not None else ""
+            cycle = prov.pack_cycle if prov is not None else 0
+            f.prov_word = pack_word(code, int(f.l7), hit, gen,
+                                    cycle, kernel)
+            f.prov_generation = gen
+            f.prov_memo = hit
+            res = amap.resolve(int(f.l7), code) if code >= 0 else None
+            if res is not None:
+                f.prov_rule = amap.rule_label(int(f.l7), code)
+                f.prov_bank = str(res.get("bank_key", "") or "")
     return flows
 
 

@@ -18,6 +18,7 @@ from cilium_tpu.core.flow import (
     HTTPInfo,
     KafkaInfo,
     L7Type,
+    PolicyMatchType,
     Protocol,
     TrafficDirection,
     Verdict,
@@ -65,6 +66,25 @@ def flow_to_dict(f: Flow) -> Dict:
         d["node_name"] = f.node_name
     if f.trace_id:
         d["trace_id"] = f.trace_id
+    if f.policy_match_type != PolicyMatchType.NONE:
+        # flowpb policy_match_type, finally filled honestly (the
+        # attribution lane); omitted when NONE so old flows and new
+        # no-match flows serialize identically
+        d["policy_match_type"] = int(f.policy_match_type)
+    if f.prov_word:
+        # verdict provenance (engine/attribution.py): absent on old
+        # writers; old READERS ignore the unknown key — both
+        # directions pinned by tests/test_provenance.py
+        prov = {"word": int(f.prov_word)}
+        if f.prov_rule:
+            prov["rule"] = f.prov_rule
+        if f.prov_bank:
+            prov["bank"] = f.prov_bank
+        if f.prov_generation >= 0:
+            prov["generation"] = int(f.prov_generation)
+        if f.prov_memo:
+            prov["memo"] = True
+        d["provenance"] = prov
     if f.src_ip or f.dst_ip:
         d["IP"] = {"source": f.src_ip, "destination": f.dst_ip}
     l4_proto = Protocol(f.protocol)
@@ -153,6 +173,21 @@ def flow_from_dict(d: Dict) -> Flow:
     f.dst_labels = tuple(dst.get("labels") or ())
     f.node_name = d.get("node_name", "") or ""
     f.trace_id = d.get("trace_id", "") or ""
+    try:
+        # absent (old writers) decodes to NONE — the compat contract
+        f.policy_match_type = PolicyMatchType(
+            int(d.get("policy_match_type", 0) or 0))
+    except ValueError:
+        f.policy_match_type = PolicyMatchType.NONE
+    prov = d.get("provenance") or {}
+    if isinstance(prov, dict) and prov:
+        f.prov_word = int(prov.get("word", 0) or 0)
+        f.prov_rule = str(prov.get("rule", "") or "")
+        f.prov_bank = str(prov.get("bank", "") or "")
+        f.prov_generation = int(prov.get("generation", -1)
+                                if prov.get("generation") is not None
+                                else -1)
+        f.prov_memo = bool(prov.get("memo", False))
     ip = d.get("IP") or {}
     f.src_ip = ip.get("source", "")
     f.dst_ip = ip.get("destination", "")

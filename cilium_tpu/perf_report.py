@@ -28,7 +28,10 @@ This module is the reader:
   sharded multichip lane records more ledger collectives per compiled
   block than the budget it declared on the bench line
   (:func:`collective_budget_violations` — the structural guard
-  against a per-byte-collective regression).
+  against a per-byte-collective regression), or when a lane's
+  measured provenance-consumption overhead exceeds the budget it
+  declared (:func:`provenance_budget_violations` — the ≤2%
+  explain-plane cost contract).
 
 Faces: ``cilium-tpu perf-report``, ``python -m cilium_tpu.perf_report``,
 ``make perf-report`` (writes ``PERF_TRAJECTORY.json``, part of
@@ -102,7 +105,8 @@ _EXTRA_KEYS = ("tunnel_rtt_ms", "tunnel_rtt_max_ms", "stage_ms",
                "attempts", "transient", "memo", "memo_fill_ms",
                "memo_hits", "memo_misses", "dedup_ratio",
                "stage_warm_ms", "stage_warm_phases_ms",
-               "capture_write_ms", "capture_open_ms")
+               "capture_write_ms", "capture_open_ms",
+               "provenance_overhead_pct", "provenance_budget_pct")
 
 
 def _entry(source: str, kind: str, obj: Dict,
@@ -387,6 +391,44 @@ def collective_budget_violations(entries: List[Dict],
     return out
 
 
+def provenance_budget_violations(entries: List[Dict],
+                                 newest: Optional[int]) -> List[Dict]:
+    """The provenance-overhead gate (ISSUE 14): every bench lane that
+    DECLARES a provenance budget on its line
+    (``provenance_budget_pct``) is held to it against the measured
+    ``provenance_overhead_pct`` — the marginal cost of consuming the
+    attribution/provenance surfaces vs verdict-only windows. The
+    e2e capture-replay lane declares 2.0%. Only the NEWEST round
+    gates; lanes without a declared budget are not judged."""
+    out = []
+    for e in entries:
+        if e["status"] != "ok" or e["round"] != newest:
+            continue
+        budget = e["extras"].get("provenance_budget_pct")
+        measured = e["extras"].get("provenance_overhead_pct")
+        if budget is None or measured is None:
+            continue
+        if float(measured) <= float(budget):
+            continue
+        out.append({
+            "metric": f"{e['metric']}[provenance]",
+            "kind": e["kind"],
+            "from": e["round_label"],
+            "to": e["round_label"],
+            "from_value": float(budget),
+            "to_value": float(measured),
+            "direction": "lower",
+            "worse_factor": round(
+                float(measured) / max(float(budget), 1e-9), 4),
+            "classification": "code_regression",
+            "reason": (f"provenance-lane overhead "
+                       f"{float(measured):g}% over its declared "
+                       f"budget {float(budget):g}% — consuming the "
+                       f"attribution surfaces got expensive"),
+        })
+    return out
+
+
 # -- trajectory + classification --------------------------------------------
 
 def _effective_rtt(entry: Dict) -> Tuple[Optional[float], str]:
@@ -594,6 +636,8 @@ def build_trajectory(entries: List[Dict],
                 })
     collective_violations = collective_budget_violations(entries,
                                                          newest)
+    provenance_violations = provenance_budget_violations(entries,
+                                                         newest)
     return {
         "schema": TRAJECTORY_SCHEMA,
         "threshold": threshold,
@@ -604,7 +648,8 @@ def build_trajectory(entries: List[Dict],
         "deltas": deltas,
         "failures": failures,
         "gate_regressions": (gate + budget_violations
-                             + collective_violations),
+                             + collective_violations
+                             + provenance_violations),
     }
 
 

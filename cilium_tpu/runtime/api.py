@@ -21,6 +21,9 @@ shapes:
   GET    /v1/fqdn/cache     DNS cache dump
   GET    /v1/service        load-balancer services
   GET    /v1/metrics        Prometheus text exposition
+  GET    /v1/explain        verdict provenance for ?trace_id= — the
+                            recorded (rule, bank, generation), each
+                            re-resolved through the CPU oracle
   GET    /v1/trace          flight-recorder spans (runtime/tracing.py);
                             ?trace_id= filters, ?limit= bounds,
                             ?format=chrome → Chrome trace-event JSON
@@ -241,6 +244,17 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                         404, b'{"error": "metrics disabled"}')
                 return self._send(200, METRICS.expose().encode(),
                                   content_type="text/plain; version=0.0.4")
+            if path == "/v1/explain":
+                # verdict provenance for one trace id, re-resolved
+                # through the CPU oracle (runtime/explain.py)
+                from cilium_tpu.runtime.explain import resolve_explain
+
+                tid = query.get("trace_id") or ""
+                if not tid:
+                    return self._send(400, {"error": "explain needs "
+                                            "?trace_id="})
+                return self._send(200,
+                                  resolve_explain(agent.loader, tid))
             if path == "/v1/trace":
                 from cilium_tpu.runtime.tracing import TRACER
 

@@ -22,6 +22,13 @@ standing invariants checked after every event:
   (hits+misses == lookups) never lies.
 * **O(Δ) compile** — bank compiles grow with the CHANGE count, never
   with policy size × updates.
+* **Explanation honesty** — every sampled ring-served verdict's
+  provenance is trustworthy: the cited rule re-resolves to the served
+  verdict under the committed rule set AT THE CITED GENERATION, rows
+  computed this round cite the current generation, and memo-served
+  rows cite the (possibly older) generation they were actually
+  computed under — the exact staleness class the PR-11 review found
+  by hand, now searched continuously.
 * **Liveness** — with faults exhausted, bounded virtual time recovers
   everything: the breaker re-closes past its probe interval and
   quarantined banks clear past their TTL.
@@ -232,6 +239,14 @@ class DSTWorld:
         #: drain-restore (a restarted process builds a fresh one)
         self._serve = None
         self._serve_streams = 0
+        #: generation → (committed rules at that epoch, degraded?) —
+        #: the explanation-honesty invariant's re-resolve base:
+        #: memo-served rows cite the generation they were computed
+        #: under, and the cited rule set must still produce the
+        #: served verdict. Recorded lazily at every serve round (the
+        #: only place ring memo fills happen), bounded.
+        self._gen_snapshots: Dict[int, tuple] = {}
+        self._serve_gen = -1
 
     def bank_compiles(self) -> int:
         """Compile-or-fetch WORK units: with bank artifacts on, a
@@ -563,12 +578,26 @@ class DSTWorld:
             ShedError,
         )
 
+        from cilium_tpu.engine.memo import policy_generation
+
         if self._serve is None:
             self._serve = ServeLoop(self.loader, capacity=4,
                                     lease_ttl_s=10.0,
                                     pack_interval_s=0.01)
         loop = self._serve
         flows = self.corpus()
+        # explanation-honesty base: pin what "the committed rule set
+        # at this generation" MEANS before any fill can cite it. Ring
+        # memo fills only happen inside serve rounds, so lazily
+        # snapshotting here covers every citable generation.
+        self._serve_gen = policy_generation()
+        degraded_now = bool(self.loader.bank_status().get("degraded"))
+        self._gen_snapshots.setdefault(
+            self._serve_gen,
+            ({i: list(v) for i, v in self.committed.items()},
+             degraded_now))
+        while len(self._gen_snapshots) > 128:
+            self._gen_snapshots.pop(min(self._gen_snapshots))
         cols = flows_to_columns(flows)
         sections = (cols.rec, cols.l7, cols.offsets, cols.blob,
                     cols.gen)
@@ -596,6 +625,7 @@ class DSTWorld:
         degraded = bool(self.loader.bank_status().get("degraded"))
         want = None
         got_digest = ""
+        prov_checked = 0
         for t in tickets:
             if not t.done:
                 raise InvariantViolation(
@@ -621,6 +651,12 @@ class DSTWorld:
                     index, "serve-stale",
                     "ring verdicts diverged from the serving engine")
             got_digest = _digest(got)
+            if not prov_checked and t.prov is not None:
+                # one ticket's worth of sampled explanation-honesty
+                # checks per round (tickets share the corpus; one
+                # bound keeps the schedule cost flat)
+                prov_checked = self._check_explanation_honesty(
+                    t, flows, index, degraded)
         st = loop.status()
         if st["grants"] - st["expiries"] - st["releases"] \
                 != st["occupancy"]:
@@ -633,7 +669,79 @@ class DSTWorld:
                 "grants_new": loop.grants - grants_before,
                 "occupancy": st["occupancy"],
                 "bytes_saved": st["bytes_saved"],
-                "verdicts": got_digest}
+                "verdicts": got_digest,
+                "prov_checked": prov_checked}
+
+    def _check_explanation_honesty(self, ticket, flows, index: int,
+                                   degraded_now: bool) -> int:
+        """The explanation-honesty invariant over one resolved
+        ticket's provenance: sampled rows must (a) cite a generation
+        whose committed rule set was recorded, (b) cite the CURRENT
+        generation when computed this round (memo-hit rows may
+        legitimately cite older epochs — that is the point), and (c)
+        re-resolve, under the cited generation's committed rules, to
+        the served verdict (fail-closed comparison when either epoch
+        was degraded). Returns sampled-row count."""
+        import numpy as np
+
+        from cilium_tpu.core.flow import Verdict
+        from cilium_tpu.policy.oracle import OracleVerdictEngine
+
+        prov = ticket.prov
+        l7m = np.asarray(prov.l7_match)
+        gens = np.asarray(prov.gens)
+        hits = np.asarray(prov.memo_hit)
+        verd = np.asarray(prov.verdict)
+        n = min(len(flows), len(verd))
+        step = max(1, n // 8)
+        oracles: Dict[int, object] = {}
+        checked = 0
+        for r in range(0, n, step):
+            gen = int(gens[r])
+            snap = self._gen_snapshots.get(gen)
+            if snap is None:
+                raise InvariantViolation(
+                    index, "explanation-honesty",
+                    f"row {r} cites generation {gen} — no committed "
+                    f"snapshot ever recorded for it (a fabricated or "
+                    f"pre-fill citation)")
+            if not bool(hits[r]) and gen != self._serve_gen:
+                raise InvariantViolation(
+                    index, "explanation-honesty",
+                    f"row {r} was computed this round but cites "
+                    f"generation {gen} != current {self._serve_gen}")
+            rules_at, degraded_at = snap
+            oracle = oracles.get(gen)
+            if oracle is None:
+                saved = self.rules_of
+                self.rules_of = {i: list(v)
+                                 for i, v in rules_at.items()}
+                try:
+                    per_identity = self._resolve()
+                finally:
+                    self.rules_of = saved
+                oracle = oracles[gen] = OracleVerdictEngine(
+                    per_identity)
+            want = int(oracle.verdict_flows([flows[r]])["verdict"][0])
+            got = int(verd[r])
+            if degraded_at or degraded_now:
+                # a degraded epoch may deny more, never allow what
+                # the cited oracle denies
+                if want == int(Verdict.DROPPED) and got != want:
+                    raise InvariantViolation(
+                        index, "explanation-honesty",
+                        f"row {r}: degraded plane allowed what the "
+                        f"cited-generation {gen} oracle denies")
+            elif got != want:
+                hint = ("memo-served" if bool(hits[r])
+                        else "computed")
+                raise InvariantViolation(
+                    index, "explanation-honesty",
+                    f"row {r} ({hint}, l7_match={int(l7m[r])}): "
+                    f"served {got} != cited-generation {gen} oracle "
+                    f"{want}")
+            checked += 1
+        return checked
 
     def multichip(self, index: int) -> Dict:
         """Sampled invariant checks through the SHARDED verdict lanes

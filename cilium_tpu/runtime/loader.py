@@ -467,7 +467,9 @@ class Loader:
         # differs from the positional grouping); v8 the megakernel
         # resolve plan (rp_* group arrays + resolve_meta on the
         # artifact); v9 kafka/generic predicate groups joined the plan
-        # (rp_k_*/rp_gen_*) — each bump invalidates older cached
+        # (rp_k_*/rp_gen_*); v10 the attribution lane's rule→group
+        # maps (rp_rule_group/rp_k_rule_group/rp_gen_rule_group +
+        # group-member meta) — each bump invalidates older cached
         # artifacts.
         # The key is now derived from the per-identity fingerprints +
         # a globals fingerprint, so the SAME inputs also seed the
@@ -488,7 +490,7 @@ class Loader:
             _referenced_secret_values(per_identity, self.secrets),
         )
         key = ruleset_fingerprint(
-            "policy-v9", globals_fp, tuple(sorted(fps.items())))
+            "policy-v10", globals_fp, tuple(sorted(fps.items())))
         with self._lock:
             serving_engine = self._engine
         if (key == self._last_artifact_key and not self._degraded

@@ -217,8 +217,9 @@ class LoadModel:
             raise Violation(index, "memo-accounting", str(memo))
 
     def _sweep(self, outstanding: List, index: int) -> None:
-        """Collect resolved tickets: latencies, sampled correctness,
-        retry bookkeeping. Nothing may vanish."""
+        """Collect resolved tickets: latencies, sampled correctness
+        AND sampled explanation decode, retry bookkeeping. Nothing
+        may vanish."""
         keep = []
         for ticket, chunk, stream in outstanding:
             if not ticket.done:
@@ -241,7 +242,43 @@ class LoadModel:
                         index, "verdict-correctness",
                         f"stream {stream}: ring verdicts diverged "
                         f"from the engine's direct verdicts")
+                self._check_explainable(ticket, chunk, stream, index)
         outstanding[:] = keep
+
+    def _check_explainable(self, ticket, chunk, stream,
+                           index: int) -> None:
+        """Sampled explanation decode: a served chunk's provenance
+        must be present, its L7 winners must resolve through the
+        policy's AttributionMap, and cited generations must be sane
+        (in (0, current])."""
+        import numpy as np
+
+        from cilium_tpu.engine.memo import policy_generation
+
+        prov = ticket.prov
+        if prov is None:
+            raise Violation(index, "explain-coverage",
+                            f"stream {stream}: served chunk carried "
+                            f"no provenance bundle")
+        amap = self._loop._amap_for(self._loop.ring.session.engine)
+        l7m = np.asarray(prov.l7_match)
+        gens = np.asarray(prov.gens)
+        l7t = np.asarray(chunk.sections[0]["l7_type"])
+        gen_now = policy_generation()
+        for r in range(min(len(l7m), len(l7t))):
+            code = int(l7m[r])
+            if code >= 0 and (amap is None
+                              or amap.resolve(int(l7t[r]),
+                                              code) is None):
+                raise Violation(
+                    index, "explain-undecodable",
+                    f"stream {stream} row {r}: l7_match={code} does "
+                    f"not resolve to a live rule")
+            if not (0 < int(gens[r]) <= gen_now):
+                raise Violation(
+                    index, "explain-undecodable",
+                    f"stream {stream} row {r}: cited generation "
+                    f"{int(gens[r])} outside (0, {gen_now}]")
 
     # -- the run ----------------------------------------------------------
     def run(self) -> Dict:
@@ -257,6 +294,7 @@ class LoadModel:
                              lease_ttl_s=self.lease_ttl_s,
                              pack_interval_s=self.pack_interval_s,
                              max_slot_pending=8)
+            self._loop = loop
             # -- unloaded baseline: one stream, quiet ring -------------
             base = self._baseline(loop, pool, clock, autojump)
             with faults.inject(plan):
@@ -458,7 +496,20 @@ class LoadModel:
 
         shed_total = self.shed_submits + self.shed_connects
         denom = max(1, self.submissions + shed_total)
+        prov = st.get("provenance", {})
+        slo = st.get("slo", {})
+        burn = slo.get("burn_rates", {})
+        # gate on the LONGEST window: it covers the whole virtual run
+        long_w = (f"{int(max(loop.slo.windows_s))}s"
+                  if loop.slo is not None else "")
         return {
+            "explain_coverage": prov.get("explain_coverage", 0.0),
+            "records_explained": prov.get("records_explained", 0),
+            "records_unexplained": prov.get("records_unexplained", 0),
+            "slo_burn": burn,
+            "slo_burn_p99": burn.get("serve-p99", {}).get(long_w, 0.0),
+            "slo_burn_shed": burn.get("serve-shed", {}).get(long_w,
+                                                            0.0),
             "seed": self.seed,
             "mode": self.mode,
             "streams": self.streams,
@@ -512,6 +563,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "(seeded; 0 disables)")
     ap.add_argument("--p99-factor", type=float, default=2.0)
     ap.add_argument("--max-shed-rate", type=float, default=0.02)
+    ap.add_argument("--min-explain-coverage", type=float,
+                    default=0.999,
+                    help="served verdicts carrying decodable "
+                         "provenance, as a fraction")
+    ap.add_argument("--max-burn", type=float, default=1.0,
+                    help="whole-run SLO burn-rate ceiling "
+                         "(1.0 = exactly the declared budget)")
     ap.add_argument("--target-concurrency", type=int, default=0,
                     help="gate floor (default: 95%% of --streams)")
     ap.add_argument("--out", default="BENCH_SERVE_r07.jsonl")
@@ -545,6 +603,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "p99": result["p99_ratio"] <= args.p99_factor,
         "shed_rate": result["shed_rate"] <= args.max_shed_rate,
         "bytes_saved": result["bytes_saved"] > 0,
+        # ISSUE-14 provenance gates: ≥99.9% of served verdicts carry
+        # a decodable provenance bundle, and the declared-SLO burn
+        # rates over the whole-run window stay within budget
+        "explain_coverage":
+            result["explain_coverage"] >= args.min_explain_coverage,
+        "burn_rate": (result["slo_burn_p99"] <= args.max_burn
+                      and result["slo_burn_shed"] <= args.max_burn),
     }
     result["gates"] = {k: bool(v) for k, v in gates.items()}
 
@@ -574,7 +639,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
           f"{result['packs']} packs; p99 {result['p99_ms']}ms "
           f"({result['p99_ratio']}x unloaded), shed rate "
           f"{result['shed_rate']}, {result['bytes_saved']} H2D bytes "
-          f"saved by memo bypass; simulated "
+          f"saved by memo bypass; explain coverage "
+          f"{result['explain_coverage']}, burn p99/shed "
+          f"{result['slo_burn_p99']}/{result['slo_burn_shed']}; "
+          f"simulated "
           f"{result['simulated_s']:.0f}s in {wall_s:.1f}s wall "
           f"({result['speedup_vs_real_time']}x); gates "
           f"{'OK' if ok else 'FAILED ' + str(result['gates'])}",

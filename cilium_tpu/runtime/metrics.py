@@ -180,6 +180,26 @@ SERVE_PACK_STREAMS = "cilium_tpu_serve_pack_streams"
 #: submit→verdict latency through the serving loop (seconds, on the
 #: installed clock — virtual under the DST load model)
 SERVE_LATENCY = "cilium_tpu_serve_latency_seconds"
+#: wall seconds one pack cycle spent in the fused dispatch (encode
+#: excluded — submit-side host work is attributed to the submitter)
+SERVE_PACK_DISPATCH_SECONDS = "cilium_tpu_serve_pack_dispatch_seconds"
+#: leased-slot occupancy sampled once per pack cycle (the histogram
+#: face of the occupancy gauge: burn-rate math wants distributions)
+SERVE_PACK_OCCUPANCY = "cilium_tpu_serve_pack_occupancy"
+
+# -- verdict provenance & SLO telemetry (engine/attribution.py,
+# runtime/explain.py, runtime/slo.py)
+#: gauge: error-budget burn rate per declared SLO and trailing
+#: window ({slo="serve-p99"|"serve-shed", window="300s"|...}); 1.0 =
+#: spending budget exactly as declared
+SLO_BURN_RATE = "cilium_tpu_slo_burn_rate"
+#: verdicts that passed through provenance recording, by result
+#: (explained / unexplained) — the explanation-coverage numerator and
+#: denominator the serve-soak gate holds ≥0.999
+PROVENANCE_RECORDS = "cilium_tpu_provenance_records_total"
+#: explain-plane queries (/v1/explain, the explain op/CLI), by result
+#: (hit / miss)
+EXPLAIN_QUERIES = "cilium_tpu_explain_queries_total"
 
 # -- megakernel scan autotuner (engine/megakernel.py): dense-DFA vs
 # bitset-NFA measured per bank shape at engine staging
@@ -730,6 +750,19 @@ METRICS.describe(SERVE_PACK_STREAMS,
 METRICS.describe(SERVE_LATENCY,
                  "submit-to-verdict latency through the serving loop "
                  "(installed-clock seconds)")
+METRICS.describe(SERVE_PACK_DISPATCH_SECONDS,
+                 "wall seconds per pack-cycle fused dispatch")
+METRICS.describe(SERVE_PACK_OCCUPANCY,
+                 "leased-slot occupancy sampled per pack cycle",
+                 buckets=SIZE_BUCKETS)
+METRICS.describe(SLO_BURN_RATE,
+                 "error-budget burn rate, by slo and trailing window "
+                 "(1.0 = spending budget exactly as declared)")
+METRICS.describe(PROVENANCE_RECORDS,
+                 "verdicts through provenance recording, by result "
+                 "(explained / unexplained)")
+METRICS.describe(EXPLAIN_QUERIES,
+                 "explain-plane queries, by result (hit / miss)")
 
 
 class SpanStat:

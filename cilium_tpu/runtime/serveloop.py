@@ -63,6 +63,8 @@ from cilium_tpu.runtime.metrics import (
     SERVE_LEASE_EXPIRIES,
     SERVE_LEASE_GRANTS,
     SERVE_LEASE_RELEASES,
+    SERVE_PACK_DISPATCH_SECONDS,
+    SERVE_PACK_OCCUPANCY,
     SERVE_RING_OCCUPANCY,
 )
 
@@ -121,22 +123,32 @@ class SlotLease:
 class ChunkTicket:
     """Completion token for one submitted chunk: the submitter parks
     on a clock-integrated event; the pack cycle resolves it with host
-    verdicts or an error string."""
+    verdicts or an error string. ``trace_id`` is the submitting
+    stream's flight-recorder context — stamped at submit so the pack
+    thread (which has no contextvar) can still attribute its work and
+    the explain plane can key on it; ``prov`` is the chunk's
+    :class:`~cilium_tpu.engine.attribution.ServedPack` slice when the
+    ring serves with provenance on."""
 
-    __slots__ = ("ev", "n", "t_submit", "t_done", "verdicts", "error")
+    __slots__ = ("ev", "n", "t_submit", "t_done", "verdicts", "error",
+                 "trace_id", "prov", "sample_flows")
 
-    def __init__(self, n: int):
+    def __init__(self, n: int, trace_id: str = ""):
         self.ev = simclock.event()
         self.n = n
         self.t_submit = simclock.now()
         self.t_done: Optional[float] = None
         self.verdicts: Optional[np.ndarray] = None
         self.error: Optional[str] = None
+        self.trace_id = trace_id
+        self.prov = None
+        self.sample_flows = None
 
     def resolve(self, verdicts: Optional[np.ndarray],
-                error: Optional[str] = None) -> None:
+                error: Optional[str] = None, prov=None) -> None:
         self.verdicts = verdicts
         self.error = error
+        self.prov = prov
         self.t_done = simclock.now()
         self.ev.set()
 
@@ -170,7 +182,12 @@ class ServeLoop:
                  gate: Optional[admission.AdmissionGate] = None,
                  authed_pairs_fn=None,
                  widths: Optional[Dict[str, int]] = None,
-                 memo: bool = True):
+                 memo: bool = True,
+                 provenance: Optional[bool] = None,
+                 slo=None):
+        from cilium_tpu.runtime.explain import EXPLAIN
+        from cilium_tpu.runtime.slo import SLOTracker
+
         engine = loader.engine
         if engine is None or not hasattr(engine, "_blob_step"):
             raise RuntimeError(
@@ -178,8 +195,23 @@ class ServeLoop:
                 "(enable_tpu_offload) — the oracle has no ring to "
                 "be resident in")
         self.loader = loader
+        root_cfg = getattr(loader, "config", None)
+        prov_cfg = getattr(root_cfg, "provenance", None)
+        if provenance is None:
+            provenance = bool(getattr(prov_cfg, "enabled", True))
+        self.provenance = bool(provenance)
+        self.explain_sample = int(getattr(prov_cfg, "sample_per_chunk",
+                                          8) or 0)
+        self.explain = EXPLAIN
+        if prov_cfg is not None:
+            self.explain.configure(
+                capacity=getattr(prov_cfg, "explain_capacity", None))
+        self.slo = (SLOTracker.from_config(slo) if slo is not None
+                    else SLOTracker.from_config(
+                        getattr(root_cfg, "slo", None)))
         self.ring = VerdictRing(engine, capacity, loader=loader,
-                                widths=widths, memo=memo)
+                                widths=widths, memo=memo,
+                                provenance=self.provenance)
         self.lease_ttl_s = float(lease_ttl_s)
         self.pack_interval_s = float(pack_interval_s)
         #: per-slot pending-chunk bound: a producer outrunning the
@@ -210,12 +242,18 @@ class ServeLoop:
         self.served_records = 0
         self.chunk_errors = 0
         self.pack_failures = 0
+        #: explanation-coverage counters: served records that carried
+        #: a provenance bundle vs not (the ≥0.999 serve-soak gate)
+        self.records_explained = 0
+        self.records_unexplained = 0
 
     @classmethod
     def from_config(cls, loader, cfg, gate=None,
                     authed_pairs_fn=None) -> "ServeLoop":
         """Build from ``Config.serve`` (tolerates absence so embedders
-        with older configs keep working)."""
+        with older configs keep working). Provenance and SLO knobs
+        come off the loader's ROOT config (``[provenance]``/``[slo]``)
+        inside ``__init__``."""
         return cls(
             loader,
             capacity=getattr(cfg, "slot_capacity", 1024),
@@ -228,6 +266,8 @@ class ServeLoop:
     def _shed(self, reason: str) -> None:
         self.sheds += 1
         admission.count_shed("serve", admission.CLASS_DATA, reason)
+        if self.slo is not None:
+            self.slo.observe_request(shed=True)
 
     def connect(self, stream_id: str,
                 resume: bool = False) -> SlotLease:
@@ -359,7 +399,26 @@ class ServeLoop:
                 self._shed(admission.SHED_QUEUE_FULL)
                 raise ShedError(admission.SHED_QUEUE_FULL)
             lease.renew(now)
-        ticket = ChunkTicket(len(rec))
+        # the stream's trace context rides the TICKET: the pack thread
+        # has no contextvar, so this is where ring-path verdicts keep
+        # their trace id (flows/log lines/explain entries join on it)
+        from cilium_tpu.runtime.tracing import TRACER
+
+        ticket = ChunkTicket(len(rec),
+                             trace_id=TRACER.current_trace_id())
+        if ticket.trace_id and self.provenance \
+                and self.explain_sample > 0:
+            # sampled flows for the explain plane: only TRACED chunks
+            # pay the (bounded) host reconstruction
+            try:
+                from cilium_tpu.ingest.binary import records_to_flows_l7
+
+                k = min(self.explain_sample, len(rec))
+                ticket.sample_flows = records_to_flows_l7(
+                    rec[:k], l7[:k], offsets, blob,
+                    gen=(gen[:k] if gen is not None else None))
+            except Exception:  # noqa: BLE001 — explain is advisory;
+                ticket.sample_flows = None  # never fail the chunk
         # ring.submit takes its own lock; encoding outside ours keeps
         # lease ops responsive while a big chunk featurizes
         try:
@@ -397,6 +456,57 @@ class ServeLoop:
                     heapq.heappush(heap, (lease.expires_at, stream_id))
         return lapsed
 
+    def _amap_for(self, engine):
+        """AttributionMap for the serving engine, rebuilt on swap."""
+        if getattr(self, "_amap_engine", None) is not engine:
+            from cilium_tpu.engine.attribution import AttributionMap
+
+            try:
+                self._amap = AttributionMap.from_policy(engine.policy)
+            except Exception:  # noqa: BLE001 — attribution is
+                self._amap = None  # advisory; never fail serving
+            self._amap_engine = engine
+        return self._amap
+
+    def _resolve_ticket(self, ticket: ChunkTicket, n: int, dev
+                        ) -> int:
+        """Resolve one packed chunk's ticket (verdicts + provenance),
+        feed the SLO trackers, and record explain entries for traced
+        chunks. Returns records served."""
+        prov = None
+        if hasattr(dev, "slice"):        # ServedPack (provenance on)
+            prov = dev.host()
+            verdicts = np.asarray(prov.verdict)[:n].astype(np.int32)
+        else:
+            verdicts = np.asarray(dev)[:n].astype(np.int32)
+        ticket.resolve(verdicts, prov=prov)
+        lat = max(0.0, simclock.now() - ticket.t_submit)
+        METRICS.observe(SERVE_LATENCY, lat)
+        if self.slo is not None:
+            self.slo.observe_latency(lat)
+            self.slo.observe_request(shed=False)
+        if prov is not None:
+            self.records_explained += n
+        else:
+            self.records_unexplained += n
+        if ticket.trace_id and ticket.sample_flows and prov is not None:
+            from cilium_tpu.runtime.explain import build_entries
+
+            amap = self._amap_for(self.ring.session.engine)
+            entries = build_entries(
+                ticket.trace_id, "serve", ticket.sample_flows,
+                prov.verdict, prov.l7_match, amap,
+                gens=prov.gens, memo_hit=prov.memo_hit,
+                match_spec=prov.match_spec, kernel=prov.kernel,
+                pack_cycle=prov.pack_cycle,
+                generation=prov.generation,
+                sample=len(ticket.sample_flows))
+            self.explain.record(ticket.trace_id, entries)
+            LOG.debug("serve chunk explained", extra={"fields": {
+                "trace_id": ticket.trace_id, "records": n,
+                "sampled": len(entries)}})
+        return n
+
     def step(self) -> int:
         """One pack cycle: expire idle leases, pack + dispatch
         pending chunks, resolve tickets. Returns records served.
@@ -407,8 +517,17 @@ class ServeLoop:
         pairs = (self.authed_pairs_fn()
                  if self.authed_pairs_fn is not None else None)
         served = 0
+        t0 = simclock.perf()
         with self._pack_lock:
             results = self.ring.pack(authed_pairs=pairs)
+        if results:
+            # per-pack-cycle SLO telemetry: dispatch wall, pack size
+            # (SERVE_PACK_RECORDS rides ring.pack), slot occupancy
+            METRICS.observe(SERVE_PACK_DISPATCH_SECONDS,
+                            max(0.0, simclock.perf() - t0))
+            with self._lock:
+                occ = float(len(self._leases))
+            METRICS.observe(SERVE_PACK_OCCUPANCY, occ)
         for _slot, n, ticket, dev in results:
             if ticket is None:
                 continue
@@ -418,11 +537,10 @@ class ServeLoop:
                 self.chunk_errors += 1
                 ticket.resolve(None, error="session-reset")
                 continue
-            ticket.resolve(np.asarray(dev)[:n].astype(np.int32))
-            METRICS.observe(SERVE_LATENCY,
-                            max(0.0, simclock.now() - ticket.t_submit))
-            served += n
+            served += self._resolve_ticket(ticket, n, dev)
         self.served_records += served
+        if results and self.slo is not None:
+            self.slo.publish()
         return served
 
     def _run(self) -> None:
@@ -486,8 +604,7 @@ class ServeLoop:
                     self.chunk_errors += 1
                     ticket.resolve(None, error="session-reset")
                     continue
-                ticket.resolve(np.asarray(dev)[:n].astype(np.int32))
-                flushed += n
+                flushed += self._resolve_ticket(ticket, n, dev)
         self.served_records += flushed
         with self._lock:
             for lease in list(self._leases.values()):
@@ -505,7 +622,9 @@ class ServeLoop:
     def status(self) -> Dict[str, object]:
         with self._lock:
             occupancy = len(self._leases)
-        return {
+        served = max(1, self.records_explained
+                     + self.records_unexplained)
+        out = {
             "occupancy": occupancy,
             "capacity": self.ring.capacity,
             "grants": self.grants,
@@ -521,4 +640,15 @@ class ServeLoop:
             "bytes_shipped": self.ring.bytes_shipped,
             "memo": self.ring.memo_stats(),
             "draining": self._draining,
+            "provenance": {
+                "enabled": self.provenance,
+                "records_explained": self.records_explained,
+                "records_unexplained": self.records_unexplained,
+                "explain_coverage": round(
+                    self.records_explained / served, 6),
+                "explain_entries": len(self.explain),
+            },
         }
+        if self.slo is not None:
+            out["slo"] = self.slo.status()
+        return out

@@ -904,9 +904,25 @@ class VerdictService:
                 if isinstance(status, dict):
                     status.setdefault("banks",
                                       self.loader.bank_status())
+                    if self.serveloop is not None:
+                        status.setdefault("serve",
+                                          self.serveloop.status())
                 return status
-            return {"engine_revision": self.loader.revision,
-                    "banks": self.loader.bank_status()}
+            out = {"engine_revision": self.loader.revision,
+                   "banks": self.loader.bank_status()}
+            if self.serveloop is not None:
+                out["serve"] = self.serveloop.status()
+            return out
+        if op == "explain":
+            # the explain plane (runtime/explain.py): recorded
+            # provenance for one trace id, re-resolved through the
+            # CPU oracle at the current revision → served-vs-fresh
+            from cilium_tpu.runtime.explain import resolve_explain
+
+            tid = str(req.get("trace_id", "") or "")
+            if not tid:
+                return {"error": "explain needs trace_id"}
+            return resolve_explain(self.loader, tid)
         if op == "metrics":
             return {"text": METRICS.expose()}
         if op == "mapstate_pull":

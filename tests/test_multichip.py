@@ -15,10 +15,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
-#: every key the verdict step emits — the nine output lanes
+#: every key the verdict step emits — the nine original output lanes
+#: plus the attribution lane (``l7_match``, PR 14 provenance)
 NINE_LANES = ("verdict", "allowed", "l3l4_allowed", "redirect",
               "l7_ok", "l7_log", "match_spec", "ruleset",
-              "auth_required")
+              "auth_required", "l7_match")
 
 
 def _policy_and_batch(widen: bool = False):

@@ -399,6 +399,58 @@ def test_collective_budget_old_rounds_do_not_gate(tmp_path):
     assert report["gate_regressions"] == []
 
 
+# -- provenance-overhead gate (ISSUE 14) -------------------------------------
+
+def _e2e_prov_line(overhead, budget=2.0):
+    return [{"metric": "e2e_capture_replay_http_100rules",
+             "value": 1e7, "unit": "verdicts/s",
+             "provenance_overhead_pct": overhead,
+             "provenance_budget_pct": budget}]
+
+
+def test_provenance_overhead_within_budget_is_clean(tmp_path):
+    _write(tmp_path, "BENCH_ALL_r08.jsonl", _e2e_prov_line(0.7),
+           jsonl=True)
+    entries, _ = normalize_all(str(tmp_path))
+    report = build_trajectory(entries)
+    assert report["gate_regressions"] == []
+
+
+def test_provenance_overhead_violation_gates_newest_round(tmp_path):
+    _write(tmp_path, "BENCH_ALL_r08.jsonl", _e2e_prov_line(4.5),
+           jsonl=True)
+    entries, _ = normalize_all(str(tmp_path))
+    report = build_trajectory(entries)
+    gate = report["gate_regressions"]
+    assert len(gate) == 1, gate
+    assert gate[0]["classification"] == "code_regression"
+    assert "[provenance]" in gate[0]["metric"]
+    assert "4.5" in gate[0]["reason"]
+
+
+def test_provenance_overhead_old_rounds_do_not_gate(tmp_path):
+    _write(tmp_path, "BENCH_ALL_r05.jsonl", _e2e_prov_line(9.0),
+           jsonl=True)
+    _write(tmp_path, "BENCH_ALL_r08.jsonl", _e2e_prov_line(0.5),
+           jsonl=True)
+    entries, _ = normalize_all(str(tmp_path))
+    report = build_trajectory(entries)
+    assert report["newest_round"] == 8
+    assert report["gate_regressions"] == []
+
+
+def test_provenance_overhead_undeclared_not_judged(tmp_path):
+    # a lane without a declared budget (pre-ISSUE-14 lines) is not
+    # judged, whatever it measured
+    _write(tmp_path, "BENCH_ALL_r08.jsonl",
+           [{"metric": "e2e_capture_replay_http_100rules",
+             "value": 1e7, "unit": "verdicts/s",
+             "provenance_overhead_pct": 9.9}], jsonl=True)
+    entries, _ = normalize_all(str(tmp_path))
+    report = build_trajectory(entries)
+    assert report["gate_regressions"] == []
+
+
 def test_real_multichip_artifact_budgets_hold():
     """The committed r06 artifact's declared budgets hold through the
     same reader CI runs — the acceptance pin, not a fixture."""

@@ -1,0 +1,459 @@
+"""Verdict provenance (ISSUE 14): the attribution output lane, the
+host-side AttributionMap decode, memo-cited generations across
+hot-swaps, the packed provenance word, honest Hubble annotation, and
+the flow-serde round-trip with old-reader compatibility."""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import (
+    Flow,
+    HTTPInfo,
+    L7Type,
+    PolicyMatchType,
+    Protocol,
+    TrafficDirection,
+    Verdict,
+)
+from cilium_tpu.engine.attribution import (
+    AttributionMap,
+    ServedPack,
+    kernel_label,
+    pack_word,
+    unpack_word,
+)
+from cilium_tpu.engine.session import IncrementalSession
+from cilium_tpu.ingest import synth
+from cilium_tpu.ingest.binary import capture_from_bytes, capture_to_bytes
+from cilium_tpu.runtime.loader import Loader
+
+
+def _engine(name, n_rules=60, n_flows=512, **engine_kw):
+    scenario = synth.scenario_by_name(name, n_rules, n_flows)
+    per_identity, scenario = synth.realize_scenario(scenario)
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    for k, v in engine_kw.items():
+        setattr(cfg.engine, k, v)
+    engine = Loader(cfg).regenerate(per_identity, revision=1)
+    return engine, scenario
+
+
+# ------------------------------------------------------ the device lane
+@pytest.mark.parametrize("name", ["http", "kafka", "fqdn", "generic"])
+def test_l7_match_lane_fused_equals_legacy(name):
+    """The attribution lane is bit-equal between the fused megakernel
+    and the legacy per-rule resolve for every protocol family — the
+    group-min/rule-group-min equivalence, pinned."""
+    import jax
+
+    from cilium_tpu.engine.verdict import (
+        encode_flows,
+        flowbatch_to_host_dict,
+        verdict_step,
+    )
+
+    engine, scenario = _engine(name)
+    assert engine.impl_plan, "fused step should be staged by default"
+    host = flowbatch_to_host_dict(encode_flows(
+        scenario.flows, engine.policy.kafka_interns))
+    batch = {k: jax.device_put(v) for k, v in host.items()}
+    legacy = jax.jit(verdict_step)(engine._arrays, batch)
+    fused = engine.verdict_batch_arrays(batch)
+    np.testing.assert_array_equal(np.asarray(legacy["l7_match"]),
+                                  np.asarray(fused["l7_match"]))
+    # the lane is live: some flow in every scenario matches an L7 rule
+    assert (np.asarray(fused["l7_match"]) >= 0).any()
+
+
+@pytest.mark.parametrize("name", ["http", "kafka", "fqdn", "generic"])
+def test_l7_match_resolves_through_attribution_map(name):
+    """Every L7 winner decodes to live rules of the right family, and
+    every l7_ok flow HAS a winner (explanation coverage = 1.0 on the
+    device path)."""
+    engine, scenario = _engine(name)
+    out = engine.verdict_flows(scenario.flows)
+    l7m = np.asarray(out["l7_match"])
+    l7ok = np.asarray(out["l7_ok"])
+    amap = engine.attribution
+    assert isinstance(amap, AttributionMap)
+    assert (l7m[l7ok] >= 0).all(), "an allowed L7 flow has no winner"
+    fams = {"http": L7Type.HTTP, "kafka": L7Type.KAFKA,
+            "dns": L7Type.DNS, "generic": L7Type.GENERIC}
+    seen = 0
+    for i, f in enumerate(scenario.flows):
+        if l7m[i] < 0:
+            continue
+        res = amap.resolve(int(f.l7), int(l7m[i]))
+        assert res is not None, (
+            f"flow {i}: l7_match={int(l7m[i])} undecodable")
+        assert fams[res["family"]] == f.l7
+        assert res["rule_ids"], "winner with no member rules"
+        assert amap.rule_label(int(f.l7), int(l7m[i]))
+        seen += 1
+    assert seen > 0
+
+
+def test_http_attribution_names_the_bank():
+    engine, scenario = _engine("http", n_rules=120)
+    out = engine.verdict_flows(scenario.flows)
+    l7m = np.asarray(out["l7_match"])
+    amap = engine.attribution
+    banked = 0
+    for i, f in enumerate(scenario.flows):
+        if l7m[i] < 0 or f.l7 != L7Type.HTTP:
+            continue
+        res = amap.resolve(int(f.l7), int(l7m[i]))
+        if res["bank_key"]:
+            banked += 1
+            assert res["bank_key"] in engine.policy.bank_plan["path"]
+    assert banked > 0, "no http winner resolved to a path bank key"
+
+
+# ---------------------------------------------------- provenance word
+def test_pack_word_round_trip():
+    w = pack_word(code=137, family=int(L7Type.HTTP), memo_hit=True,
+                  gen=42, pack_cycle=77, kernel="dfa-dense")
+    d = unpack_word(w)
+    assert d == {"code": 137, "family": int(L7Type.HTTP),
+                 "memo_hit": True, "generation": 42,
+                 "pack_cycle": 77, "kernel": "dfa-dense"}
+    # no-winner packs as code -1 and still decodes (versioned)
+    d2 = unpack_word(pack_word(-1, 0, False, 3))
+    assert d2["code"] == -1 and d2["generation"] == 3
+    # pre-provenance values decode to nothing, never garbage
+    assert unpack_word(0) is None
+    assert unpack_word(12345) is None  # unversioned legacy int
+
+
+def test_kernel_label_shapes():
+    class _E:
+        impl_plan = {}
+
+    assert kernel_label(_E()) == "legacy"
+    _E.impl_plan = {"path": "dfa-dense", "dns": "dfa-dense"}
+    assert kernel_label(_E()) == "dfa-dense"
+    _E.impl_plan = {"path": "nfa-bitset", "dns": "dfa-dense"}
+    assert kernel_label(_E()) == "mixed"
+
+
+# ------------------------------------------- memo cited generations
+def _paths_world(tmp_path, bank_size=4):
+    from cilium_tpu.core.identity import IdentityAllocator
+    from cilium_tpu.core.labels import LabelSet
+    from cilium_tpu.policy.api import (
+        EndpointSelector,
+        IngressRule,
+        PortProtocol,
+        PortRule,
+        Rule,
+    )
+    from cilium_tpu.policy.api.l7 import L7Rules, PortRuleHTTP
+    from cilium_tpu.policy.mapstate import PolicyResolver
+    from cilium_tpu.policy.repository import Repository
+    from cilium_tpu.policy.selectorcache import SelectorCache
+
+    alloc = IdentityAllocator()
+    db = alloc.allocate(LabelSet.from_dict({"app": "db"}))
+    web = alloc.allocate(LabelSet.from_dict({"app": "web"}))
+
+    def resolve(paths):
+        rules = [Rule(
+            endpoint_selector=EndpointSelector.from_labels(app="db"),
+            ingress=(IngressRule(
+                from_endpoints=(
+                    EndpointSelector.from_labels(app="web"),),
+                to_ports=(PortRule(
+                    ports=(PortProtocol(80, Protocol.TCP),),
+                    rules=L7Rules(http=tuple(
+                        PortRuleHTTP(path=p, method="GET")
+                        for p in paths))),)),),
+        )]
+        repo = Repository()
+        repo.add(rules, sanitize=False)
+        return {db: PolicyResolver(
+            repo, SelectorCache(alloc)).resolve(alloc.lookup(db))}
+
+    def flow(path, dport=80, l7=L7Type.HTTP):
+        return Flow(src_identity=web, dst_identity=db, dport=dport,
+                    protocol=Protocol.TCP,
+                    direction=TrafficDirection.INGRESS, l7=l7,
+                    http=HTTPInfo(method="GET", path=path))
+
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.engine.bank_size = bank_size
+    cfg.loader.cache_dir = str(tmp_path / "cache")
+    loader = Loader(cfg)
+    return loader, resolve, flow
+
+
+def test_memo_cited_generations_across_hot_swap(tmp_path):
+    """ISSUE-14 satellite: a bank-reference refill updates EXACTLY the
+    refilled rows' cited generation; untouched rows keep citing the
+    generation they were computed under — and memo-hit flags track
+    the same split."""
+    from cilium_tpu.engine.memo import policy_generation
+
+    loader, resolve, flow = _paths_world(tmp_path)
+    base = [f"/p{i}/.*" for i in range(10)]
+    loader.regenerate(resolve(base), revision=1)
+    flows = [flow(f"/p{i}/x") for i in range(10)] + [flow("/no")]
+    rec, l7, offsets, blob, gen = capture_from_bytes(
+        capture_to_bytes(flows))
+
+    sess = IncrementalSession(loader.engine, loader=loader)
+    idx, _ = sess.encode_ids(rec, l7, offsets, blob, gen)
+    pack1 = sess.serve_ids(idx, provenance=True)
+    assert isinstance(pack1, ServedPack)
+    gen1 = policy_generation()
+    n = len(flows)
+    assert (pack1.gens[:n] == gen1).all()
+    assert not pack1.memo_hit[:n].any(), "first serve computed all"
+
+    # steady state: everything memo-hit, citations unchanged
+    idx2, _ = sess.encode_ids(rec, l7, offsets, blob, gen)
+    pack2 = sess.serve_ids(idx2, provenance=True)
+    assert pack2.memo_hit[:n].all()
+    assert (pack2.gens[:n] == gen1).all()
+
+    # bank-scoped commit (same identity, http family): ALL http rows
+    # of the identity refill and re-cite; the session keeps its ids
+    loader.regenerate(resolve(base + ["/new/.*"]), revision=2)
+    idx3, _ = sess.encode_ids(rec, l7, offsets, blob, gen)
+    pack3 = sess.serve_ids(idx3, provenance=True)
+    gen2 = policy_generation()
+    assert gen2 > gen1
+    assert sess.resets == 0
+    assert (pack3.gens[:n] == gen2).all(), (
+        "refilled http rows must cite the NEW generation")
+    assert not pack3.memo_hit[:n].any(), (
+        "refilled rows are computed, not memo-served")
+    # verdicts still match the serving engine
+    want = [int(v) for v in
+            loader.engine.verdict_flows(flows)["verdict"]]
+    assert [int(v) for v in np.asarray(pack3.verdict)[:n]] == want
+
+
+def test_memo_untouched_family_keeps_its_citation(tmp_path):
+    """The other half of the satellite: rows whose family/port did
+    NOT read a swapped bank keep citing their original generation
+    while the swapped family's rows move to the new one."""
+    from cilium_tpu.core.flow import DNSInfo
+    from cilium_tpu.engine.memo import policy_generation
+    from cilium_tpu.policy.api import (
+        EndpointSelector,
+        IngressRule,
+        PortProtocol,
+        PortRule,
+        Rule,
+    )
+    from cilium_tpu.policy.api.l7 import (
+        L7Rules,
+        PortRuleDNS,
+        PortRuleHTTP,
+    )
+    from cilium_tpu.policy.mapstate import PolicyResolver
+    from cilium_tpu.policy.repository import Repository
+    from cilium_tpu.policy.selectorcache import SelectorCache
+    from cilium_tpu.core.identity import IdentityAllocator
+    from cilium_tpu.core.labels import LabelSet
+
+    alloc = IdentityAllocator()
+    db = alloc.allocate(LabelSet.from_dict({"app": "db"}))
+    web = alloc.allocate(LabelSet.from_dict({"app": "web"}))
+
+    def resolve(paths, names):
+        rules = [Rule(
+            endpoint_selector=EndpointSelector.from_labels(app="db"),
+            ingress=(IngressRule(
+                from_endpoints=(
+                    EndpointSelector.from_labels(app="web"),),
+                to_ports=(
+                    PortRule(ports=(PortProtocol(80, Protocol.TCP),),
+                             rules=L7Rules(http=tuple(
+                                 PortRuleHTTP(path=p, method="GET")
+                                 for p in paths))),
+                    PortRule(ports=(PortProtocol(53, Protocol.UDP),),
+                             rules=L7Rules(dns=tuple(
+                                 PortRuleDNS(match_name=q)
+                                 for q in names))),)),),
+        )]
+        repo = Repository()
+        repo.add(rules, sanitize=False)
+        return {db: PolicyResolver(
+            repo, SelectorCache(alloc)).resolve(alloc.lookup(db))}
+
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.engine.bank_size = 4
+    cfg.loader.cache_dir = str(tmp_path / "cache")
+    loader = Loader(cfg)
+    paths = [f"/p{i}/.*" for i in range(6)]
+    names = [f"api{i}.corp.io" for i in range(4)]
+    loader.regenerate(resolve(paths, names), revision=1)
+
+    http_flows = [Flow(src_identity=web, dst_identity=db, dport=80,
+                       protocol=Protocol.TCP,
+                       direction=TrafficDirection.INGRESS,
+                       l7=L7Type.HTTP,
+                       http=HTTPInfo(method="GET", path=f"/p{i}/x"))
+                  for i in range(6)]
+    dns_flows = [Flow(src_identity=web, dst_identity=db, dport=53,
+                      protocol=Protocol.UDP,
+                      direction=TrafficDirection.INGRESS,
+                      l7=L7Type.DNS, dns=DNSInfo(query=q))
+                 for q in names]
+    flows = http_flows + dns_flows
+    rec, l7, offsets, blob, gen = capture_from_bytes(
+        capture_to_bytes(flows))
+    sess = IncrementalSession(loader.engine, loader=loader)
+    idx, _ = sess.encode_ids(rec, l7, offsets, blob, gen)
+    sess.serve_ids(idx, provenance=True)
+    gen1 = policy_generation()
+
+    # http-only change: dns rows keep serving AND keep their citation
+    loader.regenerate(resolve(paths + ["/new/.*"], names), revision=2)
+    idx2, _ = sess.encode_ids(rec, l7, offsets, blob, gen)
+    pack = sess.serve_ids(idx2, provenance=True)
+    gen2 = policy_generation()
+    n_http, n_dns = len(http_flows), len(dns_flows)
+    assert (pack.gens[:n_http] == gen2).all(), \
+        "swapped-family rows must re-cite"
+    assert (pack.gens[n_http:n_http + n_dns] == gen1).all(), \
+        "untouched-family rows must keep citing their fill epoch"
+    assert pack.memo_hit[n_http:n_http + n_dns].all()
+    assert not pack.memo_hit[:n_http].any()
+
+
+# ------------------------------------------------- annotation + serde
+def test_annotate_flows_honest_match_type_and_stamps():
+    from cilium_tpu.hubble.observer import annotate_flows
+
+    engine, scenario = _engine("http", n_rules=40)
+    flows = scenario.flows[:64]
+    out = {k: np.asarray(v)
+           for k, v in engine.verdict_flows(flows).items()}
+    annotate_flows(flows, out, amap=engine.attribution)
+    l7m = out["l7_match"]
+    saw_l7 = saw_l4 = 0
+    for i, f in enumerate(flows):
+        if l7m[i] >= 0:
+            assert f.policy_match_type == PolicyMatchType.L7
+            assert f.prov_word > 0
+            assert f.prov_rule.startswith(("http:", "dns:", "kafka:",
+                                           "generic:"))
+            assert f.prov_generation >= 1
+            d = unpack_word(f.prov_word)
+            assert d["code"] == int(l7m[i])
+            saw_l7 += 1
+        elif f.verdict == Verdict.DROPPED:
+            assert f.policy_match_type == PolicyMatchType.NONE
+            saw_l4 += 1
+    assert saw_l7 > 0 and saw_l4 > 0
+
+
+def test_flow_serde_round_trip_and_old_reader_compat():
+    from cilium_tpu.ingest.hubble import flow_from_dict, flow_to_dict
+
+    f = Flow(src_identity=7, dst_identity=9, dport=80,
+             protocol=Protocol.TCP,
+             direction=TrafficDirection.INGRESS, l7=L7Type.HTTP,
+             http=HTTPInfo(method="GET", path="/x"),
+             verdict=Verdict.FORWARDED,
+             policy_match_type=PolicyMatchType.L7,
+             prov_word=pack_word(3, int(L7Type.HTTP), True, 12, 5,
+                                 "dfa-dense"),
+             prov_rule="http:g3/r7", prov_bank="sha-abc",
+             prov_generation=12, prov_memo=True)
+    d = flow_to_dict(f)
+    g = flow_from_dict(d)
+    assert g.policy_match_type == PolicyMatchType.L7
+    assert g.prov_word == f.prov_word
+    assert g.prov_rule == "http:g3/r7"
+    assert g.prov_bank == "sha-abc"
+    assert g.prov_generation == 12 and g.prov_memo is True
+
+    # OLD WRITER → new reader: absent fields decode to NONE/defaults
+    old = dict(d)
+    old.pop("provenance")
+    old.pop("policy_match_type")
+    h = flow_from_dict(old)
+    assert h.policy_match_type == PolicyMatchType.NONE
+    assert h.prov_word == 0 and h.prov_rule == ""
+    assert h.prov_generation == -1 and h.prov_memo is False
+
+    # NEW WRITER → old reader: the new keys are purely ADDITIVE, so
+    # an old flow_from_dict (which only reads the keys it knows)
+    # decodes the rest of the record unchanged
+    f0 = Flow(src_identity=7, dst_identity=9, dport=80,
+              protocol=Protocol.TCP,
+              direction=TrafficDirection.INGRESS, l7=L7Type.HTTP,
+              http=HTTPInfo(method="GET", path="/x"),
+              verdict=Verdict.FORWARDED)
+    assert set(d) - set(flow_to_dict(f0)) == {"provenance",
+                                              "policy_match_type"}
+
+
+def test_no_match_flow_serializes_without_provenance_keys():
+    from cilium_tpu.ingest.hubble import flow_to_dict
+
+    f = Flow(src_identity=1, dst_identity=2, dport=80,
+             protocol=Protocol.TCP, verdict=Verdict.DROPPED)
+    d = flow_to_dict(f)
+    assert "provenance" not in d
+    assert "policy_match_type" not in d
+
+
+# -------------------------------------------- capture replay coverage
+@pytest.mark.slow
+def test_golden_replay_provenance_coverage(tmp_path):
+    """Acceptance: the 5000-flow golden replay with provenance on —
+    every sampled verdict explainable to (rule id, bank, generation)
+    through the memo-gather path."""
+    from cilium_tpu.engine.memo import policy_generation
+    from cilium_tpu.engine.verdict import CaptureReplay
+    from cilium_tpu.ingest.columnar import flows_to_columns
+
+    scenario = synth.scenario_by_name("http", 100, 5000)
+    per_identity, scenario = synth.realize_scenario(scenario)
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.loader.cache_dir = str(tmp_path / "cache")
+    loader = Loader(cfg)
+    engine = loader.regenerate(per_identity, revision=1)
+    flows = scenario.flows
+    cols = flows_to_columns(flows)
+    replay = CaptureReplay(engine, cols.l7, cols.offsets, cols.blob,
+                           cfg.engine, gen=cols.gen, loader=loader)
+    replay.stage_rows(cols.rec, cols.l7)
+    replay.stage_unique()
+    amap = engine.attribution
+    gen_now = policy_generation()
+    total = explained = 0
+    bs = 1000
+    for start in range(0, len(flows), bs):
+        out = replay.verdict_chunk(cols.rec[start:start + bs],
+                                   cols.l7[start:start + bs],
+                                   start=start)
+        l7m = np.asarray(out["l7_match"])
+        spec = np.asarray(out["match_spec"])
+        verd = np.asarray(out["verdict"])
+        m = replay.memo
+        gens = (m.cited_gens(replay.row_idx[start:start + len(l7m)])
+                if m is not None and m.gens is not None else
+                np.full(len(l7m), gen_now))
+        for i in range(len(l7m)):
+            total += 1
+            code = int(l7m[i])
+            flow = flows[start + i]
+            ok = (amap.resolve(int(flow.l7), code) is not None
+                  if code >= 0
+                  else int(spec[i]) >= 0
+                  or int(verd[i]) == int(Verdict.DROPPED))
+            ok = ok and 0 < int(gens[i]) <= gen_now
+            explained += bool(ok)
+    assert total >= 5000
+    assert explained / total >= 0.999, (
+        f"explanation coverage {explained}/{total}")

@@ -154,7 +154,7 @@ def test_dispatch_failure_resolves_tickets_of_released_slots(tmp_path):
         sess = loop.ring.session
         real = sess.serve_ids
 
-        def sick_device(idx, authed_pairs=None):
+        def sick_device(idx, authed_pairs=None, provenance=False):
             # stream a hangs up while the dispatch is in flight...
             loop.disconnect(a)
             # ...and the device fails the launch
