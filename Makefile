@@ -7,7 +7,7 @@ PY ?= python
         churn-fleet churn-fleet-smoke dst dst-validate serve-soak \
         bench bench-all bench-e2e bench-service bench-regen bench-sp \
         bench-stage bench-stream bench-kernel bench-multichip \
-        bench-watch perf-report check
+        bench-protocols bench-watch perf-report check
 
 test:            ## full suite (CPU, virtual 8-device mesh via conftest)
 	$(PY) -m pytest tests/ -q
@@ -194,6 +194,19 @@ bench-multichip: ## DP/EP/CP/TP scaling + collective-budget gate
 	JAX_PLATFORMS=cpu $(PY) bench_multichip.py --devices 8 \
 	    --flows-per-device 1024 --strict-gate \
 	    --out MULTICHIP_PERF_r06.json
+
+# bench-protocols: the ISSUE-15 lane — per-protocol verdict
+# throughput for the frontend families (cassandra/memcache/r2d2 +
+# the mixed protocols scenario, with an in-process http reference),
+# each lane oracle-checked, plus the cross-cluster leg: a 50-update
+# remote-identity churn storm streamed through clustermesh into the
+# serving loader, gated on ZERO stale/ERROR verdicts and
+# update->enforcement p99 <= 2x the committed single-cluster churn
+# number. Provenance-stamped lines land in BENCH_PROTO_r07.jsonl
+# (consumed by perf-report).
+bench-protocols: ## frontend-family throughput + cross-cluster churn
+	JAX_PLATFORMS=cpu $(PY) bench_protocols.py --updates 50 \
+	    --out BENCH_PROTO_r07.jsonl
 
 bench-watch:     ## probe until the tunnel answers, then capture the sweep
 	$(PY) bench.py --watch r04

@@ -299,6 +299,11 @@ RULES: Dict[str, str] = {
                   "serving-plane modules routes through the injected "
                   "Clock (runtime/simclock.py); real-world reads "
                   "carry a justified disable",
+    "frontend-registry": "every proxylib register_parser name has an "
+                         "engine frontend or a justified proxy-only "
+                         "pragma, and every frontend's family appears "
+                         "in the L7Type / memo / attribution family "
+                         "enums",
     "bare-disable": "every ctlint disable comment carries a "
                     "justification",
     "parse-error": "every analyzed file parses",
@@ -343,6 +348,7 @@ def run(root: str, targets: Sequence[str] = (DEFAULT_TARGET,),
         abi,
         configsurface,
         exceptions,
+        frontendreg,
         imports,
         locks,
         obsdocs,

@@ -49,6 +49,12 @@ class EngineConfig:
     #: encodes pair slots for (our parsers emit ≤4; truncation beyond
     #: this could only false-DENY, never false-allow)
     max_generic_fields: int = 16
+    #: protocol-frontend records (policy/compiler/frontends/): byte
+    #: cap on the canonical serialized record the ``l7g`` banked
+    #: automaton scans. A record serializing past it is marked
+    #: invalid — zero match words, so truncation can only false-DENY,
+    #: never false-allow (same contract as every other byte bucket)
+    l7g_len: int = 256
     #: replay/featurize chunk unit — the batch shape the jitted step
     #: compiles for (``cilium-tpu replay`` and the bench sweeps)
     batch_size: int = 8192
@@ -384,6 +390,8 @@ class Config:
             cfg.engine.bank_size = int(env["CILIUM_TPU_BANK_SIZE"])
         if "CILIUM_TPU_BATCH_SIZE" in env:
             cfg.engine.batch_size = int(env["CILIUM_TPU_BATCH_SIZE"])
+        if "CILIUM_TPU_L7G_LEN" in env:
+            cfg.engine.l7g_len = int(env["CILIUM_TPU_L7G_LEN"])
         if "CILIUM_TPU_STAGE_UNIQUE_DROP_RATIO" in env:
             cfg.engine.stage_unique_drop_ratio = float(
                 env["CILIUM_TPU_STAGE_UNIQUE_DROP_RATIO"])

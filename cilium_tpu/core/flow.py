@@ -47,6 +47,16 @@ class L7Type(enum.IntEnum):
     KAFKA = 2
     DNS = 3
     GENERIC = 4   # proxylib-style l7proto parser records
+    # Engine-frontend families (policy/compiler/frontends/): records
+    # still ride ``Flow.generic``/the capture GENERIC section with
+    # l7 == GENERIC on the wire; the engine featurize paths normalize
+    # the l7-type lane to the frontend family so the fused dispatch,
+    # verdict-memo row mirror (ep, l7type, dport), and bank-reference
+    # delta all resolve per protocol. Capped at 7 by the provenance
+    # word's 3-bit family field (engine/attribution.py).
+    CASSANDRA = 5
+    MEMCACHE = 6
+    R2D2 = 7
 
 
 class PolicyMatchType(enum.IntEnum):
@@ -156,6 +166,8 @@ class Flow:
             return self.kafka
         if self.l7 == L7Type.DNS:
             return self.dns
-        if self.l7 == L7Type.GENERIC:
+        if self.l7 >= L7Type.GENERIC:
+            # GENERIC and the frontend families all carry their record
+            # in the generic slot
             return self.generic
         return None

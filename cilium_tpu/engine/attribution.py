@@ -52,7 +52,33 @@ KERNEL_CODES = {"": 0, "legacy": 1, "dfa-dense": 2, "nfa-bitset": 3,
 KERNEL_NAMES = {v: k for k, v in KERNEL_CODES.items()}
 
 FAMILY_NAMES = {int(L7Type.HTTP): "http", int(L7Type.KAFKA): "kafka",
-                int(L7Type.DNS): "dns", int(L7Type.GENERIC): "generic"}
+                int(L7Type.DNS): "dns", int(L7Type.GENERIC): "generic",
+                int(L7Type.CASSANDRA): "cassandra",
+                int(L7Type.MEMCACHE): "memcache",
+                int(L7Type.R2D2): "r2d2"}
+
+#: frontend family ids share ONE decode table ("fe"): their l7_match
+#: codes live in the common fe-group (or fe-rule) space
+_FE_FAMILIES = frozenset((int(L7Type.CASSANDRA), int(L7Type.MEMCACHE),
+                          int(L7Type.R2D2)))
+
+
+def flow_family(flow) -> int:
+    """The ENGINE family of a flow object — what the attribution
+    lane's code is scoped to. Frontend records carry ``l7 ==
+    GENERIC`` on the wire; the engine normalizes their l7-type lane
+    to the frontend family, so flow-side decoders must apply the
+    same mapping or a cassandra code would resolve through the
+    generic pair table."""
+    from cilium_tpu.policy.compiler import frontends
+
+    l7 = int(flow.l7)
+    g = getattr(flow, "generic", None)
+    if l7 == int(L7Type.GENERIC) and g is not None:
+        fam = frontends.family_of(g.proto)
+        if fam:
+            return fam
+    return l7
 
 
 def kernel_label(engine) -> str:
@@ -110,6 +136,9 @@ def _rule_label(family: str, rid: int, rule) -> str:
     if family == "dns":
         pat = rule.match_name or rule.match_pattern
         return f"dns[{rid}] {pat!r}"
+    if family == "fe":
+        proto, pairs = rule
+        return f"{proto}[{rid}] l7={dict(pairs)!r}"
     if family == "kafka":
         parts = [p for p in (
             f"role={rule.role!r}" if rule.role else "",
@@ -197,6 +226,32 @@ class AttributionMap:
             members["kafka"] = [(r,) for r in range(n_kafka)]
         bank_of["kafka"] = [-1] * len(members["kafka"])  # columnar
 
+        # protocol-frontend rules: one shared decode table for every
+        # fe family (codes live in the common fe-group space); the
+        # bank index derives from the rule's l7g automaton lane
+        n_fe = len(getattr(policy, "fe_rules", ()) or ())
+        fe_lane = np.asarray(a.get("fe_lane", np.full(max(1, n_fe),
+                                                      -1)))
+        lw = int(a["l7g_accept"].shape[2]) if "l7g_accept" in a else 1
+        if space == "group" and "rp_fe_rule_group" in a:
+            fg = meta.get("fe_group_rules")
+            if fg is None:
+                rg = np.asarray(a["rp_fe_rule_group"])[:n_fe]
+                n_g = int(rg.max()) + 1 if len(rg) and rg.max() >= 0 \
+                    else 0
+                fg = tuple(tuple(int(r)
+                                 for r in np.nonzero(rg == g)[0])
+                           for g in range(n_g))
+            members["fe"] = [tuple(g) for g in fg]
+        else:
+            members["fe"] = [(r,) for r in range(n_fe)]
+        bank_of["fe"] = []
+        for mem in members["fe"]:
+            lane = int(fe_lane[mem[0]]) if mem and \
+                mem[0] < len(fe_lane) else -1
+            bank_of["fe"].append(lane // (32 * lw) if lane >= 0
+                                 else -1)
+
         n_gen = len(policy.gen_rules)
         if space == "group" and "rp_gen_rule_group" in a:
             gg = meta.get("gen_group_rules")
@@ -216,20 +271,26 @@ class AttributionMap:
                    {"http": policy.http_rules,
                     "kafka": policy.kafka_rules,
                     "dns": policy.dns_rules,
-                    "generic": policy.gen_rules},
+                    "generic": policy.gen_rules,
+                    "fe": list(getattr(policy, "fe_rules", ()) or ())},
                    bank_of, dict(getattr(policy, "bank_plan", {}) or {}))
 
     # -- resolution -------------------------------------------------------
-    _FIELD_OF = {"http": "path", "dns": "dns"}
+    _FIELD_OF = {"http": "path", "dns": "dns", "fe": "l7g"}
 
     def resolve(self, l7_type: int, code: int
                 ) -> Optional[Dict[str, object]]:
         """``(l7_type, l7_match code)`` → the explanation dict, or
         None when the code does not name a live rule (the
-        "unexplainable" bucket the coverage gate counts)."""
+        "unexplainable" bucket the coverage gate counts). Frontend
+        family codes (cassandra/memcache/r2d2) resolve through the
+        shared "fe" table; the reported family stays the flow's own."""
         family = FAMILY_NAMES.get(int(l7_type))
         if family is None or code is None or int(code) < 0:
             return None
+        report_family = family
+        if int(l7_type) in _FE_FAMILIES:
+            family = "fe"
         code = int(code)
         fam_members = self._members.get(family, [])
         if code >= len(fam_members) or not fam_members[code]:
@@ -246,7 +307,7 @@ class AttributionMap:
         bank_key = (keys[bank_idx]
                     if 0 <= bank_idx < len(keys) else "")
         return {
-            "family": family,
+            "family": report_family,
             "space": self.space,
             "code": code,
             "rule_ids": list(rule_ids),
@@ -259,7 +320,8 @@ class AttributionMap:
 
     def rule_label(self, l7_type: int, code: int) -> str:
         """Compact label for flow records / logs:
-        ``http:g3/r17`` (group space) or ``dns:r2`` (rule/lane)."""
+        ``http:g3/r17`` (group space), ``dns:r2`` (rule/lane), or
+        ``cassandra:g0/r1`` (frontend families, fe-group space)."""
         res = self.resolve(l7_type, code)
         if res is None:
             return ""

@@ -78,6 +78,20 @@ GROUP_CAP = 2048
 SCAN_FIELDS = (("path", "path"), ("method", "method"),
                ("host", "host"), ("hdr", "headers"), ("dns", "qname"))
 
+#: the l7g (protocol-frontend) field stack, present only on policies
+#: carrying frontend rules — words slot 5 by convention
+L7G_FIELD = ("l7g", "l7g")
+
+
+def scan_fields(arrays) -> tuple:
+    """The policy's scanned fields, in ``words``-tuple order: the
+    five string fields plus — when the policy staged a frontend
+    automaton (``l7g_trans`` present, a static property of the
+    staged arrays) — the l7g serialized-record field."""
+    if "l7g_trans" in arrays:
+        return SCAN_FIELDS + (L7G_FIELD,)
+    return SCAN_FIELDS
+
 
 # ------------------------------------------------------------ plan build --
 def _mask_bits(mask: np.ndarray, n: int) -> np.ndarray:
@@ -189,9 +203,60 @@ def _dedup_gen_groups(arrays: Dict[str, np.ndarray],
             "rp_gen_rule_group": gen_rule_group}, len(groups)
 
 
+def _dedup_fe_groups(arrays: Dict[str, np.ndarray],
+                     n_fe: int) -> Tuple[Dict, int]:
+    """Protocol-frontend rules deduped to distinct (family, scan
+    lane, enum pair-id SET) predicate groups — pair matching is
+    subset semantics (order/duplicates inside a rule's pair row are
+    irrelevant), so identical predicates across rulesets collapse
+    exactly like kafka's columnar groups. Dead rules (unsatisfiable
+    scan constraints) never join a group."""
+    if "fe_lane" not in arrays:
+        return {}, 0
+    RS = arrays["rs_fe_mask"].shape[0]
+    member = _mask_bits(arrays["rs_fe_mask"], max(1, n_fe))
+    groups: Dict[tuple, set] = {}
+    rule_keys: Dict[int, tuple] = {}
+    for r in range(n_fe):
+        if bool(arrays["fe_dead"][r]):
+            continue
+        rss = np.nonzero(member[:, r])[0]
+        if not len(rss):
+            continue
+        pairs = tuple(sorted({int(p) for p in arrays["fe_pairs"][r]
+                              if p >= 0}))
+        key = (int(arrays["fe_family"][r]),
+               int(arrays["fe_lane"][r]), pairs)
+        rule_keys[r] = key
+        groups.setdefault(key, set()).update(int(x) for x in rss)
+    G = max(1, len(groups))
+    Gw = (G + 31) // 32
+    Km = max([len(k[2]) for k in groups] + [1])
+    g_family = np.full(G, -1, np.int32)
+    g_lane = np.full(G, -1, np.int32)
+    g_pairs = np.full((G, Km), -1, np.int32)
+    rs_fmask = np.zeros((RS, Gw), np.uint32)
+    group_of_key: Dict[tuple, int] = {}
+    for g, (key, rss) in enumerate(groups.items()):
+        group_of_key[key] = g
+        g_family[g], g_lane[g] = key[0], key[1]
+        g_pairs[g, :len(key[2])] = key[2]
+        gbit = np.uint32(1 << (g % 32))
+        for rs in rss:
+            rs_fmask[rs, g // 32] |= gbit
+    fe_rule_group = np.full(
+        max(1, int(arrays["fe_lane"].shape[0])), -1, np.int32)
+    for r, key in rule_keys.items():
+        fe_rule_group[r] = group_of_key[key]
+    return {"rp_fe_family": g_family, "rp_fe_lane": g_lane,
+            "rp_fe_pairs": g_pairs, "rp_rs_femask": rs_fmask,
+            "rp_fe_rule_group": fe_rule_group}, len(groups)
+
+
 def build_resolve_plan(arrays: Dict[str, np.ndarray], n_http: int,
                        n_dns: int, n_kafka: int = 0,
-                       n_gen: int = 0) -> Optional[Tuple[Dict, Dict]]:
+                       n_gen: int = 0,
+                       n_fe: int = 0) -> Optional[Tuple[Dict, Dict]]:
     """Factor the per-rule HTTP conjunction, the DNS lane checks, and
     the kafka/generic predicate tables into group space. Returns
     ``(rp_arrays, meta)`` — ``rp_arrays`` joins
@@ -309,7 +374,8 @@ def build_resolve_plan(arrays: Dict[str, np.ndarray], n_http: int,
     # in group space
     k_arrays, k_groups = _dedup_kafka_groups(arrays, n_kafka)
     gen_arrays, gen_groups = _dedup_gen_groups(arrays, n_gen)
-    if len(groups) + k_groups + gen_groups > GROUP_CAP:
+    fe_arrays, fe_groups = _dedup_fe_groups(arrays, n_fe)
+    if len(groups) + k_groups + gen_groups + fe_groups > GROUP_CAP:
         return None
 
     rp = {
@@ -322,8 +388,10 @@ def build_resolve_plan(arrays: Dict[str, np.ndarray], n_http: int,
     }
     rp.update(k_arrays)
     rp.update(gen_arrays)
+    rp.update(fe_arrays)
     meta = {"groups": len(groups), "lane_groups": lane_groups,
             "kafka_groups": k_groups, "gen_groups": gen_groups,
+            "fe_groups": fe_groups,
             # attribution: group → ordered member rule ids per family
             # (host-side; the explain plane maps a winning group back
             # to concrete rules through these)
@@ -336,7 +404,11 @@ def build_resolve_plan(arrays: Dict[str, np.ndarray], n_http: int,
             "gen_group_rules": tuple(
                 tuple(int(r) for r in range(n_gen)
                       if int(gen_arrays["rp_gen_rule_group"][r]) == g)
-                for g in range(gen_groups))}
+                for g in range(gen_groups)),
+            "fe_group_rules": tuple(
+                tuple(int(r) for r in range(n_fe)
+                      if int(fe_arrays["rp_fe_rule_group"][r]) == g)
+                for g in range(fe_groups)) if fe_groups else ()}
     return rp, meta
 
 
@@ -352,7 +424,7 @@ def _fused_l7_http(arrays, ruleset, words, gwords, l7t):
         _rule_bit,
     )
 
-    _path_w, method_w, host_w, hdr_w, _dns_w = words
+    _path_w, method_w, host_w, hdr_w, _dns_w = words[:5]
     sig_ok = (_rule_bit(method_w, arrays["rp_g_method"])
               & _rule_bit(host_w, arrays["rp_g_host"]))
     hdr_ok = jax.vmap(lambda lanes: _rule_bit(hdr_w, lanes),
@@ -439,6 +511,34 @@ def _fused_l7_generic(arrays, ruleset, gen_cols, l7t):
     return ok, _first_lane(g_words & gmask)
 
 
+def _fused_l7_frontend(arrays, ruleset, l7g_w, gen_pairs, l7t):
+    """Group-space protocol-frontend matching over the deduped
+    (family, scan lane, enum pair-set) predicate table (``rp_fe_*``)
+    — the frontend analog of ``_fused_l7_kafka``: one scan-lane bit,
+    one pair-subset check, one family equality per distinct
+    predicate."""
+    from cilium_tpu.engine.verdict import (
+        _bools_to_words,
+        _first_lane,
+        _rule_bit,
+    )
+
+    grp = arrays["rp_fe_pairs"]                 # [Gf, Km]
+    have = jnp.any(
+        gen_pairs[:, None, None, :] == grp[None, :, :, None],
+        axis=-1)                                # [B, Gf, Km]
+    pair_ok = jnp.all(jnp.where(grp[None, :, :] < 0, True, have),
+                      axis=-1)
+    g_ok = (_rule_bit(l7g_w, arrays["rp_fe_lane"])
+            & pair_ok
+            & (arrays["rp_fe_family"][None, :] == l7t[:, None])
+            & (arrays["rp_fe_family"] >= 0)[None, :])
+    gmask = arrays["rp_rs_femask"][ruleset]
+    g_words = _bools_to_words(g_ok, gmask.shape[1])
+    ok = jnp.any((g_words & gmask) != 0, axis=1)
+    return ok, _first_lane(g_words & gmask)
+
+
 def fused_verdict_core(arrays, ms, l7t, words, gwords, kafka_cols,
                        auth_src_dst, batch, gen_cols=None):
     """The factored-resolve back half; shares the precedence/auth/
@@ -451,12 +551,14 @@ def fused_verdict_core(arrays, ms, l7t, words, gwords, kafka_cols,
     from cilium_tpu.engine.verdict import (
         _assemble_verdict,
         _combine_l7_match,
+        _l7_frontend,
         _l7_generic,
         _l7_kafka,
     )
 
     ruleset = jnp.clip(ms["ruleset"], 0,
                        arrays["rs_http_mask"].shape[0] - 1)
+    l7g_w = words[5] if len(words) > 5 else None
     http_ok, l7_log_http, http_win = _fused_l7_http(
         arrays, ruleset, words, gwords, l7t)
     if "rp_rs_kmask" in arrays:      # static under jit
@@ -476,10 +578,22 @@ def fused_verdict_core(arrays, ms, l7t, words, gwords, kafka_cols,
             gen_ok, gen_win = _l7_generic(arrays, ruleset, gen_cols,
                                           l7t)
         l7_ok = l7_ok | gen_ok
+    fe_ok = fe_win = None
+    if l7g_w is not None and gen_cols is not None \
+            and "fe_lane" in arrays:
+        if "rp_rs_femask" in arrays:
+            fe_ok, fe_win = _fused_l7_frontend(arrays, ruleset,
+                                               l7g_w, gen_cols[1],
+                                               l7t)
+        else:
+            fe_ok, fe_win = _l7_frontend(arrays, ruleset, l7g_w,
+                                         gen_cols[1], l7t)
+        l7_ok = l7_ok | fe_ok
     l7_match = _combine_l7_match(
         (http_ok, http_win), (kafka_ok, kafka_win),
         (dns_ok, dns_win),
-        (gen_ok, gen_win) if gen_ok is not None else None)
+        (gen_ok, gen_win) if gen_ok is not None else None,
+        fe=(fe_ok, fe_win) if fe_ok is not None else None)
     return _assemble_verdict(arrays, ms, l7_ok, l7_log_http,
                              auth_src_dst, batch, l7_match=l7_match)
 
@@ -557,7 +671,7 @@ def fused_verdict_step(arrays, batch, *, impl_plan=(),
     impls = dict(impl_plan)
     words = []
     gwords = None
-    for prefix, field in SCAN_FIELDS:
+    for prefix, field in scan_fields(arrays):
         w, gw = fused_scan_field(
             arrays, prefix, *batch_field(b, field),
             impl=impls.get(prefix, IMPL_DENSE), dfa_impl=dfa_impl,
@@ -673,7 +787,8 @@ def autotune_field(field: str, arrays: Dict, prefix: str,
 def _field_widths(cfg) -> Dict[str, int]:
     return {"path": max(cfg.http_path_buckets),
             "method": cfg.http_method_len, "host": cfg.http_host_len,
-            "hdr": 1024, "dns": cfg.dns_name_len}
+            "hdr": 1024, "dns": cfg.dns_name_len,
+            "l7g": getattr(cfg, "l7g_len", 256)}
 
 
 def plan_for_engine(policy, cfg, interpret: bool) -> Tuple[
@@ -690,6 +805,9 @@ def plan_for_engine(policy, cfg, interpret: bool) -> Tuple[
                 "host": policy.host_matcher,
                 "hdr": policy.header_matcher,
                 "dns": policy.dns_matcher}
+    if getattr(policy, "l7g_matcher", None) is not None:
+        # protocol-frontend automaton: autotuned/armed like any field
+        matchers["l7g"] = policy.l7g_matcher
     widths = _field_widths(cfg)
     lane_groups = (policy.resolve_meta or {}).get("lane_groups") \
         if getattr(policy, "resolve_meta", None) is not None else None

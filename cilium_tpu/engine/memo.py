@@ -47,9 +47,15 @@ from cilium_tpu.runtime.metrics import (
 #: L7 family names of the bank-reference granularity: which rule
 #: family a memoized row's verdict actually READ. Rows carry their
 #: family in the l7_types column; "l4" rows read no L7 banks at all
-#: and move only on a structural (MapState) change.
+#: and move only on a structural (MapState) change. Codes 5..7 are
+#: the protocol-frontend families (policy/compiler/frontends/) — the
+#: featurize paths normalize frontend records' l7-type lane to these,
+#: so a cassandra-bank swap refills ONLY cassandra rows. The
+#: frontend-registry ctlint rule pins this map against the frontend
+#: registry's declared families.
 FAMILY_OF_L7TYPE = {0: "l4", 1: "http", 2: "kafka", 3: "dns",
-                    4: "generic"}
+                    4: "generic", 5: "cassandra", 6: "memcache",
+                    7: "r2d2"}
 
 #: wildcard family: the identity's STRUCTURAL state (MapState keys,
 #: deny/auth/wildcard bits, enforcement flags) changed — every row of

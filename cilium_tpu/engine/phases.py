@@ -139,11 +139,14 @@ def _live_scan(arrays, batch):
         flat = words.reshape(words.shape[0], -1)
         return jnp.where(valid[:, None], flat, 0)
 
-    return (scan_field("path", *batch_field(b, "path")),
-            scan_field("method", *batch_field(b, "method")),
-            scan_field("host", *batch_field(b, "host")),
-            scan_field("hdr", *batch_field(b, "headers")),
-            scan_field("dns", *batch_field(b, "qname")))
+    words = (scan_field("path", *batch_field(b, "path")),
+             scan_field("method", *batch_field(b, "method")),
+             scan_field("host", *batch_field(b, "host")),
+             scan_field("hdr", *batch_field(b, "headers")),
+             scan_field("dns", *batch_field(b, "qname")))
+    if "l7g_trans" in arrays:   # frontend automaton staged (static)
+        words = words + (scan_field("l7g", *batch_field(b, "l7g")),)
+    return words
 
 
 def _live_resolve(arrays, ms, words, batch):
@@ -172,6 +175,12 @@ def _cap_gather(table_words, batch):
     words = tuple(
         table_words[field][rows[:, col[f"{field}_row"]]]
         for field in ("path", "method", "host", "headers", "qname"))
+    # ctlint: disable=recompile-hazard  # row width is static per capture layout: one compile per layout, by design
+    if "l7g" in table_words and rows.shape[1] > len(_ROW_COLS):
+        # frontend serialized-record words ride the gen block's l7g
+        # row column (gen layout: proto, family, l7g row, pairs...)
+        words = words + (
+            table_words["l7g"][rows[:, len(_ROW_COLS) + 2]],)
     return rows, words
 
 
@@ -201,7 +210,7 @@ def _cap_resolve(arrays, ms, rows, words, batch):
     dst = jnp.where(ingress, c("ep_ids"), c("peer_ids"))
     n = len(_ROW_COLS)
     # ctlint: disable=recompile-hazard  # row width is static per capture layout: one compile per layout, by design
-    gen_cols = ((rows[:, n], rows[:, n + 1:])
+    gen_cols = ((rows[:, n], rows[:, n + 3:])
                 if rows.shape[1] > n else None)
     return _verdict_core(
         arrays, ms, c("l7_types"), words,
@@ -235,12 +244,12 @@ def _impl_scan(arrays, batch, impl_plan, wanted: str,
     """Scan only the fields the engine's kernel plan runs through
     ``wanted`` — the per-impl attribution lanes (dfa-dense /
     nfa-bitset phase labels)."""
-    from cilium_tpu.engine.megakernel import SCAN_FIELDS, fused_scan_field
+    from cilium_tpu.engine.megakernel import fused_scan_field, scan_fields
 
     b = _unpacked(batch)
     impls = dict(impl_plan)
     out = []
-    for prefix, field in SCAN_FIELDS:
+    for prefix, field in scan_fields(arrays):
         if impls.get(prefix, "dfa-dense") != wanted:
             continue
         w, _ = fused_scan_field(

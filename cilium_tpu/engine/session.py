@@ -49,6 +49,7 @@ from cilium_tpu.engine.memo import (
 from cilium_tpu.engine.verdict import (
     _ROW_COLS,
     _gen_intern_rows,
+    _gen_l7g_cols,
     verdict_step_capture,
 )
 from cilium_tpu.core.flow import TrafficDirection
@@ -64,7 +65,7 @@ _FIELDS = ("path", "method", "host", "headers", "qname")
 _L7_COL = _ROW_COLS.index("l7_types")
 _DPORT_COL = _ROW_COLS.index("dports")
 _PREFIX = {"path": "path", "method": "method", "host": "host",
-           "headers": "hdr", "qname": "dns"}
+           "headers": "hdr", "qname": "dns", "l7g": "l7g"}
 
 
 def _pow2(n: int, floor: int = 256) -> int:
@@ -121,6 +122,13 @@ class _StringTable:
         eng = self.engine
         prefix = _PREFIX[self.field]
         a = eng._arrays
+        if f"{prefix}_trans" not in a:
+            # the engine staged no automaton for this field (an l7g
+            # table under a policy with no frontend rules): interning
+            # continues host-side — ids stay stable across swaps —
+            # and the pending delta scans when a policy that needs
+            # the words arrives
+            return
         if self._nw is None:
             # words-per-bank from the accept table: [NB, S, W] u32 →
             # flattened row is NB*W u32 lanes
@@ -196,13 +204,17 @@ class IncrementalSession:
         caps = {"path": max(cfg.http_path_buckets),
                 "method": cfg.http_method_len,
                 "host": cfg.http_host_len,
-                "headers": 1024, "qname": cfg.dns_name_len}
+                "headers": 1024, "qname": cfg.dns_name_len,
+                "l7g": cfg.l7g_len}
         self.widths = {f: min(int((widths or {}).get(f, caps[f])),
-                              caps[f]) for f in _FIELDS}
+                              caps[f])
+                       for f in _FIELDS + ("l7g",)}
         self.max_rows = max_rows
         self.max_strings = max_strings
         self.fmax = int(engine.policy.kafka_interns.get("gen_fmax", 4))
-        self.row_width = len(_ROW_COLS) + 1 + self.fmax
+        # gen block: [proto id, frontend family, l7g string id,
+        # pair ids...] (see CaptureFeaturizer.gen_rows)
+        self.row_width = len(_ROW_COLS) + 3 + self.fmax
         self._step = jax.jit(verdict_step_capture)
         self.resets = 0
         self._init_state()
@@ -210,6 +222,12 @@ class IncrementalSession:
     def _init_state(self) -> None:
         self.tables = {f: _StringTable(self.engine, f, self.widths[f])
                        for f in _FIELDS}
+        # the l7g (serialized frontend record) table interns host-side
+        # unconditionally — string ids are policy-independent, so row
+        # encodings survive swaps between fe and non-fe policies —
+        # but only flushes/scans when the engine staged l7g arrays
+        self.tables["l7g"] = _StringTable(self.engine, "l7g",
+                                          self.widths["l7g"])
         self.kafka_memo: Dict[Tuple[str, bytes], int] = {}
         #: row-hash → [(row bytes, id), ...] chains (exact, see
         #: _row_idx)
@@ -371,12 +389,31 @@ class IncrementalSession:
                 f, l7[f], offsets, blob)
         ncols = len(_ROW_COLS)
         if gen is not None:
-            out[:, ncols:] = _gen_intern_rows(
+            gen_block = _gen_intern_rows(
                 gen, offsets, blob, self.engine.policy.kafka_interns)
+            fam, uniq_ser, l7g_row = _gen_l7g_cols(gen, offsets, blob)
+            # serialized frontend records intern into the session l7g
+            # table (delta-scanned like any string); non-frontend
+            # records keep id 0 (the empty string)
+            tbl = self.tables["l7g"]
+            ser_ids = np.zeros(len(uniq_ser), dtype=np.int32)
+            for j, s in enumerate(uniq_ser[1:], start=1):
+                ser_ids[j] = tbl.intern(s)
+            out[:, ncols] = gen_block[:, 0]
+            out[:, ncols + 1] = fam
+            out[:, ncols + 2] = ser_ids[l7g_row]
+            out[:, ncols + 3:] = gen_block[:, 1:]
+            # frontend records normalize the l7-type lane to their
+            # family — same invariant as encode_flows; keys the fe
+            # lane on device and the (ep, l7type, dport) memo mirror
+            out[:, col["l7_types"]] = np.where(
+                fam > 0, fam, out[:, col["l7_types"]])
         else:
             # no generic section: proto/pair slots stay -2 ("absent"),
-            # matching encode_flows' defaults for non-generic flows
-            pass
+            # matching encode_flows' defaults for non-generic flows;
+            # the family/l7g columns read "no frontend record"
+            out[:, ncols + 1] = 0
+            out[:, ncols + 2] = 0
         return out
 
     @staticmethod
@@ -527,6 +564,8 @@ class IncrementalSession:
 
         _faults.maybe_fail(DISPATCH_POINT)
         table_words = {f: self.tables[f].words for f in _FIELDS}
+        if "l7g_trans" in self.engine._arrays:
+            table_words["l7g"] = self.tables["l7g"].words
         if self.memo is not None:
             return self._memo_serve(idx, table_words, authed_pairs,
                                     provenance=provenance)

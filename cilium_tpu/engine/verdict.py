@@ -198,6 +198,15 @@ class CompiledPolicy:
     header_matcher: _FieldMatcher
     dns_matcher: _FieldMatcher
     revision: int = 0
+    #: protocol-frontend rules (policy/compiler/frontends/):
+    #: (l7proto, sorted (key, value) pairs) per rule, compiled onto
+    #: the ``l7g`` banked automaton instead of the generic pair path
+    fe_rules: List[Tuple[str, Tuple[Tuple[str, str], ...]]] = \
+        dataclasses.field(default_factory=list)
+    #: the ``l7g`` field matcher over the frontend pattern universe;
+    #: None when no frontend rules exist (the l7g_* arrays are then
+    #: absent and every l7g code path is statically skipped)
+    l7g_matcher: Optional[_FieldMatcher] = None
     #: per-HTTP-rule proxy-side header rewrites from ADD/DELETE/REPLACE
     #: mismatch actions: [(action, header-name, value), ...] — the
     #: shim/Envoy layer owns applying them; the verdict engine only
@@ -256,6 +265,15 @@ class CompiledPolicy:
         # an l7proto with no l7 constraints is the 0-pair allow-all rule
         gen_rules: List[Tuple[str, Tuple[Tuple[str, str], ...]]] = []
         gen_index: Dict[Tuple, int] = {}
+        # protocol-FRONTEND rules: same (proto, pairs) shape, routed
+        # to the l7g banked automaton (policy/compiler/frontends/) —
+        # a proto with a registered frontend never compiles onto the
+        # generic pair path, and an UNKNOWN proto (neither frontend
+        # nor registered proxy parser) fails loudly right here
+        from cilium_tpu.policy.compiler import frontends as _frontends
+
+        fe_rules: List[Tuple[str, Tuple[Tuple[str, str], ...]]] = []
+        fe_index: Dict[Tuple, int] = {}
 
         ruleset_key_to_id: Dict[Tuple, int] = {}
         # per ruleset: member rule ids in each protocol family's space —
@@ -265,6 +283,7 @@ class CompiledPolicy:
         ruleset_kafka: List[List[int]] = []
         ruleset_dns: List[List[int]] = []
         ruleset_gen: List[List[int]] = []
+        ruleset_fe: List[List[int]] = []
 
         def intern_rule(table, index, rule):
             if rule not in index:
@@ -273,7 +292,8 @@ class CompiledPolicy:
             return index[rule]
 
         def ruleset_of(l7_rules_tuple: Tuple[L7Rules, ...]) -> int:
-            http_ids, kafka_ids, dns_ids, gen_ids = [], [], [], []
+            http_ids, kafka_ids, dns_ids = [], [], []
+            gen_ids, fe_ids = [], []
             for lr in l7_rules_tuple:
                 for h in lr.http:
                     http_ids.append(intern_rule(http_rules, http_index, h))
@@ -282,19 +302,32 @@ class CompiledPolicy:
                 for d in lr.dns:
                     dns_ids.append(intern_rule(dns_rules, dns_index, d))
                 if lr.l7proto:
+                    # the unified-registry check (ISSUE 15 satellite):
+                    # an l7proto that is neither an engine frontend
+                    # nor a registered proxy parser fails the COMPILE
+                    # loudly instead of compiling to unmatched rules
+                    _frontends.validate_l7proto(lr.l7proto)
+                    fe = _frontends.get(lr.l7proto)
+                    table, index, ids = (
+                        (fe_rules, fe_index, fe_ids) if fe is not None
+                        else (gen_rules, gen_index, gen_ids))
                     if not lr.l7:
-                        gen_ids.append(intern_rule(
-                            gen_rules, gen_index, (lr.l7proto, ())))
+                        ids.append(intern_rule(
+                            table, index, (lr.l7proto, ())))
                     for g in lr.l7:
-                        gen_ids.append(intern_rule(
-                            gen_rules, gen_index,
-                            (lr.l7proto, tuple(sorted(g.items())))))
-            if not (http_ids or kafka_ids or dns_ids or gen_ids):
+                        pairs = tuple(sorted(g.items()))
+                        if fe is not None:
+                            fe.validate_rule(pairs)
+                        ids.append(intern_rule(
+                            table, index, (lr.l7proto, pairs)))
+            if not (http_ids or kafka_ids or dns_ids or gen_ids
+                    or fe_ids):
                 return -1
             key = (tuple(sorted(set(http_ids))),
                    tuple(sorted(set(kafka_ids))),
                    tuple(sorted(set(dns_ids))),
-                   tuple(sorted(set(gen_ids))))
+                   tuple(sorted(set(gen_ids))),
+                   tuple(sorted(set(fe_ids))))
             rid = ruleset_key_to_id.get(key)
             if rid is None:
                 rid = len(ruleset_http)
@@ -303,6 +336,7 @@ class CompiledPolicy:
                 ruleset_kafka.append(list(key[1]))
                 ruleset_dns.append(list(key[2]))
                 ruleset_gen.append(list(key[3]))
+                ruleset_fe.append(list(key[4]))
             return rid
 
         # per-build memo keyed by the l7-rules tuple's OBJECT identity:
@@ -477,6 +511,53 @@ class CompiledPolicy:
             for j, (k, v) in enumerate(pairs):
                 gen_rule_pairs[i, j] = gen_pair_intern[(proto, k, v)]
 
+        # -- protocol-frontend rules: scan-field patterns + predicates --
+        # Each frontend rule lowers (frontends.lower_rule) into (a)
+        # one full-match pattern over its protocol's SCAN FIELD value
+        # — compiled through the same content-defined bank pipeline
+        # as the HTTP/DNS fields (bankplan partition → CompileQueue →
+        # quarantine/artifacts), read off the l7g scan as a lane —
+        # and (b) interned enum/presence predicates matched by the
+        # generic pair-subset check. Exact-value patterns keep the
+        # bank subset construction trie-shaped, so the universe
+        # compiles in time linear in total literal length. An
+        # unsatisfiable rule (two exact scan values — the oracle can
+        # never match it either) compiles DEAD.
+        l7g_matcher: Optional[_FieldMatcher] = None
+        fe_lane = np.full(max(1, _rbucket(len(fe_rules))), -1,
+                          dtype=np.int32)
+        fe_family = np.full(len(fe_lane), -1, dtype=np.int32)
+        fe_dead = np.zeros(len(fe_lane), dtype=bool)
+        fe_dead[len(fe_rules):] = True       # padding is inert
+        fe_max_pairs = 1
+        fe_pairs = np.full((len(fe_lane), fe_max_pairs), -1,
+                           dtype=np.int32)
+        if fe_rules:
+            lowered = [_frontends.get(proto).lower_rule(pairs)
+                       for proto, pairs in fe_rules]
+            for lo in lowered:
+                for t in lo.pairs:
+                    gen_pair_intern.setdefault(t,
+                                               len(gen_pair_intern))
+            fe_max_pairs = max([len(lo.pairs) for lo in lowered] + [1])
+            fe_pairs = np.full((len(fe_lane), fe_max_pairs), -1,
+                               dtype=np.int32)
+            l7g_matcher = _FieldMatcher.build(
+                [lo.pattern for lo in lowered
+                 if lo.pattern is not None], cfg,
+                bank_cache=bank_cache, bank_registry=bank_registry,
+                field="l7g")
+            for i, ((proto, _pairs), lo) in enumerate(
+                    zip(fe_rules, lowered)):
+                fe_family[i] = _frontends.family_of(proto)
+                if lo.dead:
+                    fe_dead[i] = True
+                    continue
+                if lo.pattern is not None:
+                    fe_lane[i] = l7g_matcher.lane(lo.pattern)
+                for j, t in enumerate(lo.pairs):
+                    fe_pairs[i, j] = gen_pair_intern[t]
+
         # -- ruleset masks ----------------------------------------------
         http_members = ruleset_http
         kafka_members = ruleset_kafka
@@ -515,13 +596,26 @@ class CompiledPolicy:
             "kafka_topic": kafka_topic,
             "dns_lane": dns_lane,
         }
-        for prefix, m in (
+        matcher_stacks = [
             ("path", path_matcher),
             ("method", method_matcher),
             ("host", host_matcher),
             ("hdr", header_matcher),
             ("dns", dns_matcher),
-        ):
+        ]
+        if l7g_matcher is not None:
+            # the l7g stack + fe rule arrays exist ONLY when frontend
+            # rules do: policies without them stage byte-identical
+            # arrays (and every l7g code path is statically skipped
+            # under jit — "l7g_trans" is the one gate)
+            matcher_stacks.append(("l7g", l7g_matcher))
+            arrays["rs_fe_mask"] = _masks_to_array(
+                ruleset_fe or [[]], len(fe_lane))
+            arrays["fe_lane"] = fe_lane
+            arrays["fe_family"] = fe_family
+            arrays["fe_dead"] = fe_dead
+            arrays["fe_pairs"] = fe_pairs
+        for prefix, m in matcher_stacks:
             for k, v in m.arrays.items():
                 if k != "lane_of":
                     arrays[f"{prefix}_{k}"] = v
@@ -536,8 +630,7 @@ class CompiledPolicy:
 
         bank_plan: Dict[str, Tuple[str, ...]] = {}
         bank_quarantined: List[str] = []
-        for m in (path_matcher, method_matcher, host_matcher,
-                  header_matcher, dns_matcher):
+        for _prefix, m in matcher_stacks:
             st = m.bank_stats
             if st is not None:
                 bank_plan[st.field] = st.bank_keys
@@ -553,7 +646,8 @@ class CompiledPolicy:
         plan = _mk.build_resolve_plan(arrays, len(http_rules),
                                       len(dns_rules),
                                       n_kafka=len(kafka_rules),
-                                      n_gen=len(gen_rules))
+                                      n_gen=len(gen_rules),
+                                      n_fe=len(fe_rules))
         if plan is not None:
             rp_arrays, resolve_meta = plan
             arrays.update(rp_arrays)
@@ -579,6 +673,8 @@ class CompiledPolicy:
             bank_plan=bank_plan,
             bank_quarantined=tuple(bank_quarantined),
             resolve_meta=resolve_meta,
+            fe_rules=fe_rules,
+            l7g_matcher=l7g_matcher,
         )
 
 
@@ -604,6 +700,9 @@ class FlowBatch:
     kafka_topic: np.ndarray
     gen_proto: np.ndarray     # [B] interned l7proto id, -2 = none/unknown
     gen_pairs: np.ndarray     # [B, F] interned (proto,key,value) ids, -2 pad
+    #: canonical serialized frontend record bytes (the l7g automaton's
+    #: input; empty for non-frontend flows) — (data, len, valid)
+    l7g: Tuple[np.ndarray, np.ndarray, np.ndarray] = None
 
     @property
     def size(self) -> int:
@@ -640,6 +739,9 @@ def encode_flows(
     gpair_intern = interns.get("gen_pairs", {})
     g_proto = np.full(B, -2, dtype=np.int32)
     g_pair_lists: List[List[int]] = [[] for _ in range(B)]
+    from cilium_tpu.policy.compiler import frontends as _frontends
+
+    l7g_strings: List[bytes] = []
     for i, f in enumerate(flows):
         ingress = f.direction == TrafficDirection.INGRESS
         ep[i] = f.dst_identity if ingress else f.src_identity
@@ -664,14 +766,29 @@ def encode_flows(
             k_cli[i] = cintern.get(k.client_id, -2)
             k_top[i] = tintern.get(k.topic, -2)
         g = f.generic
+        fam = _frontends.family_of(g.proto) if g is not None else 0
+        if fam:
+            # frontend-routed record: the l7-type lane NORMALIZES to
+            # the frontend family (memo row mirror + per-family
+            # invalidation + the fe family gate key on it) and the
+            # SCAN FIELD's value feeds the l7g automaton; the enum
+            # predicates ride the shared pair-id probing below
+            # (gen_proto stays -2 so generic rules never see it)
+            l7t[i] = fam
+            l7g_strings.append(_frontends.scan_value(g.proto,
+                                                     g.fields))
+        else:
+            l7g_strings.append(b"")
         if g is not None:
-            g_proto[i] = gproto_intern.get(g.proto, -2)
+            if not fam:
+                g_proto[i] = gproto_intern.get(g.proto, -2)
             # only interned ids matter — pairs no rule references can
             # never satisfy a requirement (deduped: a field emits at
             # most one value id + one presence id). Sorted key order:
             # the capture path (_gen_intern_rows) reproduces this
             # exact id sequence, so Fmax truncation selects the SAME
-            # subset live and on replay.
+            # subset live and on replay. Frontend records probe the
+            # same table: their enum/presence predicates intern there.
             seen: set = set()
             for key, val in sorted(g.fields.items()):
                 for probe in ((g.proto, key, val), (g.proto, key, "")):
@@ -695,6 +812,7 @@ def encode_flows(
         kafka_api_key=k_api, kafka_api_version=k_ver,
         kafka_client=k_cli, kafka_topic=k_top,
         gen_proto=g_proto, gen_pairs=g_pairs,
+        l7g=encode_strings(l7g_strings, cfg.l7g_len),
     )
 
 
@@ -743,6 +861,7 @@ def encode_records(rec, cfg: Optional[EngineConfig] = None,
         # fmax mirrors encode_flows' interned width so record batches
         # share the flows path's jit cache entry
         gen_pairs=np.full((B, fmax), -2, dtype=np.int32),
+        l7g=empty_field(cfg.l7g_len),
     )
 
 
@@ -851,6 +970,58 @@ def _gen_intern_rows(gen, offsets: np.ndarray, blob: np.ndarray,
 
 
 
+def _gen_l7g_cols(gen, offsets: np.ndarray, blob: np.ndarray):
+    """v3 GENERIC section → the frontend columns every capture path
+    shares: ``(fam [N] int32, uniq_scan List[bytes], row [N] int32)``
+    where ``fam`` is the frontend family id (0 = not a frontend
+    record), ``uniq_scan`` the deduped SCAN-FIELD values
+    (frontends.scan_value; index 0 is always empty), and ``row[i]``
+    indexes a record's scan bytes in that list. The (proto,
+    pair-row) → scan-value work runs once per UNIQUE section row —
+    capture traffic repeats its records heavily, which is the same
+    dedup the string tables ride."""
+    from cilium_tpu.policy.compiler import frontends as _frontends
+
+    N = len(gen)
+    fam = np.zeros(N, dtype=np.int32)
+    row = np.zeros(N, dtype=np.int32)
+    uniq_serialized: List[bytes] = [b""]
+    if N == 0:
+        return fam, uniq_serialized, row
+    proto_idx = np.asarray(gen["proto"], dtype=np.int64)
+    pairs = np.asarray(gen["pairs"], dtype=np.int64)    # [N, F, 2]
+    whole = np.concatenate(
+        [proto_idx[:, None], pairs.reshape(N, -1)], axis=1)
+    uniq, inv = np.unique(whole, axis=0, return_inverse=True)
+
+    def s(i: int) -> str:
+        return blob[int(offsets[i]):int(offsets[i + 1])] \
+            .tobytes().decode("utf-8", "replace")
+
+    ser_of = np.zeros(len(uniq), dtype=np.int32)
+    fam_of = np.zeros(len(uniq), dtype=np.int32)
+    index: Dict[bytes, int] = {b"": 0}
+    for j, u in enumerate(uniq):
+        proto = s(int(u[0]))
+        f = _frontends.family_of(proto)
+        if not f:
+            continue
+        fields = {}
+        for k_idx, v_idx in u[1:].reshape(-1, 2):
+            if k_idx:           # string 0 = "" = unused pair slot
+                fields[s(int(k_idx))] = s(int(v_idx))
+        ser = _frontends.scan_value(proto, fields)
+        rid = index.get(ser)
+        if rid is None:
+            rid = index[ser] = len(uniq_serialized)
+            uniq_serialized.append(ser)
+        ser_of[j] = rid
+        fam_of[j] = f
+    fam[:] = fam_of[inv]
+    row[:] = ser_of[inv]
+    return fam, uniq_serialized, row
+
+
 def _pad_rows_pow2(*arrays):
     """Pad each array's FIRST axis (same length across arrays) with
     zeros up to the next power of two — shape buckets so the jitted
@@ -895,10 +1066,21 @@ class CaptureFeaturizer:
         self.fmax = int(interns.get("gen_fmax", 4))
         self.widths = capture_field_widths(l7, offsets, cfg)
         #: v3 captures: whole-capture generic columns, row-aligned
-        #: ([N, 1+fmax] int32); chunk callers pass the slice matching
-        #: their record slice to :meth:`encode_rows`
-        self.gen_rows = (_gen_intern_rows(gen, offsets, blob, interns)
-                         if gen is not None else None)
+        #: ([N, 3+fmax] int32: interned proto id, frontend family id
+        #: (0 = not a frontend record), row into the staged l7g
+        #: string table, then the interned pair ids); chunk callers
+        #: pass the slice matching their record slice to
+        #: :meth:`encode_rows`
+        self.gen_rows = None
+        self._l7g_uniq = None
+        if gen is not None:
+            gen_block = _gen_intern_rows(gen, offsets, blob, interns)
+            fam, uniq_ser, l7g_row = _gen_l7g_cols(gen, offsets, blob)
+            self._l7g_uniq = uniq_ser
+            self.gen_rows = np.concatenate(
+                [gen_block[:, :1], fam[:, None].astype(np.int32),
+                 l7g_row[:, None].astype(np.int32), gen_block[:, 1:]],
+                axis=1)
         n_strings = len(offsets) - 1
         self.tables: Dict[str, tuple] = {}
         self.luts: Dict[str, np.ndarray] = {}
@@ -916,6 +1098,13 @@ class CaptureFeaturizer:
             lut[used] = np.arange(len(used), dtype=np.int32)
             self.tables[field] = (data, lens, valid)
             self.luts[field] = lut
+        if self._l7g_uniq is not None:
+            # frontend record serializations as one more staged string
+            # table (scanned through the l7g automaton when the policy
+            # carries frontend rules); no LUT — l7g_rows already
+            # indexes this table directly
+            self.tables["l7g"] = _pad_rows_pow2(
+                *encode_strings(self._l7g_uniq, cfg.l7g_len))
         for col, key in (("kafka_client", "client_id"),
                          ("kafka_topic", "topic")):
             used = np.unique(l7[col])
@@ -959,8 +1148,15 @@ class CaptureFeaturizer:
         for name, _ in self._FIELD_CAPS:
             out[:, col[f"{name}_row"]] = self.luts[name][l7[name]]
         if gen_rows is not None:
-            out = np.concatenate(
-                [out, np.asarray(gen_rows, dtype=np.int32)], axis=1)
+            gen_rows = np.asarray(gen_rows, dtype=np.int32)
+            # frontend records normalize the l7-type lane to their
+            # family (gen col 1) — what keys the fe lane on device
+            # and the (ep, l7type, dport) memo mirror host-side;
+            # identical to encode_flows' live normalization
+            fam = gen_rows[:, 1]
+            out[:, col["l7_types"]] = np.where(
+                fam > 0, fam, out[:, col["l7_types"]])
+            out = np.concatenate([out, gen_rows], axis=1)
         return out
 
     def encode(self, rec, l7) -> FlowBatch:
@@ -987,6 +1183,9 @@ class CaptureFeaturizer:
             kafka_topic=self.luts["kafka_topic"][l7["kafka_topic"]],
             gen_proto=np.full(B, -2, dtype=np.int32),
             gen_pairs=np.full((B, self.fmax), -2, dtype=np.int32),
+            l7g=(np.zeros((B, 32), dtype=np.uint8),
+                 np.zeros(B, dtype=np.int32),
+                 np.ones(B, dtype=bool)),
         )
 
 
@@ -1026,7 +1225,13 @@ def _stage_tables_step(arrays: Dict[str, jax.Array],
     gathers. ``impl``/``interpret`` are trace-static (the engine
     resolves them at staging; see dfa_kernel.resolve_impl)."""
     tw: Dict[str, jax.Array] = {}
-    for field, prefix in _TABLE_FIELDS:
+    table_fields = _TABLE_FIELDS
+    if "l7g_trans" in arrays and "l7g" in tables:
+        # frontend serialized-record table: scanned through the l7g
+        # automaton exactly like the five string fields (static under
+        # jit — policies without frontend rules skip it wholesale)
+        table_fields = table_fields + (("l7g", "l7g"),)
+    for field, prefix in table_fields:
         data, lens, valid = tables[field]
         want_groups = field == "path" and "rp_path_gaccept" in arrays
         out = dfa_scan_banked(
@@ -1074,6 +1279,9 @@ def stage_capture_tables(engine: "VerdictEngine",
     compile."""
     tables = {field: jax.device_put(feat.tables[field], engine.device)
               for field, _ in _TABLE_FIELDS}
+    if "l7g" in feat.tables and "l7g_trans" in engine._arrays:
+        tables["l7g"] = jax.device_put(feat.tables["l7g"],
+                                       engine.device)
     step = _stage_tables_jit(getattr(engine, "_dfa_impl", "gather"),
                              getattr(engine, "_interpret", None))
     return step(engine._arrays, tables)
@@ -1127,9 +1335,15 @@ def verdict_step_capture(arrays: Dict[str, jax.Array],
     src = jnp.where(ingress, c("peer_ids"), c("ep_ids"))
     dst = jnp.where(ingress, c("ep_ids"), c("peer_ids"))
     n = len(_ROW_COLS)
+    gen_cols = None
     # ctlint: disable=recompile-hazard  # row width is static per capture layout: one compile per layout, by design
-    gen_cols = ((rows[:, n], rows[:, n + 1:])
-                if rows.shape[1] > n else None)
+    if rows.shape[1] > n:
+        # gen block layout (CaptureFeaturizer / IncrementalSession):
+        # [proto id, frontend family, l7g table row, pair ids...]
+        gen_cols = (rows[:, n], rows[:, n + 3:])
+        if "l7g_trans" in arrays and "l7g" in table_words:
+            words = words + (
+                table_words["l7g"][rows[:, n + 2]],)
     kafka_cols = (c("kafka_api_key"), c("kafka_api_version"),
                   c("kafka_client"), c("kafka_topic"))
     if "rp_g_method" in arrays and "path_groups" in table_words:
@@ -1174,6 +1388,23 @@ def encode_l7_records(rec, l7, offsets, blob,
     w = widths or {}
     gen_rows = (_gen_intern_rows(gen, offsets, blob, interns)
                 if gen is not None else None)
+    l7_types = rec["l7_type"].astype(np.int32)
+    if gen is not None:
+        fam, uniq_ser, l7g_row = _gen_l7g_cols(gen, offsets, blob)
+        # frontend records: normalize the l7-type lane to the family
+        # and encode the serialized records (same invariants as
+        # encode_flows — a chunked caller's fixed widths come from
+        # capture_field_widths, but l7g serializations are derived,
+        # so the cap itself is the fixed width)
+        l7_types = np.where(fam > 0, fam, l7_types)
+        ser = [uniq_ser[r] for r in l7g_row]
+        l7g_field = encode_strings(
+            ser, cfg.l7g_len,
+            pad_multiple=cfg.l7g_len if w else 32)
+    else:
+        l7g_field = (np.zeros((B, 32), dtype=np.uint8),
+                     np.zeros(B, dtype=np.int32),
+                     np.ones(B, dtype=bool))
 
     def field(name: str, cap: int):
         return _gather_table_field(blob, offsets, l7[name], cap,
@@ -1184,7 +1415,7 @@ def encode_l7_records(rec, l7, offsets, blob,
         dports=rec["dport"].astype(np.int32),
         protos=rec["proto"].astype(np.int32),
         directions=rec["direction"].astype(np.int32),
-        l7_types=rec["l7_type"].astype(np.int32),
+        l7_types=l7_types,
         path=field("path", max(cfg.http_path_buckets)),
         method=field("method", cfg.http_method_len),
         host=field("host", cfg.http_host_len),
@@ -1200,6 +1431,7 @@ def encode_l7_records(rec, l7, offsets, blob,
                    else np.full(B, -2, dtype=np.int32)),
         gen_pairs=(gen_rows[:, 1:] if gen_rows is not None
                    else np.full((B, fmax), -2, dtype=np.int32)),
+        l7g=l7g_field,
     )
 
 
@@ -1214,7 +1446,7 @@ _SCALAR_COLS = (
     "gen_proto",
     "path_len", "path_valid", "method_len", "method_valid",
     "host_len", "host_valid", "headers_len", "headers_valid",
-    "qname_len", "qname_valid",
+    "qname_len", "qname_valid", "l7g_len", "l7g_valid",
 )
 
 
@@ -1227,7 +1459,7 @@ def pack_batch(d: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     scalars = np.stack(
         [d[c].astype(np.int32) for c in _SCALAR_COLS], axis=1)
     out = {"scalars": np.ascontiguousarray(scalars)}
-    for name in ("path", "method", "host", "headers", "qname"):
+    for name in ("path", "method", "host", "headers", "qname", "l7g"):
         out[f"{name}_data"] = d[f"{name}_data"]
     out["gen_pairs"] = d["gen_pairs"]
     return out
@@ -1241,7 +1473,7 @@ def unpack_batch(packed: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
     for i, col in enumerate(_SCALAR_COLS):
         v = scalars[:, i]
         out[col] = (v != 0) if col.endswith("_valid") else v
-    for name in ("path", "method", "host", "headers", "qname"):
+    for name in ("path", "method", "host", "headers", "qname", "l7g"):
         out[f"{name}_data"] = packed[f"{name}_data"]
     out["gen_pairs"] = packed["gen_pairs"]
     if "auth_pairs" in packed:  # staged auth table rides alongside
@@ -1284,11 +1516,12 @@ def _masked_min(matched: "jax.Array", values: "jax.Array"
     return jnp.where(m == _ATTR_NONE, -1, m)
 
 
-def _combine_l7_match(http, kafka, dns, gen=None) -> "jax.Array":
+def _combine_l7_match(http, kafka, dns, gen=None,
+                      fe=None) -> "jax.Array":
     """Per-family (ok, win) pairs → ONE [B] int32 attribution lane.
     Families are mutually exclusive per flow (every family's ``ok``
-    is gated on its own ``l7t``), so the combine is a select, not a
-    priority."""
+    is gated on its own ``l7t``; frontend families are distinct
+    l7-type values), so the combine is a select, not a priority."""
     http_ok, http_win = http
     kafka_ok, kafka_win = kafka
     dns_ok, dns_win = dns
@@ -1298,6 +1531,9 @@ def _combine_l7_match(http, kafka, dns, gen=None) -> "jax.Array":
     if gen is not None:
         gen_ok, gen_win = gen
         out = jnp.where((out < 0) & gen_ok, gen_win, out)
+    if fe is not None:
+        fe_ok, fe_win = fe
+        out = jnp.where((out < 0) & fe_ok, fe_win, out)
     return out.astype(jnp.int32)
 
 
@@ -1363,6 +1599,42 @@ def _l7_generic(arrays, ruleset, gen_cols, l7t):
               if "rp_gen_rule_group" in arrays
               else jnp.arange(Rg, dtype=jnp.int32))
     return ok, _masked_min(g_ok & in_set, values)
+
+
+def _l7_frontend(arrays, ruleset, l7g_w, gen_pairs, l7t):
+    """Protocol-frontend rule matching → ``(ruleset-any [B] bool,
+    attribution winner [B] int32)``. Per rule: one automaton lane bit
+    over the protocol's SCAN-FIELD value (``fe_lane``; -1 =
+    unconstrained) AND a pair-subset check of the rule's interned
+    enum/presence predicates (``fe_pairs``, same id space and same
+    subset semantics as the generic path's ``gen_pairs`` column),
+    gated on the rule's family matching the flow's normalized l7-type
+    lane; dead rules (unsatisfiable / padding) never match. Shared
+    verbatim by the legacy and fused resolves (winner space: see
+    ``_l7_kafka``)."""
+    lane_ok = _rule_bit(l7g_w, arrays["fe_lane"])
+    grp = arrays["fe_pairs"]                    # [Rf, Km]
+    have = jnp.any(
+        gen_pairs[:, None, None, :] == grp[None, :, :, None],
+        axis=-1)                                # [B, Rf, Km]
+    pair_ok = jnp.all(jnp.where(grp[None, :, :] < 0, True, have),
+                      axis=-1)
+    fam = arrays["fe_family"]
+    f_ok = (lane_ok & pair_ok
+            & (fam[None, :] == l7t[:, None])
+            & (fam >= 0)[None, :]
+            & ~arrays["fe_dead"][None, :])
+    fe_mask = arrays["rs_fe_mask"][ruleset]
+    f_words = _bools_to_words(f_ok, fe_mask.shape[1])
+    ok = jnp.any((f_words & fe_mask) != 0, axis=1)
+    Rf = f_ok.shape[1]
+    r_idx = jnp.arange(Rf)
+    in_set = ((fe_mask[:, r_idx >> 5]
+               >> (r_idx & 31).astype(jnp.uint32)) & 1).astype(bool)
+    values = (arrays["rp_fe_rule_group"]
+              if "rp_fe_rule_group" in arrays
+              else jnp.arange(Rf, dtype=jnp.int32))
+    return ok, _masked_min(f_ok & in_set, values)
 
 
 def _assemble_verdict(arrays, ms, l7_ok, l7_log_http, auth_src_dst,
@@ -1434,9 +1706,13 @@ def _verdict_core(arrays, ms, l7t, words, kafka_cols, auth_src_dst,
     int32 columns; ``auth_src_dst`` = (src, dst) identity columns for
     the authed-pairs check; ``gen_cols`` = (gen_proto, gen_pairs) or
     None when the caller's format cannot carry generic records (v2
-    captures — a -2 proto could never match anyway)."""
+    captures — a -2 proto could never match anyway). A sixth entry in
+    ``words`` is the l7g (protocol-frontend) match words — present
+    exactly when the policy staged an l7g automaton and the caller's
+    format carries serialized frontend records."""
     ruleset = jnp.clip(ms["ruleset"], 0, arrays["rs_http_mask"].shape[0] - 1)
-    path_w, method_w, host_w, hdr_w, dns_w = words
+    path_w, method_w, host_w, hdr_w, dns_w = words[:5]
+    l7g_w = words[5] if len(words) > 5 else None
 
     # HTTP: conjunction of per-field pattern bits per rule
     rule_ok = (
@@ -1513,9 +1789,20 @@ def _verdict_core(arrays, ms, l7t, words, kafka_cols, auth_src_dst,
         l7_ok = l7_ok | gen_ok
         gen_pair = (gen_ok, gen_win)
 
+    fe_pair = None
+    if l7g_w is not None and gen_cols is not None \
+            and "fe_lane" in arrays:
+        # protocol-frontend records: scan-field automaton lane +
+        # enum pair subset + family equality
+        fe_ok, fe_win = _l7_frontend(arrays, ruleset, l7g_w,
+                                     gen_cols[1], l7t)
+        l7_ok = l7_ok | fe_ok
+        fe_pair = (fe_ok, fe_win)
+
     l7_match = _combine_l7_match((http_ok, http_win),
                                  (kafka_ok, kafka_win),
-                                 (dns_ok, dns_win), gen_pair)
+                                 (dns_ok, dns_win), gen_pair,
+                                 fe=fe_pair)
     return _assemble_verdict(arrays, ms, l7_ok, l7_log_http,
                              auth_src_dst, batch, l7_match=l7_match)
 
@@ -1523,7 +1810,7 @@ def _verdict_core(arrays, ms, l7t, words, kafka_cols, auth_src_dst,
 #: transfer order of the single-blob service transport (pack_blob_host
 #: / unpack_blob): every per-batch array, one H2D
 _BLOB_KEYS = ("scalars", "path_data", "method_data", "host_data",
-              "headers_data", "qname_data", "gen_pairs")
+              "headers_data", "qname_data", "l7g_data", "gen_pairs")
 
 
 def pack_blob_host(host: Dict[str, np.ndarray]):
@@ -1613,6 +1900,9 @@ def verdict_step(arrays: Dict[str, jax.Array], batch: Dict[str, jax.Array]
              scan_field("host", *batch_field(batch, "host")),
              scan_field("hdr", *batch_field(batch, "headers")),
              scan_field("dns", *batch_field(batch, "qname")))
+    if "l7g_trans" in arrays:   # frontend rules staged (static)
+        words = words + (
+            scan_field("l7g", *batch_field(batch, "l7g")),)
     # flows rebuild (src, dst) from (ep, peer) by direction
     ingress = batch["directions"] == int(TrafficDirection.INGRESS)
     src = jnp.where(ingress, batch["peer_ids"], batch["ep_ids"])
@@ -2318,7 +2608,7 @@ def flowbatch_to_host_dict(fb: FlowBatch) -> Dict[str, np.ndarray]:
         "gen_proto": fb.gen_proto,
         "gen_pairs": fb.gen_pairs,
     }
-    for name in ("path", "method", "host", "headers", "qname"):
+    for name in ("path", "method", "host", "headers", "qname", "l7g"):
         data, lengths, valid = getattr(fb, name)
         d[f"{name}_data"] = data
         d[f"{name}_len"] = lengths

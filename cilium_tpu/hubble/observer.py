@@ -76,7 +76,10 @@ def annotate_flows(flows: Sequence[Flow], outputs: Dict[str, np.ndarray],
         else:
             f.policy_match_type = PolicyMatchType.NONE
         if amap is not None and l7m is not None:
-            from cilium_tpu.engine.attribution import pack_word
+            from cilium_tpu.engine.attribution import (
+                flow_family,
+                pack_word,
+            )
 
             gen = (int(prov.gens[i]) if prov is not None
                    and i < len(prov.gens) else gen_now)
@@ -84,13 +87,16 @@ def annotate_flows(flows: Sequence[Flow], outputs: Dict[str, np.ndarray],
                    and i < len(prov.memo_hit) else False)
             kernel = prov.kernel if prov is not None else ""
             cycle = prov.pack_cycle if prov is not None else 0
-            f.prov_word = pack_word(code, int(f.l7), hit, gen,
+            # frontend records carry l7 == GENERIC on the flow but
+            # verdict on their family lane — decode in that space
+            fam = flow_family(f)
+            f.prov_word = pack_word(code, fam, hit, gen,
                                     cycle, kernel)
             f.prov_generation = gen
             f.prov_memo = hit
-            res = amap.resolve(int(f.l7), code) if code >= 0 else None
+            res = amap.resolve(fam, code) if code >= 0 else None
             if res is not None:
-                f.prov_rule = amap.rule_label(int(f.l7), code)
+                f.prov_rule = amap.rule_label(fam, code)
                 f.prov_bank = str(res.get("bank_key", "") or "")
     return flows
 

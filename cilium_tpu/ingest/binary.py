@@ -385,13 +385,18 @@ def flows_to_capture_l7(flows: Iterable[Flow]):
     fmax = 0
     for i, f in enumerate(flows):
         g = f.generic
-        carriable = (f.l7 == L7Type.GENERIC and g is not None
+        carriable = (f.l7 >= L7Type.GENERIC and g is not None
                      and g.proto)
         # a GENERIC flow with no payload/proto can never match a rule;
         # flatten it to the L4 tuple (same invariant as v1: an
-        # uncarriable payload must not re-verdict against EMPTY fields)
-        l7t = (L7Type.NONE
-               if f.l7 == L7Type.GENERIC and not carriable else f.l7)
+        # uncarriable payload must not re-verdict against EMPTY
+        # fields). Frontend-family flows carry like GENERIC and
+        # normalize to the canonical GENERIC code — replay re-derives
+        # the family from the record's proto.
+        if f.l7 >= L7Type.GENERIC:
+            l7t = L7Type.GENERIC if carriable else L7Type.NONE
+        else:
+            l7t = f.l7
         rec[i] = (f.src_identity, f.dst_identity, f.dport, f.sport,
                   int(f.protocol), int(f.direction), int(l7t),
                   int(f.verdict), f.time, 0, 0)

@@ -165,13 +165,19 @@ def flow_to_column_tuple(f) -> tuple:
         kapi = k.api_key
         kver = k.api_version
     g = f.generic
-    if f.l7 == L7Type.GENERIC and g is not None:
+    # frontend-family flows (l7 > GENERIC) carry like GENERIC: the
+    # capture's canonical l7_type stays GENERIC — replay re-derives
+    # the family from the record's proto, so old readers never see
+    # codes past the v3 universe
+    l7t_out = int(f.l7)
+    if f.l7 >= L7Type.GENERIC and g is not None:
         gproto = g.proto.encode("utf-8")
         gpairs = tuple((kk.encode("utf-8"), vv.encode("utf-8"))
                        for kk, vv in sorted(g.fields.items()) if kk)
+        l7t_out = int(L7Type.GENERIC)
     return (f.time, int(f.verdict), int(f.direction),
             f.src_identity, f.dst_identity, f.sport, f.dport,
-            int(f.protocol), int(f.l7),
+            int(f.protocol), l7t_out,
             path, method, host, headers, qname,
             kclient, ktopic, kapi, kver, gproto, gpairs)
 
@@ -196,12 +202,13 @@ def tuples_to_columns(rows: List[tuple]) -> CaptureColumns:
     gproto_col = c("gen_proto")
     carriable = np.array(
         [bool(p) for p in gproto_col], dtype=bool) \
-        & (l7t == int(L7Type.GENERIC))
+        & (l7t >= int(L7Type.GENERIC))
     # flatten uncarriable generic records to their L4 tuple (same
     # invariant as v1: no payload must not re-verdict against EMPTY
-    # fields)
-    l7t = np.where((l7t == int(L7Type.GENERIC)) & ~carriable,
+    # fields); carriable ones normalize to the canonical GENERIC code
+    l7t = np.where((l7t >= int(L7Type.GENERIC)) & ~carriable,
                    int(L7Type.NONE), l7t)
+    l7t = np.where(carriable, int(L7Type.GENERIC), l7t)
 
     rec = np.zeros(n, dtype=RECORD)
     rec["src_identity"] = c("src_identity")
@@ -241,7 +248,7 @@ def tuples_to_columns(rows: List[tuple]) -> CaptureColumns:
         rec=rec, l7=l7, offsets=offsets, blob=blob, gen=gen,
         fmax=fmax,
         gen_dropped=int(
-            ((np.array(c("l7_type")) == int(L7Type.GENERIC))
+            ((np.array(c("l7_type")) >= int(L7Type.GENERIC))
              & ~carriable).sum()))
 
 

@@ -124,7 +124,7 @@ def flow_to_dict(f: Flow) -> Dict:
             "ips": list(f.dns.ips),
             "ttl": f.dns.ttl,
         }}
-    elif f.l7 == L7Type.GENERIC and f.generic:
+    elif f.l7 >= L7Type.GENERIC and f.generic:
         # flowpb models proxylib records as {proto, fields} key/value
         # pairs (flow.proto L7 "kind: generic")
         d["l7"] = {"type": "REQUEST", "generic": {

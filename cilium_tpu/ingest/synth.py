@@ -37,6 +37,7 @@ from cilium_tpu.policy.api import (
     PortRuleKafka,
     Rule,
 )
+from cilium_tpu.policy.api.l7 import PortRuleL7
 
 ING = TrafficDirection.INGRESS
 EG = TrafficDirection.EGRESS
@@ -277,6 +278,88 @@ def synth_generic_scenario(n_rules: int = 200, n_flows: int = 100000,
     )
 
 
+# ------------------------------------------- protocol-frontend lane --
+#: per-protocol traffic shares of the mixed protocols scenario (and
+#: the serve-soak load model's protocol-mix knob default)
+PROTOCOL_MIX = (("cassandra", 0.4), ("memcache", 0.4), ("r2d2", 0.2))
+
+#: dports per frontend protocol (the well-known service ports)
+PROTOCOL_PORTS = {"cassandra": 9042, "memcache": 11211, "r2d2": 4040}
+
+
+def synth_protocols_scenario(n_rules: int = 120, n_flows: int = 100000,
+                             seed: int = 0,
+                             mix=PROTOCOL_MIX) -> SynthScenario:
+    """Mixed protocol-frontend traffic (ISSUE 15): cassandra,
+    memcached, and r2d2 records against per-protocol rule sets on one
+    endpoint — every record compiles through the frontend registry
+    onto the l7g banked automaton and rides the same fused dispatch.
+    ``mix`` weights the per-protocol traffic shares (the serve-soak
+    protocol-mix knob reuses it)."""
+    from cilium_tpu.core.flow import GenericL7Info
+
+    rng = random.Random(seed)
+    protos = [p for p, _ in mix]
+    weights = [w for _, w in mix]
+    per = max(1, n_rules // max(1, len(protos)))
+    rules_of: Dict[str, list] = {}
+    for proto in protos:
+        rr = []
+        for i in range(per):
+            if proto == "cassandra":
+                rr.append({"query_action":
+                           ("select", "insert", "update")[i % 3],
+                           "query_table": f"ks.t{i}"})
+            elif proto == "memcache":
+                rr.append({"cmd": ("get", "set", "delete")[i % 3],
+                           "key": f"k{i}"})
+            else:
+                rr.append({"cmd": ("READ", "WRITE")[i % 2],
+                           "file": f"f{i}.dat"})
+        rules_of[proto] = rr
+    ports = tuple(
+        PortRule(ports=(PortProtocol(PROTOCOL_PORTS[p], Protocol.TCP),),
+                 rules=L7Rules(l7proto=p,
+                               l7=tuple(PortRuleL7.from_dict(r)
+                                        for r in rules_of[p])))
+        for p in protos)
+    rule = Rule(
+        endpoint_selector=_sel(app="polysvc"),
+        ingress=(IngressRule(from_endpoints=(_sel(app="client"),),
+                             to_ports=ports),),
+        labels=("synth=protocols",),
+    )
+    flows = []
+    for _ in range(n_flows):
+        proto = rng.choices(protos, weights=weights)[0]
+        rr = rules_of[proto]
+        i = rng.randrange(len(rr) + len(rr) // 4 + 1)  # some unmatched
+        if i < len(rr):
+            fields = dict(rr[i])
+            if rng.random() < 0.25 and len(fields) > 1:
+                # matched command, wrong second field → denied
+                k = sorted(fields)[-1]
+                fields[k] = fields[k] + ".nope"
+        else:
+            fields = ({"query_action": "drop",
+                       "query_table": "forbidden"}
+                      if proto == "cassandra" else
+                      {"cmd": "flush_all"} if proto == "memcache"
+                      else {"cmd": "HALT"})
+        flows.append(Flow(
+            src_identity=0, dst_identity=0,
+            dport=PROTOCOL_PORTS[proto],
+            protocol=Protocol.TCP, direction=ING, l7=L7Type.GENERIC,
+            generic=GenericL7Info(proto=proto, fields=fields),
+        ))
+    return SynthScenario(
+        name="protocols", rules=[rule],
+        endpoints={"polysvc": {"app": "polysvc"},
+                   "client": {"app": "client"}},
+        flows=flows,
+    )
+
+
 # ------------------------------------------------------ config 3: mixed --
 def synth_mixed_scenario(corpus_dir: str, n_tuples: int = 1_000_000,
                          seed: int = 0) -> SynthScenario:
@@ -439,6 +522,9 @@ def scenario_by_name(name: str, n_rules: int, n_flows: int,
     if name == "generic":
         return synth_generic_scenario(n_rules=n_rules, n_flows=n_flows,
                                       seed=seed)
+    if name == "protocols":
+        return synth_protocols_scenario(n_rules=n_rules,
+                                        n_flows=n_flows, seed=seed)
     raise ValueError(f"unknown scenario {name!r}")
 
 
@@ -490,6 +576,10 @@ def realize_scenario(scenario: SynthScenario, resolve: bool = True):
         for f in scenario.flows:
             f.src_identity = ids["droid"]
             f.dst_identity = ids["r2d2"]
+    elif scenario.name == "protocols":
+        for f in scenario.flows:
+            f.src_identity = ids["client"]
+            f.dst_identity = ids["polysvc"]
     elif scenario.name == "fqdn":
         for f in scenario.flows:
             f.src_identity = ids["crawler"]

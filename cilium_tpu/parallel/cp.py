@@ -278,6 +278,16 @@ def _cp_verdict_factory(mesh: Mesh, seq_axis: str, block: int,
                 gwords = jnp.where(valid[:, None], gw, 0)
             flat = w3.reshape(w3.shape[0], -1)
             words.append(jnp.where(valid[:, None], flat, 0))
+        if "l7g_trans" in arrays:   # static per staged policy
+            # protocol-frontend scan: small replicated bank stack,
+            # full batch per device (serialized records are short —
+            # CP column-sharding them would be all exchange, no scan)
+            w3 = dfa_scan_banked(
+                arrays["l7g_trans"], arrays["l7g_byteclass"],
+                arrays["l7g_start"], arrays["l7g_accept"],
+                b["l7g_data"], b["l7g_len"])
+            flat = w3.reshape(w3.shape[0], -1)
+            words.append(jnp.where(b["l7g_valid"][:, None], flat, 0))
         words = tuple(words)
         ingress = b["directions"] == int(TrafficDirection.INGRESS)
         src = jnp.where(ingress, b["peer_ids"], b["ep_ids"])

@@ -222,6 +222,21 @@ def _ep_verdict_factory(mesh: Mesh, axis: str,
                                     jax.lax.bitwise_or, (1,))
                 gwords = jnp.where(valid_l[:, None], gw, 0)
         words = tuple(words)
+        if "l7g_trans" in arrays:   # static per staged layout
+            # protocol-frontend scan: the l7g bank stack is small and
+            # REPLICATED (not EP-sharded), so each device scans only
+            # its LOCAL batch slice after the switch — no extra
+            # payload in the all_to_all
+            from cilium_tpu.engine.dfa_kernel import (
+                dfa_scan_banked as _scan,
+            )
+
+            w3 = _scan(arrays["l7g_trans"], arrays["l7g_byteclass"],
+                       arrays["l7g_start"], arrays["l7g_accept"],
+                       loc(b["l7g_data"]), loc(b["l7g_len"]))
+            flat = w3.reshape(Bl, -1)
+            words = words + (jnp.where(
+                loc(b["l7g_valid"])[:, None], flat, 0),)
 
         # match: LOCAL batch slice only — mapstate + resolve shard
         # over the batch like DP, scan work sharded over banks

@@ -110,6 +110,15 @@ _REGISTRY: Dict[str, Callable[..., Parser]] = {}
 
 def register_parser(name: str, factory: Callable[..., Parser]) -> None:
     _REGISTRY[name] = factory
+    # ONE l7proto registry (ISSUE 15 satellite): the engine compiler
+    # validates policy `l7proto` names against the union of engine
+    # frontends and these proxy registrations, so a parser the proxy
+    # can dispatch is always a name the compiler accepts — and an
+    # unknown name fails loudly at compile instead of silently
+    # compiling to unmatched generic rules
+    from cilium_tpu.policy.compiler import frontends as _fe
+
+    _fe.register_proxy_parser(name)
 
 
 def create_parser(name: str, connection: Connection,

@@ -91,6 +91,12 @@ FAULT_POINTS = (
     # (degrades to a counted recompile)
     "compile.worker",
     "artifact.fetch",
+    # ISSUE 15 — the cross-cluster surface: a remote-cluster event
+    # ingest dying mid-delivery (isolated by the kvstore watch; the
+    # re-announce repairs it) and a publisher heartbeat miss (the
+    # lease keeps state alive until the next beat)
+    "clustermesh.session",
+    "clustermesh.heartbeat",
 )
 
 #: breaker/quarantine timings the schedules steer around; small so
@@ -196,11 +202,18 @@ class DSTWorld:
         self.dbs = [self.alloc.allocate(
             LabelSet.from_dict({"app": f"db{i}"}))
             for i in range(self.N_IDS)]
-        #: identity index → list of (kind, pattern); the DESIRED state
+        #: identity index → list of (kind, pattern); the DESIRED
+        #: state. The protocol-frontend kinds (ISSUE 15) put one
+        #: cassandra/memcache/r2d2 rule per identity in the BASE
+        #: policy, so the oracle-agreement + fail-closed invariants
+        #: arm over the new families on every schedule and a
+        #: loader.bank_compile fault can land on an l7g bank
         self.rules_of = {
             i: [("http", f"/svc{i}/p{j}/.*")
                 for j in range(self.BASE_PATHS)]
-            + [("dns", f"api{i}.corp.io")]
+            + [("dns", f"api{i}.corp.io"),
+               ("cass", f"tbl{i}"), ("mc", f"k{i}"),
+               ("r2d2", f"f{i}.dat")]
             for i in range(self.N_IDS)}
         #: the last state a successful commit (or warm restore) staged
         #: — the oracle the serving plane is held to
@@ -232,6 +245,11 @@ class DSTWorld:
         self.cluster_alloc = ClusterIdentityAllocator(self.store).start()
         self.storm_pool = [LabelSet.from_dict({"storm": f"s{i}"})
                            for i in range(8)]
+        #: lazily-built clustermesh slice (publisher → kvstore →
+        #: remote watcher, ISSUE 15): (store, remote ipcache, local
+        #: ipcache, publisher, RemoteCluster)
+        self._mesh = None
+        self._mesh_n = 0
         #: lazily-built continuously-batched serving loop
         #: (runtime/serveloop.py) — a SMALL ring (capacity 4, short
         #: lease TTL) so ring-full sheds and TTL expiries are
@@ -278,6 +296,8 @@ class DSTWorld:
         from cilium_tpu.policy.repository import Repository
         from cilium_tpu.policy.selectorcache import SelectorCache
 
+        from cilium_tpu.policy.api.l7 import PortRuleL7
+
         repo = Repository()
         rules = []
         for i in range(self.N_IDS):
@@ -285,17 +305,34 @@ class DSTWorld:
                          for k, p in self.rules_of[i] if k == "http")
             dns = tuple(PortRuleDNS(match_name=p)
                         for k, p in self.rules_of[i] if k == "dns")
+            cass = tuple(PortRuleL7.from_dict(
+                {"query_action": "select", "query_table": p})
+                for k, p in self.rules_of[i] if k == "cass")
+            mc = tuple(PortRuleL7.from_dict({"cmd": "get", "key": p})
+                       for k, p in self.rules_of[i] if k == "mc")
+            r2 = tuple(PortRuleL7.from_dict(
+                {"cmd": "READ", "file": p})
+                for k, p in self.rules_of[i] if k == "r2d2")
+            ports = [
+                PortRule(ports=(PortProtocol(80, Protocol.TCP),),
+                         rules=L7Rules(http=http)),
+                PortRule(ports=(PortProtocol(53, Protocol.UDP),),
+                         rules=L7Rules(dns=dns)),
+            ]
+            for proto, port, rr in (("cassandra", 9042, cass),
+                                    ("memcache", 11211, mc),
+                                    ("r2d2", 4040, r2)):
+                if rr:
+                    ports.append(PortRule(
+                        ports=(PortProtocol(port, Protocol.TCP),),
+                        rules=L7Rules(l7proto=proto, l7=rr)))
             rules.append(Rule(
                 endpoint_selector=EndpointSelector.from_labels(
                     app=f"db{i}"),
                 ingress=(IngressRule(
                     from_endpoints=(
                         EndpointSelector.from_labels(app="web"),),
-                    to_ports=(
-                        PortRule(ports=(PortProtocol(80, Protocol.TCP),),
-                                 rules=L7Rules(http=http)),
-                        PortRule(ports=(PortProtocol(53, Protocol.UDP),),
-                                 rules=L7Rules(dns=dns)),)),),
+                    to_ports=tuple(ports)),),
             ))
         repo.add(rules, sanitize=False)
         resolver = PolicyResolver(repo, SelectorCache(self.alloc))
@@ -330,13 +367,44 @@ class DSTWorld:
                     direction=TrafficDirection.INGRESS, l7=L7Type.DNS,
                     dns=DNSInfo(query=qname))
 
+    #: frontend probe shapes per rules_of kind: (l7proto, dport,
+    #: record-fields builder). The record matching the committed
+    #: pattern must be ALLOWED; the fixed never-records are the
+    #: fail-closed canaries of the new families.
+    _FE_KINDS = {
+        "cass": ("cassandra", 9042,
+                 lambda p: {"query_action": "select",
+                            "query_table": p}),
+        "mc": ("memcache", 11211, lambda p: {"cmd": "get", "key": p}),
+        "r2d2": ("r2d2", 4040, lambda p: {"cmd": "READ", "file": p}),
+    }
+
+    def _fe(self, i: int, proto: str, dport: int, fields):
+        from cilium_tpu.core.flow import (
+            Flow,
+            GenericL7Info,
+            L7Type,
+            Protocol,
+            TrafficDirection,
+        )
+
+        return Flow(src_identity=self.web, dst_identity=self.dbs[i],
+                    dport=dport, protocol=Protocol.TCP,
+                    direction=TrafficDirection.INGRESS,
+                    l7=L7Type.GENERIC,
+                    generic=GenericL7Info(proto=proto,
+                                          fields=dict(fields)))
+
     def corpus(self):
         """The probe corpus: every pattern in the UNION of committed
         and desired states, plus never-allowed probes. Probing
         desired-but-rolled-back patterns is what catches a plane
         serving an aborted revision (it allows what the committed
         oracle denies); the fixed probes are the fail-closed
-        canaries. Deterministic order."""
+        canaries. Deterministic order. The frontend kinds (ISSUE 15)
+        probe their families the same way — the oracle here is the
+        parser-semantics CPU matcher, so oracle-agreement covers the
+        l7g automaton + enum-predicate lowering end to end."""
         flows = []
         for i in range(self.N_IDS):
             pats = list(self.committed[i])
@@ -345,10 +413,19 @@ class DSTWorld:
                 if kind == "http":
                     flows.append(self._http(
                         i, pat.replace("/.*", "/x")))
-                else:
+                elif kind == "dns":
                     flows.append(self._dns(i, pat))
+                else:
+                    proto, dport, mk = self._FE_KINDS[kind]
+                    flows.append(self._fe(i, proto, dport, mk(pat)))
             flows.append(self._http(i, "/never/allowed"))
             flows.append(self._dns(i, "evil.example"))
+            flows.append(self._fe(i, "cassandra", 9042,
+                                  {"query_action": "drop",
+                                   "query_table": "forbidden"}))
+            flows.append(self._fe(i, "memcache", 11211,
+                                  {"cmd": "flush_all"}))
+            flows.append(self._fe(i, "r2d2", 4040, {"cmd": "HALT"}))
         return flows
 
     def oracle_verdicts(self, flows) -> List[int]:
@@ -374,13 +451,21 @@ class DSTWorld:
         degraded — both recorded."""
         if op == "delete":
             extras = [(k, p) for k, p in self.rules_of[i]
-                      if "/churn" in p or p.startswith("churn")]
+                      if "/churn" in p or p.startswith("churn")
+                      or p.startswith("ctbl")]
             if not extras:
                 op = "add"  # nothing churned-in yet: degrade to add
             else:
                 self.rules_of[i].remove(extras[0])
         if op == "add":
-            self.rules_of[i].append(("http", f"/churn{step}/.*"))
+            # every 4th churned-in pattern lands on the cassandra
+            # frontend (ISSUE 15): l7g bank churn rides the same O(Δ)
+            # bound, bank-compile faults, and memo-refill machinery
+            # as the http banks
+            if step % 4 == 3:
+                self.rules_of[i].append(("cass", f"ctbl{step}"))
+            else:
+                self.rules_of[i].append(("http", f"/churn{step}/.*"))
         self.revision += 1
         rolled_back = False
         reg = self.loader.bank_registry
@@ -868,6 +953,95 @@ class DSTWorld:
             fresh.close()
         return {"events": n, "store_keys": len(self.store)}
 
+    def clustermesh_sync(self, n: int, index: int) -> Dict:
+        """A remote-cluster sync round (ISSUE 15): ``n`` remote
+        endpoint announcements ride a LocalStatePublisher → kvstore →
+        RemoteCluster watch into the LOCAL allocator/ipcache, with
+        the ``clustermesh.session``/``clustermesh.heartbeat`` fault
+        points live on the path. A session fault eats one delivery
+        (isolated by the kvstore watch) and a heartbeat fault skips a
+        lease keepalive — both must CONVERGE under the bounded repair
+        loop (re-upsert + heartbeat), or the mesh is silently
+        diverging: every published prefix must resolve locally to an
+        identity tagged with the remote cluster's name."""
+        import json as _json
+
+        from cilium_tpu.clustermesh import (
+            CLUSTER_LABEL_KEY,
+            IP_PREFIX,
+            LocalStatePublisher,
+            RemoteCluster,
+        )
+        from cilium_tpu.core.labels import SOURCE_K8S, LabelSet
+        from cilium_tpu.ipcache import IPCache
+        from cilium_tpu.kvstore import KVStore
+
+        if self._mesh is None:
+            store = KVStore()
+            remote_alloc_ipc = IPCache(self.alloc)
+            local_ipc = IPCache(self.alloc)
+            pub = LocalStatePublisher(store, "alpha", self.alloc,
+                                      remote_alloc_ipc,
+                                      lease_ttl=3600.0)
+            rc = RemoteCluster("alpha", store, self.alloc,
+                               local_ipc).connect()
+            self._mesh = (store, remote_alloc_ipc, local_ipc, pub, rc)
+            self._mesh_n = 0
+        store, remote_ipc, local_ipc, pub, rc = self._mesh
+        faulted = 0
+        for k in range(n):
+            idx = self._mesh_n
+            self._mesh_n += 1
+            nid = self.alloc.allocate(LabelSet.from_dict(
+                {"meshapp": f"m{idx % 6}"}))
+            try:
+                remote_ipc.upsert(f"10.9.{idx // 200}."
+                                  f"{idx % 200 + 1}/32", nid)
+            except Exception:  # noqa: BLE001 — injected session fault
+                faulted += 1
+        try:
+            pub.heartbeat()
+        except Exception:  # noqa: BLE001 — injected heartbeat fault
+            faulted += 1
+        # bounded repair: re-announce every published entry (value
+        # bumped so the watch re-delivers) + heartbeat, then REQUIRE
+        # convergence — the re-announce is exactly the reference's
+        # reconcile loop, so an unconverged mesh is a real bug
+        for _attempt in range(3):
+            try:
+                for e in remote_ipc.dump():
+                    nid = int(e["identity"])
+                    labels = self.alloc.lookup(nid)
+                    store.set(
+                        f"{IP_PREFIX}alpha/{e['cidr']}",
+                        _json.dumps({
+                            "prefix": e["cidr"], "identity": nid,
+                            "labels": (list(labels.format())
+                                       if labels else []),
+                            "cluster": "alpha",
+                            "seq": _attempt}))
+                pub.heartbeat()
+                break
+            except Exception:  # noqa: BLE001 — still-armed faults
+                faulted += 1
+        for e in remote_ipc.dump():
+            nid = local_ipc.lookup(e["cidr"].split("/")[0])
+            if nid is None:
+                raise InvariantViolation(
+                    index, "clustermesh-convergence",
+                    f"published prefix {e['cidr']} missing from the "
+                    f"local ipcache after repair")
+            labels = self.alloc.lookup(nid)
+            tag = (labels.get(CLUSTER_LABEL_KEY, SOURCE_K8S)
+                   if labels else None)
+            if tag is None or tag.value != "alpha":
+                raise InvariantViolation(
+                    index, "clustermesh-convergence",
+                    f"prefix {e['cidr']} resolved without the remote "
+                    f"cluster tag")
+        return {"announced": n, "entries": rc.num_entries(),
+                "faulted": faulted}
+
     def drain_restore(self, index: int) -> Dict:
         """Warm-restart cycle: snapshot the serving state, restore it
         into a FRESH loader (the restarted process), and re-point the
@@ -968,6 +1142,9 @@ class DSTWorld:
                 "restart": restart}
 
     def close(self) -> None:
+        if self._mesh is not None:
+            self._mesh[4].disconnect()
+            self._mesh = None
         self.cluster_alloc.close()
         self.loader.close()
 
@@ -1011,9 +1188,15 @@ def generate(seed: int, max_events: int = 12) -> List[List]:
             # ISSUE 12: sharded-lane checks ride the schedule space —
             # a fault armed two events earlier now also hits the mesh
             events.append(["multichip"])
-        elif roll < 0.80:
+        elif roll < 0.77:
+            # ISSUE 15: a cross-cluster sync round — remote-identity
+            # announcements through the clustermesh watch, with the
+            # session/heartbeat fault points in the armable set and a
+            # convergence invariant after the bounded repair loop
+            events.append(["clustermesh", rng.randint(2, 6)])
+        elif roll < 0.83:
             events.append(["advance", rng.choice(ADVANCES)])
-        elif roll < 0.89:
+        elif roll < 0.91:
             events.append(["storm", rng.randint(4, 24)])
         else:
             events.append(["drain-restore"])
@@ -1068,6 +1251,8 @@ def run_schedule(seed: int, events: Optional[List[List]] = None,
                             out = world.serve(int(ev[1]), i)
                         elif kind == "multichip":
                             out = world.multichip(i)
+                        elif kind == "clustermesh":
+                            out = world.clustermesh_sync(int(ev[1]), i)
                         elif kind == "advance":
                             clock.advance(float(ev[1]))
                             out = {"now": round(clock.now(), 6)}

@@ -99,7 +99,7 @@ def build_entries(trace_id: str, surface: str, flows: Sequence,
     resolves to live rules, or an honest L3/L4-only attribution via
     ``match_spec``)."""
     from cilium_tpu.core.flow import Verdict
-    from cilium_tpu.engine.attribution import pack_word
+    from cilium_tpu.engine.attribution import flow_family, pack_word
     from cilium_tpu.ingest.hubble import flow_to_dict
 
     verdicts = np.asarray(verdicts)
@@ -116,7 +116,10 @@ def build_entries(trace_id: str, surface: str, flows: Sequence,
             else int(generation)
         hit = bool(memo_hit[i]) if memo_hit is not None \
             and i < len(memo_hit) else False
-        res = amap.resolve(int(f.l7), code) if amap is not None \
+        # frontend records carry l7 == GENERIC on the flow object
+        # but verdict on their family lane (engine normalization)
+        fam = flow_family(f)
+        res = amap.resolve(fam, code) if amap is not None \
             else None
         spec = int(specs[i]) if i < len(specs) else -1
         explained = res is not None or (code < 0 and spec >= 0) \
@@ -125,7 +128,7 @@ def build_entries(trace_id: str, surface: str, flows: Sequence,
                     labels={"result": "explained" if explained
                             else "unexplained"})
         prov: Dict[str, object] = {
-            "word": pack_word(code, int(f.l7), hit, gen, pack_cycle,
+            "word": pack_word(code, fam, hit, gen, pack_cycle,
                               kernel),
             "generation": gen,
             "memo_hit": hit,

@@ -75,9 +75,22 @@ def identity_fingerprints(per_identity: Dict[int, "MapState"]
 
 
 #: rule-family accessors of one L7Rules object — the split behind the
-#: family-granular (bank-reference) invalidation delta
+#: family-granular (bank-reference) invalidation delta. The generic
+#: accessor splits further at runtime: ``l7proto`` rules whose proto
+#: has a registered engine frontend fingerprint under that frontend's
+#: family name (cassandra/memcache/r2d2), so a cassandra-rule change
+#: refills only cassandra memo rows.
 _L7_FAMILIES = (("http", "http"), ("kafka", "kafka"), ("dns", "dns"),
                 ("generic", "l7"))
+
+
+def _l7_family_names() -> tuple:
+    """Every family name the split can produce: the static four plus
+    the registered frontend families (policy/compiler/frontends)."""
+    from cilium_tpu.policy.compiler import frontends as _fe
+
+    return tuple(name for name, _ in _L7_FAMILIES) + tuple(
+        sorted(set(_fe.family_names().values())))
 
 
 def _family_port_of(key) -> int:
@@ -105,21 +118,37 @@ def _identity_family_tuples(ms) -> Dict[str, object]:
     through its own entry's ruleset). A path-bank swap on port 8080
     moves only the ``http``/8080 tuple, so the identity's DNS/kafka
     rows — and its port-80 HTTP rows — keep serving."""
+    from cilium_tpu.policy.compiler import frontends as _fe
+
     struct = []
     fam: Dict[str, Dict[int, list]] = {name: {}
-                                       for name, _ in _L7_FAMILIES}
+                                       for name in _l7_family_names()}
     for k, e in sorted(ms.entries.items(),
                        key=lambda kv: repr(kv[0])):
         key = (k.identity, k.dport, k.proto, k.direction, k.port_plen)
         struct.append((key, e.is_deny, e.l7_wildcard, e.auth_required,
                        bool(e.l7_rules)))
         port = _family_port_of(k)
-        for name, attr in _L7_FAMILIES:
+        for name, attr in _L7_FAMILIES[:3]:
             rules = tuple(sorted(
                 repr(r) for lr in e.l7_rules
                 for r in getattr(lr, attr)))
             if rules:
                 fam[name].setdefault(port, []).append((key, rules))
+        # generic/frontend split: each l7proto rule set fingerprints
+        # under its FRONTEND family when one is registered, so a
+        # cassandra-only change never refills generic (or memcache)
+        # rows — the frontend half of the bank-reference granularity
+        by_fam: Dict[str, list] = {}
+        for lr in e.l7_rules:
+            if not lr.l7proto:
+                continue
+            name = _fe.family_name_of(lr.l7proto) or "generic"
+            by_fam.setdefault(name, []).append(
+                (lr.l7proto, tuple(sorted(repr(r) for r in lr.l7))))
+        for name, rules in by_fam.items():
+            fam[name].setdefault(port, []).append(
+                (key, tuple(sorted(rules))))
     out: Dict[str, object] = {
         "struct": (tuple(struct), ms.ingress_enforced,
                    ms.egress_enforced, getattr(ms, "audit", False))}
@@ -459,7 +488,7 @@ class Loader:
         from cilium_tpu.engine.memo import PolicyDelta
         from cilium_tpu.engine.verdict import CompiledPolicy, VerdictEngine
 
-        # "policy-v9": v2 gained the ms_auth array; v3 port-range prefix
+        # "policy-v11": v2 gained the ms_auth array; v3 port-range prefix
         # keys (ms_plens + the w2 repack); v4 the audit_mode scalar; v5
         # the per-endpoint audit bit (enf_flags grew a column); v6 the
         # distillery template dedup (ms_tmpl_ids; key_w0 holds template
@@ -469,8 +498,11 @@ class Loader:
         # artifact); v9 kafka/generic predicate groups joined the plan
         # (rp_k_*/rp_gen_*); v10 the attribution lane's rule→group
         # maps (rp_rule_group/rp_k_rule_group/rp_gen_rule_group +
-        # group-member meta) — each bump invalidates older cached
-        # artifacts.
+        # group-member meta); v11 the protocol-frontend compiler plane
+        # (fe rule tables + l7g automaton stack + rp_fe_* groups +
+        # frontend enum predicates in the gen pair interns, l7-type
+        # lanes normalized to frontend families) — each bump
+        # invalidates older cached artifacts.
         # The key is now derived from the per-identity fingerprints +
         # a globals fingerprint, so the SAME inputs also seed the
         # bank-scoped invalidation delta. Both fingerprint views come
@@ -490,7 +522,7 @@ class Loader:
             _referenced_secret_values(per_identity, self.secrets),
         )
         key = ruleset_fingerprint(
-            "policy-v10", globals_fp, tuple(sorted(fps.items())))
+            "policy-v11", globals_fp, tuple(sorted(fps.items())))
         with self._lock:
             serving_engine = self._engine
         if (key == self._last_artifact_key and not self._degraded
@@ -533,6 +565,14 @@ class Loader:
                 engine = VerdictEngine(policy, device=self.device,
                                        cfg=self.config.engine)
         self._record_kernel_plan(policy, engine)
+        # serving frontend-rule counts per proto (the ISSUE-15 family
+        # surface; zeroed protos simply stop being reported)
+        fe_counts: Dict[str, int] = {}
+        for proto, _pairs in getattr(policy, "fe_rules", ()) or ():
+            fe_counts[proto] = fe_counts.get(proto, 0) + 1
+        for proto, n in fe_counts.items():
+            METRICS.set_gauge("cilium_tpu_frontend_rules", n,
+                              labels={"proto": proto})
         new_plan = dict(getattr(policy, "bank_plan", {}) or {})
         fam_fps = fam_fps_all
         delta = self._delta_for(fps, globals_fp, new_plan,

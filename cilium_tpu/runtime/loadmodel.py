@@ -84,9 +84,13 @@ class Violation(AssertionError):
 
 
 def _build_world(seed: int, n_rules: int, pool_chunks: int,
-                 chunk_flows: int):
+                 chunk_flows: int, protocol_mix: float = 0.0):
     """A real compiled serving slice: synth policy → TPU loader →
-    chunk pool with engine ground truth."""
+    chunk pool with engine ground truth. ``protocol_mix`` > 0 blends
+    protocol-frontend traffic (cassandra/memcache/r2d2, ISSUE 15)
+    into the pool at that chunk fraction: ONE loader serves a merged
+    policy (http + frontend rule sets), so mixed-family packs ride
+    one fused dispatch exactly like production."""
     from cilium_tpu.core.config import Config
     from cilium_tpu.ingest import synth
     from cilium_tpu.ingest.binary import (
@@ -95,9 +99,30 @@ def _build_world(seed: int, n_rules: int, pool_chunks: int,
     )
     from cilium_tpu.runtime.loader import Loader
 
-    scenario = synth.scenario_by_name("http", n_rules,
-                                      max(1024, chunk_flows * 8))
-    per_identity, scenario = synth.realize_scenario(scenario)
+    n_flows = max(1024, chunk_flows * 8)
+    sc_http = synth.scenario_by_name("http", n_rules, n_flows)
+    proto_flows: List = []
+    if protocol_mix > 0:
+        sc_proto = synth.scenario_by_name(
+            "protocols", max(12, n_rules // 2), n_flows)
+        merged = synth.SynthScenario(
+            name="servemix",
+            rules=sc_http.rules + sc_proto.rules,
+            endpoints={**sc_http.endpoints, **sc_proto.endpoints},
+            flows=[])
+        per_identity, merged = synth.realize_scenario(merged)
+        ids = merged.ids
+        for f in sc_http.flows:
+            f.src_identity, f.dst_identity = (ids["client"],
+                                              ids["server"])
+        for f in sc_proto.flows:
+            f.src_identity, f.dst_identity = (ids["client"],
+                                              ids["polysvc"])
+        proto_flows = list(sc_proto.flows)
+        scenario_flows = list(sc_http.flows)
+    else:
+        per_identity, sc_http = synth.realize_scenario(sc_http)
+        scenario_flows = list(sc_http.flows)
     cfg = Config()
     cfg.enable_tpu_offload = True
     loader = Loader(cfg)
@@ -105,9 +130,10 @@ def _build_world(seed: int, n_rules: int, pool_chunks: int,
     engine = loader.engine
     rng = random.Random(seed ^ 0x5EED)
     pool: List[_Chunk] = []
-    flows_all = list(scenario.flows)
     for _ in range(pool_chunks):
-        flows = [flows_all[rng.randrange(len(flows_all))]
+        src = (proto_flows if proto_flows
+               and rng.random() < protocol_mix else scenario_flows)
+        flows = [src[rng.randrange(len(src))]
                  for _ in range(chunk_flows)]
         sections = capture_from_bytes(capture_to_bytes(flows))
         truth = [int(v) for v in
@@ -130,7 +156,8 @@ class LoadModel:
                  storm_size: int = 2000,
                  pareto_xm_s: float = 30.0, pareto_alpha: float = 1.3,
                  fault_rules: Optional[Sequence] = None,
-                 sample_every: int = 64, mode: str = "thread"):
+                 sample_every: int = 64, mode: str = "thread",
+                 protocol_mix: float = 0.0):
         self.seed = seed
         self.streams = int(streams)
         self.virtual_s = float(virtual_s)
@@ -150,6 +177,10 @@ class LoadModel:
         self.fault_rules = list(fault_rules or ())
         self.sample_every = max(1, int(sample_every))
         self.mode = mode
+        #: fraction of pool chunks carrying protocol-frontend traffic
+        #: (cassandra/memcache/r2d2) instead of http — the ISSUE-15
+        #: protocol-mix knob; the lane default is 0.2
+        self.protocol_mix = float(protocol_mix)
         self.rng = random.Random(seed)
         self.violations: List[Dict] = []
         self.latencies: List[float] = []
@@ -264,6 +295,17 @@ class LoadModel:
         l7m = np.asarray(prov.l7_match)
         gens = np.asarray(prov.gens)
         l7t = np.asarray(chunk.sections[0]["l7_type"])
+        gen = chunk.sections[4]
+        if gen is not None:
+            # protocol-frontend records carry the canonical GENERIC
+            # code in the capture; the engine verdicts them on their
+            # FAMILY lane — decode the attribution code in that space
+            # (the same normalization every featurize path applies)
+            from cilium_tpu.engine.verdict import _gen_l7g_cols
+
+            fam, _uniq, _row = _gen_l7g_cols(
+                gen, chunk.sections[2], chunk.sections[3])
+            l7t = np.where(fam > 0, fam, l7t)
         gen_now = policy_generation()
         for r in range(min(len(l7m), len(l7t))):
             code = int(l7m[r])
@@ -283,7 +325,8 @@ class LoadModel:
     # -- the run ----------------------------------------------------------
     def run(self) -> Dict:
         loader, pool = _build_world(self.seed, self.n_rules,
-                                    self.pool_chunks, self.chunk_flows)
+                                    self.pool_chunks, self.chunk_flows,
+                                    protocol_mix=self.protocol_mix)
         autojump = self.mode == "thread"
         clock = simclock.VirtualClock(
             autojump=0.001 if autojump else None, poll=0.001)
@@ -570,6 +613,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--max-burn", type=float, default=1.0,
                     help="whole-run SLO burn-rate ceiling "
                          "(1.0 = exactly the declared budget)")
+    ap.add_argument("--protocol-mix", type=float, default=0.2,
+                    help="fraction of traffic chunks carrying "
+                         "protocol-frontend records (ISSUE 15)")
     ap.add_argument("--target-concurrency", type=int, default=0,
                     help="gate floor (default: 95%% of --streams)")
     ap.add_argument("--out", default="BENCH_SERVE_r07.jsonl")
@@ -589,7 +635,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                       pack_interval_ms=args.pack_interval_ms,
                       lease_ttl_s=args.lease_ttl_s,
                       storms=args.storms, storm_size=args.storm_size,
-                      fault_rules=rules, mode=args.mode)
+                      fault_rules=rules, mode=args.mode,
+                      protocol_mix=args.protocol_mix)
     result = model.run()
     wall_s = simclock.perf() - t0
     result["wall_s"] = round(wall_s, 3)

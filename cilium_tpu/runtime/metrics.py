@@ -577,6 +577,9 @@ METRICS.describe("cilium_tpu_endpoints",
                  "endpoints currently managed")
 METRICS.describe("cilium_tpu_endpoints_restored_total",
                  "endpoints restored from the state dir at startup")
+METRICS.describe("cilium_tpu_frontend_rules",
+                 "protocol-frontend rules in the serving compiled "
+                 "policy, by proto (policy/compiler/frontends)")
 METRICS.describe("cilium_tpu_fqdn_handler_errors_total",
                  "DNS proxy handler threads that raised")
 METRICS.describe("cilium_tpu_fqdn_malformed_queries_total",

@@ -1,0 +1,52 @@
+"""bench_protocols driver smoke (ISSUE 15): the real throughput and
+cross-cluster lanes at check-sized scale — every correctness gate
+armed (oracle agreement per lane, zero stale/ERROR under the remote-
+identity churn), the p99 gate off (the committed single-cluster
+baseline is not comparable at smoke scale)."""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, ".")  # repo-root bench drivers
+
+import bench_protocols  # noqa: E402
+
+
+def test_throughput_lane_smoke(tmp_path, capsys):
+    line = bench_protocols.run_throughput(
+        "protocols", 24, 4096, str(tmp_path / "cache"),
+        lambda m: None)
+    assert line["metric"] == "proto_protocols_verdicts_per_s"
+    assert line["value"] > 0
+    assert line["memo_hit_ratio"] > 0.9
+    assert 0.0 < line["allow_fraction"] < 1.0
+
+
+def test_crosscluster_lane_smoke():
+    line = bench_protocols.run_crosscluster(
+        8, lambda m: None, gate_p99=False)
+    assert line["stale"] == 0 and line["errors"] == 0
+    assert line["value"] > 0
+    assert line["updates"] == 8
+
+
+def test_loadmodel_protocol_mix_pool():
+    """The serve-soak protocol-mix knob: a mixed pool carries
+    frontend chunks whose ground truth the merged policy's engine
+    computed — the LoadModel invariants then hold them bit-equal
+    through the ring."""
+    from cilium_tpu.runtime.loadmodel import _build_world
+
+    loader, pool = _build_world(seed=3, n_rules=24, pool_chunks=12,
+                                chunk_flows=6, protocol_mix=0.5)
+    try:
+        protos = set()
+        for chunk in pool:
+            rec = chunk.sections[0]
+            protos.update(int(x) for x in rec["dport"])
+        # both http (80) and frontend ports are in the pool
+        assert 80 in protos
+        assert protos & {9042, 11211, 4040}, protos
+    finally:
+        loader.close()

@@ -350,10 +350,20 @@ def test_generic_capture_hypothesis_differential(tmp_path):
     from cilium_tpu.policy.repository import Repository
     from cilium_tpu.policy.selectorcache import SelectorCache
 
+    from cilium_tpu.policy.compiler import frontends
+
     rng = random.Random(77)
     keys = ["cmd", "file", "op", "mode", "extra1", "extra2"]
     vals = ["GET", "PUT", "x.txt", "y.txt", "on", ""]
-    protos = ["r2d2", "custom", "memq"]
+    # proxy-only protos (no engine frontend): the sweep exercises the
+    # generic PAIR path, whose key/value universe is open — frontend
+    # protos like r2d2 now validate rule keys at compile and route to
+    # the l7g automaton instead (tests/test_frontends.py covers them).
+    # Registration is required since ISSUE 15: an unknown l7proto
+    # fails the compile loudly.
+    protos = ["test.lineparser", "custom", "memq"]
+    for p in ("custom", "memq"):
+        frontends.register_proxy_parser(p)
     seen_verdicts: set = set()
 
     for trial in range(6):

@@ -1619,3 +1619,120 @@ def test_unbounded_registry_tree_clean():
 
     findings, _ = _ctrun(REPO_ROOT, rules=["unbounded-registry"])
     assert findings == [], [str(f) for f in findings]
+
+
+# -------------------------------------------------- frontend-registry --
+
+from cilium_tpu.analysis import frontendreg as fereg_rule  # noqa: E402
+
+FEREG_FLOW = (
+    "import enum\n"
+    "class L7Type(enum.IntEnum):\n"
+    "    NONE = 0\n"
+    "    HTTP = 1\n"
+    "    KAFKA = 2\n"
+    "    DNS = 3\n"
+    "    GENERIC = 4\n"
+    "    CASS = 5\n")
+
+FEREG_MEMO = (
+    'FAMILY_OF_L7TYPE = {0: "l4", 1: "http", 2: "kafka", 3: "dns",\n'
+    '                    4: "generic", 5: "cass"}\n')
+
+FEREG_ATTR = (
+    "from cilium_tpu.core.flow import L7Type\n"
+    'FAMILY_NAMES = {int(L7Type.HTTP): "http",\n'
+    '                int(L7Type.CASS): "cass"}\n')
+
+FEREG_SPEC = (
+    "from cilium_tpu.policy.compiler.frontends import (\n"
+    "    FrontendSpec, ProtocolFrontend, register_frontend)\n"
+    "class CassFe(ProtocolFrontend):\n"
+    "    spec = FrontendSpec(name='cass', family=5,\n"
+    "                        family_name='cass', fields=('q',))\n"
+    "register_frontend(CassFe())\n")
+
+FEREG_PARSERS = (
+    "from cilium_tpu.proxylib.parser import register_parser\n"
+    "class P: pass\n"
+    "register_parser('cass', P)\n")
+
+
+def _fereg_corpus(**over):
+    base = {
+        "cilium_tpu/core/flow.py": FEREG_FLOW,
+        "cilium_tpu/engine/memo.py": FEREG_MEMO,
+        "cilium_tpu/engine/attribution.py": FEREG_ATTR,
+        "cilium_tpu/policy/compiler/frontends/cass.py": FEREG_SPEC,
+        "cilium_tpu/proxylib/cass.py": FEREG_PARSERS,
+    }
+    base.update(over)
+    return base
+
+
+def test_frontend_registry_good_corpus():
+    assert _check(_fereg_corpus(),
+                  fereg_rule.check_frontend_registry) == []
+
+
+def test_frontend_registry_parser_without_frontend():
+    bad = FEREG_PARSERS + "register_parser('loose', P)\n"
+    findings = _check(_fereg_corpus(**{
+        "cilium_tpu/proxylib/cass.py": bad}),
+        fereg_rule.check_frontend_registry)
+    assert len(findings) == 1
+    assert "loose" in findings[0].message
+    assert "proxy-only" in findings[0].message
+    # ...and the justified pragma allowlists it
+    ok = FEREG_PARSERS + ("register_parser('loose', P)"
+                          "  # ctlint: disable=frontend-registry"
+                          "  # proxy-only fixture\n")
+    assert _check(_fereg_corpus(**{
+        "cilium_tpu/proxylib/cass.py": ok}),
+        fereg_rule.check_frontend_registry) == []
+
+
+def test_frontend_registry_family_missing_from_memo_enum():
+    memo = FEREG_MEMO.replace(', 5: "cass"', "")
+    findings = _check(_fereg_corpus(**{
+        "cilium_tpu/engine/memo.py": memo}),
+        fereg_rule.check_frontend_registry)
+    assert any("FAMILY_OF_L7TYPE" in f.message for f in findings)
+
+
+def test_frontend_registry_family_missing_from_attribution():
+    attr = ('from cilium_tpu.core.flow import L7Type\n'
+            'FAMILY_NAMES = {int(L7Type.HTTP): "http"}\n')
+    findings = _check(_fereg_corpus(**{
+        "cilium_tpu/engine/attribution.py": attr}),
+        fereg_rule.check_frontend_registry)
+    assert any("FAMILY_NAMES" in f.message for f in findings)
+
+
+def test_frontend_registry_family_missing_from_l7type():
+    flow = FEREG_FLOW.replace("    CASS = 5\n", "")
+    findings = _check(_fereg_corpus(**{
+        "cilium_tpu/core/flow.py": flow}),
+        fereg_rule.check_frontend_registry)
+    assert any("L7Type" in f.message for f in findings)
+
+
+def test_frontend_registry_frontend_without_parser():
+    findings = _check(_fereg_corpus(**{
+        "cilium_tpu/proxylib/cass.py": "x = 1\n"}),
+        fereg_rule.check_frontend_registry)
+    assert any("differential CPU oracle" in f.message
+               for f in findings)
+
+
+def test_frontend_registry_tree_clean():
+    index, _ = ProjectIndex.from_tree(REPO_ROOT,
+                                      targets=("cilium_tpu",))
+    findings = [f for f in
+                fereg_rule.check_frontend_registry(index)
+                if not index.by_path[f.path].disabled(f.line, f.rule)]
+    assert findings == [], [f.format() for f in findings]
+    # non-vacuity: the shipped tree declares >= 3 frontends and >= 5
+    # parser registrations the rule actually walked
+    assert len(fereg_rule._frontend_specs(index)) >= 3
+    assert len(fereg_rule._parser_registrations(index)) >= 5

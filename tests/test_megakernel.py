@@ -505,7 +505,10 @@ def test_kafka_rides_the_factored_plan_with_predicate_dedup():
 def test_generic_rides_the_factored_plan_with_predicate_dedup():
     """Generic (l7proto) rules dedup to (proto, pair-set) groups —
     pair ORDER inside a rule is predicate-irrelevant, so permuted
-    copies collapse; resolve stays bit-equal."""
+    copies collapse; resolve stays bit-equal. Uses a PROXY-ONLY
+    proto (test.lineparser): frontend protos like r2d2 route to the
+    l7g automaton path since ISSUE 15 and are covered by
+    tests/test_frontends.py."""
     from cilium_tpu.core.flow import GenericL7Info
     from cilium_tpu.policy.api.l7 import PortRuleL7
 
@@ -522,7 +525,8 @@ def test_generic_rides_the_factored_plan_with_predicate_dedup():
                 from_endpoints=(sel(app="client"),),
                 to_ports=(PortRule(
                     ports=(PortProtocol(6379, Protocol.TCP),),
-                    rules=L7Rules(l7proto="r2d2", l7=gen)),)),),
+                    rules=L7Rules(l7proto="test.lineparser",
+                                  l7=gen)),)),),
             labels=(f"gen={i}",)))
     endpoints = {f"db{i}": {"app": f"db{i}"} for i in range(3)}
     endpoints["client"] = {"app": "client"}
@@ -548,7 +552,7 @@ def test_generic_rides_the_factored_plan_with_predicate_dedup():
                 protocol=Protocol.TCP,
                 direction=TrafficDirection.INGRESS,
                 l7=L7Type.GENERIC,
-                generic=GenericL7Info(proto="r2d2",
+                generic=GenericL7Info(proto="test.lineparser",
                                       fields=dict(fields))))
     _assert_fused_equals_legacy(engine, flows, cfg)
     out = engine.verdict_flows(flows)
