@@ -5,6 +5,7 @@ PY ?= python
 
 .PHONY: test shim lint determinism dryrun chaos obs soak churn \
         churn-fleet churn-fleet-smoke dst dst-validate serve-soak \
+        serve-fleet serve-fleet-smoke \
         bench bench-all bench-e2e bench-service bench-regen bench-sp \
         bench-stage bench-stream bench-kernel bench-multichip \
         bench-protocols bench-watch perf-report check
@@ -77,6 +78,30 @@ soak:            ## synthetic-overload admission/shed lane
 serve-soak:      ## 100k-virtual-stream continuous-batching soak
 	JAX_PLATFORMS=cpu $(PY) -m cilium_tpu.runtime.loadmodel \
 	    --streams 100000 --out BENCH_SERVE_r07.jsonl
+
+# serve-fleet: the ISSUE-16 acceptance lane — the DST fleet model
+# (runtime/fleetserve.py) drives >=1M concurrent virtual streams
+# across >=4 simulated hosts (each a real ServeLoop + ring + session
+# over bank artifacts shared via the artifact store) behind the
+# stream-affinity router, with mid-storm host KILL / partition /
+# drain-restart / warm rejoin and seeded fleet.heartbeat +
+# fleet.handoff faults. Gates: 0 invariant violations (fleet-exact
+# lease books, lease conservation, sampled correctness + explanation
+# honesty at the CITED generation), aggregate p99 <= 2x the committed
+# single-host serve-soak baseline, shed rate <= 2%, zero survivor
+# recompiles + a zero-compile warm restore on every rejoin, and zero
+# unrecovered streams across the failovers.
+serve-fleet:     ## 1M-stream serving fleet: failover + shedding soak
+	JAX_PLATFORMS=cpu $(PY) -m cilium_tpu.runtime.fleetserve \
+	    --streams 1050000 --hosts 4 --out BENCH_FLEET_SERVE_r08.jsonl
+
+# the smoke face of the same driver — small enough for `make check`;
+# the p99 gate stays off (tiny runs are all fixed overhead) but every
+# failover/conservation/honesty gate is armed
+serve-fleet-smoke: ## serving-fleet driver at check-sized smoke scale
+	JAX_PLATFORMS=cpu $(PY) -m cilium_tpu.runtime.fleetserve \
+	    --streams 2000 --hosts 4 --virtual-s 60 --storm-size 200 \
+	    --no-p99-gate --out /tmp/BENCH_FLEET_SERVE_smoke.jsonl
 
 # churn: the ISSUE-8 acceptance soak — sustained CNP add/delete +
 # FQDN pattern churn through a live replay session across ≥50
@@ -219,4 +244,4 @@ bench-watch:     ## probe until the tunnel answers, then capture the sweep
 perf-report:     ## bench trajectory + regression gate
 	$(PY) -m cilium_tpu.perf_report --root . --out PERF_TRAJECTORY.json
 
-check: shim lint test determinism dryrun obs churn-fleet-smoke bench-multichip perf-report   ## the full CI gate
+check: shim lint test determinism dryrun obs churn-fleet-smoke serve-fleet-smoke bench-multichip perf-report   ## the full CI gate

@@ -240,6 +240,31 @@ class DSTConfig:
 
 
 @dataclasses.dataclass
+class FleetConfig:
+    """Horizontal serving fleet (runtime/fleetserve.py): N agent
+    replicas behind a stream-affinity rendezvous router with per-host
+    heartbeats. A host that misses heartbeats past ``suspicion_ttl_s``
+    is declared dead and FAILS CLOSED (stops serving rather than
+    answer from stale policy); the router re-grants its leases on
+    survivors and clients replay in-flight chunks through the resume
+    protocol. Every knob moves placement/failover timing only —
+    verdicts stay bit-equal to a single host."""
+
+    #: simulated/managed serving replicas the fleet lane runs
+    replicas: int = 4
+    #: seconds between per-host heartbeats on the installed clock
+    heartbeat_interval_s: float = 1.0
+    #: missed-heartbeat budget: a host silent this long is suspected,
+    #: declared dead, and handed off (it fail-closes itself on the
+    #: same budget, so a partitioned host stops serving first)
+    suspicion_ttl_s: float = 5.0
+    #: occupancy fraction kept free per host: past ``1 - headroom``
+    #: the router spills NEW streams to emptier hosts, and a host
+    #: with no spill target sheds ``host-overloaded``
+    spill_headroom: float = 0.1
+
+
+@dataclasses.dataclass
 class ServeConfig:
     """Continuously-batched serving loop (runtime/serveloop.py +
     engine/ring.py): streams are admitted into verdict-ring slot
@@ -362,6 +387,7 @@ class Config:
     provenance: ProvenanceConfig = dataclasses.field(
         default_factory=ProvenanceConfig)
     dst: DSTConfig = dataclasses.field(default_factory=DSTConfig)
+    fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     log_level: str = "info"
     #: ``--k8s-api-socket``: when set, the agent consumes CNP/CCNP
     #: from the fake-apiserver (cilium_tpu.k8s) through list+watch
@@ -477,6 +503,17 @@ class Config:
             cfg.dst.seed = int(env["CILIUM_TPU_DST_SEED"])
         if "CILIUM_TPU_DST_MUTATION" in env:
             cfg.dst.mutation = env["CILIUM_TPU_DST_MUTATION"]
+        if "CILIUM_TPU_FLEET_REPLICAS" in env:
+            cfg.fleet.replicas = int(env["CILIUM_TPU_FLEET_REPLICAS"])
+        if "CILIUM_TPU_FLEET_HEARTBEAT_INTERVAL_S" in env:
+            cfg.fleet.heartbeat_interval_s = float(
+                env["CILIUM_TPU_FLEET_HEARTBEAT_INTERVAL_S"])
+        if "CILIUM_TPU_FLEET_SUSPICION_TTL_S" in env:
+            cfg.fleet.suspicion_ttl_s = float(
+                env["CILIUM_TPU_FLEET_SUSPICION_TTL_S"])
+        if "CILIUM_TPU_FLEET_SPILL_HEADROOM" in env:
+            cfg.fleet.spill_headroom = float(
+                env["CILIUM_TPU_FLEET_SPILL_HEADROOM"])
         return cfg
 
     @classmethod
@@ -505,7 +542,8 @@ class Config:
                                 ("serve", cfg.serve),
                                 ("slo", cfg.slo),
                                 ("provenance", cfg.provenance),
-                                ("dst", cfg.dst)):
+                                ("dst", cfg.dst),
+                                ("fleet", cfg.fleet)):
             for k, v in data.get(section, {}).items():
                 if hasattr(target, k):
                     setattr(target, k, tuple(v) if isinstance(v, list) else v)

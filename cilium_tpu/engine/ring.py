@@ -331,3 +331,34 @@ class VerdictRing:
             return {}
         return {"hits": m.hits, "misses": m.misses,
                 "invalidations": m.invalidations}
+
+    # -- fleet handoff (runtime/fleetserve.py) ----------------------------
+    def resident_keys(self) -> frozenset:
+        """Content hashes of every session-resident unique row — the
+        cross-host handoff manifest. Row hashes are content-addressed
+        (``engine/memo.hash_rows`` over the featurized row bytes), so
+        two hosts that interned the same 15-tuple/string row hold the
+        same key even though their session row IDS differ. A lease
+        migration ships this set (8 bytes/row) instead of featurized
+        row blocks; the receiving host intersects with its own
+        residency to learn which replayed rows need only a 4-byte id —
+        the Libra selective-copy discipline applied at the HOST
+        boundary instead of the H2D one."""
+        with self._lock:
+            with self._session_lock:
+                return frozenset(self.session.row_ids.keys())
+
+    def handoff_overlap(self, keys) -> Tuple[int, int]:
+        """How much of a peer's residency manifest is already resident
+        HERE: ``(rows, bytes_avoided)``. ``bytes_avoided`` is the
+        featurized bytes a replay of those rows will not re-ship
+        (row block minus the 4-byte id), mirroring the per-chunk
+        ``bytes_saved`` accounting so the fleet lane's handoff numbers
+        and the single-host memo-bypass numbers add up in the same
+        currency."""
+        with self._lock:
+            with self._session_lock:
+                mine = self.session.row_ids
+                rows = sum(1 for k in keys if k in mine)
+                row_bytes = self.session.row_width * 4
+        return rows, rows * max(0, row_bytes - 4)

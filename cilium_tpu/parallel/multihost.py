@@ -70,3 +70,31 @@ def process_span() -> Tuple[int, int]:
     """(process_index, process_count) — for sharding host-side work such
     as flow-capture file assignment across agent processes."""
     return jax.process_index(), jax.process_count()
+
+
+def host_id(index: Optional[int] = None) -> str:
+    """Stable host identity — the fleet seam (ISSUE 16).
+
+    Everything that attributes work to a HOST (the serving-fleet
+    router, provenance stamps on bench lines, the explain plane's
+    (host, pack-cycle) scope) names hosts through this one function so
+    simulated in-process replicas and real multi-process runs agree on
+    the format:
+
+    * ``index`` given → ``host-<index>`` (the fleetserve simulated
+      replicas, where many "hosts" share one process);
+    * ``CILIUM_TPU_HOST_ID`` set → that value verbatim (operators
+      pinning an external identity, and the bench harness making fleet
+      lines attributable);
+    * otherwise ``host-<jax.process_index()>`` — one identity per
+      process in a real multi-host runtime, ``host-0`` single-process.
+    """
+    if index is not None:
+        return f"host-{int(index)}"
+    env = os.environ.get("CILIUM_TPU_HOST_ID", "")
+    if env:
+        return env
+    try:
+        return f"host-{jax.process_index()}"
+    except RuntimeError:  # backend not initialized yet
+        return "host-0"

@@ -106,7 +106,11 @@ _EXTRA_KEYS = ("tunnel_rtt_ms", "tunnel_rtt_max_ms", "stage_ms",
                "memo_hits", "memo_misses", "dedup_ratio",
                "stage_warm_ms", "stage_warm_phases_ms",
                "capture_write_ms", "capture_open_ms",
-               "provenance_overhead_pct", "provenance_budget_pct")
+               "provenance_overhead_pct", "provenance_budget_pct",
+               # serve-fleet lane (ISSUE 16): the failover trajectory
+               "hosts", "handoffs", "host_deaths", "rejoins",
+               "spilled_streams", "shed_rate", "p99_ratio",
+               "rejoin_warm_restores")
 
 
 def _entry(source: str, kind: str, obj: Dict,

@@ -72,6 +72,17 @@ SHED_FAULT = "fault"
 #: the verdict ring has no free slot for a new stream lease
 #: (runtime/serveloop.py) — explicit, counted, retryable
 SHED_RING_FULL = "ring-full"
+#: fleet serving (runtime/fleetserve.py): every live host is past its
+#: spill headroom — the router found no headroom anywhere, so the
+#: saturated owner sheds explicitly instead of queueing
+SHED_HOST_OVERLOADED = "host-overloaded"
+#: the placed host is draining toward a restart/rejoin — new streams
+#: belong elsewhere (retryable; the router re-places on retry)
+SHED_HOST_DRAINING = "host-draining"
+#: the host missed enough heartbeats to suspect a partition and FAILED
+#: CLOSED: it refuses to serve possibly-stale policy rather than
+#: answer from the wrong side of a split
+SHED_PARTITIONED = "partitioned"
 
 #: fires at every admission decision; an injected fault forces a shed
 #: (reason "fault") — the chaos suite's handle on the gate

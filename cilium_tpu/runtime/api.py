@@ -253,6 +253,13 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 if not tid:
                     return self._send(400, {"error": "explain needs "
                                             "?trace_id="})
+                # an agent fronting a serving fleet router-forwards
+                # the query to whichever replica recorded the trace
+                # (runtime/fleetserve.py — the store travels with the
+                # host, so the answer survives handoffs and rejoins)
+                fleet = getattr(agent, "fleet", None)
+                if fleet is not None:
+                    return self._send(200, fleet.explain(tid))
                 return self._send(200,
                                   resolve_explain(agent.loader, tid))
             if path == "/v1/trace":

@@ -97,6 +97,13 @@ FAULT_POINTS = (
     # lease keeps state alive until the next beat)
     "clustermesh.session",
     "clustermesh.heartbeat",
+    # ISSUE 16 — the horizontal serving fleet's fault surface: a lost
+    # replica heartbeat (suspicion runs on the virtual clock; aging
+    # past the TTL is a fail-closed death + handoff) and a handoff
+    # interrupted mid-re-grant (the un-re-granted remainder rides the
+    # client resume protocol instead of double-granting)
+    "fleet.heartbeat",
+    "fleet.handoff",
 )
 
 #: breaker/quarantine timings the schedules steer around; small so
@@ -257,6 +264,12 @@ class DSTWorld:
         #: drain-restore (a restarted process builds a fresh one)
         self._serve = None
         self._serve_streams = 0
+        #: lazily-built horizontal serving fleet (ISSUE 16,
+        #: runtime/fleetserve.py): 3 simulated host replicas SHARING
+        #: this world's loader behind a stream-affinity router, tiny
+        #: rings so saturation/spill/shed are reachable in-schedule;
+        #: dropped on drain-restore like the single loop
+        self._fleet = None
         #: generation → (committed rules at that epoch, degraded?) —
         #: the explanation-honesty invariant's re-resolve base:
         #: memo-served rows cite the generation they were computed
@@ -916,6 +929,152 @@ class DSTWorld:
         return {"devices": n, "flows": len(flows),
                 "verdicts": _digest(got), "degraded": degraded}
 
+    def fleet(self, n_streams: int, action: str, index: int) -> Dict:
+        """One round through the HORIZONTAL serving fleet (ISSUE 16):
+        a scheduled fleet action (host kill / partition / heartbeat
+        round / rejoin), then ``n_streams`` virtual streams connect
+        through the stream-affinity router and submit the probe
+        corpus. Armed ``fleet.heartbeat``/``fleet.handoff`` faults
+        land on the beat and the death handoff. Invariants on every
+        round: chunks resolve or shed explicitly (a HostDead submit
+        resumes, never vanishes), no ERROR / stale verdicts off any
+        replica ring, the fleet lease books are EXACT (sum over all
+        replicas, dead ones included), and lease conservation — no
+        stream holds leases on two live hosts, however the kill /
+        handoff-interrupt / rejoin events interleave."""
+        from cilium_tpu.core.flow import Verdict
+        from cilium_tpu.ingest.columnar import flows_to_columns
+        from cilium_tpu.runtime.fleetserve import (
+            FleetRouter,
+            HostDead,
+            HostReplica,
+        )
+        from cilium_tpu.runtime.serveloop import LeaseExpired, ShedError
+
+        if self._fleet is None:
+            replicas = [HostReplica(i, self.loader, capacity=4,
+                                    lease_ttl_s=10.0,
+                                    pack_interval_s=0.01)
+                        for i in range(3)]
+            self._fleet = FleetRouter(replicas,
+                                      heartbeat_interval_s=0.5,
+                                      suspicion_ttl_s=2.0,
+                                      spill_headroom=0.0)
+        router = self._fleet
+        # -- the scheduled fleet action (deterministic target pick:
+        # the highest-index live replica, never the last one standing)
+        live = [r for r in router.replicas if r.alive]
+        did = action
+        if action == "kill" and len(live) >= 2:
+            router.kill(live[-1].name)
+        elif action == "partition" and len(live) >= 2 \
+                and not live[-1].cut:
+            # the cut host fails CLOSED immediately (sheds
+            # ``partitioned``); the suspicion sweep declares it dead
+            # only once virtual time advances past the TTL — exactly
+            # the window the conservation invariant must survive
+            router.partition(live[-1].name)
+        elif action == "rejoin":
+            dead = [r for r in router.replicas if not r.alive]
+            if dead:
+                # loader=None: the revived replica keeps the world's
+                # shared loader — the zero-recompile warm-restore path
+                router.rejoin(dead[0].name)
+            else:
+                did = "rejoin-noop"
+        else:
+            did = f"{action}-noop" if action != "beat" else "beat"
+        died = router.beat()
+        # -- the serve round through the router ---------------------------
+        flows = self.corpus()
+        cols = flows_to_columns(flows)
+        sections = (cols.rec, cols.l7, cols.offsets, cols.blob,
+                    cols.gen)
+        tickets = []
+        sheds = 0
+        replays = 0
+        for k in range(n_streams):
+            sid = f"dstf-s{k}"
+            for _attempt in (0, 1):
+                try:
+                    _host, lease = router.connect(sid, resume=True)
+                except ShedError:
+                    sheds += 1
+                    break
+                except HostDead:
+                    replays += 1
+                    continue
+                try:
+                    tickets.append(router.submit(sid, lease, sections))
+                    break
+                except HostDead:
+                    # died between admit and submit: the typed resume
+                    # path — reconnect and replay, never stream-fatal
+                    replays += 1
+                    continue
+                except (ShedError, LeaseExpired):
+                    sheds += 1
+                    break
+            else:
+                sheds += 1  # resume budget exhausted: explicit shed
+        try:
+            router.step_all()
+        except Exception as e:  # noqa: BLE001 — an injected dispatch
+            # fault failing a pack is a legitimate outcome; the fresh
+            # fleet must converge next round
+            self._fleet = None
+            return {"faulted": type(e).__name__, "sheds": sheds}
+        degraded = bool(self.loader.bank_status().get("degraded"))
+        want = None
+        resolved = 0
+        for t in tickets:
+            if not t.done:
+                raise InvariantViolation(
+                    index, "fleet-liveness",
+                    "a chunk submitted through the router neither "
+                    "resolved nor shed after the fleet pack cycle")
+            if t.error is not None:
+                sheds += 1  # lease-closed from a death: explicit
+                continue
+            resolved += 1
+            got = [int(v) for v in t.verdicts]
+            if int(Verdict.ERROR) in got:
+                raise InvariantViolation(
+                    index, "fleet-no-error",
+                    "a replica ring served ERROR")
+            if want is None:
+                try:
+                    want = [int(v) for v in
+                            self.loader.engine.verdict_flows(
+                                flows)["verdict"]]
+                except Exception:  # noqa: BLE001 — injected dispatch
+                    want = got  # comparison round faulted: skip
+            if not degraded and got != want:
+                raise InvariantViolation(
+                    index, "fleet-stale",
+                    "a replica ring's verdicts diverged from the "
+                    "shared serving engine")
+        bal, occ = router.books()
+        if bal != occ:
+            raise InvariantViolation(
+                index, "fleet-lease-accounting",
+                f"fleet-wide grants - expiries - releases = {bal} != "
+                f"occupancy {occ} (summed over ALL replicas)")
+        dup = router.conservation_violation()
+        if dup is not None:
+            raise InvariantViolation(
+                index, "lease-conservation",
+                f"stream {dup[0]!r} holds live leases on {dup[1]} "
+                f"and {dup[2]}")
+        return {"streams": n_streams, "action": did,
+                "beat_deaths": list(died), "sheds": sheds,
+                "replays": replays, "resolved": resolved,
+                "live_hosts": sum(1 for r in router.replicas
+                                  if r.alive),
+                "handoffs": router.handoffs,
+                "partial_handoffs": router.partial_handoffs,
+                "occupancy": occ}
+
     def storm(self, n: int, index: int) -> Dict:
         """A burst of identity add/delete through the kvstore watch
         (the churn_storm point may lose deliveries); local allocation
@@ -1075,6 +1234,9 @@ class DSTWorld:
                 # ...and a fresh serving loop: ring/lease state is
                 # process-resident, not snapshot state
                 self._serve = None
+                # ...the fleet too: the replicas' rings died with the
+                # old process, and they must share the NEW loader
+                self._fleet = None
                 self.compiles0 = self.bank_compiles()
                 self.attempts = 0
         return {"warm_snapshot": warm, "restored": restored,
@@ -1184,6 +1346,15 @@ def generate(seed: int, max_events: int = 12) -> List[List]:
             events.append(["traffic"])
         elif roll < 0.66:
             events.append(["serve", rng.randint(2, 6)])
+        elif roll < 0.70:
+            # ISSUE 16: the horizontal fleet enters the searched
+            # space — a scheduled host kill/partition/beat/rejoin
+            # with the heartbeat+handoff fault points armable, then a
+            # routed serve round; lease conservation and exact
+            # fleet-wide books checked every time
+            events.append(["fleet", rng.randint(2, 6),
+                           rng.choice(["kill", "partition", "beat",
+                                       "rejoin"])])
         elif roll < 0.72:
             # ISSUE 12: sharded-lane checks ride the schedule space —
             # a fault armed two events earlier now also hits the mesh
@@ -1249,6 +1420,8 @@ def run_schedule(seed: int, events: Optional[List[List]] = None,
                             out = world.traffic(i)
                         elif kind == "serve":
                             out = world.serve(int(ev[1]), i)
+                        elif kind == "fleet":
+                            out = world.fleet(int(ev[1]), str(ev[2]), i)
                         elif kind == "multichip":
                             out = world.multichip(i)
                         elif kind == "clustermesh":

@@ -90,14 +90,21 @@ def build_entries(trace_id: str, surface: str, flows: Sequence,
                   verdicts, l7_match, amap,
                   gens=None, memo_hit=None, match_spec=None,
                   kernel: str = "", pack_cycle: int = -1,
-                  generation: int = -1,
+                  generation: int = -1, host_id: str = "",
                   sample: int = DEFAULT_SAMPLE) -> List[Dict]:
     """Explain entries for (up to ``sample``) flows of one served
     chunk. Alignment contract: ``flows[i]`` ↔ row i of every array.
     Counts explained/unexplained on the provenance series — a verdict
     is *explainable* when its attribution decodes (an L7 winner that
     resolves to live rules, or an honest L3/L4-only attribution via
-    ``match_spec``)."""
+    ``match_spec``).
+
+    ``host_id`` widens the packed word's pack-cycle scope to the FLEET:
+    pack cycles are per-ring counters, so once several replica rings
+    serve concurrently (runtime/fleetserve.py) cycle 17 exists on every
+    host — the ``host`` field is the disambiguating half of the
+    (host, cycle) pair and the join key a router-forwarded explain
+    query uses to attribute a trace to the replica that served it."""
     from cilium_tpu.core.flow import Verdict
     from cilium_tpu.engine.attribution import flow_family, pack_word
     from cilium_tpu.ingest.hubble import flow_to_dict
@@ -136,6 +143,7 @@ def build_entries(trace_id: str, surface: str, flows: Sequence,
             "pack_cycle": pack_cycle,
             "match_spec": spec,
             "explained": bool(explained),
+            "host": host_id,
         }
         if res is not None:
             prov.update(res)

@@ -67,6 +67,17 @@ Known injection points (registered by the modules owning the seam):
 ``clustermesh.session``    remote-cluster event ingest in ``clustermesh.py``
 ``clustermesh.heartbeat``  local-state publisher heartbeat
 ``dnsproxy.query``         banked-DFA batch path in ``fqdn/dnsproxy.py``
+``fleet.heartbeat``        per-host heartbeat in ``runtime/fleetserve.py``
+                           (a fired fault LOSES the beat — enough lost
+                           beats push the host through suspicion into
+                           fail-closed death)
+``fleet.handoff``          per-stream lease migration in the fleet
+                           router's host-death handoff (a fired fault
+                           interrupts the transfer mid-batch; the
+                           unmigrated remainder re-grants through the
+                           client resume path, never on two live hosts)
+``artifact.fetch``         compiled-bank artifact fetch in
+                           ``runtime/checkpoint.BankArtifactStore``
 =========================  ==================================================
 """
 

@@ -83,21 +83,17 @@ class Violation(AssertionError):
         self.detail = detail
 
 
-def _build_world(seed: int, n_rules: int, pool_chunks: int,
-                 chunk_flows: int, protocol_mix: float = 0.0):
-    """A real compiled serving slice: synth policy → TPU loader →
-    chunk pool with engine ground truth. ``protocol_mix`` > 0 blends
-    protocol-frontend traffic (cassandra/memcache/r2d2, ISSUE 15)
-    into the pool at that chunk fraction: ONE loader serves a merged
-    policy (http + frontend rule sets), so mixed-family packs ride
-    one fused dispatch exactly like production."""
-    from cilium_tpu.core.config import Config
+def _build_policy(n_rules: int, chunk_flows: int,
+                  protocol_mix: float = 0.0):
+    """The policy half of the world: synth scenario(s) → realized
+    per-identity rule sets + the flow pools chunks draw from. Split
+    out of :func:`_build_world` so the serving FLEET
+    (runtime/fleetserve.py) can regenerate the SAME policy on every
+    replica loader — identical rules per host is the precondition for
+    cross-host handoff serving identical verdicts, and for the
+    bank-artifact store satisfying every host after the first
+    without a recompile."""
     from cilium_tpu.ingest import synth
-    from cilium_tpu.ingest.binary import (
-        capture_from_bytes,
-        capture_to_bytes,
-    )
-    from cilium_tpu.runtime.loader import Loader
 
     n_flows = max(1024, chunk_flows * 8)
     sc_http = synth.scenario_by_name("http", n_rules, n_flows)
@@ -123,6 +119,26 @@ def _build_world(seed: int, n_rules: int, pool_chunks: int,
     else:
         per_identity, sc_http = synth.realize_scenario(sc_http)
         scenario_flows = list(sc_http.flows)
+    return per_identity, scenario_flows, proto_flows
+
+
+def _build_world(seed: int, n_rules: int, pool_chunks: int,
+                 chunk_flows: int, protocol_mix: float = 0.0):
+    """A real compiled serving slice: synth policy → TPU loader →
+    chunk pool with engine ground truth. ``protocol_mix`` > 0 blends
+    protocol-frontend traffic (cassandra/memcache/r2d2, ISSUE 15)
+    into the pool at that chunk fraction: ONE loader serves a merged
+    policy (http + frontend rule sets), so mixed-family packs ride
+    one fused dispatch exactly like production."""
+    from cilium_tpu.core.config import Config
+    from cilium_tpu.ingest.binary import (
+        capture_from_bytes,
+        capture_to_bytes,
+    )
+    from cilium_tpu.runtime.loader import Loader
+
+    per_identity, scenario_flows, proto_flows = _build_policy(
+        n_rules, chunk_flows, protocol_mix=protocol_mix)
     cfg = Config()
     cfg.enable_tpu_offload = True
     loader = Loader(cfg)

@@ -201,6 +201,23 @@ PROVENANCE_RECORDS = "cilium_tpu_provenance_records_total"
 #: (hit / miss)
 EXPLAIN_QUERIES = "cilium_tpu_explain_queries_total"
 
+# -- serving fleet (runtime/fleetserve.py): stream-affinity routing,
+# host-death failover, and the fleet-coherent shedding ledger.
+#: stream leases migrated off a dead/partitioned host and re-granted
+#: on a survivor (one per stream that moved)
+FLEET_HANDOFFS = "cilium_tpu_fleet_handoffs_total"
+#: hosts declared dead by the suspicion state machine (missed
+#: heartbeats past the TTL) or killed outright
+FLEET_HOST_DEATHS = "cilium_tpu_fleet_host_deaths_total"
+#: dead hosts warm-restored back into the placement ring
+FLEET_REJOINS = "cilium_tpu_fleet_rejoins_total"
+#: new streams the router placed AWAY from their rendezvous owner
+#: because the owner was past its spill headroom
+FLEET_SPILLED_STREAMS = "cilium_tpu_fleet_spilled_streams_total"
+#: gauge: leased-slot occupancy per host, by host — the occupancy
+#: digest the fleet-coherent shed/spill decision reads
+FLEET_HOST_OCCUPANCY = "cilium_tpu_fleet_host_occupancy"
+
 # -- megakernel scan autotuner (engine/megakernel.py): dense-DFA vs
 # bitset-NFA measured per bank shape at engine staging
 #: autotuner decisions, by winning impl and field (cache misses only —
@@ -766,6 +783,19 @@ METRICS.describe(PROVENANCE_RECORDS,
                  "(explained / unexplained)")
 METRICS.describe(EXPLAIN_QUERIES,
                  "explain-plane queries, by result (hit / miss)")
+METRICS.describe(FLEET_HANDOFFS,
+                 "stream leases migrated off a dead host and "
+                 "re-granted on a survivor")
+METRICS.describe(FLEET_HOST_DEATHS,
+                 "hosts declared dead (missed heartbeats past the "
+                 "suspicion TTL, or killed)")
+METRICS.describe(FLEET_REJOINS,
+                 "dead hosts warm-restored back into rotation")
+METRICS.describe(FLEET_SPILLED_STREAMS,
+                 "new streams placed away from their rendezvous "
+                 "owner for headroom")
+METRICS.describe(FLEET_HOST_OCCUPANCY,
+                 "leased-slot occupancy per fleet host, by host")
 
 
 class SpanStat:

@@ -139,10 +139,20 @@ def dst_stamp() -> Optional[Dict[str, object]]:
 
 def stamp(obj: Dict, rtt: bool = True) -> Dict:
     """Stamp ``obj`` (a bench line or artifact dict) in place with the
-    versioned schema tag + fingerprint; returns ``obj``. Never raises."""
+    versioned schema tag + fingerprint; returns ``obj``. Never raises.
+
+    Fleet lines additionally carry ``host_id`` — which host produced
+    the number (``parallel/multihost.host_id``: ``CILIUM_TPU_HOST_ID``
+    when the harness pins one, else the process identity). The id
+    makes per-host numbers from the fleetserve lane attributable the
+    way ``git_rev`` makes rounds attributable; callers that already
+    set a ``host_id`` (the router stamping a replica's line) win."""
     try:
         obj["bench_schema"] = BENCH_SCHEMA
         obj["provenance"] = fingerprint(rtt=rtt)
+        from cilium_tpu.parallel.multihost import host_id
+
+        obj.setdefault("host_id", host_id())
         dst = dst_stamp()
         if dst is not None:
             obj["dst"] = dst

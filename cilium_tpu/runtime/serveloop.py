@@ -184,7 +184,9 @@ class ServeLoop:
                  widths: Optional[Dict[str, int]] = None,
                  memo: bool = True,
                  provenance: Optional[bool] = None,
-                 slo=None):
+                 slo=None,
+                 explain_store=None,
+                 host_id: str = ""):
         from cilium_tpu.runtime.explain import EXPLAIN
         from cilium_tpu.runtime.slo import SLOTracker
 
@@ -202,7 +204,15 @@ class ServeLoop:
         self.provenance = bool(provenance)
         self.explain_sample = int(getattr(prov_cfg, "sample_per_chunk",
                                           8) or 0)
-        self.explain = EXPLAIN
+        #: which host this loop serves AS (fleet replicas pass their
+        #: identity; a standalone loop is anonymous) — rides every
+        #: explain entry so a pack cycle is scoped (host, cycle)
+        self.host_id = str(host_id)
+        #: fleet replicas pass a per-replica store so a trace resolves
+        #: against the replica that served it; standalone loops share
+        #: the process-global EXPLAIN (the pre-fleet contract)
+        self.explain = explain_store if explain_store is not None \
+            else EXPLAIN
         if prov_cfg is not None:
             self.explain.configure(
                 capacity=getattr(prov_cfg, "explain_capacity", None))
@@ -500,6 +510,7 @@ class ServeLoop:
                 match_spec=prov.match_spec, kernel=prov.kernel,
                 pack_cycle=prov.pack_cycle,
                 generation=prov.generation,
+                host_id=self.host_id,
                 sample=len(ticket.sample_flows))
             self.explain.record(ticket.trace_id, entries)
             LOG.debug("serve chunk explained", extra={"fields": {
@@ -610,6 +621,32 @@ class ServeLoop:
             for lease in list(self._leases.values()):
                 self._release_locked(lease, "drained")
         return flushed
+
+    def abandon(self, how: str = "closed") -> int:
+        """Host-death face (runtime/fleetserve.py): release EVERY
+        lease without a final pack — nothing else is served; pending
+        chunks resolve as ``lease-{how}`` errors, which is exactly
+        what a client sees when its host dies mid-chunk (connection
+        reset → the reconnect-with-resume replay path). Contrast
+        :meth:`drain` (graceful: pending chunks FLUSH). Returns the
+        number of leases dropped. The books stay exact — every
+        abandoned lease counts as a release — so a dead host's loop
+        still balances in the fleet-wide accounting."""
+        with self._lock:
+            self._draining = True
+            dropped = 0
+            for lease in list(self._leases.values()):
+                self._release_locked(lease, how)
+                dropped += 1
+        return dropped
+
+    def lease_ids(self) -> list:
+        """Stream ids currently holding a live lease here — the fleet
+        router's lease-conservation invariant reads this per host to
+        prove no stream is leased on two live hosts at once."""
+        with self._lock:
+            return [sid for sid, lease in self._leases.items()
+                    if lease.active]
 
     def stop(self) -> None:
         with self._lock:

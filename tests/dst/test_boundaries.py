@@ -518,8 +518,151 @@ def test_worker_death_exhaustion_reaches_quarantine_with_cover():
             reg.close()
 
 
-def test_drain_while_compiling_completes_inflight_and_stores(
+# ---------------------------------------------------------------------------
+# ISSUE 16: serving-fleet handoff boundaries — a lease lapsing at the
+# EXACT tick its host is declared dead, a chunk submitted to a host
+# that died between admit and submit, and a rejoin racing the handoff
+# of the rejoining host's own old leases.
+
+
+def _fleet_world(tmp_path, hosts=3, capacity=8, ttl=10.0):
+    from cilium_tpu.core.config import Config
+    from cilium_tpu.ingest import synth
+    from cilium_tpu.ingest.binary import (
+        capture_from_bytes,
+        capture_to_bytes,
+    )
+    from cilium_tpu.runtime.fleetserve import FleetRouter, HostReplica
+    from cilium_tpu.runtime.loader import Loader
+
+    scenario = synth.scenario_by_name("http", 12, 64)
+    per_identity, scenario = synth.realize_scenario(scenario)
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.loader.cache_dir = str(tmp_path / "cache")
+    loader = Loader(cfg)
+    loader.regenerate(per_identity, revision=1)
+    sections = capture_from_bytes(
+        capture_to_bytes(scenario.flows[:16]))
+    replicas = [HostReplica(i, loader, capacity=capacity,
+                            lease_ttl_s=ttl, pack_interval_s=0.01)
+                for i in range(hosts)]
+    router = FleetRouter(replicas, heartbeat_interval_s=1.0,
+                         suspicion_ttl_s=3.0, spill_headroom=0.0)
+    return router, loader, sections
+
+
+def test_lease_expiring_at_the_exact_death_tick_never_double_counts(
         tmp_path):
+    """A lease whose TTL lapses at EXACTLY the tick its host is
+    declared dead: the abandonment releases the slot exactly once
+    (as a close — never ALSO swept as an expiry), the handoff
+    re-grant on a survivor counts exactly one new grant, and the
+    fleet books stay exact through the coincidence."""
+    clk = VirtualClock()
+    with simclock.use(clk):
+        router, loader, sections = _fleet_world(tmp_path, ttl=10.0)
+        host, lease = router.connect("race-0")
+        dead = next(r for r in router.replicas if r.name == host)
+        # advance to EXACTLY the lease expiry tick, then declare the
+        # host dead without an intervening pack — the race, pinned
+        clk.advance_to(lease.expires_at)
+        assert lease.expired
+        router.kill(host)
+        st = dead.loop.status()
+        assert (st["grants"], st["expiries"], st["releases"]) \
+            == (1, 0, 1), "abandon must release ONCE, never also expire"
+        assert st["occupancy"] == 0
+        # the handoff re-granted on a survivor — exactly one grant,
+        # never one on each side of the death
+        assert router.conservation_violation() is None
+        bal, occ = router.books()
+        assert bal == occ == 1
+        placed = router.placements.get("race-0")
+        assert placed is not None and placed != host
+
+
+def test_submit_to_host_dead_between_admit_and_submit_resumes(
+        tmp_path):
+    """Admit lands, the host dies, THEN the chunk arrives: the submit
+    raises the TYPED HostDead (the client's resume signal, never a
+    stream-fatal error), and the reconnect-with-resume replay serves
+    the chunk on a survivor with the books exact."""
+    from cilium_tpu.runtime.fleetserve import HostDead
+
+    clk = VirtualClock()
+    with simclock.use(clk):
+        router, loader, sections = _fleet_world(tmp_path)
+        host, lease = router.connect("gap-0")
+        # the death slips into the admit→submit gap; the handoff is
+        # fully interrupted so the stream is left UNPLACED (the
+        # client-resume face of the race, not the migrated face)
+        from cilium_tpu.runtime import faults as _faults
+
+        with _faults.inject(_faults.FaultPlan(
+                [_faults.FaultRule("fleet.handoff", times=1)])):
+            router.kill(host)
+        with pytest.raises(HostDead):
+            router.submit("gap-0", lease, sections)
+        # the typed error drives the replay: resume, re-submit, serve
+        host2, lease2 = router.connect("gap-0", resume=True)
+        assert host2 != host
+        ticket = router.submit("gap-0", lease2, sections)
+        router.step_all()
+        assert ticket.done and ticket.error is None
+        assert len(ticket.verdicts) == ticket.n
+        assert router.conservation_violation() is None
+        bal, occ = router.books()
+        assert bal == occ == 1
+
+
+def test_rejoin_racing_the_handoff_of_its_own_old_leases(tmp_path):
+    """The rejoining host comes back while its OWN old leases are
+    still mid-migration (the handoff was interrupted after one
+    re-grant): already-migrated streams stay pinned to their
+    survivor, unmigrated ones may resume onto the rejoined host's
+    FRESH ring — and at no point does any stream hold leases on two
+    live hosts, including the rejoined incarnation vs its survivors."""
+    from cilium_tpu.runtime import faults as _faults
+
+    clk = VirtualClock()
+    with simclock.use(clk):
+        router, loader, sections = _fleet_world(tmp_path)
+        streams = [f"r{k}" for k in range(8)]
+        for s in streams:
+            router.connect(s)
+        counts = {}
+        for s in streams:
+            h = router.placements[s]
+            counts[h] = counts.get(h, 0) + 1
+        victim = max(counts, key=lambda h: counts[h])
+        assert counts[victim] >= 2
+        # interrupt AFTER one re-grant: one stream migrated, the rest
+        # of the victim's streams left unplaced
+        with _faults.inject(_faults.FaultPlan(
+                [_faults.FaultRule("fleet.handoff", times=1,
+                                   after=1)])):
+            router.kill(victim)
+        assert router.partial_handoffs == 1
+        assert router.handoffs == 1
+        # the rejoin races the unfinished migration
+        router.rejoin(victim)
+        rejoined = next(r for r in router.replicas
+                        if r.name == victim)
+        assert rejoined.alive and not rejoined.loop.lease_ids(), \
+            "the rejoined incarnation must start with a FRESH ring"
+        # every stream resumes: pinned ones stay put, unplaced ones
+        # may land on the rejoined host — exactly one live lease each
+        pinned_before = {s: router.placements[s] for s in streams
+                         if s in router.placements}
+        for s in streams:
+            router.connect(s, resume=True)
+        for s, h in pinned_before.items():
+            assert router.placements[s] == h, \
+                "a pinned stream moved during the rejoin race"
+        assert router.conservation_violation() is None
+        bal, occ = router.books()
+        assert bal == occ == len(streams)
     """Drain racing an in-flight bank compile: the compile finishes,
     its result lands in the registry (and the artifact store), and
     the drained queue refuses new work instead of buffering it."""

@@ -125,6 +125,7 @@ def test_registered_points_cover_the_documented_seams():
     import cilium_tpu.identity_kvstore  # noqa: F401
     import cilium_tpu.kvstore  # noqa: F401
     import cilium_tpu.policy.compiler.bankplan  # noqa: F401
+    import cilium_tpu.runtime.fleetserve  # noqa: F401
     import cilium_tpu.runtime.stream  # noqa: F401
 
     pts = faults.registered_points()
@@ -132,7 +133,8 @@ def test_registered_points_cover_the_documented_seams():
               "stream.frame.server",
               "stream.frame.client", "stream.credit", "service.admit",
               "service.drain", "kvstore.watch", "kvstore.churn_storm",
-              "clustermesh.session", "dnsproxy.query"):
+              "clustermesh.session", "dnsproxy.query",
+              "fleet.heartbeat", "fleet.handoff"):
         assert p in pts, p
 
 
@@ -1253,6 +1255,108 @@ def test_kvstore_churn_storm_loses_deliveries_not_correctness():
     writer.close()
     watcher.close()
     fresh.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 16: serving-fleet fault points — heartbeat loss runs the
+# suspicion clock down to a FAIL-CLOSED death, and an interrupted
+# handoff never leaves a stream leased on two live hosts.
+
+
+def _fleet_world(tmp_path, hosts=3):
+    from cilium_tpu.ingest import synth
+    from cilium_tpu.runtime.fleetserve import FleetRouter, HostReplica
+
+    scenario = synth.scenario_by_name("http", 24, 64)
+    per_identity, scenario = synth.realize_scenario(scenario)
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.loader.cache_dir = str(tmp_path / "cache")
+    loader = Loader(cfg)
+    loader.regenerate(per_identity, revision=1)
+    replicas = [HostReplica(i, loader, capacity=8, lease_ttl_s=60.0,
+                            pack_interval_s=0.01)
+                for i in range(hosts)]
+    router = FleetRouter(replicas, heartbeat_interval_s=1.0,
+                         suspicion_ttl_s=3.0, spill_headroom=0.0)
+    return router, loader, scenario
+
+
+def test_fleet_heartbeat_loss_suspicion_is_fail_closed(tmp_path):
+    """Armed fleet.heartbeat fires eat every replica's beats: once
+    the suspicion TTL lapses, the sweep declares them dead (counted),
+    every lease closes (exact books), new admits shed coherently, and
+    a submit against a dead placement is the TYPED resume error —
+    never fail-open service from a host nobody has heard from."""
+    from cilium_tpu.runtime.fleetserve import HostDead
+    from cilium_tpu.runtime.metrics import FLEET_HOST_DEATHS
+    from cilium_tpu.runtime.serveloop import ShedError
+
+    clk = simclock.VirtualClock()
+    with simclock.use(clk):
+        router, loader, _ = _fleet_world(tmp_path)
+        leases = {}
+        for k in range(6):
+            _host, lease = router.connect(f"s{k}")
+            leases[f"s{k}"] = lease
+        deaths0 = _metric(FLEET_HOST_DEATHS)
+        with faults.inject(FaultPlan(
+                [FaultRule("fleet.heartbeat", times=9)], seed=0)):
+            died = []
+            for dt in (1.0, 1.0, 1.1):  # 3 beat rounds, all lost
+                clk.advance(dt)
+                died += router.beat()
+        assert sorted(died) == sorted(r.name for r in router.replicas)
+        assert _metric(FLEET_HOST_DEATHS) == deaths0 + 3
+        # fail-closed: no live host → a coherent explicit shed
+        with pytest.raises(ShedError):
+            router.connect("fresh")
+        # a dead placement is the typed resume path, never stream-fatal
+        with pytest.raises(HostDead):
+            router.submit("s0", leases["s0"], None)
+        assert router.books() == (0, 0)
+        assert router.conservation_violation() is None
+        # warm rejoin: resume re-grants exactly once, books exact
+        for r in router.replicas:
+            router.rejoin(r.name)
+        router.connect("s0", resume=True)
+        assert router.books() == (1, 1)
+        assert router.conservation_violation() is None
+
+
+def test_fleet_handoff_interrupt_conserves_leases(tmp_path):
+    """A fleet.handoff fire interrupts the dead host's lease
+    migration mid-batch: the un-re-granted remainder stays UNPLACED
+    (client-resume territory) — at no instant does any stream hold
+    leases on two live hosts, and the fleet books stay exact through
+    the interrupt and through every later resume."""
+    clk = simclock.VirtualClock()
+    with simclock.use(clk):
+        router, loader, _ = _fleet_world(tmp_path)
+        streams = [f"h{k}" for k in range(9)]
+        for s in streams:
+            router.connect(s)
+        counts = {}
+        for s in streams:
+            host = router.placements[s]
+            counts[host] = counts.get(host, 0) + 1
+        victim = max(counts, key=lambda h: counts[h])
+        doomed = counts[victim]
+        assert doomed >= 2  # the interrupt needs a batch to cut
+        with faults.inject(FaultPlan(
+                [FaultRule("fleet.handoff", times=1)], seed=0)):
+            router.kill(victim)
+        assert router.partial_handoffs == 1
+        assert router.handoffs == 0  # the fire cut the whole batch
+        assert router.conservation_violation() is None
+        bal, occ = router.books()
+        assert bal == occ
+        # every stream resumes somewhere LIVE, still without a dup
+        for s in streams:
+            host, _lease = router.connect(s, resume=True)
+            assert host != victim
+        assert router.conservation_violation() is None
+        assert router.books() == (len(streams), len(streams))
 
 
 def test_warm_restore_same_artifact_keeps_memo(tmp_path):
