@@ -90,18 +90,26 @@ serve-soak:      ## 100k-virtual-stream continuous-batching soak
 # honesty at the CITED generation), aggregate p99 <= 2x the committed
 # single-host serve-soak baseline, shed rate <= 2%, zero survivor
 # recompiles + a zero-compile warm restore on every rejoin, and zero
-# unrecovered streams across the failovers.
+# unrecovered streams across the failovers. ISSUE 17 arms the fleet
+# observability gates on the same run: >=400 handoffs with >=99%
+# cross-host trace-stitch coverage, a non-empty merged Hubble flow
+# export, a consistent fleet event journal, and observability
+# overhead <= 2% of wall time.
 serve-fleet:     ## 1M-stream serving fleet: failover + shedding soak
 	JAX_PLATFORMS=cpu $(PY) -m cilium_tpu.runtime.fleetserve \
 	    --streams 1050000 --hosts 4 --out BENCH_FLEET_SERVE_r08.jsonl
 
 # the smoke face of the same driver — small enough for `make check`;
-# the p99 gate stays off (tiny runs are all fixed overhead) but every
-# failover/conservation/honesty gate is armed
+# the p99 gate stays off (tiny runs are all fixed overhead) and the
+# handoff floor drops to 1 (a 60-virtual-second run can't stage 400
+# failovers) but every failover/conservation/honesty gate — and the
+# journal/books-consistency + stitch-coverage + flow-export +
+# obs-overhead gates — is armed
 serve-fleet-smoke: ## serving-fleet driver at check-sized smoke scale
 	JAX_PLATFORMS=cpu $(PY) -m cilium_tpu.runtime.fleetserve \
 	    --streams 2000 --hosts 4 --virtual-s 60 --storm-size 200 \
-	    --no-p99-gate --out /tmp/BENCH_FLEET_SERVE_smoke.jsonl
+	    --no-p99-gate --min-handoffs 1 \
+	    --out /tmp/BENCH_FLEET_SERVE_smoke.jsonl
 
 # churn: the ISSUE-8 acceptance soak — sustained CNP add/delete +
 # FQDN pattern churn through a live replay session across ≥50

@@ -25,7 +25,13 @@ both ways:
   the package — must appear in the doc's **Reason-label catalog**
   section, and a catalog row whose value is no longer emitted
   anywhere is a stale-doc finding (a dashboard filtering on a dead
-  label value silently matches nothing).
+  label value silently matches nothing);
+* every **fleet journal event KIND** — the ``JOURNAL_KINDS`` tuple in
+  ``runtime/fleetserve.py`` plus every literal ``journal.record(...)``
+  first argument — must appear in the doc's **Fleet event-journal
+  catalog** section, both ways: an undocumented kind is an event an
+  operator cannot interpret, a catalog row for a kind the journal
+  never records is a stale doc.
 """
 
 from __future__ import annotations
@@ -62,6 +68,11 @@ _LABEL_KEYS = ("reason", "result")
 #: ``| `value` | ... |`` table lines
 REASON_SECTION = "## Reason-label catalog"
 _REASON_ROW_RE = re.compile(r"^\|\s*`([a-z0-9*_-]+)`")
+
+FLEETSERVE_MODULE = "cilium_tpu.runtime.fleetserve"
+#: the doc section holding the fleet event-journal catalog; rows are
+#: ``| `kind` | ... |`` table lines (same row shape as reasons)
+JOURNAL_SECTION = "## Fleet event-journal catalog"
 
 
 def _declared_families(project: Project) -> Dict[str, Tuple[str, int]]:
@@ -200,13 +211,50 @@ def _reason_values(project: Project) -> Dict[str, Tuple[str, int]]:
     return out
 
 
-def _documented_reasons(doc_text: str) -> Dict[str, int]:
-    """Value → doc line of every Reason-label catalog row."""
+def _journal_kinds(project: Project) -> Dict[str, Tuple[str, int]]:
+    """Every fleet journal event KIND the tree can record →
+    declaring (path, line): the ``JOURNAL_KINDS`` tuple in
+    ``runtime/fleetserve.py`` plus literal first args of
+    ``journal.record("...")`` call sites (a recorded kind missing
+    from the tuple is caught at runtime; here both feed the doc
+    diff)."""
+    out: Dict[str, Tuple[str, int]] = {}
+    mi = project.modules.get(FLEETSERVE_MODULE)
+    if mi is None:
+        return out
+    for node in mi.sf.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "JOURNAL_KINDS" \
+                and isinstance(node.value, ast.Tuple):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) \
+                        and isinstance(elt.value, str):
+                    out.setdefault(elt.value, (mi.sf.path,
+                                               node.lineno))
+    for node in ast.walk(mi.sf.tree):
+        if not (isinstance(node, ast.Call) and node.args
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "record"):
+            continue
+        owner = node.func.value
+        owner_name = owner.attr if isinstance(owner, ast.Attribute) \
+            else owner.id if isinstance(owner, ast.Name) else ""
+        if owner_name != "journal":
+            continue
+        for v in _const_strs(node.args[0]):
+            out.setdefault(v, (mi.sf.path, node.lineno))
+    return out
+
+
+def _documented_rows(doc_text: str, section: str) -> Dict[str, int]:
+    """Value → doc line of every ``| `value` |`` row under one
+    ``## ...`` section."""
     out: Dict[str, int] = {}
     in_section = False
     for i, line in enumerate(doc_text.splitlines(), 1):
         if line.strip().startswith("## "):
-            in_section = line.strip() == REASON_SECTION.strip()
+            in_section = line.strip() == section.strip()
             continue
         if not in_section:
             continue
@@ -214,6 +262,11 @@ def _documented_reasons(doc_text: str) -> Dict[str, int]:
         if m:
             out.setdefault(m.group(1), i)
     return out
+
+
+def _documented_reasons(doc_text: str) -> Dict[str, int]:
+    """Value → doc line of every Reason-label catalog row."""
+    return _documented_rows(doc_text, REASON_SECTION)
 
 
 def check_obs_docs(index: ProjectIndex,
@@ -268,6 +321,27 @@ def check_obs_docs(index: ProjectIndex,
                     f"{DOC_PATH} catalogs reason-label value "
                     f"`{value}` but nothing in the tree emits it — "
                     f"stale doc or typo"))
+    # fleet journal-kind parity, both directions (only when the tree
+    # has a journal at all — corpora without fleetserve are not
+    # judged)
+    kinds = _journal_kinds(project)
+    if kinds:
+        doc_kinds = _documented_rows(doc_text, JOURNAL_SECTION)
+        for kind, (path, line) in sorted(kinds.items()):
+            if kind not in doc_kinds:
+                findings.append(Finding(
+                    path, line, RULE,
+                    f"fleet journal event kind `{kind}` is not in "
+                    f"{DOC_PATH}'s Fleet event-journal catalog (an "
+                    f"operator cannot interpret an undocumented "
+                    f"event)"))
+        for kind, line in sorted(doc_kinds.items()):
+            if kind not in kinds:
+                findings.append(Finding(
+                    DOC_PATH, line, RULE,
+                    f"{DOC_PATH} catalogs fleet journal event kind "
+                    f"`{kind}` but runtime/fleetserve.py never "
+                    f"records it — stale doc or typo"))
     # stale direction: doc tokens that are no longer declared families
     if families:
         derived = set()

@@ -432,6 +432,54 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_flows(args) -> int:
+    """Aggregated Hubble flow export (`/v1/flows`).
+
+    Per-host flow counts keyed by (src identity, dst identity,
+    verdict, rule, bank, generation), router-merged with host
+    attribution when the agent fronts a serving fleet. ``--out``
+    writes exporter-enveloped JSONL (``{"flow": {...}}`` lines) that
+    ``ingest/hubble.read_jsonl`` parses straight back."""
+    c = _api(args)
+    body = c.flows(limit=args.limit)
+    if args.out:
+        n = 0
+        with open(args.out, "w") as fp:
+            for row in body.get("flows", ()):
+                fp.write(json.dumps({
+                    "flow": row.get("flow") or {},
+                    "count": row.get("count", 0),
+                    **({"node_name": row["host"]}
+                       if row.get("host") else {}),
+                }) + "\n")
+                n += 1
+        print(json.dumps({"out": args.out, "flows": n,
+                          "records": body.get("records", 0)}))
+        return 0
+    if args.json:
+        print(json.dumps(body, indent=2, default=str))
+        return 0
+    hosts = body.get("hosts") or ([body["host"]]
+                                  if body.get("host") else [])
+    print(f"{body.get('records', 0)} records, "
+          f"{body.get('aggregated', 0)} aggregated into "
+          f"{body.get('keys', 0)} keys, overflow "
+          f"{body.get('overflow', 0)}"
+          + (f"  hosts={','.join(hosts)}" if hosts else ""))
+    for row in body.get("flows", ()):
+        where = ""
+        if row.get("hosts"):
+            where = "  hosts=" + ",".join(
+                f"{h}:{n}" for h, n in sorted(row["hosts"].items()))
+        elif row.get("host"):
+            where = f"  host={row['host']}"
+        print(f"  {row.get('src_identity')}->"
+              f"{row.get('dst_identity')} {row.get('verdict')} "
+              f"x{row.get('count')}  rule={row.get('rule') or '-'} "
+              f"gen={row.get('generation')}{where}")
+    return 0
+
+
 def cmd_auth(args) -> int:
     """Mutual-auth pair management over the REST API."""
     c = _api(args)
@@ -1026,6 +1074,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     td.add_argument("--spans", action="store_true",
                     help="raw span records instead of Chrome JSON")
     td.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser(
+        "flows",
+        help="aggregated Hubble flow export (/v1/flows): per-host "
+             "verdict counts, fleet-merged; --out writes JSONL")
+    p.add_argument("--api", required=True)
+    p.add_argument("--limit", type=int, default=None,
+                   help="largest N aggregation keys")
+    p.add_argument("--out", default=None,
+                   help="write exporter-enveloped JSONL instead of "
+                        "the summary lines")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON instead of the summary lines")
+    p.set_defaults(fn=cmd_flows)
 
     p = sub.add_parser(
         "explain",

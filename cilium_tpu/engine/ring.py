@@ -109,8 +109,15 @@ class VerdictRing:
 
     def __init__(self, engine, capacity: int, loader=None,
                  widths: Optional[Dict[str, int]] = None,
-                 memo: bool = True, provenance: bool = False):
+                 memo: bool = True, provenance: bool = False,
+                 host: str = ""):
         self.capacity = max(1, int(capacity))
+        #: fleet replicas pass their identity so the ring's serve-
+        #: plane families land as per-host series instead of N
+        #: in-process rings colliding on one unlabeled series
+        #: (ISSUE 17 satellite); standalone rings stay unlabeled
+        self.host = str(host)
+        self._host_labels = {"host": self.host} if self.host else None
         #: serve with the attribution/provenance lanes riding the
         #: dispatch (engine/attribution.ServedPack per chunk)
         self.provenance = bool(provenance)
@@ -210,7 +217,8 @@ class VerdictRing:
             self.bytes_shipped += novel * row_bytes + n * 4
             if known:
                 METRICS.inc(SERVE_MEMO_BYPASS_BYTES,
-                            known * max(0, row_bytes - 4))
+                            known * max(0, row_bytes - 4),
+                            labels=self._host_labels)
             # the epoch rides the chunk, not the slot: a later submit
             # after a reset must not launder THIS chunk's stale ids
             slot.pending.append((idx, done, epoch))
@@ -305,9 +313,11 @@ class VerdictRing:
             raise
         self.packs += 1
         self.records_packed += int(total)
-        METRICS.observe(SERVE_PACK_RECORDS, float(total))
+        METRICS.observe(SERVE_PACK_RECORDS, float(total),
+                        labels=self._host_labels)
         METRICS.observe(SERVE_PACK_STREAMS,
-                        float(len({s.slot_id for s, _, _, _ in batch})))
+                        float(len({s.slot_id for s, _, _, _ in batch})),
+                        labels=self._host_labels)
         if self.provenance and hasattr(verdicts, "slice"):
             # stamp the pack-cycle id on the bundle before slicing —
             # every chunk of this dispatch shares it

@@ -110,7 +110,13 @@ _EXTRA_KEYS = ("tunnel_rtt_ms", "tunnel_rtt_max_ms", "stage_ms",
                # serve-fleet lane (ISSUE 16): the failover trajectory
                "hosts", "handoffs", "host_deaths", "rejoins",
                "spilled_streams", "shed_rate", "p99_ratio",
-               "rejoin_warm_restores")
+               "rejoin_warm_restores",
+               # fleet observability plane (ISSUE 17): trace
+               # stitching, flow export, journal, obs-overhead budget
+               "stitch_coverage", "handoff_replays",
+               "flows_aggregated", "flow_keys", "journal_events",
+               "failover_p99_ms", "obs_overhead_pct",
+               "obs_budget_pct")
 
 
 def _entry(source: str, kind: str, obj: Dict,
@@ -433,6 +439,43 @@ def provenance_budget_violations(entries: List[Dict],
     return out
 
 
+def obs_budget_violations(entries: List[Dict],
+                          newest: Optional[int]) -> List[Dict]:
+    """The fleet-observability overhead gate (ISSUE 17): a lane that
+    DECLARES an observability budget (``obs_budget_pct`` — the
+    serve-fleet soak declares 2.0%) is held to its measured
+    ``obs_overhead_pct``, the wall fraction spent on trace stitching,
+    flow aggregation and journal/roll-up bookkeeping. Only the NEWEST
+    round gates; lanes without a declared budget are not judged."""
+    out = []
+    for e in entries:
+        if e["status"] != "ok" or e["round"] != newest:
+            continue
+        budget = e["extras"].get("obs_budget_pct")
+        measured = e["extras"].get("obs_overhead_pct")
+        if budget is None or measured is None:
+            continue
+        if float(measured) <= float(budget):
+            continue
+        out.append({
+            "metric": f"{e['metric']}[observability]",
+            "kind": e["kind"],
+            "from": e["round_label"],
+            "to": e["round_label"],
+            "from_value": float(budget),
+            "to_value": float(measured),
+            "direction": "lower",
+            "worse_factor": round(
+                float(measured) / max(float(budget), 1e-9), 4),
+            "classification": "code_regression",
+            "reason": (f"fleet observability overhead "
+                       f"{float(measured):g}% over its declared "
+                       f"budget {float(budget):g}% — the stitching/"
+                       f"flow-export/journal plane got expensive"),
+        })
+    return out
+
+
 # -- trajectory + classification --------------------------------------------
 
 def _effective_rtt(entry: Dict) -> Tuple[Optional[float], str]:
@@ -642,6 +685,7 @@ def build_trajectory(entries: List[Dict],
                                                          newest)
     provenance_violations = provenance_budget_violations(entries,
                                                          newest)
+    obs_violations = obs_budget_violations(entries, newest)
     return {
         "schema": TRAJECTORY_SCHEMA,
         "threshold": threshold,
@@ -653,7 +697,8 @@ def build_trajectory(entries: List[Dict],
         "failures": failures,
         "gate_regressions": (gate + budget_violations
                              + collective_violations
-                             + provenance_violations),
+                             + provenance_violations
+                             + obs_violations),
     }
 
 

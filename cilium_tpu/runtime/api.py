@@ -274,6 +274,18 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 except ValueError:
                     return self._send(400, {"error": "limit must be "
                                             "an integer"})
+                # a trace-id query against a fleet (or ?stitch=1)
+                # returns the STITCHED cross-host timeline — spans
+                # merged by id, ordered by (causal epoch, ts), host-
+                # attributed (runtime/fleetserve.py handoff stitching)
+                fleet = getattr(agent, "fleet", None)
+                if tid and (fleet is not None or query.get("stitch")):
+                    stitched = (fleet.trace(tid) if fleet is not None
+                                else TRACER.stitch(tid))
+                    if limit:
+                        stitched["records"] = \
+                            stitched["records"][:limit]
+                    return self._send(200, stitched)
                 return self._send(200, {
                     "enabled": TRACER.enabled,
                     "sample_rate": TRACER.sample_rate,
@@ -281,6 +293,27 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     "trace_ids": TRACER.trace_ids(),
                     "spans": TRACER.dump(trace_id=tid, limit=limit),
                 })
+            if path == "/v1/flows":
+                # continuous Hubble flow export: per-host aggregated
+                # (identity, identity, verdict, rule, bank,
+                # generation) counts — router-merged with host
+                # attribution when fronting a fleet
+                try:
+                    limit = int(query.get("limit", 0)) or None
+                except ValueError:
+                    return self._send(400, {"error": "limit must be "
+                                            "an integer"})
+                fleet = getattr(agent, "fleet", None)
+                if fleet is not None:
+                    return self._send(200, fleet.flows(limit=limit))
+                loop = getattr(agent, "serve_loop", None)
+                if loop is not None and \
+                        getattr(loop, "flows", None) is not None:
+                    return self._send(200,
+                                      loop.flows.snapshot(limit=limit))
+                from cilium_tpu.hubble.flowagg import merge_snapshots
+
+                return self._send(200, merge_snapshots(()))
             if path == "/v1/debuginfo":
                 return self._send(200, agent.status())
             return self._send(404, {"error": f"no such resource {path}"})
@@ -697,6 +730,10 @@ class APIClient:
             q.append("format=chrome")
         path = "/v1/trace" + ("?" + "&".join(q) if q else "")
         return self.request("GET", path)[1]
+
+    def flows(self, limit: Optional[int] = None):
+        q = f"?limit={int(limit)}" if limit else ""
+        return self.request("GET", "/v1/flows" + q)[1]
 
     def debuginfo(self):
         return self.request("GET", "/v1/debuginfo")[1]

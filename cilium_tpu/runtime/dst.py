@@ -1066,6 +1066,12 @@ class DSTWorld:
                 index, "lease-conservation",
                 f"stream {dup[0]!r} holds live leases on {dup[1]} "
                 f"and {dup[2]}")
+        journal_bad = router.journal_consistent()
+        if journal_bad is not None:
+            raise InvariantViolation(
+                index, "fleet-journal-consistency",
+                f"folding the fleet event journal diverged from the "
+                f"router's books: {journal_bad}")
         return {"streams": n_streams, "action": did,
                 "beat_deaths": list(died), "sheds": sheds,
                 "replays": replays, "resolved": resolved,

@@ -211,4 +211,15 @@ def resolve_explain(loader, trace_id: str,
         from cilium_tpu.engine.memo import policy_generation
 
         out["generation_now"] = policy_generation()
+    # link to the stitched cross-host timeline (ISSUE 17): a verdict
+    # served after a handoff explains on host B while its trace spans
+    # hosts A and B — the summary joins the two planes on the id
+    from cilium_tpu.runtime.tracing import TRACER
+
+    stitched = TRACER.stitch(trace_id)
+    if stitched["records"]:
+        out["trace"] = {"hosts": stitched["hosts"],
+                        "epochs": stitched["epochs"],
+                        "spans": len(stitched["records"]),
+                        "stitched": stitched["stitched"]}
     return out

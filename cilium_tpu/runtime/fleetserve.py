@@ -86,11 +86,15 @@ from cilium_tpu.runtime.loadmodel import (
 )
 from cilium_tpu.runtime.logging import get_logger
 from cilium_tpu.runtime.metrics import (
+    FLEET_FAILOVER_SECONDS,
     FLEET_HANDOFFS,
     FLEET_HOST_DEATHS,
     FLEET_HOST_OCCUPANCY,
+    FLEET_JOURNAL_EVENTS,
     FLEET_REJOINS,
+    FLEET_SLO_BURN_RATE,
     FLEET_SPILLED_STREAMS,
+    FLEET_TRACE_STITCHES,
     METRICS,
 )
 from cilium_tpu.runtime.serveloop import (
@@ -98,6 +102,7 @@ from cilium_tpu.runtime.serveloop import (
     ServeLoop,
     ShedError,
 )
+from cilium_tpu.runtime.tracing import TRACER, TraceContext
 
 LOG = get_logger("fleetserve")
 
@@ -114,6 +119,72 @@ HANDOFF_POINT = faults.register_point(
     "fleet.handoff", "per-stream lease migration in "
                      "FleetRouter._handoff (a fired fault interrupts "
                      "the transfer mid-batch)")
+
+
+#: the fleet event-journal catalog (ISSUE 17): every membership /
+#: suspicion / handoff / drain / rejoin transition the router makes,
+#: as an exactly-tick-stamped, causally-ordered journal entry. The
+#: catalog is machine-checked against OBSERVABILITY.md by ctlint's
+#: obs-doc-parity rule — adding a kind here without a documented row
+#: (or leaving a stale row behind) is a lint finding.
+JOURNAL_KINDS = (
+    "host-join",
+    "beat-lost",
+    "host-death",
+    "handoff",
+    "handoff-interrupted",
+    "host-partitioned",
+    "drain-begin",
+    "host-restart",
+    "host-rejoin",
+)
+
+
+class FleetJournal:
+    """The fleet's membership timeline: bounded, append-only, stamped
+    with the installed clock's EXACT tick and a monotone sequence
+    number taken under one lock — so two events at the same virtual
+    tick (a suspicion death and its handoff) still order causally.
+    The DST fleet arm holds the journal consistent with the router's
+    exact books after every membership change."""
+
+    def __init__(self, capacity: int = 65536):
+        self._lock = threading.Lock()
+        self._events: List[Dict] = []
+        self.capacity = max(1, int(capacity))
+        self._seq = 0
+        #: events dropped at the bound (consistency folding refuses
+        #: to pretend it saw a truncated history)
+        self.dropped = 0
+
+    def record(self, kind: str, host: str = "", **detail) -> None:
+        if kind not in JOURNAL_KINDS:
+            raise ValueError(f"unknown journal event kind: {kind!r}")
+        now = simclock.now()
+        with self._lock:
+            self._seq += 1
+            if len(self._events) >= self.capacity:
+                self.dropped += 1
+            else:
+                self._events.append({
+                    "seq": self._seq, "t": round(now, 9),
+                    "kind": kind, "host": host,
+                    **({"detail": detail} if detail else {})})
+        METRICS.inc(FLEET_JOURNAL_EVENTS, labels={"kind": kind})
+
+    def events(self) -> List[Dict]:
+        with self._lock:
+            return list(self._events)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for e in self.events():
+            out[e["kind"]] = out.get(e["kind"], 0) + 1
+        return out
 
 
 class HostDead(RuntimeError):
@@ -241,6 +312,31 @@ class FleetRouter:
         #: residency avoids re-shipping
         self.handoff_rows_resident = 0
         self.handoff_bytes_avoided = 0
+        #: the fleet event journal (ISSUE 17): every membership
+        #: transition, exactly tick-stamped and causally ordered
+        self.journal = FleetJournal()
+        #: stream id → {"tid", "epoch"}: the stitch context that rides
+        #: the lease handoff — a traced stream's replayed chunks adopt
+        #: the SAME trace id with a bumped causal epoch, so the kill →
+        #: abandon → re-grant → replay sequence is ONE timeline.
+        #: Bounded: only traced streams get entries
+        self._trace_ctx: Dict[str, Dict] = {}
+        self._trace_ctx_cap = 8192
+        #: stream id → failover stamps ({"death", "regrant"}): the
+        #: death-declared → re-grant → first-verdict-after-replay
+        #: latency ledger, bounded per death (histograms need volume,
+        #: not totality)
+        self._failover: Dict[str, Dict] = {}
+        self._failover_cap = 4096
+        self.failover_samples: List[float] = []
+        #: wall seconds spent on observability bookkeeping (journal,
+        #: stitch plumbing, roll-ups) — the ≤2% budget's numerator
+        self.obs_seconds = 0.0
+        #: last fleet burn-rate roll-up ({slo: {window: {view: rate}}})
+        self._fleet_burn: Dict = {}
+        for r in self.replicas:
+            self.journal.record("host-join", host=r.name,
+                                index=r.index)
 
     # -- placement --------------------------------------------------------
     @staticmethod
@@ -318,7 +414,11 @@ class FleetRouter:
             self.placements[stream_id] = target.name
             self._digest[target.name] = \
                 self._digest.get(target.name, 0) + 1
-            return target.name, lease
+        # a doomed stream re-placing through lazy client resume (the
+        # fault-interrupted handoff remainder) closes its death→
+        # re-grant stage here instead of in the handoff loop
+        self._note_regrant(stream_id)
+        return target.name, lease
 
     def replica_of(self, stream_id: str) -> Optional[HostReplica]:
         with self._lock:
@@ -337,6 +437,31 @@ class FleetRouter:
             raise HostDead("", f"stream {stream_id} has no live "
                                f"placement")
         replica.guard(new_stream=False)
+        ctx = TRACER.current()
+        if ctx is not None:
+            # a client-side trace is active: remember its id so the
+            # handoff can carry it to the survivor (ISSUE 17). Same
+            # id → keep the stored entry (its epoch may already be
+            # bumped past the client's stale context)
+            with self._lock:
+                entry = self._trace_ctx.get(stream_id)
+                if (entry is None or entry["tid"] != ctx.trace_id) \
+                        and len(self._trace_ctx) < self._trace_ctx_cap:
+                    self._trace_ctx[stream_id] = {
+                        "tid": ctx.trace_id,
+                        "epoch": getattr(ctx, "epoch", 0)}
+            return replica.loop.submit(lease, *sections)
+        with self._lock:
+            entry = self._trace_ctx.get(stream_id)
+        if entry is not None and TRACER.enabled:
+            # client replay with no active context (the reconnect-
+            # with-resume path after a host death): the chunk rides
+            # the stream's STITCHED trace — same id, bumped epoch —
+            # so both hosts' spans land on one timeline
+            resume_ctx = TraceContext(entry["tid"], "stream.resume",
+                                      epoch=entry["epoch"])
+            with TRACER.activate(resume_ctx):
+                return replica.loop.submit(lease, *sections)
         return replica.loop.submit(lease, *sections)
 
     # -- health plane -----------------------------------------------------
@@ -358,6 +483,9 @@ class FleetRouter:
                     faults.maybe_fail(HEARTBEAT_POINT)
                 except Exception:  # noqa: BLE001 — plan-chosen exc
                     lost = True
+                if lost:
+                    self.journal.record("beat-lost", host=r.name,
+                                        reason="fault")
             if not lost:
                 r.last_beat = now
             occ = int(r.loop.status()["occupancy"])
@@ -370,12 +498,52 @@ class FleetRouter:
             if r.alive and now - r.last_beat >= self.suspicion_ttl_s:
                 self._declare_dead(r, partitioned=True)
                 died.append(r.name)
+        t_obs = simclock.perf()
+        self._publish_fleet_slo()
+        self.obs_seconds += max(0.0, simclock.perf() - t_obs)
         return died
+
+    def _publish_fleet_slo(self) -> Dict:
+        """Fleet burn-rate roll-up over the per-replica SLO trackers
+        (ISSUE 17): ``worst`` is the worst single host (the paging
+        view — one burning host must not hide behind a quiet fleet),
+        ``weighted`` is fleet-weighted by each host's request volume
+        over the same window (the capacity view)."""
+        per_slo: Dict[str, Dict[str, Dict[str, float]]] = {}
+        acc: Dict = {}
+        for r in self.replicas:
+            if not r.alive:
+                continue
+            slo = r.loop.slo
+            if slo is None:
+                continue
+            rates = slo.burn_rates()
+            totals = slo.window_totals()
+            for name, per_window in rates.items():
+                for window, rate in per_window.items():
+                    key = (name, window)
+                    worst, wsum, tsum = acc.get(key, (0.0, 0.0, 0))
+                    weight = totals.get(window, 0)
+                    acc[key] = (max(worst, rate),
+                                wsum + rate * weight, tsum + weight)
+        for (name, window), (worst, wsum, tsum) in acc.items():
+            weighted = round(wsum / tsum, 4) if tsum else 0.0
+            per_slo.setdefault(name, {})[window] = {
+                "worst": worst, "weighted": weighted}
+            METRICS.set_gauge(FLEET_SLO_BURN_RATE, worst,
+                              labels={"slo": name, "window": window,
+                                      "view": "worst"})
+            METRICS.set_gauge(FLEET_SLO_BURN_RATE, weighted,
+                              labels={"slo": name, "window": window,
+                                      "view": "weighted"})
+        self._fleet_burn = per_slo
+        return per_slo
 
     def partition(self, name: str) -> None:
         """Cut the host off the heartbeat plane: it fails CLOSED on
         its own (sheds ``partitioned``) while suspicion runs down."""
         self._by_name[name].cut = True
+        self.journal.record("host-partitioned", host=name)
 
     def kill(self, name: str) -> int:
         """Hard host death (power loss): declare dead NOW and hand
@@ -388,6 +556,7 @@ class FleetRouter:
         host (they shed ``host-draining`` / re-place); existing
         leases keep serving until :meth:`restart_host`."""
         self._by_name[name].draining = True
+        self.journal.record("drain-begin", host=name)
 
     def restart_host(self, name: str) -> int:
         """Planned restart, phase 2: graceful — pack out every
@@ -401,6 +570,8 @@ class FleetRouter:
             for sid in [s for s, n in self.placements.items()
                         if n == name]:
                 self.placements.pop(sid, None)
+        self.journal.record("host-restart", host=name,
+                            flushed=flushed)
         return flushed
 
     def _declare_dead(self, r: HostReplica, partitioned: bool) -> int:
@@ -423,6 +594,30 @@ class FleetRouter:
                       if n == r.name]
             for s in doomed:
                 self.placements.pop(s, None)
+        t_obs = simclock.perf()
+        t_death = simclock.now()
+        self.journal.record("host-death", host=r.name,
+                            partitioned=partitioned,
+                            leases=len(doomed))
+        with self._lock:
+            for s in doomed:
+                # the trace context rides the handoff: bump the
+                # stream's causal epoch BEFORE any re-grant, so even
+                # a fault-interrupted remainder (re-granted lazily
+                # through client resume) replays onto the stitched
+                # timeline — the fleet.handoff marker is the seam
+                # the merged trace shows between the two hosts
+                entry = self._trace_ctx.get(s)
+                if entry is not None:
+                    entry["epoch"] += 1
+                    TRACER.event_remote(
+                        entry["tid"], "fleet.handoff", host=r.name,
+                        epoch=entry["epoch"], stream=s,
+                        partitioned=partitioned)
+                # failover latency ledger, bounded per death
+                if len(self._failover) < self._failover_cap:
+                    self._failover[s] = {"death": t_death}
+        self.obs_seconds += max(0.0, simclock.perf() - t_obs)
         survivors = [x for x in self.replicas
                      if x.alive and not x.cut]
         for x in survivors:
@@ -430,6 +625,7 @@ class FleetRouter:
             self.handoff_rows_resident += rows
             self.handoff_bytes_avoided += avoided
         migrated = 0
+        interrupted = False
         for s in doomed:
             if not survivors:
                 break
@@ -440,6 +636,7 @@ class FleetRouter:
                 # simply UNPLACED — each stream re-grants through its
                 # own reconnect-with-resume, never on two live hosts
                 self.partial_handoffs += 1
+                interrupted = True
                 break
             ranked = self._rank(s, survivors)
             with self._lock:
@@ -456,11 +653,50 @@ class FleetRouter:
             migrated += 1
             self.handoffs += 1
             METRICS.inc(FLEET_HANDOFFS)
+            self._note_regrant(s)
+        t_obs = simclock.perf()
+        self.journal.record("handoff", host=r.name,
+                            streams=migrated)
+        if interrupted:
+            self.journal.record("handoff-interrupted", host=r.name,
+                                remainder=len(doomed) - migrated)
+        self.obs_seconds += max(0.0, simclock.perf() - t_obs)
         LOG.warning("host death handled", extra={"fields": {
             "host": r.name, "partitioned": partitioned,
             "leases_dropped": dropped, "migrated": migrated,
             "resident_rows_on_survivors": self.handoff_rows_resident}})
         return migrated
+
+    def _note_regrant(self, stream_id: str) -> None:
+        """Stamp the death→re-grant stage of the failover latency
+        ledger (called at the handoff re-grant AND at a lazy client
+        resume that re-places a doomed stream)."""
+        fo = self._failover.get(stream_id)
+        if fo is None or "regrant" in fo:
+            return
+        now = simclock.now()
+        fo["regrant"] = now
+        METRICS.observe(FLEET_FAILOVER_SECONDS,
+                        max(0.0, now - fo["death"]),
+                        labels={"stage": "death-to-regrant"})
+
+    def note_failover_verdict(self, stream_id: str) -> None:
+        """Close a stream's failover ledger at its first verdict
+        after replay: observes the regrant→verdict and end-to-end
+        death→verdict latencies and frees the entry. The driving
+        model calls this when a replayed ticket resolves cleanly."""
+        fo = self._failover.pop(stream_id, None)
+        if fo is None:
+            return
+        now = simclock.now()
+        if "regrant" in fo:
+            METRICS.observe(FLEET_FAILOVER_SECONDS,
+                            max(0.0, now - fo["regrant"]),
+                            labels={"stage": "regrant-to-verdict"})
+        total = max(0.0, now - fo["death"])
+        METRICS.observe(FLEET_FAILOVER_SECONDS, total,
+                        labels={"stage": "death-to-verdict"})
+        self.failover_samples.append(total)
 
     def rejoin(self, name: str, loader=None) -> None:
         """Warm restore the dead host back into rotation: fresh loop,
@@ -473,6 +709,7 @@ class FleetRouter:
             self._digest[name] = 0
         self.rejoins += 1
         METRICS.inc(FLEET_REJOINS)
+        self.journal.record("host-rejoin", host=name)
 
     # -- fleet-wide invariants & introspection ----------------------------
     def books(self) -> Tuple[int, int]:
@@ -500,6 +737,81 @@ class FleetRouter:
                     return sid, seen[sid], r.name
                 seen[sid] = r.name
         return None
+
+    def journal_consistent(self) -> Optional[str]:
+        """The journal's DST invariant (ISSUE 17): folding the event
+        journal forward must reproduce the router's EXACT fleet books
+        — per-host liveness/cut/drain state and the death / rejoin /
+        handoff / interruption counters. Returns a description of the
+        first divergence, ``None`` when consistent. A truncated
+        journal (events dropped at the bound) refuses to certify."""
+        if self.journal.dropped:
+            return (f"journal truncated: {self.journal.dropped} "
+                    f"events dropped at the bound")
+        folded: Dict[str, Dict[str, bool]] = {}
+        deaths = rejoins = handoffs = interrupted = 0
+        for e in self.journal.events():
+            host, kind = e["host"], e["kind"]
+            st = folded.setdefault(host, {
+                "alive": False, "cut": False, "draining": False})
+            if kind == "host-join":
+                st.update(alive=True, cut=False, draining=False)
+            elif kind == "host-partitioned":
+                st["cut"] = True
+            elif kind == "drain-begin":
+                st["draining"] = True
+            elif kind == "host-death":
+                st["alive"] = False
+                if (e.get("detail") or {}).get("partitioned"):
+                    st["cut"] = True
+                deaths += 1
+            elif kind == "host-restart":
+                st["alive"] = False
+            elif kind == "host-rejoin":
+                st.update(alive=True, cut=False, draining=False)
+                rejoins += 1
+            elif kind == "handoff":
+                handoffs += int((e.get("detail") or {})
+                                .get("streams", 0))
+            elif kind == "handoff-interrupted":
+                interrupted += 1
+        for r in self.replicas:
+            st = folded.get(r.name)
+            if st is None:
+                return f"host {r.name} never joined the journal"
+            actual = {"alive": r.alive, "cut": r.cut,
+                      "draining": r.draining}
+            if st != actual:
+                return (f"host {r.name}: journal folds to {st}, "
+                        f"router books say {actual}")
+        for label, got, want in (
+                ("host-death", deaths, self.host_deaths),
+                ("host-rejoin", rejoins, self.rejoins),
+                ("handoff streams", handoffs, self.handoffs),
+                ("handoff-interrupted", interrupted,
+                 self.partial_handoffs)):
+            if got != want:
+                return (f"{label}: journal folds to {got}, router "
+                        f"counters say {want}")
+        return None
+
+    def flows(self, limit: Optional[int] = None) -> Dict:
+        """The fleet's continuous flow export: per-replica
+        FlowAggregator snapshots merged by aggregation key with
+        per-host attribution (``hubble/flowagg.merge_snapshots``)."""
+        from cilium_tpu.hubble.flowagg import merge_snapshots
+
+        return merge_snapshots(
+            r.loop.flows.snapshot(limit=limit)
+            for r in self.replicas)
+
+    def trace(self, trace_id: str) -> Dict:
+        """The stitched cross-host timeline for one trace id: spans
+        merged across every replica that served the stream, ordered
+        by (causal epoch, timestamp), host-attributed. In-process
+        replicas share the flight recorder, so the fan-out/merge
+        degenerates to one stitch over the shared ring."""
+        return TRACER.stitch(trace_id)
 
     def step_all(self) -> int:
         """One pack cycle on every live replica (the driven face)."""
@@ -544,6 +856,13 @@ class FleetRouter:
             "spilled_streams": self.spilled,
             "handoff_rows_resident": self.handoff_rows_resident,
             "handoff_bytes_avoided": self.handoff_bytes_avoided,
+            "journal": {
+                "events": len(self.journal),
+                "counts": self.journal.counts(),
+                "consistent": self.journal_consistent() is None,
+            },
+            "fleet_burn_rates": self._fleet_burn,
+            "failover_tracked": len(self.failover_samples),
         }
 
 
@@ -586,7 +905,8 @@ class FleetModel:
                  pareto_xm_s: float = 30.0, pareto_alpha: float = 1.3,
                  fault_rules: Optional[Sequence] = None,
                  sample_every: int = 64,
-                 max_replays: int = 4):
+                 max_replays: int = 4,
+                 trace_sample_every: int = 8):
         if hosts < 2:
             raise ValueError("a fleet needs >= 2 hosts")
         self.seed = seed
@@ -618,6 +938,9 @@ class FleetModel:
         self.fault_rules = list(fault_rules or ())
         self.sample_every = max(1, int(sample_every))
         self.max_replays = max(1, int(max_replays))
+        #: every Nth EMITTING stream carries a trace context end to
+        #: end (0 disables) — the stitched-coverage population
+        self.trace_sample_every = max(0, int(trace_sample_every))
         self.rng = random.Random(seed)
         self.violations: List[Dict] = []
         self.latencies: List[float] = []
@@ -638,6 +961,14 @@ class FleetModel:
         #: policy registers compiles > 0, so zero is real evidence
         self.rejoin_warm_restores = 0
         self.survivor_recompiles = 0
+        #: chunk submits made under an active trace context
+        self.traced_chunks = 0
+        #: replayed chunks whose original ticket died traced on a
+        #: closing lease (the stitch-coverage denominator) and the
+        #: subset whose replacement ticket carried the SAME trace id
+        #: at a HIGHER causal epoch (the numerator)
+        self.handoff_replays = 0
+        self.stitched_replays = 0
 
     # -- world ------------------------------------------------------------
     def _build_fleet(self):
@@ -805,8 +1136,24 @@ class FleetModel:
                 t2 = self._replay(router, leases, pool, chunk,
                                   stream)
                 if t2 is not None:
+                    # stitch coverage, measured STRUCTURALLY: a chunk
+                    # that died traced on a closing lease must replay
+                    # under the SAME trace id at a HIGHER causal
+                    # epoch — one timeline across both hosts,
+                    # independent of trace-ring retention
+                    if ticket.error == "lease-closed" \
+                            and ticket.trace_id:
+                        self.handoff_replays += 1
+                        if t2.trace_id == ticket.trace_id \
+                                and t2.epoch > ticket.epoch:
+                            self.stitched_replays += 1
+                            METRICS.inc(FLEET_TRACE_STITCHES)
                     keep.append((t2, chunk, stream, attempt + 1))
                 continue
+            if attempt > 0:
+                # first clean verdict after a replay closes the
+                # stream's failover-latency ledger on the router
+                router.note_failover_verdict(f"vs{stream}")
             lat = ticket.latency
             if lat is not None:
                 self.latencies.append(lat)
@@ -877,8 +1224,19 @@ class FleetModel:
             return
         chunk = pool[(i * 2654435761 + index) % len(pool)]
         sid = f"vs{i}"
+        traced = (self.trace_sample_every > 0
+                  and i % self.trace_sample_every == 0)
         try:
-            ticket = router.submit(sid, lease, chunk.sections)
+            if traced:
+                # deterministic stride: every Nth emitting stream
+                # carries a trace context; the router pins it so
+                # post-handoff replays resume the SAME timeline
+                with TRACER.trace("stream.chunk", stream=sid):
+                    ticket = router.submit(sid, lease,
+                                           chunk.sections)
+                self.traced_chunks += 1
+            else:
+                ticket = router.submit(sid, lease, chunk.sections)
             outstanding.append((ticket, chunk, i, 0))
             self.submissions += 1
         except (LeaseExpired, HostDead):
@@ -970,6 +1328,8 @@ class FleetModel:
 
     def _run_event(self, router, pool, events, leases, outstanding,
                    kind, arg, index) -> None:
+        membership = kind in (_KILL, _REJOIN, _PARTITION, _DRAIN,
+                              _RESTART)
         if kind == _ARRIVE:
             self._arrive(router, leases, arg, events)
         elif kind == _EMIT:
@@ -981,6 +1341,7 @@ class FleetModel:
             before = self._survivor_compile_delta(router)
             died = router.beat()
             if died:
+                membership = True
                 self.survivor_recompiles += \
                     self._survivor_compile_delta(router) - before
                 self._check_conservation(router, index)
@@ -995,6 +1356,14 @@ class FleetModel:
         elif kind == _RESTART:
             router.restart_host(router.replicas[arg].name)
             self._check_conservation(router, index)
+        if membership:
+            # the journal's DST invariant: after EVERY membership
+            # change, folding the event journal must reproduce the
+            # router's exact fleet books
+            msg = router.journal_consistent()
+            if msg is not None:
+                raise Violation(index, "fleet-journal-consistency",
+                                msg)
         self._check(router, index)
 
     # -- the run ----------------------------------------------------------
@@ -1002,6 +1371,21 @@ class FleetModel:
         clock = simclock.VirtualClock(poll=0.001)
         plan = faults.FaultPlan(rules=self.fault_rules,
                                 seed=self.seed)
+        result: Dict = {}
+        # the model owns its trace sampling stride (every Nth
+        # emitting stream), so the flight recorder itself runs
+        # unsampled for the run; restored after — callers (tests,
+        # the DST arm) keep their own tracer state
+        prev_enabled, prev_rate = TRACER.enabled, TRACER.sample_rate
+        TRACER.configure(enabled=True, sample_rate=1.0)
+        try:
+            result = self._run(clock, plan)
+        finally:
+            TRACER.configure(enabled=prev_enabled,
+                             sample_rate=prev_rate)
+        return result
+
+    def _run(self, clock, plan) -> Dict:
         result: Dict = {}
         with simclock.use(clock):
             router, pool = self._build_fleet()
@@ -1089,6 +1473,9 @@ class FleetModel:
         shed_total = self.shed_submits + self.shed_connects
         denom = max(1, self.submissions + shed_total)
         explained = unexplained = served = packs = 0
+        flow_records = flows_aggregated = 0
+        flow_keys = flow_overflow = 0
+        obs_seconds = router.obs_seconds
         for r in router.replicas:
             st = r.loop.status()
             prov = st.get("provenance", {})
@@ -1096,7 +1483,20 @@ class FleetModel:
             unexplained += prov.get("records_unexplained", 0)
             served += st["served_records"]
             packs += st["packs"]
+            fl = r.loop.flows
+            flow_records += fl.records
+            flows_aggregated += fl.aggregated
+            flow_keys += fl.key_count()
+            flow_overflow += fl.overflow
+            obs_seconds += r.loop.obs_seconds
         fleet = router.status()
+        p99_burn = (fleet.get("fleet_burn_rates") or {}).get(
+            "serve-p99") or {}
+        wkey = min(p99_burn, key=lambda w: int(w.rstrip("s"))) \
+            if p99_burn else None
+        fo = sorted(router.failover_samples)
+        failover_p99 = fo[min(len(fo) - 1, int(0.99 * len(fo)))] \
+            if fo else 0.0
         return {
             "seed": self.seed,
             "streams": self.streams,
@@ -1131,6 +1531,25 @@ class FleetModel:
             "records_unexplained": unexplained,
             "explain_coverage": round(
                 explained / max(1, explained + unexplained), 6),
+            "traced_chunks": self.traced_chunks,
+            "handoff_replays": self.handoff_replays,
+            "stitched_replays": self.stitched_replays,
+            "stitch_coverage": round(
+                self.stitched_replays / self.handoff_replays, 6)
+            if self.handoff_replays else 1.0,
+            "flow_records": flow_records,
+            "flows_aggregated": flows_aggregated,
+            "flow_keys": flow_keys,
+            "flow_overflow": flow_overflow,
+            "journal_events": fleet["journal"]["events"],
+            "journal_consistent": fleet["journal"]["consistent"],
+            "burn_worst": p99_burn[wkey]["worst"] if wkey else 0.0,
+            "burn_weighted": p99_burn[wkey]["weighted"]
+            if wkey else 0.0,
+            "failover_p99_ms": round(failover_p99 * 1e3, 3),
+            "failover_tracked": len(router.failover_samples),
+            "obs_seconds": round(obs_seconds, 6),
+            "obs_budget_pct": 2.0,
             "p50_ms": round(pct(0.50) * 1e3, 3),
             "p99_ms": round(pct(0.99) * 1e3, 3),
             "p99_unloaded_ms": round(base_p99 * 1e3, 3),
@@ -1208,6 +1627,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--no-p99-gate", action="store_true",
                     help="smoke mode: skip the p99-vs-baseline gate "
                          "(tiny runs are all fixed overhead)")
+    ap.add_argument("--min-handoffs", type=int, default=400,
+                    help="gate floor on handed-off streams (the "
+                         "stitch-coverage population; smoke runs "
+                         "set 1)")
+    ap.add_argument("--trace-sample-every", type=int, default=8,
+                    help="every Nth emitting stream carries a trace "
+                         "context end to end (0 disables)")
     ap.add_argument("--out", default="BENCH_FLEET_SERVE_r08.jsonl")
     args = ap.parse_args(argv)
 
@@ -1230,12 +1656,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         heartbeat_interval_s=args.heartbeat_interval_s,
         suspicion_ttl_s=args.suspicion_ttl_s,
         spill_headroom=args.spill_headroom,
-        fault_rules=rules)
+        fault_rules=rules,
+        trace_sample_every=args.trace_sample_every)
     result = model.run()
     wall_s = simclock.perf() - t0
     result["wall_s"] = round(wall_s, 3)
     result["speedup_vs_real_time"] = round(
         result["simulated_s"] / max(wall_s, 1e-9), 1)
+    result["obs_overhead_pct"] = round(
+        100.0 * result["obs_seconds"] / max(wall_s, 1e-9), 3)
 
     base_ms = _single_host_baseline_ms()
     result["single_host_p99_ms"] = base_ms
@@ -1254,7 +1683,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "shed_rate": result["shed_rate"] <= args.max_shed_rate,
         "deaths": result["host_deaths"] >= 1,
         "rejoins": result["rejoins"] >= 1,
-        "handoffs": result["handoffs"] >= 1,
+        "handoffs": result["handoffs"] >= max(1, args.min_handoffs),
+        # fleet observability plane (ISSUE 17): handoff-replayed
+        # chunks keep ONE causally-ordered trace (≥99%), flows export
+        # continuously, the event journal folds to the router's exact
+        # books, and the whole plane stays under its ≤2% wall budget
+        "stitch_coverage": result["stitch_coverage"] >= 0.99,
+        "flow_export": result["flows_aggregated"] > 0,
+        "journal_consistent": bool(result["journal_consistent"]),
+        "obs_overhead": (result["obs_overhead_pct"]
+                         <= result["obs_budget_pct"]),
         # the zero-recompile swap path: survivors compiled nothing
         # during any handoff, and every warm rejoin came entirely
         # from the shared policy/bank artifact store (a cold build
@@ -1299,7 +1737,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
           f"{result['shed_rate']}, replays {result['replays']}, "
           f"unrecovered {result['unrecovered']}; "
           f"{result['rejoin_warm_restores']} warm restores / "
-          f"{result['rejoin_compiles']} rejoin compiles; simulated "
+          f"{result['rejoin_compiles']} rejoin compiles; stitch "
+          f"coverage {result['stitch_coverage']} over "
+          f"{result['handoff_replays']} handoff replays "
+          f"({result['traced_chunks']} traced chunks), "
+          f"{result['flows_aggregated']} flows aggregated into "
+          f"{result['flow_keys']} keys "
+          f"(overflow {result['flow_overflow']}), journal "
+          f"{result['journal_events']} events "
+          f"{'consistent' if result['journal_consistent'] else 'INCONSISTENT'}, "
+          f"burn worst/weighted {result['burn_worst']}/"
+          f"{result['burn_weighted']}, failover p99 "
+          f"{result['failover_p99_ms']}ms "
+          f"({result['failover_tracked']} tracked), obs overhead "
+          f"{result['obs_overhead_pct']}%; simulated "
           f"{result['simulated_s']:.0f}s in {wall_s:.1f}s wall "
           f"({result['speedup_vs_real_time']}x); gates "
           f"{'OK' if ok else 'FAILED ' + str(result['gates'])}",

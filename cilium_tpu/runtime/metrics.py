@@ -218,6 +218,31 @@ FLEET_SPILLED_STREAMS = "cilium_tpu_fleet_spilled_streams_total"
 #: digest the fleet-coherent shed/spill decision reads
 FLEET_HOST_OCCUPANCY = "cilium_tpu_fleet_host_occupancy"
 
+# -- fleet observability plane (runtime/fleetserve.py + hubble/
+# flowagg.py): cross-host trace stitching, continuous flow export,
+# fleet SLO roll-ups, and the fleet event journal.
+#: gauge: fleet-wide burn-rate roll-up over the per-replica SLO
+#: trackers, by slo, trailing window, and view (``worst`` = the worst
+#: single host; ``weighted`` = fleet-weighted by request volume)
+FLEET_SLO_BURN_RATE = "cilium_tpu_fleet_slo_burn_rate"
+#: failover latency per handoff, by stage: ``death-to-regrant``
+#: (death declared → lease re-granted on a survivor),
+#: ``regrant-to-verdict`` (re-grant → first verdict after replay),
+#: ``death-to-verdict`` (the end-to-end client-visible gap)
+FLEET_FAILOVER_SECONDS = "cilium_tpu_fleet_failover_seconds"
+#: fleet event-journal entries appended, by kind (the journal's
+#: catalog is machine-checked against OBSERVABILITY.md)
+FLEET_JOURNAL_EVENTS = "cilium_tpu_fleet_journal_events_total"
+#: handoff-replayed chunks that resolved with a STITCHED trace — one
+#: trace id spanning spans from both the dead host and the survivor
+FLEET_TRACE_STITCHES = "cilium_tpu_fleet_trace_stitches_total"
+#: provenance-stamped flow records fed into the per-host Hubble
+#: FlowAggregator off the serve resolve path, by host
+HUBBLE_FLOW_RECORDS = "cilium_tpu_hubble_flow_records_total"
+#: flow aggregation keys dropped at the aggregator's bound (the
+#: overflow counter that keeps the export honest about sampling)
+HUBBLE_FLOW_OVERFLOW = "cilium_tpu_hubble_flow_overflow_total"
+
 # -- megakernel scan autotuner (engine/megakernel.py): dense-DFA vs
 # bitset-NFA measured per bank shape at engine staging
 #: autotuner decisions, by winning impl and field (cache misses only —
@@ -796,6 +821,23 @@ METRICS.describe(FLEET_SPILLED_STREAMS,
                  "owner for headroom")
 METRICS.describe(FLEET_HOST_OCCUPANCY,
                  "leased-slot occupancy per fleet host, by host")
+METRICS.describe(FLEET_SLO_BURN_RATE,
+                 "fleet burn-rate roll-up, by slo, window, and view "
+                 "(worst single host / fleet-weighted)")
+METRICS.describe(FLEET_FAILOVER_SECONDS,
+                 "failover latency per handoff, by stage (death-to-"
+                 "regrant / regrant-to-verdict / death-to-verdict)")
+METRICS.describe(FLEET_JOURNAL_EVENTS,
+                 "fleet event-journal entries appended, by kind")
+METRICS.describe(FLEET_TRACE_STITCHES,
+                 "handoff-replayed chunks resolved under a stitched "
+                 "cross-host trace")
+METRICS.describe(HUBBLE_FLOW_RECORDS,
+                 "flow records fed into the per-host Hubble flow "
+                 "aggregator, by host")
+METRICS.describe(HUBBLE_FLOW_OVERFLOW,
+                 "flow aggregation keys dropped at the aggregator's "
+                 "key bound")
 
 
 class SpanStat:

@@ -131,9 +131,9 @@ class ChunkTicket:
     ring serves with provenance on."""
 
     __slots__ = ("ev", "n", "t_submit", "t_done", "verdicts", "error",
-                 "trace_id", "prov", "sample_flows")
+                 "trace_id", "prov", "sample_flows", "epoch")
 
-    def __init__(self, n: int, trace_id: str = ""):
+    def __init__(self, n: int, trace_id: str = "", epoch: int = 0):
         self.ev = simclock.event()
         self.n = n
         self.t_submit = simclock.now()
@@ -141,6 +141,10 @@ class ChunkTicket:
         self.verdicts: Optional[np.ndarray] = None
         self.error: Optional[str] = None
         self.trace_id = trace_id
+        #: the trace's causal epoch at submit (bumped per handoff) —
+        #: rides the ticket so the pack thread's span sorts AFTER the
+        #: dead host's spans in the stitched timeline
+        self.epoch = int(epoch)
         self.prov = None
         self.sample_flows = None
 
@@ -208,6 +212,12 @@ class ServeLoop:
         #: identity; a standalone loop is anonymous) — rides every
         #: explain entry so a pack cycle is scoped (host, cycle)
         self.host_id = str(host_id)
+        #: serve-plane metric labels: host-scoped for fleet replicas
+        #: so N in-process loops land on DISTINCT series instead of
+        #: colliding on one unlabeled family (ISSUE 17 satellite);
+        #: standalone loops keep the pre-fleet unlabeled series
+        self._host_labels = ({"host": self.host_id}
+                             if self.host_id else None)
         #: fleet replicas pass a per-replica store so a trace resolves
         #: against the replica that served it; standalone loops share
         #: the process-global EXPLAIN (the pre-fleet contract)
@@ -219,9 +229,18 @@ class ServeLoop:
         self.slo = (SLOTracker.from_config(slo) if slo is not None
                     else SLOTracker.from_config(
                         getattr(root_cfg, "slo", None)))
+        if self.slo is not None and self.host_id:
+            self.slo.host = self.host_id
+        from cilium_tpu.hubble.flowagg import FlowAggregator
+
+        #: continuous Hubble flow export (ISSUE 17): per-host bounded
+        #: aggregation fed from the resolve path — ids on the hot
+        #: path, sampled flows reused from the explain feed
+        self.flows = FlowAggregator(host=self.host_id)
         self.ring = VerdictRing(engine, capacity, loader=loader,
                                 widths=widths, memo=memo,
-                                provenance=self.provenance)
+                                provenance=self.provenance,
+                                host=self.host_id)
         self.lease_ttl_s = float(lease_ttl_s)
         self.pack_interval_s = float(pack_interval_s)
         #: per-slot pending-chunk bound: a producer outrunning the
@@ -256,6 +275,10 @@ class ServeLoop:
         #: a provenance bundle vs not (the ≥0.999 serve-soak gate)
         self.records_explained = 0
         self.records_unexplained = 0
+        #: wall seconds spent on observability bookkeeping (flow
+        #: aggregation, trace spans, explain sampling) — the fleet
+        #: lane's ≤2% obs-budget numerator
+        self.obs_seconds = 0.0
 
     @classmethod
     def from_config(cls, loader, cfg, gate=None,
@@ -348,9 +371,10 @@ class ServeLoop:
             heapq.heappush(self._expiry_heap,
                            (lease.expires_at, stream_id))
             self.grants += 1
-            METRICS.inc(SERVE_LEASE_GRANTS)
+            METRICS.inc(SERVE_LEASE_GRANTS, labels=self._host_labels)
             METRICS.set_gauge(SERVE_RING_OCCUPANCY,
-                              float(len(self._leases)))
+                              float(len(self._leases)),
+                              labels=self._host_labels)
             return lease
 
     def _release_locked(self, lease: SlotLease, how: str) -> None:
@@ -368,14 +392,29 @@ class ServeLoop:
         for _idx, done, _epoch in dropped:
             if done is not None:
                 done.resolve(None, error=f"lease-{how}")
+                tid = getattr(done, "trace_id", "")
+                if tid:
+                    # the dropped chunk's host-A attribution: the
+                    # abandon marker is what the stitched timeline
+                    # shows between the dead host's last span and
+                    # the survivor's replay (ISSUE 17)
+                    from cilium_tpu.runtime.tracing import TRACER
+
+                    TRACER.event_remote(
+                        tid, "serve.abandon", host=self.host_id,
+                        epoch=getattr(done, "epoch", 0),
+                        error=f"lease-{how}")
         if how == "expired":
             self.expiries += 1
-            METRICS.inc(SERVE_LEASE_EXPIRIES)
+            METRICS.inc(SERVE_LEASE_EXPIRIES,
+                        labels=self._host_labels)
         else:
             self.releases += 1
-            METRICS.inc(SERVE_LEASE_RELEASES)
+            METRICS.inc(SERVE_LEASE_RELEASES,
+                        labels=self._host_labels)
         METRICS.set_gauge(SERVE_RING_OCCUPANCY,
-                          float(len(self._leases)))
+                          float(len(self._leases)),
+                          labels=self._host_labels)
 
     def disconnect(self, lease: SlotLease) -> None:
         """Clean stream end: release the slot (pending unpacked
@@ -414,12 +453,16 @@ class ServeLoop:
         # their trace id (flows/log lines/explain entries join on it)
         from cilium_tpu.runtime.tracing import TRACER
 
-        ticket = ChunkTicket(len(rec),
-                             trace_id=TRACER.current_trace_id())
+        ctx = TRACER.current()
+        ticket = ChunkTicket(
+            len(rec),
+            trace_id=ctx.trace_id if ctx is not None else "",
+            epoch=getattr(ctx, "epoch", 0) if ctx is not None else 0)
         if ticket.trace_id and self.provenance \
                 and self.explain_sample > 0:
             # sampled flows for the explain plane: only TRACED chunks
             # pay the (bounded) host reconstruction
+            t_obs = simclock.perf()
             try:
                 from cilium_tpu.ingest.binary import records_to_flows_l7
 
@@ -429,6 +472,7 @@ class ServeLoop:
                     gen=(gen[:k] if gen is not None else None))
             except Exception:  # noqa: BLE001 — explain is advisory;
                 ticket.sample_flows = None  # never fail the chunk
+            self.obs_seconds += max(0.0, simclock.perf() - t_obs)
         # ring.submit takes its own lock; encoding outside ours keeps
         # lease ops responsive while a big chunk featurizes
         try:
@@ -491,7 +535,7 @@ class ServeLoop:
             verdicts = np.asarray(dev)[:n].astype(np.int32)
         ticket.resolve(verdicts, prov=prov)
         lat = max(0.0, simclock.now() - ticket.t_submit)
-        METRICS.observe(SERVE_LATENCY, lat)
+        METRICS.observe(SERVE_LATENCY, lat, labels=self._host_labels)
         if self.slo is not None:
             self.slo.observe_latency(lat)
             self.slo.observe_request(shed=False)
@@ -499,6 +543,19 @@ class ServeLoop:
             self.records_explained += n
         else:
             self.records_unexplained += n
+        self.flows.note_served(n)
+        if ticket.trace_id:
+            # the serving host's span, appended BY id: the pack
+            # thread holds no contextvar for the submitter's trace,
+            # and after a handoff THIS host is not the one that
+            # started the trace — host + epoch are what the stitched
+            # timeline orders by (ISSUE 17)
+            from cilium_tpu.runtime.tracing import TRACER
+
+            TRACER.record_remote(
+                ticket.trace_id, "serve.chunk", phase="device-dispatch",
+                t0=ticket.t_submit, dur=lat, host=self.host_id,
+                epoch=ticket.epoch, records=n)
         if ticket.trace_id and ticket.sample_flows and prov is not None:
             from cilium_tpu.runtime.explain import build_entries
 
@@ -513,6 +570,7 @@ class ServeLoop:
                 host_id=self.host_id,
                 sample=len(ticket.sample_flows))
             self.explain.record(ticket.trace_id, entries)
+            self.flows.observe_entries(entries)
             LOG.debug("serve chunk explained", extra={"fields": {
                 "trace_id": ticket.trace_id, "records": n,
                 "sampled": len(entries)}})
@@ -535,10 +593,12 @@ class ServeLoop:
             # per-pack-cycle SLO telemetry: dispatch wall, pack size
             # (SERVE_PACK_RECORDS rides ring.pack), slot occupancy
             METRICS.observe(SERVE_PACK_DISPATCH_SECONDS,
-                            max(0.0, simclock.perf() - t0))
+                            max(0.0, simclock.perf() - t0),
+                            labels=self._host_labels)
             with self._lock:
                 occ = float(len(self._leases))
-            METRICS.observe(SERVE_PACK_OCCUPANCY, occ)
+            METRICS.observe(SERVE_PACK_OCCUPANCY, occ,
+                            labels=self._host_labels)
         for _slot, n, ticket, dev in results:
             if ticket is None:
                 continue
@@ -684,6 +744,12 @@ class ServeLoop:
                 "explain_coverage": round(
                     self.records_explained / served, 6),
                 "explain_entries": len(self.explain),
+            },
+            "flows": {
+                "records": self.flows.records,
+                "aggregated": self.flows.aggregated,
+                "overflow": self.flows.overflow,
+                "keys": self.flows.key_count(),
             },
         }
         if self.slo is not None:

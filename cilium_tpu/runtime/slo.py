@@ -74,7 +74,13 @@ class SLOTracker:
 
     def __init__(self, serve_p99_ms: float = 50.0,
                  shed_rate: float = 1e-3,
-                 windows_s: Tuple[float, ...] = (300.0, 3600.0)):
+                 windows_s: Tuple[float, ...] = (300.0, 3600.0),
+                 host: str = ""):
+        #: which host this tracker burns FOR: fleet replicas set it so
+        #: per-host gauges stay distinct series in the shared
+        #: process-global registry (ISSUE 17 satellite); a standalone
+        #: tracker publishes the pre-fleet unlabeled series
+        self.host = str(host)
         self.serve_p99_s = float(serve_p99_ms) / 1e3
         #: the latency SLO's error budget: p99 ⇒ 1% may exceed
         self.latency_budget = 0.01
@@ -141,10 +147,23 @@ class SLOTracker:
         rates = self.burn_rates()
         for slo, per_window in rates.items():
             for window, rate in per_window.items():
-                METRICS.set_gauge(SLO_BURN_RATE, rate,
-                                  labels={"slo": slo,
-                                          "window": window})
+                labels = {"slo": slo, "window": window}
+                if self.host:
+                    labels["host"] = self.host
+                METRICS.set_gauge(SLO_BURN_RATE, rate, labels=labels)
         return rates
+
+    def window_totals(self) -> Dict[str, int]:
+        """Requests observed per trailing window — the weights the
+        fleet-weighted burn-rate roll-up multiplies each host's rate
+        by (a quiet host must not dilute a burning one equally)."""
+        now = simclock.now()
+        out: Dict[str, int] = {}
+        with self._lock:
+            for ws, w in self._shed.items():
+                _bad, total = w.fraction(now)
+                out[self._label(ws)] = total
+        return out
 
     def status(self) -> Dict[str, object]:
         return {

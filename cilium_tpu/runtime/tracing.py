@@ -90,14 +90,20 @@ class TraceContext:
     (and thread handoffs, explicitly) — spans land in the tracer's
     ring, not here, so contexts are cheap to drop."""
 
-    __slots__ = ("trace_id", "name", "t0", "attrs", "_next_span")
+    __slots__ = ("trace_id", "name", "t0", "attrs", "_next_span",
+                 "epoch")
 
     def __init__(self, trace_id: str, name: str,
-                 attrs: Optional[Dict] = None):
+                 attrs: Optional[Dict] = None, epoch: int = 0):
         self.trace_id = trace_id
         self.name = name
         self.t0 = simclock.wall()
         self.attrs = attrs or {}
+        #: causal epoch for cross-host stitching (ISSUE 17): 0 on the
+        #: original host; each lease handoff bumps it, so a stitched
+        #: timeline orders by (epoch, ts) even when the survivor's
+        #: clock reads earlier than the dead host's last span
+        self.epoch = int(epoch)
         self._next_span = [0]  # list: shared mutable counter, no lock
         # (span ids only need uniqueness per trace; a rare duplicate
         # under a race costs nothing — ids are for display grouping)
@@ -188,6 +194,8 @@ class Tracer:
         #: under bursts, unlike a per-ingress coin flip
         self._ingress = 0
         self.dropped = 0  # records evicted by the ring bound
+        #: span ids for by-id (contextvar-less) remote records
+        self._remote_span = 1 << 20
 
     # -- configuration ----------------------------------------------------
     def configure(self, enabled: Optional[bool] = None,
@@ -299,6 +307,8 @@ class Tracer:
         recs = [{"trace_id": m.trace_id, "span_id": m.next_span_id(),
                  "name": name, "phase": phase, "ts": round(t0, 6),
                  "dur": round(max(0.0, dur), 9),
+                 **({"epoch": m.epoch}
+                    if getattr(m, "epoch", 0) else {}),
                  **({"attrs": attrs} if attrs else {})}
                 for m in ctx.members()]
         with self._lock:
@@ -306,6 +316,84 @@ class Tracer:
             self._ring.extend(recs)
         METRICS.inc(TRACE_SPANS, len(recs),
                     labels={"phase": phase or "root"})
+
+    # -- cross-host stitching (ISSUE 17) ----------------------------------
+    def _append_remote(self, rec: Dict, phase: str) -> None:
+        with self._lock:
+            rec["span_id"] = self._remote_span
+            self._remote_span += 1
+            self._note_evictions(1)
+            self._ring.append(rec)
+        METRICS.inc(TRACE_SPANS, labels={"phase": phase or "root"})
+
+    def record_remote(self, trace_id: str, name: str, phase: str = "",
+                      t0: Optional[float] = None, dur: float = 0.0,
+                      host: str = "", epoch: int = 0,
+                      parent: Optional[int] = None, **attrs) -> None:
+        """Append a span to a trace BY ID — for code that holds no
+        contextvar for the trace: the pack thread resolving another
+        stream's ticket, or the router minting handoff spans for a
+        dead host's streams. ``host``/``epoch``/``parent`` land as
+        record keys only when set, so pre-fleet record shapes are
+        unchanged."""
+        if not self.enabled or not trace_id:
+            return
+        ts = simclock.wall() if t0 is None else t0
+        rec: Dict = {"trace_id": trace_id, "name": name,
+                     "phase": phase, "ts": round(ts, 6),
+                     "dur": round(max(0.0, dur), 9)}
+        if host:
+            rec["host"] = host
+        if epoch:
+            rec["epoch"] = int(epoch)
+        if parent is not None:
+            rec["parent"] = int(parent)
+        if attrs:
+            rec["attrs"] = attrs
+        self._append_remote(rec, phase)
+
+    def event_remote(self, trace_id: str, name: str, host: str = "",
+                     epoch: int = 0, **attrs) -> None:
+        """Point-in-time annotation appended BY trace id (the
+        handoff/abandon markers that stitch a failover timeline)."""
+        if not self.enabled or not trace_id:
+            return
+        rec: Dict = {"trace_id": trace_id, "name": name,
+                     "event": True, "ts": round(simclock.wall(), 6)}
+        if host:
+            rec["host"] = host
+        if epoch:
+            rec["epoch"] = int(epoch)
+        if attrs:
+            rec["attrs"] = attrs
+        self._append_remote(rec, "")
+
+    def stitch(self, trace_id: str) -> Dict:
+        """One stream's causally-ordered cross-host timeline: every
+        record for the trace, ordered by (causal epoch, timestamp) —
+        NOT timestamp alone, because a survivor's span can carry an
+        earlier wall reading than the dead host's last span — plus
+        the distinct hosts that contributed and whether the timeline
+        actually crossed a handoff (``stitched``)."""
+        recs = self.dump(trace_id=trace_id)
+        recs.sort(key=lambda r: (r.get("epoch", 0), r["ts"]))
+        hosts: List[str] = []
+        for r in recs:
+            h = r.get("host") or (r.get("attrs") or {}).get("host")
+            if h and h not in hosts:
+                hosts.append(h)
+        epochs = sorted({int(r.get("epoch", 0)) for r in recs})
+        handoff = any(r.get("event") and r["name"] == "fleet.handoff"
+                      for r in recs)
+        return {
+            "trace_id": trace_id,
+            "records": recs,
+            "hosts": hosts,
+            "epochs": epochs,
+            "stitched": bool(handoff or len(hosts) > 1
+                             or any(epochs[1:])
+                             or (epochs and epochs[0] > 0)),
+        }
 
     def _note_evictions(self, incoming: int) -> None:
         room = self._ring.maxlen - len(self._ring)

@@ -720,3 +720,65 @@ def test_rejoin_racing_the_handoff_of_its_own_old_leases(tmp_path):
         # ...and the artifact was published before the drain finished
         assert store.fetch(s.rebuilt[0]) is not None
         reg.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 17: fleet observability boundaries — a traced stream whose
+# host is declared dead at EXACTLY its lease-expiry tick still
+# stitches to ONE trace id with a bumped causal epoch, and the fleet
+# event journal folds to the router's books through the coincidence.
+
+
+def test_trace_stitches_when_death_lands_on_the_exact_expiry_tick(
+        tmp_path):
+    """Host death at EXACTLY the traced lease's expiry tick: the
+    abandoned chunk resolves as lease-closed (not silently expired),
+    the replay adopts the SAME trace id at a bumped causal epoch, and
+    the stitched timeline orders epoch 0 strictly before epoch 1 with
+    both hosts attributed — the kill → abandon → re-grant → replay
+    chain is one trace even when the TTL and the death coincide."""
+    from cilium_tpu.runtime.tracing import TRACER
+
+    clk = VirtualClock()
+    with simclock.use(clk):
+        router, loader, sections = _fleet_world(tmp_path, ttl=10.0)
+        prev_enabled, prev_rate = TRACER.enabled, TRACER.sample_rate
+        TRACER.configure(enabled=True, sample_rate=1.0)
+        try:
+            host, lease = router.connect("tb-0")
+            with TRACER.trace("stream.chunk", stream="tb-0") as ctx:
+                ticket = router.submit("tb-0", lease, sections)
+            tid = ctx.trace_id
+            assert ticket.trace_id == tid and ticket.epoch == 0
+            # advance to EXACTLY the expiry tick, then declare the
+            # host dead with no intervening pack — the race, pinned
+            clk.advance_to(lease.expires_at)
+            assert lease.expired
+            router.kill(host)
+            assert ticket.done and ticket.error == "lease-closed"
+            # the replay adopts the SAME id at a bumped epoch
+            host2, lease2 = router.connect("tb-0", resume=True)
+            assert host2 != host
+            t2 = router.submit("tb-0", lease2, sections)
+            assert t2.trace_id == tid
+            assert t2.epoch > ticket.epoch
+            router.step_all()
+            assert t2.done and t2.error is None
+            stitched = router.trace(tid)
+            assert stitched["stitched"] is True
+            assert stitched["epochs"] == [0, 1]
+            assert host in stitched["hosts"]
+            assert host2 in stitched["hosts"]
+            names = [r["name"] for r in stitched["records"]]
+            assert "fleet.handoff" in names
+            # epoch ordering is strict even though the wall stamps of
+            # both sides share the exact same virtual tick
+            epochs = [r.get("epoch", 0) for r in stitched["records"]]
+            assert epochs == sorted(epochs)
+            # the journal folds to the router's books through the
+            # expiry/death coincidence
+            assert router.journal_consistent() is None
+            assert router.conservation_violation() is None
+        finally:
+            TRACER.configure(enabled=prev_enabled,
+                             sample_rate=prev_rate)

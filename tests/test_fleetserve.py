@@ -230,6 +230,147 @@ def test_fleet_full_scale(tmp_path):
     assert rc == 0
 
 
+# ------------------------------------------- cross-host trace stitching
+def test_trace_stitches_across_host_death(tmp_path):
+    """ISSUE 17 tentpole: a traced stream killed mid-chunk on host A
+    and replayed on host B keeps ONE trace id — spans from BOTH
+    hosts, the `fleet.handoff` event between them, causally ordered
+    by the bumped epoch."""
+    clk = VirtualClock()
+    with simclock.use(clk):
+        router, _loader, sections = _fleet_world(tmp_path)
+        TRACER.configure(enabled=True, sample_rate=1.0)
+        TRACER.clear()
+        host_a, lease = router.connect("st0")
+        with TRACER.trace("stream.chunk") as ctx:
+            ticket = router.submit("st0", lease, sections)
+            tid = ctx.trace_id
+        assert ticket.trace_id == tid and ticket.epoch == 0
+        # host A dies with the chunk IN FLIGHT: abandoned exactly
+        # once, resolved as the typed lease-closed error
+        router.kill(host_a)
+        assert ticket.done and ticket.error == "lease-closed"
+        # the client replay: reconnect-with-resume + resubmit with NO
+        # active trace context — the router stitches the stored one
+        # (same id, bumped epoch) onto the replayed chunk
+        host_b, lease2 = router.connect("st0", resume=True)
+        assert host_b != host_a
+        t2 = router.submit("st0", lease2, sections)
+        assert t2.trace_id == tid
+        assert t2.epoch > ticket.epoch
+        clk.advance(0.02)
+        router.step_all()
+        assert t2.done and t2.error is None
+        stitched = router.trace(tid)
+        assert stitched["stitched"] is True
+        assert host_a in stitched["hosts"]
+        assert host_b in stitched["hosts"]
+        assert stitched["epochs"] == [0, 1]
+        names = [r["name"] for r in stitched["records"]]
+        assert "fleet.handoff" in names
+        assert "serve.abandon" in names
+        # causal order: every epoch-0 record precedes every epoch-1
+        # record, regardless of wall readings
+        epochs = [r.get("epoch", 0) for r in stitched["records"]]
+        assert epochs == sorted(epochs)
+        # the explain plane links the stitched timeline
+        out = router.explain(tid)
+        assert out["found"] is True
+        assert out["trace"]["stitched"] is True
+        assert set(out["trace"]["hosts"]) >= {host_a, host_b}
+
+
+# ------------------------------------------- the fleet event journal
+def test_journal_folds_to_router_books(tmp_path):
+    """The journal's DST invariant at test scale: after a kill, a
+    partition run down by suspicion, a drain/restart, and three warm
+    rejoins, folding the event journal reproduces the router's exact
+    fleet books."""
+    clk = VirtualClock()
+    with simclock.use(clk):
+        router, _loader, _sections = _fleet_world(tmp_path,
+                                                  capacity=64)
+        for k in range(12):
+            router.connect(f"j{k}")
+        a, b, c = (router.replicas[i].name for i in range(3))
+        assert router.journal_consistent() is None
+        router.kill(a)
+        assert router.journal_consistent() is None
+        router.partition(b)
+        for _ in range(4):
+            clk.advance(1.1)
+            router.beat()
+        assert router.journal_consistent() is None
+        router.begin_drain(c)
+        router.restart_host(c)
+        router.rejoin(a)
+        router.rejoin(b)
+        router.rejoin(c)
+        msg = router.journal_consistent()
+        assert msg is None, msg
+        st = router.status()
+        assert st["journal"]["consistent"] is True
+        counts = st["journal"]["counts"]
+        assert counts.get("host-death", 0) >= 2
+        assert counts.get("host-rejoin", 0) == 3
+        assert counts.get("drain-begin", 0) == 1
+        assert counts.get("host-restart", 0) == 1
+
+
+# ------------------------------------------- continuous flow export
+def test_flow_export_merges_hosts_and_round_trips_serde(tmp_path):
+    """The flow aggregator feeds off the explain plane's sampled
+    entries, the router merge keeps host attribution, and the JSONL
+    export parses straight back through the hubble serde."""
+    from cilium_tpu.ingest.hubble import read_jsonl
+
+    clk = VirtualClock()
+    with simclock.use(clk):
+        router, _loader, sections = _fleet_world(tmp_path)
+        TRACER.configure(enabled=True, sample_rate=1.0)
+        host, lease = router.connect("fx0")
+        with TRACER.trace("stream.chunk"):
+            t = router.submit("fx0", lease, sections)
+        clk.advance(0.02)
+        router.step_all()
+        assert t.done and t.error is None
+        merged = router.flows()
+        assert merged["records"] > 0
+        assert merged["aggregated"] > 0
+        assert merged["flows"], "no aggregated keys"
+        assert merged["flows"][0]["hosts"], \
+            "merged row lost its host attribution"
+        replica = next(r for r in router.replicas if r.name == host)
+        path = str(tmp_path / "flows.jsonl")
+        n = replica.loop.flows.export_jsonl(path)
+        assert n > 0
+        assert len(list(read_jsonl(path))) == n, \
+            "export did not round-trip flow_from_dict"
+
+
+# ------------------------------------------- host-labeled series (S1)
+def test_serve_metrics_are_host_labeled_per_replica(tmp_path):
+    """Regression pin: in-process fleet replicas must not collide on
+    one unlabeled series — the serve-plane families carry each
+    replica's host label."""
+    from cilium_tpu.runtime.metrics import SERVE_LEASE_GRANTS
+
+    clk = VirtualClock()
+    with simclock.use(clk):
+        router, _loader, _sections = _fleet_world(tmp_path,
+                                                  capacity=64)
+        r0, r1 = router.replicas[0], router.replicas[1]
+        g0 = METRICS.get(SERVE_LEASE_GRANTS, labels={"host": r0.name})
+        g1 = METRICS.get(SERVE_LEASE_GRANTS, labels={"host": r1.name})
+        r0.loop.connect("hl0")
+        r1.loop.connect("hl1")
+        r1.loop.connect("hl2")
+        assert METRICS.get(SERVE_LEASE_GRANTS,
+                           labels={"host": r0.name}) == g0 + 1
+        assert METRICS.get(SERVE_LEASE_GRANTS,
+                           labels={"host": r1.name}) == g1 + 2
+
+
 def test_submit_after_silent_death_is_typed_resume(tmp_path):
     clk = VirtualClock()
     with simclock.use(clk):

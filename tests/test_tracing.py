@@ -524,3 +524,69 @@ def test_global_registry_exposition_is_clean():
     METRICS.inc("cilium_tpu_selftest_total")
     errs = lint_exposition(METRICS.expose())
     assert errs == [], errs
+
+
+# ---------------------------------------------------------------------------
+# cross-host stitching primitives (ISSUE 17): by-id remote records
+# and the (epoch, ts)-ordered stitched timeline
+
+
+def test_record_remote_and_event_remote_append_by_id():
+    tr = Tracer()
+    tid = "t" * TRACE_ID_CHARS
+    tr.record_remote(tid, "serve.chunk", phase=PHASE_DEVICE, t0=1.0,
+                     dur=0.5, host="hostA", epoch=1, records=8)
+    tr.event_remote(tid, "fleet.handoff", host="hostA", epoch=2,
+                    stream="vs0")
+    span, ev = tr.dump(trace_id=tid)
+    assert span["host"] == "hostA" and span["epoch"] == 1
+    assert span["dur"] == 0.5 and span["attrs"]["records"] == 8
+    assert ev["event"] is True and ev["epoch"] == 2
+    assert span["span_id"] != ev["span_id"]
+    # disabled recorder / empty trace id: both are no-ops
+    tr.configure(enabled=False)
+    tr.record_remote("x" * TRACE_ID_CHARS, "n")
+    tr.configure(enabled=True)
+    tr.record_remote("", "n")
+    tr.event_remote("", "n")
+    assert len(tr.dump()) == 2
+
+
+def test_remote_records_keep_pre_fleet_shape_when_unset():
+    """host/epoch/parent/attrs land as record keys only when set —
+    pre-fleet consumers of the span shape see no new fields."""
+    tr = Tracer()
+    tr.record_remote("a" * TRACE_ID_CHARS, "serve.chunk", t0=0.0)
+    (rec,) = tr.dump()
+    for absent in ("host", "epoch", "parent", "attrs"):
+        assert absent not in rec
+
+
+def test_stitch_orders_by_epoch_then_ts_and_attributes_hosts():
+    """The survivor's span can carry an EARLIER wall reading than the
+    dead host's last span — causal epoch must win the sort."""
+    tr = Tracer()
+    tid = "s" * TRACE_ID_CHARS
+    tr.record_remote(tid, "serve.chunk", t0=5.0, dur=0.1, host="hA")
+    tr.event_remote(tid, "fleet.handoff", host="hA", epoch=1)
+    tr.record_remote(tid, "serve.chunk", t0=1.0, dur=0.1, host="hB",
+                     epoch=1)
+    out = tr.stitch(tid)
+    assert out["hosts"] == ["hA", "hB"]
+    assert out["epochs"] == [0, 1]
+    assert out["stitched"] is True
+    epochs = [r.get("epoch", 0) for r in out["records"]]
+    assert epochs == sorted(epochs)
+    # the epoch-0 span leads despite its LATER timestamp
+    assert out["records"][0]["ts"] == 5.0
+    assert out["records"][0]["host"] == "hA"
+
+
+def test_stitch_single_host_single_epoch_is_not_stitched():
+    tr = Tracer()
+    tid = "u" * TRACE_ID_CHARS
+    tr.record_remote(tid, "serve.chunk", t0=0.0, host="hA")
+    out = tr.stitch(tid)
+    assert out["stitched"] is False
+    assert out["hosts"] == ["hA"]
+    assert out["epochs"] == [0]
