@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test shim lint determinism dryrun chaos obs soak churn \
+.PHONY: test shim lint precommit determinism dryrun chaos obs soak churn \
         churn-fleet churn-fleet-smoke dst dst-validate serve-soak \
         serve-fleet serve-fleet-smoke \
         bench bench-all bench-e2e bench-service bench-regen bench-sp \
@@ -18,13 +18,24 @@ shim:            ## build the C++ proxylib-ABI shim
 
 # lint: ctlint codebase-aware static analysis (cilium_tpu/analysis —
 # jit-purity, lock-order, registry consistency, swallowed exceptions,
-# unused imports, plus the v2 dataflow families: shape-dtype,
-# recompile-hazard, abi-surface, config-surface). Fails on any
+# unused imports, the v2 dataflow families: shape-dtype,
+# recompile-hazard, abi-surface, config-surface, plus the v3
+# thread-safety family: guarded-field inference, check-then-act,
+# lock-release windows, publication safety). Fails on any
 # non-allowlisted finding; CTLINT.json is the CI report artifact
-# (schema 2: findings byte-stable for a clean tree + timings_ms).
-# Rule catalog and dataflow-core internals: docs/ANALYSIS.md
+# (schema 3: findings byte-stable for a clean tree + timings_ms +
+# racing-root attribution). Rules run on a thread pool; the
+# --wall-budget-ms gate (2x the pre-v3 serial baseline) keeps the
+# lint lane's latency honest. Catalog: docs/ANALYSIS.md
 lint:            ## ctlint static-analysis gate
-	$(PY) -m cilium_tpu.analysis --format text --out CTLINT.json
+	$(PY) -m cilium_tpu.analysis --format text --out CTLINT.json \
+	    --wall-budget-ms 24000
+
+# the pre-commit face: thread-safety findings on changed files only —
+# fast enough (single rule, changed-paths filter) to run on every
+# commit without the full lint lane's latency
+precommit:       ## changed-files thread-safety lint (pre-commit hook face)
+	$(PY) -m cilium_tpu.cli lint --rule thread-safety --changed-only
 
 determinism:     ## deterministic-compile + debug_nans sanitizer lane
 	$(PY) -m pytest tests/test_determinism.py -q

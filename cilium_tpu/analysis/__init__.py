@@ -54,6 +54,13 @@ def run_cli(argv=None) -> int:
     ap.add_argument("--out", default=None,
                     help="also write a JSON report here (the CI "
                          "artifact)")
+    ap.add_argument("--wall-budget-ms", type=int, default=None,
+                    metavar="MS",
+                    help="fail (exit 1) if the whole lint run takes "
+                         "longer than this many wall-clock ms — the "
+                         "`make lint` latency gate (the committed "
+                         "budget lives in CTLINT.json as "
+                         "wall_budget_ms)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
     args = ap.parse_args(argv)
@@ -93,6 +100,14 @@ def run_cli(argv=None) -> int:
         print(render_json(findings, suppressed))
     else:
         print(render_text(findings, suppressed))
+    if args.wall_budget_ms is not None:
+        from cilium_tpu.analysis.core import LAST_TIMINGS
+
+        wall = LAST_TIMINGS.get("wall", 0.0)
+        if wall > args.wall_budget_ms:
+            print(f"ctlint: wall time {wall:.0f}ms exceeds budget "
+                  f"{args.wall_budget_ms}ms", file=sys.stderr)
+            return 1
     return 1 if findings else 0
 
 

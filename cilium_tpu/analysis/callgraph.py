@@ -9,9 +9,24 @@ something.
 from __future__ import annotations
 
 import ast
+import threading
 from typing import Dict, List, Optional, Tuple
 
 from cilium_tpu.analysis.core import ProjectIndex, SourceFile
+
+_MEMO_LOCK = threading.Lock()
+
+
+def project_for(index: ProjectIndex) -> "Project":
+    """One shared ``Project`` per index. Several rules (lock-order,
+    thread-safety, registries) need the same symbol tables; building
+    them once matters now that checkers run on a thread pool."""
+    with _MEMO_LOCK:
+        project = getattr(index, "_ctlint_project", None)
+        if project is None:
+            project = Project(index)
+            index._ctlint_project = project
+        return project
 
 
 def dotted(node: ast.AST) -> Optional[str]:

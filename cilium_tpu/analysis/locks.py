@@ -17,10 +17,26 @@ with one thread).
 from __future__ import annotations
 
 import ast
+import threading
 from typing import Dict, List, Optional, Set, Tuple
 
-from cilium_tpu.analysis.callgraph import ModuleInfo, Project, dotted
+from cilium_tpu.analysis.callgraph import (ModuleInfo, Project, dotted,
+                                           project_for)
 from cilium_tpu.analysis.core import Finding, ProjectIndex, checker
+
+_MEMO_LOCK = threading.Lock()
+
+
+def analyzer_for(project: Project) -> "_Analyzer":
+    """One shared lock analyzer per project — thread-safety reuses the
+    class models and call summaries built here, and checkers now run
+    concurrently, so the memo is lock-guarded."""
+    with _MEMO_LOCK:
+        a = getattr(project, "_ctlint_lock_analyzer", None)
+        if a is None:
+            a = _Analyzer(project)
+            project._ctlint_lock_analyzer = a
+        return a
 
 RULE = "lock-order"
 
@@ -288,8 +304,8 @@ def _fmt_key(key: Tuple) -> str:
 
 @checker
 def check(index: ProjectIndex) -> List[Finding]:
-    project = Project(index)
-    a = _Analyzer(project)
+    project = project_for(index)
+    a = analyzer_for(project)
     findings: List[Finding] = []
     #: edges: held → acquired → (path, line, note)
     edges: Dict[str, Dict[str, Tuple[str, int, str]]] = {}

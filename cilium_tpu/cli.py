@@ -751,6 +751,8 @@ def cmd_lint(args) -> int:
         argv += ["--changed-only"]
     if args.out:
         argv += ["--out", args.out]
+    if args.wall_budget_ms is not None:
+        argv += ["--wall-budget-ms", str(args.wall_budget_ms)]
     if args.list_rules:
         argv += ["--list-rules"]
     return run_cli(argv)
@@ -1138,6 +1140,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "(pre-commit face; the tree is still indexed)")
     p.add_argument("--out", default=None,
                    help="also write a JSON report here")
+    p.add_argument("--wall-budget-ms", type=int, default=None,
+                   metavar="MS",
+                   help="fail if the lint run exceeds this wall-clock "
+                        "budget (the make lint latency gate)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
     p.set_defaults(fn=cmd_lint)

@@ -311,8 +311,12 @@ class VerdictRing:
                 if done is not None:
                     done.resolve(None, error="slot-released")
             raise
-        self.packs += 1
-        self.records_packed += int(total)
+        # pack/record totals race the submit path's occupancy reads
+        # and a concurrent drain() pack cycle — bump under the ring
+        # lock like every other book
+        with self._lock:
+            self.packs += 1
+            self.records_packed += int(total)
         METRICS.observe(SERVE_PACK_RECORDS, float(total),
                         labels=self._host_labels)
         METRICS.observe(SERVE_PACK_STREAMS,

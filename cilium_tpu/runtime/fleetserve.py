@@ -500,7 +500,8 @@ class FleetRouter:
                 died.append(r.name)
         t_obs = simclock.perf()
         self._publish_fleet_slo()
-        self.obs_seconds += max(0.0, simclock.perf() - t_obs)
+        with self._lock:
+            self.obs_seconds += max(0.0, simclock.perf() - t_obs)
         return died
 
     def _publish_fleet_slo(self) -> Dict:
@@ -585,7 +586,8 @@ class FleetRouter:
         r.alive = False
         r.cut = r.cut or partitioned
         r.deaths += 1
-        self.host_deaths += 1
+        with self._lock:
+            self.host_deaths += 1
         METRICS.inc(FLEET_HOST_DEATHS)
         dropped = r.loop.abandon("closed")
         manifest = r.loop.ring.resident_keys()
@@ -617,13 +619,14 @@ class FleetRouter:
                 # failover latency ledger, bounded per death
                 if len(self._failover) < self._failover_cap:
                     self._failover[s] = {"death": t_death}
-        self.obs_seconds += max(0.0, simclock.perf() - t_obs)
+            self.obs_seconds += max(0.0, simclock.perf() - t_obs)
         survivors = [x for x in self.replicas
                      if x.alive and not x.cut]
         for x in survivors:
             rows, avoided = x.loop.ring.handoff_overlap(manifest)
-            self.handoff_rows_resident += rows
-            self.handoff_bytes_avoided += avoided
+            with self._lock:
+                self.handoff_rows_resident += rows
+                self.handoff_bytes_avoided += avoided
         migrated = 0
         interrupted = False
         for s in doomed:
@@ -635,7 +638,8 @@ class FleetRouter:
                 # mid-batch interruption: the unmigrated remainder is
                 # simply UNPLACED — each stream re-grants through its
                 # own reconnect-with-resume, never on two live hosts
-                self.partial_handoffs += 1
+                with self._lock:
+                    self.partial_handoffs += 1
                 interrupted = True
                 break
             ranked = self._rank(s, survivors)
@@ -647,11 +651,12 @@ class FleetRouter:
             except ShedError:
                 continue  # stays unplaced; client resume retries
             with self._lock:
+                # ctlint: disable=thread-safety  # lost race is self-healing: if a concurrent connect() placed this stream while the lock was dropped for the blocking connect above, the orphaned re-grant lease expires and the client's own placement wins on reconnect
                 self.placements[s] = target.name
                 self._digest[target.name] = \
                     self._digest.get(target.name, 0) + 1
+                self.handoffs += 1
             migrated += 1
-            self.handoffs += 1
             METRICS.inc(FLEET_HANDOFFS)
             self._note_regrant(s)
         t_obs = simclock.perf()
@@ -660,7 +665,8 @@ class FleetRouter:
         if interrupted:
             self.journal.record("handoff-interrupted", host=r.name,
                                 remainder=len(doomed) - migrated)
-        self.obs_seconds += max(0.0, simclock.perf() - t_obs)
+        with self._lock:
+            self.obs_seconds += max(0.0, simclock.perf() - t_obs)
         LOG.warning("host death handled", extra={"fields": {
             "host": r.name, "partitioned": partitioned,
             "leases_dropped": dropped, "migrated": migrated,
@@ -670,14 +676,20 @@ class FleetRouter:
     def _note_regrant(self, stream_id: str) -> None:
         """Stamp the death→re-grant stage of the failover latency
         ledger (called at the handoff re-grant AND at a lazy client
-        resume that re-places a doomed stream)."""
-        fo = self._failover.get(stream_id)
-        if fo is None or "regrant" in fo:
-            return
+        resume that re-places a doomed stream). The ledger mutates
+        under ``_lock`` — a racing ``note_failover_verdict`` pop
+        would otherwise leave this stamp on an orphaned dict — and
+        the metric is emitted after release (no lock-order edge into
+        the metrics registry)."""
         now = simclock.now()
-        fo["regrant"] = now
+        with self._lock:
+            fo = self._failover.get(stream_id)
+            if fo is None or "regrant" in fo:
+                return
+            fo["regrant"] = now
+            death = fo["death"]
         METRICS.observe(FLEET_FAILOVER_SECONDS,
-                        max(0.0, now - fo["death"]),
+                        max(0.0, now - death),
                         labels={"stage": "death-to-regrant"})
 
     def note_failover_verdict(self, stream_id: str) -> None:
@@ -685,10 +697,11 @@ class FleetRouter:
         after replay: observes the regrant→verdict and end-to-end
         death→verdict latencies and frees the entry. The driving
         model calls this when a replayed ticket resolves cleanly."""
-        fo = self._failover.pop(stream_id, None)
+        now = simclock.now()
+        with self._lock:
+            fo = self._failover.pop(stream_id, None)
         if fo is None:
             return
-        now = simclock.now()
         if "regrant" in fo:
             METRICS.observe(FLEET_FAILOVER_SECONDS,
                             max(0.0, now - fo["regrant"]),
@@ -707,7 +720,7 @@ class FleetRouter:
         r.revive(loader)
         with self._lock:
             self._digest[name] = 0
-        self.rejoins += 1
+            self.rejoins += 1
         METRICS.inc(FLEET_REJOINS)
         self.journal.record("host-rejoin", host=name)
 

@@ -411,6 +411,7 @@ class Loader:
                 return self._regenerate(per_identity, revision)
             except Exception as e:
                 with self._lock:
+                    # ctlint: disable=thread-safety  # rollback restores the pre-attempt snapshot verbatim under the lock; regenerate() is the only writer between read and restore and it is the frame raising here
                     self._engine, self._revision, self.per_identity = \
                         prev[:3]
                     # the artifact pointer rolls back WITH the triple:
@@ -429,6 +430,7 @@ class Loader:
                     # commit's bank-scoped invalidation
                     self._identity_fps = prev[4]
                     self._globals_fp = prev[5]
+                    # ctlint: disable=thread-safety  # same rollback window as above: the snapshot is restored wholesale, racing writers rolled back with it
                     self._bank_plan = prev[6]
                     self._degraded = prev[7]
                     self._identity_family_fps = prev[8]
@@ -477,12 +479,15 @@ class Loader:
             engine = OracleVerdictEngine(
                 per_identity, secret_lookup=secret_lookup,
                 audit=self.config.policy_audit_mode)
-            self._last_artifact_key = None
-            self._identity_fps = None
-            self._identity_family_fps = None
-            self._globals_fp = None
-            self._bank_plan = {}
-            self._degraded = False
+            # delta inputs move under the loader lock: bank_status /
+            # _delta_for read them from other threads mid-regeneration
+            with self._lock:
+                self._last_artifact_key = None
+                self._identity_fps = None
+                self._identity_family_fps = None
+                self._globals_fp = None
+                self._bank_plan = {}
+                self._degraded = False
             return self._commit(engine, revision, per_identity, "oracle")
 
         from cilium_tpu.engine.memo import PolicyDelta
@@ -525,14 +530,17 @@ class Loader:
             "policy-v11", globals_fp, tuple(sorted(fps.items())))
         with self._lock:
             serving_engine = self._engine
-        if (key == self._last_artifact_key and not self._degraded
+            serving_key = self._last_artifact_key
+            serving_degraded = self._degraded
+        if (key == serving_key and not serving_degraded
                 and isinstance(serving_engine, VerdictEngine)):
             # byte-identical policy re-committed (identity churn that
             # netted out, a redundant update): keep the serving engine,
             # advance the revision, and tell memo owners NOTHING
             # changed — the add-then-delete case of the churn plane
-            self._identity_fps = fps
-            self._identity_family_fps = fam_fps_all
+            with self._lock:
+                self._identity_fps = fps
+                self._identity_family_fps = fam_fps_all
             return self._commit(serving_engine, revision, per_identity,
                                 "tpu", delta=PolicyDelta.none())
         policy = self._cache.get(key)
@@ -577,13 +585,16 @@ class Loader:
         fam_fps = fam_fps_all
         delta = self._delta_for(fps, globals_fp, new_plan,
                                 bool(quarantined), fam_fps)
-        self._last_artifact_key = key if not quarantined else None
+        with self._lock:
+            self._last_artifact_key = key if not quarantined else None
+            self._identity_fps = fps
+            self._identity_family_fps = fam_fps
+            self._globals_fp = globals_fp
+            self._bank_plan = new_plan
+            self._degraded = bool(quarantined)
+        # the cache has its own lock — keep it out of ours so the
+        # loader lock never nests into the artifact-cache lock
         self._update_protected()
-        self._identity_fps = fps
-        self._identity_family_fps = fam_fps
-        self._globals_fp = globals_fp
-        self._bank_plan = new_plan
-        self._degraded = bool(quarantined)
         return self._commit(engine, revision, per_identity, "tpu",
                             delta=delta)
 
@@ -602,24 +613,31 @@ class Loader:
         range entries)."""
         from cilium_tpu.engine.memo import FAMILY_ALL, PolicyDelta
 
+        # one coherent snapshot of the serving-side delta inputs: a
+        # concurrent commit/rollback must not swap them out between
+        # the bank diff and the fingerprint diff below
+        with self._lock:
+            old_plan = dict(self._bank_plan)
+            prev_fps = self._identity_fps
+            prev_globals_fp = self._globals_fp
+            prev_degraded = self._degraded
+            prev_fams = self._identity_family_fps
         changed_banks = set()
-        for field in set(self._bank_plan) | set(new_plan):
-            old_keys = set(self._bank_plan.get(field, ()))
+        for field in set(old_plan) | set(new_plan):
+            old_keys = set(old_plan.get(field, ()))
             new_keys = set(new_plan.get(field, ()))
             changed_banks |= old_keys ^ new_keys
             swapped_in = len(new_keys - old_keys)
             if swapped_in:
                 METRICS.inc(BANK_HOTSWAPS, swapped_in,
                             labels={"field": field})
-        prev_fps = self._identity_fps
-        if (prev_fps is None or self._globals_fp != globals_fp
-                or degraded or self._degraded):
+        if (prev_fps is None or prev_globals_fp != globals_fp
+                or degraded or prev_degraded):
             return PolicyDelta(full=True)
         changed_ids = {ep for ep in set(prev_fps) | set(fps)
                        if prev_fps.get(ep) != fps.get(ep)}
         families: set = set()
         family_ports: set = set()
-        prev_fams = self._identity_family_fps
         if prev_fams is not None and fam_fps is not None:
             for ep in changed_ids:
                 old_f = prev_fams.get(ep)
@@ -699,10 +717,12 @@ class Loader:
         op's churn-plane face)."""
         if self.bank_registry is None:
             return {"enabled": False}
-        out: Dict[str, object] = {"enabled": True,
-                                  "degraded": self._degraded}
+        with self._lock:
+            degraded = self._degraded
+            plan = {f: len(k) for f, k in self._bank_plan.items()}
+        out: Dict[str, object] = {"enabled": True, "degraded": degraded}
         out.update(self.bank_registry.status())
-        out["plan"] = {f: len(k) for f, k in self._bank_plan.items()}
+        out["plan"] = plan
         out["kernel_plan"] = dict(getattr(self, "_kernel_plan", {}))
         out["fp_store"] = self._fp_store.status()
         return out
@@ -770,7 +790,9 @@ class Loader:
 
             with self._lock:
                 serving_engine = self._engine
-            if (key == self._last_artifact_key and not self._degraded
+                serving_key = self._last_artifact_key
+                serving_degraded = self._degraded
+            if (key == serving_key and not serving_degraded
                     and isinstance(serving_engine, VerdictEngine)):
                 # the snapshot IS the serving policy (drain → restore
                 # without an intervening change): keep the staged
@@ -779,9 +801,11 @@ class Loader:
                 # hot across the warm restart (ISSUE-8 satellite; the
                 # old unconditional drop cost the whole memo hit
                 # ratio on every restart)
-                self._identity_fps = identity_fingerprints(per_identity)
-                self._identity_family_fps = \
-                    identity_family_fingerprints(per_identity)
+                fps = identity_fingerprints(per_identity)
+                fam = identity_family_fingerprints(per_identity)
+                with self._lock:
+                    self._identity_fps = fps
+                    self._identity_family_fps = fam
                 self._commit(serving_engine, revision, per_identity,
                              "warm", delta=PolicyDelta.none())
                 METRICS.inc(WARM_RESTORES)
@@ -803,16 +827,19 @@ class Loader:
                 fps = identity_fingerprints(per_identity)
                 fam_fps = identity_family_fingerprints(per_identity)
                 new_plan = dict(getattr(policy, "bank_plan", {}) or {})
-                delta = self._delta_for(fps, self._globals_fp or "",
+                with self._lock:
+                    globals_fp = self._globals_fp
+                delta = self._delta_for(fps, globals_fp or "",
                                         new_plan, False, fam_fps) \
-                    if self._globals_fp is not None \
+                    if globals_fp is not None \
                     else PolicyDelta(full=True)
-                self._last_artifact_key = key
+                with self._lock:
+                    self._last_artifact_key = key
+                    self._identity_fps = fps
+                    self._identity_family_fps = fam_fps
+                    self._bank_plan = new_plan
+                    self._degraded = False
                 self._update_protected()
-                self._identity_fps = fps
-                self._identity_family_fps = fam_fps
-                self._bank_plan = new_plan
-                self._degraded = False
                 self._commit(engine, revision, per_identity, "warm",
                              delta=delta)
                 METRICS.inc(WARM_RESTORES)
@@ -823,7 +850,8 @@ class Loader:
             engine = OracleVerdictEngine(
                 per_identity, secret_lookup=secret_lookup,
                 audit=self.config.policy_audit_mode)
-            self._last_artifact_key = None
+            with self._lock:
+                self._last_artifact_key = None
             self._commit(engine, revision, per_identity, "warm")
             METRICS.inc(WARM_RESTORES)
             return True

@@ -263,6 +263,11 @@ class ServeLoop:
         self._draining = False
         self._stop = False
         self._thread: Optional[threading.Thread] = None
+        #: leaf lock for the lifetime counters below: they are bumped
+        #: from client threads AND the pack thread, sometimes while
+        #: self._lock is held and sometimes not (`_shed`), so they
+        #: get their own guard — nothing is called while holding it
+        self._stats_lock = threading.Lock()
         #: lifetime counters (the load model's invariant face)
         self.grants = 0
         self.expiries = 0
@@ -297,7 +302,8 @@ class ServeLoop:
 
     # -- leases -----------------------------------------------------------
     def _shed(self, reason: str) -> None:
-        self.sheds += 1
+        with self._stats_lock:
+            self.sheds += 1
         admission.count_shed("serve", admission.CLASS_DATA, reason)
         if self.slo is not None:
             self.slo.observe_request(shed=True)
@@ -340,7 +346,8 @@ class ServeLoop:
         if self.gate is not None:
             ok, reason = self.gate.admit(admission.CLASS_DATA)
             if not ok:
-                self.sheds += 1  # counted by the gate already
+                with self._stats_lock:
+                    self.sheds += 1  # counted by the gate already
                 raise ShedError(reason)
         now = simclock.now()
         with self._lock:
@@ -434,7 +441,8 @@ class ServeLoop:
         try:
             faults.maybe_fail(RING_SLOT_POINT)
         except Exception:  # noqa: BLE001 — plan-chosen exception
-            self.chunk_errors += 1
+            with self._stats_lock:
+                self.chunk_errors += 1
             self._shed(admission.SHED_FAULT)
             raise ShedError(admission.SHED_FAULT)
         now = simclock.now()
@@ -472,7 +480,8 @@ class ServeLoop:
                     gen=(gen[:k] if gen is not None else None))
             except Exception:  # noqa: BLE001 — explain is advisory;
                 ticket.sample_flows = None  # never fail the chunk
-            self.obs_seconds += max(0.0, simclock.perf() - t_obs)
+            with self._stats_lock:
+                self.obs_seconds += max(0.0, simclock.perf() - t_obs)
         # ring.submit takes its own lock; encoding outside ours keeps
         # lease ops responsive while a big chunk featurizes
         try:
@@ -539,10 +548,11 @@ class ServeLoop:
         if self.slo is not None:
             self.slo.observe_latency(lat)
             self.slo.observe_request(shed=False)
-        if prov is not None:
-            self.records_explained += n
-        else:
-            self.records_unexplained += n
+        with self._stats_lock:
+            if prov is not None:
+                self.records_explained += n
+            else:
+                self.records_unexplained += n
         self.flows.note_served(n)
         if ticket.trace_id:
             # the serving host's span, appended BY id: the pack
@@ -605,11 +615,13 @@ class ServeLoop:
             if dev is None:
                 # encoded ids predate a session reset — the payload
                 # is gone; the stream retries the chunk
-                self.chunk_errors += 1
+                with self._stats_lock:
+                    self.chunk_errors += 1
                 ticket.resolve(None, error="session-reset")
                 continue
             served += self._resolve_ticket(ticket, n, dev)
-        self.served_records += served
+        with self._stats_lock:
+            self.served_records += served
         if results and self.slo is not None:
             self.slo.publish()
         return served
@@ -629,7 +641,8 @@ class ServeLoop:
                 except Exception as e:  # noqa: BLE001 — degrade,
                     # never die: the ring put the batch back, the
                     # next cycle retries (transient faults recover)
-                    self.pack_failures += 1
+                    with self._stats_lock:
+                        self.pack_failures += 1
                     LOG.warning("pack cycle failed; retrying next "
                                 "interval", extra={"fields": {
                                     "error": f"{type(e).__name__}: "
@@ -672,11 +685,13 @@ class ServeLoop:
                 if ticket is None:
                     continue
                 if dev is None:
-                    self.chunk_errors += 1
+                    with self._stats_lock:
+                        self.chunk_errors += 1
                     ticket.resolve(None, error="session-reset")
                     continue
                 flushed += self._resolve_ticket(ticket, n, dev)
-        self.served_records += flushed
+        with self._stats_lock:
+            self.served_records += flushed
         with self._lock:
             for lease in list(self._leases.values()):
                 self._release_locked(lease, "drained")

@@ -472,11 +472,15 @@ class MicroBatcher:
                 simclock.wait_cond(self._cond, min(left, 0.05))
             self._closed = True
             leftovers, self._pending = self._pending, []
+            # snapshot the worker list under the cond's lock; joining
+            # happens OUTSIDE it (workers need the lock to observe
+            # _closed and exit)
+            workers = list(self._workers)
             self._cond.notify_all()
         for entry in leftovers:
             entry.box.append(int(Verdict.ERROR))
             entry.ev.set()
-        for w in self._workers:
+        for w in workers:
             w.join(timeout=1.0)
         return max(0, backlog - len(leftovers))
 

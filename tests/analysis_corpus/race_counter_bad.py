@@ -1,0 +1,36 @@
+"""Pre-fix PR-11 race #1: the serve loop's lifetime counters.
+
+The pack thread and drain bump ``sheds`` under the loop lock, but the
+client-facing ``connect`` bumped it bare — and ``chunk_errors`` never
+saw a lock at all, so the ``+=`` read-modify-write loses updates
+whenever a client thread races the pack thread."""
+
+import threading
+
+
+class ServeLoop:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.sheds = 0
+        self.chunk_errors = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self.sheds += 1
+
+    def drain(self):
+        with self._lock:
+            self.sheds += 1
+
+    def connect(self, stream_id):
+        self.sheds += 1  # counted by the gate already
+        return stream_id
+
+    def submit(self, chunk):
+        if chunk is None:
+            self.chunk_errors += 1
+            return False
+        return True

@@ -1,0 +1,35 @@
+"""Fixed counterpart of ``race_counter_bad``: every counter bump —
+pack thread, drain, and the client-facing paths — happens under the
+same lock, so the majority-guard inference sees 100% agreement."""
+
+import threading
+
+
+class ServeLoop:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.sheds = 0
+        self.chunk_errors = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self.sheds += 1
+
+    def drain(self):
+        with self._lock:
+            self.sheds += 1
+
+    def connect(self, stream_id):
+        with self._lock:
+            self.sheds += 1
+        return stream_id
+
+    def submit(self, chunk):
+        if chunk is None:
+            with self._lock:
+                self.chunk_errors += 1
+            return False
+        return True

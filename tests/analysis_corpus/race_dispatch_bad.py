@@ -1,0 +1,35 @@
+"""Pre-fix PR-11 race #5: unlocked dispatch against a guarded map.
+
+``reset`` and ``connect`` mutate the session map under the lock —
+that is the declared protocol — but the hot dispatch path read it
+bare, so a concurrent reset can yank a session out from under a
+dispatch mid-read (dict mutated during lookup, stale session
+served)."""
+
+import threading
+
+
+class Dispatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sessions = {}
+        self._reaper = threading.Thread(target=self._reap, daemon=True)
+        self._reaper.start()
+
+    def _reap(self):
+        while True:
+            self.reset()
+
+    def connect(self, sid, session):
+        with self._lock:
+            self._sessions[sid] = session
+
+    def reset(self):
+        with self._lock:
+            self._sessions.clear()
+
+    def dispatch(self, sid, frame):
+        session = self._sessions.get(sid)
+        if session is None:
+            return None
+        return session.feed(frame)

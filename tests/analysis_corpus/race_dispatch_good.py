@@ -1,0 +1,32 @@
+"""Fixed counterpart of ``race_dispatch_bad``: the session is bound
+AND used under the lock, so a concurrent reset either happens-before
+the dispatch (miss) or after it (served from the coherent map)."""
+
+import threading
+
+
+class Dispatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sessions = {}
+        self._reaper = threading.Thread(target=self._reap, daemon=True)
+        self._reaper.start()
+
+    def _reap(self):
+        while True:
+            self.reset()
+
+    def connect(self, sid, session):
+        with self._lock:
+            self._sessions[sid] = session
+
+    def reset(self):
+        with self._lock:
+            self._sessions.clear()
+
+    def dispatch(self, sid, frame):
+        with self._lock:
+            session = self._sessions.get(sid)
+            if session is None:
+                return None
+            return session.feed(frame)

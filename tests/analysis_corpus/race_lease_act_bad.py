@@ -1,0 +1,35 @@
+"""Pre-fix PR-11 race #2: validate-then-act on a lease.
+
+``submit`` binds the lease out of the guarded map and validates it
+under the lock, then calls into it AFTER releasing — the expiry sweep
+(its own thread) can revoke the lease in the window, so the submit
+acts on a lease that is no longer granted."""
+
+import threading
+
+
+class LeaseTable:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._leases = {}
+        self._sweeper = threading.Thread(target=self._sweep,
+                                         daemon=True)
+        self._sweeper.start()
+
+    def _sweep(self):
+        while True:
+            with self._lock:
+                for sid in list(self._leases):
+                    if self._leases[sid].expired():
+                        self._leases.pop(sid)
+
+    def grant(self, sid, lease):
+        with self._lock:
+            self._leases[sid] = lease
+
+    def submit(self, sid, chunk):
+        with self._lock:
+            lease = self._leases.get(sid)
+            if lease is None:
+                return False
+        return lease.accept(chunk)

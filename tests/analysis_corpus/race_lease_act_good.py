@@ -1,0 +1,32 @@
+"""Fixed counterpart of ``race_lease_act_bad``: the act happens in
+the same critical section as the validation, so the expiry sweep can
+never revoke the lease between check and use."""
+
+import threading
+
+
+class LeaseTable:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._leases = {}
+        self._sweeper = threading.Thread(target=self._sweep,
+                                         daemon=True)
+        self._sweeper.start()
+
+    def _sweep(self):
+        while True:
+            with self._lock:
+                for sid in list(self._leases):
+                    if self._leases[sid].expired():
+                        self._leases.pop(sid)
+
+    def grant(self, sid, lease):
+        with self._lock:
+            self._leases[sid] = lease
+
+    def submit(self, sid, chunk):
+        with self._lock:
+            lease = self._leases.get(sid)
+            if lease is None:
+                return False
+            return lease.accept(chunk)

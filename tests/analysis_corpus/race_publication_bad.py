@@ -1,0 +1,27 @@
+"""Pre-fix PR-11 race #4: unsafe publication out of ``__init__``.
+
+The pack thread is started BEFORE the books it reads are assigned —
+the brand-new thread can observe a partially-constructed loop and
+die on a missing attribute (or worse, silently skip accounting)."""
+
+import threading
+
+
+class PackLoop:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        self._pending = {}
+        self.packs = 0
+
+    def _run(self):
+        while True:
+            with self._lock:
+                for key in list(self._pending):
+                    self._pending.pop(key)
+                    self.packs += 1
+
+    def submit(self, key, chunk):
+        with self._lock:
+            self._pending[key] = chunk

@@ -1,0 +1,25 @@
+"""Fixed counterpart of ``race_publication_bad``: construction
+finishes — every shared field assigned — before the instance escapes
+to the new thread via ``start()``."""
+
+import threading
+
+
+class PackLoop:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = {}
+        self.packs = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                for key in list(self._pending):
+                    self._pending.pop(key)
+                    self.packs += 1
+
+    def submit(self, key, chunk):
+        with self._lock:
+            self._pending[key] = chunk

@@ -1,0 +1,36 @@
+"""Pre-fix PR-11 race #3: the re-insert lock-release window.
+
+``repack`` reads a slot under the ring lock, rebuilds it with the
+lock dropped (the expensive part), then writes it back blind. If the
+owning stream released the slot in the window, the write-back
+resurrects a slot nobody owns and the occupancy books drift."""
+
+import threading
+
+
+class SlotRing:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slots = {}
+        self._packer = threading.Thread(target=self._pack_loop,
+                                        daemon=True)
+        self._packer.start()
+
+    def _pack_loop(self):
+        while True:
+            self.repack("hot")
+
+    def insert(self, key, buf):
+        with self._lock:
+            self._slots[key] = buf
+
+    def release(self, key):
+        with self._lock:
+            self._slots.pop(key, None)
+
+    def repack(self, key):
+        with self._lock:
+            entry = self._slots.get(key)
+        rebuilt = [entry, entry]
+        with self._lock:
+            self._slots[key] = rebuilt

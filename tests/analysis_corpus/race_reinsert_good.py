@@ -1,0 +1,36 @@
+"""Fixed counterpart of ``race_reinsert_bad``: the write-back
+re-validates the slot still exists under the lock before touching it
+— the re-validation idiom the rule recognizes (a concurrent release
+in the window makes the repack a no-op instead of a resurrection)."""
+
+import threading
+
+
+class SlotRing:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slots = {}
+        self._packer = threading.Thread(target=self._pack_loop,
+                                        daemon=True)
+        self._packer.start()
+
+    def _pack_loop(self):
+        while True:
+            self.repack("hot")
+
+    def insert(self, key, buf):
+        with self._lock:
+            self._slots[key] = buf
+
+    def release(self, key):
+        with self._lock:
+            self._slots.pop(key, None)
+
+    def repack(self, key):
+        with self._lock:
+            entry = self._slots.get(key)
+        rebuilt = [entry, entry]
+        with self._lock:
+            if key not in self._slots:
+                return
+            self._slots[key] = rebuilt

@@ -882,10 +882,15 @@ def test_report_schema_and_stability():
     assert a == b
     assert a["schema_version"] == SCHEMA_VERSION
     assert a["findings"] == []
-    # timings cover every rule module plus the parse stage
+    # timings cover every rule module plus the parse stage and the
+    # whole-run wall clock (rules run on a thread pool, so per-rule
+    # times overlap and may sum past the wall)
     assert "parse" in ta[0]
     assert "shapes" in ta[0] and "recompile" in ta[0]
     assert "abi" in ta[0] and "configsurface" in ta[0]
+    assert "threadsafety" in ta[0] and "wall" in ta[0]
+    # the committed lint-latency budget is part of the stable report
+    assert a["wall_budget_ms"] >= 1000
 
 
 def test_ast_cache_roundtrip(tmp_path):
@@ -1736,3 +1741,167 @@ def test_frontend_registry_tree_clean():
     # parser registrations the rule actually walked
     assert len(fereg_rule._frontend_specs(index)) >= 3
     assert len(fereg_rule._parser_registrations(index)) >= 5
+
+
+# -- thread-safety (v3) -----------------------------------------------------
+
+from cilium_tpu.analysis import threadsafety as ts_rule  # noqa: E402
+
+CORPUS_DIR = os.path.join(REPO_ROOT, "tests", "analysis_corpus")
+
+
+def _corpus(name):
+    with open(os.path.join(CORPUS_DIR, name)) as fp:
+        return fp.read()
+
+
+def _ts_check_file(name):
+    """Run the thread-safety rule over ONE corpus file, placed under
+    the rule's default scope (cilium_tpu/runtime/)."""
+    return _check({f"cilium_tpu/runtime/{name}": _corpus(name)},
+                  ts_rule.check)
+
+
+def test_thread_safety_bad_corpus_catches_prefix_races():
+    """The five pre-fix PR-11 race reconstructions: the rule must
+    keep catching at least four of the five (the acceptance floor —
+    today it catches all five)."""
+    bad = ["race_counter_bad.py", "race_lease_act_bad.py",
+           "race_reinsert_bad.py", "race_publication_bad.py",
+           "race_dispatch_bad.py"]
+    caught = [n for n in bad if _ts_check_file(n)]
+    assert len(caught) >= 4, f"only caught {caught}"
+
+
+def test_thread_safety_good_corpus_clean():
+    """Every fixed counterpart — the shape the real fix took — must
+    be quiet."""
+    for name in ["race_counter_good.py", "race_lease_act_good.py",
+                 "race_reinsert_good.py", "race_publication_good.py",
+                 "race_dispatch_good.py"]:
+        assert _ts_check_file(name) == [], name
+
+
+def test_thread_safety_guard_inference_names_racing_roots():
+    """Majority-guard inference: the unlocked `connect` bump is
+    flagged (2/3 sites locked) and the finding names two distinct
+    racing roots — the public caller and the pack thread."""
+    out = _ts_check_file("race_counter_bad.py")
+    guarded = [f for f in out if "guarded by" in f.message]
+    assert len(guarded) == 1
+    f = guarded[0]
+    assert "2/3 mutation sites" in f.message
+    assert len(f.roots) == 2
+    assert any(r.startswith("thread:") for r in f.roots)
+    assert f.as_dict()["roots"] == list(f.roots)
+    # and the bare += with no lock anywhere is its own finding
+    assert any("read-modify-write" in f.message for f in out)
+
+
+def test_thread_safety_check_then_act():
+    out = _ts_check_file("race_lease_act_bad.py")
+    assert any("check-then-act" in f.message and "`lease`" in f.message
+               for f in out)
+
+
+def test_thread_safety_release_window_and_revalidation_idiom():
+    """The blind write-back is a lock-release window; re-validating
+    the key under the lock before the write (the fixed idiom) is
+    recognized and suppresses it."""
+    out = _ts_check_file("race_reinsert_bad.py")
+    assert any("lock-release window" in f.message for f in out)
+    assert _ts_check_file("race_reinsert_good.py") == []
+
+
+def test_thread_safety_publication():
+    out = _ts_check_file("race_publication_bad.py")
+    assert any("unsafe publication" in f.message for f in out)
+
+
+def test_thread_safety_out_of_scope_modules_untouched():
+    """The rule only reports inside the serving fleet's scope — the
+    same racy source outside cilium_tpu/runtime/ stays quiet."""
+    src = _corpus("race_counter_bad.py")
+    assert _check({"cilium_tpu/hubble/race_counter_bad.py": src},
+                  ts_rule.check) == []
+    # ...unless a test overrides the scope explicitly
+    assert _check({"cilium_tpu/hubble/race_counter_bad.py": src},
+                  ts_rule.check, scope=("cilium_tpu/hubble/",)) != []
+
+
+def test_thread_safety_disable_pragma_honored():
+    # the finding anchors on the first late assign, so the pragma's
+    # comment-only line goes right above it
+    src = _corpus("race_publication_bad.py").replace(
+        "        self._pending = {}",
+        "        # ctlint: disable=thread-safety  # corpus fixture\n"
+        "        self._pending = {}")
+    out = _check({"cilium_tpu/runtime/race_publication_bad.py": src},
+                 ts_rule.check)
+    assert not any("unsafe publication" in f.message for f in out)
+
+
+def _real_tree_index():
+    """One shared tree index for the real-tree thread-safety tests
+    (project/analyzer memoize onto it, so building it once keeps
+    these tests off the suite's wall-time budget)."""
+    global _TS_TREE_INDEX
+    if _TS_TREE_INDEX is None:
+        index, errors = ProjectIndex.from_tree(REPO_ROOT,
+                                               ("cilium_tpu",))
+        assert not errors
+        _TS_TREE_INDEX = index
+    return _TS_TREE_INDEX
+
+
+_TS_TREE_INDEX = None
+
+
+def test_thread_safety_roots_nonvacuous():
+    """Guard against root discovery going vacuously quiet: the real
+    tree must yield a healthy set of concurrency roots (thread
+    targets, executor submits, handler entries)."""
+    from cilium_tpu.analysis.callgraph import project_for
+    from cilium_tpu.analysis.locks import analyzer_for
+
+    a = analyzer_for(project_for(_real_tree_index()))
+    seeds = ts_rule.discover_roots(a)
+    labels = set()
+    for v in seeds.values():
+        labels |= v
+    assert len(seeds) >= 10, sorted(labels)
+    assert any(lbl.startswith("thread:") for lbl in labels)
+    assert any(lbl.startswith("executor:") for lbl in labels)
+    reach = ts_rule.reachable_roots(a, seeds)
+    assert len(reach) > len(seeds)
+
+
+def test_thread_safety_tree_is_clean():
+    """The serving fleet itself passes its own analysis (fixes +
+    justified allowlists, never silent). Runs the one checker over
+    the shared index — `make lint` and test_shipped_tree_is_clean
+    already cover the full-run path."""
+    index = _real_tree_index()
+    findings = []
+    for f in ts_rule.check(index):
+        sf = index.by_path.get(f.path)
+        if sf is not None and sf.disabled(f.line, f.rule):
+            continue
+        findings.append(f)
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_wall_budget_gate(tmp_path, capsys):
+    """--wall-budget-ms: a generous budget passes, an impossible one
+    fails the run even with zero findings (the make lint latency
+    gate)."""
+    from cilium_tpu.analysis import run_cli
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "m.py").write_text("X = 1\n")
+    argv = ["pkg", "--root", str(tmp_path)]
+    assert run_cli(argv + ["--wall-budget-ms", "600000"]) == 0
+    capsys.readouterr()
+    assert run_cli(argv + ["--wall-budget-ms", "0"]) == 1
+    assert "exceeds budget" in capsys.readouterr().err

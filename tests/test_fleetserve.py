@@ -393,3 +393,44 @@ def test_submit_after_silent_death_is_typed_resume(tmp_path):
             with pytest.raises(HostDead):
                 router.submit("z0", lease, sections)
         assert router.books()[0] == router.books()[1]
+
+
+def test_failover_ledger_survives_racing_verdict_pop(tmp_path):
+    """The PR-18 ledger-lock regression gate: ``_note_regrant`` and
+    ``note_failover_verdict`` both touch the ``_failover`` ledger and
+    race each other (handoff thread vs the client's verdict path) —
+    both now mutate under ``_lock``, so a verdict pop that lands
+    first makes the late regrant a clean no-op instead of stamping an
+    orphaned dict. Fully simclock-driven, no sleeps."""
+    clk = VirtualClock()
+    with simclock.use(clk):
+        router, _loader, _sections = _fleet_world(tmp_path)
+        # normal order: death -> regrant -> verdict, exact latencies
+        with router._lock:
+            router._failover["s0"] = {"death": simclock.now()}
+        clk.advance(2.0)
+        router._note_regrant("s0")
+        with router._lock:
+            assert router._failover["s0"]["regrant"] == \
+                pytest.approx(simclock.now())
+        clk.advance(1.5)
+        # a second regrant keeps the FIRST stamp (idempotent)
+        router._note_regrant("s0")
+        with router._lock:
+            assert router._failover["s0"]["regrant"] == \
+                pytest.approx(simclock.now() - 1.5)
+        clk.advance(1.5)
+        router.note_failover_verdict("s0")
+        assert router.failover_samples[-1] == pytest.approx(5.0)
+        with router._lock:
+            assert "s0" not in router._failover
+        # adversarial order: the verdict pop wins the race — the late
+        # regrant must neither resurrect the entry nor record a sample
+        with router._lock:
+            router._failover["s1"] = {"death": simclock.now()}
+        samples_before = len(router.failover_samples)
+        router.note_failover_verdict("s1")
+        router._note_regrant("s1")
+        with router._lock:
+            assert "s1" not in router._failover
+        assert len(router.failover_samples) == samples_before + 1

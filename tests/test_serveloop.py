@@ -489,3 +489,49 @@ def test_lease_expired_submit_raises_and_releases(tmp_path):
             loop.submit(lease, *_sections(scenario.flows[:8]))
         assert loop.status()["occupancy"] == 0
         assert loop.status()["expiries"] == 1
+
+
+def test_lifetime_counters_exact_under_concurrent_bumps(tmp_path):
+    """The PR-18 stats-lock regression gate: the lifetime counters
+    are bumped from client threads AND the pack thread, sometimes
+    while ``_lock`` is held (the gate path) and sometimes not — they
+    ride a dedicated leaf lock, so (a) ``_shed`` must not deadlock
+    when invoked WITH the loop lock held, and (b) concurrent bumps
+    must never lose an update. Deterministic under the fix (the lock
+    makes every increment atomic); pre-fix this flaked on preemption
+    mid ``+=``. Virtual clock, no sleeps."""
+    import sys
+    import threading
+
+    clk = VirtualClock()
+    with simclock.use(clk):
+        loop, _loader, _scenario = _world(tmp_path)
+        # (a) the gate path: _shed under the loop lock — a counter
+        # guarded by _lock itself would self-deadlock right here
+        before = loop.sheds
+        with loop._lock:
+            loop._shed("queue-full")
+        assert loop.sheds == before + 1
+
+        # (b) exactness: hammer the counter from racing threads with
+        # an aggressive switch interval so a bare += would drop bumps
+        n_threads, per_thread = 8, 400
+        old = sys.getswitchinterval()
+        sys.setswitchinterval(1e-6)
+        try:
+            start = threading.Barrier(n_threads)
+
+            def bump():
+                start.wait()
+                for _ in range(per_thread):
+                    loop._shed("queue-full")
+
+            threads = [threading.Thread(target=bump)
+                       for _ in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            sys.setswitchinterval(old)
+        assert loop.sheds == before + 1 + n_threads * per_thread
