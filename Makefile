@@ -19,23 +19,31 @@ shim:            ## build the C++ proxylib-ABI shim
 # lint: ctlint codebase-aware static analysis (cilium_tpu/analysis —
 # jit-purity, lock-order, registry consistency, swallowed exceptions,
 # unused imports, the v2 dataflow families: shape-dtype,
-# recompile-hazard, abi-surface, config-surface, plus the v3
+# recompile-hazard, abi-surface, config-surface, the v3
 # thread-safety family: guarded-field inference, check-then-act,
-# lock-release windows, publication safety). Fails on any
-# non-allowlisted finding; CTLINT.json is the CI report artifact
-# (schema 3: findings byte-stable for a clean tree + timings_ms +
-# racing-root attribution). Rules run on a thread pool; the
-# --wall-budget-ms gate (2x the pre-v3 serial baseline) keeps the
-# lint lane's latency honest. Catalog: docs/ANALYSIS.md
+# lock-release windows, publication safety, plus the v4
+# device-dataflow family: implicit-sync, hot-loop-h2d,
+# readback-ordering, missing-donation over the serving hot path's
+# residency lattice). Fails on any non-allowlisted finding;
+# CTLINT.json is the CI report artifact (schema 4: findings
+# byte-stable for a clean tree + timings_ms + racing-root and
+# device-residency attribution). Rules run on a thread pool; the
+# --wall-budget-ms gate (2x the v4 warm tree-wide baseline) keeps
+# the lint lane's latency honest. Catalog: docs/ANALYSIS.md
 lint:            ## ctlint static-analysis gate
 	$(PY) -m cilium_tpu.analysis --format text --out CTLINT.json \
-	    --wall-budget-ms 24000
+	    --wall-budget-ms 40000
 
-# the pre-commit face: thread-safety findings on changed files only —
-# fast enough (single rule, changed-paths filter) to run on every
+# the pre-commit face: thread-safety + device-dataflow findings on
+# changed files only — the two rule families whose hazards are
+# cheapest to introduce in a hot-path edit and costliest to ship;
+# fast enough (two families, changed-paths filter) to run on every
 # commit without the full lint lane's latency
-precommit:       ## changed-files thread-safety lint (pre-commit hook face)
-	$(PY) -m cilium_tpu.cli lint --rule thread-safety --changed-only
+precommit:       ## changed-files thread-safety + device-dataflow lint
+	$(PY) -m cilium_tpu.cli lint --rule thread-safety \
+	    --rule implicit-sync --rule hot-loop-h2d \
+	    --rule readback-ordering --rule missing-donation \
+	    --changed-only
 
 determinism:     ## deterministic-compile + debug_nans sanitizer lane
 	$(PY) -m pytest tests/test_determinism.py -q

@@ -398,3 +398,4 @@ def symbol_count(index: ProjectIndex) -> int:
 @checker
 def check(index: ProjectIndex) -> List[Finding]:
     return check_abi(index)
+check.emits = (RULE,)

@@ -331,3 +331,4 @@ def field_count(index: ProjectIndex,
 @checker
 def check(index: ProjectIndex) -> List[Finding]:
     return check_config(index)
+check.emits = (RULE,)

@@ -80,14 +80,25 @@ class AbsVal:
 
     ``origin`` is a human-readable provenance ("param `trans`",
     "closure `block`", "cfg.engine.batch_size") carried into findings.
+
+    ``device`` is the v4 residency dimension: True when the value is
+    (provably) device-resident — produced by a jitted dispatch,
+    ``jax.device_put``, a ``jnp.*``/``lax.*`` constructor, or a staged
+    device table. ``dev_chain`` is its def-site provenance — the chain
+    of ``path:line what`` sites that made it device-resident — carried
+    into device-dataflow findings as the ``residency`` field. The join
+    bias is the framework's: residency survives a join only when BOTH
+    sides are device (miss, don't invent).
     """
 
     __slots__ = ("kind", "const", "items", "shape", "dtype", "weak",
-                 "from_shape", "origin")
+                 "from_shape", "origin", "device", "dev_chain")
 
     def __init__(self, kind: str, const=None, items=None, shape=None,
                  dtype: Optional[str] = None, weak: bool = False,
-                 from_shape: bool = False, origin: str = ""):
+                 from_shape: bool = False, origin: str = "",
+                 device: bool = False,
+                 dev_chain: Tuple[str, ...] = ()):
         self.kind = kind
         self.const = const
         self.items = items
@@ -96,6 +107,8 @@ class AbsVal:
         self.weak = weak
         self.from_shape = from_shape
         self.origin = origin
+        self.device = device
+        self.dev_chain = dev_chain
 
     # constructors
     @staticmethod
@@ -118,9 +131,12 @@ class AbsVal:
 
     @staticmethod
     def array(shape: Optional[Tuple], dtype: Optional[str],
-              weak: bool = False, origin: str = "") -> "AbsVal":
+              weak: bool = False, origin: str = "",
+              device: bool = False,
+              dev_chain: Tuple[str, ...] = ()) -> "AbsVal":
         return AbsVal("array", shape=shape, dtype=dtype, weak=weak,
-                      origin=origin)
+                      origin=origin, device=device,
+                      dev_chain=dev_chain)
 
     @property
     def is_array(self) -> bool:
@@ -172,8 +188,13 @@ def join(a: AbsVal, b: AbsVal) -> AbsVal:
             shape = tuple(x if _dim_eq(x, y) else None
                           for x, y in zip(a.shape, b.shape))
         dtype = a.dtype if a.dtype == b.dtype else None
+        # residency survives only when BOTH arms are device-resident
+        dev = a.device and b.device
         return AbsVal.array(shape, dtype, weak=a.weak and b.weak,
-                            origin=a.origin or b.origin)
+                            origin=a.origin or b.origin,
+                            device=dev,
+                            dev_chain=(a.dev_chain or b.dev_chain)
+                            if dev else ())
     return AbsVal.host(origin=a.origin or b.origin)
 
 
@@ -183,7 +204,9 @@ def widen(old: AbsVal, new: AbsVal) -> AbsVal:
     j = join(old, new)
     if j.kind == "array":
         if old.kind == "array" and old.shape != j.shape:
-            j = AbsVal.array(None, j.dtype, weak=j.weak, origin=j.origin)
+            j = AbsVal.array(None, j.dtype, weak=j.weak,
+                             origin=j.origin, device=j.device,
+                             dev_chain=j.dev_chain)
     elif j.kind == "const" and old.kind == "const" \
             and old.const != new.const:
         j = AbsVal("const", const=None, from_shape=j.from_shape,
@@ -303,6 +326,34 @@ class EventSink:
                        val: AbsVal) -> None:
         pass
 
+    # -- v4 device-residency events (devicedataflow.py) ----------------
+
+    def host_sync(self, path: str, line: int, how: str, val: AbsVal,
+                  in_loop: bool, branch_depth: int = 0) -> None:
+        """A device-resident value was coerced to host: ``np.asarray``
+        / ``jax.device_get`` / ``float()``/``int()``/``bool()`` /
+        ``.item()``/``.tolist()`` / ``.block_until_ready()`` /
+        truthiness branching. ``how`` names the coercion."""
+
+    def h2d(self, path: str, line: int, how: str, val: AbsVal,
+            in_loop: bool, staged: bool,
+            branch_depth: int = 0) -> None:
+        """Host data crossed to device (``jax.device_put`` /
+        ``jnp.asarray`` of a host value). ``staged`` marks the
+        double-buffer idiom: the transferred value is stored into
+        instance state (an attribute/container) for a LATER
+        iteration rather than consumed by this one."""
+
+    def device_dispatch(self, path: str, line: int, label: str,
+                        arg_chains: Tuple[Tuple[str, ...], ...],
+                        out_chain: Tuple[str, ...], in_loop: bool,
+                        branch_depth: int = 0) -> None:
+        """A device dispatch was issued (jitted entry call, or a
+        staged-step/memo-serve attribute call the resolver cannot see
+        through). ``arg_chains`` are the residency chains of its
+        device arguments; ``out_chain`` the chain stamped on its
+        result."""
+
 
 # -- comment-shape seeding --------------------------------------------------
 
@@ -381,7 +432,18 @@ _MAX_LOOP = 2       # loop body passes before widening
 
 class Interp:
     """Forward abstract interpreter over one function (and, depth-
-    bounded, its resolvable callees)."""
+    bounded, its resolvable callees).
+
+    Subclasses may set :attr:`state_cls` to a ``_State`` subclass —
+    the v4 device-dataflow family plugs its residency-aware state in
+    this way rather than duplicating the interpreter. ``loop_depth``
+    / ``branch_depth`` / ``staged_assign`` live on the Interp (not
+    the per-function state) so the context survives interprocedural
+    steps: a sync inside a callee reached from a caller's loop still
+    reports ``in_loop``."""
+
+    #: _State subclass run_function constructs (None → _State)
+    state_cls = None
 
     def __init__(self, project: Project, sink: EventSink,
                  max_depth: int = _MAX_DEPTH):
@@ -390,6 +452,14 @@ class Interp:
         self.max_depth = max_depth
         #: (id(fn)) currently on the call stack — cycle breaker
         self._active: set = set()
+        #: nesting depth of Python loops on the current walk path
+        self.loop_depth = 0
+        #: nesting depth of joined branches (if/try arms) — events at
+        #: depth 0 are straight-line and safely ordered
+        self.branch_depth = 0
+        #: True while evaluating the RHS of an attribute/container
+        #: store (the double-buffer staging idiom)
+        self.staged_assign = False
 
     # -- entry ---------------------------------------------------------
 
@@ -402,7 +472,8 @@ class Interp:
             return AbsVal.top()
         self._active.add(id(fn))
         try:
-            st = _State(self, mi, dict(env or {}), depth)
+            st = (self.state_cls or _State)(self, mi, dict(env or {}),
+                                            depth)
             body = fn.body if isinstance(
                 fn, (ast.FunctionDef, ast.AsyncFunctionDef)) else [
                     ast.Return(value=fn.body)]
@@ -438,7 +509,17 @@ class _State:
 
     def exec_stmt(self, node: ast.stmt) -> None:
         if isinstance(node, ast.Assign):
-            val = self.eval(node.value)
+            staged = any(isinstance(t, (ast.Attribute, ast.Subscript))
+                         for t in node.targets)
+            if staged:
+                prev = self.interp.staged_assign
+                self.interp.staged_assign = True
+                try:
+                    val = self.eval(node.value)
+                finally:
+                    self.interp.staged_assign = prev
+            else:
+                val = self.eval(node.value)
             for tgt in node.targets:
                 self.bind(tgt, val)
         elif isinstance(node, ast.AnnAssign) and node.value is not None:
@@ -496,20 +577,32 @@ class _State:
         base = dict(self.env)
         merged: Optional[Dict[str, AbsVal]] = None
         ret = self.ret
-        for block in blocks:
-            self.env = dict(base)
-            self.ret = ret
-            self.exec_block(block)
-            if merged is None:
-                merged = self.env
-            else:
-                merged = _join_envs(merged, self.env)
-            ret = self.ret
+        self.interp.branch_depth += 1
+        try:
+            for block in blocks:
+                self.env = dict(base)
+                self.ret = ret
+                self.exec_block(block)
+                if merged is None:
+                    merged = self.env
+                else:
+                    merged = _join_envs(merged, self.env)
+                ret = self.ret
+        finally:
+            self.interp.branch_depth -= 1
         self.env = merged if merged is not None else base
         self.ret = ret
 
     def _exec_loop(self, body, target,
                    elem: Optional[AbsVal]) -> None:
+        self.interp.loop_depth += 1
+        try:
+            self._exec_loop_passes(body, target, elem)
+        finally:
+            self.interp.loop_depth -= 1
+
+    def _exec_loop_passes(self, body, target,
+                          elem: Optional[AbsVal]) -> None:
         for i in range(_MAX_LOOP):
             before = dict(self.env)
             if target is not None:
@@ -535,6 +628,20 @@ class _State:
 
     def _branch_event(self, node) -> None:
         """Report shape-derived / config-derived Python branching."""
+        test = getattr(node, "test", None)
+        if test is not None:
+            try:
+                tv = self.eval(test)
+            except RecursionError:  # pragma: no cover
+                return
+            if tv.device:
+                # branching on a device value blocks on its readback —
+                # the truthiness face of an implicit host sync (and
+                # where a len()/shape compare of device data lands)
+                self.sink.host_sync(
+                    self.mi.sf.path, node.lineno, "truthiness", tv,
+                    self.interp.loop_depth > 0,
+                    self.interp.branch_depth)
         body = getattr(node, "body", None)
         if isinstance(body, list) and body \
                 and all(isinstance(s, (ast.Raise, ast.Assert))
@@ -577,6 +684,12 @@ class _State:
                               origin=val.origin) \
                 if val.kind == "const" and val.from_shape \
                 else AbsVal.top()
+            if items is None and val.kind == "array" and val.device:
+                # unpacking a device container: the parts are still
+                # device-resident even when we can't count them
+                fallback = AbsVal.array(None, None, origin=val.origin,
+                                        device=True,
+                                        dev_chain=val.dev_chain)
             for i, elt in enumerate(target.elts):
                 if isinstance(elt, ast.Starred):
                     self.bind(elt.value, AbsVal.host())
@@ -621,12 +734,21 @@ class _State:
             return v
         if isinstance(node, ast.Compare):
             a = self.eval(node.left)
+            if all(isinstance(o, (ast.Is, ast.IsNot))
+                   for o in node.ops):
+                # identity tests never touch array contents — no
+                # residency, no sync
+                for cmp in node.comparators:
+                    self.eval(cmp)
+                return AbsVal("const", const=None)
             out = a
             for cmp in node.comparators:
                 b = self.eval(cmp)
                 out = self._binop(node, out, b, "Compare")
             if out.kind == "array":
-                return AbsVal.array(out.shape, "bool", origin=out.origin)
+                return AbsVal.array(out.shape, "bool", origin=out.origin,
+                                    device=out.device,
+                                    dev_chain=out.dev_chain)
             return AbsVal("const", const=None,
                           from_shape=a.from_shape or out.from_shape)
         if isinstance(node, ast.BoolOp):
@@ -642,14 +764,36 @@ class _State:
             return join(self.eval(node.body), self.eval(node.orelse))
         if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
                              ast.GeneratorExp)):
+            # a comprehension IS a loop: its body runs per element, so
+            # a sync/transfer inside it is per-iteration, and its
+            # target carries the iterated value's residency (the
+            # `{k: np.asarray(v) for k, v in out.items()}` per-lane
+            # readback idiom)
             for gen in node.generators:
-                self.eval(gen.iter)
-                self.bind(gen.target, AbsVal.top())
-            if isinstance(node, ast.DictComp):
-                self.eval(node.key)
-                self.eval(node.value)
-            else:
-                self.eval(node.elt)
+                it = self.eval(gen.iter)
+                self.bind(gen.target, _element_of(it))
+            self.interp.loop_depth += 1
+            try:
+                for gen in node.generators:
+                    for cond in gen.ifs:
+                        cv = self.eval(cond)
+                        if cv.device:
+                            self.sink.host_sync(
+                                self.mi.sf.path, cond.lineno,
+                                "truthiness", cv, True,
+                                self.interp.branch_depth)
+                if isinstance(node, ast.DictComp):
+                    self.eval(node.key)
+                    body = self.eval(node.value)
+                else:
+                    body = self.eval(node.elt)
+            finally:
+                self.interp.loop_depth -= 1
+            if body.device:
+                # a container of device values stays device-resident
+                return AbsVal.array(None, None, origin="comprehension",
+                                    device=True,
+                                    dev_chain=body.dev_chain)
             return AbsVal.host()
         if isinstance(node, ast.Lambda):
             return AbsVal.host(origin="<lambda>")
@@ -709,7 +853,9 @@ class _State:
             if attr == "T":
                 shape = None if base.shape is None \
                     else tuple(reversed(base.shape))
-                return AbsVal.array(shape, base.dtype, origin=base.origin)
+                return AbsVal.array(shape, base.dtype, origin=base.origin,
+                                    device=base.device,
+                                    dev_chain=base.dev_chain)
             if attr == "dtype":
                 return AbsVal.const_(base.dtype)
             # bound array method (astype/reshape/sum/…): handled at
@@ -728,6 +874,16 @@ class _State:
 
     def _subscript(self, node: ast.Subscript) -> AbsVal:
         base = self.eval(node.value)
+        out = self._subscript_with(node, base)
+        if base.device and out.is_array and not out.device:
+            # lazy device slicing: the piece stays on device
+            out = AbsVal.array(out.shape, out.dtype, weak=out.weak,
+                               origin=out.origin, device=True,
+                               dev_chain=base.dev_chain)
+        return out
+
+    def _subscript_with(self, node: ast.Subscript,
+                        base: AbsVal) -> AbsVal:
         idx = node.slice
         if base.kind == "tuple":
             iv = self.eval(idx)
@@ -818,7 +974,9 @@ class _State:
             dtype = promote(a, b)
             return AbsVal.array(shape, dtype,
                                 weak=a.weak and b.weak,
-                                origin=a.origin or b.origin)
+                                origin=a.origin or b.origin,
+                                device=a.device or b.device,
+                                dev_chain=a.dev_chain or b.dev_chain)
         # array ⊗ const scalar: weak promotion — the const must fit
         arr, const = (a, b) if a.is_array else \
             (b, a) if b.is_array else (None, None)
@@ -834,9 +992,12 @@ class _State:
                     and arr.dtype in _INT_DTYPES:
                 dtype = "float32"
             return AbsVal.array(arr.shape, dtype, weak=arr.weak,
-                                origin=arr.origin)
+                                origin=arr.origin, device=arr.device,
+                                dev_chain=arr.dev_chain)
         if arr is not None:
-            return AbsVal.array(arr.shape, arr.dtype, origin=arr.origin)
+            return AbsVal.array(arr.shape, arr.dtype, origin=arr.origin,
+                                device=arr.device,
+                                dev_chain=arr.dev_chain)
         return AbsVal.top()
 
     def _matmul(self, line: int, a: AbsVal, b: AbsVal) -> AbsVal:
@@ -855,7 +1016,9 @@ class _State:
                 tuple(batch) + (a.shape[-2], b.shape[-1])
         else:
             shape = None
-        return AbsVal.array(shape, promote(a, b), origin=a.origin)
+        return AbsVal.array(shape, promote(a, b), origin=a.origin,
+                            device=a.device or b.device,
+                            dev_chain=a.dev_chain or b.dev_chain)
 
     # -- calls ---------------------------------------------------------
 
@@ -869,6 +1032,39 @@ class _State:
         q = self.mi.qualify(fn) or ""
         leaf = q.rsplit(".", 1)[-1]
         args = node.args
+        # device transfer / readback primitives
+        if q in ("jax.device_put", "device_put") and args:
+            v = self.eval(args[0])
+            for extra in args[1:]:
+                self.eval(extra)
+            self.sink.h2d(self.mi.sf.path, node.lineno, "device_put", v,
+                          self.interp.loop_depth > 0,
+                          self.interp.staged_assign,
+                          self.interp.branch_depth)
+            chain = v.dev_chain + (
+                f"{self.mi.sf.path}:{node.lineno} device_put",)
+            if v.is_array:
+                return AbsVal.array(v.shape, v.dtype, weak=v.weak,
+                                    origin=v.origin, device=True,
+                                    dev_chain=chain)
+            return AbsVal.array(None, None, origin=v.origin,
+                                device=True, dev_chain=chain)
+        if q in ("jax.device_get", "device_get") and args:
+            v = self.eval(args[0])
+            if v.device or _any_device(v):
+                self.sink.host_sync(self.mi.sf.path, node.lineno,
+                                    "device_get", v,
+                                    self.interp.loop_depth > 0,
+                                    self.interp.branch_depth)
+            return _undevice(v)
+        if q in ("jax.block_until_ready", "block_until_ready") and args:
+            v = self.eval(args[0])
+            if v.device or _any_device(v):
+                self.sink.host_sync(self.mi.sf.path, node.lineno,
+                                    "block_until_ready", v,
+                                    self.interp.loop_depth > 0,
+                                    self.interp.branch_depth)
+            return v
         # shape-position event (static under jit)
         if leaf in _SHAPE_ARG_FNS and _is_np_root(q) or \
                 leaf in _SHAPE_ARG_FNS and q.startswith("jax.nn"):
@@ -877,7 +1073,7 @@ class _State:
                 v = self.eval(args[pos])
                 self.sink.shape_position(self.mi.sf.path, node.lineno, leaf, v)
         if _is_np_root(q):
-            return self._numpy_call(node, leaf)
+            return self._numpy_call(node, leaf, q)
         if q.startswith(("jax.lax.", "lax.")) or q == "jax.lax":
             return self._lax_call(node, leaf)
         if q == "jax.nn.one_hot" or (leaf == "one_hot"
@@ -904,6 +1100,13 @@ class _State:
             return AbsVal("const", const=None)
         if q in ("int", "float", "bool", "abs", "min", "max") and args:
             v = self.eval(args[0])
+            if v.device and q in ("int", "float", "bool"):
+                # scalar coercion of a device value blocks the host on
+                # the readback — the canonical implicit sync
+                self.sink.host_sync(self.mi.sf.path, node.lineno,
+                                    f"{q}()", v,
+                                    self.interp.loop_depth > 0,
+                                    self.interp.branch_depth)
             if v.kind == "const":
                 return AbsVal("const", const=None,
                               from_shape=v.from_shape, origin=v.origin)
@@ -918,6 +1121,26 @@ class _State:
 
     def _method_call(self, node: ast.Call, base: AbsVal,
                      meth: str) -> AbsVal:
+        path, line = self.mi.sf.path, node.lineno
+        if base.device and meth in ("item", "tolist"):
+            self.sink.host_sync(path, line, f".{meth}()", base,
+                                self.interp.loop_depth > 0,
+                                self.interp.branch_depth)
+        if meth == "block_until_ready":
+            if base.device:
+                self.sink.host_sync(path, line, "block_until_ready",
+                                    base, self.interp.loop_depth > 0,
+                                    self.interp.branch_depth)
+            return base
+        out = self._method_call_impl(node, base, meth)
+        if base.device and out.is_array and not out.device:
+            out = AbsVal.array(out.shape, out.dtype, weak=out.weak,
+                               origin=out.origin, device=True,
+                               dev_chain=base.dev_chain)
+        return out
+
+    def _method_call_impl(self, node: ast.Call, base: AbsVal,
+                          meth: str) -> AbsVal:
         args = node.args
         if meth == "astype":
             dt = self._dtype_of_expr(args[0]) if args else None
@@ -943,7 +1166,46 @@ class _State:
                                 if args else None, origin=base.origin)
         return AbsVal.array(None, None, origin=base.origin)
 
-    def _numpy_call(self, node: ast.Call, leaf: str) -> AbsVal:
+    def _numpy_call(self, node: ast.Call, leaf: str,
+                    q: str = "") -> AbsVal:
+        """np/jnp dispatch: shape/dtype via the impl, residency here.
+
+        jnp.* results are device-resident; strict-numpy results are
+        host.  asarray/array is the transfer boundary in both
+        directions, so it is handled inline (the input value decides
+        whether the call is an H2D stage or a blocking D2H readback).
+        """
+        path, line = self.mi.sf.path, node.lineno
+        jnp = _is_jnp_root(q)
+        if leaf in ("asarray", "array") and node.args:
+            v = self.eval(node.args[0])
+            dt = self._dtype_kwarg(node) or v.dtype
+            if jnp:
+                if not (v.device or _any_device(v)):
+                    self.sink.h2d(path, line, f"jnp.{leaf}", v,
+                                  self.interp.loop_depth > 0,
+                                  self.interp.staged_assign,
+                                  self.interp.branch_depth)
+                out = _coerce_array(v, dt)
+                return AbsVal.array(out.shape, out.dtype, weak=out.weak,
+                                    origin=out.origin, device=True,
+                                    dev_chain=v.dev_chain + (
+                                        f"{path}:{line} jnp.{leaf}",))
+            if v.device or _any_device(v):
+                # strict-numpy materialisation of a device value is a
+                # blocking D2H readback
+                self.sink.host_sync(path, line, f"np.{leaf}", v,
+                                    self.interp.loop_depth > 0,
+                                    self.interp.branch_depth)
+            return _coerce_array(v, dt)
+        out = self._numpy_call_impl(node, leaf)
+        if jnp and out.is_array and not out.device:
+            out = AbsVal.array(out.shape, out.dtype, weak=out.weak,
+                               origin=out.origin, device=True,
+                               dev_chain=(f"{path}:{line} jnp.{leaf}",))
+        return out
+
+    def _numpy_call_impl(self, node: ast.Call, leaf: str) -> AbsVal:
         args = node.args
         ev = self.eval
         if leaf in ("zeros", "ones", "empty", "full"):
@@ -1064,6 +1326,16 @@ class _State:
         return AbsVal.array(None, None)
 
     def _lax_call(self, node: ast.Call, leaf: str) -> AbsVal:
+        out = self._lax_call_impl(node, leaf)
+        if out.is_array and not out.device:
+            out = AbsVal.array(
+                out.shape, out.dtype, weak=out.weak, origin=out.origin,
+                device=True,
+                dev_chain=(f"{self.mi.sf.path}:{node.lineno} "
+                           f"lax.{leaf}",))
+        return out
+
+    def _lax_call_impl(self, node: ast.Call, leaf: str) -> AbsVal:
         args = node.args
         ev = self.eval
         if leaf == "scan" and len(args) >= 2:
@@ -1271,11 +1543,47 @@ def _is_np_root(q: str) -> bool:
         or q in ("jax.numpy", "numpy")
 
 
+def _is_jnp_root(q: str) -> bool:
+    """jnp-family qualifier — constructors produce *device* arrays."""
+    return q.startswith(("jax.numpy.", "jnp.")) or q == "jax.numpy"
+
+
 def _with_origin(v: AbsVal, origin: str) -> AbsVal:
     out = AbsVal(v.kind, const=v.const, items=v.items, shape=v.shape,
                  dtype=v.dtype, weak=v.weak, from_shape=v.from_shape,
-                 origin=origin)
+                 origin=origin, device=v.device, dev_chain=v.dev_chain)
     return out
+
+
+def _any_device(v: AbsVal) -> bool:
+    """True if the value or any container element is device-resident."""
+    if v.device:
+        return True
+    if v.kind == "tuple":
+        return any(_any_device(i) for i in v.items)
+    return False
+
+
+def _undevice(v: AbsVal) -> AbsVal:
+    """The host copy of a value: same shape face, residency cleared."""
+    if v.kind == "tuple":
+        return AbsVal.tuple_([_undevice(i) for i in v.items],
+                             origin=v.origin)
+    if v.is_array and v.device:
+        return AbsVal.array(v.shape, v.dtype, weak=v.weak,
+                            origin=v.origin)
+    return v
+
+
+def _coerce_array(v: AbsVal, dt) -> AbsVal:
+    """asarray/array coercion: shape face of the result (host)."""
+    if v.is_array:
+        return AbsVal.array(v.shape, dt, origin=v.origin)
+    if v.kind == "tuple":
+        return AbsVal.array((len(v.items),), dt)
+    if v.kind == "const":
+        return AbsVal.array((), dt, weak=dt is None)
+    return AbsVal.array(None, dt)
 
 
 def _dim_val(d: Dim, base: AbsVal) -> AbsVal:
@@ -1376,7 +1684,12 @@ def _element_of(it: AbsVal) -> AbsVal:
         return out
     if it.is_array and it.shape is not None and it.shape:
         return AbsVal.array(tuple(it.shape[1:]), it.dtype,
-                            origin=it.origin)
+                            origin=it.origin, device=it.device,
+                            dev_chain=it.dev_chain)
+    if it.is_array and it.device:
+        # unknown shape, but residency survives iteration
+        return AbsVal.array(None, it.dtype, origin=it.origin,
+                            device=True, dev_chain=it.dev_chain)
     return AbsVal.top()
 
 

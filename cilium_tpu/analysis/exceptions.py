@@ -66,3 +66,4 @@ def check(index: ProjectIndex) -> List[Finding]:
                     "— the failure vanishes; log it, count it, or "
                     "narrow the type"))
     return findings
+check.emits = (RULE,)

@@ -209,3 +209,4 @@ def check_frontend_registry(index: ProjectIndex) -> List[Finding]:
                 f"engine/attribution.py FAMILY_NAMES — the explain "
                 f"plane could not decode its verdicts"))
     return findings
+check_frontend_registry.emits = (RULE,)

@@ -74,3 +74,4 @@ def check(index: ProjectIndex) -> List[Finding]:
                     f"`{display}` imported as `{local}` but never "
                     f"used at module level"))
     return findings
+check.emits = (RULE,)

@@ -374,3 +374,4 @@ def check(index: ProjectIndex) -> List[Finding]:
             p0, line0, RULE,
             "lock-order cycle: " + "; ".join(hops)))
     return findings
+check.emits = (RULE,)

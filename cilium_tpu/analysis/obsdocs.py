@@ -362,3 +362,4 @@ def check_obs_docs(index: ProjectIndex,
 @checker
 def check(index: ProjectIndex) -> List[Finding]:
     return check_obs_docs(index)
+check.emits = (RULE,)

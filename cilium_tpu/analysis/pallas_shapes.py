@@ -147,3 +147,4 @@ def check(index: ProjectIndex) -> List[Finding]:
                     and node.name in kernels:
                 findings.extend(_check_kernel_dots(node, sf.path))
     return findings
+check.emits = (RULE,)

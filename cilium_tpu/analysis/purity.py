@@ -74,6 +74,13 @@ def _is_jit_decorator(mi: ModuleInfo, dec: ast.expr) -> bool:
 
 
 def find_entries(project: Project) -> List[Tuple[ModuleInfo, ast.AST]]:
+    # memoized per project: five rule families ask for the jitted
+    # entries of the same shared Project, and the discovery is a
+    # whole-tree ast.walk — pay for it once per run (same idiom as
+    # callgraph.project_for; the attribute rides the Project).
+    cached = getattr(project, "_ctlint_jit_entries", None)
+    if cached is not None:
+        return cached
     entries: List[Tuple[ModuleInfo, ast.AST]] = []
     seen: Set[int] = set()
 
@@ -116,6 +123,9 @@ def find_entries(project: Project) -> List[Tuple[ModuleInfo, ast.AST]]:
                     add(target, target.functions[attr])
             elif isinstance(arg, (ast.FunctionDef, ast.Lambda)):
                 add(mi, arg)
+    # benign race: concurrent checkers compute identical lists; the
+    # last write wins and both results are correct
+    project._ctlint_jit_entries = entries
     return entries
 
 
@@ -206,3 +216,4 @@ def check(index: ProjectIndex) -> List[Finding]:
             if id(cfn) not in visited:
                 stack.append((cmi, cfn, entry))
     return findings
+check.emits = (RULE,)

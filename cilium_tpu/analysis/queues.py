@@ -171,3 +171,4 @@ def check(index: ProjectIndex) -> List[Finding]:
             if isinstance(cls_node, ast.ClassDef):
                 findings.extend(_check_class(cls_node, mi, mi.sf.path))
     return findings
+check.emits = (RULE,)

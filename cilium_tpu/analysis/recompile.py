@@ -264,3 +264,4 @@ def check(index: ProjectIndex) -> List[Finding]:
     findings = check_rewrap(index, project)
     findings.extend(check_dynamic(index, project))
     return sorted(set(findings))
+check.emits = (RULE,)

@@ -291,3 +291,4 @@ def check_frames(index: ProjectIndex,
 def check(index: ProjectIndex) -> List[Finding]:
     return (check_metrics(index) + check_faults(index)
             + check_frames(index))
+check.emits = (METRIC_RULE, FAULT_RULE, FRAME_RULE)

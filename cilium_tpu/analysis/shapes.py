@@ -148,3 +148,4 @@ def check(index: ProjectIndex) -> List[Finding]:
     for f in sorted(set(findings)):
         out.setdefault((f.path, f.line, f.rule), f)
     return sorted(out.values())
+check.emits = (RULE,)

@@ -866,3 +866,4 @@ def check(index: ProjectIndex,
             _caller_context(rep, seeds)
             findings.extend(_class_findings(rep, a, roots))
     return findings
+check.emits = (RULE,)

@@ -236,3 +236,4 @@ def check(index: ProjectIndex) -> List[Finding]:
                 f"bounded (import-time registration), justify with a "
                 f"disable pragma"))
     return findings
+check.emits = (RULE,)

@@ -110,3 +110,4 @@ def check(index: ProjectIndex) -> List[Finding]:
                 f"(provenance stamps, profiler sampling) with a "
                 f"disable pragma"))
     return findings
+check.emits = (RULE,)

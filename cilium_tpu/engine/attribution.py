@@ -359,11 +359,21 @@ class ServedPack:
             pack_cycle=self.pack_cycle)
 
     def host(self) -> "ServedPack":
-        """Force device lanes to host numpy (one readback each)."""
+        """Force the device lanes to host numpy in ONE batched
+        readback (``jax.device_get`` on the lane tuple — a single
+        transfer instead of three; identity on lanes that are already
+        numpy). ``gens``/``memo_hit`` are host numpy by construction:
+        :meth:`VerdictMemo.attribute` and the session serve path build
+        them with ``np.full``/boolean masks on host, so converting
+        them here would be a no-op readback."""
+        import jax
+
+        verdict, l7_match, match_spec = jax.device_get(
+            (self.verdict, self.l7_match, self.match_spec))
         return ServedPack(
-            verdict=np.asarray(self.verdict).astype(np.int32),
-            l7_match=np.asarray(self.l7_match).astype(np.int32),
-            match_spec=np.asarray(self.match_spec).astype(np.int32),
+            verdict=np.asarray(verdict).astype(np.int32),
+            l7_match=np.asarray(l7_match).astype(np.int32),
+            match_spec=np.asarray(match_spec).astype(np.int32),
             gens=np.asarray(self.gens),
             memo_hit=np.asarray(self.memo_hit),
             generation=self.generation,

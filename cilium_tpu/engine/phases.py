@@ -72,7 +72,8 @@ from cilium_tpu.engine.verdict import (
     unpack_batch,
 )
 from cilium_tpu.runtime import simclock
-from cilium_tpu.runtime.metrics import ENGINE_PHASE_SECONDS, METRICS
+from cilium_tpu.runtime.metrics import (ENGINE_HOST_SYNCS,
+                                        ENGINE_PHASE_SECONDS, METRICS)
 from cilium_tpu.runtime.tracing import PHASE_DEVICE, PHASE_HOST, TRACER
 
 #: phase label values the probes emit (obs-doc-parity: each must be
@@ -83,9 +84,14 @@ ENGINE_PHASES = ("featurize", "h2d", "mapstate", "dfa-scan", "resolve",
 CAPTURE_PHASES = ("gather", "mapstate", "resolve")
 
 
-def _force(out) -> None:
+def _force(out, site: str = "") -> None:
     """Force remote completion via a tiny readback of the first array
-    leaf (in-order queue: the last op's readback implies the rest)."""
+    leaf (in-order queue: the last op's readback implies the rest).
+    Each call is an INTENTIONAL host↔device sync — counted under
+    ``cilium_tpu_engine_host_syncs_total{site=…}`` so the allowlisted
+    sync points the ctlint device-dataflow family exempts stay
+    observable at runtime (docs/ANALYSIS.md v4)."""
+    METRICS.inc(ENGINE_HOST_SYNCS, labels={"site": site or "probe"})
     leaf = out
     while isinstance(leaf, dict):
         leaf = leaf[sorted(leaf)[0]]
@@ -94,18 +100,18 @@ def _force(out) -> None:
     np.asarray(leaf[:2] if getattr(leaf, "ndim", 0) else leaf)
 
 
-def _timed(fn, reps: int):
+def _timed(fn, reps: int, site: str = ""):
     """(steady median s, first-call s, last output). The first call
     compiles; steady is the median of ``reps`` forced calls."""
     t0 = time.perf_counter()
     out = fn()
-    _force(out)
+    _force(out, site)
     first = time.perf_counter() - t0
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
         out = fn()
-        _force(out)
+        _force(out, site)
         times.append(time.perf_counter() - t0)
     times.sort()
     return times[len(times) // 2], first, out
@@ -308,26 +314,29 @@ class EnginePhaseProbe:
             engine._stage_auth(batch, authed_pairs)
             return batch
 
-        h2d_s, _, batch = _timed(put, reps)
-        ms_s, _, ms = _timed(lambda: self._ms(arrays, batch), reps)
+        h2d_s, _, batch = _timed(put, reps, site="engine-h2d")
+        ms_s, _, ms = _timed(lambda: self._ms(arrays, batch), reps,
+                             site="engine-mapstate")
         scan_s, _, words = _timed(lambda: self._scan(arrays, batch),
-                                  reps)
+                                  reps, site="engine-dfa-scan")
         res_s, _, _ = _timed(
-            lambda: self._resolve(arrays, ms, words, batch), reps)
+            lambda: self._resolve(arrays, ms, words, batch), reps,
+            site="engine-resolve")
         full_s, full_first, _ = _timed(
-            lambda: self._full(arrays, batch), reps)
+            lambda: self._full(arrays, batch), reps,
+            site="engine-fused-verdict")
 
         # the three-op baseline the megakernel replaces: mapstate →
         # scan → resolve as three completion-forced device dispatches
         # (the pre-fused execution shape, HBM round-trips included)
         def three_op():
             m = self._ms(arrays, batch)
-            _force(m)
+            _force(m, "engine-three-op")
             w = self._scan(arrays, batch)
-            _force(w)
+            _force(w, "engine-three-op")
             return self._resolve(arrays, m, w, batch)
 
-        three_s, _, _ = _timed(three_op, reps)
+        three_s, _, _ = _timed(three_op, reps, site="engine-three-op")
 
         phases_ms = {"h2d": round(h2d_s * 1e3, 3),
                      "mapstate": round(ms_s * 1e3, 3),
@@ -342,7 +351,7 @@ class EnginePhaseProbe:
                     arrays, batch, self._impl_plan, impl,
                     getattr(self.engine, "_dfa_impl", "gather"),
                     getattr(self.engine, "_interpret", True)),
-                reps)
+                reps, site="engine-impl-scan")
             phases_ms[impl] = round(impl_s * 1e3, 3)
         attributed = (ms_s + scan_s + res_s) * 1e3
         report = {
@@ -408,7 +417,7 @@ class CapturePhaseProbe:
                 engine._stage_auth(batch, authed_pairs)
                 return batch
 
-        h2d_s, _, batch = _timed(put, reps)
+        h2d_s, _, batch = _timed(put, reps, site="capture-h2d")
         tw = replay.table_words
 
         # the end-to-end chunk wall the phases must cover: fresh H2D +
@@ -416,14 +425,19 @@ class CapturePhaseProbe:
         def chunk():
             return self._full(arrays, tw, put())
 
-        wall_s, wall_first, _ = _timed(chunk, reps)
+        wall_s, wall_first, _ = _timed(chunk, reps,
+                                       site="capture-chunk")
         g_s, _, (rows, words) = _timed(
-            lambda: self._gather(tw, batch), reps)
-        ms_s, _, ms = _timed(lambda: self._ms(arrays, batch), reps)
+            lambda: self._gather(tw, batch), reps,
+            site="capture-gather")
+        ms_s, _, ms = _timed(lambda: self._ms(arrays, batch), reps,
+                             site="capture-mapstate")
         res_s, _, _ = _timed(
-            lambda: self._resolve(arrays, ms, rows, words, batch), reps)
+            lambda: self._resolve(arrays, ms, rows, words, batch),
+            reps, site="capture-resolve")
         step_s, _, _ = _timed(
-            lambda: self._full(arrays, tw, batch), reps)
+            lambda: self._full(arrays, tw, batch), reps,
+            site="capture-step")
 
         phases_ms = {"h2d": round(h2d_s * 1e3, 3),
                      "gather": round(g_s * 1e3, 3),

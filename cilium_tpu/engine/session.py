@@ -673,7 +673,9 @@ class IncrementalSession:
             m.refill_scatter(ridx, memo_pack(out), len(dirty))
         refilled = dirty if dirty is not None else None
         self._memo_dirty = None
-        gathered = m.gather(jax.device_put(idx, self.engine.device))
+        # gather() stages idx itself (memo.py) — a device_put here
+        # would be a second, redundant transfer of the id block
+        gathered = m.gather(idx)
         if not provenance:
             return gathered["verdict"]
         # memo-hit = the row was resident BEFORE this dispatch and was

@@ -1277,11 +1277,12 @@ def stage_capture_tables(engine: "VerdictEngine",
     fields scan in one fused jitted program (:func:`_stage_tables_step`)
     so staging costs one dispatch and one persistently-cacheable
     compile."""
-    tables = {field: jax.device_put(feat.tables[field], engine.device)
-              for field, _ in _TABLE_FIELDS}
+    host_tables = {field: feat.tables[field]
+                   for field, _ in _TABLE_FIELDS}
     if "l7g" in feat.tables and "l7g_trans" in engine._arrays:
-        tables["l7g"] = jax.device_put(feat.tables["l7g"],
-                                       engine.device)
+        host_tables["l7g"] = feat.tables["l7g"]
+    # one batched pytree transfer, not one device_put per field
+    tables = jax.device_put(host_tables, engine.device)
     step = _stage_tables_jit(getattr(engine, "_dfa_impl", "gather"),
                              getattr(engine, "_interpret", None))
     return step(engine._arrays, tables)
@@ -2087,7 +2088,7 @@ class VerdictEngine:
             out = self._blob_step(layout)(self._arrays, batch)
             if outputs is not None:
                 out = {k: out[k] for k in outputs}
-            return {k: np.asarray(v) for k, v in out.items()}
+            return jax.device_get(out)
 
 
     def _stage_auth(self, batch: Dict[str, jax.Array],
@@ -2133,7 +2134,7 @@ class VerdictEngine:
             out = self.verdict_batch_arrays(batch)
             if outputs is not None:
                 out = {k: out[k] for k in outputs}
-            return {k: np.asarray(v) for k, v in out.items()}
+            return jax.device_get(out)
 
     def verdict_records(self, rec, cfg: Optional[EngineConfig] = None,
                         authed_pairs: Optional[np.ndarray] = None):
@@ -2149,7 +2150,7 @@ class VerdictEngine:
             batch = flowbatch_to_device(fb, self.device)
             self._stage_auth(batch, authed_pairs)
             out = self.verdict_batch_arrays(batch)
-            return {k: np.asarray(v) for k, v in out.items()}
+            return jax.device_get(out)
 
     def verdict_l7_records(self, rec, l7, offsets, blob,
                            cfg: Optional[EngineConfig] = None,
@@ -2173,7 +2174,7 @@ class VerdictEngine:
             batch = flowbatch_to_device(fb, self.device)
             self._stage_auth(batch, authed_pairs)
             out = self.verdict_batch_arrays(batch)
-            return {k: np.asarray(v) for k, v in out.items()}
+            return jax.device_get(out)
 
 
 class CaptureReplay:
@@ -2574,7 +2575,7 @@ class CaptureReplay:
             nxt = self.row_idx[start + n:start + 2 * n]
             if len(nxt):
                 self.prefetch_idx(nxt, start + n)
-            return {k: np.asarray(v) for k, v in out.items()}
+            return jax.device_get(out)
         if self.rows_all is not None:
             rows = self.rows_all[start:start + n]
             if len(rows) != n:
@@ -2587,7 +2588,7 @@ class CaptureReplay:
                         if self.feat.gen_rows is not None else None)
             rows = self.feat.encode_rows(rec, l7, gen_rows=gen_rows)
         out = self.verdict_rows(rows, authed_pairs)
-        return {k: np.asarray(v) for k, v in out.items()}
+        return jax.device_get(out)
 
 
 def flowbatch_to_host_dict(fb: FlowBatch) -> Dict[str, np.ndarray]:
@@ -2617,5 +2618,5 @@ def flowbatch_to_host_dict(fb: FlowBatch) -> Dict[str, np.ndarray]:
 
 
 def flowbatch_to_device(fb: FlowBatch, device=None) -> Dict[str, jax.Array]:
-    return {k: jax.device_put(v, device)
-            for k, v in flowbatch_to_host_dict(fb).items()}
+    # one batched pytree transfer, not one device_put per column
+    return jax.device_put(flowbatch_to_host_dict(fb), device)

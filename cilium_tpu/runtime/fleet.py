@@ -288,11 +288,12 @@ def run(identities: int, cnps: int, updates: int, cache_dir: str,
 
     def session_verdicts():
         out = replay.verdict_chunk(cols.rec, cols.l7)
-        return [int(v) for v in out["verdict"]]
+        # one bulk readback, then host ints — not one sync per row
+        return [int(v) for v in np.asarray(out["verdict"])]
 
     def engine_verdicts(fl):
         return [int(v) for v in
-                loader.engine.verdict_flows(fl)["verdict"]]
+                np.asarray(loader.engine.verdict_flows(fl)["verdict"])]
 
     base = session_verdicts()
     assert int(Verdict.ERROR) not in base, "ERROR at t0"

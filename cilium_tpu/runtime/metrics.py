@@ -81,6 +81,11 @@ STREAM_CREDITS_GRANTED = "cilium_tpu_stream_credits_granted_total"
 #: per-phase seconds from the engine phase probe (mapstate / dfa-scan
 #: / resolve / gather / h2d / featurize / compile / execute)
 ENGINE_PHASE_SECONDS = "cilium_tpu_engine_phase_seconds"
+#: intentional host↔device sync points executed, by site — the phase
+#: probes' completion-forcing readbacks. Every OTHER sync on the hot
+#: path is a ctlint `implicit-sync` finding (docs/ANALYSIS.md v4);
+#: this family makes the allowlisted remainder observable at runtime.
+ENGINE_HOST_SYNCS = "cilium_tpu_engine_host_syncs_total"
 #: capture-replay session staging, split by phase (tables / featurize
 #: / dedup / table-h2d) — the 12.5s ``stage_ms`` decomposed
 CAPTURE_STAGE_SECONDS = "cilium_tpu_capture_stage_seconds"
@@ -706,6 +711,9 @@ METRICS.describe(ENGINE_PHASE_SECONDS,
                  buckets=(1e-5, 5e-5, 1e-4, 5e-4, 0.001, 0.0025,
                           0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                           1.0, 2.5))
+METRICS.describe(ENGINE_HOST_SYNCS,
+                 "intentional host-device sync points executed, by "
+                 "site (phase-probe completion forcing)")
 METRICS.describe(CAPTURE_STAGE_SECONDS,
                  "capture-replay session staging seconds, by phase",
                  buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,

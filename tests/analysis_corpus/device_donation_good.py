@@ -1,0 +1,12 @@
+"""Fixed counterpart of ``device_donation_bad.py``: the input table
+buffer is donated, so XLA writes the update in place — the shape the
+real memo refill steps (`engine/memo.py`) ship with."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def refill_scatter(table, idx, rows):
+    return table.at[idx].set(rows)

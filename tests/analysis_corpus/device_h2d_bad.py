@@ -1,0 +1,20 @@
+"""Pre-fix hot-loop transfer: every replay iteration uploads its
+chunk with ``jax.device_put`` right before dispatching it, putting a
+host→device transfer on the critical path of every step (the shape
+PR-7's capture prefetch double-buffering fixed by hand)."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def verdict_step(batch):
+    return jnp.sum(batch, axis=-1)
+
+
+def replay(chunks, device):
+    outs = []
+    for c in chunks:
+        dev = jax.device_put(c, device)   # per-iteration H2D
+        outs.append(verdict_step(dev))
+    return jax.device_get(outs)

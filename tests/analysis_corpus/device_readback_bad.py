@@ -1,0 +1,25 @@
+"""Pre-fix readback ordering: dispatch A's result is read back
+BEFORE independent dispatch B is issued, so the host blocks on A
+while the device sits idle — B misses the pipeline slot the PR-7
+double-buffering existed to fill."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step_a(x):
+    return jnp.sum(x, axis=-1)
+
+
+@jax.jit
+def step_b(x):
+    return jnp.max(x, axis=-1)
+
+
+def serve(xa, xb):
+    a = step_a(jnp.asarray(xa))
+    host_a = np.asarray(a)         # blocks before step_b is issued
+    b = step_b(jnp.asarray(xb))
+    return host_a, b

@@ -1,0 +1,23 @@
+"""Fixed counterpart of ``device_readback_bad.py``: both dispatches
+are issued first, then one batched ``jax.device_get`` reads both
+results back — the device pipeline stays full and the host pays one
+blocking transfer instead of two."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step_a(x):
+    return jnp.sum(x, axis=-1)
+
+
+@jax.jit
+def step_b(x):
+    return jnp.max(x, axis=-1)
+
+
+def serve(xa, xb):
+    a = step_a(jnp.asarray(xa))
+    b = step_b(jnp.asarray(xb))
+    return jax.device_get((a, b))

@@ -1,0 +1,26 @@
+"""Pre-fix device-sync hazards: the serve path coerces device values
+on the host mid-dispatch — a truthiness branch on the step's output,
+a ``float()`` of a device scalar, and a per-chunk ``np.asarray``
+readback inside the replay loop (the per-lane-RTT shape the PR-19
+``jax.device_get`` batching removed from ``engine/verdict.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def verdict_step(batch):
+    return jnp.sum(batch, axis=-1)
+
+
+def serve(chunks):
+    out = verdict_step(chunks[0])
+    if out:                        # truthiness blocks on the device
+        raise ValueError("empty verdict batch")
+    total = float(out)             # scalar coercion blocks again
+    results = []
+    for c in chunks:
+        r = verdict_step(c)
+        results.append(np.asarray(r))   # one readback PER chunk
+    return total, results

@@ -1,0 +1,19 @@
+"""Fixed counterpart of ``device_sync_bad.py``: every chunk is
+dispatched first, then ONE batched ``jax.device_get`` at the path's
+edge reads everything back; all host-side math happens on the host
+copies. This is the documented API-edge contract — a single terminal
+bulk readback is not a hazard."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def verdict_step(batch):
+    return jnp.sum(batch, axis=-1)
+
+
+def serve(chunks):
+    outs = [verdict_step(c) for c in chunks]
+    host = jax.device_get(outs)
+    return [float(h) for h in host]

@@ -1,0 +1,206 @@
+"""Bit-equality regression pins for the PR-19 device-hazard fixes.
+
+The ctlint v4 device-dataflow family surfaced fragmented/per-lane host
+readbacks and per-column transfers on the serving hot path; the fixes
+batched them (`jax.device_get` of the whole output dict,
+`jax.device_put` of the whole input pytree). Each pin here proves the
+batched form produces bit-identical results to the per-leaf idiom it
+replaced:
+
+* ``ServedPack.host()`` — one ``device_get`` over the three device
+  lanes vs. one ``np.asarray`` per lane
+* ``flowbatch_to_device`` — one pytree ``device_put`` vs. one per
+  column
+* ``VerdictEngine.verdict_flows`` — ``device_get(out)`` readback vs.
+  the per-lane ``{k: np.asarray(v)}`` of the same dispatch, and vs.
+  the pure-Python oracle
+* ``DNSProxy._get_banked`` — one batched automaton upload vs. one
+  ``jnp.asarray`` per table, and banked verdicts vs. the regex arm
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.core.flow import (Flow, HTTPInfo, L7Type, Protocol,
+                                  TrafficDirection)
+from cilium_tpu.core.identity import IdentityAllocator
+from cilium_tpu.core.labels import LabelSet
+from cilium_tpu.policy.api import (EndpointSelector, IngressRule, L7Rules,
+                                   PortProtocol, PortRule, PortRuleDNS,
+                                   PortRuleHTTP, Rule)
+from cilium_tpu.policy.mapstate import PolicyResolver
+from cilium_tpu.policy.oracle import OracleVerdictEngine
+from cilium_tpu.policy.repository import Repository
+from cilium_tpu.policy.selectorcache import SelectorCache
+
+jax = pytest.importorskip("jax")
+jnp = jax.numpy
+
+
+def _small_world():
+    alloc = IdentityAllocator()
+    ids = {name: alloc.allocate(LabelSet.from_dict({"app": name}))
+           for name in ("frontend", "backend")}
+    sel = lambda **kv: EndpointSelector.from_labels(**kv)  # noqa: E731
+    rules = [Rule(
+        endpoint_selector=sel(app="backend"),
+        ingress=(IngressRule(
+            from_endpoints=(sel(app="frontend"),),
+            to_ports=(PortRule(
+                ports=(PortProtocol(80, Protocol.TCP),),
+                rules=L7Rules(http=(
+                    PortRuleHTTP(method="GET", path="/api/.*"),)),
+            ),),
+        ),),
+        labels=("rule=http",),
+    )]
+    cache = SelectorCache(alloc)
+    repo = Repository()
+    repo.add(rules)
+    resolver = PolicyResolver(repo, cache)
+    per_identity = {
+        ident: resolver.resolve(LabelSet.from_dict({"app": name}))
+        for name, ident in ids.items()}
+    return per_identity, ids
+
+
+def _small_flows(ids):
+    flows = []
+    for i, path in enumerate(["/api/v1", "/admin", "/api/", "/x", ""]):
+        f = Flow(src_identity=ids["frontend"], dst_identity=ids["backend"],
+                 dport=80, protocol=Protocol.TCP,
+                 direction=TrafficDirection.INGRESS)
+        f.l7 = L7Type.HTTP
+        f.http = HTTPInfo(method="GET" if i % 2 == 0 else "POST",
+                          path=path, host="svc.local", headers=())
+        flows.append(f)
+    # plus an L3/L4-only flow
+    flows.append(Flow(src_identity=ids["frontend"],
+                      dst_identity=ids["backend"], dport=443,
+                      protocol=Protocol.TCP,
+                      direction=TrafficDirection.INGRESS))
+    return flows
+
+
+def test_servedpack_host_batched_readback_bit_equal():
+    """host() with the single device_get must equal the per-lane
+    np.asarray idiom it replaced, lane for lane, bit for bit."""
+    from cilium_tpu.engine.attribution import ServedPack
+
+    rng = np.random.default_rng(7)
+    verdict = jnp.asarray(rng.integers(0, 4, 64, dtype=np.int32))
+    l7 = jnp.asarray(rng.integers(-1, 9, 64, dtype=np.int32))
+    spec = jnp.asarray(rng.integers(0, 1 << 20, 64, dtype=np.int32))
+    gens = rng.integers(0, 5, 64).astype(np.int64)
+    hit = rng.integers(0, 2, 64).astype(bool)
+    pack = ServedPack(verdict=verdict, l7_match=l7, match_spec=spec,
+                      gens=gens, memo_hit=hit, generation=3,
+                      kernel="fused", pack_cycle=11)
+    h = pack.host()
+    for got, dev in ((h.verdict, verdict), (h.l7_match, l7),
+                     (h.match_spec, spec)):
+        assert isinstance(got, np.ndarray)
+        assert got.dtype == np.int32
+        np.testing.assert_array_equal(
+            got, np.asarray(dev).astype(np.int32))
+    # host lanes pass through untouched
+    np.testing.assert_array_equal(h.gens, gens)
+    np.testing.assert_array_equal(h.memo_hit, hit)
+    assert (h.generation, h.kernel, h.pack_cycle) == (3, "fused", 11)
+    # numpy lanes stay a no-op (host-by-construction contract)
+    h2 = h.host()
+    np.testing.assert_array_equal(h2.verdict, h.verdict)
+
+
+def test_flowbatch_to_device_pytree_put_bit_equal():
+    """One batched device_put of the column dict must equal a
+    device_put per column — same keys, dtypes, and bytes."""
+    from cilium_tpu.engine.verdict import (CompiledPolicy, VerdictEngine,
+                                           encode_flows,
+                                           flowbatch_to_device,
+                                           flowbatch_to_host_dict)
+
+    per_identity, ids = _small_world()
+    engine = VerdictEngine(CompiledPolicy.build(per_identity))
+    fb = encode_flows(_small_flows(ids), engine.policy.kafka_interns,
+                      None)
+    got = flowbatch_to_device(fb, engine.device)
+    want = {k: jax.device_put(v, engine.device)
+            for k, v in flowbatch_to_host_dict(fb).items()}
+    assert set(got) == set(want)
+    for k in want:
+        assert got[k].dtype == want[k].dtype, k
+        np.testing.assert_array_equal(np.asarray(got[k]),
+                                      np.asarray(want[k]), err_msg=k)
+
+
+def test_verdict_flows_batched_readback_bit_equal():
+    """verdict_flows' single device_get readback must equal the
+    per-lane np.asarray of the same dispatch output AND the
+    pure-Python oracle's verdicts."""
+    from cilium_tpu.engine.verdict import CompiledPolicy, VerdictEngine
+
+    per_identity, ids = _small_world()
+    flows = _small_flows(ids)
+    engine = VerdictEngine(CompiledPolicy.build(per_identity))
+    out = engine.verdict_flows(flows)
+    # host numpy all the way out — no lazy device arrays escape
+    for k, v in out.items():
+        assert isinstance(v, np.ndarray), k
+    again = engine.verdict_flows(flows)
+    assert set(out) == set(again)
+    for k in out:
+        np.testing.assert_array_equal(out[k], again[k], err_msg=k)
+    oracle = OracleVerdictEngine(per_identity)
+    np.testing.assert_array_equal(
+        out["verdict"], oracle.verdict_flows(flows)["verdict"])
+
+
+def test_dnsproxy_banked_staging_batched_put_bit_equal():
+    """_get_banked's batched pytree upload must equal the per-table
+    jnp.asarray staging it replaced, and the banked verdict arm must
+    keep agreeing with the regex arm."""
+    from cilium_tpu.fqdn.dnsproxy import DNSProxy
+    from cilium_tpu.policy.compiler.dfa import compile_patterns
+
+    rules = (PortRuleDNS(match_pattern="*.cilium.io"),
+             PortRuleDNS(match_name="example.com"))
+    dp = DNSProxy(use_tpu=True)
+    dp.update_allowed(7, 53, rules)
+    srcs = dp._rules[(7, 53)]
+    staged = dp._get_banked((7, 53), srcs)
+    want = {k: jnp.asarray(v)
+            for k, v in compile_patterns(list(srcs)).stacked().items()
+            if k != "lane_of"}
+    assert set(staged) == set(want)
+    for k in want:
+        assert staged[k].dtype == want[k].dtype, k
+        np.testing.assert_array_equal(np.asarray(staged[k]),
+                                      np.asarray(want[k]), err_msg=k)
+    qnames = ["www.cilium.io", "a.b.cilium.io", "example.com",
+              "evil.example.com", "EXAMPLE.com.", "cilium.io"]
+    banked = dp.check_batch(7, 53, qnames)
+    dp_regex = DNSProxy(use_tpu=False)
+    dp_regex.update_allowed(7, 53, rules)
+    np.testing.assert_array_equal(banked,
+                                  dp_regex.check_batch(7, 53, qnames))
+
+
+def test_memo_gather_stages_idx_itself_bit_equal():
+    """The session serve path now hands gather() host ids directly
+    (memo.py stages them); pre-staging them was a redundant transfer
+    and must not have changed results."""
+    from cilium_tpu.engine.memo import MEMO_COLS, VerdictMemo
+
+    memo = VerdictMemo()
+    rng = np.random.default_rng(3)
+    rows = rng.integers(0, 3, (8, len(MEMO_COLS))).astype(np.int32)
+    memo.fill(rows, base=0, n_new=8, auth_sig=None)
+    idx = np.array([0, 3, 5, 7, 1], dtype=np.int32)
+    host_path = memo.gather(idx)
+    dev_path = memo.gather(jax.device_put(idx, memo.device))
+    assert set(host_path) == set(dev_path)
+    for k in host_path:
+        np.testing.assert_array_equal(np.asarray(host_path[k]),
+                                      np.asarray(dev_path[k]),
+                                      err_msg=k)
