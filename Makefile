@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test shim lint precommit determinism dryrun chaos obs soak churn \
         churn-fleet churn-fleet-smoke dst dst-validate serve-soak \
-        serve-fleet serve-fleet-smoke \
+        serve-fleet serve-fleet-smoke canary canary-smoke \
         bench bench-all bench-e2e bench-service bench-regen bench-sp \
         bench-stage bench-stream bench-kernel bench-multichip \
         bench-protocols bench-watch perf-report check
@@ -129,6 +129,29 @@ serve-fleet-smoke: ## serving-fleet driver at check-sized smoke scale
 	    --streams 2000 --hosts 4 --virtual-s 60 --storm-size 200 \
 	    --no-p99-gate --min-handoffs 1 \
 	    --out /tmp/BENCH_FLEET_SERVE_smoke.jsonl
+
+# canary: the ISSUE-20 acceptance lane — shadow/canary policy rollout
+# through a live ServeLoop (runtime/canary.py): stage a PLANTED bad
+# generation (every verdict flipped to deny) as N+1 beside serving N,
+# double-dispatch a sampled fraction of ring traffic through both
+# engines in the same pack cycle, and prove the verdict-diff gate
+# REFUSES the commit before a single bad verdict is served; then a
+# clean rollout through the same pipeline must commit. Gates:
+# diff_caught + serving_untouched + clean_committed + clean_verdicts
+# + sampled, and double-dispatch overhead <= 5% of pack-cycle wall.
+# One provenance-stamped line lands in BENCH_CANARY_r09.jsonl
+# (consumed by perf-report, whose canary-budget gate holds the
+# declared budget across rounds).
+canary:          ## shadow-rollout verdict-diff gate + overhead budget
+	JAX_PLATFORMS=cpu $(PY) -m cilium_tpu.runtime.canary \
+	    --out BENCH_CANARY_r09.jsonl
+
+# the smoke face of the same driver — small enough for `make check`;
+# every gate stays armed (the lane is virtual-time cheap already)
+canary-smoke:    ## canary rollout driver at check-sized smoke scale
+	JAX_PLATFORMS=cpu $(PY) -m cilium_tpu.runtime.canary \
+	    --chunks 48 --pool-chunks 12 \
+	    --out /tmp/BENCH_CANARY_smoke.jsonl
 
 # churn: the ISSUE-8 acceptance soak — sustained CNP add/delete +
 # FQDN pattern churn through a live replay session across ≥50
@@ -271,4 +294,4 @@ bench-watch:     ## probe until the tunnel answers, then capture the sweep
 perf-report:     ## bench trajectory + regression gate
 	$(PY) -m cilium_tpu.perf_report --root . --out PERF_TRAJECTORY.json
 
-check: shim lint test determinism dryrun obs churn-fleet-smoke serve-fleet-smoke bench-multichip perf-report   ## the full CI gate
+check: shim lint test determinism dryrun obs churn-fleet-smoke serve-fleet-smoke canary-smoke bench-multichip perf-report   ## the full CI gate

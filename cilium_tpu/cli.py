@@ -411,6 +411,32 @@ def cmd_explain(args) -> int:
     return 0 if ok else 1
 
 
+def cmd_canary(args) -> int:
+    """Shadow/canary rollout status (`/v1/canary`): the staged
+    generation, the live verdict-diff ledger, and the commit gate's
+    decision surface. Exit status mirrors the gate: 0 while the
+    rollout is healthy (idle/sampling/committed), 1 when the staged
+    generation was refused or aborted — scriptable as a rollout
+    health probe."""
+    resp = _api(args).canary()
+    if args.json:
+        print(json.dumps(resp, indent=2, default=str))
+    else:
+        state = resp.get("state", "idle")
+        if state == "idle":
+            print("canary: idle (no staged generation)")
+        else:
+            print(f"canary: {state} — staged revision "
+                  f"{resp.get('revision', resp.get('staged_revision'))}"
+                  f", {resp.get('samples', 0)} sampled verdicts, "
+                  f"{resp.get('diffs', 0)} diffs "
+                  f"(diff_fraction {resp.get('diff_fraction', 0.0)}, "
+                  f"budget {resp.get('diff_budget', 0.0)})")
+            if resp.get("reason"):
+                print(f"  reason: {resp['reason']}")
+    return 1 if resp.get("state") in ("refused", "aborted") else 0
+
+
 def cmd_trace(args) -> int:
     """Dump the live agent's flight recorder (`/v1/trace`).
 
@@ -1101,6 +1127,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--json", action="store_true",
                    help="raw JSON instead of the summary lines")
     p.set_defaults(fn=cmd_explain)
+
+    p = sub.add_parser(
+        "canary",
+        help="shadow/canary rollout status: staged generation, "
+             "verdict-diff ledger, commit-gate decision")
+    p.add_argument("--api", required=True, help="agent REST api socket")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON instead of the summary lines")
+    p.set_defaults(fn=cmd_canary)
 
     p = sub.add_parser("inspect", help="dump a compiled-policy artifact")
     p.add_argument("artifact")

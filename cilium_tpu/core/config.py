@@ -327,6 +327,62 @@ class ProvenanceConfig:
 
 
 @dataclasses.dataclass
+class TenantConfig:
+    """Multi-tenant control plane (runtime/tenant.py): identity
+    ranges partition the policy plane into tenant namespaces carried
+    through bank keys (one tenant's churn/quarantine never recompiles
+    another's banks), the AdmissionGate and CompileQueue run
+    weighted-fair per-tenant quanta with per-tenant occupancy bounds
+    (a storming tenant sheds ``tenant-quota``; everyone else stays in
+    SLO), and the serve/SLO/explain planes carry the tenant label."""
+
+    enabled: bool = False
+    #: the namespace of identities matching no declared range (and of
+    #: requests that carry no tenant)
+    default_tenant: str = "default"
+    #: identity-range → tenant declarations, ``"name:lo-hi"`` each
+    #: (inclusive numeric identity bounds); first match wins
+    ranges: Tuple[str, ...] = ()
+    #: per-tenant fair-queueing weights, ``"name:weight"`` each;
+    #: undeclared tenants weigh 1.0
+    weights: Tuple[str, ...] = ()
+    #: per-tenant occupancy ceiling as a fraction of each bounded
+    #: surface (admission window, compile-queue pending): one tenant
+    #: can burst into idle capacity but never squat past this share
+    #: while others are waiting
+    max_share: float = 0.5
+    #: fairness quantum: the admission fair-share window rotates every
+    #: this many virtual seconds (exact-tick boundary, pinned by
+    #: tests/dst/test_boundaries.py)
+    quantum_s: float = 1.0
+    #: quota-store entry TTL: a per-tenant share not refreshed within
+    #: this lapses to the conservative default (``tenant.quota`` fault
+    #: point models the read loss)
+    quota_ttl_s: float = 60.0
+
+
+@dataclasses.dataclass
+class CanaryConfig:
+    """Shadow/canary policy rollout (runtime/canary.py): generation
+    N+1 stages alongside the serving N, a sample fraction of ring
+    traffic double-dispatches through both in the same pack cycle,
+    and commit is REFUSED when the verdict-diff fraction exceeds the
+    declared budget — a bad rollout is caught by the diff, not by
+    dropped traffic."""
+
+    enabled: bool = False
+    #: fraction of ring chunks double-dispatched through the staged
+    #: engine (deterministic counter-based selection — no RNG)
+    sample_fraction: float = 0.25
+    #: commit gate: the observed verdict-diff fraction must stay at or
+    #: under this for ``commit`` to proceed (0.0 = any diff refuses)
+    diff_budget: float = 0.0
+    #: minimum sampled verdicts before the gate will pass a commit
+    #: (an unsampled canary never auto-passes)
+    min_samples: int = 64
+
+
+@dataclasses.dataclass
 class ParallelConfig:
     """Mesh / sharding layout (SURVEY.md §2.6)."""
 
@@ -388,6 +444,8 @@ class Config:
         default_factory=ProvenanceConfig)
     dst: DSTConfig = dataclasses.field(default_factory=DSTConfig)
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
+    tenant: TenantConfig = dataclasses.field(default_factory=TenantConfig)
+    canary: CanaryConfig = dataclasses.field(default_factory=CanaryConfig)
     log_level: str = "info"
     #: ``--k8s-api-socket``: when set, the agent consumes CNP/CCNP
     #: from the fake-apiserver (cilium_tpu.k8s) through list+watch
@@ -514,6 +572,38 @@ class Config:
         if "CILIUM_TPU_FLEET_SPILL_HEADROOM" in env:
             cfg.fleet.spill_headroom = float(
                 env["CILIUM_TPU_FLEET_SPILL_HEADROOM"])
+        if env.get("CILIUM_TPU_TENANT_ISOLATION", "").lower() in (
+                "1", "true", "yes"):
+            cfg.tenant.enabled = True
+        if "CILIUM_TPU_TENANT_RANGES" in env:
+            cfg.tenant.ranges = tuple(
+                s for s in env["CILIUM_TPU_TENANT_RANGES"].split(",")
+                if s)
+        if "CILIUM_TPU_TENANT_WEIGHTS" in env:
+            cfg.tenant.weights = tuple(
+                s for s in env["CILIUM_TPU_TENANT_WEIGHTS"].split(",")
+                if s)
+        if "CILIUM_TPU_TENANT_MAX_SHARE" in env:
+            cfg.tenant.max_share = float(
+                env["CILIUM_TPU_TENANT_MAX_SHARE"])
+        if "CILIUM_TPU_TENANT_QUANTUM_S" in env:
+            cfg.tenant.quantum_s = float(
+                env["CILIUM_TPU_TENANT_QUANTUM_S"])
+        if "CILIUM_TPU_TENANT_QUOTA_TTL_S" in env:
+            cfg.tenant.quota_ttl_s = float(
+                env["CILIUM_TPU_TENANT_QUOTA_TTL_S"])
+        if env.get("CILIUM_TPU_CANARY", "").lower() in (
+                "1", "true", "yes"):
+            cfg.canary.enabled = True
+        if "CILIUM_TPU_CANARY_SAMPLE_FRACTION" in env:
+            cfg.canary.sample_fraction = float(
+                env["CILIUM_TPU_CANARY_SAMPLE_FRACTION"])
+        if "CILIUM_TPU_CANARY_DIFF_BUDGET" in env:
+            cfg.canary.diff_budget = float(
+                env["CILIUM_TPU_CANARY_DIFF_BUDGET"])
+        if "CILIUM_TPU_CANARY_MIN_SAMPLES" in env:
+            cfg.canary.min_samples = int(
+                env["CILIUM_TPU_CANARY_MIN_SAMPLES"])
         return cfg
 
     @classmethod
@@ -543,7 +633,9 @@ class Config:
                                 ("slo", cfg.slo),
                                 ("provenance", cfg.provenance),
                                 ("dst", cfg.dst),
-                                ("fleet", cfg.fleet)):
+                                ("fleet", cfg.fleet),
+                                ("tenant", cfg.tenant),
+                                ("canary", cfg.canary)):
             for k, v in data.get(section, {}).items():
                 if hasattr(target, k):
                     setattr(target, k, tuple(v) if isinstance(v, list) else v)

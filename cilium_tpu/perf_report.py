@@ -116,7 +116,12 @@ _EXTRA_KEYS = ("tunnel_rtt_ms", "tunnel_rtt_max_ms", "stage_ms",
                "stitch_coverage", "handoff_replays",
                "flows_aggregated", "flow_keys", "journal_events",
                "failover_p99_ms", "obs_overhead_pct",
-               "obs_budget_pct")
+               "obs_budget_pct",
+               # canary lane (ISSUE 20): shadow double-dispatch cost
+               # and the verdict-diff gate's evidence
+               "canary_overhead_pct", "canary_budget_pct",
+               "canary_samples", "canary_diffs", "diff_caught",
+               "diff_fraction", "bad_verdicts_served")
 
 
 def _entry(source: str, kind: str, obj: Dict,
@@ -476,6 +481,61 @@ def obs_budget_violations(entries: List[Dict],
     return out
 
 
+def canary_budget_violations(entries: List[Dict],
+                             newest: Optional[int]) -> List[Dict]:
+    """The canary double-dispatch gate (ISSUE 20): a lane that
+    DECLARES a canary budget (``canary_budget_pct`` — the canary
+    rollout lane declares 5.0%) is held to its measured
+    ``canary_overhead_pct``, the pack-cycle wall fraction spent
+    shadow-dispatching sampled traffic through the staged generation.
+    A lane that declares a budget must also have CAUGHT its planted
+    bad rollout (``diff_caught``) — a canary plane that is cheap but
+    blind fails the gate too. Only the NEWEST round gates; lanes
+    without a declared budget are not judged."""
+    out = []
+    for e in entries:
+        if e["status"] != "ok" or e["round"] != newest:
+            continue
+        budget = e["extras"].get("canary_budget_pct")
+        if budget is None:
+            continue
+        measured = e["extras"].get("canary_overhead_pct")
+        if measured is not None and float(measured) > float(budget):
+            out.append({
+                "metric": f"{e['metric']}[canary]",
+                "kind": e["kind"],
+                "from": e["round_label"],
+                "to": e["round_label"],
+                "from_value": float(budget),
+                "to_value": float(measured),
+                "direction": "lower",
+                "worse_factor": round(
+                    float(measured) / max(float(budget), 1e-9), 4),
+                "classification": "code_regression",
+                "reason": (f"canary double-dispatch overhead "
+                           f"{float(measured):g}% over its declared "
+                           f"budget {float(budget):g}% — shadow "
+                           f"evaluation got expensive"),
+            })
+        caught = e["extras"].get("diff_caught")
+        if caught is False:
+            out.append({
+                "metric": f"{e['metric']}[canary-gate]",
+                "kind": e["kind"],
+                "from": e["round_label"],
+                "to": e["round_label"],
+                "from_value": 1.0,
+                "to_value": 0.0,
+                "direction": "higher",
+                "worse_factor": 0.0,
+                "classification": "code_regression",
+                "reason": ("the planted bad-policy rollout was NOT "
+                           "refused by the verdict-diff gate — the "
+                           "canary plane went blind"),
+            })
+    return out
+
+
 # -- trajectory + classification --------------------------------------------
 
 def _effective_rtt(entry: Dict) -> Tuple[Optional[float], str]:
@@ -686,6 +746,7 @@ def build_trajectory(entries: List[Dict],
     provenance_violations = provenance_budget_violations(entries,
                                                          newest)
     obs_violations = obs_budget_violations(entries, newest)
+    canary_violations = canary_budget_violations(entries, newest)
     return {
         "schema": TRAJECTORY_SCHEMA,
         "threshold": threshold,
@@ -698,7 +759,8 @@ def build_trajectory(entries: List[Dict],
         "gate_regressions": (gate + budget_violations
                              + collective_violations
                              + provenance_violations
-                             + obs_violations),
+                             + obs_violations
+                             + canary_violations),
     }
 
 

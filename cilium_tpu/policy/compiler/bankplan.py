@@ -119,14 +119,29 @@ def bank_boundary(pattern: str, target: int) -> bool:
     return zlib.crc32(pattern.encode("utf-8")) % max(1, target) == 0
 
 
-def partition_patterns(patterns: Sequence[str],
-                       target: int) -> List[Tuple[str, ...]]:
+def partition_patterns(patterns: Sequence[str], target: int,
+                       namer=None) -> List[Tuple[str, ...]]:
     """Content-defined partition of a pattern set into bank groups.
 
     A pure function of ``set(patterns)`` and ``target`` (sorted walk +
     per-pattern hash boundaries): add-then-delete of any subset returns
     the exact original groups, and an add/delete perturbs only the
-    group(s) adjacent to the touched patterns."""
+    group(s) adjacent to the touched patterns.
+
+    With a ``namer`` (pattern → tenant namespace, ISSUE 20) the
+    universe is first split by namespace and each namespace partitions
+    INDEPENDENTLY — a tenant's pattern add/delete can only perturb
+    groups inside its own namespace, so no tenant's churn ever shifts
+    another tenant's bank membership. Namespace order is sorted, so
+    the overall group list stays a pure function of the set."""
+    if namer is not None:
+        by_ns: Dict[str, List[str]] = {}
+        for p in set(patterns):
+            by_ns.setdefault(namer(p), []).append(p)
+        groups: List[Tuple[str, ...]] = []
+        for ns in sorted(by_ns):
+            groups.extend(partition_patterns(by_ns[ns], target))
+        return groups
     if faults.mutation_active("positional-banks"):
         # DST planted bug (the pre-ISSUE-8 positional grouping): one
         # delete shifts every later bank → O(policy) recompiles per
@@ -150,9 +165,18 @@ def partition_patterns(patterns: Sequence[str],
     return groups
 
 
-def bank_key(patterns: Tuple[str, ...], opts: Tuple) -> str:
+def bank_key(patterns: Tuple[str, ...], opts: Tuple,
+             namespace: str = "") -> str:
     """Cross-process-stable content address of one bank group (pattern
-    tuple + compile options), like the checkpoint fingerprints."""
+    tuple + compile options), like the checkpoint fingerprints. A
+    tenant NAMESPACE folds into the key only when non-empty, so
+    single-tenant deployments keep their pre-tenant keys (pinned
+    registries/artifacts stay warm across the upgrade) while two
+    tenants sharing a pattern text still own distinct banks —
+    quarantining one can never serve or invalidate the other's."""
+    if namespace:
+        return ruleset_fingerprint(BANK_FORMAT, patterns, opts,
+                                   ("ns", namespace))
     return ruleset_fingerprint(BANK_FORMAT, patterns, opts)
 
 
@@ -289,6 +313,16 @@ class BankRegistry:
         #: loader writes it after every successful stage; pruned to
         #: live groups so it can't outgrow the bounded store)
         self.kernel_picks: Dict[str, str] = {}
+        #: pattern → tenant namespace (None = tenant-blind): the
+        #: loader installs it from the TenantMap before a regeneration
+        #: so the partition, the bank keys, and the queue's fair-share
+        #: attribution all see the same namespace split (ISSUE 20)
+        self.namer = None
+        #: bank key → tenant namespace, the attribution index the DST
+        #: tenant-isolation invariant reads; pruned alongside
+        #: kernel_picks so it can't outgrow the bounded store
+        # ctlint: disable=unbounded-registry  # pruned with the cover index
+        self.namespaces: Dict[str, str] = {}
 
     @property
     def bytes(self) -> int:
@@ -354,6 +388,9 @@ class BankRegistry:
                                if k in live}
                 self.kernel_picks = {
                     k: v for k, v in self.kernel_picks.items()
+                    if k in live}
+                self.namespaces = {
+                    k: v for k, v in self.namespaces.items()
                     if k in live}
         return True
 
@@ -485,13 +522,16 @@ class BankRegistry:
         for key, q in expired:
             fn = functools.partial(self._compile_group, q.group,
                                    q.opts)
+            with self._meta:
+                ns = self.namespaces.get(key, "")
             try:
                 self.queue.submit(
                     work_key(key), fn, prio=PRIO_BACKGROUND,
                     on_done=functools.partial(
                         self._task_done, key, q.field, q.group,
                         q.opts),
-                    payload_bytes=sum(len(p) for p in q.group))
+                    payload_bytes=sum(len(p) for p in q.group),
+                    tenant=ns)
             except QueueDraining:
                 break
             n += 1
@@ -509,7 +549,9 @@ class BankRegistry:
         opts = (cfg.max_dfa_states, cfg.max_quantifier,
                 bool(case_insensitive))
         now = self.clock()
-        groups = partition_patterns(patterns, cfg.bank_size)
+        namer = self.namer
+        groups = partition_patterns(patterns, cfg.bank_size,
+                                    namer=namer)
 
         #: per-partition-slot outcome — assembly happens strictly in
         #: partition order afterwards, so the bank stack, lane
@@ -521,7 +563,14 @@ class BankRegistry:
         to_wait: List[Tuple[int, str, Tuple[str, ...], object]] = []
 
         for si, group in enumerate(groups):
-            key = bank_key(group, opts)
+            # every pattern of a group shares one namespace (the
+            # partition split by namespace first), so the first
+            # member names the group
+            ns = namer(group[0]) if namer is not None else ""
+            key = bank_key(group, opts, namespace=ns)
+            if ns:
+                with self._meta:
+                    self.namespaces[key] = ns
             cached = self._get(key)
             if cached is not None:
                 slots[si] = (LIVE, key, cached, "reused")
@@ -555,7 +604,8 @@ class BankRegistry:
                         prio=PRIO_SERVING,
                         on_done=functools.partial(
                             self._task_done, key, field, group, opts),
-                        payload_bytes=sum(len(p) for p in group))
+                        payload_bytes=sum(len(p) for p in group),
+                        tenant=ns)
                 except QueueDraining as e:
                     self._quarantine_key(key, field, group, opts, e)
                     slots[si] = (COVER, key, group, "quarantined")
@@ -702,6 +752,14 @@ class BankRegistry:
         with self._meta:
             return tuple(k for k, q in self._quarantine.items()
                          if now >= q.until)
+
+    def keys_in_namespace(self, namespace: str) -> Tuple[str, ...]:
+        """Bank keys attributed to one tenant namespace, sorted — what
+        the DST tenant-isolation invariant snapshots for tenant B
+        before storming tenant A."""
+        with self._meta:
+            return tuple(sorted(k for k, ns in self.namespaces.items()
+                                if ns == namespace))
 
     def status(self) -> Dict:
         out = {

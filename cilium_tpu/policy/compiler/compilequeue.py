@@ -28,7 +28,14 @@ regeneration. This module turns per-bank compiles into WORK:
   buffering without limit;
 * **work-key dedup**: two submitters racing on the same
   content-addressed bank produce ONE task and ONE registry insert
-  (pinned by the 8-worker race test in tests/test_checkpoint.py).
+  (pinned by the 8-worker race test in tests/test_checkpoint.py);
+* **per-tenant weighted-fair queueing** (ISSUE 20): within a priority
+  class, the next task claimed belongs to the tenant with the lowest
+  virtual finish time (each claim charges ``1/weight``), so one
+  tenant's churn-storm backlog cannot monopolize the workers; a
+  **per-tenant occupancy bound** (``tenant_max_share`` of
+  ``max_pending``) blocks only the storming tenant's submits while
+  every other tenant keeps its queue capacity.
 
 Everything timed — deadlines, backoff, idle worker reaping — reads the
 installed :mod:`~cilium_tpu.runtime.simclock` clock, so the DST
@@ -115,14 +122,17 @@ class CompileTask:
     __slots__ = ("key", "fn", "prio", "deadline", "on_done",
                  "attempts", "seq", "not_before", "not_before_real",
                  "done", "result", "error", "event", "payload_bytes",
-                 "lapsed")
+                 "lapsed", "tenant")
 
     def __init__(self, key: str, fn: Callable, prio: int,
                  deadline: float, on_done: Optional[Callable],
-                 seq: int, payload_bytes: int):
+                 seq: int, payload_bytes: int, tenant: str = ""):
         self.key = key
         self.fn = fn
         self.prio = prio
+        #: owning tenant namespace ("" = tenant-blind): the WFQ pick
+        #: and the per-tenant occupancy bound key off it
+        self.tenant = tenant
         self.deadline = deadline        # absolute, installed clock
         self.on_done = on_done
         self.attempts = 0
@@ -153,13 +163,21 @@ class CompileQueue:
 
     def __init__(self, workers: int = 2, deadline_s: float = 30.0,
                  max_retries: int = 3, backoff_base_s: float = 0.25,
-                 backoff_max_s: float = 8.0, max_pending: int = 256):
+                 backoff_max_s: float = 8.0, max_pending: int = 256,
+                 weight_of=None, tenant_max_share: float = 1.0):
         self.workers = max(1, int(workers))
         self.deadline_s = float(deadline_s)
         self.max_retries = max(0, int(max_retries))
         self.backoff_base_s = float(backoff_base_s)
         self.backoff_max_s = float(backoff_max_s)
         self.max_pending = max(1, int(max_pending))
+        #: tenant → fair-queueing weight (default 1.0 for every
+        #: tenant): each claim charges ``1/weight`` of virtual time
+        self.weight_of = weight_of or (lambda tenant: 1.0)
+        #: per-tenant occupancy ceiling as a fraction of
+        #: ``max_pending`` — 1.0 disables the bound (single-tenant
+        #: deployments keep the pre-tenant submit semantics)
+        self.tenant_max_share = float(tenant_max_share)
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         #: work key → live task (pending or running); completed tasks
@@ -172,6 +190,11 @@ class CompileQueue:
         self._seq = 0
         self._draining = False
         self._closed = False
+        #: tenant → virtual finish time, the WFQ pick's memory; keyed
+        #: by the configured tenant set (plus "" for tenant-blind
+        #: submits), so its size is bounded by the declared tenants
+        # ctlint: disable=unbounded-registry  # keyed by configured tenant set
+        self._vtime: Dict[str, float] = {}
         #: lifetime counters (the fleet lane's ledger; METRICS mirrors)
         self.submitted = 0
         self.dedup_hits = 0
@@ -191,8 +214,12 @@ class CompileQueue:
         with self._lock:
             return sum(t.payload_bytes for t in self._tasks.values())
 
-    def status(self) -> Dict[str, int]:
+    def status(self) -> Dict:
         with self._lock:
+            tenants: Dict[str, int] = {}
+            for t in self._tasks.values():
+                if t.tenant:
+                    tenants[t.tenant] = tenants.get(t.tenant, 0) + 1
             return {
                 "workers": len(self._threads),
                 "pending": len(self._pending),
@@ -205,20 +232,34 @@ class CompileQueue:
                 "worker_deaths": self.worker_deaths,
                 "deadline_lapses": self.deadline_lapses,
                 "late_results": self.late_results,
+                "tenant_inflight": tenants,
             }
+
+    def _tenant_live_locked(self, tenant: str) -> int:
+        return sum(1 for t in self._tasks.values()
+                   if t.tenant == tenant and not t.done)
 
     # -- submit / wait ----------------------------------------------------
     def submit(self, key: str, fn: Callable,
                prio: int = PRIO_SERVING,
                on_done: Optional[Callable] = None,
                payload_bytes: int = 0,
-               deadline_s: Optional[float] = None) -> CompileTask:
+               deadline_s: Optional[float] = None,
+               tenant: str = "") -> CompileTask:
         """Enqueue one compile (or join the in-flight task with the
         same work key). Blocks while the queue is at ``max_pending``
         — bounded in-flight memory beats an unbounded buffer, and the
         producer is the regeneration thread, which has nothing better
-        to do than wait for compile capacity."""
+        to do than wait for compile capacity. A TENANT at its
+        occupancy bound (``tenant_max_share × max_pending`` live
+        tasks) blocks the same way, but only for ITS OWN submits —
+        the storming tenant waits on itself while everyone else's
+        capacity stays untouched."""
         budget = self.deadline_s if deadline_s is None else deadline_s
+        tenant_cap = self.max_pending
+        if tenant and self.tenant_max_share < 1.0:
+            tenant_cap = max(1, int(self.tenant_max_share
+                                    * self.max_pending))
         with self._work:
             if self._draining or self._closed:
                 raise QueueDraining("compile queue is draining")
@@ -232,7 +273,9 @@ class CompileQueue:
                     existing.prio = prio
                     self._work.notify_all()
                 return existing
-            while (len(self._tasks) >= self.max_pending
+            while ((len(self._tasks) >= self.max_pending
+                    or (tenant and self._tenant_live_locked(tenant)
+                        >= tenant_cap))
                    and not self._draining and not self._closed):
                 simclock.wait_cond(self._work, timeout=0.25)
             if self._draining or self._closed:
@@ -240,7 +283,8 @@ class CompileQueue:
             self._seq += 1
             task = CompileTask(key, fn, prio,
                                simclock.now() + budget, on_done,
-                               self._seq, payload_bytes)
+                               self._seq, payload_bytes,
+                               tenant=tenant)
             self._tasks[key] = task
             self._pending.append(task)
             self.submitted += 1
@@ -283,19 +327,24 @@ class CompileQueue:
 
     def _pop_locked(self) -> Optional[CompileTask]:
         """The scheduling decision: among runnable tasks (backoff gate
-        passed), strictly lowest (priority, submit order). Backoff
-        gates wait on the installed clock (behavioral time: the DST
-        boundary suite pins the exact-tick semantics); the IDLE park
-        is a plain condition wait with a real-time reap — resource
-        hygiene, not behavioral time, so an idle worker costs zero
-        wake-ups under a driven VirtualClock and reaps itself after
-        IDLE_REAP_S real seconds without work (the pool respawns
-        lazily on the next submit)."""
+        passed), strictly lowest priority class first; WITHIN a class,
+        the task whose tenant has the lowest virtual finish time
+        (weighted-fair: each claim charges ``1/weight``), tie-broken
+        deterministically on (tenant, submit order) — tenant-blind
+        tasks all share the "" tenant, which degenerates to the
+        pre-tenant pure submit order. Backoff gates wait on the
+        installed clock (behavioral time: the DST boundary suite pins
+        the exact-tick semantics); the IDLE park is a plain condition
+        wait with a real-time reap — resource hygiene, not behavioral
+        time, so an idle worker costs zero wake-ups under a driven
+        VirtualClock and reaps itself after IDLE_REAP_S real seconds
+        without work (the pool respawns lazily on the next submit)."""
         while True:
             if self._closed:
                 return None
             now = simclock.now()
             best = None
+            best_key = None
             next_gate = None
             # wall-clock read is the gate's REAL release valve, by
             # design (see CompileTask.not_before_real)
@@ -306,12 +355,21 @@ class CompileQueue:
                     if next_gate is None or t.not_before < next_gate:
                         next_gate = t.not_before
                     continue
-                if best is None or (t.prio, t.seq) < (best.prio,
-                                                      best.seq):
-                    best = t
+                key = (t.prio, self._vtime.get(t.tenant, 0.0),
+                       t.tenant, t.seq)
+                if best_key is None or key < best_key:
+                    best, best_key = t, key
             if best is not None:
                 self._pending.remove(best)
                 self._running += 1
+                # charge the claim to the tenant's virtual time; a
+                # first-seen tenant starts at the current floor so it
+                # gets a fair turn, not an unbounded historical credit
+                floor = min(self._vtime.values(), default=0.0)
+                vt = max(self._vtime.get(best.tenant, floor), floor)
+                weight = max(self.weight_of(best.tenant), 1e-9)
+                # ctlint: disable=unbounded-registry  # keyed by configured tenant set
+                self._vtime[best.tenant] = vt + 1.0 / weight
                 return best
             if self._draining and not self._pending:
                 return None

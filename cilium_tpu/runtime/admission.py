@@ -83,6 +83,12 @@ SHED_HOST_DRAINING = "host-draining"
 #: CLOSED: it refuses to serve possibly-stale policy rather than
 #: answer from the wrong side of a split
 SHED_PARTITIONED = "partitioned"
+#: multi-tenant fairness (ISSUE 20): the requesting tenant is past its
+#: weighted fair share of the admission window while the gate is
+#: congested — THAT tenant sheds; everyone else keeps admitting. The
+#: shed carries the tenant label, so per-tenant debugging works day
+#: one
+SHED_TENANT_QUOTA = "tenant-quota"
 
 #: fires at every admission decision; an injected fault forces a shed
 #: (reason "fault") — the chaos suite's handle on the gate
@@ -106,12 +112,16 @@ def deadline_from_ms(deadline_ms, default_ms: float,
     return now + ms / 1e3
 
 
-def count_shed(surface: str, klass: str, reason: str) -> None:
+def count_shed(surface: str, klass: str, reason: str,
+               tenant: str = "") -> None:
     """One shed, on the shared counter — callers that shed outside the
-    gate (the MicroBatcher's hard bound) stay on the same series."""
-    METRICS.inc(ADMISSION_SHED,
-                labels={"surface": surface, "class": klass,
-                        "reason": reason})
+    gate (the MicroBatcher's hard bound) stay on the same series. A
+    non-empty ``tenant`` rides as an extra label (tenant-less callers
+    keep the exact pre-tenant series)."""
+    labels = {"surface": surface, "class": klass, "reason": reason}
+    if tenant:
+        labels["tenant"] = tenant
+    METRICS.inc(ADMISSION_SHED, labels=labels)
 
 
 class AdmissionGate:
@@ -123,13 +133,22 @@ class AdmissionGate:
     def __init__(self, max_pending: int = 1024,
                  control_reserve: int = 64, enabled: bool = True,
                  depth_fn: Optional[Callable[[], int]] = None,
-                 clock=None, surface: str = "service"):
+                 clock=None, surface: str = "service",
+                 fairness=None, quotas=None):
         self.max_pending = max(1, int(max_pending))
         self.control_reserve = max(0, int(control_reserve))
         self.enabled = bool(enabled)
         self.depth_fn = depth_fn
         self.clock = clock if clock is not None else simclock.now
         self.surface = surface
+        #: per-tenant weighted-fairness window
+        #: (:class:`~cilium_tpu.runtime.tenant.FairShareWindow`); None
+        #: = tenant-blind, the pre-ISSUE-20 behavior
+        self.fairness = fairness
+        #: TTL'd per-tenant share store
+        #: (:class:`~cilium_tpu.runtime.tenant.TenantQuotas`) feeding
+        #: the fairness ceiling; None = the window's static max_share
+        self.quotas = quotas
         self._lock = threading.Lock()
         self._draining = False
         #: EWMA of the batcher's service rate (records/second) — the
@@ -180,21 +199,27 @@ class AdmissionGate:
 
     # -- the decision -----------------------------------------------------
     def admit(self, klass: str = CLASS_DATA,
-              deadline: Optional[float] = None) -> Tuple[bool, str]:
+              deadline: Optional[float] = None,
+              tenant: str = "") -> Tuple[bool, str]:
         """(admitted, shed_reason). Sheds are counted; admitted
         requests are counted per class. Disabled gates only enforce
-        drain mode — drain correctness trumps the knob."""
+        drain mode — drain correctness trumps the knob. A non-empty
+        ``tenant`` rides every shed's label and, when a fairness
+        window is wired, subjects the request to the weighted-fair
+        share check while the gate is congested (past half the
+        data-path bound — a lone tenant bursting into idle capacity
+        is never penalized)."""
         try:
             faults.maybe_fail(ADMIT_POINT)
         except Exception:  # noqa: BLE001 — plan-chosen exception
             # an injected admission fault IS a shed: the request is
             # refused explicitly, never half-admitted
-            count_shed(self.surface, klass, SHED_FAULT)
+            count_shed(self.surface, klass, SHED_FAULT, tenant)
             return False, SHED_FAULT
         with self._lock:
             draining = self._draining
         if draining and klass != CLASS_CONTROL:
-            count_shed(self.surface, klass, SHED_DRAINING)
+            count_shed(self.surface, klass, SHED_DRAINING, tenant)
             return False, SHED_DRAINING
         if not self.enabled:
             return True, ""
@@ -204,15 +229,28 @@ class AdmissionGate:
         bound = self.max_pending + (self.control_reserve
                                     if klass == CLASS_CONTROL else 0)
         if depth >= bound:
-            count_shed(self.surface, klass, SHED_QUEUE_FULL)
+            count_shed(self.surface, klass, SHED_QUEUE_FULL, tenant)
             return False, SHED_QUEUE_FULL
+        if (tenant and self.fairness is not None
+                and klass != CLASS_CONTROL
+                and depth > self.max_pending // 2):
+            cap = (self.quotas.share_of(tenant)
+                   if self.quotas is not None else None)
+            if self.fairness.over_share(tenant, share_cap=cap):
+                # the storming tenant sheds; every other tenant's
+                # window share is untouched by this decision
+                count_shed(self.surface, klass, SHED_TENANT_QUOTA,
+                           tenant)
+                return False, SHED_TENANT_QUOTA
         if deadline is not None:
             remaining = deadline - self.clock()
             if remaining <= 0.0 or remaining < self.estimated_wait(depth):
                 # infeasible: the caller will have given up before we
                 # could answer — admitting it only wastes a batch slot
-                count_shed(self.surface, klass, SHED_DEADLINE)
+                count_shed(self.surface, klass, SHED_DEADLINE, tenant)
                 return False, SHED_DEADLINE
+        if tenant and self.fairness is not None:
+            self.fairness.note(tenant)
         METRICS.inc(ADMISSION_ADMITTED,
                     labels={"surface": self.surface, "class": klass})
         return True, ""

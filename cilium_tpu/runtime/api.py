@@ -24,6 +24,9 @@ shapes:
   GET    /v1/explain        verdict provenance for ?trace_id= — the
                             recorded (rule, bank, generation), each
                             re-resolved through the CPU oracle
+  GET    /v1/canary         shadow/canary rollout status: the staged
+                            generation, the verdict-diff ledger, and
+                            the commit/refuse decision surface
   GET    /v1/trace          flight-recorder spans (runtime/tracing.py);
                             ?trace_id= filters, ?limit= bounds,
                             ?format=chrome → Chrome trace-event JSON
@@ -262,6 +265,24 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     return self._send(200, fleet.explain(tid))
                 return self._send(200,
                                   resolve_explain(agent.loader, tid))
+            if path == "/v1/canary":
+                # shadow/canary rollout status (runtime/canary.py):
+                # the verdict-diff ledger for the staged generation.
+                # The controller usually rides on the serve loop; an
+                # agent without one still reports the loader's staged
+                # revision so operators can see a canary is parked.
+                ctrl = getattr(agent, "canary", None)
+                if ctrl is None:
+                    loop = getattr(agent, "serve_loop", None)
+                    ctrl = getattr(loop, "canary", None) \
+                        if loop is not None else None
+                if ctrl is not None:
+                    return self._send(200, ctrl.report())
+                return self._send(200, {
+                    "state": "idle",
+                    "staged_revision": agent.loader.canary_revision,
+                    "serving_revision": agent.loader.revision,
+                })
             if path == "/v1/trace":
                 from cilium_tpu.runtime.tracing import TRACER
 
@@ -730,6 +751,9 @@ class APIClient:
             q.append("format=chrome")
         path = "/v1/trace" + ("?" + "&".join(q) if q else "")
         return self.request("GET", path)[1]
+
+    def canary(self):
+        return self.request("GET", "/v1/canary")[1]
 
     def flows(self, limit: Optional[int] = None):
         q = f"?limit={int(limit)}" if limit else ""

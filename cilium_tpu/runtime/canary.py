@@ -1,0 +1,496 @@
+"""Shadow/canary policy rollout with a verdict-diff gate (ISSUE 20).
+
+A bad CNP rollout at fleet scale is a mass outage with a one-line
+root cause. This module makes generation N+1 EARN its commit: the
+loader stages N+1 alongside the serving generation N
+(:meth:`~cilium_tpu.runtime.loader.Loader.stage_canary` — the shadow
+is the CPU oracle over the N+1 snapshot, bit-equal to the compiled
+engine by the repo's core invariant, so a diff measures the POLICY
+change, never a backend artifact), the serve loop double-dispatches a
+configured sample fraction of ring traffic through BOTH generations
+in the same pack cycle, and :class:`CanaryController` keeps the
+verdict-diff ledger. Commit is REFUSED — serving generation N
+untouched, zero bad verdicts served — when the diff fraction exceeds
+the declared budget or the sample floor wasn't reached.
+
+Sample selection is a pure counter walk (``floor(c·f) ≠
+floor((c-1)·f)``), deterministic under any PYTHONHASHSEED and across
+hosts (tests/dst/test_boundaries.py pins it) — never an RNG, never an
+id hash.
+
+The ``canary.dispatch`` fault point fires on every shadow dispatch: a
+fired fault ABORTS the canary safely (counted, reported, staged
+generation dropped) while generation N keeps serving untouched —
+shadow evaluation is advisory until the moment of commit.
+
+``python -m cilium_tpu.runtime.canary`` is the ``make canary`` lane:
+it plants a genuinely bad N+1 (allow entries flipped to deny) behind
+real ring traffic, proves the gate refuses it with ZERO bad verdicts
+served, then commits a clean N+1 through the same gate, and stamps
+the double-dispatch overhead against the pack-cycle wall budget.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from cilium_tpu.runtime import faults
+from cilium_tpu.runtime.logging import get_logger
+from cilium_tpu.runtime.metrics import (
+    CANARY_COMMITS,
+    CANARY_DIFF_FRACTION,
+    CANARY_SAMPLES,
+    METRICS,
+)
+
+LOG = get_logger("canary")
+
+#: fires on every shadow (N+1) dispatch of a sampled chunk: a fired
+#: fault models the shadow evaluation path failing and must ABORT the
+#: canary — counted, staged generation dropped — while the serving
+#: generation N is untouched (tests/test_faults.py pins it)
+CANARY_DISPATCH_POINT = faults.register_point(
+    "canary.dispatch", "shadow verdict dispatch in CanaryController")
+
+#: controller states; terminal ones keep their final report readable
+STATE_IDLE = "idle"
+STATE_SAMPLING = "sampling"
+STATE_COMMITTED = "committed"
+STATE_REFUSED = "refused"
+STATE_ABORTED = "aborted"
+
+
+class CanaryRefused(RuntimeError):
+    """The verdict-diff gate refused the commit: the diff fraction
+    exceeded the declared budget (or the sample floor wasn't met with
+    a non-zero diff). Serving generation N is untouched."""
+
+    def __init__(self, report: Dict):
+        super().__init__(
+            f"canary refused: diff_fraction="
+            f"{report.get('diff_fraction')} over budget="
+            f"{report.get('diff_budget')} after "
+            f"{report.get('samples')} samples")
+        self.report = report
+
+
+class CanaryController:
+    """The verdict-diff ledger of one staged rollout.
+
+    One per service/loader. ``stage()`` installs generation N+1 as
+    the loader's shadow; the serve loop calls ``should_sample`` on
+    its chunk counter and ``observe_chunk`` with each sampled chunk's
+    (flows, served verdicts); ``try_commit`` is the gate. Thread-safe:
+    observes land from the pack thread while status/commit come from
+    the API thread."""
+
+    def __init__(self, loader, sample_fraction: float = 0.25,
+                 diff_budget: float = 0.0, min_samples: int = 64):
+        self.loader = loader
+        self.sample_fraction = float(sample_fraction)
+        self.diff_budget = float(diff_budget)
+        self.min_samples = max(1, int(min_samples))
+        self._lock = threading.Lock()
+        self.state = STATE_IDLE
+        self.revision = 0
+        self.samples = 0       # sampled flow verdicts compared
+        self.diffs = 0         # ... that disagreed across generations
+        self.chunks = 0        # sampled chunks double-dispatched
+        self.reason = ""       # terminal detail (abort cause, ...)
+
+    @classmethod
+    def from_config(cls, loader, cfg=None) -> "CanaryController":
+        ccfg = cfg if cfg is not None else loader.config.canary
+        return cls(loader,
+                   sample_fraction=ccfg.sample_fraction,
+                   diff_budget=ccfg.diff_budget,
+                   min_samples=ccfg.min_samples)
+
+    # -- rollout lifecycle ------------------------------------------------
+    def stage(self, per_identity, revision: int = 0) -> None:
+        """Stage generation N+1 and start sampling. Restaging while a
+        rollout is live replaces it (the old ledger resets — a new
+        generation earns its own samples)."""
+        self.loader.stage_canary(per_identity, revision=revision)
+        with self._lock:
+            self.state = STATE_SAMPLING
+            self.revision = int(revision)
+            self.samples = 0
+            self.diffs = 0
+            self.chunks = 0
+            self.reason = ""
+        LOG.info("canary staged", extra={"fields": {
+            "revision": revision,
+            "sample_fraction": self.sample_fraction,
+            "diff_budget": self.diff_budget}})
+
+    def active(self) -> bool:
+        with self._lock:
+            return self.state == STATE_SAMPLING
+
+    def should_sample(self, counter: int) -> bool:
+        """Deterministic counter-walk sample selection: chunk ``c``
+        (1-based) is sampled iff ``floor(c·f) != floor((c-1)·f)`` —
+        exactly a fraction ``f`` of chunks, the SAME chunks on every
+        host and under every PYTHONHASHSEED (pinned by the DST
+        boundary suite)."""
+        f = self.sample_fraction
+        if f <= 0.0:
+            return False
+        c = int(counter)
+        return int(c * f) != int((c - 1) * f)
+
+    # -- the double-dispatch observe path ---------------------------------
+    def observe_chunk(self, flows, served_verdicts) -> bool:
+        """Dispatch one sampled chunk's flows through the SHADOW
+        generation and diff against the verdicts generation N served.
+        Returns False when the canary is not sampling (or just
+        aborted) — the caller simply stops sampling; serving is never
+        affected either way."""
+        with self._lock:
+            if self.state != STATE_SAMPLING:
+                return False
+        shadow = self.loader.canary_engine
+        if shadow is None:
+            return False
+        try:
+            faults.maybe_fail(CANARY_DISPATCH_POINT)
+            shadow_verdicts = shadow.verdict_flows(flows)["verdict"]
+        except Exception as e:  # noqa: BLE001 — ANY shadow-dispatch
+            # failure (armed fault or real) aborts the canary safely:
+            # the staged generation is advisory until commit, so the
+            # only safe degradation is to stop the rollout — never to
+            # guess a diff, never to touch generation N
+            self.abort(f"dispatch-failed: {type(e).__name__}: {e}")
+            return False
+        matches = 0
+        diffs = 0
+        for served, shadowed in zip(served_verdicts, shadow_verdicts):
+            if int(served) == int(shadowed):
+                matches += 1
+            else:
+                diffs += 1
+        with self._lock:
+            self.samples += matches + diffs
+            self.diffs += diffs
+            self.chunks += 1
+            frac = self.diffs / max(1, self.samples)
+        if matches:
+            METRICS.inc(CANARY_SAMPLES, matches,
+                        labels={"result": "match"})
+        if diffs:
+            METRICS.inc(CANARY_SAMPLES, diffs,
+                        labels={"result": "diff"})
+        METRICS.set_gauge(CANARY_DIFF_FRACTION, frac)
+        return True
+
+    # -- terminal transitions ---------------------------------------------
+    def abort(self, reason: str) -> None:
+        """Stop the rollout without committing: staged generation
+        dropped, ledger kept for the report, serving generation N
+        untouched by construction."""
+        with self._lock:
+            if self.state not in (STATE_SAMPLING, STATE_IDLE):
+                return
+            self.state = STATE_ABORTED
+            self.reason = str(reason)
+        self.loader.clear_canary()
+        METRICS.inc(CANARY_COMMITS, labels={"result": "aborted"})
+        LOG.warning("canary aborted", extra={"fields": {
+            "revision": self.revision, "reason": reason}})
+
+    def diff_fraction(self) -> float:
+        with self._lock:
+            return self.diffs / max(1, self.samples)
+
+    def try_commit(self):
+        """The verdict-diff gate. Passes only when the sample floor
+        was reached AND the diff fraction is within the declared
+        budget; then — and only then — the staged snapshot promotes
+        through the loader's normal regenerate. A refusal drops the
+        staged generation and raises :class:`CanaryRefused`; the
+        serving generation N never moves."""
+        with self._lock:
+            if self.state != STATE_SAMPLING:
+                raise RuntimeError(
+                    f"no canary sampling (state={self.state})")
+            samples = self.samples
+            frac = self.diffs / max(1, self.samples)
+        ok = samples >= self.min_samples and frac <= self.diff_budget
+        if not ok:
+            report = self.report()
+            with self._lock:
+                self.state = STATE_REFUSED
+                self.reason = (
+                    f"diff_fraction {round(frac, 6)} > budget "
+                    f"{self.diff_budget}" if frac > self.diff_budget
+                    else f"samples {samples} < floor "
+                         f"{self.min_samples}")
+                report["reason"] = self.reason
+            self.loader.clear_canary()
+            METRICS.inc(CANARY_COMMITS, labels={"result": "refused"})
+            LOG.error("canary REFUSED", extra={"fields": {
+                "revision": self.revision,
+                "diff_fraction": round(frac, 6),
+                "budget": self.diff_budget, "samples": samples}})
+            raise CanaryRefused(report)
+        engine = self.loader.commit_canary()
+        with self._lock:
+            self.state = STATE_COMMITTED
+        METRICS.inc(CANARY_COMMITS, labels={"result": "committed"})
+        LOG.info("canary committed", extra={"fields": {
+            "revision": self.revision, "samples": samples,
+            "diff_fraction": round(frac, 6)}})
+        return engine
+
+    # -- introspection ----------------------------------------------------
+    def report(self) -> Dict:
+        """The verdict-diff report (`GET /v1/canary`, `cilium-tpu
+        canary`)."""
+        with self._lock:
+            return {
+                "state": self.state,
+                "revision": self.revision,
+                "sample_fraction": self.sample_fraction,
+                "diff_budget": self.diff_budget,
+                "min_samples": self.min_samples,
+                "chunks": self.chunks,
+                "samples": self.samples,
+                "diffs": self.diffs,
+                "diff_fraction": round(
+                    self.diffs / max(1, self.samples), 6),
+                "reason": self.reason,
+            }
+
+
+# -- the `make canary` lane ---------------------------------------------------
+
+
+def _build_world(n_rules: int, chunk_flows: int, pool_chunks: int,
+                 seed: int, sample_fraction: float,
+                 min_samples: int):
+    """Synth policy → TPU loader (CPU backend) → serve loop with the
+    canary controller wired, plus a chunk pool with generation-N
+    ground truth."""
+    import random
+
+    from cilium_tpu.core.config import Config
+    from cilium_tpu.ingest import synth
+    from cilium_tpu.ingest.binary import (
+        capture_from_bytes,
+        capture_to_bytes,
+    )
+    from cilium_tpu.runtime.loader import Loader
+    from cilium_tpu.runtime.serveloop import ServeLoop
+
+    sc = synth.scenario_by_name("http", n_rules,
+                                max(512, chunk_flows * 8))
+    per_identity, sc = synth.realize_scenario(sc)
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    cfg.canary.enabled = True
+    cfg.canary.sample_fraction = sample_fraction
+    cfg.canary.min_samples = min_samples
+    loader = Loader(cfg)
+    loader.regenerate(per_identity, revision=1)
+    engine = loader.engine
+    rng = random.Random(seed ^ 0xCA7A)
+    pool = []
+    flows = list(sc.flows)
+    for _ in range(pool_chunks):
+        batch = [flows[rng.randrange(len(flows))]
+                 for _ in range(chunk_flows)]
+        sections = capture_from_bytes(capture_to_bytes(batch))
+        truth = [int(v) for v in
+                 engine.verdict_flows(batch)["verdict"]]
+        pool.append((sections, truth))
+    canary = CanaryController.from_config(loader)
+    loop = ServeLoop(loader, capacity=64, lease_ttl_s=300.0,
+                     pack_interval_s=0.001, canary=canary)
+    return cfg, loader, per_identity, pool, canary, loop
+
+
+def _bad_snapshot(per_identity):
+    """The planted bad rollout: every ALLOW entry flipped to deny —
+    the one-line CNP mistake that mass-denies a fleet. Deep-copied so
+    the serving snapshot is untouched."""
+    import copy
+
+    bad = copy.deepcopy(per_identity)
+    for ms in bad.values():
+        for entry in ms.entries.values():
+            entry.is_deny = True
+    return bad
+
+
+def _drive(loop, pool, chunks: int) -> Dict:
+    """Push ``chunks`` chunks through the ring (inline pack cycles)
+    and return {served_chunks, bad_verdicts} — a bad verdict is any
+    SERVED verdict disagreeing with the generation-N ground truth, the
+    'zero bad verdicts served' ledger of the lane."""
+    from cilium_tpu.runtime.serveloop import LeaseExpired, ShedError
+
+    lease = loop.connect("canary-lane", resume=True)
+    served = 0
+    bad = 0
+    outstanding: List = []
+    for i in range(chunks):
+        sections, truth = pool[i % len(pool)]
+        try:
+            ticket = loop.submit(lease, *sections)
+        except (ShedError, LeaseExpired):
+            lease = loop.connect("canary-lane", resume=True)
+            continue
+        outstanding.append((ticket, truth))
+        loop.step()
+        done = []
+        for ticket, t in outstanding:
+            if ticket.done and ticket.error is None:
+                served += 1
+                for got, want in zip(ticket.verdicts, t):
+                    if int(got) != int(want):
+                        bad += 1
+                done.append((ticket, t))
+        for pair in done:
+            outstanding.remove(pair)
+    # bounded inline flush (drain() would wedge the loop for the
+    # next rollout phase — it stops admitting permanently)
+    for _ in range(8):
+        if all(t.done for t, _ in outstanding):
+            break
+        loop.step()
+    for ticket, t in outstanding:
+        if ticket.done and ticket.error is None and \
+                ticket.verdicts is not None:
+            served += 1
+            for got, want in zip(ticket.verdicts, t):
+                if int(got) != int(want):
+                    bad += 1
+    return {"served_chunks": served, "bad_verdicts": bad}
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="canary rollout lane: planted bad-policy commit "
+                    "must be refused by the verdict-diff gate with "
+                    "zero bad verdicts served")
+    ap.add_argument("--rules", type=int, default=40)
+    ap.add_argument("--chunk-flows", type=int, default=16)
+    ap.add_argument("--pool-chunks", type=int, default=24)
+    ap.add_argument("--chunks", type=int, default=96,
+                    help="ring chunks driven per rollout phase")
+    ap.add_argument("--sample-fraction", type=float, default=0.25)
+    ap.add_argument("--min-samples", type=int, default=16)
+    ap.add_argument("--seed", type=int,
+                    default=int(os.environ.get("CILIUM_TPU_DST_SEED",
+                                               "0") or 0))
+    ap.add_argument("--budget-pct", type=float, default=5.0,
+                    help="double-dispatch overhead ceiling, %% of "
+                         "pack-cycle wall")
+    ap.add_argument("--out", default="BENCH_CANARY_r09.jsonl")
+    args = ap.parse_args(argv)
+
+    cfg, loader, per_identity, pool, canary, loop = _build_world(
+        args.rules, args.chunk_flows, args.pool_chunks, args.seed,
+        args.sample_fraction, args.min_samples)
+
+    # phase 1: the PLANTED BAD rollout — stage, sample, expect REFUSED
+    canary.stage(_bad_snapshot(per_identity), revision=2)
+    bad_phase = _drive(loop, pool, args.chunks)
+    refused = False
+    try:
+        canary.try_commit()
+    except CanaryRefused as e:
+        refused = True
+        refusal = e.report
+    serving_rev_after_bad = loader.revision
+    bad_report = canary.report()
+
+    # phase 2: a CLEAN rollout of the same policy through the same
+    # gate — zero diffs, commit passes, revision advances
+    canary2 = CanaryController.from_config(loader)
+    loop.canary = canary2
+    canary2.stage(dict(per_identity), revision=3)
+    clean_phase = _drive(loop, pool, args.chunks)
+    committed = False
+    try:
+        canary2.try_commit()
+        committed = True
+    except CanaryRefused:
+        pass
+    clean_report = canary2.report()
+
+    pack_s = max(loop.pack_seconds, 1e-9)
+    overhead_pct = 100.0 * loop.canary_seconds / pack_s
+    gates = {
+        "diff_caught": refused,
+        "serving_untouched": serving_rev_after_bad == 1
+        and bad_phase["bad_verdicts"] == 0,
+        "clean_committed": committed and loader.revision == 3
+        and clean_report["diffs"] == 0,
+        "clean_verdicts": clean_phase["bad_verdicts"] == 0,
+        "sampled": bad_report["samples"] >= args.min_samples,
+        "overhead": overhead_pct <= args.budget_pct,
+    }
+
+    from cilium_tpu.runtime.provenance import stamp
+
+    os.environ["CILIUM_TPU_DST_SEED"] = str(args.seed)
+    os.environ["CILIUM_TPU_DST_DIGEST"] = hashlib.sha256(
+        json.dumps({"rules": args.rules, "chunks": args.chunks,
+                    "seed": args.seed,
+                    "sample_fraction": args.sample_fraction},
+                   sort_keys=True).encode()).hexdigest()[:16]
+    line = stamp({
+        "metric": "canary_overhead_pct",
+        "value": round(overhead_pct, 4),
+        "unit": "% of pack-cycle wall spent double-dispatching",
+        "lane": "canary",
+        "canary_overhead_pct": round(overhead_pct, 4),
+        "canary_budget_pct": args.budget_pct,
+        "canary_samples": bad_report["samples"],
+        "canary_diffs": bad_report["diffs"],
+        "diff_caught": refused,
+        "diff_fraction": bad_report["diff_fraction"],
+        "sample_fraction": args.sample_fraction,
+        "bad_verdicts_served": bad_phase["bad_verdicts"],
+        "clean_samples": clean_report["samples"],
+        "clean_diffs": clean_report["diffs"],
+        "served_chunks": bad_phase["served_chunks"]
+        + clean_phase["served_chunks"],
+        "seed": args.seed,
+        "gates": {k: bool(v) for k, v in gates.items()},
+    })
+    with open(args.out, "a") as fp:
+        fp.write(json.dumps(line) + "\n")
+
+    ok = all(gates.values())
+    print(f"[canary] bad rollout: "
+          f"{'REFUSED' if refused else 'NOT refused'} at "
+          f"diff_fraction {bad_report['diff_fraction']} "
+          f"({bad_report['diffs']}/{bad_report['samples']} sampled "
+          f"verdicts), {bad_phase['bad_verdicts']} bad verdicts "
+          f"served, serving revision {serving_rev_after_bad}; "
+          f"clean rollout: "
+          f"{'COMMITTED' if committed else 'refused'} at revision "
+          f"{loader.revision} ({clean_report['samples']} samples, "
+          f"{clean_report['diffs']} diffs); double-dispatch overhead "
+          f"{overhead_pct:.2f}% of pack wall "
+          f"(budget {args.budget_pct}%); gates "
+          f"{'OK' if ok else 'FAILED ' + str(gates)}", flush=True)
+    if refused:
+        print(f"[canary] refusal: {refusal.get('reason', '')}",
+              flush=True)
+    loop.stop()
+    loader.close()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -104,6 +104,12 @@ FAULT_POINTS = (
     # client resume protocol instead of double-granting)
     "fleet.heartbeat",
     "fleet.handoff",
+    # ISSUE 20 — the multi-tenant control plane's fault surface: a
+    # lost per-tenant quota-store read (falls to the conservative
+    # default share, never unbounded) and a failed shadow dispatch
+    # (aborts the canary safely; serving generation N untouched)
+    "tenant.quota",
+    "canary.dispatch",
 )
 
 #: breaker/quarantine timings the schedules steer around; small so
@@ -222,6 +228,15 @@ class DSTWorld:
                ("cass", f"tbl{i}"), ("mc", f"k{i}"),
                ("r2d2", f"f{i}.dat")]
             for i in range(self.N_IDS)}
+        # ISSUE 20: the world is TENANT-PARTITIONED — db0 is tenant
+        # "a", db1 is tenant "b", db2 rides the default namespace.
+        # Partitioning is on for EVERY schedule (the namespaced bank
+        # planner lives inside the whole searched fault space), and
+        # the `tenant` arm proves A's faults never move B's verdicts,
+        # banks, or admission outcomes.
+        cfg.tenant.enabled = True
+        cfg.tenant.ranges = (f"a:{self.dbs[0]}-{self.dbs[0]}",
+                             f"b:{self.dbs[1]}-{self.dbs[1]}")
         #: the last state a successful commit (or warm restore) staged
         #: — the oracle the serving plane is held to
         self.committed = {i: list(v) for i, v in self.rules_of.items()}
@@ -1081,6 +1096,217 @@ class DSTWorld:
                 "partial_handoffs": router.partial_handoffs,
                 "occupancy": occ}
 
+    def _tenant_probe_flows(self, i: int):
+        """Tenant ``i``'s slice of the probe corpus (its committed
+        patterns + a never-allowed canary), deterministic order."""
+        flows = []
+        for kind, pat in self.committed[i]:
+            if kind == "http":
+                flows.append(self._http(i, pat.replace("/.*", "/x")))
+            elif kind == "dns":
+                flows.append(self._dns(i, pat))
+            else:
+                proto, dport, mk = self._FE_KINDS[kind]
+                flows.append(self._fe(i, proto, dport, mk(pat)))
+        flows.append(self._http(i, "/never/allowed"))
+        return flows
+
+    def tenant_isolation(self, mode: str, index: int) -> Dict:
+        """The ISSUE-20 tenant-isolation invariant: tenant A's faults
+        — an A-only churn storm (with whatever bank-compile faults
+        the schedule armed), a quota lapse/fault while A floods a
+        congested admission window, or a bad canary rollout scoped to
+        A's entries — must provably never move tenant B's served
+        verdicts, B's compiled banks (namespace-attributed keys), or
+        B's admission outcomes."""
+        A, B = 0, 1
+        reg = self.loader.bank_registry
+        flows_b = self._tenant_probe_flows(B)
+
+        def b_verdicts():
+            try:
+                return [int(v) for v in self.loader.engine
+                        .verdict_flows(flows_b)["verdict"]]
+            except Exception:  # noqa: BLE001 — an injected dispatch
+                return None    # fault: skip the equality leg
+
+        before = b_verdicts()
+        keys_before = tuple(reg.keys_in_namespace("b")) if reg else ()
+        out: Dict = {"mode": mode}
+        if mode == "churn-storm":
+            # tenant A's churn storm: 3 A-only mutations, one
+            # regenerate — with the namespaced planner, only A (and
+            # shared) banks may compile; an armed loader.bank_compile
+            # fault can only quarantine those
+            # 6 patterns: enough banks in A's namespace (bank_size 2)
+            # that a positional wholesale shift on the delete leg
+            # below exceeds the O(Δ) adjacency bound — the planted
+            # positional-banks mutation stays catchable in the
+            # NAMESPACED world (tests/dst/test_planted.py budget)
+            applied = 6
+            for k in range(applied):
+                # the "/churn" stem keeps these deletable by the churn
+                # executor: the storm's delete leg below rides the
+                # same O(Δ) adjacency check as plain churn deletes
+                self.rules_of[A].append(
+                    ("http", f"/churnt{index}k{k}/.*"))
+            self.revision += 1
+            rolled_back = False
+            warm_registry = bool(reg and reg.status()["groups"])
+            compiles_before = self.bank_compiles()
+            self.attempts += applied
+            try:
+                self.loader.regenerate(self._resolve(),
+                                       revision=self.revision)
+            except Exception:
+                rolled_back = True
+            else:
+                self.committed = {j: list(v)
+                                  for j, v in self.rules_of.items()}
+                self.changes += applied
+            compiles = self.bank_compiles() - compiles_before
+            if not warm_registry:
+                self.compiles0 += compiles
+                self.attempts -= applied
+            out.update({"mutations": applied,
+                        "rolled_back": rolled_back,
+                        "compiles": compiles,
+                        "degraded": bool(self.loader.bank_status()
+                                         .get("degraded"))})
+            if not rolled_back:
+                # the storm's DELETE leg: tenant A retracts one of its
+                # churned-in patterns through the ordinary churn
+                # executor — a warm A-namespace delete must perturb
+                # only the adjacent A bank(s) (the o-delta-compile
+                # check inside churn() enforces it), and B's banks/
+                # verdicts stay unmoved either way
+                out["delete"] = self.churn("delete", A, index)
+        elif mode == "quota":
+            from cilium_tpu.runtime import admission as adm
+            from cilium_tpu.runtime.tenant import (
+                FairShareWindow,
+                TenantMap,
+                TenantQuotas,
+            )
+
+            tmap = TenantMap.from_config(self.cfg)
+            quotas = TenantQuotas.from_config(self.cfg)
+            # A's generous share lapses AT the tick (ttl 0, closed
+            # boundary): every read from here is the conservative
+            # default — and an armed tenant.quota fault forces the
+            # same default, so A is bounded either way
+            quotas.set_share("a", 0.9, ttl_s=0.0)
+            fair = FairShareWindow(
+                quantum_s=self.cfg.tenant.quantum_s,
+                max_share=self.cfg.tenant.max_share,
+                weight_of=tmap.weight_of)
+            gate = adm.AdmissionGate(
+                max_pending=8, control_reserve=2,
+                depth_fn=lambda: 6,  # congested: fairness armed
+                fairness=fair, quotas=quotas)
+            for _ in range(3):   # B establishes presence first
+                ok, _r = gate.admit(adm.CLASS_DATA, tenant="b")
+                if not ok:
+                    raise InvariantViolation(
+                        index, "tenant-isolation",
+                        "tenant B shed before tenant A stormed")
+            a_ok = a_shed = 0
+            for _ in range(12):  # tenant A floods the window
+                ok, reason = gate.admit(adm.CLASS_DATA, tenant="a")
+                if ok:
+                    a_ok += 1
+                    continue
+                a_shed += 1
+                if reason != adm.SHED_TENANT_QUOTA:
+                    raise InvariantViolation(
+                        index, "tenant-isolation",
+                        f"tenant A's flood shed with reason "
+                        f"{reason!r} — not tenant-attributed")
+            if a_shed == 0:
+                raise InvariantViolation(
+                    index, "tenant-isolation",
+                    "tenant A stormed 12 admits past its share and "
+                    "never shed tenant-quota")
+            # B's outcomes unmoved by A's storm: B's fair allotment
+            # (2 more of this window under equal weights) must admit
+            for _ in range(2):
+                ok, reason = gate.admit(adm.CLASS_DATA, tenant="b")
+                if not ok:
+                    raise InvariantViolation(
+                        index, "tenant-isolation",
+                        f"tenant B shed ({reason}) while only tenant "
+                        f"A stormed the window")
+            out.update({"a_admitted": a_ok, "a_shed": a_shed,
+                        "quota": quotas.status()["default_share"]})
+        else:  # canary
+            if bool(self.loader.bank_status().get("degraded")):
+                # a quarantined plane may already DENY A's flows —
+                # the bad rollout would legitimately diff zero; the
+                # arm only proves the gate on a healthy plane
+                out["skipped"] = "degraded"
+            else:
+                import copy
+
+                from cilium_tpu.runtime.canary import (
+                    CanaryController,
+                    CanaryRefused,
+                )
+
+                rev_before = self.loader.revision
+                try:
+                    flows = self.corpus()
+                    served = [int(v) for v in self.loader.engine
+                              .verdict_flows(flows)["verdict"]]
+                except Exception as e:  # noqa: BLE001 — injected
+                    out["faulted"] = type(e).__name__
+                    return out
+                bad = copy.deepcopy(self._resolve())
+                for entry in bad[self.dbs[A]].entries.values():
+                    entry.is_deny = True  # A's bad CNP: mass-deny
+                ctl = CanaryController(self.loader,
+                                       sample_fraction=1.0,
+                                       diff_budget=0.0,
+                                       min_samples=1)
+                ctl.stage(bad, revision=rev_before + 1)
+                ctl.observe_chunk(flows, served)
+                refused = aborted = False
+                try:
+                    ctl.try_commit()
+                except CanaryRefused:
+                    refused = True
+                except RuntimeError:
+                    # an armed canary.dispatch fault aborted the
+                    # rollout before commit — the safe degradation
+                    aborted = ctl.state == "aborted"
+                if not (refused or aborted):
+                    raise InvariantViolation(
+                        index, "tenant-isolation",
+                        "a bad tenant-A canary COMMITTED through "
+                        "the verdict-diff gate")
+                if self.loader.revision != rev_before:
+                    raise InvariantViolation(
+                        index, "tenant-isolation",
+                        "a refused/aborted canary moved the serving "
+                        "revision")
+                out.update({"refused": refused, "aborted": aborted,
+                            "diffs": ctl.report()["diffs"]})
+        keys_after = tuple(reg.keys_in_namespace("b")) if reg else ()
+        if keys_before and keys_after != keys_before:
+            raise InvariantViolation(
+                index, "tenant-isolation",
+                f"tenant A's {mode} moved tenant B's bank keys "
+                f"({len(keys_before)} -> {len(keys_after)})")
+        after = b_verdicts()
+        if before is not None and after is not None \
+                and after != before:
+            raise InvariantViolation(
+                index, "tenant-isolation",
+                f"tenant A's {mode} changed tenant B's served "
+                f"verdicts")
+        out["b_verdicts"] = _digest(after if after is not None
+                                    else [])
+        return out
+
     def storm(self, n: int, index: int) -> Dict:
         """A burst of identity add/delete through the kvstore watch
         (the churn_storm point may lose deliveries); local allocation
@@ -1373,6 +1599,15 @@ def generate(seed: int, max_events: int = 12) -> List[List]:
             events.append(["clustermesh", rng.randint(2, 6)])
         elif roll < 0.83:
             events.append(["advance", rng.choice(ADVANCES)])
+        elif roll < 0.88:
+            # ISSUE 20: the tenant-isolation invariant enters the
+            # searched space — tenant A storms/lapses/stages a bad
+            # canary (whatever faults are armed land on it) and
+            # tenant B's verdicts, banks, and admission outcomes are
+            # checked unmoved
+            events.append(["tenant",
+                           rng.choice(["churn-storm", "quota",
+                                       "canary"])])
         elif roll < 0.91:
             events.append(["storm", rng.randint(4, 24)])
         else:
@@ -1435,6 +1670,8 @@ def run_schedule(seed: int, events: Optional[List[List]] = None,
                         elif kind == "advance":
                             clock.advance(float(ev[1]))
                             out = {"now": round(clock.now(), 6)}
+                        elif kind == "tenant":
+                            out = world.tenant_isolation(str(ev[1]), i)
                         elif kind == "storm":
                             out = world.storm(int(ev[1]), i)
                         elif kind == "drain-restore":

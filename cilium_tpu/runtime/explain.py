@@ -91,7 +91,8 @@ def build_entries(trace_id: str, surface: str, flows: Sequence,
                   gens=None, memo_hit=None, match_spec=None,
                   kernel: str = "", pack_cycle: int = -1,
                   generation: int = -1, host_id: str = "",
-                  sample: int = DEFAULT_SAMPLE) -> List[Dict]:
+                  sample: int = DEFAULT_SAMPLE,
+                  tenant: str = "") -> List[Dict]:
     """Explain entries for (up to ``sample``) flows of one served
     chunk. Alignment contract: ``flows[i]`` ↔ row i of every array.
     Counts explained/unexplained on the provenance series — a verdict
@@ -104,7 +105,10 @@ def build_entries(trace_id: str, surface: str, flows: Sequence,
     serve concurrently (runtime/fleetserve.py) cycle 17 exists on every
     host — the ``host`` field is the disambiguating half of the
     (host, cycle) pair and the join key a router-forwarded explain
-    query uses to attribute a trace to the replica that served it."""
+    query uses to attribute a trace to the replica that served it.
+
+    ``tenant`` attributes the entry to the tenant whose stream it was
+    served on (ISSUE 20 satellite) — "" keeps the pre-tenant shape."""
     from cilium_tpu.core.flow import Verdict
     from cilium_tpu.engine.attribution import flow_family, pack_word
     from cilium_tpu.ingest.hubble import flow_to_dict
@@ -152,7 +156,7 @@ def build_entries(trace_id: str, surface: str, flows: Sequence,
 
                 prov["bank_epoch"] = POLICY_GENERATION.bank_epoch(
                     str(res["bank_key"]))
-        out.append({
+        entry = {
             "trace_id": trace_id,
             "surface": surface,
             "t": simclock.wall(),
@@ -161,7 +165,10 @@ def build_entries(trace_id: str, surface: str, flows: Sequence,
             "verdict_name": Verdict(int(verdicts[i])).name,
             "flow": flow_to_dict(f),
             "provenance": prov,
-        })
+        }
+        if tenant:
+            entry["tenant"] = tenant
+        out.append(entry)
     return out
 
 

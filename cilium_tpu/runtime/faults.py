@@ -78,6 +78,15 @@ Known injection points (registered by the modules owning the seam):
                            client resume path, never on two live hosts)
 ``artifact.fetch``         compiled-bank artifact fetch in
                            ``runtime/checkpoint.BankArtifactStore``
+``canary.dispatch``        shadow (N+1) verdict dispatch in
+                           ``runtime/canary.CanaryController`` (a fired
+                           fault ABORTS the canary safely — staged
+                           generation dropped, serving generation N
+                           untouched)
+``tenant.quota``           per-tenant quota-store read in
+                           ``runtime/tenant.TenantQuotas`` (a fired
+                           fault falls back to the conservative
+                           configured default share)
 =========================  ==================================================
 """
 

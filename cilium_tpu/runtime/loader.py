@@ -237,6 +237,22 @@ class Loader:
         self._lock = threading.Lock()
         self._engine = None
         self._revision = 0
+        #: declared tenant partition (None = tenant-blind): built once
+        #: from [tenant] config — the bank namer, the compile queue's
+        #: fair-share weights, and the admission plane all read it
+        if self.config.tenant.enabled:
+            from cilium_tpu.runtime.tenant import TenantMap
+
+            self.tenant_map: Optional[TenantMap] = \
+                TenantMap.from_config(self.config)
+        else:
+            self.tenant_map = None
+        #: staged generation N+1 (shadow engine + snapshot) while a
+        #: canary rollout samples — NEVER the serving engine until
+        #: commit_canary() promotes it through the normal regenerate
+        self._canary_engine = None
+        self._canary_snapshot: Optional[Dict[int, MapState]] = None
+        self._canary_revision = 0
         #: the staged snapshot (identity → MapState); the proxy bridge
         #: walks it host-side for per-request header-rewrite ops (the
         #: winning entry's HTTP rules carry the mismatch actions)
@@ -276,13 +292,22 @@ class Loader:
             ccfg = self.config.compile
             queue = None
             if ccfg.workers > 0:
+                # tenant-aware fair queueing: weights + the per-tenant
+                # occupancy bound come from the declared partition, so
+                # one tenant's compile storm queues against itself
+                weight_of = (self.tenant_map.weight_of
+                             if self.tenant_map is not None else None)
+                tenant_share = (self.config.tenant.max_share
+                                if self.tenant_map is not None else 1.0)
                 queue = CompileQueue(
                     workers=ccfg.workers,
                     deadline_s=ccfg.deadline_s,
                     max_retries=ccfg.max_retries,
                     backoff_base_s=ccfg.backoff_base_s,
                     backoff_max_s=ccfg.backoff_max_s,
-                    max_pending=ccfg.max_pending)
+                    max_pending=ccfg.max_pending,
+                    weight_of=weight_of,
+                    tenant_max_share=tenant_share)
             artifacts = None
             if ccfg.bank_artifacts and self.config.loader.enable_cache:
                 artifacts = BankArtifactStore(self._cache)
@@ -521,6 +546,11 @@ class Loader:
             self.config.policy_audit_mode,
             repr(self.config.engine),
             bool(self.config.loader.bank_isolation),
+            # the tenant partition shapes the bank order (and thus the
+            # compiled lane layout): flipping/redeclaring it must read
+            # as a different policy, never as a stale-artifact hit
+            (self.config.tenant.enabled, self.config.tenant.ranges,
+             self.config.tenant.default_tenant),
             # only secrets actually REFERENCED by this snapshot's
             # header matches enter the key: rotating an unrelated
             # secret must not invalidate every cached artifact
@@ -546,6 +576,13 @@ class Loader:
         policy = self._cache.get(key)
         cached = policy is not None
         if policy is None:
+            if self.bank_registry is not None:
+                # install THIS snapshot's pattern → namespace map
+                # before compiling: the partition splits by namespace
+                # first, so tenant A's churn can only perturb banks
+                # inside A's namespace (or the shared one)
+                self.bank_registry.namer = \
+                    self._tenant_namer(per_identity)
             with SpanStat("policy_compile") as span, \
                     TRACER.span("policy.compile", phase=PHASE_HOST,
                                 identities=len(per_identity)):
@@ -726,6 +763,129 @@ class Loader:
         out["kernel_plan"] = dict(getattr(self, "_kernel_plan", {}))
         out["fp_store"] = self._fp_store.status()
         return out
+
+    # -- tenant namespaces (ISSUE 20) -------------------------------------
+    def _tenant_namer(self, per_identity: Dict[int, MapState]):
+        """Pattern → tenant namespace for THIS snapshot, or None when
+        tenancy is off. Walks the snapshot exactly the way the compiler
+        extracts pattern text (h.path / h.method / h.host, header
+        requirement regexes, DNS matchpattern regexes), claiming each
+        pattern for the tenant of the identity carrying it. A pattern
+        claimed by two tenants — or one the walk can't attribute
+        (kafka/generic/frontend predicates) — lands in the SHARED
+        namespace: its banks are common infrastructure, attributable
+        to every claimant, and recompiling them isolates no one."""
+        if self.tenant_map is None:
+            return None
+        from cilium_tpu.engine.verdict import header_requirement_regex
+        from cilium_tpu.policy.compiler import matchpattern
+        from cilium_tpu.runtime.tenant import SHARED_NAMESPACE
+        from cilium_tpu.secrets import resolve_header_value
+
+        secret_lookup = (self.secrets.lookup
+                         if self.secrets is not None else None)
+        claims: Dict[str, str] = {}
+
+        def claim(pat: str, tenant: str) -> None:
+            if not pat:
+                return
+            prev = claims.get(pat)
+            if prev is None:
+                claims[pat] = tenant
+            elif prev != tenant:
+                claims[pat] = SHARED_NAMESPACE
+
+        for ep, ms in per_identity.items():
+            tenant = self.tenant_map.tenant_of(ep)
+            for entry in ms.entries.values():
+                for lr in entry.l7_rules:
+                    for h in lr.http:
+                        claim(h.path, tenant)
+                        claim(h.method, tenant)
+                        claim(h.host, tenant)
+                        for hdr in h.headers:
+                            if ":" in hdr:
+                                name, value = hdr.split(":", 1)
+                            else:
+                                name, value = hdr, ""
+                            claim(header_requirement_regex(name, value),
+                                  tenant)
+                        for hm in h.header_matches:
+                            value = resolve_header_value(hm,
+                                                         secret_lookup)
+                            if value is not None:
+                                claim(header_requirement_regex(
+                                    hm.name, value), tenant)
+                    for d in lr.dns:
+                        if d.match_name:
+                            claim(matchpattern.name_to_regex(
+                                d.match_name), tenant)
+                        else:
+                            claim(matchpattern.to_regex(
+                                d.match_pattern), tenant)
+
+        def namer(pattern: str) -> str:
+            return claims.get(pattern, SHARED_NAMESPACE)
+
+        return namer
+
+    # -- shadow/canary staging (ISSUE 20) ---------------------------------
+    def stage_canary(self, per_identity: Dict[int, MapState],
+                     revision: int = 0):
+        """Stage generation N+1 ALONGSIDE the serving generation N.
+
+        The shadow is the CPU oracle over the N+1 snapshot — bit-equal
+        to the compiled engine by the repo's core invariant (the
+        oracle IS the correctness reference the engine is pinned
+        against), so a verdict diff between serving and shadow
+        measures the POLICY change, never a backend artifact. The
+        expensive compile happens once, at :meth:`commit_canary`,
+        after the verdict-diff gate passed — a refused canary costs
+        zero compile work and never touches the serving triple."""
+        secret_lookup = (self.secrets.lookup
+                         if self.secrets is not None else None)
+        shadow = OracleVerdictEngine(
+            per_identity, secret_lookup=secret_lookup,
+            audit=self.config.policy_audit_mode)
+        with self._lock:
+            self._canary_engine = shadow
+            self._canary_snapshot = per_identity
+            self._canary_revision = revision
+        return shadow
+
+    @property
+    def canary_engine(self):
+        """The staged shadow engine, or None when no canary is live."""
+        with self._lock:
+            return self._canary_engine
+
+    @property
+    def canary_revision(self) -> int:
+        with self._lock:
+            return self._canary_revision
+
+    def clear_canary(self) -> None:
+        """Drop the staged generation (abort/refuse path): the serving
+        triple is untouched by construction — the shadow never entered
+        it."""
+        with self._lock:
+            self._canary_engine = None
+            self._canary_snapshot = None
+            self._canary_revision = 0
+
+    def commit_canary(self):
+        """Promote the staged snapshot to the serving generation via
+        the normal :meth:`regenerate` (compile → stage → atomic swap,
+        rollback on failure). Only the verdict-diff gate
+        (runtime/canary.py) calls this, and only after it passed."""
+        with self._lock:
+            snap = self._canary_snapshot
+            revision = self._canary_revision
+        if snap is None:
+            raise RuntimeError("no canary generation staged")
+        engine = self.regenerate(snap, revision=revision)
+        self.clear_canary()
+        return engine
 
     # -- warm restart -----------------------------------------------------
     def snapshot_warm(self) -> bool:

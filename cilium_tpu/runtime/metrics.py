@@ -248,6 +248,22 @@ HUBBLE_FLOW_RECORDS = "cilium_tpu_hubble_flow_records_total"
 #: overflow counter that keeps the export honest about sampling)
 HUBBLE_FLOW_OVERFLOW = "cilium_tpu_hubble_flow_overflow_total"
 
+# -- multi-tenant control plane & policy canary (runtime/tenant.py,
+# runtime/canary.py): per-tenant fairness attribution and the
+# shadow-rollout verdict-diff gate.
+#: per-tenant quota-store reads, by result (``live`` = an unexpired
+#: declared share, ``lapsed`` = TTL expiry fell back to the
+#: conservative default, ``fault-default`` = the ``tenant.quota``
+#: read was lost and the conservative default applied)
+TENANT_QUOTA_READS = "cilium_tpu_tenant_quota_reads_total"
+#: canary double-dispatch samples, by result (``match`` / ``diff``)
+CANARY_SAMPLES = "cilium_tpu_canary_samples_total"
+#: canary commit attempts, by result (``committed`` / ``refused`` /
+#: ``aborted``)
+CANARY_COMMITS = "cilium_tpu_canary_commits_total"
+#: gauge: observed verdict-diff fraction of the active canary
+CANARY_DIFF_FRACTION = "cilium_tpu_canary_diff_fraction"
+
 # -- megakernel scan autotuner (engine/megakernel.py): dense-DFA vs
 # bitset-NFA measured per bank shape at engine staging
 #: autotuner decisions, by winning impl and field (cache misses only —
@@ -846,6 +862,18 @@ METRICS.describe(HUBBLE_FLOW_RECORDS,
 METRICS.describe(HUBBLE_FLOW_OVERFLOW,
                  "flow aggregation keys dropped at the aggregator's "
                  "key bound")
+METRICS.describe(TENANT_QUOTA_READS,
+                 "per-tenant quota-store reads, by result (live / "
+                 "lapsed / fault-default)")
+METRICS.describe(CANARY_SAMPLES,
+                 "canary double-dispatch samples, by result "
+                 "(match / diff)")
+METRICS.describe(CANARY_COMMITS,
+                 "canary commit attempts, by result (committed / "
+                 "refused / aborted)")
+METRICS.describe(CANARY_DIFF_FRACTION,
+                 "observed verdict-diff fraction of the active "
+                 "canary")
 
 
 class SpanStat:

@@ -27,6 +27,17 @@ loop over device-resident state (engine/ring.py):
   PolicyDeltas (PR 8): a bank-scoped commit refills only the memo
   rows whose identity+family read the swapped bank; slots and leases
   notice nothing.
+* **Canary double-dispatch.** With a :class:`~cilium_tpu.runtime.
+  canary.CanaryController` wired and sampling, a deterministic
+  fraction of chunks evaluates through the STAGED generation N+1 as
+  well — in the same pack cycle, off the already-resolved verdicts —
+  feeding the verdict-diff gate. Shadow work is advisory: its wall is
+  metered (``canary_seconds`` vs ``pack_seconds``) and a shadow
+  failure aborts the canary, never the chunk (ISSUE 20).
+* **Tenant attribution.** Streams connect WITH a tenant; the tenant
+  rides the lease and every chunk ticket, so sheds, SLO windows, and
+  explain entries attribute to the tenant that caused them (ISSUE 20
+  satellite).
 
 Two driving modes, mirroring the simulation clock's: ``start()``
 spawns the production pack thread (``simclock.sleep`` paced, so an
@@ -100,16 +111,20 @@ class SlotLease:
     expired by the pack cycle when idle past ``ttl_s``."""
 
     __slots__ = ("stream_id", "slot", "ttl_s", "granted_at",
-                 "expires_at", "active")
+                 "expires_at", "active", "tenant")
 
     def __init__(self, stream_id: str, slot: RingSlot, ttl_s: float,
-                 now: float):
+                 now: float, tenant: str = ""):
         self.stream_id = stream_id
         self.slot = slot
         self.ttl_s = float(ttl_s)
         self.granted_at = now
         self.expires_at = now + self.ttl_s
         self.active = True
+        #: the stream's tenant — rides every chunk this lease submits
+        #: so sheds/SLO/explain attribute to the tenant that caused
+        #: them; "" is the pre-tenant (unattributed) contract
+        self.tenant = str(tenant)
 
     def renew(self, now: float) -> None:
         self.expires_at = now + self.ttl_s
@@ -131,7 +146,8 @@ class ChunkTicket:
     ring serves with provenance on."""
 
     __slots__ = ("ev", "n", "t_submit", "t_done", "verdicts", "error",
-                 "trace_id", "prov", "sample_flows", "epoch")
+                 "trace_id", "prov", "sample_flows", "epoch",
+                 "tenant", "canary")
 
     def __init__(self, n: int, trace_id: str = "", epoch: int = 0):
         self.ev = simclock.event()
@@ -147,6 +163,11 @@ class ChunkTicket:
         self.epoch = int(epoch)
         self.prov = None
         self.sample_flows = None
+        #: tenant attribution (from the lease) for SLO/explain
+        self.tenant = ""
+        #: True when this chunk was canary-sampled: its sampled flows
+        #: double-dispatch through the staged generation at resolve
+        self.canary = False
 
     def resolve(self, verdicts: Optional[np.ndarray],
                 error: Optional[str] = None, prov=None) -> None:
@@ -190,7 +211,8 @@ class ServeLoop:
                  provenance: Optional[bool] = None,
                  slo=None,
                  explain_store=None,
-                 host_id: str = ""):
+                 host_id: str = "",
+                 canary=None):
         from cilium_tpu.runtime.explain import EXPLAIN
         from cilium_tpu.runtime.slo import SLOTracker
 
@@ -284,6 +306,18 @@ class ServeLoop:
         #: aggregation, trace spans, explain sampling) — the fleet
         #: lane's ≤2% obs-budget numerator
         self.obs_seconds = 0.0
+        #: shadow/canary rollout (ISSUE 20): a CanaryController whose
+        #: sampling window double-dispatches a deterministic fraction
+        #: of chunks through the staged generation N+1
+        self.canary = canary
+        #: monotone chunk counter driving the canary's deterministic
+        #: counter-walk sample selection (never an RNG/id hash)
+        self._canary_counter = 0
+        #: wall seconds spent double-dispatching sampled chunks — the
+        #: canary lane's ≤5%-of-pack-wall overhead numerator ...
+        self.canary_seconds = 0.0
+        #: ... and the pack-cycle wall it is measured against
+        self.pack_seconds = 0.0
 
     @classmethod
     def from_config(cls, loader, cfg, gate=None,
@@ -301,30 +335,34 @@ class ServeLoop:
             gate=gate, authed_pairs_fn=authed_pairs_fn)
 
     # -- leases -----------------------------------------------------------
-    def _shed(self, reason: str) -> None:
+    def _shed(self, reason: str, tenant: str = "") -> None:
         with self._stats_lock:
             self.sheds += 1
-        admission.count_shed("serve", admission.CLASS_DATA, reason)
+        admission.count_shed("serve", admission.CLASS_DATA, reason,
+                             tenant=tenant)
         if self.slo is not None:
-            self.slo.observe_request(shed=True)
+            self.slo.observe_request(shed=True, tenant=tenant)
 
-    def connect(self, stream_id: str,
-                resume: bool = False) -> SlotLease:
+    def connect(self, stream_id: str, resume: bool = False,
+                tenant: str = "") -> SlotLease:
         """Admit one stream into a slot lease. ``resume=True`` is
         reconnect-with-resume: a still-live lease for the stream is
         RENEWED and returned — never granted (counted) twice; an
-        expired/absent one falls through to a fresh grant. Raises
-        :class:`ShedError` (reason ``fault`` / ``draining`` /
-        ``ring-full`` / gate reason) instead of queueing."""
+        expired/absent one falls through to a fresh grant. ``tenant``
+        attributes the stream (sheds, SLO, explain) and rides its
+        lease. Raises :class:`ShedError` (reason ``fault`` /
+        ``draining`` / ``ring-full`` / gate reason — including
+        ``tenant-quota`` when the gate's fairness window sheds this
+        tenant) instead of queueing."""
         try:
             faults.maybe_fail(LEASE_POINT)
         except Exception:  # noqa: BLE001 — plan-chosen exception
-            self._shed(admission.SHED_FAULT)
+            self._shed(admission.SHED_FAULT, tenant=tenant)
             raise ShedError(admission.SHED_FAULT)
         now = simclock.now()
         with self._lock:
             if self._draining:
-                self._shed(admission.SHED_DRAINING)
+                self._shed(admission.SHED_DRAINING, tenant=tenant)
                 raise ShedError(admission.SHED_DRAINING)
             if resume:
                 lease = self._leases.get(stream_id)
@@ -344,7 +382,8 @@ class ServeLoop:
                 self._release_locked(self._leases[stream_id],
                                      "superseded")
         if self.gate is not None:
-            ok, reason = self.gate.admit(admission.CLASS_DATA)
+            ok, reason = self.gate.admit(admission.CLASS_DATA,
+                                         tenant=tenant)
             if not ok:
                 with self._stats_lock:
                     self.sheds += 1  # counted by the gate already
@@ -352,7 +391,7 @@ class ServeLoop:
         now = simclock.now()
         with self._lock:
             if self._draining:
-                self._shed(admission.SHED_DRAINING)
+                self._shed(admission.SHED_DRAINING, tenant=tenant)
                 raise ShedError(admission.SHED_DRAINING)
             # the lock was dropped around gate.admit: a concurrent
             # connect for the SAME stream may have granted meanwhile.
@@ -371,9 +410,10 @@ class ServeLoop:
             try:
                 slot = self.ring.acquire(stream_id)
             except RingFull:
-                self._shed(admission.SHED_RING_FULL)
+                self._shed(admission.SHED_RING_FULL, tenant=tenant)
                 raise ShedError(admission.SHED_RING_FULL)
-            lease = SlotLease(stream_id, slot, self.lease_ttl_s, now)
+            lease = SlotLease(stream_id, slot, self.lease_ttl_s, now,
+                              tenant=tenant)
             self._leases[stream_id] = lease
             heapq.heappush(self._expiry_heap,
                            (lease.expires_at, stream_id))
@@ -443,7 +483,7 @@ class ServeLoop:
         except Exception:  # noqa: BLE001 — plan-chosen exception
             with self._stats_lock:
                 self.chunk_errors += 1
-            self._shed(admission.SHED_FAULT)
+            self._shed(admission.SHED_FAULT, tenant=lease.tenant)
             raise ShedError(admission.SHED_FAULT)
         now = simclock.now()
         with self._lock:
@@ -453,7 +493,8 @@ class ServeLoop:
                 raise LeaseExpired(
                     f"lease for {lease.stream_id} lapsed")
             if len(lease.slot.pending) >= self.max_slot_pending:
-                self._shed(admission.SHED_QUEUE_FULL)
+                self._shed(admission.SHED_QUEUE_FULL,
+                           tenant=lease.tenant)
                 raise ShedError(admission.SHED_QUEUE_FULL)
             lease.renew(now)
         # the stream's trace context rides the TICKET: the pack thread
@@ -466,20 +507,32 @@ class ServeLoop:
             len(rec),
             trace_id=ctx.trace_id if ctx is not None else "",
             epoch=getattr(ctx, "epoch", 0) if ctx is not None else 0)
-        if ticket.trace_id and self.provenance \
-                and self.explain_sample > 0:
-            # sampled flows for the explain plane: only TRACED chunks
-            # pay the (bounded) host reconstruction
+        ticket.tenant = lease.tenant
+        # canary sample selection (ISSUE 20): a monotone chunk counter
+        # walked through the controller's deterministic fraction —
+        # the SAME chunks sample on every host and PYTHONHASHSEED
+        if self.canary is not None and self.canary.active():
+            with self._stats_lock:
+                self._canary_counter += 1
+                c = self._canary_counter
+            ticket.canary = self.canary.should_sample(c)
+        want_explain = (ticket.trace_id and self.provenance
+                        and self.explain_sample > 0)
+        if want_explain or ticket.canary:
+            # sampled flows for the explain plane (traced chunks) and
+            # the canary's shadow dispatch — both pay the same
+            # bounded host reconstruction, built once
             t_obs = simclock.perf()
             try:
                 from cilium_tpu.ingest.binary import records_to_flows_l7
 
-                k = min(self.explain_sample, len(rec))
+                k = min(self.explain_sample or 8, len(rec))
                 ticket.sample_flows = records_to_flows_l7(
                     rec[:k], l7[:k], offsets, blob,
                     gen=(gen[:k] if gen is not None else None))
-            except Exception:  # noqa: BLE001 — explain is advisory;
-                ticket.sample_flows = None  # never fail the chunk
+            except Exception:  # noqa: BLE001 — explain/canary are
+                ticket.sample_flows = None  # advisory; never fail
+                ticket.canary = False       # the chunk
             with self._stats_lock:
                 self.obs_seconds += max(0.0, simclock.perf() - t_obs)
         # ring.submit takes its own lock; encoding outside ours keeps
@@ -546,8 +599,21 @@ class ServeLoop:
         lat = max(0.0, simclock.now() - ticket.t_submit)
         METRICS.observe(SERVE_LATENCY, lat, labels=self._host_labels)
         if self.slo is not None:
-            self.slo.observe_latency(lat)
-            self.slo.observe_request(shed=False)
+            self.slo.observe_latency(lat, tenant=ticket.tenant)
+            self.slo.observe_request(shed=False,
+                                     tenant=ticket.tenant)
+        if ticket.canary and self.canary is not None \
+                and ticket.sample_flows:
+            # the double dispatch: the sampled flows re-evaluate
+            # through the STAGED generation, diffed against what N
+            # just served — in this pack cycle, metered against it
+            t_can = simclock.perf()
+            self.canary.observe_chunk(
+                ticket.sample_flows,
+                verdicts[:len(ticket.sample_flows)])
+            with self._stats_lock:
+                self.canary_seconds += max(
+                    0.0, simclock.perf() - t_can)
         with self._stats_lock:
             if prov is not None:
                 self.records_explained += n
@@ -578,7 +644,8 @@ class ServeLoop:
                 pack_cycle=prov.pack_cycle,
                 generation=prov.generation,
                 host_id=self.host_id,
-                sample=len(ticket.sample_flows))
+                sample=len(ticket.sample_flows),
+                tenant=ticket.tenant)
             self.explain.record(ticket.trace_id, entries)
             self.flows.observe_entries(entries)
             LOG.debug("serve chunk explained", extra={"fields": {
@@ -622,6 +689,10 @@ class ServeLoop:
             served += self._resolve_ticket(ticket, n, dev)
         with self._stats_lock:
             self.served_records += served
+            if results:
+                # pack-cycle wall (dispatch + resolution, shadow
+                # included) — the canary overhead's denominator
+                self.pack_seconds += max(0.0, simclock.perf() - t0)
         if results and self.slo is not None:
             self.slo.publish()
         return served
@@ -677,6 +748,7 @@ class ServeLoop:
             # lapses mid-drain
             pairs = (self.authed_pairs_fn()
                      if self.authed_pairs_fn is not None else None)
+            t0 = simclock.perf()
             with self._pack_lock:
                 results = self.ring.pack(authed_pairs=pairs)
             if not results:
@@ -690,6 +762,8 @@ class ServeLoop:
                     ticket.resolve(None, error="session-reset")
                     continue
                 flushed += self._resolve_ticket(ticket, n, dev)
+            with self._stats_lock:
+                self.pack_seconds += max(0.0, simclock.perf() - t0)
         with self._stats_lock:
             self.served_records += flushed
         with self._lock:
@@ -769,4 +843,9 @@ class ServeLoop:
         }
         if self.slo is not None:
             out["slo"] = self.slo.status()
+        if self.canary is not None:
+            report = self.canary.report()
+            report["canary_seconds"] = round(self.canary_seconds, 6)
+            report["pack_seconds"] = round(self.pack_seconds, 6)
+            out["canary"] = report
         return out

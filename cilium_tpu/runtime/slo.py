@@ -16,7 +16,14 @@ burn-rate formulation over the targets declared in ``Config.slo``:
 Each SLO is tracked over every window in ``windows_s`` (default 5 min
 and 1 h) with bounded bucketed counters — memory is constant, and
 time comes off the installed simclock, so the DST load model and the
-serve-soak lane read deterministic virtual-time burn rates. A burn
+serve-soak lane read deterministic virtual-time burn rates.
+
+Multi-tenant burn attribution (ISSUE 20): observations carrying a
+tenant ALSO land in that tenant's own window set, published as
+``cilium_tpu_slo_burn_rate{slo,window,tenant}`` series alongside the
+aggregate. A tenant storming its own quota burns ITS series; the
+isolation invariant reads the other tenants' series to prove they
+stayed within SLO. A burn
 rate of 1.0 means "spending budget exactly as declared"; the classic
 page-worthy thresholds (14.4× over 5 min, 6× over 1 h) are the
 operator's to pick — we publish the gauges
@@ -89,6 +96,13 @@ class SLOTracker:
         self._lock = threading.Lock()
         self._lat = {w: _Window(w) for w in self.windows_s}
         self._shed = {w: _Window(w) for w in self.windows_s}
+        #: per-tenant window sets, created on first observation —
+        #: keyed by the CONFIGURED tenant set (plus "default"), so
+        #: cardinality is operator-bounded, never flow-driven
+        # ctlint: disable=unbounded-registry  # keyed by configured tenants
+        self._tenant_lat: Dict[str, Dict[float, _Window]] = {}
+        # ctlint: disable=unbounded-registry  # keyed by configured tenants
+        self._tenant_shed: Dict[str, Dict[float, _Window]] = {}
 
     @classmethod
     def from_config(cls, cfg) -> Optional["SLOTracker"]:
@@ -102,20 +116,36 @@ class SLOTracker:
                                            (300.0, 3600.0))))
 
     # -- observation ------------------------------------------------------
-    def observe_latency(self, latency_s: float) -> None:
+    def _tenant_windows_locked(self, registry, tenant: str):
+        wins = registry.get(tenant)
+        if wins is None:
+            wins = {w: _Window(w) for w in self.windows_s}
+            registry[tenant] = wins
+        return wins
+
+    def observe_latency(self, latency_s: float,
+                        tenant: str = "") -> None:
         now = simclock.now()
         bad = latency_s > self.serve_p99_s
         with self._lock:
             for w in self._lat.values():
                 w.observe(now, bad)
+            if tenant:
+                for w in self._tenant_windows_locked(
+                        self._tenant_lat, tenant).values():
+                    w.observe(now, bad)
 
-    def observe_request(self, shed: bool) -> None:
+    def observe_request(self, shed: bool, tenant: str = "") -> None:
         """One admission outcome (served or shed) for the
-        availability SLO."""
+        availability SLO, attributed to ``tenant`` when given."""
         now = simclock.now()
         with self._lock:
             for w in self._shed.values():
                 w.observe(now, shed)
+            if tenant:
+                for w in self._tenant_windows_locked(
+                        self._tenant_shed, tenant).values():
+                    w.observe(now, shed)
 
     # -- read-out ---------------------------------------------------------
     @staticmethod
@@ -141,9 +171,37 @@ class SLOTracker:
                     frac / self.shed_budget, 4)
         return out
 
+    def tenant_burn_rates(self) -> Dict[str, Dict[str, Dict[str,
+                                                            float]]]:
+        """{tenant: {slo: {window label: burn rate}}} over every
+        tenant that has observed — the isolation invariant's per-
+        tenant SLO face."""
+        now = simclock.now()
+        out: Dict[str, Dict[str, Dict[str, float]]] = {}
+        with self._lock:
+            tenants = set(self._tenant_lat) | set(self._tenant_shed)
+            for tenant in sorted(tenants):
+                rates: Dict[str, Dict[str, float]] = {
+                    "serve-p99": {}, "serve-shed": {}}
+                for ws, w in self._tenant_lat.get(tenant,
+                                                  {}).items():
+                    bad, total = w.fraction(now)
+                    frac = bad / total if total else 0.0
+                    rates["serve-p99"][self._label(ws)] = round(
+                        frac / self.latency_budget, 4)
+                for ws, w in self._tenant_shed.get(tenant,
+                                                   {}).items():
+                    bad, total = w.fraction(now)
+                    frac = bad / total if total else 0.0
+                    rates["serve-shed"][self._label(ws)] = round(
+                        frac / self.shed_budget, 4)
+                out[tenant] = rates
+        return out
+
     def publish(self) -> Dict[str, Dict[str, float]]:
         """Refresh the burn-rate gauges (called once per pack cycle —
-        cheap, bounded by slos × windows) and return the rates."""
+        cheap, bounded by slos × windows × configured tenants) and
+        return the aggregate rates."""
         rates = self.burn_rates()
         for slo, per_window in rates.items():
             for window, rate in per_window.items():
@@ -151,6 +209,15 @@ class SLOTracker:
                 if self.host:
                     labels["host"] = self.host
                 METRICS.set_gauge(SLO_BURN_RATE, rate, labels=labels)
+        for tenant, per_slo in self.tenant_burn_rates().items():
+            for slo, per_window in per_slo.items():
+                for window, rate in per_window.items():
+                    labels = {"slo": slo, "window": window,
+                              "tenant": tenant}
+                    if self.host:
+                        labels["host"] = self.host
+                    METRICS.set_gauge(SLO_BURN_RATE, rate,
+                                      labels=labels)
         return rates
 
     def window_totals(self) -> Dict[str, int]:
@@ -166,9 +233,13 @@ class SLOTracker:
         return out
 
     def status(self) -> Dict[str, object]:
-        return {
+        out = {
             "targets": {"serve_p99_ms": self.serve_p99_s * 1e3,
                         "shed_rate": self.shed_budget},
             "windows_s": list(self.windows_s),
             "burn_rates": self.burn_rates(),
         }
+        tenants = self.tenant_burn_rates()
+        if tenants:
+            out["tenants"] = tenants
+        return out

@@ -782,3 +782,138 @@ def test_trace_stitches_when_death_lands_on_the_exact_expiry_tick(
         finally:
             TRACER.configure(enabled=prev_enabled,
                              sample_rate=prev_rate)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 20: tenant-fairness and canary boundaries — quantum rotation
+# at the exact virtual tick, counter-walk sample selection identical
+# under every PYTHONHASHSEED, and a quota lapse landing on the exact
+# tick of a regeneration's admission decisions.
+
+
+def test_fairness_quantum_rotates_at_the_exact_virtual_tick():
+    """A tenant shed for hogging the window is forgiven at EXACTLY
+    start+quantum — the rotation boundary is closed (now >= start +
+    quantum). One tick before the quantum the storming tenant still
+    sheds; AT the tick the window is fresh and the same tenant
+    admits. The rotation also lands on the quantum grid, never on
+    'whenever the next request happened to arrive'."""
+    from cilium_tpu.runtime.admission import (
+        CLASS_DATA,
+        SHED_TENANT_QUOTA,
+        AdmissionGate,
+    )
+    from cilium_tpu.runtime.tenant import FairShareWindow
+
+    clk = VirtualClock(start=100.0)
+    with simclock.use(clk):
+        fair = FairShareWindow(quantum_s=5.0, max_share=0.3)
+        gate = AdmissionGate(max_pending=8, control_reserve=2,
+                             depth_fn=lambda: 6, fairness=fair)
+        assert gate.admit(CLASS_DATA, tenant="b") == (True, "")
+        # a storms until the window judges it over cap AND fair share
+        shed = False
+        for _ in range(12):
+            ok, reason = gate.admit(CLASS_DATA, tenant="a")
+            if not ok:
+                assert reason == SHED_TENANT_QUOTA
+                shed = True
+                break
+        assert shed, "storming tenant must shed within the window"
+        # one tick BEFORE the quantum boundary: still the same window,
+        # the storm is still on the books, a still sheds
+        clk.advance_to(100.0 + 5.0 - 1e-6)
+        ok, reason = gate.admit(CLASS_DATA, tenant="a")
+        assert (ok, reason) == (False, SHED_TENANT_QUOTA)
+        # AT exactly start+quantum: fresh window, a is forgiven
+        clk.advance_to(105.0)
+        assert gate.admit(CLASS_DATA, tenant="a") == (True, "")
+        assert fair.window_start() == 105.0   # grid, not arrival time
+        # an idle gap of 2.5 quanta later: the window start is still
+        # on the grid (105 + 2*5), not at the arrival tick
+        clk.advance_to(105.0 + 12.5)
+        fair.note("b")
+        assert fair.window_start() == 115.0
+
+
+def test_canary_sample_selection_identical_under_hashseeds():
+    """Sample selection is a pure counter walk — floor(c*f) !=
+    floor((c-1)*f) — so the SAME chunks are sampled on every host and
+    under every PYTHONHASHSEED. Three fresh interpreters with seeds
+    0/1/2 must pick byte-identical counter sets of exactly
+    floor(n*f) chunks."""
+    import os
+    import subprocess
+    import sys
+
+    prog = (
+        "from cilium_tpu.runtime.loader import Loader\n"
+        "from cilium_tpu.core.config import Config\n"
+        "from cilium_tpu.runtime.canary import CanaryController\n"
+        "class _L:\n"
+        "    pass\n"
+        "c = CanaryController(_L(), sample_fraction=0.37)\n"
+        "picked = [i for i in range(1, 201) if c.should_sample(i)]\n"
+        "print(len(picked), ','.join(map(str, picked)))\n"
+    )
+    outs = []
+    for seed in ("0", "1", "2"):
+        env = dict(os.environ,
+                   PYTHONHASHSEED=seed, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-c", prog], env=env,
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        outs.append(out.stdout.strip())
+    assert outs[0] == outs[1] == outs[2]
+    count = int(outs[0].split()[0])
+    assert count == int(200 * 0.37)          # exactly floor(n*f)
+
+
+def test_tenant_quota_lapse_races_a_regeneration():
+    """Tenant a's quota TTL expires at EXACTLY the tick its own
+    regeneration lands admission decisions. The boundary is closed
+    (expires_at <= now): AT the tick the conservative default share
+    applies — a's data-plane burst sheds tenant-quota — while the
+    regeneration's CLASS_CONTROL traffic is exempt and sails through,
+    so a quota lapse can never starve the control plane that would
+    refresh it. One tick earlier the live quota still holds."""
+    from cilium_tpu.runtime.admission import (
+        CLASS_CONTROL,
+        CLASS_DATA,
+        SHED_TENANT_QUOTA,
+        AdmissionGate,
+    )
+    from cilium_tpu.runtime.metrics import METRICS, TENANT_QUOTA_READS
+    from cilium_tpu.runtime.tenant import (
+        FairShareWindow,
+        TenantQuotas,
+    )
+
+    clk = VirtualClock(start=0.0)
+    with simclock.use(clk):
+        quotas = TenantQuotas(default_share=0.2, ttl_s=10.0)
+        quotas.set_share("a", 0.95)          # expires_at == 10.0
+        fair = FairShareWindow(quantum_s=1000.0, max_share=0.2)
+        gate = AdmissionGate(max_pending=8, control_reserve=2,
+                             depth_fn=lambda: 6, fairness=fair,
+                             quotas=quotas)
+        fair.note("b")
+        lapsed0 = METRICS.get(TENANT_QUOTA_READS,
+                              {"result": "lapsed"})
+        # one tick BEFORE expiry: the live 0.95 quota admits the burst
+        clk.advance_to(10.0 - 1e-6)
+        for _ in range(6):
+            assert gate.admit(CLASS_DATA, tenant="a") == (True, "")
+        # AT exactly expires_at — the regeneration tick: data sheds on
+        # the conservative default, control admits
+        clk.advance_to(10.0)
+        ok, reason = gate.admit(CLASS_DATA, tenant="a")
+        assert (ok, reason) == (False, SHED_TENANT_QUOTA)
+        assert gate.admit(CLASS_CONTROL, tenant="a") == (True, "")
+        assert METRICS.get(TENANT_QUOTA_READS,
+                           {"result": "lapsed"}) == lapsed0 + 1
+        # the quota store dropped the entry — a later refresh (the
+        # regeneration's control plane got through) restores service
+        quotas.set_share("a", 0.95)
+        assert gate.admit(CLASS_DATA, tenant="a") == (True, "")

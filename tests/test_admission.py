@@ -500,3 +500,40 @@ def test_agent_stop_drains_in_flight_requests(tmp_path):
     t.join(timeout=10.0)
     stopper.join(timeout=10.0)
     assert got == [1], got
+
+
+# ---------------------------------------------------------------------------
+# tenant fairness congestion threshold (ISSUE 20)
+
+
+def test_tenant_fairness_congestion_threshold_is_exact():
+    """The fairness check arms strictly past HALF the data-path bound
+    (depth > max_pending // 2): at exactly half, a hogging tenant
+    still rides idle capacity; one deeper, the same tenant sheds
+    tenant-quota — and the shed carries the tenant on the label."""
+    from cilium_tpu.runtime.admission import SHED_TENANT_QUOTA
+    from cilium_tpu.runtime.tenant import FairShareWindow
+
+    depth = [0]
+    fair = FairShareWindow(quantum_s=1000.0, max_share=0.3,
+                           clock=lambda: 0.0)
+    gate = AdmissionGate(max_pending=8, control_reserve=2,
+                         depth_fn=lambda: depth[0], fairness=fair)
+    # tenant a owns the whole window vs a modest b share
+    gate.admit(CLASS_DATA, tenant="b")
+    for _ in range(8):
+        fair.note("a")
+    shed0 = _metric(ADMISSION_SHED,
+                    {"surface": "service", "class": CLASS_DATA,
+                     "reason": SHED_TENANT_QUOTA, "tenant": "a"})
+    depth[0] = 4                        # exactly half: NOT congested
+    assert gate.admit(CLASS_DATA, tenant="a") == (True, "")
+    depth[0] = 5                        # one past half: armed
+    ok, reason = gate.admit(CLASS_DATA, tenant="a")
+    assert (ok, reason) == (False, SHED_TENANT_QUOTA)
+    assert _metric(ADMISSION_SHED,
+                   {"surface": "service", "class": CLASS_DATA,
+                    "reason": SHED_TENANT_QUOTA,
+                    "tenant": "a"}) == shed0 + 1
+    # b stays under its share at the same depth
+    assert gate.admit(CLASS_DATA, tenant="b") == (True, "")

@@ -1,0 +1,223 @@
+"""runtime/canary.py (ISSUE 20): the shadow-rollout verdict-diff
+gate. A staged generation N+1 earns its commit through sampled
+double-dispatch; a diff over budget REFUSES the commit with serving
+generation N untouched; sample selection is a deterministic counter
+walk, never an RNG."""
+
+import pytest
+
+from cilium_tpu.core.config import Config
+from cilium_tpu.core.flow import Flow, Protocol, TrafficDirection
+from cilium_tpu.runtime.canary import (
+    STATE_COMMITTED,
+    STATE_IDLE,
+    STATE_REFUSED,
+    STATE_SAMPLING,
+    CanaryController,
+    CanaryRefused,
+)
+from cilium_tpu.runtime.loader import Loader
+from cilium_tpu.runtime.metrics import (
+    CANARY_COMMITS,
+    CANARY_SAMPLES,
+    METRICS,
+)
+
+
+def _metric(name, labels=None):
+    return METRICS.get(name, labels)
+
+
+def _tiny_policy(port):
+    from cilium_tpu.core.identity import IdentityAllocator
+    from cilium_tpu.core.labels import LabelSet
+    from cilium_tpu.policy.api import (
+        EndpointSelector,
+        IngressRule,
+        PortProtocol,
+        PortRule,
+        Rule,
+    )
+    from cilium_tpu.policy.mapstate import PolicyResolver
+    from cilium_tpu.policy.repository import Repository
+    from cilium_tpu.policy.selectorcache import SelectorCache
+
+    rules = [Rule(
+        endpoint_selector=EndpointSelector.from_labels(app="db"),
+        ingress=(IngressRule(
+            from_endpoints=(EndpointSelector.from_labels(app="web"),),
+            to_ports=(PortRule(ports=(
+                PortProtocol(port, Protocol.TCP),)),)),),
+    )]
+    alloc = IdentityAllocator()
+    db = alloc.allocate(LabelSet.from_dict({"app": "db"}))
+    web = alloc.allocate(LabelSet.from_dict({"app": "web"}))
+    cache = SelectorCache(alloc)
+    repo = Repository()
+    repo.add(rules, sanitize=False)
+    per_identity = {db: PolicyResolver(repo, cache).resolve(
+        alloc.lookup(db))}
+    return per_identity, db, web
+
+
+def _flow(web, db, port):
+    return Flow(src_identity=web, dst_identity=db, dport=port,
+                protocol=Protocol.TCP,
+                direction=TrafficDirection.INGRESS)
+
+
+def _world(port=5432, **canary_kw):
+    cfg = Config()
+    cfg.loader.enable_cache = False
+    loader = Loader(cfg)
+    per, db, web = _tiny_policy(port)
+    loader.regenerate(per, revision=1)
+    ctrl = CanaryController(loader, **canary_kw)
+    return loader, ctrl, per, db, web
+
+
+# ---------------------------------------------------------------------------
+# sampling determinism
+
+
+def test_should_sample_is_an_exact_counter_walk():
+    loader, ctrl, *_ = _world(sample_fraction=0.25)
+    picked = [c for c in range(1, 101) if ctrl.should_sample(c)]
+    # exactly floor(100 * 0.25) chunks, a pure function of the counter
+    assert len(picked) == 25
+    assert picked == [c for c in range(1, 101)
+                      if int(c * 0.25) != int((c - 1) * 0.25)]
+    # idempotent re-ask — no hidden state advanced by asking
+    assert [c for c in range(1, 101) if ctrl.should_sample(c)] == picked
+    loader.close()
+
+
+def test_zero_fraction_never_samples_full_fraction_always_does():
+    loader, ctrl, *_ = _world(sample_fraction=0.0)
+    assert not any(ctrl.should_sample(c) for c in range(1, 50))
+    ctrl.sample_fraction = 1.0
+    assert all(ctrl.should_sample(c) for c in range(1, 50))
+    loader.close()
+
+
+# ---------------------------------------------------------------------------
+# refuse / commit / lifecycle
+
+
+def test_bad_rollout_refused_serving_untouched():
+    loader, ctrl, per, db, web = _world(
+        sample_fraction=1.0, diff_budget=0.0, min_samples=4)
+    refused0 = _metric(CANARY_COMMITS, {"result": "refused"})
+    diff0 = _metric(CANARY_SAMPLES, {"result": "diff"})
+
+    import copy
+    bad = copy.deepcopy(per)
+    for ms in bad.values():
+        for entry in ms.entries.values():
+            entry.is_deny = True
+    ctrl.stage(bad, revision=2)
+    assert ctrl.state == STATE_SAMPLING
+    assert loader.canary_revision == 2
+
+    flows = [_flow(web, db, 5432)] * 4
+    served = [int(v) for v in
+              loader.engine.verdict_flows(flows)["verdict"]]
+    assert ctrl.observe_chunk(flows, served)
+    assert ctrl.diffs == 4                  # deny-flip diffs every flow
+
+    with pytest.raises(CanaryRefused) as exc:
+        ctrl.try_commit()
+    assert ctrl.state == STATE_REFUSED
+    assert "diff_fraction" in exc.value.report["reason"] or \
+        exc.value.report["diff_fraction"] == 1.0
+    # serving generation N: untouched — revision, engine, verdicts
+    assert loader.revision == 1
+    assert loader.canary_engine is None     # staged generation dropped
+    assert [int(v) for v in
+            loader.engine.verdict_flows(flows)["verdict"]] == served
+    assert _metric(CANARY_COMMITS, {"result": "refused"}) == refused0 + 1
+    assert _metric(CANARY_SAMPLES, {"result": "diff"}) == diff0 + 4
+    loader.close()
+
+
+def test_clean_rollout_commits_and_promotes():
+    loader, ctrl, per, db, web = _world(
+        sample_fraction=1.0, diff_budget=0.0, min_samples=4)
+    committed0 = _metric(CANARY_COMMITS, {"result": "committed"})
+    per2, _, _ = _tiny_policy(5432)         # same semantics, new gen
+    ctrl.stage(per2, revision=2)
+    flows = [_flow(web, db, 5432)] * 4
+    served = [int(v) for v in
+              loader.engine.verdict_flows(flows)["verdict"]]
+    ctrl.observe_chunk(flows, served)
+    assert ctrl.diffs == 0
+    ctrl.try_commit()
+    assert ctrl.state == STATE_COMMITTED
+    assert loader.revision == 2             # N+1 promoted
+    assert loader.canary_engine is None
+    assert _metric(CANARY_COMMITS,
+                   {"result": "committed"}) == committed0 + 1
+    loader.close()
+
+
+def test_under_sampled_rollout_refused_even_with_zero_diffs():
+    """The sample floor is part of the gate: zero diffs over too few
+    samples is absence of evidence, not evidence of absence."""
+    loader, ctrl, per, db, web = _world(
+        sample_fraction=1.0, diff_budget=0.0, min_samples=64)
+    per2, _, _ = _tiny_policy(5432)
+    ctrl.stage(per2, revision=2)
+    flows = [_flow(web, db, 5432)] * 4
+    served = [int(v) for v in
+              loader.engine.verdict_flows(flows)["verdict"]]
+    ctrl.observe_chunk(flows, served)
+    with pytest.raises(CanaryRefused) as exc:
+        ctrl.try_commit()
+    assert "floor" in exc.value.report["reason"]
+    assert loader.revision == 1
+    loader.close()
+
+
+def test_observe_is_inert_outside_sampling_and_commit_needs_a_stage():
+    loader, ctrl, per, db, web = _world()
+    assert ctrl.state == STATE_IDLE
+    flows = [_flow(web, db, 5432)]
+    assert not ctrl.observe_chunk(flows, [1])
+    assert ctrl.samples == 0
+    with pytest.raises(RuntimeError, match="no canary sampling"):
+        ctrl.try_commit()
+    loader.close()
+
+
+def test_restage_resets_the_ledger():
+    loader, ctrl, per, db, web = _world(sample_fraction=1.0,
+                                        min_samples=1)
+    per2, _, _ = _tiny_policy(5432)
+    ctrl.stage(per2, revision=2)
+    flows = [_flow(web, db, 5432)] * 3
+    served = [int(v) for v in
+              loader.engine.verdict_flows(flows)["verdict"]]
+    ctrl.observe_chunk(flows, served)
+    assert ctrl.samples == 3
+    ctrl.stage(per2, revision=3)            # a new gen earns its own
+    assert (ctrl.samples, ctrl.diffs, ctrl.chunks) == (0, 0, 0)
+    assert ctrl.revision == 3
+    assert loader.canary_revision == 3
+    loader.close()
+
+
+def test_report_shape_and_from_config():
+    loader, ctrl, per, db, web = _world(
+        sample_fraction=0.5, diff_budget=0.01, min_samples=7)
+    rep = ctrl.report()
+    assert rep == {
+        "state": "idle", "revision": 0, "sample_fraction": 0.5,
+        "diff_budget": 0.01, "min_samples": 7, "chunks": 0,
+        "samples": 0, "diffs": 0, "diff_fraction": 0.0, "reason": "",
+    }
+    loader.config.canary.sample_fraction = 0.125
+    loader.config.canary.min_samples = 3
+    ctrl2 = CanaryController.from_config(loader)
+    assert ctrl2.sample_fraction == 0.125
+    assert ctrl2.min_samples == 3
+    loader.close()

@@ -461,3 +461,83 @@ def test_shard_of_is_stable_and_spread():
 def test_work_key_is_pure_function_of_bank_key():
     assert work_key("abc") == work_key("abc")
     assert work_key("abc") != work_key("abd")
+
+
+# ---------------------------------------------------------------------------
+# per-tenant weighted-fair queueing + occupancy bound (ISSUE 20)
+
+
+def test_wfq_claims_follow_tenant_virtual_time():
+    """Single worker, a plugged head, then a backlog of two tenants
+    with weights 2:1 — the claim order is the deterministic WFQ walk
+    (lowest virtual finish time, 1/weight charged per claim, ties on
+    tenant name then submit order), NOT pure submit order."""
+    release = threading.Event()
+    order = []
+    q = CompileQueue(workers=1, deadline_s=30.0, max_pending=32,
+                     weight_of=lambda t: 2.0 if t == "big" else 1.0)
+    try:
+        def mk(name):
+            def fn():
+                order.append(name)
+                return name
+            return fn
+
+        plug = q.submit("plug", lambda: release.wait(10.0))
+        # the worker is busy in the plug: the backlog queues untouched
+        tasks = []
+        for i in range(3):
+            tasks.append(q.submit(f"big-{i}", mk(f"big-{i}"),
+                                  tenant="big"))
+            tasks.append(q.submit(f"small-{i}", mk(f"small-{i}"),
+                                  tenant="small"))
+        release.set()
+        assert q.wait(plug, timeout=10.0)
+        for t in tasks:
+            assert q.wait(t, timeout=10.0)
+        # vtime walk: big charges 0.5/claim, small 1.0/claim; ties
+        # break on tenant name — byte-deterministic, pinned exactly
+        assert order == ["big-0", "small-0", "big-1", "big-2",
+                         "small-1", "small-2"]
+    finally:
+        q.close()
+
+
+def test_tenant_occupancy_bound_blocks_only_the_storming_tenant():
+    """Tenant a at its occupancy cap (tenant_max_share × max_pending
+    live tasks) blocks a's NEXT submit — while tenant b's submit
+    sails through the same queue at the same moment."""
+    release = threading.Event()
+    q = CompileQueue(workers=1, deadline_s=30.0, max_pending=4,
+                     tenant_max_share=0.5)      # a's cap: 2 live
+    try:
+        a0 = q.submit("a-0", lambda: release.wait(10.0), tenant="a")
+        a1 = q.submit("a-1", lambda: "a1", tenant="a")
+        assert q.status()["tenant_inflight"] == {"a": 2}
+
+        entered = threading.Event()
+        unblocked = threading.Event()
+
+        def storm():
+            entered.set()
+            q.submit("a-2", lambda: "a2", tenant="a")
+            unblocked.set()
+
+        th = threading.Thread(target=storm, daemon=True)
+        th.start()
+        assert entered.wait(5.0)
+        # a is at its bound: the submit parks instead of returning
+        assert not unblocked.wait(0.6)
+        # b is untouched by a's storm: same queue, instant admission
+        b0 = q.submit("b-0", lambda: "b0", tenant="b")
+        assert q.status()["tenant_inflight"]["b"] == 1
+        # capacity frees → ONLY then does a's parked submit return
+        release.set()
+        assert unblocked.wait(10.0)
+        th.join(10.0)
+        for t in (a0, a1, b0):
+            assert q.wait(t, timeout=10.0)
+        assert q.wait(q.submit("a-2", lambda: "a2", tenant="a"),
+                      timeout=10.0)
+    finally:
+        q.close()

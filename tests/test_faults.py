@@ -125,8 +125,10 @@ def test_registered_points_cover_the_documented_seams():
     import cilium_tpu.identity_kvstore  # noqa: F401
     import cilium_tpu.kvstore  # noqa: F401
     import cilium_tpu.policy.compiler.bankplan  # noqa: F401
+    import cilium_tpu.runtime.canary  # noqa: F401
     import cilium_tpu.runtime.fleetserve  # noqa: F401
     import cilium_tpu.runtime.stream  # noqa: F401
+    import cilium_tpu.runtime.tenant  # noqa: F401
 
     pts = faults.registered_points()
     for p in ("engine.dispatch", "loader.swap", "loader.bank_compile",
@@ -134,7 +136,8 @@ def test_registered_points_cover_the_documented_seams():
               "stream.frame.client", "stream.credit", "service.admit",
               "service.drain", "kvstore.watch", "kvstore.churn_storm",
               "clustermesh.session", "dnsproxy.query",
-              "fleet.heartbeat", "fleet.handoff"):
+              "fleet.heartbeat", "fleet.handoff",
+              "canary.dispatch", "tenant.quota"):
         assert p in pts, p
 
 
@@ -1395,3 +1398,72 @@ def test_warm_restore_same_artifact_keeps_memo(tmp_path):
     assert memo.hits > hits0
     assert replay.unique_rows is uniq_buf, \
         "unique-row device buffer was re-staged"
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 20: tenant.quota + canary.dispatch fault points
+
+
+def test_tenant_quota_fault_falls_to_conservative_default():
+    """A LOST quota read (tenant.quota fires) must return the
+    conservative default share — bounded, never unbounded — counted
+    ``fault-default``; once the fault exhausts, the live entry serves
+    again and a lapsed TTL reads as the default too."""
+    from cilium_tpu.runtime.metrics import TENANT_QUOTA_READS
+    from cilium_tpu.runtime.tenant import TenantQuotas
+
+    now = [0.0]
+    quotas = TenantQuotas(default_share=0.25, ttl_s=10.0,
+                          clock=lambda: now[0])
+    quotas.set_share("a", 0.9)
+    fd0 = _metric(TENANT_QUOTA_READS, {"result": "fault-default"})
+    live0 = _metric(TENANT_QUOTA_READS, {"result": "live"})
+    with faults.inject(FaultPlan([FaultRule("tenant.quota", times=1)])):
+        assert quotas.share_of("a") == 0.25, \
+            "faulted quota read must be the conservative default"
+        assert quotas.share_of("a") == 0.9, \
+            "after the fault exhausts the live entry serves"
+    assert _metric(TENANT_QUOTA_READS,
+                   {"result": "fault-default"}) == fd0 + 1
+    assert _metric(TENANT_QUOTA_READS, {"result": "live"}) == live0 + 1
+    # TTL lapse at EXACTLY the tick (closed boundary) → default
+    now[0] = 10.0
+    assert quotas.share_of("a") == 0.25
+
+
+def test_canary_dispatch_fault_aborts_canary_serving_untouched():
+    """A failed shadow dispatch (canary.dispatch fires) must ABORT the
+    canary — staged generation dropped, serving generation untouched,
+    commit refused as aborted — never crash the serve path."""
+    from cilium_tpu.core.flow import Verdict
+    from cilium_tpu.runtime.canary import (
+        STATE_ABORTED,
+        CanaryController,
+    )
+    from cilium_tpu.runtime.metrics import CANARY_COMMITS
+
+    cfg = Config()
+    cfg.enable_tpu_offload = True
+    loader = Loader(cfg)
+    per1, db, web = _tiny_policy(5432)
+    loader.regenerate(per1, revision=1)
+    flows = [_flow(web, db, 5432), _flow(web, db, 6000)]
+    served = [int(v) for v in
+              loader.engine.verdict_flows(flows)["verdict"]]
+
+    canary = CanaryController(loader, sample_fraction=1.0,
+                              diff_budget=0.0, min_samples=1)
+    canary.stage(per1, revision=2)
+    ab0 = _metric(CANARY_COMMITS, {"result": "aborted"})
+    with faults.inject(FaultPlan([FaultRule("canary.dispatch",
+                                            times=1)])):
+        canary.observe_chunk(flows, served)  # must not raise
+    assert canary.state == STATE_ABORTED
+    assert loader.canary_engine is None, "staged generation dropped"
+    assert loader.revision == 1, "serving generation untouched"
+    assert _metric(CANARY_COMMITS, {"result": "aborted"}) == ab0 + 1
+    after = [int(v) for v in
+             loader.engine.verdict_flows(flows)["verdict"]]
+    assert after == served
+    assert Verdict(after[0]) is not None  # decodable, not ERROR junk
+    loader.close()

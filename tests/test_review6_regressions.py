@@ -105,7 +105,7 @@ def test_duplicate_connect_race_around_gate_leaks_no_slot(tmp_path):
                 self.racer = None
                 self._fired = False
 
-            def admit(self, cls):
+            def admit(self, cls, tenant=""):
                 if not self._fired:
                     self._fired = True
                     self.racer = loop.connect(self.stream_id,

@@ -1,0 +1,257 @@
+"""runtime/tenant.py (ISSUE 20): the tenant partition vocabulary —
+range/weight parsing, the identity→tenant map, the TTL'd quota store
+with its conservative default, the rotating weighted-fair admission
+window — and the AdmissionGate's tenant-fairness integration (a
+storming tenant sheds ``tenant-quota`` with the tenant on the label
+while other tenants keep admitting)."""
+
+import pytest
+
+from cilium_tpu.core.config import Config
+from cilium_tpu.runtime import admission
+from cilium_tpu.runtime.admission import (
+    CLASS_CONTROL,
+    CLASS_DATA,
+    SHED_TENANT_QUOTA,
+    AdmissionGate,
+)
+from cilium_tpu.runtime.metrics import ADMISSION_SHED, METRICS
+from cilium_tpu.runtime.tenant import (
+    DEFAULT_TENANT,
+    FairShareWindow,
+    TenantMap,
+    TenantQuotas,
+    parse_ranges,
+    parse_weights,
+)
+
+
+def _metric(name, labels=None):
+    return METRICS.get(name, labels)
+
+
+# ---------------------------------------------------------------------------
+# parsing
+
+
+def test_parse_ranges_and_weights():
+    assert parse_ranges(["a:100-199", "b:200-299"]) == (
+        ("a", 100, 199), ("b", 200, 299))
+    assert parse_weights(["a:2.0", "b:0.5"]) == {"a": 2.0, "b": 0.5}
+
+
+@pytest.mark.parametrize("bad", ["a", "a:", ":100-200", "a:100",
+                                 "a:-200"])
+def test_parse_ranges_rejects_malformed_at_config_time(bad):
+    with pytest.raises(ValueError):
+        parse_ranges([bad])
+
+
+def test_parse_weights_rejects_zero_and_negative():
+    # a zero-weight tenant could never drain its queue
+    with pytest.raises(ValueError):
+        parse_weights(["a:0"])
+    with pytest.raises(ValueError):
+        parse_weights(["a:-1.5"])
+
+
+# ---------------------------------------------------------------------------
+# TenantMap
+
+
+def test_tenant_map_first_match_wins_and_default():
+    tm = TenantMap(ranges=("a:100-199", "b:150-299"),
+                   weights=("a:2.0",))
+    assert tm.tenant_of(100) == "a"
+    assert tm.tenant_of(199) == "a"
+    assert tm.tenant_of(150) == "a"      # overlapping: first declared
+    assert tm.tenant_of(200) == "b"
+    assert tm.tenant_of(5) == DEFAULT_TENANT
+    assert tm.weight_of("a") == 2.0
+    assert tm.weight_of("b") == 1.0      # undeclared weighs 1.0
+    assert tm.tenants() == ("a", "b")
+
+
+def test_tenant_map_from_config():
+    cfg = Config()
+    cfg.tenant.ranges = ("x:1-10",)
+    cfg.tenant.default_tenant = "house"
+    tm = TenantMap.from_config(cfg)
+    assert tm.tenant_of(5) == "x"
+    assert tm.tenant_of(99) == "house"
+
+
+# ---------------------------------------------------------------------------
+# TenantQuotas
+
+
+def test_quota_ttl_lapses_at_exactly_the_tick():
+    now = [0.0]
+    q = TenantQuotas(default_share=0.3, ttl_s=10.0,
+                     clock=lambda: now[0])
+    q.set_share("a", 0.8)
+    assert q.share_of("a") == 0.8
+    now[0] = 10.0 - 1e-9
+    assert q.share_of("a") == 0.8
+    now[0] = 10.0                        # closed boundary: lapsed AT
+    assert q.share_of("a") == 0.3
+    # the lapse dropped the entry — a refresh starts a fresh TTL
+    q.set_share("a", 0.9)
+    now[0] = 19.0
+    assert q.share_of("a") == 0.9
+    assert q.status()["default_share"] == 0.3
+
+
+# ---------------------------------------------------------------------------
+# FairShareWindow
+
+
+def test_window_rotates_at_exactly_the_quantum_tick():
+    now = [0.0]
+    w = FairShareWindow(quantum_s=1.0, max_share=0.5,
+                        clock=lambda: now[0])
+    for _ in range(4):
+        w.note("a")
+    assert w.counts() == {"a": 4}
+    now[0] = 1.0 - 1e-9
+    w.note("a")
+    assert w.counts() == {"a": 5}        # still the same window
+    now[0] = 1.0                         # closed boundary: rotate AT
+    w.note("a")
+    assert w.counts() == {"a": 1}
+    # rotation lands on the quantum grid even after an idle gap
+    now[0] = 5.7
+    w.note("b")
+    assert w.window_start() == 5.0
+
+
+def test_over_share_judges_current_share_not_next_request():
+    """Two equal tenants at exact equilibrium both ADMIT (alternation,
+    not mutual shed); the tenant strictly past both the cap and its
+    weighted fair share is over."""
+    w = FairShareWindow(quantum_s=100.0, max_share=0.4,
+                        clock=lambda: 0.0)
+    for _ in range(3):
+        w.note("a")
+        w.note("b")
+    # 50/50: both past the 0.4 cap but AT fair share — neither sheds
+    assert not w.over_share("a")
+    assert not w.over_share("b")
+    w.note("a")                          # a: 4/7 > cap and > 0.5 fair
+    assert w.over_share("a")
+    assert not w.over_share("b")
+
+
+def test_over_share_lone_tenant_never_penalized():
+    w = FairShareWindow(quantum_s=100.0, max_share=0.2,
+                        clock=lambda: 0.0)
+    for _ in range(50):
+        w.note("a")
+    # frac 1.0 > cap, but fair share among {a} alone is 1.0
+    assert not w.over_share("a")
+
+
+def test_over_share_respects_weights_and_cap_override():
+    w = FairShareWindow(quantum_s=100.0, max_share=0.1,
+                        weight_of=lambda t: 3.0 if t == "big" else 1.0,
+                        clock=lambda: 0.0)
+    for _ in range(3):
+        w.note("big")
+    w.note("small")
+    # big holds 3/4 = fair share exactly (3/(3+1)) — not over
+    assert not w.over_share("big")
+    assert not w.over_share("small")
+    w.note("big")                        # 4/5 > 0.75 fair
+    assert w.over_share("big")
+    # a generous per-tenant quota cap overrides the window ceiling
+    assert not w.over_share("big", share_cap=0.9)
+
+
+def test_empty_window_is_never_over_share():
+    w = FairShareWindow(clock=lambda: 0.0)
+    assert not w.over_share("anyone")
+
+
+# ---------------------------------------------------------------------------
+# AdmissionGate integration
+
+
+def _fair_gate(depth, max_share=0.5, quotas=None):
+    fair = FairShareWindow(quantum_s=1000.0, max_share=max_share,
+                           clock=lambda: 0.0)
+    gate = AdmissionGate(max_pending=8, control_reserve=2,
+                         depth_fn=lambda: depth,
+                         fairness=fair, quotas=quotas)
+    return gate, fair
+
+
+def test_storming_tenant_sheds_tenant_quota_with_tenant_label():
+    gate, _ = _fair_gate(depth=6)
+    shed0 = _metric(ADMISSION_SHED,
+                    {"surface": "service", "class": CLASS_DATA,
+                     "reason": SHED_TENANT_QUOTA, "tenant": "a"})
+    # b takes a modest share first
+    for _ in range(2):
+        assert gate.admit(CLASS_DATA, tenant="b") == (True, "")
+    # a storms: once past cap AND fair share, a sheds tenant-quota
+    a_admitted = a_shed = 0
+    for _ in range(10):
+        ok, reason = gate.admit(CLASS_DATA, tenant="a")
+        if ok:
+            a_admitted += 1
+        else:
+            assert reason == SHED_TENANT_QUOTA
+            a_shed += 1
+    assert a_admitted > 0 and a_shed > 0
+    assert _metric(ADMISSION_SHED,
+                   {"surface": "service", "class": CLASS_DATA,
+                    "reason": SHED_TENANT_QUOTA,
+                    "tenant": "a"}) == shed0 + a_shed
+    # b is NOT over its share: b still admits after a's storm
+    assert gate.admit(CLASS_DATA, tenant="b") == (True, "")
+
+
+def test_fairness_only_applies_when_congested():
+    # depth at half the bound or below: a lone burst rides idle
+    # capacity freely — fairness is a congestion policy, not a tax
+    gate, _ = _fair_gate(depth=4)
+    for _ in range(20):
+        assert gate.admit(CLASS_DATA, tenant="a") == (True, "")
+
+
+def test_control_class_never_tenant_shed():
+    gate, fair = _fair_gate(depth=6)
+    for _ in range(10):
+        fair.note("a")
+    assert gate.admit(CLASS_CONTROL, tenant="a") == (True, "")
+
+
+def test_quota_store_feeds_the_fairness_ceiling():
+    now = [0.0]
+    quotas = TenantQuotas(default_share=0.2, ttl_s=10.0,
+                          clock=lambda: now[0])
+    quotas.set_share("a", 0.95)
+    gate, fair = _fair_gate(depth=6, max_share=0.2, quotas=quotas)
+    fair.note("b")
+    # a's generous LIVE quota (0.95) overrides the 0.2 window ceiling
+    for _ in range(6):
+        assert gate.admit(CLASS_DATA, tenant="a") == (True, "")
+    # the quota lapses → conservative default 0.2: a now sheds
+    now[0] = 10.0
+    ok, reason = gate.admit(CLASS_DATA, tenant="a")
+    assert (ok, reason) == (False, SHED_TENANT_QUOTA)
+    # b keeps admitting through a's lapse
+    assert gate.admit(CLASS_DATA, tenant="b") == (True, "")
+
+
+def test_tenantless_requests_keep_pre_tenant_series_shape():
+    """A tenant-less admit/shed must not grow a tenant label — the
+    pre-ISSUE-20 series stay byte-identical for existing dashboards."""
+    gate = AdmissionGate(max_pending=1, depth_fn=lambda: 1)
+    shed0 = _metric(ADMISSION_SHED,
+                    {"surface": "service", "class": CLASS_DATA,
+                     "reason": admission.SHED_QUEUE_FULL})
+    assert gate.admit(CLASS_DATA) == (False, admission.SHED_QUEUE_FULL)
+    assert _metric(ADMISSION_SHED,
+                   {"surface": "service", "class": CLASS_DATA,
+                    "reason": admission.SHED_QUEUE_FULL}) == shed0 + 1
